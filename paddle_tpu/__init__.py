@@ -1,0 +1,207 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of the reference (GerHobbelt/Paddle, PaddlePaddle ~3.0-dev), built
+from scratch on JAX/XLA/Pallas/pjit.
+
+See /root/repo/SURVEY.md for the reference structural analysis and the
+architecture mapping this package implements.
+"""
+from __future__ import annotations
+
+# dtypes first (no jax-heavy imports)
+from .framework.dtype import (
+    bool_ as bool,  # noqa: A001 - paddle exports `paddle.bool`
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    complex64,
+    complex128,
+    float8_e4m3fn,
+    float8_e5m2,
+    set_default_dtype,
+    get_default_dtype,
+)
+
+from .tensor_class import Tensor, Parameter, is_tensor
+from .autograd import no_grad, enable_grad, set_grad_enabled, grad
+from .autograd.pylayer import PyLayer, PyLayerContext
+from .framework.random import seed, get_rng_state, set_rng_state
+from .framework import device
+from .framework.device import (
+    set_device,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    CPUPlace,
+    TPUPlace,
+    CUDAPlace,
+)
+
+from . import ops
+from .ops import registry as _registry
+
+# ---- re-export the functional surface at top level (paddle.* parity) --------
+from .ops.creation import (
+    to_tensor, zeros, ones, full, empty, zeros_like, ones_like, full_like,
+    empty_like, arange, linspace, logspace, eye, diag, diagflat, tril, triu,
+    tril_indices, triu_indices, meshgrid, clone, assign, rand, randn, randint,
+    randint_like, uniform, normal, standard_normal, randperm, bernoulli,
+    poisson, multinomial, complex, polar,
+)
+from .ops.math import (
+    abs, acos, acosh, asin, asinh, atan, atanh, ceil, cos, cosh, digamma, erf,
+    erfinv, exp, expm1, floor, lgamma, log, log10, log1p, log2, neg,
+    reciprocal, round, rsqrt, sigmoid, sign, sin, sinh, sqrt, square, tan,
+    tanh, trunc, frac, angle, conj, real, imag, deg2rad, rad2deg, isnan,
+    isinf, isfinite, logical_not, bitwise_not, add, subtract, multiply,
+    divide, floor_divide, remainder, mod, floor_mod, pow, maximum, minimum,
+    fmax, fmin, atan2, hypot, logaddexp, nextafter, copysign, heaviside, gcd,
+    lcm, ldexp, bitwise_and, bitwise_or, bitwise_xor, divide_no_nan, scale,
+    cast, clip, lerp, stanh, multiplex, addmm, inner, outer, logit,
+    polygamma, nan_to_num, trapezoid, diff, sum, mean, prod, max, min, amax,
+    amin, any, all, nansum, nanmean, median, nanmedian, std, var, logsumexp,
+    logcumsumexp, cumsum, cumprod, cummax, cummin, count_nonzero, argmax,
+    argmin, argsort, sort, topk, kthvalue, mode, equal, not_equal,
+    greater_than, greater_equal, less_than, less_equal, logical_and,
+    logical_or, logical_xor, allclose, isclose, equal_all, where,
+    masked_fill, isneginf, isposinf, isreal,
+)
+from .ops.manipulation import (
+    reshape, flatten, squeeze, unsqueeze, transpose, moveaxis, concat, stack,
+    split, chunk, unbind, unstack, tile, repeat_interleave, expand, expand_as,
+    broadcast_to, broadcast_tensors, flip, rot90, roll, slice, strided_slice,
+    crop, gather, gather_nd, take_along_axis, put_along_axis, scatter,
+    scatter_nd_add, scatter_nd, index_select, index_sample, index_add,
+    index_put, masked_select, take, unique, unique_consecutive, nonzero,
+    searchsorted, bucketize, as_complex, as_real, atleast_1d, atleast_2d,
+    atleast_3d, tensordot, tolist, numel, shard_index, swapaxes, pad,
+)
+from .ops.linalg import (
+    matmul, mm, dot, bmm, mv, t, cross, dist, norm, trace, diagonal, kron,
+    einsum, histogram, bincount,
+)
+from .ops import linalg
+from .autograd import backward as _backward_fn
+
+__version__ = "0.1.0"
+
+
+def flops(*args, **kwargs):  # paddle.flops parity — model profiler hook
+    from .hapi.summary import flops as _flops
+
+    return _flops(*args, **kwargs)
+
+
+def in_dynamic_mode() -> bool:
+    """Eager-vs-traced probe (paddle.in_dynamic_mode parity). Returns False
+    inside jit-traced code."""
+    import jax
+
+    try:
+        return jax.core.trace_state_clean()
+    except Exception:  # pragma: no cover - jax internal API drift
+        return True
+
+
+def get_flags(name=None):
+    from .utils import flags as _flags
+
+    return _flags.get_flags(name)
+
+
+def set_flags(d):
+    from .utils import flags as _flags
+
+    return _flags.set_flags(d)
+
+
+def save(obj, path, **kwargs):
+    from .framework_io import save as _save
+
+    return _save(obj, path, **kwargs)
+
+
+def load(path, **kwargs):
+    from .framework_io import load as _load
+
+    return _load(path, **kwargs)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+
+    return _summary(net, input_size, dtypes, input)
+
+
+def iinfo(dtype):
+    import numpy as np
+
+    from .framework.dtype import convert_dtype
+
+    return np.iinfo(convert_dtype(dtype))
+
+
+def finfo(dtype):
+    import jax.numpy as jnp
+
+    from .framework.dtype import convert_dtype
+
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def is_grad_enabled():
+    from .autograd.tape import grad_enabled
+
+    return grad_enabled()
+
+
+# subpackages (imported lazily in __getattr__ to keep import light and avoid
+# cycles: nn imports paddle_tpu at module load)
+_LAZY_SUBMODULES = (
+    "nn",
+    "optimizer",
+    "amp",
+    "io",
+    "jit",
+    "distributed",
+    "vision",
+    "metric",
+    "hapi",
+    "profiler",
+    "incubate",
+    "sparse",
+    "static",
+    "utils",
+    "text",
+    "audio",
+    "onnx",
+    "quantization",
+    "autograd",
+    "linalg",
+    "fft",
+    "signal",
+    "geometric",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "Model":
+        from .hapi.model import Model
+
+        return Model
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel
+
+        return DataParallel
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -1,0 +1,22 @@
+from .tape import (
+    backward,
+    grad,
+    no_grad,
+    enable_grad,
+    set_grad_enabled,
+    grad_enabled,
+    reset_tape,
+)
+
+# PyLayer imported lazily: pylayer.py needs tensor_class, which imports this
+# package for the tape (tensor → tape → [lazy] pylayer → tensor).
+
+
+def __getattr__(name):
+    if name in ("PyLayer", "PyLayerContext"):
+        from . import pylayer
+
+        globals()["PyLayer"] = pylayer.PyLayer
+        globals()["PyLayerContext"] = pylayer.PyLayerContext
+        return globals()[name]
+    raise AttributeError(f"module 'paddle_tpu.autograd' has no attribute {name!r}")

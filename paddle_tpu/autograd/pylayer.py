@@ -1,0 +1,99 @@
+"""PyLayer — user-defined autograd ops.
+
+Reference parity: paddle.autograd.PyLayer
+(paddle/fluid/eager/pylayer/, paddle/fluid/pybind/eager_py_layer.cc).
+TPU-native: the user's forward/backward pair becomes a custom tape node; the
+generic backward walk (autograd/tape.py) dispatches to ``run_backward``.
+"""
+from __future__ import annotations
+
+import weakref
+
+import jax.numpy as jnp
+
+from ..tensor_class import Tensor, unwrap, wrap
+from . import tape as _tape
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self._non_differentiable = set()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self._non_differentiable.update(id(t) for t in tensors)
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class _PyLayerNode:
+    """Tape node whose backward calls the user's backward() instead of jax.vjp."""
+
+    __slots__ = ("cls", "ctx", "in_tensors", "out_refs", "name", "__weakref__")
+
+    def __init__(self, cls, ctx, in_tensors, outputs):
+        self.cls = cls
+        self.ctx = ctx
+        self.in_tensors = tuple(in_tensors)
+        self.out_refs = tuple(weakref.ref(o) for o in outputs)
+        self.name = cls.__name__
+
+    def run_backward(self, outs, gs):
+        grads_in = []
+        for o, g in zip(outs, gs):
+            if g is None and self.ctx.materialize_grads and o is not None:
+                g = jnp.zeros_like(o._array)
+            grads_in.append(wrap(g) if g is not None else None)
+        result = self.cls.backward(self.ctx, *grads_in)
+        if not isinstance(result, (tuple, list)):
+            result = (result,)
+        return [unwrap(r) if isinstance(r, Tensor) else r for r in result]
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError("PyLayer subclasses are used via .apply(), not instantiated")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with @staticmethod forward(ctx, *args) / backward(ctx, *grads)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        outputs = cls.forward(ctx, *args, **kwargs)
+        outs = [outputs] if not isinstance(outputs, (tuple, list)) else list(outputs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        requires_grad = _tape.grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        out_tensors = [o for o in outs if isinstance(o, Tensor)]
+        if requires_grad and out_tensors:
+            node = _PyLayerNode(cls, ctx, tensor_inputs, out_tensors)
+            _tape._st().tape.append(node)
+            for o in out_tensors:
+                if id(o) not in ctx._non_differentiable:
+                    o.stop_gradient = False
+                    o._grad_node = node
+        return outputs

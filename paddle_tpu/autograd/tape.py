@@ -1,0 +1,230 @@
+"""Eager autograd engine: a gradient tape over functional JAX.
+
+Reference parity: the reference's eager autograd records a ``GradNode`` per op
+with saved inputs and runs a topologically-ordered backward queue walk
+(`paddle/fluid/eager/backward.cc:105,439`, `paddle/fluid/eager/grad_node_info.h`).
+
+TPU-native design: instead of per-op handwritten grad kernels, every recorded
+node stores the *pure jax function* and its input arrays; backward calls
+``jax.vjp`` on that function. Execution order on the tape is a valid
+topological order of the autograd DAG, so the backward pass is simply a
+reverse walk with cotangent accumulation — no in-degree bookkeeping needed.
+The performance-critical path does not use this engine at all: training steps
+are traced to a single XLA computation via ``jax.grad`` (see paddle_tpu.jit).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "tape"):
+        _state.tape = []
+        _state.enabled = True
+        _state.depth = 0
+    return _state
+
+
+def grad_enabled() -> bool:
+    return _st().enabled
+
+
+def set_grad_enabled(mode: bool) -> bool:
+    st = _st()
+    prev = st.enabled
+    st.enabled = bool(mode)
+    return prev
+
+
+class no_grad:
+    """paddle.no_grad parity — context manager and decorator."""
+
+    def __enter__(self):
+        self._prev = set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class GradNode:
+    """One recorded op: pure fn + input arrays + the participating tensors.
+
+    ``fn`` maps the *differentiable* input arrays to the op's output array(s)
+    (non-tensor and non-differentiable args are closed over).
+    """
+
+    __slots__ = ("fn", "in_arrays", "in_tensors", "out_refs", "name", "__weakref__")
+
+    def __init__(self, fn, in_arrays, in_tensors, outputs, name=""):
+        self.fn = fn
+        self.in_arrays = tuple(in_arrays)
+        self.in_tensors = tuple(in_tensors)  # strong refs: grads accumulate here
+        self.out_refs = tuple(weakref.ref(o) for o in outputs)
+        self.name = name
+
+
+def record(fn: Callable, in_arrays: Sequence[Any], in_tensors: Sequence[Any], outputs: Sequence[Any], name: str = ""):
+    """Append a node to the active tape and link outputs to it."""
+    node = GradNode(fn, in_arrays, in_tensors, outputs, name)
+    _st().tape.append(node)
+    for o in outputs:
+        o._grad_node = node
+    return node
+
+
+def reset_tape():
+    _st().tape = []
+
+
+def _ones_like(arr):
+    return jnp.ones_like(arr)
+
+
+def _zero_cotangent(p):
+    import numpy as np
+
+    if jnp.issubdtype(p.dtype, jnp.inexact):
+        return jnp.zeros_like(p)
+    return np.zeros(p.shape, dtype=jax.dtypes.float0)
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+    """Run reverse-mode accumulation from ``tensors`` over the recorded tape.
+
+    Parity: ``egr::Backward`` (paddle/fluid/eager/backward.cc:439). Leaf
+    tensors (those with stop_gradient=False and no grad node) receive ``.grad``
+    (the role of GradNodeAccumulation, paddle/fluid/eager/accumulation/).
+    """
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # cotangent accumulator keyed by tensor identity
+    cotan: dict[int, Any] = {}
+    keep_alive: dict[int, Any] = {}
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError("backward() called on a tensor with stop_gradient=True")
+        seed = g._array if hasattr(g, "_array") else g
+        if seed is None:
+            if t._array.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t._array.shape)}"
+                )
+            seed = _ones_like(t._array)
+        cotan[id(t)] = seed
+        keep_alive[id(t)] = t
+
+    tape = _st().tape
+    for node in reversed(tape):
+        outs = [r() for r in node.out_refs]
+        gs = [cotan.pop(id(o), None) if o is not None else None for o in outs]
+        for o in outs:
+            keep_alive.pop(id(o), None)
+        if all(g is None for g in gs):
+            continue
+        if hasattr(node, "run_backward"):
+            # custom node (PyLayer): user-supplied backward
+            in_grads = node.run_backward(outs, gs)
+        else:
+            # fill missing output cotangents with zeros (float0 for int outputs)
+            primals_out, vjp_fn = jax.vjp(node.fn, *node.in_arrays)
+            if isinstance(primals_out, (tuple, list)):
+                filled = tuple(
+                    g if g is not None else _zero_cotangent(p)
+                    for g, p in zip(gs, primals_out)
+                )
+                in_grads = vjp_fn(filled)
+            else:
+                in_grads = vjp_fn(gs[0])
+        for t, g in zip(node.in_tensors, in_grads):
+            if t is None or g is None or t.stop_gradient:
+                continue
+            tid = id(t)
+            if t._grad_node is None or t.is_leaf:
+                # leaf accumulation → .grad
+                t._accumulate_grad(g)
+            if t._grad_node is not None:
+                cotan[tid] = cotan[tid] + g if tid in cotan else g
+                keep_alive[tid] = t
+        # fire user hooks registered on output tensors
+        for o, g in zip(outs, gs):
+            if o is not None and g is not None and o._backward_hooks:
+                for hook in o._backward_hooks:
+                    hook(g)
+
+    if not retain_graph:
+        reset_tape()
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    allow_unused=False,
+):
+    """paddle.grad parity (paddle/fluid/eager/backward.cc:450 ``Grad``):
+    compute grads of outputs w.r.t. inputs without touching ``.grad``."""
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    saved = {id(t): t.grad for t in inputs}
+    for t in inputs:
+        t._clear_grad_internal()
+    retain = True if retain_graph is None else retain_graph
+    backward(list(outputs), grad_outputs, retain_graph=retain)
+    results = []
+    for t in inputs:
+        g = t.grad
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "One of the differentiated tensors appears to not have been used "
+                "in the graph. Set allow_unused=True if this is desired."
+            )
+        results.append(g)
+    # restore prior .grad values
+    for t in inputs:
+        t._set_grad_internal(saved[id(t)])
+    if retain_graph is None:
+        reset_tape()
+    return results

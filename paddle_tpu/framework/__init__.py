@@ -1,0 +1,7 @@
+from . import dtype, random, device
+from .dtype import (
+    set_default_dtype,
+    get_default_dtype,
+    convert_dtype,
+)
+from .random import seed, get_rng_state, set_rng_state, get_rng_state_tracker

@@ -1,0 +1,98 @@
+"""Device abstraction.
+
+Reference parity: paddle.device (python/paddle/device/__init__.py) +
+phi Place types. On this stack a "place" is a jax.Device; the default device
+is the first TPU chip when present, else CPU.
+"""
+from __future__ import annotations
+
+import jax
+
+_current = [None]
+
+
+class Place:
+    def __init__(self, device: "jax.Device"):
+        self._device = device
+
+    @property
+    def platform(self):
+        return self._device.platform
+
+    def __repr__(self):
+        return f"Place({self._device})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and self._device == other._device
+
+
+def _resolve(device):
+    if device is None:
+        return get_device_object()
+    if isinstance(device, Place):
+        return device._device
+    if hasattr(device, "platform"):
+        return device
+    if isinstance(device, str):
+        spec = device.lower()
+        if ":" in spec:
+            kind, idx = spec.split(":")
+            idx = int(idx)
+        else:
+            kind, idx = spec, 0
+        kind = {"gpu": "tpu", "xpu": "tpu", "cuda": "tpu"}.get(kind, kind)  # accelerator aliases
+        devs = [d for d in jax.devices() if d.platform.startswith(kind)] or (
+            jax.devices("cpu") if kind == "cpu" else []
+        )
+        if not devs:
+            raise ValueError(f"no device matching {device!r}; available: {jax.devices()}")
+        return devs[idx]
+    raise TypeError(f"cannot resolve device from {device!r}")
+
+
+def set_device(device: str):
+    _current[0] = _resolve(device)
+    return get_device()
+
+
+def get_device() -> str:
+    d = get_device_object()
+    return f"{d.platform}:{d.id}"
+
+
+def get_device_object():
+    if _current[0] is None:
+        _current[0] = jax.devices()[0]
+    return _current[0]
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def CPUPlace():
+    return Place(jax.devices("cpu")[0])
+
+
+def TPUPlace(idx=0):
+    return Place(jax.devices()[idx])
+
+
+CUDAPlace = TPUPlace  # API-compat alias: "the accelerator place"
+
+
+def synchronize():
+    """Block until all dispatched device work completes."""
+    (jax.device_put(0) + 0).block_until_ready()

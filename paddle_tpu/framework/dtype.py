@@ -1,0 +1,124 @@
+"""Dtype system for paddle_tpu.
+
+Mirrors the dtype surface of the reference framework (paddle's
+``paddle/phi/common/data_type.h`` and ``python/paddle/framework/dtype.py``)
+but is a thin veneer over numpy/jax dtypes: on TPU the canonical compute
+dtype is bfloat16 and the canonical accumulate dtype is float32.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtypes (exported at top level as paddle_tpu.float32 etc.)
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def convert_dtype(dtype):
+    """Normalise any dtype spec (str, np.dtype, jnp dtype, Tensor dtype) to a
+    numpy dtype object usable by jax.
+
+    Reference parity: ``python/paddle/base/data_feeder.py::convert_dtype``.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = dtype.replace("paddle.", "").replace("paddle_tpu.", "")
+        if name in _ALIASES:
+            return _canonical(np.dtype(_ALIASES[name]))
+        return _canonical(np.dtype(name))
+    return _canonical(np.dtype(dtype))
+
+
+def _canonical(d: "np.dtype") -> "np.dtype":
+    """Map 64-bit dtypes to their 32-bit TPU-native forms unless jax x64 is
+    enabled (TPUs have no fast 64-bit path; this mirrors jax canonicalization
+    without the per-op warning)."""
+    import jax
+
+    if jax.config.jax_enable_x64:
+        return d
+    if d == np.dtype(np.int64):
+        return np.dtype(np.int32)
+    if d == np.dtype(np.uint64):
+        return np.dtype(np.uint32)
+    if d == np.dtype(np.float64):
+        return np.dtype(np.float32)
+    if d == np.dtype(np.complex128):
+        return np.dtype(np.complex64)
+    return d
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity (python/paddle/framework/framework.py)."""
+    d = convert_dtype(d)
+    if d not in (np.dtype(jnp.float16), np.dtype(jnp.bfloat16), np.dtype(jnp.float32), np.dtype(jnp.float64)):
+        raise TypeError(f"set_default_dtype only supports floating dtypes, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return np.dtype(_DEFAULT_DTYPE[0]).name
+
+
+def default_float_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_floating_point_dtype(dtype) -> bool:
+    return np.issubdtype(convert_dtype(dtype), np.floating) or convert_dtype(dtype) in (
+        np.dtype(jnp.bfloat16),
+        np.dtype(jnp.float8_e4m3fn),
+        np.dtype(jnp.float8_e5m2),
+    )
+
+
+def is_integer_dtype(dtype) -> bool:
+    return np.issubdtype(convert_dtype(dtype), np.integer)
+
+
+def is_complex_dtype(dtype) -> bool:
+    return np.issubdtype(convert_dtype(dtype), np.complexfloating)
+
+
+def is_inexact_dtype(dtype) -> bool:
+    """True if gradients can flow through values of this dtype."""
+    return is_floating_point_dtype(dtype) or is_complex_dtype(dtype)

@@ -1,0 +1,120 @@
+"""Stateful-looking RNG over JAX's functional PRNG.
+
+The reference framework exposes a global seed (`paddle.seed`) plus per-mesh
+RNG state trackers for parallel layers
+(`python/paddle/distributed/fleet/layers/mpu/random.py::RNGStatesTracker`).
+We reproduce that surface:
+
+- Eager mode: a process-global key that is split on every draw.
+- Traced mode (inside ``paddle_tpu.jit``-compiled functions): random ops draw
+  from a *traced* key installed via :func:`rng_context`, so each compiled step
+  gets fresh randomness as an explicit input instead of baking a constant.
+- :class:`RNGStatesTracker` gives named RNG streams for tensor-parallel
+  regions (same-seed-in-replicated-regions / different-seed-per-mp-rank).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+_state = threading.local()
+
+
+def _global():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.key(0)
+        _state.seed_value = 0
+    return _state
+
+
+def seed(value: int):
+    """paddle.seed parity: reseed the global generator."""
+    st = _global()
+    st.key = jax.random.key(int(value))
+    st.seed_value = int(value)
+    return st.key
+
+
+def get_rng_state():
+    return _global().key
+
+
+def set_rng_state(key):
+    _global().key = key
+
+
+@contextlib.contextmanager
+def rng_context(key):
+    """Install a (possibly traced) key that next_key() draws from.
+
+    Used by the jit bridge: the compiled train step takes an explicit key
+    argument and installs it here so dropout etc. stays fresh per step.
+    """
+    st = _global()
+    prev = getattr(st, "ctx_key", None)
+    prev_count = getattr(st, "ctx_count", 0)
+    st.ctx_key = key
+    st.ctx_count = 0
+    try:
+        yield
+    finally:
+        st.ctx_key = prev
+        st.ctx_count = prev_count
+
+
+def in_rng_context() -> bool:
+    return getattr(_global(), "ctx_key", None) is not None
+
+
+def next_key():
+    """Return a fresh PRNG key (functional split under the hood)."""
+    st = _global()
+    ctx = getattr(st, "ctx_key", None)
+    if ctx is not None:
+        # Traced context: fold in a per-draw counter so multiple draws in one
+        # trace differ, while the key itself remains a traced value.
+        st.ctx_count += 1
+        return jax.random.fold_in(ctx, st.ctx_count)
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+class RNGStatesTracker:
+    """Named RNG streams, parity with the reference's mpu RNGStatesTracker
+    (fleet/layers/mpu/random.py): tensor-parallel dropout needs one stream
+    shared across mp ranks and one unique per rank."""
+
+    def __init__(self):
+        self.states = {}
+
+    def reset(self):
+        self.states = {}
+
+    def add(self, name: str, seed_: int):
+        if name in self.states:
+            raise ValueError(f"rng state {name} already exists")
+        self.states[name] = jax.random.key(int(seed_))
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self.states:
+            raise ValueError(f"rng state {name} does not exist")
+        st = _global()
+        prev = st.key
+        st.key = self.states[name]
+        try:
+            yield
+        finally:
+            self.states[name] = st.key
+            st.key = prev
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER

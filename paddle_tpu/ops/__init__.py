@@ -1,0 +1,136 @@
+"""Functional op surface (the `_C_ops`-analog of the reference, but public).
+
+Also installs the Tensor method/dunder surface: every functional op whose
+first argument is a tensor becomes a Tensor method, matching the reference's
+monkey-patched `paddle.Tensor` method table
+(python/paddle/tensor/__init__.py::tensor_method_func).
+"""
+from __future__ import annotations
+
+from . import registry, math, creation, manipulation, linalg, indexing
+from ..tensor_class import Tensor, Parameter, unwrap, wrap
+
+
+def _install_tensor_methods():
+    import jax.numpy as jnp
+
+    T = Tensor
+
+    # operator dunders
+    T.__add__ = lambda s, o: math.add(s, _coerce(o, s))
+    T.__radd__ = lambda s, o: math.add(_coerce(o, s), s)
+    T.__sub__ = lambda s, o: math.subtract(s, _coerce(o, s))
+    T.__rsub__ = lambda s, o: math.subtract(_coerce(o, s), s)
+    T.__mul__ = lambda s, o: math.multiply(s, _coerce(o, s))
+    T.__rmul__ = lambda s, o: math.multiply(_coerce(o, s), s)
+    T.__truediv__ = lambda s, o: math.divide(s, _coerce(o, s))
+    T.__rtruediv__ = lambda s, o: math.divide(_coerce(o, s), s)
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, _coerce(o, s))
+    T.__rfloordiv__ = lambda s, o: math.floor_divide(_coerce(o, s), s)
+    T.__mod__ = lambda s, o: math.remainder(s, _coerce(o, s))
+    T.__rmod__ = lambda s, o: math.remainder(_coerce(o, s), s)
+    T.__pow__ = lambda s, o: math.pow(s, _coerce(o, s))
+    T.__rpow__ = lambda s, o: math.pow(_coerce(o, s), s)
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+    T.__eq__ = lambda s, o: math.equal(s, _coerce(o, s))
+    T.__ne__ = lambda s, o: math.not_equal(s, _coerce(o, s))
+    T.__lt__ = lambda s, o: math.less_than(s, _coerce(o, s))
+    T.__le__ = lambda s, o: math.less_equal(s, _coerce(o, s))
+    T.__gt__ = lambda s, o: math.greater_than(s, _coerce(o, s))
+    T.__ge__ = lambda s, o: math.greater_equal(s, _coerce(o, s))
+    T.__and__ = lambda s, o: math.bitwise_and(s, _coerce(o, s))
+    T.__or__ = lambda s, o: math.bitwise_or(s, _coerce(o, s))
+    T.__xor__ = lambda s, o: math.bitwise_xor(s, _coerce(o, s))
+    T.__invert__ = lambda s: math.bitwise_not(s)
+
+    # method table from functional ops (first-arg-is-tensor convention)
+    method_sources = {
+        math: [
+            "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil", "cos",
+            "cosh", "digamma", "erf", "erfinv", "exp", "expm1", "floor", "lgamma",
+            "log", "log10", "log1p", "log2", "neg", "reciprocal", "round", "rsqrt",
+            "sigmoid", "sign", "sin", "sinh", "sqrt", "square", "tan", "tanh",
+            "trunc", "frac", "angle", "conj", "real", "imag", "deg2rad", "rad2deg",
+            "isnan", "isinf", "isfinite", "logical_not", "bitwise_not",
+            "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+            "mod", "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "hypot",
+            "logaddexp", "copysign", "heaviside", "gcd", "lcm", "ldexp",
+            "bitwise_and", "bitwise_or", "bitwise_xor",
+            "scale", "clip", "lerp", "stanh", "addmm", "inner", "outer", "logit",
+            "nan_to_num", "diff", "sum", "mean", "prod", "max", "min", "amax",
+            "amin", "any", "all", "nansum", "nanmean", "median", "nanmedian",
+            "std", "var", "logsumexp", "logcumsumexp", "cumsum", "cumprod",
+            "cummax", "cummin", "count_nonzero", "argmax", "argmin", "argsort",
+            "sort", "topk", "kthvalue", "mode",
+            "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+            "less_equal", "logical_and", "logical_or", "logical_xor", "allclose",
+            "isclose", "equal_all", "where", "masked_fill",
+        ],
+        manipulation: [
+            "reshape", "reshape_", "flatten", "squeeze", "unsqueeze", "transpose",
+            "moveaxis", "swapaxes", "split", "chunk", "unbind", "unstack", "tile",
+            "repeat_interleave", "expand", "expand_as", "broadcast_to", "flip",
+            "rot90", "roll", "slice", "strided_slice", "pad", "gather", "gather_nd",
+            "take_along_axis", "put_along_axis", "scatter", "scatter_nd_add",
+            "index_select", "index_sample", "index_add", "index_put",
+            "masked_select", "take", "unique", "unique_consecutive", "nonzero",
+            "tensordot", "tolist",
+        ],
+        linalg: [
+            "matmul", "mm", "dot", "bmm", "mv", "t", "cross", "dist", "norm",
+            "trace", "diagonal", "kron", "matrix_power", "cholesky", "qr", "svd",
+            "eig", "eigvals", "inverse", "pinv", "solve", "det", "slogdet",
+            "matrix_rank", "bincount", "histogram",
+        ],
+        creation: ["diag", "diagflat", "tril", "triu", "clone"],
+    }
+    for mod, names in method_sources.items():
+        for name in names:
+            fn = getattr(mod, name, None)
+            if fn is not None and not hasattr(T, name):
+                setattr(T, name, fn)
+
+    # astype-family already defined on Tensor; cast alias handled there
+    T.cast = lambda s, dtype: math.cast(s, dtype)
+    T.astype = T.cast
+
+    # in-place variants (add_, clip_, ...): compute then swap payload
+    def _make_inplace(fn):
+        def method(self, *a, **k):
+            out = fn(self, *a, **k)
+            self._array = out._array
+            self._grad_node = out._grad_node
+            return self
+
+        return method
+
+    for name in [
+        "add", "subtract", "multiply", "divide", "clip", "scale", "exp", "sqrt",
+        "rsqrt", "reciprocal", "round", "floor", "ceil", "abs", "tanh", "sigmoid",
+        "remainder", "lerp", "pow",
+    ]:
+        setattr(T, name + "_", _make_inplace(getattr(math, name)))
+    T.flatten_ = _make_inplace(manipulation.flatten)
+    T.squeeze_ = _make_inplace(manipulation.squeeze)
+    T.unsqueeze_ = _make_inplace(manipulation.unsqueeze)
+    T.scatter_ = _make_inplace(manipulation.scatter)
+    T.uniform_ = creation.uniform_
+    T.normal_ = creation.normal_
+
+
+def _coerce(o, like):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if isinstance(o, Tensor):
+        return o
+    if isinstance(o, (int, float, bool, complex)):
+        return o  # scalars pass straight to jnp (weak typing preserves dtype)
+    return wrap(jnp.asarray(np.asarray(o)))
+
+
+_install_tensor_methods()

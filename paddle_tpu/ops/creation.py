@@ -1,0 +1,243 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py + random.py. Random ops use
+the stateful-looking RNG in framework/random.py (global key splitting eagerly,
+context key under trace).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_class import Tensor, wrap, unwrap
+from ..framework import dtype as _dtype_mod
+from ..framework import random as _random
+from .registry import apply
+
+
+def _dt(dtype):
+    return _dtype_mod.convert_dtype(dtype) if dtype is not None else _dtype_mod.default_float_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if not isinstance(s, int) else s for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        arr = data._array
+        if dtype is not None:
+            arr = arr.astype(_dtype_mod.convert_dtype(dtype))
+        t = wrap(arr, stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    return wrap(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return wrap(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    if dtype is None:
+        arr = jnp.full(_shape(shape), fill_value)
+        if arr.dtype == jnp.float64:
+            arr = arr.astype(_dtype_mod.default_float_dtype())
+    else:
+        arr = jnp.full(_shape(shape), fill_value, dtype=_dt(dtype))
+    return wrap(arr)
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return wrap(jnp.zeros_like(unwrap(x), dtype=_dtype_mod.convert_dtype(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None):
+    return wrap(jnp.ones_like(unwrap(x), dtype=_dtype_mod.convert_dtype(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return wrap(jnp.full_like(unwrap(x), unwrap(fill_value), dtype=_dtype_mod.convert_dtype(dtype) if dtype else None))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        py = (start, end, step)
+        dtype = _dtype_mod.convert_dtype("int64") if all(isinstance(v, (int, np.integer)) for v in py) else _dtype_mod.default_float_dtype()
+    return wrap(jnp.arange(start, end, step, dtype=_dtype_mod.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return wrap(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return wrap(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return wrap(jnp.eye(int(num_rows), int(num_columns) if num_columns else None, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(x):
+        if x.ndim == 1 and padding_value != 0:
+            base = jnp.diag(x, k=offset)
+            mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+            return jnp.where(mask, base, jnp.asarray(padding_value, dtype=x.dtype))
+        return jnp.diag(x, k=offset)
+
+    return apply("diag", fn, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", lambda x: jnp.diagflat(x, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", lambda x: jnp.tril(x, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", lambda x: jnp.triu(x, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return wrap(jnp.asarray(np.stack([r, c]).astype(_dtype_mod.convert_dtype(dtype))))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return wrap(jnp.asarray(np.stack([r, c]).astype(_dtype_mod.convert_dtype(dtype))))
+
+
+def meshgrid(*args, **kwargs):
+    arrays = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [wrap(a) for a in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+def clone(x, name=None):
+    return apply("clone", lambda a: a + 0, x)
+
+
+def assign(x, output=None):
+    arr = jnp.asarray(unwrap(x) if isinstance(x, Tensor) else np.asarray(x))
+    if output is not None:
+        output.set_value(arr)
+        return output
+    return wrap(arr)
+
+
+def complex(real, imag, name=None):
+    return apply("complex", jax.lax.complex, real, imag)
+
+
+def polar(abs, angle, name=None):
+    return apply("polar", lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)), abs, angle)
+
+
+# ---- random ------------------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    key = _random.next_key()
+    return wrap(jax.random.normal(key, _shape(shape), dtype=_dt(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = _random.next_key()
+    return wrap(jax.random.randint(key, _shape(shape), int(low), int(high), dtype=_dtype_mod.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dtype = dtype or x.dtype
+    return randint(low, high, tuple(unwrap(x).shape), dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else _random.next_key()
+    return wrap(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype), minval=float(unwrap(min)), maxval=float(unwrap(max))))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        shape = tuple(np.broadcast_shapes(np.shape(unwrap(mean)), np.shape(unwrap(std))))
+        key = _random.next_key()
+        z = jax.random.normal(key, shape, dtype=_dt(None))
+        return wrap(unwrap(mean) + z * unwrap(std))
+    key = _random.next_key()
+    z = jax.random.normal(key, _shape(shape), dtype=_dt(None))
+    return wrap(mean + std * z)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype, name)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = _random.next_key()
+    return wrap(jax.random.permutation(key, int(n)).astype(_dtype_mod.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    key = _random.next_key()
+    return wrap(jax.random.bernoulli(key, unwrap(x)).astype(unwrap(x).dtype))
+
+
+def poisson(x, name=None):
+    key = _random.next_key()
+    return wrap(jax.random.poisson(key, unwrap(x)).astype(unwrap(x).dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = _random.next_key()
+    arr = unwrap(x)
+    logits = jnp.log(jnp.clip(arr, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(*arr.shape[:-1], num_samples) if arr.ndim > 1 else (num_samples,))
+        if arr.ndim > 1:
+            out = out.reshape(*arr.shape[:-1], num_samples)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, arr.shape, dtype=jnp.float32)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return wrap(out.astype(_dtype_mod.convert_dtype("int64")))
+
+
+def normal_(tensor, mean=0.0, std=1.0):
+    key = _random.next_key()
+    tensor._array = mean + std * jax.random.normal(key, tensor._array.shape, dtype=tensor._array.dtype)
+    return tensor
+
+
+def uniform_(tensor, min=-1.0, max=1.0):
+    key = _random.next_key()
+    tensor._array = jax.random.uniform(key, tensor._array.shape, dtype=tensor._array.dtype, minval=min, maxval=max)
+    return tensor

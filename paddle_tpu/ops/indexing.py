@@ -1,0 +1,75 @@
+"""Tensor __getitem__/__setitem__ with Paddle semantics.
+
+Reference parity: python/paddle/base/variable_index.py + the stride/view
+kernels. Advanced indexing maps to jnp gather; setitem maps to ``.at[...]``
+functional updates (the tensor wrapper mutates to point at the new array,
+which is the eager-mode illusion of in-place assignment).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_class import Tensor, unwrap, wrap
+from .registry import apply
+
+
+def _norm_index(idx):
+    """Unwrap Tensors inside an index expression to plain arrays."""
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, Tensor):
+        arr = idx._array
+        if arr.dtype == jnp.bool_:
+            return np.asarray(arr)  # boolean mask → host (data-dependent shape)
+        return arr
+    if isinstance(idx, (list, np.ndarray)):
+        a = np.asarray(idx)
+        return a
+    return idx
+
+
+def getitem(x, idx):
+    pure_idx = _norm_index(idx)
+
+    has_bool = _contains_bool(pure_idx)
+    if has_bool:
+        # data-dependent result shape: evaluate eagerly outside trace
+        return wrap(jnp.asarray(np.asarray(unwrap(x))[_to_numpy_index(pure_idx)]), x.stop_gradient)
+
+    def fn(a):
+        return a[pure_idx]
+
+    return apply("getitem", fn, x)
+
+
+def _contains_bool(idx):
+    if isinstance(idx, tuple):
+        return any(_contains_bool(i) for i in idx)
+    return isinstance(idx, np.ndarray) and idx.dtype == np.bool_
+
+
+def _to_numpy_index(idx):
+    if isinstance(idx, tuple):
+        return tuple(_to_numpy_index(i) for i in idx)
+    if hasattr(idx, "dtype") and not isinstance(idx, np.ndarray):
+        return np.asarray(idx)
+    return idx
+
+
+def setitem_(x, idx, value):
+    """In-place setitem: functional .at[] update swapped into the wrapper."""
+    pure_idx = _norm_index(idx)
+    v = unwrap(value) if isinstance(value, Tensor) else value
+
+    def fn(a, vv):
+        vv = jnp.asarray(vv, dtype=a.dtype)
+        return a.at[pure_idx].set(vv)
+
+    if isinstance(value, Tensor):
+        out = apply("setitem", fn, x, value)
+    else:
+        out = apply("setitem", lambda a: a.at[pure_idx].set(jnp.asarray(v, dtype=a.dtype)), x)
+    x._array = out._array
+    x._grad_node = out._grad_node
+    return x

@@ -1,0 +1,312 @@
+"""Linear algebra ops.
+
+Reference parity: python/paddle/tensor/linalg.py (e.g. ``matmul`` at :219) and
+paddle/phi/kernels/{gpu,impl}/matmul_*, plus the ``paddle.linalg`` namespace.
+Matmuls are the MXU path: keep them batched, let XLA tile them; bf16 inputs
+with f32 accumulation via ``preferred_element_type``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_class import unwrap, wrap
+from .registry import apply, defop
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return apply("matmul", fn, x, y)
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    def fn(a, b):
+        return jnp.sum(a * b, axis=-1)
+
+    return apply("dot", fn, x, y)
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, x, y)
+
+
+def mv(x, vec, name=None):
+    return apply("mv", jnp.matmul, x, vec)
+
+
+def t(x, name=None):
+    return apply("t", lambda a: a.T if a.ndim == 2 else a, x)
+
+
+@defop("cross")
+def cross(x, y, axis=9):
+    ax = axis if axis != 9 else None
+    if ax is None:
+        # first axis with dim 3 (paddle semantics)
+        ax = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=ax)
+
+
+@defop("dist")
+def dist(x, y, p=2.0):
+    d = (x - y).reshape(-1)
+    if p == 0:
+        return jnp.sum(d != 0).astype(x.dtype)
+    if jnp.isinf(p):
+        return jnp.max(jnp.abs(d)) if p > 0 else jnp.min(jnp.abs(d))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(a):
+        pp = p
+        if pp is None:
+            pp = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+        if pp == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis, keepdims=keepdim))
+        if pp == "nuc":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return jnp.sum(s, axis=-1, keepdims=keepdim)
+        if isinstance(axis, (list, tuple)) and len(axis) == 2:
+            return jnp.linalg.norm(a, ord=pp, axis=tuple(axis), keepdims=keepdim)
+        if np.isinf(pp):
+            red = jnp.max if pp > 0 else jnp.min
+            return red(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), pp), axis=axis, keepdims=keepdim), 1.0 / pp)
+
+    return apply("norm", fn, x)
+
+
+vector_norm = norm
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return norm(x, p, list(axis), keepdim, name)
+
+
+@defop("trace")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    # place vector(s) on the diagonal of a new matrix
+    out = jnp.zeros((*x.shape, x.shape[-1] + abs(offset)), dtype=x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    if offset >= 0:
+        out = out.at[..., idx, idx + offset].set(x) if False else jnp.apply_along_axis
+    # simpler: use vectorized construction
+    n = x.shape[-1] + abs(offset)
+    eye = jnp.eye(n, dtype=x.dtype)
+    base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    rows = jnp.arange(x.shape[-1]) + (0 if offset >= 0 else -offset)
+    cols = jnp.arange(x.shape[-1]) + (offset if offset >= 0 else 0)
+    base = base.at[..., rows, cols].set(x)
+    if dim1 != -2 or dim2 != -1:
+        base = jnp.moveaxis(base, (-2, -1), (dim1, dim2))
+    return base
+
+
+@defop("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@defop("matrix_power")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@defop("multi_dot")
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+# ---- decompositions / solvers ------------------------------------------------
+
+@defop("cholesky")
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@defop("cholesky_solve")
+def cholesky_solve(x, y, upper=False):
+    L = jnp.swapaxes(y, -1, -2).conj() if upper else y
+    z = jax.scipy.linalg.solve_triangular(L, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(jnp.swapaxes(L, -1, -2).conj(), z, lower=False)
+
+
+def qr(x, mode="reduced", name=None):
+    def fn(a):
+        return jnp.linalg.qr(a, mode=mode)
+
+    out = apply("qr", fn, x)
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    def fn(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+    return apply("svd", fn, x)
+
+
+def svdvals(x, name=None):
+    return apply("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False), x)
+
+
+def eig(x, name=None):
+    return apply("eig", jnp.linalg.eig, x, differentiable=False)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", lambda a: jnp.linalg.eigh(a, UPLO=UPLO), x)
+
+
+def eigvals(x, name=None):
+    return apply("eigvals", jnp.linalg.eigvals, x, differentiable=False)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle uses 1-based pivots
+
+    out = apply("lu", fn, x, differentiable=False)
+    if get_infos:
+        return out[0], out[1], wrap(jnp.zeros((), dtype=jnp.int32))
+    return out
+
+
+@defop("inverse")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+inv = inverse
+
+
+@defop("pinv")
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@defop("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@defop("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+    )
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int32), sv
+
+    return apply("lstsq", fn, x, y, differentiable=False)
+
+
+@defop("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet], axis=0) if sign.ndim == 0 else jnp.stack([sign, logdet])
+
+    return apply("slogdet", fn, x)
+
+
+@defop("matrix_rank", differentiable=False)
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@defop("cond", differentiable=False)
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@defop("corrcoef")
+def corrcoef(x, rowvar=True):
+    a = x if rowvar else x.T
+    return jnp.corrcoef(a)
+
+
+@defop("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fweights, aweights=aweights)
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    a = unwrap(x)
+    rng = None if (min == 0 and max == 0) else (min, max)
+    h, _ = jnp.histogram(a, bins=bins, range=rng)
+    return wrap(h.astype(_dtype_mod.convert_dtype("int64")))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    a = np.asarray(unwrap(x))
+    h, edges = np.histogramdd(a, bins=bins, range=ranges, density=density, weights=np.asarray(unwrap(weights)) if weights is not None else None)
+    return wrap(jnp.asarray(h)), [wrap(jnp.asarray(e)) for e in edges]
+
+
+@defop("bincount", differentiable=False)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+def einsum(equation, *operands, **kwargs):
+    return apply("einsum", lambda *ops: jnp.einsum(equation, *ops), *operands)
+
+
+@defop("householder_product")
+def householder_product(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+
+    def single(a, t):
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[:, i])
+            v = v.at[i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v.conj())
+            q = q @ h
+        return q[:, :n]
+
+    if x.ndim == 2:
+        return single(x, tau)
+    batch = x.reshape((-1, m, n))
+    taub = tau.reshape((-1, n))
+    out = jax.vmap(single)(batch, taub)
+    return out.reshape(x.shape[:-2] + (m, n))

@@ -1,0 +1,478 @@
+"""Shape / layout manipulation ops.
+
+Reference parity: python/paddle/tensor/manipulation.py + the stride/view
+kernels (paddle/phi/kernels/stride/). On XLA views vs copies is moot — the
+compiler handles layout — so every op here is a pure functional transform.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_class import Tensor, unwrap, wrap
+from .registry import apply, defop
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy().tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    shape = _norm_shape(shape)
+    return apply("reshape", lambda a: jnp.reshape(a, shape), x)
+
+
+view = reshape
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._array, x._grad_node = out._array, out._grad_node
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(a):
+        nd = a.ndim
+        s, e = start_axis % nd if nd else 0, stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+
+    return apply("flatten", fn, x)
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return apply("squeeze", fn, x)
+
+
+def unsqueeze(x, axis, name=None):
+    def fn(a):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = a
+        for ax in sorted(int(unwrap(v)) for v in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+
+    return apply("unsqueeze", fn, x)
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return apply("transpose", lambda a: jnp.transpose(a, perm), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+transpose_ = swapaxes
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis))
+    return apply("concat", lambda xs: jnp.concatenate(xs, axis=axis), list(x))
+
+
+def stack(x, axis=0, name=None):
+    return apply("stack", lambda xs: jnp.stack(xs, axis=axis), list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis))
+
+    def fn(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        sections = [int(unwrap(s)) for s in num_or_sections]
+        total = a.shape[axis]
+        known = builtins_sum(s for s in sections if s >= 0)
+        sections = [s if s >= 0 else total - known for s in sections]
+        offsets = np.cumsum(sections)[:-1].tolist()
+        return tuple(jnp.split(a, offsets, axis=axis))
+
+    return list(apply("split", fn, x))
+
+
+def builtins_sum(it):
+    import builtins
+
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis, name)
+
+
+def unbind(x, axis=0, name=None):
+    n = unwrap(x).shape[axis]
+
+    def fn(a):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+
+    return list(apply("unbind", fn, x))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis, name)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _norm_shape(repeat_times)
+    return apply("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    repeats = unwrap(repeats)
+    return apply("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+def expand(x, shape, name=None):
+    shape = _norm_shape(shape)
+
+    def fn(a):
+        tgt = list(shape)
+        # -1 means keep original dim
+        offset = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - offset]
+        return jnp.broadcast_to(a, tuple(tgt))
+
+    return apply("expand", fn, x)
+
+
+def expand_as(x, y, name=None):
+    return apply("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape, name)
+
+
+def broadcast_tensors(inputs, name=None):
+    def fn(xs):
+        shape = np.broadcast_shapes(*[a.shape for a in xs])
+        return tuple(jnp.broadcast_to(a, shape) for a in xs)
+
+    return list(apply("broadcast_tensors", fn, list(inputs)))
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply("flip", lambda a: jnp.flip(a, axis=tuple(axes)), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply("roll", lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def slice(x, axes, starts, ends, name=None):
+    def fn(a):
+        idx = [builtins_slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins_slice(int(unwrap(s)), int(unwrap(e)))
+        return a[tuple(idx)]
+
+    return apply("slice", fn, x)
+
+
+def builtins_slice(*args):
+    return __builtins__["slice"](*args) if isinstance(__builtins__, dict) else slice.__self__  # pragma: no cover
+
+
+# simpler: capture python slice builtin before shadowing
+import builtins as _builtins
+
+def builtins_slice(*args):  # noqa: F811
+    return _builtins.slice(*args)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(a):
+        idx = [_builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = _builtins.slice(int(unwrap(s)), int(unwrap(e)), int(unwrap(st)))
+        return a[tuple(idx)]
+
+    return apply("strided_slice", fn, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def fn(a):
+        offs = [int(unwrap(o)) for o in (offsets or [0] * a.ndim)]
+        shp = [int(unwrap(s)) for s in (shape or a.shape)]
+        shp = [a.shape[i] - offs[i] if s == -1 else s for i, s in enumerate(shp)]
+        return jax.lax.dynamic_slice(a, offs, shp)
+
+    return apply("crop", fn, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def fn(a):
+        pads = [int(unwrap(p)) for p in pad]
+        if len(pads) == 2 * a.ndim:
+            cfg = [(pads[2 * i], pads[2 * i + 1]) for i in range(a.ndim)]
+        else:
+            # paddle semantics: pad applies to last len(pad)//2 spatial dims,
+            # ordered from last dim backwards, optionally per data_format
+            cfg = [(0, 0)] * a.ndim
+            nspatial = len(pads) // 2
+            if data_format.endswith("C") and a.ndim >= 3:  # NHWC-style
+                dims = list(range(a.ndim - 1 - nspatial, a.ndim - 1))
+            else:
+                dims = list(range(a.ndim - nspatial, a.ndim))
+            for i, d in enumerate(dims):
+                cfg[d] = (pads[2 * i], pads[2 * i + 1])
+        if mode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(a, cfg, mode=jmode)
+
+    return apply("pad", fn, x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis_v = int(unwrap(axis))
+    return apply("gather", lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis_v), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def fn(a, i):
+        i = i.astype(jnp.int32)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+
+    return apply("gather_nd", fn, x, index)
+
+
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    def fn(a, i):
+        return jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis)
+
+    return apply("take_along_axis", fn, x, indices)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
+    def fn(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(jnp.asarray(v, dtype=a.dtype), i.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        elif reduce in ("add", "sum"):
+            dnums = None
+            out = a
+            # scatter-add via segment trick: use jnp.zeros + at[].add on moved axis
+            idx_grid = jnp.indices(i.shape)
+            full_idx = list(idx_grid)
+            full_idx[axis] = i
+            return out.at[tuple(full_idx)].add(v)
+        elif reduce in ("mul", "multiply"):
+            idx_grid = jnp.indices(i.shape)
+            full_idx = list(idx_grid)
+            full_idx[axis] = i
+            return a.at[tuple(full_idx)].multiply(v)
+        raise ValueError(f"unsupported reduce {reduce}")
+
+    return apply("put_along_axis", fn, x, indices, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(a, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        return a.at[i].add(u)
+
+    return apply("scatter", fn, x, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(a, i, u):
+        i = i.astype(jnp.int32)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+
+    return apply("scatter_nd_add", fn, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def fn(i, u):
+        zeros = jnp.zeros(_norm_shape(shape), dtype=u.dtype)
+        idx = tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))
+        return zeros.at[idx].add(u)
+
+    return apply("scatter_nd", fn, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis), x, index)
+
+
+def index_sample(x, index):
+    def fn(a, i):
+        return jnp.take_along_axis(a, i.astype(jnp.int32), axis=1)
+
+    return apply("index_sample", fn, x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(a, i, v):
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = am.at[i.astype(jnp.int32)].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply("index_add", fn, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def fn(a, v, *idx):
+        idx = tuple(ix.astype(jnp.int32) if jnp.issubdtype(ix.dtype, jnp.integer) else ix for ix in idx)
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v)
+
+    return apply("index_put", fn, x, value, *indices)
+
+
+def masked_select(x, mask, name=None):
+    """Note: output shape is data-dependent — eager only, not jittable."""
+    a, m = unwrap(x), unwrap(mask)
+    return wrap(a[np.asarray(m)])
+
+
+def take(x, index, mode="raise", name=None):
+    def fn(a, i):
+        i = i.astype(jnp.int32)
+        flat = a.reshape(-1)
+        if mode == "wrap":
+            i = jnp.mod(i, flat.shape[0])
+        elif mode == "clip":
+            i = jnp.clip(i, 0, flat.shape[0] - 1)
+        return flat[i]
+
+    return apply("take", fn, x, index)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    """Data-dependent output shape — eager host-side op."""
+    a = np.asarray(unwrap(x))
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return wrap(jnp.asarray(res))
+    return tuple(wrap(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.concatenate([[True], a[1:] != a[:-1]])
+        out = a[keep]
+    else:
+        diff = np.any(np.diff(a, axis=axis) != 0, axis=tuple(i for i in range(a.ndim) if i != axis))
+        keep = np.concatenate([[True], diff])
+        out = np.take(a, np.where(keep)[0], axis=axis)
+    results = [wrap(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(~keep) if axis is None else np.cumsum(~keep)
+        results.append(wrap(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.where(np.concatenate([keep, [True]]))[0] if axis is None else np.where(np.concatenate([keep, [True]]))[0]
+        counts = np.diff(np.where(np.concatenate([keep, [True]]))[0])
+        results.append(wrap(jnp.asarray(counts)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def nonzero(x, as_tuple=False):
+    """Data-dependent output shape — eager host-side op."""
+    a = np.asarray(unwrap(x))
+    idx = np.nonzero(a)
+    if as_tuple:
+        return tuple(wrap(jnp.asarray(i.astype(np.int64))) for i in idx)
+    return wrap(jnp.asarray(np.stack(idx, axis=-1).astype(np.int64)))
+
+
+def where_index(condition):
+    return nonzero(condition)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def fn(s, v):
+        side = "right" if right else "left"
+        out = jnp.searchsorted(s, v, side=side) if s.ndim == 1 else jax.vmap(
+            lambda ss, vv: jnp.searchsorted(ss, vv, side=side)
+        )(s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else _dtype_mod.convert_dtype("int64"))
+
+    return apply("searchsorted", fn, sorted_sequence, values, differentiable=False)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensordot(x, y, axes=2, name=None):
+    axes_v = unwrap(axes)
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes_v), x, y)
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def numel(x, name=None):
+    return wrap(jnp.asarray(unwrap(x).size, dtype=_dtype_mod.convert_dtype("int64")))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(a):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+        in_range = (a >= lo) & (a < hi)
+        return jnp.where(in_range, a - lo, ignore_value)
+
+    return apply("shard_index", fn, input, differentiable=False)

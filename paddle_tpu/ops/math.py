@@ -1,0 +1,431 @@
+"""Elementwise / reduction / cast math ops.
+
+Reference parity: paddle/phi/kernels/{cpu,gpu}/*_kernel.* + python surface
+python/paddle/tensor/math.py. All impls are jax.numpy — XLA fuses elementwise
+chains into single kernels, which replaces the reference's handwritten fused
+CUDA kernels and most of CINN's job (SURVEY.md §7 architecture mapping).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import apply, defop, register_op, unary_from_jnp
+from ..framework import dtype as _dtype_mod
+
+# ---- unary elementwise -------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs,
+    "acos": jnp.arccos,
+    "acosh": jnp.arccosh,
+    "asin": jnp.arcsin,
+    "asinh": jnp.arcsinh,
+    "atan": jnp.arctan,
+    "atanh": jnp.arctanh,
+    "ceil": jnp.ceil,
+    "cos": jnp.cos,
+    "cosh": jnp.cosh,
+    "digamma": jax.scipy.special.digamma,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "floor": jnp.floor,
+    "i0": lambda x: jax.scipy.special.i0(x),
+    "i1": lambda x: jax.scipy.special.i1(x),
+    "lgamma": jax.scipy.special.gammaln,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "log2": jnp.log2,
+    "neg": jnp.negative,
+    "reciprocal": jnp.reciprocal,
+    "round": jnp.round,
+    "rsqrt": jax.lax.rsqrt,
+    "sigmoid": jax.nn.sigmoid,
+    "sign": jnp.sign,
+    "sin": jnp.sin,
+    "sinh": jnp.sinh,
+    "sqrt": jnp.sqrt,
+    "square": jnp.square,
+    "tan": jnp.tan,
+    "tanh": jnp.tanh,
+    "trunc": jnp.trunc,
+    "frac": lambda x: x - jnp.trunc(x),
+    "angle": jnp.angle,
+    "conj": jnp.conj,
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg,
+}
+
+_g = globals()
+for _name, _fn in _UNARY.items():
+    _g[_name] = unary_from_jnp(_name, _fn)
+
+_NONDIFF_UNARY = {
+    "isnan": jnp.isnan,
+    "isinf": jnp.isinf,
+    "isfinite": jnp.isfinite,
+    "logical_not": jnp.logical_not,
+    "bitwise_not": jnp.bitwise_not,
+}
+for _name, _fn in _NONDIFF_UNARY.items():
+    _g[_name] = unary_from_jnp(_name, _fn, differentiable=False)
+
+
+# ---- binary elementwise (with broadcasting, like phi elementwise kernels) ----
+
+def _binop(name, jnp_fn, differentiable=True):
+    def fn(x, y):
+        return jnp_fn(x, y)
+
+    register_op(name, fn, differentiable=differentiable)
+
+    def eager(x, y, name_=None):
+        return apply(name, fn, x, y, differentiable=differentiable)
+
+    eager.__name__ = name
+    eager.raw = fn
+    return eager
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.true_divide)
+floor_divide = _binop("floor_divide", jnp.floor_divide, differentiable=False)
+remainder = _binop("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow = _binop("pow", jnp.power)
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+atan2 = _binop("atan2", jnp.arctan2)
+hypot = _binop("hypot", jnp.hypot)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+nextafter = _binop("nextafter", jnp.nextafter, differentiable=False)
+copysign = _binop("copysign", jnp.copysign)
+heaviside = _binop("heaviside", jnp.heaviside)
+gcd = _binop("gcd", jnp.gcd, differentiable=False)
+lcm = _binop("lcm", jnp.lcm, differentiable=False)
+ldexp = _binop("ldexp", jnp.ldexp)
+
+bitwise_and = _binop("bitwise_and", jnp.bitwise_and, differentiable=False)
+bitwise_or = _binop("bitwise_or", jnp.bitwise_or, differentiable=False)
+bitwise_xor = _binop("bitwise_xor", jnp.bitwise_xor, differentiable=False)
+bitwise_left_shift = _binop("bitwise_left_shift", jnp.left_shift, differentiable=False)
+bitwise_right_shift = _binop("bitwise_right_shift", jnp.right_shift, differentiable=False)
+
+
+@defop("divide_no_nan")
+def divide_no_nan(x, y):
+    return jnp.where(y == 0, jnp.zeros_like(x * y), x / y)
+
+
+@defop("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    """paddle.scale (ops.yaml `scale`)."""
+    if bias_after_scale:
+        out = x * scale + bias
+    else:
+        out = (x + bias) * scale
+    return out
+
+
+@defop("cast")
+def cast(x, dtype):
+    return x.astype(_dtype_mod.convert_dtype(dtype))
+
+
+@defop("clip")
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@defop("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@defop("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@defop("multiplex", differentiable=True)
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)
+    idx = index.reshape(-1).astype(jnp.int32)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+@defop("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+@defop("inner")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@defop("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@defop("logit")
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@defop("polygamma")
+def polygamma(x, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@defop("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@defop("trapezoid")
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if dx is None:
+        dx = 1.0
+    return jnp.trapezoid(y, x=x, dx=dx, axis=axis)
+
+
+@defop("diff")
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+# ---- reductions --------------------------------------------------------------
+
+def _reduce(name, jnp_fn, differentiable=True):
+    def fn(x, axis=None, keepdim=False):
+        return jnp_fn(x, axis=axis, keepdims=keepdim)
+
+    register_op(name, fn, differentiable=differentiable)
+
+    def eager(x, axis=None, keepdim=False, name_=None, **kw):
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(int(a) for a in axis)
+        return apply(name, fn, x, axis=axis, keepdim=keepdim, differentiable=differentiable)
+
+    eager.__name__ = name
+    eager.raw = fn
+    return eager
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+any = _reduce("any", jnp.any, differentiable=False)
+all = _reduce("all", jnp.all, differentiable=False)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+median = _reduce("median", jnp.median)
+nanmedian = _reduce("nanmedian", jnp.nanmedian)
+
+
+@defop("std")
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@defop("var")
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@defop("logsumexp")
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+@defop("logcumsumexp")
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+@defop("cumsum")
+def cumsum(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis)
+
+
+@defop("cumprod")
+def cumprod(x, dim=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim)
+
+
+@defop("cummax", differentiable=False)
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    values = jax.lax.cummax(x, axis=axis)
+    return values
+
+
+@defop("cummin", differentiable=False)
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cummin(x, axis=axis)
+
+
+@defop("count_nonzero", differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+# ---- arg/index reductions (non-differentiable) -------------------------------
+
+@defop("argmax", differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(_dtype_mod.convert_dtype(dtype))
+
+
+@defop("argmin", differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(_dtype_mod.convert_dtype(dtype))
+
+
+@defop("argsort", differentiable=False)
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(_dtype_mod.convert_dtype("int64"))
+
+
+@defop("mode")
+def mode(x, axis=-1, keepdim=False):
+    # values differentiable-ish; implement via sort
+    sorted_x = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+    med = jnp.take(sorted_x, n // 2, axis=axis)
+    if keepdim:
+        med = jnp.expand_dims(med, axis)
+    return med
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def fn(x):
+        out = jnp.sort(x, axis=axis, stable=stable)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+
+    return apply("sort", fn, x)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    """Returns (values, indices); values carry gradient (gather vjp)."""
+
+    def fn(x):
+        if axis not in (-1, x.ndim - 1):
+            xm = jnp.moveaxis(x, axis, -1)
+        else:
+            xm = x
+        src = xm if largest else -xm
+        v, i = jax.lax.top_k(src, k)
+        if not largest:
+            v = -v
+        if axis not in (-1, x.ndim - 1):
+            v = jnp.moveaxis(v, -1, axis)
+            i = jnp.moveaxis(i, -1, axis)
+        return v, i.astype(_dtype_mod.convert_dtype("int64"))
+
+    return apply("topk", fn, x)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(x):
+        sorted_x = jnp.sort(x, axis=axis)
+        idx_sorted = jnp.argsort(x, axis=axis)
+        v = jnp.take(sorted_x, k - 1, axis=axis)
+        i = jnp.take(idx_sorted, k - 1, axis=axis)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            i = jnp.expand_dims(i, axis)
+        return v, i.astype(_dtype_mod.convert_dtype("int64"))
+
+    return apply("kthvalue", fn, x)
+
+
+# ---- logic / comparison ------------------------------------------------------
+
+equal = _binop("equal", jnp.equal, differentiable=False)
+not_equal = _binop("not_equal", jnp.not_equal, differentiable=False)
+greater_than = _binop("greater_than", jnp.greater, differentiable=False)
+greater_equal = _binop("greater_equal", jnp.greater_equal, differentiable=False)
+less_than = _binop("less_than", jnp.less, differentiable=False)
+less_equal = _binop("less_equal", jnp.less_equal, differentiable=False)
+logical_and = _binop("logical_and", jnp.logical_and, differentiable=False)
+logical_or = _binop("logical_or", jnp.logical_or, differentiable=False)
+logical_xor = _binop("logical_xor", jnp.logical_xor, differentiable=False)
+
+
+@defop("allclose", differentiable=False)
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop("isclose", differentiable=False)
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop("equal_all", differentiable=False)
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+@defop("where")
+def where(condition, x=None, y=None):
+    return jnp.where(condition, x, y)
+
+
+@defop("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+@defop("isneginf", differentiable=False)
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+@defop("isposinf", differentiable=False)
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+@defop("isreal", differentiable=False)
+def isreal(x):
+    return jnp.isreal(x)

@@ -1,0 +1,156 @@
+"""Op registry and eager dispatch.
+
+Reference parity: the YAML op schema + generated dispatch
+(paddle/phi/ops/yaml/ops.yaml, paddle/phi/api/generator/api_base.py:1410,
+paddle/phi/core/kernel_factory.h:316). TPU-native design: there is exactly one
+"kernel backend" — XLA via jax.numpy/lax (plus Pallas for hot ops) — so the
+(backend, layout, dtype) dispatch lattice collapses. What remains of the
+reference machinery:
+
+- a name → OpDef registry (introspection, _C_ops surface, test enumeration);
+- ``apply``: the single eager entry point that unwraps Tensors, calls the pure
+  jax implementation, wraps outputs, and records the op on the autograd tape
+  when gradients are required (the role of the generated ``*_ad_func``,
+  paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:323).
+
+Inside jit-traced code ``apply`` still works (arrays are tracers; tape
+recording is skipped because traced training uses jax.grad instead).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_class import Tensor, unwrap, wrap
+from ..autograd import tape as _tape
+from ..framework import dtype as _dtype_mod
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "differentiable", "doc")
+
+    def __init__(self, name, fn, differentiable=True, doc=""):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.doc = doc
+
+
+OPS: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, fn: Callable, differentiable: bool = True, doc: str = ""):
+    OPS[name] = OpDef(name, fn, differentiable, doc)
+    return OPS[name]
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def apply(name: str, fn: Callable, *args, differentiable: bool = True, n_outputs=None, **kwargs):
+    """Run ``fn`` (a pure jax function) on the given args eagerly.
+
+    Tensors anywhere in args/kwargs (including inside lists/tuples, e.g.
+    ``concat([a, b])``) are unwrapped; if any requires grad and grad mode is
+    on, the op is recorded on the tape with a closure over the
+    non-differentiable arguments.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor_leaf)
+    tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    arrays = [l._array if isinstance(l, Tensor) else l for l in leaves]
+
+    requires_grad = (
+        differentiable
+        and _tape.grad_enabled()
+        and any(
+            not leaves[i].stop_gradient and _dtype_mod.is_inexact_dtype(leaves[i].dtype)
+            for i in tensor_idx
+        )
+    )
+
+    if not requires_grad:
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, arrays)
+        out = fn(*a2, **k2)
+        return _wrap_outputs(out, stop_gradient=True)
+
+    diff_idx = [
+        i
+        for i in tensor_idx
+        if _dtype_mod.is_inexact_dtype(leaves[i].dtype) and not leaves[i].stop_gradient
+    ]
+    diff_arrays = [arrays[i] for i in diff_idx]
+    diff_tensors = [leaves[i] for i in diff_idx]
+
+    def pure(*diff_args):
+        substituted = list(arrays)
+        for p, a in zip(diff_idx, diff_args):
+            substituted[p] = a
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, substituted)
+        return fn(*a2, **k2)
+
+    out = pure(*diff_arrays)
+    wrapped = _wrap_outputs(out, stop_gradient=False)
+
+    # tape only tracks float outputs; record with the full output structure
+    out_tensors = [t for t in jax.tree_util.tree_leaves(wrapped, is_leaf=_is_tensor_leaf) if isinstance(t, Tensor)]
+    tracked = [t for t in out_tensors if _dtype_mod.is_inexact_dtype(t.dtype)]
+    for t in out_tensors:
+        if not _dtype_mod.is_inexact_dtype(t.dtype):
+            t.stop_gradient = True
+    if tracked:
+        _tape.record(pure, diff_arrays, diff_tensors, out_tensors, name=name)
+    return wrapped
+
+
+def _wrap_outputs(out, stop_gradient):
+    if isinstance(out, (jax.Array, np.ndarray)) or jnp.isscalar(out):
+        return wrap(jnp.asarray(out), stop_gradient)
+    if isinstance(out, tuple):
+        return tuple(_wrap_outputs(o, stop_gradient) for o in out)
+    if isinstance(out, list):
+        return [_wrap_outputs(o, stop_gradient) for o in out]
+    if out is None:
+        return None
+    return wrap(jnp.asarray(out), stop_gradient)
+
+
+def defop(name: str, differentiable: bool = True):
+    """Decorator: define an op by its pure-jax implementation.
+
+    The decorated function becomes the eager, tape-recorded version; the raw
+    implementation stays reachable as ``.raw`` for use inside jit-traced pure
+    code paths.
+    """
+
+    def deco(fn):
+        register_op(name, fn, differentiable=differentiable, doc=fn.__doc__ or "")
+
+        def eager(*args, **kwargs):
+            return apply(name, fn, *args, differentiable=differentiable, **kwargs)
+
+        eager.__name__ = name
+        eager.__qualname__ = name
+        eager.__doc__ = fn.__doc__
+        eager.raw = fn
+        return eager
+
+    return deco
+
+
+def unary_from_jnp(name, jnp_fn, differentiable=True, doc=""):
+    def fn(x):
+        return jnp_fn(x)
+
+    fn.__doc__ = doc
+    register_op(name, fn, differentiable=differentiable, doc=doc)
+
+    def eager(x, name_=None, **kw):
+        return apply(name, fn, x, differentiable=differentiable)
+
+    eager.__name__ = name
+    eager.raw = fn
+    return eager

@@ -1,0 +1,412 @@
+"""paddle_tpu.Tensor — eager tensor wrapping an immutable jax.Array.
+
+Reference parity: ``phi::DenseTensor`` + Python ``paddle.Tensor``
+(paddle/phi/core/dense_tensor.h:1-296, python/paddle/tensor/). TPU-native
+design: the payload is a ``jax.Array`` (device-resident, possibly sharded
+across a mesh — so DistTensor parity comes for free via jax.sharding), the
+wrapper adds Paddle eager semantics: ``stop_gradient``, ``.grad``,
+``backward()``, in-place variants, and ~the full method surface, with every
+differentiable op recorded on the autograd tape (see autograd/tape.py).
+
+Tensor is registered as a jax pytree node, so it can flow directly through
+``jax.jit`` / ``jax.grad`` / ``shard_map``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework import dtype as _dtype_mod
+from .autograd import tape as _tape
+
+
+class Tensor:
+    __slots__ = (
+        "_array",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "name",
+        "persistable",
+        "_backward_hooks",
+        "__weakref__",
+    )
+
+    def __init__(self, data=None, dtype=None, stop_gradient=True, name=None):
+        if data is None:
+            arr = jnp.zeros((), dtype=dtype or _dtype_mod.default_float_dtype())
+        elif isinstance(data, Tensor):
+            arr = data._array
+        elif isinstance(data, jax.Array):
+            arr = data
+        else:
+            np_arr = np.asarray(data)
+            if dtype is None and np_arr.dtype == np.float64:
+                np_arr = np_arr.astype(_dtype_mod.default_float_dtype())
+            arr = jnp.asarray(np_arr)
+        if dtype is not None:
+            arr = arr.astype(_dtype_mod.convert_dtype(dtype))
+        self._array = arr
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self.name = name
+        self.persistable = False
+        self._backward_hooks = []
+
+    # ---- construction helpers -------------------------------------------------
+    @classmethod
+    def _wrap(cls, arr, stop_gradient=True):
+        t = cls.__new__(cls)
+        t._array = arr
+        t.stop_gradient = stop_gradient
+        t._grad = None
+        t._grad_node = None
+        t.name = None
+        t.persistable = False
+        t._backward_hooks = []
+        return t
+
+    # ---- core properties ------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._array.shape)
+
+    @property
+    def ndim(self):
+        return self._array.ndim
+
+    ndimension = ndim
+
+    @property
+    def dtype(self):
+        return self._array.dtype
+
+    @property
+    def size(self):
+        return int(self._array.size)
+
+    @property
+    def place(self):
+        devs = getattr(self._array, "devices", None)
+        if devs is None:
+            return "unknown"
+        ds = list(self._array.devices())
+        return str(ds[0]) if len(ds) == 1 else f"sharded({len(ds)} devices)"
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = value
+
+    @property
+    def T(self):
+        from . import ops
+
+        return ops.manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        from . import ops
+
+        perm = list(range(self.ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        return ops.manipulation.transpose(self, perm)
+
+    @property
+    def sharding(self):
+        return getattr(self._array, "sharding", None)
+
+    # jax interop: jnp.* accepts Tensor transparently
+    def __jax_array__(self):
+        return self._array
+
+    # ---- conversion -----------------------------------------------------------
+    def numpy(self):
+        return np.asarray(jax.device_get(self._array))
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self._array.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is ambiguous"
+            )
+        return bool(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._array.shape[0]
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={_dtype_mod.dtype_name(self.dtype)}"
+            f"{grad_str},\n       {np.array2string(self.numpy(), prefix='       ')})"
+        )
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # ---- autograd surface -----------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _tape.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def _clear_grad_internal(self):
+        self._grad = None
+
+    def _set_grad_internal(self, g):
+        self._grad = g
+
+    def _accumulate_grad(self, g_arr):
+        if isinstance(g_arr, Tensor):
+            g_arr = g_arr._array
+        if g_arr is None:
+            return
+        if getattr(g_arr, "dtype", None) is not None and g_arr.dtype == jax.dtypes.float0:
+            return
+        if self._grad is None:
+            self._grad = Tensor._wrap(jnp.asarray(g_arr))
+        else:
+            self._grad = Tensor._wrap(self._grad._array + g_arr)
+
+    def register_hook(self, hook):
+        self._backward_hooks.append(hook)
+
+        class _Removable:
+            def remove(inner):
+                try:
+                    self._backward_hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Removable()
+
+    def detach(self):
+        t = Tensor._wrap(self._array, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self.stop_gradient = True
+        self._grad_node = None
+        return self
+
+    def clone(self):
+        from . import ops
+
+        return ops.registry.apply("clone", lambda x: x + 0, self)
+
+    # ---- data movement / mutation --------------------------------------------
+    def to(self, *args, **kwargs):
+        """Supports to(dtype), to(device_str), to(device, dtype)."""
+        dtype = kwargs.get("dtype")
+        device = kwargs.get("device")
+        for a in args:
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu") or not isinstance(a, str) and hasattr(a, "platform"):
+                device = a
+            else:
+                dtype = a
+        arr = self._array
+        if device is not None:
+            from .framework import device as _device_mod
+
+            arr = jax.device_put(arr, _device_mod._resolve(device))
+        if dtype is not None:
+            return self._wrap_like(arr.astype(_dtype_mod.convert_dtype(dtype)))
+        t = Tensor._wrap(arr, self.stop_gradient)
+        t._grad_node = self._grad_node
+        return t
+
+    def _wrap_like(self, arr):
+        from . import ops
+
+        # route through apply so casts stay differentiable
+        return ops.registry.apply(
+            "cast", lambda x: x.astype(arr.dtype), self
+        ) if arr.dtype != self._array.dtype else Tensor._wrap(arr, self.stop_gradient)
+
+    def astype(self, dtype):
+        from . import ops
+
+        return ops.math.cast(self, dtype)
+
+    cast = astype
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):  # API-compat alias: accelerator place
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # in-place value assignment (optimizer updates, init)
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            arr = value._array
+        else:
+            arr = jnp.asarray(np.asarray(value))
+        if tuple(arr.shape) != tuple(self._array.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {tuple(arr.shape)} vs {tuple(self._array.shape)}"
+            )
+        self._array = arr.astype(self._array.dtype)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def zero_(self):
+        self._array = jnp.zeros_like(self._array)
+        return self
+
+    def fill_(self, value):
+        self._array = jnp.full_like(self._array, value)
+        return self
+
+    # ---- indexing -------------------------------------------------------------
+    def __getitem__(self, idx):
+        from . import ops
+
+        return ops.indexing.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from . import ops
+
+        ops.indexing.setitem_(self, idx, value)
+
+    # dim helpers
+    def dim(self):
+        return self.ndim
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return self._array.dtype.itemsize
+
+    def value(self):
+        return self
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient defaults to False, persistable True.
+
+    Parity: paddle.base.framework.EagerParamBase."""
+
+    def __init__(self, data=None, dtype=None, trainable=True, name=None):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+
+    @classmethod
+    def from_tensor(cls, t: Tensor, trainable=True, name=None):
+        p = cls.__new__(cls)
+        p._array = t._array if isinstance(t, Tensor) else jnp.asarray(t)
+        p.stop_gradient = not trainable
+        p._grad = None
+        p._grad_node = None
+        p.name = name
+        p.persistable = True
+        p._backward_hooks = []
+        return p
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._array,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor._wrap(children[0], stop_gradient=aux[0])
+    t.name = aux[1]
+    return t
+
+
+def _param_flatten(p: Parameter):
+    return (p._array,), (p.stop_gradient, p.name)
+
+
+def _param_unflatten(aux, children):
+    p = Parameter.__new__(Parameter)
+    p._array = children[0]
+    p.stop_gradient = aux[0]
+    p._grad = None
+    p._grad_node = None
+    p.name = aux[1]
+    p.persistable = True
+    p._backward_hooks = []
+    return p
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+jax.tree_util.register_pytree_node(Parameter, _param_flatten, _param_unflatten)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def unwrap(x):
+    """Tensor → jax.Array (identity on non-tensors)."""
+    return x._array if isinstance(x, Tensor) else x
+
+
+def wrap(arr, stop_gradient=True):
+    return Tensor._wrap(arr, stop_gradient)

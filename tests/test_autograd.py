@@ -1,0 +1,206 @@
+"""Eager autograd (tape) tests: backward, grad, hooks, PyLayer, no_grad.
+
+Mirrors the reference test strategy for the eager engine
+(test/legacy_test + test/autograd): analytic grads checked against
+hand-derived and numeric values.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_backward_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x  # 4
+    z = y * x  # x^3 = 8
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)  # 3x^2
+
+
+def test_backward_branching():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    a = x * 2
+    b = x * 5
+    out = a + b
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 7.0)
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 5.0)
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = paddle.to_tensor(3.0)  # stop_gradient=True
+    out = x * y
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3.0)
+    assert y.grad is None
+
+
+def test_detach_blocks():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = (x * x).detach()
+    z = y * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 4.0)  # only the last mult
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    with paddle.no_grad():
+        y = x * x
+    assert y.stop_gradient
+    z = x * 3
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3.0)
+
+
+def test_matmul_grad():
+    A = paddle.randn([3, 4])
+    A.stop_gradient = False
+    B = paddle.randn([4, 5])
+    B.stop_gradient = False
+    out = (A @ B).sum()
+    out.backward()
+    np.testing.assert_allclose(A.grad.numpy(), np.ones((3, 5)) @ B.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(B.grad.numpy(), A.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), 12.0)
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_backward_nonscalar_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+
+def test_backward_nonscalar_raises():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0]], stop_gradient=False)
+    values, indices = paddle.topk(x, k=2)
+    values.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0, 1]])
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x[1] * 5
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 5, 0])
+
+
+def test_reduction_grads():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    x.mean().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 0.25))
+
+
+def test_numeric_gradient_check():
+    """Finite-difference check, the OpTest check_grad analog
+    (test/legacy_test/op_test.py:3081)."""
+    rng = np.random.RandomState(0)
+    x0 = rng.randn(4, 3).astype(np.float32)
+
+    def f_np(x):
+        return np.tanh(x).sum() + (x * x).sum()
+
+    x = paddle.to_tensor(x0, stop_gradient=False)
+    out = paddle.tanh(x).sum() + (x * x).sum()
+    out.backward()
+    analytic = x.grad.numpy()
+
+    eps = 1e-3
+    numeric = np.zeros_like(x0)
+    for i in range(x0.shape[0]):
+        for j in range(x0.shape[1]):
+            xp = x0.copy()
+            xp[i, j] += eps
+            xm = x0.copy()
+            xm[i, j] -= eps
+            numeric[i, j] = (f_np(xp) - f_np(xm)) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=1e-2, atol=1e-3)
+
+
+def test_register_hook():
+    seen = []
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * 3
+    y.register_hook(lambda g: seen.append(np.asarray(g)))
+    y.backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], 1.0)
+
+
+def test_pylayer():
+    class Double(paddle.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor
+            return grad * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [2, 4])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+
+def test_pylayer_composes_with_ops():
+    class Square(paddle.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor
+            return grad * 2 * x
+
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = Square.apply(x * 2)  # (2x)^2 = 4x^2 → d/dx = 8x = 24
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 24.0)
+
+
+def test_higher_order_via_double_backward():
+    # d2/dx2 x^3 = 6x via paddle.grad twice is not supported by the tape
+    # (create_graph pending); verify the documented jax.grad escape hatch
+    import jax
+
+    f = lambda x: (x ** 3).sum()
+    g2 = jax.grad(jax.grad(f))(2.0)
+    np.testing.assert_allclose(g2, 12.0)

@@ -1,0 +1,140 @@
+"""Tensor construction, properties, methods, dunders."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_conversion():
+    t = paddle.to_tensor([1, 2, 3])
+    assert "int" in str(t.dtype)
+    f = t.astype("float32")
+    assert f.dtype == paddle.float32
+    b = f.astype(paddle.bfloat16)
+    assert b.dtype == paddle.bfloat16
+
+
+def test_arithmetic_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a + 1).numpy(), [2, 3])
+    np.testing.assert_allclose((2 * a).numpy(), [2, 4])
+    np.testing.assert_allclose((1 - a).numpy(), [0, -1])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+
+
+def test_comparison():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+    np.testing.assert_array_equal((a >= b).numpy(), [False, True, True])
+
+
+def test_matmul_dunder():
+    a = paddle.randn([3, 4])
+    b = paddle.randn([4, 5])
+    c = a @ b
+    assert c.shape == [3, 5]
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
+
+
+def test_indexing_basic():
+    t = paddle.arange(12, dtype="float32").reshape([3, 4])
+    np.testing.assert_allclose(t[0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(t[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(t[1, 2].numpy(), 6)
+    np.testing.assert_allclose(t[::2].numpy(), t.numpy()[::2])
+    np.testing.assert_allclose(t[..., -1].numpy(), [3, 7, 11])
+
+
+def test_indexing_bool_mask():
+    t = paddle.to_tensor([1.0, -2.0, 3.0, -4.0])
+    out = t[t > 0]
+    np.testing.assert_allclose(out.numpy(), [1, 3])
+
+
+def test_setitem():
+    t = paddle.zeros([3, 3])
+    t[1] = 5.0
+    np.testing.assert_allclose(t.numpy()[1], [5, 5, 5])
+    t[0, 0] = 7.0
+    assert t.numpy()[0, 0] == 7
+
+
+def test_item_and_scalars():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == pytest.approx(3.5)
+    assert float(t) == pytest.approx(3.5)
+    assert int(paddle.to_tensor(7)) == 7
+
+
+def test_inplace_ops():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(t.numpy(), [2, 3])
+    t.clip_(min=0.0, max=2.5)
+    np.testing.assert_allclose(t.numpy(), [2, 2.5])
+    t.zero_()
+    np.testing.assert_allclose(t.numpy(), [0, 0])
+
+
+def test_clone_detach():
+    t = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    c = t.clone()
+    d = t.detach()
+    assert not c.stop_gradient
+    assert d.stop_gradient
+    np.testing.assert_allclose(c.numpy(), t.numpy())
+
+
+def test_shape_props():
+    t = paddle.zeros([2, 3, 4])
+    assert t.ndim == 3
+    assert t.size == 24
+    assert t.numel() == 24
+    assert len(t) == 2
+    assert t.T.shape == [4, 3, 2]
+
+
+def test_pytree_registration():
+    import jax
+
+    t = paddle.to_tensor([1.0, 2.0])
+    leaves = jax.tree_util.tree_leaves(t)
+    assert len(leaves) == 1
+    doubled = jax.jit(lambda x: x * 2)(t)
+    np.testing.assert_allclose(np.asarray(jax.tree_util.tree_leaves(doubled)[0]), [2, 4])
+
+
+def test_creation_ops():
+    np.testing.assert_allclose(paddle.zeros([2, 2]).numpy(), np.zeros((2, 2)))
+    np.testing.assert_allclose(paddle.ones([2]).numpy(), [1, 1])
+    np.testing.assert_allclose(paddle.full([2], 3.0).numpy(), [3, 3])
+    np.testing.assert_allclose(paddle.arange(5).numpy(), [0, 1, 2, 3, 4])
+    np.testing.assert_allclose(paddle.linspace(0, 1, 3).numpy(), [0, 0.5, 1])
+    np.testing.assert_allclose(paddle.eye(2).numpy(), np.eye(2))
+    assert paddle.randn([4, 4]).shape == [4, 4]
+    assert paddle.randint(0, 10, [5]).shape == [5]
+    r = paddle.uniform([100], min=2.0, max=3.0)
+    assert (r.numpy() >= 2).all() and (r.numpy() < 3).all()
+
+
+def test_random_seed_reproducible():
+    paddle.seed(42)
+    a = paddle.randn([4])
+    paddle.seed(42)
+    b = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
