@@ -117,12 +117,17 @@ def _zero_cotangent(p):
     return np.zeros(p.shape, dtype=jax.dtypes.float0)
 
 
-def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+def backward(tensors, grad_tensors=None, retain_graph: bool = False, grads_out=None):
     """Run reverse-mode accumulation from ``tensors`` over the recorded tape.
 
     Parity: ``egr::Backward`` (paddle/fluid/eager/backward.cc:439). Leaf
     tensors (those with stop_gradient=False and no grad node) receive ``.grad``
     (the role of GradNodeAccumulation, paddle/fluid/eager/accumulation/).
+
+    When ``grads_out`` (a dict ``id(tensor) -> accumulated grad array``) is
+    given, the walk runs in "Grad mode" (backward.cc:450): nothing touches
+    ``.grad``; contributions for the requested tensor ids (leaf or not) are
+    collected into the dict instead.
     """
     if not isinstance(tensors, (list, tuple)):
         tensors = [tensors]
@@ -150,6 +155,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
         keep_alive[id(t)] = t
 
     tape = _st().tape
+    consumed = set()
     for node in reversed(tape):
         outs = [r() for r in node.out_refs]
         gs = [cotan.pop(id(o), None) if o is not None else None for o in outs]
@@ -157,6 +163,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
             keep_alive.pop(id(o), None)
         if all(g is None for g in gs):
             continue
+        consumed.add(id(node))
         if hasattr(node, "run_backward"):
             # custom node (PyLayer): user-supplied backward
             in_grads = node.run_backward(outs, gs)
@@ -174,11 +181,19 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
         for t, g in zip(node.in_tensors, in_grads):
             if t is None or g is None or t.stop_gradient:
                 continue
+            if getattr(g, "dtype", None) == jax.dtypes.float0:
+                continue
             tid = id(t)
-            if t._grad_node is None or t.is_leaf:
-                # leaf accumulation → .grad
+            if grads_out is not None:
+                if tid in grads_out:
+                    prev = grads_out[tid]
+                    grads_out[tid] = g if prev is None else prev + g
+            elif t.is_leaf:
                 t._accumulate_grad(g)
-            if t._grad_node is not None:
+            if not t.is_leaf:
+                # non-leaf: pass the contribution upstream (for an in-place
+                # op, t is its own output — the deposit reaches t's original
+                # producer node, whose out_refs still point at t)
                 cotan[tid] = cotan[tid] + g if tid in cotan else g
                 keep_alive[tid] = t
         # fire user hooks registered on output tensors
@@ -188,7 +203,9 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
                     hook(g)
 
     if not retain_graph:
-        reset_tape()
+        # free only the walked graph; independent live graphs keep their nodes
+        st = _st()
+        st.tape = [n for n in st.tape if id(n) not in consumed]
 
 
 def grad(
@@ -208,23 +225,20 @@ def grad(
     if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
 
-    saved = {id(t): t.grad for t in inputs}
-    for t in inputs:
-        t._clear_grad_internal()
+    collected = {id(t): None for t in inputs}
     retain = True if retain_graph is None else retain_graph
-    backward(list(outputs), grad_outputs, retain_graph=retain)
+    backward(list(outputs), grad_outputs, retain_graph=retain, grads_out=collected)
     results = []
     for t in inputs:
-        g = t.grad
+        g = collected[id(t)]
         if g is None and not allow_unused:
             raise RuntimeError(
                 "One of the differentiated tensors appears to not have been used "
                 "in the graph. Set allow_unused=True if this is desired."
             )
-        results.append(g)
-    # restore prior .grad values
-    for t in inputs:
-        t._set_grad_internal(saved[id(t)])
+        from ..tensor_class import Tensor
+
+        results.append(Tensor._wrap(g) if g is not None else None)
     if retain_graph is None:
         reset_tape()
     return results

@@ -97,13 +97,11 @@ def _install_tensor_methods():
     T.cast = lambda s, dtype: math.cast(s, dtype)
     T.astype = T.cast
 
-    # in-place variants (add_, clip_, ...): compute then swap payload
+    # in-place variants (add_, clip_, ...): compute then swap payload with
+    # autograd-chain re-keying (see registry.inplace_swap)
     def _make_inplace(fn):
         def method(self, *a, **k):
-            out = fn(self, *a, **k)
-            self._array = out._array
-            self._grad_node = out._grad_node
-            return self
+            return registry.inplace_swap(self, fn(self, *a, **k))
 
         return method
 

@@ -32,10 +32,12 @@ def _norm_index(idx):
 def getitem(x, idx):
     pure_idx = _norm_index(idx)
 
-    has_bool = _contains_bool(pure_idx)
-    if has_bool:
-        # data-dependent result shape: evaluate eagerly outside trace
-        return wrap(jnp.asarray(np.asarray(unwrap(x))[_to_numpy_index(pure_idx)]), x.stop_gradient)
+    # Boolean masks have data-dependent result shapes; resolve them to
+    # concrete integer index arrays on the host (eager-only, like the
+    # reference's dygraph bool indexing), then index through the tape so the
+    # gather stays differentiable.
+    if _contains_bool(pure_idx):
+        pure_idx = _bools_to_ints(pure_idx)
 
     def fn(a):
         return a[pure_idx]
@@ -49,12 +51,18 @@ def _contains_bool(idx):
     return isinstance(idx, np.ndarray) and idx.dtype == np.bool_
 
 
-def _to_numpy_index(idx):
+def _bools_to_ints(idx):
+    """Replace boolean mask components with the tuple of their nonzero index
+    arrays (numpy advanced-indexing equivalence), keeping everything concrete."""
     if isinstance(idx, tuple):
-        return tuple(_to_numpy_index(i) for i in idx)
-    if hasattr(idx, "dtype") and not isinstance(idx, np.ndarray):
-        return np.asarray(idx)
-    return idx
+        out = []
+        for i in idx:
+            if isinstance(i, np.ndarray) and i.dtype == np.bool_:
+                out.extend(np.nonzero(i))
+            else:
+                out.append(i)
+        return tuple(out)
+    return tuple(np.nonzero(idx)) if idx.ndim > 1 else np.nonzero(idx)[0]
 
 
 def setitem_(x, idx, value):
@@ -70,6 +78,6 @@ def setitem_(x, idx, value):
         out = apply("setitem", fn, x, value)
     else:
         out = apply("setitem", lambda a: a.at[pure_idx].set(jnp.asarray(v, dtype=a.dtype)), x)
-    x._array = out._array
-    x._grad_node = out._grad_node
-    return x
+    from .registry import inplace_swap
+
+    return inplace_swap(x, out)

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..tensor_class import unwrap, wrap
+from ..framework import dtype as _dtype_mod
 from .registry import apply, defop
 
 
@@ -109,13 +110,7 @@ def diagonal(x, offset=0, axis1=0, axis2=1):
 @defop("diag_embed")
 def diag_embed(x, offset=0, dim1=-2, dim2=-1):
     # place vector(s) on the diagonal of a new matrix
-    out = jnp.zeros((*x.shape, x.shape[-1] + abs(offset)), dtype=x.dtype)
-    idx = jnp.arange(x.shape[-1])
-    if offset >= 0:
-        out = out.at[..., idx, idx + offset].set(x) if False else jnp.apply_along_axis
-    # simpler: use vectorized construction
     n = x.shape[-1] + abs(offset)
-    eye = jnp.eye(n, dtype=x.dtype)
     base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
     rows = jnp.arange(x.shape[-1]) + (0 if offset >= 0 else -offset)
     cols = jnp.arange(x.shape[-1]) + (offset if offset >= 0 else 0)

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..tensor_class import Tensor, unwrap, wrap
+from ..framework import dtype as _dtype_mod
 from .registry import apply, defop
 
 
@@ -31,9 +32,9 @@ view = reshape
 
 
 def reshape_(x, shape, name=None):
-    out = reshape(x, shape)
-    x._array, x._grad_node = out._array, out._grad_node
-    return x
+    from .registry import inplace_swap
+
+    return inplace_swap(x, reshape(x, shape))
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -196,14 +197,11 @@ def slice(x, axes, starts, ends, name=None):
     return apply("slice", fn, x)
 
 
-def builtins_slice(*args):
-    return __builtins__["slice"](*args) if isinstance(__builtins__, dict) else slice.__self__  # pragma: no cover
-
-
-# simpler: capture python slice builtin before shadowing
+# the module-level `slice` op shadows the builtin; keep a handle to it
 import builtins as _builtins
 
-def builtins_slice(*args):  # noqa: F811
+
+def builtins_slice(*args):
     return _builtins.slice(*args)
 
 
@@ -356,9 +354,12 @@ def index_put(x, indices, value, accumulate=False, name=None):
 
 
 def masked_select(x, mask, name=None):
-    """Note: output shape is data-dependent — eager only, not jittable."""
-    a, m = unwrap(x), unwrap(mask)
-    return wrap(a[np.asarray(m)])
+    """Output shape is data-dependent — eager only, not jittable; the gather
+    itself runs through the tape so gradients flow (paddle masked_select is
+    differentiable)."""
+    m = np.asarray(unwrap(mask))
+    flat_idx = np.nonzero(np.broadcast_to(m, unwrap(x).shape).reshape(-1))[0]
+    return apply("masked_select", lambda a: a.reshape(-1)[flat_idx], x)
 
 
 def take(x, index, mode="raise", name=None):

@@ -323,15 +323,21 @@ def argsort(x, axis=-1, descending=False, stable=True):
     return out.astype(_dtype_mod.convert_dtype("int64"))
 
 
-@defop("mode")
-def mode(x, axis=-1, keepdim=False):
-    # values differentiable-ish; implement via sort
-    sorted_x = jnp.sort(x, axis=axis)
-    n = x.shape[axis]
-    med = jnp.take(sorted_x, n // 2, axis=axis)
-    if keepdim:
-        med = jnp.expand_dims(med, axis)
-    return med
+def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value along axis; returns (values, indices) like paddle.
+    O(n^2) pairwise-count formulation — fine for the small axes this op sees."""
+
+    def fn(x):
+        xm = jnp.moveaxis(x, axis, -1)
+        counts = (xm[..., :, None] == xm[..., None, :]).sum(-1)
+        pos = jnp.argmax(counts, axis=-1)
+        values = jnp.take_along_axis(xm, pos[..., None], axis=-1)[..., 0]
+        if keepdim:
+            values = jnp.expand_dims(values, axis)
+            pos = jnp.expand_dims(pos, axis)
+        return values, pos.astype(_dtype_mod.convert_dtype("int64"))
+
+    return apply("mode", fn, x)
 
 
 def sort(x, axis=-1, descending=False, stable=True, name=None):

@@ -118,6 +118,33 @@ def _wrap_outputs(out, stop_gradient):
     return wrap(jnp.asarray(out), stop_gradient)
 
 
+def inplace_swap(x: Tensor, out: Tensor) -> Tensor:
+    """Make ``x`` adopt the result of an out-of-place op as an in-place update
+    without severing the autograd chain.
+
+    Parity: the reference's inplace op variants (ops.yaml ``inplace:`` maps +
+    eager inplace version checking). The recorded tape node's output weakref is
+    re-pointed from the temporary ``out`` to ``x`` itself, so backward cotangent
+    lookup (keyed by tensor identity) finds it; the contribution then flows to
+    x's original producer, whose out_refs still reference ``x``.
+    """
+    import weakref
+
+    node = out._grad_node
+    if node is not None:
+        if x.is_leaf and not x.stop_gradient:
+            raise RuntimeError(
+                "a leaf Tensor that requires grad is being used in an in-place "
+                "operation; detach() it or wrap the update in no_grad()"
+            )
+        node.out_refs = tuple(
+            weakref.ref(x) if r() is out else r for r in node.out_refs
+        )
+    x._array = out._array
+    x._grad_node = node
+    return x
+
+
 def defop(name: str, differentiable: bool = True):
     """Decorator: define an op by its pure-jax implementation.
 

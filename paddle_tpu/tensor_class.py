@@ -242,28 +242,28 @@ class Tensor:
         dtype = kwargs.get("dtype")
         device = kwargs.get("device")
         for a in args:
-            if isinstance(a, str) and a in ("cpu", "tpu", "gpu") or not isinstance(a, str) and hasattr(a, "platform"):
+            if isinstance(a, str):
+                if a.split(":")[0].lower() in ("cpu", "tpu", "gpu", "cuda", "xpu"):
+                    device = a
+                else:
+                    dtype = a
+            elif hasattr(a, "platform") or type(a).__name__ == "Place":
                 device = a
             else:
                 dtype = a
-        arr = self._array
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)  # tape-recorded cast
         if device is not None:
+            from . import ops
             from .framework import device as _device_mod
 
-            arr = jax.device_put(arr, _device_mod._resolve(device))
-        if dtype is not None:
-            return self._wrap_like(arr.astype(_dtype_mod.convert_dtype(dtype)))
-        t = Tensor._wrap(arr, self.stop_gradient)
-        t._grad_node = self._grad_node
-        return t
-
-    def _wrap_like(self, arr):
-        from . import ops
-
-        # route through apply so casts stay differentiable
-        return ops.registry.apply(
-            "cast", lambda x: x.astype(arr.dtype), self
-        ) if arr.dtype != self._array.dtype else Tensor._wrap(arr, self.stop_gradient)
+            dev = _device_mod._resolve(device)
+            out = ops.registry.apply("to_device", lambda x: jax.device_put(x, dev), out)
+        if out is self:
+            out = Tensor._wrap(self._array, self.stop_gradient)
+            out._grad_node = self._grad_node
+        return out
 
     def astype(self, dtype):
         from . import ops

@@ -204,3 +204,74 @@ def test_higher_order_via_double_backward():
     f = lambda x: (x ** 3).sum()
     g2 = jax.grad(jax.grad(f))(2.0)
     np.testing.assert_allclose(g2, 12.0)
+
+
+def test_inplace_grad_flows():
+    """In-place op on a non-leaf keeps the chain (review regression)."""
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2          # non-leaf
+    y[0] = 10.0        # in-place setitem on non-leaf
+    y.sum().backward()
+    # d(sum)/dx: position 0 overwritten -> 0, others flow through *2
+    np.testing.assert_allclose(x.grad.numpy(), [0, 2, 2])
+
+
+def test_inplace_on_leaf_raises():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        x.add_(paddle.to_tensor([1.0, 1.0]))
+
+
+def test_bool_mask_grad_flows():
+    """Boolean-mask indexing is differentiable (review regression)."""
+    x = paddle.to_tensor([1.0, -2.0, 3.0], stop_gradient=False)
+    y = x[paddle.to_tensor([True, False, True])]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 0, 1])
+
+
+def test_masked_select_grad_flows():
+    x = paddle.to_tensor([[1.0, -2.0], [3.0, -4.0]], stop_gradient=False)
+    out = paddle.masked_select(x, x > 0)
+    np.testing.assert_allclose(out.numpy(), [1, 3])
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0], [1, 0]])
+
+
+def test_grad_api_nonleaf_input():
+    """paddle.grad with a non-leaf input (review regression)."""
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * 2
+    z = (y * 3).sum()
+    (gy,) = paddle.grad(z, [y])
+    np.testing.assert_allclose(gy.numpy(), 3.0)
+
+
+def test_grad_api_no_leaf_pollution():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    w = paddle.to_tensor(5.0, stop_gradient=False)
+    z = x * w
+    (gx,) = paddle.grad(z, [x])
+    assert w.grad is None and x.grad is None
+
+
+def test_independent_graphs_survive_backward():
+    """backward() must not destroy other live graphs (review regression)."""
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    y1 = x * 2
+    y2 = x * 3
+    y1.backward()
+    y2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 5.0)
+
+
+def test_mode_returns_most_frequent():
+    v, i = paddle.mode(paddle.to_tensor([2.0, 2.0, 7.0, 8.0, 9.0]))
+    assert v.item() == 2.0
+
+
+def test_to_device_and_dtype():
+    t = paddle.to_tensor([1.0, 2.0])
+    out = t.to("cpu", dtype="float16")
+    assert out.dtype == paddle.float16
+    assert "cpu" in str(out.place).lower() or "Cpu" in str(out.place)
