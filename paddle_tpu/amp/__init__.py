@@ -1,0 +1,227 @@
+"""Automatic mixed precision.
+
+Reference parity: python/paddle/amp/ — ``auto_cast`` (O1/O2 with per-op
+white/black lists, auto_cast.py:462,1029), ``decorate`` (:1114), ``GradScaler``
+(grad_scaler.py:657).
+
+TPU-native design: the native compute dtype is bfloat16, whose exponent range
+matches f32 — so **loss scaling is unnecessary** (GradScaler is kept for API
+parity and behaves as configured but defaults to enable=True/no-op scaling
+under bf16). The autocast decision is made at op-dispatch time: the eager op
+registry consults the active AmpState (the role eager_gen.py:596 plays in
+every generated fwd function of the reference).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..framework import dtype as _dtype_mod
+from ..tensor_class import Tensor, unwrap, wrap
+
+# Per-op lists, mirroring the reference's default white/black lists
+# (python/paddle/amp/amp_lists.py): white → run in low precision,
+# black → force f32.
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv", "conv_transpose", "einsum",
+    "flash_attention", "sdpa", "addmm",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "cos_sim",
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+    "norm", "logsumexp", "cumsum", "pow", "erf", "erfinv",
+}
+
+_state = threading.local()
+
+
+class AmpState:
+    __slots__ = ("enabled", "dtype", "level", "custom_white", "custom_black")
+
+    def __init__(self, enabled, dtype, level, custom_white=None, custom_black=None):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.level = level
+        self.custom_white = set(custom_white or ())
+        self.custom_black = set(custom_black or ())
+
+
+def _amp_state() -> AmpState | None:
+    return getattr(_state, "amp", None)
+
+
+def amp_dtype_for(op_name: str):
+    """Called by the op registry: returns the compute dtype this op should
+    cast float inputs to, or None for no cast."""
+    st = _amp_state()
+    if st is None or not st.enabled:
+        return None
+    name = op_name.lower()
+    if name in st.custom_black or name in BLACK_LIST:
+        return jnp.float32
+    if st.level == "O2":
+        return st.dtype
+    if name in st.custom_white or name in WHITE_LIST:
+        return st.dtype
+    return None
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast parity (auto_cast.py:1029)."""
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level must be O0/O1/O2, got {level}")
+    prev = _amp_state()
+    _state.amp = AmpState(enable and level != "O0", _dtype_mod.convert_dtype(dtype),
+                          level, custom_white_list, custom_black_list)
+    try:
+        yield
+    finally:
+        _state.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False, excluded_layers=None):
+    """paddle.amp.decorate parity (auto_cast.py:1114): O2 casts model params to
+    the low-precision dtype (master f32 copies live in the optimizer state)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        from ..nn.layers_common import _BatchNormBase
+        from ..nn.layers_common import LayerNorm
+
+        excluded = tuple(excluded_layers) if excluded_layers else (_BatchNormBase, LayerNorm)
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if isinstance(layer, excluded):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and _dtype_mod.is_floating_point_dtype(p.dtype):
+                        p._array = p._array.astype(_dtype_mod.convert_dtype(dtype))
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+class GradScaler:
+    """paddle.amp.GradScaler parity (grad_scaler.py:657). On TPU/bf16 loss
+    scaling is a no-op numerically, but dynamic-scale bookkeeping is kept so
+    fp16 workflows and checkpoints behave identically."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return wrap(jnp.asarray(self._scale, jnp.float32))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import numpy as np
+
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None:
+                g = unwrap(p.grad) / self._scale
+                p.grad._array = g
+                if not bool(jnp.isfinite(g).all()):
+                    found = True
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_count": self._good_steps,
+            "decr_count": self._bad_steps,
+        }
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("incr_count", 0)
+        self._bad_steps = sd.get("decr_count", 0)
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+class debugging:
+    """Namespace stub mirroring paddle.amp.debugging (nan/inf checks live in
+    utils/debugging.py)."""
+
+    @staticmethod
+    def enable_operator_stats_collection():
+        pass
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        pass

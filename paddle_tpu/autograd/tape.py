@@ -117,6 +117,15 @@ def _zero_cotangent(p):
     return np.zeros(p.shape, dtype=jax.dtypes.float0)
 
 
+def _match_cotangent(g, p):
+    """Cast/derive a cotangent matching primal ``p``'s JAX type."""
+    if g is None:
+        return _zero_cotangent(p)
+    if jnp.issubdtype(p.dtype, jnp.inexact) and g.dtype != p.dtype:
+        return g.astype(p.dtype)
+    return g
+
+
 def backward(tensors, grad_tensors=None, retain_graph: bool = False, grads_out=None):
     """Run reverse-mode accumulation from ``tensors`` over the recorded tape.
 
@@ -168,16 +177,17 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False, grads_out=N
             # custom node (PyLayer): user-supplied backward
             in_grads = node.run_backward(outs, gs)
         else:
-            # fill missing output cotangents with zeros (float0 for int outputs)
+            # fill missing output cotangents with zeros (float0 for int
+            # outputs) and match the primal dtype — under AMP a node's
+            # consumer may run in a different precision than the node itself
             primals_out, vjp_fn = jax.vjp(node.fn, *node.in_arrays)
             if isinstance(primals_out, (tuple, list)):
                 filled = tuple(
-                    g if g is not None else _zero_cotangent(p)
-                    for g, p in zip(gs, primals_out)
+                    _match_cotangent(g, p) for g, p in zip(gs, primals_out)
                 )
                 in_grads = vjp_fn(filled)
             else:
-                in_grads = vjp_fn(gs[0])
+                in_grads = vjp_fn(_match_cotangent(gs[0], primals_out))
         for t, g in zip(node.in_tensors, in_grads):
             if t is None or g is None or t.stop_gradient:
                 continue

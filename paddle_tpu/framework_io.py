@@ -1,0 +1,80 @@
+"""paddle.save / paddle.load parity.
+
+Reference: python/paddle/framework/io.py:773 (save), :1020 (load) — pickled
+nested containers of tensors. Tensors serialize as numpy arrays; on load
+they come back as paddle_tpu Tensors (or stay numpy with return_numpy=True).
+"""
+from __future__ import annotations
+
+import io
+import os
+import pickle
+
+import numpy as np
+
+from .tensor_class import Tensor, Parameter
+
+
+class _TensorPayload:
+    """Pickle surrogate for device tensors."""
+
+    __slots__ = ("array", "is_param", "name", "stop_gradient")
+
+    def __init__(self, array, is_param, name, stop_gradient):
+        self.array = array
+        self.is_param = is_param
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def _encode(obj):
+    if isinstance(obj, Tensor):
+        arr = obj.numpy()
+        # bfloat16 has no numpy dtype guaranteed pickle-stable; ship as u16 view
+        if str(obj.dtype) == "bfloat16":
+            return _TensorPayload(("bf16", arr.view(np.uint16) if arr.dtype != np.uint16 else arr),
+                                  isinstance(obj, Parameter), obj.name, obj.stop_gradient)
+        return _TensorPayload(arr, isinstance(obj, Parameter), obj.name, obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_encode(v) for v in obj)
+    return obj
+
+
+def _decode(obj, return_numpy=False):
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    if isinstance(obj, _TensorPayload):
+        arr = obj.array
+        if isinstance(arr, tuple) and arr[0] == "bf16":
+            arr = arr[1].view(ml_dtypes.bfloat16)
+        if return_numpy:
+            return arr
+        t = Parameter(jnp.asarray(arr), name=obj.name) if obj.is_param else Tensor(jnp.asarray(arr))
+        t.stop_gradient = obj.stop_gradient
+        return t
+    if isinstance(obj, dict):
+        return {k: _decode(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_decode(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    if hasattr(path, "write"):
+        pickle.dump(_encode(obj), path, protocol=protocol)
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_encode(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    if hasattr(path, "read"):
+        return _decode(pickle.load(path), return_numpy)
+    with open(path, "rb") as f:
+        return _decode(pickle.load(f), return_numpy)

@@ -1,0 +1,22 @@
+"""paddle_tpu.io — datasets, samplers, DataLoader.
+
+Reference parity: python/paddle/io/ (Dataset/IterableDataset/TensorDataset/
+Subset/random_split/ConcatDataset/ChainDataset, BatchSampler,
+DistributedBatchSampler at dataloader/batch_sampler.py:192, multiprocess
+DataLoader at dataloader/dataloader_iter.py + worker.py).
+
+TPU-native notes: the hot path feeds jnp arrays; multiprocess workers use the
+standard multiprocessing pool producing numpy batches (host-side), and
+device transfer happens at iteration time (async via jax device_put). The
+reference's shared-memory tensor transport is unnecessary — numpy pickling
+through the pool plays the same role on a single host.
+"""
+from .dataset import (
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split,
+)
+from .sampler import (
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler, SubsetRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info

@@ -1,0 +1,198 @@
+"""DataLoader.
+
+Reference parity: python/paddle/io/DataLoader (+ dataloader_iter.py,
+worker.py): single-process and multi-process iteration, default collate to
+batched tensors, worker_init_fn, prefetch.
+
+TPU-native notes: workers produce numpy batches via a multiprocessing.Pool
+(spawn-safe); conversion to device arrays happens in the consumer so the
+pool never touches jax. Prefetching = pool imap with a lookahead window,
+which plays the role of the reference's _prefetch_factor queue.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, DistributedBatchSampler
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed=0):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched Tensors (reference collate.py)."""
+    from ..tensor_class import Tensor, wrap
+    import jax.numpy as jnp
+
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return wrap(jnp.stack([s._array for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return wrap(jnp.asarray(np.stack(batch)))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return wrap(jnp.asarray(np.asarray(batch)))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return type(sample)(default_collate_fn(list(items)) for items in zip(*batch))
+    return list(batch)
+
+
+def _np_collate(batch):
+    """Worker-side collate: numpy only (pickle-friendly, no jax in workers)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: _np_collate([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return type(sample)(_np_collate(list(items)) for items in zip(*batch))
+    return list(batch)
+
+
+def _to_tensors(obj):
+    from ..tensor_class import wrap
+    import jax.numpy as jnp
+
+    if isinstance(obj, np.ndarray):
+        return wrap(jnp.asarray(obj))
+    if isinstance(obj, dict):
+        return {k: _to_tensors(v) for k, v in obj.items()}
+    if isinstance(obj, (tuple, list)):
+        return type(obj)(_to_tensors(v) for v in obj)
+    return obj
+
+
+class _WorkerTask:
+    """Top-level callable for the pool (picklable)."""
+
+    def __init__(self, dataset, collate_fn, worker_init_fn, num_workers):
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+        self.worker_init_fn = worker_init_fn
+        self.num_workers = num_workers
+        self._initialized = False
+
+    def __call__(self, indices):
+        import multiprocessing as mp
+
+        if not self._initialized:
+            proc = mp.current_process()
+            wid = (proc._identity[0] - 1) % self.num_workers if proc._identity else 0
+            _worker_info.info = WorkerInfo(wid, self.num_workers, self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            self._initialized = True
+        samples = [self.dataset[i] for i in indices]
+        if self.collate_fn is not None:
+            return self.collate_fn(samples)
+        return _np_collate([_as_numpy_sample(s) for s in samples])
+
+
+def _as_numpy_sample(s):
+    from ..tensor_class import Tensor
+
+    if isinstance(s, Tensor):
+        return s.numpy()
+    if isinstance(s, dict):
+        return {k: _as_numpy_sample(v) for k, v in s.items()}
+    if isinstance(s, (tuple, list)):
+        return type(s)(_as_numpy_sample(v) for v in s)
+    return s
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.collate_fn = collate_fn
+        self.worker_init_fn = worker_init_fn
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        self._pool = None
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(dataset=dataset, shuffle=shuffle,
+                                                  batch_size=batch_size, drop_last=drop_last)
+                self.batch_size = batch_size
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if self.batch_size is not None and len(batch) == self.batch_size:
+                yield self._collate(batch)
+                batch = []
+        if batch and not getattr(self, "drop_last", False):
+            yield self._collate(batch)
+
+    def _collate(self, samples):
+        if self.collate_fn is not None:
+            return self.collate_fn(samples)
+        return default_collate_fn(samples)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            yield from self._iter_iterable()
+            return
+        if self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield self._collate([self.dataset[i]])
+            return
+        if self.num_workers <= 0:
+            for indices in self.batch_sampler:
+                samples = [self.dataset[i] for i in indices]
+                yield self._collate(samples)
+            return
+        # multiprocess path: pool imap with prefetch lookahead. A user
+        # collate_fn runs worker-side (must be picklable, as in the reference).
+        import multiprocessing as mp
+
+        task = _WorkerTask(self.dataset, self.collate_fn, self.worker_init_fn, self.num_workers)
+        ctx = mp.get_context("fork")
+        with ctx.Pool(self.num_workers) as pool:
+            for np_batch in pool.imap(task, self.batch_sampler, chunksize=1):
+                yield _to_tensors(np_batch)

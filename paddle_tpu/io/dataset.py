@@ -1,0 +1,117 @@
+"""Dataset types (reference python/paddle/io/dataloader/dataset.py)."""
+from __future__ import annotations
+
+import bisect
+from typing import Iterable, List, Sequence
+
+
+class Dataset:
+    def __getitem__(self, idx):  # pragma: no cover - abstract
+        raise NotImplementedError(f"{type(self).__name__} must implement __getitem__")
+
+    def __len__(self):  # pragma: no cover - abstract
+        raise NotImplementedError(f"{type(self).__name__} must implement __len__")
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):  # pragma: no cover - abstract
+        raise NotImplementedError(f"{type(self).__name__} must implement __iter__")
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset does not support indexing")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        from ..tensor_class import unwrap
+
+        lengths = {t.shape[0] if hasattr(t, "shape") else len(t) for t in tensors}
+        assert len(lengths) == 1, "all tensors must have the same first dimension"
+        self.tensors = tensors
+
+    def __getitem__(self, index):
+        return tuple(t[index] for t in self.tensors)
+
+    def __len__(self):
+        t = self.tensors[0]
+        return t.shape[0] if hasattr(t, "shape") else len(t)
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert len({len(d) for d in self.datasets}) == 1
+
+    def __getitem__(self, index):
+        out = []
+        for d in self.datasets:
+            sample = d[index]
+            out.extend(sample if isinstance(sample, (tuple, list)) else [sample])
+        return tuple(out)
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cumulative_sizes = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self.cumulative_sizes.append(total)
+
+    def __len__(self):
+        return self.cumulative_sizes[-1] if self.cumulative_sizes else 0
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        ds_idx = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = self.cumulative_sizes[ds_idx - 1] if ds_idx > 0 else 0
+        return self.datasets[ds_idx][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    import numpy as np
+
+    lengths = list(lengths)
+    if all(isinstance(l, float) for l in lengths) and abs(sum(lengths) - 1.0) < 1e-6:
+        n = len(dataset)
+        counts = [int(np.floor(n * l)) for l in lengths]
+        rem = n - sum(counts)
+        for i in range(rem):
+            counts[i % len(counts)] += 1
+        lengths = counts
+    assert sum(lengths) == len(dataset)
+    perm = np.random.permutation(len(dataset)).tolist()
+    out, offset = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[offset : offset + l]))
+        offset += l
+    return out

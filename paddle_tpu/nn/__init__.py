@@ -1,0 +1,46 @@
+"""paddle_tpu.nn — layers, functional, initializers.
+
+Reference parity: python/paddle/nn/__init__.py.
+"""
+from . import functional
+from . import initializer
+from .layer import Layer, HookRemoveHelper
+from .initializer_core import ParamAttr
+from .container import Sequential, LayerList, LayerDict, ParameterList
+from .layers_common import (
+    Linear, Identity, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm, SpectralNorm,
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+    AvgPool1D, AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+    Flatten, Unflatten, Pad1D, Pad2D, Pad3D, ZeroPad2D, PixelShuffle,
+    PixelUnshuffle, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    CosineSimilarity, Bilinear,
+)
+from .layers_act_loss import (
+    ReLU, ReLU6, GELU, SiLU, Swish, Mish, ELU, SELU, CELU, LeakyReLU,
+    Hardshrink, Hardsigmoid, Hardswish, Hardtanh, LogSigmoid, LogSoftmax,
+    Softmax, Softmax2D, Softplus, Softshrink, Softsign, Tanh, Tanhshrink,
+    ThresholdedReLU, Sigmoid, GLU, RReLU, Maxout, PReLU,
+    CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, HuberLoss, BCELoss,
+    BCEWithLogitsLoss, KLDivLoss, NLLLoss, MarginRankingLoss,
+    HingeEmbeddingLoss, CosineEmbeddingLoss, TripletMarginLoss, CTCLoss,
+)
+from .transformer import (
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .rnn import SimpleRNN, LSTM, GRU, RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell
+from ..tensor_class import Parameter
+
+
+def __getattr__(name):
+    if name == "utils":
+        from . import utils as _u
+
+        globals()["utils"] = _u
+        return _u
+    raise AttributeError(f"module 'paddle_tpu.nn' has no attribute {name!r}")

@@ -1,0 +1,30 @@
+"""paddle_tpu.nn.functional — the F.* surface.
+
+Reference parity: python/paddle/nn/functional/__init__.py.
+"""
+from .activation import *  # noqa: F401,F403
+from .common import (  # noqa: F401
+    linear, embedding, one_hot, dropout, dropout2d, dropout3d, alpha_dropout,
+    normalize, layer_norm, rms_norm, batch_norm, group_norm, instance_norm,
+    local_response_norm, label_smooth, cosine_similarity, pixel_shuffle,
+    pixel_unshuffle, unfold, interpolate, upsample, sequence_mask,
+    temporal_shift,
+)
+from .conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+    conv3d_transpose, avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d,
+    max_pool2d, max_pool3d, adaptive_avg_pool1d, adaptive_avg_pool2d,
+    adaptive_avg_pool3d, adaptive_max_pool1d, adaptive_max_pool2d,
+    adaptive_max_pool3d,
+)
+from .loss import (  # noqa: F401
+    cross_entropy, softmax_with_cross_entropy, nll_loss, binary_cross_entropy,
+    binary_cross_entropy_with_logits, mse_loss, l1_loss, smooth_l1_loss,
+    huber_loss, kl_div, margin_ranking_loss, hinge_embedding_loss,
+    cosine_embedding_loss, triplet_margin_loss, log_loss, square_error_cost,
+    sigmoid_focal_loss, ctc_loss,
+)
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention, flash_attention,
+)
+from ...ops.manipulation import pad  # noqa: F401  (F.pad parity)

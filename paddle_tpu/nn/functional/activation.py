@@ -1,0 +1,208 @@
+"""Activation functionals.
+
+Reference parity: python/paddle/nn/functional/activation.py (+ the phi
+activation kernels). All are single jnp expressions — XLA fuses them into
+surrounding matmuls, which is the TPU replacement for the reference's fused
+activation CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import apply, defop
+from ...framework import random as _random
+
+
+@defop("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@defop("relu6")
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@defop("relu_")
+def relu_(x):
+    return jax.nn.relu(x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+@defop("silu")
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+@defop("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda a: jax.nn.elu(a, alpha=alpha), x)
+
+
+@defop("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda a: jax.nn.celu(a, alpha=alpha), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope=negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        # per-channel weight
+        ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return apply("prelu", fn, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    if training:
+        key = _random.next_key()
+
+        def fn(a):
+            slope = jax.random.uniform(key, a.shape, dtype=a.dtype, minval=lower, maxval=upper)
+            return jnp.where(a >= 0, a, slope * a)
+
+        return apply("rrelu", fn, x)
+    mid = (lower + upper) / 2.0
+    return apply("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply("hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+@defop("hardswish")
+def hardswish(x):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+@defop("log_sigmoid")
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply("log_softmax", fn, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply("softmax", fn, x)
+
+
+softmax_ = softmax
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def fn(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a, jax.nn.softplus(scaled) / beta)
+
+    return apply("softplus", fn, x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)),
+        x,
+    )
+
+
+@defop("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@defop("tanhshrink")
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply("thresholded_relu", lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return apply("maxout", fn, x)
+
+
+def tanh(x, name=None):
+    from ...ops import math as _math
+
+    return _math.tanh(x)
+
+
+def sigmoid(x, name=None):
+    from ...ops import math as _math
+
+    return _math.sigmoid(x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = _random.next_key()
+
+    def fn(a):
+        g = jax.random.gumbel(key, a.shape, dtype=a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y  # straight-through estimator
+        return y
+
+    return apply("gumbel_softmax", fn, x)

@@ -1,0 +1,91 @@
+"""Attention functionals: SDPA + flash attention.
+
+Reference parity: python/paddle/nn/functional/flash_attention.py:195
+(wrapping paddle/phi/kernels/gpu/flash_attn_kernel.cu) and
+scaled_dot_product_attention. TPU-native: the fused path is a Pallas flash
+kernel (ops/pallas/flash_attention.py); the fallback is pure-XLA SDPA which
+XLA fuses reasonably. Layout follows paddle flash_attention: [batch, seqlen,
+num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import apply
+from ...framework import random as _random
+
+
+def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None, dropout_key=None):
+    """Pure-XLA SDPA on [B, S, H, D] layout, f32 softmax accumulation."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    # [B,H,Sq,Sk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * s
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(cm, scores, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -jnp.inf)
+        else:
+            scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention parity.
+    Layout [batch, seq, heads, head_dim]."""
+    dk = _random.next_key() if (dropout_p > 0.0 and training) else None
+
+    def fn(q, k, v, *m):
+        mask = m[0] if m else None
+        return _sdpa_ref(q, k, v, mask=mask, dropout=dropout_p if training else 0.0,
+                         causal=is_causal, dropout_key=dk)
+
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+    return apply("sdpa", fn, *args)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity
+    (python/paddle/nn/functional/flash_attention.py:195).
+
+    Dispatches to the Pallas TPU flash kernel when running on TPU with
+    supported shapes; otherwise the XLA SDPA reference. Returns
+    (out, softmax_lse-like None) tuple to match the reference's (out, softmax)
+    when return_softmax=False.
+    """
+    from ...ops.pallas import flash_attention as pallas_flash
+
+    dk = _random.next_key() if (dropout > 0.0 and training) else None
+
+    def fn(q, k, v):
+        if pallas_flash.supported(q, k, v, dropout):
+            return pallas_flash.flash_attention_bshd(q, k, v, causal=causal)
+        return _sdpa_ref(q, k, v, dropout=dropout if training else 0.0, causal=causal, dropout_key=dk)
+
+    out = apply("flash_attention", fn, query, key, value)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError("varlen flash attention lands with the serving stack")
+
+
+def sdp_kernel(*args, **kwargs):  # config context stub (torch-compat in ref)
+    import contextlib
+
+    return contextlib.nullcontext()

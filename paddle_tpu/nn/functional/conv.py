@@ -1,0 +1,238 @@
+"""Convolution / pooling functionals over jax.lax.
+
+Reference parity: python/paddle/nn/functional/{conv,pooling}.py (kernels:
+paddle/phi/kernels/gpudnn/conv_kernel.cu etc.). Convs are MXU ops on TPU —
+jax.lax.conv_general_dilated lowers to XLA convolution which maps directly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.registry import apply
+from ...tensor_class import unwrap
+
+
+def _tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _conv_padding(padding, n, kernel, dilation):
+    """Normalise paddle padding spec → lax padding list of (lo, hi)."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dimension_numbers(ndim, channel_last):
+    if ndim == 3:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if ndim == 4:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format, transpose=False, output_padding=0):
+    channel_last = data_format[-1] == "C"
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+
+    def fn(a, w, *b):
+        if transpose:
+            # Transposed conv as a fractionally-strided conv: dilate the input
+            # by `stride` (lhs_dilation) and run a unit-stride conv with the
+            # spatially-flipped kernel. Paddle weight layout is
+            # [in, out/groups, *k] → regroup to [out, in/groups, *k].
+            cin = w.shape[0]
+            w_g = w.reshape(groups, cin // groups, w.shape[1], *w.shape[2:])
+            w_oi = jnp.swapaxes(w_g, 1, 2).reshape(groups * w.shape[1], cin // groups, *w.shape[2:])
+            w_oi = jnp.flip(w_oi, axis=tuple(range(2, 2 + n)))
+            pad = _conv_padding(padding, n, None, None)
+            if isinstance(pad, str):
+                raise ValueError("string padding unsupported for conv_transpose")
+            opad = _tuple(output_padding, n)
+            kshape = w.shape[2:]
+            tpad = [
+                (dil[i] * (kshape[i] - 1) - pad[i][0],
+                 dil[i] * (kshape[i] - 1) - pad[i][1] + opad[i])
+                for i in range(n)
+            ]
+            dn = jax.lax.conv_dimension_numbers(a.shape, w_oi.shape, _dimension_numbers(a.ndim, channel_last))
+            out = jax.lax.conv_general_dilated(
+                a, w_oi, (1,) * n, tpad, lhs_dilation=strides, rhs_dilation=dil,
+                dimension_numbers=dn, feature_group_count=groups,
+            )
+        else:
+            dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, _dimension_numbers(a.ndim, channel_last))
+            pad = _conv_padding(padding, n, w.shape, dil)
+            out = jax.lax.conv_general_dilated(
+                a, w, strides, pad, rhs_dilation=dil, dimension_numbers=dn,
+                feature_group_count=groups,
+            )
+        if b:
+            shape = [1] * out.ndim
+            ch_axis = out.ndim - 1 if channel_last else 1
+            shape[ch_axis] = b[0].size
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply("conv_transpose" if transpose else "conv", fn, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format, True, output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format, True, output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format, True, output_padding)
+
+
+# ---- pooling -----------------------------------------------------------------
+
+def _pool(x, kernel, stride, padding, n, data_format, reducer, init, ceil_mode=False,
+          count_include_pad=True, divisor_override=None, average=False):
+    channel_last = data_format[-1] == "C"
+    ks = _tuple(kernel, n)
+    st = _tuple(stride if stride is not None else kernel, n)
+
+    def fn(a):
+        if channel_last:
+            window = (1, *ks, 1)
+            strides = (1, *st, 1)
+            sp_dims = list(range(1, 1 + n))
+        else:
+            window = (1, 1, *ks)
+            strides = (1, 1, *st)
+            sp_dims = list(range(2, 2 + n))
+        pad = _conv_padding(padding, n, ks, None)
+        if isinstance(pad, str):
+            pad_cfg = pad
+        else:
+            pad_cfg = [(0, 0)] * a.ndim
+            for d, p in zip(sp_dims, pad):
+                pad_cfg[d] = p
+        if average:
+            summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pad_cfg)
+            if divisor_override:
+                return summed / divisor_override
+            if count_include_pad or (isinstance(pad_cfg, str) or all(p == (0, 0) for p in pad_cfg)):
+                return summed / np.prod(ks)
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pad_cfg)
+            return summed / counts
+        return jax.lax.reduce_window(a, init, reducer, window, strides, pad_cfg)
+
+    return apply("pool", fn, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCL", jax.lax.add, 0.0, ceil_mode,
+                 count_include_pad=not exclusive, average=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.add, 0.0, ceil_mode,
+                 count_include_pad=not exclusive, divisor_override=divisor_override, average=True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.add, 0.0, ceil_mode,
+                 count_include_pad=not exclusive, divisor_override=divisor_override, average=True)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCL", jax.lax.max, -jnp.inf)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.max, -jnp.inf)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.max, -jnp.inf)
+
+
+def _adaptive_pool(x, output_size, n, data_format, average):
+    channel_last = data_format[-1] == "C"
+    out_sizes = _tuple(output_size, n)
+
+    def fn(a):
+        sp_dims = list(range(1, 1 + n)) if channel_last else list(range(2, 2 + n))
+        out = a
+        for d, o in zip(sp_dims, out_sizes):
+            s = out.shape[d]
+            if s % o == 0:
+                k = s // o
+                new_shape = out.shape[:d] + (o, k) + out.shape[d + 1:]
+                r = out.reshape(new_shape)
+                out = jnp.mean(r, axis=d + 1) if average else jnp.max(r, axis=d + 1)
+            else:
+                # general case: per-output-bin slices
+                pieces = []
+                for i in range(o):
+                    lo = (i * s) // o
+                    hi = -(-((i + 1) * s) // o)
+                    sl = jax.lax.slice_in_dim(out, lo, hi, axis=d)
+                    pieces.append(jnp.mean(sl, axis=d, keepdims=True) if average else jnp.max(sl, axis=d, keepdims=True))
+                out = jnp.concatenate(pieces, axis=d)
+        return out
+
+    return apply("adaptive_pool", fn, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", True)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, True)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, True)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", False)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", False)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", False)

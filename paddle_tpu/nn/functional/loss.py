@@ -1,0 +1,244 @@
+"""Loss functionals.
+
+Reference parity: python/paddle/nn/functional/loss.py. Cross-entropy follows
+the reference's softmax_with_cross_entropy semantics (integer or soft labels,
+ignore_index, label smoothing via label_smooth + soft labels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import apply
+from ...tensor_class import unwrap
+
+
+def _reduce(loss, reduction, weight_sum=None):
+    if reduction == "mean":
+        if weight_sum is not None:
+            return jnp.sum(loss) / jnp.maximum(weight_sum, 1e-12)
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    def fn(logits, lbl, *w):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(jnp.clip(logits, 1e-30, None))
+        is_soft = soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape and jnp.issubdtype(lbl.dtype, jnp.inexact))
+        safe_idx = None
+        if is_soft:
+            soft = lbl
+            if label_smoothing > 0.0:
+                k = logits.shape[axis]
+                soft = (1 - label_smoothing) * soft + label_smoothing / k
+            loss = -jnp.sum(soft * logp, axis=axis)
+            mask = None
+        else:
+            idx = lbl.astype(jnp.int32)
+            if idx.ndim == logits.ndim:  # trailing [..., 1] label
+                idx = jnp.squeeze(idx, axis=axis)
+            mask = idx != ignore_index
+            safe_idx = jnp.where(mask, idx, 0)
+            if label_smoothing > 0.0:
+                k = logits.shape[axis]
+                nll = -jnp.take_along_axis(logp, safe_idx[..., None], axis=axis)[..., 0]
+                smooth = -jnp.mean(logp, axis=axis)
+                loss = (1 - label_smoothing) * nll + label_smoothing * smooth
+            else:
+                loss = -jnp.take_along_axis(logp, safe_idx[..., None], axis=axis)[..., 0]
+            loss = jnp.where(mask, loss, 0.0)
+        wsum = None
+        if w:
+            cw = jnp.take(w[0], safe_idx if safe_idx is not None else jnp.argmax(lbl, axis=axis), axis=0)
+            if mask is not None:
+                cw = jnp.where(mask, cw, 0.0)
+            loss = loss * cw
+            wsum = jnp.sum(cw)
+        elif mask is not None and reduction == "mean":
+            wsum = jnp.sum(mask.astype(loss.dtype))
+        return _reduce(loss, reduction, wsum)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply("cross_entropy", fn, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from . import activation
+
+    loss = loss.unsqueeze(axis) if loss.ndim < unwrap(logits).ndim else loss
+    if return_softmax:
+        return loss, activation.softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    return cross_entropy(input, label, weight=weight, ignore_index=ignore_index,
+                         reduction=reduction, use_softmax=False, soft_label=False)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, l, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(l * jnp.log(p) + (1 - l) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply("binary_cross_entropy", fn, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, l, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]
+            i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable formulation
+        neg_abs = -jnp.abs(z)
+        if pw is not None:
+            log_weight = 1 + (pw - 1) * l
+            loss = (1 - l) * z + log_weight * (jnp.log1p(jnp.exp(neg_abs)) + jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0.0) - z * l + jnp.log1p(jnp.exp(neg_abs))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [logit, label] + [t for t in (weight, pos_weight) if t is not None]
+    return apply("bce_with_logits", fn, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply("mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta, jnp.abs(d) - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return apply("smooth_l1", fn, input, label)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply("huber", fn, input, label)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(logp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - logp)
+        else:
+            loss = t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply("kl_div", fn, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, l):
+        return _reduce(jnp.maximum(0.0, -l * (a - b) + margin), reduction)
+
+    return apply("margin_ranking", fn, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, l):
+        loss = jnp.where(l == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+
+    return apply("hinge_embedding", fn, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, l):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(l == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply("cosine_embedding", fn, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p), axis=-1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p), axis=-1), 1 / p)
+        if swap:
+            dsn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon, p), axis=-1), 1 / p)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply("triplet_margin", fn, input, positive, negative)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, l):
+        return -l * jnp.log(p + epsilon) - (1 - l) * jnp.log(1 - p + epsilon)
+
+    return apply("log_loss", fn, input, label)
+
+
+def square_error_cost(input, label):
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def fn(z, l, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0.0) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * l + (1 - p) * (1 - l)
+        loss = ce * ((1 - p_t) ** gamma)
+        if alpha >= 0:
+            alpha_t = alpha * l + (1 - alpha) * (1 - l)
+            loss = alpha_t * loss
+        if nrm:
+            loss = loss / nrm[0]
+        return _reduce(loss, reduction)
+
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply("sigmoid_focal", fn, *args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via jax: log_probs [T, B, C] (paddle layout)."""
+    import optax
+
+    def fn(lp, lbl, il, ll):
+        # optax ctc expects [B, T, C] logits and padded labels
+        logits = jnp.transpose(lp, (1, 0, 2))
+        B, T, C = logits.shape
+        logit_padding = (jnp.arange(T)[None, :] >= il[:, None]).astype(jnp.float32)
+        label_padding = (jnp.arange(lbl.shape[1])[None, :] >= ll[:, None]).astype(jnp.float32)
+        loss = optax.ctc_loss(logits, logit_padding, lbl.astype(jnp.int32), label_padding, blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(ll.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply("ctc_loss", fn, log_probs, labels, input_lengths, label_lengths)

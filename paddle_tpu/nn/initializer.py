@@ -1,0 +1,21 @@
+"""paddle_tpu.nn.initializer namespace (reference python/paddle/nn/initializer/)."""
+from .initializer_core import (
+    Initializer,
+    Constant,
+    Normal,
+    TruncatedNormal,
+    Uniform,
+    XavierNormal,
+    XavierUniform,
+    KaimingNormal,
+    KaimingUniform,
+    Assign,
+    Orthogonal,
+    Dirac,
+    calculate_gain,
+)
+
+# paddle also exposes lowercase aliases in nn.initializer
+constant = Constant
+normal = Normal
+uniform = Uniform

@@ -1,0 +1,141 @@
+"""Activation layers and loss layers (thin wrappers over nn.functional).
+
+Reference parity: python/paddle/nn/layer/{activation,loss}.py.
+"""
+from __future__ import annotations
+
+from .layer import Layer
+from .functional import activation as F_act
+from .functional import loss as F_loss
+from .functional import common as F_common
+
+
+def _act_layer(name, fn, params=()):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kw = {}
+            for p, v in zip(params, args):
+                self._kw[p] = v
+            for p in params:
+                if p in kwargs:
+                    self._kw[p] = kwargs[p]
+
+        def forward(self, x):
+            return fn(x, **self._kw)
+
+        def extra_repr(self):
+            return ", ".join(f"{k}={v}" for k, v in self._kw.items())
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", lambda x: F_act.relu(x))
+ReLU6 = _act_layer("ReLU6", lambda x: F_act.relu6(x))
+GELU = _act_layer("GELU", F_act.gelu, ("approximate",))
+SiLU = _act_layer("SiLU", lambda x: F_act.silu(x))
+Swish = SiLU
+Mish = _act_layer("Mish", lambda x: F_act.mish(x))
+ELU = _act_layer("ELU", F_act.elu, ("alpha",))
+SELU = _act_layer("SELU", lambda x, scale=1.0507009873554805, alpha=1.6732632423543772: F_act.selu(x), ("scale", "alpha"))
+CELU = _act_layer("CELU", F_act.celu, ("alpha",))
+LeakyReLU = _act_layer("LeakyReLU", F_act.leaky_relu, ("negative_slope",))
+Hardshrink = _act_layer("Hardshrink", F_act.hardshrink, ("threshold",))
+Hardsigmoid = _act_layer("Hardsigmoid", lambda x: F_act.hardsigmoid(x))
+Hardswish = _act_layer("Hardswish", lambda x: F_act.hardswish(x))
+Hardtanh = _act_layer("Hardtanh", F_act.hardtanh, ("min", "max"))
+LogSigmoid = _act_layer("LogSigmoid", lambda x: F_act.log_sigmoid(x))
+LogSoftmax = _act_layer("LogSoftmax", F_act.log_softmax, ("axis",))
+Softmax = _act_layer("Softmax", F_act.softmax, ("axis",))
+Softmax2D = _act_layer("Softmax2D", lambda x: F_act.softmax(x, axis=-3))
+Softplus = _act_layer("Softplus", F_act.softplus, ("beta", "threshold"))
+Softshrink = _act_layer("Softshrink", F_act.softshrink, ("threshold",))
+Softsign = _act_layer("Softsign", lambda x: F_act.softsign(x))
+Tanh = _act_layer("Tanh", lambda x: F_act.tanh(x))
+Tanhshrink = _act_layer("Tanhshrink", lambda x: F_act.tanhshrink(x))
+ThresholdedReLU = _act_layer("ThresholdedReLU", F_act.thresholded_relu, ("threshold", "value"))
+Sigmoid = _act_layer("Sigmoid", lambda x: F_act.sigmoid(x))
+GLU = _act_layer("GLU", F_act.glu, ("axis",))
+RReLU = _act_layer("RReLU", F_act.rrelu, ("lower", "upper"))
+Maxout = _act_layer("Maxout", F_act.maxout, ("groups", "axis"))
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        from .initializer_core import Constant
+
+        self._data_format = data_format
+        self.weight = self.create_parameter([num_parameters], attr=weight_attr,
+                                            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F_act.prelu(x, self.weight, self._data_format)
+
+
+# ---- loss layers -------------------------------------------------------------
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean",
+                 soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+        super().__init__()
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+        self.soft_label = soft_label
+        self.axis = axis
+        self.use_softmax = use_softmax
+        self.label_smoothing = label_smoothing
+
+    def forward(self, input, label):
+        return F_loss.cross_entropy(input, label, self.weight, self.ignore_index,
+                                    self.reduction, self.soft_label, self.axis,
+                                    self.use_softmax, self.label_smoothing)
+
+
+def _loss_layer(name, fn, params):
+    class _Loss(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kw = {}
+            for p, v in zip(params, args):
+                self._kw[p] = v
+            for p in params:
+                if p in kwargs:
+                    self._kw[p] = kwargs[p]
+
+        def forward(self, *inputs):
+            return fn(*inputs, **self._kw)
+
+    _Loss.__name__ = name
+    _Loss.__qualname__ = name
+    return _Loss
+
+
+MSELoss = _loss_layer("MSELoss", F_loss.mse_loss, ("reduction",))
+L1Loss = _loss_layer("L1Loss", F_loss.l1_loss, ("reduction",))
+SmoothL1Loss = _loss_layer("SmoothL1Loss", F_loss.smooth_l1_loss, ("reduction", "delta"))
+HuberLoss = _loss_layer("HuberLoss", F_loss.huber_loss, ("delta", "reduction"))
+BCELoss = _loss_layer("BCELoss", F_loss.binary_cross_entropy, ("weight", "reduction"))
+BCEWithLogitsLoss = _loss_layer("BCEWithLogitsLoss", F_loss.binary_cross_entropy_with_logits,
+                                ("weight", "reduction", "pos_weight"))
+KLDivLoss = _loss_layer("KLDivLoss", F_loss.kl_div, ("reduction",))
+NLLLoss = _loss_layer("NLLLoss", F_loss.nll_loss, ("weight", "ignore_index", "reduction"))
+MarginRankingLoss = _loss_layer("MarginRankingLoss", F_loss.margin_ranking_loss, ("margin", "reduction"))
+HingeEmbeddingLoss = _loss_layer("HingeEmbeddingLoss", F_loss.hinge_embedding_loss, ("margin", "reduction"))
+CosineEmbeddingLoss = _loss_layer("CosineEmbeddingLoss", F_loss.cosine_embedding_loss, ("margin", "reduction"))
+TripletMarginLoss = _loss_layer("TripletMarginLoss", F_loss.triplet_margin_loss,
+                                ("margin", "p", "epsilon", "swap", "reduction"))
+CTCLoss = _loss_layer("CTCLoss", F_loss.ctc_loss, ("blank", "reduction"))
+
+
+class CTCLoss(Layer):  # noqa: F811 - needs arg reordering vs functional
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, logits, labels, input_lengths, label_lengths, norm_by_times=False):
+        return F_loss.ctc_loss(logits, labels, input_lengths, label_lengths,
+                               self.blank, self.reduction, norm_by_times)

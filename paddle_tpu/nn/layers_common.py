@@ -1,0 +1,614 @@
+"""Core nn layers.
+
+Reference parity: python/paddle/nn/layer/{common,norm,conv,pooling,
+transformer}.py. Weight layouts match the reference exactly (Linear weight is
+[in, out]; Conv weight [out, in/groups, *k]) so state_dicts transfer.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from .layer import Layer
+from .initializer_core import (
+    Constant, KaimingUniform, Normal, ParamAttr, Uniform, XavierNormal,
+)
+from ..tensor_class import Tensor, wrap, unwrap
+from ..framework import dtype as _dtype_mod
+from .functional import (
+    activation as F_act,
+    common as F_common,
+    conv as F_conv,
+    attention as F_attn,
+)
+from . import functional as F
+
+
+class Linear(Layer):
+    """y = xW + b, weight [in_features, out_features]
+    (reference python/paddle/nn/layer/common.py::Linear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        self.bias = self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True,
+        )
+
+    def forward(self, x):
+        return F_common.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in_features={self.in_features}, out_features={self.out_features}"
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None, sparse=False,
+                 weight_attr=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        if padding_idx is not None:
+            self.weight._array = self.weight._array.at[padding_idx].set(0.0)
+
+    def forward(self, x):
+        return F_common.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+    def extra_repr(self):
+        return f"{self._num_embeddings}, {self._embedding_dim}"
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x):
+        return F_common.dropout(x, self.p, axis=self.axis, training=self.training, mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}"
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F_common.dropout2d(x, self.p, training=self.training, data_format=self.data_format)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F_common.dropout3d(x, self.p, training=self.training, data_format=self.data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F_common.alpha_dropout(x, self.p, training=self.training)
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = None if weight_attr is False else self.create_parameter(
+            self._normalized_shape, attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            self._normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F_common.layer_norm(x, self._normalized_shape, self.weight, self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}, epsilon={self._epsilon}"
+
+
+class RMSNorm(Layer):
+    """paddle.incubate fused_rms_norm parity; Pallas-fused on TPU."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self._epsilon = epsilon
+        self.weight = self.create_parameter([hidden_size], attr=weight_attr,
+                                            default_initializer=Constant(1.0))
+
+    def forward(self, x):
+        from ..ops.registry import apply
+        from ..ops.pallas import fused_norm
+
+        return apply("rms_norm", lambda a, w: fused_norm.rms_norm(a, w, self._epsilon), x, self.weight)
+
+    def extra_repr(self):
+        return f"hidden_size={self.hidden_size}, epsilon={self._epsilon}"
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_features], attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True)
+        self._mean = self.register_buffer("_mean", wrap(jnp.zeros(num_features, jnp.float32)))
+        self._variance = self.register_buffer("_variance", wrap(jnp.ones(num_features, jnp.float32)))
+
+    def forward(self, x):
+        return F_common.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum, epsilon=self._epsilon,
+            data_format=self._data_format, use_global_stats=self._use_global_stats,
+        )
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, momentum={self._momentum}, epsilon={self._epsilon}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCL", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr, data_format, use_global_stats)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr, data_format, use_global_stats)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Under GSPMD, batch stats are computed over the global (sharded) batch
+    inside pjit — sync comes from the partitioner, so this is BatchNorm with
+    the conversion helper for API parity."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, cls):
+            new = cls(layer._num_features, layer._momentum, layer._epsilon,
+                      data_format=layer._data_format)
+            new.weight, new.bias = layer.weight, layer.bias
+            new._mean, new._variance = layer._mean, layer._variance
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._num_channels = num_channels
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_channels], attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F_common.group_norm(x, self._num_groups, self.weight, self.bias,
+                                   self._epsilon, self._data_format)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCL", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.scale = None if weight_attr is False else self.create_parameter(
+            [num_features], attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F_common.instance_norm(x, weight=self.scale, bias=self.bias,
+                                      eps=self._epsilon, data_format=self._data_format)
+
+
+class InstanceNorm2D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr, data_format)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW", name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr, data_format)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F_common.local_response_norm(x, self.size, self.alpha, self.beta, self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12, dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter([h], default_initializer=Normal(0, 1))
+        self.weight_v = self.create_parameter([w], default_initializer=Normal(0, 1))
+
+    def forward(self, weight):
+        from ..ops.registry import apply
+
+        def fn(w, u, v):
+            mat = jnp.moveaxis(w, self._dim, 0).reshape(w.shape[self._dim], -1)
+            for _ in range(self._power_iters):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + self._epsilon)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + self._epsilon)
+            sigma = u @ mat @ v
+            return w / sigma
+
+        return apply("spectral_norm", fn, weight, self.weight_u, self.weight_v)
+
+
+# ---- conv layers -------------------------------------------------------------
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, n, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW", transpose=False, output_padding=0):
+        super().__init__()
+        ks = (kernel_size,) * n if isinstance(kernel_size, int) else tuple(kernel_size)
+        self._in_channels, self._out_channels = in_channels, out_channels
+        self._kernel_size = ks
+        self._stride, self._padding, self._dilation = stride, padding, dilation
+        self._groups, self._data_format = groups, data_format
+        self._transpose, self._output_padding = transpose, output_padding
+        if transpose:
+            wshape = [in_channels, out_channels // groups, *ks]
+        else:
+            wshape = [out_channels, in_channels // groups, *ks]
+        fan_in = in_channels * int(np.prod(ks)) // groups
+        self.weight = self.create_parameter(
+            wshape, attr=weight_attr,
+            default_initializer=KaimingUniform(fan_in=fan_in, negative_slope=math.sqrt(5), nonlinearity="leaky_relu"),
+        )
+        bound = 1 / math.sqrt(fan_in)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=Uniform(-bound, bound) if bias_attr is None else None,
+        )
+
+    def extra_repr(self):
+        return (f"{self._in_channels}, {self._out_channels}, kernel_size={self._kernel_size}, "
+                f"stride={self._stride}, padding={self._padding}")
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F_conv.conv1d(x, self.weight, self.bias, self._stride, self._padding,
+                             self._dilation, self._groups, self._data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F_conv.conv2d(x, self.weight, self.bias, self._stride, self._padding,
+                             self._dilation, self._groups, self._data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F_conv.conv3d(x, self.weight, self.bias, self._stride, self._padding,
+                             self._dilation, self._groups, self._data_format)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, groups=1, dilation=1, weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride, padding,
+                         dilation, groups, "zeros", weight_attr, bias_attr, data_format,
+                         transpose=True, output_padding=output_padding)
+
+    def forward(self, x):
+        return F_conv.conv1d_transpose(x, self.weight, self.bias, self._stride, self._padding,
+                                       self._output_padding, self._groups, self._dilation,
+                                       data_format=self._data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, groups=1, dilation=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride, padding,
+                         dilation, groups, "zeros", weight_attr, bias_attr, data_format,
+                         transpose=True, output_padding=output_padding)
+
+    def forward(self, x):
+        return F_conv.conv2d_transpose(x, self.weight, self.bias, self._stride, self._padding,
+                                       self._output_padding, self._groups, self._dilation,
+                                       data_format=self._data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, groups=1, dilation=1, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride, padding,
+                         dilation, groups, "zeros", weight_attr, bias_attr, data_format,
+                         transpose=True, output_padding=output_padding)
+
+    def forward(self, x):
+        return F_conv.conv3d_transpose(x, self.weight, self.bias, self._stride, self._padding,
+                                       self._output_padding, self._groups, self._dilation,
+                                       data_format=self._data_format)
+
+
+# ---- pooling layers ----------------------------------------------------------
+
+def _make_pool_layer(fn_name, n):
+    fn = getattr(F_conv, fn_name)
+
+    class _Pool(Layer):
+        def __init__(self, kernel_size=None, stride=None, padding=0, **kwargs):
+            super().__init__()
+            self.kernel_size = kernel_size
+            self.stride = stride
+            self.padding = padding
+            self.kwargs = {k: v for k, v in kwargs.items() if k != "name"}
+
+        def forward(self, x):
+            return fn(x, self.kernel_size, self.stride, self.padding, **self.kwargs)
+
+    _Pool.__name__ = "".join(p.capitalize() for p in fn_name.split("_"))
+    return _Pool
+
+
+AvgPool1D = _make_pool_layer("avg_pool1d", 1)
+AvgPool2D = _make_pool_layer("avg_pool2d", 2)
+AvgPool3D = _make_pool_layer("avg_pool3d", 3)
+MaxPool1D = _make_pool_layer("max_pool1d", 1)
+MaxPool2D = _make_pool_layer("max_pool2d", 2)
+MaxPool3D = _make_pool_layer("max_pool3d", 3)
+
+
+class _AdaptivePool(Layer):
+    def __init__(self, output_size, fn, **kw):
+        super().__init__()
+        self.output_size = output_size
+        self._fn = fn
+        self._kw = kw
+
+    def forward(self, x):
+        return self._fn(x, self.output_size, **self._kw)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    def __init__(self, output_size, name=None):
+        super().__init__(output_size, F_conv.adaptive_avg_pool1d)
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__(output_size, F_conv.adaptive_avg_pool2d, data_format=data_format)
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__(output_size, F_conv.adaptive_avg_pool3d, data_format=data_format)
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, F_conv.adaptive_max_pool1d)
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, F_conv.adaptive_max_pool2d)
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, F_conv.adaptive_max_pool3d)
+
+
+# ---- padding / reshaping layers ---------------------------------------------
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis, self.stop_axis = start_axis, stop_axis
+
+    def forward(self, x):
+        from ..ops import manipulation
+
+        return manipulation.flatten(x, self.start_axis, self.stop_axis)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ..ops import manipulation
+
+        full = x.shape[: self.axis] + list(self.shape) + x.shape[self.axis + 1:]
+        return manipulation.reshape(x, full)
+
+
+class _PadNd(Layer):
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding, self.mode, self.value, self.data_format = padding, mode, value, data_format
+
+    def forward(self, x):
+        from ..ops import manipulation
+
+        return manipulation.pad(x, self.padding, self.mode, self.value, self.data_format)
+
+
+class Pad1D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCL", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad2D(_PadNd):
+    pass
+
+
+class Pad3D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCDHW", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class ZeroPad2D(_PadNd):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.factor, self.data_format = upscale_factor, data_format
+
+    def forward(self, x):
+        return F_common.pixel_shuffle(x, self.factor, self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.factor, self.data_format = downscale_factor, data_format
+
+    def forward(self, x):
+        return F_common.pixel_unshuffle(x, self.factor, self.data_format)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                 align_mode=0, data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.scale_factor, self.mode = size, scale_factor, mode
+        self.align_corners, self.align_mode, self.data_format = align_corners, align_mode, data_format
+
+    def forward(self, x):
+        return F_common.interpolate(x, self.size, self.scale_factor, self.mode,
+                                    self.align_corners, self.align_mode, self.data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW", name=None):
+        super().__init__(size, scale_factor, "bilinear", True, data_format=data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW", name=None):
+        super().__init__(size, scale_factor, "nearest", False, data_format=data_format)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F_common.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter([out_features, in1_features, in2_features],
+                                            attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [1, out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x1, x2):
+        from ..ops.registry import apply
+
+        def fn(a, b, w, *bias):
+            out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+            if bias:
+                out = out + bias[0]
+            return out
+
+        args = [x1, x2, self.weight] + ([self.bias] if self.bias is not None else [])
+        return apply("bilinear", fn, *args)

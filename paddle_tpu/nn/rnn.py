@@ -1,0 +1,249 @@
+"""Recurrent layers over lax.scan.
+
+Reference parity: python/paddle/nn/layer/rnn.py (SimpleRNN/LSTM/GRU + cells).
+TPU-native: the time loop is ``lax.scan`` inside the recorded op — one XLA
+while-loop, not a Python loop — so the whole RNN jits and differentiates as a
+single computation. Weight layout matches the reference cells
+(weight_ih [hidden*gates, input], weight_hh [hidden*gates, hidden]).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .layer import Layer
+from .container import LayerList
+from .initializer_core import Uniform
+from ..ops.registry import apply
+from ..tensor_class import unwrap
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        from ..ops import creation
+
+        b = unwrap(batch_ref).shape[batch_dim_idx]
+        return creation.full([b, self.hidden_size], init_value, dtype or "float32")
+
+
+def _cell_params(layer, input_size, hidden_size, gates):
+    std = 1.0 / math.sqrt(hidden_size)
+    u = Uniform(-std, std)
+    layer.weight_ih = layer.create_parameter([gates * hidden_size, input_size], default_initializer=u)
+    layer.weight_hh = layer.create_parameter([gates * hidden_size, hidden_size], default_initializer=u)
+    layer.bias_ih = layer.create_parameter([gates * hidden_size], is_bias=True, default_initializer=u)
+    layer.bias_hh = layer.create_parameter([gates * hidden_size], is_bias=True, default_initializer=u)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1)
+
+    def forward(self, inputs, states=None):
+        states = states if states is not None else self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, bi, bh):
+            act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply("rnn_cell", fn, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 4)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h0 = self.get_initial_states(inputs)
+            states = (h0, h0)
+        h_prev, c_prev = states
+
+        def fn(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h, c = apply("lstm_cell", fn, inputs, h_prev, c_prev, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 3)
+
+    def forward(self, inputs, states=None):
+        h_prev = states if states is not None else self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        h = apply("gru_cell", fn, inputs, h_prev, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) RNN: lax.scan over time."""
+
+    GATES = {"SimpleRNN": 1, "LSTM": 4, "GRU": 3}
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        super().__init__()
+        self.mode = mode
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        self.activation = activation
+        gates = self.GATES[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for direction_i in range(self.num_directions):
+                in_size = input_size if layer == 0 else hidden_size * self.num_directions
+                suffix = "_reverse" if direction_i else ""
+                wi = self.create_parameter([gates * hidden_size, in_size], default_initializer=u)
+                wh = self.create_parameter([gates * hidden_size, hidden_size], default_initializer=u)
+                bi = self.create_parameter([gates * hidden_size], is_bias=True, default_initializer=u)
+                bh = self.create_parameter([gates * hidden_size], is_bias=True, default_initializer=u)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wi)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", wh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bi)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bh)
+
+    def _step(self, mode, activation):
+        if mode == "LSTM":
+            def step(carry, x, wi, wh, bi, bh):
+                h, c = carry
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+                h = jax.nn.sigmoid(o) * jnp.tanh(c)
+                return (h, c), h
+        elif mode == "GRU":
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry[0]
+                gi = x @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                c = jnp.tanh(ic + r * hc)
+                h = (1 - z) * c + z * h
+                return (h,), h
+        else:
+            act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+            def step(carry, x, wi, wh, bi, bh):
+                h = act(x @ wi.T + bi + carry[0] @ wh.T + bh)
+                return (h,), h
+
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.mode
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        step = self._step(mode, self.activation)
+        weights = []
+        for layer in range(nl):
+            for d in range(nd):
+                suffix = "_reverse" if d else ""
+                weights += [
+                    getattr(self, f"weight_ih_l{layer}{suffix}"),
+                    getattr(self, f"weight_hh_l{layer}{suffix}"),
+                    getattr(self, f"bias_ih_l{layer}{suffix}"),
+                    getattr(self, f"bias_hh_l{layer}{suffix}"),
+                ]
+
+        is_lstm = mode == "LSTM"
+
+        def fn(x, *flat_w):
+            xt = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, F]
+            b = xt.shape[1]
+            out = xt
+            last_h, last_c = [], []
+            wi_idx = 0
+            for layer in range(nl):
+                outs_dir = []
+                for d in range(nd):
+                    wi, wh, bi, bh = flat_w[wi_idx : wi_idx + 4]
+                    wi_idx += 4
+                    h0 = jnp.zeros((b, hs), dtype=x.dtype)
+                    carry0 = (h0, h0) if is_lstm else (h0,)
+                    seq = out if d == 0 else jnp.flip(out, axis=0)
+
+                    def scan_fn(carry, xx, wi=wi, wh=wh, bi=bi, bh=bh):
+                        return step(carry, xx, wi, wh, bi, bh)
+
+                    carry, ys = jax.lax.scan(scan_fn, carry0, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, axis=0)
+                    outs_dir.append(ys)
+                    last_h.append(carry[0])
+                    if is_lstm:
+                        last_c.append(carry[1])
+                out = jnp.concatenate(outs_dir, axis=-1) if nd == 2 else outs_dir[0]
+            final = out if time_major else jnp.swapaxes(out, 0, 1)
+            h_stack = jnp.stack(last_h, axis=0)
+            if is_lstm:
+                return final, h_stack, jnp.stack(last_c, axis=0)
+            return final, h_stack
+
+        result = apply("rnn", fn, inputs, *weights)
+        if is_lstm:
+            out, h, c = result
+            return out, (h, c)
+        out, h = result
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        super().__init__("SimpleRNN", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)

@@ -1,0 +1,115 @@
+"""nn.utils: weight norm / spectral norm wrappers, vector<->parameters.
+
+Reference parity: python/paddle/nn/utils/.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor_class import Tensor, wrap, unwrap
+
+
+def parameters_to_vector(parameters, name=None):
+    return wrap(jnp.concatenate([unwrap(p).reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    arr = unwrap(vec)
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._array = arr[offset : offset + n].reshape(p._array.shape).astype(p.dtype)
+        offset += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return wrap(jnp.zeros(()))
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(unwrap(g))) for g in grads))
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._array = unwrap(p.grad) * clip_coef
+    return wrap(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._array = jnp.clip(unwrap(p.grad), -clip_value, clip_value)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Re-parameterise weight = g * v / ||v|| (reference nn/utils/weight_norm_hook.py)."""
+    from .layer import Layer
+    from ..tensor_class import Parameter
+
+    w = getattr(layer, name)
+    arr = unwrap(w)
+    if dim is None:
+        norm = jnp.linalg.norm(arr)
+    else:
+        axes = tuple(i for i in range(arr.ndim) if i != dim)
+        norm = jnp.sqrt(jnp.sum(jnp.square(arr), axis=axes, keepdims=False))
+    g = Parameter(norm)
+    v = Parameter(arr)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        varr = unwrap(l._parameters[name + "_v"])
+        garr = unwrap(l._parameters[name + "_g"])
+        if dim is None:
+            w_new = garr * varr / jnp.linalg.norm(varr)
+        else:
+            axes = tuple(i for i in range(varr.ndim) if i != dim)
+            nrm = jnp.sqrt(jnp.sum(jnp.square(varr), axis=axes, keepdims=True))
+            shape = [1] * varr.ndim
+            shape[dim] = -1
+            w_new = garr.reshape(shape) * varr / nrm
+        object.__setattr__(l, "_wn_cache", w_new)
+        l.__dict__[name] = wrap(w_new, stop_gradient=False)
+
+    layer._wn_hook = layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hook = getattr(layer, "_wn_hook", None)
+    if hook is not None:
+        hook.remove()
+    from ..tensor_class import Parameter
+
+    w = layer.__dict__.pop(name, None)
+    if w is not None:
+        layer.add_parameter(name, Parameter(unwrap(w)))
+    for k in (name + "_g", name + "_v"):
+        layer._parameters.pop(k, None)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    from .layers_common import SpectralNorm as _SN
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = _SN(tuple(unwrap(w).shape), dim=dim, power_iters=n_power_iterations, epsilon=eps)
+    layer.add_sublayer(name + "_sn", sn)
+
+    def hook(l, inputs):
+        w_orig = l._parameters.get(name + "_orig")
+        l.__dict__[name] = sn(w_orig)
+
+    if name in layer._parameters:
+        layer.add_parameter(name + "_orig", layer._parameters.pop(name))
+    layer._sn_hook = layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels — the framework's handwritten-kernel layer.
+
+Role parity with the reference's fused CUDA kernels
+(paddle/phi/kernels/fusion/) and KPS primitives (paddle/phi/kernels/primitive/):
+flash attention, fused RMSNorm/residual, fused RoPE, plus wrappers over JAX's
+bundled Pallas ops (splash attention, megablox grouped matmul for MoE).
+"""
+from . import flash_attention, fused_norm
+from .fused_norm import rms_norm, add_rms_norm, fused_rope, rope_ref

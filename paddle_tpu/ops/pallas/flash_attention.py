@@ -1,0 +1,70 @@
+"""TPU flash attention dispatch.
+
+Reference parity: paddle/phi/kernels/gpu/flash_attn_kernel.cu (which wraps
+the flash-attn CUDA library). The TPU equivalent wraps JAX's bundled Pallas
+flash-attention kernel (jax.experimental.pallas.ops.tpu.flash_attention) —
+an MXU-tiled streaming-softmax kernel with fused causal masking — with a
+layout shim (paddle uses [batch, seq, heads, dim]; the kernel wants
+[batch, heads, seq, dim]) and a conservative `supported()` gate that falls
+back to the pure-XLA SDPA in nn/functional/attention.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def supported(q, k, v, dropout: float = 0.0) -> bool:
+    """Gate for the Pallas path: TPU backend, no dropout (the kernel has no
+    dropout; the reference's flash kernel's dropout is likewise in-kernel —
+    we fall back instead), 4D BSHD, head_dim and seq multiples that tile."""
+    if dropout != 0.0 or q.ndim != 4:
+        return False
+    if not _on_tpu():
+        return False
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if d % 128 != 0:
+        return False
+    if s_q % 128 != 0 or s_k % 128 != 0:
+        return False
+    if k.shape[2] != h:  # MQA/GQA: expand outside before calling
+        return False
+    return True
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
+def flash_attention_bshd(q, k, v, causal: bool = False, sm_scale: float | None = None):
+    """[B, S, H, D] flash attention on TPU via the bundled Pallas kernel."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    qt = jnp.swapaxes(q, 1, 2)  # BHSD
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    block_q = min(512, qt.shape[2])
+    block_k = min(512, kt.shape[2])
+    sizes = fa.BlockSizes(
+        block_q=block_q,
+        block_k_major=block_k,
+        block_k=block_k,
+        block_b=1,
+        block_q_major_dkv=block_q,
+        block_k_major_dkv=block_k,
+        block_k_dkv=block_k,
+        block_q_dkv=block_q,
+        block_k_major_dq=block_k,
+        block_k_dq=block_k,
+        block_q_dq=block_q,
+    )
+    out = fa.flash_attention(qt, kt, vt, causal=causal, sm_scale=scale, block_sizes=sizes)
+    return jnp.swapaxes(out, 1, 2)

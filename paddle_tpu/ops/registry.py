@@ -63,6 +63,19 @@ def apply(name: str, fn: Callable, *args, differentiable: bool = True, n_outputs
     tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
     arrays = [l._array if isinstance(l, Tensor) else l for l in leaves]
 
+    # AMP autocast decision (parity: AMP hook in every generated eager fwd fn,
+    # eager_gen.py:596) — cast float inputs to the active amp dtype per op list
+    from ..amp import amp_dtype_for
+
+    amp_dt = amp_dtype_for(name)
+    if amp_dt is not None:
+        arrays = [
+            a.astype(amp_dt)
+            if i in tensor_idx and _dtype_mod.is_floating_point_dtype(a.dtype) and a.dtype != amp_dt
+            else a
+            for i, a in enumerate(arrays)
+        ]
+
     requires_grad = (
         differentiable
         and _tape.grad_enabled()

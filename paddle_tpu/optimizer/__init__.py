@@ -1,0 +1,8 @@
+"""paddle_tpu.optimizer (reference python/paddle/optimizer/)."""
+from . import lr
+from .optimizer import (
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
+    RMSProp, Lamb, Lion,
+)
+from .clip import ClipGradBase, ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm
+from .lr import LRScheduler

@@ -1,0 +1,67 @@
+"""Gradient clipping.
+
+Reference parity: python/paddle/nn/clip.py — ClipGradByValue, ClipGradByNorm,
+ClipGradByGlobalNorm (the hybrid-parallel-aware one; under GSPMD the global
+norm over sharded grads is computed inside pjit, so cross-axis correctness is
+the partitioner's job — matching the reference's
+HybridParallelClipGrad behavior without manual allreduces).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def functional_clip(self, grads: dict) -> dict:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        """Eager list-of-(param, grad) API parity."""
+        grads = {i: g._array if hasattr(g, "_array") else g for i, (p, g) in enumerate(params_grads) if g is not None}
+        clipped = self.functional_clip(grads)
+        out = []
+        i = 0
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                from ..tensor_class import wrap
+
+                out.append((p, wrap(clipped[i])))
+            i += 1
+        return out
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+    def functional_clip(self, grads):
+        return {k: jnp.clip(g, self.min, self.max) for k, g in grads.items()}
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def functional_clip(self, grads):
+        out = {}
+        for k, g in grads.items():
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.where(n > self.clip_norm, self.clip_norm / n, 1.0)
+            out[k] = (g.astype(jnp.float32) * scale).astype(g.dtype)
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def functional_clip(self, grads):
+        total = jnp.sqrt(
+            sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads.values())
+        )
+        scale = jnp.minimum(self.clip_norm / (total + 1e-6), 1.0)
+        return {k: (g.astype(jnp.float32) * scale).astype(g.dtype) for k, g in grads.items()}

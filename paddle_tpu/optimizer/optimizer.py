@@ -1,0 +1,367 @@
+"""Optimizer base + the full optimizer family.
+
+Reference parity: python/paddle/optimizer/ (Optimizer base in optimizer.py;
+SGD/Momentum/Adam/AdamW/Adamax/Adagrad/Adadelta/RMSProp/Lamb/Lion; all with
+multi-precision master weights as in paddle/phi/kernels/gpu/adamw_kernel.cu).
+
+TPU-native design: each optimizer exposes
+- the eager path: ``step()`` consumes ``.grad`` under no_grad (dygraph parity);
+- the functional path: ``init_state(params)`` + ``apply_gradients(state,
+  params, grads)`` — pure pytree functions usable inside jit/pjit, which is
+  what the Trainer/jit bridge compiles. ``step()`` simply calls the functional
+  path eagerly, so both routes share one update rule implementation.
+
+Master weights: when a parameter is bf16/fp16, state carries an f32 copy; the
+update computes in f32 and writes both (multi_precision parity).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor_class import Tensor, Parameter, unwrap, wrap
+from ..autograd.tape import no_grad
+from .lr import LRScheduler
+
+
+def _is_low_precision(dtype):
+    return dtype in (jnp.float16, jnp.bfloat16) or str(dtype) in ("float16", "bfloat16")
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._weight_decay = weight_decay if weight_decay is not None else 0.0
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[int, Any] = {}
+        self._step_count = 0
+
+    # ---- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr.get_lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler; call scheduler.step()")
+        self._lr = value
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # ---- functional core (override in subclasses) ---------------------------
+    def init_param_state(self, arr) -> Dict[str, Any]:
+        """Per-parameter accumulator pytree."""
+        return {}
+
+    def update(self, arr, grad, state, lr, step) -> tuple:
+        """Pure update rule: returns (new_arr_f32, new_state). ``arr`` is the
+        master (f32) value; caller handles low-precision write-back."""
+        raise NotImplementedError
+
+    # ---- functional API (jit path) ------------------------------------------
+    def init_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        state = {"step": jnp.zeros((), jnp.int32)}
+        per_param = {}
+        for name, arr in params.items():
+            s = self.init_param_state(arr)
+            if self._multi_precision and _is_low_precision(arr.dtype):
+                s["master"] = arr.astype(jnp.float32)
+            per_param[name] = s
+        state["param_states"] = per_param
+        return state
+
+    def apply_gradients(self, state, params, grads, lr=None):
+        """Pure: returns (new_params, new_state). Usable inside jit/pjit."""
+        lr_val = lr if lr is not None else self.get_lr()
+        step = state["step"] + 1
+        wd = self._weight_decay if not callable(self._weight_decay) else 0.0
+
+        if self._grad_clip is not None:
+            grads = self._grad_clip.functional_clip(grads)
+
+        new_params = {}
+        new_states = {}
+        for name, arr in params.items():
+            g = grads.get(name)
+            pstate = dict(state["param_states"][name])
+            if g is None:
+                new_params[name] = arr
+                new_states[name] = pstate
+                continue
+            master = pstate.pop("master", None)
+            work = master if master is not None else arr
+            work32 = work.astype(jnp.float32)
+            g32 = g.astype(jnp.float32)
+            if wd and self._decoupled_wd():
+                work32 = work32 * (1.0 - lr_val * wd)
+            elif wd:
+                g32 = g32 + wd * work32
+            new32, pstate = self.update(work32, g32, pstate, lr_val, step)
+            if master is not None:
+                pstate["master"] = new32
+                new_params[name] = new32.astype(arr.dtype)
+            else:
+                new_params[name] = new32.astype(arr.dtype)
+            new_states[name] = pstate
+        return new_params, {"step": step, "param_states": new_states}
+
+    def _decoupled_wd(self) -> bool:
+        return False
+
+    # ---- eager API (dygraph parity) -----------------------------------------
+    @no_grad()
+    def step(self):
+        if self._parameter_list is None:
+            raise RuntimeError("this optimizer was created without a parameter list")
+        params, grads, tensors = {}, {}, {}
+        for i, p in enumerate(self._parameter_list):
+            if p.stop_gradient:
+                continue
+            key = p.name or f"p{i}"
+            params[key] = unwrap(p)
+            tensors[key] = p
+            if p.grad is not None:
+                grads[key] = unwrap(p.grad)
+        if not hasattr(self, "_eager_state"):
+            self._eager_state = self.init_state(params)
+        new_params, self._eager_state = self.apply_gradients(self._eager_state, params, grads)
+        for key, p in tensors.items():
+            p._array = new_params[key]
+        self._step_count += 1
+
+    minimize = None  # assigned below
+
+    def clear_grad(self, set_to_zero=True):
+        if self._parameter_list is not None:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):  # noqa: F811
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # ---- state dict ----------------------------------------------------------
+    def state_dict(self):
+        sd = {"step": self._step_count}
+        if hasattr(self, "_eager_state"):
+            sd["state"] = jax.tree_util.tree_map(lambda x: x, self._eager_state)
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, sd):
+        self._step_count = sd.get("step", 0)
+        if "state" in sd:
+            self._eager_state = sd["state"]
+        if "LR_Scheduler" in sd and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(sd["LR_Scheduler"])
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+
+    def update(self, arr, grad, state, lr, step):
+        return arr - lr * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_param_state(self, arr):
+        return {"velocity": jnp.zeros(arr.shape, jnp.float32)}
+
+    def update(self, arr, grad, state, lr, step):
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            new = arr - lr * (grad + self._momentum * v)
+        else:
+            new = arr - lr * v
+        return new, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=True, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def init_param_state(self, arr):
+        s = {"moment1": jnp.zeros(arr.shape, jnp.float32),
+             "moment2": jnp.zeros(arr.shape, jnp.float32)}
+        if self._amsgrad:
+            s["moment2_max"] = jnp.zeros(arr.shape, jnp.float32)
+        return s
+
+    def update(self, arr, grad, state, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        stepf = step.astype(jnp.float32)
+        m_hat = m / (1 - b1**stepf)
+        if self._amsgrad:
+            vmax = jnp.maximum(state["moment2_max"], v)
+            v_hat = vmax / (1 - b2**stepf)
+            new_state = {"moment1": m, "moment2": v, "moment2_max": vmax}
+        else:
+            v_hat = v / (1 - b2**stepf)
+            new_state = {"moment1": m, "moment2": v}
+        new = arr - lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return new, new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, amsgrad)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_wd(self):
+        return True
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_param_state(self, arr):
+        return {"moment": jnp.zeros(arr.shape, jnp.float32),
+                "inf_norm": jnp.zeros(arr.shape, jnp.float32)}
+
+    def update(self, arr, grad, state, lr, step):
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * grad
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(grad))
+        stepf = step.astype(jnp.float32)
+        new = arr - (lr / (1 - self._beta1**stepf)) * m / (u + self._eps)
+        return new, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_param_state(self, arr):
+        return {"moment": jnp.full(arr.shape, self._init_acc, jnp.float32)}
+
+    def update(self, arr, grad, state, lr, step):
+        acc = state["moment"] + grad * grad
+        new = arr - lr * grad / (jnp.sqrt(acc) + self._eps)
+        return new, {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._eps, self._rho = epsilon, rho
+
+    def init_param_state(self, arr):
+        return {"avg_squared_grad": jnp.zeros(arr.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(arr.shape, jnp.float32)}
+
+    def update(self, arr, grad, state, lr, step):
+        g2 = self._rho * state["avg_squared_grad"] + (1 - self._rho) * grad * grad
+        delta = jnp.sqrt(state["avg_squared_update"] + self._eps) / jnp.sqrt(g2 + self._eps) * grad
+        u2 = self._rho * state["avg_squared_update"] + (1 - self._rho) * delta * delta
+        return arr - lr * delta, {"avg_squared_grad": g2, "avg_squared_update": u2}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def init_param_state(self, arr):
+        s = {"mean_square": jnp.zeros(arr.shape, jnp.float32),
+             "momentum": jnp.zeros(arr.shape, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(arr.shape, jnp.float32)
+        return s
+
+    def update(self, arr, grad, state, lr, step):
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * grad * grad
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+            new_state = {"mean_square": ms, "mean_grad": mg}
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+            new_state = {"mean_square": ms}
+        mom = self._momentum * state["momentum"] + lr * grad / denom
+        new_state["momentum"] = mom
+        return arr - mom, new_state
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, 0.0, grad_clip, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_param_state(self, arr):
+        return {"moment1": jnp.zeros(arr.shape, jnp.float32),
+                "moment2": jnp.zeros(arr.shape, jnp.float32)}
+
+    def update(self, arr, grad, state, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        stepf = step.astype(jnp.float32)
+        m_hat = m / (1 - b1**stepf)
+        v_hat = v / (1 - b2**stepf)
+        r = m_hat / (jnp.sqrt(v_hat) + self._eps) + self._lamb_wd * arr
+        w_norm = jnp.linalg.norm(arr)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return arr - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class Lion(Optimizer):
+    def __init__(self, learning_rate=1e-4, beta1=0.9, beta2=0.99, parameters=None,
+                 weight_decay=0.0, grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._beta1, self._beta2 = beta1, beta2
+
+    def _decoupled_wd(self):
+        return True
+
+    def init_param_state(self, arr):
+        return {"moment": jnp.zeros(arr.shape, jnp.float32)}
+
+    def update(self, arr, grad, state, lr, step):
+        update = jnp.sign(self._beta1 * state["moment"] + (1 - self._beta1) * grad)
+        m = self._beta2 * state["moment"] + (1 - self._beta2) * grad
+        return arr - lr * update, {"moment": m}

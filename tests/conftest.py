@@ -17,6 +17,12 @@ import pytest
 
 import jax
 
+# Force the CPU backend at the *config* level: the environment's TPU-tunnel
+# plugin (sitecustomize) overrides jax_platforms after import, so the env var
+# alone is not enough — without this, "CPU" tests silently run through the
+# remote TPU tunnel (and hang when it is down).
+jax.config.update("jax_platforms", "cpu")
+
 # numeric-parity tests compare against float64-ish numpy references
 jax.config.update("jax_default_matmul_precision", "highest")
 

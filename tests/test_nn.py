@@ -1,0 +1,268 @@
+"""nn.Layer system + layers + functional tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_forward_backward():
+    l = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = l(x)
+    assert y.shape == [2, 3]
+    y.sum().backward()
+    assert l.weight.grad is not None and l.weight.grad.shape == [4, 3]
+    assert l.bias.grad is not None
+
+
+def test_sequential_and_state_dict():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = m.state_dict()
+    assert "0.weight" in sd and "2.bias" in sd
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    missing, unexpected = m2.set_state_dict(sd)
+    assert not missing and not unexpected
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_layerlist_and_dict():
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3 and len(ll.parameters()) == 6
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in ld
+
+
+def test_train_eval_mode():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100])
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), np.ones(100))
+    d.train()
+    out = d(x).numpy()
+    assert (out == 0).any() and (out > 1).any()  # upscale_in_train
+
+
+def test_embedding_padding_idx():
+    e = nn.Embedding(10, 4, padding_idx=0)
+    out = e(paddle.to_tensor([[0, 1], [2, 0]]))
+    np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+    assert np.abs(out.numpy()[0, 1]).sum() > 0
+
+
+def test_layernorm_stats():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([4, 8]) * 5 + 3
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), np.ones(4), atol=1e-2)
+
+
+def test_rmsnorm_matches_reference():
+    rms = nn.RMSNorm(16)
+    x = paddle.randn([2, 3, 16])
+    y = rms(x).numpy()
+    xn = x.numpy()
+    ref = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, ref, rtol=1e-4)
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm1D(4, momentum=0.9)
+    bn.train()
+    x = paddle.randn([32, 4]) * 2 + 1
+    bn(x)
+    assert np.abs(bn._mean.numpy()).sum() > 0  # moved from zeros
+    bn.eval()
+    y = bn(x)
+    assert y.shape == [32, 4]
+
+
+def test_conv2d_shape_and_grad():
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = paddle.randn([2, 3, 8, 8])
+    y = conv(x)
+    assert y.shape == [2, 8, 8, 8]
+    y.mean().backward()
+    assert conv.weight.grad.shape == [8, 3, 3, 3]
+
+
+def test_conv2d_matches_numpy():
+    conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+    x = paddle.ones([1, 1, 3, 3])
+    w = conv.weight.numpy()
+    y = conv(x).numpy()
+    assert y.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(y[0, 0, 0, 0], w.sum(), rtol=1e-5)
+
+
+def test_conv_transpose_shape():
+    ct = nn.Conv2DTranspose(4, 2, 4, stride=2, padding=1)
+    y = ct(paddle.randn([1, 4, 8, 8]))
+    assert y.shape == [1, 2, 16, 16]
+
+
+def test_grouped_conv():
+    conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+    assert conv(paddle.randn([1, 4, 5, 5])).shape == [1, 8, 5, 5]
+
+
+def test_pools():
+    x = paddle.randn([1, 2, 8, 8])
+    assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+    np.testing.assert_allclose(
+        nn.AdaptiveAvgPool2D(1)(x).numpy()[0, 0, 0, 0], x.numpy()[0, 0].mean(), rtol=1e-5)
+
+
+def test_activations():
+    x = paddle.to_tensor([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 0, 0.5, 2])
+    np.testing.assert_allclose(F.hardtanh(x).numpy(), [-1, -0.5, 0, 0.5, 1])
+    assert F.gelu(x).shape == [5]
+    assert F.softmax(x).numpy().sum() == pytest.approx(1.0, rel=1e-5)
+    np.testing.assert_allclose(F.glu(paddle.to_tensor([1.0, 0.0])).numpy(), [0.5], rtol=1e-5)
+
+
+def test_cross_entropy_matches_manual():
+    logits = paddle.randn([3, 5])
+    labels = paddle.to_tensor([0, 2, 4])
+    loss = F.cross_entropy(logits, labels).numpy()
+    l = logits.numpy()
+    logp = l - np.log(np.exp(l).sum(-1, keepdims=True))
+    manual = -logp[np.arange(3), [0, 2, 4]].mean()
+    np.testing.assert_allclose(loss, manual, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor([0, -100, 2, -100])
+    loss = F.cross_entropy(logits, labels, ignore_index=-100).numpy()
+    l = logits.numpy()
+    logp = l - np.log(np.exp(l).sum(-1, keepdims=True))
+    manual = -(logp[0, 0] + logp[2, 2]) / 2
+    np.testing.assert_allclose(loss, manual, rtol=1e-5)
+
+
+def test_bce_with_logits_stable():
+    z = paddle.to_tensor([100.0, -100.0])
+    l = paddle.to_tensor([1.0, 0.0])
+    loss = F.binary_cross_entropy_with_logits(z, l)
+    assert np.isfinite(loss.numpy()) and loss.numpy() < 1e-3
+
+
+def test_mha_shapes_and_grad():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    y = mha(x)
+    assert y.shape == [2, 6, 16]
+    y.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_sdpa_matches_reference():
+    q = paddle.randn([1, 4, 2, 8])
+    k = paddle.randn([1, 4, 2, 8])
+    v = paddle.randn([1, 4, 2, 8])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    qn, kn, vn = (t.numpy().transpose(0, 2, 1, 3) for t in (q, k, v))  # BHSD
+    scores = qn @ kn.transpose(0, 1, 3, 2) / np.sqrt(8)
+    mask = np.tril(np.ones((4, 4), bool))
+    scores = np.where(mask, scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = (p @ vn).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=2, dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, 2)
+    out = enc(paddle.randn([2, 5, 16]))
+    assert out.shape == [2, 5, 16]
+
+
+def test_lstm_and_gru():
+    lstm = nn.LSTM(8, 16, num_layers=2, direction="bidirectional")
+    out, (h, c) = lstm(paddle.randn([3, 5, 8]))
+    assert out.shape == [3, 5, 32]
+    assert h.shape == [4, 3, 16]
+    gru = nn.GRU(8, 16)
+    out, h = gru(paddle.randn([3, 5, 8]))
+    assert out.shape == [3, 5, 16]
+    out.sum().backward()
+
+
+def test_rnn_grad_flows():
+    rnn = nn.SimpleRNN(4, 8)
+    out, h = rnn(paddle.randn([2, 3, 4]))
+    out.sum().backward()
+    assert rnn.weight_ih_l0.grad is not None
+
+
+def test_initializers():
+    from paddle_tpu.nn.initializer import Constant, XavierNormal, KaimingNormal, Orthogonal
+
+    l = nn.Linear(10, 10, weight_attr=nn.ParamAttr(initializer=Constant(2.0)))
+    np.testing.assert_allclose(l.weight.numpy(), np.full((10, 10), 2.0))
+    w = Orthogonal()((8, 8), np.float32)
+    np.testing.assert_allclose(np.asarray(w) @ np.asarray(w).T, np.eye(8), atol=1e-5)
+
+
+def test_parameter_freeze():
+    l = nn.Linear(4, 4)
+    l.weight.stop_gradient = True
+    y = l(paddle.randn([2, 4]))
+    y.sum().backward()
+    assert l.weight.grad is None and l.bias.grad is not None
+
+
+def test_hooks():
+    l = nn.Linear(4, 4)
+    calls = []
+    h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    l(paddle.randn([1, 4]))
+    assert calls == [1]
+    h.remove()
+    l(paddle.randn([1, 4]))
+    assert calls == [1]
+
+
+def test_to_dtype():
+    l = nn.Linear(4, 4)
+    l.bfloat16()
+    assert l.weight.dtype == paddle.bfloat16
+    out = l(paddle.randn([2, 4]).astype("bfloat16"))
+    assert out.dtype == paddle.bfloat16
+
+
+def test_weight_norm():
+    from paddle_tpu.nn.utils import weight_norm, remove_weight_norm
+
+    l = nn.Linear(4, 3)
+    w0 = l.weight.numpy() if hasattr(l, "weight") else None
+    weight_norm(l, "weight")
+    assert "weight_g" in dict(l.named_parameters())
+    y = l(paddle.randn([2, 4]))
+    assert y.shape == [2, 3]
+    remove_weight_norm(l)
+    assert "weight" in dict(l.named_parameters())
+
+
+def test_pixel_shuffle_roundtrip():
+    x = paddle.randn([1, 8, 4, 4])
+    up = F.pixel_shuffle(x, 2)
+    assert up.shape == [1, 2, 8, 8]
+    down = F.pixel_unshuffle(up, 2)
+    np.testing.assert_allclose(down.numpy(), x.numpy(), rtol=1e-6)
+
+
+def test_interpolate():
+    x = paddle.randn([1, 2, 4, 4])
+    y = F.interpolate(x, scale_factor=2, mode="nearest")
+    assert y.shape == [1, 2, 8, 8]
+    y = F.interpolate(x, size=[6, 6], mode="bilinear")
+    assert y.shape == [1, 2, 6, 6]
