@@ -128,6 +128,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._already_unscaled = False
 
     def is_enable(self):
         return self._enable
@@ -147,10 +148,9 @@ class GradScaler:
         return var * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or self._already_unscaled:
             return
-        import numpy as np
-
+        self._already_unscaled = True
         found = False
         for p in optimizer._parameter_list or []:
             if p.grad is not None:
@@ -175,6 +175,7 @@ class GradScaler:
         optimizer.clear_grad()
 
     def update(self):
+        self._already_unscaled = False
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

@@ -4,10 +4,11 @@ Reference parity: python/paddle/io/DataLoader (+ dataloader_iter.py,
 worker.py): single-process and multi-process iteration, default collate to
 batched tensors, worker_init_fn, prefetch.
 
-TPU-native notes: workers produce numpy batches via a multiprocessing.Pool
-(spawn-safe); conversion to device arrays happens in the consumer so the
-pool never touches jax. Prefetching = pool imap with a lookahead window,
-which plays the role of the reference's _prefetch_factor queue.
+TPU-native notes: workers produce numpy batches via a fork-context
+multiprocessing.Pool; conversion to device arrays happens in the consumer so
+workers never touch jax (forked children must not use device state).
+Prefetching = pool imap with a lookahead window, which plays the role of the
+reference's _prefetch_factor queue.
 """
 from __future__ import annotations
 
@@ -86,30 +87,30 @@ def _to_tensors(obj):
     return obj
 
 
-class _WorkerTask:
-    """Top-level callable for the pool (picklable)."""
+# Worker-process globals: the dataset/collate are shipped ONCE via the pool
+# initializer (not per task), and worker_init_fn runs once per worker.
+_worker_state: dict = {}
 
-    def __init__(self, dataset, collate_fn, worker_init_fn, num_workers):
-        self.dataset = dataset
-        self.collate_fn = collate_fn
-        self.worker_init_fn = worker_init_fn
-        self.num_workers = num_workers
-        self._initialized = False
 
-    def __call__(self, indices):
-        import multiprocessing as mp
+def _pool_worker_init(dataset, collate_fn, worker_init_fn, num_workers):
+    import multiprocessing as mp
 
-        if not self._initialized:
-            proc = mp.current_process()
-            wid = (proc._identity[0] - 1) % self.num_workers if proc._identity else 0
-            _worker_info.info = WorkerInfo(wid, self.num_workers, self.dataset)
-            if self.worker_init_fn is not None:
-                self.worker_init_fn(wid)
-            self._initialized = True
-        samples = [self.dataset[i] for i in indices]
-        if self.collate_fn is not None:
-            return self.collate_fn(samples)
-        return _np_collate([_as_numpy_sample(s) for s in samples])
+    proc = mp.current_process()
+    wid = (proc._identity[0] - 1) % num_workers if proc._identity else 0
+    _worker_state["dataset"] = dataset
+    _worker_state["collate_fn"] = collate_fn
+    _worker_info.info = WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+
+
+def _pool_worker_task(indices):
+    dataset = _worker_state["dataset"]
+    collate_fn = _worker_state["collate_fn"]
+    samples = [dataset[i] for i in indices]
+    if collate_fn is not None:
+        return collate_fn(samples)
+    return _np_collate([_as_numpy_sample(s) for s in samples])
 
 
 def _as_numpy_sample(s):
@@ -187,12 +188,18 @@ class DataLoader:
                 samples = [self.dataset[i] for i in indices]
                 yield self._collate(samples)
             return
-        # multiprocess path: pool imap with prefetch lookahead. A user
-        # collate_fn runs worker-side (must be picklable, as in the reference).
+        # multiprocess path: pool imap with prefetch lookahead. Dataset +
+        # collate_fn ship once per worker via the initializer; only index
+        # lists cross per batch. A user collate_fn runs worker-side (must be
+        # picklable, as in the reference). Fork context: workers do numpy
+        # work only — do not touch jax/device state inside Dataset code.
         import multiprocessing as mp
 
-        task = _WorkerTask(self.dataset, self.collate_fn, self.worker_init_fn, self.num_workers)
         ctx = mp.get_context("fork")
-        with ctx.Pool(self.num_workers) as pool:
-            for np_batch in pool.imap(task, self.batch_sampler, chunksize=1):
+        with ctx.Pool(
+            self.num_workers,
+            initializer=_pool_worker_init,
+            initargs=(self.dataset, self.collate_fn, self.worker_init_fn, self.num_workers),
+        ) as pool:
+            for np_batch in pool.imap(_pool_worker_task, self.batch_sampler, chunksize=1):
                 yield _to_tensors(np_batch)

@@ -38,9 +38,19 @@ class InputSpec:
         self.dtype = convert_dtype(dtype)
         self.name = name
 
-    def to_shape_dtype_struct(self):
-        shape = tuple(1 if s in (None, -1) else s for s in self.shape)
-        return jax.ShapeDtypeStruct(shape, self.dtype)
+    def to_shape_dtype_struct(self, sym_prefix: str = "d"):
+        """Dynamic dims (None/-1) become jax.export symbolic dimensions so an
+        exported artifact accepts any size there (paddle InputSpec semantics)."""
+        if any(s in (None, -1) for s in self.shape):
+            from jax import export as jax_export
+
+            spec = ",".join(
+                f"{sym_prefix}{i}" if s in (None, -1) else str(s)
+                for i, s in enumerate(self.shape)
+            )
+            shape = jax_export.symbolic_shape(spec)
+            return jax.ShapeDtypeStruct(tuple(shape), self.dtype)
+        return jax.ShapeDtypeStruct(tuple(self.shape), self.dtype)
 
     def __repr__(self):
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"

@@ -147,21 +147,17 @@ def batch_norm(
     ch_axis = 1 if data_format[1] == "C" else -1
 
     if training and not use_global_stats:
-        # compute batch stats eagerly, update running stats in-place (the
-        # reference updates running stats inside the kernel)
-        axes = tuple(i for i in range(unwrap(x).ndim) if i != ch_axis % unwrap(x).ndim)
-        batch_mean = jnp.mean(unwrap(x).astype(jnp.float32), axis=axes)
-        batch_var = jnp.var(unwrap(x).astype(jnp.float32), axis=axes)
-        if running_mean is not None:
-            running_mean._array = (momentum * running_mean._array + (1 - momentum) * batch_mean).astype(running_mean.dtype)
-            running_var._array = (momentum * running_var._array + (1 - momentum) * batch_var).astype(running_var.dtype)
-        mean_used, var_used = batch_mean, batch_var
-
+        # Batch stats are computed INSIDE the recorded op so the full BN VJP
+        # (including d mean/d x and d var/d x) flows through the tape.
         def fn(a, *wb):
+            axes = tuple(i for i in range(a.ndim) if i != ch_axis % a.ndim)
+            a32 = a.astype(jnp.float32)
+            mean = jnp.mean(a32, axis=axes, keepdims=True)
+            var = jnp.var(a32, axis=axes, keepdims=True)
+            out = (a32 - mean) * jax.lax.rsqrt(var + epsilon)
+            out = out.astype(a.dtype)
             shape = [1] * a.ndim
             shape[ch_axis] = a.shape[ch_axis]
-            out = (a.astype(jnp.float32) - mean_used.reshape(shape)) * jax.lax.rsqrt(var_used.reshape(shape) + epsilon)
-            out = out.astype(a.dtype)
             i = 0
             if weight is not None:
                 out = out * wb[i].reshape(shape)
@@ -171,7 +167,26 @@ def batch_norm(
             return out
 
         args = [t for t in (weight, bias) if t is not None]
-        return apply("batch_norm", fn, x, *args)
+        out = apply("batch_norm", fn, x, *args)
+
+        # Running-stat update: eager only (under a jit trace this would leak
+        # tracers into the buffers; compiled training uses functional state
+        # or use_global_stats, as in other XLA frameworks).
+        if running_mean is not None:
+            try:
+                import jax.core as _jc
+
+                tracing = not _jc.trace_state_clean()
+            except Exception:  # pragma: no cover
+                tracing = False
+            if not tracing:
+                arr = unwrap(x)
+                axes = tuple(i for i in range(arr.ndim) if i != ch_axis % arr.ndim)
+                batch_mean = jnp.mean(arr.astype(jnp.float32), axis=axes)
+                batch_var = jnp.var(arr.astype(jnp.float32), axis=axes)
+                running_mean._array = (momentum * running_mean._array + (1 - momentum) * batch_mean).astype(running_mean.dtype)
+                running_var._array = (momentum * running_var._array + (1 - momentum) * batch_var).astype(running_var.dtype)
+        return out
 
     def fn(a, m, v, *wb):
         shape = [1] * a.ndim

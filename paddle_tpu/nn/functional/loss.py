@@ -78,8 +78,26 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
 
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
-    return cross_entropy(input, label, weight=weight, ignore_index=ignore_index,
-                         reduction=reduction, use_softmax=False, soft_label=False)
+    """Input is already log-probabilities (paddle semantics): the loss is a
+    plain negative gather, no log applied."""
+
+    def fn(logp, lbl, *w):
+        idx = lbl.astype(jnp.int32)
+        mask = idx != ignore_index
+        safe_idx = jnp.where(mask, idx, 0)
+        loss = -jnp.take_along_axis(logp, safe_idx[..., None], axis=-1)[..., 0]
+        loss = jnp.where(mask, loss, 0.0)
+        wsum = None
+        if w:
+            cw = jnp.where(mask, jnp.take(w[0], safe_idx, axis=0), 0.0)
+            loss = loss * cw
+            wsum = jnp.sum(cw)
+        elif reduction == "mean":
+            wsum = jnp.sum(mask.astype(loss.dtype))
+        return _reduce(loss, reduction, wsum)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply("nll_loss", fn, *args)
 
 
 def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):

@@ -188,7 +188,16 @@ class _RNNBase(Layer):
 
         is_lstm = mode == "LSTM"
 
-        def fn(x, *flat_w):
+        # initial states: paddle layout [num_layers*num_directions, B, hidden]
+        def fn(x, *flat):
+            if initial_states is not None:
+                if is_lstm:
+                    h_init, c_init, flat_w = flat[0], flat[1], flat[2:]
+                else:
+                    h_init, c_init, flat_w = flat[0], None, flat[1:]
+            else:
+                h_init = c_init = None
+                flat_w = flat
             xt = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, F]
             b = xt.shape[1]
             out = xt
@@ -199,8 +208,14 @@ class _RNNBase(Layer):
                 for d in range(nd):
                     wi, wh, bi, bh = flat_w[wi_idx : wi_idx + 4]
                     wi_idx += 4
-                    h0 = jnp.zeros((b, hs), dtype=x.dtype)
-                    carry0 = (h0, h0) if is_lstm else (h0,)
+                    slot = layer * nd + d
+                    if h_init is not None:
+                        h0 = h_init[slot].astype(x.dtype)
+                        c0 = c_init[slot].astype(x.dtype) if c_init is not None else h0
+                    else:
+                        h0 = jnp.zeros((b, hs), dtype=x.dtype)
+                        c0 = h0
+                    carry0 = (h0, c0) if is_lstm else (h0,)
                     seq = out if d == 0 else jnp.flip(out, axis=0)
 
                     def scan_fn(carry, xx, wi=wi, wh=wh, bi=bi, bh=bh):
@@ -220,7 +235,10 @@ class _RNNBase(Layer):
                 return final, h_stack, jnp.stack(last_c, axis=0)
             return final, h_stack
 
-        result = apply("rnn", fn, inputs, *weights)
+        extra = []
+        if initial_states is not None:
+            extra = [initial_states[0], initial_states[1]] if is_lstm else [initial_states]
+        result = apply("rnn", fn, inputs, *extra, *weights)
         if is_lstm:
             out, h, c = result
             return out, (h, c)

@@ -63,18 +63,20 @@ def weight_norm(layer, name="weight", dim=0):
     del layer._parameters[name]
 
     def hook(l, inputs):
-        varr = unwrap(l._parameters[name + "_v"])
-        garr = unwrap(l._parameters[name + "_g"])
-        if dim is None:
-            w_new = garr * varr / jnp.linalg.norm(varr)
-        else:
+        from ..ops.registry import apply
+
+        def fn(varr, garr):
+            if dim is None:
+                return garr * varr / jnp.linalg.norm(varr)
             axes = tuple(i for i in range(varr.ndim) if i != dim)
             nrm = jnp.sqrt(jnp.sum(jnp.square(varr), axis=axes, keepdims=True))
             shape = [1] * varr.ndim
             shape[dim] = -1
-            w_new = garr.reshape(shape) * varr / nrm
-        object.__setattr__(l, "_wn_cache", w_new)
-        l.__dict__[name] = wrap(w_new, stop_gradient=False)
+            return garr.reshape(shape) * varr / nrm
+
+        # recorded on the tape → gradients flow back to weight_v / weight_g
+        l.__dict__[name] = apply("weight_norm", fn,
+                                 l._parameters[name + "_v"], l._parameters[name + "_g"])
 
     layer._wn_hook = layer.register_forward_pre_hook(hook)
     hook(layer, None)

@@ -100,10 +100,12 @@ class Optimizer:
             work = master if master is not None else arr
             work32 = work.astype(jnp.float32)
             g32 = g.astype(jnp.float32)
-            if wd and self._decoupled_wd():
+            decay_this = wd and self._should_decay(name)
+            if decay_this and self._decoupled_wd():
                 work32 = work32 * (1.0 - lr_val * wd)
-            elif wd:
+            elif decay_this:
                 g32 = g32 + wd * work32
+            self._cur_param_name = name
             new32, pstate = self.update(work32, g32, pstate, lr_val, step)
             if master is not None:
                 pstate["master"] = new32
@@ -115,6 +117,12 @@ class Optimizer:
 
     def _decoupled_wd(self) -> bool:
         return False
+
+    def _should_decay(self, name: str) -> bool:
+        """Per-parameter weight-decay gate. Names are the structural
+        state-dict names on the functional path (TrainStep), or ``p.name``/
+        positional ids on the bare eager list path."""
+        return True
 
     # ---- eager API (dygraph parity) -----------------------------------------
     @no_grad()
@@ -238,8 +246,14 @@ class AdamW(Adam):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision, amsgrad)
         self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
 
     def _decoupled_wd(self):
+        return True
+
+    def _should_decay(self, name):
+        if self._apply_decay_param_fun is not None:
+            return bool(self._apply_decay_param_fun(name))
         return True
 
 
@@ -331,6 +345,11 @@ class Lamb(Optimizer):
         self._lamb_wd = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn
 
+    def _should_decay(self, name):
+        if self._exclude_fn is not None:
+            return not bool(self._exclude_fn(name))
+        return True
+
     def init_param_state(self, arr):
         return {"moment1": jnp.zeros(arr.shape, jnp.float32),
                 "moment2": jnp.zeros(arr.shape, jnp.float32)}
@@ -342,7 +361,8 @@ class Lamb(Optimizer):
         stepf = step.astype(jnp.float32)
         m_hat = m / (1 - b1**stepf)
         v_hat = v / (1 - b2**stepf)
-        r = m_hat / (jnp.sqrt(v_hat) + self._eps) + self._lamb_wd * arr
+        wd = self._lamb_wd if self._should_decay(getattr(self, "_cur_param_name", "")) else 0.0
+        r = m_hat / (jnp.sqrt(v_hat) + self._eps) + wd * arr
         w_norm = jnp.linalg.norm(arr)
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)

@@ -266,3 +266,52 @@ def test_interpolate():
     assert y.shape == [1, 2, 8, 8]
     y = F.interpolate(x, size=[6, 6], mode="bilinear")
     assert y.shape == [1, 2, 6, 6]
+
+
+def test_batchnorm_grad_includes_stats_terms():
+    """BN input grad must include d(mean)/dx and d(var)/dx (review regression):
+    for affine-less BN over a batch, sum of input grads of sum(output) ~ 0."""
+    bn = nn.BatchNorm1D(3, weight_attr=False, bias_attr=False)
+    bn.train()
+    x = paddle.randn([8, 3])
+    x.stop_gradient = False
+    bn(x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy().sum(axis=0), np.zeros(3), atol=1e-4)
+
+
+def test_nll_loss_log_prob_input():
+    logits = paddle.randn([4, 5])
+    logits.stop_gradient = False
+    logp = F.log_softmax(logits)
+    labels = paddle.to_tensor([1, 0, 3, 2])
+    loss = F.nll_loss(logp, labels)
+    ce = F.cross_entropy(logits.detach(), labels)
+    np.testing.assert_allclose(loss.numpy(), ce.numpy(), rtol=1e-5)
+    loss.backward()
+    assert np.abs(logits.grad.numpy()).sum() > 0
+
+
+def test_lstm_initial_state_used():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.randn([2, 3, 4])
+    h0 = paddle.ones([1, 2, 8])
+    c0 = paddle.ones([1, 2, 8])
+    out_zero, _ = lstm(x)
+    out_init, (h, c) = lstm(x, (h0, c0))
+    assert not np.allclose(out_zero.numpy(), out_init.numpy())
+    # chunked == full sequence when states carried over
+    out_full, (hf, cf) = lstm(x)
+    o1, (h1, c1) = lstm(x[:, :2])
+    o2, (h2, c2) = lstm(x[:, 2:], (h1, c1))
+    np.testing.assert_allclose(np.concatenate([o1.numpy(), o2.numpy()], axis=1),
+                               out_full.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_weight_norm_grads_flow():
+    from paddle_tpu.nn.utils import weight_norm
+
+    l = weight_norm(nn.Linear(4, 3))
+    y = l(paddle.randn([2, 4]))
+    y.sum().backward()
+    assert l._parameters["weight_g"].grad is not None
+    assert l._parameters["weight_v"].grad is not None

@@ -161,3 +161,26 @@ def test_grad_scaler_skips_on_inf():
     scaler.step(o)
     np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
     assert scaler._scale < 2.0  # scale decreased
+
+
+def test_gradscaler_no_double_unscale():
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    o = opt.SGD(0.1, parameters=[w])
+    scaler.scale((w * 2).sum()).backward()
+    scaler.unscale_(o)   # manual unscale (clip workflow)
+    g1 = float(w.grad.numpy()[0])
+    scaler.step(o)       # must NOT unscale again
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * g1], rtol=1e-5)
+    assert g1 == pytest.approx(2.0)
+
+
+def test_adamw_decay_param_fun():
+    wa = paddle.to_tensor([1.0], stop_gradient=False); wa.name = "linear.weight"
+    wb = paddle.to_tensor([1.0], stop_gradient=False); wb.name = "norm.bias"
+    o = opt.AdamW(0.1, parameters=[wa, wb], weight_decay=0.5,
+                  apply_decay_param_fun=lambda n: "bias" not in n)
+    ((wa * 0.0) + (wb * 0.0)).sum().backward()
+    o.step()
+    assert float(wa.numpy()[0]) < 1.0     # decayed
+    assert float(wb.numpy()[0]) == 1.0    # excluded from decay
