@@ -1,0 +1,113 @@
+"""Benchmark: Llama causal-LM training step on one real TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric is tokens/sec/chip on a compiled fwd+bwd+AdamW step (bf16 params,
+f32 master weights); vs_baseline is achieved MFU / 0.40 (the north-star MFU
+target from BASELINE.md — the reference publishes no numbers to beat).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# TPU peak bf16 TFLOP/s per chip by generation
+_PEAK_TFLOPS = {"v5e": 197.0, "v5p": 459.0, "v4": 275.0, "v6e": 918.0}
+
+
+def _model_flops_per_token(cfg) -> float:
+    """6*N style estimate incl. attention term."""
+    h, L = cfg.hidden_size, cfg.num_hidden_layers
+    inter = cfg.intermediate_size
+    v = cfg.vocab_size
+    kv_ratio = cfg.num_key_value_heads / cfg.num_attention_heads
+    per_layer = (
+        2 * h * h * (1 + 2 * kv_ratio + 1)  # q,k,v,o projections
+        + 2 * h * inter * 3                 # swiglu gate/up/down
+    )
+    emb = 2 * h * v  # lm head matmul
+    params_matmul = L * per_layer + emb
+    return 3 * params_matmul  # fwd (1x) + bwd (2x)
+
+
+def _attn_flops_per_token(cfg, seq) -> float:
+    return 3 * 2 * 2 * cfg.num_hidden_layers * cfg.hidden_size * seq  # qk + pv, fwd+bwd
+
+
+def main():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # honor an explicit CPU request at config level (the TPU-tunnel
+        # plugin's sitecustomize overrides the env var after import)
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = _PEAK_TFLOPS.get(gen, 197.0) * 1e12
+
+    seq = 2048
+    batch = 4
+    cfg = LlamaConfig(
+        vocab_size=32000,
+        hidden_size=2048,
+        intermediate_size=5632,
+        num_hidden_layers=8,
+        num_attention_heads=16,
+        num_key_value_heads=8,
+        max_position_embeddings=seq,
+        use_flash_attention=on_tpu,
+        dtype="bfloat16" if on_tpu else "float32",
+    )
+    if not on_tpu:  # CPU smoke fallback so the script always emits a line
+        seq, batch = 128, 2
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    optimizer = opt.AdamW(3e-4, parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        loss, _ = m(x, labels=y)
+        return loss
+
+    step = paddle.jit.train_step(model, loss_fn, optimizer)
+
+    ids = np.random.randint(0, cfg.vocab_size, (batch, seq + 1))
+    x = paddle.to_tensor(ids[:, :-1])
+    y = paddle.to_tensor(ids[:, 1:])
+
+    step(x, y)  # compile
+    # timed steps
+    n_steps = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step(x, y)
+    loss.numpy()  # sync
+    dt = (time.perf_counter() - t0) / n_steps
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step / dt
+    flops_per_token = _model_flops_per_token(cfg) + _attn_flops_per_token(cfg, seq)
+    mfu = tokens_per_sec * flops_per_token / peak
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+    print(f"# step={dt*1000:.1f}ms mfu={mfu:.3f} gen={gen} loss={float(loss.numpy()):.3f} "
+          f"params={model.num_parameters()/1e6:.0f}M platform={jax.devices()[0].platform}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

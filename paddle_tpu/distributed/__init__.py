@@ -1,0 +1,59 @@
+"""paddle_tpu.distributed — the distributed stack.
+
+Reference parity map (SURVEY.md §2.5-2.7):
+- comm backend → XLA collectives over mesh axes (collective.py)
+- DistTensor/SPMD/reshard → jax.sharding + GSPMD (api.py, placements.py)
+- HybridCommunicateGroup → one named-axis Mesh (topology.py)
+- fleet → fleet.py; DataParallel → parallel.py
+- TP/SP layers → parallel_layers.py; recompute → recompute_layer.py
+- PP → pipeline.py; MoE/EP → moe.py; ring attention → ring_attention.py
+- distributed checkpoint → checkpoint.py; launcher → launch/
+"""
+from . import env
+from .env import (
+    get_rank, get_world_size, init_parallel_env, is_initialized,
+)
+from .placements import Placement, Shard, Replicate, Partial
+from .process_mesh import ProcessMesh, get_mesh, set_mesh, auto_mesh
+from .api import (
+    shard_tensor, reshard, dtensor_from_local, dtensor_to_local,
+    unshard_dtensor, shard_layer, shard_optimizer, DistAttr,
+    ShardingStage1, ShardingStage2, ShardingStage3,
+)
+from .collective import (
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    reduce_scatter, all_to_all, broadcast, scatter, barrier, send, recv,
+    psum, pmean, ppermute, axis_index,
+)
+from .strategy import DistributedStrategy
+from .topology import (
+    HybridCommunicateGroup, CommunicateTopology,
+    set_hybrid_communicate_group, get_hybrid_communicate_group,
+)
+from .parallel import DataParallel
+from . import fleet as _fleet_mod
+from .fleet import fleet
+from .parallel_layers import (
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+    ParallelCrossEntropy, GatherOp, ScatterOp,
+)
+from .recompute_layer import recompute, RecomputeLayer
+
+
+def __getattr__(name):
+    if name in ("pipeline", "moe", "ring_attention", "checkpoint", "launch", "sharding"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "save_state_dict":
+        from .checkpoint import save_state_dict
+
+        return save_state_dict
+    if name == "load_state_dict":
+        from .checkpoint import load_state_dict
+
+        return load_state_dict
+    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

@@ -1,0 +1,76 @@
+"""Distributed environment: ranks, world size, multi-host bootstrap.
+
+Reference parity: paddle.distributed.init_parallel_env + PADDLE_TRAINER_* env
+protocol (python/paddle/distributed/parallel.py:978,1134 — TCPStore
+rendezvous). TPU-native: ``jax.distributed.initialize`` is the coordinator
+(the TCPStore analog); after it, ``jax.devices()`` is the global device list
+and all collectives compile over ICI/DCN. Single-process multi-device (one
+host, N chips) needs no bootstrap at all.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = [False]
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None, process_id=None,
+                      local_device_ids=None):
+    """Bootstrap multi-host; no-op for single-process jobs.
+
+    Env protocol (launcher parity): PADDLE_MASTER / PADDLE_TRAINERS_NUM /
+    PADDLE_TRAINER_ID, falling back to the JAX coordination vars.
+    """
+    if _initialized[0]:
+        return
+    coordinator_address = coordinator_address or os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    num_processes = num_processes or _int_env("PADDLE_TRAINERS_NUM") or _int_env("JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else (
+        _int_env("PADDLE_TRAINER_ID") if "PADDLE_TRAINER_ID" in os.environ else _int_env("JAX_PROCESS_ID"))
+    if coordinator_address and num_processes and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    _initialized[0] = True
+
+
+def _int_env(name):
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+def get_rank(group=None) -> int:
+    """Process index (reference: paddle.distributed.get_rank)."""
+    if group is not None:
+        return group.rank
+    try:
+        return jax.process_index()
+    except Exception:  # pragma: no cover
+        return 0
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    try:
+        return jax.process_count()
+    except Exception:  # pragma: no cover
+        return 1
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()

@@ -1,0 +1,79 @@
+"""DataParallel + parallel env entry.
+
+Reference parity: paddle.DataParallel (python/paddle/distributed/parallel.py
+:219, C++ EagerReducer reducer.h:88) and init_parallel_env (:978).
+
+TPU-native: DP is a batch sharding — the model wrapper shards inputs on the
+'dp'/default axis and lets GSPMD average gradients (the reducer's bucketed
+overlap allreduce is what XLA emits for replicated-param gradients
+automatically). No bucket bookkeeping survives; the wrapper exists for API
+parity and to install the input-sharding hook.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..nn.layer import Layer
+from ..tensor_class import Tensor, unwrap, wrap
+from .process_mesh import ProcessMesh
+from . import env as _env
+
+
+def init_parallel_env():
+    _env.init_parallel_env()
+    return _env.get_rank()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        n = jax.device_count()
+        self._mesh = ProcessMesh(np.arange(n), ["dp"])
+
+    def forward(self, *inputs, **kwargs):
+        sharded = []
+        for t in inputs:
+            if isinstance(t, Tensor) and t.ndim >= 1 and t.shape[0] % self._mesh.size == 0:
+                arr = jax.device_put(
+                    unwrap(t),
+                    NamedSharding(self._mesh.jax_mesh(),
+                                  PartitionSpec("dp", *([None] * (t.ndim - 1)))))
+                nt = wrap(arr, t.stop_gradient)
+                sharded.append(nt)
+            else:
+                sharded.append(t)
+        return self._layers(*sharded, **kwargs)
+
+    # delegate the Layer surface to the wrapped model
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def scale_loss(self, loss):
+        return loss

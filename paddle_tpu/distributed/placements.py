@@ -1,0 +1,116 @@
+"""Placement types: Shard / Replicate / Partial.
+
+Reference parity: paddle/phi/core/distributed/auto_parallel/placement_types.h
+and python/paddle/distributed (dist.Shard/dist.Replicate/dist.Partial).
+Mapping to jax.sharding: a placements list (one entry per MESH dim) compiles
+to a PartitionSpec (one entry per TENSOR dim); Partial has no direct
+PartitionSpec form — it is tracked as a pending-reduce annotation and
+materialised by reshard() via psum (the same role the reference's
+p_to_{r,s} reshard functions play).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return True if dim is None else self.dim == dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+def placements_to_partition_spec(placements: Sequence[Placement], mesh_dim_names: Sequence[str],
+                                 tensor_ndim: int):
+    """Build the jax PartitionSpec equivalent of a placements list.
+
+    Partial entries contribute nothing to the spec (the value is locally
+    unreduced but replicated in layout terms).
+    """
+    from jax.sharding import PartitionSpec
+
+    per_tensor_dim: List[list] = [[] for _ in range(tensor_ndim)]
+    for mesh_dim, placement in enumerate(placements):
+        if isinstance(placement, Shard):
+            if placement.dim >= tensor_ndim:
+                raise ValueError(
+                    f"Shard(dim={placement.dim}) invalid for tensor of rank {tensor_ndim}")
+            per_tensor_dim[placement.dim].append(mesh_dim_names[mesh_dim])
+    entries = []
+    for axes in per_tensor_dim:
+        if not axes:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(tuple(axes))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def partition_spec_to_placements(spec, mesh_dim_names: Sequence[str]) -> List[Placement]:
+    placements: List[Placement] = [Replicate() for _ in mesh_dim_names]
+    name_to_mesh_dim = {n: i for i, n in enumerate(mesh_dim_names)}
+    for tensor_dim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            placements[name_to_mesh_dim[ax]] = Shard(tensor_dim)
+    return placements

@@ -1,0 +1,133 @@
+"""ProcessMesh — the N-d logical device mesh.
+
+Reference parity: paddle ProcessMesh
+(python/paddle/distributed/auto_parallel/process_mesh.py:85,
+paddle/phi/core/distributed/auto_parallel/process_mesh.h:34). TPU-native: a
+thin veneer over jax.sharding.Mesh whose axes map onto the ICI torus — jax
+orders jax.devices() so contiguous mesh dims align with physical links; all
+collectives over these axes ride ICI.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+_current_mesh: list = [None]
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None, shape=None,
+                 process_ids=None):
+        if shape is not None and process_ids is not None:
+            arr = np.asarray(process_ids).reshape(shape)
+        else:
+            arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(f"{len(dim_names)} dim_names for mesh of rank {arr.ndim}")
+        self._mesh = arr
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    # ---- paddle surface ------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def process_ids(self) -> List[int]:
+        return self._mesh.reshape(-1).tolist()
+
+    @property
+    def size(self) -> int:
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index=None):
+        """Sub-mesh: drop (or index into) one dimension."""
+        axis = self._dim_names.index(dim_name)
+        names = [n for n in self._dim_names if n != dim_name]
+        if index is None:
+            moved = np.moveaxis(self._mesh, axis, 0)
+            return [ProcessMesh(moved[i], names) for i in range(moved.shape[0])]
+        return ProcessMesh(np.take(self._mesh, index, axis=axis), names)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    # ---- jax bridge ----------------------------------------------------------
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = np.asarray(jax.devices(), dtype=object)
+            if self.size > devices.size:
+                raise ValueError(
+                    f"mesh needs {self.size} devices; only {devices.size} present")
+            dev_grid = np.empty(self._mesh.shape, dtype=object)
+            flat_ids = self._mesh.reshape(-1)
+            dev_by_id = {d.id: d for d in jax.devices()}
+            for i, pid in enumerate(flat_ids):
+                dev_grid.reshape(-1)[i] = dev_by_id.get(int(pid), jax.devices()[int(pid) % devices.size])
+            self._jax_mesh = Mesh(dev_grid, axis_names=tuple(self._dim_names))
+        return self._jax_mesh
+
+    def sharding_for(self, placements, tensor_ndim: int) -> NamedSharding:
+        from .placements import placements_to_partition_spec
+
+        spec = placements_to_partition_spec(placements, self._dim_names, tensor_ndim)
+        return NamedSharding(self.jax_mesh(), spec)
+
+    def __enter__(self):
+        _current_mesh.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _current_mesh.pop()
+        return False
+
+
+def get_current_mesh() -> Optional[ProcessMesh]:
+    return _current_mesh[-1]
+
+
+def set_mesh(mesh: ProcessMesh):
+    _current_mesh[-1] = mesh
+
+
+def get_mesh():
+    return _current_mesh[-1]
+
+
+def auto_mesh(*dim_sizes, dim_names=None) -> ProcessMesh:
+    """Build a mesh over all visible devices with the given logical shape."""
+    n = int(np.prod(dim_sizes)) if dim_sizes else jax.device_count()
+    if not dim_sizes:
+        dim_sizes = (jax.device_count(),)
+    return ProcessMesh(np.arange(n).reshape(dim_sizes),
+                       dim_names or [f"d{i}" for i in range(len(dim_sizes))])

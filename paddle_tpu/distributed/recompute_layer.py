@@ -1,0 +1,101 @@
+"""Recompute (activation checkpointing).
+
+Reference parity: fleet/recompute/recompute.py (RecomputeFunction :124,455,
+non-reentrant :319, RNG replay via switch_rng_state_tracker :112).
+
+TPU-native: ``jax.checkpoint`` (rematerialisation) with selectable policies —
+XLA replays the forward during backward, which is exactly the reference's
+recompute but compiler-managed; RNG replay is free because dropout keys are
+explicit functional inputs. Works in both modes: under jit it's the real
+remat; eagerly it wraps the layer call in a tape-recorded jax.checkpoint fn.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ..nn.layer import Layer
+from ..ops.registry import apply
+from ..tensor_class import Tensor, unwrap, wrap
+
+_POLICIES = {
+    "full": None,  # save nothing, recompute all
+    "dots_saveable": "dots_saveable",
+    "nothing_saveable": "nothing_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+}
+
+
+def _jax_policy(name):
+    if name is None or name == "full":
+        return None
+    return getattr(jax.checkpoint_policies, _POLICIES[name])
+
+
+def recompute(function, *args, use_reentrant=True, policy=None, **kwargs):
+    """paddle.distributed.fleet.utils.recompute parity: checkpoint one call."""
+    pol = _jax_policy(policy)
+
+    ckpt_fn = jax.checkpoint(
+        lambda *arrs: _call_with_arrays(function, args, kwargs, arrs),
+        policy=pol,
+    )
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    return apply("recompute", ckpt_fn, *tensor_args)
+
+
+def _call_with_arrays(function, args, kwargs, arrs):
+    """Re-substitute traced arrays into the original tensor positions."""
+    it = iter(arrs)
+    new_args = [wrap(next(it)) if isinstance(a, Tensor) else a for a in args]
+    out = function(*new_args, **kwargs)
+    return unwrap(out) if isinstance(out, Tensor) else jax.tree_util.tree_map(
+        lambda x: unwrap(x) if isinstance(x, Tensor) else x, out,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+class RecomputeLayer(Layer):
+    """Wrap a sublayer so its forward is rematerialised."""
+
+    def __init__(self, inner: Layer, policy=None):
+        super().__init__()
+        self.inner = inner
+        self._policy = policy
+
+    def forward(self, *args, **kwargs):
+        # include parameters as differentiable inputs of the checkpointed fn
+        params = [p for _, p in self.inner.named_parameters()]
+        pol = _jax_policy(self._policy)
+        inner = self.inner
+        n_args = len(args)
+
+        def fn(*arrs):
+            arg_arrs = arrs[:n_args]
+            param_arrs = arrs[n_args:]
+            snapshot = {}
+            for (name, p), a in zip(inner.named_parameters(), param_arrs):
+                snapshot[name] = p._array
+                p._array = a
+            try:
+                out = inner(*[wrap(a) for a in arg_arrs], **kwargs)
+                return unwrap(out)
+            finally:
+                for name, p in inner.named_parameters():
+                    p._array = snapshot[name]
+
+        ckpt = jax.checkpoint(fn, policy=pol)
+        return apply("recompute_layer", ckpt, *args, *params)
+
+
+def apply_recompute(model: Layer, configs):
+    """Wrap either the named checkpoints or every direct child that has
+    parameters (strategy.recompute_configs parity)."""
+    targets = set(configs.checkpoints or [])
+    for name, sub in list(model._sub_layers.items()):
+        if sub is None:
+            continue
+        if not targets or name in targets:
+            if any(True for _ in sub.named_parameters()):
+                model._sub_layers[name] = RecomputeLayer(sub, policy=configs.policy)
+    return model

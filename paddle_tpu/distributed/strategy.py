@@ -1,0 +1,156 @@
+"""DistributedStrategy — the hybrid-parallel config object.
+
+Reference parity: the DistributedStrategy protobuf
+(paddle/fluid/framework/distributed_strategy.proto:364, 248 fields) and
+python/paddle/distributed/fleet/base/distributed_strategy.py. TPU-native: a
+plain dataclass tree (SURVEY §5 config mapping: "absl-style flags + a
+dataclass strategy object"); only fields with TPU meaning are interpreted,
+the rest are accepted and stored for checkpoint/config compatibility.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class HybridConfigs:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+    order: tuple = ("dp", "pp", "sharding", "sep", "mp")
+
+
+@dataclasses.dataclass
+class RecomputeConfigs:
+    enable: bool = False
+    checkpoints: Optional[list] = None
+    policy: str = "full"  # full | dots_saveable | nothing_saveable
+
+
+@dataclasses.dataclass
+class AmpConfigs:
+    enable: bool = False
+    dtype: str = "bfloat16"
+    level: str = "O1"
+    custom_white_list: Optional[list] = None
+    custom_black_list: Optional[list] = None
+
+
+@dataclasses.dataclass
+class ShardingConfigs:
+    stage: int = 1
+    degree: int = 1
+    offload: bool = False
+
+
+@dataclasses.dataclass
+class PipelineConfigs:
+    micro_batch_size: int = 1
+    accumulate_steps: int = 1
+    schedule_mode: str = "1F1B"  # FThenB | 1F1B (host) | gpipe-circular (in-graph)
+
+
+class DistributedStrategy:
+    """Accepts paddle-style nested dict configs:
+    ``strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, ...}``."""
+
+    def __init__(self):
+        self._hybrid = HybridConfigs()
+        self._recompute = RecomputeConfigs()
+        self._amp = AmpConfigs()
+        self._sharding = ShardingConfigs()
+        self._pipeline = PipelineConfigs()
+        self.find_unused_parameters = False
+        self.gradient_merge = {"enable": False, "k_steps": 1}
+        self._extra: Dict[str, Any] = {}
+
+    # paddle-style property-with-dict-assign surface
+    @property
+    def hybrid_configs(self):
+        return self._hybrid
+
+    @hybrid_configs.setter
+    def hybrid_configs(self, cfg: Dict[str, Any]):
+        for k, v in cfg.items():
+            if hasattr(self._hybrid, k):
+                setattr(self._hybrid, k, v)
+            else:
+                self._extra[f"hybrid.{k}"] = v
+
+    @property
+    def recompute(self):
+        return self._recompute.enable
+
+    @recompute.setter
+    def recompute(self, v):
+        self._recompute.enable = bool(v)
+
+    @property
+    def recompute_configs(self):
+        return self._recompute
+
+    @recompute_configs.setter
+    def recompute_configs(self, cfg):
+        for k, v in cfg.items():
+            if hasattr(self._recompute, k):
+                setattr(self._recompute, k, v)
+
+    @property
+    def amp(self):
+        return self._amp.enable
+
+    @amp.setter
+    def amp(self, v):
+        self._amp.enable = bool(v)
+
+    @property
+    def amp_configs(self):
+        return self._amp
+
+    @amp_configs.setter
+    def amp_configs(self, cfg):
+        for k, v in cfg.items():
+            if hasattr(self._amp, k):
+                setattr(self._amp, k, v)
+
+    @property
+    def sharding_configs(self):
+        return self._sharding
+
+    @sharding_configs.setter
+    def sharding_configs(self, cfg):
+        for k, v in cfg.items():
+            if hasattr(self._sharding, k):
+                setattr(self._sharding, k, v)
+
+    @property
+    def pipeline_configs(self):
+        return self._pipeline
+
+    @pipeline_configs.setter
+    def pipeline_configs(self, cfg):
+        for k, v in cfg.items():
+            if hasattr(self._pipeline, k):
+                setattr(self._pipeline, k, v)
+
+    def __setattr__(self, name, value):
+        # unknown strategy switches are stored, not rejected (proto has 248)
+        if name.startswith("_") or name in type(self).__dict__ or name in (
+                "find_unused_parameters", "gradient_merge"):
+            object.__setattr__(self, name, value)
+        else:
+            self._extra[name] = value
+
+    def __getattr__(self, name):
+        extra = self.__dict__.get("_extra", {})
+        if name in extra:
+            return extra[name]
+        raise AttributeError(name)
+
+    def __repr__(self):
+        return (f"DistributedStrategy(hybrid={self._hybrid}, amp={self._amp}, "
+                f"recompute={self._recompute}, sharding={self._sharding}, "
+                f"pipeline={self._pipeline})")

@@ -1,0 +1,165 @@
+"""Hybrid-parallel topology: the 5-axis mesh.
+
+Reference parity: HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py:189) which factors the
+world into dp × pp × sharding × sep × mp and creates a NCCL group per axis
+plus fused axes (get_dp_sep_parallel_group :566 etc.).
+
+TPU-native: ONE jax Mesh with named axes ('pp','dp','sharding','sep','mp')
+— axis order chosen so mp (highest-traffic collectives) maps to the
+innermost/fastest ICI dimension, pp (cheapest, p2p only) outermost; every
+"group" is a Group view over one or more axes of that single mesh, and
+"creating a communicator" costs nothing.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .process_mesh import ProcessMesh
+from .collective import Group
+
+
+class CommunicateTopology:
+    """Parity: fleet.base.topology.CommunicateTopology."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+
+class HybridCommunicateGroup:
+    """The mesh-backed hybrid topology (topology.py:189 parity).
+
+    Axis layout (outer→inner): pp, dp, sharding, sep, mp. ``get_*_parallel_*``
+    accessors mirror the reference; group objects are mesh-axis views usable
+    with the collective API and as sharding axis names.
+    """
+
+    AXES = ("pp", "dp", "sharding", "sep", "mp")
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None, *,
+                 dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1, sep_degree=1):
+        if topology is not None:
+            m = dict(zip(topology.get_hybrid_group_names(), topology._dims))
+            dp_degree = m.get("data", 1)
+            pp_degree = m.get("pipe", 1)
+            sharding_degree = m.get("sharding", 1)
+            sep_degree = m.get("sep", 1)
+            mp_degree = m.get("model", 1)
+        self._dp, self._mp, self._pp = dp_degree, mp_degree, pp_degree
+        self._sharding, self._sep = sharding_degree, sep_degree
+        world = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree
+        ids = np.arange(world).reshape(pp_degree, dp_degree, sharding_degree, sep_degree, mp_degree)
+        self._mesh = ProcessMesh(ids, list(self.AXES))
+        self.global_rank = 0
+
+    @property
+    def mesh(self) -> ProcessMesh:
+        return self._mesh
+
+    def jax_mesh(self):
+        return self._mesh.jax_mesh()
+
+    # ---- degrees -------------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp
+
+    def get_model_parallel_world_size(self):
+        return self._mp
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding
+
+    def get_sep_parallel_world_size(self):
+        return self._sep
+
+    # ---- ranks (single-process SPMD: coordinate of this process) ------------
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # ---- groups --------------------------------------------------------------
+    def get_data_parallel_group(self) -> Group:
+        return Group(self._mesh, ["dp"])
+
+    def get_model_parallel_group(self) -> Group:
+        return Group(self._mesh, ["mp"])
+
+    def get_pipe_parallel_group(self) -> Group:
+        return Group(self._mesh, ["pp"])
+
+    def get_sharding_parallel_group(self) -> Group:
+        return Group(self._mesh, ["sharding"])
+
+    def get_sep_parallel_group(self) -> Group:
+        return Group(self._mesh, ["sep"])
+
+    def get_dp_sep_parallel_group(self) -> Group:
+        return Group(self._mesh, ["dp", "sep"])
+
+    def get_pp_mp_parallel_group(self) -> Group:
+        return Group(self._mesh, ["pp", "mp"])
+
+    def get_check_parallel_group(self, sharding=False) -> Group:
+        axes = ["pp", "sep", "mp"] + (["sharding"] if sharding else [])
+        return Group(self._mesh, axes)
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id * (self._dp * self._sharding * self._sep * self._mp)
+
+    # convenience: axes with degree > 1 (for sharding annotations)
+    def active_axes(self) -> List[str]:
+        return [a for a, d in zip(self.AXES, (self._pp, self._dp, self._sharding, self._sep, self._mp)) if d > 1]
+
+    def topology(self):
+        return CommunicateTopology(
+            ("data", "pipe", "sharding", "sep", "model"),
+            (self._dp, self._pp, self._sharding, self._sep, self._mp))
+
+    def __repr__(self):
+        return (f"HybridCommunicateGroup(dp={self._dp}, mp={self._mp}, pp={self._pp}, "
+                f"sharding={self._sharding}, sep={self._sep})")
+
+
+_hcg: list = [None]
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    _hcg[0] = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg[0]

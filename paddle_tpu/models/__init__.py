@@ -1,0 +1,20 @@
+"""Model zoo: flagship pretraining models (SURVEY §6 workload configs)."""
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaDecoderLayer
+
+
+def __getattr__(name):
+    if name in ("gpt", "GPTConfig", "GPTForCausalLM"):
+        from . import gpt
+
+        globals()["gpt"] = gpt
+        if name != "gpt":
+            return getattr(gpt, name)
+        return gpt
+    if name in ("moe", "MoEConfig", "LlamaMoEForCausalLM"):
+        from . import moe as moe_mod
+
+        globals()["moe"] = moe_mod
+        if name != "moe":
+            return getattr(moe_mod, name)
+        return moe_mod
+    raise AttributeError(f"module 'paddle_tpu.models' has no attribute {name!r}")

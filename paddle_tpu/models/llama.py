@@ -1,0 +1,296 @@
+"""Llama-3 model family — the flagship pretraining workload.
+
+Reference parity: the reference trains Llama via PaddleNLP on the fleet
+hybrid-parallel stack (SURVEY §2.7, CS4); this is the equivalent model
+implemented on paddle_tpu's layer system with TPU-first choices:
+
+- GQA attention with a fused Pallas flash kernel (ops/pallas/flash_attention)
+  and fused rotary embeddings (ops/pallas/fused_norm.fused_rope);
+- RMSNorm via the fused Pallas kernel;
+- tensor/sequence parallelism via the mp/sep axes of the hybrid mesh
+  (parallel layers + sharding constraints), FSDP via the sharding axis;
+- bf16 weights with f32 master copies in the optimizer (framework default).
+
+Config names follow HF/PaddleNLP llama conventions so checkpoints map 1:1.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.layer import Layer
+from ..nn.initializer_core import Normal, Constant
+from ..ops.registry import apply
+from ..tensor_class import Tensor, unwrap, wrap
+from ..distributed.topology import get_hybrid_communicate_group
+from ..distributed import parallel_layers as mpu
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    sequence_parallel: bool = False
+    recompute: bool = False
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def llama3_8b(**kw):
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama3_70b(**kw):
+        base = dict(hidden_size=8192, intermediate_size=28672, num_hidden_layers=80,
+                    num_attention_heads=64, num_key_value_heads=8)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                    max_position_embeddings=256, dtype="float32")
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+def _rope_tables(seq_len, head_dim, theta, dtype=jnp.float32):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [S, D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, D]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+class LlamaRMSNorm(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.hidden_size = config.hidden_size
+        self.variance_epsilon = config.rms_norm_eps
+        self.weight = self.create_parameter([config.hidden_size],
+                                            default_initializer=Constant(1.0),
+                                            dtype=config.dtype)
+
+    def forward(self, x):
+        from ..ops.pallas import fused_norm
+
+        eps = self.variance_epsilon
+        return apply("rms_norm", lambda a, w: fused_norm.rms_norm(a, w, eps), x, self.weight)
+
+
+def _mp_enabled():
+    hcg = get_hybrid_communicate_group()
+    return hcg is not None and hcg.get_model_parallel_world_size() > 1
+
+
+def _make_linear(in_f, out_f, *, column: bool, config: LlamaConfig, gather_output=False,
+                 input_is_parallel=True):
+    if _mp_enabled():
+        if column:
+            cls = (mpu.ColumnSequenceParallelLinear if config.sequence_parallel
+                   else mpu.ColumnParallelLinear)
+            return cls(in_f, out_f, has_bias=False, gather_output=gather_output)
+        cls = (mpu.RowSequenceParallelLinear if config.sequence_parallel
+               else mpu.RowParallelLinear)
+        return cls(in_f, out_f, has_bias=False, input_is_parallel=input_is_parallel)
+    return nn.Linear(in_f, out_f, bias_attr=False)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.hidden_size = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        self.q_proj = _make_linear(self.hidden_size, self.num_heads * self.head_dim,
+                                   column=True, config=config)
+        self.k_proj = _make_linear(self.hidden_size, self.num_kv_heads * self.head_dim,
+                                   column=True, config=config)
+        self.v_proj = _make_linear(self.hidden_size, self.num_kv_heads * self.head_dim,
+                                   column=True, config=config)
+        self.o_proj = _make_linear(self.num_heads * self.head_dim, self.hidden_size,
+                                   column=False, config=config)
+
+    def forward(self, hidden_states, cos, sin, attention_mask=None, kv_cache=None, position_offset=0):
+        b, s = hidden_states.shape[0], hidden_states.shape[1]
+        h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
+        q = self.q_proj(hidden_states).reshape([b, s, h, d])
+        k = self.k_proj(hidden_states).reshape([b, s, hk, d])
+        v = self.v_proj(hidden_states).reshape([b, s, hk, d])
+
+        cfg = self.config
+
+        def attn_fn(q, k, v, cos, sin, *cache):
+            from ..ops.pallas import fused_norm, flash_attention as pf
+            from ..nn.functional.attention import _sdpa_ref
+
+            q = fused_norm.fused_rope(q, cos, sin)
+            k = fused_norm.fused_rope(k, cos, sin)
+            if cache:
+                k = jnp.concatenate([cache[0], k], axis=1)
+                v = jnp.concatenate([cache[1], v], axis=1)
+            # GQA: expand kv heads to q heads
+            if hk != h:
+                rep = h // hk
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            if cfg.use_flash_attention and pf.supported(q, k, v):
+                out = pf.flash_attention_bshd(q, k, v, causal=True)
+            else:
+                out = _sdpa_ref(q, k, v, causal=True)
+            return out.reshape(b, out.shape[1], h * d), k, v
+
+        cache_args = [kv_cache[0], kv_cache[1]] if kv_cache is not None else []
+        out, k_new, v_new = apply("llama_attention", attn_fn, q, k, v, cos, sin, *cache_args)
+        result = self.o_proj(out)
+        if kv_cache is not None:
+            return result, (k_new, v_new)
+        return result
+
+
+class LlamaMLP(Layer):
+    """SwiGLU MLP."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.gate_proj = _make_linear(config.hidden_size, config.intermediate_size,
+                                      column=True, config=config)
+        self.up_proj = _make_linear(config.hidden_size, config.intermediate_size,
+                                    column=True, config=config)
+        self.down_proj = _make_linear(config.intermediate_size, config.hidden_size,
+                                      column=False, config=config)
+
+    def forward(self, x):
+        gate = self.gate_proj(x)
+        up = self.up_proj(x)
+        act = apply("swiglu", lambda g, u: jax.nn.silu(g) * u, gate, up)
+        return self.down_proj(act)
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = LlamaRMSNorm(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config)
+
+    def forward(self, hidden_states, cos, sin, attention_mask=None, kv_cache=None):
+        residual = hidden_states
+        hidden_states = self.input_layernorm(hidden_states)
+        if kv_cache is not None:
+            hidden_states, kv_cache = self.self_attn(hidden_states, cos, sin,
+                                                     attention_mask, kv_cache)
+        else:
+            hidden_states = self.self_attn(hidden_states, cos, sin, attention_mask)
+        hidden_states = residual + hidden_states
+        residual = hidden_states
+        hidden_states = self.post_attention_layernorm(hidden_states)
+        hidden_states = residual + self.mlp(hidden_states)
+        if kv_cache is not None:
+            return hidden_states, kv_cache
+        return hidden_states
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        if _mp_enabled() and config.vocab_size % get_hybrid_communicate_group().get_model_parallel_world_size() == 0:
+            self.embed_tokens = mpu.VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        else:
+            self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.embed_tokens.weight._array = (
+            Normal(0.0, config.initializer_range)(
+                (config.vocab_size, config.hidden_size), jnp.float32)
+            .astype(self.embed_tokens.weight.dtype))
+        layers = [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        if config.recompute:
+            from ..distributed.recompute_layer import RecomputeLayer
+
+            layers = [RecomputeLayer(l) for l in layers]
+        self.layers = nn.LayerList(layers)
+        self.norm = LlamaRMSNorm(config)
+        self._rope_cache = {}
+
+    def _rope(self, seq_len):
+        if seq_len in self._rope_cache:
+            return self._rope_cache[seq_len]
+        cos, sin = _rope_tables(seq_len, self.config.hidden_size // self.config.num_attention_heads,
+                                self.config.rope_theta)
+        pair = (wrap(cos), wrap(sin))
+        # memoize only outside traces (a traced constant must not escape)
+        try:
+            if jax.core.trace_state_clean():
+                self._rope_cache[seq_len] = pair
+        except Exception:  # pragma: no cover
+            pass
+        return pair
+
+    def forward(self, input_ids, attention_mask=None):
+        s = input_ids.shape[1]
+        cos, sin = self._rope(s)
+        hidden = self.embed_tokens(input_ids)
+        hidden = hidden.astype(self.config.dtype)
+        for layer in self.layers:
+            hidden = layer(hidden, cos, sin, attention_mask)
+        return self.norm(hidden)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = _make_linear(config.hidden_size, config.vocab_size,
+                                        column=True, config=config, gather_output=True)
+            self.lm_head.weight._array = (
+                Normal(0.0, config.initializer_range)(
+                    (config.hidden_size, config.vocab_size), jnp.float32)
+                .astype(self.lm_head.weight.dtype))
+
+    def forward(self, input_ids, labels=None, attention_mask=None):
+        hidden = self.llama(input_ids, attention_mask)
+        if self.lm_head is None:
+            logits = apply("tied_lm_head", lambda h, w: h @ w.T,
+                           hidden, self.llama.embed_tokens.weight)
+        else:
+            logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+
+        def loss_fn(lg, lb):
+            lg32 = lg.astype(jnp.float32)
+            logp = jax.nn.log_softmax(lg32, axis=-1)
+            idx = lb.astype(jnp.int32)
+            mask = idx >= 0
+            safe = jnp.where(mask, idx, 0)
+            nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+            nll = jnp.where(mask, nll, 0.0)
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+
+        loss = apply("causal_lm_loss", loss_fn, logits, labels)
+        return loss, logits
+
+    def num_parameters(self) -> int:
+        return sum(p.size for p in self.parameters())

@@ -32,6 +32,7 @@ class Tensor:
         "name",
         "persistable",
         "_backward_hooks",
+        "_dist_attr",  # (ProcessMesh, placements) for the semi-auto-parallel API
         "__weakref__",
     )
 
@@ -56,6 +57,7 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._backward_hooks = []
+        self._dist_attr = None
 
     # ---- construction helpers -------------------------------------------------
     @classmethod
@@ -68,6 +70,7 @@ class Tensor:
         t.name = None
         t.persistable = False
         t._backward_hooks = []
+        t._dist_attr = None
         return t
 
     # ---- core properties ------------------------------------------------------
@@ -355,6 +358,7 @@ class Parameter(Tensor):
         p.name = name
         p.persistable = True
         p._backward_hooks = []
+        p._dist_attr = None
         return p
 
     @property
