@@ -48,6 +48,11 @@ def __getattr__(name):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name in ("PipelineLayer", "PipelineParallel", "LayerDesc", "SharedLayerDesc",
+                "SegmentLayers"):
+        from . import pipeline as _pp
+
+        return getattr(_pp, name)
     if name == "save_state_dict":
         from .checkpoint import save_state_dict
 

@@ -61,6 +61,12 @@ class _Fleet:
         hcg = self._hcg
         strategy = self._strategy
 
+        # pipeline topology → wrap the PipelineLayer in the micro-batch runtime
+        from .pipeline import PipelineLayer, PipelineParallel
+
+        if isinstance(model, PipelineLayer) and hcg.get_pipe_parallel_world_size() > 1:
+            return PipelineParallel(model, hcg=hcg, strategy=strategy)
+
         # sharding axis → FSDP-style parameter placement rewrite (ZeRO-3 when
         # stage==3, else params replicated and only state shards at opt init)
         if hcg.get_sharding_parallel_world_size() > 1 and strategy.sharding_configs.stage >= 3:

@@ -1,0 +1,308 @@
+"""Pipeline-parallel tests: segmentation, schedules, and loss/param parity
+between pipelined and sequential training (the reference's loss-parity test
+style, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.pipeline import (
+    LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer, PipelineParallel,
+    fthenb_order, one_f_one_b_order,
+)
+from paddle_tpu.optimizer import SGD
+
+
+def _mse(out, label):
+    diff = out - label
+    return (diff * diff).mean()
+
+
+def _make_descs(width=16, n_blocks=8):
+    return [LayerDesc(nn.Linear, width, width) for _ in range(n_blocks)]
+
+
+def _snapshot(layer):
+    return {k: np.asarray(v._array) for k, v in layer.state_dict().items()}
+
+
+def _load(layer, snap):
+    import jax.numpy as jnp
+
+    own = layer.state_dict()
+    for k, v in snap.items():
+        own[k]._array = jnp.asarray(v)
+
+
+class TestSegmentLayers:
+    def test_uniform_even(self):
+        seg = SegmentLayers(_make_descs(n_blocks=8), 4, "uniform")
+        assert seg.do_segment() == [0, 2, 4, 6, 8]
+
+    def test_uniform_remainder(self):
+        seg = SegmentLayers(_make_descs(n_blocks=10), 4, "uniform")
+        parts = seg.do_segment()
+        assert parts[0] == 0 and parts[-1] == 10
+        sizes = [parts[i + 1] - parts[i] for i in range(4)]
+        assert sorted(sizes) == [2, 2, 3, 3]
+        # remainder goes to the earliest stages (reference behavior)
+        assert sizes == [3, 3, 2, 2]
+
+    def test_layer_name_method(self):
+        descs = []
+        for _ in range(4):
+            descs.append(LayerDesc(nn.Linear, 8, 8))
+            descs.append(LayerDesc(nn.GELU))
+        seg = SegmentLayers(descs, 4, "layer:Linear")
+        parts = seg.do_segment()
+        assert parts == [0, 2, 4, 6, 8]
+
+    def test_too_few_layers_raises(self):
+        with pytest.raises(ValueError):
+            SegmentLayers(_make_descs(n_blocks=2), 4, "uniform")
+
+
+class TestSchedules:
+    def test_1f1b_local_orders(self):
+        order = one_f_one_b_order(num_stages=4, num_micro=8)
+        # last stage strictly alternates F,B from the start
+        assert order[3][:6] == [("fwd", 0), ("bwd", 0), ("fwd", 1), ("bwd", 1), ("fwd", 2), ("bwd", 2)]
+        # first stage warms up with (S-1)=3 forwards
+        assert order[0][:3] == [("fwd", 0), ("fwd", 1), ("fwd", 2)]
+        assert order[0][3] == ("fwd", 3)
+        assert order[0][4] == ("bwd", 0)
+        for s in range(4):
+            assert len(order[s]) == 16
+            assert order[s].count(("fwd", 7)) == 1 and order[s].count(("bwd", 7)) == 1
+
+    def test_fthenb_local_orders(self):
+        order = fthenb_order(2, 4)
+        assert order[0] == [("fwd", m) for m in range(4)] + [("bwd", m) for m in range(4)]
+
+
+class TestPipelineForward:
+    def test_forward_matches_sequential(self):
+        paddle.seed(7)
+        pipe = PipelineLayer(_make_descs(), num_stages=4, loss_fn=_mse)
+        x = paddle.to_tensor(np.random.randn(4, 16).astype("float32"))
+        out = pipe(x)
+        # sequential application of the same built layers
+        y = x
+        for part in range(4):
+            y = pipe.get_stage_layer(part)(y)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(y), rtol=1e-6)
+
+
+class TestTrainParity:
+    @pytest.mark.parametrize("schedule", ["1F1B", "FThenB"])
+    def test_param_parity_vs_sequential(self, schedule):
+        paddle.seed(11)
+        pipe = PipelineLayer(_make_descs(), num_stages=4, loss_fn=_mse)
+        snap = _snapshot(pipe)
+
+        paddle.seed(99)  # different init, will be overwritten by snapshot
+        ref = PipelineLayer(_make_descs(), num_stages=4, loss_fn=_mse)
+        _load(ref, snap)
+
+        pp = PipelineParallel(pipe, accumulate_steps=4, schedule=schedule)
+        opt_p = SGD(learning_rate=0.1, parameters=pipe.parameters())
+        opt_r = SGD(learning_rate=0.1, parameters=ref.parameters())
+
+        rng = np.random.RandomState(0)
+        for step in range(3):
+            x = rng.randn(8, 16).astype("float32")
+            lbl = rng.randn(8, 16).astype("float32")
+            loss_p = pp.train_batch([paddle.to_tensor(x), paddle.to_tensor(lbl)], opt_p)
+
+            xt = paddle.to_tensor(x)
+            out = ref(xt)
+            loss_r = _mse(out, paddle.to_tensor(lbl))
+            loss_r.backward()
+            opt_r.step()
+            opt_r.clear_grad()
+
+            np.testing.assert_allclose(float(loss_p), float(loss_r), rtol=1e-5)
+
+        for (k, p), (k2, p2) in zip(sorted(pipe.state_dict().items()),
+                                    sorted(ref.state_dict().items())):
+            assert k == k2
+            np.testing.assert_allclose(np.asarray(p._array), np.asarray(p2._array),
+                                       rtol=2e-5, atol=2e-6)
+
+    def test_op_log_is_valid_1f1b(self):
+        paddle.seed(3)
+        pipe = PipelineLayer(_make_descs(), num_stages=4, loss_fn=_mse)
+        pp = PipelineParallel(pipe, accumulate_steps=8, schedule="1F1B")
+        opt = SGD(learning_rate=0.01, parameters=pipe.parameters())
+        x = np.random.randn(8, 16).astype("float32")
+        pp.train_batch([paddle.to_tensor(x), paddle.to_tensor(x)], opt)
+
+        log = pp.op_log
+        assert len(log) == 2 * 4 * 8  # fwd+bwd per stage per micro
+        done = set()
+        for op, s, mb in log:
+            if op == "fwd":
+                assert s == 0 or ("fwd", s - 1, mb) in done
+            else:
+                assert ("fwd", s, mb) in done
+                assert s == 3 or ("bwd", s + 1, mb) in done
+            done.add((op, s, mb))
+        # per-stage projection equals the canonical local 1F1B order
+        expect = one_f_one_b_order(4, 8)
+        for s in range(4):
+            local = [(op, mb) for op, st, mb in log if st == s]
+            assert local == expect[s]
+
+
+class TestSharedLayers:
+    def test_shared_desc_ties_weights(self):
+        paddle.seed(5)
+        V, H = 32, 16
+
+        # first stage embeds via gather, last stage projects with the SAME weight
+        class TiedEmbed(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.weight = self.create_parameter([V, H])
+
+            def forward(self, x):
+                import paddle_tpu.nn.functional as F
+
+                return F.embedding(x, self.weight)
+
+        def head_fwd(layer, h):
+            return paddle.matmul(h, layer.weight, transpose_y=True)
+
+        descs = [
+            SharedLayerDesc("emb", TiedEmbed),
+            LayerDesc(nn.Linear, H, H),
+            LayerDesc(nn.Linear, H, H),
+            SharedLayerDesc("emb", TiedEmbed, forward_func=head_fwd),
+        ]
+        pipe = PipelineLayer(descs, num_stages=4,
+                             loss_fn=lambda out, lbl: paddle.nn.functional.cross_entropy(
+                                 out.reshape([-1, V]), lbl.reshape([-1])).mean())
+        # one shared weight object across both stages
+        names = [k for k, _ in pipe.named_parameters() if k.endswith("weight")]
+        embeds = [pipe.get_stage_layer(0)._items[0], pipe.get_stage_layer(3)._items[0]]
+        assert embeds[0] is embeds[1]
+        n_emb_params = sum(1 for k in names if "stage_0" in k or "stage_3" in k)
+        assert n_emb_params == 1  # deduped in named_parameters
+
+        pp = PipelineParallel(pipe, accumulate_steps=2, schedule="1F1B")
+        opt = SGD(learning_rate=0.05, parameters=pipe.parameters())
+        ids = np.random.randint(0, V, (4, 6)).astype("int32")
+        before = np.asarray(embeds[0].weight._array).copy()
+        loss = pp.train_batch([paddle.to_tensor(ids), paddle.to_tensor(ids.astype("int64"))], opt)
+        after = np.asarray(embeds[0].weight._array)
+        assert np.isfinite(float(loss))
+        assert not np.allclose(before, after)  # tied weight received grads
+
+
+class TestInterleaved:
+    def test_vpp_param_parity(self):
+        """Virtual pipeline stages (VPP): S=2 stages x V=2 chunks over 8
+        blocks; parity vs sequential training."""
+        paddle.seed(21)
+        pipe = PipelineLayer(_make_descs(), num_stages=2, loss_fn=_mse,
+                             num_virtual_pipeline_stages=2)
+        assert len(pipe._stages) == 4
+        snap = _snapshot(pipe)
+        ref = PipelineLayer(_make_descs(), num_stages=2, loss_fn=_mse,
+                            num_virtual_pipeline_stages=2)
+        _load(ref, snap)
+
+        pp = PipelineParallel(pipe, accumulate_steps=4, schedule="1F1B")
+        opt_p = SGD(learning_rate=0.1, parameters=pipe.parameters())
+        opt_r = SGD(learning_rate=0.1, parameters=ref.parameters())
+        rng = np.random.RandomState(1)
+        for _ in range(2):
+            x = rng.randn(8, 16).astype("float32")
+            lbl = rng.randn(8, 16).astype("float32")
+            loss_p = pp.train_batch([paddle.to_tensor(x), paddle.to_tensor(lbl)], opt_p)
+            out = ref(paddle.to_tensor(x))
+            loss_r = _mse(out, paddle.to_tensor(lbl))
+            loss_r.backward()
+            opt_r.step()
+            opt_r.clear_grad()
+            np.testing.assert_allclose(float(loss_p), float(loss_r), rtol=1e-5)
+
+
+class TestFleetIntegration:
+    def test_distributed_model_wraps_pipeline(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import fleet
+
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        pipe = PipelineLayer(_make_descs(n_blocks=4), num_stages=2, loss_fn=_mse)
+        model = fleet.distributed_model(pipe)
+        assert isinstance(model, PipelineParallel)
+        assert model._accumulate_steps == 2
+        opt = SGD(learning_rate=0.05, parameters=pipe.parameters())
+        x = np.random.randn(4, 16).astype("float32")
+        loss = model.train_batch([paddle.to_tensor(x), paddle.to_tensor(x)], opt)
+        assert np.isfinite(float(loss))
+
+
+class TestReviewRegressions:
+    def test_segment_by_params_monotonic(self):
+        """Boundaries must be strictly monotonic with no empty/duplicated
+        segments, even with one dominant prebuilt layer."""
+        big = nn.Linear(100, 100)
+        descs = [LayerDesc(nn.Linear, 4, 4), LayerDesc(nn.Linear, 4, 4),
+                 LayerDesc(nn.Linear, 4, 4), big]
+        parts = SegmentLayers(descs, 3, "parameter").do_segment()
+        assert parts[0] == 0 and parts[-1] == 4
+        assert all(parts[i] < parts[i + 1] for i in range(3))
+
+    def test_batchnorm_running_stats_update(self):
+        """BN running stats mutated inside a stage forward must survive the
+        functional stage boundary (threaded out as new_buffers)."""
+        paddle.seed(17)
+        descs = [LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.BatchNorm1D, 8),
+                 LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.BatchNorm1D, 8)]
+        pipe = PipelineLayer(descs, num_stages=2, loss_fn=_mse)
+        pipe.train()
+        pp = PipelineParallel(pipe, accumulate_steps=2, schedule="1F1B")
+        opt = SGD(learning_rate=0.01, parameters=pipe.parameters())
+        bn = pipe.get_stage_layer(0)._items[1]
+        before = np.asarray(bn._mean._array).copy()
+        x = np.random.randn(8, 8).astype("float32") * 3 + 1
+        pp.train_batch([paddle.to_tensor(x), paddle.to_tensor(x)], opt)
+        after = np.asarray(bn._mean._array)
+        assert not np.allclose(before, after)
+
+    def test_global_norm_clip_parity(self):
+        """ClipGradByGlobalNorm must clip against the ALL-parameter norm even
+        when stages live on different devices."""
+        from paddle_tpu.optimizer.clip import ClipGradByGlobalNorm
+
+        paddle.seed(23)
+        pipe = PipelineLayer(_make_descs(), num_stages=4, loss_fn=_mse)
+        snap = _snapshot(pipe)
+        ref = PipelineLayer(_make_descs(), num_stages=4, loss_fn=_mse)
+        _load(ref, snap)
+
+        pp = PipelineParallel(pipe, accumulate_steps=4)
+        clip_val = 0.05  # small enough that clipping definitely activates
+        opt_p = SGD(learning_rate=0.1, parameters=pipe.parameters(),
+                    grad_clip=ClipGradByGlobalNorm(clip_val))
+        opt_r = SGD(learning_rate=0.1, parameters=ref.parameters(),
+                    grad_clip=ClipGradByGlobalNorm(clip_val))
+        rng = np.random.RandomState(4)
+        for _ in range(2):
+            x = rng.randn(8, 16).astype("float32")
+            lbl = rng.randn(8, 16).astype("float32") * 5
+            pp.train_batch([paddle.to_tensor(x), paddle.to_tensor(lbl)], opt_p)
+            out = ref(paddle.to_tensor(x))
+            loss_r = _mse(out, paddle.to_tensor(lbl))
+            loss_r.backward()
+            opt_r.step()
+            opt_r.clear_grad()
+        for (k, p), (k2, p2) in zip(sorted(pipe.state_dict().items()),
+                                    sorted(ref.state_dict().items())):
+            np.testing.assert_allclose(np.asarray(p._array), np.asarray(p2._array),
+                                       rtol=3e-5, atol=3e-6)
