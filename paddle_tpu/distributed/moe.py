@@ -1,0 +1,490 @@
+"""Mixture-of-Experts with expert parallelism (EP).
+
+Reference parity (SURVEY.md §2.7 "EP"):
+- ``MoELayer``: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+- gates: python/paddle/incubate/distributed/models/moe/gate/
+  {naive_gate,gshard_gate,switch_gate}.py
+- count/capacity ops: python/paddle/incubate/distributed/models/moe/utils.py
+  (count_by_gate, limit_by_capacity, prune_gate_by_capacity)
+- global_scatter/global_gather: python/paddle/distributed/utils/moe_utils.py:20,153
+- SPMD rule: paddle/phi/infermeta/spmd_rules/moe_gate_dispatch.cc
+- fused grouped-GEMM path: paddle/phi/kernels/fusion/cutlass/fused_moe_kernel.cu
+
+TPU-native design (SURVEY.md §7 step 8). The reference routes tokens with a
+sort + variable-length NCCL alltoall (``global_scatter``). That shape-dynamic
+pattern defeats XLA, so dispatch here is the dense GShard formulation:
+a capacity-``C`` one-hot dispatch tensor ``[S, E, C]`` and combine tensor of
+the same shape, applied with einsums — static shapes, MXU-friendly grouped
+matmuls, and when the expert dim is sharded over mesh axes (``moe_group``)
+GSPMD materialises exactly the expert-parallel all_to_all the reference
+issues by hand. Experts are authored in the GLOBAL view (all ``E`` experts
+constructed once, sharded by annotation) rather than per-rank construction.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..nn.layer import Layer
+from ..nn.initializer_core import XavierUniform, Constant
+from ..tensor_class import wrap, unwrap
+from .collective import Group
+from .topology import get_hybrid_communicate_group
+
+
+# --------------------------------------------------------------------------
+# capacity / counting primitives (parity: moe/utils.py ops, as pure jnp fns)
+# --------------------------------------------------------------------------
+
+def expert_count(gate_idx, n_expert: int):
+    """Tokens assigned per expert. Parity: number_count op
+    (moe/utils.py count_by_gate)."""
+    gate_idx = unwrap(gate_idx)
+    return jnp.sum(jax.nn.one_hot(gate_idx.reshape(-1), n_expert, dtype=jnp.int32), axis=0)
+
+
+def limit_by_capacity(expert_counts, capacity: int):
+    """Clamp per-expert counts to capacity (moe/utils.py limit_by_capacity)."""
+    return jnp.minimum(unwrap(expert_counts), capacity)
+
+
+def prune_gate_by_capacity(gate_idx, n_expert: int, capacity: int):
+    """Replace over-capacity assignments with -1
+    (moe/utils.py prune_gate_by_capacity)."""
+    gate_idx = unwrap(gate_idx)
+    flat = gate_idx.reshape(-1)
+    onehot = jax.nn.one_hot(flat, n_expert, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) - 1  # position of each token within its expert
+    mypos = jnp.take_along_axis(pos, flat[:, None], axis=1)[:, 0]
+    pruned = jnp.where(mypos < capacity, flat, -1)
+    return pruned.reshape(gate_idx.shape)
+
+
+def compute_capacity(num_tokens: int, num_experts: int, top_k: int,
+                     capacity_factor: float) -> int:
+    cap = int(math.ceil(num_tokens * top_k * capacity_factor / num_experts))
+    return max(1, min(cap, num_tokens))
+
+
+def one_hot_dispatch(probs, topk_idx, capacity: int):
+    """Dense GShard dispatch from top-k routing.
+
+    probs: [S, E] softmax router probabilities.
+    topk_idx: [S, K] chosen experts per token (priority = batch order,
+      matching the reference's cumsum-position semantics in
+      prune_gate_by_capacity).
+    Returns (combine [S, E, C] float, dispatch [S, E, C] bool).
+    """
+    S, E = probs.shape
+    K = topk_idx.shape[1]
+    base = jnp.zeros((E,), jnp.int32)
+    combine = jnp.zeros((S, E, capacity), probs.dtype)
+    for i in range(K):
+        # one_hot of a -1 (dropped-route sentinel) row is all-zero
+        mask = jax.nn.one_hot(topk_idx[:, i], E, dtype=jnp.int32)       # [S, E]
+        pos = (jnp.cumsum(mask, axis=0) - 1) + base[None, :]            # [S, E]
+        base = base + jnp.sum(mask, axis=0)
+        keep = mask * (pos < capacity)                                  # [S, E]
+        pos_oh = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
+                                dtype=probs.dtype)                      # [S, E, C]
+        combine = combine + (keep.astype(probs.dtype) * probs)[:, :, None] * pos_oh
+    dispatch = combine > 0
+    return combine, dispatch
+
+
+def load_balance_loss(probs, topk_idx):
+    """Switch/GShard auxiliary loss: E * sum_e(mean_prob_e * frac_tokens_e),
+    using the top-1 assignment fraction. =1 at perfect balance."""
+    E = probs.shape[-1]
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(topk_idx[:, 0], E, dtype=probs.dtype), axis=0)
+    return E * jnp.sum(me * ce)
+
+
+# --------------------------------------------------------------------------
+# gates
+# --------------------------------------------------------------------------
+
+class BaseGate(Layer):
+    """Router base (gate/base_gate.py). ``num_expert`` is the per-rank count
+    in the reference; total experts = num_expert * world_size. Here experts
+    are global, so tot_expert is the routing width."""
+
+    def __init__(self, num_expert: int, world_size: int = 1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = num_expert * world_size
+        self.loss = None
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear: bool = True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+    def dispatch(self, x_flat):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class NaiveGate(BaseGate):
+    """Plain top-k softmax router, no capacity drop (gate/naive_gate.py).
+
+    Routing runs through :func:`~paddle_tpu.ops.registry.apply` as one pure
+    stage so the eager tape differentiates through the combine weights."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1, topk: int = 2,
+                 capacity_factor: Optional[float] = None):
+        super().__init__(num_expert, world_size)
+        self.d_model = d_model
+        self.top_k = topk
+        # Default None = the reference's strict no-drop semantics (C = S,
+        # which makes the [S, E, C] dispatch tensors quadratic in tokens —
+        # fine for small S). Pass a factor to bound them at O(S*K*factor*M)
+        # at the cost of drops under imbalance.
+        self.capacity_factor = capacity_factor
+        self.gate_weight = self.create_parameter(
+            [d_model, self.tot_expert], default_initializer=XavierUniform())
+        self.gate_bias = self.create_parameter(
+            [self.tot_expert], default_initializer=Constant(0.0), is_bias=True)
+
+    # -- pure routing stage (x, w, b, key are raw arrays) ------------------
+    def _route(self, x, w, b, key, training):
+        probs = jax.nn.softmax((x @ w + b).astype(jnp.float32), axis=-1)
+        _, topk_idx = jax.lax.top_k(probs, self.top_k)
+        if self.capacity_factor is None:
+            cap = x.shape[0]  # no drop
+        else:
+            cap = compute_capacity(x.shape[0], self.tot_expert, self.top_k,
+                                   self.capacity_factor)
+        combine, disp = one_hot_dispatch(probs, topk_idx, cap)
+        aux = jnp.zeros((), jnp.float32)
+        return (combine.astype(x.dtype),
+                jax.lax.stop_gradient(disp.astype(x.dtype)), aux)
+
+    def dispatch(self, x_flat):
+        """x_flat: Tensor [S, M] → (combine [S,E,C], dispatch_f [S,E,C])."""
+        from ..ops.registry import apply
+
+        key = self._routing_key()
+        combine, disp, aux = apply(
+            "moe_gate", self._route, x_flat, self.gate_weight, self.gate_bias,
+            key, training=self.training)
+        self.set_loss(aux)
+        return combine, disp
+
+    def _routing_key(self):
+        return None
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 router with capacity + training jitter (gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size: int = 1, topk: int = 1,
+                 switch_eps: float = 0.1, capacity: Sequence[float] = (1.2, 2.4)):
+        assert topk == 1, "switch gate is top-1"
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity = capacity  # (train_factor, eval_factor)
+
+    def _routing_key(self):
+        if self.training and self.switch_eps > 0:
+            from ..framework.random import next_key
+
+            return next_key()
+        return None
+
+    def _route(self, x, w, b, key, training):
+        logits = (x @ w + b).astype(jnp.float32)
+        if key is not None:
+            noise = jax.random.uniform(
+                key, logits.shape,
+                minval=1.0 - self.switch_eps, maxval=1.0 + self.switch_eps)
+            logits = logits + jnp.log(noise)
+        probs = jax.nn.softmax(logits, axis=-1)
+        topk_idx = jnp.argmax(probs, axis=-1)[:, None]
+        factor = self.capacity[0] if training else self.capacity[1]
+        cap = compute_capacity(x.shape[0], self.tot_expert, 1, factor)
+        combine, disp = one_hot_dispatch(probs, topk_idx, cap)
+        aux = load_balance_loss(probs, topk_idx)
+        return (combine.astype(x.dtype),
+                jax.lax.stop_gradient(disp.astype(x.dtype)), aux)
+
+
+class GShardGate(NaiveGate):
+    """Top-2 router with capacity + balance loss (gate/gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size: int = 1, topk: int = 2,
+                 capacity: Sequence[float] = (1.2, 2.4), random_routing: bool = True):
+        assert topk == 2, "gshard gate is top-2"
+        super().__init__(d_model, num_expert, world_size, topk=2)
+        self.capacity = capacity
+        self.random_routing = random_routing
+
+    def _routing_key(self):
+        if self.random_routing and self.training:
+            from ..framework.random import next_key
+
+            return next_key()
+        return None
+
+    def _route(self, x, w, b, key, training):
+        probs = jax.nn.softmax((x @ w + b).astype(jnp.float32), axis=-1)
+        topk_val, topk_idx = jax.lax.top_k(probs, 2)
+        if key is not None:
+            # keep 2nd expert with prob 2*gate2 (gshard_gate.py random routing);
+            # -1 is the drop sentinel: one_hot(-1) is all-zero, so the route
+            # simply vanishes (matches the reference's _random_routing)
+            r = jax.random.uniform(key, topk_val[:, 1].shape)
+            drop = r >= 2.0 * jax.lax.stop_gradient(topk_val[:, 1])
+            topk_idx = topk_idx.at[:, 1].set(
+                jnp.where(drop, -1, topk_idx[:, 1]))
+        factor = self.capacity[0] if training else self.capacity[1]
+        cap = compute_capacity(x.shape[0], self.tot_expert, 2, factor)
+        combine, disp = one_hot_dispatch(probs, topk_idx, cap)
+        aux = load_balance_loss(probs, topk_idx)
+        return (combine.astype(x.dtype),
+                jax.lax.stop_gradient(disp.astype(x.dtype)), aux)
+
+
+# --------------------------------------------------------------------------
+# experts
+# --------------------------------------------------------------------------
+
+def _grouped_ffn(xe, w1, b1, w2, b2, activation: str):
+    """[E, C, M] grouped two-layer FFN on raw arrays — shared by the Layer
+    forward and the tape-recorded apply() path."""
+    if activation == "gelu":  # exact erf gelu (paddle F.gelu default)
+        act = lambda v: jax.nn.gelu(v, approximate=False)
+    else:
+        act = getattr(jax.nn, activation)
+    h = act(jnp.einsum("ecm,emh->ech", xe, w1) + b1)
+    return jnp.einsum("ech,ehm->ecm", h, w2) + b2
+
+
+class GroupedMLP(Layer):
+    """All E experts' FFN weights stacked on a leading expert dim — the
+    grouped-GEMM formulation (parity: fused_moe cutlass grouped GEMM,
+    paddle/phi/kernels/fusion/cutlass/cutlass_kernels/moe_gemm/). One einsum
+    per projection keeps the MXU busy across experts and lets the expert dim
+    be sharded for EP."""
+
+    def __init__(self, num_experts: int, d_model: int, d_hidden: int,
+                 activation: str = "gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.d_model, self.d_hidden = d_model, d_hidden
+        self.activation = activation
+        self.w1 = self.create_parameter(
+            [num_experts, d_model, d_hidden], default_initializer=XavierUniform())
+        self.b1 = self.create_parameter(
+            [num_experts, 1, d_hidden], default_initializer=Constant(0.0), is_bias=True)
+        self.w2 = self.create_parameter(
+            [num_experts, d_hidden, d_model], default_initializer=XavierUniform())
+        self.b2 = self.create_parameter(
+            [num_experts, 1, d_model], default_initializer=Constant(0.0), is_bias=True)
+
+    def forward_expert_batch(self, xe):
+        """xe: [E, C, M] → [E, C, M]."""
+        return _grouped_ffn(xe, unwrap(self.w1), unwrap(self.b1),
+                            unwrap(self.w2), unwrap(self.b2), self.activation)
+
+    def forward(self, x):
+        return wrap(self.forward_expert_batch(unwrap(x)))
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts layer (moe_layer.py:263).
+
+    Args mirror the reference: ``experts`` is either a :class:`GroupedMLP`
+    (preferred — grouped GEMM + EP sharding) or a list of per-expert Layers
+    (looped; kept for API parity with arbitrary expert modules).
+    ``moe_group`` names the mesh axes the expert dim is sharded over (the
+    reference's NCCL moe group); default: the hybrid topology's data axes.
+    """
+
+    def __init__(self, d_model: int, experts, gate=None,
+                 moe_group: Optional[Group] = None, mp_group=None,
+                 recompute_interval: int = 0, top_k: int = 2):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(experts, GroupedMLP):
+            self.experts = experts
+            num_experts = experts.num_experts
+        else:
+            from ..nn.container import LayerList
+
+            if not isinstance(experts, Layer):
+                experts = LayerList(list(experts))  # materialize iterables once
+            self.experts = experts
+            num_experts = len(list(experts))
+        self.num_experts = num_experts
+        if gate is None:
+            gate = NaiveGate(d_model, num_experts, topk=top_k)
+        elif isinstance(gate, dict):
+            kind = gate.get("type", "naive")
+            cls = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}[kind]
+            kwargs = {k: v for k, v in gate.items() if k != "type"}
+            kwargs.setdefault("topk", 1 if kind == "switch" else 2)
+            gate = cls(d_model, num_experts, **kwargs)
+        self.gate = gate
+        self.recompute_interval = recompute_interval
+        self.activation_name = (experts.activation
+                                if isinstance(experts, GroupedMLP) else "gelu")
+        self._ep_axes = self._resolve_ep_axes(moe_group)
+        if self._ep_axes and isinstance(self.experts, GroupedMLP):
+            self._shard_experts()
+
+    # -- EP sharding -------------------------------------------------------
+    def _resolve_ep_axes(self, moe_group):
+        if isinstance(moe_group, Group):
+            return tuple(moe_group.axis_names)
+        if isinstance(moe_group, (tuple, list)):
+            return tuple(moe_group)
+        if moe_group is None:
+            hcg = get_hybrid_communicate_group()
+            if hcg is not None:
+                axes = tuple(a for a in ("dp", "sharding")
+                             if hcg.mesh.get_dim_size(a) > 1)
+                if axes and self.num_experts % np.prod(
+                        [hcg.mesh.get_dim_size(a) for a in axes]) == 0:
+                    return axes
+        return ()
+
+    def _shard_experts(self):
+        hcg = get_hybrid_communicate_group()
+        if hcg is None:
+            return
+        mesh = hcg.mesh
+        for name in ("w1", "b1", "w2", "b2"):
+            p = getattr(self.experts, name)
+            # the expert dim folds jointly over all EP axes (a multi-axis Shard)
+            spec = [None] * len(p.shape)
+            spec[0] = self._ep_axes if len(self._ep_axes) > 1 else self._ep_axes[0]
+            arr = jax.device_put(
+                unwrap(p), NamedSharding(mesh.jax_mesh(), PartitionSpec(*spec)))
+            p._array = arr
+
+    def _constrain(self, arr, expert_sharded: bool):
+        """Sharding constraint on the [E, C, M] dispatched block so GSPMD
+        inserts the EP all_to_all at the dispatch/combine boundary."""
+        if not self._ep_axes:
+            return arr
+        hcg = get_hybrid_communicate_group()
+        if hcg is None:
+            return arr
+        try:
+            if not jax.core.trace_state_clean():
+                spec = [None] * arr.ndim
+                if expert_sharded:
+                    spec[0] = (self._ep_axes if len(self._ep_axes) > 1
+                               else self._ep_axes[0])
+                return jax.lax.with_sharding_constraint(
+                    arr, NamedSharding(hcg.mesh.jax_mesh(), PartitionSpec(*spec)))
+        except Exception:  # pragma: no cover
+            pass
+        return arr
+
+    # -- forward -----------------------------------------------------------
+    def _dispatch_fn(self, x_flat, dispatch):
+        # [S,M] x [S,E,C] -> [E,C,M]  (the reference's MoEScatter+global_scatter)
+        xe = jnp.einsum("sm,sec->ecm", x_flat, dispatch.astype(x_flat.dtype))
+        return self._constrain(xe, expert_sharded=True)
+
+    def _expert_ffn_fn(self, xe, w1, b1, w2, b2):
+        ffn = lambda v: _grouped_ffn(v, w1, b1, w2, b2, self.activation_name)
+        if self.recompute_interval > 0:
+            ffn = jax.checkpoint(ffn)
+        return self._constrain(ffn(xe), expert_sharded=True)
+
+    def _combine_fn(self, ye, combine):
+        # [E,C,M] x [S,E,C] -> [S,M]  (MoEGather+global_gather)
+        return jnp.einsum("ecm,sec->sm", ye, combine.astype(ye.dtype))
+
+    def forward(self, x):
+        from ..ops.registry import apply
+
+        orig_shape = tuple(x.shape)
+        x_flat = apply("reshape", lambda a: a.reshape(-1, self.d_model), x)
+        combine, dispatch = self.gate.dispatch(x_flat)
+        xe = apply("moe_dispatch", self._dispatch_fn, x_flat, dispatch)
+        if isinstance(self.experts, GroupedMLP):
+            g = self.experts
+            ye = apply("moe_expert_ffn", self._expert_ffn_fn, xe,
+                       g.w1, g.b1, g.w2, g.b2)
+        else:
+            outs = [expert(xe[e]) for e, expert in enumerate(self.experts)]
+            ye = apply("stack", lambda *a: jnp.stack(a, axis=0), *outs)
+        y = apply("moe_combine", self._combine_fn, ye, combine)
+        return apply("reshape", lambda a: a.reshape(orig_shape), y)
+
+
+# --------------------------------------------------------------------------
+# eager global_scatter / global_gather (moe_utils.py:20,153)
+# --------------------------------------------------------------------------
+
+def _counts_to_np(c):
+    return np.asarray(unwrap(c)).astype(np.int64)
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Reference-semantics expert exchange (moe_utils.py:20) in the global
+    view. ``x``: [world, local_batch, M] stacked per-rank token buffers, each
+    rank's tokens ordered by destination index i = dest_rank * n_expert +
+    expert; ``local_count``: [world, world * n_expert]; ``global_count``:
+    [world, world * n_expert] (i = src_rank * n_expert + expert). Output:
+    [world, out_batch, M] where each rank's buffer is ordered expert-major
+    then source-rank (the layout the reference's recv loop produces),
+    zero-padded to the max recv count.
+
+    This is an EAGER data-movement utility for API parity/testing; the
+    jit/production path is MoELayer's dense dispatch (see module docstring).
+    """
+    xg = np.asarray(unwrap(x))
+    lc, gc = _counts_to_np(local_count), _counts_to_np(global_count)
+    world, _, M = xg.shape
+    n_expert = lc.shape[1] // world
+    # start offset of segment i in each source rank's buffer
+    starts = np.concatenate([np.zeros((world, 1), np.int64), np.cumsum(lc, axis=1)], axis=1)
+    out_batch = int(gc.sum(axis=1).max()) if gc.size else 0
+    out = np.zeros((world, out_batch, M), xg.dtype)
+    for dst in range(world):
+        off = 0
+        for e in range(n_expert):
+            for src in range(world):
+                cnt = int(lc[src, dst * n_expert + e])
+                s = int(starts[src, dst * n_expert + e])
+                out[dst, off:off + cnt] = xg[src, s:s + cnt]
+                off += cnt
+    return wrap(jnp.asarray(out))
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of :func:`global_scatter` (moe_utils.py:153): routes expert
+    outputs back to the token owners, restoring each rank's original
+    local-buffer order."""
+    xg = np.asarray(unwrap(x))
+    lc, gc = _counts_to_np(local_count), _counts_to_np(global_count)
+    world, _, M = xg.shape
+    n_expert = lc.shape[1] // world
+    starts = np.concatenate([np.zeros((world, 1), np.int64), np.cumsum(lc, axis=1)], axis=1)
+    out_batch = int(lc.sum(axis=1).max()) if lc.size else 0
+    out = np.zeros((world, out_batch, M), xg.dtype)
+    # walk the scattered layout in the same order global_scatter wrote it
+    for dst in range(world):
+        off = 0
+        for e in range(n_expert):
+            for src in range(world):
+                cnt = int(lc[src, dst * n_expert + e])
+                s = int(starts[src, dst * n_expert + e])
+                out[src, s:s + cnt] = xg[dst, off:off + cnt]
+                off += cnt
+    return wrap(jnp.asarray(out))

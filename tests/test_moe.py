@@ -1,0 +1,261 @@
+"""MoE/EP tests (reference: test/collective/ moe cases + moe op unit tests;
+SURVEY §2.7 EP row)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import moe
+
+
+pytestmark = pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 virtual devices")
+
+
+def test_one_hot_dispatch_capacity_semantics():
+    # 4 tokens, 2 experts, capacity 1: later tokens to a full expert drop
+    probs = jnp.asarray([[0.9, 0.1], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]], jnp.float32)
+    idx = jnp.argmax(probs, axis=-1)[:, None]  # [0,0,1,0]
+    combine, disp = moe.one_hot_dispatch(probs, idx, capacity=1)
+    assert combine.shape == (4, 2, 1)
+    np.testing.assert_allclose(combine[0, 0, 0], 0.9, rtol=1e-6)  # token0 → e0 slot0
+    np.testing.assert_allclose(combine[2, 1, 0], 0.7, rtol=1e-6)  # token2 → e1 slot0
+    assert float(combine[1].sum()) == 0.0  # token1 dropped (e0 full)
+    assert float(combine[3].sum()) == 0.0  # token3 dropped
+    assert bool(disp[0, 0, 0]) and not bool(disp[1].any())
+
+
+def test_expert_count_and_prune():
+    idx = paddle.to_tensor(np.array([0, 0, 1, 0, 2], np.int32))
+    counts = moe.expert_count(idx, 4)
+    np.testing.assert_array_equal(np.asarray(counts), [3, 1, 1, 0])
+    np.testing.assert_array_equal(
+        np.asarray(moe.limit_by_capacity(counts, 2)), [2, 1, 1, 0])
+    pruned = moe.prune_gate_by_capacity(idx, 4, capacity=2)
+    np.testing.assert_array_equal(np.asarray(pruned), [0, 0, 1, -1, 2])
+
+
+def _np_moe_reference(x, layer):
+    """Dense loop reference: top-k routing with capacity bookkeeping."""
+    gate = layer.gate
+    w = gate.gate_weight.numpy()
+    b = gate.gate_bias.numpy()
+    logits = x @ w + b
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    k = gate.top_k
+    topk = np.argsort(-probs, axis=-1, kind="stable")[:, :k]
+    S, E = probs.shape
+    mlp = layer.experts
+    w1, b1 = mlp.w1.numpy(), mlp.b1.numpy()
+    w2, b2 = mlp.w2.numpy(), mlp.b2.numpy()
+
+    import math
+
+    def expert(eid, v):
+        h = v @ w1[eid] + b1[eid][0]
+        h = 0.5 * h * (1 + np.vectorize(math.erf)(h / np.sqrt(2)))
+        return h @ w2[eid] + b2[eid][0]
+
+    counts = np.zeros(E, np.int64)
+    cap = S  # naive gate: no drop
+    out = np.zeros_like(x)
+    # column-by-column to match one_hot_dispatch's priority ordering
+    for i in range(k):
+        for s in range(S):
+            eid = topk[s, i]
+            if counts[eid] < cap:
+                out[s] += probs[s, eid] * expert(eid, x[s])
+                counts[eid] += 1
+    return out
+
+
+def test_moe_layer_naive_gate_parity():
+    paddle.seed(7)
+    d_model, E = 16, 4
+    layer = moe.MoELayer(
+        d_model, moe.GroupedMLP(E, d_model, 32, activation="gelu"),
+        gate=moe.NaiveGate(d_model, E, topk=2))
+    x = np.random.randn(10, d_model).astype(np.float32)
+    out = layer(paddle.to_tensor(x))
+    ref = _np_moe_reference(x, layer)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-3, atol=2e-4)
+
+
+def test_moe_layer_list_experts_matches_grouped():
+    """Per-expert Layer list path (reference API) agrees with GroupedMLP."""
+    import paddle_tpu.nn as nn
+
+    paddle.seed(3)
+    d_model, E = 8, 4
+    grouped = moe.GroupedMLP(E, d_model, 16)
+    layer_g = moe.MoELayer(d_model, grouped, gate=moe.NaiveGate(d_model, E, topk=2))
+
+    class Expert(nn.Layer):
+        def __init__(self, eid):
+            super().__init__()
+            self.fc1 = nn.Linear(d_model, 16)
+            self.fc2 = nn.Linear(16, d_model)
+            w1, b1 = grouped.w1.numpy()[eid], grouped.b1.numpy()[eid][0]
+            w2, b2 = grouped.w2.numpy()[eid], grouped.b2.numpy()[eid][0]
+            self.fc1.weight.set_value(w1)
+            self.fc1.bias.set_value(b1)
+            self.fc2.weight.set_value(w2)
+            self.fc2.bias.set_value(b2)
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+
+            return self.fc2(F.gelu(self.fc1(x)))
+
+    experts = [Expert(e) for e in range(E)]
+    layer_l = moe.MoELayer(d_model, experts, gate=layer_g.gate)
+
+    x = paddle.to_tensor(np.random.randn(6, d_model).astype(np.float32))
+    np.testing.assert_allclose(layer_g(x).numpy(), layer_l(x).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_switch_gate_drops_over_capacity():
+    paddle.seed(1)
+    d_model, E = 8, 2
+    gate = moe.SwitchGate(d_model, E, capacity=(0.5, 0.5))
+    layer = moe.MoELayer(d_model, moe.GroupedMLP(E, d_model, 16), gate=gate)
+    layer.eval()
+    x = paddle.to_tensor(np.random.randn(8, d_model).astype(np.float32))
+    out = layer(x)
+    # capacity = ceil(8*1*0.5/2) = 2 per expert → at most 4 tokens routed
+    routed = (np.abs(out.numpy()).sum(-1) > 1e-7).sum()
+    assert routed <= 4
+    assert gate.get_loss() is not None
+
+
+def test_moe_backward_flows_to_gate_and_experts():
+    paddle.seed(5)
+    d_model, E = 8, 4
+    layer = moe.MoELayer(d_model, moe.GroupedMLP(E, d_model, 16),
+                         gate=moe.GShardGate(d_model, E, random_routing=False))
+    layer.train()
+    x = paddle.to_tensor(np.random.randn(16, d_model).astype(np.float32))
+    x.stop_gradient = False
+    out = layer(x)
+    loss = (out * out).mean() + layer.gate.get_loss()
+    loss.backward()
+    assert layer.experts.w1.grad is not None
+    assert float(np.abs(layer.experts.w1.grad.numpy()).sum()) > 0
+    assert layer.gate.gate_weight.grad is not None
+    assert float(np.abs(layer.gate.gate_weight.grad.numpy()).sum()) > 0
+    assert x.grad is not None
+
+
+def test_gshard_random_routing_drops_not_doubles():
+    """Dropped 2nd routes vanish (-1 sentinel) rather than double-count e0."""
+    paddle.seed(9)
+    d_model, E = 8, 4
+    gate = moe.GShardGate(d_model, E, random_routing=True, capacity=(10.0, 10.0))
+    layer = moe.MoELayer(d_model, moe.GroupedMLP(E, d_model, 16), gate=gate)
+    layer.train()
+    x = np.random.randn(32, d_model).astype(np.float32)
+    out = layer(paddle.to_tensor(x))
+    assert np.isfinite(out.numpy()).all()
+    # per-token combine mass never exceeds p1+p2 (no double-counted expert):
+    w = gate.gate_weight.numpy()
+    b = gate.gate_bias.numpy()
+    logits = x @ w + b
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    top2 = np.sort(probs, axis=-1)[:, -2:].sum(-1)
+    combine, disp, _ = gate._route(
+        jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+        jax.random.PRNGKey(0), True)
+    mass = np.asarray(combine).sum(axis=(1, 2))
+    assert (mass <= top2 + 1e-5).all()
+
+
+def test_moe_ep_sharded_matches_unsharded():
+    """EP over the dp axis: same numbers as the unsharded run, expert dim
+    really sharded (loss-parity strategy, SURVEY §4)."""
+    paddle.seed(11)
+    d_model, E = 16, 8
+    ref_layer = moe.MoELayer(d_model, moe.GroupedMLP(E, d_model, 32),
+                             gate=moe.NaiveGate(d_model, E, topk=2))
+    x = np.random.randn(12, d_model).astype(np.float32)
+    ref = ref_layer(paddle.to_tensor(x)).numpy()
+
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(11)
+        layer = moe.MoELayer(d_model, moe.GroupedMLP(E, d_model, 32),
+                             gate=moe.NaiveGate(d_model, E, topk=2),
+                             moe_group=("dp",))
+        assert layer._ep_axes == ("dp",)
+        # expert dim sharded 8/4=2 per dp rank
+        assert {s.data.shape for s in layer.experts.w1._array.addressable_shards} \
+            == {(2, d_model, 32)}
+        out = layer(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    finally:
+        dist.set_hybrid_communicate_group(None)
+
+
+def test_moe_ep_under_jit_train_step():
+    """MoE inside a jitted loss/grad step with EP sharding compiles and runs."""
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(2)
+        d_model, E = 8, 8
+        layer = moe.MoELayer(d_model, moe.GroupedMLP(E, d_model, 16),
+                             gate=moe.SwitchGate(d_model, E, switch_eps=0.0),
+                             moe_group=("dp",))
+        layer.train()
+        state = layer.functional_state()
+        import jax as _jax
+
+        from paddle_tpu.tensor_class import wrap, unwrap
+
+        def loss_fn(state, xs):
+            layer.load_functional_state(state)
+            out = layer(wrap(xs))
+            return (unwrap(out) ** 2).mean()
+
+        xs = jnp.asarray(np.random.randn(8, d_model), jnp.float32)
+        val, grads = _jax.jit(_jax.value_and_grad(loss_fn))(state, xs)
+        assert np.isfinite(float(val))
+        leaves = _jax.tree_util.tree_leaves(grads)
+        assert any(float(jnp.abs(l).sum()) > 0 for l in leaves)
+    finally:
+        dist.set_hybrid_communicate_group(None)
+
+
+def test_global_scatter_gather_roundtrip():
+    world, n_expert, M = 2, 2, 4
+    rng = np.random.RandomState(0)
+    # rank0 sends [2,0,2,0]; rank1 sends [1,1,0,2] (i = dst*n_expert + e)
+    lc = np.array([[2, 0, 2, 0], [1, 1, 0, 2]], np.int64)
+    # global_count[dst, i] with i = src*n_expert + e: receives from each src
+    gc = np.zeros_like(lc)
+    for dst in range(world):
+        for src in range(world):
+            for e in range(n_expert):
+                gc[dst, src * n_expert + e] = lc[src, dst * n_expert + e]
+    batch = int(lc.sum(1).max())
+    x = np.zeros((world, batch, M), np.float32)
+    for r in range(world):
+        n = int(lc[r].sum())
+        x[r, :n] = rng.randn(n, M)
+    xs = moe.global_scatter(paddle.to_tensor(x), paddle.to_tensor(lc),
+                            paddle.to_tensor(gc))
+    # rank0 receives: e0 ← src0's 2 (seg i=0) + src1's 1 (seg i=0); e1 ← src1's 1
+    np.testing.assert_allclose(xs.numpy()[0, :2], x[0, :2])   # src0 → e0
+    np.testing.assert_allclose(xs.numpy()[0, 2:3], x[1, :1])  # src1 → e0
+    np.testing.assert_allclose(xs.numpy()[0, 3:4], x[1, 1:2])  # src1 → e1
+    back = moe.global_gather(xs, paddle.to_tensor(lc), paddle.to_tensor(gc))
+    for r in range(world):
+        n = int(lc[r].sum())
+        np.testing.assert_allclose(back.numpy()[r, :n], x[r, :n])
