@@ -42,12 +42,17 @@ from .recompute_layer import recompute, RecomputeLayer
 
 
 def __getattr__(name):
-    if name in ("pipeline", "moe", "ring_attention", "checkpoint", "launch", "sharding"):
+    if name in ("pipeline", "moe", "context_parallel", "checkpoint", "launch",
+                "sharding"):
         import importlib
 
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name in ("ring_attention", "ulysses_attention", "sep_attention"):
+        from . import context_parallel as _cp
+
+        return getattr(_cp, name)
     if name in ("PipelineLayer", "PipelineParallel", "LayerDesc", "SharedLayerDesc",
                 "SegmentLayers"):
         from . import pipeline as _pp

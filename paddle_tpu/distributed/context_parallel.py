@@ -1,0 +1,210 @@
+"""Context parallelism for long sequences: ring attention + Ulysses all-to-all.
+
+The reference has NO ring/context-parallel attention (SURVEY.md §2.7 "CP /
+ring attention — absent"); its long-context story is Megatron-SP boundaries
+(fleet/utils/sequence_parallel_utils.py) plus a bare ``sep`` topology axis
+whose all-to-all redistribution lives in user model code
+(python/paddle/distributed/fleet/base/topology.py:199). This module goes
+beyond the reference — per the build plan (SURVEY.md §7 step 9) — with two
+TPU-native mechanisms, both expressed as collectives inside ``shard_map``
+so XLA schedules the ICI transfers:
+
+- **Ring attention** (`ring_attention`): q/k/v are sharded along the
+  sequence axis; k/v blocks rotate around the ring via ``lax.ppermute``
+  while each device accumulates blockwise-streaming-softmax partial results
+  (the flash-attention recurrence, carried as (m, l, o)). Memory per device
+  is O(S_local); the full S×S score matrix never materialises.
+- **Ulysses attention** (`ulysses_attention`): ``lax.all_to_all`` swaps the
+  sharded axis from sequence to heads, runs ordinary (flash) attention on
+  full-length sequences for a head subset, and swaps back. Cheaper than a
+  ring for moderate S (two a2a's vs N-1 permutes) but caps the degree at
+  num_heads.
+
+Both are reverse-mode differentiable (the ring loop is a ``lax.scan``).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _neg_inf(dtype):
+    return jnp.asarray(jnp.finfo(dtype).min, dtype)
+
+
+def _block_step(q, k, v, m, l, o, mask, scale):
+    """One blockwise flash-attention accumulation step.
+
+    q: [B,H,Sq,D] local queries; k/v: [B,H,Sk,D] current ring block;
+    carry m (running max, [B,H,Sq]), l (running denom), o (unnormalised
+    accumulator [B,H,Sq,D]); mask: [Sq,Sk] bool (True = attend).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # rows still fully masked have m_new == -inf; exp(-inf - -inf) would be
+    # NaN, so guard both the rescale factor and the block probabilities.
+    dead = jnp.isneginf(m_new)
+    alpha = jnp.where(dead, 0.0, jnp.exp(m - m_new))
+    p = jnp.where(dead[..., None], 0.0, jnp.exp(s - m_new[..., None]))
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Ring attention over a named mesh axis. Call INSIDE shard_map.
+
+    q/k/v: [B, S_local, H, D] (paddle's BSHD layout), the local sequence
+    shard; the global sequence is the concatenation over ``axis_name`` in
+    axis-index order. Returns [B, S_local, H, D] in q.dtype.
+
+    Causal masking uses global positions, so device i's queries attend to
+    k/v blocks j<i fully, block j==i triangularly, and blocks j>i not at
+    all (those steps are skipped via ``lax.cond``). K/V rotate via
+    ``ppermute`` so step t processes block (i - t) mod N; each permute is a
+    neighbour hop that rides ICI.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+
+    if k.shape[2] != h:  # GQA: expand kv heads to q heads
+        rep = h // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    qt = jnp.swapaxes(q, 1, 2)                     # [B,H,Sq,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    q_pos = idx * s_q + jnp.arange(s_q)            # global query positions
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # derive the accumulators from qt (zeroed) so they carry the same
+    # varying-manual-axes type as the inputs — both lax.cond branches (and
+    # the scan carry) must agree on vma under shard_map's typing
+    o0 = qt.astype(jnp.float32) * 0.0
+    l0 = o0[..., 0]
+    m0 = l0 - jnp.inf
+
+    def step(carry, t):
+        kc, vc, m, l, o = carry
+        kv_idx = (idx - t) % n
+        k_pos = kv_idx * s_k + jnp.arange(s_k)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = jnp.ones((s_q, s_k), bool)
+        live = jnp.any(mask)
+
+        def compute(args):
+            m, l, o = args
+            return _block_step(qt, kc, vc, m, l, o, mask, scale)
+
+        m, l, o = lax.cond(live, compute, lambda args: args, (m, l, o))
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, m, l, o), None
+
+    (_, _, m, l, o), _ = lax.scan(step, (kt, vt, m0, l0, o0), jnp.arange(n))
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def _sdpa_core(q, k, v, causal, scale):
+    """Plain blockless attention on BSHD, fp32 softmax. Used by Ulysses."""
+    from ..nn.functional.attention import _sdpa_ref
+
+    if k.shape[2] != q.shape[2]:  # GQA: expand kv heads to q heads
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return _sdpa_ref(q, k, v, causal=causal, scale=scale)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      sm_scale: Optional[float] = None):
+    """DeepSpeed-Ulysses-style attention over a named axis. Call INSIDE
+    shard_map.
+
+    q/k/v: [B, S_local, H, D]. An ``all_to_all`` re-shards from sequence to
+    heads ([B, S, H/N, D]), full-sequence attention runs per head subset,
+    and a second ``all_to_all`` restores sequence sharding. Requires
+    H % axis_size == 0 (and kv_heads % axis_size == 0 for GQA).
+    """
+    n = lax.psum(1, axis_name)
+    h, h_kv = q.shape[2], k.shape[2]
+    if h % n:
+        raise ValueError(
+            f"ulysses needs num_heads divisible by sep degree: {h} vs {n}")
+    if h_kv % n:
+        # GQA with fewer kv heads than the degree: minimally replicate kv
+        # heads until they split evenly (h divides by n, so rep <= h/h_kv)
+        rep = n // math.gcd(h_kv, n)
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        h_kv *= rep
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg = seq_to_heads(q)                           # [B, S, H/N, D]
+    kg = seq_to_heads(k)
+    vg = seq_to_heads(v)
+    out = _sdpa_core(qg, kg, vg, causal, scale)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def sep_attention(query, key, value, causal: bool = False,
+                  sm_scale: Optional[float] = None, mode: str = "ring",
+                  group=None):
+    """High-level eager entry: context-parallel attention on the hybrid
+    topology's ``sep`` axis (parity surface for what reference users build
+    by hand on the sep group — topology.py:199 + alltoall in model code).
+
+    query/key/value: Tensors or arrays of GLOBAL shape [B, S, H, D]; the
+    call shard_maps them over the sep axis (sequence dim sharded) and
+    returns the global-shape result.
+    """
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from ..tensor_class import Tensor, unwrap, wrap
+    from .topology import get_hybrid_communicate_group
+
+    if mode not in ("ring", "ulysses"):
+        raise ValueError(f"sep_attention mode must be 'ring' or 'ulysses', got {mode!r}")
+    if group is None:
+        hcg = get_hybrid_communicate_group()
+        if hcg is None:
+            raise RuntimeError("sep_attention needs fleet.init or a group=")
+        group = hcg.get_sep_parallel_group()
+    mesh = group.mesh.jax_mesh()
+    axis = group.axis_names[0]
+    inner = ring_attention if mode == "ring" else ulysses_attention
+
+    spec = P(*([None, axis] + [None] * 2))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    def fn(q, k, v):
+        return inner(q, k, v, axis, causal=causal, sm_scale=sm_scale)
+
+    was_tensor = isinstance(query, Tensor)
+    out = fn(unwrap(query), unwrap(key), unwrap(value))
+    return wrap(out) if was_tensor else out

@@ -283,12 +283,16 @@ class GroupedMLP(Layer):
         self.num_experts = num_experts
         self.d_model, self.d_hidden = d_model, d_hidden
         self.activation = activation
+        # per-expert fans: the stacked [E, in, out] layout would otherwise be
+        # read as conv-style (E*out receptive) by Initializer._fan
         self.w1 = self.create_parameter(
-            [num_experts, d_model, d_hidden], default_initializer=XavierUniform())
+            [num_experts, d_model, d_hidden],
+            default_initializer=XavierUniform(fan_in=d_model, fan_out=d_hidden))
         self.b1 = self.create_parameter(
             [num_experts, 1, d_hidden], default_initializer=Constant(0.0), is_bias=True)
         self.w2 = self.create_parameter(
-            [num_experts, d_hidden, d_model], default_initializer=XavierUniform())
+            [num_experts, d_hidden, d_model],
+            default_initializer=XavierUniform(fan_in=d_hidden, fan_out=d_model))
         self.b2 = self.create_parameter(
             [num_experts, 1, d_model], default_initializer=Constant(0.0), is_bias=True)
 
@@ -345,10 +349,17 @@ class MoELayer(Layer):
 
     # -- EP sharding -------------------------------------------------------
     def _resolve_ep_axes(self, moe_group):
-        if isinstance(moe_group, Group):
-            return tuple(moe_group.axis_names)
-        if isinstance(moe_group, (tuple, list)):
-            return tuple(moe_group)
+        if isinstance(moe_group, (Group, tuple, list)):
+            axes = tuple(moe_group.axis_names if isinstance(moe_group, Group)
+                         else moe_group)
+            hcg = get_hybrid_communicate_group()
+            if hcg is not None and axes:
+                ep = int(np.prod([hcg.mesh.get_dim_size(a) for a in axes]))
+                if self.num_experts % ep != 0:
+                    raise ValueError(
+                        f"num_experts={self.num_experts} must be divisible by "
+                        f"EP degree {ep} (moe_group axes {axes})")
+            return axes
         if moe_group is None:
             hcg = get_hybrid_communicate_group()
             if hcg is not None:

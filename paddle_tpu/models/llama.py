@@ -45,9 +45,18 @@ class LlamaConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
+    # attention strategy when the hybrid topology has sep_degree > 1:
+    # "ring" (ppermute ring attention), "ulysses" (all-to-all head redistribution),
+    # or "allgather" (let GSPMD gather k/v — the reference's SP-only behaviour)
+    sep_mode: str = "ring"
     sequence_parallel: bool = False
     recompute: bool = False
     dtype: str = "bfloat16"
+
+    def __post_init__(self):
+        if self.sep_mode not in ("ring", "ulysses", "allgather"):
+            raise ValueError(
+                f"sep_mode must be 'ring', 'ulysses' or 'allgather', got {self.sep_mode!r}")
 
     @staticmethod
     def llama3_8b(**kw):
@@ -146,15 +155,44 @@ class LlamaAttention(Layer):
             if cache:
                 k = jnp.concatenate([cache[0], k], axis=1)
                 v = jnp.concatenate([cache[1], v], axis=1)
-            # GQA: expand kv heads to q heads
-            if hk != h:
-                rep = h // hk
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
-            if cfg.use_flash_attention and pf.supported(q, k, v):
-                out = pf.flash_attention_bshd(q, k, v, causal=True)
+            hcg = get_hybrid_communicate_group()
+            if (not cache and hcg is not None
+                    and hcg.get_sep_parallel_world_size() > 1
+                    and cfg.sep_mode in ("ring", "ulysses")):
+                # context parallelism: sequence stays sharded over sep; k/v
+                # blocks ride the ring (or heads ride an all-to-all) instead
+                # of GSPMD all-gathering the whole sequence per device.
+                # k/v enter UNexpanded: the CP kernels handle GQA internally,
+                # so the ring moves num_kv_heads worth of bytes, not num_heads.
+                import functools
+
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                from ..distributed.context_parallel import (
+                    ring_attention, ulysses_attention)
+
+                mesh = hcg.jax_mesh()
+                batch_ax = tuple(a for a in ("dp", "sharding")
+                                 if mesh.shape[a] > 1) or None
+                head_ax = "mp" if mesh.shape["mp"] > 1 else None
+                spec = P(batch_ax, "sep", head_ax, None)
+                inner = (ring_attention if cfg.sep_mode == "ring"
+                         else ulysses_attention)
+                cp = shard_map(
+                    functools.partial(inner, axis_name="sep", causal=True),
+                    mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                out = cp(q, k, v)
             else:
-                out = _sdpa_ref(q, k, v, causal=True)
+                ke, ve = k, v
+                if hk != h:  # GQA: expand kv heads to q heads
+                    rep = h // hk
+                    ke = jnp.repeat(k, rep, axis=2)
+                    ve = jnp.repeat(v, rep, axis=2)
+                if cfg.use_flash_attention and pf.supported(q, ke, ve):
+                    out = pf.flash_attention_bshd(q, ke, ve, causal=True)
+                else:
+                    out = _sdpa_ref(q, ke, ve, causal=True)
             return out.reshape(b, out.shape[1], h * d), k, v
 
         cache_args = [kv_cache[0], kv_cache[1]] if kv_cache is not None else []
