@@ -1,0 +1,14 @@
+"""Distributed checkpoint: sharded save/load with reshard-on-load.
+
+Reference parity: python/paddle/distributed/checkpoint/ (save_state_dict
+:145, load_state_dict :467, metadata.py:19-43).
+"""
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .save_state_dict import save_state_dict, wait_async_save
+from .load_state_dict import load_state_dict, get_checkpoint_metadata
+
+__all__ = [
+    "LocalTensorIndex", "LocalTensorMetadata", "Metadata",
+    "save_state_dict", "wait_async_save",
+    "load_state_dict", "get_checkpoint_metadata",
+]

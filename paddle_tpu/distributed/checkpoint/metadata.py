@@ -1,0 +1,40 @@
+"""Checkpoint metadata types.
+
+Reference parity: python/paddle/distributed/checkpoint/metadata.py:19-43
+(LocalTensorMetadata / LocalTensorIndex / Metadata). Same shapes so saved
+checkpoints carry the same information: where each local shard sits in its
+global tensor, and which storage file holds it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass
+class LocalTensorMetadata:
+    """The location of a local tensor in the global tensor."""
+
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    """The identifier of a local tensor (dedup key across replicas)."""
+
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    # tensor key -> every saved shard of that tensor
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(
+        default_factory=dict)
+    # shard identity -> storage file that holds its bytes
+    storage_metadata: Dict[LocalTensorIndex, str] = field(default_factory=dict)
+    # global shape per tensor key (ours; the reference derives it from shards)
+    global_shapes: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)

@@ -37,16 +37,28 @@ def _neg_inf(dtype):
     return jnp.asarray(jnp.finfo(dtype).min, dtype)
 
 
-def _block_step(q, k, v, m, l, o, mask, scale):
-    """One blockwise flash-attention accumulation step.
+def _expand_gqa(k, v, num_q_heads):
+    """Repeat kv heads up to ``num_q_heads`` (standard GQA grouping: q head
+    j reads kv head j // (H/H_kv))."""
+    rep = num_q_heads // k.shape[2]
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
 
-    q: [B,H,Sq,D] local queries; k/v: [B,H,Sk,D] current ring block;
-    carry m (running max, [B,H,Sq]), l (running denom), o (unnormalised
-    accumulator [B,H,Sq,D]); mask: [Sq,Sk] bool (True = attend).
+
+def _block_step(q, k, v, m, l, o, mask, scale):
+    """One blockwise flash-attention accumulation step, GQA-grouped.
+
+    q: [B,Hkv,G,Sq,D] local queries (G = num_q_heads / num_kv_heads);
+    k/v: [B,Hkv,Sk,D] current ring block — kv heads stay UNexpanded so the
+    ring carry (and every ppermute hop) moves only kv-head bytes; carry
+    m (running max, [B,Hkv,G,Sq]), l (running denom), o (unnormalised
+    accumulator, q-shaped); mask: [Sq,Sk] bool (True = attend).
     """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", q, k,
                    preferred_element_type=jnp.float32) * scale
-    s = jnp.where(mask[None, None], s, -jnp.inf)
+    s = jnp.where(mask[None, None, None], s, -jnp.inf)
     m_new = jnp.maximum(m, s.max(axis=-1))
     # rows still fully masked have m_new == -inf; exp(-inf - -inf) would be
     # NaN, so guard both the rescale factor and the block probabilities.
@@ -55,7 +67,7 @@ def _block_step(q, k, v, m, l, o, mask, scale):
     p = jnp.where(dead[..., None], 0.0, jnp.exp(s - m_new[..., None]))
     l_new = l * alpha + p.sum(axis=-1)
     o_new = o * alpha[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        "bhgqk,bhkd->bhgqd", p, v.astype(jnp.float32),
         preferred_element_type=jnp.float32)
     return m_new, l_new, o_new
 
@@ -77,15 +89,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, s_q, h, d = q.shape
-    s_k = k.shape[1]
+    s_k, h_kv = k.shape[1], k.shape[2]
+    g = h // h_kv  # GQA group size; kv stays unexpanded through the ring
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
 
-    if k.shape[2] != h:  # GQA: expand kv heads to q heads
-        rep = h // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-
-    qt = jnp.swapaxes(q, 1, 2)                     # [B,H,Sq,D]
+    # q: [B,Hkv,G,Sq,D] grouped by kv head; k/v: [B,Hkv,Sk,D]
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, h_kv, g, s_q, d)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
 
@@ -120,6 +129,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
     (_, _, m, l, o), _ = lax.scan(step, (kt, vt, m0, l0, o0), jnp.arange(n))
     out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    out = out.reshape(b, h, s_q, d)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
@@ -127,10 +137,7 @@ def _sdpa_core(q, k, v, causal, scale):
     """Plain blockless attention on BSHD, fp32 softmax. Used by Ulysses."""
     from ..nn.functional.attention import _sdpa_ref
 
-    if k.shape[2] != q.shape[2]:  # GQA: expand kv heads to q heads
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    k, v = _expand_gqa(k, v, q.shape[2])
     return _sdpa_ref(q, k, v, causal=causal, scale=scale)
 
 

@@ -1,0 +1,14 @@
+"""Process launcher: ``python -m paddle_tpu.distributed.launch``.
+
+Reference parity: python/paddle/distributed/launch/ (main.py + the
+collective controller, launch/controllers/collective.py): build per-rank
+env (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER), spawn local
+worker processes, aggregate per-rank logs, propagate failures.
+
+TPU-native: on TPU pods there is ONE process per host and JAX's runtime
+owns the chips, so ``--nproc_per_node`` defaults to 1 (the reference
+defaults to #GPUs); multi-host jobs point every host at the same
+``--master`` and give each its ``--rank``. The spawned env also carries the
+JAX coordination variables consumed by env.init_parallel_env.
+"""
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,119 @@
+"""Launcher implementation.
+
+Reference parity: python/paddle/distributed/launch/main.py (arg surface)
+and launch/controllers/collective.py (per-rank env construction, process
+watch loop, log files under --log_dir, first-failure abort).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch distributed training processes")
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (every node passes the same)")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of nodes (hosts) in the job")
+    p.add_argument("--rank", type=int, default=0,
+                   help="this node's index in [0, nnodes)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes on this node (TPU: 1 per host)")
+    p.add_argument("--log_dir", default="log",
+                   help="directory for per-rank worker logs")
+    p.add_argument("--job_id", default="default",
+                   help="job name prefix for log files")
+    p.add_argument("--devices", default=None,
+                   help="visible device ids for this node (comma-separated)")
+    p.add_argument("training_script",
+                   help="script to run (or module with -m inside the script)")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _rank_env(args, local_rank: int) -> dict:
+    world = args.nnodes * args.nproc_per_node
+    rank = args.rank * args.nproc_per_node + local_rank
+    env = dict(os.environ)
+    master = args.master or "127.0.0.1:8778"
+    env.update({
+        "PADDLE_MASTER": master,
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_NNODES": str(args.nnodes),
+        # JAX coordination mirror (env.init_parallel_env reads either)
+        "JAX_COORDINATOR_ADDRESS": master,
+        "JAX_NUM_PROCESSES": str(world),
+        "JAX_PROCESS_ID": str(rank),
+    })
+    if args.devices is not None:
+        env["TPU_VISIBLE_DEVICES"] = args.devices
+        env["CUDA_VISIBLE_DEVICES"] = args.devices
+    return env
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    """Spawn workers, stream logs to --log_dir, return first failure code."""
+    args = _build_parser().parse_args(argv)
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    procs: List[subprocess.Popen] = []
+    logs = []
+    for local_rank in range(args.nproc_per_node):
+        rank = args.rank * args.nproc_per_node + local_rank
+        log_path = os.path.join(
+            args.log_dir, f"{args.job_id}.workerlog.{rank}")
+        logf = open(log_path, "w")
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        procs.append(subprocess.Popen(
+            cmd, env=_rank_env(args, local_rank),
+            stdout=logf, stderr=subprocess.STDOUT))
+        logs.append(log_path)
+        print(f"launch: rank {rank} pid {procs[-1].pid} log {log_path}",
+              flush=True)
+
+    # watch loop: first non-zero exit kills the rest (collective.py watch)
+    exit_code = 0
+    try:
+        while procs:
+            for p in list(procs):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                procs.remove(p)
+                if ret != 0 and exit_code == 0:
+                    exit_code = ret
+                    for q in procs:
+                        q.send_signal(signal.SIGTERM)
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for q in procs:
+            q.send_signal(signal.SIGTERM)
+        exit_code = 130
+    finally:
+        for q in procs:
+            q.wait()
+    if exit_code != 0:
+        for lp in logs:
+            tail = open(lp).read().splitlines()[-20:]
+            print(f"---- {lp} (tail) ----", flush=True)
+            print("\n".join(tail), flush=True)
+    return exit_code
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -350,11 +350,14 @@ class MoELayer(Layer):
     # -- EP sharding -------------------------------------------------------
     def _resolve_ep_axes(self, moe_group):
         if isinstance(moe_group, (Group, tuple, list)):
-            axes = tuple(moe_group.axis_names if isinstance(moe_group, Group)
-                         else moe_group)
-            hcg = get_hybrid_communicate_group()
-            if hcg is not None and axes:
-                ep = int(np.prod([hcg.mesh.get_dim_size(a) for a in axes]))
+            if isinstance(moe_group, Group):
+                axes, mesh = tuple(moe_group.axis_names), moe_group.mesh
+            else:
+                axes = tuple(moe_group)
+                hcg = get_hybrid_communicate_group()
+                mesh = hcg.mesh if hcg is not None else None
+            if mesh is not None and axes:
+                ep = int(np.prod([mesh.get_dim_size(a) for a in axes]))
                 if self.num_experts % ep != 0:
                     raise ValueError(
                         f"num_experts={self.num_experts} must be divisible by "

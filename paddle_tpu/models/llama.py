@@ -184,11 +184,9 @@ class LlamaAttention(Layer):
                     mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
                 out = cp(q, k, v)
             else:
-                ke, ve = k, v
-                if hk != h:  # GQA: expand kv heads to q heads
-                    rep = h // hk
-                    ke = jnp.repeat(k, rep, axis=2)
-                    ve = jnp.repeat(v, rep, axis=2)
+                from ..distributed.context_parallel import _expand_gqa
+
+                ke, ve = _expand_gqa(k, v, h)
                 if cfg.use_flash_attention and pf.supported(q, ke, ve):
                     out = pf.flash_attention_bshd(q, ke, ve, causal=True)
                 else:

@@ -1,0 +1,56 @@
+"""Launcher: per-rank env construction + process supervision.
+
+Parity: launch/controllers/collective.py env contract
+(PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/PADDLE_MASTER) and first-failure
+abort.
+"""
+import os
+import subprocess
+import sys
+
+SCRIPT_OK = """
+import os, sys
+print("rank", os.environ["PADDLE_TRAINER_ID"], "of", os.environ["PADDLE_TRAINERS_NUM"],
+      "master", os.environ["PADDLE_MASTER"], "jaxid", os.environ["JAX_PROCESS_ID"])
+"""
+
+SCRIPT_FAIL = """
+import os, sys, time
+if os.environ["PADDLE_TRAINER_ID"] == "1":
+    sys.exit(3)
+time.sleep(30)
+"""
+
+
+def _run(tmp_path, script, nproc, extra=()):
+    sc = tmp_path / "worker.py"
+    sc.write_text(script)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", str(nproc), "--log_dir", str(tmp_path / "log"),
+         *extra, str(sc)],
+        capture_output=True, text=True, env=env, cwd="/root/repo", timeout=120)
+
+
+def test_launch_sets_rank_env(tmp_path):
+    r = _run(tmp_path, SCRIPT_OK, 2)
+    assert r.returncode == 0, r.stdout + r.stderr
+    logs = sorted((tmp_path / "log").iterdir())
+    assert len(logs) == 2
+    text = "".join(p.read_text() for p in logs)
+    assert "rank 0 of 2" in text and "rank 1 of 2" in text
+    assert "jaxid" in text
+
+
+def test_launch_aborts_all_on_failure(tmp_path):
+    r = _run(tmp_path, SCRIPT_FAIL, 2)
+    assert r.returncode == 3
+    assert "workerlog" in r.stdout  # failure tail printed
+
+
+def test_launch_node_rank_offset(tmp_path):
+    r = _run(tmp_path, SCRIPT_OK, 2, extra=("--nnodes", "2", "--rank", "1"))
+    assert r.returncode == 0
+    text = "".join(p.read_text() for p in sorted((tmp_path / "log").iterdir()))
+    assert "rank 2 of 4" in text and "rank 3 of 4" in text
