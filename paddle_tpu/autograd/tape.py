@@ -188,6 +188,9 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False, grads_out=N
                 in_grads = vjp_fn(filled)
             else:
                 in_grads = vjp_fn(_match_cotangent(gs[0], primals_out))
+        from ..ops.registry import _check_nan_inf
+
+        _check_nan_inf(f"{node.name}_grad", list(in_grads))
         for t, g in zip(node.in_tensors, in_grads):
             if t is None or g is None or t.stop_gradient:
                 continue

@@ -20,7 +20,7 @@ from typing import Dict, Optional
 import jax
 import numpy as np
 
-from .metadata import Metadata
+from .metadata import LocalTensorIndex, Metadata
 
 
 def _load_all_metadata(path: str) -> Metadata:
@@ -40,17 +40,21 @@ def _load_all_metadata(path: str) -> Metadata:
 
 
 class _ShardReader:
-    """Lazily opens .distcp files and serves global-slice reads."""
+    """Serves global-slice reads from per-shard .npy files. Files are
+    memory-mapped, so only the pages a slice actually touches are read —
+    peak host memory stays bounded by the target shards, not the full
+    checkpoint."""
 
     def __init__(self, path: str, metadata: Metadata):
         self.path = path
         self.metadata = metadata
-        self._files: Dict[str, Dict] = {}
+        self._files: Dict[str, np.ndarray] = {}
 
-    def _file(self, name):
+    def _shard(self, key, offset):
+        name = self.metadata.storage_metadata[LocalTensorIndex(key, offset)]
         if name not in self._files:
-            with open(os.path.join(self.path, name), "rb") as f:
-                self._files[name] = pickle.load(f)
+            self._files[name] = np.load(os.path.join(self.path, name),
+                                        mmap_mode="r")
         return self._files[name]
 
     def read_slice(self, key: str, index, global_shape, dtype) -> np.ndarray:
@@ -68,10 +72,7 @@ class _ShardReader:
             hi = [min(b, o + s) for b, o, s in zip(stops, off, shp)]
             if any(l >= h for l, h in zip(lo, hi)):
                 continue
-            from .metadata import LocalTensorIndex
-
-            fname = self.metadata.storage_metadata[LocalTensorIndex(key, off)]
-            src = self._file(fname)[(key, off)]
+            src = self._shard(key, off)
             src_sl = tuple(slice(l - o, h - o) for l, h, o in zip(lo, hi, off))
             dst_sl = tuple(slice(l - a, h - a) for l, h, a in zip(lo, hi, starts))
             out[dst_sl] = src[src_sl]

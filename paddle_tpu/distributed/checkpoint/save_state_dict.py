@@ -3,19 +3,25 @@
 Reference parity: python/paddle/distributed/checkpoint/save_state_dict.py:145
 (save_state_dict): each rank writes only the shards it owns, replicas are
 deduplicated (exactly one copy of every (tensor, global_offset) shard lands
-on disk), and a coordinator writes a global Metadata describing every shard.
+on disk), and every process writes a metadata piece describing its shards
+(load unions all pieces — a host-side gather-to-coordinator is thereby
+avoided; the reference's coordinator_rank gather exists for its file
+format, not for correctness).
 
 TPU-native differences: shard ownership comes from ``jax.Array``'s
 addressable-shard table (``shard.replica_id == 0`` marks the canonical
 replica — the role the reference's rank-dedup pass plays), and one process
-may own many devices' shards, so files are per *process*, not per rank.
-Layout under ``path``:
+may own many devices' shards. Layout under ``path``:
 
-    {process_index}_0.distcp   pickle: {(key, global_offset): np.ndarray}
-    0.metadata                 pickle: Metadata (written by coordinator)
+    {process_index}_{seq}.npy   one file per owned shard (mmap-readable)
+    {process_index}.metadata    pickle: Metadata for this process's shards
+
+Writes go to ``*.tmp`` then rename, so a crash mid-save never leaves a
+truncated file that load would trip over.
 """
 from __future__ import annotations
 
+import atexit
 import os
 import pickle
 import threading
@@ -72,17 +78,16 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0, async_save: bool = False) -> None:
     """Save a (possibly sharded) state_dict under ``path``.
 
-    Every process writes its own ``{process_index}_0.distcp`` with exactly
-    the shards it canonically owns; the coordinator process additionally
-    writes ``0.metadata``. Values may be Tensors (sharded or not), jax
-    Arrays, numpy arrays, or scalars.
+    Every process writes one ``.npy`` per shard it canonically owns plus a
+    ``{process_index}.metadata`` piece. Values may be Tensors (sharded or
+    not), jax Arrays, numpy arrays, or scalars.
     """
     os.makedirs(path, exist_ok=True)
     pidx = jax.process_index()
-    fname = f"{pidx}_0.distcp"
 
-    local: Dict = {}
+    to_write = []  # (filename, np.ndarray)
     metadata = Metadata()
+    seq = 0
     for key, value in state_dict.items():
         arr = _as_array(value)
         if not isinstance(arr, (jax.Array, np.ndarray)):
@@ -90,23 +95,26 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
         metadata.global_shapes[key] = tuple(np.shape(arr))
         shard_metas = []
         for idx, meta, data in _gather_local_shards(key, arr):
-            local[(idx.tensor_key, idx.global_offset)] = data
+            fname = f"{pidx}_{seq}.npy"
+            seq += 1
+            to_write.append((fname, data))
             shard_metas.append(meta)
             metadata.storage_metadata[idx] = fname
         metadata.state_dict_metadata[key] = shard_metas
 
     def _write():
-        with open(os.path.join(path, fname), "wb") as f:
-            pickle.dump(local, f)
-        # single-process SPMD: this process IS the coordinator. Multi-host
-        # metadata merge happens on load (all *.metadata files are unioned),
-        # so each process writing its own piece is sufficient and avoids a
-        # host-side gather.
-        with open(os.path.join(path, f"{pidx}.metadata"), "wb") as f:
+        for fname, data in to_write:
+            # tmp name keeps the .npy suffix (np.save would append one)
+            tmp = os.path.join(path, fname + ".tmp.npy")
+            np.save(tmp, data, allow_pickle=False)
+            os.replace(tmp, os.path.join(path, fname))
+        meta_tmp = os.path.join(path, f"{pidx}.metadata.tmp")
+        with open(meta_tmp, "wb") as f:
             pickle.dump(metadata, f)
+        os.replace(meta_tmp, os.path.join(path, f"{pidx}.metadata"))
 
     if async_save:
-        t = threading.Thread(target=_write, daemon=True)
+        t = threading.Thread(target=_write, daemon=False)
         t.start()
         _ASYNC_WRITERS.append(t)
     else:
@@ -118,6 +126,10 @@ _ASYNC_WRITERS: list = []
 
 def wait_async_save():
     """Block until pending async saves complete (reference: the async_save
-    executor join inside save_state_dict.py)."""
+    executor join inside save_state_dict.py). Also registered atexit, so a
+    returning script cannot truncate its final checkpoint."""
     while _ASYNC_WRITERS:
         _ASYNC_WRITERS.pop().join()
+
+
+atexit.register(wait_async_save)

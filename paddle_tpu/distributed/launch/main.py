@@ -43,6 +43,10 @@ def _rank_env(args, local_rank: int) -> dict:
     world = args.nnodes * args.nproc_per_node
     rank = args.rank * args.nproc_per_node + local_rank
     env = dict(os.environ)
+    if args.master is None and args.nnodes > 1:
+        raise SystemExit(
+            "--master host:port is required when --nnodes > 1 (all nodes "
+            "must rendezvous at the same coordinator)")
     master = args.master or "127.0.0.1:8778"
     env.update({
         "PADDLE_MASTER": master,
@@ -68,11 +72,13 @@ def launch(argv: Optional[List[str]] = None) -> int:
 
     procs: List[subprocess.Popen] = []
     logs = []
+    log_files = []
     for local_rank in range(args.nproc_per_node):
         rank = args.rank * args.nproc_per_node + local_rank
         log_path = os.path.join(
             args.log_dir, f"{args.job_id}.workerlog.{rank}")
         logf = open(log_path, "w")
+        log_files.append(logf)
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
         procs.append(subprocess.Popen(
@@ -101,8 +107,17 @@ def launch(argv: Optional[List[str]] = None) -> int:
             q.send_signal(signal.SIGTERM)
         exit_code = 130
     finally:
+        # grace period, then SIGKILL stragglers (collective.py's watch loop
+        # escalation) so a SIGTERM-ignoring worker cannot hang the launcher
+        deadline = time.time() + 15.0
         for q in procs:
-            q.wait()
+            try:
+                q.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                q.kill()
+                q.wait()
+        for f in log_files:
+            f.close()
     if exit_code != 0:
         for lp in logs:
             tail = open(lp).read().splitlines()[-20:]

@@ -37,7 +37,13 @@ def group_sharded_parallel(model, optimizer, level: str, scaler=None,
             "use sharding degree or recompute to reduce memory")
     hcg = get_hybrid_communicate_group()
     if group is not None:
-        axis = tuple(group.axis_names)[0]
+        axes = tuple(group.axis_names)
+        if len(axes) != 1:
+            raise NotImplementedError(
+                f"group_sharded_parallel needs a single-axis group, got "
+                f"axes {axes}; shard over one axis or configure fused "
+                f"degrees via DistributedStrategy.hybrid_configs")
+        axis = axes[0]
         mesh = group.mesh
     elif hcg is not None:
         if hcg.get_sharding_parallel_world_size() > 1:

@@ -96,3 +96,39 @@ CUDAPlace = TPUPlace  # API-compat alias: "the accelerator place"
 def synchronize():
     """Block until all dispatched device work completes."""
     (jax.device_put(0) + 0).block_until_ready()
+
+
+# ---- memory stats (paddle/phi/core/memory/stats.cc parity: live + peak
+# trackers exposed as paddle.device.cuda.max_memory_allocated etc.; on TPU
+# the numbers come from the runtime's per-device memory_stats()) -------------
+
+def _mem_stats(device=None):
+    dev = _resolve(device)  # None → the device selected via set_device
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return stats or {}
+
+
+def memory_allocated(device=None) -> int:
+    """Live bytes in use on the device (stats.cc Allocated stat)."""
+    return int(_mem_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak bytes in use (stats.cc peak tracker)."""
+    s = _mem_stats(device)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device=None) -> int:
+    """Pool reservation (bytes_limit on TPU: HBM the runtime owns)."""
+    s = _mem_stats(device)
+    return int(s.get("bytes_reservable_limit", s.get("bytes_limit", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    return memory_reserved(device)
+
+
+def empty_cache():
+    """paddle.device.cuda.empty_cache parity: no-op on TPU (XLA owns HBM;
+    nothing user-facing to release)."""

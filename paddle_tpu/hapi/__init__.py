@@ -1,0 +1,6 @@
+"""paddle.hapi parity (python/paddle/hapi/): Model, callbacks, summary."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger)
+from .model import Model  # noqa: F401
+from .summary import flops, summary  # noqa: F401

@@ -1,0 +1,234 @@
+"""hapi callbacks.
+
+Reference parity: python/paddle/hapi/callbacks.py (Callback, ProgBarLogger,
+ModelCheckpoint, EarlyStopping, LRScheduler, VisualDL/WandbCallback as
+logging sinks).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        ...
+
+    def on_train_end(self, logs=None):
+        ...
+
+    def on_eval_begin(self, logs=None):
+        ...
+
+    def on_eval_end(self, logs=None):
+        ...
+
+    def on_predict_begin(self, logs=None):
+        ...
+
+    def on_predict_end(self, logs=None):
+        ...
+
+    def on_epoch_begin(self, epoch, logs=None):
+        ...
+
+    def on_epoch_end(self, epoch, logs=None):
+        ...
+
+    def on_train_batch_begin(self, step, logs=None):
+        ...
+
+    def on_train_batch_end(self, step, logs=None):
+        ...
+
+    def on_eval_batch_begin(self, step, logs=None):
+        ...
+
+    def on_eval_batch_end(self, step, logs=None):
+        ...
+
+    def on_predict_batch_begin(self, step, logs=None):
+        ...
+
+    def on_predict_batch_end(self, step, logs=None):
+        ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            def dispatch(*args, **kwargs):
+                for cb in self.callbacks:
+                    getattr(cb, name)(*args, **kwargs)
+
+            return dispatch
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """callbacks.py ProgBarLogger parity (line-per-epoch console logging)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = self.params.get("steps")
+        self._start = time.time()
+        if self.verbose and self.params.get("verbose", 1):
+            print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}")
+
+    def _fmt(self, logs):
+        items = []
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)):
+                items.append(f"{k}: {', '.join(f'{x:.4f}' for x in v)}")
+            elif isinstance(v, float):
+                items.append(f"{k}: {v:.4f}")
+            else:
+                items.append(f"{k}: {v}")
+        return " - ".join(items)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose > 1 and step % self.log_freq == 0:
+            print(f"step {step + 1}/{self.steps or '?'} - {self._fmt(logs)}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._start
+            print(f"Epoch {epoch + 1} done in {dt:.1f}s - {self._fmt(logs)}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            print(f"Eval - {self._fmt(logs)}")
+
+
+class ModelCheckpoint(Callback):
+    """callbacks.py ModelCheckpoint parity: save every save_freq epochs."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and self.model and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir and self.model:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """callbacks.py EarlyStopping parity."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "min" if "loss" in monitor or "err" in monitor else "max"
+        self.mode = mode
+        self.stopped_epoch = 0
+        self.wait = 0
+        self.best = None
+        self.stop_training = False
+
+    def _better(self, cur, ref):
+        if self.mode == "min":
+            return cur < ref - self.min_delta
+        return cur > ref + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        if self.monitor not in logs:
+            return
+        cur = logs[self.monitor]
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self.best is None or self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and self.model and \
+                    self.params.get("save_dir"):
+                self.model.save(os.path.join(self.params["save_dir"],
+                                             "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+                if self.model is not None:
+                    self.model.stop_training = True
+
+
+class LRScheduler(Callback):
+    """callbacks.py LRScheduler parity: steps the optimizer's LR scheduler."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        assert by_step != by_epoch
+        self.by_step = by_step
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if not self.by_step and s is not None:
+            s.step()
+
+
+def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
+                     verbose=1, save_freq=1, save_dir=None, metrics=None,
+                     mode="train"):
+    cbs = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbs) and verbose:
+        cbs = [ProgBarLogger(verbose=verbose)] + cbs
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbs):
+        cbs.append(ModelCheckpoint(save_freq, save_dir))
+    lst = CallbackList(cbs)
+    lst.set_model(model)
+    lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
+                    "metrics": metrics or [], "save_dir": save_dir})
+    return lst

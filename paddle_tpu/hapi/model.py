@@ -1,0 +1,242 @@
+"""Keras-style Model.
+
+Reference parity: python/paddle/hapi/model.py (Model :~900, fit :1472,
+evaluate :2200, predict, train_batch/eval_batch/predict_batch, save/load,
+prepare). The TPU build's Model drives the eager layer system; the step
+itself stays jittable through the layer forward (users wanting a compiled
+step use paddle.jit.to_static or distributed.engine.parallelize).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..metric import Metric
+from ..tensor_class import Tensor
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_tensor(x):
+    import paddle_tpu as paddle
+
+    if isinstance(x, Tensor):
+        return x
+    return paddle.to_tensor(np.asarray(x))
+
+
+class Model:
+    """paddle.Model(network, inputs=None, labels=None)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        for m in _to_list(metrics):
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle.metric.Metric")
+        self._metrics = _to_list(metrics)
+
+    # -- single-batch entries ------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        lbls = _to_list(labels)
+        if self._loss is None:
+            raise RuntimeError("Model.prepare(loss=...) was not called")
+        return self._loss(*outs, *lbls)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        ins = [_to_tensor(i) for i in _to_list(inputs)]
+        lbl = [_to_tensor(l) for l in _to_list(labels)]
+        outputs = self.network(*ins)
+        loss = self._compute_loss(outputs, lbl)
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(*m.compute(*_to_list(outputs), *lbl))
+            metrics.append(m.accumulate())
+        out = [float(loss.numpy())]
+        return (out, metrics) if metrics else out
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = [_to_tensor(i) for i in _to_list(inputs)]
+        lbl = [_to_tensor(l) for l in _to_list(labels)]
+        outputs = self.network(*ins)
+        loss = self._compute_loss(outputs, lbl)
+        metrics = []
+        for m in self._metrics:
+            m.update(*m.compute(*_to_list(outputs), *lbl))
+            metrics.append(m.accumulate())
+        out = [float(loss.numpy())]
+        return (out, metrics) if metrics else out
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = [_to_tensor(i) for i in _to_list(inputs)]
+        out = self.network(*ins)
+        return [o.numpy() for o in _to_list(out)]
+
+    # -- loops ---------------------------------------------------------------
+    def _run_one_epoch(self, loader, cbs, mode, logs):
+        step = 0
+        for batch in loader:
+            batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+            n_in = max(1, len(batch) - 1)
+            ins, lbl = batch[:n_in], batch[n_in:]
+            if mode == "train":
+                cbs.on_train_batch_begin(step)
+                res = self.train_batch(ins, lbl)
+            else:
+                cbs.on_eval_batch_begin(step)
+                res = self.eval_batch(ins, lbl)
+            if isinstance(res, tuple):
+                losses, metrics = res
+            else:
+                losses, metrics = res, []
+            logs["loss"] = losses[0]
+            for m, v in zip(self._metrics, metrics):
+                names = m.name()
+                if isinstance(names, list):
+                    for n, x in zip(names, v):
+                        logs[n] = x
+                else:
+                    logs[names] = v
+            batch_size = getattr(ins[0], "shape", [1])[0]
+            logs["batch_size"] = batch_size
+            if mode == "train":
+                cbs.on_train_batch_end(step, logs)
+            else:
+                cbs.on_eval_batch_end(step, logs)
+            step += 1
+            if self.stop_training:
+                break
+        return logs
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._make_loader(train_data, batch_size, shuffle,
+                                         drop_last, num_workers)
+        eval_loader = (self._make_loader(eval_data, batch_size, False, False,
+                                         num_workers)
+                       if eval_data is not None else None)
+        steps = len(train_loader) if hasattr(train_loader, "__len__") else None
+        cbs = config_callbacks(callbacks, model=self, epochs=epochs,
+                               steps=steps, verbose=verbose,
+                               save_freq=save_freq, save_dir=save_dir,
+                               metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        cbs.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbs.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(train_loader, cbs, "train", {})
+            cbs.on_epoch_end(epoch, logs)
+            if eval_loader is not None and epoch % eval_freq == 0:
+                eval_logs = self.evaluate_with_callbacks(eval_loader, cbs)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            history.append(dict(logs))
+            if self.stop_training:
+                break
+        cbs.on_train_end(logs if history else None)
+        return history
+
+    def evaluate_with_callbacks(self, loader, cbs):
+        for m in self._metrics:
+            m.reset()
+        cbs.on_eval_begin()
+        logs = self._run_one_epoch(loader, cbs, "eval", {})
+        cbs.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._make_loader(eval_data, batch_size, False, False,
+                                   num_workers)
+        cbs = config_callbacks(callbacks, model=self, verbose=verbose,
+                               steps=len(loader) if hasattr(loader, "__len__")
+                               else None,
+                               metrics=[m.name() for m in self._metrics])
+        return self.evaluate_with_callbacks(loader, cbs)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, False,
+                                   num_workers)
+        outputs = []
+        for batch in loader:
+            batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+            # datasets that also yield labels (fit-style): drop the trailing
+            # label element, same split rule as the train/eval loops
+            if len(batch) > 1:
+                batch = batch[:max(1, len(batch) - 1)]
+            outputs.append(self.predict_batch(batch))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    def _make_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        from ..io import DataLoader
+        from ..io.dataset import Dataset
+
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data  # already a loader/iterable
+
+    # -- persistence / inspection -------------------------------------------
+    def save(self, path, training=True):
+        import os
+
+        from ..framework_io import save
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        from ..framework_io import load
+
+        self.network.set_state_dict(load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        return summary(self.network, input_size, dtype)

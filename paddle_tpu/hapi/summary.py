@@ -1,0 +1,118 @@
+"""Model summary + flops.
+
+Reference parity: python/paddle/hapi/model_summary.py (summary table:
+layer, output shape, params) and python/paddle/hapi/dynamic_flops.py
+(paddle.flops).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..nn.layer import Layer
+
+
+def _num_params(layer: Layer):
+    return sum(int(np.prod(p.shape)) for p in layer.parameters())
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-sublayer table; returns {'total_params', 'trainable_params'}."""
+    import paddle_tpu as paddle
+
+    rows = []
+    hooks = []
+    seen = set()
+
+    def make_hook(name, mod):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(getattr(out, "shape", [])) if out is not None else []
+            own = sum(int(np.prod(p.shape)) for p in layer.parameters(
+                include_sublayers=False))
+            rows.append((name or layer.__class__.__name__,
+                         layer.__class__.__name__, shape, own))
+
+        return hook
+
+    for name, sub in net.named_sublayers(include_self=False):
+        if id(sub) in seen:
+            continue
+        seen.add(id(sub))
+        hooks.append(sub.register_forward_post_hook(make_hook(name, sub)))
+
+    if input is not None:
+        x = input if isinstance(input, (list, tuple)) else [input]
+    else:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) and isinstance(
+            input_size[0], (list, tuple)) else [input_size]
+        dts = dtypes or ["float32"] * len(sizes)
+        x = [paddle.to_tensor(np.zeros(s, np.dtype(d)))
+             for s, d in zip(sizes, dts)]
+    was_training = net.training
+    net.eval()
+    try:
+        net(*x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    header = f"{'Layer (type)':<40}{'Output Shape':<24}{'Param #':>12}"
+    lines = [header, "=" * len(header)]
+    for name, cls, shape, own in rows:
+        lines.append(f"{name + ' (' + cls + ')':<40}{str(shape):<24}{own:>12,}")
+    total = _num_params(net)
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    lines += ["=" * len(header),
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}"]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
+    """Rough per-layer FLOPs count (dynamic_flops.py parity for the common
+    layer set: conv/linear/norm; other layers count 0)."""
+    import paddle_tpu as paddle
+    from .. import nn
+
+    total = [0]
+    hooks = []
+
+    def conv_hook(layer, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        k = int(np.prod(layer._kernel_size)) if hasattr(layer, "_kernel_size") \
+            else int(np.prod(layer.weight.shape[2:]))
+        cin = layer.weight.shape[1]
+        total[0] += int(np.prod(out.shape)) * cin * k * 2
+
+    def linear_hook(layer, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        total[0] += int(np.prod(out.shape)) * layer.weight.shape[0] * 2
+
+    for _, sub in net.named_sublayers():
+        if isinstance(sub, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+            hooks.append(sub.register_forward_post_hook(conv_hook))
+        elif isinstance(sub, nn.Linear):
+            hooks.append(sub.register_forward_post_hook(linear_hook))
+
+    x = paddle.to_tensor(np.zeros(input_size, np.float32))
+    was_training = net.training
+    net.eval()
+    try:
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

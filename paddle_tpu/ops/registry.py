@@ -88,6 +88,7 @@ def apply(name: str, fn: Callable, *args, differentiable: bool = True, n_outputs
     if not requires_grad:
         a2, k2 = jax.tree_util.tree_unflatten(treedef, arrays)
         out = fn(*a2, **k2)
+        _check_nan_inf(name, out)
         return _wrap_outputs(out, stop_gradient=True)
 
     diff_idx = [
@@ -106,6 +107,7 @@ def apply(name: str, fn: Callable, *args, differentiable: bool = True, n_outputs
         return fn(*a2, **k2)
 
     out = pure(*diff_arrays)
+    _check_nan_inf(name, out)
     wrapped = _wrap_outputs(out, stop_gradient=False)
 
     # tape only tracks float outputs; record with the full output structure
@@ -117,6 +119,32 @@ def apply(name: str, fn: Callable, *args, differentiable: bool = True, n_outputs
     if tracked:
         _tape.record(pure, diff_arrays, diff_tensors, out_tensors, name=name)
     return wrapped
+
+
+def _check_nan_inf(name, out):
+    """FLAGS_check_nan_inf parity (eager_gen.py:440,691 injects this check
+    into every generated fwd/bwd; impl paddle/fluid/eager/nan_inf_utils.cc).
+    Eager-only: inside jit tracing arrays are abstract, so the check is
+    skipped there (the reference likewise checks at kernel boundaries)."""
+    from ..utils.flags import flag
+
+    if not flag("FLAGS_check_nan_inf"):
+        return
+    for arr in jax.tree_util.tree_leaves(out):
+        if not isinstance(arr, (jax.Array, np.ndarray)):
+            continue
+        if not _dtype_mod.is_inexact_dtype(arr.dtype):
+            continue
+        if isinstance(arr, jax.Array) and not getattr(arr, "is_fully_addressable", True):
+            continue
+        try:
+            bad = not bool(jnp.isfinite(arr).all())
+        except jax.errors.TracerBoolConversionError:
+            return  # under jit tracing — cannot check concretely
+        if bad:
+            raise FloatingPointError(
+                f"NaN or Inf found in output of operator [{name}] "
+                f"(FLAGS_check_nan_inf is set)")
 
 
 def _wrap_outputs(out, stop_gradient):

@@ -1,0 +1,25 @@
+"""paddle.profiler parity.
+
+Reference: three-part profiler (SURVEY.md §5) — host RecordEvent spans
+(paddle/phi/api/profiler/event_tracing.h), device tracer (CUPTI), merged
+chrome-trace export (chrometracing_logger.cc); Python surface
+python/paddle/profiler/profiler.py:358 (Profiler with scheduler state
+machine), :227 (export_chrome_tracing), timer.py (ips benchmark).
+
+TPU-native: host spans are recorded by a pure-Python recorder (the
+RecordEvent API is preserved); the device side delegates to jax.profiler
+(XPlane/perfetto), started/stopped by the same scheduler. Both land in the
+same output dir.
+"""
+from .profiler import (
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, SummaryView,
+    export_chrome_tracing, export_protobuf, load_profiler_result,
+    make_scheduler)
+from .timer import benchmark
+from .profiler_statistic import SortedKeys
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "SummaryView", "SortedKeys", "export_chrome_tracing", "export_protobuf",
+    "load_profiler_result", "make_scheduler", "benchmark",
+]

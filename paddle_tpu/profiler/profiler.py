@@ -1,0 +1,291 @@
+"""Profiler core: RecordEvent spans, scheduler state machine, chrome trace.
+
+Reference: python/paddle/profiler/profiler.py (Profiler :358, make_scheduler
+:129, export_chrome_tracing :227, ProfilerState :89, ProfilerTarget :110);
+host recorder paddle/phi/api/profiler/host_event_recorder.h; chrome export
+paddle/fluid/platform/profiler/chrometracing_logger.cc.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+class _HostEventRecorder:
+    """host_event_recorder.h parity: thread-local span stacks, one global
+    sink; spans carry (name, event_type, start_us, end_us, tid)."""
+
+    def __init__(self):
+        self._events = []
+        self._lock = threading.Lock()
+        self._enabled = False
+
+    def start(self):
+        with self._lock:
+            self._events = []
+            self._enabled = True
+
+    def stop(self):
+        with self._lock:
+            self._enabled = False
+
+    def record(self, name, typ, start_us, end_us):
+        if not self._enabled:
+            return
+        ev = (name, typ, start_us, end_us, threading.get_ident())
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """User span (event_tracing.h RecordEvent parity): context manager or
+    explicit begin()/end()."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._start = None
+
+    def begin(self):
+        self._start = time.perf_counter_ns() // 1000
+
+    def end(self):
+        if self._start is None:
+            return
+        _recorder.record(self.name, self.event_type, self._start,
+                         time.perf_counter_ns() // 1000)
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """profiler.py:129 parity: step → state, cycling
+    [closed, ready, record(last step RECORD_AND_RETURN)] repeat times."""
+    span = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * span:
+            return ProfilerState.CLOSED
+        pos = s % span
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == span - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str,
+                          worker_name: Optional[str] = None) -> Callable:
+    """profiler.py:227 parity: on_trace_ready callback writing
+    chrome://tracing JSON into dir_name."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{name}_time_{int(time.time() * 1000)}.paddle_trace.json")
+        prof._export_chrome(path)
+        prof._last_export = path
+
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None) -> Callable:
+    """API parity; the TPU build's device traces are XPlane protos written
+    by jax.profiler into the same dir."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def load_profiler_result(filename: str):
+    with open(filename) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """profiler.py:358 parity. targets/scheduler/on_trace_ready keep their
+    meaning; device tracing is jax.profiler (XPlane) when a trace dir is
+    known and the platform supports it."""
+
+    def __init__(self, *, targets: Optional[Iterable[ProfilerTarget]] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 with_flops: bool = False, timer_only: bool = False,
+                 emit_nvtx: bool = False, custom_device_types=None):
+        self.targets = list(targets) if targets else [ProfilerTarget.CPU]
+        if scheduler is None:
+            self._scheduler = _default_state_scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+        else:
+            self._scheduler = scheduler
+        self.on_trace_ready = on_trace_ready or export_chrome_tracing(
+            "./profiler_log/")
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._jax_tracing = False
+        self._trace_dir = None
+        self._last_export = None
+        from .timer import benchmark as _bm
+
+        self._benchmark = _bm()
+
+    # -- device (jax) tracer ------------------------------------------------
+    def _device_start(self):
+        if self.timer_only or self._jax_tracing:
+            return
+        try:
+            import jax
+
+            self._trace_dir = getattr(self.on_trace_ready, "_dir", None) or \
+                "./profiler_log/"
+            os.makedirs(self._trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self._trace_dir)
+            self._jax_tracing = True
+        except Exception:  # pragma: no cover - device tracer unavailable
+            self._jax_tracing = False
+
+    def _device_stop(self):
+        if not self._jax_tracing:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:  # pragma: no cover
+            pass
+        self._jax_tracing = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._benchmark.begin()
+        if self.timer_only:
+            return
+        self.current_state = self._scheduler(self.step_num)
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            _recorder.start()
+            self._device_start()
+
+    def stop(self):
+        self._benchmark.end()
+        if self.timer_only:
+            return
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._device_stop()
+            _recorder.stop()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        self._benchmark.step(num_samples)
+        if self.timer_only:
+            self.step_num += 1
+            return
+        prev = self.current_state
+        self.step_num += 1
+        new = self._scheduler(self.step_num)
+        if prev != new:
+            if prev == ProfilerState.RECORD_AND_RETURN or (
+                    prev in (ProfilerState.RECORD,) and new in (
+                        ProfilerState.CLOSED, ProfilerState.READY)):
+                self._device_stop()
+                _recorder.stop()
+                if self.on_trace_ready:
+                    self.on_trace_ready(self)
+            if new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
+                    and prev not in (ProfilerState.RECORD,):
+                _recorder.start()
+                self._device_start()
+        self.current_state = new
+
+    def step_info(self, unit: Optional[str] = None) -> str:
+        return self._benchmark.step_info(unit)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- export / summary ---------------------------------------------------
+    def _export_chrome(self, path: str):
+        events = _recorder.events()
+        trace = {"traceEvents": [
+            {"name": n, "cat": t, "ph": "X", "pid": os.getpid(), "tid": tid,
+             "ts": start, "dur": end - start}
+            for (n, t, start, end, tid) in events]}
+        with open(path, "w") as f:
+            json.dump(trace, f)
+
+    def export(self, path: str, format: str = "json"):
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        from .profiler_statistic import host_summary
+
+        print(host_summary(_recorder.events(), time_unit))

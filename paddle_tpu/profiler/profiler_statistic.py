@@ -1,0 +1,40 @@
+"""Host-span statistics tables.
+
+Reference: python/paddle/profiler/profiler_statistic.py (SortedKeys, the
+summary table printers consumed by Profiler.summary).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from enum import Enum
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+_UNIT = {"s": 1e-6, "ms": 1e-3, "us": 1.0}
+
+
+def host_summary(events, time_unit="ms") -> str:
+    """Aggregate (name → calls, total, avg, max, min) over recorded spans."""
+    scale = _UNIT.get(time_unit, 1e-3)
+    agg = defaultdict(list)
+    for (name, typ, start, end, tid) in events:
+        agg[name].append((end - start) * scale)
+    rows = [(n, len(d), sum(d), sum(d) / len(d), max(d), min(d))
+            for n, d in sorted(agg.items(), key=lambda kv: -sum(kv[1]))]
+    header = (f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+              f"{'Avg':>12}{'Max':>12}{'Min':>12}")
+    lines = [header, "-" * len(header)]
+    for n, c, tot, avg, mx, mn in rows:
+        lines.append(f"{n[:39]:<40}{c:>8}{tot:>14.4f}{avg:>12.4f}"
+                     f"{mx:>12.4f}{mn:>12.4f}")
+    return "\n".join(lines)

@@ -1,0 +1,72 @@
+"""Throughput timer (ips / reader-cost).
+
+Reference: python/paddle/profiler/timer.py — Benchmark with reader/batch
+cost averagers and get_ips_average (:332), surfaced via
+Profiler.step_info (:735-style "reader_cost ... batch_cost ... ips ...").
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class _Averager:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+
+    def record(self, v, n=1):
+        self.total += v
+        self.count += n
+
+    def average(self):
+        return self.total / self.count if self.count else 0.0
+
+
+class benchmark:
+    """Reference timer.Benchmark parity (lowercase name matches
+    paddle.profiler.benchmark usage via Profiler)."""
+
+    def __init__(self):
+        self.reader_cost = _Averager()
+        self.batch_cost = _Averager()
+        self.ips = _Averager()
+        self._batch_start = None
+        self._reader_mark = None
+        self.last = {}
+
+    def begin(self):
+        self._batch_start = time.perf_counter()
+        self._reader_mark = self._batch_start
+
+    def before_reader(self):
+        self._reader_mark = time.perf_counter()
+
+    def after_reader(self):
+        if self._reader_mark is not None:
+            self.reader_cost.record(time.perf_counter() - self._reader_mark)
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._batch_start is not None:
+            dt = now - self._batch_start
+            self.batch_cost.record(dt)
+            if num_samples:
+                self.ips.record(num_samples, 1)
+                self.last["ips"] = num_samples / dt if dt else 0.0
+            self.last["batch_cost"] = dt
+        self._batch_start = now
+
+    def end(self):
+        self._batch_start = None
+
+    def step_info(self, unit: Optional[str] = None) -> str:
+        avg_batch = self.batch_cost.average()
+        ips = (self.ips.total / self.batch_cost.total
+               if self.batch_cost.total else 0.0)
+        u = unit or "samples"
+        return (f"reader_cost: {self.reader_cost.average():.5f} s "
+                f"batch_cost: {avg_batch:.5f} s ips: {ips:.3f} {u}/s")

@@ -1,0 +1,154 @@
+"""paddle.text parity (python/paddle/text/): viterbi decode + datasets.
+
+Datasets are download-gated (no egress in the TPU image) but accept the
+reference's cached-file formats from disk.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..ops.registry import apply
+from ..tensor_class import Tensor, unwrap, wrap
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing", "Imdb"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """python/paddle/text/viterbi_decode.py parity: batched Viterbi over
+    emission potentials [B, L, T] with transitions [T, T] (or [T+2, T+2]
+    with BOS/EOS). Returns (scores [B], paths [B, L]).
+
+    Implemented as a lax.scan over time — jit/TPU friendly (no Python loop
+    over sequence length).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fn(pot, trans, lens):
+        b, l, t = pot.shape
+        if include_bos_eos_tag:
+            # reference semantics: tags [0..T), trans has BOS=T, EOS=T+1 rows
+            bos, eos = t, t + 1
+            init = pot[:, 0] + trans[bos, :t][None, :]
+        else:
+            init = pot[:, 0]
+
+        def step(carry, i):
+            alpha, hist_dummy = carry
+            scores = alpha[:, :, None] + trans[:t, :t][None]  # [B, T, T]
+            best_prev = jnp.argmax(scores, axis=1)            # [B, T]
+            best_score = jnp.max(scores, axis=1) + pot[:, i]
+            keep = (i < lens)[:, None]
+            alpha_new = jnp.where(keep, best_score, alpha)
+            bp = jnp.where(keep, best_prev, jnp.arange(t)[None, :])
+            return (alpha_new, None), bp
+
+        (alpha, _), bps = lax.scan(step, (init, None), jnp.arange(1, l))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:t, eos][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1)  # [B]
+
+        def back(carry, bp):
+            tag = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        # bps[i-1] maps step-i tags → best step-(i-1) tag; walking in reverse
+        # emits tags for steps l-1..1, and the final carry is step 0's tag
+        first, path_rev = lax.scan(back, last, bps, reverse=True)
+        paths = jnp.concatenate(
+            [first[:, None], jnp.swapaxes(path_rev, 0, 1)], axis=1)  # [B, L]
+        # positions beyond each length keep tag 0 (reference pads with 0)
+        mask = jnp.arange(l)[None, :] < lens[:, None]
+        return scores, jnp.where(mask, paths, 0)
+
+    return apply("viterbi_decode", fn, potentials, transition_params, lengths,
+                 differentiable=False, n_outputs=2)
+
+
+class ViterbiDecoder:
+    """python/paddle/text/viterbi_decode.py ViterbiDecoder parity."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+_NO_EGRESS = ("{name}: data file not found at {path}; this environment has "
+              "no network egress — place the reference's cached dataset "
+              "file there")
+
+
+from ..io.dataset import Dataset  # noqa: E402
+
+
+class UCIHousing(Dataset):
+    """python/paddle/text/datasets/uci_housing.py parity."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/uci_housing/housing.data")
+        if not os.path.exists(path):
+            raise RuntimeError(_NO_EGRESS.format(name="UCIHousing", path=path))
+        raw = np.loadtxt(path).astype(np.float32)
+        feat = raw[:, :-1]
+        feat = (feat - feat.mean(0)) / np.maximum(feat.std(0), 1e-8)
+        n = int(len(raw) * 0.8)
+        sl = slice(0, n) if mode == "train" else slice(n, None)
+        self.x = feat[sl]
+        self.y = raw[sl, -1:]
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Imdb(Dataset):
+    """python/paddle/text/datasets/imdb.py parity (tokenised tar)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/imdb/aclImdb_v1.tar.gz")
+        if not os.path.exists(path):
+            raise RuntimeError(_NO_EGRESS.format(name="Imdb", path=path))
+        import re
+        import tarfile
+
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        freq = {}
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                g = pat.match(m.name)
+                if not g:
+                    continue
+                words = tf.extractfile(m).read().decode(
+                    "utf-8", "ignore").lower().split()
+                docs.append(words)
+                labels.append(0 if g.group(1) == "pos" else 1)
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))) if c > cutoff}
+        unk = len(vocab)
+        self.word_idx = vocab
+        self.docs = [np.array([vocab.get(w, unk) for w in d], np.int64)
+                     for d in docs]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)

@@ -1,0 +1,79 @@
+"""paddle.utils parity: flags, deprecated-API decorator, dlpack, unique
+names, layer helpers (python/paddle/utils/)."""
+from __future__ import annotations
+
+from . import flags  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+
+
+def try_import(module_name: str):
+    """python/paddle/utils/lazy_import.py parity."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            f"{module_name} is required but not installed; the TPU image "
+            f"bakes no extra pip packages") from e
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the install can compute."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    a = paddle.to_tensor(np.ones((2, 2), np.float32))
+    b = paddle.matmul(a, a)
+    assert float(b.numpy()[0, 0]) == 2.0
+    n = paddle.device.device_count() if hasattr(paddle, "device") else 1
+    print(f"PaddleTPU works! device check OK ({n} device(s)).")
+
+
+class unique_name:
+    """paddle.utils.unique_name parity (python/paddle/utils/unique_name.py)."""
+
+    _counters = {}
+
+    @classmethod
+    def generate(cls, key: str) -> str:
+        idx = cls._counters.get(key, 0)
+        cls._counters[key] = idx + 1
+        return f"{key}_{idx}"
+
+    @classmethod
+    def guard(cls, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            saved = dict(cls._counters)
+            cls._counters.clear()
+            try:
+                yield
+            finally:
+                cls._counters.clear()
+                cls._counters.update(saved)
+
+        return _guard()
+
+
+def to_dlpack(tensor):
+    """paddle.utils.dlpack.to_dlpack parity."""
+    from ..tensor_class import unwrap
+
+    return unwrap(tensor).__dlpack__()
+
+
+def from_dlpack(capsule):
+    import jax.numpy as jnp
+
+    from ..tensor_class import wrap
+
+    return wrap(jnp.from_dlpack(capsule))
+
+
+class dlpack:
+    to_dlpack = staticmethod(to_dlpack)
+    from_dlpack = staticmethod(from_dlpack)

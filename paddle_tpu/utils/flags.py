@@ -1,0 +1,89 @@
+"""Global flag registry.
+
+Reference parity: paddle/common/flags.h:38-94 (PD_DEFINE_* registry; every
+flag settable via env FLAGS_xxx, paddle.set_flags, or pybind) — here an
+absl-style Python registry (SURVEY.md §5 "TPU equivalent: absl-style flags
++ a dataclass strategy object"). Flags are read at TRACE time (jit treats
+them as constants), matching how the reference's C++ reads them at kernel
+launch.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "help", "typ")
+
+    def __init__(self, name, default, help_str):
+        self.name = name
+        self.default = default
+        self.typ = type(default)
+        self.help = help_str
+        env = os.environ.get(name)
+        self.value = self._parse(env) if env is not None else default
+
+    def _parse(self, raw):
+        if self.typ is bool:
+            return str(raw).lower() in ("1", "true", "yes", "on")
+        return self.typ(raw)
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default: Any, help_str: str = "") -> None:
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    if name not in _REGISTRY:
+        _REGISTRY[name] = _Flag(name, default, help_str)
+
+
+def get_flags(name: Optional[object] = None) -> Dict[str, Any]:
+    """paddle.get_flags parity: str or list of str → {name: value}."""
+    if name is None:
+        return {k: f.value for k, f in _REGISTRY.items()}
+    names = [name] if isinstance(name, str) else list(name)
+    out = {}
+    for n in names:
+        key = n if n.startswith("FLAGS_") else "FLAGS_" + n
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {n!r}")
+        out[n] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(d: Dict[str, Any]) -> None:
+    """paddle.set_flags parity."""
+    for n, v in d.items():
+        key = n if n.startswith("FLAGS_") else "FLAGS_" + n
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {n!r}")
+        f = _REGISTRY[key]
+        f.value = f._parse(v) if isinstance(v, str) else f.typ(v)
+
+
+def flag(name: str) -> Any:
+    """Fast internal read."""
+    key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    return _REGISTRY[key].value
+
+
+# ---- core flags (the subset of the reference's ~hundreds that has meaning
+# on the TPU build; each cites its reference definition site) -----------------
+define_flag("check_nan_inf", False,
+            "check every op output for NaN/Inf (paddle/fluid/eager/nan_inf_utils.cc)")
+define_flag("benchmark", False,
+            "sync after every op for timing (paddle/phi/core/flags.cc benchmark)")
+define_flag("use_autotune", True,
+            "enable kernel autotune cache (paddle/phi/kernels/autotune/)")
+define_flag("allocator_strategy", "auto_growth",
+            "allocator strategy name; informational on TPU (XLA owns HBM)")
+define_flag("embedding_deterministic", False,
+            "deterministic embedding grad accumulation "
+            "(paddle/phi/kernels/gpu/embedding_grad_kernel.cu FLAGS_embedding_deterministic)")
+define_flag("cudnn_deterministic", False,
+            "map to XLA deterministic reductions where applicable")
+define_flag("log_memory_stats", False,
+            "log live/peak device memory at step boundaries (memory/stats.cc)")

@@ -1,0 +1,19 @@
+"""paddle.vision parity (python/paddle/vision/)."""
+from . import datasets, models, ops, transforms  # noqa: F401
+from .models import *  # noqa: F401,F403
+
+
+def set_image_backend(backend: str):
+    """API parity; the numpy pipeline ignores the hint."""
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+
+
+def get_image_backend() -> str:
+    return "cv2"
+
+
+def image_load(path, backend=None):
+    from .datasets import _default_loader
+
+    return _default_loader(path)

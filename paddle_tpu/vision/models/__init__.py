@@ -1,0 +1,15 @@
+"""paddle.vision.models parity (python/paddle/vision/models/__init__.py).
+
+Implemented: LeNet, AlexNet, VGG (11/13/16/19), ResNet family (18-152,
+resnext, wide), MobileNetV1/V2. Remaining reference zoo entries (densenet,
+googlenet, inception, shufflenet, squeezenet, mobilenetv3) are tracked
+gaps for a later round.
+"""
+from .resnet import (  # noqa: F401
+    BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
+    resnet101, resnet152, resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+    resnext101_64x4d, resnext152_32x4d, resnext152_64x4d, wide_resnet50_2,
+    wide_resnet101_2)
+from .small import (  # noqa: F401
+    AlexNet, LeNet, MobileNetV1, MobileNetV2, VGG, alexnet, mobilenet_v1,
+    mobilenet_v2, vgg11, vgg13, vgg16, vgg19)

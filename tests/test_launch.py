@@ -30,7 +30,9 @@ def _run(tmp_path, script, nproc, extra=()):
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", str(nproc), "--log_dir", str(tmp_path / "log"),
          *extra, str(sc)],
-        capture_output=True, text=True, env=env, cwd="/root/repo", timeout=120)
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        timeout=120)
 
 
 def test_launch_sets_rank_env(tmp_path):
@@ -50,7 +52,14 @@ def test_launch_aborts_all_on_failure(tmp_path):
 
 
 def test_launch_node_rank_offset(tmp_path):
+    # --nnodes > 1 without --master must fail fast (silent loopback default
+    # would hang the real job at rendezvous)
     r = _run(tmp_path, SCRIPT_OK, 2, extra=("--nnodes", "2", "--rank", "1"))
+    assert r.returncode != 0 and "--master" in (r.stdout + r.stderr)
+
+    r = _run(tmp_path, SCRIPT_OK, 2,
+             extra=("--nnodes", "2", "--rank", "1",
+                    "--master", "127.0.0.1:8899"))
     assert r.returncode == 0
     text = "".join(p.read_text() for p in sorted((tmp_path / "log").iterdir()))
     assert "rank 2 of 4" in text and "rank 3 of 4" in text

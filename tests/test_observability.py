@@ -1,0 +1,168 @@
+"""Flags, profiler, NaN/Inf checking, memory stats.
+
+Parity model: paddle.set_flags/get_flags (paddle/common/flags.h registry),
+paddle.profiler.Profiler scheduler + chrome export (profiler.py:358,:227),
+FLAGS_check_nan_inf (eager_gen.py:440, nan_inf_utils.cc).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 export_chrome_tracing, make_scheduler)
+
+
+# ---- flags -------------------------------------------------------------------
+
+def test_flags_get_set_roundtrip():
+    assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is False
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        assert paddle.get_flags(["check_nan_inf"])["check_nan_inf"] is True
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_flags_unknown_raises():
+    with pytest.raises(ValueError):
+        paddle.set_flags({"FLAGS_no_such_flag": 1})
+    with pytest.raises(ValueError):
+        paddle.get_flags("no_such_flag")
+
+
+def test_flags_string_bool_parse():
+    paddle.set_flags({"FLAGS_benchmark": "true"})
+    try:
+        assert paddle.get_flags("FLAGS_benchmark")["FLAGS_benchmark"] is True
+    finally:
+        paddle.set_flags({"FLAGS_benchmark": "false"})
+
+
+# ---- NaN/Inf checking --------------------------------------------------------
+
+def test_check_nan_inf_forward_and_backward():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError, match=r"operator \[divide\]|divide"):
+            _ = paddle.to_tensor(np.array([1.0, 1.0], np.float32)) / x
+
+        # backward: log'(0) = inf
+        y = paddle.to_tensor(np.array([1.0, 0.0], np.float32),
+                             stop_gradient=False)
+        out = (y * y).sum()  # fine forward
+        out.backward()  # fine backward
+        z = paddle.to_tensor(np.array([0.5, 0.0], np.float32),
+                             stop_gradient=False)
+        with pytest.raises(FloatingPointError):
+            paddle.log(z).sum().backward()
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+        from paddle_tpu.autograd import tape
+
+        tape.reset_tape()
+
+
+def test_check_nan_inf_off_is_silent():
+    x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    out = paddle.to_tensor(np.array([1.0, 1.0], np.float32)) / x
+    assert np.isinf(out.numpy()[1])
+
+
+# ---- profiler ----------------------------------------------------------------
+
+def test_make_scheduler_state_machine():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2, skip_first=1)
+    states = [sched(i) for i in range(10)]
+    assert states[0] == ProfilerState.CLOSED            # skip_first
+    assert states[1] == ProfilerState.CLOSED
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[5] == ProfilerState.CLOSED            # cycle 2
+    assert states[9] == ProfilerState.CLOSED            # repeat exhausted
+
+
+def test_profiler_records_spans_and_exports(tmp_path):
+    traces = []
+
+    def on_ready(prof):
+        path = tmp_path / "trace.json"
+        prof._export_chrome(str(path))
+        traces.append(path)
+
+    p = Profiler(scheduler=make_scheduler(closed=0, ready=0, record=2, repeat=1),
+                 on_trace_ready=on_ready)
+    p.start()
+    for step in range(2):
+        with RecordEvent("train_step"):
+            with RecordEvent("forward"):
+                pass
+        p.step(num_samples=32)
+    p.stop()
+    assert traces, "trace not exported"
+    data = json.load(open(traces[0]))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "train_step" in names and "forward" in names
+    info = p.step_info()
+    assert "ips" in info and "batch_cost" in info
+
+
+def test_profiler_timer_only_ips():
+    p = Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        p.step(num_samples=16)
+    p.stop()
+    assert "ips" in p.step_info()
+
+
+def test_record_event_outside_profiler_is_noop():
+    with RecordEvent("orphan"):
+        pass  # must not raise or leak
+
+
+def test_profiler_summary_prints(capsys):
+    p = Profiler(scheduler=make_scheduler(closed=0, ready=0, record=1, repeat=1),
+                 on_trace_ready=lambda prof: None)
+    p.start()
+    with RecordEvent("op_a"):
+        pass
+    p.stop()
+    p.summary()
+    out = capsys.readouterr().out
+    assert "op_a" in out and "Calls" in out
+
+
+# ---- memory stats ------------------------------------------------------------
+
+def test_memory_stats_api():
+    from paddle_tpu.framework import device as dev
+
+    x = paddle.to_tensor(np.zeros((256, 256), np.float32))
+    assert dev.memory_allocated() >= 0
+    assert dev.max_memory_allocated() >= dev.memory_allocated() or \
+        dev.max_memory_allocated() == 0  # cpu backend may not track
+    dev.empty_cache()
+
+
+# ---- utils -------------------------------------------------------------------
+
+def test_unique_name_and_run_check(capsys):
+    from paddle_tpu.utils import unique_name
+
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
+    assert unique_name.generate("fc") == "fc_2"
+
+    import paddle_tpu.utils as utils
+
+    utils.run_check()
+    assert "works" in capsys.readouterr().out

@@ -1,0 +1,267 @@
+"""vision (transforms/models/ops), metric, hapi Model, text viterbi.
+
+Parity model: test/legacy_test/test_vision_models.py (forward shape
+checks), transforms unit tests, hapi model fit/evaluate/predict tests
+(test/legacy_test/test_model.py semantics), metric tests vs sklearn-style
+references.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io.dataset import Dataset
+
+
+# ---- transforms --------------------------------------------------------------
+
+def test_transforms_pipeline():
+    import paddle_tpu.vision.transforms as T
+
+    img = np.random.randint(0, 255, (40, 60, 3), np.uint8)
+    tf = T.Compose([
+        T.Resize(32), T.CenterCrop(24), T.RandomHorizontalFlip(0.0),
+        T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3)])
+    out = tf(img)
+    assert out.shape == [3, 24, 24]
+    assert out.numpy().dtype == np.float32
+
+
+def test_resize_semantics():
+    from paddle_tpu.vision.transforms import functional as F
+
+    img = np.arange(16, dtype=np.uint8).reshape(4, 4)
+    up = F.resize(img, (8, 8), "nearest")
+    assert up.shape == (8, 8)
+    assert up[0, 0] == img[0, 0] and up[-1, -1] == img[-1, -1]
+    # short-side int resize keeps aspect
+    rect = np.zeros((10, 20), np.uint8)
+    out = F.resize(rect, 5)
+    assert out.shape == (5, 10)
+
+
+def test_color_transforms_preserve_dtype():
+    from paddle_tpu.vision.transforms import functional as F
+
+    img = np.random.randint(0, 255, (8, 8, 3), np.uint8)
+    for fn, arg in [(F.adjust_brightness, 1.2), (F.adjust_contrast, 0.8),
+                    (F.adjust_saturation, 1.5), (F.adjust_hue, 0.1)]:
+        out = fn(img, arg)
+        assert out.dtype == np.uint8 and out.shape == img.shape
+    # hue identity: factor 0 returns (almost) the same image
+    np.testing.assert_allclose(F.adjust_hue(img, 0.0), img, atol=2)
+
+
+def test_random_erasing_and_crop():
+    import paddle_tpu.vision.transforms as T
+
+    img = np.ones((16, 16, 3), np.uint8) * 255
+    erased = T.RandomErasing(prob=1.0)(img)
+    assert (erased == 0).any()
+    cropped = T.RandomCrop(8)(img)
+    assert cropped.shape == (8, 8, 3)
+
+
+# ---- models ------------------------------------------------------------------
+
+@pytest.mark.parametrize("factory,in_shape,n_cls", [
+    ("lenet", (2, 1, 28, 28), 10),
+    ("resnet18", (2, 3, 32, 32), 1000),
+])
+def test_model_forward_shapes(factory, in_shape, n_cls):
+    import paddle_tpu.vision.models as M
+
+    if factory == "lenet":
+        net = M.LeNet()
+    else:
+        net = getattr(M, factory)()
+    net.eval()
+    x = paddle.to_tensor(np.random.randn(*in_shape).astype(np.float32))
+    out = net(x)
+    assert out.shape == [in_shape[0], n_cls]
+
+
+def test_resnet50_and_friends_construct():
+    import paddle_tpu.vision.models as M
+
+    for f in (M.resnet50, M.vgg11, M.mobilenet_v1, M.mobilenet_v2, M.alexnet):
+        net = f(num_classes=4)
+        assert len(list(net.parameters())) > 0
+    with pytest.raises(NotImplementedError):
+        M.resnet18(pretrained=True)
+
+
+def test_lenet_trains():
+    import paddle_tpu.vision.models as M
+    from paddle_tpu import optimizer as opt
+
+    paddle.seed(0)
+    net = M.LeNet()
+    optim = opt.Adam(1e-3, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.randn(8, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(np.random.randint(0, 10, (8,)))
+    loss_fn = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(5):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+# ---- vision ops --------------------------------------------------------------
+
+def test_nms_and_box_iou():
+    from paddle_tpu.vision import ops as vops
+
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                    scores=paddle.to_tensor(scores))
+    assert keep.numpy().tolist() == [0, 2]
+    iou = vops.box_iou(paddle.to_tensor(boxes), paddle.to_tensor(boxes))
+    np.testing.assert_allclose(np.diag(iou.numpy()), 1.0, atol=1e-6)
+
+
+def test_roi_align_shapes_and_values():
+    from paddle_tpu.vision import ops as vops
+
+    # constant feature map → every roi bin equals the constant
+    x = np.full((1, 2, 8, 8), 3.0, np.float32)
+    boxes = np.array([[0, 0, 4, 4], [2, 2, 6, 6]], np.float32)
+    out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([2], np.int32)), 2)
+    assert out.shape == [2, 2, 2, 2]
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+
+
+# ---- metric ------------------------------------------------------------------
+
+def test_accuracy_metric():
+    from paddle_tpu.metric import Accuracy
+
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.9, 0], [0.8, 0.1, 0.1], [0.2, 0.3, 0.5]],
+                    np.float32)
+    label = np.array([[1], [2], [2]])
+    m.update(m.compute(pred, label))
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 2 / 3) < 1e-6
+    assert abs(top2 - 2 / 3) < 1e-6 or top2 >= top1
+
+
+def test_precision_recall_auc():
+    from paddle_tpu.metric import Auc, Precision, Recall
+
+    preds = np.array([0.9, 0.8, 0.2, 0.6], np.float32)
+    labels = np.array([1, 0, 0, 1])
+    p = Precision(); p.update(preds, labels)
+    assert abs(p.accumulate() - 2 / 3) < 1e-6
+    r = Recall(); r.update(preds, labels)
+    assert abs(r.accumulate() - 1.0) < 1e-6
+    a = Auc(); a.update(np.stack([1 - preds, preds], 1), labels)
+    # one inverted pair (0.8 neg above 0.6 pos) out of 4 → AUC = 0.75
+    assert abs(a.accumulate() - 0.75) < 1e-3
+
+
+# ---- hapi Model --------------------------------------------------------------
+
+class _RandomDataset(Dataset):
+    def __init__(self, n=32):
+        rng = np.random.default_rng(0)
+        self.x = rng.standard_normal((n, 8)).astype(np.float32)
+        w = rng.standard_normal((8,)).astype(np.float32)
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path, capsys):
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Accuracy
+
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = Model(net)
+    model.prepare(opt.Adam(5e-3, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    ds = _RandomDataset()
+    hist = model.fit(ds, epochs=3, batch_size=8, verbose=0)
+    assert len(hist) == 3
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    ev = model.evaluate(ds, batch_size=8, verbose=0)
+    assert "acc" in ev and ev["acc"] > 0.5
+    preds = model.predict(ds, batch_size=8, stack_outputs=True)
+    assert preds[0].shape == (32, 2)
+
+    model.save(str(tmp_path / "ck"))
+    net2 = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    m2 = Model(net2)
+    m2.prepare(opt.Adam(5e-3, parameters=net2.parameters()),
+               nn.CrossEntropyLoss(), Accuracy())
+    m2.load(str(tmp_path / "ck"))
+    ev2 = m2.evaluate(ds, batch_size=8, verbose=0)
+    np.testing.assert_allclose(ev2["loss"], ev["loss"], rtol=1e-5)
+
+
+def test_hapi_early_stopping():
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.hapi import EarlyStopping, Model
+    from paddle_tpu.metric import Accuracy
+
+    paddle.seed(5)
+    net = nn.Linear(8, 2)
+    model = Model(net)
+    model.prepare(opt.Adam(0.0, parameters=net.parameters()),  # lr=0: no progress
+                  nn.CrossEntropyLoss(), Accuracy())
+    ds = _RandomDataset()
+    es = EarlyStopping(monitor="loss", patience=1, save_best_model=False)
+    hist = model.fit(ds, eval_data=ds, epochs=10, batch_size=8, verbose=0,
+                     callbacks=[es])
+    assert len(hist) < 10  # stopped early
+
+
+def test_model_summary_and_flops(capsys):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    info = paddle.summary(net, (1, 8))
+    out = capsys.readouterr().out
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+    assert "Linear" in out
+    fl = paddle.flops(net, (1, 8))
+    assert fl == 2 * (8 * 16 + 16 * 2)
+
+
+# ---- text --------------------------------------------------------------------
+
+def test_viterbi_decode_matches_bruteforce():
+    import itertools
+
+    from paddle_tpu.text import ViterbiDecoder
+
+    rng = np.random.default_rng(0)
+    b, l, t = 2, 5, 3
+    pot = rng.standard_normal((b, l, t)).astype(np.float32)
+    trans = rng.standard_normal((t, t)).astype(np.float32)
+    lens = np.array([5, 3], np.int64)
+
+    dec = ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=False)
+    scores, paths = dec(paddle.to_tensor(pot), paddle.to_tensor(lens))
+
+    for i in range(b):
+        best, best_path = -1e9, None
+        for path in itertools.product(range(t), repeat=int(lens[i])):
+            s = pot[i, 0, path[0]]
+            for j in range(1, len(path)):
+                s += trans[path[j - 1], path[j]] + pot[i, j, path[j]]
+            if s > best:
+                best, best_path = s, path
+        np.testing.assert_allclose(float(scores.numpy()[i]), best, rtol=1e-5)
+        assert paths.numpy()[i, :int(lens[i])].tolist() == list(best_path)
