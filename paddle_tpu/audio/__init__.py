@@ -1,0 +1,4 @@
+"""paddle.audio parity (python/paddle/audio/): feature extractors +
+functional window/mel utilities."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401

@@ -1,0 +1,124 @@
+"""paddle.audio.functional parity (audio/functional/{window,functional}.py):
+windows, mel filterbanks, unit conversions."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype: str = "float64"):
+    """window.py get_window parity (hann/hamming/blackman/bohman/
+    triang/gaussian via scipy-free numpy)."""
+    import paddle_tpu as paddle
+
+    sym = not fftbins
+    n = win_length + (0 if sym else 1)
+    k = np.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / (n - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / (n - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / (n - 1))
+             + 0.08 * np.cos(4 * np.pi * k / (n - 1)))
+    elif window == "bartlett":
+        w = np.bartlett(n)
+    elif window == "triang":
+        w = 1 - np.abs(2 * k - (n - 1)) / (n + (1 if n % 2 else 0))
+    elif window == "bohman":
+        x = np.abs(2 * k / (n - 1) - 1)
+        w = (1 - x) * np.cos(np.pi * x) + np.sin(np.pi * x) / np.pi
+    elif window.startswith("gaussian"):
+        std = 7.0
+        w = np.exp(-0.5 * ((k - (n - 1) / 2) / (std * (n - 1) / 2)) ** 2)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    w = w[:win_length]
+    return paddle.to_tensor(w.astype(np.dtype(dtype)))
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                       mels)
+    return float(out) if np.isscalar(freq) else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+    return float(out) if np.isscalar(mel) else out
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64, f_min: float = 0.0,
+                         f_max=None, htk: bool = False, norm: str = "slaney",
+                         dtype: str = "float32"):
+    """functional.py compute_fbank_matrix parity: [n_mels, n_fft//2+1]."""
+    import paddle_tpu as paddle
+
+    f_max = f_max or sr / 2.0
+    fft_freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, len(fft_freqs)))
+    for i in range(n_mels):
+        lo, ce, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (fft_freqs - lo) / max(ce - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ce, 1e-10)
+        fb[i] = np.maximum(0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return paddle.to_tensor(fb.astype(np.dtype(dtype)))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    """functional.py power_to_db parity."""
+    from ..ops.registry import apply
+
+    def fn(s):
+        db = 10.0 * jnp.log10(jnp.maximum(s, amin))
+        db = db - 10.0 * jnp.log10(jnp.maximum(jnp.asarray(ref_value), amin))
+        if top_db is not None:
+            db = jnp.maximum(db, db.max() - top_db)
+        return db
+
+    return apply("power_to_db", fn, spect)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm="ortho", dtype="float32"):
+    """functional.py create_dct parity: [n_mels, n_mfcc] DCT-II basis."""
+    import paddle_tpu as paddle
+
+    k = np.arange(n_mels)[:, None]
+    n = np.arange(n_mfcc)[None, :]
+    basis = np.cos(np.pi / n_mels * (k + 0.5) * n)
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(2)
+        basis *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return paddle.to_tensor(basis.astype(np.dtype(dtype)))

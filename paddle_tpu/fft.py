@@ -1,0 +1,64 @@
+"""paddle.fft parity (python/paddle/fft.py) over jnp.fft — every public
+transform in the reference's surface."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.registry import apply
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2",
+           "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _wrap1(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name_arg=None):
+        return apply(name, lambda a: fn(a, n=n, axis=axis, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+def _wrapn(name, fn, saxes=(-2, -1)):
+    def op(x, s=None, axes=saxes, norm="backward", name_arg=None):
+        return apply(name, lambda a: fn(a, s=s, axes=axes, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+fft2 = _wrapn("fft2", jnp.fft.fft2)
+ifft2 = _wrapn("ifft2", jnp.fft.ifft2)
+rfft2 = _wrapn("rfft2", jnp.fft.rfft2)
+irfft2 = _wrapn("irfft2", jnp.fft.irfft2)
+fftn = _wrapn("fftn", jnp.fft.fftn, None)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn, None)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn, None)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn, None)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    import paddle_tpu as paddle
+
+    return paddle.to_tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    import paddle_tpu as paddle
+
+    return paddle.to_tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)

@@ -1,0 +1,110 @@
+"""paddle.geometric parity (python/paddle/geometric/): graph message
+passing + segment ops, built on jax segment reductions (TPU-friendly
+scatter lowering)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops.registry import apply
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _segments(ids, n=None):
+    return int(n) if n is not None else None
+
+
+def _concrete_num_segments(s):
+    """Eager: max(ids)+1 (the reference's data-dependent output size).
+    Under jit tracing that size cannot be data-dependent on TPU (static
+    shapes) — raise with the workaround instead of silently mis-sizing."""
+    if isinstance(s, jax.core.Tracer):
+        raise ValueError(
+            "paddle.geometric.segment_* output size is data-dependent "
+            "(max(ids)+1) and cannot be traced under jit; call eagerly, or "
+            "use send_u_recv(..., out_size=N) which has a static size")
+    return int(jax.device_get(s).max()) + 1
+
+
+def segment_sum(data, segment_ids, name=None):
+    def fn(d, s):
+        return jax.ops.segment_sum(d, s, num_segments=_concrete_num_segments(s))
+
+    return apply("segment_sum", fn, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    def fn(d, s):
+        n = _concrete_num_segments(s)
+        tot = jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(s, d.dtype), s, num_segments=n)
+        shape = (-1,) + (1,) * (d.ndim - 1)
+        return tot / jnp.maximum(cnt, 1).reshape(shape)
+
+    return apply("segment_mean", fn, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    def fn(d, s):
+        return jax.ops.segment_max(d, s, num_segments=_concrete_num_segments(s))
+
+    return apply("segment_max", fn, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    def fn(d, s):
+        return jax.ops.segment_min(d, s, num_segments=_concrete_num_segments(s))
+
+    return apply("segment_min", fn, data, segment_ids)
+
+
+_POOLS = {"sum": jax.ops.segment_sum, "mean": None, "max": jax.ops.segment_max,
+          "min": jax.ops.segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """geometric/message_passing/send_recv.py parity: gather x[src], reduce
+    at dst."""
+
+    def fn(xv, src, dst):
+        n = int(out_size) if out_size is not None else xv.shape[0]
+        msgs = xv[src]
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(dst, xv.dtype), dst,
+                                      num_segments=n)
+            return tot / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (xv.ndim - 1))
+        return _POOLS[reduce_op](msgs, dst, num_segments=n)
+
+    return apply("send_u_recv", fn, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Edge-feature variant: combine x[src] with edge feature y."""
+
+    def fn(xv, yv, src, dst):
+        n = int(out_size) if out_size is not None else xv.shape[0]
+        m = xv[src]
+        msgs = {"add": m + yv, "sub": m - yv, "mul": m * yv,
+                "div": m / yv}[message_op]
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(dst, xv.dtype), dst,
+                                      num_segments=n)
+            return tot / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (xv.ndim - 1))
+        return _POOLS[reduce_op](msgs, dst, num_segments=n)
+
+    return apply("send_ue_recv", fn, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    def fn(xv, yv, src, dst):
+        a, b = xv[src], yv[dst]
+        return {"add": a + b, "sub": a - b, "mul": a * b,
+                "div": a / b}[message_op]
+
+    return apply("send_uv", fn, x, y, src_index, dst_index)

@@ -1,0 +1,22 @@
+"""paddle.incubate parity (python/paddle/incubate/): preview/fused APIs.
+
+The fused functional surface maps onto the Pallas kernels and XLA-fused
+compositions this framework already ships (SURVEY.md §2.8 incubate row:
+fused transformer/attention/MoE, memory-efficient attention).
+"""
+from . import nn  # noqa: F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    import paddle_tpu as paddle
+
+    return paddle.nn.functional.softmax(x + mask, axis=-1)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Old name of geometric.send_u_recv."""
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)

@@ -1,0 +1,4 @@
+"""paddle.incubate.nn parity: fused layers + functional."""
+from . import functional  # noqa: F401
+from .layers import (  # noqa: F401
+    FusedFeedForward, FusedLinear, FusedMultiHeadAttention)

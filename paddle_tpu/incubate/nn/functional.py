@@ -1,0 +1,227 @@
+"""paddle.incubate.nn.functional parity: fused functional ops.
+
+Each maps to a Pallas kernel (ops/pallas/) or an XLA-fused composition —
+the role of paddle/phi/kernels/fusion/ (SURVEY.md §2.2 fused kernels).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import apply
+from ...tensor_class import unwrap
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    """fusion/gpu rms_norm parity → Pallas rms_norm kernel."""
+    from ...ops.pallas import fused_norm
+
+    out = apply("fused_rms_norm",
+                lambda a, w: fused_norm.rms_norm(a, w, epsilon), x, norm_weight)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, **kw):
+    def fn(a, w, b):
+        mean = a.mean(-1, keepdims=True)
+        var = ((a - mean) ** 2).mean(-1, keepdims=True)
+        return (a - mean) * jax.lax.rsqrt(var + epsilon) * w + b
+
+    return apply("fused_layer_norm", fn, x, norm_weight, norm_bias)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """incubate fused_linear parity: one matmul+bias (XLA fuses the add)."""
+
+    def fn(a, w, *b):
+        wv = w.T if transpose_weight else w
+        out = a @ wv
+        return out + b[0] if b else out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply("fused_linear", fn, *args)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    def fn(a, w, b):
+        a = a.T if trans_x else a
+        w = w.T if trans_y else w
+        out = a @ w + b
+        return {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+                "none": lambda v: v}[activation](out)
+
+    return apply("fused_linear_activation", fn, x, y, bias)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    def fn(a, *b):
+        v = a + b[0] if b else a
+        return {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+                "swiglu": lambda t: jax.nn.silu(t[..., :t.shape[-1] // 2])
+                * t[..., t.shape[-1] // 2:]}[act_method](v)
+
+    args = (x,) + ((bias,) if bias is not None else ())
+    return apply("fused_bias_act", fn, *args)
+
+
+def swiglu(x, y=None, name=None):
+    """phi swiglu fusion parity: silu(x) * y (or split-x form)."""
+
+    if y is not None:
+        return apply("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)
+    return apply("swiglu",
+                 lambda a: jax.nn.silu(a[..., :a.shape[-1] // 2])
+                 * a[..., a.shape[-1] // 2:], x)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """fused_rope fusion parity → Pallas fused_rope kernel. q/k/v are
+    [B, S, H, D]. When sin/cos are omitted they are computed from the
+    default theta=10000 table (reference fused_rope kernel behaviour);
+    position_ids gathers per-token rows from the tables (decode path).
+    Only the neox (rotate-half) layout is implemented — the GPT-J
+    interleaved style raises."""
+    if not use_neox_rotary_style:
+        raise NotImplementedError(
+            "use_neox_rotary_style=False (interleaved rotary) is not "
+            "implemented; the neox rotate-half layout is")
+    from ...ops.pallas import fused_norm
+
+    seq = q.shape[1]
+    head_dim = q.shape[-1]
+    if (sin is None) != (cos is None):
+        raise ValueError("pass both sin and cos, or neither")
+    if sin is None:
+        table_len = seq
+        if position_ids is not None:
+            pid_arr = unwrap(position_ids)
+            if isinstance(pid_arr, jax.core.Tracer):
+                raise ValueError(
+                    "fused_rotary_position_embedding with position_ids and "
+                    "no sin/cos needs a concrete max position under jit — "
+                    "pass sin/cos tables explicitly")
+            table_len = max(seq, int(jax.device_get(pid_arr).max()) + 1)
+        pos = jnp.arange(table_len, dtype=jnp.float32)
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, head_dim, 2, jnp.float32)
+                                 / head_dim))
+        freqs = jnp.outer(pos, inv)
+        emb = jnp.concatenate([freqs, freqs], axis=-1)
+        cos_t, sin_t = jnp.cos(emb), jnp.sin(emb)
+    else:
+        cos_t = unwrap(cos).reshape(-1, head_dim)
+        sin_t = unwrap(sin).reshape(-1, head_dim)
+
+    def rope(t):
+        if t is None:
+            return None
+
+        def fn(a, c, s, *pid):
+            if pid:
+                c = c[pid[0]]  # [B, S, D] per-token gather
+                s = s[pid[0]]
+                half = a.shape[-1] // 2
+                a1, a2 = a[..., :half], a[..., half:]
+                cb, sb = c[:, :, None, :], s[:, :, None, :]
+                rot = jnp.concatenate([-a2, a1], axis=-1)
+                return a * cb + rot * sb
+            return fused_norm.fused_rope(a, c[:a.shape[1]], s[:a.shape[1]])
+
+        args = (t, cos_t, sin_t) + ((position_ids,)
+                                    if position_ids is not None else ())
+        return apply("fused_rope", fn, *args)
+
+    # v passes through: rotary covers q/k only (reference kernel semantics)
+    return rope(q), rope(k), v
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               ln_epsilon=1e-5, training=True, mode='upscale_in_train',
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """fused_attention kernel parity (phi fusion/fused_attention): pre-LN →
+    qkv proj → SDPA (flash path when available) → out proj → residual."""
+    import paddle_tpu as paddle
+    from ...nn.functional.attention import scaled_dot_product_attention
+
+    residual = x
+    if pre_layer_norm and pre_ln_scale is not None:
+        x = fused_layer_norm(x, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qkvw = unwrap(qkv_weight)
+    if transpose_qkv_wb:
+        # weight [embed, 3*embed] form
+        embed = qkvw.shape[0]
+        h = num_heads
+        qkv = paddle.matmul(x, qkv_weight)
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape([b, s, 3, h, embed // h])
+    else:
+        # reference layout: [3, n_heads, head_dim, embed]
+        three, h, hd, embed = qkvw.shape
+        qkv = apply("qkv_proj",
+                    lambda a, w: jnp.einsum("bse,thde->bsthd", a, w),
+                    x, qkv_weight)
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias.reshape([3, h, hd])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    out = scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                       dropout_p=attn_dropout_rate,
+                                       training=training)
+    b, s = out.shape[0], out.shape[1]
+    out = out.reshape([b, s, -1])
+    out = paddle.matmul(out, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm and ln_scale is not None:
+        out = fused_layer_norm(out, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode='upscale_in_train', ring_id=-1, name=None):
+    """fused_feedforward kernel parity."""
+    import paddle_tpu as paddle
+
+    residual = x
+    if pre_layer_norm and ln1_scale is not None:
+        x = fused_layer_norm(x, ln1_scale, ln1_bias, ln1_epsilon)
+    out = fused_linear(x, linear1_weight, linear1_bias)
+    out = getattr(paddle.nn.functional, activation)(out)
+    out = fused_linear(out, linear2_weight, linear2_bias)
+    out = residual + out
+    if not pre_layer_norm and ln2_scale is not None:
+        out = fused_layer_norm(out, ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_moe(x, gate_weight, expert_weights1, expert_biases1,
+              expert_weights2, expert_biases2, quant_method="None",
+              moe_topk=2, norm_topk_prob=True):
+    """cutlass fused_moe kernel parity → grouped-GEMM MoE
+    (distributed/moe.py GroupedMLP path)."""
+    from ...distributed.moe import MoELayer  # surface parity note
+
+    raise NotImplementedError(
+        "use paddle_tpu.distributed.moe.MoELayer(GroupedMLP) — the TPU "
+        "grouped-GEMM MoE with EP sharding; a stateless functional wrapper "
+        "is tracked for a later round")

@@ -1,0 +1,9 @@
+"""paddle.linalg namespace (python/paddle/linalg.py re-export pattern):
+the linear-algebra surface lives in ops/linalg.py; this module mirrors the
+reference's public module layout."""
+from .ops.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, diag_embed, diagonal,
+    eig, eigh, eigvals, eigvalsh, householder_product, inverse as inv, kron,
+    lstsq, lu, lu_unpack, matmul, matrix_norm, matrix_power, matrix_rank,
+    multi_dot, norm, pinv, qr, slogdet, solve, svd, svdvals,
+    triangular_solve, vector_norm)

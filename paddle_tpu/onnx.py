@@ -1,0 +1,17 @@
+"""paddle.onnx parity surface.
+
+The reference delegates paddle.onnx.export to the external paddle2onnx
+package (python/paddle/onnx/export.py); this build has no egress to fetch
+it, and the TPU-native deployment artifact is StableHLO
+(static.save_inference_model / jit.save). export() raises with that
+guidance rather than silently writing a wrong format.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export requires the external paddle2onnx toolchain (the "
+        "reference shells out to it too). On the TPU build, export a "
+        "deployable artifact with paddle.static.save_inference_model "
+        "(StableHLO via jax.export) or paddle.jit.save instead.")

@@ -90,9 +90,6 @@ def norm(x, p=None, axis=None, keepdim=False, name=None):
     return apply("norm", fn, x)
 
 
-vector_norm = norm
-
-
 def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
     return norm(x, p, list(axis), keepdim, name)
 
@@ -159,9 +156,12 @@ def qr(x, mode="reduced", name=None):
 
 
 def svd(x, full_matrices=False, name=None):
+    """Returns (U, S, VH) — VH is V conjugate-transposed, matching the
+    reference (python/paddle/tensor/linalg.py svd Returns: 'VH is the
+    conjugate transpose of V')."""
+
     def fn(a):
-        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
-        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+        return jnp.linalg.svd(a, full_matrices=full_matrices)
 
     return apply("svd", fn, x)
 
@@ -305,3 +305,65 @@ def householder_product(x, tau):
     taub = tau.reshape((-1, n))
     out = jax.vmap(single)(batch, taub)
     return out.reshape(x.shape[:-2] + (m, n))
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """paddle.linalg.lu_unpack parity: split packed LU into (P, L, U);
+    unrequested parts are skipped (and returned as None)."""
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+
+    def fn_lu(a):
+        l = jnp.tril(a, -1)[..., :, :k] + jnp.eye(m, k, dtype=a.dtype)
+        u = jnp.triu(a)[..., :k, :]
+        return l, u
+
+    def fn_p(a, piv_all):
+        # pivots (1-based row swaps) → permutation matrix
+        def perm_of(piv):
+            p = jnp.arange(m)
+
+            def body(i, p):
+                j = piv[i] - 1
+                pi, pj = p[i], p[j]
+                return p.at[i].set(pj).at[j].set(pi)
+
+            p = jax.lax.fori_loop(0, piv.shape[0], body, p)
+            return jnp.eye(m, dtype=a.dtype)[p].T
+
+        if piv_all.ndim == 1:
+            return perm_of(piv_all)
+        return jax.vmap(perm_of)(piv_all.reshape(-1, piv_all.shape[-1])).reshape(
+            a.shape[:-2] + (m, m))
+
+    p = apply("lu_unpack_p", fn_p, x, y, differentiable=False) \
+        if unpack_pivots else None
+    if unpack_ludata:
+        l, u = apply("lu_unpack_lu", fn_lu, x, differentiable=False)
+    else:
+        l = u = None
+    return p, l, u
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """paddle.linalg.vector_norm parity: always a VECTOR p-norm, even over
+    multiple axes (unlike jnp.linalg.norm, which reads a 2-axis tuple as a
+    matrix norm)."""
+
+    def fn(a):
+        axes = (tuple(range(a.ndim)) if axis is None
+                else tuple(axis) if isinstance(axis, (list, tuple))
+                else (axis,))
+        if p == float("inf"):
+            out = jnp.abs(a).max(axis=axes, keepdims=keepdim)
+        elif p == float("-inf"):
+            out = jnp.abs(a).min(axis=axes, keepdims=keepdim)
+        elif p == 0:
+            out = (a != 0).astype(a.dtype).sum(axis=axes, keepdims=keepdim)
+        else:
+            out = jnp.power(
+                jnp.sum(jnp.power(jnp.abs(a), p), axis=axes, keepdims=keepdim),
+                1.0 / p)
+        return out
+
+    return apply("vector_norm", fn, x)

@@ -89,7 +89,9 @@ def apply(name: str, fn: Callable, *args, differentiable: bool = True, n_outputs
         a2, k2 = jax.tree_util.tree_unflatten(treedef, arrays)
         out = fn(*a2, **k2)
         _check_nan_inf(name, out)
-        return _wrap_outputs(out, stop_gradient=True)
+        wrapped = _wrap_outputs(out, stop_gradient=True)
+        _static_record(name, fn, treedef, leaves, tensor_idx, wrapped, None)
+        return wrapped
 
     diff_idx = [
         i
@@ -118,7 +120,29 @@ def apply(name: str, fn: Callable, *args, differentiable: bool = True, n_outputs
             t.stop_gradient = True
     if tracked:
         _tape.record(pure, diff_arrays, diff_tensors, out_tensors, name=name)
+    _static_record(name, fn, treedef, leaves, tensor_idx, wrapped, out_tensors)
     return wrapped
+
+
+def _static_record(name, fn, treedef, leaves, tensor_idx, wrapped,
+                   out_tensors):
+    """Static-graph capture (paddle.static Program): record this op when a
+    Program is active. Zero-cost when static mode is off (one sys.modules
+    probe — recording can only be active once paddle.static was imported);
+    the recorder is the TPU build's analog of PIR op capture. ``out_tensors``
+    is the already-flattened output list when the caller has it."""
+    import sys
+
+    _prog = sys.modules.get("paddle_tpu.static.program")
+    if _prog is None:
+        return
+    p = _prog.current_program()
+    if p is None:
+        return
+    if out_tensors is None:
+        out_tensors = [t for t in jax.tree_util.tree_leaves(
+            wrapped, is_leaf=_is_tensor_leaf) if isinstance(t, Tensor)]
+    p.record(name, fn, treedef, leaves, tensor_idx, out_tensors)
 
 
 def _check_nan_inf(name, out):

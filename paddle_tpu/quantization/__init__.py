@@ -1,0 +1,222 @@
+"""paddle.quantization parity (python/paddle/quantization/): QuantConfig +
+QAT (fake-quant training) and PTQ (observe → convert).
+
+TPU note: fake-quant is pure elementwise math, so under jit XLA fuses it
+into the surrounding matmuls; int8 *execution* is a serving-stack concern
+(tracked gap), simulation semantics match the reference's QAT/PTQ.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..nn.layer import Layer
+from ..ops.registry import apply
+from ..tensor_class import Tensor, unwrap, wrap
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
+            "AbsMaxObserver", "QuanterFactory", "quanter"]
+
+
+def _fake_quant(x, scale, bits=8):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.clip(jnp.round(x / s * qmax), -qmax - 1, qmax) * s / qmax
+
+
+class QuanterFactory:
+    def __init__(self, cls, **kwargs):
+        self._cls = cls
+        self._kwargs = kwargs
+
+    def instance(self, layer=None):
+        return self._cls(**self._kwargs)
+
+
+def quanter(name):  # decorator parity (quantization/factory.py)
+    def deco(cls):
+        return cls
+
+    return deco
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT activation/weight quanter (fake_quanter.py parity): moving
+    average abs-max scale + straight-through-estimator rounding."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self._scale = None
+
+    def forward(self, x):
+        absmax = float(jnp.max(jnp.abs(unwrap(x))))
+        if self._scale is None:
+            self._scale = absmax
+        elif self.training:
+            self._scale = (self.moving_rate * self._scale
+                           + (1 - self.moving_rate) * absmax)
+        scale, bits = self._scale, self.bit_length
+
+        def fn(a):
+            q = _fake_quant(a, jnp.asarray(scale, a.dtype), bits)
+            # straight-through estimator: identity gradient
+            return a + jax.lax.stop_gradient(q - a)
+
+        import jax
+
+        return apply("fake_quant", fn, x)
+
+    def scales(self):
+        return self._scale
+
+
+class AbsMaxObserver(Layer):
+    """PTQ observer (observers/abs_max.py parity): track abs-max, no
+    quantization during calibration."""
+
+    def __init__(self, quant_bits=8, name=None):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def forward(self, x):
+        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(unwrap(x)))))
+        return x
+
+    def scales(self):
+        return self._absmax
+
+
+class QuantConfig:
+    """config.py parity: which quanters apply to activations/weights, with
+    per-layer overrides."""
+
+    def __init__(self, activation: Optional[QuanterFactory],
+                 weight: Optional[QuanterFactory]):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs = []
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        self._layer_configs.append((layers, activation, weight))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        self._layer_configs.append((types, activation, weight))
+
+    def _factories_for(self, layer):
+        for targets, act, wt in self._layer_configs:
+            for t in targets:
+                if layer is t or (isinstance(t, type) and isinstance(layer, t)):
+                    return act or self.activation, wt or self.weight
+        return self.activation, self.weight
+
+
+class QuantedLinear(Layer):
+    """Quantized stand-in for nn.Linear (nn/quant/qat/linear.py parity)."""
+
+    def __init__(self, inner: "nn.Linear", act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        import paddle_tpu as paddle
+
+        out = paddle.matmul(x, w)
+        if self.inner.bias is not None:
+            out = out + self.inner.bias
+        return out
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, inner: "nn.Conv2D", act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        from ..nn.functional import conv as F_conv
+
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        i = self.inner
+        return F_conv.conv2d(x, w, i.bias, i._stride, i._padding, i._dilation,
+                             i._groups, i._data_format)
+
+
+_QUANTABLE = {}
+
+
+def _swap(model: Layer, config: QuantConfig, observer_only: bool):
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, nn.Linear):
+            act_f, wt_f = config._factories_for(sub)
+            model._sub_layers[name] = QuantedLinear(
+                sub, act_f.instance(sub) if act_f else None,
+                wt_f.instance(sub) if wt_f and not observer_only else None)
+        elif isinstance(sub, nn.Conv2D):
+            act_f, wt_f = config._factories_for(sub)
+            model._sub_layers[name] = QuantedConv2D(
+                sub, act_f.instance(sub) if act_f else None,
+                wt_f.instance(sub) if wt_f and not observer_only else None)
+        else:
+            _swap(sub, config, observer_only)
+    return model
+
+
+class QAT:
+    """qat.py parity: model → fake-quant model for quant-aware training."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        import copy
+
+        target = model if inplace else copy.deepcopy(model)
+        return _swap(target, self.config, observer_only=False)
+
+
+class PTQ:
+    """ptq.py parity: insert observers, calibrate, convert."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        import copy
+
+        target = model if inplace else copy.deepcopy(model)
+        return _swap(target, self.config, observer_only=True)
+
+    def convert(self, model: Layer, inplace=False):
+        """Bake observed scales: weights round-trip through int8 grid."""
+        import copy
+
+        target = model if inplace else copy.deepcopy(model)
+        for _, sub in target.named_sublayers(include_self=True):
+            if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+                w = sub.inner.weight
+                absmax = float(jnp.max(jnp.abs(unwrap(w))))
+                q = _fake_quant(unwrap(w), jnp.asarray(absmax, "float32"))
+                w.set_value(np.asarray(q))
+        return target

@@ -1,0 +1,113 @@
+"""paddle.signal parity (python/paddle/signal.py): stft / istft frame ops."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.registry import apply
+from .tensor_class import unwrap
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames along ``axis`` (signal.py frame parity)."""
+
+    def fn(a):
+        n = a.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        moved = jnp.moveaxis(a, axis, -1)
+        framed = moved[..., idx]  # [..., num, frame_length]
+        if axis in (-1, a.ndim - 1):
+            return jnp.swapaxes(framed, -1, -2)  # [..., frame_length, num]
+        return jnp.moveaxis(jnp.swapaxes(framed, -1, -2), -1, axis)
+
+    return apply("frame", fn, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (signal.py overlap_add parity); x[..., fl, frames]."""
+
+    def fn(a):
+        moved = jnp.moveaxis(a, axis, -1) if axis not in (-1, a.ndim - 1) else a
+        fl, num = moved.shape[-2], moved.shape[-1]
+        out_len = fl + hop_length * (num - 1)
+        out = jnp.zeros(moved.shape[:-2] + (out_len,), moved.dtype)
+        for i in range(num):  # static python loop — num is trace-static
+            out = out.at[..., i * hop_length:i * hop_length + fl].add(
+                moved[..., i])
+        return out
+
+    return apply("overlap_add", fn, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """signal.py stft parity: [B, N] (or [N]) → complex spectrogram
+    [B, n_fft//2+1, frames] (onesided)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(a, *w):
+        sig = a[None] if a.ndim == 1 else a
+        if center:
+            pad = n_fft // 2
+            sig = jnp.pad(sig, [(0, 0), (pad, pad)], mode=pad_mode)
+        win = w[0] if w else jnp.ones(win_length, sig.dtype)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(num) * hop_length
+        frames = sig[:, starts[:, None] + jnp.arange(n_fft)[None, :]]  # [B,F,n_fft]
+        frames = frames * win[None, None, :]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -1, -2)  # [B, freq, frames]
+        return out[0] if a.ndim == 1 else out
+
+    args = (x,) if window is None else (x, window)
+    return apply("stft", fn, *args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """signal.py istft parity (inverse via overlap-add with window-square
+    normalization)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(a, *w):
+        spec = a[None] if a.ndim == 2 else a  # [B, freq, frames]
+        spec = jnp.swapaxes(spec, -1, -2)     # [B, frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        win = w[0] if w else jnp.ones(win_length, frames.dtype)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+        frames = frames * win[None, None, :]
+        num = frames.shape[1]
+        out_len = n_fft + hop_length * (num - 1)
+        out = jnp.zeros(frames.shape[:1] + (out_len,), frames.dtype)
+        wsum = jnp.zeros(out_len, frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[:, sl].add(frames[:, i])
+            wsum = wsum.at[sl].add(win * win)
+        out = out / jnp.maximum(wsum, 1e-10)[None]
+        if center:
+            pad = n_fft // 2
+            out = out[:, pad:out_len - pad]
+        if length is not None:
+            out = out[:, :length]
+        return out[0] if a.ndim == 2 else out
+
+    args = (x,) if window is None else (x, window)
+    return apply("istft", fn, *args)

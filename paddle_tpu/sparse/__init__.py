@@ -1,0 +1,245 @@
+"""paddle.sparse parity (python/paddle/sparse/, phi sparse kernels
+paddle/phi/kernels/sparse/ — SURVEY.md §2.2).
+
+TPU-native: sparse tensors wrap jax.experimental.sparse BCOO/BCSR; unary
+math runs on the values, matmul goes through the BCOO matmul lowering
+(which XLA executes as gather/scatter + dense MXU tiles).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..tensor_class import Tensor, unwrap, wrap
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "is_same_shape",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "relu", "abs", "sin", "tanh", "sqrt", "pow", "neg", "cast",
+    "transpose", "sum", "nn",
+]
+
+
+class SparseTensor(Tensor):
+    """A Tensor whose _array is a jax BCOO/BCSR. Dense-only methods fall
+    back through to_dense()."""
+
+    def __init__(self, sp, stop_gradient=True):
+        self._array = sp
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.name = None
+
+    # paddle Tensor sparse surface
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        from jax.experimental import sparse as jsp
+
+        return isinstance(self._array, jsp.BCOO)
+
+    def is_sparse_csr(self):
+        from jax.experimental import sparse as jsp
+
+        return isinstance(self._array, jsp.BCSR)
+
+    def to_dense(self):
+        return wrap(self._array.todense(), self.stop_gradient)
+
+    def values(self):
+        return wrap(self._array.data, self.stop_gradient)
+
+    def indices(self):
+        import jax.numpy as jnp
+
+        return wrap(jnp.swapaxes(self._array.indices, -1, -2))
+
+    def crows(self):
+        return wrap(self._array.indptr)
+
+    def cols(self):
+        return wrap(self._array.indices)
+
+    def nnz(self):
+        return int(self._array.nse)
+
+    def numpy(self):
+        return np.asarray(self._array.todense())
+
+    def __repr__(self):
+        kind = "coo" if self.is_sparse_coo() else "csr"
+        return (f"SparseTensor({kind}, shape={list(self._array.shape)}, "
+                f"nnz={self.nnz()})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """paddle.sparse.sparse_coo_tensor parity: indices [ndim, nnz]."""
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsp
+
+    idx = jnp.asarray(unwrap(indices)).T  # BCOO wants [nnz, ndim]
+    vals = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=0))
+    sp = jsp.BCOO((vals, idx), shape=tuple(shape))
+    return SparseTensor(sp, stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsp
+
+    vals = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    sp = jsp.BCSR((vals, jnp.asarray(unwrap(cols)),
+                   jnp.asarray(unwrap(crows))), shape=tuple(shape))
+    return SparseTensor(sp, stop_gradient)
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x._array.shape) == tuple(y._array.shape)
+
+
+def _coo(x):
+    from jax.experimental import sparse as jsp
+
+    a = x._array
+    return a if isinstance(a, jsp.BCOO) else a.to_bcoo()
+
+
+def _unary(fn_name):
+    import jax.numpy as jnp
+
+    fn = getattr(jnp, fn_name)
+
+    def op(x, name=None):
+        sp = _coo(x)
+        out = sp.__class__((fn(sp.data), sp.indices), shape=sp.shape)
+        return SparseTensor(out, x.stop_gradient)
+
+    op.__name__ = fn_name
+    return op
+
+
+sin = _unary("sin")
+tanh = _unary("tanh")
+sqrt = _unary("sqrt")
+abs = _unary("abs")
+
+
+def neg(x, name=None):
+    sp = _coo(x)
+    return SparseTensor(sp.__class__((-sp.data, sp.indices), shape=sp.shape),
+                        x.stop_gradient)
+
+
+def pow(x, factor, name=None):
+    sp = _coo(x)
+    return SparseTensor(sp.__class__((sp.data ** factor, sp.indices),
+                                     shape=sp.shape), x.stop_gradient)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+
+    sp = _coo(x)
+    data = sp.data if value_dtype is None else sp.data.astype(
+        convert_dtype(value_dtype))
+    idx = sp.indices if index_dtype is None else sp.indices.astype(
+        convert_dtype(index_dtype))
+    return SparseTensor(sp.__class__((data, idx), shape=sp.shape),
+                        x.stop_gradient)
+
+
+def relu(x, name=None):
+    import jax.numpy as jnp
+
+    sp = _coo(x)
+    return SparseTensor(sp.__class__((jnp.maximum(sp.data, 0), sp.indices),
+                                     shape=sp.shape), x.stop_gradient)
+
+
+def _binary(opname, jop):
+    def op(x, y, name=None):
+        from jax.experimental import sparse as jsp
+
+        if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+            # same-pattern fast path, else densify (reference CPU kernels
+            # merge patterns; pattern-union on TPU would be scatter-heavy)
+            xs, ys = _coo(x), _coo(y)
+            import jax.numpy as jnp
+
+            if xs.indices.shape == ys.indices.shape and bool(
+                    jnp.all(xs.indices == ys.indices)):
+                return SparseTensor(
+                    xs.__class__((jop(xs.data, ys.data), xs.indices),
+                                 shape=xs.shape), x.stop_gradient)
+            dense = jop(xs.todense(), ys.todense())
+            return wrap(dense)
+        raise TypeError(f"sparse.{opname} expects two sparse tensors")
+
+    op.__name__ = opname
+    return op
+
+
+import jax.numpy as _jnp  # noqa: E402
+
+add = _binary("add", _jnp.add)
+subtract = _binary("subtract", _jnp.subtract)
+multiply = _binary("multiply", _jnp.multiply)
+divide = _binary("divide", _jnp.divide)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (or sparse @ sparse → dense)."""
+    from jax.experimental import sparse as jsp
+
+    if isinstance(x, SparseTensor):
+        xs = _coo(x)
+        yv = _coo(y) if isinstance(y, SparseTensor) else unwrap(y)
+        out = xs @ yv
+        if isinstance(out, jsp.BCOO):
+            return SparseTensor(out)
+        return wrap(out)
+    raise TypeError("sparse.matmul expects a sparse lhs")
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at mask's nonzeros (sddmm)."""
+    import jax.numpy as jnp
+
+    ms = _coo(mask)
+    xv, yv = unwrap(x), unwrap(y)
+    rows, cols = ms.indices[:, 0], ms.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseTensor(ms.__class__((vals, ms.indices), shape=ms.shape))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    import jax.numpy as jnp
+
+    sp = _coo(x)
+    if axis is None:
+        out = sp.data.sum()
+        return wrap(out if not keepdim else out.reshape((1,) * len(sp.shape)))
+    return wrap(jnp.sum(sp.todense(), axis=axis, keepdims=keepdim))
+
+
+class nn:
+    """paddle.sparse.nn subset: ReLU layer (conv3d submanifold kernels are
+    a tracked gap — SURVEY §2.2 sparse conv)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

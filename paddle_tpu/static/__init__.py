@@ -1,0 +1,110 @@
+"""paddle.static parity (python/paddle/static/).
+
+Program/Executor over an op recorder + XLA (see program.py);
+save_inference_model exports the compiled graph as serialized StableHLO
+via jax.export — the deployment artifact role of the reference's
+save_inference_model (inference program + params) with an XLA-native
+format.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Sequence
+
+import numpy as np
+
+from .program import (  # noqa: F401
+    Executor, Program, current_program, data, default_main_program,
+    default_startup_program, disable_static, enable_static, in_static_mode,
+    program_guard)
+from ..jit import InputSpec  # noqa: F401
+
+
+class CompiledProgram:
+    """API-shape parity; Program.compiled already caches executables."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs) -> None:
+    """Serialize the (feed → fetch) computation as StableHLO + metadata.
+
+    Files: {path_prefix}.stablehlo (jax.export bytes), {path_prefix}.meta
+    (feed names/specs). Loadable by load_inference_model on any machine
+    with a compatible jax — the params are baked into the artifact like
+    the reference's combined save.
+    """
+    import jax
+    from jax import export as jexport
+
+    program = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    name_of = {tid: n for n, tid in program.feeds.items()}
+    feed_names = [name_of[id(v)] for v in feed_vars]
+    feed_names_sorted = sorted(feed_names)
+    fetch_ids = [id(v) for v in fetch_vars]
+
+    fn = program.as_function(feed_names_sorted, fetch_ids)
+    by_name = {name_of[id(v)]: v for v in feed_vars}
+    specs = []
+    for n in feed_names_sorted:
+        from ..tensor_class import unwrap
+
+        arr = unwrap(by_name[n])
+        specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+    exported = jexport.export(jax.jit(fn))(*specs)
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".stablehlo", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".meta", "wb") as f:
+        pickle.dump({"feed_names": feed_names_sorted,
+                     "num_fetch": len(fetch_vars)}, f)
+
+
+class _LoadedPredictor:
+    def __init__(self, exported, feed_names):
+        self._exported = exported
+        self.feed_names = feed_names
+
+    def run(self, feeds: Sequence[np.ndarray]):
+        from jax import export as jexport  # noqa: F401
+
+        outs = self._exported.call(*[np.asarray(a) for a in feeds])
+        return [np.asarray(o) for o in outs]
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Returns [predictor, feed_target_names, fetch_count] (shape parity
+    with the reference's [program, feed_names, fetch_targets])."""
+    from jax import export as jexport
+
+    with open(path_prefix + ".stablehlo", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path_prefix + ".meta", "rb") as f:
+        meta = pickle.load(f)
+    pred = _LoadedPredictor(exported, meta["feed_names"])
+    return [pred, meta["feed_names"], meta["num_fetch"]]
+
+
+# name re-exports the reference also offers under paddle.static
+class nn:
+    """paddle.static.nn subset: fc/embedding map onto the dygraph layers
+    (static graphs record through them transparently)."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        import paddle_tpu as paddle
+        from .. import nn as dynn
+
+        in_f = int(np.prod(x.shape[num_flatten_dims:]))
+        layer = dynn.Linear(in_f, size)
+        out = layer(x.reshape(list(x.shape[:num_flatten_dims]) + [in_f]))
+        if activation:
+            out = getattr(paddle.nn.functional, activation)(out)
+        return out

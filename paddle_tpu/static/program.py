@@ -1,0 +1,214 @@
+"""Static graph: Program recorder + Executor.
+
+Reference parity: paddle.static (python/paddle/static/) — Program /
+program_guard / data / Executor.run(feed, fetch_list) — and the PIR
+static-execution spine (SURVEY.md CS3: build program → lower → interpret).
+
+TPU-native design: a Program records every eager op (the recorder hooks
+``ops.registry.apply``, the single choke point every op goes through —
+the role PIR op capture plays in the reference). ``Executor.run`` replays
+the recorded graph as a pure function of the feed arrays and ``jax.jit``s
+it — so the "interpreter" is XLA itself: one compiled executable per
+(program, feed shapes/dtypes), cached like the reference's _ExecutorCache
+(executor.py:871). Parameters are captured by value at record time; for
+training use paddle.jit.to_static / distributed.engine (the dygraph path).
+
+Limitation (documented): ops record with placeholder values flowing
+through, so Python-level data-dependent control flow inside the recorded
+region bakes the placeholder branch — same caveat as the reference's
+dy2static AST path, resolved the same way (use cond/where ops).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..tensor_class import Tensor, unwrap, wrap
+
+
+class _Node:
+    __slots__ = ("name", "fn", "treedef", "leaves", "tensor_pos", "in_ids",
+                 "out_ids")
+
+    def __init__(self, name, fn, treedef, leaves, tensor_pos, in_ids, out_ids):
+        self.name = name
+        self.fn = fn
+        self.treedef = treedef
+        self.leaves = leaves          # leaf list; tensor slots hold arrays
+        self.tensor_pos = tensor_pos  # leaf indices that are graph tensors
+        self.in_ids = in_ids          # tensor id per tensor_pos entry
+        self.out_ids = out_ids        # flattened output tensor ids
+
+
+class Program:
+    """paddle.static.Program parity: an op-recording container."""
+
+    def __init__(self):
+        self.nodes: List[_Node] = []
+        self.feeds: Dict[str, int] = {}      # name -> placeholder tensor id
+        self.feed_specs: Dict[str, tuple] = {}  # name -> (shape, dtype)
+        self._cache = {}
+        # strong refs to every graph tensor: ids are the graph's identity
+        # keys, so the objects must outlive the Program (id reuse after GC
+        # would silently cross wires)
+        self._keepalive: List = []
+
+    # -- recording -----------------------------------------------------------
+    def record(self, name, fn, treedef, leaves, tensor_idx, out_tensors):
+        tensor_pos, in_ids, stored = [], [], list(leaves)
+        for i in tensor_idx:
+            t = leaves[i]
+            tensor_pos.append(i)
+            in_ids.append(id(t))
+            stored[i] = t._array  # captured value (params/consts)
+        out_ids = [id(t) for t in out_tensors]
+        self._keepalive.extend(leaves[i] for i in tensor_idx)
+        self._keepalive.extend(out_tensors)
+        self.nodes.append(_Node(name, fn, treedef, stored, tensor_pos,
+                                in_ids, out_ids))
+        self._cache.clear()
+
+    def global_block(self):  # API-shape parity
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+
+        p = Program()
+        p.nodes = list(self.nodes)
+        p.feeds = dict(self.feeds)
+        p.feed_specs = dict(self.feed_specs)
+        return p
+
+    def __repr__(self):
+        ops = ", ".join(n.name for n in self.nodes[:8])
+        more = "..." if len(self.nodes) > 8 else ""
+        return (f"Program({len(self.nodes)} ops: {ops}{more}; "
+                f"feeds={list(self.feeds)})")
+
+    # -- replay --------------------------------------------------------------
+    def as_function(self, feed_names: Sequence[str],
+                    fetch_ids: Sequence[int]):
+        """Pure (feed arrays...) -> (fetch arrays...) replay of the graph."""
+
+        def run(*feed_arrays):
+            env = {self.feeds[n]: a for n, a in zip(feed_names, feed_arrays)}
+            for node in self.nodes:
+                leaves = list(node.leaves)
+                for pos, tid in zip(node.tensor_pos, node.in_ids):
+                    if tid in env:
+                        leaves[pos] = env[tid]
+                args, kwargs = jax.tree_util.tree_unflatten(node.treedef, leaves)
+                out = node.fn(*args, **kwargs)
+                flat = [o for o in jax.tree_util.tree_leaves(out)]
+                for tid, arr in zip(node.out_ids, flat):
+                    env[tid] = arr
+            missing = [i for i in fetch_ids if i not in env]
+            if missing:
+                raise ValueError(
+                    "fetch target was not produced by this program (was it "
+                    "created outside program_guard?)")
+            return tuple(env[i] for i in fetch_ids)
+
+        return run
+
+    def compiled(self, feed_names, fetch_ids, shapes_key):
+        key = (tuple(feed_names), tuple(fetch_ids), shapes_key)
+        if key not in self._cache:
+            self._cache[key] = jax.jit(self.as_function(feed_names, fetch_ids))
+        return self._cache[key]
+
+
+_default_program = Program()
+_startup_program = Program()
+_active: List[Optional[Program]] = [None]
+_static_mode = [False]
+
+
+def default_main_program() -> Program:
+    return _default_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def current_program() -> Optional[Program]:
+    if _active[0] is not None:
+        return _active[0]
+    return _default_program if _static_mode[0] else None
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Program = None):
+    prev = _active[0]
+    _active[0] = main_program
+    try:
+        yield
+    finally:
+        _active[0] = prev
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode[0] or _active[0] is not None
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """paddle.static.data parity: a named placeholder. Unknown dims (-1 or
+    None) trace as size 1; the jitted replay re-specializes per fed shape."""
+    import jax.numpy as jnp
+
+    prog = current_program()
+    if prog is None:
+        raise RuntimeError(
+            "paddle.static.data requires static mode (enable_static or "
+            "program_guard)")
+    concrete = [1 if (d is None or d < 0) else int(d) for d in shape]
+    from ..framework.dtype import convert_dtype
+
+    t = wrap(jnp.zeros(concrete, convert_dtype(dtype)), stop_gradient=True)
+    prog.feeds[name] = id(t)
+    prog.feed_specs[name] = (tuple(shape), str(dtype))
+    prog._keepalive.append(t)
+    return t
+
+
+class Executor:
+    """paddle.static.Executor parity (executor.py:1234 run surface)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        feed_names = sorted(feed.keys())
+        unknown = [n for n in feed_names if n not in program.feeds]
+        if unknown:
+            raise KeyError(f"feed names {unknown} not declared via "
+                           f"paddle.static.data in this program")
+        fetch_ids = [id(f) if isinstance(f, Tensor) else id(f)
+                     for f in fetch_list]
+        arrays = [np.asarray(feed[n]) for n in feed_names]
+        shapes_key = tuple((a.shape, str(a.dtype)) for a in arrays)
+        fn = program.compiled(feed_names, fetch_ids, shapes_key)
+        outs = fn(*arrays)
+        if return_numpy:
+            return [np.asarray(jax.device_get(o)) for o in outs]
+        return [wrap(o) for o in outs]
+
+    def close(self):
+        ...

@@ -1,0 +1,327 @@
+"""static Program/Executor, sparse, linalg/fft/signal, quantization,
+geometric, audio, incubate.
+
+Parity model: test/legacy_test static executor tests (feed/fetch), sparse
+op tests, OpTest-style numpy references.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+# ---- static ------------------------------------------------------------------
+
+def test_static_program_executor():
+    import paddle_tpu.static as static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [-1, 4])
+        w = paddle.to_tensor(np.ones((4, 2), np.float32))
+        y = paddle.matmul(x, w) + 1.0
+    exe = static.Executor()
+    feed = np.arange(8, dtype=np.float32).reshape(2, 4)
+    out, = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+    np.testing.assert_allclose(out, feed @ np.ones((4, 2), np.float32) + 1.0)
+    # different batch size re-specializes
+    feed3 = np.ones((3, 4), np.float32)
+    out3, = exe.run(prog, feed={"x": feed3}, fetch_list=[y])
+    assert out3.shape == (3, 2)
+
+
+def test_static_layer_graph_and_enable_static():
+    import paddle_tpu.static as static
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    static.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4])
+            y = net(x)
+        exe = static.Executor()
+        feed = np.random.randn(5, 4).astype(np.float32)
+        out, = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+    finally:
+        static.disable_static()
+    net.eval()
+    ref = net(paddle.to_tensor(feed)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_static_errors():
+    import paddle_tpu.static as static
+
+    with pytest.raises(RuntimeError):
+        static.data("x", [2, 2])  # outside static mode
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 2])
+        y = x + 1.0
+    exe = static.Executor()
+    with pytest.raises(KeyError):
+        exe.run(prog, feed={"bogus": np.zeros((2, 2), np.float32)},
+                fetch_list=[y])
+
+
+def test_save_load_inference_model(tmp_path):
+    import paddle_tpu.static as static
+
+    paddle.seed(1)
+    net = nn.Linear(4, 3)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 4])
+        y = net(x)
+    exe = static.Executor()
+    prefix = str(tmp_path / "model")
+    static.save_inference_model(prefix, [x], [y], exe, program=prog)
+
+    pred, feed_names, n_fetch = static.load_inference_model(prefix)
+    assert feed_names == ["x"] and n_fetch == 1
+    feed = np.random.randn(2, 4).astype(np.float32)
+    out, = pred.run([feed])
+    net.eval()
+    np.testing.assert_allclose(out, net(paddle.to_tensor(feed)).numpy(),
+                               rtol=1e-5)
+
+
+# ---- sparse ------------------------------------------------------------------
+
+def test_sparse_coo_roundtrip_and_ops():
+    import paddle_tpu.sparse as sp
+
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    s = sp.sparse_coo_tensor(indices, values, shape=[3, 3])
+    assert s.is_sparse_coo() and s.nnz() == 3
+    dense = s.to_dense().numpy()
+    assert dense[0, 1] == 1.0 and dense[2, 0] == 3.0
+
+    r = sp.relu(sp.neg(s))
+    assert float(r.values().numpy().max()) == 0.0  # all values were positive
+
+    two = sp.add(s, s)
+    np.testing.assert_allclose(two.to_dense().numpy(), dense * 2)
+
+
+def test_sparse_matmul_and_csr():
+    import paddle_tpu.sparse as sp
+
+    s = sp.sparse_coo_tensor([[0, 1], [1, 0]], [2.0, 3.0], shape=[2, 2])
+    d = paddle.to_tensor(np.eye(2, dtype=np.float32))
+    out = sp.matmul(s, d)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               [[0, 2], [3, 0]])
+
+    csr = sp.sparse_csr_tensor([0, 1, 2], [1, 0], [2.0, 3.0], [2, 2])
+    assert csr.is_sparse_csr()
+    np.testing.assert_allclose(csr.to_dense().numpy(), [[0, 2], [3, 0]])
+
+
+# ---- linalg / fft / signal ---------------------------------------------------
+
+def test_linalg_namespace():
+    import paddle_tpu.linalg as L
+
+    a = np.random.randn(3, 3).astype(np.float32)
+    a = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    x = paddle.to_tensor(a)
+    inv = L.inv(x).numpy()
+    np.testing.assert_allclose(inv @ a, np.eye(3), atol=1e-4)
+    u, s, vh = (t.numpy() for t in L.svd(x))
+    np.testing.assert_allclose((u * s[..., None, :]) @ vh, a, atol=1e-4)
+    p, l_, u_ = (t.numpy() for t in L.lu_unpack(*L.lu(x)))
+    np.testing.assert_allclose(p @ l_ @ u_, a, atol=1e-4)
+
+
+def test_fft_roundtrip():
+    import paddle_tpu.fft as fft
+
+    x = np.random.randn(8).astype(np.float32)
+    X = fft.fft(paddle.to_tensor(x))
+    back = fft.ifft(X).numpy()
+    np.testing.assert_allclose(back.real, x, atol=1e-5)
+    f = fft.rfftfreq(8, d=0.5).numpy()
+    np.testing.assert_allclose(f, np.fft.rfftfreq(8, 0.5))
+
+
+def test_stft_istft_roundtrip():
+    import paddle_tpu.signal as signal
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 512)).astype(np.float32)
+    spec = signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16)
+    assert list(spec.shape) == [2, 33, 512 // 16 + 1]
+    rec = signal.istft(spec, n_fft=64, hop_length=16, length=512).numpy()
+    np.testing.assert_allclose(rec, x, atol=1e-4)
+
+
+# ---- quantization ------------------------------------------------------------
+
+def test_qat_and_ptq():
+    from paddle_tpu.quantization import (
+        AbsMaxObserver, FakeQuanterWithAbsMaxObserver, PTQ, QAT, QuantConfig,
+        QuanterFactory)
+
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+    ref = net(x).numpy()
+
+    qat_cfg = QuantConfig(
+        activation=QuanterFactory(FakeQuanterWithAbsMaxObserver),
+        weight=QuanterFactory(FakeQuanterWithAbsMaxObserver))
+    qmodel = QAT(qat_cfg).quantize(net)
+    qout = qmodel(x).numpy()
+    assert qout.shape == ref.shape
+    # int8 fake-quant error should be small but nonzero
+    err = np.abs(qout - ref).max()
+    assert 0 < err < 0.5
+
+    # QAT model still trains (straight-through grads)
+    from paddle_tpu import optimizer as opt
+
+    optim = opt.Adam(1e-2, parameters=qmodel.parameters())
+    y = paddle.to_tensor(np.random.randn(4, 2).astype(np.float32))
+    l0 = None
+    for i in range(5):
+        loss = ((qmodel(x) - y) ** 2).mean()
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        l0 = l0 or float(loss.numpy())
+    assert float(loss.numpy()) < l0
+
+    ptq_cfg = QuantConfig(activation=QuanterFactory(AbsMaxObserver),
+                          weight=QuanterFactory(AbsMaxObserver))
+    pmodel = PTQ(ptq_cfg).quantize(net)
+    pmodel(x)  # calibrate
+    converted = PTQ(ptq_cfg).convert(pmodel)
+    cout = converted(x).numpy()
+    np.testing.assert_allclose(cout, ref, atol=0.3)
+
+
+# ---- geometric / audio / incubate -------------------------------------------
+
+def test_geometric_send_u_recv():
+    import paddle_tpu.geometric as G
+
+    x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(), [[1.0], [4.0], [2.0]])
+    seg = G.segment_mean(paddle.to_tensor(np.array([1.0, 3.0, 5.0], np.float32)),
+                         paddle.to_tensor(np.array([0, 0, 1], np.int32)))
+    np.testing.assert_allclose(seg.numpy(), [2.0, 5.0])
+
+
+def test_audio_features():
+    from paddle_tpu.audio.features import MFCC, MelSpectrogram
+
+    x = paddle.to_tensor(np.random.randn(1, 2048).astype(np.float32))
+    mel = MelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+    assert mel.shape[1] == 32
+    mfcc = MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+    assert mfcc.shape[1] == 13
+    assert np.isfinite(mfcc.numpy()).all()
+
+
+def test_incubate_fused_ops():
+    import paddle_tpu.incubate.nn.functional as IF
+
+    x = paddle.to_tensor(np.random.randn(2, 4, 16).astype(np.float32))
+    w = paddle.to_tensor(np.ones(16, np.float32))
+    out = IF.fused_rms_norm(x, w)
+    ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+    g = paddle.to_tensor(np.random.randn(2, 6).astype(np.float32))
+    sw = IF.swiglu(g)
+    gn = g.numpy()
+    sil = gn[:, :3] / (1 + np.exp(-gn[:, :3]))
+    np.testing.assert_allclose(sw.numpy(), sil * gn[:, 3:], rtol=1e-4)
+
+
+def test_incubate_fused_attention_layer():
+    from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+
+    paddle.seed(3)
+    layer = FusedMultiHeadAttention(embed_dim=16, num_heads=2,
+                                    dropout_rate=0.0, attn_dropout_rate=0.0)
+    layer.eval()
+    x = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+    out = layer(x)
+    assert out.shape == [2, 5, 16]
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_onnx_export_raises():
+    import paddle_tpu.onnx as onnx
+
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        onnx.export(nn.Linear(2, 2), "m.onnx")
+
+
+def test_vector_norm_semantics():
+    import paddle_tpu.linalg as L
+
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    # multi-axis stays a VECTOR norm (not spectral)
+    v = L.vector_norm(x, p=2.0, axis=[-2, -1])
+    np.testing.assert_allclose(float(v.numpy()),
+                               np.sqrt((np.arange(6) ** 2).sum()), rtol=1e-6)
+    kd = L.vector_norm(x, keepdim=True)
+    assert list(kd.shape) == [1, 1]
+    inf = L.vector_norm(x, p=float("inf"))
+    assert float(inf.numpy()) == 5.0
+
+
+def test_lu_unpack_flags():
+    import paddle_tpu.linalg as L
+
+    a = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    lu_, piv = L.lu(paddle.to_tensor(a))
+    p, l_, u_ = L.lu_unpack(lu_, piv)
+    np.testing.assert_allclose(p.numpy() @ l_.numpy() @ u_.numpy(), a,
+                               atol=1e-4)
+    p2, l2, u2 = L.lu_unpack(lu_, piv, unpack_ludata=False)
+    assert l2 is None and u2 is None and p2 is not None
+    p3, l3, u3 = L.lu_unpack(lu_, piv, unpack_pivots=False)
+    assert p3 is None and l3 is not None
+
+
+def test_segment_ops_reject_tracing():
+    import jax
+
+    import paddle_tpu.geometric as G
+
+    def traced(d, s):
+        return G.segment_mean(d, s)
+
+    with pytest.raises(ValueError, match="out_size"):
+        jax.jit(lambda d, s: G.segment_mean(
+            paddle.to_tensor(d), paddle.to_tensor(s)).numpy())(
+                np.ones((3, 1), np.float32), np.array([0, 0, 1], np.int32))
+
+
+def test_fused_rope_defaults_and_position_ids():
+    import paddle_tpu.incubate.nn.functional as IF
+
+    rng = np.random.default_rng(0)
+    q = paddle.to_tensor(rng.standard_normal((1, 4, 2, 8)).astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((1, 4, 2, 8)).astype(np.float32))
+    qo, ko, vo = IF.fused_rotary_position_embedding(q, k)
+    assert vo is None and qo.shape == q.shape
+    # position 0 is identity rotation
+    np.testing.assert_allclose(qo.numpy()[:, 0], q.numpy()[:, 0], atol=1e-6)
+
+    # decode: single token at position 2 must equal full-seq row 2
+    pid = paddle.to_tensor(np.array([[2]], np.int64))
+    q1 = paddle.to_tensor(q.numpy()[:, 2:3])
+    qd, _, _ = IF.fused_rotary_position_embedding(q1, position_ids=pid)
+    np.testing.assert_allclose(qd.numpy()[:, 0], qo.numpy()[:, 2], atol=1e-5)
