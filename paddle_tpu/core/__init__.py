@@ -1,0 +1,9 @@
+"""Native runtime core (C++), loaded via ctypes.
+
+The reference's runtime around the compute path is C++ (SURVEY.md §2.1/2.5:
+store/rendezvous tcp_store.cc, dataloader shm transport); this package
+holds the TPU build's C++ equivalents, compiled on demand with the
+in-image g++ and cached under ~/.cache/paddle_tpu (the role of the
+reference's prebuilt .so in the wheel).
+"""
+from .build import load_native  # noqa: F401

@@ -1,0 +1,246 @@
+// Shared-memory ring queue for DataLoader worker → main-process transport.
+//
+// Reference parity: the reference DataLoader's shared-memory path
+// (python/paddle/io/dataloader/worker.py + paddle/fluid's memory-mapped
+// tensor transport): worker processes serialize batches into shm instead
+// of piping pickles through multiprocessing queues. Re-designed as a
+// single contiguous POSIX shm ring with process-shared mutex/condvars and
+// a C ABI for ctypes.
+//
+// Layout: [Header | byte ring of capacity bytes]; messages are stored as
+// u32 length + payload, wrapping at the ring edge (a message never splits:
+// if it does not fit in the tail gap, a 0xFFFFFFFF wrap marker is written
+// and the message starts at offset 0).
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
+
+struct Header {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t capacity;   // ring bytes
+  uint64_t head;       // read offset
+  uint64_t tail;       // write offset
+  uint64_t used;       // bytes in use (incl. length prefixes + wrap gaps)
+  uint64_t count;      // queued messages
+  uint32_t closed;     // producer-side close flag
+};
+
+struct Handle {
+  Header* hdr;
+  char* ring;
+  size_t total;
+  char name[256];
+  bool owner;
+};
+
+void abs_deadline(double timeout_s, timespec* ts) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  time_t sec = static_cast<time_t>(timeout_s);
+  long nsec = static_cast<long>((timeout_s - sec) * 1e9);
+  ts->tv_sec += sec;
+  ts->tv_nsec += nsec;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pd_shmq_create(const char* name, uint64_t capacity) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = sizeof(Header) + capacity;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = static_cast<Header*>(mem);
+  memset(hdr, 0, sizeof(Header));
+  hdr->capacity = capacity;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&hdr->not_empty, &ca);
+  pthread_cond_init(&hdr->not_full, &ca);
+
+  auto* h = new Handle();
+  h->hdr = hdr;
+  h->ring = static_cast<char*>(mem) + sizeof(Header);
+  h->total = total;
+  snprintf(h->name, sizeof(h->name), "%s", name);
+  h->owner = true;
+  return h;
+}
+
+void* pd_shmq_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* h = new Handle();
+  h->hdr = static_cast<Header*>(mem);
+  h->ring = static_cast<char*>(mem) + sizeof(Header);
+  h->total = static_cast<size_t>(st.st_size);
+  snprintf(h->name, sizeof(h->name), "%s", name);
+  h->owner = false;
+  return h;
+}
+
+static int lock_robust(Header* hdr) {
+  int rc = pthread_mutex_lock(&hdr->mu);
+  if (rc == EOWNERDEAD) {  // a worker died holding the lock — recover
+    pthread_mutex_consistent(&hdr->mu);
+    return 0;
+  }
+  return rc;
+}
+
+// 0 ok, 1 timeout, -1 error/too-big, -2 closed
+int pd_shmq_push(void* vh, const char* data, uint64_t len, double timeout_s) {
+  auto* h = static_cast<Handle*>(vh);
+  Header* hdr = h->hdr;
+  uint64_t need = len + 4;
+  if (need + 4 > hdr->capacity) return -1;  // +4: potential wrap marker
+  timespec ts;
+  abs_deadline(timeout_s, &ts);
+  if (lock_robust(hdr) != 0) return -1;
+  while (hdr->capacity - hdr->used < need + 4) {
+    if (hdr->closed) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -2;
+    }
+    if (pthread_cond_timedwait(&hdr->not_full, &hdr->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&hdr->mu);
+      return 1;
+    }
+  }
+  uint64_t tail = hdr->tail;
+  uint64_t gap = hdr->capacity - tail;
+  if (gap < need) {  // cannot fit contiguously: wrap
+    if (gap >= 4) {
+      uint32_t marker = kWrapMarker;
+      memcpy(h->ring + tail, &marker, 4);
+    }
+    hdr->used += gap;
+    tail = 0;
+  }
+  uint32_t len32 = static_cast<uint32_t>(len);
+  memcpy(h->ring + tail, &len32, 4);
+  memcpy(h->ring + tail + 4, data, len);
+  hdr->tail = (tail + need) % hdr->capacity;
+  hdr->used += need;
+  hdr->count += 1;
+  pthread_cond_signal(&hdr->not_empty);
+  pthread_mutex_unlock(&hdr->mu);
+  return 0;
+}
+
+// >=0: message length (copied into *out, malloc'd); -1 error; -2 timeout;
+// -3 closed-and-drained
+int64_t pd_shmq_pop(void* vh, char** out, double timeout_s) {
+  auto* h = static_cast<Handle*>(vh);
+  Header* hdr = h->hdr;
+  timespec ts;
+  abs_deadline(timeout_s, &ts);
+  if (lock_robust(hdr) != 0) return -1;
+  while (hdr->count == 0) {
+    if (hdr->closed) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -3;
+    }
+    if (pthread_cond_timedwait(&hdr->not_empty, &hdr->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -2;
+    }
+  }
+  uint64_t head = hdr->head;
+  uint32_t len32;
+  if (hdr->capacity - head >= 4) {
+    memcpy(&len32, h->ring + head, 4);
+    if (len32 == kWrapMarker) {
+      hdr->used -= hdr->capacity - head;
+      head = 0;
+      memcpy(&len32, h->ring, 4);
+    }
+  } else {  // tail gap < 4 counted as wrap space
+    hdr->used -= hdr->capacity - head;
+    head = 0;
+    memcpy(&len32, h->ring, 4);
+  }
+  char* buf = static_cast<char*>(malloc(len32 ? len32 : 1));
+  memcpy(buf, h->ring + head + 4, len32);
+  hdr->head = (head + len32 + 4) % hdr->capacity;
+  hdr->used -= len32 + 4;
+  hdr->count -= 1;
+  pthread_cond_signal(&hdr->not_full);
+  pthread_mutex_unlock(&hdr->mu);
+  *out = buf;
+  return len32;
+}
+
+uint64_t pd_shmq_count(void* vh) {
+  auto* h = static_cast<Handle*>(vh);
+  if (lock_robust(h->hdr) != 0) return 0;
+  uint64_t c = h->hdr->count;
+  pthread_mutex_unlock(&h->hdr->mu);
+  return c;
+}
+
+void pd_shmq_close_writers(void* vh) {
+  auto* h = static_cast<Handle*>(vh);
+  if (lock_robust(h->hdr) == 0) {
+    h->hdr->closed = 1;
+    pthread_cond_broadcast(&h->hdr->not_empty);
+    pthread_cond_broadcast(&h->hdr->not_full);
+    pthread_mutex_unlock(&h->hdr->mu);
+  }
+}
+
+void pd_shmq_free(char* p) { free(p); }
+
+void pd_shmq_close(void* vh) {
+  auto* h = static_cast<Handle*>(vh);
+  munmap(h->hdr, h->total);
+  if (h->owner) shm_unlink(h->name);
+  delete h;
+}
+
+}  // extern "C"

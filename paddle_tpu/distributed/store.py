@@ -1,0 +1,96 @@
+"""TCPStore: rank rendezvous over the native C++ daemon.
+
+Reference parity: paddle/phi/core/distributed/store/tcp_store.h:121
+(TCPStore(host, port, is_master, world_size, timeout) with
+set/get/add/wait) — the daemon and client are C++ (core/csrc/tcp_store.cpp)
+bound via ctypes; this class is the Python surface, used by
+init_parallel_env/launch for bootstrap barriers.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional
+
+from ..core import load_native
+
+
+class TCPStore:
+    def __init__(self, host: str = "127.0.0.1", port: int = 6170,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 900.0):
+        self._lib = load_native()
+        self._server = None
+        self.host, self.is_master, self.world_size = host, is_master, world_size
+        self.timeout = timeout
+        if is_master:
+            self._server = self._lib.pd_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore master failed to bind port {port}")
+            port = self._lib.pd_store_server_port(self._server)
+        self.port = port
+        self._client = self._lib.pd_store_client_connect(
+            host.encode(), port, timeout)
+        if not self._client:
+            if self._server:
+                self._lib.pd_store_server_stop(self._server)
+            raise RuntimeError(f"TCPStore could not reach {host}:{port} "
+                               f"within {timeout}s")
+
+    # -- kv ops ---------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        v = value if isinstance(value, (bytes, bytearray)) else str(value).encode()
+        k = key.encode()
+        rc = self._lib.pd_store_client_set(self._client, k, len(k), bytes(v),
+                                           len(v))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        k = key.encode()
+        out = ctypes.POINTER(ctypes.c_char)()
+        out_len = ctypes.c_uint32()
+        rc = self._lib.pd_store_client_get(
+            self._client, k, len(k), ctypes.byref(out),
+            ctypes.byref(out_len),
+            self.timeout if timeout is None else timeout)
+        if rc == 1:
+            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) failed")
+        data = ctypes.string_at(out, out_len.value)
+        self._lib.pd_store_free(out)
+        return data
+
+    def add(self, key: str, amount: int = 1) -> int:
+        k = key.encode()
+        v = self._lib.pd_store_client_add(self._client, k, len(k), amount)
+        if v == -(2 ** 63):
+            raise RuntimeError(f"TCPStore.add({key!r}) failed")
+        return int(v)
+
+    def wait(self, keys: List[str], timeout: Optional[float] = None) -> None:
+        for key in ([keys] if isinstance(keys, str) else keys):
+            self.get(key, timeout)
+
+    def delete_key(self, key: str) -> None:
+        k = key.encode()
+        self._lib.pd_store_client_del(self._client, k, len(k))
+
+    # -- rendezvous helper ----------------------------------------------------
+    def barrier(self, name: str = "barrier", timeout: Optional[float] = None):
+        """All world_size participants block until everyone arrived."""
+        n = self.add(f"__{name}__count", 1)
+        gen = (n - 1) // self.world_size  # reusable barrier generations
+        if n % self.world_size == 0:
+            self.set(f"__{name}__release_{gen}", b"1")
+        self.get(f"__{name}__release_{gen}", timeout)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_client", None):
+                self._lib.pd_store_client_close(self._client)
+            if getattr(self, "_server", None):
+                self._lib.pd_store_server_stop(self._server)
+        except Exception:
+            pass

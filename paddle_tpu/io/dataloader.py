@@ -125,6 +125,33 @@ def _as_numpy_sample(s):
     return s
 
 
+def _shm_worker_loop(chan_name, task_q, dataset, collate_fn, worker_init_fn,
+                     wid, num_workers):
+    """Worker body for the shared-memory transport: pull index batches,
+    build numpy batches, push them into the C++ shm ring (worker.py
+    _worker_loop parity; the ring replaces the pickle pipe)."""
+    from .shm_channel import ShmChannel
+
+    chan = ShmChannel(chan_name, create=False)
+    _worker_info.info = WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    try:
+        while True:
+            item = task_q.get()
+            if item is None:
+                break
+            seq, indices = item
+            samples = [dataset[i] for i in indices]
+            if collate_fn is not None:
+                batch = _as_numpy_sample(collate_fn(samples))
+            else:
+                batch = _np_collate([_as_numpy_sample(s) for s in samples])
+            chan.put((seq, batch))
+    finally:
+        chan.close()
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
@@ -136,6 +163,7 @@ class DataLoader:
         self.collate_fn = collate_fn
         self.worker_init_fn = worker_init_fn
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
         self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self._pool = None
@@ -188,6 +216,12 @@ class DataLoader:
                 samples = [self.dataset[i] for i in indices]
                 yield self._collate(samples)
             return
+        if self.use_shared_memory:
+            try:
+                yield from self._iter_multiprocess_shm()
+                return
+            except RuntimeError:
+                pass  # native core unavailable → pipe-based pool below
         # multiprocess path: pool imap with prefetch lookahead. Dataset +
         # collate_fn ship once per worker via the initializer; only index
         # lists cross per batch. A user collate_fn runs worker-side (must be
@@ -203,3 +237,57 @@ class DataLoader:
         ) as pool:
             for np_batch in pool.imap(_pool_worker_task, self.batch_sampler, chunksize=1):
                 yield _to_tensors(np_batch)
+
+    def _iter_multiprocess_shm(self):
+        """Shared-memory transport: workers push packed numpy batches into
+        the native C++ ring (io/shm_channel.py); batches re-order by
+        sequence id here (the reference's _order outstanding-batch cache)."""
+        import multiprocessing as mp
+
+        from .shm_channel import ShmChannel
+
+        chan = ShmChannel(capacity_mb=64)  # raises RuntimeError if no native core
+        ctx = mp.get_context("fork")
+        task_q = ctx.Queue()
+        procs = [
+            ctx.Process(
+                target=_shm_worker_loop,
+                args=(chan.name, task_q, self.dataset, self.collate_fn,
+                      self.worker_init_fn, wid, self.num_workers),
+                daemon=True)
+            for wid in range(self.num_workers)
+        ]
+        for p in procs:
+            p.start()
+        try:
+            expected = 0
+            for seq, indices in enumerate(self.batch_sampler):
+                task_q.put((seq, list(indices)))
+                expected += 1
+            for _ in procs:
+                task_q.put(None)
+            buffer = {}
+            next_seq = 0
+            timeout = self.timeout or 300.0
+            while next_seq < expected:
+                if next_seq in buffer:
+                    yield _to_tensors(buffer.pop(next_seq))
+                    next_seq += 1
+                    continue
+                try:
+                    seq, batch = chan.get(timeout=5.0)
+                except TimeoutError:
+                    if not any(p.is_alive() for p in procs) and \
+                            chan.qsize() == 0:
+                        raise RuntimeError(
+                            "DataLoader shm workers exited before producing "
+                            "all batches (worker crash?)") from None
+                    continue
+                buffer[seq] = batch
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join()
+            chan.close()

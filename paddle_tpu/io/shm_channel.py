@@ -1,0 +1,137 @@
+"""Shared-memory batch channel for DataLoader workers.
+
+Reference parity: the reference DataLoader's use_shared_memory transport
+(python/paddle/io/dataloader/worker.py `_worker_loop` + core memory-mapped
+tensor channel): worker processes hand finished batches to the main
+process through shared memory instead of pickling into a pipe. Here the
+ring itself is C++ (core/csrc/shm_queue.cpp); numpy batches serialize as
+a tiny header + raw array bytes (zero pickle on the payload).
+"""
+from __future__ import annotations
+
+import ctypes
+import io
+import os
+import pickle
+import struct
+from typing import Any
+
+import numpy as np
+
+from ..core import load_native
+
+
+def _pack(obj: Any) -> bytes:
+    """Fast path: (nested) numpy arrays go as raw bytes; the structure is a
+    small pickled skeleton with placeholders."""
+    arrays = []
+
+    def strip(o):
+        if isinstance(o, np.ndarray):
+            arrays.append(o)
+            return ("__nd__", len(arrays) - 1, o.shape, str(o.dtype))
+        if isinstance(o, (list, tuple)):
+            t = [strip(x) for x in o]
+            return tuple(t) if isinstance(o, tuple) else t
+        if isinstance(o, dict):
+            return {k: strip(v) for k, v in o.items()}
+        return o
+
+    skeleton = pickle.dumps(strip(obj), protocol=4)
+    parts = [struct.pack("<II", len(skeleton), len(arrays)), skeleton]
+    for a in arrays:
+        b = np.ascontiguousarray(a).tobytes()
+        parts.append(struct.pack("<Q", len(b)))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def _unpack(buf: bytes) -> Any:
+    sk_len, n_arr = struct.unpack_from("<II", buf, 0)
+    off = 8
+    skeleton = pickle.loads(buf[off:off + sk_len])
+    off += sk_len
+    arrays = []
+    for _ in range(n_arr):
+        (blen,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        arrays.append((off, blen))
+        off += blen
+
+    def rebuild(o):
+        if isinstance(o, tuple) and len(o) == 4 and o[0] == "__nd__":
+            _, i, shape, dtype = o
+            aoff, alen = arrays[i]
+            return np.frombuffer(buf, np.dtype(dtype), count=alen // np.dtype(dtype).itemsize,
+                                 offset=aoff).reshape(shape).copy()
+        if isinstance(o, tuple):
+            return tuple(rebuild(x) for x in o)
+        if isinstance(o, list):
+            return [rebuild(x) for x in o]
+        if isinstance(o, dict):
+            return {k: rebuild(v) for k, v in o.items()}
+        return o
+
+    return rebuild(skeleton)
+
+
+class ShmChannel:
+    """Process-shared bounded queue of python batches over the C++ ring."""
+
+    def __init__(self, name: str = None, capacity_mb: int = 64,
+                 create: bool = True):
+        self._lib = load_native()
+        self.name = name or f"/pdtpu_q_{os.getpid()}_{id(self) & 0xFFFF}"
+        if create:
+            self._h = self._lib.pd_shmq_create(self.name.encode(),
+                                               capacity_mb * 1024 * 1024)
+        else:
+            self._h = self._lib.pd_shmq_open(self.name.encode())
+        if not self._h:
+            raise RuntimeError(f"shm queue {'create' if create else 'open'} "
+                               f"failed for {self.name}")
+        self._owner = create
+
+    def open_in_child(self) -> "ShmChannel":
+        return ShmChannel(self.name, create=False)
+
+    def put(self, obj: Any, timeout: float = 300.0) -> None:
+        data = _pack(obj)
+        rc = self._lib.pd_shmq_push(self._h, data, len(data), timeout)
+        if rc == 1:
+            raise TimeoutError("shm queue full")
+        if rc == -2:
+            raise BrokenPipeError("shm queue closed")
+        if rc != 0:
+            raise RuntimeError(f"shm push failed (batch {len(data)} bytes "
+                               f"exceeds ring capacity?)")
+
+    def get(self, timeout: float = 300.0) -> Any:
+        out = ctypes.POINTER(ctypes.c_char)()
+        n = self._lib.pd_shmq_pop(self._h, ctypes.byref(out), timeout)
+        if n == -2:
+            raise TimeoutError("shm queue empty")
+        if n == -3:
+            raise EOFError("shm queue closed and drained")
+        if n < 0:
+            raise RuntimeError("shm pop failed")
+        buf = ctypes.string_at(out, n)
+        self._lib.pd_shmq_free(out)
+        return _unpack(buf)
+
+    def qsize(self) -> int:
+        return int(self._lib.pd_shmq_count(self._h))
+
+    def close_writers(self):
+        self._lib.pd_shmq_close_writers(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.pd_shmq_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
