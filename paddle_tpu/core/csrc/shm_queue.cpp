@@ -142,7 +142,24 @@ int pd_shmq_push(void* vh, const char* data, uint64_t len, double timeout_s) {
   timespec ts;
   abs_deadline(timeout_s, &ts);
   if (lock_robust(hdr) != 0) return -1;
-  while (hdr->capacity - hdr->used < need + 4) {
+  // Wait until the message fits in ONE of the two legal placements — not
+  // merely until total free bytes suffice (round-1 bug: a wrap-placed
+  // message could overwrite unread data at the front of the ring):
+  //   contiguous: gap bytes at tail are free (requires free_total >= need;
+  //               when data wraps, the free region [tail, head) is exactly
+  //               free_total)
+  //   wrapped:    sacrifice the gap, write at 0 — needs head >= need and
+  //               data must NOT already wrap (tail >= head)
+  for (;;) {
+    if (hdr->count == 0 && hdr->used == 0) {
+      hdr->head = hdr->tail = 0;  // empty: normalize so any need <= cap fits
+    }
+    uint64_t gap_now = hdr->capacity - hdr->tail;
+    uint64_t free_total = hdr->capacity - hdr->used;
+    bool fits = (gap_now >= need)
+                    ? (free_total >= need)
+                    : (hdr->tail >= hdr->head && hdr->head >= need);
+    if (fits) break;
     if (hdr->closed) {
       pthread_mutex_unlock(&hdr->mu);
       return -2;
@@ -210,7 +227,9 @@ int64_t pd_shmq_pop(void* vh, char** out, double timeout_s) {
   hdr->head = (head + len32 + 4) % hdr->capacity;
   hdr->used -= len32 + 4;
   hdr->count -= 1;
-  pthread_cond_signal(&hdr->not_full);
+  // broadcast: producers wait on size-dependent fit conditions, so waking
+  // just one could strand another whose (smaller) message now fits
+  pthread_cond_broadcast(&hdr->not_full);
   pthread_mutex_unlock(&hdr->mu);
   *out = buf;
   return len32;

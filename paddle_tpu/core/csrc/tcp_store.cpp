@@ -41,12 +41,22 @@ bool read_full(int fd, void* buf, size_t n) {
     ssize_t r = ::recv(fd, p, n, 0);
     if (r <= 0) {
       if (r < 0 && (errno == EINTR)) continue;
+      // EAGAIN/EWOULDBLOCK = SO_RCVTIMEO expired: treat as failure so a
+      // stalled peer can't block the caller forever
       return false;
     }
     p += r;
     n -= static_cast<size_t>(r);
   }
   return true;
+}
+
+void set_op_timeout(int fd, double seconds) {
+  timeval tv{};
+  tv.tv_sec = static_cast<long>(seconds);
+  tv.tv_usec = static_cast<long>((seconds - tv.tv_sec) * 1e6);
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
 }
 
 bool write_full(int fd, const void* buf, size_t n) {
@@ -110,14 +120,6 @@ class Server {
       for (int fd : conns_) fds.push_back({fd, POLLIN, 0});
       int rc = ::poll(fds.data(), fds.size(), 100 /*ms*/);
       if (rc <= 0) continue;
-      if (fds[0].revents & POLLIN) {
-        int conn = ::accept(listen_fd_, nullptr, nullptr);
-        if (conn >= 0) {
-          int one = 1;
-          ::setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-          conns_.push_back(conn);
-        }
-      }
       std::vector<int> alive;
       for (size_t i = 1; i < fds.size(); i++) {
         int fd = fds[i].fd;
@@ -132,6 +134,17 @@ class Server {
           }
         }
         alive.push_back(fd);
+      }
+      if (fds[0].revents & POLLIN) {
+        int conn = ::accept(listen_fd_, nullptr, nullptr);
+        if (conn >= 0) {
+          int one = 1;
+          ::setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          // bound per-request reads so one stalled/partial-writing peer
+          // cannot wedge the single daemon thread (ADVICE.md round 1)
+          set_op_timeout(conn, 30.0);
+          alive.push_back(conn);
+        }
       }
       conns_ = std::move(alive);
     }
@@ -234,6 +247,9 @@ class Client {
           ::connect(fd_, res->ai_addr, res->ai_addrlen) == 0) {
         int one = 1;
         ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        // honor the Python-level timeout on every socket op, not just
+        // connect: a dead daemon must surface as an error, not a hang
+        set_op_timeout(fd_, timeout_s > 0 ? timeout_s : 30.0);
         ::freeaddrinfo(res);
         return true;
       }

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -217,11 +218,19 @@ class DataLoader:
                 yield self._collate(samples)
             return
         if self.use_shared_memory:
+            # Probe channel creation HERE so only "native core unavailable"
+            # falls back to the pool; a RuntimeError raised mid-iteration
+            # (worker crash) must propagate — falling back after batches
+            # were already yielded would silently duplicate the epoch.
             try:
-                yield from self._iter_multiprocess_shm()
-                return
+                from .shm_channel import ShmChannel
+
+                chan = ShmChannel(capacity_mb=64)
             except RuntimeError:
-                pass  # native core unavailable → pipe-based pool below
+                chan = None  # native core unavailable → pipe-based pool below
+            if chan is not None:
+                yield from self._iter_multiprocess_shm(chan)
+                return
         # multiprocess path: pool imap with prefetch lookahead. Dataset +
         # collate_fn ship once per worker via the initializer; only index
         # lists cross per batch. A user collate_fn runs worker-side (must be
@@ -238,15 +247,14 @@ class DataLoader:
             for np_batch in pool.imap(_pool_worker_task, self.batch_sampler, chunksize=1):
                 yield _to_tensors(np_batch)
 
-    def _iter_multiprocess_shm(self):
+    def _iter_multiprocess_shm(self, chan):
         """Shared-memory transport: workers push packed numpy batches into
         the native C++ ring (io/shm_channel.py); batches re-order by
-        sequence id here (the reference's _order outstanding-batch cache)."""
+        sequence id here (the reference's _order outstanding-batch cache).
+        ``chan`` is created by the caller so creation failure (no native
+        core) can fall back without masking mid-iteration worker crashes."""
         import multiprocessing as mp
 
-        from .shm_channel import ShmChannel
-
-        chan = ShmChannel(capacity_mb=64)  # raises RuntimeError if no native core
         ctx = mp.get_context("fork")
         task_q = ctx.Queue()
         procs = [
@@ -257,9 +265,9 @@ class DataLoader:
                 daemon=True)
             for wid in range(self.num_workers)
         ]
-        for p in procs:
-            p.start()
         try:
+            for p in procs:
+                p.start()
             expected = 0
             for seq, indices in enumerate(self.batch_sampler):
                 task_q.put((seq, list(indices)))
@@ -269,21 +277,28 @@ class DataLoader:
             buffer = {}
             next_seq = 0
             timeout = self.timeout or 300.0
+            last_progress = time.monotonic()
             while next_seq < expected:
                 if next_seq in buffer:
                     yield _to_tensors(buffer.pop(next_seq))
                     next_seq += 1
+                    last_progress = time.monotonic()
                     continue
                 try:
-                    seq, batch = chan.get(timeout=5.0)
+                    seq, batch = chan.get(timeout=min(5.0, timeout))
                 except TimeoutError:
                     if not any(p.is_alive() for p in procs) and \
                             chan.qsize() == 0:
                         raise RuntimeError(
                             "DataLoader shm workers exited before producing "
                             "all batches (worker crash?)") from None
+                    if time.monotonic() - last_progress > timeout:
+                        raise TimeoutError(
+                            f"DataLoader timed out: no batch for "
+                            f"{timeout:.0f}s (stuck worker?)") from None
                     continue
                 buffer[seq] = batch
+                last_progress = time.monotonic()
         finally:
             for p in procs:
                 if p.is_alive():

@@ -56,7 +56,16 @@ class FakeQuanterWithAbsMaxObserver(Layer):
         self._scale = None
 
     def forward(self, x):
-        absmax = float(jnp.max(jnp.abs(unwrap(x))))
+        import jax.core as _jc
+
+        m = jnp.max(jnp.abs(unwrap(x)))
+        if isinstance(m, _jc.Tracer):
+            raise RuntimeError(
+                "FakeQuanterWithAbsMaxObserver updates its moving-average "
+                "scale eagerly and cannot run under jax.jit/to_static "
+                "tracing; run QAT in eager mode (same restriction family "
+                "as _check_nan_inf), or export after calibration.")
+        absmax = float(m)
         if self._scale is None:
             self._scale = absmax
         elif self.training:

@@ -81,6 +81,7 @@ class Program:
         p.nodes = list(self.nodes)
         p.feeds = dict(self.feeds)
         p.feed_specs = dict(self.feed_specs)
+        p._keepalive = list(self._keepalive)  # ids must stay valid for fetches
         return p
 
     def __repr__(self):
@@ -200,8 +201,7 @@ class Executor:
         if unknown:
             raise KeyError(f"feed names {unknown} not declared via "
                            f"paddle.static.data in this program")
-        fetch_ids = [id(f) if isinstance(f, Tensor) else id(f)
-                     for f in fetch_list]
+        fetch_ids = [self._resolve_fetch(program, f) for f in fetch_list]
         arrays = [np.asarray(feed[n]) for n in feed_names]
         shapes_key = tuple((a.shape, str(a.dtype)) for a in arrays)
         fn = program.compiled(feed_names, fetch_ids, shapes_key)
@@ -209,6 +209,23 @@ class Executor:
         if return_numpy:
             return [np.asarray(jax.device_get(o)) for o in outs]
         return [wrap(o) for o in outs]
+
+    @staticmethod
+    def _resolve_fetch(program: Program, f) -> int:
+        """Map a fetch_list entry (Tensor or variable name) to a graph id."""
+        if isinstance(f, Tensor):
+            return id(f)
+        if isinstance(f, str):
+            if f in program.feeds:
+                return program.feeds[f]
+            for t in reversed(program._keepalive):  # latest definition wins
+                if isinstance(t, Tensor) and getattr(t, "name", None) == f:
+                    return id(t)
+            raise KeyError(
+                f"fetch name {f!r} matches no feed and no recorded tensor "
+                f"in this program")
+        raise TypeError(
+            f"fetch_list entries must be Tensor or str, got {type(f)}")
 
     def close(self):
         ...

@@ -67,6 +67,45 @@ def test_static_errors():
                 fetch_list=[y])
 
 
+def test_static_fetch_by_name():
+    """Fetching by variable name (a common paddle.static fetch_list form):
+    feed names resolve through program.feeds; tensor .name attributes
+    resolve through the recorded graph; unknown names raise."""
+    import paddle_tpu.static as static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 3])
+        y = x * 2.0
+        y.name = "doubled"
+    exe = static.Executor()
+    feed = np.ones((2, 3), np.float32)
+    out_feed, out_named = exe.run(prog, feed={"x": feed},
+                                  fetch_list=["x", "doubled"])
+    np.testing.assert_allclose(out_feed, feed)
+    np.testing.assert_allclose(out_named, feed * 2.0)
+    with pytest.raises(KeyError):
+        exe.run(prog, feed={"x": feed}, fetch_list=["nope"])
+    with pytest.raises(TypeError):
+        exe.run(prog, feed={"x": feed}, fetch_list=[123])
+
+
+def test_qat_rejects_tracing():
+    """QAT fake-quant layers update python-side scale state and must refuse
+    to run under jit tracing instead of silently freezing the scale."""
+    import jax
+
+    from paddle_tpu.quantization import FakeQuanterWithAbsMaxObserver
+
+    q = FakeQuanterWithAbsMaxObserver()
+    x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+    q(x)  # eager works
+
+    with pytest.raises(RuntimeError, match="eager"):
+        jax.jit(lambda a: q(paddle.to_tensor(a)).numpy())(
+            np.random.randn(4, 4).astype(np.float32))
+
+
 def test_save_load_inference_model(tmp_path):
     import paddle_tpu.static as static
 
