@@ -61,7 +61,8 @@ from .ops.math import (
     isinf, isfinite, logical_not, bitwise_not, add, subtract, multiply,
     divide, floor_divide, remainder, mod, floor_mod, pow, maximum, minimum,
     fmax, fmin, atan2, hypot, logaddexp, nextafter, copysign, heaviside, gcd,
-    lcm, ldexp, bitwise_and, bitwise_or, bitwise_xor, divide_no_nan, scale,
+    lcm, ldexp, bitwise_and, bitwise_or, bitwise_xor, bitwise_left_shift,
+    bitwise_right_shift, i0, i1, divide_no_nan, scale,
     cast, clip, lerp, stanh, multiplex, addmm, inner, outer, logit,
     polygamma, nan_to_num, trapezoid, diff, sum, mean, prod, max, min, amax,
     amin, any, all, nansum, nanmean, median, nanmedian, std, var, logsumexp,

@@ -249,7 +249,8 @@ class Client {
         ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
         // honor the Python-level timeout on every socket op, not just
         // connect: a dead daemon must surface as an error, not a hang
-        set_op_timeout(fd_, timeout_s > 0 ? timeout_s : 30.0);
+        default_timeout_ = timeout_s > 0 ? timeout_s : 30.0;
+        set_op_timeout(fd_, default_timeout_);
         ::freeaddrinfo(res);
         return true;
       }
@@ -277,6 +278,14 @@ class Client {
           double timeout_s) {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::duration<double>(timeout_s);
+    // honor the per-call timeout even against a STALLED (not dead) daemon:
+    // bound each blocking recv by the call deadline, not the ctor default
+    if (timeout_s > 0 && timeout_s < default_timeout_)
+      set_op_timeout(fd_, timeout_s);
+    struct Restore {
+      Client* c;
+      ~Restore() { set_op_timeout(c->fd_, c->default_timeout_); }
+    } restore{this};
     while (true) {
       {
         std::lock_guard<std::mutex> g(mu_);
@@ -331,6 +340,7 @@ class Client {
 
  private:
   int fd_ = -1;
+  double default_timeout_ = 30.0;
   std::mutex mu_;  // one request in flight per client
 };
 

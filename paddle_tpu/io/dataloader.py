@@ -276,7 +276,7 @@ class DataLoader:
                 task_q.put(None)
             buffer = {}
             next_seq = 0
-            timeout = self.timeout or 300.0
+            timeout = self.timeout  # paddle semantics: 0/None = wait forever
             last_progress = time.monotonic()
             while next_seq < expected:
                 if next_seq in buffer:
@@ -285,14 +285,14 @@ class DataLoader:
                     last_progress = time.monotonic()
                     continue
                 try:
-                    seq, batch = chan.get(timeout=min(5.0, timeout))
+                    seq, batch = chan.get(timeout=5.0)
                 except TimeoutError:
                     if not any(p.is_alive() for p in procs) and \
                             chan.qsize() == 0:
                         raise RuntimeError(
                             "DataLoader shm workers exited before producing "
                             "all batches (worker crash?)") from None
-                    if time.monotonic() - last_progress > timeout:
+                    if timeout and time.monotonic() - last_progress > timeout:
                         raise TimeoutError(
                             f"DataLoader timed out: no batch for "
                             f"{timeout:.0f}s (stuck worker?)") from None

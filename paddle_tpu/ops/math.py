@@ -281,13 +281,27 @@ def cumprod(x, dim=None):
     return jnp.cumprod(x, axis=dim)
 
 
+def _cum_extreme_indices(x, values, axis):
+    """Running-extreme indices, latest occurrence winning ties (paddle /
+    torch cummax convention): positions where the running extreme was
+    (re-)attained carry their own index, others -1; a running max over
+    those yields the index of the current extreme."""
+    ax = axis % x.ndim
+    n = x.shape[ax]
+    pos = jnp.expand_dims(jnp.arange(n),
+                          tuple(d for d in range(x.ndim) if d != ax))
+    idx_at = jnp.where(x == values, pos, -1)
+    out = jax.lax.cummax(idx_at, axis=ax)
+    return out.astype(_dtype_mod.convert_dtype("int64"))
+
+
 @defop("cummax", differentiable=False)
 def cummax(x, axis=None):
     if axis is None:
         x = x.reshape(-1)
         axis = 0
     values = jax.lax.cummax(x, axis=axis)
-    return values
+    return values, _cum_extreme_indices(x, values, axis)
 
 
 @defop("cummin", differentiable=False)
@@ -295,7 +309,8 @@ def cummin(x, axis=None):
     if axis is None:
         x = x.reshape(-1)
         axis = 0
-    return jax.lax.cummin(x, axis=axis)
+    values = jax.lax.cummin(x, axis=axis)
+    return values, _cum_extreme_indices(x, values, axis)
 
 
 @defop("count_nonzero", differentiable=False)
@@ -329,9 +344,19 @@ def mode(x, axis=-1, keepdim=False, name=None):
 
     def fn(x):
         xm = jnp.moveaxis(x, axis, -1)
+        n = xm.shape[-1]
         counts = (xm[..., :, None] == xm[..., None, :]).sum(-1)
-        pos = jnp.argmax(counts, axis=-1)
-        values = jnp.take_along_axis(xm, pos[..., None], axis=-1)[..., 0]
+        # torch/paddle tie conventions: smallest most-frequent value,
+        # index of its last occurrence
+        maxc = counts.max(-1, keepdims=True)
+        # dtype-preserving "ignore" sentinel (inf would promote ints to float)
+        if jnp.issubdtype(xm.dtype, jnp.inexact):
+            big = jnp.asarray(jnp.inf, xm.dtype)
+        else:
+            big = jnp.asarray(jnp.iinfo(xm.dtype).max, xm.dtype)
+        values = jnp.where(counts == maxc, xm, big).min(-1)
+        eq = xm == values[..., None]
+        pos = jnp.where(eq, jnp.arange(n), -1).max(-1)
         if keepdim:
             values = jnp.expand_dims(values, axis)
             pos = jnp.expand_dims(pos, axis)

@@ -1,0 +1,623 @@
+"""Registry-wide op sweep: numpy-reference forward + numeric grad checks.
+
+Parity model: /root/reference/test/legacy_test/op_test.py (OpTest :418,
+check_grad :3081) — every spec below is (public fn, independent numpy/scipy
+reference, dtypes, grad-checked inputs). test_registry_swept asserts every
+op registered in ops.registry.OPS is either covered here or whitelisted
+with a reason (the role of test/white_list/op_accuracy_white_list.py).
+"""
+import numpy as np
+import pytest
+import scipy.special as sp
+
+import paddle_tpu as paddle
+from op_harness import OpSpec, run_spec
+
+R = np.random.RandomState(1234)
+
+
+def _arr(shape=(3, 4), lo=-2.0, hi=2.0):
+    return R.uniform(lo, hi, shape)
+
+
+def _pos(shape=(3, 4), lo=0.1, hi=3.0):
+    return R.uniform(lo, hi, shape)
+
+
+def _ints(shape=(3, 4), lo=0, hi=8):
+    return R.randint(lo, hi, shape).astype("int64")
+
+
+def _spd(n=4):
+    a = R.uniform(-1, 1, (n, n))
+    return a @ a.T + n * np.eye(n)
+
+
+def U(name, ref, x=None, grad=True, covers=(), **kw):
+    """Unary elementwise spec."""
+    x = _arr() if x is None else x
+    return OpSpec(name=name, inputs={"x": x}, ref=lambda x: ref(x),
+                  grad=("x",) if grad else (), covers=covers, **kw)
+
+
+def B(name, ref, x=None, y=None, grad=("x", "y"), covers=(), **kw):
+    """Binary (broadcasting) spec."""
+    x = _arr() if x is None else x
+    y = _arr((4,)) if y is None else y
+    return OpSpec(name=name, inputs={"x": x, "y": y},
+                  ref=lambda x, y: ref(x, y), grad=tuple(grad),
+                  covers=covers, **kw)
+
+
+def RED(name, ref, x=None, grad=True, **kw):
+    """Reduction spec: checks full, per-axis, and keepdim forms."""
+    x = _arr((3, 4, 2)) if x is None else x
+    specs = []
+    for attrs in ({}, {"axis": 1}, {"axis": -1, "keepdim": True}):
+        def mkref(attrs=attrs):
+            def f(x, **_):
+                ax = attrs.get("axis")
+                return ref(x, axis=ax, keepdims=attrs.get("keepdim", False))
+            return f
+        specs.append(OpSpec(name=name, inputs={"x": x}, ref=mkref(),
+                            attrs=dict(attrs),
+                            grad=("x",) if grad else (), **kw))
+    return specs
+
+
+_softplus = lambda x: np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)
+_sigmoid = lambda x: 1 / (1 + np.exp(-x))
+
+SPECS = [
+    # ---- unary math ----------------------------------------------------------
+    U("abs", np.abs, x=_arr() + 0.3),  # keep away from the |x| kink
+    U("acos", np.arccos, x=_arr(lo=-0.9, hi=0.9)),
+    U("acosh", np.arccosh, x=_pos(lo=1.2, hi=4.0)),
+    U("asin", np.arcsin, x=_arr(lo=-0.9, hi=0.9)),
+    U("asinh", np.arcsinh),
+    U("atan", np.arctan),
+    U("atanh", np.arctanh, x=_arr(lo=-0.8, hi=0.8)),
+    U("ceil", np.ceil, grad=False),
+    U("cos", np.cos),
+    U("cosh", np.cosh),
+    U("deg2rad", np.deg2rad),
+    U("digamma", sp.digamma, x=_pos(lo=0.5)),
+    U("erf", sp.erf),
+    U("erfinv", sp.erfinv, x=_arr(lo=-0.9, hi=0.9), rtol=1e-4, atol=1e-5),
+    U("exp", np.exp),
+    U("expm1", np.expm1),
+    U("floor", np.floor, grad=False),
+    U("frac", lambda x: x - np.trunc(x), grad=False),
+    U("i0", sp.i0, rtol=1e-4, atol=1e-5),
+    U("i1", sp.i1, rtol=1e-4, atol=1e-5),
+    U("lgamma", sp.gammaln, x=_pos(lo=0.5), rtol=1e-4, atol=1e-5),
+    U("log", np.log, x=_pos()),
+    U("log10", np.log10, x=_pos()),
+    U("log1p", np.log1p, x=_pos(lo=-0.5)),
+    U("log2", np.log2, x=_pos()),
+    U("neg", np.negative),
+    U("rad2deg", np.rad2deg, rtol=1e-4, atol=1e-4),
+    U("reciprocal", np.reciprocal, x=_pos(lo=0.4)),
+    U("round", np.round, grad=False),
+    U("rsqrt", lambda x: 1 / np.sqrt(x), x=_pos(lo=0.3)),
+    U("sign", np.sign, x=_arr() + 0.2, grad=False),
+    U("sin", np.sin),
+    U("sinh", np.sinh),
+    U("sqrt", np.sqrt, x=_pos(lo=0.2)),
+    U("square", np.square),
+    U("tan", np.tan, x=_arr(lo=-1.2, hi=1.2)),
+    U("tanh", np.tanh),
+    U("trunc", np.trunc, grad=False),
+    U("angle", np.angle, x=_arr() + 0.3, grad=False),
+    U("conj", np.conj),
+    U("real", np.real),
+    U("imag", np.imag, grad=False),  # imag(real tensor) == 0, grad is 0-fn
+    OpSpec(name="logit", inputs={"x": _arr(lo=0.1, hi=0.9)},
+           ref=lambda x: np.log(x / (1 - x)), grad=("x",)),
+    OpSpec(name="polygamma", inputs={"x": _pos(lo=0.6)}, attrs={"n": 1},
+           ref=lambda x, n: sp.polygamma(n, x), rtol=1e-4, atol=1e-4,
+           grad=("x",)),
+    OpSpec(name="nan_to_num",
+           inputs={"x": np.array([1.0, np.nan, np.inf, -np.inf, 2.0])},
+           ref=lambda x: np.nan_to_num(x, posinf=np.finfo(np.float32).max,
+                                       neginf=np.finfo(np.float32).min),
+           grad=()),
+    OpSpec(name="cast", inputs={"x": _arr()}, attrs={"dtype": "int32"},
+           ref=lambda x, dtype: x.astype(dtype), grad=(), out_cast=False),
+    OpSpec(name="scale", inputs={"x": _arr()},
+           attrs={"scale": 2.5, "bias": 0.5},
+           ref=lambda x, scale, bias: x * scale + bias, grad=("x",)),
+    OpSpec(name="clip", inputs={"x": _arr()}, attrs={"min": -0.5, "max": 1.0},
+           ref=lambda x, min, max: np.clip(x, min, max), grad=("x",)),
+    OpSpec(name="stanh", inputs={"x": _arr()},
+           attrs={"scale_a": 0.67, "scale_b": 1.7159},
+           ref=lambda x, scale_a, scale_b: scale_b * np.tanh(scale_a * x),
+           grad=("x",)),
+
+    # ---- activations ---------------------------------------------------------
+    U("nn.functional.relu", lambda x: np.maximum(x, 0), x=_arr() + 0.15),
+    U("nn.functional.relu6", lambda x: np.clip(x, 0, 6), x=_arr() + 0.15),
+    U("sigmoid", _sigmoid),
+    U("nn.functional.log_sigmoid", lambda x: -_softplus(-x)),
+    U("nn.functional.silu", lambda x: x * _sigmoid(x)),
+    U("nn.functional.mish", lambda x: x * np.tanh(_softplus(x))),
+    U("nn.functional.softsign", lambda x: x / (1 + np.abs(x))),
+    U("nn.functional.tanhshrink", lambda x: x - np.tanh(x)),
+    U("nn.functional.selu", lambda x: 1.0507009873554805 * np.where(
+        x > 0, x, 1.6732632423543772 * (np.exp(x) - 1)), x=_arr() + 0.15),
+    U("nn.functional.hardswish", lambda x: x * np.clip(x + 3, 0, 6) / 6,
+      x=_arr(lo=-5, hi=5) + 0.1),
+
+    # ---- binary math ---------------------------------------------------------
+    B("add", np.add),
+    B("subtract", np.subtract),
+    B("multiply", np.multiply),
+    B("divide", np.divide, y=_pos((4,), lo=0.4)),
+    B("divide_no_nan",
+      lambda x, y: np.where(y == 0, 0.0, x / np.where(y == 0, 1.0, y)),
+      y=np.array([0.5, 0.0, 2.0, 0.0]), grad=()),
+    B("floor_divide", np.floor_divide, y=_pos((4,), lo=0.4), grad=()),
+    B("remainder", lambda x, y: np.mod(x, y), y=_pos((4,), lo=0.5), grad=()),
+    B("pow", np.power, x=_pos(lo=0.3), y=_pos((4,), lo=0.5, hi=2.0)),
+    B("maximum", np.maximum, grad=()),
+    B("minimum", np.minimum, grad=()),
+    B("fmax", np.fmax, grad=()),
+    B("fmin", np.fmin, grad=()),
+    B("atan2", np.arctan2, x=_pos(), y=_pos((4,))),
+    B("copysign", np.copysign, x=_arr() + 0.3, y=_arr((4,)) + 0.2, grad=("x",)),
+    B("hypot", np.hypot, x=_pos(lo=0.3), y=_pos((4,), lo=0.3)),
+    B("logaddexp", np.logaddexp),
+    B("nextafter", lambda x, y: np.nextafter(
+          x.astype("float32"), y.astype("float32")), grad=(), rtol=0, atol=0),
+    B("heaviside", np.heaviside, x=_arr() + 0.2, y=_arr((4,)), grad=()),
+    OpSpec(name="ldexp", inputs={"x": _arr(), "y": _ints((4,), 0, 4)},
+           ref=lambda x, y: np.ldexp(x, y), grad=()),
+    OpSpec(name="lerp", inputs={"x": _arr(), "y": _arr(), "weight": _pos(lo=0.1, hi=0.9)},
+           ref=lambda x, y, weight: x + weight * (y - x),
+           grad=("x", "y", "weight")),
+    OpSpec(name="gcd", inputs={"x": _ints(lo=1, hi=30), "y": _ints(lo=1, hi=30)},
+           ref=lambda x, y: np.gcd(x, y), grad=()),
+    OpSpec(name="lcm", inputs={"x": _ints(lo=1, hi=12), "y": _ints(lo=1, hi=12)},
+           ref=lambda x, y: np.lcm(x, y), grad=()),
+
+    # ---- bitwise / logical / compare ----------------------------------------
+    OpSpec(name="bitwise_and", inputs={"x": _ints(), "y": _ints()}, ref=lambda x, y: np.bitwise_and(x, y)),
+    OpSpec(name="bitwise_or", inputs={"x": _ints(), "y": _ints()}, ref=lambda x, y: np.bitwise_or(x, y)),
+    OpSpec(name="bitwise_xor", inputs={"x": _ints(), "y": _ints()}, ref=lambda x, y: np.bitwise_xor(x, y)),
+    OpSpec(name="bitwise_not", inputs={"x": _ints()}, ref=lambda x: np.bitwise_not(x)),
+    OpSpec(name="bitwise_left_shift", inputs={"x": _ints(), "y": _ints(lo=0, hi=4)},
+           ref=lambda x, y: np.left_shift(x, y)),
+    OpSpec(name="bitwise_right_shift", inputs={"x": _ints(hi=64), "y": _ints(lo=0, hi=4)},
+           ref=lambda x, y: np.right_shift(x, y)),
+    OpSpec(name="logical_and", inputs={"x": _ints(hi=2).astype(bool), "y": _ints(hi=2).astype(bool)},
+           ref=lambda x, y: np.logical_and(x, y)),
+    OpSpec(name="logical_or", inputs={"x": _ints(hi=2).astype(bool), "y": _ints(hi=2).astype(bool)},
+           ref=lambda x, y: np.logical_or(x, y)),
+    OpSpec(name="logical_xor", inputs={"x": _ints(hi=2).astype(bool), "y": _ints(hi=2).astype(bool)},
+           ref=lambda x, y: np.logical_xor(x, y)),
+    OpSpec(name="logical_not", inputs={"x": _ints(hi=2).astype(bool)},
+           ref=lambda x: np.logical_not(x)),
+    B("equal", np.equal, y=_arr((4,)), grad=()),
+    B("not_equal", np.not_equal, grad=()),
+    B("greater_equal", np.greater_equal, grad=()),
+    B("greater_than", np.greater, grad=()),
+    B("less_equal", np.less_equal, grad=()),
+    B("less_than", np.less, grad=()),
+    B("equal_all", lambda x, y: np.array(np.array_equal(x, y)), grad=()),
+    B("allclose", lambda x, y: np.array(np.allclose(x, y)), grad=()),
+    B("isclose", np.isclose, grad=()),
+    U("isfinite", np.isfinite, grad=False),
+    U("isinf", np.isinf, grad=False),
+    U("isnan", np.isnan, grad=False),
+    U("isneginf", np.isneginf, grad=False),
+    U("isposinf", np.isposinf, grad=False),
+    U("isreal", np.isreal, grad=False),
+
+    # ---- reductions ----------------------------------------------------------
+    *RED("sum", np.sum),
+    *RED("mean", np.mean),
+    *RED("prod", np.prod, x=_arr((3, 4, 2), lo=0.5, hi=1.5)),
+    *RED("max", np.max, x=_arr((3, 4, 2)) * 7, grad=False),
+    *RED("min", np.min, x=_arr((3, 4, 2)) * 7, grad=False),
+    *RED("amax", np.max, x=_arr((3, 4, 2)) * 7, grad=False),
+    *RED("amin", np.min, x=_arr((3, 4, 2)) * 7, grad=False),
+    *RED("all", lambda x, axis=None, keepdims=False: np.all(x, axis=axis, keepdims=keepdims),
+         x=_ints((3, 4, 2), hi=2).astype(bool), grad=False),
+    *RED("any", lambda x, axis=None, keepdims=False: np.any(x, axis=axis, keepdims=keepdims),
+         x=_ints((3, 4, 2), hi=2).astype(bool), grad=False),
+    *RED("nansum", np.nansum, grad=False),
+    *RED("nanmean", np.nanmean, grad=False),
+    *RED("logsumexp", lambda x, axis=None, keepdims=False: sp.logsumexp(x, axis=axis, keepdims=keepdims)),
+    *RED("median", lambda x, axis=None, keepdims=False: np.median(x, axis=axis, keepdims=keepdims),
+         x=_arr((3, 5)), grad=False),
+    *RED("nanmedian", lambda x, axis=None, keepdims=False: np.nanmedian(x, axis=axis, keepdims=keepdims),
+         x=_arr((3, 5)), grad=False),
+    *RED("count_nonzero", lambda x, axis=None, keepdims=False:
+         np.count_nonzero(x, axis=axis, keepdims=keepdims), grad=False),
+    OpSpec(name="std", inputs={"x": _arr((3, 5))},
+           ref=lambda x: np.std(x, ddof=1), grad=("x",)),
+    OpSpec(name="std", inputs={"x": _arr((3, 5))}, attrs={"axis": 1},
+           ref=lambda x, axis: np.std(x, axis=axis, ddof=1), grad=("x",)),
+    OpSpec(name="var", inputs={"x": _arr((3, 5))},
+           ref=lambda x: np.var(x, ddof=1), grad=("x",)),
+    OpSpec(name="var", inputs={"x": _arr((3, 5))},
+           attrs={"axis": 0, "unbiased": False},
+           ref=lambda x, axis, unbiased: np.var(x, axis=axis, ddof=0),
+           grad=("x",)),
+    OpSpec(name="argmax", inputs={"x": _arr((3, 5)) * 9}, attrs={"axis": 1},
+           ref=lambda x, axis: np.argmax(x, axis=axis), out_cast=False, grad=()),
+    OpSpec(name="argmin", inputs={"x": _arr((3, 5)) * 9}, attrs={"axis": 0},
+           ref=lambda x, axis: np.argmin(x, axis=axis), out_cast=False, grad=()),
+
+    # ---- cumulative ----------------------------------------------------------
+    OpSpec(name="cumsum", inputs={"x": _arr((3, 4))}, attrs={"axis": 1},
+           ref=lambda x, axis: np.cumsum(x, axis=axis), grad=("x",)),
+    OpSpec(name="cumprod", inputs={"x": _arr((3, 4), lo=0.4, hi=1.6)},
+           attrs={"dim": 1},
+           ref=lambda x, dim: np.cumprod(x, axis=dim), grad=("x",)),
+    OpSpec(name="logcumsumexp", inputs={"x": _arr((3, 4))}, attrs={"axis": 1},
+           ref=lambda x, axis: np.log(np.cumsum(np.exp(x), axis=axis)),
+           grad=("x",)),
+    OpSpec(name="cummax", inputs={"x": _arr((3, 4)) * 5}, attrs={"axis": 1},
+           ref=lambda x, axis: (np.maximum.accumulate(x, axis=axis),
+                                _cum_idx(x, axis, np.greater_equal)),
+           out_cast=False, grad=()),
+    OpSpec(name="cummin", inputs={"x": _arr((3, 4)) * 5}, attrs={"axis": 1},
+           ref=lambda x, axis: (np.minimum.accumulate(x, axis=axis),
+                                _cum_idx(x, axis, np.less_equal)),
+           out_cast=False, grad=()),
+
+    # ---- linalg --------------------------------------------------------------
+    OpSpec(name="matmul", inputs={"x": _arr((3, 4)), "y": _arr((4, 5))},
+           ref=lambda x, y: x @ y, grad=("x", "y")),
+    OpSpec(name="bmm", inputs={"x": _arr((2, 3, 4)), "y": _arr((2, 4, 5))},
+           ref=lambda x, y: x @ y, grad=("x", "y")),
+    OpSpec(name="mm", inputs={"x": _arr((3, 4)), "y": _arr((4, 5))},
+           ref=lambda x, y: x @ y, grad=("x", "y")),
+    OpSpec(name="mv", inputs={"x": _arr((3, 4)), "vec": _arr((4,))},
+           ref=lambda x, vec: x @ vec, grad=("x", "vec")),
+    OpSpec(name="dot", inputs={"x": _arr((5,)), "y": _arr((5,))},
+           ref=lambda x, y: np.array(np.dot(x, y)), grad=("x", "y")),
+    B("inner", np.inner, x=_arr((3, 4)), y=_arr((2, 4))),
+    B("outer", np.outer, x=_arr((3,)), y=_arr((4,))),
+    B("kron", np.kron, x=_arr((2, 3)), y=_arr((3, 2))),
+    B("cross", lambda x, y: np.cross(x, y), x=_arr((4, 3)), y=_arr((4, 3))),
+    OpSpec(name="trace", inputs={"x": _arr((4, 4))},
+           ref=lambda x: np.array(np.trace(x)), grad=("x",)),
+    OpSpec(name="diagonal", inputs={"x": _arr((3, 4))},
+           ref=lambda x: np.diagonal(x), grad=("x",)),
+    OpSpec(name="linalg.diag_embed", inputs={"x": _arr((3, 4))},
+           ref=lambda x: _diag_embed_ref(x), grad=()),
+    OpSpec(name="linalg.det", inputs={"x": _spd()},
+           ref=lambda x: np.array(np.linalg.det(x)), grad=("x",),
+           grad_rtol=3e-2),
+    OpSpec(name="linalg.inverse", inputs={"x": _spd()},
+           ref=lambda x: np.linalg.inv(x), grad=("x",), grad_rtol=3e-2),
+    # grad via symmetrized ref: numpy reads only the lower triangle, while
+    # the jax VJP distributes the cotangent across both triangles
+    OpSpec(name="linalg.cholesky", inputs={"x": _spd()},
+           ref=lambda x: np.linalg.cholesky((x + x.T) / 2),
+           grad=("x",), grad_rtol=3e-2),
+    OpSpec(name="linalg.solve", inputs={"x": _spd(), "y": _arr((4, 2))},
+           ref=lambda x, y: np.linalg.solve(x, y), grad=("x", "y"), grad_rtol=3e-2),
+    OpSpec(name="linalg.cholesky_solve", inputs={"x": _arr((4, 2)),
+                                          "y": np.linalg.cholesky(_spd())},
+           attrs={"upper": False},
+           ref=lambda x, y, upper: np.linalg.solve(y @ y.T, x), grad=(),
+           rtol=1e-4, atol=1e-5),
+    OpSpec(name="linalg.triangular_solve",
+           inputs={"x": np.tril(_arr((4, 4))) + 3 * np.eye(4), "y": _arr((4, 2))},
+           attrs={"upper": False},
+           ref=lambda x, y, upper: np.linalg.solve(x, y), grad=(),
+           rtol=1e-4, atol=1e-5),
+    OpSpec(name="linalg.matrix_power", inputs={"x": _spd()}, attrs={"n": 3},
+           ref=lambda x, n: np.linalg.matrix_power(x, n), rtol=1e-4, atol=1e-4, grad=("x",),
+           grad_rtol=5e-2, grad_atol=1e-2),
+    OpSpec(name="linalg.matrix_rank", inputs={"x": _spd()},
+           ref=lambda x: np.array(np.linalg.matrix_rank(x)), out_cast=False,
+           grad=()),
+    OpSpec(name="linalg.pinv", inputs={"x": _arr((4, 3))},
+           ref=lambda x: np.linalg.pinv(x), rtol=1e-4, atol=1e-5, grad=()),
+    OpSpec(name="linalg.cond", inputs={"x": _spd()},
+           ref=lambda x: np.array(np.linalg.cond(x)), rtol=1e-4, atol=1e-4,
+           grad=()),
+    OpSpec(name="linalg.multi_dot", inputs={"xs": [_arr((3, 4)), _arr((4, 5)), _arr((5, 2))]},
+           ref=lambda xs: np.linalg.multi_dot(xs), grad=()),
+    OpSpec(name="addmm", inputs={"input": _arr((3, 5)), "x": _arr((3, 4)),
+                                 "y": _arr((4, 5))},
+           attrs={"beta": 0.7, "alpha": 1.3},
+           ref=lambda input, x, y, beta, alpha: beta * input + alpha * (x @ y),
+           grad=("input", "x", "y")),
+    OpSpec(name="linalg.cov", inputs={"x": _arr((3, 6))},
+           ref=lambda x: np.cov(x), grad=("x",)),
+    OpSpec(name="linalg.corrcoef", inputs={"x": _arr((3, 6))},
+           ref=lambda x: np.corrcoef(x), grad=()),
+    OpSpec(name="dist", inputs={"x": _arr((3, 4)), "y": _arr((3, 4))},
+           attrs={"p": 2},
+           ref=lambda x, y, p: np.array(np.linalg.norm((x - y).ravel(), p)),
+           grad=("x", "y")),
+    OpSpec(name="linalg.householder_product",
+           inputs={"x": np.tril(_arr((4, 3)), -1) + np.eye(4, 3),
+                   "tau": _pos((3,), 0.1, 0.9)},
+           ref=lambda x, tau: _householder_ref(x, tau),
+           rtol=1e-4, atol=1e-5, grad=()),
+
+    # ---- manipulation --------------------------------------------------------
+    OpSpec(name="concat", inputs={"x": [_arr((2, 3)), _arr((2, 3))]},
+           attrs={"axis": 1},
+           ref=lambda x, axis: np.concatenate(x, axis=axis), grad=()),
+    OpSpec(name="stack", inputs={"x": [_arr((2, 3)), _arr((2, 3))]},
+           attrs={"axis": 0}, ref=lambda x, axis: np.stack(x, axis), grad=()),
+    OpSpec(name="reshape", inputs={"x": _arr((3, 4))}, attrs={"shape": [2, 6]},
+           ref=lambda x, shape: np.reshape(x, shape), grad=("x",)),
+    OpSpec(name="transpose", inputs={"x": _arr((2, 3, 4))},
+           attrs={"perm": [2, 0, 1]},
+           ref=lambda x, perm: np.transpose(x, perm), grad=("x",)),
+    OpSpec(name="t", inputs={"x": _arr((3, 4))},
+           ref=lambda x: x.T, grad=("x",)),
+    OpSpec(name="moveaxis", inputs={"x": _arr((2, 3, 4))},
+           attrs={"source": 0, "destination": 2},
+           ref=lambda x, source, destination: np.moveaxis(x, source, destination),
+           grad=("x",)),
+    OpSpec(name="swapaxes", inputs={"x": _arr((2, 3, 4))},
+           attrs={"axis0": 0, "axis1": 2},
+           ref=lambda x, axis0, axis1: np.swapaxes(x, axis0, axis1),
+           grad=("x",)),
+    OpSpec(name="flatten", inputs={"x": _arr((2, 3, 4))},
+           attrs={"start_axis": 1, "stop_axis": 2},
+           ref=lambda x, start_axis, stop_axis: x.reshape(2, 12), grad=("x",)),
+    OpSpec(name="squeeze", inputs={"x": _arr((3, 1, 4))}, attrs={"axis": 1},
+           ref=lambda x, axis: np.squeeze(x, axis), grad=("x",)),
+    OpSpec(name="unsqueeze", inputs={"x": _arr((3, 4))}, attrs={"axis": 1},
+           ref=lambda x, axis: np.expand_dims(x, axis), grad=("x",)),
+    OpSpec(name="tile", inputs={"x": _arr((2, 3))},
+           attrs={"repeat_times": [2, 2]},
+           ref=lambda x, repeat_times: np.tile(x, repeat_times), grad=("x",)),
+    OpSpec(name="expand", inputs={"x": _arr((1, 3))}, attrs={"shape": [4, 3]},
+           ref=lambda x, shape: np.broadcast_to(x, shape), grad=("x",)),
+    OpSpec(name="broadcast_to", inputs={"x": _arr((1, 3))},
+           attrs={"shape": [4, 3]},
+           ref=lambda x, shape: np.broadcast_to(x, shape), grad=("x",)),
+    OpSpec(name="expand_as", inputs={"x": _arr((1, 3)), "y": _arr((4, 3))},
+           ref=lambda x, y: np.broadcast_to(x, y.shape), grad=()),
+    OpSpec(name="flip", inputs={"x": _arr((3, 4))}, attrs={"axis": [0]},
+           ref=lambda x, axis: np.flip(x, axis), grad=("x",)),
+    OpSpec(name="rot90", inputs={"x": _arr((3, 4))}, attrs={"k": 1},
+           ref=lambda x, k: np.rot90(x, k), grad=("x",)),
+    OpSpec(name="roll", inputs={"x": _arr((3, 4))},
+           attrs={"shifts": 2, "axis": 1},
+           ref=lambda x, shifts, axis: np.roll(x, shifts, axis), grad=("x",)),
+    OpSpec(name="tril", inputs={"x": _arr((4, 4))},
+           ref=lambda x: np.tril(x), grad=("x",)),
+    OpSpec(name="triu", inputs={"x": _arr((4, 4))},
+           ref=lambda x: np.triu(x), grad=("x",)),
+    OpSpec(name="diag", inputs={"x": _arr((4,))},
+           ref=lambda x: np.diag(x), grad=("x",)),
+    OpSpec(name="diagflat", inputs={"x": _arr((2, 3))},
+           ref=lambda x: np.diagflat(x), grad=()),
+    OpSpec(name="gather", inputs={"x": _arr((5, 3)),
+                                  "index": np.array([0, 2, 4])},
+           ref=lambda x, index: x[index], grad=("x",)),
+    OpSpec(name="gather_nd", inputs={"x": _arr((3, 4)),
+                                     "index": np.array([[0, 1], [2, 3]])},
+           ref=lambda x, index: x[tuple(index.T)], grad=("x",)),
+    OpSpec(name="index_select", inputs={"x": _arr((5, 3)),
+                                        "index": np.array([1, 1, 3])},
+           attrs={"axis": 0},
+           ref=lambda x, index, axis: np.take(x, index, axis), grad=("x",)),
+    OpSpec(name="index_sample", inputs={"x": _arr((3, 5)),
+                                        "index": _ints((3, 2), 0, 5)},
+           ref=lambda x, index: np.take_along_axis(x, index, 1), grad=("x",)),
+    OpSpec(name="take", inputs={"x": _arr((3, 4)),
+                                "index": np.array([0, 5, 11])},
+           ref=lambda x, index: x.ravel()[index], grad=()),
+    OpSpec(name="take_along_axis", inputs={"x": _arr((3, 5)),
+                                           "indices": _ints((3, 2), 0, 5)},
+           attrs={"axis": 1},
+           ref=lambda x, indices, axis: np.take_along_axis(x, indices, axis),
+           grad=()),
+    OpSpec(name="masked_select",
+           inputs={"x": np.array([1.0, 2.0, 3.0, 4.0]),
+                   "mask": np.array([True, False, True, False])},
+           ref=lambda x, mask: x[mask], grad=()),
+    OpSpec(name="masked_fill",
+           inputs={"x": _arr((3, 4)),
+                   "mask": _ints((3, 4), 0, 2).astype(bool)},
+           attrs={"value": -1.5},
+           ref=lambda x, mask, value: np.where(mask, value, x), grad=("x",)),
+    OpSpec(name="where", inputs={"condition": _ints((3, 4), 0, 2).astype(bool),
+                                 "x": _arr((3, 4)), "y": _arr((3, 4))},
+           ref=lambda condition, x, y: np.where(condition, x, y),
+           grad=("x", "y")),
+    OpSpec(name="multiplex", inputs={"inputs": [_arr((4, 3)), _arr((4, 3))],
+                                     "index": np.array([[0], [1], [0], [1]])},
+           ref=lambda inputs, index: np.stack(
+               [inputs[int(i)][r] for r, i in enumerate(index[:, 0])]),
+           grad=()),
+    OpSpec(name="pad", inputs={"x": _arr((3, 4))},
+           attrs={"pad": [1, 1, 0, 2], "value": 0.5},
+           ref=lambda x, pad, value: np.pad(
+               x, [(pad[0], pad[1]), (pad[2], pad[3])],
+               constant_values=value),
+           grad=("x",)),
+    OpSpec(name="slice", inputs={"x": _arr((4, 5))},
+           attrs={"axes": [0, 1], "starts": [1, 0], "ends": [3, 4]},
+           ref=lambda x, axes, starts, ends: x[1:3, 0:4], grad=("x",)),
+    OpSpec(name="strided_slice", inputs={"x": _arr((6, 5))},
+           attrs={"axes": [0], "starts": [0], "ends": [6], "strides": [2]},
+           ref=lambda x, axes, starts, ends, strides: x[::2], grad=("x",)),
+    OpSpec(name="crop", inputs={"x": _arr((4, 5))},
+           attrs={"shape": [2, 3], "offsets": [1, 1]},
+           ref=lambda x, shape, offsets: x[1:3, 1:4], grad=()),
+    OpSpec(name="repeat_interleave", inputs={"x": _arr((3, 2))},
+           attrs={"repeats": 2, "axis": 0},
+           ref=lambda x, repeats, axis: np.repeat(x, repeats, axis),
+           grad=("x",)),
+    OpSpec(name="unbind", inputs={"x": _arr((3, 4))}, attrs={"axis": 0},
+           ref=lambda x, axis: [x[i] for i in range(3)], grad=()),
+    OpSpec(name="unstack", inputs={"x": _arr((3, 4))}, attrs={"axis": 0},
+           ref=lambda x, axis: [x[i] for i in range(3)], grad=()),
+    OpSpec(name="split", inputs={"x": _arr((4, 6))},
+           attrs={"num_or_sections": 2, "axis": 1},
+           ref=lambda x, num_or_sections, axis: np.split(x, 2, axis), grad=()),
+    OpSpec(name="chunk", inputs={"x": _arr((4, 6))},
+           attrs={"chunks": 3, "axis": 1},
+           ref=lambda x, chunks, axis: np.split(x, 3, axis), grad=()),
+    OpSpec(name="as_complex", inputs={"x": np.stack([_arr((3, 4)), _arr((3, 4))], -1)},
+           ref=lambda x: x[..., 0] + 1j * x[..., 1], grad=(), out_cast=False,
+           rtol=1e-6, atol=1e-6),
+    OpSpec(name="as_real", inputs={"x": (_arr((3, 4)) + 1j * _arr((3, 4))).astype("complex64")},
+           ref=lambda x: np.stack([x.real, x.imag], -1), grad=(),
+           rtol=1e-6, atol=1e-6),
+
+    # ---- sorting / search ----------------------------------------------------
+    OpSpec(name="sort", inputs={"x": _arr((3, 5)) * 9},
+           ref=lambda x: np.sort(x, axis=-1), grad=("x",)),
+    OpSpec(name="argsort", inputs={"x": _arr((3, 5)) * 9},
+           ref=lambda x: np.argsort(x, axis=-1, kind="stable"),
+           out_cast=False, grad=()),
+    OpSpec(name="topk", inputs={"x": _arr((3, 6)) * 9}, attrs={"k": 2},
+           ref=lambda x, k: (np.sort(x, -1)[:, ::-1][:, :k],
+                             np.argsort(-x, -1, kind="stable")[:, :k]),
+           out_cast=False, grad=()),
+    OpSpec(name="kthvalue", inputs={"x": _arr((3, 6)) * 9}, attrs={"k": 2},
+           ref=lambda x, k: (np.sort(x, -1)[:, k - 1],
+                             np.argsort(x, -1, kind="stable")[:, k - 1]),
+           out_cast=False, grad=()),
+    OpSpec(name="mode", inputs={"x": _ints((3, 5), 0, 3).astype("float64")},
+           ref=lambda x: _mode_ref(x), out_cast=False, grad=()),
+    OpSpec(name="searchsorted",
+           inputs={"sorted_sequence": np.array([1.0, 3.0, 5.0, 7.0]),
+                   "values": np.array([0.0, 4.0, 8.0])},
+           ref=lambda sorted_sequence, values: np.searchsorted(
+               sorted_sequence, values), out_cast=False, grad=()),
+    OpSpec(name="bucketize",
+           inputs={"x": np.array([0.0, 2.0, 4.0, 6.0]),
+                   "sorted_sequence": np.array([1.0, 3.0, 5.0])},
+           ref=lambda x, sorted_sequence: np.searchsorted(sorted_sequence, x),
+           out_cast=False, grad=()),
+    OpSpec(name="nonzero", inputs={"x": np.array([[1.0, 0.0], [0.0, 2.0]])},
+           ref=lambda x: np.stack(np.nonzero(x), -1), out_cast=False, grad=()),
+    OpSpec(name="unique", inputs={"x": np.array([3.0, 1.0, 3.0, 2.0])},
+           ref=lambda x: np.unique(x), grad=()),
+    OpSpec(name="unique_consecutive",
+           inputs={"x": np.array([1.0, 1.0, 2.0, 2.0, 3.0, 1.0])},
+           ref=lambda x: np.array([1.0, 2.0, 3.0, 1.0]), grad=()),
+    OpSpec(name="histogram", inputs={"x": _pos((20,), 0.0, 1.0)},
+           attrs={"bins": 4, "min": 0.0, "max": 1.0},
+           ref=lambda x, bins, min, max: np.histogram(
+               x, bins=bins, range=(min, max))[0],
+           out_cast=False, grad=()),
+    OpSpec(name="bincount", inputs={"x": _ints((12,), 0, 5)},
+           ref=lambda x: np.bincount(x), out_cast=False, grad=()),
+
+    # ---- misc ----------------------------------------------------------------
+    OpSpec(name="trapezoid", inputs={"y": _arr((3, 5))}, attrs={"dx": 0.5},
+           ref=lambda y, dx: np.trapz(y, dx=dx, axis=-1), grad=("y",)),
+    OpSpec(name="diff", inputs={"x": _arr((3, 5))},
+           ref=lambda x: np.diff(x, axis=-1), grad=("x",)),
+    OpSpec(name="norm", inputs={"x": _arr((3, 4))},
+           ref=lambda x: np.array(np.linalg.norm(x)), grad=("x",)),
+    OpSpec(name="norm", inputs={"x": _arr((3, 4))}, attrs={"p": 1, "axis": 1},
+           ref=lambda x, p, axis: np.linalg.norm(x, p, axis), grad=()),
+    OpSpec(name="tensordot", inputs={"x": _arr((3, 4)), "y": _arr((4, 5))},
+           attrs={"axes": 1},
+           ref=lambda x, y, axes: np.tensordot(x, y, axes), grad=()),
+    OpSpec(name="dot", inputs={"x": _arr((2, 5)), "y": _arr((2, 5))},
+           ref=lambda x, y: np.sum(x * y, -1), grad=("x", "y")),
+]
+
+
+def _cum_idx(x, axis, cmp):
+    """Running-extreme indices, latest occurrence winning ties (torch/paddle
+    cummax/cummin convention)."""
+    running = np.take(x, [0], axis=axis)
+    run_idx = np.zeros(running.shape, "int64")
+    parts = []
+    for i in range(x.shape[axis]):
+        cur = np.take(x, [i], axis=axis)
+        better = cmp(cur, running)
+        running = np.where(better, cur, running)
+        run_idx = np.where(better, i, run_idx)
+        parts.append(run_idx.copy())
+    return np.concatenate(parts, axis=axis)
+
+
+def _diag_embed_ref(x):
+    out = np.zeros(x.shape + (x.shape[-1],), x.dtype)
+    for i in range(x.shape[0]):
+        out[i] = np.diag(x[i])
+    return out
+
+
+def _householder_ref(x, tau):
+    m, n = x.shape
+    q = np.eye(m)
+    for j in range(n):
+        v = x[:, j].copy()
+        v[:j] = 0
+        v[j] = 1
+        q = q @ (np.eye(m) - tau[j] * np.outer(v, v))
+    return q[:, :n]
+
+
+def _mode_ref(x):
+    """Smallest most-frequent value, last-occurrence index (torch/paddle
+    mode tie convention)."""
+    vals = np.zeros(x.shape[0])
+    idxs = np.zeros(x.shape[0], "int64")
+    for r in range(x.shape[0]):
+        uniq, counts = np.unique(x[r], return_counts=True)
+        best = uniq[counts == counts.max()].min()
+        vals[r] = best
+        idxs[r] = np.where(x[r] == best)[0][-1]
+    return vals, idxs
+
+
+_IDS = [f"{i}_{s.name.replace('.', '_')}" for i, s in enumerate(SPECS)]
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=_IDS)
+def test_op(spec):
+    run_spec(spec)
+
+
+def test_einsum_and_atleast():
+    """Positional-vararg signatures the OpSpec harness can't express."""
+    a, b = _arr((3, 4)), _arr((4, 5))
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a.astype("float32")),
+                        paddle.to_tensor(b.astype("float32")))
+    np.testing.assert_allclose(out.numpy(), (a @ b).astype("float32"),
+                               rtol=1e-5, atol=1e-6)
+    v = _arr((4,)).astype("float32")
+    np.testing.assert_allclose(
+        paddle.atleast_2d(paddle.to_tensor(v)).numpy(), np.atleast_2d(v))
+    assert paddle.atleast_1d(paddle.to_tensor(v)).shape == [4]
+    assert paddle.atleast_3d(paddle.to_tensor(v)).numpy().ndim == 3
+
+
+# ---- registry completeness ---------------------------------------------------
+
+# Ops that cannot be checked by this harness, each with the reason —
+# the role of the reference's test/white_list/ files.
+WHITELIST = {
+    # positional-vararg signature; dedicated test_einsum_and_atleast
+    "einsum": "vararg signature; test_einsum_and_atleast",
+}
+
+
+def test_registry_swept():
+    """Every registered op is covered by a spec (by name or `covers`) or
+    whitelisted with a reason."""
+    from paddle_tpu.ops.registry import OPS
+
+    covered = set()
+    for s in SPECS:
+        covered.add(s.name.split(".")[-1])
+        covered.update(s.covers)
+    missing = [n for n in sorted(OPS)
+               if n not in covered and n not in WHITELIST
+               and not n.rstrip("_") in covered]
+    assert not missing, (
+        f"{len(missing)} registered ops lack an OpSpec or whitelist entry: "
+        f"{missing}")
