@@ -216,9 +216,175 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False, grads_out=N
                     hook(g)
 
     if not retain_graph:
-        # free only the walked graph; independent live graphs keep their nodes
+        # free the walked graph; also GC nodes whose every output tensor has
+        # died — they can never receive a cotangent again, and keeping them
+        # leaks their saved arrays (the create_graph training-loop pattern
+        # retains forward nodes that no later backward ever consumes)
         st = _st()
-        st.tape = [n for n in st.tape if id(n) not in consumed]
+        st.tape = [
+            n for n in st.tape
+            if id(n) not in consumed and any(r() is not None for r in n.out_refs)
+        ]
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused,
+                       retain_graph=None):
+    """``paddle.grad(create_graph=True)``: differentiable gradients.
+
+    TPU-native higher-order AD (role of the reference's prim/vjp_interface,
+    paddle/fluid/primitive/): slice the recorded tape to the subgraph between
+    ``inputs`` and ``outputs``, rebuild it as one pure composite function, and
+    take ``jax.vjp`` of the composite. The whole grad computation is recorded
+    back onto the tape as a single node, so a further backward()/grad() call
+    differentiates *through* it via jax's composable transforms — no manual
+    double-backward rules needed.
+    """
+    from ..tensor_class import Tensor
+
+    # a duplicated input would collapse in the id-keyed replay env and the
+    # later occurrences would shadow the earlier positional bindings in
+    # jax.vjp — dedupe here and fan the per-unique grads back out (paddle
+    # gives every duplicate the full gradient)
+    uniq, pos_of = [], []
+    seen: dict[int, int] = {}
+    for t in inputs:
+        if id(t) not in seen:
+            seen[id(t)] = len(uniq)
+            uniq.append(t)
+        pos_of.append(seen[id(t)])
+    if len(uniq) != len(inputs):
+        res_u = _grad_create_graph(outputs, uniq, grad_outputs, allow_unused,
+                                   retain_graph)
+        return [res_u[i] for i in pos_of]
+
+    tape = list(_st().tape)
+    input_ids = {id(t) for t in inputs}
+
+    # forward slice: nodes whose output depends (transitively) on any input
+    reach = set(input_ids)
+    fwd_nodes = []
+    for node in tape:
+        depends = any(
+            t is not None and id(t) in reach for t in node.in_tensors
+        )
+        if not depends:
+            continue
+        fwd_nodes.append(node)
+        for r in node.out_refs:
+            o = r()
+            if o is not None:
+                reach.add(id(o))
+
+    # backward slice: keep only nodes some requested output depends on
+    needed = {id(t) for t in outputs}
+    used = []
+    for node in reversed(fwd_nodes):
+        if any(r() is not None and id(r()) in needed for r in node.out_refs):
+            used.append(node)
+            for t in node.in_tensors:
+                if t is not None:
+                    needed.add(id(t))
+    used.reverse()
+    # only the pruned slice matters: a PyLayer elsewhere on the tape is fine
+    for node in used:
+        if hasattr(node, "run_backward"):
+            raise RuntimeError(
+                "paddle.grad(create_graph=True) through a PyLayer is not "
+                "supported; implement the op with a jax-differentiable "
+                "function (or jax.custom_vjp) instead"
+            )
+
+    used_input_ids = input_ids & needed
+    if not allow_unused:
+        for t in inputs:
+            if id(t) not in used_input_ids:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph. Set allow_unused=True if this "
+                    "is desired."
+                )
+
+    n_in = len(inputs)
+
+    def _composite(in_arrays):
+        env = {id(t): a for t, a in zip(inputs, in_arrays)}
+        for node in used:
+            args = [
+                env.get(id(t), a) if t is not None else a
+                for t, a in zip(node.in_tensors, node.in_arrays)
+            ]
+            res = node.fn(*args)
+            res = res if isinstance(res, (tuple, list)) else (res,)
+            for r, a in zip(node.out_refs, res):
+                o = r()
+                if o is not None:
+                    env[id(o)] = a
+        # an output independent of inputs contributes a constant (zero grad)
+        return tuple(
+            env.get(id(t), t._array) for t in outputs
+        )
+
+    # seed cotangents
+    seeds = []
+    seed_tensors = []
+    gos = grad_outputs or [None] * len(outputs)
+    for t, g in zip(outputs, gos):
+        if g is None:
+            if t._array.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t._array.shape)}"
+                )
+            seeds.append(jnp.ones_like(t._array))
+            seed_tensors.append(None)
+        else:
+            seeds.append(g._array if hasattr(g, "_array") else jnp.asarray(g))
+            seed_tensors.append(g if hasattr(g, "_array") else None)
+
+    def _grad_fn(*arrs):
+        prim, cots = arrs[:n_in], arrs[n_in:]
+        _, vjp_fn = jax.vjp(lambda *xs: _composite(xs), *prim)
+        return vjp_fn(tuple(cots))
+
+    in_arrays = [t._array for t in inputs] + list(seeds)
+    grads = _grad_fn(*in_arrays)
+
+    results = []
+    out_tensors = []
+    for t, g in zip(inputs, grads):
+        if id(t) not in used_input_ids:
+            results.append(None)
+            continue
+        r = Tensor._wrap(g, stop_gradient=False)
+        results.append(r)
+        out_tensors.append(r)
+    if out_tensors:
+        record(
+            _grad_fn,
+            in_arrays,
+            list(inputs) + seed_tensors,
+            out_tensors,
+            name="grad",
+        )
+        # record() links each output to the node positionally by out_refs;
+        # the node returns one grad per input, so outputs must line up with
+        # the full grads tuple — rebuild out_refs including unused slots.
+        node = out_tensors[0]._grad_node
+        node.out_refs = tuple(
+            weakref.ref(r) if r is not None else _dead_ref for r in results
+        )
+    if retain_graph is False:
+        # paddle semantics: retain_graph defaults to create_graph, but an
+        # explicit False frees the differentiated forward slice (the recorded
+        # grad node stays usable — it closes over the composite's arrays)
+        dropped = {id(n) for n in used}
+        st = _st()
+        st.tape = [n for n in st.tape if id(n) not in dropped]
+    return results
+
+
+def _dead_ref():
+    return None
 
 
 def grad(
@@ -230,13 +396,21 @@ def grad(
     allow_unused=False,
 ):
     """paddle.grad parity (paddle/fluid/eager/backward.cc:450 ``Grad``):
-    compute grads of outputs w.r.t. inputs without touching ``.grad``."""
+    compute grads of outputs w.r.t. inputs without touching ``.grad``.
+
+    With ``create_graph=True`` the returned grads are themselves
+    differentiable (recorded on the tape via a jax.vjp composite — see
+    ``_grad_create_graph``)."""
     if not isinstance(outputs, (list, tuple)):
         outputs = [outputs]
     if not isinstance(inputs, (list, tuple)):
         inputs = [inputs]
     if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
+
+    if create_graph:
+        return _grad_create_graph(outputs, inputs, grad_outputs, allow_unused,
+                                  retain_graph)
 
     collected = {id(t): None for t in inputs}
     retain = True if retain_graph is None else retain_graph

@@ -92,6 +92,12 @@ class _Fleet:
 
             stage_cls = ShardingStage1 if st.sharding_configs.stage == 1 else ShardingStage2
             shard_optimizer(optimizer, stage_cls(axis_name="sharding", mesh=hcg.mesh))
+        gm = st.gradient_merge
+        if gm.enable and int(gm.k_steps) > 1:
+            from .gradient_merge import GradientMergeOptimizer
+
+            optimizer = GradientMergeOptimizer(
+                optimizer, k_steps=int(gm.k_steps), avg=bool(gm.avg))
         return optimizer
 
 

@@ -196,14 +196,46 @@ def test_pylayer_composes_with_ops():
     np.testing.assert_allclose(x.grad.numpy(), 24.0)
 
 
-def test_higher_order_via_double_backward():
-    # d2/dx2 x^3 = 6x via paddle.grad twice is not supported by the tape
-    # (create_graph pending); verify the documented jax.grad escape hatch
-    import jax
+def test_create_graph_double_backward():
+    """d2/dx2 x^3 = 6x through paddle.grad(create_graph=True) twice.
 
-    f = lambda x: (x ** 3).sum()
-    g2 = jax.grad(jax.grad(f))(2.0)
-    np.testing.assert_allclose(g2, 12.0)
+    Parity: paddle/fluid/eager/backward.cc:450 Grad with create_graph; the
+    TPU build records the whole vjp composite as one differentiable node."""
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), 12.0)  # 3x^2
+    (g2,) = paddle.grad(g, [x])
+    np.testing.assert_allclose(g2.numpy(), 12.0)  # 6x
+
+
+def test_create_graph_grad_in_loss():
+    """Gradient-penalty pattern: grads used inside a further loss."""
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x * x).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    z = (g * g).sum()  # sum (3x^2)^2 = 9x^4 → dz/dx = 36x^3
+    (h,) = paddle.grad(z, [x])
+    np.testing.assert_allclose(h.numpy(), [288.0, 972.0])
+
+
+def test_create_graph_backward_into_leaf_grad():
+    """create_graph grads feed .backward() accumulation as well."""
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    (g,) = paddle.grad(x * x, [x], create_graph=True)  # 2x
+    (g * g).backward()  # 4x^2 → d/dx = 8x = 16
+    np.testing.assert_allclose(x.grad.numpy(), 16.0)
+
+
+def test_create_graph_unused_input():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    u = paddle.to_tensor(5.0, stop_gradient=False)
+    y = x * x
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [x, u], create_graph=True)
+    gx, gu = paddle.grad(y, [x, u], create_graph=True, allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), 4.0)
+    assert gu is None
 
 
 def test_inplace_grad_flows():

@@ -236,5 +236,59 @@ def test_strategy_object():
     s.amp = True
     s.amp_configs = {"dtype": "bfloat16", "level": "O2"}
     assert s.amp_configs.level == "O2"
-    s.some_future_flag = 123  # 248-field proto compat: unknown accepted
+    with pytest.warns(UserWarning, match="some_future_flag"):
+        s.some_future_flag = 123  # 248-field proto compat: stored, but loud
     assert s.some_future_flag == 123
+    with pytest.warns(UserWarning, match="amp_configs.use_dynamic"):
+        s.amp_configs = {"use_dynamic": True}  # unknown nested key warns too
+
+
+def test_gradient_merge_optimizer():
+    """k-step accumulation applies one merged update (VERDICT r2 item 7).
+
+    Parity: passes/auto_parallel_gradient_merge.py semantics — grads from k
+    micro-steps averaged, optimizer stepped once."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.gradient_merge import GradientMergeOptimizer
+
+    def make(k):
+        paddle.seed(7)
+        m = paddle.nn.Linear(4, 4)
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        return m, (GradientMergeOptimizer(o, k_steps=k, avg=True) if k else o)
+
+    xs = [paddle.to_tensor(np.random.RandomState(i).rand(2, 4).astype("float32")) for i in range(4)]
+
+    # reference: single step on the mean of the 4 micro-grad batches
+    m_ref, o_ref = make(0)
+    loss = sum((m_ref(x) ** 2).mean() for x in xs) / 4
+    loss.backward()
+    o_ref.step()
+
+    m_gm, o_gm = make(4)
+    for x in xs:
+        (m_gm(x) ** 2).mean().backward()
+        o_gm.step()  # only the 4th call applies
+    for p_ref, p_gm in zip(m_ref.parameters(), m_gm.parameters()):
+        np.testing.assert_allclose(p_ref.numpy(), p_gm.numpy(), rtol=1e-5, atol=1e-6)
+
+    # mid-cycle steps must not move params
+    m2, o2 = make(2)
+    before = [p.numpy().copy() for p in m2.parameters()]
+    (m2(xs[0]) ** 2).mean().backward()
+    o2.step()  # micro-step 1 of 2: accumulate only
+    for b, p in zip(before, m2.parameters()):
+        np.testing.assert_array_equal(b, p.numpy())
+
+
+def test_fleet_distributed_optimizer_wraps_gradient_merge():
+    from paddle_tpu.distributed.gradient_merge import GradientMergeOptimizer
+    import paddle_tpu.optimizer as opt
+
+    s = dist.DistributedStrategy()
+    s.gradient_merge = {"enable": True, "k_steps": 4, "avg": True}
+    dist.fleet.init(is_collective=True, strategy=s)
+    m = paddle.nn.Linear(2, 2)
+    o = dist.fleet.distributed_optimizer(opt.SGD(0.1, parameters=m.parameters()))
+    assert isinstance(o, GradientMergeOptimizer)
+    assert o._k == 4
