@@ -183,14 +183,15 @@ class LlamaAttention(Layer):
                     functools.partial(inner, axis_name="sep", causal=True),
                     mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
                 out = cp(q, k, v)
+            elif cfg.use_flash_attention and pf.supported(q, k, v):
+                # GQA-native splash kernel: KV stays at num_kv_heads width
+                # through HBM (no _expand_gqa on the hot path)
+                out = pf.flash_attention_bshd(q, k, v, causal=True)
             else:
                 from ..distributed.context_parallel import _expand_gqa
 
                 ke, ve = _expand_gqa(k, v, h)
-                if cfg.use_flash_attention and pf.supported(q, ke, ve):
-                    out = pf.flash_attention_bshd(q, ke, ve, causal=True)
-                else:
-                    out = _sdpa_ref(q, ke, ve, causal=True)
+                out = _sdpa_ref(q, ke, ve, causal=True)
             return out.reshape(b, out.shape[1], h * d), k, v
 
         cache_args = [kv_cache[0], kv_cache[1]] if kv_cache is not None else []
@@ -229,6 +230,8 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = LlamaRMSNorm(config)
 
     def forward(self, hidden_states, cos, sin, attention_mask=None, kv_cache=None):
+        from ..ops.pallas import fused_norm
+
         residual = hidden_states
         hidden_states = self.input_layernorm(hidden_states)
         if kv_cache is not None:
@@ -236,9 +239,14 @@ class LlamaDecoderLayer(Layer):
                                                      attention_mask, kv_cache)
         else:
             hidden_states = self.self_attn(hidden_states, cos, sin, attention_mask)
-        hidden_states = residual + hidden_states
-        residual = hidden_states
-        hidden_states = self.post_attention_layernorm(hidden_states)
+        # fused residual-add + RMSNorm (Pallas): h = residual + attn_out is
+        # written once and normed in the same HBM pass; h doubles as the next
+        # residual (the block's hottest bandwidth pattern — VERDICT r2 item 1)
+        eps = self.post_attention_layernorm.variance_epsilon
+        hidden_states, residual = apply(
+            "add_rms_norm",
+            lambda a, r, w: fused_norm.add_rms_norm(a, r, w, eps),
+            hidden_states, residual, self.post_attention_layernorm.weight)
         hidden_states = residual + self.mlp(hidden_states)
         if kv_cache is not None:
             return hidden_states, kv_cache

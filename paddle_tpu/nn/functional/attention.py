@@ -73,6 +73,12 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     def fn(q, k, v):
         if pallas_flash.supported(q, k, v, dropout):
             return pallas_flash.flash_attention_bshd(q, k, v, causal=causal)
+        if k.shape[2] != q.shape[2]:
+            # GQA inputs reaching the XLA fallback: expand KV heads (the
+            # splash path handles grouping in-kernel; einsum cannot)
+            from ...distributed.context_parallel import _expand_gqa
+
+            k, v = _expand_gqa(k, v, q.shape[2])
         return _sdpa_ref(q, k, v, dropout=dropout if training else 0.0, causal=causal, dropout_key=dk)
 
     out = apply("flash_attention", fn, query, key, value)

@@ -140,3 +140,102 @@ def test_llama_fsdp_parity():
     fsdp = [float(s2(x, y).numpy()) for _ in range(3)]
 
     np.testing.assert_allclose(serial, fsdp, rtol=2e-3)
+
+
+def test_splash_flash_attention_gqa_parity():
+    """GQA-native splash kernel vs the XLA SDPA reference (interpret mode).
+
+    VERDICT r2 item 2: the flash path must accept num_kv_heads < num_heads
+    without expanding KV. Parity ref: flash_attn_kernel.cu handles GQA
+    natively in the reference."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.attention import _sdpa_ref
+    from paddle_tpu.ops.pallas import flash_attention as pf
+    from paddle_tpu.distributed.context_parallel import _expand_gqa
+
+    b, s, hq, hkv, d = 1, 256, 4, 2, 128
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, s, hq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+
+    assert pf.supported(q, k, v, interpret=True)
+    out = pf.flash_attention_bshd(q, k, v, causal=True, interpret=True)
+    ke, ve = _expand_gqa(k, v, hq)
+    ref = _sdpa_ref(q, ke, ve, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_splash_flash_attention_grad_parity():
+    """The splash custom-VJP backward matches the SDPA reference grads."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.attention import _sdpa_ref
+    from paddle_tpu.ops.pallas import flash_attention as pf
+    from paddle_tpu.distributed.context_parallel import _expand_gqa
+
+    b, s, hq, hkv, d = 1, 256, 2, 1, 128
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(b, s, hq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+
+    def loss_splash(q, k, v):
+        return (pf.flash_attention_bshd(q, k, v, causal=True, interpret=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        ke, ve = _expand_gqa(k, v, hq)
+        return (_sdpa_ref(q, ke, ve, causal=True) ** 2).sum()
+
+    gs = jax.grad(loss_splash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=5e-2, atol=5e-2)
+
+
+def test_splash_rectangular_causal_parity():
+    """Chunked-prefill shape (s_q < s_kv): the causal triangle must be
+    bottom-aligned like _sdpa_ref's tril(k=s_kv-s_q) (review regression)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.attention import _sdpa_ref
+    from paddle_tpu.ops.pallas import flash_attention as pf
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 128, 2, 128), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 256, 2, 128), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 256, 2, 128), jnp.float32)
+    out = pf.flash_attention_bshd(q, k, v, causal=True, interpret=True)
+    ref = _sdpa_ref(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_splash_block_sizes_divide_seq():
+    """seq=640 passes supported() (128-multiple) but 512 does not divide it;
+    the kernel must pick a dividing block, not crash (review regression)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas import flash_attention as pf
+
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 640, 2, 128), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 640, 1, 128), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 640, 1, 128), jnp.float32)
+    assert pf.supported(q, k, v, interpret=True)
+    out = pf.flash_attention_bshd(q, k, v, causal=True, interpret=True)
+    assert out.shape == q.shape
+
+
+def test_functional_flash_attention_gqa_fallback():
+    """GQA inputs through the public wrapper on the XLA fallback path must
+    expand KV, not crash in the einsum (review regression)."""
+    from paddle_tpu.nn.functional.attention import flash_attention
+
+    rng = np.random.RandomState(2)
+    q = paddle.to_tensor(rng.randn(1, 64, 4, 32).astype("float32"))
+    k = paddle.to_tensor(rng.randn(1, 64, 2, 32).astype("float32"))
+    v = paddle.to_tensor(rng.randn(1, 64, 2, 32).astype("float32"))
+    out, _ = flash_attention(q, k, v, causal=True)
+    assert tuple(out.shape) == (1, 64, 4, 32)
