@@ -1,0 +1,94 @@
+"""paddle.distribution parity package.
+
+Reference: python/paddle/distribution/__init__.py — same ``__all__``
+(26 distributions + kl_divergence/register_kl + the transform list).
+TPU-native: jax.random sampling (implicit-reparameterization gradients for
+gamma/beta/dirichlet/student-t), jax.scipy special-function math, op-registry
+routing for eager tape recording.
+"""
+from .distribution import Distribution, ExponentialFamily
+from .continuous import (
+    Beta,
+    Cauchy,
+    Chi2,
+    ContinuousBernoulli,
+    Dirichlet,
+    Exponential,
+    Gamma,
+    Gumbel,
+    Laplace,
+    LKJCholesky,
+    LogNormal,
+    Normal,
+    StudentT,
+    Uniform,
+)
+from .discrete import (
+    Bernoulli,
+    Binomial,
+    Categorical,
+    Geometric,
+    Multinomial,
+    Poisson,
+)
+from .multivariate_normal import MultivariateNormal
+from .transformed_distribution import Independent, TransformedDistribution
+from .kl import kl_divergence, register_kl
+from .transform import (
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+)
+
+__all__ = [
+    "Bernoulli",
+    "Beta",
+    "Binomial",
+    "Categorical",
+    "Cauchy",
+    "Chi2",
+    "ContinuousBernoulli",
+    "Dirichlet",
+    "Distribution",
+    "Exponential",
+    "ExponentialFamily",
+    "Gamma",
+    "Geometric",
+    "Gumbel",
+    "Independent",
+    "Laplace",
+    "LKJCholesky",
+    "LogNormal",
+    "Multinomial",
+    "MultivariateNormal",
+    "Normal",
+    "Poisson",
+    "StudentT",
+    "TransformedDistribution",
+    "Uniform",
+    "kl_divergence",
+    "register_kl",
+    "Transform",
+    "AbsTransform",
+    "AffineTransform",
+    "ChainTransform",
+    "ExpTransform",
+    "IndependentTransform",
+    "PowerTransform",
+    "ReshapeTransform",
+    "SigmoidTransform",
+    "SoftmaxTransform",
+    "StackTransform",
+    "StickBreakingTransform",
+    "TanhTransform",
+]

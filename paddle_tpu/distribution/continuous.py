@@ -1,0 +1,718 @@
+"""Continuous distributions.
+
+Reference parity: python/paddle/distribution/{normal,uniform,beta,gamma,
+chi2,dirichlet,exponential,laplace,lognormal,cauchy,gumbel,student_t,
+continuous_bernoulli,lkj_cholesky}.py — same constructor signatures and the
+sample/rsample/log_prob/entropy/mean/variance surface.
+
+TPU-native: sampling uses jax.random (gamma/beta/dirichlet/t carry JAX's
+implicit-reparameterization gradients, so ``rsample`` is differentiable for
+those families too); math goes through the op-registry ``apply`` for tape
+recording.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..ops.registry import apply
+from ..framework import random as _random
+from ..autograd import tape as _tape
+from .distribution import (Distribution, ExponentialFamily, _arr, _param,
+                           _shape_of, _shape_tuple)
+
+_EULER = 0.5772156649015329  # Euler–Mascheroni
+
+
+def _bshape(*arrs) -> tuple:
+    return jnp.broadcast_shapes(*[_shape_of(a) for a in arrs])
+
+
+class Normal(ExponentialFamily):
+    """python/paddle/distribution/normal.py parity."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return apply("normal_mean", lambda l, s: jnp.broadcast_to(l, _bshape(l, s)),
+                     self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply("normal_variance",
+                     lambda l, s: jnp.broadcast_to(s * s, _bshape(l, s)),
+                     self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(l, s):
+            return l + s * jax.random.normal(key, out_shape, dtype=s.dtype)
+
+        return apply("normal_rsample", fn, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(l, s, v):
+            var = s * s
+            return (-((v - l) ** 2) / (2 * var) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi))
+
+        return apply("normal_log_prob", fn, self.loc, self.scale, value)
+
+    def entropy(self):
+        def fn(l, s):
+            h = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s)
+            return jnp.broadcast_to(h, _bshape(l, s))
+
+        return apply("normal_entropy", fn, self.loc, self.scale)
+
+    def cdf(self, value):
+        def fn(l, s, v):
+            return 0.5 * (1 + jsp.erf((v - l) / (s * math.sqrt(2.0))))
+
+        return apply("normal_cdf", fn, self.loc, self.scale, value)
+
+    def icdf(self, value):
+        def fn(l, s, v):
+            return l + s * math.sqrt(2.0) * jsp.erfinv(2 * v - 1)
+
+        return apply("normal_icdf", fn, self.loc, self.scale, value)
+
+
+class Uniform(Distribution):
+    """python/paddle/distribution/uniform.py parity."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _param(low)
+        self.high = _param(high)
+        super().__init__(batch_shape=_bshape(self.low, self.high))
+
+    @property
+    def mean(self):
+        return apply("uniform_mean", lambda a, b: (a + b) / 2, self.low, self.high)
+
+    @property
+    def variance(self):
+        return apply("uniform_variance", lambda a, b: (b - a) ** 2 / 12,
+                     self.low, self.high)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(a, b):
+            u = jax.random.uniform(key, out_shape, dtype=a.dtype)
+            return a + (b - a) * u
+
+        return apply("uniform_rsample", fn, self.low, self.high)
+
+    def log_prob(self, value):
+        def fn(a, b, v):
+            inside = (v >= a) & (v < b)
+            return jnp.where(inside, -jnp.log(b - a), -jnp.inf)
+
+        return apply("uniform_log_prob", fn, self.low, self.high, value)
+
+    def entropy(self):
+        return apply("uniform_entropy", lambda a, b: jnp.log(b - a),
+                     self.low, self.high)
+
+
+class Beta(ExponentialFamily):
+    """python/paddle/distribution/beta.py parity."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _param(alpha)
+        self.beta = _param(beta)
+        super().__init__(batch_shape=_bshape(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return apply("beta_mean", lambda a, b: a / (a + b), self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        def fn(a, b):
+            s = a + b
+            return a * b / (s * s * (s + 1))
+
+        return apply("beta_variance", fn, self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(a, b):
+            return jax.random.beta(key, a, b, out_shape, dtype=a.dtype)
+
+        return apply("beta_rsample", fn, self.alpha, self.beta)
+
+    sample = Distribution.sample
+
+    def log_prob(self, value):
+        def fn(a, b, v):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - (jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)))
+
+        return apply("beta_log_prob", fn, self.alpha, self.beta, value)
+
+    def entropy(self):
+        def fn(a, b):
+            s = a + b
+            lbeta = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(s)
+            return (lbeta - (a - 1) * jsp.digamma(a) - (b - 1) * jsp.digamma(b)
+                    + (s - 2) * jsp.digamma(s))
+
+        return apply("beta_entropy", fn, self.alpha, self.beta)
+
+
+class Gamma(ExponentialFamily):
+    """python/paddle/distribution/gamma.py parity (concentration, rate)."""
+
+    def __init__(self, concentration, rate):
+        self.concentration = _param(concentration)
+        self.rate = _param(rate)
+        super().__init__(batch_shape=_bshape(self.concentration, self.rate))
+
+    @property
+    def mean(self):
+        return apply("gamma_mean", lambda c, r: c / r, self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return apply("gamma_variance", lambda c, r: c / (r * r),
+                     self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(c, r):
+            return jax.random.gamma(key, c, out_shape, dtype=c.dtype) / r
+
+        return apply("gamma_rsample", fn, self.concentration, self.rate)
+
+    def log_prob(self, value):
+        def fn(c, r, v):
+            return (c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v
+                    - jsp.gammaln(c))
+
+        return apply("gamma_log_prob", fn, self.concentration, self.rate, value)
+
+    def entropy(self):
+        def fn(c, r):
+            return (c - jnp.log(r) + jsp.gammaln(c)
+                    + (1 - c) * jsp.digamma(c))
+
+        return apply("gamma_entropy", fn, self.concentration, self.rate)
+
+
+class Chi2(Gamma):
+    """python/paddle/distribution/chi2.py parity: Gamma(df/2, 1/2)."""
+
+    def __init__(self, df):
+        self.df = _param(df)
+        half = jnp.asarray(0.5, _arr(self.df).dtype)
+        super().__init__(self.df / 2, half)
+
+
+class Dirichlet(ExponentialFamily):
+    """python/paddle/distribution/dirichlet.py parity."""
+
+    def __init__(self, concentration):
+        self.concentration = _param(concentration)
+        cshape = _shape_of(self.concentration)
+        if len(cshape) < 1:
+            raise ValueError("Dirichlet concentration must be at least 1-D")
+        super().__init__(batch_shape=cshape[:-1], event_shape=cshape[-1:])
+
+    @property
+    def mean(self):
+        return apply("dirichlet_mean",
+                     lambda c: c / c.sum(-1, keepdims=True), self.concentration)
+
+    @property
+    def variance(self):
+        def fn(c):
+            s = c.sum(-1, keepdims=True)
+            m = c / s
+            return m * (1 - m) / (s + 1)
+
+        return apply("dirichlet_variance", fn, self.concentration)
+
+    def rsample(self, shape=()):
+        key = _random.next_key()
+        sample_shape = _shape_tuple(shape) + tuple(self.batch_shape)
+
+        def fn(c):
+            return jax.random.dirichlet(key, c, sample_shape, dtype=c.dtype)
+
+        return apply("dirichlet_rsample", fn, self.concentration)
+
+    def log_prob(self, value):
+        def fn(c, v):
+            return ((jnp.log(v) * (c - 1)).sum(-1)
+                    + jsp.gammaln(c.sum(-1)) - jsp.gammaln(c).sum(-1))
+
+        return apply("dirichlet_log_prob", fn, self.concentration, value)
+
+    def entropy(self):
+        def fn(c):
+            a0 = c.sum(-1)
+            k = c.shape[-1]
+            lnB = jsp.gammaln(c).sum(-1) - jsp.gammaln(a0)
+            return (lnB + (a0 - k) * jsp.digamma(a0)
+                    - ((c - 1) * jsp.digamma(c)).sum(-1))
+
+        return apply("dirichlet_entropy", fn, self.concentration)
+
+
+class Exponential(ExponentialFamily):
+    """python/paddle/distribution/exponential.py parity (rate)."""
+
+    def __init__(self, rate):
+        self.rate = _param(rate)
+        super().__init__(batch_shape=_shape_of(self.rate))
+
+    @property
+    def mean(self):
+        return apply("exponential_mean", lambda r: 1 / r, self.rate)
+
+    @property
+    def variance(self):
+        return apply("exponential_variance", lambda r: 1 / (r * r), self.rate)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(r):
+            return jax.random.exponential(key, out_shape, dtype=r.dtype) / r
+
+        return apply("exponential_rsample", fn, self.rate)
+
+    def log_prob(self, value):
+        def fn(r, v):
+            return jnp.where(v >= 0, jnp.log(r) - r * v, -jnp.inf)
+
+        return apply("exponential_log_prob", fn, self.rate, value)
+
+    def entropy(self):
+        return apply("exponential_entropy", lambda r: 1 - jnp.log(r), self.rate)
+
+
+class Laplace(Distribution):
+    """python/paddle/distribution/laplace.py parity."""
+
+    def __init__(self, loc, scale):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return apply("laplace_mean", lambda l, s: jnp.broadcast_to(l, _bshape(l, s)),
+                     self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply("laplace_variance",
+                     lambda l, s: jnp.broadcast_to(2 * s * s, _bshape(l, s)),
+                     self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(l, s):
+            return l + s * jax.random.laplace(key, out_shape, dtype=s.dtype)
+
+        return apply("laplace_rsample", fn, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(l, s, v):
+            return -jnp.abs(v - l) / s - jnp.log(2 * s)
+
+        return apply("laplace_log_prob", fn, self.loc, self.scale, value)
+
+    def entropy(self):
+        def fn(l, s):
+            return jnp.broadcast_to(1 + jnp.log(2 * s), _bshape(l, s))
+
+        return apply("laplace_entropy", fn, self.loc, self.scale)
+
+    def cdf(self, value):
+        def fn(l, s, v):
+            z = (v - l) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+
+        return apply("laplace_cdf", fn, self.loc, self.scale, value)
+
+    def icdf(self, value):
+        def fn(l, s, v):
+            term = v - 0.5
+            return l - s * jnp.sign(term) * jnp.log1p(-2 * jnp.abs(term))
+
+        return apply("laplace_icdf", fn, self.loc, self.scale, value)
+
+
+class LogNormal(Distribution):
+    """python/paddle/distribution/lognormal.py parity: exp(Normal(loc, scale))."""
+
+    def __init__(self, loc, scale):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        self._base = Normal(self.loc, self.scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return apply("lognormal_mean",
+                     lambda l, s: jnp.exp(l + s * s / 2), self.loc, self.scale)
+
+    @property
+    def variance(self):
+        def fn(l, s):
+            s2 = s * s
+            return jnp.expm1(s2) * jnp.exp(2 * l + s2)
+
+        return apply("lognormal_variance", fn, self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        base = self._base.rsample(shape)
+        return apply("lognormal_exp", jnp.exp, base)
+
+    def log_prob(self, value):
+        def fn(l, s, v):
+            logv = jnp.log(v)
+            return (-((logv - l) ** 2) / (2 * s * s) - jnp.log(s)
+                    - logv - 0.5 * math.log(2 * math.pi))
+
+        return apply("lognormal_log_prob", fn, self.loc, self.scale, value)
+
+    def entropy(self):
+        def fn(l, s):
+            return l + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s)
+
+        return apply("lognormal_entropy", fn, self.loc, self.scale)
+
+
+class Cauchy(Distribution):
+    """python/paddle/distribution/cauchy.py parity."""
+
+    def __init__(self, loc, scale):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(l, s):
+            return l + s * jax.random.cauchy(key, out_shape, dtype=s.dtype)
+
+        return apply("cauchy_rsample", fn, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(l, s, v):
+            z = (v - l) / s
+            return -jnp.log(math.pi * s * (1 + z * z))
+
+        return apply("cauchy_log_prob", fn, self.loc, self.scale, value)
+
+    def entropy(self):
+        def fn(l, s):
+            return jnp.broadcast_to(jnp.log(4 * math.pi * s), _bshape(l, s))
+
+        return apply("cauchy_entropy", fn, self.loc, self.scale)
+
+    def cdf(self, value):
+        def fn(l, s, v):
+            return jnp.arctan((v - l) / s) / math.pi + 0.5
+
+        return apply("cauchy_cdf", fn, self.loc, self.scale, value)
+
+
+class Gumbel(Distribution):
+    """python/paddle/distribution/gumbel.py parity."""
+
+    def __init__(self, loc, scale):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return apply("gumbel_mean", lambda l, s: l + s * _EULER,
+                     self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply("gumbel_variance",
+                     lambda l, s: jnp.broadcast_to(
+                         (math.pi ** 2 / 6) * s * s, _bshape(l, s)),
+                     self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(l, s):
+            return l + s * jax.random.gumbel(key, out_shape, dtype=s.dtype)
+
+        return apply("gumbel_rsample", fn, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(l, s, v):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return apply("gumbel_log_prob", fn, self.loc, self.scale, value)
+
+    def entropy(self):
+        def fn(l, s):
+            return jnp.broadcast_to(jnp.log(s) + 1 + _EULER, _bshape(l, s))
+
+        return apply("gumbel_entropy", fn, self.loc, self.scale)
+
+
+class StudentT(Distribution):
+    """python/paddle/distribution/student_t.py parity (df, loc, scale)."""
+
+    def __init__(self, df, loc, scale, name=None):
+        self.df = _param(df)
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(batch_shape=_bshape(self.df, self.loc, self.scale))
+
+    @property
+    def mean(self):
+        def fn(df, l, s):
+            return jnp.where(df > 1, jnp.broadcast_to(l, _bshape(df, l, s)),
+                             jnp.nan)
+
+        return apply("studentt_mean", fn, self.df, self.loc, self.scale)
+
+    @property
+    def variance(self):
+        def fn(df, l, s):
+            shape = _bshape(df, l, s)
+            var = jnp.where(df > 2, s * s * df / (df - 2), jnp.inf)
+            return jnp.broadcast_to(jnp.where(df > 1, var, jnp.nan), shape)
+
+        return apply("studentt_variance", fn, self.df, self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(df, l, s):
+            return l + s * jax.random.t(key, df, out_shape, dtype=s.dtype)
+
+        return apply("studentt_rsample", fn, self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(df, l, s, v):
+            z = (v - l) / s
+            return (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+
+        return apply("studentt_log_prob", fn, self.df, self.loc, self.scale, value)
+
+    def entropy(self):
+        def fn(df, l, s):
+            h = ((df + 1) / 2 * (jsp.digamma((df + 1) / 2) - jsp.digamma(df / 2))
+                 + 0.5 * jnp.log(df) + jsp.gammaln(df / 2)
+                 + jsp.gammaln(0.5) - jsp.gammaln((df + 1) / 2) + jnp.log(s))
+            return jnp.broadcast_to(h, _bshape(df, l, s))
+
+        return apply("studentt_entropy", fn, self.df, self.loc, self.scale)
+
+
+class ContinuousBernoulli(Distribution):
+    """python/paddle/distribution/continuous_bernoulli.py parity."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _param(probs)
+        self._lims = lims
+        super().__init__(batch_shape=_shape_of(self.probs))
+
+    def _clipped(self, p):
+        eps = jnp.finfo(p.dtype).eps
+        return jnp.clip(p, eps, 1 - eps)
+
+    def _outside_unstable(self, p):
+        lo, hi = self._lims
+        return (p < lo) | (p > hi)
+
+    def _log_norm_const(self, p):
+        """log C(p); C = 2 atanh(1-2p)/(1-2p) for p != 1/2, else 2."""
+        p = self._clipped(p)
+        safe = jnp.where(self._outside_unstable(p), p, 0.25)
+        c = 2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe)
+        # Taylor expansion around p = 1/2: C ≈ 2 + (1-2p)^2 * 4/3
+        t = 1 - 2 * p
+        taylor = 2.0 + (4.0 / 3.0) * t * t
+        return jnp.log(jnp.where(self._outside_unstable(p), c, taylor))
+
+    @property
+    def mean(self):
+        def fn(p):
+            p = self._clipped(p)
+            safe = jnp.where(self._outside_unstable(p), p, 0.25)
+            m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+            # Taylor around 1/2: mean ≈ 1/2 + (p-1/2)/3
+            taylor = 0.5 + (p - 0.5) / 3.0
+            return jnp.where(self._outside_unstable(p), m, taylor)
+
+        return apply("cb_mean", fn, self.probs)
+
+    @property
+    def variance(self):
+        def fn(p):
+            p = self._clipped(p)
+            safe = jnp.where(self._outside_unstable(p), p, 0.25)
+            t = 1 - 2 * safe
+            v = safe * (safe - 1) / (t * t) + 1 / (2 * jnp.arctanh(t)) ** 2
+            taylor = 1.0 / 12.0 - (p - 0.5) ** 2 * (2.0 / 15.0)
+            return jnp.where(self._outside_unstable(p), v, taylor)
+
+        return apply("cb_variance", fn, self.probs)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(p):
+            u = jax.random.uniform(key, out_shape, dtype=p.dtype)
+            return self._icdf_arr(p, u)
+
+        return apply("cb_rsample", fn, self.probs)
+
+    def _icdf_arr(self, p, u):
+        # F⁻¹(u) = log1p(u(2p-1)/(1-p)) / log(p/(1-p)) for p != 1/2; u at 1/2
+        p = self._clipped(p)
+        safe = jnp.where(self._outside_unstable(p), p, 0.25)
+        num = jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+        den = jnp.log(safe) - jnp.log1p(-safe)
+        x = num / den
+        return jnp.where(self._outside_unstable(p), x, u)
+
+    def log_prob(self, value):
+        def fn(p, v):
+            pc = self._clipped(p)
+            return (v * jnp.log(pc) + (1 - v) * jnp.log1p(-pc)
+                    + self._log_norm_const(p))
+
+        return apply("cb_log_prob", fn, self.probs, value)
+
+    def entropy(self):
+        # mean recomputed inline so the op stays pure under jit
+        def fn_pure(p):
+            pc = self._clipped(p)
+            safe = jnp.where(self._outside_unstable(pc), pc, 0.25)
+            mu = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+            mu = jnp.where(self._outside_unstable(pc), mu,
+                           0.5 + (pc - 0.5) / 3.0)
+            return -(mu * jnp.log(pc) + (1 - mu) * jnp.log1p(-pc)
+                     + self._log_norm_const(p))
+
+        return apply("cb_entropy", fn_pure, self.probs)
+
+
+class LKJCholesky(Distribution):
+    """python/paddle/distribution/lkj_cholesky.py parity: distribution over
+    Cholesky factors of correlation matrices (onion-method sampling)."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion"):
+        if dim < 2:
+            raise ValueError("LKJCholesky dim must be >= 2")
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError(f"unknown sample_method {sample_method}")
+        self.dim = int(dim)
+        self.concentration = _param(concentration)
+        self.sample_method = sample_method
+        super().__init__(batch_shape=_shape_of(self.concentration),
+                         event_shape=(self.dim, self.dim))
+
+    def sample(self, shape=()):
+        """Onion method (the cvine request also uses it — same law)."""
+        d = self.dim
+        sample_shape = _shape_tuple(shape) + tuple(self.batch_shape)
+        key = _random.next_key()
+        k1, k2 = jax.random.split(key)
+
+        def fn(eta):
+            # per-row beta draws: row i (1-based, i>=1) uses
+            # Beta(i/2, eta + (d - 1 - i)/2)
+            i = jnp.arange(1, d, dtype=eta.dtype)
+            conc1 = i / 2
+            conc0 = eta[..., None] + (d - 1 - i) / 2
+            y = jax.random.beta(
+                k1, jnp.broadcast_to(conc1, sample_shape + (d - 1,)),
+                jnp.broadcast_to(conc0, sample_shape + (d - 1,)),
+            )  # squared norms of each below-diagonal row
+            # directions: rows of standard normals, normalized over the
+            # first (i) coordinates via masking
+            z = jax.random.normal(k2, sample_shape + (d - 1, d - 1),
+                                  dtype=eta.dtype)
+            mask = (jnp.arange(d - 1)[None, :]
+                    <= jnp.arange(d - 1)[:, None]).astype(eta.dtype)
+            zm = z * mask
+            norm = jnp.sqrt((zm * zm).sum(-1, keepdims=True))
+            u = zm / jnp.maximum(norm, jnp.finfo(eta.dtype).tiny)
+            w = jnp.sqrt(y)[..., None] * u  # below-diagonal rows
+            diag = jnp.sqrt(jnp.clip(1 - y, 0))  # row diagonals
+            L = jnp.zeros(sample_shape + (d, d), eta.dtype)
+            L = L.at[..., 0, 0].set(1.0)
+            L = L.at[..., 1:, :-1].set(w)
+            L = L.at[..., jnp.arange(1, d), jnp.arange(1, d)].set(diag)
+            return L
+
+        with _tape.no_grad():
+            out = apply("lkj_sample", fn, self.concentration,
+                        differentiable=False)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        d = self.dim
+
+        def fn(eta, L):
+            # p(L) ∝ Π_{i=2..d} L_ii^{2(η-1) + d - i}; normalization via the
+            # multivariate log-gamma (LKJ 2009, Theorem/p.1999 form, as in
+            # the reference's lkj_cholesky.py)
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            order = jnp.arange(2, d + 1, dtype=eta.dtype)
+            exponents = 2 * (eta[..., None] - 1) + d - order
+            unnorm = (exponents * jnp.log(diag)).sum(-1)
+            dm1 = d - 1
+            alpha = eta + 0.5 * dm1
+            denominator = jsp.gammaln(alpha) * dm1
+            numerator = jsp.multigammaln(alpha - 0.5, dm1)
+            pi_constant = 0.5 * dm1 * math.log(math.pi)
+            return unnorm - (pi_constant + numerator - denominator)
+
+        return apply("lkj_log_prob", fn, self.concentration, value)

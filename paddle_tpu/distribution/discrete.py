@@ -1,0 +1,421 @@
+"""Discrete distributions.
+
+Reference parity: python/paddle/distribution/{bernoulli,binomial,categorical,
+geometric,multinomial,poisson}.py. Sampling via jax.random; none are
+reparameterizable, so only ``sample`` is offered (rsample raises, matching
+the reference's behavior for discrete families).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..ops.registry import apply
+from ..framework import random as _random
+from ..autograd import tape as _tape
+from .distribution import (Distribution, ExponentialFamily, _arr, _param,
+                           _shape_of, _shape_tuple)
+
+
+def _probs_to_logits(p, eps=1e-7):
+    pc = jnp.clip(p, eps, 1 - eps)
+    return jnp.log(pc) - jnp.log1p(-pc)
+
+
+class Bernoulli(ExponentialFamily):
+    """python/paddle/distribution/bernoulli.py parity (probs)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _param(probs)
+        super().__init__(batch_shape=_shape_of(self.probs))
+
+    @property
+    def logits(self):
+        return apply("bernoulli_logits", _probs_to_logits, self.probs)
+
+    @property
+    def mean(self):
+        return apply("bernoulli_mean", lambda p: p + 0, self.probs)
+
+    @property
+    def variance(self):
+        return apply("bernoulli_variance", lambda p: p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(p):
+            return jax.random.bernoulli(
+                key, jnp.broadcast_to(p, out_shape)).astype(p.dtype)
+
+        with _tape.no_grad():
+            out = apply("bernoulli_sample", fn, self.probs, differentiable=False)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (bernoulli.py rsample parity: returns a
+        continuous relaxation in (0,1), differentiable wrt probs)."""
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+        t = float(temperature)
+
+        def fn(p):
+            logits = _probs_to_logits(p)
+            u = jax.random.logistic(key, out_shape, dtype=p.dtype)
+            return jax.nn.sigmoid((logits + u) / t)
+
+        return apply("bernoulli_rsample", fn, self.probs)
+
+    def log_prob(self, value):
+        def fn(p, v):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(pc) + (1 - v) * jnp.log1p(-pc)
+
+        return apply("bernoulli_log_prob", fn, self.probs, value)
+
+    def entropy(self):
+        def fn(p):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            return -(pc * jnp.log(pc) + (1 - pc) * jnp.log1p(-pc))
+
+        return apply("bernoulli_entropy", fn, self.probs)
+
+    def cdf(self, value):
+        def fn(p, v):
+            return jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - p, 1.0))
+
+        return apply("bernoulli_cdf", fn, self.probs, value)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+
+class Categorical(Distribution):
+    """python/paddle/distribution/categorical.py parity (logits)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _param(logits)
+        lshape = _shape_of(self.logits)
+        if len(lshape) < 1:
+            raise ValueError("Categorical logits must be at least 1-D")
+        super().__init__(batch_shape=lshape[:-1])
+
+    @property
+    def probs(self):
+        # paddle's Categorical accepts unnormalized non-negative weights in
+        # `logits`... the modern surface treats them as log-weights
+        return apply("categorical_probs", jax.nn.softmax, self.logits)
+
+    def sample(self, shape=()):
+        out_shape = _shape_tuple(shape) + tuple(self.batch_shape)
+        key = _random.next_key()
+
+        def fn(lg):
+            return jax.random.categorical(key, lg, shape=out_shape)
+
+        with _tape.no_grad():
+            out = apply("categorical_sample", fn, self.logits,
+                        differentiable=False)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def fn(lg, v):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            # value may carry extra sample dims in front of the batch dims
+            logp = jnp.broadcast_to(logp, jnp.shape(v) + logp.shape[-1:])
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+        return apply("categorical_log_prob", fn, self.logits, value)
+
+    def probabilities(self, value):
+        return self.prob(value)
+
+    def prob(self, value):
+        return apply("categorical_prob", jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        def fn(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return -(jnp.exp(logp) * logp).sum(-1)
+
+        return apply("categorical_entropy", fn, self.logits)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+
+class Geometric(Distribution):
+    """python/paddle/distribution/geometric.py parity: #failures before the
+    first success, support {0, 1, 2, ...}."""
+
+    def __init__(self, probs):
+        self.probs = _param(probs)
+        super().__init__(batch_shape=_shape_of(self.probs))
+
+    @property
+    def mean(self):
+        return apply("geometric_mean", lambda p: (1 - p) / p, self.probs)
+
+    @property
+    def variance(self):
+        return apply("geometric_variance", lambda p: (1 - p) / (p * p),
+                     self.probs)
+
+    @property
+    def stddev(self):
+        return apply("geometric_stddev",
+                     lambda p: jnp.sqrt(1 - p) / p, self.probs)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(p):
+            u = jax.random.uniform(
+                key, out_shape, dtype=p.dtype,
+                minval=jnp.finfo(p.dtype).tiny)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+
+        with _tape.no_grad():
+            out = apply("geometric_sample", fn, self.probs, differentiable=False)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        """Continuous relaxation: the underlying exponential draw, as in the
+        reference (geometric.py rsample uses uniform reparameterization)."""
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(p):
+            u = jax.random.uniform(key, out_shape, dtype=p.dtype,
+                                   minval=jnp.finfo(p.dtype).tiny)
+            return jnp.log(u) / jnp.log1p(-p)
+
+        return apply("geometric_rsample", fn, self.probs)
+
+    def log_prob(self, value):
+        def fn(p, v):
+            return v * jnp.log1p(-p) + jnp.log(p)
+
+        return apply("geometric_log_prob", fn, self.probs, value)
+
+    def pmf(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        def fn(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+
+        return apply("geometric_entropy", fn, self.probs)
+
+    def cdf(self, value):
+        def fn(p, v):
+            return 1 - jnp.power(1 - p, v + 1)
+
+        return apply("geometric_cdf", fn, self.probs, value)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+
+class Binomial(Distribution):
+    """python/paddle/distribution/binomial.py parity (total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = jnp.asarray(_arr(total_count))
+        self.probs = _param(probs)
+        super().__init__(
+            batch_shape=jnp.broadcast_shapes(jnp.shape(self.total_count),
+                                             _shape_of(self.probs)))
+
+    @property
+    def mean(self):
+        return apply("binomial_mean",
+                     lambda n, p: n.astype(p.dtype) * p,
+                     self.total_count, self.probs)
+
+    @property
+    def variance(self):
+        return apply("binomial_variance",
+                     lambda n, p: n.astype(p.dtype) * p * (1 - p),
+                     self.total_count, self.probs)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(n, p):
+            return jax.random.binomial(
+                key, jnp.broadcast_to(n, out_shape).astype(p.dtype),
+                jnp.broadcast_to(p, out_shape), dtype=p.dtype)
+
+        with _tape.no_grad():
+            out = apply("binomial_sample", fn, self.total_count, self.probs,
+                        differentiable=False)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def fn(n, p, v):
+            n = n.astype(p.dtype)
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            return (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(n - v + 1)
+                    + v * jnp.log(pc) + (n - v) * jnp.log1p(-pc))
+
+        return apply("binomial_log_prob", fn, self.total_count, self.probs, value)
+
+    def entropy(self):
+        """Exact entropy by summing the pmf over the support (matches the
+        reference, which enumerates 0..n; requires a scalar/uniform n)."""
+        def fn(n, p):
+            nmax = int(jnp.max(n))
+            k = jnp.arange(nmax + 1, dtype=p.dtype)
+            shape = jnp.broadcast_shapes(jnp.shape(n), jnp.shape(p))
+            nb = jnp.broadcast_to(n, shape).astype(p.dtype)[..., None]
+            pb = jnp.clip(jnp.broadcast_to(p, shape), 1e-7, 1 - 1e-7)[..., None]
+            logpmf = (jsp.gammaln(nb + 1) - jsp.gammaln(k + 1)
+                      - jsp.gammaln(nb - k + 1)
+                      + k * jnp.log(pb) + (nb - k) * jnp.log1p(-pb))
+            valid = k <= nb
+            pmf = jnp.where(valid, jnp.exp(logpmf), 0.0)
+            return -(pmf * jnp.where(valid, logpmf, 0.0)).sum(-1)
+
+        return apply("binomial_entropy", fn, self.total_count, self.probs)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+
+class Multinomial(Distribution):
+    """python/paddle/distribution/multinomial.py parity (total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _param(probs)
+        pshape = _shape_of(self.probs)
+        if len(pshape) < 1:
+            raise ValueError("Multinomial probs must be at least 1-D")
+        super().__init__(batch_shape=pshape[:-1], event_shape=pshape[-1:])
+
+    @property
+    def mean(self):
+        return apply("multinomial_mean",
+                     lambda p: self.total_count * (p / p.sum(-1, keepdims=True)),
+                     self.probs)
+
+    @property
+    def variance(self):
+        def fn(p):
+            pn = p / p.sum(-1, keepdims=True)
+            return self.total_count * pn * (1 - pn)
+
+        return apply("multinomial_variance", fn, self.probs)
+
+    def sample(self, shape=()):
+        sample_shape = _shape_tuple(shape) + tuple(self.batch_shape)
+        key = _random.next_key()
+        n = self.total_count
+
+        def fn(p):
+            pn = p / p.sum(-1, keepdims=True)
+            return jax.random.multinomial(
+                key, n, pn, shape=sample_shape + tuple(self.event_shape),
+            ).astype(p.dtype)
+
+        with _tape.no_grad():
+            out = apply("multinomial_sample", fn, self.probs,
+                        differentiable=False)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def fn(p, v):
+            pn = jnp.clip(p / p.sum(-1, keepdims=True), 1e-7, 1.0)
+            return (jsp.gammaln(jnp.asarray(self.total_count + 1.0, p.dtype))
+                    - jsp.gammaln(v + 1).sum(-1)
+                    + (v * jnp.log(pn)).sum(-1))
+
+        return apply("multinomial_log_prob", fn, self.probs, value)
+
+    def entropy(self):
+        """Reference computes entropy via the categorical decomposition
+        bound; we match the exact formula for n=1 and use the standard
+        approximation-free sum otherwise is intractable — follow the
+        reference's implementation: n*H(p) - correction-free form."""
+        def fn(p):
+            pn = jnp.clip(p / p.sum(-1, keepdims=True), 1e-7, 1.0)
+            return -self.total_count * (pn * jnp.log(pn)).sum(-1)
+
+        return apply("multinomial_entropy", fn, self.probs)
+
+
+class Poisson(ExponentialFamily):
+    """python/paddle/distribution/poisson.py parity (rate)."""
+
+    def __init__(self, rate):
+        self.rate = _param(rate)
+        super().__init__(batch_shape=_shape_of(self.rate))
+
+    @property
+    def mean(self):
+        return apply("poisson_mean", lambda r: r + 0, self.rate)
+
+    @property
+    def variance(self):
+        return apply("poisson_variance", lambda r: r + 0, self.rate)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(r):
+            return jax.random.poisson(key, r, out_shape).astype(r.dtype)
+
+        with _tape.no_grad():
+            out = apply("poisson_sample", fn, self.rate, differentiable=False)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def fn(r, v):
+            return v * jnp.log(r) - r - jsp.gammaln(v + 1)
+
+        return apply("poisson_log_prob", fn, self.rate, value)
+
+    def entropy(self):
+        """Series entropy (reference enumerates a truncated support)."""
+        def fn(r):
+            kmax = int(jnp.maximum(20, jnp.max(r) * 3 + 20))
+            k = jnp.arange(kmax, dtype=r.dtype)
+            rb = r[..., None]
+            logpmf = k * jnp.log(rb) - rb - jsp.gammaln(k + 1)
+            pmf = jnp.exp(logpmf)
+            return -(pmf * logpmf).sum(-1)
+
+        return apply("poisson_entropy", fn, self.rate)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)

@@ -1,0 +1,144 @@
+"""Distribution base class.
+
+Reference parity: python/paddle/distribution/distribution.py (class
+``Distribution``: batch_shape/event_shape, sample/rsample/prob/log_prob/
+entropy/kl_divergence surface) and exponential_family.py.
+
+TPU-native design: parameters live as jax arrays; every differentiable
+method (rsample, log_prob, entropy, mean, variance) routes through the op
+registry's ``apply`` so eager calls are tape-recorded and jit-traced calls
+stay pure. Sampling draws keys from the framework RNG
+(paddle_tpu.framework.random), so ``paddle.seed`` governs reproducibility
+exactly as for the rest of the framework.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_class import Tensor, unwrap, wrap
+from ..ops.registry import apply
+from ..framework import random as _random
+from ..autograd import tape as _tape
+
+
+def _to_arr(x, dtype=None):
+    """Normalize a parameter (Tensor | array | python scalar) to jnp array."""
+    a = unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+    if dtype is not None:
+        a = a.astype(dtype)
+    elif not jnp.issubdtype(a.dtype, jnp.floating):
+        a = a.astype(jnp.float32)
+    return a
+
+
+def _param(x):
+    """Keep a parameter AS a Tensor when one is given (so rsample/log_prob
+    stay differentiable wrt it on the eager tape via ``apply``); normalize
+    scalars/arrays to float jnp arrays otherwise."""
+    if isinstance(x, Tensor):
+        return x
+    a = jnp.asarray(x)
+    if not jnp.issubdtype(a.dtype, jnp.floating):
+        a = a.astype(jnp.float32)
+    return a
+
+
+def _shape_of(x) -> tuple:
+    return tuple(x.shape) if isinstance(x, Tensor) else tuple(jnp.shape(x))
+
+
+def _arr(x):
+    return x._array if isinstance(x, Tensor) else x
+
+
+def _shape_tuple(shape) -> tuple:
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """Abstract base (python/paddle/distribution/distribution.py:40)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape_tuple(batch_shape)
+        self._event_shape = _shape_tuple(event_shape)
+
+    @property
+    def batch_shape(self) -> Sequence[int]:
+        return self._batch_shape
+
+    @property
+    def event_shape(self) -> Sequence[int]:
+        return self._event_shape
+
+    # ---- extension points ----------------------------------------------------
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return apply(f"{type(self).__name__.lower()}_stddev",
+                     jnp.sqrt, self.variance)
+
+    def sample(self, shape=()):
+        """Non-differentiable draw (paddle semantics: detached)."""
+        with _tape.no_grad():
+            out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement rsample (not "
+            "reparameterizable)")
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply(f"{type(self).__name__.lower()}_prob",
+                     jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other) -> Tensor:
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    # ---- helpers -------------------------------------------------------------
+    def _extend_shape(self, sample_shape) -> tuple:
+        """sample_shape + batch_shape + event_shape (distribution.py parity)."""
+        return (_shape_tuple(sample_shape) + tuple(self._batch_shape)
+                + tuple(self._event_shape))
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(batch_shape={self._batch_shape}, "
+                f"event_shape={self._event_shape})")
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions
+    (python/paddle/distribution/exponential_family.py). Subclasses expose
+    natural parameters + log normalizer; the Bregman-divergence entropy
+    shortcut is inherited where defined."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError

@@ -1,0 +1,238 @@
+"""KL divergence registry.
+
+Reference parity: python/paddle/distribution/kl.py — ``kl_divergence(p, q)``
+dispatches on the most-derived registered (type(p), type(q)) pair;
+``register_kl`` is the user-extension decorator.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..ops.registry import apply
+from .distribution import Distribution
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator: register a pairwise KL implementation."""
+
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def _dispatch(type_p, type_q):
+    matches = [
+        (p, q) for (p, q) in _KL_REGISTRY
+        if issubclass(type_p, p) and issubclass(type_q, q)
+    ]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL(p || q) registered for ({type_p.__name__}, "
+            f"{type_q.__name__})")
+
+    # most-derived match (paddle kl.py uses total ordering by specificity)
+    def key(pair):
+        p, q = pair
+        return (sum(issubclass(p2, p) for (p2, _) in matches),
+                sum(issubclass(q2, q) for (_, q2) in matches))
+
+    return _KL_REGISTRY[min(matches, key=key)]
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return _dispatch(type(p), type(q))(p, q)
+
+
+# ---- registered pairs --------------------------------------------------------
+
+from .continuous import (  # noqa: E402
+    Beta, Cauchy, Dirichlet, Exponential, Gamma, Gumbel, Laplace, LogNormal,
+    Normal, Uniform)
+from .discrete import Bernoulli, Categorical, Geometric, Poisson  # noqa: E402
+from .multivariate_normal import MultivariateNormal  # noqa: E402
+from .transformed_distribution import Independent  # noqa: E402
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def fn(l1, s1, l2, s2):
+        var_ratio = (s1 / s2) ** 2
+        t1 = ((l1 - l2) / s2) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+    return apply("kl_normal", fn, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def fn(a1, b1, a2, b2):
+        res = jnp.log((b2 - a2) / (b1 - a1))
+        return jnp.where((a2 <= a1) & (b1 <= b2), res, jnp.inf)
+
+    return apply("kl_uniform", fn, p.low, p.high, q.low, q.high)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    def fn(p1, p2):
+        eps = 1e-7
+        a = jnp.clip(p1, eps, 1 - eps)
+        b = jnp.clip(p2, eps, 1 - eps)
+        return (a * (jnp.log(a) - jnp.log(b))
+                + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+    return apply("kl_bernoulli", fn, p.probs, q.probs)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    def fn(lg1, lg2):
+        lp = jnp.log(jnp.clip(jnp.exp(lg1 - jsp.logsumexp(lg1, -1, keepdims=True)), 1e-30))
+        lq = lg2 - jsp.logsumexp(lg2, -1, keepdims=True)
+        pr = jnp.exp(lp)
+        return (pr * (lp - lq)).sum(-1)
+
+    return apply("kl_categorical", fn, p.logits, q.logits)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def fn(a1, b1, a2, b2):
+        s1 = a1 + b1
+        lbeta1 = jsp.gammaln(a1) + jsp.gammaln(b1) - jsp.gammaln(s1)
+        lbeta2 = jsp.gammaln(a2) + jsp.gammaln(b2) - jsp.gammaln(a2 + b2)
+        return (lbeta2 - lbeta1
+                + (a1 - a2) * jsp.digamma(a1)
+                + (b1 - b2) * jsp.digamma(b1)
+                + (a2 - a1 + b2 - b1) * jsp.digamma(s1))
+
+    return apply("kl_beta", fn, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def fn(c1, c2):
+        s1 = c1.sum(-1)
+        return (jsp.gammaln(s1) - jsp.gammaln(c2.sum(-1))
+                - (jsp.gammaln(c1) - jsp.gammaln(c2)).sum(-1)
+                + ((c1 - c2) * (jsp.digamma(c1)
+                                - jsp.digamma(s1)[..., None])).sum(-1))
+
+    return apply("kl_dirichlet", fn, p.concentration, q.concentration)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def fn(c1, r1, c2, r2):
+        return ((c1 - c2) * jsp.digamma(c1)
+                - jsp.gammaln(c1) + jsp.gammaln(c2)
+                + c2 * (jnp.log(r1) - jnp.log(r2))
+                + c1 * (r2 / r1 - 1))
+
+    return apply("kl_gamma", fn, p.concentration, p.rate,
+                 q.concentration, q.rate)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    def fn(r1, r2):
+        ratio = r2 / r1
+        return ratio - 1 - jnp.log(ratio)
+
+    return apply("kl_exponential", fn, p.rate, q.rate)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def fn(l1, s1, l2, s2):
+        # log(s2/s1) + |l1-l2|/s2 + (s1/s2) e^{-|l1-l2|/s1} - 1
+        diff = jnp.abs(l1 - l2)
+        return (jnp.log(s2) - jnp.log(s1) + diff / s2
+                + (s1 / s2) * jnp.exp(-diff / s1) - 1)
+
+    return apply("kl_laplace", fn, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    def fn(p1, p2):
+        # Σ_k p1(1-p1)^k [log(p1/p2) + k log((1-p1)/(1-p2))]
+        return (jnp.log(p1) - jnp.log(p2)
+                + (1 - p1) / p1 * (jnp.log1p(-p1) - jnp.log1p(-p2)))
+
+    return apply("kl_geometric", fn, p.probs, q.probs)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    def fn(r1, r2):
+        return r1 * (jnp.log(r1) - jnp.log(r2)) - r1 + r2
+
+    return apply("kl_poisson", fn, p.rate, q.rate)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    _EULER = 0.5772156649015329
+
+    def fn(l1, s1, l2, s2):
+        # log(s2/s1) + γ(s1/s2 - 1) + (l1-l2)/s2
+        #   + e^{(l2-l1)/s2} Γ(1 + s1/s2) - 1
+        ratio = s1 / s2
+        return (jnp.log(s2) - jnp.log(s1) + _EULER * (ratio - 1)
+                + (l1 - l2) / s2
+                + jnp.exp((l2 - l1) / s2 + jsp.gammaln(1 + ratio)) - 1)
+
+    return apply("kl_gumbel", fn, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    def fn(l1, s1, l2, s2):
+        return (jnp.log(((s1 + s2) ** 2 + (l1 - l2) ** 2)
+                        / (4 * s1 * s2)))
+
+    return apply("kl_cauchy", fn, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    import jax
+
+    def fn(l1, st1, l2, st2):
+        d = l1.shape[-1]
+        half_logdet1 = jnp.log(jnp.diagonal(st1, axis1=-2, axis2=-1)).sum(-1)
+        half_logdet2 = jnp.log(jnp.diagonal(st2, axis1=-2, axis2=-1)).sum(-1)
+        # tr(Σ2⁻¹ Σ1) = ||L2⁻¹ L1||_F²
+        m = jax.scipy.linalg.solve_triangular(st2, st1, lower=True)
+        tr = (m * m).sum((-2, -1))
+        diff = l2 - l1
+        y = jax.scipy.linalg.solve_triangular(st2, diff[..., None],
+                                              lower=True)[..., 0]
+        maha = (y * y).sum(-1)
+        return 0.5 * (tr + maha - d) + half_logdet2 - half_logdet1
+
+    return apply("kl_mvn", fn, p.loc, p.scale_tril, q.loc, q.scale_tril)
+
+
+@register_kl(Independent, Independent)
+def _kl_independent_independent(p, q):
+    if p.reinterpreted_batch_rank != q.reinterpreted_batch_rank:
+        raise NotImplementedError("mismatched reinterpreted_batch_rank")
+    from .transform import _sum_event
+
+    inner = kl_divergence(p.base, q.base)
+    return apply("kl_independent",
+                 lambda a: _sum_event(a, p.reinterpreted_batch_rank), inner)

@@ -1,0 +1,102 @@
+"""MultivariateNormal.
+
+Reference parity: python/paddle/distribution/multivariate_normal.py
+(loc + one of covariance_matrix / precision_matrix / scale_tril).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import apply
+from ..framework import random as _random
+from .distribution import Distribution, _arr, _param, _shape_of
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = _param(loc)
+        if len(_shape_of(self.loc)) < 1:
+            raise ValueError("MultivariateNormal loc must be at least 1-D")
+        given = [a is not None
+                 for a in (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError(
+                "exactly one of covariance_matrix, precision_matrix, "
+                "scale_tril must be specified")
+        if scale_tril is not None:
+            self.scale_tril = _param(scale_tril)
+        elif covariance_matrix is not None:
+            self.covariance_matrix = _param(covariance_matrix)
+            self.scale_tril = apply("mvn_cholesky", jnp.linalg.cholesky,
+                                    self.covariance_matrix)
+        else:
+            self.precision_matrix = _param(precision_matrix)
+            self.scale_tril = apply(
+                "mvn_prec_cholesky",
+                lambda pm: jnp.linalg.cholesky(jnp.linalg.inv(pm)),
+                self.precision_matrix)
+        lshape, sshape = _shape_of(self.loc), _shape_of(self.scale_tril)
+        d = lshape[-1]
+        if sshape[-1] != d or sshape[-2] != d:
+            raise ValueError("scale_tril/covariance shape mismatch with loc")
+        batch = jnp.broadcast_shapes(lshape[:-1], sshape[:-2])
+        super().__init__(batch_shape=batch, event_shape=(d,))
+
+    @property
+    def mean(self):
+        return apply("mvn_mean",
+                     lambda l: jnp.broadcast_to(
+                         l, tuple(self.batch_shape) + tuple(self.event_shape)),
+                     self.loc)
+
+    @property
+    def variance(self):
+        def fn(st):
+            var = (st * st).sum(-1)
+            return jnp.broadcast_to(
+                var, tuple(self.batch_shape) + tuple(self.event_shape))
+
+        return apply("mvn_variance", fn, self.scale_tril)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        key = _random.next_key()
+
+        def fn(l, st):
+            eps = jax.random.normal(key, out_shape, dtype=l.dtype)
+            return l + jnp.einsum("...ij,...j->...i", st, eps)
+
+        return apply("mvn_rsample", fn, self.loc, self.scale_tril)
+
+    def log_prob(self, value):
+        def fn(l, st, v):
+            diff = v - l
+            # solve L y = diff  → mahalanobis = ||y||²
+            y = jax.scipy.linalg.solve_triangular(st, diff[..., None],
+                                                  lower=True)[..., 0]
+            m = (y * y).sum(-1)
+            half_logdet = jnp.log(
+                jnp.diagonal(st, axis1=-2, axis2=-1)).sum(-1)
+            d = v.shape[-1]
+            return -0.5 * (m + d * math.log(2 * math.pi)) - half_logdet
+
+        return apply("mvn_log_prob", fn, self.loc, self.scale_tril, value)
+
+    def entropy(self):
+        def fn(st):
+            d = st.shape[-1]
+            half_logdet = jnp.log(
+                jnp.diagonal(st, axis1=-2, axis2=-1)).sum(-1)
+            h = 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+            return jnp.broadcast_to(h, tuple(self.batch_shape))
+
+        return apply("mvn_entropy", fn, self.scale_tril)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)

@@ -1,0 +1,115 @@
+"""TransformedDistribution + Independent.
+
+Reference parity: python/paddle/distribution/transformed_distribution.py and
+independent.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.registry import apply
+from ..tensor_class import Tensor, unwrap
+from .distribution import Distribution, _shape_tuple
+from .transform import ChainTransform, Transform, Type, _sum_event
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a chain of transforms."""
+
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError(f"not a Transform: {t!r}")
+        self.base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        base_shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        out_shape = chain.forward_shape(base_shape)
+        event_rank = max(chain._codomain_event_rank, len(base.event_shape))
+        cut = len(out_shape) - event_rank
+        super().__init__(batch_shape=out_shape[:cut],
+                         event_shape=out_shape[cut:])
+        self._chain = chain
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def sample(self, shape=()):
+        from ..autograd import tape as _tape
+
+        with _tape.no_grad():
+            out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        event_rank = max(self._chain._codomain_event_rank,
+                         len(self.base.event_shape))
+        x = value
+        terms = []
+        for t in reversed(self.transforms):
+            if not Type.is_injective(t.type):
+                raise NotImplementedError(
+                    "log_prob through a non-injective transform")
+            x_prev = t.inverse(x)
+            ldj = t.forward_log_det_jacobian(x_prev)
+            terms.append((ldj, event_rank - t._codomain_event_rank))
+            x = x_prev
+            event_rank = max(event_rank - t._codomain_event_rank
+                             + t._domain_event_rank, len(self.base.event_shape))
+        base_lp = self.base.log_prob(x)
+
+        def fn(blp, *ldjs):
+            total = blp
+            for (arr, extra) in zip(ldjs, [e for (_, e) in terms]):
+                total = total - _sum_event(arr, extra)
+            return total
+
+        return apply("transformed_log_prob", fn, base_lp,
+                     *[ldj for (ldj, _) in terms])
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims of ``base`` as event dims
+    (python/paddle/distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        r = int(reinterpreted_batch_rank)
+        if r <= 0 or r > len(base.batch_shape):
+            raise ValueError(
+                f"reinterpreted_batch_rank must be in (0, {len(base.batch_shape)}]")
+        self.base = base
+        self.reinterpreted_batch_rank = r
+        bshape = tuple(base.batch_shape)
+        super().__init__(
+            batch_shape=bshape[: len(bshape) - r],
+            event_shape=bshape[len(bshape) - r:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return apply("independent_log_prob",
+                     lambda a: _sum_event(a, self.reinterpreted_batch_rank), lp)
+
+    def entropy(self):
+        h = self.base.entropy()
+        return apply("independent_entropy",
+                     lambda a: _sum_event(a, self.reinterpreted_batch_rank), h)

@@ -28,3 +28,17 @@ from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention,
 )
 from ...ops.manipulation import pad  # noqa: F401  (F.pad parity)
+from ...ops import schema as _schema  # noqa: E402
+
+# schema-generated tail (declared once in ops/schema.py — ops.yaml analog)
+channel_shuffle = _schema.generated("channel_shuffle")
+affine_grid = _schema.generated("affine_grid")
+grid_sample = _schema.generated("grid_sample")
+fold = _schema.generated("fold")
+lp_pool2d = _schema.generated("lp_pool2d")
+max_unpool2d = _schema.generated("max_unpool2d")
+soft_margin_loss = _schema.generated("soft_margin_loss")
+multi_margin_loss = _schema.generated("multi_margin_loss")
+multi_label_soft_margin_loss = _schema.generated("multi_label_soft_margin_loss")
+npair_loss = _schema.generated("npair_loss")
+margin_cross_entropy = _schema.generated("margin_cross_entropy")

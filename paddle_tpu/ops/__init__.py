@@ -93,6 +93,26 @@ def _install_tensor_methods():
             if fn is not None and not hasattr(T, name):
                 setattr(T, name, fn)
 
+    # schema-generated tail as Tensor methods
+    from . import schema as _schema
+
+    T.unfold = lambda s, axis, size, step: _schema.generated("unfold_window")(s, axis, size, step)
+    T.fill_diagonal = lambda s, value, offset=0, wrap=False: _schema.generated("fill_diagonal")(s, value, offset, wrap)
+
+    def _fill_diagonal_(self, value, offset=0, wrap=False):
+        from .registry import inplace_swap
+
+        out = _schema.generated("fill_diagonal")(self, value, offset, wrap)
+        return inplace_swap(self, out)
+
+    T.fill_diagonal_ = _fill_diagonal_
+    T.quantile = lambda s, q, axis=None, keepdim=False, interpolation="linear": _schema.generated("quantile")(s, q, axis=axis, keepdim=keepdim, interpolation=interpolation)
+    T.vander = lambda s, n=None, increasing=False: _schema.generated("vander")(s, n=n, increasing=increasing)
+    T.view_as = lambda s, other: _schema.generated("view_as")(s, other)
+    T.as_strided = lambda s, shape, stride, offset=0: _schema.generated("as_strided")(s, shape, stride, offset)
+    T.index_fill = lambda s, index, axis, value: _schema.generated("index_fill")(s, index, axis, value)
+    T.gammaln = lambda s: _schema.generated("gammaln")(s)
+
     # astype-family already defined on Tensor; cast alias handled there
     T.cast = lambda s, dtype: math.cast(s, dtype)
     T.astype = T.cast

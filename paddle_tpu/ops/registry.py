@@ -30,13 +30,14 @@ from ..framework import dtype as _dtype_mod
 
 
 class OpDef:
-    __slots__ = ("name", "fn", "differentiable", "doc")
+    __slots__ = ("name", "fn", "differentiable", "doc", "decl")
 
     def __init__(self, name, fn, differentiable=True, doc=""):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
         self.doc = doc
+        self.decl = None  # OpSchema declaration when schema-generated
 
 
 OPS: Dict[str, OpDef] = {}

@@ -1,0 +1,81 @@
+"""TensorArray: dynamic list-of-tensors surface.
+
+Reference parity: paddle/phi/core/tensor_array.h (the C++ type) and
+python/paddle/tensor/array.py (array_length/array_read/array_write/
+create_array).
+
+TPU-native design: in eager mode a TensorArray IS a Python list of Tensors
+(exactly what the reference does in dygraph — array.py:43 returns len(array)
+for lists). Inside jit-traced code, a Python list of traced values plays the
+same role; loops that need a traced-length array should use lax.scan with a
+stacked tensor instead, which is the XLA-idiomatic replacement for the
+while_loop+TensorArray pattern of the static graph.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .tensor_class import Tensor
+
+
+class TensorArray(list):
+    """A list subtype so user isinstance checks on list keep working."""
+
+    def __repr__(self):
+        return f"TensorArray(len={len(self)})"
+
+
+def create_array(dtype: str = "float32", initialized_list: Optional[list] = None):
+    """paddle.tensor.create_array parity (array.py:309)."""
+    arr = TensorArray()
+    if initialized_list is not None:
+        for v in initialized_list:
+            if not isinstance(v, Tensor):
+                raise TypeError(
+                    "All values in `initialized_list` should be Tensor, but "
+                    f"received {type(v).__name__}.")
+            arr.append(v)
+    return arr
+
+
+def array_length(array) -> int:
+    """paddle.tensor.array_length parity (array.py:43)."""
+    if not isinstance(array, list):
+        raise TypeError(
+            "array should be a python list (TensorArray in the reference), "
+            f"got {type(array).__name__}")
+    return len(array)
+
+
+def _index_of(i) -> int:
+    if isinstance(i, Tensor):
+        if i._array.size != 1:
+            raise ValueError("array index must be a scalar")
+        return int(i._array)
+    return int(i)
+
+
+def array_read(array, i):
+    """paddle.tensor.array_read parity (array.py:110)."""
+    idx = _index_of(i)
+    if not isinstance(array, list):
+        raise TypeError("array should be a python list")
+    if idx >= len(array):
+        raise IndexError(f"array index {idx} out of range ({len(array)})")
+    return array[idx]
+
+
+def array_write(x, i, array=None):
+    """paddle.tensor.array_write parity (array.py:206): write ``x`` at
+    position ``i``, growing the array if i == len(array)."""
+    idx = _index_of(i)
+    if array is None:
+        array = create_array()
+    if idx > len(array):
+        raise IndexError(
+            f"array index {idx} skips positions (len={len(array)})")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array

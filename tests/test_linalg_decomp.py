@@ -1,0 +1,107 @@
+"""Property tests for matrix decompositions.
+
+OpTest-style value comparison fails for decompositions whose outputs are
+only unique up to sign/phase/ordering (qr, svd, eig, eigh, lu); these are
+instead validated by reconstruction and structural properties, the way the
+reference's test/legacy_test/test_qr_op.py etc. verify Q@R == A.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import linalg as L
+
+R = np.random.RandomState(7)
+
+
+def _mat(shape=(5, 4)):
+    return paddle.to_tensor(R.uniform(-1, 1, shape).astype("float32"))
+
+
+def _spd(n=4):
+    a = R.uniform(-1, 1, (n, n))
+    return paddle.to_tensor((a @ a.T + n * np.eye(n)).astype("float32"))
+
+
+def test_qr_reconstruction():
+    x = _mat((5, 4))
+    q, r = L.qr(x)
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), x.numpy(), atol=1e-5)
+    np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(4), atol=1e-5)
+    assert np.allclose(np.tril(r.numpy(), -1), 0.0)
+    r_only = L.qr(x, mode="r")
+    np.testing.assert_allclose(np.abs(r_only.numpy()), np.abs(r.numpy()),
+                               atol=1e-5)
+
+
+def test_svd_reconstruction():
+    x = _mat((5, 4))
+    u, s, vh = L.svd(x)
+    rec = (u.numpy() * s.numpy()[None, :]) @ vh.numpy()
+    np.testing.assert_allclose(rec, x.numpy(), atol=1e-5)
+    np.testing.assert_allclose(
+        s.numpy(), np.linalg.svd(x.numpy(), compute_uv=False), atol=1e-5)
+    np.testing.assert_allclose(L.svdvals(x).numpy(), s.numpy(), atol=1e-6)
+
+
+def test_eigh_properties():
+    x = _spd()
+    w, v = L.eigh(x)
+    np.testing.assert_allclose(
+        x.numpy() @ v.numpy(), v.numpy() * w.numpy()[None, :], atol=1e-4)
+    np.testing.assert_allclose(w.numpy(), np.linalg.eigvalsh(x.numpy()),
+                               atol=1e-4)
+    np.testing.assert_allclose(L.eigvalsh(x).numpy(), w.numpy(), atol=1e-5)
+
+
+def test_eig_general():
+    x = _mat((4, 4))
+    w, v = L.eig(x)
+    xw = x.numpy().astype("complex64") @ v.numpy()
+    np.testing.assert_allclose(xw, v.numpy() * w.numpy()[None, :], atol=1e-4)
+    got = np.sort_complex(L.eigvals(x).numpy())
+    ref = np.sort_complex(np.linalg.eigvals(x.numpy()))
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_lu_and_unpack():
+    x = _mat((4, 4))
+    lu, piv = L.lu(x)
+    assert piv.numpy().min() >= 1  # paddle pivots are 1-based
+    p, l, u = L.lu_unpack(lu, piv)
+    rec = p.numpy() @ l.numpy() @ u.numpy()
+    np.testing.assert_allclose(rec, x.numpy(), atol=1e-5)
+    assert np.allclose(np.triu(l.numpy(), 1), 0.0)
+    assert np.allclose(np.diag(l.numpy()), 1.0)
+    assert np.allclose(np.tril(u.numpy(), -1), 0.0)
+    lu3 = L.lu(x, get_infos=True)
+    assert len(lu3) == 3
+
+
+def test_lstsq():
+    a = _mat((6, 3))
+    b = _mat((6, 2))
+    sol = L.lstsq(a, b)[0]
+    ref = np.linalg.lstsq(a.numpy(), b.numpy(), rcond=None)[0]
+    np.testing.assert_allclose(sol.numpy(), ref, atol=1e-4)
+
+
+def test_norms():
+    x = _mat((3, 4))
+    np.testing.assert_allclose(L.matrix_norm(x).numpy(),
+                               np.linalg.norm(x.numpy(), "fro"), rtol=1e-5)
+    np.testing.assert_allclose(L.vector_norm(x, p=2).numpy(),
+                               np.linalg.norm(x.numpy().ravel()), rtol=1e-5)
+    np.testing.assert_allclose(L.norm(x).numpy(),
+                               np.linalg.norm(x.numpy()), rtol=1e-5)
+    np.testing.assert_allclose(
+        L.norm(x, p=np.inf, axis=1).numpy(),
+        np.linalg.norm(x.numpy(), np.inf, axis=1), rtol=1e-5)
+
+
+def test_slogdet():
+    x = _spd()
+    sign, logdet = L.slogdet(x)
+    rs, rl = np.linalg.slogdet(x.numpy())
+    np.testing.assert_allclose(sign.numpy(), rs, atol=1e-5)
+    np.testing.assert_allclose(logdet.numpy(), rl, rtol=1e-4)

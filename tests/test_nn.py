@@ -315,3 +315,52 @@ def test_weight_norm_grads_flow():
     y.sum().backward()
     assert l._parameters["weight_g"].grad is not None
     assert l._parameters["weight_v"].grad is not None
+
+
+def test_rrelu_and_gumbel_softmax():
+    """Randomized activations: bounds/simplex properties + eval-mode
+    determinism (these can't be value-matched against a fixed reference)."""
+    paddle.seed(4)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(64).astype("float32"))
+    # eval mode: fixed mean slope, deterministic
+    out = F.rrelu(x, lower=0.1, upper=0.3, training=False).numpy()
+    xn = x.numpy()
+    np.testing.assert_allclose(out[xn >= 0], xn[xn >= 0])
+    np.testing.assert_allclose(out[xn < 0], xn[xn < 0] * 0.2, rtol=1e-5)
+    # training mode: slopes inside [lower, upper]
+    out_t = F.rrelu(x, lower=0.1, upper=0.3, training=True).numpy()
+    neg = xn < 0
+    slopes = out_t[neg] / xn[neg]
+    assert (slopes >= 0.1 - 1e-6).all() and (slopes <= 0.3 + 1e-6).all()
+    np.testing.assert_allclose(out_t[~neg], xn[~neg])
+
+    # gumbel softmax: simplex rows; hard=True gives one-hot straight-through
+    logits = paddle.to_tensor(np.random.RandomState(1).randn(8, 5).astype("float32"),
+                              stop_gradient=False)
+    soft = F.gumbel_softmax(logits, temperature=0.5)
+    sn = soft.numpy()
+    np.testing.assert_allclose(sn.sum(-1), 1.0, rtol=1e-5)
+    assert (sn >= 0).all()
+    hard = F.gumbel_softmax(logits, temperature=0.5, hard=True)
+    hn = hard.numpy()
+    assert ((hn == 0) | (np.isclose(hn, 1))).all()
+    np.testing.assert_array_equal(hn.sum(-1), 1.0)
+    hard.sum().backward()  # straight-through grads reach the logits
+    assert logits.grad is not None
+
+
+def test_ctc_loss_matches_manual():
+    """CTC on a tiny case vs a hand-computed forward algorithm."""
+    # T=2, B=1, C=3 (blank=0); label "1"
+    logp = np.log(np.array([
+        [[0.6, 0.3, 0.1]],   # t=0
+        [[0.5, 0.4, 0.1]],   # t=1
+    ], dtype="float32"))
+    labels = np.array([[1]], dtype="int32")
+    # paths emitting "1" over 2 frames: (1,1), (1,-), (-,1)
+    p = 0.3 * 0.4 + 0.3 * 0.5 + 0.6 * 0.4
+    ref = -np.log(p)
+    loss = F.ctc_loss(paddle.to_tensor(logp), paddle.to_tensor(labels),
+                      paddle.to_tensor(np.array([2])),
+                      paddle.to_tensor(np.array([1])), reduction="none")
+    np.testing.assert_allclose(np.ravel(loss.numpy())[0], ref, rtol=1e-4)

@@ -574,12 +574,652 @@ def _mode_ref(x):
     return vals, idxs
 
 
+
+
+# ---- helper refs for the schema-tail specs ----------------------------------
+import scipy.special as _sp  # noqa: E402
+
+
+def _erf(x):
+    return _sp.erf(x)
+
+
+def _with_nan(x):
+    x = x.copy()
+    x[0, 0] = np.nan
+    return x
+
+
+def _pos(shape=(3, 4), lo=0.1, hi=2.0):
+    return R.uniform(lo, hi, shape)
+
+
+def _cos_sim(a, b, axis=1):
+    num = (a * b).sum(axis)
+    den = np.sqrt((a * a).sum(axis)) * np.sqrt((b * b).sum(axis))
+    return num / np.maximum(den, 1e-12)
+
+
+def _softmax_ce_ref(logits, label):
+    m = logits.max(-1, keepdims=True)
+    logp = logits - m - np.log(np.exp(logits - m).sum(-1, keepdims=True))
+    return np.mean(-np.take_along_axis(logp, label[:, None], -1)[:, 0])
+
+
+def _multi_margin_ref(logit, label):
+    n, c = logit.shape
+    correct = np.take_along_axis(logit, label[:, None], 1)
+    m = np.maximum(0.0, 1.0 - correct + logit)
+    mask = np.eye(c)[label]
+    return np.mean((m * (1 - mask)).sum(1) / c)
+
+
+def _npair_ref(anchor, positive, labels):
+    reg = 0.002 * ((anchor ** 2).sum(-1).mean()
+                   + (positive ** 2).sum(-1).mean()) * 0.25
+    sim = anchor @ positive.T
+    eq = (labels[:, None] == labels[None, :]).astype("float64")
+    tgt = eq / eq.sum(-1, keepdims=True)
+    m = sim.max(-1, keepdims=True)
+    logp = sim - m - np.log(np.exp(sim - m).sum(-1, keepdims=True))
+    return -(tgt * logp).sum(-1).mean() + reg
+
+
+def _temporal_shift_ref(x, seg_num, shift_ratio):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    y = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    out = np.zeros_like(y)
+    out[:, :-1, :fold] = y[:, 1:, :fold]            # shift left
+    out[:, 1:, fold:2 * fold] = y[:, :-1, fold:2 * fold]  # shift right
+    out[:, :, 2 * fold:] = y[:, :, 2 * fold:]
+    return out.reshape(nt, c, h, w)
+
+
+def _fold_ref(x, output_sizes, kernel_sizes, strides):
+    n, ckk, L = x.shape
+    kh, kw = kernel_sizes
+    c = ckk // (kh * kw)
+    oh, ow = output_sizes
+    lh = (oh - kh) // strides + 1
+    lw = (ow - kw) // strides + 1
+    cols = x.reshape(n, c, kh, kw, lh, lw)
+    out = np.zeros((n, c, oh, ow))
+    for i in range(kh):
+        for j in range(kw):
+            out[:, :, i:i + strides * lh:strides,
+                j:j + strides * lw:strides] += cols[:, :, i, j]
+    return out
+
+
+def _unfold_ref(x, k, s):
+    n, c, h, w = x.shape
+    lh = (h - k) // s + 1
+    lw = (w - k) // s + 1
+    cols = np.zeros((n, c, k, k, lh, lw))
+    for i in range(k):
+        for j in range(k):
+            cols[:, :, i, j] = x[:, :, i:i + s * lh:s, j:j + s * lw:s]
+    return cols.reshape(n, c * k * k, lh * lw)
+
+
+def _lp_pool_ref(x, p, k):
+    n, c, h, w = x.shape
+    out = np.zeros((n, c, h // k, w // k))
+    for i in range(h // k):
+        for j in range(w // k):
+            win = x[:, :, i * k:(i + 1) * k, j * k:(j + 1) * k]
+            out[:, :, i, j] = ((np.abs(win) ** p).sum((-2, -1))) ** (1.0 / p)
+    return out
+
+
+def _affine_grid_ref(theta, out_shape):
+    n, _c, h, w = out_shape
+    ys = np.linspace(-1, 1, h)
+    xs = np.linspace(-1, 1, w)
+    gx, gy = np.meshgrid(xs, ys)
+    base = np.stack([gx, gy, np.ones_like(gx)], -1)
+    return np.einsum("hwk,nok->nhwo", base, theta)
+
+
+def _identity_grid(n, h, w):
+    ys = np.linspace(-1, 1, h)
+    xs = np.linspace(-1, 1, w)
+    gx, gy = np.meshgrid(xs, ys)
+    g = np.stack([gx, gy], -1)[None]
+    return np.repeat(g, n, 0)
+
+
+def _max_unpool_ref(x, indices, out_hw):
+    n, c = x.shape[:2]
+    out = np.zeros((n, c, out_hw[0] * out_hw[1]))
+    for b in range(n):
+        for ch in range(c):
+            out[b, ch, indices[b, ch].ravel()] = x[b, ch].ravel()
+    return out.reshape(n, c, *out_hw)
+
+
+def _overlap_add_ref(x, hop):
+    frame_len, n_frames = x.shape
+    out = np.zeros(hop * (n_frames - 1) + frame_len)
+    for i in range(n_frames):
+        out[i * hop:i * hop + frame_len] += x[:, i]
+    return out
+
+
+def _index_fill_ref(x, index, axis, value):
+    out = x.copy()
+    if axis == 0:
+        out[index] = value
+    else:
+        out[:, index] = value
+    return out
+
+
+def _index_add_ref(x, index, value):
+    out = x.copy()
+    for i, idx in enumerate(index):
+        out[idx] += value[i]
+    return out
+
+
+def _index_put_ref(x, indices, value):
+    out = x.copy()
+    out[indices] = value
+    return out
+
+
+def _put_along_ref(arr, indices, values, axis):
+    out = arr.copy()
+    np.put_along_axis(out, indices, values, axis)
+    return out
+
+
+def _scatter_ref(x, index, updates):
+    out = x.copy()
+    out[index] = updates
+    return out
+
+
+def _scatter_nd_ref(index, updates, shape):
+    out = np.zeros(shape)
+    for i, idx in enumerate(index[:, 0]):
+        out[idx] += updates[i]
+    return out
+
+
+def _fill_diag_ref(x, value):
+    out = x.copy()
+    np.fill_diagonal(out, value)
+    return out
+
+
+def _flatten_specs(items):
+    flat = []
+    for it in items:
+        if isinstance(it, list):
+            flat.extend(it)
+        else:
+            flat.append(it)
+    return flat
+
+
+# ---- schema tail: activations (VERDICT r3: registry >=300, all swept) -------
+
+_SCHEMA_SPECS = [
+    OpSpec(name="nn.functional.celu", inputs={"x": _arr()}, attrs={"alpha": 2.0},
+           ref=lambda x, alpha: np.maximum(0, x) + np.minimum(0, alpha * np.expm1(x / alpha)),
+           grad=("x",), covers=("celu",)),
+    OpSpec(name="nn.functional.elu", inputs={"x": _arr()}, attrs={"alpha": 1.5},
+           ref=lambda x, alpha: np.where(x > 0, x, alpha * np.expm1(x)),
+           grad=("x",), covers=("elu",)),
+    OpSpec(name="nn.functional.gelu", inputs={"x": _arr()},
+           ref=lambda x: x * 0.5 * (1 + _erf(x / np.sqrt(2.0))),
+           grad=("x",), covers=("gelu",)),
+    OpSpec(name="nn.functional.glu", inputs={"x": _arr((3, 6))},
+           ref=lambda x: x[:, :3] * _sigmoid(x[:, 3:]), grad=("x",),
+           covers=("glu",)),
+    OpSpec(name="nn.functional.hardshrink", inputs={"x": _arr()},
+           ref=lambda x: np.where(np.abs(x) > 0.5, x, 0.0), grad=("x",),
+           covers=("hardshrink",)),
+    OpSpec(name="nn.functional.hardsigmoid", inputs={"x": _arr()},
+           ref=lambda x: np.clip(x * 0.1666667 + 0.5, 0, 1), grad=("x",),
+           covers=("hardsigmoid",)),
+    OpSpec(name="nn.functional.hardtanh", inputs={"x": _arr() * 3},
+           ref=lambda x: np.clip(x, -1, 1), grad=("x",), covers=("hardtanh",)),
+    OpSpec(name="nn.functional.leaky_relu", inputs={"x": _arr()},
+           ref=lambda x: np.where(x >= 0, x, 0.01 * x), grad=("x",),
+           covers=("leaky_relu",)),
+    OpSpec(name="nn.functional.log_softmax", inputs={"x": _arr((3, 5))},
+           ref=lambda x: x - np.log(np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True)) - x.max(-1, keepdims=True),
+           grad=("x",), covers=("log_softmax",)),
+    OpSpec(name="nn.functional.softmax", inputs={"x": _arr((3, 5))},
+           ref=lambda x: np.exp(x - x.max(-1, keepdims=True)) / np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True),
+           grad=("x",), covers=("softmax", "softmax_")),
+    OpSpec(name="nn.functional.maxout", inputs={"x": _arr((2, 6, 3, 3))},
+           attrs={"groups": 2},
+           ref=lambda x, groups: x.reshape(2, 3, groups, 3, 3).max(2),
+           grad=("x",), covers=("maxout",)),
+    OpSpec(name="nn.functional.prelu",
+           inputs={"x": _arr((2, 3, 4)), "weight": np.array([0.25, 0.2, 0.1])},
+           ref=lambda x, weight: np.where(x >= 0, x, x * weight[None, :, None]),
+           grad=("x",), covers=("prelu",)),
+    OpSpec(name="nn.functional.softplus", inputs={"x": _arr()},
+           ref=lambda x: _softplus(x), grad=("x",), covers=("softplus",)),
+    OpSpec(name="nn.functional.softshrink", inputs={"x": _arr() * 2},
+           ref=lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0.0)),
+           grad=("x",), covers=("softshrink",)),
+    OpSpec(name="nn.functional.swish", inputs={"x": _arr()},
+           ref=lambda x: x * _sigmoid(x), grad=("x",), covers=("swish",)),
+    OpSpec(name="nn.functional.thresholded_relu", inputs={"x": _arr() * 2},
+           ref=lambda x: np.where(x > 1.0, x, 0.0), grad=("x",),
+           covers=("thresholded_relu",)),
+    # ---- losses -------------------------------------------------------------
+    OpSpec(name="nn.functional.binary_cross_entropy",
+           inputs={"input": _arr(lo=0.05, hi=0.95), "label": _arr(lo=0.0, hi=1.0)},
+           ref=lambda input, label: np.mean(-(label * np.log(input) + (1 - label) * np.log(1 - input))),
+           grad=("input",), covers=("binary_cross_entropy",)),
+    OpSpec(name="nn.functional.binary_cross_entropy_with_logits",
+           inputs={"logit": _arr(), "label": _arr(lo=0.0, hi=1.0)},
+           ref=lambda logit, label: np.mean(_softplus(logit) - label * logit),
+           grad=("logit",), covers=("binary_cross_entropy_with_logits",)),
+    OpSpec(name="nn.functional.mse_loss",
+           inputs={"input": _arr(), "label": _arr()},
+           ref=lambda input, label: np.mean((input - label) ** 2),
+           grad=("input",), covers=("mse_loss",)),
+    OpSpec(name="nn.functional.l1_loss",
+           inputs={"input": _arr(), "label": _arr() + 0.3},
+           ref=lambda input, label: np.mean(np.abs(input - label)),
+           grad=("input",), covers=("l1_loss",)),
+    OpSpec(name="nn.functional.smooth_l1_loss",
+           inputs={"input": _arr() * 3, "label": _arr()},
+           ref=lambda input, label: np.mean(np.where(np.abs(input - label) < 1.0,
+                                                     0.5 * (input - label) ** 2,
+                                                     np.abs(input - label) - 0.5)),
+           grad=("input",), covers=("smooth_l1_loss",)),
+    OpSpec(name="nn.functional.huber_loss",
+           inputs={"input": _arr() * 3, "label": _arr()},
+           ref=lambda input, label: np.mean(np.where(np.abs(input - label) <= 1.0,
+                                                     0.5 * (input - label) ** 2,
+                                                     np.abs(input - label) - 0.5)),
+           grad=("input",), covers=("huber_loss",)),
+    OpSpec(name="nn.functional.kl_div",
+           inputs={"input": np.log(_arr(lo=0.1, hi=0.9)), "label": _arr(lo=0.1, hi=0.9)},
+           ref=lambda input, label: np.mean(label * (np.log(label) - input)),
+           grad=("input",), covers=("kl_div",)),
+    OpSpec(name="nn.functional.margin_ranking_loss",
+           inputs={"input": _arr(), "other": _arr(),
+                   "label": np.sign(_arr()) + (np.sign(_arr()) == 0)},
+           ref=lambda input, other, label: np.mean(np.maximum(0, -label * (input - other))),
+           grad=("input",), covers=("margin_ranking_loss",)),
+    OpSpec(name="nn.functional.hinge_embedding_loss",
+           inputs={"input": _arr() * 2,
+                   "label": np.where(_arr() > 0, 1.0, -1.0)},
+           ref=lambda input, label: np.mean(np.where(label == 1.0, input,
+                                                     np.maximum(0, 1.0 - input))),
+           grad=("input",), covers=("hinge_embedding_loss",)),
+    OpSpec(name="nn.functional.cosine_embedding_loss",
+           inputs={"input1": _arr((4, 8)), "input2": _arr((4, 8)),
+                   "label": np.array([1.0, -1.0, 1.0, -1.0])},
+           ref=lambda input1, input2, label: np.mean(np.where(
+               label == 1,
+               1 - _cos_sim(input1, input2),
+               np.maximum(0, _cos_sim(input1, input2)))),
+           grad=(), covers=("cosine_embedding_loss",)),
+    OpSpec(name="nn.functional.cosine_similarity",
+           inputs={"x1": _arr((4, 8)), "x2": _arr((4, 8))},
+           ref=lambda x1, x2: _cos_sim(x1, x2), grad=("x1", "x2"),
+           covers=("cosine_similarity",)),
+    OpSpec(name="nn.functional.triplet_margin_loss",
+           inputs={"input": _arr((4, 8)), "positive": _arr((4, 8)),
+                   "negative": _arr((4, 8))},
+           ref=lambda input, positive, negative: np.mean(np.maximum(
+               0, np.sqrt(((input - positive) ** 2).sum(-1) + 1e-6)
+               - np.sqrt(((input - negative) ** 2).sum(-1) + 1e-6) + 1.0)),
+           rtol=1e-4, atol=1e-5,
+           grad=(), covers=("triplet_margin_loss",)),
+    OpSpec(name="nn.functional.log_loss",
+           inputs={"input": _arr(lo=0.1, hi=0.9), "label": _arr(lo=0.0, hi=1.0)},
+           ref=lambda input, label: -label * np.log(input + 1e-4)
+           - (1 - label) * np.log(1 - input + 1e-4),
+           grad=("input",), covers=("log_loss",)),
+    OpSpec(name="nn.functional.square_error_cost",
+           inputs={"input": _arr(), "label": _arr()},
+           ref=lambda input, label: (input - label) ** 2,
+           grad=("input",), covers=("square_error_cost",)),
+    OpSpec(name="nn.functional.sigmoid_focal_loss",
+           inputs={"logit": _arr((4, 3)), "label": (_arr((4, 3)) > 0).astype("float64")},
+           ref=lambda logit, label: np.sum(
+               -(label * 0.25 + (1 - label) * 0.75)
+               * ((1 - np.where(label > 0, _sigmoid(logit), 1 - _sigmoid(logit))) ** 2.0)
+               * np.where(label > 0, np.log(_sigmoid(logit)), np.log(1 - _sigmoid(logit)))),
+           rtol=1e-4, atol=1e-4, grad=(), covers=("sigmoid_focal_loss",)),
+    OpSpec(name="nn.functional.softmax_with_cross_entropy",
+           inputs={"logits": _arr((4, 5)), "label": np.array([[0], [2], [4], [1]])},
+           ref=lambda logits, label: -np.take_along_axis(
+               logits - np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) - logits.max(-1, keepdims=True),
+               label, axis=-1),
+           grad=("logits",), covers=("softmax_with_cross_entropy",)),
+    OpSpec(name="nn.functional.soft_margin_loss",
+           inputs={"logit": _arr() * 40, "label": np.where(_arr() > 0, 1.0, -1.0)},
+           ref=lambda logit, label: np.mean(_softplus(-label * logit)),
+           grad=("logit",), covers=("soft_margin_loss",)),
+    OpSpec(name="nn.functional.multi_margin_loss",
+           inputs={"logit": _arr((4, 5)), "label": np.array([0, 2, 4, 1])},
+           ref=lambda logit, label: _multi_margin_ref(logit, label),
+           grad=(), covers=("multi_margin_loss",)),
+    OpSpec(name="nn.functional.multi_label_soft_margin_loss",
+           inputs={"logit": _arr((4, 5)),
+                   "label": (_arr((4, 5)) > 0).astype("float64")},
+           ref=lambda logit, label: np.mean(np.mean(
+               -(label * np.log(_sigmoid(logit))
+                 + (1 - label) * np.log(_sigmoid(-logit))), -1)),
+           grad=("logit",), covers=("multi_label_soft_margin_loss",)),
+    OpSpec(name="nn.functional.npair_loss",
+           inputs={"anchor": _arr((4, 8)), "positive": _arr((4, 8)),
+                   "labels": np.array([0.0, 1.0, 0.0, 2.0])},
+           ref=lambda anchor, positive, labels: _npair_ref(anchor, positive, labels),
+           rtol=1e-4, atol=1e-5, grad=(), covers=("npair_loss",)),
+    OpSpec(name="nn.functional.margin_cross_entropy",
+           inputs={"logits": _arr((4, 5), lo=-0.9, hi=0.9),
+                   "label": np.array([0, 2, 4, 1])},
+           attrs={"margin1": 1.0, "margin2": 0.0, "margin3": 0.0, "scale": 2.0},
+           ref=lambda logits, label, margin1, margin2, margin3, scale:
+           _softmax_ce_ref(logits * scale, label),
+           rtol=1e-4, atol=1e-4, grad=(), covers=("margin_cross_entropy",)),
+    OpSpec(name="nn.functional.normalize", inputs={"x": _arr((3, 4))},
+           ref=lambda x: x / np.maximum(np.sqrt((x * x).sum(1, keepdims=True)), 1e-12),
+           grad=("x",), covers=("normalize",)),
+    OpSpec(name="nn.functional.label_smooth",
+           inputs={"label": (_arr((4, 5)) > 0).astype("float64")},
+           ref=lambda label: 0.9 * label + 0.1 / 5,
+           grad=(), covers=("label_smooth",)),
+    OpSpec(name="nn.functional.one_hot", inputs={"x": np.array([0, 2, 1])},
+           attrs={"num_classes": 4},
+           ref=lambda x, num_classes: np.eye(num_classes)[x],
+           grad=(), covers=("one_hot",)),
+    OpSpec(name="nn.functional.sequence_mask",
+           inputs={"lengths": np.array([1, 3, 2])}, attrs={"maxlen": 4},
+           ref=lambda lengths, maxlen: (np.arange(maxlen)[None, :]
+                                        < lengths[:, None]).astype("int64"),
+           out_cast=False, grad=(), covers=("sequence_mask",)),
+    OpSpec(name="nn.functional.temporal_shift",
+           inputs={"x": _arr((4, 4, 2, 2))},
+           attrs={"seg_num": 2, "shift_ratio": 0.25},
+           ref=lambda x, seg_num, shift_ratio: _temporal_shift_ref(x, seg_num, shift_ratio),
+           grad=("x",), covers=("temporal_shift",)),
+    # ---- nn spatial tail ----------------------------------------------------
+    OpSpec(name="nn.functional.channel_shuffle",
+           inputs={"x": _arr((2, 6, 3, 3))}, attrs={"groups": 3},
+           ref=lambda x, groups: x.reshape(2, groups, 2, 3, 3)
+           .transpose(0, 2, 1, 3, 4).reshape(2, 6, 3, 3),
+           grad=("x",), covers=("channel_shuffle",)),
+    OpSpec(name="nn.functional.fold",
+           inputs={"x": _arr((2, 4 * 4, 4))},
+           attrs={"output_sizes": (4, 4), "kernel_sizes": (2, 2),
+                  "strides": 2},
+           ref=lambda x, output_sizes, kernel_sizes, strides:
+           _fold_ref(x, output_sizes, kernel_sizes, strides),
+           grad=("x",), covers=("fold",)),
+    OpSpec(name="nn.functional.lp_pool2d",
+           inputs={"x": _arr((2, 3, 4, 4), lo=0.1, hi=1.0)},
+           attrs={"norm_type": 2, "kernel_size": 2},
+           ref=lambda x, norm_type, kernel_size: _lp_pool_ref(x, norm_type, kernel_size),
+           rtol=1e-4, atol=1e-5, grad=("x",), covers=("lp_pool2d",)),
+    OpSpec(name="nn.functional.affine_grid",
+           inputs={"theta": _arr((2, 2, 3))},
+           attrs={"out_shape": (2, 1, 3, 4)},
+           ref=lambda theta, out_shape: _affine_grid_ref(theta, out_shape),
+           rtol=1e-4, atol=1e-5, grad=("theta",), covers=("affine_grid",)),
+    # grid_sample checked against its own identity-warp property + ref
+    OpSpec(name="nn.functional.grid_sample",
+           inputs={"x": _arr((1, 2, 4, 4)),
+                   "grid": _identity_grid(1, 4, 4)},
+           ref=lambda x, grid: x,  # identity grid returns the input
+           rtol=1e-4, atol=1e-5, grad=("x",), covers=("grid_sample",)),
+    OpSpec(name="nn.functional.max_unpool2d",
+           inputs={"x": _arr((1, 1, 2, 2)),
+                   "indices": np.array([[[[0, 3], [8, 11]]]])},
+           attrs={"kernel_size": 2},
+           ref=lambda x, indices, kernel_size: _max_unpool_ref(x, indices, (4, 4)),
+           grad=(), covers=("max_unpool2d",)),
+    OpSpec(name="nn.functional.unfold",
+           inputs={"x": _arr((2, 3, 4, 4))},
+           attrs={"kernel_sizes": 2, "strides": 2},
+           ref=lambda x, kernel_sizes, strides: _unfold_ref(x, 2, 2),
+           grad=("x",), covers=("unfold",)),
+    # ---- schema tensor tail -------------------------------------------------
+    OpSpec(name="histogramdd", inputs={"x": _arr((20, 2))},
+           attrs={"bins": 4},
+           ref=lambda x, bins: (lambda h_e: [h_e[0]] + list(h_e[1]))(
+               np.histogramdd(x, bins=bins)),
+           grad=(), covers=("histogramdd",)),
+    OpSpec(name="renorm", inputs={"x": _arr((3, 4))},
+           attrs={"p": 2.0, "axis": 0, "max_norm": 1.0},
+           ref=lambda x, p, axis, max_norm: x * np.minimum(
+               1.0, max_norm / np.maximum(
+                   np.sqrt((x * x).sum(1)), 1e-12))[:, None],
+           grad=("x",), covers=("renorm",)),
+    OpSpec(name="reverse", inputs={"x": _arr((3, 4))}, attrs={"axis": 1},
+           ref=lambda x, axis: np.flip(x, axis), grad=("x",),
+           covers=("reverse",)),
+    OpSpec(name="increment", inputs={"x": _arr((1,))},
+           ref=lambda x: x + 1.0, grad=("x",), covers=("increment",)),
+    OpSpec(name="as_strided", inputs={"x": _arr((12,))},
+           attrs={"shape": (3, 2), "stride": (4, 1), "offset": 1},
+           ref=lambda x, shape, stride, offset: np.lib.stride_tricks.as_strided(
+               x[offset:], shape, (x.strides[0] * stride[0],
+                                   x.strides[0] * stride[1])).copy(),
+           grad=("x",), covers=("as_strided",)),
+    OpSpec(name="view_as", inputs={"x": _arr((2, 6)), "other": _arr((3, 4))},
+           ref=lambda x, other: x.reshape(3, 4), grad=("x",),
+           covers=("view_as",)),
+    OpSpec(name="vander", inputs={"x": _arr((4,))}, attrs={"n": 3},
+           ref=lambda x, n: np.vander(x, n), grad=("x",), covers=("vander",)),
+    OpSpec(name="quantile", inputs={"x": _arr((3, 8))},
+           attrs={"q": 0.25, "axis": 1},
+           ref=lambda x, q, axis: np.quantile(x, q, axis=axis),
+           grad=("x",), covers=("quantile",)),
+    OpSpec(name="nanquantile", inputs={"x": _with_nan(_arr((3, 8)))},
+           attrs={"q": 0.5, "axis": 1},
+           ref=lambda x, q, axis: np.nanquantile(x, q, axis=axis),
+           grad=(), covers=("nanquantile",)),
+    OpSpec(name="index_fill",
+           inputs={"x": _arr((3, 4)), "index": np.array([0, 2])},
+           attrs={"axis": 0, "fill_value": 9.0},
+           ref=lambda x, index, axis, fill_value: _index_fill_ref(x, index, axis, fill_value),
+           grad=("x",), covers=("index_fill",)),
+    OpSpec(name="fill_diagonal", inputs={"x": _arr((4, 4))},
+           attrs={"value": 7.0},
+           ref=lambda x, value: _fill_diag_ref(x, value),
+           grad=(), covers=("fill_diagonal",)),
+    # ---- special functions --------------------------------------------------
+    U("gammaln", lambda x: _sp.gammaln(x), x=_pos(lo=0.5)),
+    B("gammainc", lambda x, y: _sp.gammainc(x, y), x=_pos(lo=0.5),
+      y=_pos((4,), lo=0.2), grad=()),
+    B("gammaincc", lambda x, y: _sp.gammaincc(x, y), x=_pos(lo=0.5),
+      y=_pos((4,), lo=0.2), grad=()),
+    U("i0e", lambda x: _sp.i0e(x)),
+    U("i1e", lambda x: _sp.i1e(x)),
+    # ---- fft family (linear ops; value parity vs numpy) ---------------------
+    OpSpec(name="fft.fft", inputs={"x": _arr((8,))},
+           ref=lambda x: np.fft.fft(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("fft",)),
+    OpSpec(name="fft.ifft", inputs={"x": _arr((8,))},
+           ref=lambda x: np.fft.ifft(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("ifft",)),
+    OpSpec(name="fft.rfft", inputs={"x": _arr((8,))},
+           ref=lambda x: np.fft.rfft(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("rfft",)),
+    OpSpec(name="fft.irfft", inputs={"x": _arr((5,))},
+           ref=lambda x: np.fft.irfft(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("irfft",)),
+    OpSpec(name="fft.hfft", inputs={"x": _arr((5,))},
+           ref=lambda x: np.fft.hfft(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("hfft",)),
+    OpSpec(name="fft.ihfft", inputs={"x": _arr((8,))},
+           ref=lambda x: np.fft.ihfft(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("ihfft",)),
+    OpSpec(name="fft.fft2", inputs={"x": _arr((4, 4))},
+           ref=lambda x: np.fft.fft2(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("fft2",)),
+    OpSpec(name="fft.ifft2", inputs={"x": _arr((4, 4))},
+           ref=lambda x: np.fft.ifft2(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("ifft2",)),
+    OpSpec(name="fft.fftn", inputs={"x": _arr((2, 4, 4))},
+           ref=lambda x: np.fft.fftn(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("fftn",)),
+    OpSpec(name="fft.ifftn", inputs={"x": _arr((2, 4, 4))},
+           ref=lambda x: np.fft.ifftn(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("ifftn",)),
+    OpSpec(name="fft.rfft2", inputs={"x": _arr((4, 4))},
+           ref=lambda x: np.fft.rfft2(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("rfft2",)),
+    OpSpec(name="fft.irfft2", inputs={"x": _arr((4, 3))},
+           ref=lambda x: np.fft.irfft2(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("irfft2",)),
+    OpSpec(name="fft.rfftn", inputs={"x": _arr((2, 4, 4))},
+           ref=lambda x: np.fft.rfftn(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("rfftn",)),
+    OpSpec(name="fft.irfftn", inputs={"x": _arr((2, 4, 3))},
+           ref=lambda x: np.fft.irfftn(x), rtol=1e-4, atol=1e-4, grad=(),
+           covers=("irfftn",)),
+    OpSpec(name="fft.fftshift", inputs={"x": _arr((8,))},
+           ref=lambda x: np.fft.fftshift(x), grad=("x",), covers=("fftshift",)),
+    OpSpec(name="fft.ifftshift", inputs={"x": _arr((8,))},
+           ref=lambda x: np.fft.ifftshift(x), grad=("x",),
+           covers=("ifftshift",)),
+    OpSpec(name="fft.fftfreq", inputs={}, attrs={"n": 8, "d": 0.5},
+           ref=lambda n, d: np.fft.fftfreq(n, d), grad=(),
+           covers=("fftfreq",)),
+    OpSpec(name="fft.rfftfreq", inputs={}, attrs={"n": 8, "d": 0.5},
+           ref=lambda n, d: np.fft.rfftfreq(n, d), grad=(),
+           covers=("rfftfreq",)),
+    # ---- signal -------------------------------------------------------------
+    OpSpec(name="signal.frame", inputs={"x": _arr((16,))},
+           attrs={"frame_length": 4, "hop_length": 2},
+           ref=lambda x, frame_length, hop_length: np.stack(
+               [x[i * 2:i * 2 + 4] for i in range(7)], -1),
+           grad=("x",), covers=("frame",)),
+    OpSpec(name="signal.overlap_add",
+           inputs={"x": _arr((4, 7))}, attrs={"hop_length": 2},
+           ref=lambda x, hop_length: _overlap_add_ref(x, hop_length),
+           grad=("x",), covers=("overlap_add",)),
+    # ---- creation -----------------------------------------------------------
+    OpSpec(name="arange", inputs={}, attrs={"start": 1.0, "end": 5.0, "step": 0.5},
+           ref=lambda start, end, step: np.arange(start, end, step), grad=(),
+           covers=("arange",)),
+    OpSpec(name="linspace", inputs={}, attrs={"start": 0.0, "stop": 1.0, "num": 7},
+           ref=lambda start, stop, num: np.linspace(start, stop, num), grad=(),
+           covers=("linspace",)),
+    OpSpec(name="logspace", inputs={}, attrs={"start": 0.0, "stop": 2.0, "num": 5},
+           ref=lambda start, stop, num: np.logspace(start, stop, num), grad=(),
+           rtol=1e-4, atol=1e-4, covers=("logspace",)),
+    OpSpec(name="eye", inputs={}, attrs={"num_rows": 3, "num_columns": 4},
+           ref=lambda num_rows, num_columns: np.eye(num_rows, num_columns),
+           grad=(), covers=("eye",)),
+    OpSpec(name="ones", inputs={}, attrs={"shape": (2, 3)},
+           ref=lambda shape: np.ones(shape), grad=(), covers=("ones",)),
+    OpSpec(name="zeros", inputs={}, attrs={"shape": (2, 3)},
+           ref=lambda shape: np.zeros(shape), grad=(), covers=("zeros",)),
+    OpSpec(name="full", inputs={}, attrs={"shape": (2, 3), "fill_value": 2.5},
+           ref=lambda shape, fill_value: np.full(shape, fill_value), grad=(),
+           covers=("full",)),
+    OpSpec(name="ones_like", inputs={"x": _arr((2, 3))},
+           ref=lambda x: np.ones_like(x), grad=(), covers=("ones_like",)),
+    OpSpec(name="zeros_like", inputs={"x": _arr((2, 3))},
+           ref=lambda x: np.zeros_like(x), grad=(), covers=("zeros_like",)),
+    OpSpec(name="full_like", inputs={"x": _arr((2, 3))},
+           attrs={"fill_value": 3.5},
+           ref=lambda x, fill_value: np.full_like(x, fill_value), grad=(),
+           covers=("full_like",)),
+    OpSpec(name="empty", inputs={}, attrs={"shape": (2, 3)},
+           ref=lambda shape: np.zeros(shape), grad=(), covers=("empty",)),
+    OpSpec(name="empty_like", inputs={"x": _arr((2, 3))},
+           ref=lambda x: np.zeros_like(x), grad=(), covers=("empty_like",)),
+    OpSpec(name="tril_indices", inputs={}, attrs={"row": 4, "col": 4},
+           ref=lambda row, col: np.stack(np.tril_indices(row, 0, col)),
+           out_cast=False, grad=(), covers=("tril_indices",)),
+    OpSpec(name="triu_indices", inputs={}, attrs={"row": 4, "col": 4},
+           ref=lambda row, col: np.stack(np.triu_indices(row, 0, col)),
+           out_cast=False, grad=(), covers=("triu_indices",)),
+    OpSpec(name="complex", inputs={"real": _arr((3,)), "imag": _arr((3,))},
+           ref=lambda real, imag: real + 1j * imag, grad=(),
+           covers=("complex",)),
+    OpSpec(name="polar", inputs={"abs": _pos((3,)), "angle": _arr((3,))},
+           ref=lambda abs, angle: abs * np.cos(angle) + 1j * abs * np.sin(angle),
+           rtol=1e-4, atol=1e-5, grad=(), covers=("polar",)),
+    OpSpec(name="assign", inputs={"x": _arr((3,))}, ref=lambda x: x,
+           grad=(), covers=("assign",)),
+    OpSpec(name="numel", inputs={"x": _arr((3, 4))},
+           ref=lambda x: np.array(12), out_cast=False, grad=(),
+           covers=("numel",)),
+    OpSpec(name="broadcast_tensors",
+           inputs={"inputs": [_arr((1, 4)), _arr((3, 1))]},
+           ref=lambda inputs: list(np.broadcast_arrays(*inputs)), grad=(),
+           covers=("broadcast_tensors",)),
+    # ---- indexing tail ------------------------------------------------------
+    OpSpec(name="index_add",
+           inputs={"x": _arr((4, 3)), "index": np.array([0, 2]),
+                   "value": _arr((2, 3))},
+           attrs={"axis": 0},
+           ref=lambda x, index, value, axis: _index_add_ref(x, index, value),
+           grad=("x",), covers=("index_add",)),
+    OpSpec(name="index_put",
+           inputs={"x": _arr((4, 3)),
+                   "indices": (np.array([0, 2]), np.array([1, 2])),
+                   "value": _arr((2,))},
+           ref=lambda x, indices, value: _index_put_ref(x, indices, value),
+           grad=("x",), covers=("index_put",)),
+    OpSpec(name="put_along_axis",
+           inputs={"x": _arr((3, 4)), "indices": np.array([[0], [1], [2]]),
+                   "values": _arr((3, 1))},
+           attrs={"axis": 1},
+           ref=lambda x, indices, values, axis: _put_along_ref(x, indices, values, axis),
+           grad=(), covers=("put_along_axis",)),
+    OpSpec(name="scatter",
+           inputs={"x": _arr((4, 3)), "index": np.array([1, 3]),
+                   "updates": _arr((2, 3))},
+           ref=lambda x, index, updates: _scatter_ref(x, index, updates),
+           grad=("x",), covers=("scatter",)),
+    OpSpec(name="scatter_nd",
+           inputs={"index": np.array([[1], [3]]), "updates": _arr((2, 3))},
+           attrs={"shape": (5, 3)},
+           ref=lambda index, updates, shape: _scatter_nd_ref(index, updates, shape),
+           grad=(), covers=("scatter_nd",)),
+    OpSpec(name="shard_index", inputs={"input": np.array([[1], [6], [11]])},
+           attrs={"index_num": 20, "nshards": 2, "shard_id": 0},
+           ref=lambda input, index_num, nshards, shard_id: np.where(
+               (input // (index_num // nshards)) == shard_id,
+               input % (index_num // nshards), -1),
+           out_cast=False, grad=(), covers=("shard_index",)),
+]
+
+SPECS.extend(_flatten_specs(_SCHEMA_SPECS))
+
+
 _IDS = [f"{i}_{s.name.replace('.', '_')}" for i, s in enumerate(SPECS)]
 
 
 @pytest.mark.parametrize("spec", SPECS, ids=_IDS)
 def test_op(spec):
     run_spec(spec)
+
+
+def test_tensor_unfold_direct():
+    x = _arr((8,)).astype("float32")
+    out = paddle.to_tensor(x).unfold(0, 4, 2).numpy()
+    np.testing.assert_allclose(out, np.stack([x[0:4], x[2:6], x[4:8]]))
+
+
+def test_meshgrid_direct():
+    a = _arr((3,)).astype("float32")
+    b = _arr((4,)).astype("float32")
+    ga, gb = paddle.meshgrid(paddle.to_tensor(a), paddle.to_tensor(b))
+    ra, rb = np.meshgrid(a, b, indexing="ij")
+    np.testing.assert_allclose(ga.numpy(), ra)
+    np.testing.assert_allclose(gb.numpy(), rb)
 
 
 def test_einsum_and_atleast():
@@ -603,21 +1243,51 @@ def test_einsum_and_atleast():
 WHITELIST = {
     # positional-vararg signature; dedicated test_einsum_and_atleast
     "einsum": "vararg signature; test_einsum_and_atleast",
+    "unfold_window": "Tensor.unfold method surface; test_tensor_unfold_direct",
+    "meshgrid": "vararg signature; test_meshgrid_direct",
 }
 
 
+def _tested_by_exists(ref: str) -> bool:
+    """Verify a schema declaration's tested_by pointer ("tests/x.py::fn")
+    names a real test function — a declaration cannot point at nothing."""
+    import os
+
+    path, _, fn = ref.partition("::")
+    full = os.path.join(os.path.dirname(os.path.dirname(__file__)), path)
+    if not (fn and os.path.exists(full)):
+        return False
+    with open(full) as f:
+        return f"def {fn}(" in f.read()
+
+
 def test_registry_swept():
-    """Every registered op is covered by a spec (by name or `covers`) or
-    whitelisted with a reason."""
+    """Every registered op is covered by a spec (by name or `covers`),
+    whitelisted with a reason, or schema-declared with a VERIFIED
+    tested_by pointer (ops/schema.py Retrofit.tested_by)."""
     from paddle_tpu.ops.registry import OPS
+    from paddle_tpu.ops.schema import validate_retrofits
+
+    validate_retrofits()  # every declaration's public path must resolve
 
     covered = set()
     for s in SPECS:
         covered.add(s.name.split(".")[-1])
         covered.update(s.covers)
-    missing = [n for n in sorted(OPS)
-               if n not in covered and n not in WHITELIST
-               and not n.rstrip("_") in covered]
+    missing, bad_refs = [], []
+    for n in sorted(OPS):
+        if n in covered or n in WHITELIST or n.rstrip("_") in covered:
+            continue
+        decl = OPS[n].decl
+        ref = getattr(decl, "tested_by", "") if decl is not None else ""
+        if ref:
+            if _tested_by_exists(ref):
+                continue
+            bad_refs.append(f"{n} -> {ref}")
+            continue
+        missing.append(n)
+    assert not bad_refs, (
+        f"schema tested_by references point at nonexistent tests: {bad_refs}")
     assert not missing, (
-        f"{len(missing)} registered ops lack an OpSpec or whitelist entry: "
-        f"{missing}")
+        f"{len(missing)} registered ops lack an OpSpec, whitelist entry, or "
+        f"schema tested_by: {missing}")

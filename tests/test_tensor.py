@@ -138,3 +138,34 @@ def test_random_seed_reproducible():
     paddle.seed(42)
     b = paddle.randn([4])
     np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_tensor_array():
+    """TensorArray surface parity (python/paddle/tensor/array.py;
+    phi/core/tensor_array.h)."""
+    arr = paddle.create_array()
+    t0 = paddle.to_tensor([1.0, 2.0])
+    t1 = paddle.to_tensor([3.0, 4.0])
+    paddle.array_write(t0, 0, arr)
+    paddle.array_write(t1, paddle.to_tensor(1), arr)
+    assert paddle.array_length(arr) == 2
+    got = paddle.array_read(arr, paddle.to_tensor(0))
+    np.testing.assert_array_equal(got.numpy(), t0.numpy())
+    # overwrite in place
+    paddle.array_write(t1, 0, arr)
+    np.testing.assert_array_equal(paddle.array_read(arr, 0).numpy(), t1.numpy())
+    # init list + type checks
+    arr2 = paddle.create_array(initialized_list=[t0, t1])
+    assert paddle.array_length(arr2) == 2
+    with pytest.raises(TypeError):
+        paddle.create_array(initialized_list=[1.5])
+    with pytest.raises(IndexError):
+        paddle.array_read(arr2, 5)
+    with pytest.raises(IndexError):
+        paddle.array_write(t0, 7, arr2)
+    # grads flow through reads
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a3 = paddle.create_array()
+    paddle.array_write(x * 3, 0, a3)
+    paddle.array_read(a3, 0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3.0)
