@@ -91,6 +91,49 @@ def probe():
                       "init_s": round(t_init, 1), "tiny_s": round(t_compile, 1)}))
 
 
+def decode_bench(devs, gen):
+    """BENCH_CONFIG=decode: serving throughput — static-KV greedy decode
+    tokens/s/chip (the block_multi_head_attention serving configuration)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = devs[0].platform == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=8,
+            max_position_embeddings=1024, use_flash_attention=False,
+            dtype="bfloat16")
+        batch, prompt, new = 8, 128, 128
+    else:
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        batch, prompt, new = 2, 16, 16
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (batch, prompt)))
+    # warm with the SAME max_new_tokens: the decode step jit is keyed on
+    # max_len, so a shorter warm-up would leave the timed run compiling
+    model.generate(ids, max_new_tokens=new)
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=new)
+    dt = time.perf_counter() - t0
+    tokens_per_sec = batch * out.shape[1] / dt
+    rec = {
+        "metric": "llama_decode_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # no reference decode number exists
+        "platform": devs[0].platform,
+        "config": "decode",
+        "tpu_gen": gen,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    print(json.dumps(rec))
+
+
 def main():
     import jax
 
@@ -108,6 +151,8 @@ def main():
     peak = _PEAK_TFLOPS.get(gen, 197.0) * 1e12
 
     cfg_name = os.environ.get("BENCH_CONFIG", "1b")
+    if cfg_name == "decode":
+        return decode_bench(devs, gen)
     cfg, seq, batch = _bench_config(cfg_name, on_tpu)
 
     paddle.seed(0)

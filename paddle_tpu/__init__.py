@@ -184,6 +184,8 @@ _LAZY_SUBMODULES = (
     "quantization",
     "autograd",
     "distribution",
+    "generation",
+    "inference",
     "linalg",
     "fft",
     "signal",

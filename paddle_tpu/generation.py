@@ -1,0 +1,486 @@
+"""Text generation: static KV-cache decode, sampling, paged attention.
+
+Reference parity: the serving slice the reference builds from
+- block_multi_head_attention (paged KV cache decode kernel,
+  paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu),
+- top_p_sampling (paddle/phi/kernels/gpu/top_p_sampling_kernel.cu /
+  python/paddle/tensor/random.py top_p_sampling),
+- PaddleNLP's GenerationMixin greedy/sampling loops.
+
+TPU-native design: the KV cache is a STATIC-shape buffer per layer —
+dense [B, max_len, kv_heads, head_dim] or paged (block tables) — updated
+with dynamic_update_slice/scatter, and the whole decode step (embed →
+layers → lm head → cache update) is ONE jitted computation with the cache
+buffers donated, so each generated token is a single device dispatch and
+the buffers are updated in place. The paged layout matches JAX's bundled
+Pallas paged_attention kernel, which is used on TPU (jnp gather reference
+elsewhere).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .tensor_class import Tensor, unwrap, wrap
+from .ops.registry import apply
+from .autograd import tape as _tape
+from .framework import random as _random
+
+
+# ---------------------------------------------------------------------------
+# cache attention kernels (dense + paged)
+# ---------------------------------------------------------------------------
+
+def _rope_rows(x, cos, sin, row_pos):
+    """RoPE with PER-ROW positions: x [B,S,H,D], row_pos [B] — row b's
+    token s sits at absolute position row_pos[b]+s (ragged decode)."""
+    from .ops.pallas.fused_norm import rope_ref
+
+    S = x.shape[1]
+    idx = row_pos[:, None] + jnp.arange(S)[None, :]        # [B, S]
+    cos_b = cos[idx]                                       # [B, S, D]
+    sin_b = sin[idx]
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    c = cos_b[:, :, None, :]
+    s = sin_b[:, :, None, :]
+    return (x.astype(jnp.float32) * c + rotated.astype(jnp.float32) * s
+            ).astype(x.dtype)
+
+
+def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
+                     row_pos=None):
+    """RoPE + cache write + masked GQA attention against a dense buffer.
+
+    q [B,S,H,D]; k/v [B,S,hk,D]; cos/sin [>=max_len, D];
+    k_buf/v_buf [B,Smax,hk,D]; pos = buffer write offset (scalar);
+    allowed = optional [B,Tmax] column-validity mask (padded prompts);
+    row_pos = optional [B] per-row RoPE positions (ragged batches).
+    Returns (out [B,S,H,D], new_k_buf, new_v_buf).
+    """
+    from .ops.pallas.fused_norm import rope_ref
+
+    B, S, H, D = q.shape
+    hk = k_buf.shape[2]
+    pos = jnp.asarray(pos, jnp.int32)
+    if row_pos is None:
+        cos_s = jax.lax.dynamic_slice_in_dim(cos, pos, S, 0)
+        sin_s = jax.lax.dynamic_slice_in_dim(sin, pos, S, 0)
+        q = rope_ref(q, cos_s, sin_s)
+        k = rope_ref(k, cos_s, sin_s)
+    else:
+        q = _rope_rows(q, cos, sin, row_pos)
+        k = _rope_rows(k, cos, sin, row_pos)
+    k_buf = jax.lax.dynamic_update_slice(
+        k_buf, k.astype(k_buf.dtype), (0, pos, 0, 0))
+    v_buf = jax.lax.dynamic_update_slice(
+        v_buf, v.astype(v_buf.dtype), (0, pos, 0, 0))
+
+    g = H // hk
+    scale = 1.0 / math.sqrt(D)
+    qg = q.reshape(B, S, hk, g, D)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg.astype(jnp.float32),
+                        k_buf.astype(jnp.float32)) * scale
+    t_idx = jnp.arange(k_buf.shape[1])
+    s_idx = jnp.arange(S)
+    valid = t_idx[None, :] <= (pos + s_idx)[:, None]        # [S, T]
+    mask = valid[None, None, None]                          # [1,1,1,S,T]
+    if allowed is not None:
+        mask = mask & allowed[:, None, None, None, :]       # [B,1,1,S,T]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs,
+                     v_buf.astype(jnp.float32))
+    return out.reshape(B, S, H, D).astype(q.dtype), k_buf, v_buf
+
+
+def paged_cached_attention(q, k, v, cos, sin, k_pages, v_pages, page_indices,
+                           lengths, pos, page_size):
+    """Single-token decode over the PAGED cache (in-layer dispatch).
+
+    q [B,1,H,D]; pages [hk, n_pages, page_size, D]; lengths [B] = tokens
+    already present. Writes the new token at buffer position ``pos`` and
+    attends through the device-appropriate paged kernel.
+    """
+    from .ops.pallas.fused_norm import rope_ref
+
+    B = q.shape[0]
+    pos = jnp.asarray(pos, jnp.int32)
+    cos_s = jax.lax.dynamic_slice_in_dim(cos, pos, 1, 0)
+    sin_s = jax.lax.dynamic_slice_in_dim(sin, pos, 1, 0)
+    q = rope_ref(q, cos_s, sin_s)
+    k = rope_ref(k, cos_s, sin_s)
+    page = pos // page_size
+    slot = pos % page_size
+    rows = page_indices[:, page]
+    k_pages = k_pages.at[:, rows, slot].set(
+        jnp.moveaxis(k[:, 0], 0, 1).astype(k_pages.dtype))
+    v_pages = v_pages.at[:, rows, slot].set(
+        jnp.moveaxis(v[:, 0], 0, 1).astype(v_pages.dtype))
+    out = paged_decode_attention(q[:, 0], k_pages, v_pages, lengths + 1,
+                                 page_indices)
+    return out[:, None], k_pages, v_pages
+
+
+def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
+                           pages_per_compute_block=1):
+    """Decode attention over a paged cache: JAX's bundled Pallas kernel on
+    TPU, a jnp gather reference (identical semantics) elsewhere."""
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        on_tpu = False
+    if on_tpu:
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention as pa)
+
+        return pa.paged_attention(
+            q, k_pages, v_pages, lengths, page_indices,
+            pages_per_compute_block=pages_per_compute_block)
+    return _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices)
+
+
+def _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices):
+    B, H, D = q.shape
+    hk, _n, page_size, _ = k_pages.shape
+    g = H // hk
+    k = jnp.moveaxis(k_pages[:, page_indices], 0, 1)  # [B, hk, pages, ps, D]
+    v = jnp.moveaxis(v_pages[:, page_indices], 0, 1)
+    T = k.shape[2] * page_size
+    k = k.reshape(B, hk, T, D)
+    v = v.reshape(B, hk, T, D)
+    qg = q.reshape(B, hk, g, D).astype(jnp.float32)
+    scores = jnp.einsum("bkgd,bktd->bkgt", qg, k.astype(jnp.float32))
+    scores = scores / math.sqrt(D)
+    valid = jnp.arange(T)[None, :] < lengths[:, None]
+    scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgt,bktd->bkgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+def _top_k_filter(logits, k):
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _top_p_filter(logits, p):
+    if p >= 1.0:
+        return logits
+    srt = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(srt, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens until cumulative prob exceeds p (always keep the first)
+    keep = jnp.concatenate(
+        [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < p], -1)
+    min_logit = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits < min_logit, -jnp.inf, logits)
+
+
+def sample_logits(logits, key, do_sample=False, temperature=1.0,
+                  top_k=0, top_p=1.0):
+    """Next-token selection from [B, V] logits (pure)."""
+    logits = logits.astype(jnp.float32)
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    if temperature != 1.0:
+        logits = logits / jnp.maximum(temperature, 1e-6)
+    logits = _top_k_filter(logits, int(top_k))
+    logits = _top_p_filter(logits, float(top_p))
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None):
+    """paddle.tensor.top_p_sampling parity (ops.yaml `top_p_sampling`):
+    nucleus-sample one token per row of probabilities ``x`` [B, V] with
+    per-row cutoffs ``ps`` [B]. Returns (scores, ids)."""
+    key = (jax.random.key(seed) if seed is not None and seed >= 0
+           else _random.next_key())
+
+    def fn(probs, p):
+        logits = jnp.log(jnp.maximum(probs, 1e-38))
+        srt = jnp.sort(probs, axis=-1)[..., ::-1]
+        cum = jnp.cumsum(srt, axis=-1)
+        keep = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1], bool),
+             cum[..., :-1] < p[..., None]], -1)
+        min_prob = jnp.min(jnp.where(keep, srt, jnp.inf), -1, keepdims=True)
+        filtered = jnp.where(probs < min_prob, -jnp.inf, logits)
+        ids = jax.random.categorical(key, filtered, axis=-1)
+        score = jnp.take_along_axis(probs, ids[..., None], -1)[..., 0]
+        return score, ids
+
+    return apply("top_p_sampling", fn, x, ps, differentiable=False)
+
+
+@functools.partial(jax.jit, static_argnames=("do_sample", "temperature",
+                                             "top_k", "top_p"))
+def _select(logits_last, key, do_sample, temperature, top_k, top_p):
+    return sample_logits(logits_last, key, do_sample=do_sample,
+                         temperature=temperature, top_k=top_k, top_p=top_p)
+
+
+# ---------------------------------------------------------------------------
+# decode step machinery
+# ---------------------------------------------------------------------------
+
+def _empty_caches(model, batch, max_len, allowed=None, row_pos=None):
+    cfg = model.config
+    hk = cfg.num_key_value_heads
+    d = cfg.hidden_size // cfg.num_attention_heads
+    dt = jnp.dtype(cfg.dtype) if isinstance(cfg.dtype, str) else cfg.dtype
+    caches = []
+    for _ in range(cfg.num_hidden_layers):
+        c = {"k": jnp.zeros((batch, max_len, hk, d), dt),
+             "v": jnp.zeros((batch, max_len, hk, d), dt),
+             "pos": jnp.zeros((), jnp.int32)}
+        if allowed is not None:
+            c["allowed"] = allowed
+        if row_pos is not None:
+            c["row_pos"] = row_pos
+        caches.append(c)
+    return caches
+
+
+def _unwrap_caches(caches):
+    return jax.tree_util.tree_map(
+        lambda x: x._array if isinstance(x, Tensor) else x, caches,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+_BUF_KEYS = ("k", "v", "k_pages", "v_pages")
+
+
+def _split_caches(caches):
+    """Separate the big per-layer KV buffers (donatable — each layer owns
+    its own) from the small shared aux values (page tables / masks /
+    positions shared across layers must NOT be donated twice)."""
+    bufs = [{k: c[k] for k in _BUF_KEYS if k in c} for c in caches]
+    aux = [{k: v for k, v in c.items() if k not in _BUF_KEYS}
+           for c in caches]
+    return bufs, aux
+
+
+class _DecodeStep:
+    """ONE jitted computation per generated token: embed → all layers with
+    in-place (donated) cache buffers → lm-head logits. The TrainStep
+    pattern applied to decode (jit/__init__.py TrainStep)."""
+
+    def __init__(self, model, max_len):
+        self._model = model
+
+        def pure(state, token, bufs, aux):
+            own = model.state_dict()
+            snapshot = {k: t._array for k, t in own.items()}
+            model.load_functional_state(state)
+            caches = [{**b, **a} for b, a in zip(bufs, aux)]
+            try:
+                with _tape.no_grad():
+                    hidden, new_caches = model.llama.forward_cached(
+                        wrap(token), caches, rope_len=max_len)
+                    logits = model.lm_head_logits(hidden)
+                nb, na = _split_caches(_unwrap_caches(new_caches))
+                return unwrap(logits), nb, na
+            finally:
+                for k2, t in own.items():
+                    t._array = snapshot[k2]
+
+        self._jitted = jax.jit(pure, donate_argnums=(2,))
+        self._state = {k: v for k, v in model.functional_state().items()}
+
+    def __call__(self, token, caches):
+        bufs, aux = _split_caches(caches)
+        logits, nb, na = self._jitted(self._state, token, bufs, aux)
+        return logits, [{**b, **a} for b, a in zip(nb, na)]
+
+
+def _get_decode_step(model, max_len):
+    """Memoized per (model, max_len): jax.jit's compile cache is keyed on
+    the function object, so a fresh _DecodeStep per generate() call would
+    recompile every request (review finding). Weights are re-read from the
+    model at each generate() via the memoized step's refresh below."""
+    cache = model.__dict__.get("_decode_steps")
+    if cache is None:
+        cache = {}
+        object.__setattr__(model, "_decode_steps", cache)
+    step = cache.get(max_len)
+    if step is None:
+        step = _DecodeStep(model, max_len)
+        cache[max_len] = step
+    else:
+        # pick up any weight updates since the step was built
+        step._state = {k: v for k, v in model.functional_state().items()}
+    return step
+
+
+# ---------------------------------------------------------------------------
+# generate
+# ---------------------------------------------------------------------------
+
+def generate(model, input_ids, max_new_tokens=20, do_sample=False,
+             temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+             use_cache=True, attention_mask=None, paged=False,
+             page_size=16):
+    """Batched autoregressive decode.
+
+    ``attention_mask`` [B, S0] (1 = real token, right padding) makes
+    ragged batches correct: pad columns are never attended, RoPE positions
+    continue per row from each row's true length, and the first sampled
+    token reads each row's last real logit.
+
+    Returns generated ids [B, <=max_new_tokens] (prompt excluded); stops
+    early only when EVERY row has emitted eos.
+    """
+    ids = unwrap(input_ids) if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    B, S0 = ids.shape
+    cfg = model.config
+    if max_new_tokens <= 0:
+        return wrap(jnp.zeros((B, 0), ids.dtype))
+    max_len = S0 + max_new_tokens
+    if paged:
+        max_len = -(-max_len // page_size) * page_size
+    if max_len > cfg.max_position_embeddings:
+        raise ValueError(
+            f"generate: prompt+new tokens {max_len} exceeds "
+            f"max_position_embeddings {cfg.max_position_embeddings}")
+
+    pad_mask = None
+    lengths = jnp.full((B,), S0, jnp.int32)
+    if attention_mask is not None:
+        if paged:
+            raise NotImplementedError(
+                "generate(paged=True) does not support ragged batches yet: "
+                "paged decode writes at a single buffer slot per step, so "
+                "per-row lengths would attend stale pad slots. Use the "
+                "dense cache (paged=False) for padded prompts.")
+        if not use_cache:
+            raise NotImplementedError(
+                "generate(use_cache=False) ignores attention_mask; use the "
+                "cached path for padded prompts")
+        am = unwrap(attention_mask) if isinstance(attention_mask, Tensor) \
+            else jnp.asarray(attention_mask)
+        lengths = am.astype(jnp.int32).sum(1)
+        pad_mask = jnp.concatenate(
+            [am.astype(bool),
+             jnp.ones((B, max_len - S0), bool)], axis=1)
+
+    with _tape.no_grad():
+        if not use_cache:
+            return _generate_no_cache(model, ids, max_new_tokens, do_sample,
+                                      temperature, top_k, top_p, eos_token_id)
+
+        # ---- prefill ----
+        caches = _empty_caches(model, B, max_len, allowed=pad_mask)
+        hidden, caches = model.llama.forward_cached(
+            wrap(ids), caches, rope_len=max_len)
+        # gather each row's last REAL hidden state BEFORE the lm head so the
+        # vocab projection runs on [B,1,H], not [B,S0,H] (S0× less HBM)
+        h_last = jnp.take_along_axis(
+            unwrap(hidden), (lengths - 1)[:, None, None].astype(jnp.int32),
+            axis=1)
+        last = unwrap(model.lm_head_logits(wrap(h_last)))[:, 0, :]
+        caches = _unwrap_caches(caches)
+
+        if paged:
+            caches = _caches_to_paged(caches, page_size, lengths, pad_mask)
+
+        # per-row RoPE positions for the generated tokens (ragged batches
+        # continue at each row's true length)
+        if pad_mask is not None and not paged:
+            for c in caches:
+                c["row_pos"] = lengths
+
+        step = _get_decode_step(model, max_len)
+        finished = jnp.zeros((B,), bool)
+        out_tokens = []
+        for i in range(max_new_tokens):
+            key = _random.next_key()
+            nxt = _select(last, key, do_sample, float(temperature),
+                          int(top_k), float(top_p))
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            out_tokens.append(nxt.reshape(B, 1).astype(ids.dtype))
+            if i == max_new_tokens - 1 or (
+                    eos_token_id is not None and bool(finished.all())):
+                break
+            logits, caches = step(out_tokens[-1], caches)
+            last = logits[:, -1, :]
+        return wrap(jnp.concatenate(out_tokens, axis=1))
+
+
+def _generate_no_cache(model, ids, max_new_tokens, do_sample, temperature,
+                       top_k, top_p, eos_token_id):
+    B = ids.shape[0]
+    finished = jnp.zeros((B,), bool)
+    out_tokens = []
+    full = ids
+    for _ in range(max_new_tokens):
+        hidden = model.llama(wrap(full))
+        last = unwrap(model.lm_head_logits(hidden))[:, -1, :]
+        key = _random.next_key()
+        nxt = _select(last, key, do_sample, float(temperature),
+                      int(top_k), float(top_p))
+        if eos_token_id is not None:
+            nxt = jnp.where(finished, eos_token_id, nxt)
+            finished = finished | (nxt == eos_token_id)
+        out_tokens.append(nxt.reshape(B, 1).astype(ids.dtype))
+        full = jnp.concatenate([full, out_tokens[-1]], axis=1)
+        if eos_token_id is not None and bool(finished.all()):
+            break
+    return wrap(jnp.concatenate(out_tokens, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# paged cache construction
+# ---------------------------------------------------------------------------
+
+def _caches_to_paged(caches, page_size, lengths, pad_mask):
+    """Re-lay dense prefilled buffers [B, max_len, hk, D] into paged dicts
+    (contiguous page tables; an allocator would virtualize page_indices)."""
+    k0 = caches[0]["k"]
+    B, max_len, hk, D = k0.shape
+    pages_per_seq = max_len // page_size
+
+    def to_pages(buf):
+        p = buf.reshape(B, pages_per_seq, page_size, hk, D)
+        return jnp.moveaxis(p, 3, 0).reshape(hk, B * pages_per_seq,
+                                             page_size, D)
+
+    page_indices = jnp.arange(B * pages_per_seq, dtype=jnp.int32).reshape(
+        B, pages_per_seq)
+    out = []
+    for c in caches:
+        out.append({
+            "k_pages": to_pages(c["k"]),
+            "v_pages": to_pages(c["v"]),
+            "page_indices": page_indices,
+            # lengths counts valid tokens; with right padding the pad
+            # columns hold garbage but paged_decode_attention masks by
+            # position < length, so ragged support requires no pad columns
+            # inside [0, length) — true for right padding only when the
+            # batch is uniform; ragged paged decode uses uniform S0 here
+            "lengths": lengths,
+            "pos": c["pos"],
+            "page_size": page_size,
+        })
+    return out
+
+
+def generate_paged(model, input_ids, max_new_tokens=20, page_size=16,
+                   **kwargs):
+    """Paged-KV decode (block_multi_head_attention serving configuration):
+    generate() with the paged cache layout."""
+    return generate(model, input_ids, max_new_tokens=max_new_tokens,
+                    paged=True, page_size=page_size, **kwargs)

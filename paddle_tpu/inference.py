@@ -1,0 +1,134 @@
+"""paddle.inference parity: Config + create_predictor.
+
+Reference parity: paddle/fluid/inference/api/analysis_predictor.h —
+``paddle_infer.Config(prog, params)`` + ``create_predictor`` + the
+``Run``/``ZeroCopyRun`` tensor-feeding surface. The reference's IR analysis
+passes collapse into XLA compilation here (SURVEY §7: AnalysisPredictor →
+jit + AOT export); what remains is the user-facing predictor object.
+
+Two predictor kinds:
+- static predictor: a ``jax.export``-serialized StableHLO computation
+  (produced by ``paddle_tpu.static.save_inference_model`` or
+  ``paddle_tpu.jit.save``) — fixed signature, fastest path.
+- generation predictor: weights loaded back into a causal-LM module with
+  the static-KV-cache / paged decode loop (paddle_tpu.generation), the
+  serving configuration of the reference's block_multi_head_attention.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class Config:
+    """paddle.inference.Config subset (analysis_predictor.h config)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._model_dir = None
+        if prog_file is not None and params_file is None and os.path.isdir(prog_file):
+            self._model_dir = prog_file
+        self._memory_optim = True
+        self._extra = {}
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self.prog_file = prog_file
+        self.params_file = params_file
+
+    def model_dir(self):
+        return self._model_dir
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    # accepted-for-compat GPU/IR switches (meaningless under XLA — loud)
+    def enable_use_gpu(self, *a, **k):
+        import warnings
+
+        warnings.warn("inference.Config.enable_use_gpu has no effect on the "
+                      "TPU backend (device placement is jax-managed)")
+
+    def switch_ir_optim(self, flag: bool = True):
+        pass  # XLA always optimizes; kept for API parity
+
+
+class Predictor:
+    """Static predictor over an exported StableHLO computation
+    (the AnalysisPredictor::Run surface)."""
+
+    def __init__(self, loaded, feed_names, num_fetch):
+        self._pred = loaded
+        self._feed_names = list(feed_names)
+        self._num_fetch = num_fetch
+        self._inputs = {}
+
+    # paddle_infer handle-style surface
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_input_handle(self, name):
+        return _IOHandle(self._inputs, name)
+
+    def run(self, feeds: Optional[Sequence[np.ndarray]] = None):
+        if feeds is None:
+            feeds = [self._inputs[n] for n in self._feed_names]
+        return self._pred.run([np.asarray(f) for f in feeds])
+
+
+class _IOHandle:
+    def __init__(self, store, name):
+        self._store = store
+        self._name = name
+
+    def copy_from_cpu(self, arr):
+        self._store[self._name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes are taken from the fed array
+
+
+def create_predictor(config: Config) -> Predictor:
+    """paddle_infer.create_predictor parity: load the exported computation
+    named by ``config.prog_file`` (path prefix without extension)."""
+    from .static import load_inference_model
+
+    prefix = config.prog_file
+    if prefix is None:
+        raise ValueError("Config.prog_file (path prefix) is required")
+    if prefix.endswith(".stablehlo") or prefix.endswith(".pdmodel"):
+        prefix = prefix.rsplit(".", 1)[0]
+    pred, feed_names, num_fetch = load_inference_model(prefix)
+    return Predictor(pred, feed_names, num_fetch)
+
+
+class GenerationPredictor:
+    """Serving predictor for causal-LM decode: loads ``jit.save``d weights
+    (.pdiparams) back into a model and decodes with the static-KV or paged
+    cache (paddle_tpu.generation)."""
+
+    def __init__(self, path_prefix: str, model):
+        with open(path_prefix + ".pdiparams", "rb") as f:
+            state = pickle.load(f)
+        import jax.numpy as jnp
+
+        own = model.functional_state()
+        missing = set(own) - set(state)
+        if missing:
+            raise ValueError(f"checkpoint missing parameters: {sorted(missing)[:5]}")
+        model.load_functional_state(
+            {k: jnp.asarray(v) for k, v in state.items() if k in own})
+        self.model = model
+
+    def generate(self, input_ids, paged: bool = False, page_size: int = 16,
+                 **kwargs):
+        from . import generation
+
+        if paged:
+            return generation.generate_paged(self.model, input_ids,
+                                             page_size=page_size, **kwargs)
+        return generation.generate(self.model, input_ids, **kwargs)

@@ -146,6 +146,38 @@ class LlamaAttention(Layer):
 
         cfg = self.config
 
+        if isinstance(kv_cache, dict):
+            # static-shape decode cache (serving path): jit-stable shapes at
+            # every step. Two layouts, both with in-place buffer updates:
+            # dense [B,Smax,hk,d], or paged (block tables) matching
+            # block_multi_head_attention_kernel.cu. `allowed` is an optional
+            # [B,T] column-validity mask (padded prompts).
+            from ..generation import cached_attention, paged_cached_attention
+
+            if "k_pages" in kv_cache:
+                out, kp, vp = apply(
+                    "llama_attention_paged", paged_cached_attention,
+                    q, k, v, cos, sin, kv_cache["k_pages"],
+                    kv_cache["v_pages"], kv_cache["page_indices"],
+                    kv_cache["lengths"], kv_cache["pos"],
+                    kv_cache.get("page_size"))
+                result = self.o_proj(out.reshape([b, s, h * d]))
+                new = dict(kv_cache)
+                new.update(k_pages=kp, v_pages=vp, pos=kv_cache["pos"] + s,
+                           lengths=kv_cache["lengths"] + s)
+                return result, new
+            out, k_buf, v_buf = apply(
+                "llama_attention_cached", cached_attention, q, k, v, cos, sin,
+                kv_cache["k"], kv_cache["v"], kv_cache["pos"],
+                kv_cache.get("allowed"), kv_cache.get("row_pos"))
+            result = self.o_proj(out.reshape([b, s, h * d]))
+            new = {"k": k_buf, "v": v_buf, "pos": kv_cache["pos"] + s}
+            if "allowed" in kv_cache:
+                new["allowed"] = kv_cache["allowed"]
+            if "row_pos" in kv_cache:
+                new["row_pos"] = kv_cache["row_pos"]
+            return result, new
+
         def attn_fn(q, k, v, cos, sin, *cache):
             from ..ops.pallas import fused_norm, flash_attention as pf
             from ..nn.functional.attention import _sdpa_ref
@@ -297,6 +329,19 @@ class LlamaModel(Layer):
             hidden = layer(hidden, cos, sin, attention_mask)
         return self.norm(hidden)
 
+    def forward_cached(self, input_ids, kv_caches, rope_len):
+        """Decode-path forward over static KV caches (one dict per layer,
+        see generation.cached_attention). Returns (hidden, new_caches)."""
+        cos, sin = self._rope(rope_len)
+        hidden = self.embed_tokens(input_ids)
+        hidden = hidden.astype(self.config.dtype)
+        new_caches = []
+        for layer, cache in zip(self.layers, kv_caches):
+            inner = getattr(layer, "inner", layer)  # unwrap RecomputeLayer
+            hidden, c = inner(hidden, cos, sin, kv_cache=cache)
+            new_caches.append(c)
+        return self.norm(hidden), new_caches
+
 
 class LlamaForCausalLM(Layer):
     def __init__(self, config: LlamaConfig):
@@ -313,13 +358,28 @@ class LlamaForCausalLM(Layer):
                     (config.hidden_size, config.vocab_size), jnp.float32)
                 .astype(self.lm_head.weight.dtype))
 
+    def lm_head_logits(self, hidden):
+        if self.lm_head is None:
+            return apply("tied_lm_head", lambda h, w: h @ w.T,
+                         hidden, self.llama.embed_tokens.weight)
+        return self.lm_head(hidden)
+
+    def generate(self, input_ids, max_new_tokens=20, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 use_cache=True, attention_mask=None, paged=False,
+                 page_size=16):
+        """Batched autoregressive decode (see paddle_tpu.generation)."""
+        from ..generation import generate as _generate
+
+        return _generate(self, input_ids, max_new_tokens=max_new_tokens,
+                         do_sample=do_sample, temperature=temperature,
+                         top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+                         use_cache=use_cache, attention_mask=attention_mask,
+                         paged=paged, page_size=page_size)
+
     def forward(self, input_ids, labels=None, attention_mask=None):
         hidden = self.llama(input_ids, attention_mask)
-        if self.lm_head is None:
-            logits = apply("tied_lm_head", lambda h, w: h @ w.T,
-                           hidden, self.llama.embed_tokens.weight)
-        else:
-            logits = self.lm_head(hidden)
+        logits = self.lm_head_logits(hidden)
         if labels is None:
             return logits
 
