@@ -39,6 +39,10 @@ from .parallel_layers import (
     ParallelCrossEntropy, GatherOp, ScatterOp,
 )
 from .recompute_layer import recompute, RecomputeLayer
+from .watchdog import (Watchdog, enable_watchdog, watchdog_stamp,
+                       disable_watchdog)
+from .spawn import spawn
+from .auto_tuner import AutoTuner, TunerConfig
 
 
 def __getattr__(name):

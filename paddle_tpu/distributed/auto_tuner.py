@@ -1,0 +1,178 @@
+"""Auto-tuner: search over hybrid-parallel configurations.
+
+Reference parity: python/paddle/distributed/auto_tuner/
+(``AutoTuner.search_once`` tuner.py:21,62, pruning rules prune.py, cost
+models cost_model.py / memory_cost_model.py). Same shape here: grid search
+over (dp, mp, pp, sharding-stage, micro-batch, recompute) candidates,
+divisibility/memory pruning before any run, history-based pruning after
+measured runs, and an analytic memory cost model tuned for TPU HBM.
+
+TPU-native notes baked into the cost model: mp (tensor parallel) shards
+both weights and activations over ICI; sharding stages 1/2/3 divide
+optimizer state / grads / params; recompute trades step time for
+activation memory (jax.checkpoint).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class TunerConfig:
+    """User settings (tuner_cfg parity; only TPU-meaningful knobs)."""
+
+    num_devices: int = 8
+    global_batch_size: int = 8
+    # model shape for the memory model
+    hidden_size: int = 2048
+    num_layers: int = 8
+    seq_len: int = 2048
+    vocab_size: int = 32000
+    intermediate_size: Optional[int] = None
+    dtype_bytes: int = 2          # bf16 params
+    hbm_bytes: int = 16 * 2 ** 30  # v5e default; v5p: 95GB
+    # search space (None = derive from num_devices divisors)
+    mp_candidates: Optional[List[int]] = None
+    pp_candidates: Optional[List[int]] = None
+    sharding_stage_candidates: Optional[List[int]] = None
+    micro_batch_candidates: Optional[List[int]] = None
+    recompute_candidates: Optional[List[bool]] = None
+    task_limit: int = 100
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class MemoryCostModel:
+    """Analytic per-device HBM estimate (memory_cost_model.py role)."""
+
+    def __init__(self, cfg: TunerConfig):
+        self.cfg = cfg
+
+    def params_bytes(self) -> int:
+        c = self.cfg
+        inter = c.intermediate_size or 4 * c.hidden_size
+        per_layer = 4 * c.hidden_size * c.hidden_size + 3 * c.hidden_size * inter
+        emb = c.vocab_size * c.hidden_size
+        return (c.num_layers * per_layer + 2 * emb) * c.dtype_bytes
+
+    def estimate(self, trial: Dict[str, Any]) -> int:
+        c = self.cfg
+        mp = trial["mp_degree"]
+        pp = trial["pp_degree"]
+        stage = trial["sharding_stage"]
+        dp_shard = c.num_devices // (mp * pp)
+        p = self.params_bytes() // (mp * pp)
+        # optimizer: fp32 master + 2 adam moments = 6x param bytes (bf16->f32)
+        opt = 6 * p
+        grads = p
+        if stage >= 1:
+            opt //= max(dp_shard, 1)
+        if stage >= 2:
+            grads //= max(dp_shard, 1)
+        if stage >= 3:
+            p //= max(dp_shard, 1)
+        micro = trial["micro_batch_size"]
+        act_per_token = c.hidden_size * c.num_layers // pp * c.dtype_bytes
+        acts = micro * c.seq_len * act_per_token * (4 if not trial["recompute"] else 1)
+        acts //= mp
+        return p + opt + grads + acts
+
+
+class AutoTuner:
+    """Grid search + pruning (tuner.py:21 parity).
+
+    ``search_once()`` returns the next un-pruned candidate dict (or None
+    when exhausted); ``add_cfg(cfg)`` records a measured result
+    (``cfg["time"]`` seconds or ``cfg["error"]``) enabling history pruning
+    (a config whose strictly-weaker sibling OOMed is skipped).
+    """
+
+    def __init__(self, tuner_cfg):
+        self.cfg = (tuner_cfg if isinstance(tuner_cfg, TunerConfig)
+                    else TunerConfig(**tuner_cfg))
+        self.mem_model = MemoryCostModel(self.cfg)
+        self.history_cfgs: List[Dict[str, Any]] = []
+        self.cur_task_id = 1
+        self.task_limit = self.cfg.task_limit
+        self._candidates = self._build_candidates()
+        self._cursor = 0
+
+    # ---- candidate generation (search.py GridSearch role) -------------------
+    def _build_candidates(self) -> List[Dict[str, Any]]:
+        c = self.cfg
+        mps = c.mp_candidates or _divisors(c.num_devices)
+        pps = c.pp_candidates or _divisors(c.num_devices)
+        stages = c.sharding_stage_candidates or [0, 1, 2, 3]
+        micros = c.micro_batch_candidates or _divisors(c.global_batch_size)
+        recs = c.recompute_candidates or [False, True]
+        out = []
+        for mp, pp, st, mb, rc in itertools.product(mps, pps, stages, micros, recs):
+            trial = {"mp_degree": mp, "pp_degree": pp, "sharding_stage": st,
+                     "micro_batch_size": mb, "recompute": rc}
+            est = self._prune_static(trial)
+            if est is None:
+                continue
+            trial["dp_degree"] = c.num_devices // (mp * pp)
+            trial["estimated_memory"] = est
+            out.append(trial)
+        # cheapest memory first: likeliest to run, fastest signal (the
+        # reference sorts candidates by its cost model too)
+        out.sort(key=lambda t: t["estimated_memory"])
+        return out
+
+    # ---- pruning rules (prune.py role) ---------------------------------------
+    def _prune_static(self, t):
+        """Returns the memory estimate for a surviving trial, None when
+        pruned (the estimate is reused, not recomputed)."""
+        c = self.cfg
+        mp, pp = t["mp_degree"], t["pp_degree"]
+        if mp * pp > c.num_devices or c.num_devices % (mp * pp) != 0:
+            return None  # prune_by_num_gpus
+        if c.hidden_size % mp != 0:
+            return None  # prune_by_mp: heads/hidden must divide
+        if c.num_layers % pp != 0:
+            return None  # prune_by_pp
+        dp = c.num_devices // (mp * pp)
+        if c.global_batch_size % (dp * t["micro_batch_size"]) != 0:
+            return None  # prune_by_mbs: accumulate_steps must be integral
+        if t["sharding_stage"] > 0 and dp == 1:
+            return None  # sharding needs a data axis
+        mem = self.mem_model.estimate({**t, "dp_degree": dp})
+        if mem > self.cfg.hbm_bytes:
+            return None  # memory model prune
+        return mem
+
+    def _prune_by_history(self, t) -> bool:
+        for h in self.history_cfgs:
+            if h.get("error") == "oom":
+                # anything needing >= the OOMed config's memory is dead
+                if t["estimated_memory"] >= h["estimated_memory"]:
+                    return True
+        return False
+
+    # ---- the public surface (tuner.py:62) ------------------------------------
+    def search_once(self) -> Optional[Dict[str, Any]]:
+        """Return the next task config, or None when exhausted."""
+        if self.cur_task_id > self.task_limit:
+            return None
+        while self._cursor < len(self._candidates):
+            trial = self._candidates[self._cursor]
+            self._cursor += 1
+            if self._prune_by_history(trial):
+                continue
+            self.cur_task_id += 1
+            return dict(trial)
+        return None
+
+    def add_cfg(self, cfg: Dict[str, Any]):
+        """Record a measured result (time/error fields)."""
+        self.history_cfgs.append(cfg)
+
+    def best_cfg(self) -> Optional[Dict[str, Any]]:
+        ran = [h for h in self.history_cfgs
+               if "time" in h and h.get("error") is None]
+        return min(ran, key=lambda h: h["time"]) if ran else None

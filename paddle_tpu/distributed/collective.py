@@ -142,6 +142,48 @@ def _collective_fn(kind, mesh, axes, spec_in, spec_out, extra=None):
     return jax.jit(shard_map(f, mesh=mesh, in_specs=(spec_in,), out_specs=spec_out))
 
 
+def _multiprocess() -> bool:
+    try:
+        return jax.process_count() > 1
+    except Exception:  # pragma: no cover
+        return False
+
+
+@functools.lru_cache(maxsize=8)
+def _process_mesh():
+    """A (proc, dlocal) mesh whose first axis is exactly one row per
+    PROCESS — eager ProcessGroup semantics rank = process, regardless of
+    how many local devices each process owns (multi-host TPU topology)."""
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    p = jax.process_count()
+    local = len(devs) // p
+    grid = np.array(devs).reshape(p, local)
+    return Mesh(grid, ("proc", "dlocal"))
+
+
+def _cross_process_reduce(arr, kind):
+    """Eager allreduce across PROCESSES: each process contributes its own
+    host-local array as one row of a [n_proc, ...] global array sharded
+    over the process axis (replicated over that process's local devices);
+    a shard_map psum reduces the rows and each process reads back its
+    now-fully-reduced slice. This is the eager ProcessGroup semantic
+    (process_group_nccl.h AllReduce) expressed as XLA collectives."""
+    from jax.experimental import multihost_utils
+
+    mesh = _process_mesh()
+    row_spec = PartitionSpec("proc", *([None] * arr.ndim))
+    global_arr = multihost_utils.host_local_array_to_global_array(
+        arr[None], mesh, row_spec)
+    fn = _collective_fn(kind, mesh, ("proc",), row_spec, row_spec)
+    out_global = fn(global_arr)
+    local = multihost_utils.global_array_to_host_local_array(
+        out_global, mesh, row_spec)
+    return jnp.asarray(local)[0]
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """Reduce a tensor sharded/partial over the group axis; in paddle
     semantics every rank ends with the reduced value (here: the global array
@@ -150,9 +192,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     arr = unwrap(tensor)
     kind = {"sum": "allreduce_sum", "max": "allreduce_max",
             "min": "allreduce_min", "avg": "allreduce_avg"}[op if isinstance(op, str) else "sum"]
-    spec = PartitionSpec(*([None] * arr.ndim))
-    fn = _collective_fn(kind, mesh, tuple(axes), spec, spec)
-    out = fn(jax.device_put(arr, NamedSharding(mesh, spec)))
+    if _multiprocess():
+        out = _cross_process_reduce(arr, kind)
+    else:
+        spec = PartitionSpec(*([None] * arr.ndim))
+        fn = _collective_fn(kind, mesh, tuple(axes), spec, spec)
+        out = fn(jax.device_put(arr, NamedSharding(mesh, spec)))
     result = wrap(out, tensor.stop_gradient)
     if isinstance(tensor, Tensor):
         tensor._array = result._array

@@ -33,6 +33,11 @@ def _build_parser():
                    help="job name prefix for log files")
     p.add_argument("--devices", default=None,
                    help="visible device ids for this node (comma-separated)")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="relaunch ALL workers up to N times after a failure "
+                        "(elastic manager parity: workers must resume from "
+                        "their checkpoint; PADDLE_RESTART_COUNT tells them "
+                        "which incarnation they are)")
     p.add_argument("training_script",
                    help="script to run (or module with -m inside the script)")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -66,8 +71,24 @@ def _rank_env(args, local_rank: int) -> dict:
 
 
 def launch(argv: Optional[List[str]] = None) -> int:
-    """Spawn workers, stream logs to --log_dir, return first failure code."""
+    """Spawn workers, stream logs to --log_dir; on failure either abort or
+    (with --max_restarts) relaunch every worker, elastic-manager style
+    (fleet/elastic/manager.py:125 — membership change → restart; workers
+    resume from their own checkpoints)."""
     args = _build_parser().parse_args(argv)
+    code = _run_once(args, restart_count=0)
+    restarts = 0
+    # 130 = operator Ctrl-C: an intentional stop, never a restartable failure
+    while code not in (0, 130) and restarts < args.max_restarts:
+        restarts += 1
+        print(f"launch: failure (rc={code}); restart {restarts}/"
+              f"{args.max_restarts} of all workers", flush=True)
+        code = _run_once(args, restart_count=restarts)
+    return code
+
+
+def _run_once(args, restart_count: int) -> int:
+    """One incarnation: spawn workers, watch, first-failure abort."""
     os.makedirs(args.log_dir, exist_ok=True)
 
     procs: List[subprocess.Popen] = []
@@ -75,15 +96,17 @@ def launch(argv: Optional[List[str]] = None) -> int:
     log_files = []
     for local_rank in range(args.nproc_per_node):
         rank = args.rank * args.nproc_per_node + local_rank
+        suffix = f".r{restart_count}" if restart_count else ""
         log_path = os.path.join(
-            args.log_dir, f"{args.job_id}.workerlog.{rank}")
+            args.log_dir, f"{args.job_id}.workerlog.{rank}{suffix}")
         logf = open(log_path, "w")
         log_files.append(logf)
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
+        env = _rank_env(args, local_rank)
+        env["PADDLE_RESTART_COUNT"] = str(restart_count)
         procs.append(subprocess.Popen(
-            cmd, env=_rank_env(args, local_rank),
-            stdout=logf, stderr=subprocess.STDOUT))
+            cmd, env=env, stdout=logf, stderr=subprocess.STDOUT))
         logs.append(log_path)
         print(f"launch: rank {rank} pid {procs[-1].pid} log {log_path}",
               flush=True)

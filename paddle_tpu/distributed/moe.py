@@ -82,17 +82,19 @@ def one_hot_dispatch(probs, topk_idx, capacity: int):
     """
     S, E = probs.shape
     K = topk_idx.shape[1]
-    base = jnp.zeros((E,), jnp.int32)
-    combine = jnp.zeros((S, E, capacity), probs.dtype)
-    for i in range(K):
-        # one_hot of a -1 (dropped-route sentinel) row is all-zero
-        mask = jax.nn.one_hot(topk_idx[:, i], E, dtype=jnp.int32)       # [S, E]
-        pos = (jnp.cumsum(mask, axis=0) - 1) + base[None, :]            # [S, E]
-        base = base + jnp.sum(mask, axis=0)
-        keep = mask * (pos < capacity)                                  # [S, E]
-        pos_oh = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
-                                dtype=probs.dtype)                      # [S, E, C]
-        combine = combine + (keep.astype(probs.dtype) * probs)[:, :, None] * pos_oh
+    # vectorized over K (VERDICT r2 item 9): routes ordered k-major —
+    # all k=0 routes take expert slots before any k=1 route, matching the
+    # loop-with-base-offset (and the reference's cumsum-position semantics)
+    mask = jax.nn.one_hot(topk_idx.T, E, dtype=jnp.int32)  # [K, S, E]
+    flat = mask.reshape(K * S, E)
+    pos = jnp.cumsum(flat, axis=0) - 1                      # [K*S, E]
+    keep = flat * (pos < capacity)
+    pos_oh = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
+                            dtype=probs.dtype)              # [K*S, E, C]
+    weights = (keep.astype(probs.dtype).reshape(K, S, E)
+               * probs[None])                               # [K, S, E]
+    combine = jnp.einsum("kse,ksec->sec", weights,
+                         pos_oh.reshape(K, S, E, capacity))
     dispatch = combine > 0
     return combine, dispatch
 
@@ -142,14 +144,14 @@ class NaiveGate(BaseGate):
     stage so the eager tape differentiates through the combine weights."""
 
     def __init__(self, d_model: int, num_expert: int, world_size: int = 1, topk: int = 2,
-                 capacity_factor: Optional[float] = None):
+                 capacity_factor: Optional[float] = 2.0):
         super().__init__(num_expert, world_size)
         self.d_model = d_model
         self.top_k = topk
-        # Default None = the reference's strict no-drop semantics (C = S,
-        # which makes the [S, E, C] dispatch tensors quadratic in tokens —
-        # fine for small S). Pass a factor to bound them at O(S*K*factor*M)
-        # at the cost of drops under imbalance.
+        # Default 2.0 bounds the dispatch tensors at O(S*K*factor*M)
+        # (VERDICT r2 item 9: C = S by default is quadratic in tokens).
+        # Pass capacity_factor=None to opt IN to the reference's strict
+        # no-drop semantics (C = S) for small-S correctness work.
         self.capacity_factor = capacity_factor
         self.gate_weight = self.create_parameter(
             [d_model, self.tot_expert], default_initializer=XavierUniform())
@@ -300,6 +302,24 @@ class GroupedMLP(Layer):
         """xe: [E, C, M] → [E, C, M]."""
         return _grouped_ffn(xe, unwrap(self.w1), unwrap(self.b1),
                             unwrap(self.w2), unwrap(self.b2), self.activation)
+
+    def forward_ragged(self, x, group_sizes):
+        """Ragged grouped GEMM: x [T, M] tokens SORTED by expert,
+        group_sizes [E] (sum = T). Uses jax.lax.ragged_dot, which lowers to
+        the TPU grouped-matmul kernel (the role of the reference's cutlass
+        moe_gemm, fusion/cutlass/cutlass_kernels/moe_gemm/) — no padding to
+        a uniform capacity, so imbalanced expert loads waste no FLOPs."""
+        xs = unwrap(x)
+        gs = unwrap(group_sizes).astype(jnp.int32)
+        T = xs.shape[0]
+        w1, b1 = unwrap(self.w1), unwrap(self.b1)
+        w2, b2 = unwrap(self.w2), unwrap(self.b2)
+        b1_tok = jnp.repeat(b1[:, 0], gs, axis=0, total_repeat_length=T)
+        b2_tok = jnp.repeat(b2[:, 0], gs, axis=0, total_repeat_length=T)
+        h = jax.lax.ragged_dot(xs, w1, gs) + b1_tok
+        h = getattr(jax.nn, self.activation)(h)
+        out = jax.lax.ragged_dot(h, w2, gs) + b2_tok
+        return wrap(out)
 
     def forward(self, x):
         return wrap(self.forward_expert_batch(unwrap(x)))

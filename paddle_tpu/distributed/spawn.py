@@ -1,0 +1,89 @@
+"""paddle.distributed.spawn parity.
+
+Reference: python/paddle/distributed/spawn.py:463 — start ``nprocs``
+worker processes running a picklable ``func``, wiring the same rendezvous
+env the launcher sets (PADDLE_MASTER / PADDLE_TRAINER_ID / ...), and
+return a context whose ``join()`` raises on the first worker failure.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from typing import Any, Iterable, Optional
+
+
+class MultiprocessContext:
+    """Parity with spawn.py's MultiprocessContext (join/processes)."""
+
+    def __init__(self, processes, error_queue):
+        self.processes = processes
+        self._error_queue = error_queue
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        for p in self.processes:
+            p.join(timeout)
+        failed = [p for p in self.processes if p.exitcode not in (0, None)]
+        if failed:
+            msgs = []
+            while not self._error_queue.empty():
+                msgs.append(self._error_queue.get())
+            detail = ("\n" + "\n".join(msgs)) if msgs else ""
+            raise RuntimeError(
+                f"{len(failed)} spawned process(es) failed "
+                f"(exitcodes {[p.exitcode for p in failed]}){detail}")
+        return all(p.exitcode == 0 for p in self.processes)
+
+
+def _worker(func, rank, nprocs, master, args, error_queue):
+    os.environ.update({
+        "PADDLE_MASTER": master,
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_LOCAL_RANK": str(rank),
+        "JAX_COORDINATOR_ADDRESS": master,
+        "JAX_NUM_PROCESSES": str(nprocs),
+        "JAX_PROCESS_ID": str(rank),
+    })
+    try:
+        func(*args)
+    except Exception:
+        import traceback
+
+        error_queue.put(f"rank {rank}:\n{traceback.format_exc()}")
+        raise
+
+
+def spawn(func, args: Iterable[Any] = (), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, **options) -> MultiprocessContext:
+    """Start ``nprocs`` processes running ``func(*args)`` with rendezvous
+    env preconfigured (spawn.py:463). ``nprocs=-1`` uses the local device
+    count. Returns a :class:`MultiprocessContext`; with ``join=True`` (the
+    default) blocks and raises on first failure."""
+    if nprocs <= 0:
+        try:
+            import jax
+
+            nprocs = max(1, jax.local_device_count())
+        except Exception:
+            nprocs = 1
+    master = options.get("master")
+    if master is None:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            master = f"127.0.0.1:{s.getsockname()[1]}"
+
+    ctx = mp.get_context(options.get("start_method", "spawn"))
+    error_queue = ctx.Queue()
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, master, tuple(args),
+                              error_queue),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    context = MultiprocessContext(procs, error_queue)
+    if join:
+        context.join()
+    return context

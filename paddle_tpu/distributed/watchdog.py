@@ -1,0 +1,156 @@
+"""Progress watchdog: hang detection for distributed training.
+
+Reference parity: the comm-task watchdog — ``CommTask::IsTimeout``
+(paddle/phi/core/distributed/comm_task.h:127) and the ``CommTaskManager``
+loop threads (comm_task_manager.h:37,59-61) that track every async
+collective, detect timeout, dump desync state and abort.
+
+TPU-native collapse: collectives live INSIDE compiled XLA programs, so the
+per-collective tracking granularity doesn't exist — what can hang is a
+STEP (a compiled program waiting on a peer) or a host-side barrier. The
+watchdog therefore tracks step-level progress stamps: a daemon thread
+checks the age of the last stamp and, on timeout, dumps every Python
+thread's stack (the desync-debug dump) plus the stamp history, then runs
+the configured action (default: raise the alarm callback; ``abort=True``
+hard-exits the process so the launcher's first-failure abort and restart
+policy can take over — the role of AbortComm + elastic restart).
+"""
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+class Watchdog:
+    """Step-progress watchdog thread.
+
+    Usage::
+
+        wd = Watchdog(timeout=300, abort=True)
+        wd.start()
+        for step in range(n):
+            ...train...
+            wd.stamp(f"step {step}")
+        wd.stop()
+    """
+
+    def __init__(self, timeout: float = 300.0, name: str = "train",
+                 on_timeout: Optional[Callable[["Watchdog"], None]] = None,
+                 abort: bool = False, poll_interval: Optional[float] = None,
+                 history: int = 16, stream=None):
+        self.timeout = float(timeout)
+        self.name = name
+        self.on_timeout = on_timeout
+        self.abort = abort
+        self._poll = poll_interval if poll_interval is not None \
+            else max(0.05, self.timeout / 10)
+        self._history: List[Tuple[float, str]] = []
+        self._history_cap = history
+        self._stream = stream or sys.stderr
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    # ---- producer side -------------------------------------------------------
+    def stamp(self, tag: str = ""):
+        with self._lock:
+            self._last = time.monotonic()
+            self._history.append((time.time(), tag))
+            if len(self._history) > self._history_cap:
+                self._history.pop(0)
+
+    # ---- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self.stamp("watchdog start")
+        self._thread = threading.Thread(
+            target=self._loop, name=f"watchdog-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self._poll + 1)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ---- the monitor ---------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self._poll):
+            with self._lock:
+                age = time.monotonic() - self._last
+            if age > self.timeout:
+                self._fire(age)
+                return
+
+    def _fire(self, age: float):
+        self.fired = True
+        rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+        w = self._stream
+        print(f"[watchdog:{self.name}] rank {rank}: NO PROGRESS for "
+              f"{age:.1f}s (timeout {self.timeout}s) — likely hung "
+              "collective/barrier or dead peer", file=w, flush=True)
+        print(f"[watchdog:{self.name}] last progress stamps:", file=w)
+        with self._lock:
+            for ts, tag in self._history:
+                print(f"  {time.strftime('%H:%M:%S', time.localtime(ts))} "
+                      f"{tag}", file=w)
+        # the desync dump: every python thread's stack (faulthandler needs
+        # a real fd; fall back to frame walking for in-memory streams)
+        try:
+            faulthandler.dump_traceback(file=w)
+        except Exception:
+            import traceback
+
+            for tid, frame in sys._current_frames().items():
+                print(f"Thread {tid}:", file=w)
+                traceback.print_stack(frame, file=w)
+        w.flush()
+        if self.on_timeout is not None:
+            try:
+                self.on_timeout(self)
+            except Exception:
+                pass
+        if self.abort:
+            # hard abort (AbortComm parity): the launcher sees the death,
+            # kills peers, and its restart policy resumes from checkpoint
+            os._exit(124)
+
+
+_global_watchdog: Optional[Watchdog] = None
+
+
+def enable_watchdog(timeout: float = 300.0, abort: bool = True) -> Watchdog:
+    """Install a process-global training watchdog (comm_task_manager
+    parity). Call ``paddle_tpu.distributed.watchdog_stamp()`` per step."""
+    global _global_watchdog
+    if _global_watchdog is not None:
+        _global_watchdog.stop()
+    _global_watchdog = Watchdog(timeout=timeout, abort=abort).start()
+    return _global_watchdog
+
+
+def watchdog_stamp(tag: str = ""):
+    if _global_watchdog is not None:
+        _global_watchdog.stamp(tag)
+
+
+def disable_watchdog():
+    global _global_watchdog
+    if _global_watchdog is not None:
+        _global_watchdog.stop()
+        _global_watchdog = None

@@ -26,6 +26,18 @@ jax.config.update("jax_platforms", "cpu")
 # numeric-parity tests compare against float64-ish numpy references
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# persistent XLA compilation cache: the suite is compile-dominated (every
+# jit in every test), and the HLO-keyed disk cache makes repeat runs reuse
+# executables across processes and sessions
+_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                            "/tmp/paddle_tpu_jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:  # older jax without the knobs — run uncached
+    pass
+
 
 @pytest.fixture(autouse=True)
 def _seed_rngs():
@@ -39,3 +51,8 @@ def _seed_rngs():
 
     tape.reset_tape()
     tape.set_grad_enabled(True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / e2e tests (several seconds each)")

@@ -1,0 +1,160 @@
+"""Failure handling: progress watchdog + launch restart-from-checkpoint.
+
+Parity model: the reference's comm-task watchdog (comm_task.h:127,
+comm_task_manager.h:37 — timeout detection + desync dump + abort) and the
+elastic restart loop (fleet/elastic/manager.py:125, launch controllers).
+"""
+import io
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_watchdog_detects_stall():
+    from paddle_tpu.distributed.watchdog import Watchdog
+
+    fired = []
+    buf = io.StringIO()
+    wd = Watchdog(timeout=0.3, poll_interval=0.05,
+                  on_timeout=lambda w: fired.append(w), stream=buf)
+    wd.start()
+    time.sleep(1.0)  # no stamps → stall
+    wd.stop()
+    assert wd.fired and fired
+    out = buf.getvalue()
+    assert "NO PROGRESS" in out
+    assert "watchdog start" in out          # stamp history dumped
+    assert "Thread" in out or "thread" in out  # faulthandler stacks
+
+
+def test_watchdog_quiet_under_progress():
+    from paddle_tpu.distributed.watchdog import Watchdog
+
+    buf = io.StringIO()
+    wd = Watchdog(timeout=0.5, poll_interval=0.05, stream=buf)
+    wd.start()
+    for i in range(10):
+        time.sleep(0.1)
+        wd.stamp(f"step {i}")
+    wd.stop()
+    assert not wd.fired
+    assert buf.getvalue() == ""
+
+
+def test_watchdog_global_api():
+    import paddle_tpu.distributed as dist
+
+    wd = dist.enable_watchdog(timeout=30, abort=False)
+    dist.watchdog_stamp("step 0")
+    assert wd._history[-1][1] == "step 0"
+    dist.disable_watchdog()
+
+
+_WORKER = r'''
+import os, pickle, sys, time
+import numpy as np
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+incarnation = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+ckpt_dir = sys.argv[1]
+crash_at = int(sys.argv[2])
+total_steps = int(sys.argv[3])
+
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.watchdog import Watchdog
+
+host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+store = TCPStore(host, int(port), is_master=(rank == 0), world_size=world,
+                 timeout=30)
+store.barrier(f"boot{incarnation}")
+
+wd = Watchdog(timeout=60, name=f"rank{rank}").start()
+
+# deterministic "training": w += step value each step; checkpoint each step
+ck = os.path.join(ckpt_dir, f"rank{rank}.pkl")
+if os.path.exists(ck):
+    with open(ck, "rb") as f:
+        state = pickle.load(f)
+else:
+    state = {"step": 0, "w": 0.0}
+
+# resume-step agreement: a crashed rank may hold an older checkpoint than a
+# rank that was SIGTERMed later — everyone rolls back to the MIN step (the
+# role of the dist-checkpoint global metadata)
+store.set(f"resume_{incarnation}_{rank}", str(state["step"]).encode())
+store.barrier(f"resume{incarnation}")
+steps = [int(store.get(f"resume_{incarnation}_{r}", timeout=15))
+         for r in range(world)]
+agreed = min(steps)
+if agreed != state["step"]:
+    state = {"step": agreed, "w": float(sum(range(1, agreed + 1)))}
+if incarnation > 0:
+    print(f"rank {rank} RESUMED from step {agreed} "
+          f"(incarnation {incarnation})", flush=True)
+
+for step in range(state["step"], total_steps):
+    state["w"] += float(step + 1)
+    state["step"] = step + 1
+    # crash-safe checkpoint: tmp + rename
+    with open(ck + ".tmp", "wb") as f:
+        pickle.dump(state, f)
+    os.replace(ck + ".tmp", ck)
+    wd.stamp(f"step {step}")
+    store.barrier(f"step{incarnation}_{step}")
+    if incarnation == 0 and rank == 1 and step + 1 == crash_at:
+        print(f"rank {rank} CRASHING at step {step + 1}", flush=True)
+        os._exit(17)
+
+wd.stop()
+print(f"rank {rank} DONE w={state['w']} step={state['step']}", flush=True)
+'''
+
+
+@pytest.mark.slow
+def test_launch_restart_resumes_from_checkpoint(tmp_path):
+    """Kill one rank mid-run; the launcher detects the death, tears the
+    job down, relaunches, and workers resume from their checkpoints
+    (VERDICT r2 item 6 done-criterion)."""
+    import socket
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    logd = tmp_path / "logs"
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    total_steps, crash_at = 5, 2
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{port}",
+         "--max_restarts", "1", "--log_dir", str(logd),
+         str(worker), str(ckpt), str(crash_at), str(total_steps)],
+        cwd="/root/repo", capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "/root/repo"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "restart 1/1" in r.stdout
+
+    # both ranks finished all steps with the exact uninterrupted sum
+    import pickle
+
+    expect_w = float(sum(range(1, total_steps + 1)))
+    for rank in range(2):
+        with open(ckpt / f"rank{rank}.pkl", "rb") as f:
+            state = pickle.load(f)
+        assert state["step"] == total_steps
+        assert state["w"] == expect_w, (rank, state)
+    # the resumed incarnation logged its recovery
+    logs = "".join(p.read_text() for p in logd.iterdir())
+    assert "RESUMED from step" in logs
+    assert "CRASHING at step 2" in logs

@@ -29,8 +29,8 @@ def _prompt(cfg, b=2, s=5, seed=0):
 def test_greedy_cache_matches_no_cache(tiny_model):
     """Static-KV decode must produce exactly the no-cache argmax loop."""
     x = _prompt(tiny_model.config)
-    out_c = tiny_model.generate(x, max_new_tokens=6, use_cache=True)
-    out_n = tiny_model.generate(x, max_new_tokens=6, use_cache=False)
+    out_c = tiny_model.generate(x, max_new_tokens=4, use_cache=True)
+    out_n = tiny_model.generate(x, max_new_tokens=4, use_cache=False)
     np.testing.assert_array_equal(out_c.numpy(), out_n.numpy())
     assert out_c.shape[0] == 2  # batched decode
 
@@ -146,14 +146,14 @@ def test_attention_mask_ragged_batch(tiny_model):
     rng = np.random.RandomState(3)
     a = rng.randint(0, cfg.vocab_size, (1, 3))
     b = rng.randint(0, cfg.vocab_size, (1, 5))
-    solo_a = tiny_model.generate(paddle.to_tensor(a), max_new_tokens=4).numpy()
-    solo_b = tiny_model.generate(paddle.to_tensor(b), max_new_tokens=4).numpy()
+    solo_a = tiny_model.generate(paddle.to_tensor(a), max_new_tokens=3).numpy()
+    solo_b = tiny_model.generate(paddle.to_tensor(b), max_new_tokens=3).numpy()
 
     # batch [a padded to 5, b], mask marks real tokens
     pad = np.zeros((1, 2), a.dtype)
     batch = np.concatenate([np.concatenate([a, pad], 1), b], 0)
     mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], "int32")
-    out = tiny_model.generate(paddle.to_tensor(batch), max_new_tokens=4,
+    out = tiny_model.generate(paddle.to_tensor(batch), max_new_tokens=3,
                               attention_mask=paddle.to_tensor(mask)).numpy()
     np.testing.assert_array_equal(out[0], solo_a[0])
     np.testing.assert_array_equal(out[1], solo_b[0])

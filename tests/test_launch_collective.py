@@ -1,0 +1,115 @@
+"""Launch-driven multi-process collective integration test.
+
+Parity model: test/collective/test_communication_api_base.py:28,63-70 —
+a unittest driver launches REAL processes via `python -m
+paddle.distributed.launch` that rendezvous on one master, run collectives,
+and assert loss parity with the single-process run.
+"""
+import os
+import pickle
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+_WORKER = r'''
+import os, pickle, sys
+import numpy as np
+
+out_dir = sys.argv[1]
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+assert dist.get_world_size() == 2, dist.get_world_size()
+assert dist.get_rank() == rank
+
+# ---- all_reduce over the 2-process global mesh ----
+x = paddle.to_tensor(np.full((4,), float(rank + 1), dtype="float32"))
+dist.all_reduce(x)
+np.testing.assert_allclose(x.numpy(), 3.0)  # 1 + 2
+
+# ---- data-parallel loss parity vs the single-process whole batch ----
+# global batch split by rank; grads allreduced -> must equal whole-batch run
+paddle.seed(0)
+model = paddle.nn.Linear(8, 4)
+data = np.random.RandomState(7).randn(4, 8).astype("float32")
+label = np.random.RandomState(8).randn(4, 4).astype("float32")
+shard = slice(rank * 2, rank * 2 + 2)
+out = model(paddle.to_tensor(data[shard]))
+loss = ((out - paddle.to_tensor(label[shard])) ** 2).mean()
+loss.backward()
+# dp grad sync: mean over ranks
+for p in model.parameters():
+    g = p.grad
+    dist.all_reduce(g)
+    p._grad = g / 2.0
+loss_sync = loss.clone()
+dist.all_reduce(loss_sync)
+result = {
+    "rank": rank,
+    "mean_loss": float(loss_sync.numpy()) / 2.0,
+    "grads": {n: np.asarray(p.grad.numpy())
+              for n, p in model.named_parameters()},
+}
+with open(os.path.join(out_dir, f"rank{rank}.pkl"), "wb") as f:
+    pickle.dump(result, f)
+print(f"rank {rank} OK", flush=True)
+'''
+
+
+@pytest.mark.slow
+def test_launch_two_process_allreduce_and_loss_parity(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{port}",
+         "--log_dir", str(tmp_path / "logs"), str(worker), str(tmp_path)],
+        cwd="/root/repo", capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "/root/repo",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+
+    results = []
+    for rank in range(2):
+        with open(tmp_path / f"rank{rank}.pkl", "rb") as f:
+            results.append(pickle.load(f))
+
+    # ---- single-process reference on the WHOLE batch ----
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    model = paddle.nn.Linear(8, 4)
+    data = np.random.RandomState(7).randn(4, 8).astype("float32")
+    label = np.random.RandomState(8).randn(4, 4).astype("float32")
+    out = model(paddle.to_tensor(data))
+    loss = ((out - paddle.to_tensor(label)) ** 2).mean()
+    loss.backward()
+
+    for res in results:
+        np.testing.assert_allclose(res["mean_loss"], float(loss.numpy()),
+                                   rtol=1e-5)
+        for n, p in model.named_parameters():
+            np.testing.assert_allclose(res["grads"][n], p.grad.numpy(),
+                                       rtol=1e-4, atol=1e-6)
+    # both ranks computed identical synced grads
+    for n in results[0]["grads"]:
+        np.testing.assert_array_equal(results[0]["grads"][n],
+                                      results[1]["grads"][n])

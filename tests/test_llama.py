@@ -42,7 +42,7 @@ def test_llama_trains_eager():
     o = opt.AdamW(1e-3, parameters=model.parameters())
     x, y = tiny_batch(b=2, s=16)
     losses = []
-    for _ in range(8):
+    for _ in range(4):
         loss, _ = model(x, labels=y)
         loss.backward()
         o.step()

@@ -259,3 +259,76 @@ def test_global_scatter_gather_roundtrip():
     for r in range(world):
         n = int(lc[r].sum())
         np.testing.assert_allclose(back.numpy()[r, :n], x[r, :n])
+
+
+def test_dispatch_vectorized_matches_loop_semantics():
+    """The k-major vectorized dispatch must equal the reference loop
+    (cumsum positions, k=0 routes take slots before k=1) incl. drops."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.moe import one_hot_dispatch
+
+    rng = np.random.RandomState(0)
+    S, E, K, C = 16, 4, 2, 5
+    probs = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.randn(S, E)), -1))
+    idx = jnp.asarray(rng.randint(0, E, (S, K)))
+
+    def loop_ref(probs, topk_idx, capacity):
+        base = jnp.zeros((E,), jnp.int32)
+        combine = jnp.zeros((S, E, capacity), probs.dtype)
+        for i in range(K):
+            mask = jax.nn.one_hot(topk_idx[:, i], E, dtype=jnp.int32)
+            pos = (jnp.cumsum(mask, axis=0) - 1) + base[None, :]
+            base = base + jnp.sum(mask, axis=0)
+            keep = mask * (pos < capacity)
+            pos_oh = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
+                                    dtype=probs.dtype)
+            combine = combine + (keep.astype(probs.dtype) * probs)[:, :, None] * pos_oh
+        return combine
+
+    got, disp = one_hot_dispatch(probs, idx, C)
+    ref = loop_ref(probs, idx, C)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(disp), np.asarray(ref) > 0)
+
+
+def test_naive_gate_default_capacity_finite():
+    from paddle_tpu.distributed.moe import NaiveGate, compute_capacity
+
+    g = NaiveGate(8, 4, topk=2)
+    assert g.capacity_factor == 2.0  # finite by default (VERDICT r2 item 9)
+    # no-drop is an explicit opt-in
+    g2 = NaiveGate(8, 4, topk=2, capacity_factor=None)
+    assert g2.capacity_factor is None
+    assert compute_capacity(128, 4, 2, 2.0) == 128
+
+
+def test_grouped_mlp_ragged_matches_batch():
+    """ragged_dot grouped GEMM == looped per-expert FFN on sorted tokens."""
+    from paddle_tpu.distributed.moe import GroupedMLP
+
+    paddle.seed(0)
+    E, M, H = 3, 8, 16
+    mlp = GroupedMLP(E, M, H, activation="gelu")
+    rng = np.random.RandomState(1)
+    sizes = np.array([4, 0, 6])  # includes an empty expert
+    x = rng.randn(int(sizes.sum()), M).astype("float32")
+    out = mlp.forward_ragged(paddle.to_tensor(x),
+                             paddle.to_tensor(sizes.astype("int32"))).numpy()
+
+    # reference: run each expert's slice through its own weights
+    w1 = mlp.w1.numpy(); b1 = mlp.b1.numpy()
+    w2 = mlp.w2.numpy(); b2 = mlp.b2.numpy()
+    import jax
+
+    start = 0
+    for e, n in enumerate(sizes):
+        if n == 0:
+            continue
+        seg = x[start:start + n]
+        h = np.asarray(jax.nn.gelu(seg @ w1[e] + b1[e, 0]))
+        ref = h @ w2[e] + b2[e, 0]
+        np.testing.assert_allclose(out[start:start + n], ref, rtol=2e-4,
+                                   atol=2e-5)
+        start += n

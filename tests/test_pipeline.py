@@ -94,7 +94,7 @@ class TestPipelineForward:
 
 
 class TestTrainParity:
-    @pytest.mark.parametrize("schedule", ["1F1B", "FThenB"])
+    @pytest.mark.parametrize("schedule", ["1F1B", "FThenB", "ZBH1"])
     def test_param_parity_vs_sequential(self, schedule):
         paddle.seed(11)
         pipe = PipelineLayer(_make_descs(), num_stages=4, loss_fn=_mse)
@@ -152,6 +152,79 @@ class TestTrainParity:
         for s in range(4):
             local = [(op, mb) for op, st, mb in log if st == s]
             assert local == expect[s]
+
+
+class TestZeroBubble:
+    """ZB-H1 schedule (ref passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62):
+    backward split into B (activation grads) and W (weight grads)."""
+
+    def test_zbh1_local_orders(self):
+        from paddle_tpu.distributed.pipeline import zero_bubble_order
+
+        order = zero_bubble_order(num_stages=4, num_micro=8)
+        for s in range(4):
+            ops = order[s]
+            assert len(ops) == 3 * 8  # F, B, W per micro
+            # W(mb) strictly after B(mb)
+            for mb in range(8):
+                assert ops.index(("bwd_w", mb)) > ops.index(("bwd_b", mb))
+            # deferral bound: at any prefix, #B - #W <= S-1-s ... +1 slack
+            max_def = 0
+            b = w = 0
+            for op, _mb in ops:
+                b += op == "bwd_b"
+                w += op == "bwd_w"
+                max_def = max(max_def, b - w)
+            assert max_def <= max(4 - 1 - s, 1)
+        # last stage (deferral bound 0) runs F, B, W triplets from the start
+        assert order[3][:6] == [("fwd", 0), ("bwd_b", 0), ("bwd_w", 0),
+                                ("fwd", 1), ("bwd_b", 1), ("bwd_w", 1)]
+        # zero-bubble property: the first stage's cooldown interleaves W
+        # between the trailing B's instead of the 1F1B bubble
+        tail = order[0][-8:]
+        assert ("bwd_w", 7) == tail[-1]
+        assert any(op == "bwd_w" for op, _ in order[0][:-(8 - 4)][-6:])
+
+    def test_zbh1_op_log_dependencies(self):
+        paddle.seed(3)
+        pipe = PipelineLayer(_make_descs(), num_stages=4, loss_fn=_mse)
+        pp = PipelineParallel(pipe, accumulate_steps=8, schedule="ZBH1")
+        opt = SGD(learning_rate=0.01, parameters=pipe.parameters())
+        x = np.random.randn(8, 16).astype("float32")
+        pp.train_batch([paddle.to_tensor(x), paddle.to_tensor(x)], opt)
+
+        log = pp.op_log
+        assert len(log) == 3 * 4 * 8
+        done = set()
+        for op, s, mb in log:
+            if op == "fwd":
+                assert s == 0 or ("fwd", s - 1, mb) in done
+            elif op == "bwd_b":
+                assert ("fwd", s, mb) in done
+                assert s == 3 or ("bwd_b", s + 1, mb) in done
+            else:
+                assert op == "bwd_w"
+                assert ("bwd_b", s, mb) in done
+            done.add((op, s, mb))
+        # per-stage projection equals the canonical ZBH1 local order
+        from paddle_tpu.distributed.pipeline import zero_bubble_order
+
+        expect = zero_bubble_order(4, 8)
+        for s in range(4):
+            local = [(op, mb) for op, st, mb in log if st == s]
+            assert local == expect[s]
+
+    def test_zbh1_from_strategy(self):
+        import paddle_tpu.distributed as dist
+
+        strategy = dist.DistributedStrategy()
+        strategy.pipeline_configs = {"schedule_mode": "ZBH1",
+                                     "accumulate_steps": 4}
+        paddle.seed(3)
+        pipe = PipelineLayer(_make_descs(), num_stages=2, loss_fn=_mse)
+        pp = PipelineParallel(pipe, strategy=strategy)
+        assert pp._schedule == "ZBH1"
+        assert pp._accumulate_steps == 4
 
 
 class TestSharedLayers:

@@ -1,0 +1,94 @@
+"""Auto-tuner + spawn tests (ref: distributed/auto_tuner/tuner.py:21,62,
+prune.py; distributed/spawn.py:463)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed as dist
+
+
+def test_tuner_candidates_pruned_and_ordered():
+    t = dist.AutoTuner(dict(num_devices=8, global_batch_size=8,
+                            hidden_size=2048, num_layers=8, seq_len=1024))
+    seen = []
+    while True:
+        c = t.search_once()
+        if c is None:
+            break
+        seen.append(c)
+    assert seen, "no candidates survived pruning"
+    for c in seen:
+        # divisibility invariants (prune_by_num_gpus / mp / pp / mbs)
+        assert 8 % (c["mp_degree"] * c["pp_degree"]) == 0
+        assert 2048 % c["mp_degree"] == 0
+        assert 8 % c["pp_degree"] == 0
+        dp = c["dp_degree"]
+        assert 8 % (dp * c["micro_batch_size"]) == 0
+        assert c["estimated_memory"] <= 16 * 2 ** 30
+        if c["sharding_stage"] > 0:
+            assert dp > 1
+    # memory-ascending order
+    mems = [c["estimated_memory"] for c in seen]
+    assert mems == sorted(mems)
+
+
+def test_tuner_history_oom_prunes_bigger():
+    t = dist.AutoTuner(dict(num_devices=8, global_batch_size=8,
+                            hidden_size=2048, num_layers=8, seq_len=1024,
+                            task_limit=1000))
+    first = t.search_once()
+    mid = None
+    # walk to a mid-sized candidate and declare it OOM
+    for _ in range(5):
+        mid = t.search_once()
+    t.add_cfg({**mid, "error": "oom"})
+    rest = []
+    while True:
+        c = t.search_once()
+        if c is None:
+            break
+        rest.append(c)
+    assert all(c["estimated_memory"] < mid["estimated_memory"] for c in rest)
+    # best_cfg picks the fastest measured run
+    t.add_cfg({**first, "time": 2.0})
+    t.add_cfg({**mid, "time": 1.0, "error": None})
+    assert t.best_cfg()["time"] == 1.0
+
+
+def test_tuner_respects_task_limit():
+    t = dist.AutoTuner(dict(num_devices=8, global_batch_size=8,
+                            task_limit=3))
+    got = [t.search_once() for _ in range(5)]
+    assert sum(c is not None for c in got) <= 3
+
+
+def _spawn_worker(out_dir):
+    import os
+
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    world = os.environ["PADDLE_TRAINERS_NUM"]
+    master = os.environ["PADDLE_MASTER"]
+    with open(f"{out_dir}/r{rank}.txt", "w") as f:
+        f.write(f"{rank}/{world}@{master}")
+
+
+def _spawn_failer():
+    import os
+
+    if os.environ["PADDLE_TRAINER_ID"] == "1":
+        raise ValueError("boom from rank 1")
+
+
+@pytest.mark.slow
+def test_spawn_runs_and_sets_env(tmp_path):
+    ctx = dist.spawn(_spawn_worker, args=(str(tmp_path),), nprocs=2)
+    assert all(p.exitcode == 0 for p in ctx.processes)
+    texts = sorted((tmp_path / f"r{r}.txt").read_text() for r in range(2))
+    assert texts[0].startswith("0/2@") and texts[1].startswith("1/2@")
+    # both saw the same master
+    assert texts[0].split("@")[1] == texts[1].split("@")[1]
+
+
+@pytest.mark.slow
+def test_spawn_propagates_failure():
+    with pytest.raises(RuntimeError, match="boom from rank 1"):
+        dist.spawn(_spawn_failer, nprocs=2)
