@@ -193,6 +193,11 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     kind = {"sum": "allreduce_sum", "max": "allreduce_max",
             "min": "allreduce_min", "avg": "allreduce_avg"}[op if isinstance(op, str) else "sum"]
     if _multiprocess():
+        if group is not None and group is not _default_group[0]:
+            raise NotImplementedError(
+                "multi-process eager all_reduce supports only the default "
+                "(world) group; sub-group collectives run in-graph via "
+                "shard_map over the hybrid mesh axes")
         out = _cross_process_reduce(arr, kind)
     else:
         spec = PartitionSpec(*([None] * arr.ndim))

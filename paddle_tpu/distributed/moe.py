@@ -261,14 +261,16 @@ class GShardGate(NaiveGate):
 # experts
 # --------------------------------------------------------------------------
 
+def _act_fn(activation: str):
+    if activation == "gelu":  # exact erf gelu (paddle F.gelu default)
+        return lambda v: jax.nn.gelu(v, approximate=False)
+    return getattr(jax.nn, activation)
+
+
 def _grouped_ffn(xe, w1, b1, w2, b2, activation: str):
     """[E, C, M] grouped two-layer FFN on raw arrays — shared by the Layer
     forward and the tape-recorded apply() path."""
-    if activation == "gelu":  # exact erf gelu (paddle F.gelu default)
-        act = lambda v: jax.nn.gelu(v, approximate=False)
-    else:
-        act = getattr(jax.nn, activation)
-    h = act(jnp.einsum("ecm,emh->ech", xe, w1) + b1)
+    h = _act_fn(activation)(jnp.einsum("ecm,emh->ech", xe, w1) + b1)
     return jnp.einsum("ech,ehm->ecm", h, w2) + b2
 
 
@@ -312,12 +314,19 @@ class GroupedMLP(Layer):
         xs = unwrap(x)
         gs = unwrap(group_sizes).astype(jnp.int32)
         T = xs.shape[0]
+        try:  # loud failure beats silently-garbage trailing rows
+            total = int(gs.sum())
+            if total != T:
+                raise ValueError(
+                    f"forward_ragged: group_sizes sums to {total} but x has "
+                    f"{T} tokens")
+        except jax.errors.TracerIntegerConversionError:
+            pass  # traced sizes: shape agreement is the caller's contract
         w1, b1 = unwrap(self.w1), unwrap(self.b1)
         w2, b2 = unwrap(self.w2), unwrap(self.b2)
         b1_tok = jnp.repeat(b1[:, 0], gs, axis=0, total_repeat_length=T)
         b2_tok = jnp.repeat(b2[:, 0], gs, axis=0, total_repeat_length=T)
-        h = jax.lax.ragged_dot(xs, w1, gs) + b1_tok
-        h = getattr(jax.nn, self.activation)(h)
+        h = _act_fn(self.activation)(jax.lax.ragged_dot(xs, w1, gs) + b1_tok)
         out = jax.lax.ragged_dot(h, w2, gs) + b2_tok
         return wrap(out)
 

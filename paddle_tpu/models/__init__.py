@@ -1,20 +1,26 @@
-"""Model zoo: flagship pretraining models (SURVEY §6 workload configs)."""
+"""Model zoo: flagship pretraining models (SURVEY §6 workload configs:
+Llama-3, DeepSeekMoE/Qwen2-MoE, ERNIE; DiT lives in vision.models)."""
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaDecoderLayer
+
+_LAZY = {
+    "llama_moe": ("llama_moe", None),
+    "LlamaMoEConfig": ("llama_moe", "LlamaMoEConfig"),
+    "LlamaMoEForCausalLM": ("llama_moe", "LlamaMoEForCausalLM"),
+    "ernie": ("ernie", None),
+    "ErnieConfig": ("ernie", "ErnieConfig"),
+    "ErnieModel": ("ernie", "ErnieModel"),
+    "ErnieForMaskedLM": ("ernie", "ErnieForMaskedLM"),
+    "ErnieForSequenceClassification": ("ernie", "ErnieForSequenceClassification"),
+    "ErnieForPretraining": ("ernie", "ErnieForPretraining"),
+}
 
 
 def __getattr__(name):
-    if name in ("gpt", "GPTConfig", "GPTForCausalLM"):
-        from . import gpt
+    if name in _LAZY:
+        import importlib
 
-        globals()["gpt"] = gpt
-        if name != "gpt":
-            return getattr(gpt, name)
-        return gpt
-    if name in ("moe", "MoEConfig", "LlamaMoEForCausalLM"):
-        from . import moe as moe_mod
-
-        globals()["moe"] = moe_mod
-        if name != "moe":
-            return getattr(moe_mod, name)
-        return moe_mod
+        mod_name, attr = _LAZY[name]
+        mod = importlib.import_module(f".{mod_name}", __name__)
+        globals()[mod_name] = mod
+        return mod if attr is None else getattr(mod, attr)
     raise AttributeError(f"module 'paddle_tpu.models' has no attribute {name!r}")

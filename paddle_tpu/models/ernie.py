@@ -1,0 +1,209 @@
+"""ERNIE — Baidu's flagship encoder family (BASELINE.json config 2).
+
+Reference anchors: the ERNIE model family the reference platform trains
+(PaddleNLP ernie modeling; the framework-side pieces are the transformer
+stack python/paddle/nn/layer/transformer.py and fused attention kernels).
+Architecture = BERT-style bidirectional encoder: word + position +
+token-type embeddings → LayerNorm/dropout → N TransformerEncoder layers
+(post-norm, GELU) → pooler; heads for masked-LM, sequence classification,
+and pretraining (MLM + NSP).
+
+TPU-native: built entirely from paddle_tpu.nn blocks — every matmul is an
+XLA dot on the MXU, the encoder runs under jit/train_step unchanged, and
+GSPMD shards batch/hidden via the usual mesh annotations.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.layer import Layer
+from ..nn.initializer import Normal
+from ..ops.registry import apply
+from ..tensor_class import Tensor, unwrap, wrap
+
+
+@dataclasses.dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 4
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    dtype: str = "float32"
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=64, type_vocab_size=2)
+        base.update(kw)
+        return ErnieConfig(**base)
+
+
+class ErnieEmbeddings(Layer):
+    """word + position + token_type embeddings (+ LN + dropout)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__(dtype=config.dtype)
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = wrap(jnp.arange(s, dtype=jnp.int32)[None, :])
+        if token_type_ids is None:
+            token_type_ids = wrap(jnp.zeros(
+                (input_ids.shape[0], s), jnp.int32))
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErnieModel(Layer):
+    """Bidirectional encoder + tanh pooler over [CLS]."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        layer = nn.TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size,
+            dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            normalize_before=False)
+        self.encoder = nn.TransformerEncoder(layer, config.num_hidden_layers)
+        self.pooler_dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is None:
+            # mask pad tokens (paddle ernie builds this from pad_token_id)
+            am = apply(
+                "ernie_pad_mask",
+                lambda ids: (ids != self.config.pad_token_id), input_ids,
+                differentiable=False)
+        else:
+            am = attention_mask
+        # additive [B, 1, 1, S] mask for MultiHeadAttention
+        addmask = apply(
+            "ernie_additive_mask",
+            lambda m: jnp.where(m[:, None, None, :].astype(bool), 0.0,
+                                -1e9).astype(jnp.float32),
+            am, differentiable=False)
+        hidden = self.embeddings(input_ids, token_type_ids, position_ids)
+        hidden = self.encoder(hidden, src_mask=addmask)
+        pooled = apply("ernie_pool", lambda h, w, b: jnp.tanh(
+            h[:, 0] @ w + b), hidden, self.pooler_dense.weight,
+            self.pooler_dense.bias)
+        return hidden, pooled
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2):
+        super().__init__(dtype=config.dtype)
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None, **kw):
+        _, pooled = self.ernie(input_ids, token_type_ids, **kw)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        loss = nn.CrossEntropyLoss()(logits, labels)
+        return loss, logits
+
+
+class ErnieLMHead(Layer):
+    """Transform + decode to vocab, weights tied to the word embeddings."""
+
+    def __init__(self, config: ErnieConfig, embedding_weights):
+        super().__init__(dtype=config.dtype)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self._tied = embedding_weights
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size],
+            default_initializer=nn.initializer.Constant(0.0), is_bias=True)
+        self.act = config.hidden_act
+
+    def forward(self, hidden):
+        h = self.layer_norm(getattr(nn.functional, self.act)(
+            self.transform(hidden)))
+        return apply("ernie_mlm_logits",
+                     lambda x, w, b: x @ w.T + b, h, self._tied,
+                     self.decoder_bias)
+
+
+class ErnieForMaskedLM(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.ernie = ErnieModel(config)
+        self.cls = ErnieLMHead(config,
+                               self.ernie.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None, **kw):
+        hidden, _ = self.ernie(input_ids, token_type_ids, **kw)
+        logits = self.cls(hidden)
+        if labels is None:
+            return logits
+
+        loss = apply("ernie_mlm_loss", _mlm_loss, logits, labels)
+        return loss, logits
+
+
+def _mlm_loss(lg, lb):
+    """Masked-token cross entropy; positions with label < 0 are ignored."""
+    import jax
+
+    logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logp, jnp.maximum(lb, 0).astype(jnp.int32)[..., None], -1)[..., 0]
+    mask = (lb >= 0)
+    return -(picked * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+class ErnieForPretraining(Layer):
+    """MLM + next-sentence heads (the classic pretraining objective)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__(dtype=config.dtype)
+        self.mlm = ErnieForMaskedLM(config)
+        self.nsp = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, mlm_labels=None,
+                nsp_labels=None, **kw):
+        hidden, pooled = self.mlm.ernie(input_ids, token_type_ids, **kw)
+        mlm_logits = self.mlm.cls(hidden)
+        nsp_logits = self.nsp(pooled)
+        if mlm_labels is None:
+            return mlm_logits, nsp_logits
+        loss = apply("ernie_mlm_loss", _mlm_loss, mlm_logits, mlm_labels)
+        if nsp_labels is not None:
+            loss = loss + nn.CrossEntropyLoss()(nsp_logits, nsp_labels)
+        return loss, mlm_logits, nsp_logits

@@ -1,0 +1,227 @@
+"""MoE causal LM — the DeepSeekMoE / Qwen2-MoE decoder family.
+
+Reference anchors: the fused MoE machinery the reference serves these models
+with (paddle/phi/kernels/fusion/cutlass/fused_moe_kernel.cu, the
+moe_gate_dispatch SPMD rule paddle/phi/infermeta/spmd_rules/moe_gate_dispatch.cc,
+python/paddle/incubate/distributed/models/moe/moe_layer.py:263) and the
+DeepSeekMoE/Qwen2-MoE configs named in BASELINE.json.
+
+Architecture (DeepSeekMoE): a Llama-style decoder where every layer past
+``first_k_dense_replace`` swaps the dense SwiGLU MLP for
+- ``n_routed_experts`` fine-grained routed experts (top-k, softmax-normalized
+  combine weights) implemented as a GroupedMLP (grouped GEMM, EP-shardable), plus
+- ``n_shared_experts`` always-on shared experts (one fused SwiGLU).
+
+TPU-native: routing/dispatch runs as one pure stage (dense GShard dispatch
+einsums — MXU-friendly, GSPMD-shardable over the ep axis); the attention
+block and norms are reused from models/llama.py unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from .. import nn
+from ..nn.initializer import Constant, Normal, XavierUniform
+from ..ops.registry import apply
+from ..tensor_class import Tensor, unwrap, wrap
+from .llama import (LlamaAttention, LlamaConfig, LlamaMLP, LlamaRMSNorm,
+                    LlamaModel, LlamaForCausalLM)
+
+
+@dataclasses.dataclass
+class LlamaMoEConfig(LlamaConfig):
+    """DeepSeekMoE/Qwen2-MoE knobs on top of the Llama base."""
+
+    n_routed_experts: int = 8
+    n_shared_experts: int = 1
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int = 1408      # per-expert FFN width
+    first_k_dense_replace: int = 1         # leading dense layers (DeepSeek)
+    norm_topk_prob: bool = True            # Qwen2-MoE renormalizes top-k
+    router_aux_loss_coef: float = 0.001
+    moe_capacity_factor: float = 2.0
+
+    @staticmethod
+    def tiny_moe(**kw):
+        base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                    num_hidden_layers=3, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=256,
+                    dtype="float32", n_routed_experts=4,
+                    num_experts_per_tok=2, moe_intermediate_size=64,
+                    first_k_dense_replace=1)
+        base.update(kw)
+        return LlamaMoEConfig(**base)
+
+
+class MoEMLP(Layer):
+    """Routed experts + shared experts (DeepSeekMoE block).
+
+    The routed path is the dense GShard dispatch: router → top-k → capacity
+    positions → [S, E, C] combine/dispatch einsums → grouped FFN → combine.
+    All of it is one pure function per call, so GSPMD shards the expert dim
+    over the ep/data axes and the dispatch einsums become all_to_alls.
+    """
+
+    def __init__(self, config: LlamaMoEConfig):
+        super().__init__(dtype=config.dtype)
+        from ..distributed.moe import GroupedMLP
+
+        self.config = config
+        h = config.hidden_size
+        self.gate_weight = self.create_parameter(
+            [h, config.n_routed_experts],
+            default_initializer=XavierUniform())
+        self.experts = GroupedMLP(config.n_routed_experts, h,
+                                  config.moe_intermediate_size,
+                                  activation="silu")
+        if config.n_shared_experts > 0:
+            shared_cfg = dataclasses.replace(
+                config,
+                intermediate_size=config.moe_intermediate_size
+                * config.n_shared_experts)
+            self.shared_expert = LlamaMLP(shared_cfg)
+        else:
+            self.shared_expert = None
+        self._aux_loss = None
+
+    def forward(self, x):
+        from ..distributed.moe import compute_capacity, one_hot_dispatch
+
+        cfg = self.config
+        b, s, h = x.shape[0], x.shape[1], x.shape[2]
+        k = cfg.num_experts_per_tok
+        E = cfg.n_routed_experts
+
+        def route_and_run(xf, gate_w, w1, b1, w2, b2):
+            tokens = xf.reshape(-1, h)
+            S = tokens.shape[0]
+            logits = (tokens.astype(jnp.float32)
+                      @ gate_w.astype(jnp.float32))
+            probs = jax.nn.softmax(logits, axis=-1)
+            topk_p, topk_idx = jax.lax.top_k(probs, k)
+            if cfg.norm_topk_prob:
+                topk_p = topk_p / jnp.maximum(
+                    topk_p.sum(-1, keepdims=True), 1e-20)
+            # re-scatter the (possibly renormalized) top-k weights to [S, E]
+            weights = jnp.zeros((S, E), probs.dtype).at[
+                jnp.arange(S)[:, None], topk_idx].set(topk_p)
+            cap = compute_capacity(S, E, k, cfg.moe_capacity_factor)
+            combine, dispatch = one_hot_dispatch(weights, topk_idx, cap)
+            # dispatch tokens: [S,E,C] x [S,M] -> [E,C,M]
+            xe = jnp.einsum("sec,sm->ecm", dispatch.astype(tokens.dtype),
+                            tokens)
+            from ..distributed.moe import _grouped_ffn
+
+            ye = _grouped_ffn(xe, w1, b1, w2, b2, "silu")
+            out = jnp.einsum("sec,ecm->sm", combine.astype(ye.dtype), ye)
+            # Switch-style aux loss on the router distribution
+            me = probs.mean(0)
+            ce = jax.nn.one_hot(topk_idx[:, 0], E,
+                                dtype=probs.dtype).mean(0)
+            aux = E * jnp.sum(me * ce)
+            return out.reshape(b, s, h).astype(xf.dtype), aux
+
+        out, aux = apply("moe_mlp", route_and_run, x, self.gate_weight,
+                         self.experts.w1, self.experts.b1,
+                         self.experts.w2, self.experts.b2)
+        self._aux_loss = aux
+        if self.shared_expert is not None:
+            out = out + self.shared_expert(x)
+        return out
+
+
+class LlamaMoEDecoderLayer(Layer):
+    """Llama attention block + (dense | MoE) FFN."""
+
+    def __init__(self, config: LlamaMoEConfig, layer_idx: int):
+        super().__init__(dtype=config.dtype)
+        self.self_attn = LlamaAttention(config)
+        self.is_moe = layer_idx >= config.first_k_dense_replace
+        self.mlp = MoEMLP(config) if self.is_moe else LlamaMLP(config)
+        self.input_layernorm = LlamaRMSNorm(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config)
+
+    def forward(self, hidden_states, cos, sin, attention_mask=None,
+                kv_cache=None):
+        from ..ops.pallas import fused_norm
+
+        residual = hidden_states
+        hidden_states = self.input_layernorm(hidden_states)
+        if kv_cache is not None:
+            hidden_states, kv_cache = self.self_attn(
+                hidden_states, cos, sin, attention_mask, kv_cache)
+        else:
+            hidden_states = self.self_attn(hidden_states, cos, sin,
+                                           attention_mask)
+        eps = self.post_attention_layernorm.variance_epsilon
+        hidden_states, residual = apply(
+            "add_rms_norm",
+            lambda a, r, w: fused_norm.add_rms_norm(a, r, w, eps),
+            hidden_states, residual, self.post_attention_layernorm.weight)
+        hidden_states = residual + self.mlp(hidden_states)
+        if kv_cache is not None:
+            return hidden_states, kv_cache
+        return hidden_states
+
+
+class LlamaMoEModel(LlamaModel):
+    """LlamaModel with MoE decoder layers (embed/rope/norm reused)."""
+
+    def __init__(self, config: LlamaMoEConfig):
+        # build the base with 0 layers, then install MoE layers
+        base_cfg = dataclasses.replace(config, num_hidden_layers=0)
+        super().__init__(base_cfg)
+        self.config = config
+        self.layers = nn.LayerList(
+            [LlamaMoEDecoderLayer(config, i)
+             for i in range(config.num_hidden_layers)])
+
+
+class LlamaMoEForCausalLM(LlamaForCausalLM):
+    """DeepSeekMoE/Qwen2-MoE-style causal LM.
+
+    ``forward(..., labels=...)`` adds ``router_aux_loss_coef`` × the mean
+    Switch aux loss over the MoE layers to the LM loss (load balancing)."""
+
+    def __init__(self, config: LlamaMoEConfig):
+        Layer.__init__(self, dtype=config.dtype)
+        self.config = config
+        self.llama = LlamaMoEModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            from .llama import _make_linear
+
+            self.lm_head = _make_linear(config.hidden_size, config.vocab_size,
+                                        column=True, config=config,
+                                        gather_output=True)
+            self.lm_head.weight._array = (
+                Normal(0.0, config.initializer_range)(
+                    (config.hidden_size, config.vocab_size), jnp.float32)
+                .astype(self.lm_head.weight.dtype))
+
+    def aux_loss(self):
+        losses = [l.mlp._aux_loss for l in self.llama.layers
+                  if getattr(l, "is_moe", False)
+                  and l.mlp._aux_loss is not None]
+        if not losses:
+            return None
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        return total / len(losses)
+
+    def forward(self, input_ids, labels=None, attention_mask=None):
+        out = super().forward(input_ids, labels=labels,
+                              attention_mask=attention_mask)
+        if labels is None:
+            return out
+        loss, logits = out
+        aux = self.aux_loss()
+        if aux is not None:
+            loss = loss + self.config.router_aux_loss_coef * aux
+        return loss, logits

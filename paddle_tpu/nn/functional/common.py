@@ -23,6 +23,23 @@ def linear(x, weight, bias=None, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    # eager-mode bounds check (the reference's CPU/GPU lookup kernels
+    # enforce this): jnp.take's out-of-range NaN fill would otherwise
+    # poison the model silently. Concrete ids only; traced ids rely on
+    # the model feeding valid data (XLA clamps).
+    ids_arr = x._array if hasattr(x, "_array") else x
+    try:
+        vocab = (weight._array if hasattr(weight, "_array") else weight).shape[0]
+        lo = int(jnp.min(ids_arr))
+        hi = int(jnp.max(ids_arr))
+        if lo < 0 or hi >= vocab:
+            raise ValueError(
+                f"embedding ids out of range: [{lo}, {hi}] vs vocab {vocab}")
+    except jax.errors.TracerIntegerConversionError:
+        pass
+    except jax.errors.ConcretizationTypeError:  # pragma: no cover
+        pass
+
     def fn(ids, w):
         out = jnp.take(w, ids.astype(jnp.int32), axis=0)
         if padding_idx is not None:

@@ -13,3 +13,4 @@ from .resnet import (  # noqa: F401
 from .small import (  # noqa: F401
     AlexNet, LeNet, MobileNetV1, MobileNetV2, VGG, alexnet, mobilenet_v1,
     mobilenet_v2, vgg11, vgg13, vgg16, vgg19)
+from .dit import DiT, DiTConfig, dit_xl_2  # noqa: F401

@@ -1,0 +1,222 @@
+"""DiT — Diffusion Transformer (the DiT / Stable-Diffusion-3 family in
+BASELINE.json, trained on the reference platform via PaddleMIX).
+
+Architecture (DiT paper / PaddleMIX ppdiffusers DiTTransformer2DModel):
+patchify the latent image → add fixed sin-cos position embeddings →
+N adaLN-Zero transformer blocks conditioned on (timestep, class) embeddings
+→ adaLN final layer → unpatchify to noise (+ sigma) prediction.
+
+TPU-native: the whole forward is jit-friendly (static shapes, no Python
+control flow on data); attention is plain SDPA over full (bidirectional)
+patch sequences, which XLA maps straight onto the MXU; the adaLN modulation
+is elementwise and fuses into the surrounding matmuls.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import nn
+from ...nn.layer import Layer
+from ...nn.initializer import Constant, Normal, XavierUniform
+from ...ops.registry import apply
+from ...tensor_class import Tensor, unwrap, wrap
+
+
+def _sincos_pos_embed(dim: int, grid: int) -> np.ndarray:
+    """Fixed 2-D sin-cos position embedding [grid*grid, dim] (DiT)."""
+    def one_dim(d, pos):
+        omega = 1.0 / (10000 ** (np.arange(d // 2) / (d / 2.0)))
+        out = np.einsum("p,f->pf", pos, omega)
+        return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+    coords = np.arange(grid, dtype=np.float64)
+    gy, gx = np.meshgrid(coords, coords, indexing="ij")
+    emb = np.concatenate([one_dim(dim // 2, gy.reshape(-1)),
+                          one_dim(dim // 2, gx.reshape(-1))], axis=1)
+    return emb.astype(np.float32)
+
+
+class TimestepEmbedder(Layer):
+    """Sinusoidal timestep features → 2-layer MLP (DiT TimestepEmbedder)."""
+
+    def __init__(self, hidden_size: int, freq_dim: int = 256):
+        super().__init__()
+        self.freq_dim = freq_dim
+        self.mlp1 = nn.Linear(freq_dim, hidden_size)
+        self.mlp2 = nn.Linear(hidden_size, hidden_size)
+
+    def forward(self, t):
+        half = self.freq_dim // 2
+
+        def feats(tt):
+            freqs = jnp.exp(-math.log(10000.0)
+                            * jnp.arange(half, dtype=jnp.float32) / half)
+            args = tt.astype(jnp.float32)[:, None] * freqs[None]
+            return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+        f = apply("dit_t_feats", feats, t, differentiable=False)
+        return self.mlp2(nn.functional.silu(self.mlp1(f)))
+
+
+class LabelEmbedder(Layer):
+    """Class-label embedding with a null class for classifier-free
+    guidance (DiT LabelEmbedder)."""
+
+    def __init__(self, num_classes: int, hidden_size: int):
+        super().__init__()
+        self.embedding_table = nn.Embedding(num_classes + 1, hidden_size)
+        self.num_classes = num_classes
+
+    def forward(self, y):
+        return self.embedding_table(y)
+
+
+class DiTBlock(Layer):
+    """adaLN-Zero block: conditioning regresses per-block shift/scale/gate
+    for both the attention and MLP branches; gates start at zero so the
+    block begins as identity."""
+
+    def __init__(self, hidden_size: int, num_heads: int, mlp_ratio: float = 4.0):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(hidden_size, epsilon=1e-6,
+                                  weight_attr=False, bias_attr=False)
+        self.attn = nn.MultiHeadAttention(hidden_size, num_heads)
+        self.norm2 = nn.LayerNorm(hidden_size, epsilon=1e-6,
+                                  weight_attr=False, bias_attr=False)
+        inner = int(hidden_size * mlp_ratio)
+        self.mlp_fc1 = nn.Linear(hidden_size, inner)
+        self.mlp_fc2 = nn.Linear(inner, hidden_size)
+        self.adaLN = nn.Linear(hidden_size, 6 * hidden_size)
+        # adaLN-Zero init: modulation starts as zeros → identity block
+        self.adaLN.weight._array = jnp.zeros_like(self.adaLN.weight._array)
+        self.adaLN.bias._array = jnp.zeros_like(self.adaLN.bias._array)
+
+    def forward(self, x, c):
+        mod = self.adaLN(nn.functional.silu(c))
+
+        def split6(m):
+            return tuple(jnp.split(m, 6, axis=-1))
+
+        sa, ga, ba, sm, gm, bm = apply("dit_modulation", split6, mod)
+
+        def modulate(h, shift, scale):
+            return apply(
+                "dit_modulate",
+                lambda hh, sh, sc: hh * (1 + sc[:, None]) + sh[:, None],
+                h, shift, scale)
+
+        h = modulate(self.norm1(x), sa, ga)
+        attn_out = self.attn(h, h, h)
+        x = x + apply("dit_gate", lambda a, g: a * g[:, None], attn_out, ba)
+        h = modulate(self.norm2(x), sm, gm)
+        h = self.mlp_fc2(nn.functional.gelu(self.mlp_fc1(h),
+                                            approximate=True))
+        return x + apply("dit_gate", lambda a, g: a * g[:, None], h, bm)
+
+
+class FinalLayer(Layer):
+    def __init__(self, hidden_size: int, patch_size: int, out_channels: int):
+        super().__init__()
+        self.norm = nn.LayerNorm(hidden_size, epsilon=1e-6,
+                                 weight_attr=False, bias_attr=False)
+        self.linear = nn.Linear(hidden_size,
+                                patch_size * patch_size * out_channels)
+        self.adaLN = nn.Linear(hidden_size, 2 * hidden_size)
+        self.adaLN.weight._array = jnp.zeros_like(self.adaLN.weight._array)
+        self.adaLN.bias._array = jnp.zeros_like(self.adaLN.bias._array)
+        self.linear.weight._array = jnp.zeros_like(self.linear.weight._array)
+        self.linear.bias._array = jnp.zeros_like(self.linear.bias._array)
+
+    def forward(self, x, c):
+        mod = self.adaLN(nn.functional.silu(c))
+        shift, scale = apply(
+            "dit_final_mod", lambda m: tuple(jnp.split(m, 2, axis=-1)), mod)
+        x = apply("dit_modulate",
+                  lambda hh, sh, sc: hh * (1 + sc[:, None]) + sh[:, None],
+                  self.norm(x), shift, scale)
+        return self.linear(x)
+
+
+@dataclasses.dataclass
+class DiTConfig:
+    input_size: int = 32          # latent spatial size
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152
+    num_layers: int = 28
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    learn_sigma: bool = True
+
+    @staticmethod
+    def dit_xl_2(**kw):
+        return DiTConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(input_size=8, patch_size=2, in_channels=4,
+                    hidden_size=64, num_layers=2, num_heads=4,
+                    num_classes=10)
+        base.update(kw)
+        return DiTConfig(**base)
+
+
+class DiT(Layer):
+    """DiT noise-prediction network: forward(x_t, t, y) → eps(+sigma)."""
+
+    def __init__(self, config: DiTConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        self.out_channels = c.in_channels * (2 if c.learn_sigma else 1)
+        self.x_embedder = nn.Conv2D(c.in_channels, c.hidden_size,
+                                    kernel_size=c.patch_size,
+                                    stride=c.patch_size)
+        grid = c.input_size // c.patch_size
+        self.num_patches = grid * grid
+        self._pos = jnp.asarray(_sincos_pos_embed(c.hidden_size, grid))
+        self.t_embedder = TimestepEmbedder(c.hidden_size)
+        self.y_embedder = LabelEmbedder(c.num_classes, c.hidden_size)
+        self.blocks = nn.LayerList(
+            [DiTBlock(c.hidden_size, c.num_heads, c.mlp_ratio)
+             for _ in range(c.num_layers)])
+        self.final_layer = FinalLayer(c.hidden_size, c.patch_size,
+                                      self.out_channels)
+
+    def unpatchify(self, x):
+        c = self.config
+        p = c.patch_size
+        grid = c.input_size // p
+        oc = self.out_channels
+
+        def un(arr):
+            b = arr.shape[0]
+            arr = arr.reshape(b, grid, grid, p, p, oc)
+            arr = jnp.einsum("bhwpqc->bchpwq", arr)
+            return arr.reshape(b, oc, grid * p, grid * p)
+
+        return apply("dit_unpatchify", un, x)
+
+    def forward(self, x, t, y):
+        """x [B, C, H, W] latents; t [B] timesteps; y [B] class ids."""
+        patches = self.x_embedder(x)  # [B, hidden, gh, gw]
+        tokens = apply(
+            "dit_patchify",
+            lambda ph, pos: ph.reshape(ph.shape[0], ph.shape[1], -1)
+            .swapaxes(1, 2) + pos[None],
+            patches, self._pos)
+        c = self.t_embedder(t) + self.y_embedder(y)
+        for block in self.blocks:
+            tokens = block(tokens, c)
+        out = self.final_layer(tokens, c)
+        return self.unpatchify(out)
+
+
+def dit_xl_2(**kwargs) -> DiT:
+    return DiT(DiTConfig.dit_xl_2(**kwargs))
