@@ -164,6 +164,33 @@ def _process_mesh():
     return Mesh(grid, ("proc", "dlocal"))
 
 
+def _static_check(arr, op_name: str):
+    """Cross-process shape/dtype agreement check before an eager collective
+    (static_check.cc CheckShape/CheckDataType parity), behind
+    FLAGS_collective_static_check — a desync here otherwise surfaces as a
+    hang or garbage reduction."""
+    from ..utils.flags import flag
+
+    if not flag("FLAGS_collective_static_check"):
+        return
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    # rank-invariant descriptor (padded to MAX_DIMS): if ranks disagreed on
+    # ndim a variable-length descriptor would wedge the agreement check
+    # itself with mismatched gather shapes — the very desync being detected
+    MAX_DIMS = 8
+    shape = list(arr.shape[:MAX_DIMS]) + [0] * (MAX_DIMS - min(arr.ndim, MAX_DIMS))
+    desc = np.array([arr.ndim, np.dtype(arr.dtype).num, *shape], np.int64)
+    try:
+        multihost_utils.assert_equal(
+            desc, f"collective {op_name}: shape/dtype desync across ranks")
+    except Exception as e:
+        raise RuntimeError(
+            f"collective static check failed for {op_name}: ranks disagree "
+            f"on shape/dtype ({e})") from None
+
+
 def _cross_process_reduce(arr, kind):
     """Eager allreduce across PROCESSES: each process contributes its own
     host-local array as one row of a [n_proc, ...] global array sharded
@@ -193,6 +220,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     kind = {"sum": "allreduce_sum", "max": "allreduce_max",
             "min": "allreduce_min", "avg": "allreduce_avg"}[op if isinstance(op, str) else "sum"]
     if _multiprocess():
+        _static_check(arr, "all_reduce")
         if group is not None and group is not _default_group[0]:
             raise NotImplementedError(
                 "multi-process eager all_reduce supports only the default "

@@ -20,3 +20,28 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
 
     return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
                        out_size=out_size)
+
+
+def accuracy_check(x, y, fn_name="accuracy_check", rtol=1e-5, atol=1e-8,
+                   equal_nan=False):
+    """Cross-run tensor comparison (the reference's ``accuracy_check`` op,
+    ops.yaml; CINN accuracy_check_pass role): raises with the max
+    absolute/relative difference when ``x`` and ``y`` diverge."""
+    import numpy as np
+
+    from ..tensor_class import unwrap
+
+    a = np.asarray(unwrap(x))
+    b = np.asarray(unwrap(y))
+    if a.shape != b.shape:
+        raise AssertionError(
+            f"[{fn_name}] shape mismatch: {a.shape} vs {b.shape}")
+    if np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return True
+    diff = np.abs(a.astype(np.float64) - b.astype(np.float64))
+    denom = np.maximum(np.abs(b.astype(np.float64)), 1e-12)
+    idx = np.unravel_index(np.argmax(diff), diff.shape)
+    raise AssertionError(
+        f"[{fn_name}] tensors differ: max_abs_diff={diff.max():.6g} "
+        f"max_rel_diff={(diff / denom).max():.6g} at index {tuple(int(i) for i in idx)} "
+        f"(rtol={rtol}, atol={atol})")

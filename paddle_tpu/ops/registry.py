@@ -158,6 +158,8 @@ def _check_nan_inf(name, out):
     for arr in jax.tree_util.tree_leaves(out):
         if not isinstance(arr, (jax.Array, np.ndarray)):
             continue
+        if isinstance(arr, jax.core.Tracer):
+            continue  # traced (jit/checkify): the compiled-path hook covers it
         if not _dtype_mod.is_inexact_dtype(arr.dtype):
             continue
         if isinstance(arr, jax.Array) and not getattr(arr, "is_fully_addressable", True):

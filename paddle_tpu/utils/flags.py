@@ -87,3 +87,6 @@ define_flag("cudnn_deterministic", False,
             "map to XLA deterministic reductions where applicable")
 define_flag("log_memory_stats", False,
             "log live/peak device memory at step boundaries (memory/stats.cc)")
+define_flag("collective_static_check", False,
+            "verify shape/dtype agreement across processes before eager "
+            "collectives (paddle/phi/core/distributed/check/static_check.cc)")

@@ -28,6 +28,8 @@ jax.config.update("jax_platforms", "cpu")
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 
+import paddle_tpu
+paddle_tpu.set_flags({"FLAGS_collective_static_check": True})
 dist.init_parallel_env()
 assert dist.get_world_size() == 2, dist.get_world_size()
 assert dist.get_rank() == rank
