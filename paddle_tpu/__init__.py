@@ -40,6 +40,7 @@ from .framework.device import (
     CPUPlace,
     TPUPlace,
     CUDAPlace,
+    CUDAPinnedPlace,
 )
 
 from . import ops
@@ -51,7 +52,7 @@ from .ops.creation import (
     empty_like, arange, linspace, logspace, eye, diag, diagflat, tril, triu,
     tril_indices, triu_indices, meshgrid, clone, assign, rand, randn, randint,
     randint_like, uniform, normal, standard_normal, randperm, bernoulli,
-    poisson, multinomial, complex, polar,
+    poisson, multinomial, complex, polar, create_parameter, create_tensor,
 )
 from .ops.math import (
     abs, acos, acosh, asin, asinh, atan, atanh, ceil, cos, cosh, digamma, erf,
@@ -81,12 +82,13 @@ from .ops.manipulation import (
     index_put, masked_select, take, unique, unique_consecutive, nonzero,
     searchsorted, bucketize, as_complex, as_real, atleast_1d, atleast_2d,
     atleast_3d, tensordot, tolist, numel, shard_index, swapaxes, pad,
+    tensor_split, hsplit, vsplit, dsplit, view,
 )
 from .ops.linalg import (
     matmul, mm, dot, bmm, mv, t, cross, dist, norm, trace, diagonal, kron,
     einsum, histogram, bincount,
 )
-from .ops import linalg
+from . import linalg
 from .autograd import backward as _backward_fn
 
 __version__ = "0.1.0"
@@ -190,6 +192,7 @@ _LAZY_SUBMODULES = (
     "fft",
     "signal",
     "geometric",
+    "strings",
 )
 
 
@@ -216,6 +219,256 @@ gammainc = _schema.generated("gammainc")
 gammaincc = _schema.generated("gammaincc")
 i0e = _schema.generated("i0e")
 i1e = _schema.generated("i1e")
+
+# round-3 tensor-surface tail (tensor_method_func parity)
+sinc = _schema.generated("sinc")
+multigammaln = _schema.generated("multigammaln")
+isin = _schema.generated("isin")
+sgn = _schema.generated("sgn")
+frexp = _schema.generated("frexp")
+signbit = _schema.generated("signbit")
+cumulative_trapezoid = _schema.generated("cumulative_trapezoid")
+reduce_as = _schema.generated("reduce_as")
+add_n = _schema.generated("add_n")
+histogram_bin_edges = _schema.generated("histogram_bin_edges")
+block_diag = _schema.generated("block_diag")
+slice_scatter = _schema.generated("slice_scatter")
+select_scatter = _schema.generated("select_scatter")
+diagonal_scatter = _schema.generated("diagonal_scatter")
+masked_scatter = _schema.generated("masked_scatter")
+unflatten = _schema.generated("unflatten")
+cdist = _schema.generated("cdist")
+cholesky_inverse = _schema.generated("cholesky_inverse")
+top_p_sampling = _schema.generated("top_p_sampling")
+bitwise_invert = ops.math.bitwise_not
+less = ops.math.less_than
+
+
+def broadcast_shape(x_shape, y_shape):
+    """paddle.broadcast_shape — pure shape computation (InferMeta analog)."""
+    import numpy as _np
+
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def is_empty(x):
+    """paddle.is_empty: True iff the tensor has zero elements."""
+    import jax.numpy as _jnp
+
+    from .tensor_class import unwrap as _unwrap, wrap as _wrap
+
+    return _wrap(_jnp.asarray(_unwrap(x).size == 0))
+
+
+def rank(x):
+    """paddle.rank: 0-D int tensor holding the rank (ndim) of x."""
+    import jax.numpy as _jnp
+
+    from .tensor_class import unwrap as _unwrap, wrap as _wrap
+
+    return _wrap(_jnp.asarray(_unwrap(x).ndim))
+
+
+def is_complex(x):
+    from .framework.dtype import is_complex_dtype
+    from .tensor_class import unwrap as _unwrap
+
+    return is_complex_dtype(_unwrap(x).dtype)
+
+
+def is_floating_point(x):
+    from .framework.dtype import is_floating_point_dtype
+    from .tensor_class import unwrap as _unwrap
+
+    return is_floating_point_dtype(_unwrap(x).dtype)
+
+
+def is_integer(x):
+    from .framework.dtype import is_integer_dtype
+    from .tensor_class import unwrap as _unwrap
+
+    return is_integer_dtype(_unwrap(x).dtype)
+
+
+# ---- top-level __all__ tail (reference python/paddle/__init__.py parity) -----
+def enable_static():
+    from . import static as _static
+
+    return _static.enable_static()
+
+
+def disable_static():
+    from . import static as _static
+
+    return _static.disable_static()
+
+
+from .ops.manipulation import (  # noqa: E402
+    hstack, vstack, dstack, column_stack, row_stack, cartesian_prod,
+    combinations, shape)
+from .ops.creation import binomial, standard_gamma, log_normal  # noqa: E402
+from .nn.initializer_core import ParamAttr  # noqa: E402
+from .linalg import matrix_transpose  # noqa: E402
+
+pdist = _schema.generated("pdist")
+positive = _schema.generated("positive")
+unfold = _schema.generated("unfold_window")
+diag_embed = linalg.diag_embed
+
+import numpy as _np  # noqa: E402
+
+inf = float("inf")
+newaxis = None
+dtype = _np.dtype          # paddle.dtype: Tensor.dtype instances are np dtypes
+
+
+class _SpecialDType:
+    """Non-numeric VarType sentinel (paddle.pstring / paddle.raw parity —
+    XLA has no such dtypes; these exist for isinstance/label use only)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return f"paddle.{self.name}"
+
+
+pstring = _SpecialDType("pstring")
+raw = _SpecialDType("raw")
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions → numpy printoptions (our repr prints via
+    numpy)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch (python/paddle/batch.py): batch a sample generator."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def check_shape(shape, op_name="", expected_shape_type=(list, tuple),
+                expected_element_type=(int,), expected_tensor_dtype=("int32", "int64")):
+    """paddle.check_shape (base/data_feeder.py): eager mode returns
+    immediately in the reference too — shape errors surface from jnp."""
+    return None
+
+
+def disable_signal_handler():
+    """paddle.disable_signal_handler: the reference uninstalls its C++
+    fatal-signal dumpers; this runtime installs none, so there is nothing
+    to disable (documented no-op)."""
+    return None
+
+
+class LazyGuard:
+    """paddle.LazyGuard parity. Under JAX, parameter arrays are committed
+    lazily by async dispatch and cost no device memory until first use, so
+    eager initialization is already 'lazy' in the sense this guard provides
+    in the reference (delayed allocation); the context manager is kept for
+    API compatibility."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def get_cuda_rng_state():
+    """CUDA-API-name parity: maps to the single framework RNG state."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+def to_dlpack(x):
+    """paddle.utils.dlpack surface: the device array as a dlpack-capable
+    object (modern __dlpack__ protocol — consumers call __dlpack__
+    themselves; the legacy one-shot capsule is deprecated in jax)."""
+    from .tensor_class import unwrap as _unwrap
+
+    return _unwrap(x)
+
+
+def from_dlpack(ext):
+    import jax.numpy as _jnp2
+
+    from .tensor_class import wrap as _wrap
+
+    return _wrap(_jnp2.from_dlpack(ext))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    return x.log_normal_(mean, std)
+
+
+def _install_inplace_functions():
+    """Module-level in-place forms (paddle.log_(x) etc. — the reference
+    exports every Tensor inplace method as a function too)."""
+    g = globals()
+    names = [
+        "abs", "acos", "addmm", "asin", "atan", "bernoulli", "bitwise_and",
+        "bitwise_invert", "bitwise_left_shift", "bitwise_not", "bitwise_or",
+        "bitwise_right_shift", "bitwise_xor", "cast", "cauchy", "ceil",
+        "clip", "copysign", "cos", "cosh", "cumprod", "cumsum", "digamma",
+        "divide", "equal", "erf", "erfinv", "exp", "expm1", "flatten",
+        "floor", "floor_divide", "floor_mod", "frac", "gammainc",
+        "gammaincc", "gammaln", "gcd", "geometric", "greater_equal",
+        "greater_than", "hypot", "i0", "index_fill", "index_put", "lcm",
+        "ldexp", "lerp", "less", "less_equal", "less_than", "lgamma", "log",
+        "log10", "log1p", "log2", "logical_and", "logical_not", "logical_or",
+        "logical_xor", "logit", "masked_fill", "masked_scatter", "mod",
+        "multigammaln", "multiply", "nan_to_num", "neg", "normal",
+        "not_equal", "polygamma", "pow", "put_along_axis", "reciprocal",
+        "remainder", "renorm", "reshape", "round", "rsqrt", "scale",
+        "scatter", "sigmoid", "sign", "sin", "sinc", "sinh", "sqrt",
+        "square", "squeeze", "subtract", "t", "tan", "tanh", "transpose",
+        "tril", "triu", "trunc", "uniform", "unsqueeze", "where", "add",
+        "exponential",
+    ]
+    for name in names:
+        meth = name + "_"
+        if not hasattr(Tensor, meth):
+            continue
+
+        def fn(x, *a, _m=meth, **k):
+            return getattr(x, _m)(*a, **k)
+
+        fn.__name__ = meth
+        fn.__doc__ = (f"In-place function form of Tensor.{meth} "
+                      "(reference exports both)")
+        g.setdefault(meth, fn)
+
+
+_install_inplace_functions()
 
 
 def _finalize_schema():

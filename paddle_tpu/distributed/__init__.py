@@ -10,6 +10,7 @@ Reference parity map (SURVEY.md §2.5-2.7):
 - distributed checkpoint → checkpoint.py; launcher → launch/
 """
 from . import env
+from .log_utils import get_logger, log_on_rank
 from .env import (
     get_rank, get_world_size, init_parallel_env, is_initialized,
 )

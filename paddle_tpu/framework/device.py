@@ -91,6 +91,7 @@ def TPUPlace(idx=0):
 
 
 CUDAPlace = TPUPlace  # API-compat alias: "the accelerator place"
+CUDAPinnedPlace = CPUPlace  # pinned host memory: host-side arrays on TPU
 
 
 def synchronize():

@@ -3,7 +3,30 @@ the linear-algebra surface lives in ops/linalg.py; this module mirrors the
 reference's public module layout."""
 from .ops.linalg import (  # noqa: F401
     cholesky, cholesky_solve, cond, corrcoef, cov, det, diag_embed, diagonal,
-    eig, eigh, eigvals, eigvalsh, householder_product, inverse as inv, kron,
+    eig, eigh, eigvals, eigvalsh, householder_product, inverse, inverse as inv, kron,
     lstsq, lu, lu_unpack, matmul, matrix_norm, matrix_power, matrix_rank,
     multi_dot, norm, pinv, qr, slogdet, solve, svd, svdvals,
     triangular_solve, vector_norm)
+
+from .ops import schema as _schema  # noqa: E402
+
+ormqr = _schema.generated("ormqr")
+cholesky_inverse = _schema.generated("cholesky_inverse")
+svd_lowrank = _schema.generated("svd_lowrank")
+pca_lowrank = _schema.generated("pca_lowrank")
+cdist = _schema.generated("cdist")
+
+
+def matrix_transpose(x, name=None):
+    """paddle.linalg.matrix_transpose: swap the last two axes."""
+    from .ops.manipulation import swapaxes
+
+    return swapaxes(x, -1, -2)
+
+
+def matrix_exp(x, name=None):
+    """paddle.linalg.matrix_exp via jax.scipy.linalg.expm."""
+    from .ops.registry import apply
+    import jax.scipy.linalg as _jsl
+
+    return apply("matrix_exp", lambda a: _jsl.expm(a), x)

@@ -138,6 +138,137 @@ def _install_tensor_methods():
     T.uniform_ = creation.uniform_
     T.normal_ = creation.normal_
 
+    _install_method_tail(T)
+
+
+def _lazy_method(name, module_path=None):
+    """Tensor method resolved from the public namespace at call time — keeps
+    the method table complete (tensor_method_func parity) without forcing
+    lazy submodules (linalg/signal) to import at package-init time."""
+
+    def method(self, *a, **k):
+        import paddle_tpu as root
+
+        obj = root
+        if module_path:
+            for part in module_path.split("."):
+                obj = getattr(obj, part)
+        return getattr(obj, name)(self, *a, **k)
+
+    method.__name__ = name
+    return method
+
+
+def _install_method_tail(T):
+    """Round-3 method-table tail: attach every remaining public op as a
+    Tensor method + generate the in-place (`op_`) variants.
+
+    Parity: python/paddle/tensor/__init__.py tensor_method_func + the
+    ops.yaml ``inplace:`` maps."""
+    # public fns (top-level namespace) attached as methods
+    for name in [
+        "histogramdd", "increment", "multiplex", "floor_mod", "isneginf",
+        "isposinf", "isreal", "gammaincc", "gammainc", "concat", "reverse",
+        "stack", "nanquantile", "broadcast_tensors", "as_complex", "as_real",
+        "bucketize", "trapezoid", "polar", "nextafter", "i0", "i0e", "i1",
+        "i1e", "polygamma", "multinomial", "renorm", "bitwise_left_shift",
+        "bitwise_right_shift", "atleast_1d", "atleast_2d", "atleast_3d",
+        "sinc", "multigammaln", "isin", "sgn", "frexp", "signbit",
+        "cumulative_trapezoid", "reduce_as", "histogram_bin_edges",
+        "slice_scatter", "select_scatter", "diagonal_scatter",
+        "masked_scatter", "unflatten", "cdist", "cholesky_inverse",
+        "top_p_sampling", "bitwise_invert", "less", "is_empty", "rank",
+        "is_complex", "is_floating_point", "is_integer", "tensor_split",
+        "hsplit", "vsplit", "dsplit", "view", "block_diag", "add_n",
+        "is_tensor", "scatter_nd", "shard_index", "broadcast_shape",
+        "create_parameter", "create_tensor",
+    ]:
+        if not hasattr(T, name):
+            setattr(T, name, _lazy_method(name))
+    # linalg / signal residents
+    for name in ["cov", "corrcoef", "cond", "lstsq", "householder_product",
+                 "eigvalsh", "multi_dot", "cholesky_solve",
+                 "triangular_solve", "lu", "lu_unpack", "diag_embed",
+                 "ormqr", "pca_lowrank", "svd_lowrank"]:
+        if not hasattr(T, name):
+            setattr(T, name, _lazy_method(name, "linalg"))
+    for name in ["stft", "istft"]:
+        if not hasattr(T, name):
+            setattr(T, name, _lazy_method(name, "signal"))
+
+    # in-place variants of existing methods (inplace: map parity); the
+    # comparison/cast entries change dtype, matching the reference's
+    # type-promoting inplace ops
+    def _make_inplace_lazy(name):
+        def method(self, *a, **k):
+            out = getattr(T, name)(self, *a, **k)
+            return registry.inplace_swap(self, out)
+
+        method.__name__ = name + "_"
+        return method
+
+    for name in [
+        "asin", "cumsum", "cumprod", "logit", "log", "log2", "log10",
+        "square", "nan_to_num", "hypot", "floor_divide", "mod", "floor_mod",
+        "log1p", "addmm", "neg", "lgamma", "gammaincc", "gammainc", "equal",
+        "greater_equal", "greater_than", "less_equal", "less_than", "less",
+        "logical_and", "logical_not", "logical_or", "logical_xor",
+        "not_equal", "cast", "transpose", "tan", "where", "gammaln",
+        "digamma", "trunc", "frac", "bitwise_and", "bitwise_or",
+        "bitwise_xor", "bitwise_not", "bitwise_invert", "atanh", "gcd",
+        "lcm", "erfinv", "put_along_axis", "bernoulli", "index_put", "ldexp",
+        "i0", "polygamma", "masked_fill", "renorm", "tril", "triu", "acos",
+        "atan", "cos", "cosh", "sin", "sinh", "acosh", "asinh", "copysign",
+        "bitwise_left_shift", "bitwise_right_shift", "index_fill", "t",
+        "sinc", "multigammaln", "masked_scatter", "erf", "expm1",
+    ]:
+        if not hasattr(T, name + "_"):
+            setattr(T, name + "_", _make_inplace_lazy(name))
+
+    # random-fill in-place methods (paddle Tensor.cauchy_ etc.)
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework import random as _random
+
+    def _fill_from(sampler):
+        def method(self, *a, **k):
+            key = _random.next_key()
+            arr = self._array
+            self._array = sampler(key, arr, *a, **k).astype(arr.dtype)
+            return self
+
+        return method
+
+    def _cauchy(key, arr, loc=0.0, scale=1.0):
+        u = jax.random.uniform(key, arr.shape, jnp.float32, 1e-7, 1 - 1e-7)
+        return loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+
+    def _geometric(key, arr, probs=0.5):
+        u = jax.random.uniform(key, arr.shape, jnp.float32, 1e-7, 1 - 1e-7)
+        return jnp.ceil(jnp.log1p(-u) / jnp.log1p(-probs))
+
+    def _exponential(key, arr, lam=1.0):
+        return jax.random.exponential(key, arr.shape, jnp.float32) / lam
+
+    def _log_normal(key, arr, mean=1.0, std=2.0):
+        return jnp.exp(mean + std * jax.random.normal(key, arr.shape, jnp.float32))
+
+    T.cauchy_ = _fill_from(_cauchy)
+    T.geometric_ = _fill_from(_geometric)
+    T.exponential_ = _fill_from(_exponential)
+    T.log_normal_ = _fill_from(_log_normal)
+
+    def _set_(self, source=None):
+        """Tensor.set_: rebind payload to source's (or empty)."""
+        if source is None:
+            self._array = jnp.zeros((0,), self._array.dtype)
+        else:
+            self._array = unwrap(source)
+        return self
+
+    T.set_ = _set_
+
 
 def _coerce(o, like):
     import jax

@@ -241,3 +241,60 @@ def uniform_(tensor, min=-1.0, max=1.0):
     key = _random.next_key()
     tensor._array = jax.random.uniform(key, tensor._array.shape, dtype=tensor._array.dtype, minval=min, maxval=max)
     return tensor
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """paddle.create_parameter (python/paddle/tensor/creation.py): a fresh
+    trainable Parameter; default init is Xavier-normal for weights, zeros
+    for biases (the reference's ParamAttr defaults)."""
+    from ..tensor_class import Parameter
+
+    dt = _dtype_mod.convert_dtype(dtype)
+    shape = tuple(int(unwrap(s)) for s in shape)
+    if default_initializer is not None:
+        init = unwrap(default_initializer(shape, dt))
+    elif is_bias:
+        init = jnp.zeros(shape, dt)
+    else:
+        fan_in = shape[0] if shape else 1
+        fan_out = shape[-1] if len(shape) > 1 else 1
+        std = float(np.sqrt(2.0 / max(fan_in + fan_out, 1)))
+        init = std * jax.random.normal(_random.next_key(), shape, jnp.float32)
+    p = Parameter(jnp.asarray(init, dt))
+    if name:
+        p.name = name
+    return p
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """paddle.create_tensor: an empty (0-element) tensor of the dtype."""
+    t = wrap(jnp.zeros((0,), _dtype_mod.convert_dtype(dtype)))
+    if name:
+        t.name = name
+    return t
+
+
+def binomial(count, prob, name=None):
+    """paddle.binomial (ops.yaml `binomial`): per-element binomial draws."""
+    key = _random.next_key()
+    c = jnp.asarray(unwrap(count))
+    p = jnp.asarray(unwrap(prob))
+    out = jax.random.binomial(key, c.astype(jnp.float32),
+                              p.astype(jnp.float32))
+    return wrap(out.astype(_dtype_mod.convert_dtype("int64")))
+
+
+def standard_gamma(x, name=None):
+    """paddle.standard_gamma: Gamma(alpha, 1) draws, alpha = x."""
+    key = _random.next_key()
+    a = jnp.asarray(unwrap(x))
+    return wrap(jax.random.gamma(key, a))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    """paddle.log_normal: exp(N(mean, std)) of the given shape."""
+    key = _random.next_key()
+    dt = _dtype_mod.convert_dtype(dtype or _dtype_mod.get_default_dtype())
+    shape = tuple(int(unwrap(s)) for s in (shape or (1,)))
+    return wrap(jnp.exp(mean + std * jax.random.normal(key, shape)).astype(dt))

@@ -477,3 +477,108 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         return jnp.where(in_range, a - lo, ignore_value)
 
     return apply("shard_index", fn, input, differentiable=False)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """paddle.tensor_split (ops.yaml has no kernel — python/paddle/tensor/
+    manipulation.py tensor_split): like split but tolerates uneven division
+    (numpy array_split semantics)."""
+    axis = int(unwrap(axis))
+    if isinstance(num_or_indices, int):
+        pieces = np.array_split(np.arange(unwrap(x).shape[axis]),
+                                num_or_indices)
+        offsets = np.cumsum([len(p) for p in pieces])[:-1].tolist()
+    else:
+        offsets = [int(unwrap(i)) for i in num_or_indices]
+    out = apply("tensor_split",
+                lambda a: tuple(jnp.split(a, offsets, axis=axis)), x)
+    return list(out)
+
+
+def hsplit(x, num_or_indices, name=None):
+    """paddle.hsplit: column split (axis 1 for ndim>=2, else axis 0)."""
+    return tensor_split(x, num_or_indices, axis=1 if unwrap(x).ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def view(x, shape_or_dtype, name=None):
+    """paddle.view: zero-copy reshape (negative-one aware) or dtype bitcast.
+
+    Parity: python/paddle/tensor/manipulation.py `view` — under XLA both
+    forms lower to metadata-only ops."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    target = _dtype_mod.convert_dtype(shape_or_dtype)
+    def fn(a):
+        item_in = jnp.dtype(a.dtype).itemsize
+        item_out = jnp.dtype(target).itemsize
+        if item_in == item_out:
+            return jax.lax.bitcast_convert_type(a, target)
+        if item_out < item_in:
+            # narrowing: XLA appends a ratio axis; fold it into the last dim
+            out = jax.lax.bitcast_convert_type(a, target)
+            return out.reshape(a.shape[:-1] + (-1,))
+        # widening: XLA consumes a trailing axis equal to the ratio — split
+        # the last dim first (last dim must divide the itemsize ratio)
+        ratio = item_out // item_in
+        if a.shape[-1] % ratio:
+            raise ValueError(
+                f"view: last dim {a.shape[-1]} not divisible by dtype "
+                f"ratio {ratio}")
+        split = a.reshape(a.shape[:-1] + (a.shape[-1] // ratio, ratio))
+        return jax.lax.bitcast_convert_type(split, target)
+    return apply("view_dtype", fn, x, differentiable=False)
+
+
+def hstack(x, name=None):
+    """paddle.hstack (python/paddle/tensor/manipulation.py)."""
+    return apply("hstack", lambda *xs: jnp.hstack(xs), *x)
+
+
+def vstack(x, name=None):
+    return apply("vstack", lambda *xs: jnp.vstack(xs), *x)
+
+
+def dstack(x, name=None):
+    return apply("dstack", lambda *xs: jnp.dstack(xs), *x)
+
+
+def column_stack(x, name=None):
+    return apply("column_stack", lambda *xs: jnp.column_stack(xs), *x)
+
+
+def row_stack(x, name=None):
+    return apply("row_stack", lambda *xs: jnp.vstack(xs), *x)
+
+
+def cartesian_prod(x, name=None):
+    """paddle.cartesian_prod: cartesian product of 1-D tensors."""
+    def fn(*xs):
+        grids = jnp.meshgrid(*xs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1) \
+            if len(xs) > 1 else xs[0].reshape(-1, 1).reshape(-1)
+    return apply("cartesian_prod", fn, *x)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """paddle.combinations: r-length index combinations of a 1-D tensor
+    (index set is static — computed host-side, gathered on device)."""
+    import itertools
+
+    n = unwrap(x).shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(it), dtype=np.int32).reshape(-1, r)
+    return apply("combinations", lambda a: a[jnp.asarray(idx)], x)
+
+
+def shape(x, name=None):
+    """paddle.shape: the shape as a 1-D int32 tensor."""
+    return wrap(jnp.asarray(unwrap(x).shape, dtype=jnp.int32))

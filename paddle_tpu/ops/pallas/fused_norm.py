@@ -29,6 +29,16 @@ def _on_tpu() -> bool:
         return False
 
 
+def _pick_block_rows(rows: int, d: int) -> int:
+    """Largest row-block with block*d ≤ 512K elements (≈2 MB f32 per ref) —
+    the f32 intermediates of 4-5 refs must fit the ~16 MB scoped-VMEM stack
+    (observed OOM at d=4096 with a fixed 256-row block)."""
+    block = 256
+    while block > 8 and (block * d > 512 * 1024 or rows % block):
+        block //= 2
+    return block
+
+
 # ---------------- fused RMSNorm ----------------------------------------------
 
 def _rmsnorm_fwd_kernel(x_ref, w_ref, o_ref, *, eps):
@@ -66,7 +76,7 @@ def rms_norm(x, weight, eps=1e-6):
     rows = 1
     for s in x.shape[:-1]:
         rows *= s
-    block = 256
+    block = _pick_block_rows(rows, d)
     if d % 128 == 0 and rows % block == 0 and _HAS_PLTPU:
         out2d = _rmsnorm_pallas(x.reshape(rows, d), weight, eps, block)
         return out2d.reshape(x.shape)
@@ -109,7 +119,7 @@ def add_rms_norm(x, residual, weight, eps=1e-6):
     rows = 1
     for s in x.shape[:-1]:
         rows *= s
-    block = 256
+    block = _pick_block_rows(rows, d)
     if d % 128 == 0 and rows % block == 0 and _HAS_PLTPU:
         kernel = functools.partial(_add_rmsnorm_kernel, eps=eps)
         out2d, h2d = pl.pallas_call(

@@ -413,6 +413,241 @@ def _margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
     return loss
 
 
+# ---- tensor-surface tail (round 3: tensor_method_func parity) ---------------
+
+def _sinc(x):
+    """paddle.sinc (ops.yaml `sinc`): sin(pi x)/(pi x), 1 at 0."""
+    return jnp.sinc(x)
+
+
+def _multigammaln(x, p):
+    return jsp.multigammaln(x, p)
+
+
+def _isin(x, test_x, assume_unique=False, invert=False):
+    return jnp.isin(x, test_x, invert=invert)
+
+
+def _sgn(x):
+    """paddle.sgn: complex-aware sign (x/|x|, 0 at 0)."""
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.where(mag == 0, 1.0, mag))
+    return jnp.sign(x)
+
+
+def _frexp(x):
+    return jnp.frexp(x)
+
+
+def _signbit(x):
+    return jnp.signbit(x)
+
+
+def _cumulative_trapezoid(y, x=None, dx=1.0, axis=-1):
+    """paddle.cumulative_trapezoid (ops.yaml `cumulative_trapezoid`)."""
+    y0 = jnp.moveaxis(y, axis, -1)
+    avg = (y0[..., 1:] + y0[..., :-1]) / 2
+    if x is not None:
+        xs = jnp.moveaxis(jnp.broadcast_to(x, y.shape), axis, -1) \
+            if jnp.ndim(x) > 1 else jnp.asarray(x)
+        avg = avg * jnp.diff(xs, axis=-1)
+    else:
+        avg = avg * dx
+    return jnp.moveaxis(jnp.cumsum(avg, -1), -1, axis)
+
+
+def _reduce_as(x, target):
+    """paddle.reduce_as (ops.yaml `reduce_as`): sum x down to target's shape
+    (the broadcast inverse)."""
+    tshape = jnp.shape(target)
+    extra = len(jnp.shape(x)) - len(tshape)
+    out = jnp.sum(x, axis=tuple(range(extra))) if extra else x
+    keep = tuple(i for i, (a, b) in enumerate(zip(jnp.shape(out), tshape))
+                 if a != b and b == 1)
+    return jnp.sum(out, axis=keep, keepdims=True) if keep else out
+
+
+def _add_n(inputs):
+    """paddle.add_n (ops.yaml `add_n`): elementwise sum of a tensor list."""
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = out + x
+    return out
+
+
+def _histogram_bin_edges(x, bins=100, min=0.0, max=0.0):
+    rng = None if (min == 0.0 and max == 0.0) else (min, max)
+    return jnp.histogram_bin_edges(x, bins=bins, range=rng)
+
+
+def _block_diag(inputs):
+    """paddle.block_diag (ops.yaml `block_diag`)."""
+    mats = [jnp.atleast_2d(x) for x in inputs]
+    rows = sum(m.shape[0] for m in mats)
+    cols = sum(m.shape[1] for m in mats)
+    out = jnp.zeros((rows, cols), mats[0].dtype)
+    r = c = 0
+    for m in mats:
+        out = out.at[r:r + m.shape[0], c:c + m.shape[1]].set(m)
+        r += m.shape[0]
+        c += m.shape[1]
+    return out
+
+
+def _slice_scatter(x, value, axes, starts, ends, strides):
+    """paddle.slice_scatter (ops.yaml `slice_scatter`)."""
+    idx = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = slice(s, e, st)
+    return x.at[tuple(idx)].set(value)
+
+
+def _select_scatter(x, value, axis, index):
+    """paddle.select_scatter: write `value` into slice `index` along axis."""
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(value)
+
+
+def _diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    """paddle.diagonal_scatter (ops.yaml `diagonal_scatter`)."""
+    moved = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    m, n = moved.shape[-2], moved.shape[-1]
+    rows = jnp.arange(m)[:, None]
+    cols = jnp.arange(n)[None, :]
+    on_diag = (cols - rows) == offset
+    dlen = min(m, n - offset) if offset >= 0 else min(m + offset, n)
+    start = (0, offset) if offset >= 0 else (-offset, 0)
+    scat = jnp.zeros_like(moved)
+    ii = jnp.arange(dlen) + start[0]
+    jj = jnp.arange(dlen) + start[1]
+    scat = scat.at[..., ii, jj].set(y)
+    return jnp.moveaxis(jnp.where(on_diag, scat, moved), (-2, -1),
+                        (axis1, axis2))
+
+
+def _masked_scatter(x, mask, value):
+    """paddle.masked_scatter (ops.yaml `masked_scatter`): fill True positions
+    of mask with consecutive elements of value (static-shape scatter via
+    cumsum indexing — TPU-friendly, no data-dependent shapes)."""
+    m = jnp.broadcast_to(mask, x.shape).reshape(-1)
+    flatx = x.reshape(-1)
+    src = value.reshape(-1)
+    pos = jnp.cumsum(m.astype(jnp.int32)) - 1
+    take = jnp.clip(pos, 0, src.shape[0] - 1)
+    return jnp.where(m, src[take], flatx).reshape(x.shape)
+
+
+def _unflatten(x, axis, shape):
+    """paddle.unflatten: split one axis into the given shape."""
+    axis = axis % x.ndim
+    shape = tuple(int(s) for s in shape)
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape = tuple(x.shape[axis] // known if s == -1 else s for s in shape)
+    return x.reshape(x.shape[:axis] + shape + x.shape[axis + 1:])
+
+
+def _cdist(x, y, p=2.0):
+    """paddle.cdist (ops.yaml `cdist`): batched pairwise p-norm distances."""
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        # 1e-30 floor: sqrt'(0) = inf would NaN the backward pass at
+        # coincident points (subgradient-0 convention, same as _pdist)
+        return jnp.sqrt(jnp.maximum((diff * diff).sum(-1), 1e-30))
+    if p == float("inf"):
+        return jnp.abs(diff).max(-1)
+    if p == 0.0:
+        return (diff != 0).astype(x.dtype).sum(-1)
+    ad = jnp.abs(diff)
+    return jnp.power(jnp.power(ad, p).sum(-1), 1.0 / p)
+
+
+def _cholesky_inverse(x, upper=False):
+    """paddle.cholesky_inverse: inverse from a Cholesky factor."""
+    import jax.scipy.linalg as jsl
+
+    eye = jnp.eye(x.shape[-1], dtype=x.dtype)
+    return jsl.cho_solve((x, not upper), eye)
+
+
+def _ormqr(x, tau, other, left=True, transpose=False):
+    """paddle.linalg.ormqr: multiply `other` by the full implicit Q of a
+    geqrf factorization (householder product on the zero-padded factor gives
+    the m×m Q, then a plain matmul — MXU-friendly)."""
+    m, n = x.shape[-2], x.shape[-1]
+    if m > n:
+        pad_cols = jnp.zeros(x.shape[:-1] + (m - n,), x.dtype)
+        xf = jnp.concatenate([x, pad_cols], axis=-1)
+        tf = jnp.concatenate(
+            [tau, jnp.zeros(tau.shape[:-1] + (m - n,), tau.dtype)], axis=-1)
+    else:
+        xf, tf = x, tau
+    q = jax.lax.linalg.householder_product(xf, tf)  # [..., m, m]
+    qm = jnp.swapaxes(q, -1, -2) if transpose else q
+    return qm @ other if left else other @ qm
+
+
+def _svd_lowrank(x, q=6, niter=2):
+    """paddle.linalg.svd_lowrank: deterministic truncation of full SVD (the
+    randomized sketch buys nothing at these sizes on TPU — the full SVD is
+    one XLA call)."""
+    u, s, vh = jnp.linalg.svd(x, full_matrices=False)
+    return u[..., :q], s[..., :q], jnp.swapaxes(vh, -1, -2)[..., :q]
+
+
+def _pca_lowrank(x, q=None, center=True, niter=2):
+    """paddle.linalg.pca_lowrank."""
+    k = q if q is not None else min(6, *x.shape[-2:])
+    if center:
+        x = x - x.mean(-2, keepdims=True)
+    u, s, v = _svd_lowrank(x, q=k)
+    return u, s, v
+
+
+def _pdist(x, p=2.0):
+    """paddle.pdist (ops.yaml `pdist`): condensed pairwise distances of the
+    rows of x (computed on the i<j pairs only — routing through cdist would
+    send gradient through the zero diagonal's sqrt(0) and produce NaNs)."""
+    n = x.shape[0]
+    ii, jj = jnp.triu_indices(n, k=1)
+    diff = x[ii] - x[jj]
+    if p == 2.0:
+        return jnp.sqrt(jnp.maximum((diff * diff).sum(-1), 1e-30))
+    if p == float("inf"):
+        return jnp.abs(diff).max(-1)
+    ad = jnp.abs(diff)
+    return jnp.power(jnp.power(ad, p).sum(-1), 1.0 / p)
+
+
+def _positive(x):
+    """paddle.positive: +x (identity for numeric dtypes)."""
+    return jnp.positive(x)
+
+
+def _top_p_sampling(x, ps, threshold=None, seed=None):
+    """paddle.tensor.top_p_sampling (ops.yaml `top_p_sampling`): nucleus
+    sampling. Returns (values, indices) of the sampled token per row."""
+    from ..framework import random as _random
+
+    sorted_idx = jnp.argsort(-x, -1)
+    sorted_probs = jnp.take_along_axis(jax.nn.softmax(x, -1), sorted_idx, -1)
+    cum = jnp.cumsum(sorted_probs, -1)
+    keep = cum - sorted_probs < jnp.reshape(ps, (-1, 1))
+    keep = keep.at[..., 0].set(True)
+    masked = jnp.where(keep, sorted_probs, 0.0)
+    masked = masked / masked.sum(-1, keepdims=True)
+    key = jax.random.key(seed) if seed not in (None, -1) else _random.next_key()
+    choice = jax.random.categorical(key, jnp.log(jnp.maximum(masked, 1e-38)))
+    idx = jnp.take_along_axis(sorted_idx, choice[..., None], -1)
+    val = jnp.take_along_axis(x, idx, -1)
+    return val, idx
+
+
 # ---------------------------------------------------------------------------
 # The declarations table (ops.yaml analog)
 # ---------------------------------------------------------------------------
@@ -455,6 +690,40 @@ DECLS = [
            "nn", spmd="batch"),
     OpDecl("npair_loss", _npair_loss, "nn", spmd="batch"),
     OpDecl("margin_cross_entropy", _margin_cross_entropy, "nn", spmd="batch"),
+    # tensor-surface tail (tensor_method_func parity, round 3)
+    OpDecl("sinc", _sinc, "special", spmd="elementwise"),
+    OpDecl("multigammaln", _multigammaln, "special", spmd="elementwise",
+           dtypes=("float32", "float64")),
+    OpDecl("isin", _isin, "math", differentiable=False, spmd="elementwise"),
+    OpDecl("sgn", _sgn, "math", spmd="elementwise"),
+    OpDecl("frexp", _frexp, "math", differentiable=False,
+           spmd="elementwise", n_outputs=2),
+    OpDecl("signbit", _signbit, "math", differentiable=False,
+           spmd="elementwise"),
+    OpDecl("cumulative_trapezoid", _cumulative_trapezoid, "math"),
+    OpDecl("reduce_as", _reduce_as, "math", spmd="reduce"),
+    OpDecl("add_n", _add_n, "math", spmd="elementwise"),
+    OpDecl("histogram_bin_edges", _histogram_bin_edges, "math",
+           differentiable=False, spmd="replicated"),
+    OpDecl("block_diag", _block_diag, "manipulation", spmd="replicated"),
+    OpDecl("slice_scatter", _slice_scatter, "manipulation"),
+    OpDecl("select_scatter", _select_scatter, "manipulation"),
+    OpDecl("diagonal_scatter", _diagonal_scatter, "manipulation"),
+    OpDecl("masked_scatter", _masked_scatter, "manipulation"),
+    OpDecl("unflatten", _unflatten, "manipulation", spmd="elementwise"),
+    OpDecl("cdist", _cdist, "linalg", spmd="batch"),
+    OpDecl("cholesky_inverse", _cholesky_inverse, "linalg",
+           spmd="replicated", dtypes=("float32", "float64")),
+    OpDecl("ormqr", _ormqr, "linalg", spmd="replicated",
+           dtypes=("float32", "float64")),
+    OpDecl("svd_lowrank", _svd_lowrank, "linalg", differentiable=False,
+           spmd="replicated", dtypes=("float32", "float64"), n_outputs=3),
+    OpDecl("pca_lowrank", _pca_lowrank, "linalg", differentiable=False,
+           spmd="replicated", dtypes=("float32", "float64"), n_outputs=3),
+    OpDecl("top_p_sampling", _top_p_sampling, "random",
+           differentiable=False, spmd="batch", n_outputs=2),
+    OpDecl("pdist", _pdist, "linalg", spmd="batch"),
+    OpDecl("positive", _positive, "math", spmd="elementwise"),
 ]
 
 _GENERATED = {}
@@ -739,6 +1008,23 @@ RETROFITS = [
     Retrofit("standard_normal", "standard_normal", "random",
              differentiable=False,
              tested_by=_TT + "test_random_seed_reproducible"),
+    # round-3 top-level tail
+    Retrofit("hstack", "hstack", "manipulation"),
+    Retrofit("vstack", "vstack", "manipulation"),
+    Retrofit("dstack", "dstack", "manipulation"),
+    Retrofit("column_stack", "column_stack", "manipulation"),
+    Retrofit("row_stack", "row_stack", "manipulation"),
+    Retrofit("cartesian_prod", "cartesian_prod", "manipulation"),
+    Retrofit("combinations", "combinations", "manipulation"),
+    Retrofit("shape", "shape", "manipulation", differentiable=False,
+             tested_by=_TT + "test_shape_op"),
+    Retrofit("binomial", "binomial", "random", differentiable=False,
+             tested_by=_TT + "test_random_samplers_round3"),
+    Retrofit("standard_gamma", "standard_gamma", "random",
+             differentiable=False,
+             tested_by=_TT + "test_random_samplers_round3"),
+    Retrofit("log_normal", "log_normal", "random", differentiable=False,
+             tested_by=_TT + "test_random_samplers_round3"),
 ]
 
 

@@ -1,0 +1,97 @@
+"""StringTensor parity (paddle/phi/core/string_tensor.h + the strings
+kernel set paddle/phi/kernels/strings/: empty, copy, lower, upper — the
+reference exposes no Python API for these; this module IS the usable
+surface).
+
+TPU-native: strings never touch the device — they are host-side numpy
+object arrays (XLA has no string dtype). The op set matches the
+reference kernels 1:1, including the unicode/ascii split of
+strings_lower_upper_kernel.h.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "empty_like", "copy", "lower", "upper"]
+
+
+class StringTensor:
+    """Host-side tensor of variable-length UTF-8 strings
+    (phi::StringTensor analog: shape + pstring storage)."""
+
+    def __init__(self, data, name=None):
+        arr = np.asarray(data, dtype=object)
+        # normalize every element to str (pstring semantics)
+        self._array = np.vectorize(lambda s: "" if s is None else str(s),
+                                   otypes=[object])(arr) \
+            if arr.size else arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._array.shape)
+
+    @property
+    def ndim(self):
+        return self._array.ndim
+
+    def numel(self):
+        return int(self._array.size)
+
+    def numpy(self):
+        return self._array
+
+    def tolist(self):
+        return self._array.tolist()
+
+    def __getitem__(self, idx):
+        out = self._array[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __eq__(self, other):
+        other = other._array if isinstance(other, StringTensor) else other
+        return np.asarray(self._array == other)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._array!r})"
+
+
+def empty(shape, name=None) -> StringTensor:
+    """strings_empty_kernel.cc parity: empty strings of the given shape."""
+    return StringTensor(np.full(tuple(shape), "", dtype=object))
+
+
+def empty_like(x: StringTensor, name=None) -> StringTensor:
+    return empty(x.shape)
+
+
+def copy(x: StringTensor) -> StringTensor:
+    """strings_copy_kernel parity."""
+    return StringTensor(x._array.copy())
+
+
+def _case_map(x: StringTensor, fn, use_utf8_encoding: bool) -> StringTensor:
+    if use_utf8_encoding:
+        # unicode-aware path (unicode.h case mapping = python str casing)
+        mapped = np.vectorize(fn, otypes=[object])(x._array) \
+            if x._array.size else x._array.copy()
+    else:
+        # ascii-only path (case_utils.h): leave non-ascii bytes untouched
+        def ascii_case(s):
+            return "".join(fn(c) if ord(c) < 128 else c for c in s)
+
+        mapped = np.vectorize(ascii_case, otypes=[object])(x._array) \
+            if x._array.size else x._array.copy()
+    return StringTensor(mapped)
+
+
+def lower(x: StringTensor, use_utf8_encoding: bool = False) -> StringTensor:
+    """strings_lower_upper_kernel.h StringLowerKernel parity."""
+    return _case_map(x, str.lower, use_utf8_encoding)
+
+
+def upper(x: StringTensor, use_utf8_encoding: bool = False) -> StringTensor:
+    """strings_lower_upper_kernel.h StringUpperKernel parity."""
+    return _case_map(x, str.upper, use_utf8_encoding)

@@ -166,3 +166,28 @@ def test_unique_name_and_run_check(capsys):
 
     utils.run_check()
     assert "works" in capsys.readouterr().out
+
+
+def test_rank_aware_logger(capsys, monkeypatch):
+    """log_utils parity: records carry the [rank N/M] tag and log_on_rank
+    filters by rank."""
+    import importlib
+    import logging
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    from paddle_tpu.distributed import log_utils
+    importlib.reload(log_utils)
+    lg = log_utils.get_logger(logging.INFO, name="test_rank_logger")
+    import io
+    buf = io.StringIO()
+    lg.handlers[0].stream = buf
+    lg.info("hello")
+    assert "[rank 2/4]" in buf.getvalue() and "hello" in buf.getvalue()
+    # log_on_rank: silent on non-matching rank
+    buf2 = io.StringIO()
+    lg.handlers[0].stream = buf2
+    log_utils.log_on_rank("only-zero", rank=0, logger=lg)
+    assert "only-zero" not in buf2.getvalue()
+    log_utils.log_on_rank("mine", rank=2, logger=lg)
+    assert "mine" in buf2.getvalue()

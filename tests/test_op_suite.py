@@ -33,6 +33,15 @@ def _spd(n=4):
     return a @ a.T + n * np.eye(n)
 
 
+def _ormqr_inputs():
+    import scipy.linalg as sl
+
+    a = R.uniform(-1, 1, (5, 3))
+    (qr_f, tau), _ = sl.qr(a, mode="raw")
+    return {"x": np.asarray(qr_f), "tau": np.asarray(tau),
+            "other": R.uniform(-1, 1, (5, 4))}
+
+
 def U(name, ref, x=None, grad=True, covers=(), **kw):
     """Unary elementwise spec."""
     x = _arr() if x is None else x
@@ -525,7 +534,125 @@ SPECS = [
            ref=lambda x, y, axes: np.tensordot(x, y, axes), grad=()),
     OpSpec(name="dot", inputs={"x": _arr((2, 5)), "y": _arr((2, 5))},
            ref=lambda x, y: np.sum(x * y, -1), grad=("x", "y")),
+
+    # ---- round-3 tensor-surface tail ----------------------------------------
+    U("sinc", np.sinc),
+    OpSpec(name="multigammaln", inputs={"x": _pos(lo=2.0, hi=5.0)},
+           attrs={"p": 2},
+           ref=lambda x, p: __import__("scipy.special", fromlist=["x"])
+           .multigammaln(x, p), grad=("x",)),
+    OpSpec(name="isin", inputs={"x": _ints((3, 4), 0, 6),
+                                "test_x": _ints((4,), 0, 4)},
+           ref=lambda x, test_x: np.isin(x, test_x), out_cast=False, grad=()),
+    U("sgn", np.sign, grad=False),
+    OpSpec(name="frexp", inputs={"x": _arr(lo=0.3, hi=4.0)},
+           ref=lambda x: tuple(np.frexp(x)), grad=(), out_cast=True),
+    U("signbit", np.signbit, grad=False, out_cast=False),
+    OpSpec(name="cumulative_trapezoid", inputs={"y": _arr((3, 5))},
+           attrs={"dx": 0.5},
+           ref=lambda y, dx: __import__(
+               "scipy.integrate", fromlist=["x"]).cumulative_trapezoid(
+                   y, dx=dx, axis=-1), grad=("y",)),
+    OpSpec(name="reduce_as", inputs={"x": _arr((3, 4)), "target": _arr((1, 4))},
+           ref=lambda x, target: np.sum(x, 0, keepdims=True), grad=("x",)),
+    OpSpec(name="add_n", inputs={"inputs": [_arr(), _arr(), _arr()]},
+           ref=lambda inputs: inputs[0] + inputs[1] + inputs[2], grad=()),
+    OpSpec(name="histogram_bin_edges", inputs={"x": _arr()},
+           attrs={"bins": 5, "min": -1.0, "max": 1.0},
+           ref=lambda x, bins, min, max: np.histogram_bin_edges(
+               x, bins=bins, range=(min, max)), grad=()),
+    OpSpec(name="block_diag", inputs={"inputs": [_arr((2, 3)), _arr((3, 2))]},
+           ref=lambda inputs: __import__(
+               "scipy.linalg", fromlist=["x"]).block_diag(*inputs), grad=()),
+    OpSpec(name="cdist", inputs={"x": _arr((4, 3)), "y": _arr((5, 3))},
+           ref=lambda x, y: __import__(
+               "scipy.spatial.distance", fromlist=["x"]).cdist(x, y),
+           grad=("x", "y"), grad_atol=5e-3),
+    OpSpec(name="unflatten", inputs={"x": _arr((3, 4))},
+           attrs={"axis": 1, "shape": [2, 2]},
+           ref=lambda x, axis, shape: x.reshape(3, 2, 2), grad=("x",)),
+    OpSpec(name="slice_scatter",
+           inputs={"x": _arr((4, 5)), "value": _arr((4, 2))},
+           attrs={"axes": [1], "starts": [1], "ends": [3], "strides": [1]},
+           ref=lambda x, value, axes, starts, ends, strides: _np_slice_scatter(
+               x, value), grad=("x", "value")),
+    OpSpec(name="select_scatter",
+           inputs={"x": _arr((4, 5)), "value": _arr((5,))},
+           attrs={"axis": 0, "index": 2},
+           ref=lambda x, value, axis, index: _np_select_scatter(x, value),
+           grad=("x", "value")),
+    OpSpec(name="diagonal_scatter",
+           inputs={"x": _arr((4, 4)), "y": _arr((4,))},
+           ref=lambda x, y: _np_diagonal_scatter(x, y), grad=("x", "y")),
+    OpSpec(name="masked_scatter",
+           inputs={"x": _arr((3, 4)),
+                   "mask": R.uniform(0, 1, (3, 4)) > 0.5,
+                   "value": _arr((12,))},
+           ref=lambda x, mask, value: _np_masked_scatter(x, mask, value),
+           grad=()),
+    OpSpec(name="cholesky_inverse",
+           inputs={"x": np.linalg.cholesky(_spd(4))},
+           ref=lambda x: np.linalg.inv(x @ x.T), grad=(),
+           rtol=1e-4, atol=1e-4),
+    OpSpec(name="pdist", inputs={"x": _arr((5, 3))},
+           ref=lambda x: __import__(
+               "scipy.spatial.distance", fromlist=["x"]).pdist(x),
+           grad=("x",), grad_atol=5e-3),
+    U("positive", lambda x: +x),
+    OpSpec(name="hstack", inputs={"x": [_arr((2, 3)), _arr((2, 2))]},
+           ref=lambda x: np.hstack(x), grad=()),
+    OpSpec(name="vstack", inputs={"x": [_arr((2, 3)), _arr((1, 3))]},
+           ref=lambda x: np.vstack(x), grad=(), covers=("row_stack",)),
+    OpSpec(name="dstack", inputs={"x": [_arr((2, 3)), _arr((2, 3))]},
+           ref=lambda x: np.dstack(x), grad=()),
+    OpSpec(name="column_stack", inputs={"x": [_arr((3,)), _arr((3, 2))]},
+           ref=lambda x: np.column_stack(x), grad=()),
+    OpSpec(name="cartesian_prod",
+           inputs={"x": [_arr((2,)), _arr((3,))]},
+           ref=lambda x: np.stack(
+               [g.reshape(-1) for g in np.meshgrid(*x, indexing="ij")], -1),
+           grad=()),
+    OpSpec(name="combinations", inputs={"x": _arr((4,))},
+           ref=lambda x: np.asarray(
+               list(__import__("itertools").combinations(x, 2))), grad=()),
+    OpSpec(name="linalg.ormqr",
+           inputs=_ormqr_inputs(),
+           ref=lambda x, tau, other: _np_ormqr(x, tau, other), grad=(),
+           rtol=1e-4, atol=1e-5),
 ]
+
+
+def _np_slice_scatter(x, value):
+    out = x.copy()
+    out[:, 1:3] = value
+    return out
+
+
+def _np_select_scatter(x, value):
+    out = x.copy()
+    out[2] = value
+    return out
+
+
+def _np_diagonal_scatter(x, y):
+    out = x.copy()
+    np.fill_diagonal(out, y)
+    return out
+
+
+def _np_masked_scatter(x, mask, value):
+    out = x.copy()
+    out[mask] = value[: mask.sum()]
+    return out
+
+
+def _np_ormqr(x, tau, other):
+    import scipy.linalg as sl
+
+    # apply the full implicit Q via LAPACK ormqr itself
+    res = sl.lapack.dormqr("L", "N", x, tau, other.copy(),
+                           max(1, 64 * other.shape[1]))
+    return res[0]
 
 
 def _cum_idx(x, axis, cmp):
@@ -1245,7 +1372,42 @@ WHITELIST = {
     "einsum": "vararg signature; test_einsum_and_atleast",
     "unfold_window": "Tensor.unfold method surface; test_tensor_unfold_direct",
     "meshgrid": "vararg signature; test_meshgrid_direct",
+    # SVD sign ambiguity / sampling randomness; dedicated tests below
+    "svd_lowrank": "sign-ambiguous factors; test_lowrank_factorizations",
+    "pca_lowrank": "sign-ambiguous factors; test_lowrank_factorizations",
+    "top_p_sampling": "stochastic output; test_top_p_sampling_direct",
 }
+
+
+def test_lowrank_factorizations():
+    """svd_lowrank/pca_lowrank: reconstruction + orthonormality (factor
+    signs are implementation-defined, so compare subspaces not entries)."""
+    import paddle_tpu.linalg as L
+
+    a = paddle.to_tensor(R.uniform(-1, 1, (6, 4)).astype("float32"))
+    u, s, v = L.svd_lowrank(a, q=4)
+    recon = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(recon, a.numpy(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(u.numpy().T @ u.numpy(), np.eye(4),
+                               atol=1e-4)
+    u2, s2, v2 = L.pca_lowrank(a, q=3)
+    centered = a.numpy() - a.numpy().mean(0, keepdims=True)
+    ref_s = np.linalg.svd(centered, compute_uv=False)[:3]
+    np.testing.assert_allclose(s2.numpy(), ref_s, rtol=1e-4, atol=1e-4)
+
+
+def test_top_p_sampling_direct():
+    """top_p_sampling: sampled ids always fall inside the nucleus set."""
+    paddle.seed(0)
+    logits = paddle.to_tensor(
+        np.array([[4.0, 3.9, -10.0, -10.0], [5.0, -9.0, -9.0, -9.0]],
+                 dtype="float32"))
+    ps = paddle.to_tensor(np.array([0.9, 0.5], dtype="float32"))
+    for _ in range(5):
+        val, idx = paddle.top_p_sampling(logits, ps)
+        assert idx.numpy()[0, 0] in (0, 1)
+        assert idx.numpy()[1, 0] == 0
+        assert val.shape == [2, 1]
 
 
 def _tested_by_exists(ref: str) -> bool:

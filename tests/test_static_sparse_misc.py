@@ -364,3 +364,124 @@ def test_fused_rope_defaults_and_position_ids():
     q1 = paddle.to_tensor(q.numpy()[:, 2:3])
     qd, _, _ = IF.fused_rotary_position_embedding(q1, position_ids=pid)
     np.testing.assert_allclose(qd.numpy()[:, 0], qo.numpy()[:, 2], atol=1e-5)
+
+
+def test_string_tensor():
+    """StringTensor kernel-set parity: empty/copy/lower/upper incl. the
+    ascii-vs-utf8 split (paddle/phi/kernels/strings/)."""
+    from paddle_tpu import strings
+
+    st = strings.StringTensor([["Hello", "WORLD"], ["Grüße", ""]])
+    assert st.shape == [2, 2] and st.numel() == 4
+    low = strings.lower(st, use_utf8_encoding=True)
+    assert low.tolist() == [["hello", "world"], ["grüße", ""]]
+    up_ascii = strings.upper(st, use_utf8_encoding=False)
+    assert up_ascii.tolist()[0] == ["HELLO", "WORLD"]
+    # ascii path leaves the non-ascii ü/ß untouched
+    assert up_ascii.tolist()[1][0] == "GRüßE"
+    cp = strings.copy(st)
+    assert (cp == st).all()
+    e = strings.empty([3])
+    assert e.tolist() == ["", "", ""]
+    assert strings.empty_like(st).shape == [2, 2]
+
+
+def test_sparse_op_tail():
+    """Round-3 sparse breadth (sparse_ops.yaml parity): unary tail,
+    softmax, structural remaps, coalesce/mask_as/addmm/mv/slice."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.sparse as sparse
+
+    d = np.array([[1.0, 0, 2], [0, 3, 0]], dtype="float32")
+    m = sparse.to_sparse_coo(paddle.to_tensor(d))
+    # unary ops act on stored values only
+    np.testing.assert_allclose(sparse.expm1(m).to_dense().numpy(),
+                               np.where(d != 0, np.expm1(d), 0), rtol=1e-6)
+    np.testing.assert_allclose(
+        sparse.leaky_relu(sparse.neg(m), 0.1).values().numpy(),
+        np.array([-0.1, -0.2, -0.3], dtype="float32"), rtol=1e-6)
+    # pattern-aware softmax: absent entries = -inf
+    sm = sparse.softmax(m).to_dense().numpy()
+    row0 = np.exp([1.0, 2.0]) / np.exp([1.0, 2.0]).sum()
+    np.testing.assert_allclose(sm[0], [row0[0], 0, row0[1]], rtol=1e-5)
+    assert sm[1, 1] == 1.0
+    # structural ops preserve values
+    t = sparse.transpose(m, [1, 0])
+    np.testing.assert_allclose(t.to_dense().numpy(), d.T)
+    r = sparse.reshape(m, [3, 2])
+    np.testing.assert_allclose(r.to_dense().numpy(), d.reshape(3, 2))
+    s = sparse.slice(m, [1], [1], [3])
+    np.testing.assert_allclose(s.to_dense().numpy(), d[:, 1:3])
+    # coalesce merges duplicates
+    dup = sparse.sparse_coo_tensor(
+        paddle.to_tensor(np.array([[0, 0], [1, 1]])),
+        paddle.to_tensor(np.array([1.0, 2.0], dtype="float32")), shape=[2, 2])
+    co = sparse.coalesce(dup)
+    assert co.nnz() == 1 and float(co.values().numpy()[0]) == 3.0
+    # mask_as / addmm / mv
+    ma = sparse.mask_as(paddle.to_tensor(np.ones((2, 3), "float32")), m)
+    np.testing.assert_allclose(ma.to_dense().numpy(), (d != 0).astype("f"))
+    A = sparse.to_sparse_coo(paddle.to_tensor(np.eye(3, dtype="float32")))
+    out = sparse.addmm(paddle.to_tensor(np.ones((3, 3), "float32")), A,
+                       paddle.to_tensor(np.eye(3, dtype="float32")),
+                       beta=1.0, alpha=2.0)
+    np.testing.assert_allclose(out.numpy()[0], [3.0, 1.0, 1.0])
+    mv = sparse.mv(A, paddle.to_tensor(np.arange(3, dtype="float32")))
+    np.testing.assert_allclose(mv.numpy(), [0.0, 1.0, 2.0])
+
+
+def test_sparse_nn_layers():
+    """sparse.nn conv3d/subm_conv3d/pool/BN: dense-compute, sparse-storage
+    (docstring rationale in sparse/nn.py); subm preserves the pattern."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.sparse as sparse
+
+    paddle.seed(0)
+    dense = np.zeros((1, 4, 4, 4, 3), "float32")
+    coords = [(0, 1, 1, 1), (0, 2, 3, 0), (0, 3, 2, 2)]
+    rng = np.random.RandomState(0)
+    for c in coords:
+        dense[c] = rng.rand(3)
+    st = sparse.to_sparse_coo(paddle.to_tensor(dense), sparse_dim=4)
+
+    subm = sparse.nn.SubmConv3D(3, 5, 3)
+    out = subm(st)
+    assert out.nnz() == 3 and out.values().shape == [3, 5]
+    # subm output coords == input coords
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(out._array.indices), 0),
+        np.sort(np.asarray(st._array.indices), 0))
+    # numeric parity vs dense lax conv on the same weights
+    import jax
+    w = subm.weight.numpy()
+    ref = jax.lax.conv_general_dilated(
+        dense, w, (1, 1, 1), [(1, 1)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    ref = np.asarray(ref) + subm.bias.numpy()
+    got = out.to_dense().numpy()
+    for c in coords:
+        np.testing.assert_allclose(got[c], ref[c], rtol=1e-4, atol=1e-5)
+
+    conv = sparse.nn.Conv3D(3, 2, 2, stride=2)
+    assert conv(st).to_dense().numpy().shape == (1, 2, 2, 2, 2)
+    pool = sparse.nn.MaxPool3D(2, 2)
+    np.testing.assert_allclose(
+        pool(st).to_dense().numpy(),
+        np.asarray(jax.lax.reduce_window(
+            dense, -np.inf, jax.lax.max, (1, 2, 2, 2, 1), (1, 2, 2, 2, 1),
+            "VALID")).clip(0, None), rtol=1e-6)
+    bn = sparse.nn.BatchNorm(3)
+    nb = bn(st)
+    vals = nb.values().numpy()
+    np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-5)
+    sync = sparse.nn.SyncBatchNorm.convert_sync_batchnorm(sparse.nn.BatchNorm(3))
+    assert isinstance(sync, sparse.nn.SyncBatchNorm)
+    att = sparse.fused_attention(
+        paddle.randn([1, 2, 4, 8]), paddle.randn([1, 2, 4, 8]),
+        paddle.randn([1, 2, 4, 8]),
+        sparse.to_sparse_coo(paddle.to_tensor(np.ones((1, 2, 4, 4), "float32"))))
+    assert att.shape == [1, 2, 4, 8]

@@ -169,3 +169,74 @@ def test_tensor_array():
     paddle.array_write(x * 3, 0, a3)
     paddle.array_read(a3, 0).sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), 3.0)
+
+
+def test_tensor_method_table_parity():
+    """Every name in the reference's tensor_method_func list
+    (python/paddle/tensor/__init__.py) exists on our Tensor. This is the
+    method-table completeness gate for the round-3 surface push."""
+    import os
+    import re
+
+    ref = "/root/reference/python/paddle/tensor/__init__.py"
+    if not os.path.exists(ref):
+        import pytest
+
+        pytest.skip("reference tree not mounted")
+    src = open(ref).read()
+    m = re.search(r"tensor_method_func\s*=\s*\[(.*?)\]", src, re.S)
+    names = re.findall(r"'([A-Za-z0-9_]+)'", m.group(1))
+    missing = [n for n in names if not hasattr(paddle.Tensor, n)]
+    assert not missing, f"{len(missing)} tensor methods missing: {missing}"
+
+
+def test_inplace_and_random_fill_methods():
+    """Round-3 in-place variants: value semantics + payload swap, and the
+    random fills (cauchy_/geometric_/exponential_/log_normal_/set_)."""
+    x = paddle.to_tensor(np.array([1.0, 4.0, 9.0], dtype="float32"))
+    out = x.sqrt_()
+    assert out is x
+    np.testing.assert_allclose(x.numpy(), [1.0, 2.0, 3.0])
+    x.cumsum_()
+    np.testing.assert_allclose(x.numpy(), [1.0, 3.0, 6.0])
+    x.cast_("int32")
+    assert x.dtype == paddle.int32
+    # comparison in-place changes dtype to bool (reference type-promoting
+    # inplace semantics)
+    y = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+    y.less_than_(paddle.to_tensor(np.array([2.0, 1.0], dtype="float32")))
+    assert y.dtype == paddle.bool
+    np.testing.assert_array_equal(y.numpy(), [True, False])
+    # random fills keep shape/dtype and mutate in place
+    paddle.seed(7)
+    z = paddle.zeros([64], "float32")
+    z.exponential_()
+    assert float(z.numpy().min()) >= 0.0
+    z.cauchy_(); z.geometric_(0.4); z.log_normal_()
+    assert z.shape == [64] and z.dtype == paddle.float32
+    w = paddle.zeros([3])
+    w.set_(paddle.to_tensor(np.arange(5, dtype="float32")))
+    assert w.shape == [5]
+    t = paddle.to_tensor(np.ones((2, 3), dtype="float32"))
+    t.t_()
+    assert t.shape == [3, 2]
+
+
+def test_shape_op():
+    s = paddle.shape(paddle.ones([2, 3]))
+    assert s.dtype == paddle.int32
+    np.testing.assert_array_equal(s.numpy(), [2, 3])
+
+
+def test_random_samplers_round3():
+    """binomial/standard_gamma/log_normal: shape/dtype/moment sanity."""
+    paddle.seed(0)
+    b = paddle.binomial(paddle.full([2000], 10, "int32"),
+                        paddle.full([2000], 0.5))
+    assert paddle.is_integer(b)  # int64 logical dtype (x64-off → int32)
+    assert 4.0 < float(b.numpy().mean()) < 6.0
+    g = paddle.standard_gamma(paddle.full([2000], 2.0))
+    assert 1.7 < float(g.numpy().mean()) < 2.3
+    ln = paddle.log_normal(mean=0.0, std=0.5, shape=[2000])
+    # E[lognormal(0, .5)] = exp(.125) ~ 1.133
+    assert 1.0 < float(ln.numpy().mean()) < 1.3
