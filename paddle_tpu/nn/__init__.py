@@ -34,6 +34,18 @@ from .transformer import (
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
 from .rnn import SimpleRNN, LSTM, GRU, RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell
+from .layers_extra import (
+    PairwiseDistance, FeatureAlphaDropout, Unfold, Fold, Silu,
+    ChannelShuffle, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    FractionalMaxPool2D, FractionalMaxPool3D, LPPool1D, LPPool2D,
+    ZeroPad1D, ZeroPad3D, PoissonNLLLoss, GaussianNLLLoss, SoftMarginLoss,
+    MultiMarginLoss, MultiLabelSoftMarginLoss, TripletMarginWithDistanceLoss,
+    RNNTLoss, HSigmoidLoss, AdaptiveLogSoftmaxWithLoss, RNN, BiRNN,
+    BeamSearchDecoder, dynamic_decode, ParameterDict,
+)
+# gradient clipping lives with the optimizers; the reference also exports it
+# under paddle.nn (python/paddle/nn/__init__.py ClipGradBy*)
+from ..optimizer.clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm
 from ..tensor_class import Parameter
 
 

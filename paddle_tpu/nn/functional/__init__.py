@@ -42,3 +42,13 @@ multi_margin_loss = _schema.generated("multi_margin_loss")
 multi_label_soft_margin_loss = _schema.generated("multi_label_soft_margin_loss")
 npair_loss = _schema.generated("npair_loss")
 margin_cross_entropy = _schema.generated("margin_cross_entropy")
+from .extra import (  # noqa: F401
+    pairwise_distance, zeropad2d, bilinear, feature_alpha_dropout,
+    gather_tree, class_center_sample, elu_, hardtanh_, leaky_relu_, tanh_,
+    thresholded_relu_, lp_pool1d, max_unpool1d, max_unpool3d,
+    fractional_max_pool2d, fractional_max_pool3d, dice_loss,
+    poisson_nll_loss, gaussian_nll_loss, triplet_margin_with_distance_loss,
+    hsigmoid_loss, rnnt_loss, adaptive_log_softmax_with_loss,
+    sparse_attention, flashmask_attention, flash_attn_qkvpacked,
+    flash_attn_varlen_qkvpacked,
+)

@@ -172,17 +172,96 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
                  count_include_pad=not exclusive, divisor_override=divisor_override, average=True)
 
 
+def _max_pool_with_mask(x, kernel_size, stride, padding, n, ceil_mode=False):
+    """Max pooling that also returns flat argmax indices (the unpool
+    contract — ops.yaml `max_pool2d_with_index`). Windows are gathered
+    explicitly (static shapes), max+argmax over the window axis."""
+    if isinstance(padding, str):
+        raise NotImplementedError(
+            "max_pool return_mask: string padding modes are not supported "
+            "(pass explicit ints so unpool indices stay well-defined)")
+    ks = _tuple(kernel_size, n)
+    st = _tuple(stride if stride is not None else kernel_size, n)
+    pd = _tuple(padding, n)
+
+    def fn(a):
+        sp = a.shape[2:]
+        neg = jnp.asarray(-jnp.inf, jnp.float32)
+        # absolute input coordinates per (out position, window offset)
+        coords = []
+        valid = []
+        outs = []
+        for d in range(n):
+            num = sp[d] + 2 * pd[d] - ks[d]
+            o = (num + st[d] - 1) // st[d] + 1 if ceil_mode else num // st[d] + 1
+            outs.append(o)
+            c = (jnp.arange(o) * st[d] - pd[d])[:, None] + jnp.arange(ks[d])
+            coords.append(jnp.clip(c, 0, sp[d] - 1))
+            valid.append((c >= 0) & (c < sp[d]))
+        if n == 1:
+            win = a[:, :, coords[0]]                       # [N,C,O,K]
+            ok = valid[0][None, None]
+            flat_idx = coords[0]
+            win = jnp.where(ok, win.astype(jnp.float32), neg)
+            am = win.argmax(-1)
+            mx = win.max(-1).astype(a.dtype)
+            idx = jnp.take_along_axis(
+                jnp.broadcast_to(flat_idx, win.shape), am[..., None], -1)[..., 0]
+            return mx, idx.astype(jnp.int32)
+        if n == 2:
+            win = a[:, :, coords[0][:, None, :, None], coords[1][None, :, None, :]]
+            ok = (valid[0][:, None, :, None] & valid[1][None, :, None, :])[None, None]
+            lin = (coords[0][:, None, :, None] * sp[1]
+                   + coords[1][None, :, None, :])          # [OH,OW,KH,KW]
+            win = jnp.where(ok, win.astype(jnp.float32), neg)
+            wf = win.reshape(win.shape[:4] + (-1,))
+            am = wf.argmax(-1)
+            mx = wf.max(-1).astype(a.dtype)
+            linb = jnp.broadcast_to(lin.reshape(lin.shape[:2] + (-1,)), wf.shape)
+            idx = jnp.take_along_axis(linb, am[..., None], -1)[..., 0]
+            return mx, idx.astype(jnp.int32)
+        # n == 3
+        win = a[:, :, coords[0][:, None, None, :, None, None],
+                coords[1][None, :, None, None, :, None],
+                coords[2][None, None, :, None, None, :]]
+        ok = (valid[0][:, None, None, :, None, None]
+              & valid[1][None, :, None, None, :, None]
+              & valid[2][None, None, :, None, None, :])[None, None]
+        lin = ((coords[0][:, None, None, :, None, None] * sp[1]
+                + coords[1][None, :, None, None, :, None]) * sp[2]
+               + coords[2][None, None, :, None, None, :])
+        win = jnp.where(ok, win.astype(jnp.float32), neg)
+        wf = win.reshape(win.shape[:5] + (-1,))
+        am = wf.argmax(-1)
+        mx = wf.max(-1).astype(a.dtype)
+        linb = jnp.broadcast_to(lin.reshape(lin.shape[:3] + (-1,)), wf.shape)
+        idx = jnp.take_along_axis(linb, am[..., None], -1)[..., 0]
+        return mx, idx.astype(jnp.int32)
+
+    return apply("max_pool_with_index", fn, x)
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1, ceil_mode)
     return _pool(x, kernel_size, stride, padding, 1, "NCL", jax.lax.max, -jnp.inf)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCHW", name=None):
+    if return_mask:
+        if data_format != "NCHW":
+            raise NotImplementedError("max_pool2d return_mask: NCHW only")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2, ceil_mode)
     return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.max, -jnp.inf)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCDHW", name=None):
+    if return_mask:
+        if data_format != "NCDHW":
+            raise NotImplementedError("max_pool3d return_mask: NCDHW only")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3, ceil_mode)
     return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.max, -jnp.inf)
 
 

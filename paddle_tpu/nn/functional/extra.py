@@ -1,0 +1,637 @@
+"""F.* round-3 tail: distance/pad/pool/loss/attention functions closing the
+nn.functional __all__ gap vs the reference
+(python/paddle/nn/functional/__init__.py).
+
+Each function cites its reference implementation; all are pure-jax through
+``apply`` so AMP/NaN-check/tape integration comes from the registry.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.registry import apply, inplace_swap
+from ...tensor_class import Tensor, unwrap, wrap
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# distances / padding / misc
+# ---------------------------------------------------------------------------
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """F.pairwise_distance (python/paddle/nn/functional/distance.py)."""
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.power(jnp.power(jnp.abs(d), p).sum(-1, keepdims=keepdim),
+                         1.0 / p)
+
+    return apply("pairwise_distance", fn, x, y)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """F.zeropad2d (common.py zeropad2d): [left, right, top, bottom]."""
+    l, r, tp, b = [int(unwrap(v)) for v in padding]
+    def fn(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (tp, b), (l, r)))
+        return jnp.pad(a, ((0, 0), (tp, b), (l, r), (0, 0)))
+
+    return apply("zeropad2d", fn, x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """F.bilinear (common.py bilinear): out[b,o] = x1[b,i] W[o,i,j] x2[b,j]."""
+    def fn(a, b, w, *bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi:
+            out = out + bi[0]
+        return out
+
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply("bilinear", fn, *args)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """F.feature_alpha_dropout: alpha dropout zeroing whole channels
+    (dim 1), keeping self-normalizing statistics (SELU alpha dropout)."""
+    if not training or p == 0.0:
+        return x
+
+    from ...framework import random as _random
+
+    alpha = -1.7580993408473766
+    key = _random.next_key()
+
+    def fn(a):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        kp = 1 - p
+        # affine correction restoring N(0,1) stats: var of the dropped
+        # mixture is kp*(1 + p*alpha^2), mean is p*alpha
+        q = 1.0 / math.sqrt(kp * (1 + p * alpha * alpha))
+        b = -q * alpha * p
+        return (jnp.where(keep, a, alpha) * q + b).astype(a.dtype)
+
+    return apply("feature_alpha_dropout", fn, x)
+
+
+def gather_tree(ids, parents):
+    """F.gather_tree (ops.yaml `gather_tree`): trace beam-search parent
+    pointers backwards so each beam holds its full token path."""
+    def fn(i, p):
+        T = i.shape[0]
+
+        def step(carry, xs):
+            beams = carry  # [batch, beam] indices into next step
+            tok, par = xs
+            out = jnp.take_along_axis(tok, beams, axis=1)
+            nxt = jnp.take_along_axis(par, beams, axis=1)
+            return nxt, out
+
+        init = jnp.broadcast_to(jnp.arange(i.shape[2]), i.shape[1:])
+        _, rev = jax.lax.scan(step, init, (i[::-1], p[::-1]))
+        return rev[::-1]
+
+    return apply("gather_tree", fn, ids, parents, differentiable=False)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """F.class_center_sample (ops.yaml `class_center_sample`): sample the
+    positive class centers plus negatives up to num_samples; labels are
+    remapped into the sampled index space. Data-dependent sizes → eager
+    host-side (the margin-softmax training loop calls it outside jit)."""
+    lab = np.asarray(unwrap(label)).reshape(-1)
+    pos = np.unique(lab)
+    if pos.size >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos, assume_unique=True)
+        rng = np.random.default_rng(int(lab.sum()) + num_classes)
+        extra = rng.choice(rest, size=num_samples - pos.size, replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones((num_classes,), np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    return (wrap(jnp.asarray(remap[lab].astype(np.int64))),
+            wrap(jnp.asarray(sampled.astype(np.int64))))
+
+
+# ---------------------------------------------------------------------------
+# in-place activations (reference exports *_ variants of these five)
+# ---------------------------------------------------------------------------
+
+def _inplace_of(fn_name):
+    def op(x, *a, **k):
+        from . import activation as _act
+
+        out = getattr(_act, fn_name)(x, *a, **k)
+        return inplace_swap(x, out)
+
+    op.__name__ = fn_name + "_"
+    return op
+
+
+elu_ = _inplace_of("elu")
+hardtanh_ = _inplace_of("hardtanh")
+leaky_relu_ = _inplace_of("leaky_relu")
+tanh_ = _inplace_of("tanh")
+thresholded_relu_ = _inplace_of("thresholded_relu")
+
+
+# ---------------------------------------------------------------------------
+# pooling tail
+# ---------------------------------------------------------------------------
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """F.lp_pool1d via the 2-D kernel (width-1 axis)."""
+    from . import lp_pool2d
+
+    if data_format == "NLC":
+        x = x.transpose([0, 2, 1])
+    elif data_format != "NCL":
+        raise ValueError(f"lp_pool1d: unknown data_format {data_format!r}")
+    x4 = x.unsqueeze(-1) if isinstance(x, Tensor) else wrap(unwrap(x)[..., None])
+    out = lp_pool2d(x4, norm_type, (kernel_size, 1),
+                    (stride if stride is not None else kernel_size, 1),
+                    (padding, 0), ceil_mode, "NCHW")
+    out = out.squeeze(-1)
+    return out.transpose([0, 2, 1]) if data_format == "NLC" else out
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    """F.max_unpool1d (ops.yaml `unpool`) via the 2-D kernel."""
+    from . import max_unpool2d
+
+    out_2d = None
+    if output_size is not None:
+        out_2d = list(output_size[:-1]) + [output_size[-1], 1] \
+            if len(output_size) > 1 else [output_size[-1], 1]
+    out = max_unpool2d(x.unsqueeze(-1), indices.unsqueeze(-1),
+                       (kernel_size, 1),
+                       (stride if stride is not None else kernel_size, 1),
+                       (padding, 0), out_2d, "NCHW")
+    return out.squeeze(-1)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    """F.max_unpool3d (ops.yaml `unpool3d`): scatter pooled values back to
+    their argmax positions."""
+    def trip(v):
+        return (v, v, v) if isinstance(v, int) else tuple(v)
+
+    kd, kh, kw = trip(kernel_size)
+    sd, sh, sw = trip(stride if stride is not None else kernel_size)
+    pd, ph, pw = trip(padding)
+
+    def fn(a, idx):
+        n, c, d, h, w = a.shape
+        if output_size is None:
+            od = (d - 1) * sd - 2 * pd + kd
+            oh = (h - 1) * sh - 2 * ph + kh
+            ow = (w - 1) * sw - 2 * pw + kw
+        else:
+            od, oh, ow = output_size[-3], output_size[-2], output_size[-1]
+        flat = jnp.zeros((n, c, od * oh * ow), a.dtype)
+        ii = idx.reshape(n, c, -1).astype(jnp.int32)
+        out = flat.at[jnp.arange(n)[:, None, None],
+                      jnp.arange(c)[None, :, None], ii].set(
+            a.reshape(n, c, -1))
+        return out.reshape(n, c, od, oh, ow)
+
+    return apply("max_unpool3d", fn, x, indices)
+
+
+def _fractional_windows(in_size, out_size, u, kernel):
+    """Per-output (start, length) windows for fractional pooling (Graham
+    2014). Disjoint partition mode when kernel is None (b_i..b_{i+1}); the
+    overlapping kernel mode pools [b_i, b_i+k)."""
+    alpha = in_size / out_size
+    idx = np.arange(out_size + 1, dtype=np.float64)
+    b = np.ceil(alpha * (idx + u)).astype(np.int64) - int(np.ceil(alpha * u))
+    b = np.clip(b, 0, in_size)
+    b[0] = 0
+    b[-1] = in_size
+    starts = b[:-1]
+    if kernel is None:
+        lens = np.maximum(b[1:] - b[:-1], 1)
+    else:
+        starts = np.minimum(starts, in_size - kernel)
+        lens = np.full(out_size, kernel, np.int64)
+    return starts, lens
+
+
+def _fractional_pool_nd(x, out_sizes, u, kernels, return_mask):
+    """Shared n-D fractional max pool: windows gathered on device (padded to
+    the max window length with -inf, like _max_pool_with_mask), max+argmax
+    in the same traced fn — no host recompute."""
+    a_shape = unwrap(x).shape
+    sp = a_shape[2:]
+    nd = len(out_sizes)
+    coords, valids = [], []
+    for d in range(nd):
+        starts, lens = _fractional_windows(sp[d], out_sizes[d], u,
+                                           None if kernels is None
+                                           else kernels[d])
+        kmax = int(lens.max())
+        c = starts[:, None] + np.arange(kmax)[None, :]
+        v = np.arange(kmax)[None, :] < lens[:, None]
+        v &= c < sp[d]
+        coords.append(jnp.asarray(np.clip(c, 0, sp[d] - 1)))
+        valids.append(jnp.asarray(v))
+
+    def fn(arr):
+        neg = jnp.asarray(-jnp.inf, jnp.float32)
+        if nd == 2:
+            win = arr[:, :, coords[0][:, None, :, None],
+                      coords[1][None, :, None, :]]
+            ok = (valids[0][:, None, :, None]
+                  & valids[1][None, :, None, :])[None, None]
+            lin = (coords[0][:, None, :, None] * sp[1]
+                   + coords[1][None, :, None, :])
+            lead = 4
+        else:
+            win = arr[:, :, coords[0][:, None, None, :, None, None],
+                      coords[1][None, :, None, None, :, None],
+                      coords[2][None, None, :, None, None, :]]
+            ok = (valids[0][:, None, None, :, None, None]
+                  & valids[1][None, :, None, None, :, None]
+                  & valids[2][None, None, :, None, None, :])[None, None]
+            lin = ((coords[0][:, None, None, :, None, None] * sp[1]
+                    + coords[1][None, :, None, None, :, None]) * sp[2]
+                   + coords[2][None, None, :, None, None, :])
+            lead = 5
+        win = jnp.where(ok, win.astype(jnp.float32), neg)
+        wf = win.reshape(win.shape[:lead] + (-1,))
+        mx = wf.max(-1).astype(arr.dtype)
+        am = wf.argmax(-1)
+        linb = jnp.broadcast_to(lin.reshape(lin.shape[:nd] + (-1,)), wf.shape)
+        idx = jnp.take_along_axis(linb, am[..., None], -1)[..., 0]
+        return mx, idx.astype(jnp.int64)
+
+    mx, idx = apply("fractional_max_pool", fn, x)
+    return (mx, idx) if return_mask else mx
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """F.fractional_max_pool2d (ops.yaml `fractional_max_pool2d`)."""
+    out = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    ks = None if kernel_size is None else (
+        (kernel_size, kernel_size) if isinstance(kernel_size, int)
+        else tuple(kernel_size))
+    u = float(random_u) if random_u is not None else 0.5
+    return _fractional_pool_nd(x, out, u, ks, return_mask)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """F.fractional_max_pool3d (ops.yaml `fractional_max_pool3d`)."""
+    out = (output_size,) * 3 if isinstance(output_size, int) \
+        else tuple(output_size)
+    ks = None if kernel_size is None else (
+        (kernel_size,) * 3 if isinstance(kernel_size, int)
+        else tuple(kernel_size))
+    u = float(random_u) if random_u is not None else 0.5
+    return _fractional_pool_nd(x, out, u, ks, return_mask)
+
+
+# ---------------------------------------------------------------------------
+# loss tail
+# ---------------------------------------------------------------------------
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """F.dice_loss (loss.py dice_loss): 1 - 2|X∩Y| / (|X|+|Y|)."""
+    def fn(p, l):
+        l1 = jax.nn.one_hot(l.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = (p * l1).sum(reduce_dims)
+        union = p.sum(reduce_dims) + l1.sum(reduce_dims)
+        return (1 - (2 * inter + epsilon) / (union + epsilon)).mean()
+
+    return apply("dice_loss", fn, input, label)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    """F.poisson_nll_loss (loss.py poisson_nll_loss)."""
+    def fn(x, t):
+        if log_input:
+            loss = jnp.exp(x) - t * x
+        else:
+            loss = x - t * jnp.log(x + epsilon)
+        if full:
+            stirling = t * jnp.log(t) - t + 0.5 * jnp.log(2 * jnp.pi * t)
+            loss = loss + jnp.where(t > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply("poisson_nll_loss", fn, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """F.gaussian_nll_loss (loss.py gaussian_nll_loss)."""
+    def fn(mu, t, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (t - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+
+    return apply("gaussian_nll_loss", fn, input, label, variance)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    """F.triplet_margin_with_distance_loss (loss.py)."""
+    dist = distance_function or (
+        lambda a, b: pairwise_distance(a, b))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dpn = dist(positive, negative)
+        dn = wrap(jnp.minimum(unwrap(dn), unwrap(dpn)))
+
+    def fn(p, n):
+        return _reduce(jnp.maximum(p - n + margin, 0.0), reduction)
+
+    return apply("triplet_margin_with_distance_loss", fn, dp, dn)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """F.hsigmoid_loss (ops.yaml `hsigmoid_loss`): hierarchical sigmoid over
+    the default complete binary tree (leaf l ↔ node num_classes + l;
+    internal nodes 1..num_classes-1 carry rows of `weight`), or a custom
+    (path_table, path_code) pair — the reference MatrixBitCode scheme."""
+    depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
+
+    if path_table is None:
+        lab = np.asarray(unwrap(label)).reshape(-1).astype(np.int64)
+        nodes = np.zeros((lab.size, depth), np.int64)
+        codes = np.zeros((lab.size, depth), np.float32)
+        valid = np.zeros((lab.size, depth), np.float32)
+        for r, l in enumerate(lab):
+            c = int(l) + num_classes
+            k = 0
+            path = []
+            while c > 1:
+                path.append((c >> 1, float(c & 1)))
+                c >>= 1
+            for k, (node, bit) in enumerate(reversed(path)):
+                if k < depth:
+                    nodes[r, k] = node - 1  # weight row for internal node
+                    codes[r, k] = bit
+                    valid[r, k] = 1.0
+        tbl, code, msk = (jnp.asarray(nodes), jnp.asarray(codes),
+                          jnp.asarray(valid))
+    else:
+        tbl = jnp.asarray(unwrap(path_table)).astype(jnp.int32)
+        code = jnp.asarray(unwrap(path_code)).astype(jnp.float32)
+        msk = (tbl >= 0).astype(jnp.float32)
+        tbl = jnp.maximum(tbl, 0)
+
+    def fn(x, w, *b):
+        wv = w[tbl]                      # [batch, depth, feat]
+        logits = jnp.einsum("bf,bdf->bd", x, wv)
+        if b:
+            logits = logits + b[0].reshape(-1)[tbl]
+        # bit=1 → sigmoid(logit) target 1? The reference uses
+        # sum over path of softplus((1-2*code)*logit)
+        loss = jax.nn.softplus((1.0 - 2.0 * code) * logits) * msk
+        return loss.sum(-1).mean()
+
+    args = (input, weight) + ((bias,) if bias is not None else ())
+    return apply("hsigmoid_loss", fn, *args)
+
+
+def rnnt_loss(logits, labels, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """F.rnnt_loss (ops.yaml `warprnnt`): RNN-Transducer loss via the
+    forward (alpha) recursion in log space — lax.scan over time frames, a
+    sequential scan over label positions inside each frame."""
+    def fn(lg, lb, il, ll):
+        lp = jax.nn.log_softmax(lg, -1)           # [B, T, U1, V]
+        B, T, U1, V = lp.shape
+        blank_lp = lp[..., blank]                  # [B, T, U1]
+        lab = lb.astype(jnp.int32)                 # [B, U]
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :-1, :], lab[:, None, :, None], -1)[..., 0]  # [B,T,U]
+        if fastemit_lambda:
+            # FastEmit (warprnnt semantics): the loss VALUE is the plain
+            # transducer NLL; only label-emission gradients scale by
+            # (1+λ). value(x)=x, grad(x)=(1+λ)·dx via the stop-grad split:
+            lab_lp = ((1.0 + fastemit_lambda) * lab_lp
+                      - jax.lax.stop_gradient(fastemit_lambda * lab_lp))
+        neg = jnp.asarray(-1e30, lp.dtype)
+        alpha0 = jnp.full((B, U1), neg).at[:, 0].set(0.0)
+
+        def scan_t(alpha, t):
+            blank_prev = blank_lp[:, t - 1, :]
+            horiz = jnp.where(t == 0, alpha, alpha + blank_prev)
+
+            def u_step(carry, ys):
+                h, l = ys
+                return jnp.logaddexp(h, carry + l), \
+                    jnp.logaddexp(h, carry + l)
+
+            first = horiz[:, 0]
+            _, rest = jax.lax.scan(u_step, first,
+                                   (horiz[:, 1:].T, lab_lp[:, t, :].T))
+            out = jnp.concatenate([first[:, None], rest.T], 1)
+            return out, out
+
+        _, alphas = jax.lax.scan(scan_t, alpha0, jnp.arange(T))
+        # total log prob: alpha[T-1, U] + blank at (T-1, U)
+        tl = (il - 1).astype(jnp.int32)            # last frame index
+        ul = ll.astype(jnp.int32)                  # last label index
+        a_end = alphas[tl, jnp.arange(B), ul]
+        final_blank = blank_lp[jnp.arange(B), tl, ul]
+        nll = -(a_end + final_blank)
+        return _reduce(nll, reduction)
+
+    return apply("rnnt_loss", fn, logits, labels, input_lengths,
+                 label_lengths)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """F.adaptive_log_softmax_with_loss (loss.py): adaptive softmax
+    (Grave et al.): a head over [shortlist + clusters], low-rank tails per
+    cluster. Returns (per-sample logprob, mean nll loss)."""
+    cutoffs = [int(c) for c in cutoffs]
+    shortlist = cutoffs[0]
+
+    def fn(x, lbl, hw, *rest):
+        has_bias = head_bias is not None
+        hb = rest[0] if has_bias else None
+        tails = rest[1:] if has_bias else rest
+        head = x @ hw
+        if hb is not None:
+            head = head + hb
+        head_lp = jax.nn.log_softmax(head, -1)     # [B, shortlist+K]
+        lbl = lbl.astype(jnp.int32)
+        out = jnp.zeros(lbl.shape, x.dtype)
+        # shortlist words
+        in_short = lbl < shortlist
+        short_lp = jnp.take_along_axis(
+            head_lp, jnp.minimum(lbl, shortlist - 1)[:, None], -1)[:, 0]
+        out = jnp.where(in_short, short_lp, out)
+        # clusters
+        bounds = [shortlist] + cutoffs[1:] if len(cutoffs) > 1 else [shortlist]
+        for k in range(len(tails) // 2):
+            lo = bounds[k]
+            hi = bounds[k + 1] if k + 1 < len(bounds) else lo
+            proj, cls_w = tails[2 * k], tails[2 * k + 1]
+            tail_logits = (x @ proj) @ cls_w
+            tail_lp = jax.nn.log_softmax(tail_logits, -1)
+            in_k = (lbl >= lo) & (lbl < hi)
+            rel = jnp.clip(lbl - lo, 0, tail_lp.shape[-1] - 1)
+            lp_k = head_lp[:, shortlist + k] + jnp.take_along_axis(
+                tail_lp, rel[:, None], -1)[:, 0]
+            out = jnp.where(in_k, lp_k, out)
+        return out, -out.mean()
+
+    args = [input, label, head_weight]
+    if head_bias is not None:
+        args.append(head_bias)
+    for pair in tail_weights:
+        args.extend(pair)
+    return apply("adaptive_log_softmax_with_loss", fn, *args)
+
+
+# ---------------------------------------------------------------------------
+# attention tail
+# ---------------------------------------------------------------------------
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """F.sparse_attention (ops.yaml `sparse_attention`): attention evaluated
+    only at a CSR-described sparsity pattern. TPU-native: dense QK^T on the
+    MXU with an additive -inf mask built from the CSR structure (see
+    sparse/nn.py rationale)."""
+    def fn(q, k, v, offs, cols):
+        B, H, S, D = q.shape
+        scores = q @ jnp.swapaxes(k, -1, -2) / jnp.sqrt(float(D))
+
+        def row_mask(offs_bh, cols_bh):
+            # CSR → dense boolean mask: element j belongs to the row r with
+            # offs[r] <= j < offs[r+1]
+            m = jnp.zeros((S, S), bool)
+            seg = jnp.searchsorted(offs_bh, jnp.arange(cols_bh.shape[0]),
+                                   side="right") - 1
+            return m.at[seg, cols_bh].set(True)
+
+        mask = jax.vmap(jax.vmap(row_mask))(offs.astype(jnp.int32),
+                                            cols.astype(jnp.int32))
+        neg = jnp.asarray(-1e9, scores.dtype)
+        scores = jnp.where(mask, scores, neg)
+        return jax.nn.softmax(scores, -1) @ v
+
+    return apply("sparse_attention", fn, query, key, value,
+                 sparse_csr_offset, sparse_csr_columns)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, name=None):
+    """F.flashmask_attention (incubate flashmask): column-sparse causal
+    masking described by per-column start rows (and optional end rows).
+    startend_row_indices [B, H or 1, S, 1|2|4]; None → plain (causal)
+    attention via the flash path."""
+    from .attention import scaled_dot_product_attention
+
+    if startend_row_indices is None:
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=causal)
+
+    def fn(q, k, v, se):
+        B, S, H, D = q.shape
+        qh = jnp.moveaxis(q, 2, 1)
+        kh = jnp.moveaxis(k, 2, 1)
+        vh = jnp.moveaxis(v, 2, 1)
+        scores = qh @ jnp.swapaxes(kh, -1, -2) / jnp.sqrt(float(D))
+        rows = jnp.arange(S)[:, None]          # query index
+        cols = jnp.arange(S)[None, :]          # key index
+        se = se.astype(jnp.int32)              # [B, Hm, S, n]
+        n = se.shape[-1]
+        if causal:
+            # per-key-column band: banned where start[col] <= row < end[col]
+            end = se[..., 1] if n >= 2 else jnp.full_like(se[..., 0], S)
+            st = se[..., 0][..., None, :]      # [B,Hm,1,S] broadcast over rows
+            en = end[..., None, :]
+            banned = (rows >= st) & (rows < en)
+            allow = (rows >= cols) & ~banned
+        else:
+            # bidirectional: n==2 means [LTStart, UTEnd] (flashmask spec);
+            # n==4 is the full [LTS, LTE, UTS, UTE]
+            lts = se[..., 0][..., None, :]
+            if n >= 4:
+                lte = se[..., 1][..., None, :]
+                uts = se[..., 2][..., None, :]
+                ute = se[..., 3][..., None, :]
+            else:
+                lte = jnp.full_like(lts, S)
+                uts = jnp.zeros_like(lts)
+                ute = (se[..., 1] if n >= 2
+                       else jnp.zeros_like(se[..., 0]))[..., None, :]
+            banned_low = (rows >= lts) & (rows < lte)
+            banned_up = (rows >= uts) & (rows < ute)
+            allow = ~(banned_low | banned_up)
+        neg = jnp.asarray(-1e9, scores.dtype)
+        scores = jnp.where(allow, scores, neg)
+        out = jax.nn.softmax(scores, -1) @ vh
+        return jnp.moveaxis(out, 1, 2)
+
+    return apply("flashmask_attention", fn, query, key, value,
+                 startend_row_indices)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         name=None):
+    """F.flash_attn_qkvpacked: packed [B, S, 3, H, D] → flash attention."""
+    from .attention import flash_attention
+
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out, sm = flash_attention(q, k, v, dropout=dropout, causal=causal,
+                              return_softmax=return_softmax)
+    if return_softmax:
+        return out, sm
+    return out
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale=None, dropout=0.0,
+                                causal=False, name=None):
+    """F.flash_attn_varlen_qkvpacked: ragged batch described by cumulative
+    sequence lengths [total_tokens, 3, H, D]. Each segment runs through the
+    flash path; segments are static python slices (host-side lengths —
+    matching the reference's eager varlen API)."""
+    from .attention import flash_attention
+
+    cu = np.asarray(unwrap(cu_seqlens_q)).astype(np.int64)
+    packed = unwrap(qkv)
+    outs = []
+    for i in range(cu.size - 1):
+        seg = packed[cu[i]:cu[i + 1]]           # [s_i, 3, H, D]
+        q, k, v = seg[:, 0], seg[:, 1], seg[:, 2]
+        o, _ = flash_attention(wrap(q[None]), wrap(k[None]), wrap(v[None]),
+                               dropout=dropout, causal=causal)
+        outs.append(unwrap(o)[0])
+    return wrap(jnp.concatenate(outs, 0))

@@ -1,0 +1,510 @@
+"""nn layer round-3 tail: the remaining reference nn.__all__ classes
+(python/paddle/nn/__init__.py) — thin Layer wrappers over the functional
+tail in functional/extra.py, plus generic RNN/BiRNN runners, seq2seq
+dynamic decoding, ParameterDict, and AdaptiveLogSoftmaxWithLoss.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_class import Tensor, unwrap, wrap
+from .layer import Layer
+from .initializer_core import Uniform
+from . import functional as F
+
+
+# ---------------------------------------------------------------------------
+# functional wrappers
+# ---------------------------------------------------------------------------
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+        self.training = True
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, self.training)
+
+
+class Unfold(Layer):
+    """nn.Unfold (im2col) over F.unfold."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        k, s, p, d = self._args
+        return F.unfold(x, k, strides=s, paddings=p, dilations=d)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        o, k, s, p, d = self._args
+        return F.fold(x, o, k, s, p, d)
+
+
+class Silu(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups, self.data_format = groups, data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size, data_format)
+
+    def forward(self, x, indices):
+        k, s, p, o, fmt = self._args
+        return F.max_unpool1d(x, indices, k, s, p, o, fmt)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, o = self._args
+        return F.max_unpool2d(x, indices, k, s, p, o)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size, data_format)
+
+    def forward(self, x, indices):
+        k, s, p, o, fmt = self._args
+        return F.max_unpool3d(x, indices, k, s, p, o, fmt)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, m = self._args
+        return F.fractional_max_pool2d(x, o, k, u, m)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, m = self._args
+        return F.fractional_max_pool3d(x, o, k, u, m)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self._args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                      data_format)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, *self._args)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                      data_format)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, *self._args)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = (padding, padding) if isinstance(padding, int) \
+            else tuple(padding)
+        self.data_format = data_format
+
+    def forward(self, x):
+        l, r = self.padding
+
+        def fn(a):
+            if self.data_format == "NCL":
+                return jnp.pad(a, ((0, 0), (0, 0), (l, r)))
+            return jnp.pad(a, ((0, 0), (l, r), (0, 0)))
+
+        from ..ops.registry import apply
+
+        return apply("zeropad1d", fn, x)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = (padding,) * 6 if isinstance(padding, int) \
+            else tuple(padding)
+        self.data_format = data_format
+
+    def forward(self, x):
+        l, r, tp, bo, fr, bk = self.padding
+
+        def fn(a):
+            if self.data_format == "NCDHW":
+                return jnp.pad(a, ((0, 0), (0, 0), (fr, bk), (tp, bo), (l, r)))
+            return jnp.pad(a, ((0, 0), (fr, bk), (tp, bo), (l, r), (0, 0)))
+
+        from ..ops.registry import apply
+
+        return apply("zeropad3d", fn, x)
+
+
+# ---------------------------------------------------------------------------
+# loss wrappers
+# ---------------------------------------------------------------------------
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, *self._args)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self._args = (full, epsilon, reduction)
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, *self._args)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        p, m, w, r = self._args
+        return F.multi_margin_loss(input, label, p, m, w, r)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._args = (weight, reduction)
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, *self._args)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, *self._args)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (blank, fastemit_lambda, reduction)
+
+    def forward(self, logits, labels, input_lengths, label_lengths):
+        b, f, r = self._args
+        return F.rnnt_loss(logits, labels, input_lengths, label_lengths,
+                           b, f, r)
+
+
+class HSigmoidLoss(Layer):
+    """nn.HSigmoidLoss (hierarchical sigmoid, python/paddle/nn/layer/loss.py):
+    owns the internal-node weight table."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        std = 1.0 / math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size],
+            default_initializer=Uniform(-std, std))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], is_bias=True,
+            default_initializer=Uniform(-std, std))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """nn.AdaptiveLogSoftmaxWithLoss (Grave et al. adaptive softmax)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.shortlist = self.cutoffs[0]
+        n_clusters = len(self.cutoffs) - 1
+        self.head_weight = self.create_parameter(
+            [in_features, self.shortlist + n_clusters])
+        self.head_bias = self.create_parameter(
+            [self.shortlist + n_clusters], is_bias=True) if head_bias else None
+        self.tail_weights = []
+        for k in range(n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (k + 1))))
+            osz = self.cutoffs[k + 1] - self.cutoffs[k]
+            proj = self.create_parameter([in_features, hsz])
+            cls = self.create_parameter([hsz, osz])
+            setattr(self, f"_tail_proj_{k}", proj)
+            setattr(self, f"_tail_cls_{k}", cls)
+            self.tail_weights.append((proj, cls))
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights, self.cutoffs,
+            self.head_bias)
+
+    def log_prob(self, input):
+        """Full [batch, n_classes] log-probabilities."""
+        head = unwrap(input) @ unwrap(self.head_weight)
+        if self.head_bias is not None:
+            head = head + unwrap(self.head_bias)
+        head_lp = jax.nn.log_softmax(head, -1)
+        parts = [head_lp[:, : self.shortlist]]
+        for k, (proj, cls) in enumerate(self.tail_weights):
+            tail_lp = jax.nn.log_softmax(
+                (unwrap(input) @ unwrap(proj)) @ unwrap(cls), -1)
+            parts.append(head_lp[:, self.shortlist + k][:, None] + tail_lp)
+        return wrap(jnp.concatenate(parts, -1))
+
+    def predict(self, input):
+        return wrap(jnp.argmax(unwrap(self.log_prob(input)), -1))
+
+
+# ---------------------------------------------------------------------------
+# generic RNN runners
+# ---------------------------------------------------------------------------
+
+class RNN(Layer):
+    """nn.RNN (python/paddle/nn/layer/rnn.py RNN): run any cell over time.
+    time_major=False → inputs [batch, time, ...]."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False, name=None):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        axis = 0 if self.time_major else 1
+        steps = unwrap(inputs).shape[axis]
+        idx = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outs = []
+        states = initial_states
+        for t in idx:
+            x_t = inputs[:, t] if axis == 1 else inputs[t]
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ..ops.manipulation import stack
+
+        return stack(outs, axis=axis), states
+
+
+class BiRNN(Layer):
+    """nn.BiRNN: forward + backward cells, concatenated features."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False, name=None):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, fw_states = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, bw_states = self.rnn_bw(inputs, st_bw, sequence_length)
+        from ..ops.manipulation import concat
+
+        return concat([out_fw, out_bw], axis=-1), (fw_states, bw_states)
+
+
+# ---------------------------------------------------------------------------
+# seq2seq decoding
+# ---------------------------------------------------------------------------
+
+class BeamSearchDecoder(Layer):
+    """nn.BeamSearchDecoder (python/paddle/nn/decode.py): beam search over a
+    cell + embedding + output head."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _logits(self, out):
+        return self.output_fn(out) if self.output_fn is not None else out
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=20, **kwargs):
+    """nn.dynamic_decode: greedy-within-beam decoding loop (host loop, each
+    step jit-compiled through the cell). Returns (ids [B, T, beam],
+    final log-probs [B, beam])."""
+    beam = decoder.beam_size
+    cell_state = inits
+    # first step: start tokens
+    b_ref = None
+    tok = None
+    ids_steps = []
+    log_probs = None
+    state = cell_state
+    for step in range(max_step_num):
+        if tok is None:
+            # bootstrap: single start token per batch item
+            emb_in = decoder.embedding_fn(decoder.start_token) \
+                if decoder.embedding_fn else decoder.start_token
+            out, state = decoder.cell(emb_in, state)
+            logits = decoder._logits(out)
+            lp = jax.nn.log_softmax(unwrap(logits), -1)
+            b = lp.shape[0]
+            top_lp, top_ids = jax.lax.top_k(lp, beam)     # [B, beam]
+            log_probs = top_lp
+            tok = top_ids
+            ids_steps.append(top_ids)
+            # tile state per beam
+            state = jax.tree_util.tree_map(
+                lambda s: jnp.repeat(unwrap(s), beam, axis=0), state)
+            continue
+        flat_tok = wrap(unwrap(tok).reshape(-1))           # [B*beam]
+        emb_in = decoder.embedding_fn(flat_tok) if decoder.embedding_fn \
+            else flat_tok
+        out, state = decoder.cell(emb_in, state)
+        logits = decoder._logits(out)
+        lp = jax.nn.log_softmax(unwrap(logits), -1)        # [B*beam, V]
+        V = lp.shape[-1]
+        b = unwrap(tok).shape[0]
+        total = log_probs[..., None] + lp.reshape(b, beam, V)
+        flat = total.reshape(b, beam * V)
+        top_lp, flat_ids = jax.lax.top_k(flat, beam)
+        beam_src = flat_ids // V
+        new_tok = flat_ids % V
+        log_probs = top_lp
+        tok = new_tok
+        # reorder beams in the recorded history
+        ids_steps = [jnp.take_along_axis(s, beam_src, axis=1)
+                     for s in ids_steps]
+        ids_steps.append(new_tok)
+        # reorder cell state rows to follow surviving beams
+        gather_rows = (jnp.arange(b)[:, None] * beam + beam_src).reshape(-1)
+        state = jax.tree_util.tree_map(
+            lambda s: unwrap(s)[gather_rows], state)
+        if bool((new_tok == decoder.end_token).all()):
+            break
+    ids = jnp.stack(ids_steps, axis=1)                     # [B, T, beam]
+    return wrap(ids), wrap(log_probs)
+
+
+# ---------------------------------------------------------------------------
+# containers / clip re-exports
+# ---------------------------------------------------------------------------
+
+class ParameterDict(Layer):
+    """nn.ParameterDict (container.py ParameterDict)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        self._keys = []
+        if parameters:
+            for k, v in (parameters.items()
+                         if isinstance(parameters, dict) else parameters):
+                self[k] = v
+
+    def __setitem__(self, key, param):
+        self._keys.append(key) if key not in self._keys else None
+        self.add_parameter(str(key), param)
+
+    def __getitem__(self, key):
+        return getattr(self, str(key))
+
+    def __contains__(self, key):
+        return key in self._keys
+
+    def __len__(self):
+        return len(self._keys)
+
+    def keys(self):
+        return list(self._keys)
+
+    def items(self):
+        return [(k, self[k]) for k in self._keys]
+
+    def values(self):
+        return [self[k] for k in self._keys]
+
+    def update(self, parameters):
+        for k, v in (parameters.items()
+                     if isinstance(parameters, dict) else parameters):
+            self[k] = v

@@ -1008,6 +1008,55 @@ RETROFITS = [
     Retrofit("standard_normal", "standard_normal", "random",
              differentiable=False,
              tested_by=_TT + "test_random_seed_reproducible"),
+    # round-3 nn.functional tail (tests: tests/test_nn_extra.py)
+    Retrofit("pairwise_distance", "nn.functional.pairwise_distance", "nn",
+             tested_by="tests/test_nn_extra.py::test_functional_tail_wrappers"),
+    Retrofit("zeropad2d", "nn.functional.zeropad2d", "nn",
+             tested_by="tests/test_nn_extra.py::test_functional_tail_wrappers"),
+    Retrofit("bilinear", "nn.functional.bilinear", "nn",
+             tested_by="tests/test_nn_extra.py::test_functional_tail_wrappers"),
+    Retrofit("feature_alpha_dropout", "nn.functional.feature_alpha_dropout",
+             "nn", tested_by="tests/test_nn_extra.py::test_functional_tail_wrappers"),
+    Retrofit("gather_tree", "nn.functional.gather_tree", "nn",
+             differentiable=False,
+             tested_by="tests/test_nn_extra.py::test_gather_tree_traces_parents"),
+    Retrofit("lp_pool1d", "nn.functional.lp_pool1d", "nn",
+             tested_by="tests/test_nn_extra.py::test_functional_tail_wrappers"),
+    Retrofit("max_unpool1d", "nn.functional.max_unpool1d", "nn",
+             tested_by="tests/test_nn_extra.py::test_max_pool_return_mask_and_unpool_roundtrip"),
+    Retrofit("max_unpool3d", "nn.functional.max_unpool3d", "nn",
+             tested_by="tests/test_nn_extra.py::test_max_pool_return_mask_and_unpool_roundtrip"),
+    Retrofit("fractional_max_pool2d", "nn.functional.fractional_max_pool2d",
+             "nn", tested_by="tests/test_nn_extra.py::test_fractional_pool_partitions_input"),
+    Retrofit("fractional_max_pool3d", "nn.functional.fractional_max_pool3d",
+             "nn", tested_by="tests/test_nn_extra.py::test_fractional_pool_partitions_input"),
+    Retrofit("dice_loss", "nn.functional.dice_loss", "nn",
+             tested_by="tests/test_nn_extra.py::test_inplace_activations_and_losses"),
+    Retrofit("poisson_nll_loss", "nn.functional.poisson_nll_loss", "nn",
+             tested_by="tests/test_nn_extra.py::test_functional_tail_wrappers"),
+    Retrofit("gaussian_nll_loss", "nn.functional.gaussian_nll_loss", "nn",
+             tested_by="tests/test_nn_extra.py::test_functional_tail_wrappers"),
+    Retrofit("triplet_margin_with_distance_loss",
+             "nn.functional.triplet_margin_with_distance_loss", "nn",
+             tested_by="tests/test_nn_extra.py::test_inplace_activations_and_losses"),
+    Retrofit("hsigmoid_loss", "nn.functional.hsigmoid_loss", "nn",
+             tested_by="tests/test_nn_extra.py::test_hsigmoid_loss_binary_tree"),
+    Retrofit("rnnt_loss", "nn.functional.rnnt_loss", "nn",
+             tested_by="tests/test_nn_extra.py::test_rnnt_loss_matches_dp_reference"),
+    Retrofit("adaptive_log_softmax_with_loss",
+             "nn.functional.adaptive_log_softmax_with_loss", "nn",
+             tested_by="tests/test_nn_extra.py::test_adaptive_log_softmax_normalizes"),
+    Retrofit("sparse_attention", "nn.functional.sparse_attention", "nn",
+             tested_by="tests/test_nn_extra.py::test_sparse_attention_csr_mask"),
+    Retrofit("flashmask_attention", "nn.functional.flashmask_attention", "nn",
+             tested_by="tests/test_nn_extra.py::test_flashmask_attention_matches_dense_mask"),
+    Retrofit("flash_attn_qkvpacked", "nn.functional.flash_attn_qkvpacked",
+             "nn", tested_by="tests/test_nn_extra.py::test_functional_tail_wrappers"),
+    Retrofit("class_center_sample", "nn.functional.class_center_sample",
+             "nn", differentiable=False,
+             tested_by="tests/test_nn_extra.py::test_class_center_sample_contains_positives"),
+    Retrofit("max_pool_with_index", "nn.functional.max_pool2d", "nn",
+             tested_by="tests/test_nn_extra.py::test_max_pool_return_mask_and_unpool_roundtrip"),
     # round-3 top-level tail
     Retrofit("hstack", "hstack", "manipulation"),
     Retrofit("vstack", "vstack", "manipulation"),

@@ -1,0 +1,279 @@
+"""Round-3 nn tail: numeric references for the new F.*/nn.* surface
+(rnnt_loss DP, hierarchical sigmoid, pooling masks, adaptive softmax,
+flashmask attention, beam decode)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _rnnt_ref(logits, labels, blank=0):
+    """Direct O(T*U) log-space DP in numpy (per sample)."""
+    T, U1, V = logits.shape
+    U = U1 - 1
+    lp = np.log(np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+    alpha = np.full((T, U1), -np.inf)
+    alpha[0, 0] = 0.0
+    for t_idx in range(T):
+        for u in range(U1):
+            acc = []
+            if t_idx > 0:
+                acc.append(alpha[t_idx - 1, u] + lp[t_idx - 1, u, blank])
+            if u > 0:
+                acc.append(alpha[t_idx, u - 1] + lp[t_idx, u - 1, labels[u - 1]])
+            if acc:
+                m = max(acc)
+                alpha[t_idx, u] = m + np.log(sum(np.exp(a - m) for a in acc))
+    return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+
+def test_rnnt_loss_matches_dp_reference():
+    rng = np.random.RandomState(0)
+    B, T, U, V = 2, 5, 3, 6
+    logits = rng.randn(B, T, U + 1, V).astype("float32")
+    labels = rng.randint(1, V, (B, U))
+    got = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(np.array([T, T])),
+                      paddle.to_tensor(np.array([U, U])),
+                      reduction="none")
+    want = np.array([_rnnt_ref(logits[b], labels[b]) for b in range(B)])
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-4)
+
+
+def test_rnnt_loss_respects_lengths():
+    rng = np.random.RandomState(1)
+    B, T, U, V = 2, 6, 3, 5
+    logits = rng.randn(B, T, U + 1, V).astype("float32")
+    labels = rng.randint(1, V, (B, U))
+    # sample 1 uses only T-2 frames / U-1 labels
+    got = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(np.array([T, T - 2])),
+                      paddle.to_tensor(np.array([U, U - 1])),
+                      reduction="none").numpy()
+    want = _rnnt_ref(logits[1][: T - 2, : U, :], labels[1][: U - 1])
+    np.testing.assert_allclose(got[1], want, rtol=1e-4, atol=1e-4)
+
+
+def test_max_pool_return_mask_and_unpool_roundtrip():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 6, 8).astype("float32")
+    t = paddle.to_tensor(x)
+    mx, idx = F.max_pool2d(t, 2, 2, return_mask=True)
+    # values match plain pooling; indices point at the max elements
+    np.testing.assert_allclose(mx.numpy(), F.max_pool2d(t, 2, 2).numpy())
+    flat = x.reshape(2, 3, -1)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, idx.numpy().reshape(2, 3, -1), -1),
+        mx.numpy().reshape(2, 3, -1))
+    # unpool scatters each max back to its recorded position
+    un = F.max_unpool2d(mx, idx, 2, 2).numpy()
+    assert un.shape == x.shape
+    np.testing.assert_allclose(np.sort(un[un != 0]),
+                               np.sort(mx.numpy().reshape(-1)))
+
+
+def test_fractional_pool_partitions_input():
+    x = paddle.to_tensor(np.arange(64, dtype="float32").reshape(1, 1, 8, 8))
+    out = F.fractional_max_pool2d(x, 4)
+    assert out.shape == [1, 1, 4, 4]
+    # global max must survive any pooling partition
+    assert float(out.numpy().max()) == 63.0
+    out3 = F.fractional_max_pool3d(
+        paddle.to_tensor(np.arange(216, dtype="float32").reshape(1, 1, 6, 6, 6)), 2)
+    assert out3.shape == [1, 1, 2, 2, 2]
+    assert float(out3.numpy().max()) == 215.0
+
+
+def test_hsigmoid_loss_binary_tree():
+    """num_classes=2: the tree has one internal node → plain logistic loss."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 5).astype("float32")
+    w = rng.randn(1, 5).astype("float32")
+    lab = np.array([0, 1, 0, 1])
+    got = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lab), 2,
+                          paddle.to_tensor(w)).numpy()
+    logit = x @ w.T
+    # leaf l ↔ node 2+l; bit for class 0 is 0, class 1 is 1
+    z = (1 - 2 * lab)[:, None] * logit
+    want = np.log1p(np.exp(-np.abs(z))) + np.maximum(z, 0)
+    np.testing.assert_allclose(got, want.sum(-1).mean(), rtol=1e-5)
+
+
+def test_hsigmoid_loss_grad_flows():
+    w = paddle.create_parameter([9, 8], "float32")
+    x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"),
+                         stop_gradient=False)
+    loss = F.hsigmoid_loss(x, paddle.to_tensor(np.array([1, 4, 7, 9])), 10, w)
+    loss.backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+def test_adaptive_log_softmax_normalizes():
+    als = nn.AdaptiveLogSoftmaxWithLoss(8, 12, [4, 8])
+    lp = als.log_prob(paddle.to_tensor(np.random.rand(3, 8).astype("float32")))
+    np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1), 1.0, rtol=1e-4)
+    out, loss = als(paddle.to_tensor(np.random.rand(5, 8).astype("float32")),
+                    paddle.to_tensor(np.array([0, 3, 6, 9, 11])))
+    # per-sample outputs are the label log-probs; loss is their negative mean
+    np.testing.assert_allclose(-out.numpy().mean(), loss.numpy(), rtol=1e-5)
+
+
+def test_gather_tree_traces_parents():
+    # T=3, batch=1, beam=2
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]])
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]])
+    out = F.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents)).numpy()
+    # beam 0 at t=2 came from parent 0 at t=2 → which came from parent 1 at t=1
+    assert out[2, 0, 0] == 5
+    assert out[1, 0, 0] == 3  # parent chain: t2 beam0 -> t1 beam0? parents[2,0,0]=0 -> t1 beam0 id 3
+    assert out[0, 0, 0] == 2  # parents[1,0,0]=1 -> t0 beam1 id 2
+
+
+def test_flashmask_attention_matches_dense_mask():
+    rng = np.random.RandomState(5)
+    B, S, H, D = 1, 6, 2, 8
+    q = rng.randn(B, S, H, D).astype("float32")
+    # startend_row_indices [B, 1, S, 1]: causal masking starts at row s[i]
+    start = np.array([6, 6, 4, 4, 6, 6])  # keys 2,3 masked for rows >= 4
+    se = start.reshape(1, 1, S, 1)
+    got = F.flashmask_attention(paddle.to_tensor(q), paddle.to_tensor(q),
+                                paddle.to_tensor(q), paddle.to_tensor(se),
+                                causal=True).numpy()
+    # dense reference
+    qh = np.moveaxis(q, 2, 1)
+    scores = qh @ np.swapaxes(qh, -1, -2) / np.sqrt(D)
+    rows = np.arange(S)[:, None]
+    cols = np.arange(S)[None, :]
+    allow = (rows >= cols) & ~(rows >= start[None, :])
+    scores = np.where(allow, scores, -1e9)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.moveaxis(p @ qh, 1, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_attention_csr_mask():
+    rng = np.random.RandomState(6)
+    B, H, S, D = 1, 1, 4, 8
+    q = rng.randn(B, H, S, D).astype("float32")
+    # CSR pattern: row i attends to columns {0, i}
+    offs = np.array([[[0, 2, 4, 6, 8]]])
+    cols = np.array([[[0, 0, 0, 1, 0, 2, 0, 3]]])
+    out = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(q),
+                             paddle.to_tensor(q), paddle.to_tensor(offs),
+                             paddle.to_tensor(cols)).numpy()
+    scores = q[0, 0] @ q[0, 0].T / np.sqrt(D)
+    mask = np.zeros((S, S), bool)
+    for i in range(S):
+        mask[i, 0] = True
+        mask[i, i] = True
+    scores = np.where(mask, scores, -1e9)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out[0, 0], p @ q[0, 0], rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_birnn_and_decode():
+    paddle.seed(0)
+    cell = nn.GRUCell(4, 6)
+    out, state = nn.RNN(cell)(paddle.randn([2, 5, 4]))
+    assert out.shape == [2, 5, 6]
+    # reverse RNN sees the sequence backwards
+    out_r, _ = nn.RNN(cell, is_reverse=True)(paddle.randn([2, 5, 4]))
+    assert out_r.shape == [2, 5, 6]
+    bi = nn.BiRNN(nn.GRUCell(4, 6), nn.GRUCell(4, 6))
+    out_b, _ = bi(paddle.randn([2, 5, 4]))
+    assert out_b.shape == [2, 5, 12]
+    dec = nn.BeamSearchDecoder(
+        nn.GRUCell(3, 6), start_token=paddle.zeros([2], "int64"),
+        end_token=7, beam_size=2, embedding_fn=nn.Embedding(8, 3),
+        output_fn=nn.Linear(6, 8))
+    ids, lp = nn.dynamic_decode(dec, max_step_num=4)
+    assert ids.shape[0] == 2 and ids.shape[2] == 2 and lp.shape == [2, 2]
+    # beam log-probs sorted descending
+    assert (np.diff(lp.numpy(), axis=-1) <= 1e-6).all()
+
+
+def test_parameter_dict_registers():
+    pd = nn.ParameterDict({"w": paddle.create_parameter([2, 2], "float32")})
+    pd["b"] = paddle.create_parameter([3], "float32")
+    assert set(pd.keys()) == {"w", "b"}
+    names = dict(pd.named_parameters()).keys()
+    assert len(names) == 2
+    assert "w" in pd and len(pd) == 2
+
+
+def test_inplace_activations_and_losses():
+    x = paddle.to_tensor(np.array([-1.0, 2.0], dtype="float32"))
+    F.elu_(x)
+    np.testing.assert_allclose(x.numpy()[1], 2.0)
+    y = paddle.to_tensor(np.array([-3.0, 3.0], dtype="float32"))
+    F.hardtanh_(y)
+    np.testing.assert_allclose(y.numpy(), [-1.0, 1.0])
+    # dice loss on a perfect prediction is ~0
+    lbl = np.array([[[0], [1]]])
+    pred = np.zeros((1, 2, 2), "float32")
+    pred[0, 0, 0] = 1
+    pred[0, 1, 1] = 1
+    assert float(F.dice_loss(paddle.to_tensor(pred),
+                             paddle.to_tensor(lbl)).numpy()) < 1e-3
+
+
+def test_class_center_sample_contains_positives():
+    lab = paddle.to_tensor(np.array([2, 2, 8, 5]))
+    remapped, centers = F.class_center_sample(lab, 10, 6)
+    c = centers.numpy()
+    assert {2, 5, 8}.issubset(set(c.tolist())) and c.size == 6
+    # remapped labels index into the sampled centers
+    np.testing.assert_array_equal(c[remapped.numpy()], lab.numpy())
+
+
+def test_functional_tail_wrappers():
+    """Direct coverage for the remaining F round-3 entries: bilinear,
+    zeropad2d, pairwise_distance, poisson/gaussian NLL, lp_pool1d,
+    feature_alpha_dropout, flash_attn_qkvpacked."""
+    rng = np.random.RandomState(7)
+    x1 = rng.randn(3, 5).astype("float32")
+    x2 = rng.randn(3, 4).astype("float32")
+    w = rng.randn(6, 5, 4).astype("float32")
+    b = rng.randn(6).astype("float32")
+    got = F.bilinear(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                     paddle.to_tensor(w), paddle.to_tensor(b)).numpy()
+    want = np.einsum("bi,oij,bj->bo", x1, w, x2) + b
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    z = F.zeropad2d(paddle.ones([1, 1, 2, 2]), [1, 2, 3, 4]).numpy()
+    assert z.shape == (1, 1, 9, 5) and z.sum() == 4.0
+
+    a = rng.randn(4, 8).astype("float32")
+    c = rng.randn(4, 8).astype("float32")
+    np.testing.assert_allclose(
+        F.pairwise_distance(paddle.to_tensor(a), paddle.to_tensor(c)).numpy(),
+        np.linalg.norm(a - c + 1e-6, axis=-1), rtol=1e-5)
+
+    inp = rng.rand(6).astype("float32") + 0.5
+    lbl = rng.poisson(2.0, 6).astype("float32")
+    np.testing.assert_allclose(
+        F.poisson_nll_loss(paddle.to_tensor(inp), paddle.to_tensor(lbl)).numpy(),
+        (np.exp(inp) - lbl * inp).mean(), rtol=1e-5)
+    var = rng.rand(6).astype("float32") + 0.1
+    np.testing.assert_allclose(
+        F.gaussian_nll_loss(paddle.to_tensor(inp), paddle.to_tensor(lbl),
+                            paddle.to_tensor(var)).numpy(),
+        (0.5 * (np.log(var) + (lbl - inp) ** 2 / var)).mean(), rtol=1e-4)
+
+    lp1 = F.lp_pool1d(paddle.to_tensor(rng.randn(1, 2, 8).astype("float32")),
+                      2.0, 2)
+    assert lp1.shape == [1, 2, 4]
+
+    paddle.seed(1)
+    fad = F.feature_alpha_dropout(paddle.ones([2, 8, 4]), 0.5)
+    # whole channels share their fate
+    per_channel = fad.numpy().std(axis=-1)
+    np.testing.assert_allclose(per_channel, 0.0, atol=1e-6)
+
+    qkv = paddle.to_tensor(rng.randn(1, 4, 3, 2, 8).astype("float32"))
+    out = F.flash_attn_qkvpacked(qkv, causal=True)
+    assert out.shape == [1, 4, 2, 8]
