@@ -122,3 +122,20 @@ def create_dct(n_mfcc: int, n_mels: int, norm="ortho", dtype="float32"):
     else:
         basis *= 2.0
     return paddle.to_tensor(basis.astype(np.dtype(dtype)))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    """paddle.audio.functional.fft_frequencies (audio/functional/functional.py):
+    center frequencies of rfft bins."""
+    from ..tensor_class import wrap
+
+    return wrap(jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0, f_max: float = 11025.0,
+                    htk: bool = False, dtype: str = "float32"):
+    """paddle.audio.functional.mel_frequencies: mel-spaced frequency grid."""
+    from ..tensor_class import wrap
+
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return wrap(jnp.asarray(mel_to_hz(mels, htk)).astype(dtype))

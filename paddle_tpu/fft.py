@@ -7,8 +7,8 @@ import jax.numpy as jnp
 from .ops.registry import apply
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
-           "fft2", "ifft2", "rfft2", "irfft2",
-           "fftn", "ifftn", "rfftn", "irfftn",
+           "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+           "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
            "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
 
 
@@ -62,3 +62,54 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """paddle.fft.hfft2 (fft.py hfft2 = fftn_c2r over 2 axes)."""
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """paddle.fft.hfftn (fft.py fftn_c2r forward=True): full complex FFT
+    over the leading axes, Hermitian c2r transform over the last axis."""
+    def fn(a):
+        if axes is not None:
+            ax = tuple(axes)
+        elif s is not None:
+            ax = tuple(range(-len(s), 0))  # s pairs with the LAST len(s) axes
+        else:
+            ax = tuple(range(-a.ndim, 0))
+        lead, last = ax[:-1], ax[-1]
+        out = a
+        if lead:
+            s_lead = None if s is None else list(s[:-1])
+            out = jnp.fft.fftn(out, s=s_lead, axes=lead, norm=norm)
+        n_last = None if s is None else s[-1]
+        return jnp.fft.hfft(out, n=n_last, axis=last, norm=norm)
+
+    return apply("hfftn", fn, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """paddle.fft.ihfftn (fftn_r2c forward=False): inverse of hfftn —
+    ihfft over the last axis, then inverse complex FFT over the rest."""
+    def fn(a):
+        if axes is not None:
+            ax = tuple(axes)
+        elif s is not None:
+            ax = tuple(range(-len(s), 0))
+        else:
+            ax = tuple(range(-a.ndim, 0))
+        lead, last = ax[:-1], ax[-1]
+        n_last = None if s is None else s[-1]
+        out = jnp.fft.ihfft(a, n=n_last, axis=last, norm=norm)
+        if lead:
+            s_lead = None if s is None else list(s[:-1])
+            out = jnp.fft.ifftn(out, s=s_lead, axes=lead, norm=norm)
+        return out
+
+    return apply("ihfftn", fn, x)

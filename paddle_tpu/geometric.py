@@ -8,7 +8,9 @@ import jax.numpy as jnp
 
 from .ops.registry import apply
 
-__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+__all__ = ["reindex_graph", "reindex_heter_graph", "sample_neighbors",
+           "weighted_sample_neighbors",
+           "segment_sum", "segment_mean", "segment_max", "segment_min",
            "send_u_recv", "send_ue_recv", "send_uv"]
 
 
@@ -108,3 +110,157 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
                 "div": a / b}[message_op]
 
     return apply("send_uv", fn, x, y, src_index, dst_index)
+
+
+def _np_of(t):
+    import numpy as np
+
+    from .tensor_class import unwrap
+
+    return np.asarray(unwrap(t))
+
+
+def _host_rng():
+    """Host-side numpy RNG derived from the framework key stream, so
+    paddle.seed(k) makes graph sampling reproducible like device ops."""
+    import numpy as np
+
+    import jax
+
+    from .framework import random as _random
+
+    key_data = np.asarray(jax.random.key_data(_random.next_key()))
+    return np.random.default_rng(int(key_data.reshape(-1)[-1]) & 0x7FFFFFFF)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """paddle.geometric.reindex_graph (geometric/reindex.py; graph_reindex
+    kernel). Data-dependent output sizes → host-side eager (the reference's
+    kernel is CPU/GPU-eager too).
+
+    Returns (reindex_src, reindex_dst, out_nodes): out_nodes is x followed
+    by first-appearance neighbor nodes; src/dst are edges in local ids."""
+    import numpy as np
+
+    from .tensor_class import wrap
+    import jax.numpy as jnp
+
+    xs = _np_of(x).reshape(-1)
+    nb = _np_of(neighbors).reshape(-1)
+    cnt = _np_of(count).reshape(-1)
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    src = np.empty(nb.size, np.int64)
+    for i, v in enumerate(nb):
+        vi = int(v)
+        if vi not in mapping:
+            mapping[vi] = len(out_nodes)
+            out_nodes.append(vi)
+        src[i] = mapping[vi]
+    dst = np.repeat(np.arange(xs.size, dtype=np.int64), cnt)
+    return (wrap(jnp.asarray(src)), wrap(jnp.asarray(dst)),
+            wrap(jnp.asarray(np.asarray(out_nodes, np.int64))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """paddle.geometric.reindex_heter_graph: reindex against several
+    neighbor sets sharing one node mapping."""
+    import numpy as np
+
+    from .tensor_class import wrap
+    import jax.numpy as jnp
+
+    xs = _np_of(x).reshape(-1)
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    srcs, dsts = [], []
+    for nbr, cnt in zip(neighbors, count):
+        nb = _np_of(nbr).reshape(-1)
+        c = _np_of(cnt).reshape(-1)
+        src = np.empty(nb.size, np.int64)
+        for i, v in enumerate(nb):
+            vi = int(v)
+            if vi not in mapping:
+                mapping[vi] = len(out_nodes)
+                out_nodes.append(vi)
+            src[i] = mapping[vi]
+        srcs.append(src)
+        dsts.append(np.repeat(np.arange(xs.size, dtype=np.int64), c))
+    return (wrap(jnp.asarray(np.concatenate(srcs))),
+            wrap(jnp.asarray(np.concatenate(dsts))),
+            wrap(jnp.asarray(np.asarray(out_nodes, np.int64))))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """paddle.geometric.sample_neighbors (graph_sample_neighbors kernel):
+    uniform sampling from a CSC graph. Host-side eager (data-dependent)."""
+    import numpy as np
+
+    from .framework import random as _random
+    from .tensor_class import wrap
+    import jax.numpy as jnp
+
+    r = _np_of(row).reshape(-1)
+    cp = _np_of(colptr).reshape(-1)
+    nodes = _np_of(input_nodes).reshape(-1)
+    ev = _np_of(eids).reshape(-1) if eids is not None else None
+    rng = _host_rng()
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < idx.size:
+            idx = rng.choice(idx, size=sample_size, replace=False)
+        out_n.append(r[idx])
+        out_c.append(idx.size)
+        if ev is not None:
+            out_e.append(ev[idx])
+    neighbors = wrap(jnp.asarray(np.concatenate(out_n) if out_n
+                                 else np.empty(0, np.int64)))
+    counts = wrap(jnp.asarray(np.asarray(out_c, np.int64)))
+    if return_eids:
+        if ev is None:
+            raise ValueError("return_eids=True requires eids")
+        return neighbors, counts, wrap(jnp.asarray(np.concatenate(out_e)))
+    return neighbors, counts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """paddle.geometric.weighted_sample_neighbors: weight-proportional
+    sampling without replacement (A-ExpJ reservoir in the reference kernel;
+    numpy weighted choice here — same distribution)."""
+    import numpy as np
+
+    from .tensor_class import wrap
+    import jax.numpy as jnp
+
+    r = _np_of(row).reshape(-1)
+    cp = _np_of(colptr).reshape(-1)
+    w = _np_of(edge_weight).reshape(-1).astype(np.float64)
+    nodes = _np_of(input_nodes).reshape(-1)
+    ev = _np_of(eids).reshape(-1) if eids is not None else None
+    rng = _host_rng()
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < idx.size:
+            p = w[idx] / w[idx].sum()
+            idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+        out_n.append(r[idx])
+        out_c.append(idx.size)
+        if ev is not None:
+            out_e.append(ev[idx])
+    neighbors = wrap(jnp.asarray(np.concatenate(out_n) if out_n
+                                 else np.empty(0, np.int64)))
+    counts = wrap(jnp.asarray(np.asarray(out_c, np.int64)))
+    if return_eids:
+        if ev is None:
+            raise ValueError("return_eids=True requires eids")
+        return neighbors, counts, wrap(jnp.asarray(np.concatenate(out_e)))
+    return neighbors, counts

@@ -45,3 +45,247 @@ def accuracy_check(x, y, fn_name="accuracy_check", rtol=1e-5, atol=1e-8,
         f"[{fn_name}] tensors differ: max_abs_diff={diff.max():.6g} "
         f"max_rel_diff={(diff / denom).max():.6g} at index {tuple(int(i) for i in idx)} "
         f"(rtol={rtol}, atol={atol})")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """incubate.softmax_mask_fuse_upper_triangle (fused_softmax_mask_
+    upper_triangle op): causal softmax — upper triangle masked to -inf,
+    fused by XLA into one kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.registry import apply
+
+    def fn(a):
+        s = a.shape[-1]
+        rows = jnp.arange(a.shape[-2])[:, None]
+        cols = jnp.arange(s)[None, :]
+        neg = jnp.asarray(-1e9, a.dtype)
+        return jax.nn.softmax(jnp.where(cols <= rows, a, neg), -1)
+
+    return apply("softmax_mask_fuse_upper_triangle", fn, x)
+
+
+def identity_loss(x, reduction="none", name=None):
+    """incubate.identity_loss (ops.yaml `identity_loss`)."""
+    from ..ops.registry import apply
+    import jax.numpy as jnp
+
+    red = {"none": 2, "sum": 1, "mean": 0}.get(reduction, reduction)
+
+    def fn(a):
+        if red == 0:
+            return a.mean()
+        if red == 1:
+            return a.sum()
+        return a
+
+    return apply("identity_loss", fn, x)
+
+
+# geometric aliases kept under their legacy incubate names
+def segment_sum(data, segment_ids, name=None):
+    from ..geometric import segment_sum as f
+
+    return f(data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    from ..geometric import segment_mean as f
+
+    return f(data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    from ..geometric import segment_max as f
+
+    return f(data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    from ..geometric import segment_min as f
+
+    return f(data, segment_ids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ..geometric import reindex_graph
+
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ..geometric import sample_neighbors
+
+    return sample_neighbors(row, colptr, input_nodes, sample_size, eids,
+                            return_eids, perm_buffer)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """incubate.graph_khop_sampler (graph_khop_sampler op): multi-hop
+    sampling. Returns (edge_src, edge_dst, sample_index, reindex_x):
+    local-id edges over the union node set, the union's global ids, and
+    the input nodes' local ids."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..geometric import sample_neighbors
+    from ..tensor_class import unwrap, wrap
+
+    frontier_global = np.asarray(unwrap(input_nodes)).reshape(-1)
+    mapping = {int(v): i for i, v in enumerate(frontier_global)}
+    nodes = list(frontier_global)
+    e_src, e_dst = [], []
+    for size in sample_sizes:
+        fr = wrap(jnp.asarray(frontier_global))
+        nb, cnt = sample_neighbors(row, colptr, fr, sample_size=size)
+        nb_np = np.asarray(unwrap(nb))
+        cnt_np = np.asarray(unwrap(cnt))
+        dst_global = np.repeat(frontier_global, cnt_np)
+        for s, d in zip(nb_np, dst_global):
+            si = int(s)
+            if si not in mapping:
+                mapping[si] = len(nodes)
+                nodes.append(si)
+            e_src.append(mapping[si])
+            e_dst.append(mapping[int(d)])
+        frontier_global = np.unique(nb_np)
+    edge_src = wrap(jnp.asarray(np.asarray(e_src, np.int64)))
+    edge_dst = wrap(jnp.asarray(np.asarray(e_dst, np.int64)))
+    sample_index = wrap(jnp.asarray(np.asarray(nodes, np.int64)))
+    reindex_x = wrap(jnp.asarray(np.arange(
+        np.asarray(unwrap(input_nodes)).size, dtype=np.int64)))
+    return edge_src, edge_dst, sample_index, reindex_x
+
+
+class LookAhead:
+    """incubate.LookAhead (incubate/optimizer/lookahead.py): k inner steps,
+    then slow weights ← slow + alpha (fast − slow)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow = None
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list or []
+
+    def step(self):
+        import jax.numpy as jnp
+
+        from ..tensor_class import unwrap
+
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._slow is None:
+            self._slow = [unwrap(p).astype(jnp.float32)
+                          for p in self._params()]
+        if self._step % self.k == 0:
+            for i, p in enumerate(self._params()):
+                fast = unwrap(p).astype(jnp.float32)
+                slow = self._slow[i] + self.alpha * (fast - self._slow[i])
+                self._slow[i] = slow
+                p._array = slow.astype(unwrap(p).dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """incubate.ModelAverage (incubate/optimizer/modelaverage.py): running
+    average of parameters with apply()/restore() swap."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters) if parameters else []
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._sums = None
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        import jax.numpy as jnp
+
+        from ..tensor_class import unwrap
+
+        if self._sums is None:
+            self._sums = [jnp.zeros(tuple(p.shape), jnp.float32)
+                          for p in self._params]
+        # reference semantics: accumulate the sum, cap the window at
+        # max(min_average_window, count*rate) by restarting the sum
+        window = max(self._min_w,
+                     min(self._max_w, int(self._count * self._rate) + 1))
+        if self._count and self._count % window == 0 and \
+                self._count >= self._max_w:
+            self._sums = [jnp.zeros_like(s) for s in self._sums]
+            self._count = 0
+        self._sums = [s + unwrap(p).astype(jnp.float32)
+                      for s, p in zip(self._sums, self._params)]
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager style use is fine)."""
+        from ..tensor_class import unwrap
+
+        if self._sums is None or self._count == 0:
+            return self
+        self._backup = [unwrap(p) for p in self._params]
+        for p, s in zip(self._params, self._sums):
+            p._array = (s / self._count).astype(p._array.dtype)
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._array = b
+            self._backup = None
+
+    def __enter__(self):
+        return self.apply()
+
+    def __exit__(self, *exc):
+        self.restore()
+        return False
+
+
+class _InferenceNamespace:
+    """incubate.jit.inference decorator parity: marks a layer/function for
+    inference compilation. TPU-native: routes through jit.to_static (every
+    call compiles via XLA — there is no separate TensorRT-style engine)."""
+
+    @staticmethod
+    def __call__(function=None, **kwargs):
+        import paddle_tpu as paddle
+
+        if function is None:
+            return lambda f: paddle.jit.to_static(f)
+        return paddle.jit.to_static(function)
+
+
+inference = _InferenceNamespace()
+
+
+class _IncubateJit:
+    """paddle.incubate.jit namespace (reference path of the inference
+    decorator: python/paddle/incubate/jit/inference_decorator.py)."""
+
+    inference = inference
+
+
+jit = _IncubateJit()

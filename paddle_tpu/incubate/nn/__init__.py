@@ -1,4 +1,6 @@
 """paddle.incubate.nn parity: fused layers + functional."""
 from . import functional  # noqa: F401
 from .layers import (  # noqa: F401
-    FusedFeedForward, FusedLinear, FusedMultiHeadAttention)
+    FusedFeedForward, FusedLinear, FusedMultiHeadAttention,
+    FusedDropoutAdd, FusedBiasDropoutResidualLayerNorm,
+    FusedTransformerEncoderLayer, FusedMultiTransformer)

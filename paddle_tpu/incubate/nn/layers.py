@@ -114,3 +114,100 @@ class FusedFeedForward(Layer):
             self.ln2_bias, activation=self.activation,
             ln1_epsilon=self.epsilon, ln2_epsilon=self.epsilon,
             pre_layer_norm=self.normalize_before, training=self.training)
+
+
+class FusedDropoutAdd(Layer):
+    """incubate.nn.FusedDropoutAdd (fused_dropout_add op): dropout(x) + y
+    in one fused pass (XLA fuses the mask-scale-add chain)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        out = nn.functional.dropout(x, self.p, training=self.training,
+                                    mode=self.mode)
+        return out + y
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """incubate.nn.FusedBiasDropoutResidualLayerNorm
+    (fused_bias_dropout_residual_layer_norm op):
+    layer_norm(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn.initializer_core import Constant
+
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], is_bias=True, default_initializer=Constant(0.0))
+
+    def forward(self, x, residual):
+        h = nn.functional.dropout(x + self.linear_bias, self.dropout_rate,
+                                  training=self.training)
+        return nn.functional.layer_norm(
+            residual + h, [self.embed_dim], weight=self.ln_scale,
+            bias=self.ln_bias, epsilon=self.epsilon)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """incubate.nn.FusedTransformerEncoderLayer: fused attention + FFN
+    blocks (fused_transformer.py)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate
+            is not None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """incubate.nn.FusedMultiTransformer (fused_multi_transformer op): a
+    whole stack of fused pre-LN transformer blocks — the serving-path
+    block used by the reference's inference engine."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None, num_layers=-1,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if num_layers <= 0:
+            num_layers = 1
+        self.layers = nn.LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None, **kwargs):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=attn_mask)
+        return out

@@ -80,6 +80,7 @@ class StaticFunction:
         self._input_spec = input_spec
         self._static_argnums = static_argnums
         self._compile_count = 0
+        self._printed_sigs = set()
 
         if layer is not None:
             def pure(state, rng_key, training, *args, **kwargs):
@@ -122,9 +123,22 @@ class StaticFunction:
         try:
             if self._layer is not None:
                 state = self._layer.functional_state()
-                out = self._jitted(state, key, self._layer.training, *uargs, **ukwargs)
+                full_args = (state, key, self._layer.training) + tuple(uargs)
             else:
-                out = self._jitted(key, *uargs, **ukwargs)
+                full_args = (key,) + tuple(uargs)
+            if _SOT_VERBOSITY > 0:
+                # print the lowered program only for NEW specializations —
+                # re-lowering every call would double host overhead
+                import jax as _jax
+
+                sig = tuple(
+                    (getattr(a, "shape", None), str(getattr(a, "dtype", a)))
+                    for a in _jax.tree_util.tree_leaves((uargs, ukwargs)))
+                if sig not in self._printed_sigs:
+                    self._printed_sigs.add(sig)
+                    print(self._jitted.lower(
+                        *full_args, **ukwargs).as_text()[:10_000])
+            out = self._jitted(*full_args, **ukwargs)
         finally:
             _tape.set_grad_enabled(prev)
         return _wrap_tree(out)
@@ -348,3 +362,25 @@ def is_tracing() -> bool:
         return not jax.core.trace_state_clean()
     except Exception:  # pragma: no cover
         return False
+
+
+_SOT_CODE_LEVEL = 0
+_SOT_VERBOSITY = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """paddle.jit.set_code_level parity: the reference dumps SOT-transformed
+    bytecode at the given level; the analogous artifact here is the lowered
+    program, printed once per new specialization (same hook as
+    set_verbosity — any level > 0 enables it)."""
+    global _SOT_CODE_LEVEL, _SOT_VERBOSITY
+    _SOT_CODE_LEVEL = level
+    if level:
+        _SOT_VERBOSITY = max(_SOT_VERBOSITY, 1)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """paddle.jit.set_verbosity parity: 0 silent; >0 makes to_static print
+    the traced jaxpr of each newly compiled specialization."""
+    global _SOT_VERBOSITY
+    _SOT_VERBOSITY = level

@@ -19,3 +19,46 @@ from .initializer_core import (
 constant = Constant
 normal = Normal
 uniform = Uniform
+
+
+class Bilinear(Initializer):
+    """nn.initializer.Bilinear (python/paddle/nn/initializer/Bilinear):
+    bilinear-interpolation upsampling kernels for transposed conv weights
+    [C_out, C_in, K, K]."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        k = shape[-1]
+        factor = (k + 1) // 2
+        center = factor - 1.0 if k % 2 == 1 else factor - 0.5
+        og = np.ogrid[:k, :k]
+        filt = ((1 - np.abs(og[0] - center) / factor)
+                * (1 - np.abs(og[1] - center) / factor))
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            w[i, i % shape[1]] = filt
+        from ..framework.dtype import convert_dtype
+
+        return jnp.asarray(w, convert_dtype(dtype))
+
+
+_GLOBAL_WEIGHT_INIT = None
+_GLOBAL_BIAS_INIT = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """nn.initializer.set_global_initializer: default initializers used by
+    Layer.create_parameter when no explicit attr/initializer is given."""
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT = weight_init
+    _GLOBAL_BIAS_INIT = bias_init
+
+
+def _global_initializer(is_bias: bool):
+    return _GLOBAL_BIAS_INIT if is_bias else _GLOBAL_WEIGHT_INIT

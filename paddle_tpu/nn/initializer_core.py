@@ -193,6 +193,16 @@ class ParamAttr:
 def _resolve_initializer(attr, default_initializer, is_bias):
     if attr is not None and attr.initializer is not None:
         return attr.initializer
+    # set_global_initializer overrides built-in layer defaults (reference
+    # semantics: only an explicit ParamAttr initializer beats the global)
+    try:
+        from .initializer import _global_initializer
+
+        g = _global_initializer(is_bias)
+        if g is not None:
+            return g
+    except ImportError:  # pragma: no cover - during partial package init
+        pass
     if default_initializer is not None:
         return default_initializer
     return Constant(0.0) if is_bias else XavierNormal()

@@ -355,11 +355,37 @@ class RNN(Layer):
         axis = 0 if self.time_major else 1
         steps = unwrap(inputs).shape[axis]
         idx = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        seq_len = None
+        if sequence_length is not None:
+            seq_len = jnp.asarray(unwrap(sequence_length)).reshape(-1)
         outs = []
         states = initial_states
+        if states is None and seq_len is not None and \
+                hasattr(self.cell, "get_initial_states"):
+            # masking needs a concrete state to freeze into from step one
+            # (matters for is_reverse, where padding is visited first)
+            from .rnn import LSTMCell
+
+            first = inputs[:, 0] if axis == 1 else inputs[0]
+            init = self.cell.get_initial_states(first)
+            states = (init, init) if isinstance(self.cell, LSTMCell) else init
         for t in idx:
             x_t = inputs[:, t] if axis == 1 else inputs[t]
-            out, states = self.cell(x_t, states)
+            out, new_states = self.cell(x_t, states)
+            if seq_len is not None and states is not None:
+                # freeze state and zero output past each sample's length
+                # (reference RNN masks by sequence_length)
+                active = (seq_len > t).astype(unwrap(out).dtype)[:, None]
+                out = wrap(unwrap(out) * active)
+                is_t = lambda v: isinstance(v, Tensor)
+                new_l, treedef = jax.tree_util.tree_flatten(
+                    new_states, is_leaf=is_t)
+                old_l = jax.tree_util.tree_leaves(states, is_leaf=is_t)
+                mixed = [wrap(unwrap(n) * active + unwrap(o) * (1 - active))
+                         for n, o in zip(new_l, old_l)]
+                states = jax.tree_util.tree_unflatten(treedef, mixed)
+            else:
+                states = new_states
             outs.append(out)
         if self.is_reverse:
             outs = outs[::-1]

@@ -310,3 +310,25 @@ class CosineAnnealingWithWarmupDecay(LRScheduler):
             return self.min_lr
         frac = (step - self.warmup_step) / max(self.decay_step - self.warmup_step, 1)
         return self.min_lr + (self.max_lr - self.min_lr) * 0.5 * (1 + math.cos(math.pi * frac))
+
+
+class LinearLR(LRScheduler):
+    """optimizer.lr.LinearLR (python/paddle/optimizer/lr.py LinearLR):
+    linearly interpolate the LR factor from start_factor to end_factor over
+    total_steps."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        t = min(max(step, 0), self.total_steps)
+        factor = (self.start_factor
+                  + (self.end_factor - self.start_factor)
+                  * t / self.total_steps)
+        return self.base_lr * factor

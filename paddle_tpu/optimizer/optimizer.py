@@ -385,3 +385,244 @@ class Lion(Optimizer):
         update = jnp.sign(self._beta1 * state["moment"] + (1 - self._beta1) * grad)
         m = self._beta2 * state["moment"] + (1 - self._beta2) * grad
         return arr - lr * update, {"moment": m}
+
+
+class ASGD(Optimizer):
+    """paddle.optimizer.ASGD (python/paddle/optimizer/asgd.py, phi
+    asgd_kernel): SGD over the running average of the last ``batch_num``
+    gradients — d ← d − y_oldest + g; param ← param − lr·d/n."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        if batch_num <= 0:
+            raise ValueError("batch_num must be positive")
+        self._batch_num = batch_num
+
+    def init_param_state(self, arr):
+        return {"d": jnp.zeros(arr.shape, jnp.float32),
+                "ys": jnp.zeros((self._batch_num,) + tuple(arr.shape),
+                                jnp.float32)}
+
+    def update(self, arr, grad, state, lr, step):
+        idx = (step - 1) % self._batch_num
+        y_old = state["ys"][idx]
+        d = state["d"] - y_old + grad
+        ys = state["ys"].at[idx].set(grad)
+        n = jnp.minimum(step, self._batch_num).astype(jnp.float32)
+        new = arr - lr * d / n
+        return new, {"d": d, "ys": ys}
+
+
+class RAdam(Optimizer):
+    """paddle.optimizer.RAdam (rectified Adam, Liu et al. 2020)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_param_state(self, arr):
+        return {"moment1": jnp.zeros(arr.shape, jnp.float32),
+                "moment2": jnp.zeros(arr.shape, jnp.float32)}
+
+    def update(self, arr, grad, state, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        t = step.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        m_hat = m / (1 - b1**t)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2**t / (1 - b2**t)
+        # variance-rectification term (defined for rho_t > 4)
+        r = jnp.sqrt(jnp.maximum(
+            (rho_t - 4) * (rho_t - 2) * rho_inf
+            / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-12), 0.0))
+        v_hat = jnp.sqrt(v / (1 - b2**t)) + self._eps
+        rect = arr - lr * r * m_hat / v_hat
+        unrect = arr - lr * m_hat
+        new = jnp.where(rho_t > 4.0, rect, unrect)
+        return new, {"moment1": m, "moment2": v}
+
+
+class NAdam(Optimizer):
+    """paddle.optimizer.NAdam (Nesterov Adam, Dozat 2016; paddle follows the
+    torch formulation with momentum_decay ψ=0.004)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def init_param_state(self, arr):
+        return {"moment1": jnp.zeros(arr.shape, jnp.float32),
+                "moment2": jnp.zeros(arr.shape, jnp.float32),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def update(self, arr, grad, state, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        t = step.astype(jnp.float32)
+        mu_t = b1 * (1 - 0.5 * 0.96**(t * self._psi))
+        mu_next = b1 * (1 - 0.5 * 0.96**((t + 1) * self._psi))
+        mu_prod = state["mu_product"] * mu_t
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        m_hat = (mu_next * m / (1 - mu_prod * mu_next)
+                 + (1 - mu_t) * grad / (1 - mu_prod))
+        v_hat = v / (1 - b2**t)
+        new = arr - lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return new, {"moment1": m, "moment2": v, "mu_product": mu_prod}
+
+
+class Rprop(Optimizer):
+    """paddle.optimizer.Rprop (resilient backprop, sign-based per-weight
+    step sizes; phi rprop_kernel)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def init_param_state(self, arr):
+        return {"prev_grad": jnp.zeros(arr.shape, jnp.float32),
+                "lr_t": jnp.full(arr.shape, float(self.get_lr()), jnp.float32)}
+
+    def update(self, arr, grad, state, lr, step):
+        sign = jnp.sign(grad * state["prev_grad"])
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        lr_t = jnp.clip(state["lr_t"] * factor, self._lr_min, self._lr_max)
+        # on sign flip the step is skipped and the stored grad zeroed
+        eff_grad = jnp.where(sign < 0, 0.0, grad)
+        new = arr - lr_t * jnp.sign(eff_grad)
+        return new, {"prev_grad": eff_grad, "lr_t": lr_t}
+
+
+class LBFGS(Optimizer):
+    """paddle.optimizer.LBFGS (python/paddle/optimizer/lbfgs.py): limited-
+    memory BFGS with closure-driven line search. Eager-only by design: the
+    outer loop re-evaluates the closure a data-dependent number of times
+    (the reference is eager-only here too)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._max_iter = max_iter
+        self._max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._line_search = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+
+    def _flat_params(self):
+        from ..tensor_class import unwrap
+
+        return jnp.concatenate([unwrap(p).astype(jnp.float32).reshape(-1)
+                                for p in self._parameter_list])
+
+    def _set_flat(self, flat):
+        from ..tensor_class import unwrap
+
+        off = 0
+        for p in self._parameter_list:
+            n = 1
+            for s in p.shape:
+                n *= int(s)
+            chunk = flat[off:off + n].reshape(tuple(p.shape))
+            p._array = chunk.astype(unwrap(p).dtype)
+            off += n
+
+    def _flat_grad(self):
+        from ..tensor_class import unwrap
+
+        gs = []
+        for p in self._parameter_list:
+            g = p.grad
+            gs.append((unwrap(g) if g is not None
+                       else jnp.zeros(tuple(p.shape))).astype(
+                jnp.float32).reshape(-1))
+        return jnp.concatenate(gs)
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that re-evaluates"
+                             " the model and returns the loss")
+        loss = closure()
+        flat_g = self._flat_grad()
+        if float(jnp.abs(flat_g).max()) <= self._tol_grad:
+            return loss
+        x0 = self._flat_params()
+        evals = 1
+        for _ in range(self._max_iter):
+            # two-loop recursion
+            q = flat_g
+            alphas = []
+            for s, y in zip(reversed(self._s_hist), reversed(self._y_hist)):
+                rho = 1.0 / float(jnp.dot(y, s))
+                a = rho * float(jnp.dot(s, q))
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if self._y_hist:
+                y_last = self._y_hist[-1]
+                s_last = self._s_hist[-1]
+                gamma = float(jnp.dot(s_last, y_last)
+                              / jnp.maximum(jnp.dot(y_last, y_last), 1e-12))
+                q = q * gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * float(jnp.dot(y, q))
+                q = q + (a - b) * s
+            direction = -q
+            # backtracking line search on the closure
+            t = float(self.get_lr())
+            f0 = float(loss.numpy() if hasattr(loss, "numpy") else loss)
+            gd = float(jnp.dot(flat_g, direction))
+            x = self._flat_params()
+            success = False
+            for _ls in range(10):
+                self._set_flat(x + t * direction)
+                for p in self._parameter_list:
+                    p.clear_grad()
+                new_loss = closure()
+                evals += 1
+                f1 = float(new_loss.numpy() if hasattr(new_loss, "numpy")
+                           else new_loss)
+                if f1 <= f0 + 1e-4 * t * gd:
+                    success = True
+                    break
+                t *= 0.5
+            if not success:
+                self._set_flat(x)
+                return loss
+            new_g = self._flat_grad()
+            s_vec = t * direction
+            y_vec = new_g - flat_g
+            if float(jnp.dot(s_vec, y_vec)) > 1e-10:
+                self._s_hist.append(s_vec)
+                self._y_hist.append(y_vec)
+                if len(self._s_hist) > self._history:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+            loss, flat_g = new_loss, new_g
+            if float(jnp.abs(flat_g).max()) <= self._tol_grad:
+                break
+            if float(jnp.abs(s_vec).max()) <= self._tol_change:
+                break
+            if evals >= self._max_eval:
+                break
+        return loss

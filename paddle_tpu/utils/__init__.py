@@ -77,3 +77,51 @@ def from_dlpack(capsule):
 class dlpack:
     to_dlpack = staticmethod(to_dlpack)
     from_dlpack = staticmethod(from_dlpack)
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """paddle.utils.deprecated (python/paddle/utils/deprecated.py): decorator
+    that warns (level<=1) or raises (level==2) on use of a deprecated API."""
+    import functools
+    import warnings
+
+    def decorator(fn):
+        msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if reason:
+            msg += f", {reason}"
+        if update_to:
+            msg += f". Use '{update_to}' instead."
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        wrapper.__deprecated_message__ = msg
+        return wrapper
+
+    return decorator
+
+
+def require_version(min_version: str, max_version=None):
+    """paddle.utils.require_version (python/paddle/utils/install_check.py
+    sibling): check the installed framework version is in range."""
+    import paddle_tpu as paddle
+
+    def tup(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = tup(paddle.__version__)
+    if tup(min_version) > cur:
+        raise Exception(
+            f"installed version {paddle.__version__} < required "
+            f"{min_version}")
+    if max_version is not None and tup(max_version) < cur:
+        raise Exception(
+            f"installed version {paddle.__version__} > allowed "
+            f"{max_version}")
+    return True

@@ -1,9 +1,6 @@
-"""paddle.vision.models parity (python/paddle/vision/models/__init__.py).
-
-Implemented: LeNet, AlexNet, VGG (11/13/16/19), ResNet family (18-152,
-resnext, wide), MobileNetV1/V2. Remaining reference zoo entries (densenet,
-googlenet, inception, shufflenet, squeezenet, mobilenetv3) are tracked
-gaps for a later round.
+"""paddle.vision.models parity (python/paddle/vision/models/__init__.py):
+LeNet, AlexNet, VGG, ResNet/ResNeXt/WideResNet, MobileNetV1/V2/V3,
+DenseNet, GoogLeNet, InceptionV3, SqueezeNet, ShuffleNetV2, DiT.
 """
 from .resnet import (  # noqa: F401
     BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
@@ -14,3 +11,11 @@ from .small import (  # noqa: F401
     AlexNet, LeNet, MobileNetV1, MobileNetV2, VGG, alexnet, mobilenet_v1,
     mobilenet_v2, vgg11, vgg13, vgg16, vgg19)
 from .dit import DiT, DiTConfig, dit_xl_2  # noqa: F401
+from .zoo2 import (  # noqa: F401
+    MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small,
+    mobilenet_v3_large, DenseNet, densenet121, densenet161, densenet169,
+    densenet201, densenet264, InceptionV3, inception_v3, SqueezeNet,
+    squeezenet1_0, squeezenet1_1, GoogLeNet, googlenet, ShuffleNetV2,
+    shufflenet_v2_x0_25, shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    shufflenet_v2_swish)

@@ -1,0 +1,708 @@
+"""Vision model zoo, part 2 (python/paddle/vision/models/: densenet.py,
+googlenet.py, inceptionv3.py, mobilenetv3.py, shufflenetv2.py,
+squeezenet.py). Canonical published architectures implemented directly on
+the nn layer surface; weight layouts follow the reference so state_dicts
+line up name-for-name.
+"""
+from __future__ import annotations
+
+import math
+
+from ... import nn
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require the paddle hub download toolchain; "
+            "load a converted state_dict via set_state_dict instead")
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV3
+# ---------------------------------------------------------------------------
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        squeeze = _make_divisible(channels // reduction)
+        self.avg_pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, squeeze, 1)
+        self.fc2 = nn.Conv2D(squeeze, channels, 1)
+
+    def forward(self, x):
+        s = self.avg_pool(x)
+        s = nn.functional.relu(self.fc1(s))
+        s = nn.functional.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        self.expand = in_c != exp_c
+        if self.expand:
+            self.expand_conv = nn.Conv2D(in_c, exp_c, 1, bias_attr=False)
+            self.expand_bn = nn.BatchNorm2D(exp_c)
+        self.dw_conv = nn.Conv2D(exp_c, exp_c, kernel, stride=stride,
+                                 padding=kernel // 2, groups=exp_c,
+                                 bias_attr=False)
+        self.dw_bn = nn.BatchNorm2D(exp_c)
+        self.se = _SqueezeExcite(exp_c) if use_se else None
+        self.project_conv = nn.Conv2D(exp_c, out_c, 1, bias_attr=False)
+        self.project_bn = nn.BatchNorm2D(out_c)
+        self.act = (nn.functional.hardswish if act == "hardswish"
+                    else nn.functional.relu)
+
+    def forward(self, x):
+        h = x
+        if self.expand:
+            h = self.act(self.expand_bn(self.expand_conv(h)))
+        h = self.act(self.dw_bn(self.dw_conv(h)))
+        if self.se is not None:
+            h = self.se(h)
+        h = self.project_bn(self.project_conv(h))
+        return x + h if self.use_res else h
+
+
+# (kernel, expansion, out, use_se, activation, stride) per block
+_MBV3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_MBV3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channels, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        self.conv = nn.Conv2D(3, in_c, 3, stride=2, padding=1,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(in_c)
+        blocks = []
+        for k, exp, out, se, act, s in config:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            blocks.append(_MBV3Block(in_c, exp_c, out_c, k, s, se, act))
+            in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        last_exp = _make_divisible(config[-1][1] * scale)
+        self.last_conv = nn.Conv2D(in_c, last_exp, 1, bias_attr=False)
+        self.last_bn = nn.BatchNorm2D(last_exp)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_exp, last_channels), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channels, num_classes))
+
+    def forward(self, x):
+        x = nn.functional.hardswish(self.bn(self.conv(x)))
+        x = self.blocks(x)
+        x = nn.functional.hardswish(self.last_bn(self.last_conv(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = dropout
+
+    def forward(self, x):
+        from ... import concat
+
+        h = self.conv1(nn.functional.relu(self.bn1(x)))
+        h = self.conv2(nn.functional.relu(self.bn2(h)))
+        if self.dropout:
+            h = nn.functional.dropout(h, self.dropout,
+                                      training=self.training)
+        return concat([x, h], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_c)
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+
+    def forward(self, x):
+        x = self.conv(nn.functional.relu(self.bn(x)))
+        return nn.functional.avg_pool2d(x, 2, 2)
+
+
+_DENSENET_CFG = {
+    121: (6, 12, 24, 16), 161: (6, 12, 36, 24), 169: (6, 12, 32, 32),
+    201: (6, 12, 48, 32), 264: (6, 12, 64, 48),
+}
+
+
+class DenseNet(nn.Layer):
+    """paddle.vision.models.DenseNet (densenet.py)."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True, growth_rate=None):
+        super().__init__()
+        block_cfg = _DENSENET_CFG[layers]
+        growth = growth_rate or (48 if layers == 161 else 32)
+        init_c = 2 * growth
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv = nn.Conv2D(3, init_c, 7, stride=2, padding=3,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(init_c)
+        blocks = []
+        c = init_c
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                blocks.append(_DenseLayer(c, growth, bn_size, dropout))
+                c += growth
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(c, c // 2))
+                c //= 2
+        self.features = nn.Sequential(*blocks)
+        self.final_bn = nn.BatchNorm2D(c)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = nn.functional.max_pool2d(
+            nn.functional.relu(self.bn(self.conv(x))), 3, 2, 1)
+        x = nn.functional.relu(self.final_bn(self.features(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs):
+    _no_pretrained(pretrained)
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet
+# ---------------------------------------------------------------------------
+
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze_c, e1_c, e3_c):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze_c, 1)
+        self.expand1 = nn.Conv2D(squeeze_c, e1_c, 1)
+        self.expand3 = nn.Conv2D(squeeze_c, e3_c, 3, padding=1)
+
+    def forward(self, x):
+        from ... import concat
+
+        s = nn.functional.relu(self.squeeze(x))
+        return concat([nn.functional.relu(self.expand1(s)),
+                       nn.functional.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """paddle.vision.models.SqueezeNet (squeezenet.py); version '1.0'/'1.1'."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        v11 = str(version) in ("1.1", "squeezenet1_1")
+        if v11:
+            self.conv = nn.Conv2D(3, 64, 3, stride=2)
+            fires = [_Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64), "pool",
+                     _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                     "pool", _Fire(256, 48, 192, 192),
+                     _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                     _Fire(512, 64, 256, 256)]
+        else:
+            self.conv = nn.Conv2D(3, 96, 7, stride=2)
+            fires = [_Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                     _Fire(128, 32, 128, 128), "pool",
+                     _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                     _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                     "pool", _Fire(512, 64, 256, 256)]
+        self._fires = fires
+        mods = [f for f in fires if not isinstance(f, str)]
+        self.fires = nn.LayerList(mods)
+        self.final_conv = nn.Conv2D(512, num_classes, 1)
+
+    def forward(self, x):
+        x = nn.functional.max_pool2d(nn.functional.relu(self.conv(x)), 3, 2)
+        it = iter(self.fires)
+        for f in self._fires:
+            if isinstance(f, str):
+                x = nn.functional.max_pool2d(x, 3, 2)
+            else:
+                x = next(it)(x)
+        x = nn.functional.relu(self.final_conv(
+            nn.functional.dropout(x, 0.5, training=self.training)))
+        if self.with_pool:
+            x = nn.functional.adaptive_avg_pool2d(x, 1)
+        return x.flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.1", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet
+# ---------------------------------------------------------------------------
+
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = nn.Conv2D(in_c, c1, 1)
+        self.b2_1 = nn.Conv2D(in_c, c3r, 1)
+        self.b2_2 = nn.Conv2D(c3r, c3, 3, padding=1)
+        self.b3_1 = nn.Conv2D(in_c, c5r, 1)
+        self.b3_2 = nn.Conv2D(c5r, c5, 5, padding=2)
+        self.b4 = nn.Conv2D(in_c, proj, 1)
+
+    def forward(self, x):
+        from ... import concat
+
+        relu = nn.functional.relu
+        y1 = relu(self.b1(x))
+        y2 = relu(self.b2_2(relu(self.b2_1(x))))
+        y3 = relu(self.b3_2(relu(self.b3_1(x))))
+        y4 = relu(self.b4(nn.functional.max_pool2d(x, 3, 1, 1)))
+        return concat([y1, y2, y3, y4], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """paddle.vision.models.GoogLeNet (googlenet.py). Returns (main, aux1,
+    aux2) logits like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3)
+        self.conv2 = nn.Conv2D(64, 64, 1)
+        self.conv3 = nn.Conv2D(64, 192, 3, padding=1)
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if num_classes > 0:
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1_conv = nn.Conv2D(512, 128, 1)
+            self.aux1_fc1 = nn.Linear(128 * 4 * 4, 1024)
+            self.aux1_fc2 = nn.Linear(1024, num_classes)
+            self.aux2_conv = nn.Conv2D(528, 128, 1)
+            self.aux2_fc1 = nn.Linear(128 * 4 * 4, 1024)
+            self.aux2_fc2 = nn.Linear(1024, num_classes)
+
+    def _aux(self, x, conv, fc1, fc2):
+        a = nn.functional.adaptive_avg_pool2d(x, 4)
+        a = nn.functional.relu(conv(a)).flatten(1)
+        a = nn.functional.relu(fc1(a))
+        a = nn.functional.dropout(a, 0.7, training=self.training)
+        return fc2(a)
+
+    def forward(self, x):
+        relu = nn.functional.relu
+        mp = nn.functional.max_pool2d
+        x = mp(relu(self.conv1(x)), 3, 2, 1)
+        x = mp(relu(self.conv3(relu(self.conv2(x)))), 3, 2, 1)
+        x = mp(self.i3b(self.i3a(x)), 3, 2, 1)
+        x = self.i4a(x)
+        aux1 = (self._aux(x, self.aux1_conv, self.aux1_fc1, self.aux1_fc2)
+                if self.num_classes > 0 else None)
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = (self._aux(x, self.aux2_conv, self.aux2_fc1, self.aux2_fc2)
+                if self.num_classes > 0 else None)
+        x = mp(self.i4e(x), 3, 2, 1)
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = nn.functional.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = nn.functional.dropout(x.flatten(1), 0.4,
+                                      training=self.training)
+            x = self.fc(x)
+            return x, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return GoogLeNet(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3
+# ---------------------------------------------------------------------------
+
+class _BNConv(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, **kw):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, bias_attr=False, **kw)
+        self.bn = nn.BatchNorm2D(out_c)
+
+    def forward(self, x):
+        return nn.functional.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 64, 1)
+        self.b5_1 = _BNConv(in_c, 48, 1)
+        self.b5_2 = _BNConv(48, 64, 5, padding=2)
+        self.b3_1 = _BNConv(in_c, 64, 1)
+        self.b3_2 = _BNConv(64, 96, 3, padding=1)
+        self.b3_3 = _BNConv(96, 96, 3, padding=1)
+        self.bp = _BNConv(in_c, pool_c, 1)
+
+    def forward(self, x):
+        from ... import concat
+
+        return concat([
+            self.b1(x), self.b5_2(self.b5_1(x)),
+            self.b3_3(self.b3_2(self.b3_1(x))),
+            self.bp(nn.functional.avg_pool2d(x, 3, 1, 1))], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    """Grid reduction 35→17."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _BNConv(in_c, 384, 3, stride=2)
+        self.bd_1 = _BNConv(in_c, 64, 1)
+        self.bd_2 = _BNConv(64, 96, 3, padding=1)
+        self.bd_3 = _BNConv(96, 96, 3, stride=2)
+
+    def forward(self, x):
+        from ... import concat
+
+        return concat([self.b3(x), self.bd_3(self.bd_2(self.bd_1(x))),
+                       nn.functional.max_pool2d(x, 3, 2)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 192, 1)
+        self.b7_1 = _BNConv(in_c, c7, 1)
+        self.b7_2 = _BNConv(c7, c7, (1, 7), padding=(0, 3))
+        self.b7_3 = _BNConv(c7, 192, (7, 1), padding=(3, 0))
+        self.b77_1 = _BNConv(in_c, c7, 1)
+        self.b77_2 = _BNConv(c7, c7, (7, 1), padding=(3, 0))
+        self.b77_3 = _BNConv(c7, c7, (1, 7), padding=(0, 3))
+        self.b77_4 = _BNConv(c7, c7, (7, 1), padding=(3, 0))
+        self.b77_5 = _BNConv(c7, 192, (1, 7), padding=(0, 3))
+        self.bp = _BNConv(in_c, 192, 1)
+
+    def forward(self, x):
+        from ... import concat
+
+        return concat([
+            self.b1(x), self.b7_3(self.b7_2(self.b7_1(x))),
+            self.b77_5(self.b77_4(self.b77_3(self.b77_2(self.b77_1(x))))),
+            self.bp(nn.functional.avg_pool2d(x, 3, 1, 1))], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    """Grid reduction 17→8."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3_1 = _BNConv(in_c, 192, 1)
+        self.b3_2 = _BNConv(192, 320, 3, stride=2)
+        self.b7_1 = _BNConv(in_c, 192, 1)
+        self.b7_2 = _BNConv(192, 192, (1, 7), padding=(0, 3))
+        self.b7_3 = _BNConv(192, 192, (7, 1), padding=(3, 0))
+        self.b7_4 = _BNConv(192, 192, 3, stride=2)
+
+    def forward(self, x):
+        from ... import concat
+
+        return concat([self.b3_2(self.b3_1(x)),
+                       self.b7_4(self.b7_3(self.b7_2(self.b7_1(x)))),
+                       nn.functional.max_pool2d(x, 3, 2)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 320, 1)
+        self.b3_1 = _BNConv(in_c, 384, 1)
+        self.b3_2a = _BNConv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _BNConv(384, 384, (3, 1), padding=(1, 0))
+        self.b33_1 = _BNConv(in_c, 448, 1)
+        self.b33_2 = _BNConv(448, 384, 3, padding=1)
+        self.b33_3a = _BNConv(384, 384, (1, 3), padding=(0, 1))
+        self.b33_3b = _BNConv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = _BNConv(in_c, 192, 1)
+
+    def forward(self, x):
+        from ... import concat
+
+        y3 = self.b3_1(x)
+        y33 = self.b33_2(self.b33_1(x))
+        return concat([
+            self.b1(x),
+            concat([self.b3_2a(y3), self.b3_2b(y3)], axis=1),
+            concat([self.b33_3a(y33), self.b33_3b(y33)], axis=1),
+            self.bp(nn.functional.avg_pool2d(x, 3, 1, 1))], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """paddle.vision.models.InceptionV3 (inceptionv3.py)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BNConv(3, 32, 3, stride=2), _BNConv(32, 32, 3),
+            _BNConv(32, 64, 3, padding=1))
+        self.stem2 = nn.Sequential(_BNConv(64, 80, 1), _BNConv(80, 192, 3))
+        self.mixed = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        if num_classes > 0:
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = nn.functional.max_pool2d(self.stem(x), 3, 2)
+        x = nn.functional.max_pool2d(self.stem2(x), 3, 2)
+        x = self.mixed(x)
+        if self.with_pool:
+            x = nn.functional.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = nn.functional.dropout(x.flatten(1), 0.5,
+                                      training=self.training)
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return InceptionV3(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2
+# ---------------------------------------------------------------------------
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        self.act_name = act
+        if stride == 2:
+            self.short_dw = nn.Conv2D(in_c, in_c, 3, stride=2, padding=1,
+                                      groups=in_c, bias_attr=False)
+            self.short_dw_bn = nn.BatchNorm2D(in_c)
+            self.short_pw = nn.Conv2D(in_c, branch_c, 1, bias_attr=False)
+            self.short_pw_bn = nn.BatchNorm2D(branch_c)
+            main_in = in_c
+        else:
+            main_in = in_c // 2
+        self.pw1 = nn.Conv2D(main_in, branch_c, 1, bias_attr=False)
+        self.pw1_bn = nn.BatchNorm2D(branch_c)
+        self.dw = nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                            groups=branch_c, bias_attr=False)
+        self.dw_bn = nn.BatchNorm2D(branch_c)
+        self.pw2 = nn.Conv2D(branch_c, branch_c, 1, bias_attr=False)
+        self.pw2_bn = nn.BatchNorm2D(branch_c)
+
+    def _act(self, x):
+        return (nn.functional.swish(x) if self.act_name == "swish"
+                else nn.functional.relu(x))
+
+    def forward(self, x):
+        from ... import concat
+
+        if self.stride == 2:
+            short = self._act(self.short_pw_bn(self.short_pw(
+                self.short_dw_bn(self.short_dw(x)))))
+            main = x
+        else:
+            c = x.shape[1] // 2
+            short, main = x[:, :c], x[:, c:]
+        h = self._act(self.pw1_bn(self.pw1(main)))
+        h = self.dw_bn(self.dw(h))
+        h = self._act(self.pw2_bn(self.pw2(h)))
+        out = concat([short, h], axis=1)
+        return nn.functional.channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFG = {
+    0.25: (24, (24, 48, 96), 512), 0.33: (24, (32, 64, 128), 512),
+    0.5: (24, (48, 96, 192), 1024), 1.0: (24, (116, 232, 464), 1024),
+    1.5: (24, (176, 352, 704), 1024), 2.0: (24, (244, 488, 976), 2048),
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    """paddle.vision.models.ShuffleNetV2 (shufflenetv2.py)."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        stem_c, stage_cs, last_c = _SHUFFLE_CFG[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Conv2D(3, stem_c, 3, stride=2, padding=1,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(stem_c)
+        units = []
+        in_c = stem_c
+        for out_c, repeat in zip(stage_cs, (4, 8, 4)):
+            units.append(_ShuffleUnit(in_c, out_c, 2, act))
+            for _ in range(repeat - 1):
+                units.append(_ShuffleUnit(out_c, out_c, 1, act))
+            in_c = out_c
+        self.units = nn.Sequential(*units)
+        self.conv_last = nn.Conv2D(in_c, last_c, 1, bias_attr=False)
+        self.bn_last = nn.BatchNorm2D(last_c)
+        if num_classes > 0:
+            self.fc = nn.Linear(last_c, num_classes)
+
+    def forward(self, x):
+        x = nn.functional.relu(self.bn1(self.conv1(x)))
+        x = nn.functional.max_pool2d(x, 3, 2, 1)
+        x = self.units(x)
+        x = nn.functional.relu(self.bn_last(self.conv_last(x)))
+        if self.with_pool:
+            x = nn.functional.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

@@ -1,16 +1,16 @@
-"""Vision ops: boxes, NMS, RoI align.
-
-Reference parity: python/paddle/vision/ops.py (nms, box_coder, roi_align,
-roi_pool, deform_conv2d, PSRoIPool, yolo ops). The TPU build implements the
-detection primitives used by the model zoo; deform_conv/yolo remain gaps
-(tracked for a later round).
-"""
+"""Vision ops (python/paddle/vision/ops.py parity): boxes, NMS (greedy +
+matrix), RoI align/pool/PSRoI, anchors (prior_box), box_coder, the YOLOv3
+pair (yolo_box/yolo_loss), RPN generate_proposals, FPN distribution,
+deformable conv, and host-side image IO."""
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..nn.layer import Layer
 from ..ops.registry import apply
 from ..tensor_class import Tensor, unwrap, wrap
 
@@ -140,3 +140,629 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         output_size = (output_size, output_size)
     return roi_align(x, boxes, boxes_num, output_size, spatial_scale,
                      sampling_ratio=2, aligned=False)
+
+
+class RoIAlign(Layer):
+    """vision.ops.RoIAlign layer over roi_align."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        o, s = self._args
+        return roi_align(x, boxes, boxes_num, o, s)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        o, s = self._args
+        return roi_pool(x, boxes, boxes_num, o, s)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """vision.ops.psroi_pool (ops.yaml `psroi_pool`): position-sensitive ROI
+    pooling — output channel (c, i, j) averages input channel
+    c*k*k + i*k + j over the (i, j) bin."""
+    import numpy as np
+
+    k = output_size if isinstance(output_size, int) else output_size[0]
+    a = unwrap(x)
+    bx = np.asarray(unwrap(boxes))
+    # boxes_num assigns each box to its batch image
+    bn = np.asarray(unwrap(boxes_num)).reshape(-1)
+    img_of = np.repeat(np.arange(bn.size), bn)
+    C = a.shape[1]
+    out_c = C // (k * k)
+    outs = []
+    for b in range(bx.shape[0]):
+        img = int(img_of[b]) if b < img_of.size else 0
+        x1, y1, x2, y2 = [float(v) * spatial_scale for v in bx[b]]
+        bin_h = max(y2 - y1, 0.1) / k
+        bin_w = max(x2 - x1, 0.1) / k
+        grid = jnp.zeros((out_c, k, k), a.dtype)
+        for i in range(k):
+            for j in range(k):
+                ys = int(np.floor(y1 + i * bin_h))
+                ye = max(int(np.ceil(y1 + (i + 1) * bin_h)), ys + 1)
+                xs = int(np.floor(x1 + j * bin_w))
+                xe = max(int(np.ceil(x1 + (j + 1) * bin_w)), xs + 1)
+                ys, ye = np.clip([ys, ye], 0, a.shape[2])
+                xs, xe = np.clip([xs, xe], 0, a.shape[3])
+                if ye <= ys or xe <= xs:
+                    continue
+                chans = jnp.arange(out_c) * k * k + i * k + j
+                region = a[img, chans, ys:ye, xs:xe]
+                grid = grid.at[:, i, j].set(region.mean((-2, -1)))
+        outs.append(grid)
+    return wrap(jnp.stack(outs))
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        o, s = self._args
+        return psroi_pool(x, boxes, boxes_num, o, s)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """vision.ops.prior_box (ops.yaml `prior_box`): SSD anchor generation."""
+    import numpy as np
+
+    fh, fw = unwrap(input).shape[2:]
+    ih, iw = unwrap(image).shape[2:]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            cell = []
+            for s_i, ms in enumerate(min_sizes):
+                cell.append((cx, cy, ms, ms))
+                if max_sizes:
+                    big = math.sqrt(ms * max_sizes[s_i])
+                    cell.append((cx, cy, big, big))
+                for a in ars:
+                    if abs(a - 1.0) < 1e-6:
+                        continue
+                    cell.append((cx, cy, ms * math.sqrt(a),
+                                 ms / math.sqrt(a)))
+            boxes.extend(cell)
+    n_priors = len(boxes) // (fh * fw)
+    arr = np.asarray(boxes, np.float32).reshape(fh, fw, n_priors, 4)
+    out = np.stack([
+        (arr[..., 0] - arr[..., 2] / 2) / iw,
+        (arr[..., 1] - arr[..., 3] / 2) / ih,
+        (arr[..., 0] + arr[..., 2] / 2) / iw,
+        (arr[..., 1] + arr[..., 3] / 2) / ih], -1)
+    if clip:
+        out = out.clip(0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), out.shape).copy()
+    return wrap(jnp.asarray(out)), wrap(jnp.asarray(var))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """vision.ops.box_coder (ops.yaml `box_coder`): encode targets against
+    priors or decode deltas back to boxes."""
+    def fn(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[..., 2] - pb[..., 0] + norm
+        ph = pb[..., 3] - pb[..., 1] + norm
+        pcx = pb[..., 0] + pw / 2
+        pcy = pb[..., 1] + ph / 2
+        if code_type in ("encode_center_size", "encode"):
+            tw = tb[..., 2] - tb[..., 0] + norm
+            th = tb[..., 3] - tb[..., 1] + norm
+            tcx = tb[..., 0] + tw / 2
+            tcy = tb[..., 1] + th / 2
+            dx = (tcx[:, None] - pcx[None]) / pw[None]
+            dy = (tcy[:, None] - pcy[None]) / ph[None]
+            dw = jnp.log(tw[:, None] / pw[None])
+            dh = jnp.log(th[:, None] / ph[None])
+            out = jnp.stack([dx, dy, dw, dh], -1)
+            return out / pbv[None] if pbv is not None else out
+        # decode_center_size: tb [N, M, 4] deltas
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (pw[None], ph[None], pcx[None], pcy[None])
+            pbv_ = pbv[None] if pbv is not None else None
+        else:
+            pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None], pcx[:, None],
+                                    pcy[:, None])
+            pbv_ = pbv[:, None] if pbv is not None else None
+        d = tb * pbv_ if pbv_ is not None else tb
+        cx = d[..., 0] * pw_ + pcx_
+        cy = d[..., 1] * ph_ + pcy_
+        w = jnp.exp(d[..., 2]) * pw_
+        h = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - norm, cy + h / 2 - norm], -1)
+
+    from ..ops.registry import apply
+
+    if prior_box_var is None:
+        return apply("box_coder",
+                     lambda pb, tb: fn(pb, None, tb), prior_box, target_box)
+    return apply("box_coder", fn, prior_box, prior_box_var, target_box)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """vision.ops.yolo_box (ops.yaml `yolo_box`): decode a YOLOv3 head into
+    boxes + per-class scores."""
+    def fn(a, imsz):
+        n, _, h, w = a.shape
+        na = len(anchors) // 2
+        a5 = a.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (gx + sig(a5[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2) / w
+        by = (gy + sig(a5[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2) / h
+        aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+        input_w = w * downsample_ratio
+        input_h = h * downsample_ratio
+        bw = jnp.exp(a5[:, :, 2]) * aw / input_w
+        bh = jnp.exp(a5[:, :, 3]) * ah / input_h
+        conf = sig(a5[:, :, 4])
+        probs = sig(a5[:, :, 5:]) * conf[:, :, None]
+        imh = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        keep = (conf > conf_thresh).astype(boxes.dtype)
+        boxes = boxes * keep.reshape(n, -1)[..., None]
+        scores = (probs * keep[:, :, None]).transpose(0, 1, 3, 4, 2)
+        return boxes, scores.reshape(n, -1, class_num)
+
+    from ..ops.registry import apply
+
+    return apply("yolo_box", fn, x, img_size)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """vision.ops.yolo_loss (ops.yaml `yolov3_loss`): YOLOv3 training loss
+    (coordinate + objectness + classification terms, best-anchor matching,
+    ignore mask from IoU against any gt)."""
+    import numpy as np
+
+    a = unwrap(x)
+    boxes = np.asarray(unwrap(gt_box))      # [N, B, 4] cx,cy,w,h normalized
+    labels = np.asarray(unwrap(gt_label))   # [N, B]
+    n, _, h, w = a.shape
+    na = len(anchor_mask)
+    a5 = a.reshape(n, na, 5 + class_num, h, w)
+    input_size = downsample_ratio * h
+    all_anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_anchors = all_anchors[np.asarray(anchor_mask)]
+
+    # build targets host-side (data-dependent matching like the reference)
+    tobj = np.zeros((n, na, h, w), np.float32)
+    tcoord = np.zeros((n, na, 4, h, w), np.float32)
+    tcls = np.zeros((n, na, class_num, h, w), np.float32)
+    coord_w = np.zeros((n, na, h, w), np.float32)
+    # ignore mask: predicted boxes with IoU > ignore_thresh vs ANY gt are
+    # excluded from the no-object term (reference yolov3_loss semantics)
+    av_np = np.asarray(a).reshape(n, na, 5 + class_num, h, w)
+    noobj_mask = np.ones((n, na, h, w), np.float32)
+    sig_np = lambda z: 1.0 / (1.0 + np.exp(-z))
+    gx_grid = np.arange(w, dtype=np.float32)[None, None, :]
+    gy_grid = np.arange(h, dtype=np.float32)[None, :, None]
+    for b in range(n):
+        gts = [g for g in range(boxes.shape[1])
+               if boxes[b, g, 2] > 0 and boxes[b, g, 3] > 0]
+        if gts:
+            px = (gx_grid + sig_np(av_np[b, :, 0])) / w
+            py = (gy_grid + sig_np(av_np[b, :, 1])) / h
+            pw_ = np.exp(np.clip(av_np[b, :, 2], None, 10)) \
+                * mask_anchors[:, 0, None, None] / input_size
+            ph_ = np.exp(np.clip(av_np[b, :, 3], None, 10)) \
+                * mask_anchors[:, 1, None, None] / input_size
+            best_iou = np.zeros((na, h, w), np.float32)
+            for g in gts:
+                gx0 = boxes[b, g, 0] - boxes[b, g, 2] / 2
+                gy0 = boxes[b, g, 1] - boxes[b, g, 3] / 2
+                gx1 = boxes[b, g, 0] + boxes[b, g, 2] / 2
+                gy1 = boxes[b, g, 1] + boxes[b, g, 3] / 2
+                ix0 = np.maximum(px - pw_ / 2, gx0)
+                iy0 = np.maximum(py - ph_ / 2, gy0)
+                ix1 = np.minimum(px + pw_ / 2, gx1)
+                iy1 = np.minimum(py + ph_ / 2, gy1)
+                inter = (np.clip(ix1 - ix0, 0, None)
+                         * np.clip(iy1 - iy0, 0, None))
+                union = (pw_ * ph_ + boxes[b, g, 2] * boxes[b, g, 3]
+                         - inter)
+                best_iou = np.maximum(best_iou,
+                                      inter / np.maximum(union, 1e-10))
+            noobj_mask[b][best_iou > ignore_thresh] = 0.0
+    for b in range(n):
+        for g in range(boxes.shape[1]):
+            bw = boxes[b, g, 2] * input_size
+            bh = boxes[b, g, 3] * input_size
+            if bw <= 0 or bh <= 0:
+                continue
+            # best anchor by IoU at origin
+            inter = np.minimum(bw, all_anchors[:, 0]) * np.minimum(
+                bh, all_anchors[:, 1])
+            union = bw * bh + all_anchors.prod(-1) - inter
+            best = int((inter / union).argmax())
+            if best not in anchor_mask:
+                continue
+            k = anchor_mask.index(best)
+            gi = min(int(boxes[b, g, 0] * w), w - 1)
+            gj = min(int(boxes[b, g, 1] * h), h - 1)
+            tobj[b, k, gj, gi] = 1.0
+            tcoord[b, k, 0, gj, gi] = boxes[b, g, 0] * w - gi
+            tcoord[b, k, 1, gj, gi] = boxes[b, g, 1] * h - gj
+            tcoord[b, k, 2, gj, gi] = np.log(
+                max(bw / mask_anchors[k, 0], 1e-9))
+            tcoord[b, k, 3, gj, gi] = np.log(
+                max(bh / mask_anchors[k, 1], 1e-9))
+            coord_w[b, k, gj, gi] = 2.0 - boxes[b, g, 2] * boxes[b, g, 3]
+            c = int(labels[b, g])
+            smooth = 1.0 / class_num if use_label_smooth else 0.0
+            tcls[b, k, :, gj, gi] = smooth
+            tcls[b, k, c, gj, gi] = 1.0 - smooth if use_label_smooth else 1.0
+
+    def fn(av):
+        a5v = av.reshape(n, na, 5 + class_num, h, w)
+        sig = jax.nn.sigmoid
+        to = jnp.asarray(tobj)
+        tc = jnp.asarray(tcoord)
+        tk = jnp.asarray(tcls)
+        cw = jnp.asarray(coord_w)
+        bce = lambda z, t: jnp.maximum(z, 0) - z * t + jnp.log1p(
+            jnp.exp(-jnp.abs(z)))
+        loss_xy = (bce(a5v[:, :, 0], tc[:, :, 0]) * to * cw
+                   + bce(a5v[:, :, 1], tc[:, :, 1]) * to * cw)
+        loss_wh = ((a5v[:, :, 2] - tc[:, :, 2]) ** 2 * to * cw * 0.5
+                   + (a5v[:, :, 3] - tc[:, :, 3]) ** 2 * to * cw * 0.5)
+        loss_obj = bce(a5v[:, :, 4], to) * to
+        # negatives: only where no gt is placed AND not ignored (IoU below
+        # ignore_thresh against every gt)
+        nm = jnp.asarray(noobj_mask)
+        loss_noobj = bce(a5v[:, :, 4], to) * (1.0 - to) * nm
+        loss_cls = (bce(a5v[:, :, 5:], tk) * to[:, :, None]).sum(2)
+        total = (loss_xy + loss_wh + loss_obj + loss_noobj
+                 + loss_cls).sum((1, 2, 3))
+        return total
+
+    from ..ops.registry import apply
+
+    return apply("yolo_loss", fn, x)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """vision.ops.matrix_nms (ops.yaml `matrix_nms`): parallel soft-NMS via
+    the pairwise-IoU decay matrix (SOLOv2) — one [K, K] matrix instead of a
+    sequential suppression loop (TPU-friendly)."""
+    import numpy as np
+
+    bx = np.asarray(unwrap(bboxes))    # [N, M, 4]
+    sc = np.asarray(unwrap(scores))    # [N, C, M]
+    outs, indices, nums = [], [], []
+    for b in range(bx.shape[0]):
+        dets = []
+        idxs = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[b, c]
+            keep = np.where(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            boxes_c = bx[b, order]
+            s_c = s[order]
+            # pairwise IoU (upper triangle)
+            x1 = np.maximum(boxes_c[:, None, 0], boxes_c[None, :, 0])
+            y1 = np.maximum(boxes_c[:, None, 1], boxes_c[None, :, 1])
+            x2 = np.minimum(boxes_c[:, None, 2], boxes_c[None, :, 2])
+            y2 = np.minimum(boxes_c[:, None, 3], boxes_c[None, :, 3])
+            inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+            area = ((boxes_c[:, 2] - boxes_c[:, 0])
+                    * (boxes_c[:, 3] - boxes_c[:, 1]))
+            iou = inter / np.maximum(area[:, None] + area[None] - inter,
+                                     1e-10)
+            iou = np.triu(iou, 1)
+            iou_cmax = iou.max(0)
+            if use_gaussian:
+                decay = np.exp((iou_cmax[:, None]**2 - iou**2)
+                               / gaussian_sigma)
+            else:
+                # SOLOv2 decay: suppression by i is discounted by how much
+                # i itself was suppressed (iou_cmax of the ROW)
+                decay = (1 - iou) / np.maximum(1 - iou_cmax, 1e-10)[:, None]
+            decay = decay.min(0)
+            s_dec = s_c * decay
+            ok = s_dec >= post_threshold
+            for i in np.where(ok)[0]:
+                dets.append([c, s_dec[i], *boxes_c[i]])
+                idxs.append(order[i])
+        dets = np.asarray(dets, np.float32).reshape(-1, 6)
+        top = np.argsort(-dets[:, 1])[:keep_top_k]
+        outs.append(dets[top])
+        indices.append(np.asarray(idxs)[top] if top.size else
+                       np.empty(0, np.int64))
+        nums.append(top.size)
+    out = wrap(jnp.asarray(np.concatenate(outs) if outs
+                           else np.empty((0, 6), np.float32)))
+    rois_num = wrap(jnp.asarray(np.asarray(nums, np.int32)))
+    if return_index:
+        idx = wrap(jnp.asarray(np.concatenate(indices).astype(np.int64)))
+        return (out, idx, rois_num) if return_rois_num else (out, idx)
+    return (out, rois_num) if return_rois_num else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """vision.ops.distribute_fpn_proposals (ops.yaml): assign each RoI to an
+    FPN level by sqrt-area heuristic."""
+    import numpy as np
+
+    rois = np.asarray(unwrap(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.clip((rois[:, 2] - rois[:, 0] + off)
+                            * (rois[:, 3] - rois[:, 1] + off), 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idx_restore = [], np.empty(rois.shape[0], np.int64)
+    nums = []
+    pos = 0
+    order = []
+    for level in range(min_level, max_level + 1):
+        sel = np.where(lvl == level)[0]
+        outs.append(wrap(jnp.asarray(rois[sel])))
+        nums.append(wrap(jnp.asarray(np.asarray([sel.size], np.int32))))
+        order.extend(sel.tolist())
+    for new_i, old_i in enumerate(order):
+        idx_restore[old_i] = new_i
+    return outs, wrap(jnp.asarray(idx_restore)), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """vision.ops.generate_proposals (ops.yaml `generate_proposals`): RPN
+    box decoding + clip + min-size filter + NMS."""
+    import numpy as np
+
+    sc = np.asarray(unwrap(scores))          # [N, A, H, W]
+    bd = np.asarray(unwrap(bbox_deltas))     # [N, A*4, H, W]
+    ims = np.asarray(unwrap(img_size))       # [N, 2]
+    an = np.asarray(unwrap(anchors)).reshape(-1, 4)   # [H*W*A, 4]
+    va = np.asarray(unwrap(variances)).reshape(-1, 4)
+    n = sc.shape[0]
+    outs, out_scores, nums = [], [], []
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = bd[b].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s_o, d_o, an_o, va_o = s[order], d[order], an[order], va[order]
+        off = 1.0 if pixel_offset else 0.0
+        aw = an_o[:, 2] - an_o[:, 0] + off
+        ah = an_o[:, 3] - an_o[:, 1] + off
+        acx = an_o[:, 0] + aw / 2
+        acy = an_o[:, 1] + ah / 2
+        cx = va_o[:, 0] * d_o[:, 0] * aw + acx
+        cy = va_o[:, 1] * d_o[:, 1] * ah + acy
+        wbox = np.exp(np.clip(va_o[:, 2] * d_o[:, 2], None, 10)) * aw
+        hbox = np.exp(np.clip(va_o[:, 3] * d_o[:, 3], None, 10)) * ah
+        x1 = np.clip(cx - wbox / 2, 0, ims[b, 1] - 1)
+        y1 = np.clip(cy - hbox / 2, 0, ims[b, 0] - 1)
+        x2 = np.clip(cx + wbox / 2, 0, ims[b, 1] - 1)
+        y2 = np.clip(cy + hbox / 2, 0, ims[b, 0] - 1)
+        keep = np.where((x2 - x1 >= min_size) & (y2 - y1 >= min_size))[0]
+        props = np.stack([x1, y1, x2, y2], -1)[keep]
+        s_k = s_o[keep]
+        # greedy NMS
+        order2 = np.argsort(-s_k)
+        chosen = []
+        while order2.size and len(chosen) < post_nms_top_n:
+            i = order2[0]
+            chosen.append(i)
+            xx1 = np.maximum(props[i, 0], props[order2[1:], 0])
+            yy1 = np.maximum(props[i, 1], props[order2[1:], 1])
+            xx2 = np.minimum(props[i, 2], props[order2[1:], 2])
+            yy2 = np.minimum(props[i, 3], props[order2[1:], 3])
+            inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+            a_i = (props[i, 2] - props[i, 0]) * (props[i, 3] - props[i, 1])
+            a_r = ((props[order2[1:], 2] - props[order2[1:], 0])
+                   * (props[order2[1:], 3] - props[order2[1:], 1]))
+            iou = inter / np.maximum(a_i + a_r - inter, 1e-10)
+            order2 = order2[1:][iou <= nms_thresh]
+        outs.append(props[chosen])
+        out_scores.append(s_k[chosen])
+        nums.append(len(chosen))
+    rois = wrap(jnp.asarray(np.concatenate(outs).astype(np.float32)))
+    rscores = wrap(jnp.asarray(np.concatenate(out_scores).astype(np.float32)))
+    rnum = wrap(jnp.asarray(np.asarray(nums, np.int32)))
+    if return_rois_num:
+        return rois, rscores, rnum
+    return rois, rscores
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """vision.ops.deform_conv2d (ops.yaml `deformable_conv`): deformable
+    convolution v1/v2 — bilinear sampling at offset positions then a dense
+    matmul over the gathered patches (gather + MXU, no scatter)."""
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    sh, sw = pair(stride)
+    ph, pw = pair(padding)
+    dh, dw = pair(dilation)
+
+    def fn(a, off, wgt, *rest):
+        msk = rest[0] if (mask is not None and len(rest) > 0) else None
+        bia = None
+        if bias is not None:
+            bia = rest[-1]
+        n, cin, h, w = a.shape
+        cout, cin_g, kh, kw = wgt.shape
+        oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        # base sampling grid [oh, ow, kh, kw]
+        by = (jnp.arange(oh) * sh - ph)[:, None, None, None] \
+            + (jnp.arange(kh) * dh)[None, None, :, None]
+        bx = (jnp.arange(ow) * sw - pw)[None, :, None, None] \
+            + (jnp.arange(kw) * dw)[None, None, None, :]
+        off = off.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+        oy = off[:, :, :, 0].transpose(0, 1, 3, 4, 2).reshape(
+            n, deformable_groups, oh, ow, kh, kw)
+        ox = off[:, :, :, 1].transpose(0, 1, 3, 4, 2).reshape(
+            n, deformable_groups, oh, ow, kh, kw)
+        sy = by[None, None] + oy
+        sx = bx[None, None] + ox
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy/xx [oh, ow, kh, kw]
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+
+            def at(yi, xi):
+                inside = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+                yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                return img[:, yc, xc] * inside.astype(img.dtype)[None]
+
+            return (at(y0, x0) * ((1 - wy) * (1 - wx))[None]
+                    + at(y0, x0 + 1) * ((1 - wy) * wx)[None]
+                    + at(y0 + 1, x0) * (wy * (1 - wx))[None]
+                    + at(y0 + 1, x0 + 1) * (wy * wx)[None])
+
+        cpg = cin // deformable_groups
+        outs = []
+        for b in range(n):
+            groups_samples = []
+            for g in range(deformable_groups):
+                img = a[b, g * cpg:(g + 1) * cpg]
+                patch = bilinear(img, sy[b, g], sx[b, g])
+                if msk is not None:
+                    m = msk[b].reshape(deformable_groups, kh * kw, oh, ow)
+                    m = m[g].transpose(1, 2, 0).reshape(oh, ow, kh, kw)
+                    patch = patch * m[None]
+                groups_samples.append(patch)
+            patches = jnp.concatenate(groups_samples, 0)  # [cin, oh, ow, kh, kw]
+            col = patches.transpose(1, 2, 0, 3, 4).reshape(
+                oh * ow, cin * kh * kw)
+            wcol = wgt.reshape(cout, cin_g * kh * kw)
+            if groups == 1:
+                res = col @ wcol.T
+            else:
+                cols = col.reshape(oh * ow, groups, (cin // groups) * kh * kw)
+                wg = wcol.reshape(groups, cout // groups, -1)
+                res = jnp.concatenate(
+                    [cols[:, g] @ wg[g].T for g in range(groups)], -1)
+            outs.append(res.T.reshape(cout, oh, ow))
+        out = jnp.stack(outs)
+        if bia is not None:
+            out = out + bia[None, :, None, None]
+        return out
+
+    from ..ops.registry import apply
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply("deform_conv2d", fn, *args)
+
+
+class DeformConv2D(Layer):
+    """vision.ops.DeformConv2D layer."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        from ..nn.initializer_core import Uniform
+
+        bound = 1.0 / math.sqrt(in_channels * k[0] * k[1])
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]],
+            attr=weight_attr, default_initializer=Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+        self._args = (stride, padding, dilation, deformable_groups, groups)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._args
+        return deform_conv2d(x, offset, self.weight, self.bias, s, p, d, dg,
+                             g, mask)
+
+
+def read_file(filename, name=None):
+    """vision.ops.read_file: file bytes as a uint8 tensor."""
+    import numpy as np
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    return wrap(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """vision.ops.decode_jpeg via PIL (the reference uses nvjpeg on GPU;
+    image IO is host-side on TPU by design)."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    data = bytes(np.asarray(unwrap(x)).astype(np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return wrap(jnp.asarray(arr))

@@ -3,9 +3,10 @@ from . import functional  # noqa: F401
 from .functional import (  # noqa: F401
     adjust_brightness, adjust_contrast, adjust_hue, adjust_saturation,
     center_crop, crop, hflip, normalize, pad, resize, rotate, to_grayscale,
-    to_tensor, vflip)
+    to_tensor, vflip, affine, perspective, erase)
 from .transforms import (  # noqa: F401
     BaseTransform, BrightnessTransform, CenterCrop, ColorJitter, Compose,
     ContrastTransform, Grayscale, HueTransform, Normalize, Pad, RandomCrop,
     RandomErasing, RandomHorizontalFlip, RandomResizedCrop, RandomRotation,
-    RandomVerticalFlip, Resize, SaturationTransform, ToTensor, Transpose)
+    RandomVerticalFlip, Resize, SaturationTransform, ToTensor, Transpose,
+    RandomAffine, RandomPerspective)

@@ -237,3 +237,102 @@ def rotate(img, angle: float, interpolation="nearest", expand=False,
     out = a[syi, sxi]
     out[~valid] = fill
     return out[:, :, 0] if squeeze else out
+
+
+def _inverse_affine_matrix(center, angle, translate, scale, shear):
+    """Inverse of the composed affine map (python/paddle/vision/transforms
+    functional.affine): out←in sampling matrix."""
+    rot = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in (shear if isinstance(shear, (list, tuple))
+                                      else (shear, 0.0))]
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R(angle) Shear Scale T(-center) + translate
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0], [c * scale, d * scale, 0.0]])
+    m[0, 2] = cx + tx - (m[0, 0] * cx + m[0, 1] * cy)
+    m[1, 2] = cy + ty - (m[1, 0] * cx + m[1, 1] * cy)
+    # invert the 2x3 affine
+    det = m[0, 0] * m[1, 1] - m[0, 1] * m[1, 0]
+    inv = np.array([[m[1, 1], -m[0, 1], 0.0], [-m[1, 0], m[0, 0], 0.0]]) / det
+    inv[0, 2] = -(inv[0, 0] * m[0, 2] + inv[0, 1] * m[1, 2])
+    inv[1, 2] = -(inv[1, 0] * m[0, 2] + inv[1, 1] * m[1, 2])
+    return inv
+
+
+def _sample_inverse(a, sx, sy, fill):
+    h, w = a.shape[:2]
+    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+    sxi = np.round(sx).clip(0, w - 1).astype(int)
+    syi = np.round(sy).clip(0, h - 1).astype(int)
+    out = a[syi, sxi].copy()
+    out[~valid] = fill
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           center=None, fill=0):
+    """F.affine (vision/transforms/functional.py affine): rotation +
+    translation + scale + shear, nearest sampling."""
+    a = _as_np(img)
+    squeeze = a.ndim == 2
+    if squeeze:
+        a = a[:, :, None]
+    h, w = a.shape[:2]
+    c = ((w - 1) / 2.0, (h - 1) / 2.0) if center is None else tuple(center)
+    inv = _inverse_affine_matrix(c, angle, translate, scale, shear)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    sx = inv[0, 0] * xs + inv[0, 1] * ys + inv[0, 2]
+    sy = inv[1, 0] * xs + inv[1, 1] * ys + inv[1, 2]
+    out = _sample_inverse(a, sx, sy, fill)
+    return out[:, :, 0] if squeeze else out
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the 8-dof homography mapping endpoints → startpoints."""
+    A = []
+    B = []
+    for (xs, ys), (xd, yd) in zip(startpoints, endpoints):
+        A.append([xd, yd, 1, 0, 0, 0, -xs * xd, -xs * yd])
+        A.append([0, 0, 0, xd, yd, 1, -ys * xd, -ys * yd])
+        B.extend([xs, ys])
+    coeffs = np.linalg.solve(np.asarray(A, np.float64),
+                             np.asarray(B, np.float64))
+    return coeffs
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """F.perspective: 4-point homography warp (inverse nearest sampling)."""
+    a = _as_np(img)
+    squeeze = a.ndim == 2
+    if squeeze:
+        a = a[:, :, None]
+    h, w = a.shape[:2]
+    co = _perspective_coeffs(startpoints, endpoints)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    den = co[6] * xs + co[7] * ys + 1.0
+    sx = (co[0] * xs + co[1] * ys + co[2]) / den
+    sy = (co[3] * xs + co[4] * ys + co[5]) / den
+    out = _sample_inverse(a, sx, sy, fill)
+    return out[:, :, 0] if squeeze else out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """F.erase (vision/transforms functional.erase): fill img[i:i+h, j:j+w]
+    with v. Accepts HWC numpy/PIL or CHW Tensor (the reference's contract)."""
+    from ...tensor_class import Tensor, unwrap, wrap
+
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        a = unwrap(img)
+        val = jnp.asarray(unwrap(v) if isinstance(v, Tensor) else v, a.dtype)
+        patch = jnp.broadcast_to(val, a[..., i:i + h, j:j + w].shape)
+        return wrap(a.at[..., i:i + h, j:j + w].set(patch))
+    a = _as_np(img)
+    out = a if inplace else a.copy()
+    out[i:i + h, j:j + w] = v
+    return out

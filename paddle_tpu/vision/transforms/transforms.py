@@ -292,3 +292,63 @@ class RandomErasing(BaseTransform):
                 a[top:top + eh, left:left + ew] = self.value
                 return a
         return a
+
+
+class RandomAffine(BaseTransform):
+    """transforms.RandomAffine (vision/transforms/transforms.py)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate, self.scale_rng, self.shear = translate, scale, shear
+        self.interpolation, self.fill, self.center = interpolation, fill, center
+
+    def _apply_image(self, img):
+        a = F._as_np(img)
+        h, w = a.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        if self.shear is None:
+            sh = (0.0, 0.0)
+        elif isinstance(self.shear, numbers.Number):
+            sh = (random.uniform(-self.shear, self.shear), 0.0)
+        elif len(self.shear) == 2:
+            sh = (random.uniform(self.shear[0], self.shear[1]), 0.0)
+        else:
+            sh = (random.uniform(self.shear[0], self.shear[1]),
+                  random.uniform(self.shear[2], self.shear[3]))
+        return F.affine(img, angle, (tx, ty), sc, sh, self.interpolation,
+                        self.center, self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    """transforms.RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        a = F._as_np(img)
+        h, w = a.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = h // 2, w // 2
+        tl = (random.randint(0, int(d * half_w)), random.randint(0, int(d * half_h)))
+        tr = (w - 1 - random.randint(0, int(d * half_w)), random.randint(0, int(d * half_h)))
+        br = (w - 1 - random.randint(0, int(d * half_w)), h - 1 - random.randint(0, int(d * half_h)))
+        bl = (random.randint(0, int(d * half_w)), h - 1 - random.randint(0, int(d * half_h)))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [tl, tr, br, bl]
+        return F.perspective(img, start, end, self.interpolation, self.fill)

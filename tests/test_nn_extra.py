@@ -277,3 +277,42 @@ def test_functional_tail_wrappers():
     qkv = paddle.to_tensor(rng.randn(1, 4, 3, 2, 8).astype("float32"))
     out = F.flash_attn_qkvpacked(qkv, causal=True)
     assert out.shape == [1, 4, 2, 8]
+
+
+def test_rnn_sequence_length_masks():
+    """RNN/BiRNN honor sequence_length: outputs past each sample's length
+    are zero and the final state freezes at that step (review fix)."""
+    paddle.seed(0)
+    cell = nn.GRUCell(3, 4)
+    rnn = nn.RNN(cell)
+    inp = paddle.to_tensor(np.random.rand(2, 5, 3).astype("float32"))
+    out, st = rnn(inp, sequence_length=paddle.to_tensor(np.array([5, 2])))
+    assert np.allclose(out.numpy()[1, 2:], 0)
+    out2, st2 = rnn(paddle.to_tensor(inp.numpy()[:, :2]),
+                    sequence_length=paddle.to_tensor(np.array([2, 2])))
+    np.testing.assert_allclose(st.numpy()[1], st2.numpy()[1], atol=1e-6)
+
+
+def test_model_average_and_lookahead():
+    """incubate.ModelAverage: apply() installs the true running mean and
+    restore() puts the live weights back (review fix: no zero-biased EMA)."""
+    import paddle_tpu.incubate as inc
+    import paddle_tpu.optimizer as opt
+
+    w = paddle.Parameter(np.array([0.0], dtype="float32"))
+    ma = inc.ModelAverage(parameters=[w])
+    for v in [1.0, 2.0, 3.0]:
+        w.set_value(np.array([v], dtype="float32"))
+        ma.step()
+    with ma:
+        assert abs(float(w.numpy()[0]) - 2.0) < 1e-6  # mean(1,2,3)
+    assert float(w.numpy()[0]) == 3.0  # restored
+    # LookAhead pulls slow weights toward fast
+    wp = paddle.Parameter(np.array([4.0], dtype="float32"))
+    la = inc.LookAhead(opt.SGD(0.1, parameters=[wp]), alpha=0.5, k=2)
+    for _ in range(4):
+        loss = (wp ** 2).sum()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+    assert 0 < float(wp.numpy()[0]) < 4.0

@@ -184,3 +184,73 @@ def test_adamw_decay_param_fun():
     o.step()
     assert float(wa.numpy()[0]) < 1.0     # decayed
     assert float(wb.numpy()[0]) == 1.0    # excluded from decay
+
+
+def test_round3_optimizers_converge():
+    """ASGD/RAdam/NAdam/Rprop each minimize a quadratic (eager path)."""
+    import paddle_tpu.optimizer as opt
+
+    for cls, kwargs in [(opt.ASGD, {"batch_num": 4}),
+                        (opt.RAdam, {}), (opt.NAdam, {}),
+                        (opt.Rprop, {})]:
+        paddle.seed(0)
+        w = paddle.to_tensor(np.array([5.0, -3.0], dtype="float32"),
+                             stop_gradient=False)
+        w_param = paddle.Parameter(w._array)
+        o = cls(learning_rate=0.1, parameters=[w_param], **kwargs)
+        for _ in range(150):
+            loss = ((w_param - paddle.to_tensor(
+                np.array([1.0, 2.0], dtype="float32"))) ** 2).sum()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        err = np.abs(w_param.numpy() - np.array([1.0, 2.0])).max()
+        assert err < 0.3, f"{cls.__name__}: err {err}"
+
+
+def test_round3_optimizers_jit_path():
+    """The same optimizers work through the functional TrainStep path."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    for cls in [opt.ASGD, opt.RAdam, opt.NAdam]:
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        o = cls(learning_rate=0.05, parameters=net.parameters())
+        step = paddle.jit.train_step(
+            net, lambda m, x, y: ((m(x) - y) ** 2).mean(), o)
+        x = paddle.to_tensor(np.random.rand(8, 4).astype("float32"))
+        y = paddle.to_tensor(np.random.rand(8, 1).astype("float32"))
+        losses = [float(step(x, y).numpy()) for _ in range(30)]
+        assert losses[-1] < losses[0], f"{cls.__name__} did not descend"
+
+
+def test_lbfgs_quadratic():
+    """LBFGS drives a quadratic to optimum in a few closure steps."""
+    import paddle_tpu.optimizer as opt
+
+    w = paddle.Parameter(np.array([4.0, -2.0], dtype="float32"))
+    o = opt.LBFGS(learning_rate=1.0, max_iter=10, parameters=[w])
+    target = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+
+    def closure():
+        o.clear_grad()
+        loss = ((w - target) ** 2).sum()
+        loss.backward()
+        return loss
+
+    for _ in range(3):
+        o.step(closure)
+    assert np.abs(w.numpy() - np.array([1.0, 2.0])).max() < 1e-3
+
+
+def test_linear_lr_schedule():
+    import paddle_tpu.optimizer as opt
+
+    s = opt.lr.LinearLR(0.2, total_steps=4, start_factor=0.5, end_factor=1.0)
+    seen = [round(s.get_lr(), 4)]
+    for _ in range(4):
+        s.step()
+        seen.append(round(s.get_lr(), 4))
+    assert seen[0] == 0.1 and seen[-1] == 0.2
+    assert all(b >= a for a, b in zip(seen, seen[1:]))

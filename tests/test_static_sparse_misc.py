@@ -485,3 +485,111 @@ def test_sparse_nn_layers():
         paddle.randn([1, 2, 4, 8]),
         sparse.to_sparse_coo(paddle.to_tensor(np.ones((1, 2, 4, 4), "float32"))))
     assert att.shape == [1, 2, 4, 8]
+
+
+def test_round3_surface_tails():
+    """fft hermitian family, audio grids, utils.deprecated, initializer
+    globals, LinearLR, transforms affine/perspective/erase, geometric
+    sampling, incubate tail."""
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.fft as pfft
+
+    x = paddle.to_tensor(np.array([2 + 2j, 2 + 2j, 3 + 3j], "complex64"))
+    np.testing.assert_allclose(pfft.hfftn(x).numpy(), [9, 3, 1, -5],
+                               atol=1e-5)
+    a = np.random.rand(4, 6).astype("float32")
+    np.testing.assert_allclose(
+        pfft.hfft2(pfft.ihfft2(paddle.to_tensor(a)), s=[4, 6]).numpy(), a,
+        atol=1e-4)
+
+    from paddle_tpu.audio import functional as AF
+
+    f = AF.fft_frequencies(16000, 512)
+    assert f.shape == [257] and float(f.numpy()[-1]) == 8000.0
+    mel = AF.mel_frequencies(10, 0.0, 8000.0).numpy()
+    assert mel.shape == (10,) and np.all(np.diff(mel) > 0)
+
+    import paddle_tpu.utils as U
+
+    @U.deprecated(update_to="paddle.new", since="2.0")
+    def old_fn():
+        return 7
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_fn() == 7
+        assert any("deprecated" in str(x.message) for x in w)
+    assert U.require_version("0.0.1") is True
+
+    import paddle_tpu.nn as nn
+
+    nn.initializer.set_global_initializer(nn.initializer.Constant(2.5))
+    try:
+        lin = nn.Linear(2, 3)
+        assert float(lin.weight.numpy()[0, 0]) == 2.5
+    finally:
+        nn.initializer.set_global_initializer(None)
+    w4 = nn.initializer.Bilinear()([1, 1, 4, 4])
+    assert float(np.asarray(w4).max()) <= 1.0
+
+    import paddle_tpu.vision.transforms as T
+
+    img = (np.random.rand(6, 6, 3) * 255).astype("uint8")
+    assert np.array_equal(T.affine(img, 0.0, (0, 0), 1.0, (0.0, 0.0)), img)
+    pts = [(0, 0), (5, 0), (5, 5), (0, 5)]
+    assert np.array_equal(T.perspective(img, pts, pts), img)
+    er = T.erase(img, 1, 1, 2, 2, 0)
+    assert er[1:3, 1:3].sum() == 0
+    assert T.RandomAffine(10)(img).shape == img.shape
+    assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+
+    import paddle_tpu.geometric as G
+
+    row = paddle.to_tensor(np.array([1, 2, 3, 0, 2]))
+    colptr = paddle.to_tensor(np.array([0, 3, 5]))
+    nb, cnt = G.sample_neighbors(row, colptr,
+                                 paddle.to_tensor(np.array([0, 1])),
+                                 sample_size=2)
+    assert list(cnt.numpy()) == [2, 2] and nb.shape == [4]
+    src, dst, nodes = G.reindex_graph(
+        paddle.to_tensor(np.array([5, 9])),
+        paddle.to_tensor(np.array([9, 3, 5, 7])),
+        paddle.to_tensor(np.array([2, 2])))
+    np.testing.assert_array_equal(nodes.numpy(), [5, 9, 3, 7])
+    np.testing.assert_array_equal(src.numpy(), [1, 2, 0, 3])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1])
+
+    import paddle_tpu.incubate as inc
+
+    sm = inc.softmax_mask_fuse_upper_triangle(paddle.randn([1, 4, 4]))
+    got = sm.numpy()[0]
+    assert np.allclose(np.triu(got, 1), 0, atol=1e-6)
+    np.testing.assert_allclose(got.sum(-1), 1.0, rtol=1e-5)
+    assert float(inc.identity_loss(paddle.ones([4]), "mean").numpy()) == 1.0
+    enc = inc.nn.FusedTransformerEncoderLayer(8, 2, 16, dropout_rate=0.0)
+    assert enc(paddle.randn([2, 3, 8])).shape == [2, 3, 8]
+
+
+def test_graph_sampling_reproducible():
+    """Host-side graph sampling draws from the framework seed stream
+    (review fix: paddle.seed controls sample_neighbors)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.geometric as G
+
+    row = paddle.to_tensor(np.arange(10) % 5)
+    colptr = paddle.to_tensor(np.array([0, 5, 10]))
+    paddle.seed(42)
+    a1, _ = G.sample_neighbors(row, colptr,
+                               paddle.to_tensor(np.array([0, 1])),
+                               sample_size=3)
+    paddle.seed(42)
+    a2, _ = G.sample_neighbors(row, colptr,
+                               paddle.to_tensor(np.array([0, 1])),
+                               sample_size=3)
+    np.testing.assert_array_equal(a1.numpy(), a2.numpy())

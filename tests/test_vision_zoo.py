@@ -1,0 +1,145 @@
+"""Round-3 vision breadth: model zoo part 2 + the detection op suite
+(numeric identities: deform_conv≡conv at zero offsets, box_coder
+round-trip, NMS suppression behavior, prior_box coverage)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.models as M
+import paddle_tpu.vision.ops as V
+
+
+def test_zoo2_forward_shapes():
+    paddle.seed(0)
+    x = paddle.randn([1, 3, 64, 64])
+    for fn in (M.mobilenet_v3_small, M.squeezenet1_1,
+               M.shufflenet_v2_x0_25, M.densenet121):
+        m = fn(num_classes=7)
+        m.eval()
+        assert m(x).shape == [1, 7], fn.__name__
+    g = M.googlenet(num_classes=5)
+    g.eval()
+    main, aux1, aux2 = g(paddle.randn([1, 3, 96, 96]))
+    assert main.shape == [1, 5] and aux1.shape == [1, 5]
+
+
+def test_zoo2_state_dict_roundtrip():
+    m = M.mobilenet_v3_small(num_classes=4)
+    sd = m.state_dict()
+    m2 = M.mobilenet_v3_small(num_classes=4)
+    m2.set_state_dict(sd)
+    m.eval(); m2.eval()
+    x = paddle.randn([1, 3, 32, 32])
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    priors = paddle.to_tensor(
+        np.array([[0., 0., 10., 10.], [5., 5., 15., 15.]], "float32"))
+    pvar = paddle.to_tensor(np.full((2, 4), 1.0, "float32"))
+    targets = paddle.to_tensor(
+        np.array([[1., 1., 9., 9.], [4., 6., 16., 14.]], "float32"))
+    enc = V.box_coder(priors, pvar, targets, "encode_center_size")
+    deltas = enc.numpy()[np.arange(2), np.arange(2)][None]
+    dec = V.box_coder(priors, pvar, paddle.to_tensor(deltas),
+                      "decode_center_size", axis=0)
+    np.testing.assert_allclose(dec.numpy()[0], targets.numpy(), atol=1e-4)
+
+
+def test_deform_conv_zero_offset_equals_conv():
+    paddle.seed(1)
+    x = paddle.randn([1, 4, 8, 8])
+    w = paddle.randn([6, 4, 3, 3])
+    off = paddle.zeros([1, 18, 8, 8])
+    got = V.deform_conv2d(x, off, w, padding=1)
+    import paddle_tpu.nn.functional as F
+
+    want = F.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(got.numpy(), want.numpy(), atol=1e-3)
+    # nonzero offsets change the answer
+    off2 = paddle.full([1, 18, 8, 8], 0.7)
+    assert not np.allclose(V.deform_conv2d(x, off2, w, padding=1).numpy(),
+                           want.numpy(), atol=1e-3)
+
+
+def test_matrix_nms_suppresses_overlaps():
+    bx = paddle.to_tensor(np.array(
+        [[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5], [50, 50, 60, 60]]],
+        "float32"))
+    sc = paddle.to_tensor(np.array([[[0.9, 0.8, 0.7]]], "float32"))
+    out, num = V.matrix_nms(bx, sc, score_threshold=0.1, post_threshold=0.5,
+                            background_label=-1)
+    kept = out.numpy()
+    # the near-duplicate gets decayed below post_threshold; 2 boxes survive
+    assert kept.shape[0] == 2
+    assert {round(float(s), 1) for s in kept[:, 1]} == {0.9, 0.7}
+
+
+def test_yolo_box_and_loss():
+    paddle.seed(0)
+    x = paddle.randn([1, 3 * 7, 4, 4])
+    boxes, scores = V.yolo_box(x, paddle.to_tensor(np.array([[128, 128]])),
+                               [10, 13, 16, 30, 33, 23], 2)
+    assert boxes.shape == [1, 48, 4] and scores.shape == [1, 48, 2]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 127).all()  # clipped to image
+    gt = paddle.to_tensor(np.array([[[0.5, 0.5, 0.3, 0.3]]], "float32"))
+    gl = paddle.to_tensor(np.array([[1]]))
+    loss = V.yolo_loss(x, gt, gl, [10, 13, 16, 30, 33, 23], [0, 1, 2], 2,
+                       0.7, 32)
+    assert loss.shape == [1] and float(loss.numpy()[0]) > 0
+
+
+def test_prior_box_and_fpn_distribute():
+    pb, var = V.prior_box(paddle.randn([1, 8, 2, 2]),
+                          paddle.randn([1, 3, 16, 16]),
+                          min_sizes=[4.0], aspect_ratios=[1.0, 2.0],
+                          flip=True, clip=True)
+    assert pb.shape == [2, 2, 3, 4] and var.shape == [2, 2, 3, 4]
+    arr = pb.numpy()
+    assert (arr >= 0).all() and (arr <= 1).all()
+    rois = paddle.to_tensor(
+        np.array([[0, 0, 10, 10], [0, 0, 200, 200]], "float32"))
+    outs, restore, nums = V.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    assert len(outs) == 4
+    sizes = [o.shape[0] for o in outs]
+    assert sum(sizes) == 2 and sizes[0] == 1  # small roi → lowest level
+    assert sorted(restore.numpy().tolist()) == [0, 1]
+
+
+def test_generate_proposals_and_psroi():
+    np.random.seed(0)
+    anchors = np.zeros((2, 2, 3, 4), "float32")
+    for i in range(2):
+        for j in range(2):
+            anchors[i, j] = [[j * 16, i * 16, j * 16 + 32, i * 16 + 32]] * 3
+    rois, rscores = V.generate_proposals(
+        paddle.to_tensor(np.random.rand(1, 3, 2, 2).astype("float32")),
+        paddle.to_tensor(np.random.randn(1, 12, 2, 2).astype("float32") * 0.1),
+        paddle.to_tensor(np.array([[64.0, 64.0]], "float32")),
+        paddle.to_tensor(anchors.reshape(-1, 4)),
+        paddle.to_tensor(np.full((12, 4), 1.0, "float32")),
+        nms_thresh=0.9)
+    assert rois.shape[1] == 4 and rois.shape[0] == rscores.shape[0] > 0
+    ps = V.psroi_pool(
+        paddle.randn([1, 8, 16, 16]),
+        paddle.to_tensor(np.array([[0., 0., 8., 8.]], "float32")),
+        paddle.to_tensor(np.array([1])), 2)
+    assert ps.shape == [1, 2, 2, 2]
+
+
+def test_image_io_roundtrip(tmp_path):
+    from PIL import Image
+
+    # smooth gradient — JPEG preserves low-frequency content
+    gy, gx = np.mgrid[0:10, 0:12]
+    arr = np.stack([gy * 20, gx * 20, gy * 10 + gx * 10], -1).astype("uint8")
+    p = tmp_path / "t.jpg"
+    Image.fromarray(arr).save(p, quality=95)
+    raw = V.read_file(str(p))
+    assert raw.dtype == paddle.uint8
+    img = V.decode_jpeg(raw)
+    assert img.shape == [3, 10, 12]
+    # lossy but close
+    assert np.abs(img.numpy().transpose(1, 2, 0).astype(int)
+                  - arr.astype(int)).mean() < 20
