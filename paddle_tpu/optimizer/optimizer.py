@@ -557,7 +557,16 @@ class LBFGS(Optimizer):
             gs.append((unwrap(g) if g is not None
                        else jnp.zeros(tuple(p.shape))).astype(
                 jnp.float32).reshape(-1))
-        return jnp.concatenate(gs)
+        flat = jnp.concatenate(gs)
+        if self._weight_decay:
+            flat = flat + self._weight_decay * self._flat_params()
+        if self._grad_clip is not None:
+            # flatten-aware clip: treat the whole vector as one tensor
+            from ..tensor_class import wrap as _wrap
+
+            clipped = self._grad_clip.functional_clip({"g": flat})
+            flat = clipped["g"]
+        return flat
 
     def step(self, closure=None):
         if closure is None:
@@ -588,27 +597,35 @@ class LBFGS(Optimizer):
                 b = rho * float(jnp.dot(y, q))
                 q = q + (a - b) * s
             direction = -q
-            # backtracking line search on the closure
             t = float(self.get_lr())
-            f0 = float(loss.numpy() if hasattr(loss, "numpy") else loss)
-            gd = float(jnp.dot(flat_g, direction))
             x = self._flat_params()
-            success = False
-            for _ls in range(10):
+            if self._line_search is None:
+                # reference line_search_fn=None: plain fixed-step update
                 self._set_flat(x + t * direction)
                 for p in self._parameter_list:
                     p.clear_grad()
                 new_loss = closure()
                 evals += 1
-                f1 = float(new_loss.numpy() if hasattr(new_loss, "numpy")
-                           else new_loss)
-                if f1 <= f0 + 1e-4 * t * gd:
-                    success = True
-                    break
-                t *= 0.5
-            if not success:
-                self._set_flat(x)
-                return loss
+            else:
+                # 'strong_wolfe'/'backtracking': Armijo backtracking search
+                f0 = float(loss.numpy() if hasattr(loss, "numpy") else loss)
+                gd = float(jnp.dot(flat_g, direction))
+                success = False
+                for _ls in range(10):
+                    self._set_flat(x + t * direction)
+                    for p in self._parameter_list:
+                        p.clear_grad()
+                    new_loss = closure()
+                    evals += 1
+                    f1 = float(new_loss.numpy()
+                               if hasattr(new_loss, "numpy") else new_loss)
+                    if f1 <= f0 + 1e-4 * t * gd:
+                        success = True
+                        break
+                    t *= 0.5
+                if not success:
+                    self._set_flat(x)
+                    return loss
             new_g = self._flat_grad()
             s_vec = t * direction
             y_vec = new_g - flat_g

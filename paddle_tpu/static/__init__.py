@@ -108,3 +108,13 @@ class nn:
         if activation:
             out = getattr(paddle.nn.functional, activation)(out)
         return out
+
+from .compat import (  # noqa: E402,F401
+    Variable, Scope, global_scope, scope_guard, append_backward, gradients,
+    BuildStrategy, IpuStrategy, IpuCompiledProgram, ipu_shard_guard,
+    set_ipu_shard, device_guard, name_scope, Print, py_func,
+    create_global_var, create_parameter, accuracy, auc, ctr_metric_bundle,
+    ExponentialMovingAverage, WeightNormParamAttr, cpu_places, cuda_places,
+    xpu_places, save, load, load_program_state, set_program_state,
+    serialize_program, serialize_persistables, save_to_file, load_from_file,
+    deserialize_program, deserialize_persistables, normalize_program)

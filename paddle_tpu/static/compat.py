@@ -1,0 +1,579 @@
+"""Static-graph compatibility tail (python/paddle/static/__init__.py
+parity): scopes, gradient APIs, program serialization, metrics, device
+lists, EMA. The TPU-native 'static graph' is the record-replay Program
+(program.py) + jax.jit; these APIs operate on that representation.
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import pickle
+
+import numpy as np
+
+from ..tensor_class import Tensor, Parameter, unwrap, wrap
+
+Variable = Tensor  # static.Variable parity: one tensor type everywhere
+
+
+# ---------------------------------------------------------------------------
+# scopes
+# ---------------------------------------------------------------------------
+
+class _ScopeVar:
+    """Minimal Variable holder (core.Scope var analog)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._tensor = None
+
+    def get_tensor(self):
+        return self._tensor
+
+    def set(self, value, place=None):
+        self._tensor = value
+
+
+class Scope:
+    """paddle.static.global_scope() object parity (core.Scope)."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        if name not in self._vars:
+            self._vars[name] = _ScopeVar(name)
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def erase(self, names):
+        for n in names:
+            self._vars.pop(n, None)
+
+
+_GLOBAL_SCOPE = Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope() -> Scope:
+    return _SCOPE_STACK[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    """paddle.static.scope_guard parity."""
+    _SCOPE_STACK.append(scope)
+    try:
+        yield
+    finally:
+        _SCOPE_STACK.pop()
+
+
+# ---------------------------------------------------------------------------
+# gradient APIs
+# ---------------------------------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """paddle.static.append_backward (python/paddle/base/backward.py): add
+    the backward pass for ``loss`` and return [(param, grad)] pairs.
+
+    TPU-native: 'static mode' records eagerly-executed ops, so the backward
+    is computed right here with the tape; each grad is named param@GRAD
+    (the reference naming) and registered in the global scope."""
+    from ..autograd import grad as _grad
+
+    if parameter_list is None:
+        parameter_list = _trainable_inputs(loss)
+    params = [p for p in parameter_list
+              if no_grad_set is None or p not in no_grad_set]
+    grads = _grad([loss], params, retain_graph=True, allow_unused=True)
+    pairs = []
+    for p, g in zip(params, grads):
+        if g is None:
+            continue
+        g.name = f"{getattr(p, 'name', None) or 'param'}@GRAD"
+        global_scope().var(g.name).set(g)
+        pairs.append((p, g))
+    return pairs
+
+
+def _trainable_inputs(loss):
+    """Default parameter_list: walk the tape slice below ``loss`` and
+    collect trainable leaves (tensors no recorded op produced)."""
+    from ..autograd.tape import _st
+
+    tape = list(_st().tape)
+    produced = set()
+    for node in tape:
+        for r in node.out_refs:
+            o = r()
+            if o is not None:
+                produced.add(id(o))
+    # transitive input closure from loss
+    needed = {id(loss)}
+    leaves, seen = [], set()
+    for node in reversed(tape):
+        if not any(r() is not None and id(r()) in needed
+                   for r in node.out_refs):
+            continue
+        for t in node.in_tensors:
+            if t is None:
+                continue
+            needed.add(id(t))
+            if (id(t) not in produced and not t.stop_gradient
+                    and id(t) not in seen):
+                seen.add(id(t))
+                leaves.append(t)
+    return leaves
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients parity: d(targets)/d(inputs)."""
+    from ..autograd import grad as _grad
+
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gv = None
+    if target_gradients is not None:
+        gv = (target_gradients if isinstance(target_gradients, (list, tuple))
+              else [target_gradients])
+    return _grad(targets, inputs, grad_outputs=gv, retain_graph=True,
+                 allow_unused=True)
+
+
+# ---------------------------------------------------------------------------
+# strategies / guards
+# ---------------------------------------------------------------------------
+
+class BuildStrategy:
+    """paddle.static.BuildStrategy parity. Every knob is a fusion/exec hint
+    the reference's graph passes consume; under XLA the corresponding
+    rewrites are automatic, so the values are recorded for introspection
+    and have no additional effect (documented, not silent: see repr)."""
+
+    _FIELDS = ("build_cse_optimized_program", "debug_graphviz_path",
+               "enable_addto", "enable_auto_fusion", "enable_inplace",
+               "enable_sequential_execution", "fuse_bn_act_ops",
+               "fuse_bn_add_act_ops", "fuse_broadcast_ops",
+               "fuse_elewise_add_act_ops", "fuse_gemm_epilogue",
+               "fuse_relu_depthwise_conv", "fused_attention",
+               "fused_feedforward", "memory_optimize", "reduce_strategy",
+               "remove_unnecessary_lock", "sequential_run",
+               "sync_batch_norm")
+
+    def __init__(self):
+        for f in self._FIELDS:
+            object.__setattr__(self, f, None)
+
+    def __setattr__(self, name, value):
+        if name not in self._FIELDS:
+            raise AttributeError(
+                f"BuildStrategy has no field {name!r} (reference field set)")
+        object.__setattr__(self, name, value)
+
+    def __repr__(self):
+        set_f = {f: getattr(self, f) for f in self._FIELDS
+                 if getattr(self, f) is not None}
+        return (f"BuildStrategy({set_f} — hints only; XLA performs these "
+                "fusions automatically)")
+
+
+class IpuStrategy:
+    """IPU support is not part of this build (reference parity: paddle
+    raises on IPU APIs unless compiled with IPU)."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError("Not compiled with IPU (paddle_tpu targets TPU; "
+                           "use the default device path)")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError("Not compiled with IPU")
+
+
+def ipu_shard_guard(*a, **k):
+    raise RuntimeError("Not compiled with IPU")
+
+
+def set_ipu_shard(*a, **k):
+    raise RuntimeError("Not compiled with IPU")
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """paddle.static.device_guard: pin ops in the block to a device."""
+    import jax
+
+    if device is None or str(device).startswith(("gpu", "tpu", "npu")):
+        yield
+        return
+    plat = str(device).split(":")[0]
+    try:
+        dev = jax.devices(plat)[0]
+    except RuntimeError:
+        yield
+        return
+    with jax.default_device(dev):
+        yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """paddle.static.name_scope: prefix recorded op names (program.py
+    records through the registry; the prefix stack is consumed there)."""
+    _NAME_SCOPES.append(prefix or "")
+    try:
+        yield
+    finally:
+        _NAME_SCOPES.pop()
+
+
+_NAME_SCOPES: list = []
+
+
+def current_name_scope() -> str:
+    return "/".join(s for s in _NAME_SCOPES if s)
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """paddle.static.Print: print-and-passthrough. Inside jit it lowers to
+    jax.debug.print (host callback); eagerly it prints immediately."""
+    import jax
+
+    from ..ops.registry import apply
+
+    def fn(a):
+        tag = message or getattr(input, "name", None) or "var"
+        jax.debug.print(tag + ": {}", a)
+        return a
+
+    return apply("print", fn, input, differentiable=True)
+
+
+def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=None):
+    """paddle.static.py_func: run a host python function as an op. Eagerly
+    this is a direct call; for the jit path use
+    utils.cpp_extension.register_host_op (pure_callback bridge)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    args = [np.asarray(unwrap(v)) for v in xs]
+    res = func(*args)
+    if res is None:
+        return None
+    import jax.numpy as jnp
+
+    if isinstance(res, (list, tuple)):
+        return [wrap(jnp.asarray(np.asarray(r))) for r in res]
+    return wrap(jnp.asarray(np.asarray(res)))
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    """paddle.static.create_global_var: a named tensor in the global scope."""
+    import jax.numpy as jnp
+
+    from ..framework.dtype import convert_dtype
+
+    t = wrap(jnp.full(tuple(int(s) for s in shape), value,
+                      convert_dtype(dtype)))
+    t.name = name or f"global_var_{len(global_scope()._vars)}"
+    t.persistable = persistable
+    global_scope().var(t.name).set(t)
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..ops.creation import create_parameter as _cp
+
+    p = _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+            default_initializer=default_initializer)
+    if getattr(p, "name", None):
+        global_scope().var(p.name).set(p)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """paddle.static.accuracy: top-k accuracy of predictions."""
+    import jax.numpy as jnp
+
+    from ..ops.registry import apply
+
+    def fn(logits, lbl):
+        topk = jnp.argsort(-logits, -1)[..., :k]
+        hit = (topk == lbl.reshape(-1, 1)).any(-1)
+        return hit.mean(dtype=jnp.float32)
+
+    return apply("accuracy", fn, input, label, differentiable=False)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """paddle.static.auc: ROC-AUC via the thresholded confusion-matrix
+    histogram (the reference's auc_op algorithm). Returns
+    (auc_out, batch_auc_out, [state tensors])."""
+    import jax.numpy as jnp
+
+    from ..ops.registry import apply
+
+    def fn(pred, lbl):
+        p = pred[..., -1] if pred.ndim > 1 else pred
+        y = lbl.reshape(-1).astype(jnp.float32)
+        bins = jnp.clip((p.reshape(-1) * num_thresholds).astype(jnp.int32),
+                        0, num_thresholds)
+        pos_hist = jnp.zeros(num_thresholds + 1).at[bins].add(y)
+        neg_hist = jnp.zeros(num_thresholds + 1).at[bins].add(1 - y)
+        # sweep thresholds high→low accumulating TP/FP
+        tp = jnp.cumsum(pos_hist[::-1])
+        fp = jnp.cumsum(neg_hist[::-1])
+        tot_p = jnp.maximum(tp[-1], 1e-6)
+        tot_n = jnp.maximum(fp[-1], 1e-6)
+        tpr = tp / tot_p
+        fpr = fp / tot_n
+        return jnp.trapezoid(tpr, fpr)
+
+    a = apply("auc", fn, input, label, differentiable=False)
+    return a, a, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """paddle.static.ctr_metric_bundle: (auc, sqrerr, abserr, prob, q, pos,
+    total) aggregate CTR metrics."""
+    import jax.numpy as jnp
+
+    from ..ops.registry import apply
+
+    auc_v, _, _ = auc(input, label)
+
+    def fn(pred, lbl):
+        p = pred[..., -1] if pred.ndim > 1 else pred
+        p = p.reshape(-1)
+        y = lbl.reshape(-1).astype(jnp.float32)
+        sqrerr = ((p - y) ** 2).sum()
+        abserr = jnp.abs(p - y).sum()
+        prob = p.sum()
+        q = (p / jnp.maximum(1 - p, 1e-6)).sum()
+        pos = y.sum()
+        total = jnp.asarray(float(p.shape[0]), jnp.float32)
+        return sqrerr, abserr, prob, q, pos, total
+
+    rest = apply("ctr_metrics", fn, input, label, differentiable=False)
+    return (auc_v,) + tuple(rest)
+
+
+# ---------------------------------------------------------------------------
+# EMA / weight-norm attr
+# ---------------------------------------------------------------------------
+
+class ExponentialMovingAverage:
+    """paddle.static.ExponentialMovingAverage: bias-corrected EMA of every
+    trainable parameter with apply()/restore()."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._step = 0
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+
+        params = parameters or _all_tracked_parameters()
+        self._step += 1
+        for p in params:
+            key = id(p)
+            v = unwrap(p).astype(jnp.float32)
+            if key not in self._ema:
+                self._ema[key] = (p, jnp.zeros_like(v))
+            _, e = self._ema[key]
+            self._ema[key] = (p, self._decay * e + (1 - self._decay) * v)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+
+        correction = 1 - self._decay ** max(self._step, 1)
+        for key, (p, e) in self._ema.items():
+            self._backup[key] = unwrap(p)
+            p._array = (e / correction).astype(unwrap(p).dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for key, (p, _) in self._ema.items():
+            if key in self._backup:
+                p._array = self._backup.pop(key)
+
+
+def _all_tracked_parameters():
+    raise ValueError(
+        "ExponentialMovingAverage.update() needs `parameters` in the "
+        "TPU build (there is no global parameter registry by design; "
+        "pass model.parameters())")
+
+
+class WeightNormParamAttr:
+    """paddle.static.WeightNormParamAttr: ParamAttr carrying a weight-norm
+    dim. In this framework the reparameterization itself is applied with
+    paddle.nn.utils.weight_norm (dynamic-mode mechanism; works under jit);
+    this attr records dim/init so APIs accepting it keep working."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from ..nn.initializer_core import ParamAttr
+
+        self.dim = dim
+        self._attr = ParamAttr(name=name, initializer=initializer,
+                               learning_rate=learning_rate,
+                               regularizer=regularizer, trainable=trainable,
+                               need_clip=need_clip)
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+# ---------------------------------------------------------------------------
+# device lists
+# ---------------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    """paddle.static.cpu_places: exactly device_count (or CPU_NUM) places —
+    the reference replicates onto logical places regardless of cores."""
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    from ..framework.device import CPUPlace
+
+    return [CPUPlace() for _ in range(max(n, 1))]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (CUDAPlace aliases the TPU place)."""
+    import jax
+
+    from ..framework.device import TPUPlace
+
+    if device_ids is None:
+        try:
+            device_ids = range(len(jax.devices()))
+        except RuntimeError:
+            device_ids = [0]
+    return [TPUPlace(i) for i in device_ids]
+
+
+xpu_places = cuda_places
+
+
+# ---------------------------------------------------------------------------
+# program state / serialization
+# ---------------------------------------------------------------------------
+
+def _collect_persistables(program=None):
+    """The scope's named tensors (parameters registered via
+    create_parameter/create_global_var + everything the program tracked)."""
+    out = {}
+    for name, var in global_scope()._vars.items():
+        t = var.get_tensor()
+        if t is not None:
+            out[name] = np.asarray(unwrap(t))
+    return out
+
+
+def save(program, model_path, protocol=4, **configs):
+    """paddle.static.save: persist program structure + persistables."""
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    state = _collect_persistables(program)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(serialize_program(None, None, program=program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """paddle.static.load: restore persistables saved by static.save."""
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    set_program_state(program, state)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    """Write values back into scope vars (and any live tensors)."""
+    import jax.numpy as jnp
+
+    for name, value in state_dict.items():
+        var = global_scope().var(name)
+        t = var.get_tensor()
+        if t is not None and isinstance(t, Tensor):
+            t._array = jnp.asarray(value).astype(t._array.dtype)
+        else:
+            var.set(wrap(jnp.asarray(value)))
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Serialized program structure (the record-replay op list)."""
+    prog = program
+    if prog is None:
+        from .program import default_main_program
+
+        prog = default_main_program()
+    meta = {
+        "format": "paddle_tpu.program.v1",
+        "ops": [n.name for n in getattr(prog, "nodes", [])],
+    }
+    return pickle.dumps(meta)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    return pickle.dumps(_collect_persistables(program))
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    set_program_state(program, state)
+    return state
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """paddle.static.normalize_program: prune to the feed→fetch slice. The
+    record-replay program is already minimal per replay, so this returns
+    the program with feed/fetch metadata attached."""
+    program._normalized_feed = [getattr(v, "name", None) for v in feed_vars]
+    program._normalized_fetch = [getattr(v, "name", None) for v in fetch_vars]
+    return program

@@ -152,3 +152,270 @@ class Imdb(Dataset):
 
     def __len__(self):
         return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """text/datasets/imikolov.py parity: PTB n-grams from
+    simple-examples.tgz."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        import collections
+        import tarfile
+
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/imikolov/simple-examples.tgz")
+        if not os.path.exists(path):
+            raise RuntimeError(_NO_EGRESS.format(name="Imikolov", path=path))
+        fname = {"train": "ptb.train.txt", "valid": "ptb.valid.txt",
+                 "test": "ptb.test.txt"}[mode]
+        with tarfile.open(path) as tf:
+            member = next(m for m in tf.getmembers()
+                          if m.name.endswith(fname))
+            lines = tf.extractfile(member).read().decode().splitlines()
+            train_member = next(m for m in tf.getmembers()
+                                if m.name.endswith("ptb.train.txt"))
+            train_lines = tf.extractfile(train_member).read().decode() \
+                .splitlines()
+        freq = collections.Counter(
+            w for ln in train_lines for w in ln.split())
+        # <unk> gets the trailing id; drop a literal <unk> token first
+        # (PTB text contains it) so no id exceeds len(word_idx)-1
+        freq.pop("<unk>", None)
+        vocab = sorted(w for w, c in freq.items() if c >= min_word_freq)
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for ln in lines:
+            ids = [self.word_idx.get(w, unk) for w in ln.split()]
+            if data_type.upper() == "NGRAM":
+                for i in range(window_size - 1, len(ids)):
+                    self.data.append(np.asarray(
+                        ids[i - window_size + 1:i + 1], np.int64))
+            else:  # SEQ
+                self.data.append(np.asarray(ids, np.int64))
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """text/datasets/movielens.py parity: ml-1m.zip (ratings/users/movies
+    .dat files, '::'-separated)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        import zipfile
+
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/movielens/ml-1m.zip")
+        if not os.path.exists(path):
+            raise RuntimeError(_NO_EGRESS.format(name="Movielens", path=path))
+        with zipfile.ZipFile(path) as zf:
+            def read(name):
+                member = next(n for n in zf.namelist()
+                              if n.endswith(name))
+                return zf.read(member).decode("latin1").splitlines()
+
+            users = {}
+            for ln in read("users.dat"):
+                uid, gender, age, job, _zip = ln.split("::")
+                users[int(uid)] = (0 if gender == "M" else 1, int(age),
+                                   int(job))
+            movies = {}
+            for ln in read("movies.dat"):
+                mid, title, genres = ln.split("::")
+                movies[int(mid)] = (title, genres.split("|"))
+            rows = []
+            for ln in read("ratings.dat"):
+                uid, mid, rating, _ts = ln.split("::")
+                uid, mid = int(uid), int(mid)
+                if uid in users and mid in movies:
+                    rows.append((uid, *users[uid], mid, float(rating)))
+        rng = np.random.RandomState(rand_seed)
+        mask = rng.rand(len(rows)) < test_ratio
+        self.rows = [r for r, m in zip(rows, mask)
+                     if (m if mode == "test" else not m)]
+        self.movie_info = movies
+        self.user_info = users
+
+    def __getitem__(self, i):
+        uid, gender, age, job, mid, rating = self.rows[i]
+        return (np.asarray([uid]), np.asarray([gender]), np.asarray([age]),
+                np.asarray([job]), np.asarray([mid]),
+                np.asarray([rating], np.float32))
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class _WMTBase(Dataset):
+    """Shared tab-separated parallel-corpus parsing for WMT14/WMT16
+    (reference preprocessed archives: one 'src\\ttgt' pair per line)."""
+
+    BOS, EOS, UNK = "<s>", "<e>", "<unk>"
+
+    def _build(self, lines, src_dict_size, trg_dict_size=None):
+        import collections
+
+        trg_dict_size = trg_dict_size if trg_dict_size is not None \
+            else src_dict_size
+        src_freq = collections.Counter()
+        trg_freq = collections.Counter()
+        pairs = []
+        for ln in lines:
+            if "\t" not in ln:
+                continue
+            s, t = ln.split("\t", 1)
+            sw, tw = s.split(), t.split()
+            pairs.append((sw, tw))
+            src_freq.update(sw)
+            trg_freq.update(tw)
+
+        def make_dict(freq, size):
+            words = [w for w, _ in freq.most_common(max(size - 3, 0))]
+            d = {self.BOS: 0, self.EOS: 1, self.UNK: 2}
+            for w in words:
+                d[w] = len(d)
+            return d
+
+        self.src_dict = make_dict(src_freq, src_dict_size)
+        self.trg_dict = make_dict(trg_freq, trg_dict_size)
+        unk = 2
+        self.data = []
+        for sw, tw in pairs:
+            src_ids = [self.src_dict.get(w, unk) for w in sw]
+            trg_ids = [self.trg_dict.get(w, unk) for w in tw]
+            self.data.append((
+                np.asarray(src_ids, np.int64),
+                np.asarray([0] + trg_ids, np.int64),
+                np.asarray(trg_ids + [1], np.int64)))
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_WMTBase):
+    """text/datasets/wmt14.py parity (preprocessed en→fr pairs)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
+        import tarfile
+
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/wmt14/wmt14.tgz")
+        if not os.path.exists(path):
+            raise RuntimeError(_NO_EGRESS.format(name="WMT14", path=path))
+        want = {"train": "train/", "test": "test/", "gen": "gen/"}[mode]
+        lines = []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if want in m.name and m.isfile():
+                    lines += tf.extractfile(m).read().decode(
+                        "utf-8", "ignore").splitlines()
+        self._build(lines, dict_size)
+
+
+class WMT16(_WMTBase):
+    """text/datasets/wmt16.py parity (en↔de multi30k-style archive)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        import tarfile
+
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/wmt16/wmt16.tar.gz")
+        if not os.path.exists(path):
+            raise RuntimeError(_NO_EGRESS.format(name="WMT16", path=path))
+        fname = {"train": "train", "test": "test", "val": "val"}[mode]
+        lines = []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if m.isfile() and m.name.rstrip("/").endswith(fname):
+                    lines += tf.extractfile(m).read().decode(
+                        "utf-8", "ignore").splitlines()
+        self._build(lines, src_dict_size, trg_dict_size)
+
+
+class Conll05st(Dataset):
+    """text/datasets/conll05.py parity: SRL test set (wsj words + props
+    column files inside conll05st-tests.tar.gz)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True):
+        import gzip
+        import tarfile
+
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/conll05st/conll05st-tests.tar.gz")
+        if not os.path.exists(path):
+            raise RuntimeError(_NO_EGRESS.format(name="Conll05st", path=path))
+        with tarfile.open(path) as tf:
+            def read_gz(suffix):
+                m = next(mm for mm in tf.getmembers()
+                         if mm.name.endswith(suffix))
+                return gzip.decompress(tf.extractfile(m).read()) \
+                    .decode().splitlines()
+
+            words_lines = read_gz("words.gz")
+            props_lines = read_gz("props.gz")
+        # sentences separated by blank lines; props columns: verb + tags
+        self.sentences = []
+        cur_w, cur_p = [], []
+        for wl, pl in zip(words_lines, props_lines):
+            if not wl.strip():
+                if cur_w:
+                    self.sentences.append((cur_w, cur_p))
+                cur_w, cur_p = [], []
+                continue
+            cur_w.append(wl.strip())
+            cur_p.append(pl.split())
+        if cur_w:
+            self.sentences.append((cur_w, cur_p))
+        # flatten: one sample per predicate per sentence (SRL convention)
+        self.data = []
+        vocab = {}
+        for words, props in self.sentences:
+            for w in words:
+                vocab.setdefault(w.lower(), len(vocab))
+            n_preds = len(props[0]) - 1 if props and props[0] else 0
+            for k in range(n_preds):
+                labels = self._decode_props([p[k + 1] for p in props])
+                verb = next((w for w, p in zip(words, props)
+                             if p[0] != "-"), "-")
+                ids = np.asarray([vocab[w.lower()] for w in words], np.int64)
+                self.data.append((ids, verb, labels))
+        self.word_dict = vocab
+
+    @staticmethod
+    def _decode_props(col):
+        """IOB decode of the bracketed (A0* ... *) proposition column."""
+        labels = []
+        current = None
+        for tok in col:
+            if tok.startswith("("):
+                current = tok.strip("()*")
+                labels.append("B-" + current)
+                if tok.endswith(")"):
+                    current = None
+            elif current is not None:
+                labels.append("I-" + current)
+                if tok.endswith(")"):
+                    current = None
+            else:
+                labels.append("O")
+        return labels
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)

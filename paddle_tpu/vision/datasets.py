@@ -194,3 +194,96 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(Dataset):
+    """datasets/flowers.py parity: 102flowers.tgz + imagelabels.mat +
+    setid.mat (the reference's cached-download triple)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        base = os.path.expanduser("~/.cache/paddle/dataset/flowers/")
+        data_file = data_file or base + "102flowers.tgz"
+        label_file = label_file or base + "imagelabels.mat"
+        setid_file = setid_file or base + "setid.mat"
+        for p, n in [(data_file, "Flowers"), (label_file, "Flowers labels"),
+                     (setid_file, "Flowers setid")]:
+            if not os.path.exists(p):
+                raise RuntimeError(_NO_EGRESS.format(name=n, path=p))
+        import scipy.io as sio
+
+        labels = sio.loadmat(label_file)["labels"].reshape(-1)
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self._ids = setid[key].reshape(-1)
+        self._labels = labels
+        self._tar = data_file
+        self.transform = transform
+        # index tar members once
+        with tarfile.open(data_file) as tf:
+            self._names = {os.path.basename(m.name): m.name
+                           for m in tf.getmembers() if m.name.endswith(".jpg")}
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        import io as _io
+
+        img_id = int(self._ids[idx])
+        name = self._names[f"image_{img_id:05d}.jpg"]
+        with tarfile.open(self._tar) as tf:
+            data = tf.extractfile(name).read()
+        img = np.asarray(Image.open(_io.BytesIO(data)).convert("RGB"))
+        label = int(self._labels[img_id - 1])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([label])
+
+    def __len__(self):
+        return len(self._ids)
+
+
+class VOC2012(Dataset):
+    """datasets/voc2012.py parity: VOCtrainval_11-May-2012.tar with
+    JPEGImages + SegmentationClass + ImageSets/Segmentation splits."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        data_file = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/voc2012/VOCtrainval_11-May-2012.tar")
+        if not os.path.exists(data_file):
+            raise RuntimeError(_NO_EGRESS.format(name="VOC2012",
+                                                 path=data_file))
+        self._tar = data_file
+        self.transform = transform
+        split = {"train": "train", "valid": "val", "test": "val",
+                 "trainval": "trainval"}[mode]
+        with tarfile.open(data_file) as tf:
+            prefix = None
+            for m in tf.getmembers():
+                if m.name.endswith(
+                        f"ImageSets/Segmentation/{split}.txt"):
+                    prefix = m.name.rsplit("ImageSets/", 1)[0]
+                    ids = tf.extractfile(m).read().decode().split()
+                    break
+            else:
+                raise RuntimeError("VOC2012: split list not found in tar")
+        self._prefix = prefix
+        self._ids = ids
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        import io as _io
+
+        name = self._ids[idx]
+        with tarfile.open(self._tar) as tf:
+            img = np.asarray(Image.open(_io.BytesIO(tf.extractfile(
+                self._prefix + f"JPEGImages/{name}.jpg").read()))
+                .convert("RGB"))
+            lbl = np.asarray(Image.open(_io.BytesIO(tf.extractfile(
+                self._prefix + f"SegmentationClass/{name}.png").read())))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self._ids)

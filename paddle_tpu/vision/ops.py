@@ -235,14 +235,18 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
             cell = []
             for s_i, ms in enumerate(min_sizes):
                 cell.append((cx, cy, ms, ms))
+                ar_boxes = [(cx, cy, ms * math.sqrt(a), ms / math.sqrt(a))
+                            for a in ars if abs(a - 1.0) > 1e-6]
+                max_box = []
                 if max_sizes:
                     big = math.sqrt(ms * max_sizes[s_i])
-                    cell.append((cx, cy, big, big))
-                for a in ars:
-                    if abs(a - 1.0) < 1e-6:
-                        continue
-                    cell.append((cx, cy, ms * math.sqrt(a),
-                                 ms / math.sqrt(a)))
+                    max_box = [(cx, cy, big, big)]
+                # default order: [min, ARs..., max]; flag flips to
+                # [min, max, ARs...] (reference min_max_aspect_ratios_order)
+                if min_max_aspect_ratios_order:
+                    cell.extend(max_box + ar_boxes)
+                else:
+                    cell.extend(ar_boxes + max_box)
             boxes.extend(cell)
     n_priors = len(boxes) // (fh * fw)
     arr = np.asarray(boxes, np.float32).reshape(fh, fw, n_priors, 4)
@@ -541,14 +545,24 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
                             * (rois[:, 3] - rois[:, 1] + off), 0, None))
     lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    # image attribution of each roi (rois_num = per-image counts)
+    if rois_num is not None:
+        rn = np.asarray(unwrap(rois_num)).reshape(-1)
+        img_of = np.repeat(np.arange(rn.size), rn)
+        n_img = rn.size
+    else:
+        img_of = np.zeros(rois.shape[0], np.int64)
+        n_img = 1
     outs, idx_restore = [], np.empty(rois.shape[0], np.int64)
     nums = []
-    pos = 0
     order = []
     for level in range(min_level, max_level + 1):
         sel = np.where(lvl == level)[0]
+        # keep rois grouped by image within the level (reference layout)
+        sel = sel[np.argsort(img_of[sel], kind="stable")]
         outs.append(wrap(jnp.asarray(rois[sel])))
-        nums.append(wrap(jnp.asarray(np.asarray([sel.size], np.int32))))
+        per_img = np.bincount(img_of[sel], minlength=n_img).astype(np.int32)
+        nums.append(wrap(jnp.asarray(per_img)))
         order.extend(sel.tolist())
     for new_i, old_i in enumerate(order):
         idx_restore[old_i] = new_i

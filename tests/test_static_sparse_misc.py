@@ -593,3 +593,69 @@ def test_graph_sampling_reproducible():
                                paddle.to_tensor(np.array([0, 1])),
                                sample_size=3)
     np.testing.assert_array_equal(a1.numpy(), a2.numpy())
+
+
+def test_static_compat_tail():
+    """static round-3 tail: scopes, append_backward/gradients, metrics,
+    EMA, program state, BuildStrategy strictness."""
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.static as st
+
+    w = paddle.create_parameter([2], "float32", name="ab_w")
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    loss = ((w * x) ** 2).sum()
+    pairs = st.append_backward(loss)
+    assert pairs and pairs[0][1].shape == [2]
+    assert pairs[0][1].name.endswith("@GRAD")
+    manual = st.gradients(loss, [w])[0].numpy()
+    np.testing.assert_allclose(pairs[0][1].numpy(), manual, rtol=1e-6)
+
+    sc = st.Scope()
+    with st.scope_guard(sc):
+        st.create_global_var([2], 1.5, "float32", name="scoped_v")
+        assert st.global_scope().find_var("scoped_v") is not None
+    assert st.global_scope().find_var("scoped_v") is None
+
+    bs = st.BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True
+    with pytest.raises(AttributeError):
+        bs.not_a_knob = 1
+
+    acc = st.accuracy(
+        paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], "float32")),
+        paddle.to_tensor(np.array([[1], [1]])))
+    assert float(acc.numpy()) == 0.5
+    # separable predictions → AUC 1; anti-separable → 0
+    auc_v, _, _ = st.auc(
+        paddle.to_tensor(np.array([[0.3, 0.7], [0.6, 0.4], [0.2, 0.8],
+                                   [0.9, 0.1]], "float32")),
+        paddle.to_tensor(np.array([1, 0, 1, 0])))
+    assert abs(float(auc_v.numpy()) - 1.0) < 1e-3
+
+    p1 = paddle.Parameter(np.array([1.0], dtype="float32"))
+    ema = st.ExponentialMovingAverage(0.5)
+    for v in [1.0, 3.0]:
+        p1.set_value(np.array([v], "float32"))
+        ema.update([p1])
+    with ema.apply():
+        # bias-corrected: (0.5*0.5*1 + 0.5*3)/(1-0.25) = 2.333...
+        assert abs(float(p1.numpy()[0]) - 7.0 / 3.0) < 1e-3
+    assert float(p1.numpy()[0]) == 3.0
+
+    tmp = tempfile.mkdtemp()
+    prog = st.default_main_program()
+    w2 = st.create_parameter([3], "float32", name="w_saved_test")
+    st.save(prog, tmp + "/model")
+    old = w2.numpy().copy()
+    w2.set_value(np.zeros(3, "float32"))
+    st.load(prog, tmp + "/model")
+    np.testing.assert_allclose(w2.numpy(), old)
+
+    out = st.py_func(lambda a: a * 2, paddle.ones([3]))
+    np.testing.assert_allclose(out.numpy(), 2.0)
+    with pytest.raises(RuntimeError):
+        st.IpuStrategy()

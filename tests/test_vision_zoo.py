@@ -143,3 +143,129 @@ def test_image_io_roundtrip(tmp_path):
     # lossy but close
     assert np.abs(img.numpy().transpose(1, 2, 0).astype(int)
                   - arr.astype(int)).mean() < 20
+
+
+def _targz_of(tmp_path, name, files, mode="w:gz"):
+    import tarfile
+
+    p = tmp_path / name
+    with tarfile.open(p, mode) as tf:
+        for fname, data in files.items():
+            full = tmp_path / "stage" / fname
+            full.parent.mkdir(parents=True, exist_ok=True)
+            if isinstance(data, bytes):
+                full.write_bytes(data)
+            else:
+                full.write_text(data)
+            tf.add(full, arcname=fname)
+    return str(p)
+
+
+def test_flowers_dataset_synthetic(tmp_path):
+    """Flowers parses the reference triple (tgz + .mat labels/setid)."""
+    import io
+
+    import scipy.io as sio
+    from PIL import Image
+
+    import paddle_tpu.vision.datasets as D
+
+    imgs = {}
+    for i in (1, 2, 3):
+        buf = io.BytesIO()
+        Image.fromarray((np.ones((6, 6, 3)) * i * 40).astype("uint8")).save(
+            buf, format="JPEG")
+        imgs[f"jpg/image_{i:05d}.jpg"] = buf.getvalue()
+    tgz = _targz_of(tmp_path, "102flowers.tgz", imgs)
+    lbl = tmp_path / "imagelabels.mat"
+    sio.savemat(lbl, {"labels": np.array([[5, 7, 9]])})
+    sid = tmp_path / "setid.mat"
+    sio.savemat(sid, {"trnid": np.array([[1, 3]]), "valid": np.array([[2]]),
+                      "tstid": np.array([[2]])})
+    ds = D.Flowers(data_file=tgz, label_file=str(lbl), setid_file=str(sid),
+                   mode="train")
+    assert len(ds) == 2
+    img, label = ds[0]
+    assert img.shape == (6, 6, 3) and int(label[0]) == 5
+    img2, label2 = ds[1]
+    assert int(label2[0]) == 9
+
+
+def test_voc2012_dataset_synthetic(tmp_path):
+    import io
+
+    from PIL import Image
+
+    import paddle_tpu.vision.datasets as D
+
+    files = {}
+    buf = io.BytesIO()
+    Image.fromarray(np.zeros((5, 5, 3), "uint8")).save(buf, format="JPEG")
+    files["VOCdevkit/VOC2012/JPEGImages/2007_000001.jpg"] = buf.getvalue()
+    buf2 = io.BytesIO()
+    Image.fromarray(np.ones((5, 5), "uint8")).save(buf2, format="PNG")
+    files["VOCdevkit/VOC2012/SegmentationClass/2007_000001.png"] = buf2.getvalue()
+    files["VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt"] = "2007_000001\n"
+    tar = _targz_of(tmp_path, "voc.tar", files, mode="w")
+    ds = D.VOC2012(data_file=tar, mode="train")
+    assert len(ds) == 1
+    img, lbl = ds[0]
+    assert img.shape == (5, 5, 3) and lbl.shape == (5, 5)
+
+
+def test_text_datasets_synthetic(tmp_path):
+    import gzip
+    import zipfile
+
+    import paddle_tpu.text as T
+
+    # Imikolov: PTB-style text
+    txt = "the cat sat on the mat\nthe dog sat on the rug\n" * 30
+    tgz = _targz_of(tmp_path, "simple-examples.tgz",
+                    {"simple-examples/data/ptb.train.txt": txt,
+                     "simple-examples/data/ptb.valid.txt": txt[:60]})
+    ds = T.Imikolov(data_file=tgz, window_size=3, mode="train",
+                    min_word_freq=5)
+    assert len(ds) > 0 and ds[0].shape == (3,)
+    assert "the" in ds.word_idx
+
+    # Movielens
+    mlzip = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(mlzip, "w") as zf:
+        zf.writestr("ml-1m/users.dat", "1::M::25::4::12345\n2::F::35::7::6789\n")
+        zf.writestr("ml-1m/movies.dat", "10::Movie A::Comedy|Drama\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "1::10::5::964982703\n2::10::3::964982704\n")
+    ds2 = T.Movielens(data_file=str(mlzip), mode="train", test_ratio=0.0)
+    assert len(ds2) == 2
+    uid, gender, age, job, mid, rating = ds2[0]
+    assert rating.dtype == np.float32
+
+    # WMT16: parallel pairs
+    pairs = "ein hund\ta dog\nzwei katzen\ttwo cats\n"
+    wtar = _targz_of(tmp_path, "wmt16.tar.gz", {"wmt16/train": pairs,
+                                                "wmt16/val": pairs})
+    ds3 = T.WMT16(data_file=wtar, mode="train")
+    assert len(ds3) == 2
+    src, trg_in, trg_out = ds3[0]
+    assert trg_in[0] == 0 and trg_out[-1] == 1  # <s> ... <e>
+
+    # WMT14 same format under train/
+    wtar2 = _targz_of(tmp_path, "wmt14.tgz", {"wmt14/train/part0": pairs})
+    ds4 = T.WMT14(data_file=wtar2, mode="train")
+    assert len(ds4) == 2
+
+    # Conll05st: words + props column files, gzipped inside the tar
+    words = "The\ncat\nsat\n\n"
+    props = "-\t(A0*)\n-\t*\nsat\t(V*)\n\n".replace("\t", " ")
+    ctar = _targz_of(tmp_path, "conll05st-tests.tar.gz", {
+        "conll05st-release/test.wsj/words/test.wsj.words.gz":
+            gzip.compress(words.encode()),
+        "conll05st-release/test.wsj/props/test.wsj.props.gz":
+            gzip.compress(props.encode()),
+    })
+    ds5 = T.Conll05st(data_file=ctar)
+    assert len(ds5) == 1
+    ids, verb, labels = ds5[0]
+    assert verb == "sat" and len(labels) == 3
+    assert labels[0].startswith("B-") and labels[2] == "B-V"
