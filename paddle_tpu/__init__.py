@@ -193,6 +193,11 @@ _LAZY_SUBMODULES = (
     "signal",
     "geometric",
     "strings",
+    "regularizer",
+    "callbacks",
+    "sysconfig",
+    "hub",
+    "version",
 )
 
 

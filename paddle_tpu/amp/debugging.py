@@ -1,0 +1,158 @@
+"""paddle.amp.debugging parity (python/paddle/amp/debugging.py): operator
+stats collection, tensor checking (NaN/Inf), accuracy comparison.
+
+TPU-native: the op registry's single dispatch choke point
+(ops/registry.py::apply) is the hook — stats count every eager op by
+dtype; the tensor checker rides FLAGS_check_nan_inf (which also covers the
+compiled TrainStep path via checkify).
+"""
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass, field
+
+__all__ = ["enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "compare_accuracy",
+           "check_numerics", "DebugMode"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+_STATS = None
+
+
+def _record(op_name: str, dtypes) -> None:
+    if _STATS is None:
+        return
+    for dt in dtypes:
+        key = str(dt)
+        bucket = _STATS.setdefault(op_name, {})
+        bucket[key] = bucket.get(key, 0) + 1
+
+
+def enable_operator_stats_collection() -> None:
+    """Start counting dispatched ops per dtype (op_stats_ hook parity)."""
+    global _STATS
+    _STATS = {}
+    from ..ops import registry
+
+    registry.set_stats_hook(_record)
+
+
+def disable_operator_stats_collection() -> None:
+    """Stop collecting and print the table like the reference."""
+    global _STATS
+    from ..ops import registry
+
+    registry.set_stats_hook(None)
+    stats, _STATS = _STATS, None
+    if stats is None:
+        return
+    print("<{:-^120}>".format(" op list "))
+    print("{:<40}|{:<40}|{:<20}".format("op", "dtype", "calls"))
+    for op, by_dtype in sorted(stats.items()):
+        for dt, n in sorted(by_dtype.items()):
+            print("{:<40}|{:<40}|{:<20}".format(op, dt, n))
+    print("<{:-^120}>".format(""))
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def operator_stats_snapshot():
+    """Current counts (test/introspection hook; not in the reference API)."""
+    return {} if _STATS is None else {k: dict(v) for k, v in _STATS.items()}
+
+
+@dataclass
+class TensorCheckerConfig:
+    """paddle.amp.debugging.TensorCheckerConfig parity."""
+
+    enable: bool = True
+    debug_mode: int = DebugMode.CHECK_NAN_INF_AND_ABORT
+    output_dir: str | None = None
+    checked_op_list: list = field(default_factory=list)
+    skipped_op_list: list = field(default_factory=list)
+    debug_step: tuple | None = None
+    stack_height_limit: int = 1
+
+
+_CHECKER_PREV = None
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig) -> None:
+    """Route through FLAGS_check_nan_inf — the registry raises on the first
+    non-finite op output (and TrainStep compiles under checkify)."""
+    global _CHECKER_PREV
+    from ..utils import flags
+
+    _CHECKER_PREV = flags.get_flags("FLAGS_check_nan_inf")
+    flags.set_flags({"FLAGS_check_nan_inf": bool(checker_config.enable)})
+
+
+def disable_tensor_checker() -> None:
+    from ..utils import flags
+
+    prev = _CHECKER_PREV if _CHECKER_PREV is not None else {}
+    flags.set_flags({"FLAGS_check_nan_inf":
+                     prev.get("FLAGS_check_nan_inf", False)
+                     if isinstance(prev, dict) else False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """paddle.amp.debugging.check_numerics: raise on NaN/Inf now."""
+    import jax.numpy as jnp
+
+    from ..tensor_class import unwrap
+
+    a = unwrap(tensor)
+    if jnp.issubdtype(a.dtype, jnp.floating) and not bool(
+            jnp.isfinite(a).all()):
+        raise FloatingPointError(
+            f"check_numerics: non-finite values in {op_type or 'tensor'} "
+            f"{var_name}")
+    return tensor
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """paddle.amp.debugging.compare_accuracy: diff two tensor dumps (as
+    produced by incubate.accuracy_check / numpy .npz dumps) into a CSV."""
+    import csv
+    import os
+
+    import numpy as np
+
+    def load(p):
+        out = {}
+        for f in sorted(os.listdir(p)):
+            if f.endswith((".npy", ".npz")):
+                arr = np.load(os.path.join(p, f), allow_pickle=False)
+                out[f] = arr[arr.files[0]] if hasattr(arr, "files") else arr
+        return out
+
+    a, b = load(dump_path), load(another_dump_path)
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["tensor", "max_abs_diff", "mean_abs_diff", "shape_match"])
+        for name in sorted(set(a) | set(b)):
+            if name in a and name in b and a[name].shape == b[name].shape:
+                d = np.abs(a[name].astype(np.float64)
+                           - b[name].astype(np.float64))
+                w.writerow([name, d.max(), d.mean(), True])
+            else:
+                w.writerow([name, "", "", False])
+    return output_filename

@@ -19,4 +19,9 @@ def __getattr__(name):
         globals()["PyLayer"] = pylayer.PyLayer
         globals()["PyLayerContext"] = pylayer.PyLayerContext
         return globals()[name]
+    if name in ("jacobian", "hessian", "saved_tensors_hooks"):
+        from . import functional as _f
+
+        globals()[name] = getattr(_f, name)
+        return globals()[name]
     raise AttributeError(f"module 'paddle_tpu.autograd' has no attribute {name!r}")

@@ -1,0 +1,127 @@
+"""paddle.autograd functional APIs: jacobian / hessian (python/paddle/
+autograd/autograd.py) and saved_tensors_hooks (saved_tensors_hooks.py).
+
+TPU-native: jacobian/hessian lower straight onto jax.jacrev/jax.hessian —
+the composable-transform path the reference builds by stacking vjp calls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor_class import Tensor, unwrap, wrap
+
+
+class _LazyMatrix:
+    """Matrix façade over a computed jacobian/hessian block (the reference
+    returns lazily-evaluated Jacobian/Hessian objects; slicing works the
+    same — here the block is materialized by jax on construction)."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def __getitem__(self, idx):
+        return wrap(self._arr[idx])
+
+    @property
+    def shape(self):
+        return list(self._arr.shape)
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._arr)
+
+    def __repr__(self):
+        return f"Jacobian(shape={self.shape})"
+
+
+def _call_flat(func, xs):
+    def fn(*arrs):
+        ten = [wrap(a, stop_gradient=False) for a in arrs]
+        out = func(*ten) if len(ten) > 1 else func(ten[0])
+        return unwrap(out if not isinstance(out, (list, tuple)) else out[0])
+
+    return fn
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """paddle.autograd.jacobian: d(ys)/d(xs).
+
+    Two call forms, both supported:
+    - jacobian(func, xs): func evaluated at xs (tensor or list);
+    - jacobian(ys, xs) with ys already computed on the tape: falls back to
+      re-deriving via paddle.grad rows.
+    """
+    if callable(ys):
+        func = ys
+        inputs = xs if isinstance(xs, (list, tuple)) else [xs]
+        arrs = [unwrap(x) for x in inputs]
+        jac = jax.jacrev(_call_flat(func, inputs),
+                         argnums=tuple(range(len(arrs))))(*arrs)
+        if len(arrs) == 1:
+            return _LazyMatrix(jac[0])
+        return [_LazyMatrix(j) for j in jac]
+    # tape form: build rows with paddle.grad (one vjp per output element);
+    # unused inputs yield zero blocks, every requested output contributes
+    from .tape import grad as _grad
+
+    ys_t = ys if isinstance(ys, (list, tuple)) else [ys]
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    per_y = []
+    for y in ys_t:
+        flat_n = int(unwrap(y).size)
+        rows = []
+        for i in range(flat_n):
+            seed = jnp.zeros((flat_n,), unwrap(y).dtype).at[i].set(1.0)
+            gs = _grad([y], xs_t, grad_outputs=[wrap(
+                seed.reshape(unwrap(y).shape))], retain_graph=True,
+                allow_unused=True)
+            rows.append([
+                unwrap(g).reshape(-1) if g is not None
+                else jnp.zeros((int(unwrap(x).size),), unwrap(y).dtype)
+                for g, x in zip(gs, xs_t)])
+        mats = []
+        for k, x in enumerate(xs_t):
+            mat = jnp.stack([r[k] for r in rows])
+            mats.append(_LazyMatrix(mat.reshape(
+                tuple(unwrap(y).shape) + tuple(unwrap(x).shape))))
+        per_y.append(mats[0] if not isinstance(xs, (list, tuple)) else mats)
+    if not isinstance(ys, (list, tuple)):
+        return per_y[0]
+    return per_y
+
+
+def hessian(func, xs, batch_axis=None):
+    """paddle.autograd.hessian: d²(func)/d(xs)² for scalar-output func."""
+    if not callable(func):
+        raise TypeError("hessian expects a callable returning a scalar")
+    inputs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrs = [unwrap(x) for x in inputs]
+    hes = jax.hessian(_call_flat(func, inputs),
+                      argnums=tuple(range(len(arrs))))(*arrs)
+    if len(arrs) == 1:
+        return _LazyMatrix(hes[0][0])
+    return [[_LazyMatrix(b) for b in row] for row in hes]
+
+
+class saved_tensors_hooks:
+    """paddle.autograd.saved_tensors_hooks: transform tensors stashed for
+    backward (pack on save, unpack on use) — the activation-offload /
+    compression hook. Plugged into the tape's residual save/load path."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from . import tape
+
+        tape.set_saved_tensors_hooks(self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from . import tape
+
+        tape.set_saved_tensors_hooks(None, None)
+        return False

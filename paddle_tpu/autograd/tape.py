@@ -82,7 +82,8 @@ class GradNode:
     (non-tensor and non-differentiable args are closed over).
     """
 
-    __slots__ = ("fn", "in_arrays", "in_tensors", "out_refs", "name", "__weakref__")
+    __slots__ = ("fn", "in_arrays", "in_tensors", "out_refs", "name",
+                 "unpack_hook", "__weakref__")
 
     def __init__(self, fn, in_arrays, in_tensors, outputs, name=""):
         self.fn = fn
@@ -90,11 +91,45 @@ class GradNode:
         self.in_tensors = tuple(in_tensors)  # strong refs: grads accumulate here
         self.out_refs = tuple(weakref.ref(o) for o in outputs)
         self.name = name
+        self.unpack_hook = None  # saved_tensors_hooks: set iff pack ran
+
+
+_SAVED_PACK = None
+_SAVED_UNPACK = None
+_IN_PACK = False
+
+
+def set_saved_tensors_hooks(pack_hook, unpack_hook):
+    """autograd.saved_tensors_hooks plumbing: pack transforms each saved
+    input array at record time; the matching unpack callable is CAPTURED ON
+    THE NODE, so backward after the context exits (the standard offload
+    pattern) still restores packed residuals, and nodes recorded outside
+    the context are never spuriously unpacked."""
+    global _SAVED_PACK, _SAVED_UNPACK
+    _SAVED_PACK = pack_hook
+    _SAVED_UNPACK = unpack_hook
 
 
 def record(fn: Callable, in_arrays: Sequence[Any], in_tensors: Sequence[Any], outputs: Sequence[Any], name: str = ""):
     """Append a node to the active tape and link outputs to it."""
+    global _IN_PACK
+
+    unpack = None
+    if _SAVED_PACK is not None and not _IN_PACK:
+        from ..tensor_class import unwrap as _unw, wrap as _wrp
+
+        # re-entrancy guard: a pack hook that dispatches registry ops
+        # (e.g. t.cast) records nodes of its own — those must not re-pack
+        _IN_PACK = True
+        try:
+            in_arrays = [
+                _unw(_SAVED_PACK(_wrp(a))) if isinstance(a, jax.Array) else a
+                for a in in_arrays]
+        finally:
+            _IN_PACK = False
+        unpack = _SAVED_UNPACK
     node = GradNode(fn, in_arrays, in_tensors, outputs, name)
+    node.unpack_hook = unpack
     _st().tape.append(node)
     for o in outputs:
         o._grad_node = node
@@ -180,7 +215,19 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False, grads_out=N
             # fill missing output cotangents with zeros (float0 for int
             # outputs) and match the primal dtype — under AMP a node's
             # consumer may run in a different precision than the node itself
-            primals_out, vjp_fn = jax.vjp(node.fn, *node.in_arrays)
+            saved = node.in_arrays
+            if node.unpack_hook is not None:
+                from ..tensor_class import unwrap as _unw, wrap as _wrp
+
+                global _IN_PACK
+                _IN_PACK = True  # unpack hooks may dispatch ops too
+                try:
+                    saved = tuple(
+                        _unw(node.unpack_hook(_wrp(a)))
+                        if isinstance(a, jax.Array) else a for a in saved)
+                finally:
+                    _IN_PACK = False
+            primals_out, vjp_fn = jax.vjp(node.fn, *saved)
             if isinstance(primals_out, (tuple, list)):
                 filled = tuple(
                     _match_cotangent(g, p) for g, p in zip(gs, primals_out)

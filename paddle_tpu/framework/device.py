@@ -133,3 +133,146 @@ def max_memory_reserved(device=None) -> int:
 def empty_cache():
     """paddle.device.cuda.empty_cache parity: no-op on TPU (XLA owns HBM;
     nothing user-facing to release)."""
+
+
+# ---------------------------------------------------------------------------
+# Round-3 device-surface tail (python/paddle/device/__init__.py parity)
+# ---------------------------------------------------------------------------
+
+XPUPlace = TPUPlace    # accelerator aliases: one device class serves all
+IPUPlace = CPUPlace
+
+
+def get_cudnn_version():
+    """None — not a CUDA build (reference returns None without cudnn)."""
+    return None
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    """The XLA compiler plays CINN's role and is always present."""
+    return True
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def is_compiled_with_custom_device(device_type: str = None) -> bool:
+    """TPU rides jax's pluggable-backend mechanism — the custom-device
+    analog — so 'tpu' reports True."""
+    return device_type in (None, "tpu")
+
+
+def get_all_device_type():
+    import jax
+
+    try:
+        return sorted({d.platform for d in jax.devices()})
+    except RuntimeError:
+        return ["cpu"]
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type() if t not in ("cpu", "gpu")]
+
+
+def get_available_device():
+    import jax
+
+    try:
+        return [f"{d.platform}:{d.id}" for d in jax.devices()]
+    except RuntimeError:
+        return ["cpu:0"]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if not d.startswith(("cpu", "gpu"))]
+
+
+class Stream:
+    """paddle.device.Stream parity. XLA runs one ordered stream per device
+    (async dispatch); separate user streams do not exist, so every Stream
+    maps to the device's implicit stream and synchronize() drains it."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        synchronize()
+
+    def wait_stream(self, stream):
+        synchronize()
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+
+class Event:
+    """paddle.device.Event parity over the single-stream model: record
+    snapshots a sync point; query/elapsed ride block_until_ready."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._time = None
+
+    def record(self, stream=None):
+        import time as _time
+
+        synchronize()
+        self._time = _time.perf_counter()
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end_event) -> float:
+        if self._time is None or end_event._time is None:
+            return 0.0
+        return (end_event._time - self._time) * 1000.0
+
+
+_CURRENT_STREAM = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _CURRENT_STREAM
+
+
+def set_stream(stream: Stream):
+    global _CURRENT_STREAM
+    prev = _CURRENT_STREAM
+    _CURRENT_STREAM = stream
+    return prev
+
+
+class stream_guard:
+    """Context manager parity; the guarded region still executes on the
+    device's single ordered stream."""
+
+    def __init__(self, stream):
+        self._stream = stream
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False

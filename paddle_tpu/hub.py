@@ -1,0 +1,62 @@
+"""paddle.hub parity (python/paddle/hub.py): load entrypoints from a
+hubconf.py. This environment has no network egress, so only the 'local'
+source works; 'github'/'gitee' raise with instructions (same failure mode
+as the reference without connectivity).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise RuntimeError(f"no {_HUBCONF} found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.pop(0)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network egress, which this "
+            "environment does not have; clone the repo and use "
+            "source='local'")
+    return _load_hubconf(repo_dir)
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf."""
+    mod = _resolve(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github",  # noqa: A001
+         force_reload: bool = False):
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise RuntimeError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise RuntimeError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn(**kwargs)

@@ -1,0 +1,118 @@
+"""paddle.nn.quant parity (python/paddle/nn/quant/): weight-only
+quantization ops + the quantized linear path used by LLM serving.
+
+TPU-native: int8 weight-only quantize/dequantize are plain jnp (absmax
+per-channel); weight_only_linear dequantizes into the matmul so XLA fuses
+the scale into the MXU epilogue.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import apply
+from ...tensor_class import unwrap, wrap
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """ops.yaml `weight_quantize`: per-output-channel absmax int8.
+    Returns (quantized int8 weight [in, out], scales [out])."""
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise NotImplementedError(f"weight_quantize: algo {algo!r} "
+                                  "(int8 weight-only on TPU)")
+
+    def fn(w):
+        absmax = jnp.max(jnp.abs(w), axis=0)
+        scale = jnp.maximum(absmax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        return q, scale.astype(jnp.float32)
+
+    return apply("weight_quantize", fn, x, differentiable=False)
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
+    from ...framework.dtype import convert_dtype
+
+    dt = convert_dtype(out_dtype)
+
+    def fn(q, s):
+        return (q.astype(jnp.float32) * s).astype(dt)
+
+    return apply("weight_dequantize", fn, x, scale, differentiable=False)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """ops.yaml `weight_only_linear`: y = x @ dequant(W) + b, scale fused
+    by XLA into the matmul epilogue."""
+    def fn(a, q, *rest):
+        i = 0
+        b = None
+        s = None
+        if bias is not None:
+            b = rest[i]
+            i += 1
+        if weight_scale is not None:
+            s = rest[i]
+        w = q.astype(a.dtype)
+        if s is not None:
+            w = w * s.astype(a.dtype)
+        out = a @ w
+        if b is not None:
+            out = out + b
+        return out
+
+    args = [x, weight]
+    if bias is not None:
+        args.append(bias)
+    if weight_scale is not None:
+        args.append(weight_scale)
+    return apply("weight_only_linear", fn, *args)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """ops.yaml `llm_int8_linear`: LLM.int8() mixed decomposition —
+    columns of x with outliers (|x| > threshold) run in the activation
+    dtype against the dequantized weight, the rest in int8."""
+    def fn(a, q, *rest):
+        i = 0
+        b = None
+        s = None
+        if bias is not None:
+            b = rest[i]
+            i += 1
+        if weight_scale is not None:
+            s = rest[i]
+        # mixed decomposition (LLM.int8): regular columns run as a true
+        # int8×int8→int32 matmul with per-row activation scales; outlier
+        # feature columns (|x| > threshold anywhere) run in the activation
+        # dtype against the dequantized weight
+        outlier = (jnp.abs(a) > threshold).any(
+            tuple(range(a.ndim - 1)))         # [in]
+        a_reg = jnp.where(outlier, 0.0, a)
+        a_absmax = jnp.max(jnp.abs(a_reg), axis=-1, keepdims=True)
+        a_scale = jnp.maximum(a_absmax, 1e-8) / 127.0
+        a_q = jnp.clip(jnp.round(a_reg / a_scale), -127, 127).astype(jnp.int8)
+        int_out = jax.lax.dot_general(
+            a_q, q, (((a_q.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32)
+        reg_out = int_out * a_scale
+        if s is not None:
+            reg_out = reg_out * s
+        w_fp = q.astype(jnp.float32) * (s if s is not None else 1.0)
+        a_out = jnp.where(outlier, a, 0.0)
+        out = (reg_out + a_out.astype(jnp.float32) @ w_fp).astype(a.dtype)
+        if b is not None:
+            out = out + b
+        return out
+
+    args = [x, weight]
+    if bias is not None:
+        args.append(bias)
+    if weight_scale is not None:
+        args.append(weight_scale)
+    return apply("llm_int8_linear", fn, *args)

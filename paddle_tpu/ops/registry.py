@@ -52,6 +52,16 @@ def _is_tensor_leaf(x):
     return isinstance(x, Tensor)
 
 
+_STATS_HOOK = None
+
+
+def set_stats_hook(hook):
+    """amp.debugging operator-stats tap: hook(op_name, input_dtypes) is
+    called on every eager dispatch while set (None disables)."""
+    global _STATS_HOOK
+    _STATS_HOOK = hook
+
+
 def apply(name: str, fn: Callable, *args, differentiable: bool = True, n_outputs=None, **kwargs):
     """Run ``fn`` (a pure jax function) on the given args eagerly.
 
@@ -76,6 +86,10 @@ def apply(name: str, fn: Callable, *args, differentiable: bool = True, n_outputs
             else a
             for i, a in enumerate(arrays)
         ]
+
+    if _STATS_HOOK is not None:
+        # after the AMP cast: stats must report the EXECUTION dtype
+        _STATS_HOOK(name, {str(arrays[i].dtype) for i in tensor_idx})
 
     requires_grad = (
         differentiable

@@ -1,0 +1,36 @@
+"""paddle.regularizer parity (python/paddle/regularizer.py): L1Decay /
+L2Decay weight regularizers. Optimizers consume them through the
+``weight_decay`` argument; the functional path applies them inside
+``Optimizer.apply_gradients``.
+"""
+from __future__ import annotations
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def grad_term(self, param):
+        """The d(penalty)/d(param) term added to the gradient."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 penalty coeff*|w| → subgradient coeff*sign(w)."""
+
+    def grad_term(self, param):
+        import jax.numpy as jnp
+
+        return self.coeff * jnp.sign(param)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 penalty 0.5*coeff*w^2 → gradient coeff*w."""
+
+    def grad_term(self, param):
+        return self.coeff * param

@@ -1,0 +1,16 @@
+"""paddle.sysconfig parity: include/lib dirs of the installed package."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Headers directory (native core sources live under core/csrc)."""
+    return os.path.join(_ROOT, "core", "csrc")
+
+
+def get_lib() -> str:
+    """Directory holding the built native libraries (ctypes .so cache)."""
+    return os.path.join(_ROOT, "core", "_build")

@@ -1,0 +1,36 @@
+"""paddle.version parity (generated python/paddle/version/__init__.py)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "paddle-tpu-native"
+istaged = False
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    """CUDA version — False (not a CUDA build; the accelerator is TPU)."""
+    return False
+
+
+def cudnn():
+    return False
+
+
+def xpu():
+    return False
+
+
+def nccl():
+    return 0
+
+
+def tpu():
+    """Non-reference extra: the accelerator this build targets."""
+    return True

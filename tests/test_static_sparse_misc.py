@@ -659,3 +659,133 @@ def test_static_compat_tail():
     np.testing.assert_allclose(out.numpy(), 2.0)
     with pytest.raises(RuntimeError):
         st.IpuStrategy()
+
+
+def test_round3_misc_modules():
+    """hub/regularizer/callbacks/sysconfig/version/device-streams/
+    autograd functional/nn.quant/amp.debugging."""
+    import pathlib
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.autograd as AG
+
+    # jacobian / hessian numerics
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    J = AG.jacobian(lambda t: t ** 2, x)
+    np.testing.assert_allclose(np.diag(J.numpy()), [2, 4, 6], rtol=1e-5)
+    H = AG.hessian(lambda t: (t ** 2).sum(), x)
+    np.testing.assert_allclose(H.numpy(), 2 * np.eye(3), atol=1e-5)
+
+    # saved_tensors_hooks fire on pack and unpack
+    events = []
+    with AG.saved_tensors_hooks(
+            lambda t: (events.append("pack"), t)[1],
+            lambda t: (events.append("unpack"), t)[1]):
+        a = paddle.to_tensor(np.array([2.0], "float32"),
+                             stop_gradient=False)
+        loss = (a * a).sum()
+        loss.backward()
+    assert "pack" in events and "unpack" in events
+    np.testing.assert_allclose(a.grad.numpy(), [4.0])
+
+    # nn.quant weight-only roundtrip + fused linear
+    import paddle_tpu.nn.quant as Q
+
+    paddle.seed(0)
+    w = paddle.randn([8, 4])
+    qw, scale = Q.weight_quantize(w)
+    assert qw.dtype == paddle.int8
+    deq = Q.weight_dequantize(qw, scale, out_dtype="float32")
+    assert float(np.abs(deq.numpy() - w.numpy()).max()) < 0.05
+    xq = paddle.randn([2, 8])
+    np.testing.assert_allclose(
+        Q.weight_only_linear(xq, qw, weight_scale=scale).numpy(),
+        xq.numpy() @ w.numpy(), atol=0.1)
+
+    # amp.debugging op stats count eager dispatches
+    import paddle_tpu.amp.debugging as dbg
+
+    dbg.enable_operator_stats_collection()
+    _ = paddle.ones([2]) + paddle.ones([2])
+    snap = dbg.operator_stats_snapshot()
+    dbg.disable_operator_stats_collection()
+    assert "add" in snap and "float32" in snap["add"]
+
+    # regularizer / callbacks / sysconfig / version / hub
+    assert paddle.regularizer.L2Decay(0.1).coeff == 0.1
+    assert paddle.callbacks.EarlyStopping is not None
+    assert paddle.sysconfig.get_include().endswith("csrc")
+    assert paddle.version.full_version == paddle.__version__
+    assert paddle.version.cuda() is False
+    d = pathlib.Path(tempfile.mkdtemp())
+    (d / "hubconf.py").write_text(
+        "def tiny(n=3):\n    'Tiny.'\n    import paddle_tpu as P\n"
+        "    return P.ones([n])\n")
+    assert paddle.hub.list(str(d), source="local") == ["tiny"]
+    assert paddle.hub.load(str(d), "tiny", source="local", n=2).shape == [2]
+    assert "Tiny" in paddle.hub.help(str(d), "tiny", source="local")
+    with pytest.raises(RuntimeError):
+        paddle.hub.load("owner/repo", "m")  # github needs egress
+
+    # device streams/events over the single-XLA-stream model
+    s = paddle.device.Stream()
+    e1 = s.record_event()
+    _ = paddle.randn([32, 32]) @ paddle.randn([32, 32])
+    e2 = paddle.device.Event()
+    e2.record()
+    assert e1.elapsed_time(e2) >= 0 and e2.query()
+    with paddle.device.stream_guard(paddle.device.Stream()):
+        assert paddle.device.current_stream() is not None
+
+
+def test_saved_hooks_and_llm_int8_reviewfixes():
+    """Review regressions: (a) per-node unpack capture — backward after the
+    hooks context still restores packed residuals; (b) hooks that dispatch
+    registry ops (cast) don't recurse; (c) llm_int8_linear runs a real
+    int8 regular path and keeps outlier columns accurate vs the
+    dequantized weight; (d) AMP op stats report the execution dtype."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.autograd as AG
+    import paddle_tpu.nn.quant as Q
+
+    events = []
+    with AG.saved_tensors_hooks(
+            lambda t: (events.append("pack"), t)[1],
+            lambda t: (events.append("unpack"), t)[1]):
+        a = paddle.to_tensor(np.array([2.0], "float32"),
+                             stop_gradient=False)
+        loss = (a * a).sum()
+    loss.backward()  # outside the context: node-captured unpack fires
+    assert "unpack" in events
+    np.testing.assert_allclose(a.grad.numpy(), [4.0])
+
+    b = paddle.to_tensor(np.array([3.0], "float32"), stop_gradient=False)
+    with AG.saved_tensors_hooks(lambda t: t.cast("bfloat16"),
+                                lambda t: t.cast("float32")):
+        (b * b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [6.0], rtol=1e-2)
+
+    paddle.seed(0)
+    w = paddle.randn([8, 4])
+    qw, scale = Q.weight_quantize(w)
+    deq = Q.weight_dequantize(qw, scale, out_dtype="float32").numpy()
+    xo = np.array(paddle.randn([2, 8]).numpy())
+    xo[0, 0] = 50.0
+    out = Q.llm_int8_linear(paddle.to_tensor(xo), qw, weight_scale=scale,
+                            threshold=6.0)
+    assert float(np.abs(out.numpy() - xo @ deq).max()) < 0.05
+
+    import paddle_tpu.amp as amp
+    import paddle_tpu.amp.debugging as dbg
+
+    dbg.enable_operator_stats_collection()
+    with amp.auto_cast():
+        _ = paddle.randn([4, 4]) @ paddle.randn([4, 4])
+    snap = dbg.operator_stats_snapshot()
+    dbg.disable_operator_stats_collection()
+    assert "bfloat16" in snap.get("matmul", {})
