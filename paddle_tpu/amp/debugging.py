@@ -99,17 +99,20 @@ def enable_tensor_checker(checker_config: TensorCheckerConfig) -> None:
     global _CHECKER_PREV
     from ..utils import flags
 
-    _CHECKER_PREV = flags.get_flags("FLAGS_check_nan_inf")
+    if _CHECKER_PREV is None:  # idempotent: keep the ORIGINAL state
+        _CHECKER_PREV = flags.get_flags("FLAGS_check_nan_inf")
     flags.set_flags({"FLAGS_check_nan_inf": bool(checker_config.enable)})
 
 
 def disable_tensor_checker() -> None:
+    global _CHECKER_PREV
     from ..utils import flags
 
     prev = _CHECKER_PREV if _CHECKER_PREV is not None else {}
     flags.set_flags({"FLAGS_check_nan_inf":
                      prev.get("FLAGS_check_nan_inf", False)
                      if isinstance(prev, dict) else False})
+    _CHECKER_PREV = None
 
 
 def check_numerics(tensor, op_type="", var_name="", debug_mode=None):

@@ -1,0 +1,211 @@
+"""paddle.distributed.rpc parity (python/paddle/distributed/rpc/rpc.py:
+init_rpc / rpc_sync / rpc_async / shutdown / worker infos).
+
+TPU-native design: the reference builds RPC on brpc+protobuf
+(paddle/fluid/distributed/rpc/). Here each worker runs one daemon thread
+serving pickled (fn, args, kwargs) calls over a TCP socket, and the native
+TCPStore (core/csrc/tcp_store.cpp) is the rendezvous that maps worker
+names to endpoints — the same store the collective path uses. Futures are
+concurrent.futures handles (the FutureWrapper.wait() analog).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_GLOBAL = {}
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(conn, payload: bytes):
+    conn.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(conn) -> bytes:
+    (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+    return _recv_exact(conn, n)
+
+
+def _serve(server_sock):
+    while True:
+        try:
+            conn, _ = server_sock.accept()
+        except OSError:
+            return  # socket closed by shutdown()
+        threading.Thread(target=_handle, args=(conn,), daemon=True).start()
+
+
+def _handle(conn):
+    try:
+        req = pickle.loads(_recv_msg(conn))
+        fn, args, kwargs = req["fn"], req["args"], req["kwargs"]
+        try:
+            out = fn(*args, **(kwargs or {}))
+            payload = {"ok": True, "value": out}
+        except Exception as e:  # noqa: BLE001 - forwarded to the caller
+            payload = {"ok": False, "error": e}
+        try:
+            blob = pickle.dumps(payload)
+        except Exception as pe:  # unpicklable result/exception: still reply
+            blob = pickle.dumps({"ok": False, "error": RuntimeError(
+                f"rpc: result/exception not picklable: {pe!r}; original "
+                f"payload ok={payload['ok']}, "
+                f"{type(payload.get('value', payload.get('error'))).__name__}")})
+        _send_msg(conn, blob)
+    except Exception:
+        pass
+    finally:
+        conn.close()
+
+
+def _advertise_ip(master_host: str, master_port: int) -> str:
+    """The IP peers should dial: the outbound interface toward the master
+    (a UDP connect never sends a packet but selects the route) — avoids
+    both unresolvable hostnames and Debian's 127.0.1.1 hosts entry."""
+    if master_host in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect((master_host, master_port or 1))
+            return probe.getsockname()[0]
+        finally:
+            probe.close()
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+
+def init_rpc(name: str, rank: int = None, world_size: int = None,
+             master_endpoint: str = None):
+    """Start this worker's RPC server and register its endpoint with every
+    peer through the TCPStore at ``master_endpoint``."""
+    from .store import TCPStore
+
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = world_size if world_size is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:29531")
+    host, port_s = master_endpoint.rsplit(":", 1)
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", 0))
+    srv.listen(64)
+    my_port = srv.getsockname()[1]
+    my_ip = _advertise_ip(host, int(port_s))
+
+    store = TCPStore(host, int(port_s), is_master=(rank == 0),
+                     world_size=world_size)
+    store.set(f"rpc/worker/{rank}",
+              pickle.dumps({"name": name, "rank": rank, "ip": my_ip,
+                            "port": my_port}))
+    infos = {}
+    for r in range(world_size):
+        d = pickle.loads(bytes(store.get(f"rpc/worker/{r}", timeout=60)))
+        infos[d["name"]] = WorkerInfo(**d)
+    thread = threading.Thread(target=_serve, args=(srv,), daemon=True)
+    thread.start()
+    _GLOBAL.update(me=name, infos=infos, server=srv, thread=thread,
+                   store=store)
+    # every server must be listening before any rpc fires
+    store.barrier("rpc_init", timeout=60)
+    return infos[name]
+
+
+def _call(to: str, payload: dict, timeout=None):
+    info = _GLOBAL["infos"][to]
+    conn = socket.create_connection((info.ip, info.port), timeout=timeout)
+    try:
+        _send_msg(conn, pickle.dumps(payload))
+        resp = pickle.loads(_recv_msg(conn))
+    finally:
+        conn.close()
+    if not resp["ok"]:
+        raise resp["error"]
+    return resp["value"]
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=None):
+    """Run fn(*args, **kwargs) on worker ``to`` and return its result."""
+    return _call(to, {"fn": fn, "args": tuple(args or ()),
+                      "kwargs": kwargs}, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=None) -> Future:
+    """Like rpc_sync but returns a Future (wait() gives the value)."""
+    fut = Future()
+
+    def runner():
+        try:
+            fut.set_result(rpc_sync(to, fn, args, kwargs, timeout))
+        except Exception as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=runner, daemon=True).start()
+    fut.wait = fut.result  # paddle FutureWrapper API
+    return fut
+
+
+def shutdown():
+    """Drain and stop this worker's RPC server (graceful barrier first,
+    matching the reference's sync shutdown)."""
+    if not _GLOBAL:
+        return
+    store = _GLOBAL.get("store")
+    if store is not None:
+        try:
+            # graceful: nobody tears down while a peer may still call in
+            store.barrier("rpc_shutdown", timeout=60)
+        except Exception:
+            pass
+    srv = _GLOBAL.pop("server", None)
+    if srv is not None:
+        try:
+            srv.close()
+        except OSError:
+            pass
+    _GLOBAL.clear()
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _GLOBAL["infos"][name]
+
+
+def get_all_worker_infos():
+    return sorted(_GLOBAL["infos"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return _GLOBAL["infos"][_GLOBAL["me"]]

@@ -1134,3 +1134,59 @@ def validate_retrofits():
         fn = OPS[r.name].fn
         if isinstance(fn, _LazyFn):
             fn.resolve()
+
+
+def infer_meta(name: str, *arg_specs, **attrs):
+    """InferMeta parity (paddle/phi/infermeta/*.cc): compute output
+    shapes/dtypes WITHOUT running the kernel. TPU-native: jax.eval_shape
+    abstractly evaluates the registered pure implementation — one
+    mechanism covers every op instead of a hand-written meta function per
+    op. ``arg_specs`` are (shape, dtype) tuples, ShapeDtypeStructs, or
+    concrete tensors/arrays (used for their aval only)."""
+    from ..framework.dtype import convert_dtype
+    from ..tensor_class import Tensor
+
+    if name not in OPS:
+        raise KeyError(f"infer_meta: unknown op {name!r}")
+    fn = OPS[name].fn
+    impl = getattr(fn, "raw", None) or getattr(fn, "resolve", lambda: fn)()
+    if hasattr(impl, "raw"):
+        impl = impl.raw
+
+    def to_spec(a):
+        if isinstance(a, jax.ShapeDtypeStruct):
+            return a
+        if isinstance(a, Tensor):
+            return jax.ShapeDtypeStruct(tuple(a.shape),
+                                        jnp.asarray(a._array).dtype)
+        if isinstance(a, tuple) and len(a) == 2 and isinstance(a[0],
+                                                              (tuple, list)):
+            return jax.ShapeDtypeStruct(tuple(a[0]), convert_dtype(a[1]))
+        return a  # static attr passed positionally
+
+    converted = [to_spec(a) for a in arg_specs]
+    # only array-like specs are abstract; other positionals (axis counts,
+    # scalars-as-attrs) stay static so impls can branch on them
+    spec_pos = [i for i, a in enumerate(converted)
+                if isinstance(a, jax.ShapeDtypeStruct)]
+    specs = [converted[i] for i in spec_pos]
+
+    def call(*abstract):
+        full = list(converted)
+        for p, a in zip(spec_pos, abstract):
+            full[p] = a
+        return impl(*full, **attrs)
+
+    out = jax.eval_shape(call, *specs)
+
+    def normalize(o):
+        # retrofit public fns wrap outputs in Tensor; unwrap to the aval so
+        # every op returns plain ShapeDtypeStructs
+        if isinstance(o, Tensor):
+            inner = o._array
+            return (inner if isinstance(inner, jax.ShapeDtypeStruct)
+                    else jax.ShapeDtypeStruct(tuple(o.shape), inner.dtype))
+        return o
+
+    return jax.tree_util.tree_map(
+        normalize, out, is_leaf=lambda x: isinstance(x, Tensor))

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-3 bench catcher: probe the TPU tunnel every ~10 min; on the first
+# success run all three bench configs (1b / 8b / decode) so BENCH_STATE.json
+# holds a full measured table. Stops after capturing 8b+decode or ~6h.
+cd /root/repo
+deadline=$(( $(date +%s) + 21600 ))
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if _BENCH_CHILD=1 timeout 110 python bench.py --probe 2>/dev/null | grep -q '"platform": "tpu"'; then
+    echo "$(date -Is) tunnel UP — running benches" >> /tmp/bench_retry.log
+    timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
+    BENCH_CONFIG=8b timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
+    BENCH_CONFIG=decode timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
+    if python - <<'EOF'
+import json, sys
+state = json.load(open("BENCH_STATE.json"))
+cfgs = state.get("configs", {})
+ok = all(cfgs.get(c, {}).get("platform") == "tpu" for c in ("8b", "decode"))
+sys.exit(0 if ok else 1)
+EOF
+    then
+      echo "$(date -Is) all configs captured — done" >> /tmp/bench_retry.log
+      exit 0
+    fi
+  else
+    echo "$(date -Is) tunnel down" >> /tmp/bench_retry.log
+  fi
+  sleep 600
+done
+echo "$(date -Is) deadline reached" >> /tmp/bench_retry.log

@@ -1453,3 +1453,23 @@ def test_registry_swept():
     assert not missing, (
         f"{len(missing)} registered ops lack an OpSpec, whitelist entry, or "
         f"schema tested_by: {missing}")
+
+
+def test_infer_meta_abstract_shapes():
+    """InferMeta parity: output shapes/dtypes without execution
+    (jax.eval_shape over the registered impls — schema.infer_meta)."""
+    from paddle_tpu.ops import schema
+
+    out = schema.infer_meta("cdist", ((4, 3), "float32"),
+                            ((5, 3), "float32"))
+    assert out.shape == (4, 5) and str(out.dtype) == "float32"
+    outs = schema.infer_meta("frexp", ((3, 4), "float32"))
+    assert outs[0].shape == (3, 4) and "int" in str(outs[1].dtype)
+    # static positional attrs stay concrete (impls branch on them)
+    assert schema.infer_meta("renorm", ((2, 6), "float32"),
+                             2.0, 0, 1.0).shape == (2, 6)
+    # lazy retrofit ops resolve through the same path
+    assert str(schema.infer_meta("gelu", ((8, 16), "bfloat16")).dtype) \
+        == "bfloat16"
+    with pytest.raises(KeyError):
+        schema.infer_meta("not_an_op", ((1,), "float32"))
