@@ -276,3 +276,77 @@ class stream_guard:
     def __exit__(self, *exc):
         set_stream(self._prev)
         return False
+
+
+class _CudaNamespace:
+    """paddle.device.cuda parity (python/paddle/device/cuda/__init__.py):
+    the CUDA-named device-management surface, served by the TPU runtime
+    (one accelerator namespace, reference-compatible names)."""
+
+    Stream = None           # bound below (classes defined above)
+    Event = None
+
+    @staticmethod
+    def current_stream(device=None):
+        return current_stream(device)
+
+    @staticmethod
+    def synchronize(device=None):
+        return synchronize()
+
+    @staticmethod
+    def device_count():
+        """Accelerator count — 0 on CPU-only hosts (reference semantics:
+        guard code relies on 0 meaning 'no accelerator')."""
+        import jax
+
+        try:
+            return len([d for d in jax.devices() if d.platform != "cpu"])
+        except RuntimeError:
+            return 0
+
+    empty_cache = staticmethod(lambda: empty_cache())
+    stream_guard = staticmethod(lambda stream: stream_guard(stream))
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return memory_allocated(device)
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return max_memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved(device)
+
+    @staticmethod
+    def get_device_properties(device=None):
+        import jax
+
+        d = jax.devices()[0]
+        import types
+
+        return types.SimpleNamespace(
+            name=f"{d.platform}:{d.device_kind}",
+            total_memory=memory_reserved(device),
+            major=0, minor=0, multi_processor_count=1)
+
+    @staticmethod
+    def get_device_name(device=None):
+        import jax
+
+        return jax.devices()[0].device_kind
+
+    @staticmethod
+    def get_device_capability(device=None):
+        return (0, 0)
+
+
+cuda = _CudaNamespace()
+cuda.Stream = Stream
+cuda.Event = Event

@@ -1,0 +1,121 @@
+"""paddle.incubate.asp parity (python/paddle/incubate/asp/): automatic
+2:4 structured sparsity — prune_model computes n:m magnitude masks,
+decorate() wraps an optimizer so masks are re-applied after every step
+(the reference's OptimizerWithSparsityGuarantee).
+
+TPU note: XLA has no sparse-tensor-core path, so the value here is the
+workflow parity (mask computation, guaranteed sparsity through training)
+and model-size reduction at export; the masked matmuls stay dense on the
+MXU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density"]
+
+_EXCLUDED: set = set()
+# id(param) -> (weakref(param), mask): weakrefs let pruned models be
+# garbage-collected; dead entries are swept on access
+_MASKS: dict = {}
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Skip these parameter names in prune_model/decorate."""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros in a tensor/ndarray."""
+    from ..tensor_class import unwrap
+
+    a = np.asarray(unwrap(x) if hasattr(x, "_array") else x)
+    return float((a != 0).sum() / max(a.size, 1))
+
+
+def _nm_mask(w: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-magnitude entries in every group of m along the
+    input dim (mask_1d algorithm — the reference's default)."""
+    orig = w.shape
+    flat = w.reshape(-1)
+    pad = (-flat.size) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    groups = np.abs(flat.reshape(-1, m))
+    keep = np.argsort(-groups, axis=1)[:, :n]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, keep, 1.0, axis=1)
+    mask = mask.reshape(-1)
+    if pad:
+        mask = mask[:-pad]
+    return mask.reshape(orig)
+
+
+def _prunable(model):
+    from .. import nn
+
+    for layer in model.sublayers(include_self=True):
+        w = getattr(layer, "weight", None)
+        if w is None or not hasattr(w, "_array"):
+            continue
+        if len(w.shape) < 2:
+            continue
+        if getattr(w, "name", None) in _EXCLUDED:
+            continue
+        if not isinstance(layer, (nn.Linear, nn.Conv1D, nn.Conv2D,
+                                  nn.Conv3D)):
+            continue
+        yield w
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute and apply n:m sparsity masks to every supported layer's
+    weight. Returns {param_id: mask}."""
+    import jax.numpy as jnp
+
+    out = {}
+    for w in _prunable(model):
+        mask = _nm_mask(np.asarray(w._array), n, m)
+        jmask = jnp.asarray(mask, w._array.dtype)
+        w._array = w._array * jmask
+        if with_mask:
+            import weakref
+
+            _MASKS[id(w)] = (weakref.ref(w), jmask)
+        out[id(w)] = jmask
+    return out
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step so pruned weights stay pruned through training
+    (OptimizerWithSparsityGuarantee parity). Masks are scoped to THIS
+    optimizer's parameter list — pruning a second model never leaks into
+    another decorated optimizer."""
+    own_ids = {id(p) for p in (optimizer._parameter_list or [])}
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def step(self, *a, **k):
+            out = self._inner.step(*a, **k)
+            for pid in list(_MASKS):
+                ref, mask = _MASKS[pid]
+                w = ref()
+                if w is None:
+                    del _MASKS[pid]     # pruned model was freed
+                    continue
+                if own_ids and pid not in own_ids:
+                    continue
+                w._array = w._array * mask
+            return out
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    return _ASPOptimizer(optimizer)

@@ -225,3 +225,45 @@ def fused_moe(x, gate_weight, expert_weights1, expert_biases1,
         "use paddle_tpu.distributed.moe.MoELayer(GroupedMLP) — the TPU "
         "grouped-GEMM MoE with EP sharding; a stateless functional wrapper "
         "is tracked for a later round")
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """incubate.nn.memory_efficient_attention (xformers-style API;
+    reference incubate/nn/memory_efficient_attention/). On TPU the
+    memory-efficient algorithm IS flash attention — the Pallas splash
+    kernel streams KV blocks so the S×S score matrix never materializes;
+    the XLA fallback is an SDPA composite. Layout [B, S, H, D]."""
+    from ...nn.functional.attention import flash_attention
+
+    if attn_bias is None:
+        q = query
+        if scale is not None:
+            # flash applies 1/sqrt(d) internally; fold a custom scale in
+            d = unwrap(query).shape[-1]
+            q = query * (scale * (d ** 0.5))
+        out, _ = flash_attention(q, key, value, dropout=p, causal=False,
+                                 training=training)
+        return out
+
+    # biased attention can't ride the bias-free splash kernel: run the
+    # SDPA composite with the additive bias (and the same dropout policy)
+    from ...framework import random as _random
+
+    drop_key = _random.next_key() if (p > 0.0 and training) else None
+
+    def fn(q, k, v, b):
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / (d ** 0.5)
+        qh = jnp.moveaxis(q, 2, 1)
+        kh = jnp.moveaxis(k, 2, 1)
+        vh = jnp.moveaxis(v, 2, 1)
+        scores = (qh @ jnp.swapaxes(kh, -1, -2)) * s + b
+        probs = jax.nn.softmax(scores, -1)
+        if drop_key is not None:
+            keep = jax.random.bernoulli(drop_key, 1.0 - p, probs.shape)
+            probs = probs * keep / (1.0 - p)
+        return jnp.moveaxis(probs @ vh, 1, 2)
+
+    return apply("memory_efficient_attention", fn, query, key, value,
+                 attn_bias)

@@ -789,3 +789,39 @@ def test_saved_hooks_and_llm_int8_reviewfixes():
     snap = dbg.operator_stats_snapshot()
     dbg.disable_operator_stats_collection()
     assert "bfloat16" in snap.get("matmul", {})
+
+
+def test_asp_and_memory_efficient_attention():
+    """incubate.asp 2:4 sparsity workflow + memory_efficient_attention."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate as inc
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    inc.asp.prune_model(net)
+    assert abs(inc.asp.calculate_density(net.weight) - 0.5) < 1e-6
+    # every group of 4 has exactly 2 nonzeros
+    w = net.weight.numpy().reshape(-1, 4)
+    np.testing.assert_array_equal((w != 0).sum(1), 2)
+    o = inc.asp.decorate(opt.SGD(0.1, parameters=net.parameters()))
+    for _ in range(3):
+        loss = (net(paddle.randn([4, 8])) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    assert abs(inc.asp.calculate_density(net.weight) - 0.5) < 1e-6
+
+    q = paddle.randn([1, 6, 2, 8])
+    out = inc.nn.functional.memory_efficient_attention(q, q, q)
+    out_b = inc.nn.functional.memory_efficient_attention(
+        q, q, q, attn_bias=paddle.zeros([1, 2, 6, 6]))
+    np.testing.assert_allclose(out.numpy(), out_b.numpy(), atol=1e-5)
+
+    from paddle_tpu.optimizer import Lamb
+
+    assert isinstance(inc.DistributedFusedLamb(
+        parameters=nn.Linear(4, 4).parameters()), Lamb)
