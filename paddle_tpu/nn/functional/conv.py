@@ -290,7 +290,8 @@ def _adaptive_pool(x, output_size, n, data_format, average):
                 out = jnp.concatenate(pieces, axis=d)
         return out
 
-    return apply("adaptive_pool", fn, x)
+    return apply("adaptive_avg_pool" if average else "adaptive_max_pool",
+                 fn, x)
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):

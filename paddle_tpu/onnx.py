@@ -1,17 +1,454 @@
-"""paddle.onnx parity surface.
+"""paddle.onnx.export (python/paddle/onnx/export.py parity).
 
-The reference delegates paddle.onnx.export to the external paddle2onnx
-package (python/paddle/onnx/export.py); this build has no egress to fetch
-it, and the TPU-native deployment artifact is StableHLO
-(static.save_inference_model / jit.save). export() raises with that
-guidance rather than silently writing a wrong format.
+The reference shells out to the external paddle2onnx toolchain; this build
+has no egress and no onnx package, so the exporter is implemented directly:
+the static Program recorder (static/program.py) captures the layer's
+dataflow graph of framework-level ops, and this module lowers that graph to
+an ONNX ModelProto written with a minimal hand-rolled protobuf wire-format
+writer (varint + length-delimited fields — all the encoding ONNX needs).
+
+Covered op set (inference graphs): linear/matmul (+bias), elementwise
+add/sub/mul/div, relu/sigmoid/tanh/exp/sqrt/abs/erf, softmax, gelu (Erf
+decomposition), conv2d, adaptive_avg_pool2d(1) → GlobalAveragePool,
+batch_norm (eval), reshape/flatten/transpose, mean → ReduceMean, cast,
+dropout (eval = identity elision). Anything else raises with the op name —
+never a silently wrong file. The TPU-native serving artifact remains
+StableHLO (jit.save / save_inference_model).
 """
 from __future__ import annotations
 
+import struct
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "ONNX export requires the external paddle2onnx toolchain (the "
-        "reference shells out to it too). On the TPU build, export a "
-        "deployable artifact with paddle.static.save_inference_model "
-        "(StableHLO via jax.export) or paddle.jit.save instead.")
+import numpy as np
+
+__all__ = ["export"]
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire-format writer
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = b""
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def _f_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def _f_str(field: int, s: str) -> bytes:
+    return _f_bytes(field, s.encode())
+
+
+def _f_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+# ONNX TensorProto.DataType
+_DTYPE = {"float32": 1, "uint8": 2, "int8": 3, "int16": 5, "int32": 6,
+          "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+          "bfloat16": 16}
+
+
+def _tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    dt = _DTYPE.get(str(arr.dtype))
+    if dt is None:
+        raise NotImplementedError(f"onnx export: dtype {arr.dtype}")
+    if str(arr.dtype) == "bfloat16":
+        raw = np.asarray(arr).view(np.uint16).tobytes()
+    else:
+        raw = np.ascontiguousarray(arr).tobytes()
+    msg = b"".join(_f_varint(1, d) for d in arr.shape)
+    msg += _f_varint(2, dt)
+    msg += _f_str(8, name)
+    msg += _f_bytes(9, raw)          # raw_data
+    return msg
+
+
+# AttributeProto bodies (type codes: FLOAT=1, INT=2, INTS=7)
+def _attr_int(name, v):
+    return _f_str(1, name) + _f_varint(3, v) + _f_varint(20, 2)
+
+
+def _attr_float(name, v):
+    return _f_str(1, name) + _f_float(2, v) + _f_varint(20, 1)
+
+
+def _attr_ints(name, vs):
+    body = _f_str(1, name)
+    for v in vs:
+        body += _f_varint(8, int(v))
+    return body + _f_varint(20, 7)
+
+
+def _attr_field(attr_body: bytes) -> bytes:
+    return _f_bytes(5, attr_body)
+
+
+def _node(op_type, inputs, outputs, attrs=b"", name=""):
+    msg = b"".join(_f_str(1, i) for i in inputs)
+    msg += b"".join(_f_str(2, o) for o in outputs)
+    if name:
+        msg += _f_str(3, name)
+    msg += _f_str(4, op_type)
+    msg += attrs                      # concatenated _attr_field() blocks
+    return msg
+
+
+def _value_info(name: str, shape, dtype: str) -> bytes:
+    dims = b""
+    for k, d in enumerate(shape):
+        if d is None or (isinstance(d, int) and d < 0):
+            dim = _f_str(2, f"dyn_{k}")        # dim_param: symbolic size
+        else:
+            dim = _f_varint(1, int(d))         # dim_value
+        dims += _f_bytes(1, dim)
+    tensor_type = _f_varint(1, _DTYPE.get(dtype, 1)) + _f_bytes(2, dims)
+    type_proto = _f_bytes(1, tensor_type)
+    return _f_str(1, name) + _f_bytes(2, type_proto)
+
+
+# ---------------------------------------------------------------------------
+# graph builder
+# ---------------------------------------------------------------------------
+
+class _GraphBuilder:
+    def __init__(self):
+        self.nodes = []          # serialized NodeProto bodies
+        self.initializers = []   # serialized TensorProto bodies
+        self.names = {}          # tensor id -> onnx name
+        self._n = 0
+
+    def fresh(self, base):
+        self._n += 1
+        return f"{base}_{self._n}"
+
+    def input_name(self, tid, arr):
+        """Name for a node input: existing graph tensor, else a new
+        initializer holding the captured parameter/constant value."""
+        if tid in self.names:
+            return self.names[tid]
+        name = self.fresh("param")
+        self.initializers.append(_tensor_proto(name, np.asarray(arr)))
+        self.names[tid] = name
+        return name
+
+    def emit(self, op_type, in_names, out_ids, attrs=b"", n_out=1):
+        outs = [self.fresh(op_type.lower()) for _ in range(n_out)]
+        self.nodes.append(_node(op_type, in_names, outs, attrs))
+        for tid, name in zip(out_ids, outs):
+            self.names[tid] = name
+        return outs
+
+
+def _pair(v):
+    return [v, v] if isinstance(v, int) else list(v)
+
+
+def _closure_vars(fn):
+    """Attrs of a recorded op closure (freevar name -> cell value)."""
+    if fn.__closure__ is None:
+        return {}
+    return dict(zip(fn.__code__.co_freevars,
+                    [c.cell_contents for c in fn.__closure__]))
+
+
+_ELEMENTWISE = {"add": "Add", "subtract": "Sub", "multiply": "Mul",
+                "divide": "Div"}
+_UNARY = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "exp": "Exp", "sqrt": "Sqrt", "abs": "Abs", "erf": "Erf",
+          "floor": "Floor", "ceil": "Ceil"}
+
+
+def _convert_node(g: _GraphBuilder, node, args, kwargs, in_names, arrs,
+                  shapes):
+    """Lower one recorded framework op to ONNX node(s). ``shapes`` maps
+    tensor id -> shape for every graph tensor (from the Program's
+    keepalive list) — used where an op's attrs are closed over."""
+    op = node.name
+    out_ids = node.out_ids
+
+    if op in _ELEMENTWISE:
+        g.emit(_ELEMENTWISE[op], in_names, out_ids)
+    elif op in _UNARY:
+        g.emit(_UNARY[op], in_names[:1], out_ids)
+    elif op == "linear":
+        mm = g.fresh("matmul")
+        g.nodes.append(_node("MatMul", in_names[:2], [mm]))
+        if len(in_names) > 2:
+            g.emit("Add", [mm, in_names[2]], out_ids)
+        else:
+            g.names[out_ids[0]] = mm
+    elif op == "matmul":
+        cv = _closure_vars(node.fn)
+        tx = cv.get("transpose_x", False)
+        ty = cv.get("transpose_y", False)
+        names = list(in_names)
+        for k, flag in ((0, tx), (1, ty)):
+            if flag:
+                t = g.fresh("transpose")
+                nd = arrs[k].ndim
+                perm = list(range(nd - 2)) + [nd - 1, nd - 2]
+                g.nodes.append(_node("Transpose", [names[k]], [t],
+                                     _attr_field(_attr_ints("perm", perm))))
+                names[k] = t
+        g.emit("MatMul", names[:2], out_ids)
+    elif op == "softmax":
+        axis = _closure_vars(node.fn).get("axis", -1)
+        g.emit("Softmax", in_names[:1], out_ids,
+               _attr_field(_attr_int("axis", int(axis))))
+    elif op == "gelu":
+        x = in_names[0]
+        dt = str(arrs[0].dtype)
+        approx = _closure_vars(node.fn).get("approximate", False)
+
+        def const(val):
+            n = g.fresh("const")
+            g.initializers.append(_tensor_proto(
+                n, np.asarray(val).astype(dt)))
+            return n
+
+        if approx:
+            # tanh form: 0.5 x (1 + tanh(sqrt(2/pi)(x + 0.044715 x^3)))
+            x3 = g.fresh("mul")
+            x2 = g.fresh("mul")
+            g.nodes.append(_node("Mul", [x, x], [x2]))
+            g.nodes.append(_node("Mul", [x2, x], [x3]))
+            cx3 = g.fresh("mul")
+            g.nodes.append(_node("Mul", [x3, const(0.044715)], [cx3]))
+            inner = g.fresh("add")
+            g.nodes.append(_node("Add", [x, cx3], [inner]))
+            scaled = g.fresh("mul")
+            g.nodes.append(_node(
+                "Mul", [inner, const(np.sqrt(2.0 / np.pi))], [scaled]))
+            th = g.fresh("tanh")
+            g.nodes.append(_node("Tanh", [scaled], [th]))
+            plus1 = g.fresh("add")
+            g.nodes.append(_node("Add", [th, const(1.0)], [plus1]))
+        else:
+            # exact form: 0.5 x (1 + erf(x / sqrt(2)))
+            scaled = g.fresh("mul")
+            g.nodes.append(_node(
+                "Mul", [x, const(1.0 / np.sqrt(2.0))], [scaled]))
+            erf = g.fresh("erf")
+            g.nodes.append(_node("Erf", [scaled], [erf]))
+            plus1 = g.fresh("add")
+            g.nodes.append(_node("Add", [erf, const(1.0)], [plus1]))
+        xm = g.fresh("mul")
+        g.nodes.append(_node("Mul", [x, plus1], [xm]))
+        g.emit("Mul", [xm, const(0.5)], out_ids)
+    elif op == "reshape":
+        shape = _closure_vars(node.fn).get("shape")
+        if shape is None:
+            raise NotImplementedError("onnx export: reshape without a "
+                                      "recoverable static shape")
+        sh = g.fresh("shape_const")
+        g.initializers.append(_tensor_proto(
+            sh, np.asarray(list(shape), np.int64)))
+        g.emit("Reshape", [in_names[0], sh], out_ids)
+    elif op == "flatten":
+        # paddle flatten is rank-preserving outside [start, stop]; ONNX
+        # Flatten is always 2-D — lower as Reshape to the traced out shape
+        oshape = shapes.get(out_ids[0])
+        if oshape is None:
+            raise NotImplementedError("onnx export: flatten output shape "
+                                      "unknown")
+        sh = g.fresh("shape_const")
+        g.initializers.append(_tensor_proto(
+            sh, np.asarray(list(oshape), np.int64)))
+        g.emit("Reshape", [in_names[0], sh], out_ids)
+    elif op == "transpose":
+        perm = _closure_vars(node.fn).get("perm")
+        if perm is None:
+            raise NotImplementedError("onnx export: transpose without a "
+                                      "recoverable perm")
+        g.emit("Transpose", in_names[:1], out_ids,
+               _attr_field(_attr_ints("perm", list(perm))))
+    elif op == "conv":
+        # attrs are closed over the recorded fn (nn/functional/conv.py
+        # _conv); read them from the closure cells
+        cv = _closure_vars(node.fn)
+        if cv.get("channel_last"):
+            raise NotImplementedError("onnx export: channel-last conv")
+        n_sp = int(cv["n"])
+        stride = list(cv["strides"])
+        dilation = list(cv["dil"])
+        padding = cv["padding"]
+        groups = int(cv["groups"])
+        from .nn.functional.conv import _conv_padding
+
+        pad = _conv_padding(padding, n_sp, arrs[1].shape, dilation)
+        if isinstance(pad, str):
+            raise NotImplementedError("onnx export: string conv padding")
+        begins = [p[0] for p in pad]
+        ends = [p[1] for p in pad]
+        attrs = (_attr_field(_attr_ints("strides", stride))
+                 + _attr_field(_attr_ints("pads", begins + ends))
+                 + _attr_field(_attr_ints("dilations", dilation))
+                 + _attr_field(_attr_int("group", groups)))
+        g.emit("Conv", in_names, out_ids, attrs)
+    elif op == "adaptive_avg_pool":
+        # attrs are closed over; the OUTPUT shape tells us whether this is
+        # the global pool (the exportable case)
+        oshape = shapes.get(out_ids[0])
+        if oshape is None or any(d != 1 for d in oshape[2:]):
+            raise NotImplementedError(
+                "onnx export: adaptive_avg_pool only with output_size 1")
+        g.emit("GlobalAveragePool", in_names[:1], out_ids)
+    elif op == "batch_norm":
+        # recorded input order: x, running_mean, running_var,
+        # [weight], [bias] — presence read from the closure
+        cv = _closure_vars(node.fn)
+        eps = float(cv.get("epsilon", 1e-5))
+        has_w = cv.get("weight") is not None
+        has_b = cv.get("bias") is not None
+        ch = arrs[0].shape[1]
+        dt = str(arrs[0].dtype)
+        k = 3
+        if has_w:
+            scale_name = in_names[k]
+            k += 1
+        else:
+            scale_name = g.fresh("bn_scale")
+            g.initializers.append(_tensor_proto(
+                scale_name, np.ones(ch, dtype=dt)))
+        if has_b:
+            bias_name = in_names[k]
+        else:
+            bias_name = g.fresh("bn_bias")
+            g.initializers.append(_tensor_proto(
+                bias_name, np.zeros(ch, dtype=dt)))
+        g.emit("BatchNormalization",
+               [in_names[0], scale_name, bias_name, in_names[1],
+                in_names[2]], out_ids,
+               _attr_field(_attr_float("epsilon", eps)))
+    elif op == "cast":
+        dt = args[1] if len(args) > 1 else kwargs.get("dtype")
+        g.emit("Cast", in_names[:1], out_ids,
+               _attr_field(_attr_int("to", _DTYPE.get(str(dt), 1))))
+    elif op == "dropout":
+        cv = _closure_vars(node.fn)
+        p = cv.get("p")
+        if p is not None:
+            # downscale_in_infer eval path records a real a*(1-p) scaling
+            dt = str(arrs[0].dtype)
+            c = g.fresh("const")
+            g.initializers.append(_tensor_proto(
+                c, np.asarray(1.0 - float(p)).astype(dt)))
+            g.emit("Mul", [in_names[0], c], out_ids)
+        else:
+            # upscale_in_train at eval: identity — alias through
+            for oid in out_ids:
+                g.names[oid] = in_names[0]
+    elif op == "mean":
+        axis = args[1] if len(args) > 1 else kwargs.get("axis")
+        keep = args[2] if len(args) > 2 else kwargs.get("keepdim", False)
+        attrs = _attr_field(_attr_int("keepdims", 1 if keep else 0))
+        if axis is not None:
+            ax = axis if isinstance(axis, (list, tuple)) else [axis]
+            attrs += _attr_field(_attr_ints("axes", list(ax)))
+        g.emit("ReduceMean", in_names[:1], out_ids, attrs)
+    else:
+        raise NotImplementedError(
+            f"onnx export: op {op!r} has no ONNX lowering yet (supported: "
+            "linear/matmul, elementwise, activations, softmax, gelu, "
+            "conv2d, batch_norm, adaptive_avg_pool2d(1), reshape/flatten/"
+            "transpose, mean, cast, dropout)")
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Trace ``layer`` (eval mode) through the static Program recorder and
+    write ``{path}.onnx``. Returns the written file path."""
+    import jax.tree_util as jtu
+
+    from . import static as pstatic
+    from .static.program import Program, program_guard
+    from .tensor_class import Tensor
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec (shapes/dtypes)")
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    prog = Program()
+    g = _GraphBuilder()
+    feed_infos = []
+    try:
+        with program_guard(prog):
+            feeds = []
+            for i, spec in enumerate(input_spec):
+                if isinstance(spec, Tensor):
+                    shape = tuple(spec.shape)
+                    dtype = str(np.asarray(spec.numpy()).dtype)
+                else:  # InputSpec-like
+                    shape = tuple(spec.shape)
+                    dtype = str(np.dtype(spec.dtype))
+                name = getattr(spec, "name", None) or f"x{i}"
+                t = pstatic.data(name, [d if d not in (None, -1) else 1
+                                        for d in shape], dtype)
+                g.names[id(t)] = name
+                feed_infos.append((name, shape, dtype))
+                feeds.append(t)
+            out = layer(*feeds)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    out_ids = [id(o) for o in outs]
+
+    shapes = {id(t): tuple(t.shape) for t in prog._keepalive
+              if isinstance(t, Tensor)}
+    for node in prog.nodes:
+        stored = list(node.leaves)
+        in_names, arrs = [], []
+        for pos, tid in zip(node.tensor_pos, node.in_ids):
+            arr = stored[pos]
+            in_names.append(g.input_name(tid, arr))
+            arrs.append(np.asarray(arr))
+        args, kwargs = jtu.tree_unflatten(node.treedef, stored)
+        _convert_node(g, node, args, kwargs, in_names, arrs, shapes)
+
+    graph = b"".join(_f_bytes(1, n) for n in g.nodes)
+    graph += _f_str(2, type(layer).__name__)
+    graph += b"".join(_f_bytes(5, t) for t in g.initializers)
+    for name, shape, dtype in feed_infos:
+        graph += _f_bytes(11, _value_info(name, shape, dtype))
+    for k, oid in enumerate(out_ids):
+        if oid not in g.names:
+            raise RuntimeError("onnx export: model output was not produced "
+                               "by any recorded op")
+        o = outs[k]
+        graph += _f_bytes(12, _value_info(
+            g.names[oid], tuple(o.shape), str(np.asarray(o.numpy()).dtype)))
+
+    opset = _f_str(1, "") + _f_varint(2, int(opset_version))
+    model = (_f_varint(1, 8)                      # ir_version
+             + _f_str(2, "paddle_tpu")            # producer_name
+             + _f_str(3, "0.1.0")                 # producer_version
+             + _f_bytes(7, graph)
+             + _f_bytes(8, opset))                # opset_import
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model)
+    return out_path

@@ -1,0 +1,189 @@
+"""paddle.onnx.export: structural verification of the hand-written ONNX
+protobuf (decoded with an independent minimal wire-format reader)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.onnx as onnx
+from paddle_tpu.jit import InputSpec
+
+
+def _read_varint(b, i):
+    v = 0
+    s = 0
+    while True:
+        x = b[i]
+        i += 1
+        v |= (x & 0x7F) << s
+        if not x & 0x80:
+            return v, i
+        s += 7
+
+
+def _parse(b):
+    i = 0
+    out = {}
+    while i < len(b):
+        key, i = _read_varint(b, i)
+        f, w = key >> 3, key & 7
+        if w == 0:
+            v, i = _read_varint(b, i)
+        elif w == 2:
+            ln, i = _read_varint(b, i)
+            v = b[i:i + ln]
+            i += ln
+        elif w == 5:
+            v = b[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unexpected wire type {w}")
+        out.setdefault(f, []).append(v)
+    return out
+
+
+def _graph_of(path):
+    model = _parse(open(path, "rb").read())
+    assert model[1][0] == 8                      # ir_version
+    assert model[2][0] == b"paddle_tpu"          # producer
+    opset = _parse(model[8][0])
+    assert opset[2][0] == 13
+    return _parse(model[7][0])
+
+
+def test_mlp_export(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Dropout(0.5),
+                        nn.Linear(16, 4), nn.Softmax())
+    p = onnx.export(net, str(tmp_path / "mlp"),
+                    input_spec=[InputSpec([2, 8], "float32")])
+    g = _graph_of(p)
+    ops = [_parse(n)[4][0].decode() for n in g[1]]
+    # dropout elided in eval; linear = MatMul+Add
+    assert ops == ["MatMul", "Add", "Relu", "MatMul", "Add", "Softmax"]
+    inits = [_parse(t) for t in g.get(5, [])]
+    assert [tuple(t.get(1, [])) for t in inits] == [
+        (8, 16), (16,), (16, 4), (4,)]
+    # initializer raw bytes hold the live weights
+    w0 = np.frombuffer(inits[0][9][0], np.float32).reshape(8, 16)
+    np.testing.assert_allclose(w0, net[0].weight.numpy(), rtol=1e-6)
+    assert len(g.get(11, [])) == 1 and len(g.get(12, [])) == 1
+
+
+def test_conv_export(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1, stride=2),
+                        nn.BatchNorm2D(8), nn.ReLU(),
+                        nn.AdaptiveAvgPool2D(1), nn.Flatten(),
+                        nn.Linear(8, 4))
+    p = onnx.export(net, str(tmp_path / "cnn"),
+                    input_spec=[InputSpec([1, 3, 16, 16], "float32")])
+    g = _graph_of(p)
+    nodes = [_parse(n) for n in g[1]]
+    ops = [n[4][0].decode() for n in nodes]
+    assert ops == ["Conv", "BatchNormalization", "Relu",
+                   "GlobalAveragePool", "Reshape", "MatMul", "Add"]
+    conv_attrs = {_parse(a)[1][0].decode(): _parse(a)
+                  for a in nodes[0].get(5, [])}
+    assert conv_attrs["strides"][8] == [2, 2]
+    assert conv_attrs["pads"][8] == [1, 1, 1, 1]
+    assert conv_attrs["group"][3] == [1]
+    # BatchNormalization carries exactly 5 inputs (x, scale, B, mean, var)
+    assert len(nodes[1][1]) == 5
+
+
+def test_unsupported_op_raises(tmp_path):
+    class Weird(nn.Layer):
+        def forward(self, x):
+            return paddle.cumsum(x, 0)
+
+    with pytest.raises(NotImplementedError, match="cumsum"):
+        onnx.export(Weird(), str(tmp_path / "w"),
+                    input_spec=[InputSpec([3], "float32")])
+    with pytest.raises(ValueError):
+        onnx.export(nn.Linear(2, 2), str(tmp_path / "n"))
+
+
+def test_closure_attr_extraction(tmp_path):
+    """Review regressions: attrs live in op closures, not recorded kwargs —
+    reshape/transpose/matmul-transpose/softmax-axis/gelu-approx/custom-eps
+    BN/downscale dropout/asymmetric conv padding must all round into the
+    file correctly."""
+
+    class M(nn.Layer):
+        def forward(self, x):
+            h = paddle.reshape(x, [2, 4])
+            h = paddle.transpose(h, [1, 0])
+            h = paddle.matmul(h, paddle.ones([2, 3]))
+            return nn.functional.softmax(h, axis=0)
+
+    p = onnx.export(M(), str(tmp_path / "m"),
+                    input_spec=[InputSpec([8], "float32")])
+    g = _graph_of(p)
+    nodes = [_parse(n) for n in g[1]]
+    assert [n[4][0].decode() for n in nodes] == [
+        "Reshape", "Transpose", "MatMul", "Softmax"]
+    assert _parse(nodes[-1][5][0])[3] == [0]          # softmax axis=0
+
+    class M2(nn.Layer):
+        def forward(self, x):
+            return paddle.matmul(x, paddle.ones([4, 3]), transpose_y=True)
+
+    p2 = onnx.export(M2(), str(tmp_path / "m2"),
+                     input_spec=[InputSpec([2, 3], "float32")])
+    ops2 = [_parse(n)[4][0].decode() for n in _graph_of(p2)[1]]
+    assert ops2 == ["Transpose", "MatMul"]            # ty emitted
+
+    class M3(nn.Layer):
+        def forward(self, x):
+            return nn.functional.gelu(x, approximate=True)
+
+    p3 = onnx.export(M3(), str(tmp_path / "m3"),
+                     input_spec=[InputSpec([4], "float32")])
+    assert "Tanh" in [_parse(n)[4][0].decode() for n in _graph_of(p3)[1]]
+
+    import struct
+
+    bn = nn.BatchNorm2D(4, epsilon=1e-3, weight_attr=False, bias_attr=False)
+    bn.eval()
+    p4 = onnx.export(bn, str(tmp_path / "m4"),
+                     input_spec=[InputSpec([1, 4, 5, 5], "float32")])
+    g4 = _graph_of(p4)
+    node = _parse(g4[1][0])
+    assert len(node[1]) == 5                          # synthesized scale/bias
+    eps = struct.unpack("<f", _parse(node[5][0])[2][0])[0]
+    assert abs(eps - 1e-3) < 1e-9
+
+    class M5(nn.Layer):
+        def forward(self, x):
+            return nn.functional.dropout(x, 0.5, training=self.training,
+                                         mode="downscale_in_infer")
+
+    m5 = M5()
+    m5.eval()
+    p5 = onnx.export(m5, str(tmp_path / "m5"),
+                     input_spec=[InputSpec([4], "float32")])
+    assert [_parse(n)[4][0].decode()
+            for n in _graph_of(p5)[1]] == ["Mul"]     # (1-p) kept
+
+    conv = nn.Conv2D(2, 2, 3, padding=[1, 2])
+    p6 = onnx.export(conv, str(tmp_path / "m6"),
+                     input_spec=[InputSpec([1, 2, 8, 8], "float32")])
+    pads = {_parse(a)[1][0].decode(): _parse(a)
+            for a in _parse(_graph_of(p6)[1][0]).get(5, [])}["pads"][8]
+    assert pads == [1, 2, 1, 2]                       # begins + ends
+
+    # dynamic batch dim becomes dim_param, not a frozen 1
+    p7 = onnx.export(nn.Linear(8, 2), str(tmp_path / "m7"),
+                     input_spec=[InputSpec([None, 8], "float32")])
+    vi = _parse(_graph_of(p7)[11][0])
+    dims = [_parse(d) for d in
+            _parse(_parse(_parse(vi[2][0])[1][0])[2][0])[1]]
+    assert dims[0][2][0].decode() == "dyn_0" and dims[1][1] == [8]
+
+    # flatten lowers to Reshape with the rank-preserving target shape
+    f8 = nn.Sequential(nn.Flatten(1), nn.Linear(12, 2))
+    p8 = onnx.export(f8, str(tmp_path / "m8"),
+                     input_spec=[InputSpec([2, 3, 4], "float32")])
+    ops8 = [_parse(n)[4][0].decode() for n in _graph_of(p8)[1]]
+    assert ops8 == ["Reshape", "MatMul", "Add"]

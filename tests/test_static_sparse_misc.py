@@ -299,10 +299,12 @@ def test_incubate_fused_attention_layer():
     assert np.isfinite(out.numpy()).all()
 
 
-def test_onnx_export_raises():
+def test_onnx_export_requires_spec():
+    """onnx.export is a real exporter now (tests/test_onnx_export.py);
+    calling without input_spec still fails loudly."""
     import paddle_tpu.onnx as onnx
 
-    with pytest.raises(NotImplementedError, match="StableHLO"):
+    with pytest.raises(ValueError, match="input_spec"):
         onnx.export(nn.Linear(2, 2), "m.onnx")
 
 
