@@ -72,7 +72,8 @@ def _bench_config(name, on_tpu):
         num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=8,
         max_position_embeddings=2048, use_flash_attention=True,
         dtype="bfloat16")
-    return cfg, 2048, 4
+    batch = int(os.environ.get("BENCH_BATCH", "4"))
+    return cfg, 2048, batch
 
 
 def probe():

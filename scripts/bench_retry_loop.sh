@@ -10,6 +10,9 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
     BENCH_CONFIG=8b timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
     BENCH_CONFIG=decode timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
+    # batch sweep on the 1b config: _save_best keeps the highest tokens/s
+    BENCH_BATCH=8 timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
+    BENCH_BATCH=16 timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
     if python - <<'EOF'
 import json, sys
 state = json.load(open("BENCH_STATE.json"))
