@@ -87,8 +87,35 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     return out, None
 
 
-def flash_attn_unpadded(*args, **kwargs):
-    raise NotImplementedError("varlen flash attention lands with the serving stack")
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    """paddle.nn.functional.flash_attention.flash_attn_unpadded parity:
+    ragged batch of [total_tokens, H, D] with cumulative sequence lengths.
+    Each segment runs through the flash path (host-side static lengths,
+    like the reference's eager varlen API)."""
+    import numpy as np
+
+    from ...tensor_class import unwrap, wrap
+
+    cq = np.asarray(unwrap(cu_seqlens_q)).astype(np.int64)
+    ck = np.asarray(unwrap(cu_seqlens_k)).astype(np.int64)
+    q, k, v = unwrap(query), unwrap(key), unwrap(value)
+    if scale is not None:
+        d = q.shape[-1]
+        q = q * (scale * (d ** 0.5))  # fold custom scale over flash's 1/sqrt(d)
+    outs = []
+    for i in range(cq.size - 1):
+        qs = q[cq[i]:cq[i + 1]][None]      # [1, s_q, H, D]
+        ks = k[ck[i]:ck[i + 1]][None]
+        vs = v[ck[i]:ck[i + 1]][None]
+        o, _ = flash_attention(wrap(qs), wrap(ks), wrap(vs),
+                               dropout=dropout, causal=causal)
+        outs.append(unwrap(o)[0])
+    out = wrap(jnp.concatenate(outs, 0))
+    if return_softmax:
+        return out, None
+    return out
 
 
 def sdp_kernel(*args, **kwargs):  # config context stub (torch-compat in ref)

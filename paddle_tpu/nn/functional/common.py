@@ -411,10 +411,6 @@ def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=Fals
     return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
 
 
-def unstack_pad_sequences(*a, **k):  # placeholder for seq utils
-    raise NotImplementedError
-
-
 def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
     def fn(l):
         m = maxlen or int(jnp.max(l))

@@ -316,3 +316,27 @@ def test_model_average_and_lookahead():
         la.step()
         la.clear_grad()
     assert 0 < float(wp.numpy()[0]) < 4.0
+
+
+def test_flash_attn_unpadded_matches_sdpa():
+    """flash_attn_unpadded (varlen, separate q/k/v) == per-segment causal
+    SDPA (review: was a NotImplementedError stub)."""
+    from paddle_tpu.nn.functional.attention import flash_attn_unpadded
+
+    paddle.seed(0)
+    tot = paddle.randn([10, 2, 8])
+    cu = paddle.to_tensor(np.array([0, 4, 10]))
+    out = flash_attn_unpadded(tot, tot, tot, cu, cu, 6, 6, causal=True)
+    assert out.shape == [10, 2, 8]
+    q = tot.numpy()
+    seg = []
+    for lo, hi in [(0, 4), (4, 10)]:
+        qs = np.moveaxis(q[lo:hi][None], 2, 1)
+        s = qs @ np.swapaxes(qs, -1, -2) / np.sqrt(8)
+        S = hi - lo
+        s = np.where(np.tril(np.ones((S, S))), s, -1e9)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        seg.append(np.moveaxis(p @ qs, 1, 2)[0])
+    np.testing.assert_allclose(out.numpy(), np.concatenate(seg, 0),
+                               atol=2e-3)
