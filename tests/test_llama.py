@@ -239,3 +239,27 @@ def test_functional_flash_attention_gqa_fallback():
     v = paddle.to_tensor(rng.randn(1, 64, 2, 32).astype("float32"))
     out, _ = flash_attention(q, k, v, causal=True)
     assert tuple(out.shape) == (1, 64, 4, 32)
+
+
+def test_fused_norm_blocks_scale_with_hidden():
+    """VMEM regression (8b bench OOM): the row block shrinks as hidden
+    grows (block*d <= 512K elements) and the d=4096 path stays numerically
+    exact vs the reference formulation."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas import fused_norm as fnorm
+
+    assert fnorm._pick_block_rows(2048, 2048) == 256
+    assert fnorm._pick_block_rows(256, 4096) == 128
+    assert fnorm._pick_block_rows(256, 8192) == 64
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(256, 4096).astype("float32"))
+    w = jnp.asarray(rng.randn(4096).astype("float32"))
+    np.testing.assert_allclose(
+        np.asarray(fnorm.rms_norm(x, w)),
+        np.asarray(fnorm._rmsnorm_ref(x, w, 1e-6)), atol=1e-5)
+    r = jnp.asarray(rng.randn(256, 4096).astype("float32"))
+    o, h = fnorm.add_rms_norm(x, r, w)
+    ro, rh = fnorm._add_rms_ref(x, r, w, 1e-6)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ro), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(rh), atol=1e-5)
