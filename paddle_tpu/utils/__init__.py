@@ -3,6 +3,7 @@ names, layer helpers (python/paddle/utils/)."""
 from __future__ import annotations
 
 from . import flags  # noqa: F401
+from . import dlpack  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
 
 
@@ -59,24 +60,9 @@ class unique_name:
         return _guard()
 
 
-def to_dlpack(tensor):
-    """paddle.utils.dlpack.to_dlpack parity."""
-    from ..tensor_class import unwrap
-
-    return unwrap(tensor).__dlpack__()
-
-
-def from_dlpack(capsule):
-    import jax.numpy as jnp
-
-    from ..tensor_class import wrap
-
-    return wrap(jnp.from_dlpack(capsule))
-
-
-class dlpack:
-    to_dlpack = staticmethod(to_dlpack)
-    from_dlpack = staticmethod(from_dlpack)
+# dlpack lives in utils/dlpack.py (module), delegating to the top-level
+# modern-protocol implementation; name re-exports for compat
+from .dlpack import from_dlpack, to_dlpack  # noqa: E402,F401
 
 
 def deprecated(update_to="", since="", reason="", level=0):

@@ -1,0 +1,16 @@
+"""paddle.utils.dlpack parity (python/paddle/utils/dlpack.py)."""
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    import paddle_tpu as paddle
+
+    return paddle.to_dlpack(x)
+
+
+def from_dlpack(ext):
+    import paddle_tpu as paddle
+
+    return paddle.from_dlpack(ext)
