@@ -93,21 +93,7 @@ def load_inference_model(path_prefix: str, executor=None, **kwargs):
 
 
 # name re-exports the reference also offers under paddle.static
-class nn:
-    """paddle.static.nn subset: fc/embedding map onto the dygraph layers
-    (static graphs record through them transparently)."""
-
-    @staticmethod
-    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
-        import paddle_tpu as paddle
-        from .. import nn as dynn
-
-        in_f = int(np.prod(x.shape[num_flatten_dims:]))
-        layer = dynn.Linear(in_f, size)
-        out = layer(x.reshape(list(x.shape[:num_flatten_dims]) + [in_f]))
-        if activation:
-            out = getattr(paddle.nn.functional, activation)(out)
-        return out
+from . import nn  # noqa: E402  (module: static/nn.py, 30 reference names)
 
 from .compat import (  # noqa: E402,F401
     Variable, Scope, global_scope, scope_guard, append_backward, gradients,

@@ -1,0 +1,532 @@
+"""paddle.static.nn parity (python/paddle/static/nn/__init__.py, 30 names).
+
+TPU-native collapse: 'static' mode records eagerly-executed ops, so each
+static.nn function simply builds the corresponding dygraph layer (creating
+its parameters on the spot, like the reference's LayerHelper) and applies
+it — the Program recorder captures everything. Control flow (cond/case/
+while_loop) executes host-side on concrete values, which is exactly what
+record-replay needs. The legacy LoD sequence_* ops are adapted to padded
+[batch, time, feat] tensors with an optional ``lengths`` argument (LoD
+tensors are retired in this design; the reference is deprecating them
+too — see SURVEY §2.1).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_expand",
+]
+
+
+from .compat import py_func  # noqa: E402,F401  (shared with paddle.static)
+
+
+def _dynn():
+    from .. import nn
+
+    return nn
+
+
+def _transpose_filter(in_spatial, output_size, filter_size, stride,
+                      padding, n):
+    """Reference conv*_transpose: one of filter_size/output_size must be
+    given; when only output_size is, derive the kernel from
+    out = (in-1)*stride - 2*pad + k."""
+    if filter_size is not None:
+        return filter_size
+    if output_size is None:
+        raise ValueError(
+            "conv transpose: one of output_size and filter_size is required")
+    os_ = [output_size] * n if isinstance(output_size, int)         else list(output_size)[-n:]
+    st = [stride] * n if isinstance(stride, int) else list(stride)
+    pd = [padding] * n if isinstance(padding, int) else list(padding)
+    return [os_[i] - (in_spatial[i] - 1) * st[i] + 2 * pd[i]
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# layers-as-functions (LayerHelper pattern)
+# ---------------------------------------------------------------------------
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    import paddle_tpu as paddle
+
+    nn = _dynn()
+    in_f = int(np.prod(x.shape[num_flatten_dims:]))
+    layer = nn.Linear(in_f, size, weight_attr=weight_attr,
+                      bias_attr=bias_attr)
+    out = layer(x.reshape(list(x.shape[:num_flatten_dims]) + [in_f]))
+    if activation:
+        out = getattr(paddle.nn.functional, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    nn = _dynn()
+
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=param_attr)
+    return layer(input)
+
+
+sparse_embedding = embedding  # storage is dense on TPU; same semantics
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, **kwargs):
+    import paddle_tpu as paddle
+
+    nn = _dynn()
+    ch = input.shape[1 if data_layout[1] == "C" else -1]
+    if len(input.shape) == 4:
+        layer = nn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                               weight_attr=param_attr, bias_attr=bias_attr,
+                               data_format=data_layout)
+    else:
+        if data_layout[1] != "C":
+            raise NotImplementedError(
+                "static.nn.batch_norm: channel-last layout is only "
+                "supported for 4-D inputs")
+        layer = nn.BatchNorm1D(ch, momentum=momentum, epsilon=epsilon,
+                               weight_attr=param_attr, bias_attr=bias_attr)
+    layer.training = not is_test
+    out = layer(input)
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    import paddle_tpu as paddle
+
+    nn = _dynn()
+    shape = list(input.shape[begin_norm_axis:])
+    layer = nn.LayerNorm(shape, epsilon=epsilon,
+                         weight_attr=param_attr if scale else False,
+                         bias_attr=bias_attr if shift else False)
+    out = layer(input)
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    import paddle_tpu as paddle
+
+    nn = _dynn()
+    layer = nn.GroupNorm(groups, input.shape[1], epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(input)
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    nn = _dynn()
+
+    layer = nn.InstanceNorm2D(input.shape[1], epsilon=epsilon)
+    return layer(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """data_norm: normalization by accumulated batch statistics (CTR
+    models). Single-pass form: normalize by the batch's own moments —
+    the accumulated-summary machinery collapses to BN without affine."""
+    import paddle_tpu as paddle
+
+    from ..ops.registry import apply
+    import jax.numpy as jnp
+
+    def fn(a):
+        mean = a.mean(0, keepdims=True)
+        var = a.var(0, keepdims=True)
+        return (a - mean) / jnp.sqrt(var + epsilon)
+
+    out = apply("data_norm", fn, input)
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None, use_cudnn=True):
+    import paddle_tpu as paddle
+
+    nn = _dynn()
+    layer = nn.Conv2D(input.shape[1], num_filters, filter_size,
+                      stride=stride, padding=padding, dilation=dilation,
+                      groups=groups, weight_attr=param_attr,
+                      bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None, use_cudnn=True):
+    import paddle_tpu as paddle
+
+    nn = _dynn()
+    filter_size = _transpose_filter(input.shape[2:], output_size,
+                                    filter_size, stride, padding, 2)
+    layer = nn.Conv2DTranspose(input.shape[1], num_filters, filter_size,
+                               stride=stride, padding=padding,
+                               dilation=dilation, groups=groups,
+                               weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(input)
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None, use_cudnn=True):
+    import paddle_tpu as paddle
+
+    nn = _dynn()
+    layer = nn.Conv3D(input.shape[1], num_filters, filter_size,
+                      stride=stride, padding=padding, dilation=dilation,
+                      groups=groups, weight_attr=param_attr,
+                      bias_attr=bias_attr)
+    out = layer(input)
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW", name=None, use_cudnn=True):
+    import paddle_tpu as paddle
+
+    nn = _dynn()
+    filter_size = _transpose_filter(input.shape[2:], output_size,
+                                    filter_size, stride, padding, 3)
+    layer = nn.Conv3DTranspose(input.shape[1], num_filters, filter_size,
+                               stride=stride, padding=padding,
+                               dilation=dilation, groups=groups,
+                               weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(input)
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import DeformConv2D
+
+    layer = DeformConv2D(input.shape[1], num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(input, offset, mask)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    import paddle_tpu as paddle
+
+    nn = _dynn()
+    layer = nn.Bilinear(x.shape[-1], y.shape[-1], size,
+                        weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(x, y)
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    nn = _dynn()
+
+    ch_axis = 1 if data_format[1] == "C" else -1
+    num = 1 if mode == "all" else (
+        x.shape[ch_axis] if mode == "channel"
+        else int(np.prod(x.shape[1:])))
+    layer = nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                     data_format=data_format)
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization of a weight tensor (power iteration)."""
+    from ..ops.registry import apply
+    import jax.numpy as jnp
+
+    def fn(w):
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), w.dtype) / np.sqrt(mat.shape[0])
+        for _ in range(power_iters):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ mat @ v
+        return w / jnp.maximum(sigma, eps)
+
+    return apply("spectral_norm", fn, weight)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Lookahead (row) convolution over [batch, time, feat]."""
+    import paddle_tpu as paddle
+
+    from ..ops.registry import apply
+    from ..tensor_class import Parameter
+    import jax
+    import jax.numpy as jnp
+
+    feat = input.shape[-1]
+    k = future_context_size + 1
+    from ..nn.initializer_core import XavierNormal
+
+    w = Parameter(XavierNormal()((k, feat), jnp.float32))
+
+    def fn(a, wk):
+        pad = jnp.pad(a, ((0, 0), (0, k - 1), (0, 0)))
+        out = sum(pad[:, i:i + a.shape[1]] * wk[i] for i in range(k))
+        return out
+
+    out = apply("row_conv", fn, input, w)
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (uniform negative sampling)."""
+    import paddle_tpu as paddle
+
+    from ..framework import random as _random
+    from ..ops.registry import apply
+    from ..tensor_class import Parameter
+    import jax
+    import jax.numpy as jnp
+
+    from ..nn.initializer_core import XavierNormal
+
+    d = input.shape[-1]
+    w = Parameter(XavierNormal()((num_total_classes, d), jnp.float32))
+    b = Parameter(jnp.zeros((num_total_classes,), jnp.float32))
+    key = jax.random.key(seed) if seed else _random.next_key()
+
+    def fn(x, lbl, wv, bv):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        pos_logit = (x * wv[lbl]).sum(-1) + bv[lbl]
+        neg_ids = jax.random.randint(key, (x.shape[0], num_neg_samples), 0,
+                                     num_total_classes)
+        neg_logit = jnp.einsum("bd,bkd->bk", x, wv[neg_ids]) \
+            + bv[neg_ids]
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jax.nn.softplus(neg_logit).sum(-1)
+        return (pos_loss + neg_loss).reshape(-1, 1)
+
+    return apply("nce", fn, input, label, w, b)
+
+
+# ---------------------------------------------------------------------------
+# control flow (host-side on concrete values — record-replay semantics)
+# ---------------------------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    from ..tensor_class import Tensor
+
+    val = bool(pred.numpy()) if isinstance(pred, Tensor) else bool(pred)
+    if val:
+        return true_fn() if true_fn is not None else None
+    return false_fn() if false_fn is not None else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        from ..tensor_class import Tensor
+
+        if bool(pred.numpy()) if isinstance(pred, Tensor) else bool(pred):
+            return fn()
+    if default is not None:
+        return default()
+    raise ValueError("case: no branch matched and no default given")
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    from ..tensor_class import Tensor
+
+    idx = int(branch_index.numpy()) if isinstance(branch_index, Tensor) \
+        else int(branch_index)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    raise ValueError(f"switch_case: no branch {idx} and no default")
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    vals = list(loop_vars)
+    from ..tensor_class import Tensor
+
+    def truthy(c):
+        return bool(c.numpy()) if isinstance(c, Tensor) else bool(c)
+
+    while truthy(cond_fn(*vals)):
+        out = body(*vals)
+        vals = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vals
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    from ..autograd.pylayer import PyLayer
+
+    if backward_fn is None:
+        return forward_fn(*inputs)
+
+    class _P(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_fn(*grads)
+
+    return _P.apply(*inputs)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops over padded [batch, time, feat] (+ optional lengths)
+# ---------------------------------------------------------------------------
+
+def _len_mask(a, lengths):
+    import jax.numpy as jnp
+
+    if lengths is None:
+        return jnp.ones(a.shape[:2], bool)
+    ln = lengths if not hasattr(lengths, "_array") else lengths._array
+    return jnp.arange(a.shape[1])[None, :] < jnp.asarray(ln)[:, None]
+
+
+def sequence_softmax(input, lengths=None, name=None):
+    from ..ops.registry import apply
+    import jax
+    import jax.numpy as jnp
+
+    def fn(a, *rest):
+        mask = _len_mask(a, rest[0] if rest else None)
+        neg = jnp.asarray(-1e9, a.dtype)
+        scores = jnp.where(mask[..., None] if a.ndim == 3 else mask,
+                           a, neg)
+        return jax.nn.softmax(scores, axis=1)
+
+    args = (input,) + ((lengths,) if lengths is not None else ())
+    return apply("sequence_softmax", fn, *args)
+
+
+def sequence_pool(input, pool_type="sum", lengths=None, name=None):
+    from ..ops.registry import apply
+    import jax.numpy as jnp
+
+    def fn(a, *rest):
+        mask = _len_mask(a, rest[0] if rest else None)[..., None]
+        masked = a * mask
+        if pool_type in ("sum",):
+            return masked.sum(1)
+        if pool_type == "average":
+            return masked.sum(1) / jnp.maximum(mask.sum(1), 1)
+        if pool_type == "sqrt":
+            return masked.sum(1) / jnp.sqrt(jnp.maximum(mask.sum(1), 1))
+        if pool_type == "max":
+            neg = jnp.asarray(-1e9, a.dtype)
+            return jnp.where(mask, a, neg).max(1)
+        raise ValueError(f"sequence_pool: unknown pool_type {pool_type!r}")
+
+    args = (input,) + ((lengths,) if lengths is not None else ())
+    return apply("sequence_pool", fn, *args)
+
+
+def sequence_first_step(input, name=None):
+    from ..ops.registry import apply
+
+    return apply("sequence_first_step", lambda a: a[:, 0], input)
+
+
+def sequence_last_step(input, lengths=None, name=None):
+    from ..ops.registry import apply
+    import jax.numpy as jnp
+
+    def fn(a, *rest):
+        if rest:
+            ln = rest[0].astype(jnp.int32) - 1
+            return jnp.take_along_axis(
+                a, ln[:, None, None], axis=1)[:, 0]
+        return a[:, -1]
+
+    args = (input,) + ((lengths,) if lengths is not None else ())
+    return apply("sequence_last_step", fn, *args)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Broadcast each x row across y's time dimension (padded adaptation:
+    x [B, F] → [B, T_y, F]). A 3-D x whose T differs from y's is ambiguous
+    without LoD and is rejected loudly."""
+    from ..ops.registry import apply
+    import jax.numpy as jnp
+
+    def fn(a, b):
+        t = b.shape[1]
+        if a.ndim == 2:
+            return jnp.repeat(a[:, None], t, axis=1)
+        if a.shape[1] == t:
+            return a
+        raise NotImplementedError(
+            "sequence_expand: 3-D x with T != y's T needs LoD semantics; "
+            "collapse x to [batch, feat] first")
+
+    return apply("sequence_expand", fn, x, y)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, param_attr=None,
+                  bias_attr=None, act=None, name=None):
+    """Sequence convolution = Conv1D over time (padded adaptation)."""
+    import paddle_tpu as paddle
+
+    nn = _dynn()
+    layer = nn.Conv1D(input.shape[-1], num_filters, filter_size,
+                      stride=filter_stride,
+                      padding=(filter_size // 2 if padding else 0),
+                      weight_attr=param_attr, bias_attr=bias_attr)
+    # [B, T, C] → NCL for the conv, back to [B, T', F]
+    out = layer(input.transpose([0, 2, 1])).transpose([0, 2, 1])
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out

@@ -827,3 +827,54 @@ def test_asp_and_memory_efficient_attention():
 
     assert isinstance(inc.DistributedFusedLamb(
         parameters=nn.Linear(4, 4).parameters()), Lamb)
+
+
+def test_static_nn_module():
+    """static.nn parity module (30 names): layer-as-function helpers,
+    host control flow, padded sequence ops, review fixes (output_size-only
+    conv transpose, BN1D attrs, prelu NHWC channel count)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.static as st
+
+    paddle.seed(0)
+    assert not [n for n in st.nn.__all__ if not hasattr(st.nn, n)]
+    assert st.nn.fc(paddle.randn([2, 6]), 4, activation="relu").shape == [2, 4]
+    assert st.nn.conv2d(paddle.randn([1, 3, 8, 8]), 6, 3,
+                        padding=1).shape == [1, 6, 8, 8]
+    assert st.nn.conv2d_transpose(paddle.randn([1, 3, 8, 8]), 4,
+                                  output_size=[16, 16],
+                                  stride=2).shape == [1, 4, 16, 16]
+    assert st.nn.layer_norm(paddle.randn([2, 5])).shape == [2, 5]
+    assert st.nn.batch_norm(paddle.randn([4, 6]),
+                            bias_attr=False).shape == [4, 6]
+    sn = st.nn.spectral_norm(paddle.randn([8, 6]))
+    assert float(np.linalg.svd(sn.numpy(), compute_uv=False)[0]) < 1.3
+    assert st.nn.row_conv(paddle.randn([2, 5, 4]), 2).shape == [2, 5, 4]
+    assert st.nn.nce(paddle.randn([4, 8]),
+                     paddle.to_tensor(np.array([[1], [2], [3], [0]])),
+                     10).shape == [4, 1]
+    # control flow on concrete values
+    assert st.nn.cond(paddle.to_tensor(np.array(True)),
+                      lambda: 1, lambda: 2) == 1
+    assert st.nn.switch_case(paddle.to_tensor(np.array(1)),
+                             {0: lambda: "a", 1: lambda: "b"}) == "b"
+    out = st.nn.while_loop(lambda c: c.numpy() < 3, lambda c: [c + 1],
+                           [paddle.to_tensor(np.array(0))])
+    assert int(out[0].numpy()) == 3
+    # padded sequence ops honor lengths
+    lens = paddle.to_tensor(np.array([2, 4]))
+    sm = st.nn.sequence_softmax(paddle.randn([2, 4, 3]), lengths=lens)
+    np.testing.assert_allclose(sm.numpy()[0, :2].sum(0), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(sm.numpy()[0, 2:], 0, atol=1e-6)
+    x = np.random.rand(2, 4, 3).astype("float32")
+    last = st.nn.sequence_last_step(paddle.to_tensor(x), lengths=lens)
+    np.testing.assert_allclose(last.numpy()[0], x[0, 1])
+    np.testing.assert_allclose(last.numpy()[1], x[1, 3])
+    assert st.nn.sequence_expand(paddle.randn([2, 3]),
+                                 paddle.randn([2, 5, 3])).shape == [2, 5, 3]
+    assert st.nn.sequence_conv(paddle.randn([2, 6, 4]), 5).shape == [2, 6, 5]
+    # prelu channel count follows data_format
+    assert st.nn.prelu(paddle.randn([1, 6, 6, 4]), mode="channel",
+                       data_format="NHWC").shape == [1, 6, 6, 4]
