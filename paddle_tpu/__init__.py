@@ -31,7 +31,7 @@ from .tensor_class import Tensor, Parameter, is_tensor
 from .autograd import no_grad, enable_grad, set_grad_enabled, grad
 from .autograd.pylayer import PyLayer, PyLayerContext
 from .framework.random import seed, get_rng_state, set_rng_state
-from .framework import device
+from . import device
 from .framework.device import (
     set_device,
     get_device,
@@ -198,6 +198,7 @@ _LAZY_SUBMODULES = (
     "sysconfig",
     "hub",
     "version",
+    "tensorrt",
 )
 
 

@@ -1,4 +1,5 @@
 """paddle.audio parity (python/paddle/audio/): feature extractors +
 functional window/mel utilities."""
 from . import functional  # noqa: F401
+from . import backends  # noqa: F401
 from . import features  # noqa: F401

@@ -1,0 +1,59 @@
+"""paddle.audio.backends parity: wave-backend registry. The in-repo
+backend decodes WAV via the stdlib (no soundfile wheel in the image)."""
+from __future__ import annotations
+
+__all__ = ["get_current_backend", "list_available_backends", "set_backend"]
+
+_BACKEND = "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend() -> str:
+    return _BACKEND
+
+
+def set_backend(backend_name: str):
+    global _BACKEND
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"audio backend {backend_name!r} is not available (no soundfile "
+            "in the TPU image); available: ['wave_backend']")
+    _BACKEND = backend_name
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Decode a PCM WAV file with the stdlib wave module."""
+    import wave
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ...tensor_class import wrap
+
+    with wave.open(str(filepath), "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        w.setpos(min(frame_offset, n))
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(count)
+        width = w.getsampwidth()
+        ch = w.getnchannels()
+    if width == 3:
+        raise NotImplementedError(
+            "audio.backends.load: 24-bit PCM WAV is not supported by the "
+            "stdlib wave backend; convert to 16/32-bit")
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    arr = np.frombuffer(raw, dt).reshape(-1, ch)
+    if normalize:
+        if width == 1:
+            # 8-bit WAV is unsigned with a 128 offset
+            arr = (arr.astype(np.float32) - 128.0) / 128.0
+        else:
+            arr = arr.astype(np.float32) / float(2 ** (8 * width - 1))
+    data = arr.T if channels_first else arr
+    return wrap(jnp.asarray(data)), sr

@@ -1,0 +1,19 @@
+"""paddle.device as an importable package (python/paddle/device/__init__.py).
+
+The implementation lives in framework/device.py; this package re-exports it
+so both access styles work: ``paddle.device.X`` and
+``import paddle.device.cuda``.
+"""
+from ..framework.device import *  # noqa: F401,F403
+from ..framework.device import (  # noqa: F401  (names not caught by *)
+    Stream, Event, current_stream, set_stream, stream_guard, synchronize,
+    device_count, memory_allocated, max_memory_allocated, memory_reserved,
+    max_memory_reserved, empty_cache, get_cudnn_version, XPUPlace, IPUPlace,
+    is_compiled_with_ipu, is_compiled_with_rocm, is_compiled_with_cinn,
+    is_compiled_with_distribute, is_compiled_with_custom_device,
+    get_all_device_type, get_all_custom_device_type, get_available_device,
+    get_available_custom_device, set_device, get_device,
+    is_compiled_with_cuda, is_compiled_with_tpu, CPUPlace, TPUPlace,
+    CUDAPlace, CUDAPinnedPlace)
+from . import cuda  # noqa: F401
+from . import xpu  # noqa: F401

@@ -1,0 +1,18 @@
+"""paddle.device.cuda as an importable module — delegates to the shared
+accelerator namespace (framework/device.py::_CudaNamespace)."""
+from ..framework.device import cuda as _ns
+
+Stream = _ns.Stream
+Event = _ns.Event
+current_stream = _ns.current_stream
+synchronize = _ns.synchronize
+device_count = _ns.device_count
+empty_cache = _ns.empty_cache
+stream_guard = _ns.stream_guard
+memory_allocated = _ns.memory_allocated
+max_memory_allocated = _ns.max_memory_allocated
+memory_reserved = _ns.memory_reserved
+max_memory_reserved = _ns.max_memory_reserved
+get_device_properties = _ns.get_device_properties
+get_device_name = _ns.get_device_name
+get_device_capability = _ns.get_device_capability

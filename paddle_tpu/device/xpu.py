@@ -1,0 +1,7 @@
+"""paddle.device.xpu parity: XPU-named probe served by the TPU runtime."""
+
+
+def synchronize(device=None):
+    from ..framework.device import synchronize as _s
+
+    return _s()

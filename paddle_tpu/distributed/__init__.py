@@ -12,6 +12,7 @@ Reference parity map (SURVEY.md §2.5-2.7):
 from . import env
 from .log_utils import get_logger, log_on_rank
 from . import rpc
+from . import passes
 from .env import (
     get_rank, get_world_size, init_parallel_env, is_initialized,
 )

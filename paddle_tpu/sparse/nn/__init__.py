@@ -17,9 +17,9 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ..tensor_class import unwrap, wrap
-from ..nn import Layer
-from ..nn.initializer_core import Uniform, Constant
+from ...tensor_class import unwrap, wrap
+from ...nn import Layer
+from ...nn.initializer_core import Uniform, Constant
 
 
 def _triple(v):
@@ -27,7 +27,7 @@ def _triple(v):
 
 
 def _dense_ndhwc(x):
-    from . import SparseTensor, _coo
+    from .. import SparseTensor, _coo
 
     if isinstance(x, SparseTensor):
         return _coo(x).todense(), _coo(x)
@@ -38,7 +38,7 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NDHWC", name=None):
     """F-style sparse conv3d (sparse_ops.yaml `conv3d`). weight layout
     [kd, kh, kw, c_in/groups, c_out] (the reference's DHWCK)."""
-    from . import SparseTensor, to_sparse_coo
+    from .. import SparseTensor, to_sparse_coo
 
     dense, _ = _dense_ndhwc(x)
     w = unwrap(weight)
@@ -59,7 +59,7 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
                 groups=1, data_format="NDHWC", key=None, name=None):
     """Submanifold conv3d (sparse_ops.yaml `conv3d` subm=True): the output
     keeps the INPUT's coordinate set — values elsewhere are dropped."""
-    from . import SparseTensor, _coo
+    from .. import SparseTensor, _coo
 
     dense, sp = _dense_ndhwc(x)
     w = unwrap(weight)
@@ -86,7 +86,7 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                data_format="NDHWC", name=None):
     """sparse_ops.yaml `maxpool`: dense reduce_window, re-sparsified."""
-    from . import to_sparse_coo
+    from .. import to_sparse_coo
 
     dense, _ = _dense_ndhwc(x)
     k = _triple(kernel_size)
@@ -172,7 +172,7 @@ class BatchNorm(Layer):
         self.training = True
 
     def forward(self, x):
-        from . import SparseTensor, _coo
+        from .. import SparseTensor, _coo
 
         sp = _coo(x)
         vals = sp.data  # [nnz, C]
@@ -216,14 +216,14 @@ class SyncBatchNorm(BatchNorm):
 
 class ReLU(Layer):
     def forward(self, x):
-        from . import relu as _relu
+        from .. import relu as _relu
 
         return _relu(x)
 
 
 class ReLU6(Layer):
     def forward(self, x):
-        from . import relu6 as _relu6
+        from .. import relu6 as _relu6
 
         return _relu6(x)
 
@@ -234,7 +234,7 @@ class LeakyReLU(Layer):
         self.negative_slope = negative_slope
 
     def forward(self, x):
-        from . import leaky_relu as _lr
+        from .. import leaky_relu as _lr
 
         return _lr(x, self.negative_slope)
 
@@ -245,13 +245,80 @@ class Softmax(Layer):
         self.axis = axis
 
     def forward(self, x):
-        from . import softmax as _softmax
+        from .. import softmax as _softmax
 
         return _softmax(x, self.axis)
 
 
-functional = type("functional", (), {
-    "conv3d": staticmethod(conv3d),
-    "subm_conv3d": staticmethod(subm_conv3d),
-    "max_pool3d": staticmethod(max_pool3d),
-})
+from . import functional  # noqa: E402,F401
+
+
+def _conv2d_impl(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, subm):
+    """2-D sparse conv via the 3-D path (depth-1 axis) — one kernel serves
+    both ranks, like the reference's shared sparse conv kernel."""
+    from .. import SparseTensor, _coo
+    from ...tensor_class import unwrap, wrap
+
+    import jax.numpy as jnp
+
+    def to3d_stride(v):
+        return (1, v, v) if isinstance(v, int) else (1, *v)
+
+    def to3d_pad(v):
+        # depth axis must NOT be padded: kernel depth is 1, and any depth
+        # padding would shift the real result off plane 0
+        return (0, v, v) if isinstance(v, int) else (0, *v)
+
+    from jax.experimental import sparse as jsp
+
+    sp = _coo(x)
+    dense5 = sp.todense()[:, None]               # [N, 1, H, W, C]
+    w5 = unwrap(weight)[None]                    # [1, kh, kw, cin/g, cout]
+    if subm:
+        x5 = SparseTensor(jsp.BCOO.fromdense(dense5, n_dense=1))
+        out = subm_conv3d(x5, wrap(w5), bias, to3d_stride(stride),
+                          to3d_pad(padding), to3d_stride(dilation), groups)
+        o = _coo(out).todense()[:, 0]
+    else:
+        # conv3d accepts dense input directly — skip the BCOO round-trip
+        out = conv3d(wrap(dense5), wrap(w5), bias, to3d_stride(stride),
+                     to3d_pad(padding), to3d_stride(dilation), groups)
+        o = _coo(out).todense()[:, 0]            # drop the depth-1 axis
+    from .. import to_sparse_coo
+
+    return to_sparse_coo(wrap(o), sparse_dim=3)
+
+
+class Conv2D(_SparseConvBase):
+    """paddle.sparse.nn.Conv2D (NHWC)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None,
+                 data_format="NHWC"):
+        Layer.__init__(self)
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        fan_in = in_channels * k[0] * k[1]
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            list(k) + [in_channels // groups, out_channels],
+            default_initializer=Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+        self._cfg = (stride, padding, dilation, groups, data_format)
+
+    def forward(self, x):
+        stride, padding, dilation, groups, fmt = self._cfg
+        return _conv2d_impl(x, self.weight, self.bias, stride, padding,
+                            dilation, groups, fmt, subm=False)
+
+
+class SubmConv2D(Conv2D):
+    """paddle.sparse.nn.SubmConv2D (output pattern = input pattern)."""
+
+    def forward(self, x):
+        stride, padding, dilation, groups, fmt = self._cfg
+        return _conv2d_impl(x, self.weight, self.bias, stride, padding,
+                            dilation, groups, fmt, subm=True)

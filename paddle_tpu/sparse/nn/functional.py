@@ -1,0 +1,75 @@
+"""paddle.sparse.nn.functional parity (python/paddle/sparse/nn/functional/):
+functional faces of the sparse conv/pool family + value-wise activations."""
+from __future__ import annotations
+
+
+def _pkg():
+    from paddle_tpu.sparse import nn as _nn
+
+    return _nn
+
+
+def conv3d(*args, **kwargs):
+    return _pkg().conv3d(*args, **kwargs)
+
+
+def subm_conv3d(*args, **kwargs):
+    return _pkg().subm_conv3d(*args, **kwargs)
+
+
+def max_pool3d(*args, **kwargs):
+    return _pkg().max_pool3d(*args, **kwargs)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    return _pkg()._conv2d_impl(x, weight, bias, stride, padding, dilation,
+                               groups, data_format, subm=False)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _pkg()._conv2d_impl(x, weight, bias, stride, padding, dilation,
+                               groups, data_format, subm=True)
+
+
+def relu(x, name=None):
+    from .. import relu as _f
+
+    return _f(x)
+
+
+def relu6(x, name=None):
+    from .. import relu6 as _f
+
+    return _f(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from .. import leaky_relu as _f
+
+    return _f(x, negative_slope)
+
+
+def softmax(x, axis=-1, name=None):
+    from .. import softmax as _f
+
+    return _f(x, axis)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    from .. import fused_attention
+
+    return fused_attention(query, key, value, sparse_mask,
+                           key_padding_mask, attn_mask)
+
+
+def subm_conv2d_igemm(*args, **kwargs):
+    """Implicit-GEMM variant: on TPU the dense-MXU path IS the GEMM
+    formulation, so this aliases subm_conv2d."""
+    return subm_conv2d(*args, **kwargs)
+
+
+def subm_conv3d_igemm(*args, **kwargs):
+    return subm_conv3d(*args, **kwargs)

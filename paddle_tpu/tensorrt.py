@@ -1,0 +1,41 @@
+"""paddle.tensorrt parity surface. TensorRT is a CUDA-only engine; on the
+TPU build the equivalent deployment path is StableHLO + XLA (jit.save /
+static.save_inference_model), so the conversion entry points raise with
+that guidance — reference behavior on a build without TRT.
+"""
+from __future__ import annotations
+
+__all__ = ["Input", "TensorRTConfig", "convert", "convert_loaded_model"]
+
+
+class Input:
+    """Shape spec for a conversion input (min/opt/max shapes)."""
+
+    def __init__(self, min_input_shape=None, optim_input_shape=None,
+                 max_input_shape=None, input_data_type=None, name=None):
+        self.min_input_shape = min_input_shape
+        self.optim_input_shape = optim_input_shape
+        self.max_input_shape = max_input_shape
+        self.input_data_type = input_data_type
+        self.name = name
+
+
+class TensorRTConfig:
+    def __init__(self, inputs=None, **kwargs):
+        self.inputs = list(inputs or [])
+        self.__dict__.update(kwargs)
+
+
+def _no_trt():
+    raise RuntimeError(
+        "TensorRT is not available in the TPU build (CUDA-only engine). "
+        "Deploy with paddle.jit.save / paddle.static.save_inference_model "
+        "— the StableHLO artifact compiles with XLA on the target device.")
+
+
+def convert(model, config=None, **kwargs):
+    _no_trt()
+
+
+def convert_loaded_model(model_dir, config=None, **kwargs):
+    _no_trt()

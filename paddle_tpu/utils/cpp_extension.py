@@ -142,3 +142,60 @@ def register_host_op(name: str, host_fn: Callable, out_shape_fn: Callable,
 
     return register_custom_op(name, fn, differentiable=differentiable,
                               doc=doc)
+
+
+# ---------------------------------------------------------------------------
+# setuptools-style surface (python/paddle/utils/cpp_extension/ parity)
+# ---------------------------------------------------------------------------
+
+def get_build_directory(verbose=False) -> str:
+    """Where JIT-built user extensions are cached (PADDLE_EXTENSION_DIR
+    analog)."""
+    import os
+
+    d = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def CppExtension(sources, *args, **kwargs):
+    """Describe a C++ extension for setup() (reference returns a
+    setuptools.Extension; here the build happens through `load`, so the
+    descriptor just carries the sources/flags)."""
+    return {"sources": list(sources), "kind": "cpp", "args": args,
+            "kwargs": kwargs}
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    """Accepted for API compatibility; .cu sources cannot build on the TPU
+    image (no nvcc) and raise at setup() time."""
+    return {"sources": list(sources), "kind": "cuda", "args": args,
+            "kwargs": kwargs}
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Build the described extensions NOW with the g++ JIT path (`load`)
+    and return the loaded modules keyed by name — the reference's
+    setuptools command collapses to an eager build (no pip install step
+    exists in this environment)."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules] if ext_modules else []
+    built = {}
+    for i, ext in enumerate(exts):
+        if not isinstance(ext, dict):
+            raise TypeError("setup: pass CppExtension(...) descriptors")
+        if ext["kind"] == "cuda":
+            raise RuntimeError(
+                "CUDAExtension cannot build on the TPU image (no nvcc); "
+                "port the kernel to a Pallas custom op "
+                "(utils.cpp_extension.register_custom_op)")
+        # unique module key per extension — a shared `name` must not let
+        # later extensions overwrite earlier ones
+        mod_name = name if (name and len(exts) == 1) \
+            else f"{name or 'ext'}_{i}"
+        built[mod_name] = load(name=mod_name, sources=ext["sources"],
+                               extra_cflags=tuple(
+                                   ext["kwargs"].get("extra_compile_args")
+                                   or ()))
+    return built

@@ -878,3 +878,83 @@ def test_static_nn_module():
     # prelu channel count follows data_format
     assert st.nn.prelu(paddle.randn([1, 6, 6, 4]), mode="channel",
                        data_format="NHWC").shape == [1, 6, 6, 4]
+
+
+def test_sparse_conv2d_and_new_packages():
+    """Round-3 final parity batch: sparse 2-D convs (padding proven against
+    dense conv — review fix: depth axis must not be padded),
+    sparse.nn.functional module, device package imports, audio.backends
+    WAV decode (8/16-bit), distributed.passes registry, tensorrt guidance,
+    cpp_extension setup()."""
+    import json
+    import tempfile
+    import wave
+
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.sparse as sparse
+
+    paddle.seed(0)
+    d = np.zeros((1, 5, 5, 2), "float32")
+    d[0, 2, 2] = [1.0, 2.0]
+    st = sparse.to_sparse_coo(paddle.to_tensor(d), sparse_dim=3)
+    c = sparse.nn.Conv2D(2, 3, 3, padding=1)
+    out = c(st).to_dense().numpy()
+    ref = np.asarray(jax.lax.conv_general_dilated(
+        d, np.asarray(c.weight.numpy()), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))) + c.bias.numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+    s = sparse.nn.SubmConv2D(2, 3, 3)
+    assert s(st).nnz() == st.nnz()
+    import paddle_tpu.sparse.nn.functional as SF
+
+    assert SF.conv2d(st, c.weight, c.bias, padding=1).shape == [1, 5, 5, 3]
+
+    # importable device package, both styles
+    import paddle_tpu.device.cuda as C
+
+    assert C.device_count() == 0  # cpu-only host
+    assert paddle.device.get_device().startswith("cpu")
+
+    # wave backend: 16-bit and centered 8-bit
+    tmp = tempfile.mktemp(suffix=".wav")
+    with wave.open(tmp, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(1)
+        w.setframerate(8000)
+        w.writeframes(bytes([128, 255, 0, 128]))
+    sig, sr = paddle.audio.backends.load(tmp)
+    np.testing.assert_allclose(sig.numpy().reshape(-1)[0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(sig.numpy().reshape(-1)[2], -1.0, atol=1e-6)
+    assert sr == 8000
+    assert paddle.audio.backends.get_current_backend() == "wave_backend"
+
+    pm = paddle.distributed.passes.PassManager(
+        [paddle.distributed.passes.new_pass("auto_parallel_recompute")])
+    assert pm.apply() == ["recompute"]
+    with pytest.raises(NotImplementedError):
+        paddle.distributed.passes.new_pass("unknown_pass").apply()
+    with pytest.raises(RuntimeError, match="StableHLO"):
+        paddle.tensorrt.convert(None)
+
+    # inference tail
+    t = paddle.inference.Tensor("x")
+    t.copy_from_cpu([[1.0, 2.0]])
+    assert t.shape() == [1, 2]
+    mf = tempfile.mktemp()
+    open(mf, "w").write("x")
+    paddle.inference.convert_to_mixed_precision(
+        mf, None, mf + ".mixed", None, mixed_precision=2)
+    assert json.load(open(mf + ".mixed.precision.json"))[
+        "mixed_precision"] == 2
+
+    # setup() builds real extensions with unique keys
+    from paddle_tpu.utils import cpp_extension as ce
+
+    s1 = tempfile.mktemp(suffix=".cc")
+    open(s1, "w").write('extern "C" int f1() { return 21; }')
+    mods = ce.setup(name="one_ext", ext_modules=[ce.CppExtension([s1])])
+    assert mods["one_ext"].f1() == 21
