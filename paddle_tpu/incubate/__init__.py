@@ -292,6 +292,8 @@ jit = _IncubateJit()
 
 
 from . import asp  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
 
 
 class DistributedFusedLamb:

@@ -13,7 +13,16 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["decorate", "prune_model", "set_excluded_layers",
-           "reset_excluded_layers", "calculate_density"]
+           "reset_excluded_layers", "calculate_density",
+           "add_supported_layer"]
+
+_EXTRA_SUPPORTED: list = []
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """incubate.asp.add_supported_layer: register an extra layer TYPE (or
+    name) whose .weight prune_model should mask."""
+    _EXTRA_SUPPORTED.append((layer, pruning_func))
 
 _EXCLUDED: set = set()
 # id(param) -> (weakref(param), mask): weakrefs let pruned models be
@@ -56,9 +65,22 @@ def _nm_mask(w: np.ndarray, n: int, m: int) -> np.ndarray:
     return mask.reshape(orig)
 
 
+def _custom_pruner(layer):
+    for t, fn in _EXTRA_SUPPORTED:
+        if fn is None:
+            continue
+        if (isinstance(t, type) and isinstance(layer, t)) or \
+                (isinstance(t, str) and type(layer).__name__ == t):
+            return fn
+    return None
+
+
 def _prunable(model):
     from .. import nn
 
+    extra_types = tuple(t for t, _ in _EXTRA_SUPPORTED
+                        if isinstance(t, type))
+    extra_names = {t for t, _ in _EXTRA_SUPPORTED if isinstance(t, str)}
     for layer in model.sublayers(include_self=True):
         w = getattr(layer, "weight", None)
         if w is None or not hasattr(w, "_array"):
@@ -68,9 +90,10 @@ def _prunable(model):
         if getattr(w, "name", None) in _EXCLUDED:
             continue
         if not isinstance(layer, (nn.Linear, nn.Conv1D, nn.Conv2D,
-                                  nn.Conv3D)):
+                                  nn.Conv3D) + extra_types) and \
+                type(layer).__name__ not in extra_names:
             continue
-        yield w
+        yield layer, w
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
@@ -79,8 +102,13 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     import jax.numpy as jnp
 
     out = {}
-    for w in _prunable(model):
-        mask = _nm_mask(np.asarray(w._array), n, m)
+    for layer, w in _prunable(model):
+        pruner = _custom_pruner(layer)
+        if pruner is not None:
+            # registered custom pruning function computes the mask
+            mask = np.asarray(pruner(np.asarray(w._array), n, m))
+        else:
+            mask = _nm_mask(np.asarray(w._array), n, m)
         jmask = jnp.asarray(mask, w._array.dtype)
         w._array = w._array * jmask
         if with_mask:

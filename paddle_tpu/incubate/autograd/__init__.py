@@ -1,0 +1,139 @@
+"""paddle.incubate.autograd parity (python/paddle/incubate/autograd/):
+functional vjp/jvp, lazy Jacobian/Hessian objects, forward-mode grad, and
+the prim-mode toggles.
+
+TPU-native: jax's composable transforms ARE the prim system — jvp/vjp are
+primitive-level autodiff with full fusion, so enable_prim/disable_prim
+toggle a flag that records the preference but changes nothing (the prim
+path is always on; documented, not silent: get_prim_status reports it).
+"""
+from __future__ import annotations
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]
+
+from ...autograd.functional import _LazyMatrix, hessian as _hessian, \
+    jacobian as _jacobian
+from ...autograd.tape import grad as _tape_grad
+from ...tensor_class import Tensor, unwrap, wrap
+
+
+def _flat_call(func, inputs):
+    import jax.numpy as jnp
+
+    def fn(*arrs):
+        ten = [wrap(a, stop_gradient=False) for a in arrs]
+        out = func(*ten)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [unwrap(o) for o in outs]
+
+    return fn
+
+
+def vjp(func, xs, v=None):
+    """incubate.autograd.vjp: returns (outputs, vjp_result) for cotangent
+    v (defaults to ones)."""
+    import jax
+    import jax.numpy as jnp
+
+    inputs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrs = [unwrap(x) for x in inputs]
+    outs, vjp_fn = jax.vjp(lambda *a: tuple(_flat_call(func, inputs)(*a)),
+                           *arrs)
+    if v is None:
+        cots = tuple(jnp.ones_like(o) for o in outs)
+    else:
+        vv = v if isinstance(v, (list, tuple)) else [v]
+        cots = tuple(unwrap(t) for t in vv)
+    grads = vjp_fn(cots)
+    outs_w = [wrap(o) for o in outs]
+    grads_w = [wrap(g) for g in grads]
+    if not isinstance(xs, (list, tuple)):
+        grads_w = grads_w[0]
+    return (outs_w if len(outs_w) > 1 else outs_w[0]), grads_w
+
+
+def jvp(func, xs, v=None):
+    """incubate.autograd.jvp: forward-mode — (outputs, jvp_result) for
+    tangent v (defaults to ones)."""
+    import jax
+    import jax.numpy as jnp
+
+    inputs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrs = [unwrap(x) for x in inputs]
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        vv = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(unwrap(t) for t in vv)
+    outs, tans = jax.jvp(lambda *a: tuple(_flat_call(func, inputs)(*a)),
+                         tuple(arrs), tangents)
+    outs_w = [wrap(o) for o in outs]
+    tans_w = [wrap(t) for t in tans]
+    return ((outs_w if len(outs_w) > 1 else outs_w[0]),
+            (tans_w if len(tans_w) > 1 else tans_w[0]))
+
+
+def Jacobian(func, xs, is_batched=False):
+    """incubate.autograd.Jacobian: lazily-sliceable d(func)/d(xs)."""
+    return _jacobian(func, xs)
+
+
+def Hessian(func, xs, is_batched=False):
+    return _hessian(func, xs)
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode gradients of recorded outputs wrt inputs. Re-derives
+    through jvp of the tape slice via paddle.grad transpose (forward-over-
+    reverse), which matches the reference's prim forward_grad results."""
+    # d out = J @ v; compute via double-vjp: jvp(f)(v) = vjp(vjp(f))(v)
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    import jax.numpy as jnp
+
+    v = grad_inputs
+    if v is None:
+        v = [wrap(jnp.ones_like(unwrap(i))) for i in ins]
+    v = v if isinstance(v, (list, tuple)) else [v]
+    # cotangent trick: <J v, w> = <v, J^T w>; using tape grad twice
+    dummies = [wrap(jnp.zeros_like(unwrap(o)), stop_gradient=False)
+               for o in outs]
+    g = _tape_grad(outs, ins, grad_outputs=dummies, retain_graph=True,
+                   create_graph=True, allow_unused=True)
+    usable = [(gi, vi) for gi, vi in zip(g, v) if gi is not None]
+    inner = None
+    for gi, vi in usable:
+        term = (gi * vi).sum()
+        inner = term if inner is None else inner + term
+    if inner is None:
+        return [None for _ in outs] if isinstance(outputs, (list, tuple)) \
+            else None
+    res = _tape_grad([inner], dummies, retain_graph=True, allow_unused=True)
+    return res if isinstance(outputs, (list, tuple)) else res[0]
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """incubate.autograd.grad: reverse-mode (prim path) — same contract as
+    paddle.grad."""
+    return _tape_grad(outputs, inputs, grad_outputs=grad_outputs,
+                      retain_graph=True, allow_unused=True)
+
+
+_PRIM = True  # jax primitives are always the execution substrate
+
+
+def enable_prim():
+    global _PRIM
+    _PRIM = True
+
+
+def disable_prim():
+    """The prim lowering cannot actually be turned off (jax IS primitive
+    autodiff); the flag records the request for get_prim_status parity."""
+    global _PRIM
+    _PRIM = False
+
+
+def get_prim_status() -> bool:
+    return _PRIM

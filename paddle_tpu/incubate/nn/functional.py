@@ -267,3 +267,148 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
 
     return apply("memory_efficient_attention", fn, query, key, value,
                  attn_bias)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """incubate.nn.functional.fused_matmul_bias: matmul+bias in one op
+    (XLA fuses the epilogue onto the MXU). Delegates to fused_linear —
+    one epilogue implementation to maintain — adding the transpose_x
+    handling that fused_linear lacks."""
+    if transpose_x:
+        from ...ops.manipulation import swapaxes
+
+        x = swapaxes(x, -1, -2)
+    return fused_linear(x, y, bias, transpose_weight=transpose_y)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode=
+        "upscale_in_train", name=None):
+    """Functional face of FusedBiasDropoutResidualLayerNorm:
+    layer_norm(residual + dropout(x + bias))."""
+    import paddle_tpu as paddle
+
+    h = x if bias is None else x + bias
+    h = paddle.nn.functional.dropout(h, dropout_rate, training=training,
+                                     mode=mode)
+    h = residual + h
+    d = h.shape[-1]
+    return paddle.nn.functional.layer_norm(h, [d], weight=ln_scale,
+                                           bias=ln_bias, epsilon=ln_epsilon)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """incubate.nn.functional.fused_dropout_add: dropout(x) + y."""
+    import paddle_tpu as paddle
+
+    return paddle.nn.functional.dropout(x, p, training=training,
+                                        mode=mode) + y
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, attn_mask=None,
+                            caches=None, epsilon=1e-5, num_heads=None,
+                            normalize_before=True, dropout_rate=0.0,
+                            training=False, activation="gelu", **kwargs):
+    """incubate.nn.functional.fused_multi_transformer: a serving-style
+    stack of transformer blocks given flat per-layer weight lists (the
+    fused_multi_transformer op's calling convention). Pre-LN or post-LN;
+    attn_mask runs the masked SDPA path; incremental KV caches are not
+    implemented here (use paddle.Model.generate / generation.py, which
+    owns the jitted cache machinery) and raise loudly."""
+    import paddle_tpu as paddle
+
+    if caches is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer: incremental caches are served by "
+            "generation.py's jitted static-KV decode; call that path")
+    if num_heads is None:
+        raise ValueError(
+            "fused_multi_transformer: num_heads is required (the flat "
+            "[hidden, 3*hidden] qkv layout cannot disambiguate heads)")
+    F = paddle.nn.functional
+    h = x
+    n_layers = len(qkv_weights)
+    for i in range(n_layers):
+        # attention block
+        a = F.layer_norm(h, [h.shape[-1]], weight=ln_scales[i],
+                         bias=ln_biases[i], epsilon=epsilon) \
+            if normalize_before else h
+        qkv = fused_matmul_bias(a, qkv_weights[i], qkv_biases[i])
+        B, S, three_hd = unwrap(qkv).shape
+        nh = num_heads
+        hd = three_hd // (3 * nh)
+        qkv5 = qkv.reshape([B, S, 3, nh, hd])
+        q, k, v = qkv5[:, :, 0], qkv5[:, :, 1], qkv5[:, :, 2]
+        if attn_mask is not None:
+            attn = memory_efficient_attention(q, k, v, attn_bias=attn_mask,
+                                              p=dropout_rate,
+                                              training=training)
+        else:
+            attn, _ = F.flash_attention(q, k, v, causal=True,
+                                        dropout=dropout_rate,
+                                        training=training)
+        attn = attn.reshape([B, S, nh * hd])
+        res = h + fused_matmul_bias(attn, linear_weights[i],
+                                    linear_biases[i])
+        h = res if normalize_before else F.layer_norm(
+            res, [res.shape[-1]], weight=ln_scales[i], bias=ln_biases[i],
+            epsilon=epsilon)
+        # ffn block
+        f = F.layer_norm(h, [h.shape[-1]], weight=ffn_ln_scales[i],
+                         bias=ffn_ln_biases[i], epsilon=epsilon) \
+            if normalize_before else h
+        f = fused_matmul_bias(f, ffn1_weights[i], ffn1_biases[i])
+        f = getattr(F, activation)(f)
+        res = h + fused_matmul_bias(f, ffn2_weights[i], ffn2_biases[i])
+        h = res if normalize_before else F.layer_norm(
+            res, [res.shape[-1]], weight=ffn_ln_scales[i],
+            bias=ffn_ln_biases[i], epsilon=epsilon)
+    return h
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0):
+    """incubate.nn.functional.variable_length_memory_efficient_attention:
+    per-sample lengths [B] over padded [B, H, S, D] inputs — length masking
+    composed with the SDPA/flash path."""
+    import paddle_tpu as paddle
+
+    import numpy as np
+
+    from ...tensor_class import Tensor
+
+    q, k, v = unwrap(query), unwrap(key), unwrap(value)
+    B, H, S, D = q.shape
+
+    def lens_of(t):
+        return unwrap(t) if isinstance(t, Tensor) \
+            else jnp.asarray(np.asarray(t))
+
+    kl = lens_of(kv_seq_lens)
+    ql = lens_of(seq_lens)
+    s = scale if scale is not None else 1.0 / (D ** 0.5)
+    scores = (q @ jnp.swapaxes(k, -1, -2)) * s
+    neg = jnp.asarray(-1e9, scores.dtype)
+    key_ok = jnp.arange(S)[None, :] < kl.reshape(-1, 1)   # [B, S_k]
+    scores = jnp.where(key_ok[:, None, None, :], scores, neg)
+    if causal:
+        tri = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(tri[None, None], scores, neg)
+    if mask is not None:
+        scores = scores + unwrap(mask)
+    out = jax.nn.softmax(scores, -1) @ v
+    # padded QUERY rows produce zeros (reference varlen semantics)
+    q_ok = (jnp.arange(S)[None, :] < ql.reshape(-1, 1))   # [B, S_q]
+    from ...tensor_class import wrap
+
+    return wrap(out * q_ok[:, None, :, None].astype(out.dtype))
+
+
+

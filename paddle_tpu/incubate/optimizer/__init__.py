@@ -1,0 +1,7 @@
+"""paddle.incubate.optimizer parity: LBFGS graduated to paddle.optimizer
+in this build; re-exported here under its incubate name."""
+from ...optimizer import LBFGS  # noqa: F401
+
+from . import functional  # noqa: F401
+
+__all__ = ["LBFGS"]

@@ -1,0 +1,104 @@
+"""paddle.incubate.optimizer.functional parity: functional BFGS/L-BFGS
+minimizers (python/paddle/incubate/optimizer/functional/bfgs.py,
+lbfgs.py). Pure functions: objective in, (converged, iters, x*, f*, g*)
+out — the line-search loop runs host-side on concrete values (both
+reference implementations use a while_loop the same way). The line search
+is Armijo backtracking bounded by max_line_search_iters (a sufficient-
+decrease subset of the reference's strong-Wolfe search).
+"""
+from __future__ import annotations
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _minimize(objective_func, initial_position, history_size, max_iters,
+              tolerance_grad, tolerance_change, initial_step_length, dtype,
+              max_line_search_iters=50):
+    import jax
+    import jax.numpy as jnp
+
+    from ....tensor_class import unwrap, wrap
+
+    val_and_grad = jax.value_and_grad(
+        lambda x: jnp.asarray(unwrap(objective_func(wrap(x)))).reshape(()))
+    x = jnp.asarray(unwrap(initial_position)).astype(dtype)
+    f, g = val_and_grad(x)
+    s_hist, y_hist = [], []
+    n_iter = 0
+    converged = False
+    for n_iter in range(1, max_iters + 1):
+        if float(jnp.abs(g).max()) <= tolerance_grad:
+            converged = True
+            break
+        # two-loop recursion (BFGS keeps full history = same recursion)
+        q = g.reshape(-1)
+        alphas = []
+        for s, y in zip(reversed(s_hist), reversed(y_hist)):
+            rho = 1.0 / float(jnp.dot(y, s))
+            a = rho * float(jnp.dot(s, q))
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if y_hist:
+            gamma = float(jnp.dot(s_hist[-1], y_hist[-1])
+                          / jnp.maximum(jnp.dot(y_hist[-1], y_hist[-1]),
+                                        1e-12))
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(jnp.dot(y, q))
+            q = q + (a - b) * s
+        direction = -q.reshape(x.shape)
+        # Armijo backtracking
+        t = initial_step_length
+        gd = float(jnp.vdot(g, direction))
+        accepted = False
+        for _ in range(max_line_search_iters):
+            x_new = x + t * direction
+            f_new, g_new = val_and_grad(x_new)
+            if float(f_new) <= float(f) + 1e-4 * t * gd:
+                accepted = True
+                break
+            t *= 0.5
+        if not accepted:
+            break
+        s_vec = (x_new - x).reshape(-1)
+        y_vec = (g_new - g).reshape(-1)
+        if float(jnp.dot(s_vec, y_vec)) > 1e-10:
+            s_hist.append(s_vec)
+            y_hist.append(y_vec)
+            if history_size and len(s_hist) > history_size:
+                s_hist.pop(0)
+                y_hist.pop(0)
+        if float(jnp.abs(x_new - x).max()) <= tolerance_change:
+            x, f, g = x_new, f_new, g_new
+            converged = True
+            break
+        x, f, g = x_new, f_new, g_new
+    return (wrap(jnp.asarray(converged)), wrap(jnp.asarray(n_iter)),
+            wrap(x), wrap(f), wrap(g))
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    """paddle.incubate.optimizer.functional.minimize_bfgs parity (full
+    history — no window cap)."""
+    return _minimize(objective_func, initial_position, history_size=0,
+                     max_iters=max_iters, tolerance_grad=tolerance_grad,
+                     tolerance_change=tolerance_change,
+                     initial_step_length=initial_step_length, dtype=dtype,
+                     max_line_search_iters=max_line_search_iters)
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7, tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    return _minimize(objective_func, initial_position,
+                     history_size=history_size, max_iters=max_iters,
+                     tolerance_grad=tolerance_grad,
+                     tolerance_change=tolerance_change,
+                     initial_step_length=initial_step_length, dtype=dtype,
+                     max_line_search_iters=max_line_search_iters)

@@ -958,3 +958,47 @@ def test_sparse_conv2d_and_new_packages():
     open(s1, "w").write('extern "C" int f1() { return 21; }')
     mods = ce.setup(name="one_ext", ext_modules=[ce.CppExtension([s1])])
     assert mods["one_ext"].f1() == 21
+
+
+def test_incubate_autograd_and_minimizers():
+    """incubate.autograd vjp/jvp/Jacobian/forward_grad (forward-over-
+    reverse) + functional BFGS/L-BFGS minimizers + fused functional tail."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate as inc
+
+    paddle.seed(0)
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    _, g = inc.autograd.vjp(lambda t: (t ** 2).sum(), x)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0], rtol=1e-6)
+    _, t = inc.autograd.jvp(lambda t: (t ** 2).sum(), x,
+                            v=paddle.to_tensor(
+                                np.array([1.0, 0.0], "float32")))
+    assert abs(float(t.numpy()) - 2.0) < 1e-6
+    xt = paddle.to_tensor(np.array([1.0, 2.0], "float32"),
+                          stop_gradient=False)
+    fg = inc.autograd.forward_grad(xt ** 2, xt)
+    np.testing.assert_allclose(fg.numpy(), [2.0, 4.0], rtol=1e-5)
+
+    target = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    for minimize in (inc.optimizer.functional.minimize_lbfgs,
+                     inc.optimizer.functional.minimize_bfgs):
+        conv, iters, xs, fx, gx = minimize(
+            lambda t: ((t - target) ** 2).sum(),
+            paddle.to_tensor(np.array([5.0, -3.0], "float32")))
+        assert bool(conv.numpy())
+        np.testing.assert_allclose(xs.numpy(), [1.0, 2.0], atol=1e-3)
+
+    F = inc.nn.functional
+    a = paddle.randn([2, 4])
+    w = paddle.randn([4, 3])
+    b = paddle.randn([3])
+    np.testing.assert_allclose(
+        F.fused_matmul_bias(a, w, b).numpy(),
+        a.numpy() @ w.numpy() + b.numpy(), rtol=1e-5, atol=1e-6)
+    vm = F.variable_length_memory_efficient_attention(
+        paddle.randn([2, 2, 5, 8]), paddle.randn([2, 2, 5, 8]),
+        paddle.randn([2, 2, 5, 8]), paddle.to_tensor(np.array([5, 3])),
+        paddle.to_tensor(np.array([5, 3])))
+    assert vm.shape == [2, 2, 5, 8]
