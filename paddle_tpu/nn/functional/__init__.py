@@ -25,8 +25,11 @@ from .loss import (  # noqa: F401
     sigmoid_focal_loss, ctc_loss,
 )
 from .attention import (  # noqa: F401
-    scaled_dot_product_attention, flash_attention,
+    scaled_dot_product_attention,
 )
+# flash_attention is a MODULE in the reference layout (and callable here
+# for backward compatibility) — import last so the module wins the name
+from . import flash_attention  # noqa: F401
 from ...ops.manipulation import pad  # noqa: F401  (F.pad parity)
 from ...ops import schema as _schema  # noqa: E402
 

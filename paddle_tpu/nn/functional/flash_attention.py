@@ -1,0 +1,28 @@
+"""paddle.nn.functional.flash_attention as a MODULE (reference layout:
+python/paddle/nn/functional/flash_attention.py — users import the
+functions from this path). The module is additionally callable, forwarding
+to the flash_attention function, so code written against this build's
+earlier function-valued ``F.flash_attention`` keeps working.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+from .attention import (  # noqa: F401
+    flash_attention, flash_attn_unpadded, scaled_dot_product_attention,
+    sdp_kernel)
+from .extra import (  # noqa: F401
+    flash_attn_qkvpacked, flash_attn_varlen_qkvpacked, flashmask_attention)
+
+__all__ = ["flash_attention", "flash_attn_unpadded", "flash_attn_qkvpacked",
+           "flash_attn_varlen_qkvpacked", "flashmask_attention",
+           "scaled_dot_product_attention", "sdp_kernel"]
+
+
+class _CallableModule(types.ModuleType):
+    def __call__(self, *args, **kwargs):
+        return flash_attention(*args, **kwargs)
+
+
+sys.modules[__name__].__class__ = _CallableModule
