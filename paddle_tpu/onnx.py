@@ -341,6 +341,37 @@ def _convert_node(g: _GraphBuilder, node, args, kwargs, in_names, arrs,
         dt = args[1] if len(args) > 1 else kwargs.get("dtype")
         g.emit("Cast", in_names[:1], out_ids,
                _attr_field(_attr_int("to", _DTYPE.get(str(dt), 1))))
+    elif op == "embedding":
+        # Gather over axis 0: weight rows indexed by ids
+        # recorded as (ids, weight) → ONNX Gather(data=weight, indices=ids)
+        g.emit("Gather", [in_names[1], in_names[0]], out_ids,
+               _attr_field(_attr_int("axis", 0)))
+    elif op == "rms_norm":
+        # decomposition: x / sqrt(mean(x^2) + eps) [* w]
+        cv = _closure_vars(node.fn)
+        eps = float(cv.get("epsilon", 1e-6))
+        x_name = in_names[0]
+        dt = str(arrs[0].dtype)
+        sq = g.fresh("mul")
+        g.nodes.append(_node("Mul", [x_name, x_name], [sq]))
+        mean = g.fresh("reducemean")
+        g.nodes.append(_node(
+            "ReduceMean", [sq], [mean],
+            _attr_field(_attr_ints("axes", [-1]))
+            + _attr_field(_attr_int("keepdims", 1))))
+        eps_c = g.fresh("const")
+        g.initializers.append(_tensor_proto(eps_c,
+                                            np.asarray(eps).astype(dt)))
+        pe = g.fresh("add")
+        g.nodes.append(_node("Add", [mean, eps_c], [pe]))
+        rt = g.fresh("sqrt")
+        g.nodes.append(_node("Sqrt", [pe], [rt]))
+        normed = g.fresh("div")
+        g.nodes.append(_node("Div", [x_name, rt], [normed]))
+        if len(in_names) > 1:
+            g.emit("Mul", [normed, in_names[1]], out_ids)
+        else:
+            g.names[out_ids[0]] = normed
     elif op == "dropout":
         cv = _closure_vars(node.fn)
         p = cv.get("p")

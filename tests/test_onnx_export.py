@@ -187,3 +187,34 @@ def test_closure_attr_extraction(tmp_path):
                      input_spec=[InputSpec([2, 3, 4], "float32")])
     ops8 = [_parse(n)[4][0].decode() for n in _graph_of(p8)[1]]
     assert ops8 == ["Reshape", "MatMul", "Add"]
+
+
+def test_tiny_lm_export_with_embedding_and_rmsnorm(tmp_path):
+    """Embedding → Gather, rms_norm → Mul/ReduceMean/Add/Sqrt/Div chain —
+    a minimal language-model head exports end-to-end."""
+
+    class TinyLM(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(50, 16)
+            self.rms_w = paddle.create_parameter([16], "float32")
+            self.head = nn.Linear(16, 50)
+
+        def forward(self, ids):
+            h = self.emb(ids)
+            h = nn.functional.rms_norm(h, self.rms_w)
+            return nn.functional.softmax(self.head(h), axis=-1)
+
+    paddle.seed(0)
+    m = TinyLM()
+    m.eval()
+    p = onnx.export(m, str(tmp_path / "lm"),
+                    input_spec=[InputSpec([1, 6], "int64")])
+    g = _graph_of(p)
+    ops = [_parse(n)[4][0].decode() for n in g[1]]
+    assert ops == ["Gather", "Mul", "ReduceMean", "Add", "Sqrt", "Div",
+                   "Mul", "MatMul", "Add", "Softmax"]
+    # embedding table rides as an initializer with the right shape
+    inits = [_parse(t) for t in g.get(5, [])]
+    shapes = [tuple(t.get(1, [])) for t in inits]
+    assert (50, 16) in shapes
