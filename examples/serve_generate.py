@@ -1,0 +1,39 @@
+"""Serving: batched greedy/sampled decode with the jitted KV cache.
+
+Run: JAX_PLATFORMS=cpu python examples/serve_generate.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # honor an explicit CPU request at config level (a TPU-tunnel
+    # sitecustomize may override the env var after import)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    model = LlamaForCausalLM(cfg)
+    prompts = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (2, 8)))
+    # greedy, static-KV jitted decode
+    out = model.generate(prompts, max_new_tokens=8)
+    print("greedy:", out.shape, out.numpy()[0][-8:])
+    # nucleus sampling
+    out2 = model.generate(prompts, max_new_tokens=8, do_sample=True,
+                          top_p=0.9, temperature=0.8)
+    print("sampled:", out2.shape)
+
+
+if __name__ == "__main__":
+    main()
