@@ -54,13 +54,18 @@ def _rope_rows(x, cos, sin, row_pos):
 
 
 def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
-                     row_pos=None):
+                     row_pos=None, use_flash=False, interpret=False):
     """RoPE + cache write + masked GQA attention against a dense buffer.
 
     q [B,S,H,D]; k/v [B,S,hk,D]; cos/sin [>=max_len, D];
     k_buf/v_buf [B,Smax,hk,D]; pos = buffer write offset (scalar);
     allowed = optional [B,Tmax] column-validity mask (padded prompts);
-    row_pos = optional [B] per-row RoPE positions (ragged batches).
+    row_pos = optional [B] per-row RoPE positions (ragged batches);
+    use_flash = route an unpadded pos=0 prefill (the serving hot path)
+    through the GQA splash flash kernel instead of the dense einsum against
+    the whole buffer — at pos=0 prefill, causal attention over the prompt
+    equals causal self-attention on the S new tokens, so the flash kernel
+    is exact and never touches the (mostly empty) Smax buffer.
     Returns (out [B,S,H,D], new_k_buf, new_v_buf).
     """
     from .ops.pallas.fused_norm import rope_ref
@@ -80,6 +85,18 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
         k_buf, k.astype(k_buf.dtype), (0, pos, 0, 0))
     v_buf = jax.lax.dynamic_update_slice(
         v_buf, v.astype(v_buf.dtype), (0, pos, 0, 0))
+
+    if use_flash and S > 1 and allowed is None and row_pos is None:
+        from .ops.pallas import flash_attention as pf
+
+        try:
+            pos_is_zero = int(pos) == 0  # eager prefill: concrete scalar
+        except Exception:
+            pos_is_zero = False  # traced offset: unknown, stay dense
+        if pos_is_zero and pf.supported(q, k, v, interpret=interpret):
+            out = pf.flash_attention_bshd(q, k, v, causal=True,
+                                          interpret=interpret)
+            return out.astype(q.dtype), k_buf, v_buf
 
     g = H // hk
     scale = 1.0 / math.sqrt(D)

@@ -169,7 +169,8 @@ class LlamaAttention(Layer):
             out, k_buf, v_buf = apply(
                 "llama_attention_cached", cached_attention, q, k, v, cos, sin,
                 kv_cache["k"], kv_cache["v"], kv_cache["pos"],
-                kv_cache.get("allowed"), kv_cache.get("row_pos"))
+                kv_cache.get("allowed"), kv_cache.get("row_pos"),
+                use_flash=cfg.use_flash_attention)
             result = self.o_proj(out.reshape([b, s, h * d]))
             new = {"k": k_buf, "v": v_buf, "pos": kv_cache["pos"] + s}
             if "allowed" in kv_cache:
