@@ -83,6 +83,16 @@ def next_key():
     return sub
 
 
+def host_rng():
+    """Host-side numpy Generator derived from the framework key stream, so
+    host-eager sampling ops (graph sampling, class_center_sample) are
+    reproducible under paddle.seed like device ops."""
+    import numpy as np
+
+    key_data = np.asarray(jax.random.key_data(next_key()))
+    return np.random.default_rng(int(key_data.reshape(-1)[-1]) & 0x7FFFFFFF)
+
+
 class RNGStatesTracker:
     """Named RNG streams, parity with the reference's mpu RNGStatesTracker
     (fleet/layers/mpu/random.py): tensor-parallel dropout needs one stream

@@ -121,16 +121,9 @@ def _np_of(t):
 
 
 def _host_rng():
-    """Host-side numpy RNG derived from the framework key stream, so
-    paddle.seed(k) makes graph sampling reproducible like device ops."""
-    import numpy as np
+    from .framework.random import host_rng
 
-    import jax
-
-    from .framework import random as _random
-
-    key_data = np.asarray(jax.random.key_data(_random.next_key()))
-    return np.random.default_rng(int(key_data.reshape(-1)[-1]) & 0x7FFFFFFF)
+    return host_rng()
 
 
 def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,

@@ -71,13 +71,14 @@ def identity_loss(x, reduction="none", name=None):
     from ..ops.registry import apply
     import jax.numpy as jnp
 
-    red = {"none": 2, "sum": 1, "mean": 0}.get(reduction, reduction)
+    # reference op semantics (ops.yaml identity_loss): 0=sum, 1=mean, 2=none
+    red = {"sum": 0, "mean": 1, "none": 2}.get(reduction, reduction)
 
     def fn(a):
         if red == 0:
-            return a.mean()
-        if red == 1:
             return a.sum()
+        if red == 1:
+            return a.mean()
         return a
 
     return apply("identity_loss", fn, x)
@@ -183,11 +184,13 @@ class LookAhead:
 
         from ..tensor_class import unwrap
 
-        self.inner_optimizer.step()
-        self._step += 1
+        # capture the slow weights from the INITIAL parameters (before the
+        # first inner step), matching the reference algorithm's phi_0
         if self._slow is None:
             self._slow = [unwrap(p).astype(jnp.float32)
                           for p in self._params()]
+        self.inner_optimizer.step()
+        self._step += 1
         if self._step % self.k == 0:
             for i, p in enumerate(self._params()):
                 fast = unwrap(p).astype(jnp.float32)

@@ -116,8 +116,11 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     if pos.size >= num_samples:
         sampled = pos
     else:
+        from ...framework.random import host_rng
+
         rest = np.setdiff1d(np.arange(num_classes), pos, assume_unique=True)
-        rng = np.random.default_rng(int(lab.sum()) + num_classes)
+        rng = host_rng()  # framework key stream: fresh negatives per call,
+        # reproducible under paddle.seed (reference draws fresh per call)
         extra = rng.choice(rest, size=num_samples - pos.size, replace=False)
         sampled = np.sort(np.concatenate([pos, extra]))
     remap = -np.ones((num_classes,), np.int64)

@@ -263,14 +263,25 @@ def _convert_node(g: _GraphBuilder, node, args, kwargs, in_names, arrs,
         g.emit("Reshape", [in_names[0], sh], out_ids)
     elif op == "flatten":
         # paddle flatten is rank-preserving outside [start, stop]; ONNX
-        # Flatten is always 2-D — lower as Reshape to the traced out shape
+        # Flatten is always 2-D — lower as Reshape. Leading dims use the
+        # 0-wildcard (copy from input) and the flattened run uses -1, so a
+        # dynamic batch dim (traced at size 1) is NOT baked into the graph;
+        # only dims after stop_axis keep their traced concrete sizes.
         oshape = shapes.get(out_ids[0])
         if oshape is None:
             raise NotImplementedError("onnx export: flatten output shape "
                                       "unknown")
+        cv = _closure_vars(node.fn)
+        ishape = shapes.get(node.in_ids[0]) if node.in_ids else None
+        if cv.get("start_axis") is not None and ishape:
+            nd = len(ishape)
+            s = cv["start_axis"] % nd if nd else 0
+            target = [0] * s + [-1] + [int(d) for d in oshape[s + 1:]]
+        else:
+            target = [int(d) for d in oshape]
         sh = g.fresh("shape_const")
         g.initializers.append(_tensor_proto(
-            sh, np.asarray(list(oshape), np.int64)))
+            sh, np.asarray(target, np.int64)))
         g.emit("Reshape", [in_names[0], sh], out_ids)
     elif op == "transpose":
         perm = _closure_vars(node.fn).get("perm")

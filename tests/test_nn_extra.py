@@ -340,3 +340,35 @@ def test_flash_attn_unpadded_matches_sdpa():
         seg.append(np.moveaxis(p @ qs, 1, 2)[0])
     np.testing.assert_allclose(out.numpy(), np.concatenate(seg, 0),
                                atol=2e-3)
+
+
+def test_class_center_sample_negatives_use_seed_stream():
+    """Negative sampling draws from the framework key stream (ADVICE r3):
+    fresh negatives per call, reproducible under paddle.seed — not a
+    deterministic function of the label batch."""
+    paddle.seed(0)
+    lab = paddle.to_tensor(np.array([2, 2, 8, 5]))
+    _, c1 = F.class_center_sample(lab, 50, 10)
+    _, c2 = F.class_center_sample(lab, 50, 10)
+    assert not np.array_equal(c1.numpy(), c2.numpy())  # fresh per call
+    paddle.seed(0)
+    _, c1b = F.class_center_sample(lab, 50, 10)
+    _, c2b = F.class_center_sample(lab, 50, 10)
+    np.testing.assert_array_equal(c1.numpy(), c1b.numpy())  # reproducible
+    np.testing.assert_array_equal(c2.numpy(), c2b.numpy())
+
+
+def test_lookahead_first_sync_anchors_initial_weights():
+    """LookAhead's slow weights are the INITIAL params (ADVICE r3): with
+    k=1, alpha=0.5, w0=4, lr=0.1 on loss=w^2 the first sync lands at
+    4 + 0.5*((4 - 0.1*8) - 4) = 3.6 — not 3.2 (slow captured post-step)."""
+    import paddle_tpu.incubate as inc
+    import paddle_tpu.optimizer as opt
+
+    wp = paddle.Parameter(np.array([4.0], dtype="float32"))
+    la = inc.LookAhead(opt.SGD(0.1, parameters=[wp]), alpha=0.5, k=1)
+    loss = (wp ** 2).sum()
+    loss.backward()
+    la.step()
+    la.clear_grad()
+    assert abs(float(wp.numpy()[0]) - 3.6) < 1e-5

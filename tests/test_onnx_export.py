@@ -218,3 +218,17 @@ def test_tiny_lm_export_with_embedding_and_rmsnorm(tmp_path):
     inits = [_parse(t) for t in g.get(5, [])]
     shapes = [tuple(t.get(1, [])) for t in inits]
     assert (50, 16) in shapes
+
+
+def test_flatten_dynamic_batch_reshape_wildcards(tmp_path):
+    """flatten with a dynamic batch dim lowers to Reshape [0, -1] (ONNX
+    wildcards), not the traced concrete shape — the exported graph must be
+    valid at any batch size, not just the traced one (ADVICE r3)."""
+    net = nn.Sequential(nn.Flatten(1), nn.Linear(12, 2))
+    p = onnx.export(net, str(tmp_path / "mflat"),
+                    input_spec=[InputSpec([None, 3, 4], "float32")])
+    g = _graph_of(p)
+    inits = {_parse(t)[8][0].decode(): _parse(t) for t in g.get(5, [])}
+    shape_c = next(v for k, v in inits.items() if k.startswith("shape_const"))
+    target = np.frombuffer(shape_c[9][0], np.int64)
+    np.testing.assert_array_equal(target, [0, -1])

@@ -1002,3 +1002,16 @@ def test_incubate_autograd_and_minimizers():
         paddle.randn([2, 2, 5, 8]), paddle.to_tensor(np.array([5, 3])),
         paddle.to_tensor(np.array([5, 3])))
     assert vm.shape == [2, 2, 5, 8]
+
+
+def test_identity_loss_reduction_codes():
+    """identity_loss reference semantics (ADVICE r3): 0=sum, 1=mean,
+    2=none, matching the string forms."""
+    import paddle_tpu.incubate as inc
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    for red, want in [("sum", 6.0), (0, 6.0), ("mean", 2.0), (1, 2.0)]:
+        assert float(inc.identity_loss(x, red).numpy()) == want
+    for red in ("none", 2):
+        np.testing.assert_array_equal(inc.identity_loss(x, red).numpy(),
+                                      x.numpy())
