@@ -239,8 +239,15 @@ class ShardingStage3:
         self.axis_name = axis_name
         self.mesh = mesh
 
-    def apply(self, layer):
+    def apply(self, layer, seen=None):
+        """Shard every sublayer's params; ``seen`` (a set of sublayer ids)
+        lets repeated calls skip already-rewritten sublayers — pipeline
+        stages sharing a tied layer keep its first placement."""
         for _, sub in layer.named_sublayers(include_self=True):
+            if seen is not None:
+                if id(sub) in seen:
+                    continue
+                seen.add(id(sub))
             for pname, p in list(sub._parameters.items()):
                 if p is None or p.ndim == 0:
                     continue

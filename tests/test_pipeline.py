@@ -379,3 +379,189 @@ class TestReviewRegressions:
                                     sorted(ref.state_dict().items())):
             np.testing.assert_allclose(np.asarray(p._array), np.asarray(p2._array),
                                        rtol=3e-5, atol=3e-6)
+
+
+class TestHybridMeshPP:
+    """PP fused with the other parallel axes on ONE 5-axis mesh (VERDICT r3
+    item 2; ref topology.py:189 + pipeline_parallel.py:820): each stage owns
+    the (dp, sharding, sep, mp) submesh at its pp coordinate, in-stage
+    TP/FSDP collectives ride GSPMD, activations hop between submeshes."""
+
+    @staticmethod
+    def _tp_descs(width, n_blocks):
+        import paddle_tpu.nn as pnn
+        from paddle_tpu.distributed.parallel_layers import (
+            ColumnParallelLinear, RowParallelLinear)
+
+        class Block(pnn.Layer):
+            def __init__(self, w):
+                super().__init__()
+                self.col = ColumnParallelLinear(w, 2 * w, gather_output=False)
+                self.row = RowParallelLinear(2 * w, w, input_is_parallel=True)
+
+            def forward(self, x):
+                return self.row(self.col(x)) + x
+
+        return [LayerDesc(Block, width) for _ in range(n_blocks)]
+
+    def _run_parity(self, hybrid_configs, schedule, sharding_stage=3,
+                    steps=2, width=16):
+        import paddle_tpu.distributed as dist
+
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = hybrid_configs
+        strategy.sharding_configs = {"stage": sharding_stage}
+        try:
+            dist.fleet.init(is_collective=True, strategy=strategy)
+            paddle.seed(0)
+            pipe = PipelineLayer(self._tp_descs(width, 4),
+                                 num_stages=hybrid_configs["pp_degree"],
+                                 loss_fn=_mse)
+            snap = _snapshot(pipe)
+            pp = dist.fleet.distributed_model(pipe)
+            assert pp._hybrid, "hcg with pp>1 must enter hybrid-mesh mode"
+            # stages must own DISJOINT submeshes covering the whole mesh
+            stage_devsets = [frozenset(d.id for d in pm.jax_mesh().devices.flat)
+                             for pm in pp._stage_meshes]
+            assert len(set(stage_devsets)) == hybrid_configs["pp_degree"]
+            assert not frozenset.intersection(*stage_devsets)
+            pp._schedule = schedule
+            opt_p = SGD(learning_rate=0.1, parameters=pipe.parameters())
+        finally:
+            dist.set_hybrid_communicate_group(None)
+
+        paddle.seed(0)
+        ref = PipelineLayer(self._tp_descs(width, 4),
+                            num_stages=hybrid_configs["pp_degree"],
+                            loss_fn=_mse)
+        _load(ref, snap)
+        opt_r = SGD(learning_rate=0.1, parameters=ref.parameters())
+        rng = np.random.RandomState(0)
+        for _ in range(steps):
+            x = rng.randn(8, width).astype("float32")
+            lbl = rng.randn(8, width).astype("float32")
+            # no ambient hcg needed: stage calls install their stage-local
+            # hcg themselves (_ambient_stage_hcg)
+            loss_p = pp.train_batch(
+                [paddle.to_tensor(x), paddle.to_tensor(lbl)], opt_p)
+            out = ref(paddle.to_tensor(x))
+            loss_r = _mse(out, paddle.to_tensor(lbl))
+            loss_r.backward()
+            opt_r.step()
+            opt_r.clear_grad()
+            np.testing.assert_allclose(float(loss_p), float(loss_r), rtol=1e-5)
+        for (k, p), (k2, p2) in zip(sorted(pipe.state_dict().items()),
+                                    sorted(ref.state_dict().items())):
+            assert k == k2
+            np.testing.assert_allclose(np.asarray(p._array),
+                                       np.asarray(p2._array),
+                                       rtol=2e-5, atol=2e-6)
+
+    def test_pp_mp_sharding_parity_1f1b(self):
+        self._run_parity({"dp_degree": 1, "mp_degree": 2, "pp_degree": 2,
+                          "sharding_degree": 2, "sep_degree": 1}, "1F1B")
+
+    def test_pp_mp_sharding_parity_zbh1(self):
+        self._run_parity({"dp_degree": 1, "mp_degree": 2, "pp_degree": 2,
+                          "sharding_degree": 2, "sep_degree": 1}, "ZBH1")
+
+    def test_pp_dp_mp_parity(self):
+        """dp>1 under PP: batch dim sharded over dp inside each stage."""
+        self._run_parity({"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                          "sharding_degree": 1, "sep_degree": 1}, "1F1B",
+                         sharding_stage=0)
+
+    def test_pp_degree_mismatch_raises(self):
+        import paddle_tpu.distributed as dist
+
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 2, "sharding_degree": 1,
+                                   "sep_degree": 1}
+        try:
+            dist.fleet.init(is_collective=True, strategy=strategy)
+            paddle.seed(0)
+            pipe = PipelineLayer(_make_descs(), num_stages=4, loss_fn=_mse)
+            with pytest.raises(ValueError, match="pp degree"):
+                PipelineParallel(pipe, hcg=dist.get_hybrid_communicate_group())
+        finally:
+            dist.set_hybrid_communicate_group(None)
+
+
+class TestHybridSharedLayers:
+    def test_shared_tied_weights_hybrid_parity(self):
+        """SharedLayerDesc under the hybrid mesh: the tied weight's canonical
+        copy lives on the FIRST stage's submesh; the last stage computes on a
+        transferred replica (train via _stage_state, inference via forward).
+        Loss parity vs single-device, and the tied weight trains."""
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.nn.functional as F
+
+        V, H = 32, 16
+
+        class TiedEmbed(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.weight = self.create_parameter([V, H])
+
+            def forward(self, x):
+                return F.embedding(x, self.weight)
+
+        def head_fwd(layer, h):
+            return paddle.matmul(h, layer.weight, transpose_y=True)
+
+        def make_pipe():
+            return PipelineLayer(
+                [SharedLayerDesc("emb", TiedEmbed),
+                 LayerDesc(nn.Linear, H, H),
+                 LayerDesc(nn.Linear, H, H),
+                 SharedLayerDesc("emb", TiedEmbed, forward_func=head_fwd)],
+                num_stages=2,
+                loss_fn=lambda out, lbl: F.cross_entropy(
+                    out.reshape([-1, V]), lbl.reshape([-1])).mean())
+
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                                   "pp_degree": 2, "sharding_degree": 2,
+                                   "sep_degree": 1}
+        try:
+            dist.fleet.init(is_collective=True, strategy=strategy)
+            paddle.seed(5)
+            pipe = make_pipe()
+            snap = _snapshot(pipe)
+            pp = dist.fleet.distributed_model(pipe)
+            assert pp._hybrid
+            opt_p = SGD(learning_rate=0.05, parameters=pipe.parameters())
+        finally:
+            dist.set_hybrid_communicate_group(None)
+
+        paddle.seed(5)
+        ref = make_pipe()
+        _load(ref, snap)
+        opt_r = SGD(learning_rate=0.05, parameters=ref.parameters())
+
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, V, (4, 6)).astype("int32")
+        lbl = ids.astype("int64")
+        # hybrid inference forward crosses submeshes with the shared replica
+        out_h = pp(paddle.to_tensor(ids))
+        out_r = ref(paddle.to_tensor(ids))
+        np.testing.assert_allclose(np.asarray(out_h.numpy()),
+                                   np.asarray(out_r.numpy()),
+                                   rtol=1e-5, atol=1e-6)
+        for _ in range(2):
+            loss_p = pp.train_batch(
+                [paddle.to_tensor(ids), paddle.to_tensor(lbl)], opt_p)
+            loss_r = ref._loss_fn(ref(paddle.to_tensor(ids)),
+                                  paddle.to_tensor(lbl))
+            loss_r.backward()
+            opt_r.step()
+            opt_r.clear_grad()
+            np.testing.assert_allclose(float(loss_p), float(loss_r),
+                                       rtol=1e-5)
+        for (k, p), (k2, p2) in zip(sorted(pipe.state_dict().items()),
+                                    sorted(ref.state_dict().items())):
+            assert k == k2
+            np.testing.assert_allclose(np.asarray(p._array),
+                                       np.asarray(p2._array),
+                                       rtol=2e-5, atol=2e-6)
