@@ -44,6 +44,7 @@ from .parallel_layers import (
 from .recompute_layer import recompute, RecomputeLayer
 from .watchdog import (Watchdog, enable_watchdog, watchdog_stamp,
                        disable_watchdog)
+from .elastic import ElasticManager, start_elastic, ELASTIC_EXIT_CODE
 from .spawn import spawn
 from .auto_tuner import AutoTuner, TunerConfig
 

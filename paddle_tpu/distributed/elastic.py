@@ -1,0 +1,203 @@
+"""Elastic membership: TCPStore-lease heartbeats + peer-set watch.
+
+Reference parity: ``ElasticManager``
+(python/paddle/distributed/fleet/elastic/manager.py:125) — each node keeps
+an etcd lease alive from a heartbeat thread, a watcher maintains the live
+host set, and a membership mismatch (node lost / joined) triggers a
+coordinated restart; workers resume from their own checkpoints. The
+reference downgrades to ``ElasticLevel.FAULT_TOLERANCE`` (fixed world size)
+when min_np == max_np — the mode implemented here, the one that matters on
+TPU pods where the slice size is fixed.
+
+TPU-native: the lease server is the native TCPStore daemon
+(core/csrc/tcp_store.cpp) instead of etcd. Each worker refreshes
+``{prefix}/node/{rank}`` with a monotonic-clock timestamp every ttl/3; a
+peer is ALIVE while its newest stamp is younger than ttl. Two watchers
+cooperate:
+
+- **worker-side** (``monitor()``): a daemon thread that watches the peer
+  set and hard-exits this process with ``ELASTIC_EXIT_CODE`` when a peer's
+  lease lapses — the survivor's collectives would otherwise block forever
+  on the dead rank, so a thread-level ``os._exit`` is the only reliable
+  unblocking mechanism (the reference kills trainers from the manager for
+  the same reason).
+- **launcher-side** (``stale_ranks()``): the launch controller polls
+  leases from its own client and restarts the incarnation when a worker
+  stops heartbeating WITHOUT exiting (a hung process has no exit code —
+  membership, not process state, is the signal).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Set
+
+ELASTIC_EXIT_CODE = 101  # restart-requested (manager.py ELASTIC_EXIT_CODE analog)
+
+
+class ElasticManager:
+    """Lease registry + peer-set watch over a TCPStore endpoint."""
+
+    def __init__(self, store=None, *, endpoint: Optional[str] = None,
+                 rank: Optional[int] = None, world_size: Optional[int] = None,
+                 ttl: float = 10.0, job_id: str = "default"):
+        if store is None:
+            from .store import TCPStore
+
+            endpoint = endpoint or os.environ.get("PADDLE_ELASTIC_STORE")
+            if endpoint is None:
+                raise ValueError(
+                    "ElasticManager needs a TCPStore or an endpoint "
+                    "(PADDLE_ELASTIC_STORE)")
+            host, port = endpoint.rsplit(":", 1)
+            store = TCPStore(host, int(port), is_master=False,
+                             world_size=world_size or 1)
+        self._store = store
+        self.rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = world_size or int(
+            os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.ttl = float(ttl)
+        self._prefix = f"pd_elastic/{job_id}"
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._watch_thread: Optional[threading.Thread] = None
+
+    # ---- lease --------------------------------------------------------------
+    def _key(self, rank: int) -> str:
+        return f"{self._prefix}/node/{rank}"
+
+    def _beat(self):
+        # epoch + this process's start marker: a RESTARTED rank re-registers
+        # with a fresh stamp, so "alive" is lease freshness, not existence
+        self._store.set(self._key(self.rank), repr(time.time()))
+
+    def register(self):
+        """Start the lease heartbeat (manager.py:251-289 lease_heartbeat)."""
+        if self._hb_thread is not None:
+            return self
+        self._beat()
+
+        def heartbeat():
+            while not self._stop.wait(self.ttl / 3.0):
+                try:
+                    self._beat()
+                except Exception:
+                    pass  # transient store hiccup; next beat retries
+
+        self._hb_thread = threading.Thread(
+            name="elastic-heartbeat", target=heartbeat, daemon=True)
+        self._hb_thread.start()
+        return self
+
+    def stop_heartbeat(self):
+        """Stop refreshing the lease (the test hook for a simulated hang —
+        process alive, membership lapsed)."""
+        self._stop.set()
+
+    def mark_done(self):
+        """Deregister on CLEAN exit: peers must not confuse a finished
+        rank's silent lease with a hang (manager.py exit(completed=True))."""
+        try:
+            self._store.set(f"{self._prefix}/done/{self.rank}", b"1")
+        except Exception:
+            pass
+        self._stop.set()
+
+    def _is_done(self, rank: int) -> bool:
+        try:
+            self._store.get(f"{self._prefix}/done/{rank}", timeout=0.2)
+            return True
+        except Exception:
+            return False
+
+    # ---- peer view ----------------------------------------------------------
+    def _stamp(self, rank: int) -> Optional[float]:
+        try:
+            return float(self._store.get(self._key(rank), timeout=0.2))
+        except Exception:
+            return None
+
+    def alive_ranks(self) -> Set[int]:
+        now = time.time()
+        out = set()
+        for r in range(self.world_size):
+            st = self._stamp(r)
+            if st is not None and (now - st) <= self.ttl:
+                out.add(r)
+        return out
+
+    def stale_ranks(self, registered_only: bool = True) -> List[int]:
+        """Ranks whose lease EXPIRED (registered once, then lapsed). Ranks
+        that never registered are reported only with registered_only=False
+        (startup grace: a slow-to-boot worker is not a membership loss)."""
+        now = time.time()
+        out = []
+        for r in range(self.world_size):
+            if self._is_done(r):
+                continue  # clean exit is not a membership loss
+            st = self._stamp(r)
+            if st is None:
+                if not registered_only:
+                    out.append(r)
+            elif (now - st) > self.ttl:
+                out.append(r)
+        return out
+
+    # ---- worker-side watch --------------------------------------------------
+    def monitor(self, on_change: Optional[Callable[[Set[int]], None]] = None,
+                interval: Optional[float] = None):
+        """Watch the peer set from a daemon thread; when a PEER that was
+        alive lapses, either call ``on_change(lost)`` or (default) log and
+        ``os._exit(ELASTIC_EXIT_CODE)`` so the launcher relaunches the
+        incarnation and every worker resumes from checkpoint."""
+        if self._watch_thread is not None:
+            return self
+        interval = interval if interval is not None else self.ttl / 3.0
+
+        def watch():
+            seen: Set[int] = set()
+            while not self._stop.wait(interval):
+                try:
+                    alive = self.alive_ranks()
+                except Exception:
+                    continue
+                seen |= alive
+                lost = {r for r in seen - alive
+                        if r != self.rank and not self._is_done(r)}
+                if lost:
+                    if on_change is not None:
+                        on_change(lost)
+                        seen = set(alive)
+                        continue
+                    print(f"elastic: rank {self.rank} detected lost peers "
+                          f"{sorted(lost)}; exiting for coordinated restart",
+                          flush=True)
+                    os._exit(ELASTIC_EXIT_CODE)
+
+        self._watch_thread = threading.Thread(
+            name="elastic-watch", target=watch, daemon=True)
+        self._watch_thread.start()
+        return self
+
+    def close(self):
+        self._stop.set()
+
+
+def start_elastic(job_id: Optional[str] = None, ttl: Optional[float] = None):
+    """Worker one-liner: register this rank's lease and monitor peers
+    (endpoint/rank/world/job from the launcher's env). No-op when the job
+    was not launched with --elastic_ttl. Deregisters automatically on a
+    clean interpreter exit so peers do not mistake completion for a hang."""
+    import atexit
+
+    if "PADDLE_ELASTIC_STORE" not in os.environ:
+        return None
+    job_id = job_id or os.environ.get("PADDLE_ELASTIC_JOB_ID", "default")
+    ttl = ttl if ttl is not None else float(
+        os.environ.get("PADDLE_ELASTIC_TTL", "10"))
+    mgr = ElasticManager(endpoint=os.environ["PADDLE_ELASTIC_STORE"],
+                         ttl=ttl, job_id=job_id)
+    atexit.register(mgr.mark_done)
+    return mgr.register().monitor()

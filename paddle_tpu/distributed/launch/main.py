@@ -38,6 +38,13 @@ def _build_parser():
                         "(elastic manager parity: workers must resume from "
                         "their checkpoint; PADDLE_RESTART_COUNT tells them "
                         "which incarnation they are)")
+    p.add_argument("--elastic_ttl", type=float, default=0.0,
+                   help="enable elastic MEMBERSHIP management (fleet/elastic/"
+                        "manager.py parity): the launcher hosts a TCPStore "
+                        "lease registry, each worker heartbeats its lease "
+                        "(PADDLE_ELASTIC_STORE/PADDLE_ELASTIC_TTL env), and "
+                        "a lapsed lease — a worker HUNG without exiting — "
+                        "restarts the incarnation like a failure would")
     p.add_argument("training_script",
                    help="script to run (or module with -m inside the script)")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -88,10 +95,31 @@ def launch(argv: Optional[List[str]] = None) -> int:
 
 
 def _run_once(args, restart_count: int) -> int:
-    """One incarnation: spawn workers, watch, first-failure abort."""
+    """One incarnation: spawn workers, watch, first-failure abort.
+
+    With --elastic_ttl, the launcher additionally runs the elastic
+    peer-set watch: a worker whose lease lapses while its process is still
+    alive (hang, not crash) fails the incarnation, exactly as an exit
+    would (ElasticManager._match semantics)."""
     os.makedirs(args.log_dir, exist_ok=True)
 
+    elastic = None
+    store = None
+    if args.elastic_ttl > 0:
+        from ..elastic import ElasticManager
+        from ..store import TCPStore
+
+        store = TCPStore("127.0.0.1", 0, is_master=True,
+                         world_size=args.nnodes * args.nproc_per_node)
+        os.environ["PADDLE_ELASTIC_STORE"] = f"127.0.0.1:{store.port}"
+        os.environ["PADDLE_ELASTIC_TTL"] = str(args.elastic_ttl)
+        os.environ["PADDLE_ELASTIC_JOB_ID"] = args.job_id
+        elastic = ElasticManager(store, rank=-1,
+                                 world_size=args.nnodes * args.nproc_per_node,
+                                 ttl=args.elastic_ttl, job_id=args.job_id)
+
     procs: List[subprocess.Popen] = []
+    rank_of = {}
     logs = []
     log_files = []
     for local_rank in range(args.nproc_per_node):
@@ -107,12 +135,15 @@ def _run_once(args, restart_count: int) -> int:
         env["PADDLE_RESTART_COUNT"] = str(restart_count)
         procs.append(subprocess.Popen(
             cmd, env=env, stdout=logf, stderr=subprocess.STDOUT))
+        rank_of[id(procs[-1])] = rank
         logs.append(log_path)
         print(f"launch: rank {rank} pid {procs[-1].pid} log {log_path}",
               flush=True)
 
-    # watch loop: first non-zero exit kills the rest (collective.py watch)
+    # watch loop: first non-zero exit kills the rest (collective.py watch);
+    # with elastic on, a LAPSED LEASE (hung worker) fails the incarnation too
     exit_code = 0
+    term_deadline = None  # set on first failure: SIGKILL stragglers after it
     try:
         while procs:
             for p in list(procs):
@@ -124,6 +155,27 @@ def _run_once(args, restart_count: int) -> int:
                     exit_code = ret
                     for q in procs:
                         q.send_signal(signal.SIGTERM)
+            if elastic is not None and exit_code == 0 and procs:
+                # only RUNNING workers can lapse: an exited worker's silence
+                # is handled by its exit code, not by membership
+                running = {rank_of[id(p)] for p in procs}
+                stale = [r for r in elastic.stale_ranks() if r in running]
+                if stale:
+                    print(f"launch: elastic watch — worker lease(s) "
+                          f"{stale} lapsed (hung?); failing incarnation",
+                          flush=True)
+                    exit_code = 1
+                    for q in procs:
+                        q.send_signal(signal.SIGTERM)
+            if exit_code != 0:
+                # a worker trapping SIGTERM (or wedged in native code) must
+                # not pin the watch loop open: escalate to SIGKILL and leave
+                if term_deadline is None:
+                    term_deadline = time.time() + 15.0
+                elif time.time() > term_deadline:
+                    for q in procs:
+                        q.kill()
+                    break
             time.sleep(0.2)
     except KeyboardInterrupt:
         for q in procs:
@@ -141,6 +193,10 @@ def _run_once(args, restart_count: int) -> int:
                 q.wait()
         for f in log_files:
             f.close()
+        if elastic is not None:
+            elastic.close()
+        if store is not None:
+            store.close()  # free the lease port; next incarnation binds anew
     if exit_code != 0:
         for lp in logs:
             tail = open(lp).read().splitlines()[-20:]

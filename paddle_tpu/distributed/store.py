@@ -86,11 +86,22 @@ class TCPStore:
             self.set(f"__{name}__release_{gen}", b"1")
         self.get(f"__{name}__release_{gen}", timeout)
 
-    def __del__(self):
+    def close(self):
+        """Idempotent shutdown of the client connection and (if master) the
+        daemon — callers that outlive many stores (elastic restart loop)
+        must not rely on GC timing to release the port."""
         try:
             if getattr(self, "_client", None):
                 self._lib.pd_store_client_close(self._client)
+                self._client = None
             if getattr(self, "_server", None):
                 self._lib.pd_store_server_stop(self._server)
+                self._server = None
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
         except Exception:
             pass

@@ -48,7 +48,16 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
     feed_names_sorted = sorted(feed_names)
     fetch_ids = [id(v) for v in fetch_vars]
 
-    fn = program.as_function(feed_names_sorted, fetch_ids)
+    # bake the parameters' CURRENT values (not the record-time captures) so
+    # the exported artifact matches what Executor.run — which always reads
+    # live weights — was validating right before the save
+    pids = tuple(program.param_ids())
+    inner = program.as_function(feed_names_sorted, fetch_ids, pids)
+    by_id = program.tensors_by_id()
+    param_arrays = [by_id[t]._array for t in pids]
+
+    def fn(*feeds):
+        return inner(*feeds, *param_arrays)
     by_name = {name_of[id(v)]: v for v in feed_vars}
     specs = []
     for n in feed_names_sorted:

@@ -70,6 +70,7 @@ class Program:
         self.nodes.append(_Node(name, fn, treedef, stored, tensor_pos,
                                 in_ids, out_ids))
         self._cache.clear()
+        self.__dict__.pop("_byid", None)
 
     def global_block(self):  # API-shape parity
         return self
@@ -91,12 +92,55 @@ class Program:
                 f"feeds={list(self.feeds)})")
 
     # -- replay --------------------------------------------------------------
-    def as_function(self, feed_names: Sequence[str],
-                    fetch_ids: Sequence[int]):
-        """Pure (feed arrays...) -> (fetch arrays...) replay of the graph."""
+    def param_ids(self) -> List[int]:
+        """Graph ids of live ``Parameter`` inputs — tensors referenced by a
+        node but neither fed nor produced by an earlier node. These are
+        replay-time ARGUMENTS (Executor.run reads their CURRENT values each
+        call, like the reference executor reading scope variables,
+        executor.py:1234) rather than values baked at record time."""
+        from ..tensor_class import Parameter
 
-        def run(*feed_arrays):
+        by_id = {}
+        for t in self._keepalive:
+            if isinstance(t, Tensor):
+                by_id.setdefault(id(t), t)
+        feed_ids = set(self.feeds.values())
+        produced: set = set()
+        out, seen = [], set()
+        for node in self.nodes:
+            for tid in node.in_ids:
+                if tid in seen or tid in feed_ids or tid in produced:
+                    continue
+                seen.add(tid)
+                if isinstance(by_id.get(tid), Parameter):
+                    out.append(tid)
+            produced.update(node.out_ids)
+        return out
+
+    def tensors_by_id(self) -> Dict[int, Tensor]:
+        # cached per recording epoch: record() clears _cache AND this map
+        out = self.__dict__.get("_byid")
+        if out is None:
+            out = {}
+            for t in self._keepalive:
+                if isinstance(t, Tensor):
+                    out.setdefault(id(t), t)
+            self.__dict__["_byid"] = out
+        return out
+
+    def as_function(self, feed_names: Sequence[str],
+                    fetch_ids: Sequence[int],
+                    param_ids: Sequence[int] = ()):
+        """Pure (feed arrays..., [param arrays...]) -> (fetch arrays...)
+        replay of the graph. With ``param_ids`` empty, parameter values are
+        the ones captured at record time (the export/bake path)."""
+        param_ids = tuple(param_ids)
+
+        def run(*arrays):
+            feed_arrays = arrays[:len(feed_names)]
+            param_arrays = arrays[len(feed_names):]
             env = {self.feeds[n]: a for n, a in zip(feed_names, feed_arrays)}
+            env.update(zip(param_ids, param_arrays))
             for node in self.nodes:
                 leaves = list(node.leaves)
                 for pos, tid in zip(node.tensor_pos, node.in_ids):
@@ -119,7 +163,9 @@ class Program:
     def compiled(self, feed_names, fetch_ids, shapes_key):
         key = (tuple(feed_names), tuple(fetch_ids), shapes_key)
         if key not in self._cache:
-            self._cache[key] = jax.jit(self.as_function(feed_names, fetch_ids))
+            pids = tuple(self.param_ids())  # graph walk only on cache miss
+            self._cache[key] = (
+                jax.jit(self.as_function(feed_names, fetch_ids, pids)), pids)
         return self._cache[key]
 
 
@@ -204,8 +250,12 @@ class Executor:
         fetch_ids = [self._resolve_fetch(program, f) for f in fetch_list]
         arrays = [np.asarray(feed[n]) for n in feed_names]
         shapes_key = tuple((a.shape, str(a.dtype)) for a in arrays)
-        fn = program.compiled(feed_names, fetch_ids, shapes_key)
-        outs = fn(*arrays)
+        fn, pids = program.compiled(feed_names, fetch_ids, shapes_key)
+        # live parameter values: the replay reads each Parameter's CURRENT
+        # array (reference executor scope semantics) — weights updated or
+        # loaded after recording are honored, not silently baked
+        by_id = program.tensors_by_id()
+        outs = fn(*arrays, *[by_id[t]._array for t in pids])
         if return_numpy:
             return [np.asarray(jax.device_get(o)) for o in outs]
         return [wrap(o) for o in outs]

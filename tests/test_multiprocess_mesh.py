@@ -1,0 +1,130 @@
+"""Two processes, ONE global mesh (VERDICT r3 item 4).
+
+Parity model: test/collective/test_communication_api_base.py:28,58-70 —
+launch real processes that rendezvous on one master. TPU-native twist: the
+processes call jax.distributed.initialize (via dist.init_parallel_env) and
+form a SINGLE 8-device jax mesh (4 virtual CPU devices each), then run the
+full hybrid DistTrainStep (dp2 x mp2 x sharding2) plus a collective over
+it; loss must match the single-process 8-device run bit-for-bit.
+"""
+import os
+import pickle
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+_WORKER = r'''
+import os, pickle, sys
+import numpy as np
+
+out_dir = sys.argv[1]
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.distributed.engine import parallelize
+
+strategy = dist.DistributedStrategy()
+strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "sep_degree": 1,
+                           "sharding_degree": 2, "pp_degree": 1}
+strategy.sharding_configs = {"stage": 3}
+dist.fleet.init(is_collective=True, strategy=strategy)  # init_parallel_env
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()      # ONE global mesh
+assert jax.local_device_count() == 4
+
+# a collective over the global mesh
+t = paddle.to_tensor(np.full((4,), float(rank + 1), dtype="float32"))
+dist.all_reduce(t)
+np.testing.assert_allclose(t.numpy(), 3.0)
+
+paddle.seed(0)
+cfg = LlamaConfig.tiny(num_hidden_layers=1, use_flash_attention=False,
+                       num_attention_heads=4, num_key_value_heads=2)
+model = LlamaForCausalLM(cfg)
+model = dist.fleet.distributed_model(model)
+optimizer = opt.AdamW(1e-3, parameters=model.parameters())
+
+def loss_fn(m, x, y):
+    loss, _ = m(x, labels=y)
+    return loss
+
+step = parallelize(model, loss_fn, optimizer)
+ids = np.random.RandomState(5).randint(0, cfg.vocab_size, (8, 33))
+losses = [float(np.asarray(step(paddle.to_tensor(ids[:, :-1]),
+                                paddle.to_tensor(ids[:, 1:])).numpy()))
+          for _ in range(2)]
+with open(os.path.join(out_dir, f"rank{rank}.pkl"), "wb") as f:
+    pickle.dump({"rank": rank, "losses": losses}, f)
+print(f"rank {rank} OK", flush=True)
+'''
+
+
+@pytest.mark.slow
+def test_two_process_one_mesh_dist_train_step(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{port}",
+         "--log_dir", str(tmp_path / "logs"), str(worker), str(tmp_path)],
+        cwd="/root/repo", capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "/root/repo",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+
+    results = []
+    for rank in range(2):
+        with open(tmp_path / f"rank{rank}.pkl", "rb") as f:
+            results.append(pickle.load(f))
+    # both ranks observed the SAME global losses (one mesh, one computation)
+    assert results[0]["losses"] == results[1]["losses"]
+
+    # single-process reference over the same 8 devices (this process's
+    # virtual mesh), same seeds/degrees/data
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.distributed.engine import parallelize
+
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "sep_degree": 1, "sharding_degree": 2,
+                               "pp_degree": 1}
+    strategy.sharding_configs = {"stage": 3}
+    try:
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(num_hidden_layers=1, use_flash_attention=False,
+                               num_attention_heads=4, num_key_value_heads=2)
+        model = LlamaForCausalLM(cfg)
+        model = dist.fleet.distributed_model(model)
+        optimizer = opt.AdamW(1e-3, parameters=model.parameters())
+
+        def loss_fn(m, x, y):
+            loss, _ = m(x, labels=y)
+            return loss
+
+        step = parallelize(model, loss_fn, optimizer)
+        ids = np.random.RandomState(5).randint(0, cfg.vocab_size, (8, 33))
+        ref = [float(np.asarray(step(paddle.to_tensor(ids[:, :-1]),
+                                     paddle.to_tensor(ids[:, 1:])).numpy()))
+               for _ in range(2)]
+    finally:
+        dist.set_hybrid_communicate_group(None)
+    np.testing.assert_allclose(results[0]["losses"], ref, rtol=1e-6)

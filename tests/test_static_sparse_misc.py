@@ -1015,3 +1015,80 @@ def test_identity_loss_reduction_codes():
     for red in ("none", 2):
         np.testing.assert_array_equal(inc.identity_loss(x, red).numpy(),
                                       x.numpy())
+
+
+def test_static_executor_reads_live_params():
+    """Executor.run honors parameter values CURRENT at replay time
+    (reference executor scope semantics, executor.py:1234) — weights
+    updated after recording must flow into the next run, not the values
+    baked when the program was recorded (VERDICT r3 #8)."""
+    import paddle_tpu.static as static
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    static.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4])
+            y = net(x)
+        exe = static.Executor()
+        feed = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        out1, = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+        # update the weights AFTER recording
+        net.weight.set_value(np.zeros((4, 2), np.float32))
+        net.bias.set_value(np.full((2,), 7.0, np.float32))
+        out2, = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+    finally:
+        static.disable_static()
+    assert not np.allclose(out1, out2)
+    np.testing.assert_allclose(out2, np.full((3, 2), 7.0), rtol=1e-6)
+
+
+def test_optimizer_step_raises_inside_recording():
+    """optimizer.step() inside program_guard raises with TrainStep guidance
+    instead of silently mutating params the recorded graph never sees."""
+    import paddle_tpu.static as static
+    import paddle_tpu.optimizer as opt
+
+    net = nn.Linear(2, 2)
+    o = opt.SGD(0.1, parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    prog = static.Program()
+    with static.program_guard(prog):
+        with pytest.raises(RuntimeError, match="TrainStep"):
+            o.step()
+    o.step()  # outside the region it works
+    o.clear_grad()
+
+
+def test_save_inference_model_bakes_current_weights(tmp_path):
+    """save_inference_model exports the weights CURRENT at save time — the
+    same values Executor.run was just validating — not the record-time
+    captures (review: executor/export divergence)."""
+    import paddle_tpu.static as static
+
+    paddle.seed(0)
+    net = nn.Linear(3, 2)
+    static.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 3])
+            y = net(x)
+        exe = static.Executor()
+        # weights change AFTER recording, BEFORE saving
+        net.weight.set_value(np.zeros((3, 2), np.float32))
+        net.bias.set_value(np.full((2,), 5.0, np.float32))
+        static.save_inference_model(str(tmp_path / "m"), [x], [y], exe,
+                                    program=prog)
+        pred, feed_names, n_fetch = static.load_inference_model(
+            str(tmp_path / "m"), exe)
+    finally:
+        static.disable_static()
+    feed = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    out = pred.run([feed])[0]
+    np.testing.assert_allclose(np.asarray(out), np.full((4, 2), 5.0),
+                               rtol=1e-6)
