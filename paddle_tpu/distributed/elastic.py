@@ -69,9 +69,12 @@ class ElasticManager:
         return f"{self._prefix}/node/{rank}"
 
     def _beat(self):
-        # epoch + this process's start marker: a RESTARTED rank re-registers
-        # with a fresh stamp, so "alive" is lease freshness, not existence
-        self._store.set(self._key(self.rank), repr(time.time()))
+        # a RESTARTED rank re-registers with a fresh stamp, so "alive" is
+        # lease freshness, not existence. CLOCK_MONOTONIC: comparable across
+        # processes on one host (launcher + its workers — the supported
+        # topology) and immune to NTP steps/suspend, which under wall time
+        # would falsely lapse every lease at once
+        self._store.set(self._key(self.rank), repr(time.monotonic()))
 
     def register(self):
         """Start the lease heartbeat (manager.py:251-289 lease_heartbeat)."""
@@ -120,7 +123,7 @@ class ElasticManager:
             return None
 
     def alive_ranks(self) -> Set[int]:
-        now = time.time()
+        now = time.monotonic()
         out = set()
         for r in range(self.world_size):
             st = self._stamp(r)
@@ -132,16 +135,17 @@ class ElasticManager:
         """Ranks whose lease EXPIRED (registered once, then lapsed). Ranks
         that never registered are reported only with registered_only=False
         (startup grace: a slow-to-boot worker is not a membership loss)."""
-        now = time.time()
+        now = time.monotonic()
         out = []
         for r in range(self.world_size):
-            if self._is_done(r):
-                continue  # clean exit is not a membership loss
             st = self._stamp(r)
             if st is None:
-                if not registered_only:
+                if not registered_only and not self._is_done(r):
                     out.append(r)
-            elif (now - st) > self.ttl:
+            elif (now - st) > self.ttl and not self._is_done(r):
+                # done-marker consulted only on an actual lapse: it costs a
+                # blocking store round-trip, and the common all-alive poll
+                # must stay cheap (launcher iterates this every 0.2s)
                 out.append(r)
         return out
 

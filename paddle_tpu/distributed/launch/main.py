@@ -105,15 +105,28 @@ def _run_once(args, restart_count: int) -> int:
 
     elastic = None
     store = None
+    elastic_env = {}
     if args.elastic_ttl > 0:
+        if args.nnodes > 1:
+            # membership must be job-global: a per-node lease registry would
+            # restart one node's workers while the others stay wedged in
+            # collectives on the dead peer
+            raise SystemExit(
+                "--elastic_ttl currently supports single-node jobs only "
+                "(the lease registry binds to this host); multi-node "
+                "elastic needs a job-global store")
         from ..elastic import ElasticManager
         from ..store import TCPStore
 
         store = TCPStore("127.0.0.1", 0, is_master=True,
                          world_size=args.nnodes * args.nproc_per_node)
-        os.environ["PADDLE_ELASTIC_STORE"] = f"127.0.0.1:{store.port}"
-        os.environ["PADDLE_ELASTIC_TTL"] = str(args.elastic_ttl)
-        os.environ["PADDLE_ELASTIC_JOB_ID"] = args.job_id
+        # per-WORKER env only: mutating os.environ would leave later code
+        # in this process pointing at a store that dies with _run_once
+        elastic_env = {
+            "PADDLE_ELASTIC_STORE": f"127.0.0.1:{store.port}",
+            "PADDLE_ELASTIC_TTL": str(args.elastic_ttl),
+            "PADDLE_ELASTIC_JOB_ID": args.job_id,
+        }
         elastic = ElasticManager(store, rank=-1,
                                  world_size=args.nnodes * args.nproc_per_node,
                                  ttl=args.elastic_ttl, job_id=args.job_id)
@@ -132,6 +145,7 @@ def _run_once(args, restart_count: int) -> int:
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
         env = _rank_env(args, local_rank)
+        env.update(elastic_env)
         env["PADDLE_RESTART_COUNT"] = str(restart_count)
         procs.append(subprocess.Popen(
             cmd, env=env, stdout=logf, stderr=subprocess.STDOUT))

@@ -54,7 +54,8 @@ def _rope_rows(x, cos, sin, row_pos):
 
 
 def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
-                     row_pos=None, use_flash=False, interpret=False):
+                     row_pos=None, use_flash=False, interpret=False,
+                     prefill=False):
     """RoPE + cache write + masked GQA attention against a dense buffer.
 
     q [B,S,H,D]; k/v [B,S,hk,D]; cos/sin [>=max_len, D];
@@ -89,10 +90,15 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
     if use_flash and S > 1 and allowed is None and row_pos is None:
         from .ops.pallas import flash_attention as pf
 
-        try:
-            pos_is_zero = int(pos) == 0  # eager prefill: concrete scalar
-        except Exception:
-            pos_is_zero = False  # traced offset: unknown, stay dense
+        # `prefill` is the STATIC marker _empty_caches stamps on fresh
+        # (pos=0) caches — it survives jit tracing, where even jnp
+        # constants are tracers and a value check would always fail
+        pos_is_zero = bool(prefill)
+        if not pos_is_zero:
+            try:
+                pos_is_zero = int(pos) == 0  # eager caller: concrete scalar
+            except Exception:
+                pos_is_zero = False  # traced offset: unknown, stay dense
         if pos_is_zero and pf.supported(q, k, v, interpret=interpret):
             out = pf.flash_attention_bshd(q, k, v, causal=True,
                                           interpret=interpret)
@@ -145,9 +151,13 @@ def paged_cached_attention(q, k, v, cos, sin, k_pages, v_pages, page_indices,
 
 
 def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
-                           pages_per_compute_block=1):
+                           pages_per_compute_block=None):
     """Decode attention over a paged cache: JAX's bundled Pallas kernel on
-    TPU, a jnp gather reference (identical semantics) elsewhere."""
+    TPU, a jnp gather reference (identical semantics) elsewhere.
+
+    ``pages_per_compute_block`` defaults to the largest divisor of
+    pages-per-sequence <= 8: bigger blocks amortize the kernel's grid
+    overhead across more of the KV stream (HBM-bandwidth-bound op)."""
     try:
         on_tpu = jax.devices()[0].platform == "tpu"
     except Exception:
@@ -156,6 +166,10 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
         from jax.experimental.pallas.ops.tpu.paged_attention import (
             paged_attention as pa)
 
+        if pages_per_compute_block is None:
+            pages_per_seq = page_indices.shape[1]
+            pages_per_compute_block = next(
+                b for b in (8, 4, 2, 1) if pages_per_seq % b == 0)
         return pa.paged_attention(
             q, k_pages, v_pages, lengths, page_indices,
             pages_per_compute_block=pages_per_compute_block)
@@ -259,9 +273,17 @@ def _empty_caches(model, batch, max_len, allowed=None, row_pos=None):
     dt = jnp.dtype(cfg.dtype) if isinstance(cfg.dtype, str) else cfg.dtype
     caches = []
     for _ in range(cfg.num_hidden_layers):
+        # pos starts as a PYTHON int so it stays a concrete constant even
+        # when the prefill traces under jit — the flash fast path's
+        # `int(pos) == 0` guard (cached_attention) must see through the
+        # trace; decode steps then carry it as a traced scalar
+        # "prefill": static marker consumed by the first forward (the
+        # attention layer's `new` dict drops it), enabling the flash fast
+        # path under jit; pos stays a python 0 so the first cache write
+        # compiles as a static-offset slice
         c = {"k": jnp.zeros((batch, max_len, hk, d), dt),
              "v": jnp.zeros((batch, max_len, hk, d), dt),
-             "pos": jnp.zeros((), jnp.int32)}
+             "pos": 0, "prefill": True}
         if allowed is not None:
             c["allowed"] = allowed
         if row_pos is not None:
@@ -320,6 +342,61 @@ class _DecodeStep:
         bufs, aux = _split_caches(caches)
         logits, nb, na = self._jitted(self._state, token, bufs, aux)
         return logits, [{**b, **a} for b, a in zip(nb, na)]
+
+
+class _PrefillStep:
+    """ONE jitted computation for the whole prefill: empty caches → all
+    layers (flash kernel over the prompt — cache `pos` is a concrete 0
+    inside the trace, so the fast path survives jit) → each row's last real
+    logit. Eager prefill costs one device dispatch per op per layer; this is
+    the serving path's second half of the TrainStep pattern."""
+
+    def __init__(self, model, max_len, ragged):
+        self._model = model
+
+        def pure(state, ids, lengths, pad_mask):
+            own = model.state_dict()
+            snapshot = {k: t._array for k, t in own.items()}
+            model.load_functional_state(state)
+            try:
+                with _tape.no_grad():
+                    B = ids.shape[0]
+                    caches = _empty_caches(
+                        model, B, max_len,
+                        allowed=pad_mask if ragged else None)
+                    hidden, caches = model.llama.forward_cached(
+                        wrap(ids), caches, rope_len=max_len)
+                    h_last = jnp.take_along_axis(
+                        unwrap(hidden),
+                        (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)
+                    last = unwrap(model.lm_head_logits(wrap(h_last)))[:, 0, :]
+                return last, _unwrap_caches(caches)
+            finally:
+                for k2, t in own.items():
+                    t._array = snapshot[k2]
+
+        self._jitted = jax.jit(pure)
+        self._state = {k: v for k, v in model.functional_state().items()}
+
+    def __call__(self, ids, lengths, pad_mask):
+        return self._jitted(self._state, ids, lengths, pad_mask)
+
+
+def _get_prefill_step(model, max_len, ragged):
+    """Memoized per (model, max_len, ragged) — same rationale as
+    _get_decode_step (jit cache keys on the function object)."""
+    cache = model.__dict__.get("_prefill_steps")
+    if cache is None:
+        cache = {}
+        object.__setattr__(model, "_prefill_steps", cache)
+    key = (max_len, ragged)
+    step = cache.get(key)
+    if step is None:
+        step = _PrefillStep(model, max_len, ragged)
+        cache[key] = step
+    else:
+        step._state = {k: v for k, v in model.functional_state().items()}
+    return step
 
 
 def _get_decode_step(model, max_len):
@@ -397,17 +474,11 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
             return _generate_no_cache(model, ids, max_new_tokens, do_sample,
                                       temperature, top_k, top_p, eos_token_id)
 
-        # ---- prefill ----
-        caches = _empty_caches(model, B, max_len, allowed=pad_mask)
-        hidden, caches = model.llama.forward_cached(
-            wrap(ids), caches, rope_len=max_len)
-        # gather each row's last REAL hidden state BEFORE the lm head so the
-        # vocab projection runs on [B,1,H], not [B,S0,H] (S0× less HBM)
-        h_last = jnp.take_along_axis(
-            unwrap(hidden), (lengths - 1)[:, None, None].astype(jnp.int32),
-            axis=1)
-        last = unwrap(model.lm_head_logits(wrap(h_last)))[:, 0, :]
-        caches = _unwrap_caches(caches)
+        # ---- prefill: one jitted computation (flash kernel + cache fill +
+        # last-real-logit gather; the [B,1,H] gather before the lm head
+        # keeps the vocab projection S0x smaller in HBM) ----
+        prefill = _get_prefill_step(model, max_len, pad_mask is not None)
+        last, caches = prefill(ids, lengths, pad_mask)
 
         if paged:
             caches = _caches_to_paged(caches, page_size, lengths, pad_mask)

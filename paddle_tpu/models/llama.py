@@ -170,7 +170,8 @@ class LlamaAttention(Layer):
                 "llama_attention_cached", cached_attention, q, k, v, cos, sin,
                 kv_cache["k"], kv_cache["v"], kv_cache["pos"],
                 kv_cache.get("allowed"), kv_cache.get("row_pos"),
-                use_flash=cfg.use_flash_attention)
+                use_flash=cfg.use_flash_attention,
+                prefill=bool(kv_cache.get("prefill", False)))
             result = self.o_proj(out.reshape([b, s, h * d]))
             new = {"k": k_buf, "v": v_buf, "pos": kv_cache["pos"] + s}
             if "allowed" in kv_cache:
