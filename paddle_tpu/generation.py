@@ -382,40 +382,103 @@ class _PrefillStep:
         return self._jitted(self._state, ids, lengths, pad_mask)
 
 
-def _get_prefill_step(model, max_len, ragged):
-    """Memoized per (model, max_len, ragged) — same rationale as
-    _get_decode_step (jit cache keys on the function object)."""
-    cache = model.__dict__.get("_prefill_steps")
+def _memoized_step(model, attr, key, factory, maxsize=None):
+    """Per-model step memoization: jax.jit's compile cache keys on the
+    function object, so a fresh step per generate() call would recompile
+    every request (review finding). On a hit, the step re-reads the model's
+    CURRENT weights. ``maxsize`` evicts oldest entries (insertion order)
+    for caches whose key space is unbounded (per-request lengths)."""
+    cache = model.__dict__.get(attr)
     if cache is None:
         cache = {}
-        object.__setattr__(model, "_prefill_steps", cache)
-    key = (max_len, ragged)
+        object.__setattr__(model, attr, cache)
     step = cache.get(key)
     if step is None:
-        step = _PrefillStep(model, max_len, ragged)
+        step = factory()
+        if maxsize is not None and len(cache) >= maxsize:
+            cache.pop(next(iter(cache)))
         cache[key] = step
     else:
         step._state = {k: v for k, v in model.functional_state().items()}
     return step
 
 
+def _get_prefill_step(model, max_len, ragged):
+    return _memoized_step(model, "_prefill_steps", (max_len, ragged),
+                          lambda: _PrefillStep(model, max_len, ragged))
+
+
+class _ScanDecodeStep:
+    """The WHOLE decode loop as one jitted ``lax.scan``: each step samples
+    the next token from the carried logits, runs one cached forward, and
+    carries the updated (donated) KV buffers. One device dispatch for the
+    entire generation instead of two per token — the python loop remains
+    only for eos early-stopping (data-dependent length needs host control).
+    """
+
+    def __init__(self, model, max_len, steps, do_sample, temperature,
+                 top_k, top_p):
+        self._model = model
+
+        def pure(state, last, base_key, bufs, aux):
+            own = model.state_dict()
+            snapshot = {k: t._array for k, t in own.items()}
+            model.load_functional_state(state)
+            try:
+                def body(carry, t):
+                    last_t, bufs_t, aux_t = carry
+                    key = jax.random.fold_in(base_key, t)
+                    nxt = sample_logits(last_t, key, do_sample=do_sample,
+                                        temperature=temperature,
+                                        top_k=top_k, top_p=top_p)
+                    token = nxt[:, None].astype(jnp.int32)
+                    caches = [{**b, **a} for b, a in zip(bufs_t, aux_t)]
+                    with _tape.no_grad():
+                        hidden, new_caches = model.llama.forward_cached(
+                            wrap(token), caches, rope_len=max_len)
+                        logits = model.lm_head_logits(hidden)
+                    nb, na = _split_caches(_unwrap_caches(new_caches))
+                    return (unwrap(logits)[:, -1, :], nb, na), nxt
+
+                (last_f, bufs_f, aux_f), toks = jax.lax.scan(
+                    body, (last, bufs, aux), jnp.arange(steps))
+                return toks, last_f, bufs_f, aux_f
+            finally:
+                for k2, t in own.items():
+                    t._array = snapshot[k2]
+
+        self._jitted = jax.jit(pure, donate_argnums=(3,))
+        self._state = {k: v for k, v in model.functional_state().items()}
+
+    def __call__(self, last, base_key, caches):
+        bufs, aux = _split_caches(caches)
+        # scan carries must be type-stable across iterations: normalize the
+        # python-int pos (static after prefill) to a traced-compatible array
+        aux = [dict(a, pos=jnp.asarray(a["pos"], jnp.int32)) for a in aux]
+        toks, last_f, nb, na = self._jitted(self._state, last, base_key,
+                                            bufs, aux)
+        return toks, last_f, [{**b, **a} for b, a in zip(nb, na)]
+
+
+def _get_scan_decode(model, max_len, steps, do_sample, temperature, top_k,
+                     top_p):
+    # NOTE: keyed on the request's exact step count — a serving mix of many
+    # distinct max_new_tokens values compiles one scan program each (the
+    # fixed-length-batch assumption of this fast path). The cache is
+    # LRU-bounded so varied lengths cannot accumulate executables forever.
+    key = (max_len, steps, do_sample, float(temperature), int(top_k),
+           float(top_p))
+    return _memoized_step(
+        model, "_scan_decode_steps", key,
+        lambda: _ScanDecodeStep(model, max_len, steps, do_sample,
+                                float(temperature), int(top_k),
+                                float(top_p)),
+        maxsize=16)
+
+
 def _get_decode_step(model, max_len):
-    """Memoized per (model, max_len): jax.jit's compile cache is keyed on
-    the function object, so a fresh _DecodeStep per generate() call would
-    recompile every request (review finding). Weights are re-read from the
-    model at each generate() via the memoized step's refresh below."""
-    cache = model.__dict__.get("_decode_steps")
-    if cache is None:
-        cache = {}
-        object.__setattr__(model, "_decode_steps", cache)
-    step = cache.get(max_len)
-    if step is None:
-        step = _DecodeStep(model, max_len)
-        cache[max_len] = step
-    else:
-        # pick up any weight updates since the step was built
-        step._state = {k: v for k, v in model.functional_state().items()}
-    return step
+    return _memoized_step(model, "_decode_steps", max_len,
+                          lambda: _DecodeStep(model, max_len))
 
 
 # ---------------------------------------------------------------------------
@@ -488,6 +551,19 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
         if pad_mask is not None and not paged:
             for c in caches:
                 c["row_pos"] = lengths
+
+        if eos_token_id is None and max_new_tokens > 1:
+            # fixed-length decode: the whole loop is ONE lax.scan dispatch
+            # (sample_t → forward_t → logits_{t+1}); the final token needs
+            # only a sample, no forward
+            scan = _get_scan_decode(model, max_len, max_new_tokens - 1,
+                                    do_sample, temperature, top_k, top_p)
+            toks, last, caches = scan(last, _random.next_key(), caches)
+            final = _select(last, _random.next_key(), do_sample,
+                            float(temperature), int(top_k), float(top_p))
+            return wrap(jnp.concatenate(
+                [toks.T.astype(ids.dtype), final.reshape(B, 1).astype(ids.dtype)],
+                axis=1))
 
         step = _get_decode_step(model, max_len)
         finished = jnp.zeros((B,), bool)

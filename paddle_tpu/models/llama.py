@@ -177,7 +177,11 @@ class LlamaAttention(Layer):
             if "allowed" in kv_cache:
                 new["allowed"] = kv_cache["allowed"]
             if "row_pos" in kv_cache:
-                new["row_pos"] = kv_cache["row_pos"]
+                # per-row RoPE positions ADVANCE with each decoded token —
+                # frozen positions would rotate every generated token of a
+                # padded row at the same angle (review r4: ragged decode
+                # diverged from the solo run from the 5th token on)
+                new["row_pos"] = kv_cache["row_pos"] + s
             return result, new
 
         def attn_fn(q, k, v, cos, sin, *cache):

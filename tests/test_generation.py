@@ -219,3 +219,25 @@ def test_flash_prefill_guards_stay_dense():
                                      allowed=allowed, use_flash=True,
                                      interpret=True)
     np.testing.assert_allclose(np.asarray(base[0]), np.asarray(fl[0]))
+
+
+def test_ragged_long_generation_matches_solo(tiny_model):
+    """Ragged batch rows must match the SOLO run of the same prompt for a
+    LONG generation: per-row RoPE positions advance each decoded token
+    (review r4 — frozen row_pos diverged from token 5 on)."""
+    cfg = tiny_model.config
+    rng = np.random.RandomState(2)
+    a = rng.randint(0, cfg.vocab_size, (1, 3))
+    b = rng.randint(0, cfg.vocab_size, (1, 9))
+    pad = np.zeros((1, 9), dtype=a.dtype)
+    pad[0, :3] = a[0]
+    batch = np.concatenate([pad, b], axis=0)
+    mask = np.zeros((2, 9), dtype="int64")
+    mask[0, :3] = 1
+    mask[1, :] = 1
+    out = tiny_model.generate(paddle.to_tensor(batch), max_new_tokens=10,
+                              attention_mask=paddle.to_tensor(mask))
+    solo_a = tiny_model.generate(paddle.to_tensor(a), max_new_tokens=10)
+    solo_b = tiny_model.generate(paddle.to_tensor(b), max_new_tokens=10)
+    np.testing.assert_array_equal(out.numpy()[0], solo_a.numpy()[0])
+    np.testing.assert_array_equal(out.numpy()[1], solo_b.numpy()[0])
