@@ -185,11 +185,20 @@ def main():
     compile_s = time.perf_counter() - t0
 
     n_steps = 10 if on_tpu else 3
+    prof_dir = None
+    if os.environ.get("BENCH_PROFILE"):
+        # XLA-level step attribution (BASELINE.md breakdown): a tensorboard
+        # trace of the timed loop under profiler_log/<config>/
+        prof_dir = os.path.join(_REPO, "profiler_log", f"bench_{cfg_name}")
+        jax.profiler.start_trace(prof_dir)
     t0 = time.perf_counter()
     for _ in range(n_steps):
         loss = step(x, y)
     loss.numpy()  # sync
     dt = (time.perf_counter() - t0) / n_steps
+    if prof_dir is not None:
+        jax.profiler.stop_trace()
+        print(f"# profile written to {prof_dir}", file=sys.stderr)
 
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step / dt

@@ -21,6 +21,9 @@ ok = all(cfgs.get(c, {}).get("platform") == "tpu" for c in ("8b", "decode"))
 sys.exit(0 if ok else 1)
 EOF
     then
+      # bonus while the window is open: an XLA trace of the 8b config for
+      # the BASELINE.md step-time breakdown
+      BENCH_PROFILE=1 BENCH_CONFIG=8b timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
       echo "$(date -Is) all configs captured — done" >> /tmp/bench_retry.log
       exit 0
     fi
