@@ -1,6 +1,7 @@
 """Model zoo: flagship pretraining models (SURVEY §6 workload configs:
 Llama-3, DeepSeekMoE/Qwen2-MoE, ERNIE; DiT lives in vision.models)."""
-from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaDecoderLayer
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
+                    LlamaDecoderLayer, LlamaForCausalLMPipe)
 
 _LAZY = {
     "llama_moe": ("llama_moe", None),

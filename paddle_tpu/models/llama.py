@@ -120,6 +120,32 @@ def _make_linear(in_f, out_f, *, column: bool, config: LlamaConfig, gather_outpu
     return nn.Linear(in_f, out_f, bias_attr=False)
 
 
+def _make_embedding(config: LlamaConfig):
+    """Token embedding, vocab-parallel under mp, Normal-initialized — the
+    ONE construction shared by LlamaModel and the pipeline embed stage."""
+    if _mp_enabled() and config.vocab_size % get_hybrid_communicate_group().get_model_parallel_world_size() == 0:
+        emb = mpu.VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+    else:
+        emb = nn.Embedding(config.vocab_size, config.hidden_size)
+    emb.weight._array = (
+        Normal(0.0, config.initializer_range)(
+            (config.vocab_size, config.hidden_size), jnp.float32)
+        .astype(emb.weight.dtype))
+    return emb
+
+
+def _make_lm_head(config: LlamaConfig):
+    """Column-parallel lm head, Normal-initialized — shared by
+    LlamaForCausalLM and the pipeline head stage."""
+    head = _make_linear(config.hidden_size, config.vocab_size,
+                        column=True, config=config, gather_output=True)
+    head.weight._array = (
+        Normal(0.0, config.initializer_range)(
+            (config.hidden_size, config.vocab_size), jnp.float32)
+        .astype(head.weight.dtype))
+    return head
+
+
 class LlamaAttention(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
@@ -295,14 +321,7 @@ class LlamaModel(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
         self.config = config
-        if _mp_enabled() and config.vocab_size % get_hybrid_communicate_group().get_model_parallel_world_size() == 0:
-            self.embed_tokens = mpu.VocabParallelEmbedding(config.vocab_size, config.hidden_size)
-        else:
-            self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
-        self.embed_tokens.weight._array = (
-            Normal(0.0, config.initializer_range)(
-                (config.vocab_size, config.hidden_size), jnp.float32)
-            .astype(self.embed_tokens.weight.dtype))
+        self.embed_tokens = _make_embedding(config)
         layers = [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
         if config.recompute:
             from ..distributed.recompute_layer import RecomputeLayer
@@ -357,12 +376,7 @@ class LlamaForCausalLM(Layer):
         if config.tie_word_embeddings:
             self.lm_head = None
         else:
-            self.lm_head = _make_linear(config.hidden_size, config.vocab_size,
-                                        column=True, config=config, gather_output=True)
-            self.lm_head.weight._array = (
-                Normal(0.0, config.initializer_range)(
-                    (config.hidden_size, config.vocab_size), jnp.float32)
-                .astype(self.lm_head.weight.dtype))
+            self.lm_head = _make_lm_head(config)
 
     def lm_head_logits(self, hidden):
         if self.lm_head is None:
@@ -388,19 +402,135 @@ class LlamaForCausalLM(Layer):
         logits = self.lm_head_logits(hidden)
         if labels is None:
             return logits
-
-        def loss_fn(lg, lb):
-            lg32 = lg.astype(jnp.float32)
-            logp = jax.nn.log_softmax(lg32, axis=-1)
-            idx = lb.astype(jnp.int32)
-            mask = idx >= 0
-            safe = jnp.where(mask, idx, 0)
-            nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-            nll = jnp.where(mask, nll, 0.0)
-            return jnp.sum(nll) / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
-
-        loss = apply("causal_lm_loss", loss_fn, logits, labels)
-        return loss, logits
+        return causal_lm_loss(logits, labels), logits
 
     def num_parameters(self) -> int:
         return sum(p.size for p in self.parameters())
+
+
+def causal_lm_loss(logits, labels):
+    """Token-mean causal-LM cross entropy in f32; labels < 0 are ignored
+    (the loss the reference's PaddleNLP criterion computes)."""
+    def loss_fn(lg, lb):
+        lg32 = lg.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg32, axis=-1)
+        idx = lb.astype(jnp.int32)
+        mask = idx >= 0
+        safe = jnp.where(mask, idx, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        nll = jnp.where(mask, nll, 0.0)
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+
+    return apply("causal_lm_loss", loss_fn, logits, labels)
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel Llama (the PaddleNLP LlamaForCausalLMPipe pattern)
+# ---------------------------------------------------------------------------
+
+from ..distributed.pipeline import LayerDesc, PipelineLayer  # noqa: E402
+
+
+class LlamaEmbeddingPipe(Layer):
+    """First pipeline stage: token embedding (vocab-parallel under mp).
+    With tie_word_embeddings it is ALSO the head stage's shared layer
+    (SharedLayerDesc) — `_tied_head_forward` projects with the same weight."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.embed_tokens = _make_embedding(config)
+
+    def forward(self, input_ids):
+        return self.embed_tokens(input_ids).astype(self.config.dtype)
+
+
+def _tied_head_forward(layer: "LlamaEmbeddingPipe", hidden):
+    """Head forward over the SHARED embedding weight (tied lm head)."""
+    return apply("tied_lm_head", lambda h, w: h @ w.T,
+                 hidden, layer.embed_tokens.weight)
+
+
+class LlamaDecoderLayerPipe(Layer):
+    """One decoder layer as a pipeline item: computes its own RoPE tables
+    from the activation's seq length (constant-folded by XLA inside the
+    stage jit) so only [B, S, H] crosses stage boundaries."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        layer = LlamaDecoderLayer(config)
+        if config.recompute:
+            from ..distributed.recompute_layer import RecomputeLayer
+
+            layer = RecomputeLayer(layer)
+        self.layer = layer
+
+    def forward(self, hidden):
+        cfg = self.config
+        cos, sin = _rope_tables(hidden.shape[1],
+                                cfg.hidden_size // cfg.num_attention_heads,
+                                cfg.rope_theta)
+        return self.layer(hidden, wrap(cos), wrap(sin))
+
+
+class LlamaNormHeadPipe(Layer):
+    """Last pipeline stage: final RMSNorm + (untied) lm head."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.norm = LlamaRMSNorm(config)
+        self.lm_head = _make_lm_head(config)
+
+    def forward(self, hidden):
+        return self.lm_head(self.norm(hidden))
+
+
+class LlamaNormPipe(Layer):
+    """Final RMSNorm alone (tied-head layout: the head is the shared
+    embedding layer that follows this item)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.norm = LlamaRMSNorm(config)
+
+    def forward(self, hidden):
+        return self.norm(hidden)
+
+
+class LlamaForCausalLMPipe(PipelineLayer):
+    """Stage-partitioned Llama causal LM (PaddleNLP LlamaForCausalLMPipe
+    pattern over this build's PipelineLayer/PipelineParallel runtime).
+
+    Train with ``fleet.distributed_model(model)`` under an hcg with
+    pp_degree > 1 — each stage's mp/sharding placements ride its submesh
+    (pipeline.py hybrid mode) — then ``pp.train_batch([ids, labels], opt)``
+    with ``labels`` already shifted (same contract as LlamaForCausalLM).
+    """
+
+    def __init__(self, config: LlamaConfig, num_stages=None,
+                 seg_method="layer:LlamaDecoderLayerPipe", **pipe_kwargs):
+        if num_stages is None:
+            hcg = get_hybrid_communicate_group()
+            num_stages = (hcg.get_pipe_parallel_world_size()
+                          if hcg is not None else 1)
+        decoders = [LayerDesc(LlamaDecoderLayerPipe, config)
+                    for _ in range(config.num_hidden_layers)]
+        if config.tie_word_embeddings:
+            from ..distributed.pipeline import SharedLayerDesc
+
+            descs = ([SharedLayerDesc("llama_embed", LlamaEmbeddingPipe,
+                                      None, "weight", config)]
+                     + decoders
+                     + [LayerDesc(LlamaNormPipe, config),
+                        SharedLayerDesc("llama_embed", LlamaEmbeddingPipe,
+                                        _tied_head_forward, "weight",
+                                        config)])
+        else:
+            descs = ([LayerDesc(LlamaEmbeddingPipe, config)]
+                     + decoders
+                     + [LayerDesc(LlamaNormHeadPipe, config)])
+        super().__init__(descs, num_stages=num_stages,
+                         loss_fn=causal_lm_loss, seg_method=seg_method,
+                         **pipe_kwargs)
+        self.config = config

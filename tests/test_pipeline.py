@@ -565,3 +565,162 @@ class TestHybridSharedLayers:
             np.testing.assert_allclose(np.asarray(p._array),
                                        np.asarray(p2._array),
                                        rtol=2e-5, atol=2e-6)
+
+
+class TestLlamaPipe:
+    """LlamaForCausalLMPipe (PaddleNLP pipeline-llama pattern) under the
+    hybrid mesh: pp2 x mp2 x sharding2 training parity vs LlamaForCausalLM
+    with identical weights on one device."""
+
+    @staticmethod
+    def _copy_weights(pipe, ref):
+        """Map pipe stage params onto the monolithic model's params."""
+        import jax.numpy as jnp
+
+        src = {}
+        L = ref.config.num_hidden_layers
+        items = []
+        for part in range(len(pipe._stages)):
+            items.extend(pipe.get_stage_layer(part)._items)
+        emb, layers, head = items[0], items[1:1 + L], items[1 + L]
+        src["llama.embed_tokens.weight"] = emb.embed_tokens.weight
+        for i, lp in enumerate(layers):
+            for name, p in lp.layer.named_parameters():
+                src[f"llama.layers.{i}.{name}"] = p
+        src["llama.norm.weight"] = head.norm.weight
+        src["lm_head.weight"] = head.lm_head.weight
+        own = dict(ref.named_parameters())
+        assert set(own) == set(src), (set(own) ^ set(src))
+        for k, p in src.items():
+            own[k]._array = jnp.asarray(np.asarray(p._array))
+
+    def test_llama_pipe_hybrid_parity(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                             LlamaForCausalLMPipe)
+        from paddle_tpu.models.llama import causal_lm_loss
+
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                                   "pp_degree": 2, "sharding_degree": 2,
+                                   "sep_degree": 1}
+        strategy.sharding_configs = {"stage": 3}
+        try:
+            dist.fleet.init(is_collective=True, strategy=strategy)
+            paddle.seed(0)
+            cfg = LlamaConfig.tiny(num_hidden_layers=2,
+                                   use_flash_attention=False)
+            pipe = LlamaForCausalLMPipe(cfg)
+            assert pipe.num_stages == 2
+            pp = dist.fleet.distributed_model(pipe)
+            assert pp._hybrid
+            opt_p = SGD(learning_rate=0.05, parameters=pipe.parameters())
+        finally:
+            dist.set_hybrid_communicate_group(None)
+
+        paddle.seed(1)  # different init; weights copied from the pipe below
+        ref = LlamaForCausalLM(cfg)
+        self._copy_weights(pipe, ref)
+        opt_r = SGD(learning_rate=0.05, parameters=ref.parameters())
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (4, 17))
+        x, y = ids[:, :-1], ids[:, 1:]
+        for _ in range(2):
+            loss_p = pp.train_batch(
+                [paddle.to_tensor(x), paddle.to_tensor(y)], opt_p,
+            )
+            loss_r, _ = ref(paddle.to_tensor(x), labels=paddle.to_tensor(y))
+            loss_r.backward()
+            opt_r.step()
+            opt_r.clear_grad()
+            np.testing.assert_allclose(float(np.asarray(loss_p)),
+                                       float(loss_r.numpy()), rtol=2e-5)
+
+
+class TestHybridVPP:
+    def test_vpp_under_hybrid_mesh_parity(self):
+        """Interleaved VPP (S=2 stages x V=2 chunks) composed with mp2 on
+        the hybrid mesh: chunks of a stage colocate on the stage's submesh
+        (part % S mapping), loss parity vs single-device."""
+        import paddle_tpu.distributed as dist
+
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                                   "pp_degree": 2, "sharding_degree": 2,
+                                   "sep_degree": 1}
+        descs = TestHybridMeshPP._tp_descs(16, 8)
+        try:
+            dist.fleet.init(is_collective=True, strategy=strategy)
+            paddle.seed(13)
+            pipe = PipelineLayer(descs, num_stages=2, loss_fn=_mse,
+                                 num_virtual_pipeline_stages=2)
+            snap = _snapshot(pipe)
+            pp = PipelineParallel(pipe, hcg=dist.get_hybrid_communicate_group(),
+                                  accumulate_steps=4, schedule="1F1B")
+            assert pp._hybrid and len(pipe._stages) == 4
+            # chunk c of stage s colocates with stage s (part = c*S + s)
+            assert pp._stage_meshes[0] is pp._stage_meshes[2]
+            assert pp._stage_meshes[1] is pp._stage_meshes[3]
+            opt_p = SGD(learning_rate=0.1, parameters=pipe.parameters())
+        finally:
+            dist.set_hybrid_communicate_group(None)
+
+        paddle.seed(13)
+        ref = PipelineLayer(TestHybridMeshPP._tp_descs(16, 8), num_stages=2,
+                            loss_fn=_mse, num_virtual_pipeline_stages=2)
+        _load(ref, snap)
+        opt_r = SGD(learning_rate=0.1, parameters=ref.parameters())
+        rng = np.random.RandomState(1)
+        for _ in range(2):
+            x = rng.randn(8, 16).astype("float32")
+            lbl = rng.randn(8, 16).astype("float32")
+            loss_p = pp.train_batch(
+                [paddle.to_tensor(x), paddle.to_tensor(lbl)], opt_p)
+            out = ref(paddle.to_tensor(x))
+            loss_r = _mse(out, paddle.to_tensor(lbl))
+            loss_r.backward()
+            opt_r.step()
+            opt_r.clear_grad()
+            np.testing.assert_allclose(float(loss_p), float(loss_r),
+                                       rtol=1e-5)
+
+
+def test_llama_pipe_tied_embeddings_hybrid():
+    """tie_word_embeddings in the pipe model: ONE shared weight serves the
+    first-stage embedding and the last-stage head (SharedLayerDesc), and it
+    receives gradients from both ends under the hybrid mesh."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLMPipe
+    from paddle_tpu.optimizer import SGD as _SGD
+
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                               "pp_degree": 2, "sharding_degree": 2,
+                               "sep_degree": 1}
+    try:
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(num_hidden_layers=2,
+                               use_flash_attention=False,
+                               tie_word_embeddings=True)
+        pipe = LlamaForCausalLMPipe(cfg)
+        embeds = [pipe.get_stage_layer(0)._items[0],
+                  pipe.get_stage_layer(1)._items[-1]]
+        assert embeds[0] is embeds[1]  # one shared layer object
+        # no separate lm_head parameter exists
+        names = [k for k, _ in pipe.named_parameters()]
+        assert not any("lm_head" in k for k in names)
+        pp = dist.fleet.distributed_model(pipe)
+        opt = _SGD(learning_rate=0.05, parameters=pipe.parameters())
+    finally:
+        dist.set_hybrid_communicate_group(None)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 17))
+    before = np.asarray(embeds[0].embed_tokens.weight._array).copy()
+    losses = [float(np.asarray(pp.train_batch(
+        [paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])], opt)))
+        for _ in range(4)]
+    after = np.asarray(embeds[0].embed_tokens.weight._array)
+    assert losses[-1] < losses[0]          # learns
+    assert not np.allclose(before, after)  # tied weight got grads
