@@ -724,3 +724,105 @@ def test_llama_pipe_tied_embeddings_hybrid():
     after = np.asarray(embeds[0].embed_tokens.weight._array)
     assert losses[-1] < losses[0]          # learns
     assert not np.allclose(before, after)  # tied weight got grads
+
+
+def test_llama_pipe_sep_ring_attention_hybrid():
+    """pp2 x sep2 x sharding2: context parallelism (ring attention over the
+    sep axis) runs INSIDE each pipeline stage's submesh — the last
+    composition of the 5-axis topology. Instrumented to prove the ring path
+    traced; loss parity vs single device (ring reorders the softmax
+    reduction, so approximate)."""
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.distributed.context_parallel as cp
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLMPipe,
+                                         causal_lm_loss)
+    import jax.numpy as jnp
+
+    calls = []
+    orig_ring = cp.ring_attention
+
+    def counting_ring(*a, **k):
+        calls.append(1)
+        return orig_ring(*a, **k)
+
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 2,
+                               "sep_degree": 2}
+    cp.ring_attention = counting_ring
+    try:
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(num_hidden_layers=2,
+                               use_flash_attention=False, sep_mode="ring")
+        pipe = LlamaForCausalLMPipe(cfg)
+        snap = _snapshot(pipe)
+        pp = dist.fleet.distributed_model(pipe)
+        opt = SGD(0.05, parameters=pipe.parameters())
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (4, 33))  # seq 32 % sep2 == 0
+        loss_p = float(np.asarray(pp.train_batch(
+            [paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])],
+            opt)))
+        assert calls, "ring attention must trace inside the stage jit"
+    finally:
+        cp.ring_attention = orig_ring
+        dist.set_hybrid_communicate_group(None)
+
+    paddle.seed(9)
+    ref = LlamaForCausalLMPipe(cfg, num_stages=2)
+    _load(ref, snap)
+    out = ref(paddle.to_tensor(ids[:, :-1]))
+    loss_r = float(causal_lm_loss(out, paddle.to_tensor(ids[:, 1:])).numpy())
+    np.testing.assert_allclose(loss_p, loss_r, rtol=1e-5)
+
+
+def test_tied_weights_global_norm_clip_hybrid():
+    """Tied embeddings + ClipGradByGlobalNorm under the hybrid mesh: the
+    shared param's grad accumulator lives on the LAST stage's submesh (its
+    bwd runs first), so the lifted global-norm reduction must align grads
+    to their params' placements before fusing (found by the pipeline
+    example; parity vs single-device clip)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLMPipe,
+                                         causal_lm_loss)
+    from paddle_tpu.optimizer import AdamW, ClipGradByGlobalNorm
+
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                               "pp_degree": 2, "sharding_degree": 2,
+                               "sep_degree": 1}
+    try:
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(num_hidden_layers=2,
+                               use_flash_attention=False,
+                               tie_word_embeddings=True)
+        pipe = LlamaForCausalLMPipe(cfg)
+        snap = _snapshot(pipe)
+        pp = dist.fleet.distributed_model(pipe)
+        opt_p = AdamW(5e-3, parameters=pipe.parameters(),
+                      grad_clip=ClipGradByGlobalNorm(0.5))
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (4, 17))
+        losses = [float(np.asarray(pp.train_batch(
+            [paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])],
+            opt_p))) for _ in range(2)]
+    finally:
+        dist.set_hybrid_communicate_group(None)
+
+    # single-device reference: same tied pipe + same clipped AdamW
+    paddle.seed(9)
+    ref = LlamaForCausalLMPipe(cfg, num_stages=2)
+    _load(ref, snap)
+    opt_r = AdamW(5e-3, parameters=ref.parameters(),
+                  grad_clip=ClipGradByGlobalNorm(0.5))
+    ref_losses = []
+    for _ in range(2):
+        loss = causal_lm_loss(ref(paddle.to_tensor(ids[:, :-1])),
+                              paddle.to_tensor(ids[:, 1:]))
+        loss.backward()
+        opt_r.step()
+        opt_r.clear_grad()
+        ref_losses.append(float(loss.numpy()))
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-5)
