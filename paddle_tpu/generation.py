@@ -38,8 +38,6 @@ from .framework import random as _random
 def _rope_rows(x, cos, sin, row_pos):
     """RoPE with PER-ROW positions: x [B,S,H,D], row_pos [B] — row b's
     token s sits at absolute position row_pos[b]+s (ragged decode)."""
-    from .ops.pallas.fused_norm import rope_ref
-
     S = x.shape[1]
     idx = row_pos[:, None] + jnp.arange(S)[None, :]        # [B, S]
     cos_b = cos[idx]                                       # [B, S, D]
@@ -123,24 +121,24 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
 
 
 def paged_cached_attention(q, k, v, cos, sin, k_pages, v_pages, page_indices,
-                           lengths, pos, page_size):
+                           lengths, page_size):
     """Single-token decode over the PAGED cache (in-layer dispatch).
 
     q [B,1,H,D]; pages [hk, n_pages, page_size, D]; lengths [B] = tokens
-    already present. Writes the new token at buffer position ``pos`` and
-    attends through the device-appropriate paged kernel.
+    already present PER ROW. Fully ragged: row b's new token is RoPE'd at
+    position lengths[b] and written at its own page/slot
+    (page_indices[b, lengths[b]//ps], lengths[b]%ps) — the
+    block_multi_head_attention write pattern, which is what lets a
+    continuous-batching server mix requests of different lengths in one
+    step.
     """
-    from .ops.pallas.fused_norm import rope_ref
-
     B = q.shape[0]
-    pos = jnp.asarray(pos, jnp.int32)
-    cos_s = jax.lax.dynamic_slice_in_dim(cos, pos, 1, 0)
-    sin_s = jax.lax.dynamic_slice_in_dim(sin, pos, 1, 0)
-    q = rope_ref(q, cos_s, sin_s)
-    k = rope_ref(k, cos_s, sin_s)
-    page = pos // page_size
-    slot = pos % page_size
-    rows = page_indices[:, page]
+    lengths = jnp.asarray(lengths, jnp.int32)
+    q = _rope_rows(q, cos, sin, lengths)
+    k = _rope_rows(k, cos, sin, lengths)
+    page = lengths // page_size                     # [B]
+    slot = lengths % page_size                      # [B]
+    rows = page_indices[jnp.arange(B), page]        # [B]
     k_pages = k_pages.at[:, rows, slot].set(
         jnp.moveaxis(k[:, 0], 0, 1).astype(k_pages.dtype))
     v_pages = v_pages.at[:, rows, slot].set(
@@ -453,8 +451,10 @@ class _ScanDecodeStep:
     def __call__(self, last, base_key, caches):
         bufs, aux = _split_caches(caches)
         # scan carries must be type-stable across iterations: normalize the
-        # python-int pos (static after prefill) to a traced-compatible array
-        aux = [dict(a, pos=jnp.asarray(a["pos"], jnp.int32)) for a in aux]
+        # python-int pos (static after prefill; absent in paged caches,
+        # which track per-row lengths instead) to a traced-compatible array
+        aux = [dict(a, **({"pos": jnp.asarray(a["pos"], jnp.int32)}
+                          if "pos" in a else {})) for a in aux]
         toks, last_f, nb, na = self._jitted(self._state, last, base_key,
                                             bufs, aux)
         return toks, last_f, [{**b, **a} for b, a in zip(nb, na)]
@@ -515,12 +515,6 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     pad_mask = None
     lengths = jnp.full((B,), S0, jnp.int32)
     if attention_mask is not None:
-        if paged:
-            raise NotImplementedError(
-                "generate(paged=True) does not support ragged batches yet: "
-                "paged decode writes at a single buffer slot per step, so "
-                "per-row lengths would attend stale pad slots. Use the "
-                "dense cache (paged=False) for padded prompts.")
         if not use_cache:
             raise NotImplementedError(
                 "generate(use_cache=False) ignores attention_mask; use the "
@@ -630,13 +624,12 @@ def _caches_to_paged(caches, page_size, lengths, pad_mask):
             "k_pages": to_pages(c["k"]),
             "v_pages": to_pages(c["v"]),
             "page_indices": page_indices,
-            # lengths counts valid tokens; with right padding the pad
-            # columns hold garbage but paged_decode_attention masks by
-            # position < length, so ragged support requires no pad columns
-            # inside [0, length) — true for right padding only when the
-            # batch is uniform; ragged paged decode uses uniform S0 here
+            # per-row valid-token counts: paged_decode_attention masks by
+            # position < lengths[b], and each decode step writes row b's
+            # token at its own page/slot (lengths[b]) — right-pad garbage
+            # sits at positions >= lengths[b] until overwritten, never
+            # attended. Fully ragged batches are first-class.
             "lengths": lengths,
-            "pos": c["pos"],
             "page_size": page_size,
         })
     return out

@@ -185,11 +185,10 @@ class LlamaAttention(Layer):
                     "llama_attention_paged", paged_cached_attention,
                     q, k, v, cos, sin, kv_cache["k_pages"],
                     kv_cache["v_pages"], kv_cache["page_indices"],
-                    kv_cache["lengths"], kv_cache["pos"],
-                    kv_cache.get("page_size"))
+                    kv_cache["lengths"], kv_cache.get("page_size"))
                 result = self.o_proj(out.reshape([b, s, h * d]))
                 new = dict(kv_cache)
-                new.update(k_pages=kp, v_pages=vp, pos=kv_cache["pos"] + s,
+                new.update(k_pages=kp, v_pages=vp,
                            lengths=kv_cache["lengths"] + s)
                 return result, new
             out, k_buf, v_buf = apply(

@@ -1,0 +1,211 @@
+"""Continuous batching over the paged KV cache.
+
+Reference parity: the serving configuration the reference builds around
+``block_multi_head_attention``
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu) —
+a fixed pool of sequence slots with block tables, per-row lengths, and
+mid-flight admission (the vLLM pattern).
+
+TPU-native design: everything on-device is FIXED SHAPE — a pool of
+``max_batch`` slots, each owning a contiguous run of KV pages; every
+``step()`` decodes ONE token for ALL slots in a single jitted dispatch
+(inactive slots compute throwaway rows at length 0 — shape stability is
+worth more than skipping them on a systolic machine). The host-side engine
+does only bookkeeping: admit queued requests into free slots (bucketed
+jitted prefill + page scatter), collect sampled tokens, retire finished
+rows, immediately refill their slots. Ragged-ness is first-class because
+``paged_cached_attention`` RoPEs and writes at per-row positions.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor_class import Tensor, unwrap
+from .framework import random as _random
+from .generation import _get_decode_step, _get_prefill_step, _select
+
+
+class _Request:
+    __slots__ = ("rid", "ids", "max_new_tokens", "tokens", "slot")
+
+    def __init__(self, rid, ids, max_new_tokens):
+        self.rid = rid
+        self.ids = np.asarray(ids).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.tokens: List[int] = []
+        self.slot = -1
+
+
+class ContinuousBatchEngine:
+    """In-flight batching: add_request() any time, step() decodes one token
+    for every active slot, finished requests free their slot immediately.
+
+    >>> eng = ContinuousBatchEngine(model, max_batch=4, max_len=256)
+    >>> rid = eng.add_request(prompt_ids, max_new_tokens=64)
+    >>> done = eng.run_until_done()   # {rid: np.ndarray of generated ids}
+    """
+
+    def __init__(self, model, max_batch: int, max_len: int, page_size: int = 16,
+                 eos_token_id: Optional[int] = None, do_sample: bool = False,
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0):
+        if max_len % page_size != 0:
+            raise ValueError("max_len must be a multiple of page_size")
+        cfg = model.config
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(f"max_len {max_len} exceeds "
+                             f"max_position_embeddings {cfg.max_position_embeddings}")
+        self.model = model
+        self.max_batch, self.max_len, self.page_size = max_batch, max_len, page_size
+        self.eos_token_id = eos_token_id
+        self._sample_cfg = (do_sample, float(temperature), int(top_k), float(top_p))
+
+        hk = cfg.num_key_value_heads
+        d = cfg.hidden_size // cfg.num_attention_heads
+        dt = jnp.dtype(cfg.dtype) if isinstance(cfg.dtype, str) else cfg.dtype
+        self._pages_per_slot = max_len // page_size
+        n_pages = max_batch * self._pages_per_slot
+        page_indices = jnp.arange(n_pages, dtype=jnp.int32).reshape(
+            max_batch, self._pages_per_slot)
+        self._lengths = jnp.zeros((max_batch,), jnp.int32)
+        self._caches = [{
+            "k_pages": jnp.zeros((hk, n_pages, page_size, d), dt),
+            "v_pages": jnp.zeros((hk, n_pages, page_size, d), dt),
+            "page_indices": page_indices,
+            "lengths": self._lengths,
+            "page_size": page_size,
+        } for _ in range(cfg.num_hidden_layers)]
+        self._last = jnp.zeros((max_batch, cfg.vocab_size), jnp.float32)
+
+        self._next_rid = 0
+        self._queue: List[_Request] = []
+        self._slots: List[Optional[_Request]] = [None] * max_batch
+        self._finished: Dict[int, np.ndarray] = {}
+
+    # ---- public API ---------------------------------------------------------
+    def add_request(self, ids, max_new_tokens: int = 64) -> int:
+        ids = np.asarray(unwrap(ids) if isinstance(ids, Tensor) else ids).reshape(-1)
+        if ids.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds engine max_len {self.max_len}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, ids, max_new_tokens))
+        self._admit()
+        return rid
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    def step(self) -> Dict[int, np.ndarray]:
+        """Decode ONE token for every active slot (one fused device step);
+        returns newly finished requests {rid: generated ids}."""
+        self._admit()
+        if self.num_active == 0:
+            return self._drain_finished()
+        do_sample, temperature, top_k, top_p = self._sample_cfg
+        nxt = _select(self._last, _random.next_key(), do_sample, temperature,
+                      top_k, top_p)
+        toks = np.asarray(nxt)
+        # bookkeeping BEFORE the device step so a retired slot skips nothing
+        retiring = []
+        for s, req in enumerate(self._slots):
+            if req is None:
+                continue
+            t = int(toks[s])
+            req.tokens.append(t)
+            if (len(req.tokens) >= req.max_new_tokens
+                    or (self.eos_token_id is not None and t == self.eos_token_id)):
+                retiring.append(s)
+        step = _get_decode_step(self.model, self.max_len)
+        for c in self._caches:
+            c["lengths"] = self._lengths  # engine-owned (masks stale +1s)
+        logits, self._caches = step(nxt[:, None].astype(jnp.int32), self._caches)
+        self._last = logits[:, -1, :].astype(jnp.float32)
+        active = np.array([r is not None for r in self._slots])
+        self._lengths = jnp.where(jnp.asarray(active),
+                                  self._lengths + 1,
+                                  jnp.zeros_like(self._lengths))
+        for s in retiring:
+            req = self._slots[s]
+            self._finished[req.rid] = np.asarray(req.tokens, np.int64)
+            self._slots[s] = None
+            self._lengths = self._lengths.at[s].set(0)
+        self._admit()
+        return self._drain_finished()
+
+    def run_until_done(self, max_steps: Optional[int] = None) -> Dict[int, np.ndarray]:
+        out: Dict[int, np.ndarray] = {}
+        steps = 0
+        while self._queue or self.num_active:
+            out.update(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        out.update(self._drain_finished())
+        return out
+
+    # ---- internals ----------------------------------------------------------
+    def _drain_finished(self):
+        done, self._finished = self._finished, {}
+        return done
+
+    def _free_slot(self) -> int:
+        for s, r in enumerate(self._slots):
+            if r is None:
+                return s
+        return -1
+
+    def _bucket(self, n: int) -> int:
+        """Prompt-length bucket: next power of two, page-aligned — bounds
+        the number of prefill jit programs to O(log max_len)."""
+        b = self.page_size
+        while b < n:
+            b *= 2
+        return min(b, self.max_len)
+
+    def _admit(self):
+        while self._queue:
+            slot = self._free_slot()
+            if slot < 0:
+                return
+            req = self._queue.pop(0)
+            self._prefill_into(slot, req)
+            self._slots[slot] = req
+            req.slot = slot
+
+    def _prefill_into(self, slot: int, req: _Request):
+        """Bucketed jitted prefill of one prompt, scattered into the slot's
+        pages; the slot's last-logit row seeds sampling."""
+        S0 = int(req.ids.size)
+        bucket = self._bucket(S0)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :S0] = req.ids
+        ragged = S0 != bucket
+        prefill = _get_prefill_step(self.model, bucket, ragged)
+        lengths = jnp.asarray([S0], jnp.int32)
+        pad_mask = None
+        if ragged:
+            pad_mask = jnp.zeros((1, bucket), bool).at[0, :S0].set(True)
+        last, caches = prefill(jnp.asarray(ids), lengths, pad_mask)
+
+        ps = self.page_size
+        n_prefill_pages = bucket // ps
+        base = slot * self._pages_per_slot
+        for c_eng, c_new in zip(self._caches, caches):
+            for key in ("k", "v"):
+                buf = c_new[key][0]                      # [bucket, hk, D]
+                hk, d = buf.shape[1], buf.shape[2]
+                pages = jnp.moveaxis(
+                    buf.reshape(n_prefill_pages, ps, hk, d), 2, 0)
+                c_eng[f"{key}_pages"] = jax.lax.dynamic_update_slice(
+                    c_eng[f"{key}_pages"],
+                    pages.astype(c_eng[f"{key}_pages"].dtype),
+                    (0, base, 0, 0))
+        self._last = self._last.at[slot].set(last[0].astype(jnp.float32))
+        self._lengths = self._lengths.at[slot].set(S0)

@@ -241,3 +241,25 @@ def test_ragged_long_generation_matches_solo(tiny_model):
     solo_b = tiny_model.generate(paddle.to_tensor(b), max_new_tokens=10)
     np.testing.assert_array_equal(out.numpy()[0], solo_a.numpy()[0])
     np.testing.assert_array_equal(out.numpy()[1], solo_b.numpy()[0])
+
+
+def test_ragged_paged_decode_matches_dense(tiny_model):
+    """Ragged batches over the PAGED cache: per-row write positions +
+    per-row RoPE make padded prompts first-class in the paged layout
+    (block_multi_head_attention write pattern). Must equal the dense-cache
+    ragged run AND each row's solo run."""
+    cfg = tiny_model.config
+    rng = np.random.RandomState(5)
+    a = rng.randint(0, cfg.vocab_size, (1, 3))
+    b = rng.randint(0, cfg.vocab_size, (1, 7))
+    pad = np.zeros((1, 4), a.dtype)
+    batch = np.concatenate([np.concatenate([a, pad], 1), b], 0)
+    mask = np.array([[1, 1, 1, 0, 0, 0, 0], [1] * 7], "int64")
+    dense = tiny_model.generate(paddle.to_tensor(batch), max_new_tokens=8,
+                                attention_mask=paddle.to_tensor(mask))
+    paged = tiny_model.generate(paddle.to_tensor(batch), max_new_tokens=8,
+                                attention_mask=paddle.to_tensor(mask),
+                                paged=True, page_size=4)
+    np.testing.assert_array_equal(dense.numpy(), paged.numpy())
+    solo_a = tiny_model.generate(paddle.to_tensor(a), max_new_tokens=8)
+    np.testing.assert_array_equal(paged.numpy()[0], solo_a.numpy()[0])
