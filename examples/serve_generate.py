@@ -34,6 +34,18 @@ def main():
                           top_p=0.9, temperature=0.8)
     print("sampled:", out2.shape)
 
+    # continuous batching: requests of different lengths admitted
+    # mid-flight into a fixed slot pool over ONE paged KV cache
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    eng = ContinuousBatchEngine(model, max_batch=2, max_len=64, page_size=8)
+    rng = np.random.RandomState(0)
+    rids = [eng.add_request(rng.randint(0, cfg.vocab_size, (n,)),
+                            max_new_tokens=6) for n in (5, 9, 3)]
+    done = eng.run_until_done()
+    for rid in rids:
+        print(f"request {rid}: {done[rid].tolist()}")
+
 
 if __name__ == "__main__":
     main()

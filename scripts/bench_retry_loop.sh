@@ -7,12 +7,13 @@ deadline=$(( $(date +%s) + 21600 ))
 while [ "$(date +%s)" -lt "$deadline" ]; do
   if _BENCH_CHILD=1 timeout 110 python bench.py --probe 2>/dev/null | grep -q '"platform": "tpu"'; then
     echo "$(date -Is) tunnel UP — running benches" >> /tmp/bench_retry.log
-    timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
-    BENCH_CONFIG=8b timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
-    BENCH_CONFIG=decode timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
+    timeout 760 python bench.py >> /tmp/bench_retry.log 2>&1
+    BENCH_CONFIG=8b timeout 760 python bench.py >> /tmp/bench_retry.log 2>&1
+    BENCH_CONFIG=decode timeout 760 python bench.py >> /tmp/bench_retry.log 2>&1
+    BENCH_CONFIG=serve timeout 760 python bench.py >> /tmp/bench_retry.log 2>&1
     # batch sweep on the 1b config: _save_best keeps the highest tokens/s
-    BENCH_BATCH=8 timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
-    BENCH_BATCH=16 timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
+    BENCH_BATCH=8 timeout 760 python bench.py >> /tmp/bench_retry.log 2>&1
+    BENCH_BATCH=16 timeout 760 python bench.py >> /tmp/bench_retry.log 2>&1
     if python - <<'EOF'
 import json, sys
 state = json.load(open("BENCH_STATE.json"))
@@ -23,7 +24,7 @@ EOF
     then
       # bonus while the window is open: an XLA trace of the 8b config for
       # the BASELINE.md step-time breakdown
-      BENCH_PROFILE=1 BENCH_CONFIG=8b timeout 700 python bench.py >> /tmp/bench_retry.log 2>&1
+      BENCH_PROFILE=1 BENCH_CONFIG=8b timeout 760 python bench.py >> /tmp/bench_retry.log 2>&1
       echo "$(date -Is) all configs captured — done" >> /tmp/bench_retry.log
       exit 0
     fi
