@@ -21,6 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import nn
 from ..nn.layer import Layer
@@ -533,3 +534,118 @@ class LlamaForCausalLMPipe(PipelineLayer):
                          loss_fn=causal_lm_loss, seg_method=seg_method,
                          **pipe_kwargs)
         self.config = config
+
+
+# ---------------------------------------------------------------------------
+# HuggingFace checkpoint interop
+# ---------------------------------------------------------------------------
+
+def _hf_to_np(v):
+    try:
+        import torch
+
+        if isinstance(v, torch.Tensor):
+            return v.detach().to(torch.float32).cpu().numpy()
+    except ImportError:  # pragma: no cover
+        pass
+    return np.asarray(v)
+
+
+def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
+    """Map a transformers LlamaConfig (object or dict) onto LlamaConfig."""
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    if get("rope_scaling") not in (None, {}):
+        raise NotImplementedError(
+            "hf_config_to_llama: rope_scaling (Llama-3.1-style scaled RoPE) "
+            "is not implemented — loading would silently compute different "
+            "logits than the checkpoint's reference")
+    if get("attention_bias", False):
+        raise NotImplementedError(
+            "hf_config_to_llama: attention_bias=True checkpoints carry "
+            "q/k/v/o bias tensors this model does not represent")
+    kw = dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads",
+                                get("num_attention_heads")),
+        max_position_embeddings=get("max_position_embeddings"),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        rope_theta=get("rope_theta", 10000.0),
+        tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+    )
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict) -> "LlamaForCausalLM":
+    """Load a HuggingFace Llama checkpoint's state dict into ``model``.
+
+    Accepts torch tensors or arrays. torch ``nn.Linear`` stores weights
+    [out, in]; this build stores [in, out] (paddle convention), so every
+    projection transposes. Config names follow HF conventions, so the key
+    mapping is mechanical (docstring contract in the module header).
+    """
+    L = model.config.num_hidden_layers
+    plan = {"llama.embed_tokens.weight": ("model.embed_tokens.weight", False),
+            "llama.norm.weight": ("model.norm.weight", False)}
+    for i in range(L):
+        hf, ours = f"model.layers.{i}", f"llama.layers.{i}"
+        for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            plan[f"{ours}.self_attn.{proj}.weight"] = (
+                f"{hf}.self_attn.{proj}.weight", True)
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            plan[f"{ours}.mlp.{proj}.weight"] = (f"{hf}.mlp.{proj}.weight", True)
+        plan[f"{ours}.input_layernorm.weight"] = (
+            f"{hf}.input_layernorm.weight", False)
+        plan[f"{ours}.post_attention_layernorm.weight"] = (
+            f"{hf}.post_attention_layernorm.weight", False)
+    tied_alias = set()
+    if model.lm_head is not None:
+        src = ("lm_head.weight" if "lm_head.weight" in hf_state_dict
+               else "model.embed_tokens.weight")  # tied-in-HF checkpoint
+        plan["lm_head.weight"] = (src, True)
+    else:
+        # tied model: an HF checkpoint may still carry the lm_head alias of
+        # the embedding — represented here through the tie, not a drop
+        tied_alias.add("lm_head.weight")
+
+    # convert ONE tensor at a time (an 8B checkpoint converted wholesale
+    # would double peak host memory) and remap; set_state_dict then reuses
+    # the framework's shape-checked, dtype-cast assignment
+    mapped, consumed = {}, set()
+    for name, (hf_key, transpose) in plan.items():
+        if hf_key not in hf_state_dict:
+            raise KeyError(f"load_hf_llama: checkpoint is missing {hf_key!r}")
+        v = _hf_to_np(hf_state_dict[hf_key])
+        mapped[name] = v.T if transpose else v
+        consumed.add(hf_key)
+    leftovers = [k for k in hf_state_dict
+                 if k not in consumed and k not in tied_alias
+                 and not k.endswith("rotary_emb.inv_freq")]
+    if leftovers:
+        raise ValueError(
+            f"load_hf_llama: checkpoint tensors this model cannot represent "
+            f"(silently dropping them would change logits): {leftovers[:5]}"
+            f"{'...' if len(leftovers) > 5 else ''}")
+    missing, unexpected = model.set_state_dict(mapped)
+    assert not unexpected, unexpected  # plan keys come from named_parameters
+    if missing:
+        raise KeyError(f"load_hf_llama: model keys not covered: {missing[:5]}")
+    return model
+
+
+def llama_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a LlamaForCausalLM from a transformers model (or a raw state
+    dict + config): ``llama_from_hf(HFLlama.from_pretrained(...))``."""
+    if hf_config is None:
+        hf_config = hf_model_or_state.config
+        state = hf_model_or_state.state_dict()
+    else:
+        state = hf_model_or_state
+    cfg = hf_config_to_llama(hf_config, **config_overrides)
+    model = LlamaForCausalLM(cfg)
+    return load_hf_llama(model, state)

@@ -1,0 +1,74 @@
+"""HuggingFace Llama checkpoint interop: weight conversion + numeric
+parity against the canonical transformers implementation (the strongest
+external reference available in-image — validates RoPE/GQA/RMSNorm/SwiGLU
+semantics end to end, not just our own internal consistency)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def hf_pair():
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM as HFLlama
+    from paddle_tpu.models.llama import llama_from_hf
+
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128,
+                      rms_norm_eps=1e-5, rope_theta=10000.0,
+                      attention_bias=False, tie_word_embeddings=False)
+    hf = HFLlama(hf_cfg).eval()
+    ours = llama_from_hf(hf, dtype="float32", use_flash_attention=False)
+    return hf, ours
+
+
+def test_logits_match_transformers(hf_pair):
+    hf, ours = hf_pair
+    ids = np.random.RandomState(0).randint(0, 128, (2, 9))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_greedy_generation_matches_transformers(hf_pair):
+    hf, ours = hf_pair
+    ids = np.random.RandomState(1).randint(0, 128, (2, 7))
+    with torch.no_grad():
+        ref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                          do_sample=False).numpy()[:, 7:]
+    got = ours.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_tied_embeddings_roundtrip():
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM as HFLlama
+    from paddle_tpu.models.llama import llama_from_hf
+
+    torch.manual_seed(3)
+    hf_cfg = HFConfig(vocab_size=96, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      num_key_value_heads=1, max_position_embeddings=64,
+                      attention_bias=False, tie_word_embeddings=True)
+    hf = HFLlama(hf_cfg).eval()
+    ours = llama_from_hf(hf, dtype="float32", use_flash_attention=False)
+    assert ours.lm_head is None  # tied head maps to the tied path
+    ids = np.random.RandomState(2).randint(0, 96, (1, 5))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_shape_mismatch_rejected(hf_pair):
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, load_hf_llama
+
+    hf, _ = hf_pair
+    wrong = LlamaForCausalLM(LlamaConfig.tiny())  # different dims
+    with pytest.raises(ValueError, match="shape"):
+        load_hf_llama(wrong, hf.state_dict())
