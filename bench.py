@@ -269,6 +269,9 @@ def main():
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
+    for env in ("PD_SPLASH_BLOCK_Q", "PD_SPLASH_BLOCK_KV", "BENCH_BATCH"):
+        if os.environ.get(env):
+            rec[env.lower()] = os.environ[env]  # keep the best reproducible
     print(json.dumps(rec))
     print(f"# step={dt*1000:.1f}ms compile={compile_s:.1f}s mfu={mfu:.3f} gen={gen} "
           f"loss={float(loss.numpy()):.3f} params={model.num_parameters()/1e6:.0f}M "

@@ -49,6 +49,24 @@ def supported(q, k, v, dropout: float = 0.0, interpret: bool = False) -> bool:
     return True
 
 
+def _block_override(env: str, seq: int):
+    """Validated PD_SPLASH_BLOCK_* override: a positive multiple of 128
+    that divides ``seq``; anything else (malformed, zero, non-divisor,
+    non-MXU-tileable) falls back to None rather than crashing the bench."""
+    import os
+
+    v = os.environ.get(env)
+    if not v:
+        return None
+    try:
+        b = int(v.strip())
+    except ValueError:
+        return None
+    if b > 0 and b % 128 == 0 and seq % b == 0:
+        return b
+    return None
+
+
 def _largest_dividing_block(seq: int) -> int:
     """Largest MXU-friendly block size that divides ``seq`` (seq % 128 == 0
     is guaranteed by supported(); 512 need not divide e.g. seq=640)."""
@@ -59,7 +77,8 @@ def _largest_dividing_block(seq: int) -> int:
 
 
 @functools.lru_cache(maxsize=64)
-def _splash_kernel(h_q: int, s_q: int, s_kv: int, causal: bool, interpret: bool):
+def _splash_kernel(h_q: int, s_q: int, s_kv: int, causal: bool,
+                   interpret: bool, bq: int, bkv: int):
     """Build (and cache) the splash kernel for a head/seq/mask geometry.
 
     Mask-info construction runs on host and is O(seq²/block²); the cache
@@ -80,8 +99,6 @@ def _splash_kernel(h_q: int, s_q: int, s_kv: int, causal: bool, interpret: bool)
     else:
         base = sm.FullMask((s_q, s_kv))
     mask = sm.MultiHeadMask([base for _ in range(h_q)])
-    bq = _largest_dividing_block(s_q)
-    bkv = _largest_dividing_block(s_kv)
     sizes = sk.BlockSizes(
         block_q=bq,
         block_kv=bkv,
@@ -101,16 +118,33 @@ def _splash_kernel(h_q: int, s_q: int, s_kv: int, causal: bool, interpret: bool)
     )
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "interpret"))
-def flash_attention_bshd(q, k, v, causal: bool = False,
-                         sm_scale: float | None = None,
-                         interpret: bool = False):
-    """[B, S, H, D] x [B, S, Hkv, D] flash attention; Hkv may divide H."""
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
+                                             "interpret", "bq", "bkv"))
+def _flash_bshd_jit(q, k, v, causal, sm_scale, interpret, bq, bkv):
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     qt = jnp.swapaxes(q * jnp.asarray(scale, q.dtype), 1, 2)  # [B, H, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     kernel = _splash_kernel(qt.shape[1], qt.shape[2], kt.shape[2],
-                            causal, interpret)
+                            causal, interpret, bq, bkv)
     out = jax.vmap(kernel)(qt, kt, vt)
     return jnp.swapaxes(out, 1, 2)
+
+
+def flash_attention_bshd(q, k, v, causal: bool = False,
+                         sm_scale: float | None = None,
+                         interpret: bool = False):
+    """[B, S, H, D] x [B, S, Hkv, D] flash attention; Hkv may divide H.
+
+    Block geometry is resolved OUTSIDE the jit (env read per call, passed
+    as static args) so PD_SPLASH_BLOCK_Q/KV sweeps take effect in-process
+    on direct calls; when this traces inside an enclosing jit (the train
+    step), the geometry is baked at that outer trace, so sweeps there need
+    a fresh process — the bench children are exactly that.
+    """
+    s_q, s_kv = q.shape[1], k.shape[1]
+    bq = _block_override("PD_SPLASH_BLOCK_Q", s_q) or _largest_dividing_block(s_q)
+    bkv = (_block_override("PD_SPLASH_BLOCK_KV", s_kv)
+           or _largest_dividing_block(s_kv))
+    return _flash_bshd_jit(q, k, v, causal=causal, sm_scale=sm_scale,
+                           interpret=interpret, bq=bq, bkv=bkv)

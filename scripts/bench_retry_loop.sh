@@ -14,6 +14,14 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     # batch sweep on the 1b config: _save_best keeps the highest tokens/s
     BENCH_BATCH=8 timeout 760 python bench.py >> /tmp/bench_retry.log 2>&1
     BENCH_BATCH=16 timeout 760 python bench.py >> /tmp/bench_retry.log 2>&1
+    # splash block-geometry sweep at the 8B shape (VERDICT r3 item 5):
+    # NON-default geometries only (default at seq 4096 is 512/512, already
+    # measured by the plain 8b run); _save_best keeps the best tokens/s and
+    # the record carries pd_splash_block_* so the winner is reproducible
+    PD_SPLASH_BLOCK_Q=256 PD_SPLASH_BLOCK_KV=256 BENCH_CONFIG=8b \
+      timeout 760 python bench.py >> /tmp/bench_retry.log 2>&1
+    PD_SPLASH_BLOCK_Q=256 PD_SPLASH_BLOCK_KV=512 BENCH_CONFIG=8b \
+      timeout 760 python bench.py >> /tmp/bench_retry.log 2>&1
     if python - <<'EOF'
 import json, sys
 state = json.load(open("BENCH_STATE.json"))
