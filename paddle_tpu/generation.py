@@ -18,6 +18,7 @@ elsewhere).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 from typing import Optional
@@ -309,6 +310,21 @@ def _split_caches(caches):
     return bufs, aux
 
 
+@contextlib.contextmanager
+def _functional_weights(model, state):
+    """Temporarily install a functional parameter pytree on ``model`` inside
+    a trace, restoring the original arrays after — the shared spine of the
+    jitted prefill/decode/scan steps."""
+    own = model.state_dict()
+    snapshot = {k: t._array for k, t in own.items()}
+    model.load_functional_state(state)
+    try:
+        yield
+    finally:
+        for k, t in own.items():
+            t._array = snapshot[k]
+
+
 class _DecodeStep:
     """ONE jitted computation per generated token: embed → all layers with
     in-place (donated) cache buffers → lm-head logits. The TrainStep
@@ -318,23 +334,16 @@ class _DecodeStep:
         self._model = model
 
         def pure(state, token, bufs, aux):
-            own = model.state_dict()
-            snapshot = {k: t._array for k, t in own.items()}
-            model.load_functional_state(state)
             caches = [{**b, **a} for b, a in zip(bufs, aux)]
-            try:
-                with _tape.no_grad():
-                    hidden, new_caches = model.llama.forward_cached(
-                        wrap(token), caches, rope_len=max_len)
-                    logits = model.lm_head_logits(hidden)
-                nb, na = _split_caches(_unwrap_caches(new_caches))
-                return unwrap(logits), nb, na
-            finally:
-                for k2, t in own.items():
-                    t._array = snapshot[k2]
+            with _functional_weights(model, state), _tape.no_grad():
+                hidden, new_caches = model.llama.forward_cached(
+                    wrap(token), caches, rope_len=max_len)
+                logits = model.lm_head_logits(hidden)
+            nb, na = _split_caches(_unwrap_caches(new_caches))
+            return unwrap(logits), nb, na
 
         self._jitted = jax.jit(pure, donate_argnums=(2,))
-        self._state = {k: v for k, v in model.functional_state().items()}
+        self._state = dict(model.functional_state())
 
     def __call__(self, token, caches):
         bufs, aux = _split_caches(caches)
@@ -353,28 +362,21 @@ class _PrefillStep:
         self._model = model
 
         def pure(state, ids, lengths, pad_mask):
-            own = model.state_dict()
-            snapshot = {k: t._array for k, t in own.items()}
-            model.load_functional_state(state)
-            try:
-                with _tape.no_grad():
-                    B = ids.shape[0]
-                    caches = _empty_caches(
-                        model, B, max_len,
-                        allowed=pad_mask if ragged else None)
-                    hidden, caches = model.llama.forward_cached(
-                        wrap(ids), caches, rope_len=max_len)
-                    h_last = jnp.take_along_axis(
-                        unwrap(hidden),
-                        (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)
-                    last = unwrap(model.lm_head_logits(wrap(h_last)))[:, 0, :]
-                return last, _unwrap_caches(caches)
-            finally:
-                for k2, t in own.items():
-                    t._array = snapshot[k2]
+            with _functional_weights(model, state), _tape.no_grad():
+                B = ids.shape[0]
+                caches = _empty_caches(
+                    model, B, max_len,
+                    allowed=pad_mask if ragged else None)
+                hidden, caches = model.llama.forward_cached(
+                    wrap(ids), caches, rope_len=max_len)
+                h_last = jnp.take_along_axis(
+                    unwrap(hidden),
+                    (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)
+                last = unwrap(model.lm_head_logits(wrap(h_last)))[:, 0, :]
+            return last, _unwrap_caches(caches)
 
         self._jitted = jax.jit(pure)
-        self._state = {k: v for k, v in model.functional_state().items()}
+        self._state = dict(model.functional_state())
 
     def __call__(self, ids, lengths, pad_mask):
         return self._jitted(self._state, ids, lengths, pad_mask)
@@ -397,7 +399,7 @@ def _memoized_step(model, attr, key, factory, maxsize=None):
             cache.pop(next(iter(cache)))
         cache[key] = step
     else:
-        step._state = {k: v for k, v in model.functional_state().items()}
+        step._state = dict(model.functional_state())
     return step
 
 
@@ -419,10 +421,7 @@ class _ScanDecodeStep:
         self._model = model
 
         def pure(state, last, base_key, bufs, aux):
-            own = model.state_dict()
-            snapshot = {k: t._array for k, t in own.items()}
-            model.load_functional_state(state)
-            try:
+            with _functional_weights(model, state):
                 def body(carry, t):
                     last_t, bufs_t, aux_t = carry
                     key = jax.random.fold_in(base_key, t)
@@ -440,13 +439,10 @@ class _ScanDecodeStep:
 
                 (last_f, bufs_f, aux_f), toks = jax.lax.scan(
                     body, (last, bufs, aux), jnp.arange(steps))
-                return toks, last_f, bufs_f, aux_f
-            finally:
-                for k2, t in own.items():
-                    t._array = snapshot[k2]
+            return toks, last_f, bufs_f, aux_f
 
         self._jitted = jax.jit(pure, donate_argnums=(3,))
-        self._state = {k: v for k, v in model.functional_state().items()}
+        self._state = dict(model.functional_state())
 
     def __call__(self, last, base_key, caches):
         bufs, aux = _split_caches(caches)
