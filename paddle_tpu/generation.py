@@ -18,7 +18,6 @@ elsewhere).
 """
 from __future__ import annotations
 
-import contextlib
 import functools
 import math
 from typing import Optional
@@ -30,6 +29,7 @@ from .tensor_class import Tensor, unwrap, wrap
 from .ops.registry import apply
 from .autograd import tape as _tape
 from .framework import random as _random
+from .nn.layer import functional_weights as _functional_weights
 
 
 # ---------------------------------------------------------------------------
@@ -308,21 +308,6 @@ def _split_caches(caches):
     aux = [{k: v for k, v in c.items() if k not in _BUF_KEYS}
            for c in caches]
     return bufs, aux
-
-
-@contextlib.contextmanager
-def _functional_weights(model, state):
-    """Temporarily install a functional parameter pytree on ``model`` inside
-    a trace, restoring the original arrays after — the shared spine of the
-    jitted prefill/decode/scan steps."""
-    own = model.state_dict()
-    snapshot = {k: t._array for k, t in own.items()}
-    model.load_functional_state(state)
-    try:
-        yield
-    finally:
-        for k, t in own.items():
-            t._array = snapshot[k]
 
 
 class _DecodeStep:

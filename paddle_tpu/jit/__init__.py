@@ -200,17 +200,12 @@ class TrainStep:
 
         def pure_step(params, buffers, opt_state, rng_key, lr, *batch):
             def loss_of(p):
-                own = model.state_dict()
-                snapshot = {k: t._array for k, t in own.items()}
-                model.load_functional_state({**p, **buffers})
-                try:
-                    with _random.rng_context(rng_key):
-                        wrapped = [wrap(b) for b in batch]
-                        loss = loss_fn(model, *wrapped)
-                    return unwrap(loss)
-                finally:
-                    for k, t in own.items():
-                        t._array = snapshot[k]
+                from ..nn.layer import functional_weights
+
+                with functional_weights(model, {**p, **buffers}), \
+                        _random.rng_context(rng_key):
+                    loss = loss_fn(model, *[wrap(b) for b in batch])
+                return unwrap(loss)
 
             loss, grads = jax.value_and_grad(loss_of)(params)
             new_params, new_opt_state = optimizer.apply_gradients(opt_state, params, grads, lr=lr)
@@ -300,14 +295,11 @@ def save(layer, path, input_spec=None, **configs):
             specs = [s.to_shape_dtype_struct() for s in input_spec]
 
             def pure(state_arrs, *args):
-                own = layer.state_dict()
-                snapshot = {k: t._array for k, t in own.items()}
-                layer.load_functional_state(state_arrs)
-                try:
-                    return _unwrap_tree(layer.forward(*[wrap(a) for a in args]))
-                finally:
-                    for k, t in own.items():
-                        t._array = snapshot[k]
+                from ..nn.layer import functional_weights
+
+                with functional_weights(layer, state_arrs):
+                    return _unwrap_tree(
+                        layer.forward(*[wrap(a) for a in args]))
 
             exported = jax_export.export(jax.jit(pure))(
                 {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in state.items()},

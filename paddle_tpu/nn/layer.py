@@ -12,6 +12,7 @@ auto-parallel API (distributed/api.py).
 from __future__ import annotations
 
 import collections
+import contextlib
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -32,6 +33,23 @@ class HookRemoveHelper:
 
     def remove(self):
         self._hooks.pop(self._id, None)
+
+
+@contextlib.contextmanager
+def functional_weights(layer, state):
+    """Temporarily install a functional parameter pytree on ``layer`` inside
+    a trace, restoring the original arrays after — the shared spine of every
+    jitted step (TrainStep, pipeline stage fns, serving prefill/decode).
+    Yields the layer's live state_dict so callers can read in-trace buffer
+    updates (BatchNorm stats) before the restore."""
+    own = layer.state_dict()
+    snapshot = {k: t._array for k, t in own.items()}
+    layer.load_functional_state(state)
+    try:
+        yield own
+    finally:
+        for k, t in own.items():
+            t._array = snapshot[k]
 
 
 class Layer:
