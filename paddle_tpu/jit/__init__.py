@@ -86,22 +86,20 @@ class StaticFunction:
             def pure(state, rng_key, training, *args, **kwargs):
                 # swap traced arrays in, restore eager arrays after the trace
                 # (otherwise tracers leak into the layer's eager state)
-                own = layer.state_dict()
-                snapshot = {k: t._array for k, t in own.items()}
-                layer.load_functional_state(state)
+                from ..nn.layer import functional_weights
+
                 subs = layer.sublayers(include_self=True)
                 prev_modes = [l.training for l in subs]
                 for l in subs:
                     l.training = training
                 try:
-                    with _random.rng_context(rng_key):
+                    with functional_weights(layer, state), \
+                            _random.rng_context(rng_key):
                         out = fn(*args, **kwargs)
                     return _unwrap_tree(out)
                 finally:
                     for l, m in zip(subs, prev_modes):
                         l.training = m
-                    for k, t in own.items():
-                        t._array = snapshot[k]
 
             self._jitted = jax.jit(pure, static_argnums=(2,) + tuple(a + 3 for a in static_argnums))
         else:
