@@ -45,6 +45,12 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
+    # RoPE frequency scaling for long-context checkpoints: None, or a dict
+    # like HF's rope_scaling — {"rope_type": "llama3", "factor": 8.0,
+    # "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+    # "original_max_position_embeddings": 8192} (Llama-3.1/3.2), or
+    # {"rope_type": "linear", "factor": N} (position interpolation)
+    rope_scaling: Optional[dict] = None
     use_flash_attention: bool = True
     # attention strategy when the hybrid topology has sep_degree > 1:
     # "ring" (ppermute ring attention), "ulysses" (all-to-all head redistribution),
@@ -79,8 +85,47 @@ class LlamaConfig:
         return LlamaConfig(**base)
 
 
-def _rope_tables(seq_len, head_dim, theta, dtype=jnp.float32):
+SUPPORTED_ROPE_SCALING = ("llama3", "linear")
+
+
+def _scale_inv_freq(inv_freq, scaling: Optional[dict]):
+    """Apply HF-style rope_scaling to the base frequencies.
+
+    "llama3" (transformers modeling_rope_utils._compute_llama3_parameters):
+    wavelengths beyond the original context are divided by ``factor``,
+    short wavelengths kept, the band between smoothly interpolated.
+    "linear": classic position interpolation (all frequencies / factor).
+    """
+    if not scaling:
+        return inv_freq
+    rope_type = scaling.get("rope_type", scaling.get("type", "default"))
+    if rope_type in ("default", "none", None):
+        return inv_freq
+    if rope_type not in SUPPORTED_ROPE_SCALING:
+        raise NotImplementedError(
+            f"rope_scaling type {rope_type!r} is not implemented "
+            f"(supported: {', '.join(sorted(SUPPORTED_ROPE_SCALING))})")
+    factor = float(scaling["factor"])
+    if rope_type == "linear":
+        return inv_freq / factor
+    if rope_type == "llama3":
+        low = float(scaling["low_freq_factor"])
+        high = float(scaling["high_freq_factor"])
+        orig = float(scaling["original_max_position_embeddings"])
+        wavelen = 2.0 * math.pi / inv_freq
+        low_wavelen = orig / low
+        high_wavelen = orig / high
+        smooth = (orig / wavelen - low) / (high - low)
+        interp = (1.0 - smooth) / factor + smooth
+        scaled = jnp.where(wavelen > low_wavelen, inv_freq / factor, inv_freq)
+        in_band = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+        return jnp.where(in_band, interp * inv_freq, scaled)
+    raise AssertionError(rope_type)  # unreachable: gated above
+
+
+def _rope_tables(seq_len, head_dim, theta, dtype=jnp.float32, scaling=None):
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    inv_freq = _scale_inv_freq(inv_freq, scaling)
     t = jnp.arange(seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)  # [S, D/2]
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, D]
@@ -335,7 +380,8 @@ class LlamaModel(Layer):
         if seq_len in self._rope_cache:
             return self._rope_cache[seq_len]
         cos, sin = _rope_tables(seq_len, self.config.hidden_size // self.config.num_attention_heads,
-                                self.config.rope_theta)
+                                self.config.rope_theta,
+                                scaling=self.config.rope_scaling)
         pair = (wrap(cos), wrap(sin))
         # memoize only outside traces (a traced constant must not escape)
         try:
@@ -470,7 +516,7 @@ class LlamaDecoderLayerPipe(Layer):
         cfg = self.config
         cos, sin = _rope_tables(hidden.shape[1],
                                 cfg.hidden_size // cfg.num_attention_heads,
-                                cfg.rope_theta)
+                                cfg.rope_theta, scaling=cfg.rope_scaling)
         return self.layer(hidden, wrap(cos), wrap(sin))
 
 
@@ -555,11 +601,16 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
     """Map a transformers LlamaConfig (object or dict) onto LlamaConfig."""
     get = (hf_config.get if isinstance(hf_config, dict)
            else lambda k, d=None: getattr(hf_config, k, d))
-    if get("rope_scaling") not in (None, {}):
-        raise NotImplementedError(
-            "hf_config_to_llama: rope_scaling (Llama-3.1-style scaled RoPE) "
-            "is not implemented — loading would silently compute different "
-            "logits than the checkpoint's reference")
+    scaling = get("rope_scaling")
+    if scaling not in (None, {}):
+        rope_type = scaling.get("rope_type", scaling.get("type"))
+        if rope_type not in SUPPORTED_ROPE_SCALING + ("default",):
+            raise NotImplementedError(
+                f"hf_config_to_llama: rope_scaling type {rope_type!r} is "
+                f"not implemented (supported: "
+                f"{', '.join(SUPPORTED_ROPE_SCALING)}) — loading would "
+                "silently compute different logits than the checkpoint's "
+                "reference")
     if get("attention_bias", False):
         raise NotImplementedError(
             "hf_config_to_llama: attention_bias=True checkpoints carry "
@@ -575,6 +626,7 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
         max_position_embeddings=get("max_position_embeddings"),
         rms_norm_eps=get("rms_norm_eps", 1e-5),
         rope_theta=get("rope_theta", 10000.0),
+        rope_scaling=(dict(scaling) if scaling else None),
         tie_word_embeddings=bool(get("tie_word_embeddings", False)),
     )
     kw.update(overrides)

@@ -72,3 +72,48 @@ def test_shape_mismatch_rejected(hf_pair):
     wrong = LlamaForCausalLM(LlamaConfig.tiny())  # different dims
     with pytest.raises(ValueError, match="shape"):
         load_hf_llama(wrong, hf.state_dict())
+
+
+@pytest.mark.parametrize("rs", [
+    {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+     "high_freq_factor": 4.0, "original_max_position_embeddings": 64},
+    {"rope_type": "linear", "factor": 4.0},
+])
+def test_rope_scaling_matches_transformers(rs):
+    """Llama-3.1-style (llama3) and position-interpolation (linear)
+    rope_scaling: logits and greedy decode match the transformers
+    implementation of the scaled frequencies."""
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM as HFLlama
+    from paddle_tpu.models.llama import llama_from_hf
+
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(vocab_size=64, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      num_key_value_heads=1, max_position_embeddings=256,
+                      attention_bias=False, rope_theta=10000.0,
+                      rope_scaling=dict(rs))
+    hf = HFLlama(hf_cfg).eval()
+    ours = llama_from_hf(hf, dtype="float32", use_flash_attention=False)
+    assert ours.config.rope_scaling["rope_type"] == rs["rope_type"]
+    ids = np.random.RandomState(0).randint(0, 64, (2, 40))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+    with torch.no_grad():
+        gen_ref = hf.generate(torch.from_numpy(ids), max_new_tokens=5,
+                              do_sample=False).numpy()[:, 40:]
+    gen = ours.generate(paddle.to_tensor(ids), max_new_tokens=5).numpy()
+    np.testing.assert_array_equal(gen, gen_ref)
+
+
+def test_unsupported_rope_scaling_rejected():
+    from paddle_tpu.models.llama import hf_config_to_llama
+
+    with pytest.raises(NotImplementedError, match="yarn"):
+        hf_config_to_llama({"vocab_size": 64, "hidden_size": 64,
+                            "intermediate_size": 128, "num_hidden_layers": 1,
+                            "num_attention_heads": 2,
+                            "max_position_embeddings": 64,
+                            "rope_scaling": {"rope_type": "yarn",
+                                             "factor": 4.0}})
