@@ -503,6 +503,18 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
         am = unwrap(attention_mask) if isinstance(attention_mask, Tensor) \
             else jnp.asarray(attention_mask)
         lengths = am.astype(jnp.int32).sum(1)
+        # RIGHT padding only: RoPE positions, the cache write layout, and
+        # the last-real-logit gather all assume each row's real tokens are
+        # a CONTIGUOUS PREFIX. Left padding (HF's generation convention) or
+        # interior holes would silently rotate/gather at wrong positions —
+        # fail loudly instead.
+        prefix = jnp.arange(S0)[None, :] < lengths[:, None]
+        if bool((am.astype(bool) != prefix).any()):
+            raise ValueError(
+                "generate(attention_mask=...) expects RIGHT-padded prompts "
+                "(each row's mask is 1s then 0s); got a left-padded or "
+                "non-contiguous mask. Re-pad on the right — ragged batches "
+                "are exact in this layout.")
         pad_mask = jnp.concatenate(
             [am.astype(bool),
              jnp.ones((B, max_len - S0), bool)], axis=1)

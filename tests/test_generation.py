@@ -263,3 +263,16 @@ def test_ragged_paged_decode_matches_dense(tiny_model):
     np.testing.assert_array_equal(dense.numpy(), paged.numpy())
     solo_a = tiny_model.generate(paddle.to_tensor(a), max_new_tokens=8)
     np.testing.assert_array_equal(paged.numpy()[0], solo_a.numpy()[0])
+
+
+def test_left_padded_mask_rejected(tiny_model):
+    """Left padding (HF generation convention) would silently compute
+    wrong RoPE positions in this layout — it must fail loudly."""
+    cfg = tiny_model.config
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 5))
+    for bad in ([[0, 0, 1, 1, 1], [1, 1, 1, 1, 1]],     # left padding
+                [[1, 0, 1, 1, 0], [1, 1, 1, 1, 1]]):    # interior hole
+        with pytest.raises(ValueError, match="RIGHT-padded"):
+            tiny_model.generate(
+                paddle.to_tensor(ids), max_new_tokens=3,
+                attention_mask=paddle.to_tensor(np.array(bad, "int64")))
