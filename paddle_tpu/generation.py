@@ -515,6 +515,11 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
                 "(each row's mask is 1s then 0s); got a left-padded or "
                 "non-contiguous mask. Re-pad on the right — ragged batches "
                 "are exact in this layout.")
+        if bool((lengths < 1).any()):
+            raise ValueError(
+                "generate(attention_mask=...): every row needs at least one "
+                "real token — an all-zero mask row would decode from a pad "
+                "position's logits")
         pad_mask = jnp.concatenate(
             [am.astype(bool),
              jnp.ones((B, max_len - S0), bool)], axis=1)

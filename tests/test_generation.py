@@ -276,3 +276,9 @@ def test_left_padded_mask_rejected(tiny_model):
             tiny_model.generate(
                 paddle.to_tensor(ids), max_new_tokens=3,
                 attention_mask=paddle.to_tensor(np.array(bad, "int64")))
+    # an all-zero row passes the prefix check but has no real token to
+    # decode from — rejected explicitly, not gathered from garbage
+    empty = np.array([[0, 0, 0, 0, 0], [1, 1, 1, 1, 1]], "int64")
+    with pytest.raises(ValueError, match="at least one"):
+        tiny_model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                            attention_mask=paddle.to_tensor(empty))
