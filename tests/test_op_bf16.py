@@ -157,3 +157,55 @@ def test_adamw_update_bf16_master_weights():
 
     np.testing.assert_allclose(run("bfloat16"), run("float32"),
                                rtol=BF16_RTOL, atol=BF16_ATOL)
+
+
+def test_ring_attention_bf16():
+    """Context-parallel ring attention (raw jax kernel, GQA-native) under
+    bf16 tracks the f32 run — forward only (the kernel is pure jax, not a
+    tape op)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.context_parallel import ring_attention
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    devs = np.asarray(jax.devices()[:2])
+    mesh = Mesh(devs.reshape(2), ("sep",))
+    q = (_rng.randn(2, 8, 4, 16) * 0.5).astype("float32")
+    k = (_rng.randn(2, 8, 2, 16) * 0.5).astype("float32")
+    v = (_rng.randn(2, 8, 2, 16) * 0.5).astype("float32")
+    spec = P(None, "sep", None, None)
+
+    def run(dt):
+        import functools
+
+        cp = shard_map(
+            functools.partial(ring_attention, axis_name="sep", causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        out = cp(jnp.asarray(q, dt), jnp.asarray(k, dt), jnp.asarray(v, dt))
+        return np.asarray(out, dtype="float32")
+
+    a, b = run(jnp.float32), run(jnp.bfloat16)
+    scale = max(1.0, float(np.abs(a).max()))
+    np.testing.assert_allclose(b / scale, a / scale,
+                               rtol=BF16_RTOL, atol=BF16_ATOL)
+
+
+def test_grouped_mlp_ragged_dot_bf16():
+    """MoE grouped GEMM (lax.ragged_dot) in bf16 vs f32."""
+    import jax
+    import jax.numpy as jnp
+
+    x = _rng.randn(12, 32).astype("float32")      # tokens sorted by expert
+    w = _rng.randn(3, 32, 16).astype("float32")   # 3 experts
+    sizes = np.array([5, 4, 3], np.int32)
+
+    def run(dt):
+        return np.asarray(jax.lax.ragged_dot(
+            jnp.asarray(x, dt), jnp.asarray(w, dt),
+            jnp.asarray(sizes)), dtype="float32")
+
+    a, b = run(jnp.float32), run(jnp.bfloat16)
+    scale = max(1.0, float(np.abs(a).max()))
+    np.testing.assert_allclose(b / scale, a / scale,
+                               rtol=BF16_RTOL, atol=BF16_ATOL)
