@@ -393,6 +393,24 @@ def _get_prefill_step(model, max_len, ragged):
                           lambda: _PrefillStep(model, max_len, ragged))
 
 
+def _sample_and_forward(model, max_len, last, key, bufs, aux,
+                        do_sample, temperature, top_k, top_p):
+    """The fused per-token unit shared by the scan decode and the engine
+    step: sample from ``last``, run one cached forward, return
+    (token, next logits, split caches). Caller provides the weight context
+    (functional_weights) and the RNG key."""
+    nxt = sample_logits(last, key, do_sample=do_sample,
+                        temperature=temperature, top_k=top_k, top_p=top_p)
+    token = nxt[:, None].astype(jnp.int32)
+    caches = [{**b, **a} for b, a in zip(bufs, aux)]
+    with _tape.no_grad():
+        hidden, new_caches = model.llama.forward_cached(
+            wrap(token), caches, rope_len=max_len)
+        logits = model.lm_head_logits(hidden)
+    nb, na = _split_caches(_unwrap_caches(new_caches))
+    return nxt, unwrap(logits)[:, -1, :], nb, na
+
+
 class _ScanDecodeStep:
     """The WHOLE decode loop as one jitted ``lax.scan``: each step samples
     the next token from the carried logits, runs one cached forward, and
@@ -410,17 +428,10 @@ class _ScanDecodeStep:
                 def body(carry, t):
                     last_t, bufs_t, aux_t = carry
                     key = jax.random.fold_in(base_key, t)
-                    nxt = sample_logits(last_t, key, do_sample=do_sample,
-                                        temperature=temperature,
-                                        top_k=top_k, top_p=top_p)
-                    token = nxt[:, None].astype(jnp.int32)
-                    caches = [{**b, **a} for b, a in zip(bufs_t, aux_t)]
-                    with _tape.no_grad():
-                        hidden, new_caches = model.llama.forward_cached(
-                            wrap(token), caches, rope_len=max_len)
-                        logits = model.lm_head_logits(hidden)
-                    nb, na = _split_caches(_unwrap_caches(new_caches))
-                    return (unwrap(logits)[:, -1, :], nb, na), nxt
+                    nxt, last_n, nb, na = _sample_and_forward(
+                        model, max_len, last_t, key, bufs_t, aux_t,
+                        do_sample, temperature, top_k, top_p)
+                    return (last_n, nb, na), nxt
 
                 (last_f, bufs_f, aux_f), toks = jax.lax.scan(
                     body, (last, bufs, aux), jnp.arange(steps))
@@ -439,6 +450,39 @@ class _ScanDecodeStep:
         toks, last_f, nb, na = self._jitted(self._state, last, base_key,
                                             bufs, aux)
         return toks, last_f, [{**b, **a} for b, a in zip(nb, na)]
+
+
+class _SelectDecodeStep:
+    """sample + one cached forward fused into ONE jitted dispatch: the
+    continuous-batching engine's per-step unit (the scan variant without
+    the scan — the host must see each token for slot retirement)."""
+
+    def __init__(self, model, max_len, do_sample, temperature, top_k, top_p):
+        self._model = model
+
+        def pure(state, last, key, bufs, aux):
+            with _functional_weights(model, state):
+                nxt, last_n, nb, na = _sample_and_forward(
+                    model, max_len, last, key, bufs, aux,
+                    do_sample, temperature, top_k, top_p)
+            return nxt, last_n.astype(jnp.float32), nb, na
+
+        self._jitted = jax.jit(pure, donate_argnums=(3,))
+        self._state = dict(model.functional_state())
+
+    def __call__(self, last, key, caches):
+        bufs, aux = _split_caches(caches)
+        nxt, last_f, nb, na = self._jitted(self._state, last, key, bufs, aux)
+        return nxt, last_f, [{**b, **a} for b, a in zip(nb, na)]
+
+
+def _get_select_decode(model, max_len, do_sample, temperature, top_k, top_p):
+    key = (max_len, do_sample, float(temperature), int(top_k), float(top_p))
+    return _memoized_step(
+        model, "_select_decode_steps", key,
+        lambda: _SelectDecodeStep(model, max_len, do_sample,
+                                  float(temperature), int(top_k),
+                                  float(top_p)))
 
 
 def _get_scan_decode(model, max_len, steps, do_sample, temperature, top_k,

@@ -26,7 +26,7 @@ import numpy as np
 
 from .tensor_class import Tensor, unwrap
 from .framework import random as _random
-from .generation import _get_decode_step, _get_prefill_step, _select
+from .generation import _get_prefill_step, _get_select_decode
 
 
 class _Request:
@@ -103,16 +103,20 @@ class ContinuousBatchEngine:
         return sum(r is not None for r in self._slots)
 
     def step(self) -> Dict[int, np.ndarray]:
-        """Decode ONE token for every active slot (one fused device step);
-        returns newly finished requests {rid: generated ids}."""
+        """Decode ONE token for every active slot (sample + forward fused
+        into a single device dispatch); returns newly finished requests
+        {rid: generated ids}."""
         self._admit()
         if self.num_active == 0:
             return self._drain_finished()
         do_sample, temperature, top_k, top_p = self._sample_cfg
-        nxt = _select(self._last, _random.next_key(), do_sample, temperature,
-                      top_k, top_p)
+        step = _get_select_decode(self.model, self.max_len, do_sample,
+                                  temperature, top_k, top_p)
+        for c in self._caches:
+            c["lengths"] = self._lengths  # engine-owned (masks stale +1s)
+        nxt, self._last, self._caches = step(
+            self._last, _random.next_key(), self._caches)
         toks = np.asarray(nxt)
-        # bookkeeping BEFORE the device step so a retired slot skips nothing
         retiring = []
         for s, req in enumerate(self._slots):
             if req is None:
@@ -122,11 +126,6 @@ class ContinuousBatchEngine:
             if (len(req.tokens) >= req.max_new_tokens
                     or (self.eos_token_id is not None and t == self.eos_token_id)):
                 retiring.append(s)
-        step = _get_decode_step(self.model, self.max_len)
-        for c in self._caches:
-            c["lengths"] = self._lengths  # engine-owned (masks stale +1s)
-        logits, self._caches = step(nxt[:, None].astype(jnp.int32), self._caches)
-        self._last = logits[:, -1, :].astype(jnp.float32)
         active = np.array([r is not None for r in self._slots])
         self._lengths = jnp.where(jnp.asarray(active),
                                   self._lengths + 1,
