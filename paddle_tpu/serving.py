@@ -26,7 +26,8 @@ import numpy as np
 
 from .tensor_class import Tensor, unwrap
 from .framework import random as _random
-from .generation import _get_prefill_step, _get_select_decode
+from .generation import (_get_prefill_step, _get_select_decode,
+                         _memoized_step)
 
 
 class _Request:
@@ -80,6 +81,7 @@ class ContinuousBatchEngine:
         } for _ in range(cfg.num_hidden_layers)]
         self._last = jnp.zeros((max_batch, cfg.vocab_size), jnp.float32)
 
+        self._poisoned = False
         self._next_rid = 0
         self._queue: List[_Request] = []
         self._slots: List[Optional[_Request]] = [None] * max_batch
@@ -106,6 +108,10 @@ class ContinuousBatchEngine:
         """Decode ONE token for every active slot (sample + forward fused
         into a single device dispatch); returns newly finished requests
         {rid: generated ids}."""
+        if self._poisoned:
+            raise RuntimeError(
+                "ContinuousBatchEngine: a failed admission invalidated the "
+                "page pool; rebuild the engine and resubmit requests")
         self._admit()
         if self.num_active == 0:
             return self._drain_finished()
@@ -178,6 +184,37 @@ class ContinuousBatchEngine:
             self._slots[slot] = req
             req.slot = slot
 
+    def _scatter_fn(self, bucket: int):
+        """One jitted, page-DONATING scatter of a prefilled prompt into a
+        slot's pages across all layers (admission would otherwise rebuild
+        every layer's full page pool twice per request). Memoized on the
+        MODEL (like the prefill/decode steps) so a fresh engine over the
+        same model reuses the compiled scatter."""
+        ps = self.page_size
+        n_pages = bucket // ps
+
+        def build():
+            def scatter(pages, bufs, base):
+                out = []
+                for (kp, vp), c_new in zip(pages, bufs):
+                    new = []
+                    for pg, key in ((kp, "k"), (vp, "v")):
+                        buf = c_new[key][0]              # [bucket, hk, D]
+                        hk, d = buf.shape[1], buf.shape[2]
+                        tiles = jnp.moveaxis(
+                            buf.reshape(n_pages, ps, hk, d), 2, 0)
+                        new.append(jax.lax.dynamic_update_slice(
+                            pg, tiles.astype(pg.dtype), (0, base, 0, 0)))
+                    out.append(tuple(new))
+                return out
+
+            fn = jax.jit(scatter, donate_argnums=(0,))
+            fn._state = None  # _memoized_step refresh hook (stateless)
+            return fn
+
+        return _memoized_step(self.model, "_page_scatter_fns",
+                              (bucket, ps), build)
+
     def _prefill_into(self, slot: int, req: _Request):
         """Bucketed jitted prefill of one prompt, scattered into the slot's
         pages; the slot's last-logit row seeds sampling."""
@@ -193,18 +230,23 @@ class ContinuousBatchEngine:
             pad_mask = jnp.zeros((1, bucket), bool).at[0, :S0].set(True)
         last, caches = prefill(jnp.asarray(ids), lengths, pad_mask)
 
-        ps = self.page_size
-        n_prefill_pages = bucket // ps
         base = slot * self._pages_per_slot
-        for c_eng, c_new in zip(self._caches, caches):
-            for key in ("k", "v"):
-                buf = c_new[key][0]                      # [bucket, hk, D]
-                hk, d = buf.shape[1], buf.shape[2]
-                pages = jnp.moveaxis(
-                    buf.reshape(n_prefill_pages, ps, hk, d), 2, 0)
-                c_eng[f"{key}_pages"] = jax.lax.dynamic_update_slice(
-                    c_eng[f"{key}_pages"],
-                    pages.astype(c_eng[f"{key}_pages"].dtype),
-                    (0, base, 0, 0))
+        pages = [(c["k_pages"], c["v_pages"]) for c in self._caches]
+        try:
+            new_pages = self._scatter_fn(bucket)(
+                pages, caches, jnp.asarray(base, jnp.int32))
+        except Exception as e:
+            # the scatter DONATES the page pool: a mid-admission failure
+            # (device OOM etc.) may have invalidated it, taking every
+            # in-flight request's KV with it — poison the engine so later
+            # calls fail with context instead of 'donated buffer deleted'
+            self._poisoned = True
+            raise RuntimeError(
+                "ContinuousBatchEngine: admission failed after the page "
+                "pool was donated; the engine's KV state is invalid — "
+                "rebuild the engine and resubmit in-flight requests"
+            ) from e
+        for c_eng, (kp, vp) in zip(self._caches, new_pages):
+            c_eng["k_pages"], c_eng["v_pages"] = kp, vp
         self._last = self._last.at[slot].set(last[0].astype(jnp.float32))
         self._lengths = self._lengths.at[slot].set(S0)
