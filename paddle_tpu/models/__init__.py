@@ -13,6 +13,9 @@ _LAZY = {
     "ErnieForMaskedLM": ("ernie", "ErnieForMaskedLM"),
     "ErnieForSequenceClassification": ("ernie", "ErnieForSequenceClassification"),
     "ErnieForPretraining": ("ernie", "ErnieForPretraining"),
+    "sd3": ("sd3", None),
+    "MMDiTConfig": ("sd3", "MMDiTConfig"),
+    "MMDiT": ("sd3", "MMDiT"),
 }
 
 

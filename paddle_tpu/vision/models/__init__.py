@@ -11,6 +11,7 @@ from .small import (  # noqa: F401
     AlexNet, LeNet, MobileNetV1, MobileNetV2, VGG, alexnet, mobilenet_v1,
     mobilenet_v2, vgg11, vgg13, vgg16, vgg19)
 from .dit import DiT, DiTConfig, dit_xl_2  # noqa: F401
+from .vae import AutoencoderKL, DiagonalGaussian, VAEConfig  # noqa: F401
 from .zoo2 import (  # noqa: F401
     MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small,
     mobilenet_v3_large, DenseNet, densenet121, densenet161, densenet169,

@@ -1,0 +1,241 @@
+"""AutoencoderKL — the latent-diffusion VAE (the encode/decode half of the
+DiT / SD3 pipeline in BASELINE.json; PaddleMIX ppdiffusers AutoencoderKL).
+
+Architecture (SD family): Encoder = conv-in → N down blocks (ResNet blocks
++ strided-conv downsample) → mid (ResNet + single-head attention + ResNet)
+→ GroupNorm/SiLU → conv-out to 2·latent channels (mean ‖ logvar);
+DiagonalGaussian posterior; Decoder mirrors with nearest-neighbour
+upsample + conv. Trains with reconstruction + KL.
+
+TPU-native: everything is static-shape convs/GroupNorm (XLA lowers convs
+onto the MXU); the mid-block attention flattens HW into a token axis and
+rides the same SDPA path as the transformers, so one ``jit.TrainStep``
+compiles the whole autoencoder step.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...framework import random as _random
+from ...nn.layer import Layer
+from ...tensor_class import Tensor, unwrap, wrap
+
+
+@dataclasses.dataclass
+class VAEConfig:
+    """Defaults are the SD1.x/DiT 4-channel VAE; use :meth:`sd3` for the
+    16-channel SD3 VAE that pairs with ``models.sd3.MMDiTConfig`` defaults
+    (``MMDiTConfig.in_channels == 16``)."""
+
+    in_channels: int = 3
+    latent_channels: int = 4
+    base_channels: int = 128
+    channel_mults: Sequence[int] = (1, 2, 4, 4)
+    layers_per_block: int = 2
+    norm_groups: int = 32
+    scaling_factor: float = 0.18215   # SD latent scaling
+    shift_factor: float = 0.0         # SD3 shifts latents before scaling
+
+    @staticmethod
+    def sd3(**kw):
+        """The SD3 pairing: 16 latent channels, z' = (z - shift) * scale."""
+        base = dict(latent_channels=16, scaling_factor=1.5305,
+                    shift_factor=0.0609)
+        base.update(kw)
+        return VAEConfig(**base)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(base_channels=16, channel_mults=(1, 2),
+                    layers_per_block=1, norm_groups=4, latent_channels=4)
+        base.update(kw)
+        return VAEConfig(**base)
+
+
+class _ResnetBlock(Layer):
+    def __init__(self, cin, cout, groups):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(groups, cin, epsilon=1e-6)
+        self.conv1 = nn.Conv2D(cin, cout, 3, padding=1)
+        self.norm2 = nn.GroupNorm(groups, cout, epsilon=1e-6)
+        self.conv2 = nn.Conv2D(cout, cout, 3, padding=1)
+        self.skip = nn.Conv2D(cin, cout, 1) if cin != cout else None
+
+    def forward(self, x):
+        h = self.conv1(nn.functional.silu(self.norm1(x)))
+        h = self.conv2(nn.functional.silu(self.norm2(h)))
+        s = self.skip(x) if self.skip is not None else x
+        return s + h
+
+
+class _MidAttention(Layer):
+    """Single-head self-attention over the HW grid (SD mid-block)."""
+
+    def __init__(self, channels, groups):
+        super().__init__()
+        self.norm = nn.GroupNorm(groups, channels, epsilon=1e-6)
+        self.q = nn.Conv2D(channels, channels, 1)
+        self.k = nn.Conv2D(channels, channels, 1)
+        self.v = nn.Conv2D(channels, channels, 1)
+        self.proj = nn.Conv2D(channels, channels, 1)
+
+    def forward(self, x):
+        h = self.norm(x)
+        q, k, v = self.q(h), self.k(h), self.v(h)
+
+        def attend(qa, ka, va):
+            b, c, hh, ww = qa.shape
+            # [B, HW, 1 head, C] tokens through the shared SDPA path
+            def tok(a):
+                return a.reshape(b, c, hh * ww).transpose(0, 2, 1)[:, :, None, :]
+            out = unwrap(nn.functional.scaled_dot_product_attention(
+                wrap(tok(qa)), wrap(tok(ka)), wrap(tok(va)), is_causal=False))
+            return out[:, :, 0, :].transpose(0, 2, 1).reshape(b, c, hh, ww)
+
+        o = wrap(attend(unwrap(q), unwrap(k), unwrap(v)))
+        return x + self.proj(o)
+
+
+class Encoder(Layer):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        ch = cfg.base_channels
+        self.conv_in = nn.Conv2D(cfg.in_channels, ch, 3, padding=1)
+        blocks, downs = [], []
+        cur = ch
+        for i, m in enumerate(cfg.channel_mults):
+            out = ch * m
+            stage = [_ResnetBlock(cur if j == 0 else out, out,
+                                  cfg.norm_groups)
+                     for j in range(cfg.layers_per_block)]
+            blocks.append(nn.LayerList(stage))
+            last = i == len(cfg.channel_mults) - 1
+            downs.append(None if last
+                         else nn.Conv2D(out, out, 3, stride=2, padding=1))
+            cur = out
+        self.blocks = nn.LayerList(blocks)
+        self.downs = nn.LayerList([d for d in downs if d is not None])
+        self._down_mask = [d is not None for d in downs]
+        self.mid1 = _ResnetBlock(cur, cur, cfg.norm_groups)
+        self.mid_attn = _MidAttention(cur, cfg.norm_groups)
+        self.mid2 = _ResnetBlock(cur, cur, cfg.norm_groups)
+        self.norm_out = nn.GroupNorm(cfg.norm_groups, cur, epsilon=1e-6)
+        self.conv_out = nn.Conv2D(cur, 2 * cfg.latent_channels, 3, padding=1)
+
+    def forward(self, x):
+        h = self.conv_in(x)
+        di = 0
+        for stage, has_down in zip(self.blocks, self._down_mask):
+            for blk in stage:
+                h = blk(h)
+            if has_down:
+                h = self.downs[di](h)
+                di += 1
+        h = self.mid2(self.mid_attn(self.mid1(h)))
+        return self.conv_out(nn.functional.silu(self.norm_out(h)))
+
+
+class Decoder(Layer):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        ch = cfg.base_channels
+        mults = list(cfg.channel_mults)
+        cur = ch * mults[-1]
+        self.conv_in = nn.Conv2D(cfg.latent_channels, cur, 3, padding=1)
+        self.mid1 = _ResnetBlock(cur, cur, cfg.norm_groups)
+        self.mid_attn = _MidAttention(cur, cfg.norm_groups)
+        self.mid2 = _ResnetBlock(cur, cur, cfg.norm_groups)
+        blocks, ups = [], []
+        for i, m in enumerate(reversed(mults)):
+            out = ch * m
+            stage = [_ResnetBlock(cur if j == 0 else out, out,
+                                  cfg.norm_groups)
+                     for j in range(cfg.layers_per_block + 1)]
+            blocks.append(nn.LayerList(stage))
+            last = i == len(mults) - 1
+            ups.append(None if last else nn.Conv2D(out, out, 3, padding=1))
+            cur = out
+        self.blocks = nn.LayerList(blocks)
+        self.ups = nn.LayerList([u for u in ups if u is not None])
+        self._up_mask = [u is not None for u in ups]
+        self.norm_out = nn.GroupNorm(cfg.norm_groups, cur, epsilon=1e-6)
+        self.conv_out = nn.Conv2D(cur, cfg.in_channels, 3, padding=1)
+
+    def forward(self, z):
+        h = self.conv_in(z)
+        h = self.mid2(self.mid_attn(self.mid1(h)))
+        ui = 0
+        for stage, has_up in zip(self.blocks, self._up_mask):
+            for blk in stage:
+                h = blk(h)
+            if has_up:
+                a = unwrap(h)
+                a = jnp.repeat(jnp.repeat(a, 2, axis=2), 2, axis=3)
+                h = self.ups[ui](wrap(a))
+                ui += 1
+        return self.conv_out(nn.functional.silu(self.norm_out(h)))
+
+
+class DiagonalGaussian:
+    """Posterior q(z|x) = N(mean, diag(exp(logvar)))."""
+
+    def __init__(self, params):
+        a = unwrap(params)
+        self.mean, logvar = jnp.split(a, 2, axis=1)
+        self.logvar = jnp.clip(logvar, -30.0, 20.0)
+
+    def sample(self, key=None):
+        key = key if key is not None else _random.next_key()
+        std = jnp.exp(0.5 * self.logvar)
+        return wrap(self.mean + std * jax.random.normal(
+            key, self.mean.shape, self.mean.dtype))
+
+    def mode(self):
+        return wrap(self.mean)
+
+    def kl(self):
+        """KL(q ‖ N(0, I)) per sample, summed over latent dims."""
+        v = jnp.sum(0.5 * (self.mean ** 2 + jnp.exp(self.logvar)
+                           - 1.0 - self.logvar), axis=(1, 2, 3))
+        return wrap(v)
+
+
+class AutoencoderKL(Layer):
+    """encode(x) → DiagonalGaussian; decode(z) → reconstruction."""
+
+    def __init__(self, config: VAEConfig = None, **kw):
+        super().__init__()
+        self.config = config or VAEConfig(**kw)
+        self.encoder = Encoder(self.config)
+        self.decoder = Decoder(self.config)
+
+    def encode(self, x) -> DiagonalGaussian:
+        return DiagonalGaussian(self.encoder(x))
+
+    def decode(self, z):
+        return self.decoder(z)
+
+    def forward(self, x, sample_posterior=True):
+        post = self.encode(x)
+        z = post.sample() if sample_posterior else post.mode()
+        return self.decode(z), post
+
+    def loss(self, x, kl_weight=1e-6):
+        """Reconstruction (L1, the SD recipe's pixel term) + weighted KL."""
+        recon, post = self.forward(x)
+        rec = jnp.mean(jnp.abs(unwrap(recon) - unwrap(x)))
+        kl = jnp.mean(unwrap(post.kl()))
+        return wrap(rec + kl_weight * kl)
+
+    def scale_latents(self, z):
+        cfg = self.config
+        return wrap((unwrap(z) - cfg.shift_factor) * cfg.scaling_factor)
+
+    def unscale_latents(self, z):
+        cfg = self.config
+        return wrap(unwrap(z) / cfg.scaling_factor + cfg.shift_factor)
