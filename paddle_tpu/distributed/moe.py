@@ -334,6 +334,55 @@ class GroupedMLP(Layer):
         return wrap(self.forward_expert_batch(unwrap(x)))
 
 
+def default_ep_axes(num_experts: int):
+    """The hybrid topology's data axes (dp, sharding) whose joint degree
+    divides ``num_experts`` — the default expert-parallel placement (the
+    reference's moe group defaults to the data-parallel communicator)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return ()
+    axes = tuple(a for a in ("dp", "sharding")
+                 if hcg.mesh.get_dim_size(a) > 1)
+    if axes and num_experts % np.prod(
+            [hcg.mesh.get_dim_size(a) for a in axes]) == 0:
+        return axes
+    return ()
+
+
+def ep_constrain(arr, axes, expert_sharded: bool = True):
+    """Sharding constraint on a dispatched [E, C, M]-style block so GSPMD
+    inserts the EP all_to_all at the dispatch/combine boundary. No-op in
+    eager mode (the constraint only means something under tracing) or when
+    no hybrid mesh / axes are active."""
+    hcg = get_hybrid_communicate_group()
+    axes = tuple(axes or ())
+    if hcg is None or not axes or not isinstance(arr, jax.core.Tracer):
+        return arr
+    spec = [None] * arr.ndim
+    if expert_sharded:
+        spec[0] = axes if len(axes) > 1 else axes[0]
+    return jax.lax.with_sharding_constraint(
+        arr, NamedSharding(hcg.mesh.jax_mesh(), PartitionSpec(*spec)))
+
+
+def shard_grouped_experts(experts: "GroupedMLP", axes) -> tuple:
+    """EP placement: shard a GroupedMLP's expert dim over mesh ``axes``
+    (a multi-axis Shard when several axes fold together). Returns the axes
+    applied (() when no hybrid mesh / empty axes)."""
+    hcg = get_hybrid_communicate_group()
+    axes = tuple(axes or ())
+    if hcg is None or not axes:
+        return ()
+    mesh = hcg.mesh
+    for name in ("w1", "b1", "w2", "b2"):
+        p = getattr(experts, name)
+        spec = [None] * len(p.shape)
+        spec[0] = axes if len(axes) > 1 else axes[0]
+        p._array = jax.device_put(
+            unwrap(p), NamedSharding(mesh.jax_mesh(), PartitionSpec(*spec)))
+    return axes
+
+
 class MoELayer(Layer):
     """Mixture-of-experts layer (moe_layer.py:263).
 
@@ -393,48 +442,16 @@ class MoELayer(Layer):
                         f"EP degree {ep} (moe_group axes {axes})")
             return axes
         if moe_group is None:
-            hcg = get_hybrid_communicate_group()
-            if hcg is not None:
-                axes = tuple(a for a in ("dp", "sharding")
-                             if hcg.mesh.get_dim_size(a) > 1)
-                if axes and self.num_experts % np.prod(
-                        [hcg.mesh.get_dim_size(a) for a in axes]) == 0:
-                    return axes
+            axes = default_ep_axes(self.num_experts)
+            if axes:
+                return axes
         return ()
 
     def _shard_experts(self):
-        hcg = get_hybrid_communicate_group()
-        if hcg is None:
-            return
-        mesh = hcg.mesh
-        for name in ("w1", "b1", "w2", "b2"):
-            p = getattr(self.experts, name)
-            # the expert dim folds jointly over all EP axes (a multi-axis Shard)
-            spec = [None] * len(p.shape)
-            spec[0] = self._ep_axes if len(self._ep_axes) > 1 else self._ep_axes[0]
-            arr = jax.device_put(
-                unwrap(p), NamedSharding(mesh.jax_mesh(), PartitionSpec(*spec)))
-            p._array = arr
+        shard_grouped_experts(self.experts, self._ep_axes)
 
     def _constrain(self, arr, expert_sharded: bool):
-        """Sharding constraint on the [E, C, M] dispatched block so GSPMD
-        inserts the EP all_to_all at the dispatch/combine boundary."""
-        if not self._ep_axes:
-            return arr
-        hcg = get_hybrid_communicate_group()
-        if hcg is None:
-            return arr
-        try:
-            if not jax.core.trace_state_clean():
-                spec = [None] * arr.ndim
-                if expert_sharded:
-                    spec[0] = (self._ep_axes if len(self._ep_axes) > 1
-                               else self._ep_axes[0])
-                return jax.lax.with_sharding_constraint(
-                    arr, NamedSharding(hcg.mesh.jax_mesh(), PartitionSpec(*spec)))
-        except Exception:  # pragma: no cover
-            pass
-        return arr
+        return ep_constrain(arr, self._ep_axes, expert_sharded)
 
     # -- forward -----------------------------------------------------------
     def _dispatch_fn(self, x_flat, dispatch):

@@ -68,7 +68,8 @@ class MoEMLP(Layer):
 
     def __init__(self, config: LlamaMoEConfig):
         super().__init__(dtype=config.dtype)
-        from ..distributed.moe import GroupedMLP
+        from ..distributed.moe import (GroupedMLP, default_ep_axes,
+                                       shard_grouped_experts)
 
         self.config = config
         h = config.hidden_size
@@ -78,6 +79,12 @@ class MoEMLP(Layer):
         self.experts = GroupedMLP(config.n_routed_experts, h,
                                   config.moe_intermediate_size,
                                   activation="silu")
+        # expert parallelism: when constructed under a hybrid topology, the
+        # expert dim shards over the data axes (the reference's moe group
+        # defaults to the dp communicator) and the dispatch einsums become
+        # all_to_alls at the EP boundary
+        self._ep_axes = shard_grouped_experts(
+            self.experts, default_ep_axes(config.n_routed_experts))
         if config.n_shared_experts > 0:
             shared_cfg = dataclasses.replace(
                 config,
@@ -87,6 +94,13 @@ class MoEMLP(Layer):
         else:
             self.shared_expert = None
         self._aux_loss = None
+
+    def _ep_constrain(self, arr):
+        """Expert-dim sharding constraint on the [E, C, M] dispatched block
+        so GSPMD forms the all_to_all at the dispatch/combine boundary."""
+        from ..distributed.moe import ep_constrain
+
+        return ep_constrain(arr, self._ep_axes)
 
     def forward(self, x):
         from ..distributed.moe import compute_capacity, one_hot_dispatch
@@ -114,9 +128,11 @@ class MoEMLP(Layer):
             # dispatch tokens: [S,E,C] x [S,M] -> [E,C,M]
             xe = jnp.einsum("sec,sm->ecm", dispatch.astype(tokens.dtype),
                             tokens)
+            xe = self._ep_constrain(xe)  # all_to_all boundary (EP)
             from ..distributed.moe import _grouped_ffn
 
             ye = _grouped_ffn(xe, w1, b1, w2, b2, "silu")
+            ye = self._ep_constrain(ye)
             out = jnp.einsum("sec,ecm->sm", combine.astype(ye.dtype), ye)
             # Switch-style aux loss on the router distribution
             me = probs.mean(0)

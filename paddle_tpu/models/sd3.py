@@ -33,9 +33,25 @@ import jax.numpy as jnp
 from .. import nn
 from ..framework import random as _random
 from ..nn.layer import Layer
-from ..tensor_class import Tensor, unwrap, wrap
+import contextlib
+
+from ..tensor_class import unwrap, wrap
 from ..vision.models.dit import (FinalLayer, TimestepEmbedder,
                                  _sincos_pos_embed)
+
+
+@contextlib.contextmanager
+def _eval_mode(model):
+    """Run the sampler with the model in eval mode, restoring the caller's
+    training flag after (train loops sample periodically; the sampler must
+    not leave the model permanently in eval)."""
+    was_training = model.training
+    model.eval()
+    try:
+        yield
+    finally:
+        if was_training:
+            model.train()
 
 
 @dataclasses.dataclass
@@ -271,7 +287,6 @@ def sample_flow(model, shape, *cond, steps=28, guidance_scale=0.0,
     key = key if key is not None else _random.next_key()
     x1 = jax.random.normal(key, shape, jnp.float32)
     ts = jnp.linspace(1.0, 0.0, steps + 1)
-    model.eval()
     cond_a = [unwrap(c) for c in cond]
     unc_a = [unwrap(c) for c in uncond] if uncond is not None else None
 
@@ -289,7 +304,8 @@ def sample_flow(model, shape, *cond, steps=28, guidance_scale=0.0,
         tvec = jnp.full((shape[0],), t0, jnp.float32)
         return x + (t1 - t0) * vel(x, tvec), None
 
-    out, _ = jax.lax.scan(body, x1, jnp.arange(steps))
+    with _eval_mode(model):
+        out, _ = jax.lax.scan(body, x1, jnp.arange(steps))
     return wrap(out)
 
 
@@ -301,7 +317,6 @@ def sample_ddim(model, shape, *cond, steps=50, num_train_steps=1000,
     x = jax.random.normal(key, shape, jnp.float32)
     ab_all = _linear_alphas_bar(num_train_steps)
     idx = jnp.linspace(num_train_steps - 1, 0, steps).astype(jnp.int32)
-    model.eval()
     cond_a = [unwrap(c) for c in cond]
     unc_a = [unwrap(c) for c in uncond] if uncond is not None else None
 
@@ -323,5 +338,6 @@ def sample_ddim(model, shape, *cond, steps=50, num_train_steps=1000,
         x0 = (x - jnp.sqrt(1.0 - ab_t) * e) / jnp.sqrt(ab_t)
         return jnp.sqrt(ab_p) * x0 + jnp.sqrt(1.0 - ab_p) * e, None
 
-    out, _ = jax.lax.scan(body, x, jnp.arange(steps))
+    with _eval_mode(model):
+        out, _ = jax.lax.scan(body, x, jnp.arange(steps))
     return wrap(out)

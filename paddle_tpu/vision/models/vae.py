@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from ... import nn
 from ...framework import random as _random
 from ...nn.layer import Layer
-from ...tensor_class import Tensor, unwrap, wrap
+from ...tensor_class import unwrap, wrap
 
 
 @dataclasses.dataclass

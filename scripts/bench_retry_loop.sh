@@ -29,9 +29,14 @@ EOF
 }
 
 run_cfg() {  # $1 = BENCH_CONFIG; extra VAR=val pairs in $2..
+  # returns 0 only when the run emitted a NON-cached TPU record (a CPU
+  # fallback or cached replay does not count as a capture)
   local c="$1"; shift
   echo "$(date -Is) running config=$c $*" >> "$log"
-  env "$@" BENCH_CONFIG="$c" timeout 760 python bench.py >> "$log" 2>&1
+  local out=/tmp/bench_run_last.json
+  env "$@" BENCH_CONFIG="$c" timeout 760 python bench.py > "$out" 2>&1
+  cat "$out" >> "$log"
+  grep -q '"platform": "tpu"' "$out" && ! grep -q '"cached": true' "$out"
 }
 
 while [ "$(date +%s)" -lt "$deadline" ]; do
@@ -56,6 +61,8 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       sweep() {  # $1 = stamp name, rest = run_cfg args
         local name="$1"; shift
         [ -e "$stamp_dir/$name" ] && return 0
+        # stamp only on a real (non-cached) TPU capture — a CPU fallback
+        # must NOT mark the leg done
         run_cfg "$@" && touch "$stamp_dir/$name"
         probe_ok
       }

@@ -332,3 +332,51 @@ def test_grouped_mlp_ragged_matches_batch():
         np.testing.assert_allclose(out[start:start + n], ref, rtol=2e-4,
                                    atol=2e-5)
         start += n
+
+
+def test_llama_moe_ep_sharded_flagship():
+    """The flagship MoE LM (DeepSeekMoE/Qwen2-MoE family) constructed under
+    a hybrid topology gets its expert dims EP-sharded over the data axes,
+    and the full hybrid train step (ep x mp) matches the unsharded loss."""
+    from paddle_tpu.models.llama_moe import LlamaMoEConfig, LlamaMoEForCausalLM
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.engine import parallelize
+
+    ids = np.random.RandomState(0).randint(0, 512, (4, 33))
+
+    def build_and_step(hybrid):
+        if hybrid:
+            strategy = dist.DistributedStrategy()
+            strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+            dist.fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(7)
+        cfg = LlamaMoEConfig.tiny_moe(num_hidden_layers=2)
+        m = LlamaMoEForCausalLM(cfg)
+        o = opt.AdamW(1e-3, parameters=m.parameters())
+
+        def loss_fn(mm, x, y):
+            loss, _ = mm(x, labels=y)
+            return loss
+
+        if hybrid:
+            # every MoE layer's experts must really be EP-sharded: E=4 over
+            # dp4 -> one expert slice per dp rank
+            mlp = m.llama.layers[1].mlp
+            assert mlp._ep_axes == ("dp",)
+            shapes = {s.data.shape
+                      for s in mlp.experts.w1._array.addressable_shards}
+            assert shapes == {(1, cfg.hidden_size, cfg.moe_intermediate_size)}
+            step = parallelize(m, loss_fn, o)
+        else:
+            step = paddle.jit.train_step(m, loss_fn, o)
+        loss = step(paddle.to_tensor(ids[:, :-1]),
+                    paddle.to_tensor(ids[:, 1:]))
+        return float(loss.numpy())
+
+    try:
+        ep_loss = build_and_step(True)
+    finally:
+        dist.set_hybrid_communicate_group(None)
+    ref_loss = build_and_step(False)
+    assert np.isfinite(ep_loss)
+    np.testing.assert_allclose(ep_loss, ref_loss, rtol=2e-4)
