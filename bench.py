@@ -56,7 +56,20 @@ def _bench_config(name, on_tpu):
     from paddle_tpu.models.llama import LlamaConfig
 
     if not on_tpu:
+        if name == "longctx":
+            # long-sequence smoke: tiny model, 2k tokens in one sequence
+            return LlamaConfig.tiny(num_hidden_layers=2,
+                                    max_position_embeddings=2048), 2048, 1
         return LlamaConfig.tiny(num_hidden_layers=2), 128, 2
+    if name == "longctx":
+        # long-context leg: the 1b-class model at seq 16384 (flash/splash
+        # attention streams the KV; BASELINE "long-context first-class")
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=16384,
+            use_flash_attention=True, dtype="bfloat16")
+        return cfg, 16384, 1
     if name == "8b":
         # Llama-3-8B shape (BASELINE.json north star), depth cut to fit one
         # chip's HBM: per-layer + lm-head dims are exactly the 8B recipe so

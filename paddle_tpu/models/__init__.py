@@ -1,5 +1,7 @@
-"""Model zoo: flagship pretraining models (SURVEY §6 workload configs:
-Llama-3, DeepSeekMoE/Qwen2-MoE, ERNIE; DiT lives in vision.models)."""
+"""Model zoo: flagship pretraining models (SURVEY §6 / BASELINE.json
+workload configs): Llama-3, DeepSeekMoE/Qwen2-MoE, ERNIE (encoder) +
+ERNIE-4.5 (MoE decoder), SD3 MMDiT (DiT backbone + AutoencoderKL live in
+vision.models)."""
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
                     LlamaDecoderLayer, LlamaForCausalLMPipe)
 
@@ -13,6 +15,9 @@ _LAZY = {
     "ErnieForMaskedLM": ("ernie", "ErnieForMaskedLM"),
     "ErnieForSequenceClassification": ("ernie", "ErnieForSequenceClassification"),
     "ErnieForPretraining": ("ernie", "ErnieForPretraining"),
+    "ernie45": ("ernie45", None),
+    "Ernie45Config": ("ernie45", "Ernie45Config"),
+    "Ernie45ForCausalLM": ("ernie45", "Ernie45ForCausalLM"),
     "sd3": ("sd3", None),
     "MMDiTConfig": ("sd3", "MMDiTConfig"),
     "MMDiT": ("sd3", "MMDiT"),

@@ -1,0 +1,66 @@
+"""ERNIE-4.5 — Baidu's flagship decoder family (BASELINE.json config 2).
+
+The 4.5 generation is a heterogeneous-MoE causal LM (GQA attention, RoPE,
+SwiGLU, RMSNorm; routed experts with always-on shared experts and top-k
+softmax-renormalized gating). This module provides the text-expert slice of
+that design on the repo's MoE decoder machinery (``models.llama_moe`` —
+grouped-GEMM experts, EP sharding over the hybrid mesh, dense GShard
+dispatch); the multimodal vision-expert branch is out of scope for a
+text-pretraining framework (the reference platform trains it through
+separate PaddleMIX tooling).
+
+Role anchors: the reference serves this family with the same fused-MoE
+kernel stack as DeepSeekMoE (paddle/phi/kernels/fusion/cutlass/
+fused_moe_kernel.cu, moe_gate_dispatch SPMD rule); the architecture knobs
+below follow the published open-release configs (e.g. the 21B-A3B text
+model: 28 layers, 64 routed experts, top-6, 2 shared experts).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .llama_moe import LlamaMoEConfig, LlamaMoEForCausalLM
+
+
+@dataclasses.dataclass
+class Ernie45Config(LlamaMoEConfig):
+    """ERNIE-4.5 text-model knobs on the MoE decoder base."""
+
+    n_routed_experts: int = 64
+    n_shared_experts: int = 2
+    num_experts_per_tok: int = 6
+    norm_topk_prob: bool = True       # softmax renorm over the selected k
+    first_k_dense_replace: int = 1    # leading dense layer(s)
+    router_aux_loss_coef: float = 0.001
+
+    @staticmethod
+    def a3b(**kw):
+        """The 21B-A3B open-release shape (text experts)."""
+        base = dict(vocab_size=103424, hidden_size=2560,
+                    intermediate_size=12288, num_hidden_layers=28,
+                    num_attention_heads=20, num_key_value_heads=4,
+                    max_position_embeddings=131072,
+                    moe_intermediate_size=1536)
+        base.update(kw)
+        return Ernie45Config(**base)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                    num_hidden_layers=3, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=256,
+                    dtype="float32", n_routed_experts=4,
+                    num_experts_per_tok=2, moe_intermediate_size=64,
+                    n_shared_experts=1, first_k_dense_replace=1)
+        base.update(kw)
+        return Ernie45Config(**base)
+
+
+class Ernie45ForCausalLM(LlamaMoEForCausalLM):
+    """ERNIE-4.5-style causal LM: the MoE decoder with shared experts.
+
+    Inherits training (aux-balanced router loss), EP sharding, KV-cache
+    decode, and the serving paths unchanged from the MoE base."""
+
+    def __init__(self, config: Ernie45Config):
+        super().__init__(config)

@@ -1,0 +1,210 @@
+"""Speculative decoding: a small draft model proposes ``draft_k`` tokens,
+the target model verifies them in ONE chunked forward, and the longest
+target-greedy-consistent prefix (plus the target's bonus token) is accepted
+— per round the target runs once for up to ``draft_k + 1`` emitted tokens
+instead of once per token.
+
+Role anchor: the speculative/draft-model decode path of the reference
+platform's LLM serving stack (the same serving tier as
+paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu; the
+reference ecosystem ships it in its llm inference recipes). TPU-native
+design: both the draft proposal loop (a ``lax.scan`` of greedy steps) and
+the chunked verify are single jitted computations with donated KV buffers;
+rollback after a rejected suffix is just resetting the cache's scalar
+``pos`` — the dense serving cache (generation.cached_attention) masks
+columns ``> pos``, so stale entries beyond the accepted prefix are inert
+and get overwritten by later writes.
+
+Greedy-exactness contract: the emitted sequence is IDENTICAL to
+``target.generate(..., do_sample=False)`` — speculation changes latency,
+never output (the test asserts token equality).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd import tape as _tape
+from .generation import (_empty_caches, _memoized_step, _split_caches,
+                         _unwrap_caches)
+from .nn.layer import functional_weights as _functional_weights
+from .tensor_class import unwrap, wrap
+
+
+class _ProposeStep:
+    """Draft proposal: feed ``seed`` (1 or 2 catch-up tokens), then scan
+    ``k-1`` greedy single-token steps — one jitted dispatch for all ``k``
+    proposals, donated draft KV buffers."""
+
+    def __init__(self, model, max_len, k, seed_len):
+        self._model = model
+
+        def pure(state, seed, bufs, aux):
+            caches = [{**b, **a} for b, a in zip(bufs, aux)]
+            with _functional_weights(model, state), _tape.no_grad():
+                hidden, caches = model.llama.forward_cached(
+                    wrap(seed), caches, rope_len=max_len)
+                h_last = unwrap(hidden)[:, -1:]
+                first = jnp.argmax(
+                    unwrap(model.lm_head_logits(wrap(h_last)))[:, -1, :],
+                    axis=-1).astype(jnp.int32)
+
+                def body(carry, _):
+                    tok, caches = carry
+                    hidden, caches = model.llama.forward_cached(
+                        wrap(tok[:, None]), caches, rope_len=max_len)
+                    nxt = jnp.argmax(
+                        unwrap(model.lm_head_logits(hidden))[:, -1, :],
+                        axis=-1).astype(jnp.int32)
+                    return (nxt, caches), nxt
+
+                if k > 1:
+                    (_, caches), rest = jax.lax.scan(
+                        body, (first, caches), None, length=k - 1)
+                    toks = jnp.concatenate([first[None], rest], axis=0)
+                else:
+                    toks = first[None]
+            nb, na = _split_caches(_unwrap_caches(caches))
+            return toks.T, nb, na  # [B, k]
+
+        self._jitted = jax.jit(pure, donate_argnums=(2,))
+        self._state = dict(model.functional_state())
+
+    def __call__(self, seed, caches):
+        bufs, aux = _split_caches(caches)
+        toks, nb, na = self._jitted(self._state, seed, bufs, aux)
+        return toks, [{**b, **a} for b, a in zip(nb, na)]
+
+
+class _VerifyStep:
+    """Target verify: one chunked forward over [last, d_1..d_k]; returns
+    the target's greedy token at every chunk position."""
+
+    def __init__(self, model, max_len, chunk_len):
+        self._model = model
+
+        def pure(state, chunk, bufs, aux):
+            caches = [{**b, **a} for b, a in zip(bufs, aux)]
+            with _functional_weights(model, state), _tape.no_grad():
+                hidden, caches = model.llama.forward_cached(
+                    wrap(chunk), caches, rope_len=max_len)
+                logits = unwrap(model.lm_head_logits(hidden))
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nb, na = _split_caches(_unwrap_caches(caches))
+            return greedy, nb, na  # [B, chunk_len]
+
+        self._jitted = jax.jit(pure, donate_argnums=(2,))
+        self._state = dict(model.functional_state())
+
+    def __call__(self, chunk, caches):
+        bufs, aux = _split_caches(caches)
+        greedy, nb, na = self._jitted(self._state, chunk, bufs, aux)
+        return greedy, [{**b, **a} for b, a in zip(nb, na)]
+
+
+def _set_pos(caches, pos):
+    for c in caches:
+        c["pos"] = jnp.asarray(pos, jnp.int32)
+    return caches
+
+
+def _prefill(model, ids, max_len):
+    """Whole-prompt prefill into fresh caches; returns (greedy_next, caches)."""
+    def run(state, ids):
+        with _functional_weights(model, state), _tape.no_grad():
+            caches = _empty_caches(model, ids.shape[0], max_len)
+            hidden, caches = model.llama.forward_cached(
+                wrap(ids), caches, rope_len=max_len)
+            h_last = unwrap(hidden)[:, -1:]
+            last = unwrap(model.lm_head_logits(wrap(h_last)))[:, -1, :]
+        return (jnp.argmax(last, axis=-1).astype(jnp.int32),
+                _unwrap_caches(caches))
+
+    jitted = _memoized_step(model, "_spec_prefill_steps", max_len,
+                            lambda: jax.jit(run))
+    return jitted(dict(model.functional_state()), ids)
+
+
+def speculative_generate(target, draft, input_ids, max_new_tokens=20,
+                         draft_k=4, eos_token_id=None):
+    """Greedy speculative decode of ``input_ids`` [1, P] → [1, P + new].
+
+    Batch size 1 (per-request serving): the dense cache keeps ONE scalar
+    write position, and rows accepting different prefix lengths would need
+    per-row rollback. Output is exactly ``target.generate`` greedy.
+    """
+    ids = np.asarray(unwrap(input_ids) if hasattr(input_ids, "shape")
+                     else input_ids)
+    if ids.ndim == 1:
+        ids = ids[None]
+    if ids.shape[0] != 1:
+        raise ValueError(
+            "speculative_generate is per-request (batch 1); run rows "
+            "separately or use model.generate for batched decode")
+    B, P = ids.shape
+    k = int(draft_k)
+    assert k >= 1
+    max_len = P + max_new_tokens + k + 2
+    for name, m in (("target", target), ("draft", draft)):
+        limit = m.config.max_position_embeddings
+        if max_len > limit:
+            raise ValueError(
+                f"speculative_generate: prompt+new(+{k + 2} speculation "
+                f"slack) = {max_len} exceeds the {name} model's "
+                f"max_position_embeddings {limit}")
+    ids = jnp.asarray(ids, jnp.int32)
+
+    t0, tgt_caches = _prefill(target, ids, max_len)
+    _, dft_caches = _prefill(draft, ids, max_len)
+    tgt_pos, dft_pos = P, P
+
+    emitted = [int(t0[0])]
+    last = int(t0[0])
+    catchup = []  # accepted tokens not yet written to the draft cache
+
+    def propose_step(seed_len):
+        return _memoized_step(
+            draft, "_spec_propose_steps", (max_len, k, seed_len),
+            lambda: _ProposeStep(draft, max_len, k, seed_len))
+
+    verify_step = _memoized_step(
+        target, "_spec_verify_steps", (max_len, k + 1),
+        lambda: _VerifyStep(target, max_len, k + 1))
+
+    while len(emitted) < max_new_tokens and \
+            (eos_token_id is None or emitted[-1] != eos_token_id):
+        seed = jnp.asarray([catchup + [last]], jnp.int32)   # [1, 1|2]
+        dft_caches = _set_pos(dft_caches, dft_pos)
+        proposals, dft_caches = propose_step(seed.shape[1])(seed, dft_caches)
+        props = [int(x) for x in np.asarray(proposals[0])]   # d_1..d_k
+
+        chunk = jnp.asarray([[last] + props], jnp.int32)     # [1, k+1]
+        tgt_caches = _set_pos(tgt_caches, tgt_pos)
+        greedy, tgt_caches = verify_step(chunk, tgt_caches)
+        g = [int(x) for x in np.asarray(greedy[0])]          # g_0..g_k
+
+        m = 0
+        while m < k and props[m] == g[m]:
+            m += 1
+        accepted = props[:m] + [g[m]]                        # ≤ k+1 tokens
+
+        # context now ends ...last, d_1..d_m, g_m; g_m is the new `last`
+        ctx_len_old = tgt_pos + 1        # context length BEFORE this round
+        tgt_pos = ctx_len_old + m        # target holds ctx + d_1..d_m
+        if m == k:                       # draft never wrote d_k's entry
+            dft_pos = ctx_len_old + (k - 1)
+            catchup = [props[-1]]
+        else:                            # d_1..d_m all in the draft cache
+            dft_pos = ctx_len_old + m
+            catchup = []
+        last = accepted[-1]
+        emitted.extend(accepted)
+        if eos_token_id is not None and eos_token_id in accepted:
+            break
+
+    emitted = emitted[:max_new_tokens]
+    if eos_token_id is not None and eos_token_id in emitted:
+        emitted = emitted[:emitted.index(eos_token_id) + 1]
+    # same convention as model.generate: only the NEW tokens
+    return wrap(jnp.asarray(np.asarray(emitted, np.int32)[None]))

@@ -33,16 +33,20 @@ run_cfg() {  # $1 = BENCH_CONFIG; extra VAR=val pairs in $2..
   # fallback or cached replay does not count as a capture)
   local c="$1"; shift
   echo "$(date -Is) running config=$c $*" >> "$log"
-  local out=/tmp/bench_run_last.json
+  local out rc
+  out=$(mktemp /tmp/bench_run.XXXXXX)   # per-call: concurrent-loop safe
   env "$@" BENCH_CONFIG="$c" timeout 760 python bench.py > "$out" 2>&1
   cat "$out" >> "$log"
   grep -q '"platform": "tpu"' "$out" && ! grep -q '"cached": true' "$out"
+  rc=$?
+  rm -f "$out"
+  return $rc
 }
 
 while [ "$(date +%s)" -lt "$deadline" ]; do
   if probe_ok; then
     echo "$(date -Is) tunnel UP" >> "$log"
-    for c in 8b decode serve 1b; do
+    for c in 8b decode serve 1b longctx; do
       have "$c" && continue
       run_cfg "$c"
       if ! probe_ok; then
@@ -50,7 +54,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
         continue 2
       fi
     done
-    if have 8b && have decode && have serve; then
+    if have 8b && have decode && have serve && have longctx; then
       # core table captured — bonus passes while the window stays open:
       # batch sweep on 1b (best tokens/s wins in BENCH_STATE), splash
       # block-geometry sweep at the 8B shape, then a profiled 8b trace

@@ -1,0 +1,67 @@
+"""Speculative decoding: greedy output must be token-identical to the
+target model's own greedy generate, for both a disagreeing random draft
+(low acceptance, exercises rollback) and a perfect draft (= the target,
+full acceptance, exercises the draft catch-up path)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.speculative import speculative_generate
+
+
+def _models():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    target = LlamaForCausalLM(cfg)
+    target.eval()
+    paddle.seed(123)
+    draft_cfg = LlamaConfig.tiny(num_hidden_layers=1)
+    draft = LlamaForCausalLM(draft_cfg)
+    draft.eval()
+    return target, draft, cfg
+
+
+def test_speculative_matches_target_greedy():
+    target, draft, cfg = _models()
+    prompt = np.random.RandomState(0).randint(0, cfg.vocab_size, (1, 9))
+    ref = target.generate(paddle.to_tensor(prompt), max_new_tokens=12).numpy()
+    for k in (1, 3, 4):
+        out = speculative_generate(target, draft,
+                                   paddle.to_tensor(prompt),
+                                   max_new_tokens=12, draft_k=k).numpy()
+        np.testing.assert_array_equal(out, ref), k
+
+
+def test_speculative_perfect_draft_full_acceptance():
+    """Draft == target: every round accepts all k proposals + bonus, which
+    drives the m == k draft catch-up branch every round."""
+    target, _, cfg = _models()
+    prompt = np.random.RandomState(1).randint(0, cfg.vocab_size, (1, 7))
+    ref = target.generate(paddle.to_tensor(prompt), max_new_tokens=10).numpy()
+    out = speculative_generate(target, target, paddle.to_tensor(prompt),
+                               max_new_tokens=10, draft_k=3).numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_speculative_eos_stops():
+    target, draft, cfg = _models()
+    prompt = np.random.RandomState(2).randint(0, cfg.vocab_size, (1, 6))
+    ref = target.generate(paddle.to_tensor(prompt), max_new_tokens=10,
+                          eos_token_id=None).numpy()
+    # pick the 3rd generated token as "eos" so it lands mid-acceptance
+    # (generate returns only NEW tokens, so index 2 is the 3rd generated)
+    eos = int(ref[0, 2])
+    ref_eos = target.generate(paddle.to_tensor(prompt), max_new_tokens=10,
+                              eos_token_id=eos).numpy()
+    out = speculative_generate(target, draft, paddle.to_tensor(prompt),
+                               max_new_tokens=10, draft_k=4,
+                               eos_token_id=eos).numpy()
+    np.testing.assert_array_equal(out, ref_eos)
+
+
+def test_speculative_rejects_batched_input():
+    target, draft, cfg = _models()
+    with pytest.raises(ValueError):
+        speculative_generate(target, draft,
+                             paddle.to_tensor(np.zeros((2, 4), np.int64)))
