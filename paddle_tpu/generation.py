@@ -393,6 +393,81 @@ def _get_prefill_step(model, max_len, ragged):
                           lambda: _PrefillStep(model, max_len, ragged))
 
 
+class _ChunkedPrefillStep:
+    """Prefill as ONE jitted ``lax.scan`` over fixed-size prompt chunks
+    (vLLM-style chunked prefill, TPU-shaped): compile cost scales with the
+    CHUNK COUNT bucket instead of one compile per prompt-shape, and the
+    per-layer MLP/projection activations are one chunk's worth. Chunk c
+    writes cache entries [cC, cC+C) and attends to every earlier entry
+    through the cache's pos/column masking, so the result is exactly the
+    one-shot prefill. The running last-real-hidden is carried so only a
+    [B, H] gather (not the full prompt's hidden) leaves the loop.
+
+    Cost model: each chunk runs the DENSE cache attention (the scan's
+    traced ``pos`` rules out the flash fast path), materializing f32
+    scores of shape [B, kv_heads, group, C, max_len] per layer — pick C
+    so C x max_len stays modest (e.g. C<=256 at 16k context); total
+    attention compute is O(S x max_len), ~2x a causal-optimal kernel at
+    full length. A Pallas append-attention kernel is the future fast
+    path here."""
+
+    def __init__(self, model, max_len, chunk, n_chunks):
+        self._model = model
+        C, n = int(chunk), int(n_chunks)
+
+        def pure(state, ids_pad, lengths, allowed):
+            B = ids_pad.shape[0]
+            with _functional_weights(model, state), _tape.no_grad():
+                caches = _empty_caches(model, B, max_len, allowed=allowed)
+                for c in caches:
+                    # scan-stable carry: pos as a traced scalar, no static
+                    # "prefill" marker (its dict entry would be dropped by
+                    # the first step and change the carry structure)
+                    c.pop("prefill", None)
+                    c["pos"] = jnp.asarray(0, jnp.int32)
+                bufs, aux = _split_caches(caches)
+                chunks = ids_pad.reshape(B, n, C).transpose(1, 0, 2)
+
+                def body(carry, chunk_ids):
+                    bufs, aux, h_last, start = carry
+                    cs = [{**b, **a} for b, a in zip(bufs, aux)]
+                    hidden, cs = model.llama.forward_cached(
+                        wrap(chunk_ids), cs, rope_len=max_len)
+                    h = unwrap(hidden)
+                    idx = lengths.astype(jnp.int32) - 1 - start
+                    in_chunk = (idx >= 0) & (idx < C)
+                    picked = jnp.take_along_axis(
+                        h, jnp.clip(idx, 0, C - 1)[:, None, None], axis=1
+                    )[:, 0]
+                    h_last = jnp.where(in_chunk[:, None], picked, h_last)
+                    nb, na = _split_caches(_unwrap_caches(cs))
+                    return (nb, na, h_last, start + C), None
+
+                h0 = jnp.zeros((B, model.config.hidden_size),
+                               jnp.dtype(model.config.dtype)
+                               if isinstance(model.config.dtype, str)
+                               else model.config.dtype)
+                (bufs, aux, h_last, _), _ = jax.lax.scan(
+                    body, (bufs, aux, h0, jnp.asarray(0, jnp.int32)), chunks)
+                last = unwrap(model.lm_head_logits(
+                    wrap(h_last[:, None, :])))[:, 0, :]
+            return last, bufs, aux
+
+        self._jitted = jax.jit(pure)
+        self._state = dict(model.functional_state())
+
+    def __call__(self, ids_pad, lengths, allowed):
+        last, bufs, aux = self._jitted(self._state, ids_pad, lengths, allowed)
+        return last, [{**b, **a} for b, a in zip(bufs, aux)]
+
+
+def _get_chunked_prefill_step(model, max_len, chunk, n_chunks):
+    return _memoized_step(
+        model, "_chunked_prefill_steps", (max_len, chunk, n_chunks),
+        lambda: _ChunkedPrefillStep(model, max_len, chunk, n_chunks),
+        maxsize=8)
+
+
 def _sample_and_forward(model, max_len, last, key, bufs, aux,
                         do_sample, temperature, top_k, top_p):
     """The fused per-token unit shared by the scan decode and the engine
@@ -513,13 +588,18 @@ def _get_decode_step(model, max_len):
 def generate(model, input_ids, max_new_tokens=20, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
              use_cache=True, attention_mask=None, paged=False,
-             page_size=16):
+             page_size=16, prefill_chunk_size=None):
     """Batched autoregressive decode.
 
     ``attention_mask`` [B, S0] (1 = real token, right padding) makes
     ragged batches correct: pad columns are never attended, RoPE positions
     continue per row from each row's true length, and the first sampled
     token reads each row's last real logit.
+
+    ``prefill_chunk_size``: process the prompt as a ``lax.scan`` over
+    fixed-size chunks (chunked prefill) — compile cost buckets by chunk
+    COUNT instead of exact prompt shape, and prefill activation memory is
+    one chunk's worth. Output is identical to the one-shot prefill.
 
     Returns generated ids [B, <=max_new_tokens] (prompt excluded); stops
     early only when EVERY row has emitted eos.
@@ -529,7 +609,16 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     cfg = model.config
     if max_new_tokens <= 0:
         return wrap(jnp.zeros((B, 0), ids.dtype))
-    max_len = S0 + max_new_tokens
+    chunk = int(prefill_chunk_size) if prefill_chunk_size else 0
+    if chunk:
+        if not use_cache:
+            raise NotImplementedError(
+                "prefill_chunk_size needs the cached path (use_cache=True)")
+        n_chunks = -(-S0 // chunk)
+        prompt_pad = n_chunks * chunk   # cache slots the padded prompt uses
+    else:
+        prompt_pad = S0
+    max_len = prompt_pad + max_new_tokens
     if paged:
         max_len = -(-max_len // page_size) * page_size
     if max_len > cfg.max_position_embeddings:
@@ -576,15 +665,36 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
         # ---- prefill: one jitted computation (flash kernel + cache fill +
         # last-real-logit gather; the [B,1,H] gather before the lm head
         # keeps the vocab projection S0x smaller in HBM) ----
-        prefill = _get_prefill_step(model, max_len, pad_mask is not None)
-        last, caches = prefill(ids, lengths, pad_mask)
+        if chunk:
+            if pad_mask is None and prompt_pad == S0:
+                # evenly divisible unpadded prompt: pos masking suffices,
+                # no column mask needed
+                pass
+            else:
+                # chunked prompts are internally ragged: pad columns
+                # between each row's true length and the padded prompt
+                # region must never be attended, and decode RoPE continues
+                # per row
+                am_eff = (pad_mask[:, :S0] if pad_mask is not None
+                          else jnp.ones((B, S0), bool))
+                pad_mask = jnp.concatenate(
+                    [am_eff, jnp.zeros((B, prompt_pad - S0), bool),
+                     jnp.ones((B, max_len - prompt_pad), bool)], axis=1)
+            ids_pad = jnp.concatenate(
+                [ids, jnp.zeros((B, prompt_pad - S0), ids.dtype)], axis=1)
+            prefill = _get_chunked_prefill_step(model, max_len, chunk,
+                                                n_chunks)
+            last, caches = prefill(ids_pad, lengths, pad_mask)
+        else:
+            prefill = _get_prefill_step(model, max_len, pad_mask is not None)
+            last, caches = prefill(ids, lengths, pad_mask)
 
         if paged:
             caches = _caches_to_paged(caches, page_size, lengths, pad_mask)
 
         # per-row RoPE positions for the generated tokens (ragged batches
         # continue at each row's true length)
-        if pad_mask is not None and not paged:
+        if (pad_mask is not None or chunk) and not paged:
             for c in caches:
                 c["row_pos"] = lengths
 

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .autograd import tape as _tape
-from .generation import (_empty_caches, _memoized_step, _split_caches,
+from .generation import (_get_prefill_step, _memoized_step, _split_caches,
                          _unwrap_caches)
 from .nn.layer import functional_weights as _functional_weights
 from .tensor_class import unwrap, wrap
@@ -110,20 +110,12 @@ def _set_pos(caches, pos):
 
 
 def _prefill(model, ids, max_len):
-    """Whole-prompt prefill into fresh caches; returns (greedy_next, caches)."""
-    def run(state, ids):
-        with _functional_weights(model, state), _tape.no_grad():
-            caches = _empty_caches(model, ids.shape[0], max_len)
-            hidden, caches = model.llama.forward_cached(
-                wrap(ids), caches, rope_len=max_len)
-            h_last = unwrap(hidden)[:, -1:]
-            last = unwrap(model.lm_head_logits(wrap(h_last)))[:, -1, :]
-        return (jnp.argmax(last, axis=-1).astype(jnp.int32),
-                _unwrap_caches(caches))
-
-    jitted = _memoized_step(model, "_spec_prefill_steps", max_len,
-                            lambda: jax.jit(run))
-    return jitted(dict(model.functional_state()), ids)
+    """Whole-prompt prefill (generation's one-shot jitted step); returns
+    (greedy_next, caches)."""
+    step = _get_prefill_step(model, max_len, ragged=False)
+    lengths = jnp.full((ids.shape[0],), ids.shape[1], jnp.int32)
+    last, caches = step(ids, lengths, None)
+    return jnp.argmax(last, axis=-1).astype(jnp.int32), caches
 
 
 def speculative_generate(target, draft, input_ids, max_new_tokens=20,
@@ -136,6 +128,7 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=20,
     """
     ids = np.asarray(unwrap(input_ids) if hasattr(input_ids, "shape")
                      else input_ids)
+    out_dtype = ids.dtype
     if ids.ndim == 1:
         ids = ids[None]
     if ids.shape[0] != 1:
@@ -144,7 +137,8 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=20,
             "separately or use model.generate for batched decode")
     B, P = ids.shape
     k = int(draft_k)
-    assert k >= 1
+    if k < 1:
+        raise ValueError(f"draft_k must be >= 1, got {draft_k}")
     max_len = P + max_new_tokens + k + 2
     for name, m in (("target", target), ("draft", draft)):
         limit = m.config.max_position_embeddings
@@ -166,11 +160,11 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=20,
     def propose_step(seed_len):
         return _memoized_step(
             draft, "_spec_propose_steps", (max_len, k, seed_len),
-            lambda: _ProposeStep(draft, max_len, k, seed_len))
+            lambda: _ProposeStep(draft, max_len, k, seed_len), maxsize=8)
 
     verify_step = _memoized_step(
         target, "_spec_verify_steps", (max_len, k + 1),
-        lambda: _VerifyStep(target, max_len, k + 1))
+        lambda: _VerifyStep(target, max_len, k + 1), maxsize=8)
 
     while len(emitted) < max_new_tokens and \
             (eos_token_id is None or emitted[-1] != eos_token_id):
@@ -206,5 +200,5 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=20,
     emitted = emitted[:max_new_tokens]
     if eos_token_id is not None and eos_token_id in emitted:
         emitted = emitted[:emitted.index(eos_token_id) + 1]
-    # same convention as model.generate: only the NEW tokens
-    return wrap(jnp.asarray(np.asarray(emitted, np.int32)[None]))
+    # same convention as model.generate: only the NEW tokens, input dtype
+    return wrap(jnp.asarray(np.asarray(emitted, out_dtype)[None]))

@@ -282,3 +282,72 @@ def test_left_padded_mask_rejected(tiny_model):
     with pytest.raises(ValueError, match="at least one"):
         tiny_model.generate(paddle.to_tensor(ids), max_new_tokens=3,
                             attention_mask=paddle.to_tensor(empty))
+
+
+class TestChunkedPrefill:
+    """prefill_chunk_size must not change ANY output: the chunked scan
+    writes the same cache the one-shot prefill does."""
+
+    def _model(self):
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m, cfg
+
+    def test_matches_one_shot_single_prompt(self):
+        m, cfg = self._model()
+        prompt = np.random.RandomState(0).randint(0, cfg.vocab_size, (1, 13))
+        ref = m.generate(paddle.to_tensor(prompt), max_new_tokens=8).numpy()
+        for chunk in (4, 5, 13, 16):
+            out = m.generate(paddle.to_tensor(prompt), max_new_tokens=8,
+                             prefill_chunk_size=chunk).numpy()
+            np.testing.assert_array_equal(out, ref), chunk
+
+    def test_matches_one_shot_ragged_batch(self):
+        m, cfg = self._model()
+        rng = np.random.RandomState(1)
+        S0 = 11
+        prompt = rng.randint(0, cfg.vocab_size, (3, S0))
+        am = np.zeros((3, S0), np.int64)
+        for b, n in enumerate((11, 7, 4)):
+            am[b, :n] = 1
+            prompt[b, n:] = 0
+        ref = m.generate(paddle.to_tensor(prompt), max_new_tokens=6,
+                         attention_mask=paddle.to_tensor(am)).numpy()
+        out = m.generate(paddle.to_tensor(prompt), max_new_tokens=6,
+                         attention_mask=paddle.to_tensor(am),
+                         prefill_chunk_size=4).numpy()
+        np.testing.assert_array_equal(out, ref)
+
+    def test_matches_with_eos_and_sampling_paths(self):
+        m, cfg = self._model()
+        prompt = np.random.RandomState(2).randint(0, cfg.vocab_size, (2, 9))
+        ref = m.generate(paddle.to_tensor(prompt), max_new_tokens=7).numpy()
+        eos = int(ref[0, 2])
+        ref_eos = m.generate(paddle.to_tensor(prompt), max_new_tokens=7,
+                             eos_token_id=eos).numpy()
+        out_eos = m.generate(paddle.to_tensor(prompt), max_new_tokens=7,
+                             eos_token_id=eos, prefill_chunk_size=4).numpy()
+        np.testing.assert_array_equal(out_eos, ref_eos)
+
+    def test_paged_decode_composes(self):
+        m, cfg = self._model()
+        prompt = np.random.RandomState(3).randint(0, cfg.vocab_size, (2, 10))
+        ref = m.generate(paddle.to_tensor(prompt), max_new_tokens=6,
+                         paged=True, page_size=8).numpy()
+        out = m.generate(paddle.to_tensor(prompt), max_new_tokens=6,
+                         paged=True, page_size=8,
+                         prefill_chunk_size=4).numpy()
+        np.testing.assert_array_equal(out, ref)
+
+    def test_compile_buckets_by_chunk_count(self):
+        """Two prompts in the same chunk-count bucket reuse ONE compiled
+        prefill (the whole point of chunking)."""
+        m, cfg = self._model()
+        for s in (9, 11):  # both -> 3 chunks of 4
+            p = np.random.RandomState(s).randint(0, cfg.vocab_size, (1, s))
+            m.generate(paddle.to_tensor(p), max_new_tokens=4,
+                       prefill_chunk_size=4)
+        steps = m.__dict__.get("_chunked_prefill_steps")
+        assert steps is not None and len(steps) == 1, steps and len(steps)
