@@ -103,6 +103,17 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
                                           interpret=interpret)
             return out.astype(q.dtype), k_buf, v_buf
 
+    if use_flash and S > 1:
+        # multi-token append at pos >= 0 (chunked prefill, speculative
+        # verify): streaming-softmax Pallas kernel over the buffer, blocks
+        # beyond pos+S skipped — replaces the dense full-buffer einsum
+        from .ops.pallas import append_attention as pa
+
+        if pa.supported(q, k_buf, interpret=interpret):
+            out = pa.append_attention(q, k_buf, v_buf, pos, allowed=allowed,
+                                      interpret=interpret)
+            return out.astype(q.dtype), k_buf, v_buf
+
     g = H // hk
     scale = 1.0 / math.sqrt(D)
     qg = q.reshape(B, S, hk, g, D)
@@ -389,8 +400,10 @@ def _memoized_step(model, attr, key, factory, maxsize=None):
 
 
 def _get_prefill_step(model, max_len, ragged):
+    # max_len varies per request: bound the cache (oldest-evicted)
     return _memoized_step(model, "_prefill_steps", (max_len, ragged),
-                          lambda: _PrefillStep(model, max_len, ragged))
+                          lambda: _PrefillStep(model, max_len, ragged),
+                          maxsize=16)
 
 
 class _ChunkedPrefillStep:
@@ -403,13 +416,15 @@ class _ChunkedPrefillStep:
     one-shot prefill. The running last-real-hidden is carried so only a
     [B, H] gather (not the full prompt's hidden) leaves the loop.
 
-    Cost model: each chunk runs the DENSE cache attention (the scan's
-    traced ``pos`` rules out the flash fast path), materializing f32
-    scores of shape [B, kv_heads, group, C, max_len] per layer — pick C
-    so C x max_len stays modest (e.g. C<=256 at 16k context); total
-    attention compute is O(S x max_len), ~2x a causal-optimal kernel at
-    full length. A Pallas append-attention kernel is the future fast
-    path here."""
+    Cost model: on TPU each chunk's attention runs the Pallas
+    append-attention kernel (ops/pallas/append_attention.py — streaming
+    softmax over the buffer, traced ``pos`` via scalar prefetch, KV
+    blocks beyond pos+S skipped), so compute scales with the VALID
+    prefix: total O(S^2/2) like a causal kernel. Where the kernel's gate
+    declines (CPU, untileable dims, KV beyond its VMEM budget), the
+    dense fallback materializes f32 scores [B, kv_heads, group, C,
+    max_len] per layer and attends the whole buffer — pick C so
+    C x max_len stays modest there."""
 
     def __init__(self, model, max_len, chunk, n_chunks):
         self._model = model
@@ -694,7 +709,7 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
 
         # per-row RoPE positions for the generated tokens (ragged batches
         # continue at each row's true length)
-        if (pad_mask is not None or chunk) and not paged:
+        if pad_mask is not None and not paged:
             for c in caches:
                 c["row_pos"] = lengths
 
