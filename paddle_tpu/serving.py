@@ -30,6 +30,15 @@ from .generation import (_get_prefill_step, _get_select_decode,
                          _memoized_step)
 
 
+def _page_tiles(buf, page_size):
+    """[n_tokens, hk, D] dense rows -> [hk, n_pages, page_size, D] page
+    tiles (the pool layout) — the ONE buffer-to-pages transform, shared by
+    the admission scatter and the prefix-cache suffix scatter."""
+    n_pages = buf.shape[0] // page_size
+    hk, d = buf.shape[1], buf.shape[2]
+    return jnp.moveaxis(buf.reshape(n_pages, page_size, hk, d), 2, 0)
+
+
 class _Request:
     __slots__ = ("rid", "ids", "max_new_tokens", "tokens", "slot")
 
@@ -52,7 +61,8 @@ class ContinuousBatchEngine:
 
     def __init__(self, model, max_batch: int, max_len: int, page_size: int = 16,
                  eos_token_id: Optional[int] = None, do_sample: bool = False,
-                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0):
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 enable_prefix_cache: bool = False):
         if max_len % page_size != 0:
             raise ValueError("max_len must be a multiple of page_size")
         cfg = model.config
@@ -86,6 +96,15 @@ class ContinuousBatchEngine:
         self._queue: List[_Request] = []
         self._slots: List[Optional[_Request]] = [None] * max_batch
         self._finished: Dict[int, np.ndarray] = {}
+
+        # ---- automatic prefix caching (vLLM-style, opt-in) --------------
+        # At admission, the longest page-aligned token prefix shared with a
+        # still-ACTIVE slot's prompt is COPIED from that slot's pages
+        # (device page copy — cheap vs recomputing the prefill), and only
+        # the suffix runs the model. Copies (not aliases) keep retirement
+        # trivial: freed pages can be overwritten with no refcounts.
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        self.prefix_pages_reused = 0  # observability: total pages copied
 
     # ---- public API ---------------------------------------------------------
     def add_request(self, ids, max_new_tokens: int = 64) -> int:
@@ -175,6 +194,10 @@ class ContinuousBatchEngine:
         return min(b, self.max_len)
 
     def _admit(self):
+        if self._poisoned and self._queue:
+            raise RuntimeError(
+                "ContinuousBatchEngine: a failed admission invalidated the "
+                "page pool; rebuild the engine and resubmit requests")
         while self._queue:
             slot = self._free_slot()
             if slot < 0:
@@ -200,9 +223,7 @@ class ContinuousBatchEngine:
                     new = []
                     for pg, key in ((kp, "k"), (vp, "v")):
                         buf = c_new[key][0]              # [bucket, hk, D]
-                        hk, d = buf.shape[1], buf.shape[2]
-                        tiles = jnp.moveaxis(
-                            buf.reshape(n_pages, ps, hk, d), 2, 0)
+                        tiles = _page_tiles(buf, ps)
                         new.append(jax.lax.dynamic_update_slice(
                             pg, tiles.astype(pg.dtype), (0, base, 0, 0)))
                     out.append(tuple(new))
@@ -215,9 +236,144 @@ class ContinuousBatchEngine:
         return _memoized_step(self.model, "_page_scatter_fns",
                               (bucket, ps), build)
 
+    # ---- prefix caching ------------------------------------------------------
+    def _find_shared_prefix(self, req: _Request):
+        """Longest page-aligned token prefix shared with an ACTIVE slot's
+        prompt. Capped one token short of the whole prompt (the suffix
+        prefill needs at least one token to produce the slot's logits)."""
+        ps = self.page_size
+        cap = (int(req.ids.size) - 1) // ps
+        best_slot, best_n = -1, 0
+        for s, r in enumerate(self._slots):
+            if r is None or cap <= 0:
+                continue
+            c = min(cap * ps, (int(r.ids.size) // ps) * ps)
+            if c <= 0:
+                continue
+            neq = req.ids[:c] != r.ids[:c]
+            common = c if not neq.any() else int(np.argmax(neq))
+            n = common // ps
+            if n > best_n:
+                best_slot, best_n = s, n
+        return best_slot, best_n
+
+    def _suffix_prefill_fn(self, n_pref: int, sb: int):
+        """One jitted, page-DONATING admission with a cached prefix:
+        gather the prefix KV from the SOURCE slot's pages, run the model
+        over the suffix chunk at pos=prefix_len (append-attention fast
+        path on TPU), and scatter BOTH the copied prefix tiles and the new
+        suffix tiles into the destination slot's pages."""
+        from .autograd import tape as _tape2
+        from .nn.layer import functional_weights
+        from .tensor_class import wrap as _wrap
+
+        ps = self.page_size
+        pref_len = n_pref * ps
+        total = pref_len + sb
+        n_suf = sb // ps
+        model = self.model
+        rope_len = self.max_len
+
+        def build():
+            def run(state, pages, suffix_ids, suffix_len, src_base,
+                    dst_base):
+                with functional_weights(model, state), _tape2.no_grad():
+                    caches = []
+                    pref_tiles = []
+                    for kp, vp in pages:
+                        hk, _, _, d = kp.shape
+                        rows = src_base + jnp.arange(n_pref)
+                        tiles = (kp[:, rows], vp[:, rows])  # [hk,n_pref,ps,D]
+                        pref_tiles.append(tiles)
+
+                        def dense(t):
+                            return jnp.moveaxis(
+                                t.reshape(hk, pref_len, d), 0, 1)[None]
+
+                        k_buf = jnp.zeros((1, total, hk, d), kp.dtype
+                                          ).at[:, :pref_len].set(
+                                              dense(tiles[0]))
+                        v_buf = jnp.zeros((1, total, hk, d), vp.dtype
+                                          ).at[:, :pref_len].set(
+                                              dense(tiles[1]))
+                        allowed = (jnp.arange(total)[None, :]
+                                   < pref_len + suffix_len)
+                        caches.append({
+                            "k": k_buf, "v": v_buf, "allowed": allowed,
+                            "pos": jnp.asarray(pref_len, jnp.int32)})
+                    hidden, caches = model.llama.forward_cached(
+                        _wrap(suffix_ids), caches, rope_len=rope_len)
+                    h_last = jnp.take_along_axis(
+                        unwrap(hidden),
+                        (suffix_len - 1).reshape(1, 1, 1).astype(jnp.int32),
+                        axis=1)
+                    last = unwrap(model.lm_head_logits(
+                        _wrap(h_last)))[:, 0, :]
+
+                    new_pages = []
+                    for (kp, vp), (tk, tv), c in zip(pages, pref_tiles,
+                                                     caches):
+                        hk, _, _, d = kp.shape
+                        out_pair = []
+                        for pg, tiles_pref, key in ((kp, tk, "k"),
+                                                    (vp, tv, "v")):
+                            buf = c[key] if not isinstance(c[key], Tensor) \
+                                else unwrap(c[key])
+                            suf_tiles = _page_tiles(
+                                buf[0, pref_len:pref_len + sb], ps)
+                            pg = jax.lax.dynamic_update_slice(
+                                pg, tiles_pref.astype(pg.dtype),
+                                (0, dst_base, 0, 0))
+                            pg = jax.lax.dynamic_update_slice(
+                                pg, suf_tiles.astype(pg.dtype),
+                                (0, dst_base + n_pref, 0, 0))
+                            out_pair.append(pg)
+                        new_pages.append(tuple(out_pair))
+                return last, new_pages
+
+            fn = jax.jit(run, donate_argnums=(1,))
+            fn._state = None  # _memoized_step refresh hook (state is an arg)
+            return fn
+
+        return _memoized_step(self.model, "_suffix_prefill_fns",
+                              (n_pref, sb, ps), build, maxsize=16)
+
+    def _prefill_with_prefix(self, slot: int, req: _Request, src: int,
+                             n_pref: int):
+        ps = self.page_size
+        S0 = int(req.ids.size)
+        pref_len = n_pref * ps
+        suf = req.ids[pref_len:]
+        sb = min(self._bucket(int(suf.size)), self.max_len - pref_len)
+        ids = np.zeros((1, sb), np.int32)
+        ids[0, :suf.size] = suf
+        fn = self._suffix_prefill_fn(n_pref, sb)
+        pages = [(c["k_pages"], c["v_pages"]) for c in self._caches]
+        try:
+            last, new_pages = fn(
+                dict(self.model.functional_state()), pages,
+                jnp.asarray(ids), jnp.asarray(int(suf.size), jnp.int32),
+                jnp.asarray(src * self._pages_per_slot, jnp.int32),
+                jnp.asarray(slot * self._pages_per_slot, jnp.int32))
+        except Exception as e:
+            self._poisoned = True
+            raise RuntimeError(
+                "ContinuousBatchEngine: prefix-cached admission failed "
+                "after the page pool was donated; rebuild the engine and "
+                "resubmit in-flight requests") from e
+        for c_eng, (kp, vp) in zip(self._caches, new_pages):
+            c_eng["k_pages"], c_eng["v_pages"] = kp, vp
+        self._last = self._last.at[slot].set(last[0].astype(jnp.float32))
+        self._lengths = self._lengths.at[slot].set(S0)
+        self.prefix_pages_reused += n_pref
+
     def _prefill_into(self, slot: int, req: _Request):
         """Bucketed jitted prefill of one prompt, scattered into the slot's
         pages; the slot's last-logit row seeds sampling."""
+        if self.enable_prefix_cache:
+            src, n_pref = self._find_shared_prefix(req)
+            if n_pref > 0:
+                return self._prefill_with_prefix(slot, req, src, n_pref)
         S0 = int(req.ids.size)
         bucket = self._bucket(S0)
         ids = np.zeros((1, bucket), np.int32)

@@ -107,3 +107,60 @@ def test_engine_serves_tensor_parallel_model():
             np.testing.assert_array_equal(done[rid], solo)
     finally:
         dist.set_hybrid_communicate_group(None)
+
+
+def test_prefix_cache_token_parity():
+    """Automatic prefix caching: a request sharing a page-aligned prompt
+    prefix with an active slot reuses that slot's pages; outputs must be
+    token-identical to solo generate for every request."""
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(5)
+    shared = rng.randint(0, cfg.vocab_size, (17,))  # 2 full pages of 8
+    p1 = np.concatenate([shared, rng.randint(0, cfg.vocab_size, (4,))])
+    p2 = np.concatenate([shared, rng.randint(0, cfg.vocab_size, (7,))])
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8,
+                                enable_prefix_cache=True)
+    r1 = eng.add_request(p1, max_new_tokens=6)
+    r2 = eng.add_request(p2, max_new_tokens=6)   # admitted while r1 active
+    assert eng.prefix_pages_reused == 2          # 17 shared tokens -> 2 pages
+    done = eng.run_until_done()
+    for rid, p in ((r1, p1), (r2, p2)):
+        solo = m.generate(paddle.to_tensor(p[None]),
+                          max_new_tokens=6).numpy()[0]
+        np.testing.assert_array_equal(done[rid], solo)
+
+
+def test_prefix_cache_identical_prompt_capped():
+    """An identical prompt shares all but the last page-partial token (the
+    suffix prefill needs >= 1 token); outputs still match solo."""
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(6)
+    p = rng.randint(0, cfg.vocab_size, (16,))    # exactly 2 pages
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8,
+                                enable_prefix_cache=True)
+    r1 = eng.add_request(p, max_new_tokens=5)
+    r2 = eng.add_request(p.copy(), max_new_tokens=5)
+    assert eng.prefix_pages_reused == 1          # capped at (16-1)//8
+    done = eng.run_until_done()
+    solo = m.generate(paddle.to_tensor(p[None]), max_new_tokens=5).numpy()[0]
+    np.testing.assert_array_equal(done[r1], solo)
+    np.testing.assert_array_equal(done[r2], solo)
+
+
+def test_prefix_cache_disabled_by_default():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    m = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(7)
+    p = rng.randint(0, cfg.vocab_size, (16,))
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8)
+    eng.add_request(p, max_new_tokens=3)
+    eng.add_request(p.copy(), max_new_tokens=3)
+    eng.run_until_done()
+    assert eng.prefix_pages_reused == 0
