@@ -99,6 +99,26 @@ def get_default_dtype():
     return np.dtype(_DEFAULT_DTYPE[0]).name
 
 
+class dtype_guard:
+    """Scoped default-dtype override (PaddleNLP ``dtype_guard`` pattern):
+    layers created inside the block default their parameters to ``d`` —
+    how a bf16 model is constructed with bf16 storage (params in HBM at
+    2 bytes) while the global default stays float32."""
+
+    def __init__(self, d):
+        self._d = d
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _DEFAULT_DTYPE[0]
+        set_default_dtype(self._d)
+        return self
+
+    def __exit__(self, *exc):
+        _DEFAULT_DTYPE[0] = self._prev
+        return False
+
+
 def default_float_dtype():
     return _DEFAULT_DTYPE[0]
 

@@ -58,6 +58,11 @@ class LlamaConfig:
     sep_mode: str = "ring"
     sequence_parallel: bool = False
     recompute: bool = False
+    # chunk the lm-head matmul + CE loss over token chunks (ops.fused_loss):
+    # the [tokens, vocab] logits tensor never materializes — required to fit
+    # large-vocab training shapes in one chip's HBM. forward(labels=...)
+    # then returns (loss, None).
+    fuse_linear_cross_entropy: bool = False
     dtype: str = "bfloat16"
 
     def __post_init__(self):
@@ -155,24 +160,30 @@ def _mp_enabled():
 
 def _make_linear(in_f, out_f, *, column: bool, config: LlamaConfig, gather_output=False,
                  input_is_parallel=True):
-    if _mp_enabled():
-        if column:
-            cls = (mpu.ColumnSequenceParallelLinear if config.sequence_parallel
-                   else mpu.ColumnParallelLinear)
-            return cls(in_f, out_f, has_bias=False, gather_output=gather_output)
-        cls = (mpu.RowSequenceParallelLinear if config.sequence_parallel
-               else mpu.RowParallelLinear)
-        return cls(in_f, out_f, has_bias=False, input_is_parallel=input_is_parallel)
-    return nn.Linear(in_f, out_f, bias_attr=False)
+    from ..framework.dtype import dtype_guard
+
+    with dtype_guard(config.dtype):  # params stored in the config dtype
+        if _mp_enabled():
+            if column:
+                cls = (mpu.ColumnSequenceParallelLinear if config.sequence_parallel
+                       else mpu.ColumnParallelLinear)
+                return cls(in_f, out_f, has_bias=False, gather_output=gather_output)
+            cls = (mpu.RowSequenceParallelLinear if config.sequence_parallel
+                   else mpu.RowParallelLinear)
+            return cls(in_f, out_f, has_bias=False, input_is_parallel=input_is_parallel)
+        return nn.Linear(in_f, out_f, bias_attr=False)
 
 
 def _make_embedding(config: LlamaConfig):
     """Token embedding, vocab-parallel under mp, Normal-initialized — the
     ONE construction shared by LlamaModel and the pipeline embed stage."""
-    if _mp_enabled() and config.vocab_size % get_hybrid_communicate_group().get_model_parallel_world_size() == 0:
-        emb = mpu.VocabParallelEmbedding(config.vocab_size, config.hidden_size)
-    else:
-        emb = nn.Embedding(config.vocab_size, config.hidden_size)
+    from ..framework.dtype import dtype_guard
+
+    with dtype_guard(config.dtype):
+        if _mp_enabled() and config.vocab_size % get_hybrid_communicate_group().get_model_parallel_world_size() == 0:
+            emb = mpu.VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        else:
+            emb = nn.Embedding(config.vocab_size, config.hidden_size)
     emb.weight._array = (
         Normal(0.0, config.initializer_range)(
             (config.vocab_size, config.hidden_size), jnp.float32)
@@ -446,6 +457,28 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None, attention_mask=None):
         hidden = self.llama(input_ids, attention_mask)
+        if labels is not None and self.config.fuse_linear_cross_entropy:
+            from ..ops.fused_loss import fused_linear_cross_entropy
+
+            if _mp_enabled():
+                # the lm-head / embedding weight is a vocab SHARD under mp;
+                # feeding it to the fused op would logsumexp over the local
+                # slice only (silently wrong loss) — use the gather_output
+                # logits path there
+                raise NotImplementedError(
+                    "fuse_linear_cross_entropy is not supported under model "
+                    "parallelism (the vocab projection is sharded); unset the "
+                    "flag — the lm-head gather_output path computes the same "
+                    "loss correctly under mp")
+            if self.lm_head is None:  # tied: embedding weight [vocab, hidden]
+                w, layout = self.llama.embed_tokens.weight, "vh"
+            else:
+                w, layout = self.lm_head.weight, "hv"
+            loss = apply(
+                "fused_linear_cross_entropy",
+                lambda h, ww, lb: fused_linear_cross_entropy(h, ww, lb, layout),
+                hidden, w, labels)
+            return loss, None
         logits = self.lm_head_logits(hidden)
         if labels is None:
             return logits
@@ -557,6 +590,13 @@ class LlamaForCausalLMPipe(PipelineLayer):
 
     def __init__(self, config: LlamaConfig, num_stages=None,
                  seg_method="layer:LlamaDecoderLayerPipe", **pipe_kwargs):
+        if config.fuse_linear_cross_entropy:
+            # the pipeline head stage emits full logits into the pipeline
+            # loss; honoring the flag would need a fused head+loss stage —
+            # raise rather than silently skip the memory saving
+            raise NotImplementedError(
+                "fuse_linear_cross_entropy is not supported by the pipeline "
+                "head stage; unset the flag for LlamaForCausalLMPipe")
         if num_stages is None:
             hcg = get_hybrid_communicate_group()
             num_stages = (hcg.get_pipe_parallel_world_size()

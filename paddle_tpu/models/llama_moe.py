@@ -70,15 +70,17 @@ class MoEMLP(Layer):
         super().__init__(dtype=config.dtype)
         from ..distributed.moe import (GroupedMLP, default_ep_axes,
                                        shard_grouped_experts)
+        from ..framework.dtype import dtype_guard
 
         self.config = config
         h = config.hidden_size
         self.gate_weight = self.create_parameter(
             [h, config.n_routed_experts],
             default_initializer=XavierUniform())
-        self.experts = GroupedMLP(config.n_routed_experts, h,
-                                  config.moe_intermediate_size,
-                                  activation="silu")
+        with dtype_guard(config.dtype):  # expert weights in the config dtype
+            self.experts = GroupedMLP(config.n_routed_experts, h,
+                                      config.moe_intermediate_size,
+                                      activation="silu")
         # expert parallelism: when constructed under a hybrid topology, the
         # expert dim shards over the data axes (the reference's moe group
         # defaults to the dp communicator) and the dispatch einsums become

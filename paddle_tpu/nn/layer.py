@@ -53,9 +53,13 @@ def functional_weights(layer, state):
 
 
 class Layer:
-    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
         self.training = True
-        self._dtype = _dtype_mod.convert_dtype(dtype)
+        # reference semantics (python/paddle/nn/layer/layers.py): a Layer
+        # with no explicit dtype uses the GLOBAL default dtype, so model
+        # code under framework.dtype_guard("bfloat16") builds bf16 params
+        self._dtype = (_dtype_mod.convert_dtype(dtype) if dtype is not None
+                       else _dtype_mod.default_float_dtype())
         self._parameters: Dict[str, Optional[Parameter]] = collections.OrderedDict()
         self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
         self._non_persistable_buffer_names = set()

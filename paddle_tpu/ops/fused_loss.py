@@ -1,0 +1,133 @@
+"""Fused (chunked) lm-head + softmax cross-entropy.
+
+Role parity: the reference fuses the vocab projection with the softmax loss
+on its large-vocab LLM path (paddle/phi/kernels/fusion/ and PaddleNLP's
+parallel_matmul + fused cross entropy criterion) so the [tokens, vocab]
+logits tensor never hits device memory at once.
+
+TPU-native design: one ``lax.scan`` over fixed-size token chunks.  Each
+chunk's logits ([chunk, vocab]) live only for that scan step — the MXU still
+sees large [chunk, hidden] x [hidden, vocab] matmuls, but HBM holds one
+chunk of logits instead of the full [4096, 128256] (f32: ~2.1 GB) buffer.
+Token counts that do not divide ``chunk_size`` are padded up to the next
+chunk boundary with ignored (-1) labels, so every shape gets the chunked
+memory behavior.  The custom VJP recomputes each chunk's logits in the
+backward scan (standard remat trade: one extra lm-head matmul) and
+accumulates dW in the weight dtype.
+
+Numerics match models.llama.causal_lm_loss exactly: token-mean CE computed
+in f32, labels < 0 ignored.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _prep(hidden, labels, chunk_size):
+    """Flatten to [N, hidden] / [N], pad N up to a chunk multiple with
+    ignored labels, and return (h2d, lab, n_chunks, n_real, count)."""
+    h2d = hidden.reshape(-1, hidden.shape[-1])
+    lab = labels.reshape(-1)
+    n = h2d.shape[0]
+    count = jnp.maximum(jnp.sum((lab >= 0).astype(jnp.float32)), 1.0)
+    chunk = min(chunk_size, n)
+    pad = (-n) % chunk
+    if pad:
+        h2d = jnp.concatenate([h2d, jnp.zeros((pad, h2d.shape[1]), h2d.dtype)])
+        lab = jnp.concatenate([lab, jnp.full((pad,), -1, lab.dtype)])
+    return h2d, lab, (n + pad) // chunk, n, count
+
+
+def _chunk(x, n_chunks):
+    c = x.shape[0] // n_chunks
+    return x.reshape((n_chunks, c) + x.shape[1:])
+
+
+def _logits_chunk(h_c, weight, weight_layout):
+    # bf16 matmul on the MXU; upcast AFTER, chunk-local only
+    if weight_layout == "hv":        # weight [hidden, vocab] (nn.Linear lm head)
+        return h_c @ weight
+    return h_c @ weight.T            # "vh": tied embedding weight [vocab, hidden]
+
+
+def _chunk_nll(h_c, lab_c, weight, weight_layout):
+    lg32 = _logits_chunk(h_c, weight, weight_layout).astype(jnp.float32)
+    mask = lab_c >= 0
+    safe = jnp.where(mask, lab_c, 0).astype(jnp.int32)
+    lse = jax.nn.logsumexp(lg32, axis=-1)
+    picked = jnp.take_along_axis(lg32, safe[:, None], axis=-1)[:, 0]
+    return jnp.sum(jnp.where(mask, lse - picked, 0.0))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy(hidden, weight, labels, weight_layout="hv",
+                               chunk_size=1024):
+    """Token-mean causal-LM loss of ``softmax(hidden @ W)`` without ever
+    materializing the full logits tensor.
+
+    hidden: [..., hidden_size] (flattened to [N, hidden]); labels: [...] int,
+    < 0 ignored; weight: [hidden, vocab] ("hv") or [vocab, hidden] ("vh",
+    the tied-embedding layout, contracted in place — no transpose copy).
+
+    The weight must be the FULL (replicated) vocab projection — under
+    model-parallel vocab sharding use the gather_output lm-head path
+    instead (models.llama raises on that combination).
+    """
+    h2d, lab, n_chunks, _, count = _prep(hidden, labels, chunk_size)
+
+    def body(acc, xs):
+        h_c, lab_c = xs
+        return acc + _chunk_nll(h_c, lab_c, weight, weight_layout), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                            (_chunk(h2d, n_chunks), _chunk(lab, n_chunks)))
+    return total / count
+
+
+def _fwd(hidden, weight, labels, weight_layout, chunk_size):
+    loss = fused_linear_cross_entropy(hidden, weight, labels, weight_layout,
+                                      chunk_size)
+    return loss, (hidden, weight, labels)
+
+
+def _bwd(weight_layout, chunk_size, res, g):
+    hidden, weight, labels = res
+    h2d, lab, n_chunks, n_real, count = _prep(hidden, labels, chunk_size)
+    scale = (g / count).astype(jnp.float32)
+
+    def body(dw_acc, xs):
+        h_c, lab_c = xs
+        lg32 = _logits_chunk(h_c, weight, weight_layout).astype(jnp.float32)
+        mask = lab_c >= 0
+        safe = jnp.where(mask, lab_c, 0).astype(jnp.int32)
+        p = jax.nn.softmax(lg32, axis=-1)
+        onehot = jax.nn.one_hot(safe, lg32.shape[-1], dtype=jnp.float32)
+        dlg = (p - onehot) * (mask.astype(jnp.float32) * scale)[:, None]
+        dlg = dlg.astype(h_c.dtype)
+        # dW accumulates in the weight dtype: for f32 weights this is exact;
+        # for bf16 weights the few-chunk accumulation keeps the backward
+        # buffer at 2 bytes/element (the matmul itself still accumulates in
+        # f32 on the MXU) — the [vocab, hidden] accumulator is the largest
+        # backward temp at large vocab
+        if weight_layout == "hv":
+            dh_c = dlg @ weight.T
+            dw_acc = dw_acc + (h_c.T @ dlg).astype(dw_acc.dtype)
+        else:
+            dh_c = dlg @ weight
+            dw_acc = dw_acc + (dlg.T @ h_c).astype(dw_acc.dtype)
+        return dw_acc, dh_c
+
+    dw, dh_chunks = jax.lax.scan(
+        body, jnp.zeros(weight.shape, weight.dtype),
+        (_chunk(h2d, n_chunks), _chunk(lab, n_chunks)))
+    dh2d = dh_chunks.reshape(-1, h2d.shape[1])[:n_real]  # drop pad rows
+    dh = dh2d.reshape(hidden.shape).astype(hidden.dtype)
+    # int labels take a float0 cotangent (jax convention for non-float leaves)
+    dlab = jnp.zeros(labels.shape, jax.dtypes.float0)
+    return dh, dw.astype(weight.dtype), dlab
+
+
+fused_linear_cross_entropy.defvjp(_fwd, _bwd)

@@ -224,31 +224,39 @@ class Momentum(Optimizer):
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=True, amsgrad=False, name=None):
+                 multi_precision=True, amsgrad=False, moment_dtype=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._amsgrad = amsgrad
+        # moment_dtype="bfloat16" stores m/v at 2 bytes/param (the update
+        # still computes in f32) — on a 16 GB v5e chip this is the knob that
+        # lets the 8B-shape train config fit HBM alongside the f32 masters
+        self._moment_dtype = (jnp.dtype(moment_dtype) if moment_dtype is not None
+                              else jnp.float32)
 
     def init_param_state(self, arr):
-        s = {"moment1": jnp.zeros(arr.shape, jnp.float32),
-             "moment2": jnp.zeros(arr.shape, jnp.float32)}
+        dt = self._moment_dtype
+        s = {"moment1": jnp.zeros(arr.shape, dt),
+             "moment2": jnp.zeros(arr.shape, dt)}
         if self._amsgrad:
-            s["moment2_max"] = jnp.zeros(arr.shape, jnp.float32)
+            s["moment2_max"] = jnp.zeros(arr.shape, dt)
         return s
 
     def update(self, arr, grad, state, lr, step):
         b1, b2 = self._beta1, self._beta2
-        m = b1 * state["moment1"] + (1 - b1) * grad
-        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        dt = self._moment_dtype
+        m = b1 * state["moment1"].astype(jnp.float32) + (1 - b1) * grad
+        v = b2 * state["moment2"].astype(jnp.float32) + (1 - b2) * grad * grad
         stepf = step.astype(jnp.float32)
         m_hat = m / (1 - b1**stepf)
         if self._amsgrad:
-            vmax = jnp.maximum(state["moment2_max"], v)
+            vmax = jnp.maximum(state["moment2_max"].astype(jnp.float32), v)
             v_hat = vmax / (1 - b2**stepf)
-            new_state = {"moment1": m, "moment2": v, "moment2_max": vmax}
+            new_state = {"moment1": m.astype(dt), "moment2": v.astype(dt),
+                         "moment2_max": vmax.astype(dt)}
         else:
             v_hat = v / (1 - b2**stepf)
-            new_state = {"moment1": m, "moment2": v}
+            new_state = {"moment1": m.astype(dt), "moment2": v.astype(dt)}
         new = arr - lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
         return new, new_state
 
@@ -258,9 +266,11 @@ class AdamW(Adam):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
-                 grad_clip=None, lazy_mode=False, multi_precision=True, amsgrad=False, name=None):
+                 grad_clip=None, lazy_mode=False, multi_precision=True, amsgrad=False,
+                 moment_dtype=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip, lazy_mode, multi_precision, amsgrad)
+                         weight_decay, grad_clip, lazy_mode, multi_precision, amsgrad,
+                         moment_dtype=moment_dtype)
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
 

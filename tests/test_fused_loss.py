@@ -1,0 +1,205 @@
+"""Fused chunked lm-head + cross-entropy (ops.fused_loss) and the bf16
+memory recipe that makes the 8B-shape bench fit one chip's HBM:
+bf16 param construction under dtype_guard, AdamW moment_dtype."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.ops.fused_loss import fused_linear_cross_entropy
+
+
+def _loss_fn(m, x, y):
+    loss, _ = m(x, labels=y)
+    return loss
+
+
+def _ref_ce(h2d, w_hv, lab):
+    lg = np.asarray(h2d, np.float64) @ np.asarray(w_hv, np.float64)
+    lg -= lg.max(axis=-1, keepdims=True)
+    logp = lg - np.log(np.exp(lg).sum(axis=-1, keepdims=True))
+    mask = lab >= 0
+    safe = np.where(mask, lab, 0)
+    nll = -logp[np.arange(lab.size), safe]
+    return float(nll[mask].sum() / max(mask.sum(), 1))
+
+
+class TestFusedLinearCrossEntropy:
+    def test_matches_reference_with_ignored_labels(self):
+        rng = np.random.RandomState(0)
+        h = jnp.asarray(rng.randn(64, 32), jnp.float32)
+        w = jnp.asarray(rng.randn(32, 96) * 0.1, jnp.float32)
+        lab = rng.randint(0, 96, (64,))
+        lab[:7] = -100
+        got = float(fused_linear_cross_entropy(h, w, jnp.asarray(lab), "hv", 16))
+        assert got == pytest.approx(_ref_ce(h, w, lab), rel=1e-5)
+
+    def test_vh_layout_matches_hv(self):
+        rng = np.random.RandomState(1)
+        h = jnp.asarray(rng.randn(32, 16), jnp.float32)
+        w = jnp.asarray(rng.randn(16, 48) * 0.1, jnp.float32)
+        lab = jnp.asarray(rng.randint(0, 48, (32,)))
+        a = fused_linear_cross_entropy(h, w, lab, "hv", 8)
+        b = fused_linear_cross_entropy(h, w.T, lab, "vh", 8)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    def test_non_divisible_tokens_pad_chunked(self):
+        """N % chunk_size != 0 pads with ignored labels (stays chunked)
+        and matches the reference loss and gradients exactly."""
+        rng = np.random.RandomState(3)
+        h = jnp.asarray(rng.randn(50, 16), jnp.float32)   # 50 % 16 != 0
+        w = jnp.asarray(rng.randn(16, 40) * 0.1, jnp.float32)
+        lab_np = rng.randint(0, 40, (50,))
+        lab_np[:3] = -100
+        lab = jnp.asarray(lab_np)
+        got = float(fused_linear_cross_entropy(h, w, lab, "hv", 16))
+        assert got == pytest.approx(_ref_ce(h, w, lab_np), rel=1e-5)
+
+        def unfused(hh, ww):
+            lg = (hh @ ww).astype(jnp.float32)
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            mask = lab >= 0
+            safe = jnp.where(mask, lab, 0)
+            nll = -jnp.take_along_axis(logp, safe[:, None], axis=-1)[:, 0]
+            return jnp.sum(jnp.where(mask, nll, 0.0)) / jnp.sum(mask.astype(jnp.float32))
+
+        g1 = jax.grad(unfused, argnums=(0, 1))(h, w)
+        g2 = jax.grad(lambda hh, ww: fused_linear_cross_entropy(hh, ww, lab, "hv", 16),
+                      argnums=(0, 1))(h, w)
+        np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1[1]), np.asarray(g2[1]), atol=1e-6)
+
+    def test_mp_and_pipe_unsupported_raise(self):
+        """Vocab-sharded (mp) and pipeline head paths must refuse the flag
+        rather than silently compute a wrong/unfused loss."""
+        from paddle_tpu.models.llama import LlamaForCausalLMPipe
+
+        cfg = LlamaConfig.tiny(fuse_linear_cross_entropy=True)
+        with pytest.raises(NotImplementedError, match="pipeline head"):
+            LlamaForCausalLMPipe(cfg, num_stages=1)
+
+    def test_gradients_match_unfused(self):
+        rng = np.random.RandomState(2)
+        h = jnp.asarray(rng.randn(48, 24), jnp.float32)
+        w = jnp.asarray(rng.randn(24, 64) * 0.1, jnp.float32)
+        lab_np = rng.randint(0, 64, (48,))
+        lab_np[:5] = -1
+        lab = jnp.asarray(lab_np)
+
+        def unfused(hh, ww):
+            lg = (hh @ ww).astype(jnp.float32)
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            mask = lab >= 0
+            safe = jnp.where(mask, lab, 0)
+            nll = -jnp.take_along_axis(logp, safe[:, None], axis=-1)[:, 0]
+            return jnp.sum(jnp.where(mask, nll, 0.0)) / jnp.sum(mask.astype(jnp.float32))
+
+        g1 = jax.grad(unfused, argnums=(0, 1))(h, w)
+        g2 = jax.grad(lambda hh, ww: fused_linear_cross_entropy(hh, ww, lab, "hv", 12),
+                      argnums=(0, 1))(h, w)
+        np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1[1]), np.asarray(g2[1]), atol=1e-6)
+
+    @pytest.mark.parametrize("tie", [False, True])
+    def test_llama_train_parity(self, tie):
+        cfg = LlamaConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, use_flash_attention=False,
+            dtype="float32", tie_word_embeddings=tie)
+        paddle.seed(0)
+        m1 = LlamaForCausalLM(cfg)
+        paddle.seed(0)
+        m2 = LlamaForCausalLM(
+            dataclasses.replace(cfg, fuse_linear_cross_entropy=True))
+        x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 32)))
+        y_np = np.random.RandomState(1).randint(0, 512, (2, 32))
+        y_np[0, :4] = -100
+        y = paddle.to_tensor(y_np)
+        s1 = paddle.jit.train_step(m1, _loss_fn, opt.AdamW(1e-3, parameters=m1.parameters()))
+        s2 = paddle.jit.train_step(m2, _loss_fn, opt.AdamW(1e-3, parameters=m2.parameters()))
+        for _ in range(3):  # identical trajectories => identical grads too
+            l1, l2 = float(s1(x, y).numpy()), float(s2(x, y).numpy())
+            assert l1 == pytest.approx(l2, abs=3e-5)
+
+
+class TestBf16ParamConstruction:
+    def test_bf16_config_builds_bf16_params(self):
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+            max_position_embeddings=32, use_flash_attention=False,
+            dtype="bfloat16")
+        m = LlamaForCausalLM(cfg)
+        dts = {str(p.dtype) for _, p in m.named_parameters()}
+        assert dts == {"bfloat16"}
+        assert paddle.get_default_dtype() == "float32"  # guard restored
+
+    def test_bf16_model_trains_with_f32_masters(self):
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+            max_position_embeddings=32, use_flash_attention=False,
+            dtype="bfloat16")
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        optimizer = opt.AdamW(1e-2, parameters=m.parameters())
+        step = paddle.jit.train_step(m, _loss_fn, optimizer)
+        x = paddle.to_tensor(np.random.RandomState(0).randint(0, 64, (2, 16)))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 64, (2, 16)))
+        losses = [float(step(x, y).numpy()) for _ in range(5)]
+        assert losses[-1] < losses[0]  # learns
+        ps = step._opt_state["param_states"]
+        any_state = next(iter(ps.values()))
+        assert str(any_state["master"].dtype) == "float32"
+
+    def test_dtype_guard_scopes_default(self):
+        from paddle_tpu.framework.dtype import dtype_guard
+
+        assert paddle.get_default_dtype() == "float32"
+        with dtype_guard("bfloat16"):
+            assert paddle.get_default_dtype() == "bfloat16"
+            lin = paddle.nn.Linear(4, 4)
+        assert paddle.get_default_dtype() == "float32"
+        assert str(lin.weight.dtype) == "bfloat16"
+
+
+class TestMomentDtype:
+    def test_bf16_moments_store_and_update(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(8, 8)
+        optimizer = opt.AdamW(1e-2, parameters=lin.parameters(),
+                              moment_dtype="bfloat16")
+
+        def loss_fn(m, x):
+            return (m(x) ** 2).mean()
+
+        step = paddle.jit.train_step(lin, loss_fn, optimizer)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        l0 = float(step(x).numpy())
+        l1 = float(step(x).numpy())
+        assert l1 < l0
+        ps = next(iter(step._opt_state["param_states"].values()))
+        assert str(ps["moment1"].dtype) == "bfloat16"
+        assert str(ps["moment2"].dtype) == "bfloat16"
+
+    def test_bf16_moments_track_f32_closely(self):
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(16, 16) * 0.3, jnp.float32)
+        g = jnp.asarray(rng.randn(16, 16) * 0.1, jnp.float32)
+        o32 = opt.Adam(1e-2)
+        obf = opt.Adam(1e-2, moment_dtype="bfloat16")
+        s32 = o32.init_state({"w": w})
+        sbf = obf.init_state({"w": w})
+        p32, pbf = {"w": w}, {"w": w}
+        for _ in range(10):
+            p32, s32 = o32.apply_gradients(s32, p32, {"w": g})
+            pbf, sbf = obf.apply_gradients(sbf, pbf, {"w": g})
+        np.testing.assert_allclose(np.asarray(p32["w"]), np.asarray(pbf["w"]),
+                                   atol=2e-3)
