@@ -54,7 +54,7 @@ def _rope_rows(x, cos, sin, row_pos):
 
 def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
                      row_pos=None, use_flash=False, interpret=False,
-                     prefill=False):
+                     prefill=False, window=None):
     """RoPE + cache write + masked GQA attention against a dense buffer.
 
     q [B,S,H,D]; k/v [B,S,hk,D]; cos/sin [>=max_len, D];
@@ -100,10 +100,10 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
                 pos_is_zero = False  # traced offset: unknown, stay dense
         if pos_is_zero and pf.supported(q, k, v, interpret=interpret):
             out = pf.flash_attention_bshd(q, k, v, causal=True,
-                                          interpret=interpret)
+                                          interpret=interpret, window=window)
             return out.astype(q.dtype), k_buf, v_buf
 
-    if use_flash and S > 1:
+    if use_flash and S > 1 and window is None:
         # multi-token append at pos >= 0 (chunked prefill, speculative
         # verify): streaming-softmax Pallas kernel over the buffer, blocks
         # beyond pos+S skipped — replaces the dense full-buffer einsum
@@ -119,12 +119,30 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
     qg = q.reshape(B, S, hk, g, D)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg.astype(jnp.float32),
                         k_buf.astype(jnp.float32)) * scale
-    t_idx = jnp.arange(k_buf.shape[1])
+    T = k_buf.shape[1]
+    t_idx = jnp.arange(T)
     s_idx = jnp.arange(S)
     valid = t_idx[None, :] <= (pos + s_idx)[:, None]        # [S, T]
+    if window is not None and allowed is None and row_pos is None:
+        # sliding window, contiguous layout: column t visible from row
+        # (pos+s) only while t > (pos+s) - window
+        valid = valid & (t_idx[None, :] > (pos + s_idx)[:, None] - window)
     mask = valid[None, None, None]                          # [1,1,1,S,T]
     if allowed is not None:
         mask = mask & allowed[:, None, None, None, :]       # [B,1,1,S,T]
+    if window is not None and (allowed is not None or row_pos is not None):
+        # ragged (right-padded) layout: buffer distance != token distance —
+        # a short row's prompt sits at slots 0..len-1 while decode writes at
+        # the SHARED offset pos, so the window must count TRUE positions:
+        # column t's position in row b is the number of allowed columns
+        # before it (pads excluded), and the query at buffer slot pos+s has
+        # position colpos[b, pos+s]
+        base = (allowed.astype(jnp.int32) if allowed is not None
+                else jnp.ones((B, T), jnp.int32))
+        colpos = jnp.cumsum(base, axis=1) - 1                # [B, T]
+        curpos = jax.lax.dynamic_slice_in_dim(colpos, pos, S, 1)  # [B, S]
+        win_ok = colpos[:, None, :] > curpos[:, :, None] - window  # [B, S, T]
+        mask = mask & win_ok[:, None, None]                  # [B,1,1,S,T]
     scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", probs,

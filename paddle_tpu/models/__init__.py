@@ -21,6 +21,14 @@ _LAZY = {
     "sd3": ("sd3", None),
     "MMDiTConfig": ("sd3", "MMDiTConfig"),
     "MMDiT": ("sd3", "MMDiT"),
+    "qwen2": ("qwen2", None),
+    "Qwen2Config": ("qwen2", "Qwen2Config"),
+    "Qwen2ForCausalLM": ("qwen2", "Qwen2ForCausalLM"),
+    "qwen2_from_hf": ("qwen2", "qwen2_from_hf"),
+    "mistral": ("mistral", None),
+    "MistralConfig": ("mistral", "MistralConfig"),
+    "MistralForCausalLM": ("mistral", "MistralForCausalLM"),
+    "mistral_from_hf": ("mistral", "mistral_from_hf"),
 }
 
 

@@ -51,6 +51,13 @@ class LlamaConfig:
     # "original_max_position_embeddings": 8192} (Llama-3.1/3.2), or
     # {"rope_type": "linear", "factor": N} (position interpolation)
     rope_scaling: Optional[dict] = None
+    # bias on the q/k/v projections (Qwen2-style); o_proj stays bias-free
+    attention_bias: bool = False
+    # causal sliding-window attention (Mistral/Qwen2): each token attends
+    # to at most the last `sliding_window` positions. The splash kernel
+    # skips blocks outside the band (O(seq*window) work); dense fallbacks
+    # apply the band mask.
+    sliding_window: Optional[int] = None
     use_flash_attention: bool = True
     # attention strategy when the hybrid topology has sep_degree > 1:
     # "ring" (ppermute ring attention), "ulysses" (all-to-all head redistribution),
@@ -159,7 +166,7 @@ def _mp_enabled():
 
 
 def _make_linear(in_f, out_f, *, column: bool, config: LlamaConfig, gather_output=False,
-                 input_is_parallel=True):
+                 input_is_parallel=True, has_bias=False):
     from ..framework.dtype import dtype_guard
 
     with dtype_guard(config.dtype):  # params stored in the config dtype
@@ -167,11 +174,11 @@ def _make_linear(in_f, out_f, *, column: bool, config: LlamaConfig, gather_outpu
             if column:
                 cls = (mpu.ColumnSequenceParallelLinear if config.sequence_parallel
                        else mpu.ColumnParallelLinear)
-                return cls(in_f, out_f, has_bias=False, gather_output=gather_output)
+                return cls(in_f, out_f, has_bias=has_bias, gather_output=gather_output)
             cls = (mpu.RowSequenceParallelLinear if config.sequence_parallel
                    else mpu.RowParallelLinear)
-            return cls(in_f, out_f, has_bias=False, input_is_parallel=input_is_parallel)
-        return nn.Linear(in_f, out_f, bias_attr=False)
+            return cls(in_f, out_f, has_bias=has_bias, input_is_parallel=input_is_parallel)
+        return nn.Linear(in_f, out_f, bias_attr=None if has_bias else False)
 
 
 def _make_embedding(config: LlamaConfig):
@@ -211,12 +218,13 @@ class LlamaAttention(Layer):
         self.num_heads = config.num_attention_heads
         self.num_kv_heads = config.num_key_value_heads
         self.head_dim = config.hidden_size // config.num_attention_heads
+        bias = config.attention_bias
         self.q_proj = _make_linear(self.hidden_size, self.num_heads * self.head_dim,
-                                   column=True, config=config)
+                                   column=True, config=config, has_bias=bias)
         self.k_proj = _make_linear(self.hidden_size, self.num_kv_heads * self.head_dim,
-                                   column=True, config=config)
+                                   column=True, config=config, has_bias=bias)
         self.v_proj = _make_linear(self.hidden_size, self.num_kv_heads * self.head_dim,
-                                   column=True, config=config)
+                                   column=True, config=config, has_bias=bias)
         self.o_proj = _make_linear(self.num_heads * self.head_dim, self.hidden_size,
                                    column=False, config=config)
 
@@ -238,6 +246,10 @@ class LlamaAttention(Layer):
             from ..generation import cached_attention, paged_cached_attention
 
             if "k_pages" in kv_cache:
+                if cfg.sliding_window is not None:
+                    raise NotImplementedError(
+                        "sliding_window with the paged KV cache is not "
+                        "supported; use paged=False")
                 out, kp, vp = apply(
                     "llama_attention_paged", paged_cached_attention,
                     q, k, v, cos, sin, kv_cache["k_pages"],
@@ -253,7 +265,8 @@ class LlamaAttention(Layer):
                 kv_cache["k"], kv_cache["v"], kv_cache["pos"],
                 kv_cache.get("allowed"), kv_cache.get("row_pos"),
                 use_flash=cfg.use_flash_attention,
-                prefill=bool(kv_cache.get("prefill", False)))
+                prefill=bool(kv_cache.get("prefill", False)),
+                window=cfg.sliding_window)
             result = self.o_proj(out.reshape([b, s, h * d]))
             new = {"k": k_buf, "v": v_buf, "pos": kv_cache["pos"] + s}
             if "allowed" in kv_cache:
@@ -275,10 +288,18 @@ class LlamaAttention(Layer):
             if cache:
                 k = jnp.concatenate([cache[0], k], axis=1)
                 v = jnp.concatenate([cache[1], v], axis=1)
+            win = cfg.sliding_window
+            if win is not None and win <= 0:
+                raise ValueError("sliding_window must be positive")
             hcg = get_hybrid_communicate_group()
             if (not cache and hcg is not None
                     and hcg.get_sep_parallel_world_size() > 1
                     and cfg.sep_mode in ("ring", "ulysses")):
+                if win is not None:
+                    raise NotImplementedError(
+                        "sliding_window under sequence/context parallelism "
+                        "is not supported; use sep_mode='allgather' or "
+                        "sep_degree=1")
                 # context parallelism: sequence stays sharded over sep; k/v
                 # blocks ride the ring (or heads ride an all-to-all) instead
                 # of GSPMD all-gathering the whole sequence per device.
@@ -306,12 +327,19 @@ class LlamaAttention(Layer):
             elif cfg.use_flash_attention and pf.supported(q, k, v):
                 # GQA-native splash kernel: KV stays at num_kv_heads width
                 # through HBM (no _expand_gqa on the hot path)
-                out = pf.flash_attention_bshd(q, k, v, causal=True)
+                out = pf.flash_attention_bshd(q, k, v, causal=True, window=win)
             else:
                 from ..distributed.context_parallel import _expand_gqa
 
                 ke, ve = _expand_gqa(k, v, h)
-                out = _sdpa_ref(q, ke, ve, causal=True)
+                band = None
+                if win is not None:
+                    sq, sk = q.shape[1], k.shape[1]
+                    off = sk - sq
+                    rows = jnp.arange(sq)[:, None] + off
+                    cols = jnp.arange(sk)[None, :]
+                    band = ((cols <= rows) & (cols > rows - win))[None, None]
+                out = _sdpa_ref(q, ke, ve, causal=band is None, mask=band)
             return out.reshape(b, out.shape[1], h * d), k, v
 
         cache_args = [kv_cache[0], kv_cache[1]] if kv_cache is not None else []
@@ -652,10 +680,25 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
                 f"{', '.join(SUPPORTED_ROPE_SCALING)}) — loading would "
                 "silently compute different logits than the checkpoint's "
                 "reference")
-    if get("attention_bias", False):
-        raise NotImplementedError(
-            "hf_config_to_llama: attention_bias=True checkpoints carry "
-            "q/k/v/o bias tensors this model does not represent")
+    # HF Llama's attention_bias puts bias on q/k/v AND o; this build only
+    # represents q/k/v bias (the Qwen2 layout) — map the Qwen2-style flag,
+    # refuse a checkpoint that would carry an o_proj bias
+    window = None
+    if get("use_sliding_window", get("sliding_window") is not None
+           and get("model_type") == "mistral"):
+        window = get("sliding_window")
+        # HF Qwen2 applies the window only to layers >= max_window_layers;
+        # this build's window is uniform — a mixed-layer checkpoint loaded
+        # uniformly would silently compute different logits than its
+        # reference, so refuse it (0 = every layer windowed is exact)
+        mwl = get("max_window_layers", 0) or 0
+        if 0 < mwl < get("num_hidden_layers"):
+            raise NotImplementedError(
+                f"hf_config_to_llama: per-layer sliding window "
+                f"(max_window_layers={mwl}) is not supported — this build "
+                "applies sliding_window uniformly")
+        if mwl >= get("num_hidden_layers"):
+            window = None  # no layer is windowed in the HF semantics
     kw = dict(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
@@ -669,6 +712,9 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
         rope_theta=get("rope_theta", 10000.0),
         rope_scaling=(dict(scaling) if scaling else None),
         tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+        attention_bias=bool(get("attention_bias",
+                                get("model_type") == "qwen2")),
+        sliding_window=window,
     )
     kw.update(overrides)
     return LlamaConfig(**kw)
@@ -690,6 +736,10 @@ def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict) -> "LlamaForCausalLM
         for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
             plan[f"{ours}.self_attn.{proj}.weight"] = (
                 f"{hf}.self_attn.{proj}.weight", True)
+        if model.config.attention_bias:
+            for proj in ("q_proj", "k_proj", "v_proj"):  # o_proj stays bias-free
+                plan[f"{ours}.self_attn.{proj}.bias"] = (
+                    f"{hf}.self_attn.{proj}.bias", False)
         for proj in ("gate_proj", "up_proj", "down_proj"):
             plan[f"{ours}.mlp.{proj}.weight"] = (f"{hf}.mlp.{proj}.weight", True)
         plan[f"{ours}.input_layernorm.weight"] = (
@@ -731,14 +781,25 @@ def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict) -> "LlamaForCausalLM
     return model
 
 
-def llama_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
-    """Build a LlamaForCausalLM from a transformers model (or a raw state
-    dict + config): ``llama_from_hf(HFLlama.from_pretrained(...))``."""
+def _from_hf(config_cls, model_cls, hf_model_or_state, hf_config=None,
+             **config_overrides):
+    """Shared HF-conversion protocol for the Llama-architecture families
+    (Llama / Qwen2 / Mistral): unwrap model vs raw state, map the config,
+    build, load."""
+    import dataclasses as _dc
+
     if hf_config is None:
         hf_config = hf_model_or_state.config
         state = hf_model_or_state.state_dict()
     else:
         state = hf_model_or_state
-    cfg = hf_config_to_llama(hf_config, **config_overrides)
-    model = LlamaForCausalLM(cfg)
-    return load_hf_llama(model, state)
+    base = hf_config_to_llama(hf_config, **config_overrides)
+    cfg = base if config_cls is LlamaConfig else config_cls(**_dc.asdict(base))
+    return load_hf_llama(model_cls(cfg), state)
+
+
+def llama_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a LlamaForCausalLM from a transformers model (or a raw state
+    dict + config): ``llama_from_hf(HFLlama.from_pretrained(...))``."""
+    return _from_hf(LlamaConfig, LlamaForCausalLM, hf_model_or_state,
+                    hf_config, **config_overrides)

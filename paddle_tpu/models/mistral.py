@@ -1,0 +1,52 @@
+"""Mistral decoder family (sliding-window attention).
+
+Role parity: PaddleNLP's mistral modeling in the reference ecosystem — the
+Llama decoder recipe with causal sliding-window attention (window 4096 in
+v0.1/v0.2). Expressed as a LlamaConfig specialization: the splash kernel
+skips KV blocks outside the band (O(seq*window) attention work), and all
+training / hybrid-parallel / serving paths are the shared Llama machinery.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .llama import LlamaConfig, LlamaForCausalLM, _from_hf
+
+
+@dataclasses.dataclass
+class MistralConfig(LlamaConfig):
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 32768
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    sliding_window: Optional[int] = 4096  # the Mistral signature deviation
+
+    @staticmethod
+    def mistral_7b(**kw):
+        return MistralConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=256,
+                    sliding_window=32, dtype="float32")
+        base.update(kw)
+        return MistralConfig(**base)
+
+
+class MistralForCausalLM(LlamaForCausalLM):
+    """Mistral causal LM — Llama decoder with sliding-window attention."""
+
+
+def mistral_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a MistralForCausalLM from a transformers Mistral model (or a
+    raw state dict + config)."""
+    return _from_hf(MistralConfig, MistralForCausalLM, hf_model_or_state,
+                    hf_config, **config_overrides)

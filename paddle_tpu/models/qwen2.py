@@ -1,0 +1,66 @@
+"""Qwen2 / Qwen2.5 decoder family.
+
+Role parity: the reference serves Qwen2 through PaddleNLP's qwen2 modeling
+(same decoder recipe as its llama modeling with q/k/v projection biases and
+an optional sliding window). This build expresses Qwen2 as a LlamaConfig
+specialization — the architecture differs from Llama-3 only in
+``attention_bias=True``, the RoPE base, and the (optional) sliding window —
+so every path (training, hybrid parallel, serving, HF interop) is the
+already-tested Llama machinery.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .llama import LlamaConfig, LlamaForCausalLM, _from_hf
+
+
+@dataclasses.dataclass
+class Qwen2Config(LlamaConfig):
+    vocab_size: int = 151936
+    hidden_size: int = 3584
+    intermediate_size: int = 18944
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 28
+    num_key_value_heads: int = 4
+    max_position_embeddings: int = 32768
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1e6
+    attention_bias: bool = True          # the Qwen2 signature deviation
+
+    @staticmethod
+    def qwen25_7b(**kw):
+        return Qwen2Config(**kw)
+
+    @staticmethod
+    def qwen25_0_5b(**kw):
+        base = dict(hidden_size=896, intermediate_size=4864,
+                    num_hidden_layers=24, num_attention_heads=14,
+                    num_key_value_heads=2, tie_word_embeddings=True)
+        base.update(kw)
+        return Qwen2Config(**base)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=256,
+                    dtype="float32")
+        base.update(kw)
+        return Qwen2Config(**base)
+
+
+class Qwen2ForCausalLM(LlamaForCausalLM):
+    """Qwen2 causal LM — Llama decoder with q/k/v biases."""
+
+    def __init__(self, config: Qwen2Config):
+        if not config.attention_bias:
+            raise ValueError("Qwen2 uses attention_bias=True")
+        super().__init__(config)
+
+
+def qwen2_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a Qwen2ForCausalLM from a transformers Qwen2 model (or a raw
+    state dict + config)."""
+    return _from_hf(Qwen2Config, Qwen2ForCausalLM, hf_model_or_state,
+                    hf_config, **config_overrides)

@@ -78,7 +78,7 @@ def _largest_dividing_block(seq: int) -> int:
 
 @functools.lru_cache(maxsize=64)
 def _splash_kernel(h_q: int, s_q: int, s_kv: int, causal: bool,
-                   interpret: bool, bq: int, bkv: int):
+                   interpret: bool, bq: int, bkv: int, window: int | None = None):
     """Build (and cache) the splash kernel for a head/seq/mask geometry.
 
     Mask-info construction runs on host and is O(seq²/block²); the cache
@@ -90,7 +90,13 @@ def _splash_kernel(h_q: int, s_q: int, s_kv: int, causal: bool,
         splash_attention_mask as sm,
     )
 
-    if causal:
+    if causal and window is not None:
+        # sliding-window causal (Mistral/Qwen2): q row i attends kv cols
+        # in [i+off-(window-1), i+off] — splash skips blocks OUTSIDE the
+        # band entirely, so long-seq work scales O(seq*window) not O(seq²)
+        base = sm.LocalMask((s_q, s_kv), window_size=(window - 1, 0),
+                            offset=s_kv - s_q)
+    elif causal:
         # bottom-aligned causal triangle for rectangular shapes (decode /
         # chunked prefill against a longer KV): q row i may attend kv cols
         # j <= i + (s_kv - s_q), matching _sdpa_ref's tril(k=s_kv-s_q);
@@ -119,21 +125,24 @@ def _splash_kernel(h_q: int, s_q: int, s_kv: int, causal: bool,
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
-                                             "interpret", "bq", "bkv"))
-def _flash_bshd_jit(q, k, v, causal, sm_scale, interpret, bq, bkv):
+                                             "interpret", "bq", "bkv",
+                                             "window"))
+def _flash_bshd_jit(q, k, v, causal, sm_scale, interpret, bq, bkv,
+                    window=None):
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     qt = jnp.swapaxes(q * jnp.asarray(scale, q.dtype), 1, 2)  # [B, H, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     kernel = _splash_kernel(qt.shape[1], qt.shape[2], kt.shape[2],
-                            causal, interpret, bq, bkv)
+                            causal, interpret, bq, bkv, window)
     out = jax.vmap(kernel)(qt, kt, vt)
     return jnp.swapaxes(out, 1, 2)
 
 
 def flash_attention_bshd(q, k, v, causal: bool = False,
                          sm_scale: float | None = None,
-                         interpret: bool = False):
+                         interpret: bool = False,
+                         window: int | None = None):
     """[B, S, H, D] x [B, S, Hkv, D] flash attention; Hkv may divide H.
 
     Block geometry is resolved OUTSIDE the jit (env read per call, passed
@@ -146,5 +155,8 @@ def flash_attention_bshd(q, k, v, causal: bool = False,
     bq = _block_override("PD_SPLASH_BLOCK_Q", s_q) or _largest_dividing_block(s_q)
     bkv = (_block_override("PD_SPLASH_BLOCK_KV", s_kv)
            or _largest_dividing_block(s_kv))
+    if window is not None and (window <= 0 or not causal):
+        raise ValueError("window requires causal=True and window > 0")
     return _flash_bshd_jit(q, k, v, causal=causal, sm_scale=sm_scale,
-                           interpret=interpret, bq=bq, bkv=bkv)
+                           interpret=interpret, bq=bq, bkv=bkv,
+                           window=window)

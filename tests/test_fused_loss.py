@@ -78,11 +78,22 @@ class TestFusedLinearCrossEntropy:
     def test_mp_and_pipe_unsupported_raise(self):
         """Vocab-sharded (mp) and pipeline head paths must refuse the flag
         rather than silently compute a wrong/unfused loss."""
+        import paddle_tpu.distributed as dist
         from paddle_tpu.models.llama import LlamaForCausalLMPipe
 
         cfg = LlamaConfig.tiny(fuse_linear_cross_entropy=True)
         with pytest.raises(NotImplementedError, match="pipeline head"):
             LlamaForCausalLMPipe(cfg, num_stages=1)
+
+        dist.set_hybrid_communicate_group(
+            dist.HybridCommunicateGroup(mp_degree=2))
+        try:
+            m = LlamaForCausalLM(cfg)
+            x = paddle.to_tensor(np.zeros((1, 8), np.int64))
+            with pytest.raises(NotImplementedError, match="model"):
+                m(x, labels=x)
+        finally:
+            dist.set_hybrid_communicate_group(None)
 
     def test_gradients_match_unfused(self):
         rng = np.random.RandomState(2)

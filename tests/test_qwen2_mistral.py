@@ -1,0 +1,196 @@
+"""Qwen2 (q/k/v bias) and Mistral (sliding window) decoder families:
+construction, sliding-window attention parity (kernel vs dense band mask),
+training, decode, and numeric parity against the canonical transformers
+implementations."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models.mistral import MistralConfig, MistralForCausalLM
+from paddle_tpu.models.qwen2 import Qwen2Config, Qwen2ForCausalLM
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+class TestSlidingWindowKernel:
+    def test_splash_window_matches_dense_band(self):
+        from paddle_tpu.nn.functional.attention import _sdpa_ref
+        from paddle_tpu.ops.pallas import flash_attention as pf
+
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(1, 256, 2, 128).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 256, 1, 128).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, 256, 1, 128).astype(np.float32))
+        win = 64
+        out = pf.flash_attention_bshd(q, k, v, causal=True, window=win,
+                                      interpret=True)
+        rows = jnp.arange(256)[:, None]
+        cols = jnp.arange(256)[None, :]
+        band = ((cols <= rows) & (cols > rows - win))[None, None]
+        ke = jnp.repeat(k, 2, axis=2)
+        ve = jnp.repeat(v, 2, axis=2)
+        ref = _sdpa_ref(q, ke, ve, mask=band)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_window_requires_causal(self):
+        from paddle_tpu.ops.pallas import flash_attention as pf
+
+        q = jnp.zeros((1, 128, 2, 128), jnp.float32)
+        with pytest.raises(ValueError, match="causal"):
+            pf.flash_attention_bshd(q, q[:, :, :1], q[:, :, :1],
+                                    causal=False, window=8, interpret=True)
+
+
+class TestMistral:
+    def test_short_seq_matches_full_attention(self):
+        """Below the window the band mask is the causal mask: a Mistral
+        model must produce the same logits as the window-free twin."""
+        cfg = MistralConfig.tiny(sliding_window=64, use_flash_attention=False)
+        paddle.seed(0)
+        m1 = MistralForCausalLM(cfg)
+        paddle.seed(0)
+        m2 = MistralForCausalLM(dataclasses.replace(cfg, sliding_window=None))
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 16)))
+        np.testing.assert_allclose(m1(ids).numpy(), m2(ids).numpy(),
+                                   atol=1e-5)
+
+    def test_long_seq_window_changes_logits(self):
+        cfg = MistralConfig.tiny(sliding_window=8, use_flash_attention=False)
+        paddle.seed(0)
+        m1 = MistralForCausalLM(cfg)
+        paddle.seed(0)
+        m2 = MistralForCausalLM(dataclasses.replace(cfg, sliding_window=None))
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (1, 32)))
+        assert not np.allclose(m1(ids).numpy(), m2(ids).numpy(), atol=1e-3)
+
+    def test_trains(self):
+        from paddle_tpu import optimizer as opt
+
+        cfg = MistralConfig.tiny(sliding_window=8, use_flash_attention=False)
+        paddle.seed(0)
+        m = MistralForCausalLM(cfg)
+
+        def loss_fn(mm, x, y):
+            loss, _ = mm(x, labels=y)
+            return loss
+
+        step = paddle.jit.train_step(m, loss_fn, opt.AdamW(1e-2, parameters=m.parameters()))
+        x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 32)))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 512, (2, 32)))
+        losses = [float(step(x, y).numpy()) for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_logits_and_generate_match_transformers(self):
+        """seq > window so the sliding band actually bites; transformers'
+        eager Mistral attention is the external reference."""
+        from transformers import MistralConfig as HFConfig
+        from transformers import MistralForCausalLM as HFMistral
+        from paddle_tpu.models.mistral import mistral_from_hf
+
+        torch.manual_seed(0)
+        hf_cfg = HFConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=128,
+                          rms_norm_eps=1e-5, rope_theta=10000.0,
+                          sliding_window=8, tie_word_embeddings=False,
+                          attn_implementation="eager")
+        hf = HFMistral(hf_cfg).eval()
+        ours = mistral_from_hf(hf, dtype="float32", use_flash_attention=False)
+        assert ours.config.sliding_window == 8
+        ids = np.random.RandomState(0).randint(0, 128, (2, 24))
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids)).logits.numpy()
+        got = ours(paddle.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+        with torch.no_grad():
+            gref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                               do_sample=False).numpy()[:, 24:]
+        ggot = ours.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+        np.testing.assert_array_equal(ggot, gref)
+
+    def test_ragged_batch_decode_matches_solo(self):
+        """Right-padded batch decode under a sliding window must equal each
+        row's solo run: the window has to count TRUE token positions, not
+        shared-buffer slots (a short row's prompt lives at slots 0..len-1
+        while decode writes at the batch-wide offset)."""
+        cfg = MistralConfig.tiny(sliding_window=8, use_flash_attention=False)
+        paddle.seed(0)
+        m = MistralForCausalLM(cfg)
+        rng = np.random.RandomState(0)
+        long_ids = rng.randint(1, 512, (1, 20))
+        short_ids = rng.randint(1, 512, (1, 5))
+        solo_long = m.generate(paddle.to_tensor(long_ids), max_new_tokens=10).numpy()
+        solo_short = m.generate(paddle.to_tensor(short_ids), max_new_tokens=10).numpy()
+        batch_ids = np.zeros((2, 20), np.int64)
+        batch_ids[0] = long_ids[0]
+        batch_ids[1, :5] = short_ids[0]
+        am = np.zeros((2, 20), np.int64)
+        am[0, :] = 1
+        am[1, :5] = 1
+        got = m.generate(paddle.to_tensor(batch_ids), max_new_tokens=10,
+                         attention_mask=paddle.to_tensor(am)).numpy()
+        np.testing.assert_array_equal(got[0], solo_long[0])
+        np.testing.assert_array_equal(got[1], solo_short[0])
+
+    def test_paged_decode_refuses_window(self):
+        cfg = MistralConfig.tiny(sliding_window=8, use_flash_attention=False)
+        m = MistralForCausalLM(cfg)
+        ids = paddle.to_tensor(np.zeros((1, 4), np.int64))
+        with pytest.raises(NotImplementedError, match="paged"):
+            m.generate(ids, max_new_tokens=2, paged=True, page_size=4)
+
+
+class TestQwen2:
+    def test_bias_params_exist_and_trains(self):
+        from paddle_tpu import optimizer as opt
+
+        cfg = Qwen2Config.tiny()
+        paddle.seed(0)
+        m = Qwen2ForCausalLM(cfg)
+        names = dict(m.named_parameters())
+        assert "llama.layers.0.self_attn.q_proj.bias" in names
+        assert "llama.layers.0.self_attn.o_proj.bias" not in names
+
+        def loss_fn(mm, x, y):
+            loss, _ = mm(x, labels=y)
+            return loss
+
+        step = paddle.jit.train_step(m, loss_fn, opt.AdamW(1e-2, parameters=m.parameters()))
+        x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 16)))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 512, (2, 16)))
+        losses = [float(step(x, y).numpy()) for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_logits_and_generate_match_transformers(self):
+        from transformers import Qwen2Config as HFConfig
+        from transformers import Qwen2ForCausalLM as HFQwen2
+        from paddle_tpu.models.qwen2 import qwen2_from_hf
+
+        torch.manual_seed(0)
+        hf_cfg = HFConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=128,
+                          rms_norm_eps=1e-6, rope_theta=1e6,
+                          tie_word_embeddings=False,
+                          attn_implementation="eager")
+        hf = HFQwen2(hf_cfg).eval()
+        ours = qwen2_from_hf(hf, dtype="float32", use_flash_attention=False)
+        assert ours.config.attention_bias
+        assert ours.config.sliding_window is None  # use_sliding_window=False
+        ids = np.random.RandomState(0).randint(0, 128, (2, 9))
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids)).logits.numpy()
+        got = ours(paddle.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+        with torch.no_grad():
+            gref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                               do_sample=False).numpy()[:, 9:]
+        ggot = ours.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+        np.testing.assert_array_equal(ggot, gref)
