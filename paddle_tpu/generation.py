@@ -260,6 +260,39 @@ def sample_logits(logits, key, do_sample=False, temperature=1.0,
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def sample_logits_rows(logits, key, do_sample, temperature, top_k, top_p):
+    """Per-ROW next-token selection from [B, V] logits: every sampling knob
+    is a [B] array (the continuous-batching engine's per-request sampling —
+    one compiled program serves any mix of greedy/temperature/top-k/top-p
+    requests). Rows with do_sample=False take the plain argmax; top_k <= 0
+    means no k-filter; top_p >= 1 means no nucleus filter."""
+    lg = logits.astype(jnp.float32)
+    greedy = jnp.argmax(lg, axis=-1)
+    V = lg.shape[-1]
+    x = lg / jnp.maximum(temperature, 1e-6)[:, None]
+    # per-row top-k via the kth-value threshold (ties at the kth value are
+    # kept, matching _top_k_filter's semantics)
+    srt_desc = jnp.sort(x, axis=-1)[:, ::-1]
+    idx = jnp.clip(top_k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(srt_desc, idx[:, None], axis=-1)  # [B, 1]
+    kth = jnp.where(((top_k <= 0) | (top_k >= V))[:, None], -jnp.inf, kth)
+    x = jnp.where(x < kth, -jnp.inf, x)
+    # per-row top-p over the k-filtered distribution. The k-filter zeroes a
+    # SUFFIX of the descending sort, so the sorted filtered logits (and
+    # hence sorted probs) come from srt_desc directly — no second sort
+    probs = jax.nn.softmax(x, axis=-1)
+    srt = jax.nn.softmax(jnp.where(srt_desc < kth, -jnp.inf, srt_desc),
+                         axis=-1)
+    cum = jnp.cumsum(srt, axis=-1)
+    keep = jnp.concatenate(
+        [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p[:, None]], -1)
+    min_prob = jnp.min(jnp.where(keep, srt, jnp.inf), -1, keepdims=True)
+    min_prob = jnp.where(top_p[:, None] >= 1.0, 0.0, min_prob)  # no filter
+    x = jnp.where(probs < min_prob, -jnp.inf, x)
+    sampled = jax.random.categorical(key, x, axis=-1)
+    return jnp.where(do_sample, sampled, greedy)
+
+
 def top_p_sampling(x, ps, threshold=None, seed=None):
     """paddle.tensor.top_p_sampling parity (ops.yaml `top_p_sampling`):
     nucleus-sample one token per row of probabilities ``x`` [B, V] with
@@ -502,13 +535,17 @@ def _get_chunked_prefill_step(model, max_len, chunk, n_chunks):
 
 
 def _sample_and_forward(model, max_len, last, key, bufs, aux,
-                        do_sample, temperature, top_k, top_p):
+                        do_sample, temperature, top_k, top_p, sampler=None):
     """The fused per-token unit shared by the scan decode and the engine
     step: sample from ``last``, run one cached forward, return
     (token, next logits, split caches). Caller provides the weight context
-    (functional_weights) and the RNG key."""
-    nxt = sample_logits(last, key, do_sample=do_sample,
-                        temperature=temperature, top_k=top_k, top_p=top_p)
+    (functional_weights) and the RNG key; ``sampler`` overrides the scalar
+    sample_logits call (the per-row engine path)."""
+    if sampler is not None:
+        nxt = sampler(last, key)
+    else:
+        nxt = sample_logits(last, key, do_sample=do_sample,
+                            temperature=temperature, top_k=top_k, top_p=top_p)
     token = nxt[:, None].astype(jnp.int32)
     caches = [{**b, **a} for b, a in zip(bufs, aux)]
     with _tape.no_grad():
@@ -582,6 +619,39 @@ class _SelectDecodeStep:
         bufs, aux = _split_caches(caches)
         nxt, last_f, nb, na = self._jitted(self._state, last, key, bufs, aux)
         return nxt, last_f, [{**b, **a} for b, a in zip(nb, na)]
+
+
+class _SelectDecodeRowsStep:
+    """_SelectDecodeStep with PER-ROW sampling parameters as traced args:
+    one compiled program serves any per-request greedy/temperature/top-k/
+    top-p mix in the continuous-batching engine."""
+
+    def __init__(self, model, max_len):
+        self._model = model
+
+        def pure(state, last, key, do_s, temp, tk, tp, bufs, aux):
+            with _functional_weights(model, state):
+                nxt, last_n, nb, na = _sample_and_forward(
+                    model, max_len, last, key, bufs, aux,
+                    None, None, None, None,
+                    sampler=lambda lg, k: sample_logits_rows(
+                        lg, k, do_s, temp, tk, tp))
+            return nxt, last_n.astype(jnp.float32), nb, na
+
+        self._jitted = jax.jit(pure, donate_argnums=(7,))
+        self._state = dict(model.functional_state())
+
+    def __call__(self, last, key, do_s, temp, tk, tp, caches):
+        bufs, aux = _split_caches(caches)
+        nxt, last_f, nb, na = self._jitted(self._state, last, key, do_s,
+                                           temp, tk, tp, bufs, aux)
+        return nxt, last_f, [{**b, **a} for b, a in zip(nb, na)]
+
+
+def _get_select_decode_rows(model, max_len):
+    return _memoized_step(
+        model, "_select_decode_rows_steps", (max_len,),
+        lambda: _SelectDecodeRowsStep(model, max_len))
 
 
 def _get_select_decode(model, max_len, do_sample, temperature, top_k, top_p):

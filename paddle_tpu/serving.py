@@ -27,7 +27,7 @@ import numpy as np
 from .tensor_class import Tensor, unwrap
 from .framework import random as _random
 from .generation import (_get_prefill_step, _get_select_decode,
-                         _memoized_step)
+                         _get_select_decode_rows, _memoized_step)
 
 
 def _page_tiles(buf, page_size):
@@ -40,14 +40,15 @@ def _page_tiles(buf, page_size):
 
 
 class _Request:
-    __slots__ = ("rid", "ids", "max_new_tokens", "tokens", "slot")
+    __slots__ = ("rid", "ids", "max_new_tokens", "tokens", "slot", "sampling")
 
-    def __init__(self, rid, ids, max_new_tokens):
+    def __init__(self, rid, ids, max_new_tokens, sampling=None):
         self.rid = rid
         self.ids = np.asarray(ids).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.tokens: List[int] = []
         self.slot = -1
+        self.sampling = sampling  # (do_sample, temperature, top_k, top_p) or None
 
 
 class ContinuousBatchEngine:
@@ -107,15 +108,29 @@ class ContinuousBatchEngine:
         self.prefix_pages_reused = 0  # observability: total pages copied
 
     # ---- public API ---------------------------------------------------------
-    def add_request(self, ids, max_new_tokens: int = 64) -> int:
+    def add_request(self, ids, max_new_tokens: int = 64, do_sample=None,
+                    temperature=None, top_k=None, top_p=None) -> int:
+        """Queue one request. Sampling knobs default to the engine-level
+        configuration; any per-request override routes decoding through the
+        per-row sampling program (one compiled step serves the whole mix)."""
         ids = np.asarray(unwrap(ids) if isinstance(ids, Tensor) else ids).reshape(-1)
         if ids.size + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds engine max_len {self.max_len}")
+        sampling = None
+        if any(v is not None for v in (do_sample, temperature, top_k, top_p)):
+            eng_s, eng_t, eng_k, eng_p = self._sample_cfg
+            sampling = (
+                bool(eng_s if do_sample is None else do_sample),
+                float(eng_t if temperature is None else temperature),
+                int(eng_k if top_k is None else top_k),
+                float(eng_p if top_p is None else top_p))
+            if sampling == self._sample_cfg:
+                sampling = None  # explicit values equal to the defaults
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, ids, max_new_tokens))
+        self._queue.append(_Request(rid, ids, max_new_tokens, sampling))
         self._admit()
         return rid
 
@@ -135,12 +150,28 @@ class ContinuousBatchEngine:
         if self.num_active == 0:
             return self._drain_finished()
         do_sample, temperature, top_k, top_p = self._sample_cfg
-        step = _get_select_decode(self.model, self.max_len, do_sample,
-                                  temperature, top_k, top_p)
         for c in self._caches:
             c["lengths"] = self._lengths  # engine-owned (masks stale +1s)
-        nxt, self._last, self._caches = step(
-            self._last, _random.next_key(), self._caches)
+        # per-row program only while an ACTIVE slot carries an override —
+        # all-default mixes keep the static program (no per-row filter
+        # sorts, no [B] knob transfers), and the engine falls back to it
+        # as soon as the overriding requests retire
+        if any(r is not None and r.sampling is not None for r in self._slots):
+            rows = [(r.sampling or self._sample_cfg) if r is not None
+                    else self._sample_cfg for r in self._slots]
+            step = _get_select_decode_rows(self.model, self.max_len)
+            nxt, self._last, self._caches = step(
+                self._last, _random.next_key(),
+                jnp.asarray([r[0] for r in rows], bool),
+                jnp.asarray([r[1] for r in rows], jnp.float32),
+                jnp.asarray([r[2] for r in rows], jnp.int32),
+                jnp.asarray([r[3] for r in rows], jnp.float32),
+                self._caches)
+        else:
+            step = _get_select_decode(self.model, self.max_len, do_sample,
+                                      temperature, top_k, top_p)
+            nxt, self._last, self._caches = step(
+                self._last, _random.next_key(), self._caches)
         toks = np.asarray(nxt)
         retiring = []
         for s, req in enumerate(self._slots):

@@ -164,3 +164,59 @@ def test_prefix_cache_disabled_by_default():
     eng.add_request(p.copy(), max_new_tokens=3)
     eng.run_until_done()
     assert eng.prefix_pages_reused == 0
+
+
+def test_sample_logits_rows_uniform_matches_scalar():
+    """Per-row sampler == scalar sampler when every row shares the config
+    (same key -> identical tokens), for greedy and filtered-sampling."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.generation import sample_logits, sample_logits_rows
+
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(4, 64).astype(np.float32) * 3)
+    key = jax.random.key(42)
+    B = 4
+    for (ds, t, k, p) in [(False, 1.0, 0, 1.0), (True, 0.7, 5, 0.9),
+                          (True, 1.3, 0, 0.5), (True, 1.0, 3, 1.0)]:
+        a = sample_logits(logits, key, do_sample=ds, temperature=t,
+                          top_k=k, top_p=p)
+        b = sample_logits_rows(
+            logits, key, jnp.full((B,), ds, bool),
+            jnp.full((B,), t, jnp.float32), jnp.full((B,), k, jnp.int32),
+            jnp.full((B,), p, jnp.float32))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str((ds, t, k, p)))
+
+
+def test_per_request_sampling_mixed_batch(tiny_model):
+    """A greedy request stays token-identical to its solo run while another
+    slot decodes with per-request sampling in the same fused step."""
+    m = tiny_model
+    rng = np.random.RandomState(11)
+    pg = rng.randint(0, 512, (12,))
+    ps = rng.randint(0, 512, (9,))
+    solo = m.generate(paddle.to_tensor(pg[None]), max_new_tokens=8).numpy()[0]
+    paddle.seed(123)
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8)
+    r_greedy = eng.add_request(pg, max_new_tokens=8)   # engine default greedy
+    r_sample = eng.add_request(ps, max_new_tokens=8, do_sample=True,
+                               temperature=0.8, top_k=7)
+    done = eng.run_until_done()
+    np.testing.assert_array_equal(done[r_greedy], solo)
+    assert done[r_sample].shape == (8,)
+    assert ((0 <= done[r_sample]) & (done[r_sample] < 512)).all()
+
+
+def test_per_request_top_k1_is_greedy(tiny_model):
+    """top_k=1 sampling is argmax: per-request (do_sample=True, top_k=1)
+    must equal the solo greedy run token for token."""
+    m = tiny_model
+    rng = np.random.RandomState(12)
+    p = rng.randint(0, 512, (10,))
+    solo = m.generate(paddle.to_tensor(p[None]), max_new_tokens=6).numpy()[0]
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8)
+    rid = eng.add_request(p, max_new_tokens=6, do_sample=True, top_k=1,
+                          temperature=2.5)
+    done = eng.run_until_done()
+    np.testing.assert_array_equal(done[rid], solo)
