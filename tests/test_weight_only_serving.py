@@ -1,0 +1,88 @@
+"""Weight-only int8 serving: WeightOnlyLinear + quantize_for_serving
+(the llm.int8 / weight_only_int8 serving configuration) composed with
+generate() and the continuous-batching engine."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nn.quant import (WeightOnlyLinear, quantize_for_serving,
+                                 weight_dequantize, weight_quantize)
+from paddle_tpu.serving import ContinuousBatchEngine
+
+
+@pytest.fixture()
+def float_model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+
+
+def test_quantize_for_serving_replaces_targets(float_model):
+    m, n = quantize_for_serving(float_model)
+    # 2 layers x (q,k,v,o,gate,up,down) + lm_head
+    assert n == 15
+    assert isinstance(m.lm_head, WeightOnlyLinear)
+    assert isinstance(m.llama.layers[0].self_attn.q_proj, WeightOnlyLinear)
+    sd = m.state_dict()
+    assert str(sd["lm_head.quant_weight"].dtype) == "int8"
+
+
+def test_quantized_logits_close_and_roundtrip(float_model):
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 12)))
+    ref = float_model(ids).numpy()
+    m, _ = quantize_for_serving(float_model)
+    got = m(ids).numpy()
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < 0.05  # int8 weight rounding only
+
+    # quantize/dequantize round trip bounded by the per-channel step size
+    w = paddle.to_tensor(np.random.RandomState(1).randn(32, 16).astype("float32"))
+    q, s = weight_quantize(w)
+    back = weight_dequantize(q, s, out_dtype="float32")
+    step = np.abs(w.numpy()).max(0) / 127.0
+    assert (np.abs(back.numpy() - w.numpy()) <= step[None, :] * 0.5 + 1e-6).all()
+
+
+@pytest.mark.parametrize("algo", ["weight_only_int8", "llm.int8"])
+def test_quantized_engine_matches_solo(float_model, algo):
+    """The engine serving a quantized model is token-identical to the same
+    quantized model's solo generate (the serving stack is quantization-
+    transparent)."""
+    m, _ = quantize_for_serving(float_model, algo=algo)
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, 512, (n,)) for n in (10, 7)]
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8)
+    rids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+    done = eng.run_until_done()
+    for rid, p in zip(rids, prompts):
+        solo = m.generate(paddle.to_tensor(p[None]), max_new_tokens=6).numpy()[0]
+        np.testing.assert_array_equal(done[rid], solo)
+
+
+def test_include_set_narrows_pass(float_model):
+    m, n = quantize_for_serving(float_model, include=("lm_head",))
+    assert n == 1
+    from paddle_tpu.nn.layers_common import Linear
+
+    assert isinstance(m.llama.layers[0].self_attn.q_proj, Linear)
+
+
+def test_mp_linears_left_alone():
+    """Sharded (ColumnParallel/RowParallel) projections must NOT be swapped
+    — quantizing a local shard with shard-local scales would silently
+    change the math under mp."""
+    import paddle_tpu.distributed as dist
+
+    dist.set_hybrid_communicate_group(
+        dist.HybridCommunicateGroup(mp_degree=2))
+    try:
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1))
+        assert isinstance(m.llama.layers[0].self_attn.q_proj,
+                          dist.ColumnParallelLinear)
+        m, n = quantize_for_serving(m)
+        assert n == 0  # every projection is parallel under mp
+        assert isinstance(m.llama.layers[0].self_attn.q_proj,
+                          dist.ColumnParallelLinear)
+    finally:
+        dist.set_hybrid_communicate_group(None)
