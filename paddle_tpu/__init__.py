@@ -199,6 +199,7 @@ _LAZY_SUBMODULES = (
     "hub",
     "version",
     "tensorrt",
+    "peft",
 )
 
 

@@ -486,8 +486,6 @@ class LlamaForCausalLM(Layer):
     def forward(self, input_ids, labels=None, attention_mask=None):
         hidden = self.llama(input_ids, attention_mask)
         if labels is not None and self.config.fuse_linear_cross_entropy:
-            from ..ops.fused_loss import fused_linear_cross_entropy
-
             if _mp_enabled():
                 # the lm-head / embedding weight is a vocab SHARD under mp;
                 # feeding it to the fused op would logsumexp over the local
@@ -498,15 +496,22 @@ class LlamaForCausalLM(Layer):
                     "parallelism (the vocab projection is sharded); unset the "
                     "flag — the lm-head gather_output path computes the same "
                     "loss correctly under mp")
-            if self.lm_head is None:  # tied: embedding weight [vocab, hidden]
-                w, layout = self.llama.embed_tokens.weight, "vh"
-            else:
-                w, layout = self.lm_head.weight, "hv"
-            loss = apply(
-                "fused_linear_cross_entropy",
-                lambda h, ww, lb: fused_linear_cross_entropy(h, ww, lb, layout),
-                hidden, w, labels)
-            return loss, None
+            # the fused op contracts the RAW weight matrix; a swapped head
+            # (WeightOnlyLinear, LoRALinear, ...) computes logits through
+            # its own forward, so those fall through to the logits path
+            if self.lm_head is None or isinstance(self.lm_head, nn.Linear):
+                from ..ops.fused_loss import fused_linear_cross_entropy
+
+                if self.lm_head is None:  # tied: embedding weight [vocab, hidden]
+                    w, layout = self.llama.embed_tokens.weight, "vh"
+                else:
+                    w, layout = self.lm_head.weight, "hv"
+                loss = apply(
+                    "fused_linear_cross_entropy",
+                    lambda h, ww, lb: fused_linear_cross_entropy(h, ww, lb,
+                                                                 layout),
+                    hidden, w, labels)
+                return loss, None
         logits = self.lm_head_logits(hidden)
         if labels is None:
             return logits

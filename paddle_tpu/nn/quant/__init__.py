@@ -196,21 +196,12 @@ def quantize_for_serving(model, algo="weight_only_int8", include=None,
     any other, with int8 weights.
     """
     from ..layers_common import Linear
+    from ..utils import replace_sublayers
 
     include = _QUANT_TARGETS if include is None else tuple(include)
-    n = 0
-
-    def visit(layer):
-        nonlocal n
-        for name, sub in list(layer._sub_layers.items()):
-            if sub is None:
-                continue
-            if isinstance(sub, Linear) and name in include:
-                layer._sub_layers[name] = WeightOnlyLinear.from_linear(
-                    sub, algo=algo, llm_int8_threshold=llm_int8_threshold)
-                n += 1
-            else:
-                visit(sub)
-
-    visit(model)
+    n = replace_sublayers(
+        model,
+        lambda name, sub: isinstance(sub, Linear) and name in include,
+        lambda sub: WeightOnlyLinear.from_linear(
+            sub, algo=algo, llm_int8_threshold=llm_int8_threshold))
     return model, n

@@ -115,3 +115,29 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=Non
     layer._sn_hook = layer.register_forward_pre_hook(hook)
     hook(layer, None)
     return layer
+
+
+def replace_sublayers(model, match_fn, build_fn):
+    """Recursive IN-PLACE sublayer replacement: wherever
+    ``match_fn(attr_name, sublayer)`` is True, install
+    ``build_fn(sublayer)`` in its place (the matched subtree is not
+    descended into). Returns the replacement count.
+
+    The one traversal shared by the model-surgery passes
+    (nn.quant.quantize_for_serving, peft.get_peft_model/merge_lora).
+    """
+    n = 0
+
+    def visit(layer):
+        nonlocal n
+        for name, sub in list(layer._sub_layers.items()):
+            if sub is None:
+                continue
+            if match_fn(name, sub):
+                layer._sub_layers[name] = build_fn(sub)
+                n += 1
+            else:
+                visit(sub)
+
+    visit(model)
+    return n

@@ -1,0 +1,144 @@
+"""LoRA parameter-efficient fine-tuning.
+
+Role parity: the PEFT/LoRA layer family the reference ecosystem ships for
+LLM fine-tuning (PaddleNLP peft.lora — LoRALinear wrapping a frozen base
+projection with trainable low-rank A/B factors).
+
+TPU-native design: freezing is expressed through ``stop_gradient`` — the
+jit TrainStep already splits functional state into trainable params vs
+buffers on exactly that bit, so a LoRA-wrapped model compiles into a step
+that differentiates ONLY the adapters while the frozen base weights ride
+along as buffers (no wasted backward FLOPs on frozen projections beyond
+the activation grads that must flow through them). ``merge_lora`` folds
+B·A back into the base weight for deployment (zero-overhead inference).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from .nn.layer import Layer
+from .nn.layers_common import Linear
+from .tensor_class import Parameter, unwrap
+from .ops.registry import apply
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    r: int = 8
+    lora_alpha: int = 16
+    lora_dropout: float = 0.0
+    # leaf attribute names to wrap (the attention/MLP projections)
+    target_modules: Sequence[str] = ("q_proj", "k_proj", "v_proj", "o_proj")
+    # also train layers whose PARAMETER name contains one of these substrings
+    # (e.g. ("norm",) to keep norms trainable like PaddleNLP's modules_to_save)
+    modules_to_save: Sequence[str] = ()
+
+
+class LoRALinear(Layer):
+    """y = base(x) + (alpha/r) * dropout(x) @ A @ B with the base frozen.
+
+    A [in, r] Gaussian-initialized, B [r, out] zero-initialized, so the
+    wrapped layer starts EXACTLY equal to the base layer."""
+
+    def __init__(self, base: Linear, r: int, lora_alpha: int = 16,
+                 lora_dropout: float = 0.0):
+        super().__init__()
+        if r <= 0:
+            raise ValueError("LoRA rank r must be positive")
+        self.base = base
+        self.r = int(r)
+        self.scaling = float(lora_alpha) / float(r)
+        self.lora_dropout = float(lora_dropout)
+        in_f = int(base.weight.shape[0])
+        out_f = int(base.weight.shape[1])
+        base.weight.stop_gradient = True
+        if getattr(base, "bias", None) is not None:
+            base.bias.stop_gradient = True
+        dt = base.weight.dtype
+        import jax
+
+        from .framework import random as _random
+
+        a0 = (jax.random.normal(_random.next_key(), (in_f, self.r), jnp.float32)
+              * (1.0 / math.sqrt(self.r)))
+        self.lora_A = Parameter(a0.astype(dt))
+        self.lora_B = Parameter(jnp.zeros((self.r, out_f), dt))
+
+    def forward(self, x):
+        out = self.base(x)
+        scale = self.scaling
+
+        def delta(h, a, b):
+            return (h @ a) @ b * scale
+
+        h = x
+        if self.lora_dropout > 0.0 and self.training:
+            from .nn.functional import dropout as _dropout
+
+            h = _dropout(h, p=self.lora_dropout, training=True)
+        return out + apply("lora_delta", delta, h, self.lora_A, self.lora_B)
+
+    def merge(self) -> Linear:
+        """Fold the adapter into the base weight; returns the base layer."""
+        w = unwrap(self.base.weight)
+        delta = (unwrap(self.lora_A).astype(jnp.float32)
+                 @ unwrap(self.lora_B).astype(jnp.float32)) * self.scaling
+        self.base.weight.set_value((w.astype(jnp.float32) + delta).astype(w.dtype))
+        self.base.weight.stop_gradient = False
+        if getattr(self.base, "bias", None) is not None:
+            self.base.bias.stop_gradient = False
+        return self.base
+
+    def extra_repr(self):
+        return f"r={self.r}, scaling={self.scaling}"
+
+
+def get_peft_model(model, config: LoRAConfig):
+    """Wrap ``config.target_modules`` Linears with LoRALinear IN PLACE and
+    freeze every other parameter (except ``modules_to_save`` matches).
+    Returns (model, n_wrapped)."""
+    from .nn.utils import replace_sublayers
+
+    targets = tuple(config.target_modules)
+    n = replace_sublayers(
+        model,
+        lambda name, sub: isinstance(sub, Linear) and name in targets,
+        lambda sub: LoRALinear(sub, r=config.r, lora_alpha=config.lora_alpha,
+                               lora_dropout=config.lora_dropout))
+    if n == 0:
+        raise ValueError(
+            f"get_peft_model: no Linear matched target_modules="
+            f"{tuple(config.target_modules)}")
+    keep = tuple(config.modules_to_save)
+    for pname, p in model.named_parameters():
+        if "lora_A" in pname or "lora_B" in pname:
+            p.stop_gradient = False
+        elif keep and any(k in pname for k in keep):
+            p.stop_gradient = False
+        else:
+            p.stop_gradient = True
+    return model, n
+
+
+def merge_lora(model):
+    """Fold every LoRALinear back into its base Linear IN PLACE (deployment
+    form: zero adapter overhead, plain Linears). Returns (model, n_merged)."""
+    from .nn.utils import replace_sublayers
+
+    n = replace_sublayers(
+        model,
+        lambda name, sub: isinstance(sub, LoRALinear),
+        lambda sub: sub.merge())
+    for _, p in model.named_parameters():
+        p.stop_gradient = False
+    return model, n
+
+
+def lora_state_dict(model):
+    """Only the adapter tensors (the checkpoint a LoRA fine-tune ships)."""
+    return {k: v for k, v in model.state_dict().items()
+            if "lora_A" in k or "lora_B" in k}

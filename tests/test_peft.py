@@ -1,0 +1,87 @@
+"""LoRA fine-tuning (paddle_tpu.peft): adapters-only training through the
+jit TrainStep, identity at init, merge-for-deployment parity."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.peft import (LoRAConfig, LoRALinear, get_peft_model,
+                             lora_state_dict, merge_lora)
+
+
+def _loss_fn(m, x, y):
+    loss, _ = m(x, labels=y)
+    return loss
+
+
+@pytest.fixture()
+def lora_model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+    return m
+
+
+def test_wrap_is_identity_at_init(lora_model):
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 12)))
+    ref = lora_model(ids).numpy()
+    m, n = get_peft_model(lora_model, LoRAConfig(r=4))
+    assert n == 8  # 2 layers x (q,k,v,o)
+    np.testing.assert_allclose(m(ids).numpy(), ref, atol=1e-6)
+
+
+def test_only_adapters_train(lora_model):
+    m, _ = get_peft_model(lora_model, LoRAConfig(r=4))
+    trainable = [k for k, p in m.named_parameters() if not p.stop_gradient]
+    assert trainable and all("lora_" in k for k in trainable)
+    base_before = {k: np.array(v.numpy())
+                   for k, v in m.state_dict().items() if "lora_" not in k}
+    adapters_before = {k: np.array(v.numpy())
+                       for k, v in lora_state_dict(m).items()}
+    step = paddle.jit.train_step(
+        m, _loss_fn, opt.AdamW(1e-2, parameters=m.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 16)))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 512, (2, 16)))
+    losses = [float(step(x, y).numpy()) for _ in range(5)]
+    assert losses[-1] < losses[0]  # adapters alone reduce the loss
+    after = m.state_dict()
+    for k, v in base_before.items():
+        np.testing.assert_array_equal(np.array(after[k].numpy()), v,
+                                      err_msg=f"frozen {k} changed")
+    changed = sum(not np.array_equal(np.array(after[k].numpy()), v)
+                  for k, v in adapters_before.items())
+    assert changed > 0
+
+
+def test_merge_matches_adapter_forward(lora_model):
+    m, _ = get_peft_model(lora_model, LoRAConfig(r=4))
+    step = paddle.jit.train_step(
+        m, _loss_fn, opt.AdamW(5e-2, parameters=m.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(2).randint(0, 512, (2, 16)))
+    y = paddle.to_tensor(np.random.RandomState(3).randint(0, 512, (2, 16)))
+    for _ in range(3):
+        step(x, y)
+    ids = paddle.to_tensor(np.random.RandomState(4).randint(0, 512, (1, 10)))
+    with_adapters = m(ids).numpy()
+    m, n = merge_lora(m)
+    assert n == 8
+    assert not any("lora_" in k for k in m.state_dict())
+    np.testing.assert_allclose(m(ids).numpy(), with_adapters,
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_modules_to_save_and_generate(lora_model):
+    m, _ = get_peft_model(
+        lora_model, LoRAConfig(r=2, target_modules=("q_proj", "v_proj"),
+                               modules_to_save=("norm",)))
+    trainable = {k for k, p in m.named_parameters() if not p.stop_gradient}
+    assert any("layernorm" in k or "norm" in k for k in trainable)
+    out = m.generate(
+        paddle.to_tensor(np.random.RandomState(5).randint(0, 512, (1, 8))),
+        max_new_tokens=4)
+    assert out.shape == [1, 4]
+
+
+def test_no_target_match_raises(lora_model):
+    with pytest.raises(ValueError, match="target_modules"):
+        get_peft_model(lora_model, LoRAConfig(target_modules=("nope",)))

@@ -86,3 +86,18 @@ def test_mp_linears_left_alone():
                           dist.ColumnParallelLinear)
     finally:
         dist.set_hybrid_communicate_group(None)
+
+
+def test_fused_ce_falls_back_for_swapped_head():
+    """config.fuse_linear_cross_entropy + a quantized lm head: the fused op
+    needs the raw weight matrix, so the loss path must fall back to the
+    head's own forward instead of crashing on .weight."""
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=1, fuse_linear_cross_entropy=True)
+    m = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (1, 8)))
+    ref_loss, _ = m(ids, labels=ids)
+    m, _ = quantize_for_serving(m)
+    loss, logits = m(ids, labels=ids)  # would AttributeError before the fallback
+    assert logits is not None  # fell back to the logits path
+    assert abs(float(loss.numpy()) - float(ref_loss.numpy())) < 0.2
