@@ -323,6 +323,52 @@ def _select(logits_last, key, do_sample, temperature, top_k, top_p):
                          temperature=temperature, top_k=top_k, top_p=top_p)
 
 
+@functools.partial(jax.jit, static_argnames=("do_sample", "temperature",
+                                             "top_k", "top_p", "rp",
+                                             "block_eos", "eos_id"))
+def _select_penalized(logits_last, seen, key, do_sample, temperature, top_k,
+                      top_p, rp, block_eos, eos_id):
+    """_select with HF-semantics repetition penalty (positive logits of
+    seen tokens divided by rp, negative multiplied) and an optional eos
+    block (min_new_tokens phase)."""
+    lg = logits_last.astype(jnp.float32)
+    if rp != 1.0:
+        pen = jnp.where(lg > 0, lg / rp, lg * rp)
+        lg = jnp.where(seen, pen, lg)
+    if block_eos:
+        lg = lg.at[:, eos_id].set(-jnp.inf)
+    return sample_logits(lg, key, do_sample=do_sample,
+                         temperature=temperature, top_k=top_k, top_p=top_p)
+
+
+def _select_next(last, seen, key, do_sample, temperature, top_k, top_p,
+                 rp, i, min_new, eos_token_id):
+    """One-call next-token selection: routes to the plain _select program
+    whenever no penalty applies at step ``i`` (rp == 1 and the eos-block
+    phase is over) — the marshalling shared by the cached and no-cache
+    decode loops."""
+    if rp == 1.0 and i >= min_new:
+        return _select(last, key, do_sample, float(temperature), int(top_k),
+                       float(top_p))
+    return _select_penalized(
+        last, seen if seen is not None else jnp.zeros((last.shape[0], 1), bool),
+        key, do_sample, float(temperature), int(top_k), float(top_p), rp,
+        i < min_new, int(eos_token_id) if eos_token_id is not None else -1)
+
+
+def _seen_from_prompt(ids, vocab, pad_mask=None):
+    """[B, V] flag of tokens present in each row's prompt (pad columns
+    excluded) — the repetition-penalty working set."""
+    B, S0 = ids.shape
+    seen = jnp.zeros((B, vocab), bool)
+    safe = ids.astype(jnp.int32)
+    if pad_mask is not None:
+        upd = pad_mask[:, :S0]
+    else:
+        upd = jnp.ones((B, S0), bool)
+    return seen.at[jnp.arange(B)[:, None], safe].max(upd)
+
+
 # ---------------------------------------------------------------------------
 # decode step machinery
 # ---------------------------------------------------------------------------
@@ -691,8 +737,14 @@ def _get_decode_step(model, max_len):
 def generate(model, input_ids, max_new_tokens=20, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
              use_cache=True, attention_mask=None, paged=False,
-             page_size=16, prefill_chunk_size=None):
+             page_size=16, prefill_chunk_size=None,
+             repetition_penalty=1.0, min_new_tokens=0):
     """Batched autoregressive decode.
+
+    ``repetition_penalty`` (HF semantics): logits of tokens already in the
+    row (prompt + generated so far) are divided by the penalty when
+    positive, multiplied when negative. ``min_new_tokens`` blocks
+    ``eos_token_id`` for the first N generated tokens (requires eos).
 
     ``attention_mask`` [B, S0] (1 = real token, right padding) makes
     ragged batches correct: pad columns are never attended, RoPE positions
@@ -712,6 +764,14 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     cfg = model.config
     if max_new_tokens <= 0:
         return wrap(jnp.zeros((B, 0), ids.dtype))
+    rp = float(repetition_penalty)
+    if rp <= 0:
+        raise ValueError("repetition_penalty must be positive")
+    min_new = int(min_new_tokens)
+    if min_new > 0 and eos_token_id is None:
+        raise ValueError("min_new_tokens requires eos_token_id (it only "
+                         "delays the eos stop)")
+    penalized = rp != 1.0 or min_new > 0
     chunk = int(prefill_chunk_size) if prefill_chunk_size else 0
     if chunk:
         if not use_cache:
@@ -763,7 +823,8 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     with _tape.no_grad():
         if not use_cache:
             return _generate_no_cache(model, ids, max_new_tokens, do_sample,
-                                      temperature, top_k, top_p, eos_token_id)
+                                      temperature, top_k, top_p, eos_token_id,
+                                      rp=rp, min_new=min_new)
 
         # ---- prefill: one jitted computation (flash kernel + cache fill +
         # last-real-logit gather; the [B,1,H] gather before the lm head
@@ -801,10 +862,11 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
             for c in caches:
                 c["row_pos"] = lengths
 
-        if eos_token_id is None and max_new_tokens > 1:
+        if eos_token_id is None and max_new_tokens > 1 and not penalized:
             # fixed-length decode: the whole loop is ONE lax.scan dispatch
             # (sample_t → forward_t → logits_{t+1}); the final token needs
-            # only a sample, no forward
+            # only a sample, no forward. (A repetition penalty carries a
+            # [B, V] seen-set — that run takes the host loop below.)
             scan = _get_scan_decode(model, max_len, max_new_tokens - 1,
                                     do_sample, temperature, top_k, top_p)
             toks, last, caches = scan(last, _random.next_key(), caches)
@@ -816,14 +878,18 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
 
         step = _get_decode_step(model, max_len)
         finished = jnp.zeros((B,), bool)
+        seen = (_seen_from_prompt(ids, cfg.vocab_size, pad_mask)
+                if rp != 1.0 else None)
         out_tokens = []
         for i in range(max_new_tokens):
             key = _random.next_key()
-            nxt = _select(last, key, do_sample, float(temperature),
-                          int(top_k), float(top_p))
+            nxt = _select_next(last, seen, key, do_sample, temperature,
+                               top_k, top_p, rp, i, min_new, eos_token_id)
             if eos_token_id is not None:
                 nxt = jnp.where(finished, eos_token_id, nxt)
                 finished = finished | (nxt == eos_token_id)
+            if seen is not None:
+                seen = seen.at[jnp.arange(B), nxt].set(True)
             out_tokens.append(nxt.reshape(B, 1).astype(ids.dtype))
             if i == max_new_tokens - 1 or (
                     eos_token_id is not None and bool(finished.all())):
@@ -834,20 +900,24 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
 
 
 def _generate_no_cache(model, ids, max_new_tokens, do_sample, temperature,
-                       top_k, top_p, eos_token_id):
+                       top_k, top_p, eos_token_id, rp=1.0, min_new=0):
     B = ids.shape[0]
     finished = jnp.zeros((B,), bool)
+    seen = (_seen_from_prompt(ids, model.config.vocab_size)
+            if rp != 1.0 else None)
     out_tokens = []
     full = ids
-    for _ in range(max_new_tokens):
+    for i in range(max_new_tokens):
         hidden = model.llama(wrap(full))
         last = unwrap(model.lm_head_logits(hidden))[:, -1, :]
         key = _random.next_key()
-        nxt = _select(last, key, do_sample, float(temperature),
-                      int(top_k), float(top_p))
+        nxt = _select_next(last, seen, key, do_sample, temperature, top_k,
+                           top_p, rp, i, min_new, eos_token_id)
         if eos_token_id is not None:
             nxt = jnp.where(finished, eos_token_id, nxt)
             finished = finished | (nxt == eos_token_id)
+        if seen is not None:
+            seen = seen.at[jnp.arange(B), nxt].set(True)
         out_tokens.append(nxt.reshape(B, 1).astype(ids.dtype))
         full = jnp.concatenate([full, out_tokens[-1]], axis=1)
         if eos_token_id is not None and bool(finished.all()):

@@ -472,7 +472,8 @@ class LlamaForCausalLM(Layer):
     def generate(self, input_ids, max_new_tokens=20, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  use_cache=True, attention_mask=None, paged=False,
-                 page_size=16, prefill_chunk_size=None):
+                 page_size=16, prefill_chunk_size=None,
+                 repetition_penalty=1.0, min_new_tokens=0):
         """Batched autoregressive decode (see paddle_tpu.generation)."""
         from ..generation import generate as _generate
 
@@ -481,7 +482,9 @@ class LlamaForCausalLM(Layer):
                          top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
                          use_cache=use_cache, attention_mask=attention_mask,
                          paged=paged, page_size=page_size,
-                         prefill_chunk_size=prefill_chunk_size)
+                         prefill_chunk_size=prefill_chunk_size,
+                         repetition_penalty=repetition_penalty,
+                         min_new_tokens=min_new_tokens)
 
     def forward(self, input_ids, labels=None, attention_mask=None):
         hidden = self.llama(input_ids, attention_mask)

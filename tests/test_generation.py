@@ -364,3 +364,75 @@ class TestChunkedPrefill:
                        prefill_chunk_size=4)
         steps = m.__dict__.get("_chunked_prefill_steps")
         assert steps is not None and len(steps) == 1, steps and len(steps)
+
+
+class TestPenalties:
+    """repetition_penalty / min_new_tokens: HF-semantics parity against
+    transformers' logits processors on an identical converted model."""
+
+    @pytest.fixture(scope="class")
+    def hf_pair(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        from transformers import LlamaConfig as HFConfig
+        from transformers import LlamaForCausalLM as HFLlama
+        from paddle_tpu.models.llama import llama_from_hf
+
+        torch.manual_seed(0)
+        hf_cfg = HFConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=128,
+                          attention_bias=False, tie_word_embeddings=False)
+        hf = HFLlama(hf_cfg).eval()
+        ours = llama_from_hf(hf, dtype="float32", use_flash_attention=False)
+        return hf, ours
+
+    def test_repetition_penalty_matches_transformers(self, hf_pair):
+        import torch
+
+        hf, ours = hf_pair
+        ids = np.random.RandomState(0).randint(0, 128, (2, 10))
+        with torch.no_grad():
+            ref = hf.generate(torch.from_numpy(ids), max_new_tokens=8,
+                              do_sample=False,
+                              repetition_penalty=1.7).numpy()[:, 10:]
+        got = ours.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                            repetition_penalty=1.7).numpy()
+        np.testing.assert_array_equal(got, ref)
+
+    def test_min_new_tokens_blocks_eos(self, hf_pair):
+        import torch
+
+        hf, ours = hf_pair
+        ids = np.random.RandomState(1).randint(0, 128, (1, 8))
+        # pick the model's own first greedy token as a fake eos so the
+        # unconstrained run would stop immediately
+        first = int(ours.generate(paddle.to_tensor(ids),
+                                  max_new_tokens=1).numpy()[0, 0])
+        with torch.no_grad():
+            ref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                              do_sample=False, eos_token_id=first,
+                              min_new_tokens=4,
+                              pad_token_id=first).numpy()[:, 8:]
+        got = ours.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                            eos_token_id=first, min_new_tokens=4).numpy()
+        assert got.shape[1] >= 4
+        n = min(got.shape[1], ref.shape[1])
+        np.testing.assert_array_equal(got[:, :n], ref[:, :n])
+
+    def test_penalty_validation(self, hf_pair):
+        _, ours = hf_pair
+        ids = paddle.to_tensor(np.zeros((1, 4), np.int64))
+        with pytest.raises(ValueError, match="positive"):
+            ours.generate(ids, repetition_penalty=0.0)
+        with pytest.raises(ValueError, match="eos"):
+            ours.generate(ids, min_new_tokens=2)
+
+    def test_no_cache_path_matches_cached(self, hf_pair):
+        _, ours = hf_pair
+        ids = np.random.RandomState(2).randint(0, 128, (2, 9))
+        a = ours.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                          repetition_penalty=1.4).numpy()
+        b = ours.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                          repetition_penalty=1.4, use_cache=False).numpy()
+        np.testing.assert_array_equal(a, b)
