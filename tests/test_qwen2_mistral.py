@@ -194,3 +194,58 @@ class TestQwen2:
                                do_sample=False).numpy()[:, 9:]
         ggot = ours.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
         np.testing.assert_array_equal(ggot, gref)
+
+
+class TestHybridMesh:
+    """The family deviations (qkv bias, sliding window) must survive the
+    hybrid tensor-parallel path: mp2-sharded forward == single-device."""
+
+    def _mp2(self):
+        import paddle_tpu.distributed as dist
+
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                                   "pp_degree": 1, "sharding_degree": 1,
+                                   "sep_degree": 1}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        return dist
+
+    def test_qwen2_bias_mp2_parity(self):
+        dist = self._mp2()
+        try:
+            paddle.seed(0)
+            m = Qwen2ForCausalLM(Qwen2Config.tiny())
+            from paddle_tpu.distributed import ColumnParallelLinear
+
+            attn = m.llama.layers[0].self_attn
+            assert isinstance(attn.q_proj, ColumnParallelLinear)
+            assert attn.q_proj.bias is not None
+            state = {k: np.array(v.numpy()) for k, v in m.state_dict().items()}
+            ids = paddle.to_tensor(
+                np.random.RandomState(0).randint(0, 512, (2, 12)))
+            sharded = m(ids).numpy()
+        finally:
+            dist.set_hybrid_communicate_group(None)
+        paddle.seed(1)
+        solo = Qwen2ForCausalLM(Qwen2Config.tiny())
+        solo.set_state_dict(state)
+        np.testing.assert_allclose(solo(ids).numpy(), sharded,
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_mistral_window_mp2_parity(self):
+        dist = self._mp2()
+        try:
+            paddle.seed(0)
+            cfg = MistralConfig.tiny(sliding_window=8)
+            m = MistralForCausalLM(cfg)
+            state = {k: np.array(v.numpy()) for k, v in m.state_dict().items()}
+            ids = paddle.to_tensor(
+                np.random.RandomState(0).randint(0, 512, (1, 24)))
+            sharded = m(ids).numpy()
+        finally:
+            dist.set_hybrid_communicate_group(None)
+        paddle.seed(1)
+        solo = MistralForCausalLM(MistralConfig.tiny(sliding_window=8))
+        solo.set_state_dict(state)
+        np.testing.assert_allclose(solo(ids).numpy(), sharded,
+                                   atol=2e-4, rtol=2e-4)
