@@ -181,6 +181,13 @@ def serve_bench(devs, gen):
     slots, max_len, n_req = (16, 512, 48) if on_tpu else (4, 64, 8)
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
+    quantized = bool(os.environ.get("BENCH_SERVE_INT8"))
+    if quantized:
+        # weight-only int8 serving leg: weights at 1 byte/element through
+        # HBM (decode is weight-bandwidth-bound, so this is the knob)
+        from paddle_tpu.nn.quant import quantize_for_serving
+
+        model, _ = quantize_for_serving(model)
     rng = np.random.RandomState(0)
 
     def run():
@@ -205,7 +212,7 @@ def serve_bench(devs, gen):
         "platform": devs[0].platform,
         "requests": n_req,
         "slots": slots,
-        "config": "serve",
+        "config": "serve_int8" if quantized else "serve",
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
@@ -389,8 +396,11 @@ def orchestrate():
         print("# TPU bench failed after a good probe", file=sys.stderr)
 
     # 3. tunnel down or bench failed: fall back to the best TPU result seen
-    # for THIS config
-    best = _load_best(os.environ.get("BENCH_CONFIG", "1b"))
+    # for THIS config (the int8 serve leg records under its own key)
+    cfg_name = os.environ.get("BENCH_CONFIG", "1b")
+    if cfg_name == "serve" and os.environ.get("BENCH_SERVE_INT8"):
+        cfg_name = "serve_int8"
+    best = _load_best(cfg_name)
     if best is not None:
         best = dict(best)
         best["cached"] = True

@@ -209,3 +209,32 @@ def test_grouped_mlp_ragged_dot_bf16():
     scale = max(1.0, float(np.abs(a).max()))
     np.testing.assert_allclose(b / scale, a / scale,
                                rtol=BF16_RTOL, atol=BF16_ATOL)
+
+
+def test_fused_linear_cross_entropy_bf16():
+    """The 8b bench's loss path: chunked fused lm-head+CE under bf16
+    hidden/weight, loss and grads within bf16 scale tolerance of f32."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.fused_loss import fused_linear_cross_entropy
+
+    h_np = (_rng.randn(32, 24) * 0.5).astype("float32")
+    w_np = (_rng.randn(24, 48) * 0.2).astype("float32")
+    lab = jnp.asarray(_rng.randint(0, 48, (32,)))
+
+    def run(dt):
+        h = jnp.asarray(h_np, dt)
+        w = jnp.asarray(w_np, dt)
+        loss, grads = jax.value_and_grad(
+            lambda hh, ww: fused_linear_cross_entropy(hh, ww, lab, "hv", 8),
+            argnums=(0, 1))(h, w)
+        return [jnp.asarray(loss)[None], grads[0], grads[1]]
+
+    for i, (a, b) in enumerate(zip(run(jnp.float32), run(jnp.bfloat16))):
+        scale = max(1.0, float(np.abs(np.asarray(a)).max()))
+        rtol, atol = ((BF16_RTOL, BF16_ATOL) if i == 0   # loss: fwd tol
+                      else (GRAD_RTOL, GRAD_ATOL))       # grads: grad tol
+        np.testing.assert_allclose(np.asarray(b, np.float32) / scale,
+                                   np.asarray(a) / scale,
+                                   rtol=rtol, atol=atol)
