@@ -418,6 +418,19 @@ def _split_caches(caches):
     return bufs, aux
 
 
+def _cached_forward(model, max_len, state, token, bufs, aux):
+    """The shared pure decode body: merge cache halves, run the cached
+    forward under functional weights, split the updated caches back.
+    Returns (logits, new_bufs, new_aux)."""
+    caches = [{**b, **a} for b, a in zip(bufs, aux)]
+    with _functional_weights(model, state), _tape.no_grad():
+        hidden, new_caches = model.llama.forward_cached(
+            wrap(token), caches, rope_len=max_len)
+        logits = model.lm_head_logits(hidden)
+    nb, na = _split_caches(_unwrap_caches(new_caches))
+    return unwrap(logits), nb, na
+
+
 class _DecodeStep:
     """ONE jitted computation per generated token: embed → all layers with
     in-place (donated) cache buffers → lm-head logits. The TrainStep
@@ -427,13 +440,7 @@ class _DecodeStep:
         self._model = model
 
         def pure(state, token, bufs, aux):
-            caches = [{**b, **a} for b, a in zip(bufs, aux)]
-            with _functional_weights(model, state), _tape.no_grad():
-                hidden, new_caches = model.llama.forward_cached(
-                    wrap(token), caches, rope_len=max_len)
-                logits = model.lm_head_logits(hidden)
-            nb, na = _split_caches(_unwrap_caches(new_caches))
-            return unwrap(logits), nb, na
+            return _cached_forward(model, max_len, state, token, bufs, aux)
 
         self._jitted = jax.jit(pure, donate_argnums=(2,))
         self._state = dict(model.functional_state())
@@ -442,6 +449,166 @@ class _DecodeStep:
         bufs, aux = _split_caches(caches)
         logits, nb, na = self._jitted(self._state, token, bufs, aux)
         return logits, [{**b, **a} for b, a in zip(nb, na)]
+
+
+class _BeamStep:
+    """Beam-search decode unit, ONE jitted dispatch per step: gather the
+    cache rows each surviving beam came from (beam reordering), run the
+    cached forward on the chosen tokens, return next log-probs."""
+
+    def __init__(self, model, max_len):
+        self._model = model
+
+        def pure(state, token, row_idx, bufs, aux):
+            take = lambda a: (jnp.take(a, row_idx, axis=0)
+                              if hasattr(a, "ndim") and a.ndim >= 1
+                              and a.shape[0] == row_idx.shape[0] else a)
+            bufs = jax.tree.map(take, bufs)
+            aux = jax.tree.map(take, aux)
+            logits, nb, na = _cached_forward(model, max_len, state, token,
+                                             bufs, aux)
+            logp = jax.nn.log_softmax(
+                logits[:, -1, :].astype(jnp.float32), axis=-1)
+            return logp, nb, na
+
+        self._jitted = jax.jit(pure, donate_argnums=(3,))
+        self._state = dict(model.functional_state())
+
+    def __call__(self, token, row_idx, caches):
+        bufs, aux = _split_caches(caches)
+        logp, nb, na = self._jitted(self._state, token, row_idx, bufs, aux)
+        return logp, [{**b, **a} for b, a in zip(nb, na)]
+
+
+def _get_beam_step(model, max_len):
+    return _memoized_step(model, "_beam_steps", (max_len,),
+                          lambda: _BeamStep(model, max_len))
+
+
+class _BeamHyps:
+    """Per-batch pool of finished hypotheses (HF BeamHypotheses semantics:
+    scores are sum-logprob / len**length_penalty over GENERATED tokens)."""
+
+    def __init__(self, k, length_penalty, early_stopping):
+        self.k, self.lp, self.early = k, length_penalty, early_stopping
+        self.items = []  # (score, tokens list)
+
+    def add(self, sum_logprob, tokens):
+        score = sum_logprob / (max(len(tokens), 1) ** self.lp)
+        self.items.append((score, tokens))
+        self.items.sort(key=lambda t: -t[0])
+        del self.items[self.k:]
+
+    def is_done(self, best_running_sum, cur_len):
+        if len(self.items) < self.k:
+            return False
+        if self.early:
+            return True
+        return (best_running_sum / (max(cur_len, 1) ** self.lp)
+                <= self.items[-1][0])
+
+
+def _beam_search(model, last, caches, max_len, max_new_tokens,
+                 num_beams, eos_token_id, length_penalty, early_stopping):
+    """Host-scored beam search over the cached decode path (the LLM analog
+    of nn.BeamSearchDecoder/dynamic_decode; HF generate num_beams
+    semantics). ``last``/``caches`` arrive from the B-row prefill; beams
+    live as B*K cache rows, reordered inside the jitted _BeamStep."""
+    import numpy as np
+
+    B = last.shape[0]
+    K = num_beams
+    V = last.shape[-1]
+
+    def tile(a):
+        return jnp.repeat(a, K, axis=0)
+
+    bufs, aux = _split_caches(caches)
+    bufs = jax.tree.map(
+        lambda a: tile(a) if a.ndim >= 1 and a.shape[0] == B else a, bufs)
+    aux = jax.tree.map(
+        lambda a: tile(a) if hasattr(a, "ndim") and a.ndim >= 1
+        and a.shape[0] == B else a, aux)
+    caches = [{**b, **a} for b, a in zip(bufs, aux)]
+
+    logp0 = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
+    logp0 = np.asarray(tile(logp0)).reshape(B, K, V)
+    # beam 0 seeds the search; the copies start at -inf so step 1's top-k
+    # cannot pick the same token K times
+    cum = np.full((B, K), -np.inf, np.float64)
+    cum[:, 0] = 0.0
+    hyps = [_BeamHyps(K, length_penalty, early_stopping) for _ in range(B)]
+    done = [False] * B
+    beams_tokens = [[[] for _ in range(K)] for _ in range(B)]
+    step_fn = _get_beam_step(model, max_len)
+    row_idx = np.arange(B * K, dtype=np.int32)  # identity on the first step
+    logp = logp0
+
+    for i in range(max_new_tokens):
+        total = cum[:, :, None] + logp          # [B, K, V] float64 scores
+        flat = total.reshape(B, K * V)
+        # 2K candidates per batch (eos hits may retire, HF convention);
+        # O(KV) partial select, then sort only the survivors
+        part = np.argpartition(-flat, 2 * K - 1, axis=1)[:, : 2 * K]
+        order = np.argsort(-np.take_along_axis(flat, part, axis=1), axis=1)
+        top = np.take_along_axis(part, order, axis=1)
+        next_tokens = []
+        next_origin = []
+        next_cum = []
+        for b in range(B):
+            if done[b]:
+                next_tokens.append([0] * K)
+                next_origin.append([b * K] * K)
+                next_cum.append([-np.inf] * K)
+                continue
+            toks, orig, cums = [], [], []
+            for rank, cand in enumerate(top[b]):
+                beam, tok = divmod(int(cand), V)
+                score = flat[b, cand]
+                if eos_token_id is not None and tok == eos_token_id:
+                    if rank < K:  # only top-K eos candidates retire
+                        hyps[b].add(score, beams_tokens[b][beam] + [tok])
+                    continue
+                toks.append(tok)
+                orig.append(b * K + beam)
+                cums.append(score)
+                if len(toks) == K:
+                    break
+            next_tokens.append(toks)
+            next_origin.append(orig)
+            next_cum.append(cums)
+            beams_tokens[b] = [beams_tokens[b][orig[j] - b * K] +
+                               [toks[j]] for j in range(K)]
+            # HF passes the max over ALL 2K candidates (eos hits included)
+            # as the best running sum — not just the kept non-eos beams
+            if hyps[b].is_done(float(flat[b, top[b][0]]), i + 1):
+                done[b] = True
+        if all(done) or i == max_new_tokens - 1:
+            for b in range(B):
+                if not done[b] or not hyps[b].items:
+                    # flush running beams at the length limit
+                    for j in range(K):
+                        if np.isfinite(next_cum[b][j]):
+                            hyps[b].add(next_cum[b][j], beams_tokens[b][j])
+            break
+        cum = np.asarray(next_cum, np.float64)
+        row_idx = np.asarray(next_origin, np.int32).reshape(-1)
+        token = jnp.asarray(np.asarray(next_tokens, np.int64).reshape(-1, 1))
+        logp_dev, caches = step_fn(token, jnp.asarray(row_idx), caches)
+        logp = np.asarray(logp_dev).reshape(B, K, V)
+
+    outs = []
+    for b in range(B):
+        if hyps[b].items:
+            outs.append(hyps[b].items[0][1])
+        else:  # no finished hypothesis: best running beam
+            outs.append(beams_tokens[b][int(np.argmax(cum[b]))])
+    width = max(1, max(len(o) for o in outs))
+    fill = eos_token_id if eos_token_id is not None else 0
+    arr = np.full((B, width), fill, np.int64)
+    for b, o in enumerate(outs):
+        arr[b, : len(o)] = o
+    return wrap(jnp.asarray(arr))
 
 
 class _PrefillStep:
@@ -738,13 +905,19 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
              use_cache=True, attention_mask=None, paged=False,
              page_size=16, prefill_chunk_size=None,
-             repetition_penalty=1.0, min_new_tokens=0):
+             repetition_penalty=1.0, min_new_tokens=0,
+             num_beams=1, length_penalty=1.0, early_stopping=False):
     """Batched autoregressive decode.
 
     ``repetition_penalty`` (HF semantics): logits of tokens already in the
     row (prompt + generated so far) are divided by the penalty when
     positive, multiplied when negative. ``min_new_tokens`` blocks
     ``eos_token_id`` for the first N generated tokens (requires eos).
+
+    ``num_beams > 1`` runs beam search (greedy scoring over K beams per
+    row, HF semantics: 2K candidates per step, eos hits retire into a
+    hypothesis pool scored by sum-logprob / len**length_penalty); returns
+    each row's best hypothesis.
 
     ``attention_mask`` [B, S0] (1 = real token, right padding) makes
     ragged batches correct: pad columns are never attended, RoPE positions
@@ -772,6 +945,22 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
         raise ValueError("min_new_tokens requires eos_token_id (it only "
                          "delays the eos stop)")
     penalized = rp != 1.0 or min_new > 0
+    num_beams = int(num_beams)
+    if num_beams > 1:
+        if do_sample:
+            raise NotImplementedError(
+                "beam search with do_sample=True (beam sampling) is not "
+                "supported; use num_beams>1 with do_sample=False")
+        if paged:
+            raise NotImplementedError(
+                "beam search over the paged KV layout is not supported; "
+                "use paged=False (beams reorder dense cache rows)")
+        if penalized:
+            raise NotImplementedError(
+                "repetition_penalty/min_new_tokens with num_beams>1 is "
+                "not supported")
+        if not use_cache:
+            raise NotImplementedError("beam search needs use_cache=True")
     chunk = int(prefill_chunk_size) if prefill_chunk_size else 0
     if chunk:
         if not use_cache:
@@ -861,6 +1050,11 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
         if pad_mask is not None and not paged:
             for c in caches:
                 c["row_pos"] = lengths
+
+        if num_beams > 1:
+            return _beam_search(model, last, caches, max_len,
+                                max_new_tokens, num_beams, eos_token_id,
+                                float(length_penalty), bool(early_stopping))
 
         if eos_token_id is None and max_new_tokens > 1 and not penalized:
             # fixed-length decode: the whole loop is ONE lax.scan dispatch

@@ -473,7 +473,8 @@ class LlamaForCausalLM(Layer):
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  use_cache=True, attention_mask=None, paged=False,
                  page_size=16, prefill_chunk_size=None,
-                 repetition_penalty=1.0, min_new_tokens=0):
+                 repetition_penalty=1.0, min_new_tokens=0,
+                 num_beams=1, length_penalty=1.0, early_stopping=False):
         """Batched autoregressive decode (see paddle_tpu.generation)."""
         from ..generation import generate as _generate
 
@@ -484,7 +485,9 @@ class LlamaForCausalLM(Layer):
                          paged=paged, page_size=page_size,
                          prefill_chunk_size=prefill_chunk_size,
                          repetition_penalty=repetition_penalty,
-                         min_new_tokens=min_new_tokens)
+                         min_new_tokens=min_new_tokens, num_beams=num_beams,
+                         length_penalty=length_penalty,
+                         early_stopping=early_stopping)
 
     def forward(self, input_ids, labels=None, attention_mask=None):
         hidden = self.llama(input_ids, attention_mask)
