@@ -451,6 +451,12 @@ class _DecodeStep:
         return logits, [{**b, **a} for b, a in zip(nb, na)]
 
 
+def _rows_match(a, n):
+    """True for array leaves whose leading axis is the batch/beam rows —
+    the one predicate shared by beam tiling and beam-reorder gathers."""
+    return hasattr(a, "ndim") and a.ndim >= 1 and a.shape[0] == n
+
+
 class _BeamStep:
     """Beam-search decode unit, ONE jitted dispatch per step: gather the
     cache rows each surviving beam came from (beam reordering), run the
@@ -461,8 +467,7 @@ class _BeamStep:
 
         def pure(state, token, row_idx, bufs, aux):
             take = lambda a: (jnp.take(a, row_idx, axis=0)
-                              if hasattr(a, "ndim") and a.ndim >= 1
-                              and a.shape[0] == row_idx.shape[0] else a)
+                              if _rows_match(a, row_idx.shape[0]) else a)
             bufs = jax.tree.map(take, bufs)
             aux = jax.tree.map(take, aux)
             logits, nb, na = _cached_forward(model, max_len, state, token,
@@ -520,19 +525,12 @@ def _beam_search(model, last, caches, max_len, max_new_tokens,
     K = num_beams
     V = last.shape[-1]
 
-    def tile(a):
-        return jnp.repeat(a, K, axis=0)
-
-    bufs, aux = _split_caches(caches)
-    bufs = jax.tree.map(
-        lambda a: tile(a) if a.ndim >= 1 and a.shape[0] == B else a, bufs)
-    aux = jax.tree.map(
-        lambda a: tile(a) if hasattr(a, "ndim") and a.ndim >= 1
-        and a.shape[0] == B else a, aux)
-    caches = [{**b, **a} for b, a in zip(bufs, aux)]
+    caches = jax.tree.map(
+        lambda a: jnp.repeat(a, K, axis=0) if _rows_match(a, B) else a,
+        caches)
 
     logp0 = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
-    logp0 = np.asarray(tile(logp0)).reshape(B, K, V)
+    logp0 = np.asarray(jnp.repeat(logp0, K, axis=0)).reshape(B, K, V)
     # beam 0 seeds the search; the copies start at -inf so step 1's top-k
     # cannot pick the same token K times
     cum = np.full((B, K), -np.inf, np.float64)

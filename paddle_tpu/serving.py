@@ -40,15 +40,18 @@ def _page_tiles(buf, page_size):
 
 
 class _Request:
-    __slots__ = ("rid", "ids", "max_new_tokens", "tokens", "slot", "sampling")
+    __slots__ = ("rid", "ids", "max_new_tokens", "tokens", "slot", "sampling",
+                 "on_token")
 
-    def __init__(self, rid, ids, max_new_tokens, sampling=None):
+    def __init__(self, rid, ids, max_new_tokens, sampling=None,
+                 on_token=None):
         self.rid = rid
         self.ids = np.asarray(ids).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.tokens: List[int] = []
         self.slot = -1
         self.sampling = sampling  # (do_sample, temperature, top_k, top_p) or None
+        self.on_token = on_token  # streaming callback (rid, token, done)
 
 
 class ContinuousBatchEngine:
@@ -109,10 +112,16 @@ class ContinuousBatchEngine:
 
     # ---- public API ---------------------------------------------------------
     def add_request(self, ids, max_new_tokens: int = 64, do_sample=None,
-                    temperature=None, top_k=None, top_p=None) -> int:
+                    temperature=None, top_k=None, top_p=None,
+                    on_token=None) -> int:
         """Queue one request. Sampling knobs default to the engine-level
         configuration; any per-request override routes decoding through the
-        per-row sampling program (one compiled step serves the whole mix)."""
+        per-row sampling program (one compiled step serves the whole mix).
+
+        ``on_token(rid, token, done)`` streams each generated token as the
+        engine's step that produced it completes (token-level streaming —
+        the serving front-end's SSE hook); exceptions it raises propagate
+        out of step()/run_until_done()."""
         ids = np.asarray(unwrap(ids) if isinstance(ids, Tensor) else ids).reshape(-1)
         if ids.size + max_new_tokens > self.max_len:
             raise ValueError(
@@ -130,7 +139,8 @@ class ContinuousBatchEngine:
                 sampling = None  # explicit values equal to the defaults
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, ids, max_new_tokens, sampling))
+        self._queue.append(_Request(rid, ids, max_new_tokens, sampling,
+                                    on_token))
         self._admit()
         return rid
 
@@ -174,13 +184,20 @@ class ContinuousBatchEngine:
                 self._last, _random.next_key(), self._caches)
         toks = np.asarray(nxt)
         retiring = []
+        events = []  # (cb, rid, token, done): fired AFTER bookkeeping, so a
+        # raising callback cannot leave _lengths/slot state desynced from
+        # the already-advanced device step
         for s, req in enumerate(self._slots):
             if req is None:
                 continue
             t = int(toks[s])
             req.tokens.append(t)
-            if (len(req.tokens) >= req.max_new_tokens
-                    or (self.eos_token_id is not None and t == self.eos_token_id)):
+            finished = (len(req.tokens) >= req.max_new_tokens
+                        or (self.eos_token_id is not None
+                            and t == self.eos_token_id))
+            if req.on_token is not None:
+                events.append((req.on_token, req.rid, t, finished))
+            if finished:
                 retiring.append(s)
         active = np.array([r is not None for r in self._slots])
         self._lengths = jnp.where(jnp.asarray(active),
@@ -191,6 +208,17 @@ class ContinuousBatchEngine:
             self._finished[req.rid] = np.asarray(req.tokens, np.int64)
             self._slots[s] = None
             self._lengths = self._lengths.at[s].set(0)
+        # stream AFTER state is consistent: every callback fires even if an
+        # earlier one raises; the first exception then propagates
+        first_exc = None
+        for cb, rid, t, done in events:
+            try:
+                cb(rid, t, done)
+            except BaseException as e:  # noqa: BLE001 — deliberate collect
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
         self._admit()
         return self._drain_finished()
 

@@ -417,8 +417,10 @@ class TestPenalties:
         got = ours.generate(paddle.to_tensor(ids), max_new_tokens=6,
                             eos_token_id=first, min_new_tokens=4).numpy()
         assert got.shape[1] >= 4
-        n = min(got.shape[1], ref.shape[1])
-        np.testing.assert_array_equal(got[:, :n], ref[:, :n])
+        if got.shape[1] < ref.shape[1]:  # both pad with the eos id
+            got = np.pad(got, ((0, 0), (0, ref.shape[1] - got.shape[1])),
+                         constant_values=first)
+        np.testing.assert_array_equal(got[:, :ref.shape[1]], ref)
 
     def test_penalty_validation(self, hf_pair):
         _, ours = hf_pair
@@ -481,8 +483,13 @@ class TestBeamSearch:
         got = ours.generate(paddle.to_tensor(ids), max_new_tokens=8,
                             num_beams=beams, eos_token_id=eos,
                             length_penalty=lp, early_stopping=es).numpy()
-        n = min(got.shape[1], ref.shape[1])
-        np.testing.assert_array_equal(got[:, :n], ref[:, :n])
+        # compare at FULL reference width: both sides pad with eos, so a
+        # termination-length divergence cannot hide behind a prefix slice
+        fill = eos if eos is not None else 0
+        if got.shape[1] < ref.shape[1]:
+            got = np.pad(got, ((0, 0), (0, ref.shape[1] - got.shape[1])),
+                         constant_values=fill)
+        np.testing.assert_array_equal(got[:, :ref.shape[1]], ref)
 
     def test_ragged_batch_matches_solo(self, hf_pair):
         """Beam search over a right-padded batch == each row's solo run."""

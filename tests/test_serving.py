@@ -220,3 +220,24 @@ def test_per_request_top_k1_is_greedy(tiny_model):
                           temperature=2.5)
     done = eng.run_until_done()
     np.testing.assert_array_equal(done[rid], solo)
+
+
+def test_streaming_on_token_callback(tiny_model):
+    """on_token streams every generated token in order, flags the last one
+    done, and the streamed sequence equals the returned one."""
+    m = tiny_model
+    rng = np.random.RandomState(13)
+    streamed = {}
+
+    def cb(rid, token, done):
+        streamed.setdefault(rid, []).append((token, done))
+
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8)
+    rids = [eng.add_request(rng.randint(0, 512, (6 + i,)), max_new_tokens=5,
+                            on_token=cb) for i in range(3)]
+    done = eng.run_until_done()
+    for rid in rids:
+        toks = [t for t, _ in streamed[rid]]
+        flags = [d for _, d in streamed[rid]]
+        np.testing.assert_array_equal(np.asarray(toks), done[rid])
+        assert flags == [False] * (len(flags) - 1) + [True]
