@@ -29,6 +29,10 @@ _LAZY = {
     "MistralConfig": ("mistral", "MistralConfig"),
     "MistralForCausalLM": ("mistral", "MistralForCausalLM"),
     "mistral_from_hf": ("mistral", "mistral_from_hf"),
+    "gpt2": ("gpt2", None),
+    "GPT2Config": ("gpt2", "GPT2Config"),
+    "GPT2LMHeadModel": ("gpt2", "GPT2LMHeadModel"),
+    "gpt2_from_hf": ("gpt2", "gpt2_from_hf"),
 }
 
 

@@ -1,0 +1,359 @@
+"""GPT-2 decoder family (learned positions, pre-LN, gelu MLP).
+
+Role parity: the GPT family is the reference ecosystem's classic
+pretraining flagship (the fleet GPT-3 recipes); architecturally it is the
+pre-RoPE decoder class — learned absolute position embeddings, LayerNorm
+with bias, fused qkv projection, tanh-approx gelu, tied lm head.
+
+TPU-native design: the blocks reuse this build's cached-decode machinery
+(generation.cached_attention and every downstream path: jitted prefill,
+scan decode, paged serving, beam search) by feeding it IDENTITY rotation
+tables — RoPE with cos=1/sin=0 is the identity, so position information
+rides the wpe embedding exactly as GPT-2 defines it while the KV cache
+layout, ragged masks, and per-row position bookkeeping stay shared.
+
+HF interop note: transformers GPT-2 stores projection weights as Conv1D
+[in, out] — the SAME layout as this build's Linear — so conversion is
+transpose-free (unlike the Llama families).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..nn.layer import Layer
+from ..nn.initializer import Normal
+from ..ops.registry import apply
+from ..tensor_class import Tensor, unwrap, wrap
+from .llama import causal_lm_loss, tied_lm_head_logits
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: Optional[int] = None      # default 4*hidden
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    # MHA: the shared cache machinery reads num_key_value_heads
+    @property
+    def num_key_value_heads(self):
+        return self.num_attention_heads
+
+    @staticmethod
+    def gpt2_small(**kw):
+        return GPT2Config(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=128, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=256,
+                    dtype="float32")
+        base.update(kw)
+        return GPT2Config(**base)
+
+
+class GPT2Attention(Layer):
+    """Fused-qkv causal self-attention with biases (the c_attn/c_proj
+    pair); decode rides the shared cached_attention with identity RoPE."""
+
+    def __init__(self, config: GPT2Config):
+        super().__init__(dtype=config.dtype)
+        from ..framework.dtype import dtype_guard
+
+        self.config = config
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        with dtype_guard(config.dtype):
+            self.c_attn = nn.Linear(h, 3 * h)
+            self.c_proj = nn.Linear(h, h)
+
+    def forward(self, hidden, identity_rope, attention_mask=None,
+                kv_cache=None):
+        b, s = hidden.shape[0], hidden.shape[1]
+        h, d = self.num_heads, self.head_dim
+        qkv = self.c_attn(hidden)
+        q, k, v = (t.reshape([b, s, h, d]) for t in
+                   (qkv[:, :, : h * d], qkv[:, :, h * d: 2 * h * d],
+                    qkv[:, :, 2 * h * d:]))
+        cos, sin = identity_rope
+        cfg = self.config
+
+        if isinstance(kv_cache, dict):
+            from ..generation import cached_attention, paged_cached_attention
+
+            if "k_pages" in kv_cache:
+                out, kp, vp = apply(
+                    "gpt2_attention_paged", paged_cached_attention,
+                    q, k, v, cos, sin, kv_cache["k_pages"],
+                    kv_cache["v_pages"], kv_cache["page_indices"],
+                    kv_cache["lengths"], kv_cache.get("page_size"))
+                new = dict(kv_cache)
+                new.update(k_pages=kp, v_pages=vp,
+                           lengths=kv_cache["lengths"] + s)
+                return self.c_proj(out.reshape([b, s, h * d])), new
+            out, k_buf, v_buf = apply(
+                "gpt2_attention_cached", cached_attention, q, k, v, cos, sin,
+                kv_cache["k"], kv_cache["v"], kv_cache["pos"],
+                kv_cache.get("allowed"), kv_cache.get("row_pos"),
+                use_flash=cfg.use_flash_attention,
+                prefill=bool(kv_cache.get("prefill", False)))
+            new = {"k": k_buf, "v": v_buf, "pos": kv_cache["pos"] + s}
+            for key in ("allowed",):
+                if key in kv_cache:
+                    new[key] = kv_cache[key]
+            if "row_pos" in kv_cache:
+                new["row_pos"] = kv_cache["row_pos"] + s
+            return self.c_proj(out.reshape([b, s, h * d])), new
+
+        def attn_fn(q, k, v):
+            from ..nn.functional.attention import _sdpa_ref
+            from ..ops.pallas import flash_attention as pf
+
+            if cfg.use_flash_attention and pf.supported(q, k, v):
+                return pf.flash_attention_bshd(q, k, v, causal=True)
+            return _sdpa_ref(q, k, v, causal=True)
+
+        out = apply("gpt2_attention", attn_fn, q, k, v)
+        return self.c_proj(out.reshape([b, s, h * d]))
+
+
+class GPT2MLP(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__(dtype=config.dtype)
+        from ..framework.dtype import dtype_guard
+
+        with dtype_guard(config.dtype):
+            self.c_fc = nn.Linear(config.hidden_size, config.intermediate_size)
+            self.c_proj = nn.Linear(config.intermediate_size, config.hidden_size)
+
+    def forward(self, x):
+        # gelu_new (tanh approximation) — the GPT-2 activation
+        act = apply("gelu_tanh", lambda a: jax.nn.gelu(a, approximate=True),
+                    self.c_fc(x))
+        return self.c_proj(act)
+
+
+class GPT2Block(Layer):
+    """Pre-LN residual block: x + attn(ln_1(x)); x + mlp(ln_2(x))."""
+
+    def __init__(self, config: GPT2Config):
+        super().__init__(dtype=config.dtype)
+        from ..framework.dtype import dtype_guard
+
+        with dtype_guard(config.dtype):
+            self.ln_1 = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_epsilon)
+            self.ln_2 = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_epsilon)
+        self.attn = GPT2Attention(config)
+        self.mlp = GPT2MLP(config)
+
+    def forward(self, hidden, identity_rope, attention_mask=None,
+                kv_cache=None):
+        if kv_cache is not None:
+            a, kv_cache = self.attn(self.ln_1(hidden), identity_rope,
+                                    attention_mask, kv_cache)
+            hidden = hidden + a
+            hidden = hidden + self.mlp(self.ln_2(hidden))
+            return hidden, kv_cache
+        hidden = hidden + self.attn(self.ln_1(hidden), identity_rope,
+                                    attention_mask)
+        return hidden + self.mlp(self.ln_2(hidden))
+
+
+class GPT2Model(Layer):
+    """wte + wpe embeddings → pre-LN blocks → ln_f. Exposes the cached
+    decode contract (forward_cached) the generation/serving stack drives."""
+
+    def __init__(self, config: GPT2Config):
+        super().__init__(dtype=config.dtype)
+        from ..framework.dtype import dtype_guard
+
+        self.config = config
+        with dtype_guard(config.dtype):
+            self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+            self.wpe = nn.Embedding(config.max_position_embeddings,
+                                    config.hidden_size)
+            self.ln_f = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_epsilon)
+        for emb in (self.wte, self.wpe):
+            emb.weight._array = (
+                Normal(0.0, config.initializer_range)(
+                    tuple(emb.weight.shape), jnp.float32)
+                .astype(emb.weight.dtype))
+        self.h = nn.LayerList([GPT2Block(config)
+                               for _ in range(config.num_hidden_layers)])
+        self._rope_cache = {}
+
+    def _identity_rope(self, length):
+        """cos=1 / sin=0 tables: RoPE becomes the identity, so the shared
+        cache machinery runs unrotated GPT-2 attention."""
+        if length not in self._rope_cache:
+            d = self.config.hidden_size // self.config.num_attention_heads
+            # concrete numpy constants: this may be first called INSIDE a
+            # jit trace, and caching a traced jnp.ones would leak the tracer
+            self._rope_cache[length] = (np.ones((length, d), np.float32),
+                                        np.zeros((length, d), np.float32))
+        cos, sin = self._rope_cache[length]
+        # hand out jnp views (traced code indexes them with traced ids;
+        # numpy would call __array__ on the tracer)
+        return jnp.asarray(cos), jnp.asarray(sin)
+
+    def _positions(self, s, caches):
+        """Absolute positions for the current chunk: per-row (ragged) when
+        the cache carries row_pos, else the shared scalar offset."""
+        if caches and isinstance(caches[0], dict):
+            c0 = caches[0]
+            row_pos = c0.get("row_pos")
+            if row_pos is None and "lengths" in c0:   # paged layout
+                row_pos = c0["lengths"]
+            if row_pos is not None:
+                return row_pos[:, None] + jnp.arange(s)[None, :]
+            return c0["pos"] + jnp.arange(s)
+        return jnp.arange(s)
+
+    def _embed(self, input_ids, positions):
+        ids = unwrap(input_ids) if isinstance(input_ids, Tensor) else input_ids
+        tok = unwrap(self.wte(wrap(ids)))
+        wpe = unwrap(self.wpe.weight)
+        pe = jnp.take(wpe, jnp.asarray(positions), axis=0)
+        if pe.ndim == 2:           # [S, h] shared positions
+            pe = pe[None]
+        return wrap((tok + pe).astype(jnp.dtype(self.config.dtype)))
+
+    def forward(self, input_ids, attention_mask=None):
+        s = input_ids.shape[1]
+        if s > self.config.max_position_embeddings:
+            # learned position table is FIXED size (unlike RoPE tables);
+            # out-of-range jnp.take would silently fill garbage embeddings
+            raise ValueError(
+                f"GPT2: sequence length {s} exceeds max_position_embeddings "
+                f"{self.config.max_position_embeddings}")
+        rope = self._identity_rope(s)
+        hidden = self._embed(input_ids, jnp.arange(s))
+        for block in self.h:
+            hidden = block(hidden, rope, attention_mask)
+        return self.ln_f(hidden)
+
+    def forward_cached(self, input_ids, kv_caches, rope_len):
+        s = input_ids.shape[1]
+        rope = self._identity_rope(rope_len)
+        hidden = self._embed(input_ids, self._positions(s, kv_caches))
+        new_caches = []
+        for block, cache in zip(self.h, kv_caches):
+            hidden, c = block(hidden, rope, kv_cache=cache)
+            new_caches.append(c)
+        return self.ln_f(hidden), new_caches
+
+
+class GPT2LMHeadModel(Layer):
+    """GPT-2 causal LM with the tied wte head. The decoder module is
+    installed at the ``llama`` attribute — the cached-decode contract slot
+    every generation/serving path drives (``transformer`` aliases it)."""
+
+    def __init__(self, config: GPT2Config):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.llama = GPT2Model(config)
+        self.lm_head = None  # tied (paddle-side contract for a tied head)
+
+    @property
+    def transformer(self):
+        return self.llama
+
+    def lm_head_logits(self, hidden):
+        return tied_lm_head_logits(hidden, self.llama.wte.weight)
+
+    def forward(self, input_ids, labels=None, attention_mask=None):
+        hidden = self.llama(input_ids, attention_mask)
+        logits = self.lm_head_logits(hidden)
+        if labels is None:
+            return logits
+        return causal_lm_loss(logits, labels), logits
+
+    def generate(self, input_ids, **kw):
+        from ..generation import generate as _generate
+
+        return _generate(self, input_ids, **kw)
+
+    def num_parameters(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+
+# ---------------------------------------------------------------------------
+# HuggingFace checkpoint interop
+# ---------------------------------------------------------------------------
+
+def gpt2_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a GPT2LMHeadModel from a transformers GPT2LMHeadModel (or raw
+    state dict + config). Conv1D weights are [in, out] — no transpose."""
+    from .llama import _hf_to_np
+
+    if hf_config is None:
+        hf_config = hf_model_or_state.config
+        state = hf_model_or_state.state_dict()
+    else:
+        state = hf_model_or_state
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    kw = dict(vocab_size=get("vocab_size"),
+              hidden_size=get("n_embd", get("hidden_size")),
+              num_hidden_layers=get("n_layer", get("num_hidden_layers")),
+              num_attention_heads=get("n_head", get("num_attention_heads")),
+              max_position_embeddings=get("n_positions",
+                                          get("max_position_embeddings")),
+              layer_norm_epsilon=get("layer_norm_epsilon", 1e-5))
+    kw.update(config_overrides)
+    cfg = GPT2Config(**kw)
+    model = GPT2LMHeadModel(cfg)
+
+    plan = {"llama.wte.weight": "transformer.wte.weight",
+            "llama.wpe.weight": "transformer.wpe.weight",
+            "llama.ln_f.weight": "transformer.ln_f.weight",
+            "llama.ln_f.bias": "transformer.ln_f.bias"}
+    for i in range(cfg.num_hidden_layers):
+        hf, ours = f"transformer.h.{i}", f"llama.h.{i}"
+        for mod, parts in (("ln_1", ("weight", "bias")),
+                           ("ln_2", ("weight", "bias"))):
+            for p in parts:
+                plan[f"{ours}.{mod}.{p}"] = f"{hf}.{mod}.{p}"
+        for mod in ("attn.c_attn", "attn.c_proj", "mlp.c_fc", "mlp.c_proj"):
+            plan[f"{ours}.{mod}.weight"] = f"{hf}.{mod}.weight"
+            plan[f"{ours}.{mod}.bias"] = f"{hf}.{mod}.bias"
+
+    mapped, consumed = {}, set()
+    for name, hf_key in plan.items():
+        if hf_key not in state:
+            raise KeyError(f"gpt2_from_hf: checkpoint is missing {hf_key!r}")
+        mapped[name] = _hf_to_np(state[hf_key])
+        consumed.add(hf_key)
+    leftovers = [k for k in state
+                 if k not in consumed and k != "lm_head.weight"
+                 and not k.endswith(".attn.bias")          # causal mask buffer
+                 and not k.endswith(".attn.masked_bias")]
+    if leftovers:
+        raise ValueError(
+            f"gpt2_from_hf: checkpoint tensors this model cannot represent: "
+            f"{leftovers[:5]}{'...' if len(leftovers) > 5 else ''}")
+    missing, unexpected = model.set_state_dict(mapped)
+    assert not unexpected, unexpected
+    if missing:
+        raise KeyError(f"gpt2_from_hf: model keys not covered: {missing[:5]}")
+    return model

@@ -465,8 +465,7 @@ class LlamaForCausalLM(Layer):
 
     def lm_head_logits(self, hidden):
         if self.lm_head is None:
-            return apply("tied_lm_head", lambda h, w: h @ w.T,
-                         hidden, self.llama.embed_tokens.weight)
+            return tied_lm_head_logits(hidden, self.llama.embed_tokens.weight)
         return self.lm_head(hidden)
 
     def generate(self, input_ids, max_new_tokens=20, do_sample=False,
@@ -527,6 +526,13 @@ class LlamaForCausalLM(Layer):
         return sum(p.size for p in self.parameters())
 
 
+def tied_lm_head_logits(hidden, embed_weight):
+    """Project with the shared embedding weight [vocab, hidden] — the ONE
+    tied-head contraction used by every tied causal LM (Llama family,
+    GPT-2, the pipeline head stage)."""
+    return apply("tied_lm_head", lambda h, w: h @ w.T, hidden, embed_weight)
+
+
 def causal_lm_loss(logits, labels):
     """Token-mean causal-LM cross entropy in f32; labels < 0 are ignored
     (the loss the reference's PaddleNLP criterion computes)."""
@@ -566,8 +572,7 @@ class LlamaEmbeddingPipe(Layer):
 
 def _tied_head_forward(layer: "LlamaEmbeddingPipe", hidden):
     """Head forward over the SHARED embedding weight (tied lm head)."""
-    return apply("tied_lm_head", lambda h, w: h @ w.T,
-                 hidden, layer.embed_tokens.weight)
+    return tied_lm_head_logits(hidden, layer.embed_tokens.weight)
 
 
 class LlamaDecoderLayerPipe(Layer):

@@ -1,0 +1,125 @@
+"""GPT-2 family: the pre-RoPE decoder class (learned positions, pre-LN,
+gelu, tied head) on the shared cached-decode machinery — numeric parity
+against transformers, and composition with paged serving, beam search,
+ragged batches, training, and the continuous-batching engine."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, gpt2_from_hf
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def hf_pair():
+    from transformers import GPT2Config as HFConfig
+    from transformers import GPT2LMHeadModel as HFGPT2
+
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(vocab_size=128, n_embd=64, n_layer=2, n_head=4,
+                      n_positions=128, attn_implementation="eager")
+    hf = HFGPT2(hf_cfg).eval()
+    ours = gpt2_from_hf(hf, use_flash_attention=False)
+    return hf, ours
+
+
+def test_logits_match_transformers(hf_pair):
+    hf, ours = hf_pair
+    ids = np.random.RandomState(0).randint(0, 128, (2, 9))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_greedy_paged_and_beam_match_transformers(hf_pair):
+    hf, ours = hf_pair
+    ids = np.random.RandomState(1).randint(0, 128, (2, 9))
+    with torch.no_grad():
+        gref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                           do_sample=False, pad_token_id=0).numpy()[:, 9:]
+    ggot = ours.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(ggot, gref)
+    paged = ours.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                          paged=True, page_size=8).numpy()
+    np.testing.assert_array_equal(paged, ggot)
+    with torch.no_grad():
+        bref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                           do_sample=False, num_beams=3,
+                           pad_token_id=0).numpy()[:, 9:]
+    bgot = ours.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                         num_beams=3).numpy()
+    np.testing.assert_array_equal(bgot[:, :bref.shape[1]], bref)
+
+
+def test_ragged_batch_matches_solo():
+    """Learned positions must follow per-row true lengths in ragged decode
+    (wpe reads row_pos, not the shared buffer offset)."""
+    paddle.seed(0)
+    m = GPT2LMHeadModel(GPT2Config.tiny())
+    rng = np.random.RandomState(2)
+    long_ids = rng.randint(1, 512, (1, 14))
+    short_ids = rng.randint(1, 512, (1, 6))
+    solo_long = m.generate(paddle.to_tensor(long_ids), max_new_tokens=7).numpy()
+    solo_short = m.generate(paddle.to_tensor(short_ids), max_new_tokens=7).numpy()
+    batch = np.zeros((2, 14), np.int64)
+    batch[0] = long_ids[0]
+    batch[1, :6] = short_ids[0]
+    am = np.zeros((2, 14), np.int64)
+    am[0] = 1
+    am[1, :6] = 1
+    got = m.generate(paddle.to_tensor(batch), max_new_tokens=7,
+                     attention_mask=paddle.to_tensor(am)).numpy()
+    np.testing.assert_array_equal(got[0], solo_long[0])
+    np.testing.assert_array_equal(got[1], solo_short[0])
+
+
+def test_trains():
+    from paddle_tpu import optimizer as opt
+
+    paddle.seed(0)
+    m = GPT2LMHeadModel(GPT2Config.tiny())
+
+    def loss_fn(mm, x, y):
+        loss, _ = mm(x, labels=y)
+        return loss
+
+    step = paddle.jit.train_step(m, loss_fn,
+                                 opt.AdamW(1e-2, parameters=m.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 24)))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 512, (2, 24)))
+    losses = [float(step(x, y).numpy()) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+
+def test_serving_engine_matches_solo():
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    paddle.seed(0)
+    m = GPT2LMHeadModel(GPT2Config.tiny())
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 512, (n,)) for n in (10, 7)]
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8)
+    rids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+    done = eng.run_until_done()
+    for rid, p in zip(rids, prompts):
+        solo = m.generate(paddle.to_tensor(p[None]), max_new_tokens=6).numpy()[0]
+        np.testing.assert_array_equal(done[rid], solo)
+
+
+def test_bf16_config_builds_bf16_params():
+    m = GPT2LMHeadModel(GPT2Config.tiny(dtype="bfloat16"))
+    dts = {str(p.dtype) for _, p in m.named_parameters()}
+    assert dts == {"bfloat16"}
+
+
+def test_forward_beyond_position_table_raises():
+    m = GPT2LMHeadModel(GPT2Config.tiny(max_position_embeddings=16))
+    ids = paddle.to_tensor(np.zeros((1, 20), np.int64))
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        m(ids)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        m.generate(paddle.to_tensor(np.zeros((1, 12), np.int64)),
+                   max_new_tokens=8)  # generate()'s own cap covers decode
