@@ -56,10 +56,6 @@ class GPT2Config:
         return self.num_attention_heads
 
     @staticmethod
-    def gpt2_small(**kw):
-        return GPT2Config(**kw)
-
-    @staticmethod
     def tiny(**kw):
         base = dict(vocab_size=512, hidden_size=128, num_hidden_layers=2,
                     num_attention_heads=4, max_position_embeddings=256,
@@ -200,6 +196,23 @@ class GPT2Model(Layer):
                 .astype(emb.weight.dtype))
         self.h = nn.LayerList([GPT2Block(config)
                                for _ in range(config.num_hidden_layers)])
+        # GPT-2 init recipe: every projection N(0, initializer_range); the
+        # residual-stream projections (c_proj) scaled by 1/sqrt(2*n_layer)
+        # ("Scale initialized weights of residual layers", GPT-2 paper)
+        import math
+
+        resid_std = config.initializer_range / math.sqrt(
+            2 * config.num_hidden_layers)
+        for name, p in self.named_parameters():
+            if name.endswith("c_proj.weight"):
+                std = resid_std
+            elif name.endswith((".weight",)) and ("c_attn" in name
+                                                  or "c_fc" in name):
+                std = config.initializer_range
+            else:
+                continue
+            p._array = (Normal(0.0, std)(tuple(p.shape), jnp.float32)
+                        .astype(p.dtype))
         self._rope_cache = {}
 
     def _identity_rope(self, length):

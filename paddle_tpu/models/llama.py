@@ -491,20 +491,22 @@ class LlamaForCausalLM(Layer):
     def forward(self, input_ids, labels=None, attention_mask=None):
         hidden = self.llama(input_ids, attention_mask)
         if labels is not None and self.config.fuse_linear_cross_entropy:
-            if _mp_enabled():
-                # the lm-head / embedding weight is a vocab SHARD under mp;
-                # feeding it to the fused op would logsumexp over the local
-                # slice only (silently wrong loss) — use the gather_output
-                # logits path there
-                raise NotImplementedError(
-                    "fuse_linear_cross_entropy is not supported under model "
-                    "parallelism (the vocab projection is sharded); unset the "
-                    "flag — the lm-head gather_output path computes the same "
-                    "loss correctly under mp")
-            # the fused op contracts the RAW weight matrix; a swapped head
-            # (WeightOnlyLinear, LoRALinear, ...) computes logits through
-            # its own forward, so those fall through to the logits path
-            if self.lm_head is None or isinstance(self.lm_head, nn.Linear):
+            # mp note: parallel weights in this build are GLOBAL jax.Arrays
+            # (vocab sharding lives in the array's NamedSharding, GSPMD
+            # partitions the contraction), so the fused op computes the
+            # full-vocab logsumexp under mp too — mp2 training-trajectory
+            # parity is tested for both the ColumnParallel head and the
+            # tied VocabParallel embedding (tests/test_fused_loss.py).
+            # sequence_parallel heads are NOT verified with the chunked
+            # scan and fall through to the (correct) logits path, as do
+            # swapped heads (WeightOnlyLinear, LoRALinear, ...) whose
+            # logits come from their own forward
+            head_ok = (not self.config.sequence_parallel
+                       and (self.lm_head is None
+                            or isinstance(self.lm_head,
+                                          (nn.Linear,
+                                           mpu.ColumnParallelLinear))))
+            if head_ok:
                 from ..ops.fused_loss import fused_linear_cross_entropy
 
                 if self.lm_head is None:  # tied: embedding weight [vocab, hidden]

@@ -72,9 +72,10 @@ def fused_linear_cross_entropy(hidden, weight, labels, weight_layout="hv",
     < 0 ignored; weight: [hidden, vocab] ("hv") or [vocab, hidden] ("vh",
     the tied-embedding layout, contracted in place — no transpose copy).
 
-    The weight must be the FULL (replicated) vocab projection — under
-    model-parallel vocab sharding use the gather_output lm-head path
-    instead (models.llama raises on that combination).
+    Model parallelism: parallel weights in this build are GLOBAL
+    jax.Arrays (vocab sharding lives in the NamedSharding; GSPMD
+    partitions the contraction), so passing an mp-sharded projection
+    computes the full-vocab loss — mp2 parity is tested for both layouts.
     """
     h2d, lab, n_chunks, _, count = _prep(hidden, labels, chunk_size)
 

@@ -75,23 +75,43 @@ class TestFusedLinearCrossEntropy:
         np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]), atol=1e-6)
         np.testing.assert_allclose(np.asarray(g1[1]), np.asarray(g2[1]), atol=1e-6)
 
-    def test_mp_and_pipe_unsupported_raise(self):
-        """Vocab-sharded (mp) and pipeline head paths must refuse the flag
-        rather than silently compute a wrong/unfused loss."""
-        import paddle_tpu.distributed as dist
+    def test_pipe_unsupported_raises(self):
+        """The pipeline head path must refuse the flag rather than silently
+        skip the memory saving."""
         from paddle_tpu.models.llama import LlamaForCausalLMPipe
 
         cfg = LlamaConfig.tiny(fuse_linear_cross_entropy=True)
         with pytest.raises(NotImplementedError, match="pipeline head"):
             LlamaForCausalLMPipe(cfg, num_stages=1)
 
-        dist.set_hybrid_communicate_group(
-            dist.HybridCommunicateGroup(mp_degree=2))
+    @pytest.mark.parametrize("tie", [False, True])
+    def test_mp2_matches_unfused(self, tie):
+        """Under mp the parallel weights are GLOBAL arrays (GSPMD
+        sharding), so the fused op computes the full-vocab loss — training
+        trajectory must match the unfused mp path exactly."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu import optimizer as opt
+
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"mp_degree": 2}
+        dist.fleet.init(is_collective=True, strategy=strategy)
         try:
-            m = LlamaForCausalLM(cfg)
-            x = paddle.to_tensor(np.zeros((1, 8), np.int64))
-            with pytest.raises(NotImplementedError, match="model"):
-                m(x, labels=x)
+            cfg = LlamaConfig.tiny(num_hidden_layers=1,
+                                   tie_word_embeddings=tie)
+            paddle.seed(0)
+            m1 = LlamaForCausalLM(cfg)
+            paddle.seed(0)
+            m2 = LlamaForCausalLM(
+                dataclasses.replace(cfg, fuse_linear_cross_entropy=True))
+            x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 16)))
+            y = paddle.to_tensor(np.random.RandomState(1).randint(0, 512, (2, 16)))
+            s1 = paddle.jit.train_step(m1, _loss_fn,
+                                       opt.AdamW(1e-3, parameters=m1.parameters()))
+            s2 = paddle.jit.train_step(m2, _loss_fn,
+                                       opt.AdamW(1e-3, parameters=m2.parameters()))
+            for _ in range(3):
+                l1, l2 = float(s1(x, y).numpy()), float(s2(x, y).numpy())
+                assert l1 == pytest.approx(l2, abs=3e-5)
         finally:
             dist.set_hybrid_communicate_group(None)
 
