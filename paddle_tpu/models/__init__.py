@@ -33,6 +33,10 @@ _LAZY = {
     "GPT2Config": ("gpt2", "GPT2Config"),
     "GPT2LMHeadModel": ("gpt2", "GPT2LMHeadModel"),
     "gpt2_from_hf": ("gpt2", "gpt2_from_hf"),
+    "t5": ("t5", None),
+    "T5Config": ("t5", "T5Config"),
+    "T5ForConditionalGeneration": ("t5", "T5ForConditionalGeneration"),
+    "t5_from_hf": ("t5", "t5_from_hf"),
 }
 
 
