@@ -32,17 +32,24 @@ _PEAK_TFLOPS = {"v5e": 197.0, "v5p": 459.0, "v4": 275.0, "v6e": 918.0}
 
 
 def _model_flops_per_token(cfg) -> float:
-    """6*N style estimate incl. attention term."""
+    """6*N style estimate incl. attention term (N = ACTIVE matmul params —
+    for MoE, only the routed top-k + shared experts count)."""
     h, L = cfg.hidden_size, cfg.num_hidden_layers
     inter = cfg.intermediate_size
     v = cfg.vocab_size
     kv_ratio = cfg.num_key_value_heads / cfg.num_attention_heads
-    per_layer = (
-        2 * h * h * (1 + 2 * kv_ratio + 1)  # q,k,v,o projections
-        + 2 * h * inter * 3                 # swiglu gate/up/down
-    )
+    attn = 2 * h * h * (1 + 2 * kv_ratio + 1)  # q,k,v,o projections
+    n_exp = getattr(cfg, "n_routed_experts", 0)
+    if n_exp:
+        k = cfg.num_experts_per_tok + cfg.n_shared_experts
+        moe_mlp = 2 * h * (k * cfg.moe_intermediate_size) * 3
+        dense_layers = min(cfg.first_k_dense_replace, L)
+        params_mlp = (dense_layers * 2 * h * inter * 3
+                      + (L - dense_layers) * moe_mlp)
+    else:
+        params_mlp = L * 2 * h * inter * 3          # swiglu gate/up/down
     emb = 2 * h * v  # lm head matmul
-    params_matmul = L * per_layer + emb
+    params_matmul = L * attn + params_mlp + emb
     return 3 * params_matmul  # fwd (1x) + bwd (2x)
 
 
@@ -70,6 +77,22 @@ def _bench_config(name, on_tpu):
             num_key_value_heads=8, max_position_embeddings=16384,
             use_flash_attention=True, dtype="bfloat16")
         return cfg, 16384, 1
+    if name == "moe":
+        # MoE train leg: a 1b-class DeepSeekMoE/Qwen2-MoE shape — measures
+        # the grouped-GEMM expert path (top-2 of 8 experts + shared expert)
+        # on one chip; under a pod the same model EP-shards (moe@ep4xmp2 in
+        # the driver gate)
+        from paddle_tpu.models.llama_moe import LlamaMoEConfig
+
+        cfg = LlamaMoEConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            use_flash_attention=True, dtype="bfloat16",
+            n_routed_experts=8, num_experts_per_tok=2,
+            moe_intermediate_size=1408, n_shared_experts=1,
+            first_k_dense_replace=1)
+        return cfg, 2048, int(os.environ.get("BENCH_BATCH", "4"))
     if name == "8b":
         # Llama-3-8B shape (BASELINE.json north star), depth cut to fit one
         # chip's HBM: per-layer + lm-head dims are exactly the 8B recipe so
@@ -243,7 +266,12 @@ def main():
     cfg, seq, batch = _bench_config(cfg_name, on_tpu)
 
     paddle.seed(0)
-    model = LlamaForCausalLM(cfg)
+    if getattr(cfg, "n_routed_experts", 0):
+        from paddle_tpu.models.llama_moe import LlamaMoEForCausalLM
+
+        model = LlamaMoEForCausalLM(cfg)
+    else:
+        model = LlamaForCausalLM(cfg)
     moment_dtype = "bfloat16" if cfg_name == "8b" else None
     optimizer = opt.AdamW(3e-4, parameters=model.parameters(),
                           moment_dtype=moment_dtype)

@@ -24,6 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .tensor_class import Tensor, unwrap, wrap
 from .ops.registry import apply
@@ -339,6 +340,21 @@ def _select_penalized(logits_last, seen, key, do_sample, temperature, top_k,
         lg = lg.at[:, eos_id].set(-jnp.inf)
     return sample_logits(lg, key, do_sample=do_sample,
                          temperature=temperature, top_k=top_k, top_p=top_p)
+
+
+def _ngram_banned(histories, n, vocab):
+    """[B, V] mask of tokens that would complete an already-seen n-gram of
+    each row's history (HF NoRepeatNGramLogitsProcessor semantics)."""
+    B = len(histories)
+    banned = np.zeros((B, vocab), bool)
+    for b, hist in enumerate(histories):
+        if len(hist) < n:
+            continue
+        prefix = tuple(hist[-(n - 1):]) if n > 1 else ()
+        for j in range(len(hist) - n + 1):
+            if tuple(hist[j:j + n - 1]) == prefix:
+                banned[b, hist[j + n - 1]] = True
+    return banned
 
 
 def _select_next(last, seen, key, do_sample, temperature, top_k, top_p,
@@ -904,13 +920,16 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
              use_cache=True, attention_mask=None, paged=False,
              page_size=16, prefill_chunk_size=None,
              repetition_penalty=1.0, min_new_tokens=0,
-             num_beams=1, length_penalty=1.0, early_stopping=False):
+             num_beams=1, length_penalty=1.0, early_stopping=False,
+             no_repeat_ngram_size=0):
     """Batched autoregressive decode.
 
     ``repetition_penalty`` (HF semantics): logits of tokens already in the
     row (prompt + generated so far) are divided by the penalty when
     positive, multiplied when negative. ``min_new_tokens`` blocks
     ``eos_token_id`` for the first N generated tokens (requires eos).
+    ``no_repeat_ngram_size=n`` bans tokens that would repeat an n-gram of
+    the row's sequence (prompt + generated).
 
     ``num_beams > 1`` runs beam search (greedy scoring over K beams per
     row, HF semantics: 2K candidates per step, eos hits retire into a
@@ -942,7 +961,8 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     if min_new > 0 and eos_token_id is None:
         raise ValueError("min_new_tokens requires eos_token_id (it only "
                          "delays the eos stop)")
-    penalized = rp != 1.0 or min_new > 0
+    ngram = int(no_repeat_ngram_size)
+    penalized = rp != 1.0 or min_new > 0 or ngram > 0
     num_beams = int(num_beams)
     if num_beams > 1:
         if do_sample:
@@ -955,8 +975,8 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
                 "use paged=False (beams reorder dense cache rows)")
         if penalized:
             raise NotImplementedError(
-                "repetition_penalty/min_new_tokens with num_beams>1 is "
-                "not supported")
+                "repetition_penalty/min_new_tokens/no_repeat_ngram_size "
+                "with num_beams>1 is not supported")
         if not use_cache:
             raise NotImplementedError("beam search needs use_cache=True")
     chunk = int(prefill_chunk_size) if prefill_chunk_size else 0
@@ -1011,7 +1031,7 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
         if not use_cache:
             return _generate_no_cache(model, ids, max_new_tokens, do_sample,
                                       temperature, top_k, top_p, eos_token_id,
-                                      rp=rp, min_new=min_new)
+                                      rp=rp, min_new=min_new, ngram=ngram)
 
         # ---- prefill: one jitted computation (flash kernel + cache fill +
         # last-real-logit gather; the [B,1,H] gather before the lm head
@@ -1072,9 +1092,19 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
         finished = jnp.zeros((B,), bool)
         seen = (_seen_from_prompt(ids, cfg.vocab_size, pad_mask)
                 if rp != 1.0 else None)
+        histories = None
+        if ngram > 0:
+            ids_np = np.asarray(ids)
+            lens_np = np.asarray(lengths)
+            histories = [list(ids_np[b, : lens_np[b]]) for b in range(B)]
         out_tokens = []
         for i in range(max_new_tokens):
             key = _random.next_key()
+            if histories is not None:
+                banned = _ngram_banned(histories, ngram, cfg.vocab_size)
+                if banned.any():  # skip the transfer on no-op steps
+                    last = jnp.where(jnp.asarray(banned), -jnp.inf,
+                                     last.astype(jnp.float32))
             nxt = _select_next(last, seen, key, do_sample, temperature,
                                top_k, top_p, rp, i, min_new, eos_token_id)
             if eos_token_id is not None:
@@ -1082,6 +1112,9 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
                 finished = finished | (nxt == eos_token_id)
             if seen is not None:
                 seen = seen.at[jnp.arange(B), nxt].set(True)
+            if histories is not None:
+                for b, t in enumerate(np.asarray(nxt)):
+                    histories[b].append(int(t))
             out_tokens.append(nxt.reshape(B, 1).astype(ids.dtype))
             if i == max_new_tokens - 1 or (
                     eos_token_id is not None and bool(finished.all())):
@@ -1092,17 +1125,25 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
 
 
 def _generate_no_cache(model, ids, max_new_tokens, do_sample, temperature,
-                       top_k, top_p, eos_token_id, rp=1.0, min_new=0):
+                       top_k, top_p, eos_token_id, rp=1.0, min_new=0,
+                       ngram=0):
     B = ids.shape[0]
     finished = jnp.zeros((B,), bool)
     seen = (_seen_from_prompt(ids, model.config.vocab_size)
             if rp != 1.0 else None)
+    histories = ([list(np.asarray(ids)[b]) for b in range(B)]
+                 if ngram > 0 else None)
     out_tokens = []
     full = ids
     for i in range(max_new_tokens):
         hidden = model.llama(wrap(full))
         last = unwrap(model.lm_head_logits(hidden))[:, -1, :]
         key = _random.next_key()
+        if histories is not None:
+            banned = _ngram_banned(histories, ngram, model.config.vocab_size)
+            if banned.any():
+                last = jnp.where(jnp.asarray(banned), -jnp.inf,
+                                 last.astype(jnp.float32))
         nxt = _select_next(last, seen, key, do_sample, temperature, top_k,
                            top_p, rp, i, min_new, eos_token_id)
         if eos_token_id is not None:
@@ -1110,6 +1151,9 @@ def _generate_no_cache(model, ids, max_new_tokens, do_sample, temperature,
             finished = finished | (nxt == eos_token_id)
         if seen is not None:
             seen = seen.at[jnp.arange(B), nxt].set(True)
+        if histories is not None:
+            for b, t in enumerate(np.asarray(nxt)):
+                histories[b].append(int(t))
         out_tokens.append(nxt.reshape(B, 1).astype(ids.dtype))
         full = jnp.concatenate([full, out_tokens[-1]], axis=1)
         if eos_token_id is not None and bool(finished.all()):

@@ -473,7 +473,8 @@ class LlamaForCausalLM(Layer):
                  use_cache=True, attention_mask=None, paged=False,
                  page_size=16, prefill_chunk_size=None,
                  repetition_penalty=1.0, min_new_tokens=0,
-                 num_beams=1, length_penalty=1.0, early_stopping=False):
+                 num_beams=1, length_penalty=1.0, early_stopping=False,
+                 no_repeat_ngram_size=0):
         """Batched autoregressive decode (see paddle_tpu.generation)."""
         from ..generation import generate as _generate
 
@@ -486,7 +487,8 @@ class LlamaForCausalLM(Layer):
                          repetition_penalty=repetition_penalty,
                          min_new_tokens=min_new_tokens, num_beams=num_beams,
                          length_penalty=length_penalty,
-                         early_stopping=early_stopping)
+                         early_stopping=early_stopping,
+                         no_repeat_ngram_size=no_repeat_ngram_size)
 
     def forward(self, input_ids, labels=None, attention_mask=None):
         hidden = self.llama(input_ids, attention_mask)

@@ -521,3 +521,40 @@ class TestBeamSearch:
             ours.generate(ids, num_beams=2, paged=True)
         with pytest.raises(NotImplementedError, match="repetition"):
             ours.generate(ids, num_beams=2, repetition_penalty=1.3)
+
+
+def test_no_repeat_ngram_matches_transformers():
+    """no_repeat_ngram_size bans completions of already-seen n-grams —
+    token-identical to transformers' greedy with the same processor
+    (greedy tiny models repeat heavily, so the ban actually bites)."""
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM as HFLlama
+    from paddle_tpu.models.llama import llama_from_hf
+
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128,
+                      attention_bias=False, tie_word_embeddings=False)
+    hf = HFLlama(hf_cfg).eval()
+    ours = llama_from_hf(hf, dtype="float32", use_flash_attention=False)
+    ids = np.random.RandomState(7).randint(0, 128, (2, 10))
+    plain = ours.generate(paddle.to_tensor(ids), max_new_tokens=12).numpy()
+    with torch.no_grad():
+        ref = hf.generate(torch.from_numpy(ids), max_new_tokens=12,
+                          do_sample=False, no_repeat_ngram_size=2,
+                          pad_token_id=0).numpy()[:, 10:]
+    got = ours.generate(paddle.to_tensor(ids), max_new_tokens=12,
+                        no_repeat_ngram_size=2).numpy()
+    np.testing.assert_array_equal(got, ref)
+    assert not np.array_equal(got, plain)  # the ban actually changed output
+
+
+def test_no_repeat_ngram_no_cache_matches_cached(tiny_model):
+    x = _prompt(tiny_model.config, s=6, seed=9)
+    a = tiny_model.generate(x, max_new_tokens=10, no_repeat_ngram_size=2).numpy()
+    b = tiny_model.generate(x, max_new_tokens=10, no_repeat_ngram_size=2,
+                            use_cache=False).numpy()
+    np.testing.assert_array_equal(a, b)
