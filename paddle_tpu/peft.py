@@ -103,6 +103,15 @@ def get_peft_model(model, config: LoRAConfig):
     Returns (model, n_wrapped)."""
     from .nn.utils import replace_sublayers
 
+    # remember the user's pre-LoRA freeze state so merge_lora can RESTORE
+    # it instead of blanket-unfreezing (a user-frozen embedding must stay
+    # frozen after merge). Stacked get_peft_model calls keep the FIRST
+    # snapshot — the later call would otherwise record the all-frozen
+    # post-LoRA state and merge_lora would freeze the whole model.
+    pre_freeze = getattr(model, "_peft_pre_freeze", None)
+    if pre_freeze is None:
+        pre_freeze = {name: p.stop_gradient
+                      for name, p in model.named_parameters()}
     targets = tuple(config.target_modules)
     n = replace_sublayers(
         model,
@@ -113,6 +122,7 @@ def get_peft_model(model, config: LoRAConfig):
         raise ValueError(
             f"get_peft_model: no Linear matched target_modules="
             f"{tuple(config.target_modules)}")
+    object.__setattr__(model, "_peft_pre_freeze", pre_freeze)
     keep = tuple(config.modules_to_save)
     for pname, p in model.named_parameters():
         if "lora_A" in pname or "lora_B" in pname:
@@ -133,8 +143,13 @@ def merge_lora(model):
         model,
         lambda name, sub: isinstance(sub, LoRALinear),
         lambda sub: sub.merge())
-    for _, p in model.named_parameters():
-        p.stop_gradient = False
+    # restore the user's PRE-LoRA freeze state (recorded by get_peft_model);
+    # params that didn't exist then (none after a merge) default to trainable
+    pre = getattr(model, "_peft_pre_freeze", None) or {}
+    for name, p in model.named_parameters():
+        p.stop_gradient = bool(pre.get(name, False))
+    if hasattr(model, "_peft_pre_freeze"):
+        object.__delattr__(model, "_peft_pre_freeze")
     return model, n
 
 

@@ -85,3 +85,25 @@ def test_modules_to_save_and_generate(lora_model):
 def test_no_target_match_raises(lora_model):
     with pytest.raises(ValueError, match="target_modules"):
         get_peft_model(lora_model, LoRAConfig(target_modules=("nope",)))
+
+
+def test_merge_restores_user_freeze_state(lora_model):
+    """A parameter the USER froze before get_peft_model must stay frozen
+    after merge_lora (blanket unfreezing would silently resume training
+    a deliberately frozen embedding)."""
+    lora_model.llama.embed_tokens.weight.stop_gradient = True
+    m, _ = get_peft_model(lora_model, LoRAConfig(r=2))
+    m, _ = merge_lora(m)
+    assert m.llama.embed_tokens.weight.stop_gradient is True
+    assert m.lm_head.weight.stop_gradient is False  # others trainable again
+
+
+def test_stacked_adapters_merge_keeps_model_trainable(lora_model):
+    """Two get_peft_model calls (different targets) then merge: the model
+    must come back trainable (the first pre-LoRA snapshot wins, not the
+    all-frozen state between the calls)."""
+    m, _ = get_peft_model(lora_model, LoRAConfig(r=2, target_modules=("q_proj",)))
+    m, _ = get_peft_model(m, LoRAConfig(r=2, target_modules=("gate_proj",)))
+    m, n = merge_lora(m)
+    assert n == 4  # 2 layers x (q_proj + gate_proj)
+    assert all(not p.stop_gradient for _, p in m.named_parameters())
