@@ -1,0 +1,75 @@
+"""End-to-end model lifecycle: pretrain (fused step) → checkpoint →
+LoRA fine-tune (adapters only) → merge → int8 quantize → continuous-
+batching serve — the user journey docs/MIGRATE.md promises, as one test."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nn.quant import quantize_for_serving
+from paddle_tpu.peft import LoRAConfig, get_peft_model, lora_state_dict, merge_lora
+from paddle_tpu.serving import ContinuousBatchEngine
+
+
+def _loss_fn(m, x, y):
+    loss, _ = m(x, labels=y)
+    return loss
+
+
+def test_full_lifecycle(tmp_path):
+    rng = np.random.RandomState(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+
+    # 1. pretrain
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    step = paddle.jit.train_step(
+        model, _loss_fn, opt.AdamW(1e-2, parameters=model.parameters()))
+    ids = rng.randint(0, cfg.vocab_size, (4, 33))
+    x, y = paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
+    pre_losses = [float(step(x, y).numpy()) for _ in range(5)]
+    assert pre_losses[-1] < pre_losses[0]
+
+    # 2. checkpoint round trip
+    ckpt = str(tmp_path / "base.pdparams")
+    paddle.save(model.state_dict(), ckpt)
+    paddle.seed(123)
+    model = LlamaForCausalLM(cfg)
+    model.set_state_dict(paddle.load(ckpt))
+
+    # 3. LoRA fine-tune on a different distribution; base stays frozen
+    model, n_ad = get_peft_model(model, LoRAConfig(r=4))
+    assert n_ad == 8
+    ft_ids = rng.randint(0, cfg.vocab_size // 2, (4, 33))  # skewed data
+    fx, fy = paddle.to_tensor(ft_ids[:, :-1]), paddle.to_tensor(ft_ids[:, 1:])
+    ft_step = paddle.jit.train_step(
+        model, _loss_fn, opt.AdamW(5e-2, parameters=model.parameters()))
+    ft_losses = [float(ft_step(fx, fy).numpy()) for _ in range(5)]
+    assert ft_losses[-1] < ft_losses[0]
+    adapters = lora_state_dict(model)
+    assert len(adapters) == 16  # A+B per wrapped projection
+
+    # 4. merge; logits identical to the adapter model
+    probe = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (1, 10)))
+    with_adapters = model(probe).numpy()
+    model, n_merged = merge_lora(model)
+    assert n_merged == n_ad
+    np.testing.assert_allclose(model(probe).numpy(), with_adapters,
+                               atol=2e-5, rtol=2e-5)
+
+    # 5. quantize + serve: engine output token-identical to solo generate
+    model, n_q = quantize_for_serving(model)
+    assert n_q == 15
+    eng = ContinuousBatchEngine(model, max_batch=2, max_len=64, page_size=8)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)) for n in (9, 6)]
+    streamed = {}
+    rids = [eng.add_request(p, max_new_tokens=6,
+                            on_token=lambda rid, t, d: streamed.setdefault(
+                                rid, []).append(t))
+            for p in prompts]
+    done = eng.run_until_done()
+    for rid, p in zip(rids, prompts):
+        solo = model.generate(paddle.to_tensor(p[None]),
+                              max_new_tokens=6).numpy()[0]
+        np.testing.assert_array_equal(done[rid], solo)
+        np.testing.assert_array_equal(np.asarray(streamed[rid]), solo)
