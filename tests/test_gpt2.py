@@ -123,3 +123,36 @@ def test_forward_beyond_position_table_raises():
     with pytest.raises(ValueError, match="max_position_embeddings"):
         m.generate(paddle.to_tensor(np.zeros((1, 12), np.int64)),
                    max_new_tokens=8)  # generate()'s own cap covers decode
+
+
+def test_chunked_prefill_matches_one_shot():
+    """Learned positions survive chunked prefill (plain and ragged)."""
+    paddle.seed(0)
+    m = GPT2LMHeadModel(GPT2Config.tiny())
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(1, 512, (2, 13)))
+    a = m.generate(ids, max_new_tokens=6).numpy()
+    b = m.generate(ids, max_new_tokens=6, prefill_chunk_size=8).numpy()
+    np.testing.assert_array_equal(a, b)
+    am = np.ones((2, 13), np.int64)
+    am[1, 9:] = 0
+    c = m.generate(ids, max_new_tokens=6,
+                   attention_mask=paddle.to_tensor(am)).numpy()
+    d = m.generate(ids, max_new_tokens=6, prefill_chunk_size=8,
+                   attention_mask=paddle.to_tensor(am)).numpy()
+    np.testing.assert_array_equal(c, d)
+
+
+def test_speculative_decoding_token_identical():
+    """Draft/target GPT-2 pair through speculative_generate == target
+    greedy (the shared cache machinery carries enc-free families too)."""
+    from paddle_tpu.speculative import speculative_generate
+
+    paddle.seed(0)
+    target = GPT2LMHeadModel(GPT2Config.tiny())
+    paddle.seed(1)
+    draft = GPT2LMHeadModel(GPT2Config.tiny(num_hidden_layers=1))
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(1, 512, (1, 8)))
+    ref = target.generate(ids, max_new_tokens=10).numpy()
+    out = np.asarray(speculative_generate(target, draft, ids,
+                                          max_new_tokens=10, draft_k=4).numpy())
+    np.testing.assert_array_equal(out[0][-10:], ref[0])
