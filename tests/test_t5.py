@@ -113,3 +113,13 @@ def test_padded_generate_matches_unpadded():
                      attention_mask=paddle.to_tensor(am)).numpy()
     n = min(got.shape[1], solo.shape[1])
     np.testing.assert_array_equal(got[0, :n], solo[0, :n])
+
+
+def test_bf16_config_builds_bf16_params_and_generates():
+    paddle.seed(0)
+    m = T5ForConditionalGeneration(T5Config.tiny(dtype="bfloat16"))
+    dts = {str(p.dtype) for _, p in m.named_parameters()}
+    assert dts == {"bfloat16"}
+    out = m.generate(paddle.to_tensor(
+        np.random.RandomState(0).randint(2, 256, (1, 8))), max_new_tokens=5)
+    assert out.shape == [1, 5]
