@@ -37,6 +37,10 @@ _LAZY = {
     "T5Config": ("t5", "T5Config"),
     "T5ForConditionalGeneration": ("t5", "T5ForConditionalGeneration"),
     "t5_from_hf": ("t5", "t5_from_hf"),
+    "bart": ("bart", None),
+    "BartConfig": ("bart", "BartConfig"),
+    "BartForConditionalGeneration": ("bart", "BartForConditionalGeneration"),
+    "bart_from_hf": ("bart", "bart_from_hf"),
 }
 
 
