@@ -43,6 +43,8 @@ def main():
         ("ernie-4.5", M.Ernie45ForCausalLM(
             M.Ernie45Config.tiny_moe(vocab_size=256))),
         ("t5", M.T5ForConditionalGeneration(M.T5Config.tiny(vocab_size=256))),
+        ("bart", M.BartForConditionalGeneration(
+            M.BartConfig.tiny(vocab_size=256))),
     ]
     for name, model in zoo:
         out = model.generate(ids, max_new_tokens=6)

@@ -1,7 +1,9 @@
 """Model zoo: flagship pretraining models (SURVEY §6 / BASELINE.json
-workload configs): Llama-3, DeepSeekMoE/Qwen2-MoE, ERNIE (encoder) +
-ERNIE-4.5 (MoE decoder), SD3 MMDiT (DiT backbone + AutoencoderKL live in
-vision.models)."""
+workload configs): Llama-3 (+ Qwen2 bias / Mistral sliding-window
+variants), GPT-2 (learned positions), DeepSeekMoE/Qwen2-MoE, ERNIE
+(encoder) + ERNIE-4.5 (MoE decoder), T5 and BART encoder-decoders, SD3
+MMDiT (DiT backbone + AutoencoderKL live in vision.models). Every
+family has HF checkpoint interop with transformers parity tests."""
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
                     LlamaDecoderLayer, LlamaForCausalLMPipe)
 
