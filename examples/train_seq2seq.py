@@ -1,0 +1,68 @@
+"""Seq2seq training end to end: T5 learns to sort token sequences.
+
+The whole step (encoder + decoder + tied-head loss + AdamW) is one
+donated-buffer XLA computation via paddle.jit.train_step; greedy decode
+at the end shows the learned behavior through the cached enc-dec
+generate path.
+
+Run: JAX_PLATFORMS=cpu python examples/train_seq2seq.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+
+def batch(rng, n, s, vocab, start_id):
+    """Input: random tokens; target: the tokens SORTED ascending — the
+    classic content-addressable attention task (cross-attention selects
+    the smallest not-yet-emitted source token at each step)."""
+    src = rng.randint(10, vocab, (n, s))
+    tgt = np.sort(src, axis=1)
+    dec_in = np.concatenate(
+        [np.full((n, 1), start_id, np.int64), tgt[:, :-1]], axis=1)
+    return (paddle.to_tensor(src), paddle.to_tensor(dec_in),
+            paddle.to_tensor(tgt))
+
+
+def main():
+    cfg = T5Config.tiny(vocab_size=64, num_layers=2)
+    paddle.seed(0)
+    model = T5ForConditionalGeneration(cfg)
+
+    def loss_fn(m, x, dec_x, y):
+        loss, _ = m(x, dec_x, labels=y)
+        return loss
+
+    step = paddle.jit.train_step(
+        model, loss_fn, opt.AdamW(1e-3, parameters=model.parameters()))
+    rng = np.random.RandomState(0)
+    for i in range(801):
+        loss = step(*batch(rng, 32, 4, cfg.vocab_size,
+                           cfg.decoder_start_token_id))
+        if i % 100 == 0:
+            print(f"step {i:3d}  loss {float(loss.numpy()):.4f}")
+
+    src, _, tgt = batch(rng, 4, 4, cfg.vocab_size,
+                        cfg.decoder_start_token_id)
+    out = model.generate(src, max_new_tokens=4, eos_token_id=-1).numpy()
+    acc = (out == tgt.numpy()).mean()
+    print(f"\nsort accuracy on fresh samples: {acc:.2%}")
+    print("src:", src.numpy()[0].tolist())
+    print("out:", out[0].tolist())
+    print("tgt:", tgt.numpy()[0].tolist())
+
+
+if __name__ == "__main__":
+    main()
