@@ -70,7 +70,7 @@ class GradientMergeConfigs:
 class PipelineConfigs:
     micro_batch_size: int = 1
     accumulate_steps: int = 1
-    schedule_mode: str = "1F1B"  # FThenB | 1F1B (host) | gpipe-circular (in-graph)
+    schedule_mode: str = "1F1B"  # FThenB | 1F1B | ZBH1 | VPP (interleaved)
 
 
 class DistributedStrategy:

@@ -272,7 +272,150 @@ class TestSharedLayers:
         assert not np.allclose(before, after)  # tied weight received grads
 
 
+def _clock_sim(seqs, nparts):
+    """Clocked execution of per-physical-stage op sequences: each tick every
+    stage retires at most ONE ready op (ops cost one tick each — the
+    standard bubble accounting). Returns (total_ticks, bubble_ticks) where
+    a bubble tick is a stage idling while it still has work queued."""
+    heads = {s: 0 for s in seqs}
+    done = set()
+    ticks = bubbles = 0
+
+    def ready(op, part, mb):
+        if op == "fwd":
+            return part == 0 or ("fwd", part - 1, mb) in done
+        return ("fwd", part, mb) in done and (
+            part == nparts - 1 or ("bwd", part + 1, mb) in done)
+
+    while any(heads[s] < len(seqs[s]) for s in seqs):
+        fired = [(s, seqs[s][heads[s]]) for s in seqs
+                 if heads[s] < len(seqs[s]) and ready(*seqs[s][heads[s]])]
+        assert fired, "clock simulation deadlocked"
+        waiting = sum(1 for s in seqs if heads[s] < len(seqs[s]))
+        bubbles += waiting - len(fired)
+        for s, e in fired:
+            heads[s] += 1
+            done.add(e)
+        ticks += 1
+    return ticks, bubbles
+
+
 class TestInterleaved:
+    def test_interleaved_local_order_properties(self):
+        """Megatron-style interleave at S=2/V=2/m=4: stage s holds parts
+        {s, S+s}; forwards walk chunk 0 micros 0..S-1 then chunk 1 micros
+        0..S-1; backwards walk chunks in reverse."""
+        from paddle_tpu.distributed.pipeline import interleaved_order
+
+        order = interleaved_order(num_stages=2, num_virtual=2, num_micro=4)
+        S, V, m = 2, 2, 4
+        for s in (0, 1):
+            seq = order[s]
+            assert len(seq) == 2 * V * m
+            # every (part, micro) appears exactly once per op kind
+            for c in range(V):
+                part = c * S + s
+                for mb in range(m):
+                    assert seq.count(("fwd", part, mb)) == 1
+                    assert seq.count(("bwd", part, mb)) == 1
+        # stage 0 warmup = (S-1-0)*2 + (V-1)*S = 4 forwards:
+        # chunk0 micros 0,1 then chunk1 micros 0,1
+        assert order[0][:4] == [("fwd", 0, 0), ("fwd", 0, 1),
+                                ("fwd", 2, 0), ("fwd", 2, 1)]
+        # first backward on stage 0 is the LAST chunk (part 2), micro 0
+        first_bwd = next(e for e in order[0] if e[0] == "bwd")
+        assert first_bwd == ("bwd", 2, 0)
+        # stage 1 warmup = (S-1-1)*2 + (V-1)*S = 2 forwards
+        assert order[1][:2] == [("fwd", 1, 0), ("fwd", 1, 1)]
+        assert order[1][2] == ("fwd", 3, 0)
+        assert order[1][3] == ("bwd", 3, 0)
+
+    def test_interleaved_preconditions(self):
+        from paddle_tpu.distributed.pipeline import interleaved_order
+
+        with pytest.raises(ValueError):  # V must exceed 1
+            interleaved_order(num_stages=2, num_virtual=1, num_micro=4)
+        with pytest.raises(ValueError):  # m must divide by S
+            interleaved_order(num_stages=2, num_virtual=2, num_micro=3)
+
+    def test_interleaved_fewer_bubbles_than_1f1b(self):
+        """The VPP claim (ref pipeline_parallel.py:1174): interleaving the
+        V chunks lets early backwards start (V-1)*S slots sooner, so the
+        clocked schedule at S=2/V=2/m=8 drains in strictly fewer ticks —
+        and with strictly fewer bubble slots — than depth-first 1F1B over
+        the same 4-part chain executed on the same 2 physical stages."""
+        from paddle_tpu.distributed.pipeline import interleaved_order
+
+        S, V, m = 2, 2, 8
+        vpp = interleaved_order(S, V, m)
+
+        # baseline: the actual op_log of a 1F1B run over nparts=S*V,
+        # projected onto physical stages (stage = part % S)
+        paddle.seed(5)
+        pipe = PipelineLayer(_make_descs(), num_stages=S, loss_fn=_mse,
+                             num_virtual_pipeline_stages=V)
+        pp = PipelineParallel(pipe, accumulate_steps=m, schedule="1F1B")
+        opt = SGD(learning_rate=0.01, parameters=pipe.parameters())
+        x = np.random.randn(8, 16).astype("float32")
+        pp.train_batch([paddle.to_tensor(x), paddle.to_tensor(x)], opt)
+        base = {s: [(op, part, mb) for op, part, mb in pp.op_log
+                    if part % S == s] for s in range(S)}
+
+        ticks_v, bub_v = _clock_sim(vpp, S * V)
+        ticks_b, bub_b = _clock_sim(base, S * V)
+        assert ticks_v < ticks_b, (ticks_v, ticks_b)
+        assert bub_v < bub_b, (bub_v, bub_b)
+
+    def test_interleaved_param_parity(self):
+        """schedule="VPP" end-to-end: S=2 x V=2 over 8 blocks, m=4 —
+        loss and updated params match sequential training."""
+        paddle.seed(23)
+        pipe = PipelineLayer(_make_descs(), num_stages=2, loss_fn=_mse,
+                             num_virtual_pipeline_stages=2)
+        snap = _snapshot(pipe)
+        ref = PipelineLayer(_make_descs(), num_stages=2, loss_fn=_mse,
+                            num_virtual_pipeline_stages=2)
+        _load(ref, snap)
+
+        pp = PipelineParallel(pipe, accumulate_steps=4, schedule="VPP")
+        opt_p = SGD(learning_rate=0.1, parameters=pipe.parameters())
+        opt_r = SGD(learning_rate=0.1, parameters=ref.parameters())
+        rng = np.random.RandomState(2)
+        for _ in range(2):
+            x = rng.randn(8, 16).astype("float32")
+            lbl = rng.randn(8, 16).astype("float32")
+            loss_p = pp.train_batch([paddle.to_tensor(x), paddle.to_tensor(lbl)], opt_p)
+            out = ref(paddle.to_tensor(x))
+            loss_r = _mse(out, paddle.to_tensor(lbl))
+            loss_r.backward()
+            opt_r.step()
+            opt_r.clear_grad()
+            np.testing.assert_allclose(float(loss_p), float(loss_r), rtol=1e-5)
+        for (k, p), (k2, p2) in zip(sorted(pipe.state_dict().items()),
+                                    sorted(ref.state_dict().items())):
+            assert k == k2
+            np.testing.assert_allclose(np.asarray(p._array), np.asarray(p2._array),
+                                       rtol=2e-5, atol=2e-6)
+        # the op_log per physical stage matches the canonical interleaved order
+        from paddle_tpu.distributed.pipeline import interleaved_order
+        expect = interleaved_order(2, 2, 4)
+        for s in range(2):
+            local = [e for e in pp.op_log if e[1] % 2 == s]
+            assert local == expect[s]
+
+    def test_unknown_schedule_raises(self):
+        paddle.seed(4)
+        pipe = PipelineLayer(_make_descs(n_blocks=4), num_stages=2, loss_fn=_mse)
+        with pytest.raises(ValueError, match="unknown pipeline schedule"):
+            PipelineParallel(pipe, accumulate_steps=2, schedule="bogus")
+        # a post-construction override (test/tooling path) fails at run time
+        pp = PipelineParallel(pipe, accumulate_steps=2, schedule="1F1B")
+        pp._schedule = "not-a-schedule"
+        opt = SGD(learning_rate=0.01, parameters=pipe.parameters())
+        x = np.random.randn(4, 16).astype("float32")
+        with pytest.raises(ValueError, match="unknown pipeline schedule"):
+            pp.train_batch([paddle.to_tensor(x), paddle.to_tensor(x)], opt)
+
     def test_vpp_param_parity(self):
         """Virtual pipeline stages (VPP): S=2 stages x V=2 chunks over 8
         blocks; parity vs sequential training."""
@@ -657,7 +800,7 @@ class TestHybridVPP:
                                  num_virtual_pipeline_stages=2)
             snap = _snapshot(pipe)
             pp = PipelineParallel(pipe, hcg=dist.get_hybrid_communicate_group(),
-                                  accumulate_steps=4, schedule="1F1B")
+                                  accumulate_steps=4, schedule="VPP")
             assert pp._hybrid and len(pipe._stages) == 4
             # chunk c of stage s colocates with stage s (part = c*S + s)
             assert pp._stage_meshes[0] is pp._stage_meshes[2]
