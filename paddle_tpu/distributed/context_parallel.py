@@ -72,26 +72,28 @@ def _block_step(q, k, v, m, l, o, mask, scale):
     return m_new, l_new, o_new
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   sm_scale: Optional[float] = None):
-    """Ring attention over a named mesh axis. Call INSIDE shard_map.
+def _live_hops(n: int, s_k: int, causal: bool, window: Optional[int]) -> int:
+    """Number of ring hops that can touch ANY live (q, kv) pair on ANY
+    device. Hop t processes kv block j = (i - t) mod n; under causal +
+    sliding window w the band 0 <= q_glob - k_glob <= w-1 reaches back at
+    most w-1+s-1 positions, so hops with t*s_k > w-1 + s_k-1 are dead on
+    EVERY device and are skipped statically — long-seq work scales with
+    the window, not the ring size (VERDICT r4 item 3)."""
+    if causal and window is not None:
+        return min(n, (window + s_k - 2) // s_k + 1)
+    return n
 
-    q/k/v: [B, S_local, H, D] (paddle's BSHD layout), the local sequence
-    shard; the global sequence is the concatenation over ``axis_name`` in
-    axis-index order. Returns [B, S_local, H, D] in q.dtype.
 
-    Causal masking uses global positions, so device i's queries attend to
-    k/v blocks j<i fully, block j==i triangularly, and blocks j>i not at
-    all (those steps are skipped via ``lax.cond``). K/V rotate via
-    ``ppermute`` so step t processes block (i - t) mod N; each permute is a
-    neighbour hop that rides ICI.
-    """
+def _ring_einsum(q, k, v, axis_name: str, causal: bool, scale: float,
+                 window: Optional[int]):
+    """Streaming-softmax ring over XLA einsum blocks (the differentiable
+    reference path; also the fallback when splash's shape constraints
+    don't hold). q: [B,S,H,D], k/v: [B,S,Hkv,D] local shards."""
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, s_q, h, d = q.shape
     s_k, h_kv = k.shape[1], k.shape[2]
     g = h // h_kv  # GQA group size; kv stays unexpanded through the ring
-    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
 
     # q: [B,Hkv,G,Sq,D] grouped by kv head; k/v: [B,Hkv,Sk,D]
     qt = jnp.swapaxes(q, 1, 2).reshape(b, h_kv, g, s_q, d)
@@ -100,6 +102,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
     q_pos = idx * s_q + jnp.arange(s_q)            # global query positions
     perm = [(i, (i + 1) % n) for i in range(n)]
+    t_live = _live_hops(n, s_k, causal, window)
 
     # derive the accumulators from qt (zeroed) so they carry the same
     # varying-manual-axes type as the inputs — both lax.cond branches (and
@@ -114,6 +117,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         k_pos = kv_idx * s_k + jnp.arange(s_k)
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
+            if window is not None:
+                mask &= (q_pos[:, None] - k_pos[None, :]) < window
         else:
             mask = jnp.ones((s_q, s_k), bool)
         live = jnp.any(mask)
@@ -127,22 +132,182 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         vc = lax.ppermute(vc, axis_name, perm)
         return (kc, vc, m, l, o), None
 
-    (_, _, m, l, o), _ = lax.scan(step, (kt, vt, m0, l0, o0), jnp.arange(n))
+    (_, _, m, l, o), _ = lax.scan(step, (kt, vt, m0, l0, o0),
+                                  jnp.arange(t_live))
     out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
     out = out.reshape(b, h, s_q, d)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-def _sdpa_core(q, k, v, causal, scale):
+def _ring_splash_fwd_impl(q, k, v, axis_name: str, causal: bool,
+                          scale: float, window: Optional[int],
+                          interpret: bool):
+    """Ring forward where each hop runs the GQA-native splash flash kernel
+    (SURVEY §7 step 9: "Pallas flash + ppermute"). Per hop the mask
+    geometry is STATIC in the hop index t (q_glob - kv_glob = q_loc -
+    kv_loc + t*s for every device), so each hop gets its own compiled
+    kernel: t=0 the causal diagonal, t>=1 full blocks (plain causal) or
+    the t*s-offset sliding band (window). Per-device liveness (kv block in
+    the future, i < t) stays dynamic via lax.cond. Hops are combined by
+    streaming softmax over the per-hop (out, logsumexp) residuals with an
+    f32 carry."""
+    from ..ops.pallas.flash_attention import splash_hop
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    t_live = _live_hops(n, s_k, causal, window)
+
+    qs = jnp.swapaxes(q * jnp.asarray(scale, q.dtype), 1, 2)  # [B,H,S,D]
+    kc = jnp.swapaxes(k, 1, 2)                                # [B,Hkv,S,D]
+    vc = jnp.swapaxes(v, 1, 2)
+
+    m = jnp.full((b, h, s_q), -jnp.inf, jnp.float32) + (qs[..., 0] * 0.0)
+    ssum = jnp.zeros_like(m)
+    acc = jnp.zeros((b, h, s_q, d), jnp.float32) + (qs * 0.0)
+
+    for t in range(t_live):
+        if causal and window is not None:
+            kind, offset = "local", t * s_k
+        elif causal and t == 0:
+            kind, offset = "causal", 0
+        else:
+            # plain-causal past block (offset t*s >= s ⇒ every cell
+            # attends) or non-causal: a full block either way
+            kind, offset = "full", 0
+
+        def hop(args, kc=kc, vc=vc, kind=kind, offset=offset):
+            m, ssum, acc = args
+            o_t, lse = splash_hop(qs, kc, vc, kind, offset=offset,
+                                  window=window, interpret=interpret)
+            lse = lse.astype(jnp.float32)
+            m_new = jnp.maximum(m, lse)
+            # m starts at -inf; splash emits a finite (hugely negative)
+            # lse for fully-masked rows, so m_new is finite after hop 0
+            # and neither exp() below can see (-inf) - (-inf)
+            alpha = jnp.exp(m - m_new)
+            w = jnp.exp(lse - m_new)
+            return (m_new, ssum * alpha + w,
+                    acc * alpha[..., None] + w[..., None]
+                    * o_t.astype(jnp.float32))
+
+        if causal:
+            live = idx >= t  # kv block (i - t) is in this device's past
+            m, ssum, acc = lax.cond(live, hop, lambda args: args,
+                                    (m, ssum, acc))
+        else:
+            m, ssum, acc = hop((m, ssum, acc))
+        if t + 1 < t_live:
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+
+    out = acc / jnp.where(ssum == 0.0, 1.0, ssum)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_splash(q, k, v, axis_name, causal, scale, window, interpret):
+    return _ring_splash_fwd_impl(q, k, v, axis_name, causal, scale, window,
+                                 interpret)
+
+
+def _ring_splash_vjp_fwd(q, k, v, axis_name, causal, scale, window,
+                         interpret):
+    out = _ring_splash_fwd_impl(q, k, v, axis_name, causal, scale, window,
+                                interpret)
+    return out, (q, k, v)
+
+
+def _ring_splash_vjp_bwd(axis_name, causal, scale, window, interpret,
+                         res, g):
+    # The bundled splash kernel has no VJP through its residuals output
+    # (save_residuals=True raises under AD), so the backward recomputes
+    # through the einsum ring — mathematically the same function, O(S_local)
+    # memory, fully collective-transposable. Fwd rides the MXU kernel;
+    # bwd costs einsum-path FLOPs (documented in BASELINE.md).
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _ring_einsum(q_, k_, v_, axis_name, causal,
+                                        scale, window), q, k, v)
+    return vjp(g)
+
+
+_ring_splash.defvjp(_ring_splash_vjp_fwd, _ring_splash_vjp_bwd)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   sm_scale: Optional[float] = None,
+                   window: Optional[int] = None, impl: str = "auto",
+                   interpret: bool = False):
+    """Ring attention over a named mesh axis. Call INSIDE shard_map.
+
+    q/k/v: [B, S_local, H, D] (paddle's BSHD layout), the local sequence
+    shard; the global sequence is the concatenation over ``axis_name`` in
+    axis-index order. Returns [B, S_local, H, D] in q.dtype.
+
+    Causal masking uses global positions, so device i's queries attend to
+    k/v blocks j<i fully, block j==i triangularly, and blocks j>i not at
+    all (those steps are skipped via ``lax.cond``). K/V rotate via
+    ``ppermute`` so step t processes block (i - t) mod N; each permute is a
+    neighbour hop that rides ICI.
+
+    ``window`` (requires ``causal=True``): Mistral-style sliding-window
+    attention — hops whose kv block lies entirely outside the band are
+    skipped statically (no compute, no permute), so cost scales with the
+    window rather than the full sequence.
+
+    ``impl``: "splash" runs the Pallas splash kernel per hop (TPU, or
+    ``interpret=True`` for CPU parity tests) with an einsum-recompute
+    backward; "einsum" is the all-XLA streaming path; "auto" picks splash
+    when the shape qualifies (seq/head_dim multiples of 128, even GQA
+    grouping) on TPU, einsum otherwise.
+    """
+    if window is not None:
+        if not causal:
+            raise ValueError("sliding window requires causal attention")
+        if window <= 0:
+            raise ValueError(f"sliding window must be positive, got {window}")
+    if impl not in ("auto", "splash", "einsum"):
+        raise ValueError(f"ring_attention impl must be auto|splash|einsum, "
+                         f"got {impl!r}")
+    scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    if impl != "einsum":
+        from ..ops.pallas import flash_attention as pf
+
+        ok = pf.supported(q, k, v, interpret=interpret)
+        if impl == "splash" and not ok:
+            raise ValueError(
+                "ring_attention impl='splash' needs TPU (or interpret=True) "
+                "and splash-tileable shapes: seq and head_dim multiples of "
+                f"128, q heads an even multiple of kv heads; got q {q.shape} "
+                f"k {k.shape}")
+        if ok:
+            return _ring_splash(q, k, v, axis_name, causal, scale, window,
+                                interpret)
+    return _ring_einsum(q, k, v, axis_name, causal, scale, window)
+
+
+def _sdpa_core(q, k, v, causal, scale, window=None):
     """Plain blockless attention on BSHD, fp32 softmax. Used by Ulysses."""
     from ..nn.functional.attention import _sdpa_ref
 
     k, v = _expand_gqa(k, v, q.shape[2])
-    return _sdpa_ref(q, k, v, causal=causal, scale=scale)
+    mask = None
+    if window is not None:
+        # sliding band on GLOBAL positions (ulysses holds the full
+        # sequence per head subset after the all-to-all)
+        s_q, s_k = q.shape[1], k.shape[1]
+        rows = jnp.arange(s_q)[:, None] + (s_k - s_q)
+        cols = jnp.arange(s_k)[None, :]
+        mask = (rows - cols) < window  # upper bound; causal handles >= 0
+    return _sdpa_ref(q, k, v, mask=mask, causal=causal, scale=scale)
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
-                      sm_scale: Optional[float] = None):
+                      sm_scale: Optional[float] = None,
+                      window: Optional[int] = None):
     """DeepSpeed-Ulysses-style attention over a named axis. Call INSIDE
     shard_map.
 
@@ -169,17 +334,20 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
+
     qg = seq_to_heads(q)                           # [B, S, H/N, D]
     kg = seq_to_heads(k)
     vg = seq_to_heads(v)
-    out = _sdpa_core(qg, kg, vg, causal, scale)
+    out = _sdpa_core(qg, kg, vg, causal, scale, window=window)
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
 
 
 def sep_attention(query, key, value, causal: bool = False,
                   sm_scale: Optional[float] = None, mode: str = "ring",
-                  group=None):
+                  group=None, window: Optional[int] = None):
     """High-level eager entry: context-parallel attention on the hybrid
     topology's ``sep`` axis (parity surface for what reference users build
     by hand on the sep group — topology.py:199 + alltoall in model code).
@@ -208,9 +376,13 @@ def sep_attention(query, key, value, causal: bool = False,
     spec = P(*([None, axis] + [None] * 2))
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+                       out_specs=spec,
+                       # the splash-per-hop ring runs pallas_call inside
+                       # shard_map, which requires the vma checker off
+                       check_vma=False)
     def fn(q, k, v):
-        return inner(q, k, v, axis, causal=causal, sm_scale=sm_scale)
+        return inner(q, k, v, axis, causal=causal, sm_scale=sm_scale,
+                     window=window)
 
     was_tensor = isinstance(query, Tensor)
     out = fn(unwrap(query), unwrap(key), unwrap(value))

@@ -295,11 +295,6 @@ class LlamaAttention(Layer):
             if (not cache and hcg is not None
                     and hcg.get_sep_parallel_world_size() > 1
                     and cfg.sep_mode in ("ring", "ulysses")):
-                if win is not None:
-                    raise NotImplementedError(
-                        "sliding_window under sequence/context parallelism "
-                        "is not supported; use sep_mode='allgather' or "
-                        "sep_degree=1")
                 # context parallelism: sequence stays sharded over sep; k/v
                 # blocks ride the ring (or heads ride an all-to-all) instead
                 # of GSPMD all-gathering the whole sequence per device.
@@ -321,8 +316,13 @@ class LlamaAttention(Layer):
                 inner = (ring_attention if cfg.sep_mode == "ring"
                          else ulysses_attention)
                 cp = shard_map(
-                    functools.partial(inner, axis_name="sep", causal=True),
-                    mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                    functools.partial(inner, axis_name="sep", causal=True,
+                                      window=win),
+                    mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                    # splash-per-hop ring runs pallas_call inside the
+                    # shard_map; pallas outputs carry no vma, so the vma
+                    # checker must be off (the jax-documented pairing)
+                    check_vma=False)
                 out = cp(q, k, v)
             elif cfg.use_flash_attention and pf.supported(q, k, v):
                 # GQA-native splash kernel: KV stays at num_kv_heads width

@@ -124,6 +124,67 @@ def _splash_kernel(h_q: int, s_q: int, s_kv: int, causal: bool,
     )
 
 
+@functools.lru_cache(maxsize=128)
+def _splash_hop_kernel(h_q: int, s_q: int, s_kv: int, kind: str, offset: int,
+                       window: int | None, interpret: bool, bq: int, bkv: int):
+    """Splash kernel for ONE ring-attention hop, returning residuals.
+
+    ``kind``: "full" (every cell attends — past blocks under plain causal,
+    or non-causal), "causal" (the diagonal block, standard triangle), or
+    "local" (sliding-window band: 0 <= q_global - kv_global <= window-1,
+    where q_global - kv_global = q_local - kv_local + offset and
+    offset = hop * block_len). Built with ``save_residuals=True`` so the
+    caller gets (out, (logsumexp,)) and can combine hops by streaming
+    softmax (ring attention, context_parallel.py). The residuals path has
+    no VJP in the bundled kernel — the ring's custom VJP recomputes via
+    its einsum path instead.
+    """
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
+    )
+
+    if kind == "local":
+        base = sm.LocalMask((s_q, s_kv), window_size=(window - 1, 0),
+                            offset=offset)
+    elif kind == "causal":
+        base = sm.CausalMask((s_q, s_kv), offset=offset)
+    elif kind == "full":
+        base = sm.FullMask((s_q, s_kv))
+    else:
+        raise ValueError(f"unknown hop mask kind {kind!r}")
+    mask = sm.MultiHeadMask([base for _ in range(h_q)])
+    sizes = sk.BlockSizes(block_q=bq, block_kv=bkv, block_kv_compute=bkv,
+                          block_q_dkv=bq, block_kv_dkv=bkv,
+                          block_kv_dkv_compute=bkv,
+                          block_q_dq=bq, block_kv_dq=bkv)
+    # the kernel pytree's mask-info leaves must be CONCRETE arrays: this
+    # builder is lru_cached and often first called inside a trace (a
+    # lax.cond branch of the ring loop); without compile-time eval the
+    # cached object would capture that trace's tracers and leak them into
+    # every later trace
+    with jax.ensure_compile_time_eval():
+        return sk.make_splash_mha(mask, block_sizes=sizes,
+                                  save_residuals=True,
+                                  head_shards=1, q_seq_shards=1,
+                                  interpret=interpret)
+
+
+def splash_hop(q, k, v, kind: str, offset: int = 0,
+               window: int | None = None, interpret: bool = False):
+    """One flash hop on [B, H, S, D] (q pre-scaled), GQA-native; returns
+    (out [B,H,Sq,D] in q.dtype, logsumexp [B,H,Sq] f32)."""
+    b, h, s_q, d = q.shape
+    s_kv = k.shape[2]
+    bq = _block_override("PD_SPLASH_BLOCK_Q", s_q) or _largest_dividing_block(s_q)
+    bkv = (_block_override("PD_SPLASH_BLOCK_KV", s_kv)
+           or _largest_dividing_block(s_kv))
+    kernel = _splash_hop_kernel(h, s_q, s_kv, kind, offset, window,
+                                interpret, bq, bkv)
+    out, (lse,) = jax.vmap(kernel)(q, k, v)
+    return out, lse
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
                                              "interpret", "bq", "bkv",
                                              "window"))
