@@ -357,6 +357,35 @@ def main():
         loss, _ = m(x, labels=y)
         return loss
 
+    if on_tpu:
+        # eager autotune pass at this config's kernel shapes: measures the
+        # splash / fused-norm block-geometry candidates once, persists the
+        # winners (.pd_autotune.json), and logs the chosen blocks; the
+        # train-step trace below then reads the cache (tracing can't time)
+        from paddle_tpu.ops.pallas import autotune as _at
+
+        if _at.enabled():
+            import jax.numpy as jnp
+
+            from paddle_tpu.ops.pallas import flash_attention as _pf
+            from paddle_tpu.ops.pallas import fused_norm as _fn
+
+            hd = cfg.hidden_size // cfg.num_attention_heads
+            qa = jnp.zeros((batch, seq, cfg.num_attention_heads, hd),
+                           jnp.bfloat16)
+            ka = jnp.zeros((batch, seq, cfg.num_key_value_heads, hd),
+                           jnp.bfloat16)
+            if _pf.supported(qa, ka, ka):
+                _pf.flash_attention_bshd(
+                    qa, ka, ka, causal=True,
+                    window=getattr(cfg, "sliding_window", None))
+            xa = jnp.zeros((batch, seq, cfg.hidden_size), jnp.bfloat16)
+            _fn.add_rms_norm(xa, xa, jnp.ones((cfg.hidden_size,),
+                                              jnp.bfloat16))
+            _fn.rms_norm(xa, jnp.ones((cfg.hidden_size,), jnp.bfloat16))
+            print(f"# autotune cache: {_at.get_cache().stats()} "
+                  f"at {_at.cache_path()}", file=sys.stderr)
+
     step = paddle.jit.train_step(model, loss_fn, optimizer)
 
     ids = np.random.randint(0, cfg.vocab_size, (batch, seq + 1))

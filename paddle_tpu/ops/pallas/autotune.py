@@ -1,0 +1,167 @@
+"""Kernel block-geometry autotune with a persistent cross-process cache.
+
+Reference parity: paddle/phi/kernels/autotune/cache.h (AutoTuneCache:
+per-algorithm hashmaps keyed by shape/dtype signatures, hit-rate stats) and
+switch_autotune.cc (the run-once-then-cache switch). The TPU analog tunes
+Pallas block geometry instead of cuDNN algorithms: per (kernel, signature)
+the candidate blockings are measured ONCE on first eager TPU encounter,
+the winner is persisted to a JSON cache inside the repo (survives process
+restarts — cache.h's serialization role), and every later call — including
+traced calls inside jit, which cannot time anything — reads the cached
+choice. ``FLAGS_use_autotune`` (utils/flags.py) gates measurement exactly
+like the reference's switch; with the flag off the caller's heuristic
+default is used untouched.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+_DEFAULT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))),
+    ".pd_autotune.json")
+
+
+def cache_path() -> str:
+    return os.environ.get("PD_AUTOTUNE_CACHE", _DEFAULT_PATH)
+
+
+class AutotuneCache:
+    """kernel → {signature → {"choice": [...], "ms": float}} with JSON
+    persistence (write-temp-then-rename so concurrent processes never read
+    a torn file; last writer wins, which is fine — entries are measurements
+    of the same hardware)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or cache_path()
+        self._data: Dict[str, Dict[str, Any]] = {}
+        self._loaded = False
+
+    def _load(self):
+        if self._loaded:
+            return
+        self._loaded = True
+        try:
+            with open(self.path) as f:
+                self._data = json.load(f)
+        except Exception:
+            self._data = {}
+
+    def get(self, kernel: str, key: str):
+        self._load()
+        ent = self._data.get(kernel, {}).get(key)
+        return None if ent is None else ent.get("choice")
+
+    def put(self, kernel: str, key: str, choice: Sequence[int], ms: float):
+        self._load()
+        self._data.setdefault(kernel, {})[key] = {
+            "choice": list(choice), "ms": round(ms, 4),
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self._data, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def stats(self):
+        self._load()
+        return {k: len(v) for k, v in self._data.items()}
+
+
+_cache: Optional[AutotuneCache] = None
+
+
+def get_cache() -> AutotuneCache:
+    global _cache
+    if _cache is None or _cache.path != cache_path():
+        _cache = AutotuneCache()
+    return _cache
+
+
+def enabled() -> bool:
+    from ...utils.flags import get_flags
+
+    return bool(get_flags("FLAGS_use_autotune")["FLAGS_use_autotune"])
+
+
+def device_kind() -> str:
+    """Hardware identity baked into every cache key: block winners are a
+    property of the chip generation (v5e vs v6e tile timings differ), and
+    the cache file travels with the repo."""
+    try:
+        return jax.devices()[0].device_kind.replace(" ", "_")
+    except Exception:
+        return "unknown"
+
+
+def full_key(key: str) -> str:
+    return f"{key} @{device_kind()}"
+
+
+def _measure(fn: Callable[[], Any], reps: int = 3) -> float:
+    out = fn()  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) * 1000 / reps
+
+
+def pick(kernel: str, key: str, default: Tuple[int, ...],
+         candidates: Sequence[Tuple[int, ...]],
+         runner: Callable[[Tuple[int, ...]], Callable[[], Any]],
+         can_measure: bool, log: bool = True) -> Tuple[int, ...]:
+    """Resolve a block geometry for (kernel, key).
+
+    Order: persisted cache hit → measured sweep (only when the flag is on
+    AND ``can_measure`` — the caller passes False under tracing, off-TPU,
+    or interpret mode) → ``default`` (the caller's heuristic). A sweep
+    times each candidate via ``runner(cfg)()`` and persists the winner.
+    """
+    if not enabled():
+        return default  # the reference's switch: flag off = heuristic only
+    key = full_key(key)
+    cache = get_cache()
+    hit = cache.get(kernel, key)
+    if hit is not None:
+        hit = tuple(hit)
+        # a stale or hand-edited entry must not silently corrupt a kernel
+        # launch (e.g. a block that no longer divides the row count)
+        if not candidates or hit in {tuple(c) for c in candidates}:
+            return hit
+    if not can_measure:
+        return default
+    best, best_ms = default, float("inf")
+    for cfg in candidates:
+        try:
+            ms = _measure(runner(cfg))
+        except Exception:
+            continue  # a candidate that OOMs VMEM just loses the sweep
+        if ms < best_ms:
+            best, best_ms = tuple(cfg), ms
+    if best_ms == float("inf"):
+        return default
+    cache.put(kernel, key, best, best_ms)
+    if log:
+        import sys
+
+        print(f"# autotune[{kernel}] {key} -> {best} ({best_ms:.2f} ms)",
+              file=sys.stderr)
+    return best
+
+
+def is_concrete(*arrays) -> bool:
+    """True when none of the arrays are tracers (a timed eager sweep is
+    legal). Inside jit the kernel must consult only the persisted cache."""
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)

@@ -115,13 +115,17 @@ def _splash_kernel(h_q: int, s_q: int, s_kv: int, causal: bool,
         block_q_dq=bq,
         block_kv_dq=bkv,
     )
-    return sk.make_splash_mha(
-        mask,
-        block_sizes=sizes,
-        head_shards=1,
-        q_seq_shards=1,
-        interpret=interpret,
-    )
+    # concrete mask-info leaves only: this builder is lru_cached and may
+    # first run inside a trace (e.g. under jax.grad); a kernel pytree
+    # carrying that trace's tracers would leak into every later trace
+    with jax.ensure_compile_time_eval():
+        return sk.make_splash_mha(
+            mask,
+            block_sizes=sizes,
+            head_shards=1,
+            q_seq_shards=1,
+            interpret=interpret,
+        )
 
 
 @functools.lru_cache(maxsize=128)
@@ -213,11 +217,43 @@ def flash_attention_bshd(q, k, v, causal: bool = False,
     a fresh process — the bench children are exactly that.
     """
     s_q, s_kv = q.shape[1], k.shape[1]
-    bq = _block_override("PD_SPLASH_BLOCK_Q", s_q) or _largest_dividing_block(s_q)
-    bkv = (_block_override("PD_SPLASH_BLOCK_KV", s_kv)
-           or _largest_dividing_block(s_kv))
     if window is not None and (window <= 0 or not causal):
         raise ValueError("window requires causal=True and window > 0")
+    bq_env = _block_override("PD_SPLASH_BLOCK_Q", s_q)
+    bkv_env = _block_override("PD_SPLASH_BLOCK_KV", s_kv)
+    bq = bq_env or _largest_dividing_block(s_q)
+    bkv = bkv_env or _largest_dividing_block(s_kv)
+    if bq_env is None and bkv_env is None:
+        # no manual sweep override: consult the autotune cache; an eager
+        # TPU call with FLAGS_use_autotune measures the candidate grid once
+        # and persists the winner (traced calls read the cache only)
+        from . import autotune
+
+        key = (f"q{tuple(q.shape)} kv{tuple(k.shape)} {q.dtype} "
+               f"causal={causal} win={window}")
+        cands = [(a, b) for a in (512, 384, 256, 128) if s_q % a == 0
+                 for b in (512, 384, 256, 128) if s_kv % b == 0]
+        can = (not interpret and _on_tpu()
+               and autotune.is_concrete(q, k, v))
+
+        def runner(cfg):
+            # rank candidates by fwd+bwd: the winning (bq, bkv) also fixes
+            # the dkv/dq backward block sizes the train step runs with, so
+            # a forward-only sweep could persist a slow-backward geometry
+            def fwd_bwd(q_, k_, v_):
+                def f(qkv):
+                    out = _flash_bshd_jit(
+                        qkv[0], qkv[1], qkv[2], causal=causal,
+                        sm_scale=sm_scale, interpret=interpret,
+                        bq=cfg[0], bkv=cfg[1], window=window)
+                    return out.astype(jnp.float32).sum()
+                return jax.grad(f)((q_, k_, v_))
+
+            f = jax.jit(fwd_bwd)
+            return lambda: f(q, k, v)
+
+        bq, bkv = autotune.pick("splash_mha", key, (bq, bkv), cands,
+                                runner, can)
     return _flash_bshd_jit(q, k, v, causal=causal, sm_scale=sm_scale,
                            interpret=interpret, bq=bq, bkv=bkv,
                            window=window)

@@ -39,6 +39,28 @@ def _pick_block_rows(rows: int, d: int) -> int:
     return block
 
 
+def _block_candidates(rows: int, d: int):
+    """Row blocks the VMEM bound admits, for the autotune sweep."""
+    return [(b,) for b in (512, 256, 128, 64, 32, 16, 8)
+            if rows % b == 0 and b * d <= 512 * 1024]
+
+
+def _tuned_block_rows(kernel: str, rows: int, d: int, dtype, runner,
+                      *arrays) -> int:
+    """Heuristic block unless the autotune cache (ops/pallas/autotune.py,
+    the phi/kernels/autotune analog) knows — or can measure — better.
+    ``arrays`` are the kernel operands: a timed sweep is only legal when
+    they are concrete (not tracers) on a real TPU."""
+    from . import autotune
+
+    default = _pick_block_rows(rows, d)
+    can_measure = _on_tpu() and autotune.is_concrete(*arrays)
+    (block,) = autotune.pick(kernel, f"rows{rows} d{d} {jnp.dtype(dtype)}",
+                             (default,), _block_candidates(rows, d),
+                             runner, can_measure)
+    return block
+
+
 # ---------------- fused RMSNorm ----------------------------------------------
 
 def _rmsnorm_fwd_kernel(x_ref, w_ref, o_ref, *, eps):
@@ -76,8 +98,15 @@ def rms_norm(x, weight, eps=1e-6):
     rows = 1
     for s in x.shape[:-1]:
         rows *= s
-    block = _pick_block_rows(rows, d)
-    if d % 128 == 0 and rows % block == 0 and _HAS_PLTPU:
+    if d % 128 == 0 and rows % 8 == 0 and _HAS_PLTPU:
+        # runner jits each candidate so the sweep times the KERNEL, not
+        # eager pallas_call dispatch/retrace overhead
+        jit_norm = jax.jit(_rmsnorm_pallas, static_argnums=(2, 3))
+        block = _tuned_block_rows(
+            "rms_norm", rows, d, x.dtype,
+            lambda cfg: functools.partial(jit_norm, x.reshape(rows, d),
+                                          weight, eps, cfg[0]),
+            x, weight)
         out2d = _rmsnorm_pallas(x.reshape(rows, d), weight, eps, block)
         return out2d.reshape(x.shape)
     return _rmsnorm_ref(x, weight, eps)
@@ -119,29 +148,42 @@ def add_rms_norm(x, residual, weight, eps=1e-6):
     rows = 1
     for s in x.shape[:-1]:
         rows *= s
-    block = _pick_block_rows(rows, d)
-    if d % 128 == 0 and rows % block == 0 and _HAS_PLTPU:
-        kernel = functools.partial(_add_rmsnorm_kernel, eps=eps)
-        out2d, h2d = pl.pallas_call(
-            kernel,
-            out_shape=(
-                jax.ShapeDtypeStruct((rows, d), x.dtype),
-                jax.ShapeDtypeStruct((rows, d), x.dtype),
-            ),
-            grid=(rows // block,),
-            in_specs=[
-                pl.BlockSpec((block, d), lambda i: (i, 0)),
-                pl.BlockSpec((block, d), lambda i: (i, 0)),
-                pl.BlockSpec((1, d), lambda i: (0, 0)),
-            ],
-            out_specs=(
-                pl.BlockSpec((block, d), lambda i: (i, 0)),
-                pl.BlockSpec((block, d), lambda i: (i, 0)),
-            ),
-            interpret=not _on_tpu(),
-        )(x.reshape(rows, d), residual.reshape(rows, d), weight.reshape(1, d))
+    if d % 128 == 0 and rows % 8 == 0 and _HAS_PLTPU:
+        jit_norm = jax.jit(_add_rms_pallas, static_argnums=(3, 4))
+        block = _tuned_block_rows(
+            "add_rms_norm", rows, d, x.dtype,
+            lambda cfg: functools.partial(jit_norm, x.reshape(rows, d),
+                                          residual.reshape(rows, d),
+                                          weight, eps, cfg[0]),
+            x, residual, weight)
+        out2d, h2d = _add_rms_pallas(x.reshape(rows, d),
+                                     residual.reshape(rows, d),
+                                     weight, eps, block)
         return out2d.reshape(x.shape), h2d.reshape(x.shape)
     return _add_rms_ref(x, residual, weight, eps)
+
+
+def _add_rms_pallas(x2d, r2d, w, eps, block):
+    rows, d = x2d.shape
+    kernel = functools.partial(_add_rmsnorm_kernel, eps=eps)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, d), x2d.dtype),
+            jax.ShapeDtypeStruct((rows, d), x2d.dtype),
+        ),
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+        ),
+        interpret=not _on_tpu(),
+    )(x2d, r2d, w.reshape(1, d))
 
 
 def _add_rms_fwd(x, r, w, eps):

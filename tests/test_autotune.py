@@ -1,0 +1,143 @@
+"""Kernel block-geometry autotune (ops/pallas/autotune.py — the analog of
+paddle/phi/kernels/autotune/cache.h + switch_autotune.cc): flag-gated
+measurement, persisted cross-process cache, heuristic fallback."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu  # noqa: F401  (flag registry init)
+from paddle_tpu.ops.pallas import autotune
+from paddle_tpu.utils.flags import get_flags, set_flags
+
+
+@pytest.fixture
+def tuned_cache(tmp_path, monkeypatch):
+    """Point the autotune cache at a throwaway file and restore the flag."""
+    path = str(tmp_path / "autotune.json")
+    monkeypatch.setenv("PD_AUTOTUNE_CACHE", path)
+    prev = get_flags("FLAGS_use_autotune")["FLAGS_use_autotune"]
+    yield path
+    set_flags({"FLAGS_use_autotune": prev})
+
+
+def _runner_factory(timings, calls):
+    """Candidate runner whose fake work duration comes from ``timings``."""
+    import time
+
+    def runner(cfg):
+        def run():
+            calls.append(cfg)
+            time.sleep(timings[cfg])
+            return np.zeros(())
+        return run
+    return runner
+
+
+class TestPick:
+    def test_flag_off_returns_default_and_never_measures(self, tuned_cache):
+        set_flags({"FLAGS_use_autotune": False})
+        calls = []
+        got = autotune.pick("k", "key", (256,), [(128,), (64,)],
+                            _runner_factory({}, calls), can_measure=True)
+        assert got == (256,)
+        assert calls == []
+        assert not os.path.exists(tuned_cache)
+
+    def test_measures_picks_fastest_and_persists(self, tuned_cache):
+        set_flags({"FLAGS_use_autotune": True})
+        calls = []
+        timings = {(128,): 0.03, (64,): 0.001, (32,): 0.02}
+        got = autotune.pick("k", "rows128 d256", (128,), list(timings),
+                            _runner_factory(timings, calls),
+                            can_measure=True, log=False)
+        assert got == (64,)
+        assert set(calls) == set(timings)
+        # persisted: a FRESH cache object (new process analog) sees it
+        data = json.load(open(tuned_cache))
+        assert data["k"][autotune.full_key("rows128 d256")]["choice"] == [64]
+        fresh = autotune.AutotuneCache(tuned_cache)
+        assert fresh.get("k", autotune.full_key("rows128 d256")) == [64]
+
+    def test_cache_hit_skips_measurement(self, tuned_cache):
+        set_flags({"FLAGS_use_autotune": True})
+        autotune.get_cache().put("k", autotune.full_key("sig"), (32,), 1.0)
+        calls = []
+        got = autotune.pick("k", "sig", (128,), [(128,), (32,)],
+                            _runner_factory({}, calls), can_measure=True)
+        assert got == (32,) and calls == []
+
+    def test_no_measure_context_returns_default(self, tuned_cache):
+        """Traced / off-TPU callers pass can_measure=False: cache miss must
+        fall back to the heuristic default, not try to time tracers."""
+        set_flags({"FLAGS_use_autotune": True})
+        got = autotune.pick("k", "other", (128,), [(64,)],
+                            _runner_factory({}, []), can_measure=False)
+        assert got == (128,)
+
+    def test_failing_candidates_lose(self, tuned_cache):
+        set_flags({"FLAGS_use_autotune": True})
+
+        def runner(cfg):
+            def run():
+                if cfg == (512,):
+                    raise RuntimeError("VMEM OOM")  # oversized block
+                return np.zeros(())
+            return run
+
+        got = autotune.pick("k", "oom", (128,), [(512,), (64,)], runner,
+                            can_measure=True, log=False)
+        assert got == (64,)
+
+
+class TestKernelIntegration:
+    def test_rms_norm_uses_cached_block_and_stays_correct(self, tuned_cache):
+        """A cached (non-default) geometry is honored by the kernel wrapper
+        and does not change numerics."""
+        from paddle_tpu.ops.pallas import fused_norm as fn
+
+        set_flags({"FLAGS_use_autotune": True})
+        rows, d = 64, 256
+        autotune.get_cache().put("rms_norm",
+                                 autotune.full_key(f"rows{rows} d{d} float32"),
+                                 (8,), 1.0)
+        block = fn._tuned_block_rows("rms_norm", rows, d, jnp.float32, None)
+        assert block == 8 and block != fn._pick_block_rows(rows, d)
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 16, d), jnp.float32)
+        w = jnp.asarray(np.random.RandomState(1).randn(d), jnp.float32)
+        np.testing.assert_allclose(np.asarray(fn.rms_norm(x, w)),
+                                   np.asarray(fn._rmsnorm_ref(x, w, 1e-6)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_flash_candidates_respect_divisibility(self, tuned_cache):
+        """The splash candidate grid only offers blocks that divide the
+        sequence; with the flag on but nothing measurable (CPU), the
+        heuristic default survives and the kernel still runs."""
+        from paddle_tpu.ops.pallas import flash_attention as pf
+
+        set_flags({"FLAGS_use_autotune": True})
+        rng = np.random.RandomState(2)
+        q = jnp.asarray(rng.randn(1, 256, 2, 128), jnp.float32)
+        out = pf.flash_attention_bshd(q, q, q, causal=True, interpret=True)
+        assert out.shape == q.shape
+        assert not os.path.exists(tuned_cache)  # nothing was measured
+
+    def test_flash_reads_cached_geometry(self, tuned_cache):
+        from paddle_tpu.ops.pallas import flash_attention as pf
+
+        set_flags({"FLAGS_use_autotune": True})
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(1, 256, 2, 128), jnp.float32)
+        key = (f"q{tuple(q.shape)} kv{tuple(q.shape)} {q.dtype} "
+               "causal=True win=None")
+        autotune.get_cache().put("splash_mha", autotune.full_key(key),
+                                 (128, 128), 1.0)
+        out = pf.flash_attention_bshd(q, q, q, causal=True, interpret=True)
+        # parity against the non-tuned geometry (the 256-block default)
+        set_flags({"FLAGS_use_autotune": False})
+        out2 = pf.flash_attention_bshd(q, q, q, causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                                   rtol=1e-5, atol=1e-5)
