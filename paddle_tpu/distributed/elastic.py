@@ -189,6 +189,79 @@ class ElasticManager:
         self._stop.set()
 
 
+class RestartGuard:
+    """SIGTERM → checkpoint-then-exit, with torn-state protection.
+
+    The launcher tears an incarnation down with SIGTERM (launch/main.py
+    watch loop; ref ``ElasticManager`` stops trainers the same way before a
+    membership-driven restart). A rank that was healthy at teardown time
+    may be AHEAD of its last periodic checkpoint — ``save_fn`` runs once
+    here so the next incarnation resumes from the newest step instead of
+    replaying work (the reference's "save on signal" contract). The handler
+    then exits with ``exit_code`` (never returns): resuming training after
+    teardown began would race the relaunch.
+
+    A Python signal handler runs at an arbitrary bytecode boundary — in
+    the middle of ``optimizer.step()`` the parameters are half-updated and
+    a save there would checkpoint torn state. Wrap each mutation span in
+    ``shield()``: a signal landing inside it defers the save to the
+    ``with`` exit, when the model/step-counter pair is consistent again.
+    Between spans (data loading, collective waits — where workers spend
+    teardown in practice) the save runs immediately.
+    """
+
+    def __init__(self, save_fn: Callable[[], None],
+                 exit_code: int = ELASTIC_EXIT_CODE):
+        self._save_fn = save_fn
+        self._exit_code = exit_code
+        self._fired = False
+        self._shielded = 0
+        self._pending = False
+
+    def _save_and_exit(self):
+        try:
+            self._save_fn()
+        finally:
+            os._exit(self._exit_code)
+
+    def _handler(self, signum, frame):
+        if self._fired:
+            os._exit(self._exit_code)
+        self._fired = True
+        if self._shielded:
+            self._pending = True  # defer to the shield() exit
+            return
+        self._save_and_exit()
+
+    def shield(self):
+        """Context manager marking a model/step-counter mutation span as
+        atomic with respect to the save-on-signal handler."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            self._shielded += 1
+            try:
+                yield
+            finally:
+                self._shielded -= 1
+                if self._pending and not self._shielded:
+                    self._save_and_exit()
+
+        return cm()
+
+
+def on_restart_signal(save_fn: Callable[[], None],
+                      exit_code: int = ELASTIC_EXIT_CODE) -> RestartGuard:
+    """Install the save-on-signal SIGTERM handler; returns the guard whose
+    ``shield()`` protects mutation spans from torn-state saves."""
+    import signal
+
+    guard = RestartGuard(save_fn, exit_code)
+    signal.signal(signal.SIGTERM, guard._handler)
+    return guard
+
+
 def start_elastic(job_id: Optional[str] = None, ttl: Optional[float] = None):
     """Worker one-liner: register this rank's lease and monitor peers
     (endpoint/rank/world/job from the launcher's env). No-op when the job

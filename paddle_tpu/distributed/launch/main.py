@@ -38,6 +38,14 @@ def _build_parser():
                         "(elastic manager parity: workers must resume from "
                         "their checkpoint; PADDLE_RESTART_COUNT tells them "
                         "which incarnation they are)")
+    p.add_argument("--np_range", default=None, metavar="MIN:MAX",
+                   help="elastic world-size range (fleet/elastic np syntax): "
+                        "on a membership-driven restart the launcher drops "
+                        "the FAILED ranks and relaunches with the surviving "
+                        "count (never below MIN); workers see the new "
+                        "PADDLE_TRAINERS_NUM and reshard their checkpoint "
+                        "state on load. Single-node only (like "
+                        "--elastic_ttl)")
     p.add_argument("--elastic_ttl", type=float, default=0.0,
                    help="enable elastic MEMBERSHIP management (fleet/elastic/"
                         "manager.py parity): the launcher hosts a TCPStore "
@@ -51,9 +59,9 @@ def _build_parser():
     return p
 
 
-def _rank_env(args, local_rank: int) -> dict:
-    world = args.nnodes * args.nproc_per_node
-    rank = args.rank * args.nproc_per_node + local_rank
+def _rank_env(args, local_rank: int, nproc: int) -> dict:
+    world = args.nnodes * nproc
+    rank = args.rank * nproc + local_rank
     env = dict(os.environ)
     if args.master is None and args.nnodes > 1:
         raise SystemExit(
@@ -83,24 +91,56 @@ def launch(argv: Optional[List[str]] = None) -> int:
     (fleet/elastic/manager.py:125 — membership change → restart; workers
     resume from their own checkpoints)."""
     args = _build_parser().parse_args(argv)
-    code = _run_once(args, restart_count=0)
+    nproc = args.nproc_per_node
+    min_np = None
+    if args.np_range:
+        try:
+            lo, hi = (int(x) for x in args.np_range.split(":"))
+        except ValueError:
+            raise SystemExit(f"--np_range must be MIN:MAX, got {args.np_range!r}")
+        if not (1 <= lo <= hi):
+            raise SystemExit(f"--np_range needs 1 <= MIN <= MAX, got {args.np_range}")
+        if args.nnodes > 1:
+            # same constraint as --elastic_ttl: scale-in decisions must be
+            # job-global or the nodes' worlds/rank numbering diverge
+            raise SystemExit("--np_range currently supports single-node "
+                             "jobs only")
+        if lo > nproc:
+            raise SystemExit(f"--np_range MIN ({lo}) exceeds "
+                             f"--nproc_per_node ({nproc}): scale-in can "
+                             "never grow the world past the configured "
+                             "worker count")
+        min_np, nproc = lo, min(nproc, hi)
+    code, failed = _run_once(args, restart_count=0, nproc=nproc)
     restarts = 0
     # 130 = operator Ctrl-C: an intentional stop, never a restartable failure
     while code not in (0, 130) and restarts < args.max_restarts:
         restarts += 1
+        if min_np is not None and failed:
+            # membership-driven scale-in (ElasticManager np-range parity):
+            # the ranks that died/lapsed leave the job; survivors relaunch
+            # as a smaller world and reshard their checkpoints on load
+            new_nproc = min(nproc, max(min_np, nproc - len(failed)))
+            if new_nproc != nproc:
+                print(f"launch: elastic scale-in {nproc} -> {new_nproc} "
+                      f"(lost ranks {sorted(failed)})", flush=True)
+                nproc = new_nproc
         print(f"launch: failure (rc={code}); restart {restarts}/"
-              f"{args.max_restarts} of all workers", flush=True)
-        code = _run_once(args, restart_count=restarts)
+              f"{args.max_restarts} with {nproc} worker(s)", flush=True)
+        code, failed = _run_once(args, restart_count=restarts, nproc=nproc)
     return code
 
 
-def _run_once(args, restart_count: int) -> int:
+def _run_once(args, restart_count: int, nproc: Optional[int] = None):
     """One incarnation: spawn workers, watch, first-failure abort.
+    Returns ``(exit_code, failed_ranks)`` — the ranks that exited non-zero
+    or lapsed their lease feed the elastic scale-in decision in launch().
 
     With --elastic_ttl, the launcher additionally runs the elastic
     peer-set watch: a worker whose lease lapses while its process is still
     alive (hang, not crash) fails the incarnation, exactly as an exit
     would (ElasticManager._match semantics)."""
+    nproc = nproc if nproc is not None else args.nproc_per_node
     os.makedirs(args.log_dir, exist_ok=True)
 
     elastic = None
@@ -119,7 +159,7 @@ def _run_once(args, restart_count: int) -> int:
         from ..store import TCPStore
 
         store = TCPStore("127.0.0.1", 0, is_master=True,
-                         world_size=args.nnodes * args.nproc_per_node)
+                         world_size=args.nnodes * nproc)
         # per-WORKER env only: mutating os.environ would leave later code
         # in this process pointing at a store that dies with _run_once
         elastic_env = {
@@ -128,15 +168,15 @@ def _run_once(args, restart_count: int) -> int:
             "PADDLE_ELASTIC_JOB_ID": args.job_id,
         }
         elastic = ElasticManager(store, rank=-1,
-                                 world_size=args.nnodes * args.nproc_per_node,
+                                 world_size=args.nnodes * nproc,
                                  ttl=args.elastic_ttl, job_id=args.job_id)
 
     procs: List[subprocess.Popen] = []
     rank_of = {}
     logs = []
     log_files = []
-    for local_rank in range(args.nproc_per_node):
-        rank = args.rank * args.nproc_per_node + local_rank
+    for local_rank in range(nproc):
+        rank = args.rank * nproc + local_rank
         suffix = f".r{restart_count}" if restart_count else ""
         log_path = os.path.join(
             args.log_dir, f"{args.job_id}.workerlog.{rank}{suffix}")
@@ -144,7 +184,7 @@ def _run_once(args, restart_count: int) -> int:
         log_files.append(logf)
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
-        env = _rank_env(args, local_rank)
+        env = _rank_env(args, local_rank, nproc)
         env.update(elastic_env)
         env["PADDLE_RESTART_COUNT"] = str(restart_count)
         procs.append(subprocess.Popen(
@@ -157,6 +197,8 @@ def _run_once(args, restart_count: int) -> int:
     # watch loop: first non-zero exit kills the rest (collective.py watch);
     # with elastic on, a LAPSED LEASE (hung worker) fails the incarnation too
     exit_code = 0
+    failed: set = set()  # the CAUSAL failures (first crash / lapsed leases),
+    # not teardown casualties — this feeds the elastic scale-in decision
     term_deadline = None  # set on first failure: SIGKILL stragglers after it
     try:
         while procs:
@@ -167,6 +209,7 @@ def _run_once(args, restart_count: int) -> int:
                 procs.remove(p)
                 if ret != 0 and exit_code == 0:
                     exit_code = ret
+                    failed.add(rank_of[id(p)])
                     for q in procs:
                         q.send_signal(signal.SIGTERM)
             if elastic is not None and exit_code == 0 and procs:
@@ -179,6 +222,7 @@ def _run_once(args, restart_count: int) -> int:
                           f"{stale} lapsed (hung?); failing incarnation",
                           flush=True)
                     exit_code = 1
+                    failed.update(stale)
                     for q in procs:
                         q.send_signal(signal.SIGTERM)
             if exit_code != 0:
@@ -216,7 +260,7 @@ def _run_once(args, restart_count: int) -> int:
             tail = open(lp).read().splitlines()[-20:]
             print(f"---- {lp} (tail) ----", flush=True)
             print("\n".join(tail), flush=True)
-    return exit_code
+    return exit_code, failed
 
 
 def main():

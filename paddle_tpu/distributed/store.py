@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import time
 from typing import List, Optional
 
 from ..core import load_native
@@ -48,19 +49,28 @@ class TCPStore:
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
         k = key.encode()
-        out = ctypes.POINTER(ctypes.c_char)()
-        out_len = ctypes.c_uint32()
-        rc = self._lib.pd_store_client_get(
-            self._client, k, len(k), ctypes.byref(out),
-            ctypes.byref(out_len),
-            self.timeout if timeout is None else timeout)
-        if rc == 1:
-            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
-        if rc != 0:
-            raise RuntimeError(f"TCPStore.get({key!r}) failed")
-        data = ctypes.string_at(out, out_len.value)
-        self._lib.pd_store_free(out)
-        return data
+        total = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + total
+        # the wait is sliced into short native calls so Python-level signal
+        # handlers (save-on-signal checkpointing, Ctrl-C) run between ctypes
+        # calls — one blocking native get would pin the interpreter for the
+        # full timeout, and a SIGTERMed worker would be SIGKILLed unsaved
+        while True:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            out = ctypes.POINTER(ctypes.c_char)()
+            out_len = ctypes.c_uint32()
+            rc = self._lib.pd_store_client_get(
+                self._client, k, len(k), ctypes.byref(out),
+                ctypes.byref(out_len), min(0.5, remain))
+            if rc == 1:
+                continue  # slice elapsed without the key; re-check deadline
+            if rc != 0:
+                raise RuntimeError(f"TCPStore.get({key!r}) failed")
+            data = ctypes.string_at(out, out_len.value)
+            self._lib.pd_store_free(out)
+            return data
 
     def add(self, key: str, amount: int = 1) -> int:
         k = key.encode()

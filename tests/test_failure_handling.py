@@ -278,3 +278,175 @@ def test_elastic_clean_exit_is_not_membership_loss():
     assert m0.stale_ranks() == []  # launcher view agrees
     m0.close(); m1.close()
     master.close(); client.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic close-the-loop (VERDICT r4 item 5): real model, save-on-signal,
+# membership-driven scale-in with reshard-on-load, loss continuity
+# ---------------------------------------------------------------------------
+
+_ELASTIC_TRAIN_WORKER = r'''
+import glob, os, pickle, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import SGD
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.elastic import on_restart_signal
+
+out, crash_at, total = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+ckpt_every = int(sys.argv[4])
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+inc = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+store = TCPStore(host, int(port), is_master=(rank == 0), world_size=world,
+                 timeout=60)
+store.barrier(f"boot{inc}")
+
+paddle.seed(0)  # every incarnation builds the SAME init before any load
+model = nn.Linear(4, 1)
+opt = SGD(learning_rate=0.05, parameters=model.parameters())
+
+# resume from the NEWEST checkpoint across ALL former ranks: weights are
+# replicated, so any newest copy is valid at any world size, and the new
+# (possibly smaller) world re-partitions the data below — reshard-on-load
+step0, best = 0, None
+for f in sorted(glob.glob(os.path.join(out, "ck_*.pkl"))):
+    with open(f, "rb") as fh:
+        st = pickle.load(fh)
+    if best is None or st["step"] > best["step"]:
+        best = st
+if best is not None:
+    own = model.state_dict()
+    for k, v in best["w"].items():
+        own[k].set_value(paddle.to_tensor(v))
+    step0 = best["step"]
+    print(f"rank {rank} resumed from step {step0} at world {world}", flush=True)
+
+cur = {"step": step0}
+my_ck = os.path.join(out, f"ck_{rank}.pkl")
+
+def save():
+    cur["w"] = {k: np.asarray(v._array) for k, v in model.state_dict().items()}
+    with open(my_ck + ".tmp", "wb") as f:
+        pickle.dump(cur, f)
+    os.replace(my_ck + ".tmp", my_ck)
+    print(f"rank {rank} saved step {cur['step']}", flush=True)
+
+# launcher SIGTERM => checkpoint newest step, exit; shield() below keeps
+# the optimizer-update + step-counter span atomic wrt that save
+guard = on_restart_signal(save)
+
+rng = np.random.RandomState(42)
+X = rng.randn(64, 4).astype("float32")
+W_TRUE = np.array([[3.0], [-1.0], [2.0], [0.5]], np.float32)
+Y = X @ W_TRUE - 2.0
+
+for step in range(step0, total):
+    if rank == 1 and inc == 0 and step == crash_at:
+        print(f"rank {rank} CRASHING at step {step}", flush=True)
+        os._exit(7)
+    shard = np.array_split(np.arange(64), world)[rank]
+    x, y = paddle.to_tensor(X[shard]), paddle.to_tensor(Y[shard])
+    diff = model(x) - y
+    loss = (diff * diff).mean()
+    loss.backward()
+    # grad allreduce over the TCPStore (eager dp on the CPU test rig)
+    grads = {k: p.grad.numpy() for k, p in
+             zip(("w", "b"), model.parameters())}
+    store.set(f"g{inc}_{step}_{rank}", pickle.dumps(grads))
+    acc = None
+    for r in range(world):
+        g = pickle.loads(store.get(f"g{inc}_{step}_{r}", timeout=60))
+        acc = g if acc is None else {k: acc[k] + g[k] for k in acc}
+    with guard.shield():
+        for (k, p) in zip(("w", "b"), model.parameters()):
+            p.grad.set_value(paddle.to_tensor(acc[k] / world))
+        opt.step()
+        opt.clear_grad()
+        cur["step"] = step + 1
+    print(f"rank {rank} inc {inc} step {step + 1} loss "
+          f"{float(loss.numpy()):.6f}", flush=True)
+    if (step + 1) % ckpt_every == 0:
+        save()
+
+print(f"rank {rank} DONE at step {cur['step']}", flush=True)
+'''
+
+
+@pytest.mark.slow
+def test_elastic_scale_in_resumes_model_training(tmp_path):
+    """Kill one worker of a 2-process REAL-MODEL run: the launcher detects
+    the death, scales the world in (--np_range 1:2), and the survivor
+    resumes from the save-on-signal checkpoint with the loss continuing
+    where it left off (VERDICT r4 item 5 done-criterion)."""
+    import re
+    import socket
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_ELASTIC_TRAIN_WORKER)
+    logd = tmp_path / "logs"
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    crash_at, total, ckpt_every = 3, 20, 100  # periodic saves never fire:
+    # the resume step can only come from the SIGTERM save-on-signal path
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{port}",
+         "--max_restarts", "1", "--np_range", "1:2",
+         "--log_dir", str(logd), "--job_id", "scalein",
+         str(worker), str(tmp_path), str(crash_at), str(total),
+         str(ckpt_every)],
+        cwd="/root/repo", capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "/root/repo",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    assert "elastic scale-in 2 -> 1" in r.stdout, r.stdout
+
+    logs = {p.name: p.read_text() for p in logd.iterdir()}
+    all_logs = "".join(logs.values())
+    # rank 0 was SIGTERMed mid-step and saved the exact completed-step count
+    assert f"rank 0 saved step {crash_at}" in all_logs
+    # the survivor resumed at the signal-saved step as a world of ONE
+    assert f"resumed from step {crash_at} at world 1" in all_logs
+    assert f"DONE at step {total}" in all_logs
+
+    # loss continuity: the first post-restart loss continues the descent —
+    # below the first incarnation's initial loss, and no worse than the
+    # last pre-crash loss (allowing the world-2 -> world-1 batch change)
+    losses0 = [float(m) for m in re.findall(
+        r"rank 0 inc 0 step \d+ loss ([0-9.]+)", all_logs)]
+    losses1 = [float(m) for m in re.findall(
+        r"rank 0 inc 1 step \d+ loss ([0-9.]+)", all_logs)]
+    assert len(losses0) == crash_at and losses1, (losses0, losses1)
+    assert losses1[0] < losses0[0] * 0.9
+    assert losses1[0] < losses0[-1] * 1.5
+    assert losses1[-1] < losses0[0] * 0.2  # kept converging after resume
+
+
+def test_restart_guard_shield_defers_save(monkeypatch):
+    """A SIGTERM landing inside a shield() span must defer the checkpoint
+    to the span exit (consistent state), not save mid-update; outside a
+    span it saves immediately."""
+    from paddle_tpu.distributed import elastic
+
+    events = []
+    monkeypatch.setattr(elastic.os, "_exit",
+                        lambda code: events.append(("exit", code)))
+
+    g = elastic.RestartGuard(lambda: events.append(("save",)), exit_code=5)
+    with g.shield():
+        g._handler(15, None)          # landed mid-update: deferred
+        assert events == []           # nothing saved inside the span
+    assert events == [("save",), ("exit", 5)]
+
+    events.clear()
+    g2 = elastic.RestartGuard(lambda: events.append(("save",)), exit_code=5)
+    g2._handler(15, None)             # between spans: immediate
+    assert events == [("save",), ("exit", 5)]
