@@ -25,6 +25,7 @@ from .api import (
 )
 from .collective import (
     ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    reduce, gather,
     reduce_scatter, all_to_all, broadcast, scatter, barrier, send, recv,
     psum, pmean, ppermute, axis_index,
 )

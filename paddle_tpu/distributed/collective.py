@@ -237,6 +237,22 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return result
 
 
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """paddle.distributed.reduce parity (ops.yaml ``reduce``): the reduced
+    value lands on rank ``dst``. Under the single-controller facade the
+    reduction is computed as an all_reduce — every rank observes the
+    result, a strict superset of the reference contract (which leaves
+    non-dst buffers undefined after the call)."""
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """paddle.distributed.gather parity: rank ``dst`` receives every
+    rank's shard (single-controller: the list is filled wherever the
+    caller runs, mirroring all_gather's materialization)."""
+    return all_gather(gather_list, tensor, group=group, sync_op=sync_op)
+
+
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     """Gather shards along the group axis. ``tensor`` is the global sharded
     array; the list receives one tensor per rank position."""

@@ -50,9 +50,21 @@ from ..tensor_class import Parameter
 
 
 def __getattr__(name):
-    if name == "utils":
-        from . import utils as _u
+    # lazy submodule access (paddle.nn.utils / paddle.nn.quant / ...) via
+    # importlib, NOT `from . import x`: the fromlist machinery re-enters
+    # this __getattr__ before the submodule attribute is set, recursing
+    # forever
+    if name.startswith("_"):
+        raise AttributeError(f"module 'paddle_tpu.nn' has no attribute {name!r}")
+    import importlib
 
-        globals()["utils"] = _u
-        return _u
-    raise AttributeError(f"module 'paddle_tpu.nn' has no attribute {name!r}")
+    full = __name__ + "." + name
+    try:
+        mod = importlib.import_module(full)
+    except ImportError as e:
+        if e.name != full:
+            raise  # a REAL dependency failure inside an existing submodule
+        raise AttributeError(
+            f"module 'paddle_tpu.nn' has no attribute {name!r}") from None
+    globals()[name] = mod
+    return mod

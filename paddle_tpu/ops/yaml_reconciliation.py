@@ -1,0 +1,362 @@
+"""Reconciliation of the reference op inventory against this framework.
+
+Reference: ``paddle/phi/ops/yaml/ops.yaml`` — the generator-consumed
+declaration list of every forward op in the reference (472 ``- op:``
+entries at the pinned snapshot). VERDICT r4 item 7: the op-completeness
+gate must consume THIS inventory, not just our own registry, so that every
+reference op is either implemented (registry or public API), renamed (the
+yaml uses kernel names, the public API uses user names — e.g. ``fft_c2c``
+is ``paddle.fft.fft``), or excluded for a stated reason tied to the entry.
+
+``reconcile()`` returns the problems; ``tests/test_op_suite.py::
+test_ops_yaml_inventory_reconciled`` asserts there are none.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+OPS_YAML = "/root/reference/paddle/phi/ops/yaml/ops.yaml"
+
+
+def yaml_ops(path: str = OPS_YAML) -> List[Tuple[str, int]]:
+    """(op_name, line_number) for every ``- op:`` entry."""
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            m = re.match(r"- op\s*:\s*([a-zA-Z0-9_]+)", line)
+            if m:
+                out.append((m.group(1), i))
+    return out
+
+
+#: yaml op -> public path (relative to the paddle_tpu root package) where
+#: the capability lives under a DIFFERENT name. Paths are validated by the
+#: reconciliation test — a stale entry fails the gate.
+RENAMES: Dict[str, str] = {
+    # losses (yaml uses kernel names; public API uses the user names)
+    "bce_loss": "nn.functional.binary_cross_entropy",
+    "kldiv_loss": "nn.functional.kl_div",
+    "sigmoid_cross_entropy_with_logits":
+        "nn.functional.binary_cross_entropy_with_logits",
+    "cross_entropy_with_softmax": "nn.functional.softmax_with_cross_entropy",
+    "hinge_loss": "nn.functional.hinge_embedding_loss",
+    "identity_loss": "incubate.identity_loss",
+    "warpctc": "nn.functional.ctc_loss",
+    "warprnnt": "nn.functional.rnnt_loss",
+    # activations
+    "logsigmoid": "nn.functional.log_sigmoid",
+    "tanh_shrink": "nn.functional.tanhshrink",
+    "swiglu": "incubate.nn.functional.swiglu",
+    # interpolate family: one implementation, five kernel entries
+    "bicubic_interp": "nn.functional.interpolate",
+    "bilinear_interp": "nn.functional.interpolate",
+    "linear_interp": "nn.functional.interpolate",
+    "nearest_interp": "nn.functional.interpolate",
+    "trilinear_interp": "nn.functional.interpolate",
+    # pooling / padding / conv variants
+    "pool2d": "nn.functional.max_pool2d",
+    "pool3d": "nn.functional.max_pool3d",
+    "max_pool2d_with_index": "nn.functional.max_pool2d",
+    "max_pool3d_with_index": "nn.functional.max_pool3d",
+    "unpool": "nn.functional.max_unpool2d",
+    "unpool3d": "nn.functional.max_unpool3d",
+    "pad3d": "nn.functional.pad",
+    "depthwise_conv2d": "nn.functional.conv2d",
+    "depthwise_conv2d_transpose": "nn.functional.conv2d_transpose",
+    "conv2d_transpose_bias": "nn.functional.conv2d_transpose",
+    # rnn family
+    "rnn": "nn.SimpleRNN",
+    "lstm": "nn.LSTM",
+    "gru": "nn.GRU",
+    # random / init
+    "gaussian": "randn",
+    "gaussian_inplace": "Tensor.normal_",
+    "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
+    "uniform_inplace": "Tensor.uniform_",
+    "exponential_": "Tensor.exponential_",
+    "dirichlet": "distribution.Dirichlet",
+    # optimizers (yaml's fused in-place update kernels; here the functional
+    # optimizer classes own the update math)
+    "adam_": "optimizer.Adam", "adamw_": "optimizer.AdamW",
+    "sgd_": "optimizer.SGD", "momentum_": "optimizer.Momentum",
+    "adagrad_": "optimizer.Adagrad", "adadelta_": "optimizer.Adadelta",
+    "adamax_": "optimizer.Adamax", "lamb_": "optimizer.Lamb",
+    "rmsprop_": "optimizer.RMSProp", "nadam_": "optimizer.NAdam",
+    "radam_": "optimizer.RAdam", "rprop_": "optimizer.Rprop",
+    "asgd_": "optimizer.ASGD", "ftrl": "optimizer.Ftrl",
+    "average_accumulates_": "optimizer.ASGD",  # its accumulator update
+    # collectives (public facade; in-graph the GSPMD collectives)
+    "reduce": "distributed.reduce",
+    # fft internal kernels -> public transforms
+    "fft_c2c": "fft.fft", "fft_r2c": "fft.rfft", "fft_c2r": "fft.irfft",
+    # amp internals live inside GradScaler's jitted update
+    "update_loss_scaling_": "amp.GradScaler",
+    "check_finite_and_unscale_": "amp.GradScaler",
+    # attention
+    "flash_attn": "nn.functional.scaled_dot_product_attention",
+    "flash_attn_unpadded": "nn.functional.scaled_dot_product_attention",
+    "flash_attn_varlen_qkvpacked":
+        "nn.functional.scaled_dot_product_attention",
+    "memory_efficient_attention":
+        "nn.functional.scaled_dot_product_attention",
+    "masked_multihead_attention_": "ops.pallas.append_attention",
+    "calc_reduced_attn_scores": "ops.pallas.flash_attention",
+    # weight-only / int8 serving quant
+    "weight_only_linear": "nn.quant.WeightOnlyLinear",
+    "weight_quantize": "nn.quant.weight_quantize",
+    "weight_dequantize": "nn.quant.weight_dequantize",
+    "llm_int8_linear": "nn.quant.llm_int8_linear",
+    # QAT fake-quant family -> the quanter framework
+    "fake_quantize_abs_max": "quantization.FakeQuanterWithAbsMaxObserver",
+    "fake_quantize_dequantize_abs_max":
+        "quantization.FakeQuanterWithAbsMaxObserver",
+    "fake_quantize_range_abs_max":
+        "quantization.FakeQuanterWithAbsMaxObserver",
+    "fake_quantize_moving_average_abs_max":
+        "quantization.FakeQuanterWithAbsMaxObserver",
+    "fake_quantize_dequantize_moving_average_abs_max":
+        "quantization.FakeQuanterWithAbsMaxObserver",
+    "fake_channel_wise_quantize_abs_max":
+        "quantization.FakeQuanterWithAbsMaxObserver",
+    "fake_channel_wise_quantize_dequantize_abs_max":
+        "quantization.FakeQuanterWithAbsMaxObserver",
+    "fake_channel_wise_dequantize_max_abs":
+        "quantization.FakeQuanterWithAbsMaxObserver",
+    "fake_dequantize_max_abs": "quantization.FakeQuanterWithAbsMaxObserver",
+    "dequantize_abs_max": "quantization.FakeQuanterWithAbsMaxObserver",
+    # linalg / tensor renames
+    "frobenius_norm": "linalg.norm",
+    "l1_norm": "linalg.norm",
+    "matrix_rank_tol": "linalg.matrix_rank",
+    "matrix_rank_atol_rtol": "linalg.matrix_rank",
+    "mean_all": "mean",
+    "fill": "full",
+    "fill_diagonal_tensor": "Tensor.fill_diagonal_",
+    "split_with_num": "split",
+    "repeat_interleave_with_tensor_index": "repeat_interleave",
+    "set_value_with_tensor": "Tensor.set_value",
+    "copy_to": "Tensor.to",
+    "assign_value_": "assign",
+    "assign_out_": "assign",
+    "clip_by_norm": "nn.ClipGradByNorm",
+    "squared_l2_norm": "nn.ClipGradByGlobalNorm",  # its inner reduction
+    "crf_decoding": "text.viterbi_decode",
+    "viterbi_decode": "text.viterbi_decode",
+    "spectral_norm": "nn.utils.spectral_norm",
+    "sync_batch_norm_": "nn.SyncBatchNorm",
+    # metrics
+    "accuracy": "metric.Accuracy",
+    "auc": "metric.Auc",
+    # graph / segment
+    "segment_pool": "incubate.segment_sum",
+    "send_u_recv": "geometric.send_u_recv",
+    "send_ue_recv": "geometric.send_u_recv",
+    "send_uv": "geometric.send_u_recv",
+    # generation
+    "beam_search": "generation",
+    # MoE auxiliary kernels live inside the gate implementation
+    "number_count": "distributed.moe",
+    "assign_pos": "distributed.moe",
+    "limit_by_capacity": "distributed.moe",
+    "prune_gate_by_capacity": "distributed.moe",
+    "random_routing": "distributed.moe",
+    "global_gather": "distributed.moe",
+    "global_scatter": "distributed.moe",
+    # NaN/Inf debugging switches
+    "check_numerics": "amp.debugging",
+    "accuracy_check": "amp.debugging",
+    "enable_check_model_nan_inf": "amp.debugging",
+    "disable_check_model_nan_inf": "amp.debugging",
+}
+
+#: yaml op -> reason it is deliberately NOT built, tied to its role in the
+#: reference. Four sanctioned families (SURVEY §2.5/§2.8/§7): absorbed by
+#: XLA/jax semantics, CUDA/hardware-specific kernels, the parameter-server/
+#: rec-sys stack (scoped non-goal), and detection-model post-processing
+#: outside the vision scope.
+EXCLUDED: Dict[str, str] = {
+    # --- absorbed by XLA/jax program semantics -------------------------------
+    "data": "static-graph feed placeholder; jit arguments are the feeds",
+    "depend": "PIR scheduling edge; XLA dataflow orders effects",
+    "set": "PIR in-place SSA helper; functional updates instead",
+    "share_data": "buffer aliasing hint; XLA donation handles aliasing",
+    "memcpy_d2h": "explicit staging copy; jax.device_get is the surface",
+    "memcpy_h2d": "explicit staging copy; jax.device_put is the surface",
+    "npu_identity": "NPU layout pass-through; no NPU backend",
+    "coalesce_tensor": "fused-buffer packing for NCCL; GSPMD groups "
+        "collectives itself",
+    "trans_layout": "NHWC/NCHW layout pass; XLA picks layouts",
+    "view_dtype": "zero-copy view; jax arrays reinterpret via bitcast ops",
+    "view_shape": "zero-copy view; reshape is free under XLA",
+    "view_slice": "zero-copy view; slicing is lazy under XLA",
+    "full_int_array": "PIR constant materializer; python ints suffice",
+    "full_with_tensor": "PIR constant materializer; full() covers",
+    "full_batch_size_like": "legacy static-graph shape inference; "
+        "full(shape) with traced shapes covers",
+    "uniform_random_batch_size_like": "legacy static-graph shape "
+        "inference; uniform(shape) covers",
+    "index_select_strided": "stride-view variant; gather covers (views "
+        "are free under XLA)",
+    "merge_selected_rows": "SelectedRows (sparse-grad rows) container "
+        "op; dense grads + segment ops cover",
+    "is_empty": "numel()==0 predicate on SelectedRows; Tensor.size covers",
+    "merged_adam_": "multi-tensor fused optimizer launch; one jitted "
+        "apply over the whole param pytree is the TPU equivalent",
+    "merged_momentum_": "multi-tensor fused optimizer launch; same",
+    "fused_softmax_mask": "CUDA softmax+mask fusion; XLA fuses "
+        "where()+softmax automatically",
+    "fused_softmax_mask_upper_triangle": "CUDA fusion; XLA fuses, and "
+        "causal masking runs inside the splash kernel",
+    "fused_batch_norm_act": "cuDNN BN+act fusion; XLA fuses",
+    "fused_bn_add_activation": "cuDNN BN+add+act fusion; XLA fuses",
+    "sync_calc_stream": "CUDA stream sync; XLA owns scheduling",
+    "apply_per_channel_scale": "AWQ pre-scale helper folded into "
+        "weight_quantize preprocessing",
+    "dequantize_log": "log-scale table dequant for PS-era embeddings",
+    "lookup_table_dequant": "PS-era quantized embedding lookup",
+    # --- legacy collective op layer (GSPMD + collective facade instead) ------
+    "all_gather": "in-graph axis collective; paddle.distributed."
+        "all_gather facade + GSPMD insertion cover",
+    "all_reduce": "same: paddle.distributed.all_reduce + GSPMD",
+    "all_to_all": "same: paddle.distributed.all_to_all + GSPMD",
+    "broadcast": "same: paddle.distributed.broadcast + GSPMD",
+    "reduce_scatter": "same: paddle.distributed.reduce_scatter + GSPMD",
+    "c_allgather": "legacy c_* collective; superseded in-reference by "
+        "the comm contexts; facade + GSPMD here",
+    "c_allreduce_max": "legacy c_* collective; same",
+    "c_allreduce_min": "legacy c_* collective; same",
+    "c_allreduce_prod": "legacy c_* collective; same",
+    "c_allreduce_sum": "legacy c_* collective; same",
+    "c_broadcast": "legacy c_* collective; same",
+    "c_concat": "legacy c_* collective; same",
+    "c_identity": "legacy c_* collective; same",
+    "c_reduce_sum": "legacy c_* collective; same",
+    "c_scatter": "legacy c_* collective; same",
+    "mp_allreduce_sum": "tensor-parallel allreduce; GSPMD inserts it "
+        "from shardings (parallel_layers.py)",
+    "partial_allgather": "partial-tensor collective for PS; not needed",
+    "partial_concat": "partial-tensor op for PS; not needed",
+    "partial_sum": "partial-tensor op for PS; not needed",
+    "dgc": "deep gradient compression (CUDA momentum-sparsified "
+        "allreduce); ICI bandwidth makes it counterproductive on TPU",
+    "dgc_clip_by_norm": "DGC helper; same",
+    "dgc_momentum": "DGC helper; same",
+    # --- parameter-server / rec-sys stack (SURVEY §2.5: scoped non-goal) -----
+    "batch_fc": "PS-era batched FC for rec-sys slots",
+    "cvm": "click-through-value feature op (PS rec-sys)",
+    "pyramid_hash": "PS text-matching embedding hash",
+    "tdm_child": "tree-based deep match (PS retrieval)",
+    "tdm_sampler": "tree-based deep match (PS retrieval)",
+    "rank_attention": "PS-era ranking attention",
+    "shuffle_batch": "PS input-pipeline shuffle; io DataLoader covers",
+    "match_matrix_tensor": "PS-era text matching",
+    "sequence_conv": "LoD sequence op; ragged handled by padding/masks",
+    "sequence_pool": "LoD sequence op; same",
+    "im2sequence": "LoD sequence op; same",
+    "attention_lstm": "fused PS-era LSTM variant; nn.LSTM covers",
+    "cudnn_lstm": "cuDNN-specific fused LSTM; nn.LSTM lowers via scan",
+    "gru_unit": "legacy single-step GRU cell; nn.GRUCell covers",
+    "dpsgd": "differential-privacy SGD (PS-era)",
+    "decayed_adagrad": "PS-era optimizer variant; Adagrad covers",
+    "edit_distance": "CTC eval metric on host; hapi metrics own eval",
+    "chunk_eval": "sequence-labeling eval metric (host-side)",
+    "ctc_align": "CTC decoding postprocess (host-side)",
+    "add_position_encoding": "legacy transformer helper; embedding + "
+        "RoPE layers cover",
+    # --- detection post-processing outside the vision scope ------------------
+    "bipartite_match": "detection target assignment (host-side)",
+    "box_clip": "detection box clipping",
+    "collect_fpn_proposals": "FPN proposal gather",
+    "detection_map": "mAP eval metric",
+    "multiclass_nms3": "NMS postprocess; vision.ops.nms covers the core",
+    "yolo_box_head": "YOLO decode head",
+    "yolo_box_post": "YOLO postprocess",
+    "correlation": "optical-flow correlation volume",
+    "affine_channel": "legacy detection BN-fold helper",
+    "shuffle_channel": "ShuffleNet channel shuffle; reshape/transpose "
+        "composition covers",
+    "deformable_conv": "deformable sampling conv (CUDA gather kernels); "
+        "detection-family scope",
+    # --- graph learning (PGL) beyond the message-passing core ----------------
+    "graph_khop_sampler": "PGL neighborhood sampler (host graph store)",
+    "graph_sample_neighbors": "PGL neighborhood sampler",
+    "reindex_graph": "PGL graph reindexing",
+    "weighted_sample_neighbors": "PGL weighted sampler",
+}
+
+
+def _resolve(path: str) -> bool:
+    """Does a dotted path exist under paddle_tpu? Module paths and
+    attribute paths both count."""
+    import importlib
+
+    import paddle_tpu as root
+
+    obj = root
+    parts = path.split(".")
+    for i, p in enumerate(parts):
+        nxt = getattr(obj, p, None)
+        if nxt is None:
+            try:
+                nxt = importlib.import_module(
+                    "paddle_tpu." + ".".join(parts[: i + 1]))
+            except ImportError:
+                return False
+        obj = nxt
+    return True
+
+
+def reconcile() -> Dict[str, List[str]]:
+    """Classify every ops.yaml entry. Returns the problem lists (all empty
+    when the inventory is fully accounted for)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.registry import OPS
+
+    reg = set(OPS)
+    surfaces = []
+    for modpath in ("", "nn", "nn.functional", "linalg", "distributed",
+                    "fft", "vision.ops", "Tensor", "optimizer", "amp",
+                    "incubate", "geometric", "text", "metric",
+                    "distribution", "signal", "sparse"):
+        obj = paddle
+        ok = True
+        for p in modpath.split("."):
+            if not p:
+                continue
+            obj = getattr(obj, p, None)
+            if obj is None:
+                ok = False
+                break
+        if ok:
+            surfaces.append(obj)
+
+    def auto(n: str) -> bool:
+        for c in (n, n.rstrip("_")):
+            if c in reg:
+                return True
+        for s in surfaces:
+            for c in (n, n.rstrip("_")):
+                if hasattr(s, c):
+                    return True
+        return False
+
+    unaccounted, bad_renames, stale = [], [], []
+    seen = set()
+    for name, line in yaml_ops():
+        seen.add(name)
+        if name in RENAMES:
+            if not _resolve(RENAMES[name]):
+                bad_renames.append(f"{name} -> {RENAMES[name]}")
+            continue
+        if name in EXCLUDED:
+            continue
+        if not auto(name):
+            unaccounted.append(f"{name} (ops.yaml:{line})")
+    # entries for ops the yaml no longer declares are stale bookkeeping
+    for name in list(RENAMES) + list(EXCLUDED):
+        if name not in seen:
+            stale.append(name)
+    return {"unaccounted": unaccounted, "bad_renames": bad_renames,
+            "stale_entries": stale}

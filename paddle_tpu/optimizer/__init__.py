@@ -2,7 +2,7 @@
 from . import lr
 from .optimizer import (
     Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
-    RMSProp, Lamb, Lion, ASGD, RAdam, NAdam, Rprop, LBFGS,
+    RMSProp, Lamb, Lion, ASGD, RAdam, NAdam, Rprop, LBFGS, Ftrl,
 )
 from .clip import ClipGradBase, ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm
 from .lr import LRScheduler

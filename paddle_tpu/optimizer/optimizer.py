@@ -413,6 +413,45 @@ class Lion(Optimizer):
         return arr - lr * update, {"moment": m}
 
 
+class Ftrl(Optimizer):
+    """FTRL-proximal (ops.yaml `ftrl`, phi ftrl_kernel; the PS-era
+    follow-the-regularized-leader optimizer). Accumulators: n (squared
+    grads) and z (linearized loss); the closed-form proximal update:
+
+        sigma = (sqrt(n + g^2) - sqrt(n)) / lr
+        z    += g - sigma * w
+        n    += g^2
+        w     = -(z - sign(z)*l1) / (2*l2 + sqrt(n)/lr)  if |z| > l1 else 0
+
+    (the ``2*l2`` factor matches the reference kernel,
+    paddle/phi/kernels/impl/ftrl_kernel_impl.h; general ``lr_power`` uses
+    ``n^(-lr_power)`` in place of ``sqrt(n)``.)
+    """
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def init_param_state(self, arr):
+        return {"squared": jnp.zeros(arr.shape, jnp.float32),
+                "linear": jnp.zeros(arr.shape, jnp.float32)}
+
+    def update(self, arr, grad, state, lr, step):
+        n, z = state["squared"], state["linear"]
+        n_new = n + grad * grad
+        pow_old = n ** -self._lr_power   # == sqrt(n) at the default -0.5
+        pow_new = n_new ** -self._lr_power
+        sigma = (pow_new - pow_old) / lr
+        z_new = z + grad - sigma * arr
+        denom = 2.0 * self._l2 + pow_new / lr
+        w = jnp.where(jnp.abs(z_new) > self._l1,
+                      -(z_new - jnp.sign(z_new) * self._l1) / denom, 0.0)
+        return w, {"squared": n_new, "linear": z_new}
+
+
 class ASGD(Optimizer):
     """paddle.optimizer.ASGD (python/paddle/optimizer/asgd.py, phi
     asgd_kernel): SGD over the running average of the last ``batch_num``
