@@ -1,5 +1,11 @@
-"""paddle.audio parity (python/paddle/audio/): feature extractors +
-functional window/mel utilities."""
+"""paddle.audio parity (python/paddle/audio/): feature extractors,
+functional window/mel utilities, PCM WAV IO backend, and local-file
+datasets (TESS/ESC50)."""
 from . import functional  # noqa: F401
 from . import backends  # noqa: F401
 from . import features  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import info, load, save  # noqa: F401
+
+__all__ = ["functional", "features", "datasets", "backends",
+           "load", "info", "save"]

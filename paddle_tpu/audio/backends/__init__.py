@@ -1,8 +1,18 @@
-"""paddle.audio.backends parity: wave-backend registry. The in-repo
-backend decodes WAV via the stdlib (no soundfile wheel in the image)."""
+"""paddle.audio.backends parity: wave-backend registry + PCM WAV IO.
+
+Reference: ``python/paddle/audio/backends/`` — backend registry
+(init_backend.py) and the stdlib wave backend's info/load/save
+(wave_backend.py:43,95,174). No soundfile wheel in the image, so the wave
+backend is the only one; the registry surface is kept so reference user
+code runs unchanged.
+"""
 from __future__ import annotations
 
-__all__ = ["get_current_backend", "list_available_backends", "set_backend"]
+from dataclasses import dataclass
+
+__all__ = ["get_current_backend", "get_current_audio_backend",
+           "list_available_backends", "set_backend",
+           "AudioInfo", "info", "load", "save"]
 
 _BACKEND = "wave_backend"
 
@@ -15,13 +25,83 @@ def get_current_backend() -> str:
     return _BACKEND
 
 
+# the reference exposes both spellings across versions
+def get_current_audio_backend() -> str:
+    return _BACKEND
+
+
 def set_backend(backend_name: str):
     global _BACKEND
-    if backend_name not in list_available_backends():
+    if backend_name not in ("wave", "wave_backend"):
         raise NotImplementedError(
             f"audio backend {backend_name!r} is not available (no soundfile "
             "in the TPU image); available: ['wave_backend']")
-    _BACKEND = backend_name
+    _BACKEND = "wave_backend"
+
+
+@dataclass
+class AudioInfo:
+    """Metadata of an audio file (backend.py AudioInfo parity)."""
+
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def info(filepath) -> AudioInfo:
+    """Header-only metadata read (wave_backend.py:43)."""
+    import wave
+
+    try:
+        opened = wave.open(str(filepath), "rb")
+    except wave.Error as e:
+        raise NotImplementedError(
+            f"the wave backend decodes PCM WAV only ({e}); no soundfile "
+            "wheel is available in this image") from None
+    with opened as w:
+        return AudioInfo(sample_rate=w.getframerate(),
+                         num_samples=w.getnframes(),
+                         num_channels=w.getnchannels(),
+                         bits_per_sample=8 * w.getsampwidth())
+
+
+def save(filepath, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16):
+    """(Tensor [C, T] or [T, C]) → PCM16 WAV (wave_backend.py:174).
+    Float input is clipped to [-1, 1) and scaled; int16 is written as-is;
+    int32/uint8 PCM scales (load's ``normalize=False`` outputs) are
+    rescaled to 16-bit — a plain astype would wrap them into garbage."""
+    import wave
+
+    import numpy as np
+
+    if encoding != "PCM_16" or bits_per_sample != 16:
+        raise NotImplementedError(
+            "the wave backend writes PCM_16 only "
+            f"(got encoding={encoding!r}, bits={bits_per_sample})")
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if arr.ndim == 1:
+        arr = arr[None, :] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T                      # → [T, C]
+    if np.issubdtype(arr.dtype, np.floating):
+        arr = np.round(np.clip(arr, -1.0, 1.0 - 1.0 / 32768.0) * 32768.0)
+    elif arr.dtype == np.int32:
+        arr = arr >> 16                  # 32-bit PCM scale → 16-bit
+    elif arr.dtype == np.uint8:
+        arr = (arr.astype(np.int32) - 128) << 8   # 8-bit unsigned, offset
+    elif arr.dtype != np.int16:
+        raise TypeError(
+            f"save() accepts float, int16, int32 or uint8 PCM data, got "
+            f"{arr.dtype}")
+    pcm = np.ascontiguousarray(arr.astype(np.int16))
+    with wave.open(str(filepath), "wb") as w:
+        w.setnchannels(pcm.shape[1])
+        w.setsampwidth(2)
+        w.setframerate(int(sample_rate))
+        w.writeframes(pcm.tobytes())
 
 
 def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
@@ -35,7 +115,15 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
 
     from ...tensor_class import wrap
 
-    with wave.open(str(filepath), "rb") as w:
+    try:
+        opened = wave.open(str(filepath), "rb")
+    except wave.Error as e:
+        # the reference maps undecodable inputs to NotImplementedError with
+        # backend guidance (wave_backend.py _error_message)
+        raise NotImplementedError(
+            f"the wave backend decodes PCM WAV only ({e}); no soundfile "
+            "wheel is available in this image") from None
+    with opened as w:
         sr = w.getframerate()
         n = w.getnframes()
         w.setpos(min(frame_offset, n))

@@ -1,0 +1,126 @@
+"""Audio classification datasets over LOCAL files.
+
+Reference parity: ``python/paddle/audio/datasets/`` — ``TESS``
+(emotion-labeled speech, labels encoded in the file name) and ``ESC50``
+(environmental sounds, labels in ``meta/esc50.csv``), both returning
+(feature, label) pairs where the feature is the raw waveform or a
+spectrogram-family transform (``feat_type``).
+
+No-egress environment: the reference's auto-download is replaced by a
+``root`` pointing at an existing extraction; a missing layout raises with
+the expected structure in the message (the vision datasets follow the
+same local-first convention).
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+from ..io import Dataset
+from . import backends, features
+
+_FEATS = ("raw", "spectrogram", "melspectrogram", "logmelspectrogram",
+          "mfcc")
+
+
+def _check_mode(mode: str):
+    if mode not in ("train", "dev"):
+        raise ValueError(f"mode must be 'train' or 'dev', got {mode!r}")
+
+
+class AudioClassificationDataset(Dataset):
+    """files + integer labels → (feature, label) (datasets/dataset.py)."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: int = 16000,
+                 **feat_kwargs):
+        if feat_type not in _FEATS:
+            raise ValueError(
+                f"feat_type must be one of {_FEATS}, got {feat_type!r}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self._extractor = None
+        if feat_type != "raw":
+            cls = {"spectrogram": features.Spectrogram,
+                   "melspectrogram": features.MelSpectrogram,
+                   "logmelspectrogram": features.LogMelSpectrogram,
+                   "mfcc": features.MFCC}[feat_type]
+            if feat_type != "spectrogram":
+                feat_kwargs.setdefault("sr", sample_rate)
+            self._extractor = cls(**feat_kwargs)
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        waveform, _ = backends.load(self.files[idx])
+        if self._extractor is not None:
+            waveform = self._extractor(waveform)
+        return waveform, self.labels[idx]
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto Emotional Speech Set (datasets/tess.py): 7 emotions encoded
+    as the last underscore token of each WAV file name."""
+
+    labels_list = ["angry", "disgust", "fear", "happy", "neutral",
+                   "ps", "sad"]
+
+    def __init__(self, root: str, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw", **kwargs):
+        _check_mode(mode)
+        if not (1 <= split <= n_folds):
+            raise ValueError(f"split must be in [1, {n_folds}], got {split}")
+        wavs: List[str] = []
+        for dirpath, _, names in os.walk(root):
+            wavs.extend(os.path.join(dirpath, n) for n in names
+                        if n.lower().endswith(".wav"))
+        if not wavs:
+            raise RuntimeError(
+                f"no TESS .wav files under {root!r}; expected the extracted "
+                "dataset (…/OAF_back_angry.wav etc.). Auto-download is not "
+                "available in this build — place the files locally.")
+        wavs.sort()
+        files, labels = [], []
+        for i, path in enumerate(wavs):
+            emotion = os.path.splitext(os.path.basename(path))[0] \
+                .split("_")[-1].lower()
+            if emotion not in self.labels_list:
+                continue
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(path)
+                labels.append(self.labels_list.index(emotion))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (datasets/esc50.py): 50 classes, the
+    5-fold split and targets live in ``meta/esc50.csv``."""
+
+    def __init__(self, root: str, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", **kwargs):
+        _check_mode(mode)
+        if not (1 <= split <= 5):  # ESC-50 ships exactly 5 folds
+            raise ValueError(f"split must be in [1, 5], got {split}")
+        meta = os.path.join(root, "meta", "esc50.csv")
+        audio_dir = os.path.join(root, "audio")
+        if not os.path.exists(meta):
+            raise RuntimeError(
+                f"ESC-50 metadata not found at {meta!r}; expected the "
+                "extracted dataset layout (audio/*.wav + meta/esc50.csv). "
+                "Auto-download is not available in this build.")
+        files, labels = [], []
+        with open(meta) as f:
+            for row in csv.DictReader(f):
+                fold = int(row["fold"])
+                keep = (fold != split) if mode == "train" else (fold == split)
+                if keep:
+                    files.append(os.path.join(audio_dir, row["filename"]))
+                    labels.append(int(row["target"]))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)

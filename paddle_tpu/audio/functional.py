@@ -71,6 +71,27 @@ def mel_to_hz(mel, htk: bool = False):
     return float(out) if np.isscalar(mel) else out
 
 
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    """functional.py:126 mel_frequencies parity: n_mels frequencies evenly
+    spaced on the mel scale between f_min and f_max, returned in Hz."""
+    import paddle_tpu as paddle
+
+    lo, hi = hz_to_mel(float(f_min), htk), hz_to_mel(float(f_max), htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return paddle.to_tensor(mel_to_hz(mels, htk).astype(np.dtype(dtype)))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    """functional.py:166 fft_frequencies parity: center frequencies of the
+    rfft bins — linspace(0, sr/2, 1 + n_fft//2)."""
+    import paddle_tpu as paddle
+
+    return paddle.to_tensor(
+        np.linspace(0, sr / 2, 1 + n_fft // 2).astype(np.dtype(dtype)))
+
+
 def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64, f_min: float = 0.0,
                          f_max=None, htk: bool = False, norm: str = "slaney",
                          dtype: str = "float32"):

@@ -1,0 +1,178 @@
+"""Audio IO backend + datasets (ref python/paddle/audio/backends/,
+datasets/): PCM16 WAV roundtrip, metadata, slicing, registry, and the
+TESS/ESC50 local-file datasets."""
+import csv
+import os
+import wave
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.audio as audio
+
+
+def _sine(sr=16000, seconds=0.1, freq=440.0, channels=1):
+    t = np.arange(int(sr * seconds)) / sr
+    w = 0.4 * np.sin(2 * np.pi * freq * t).astype(np.float32)
+    return np.tile(w, (channels, 1))  # [C, T]
+
+
+class TestWaveBackend:
+    def test_save_load_roundtrip(self, tmp_path):
+        sr = 16000
+        w = _sine(sr, channels=2)
+        path = str(tmp_path / "x.wav")
+        audio.save(path, paddle.to_tensor(w), sr)
+        back, sr2 = audio.load(path)
+        assert sr2 == sr
+        assert back.shape == list(w.shape)
+        np.testing.assert_allclose(back.numpy(), w, atol=1.0 / 32000)
+
+    def test_info(self, tmp_path):
+        path = str(tmp_path / "i.wav")
+        audio.save(path, paddle.to_tensor(_sine(8000)), 8000)
+        meta = audio.info(path)
+        assert meta.sample_rate == 8000
+        assert meta.num_channels == 1
+        assert meta.num_samples == 800
+        assert meta.bits_per_sample == 16
+
+    def test_frame_slicing_and_channels_last(self, tmp_path):
+        sr = 8000
+        w = _sine(sr)
+        path = str(tmp_path / "s.wav")
+        audio.save(path, paddle.to_tensor(w), sr)
+        part, _ = audio.load(path, frame_offset=100, num_frames=50)
+        assert part.shape == [1, 50]
+        np.testing.assert_allclose(part.numpy()[0], w[0, 100:150],
+                                   atol=1.0 / 32000)
+        tc, _ = audio.load(path, channels_first=False)
+        assert tc.shape == [w.shape[1], 1]
+
+    def test_unnormalized_is_int16_scale(self, tmp_path):
+        path = str(tmp_path / "u.wav")
+        audio.save(path, paddle.to_tensor(_sine(8000)), 8000)
+        raw, _ = audio.load(path, normalize=False)
+        assert np.abs(raw.numpy()).max() > 1000  # int16 magnitude
+
+    def test_non_wav_raises(self, tmp_path):
+        bad = tmp_path / "not.wav"
+        bad.write_bytes(b"definitely not RIFF data")
+        with pytest.raises(NotImplementedError):
+            audio.load(str(bad))
+
+    def test_backend_registry(self):
+        assert audio.backends.list_available_backends() == ["wave_backend"]
+        assert audio.backends.get_current_audio_backend() == "wave_backend"
+        audio.backends.set_backend("wave")  # both spellings accepted
+        with pytest.raises(NotImplementedError):
+            audio.backends.set_backend("soundfile")
+
+
+class TestAudioDatasets:
+    def _make_tess(self, root):
+        emotions = ["angry", "happy", "sad", "neutral"]
+        for i, emo in enumerate(emotions * 3):
+            path = os.path.join(root, f"OAF_word{i}_{emo}.wav")
+            audio.save(path, paddle.to_tensor(_sine(8000, 0.02)), 8000)
+        return emotions
+
+    def test_tess_split_and_labels(self, tmp_path):
+        from paddle_tpu.audio.datasets import TESS
+
+        self._make_tess(str(tmp_path))
+        train = TESS(str(tmp_path), mode="train", n_folds=4, split=1)
+        dev = TESS(str(tmp_path), mode="dev", n_folds=4, split=1)
+        assert len(train) + len(dev) == 12
+        assert len(dev) == 3
+        w, label = train[0]
+        assert w.shape[0] == 1 and 0 <= label < len(TESS.labels_list)
+
+    def test_tess_feature_mode(self, tmp_path):
+        from paddle_tpu.audio.datasets import TESS
+
+        self._make_tess(str(tmp_path))
+        ds = TESS(str(tmp_path), mode="train", feat_type="melspectrogram",
+                  sample_rate=8000, n_fft=128, n_mels=8)
+        feat, _ = ds[0]
+        assert feat.shape[-2] == 8  # mel bins
+
+    def test_tess_missing_root_raises(self, tmp_path):
+        from paddle_tpu.audio.datasets import TESS
+
+        with pytest.raises(RuntimeError, match="no TESS"):
+            TESS(str(tmp_path / "empty"))
+
+    def test_esc50_meta_layout(self, tmp_path):
+        from paddle_tpu.audio.datasets import ESC50
+
+        os.makedirs(tmp_path / "audio")
+        os.makedirs(tmp_path / "meta")
+        rows = []
+        for i in range(10):
+            name = f"clip{i}.wav"
+            audio.save(str(tmp_path / "audio" / name),
+                       paddle.to_tensor(_sine(8000, 0.02)), 8000)
+            rows.append({"filename": name, "fold": i % 5 + 1,
+                         "target": i % 3})
+        with open(tmp_path / "meta" / "esc50.csv", "w", newline="") as f:
+            wr = csv.DictWriter(f, fieldnames=["filename", "fold", "target"])
+            wr.writeheader()
+            wr.writerows(rows)
+        train = ESC50(str(tmp_path), mode="train", split=1)
+        dev = ESC50(str(tmp_path), mode="dev", split=1)
+        assert len(train) == 8 and len(dev) == 2
+        w, label = dev[0]
+        assert w.shape[0] == 1 and label in (0, 1, 2)
+
+    def test_esc50_missing_meta_raises(self, tmp_path):
+        from paddle_tpu.audio.datasets import ESC50
+
+        with pytest.raises(RuntimeError, match="metadata"):
+            ESC50(str(tmp_path))
+
+
+def test_mel_and_fft_frequencies():
+    """functional.py:126,166 parity: endpoint + monotonicity + the rfft
+    bin grid."""
+    import paddle_tpu.audio.functional as AF
+
+    freqs = AF.mel_frequencies(n_mels=16, f_min=100.0, f_max=4000.0)
+    f = freqs.numpy()
+    assert f.shape == (16,)
+    np.testing.assert_allclose(f[0], 100.0, rtol=1e-5)
+    np.testing.assert_allclose(f[-1], 4000.0, rtol=1e-5)
+    assert np.all(np.diff(f) > 0)
+    grid = AF.fft_frequencies(sr=16000, n_fft=512).numpy()
+    assert grid.shape == (257,)
+    np.testing.assert_allclose(grid[-1], 8000.0)
+    np.testing.assert_allclose(grid[1], 16000 / 512)
+
+
+def test_save_integer_scales_and_validation(tmp_path):
+    """Review regressions: int32/uint8 PCM rescale instead of wrapping;
+    bad integer dtypes and bad dataset modes/splits fail loudly."""
+    sr = 8000
+    w = _sine(sr)
+    p16 = str(tmp_path / "a.wav")
+    audio.save(p16, paddle.to_tensor(w), sr)
+    raw16, _ = audio.load(p16, normalize=False)       # int16-scale values
+    p2 = str(tmp_path / "b.wav")
+    audio.save(p2, np.asarray(raw16.numpy(), np.int32) << 16, sr)  # 32-bit scale
+    back, _ = audio.load(p2)
+    np.testing.assert_allclose(back.numpy(), w, atol=1.0 / 32000)
+    with pytest.raises(TypeError):
+        audio.save(str(tmp_path / "c.wav"),
+                   np.zeros((1, 10), np.int64), sr)
+    bad = tmp_path / "not-riff.wav"
+    bad.write_bytes(b"not a wav header")
+    with pytest.raises(NotImplementedError):
+        audio.info(str(bad))  # same exception type as load()
+
+
+    from paddle_tpu.audio.datasets import ESC50, TESS
+    with pytest.raises(ValueError, match="mode"):
+        TESS(str(tmp_path), mode="test")
+    with pytest.raises(ValueError, match="split"):
+        ESC50(str(tmp_path), split=6)

@@ -1092,3 +1092,38 @@ def test_save_inference_model_bakes_current_weights(tmp_path):
     out = pred.run([feed])[0]
     np.testing.assert_allclose(np.asarray(out), np.full((4, 2), 5.0),
                                rtol=1e-6)
+
+
+def test_strings_tokenizer_surface():
+    """strings.py beyond the reference's 4 kernels: the tokenizer-adjacent
+    batch ops (strip/split/regex_replace/…) on host StringTensors."""
+    import numpy as np
+    from paddle_tpu import strings as S
+
+    t = S.StringTensor([["  Hello World  ", "FOO bar"],
+                        ["", "  a  b  c "]])
+    stripped = S.strip(t)
+    assert stripped[0][0] == "Hello World"
+    assert S.lstrip(t)[0][0] == "Hello World  "
+    assert S.rstrip(t)[0][0] == "  Hello World"
+    np.testing.assert_array_equal(S.length(stripped),
+                                  [[11, 7], [0, 7]])  # "a  b  c"
+    toks = S.split(stripped)
+    assert toks[0, 0] == ["Hello", "World"]
+    assert toks[1, 0] == []
+    assert S.join(S.StringTensor(["a", "b", "c"]), "-") == "a-b-c"
+    cat = S.concat(S.StringTensor(["x", "y"]), S.StringTensor(["1", "2"]))
+    assert cat.tolist() == ["x1", "y2"]
+    assert S.concat(S.StringTensor(["x"]), "!").tolist() == ["x!"]
+    rep = S.regex_replace(t, r"\s+", " ")
+    assert rep[1][1] == " a b c "
+    np.testing.assert_array_equal(
+        S.startswith(S.StringTensor(["abc", "bcd"]), "ab"), [True, False])
+    np.testing.assert_array_equal(
+        S.endswith(S.StringTensor(["abc", "bcd"]), "cd"), [False, True])
+    wt = S.whitespace_tokenize(t, lowercase=True)
+    assert wt[0, 0] == ["hello", "world"]
+    # shape-mismatch concat fails loudly
+    import pytest
+    with pytest.raises(ValueError):
+        S.concat(S.StringTensor(["a"]), S.StringTensor(["a", "b"]))
