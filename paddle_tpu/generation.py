@@ -252,7 +252,10 @@ def sample_logits(logits, key, do_sample=False, temperature=1.0,
                   top_k=0, top_p=1.0):
     """Next-token selection from [B, V] logits (pure)."""
     logits = logits.astype(jnp.float32)
-    if not do_sample:
+    # temperature ~ 0 is greedy (matches sample_logits_rows): dividing by
+    # the 1e-6 cap instead would hand near-tied runner-ups real probability
+    if not do_sample or (not isinstance(temperature, jnp.ndarray)
+                         and temperature <= 1e-6):
         return jnp.argmax(logits, axis=-1)
     if temperature != 1.0:
         logits = logits / jnp.maximum(temperature, 1e-6)
@@ -291,7 +294,10 @@ def sample_logits_rows(logits, key, do_sample, temperature, top_k, top_p):
     min_prob = jnp.where(top_p[:, None] >= 1.0, 0.0, min_prob)  # no filter
     x = jnp.where(probs < min_prob, -jnp.inf, x)
     sampled = jax.random.categorical(key, x, axis=-1)
-    return jnp.where(do_sample, sampled, greedy)
+    # temperature ~ 0 means greedy, not a 1e6x logit blow-up (ADVICE r4:
+    # the division guard alone overflowed f32 to inf and degraded
+    # jax.random.categorical)
+    return jnp.where(do_sample & (temperature > 1e-6), sampled, greedy)
 
 
 def top_p_sampling(x, ps, threshold=None, seed=None):
@@ -342,19 +348,44 @@ def _select_penalized(logits_last, seen, key, do_sample, temperature, top_k,
                          temperature=temperature, top_k=top_k, top_p=top_p)
 
 
+class _NgramBan:
+    """Incremental HF NoRepeatNGramLogitsProcessor: per row, a hash of
+    (n-1)-gram prefix -> set of banned completions, updated O(1) per
+    appended token. (ADVICE r4: the previous implementation rescanned the
+    whole history every decode step — O(len^2) host work per token that
+    serialized the loop.)"""
+
+    def __init__(self, histories, n: int):
+        self.n = n
+        self.hist = [list(h) for h in histories]
+        self.maps = [{} for _ in self.hist]
+        for b, h in enumerate(self.hist):
+            for j in range(len(h) - n + 1):
+                self.maps[b].setdefault(tuple(h[j:j + n - 1]),
+                                        set()).add(h[j + n - 1])
+
+    def append(self, b: int, tok: int):
+        h = self.hist[b]
+        h.append(tok)
+        if len(h) >= self.n:
+            self.maps[b].setdefault(tuple(h[-self.n:-1]), set()).add(h[-1])
+
+    def banned(self, vocab: int):
+        """[B, V] mask of tokens that would complete an already-seen
+        n-gram of each row's current suffix."""
+        out = np.zeros((len(self.hist), vocab), bool)
+        for b, h in enumerate(self.hist):
+            if len(h) < self.n - 1 and self.n > 1:
+                continue
+            prefix = tuple(h[-(self.n - 1):]) if self.n > 1 else ()
+            for t in self.maps[b].get(prefix, ()):
+                out[b, t] = True
+        return out
+
+
 def _ngram_banned(histories, n, vocab):
-    """[B, V] mask of tokens that would complete an already-seen n-gram of
-    each row's history (HF NoRepeatNGramLogitsProcessor semantics)."""
-    B = len(histories)
-    banned = np.zeros((B, vocab), bool)
-    for b, hist in enumerate(histories):
-        if len(hist) < n:
-            continue
-        prefix = tuple(hist[-(n - 1):]) if n > 1 else ()
-        for j in range(len(hist) - n + 1):
-            if tuple(hist[j:j + n - 1]) == prefix:
-                banned[b, hist[j + n - 1]] = True
-    return banned
+    """[B, V] mask (one-shot form; the decode loops keep a _NgramBan)."""
+    return _NgramBan(histories, n).banned(vocab)
 
 
 def _select_next(last, seen, key, do_sample, temperature, top_k, top_p,
@@ -915,6 +946,29 @@ def _get_decode_step(model, max_len):
 # generate
 # ---------------------------------------------------------------------------
 
+#: defaults of the decoder-only generate() below — encoder-decoder
+#: families (T5/BART) accept these kwargs when passed AT their default
+#: (callers using the generic signature must not break on explicit
+#: defaults, ADVICE r4) and raise only on a genuinely different value
+GENERATE_DEFAULTS = {
+    "use_cache": True, "paged": False, "page_size": 16,
+    "prefill_chunk_size": None, "repetition_penalty": 1.0,
+    "min_new_tokens": 0, "num_beams": 1, "length_penalty": 1.0,
+    "early_stopping": False, "no_repeat_ngram_size": 0,
+}
+
+
+def reject_non_default_kwargs(family: str, kwargs: dict):
+    """Raise for unsupported generate() kwargs UNLESS the caller passed
+    the shared default value explicitly."""
+    for k, v in kwargs.items():
+        if k in GENERATE_DEFAULTS and v == GENERATE_DEFAULTS[k]:
+            continue
+        raise NotImplementedError(
+            f"{family}.generate does not support {k}={v!r} (decoder-only "
+            "families carry the full strategy surface)")
+
+
 def generate(model, input_ids, max_new_tokens=20, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
              use_cache=True, attention_mask=None, paged=False,
@@ -1092,16 +1146,17 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
         finished = jnp.zeros((B,), bool)
         seen = (_seen_from_prompt(ids, cfg.vocab_size, pad_mask)
                 if rp != 1.0 else None)
-        histories = None
+        tracker = None
         if ngram > 0:
             ids_np = np.asarray(ids)
             lens_np = np.asarray(lengths)
-            histories = [list(ids_np[b, : lens_np[b]]) for b in range(B)]
+            tracker = _NgramBan(
+                [list(ids_np[b, : lens_np[b]]) for b in range(B)], ngram)
         out_tokens = []
         for i in range(max_new_tokens):
             key = _random.next_key()
-            if histories is not None:
-                banned = _ngram_banned(histories, ngram, cfg.vocab_size)
+            if tracker is not None:
+                banned = tracker.banned(cfg.vocab_size)
                 if banned.any():  # skip the transfer on no-op steps
                     last = jnp.where(jnp.asarray(banned), -jnp.inf,
                                      last.astype(jnp.float32))
@@ -1112,9 +1167,9 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
                 finished = finished | (nxt == eos_token_id)
             if seen is not None:
                 seen = seen.at[jnp.arange(B), nxt].set(True)
-            if histories is not None:
+            if tracker is not None:
                 for b, t in enumerate(np.asarray(nxt)):
-                    histories[b].append(int(t))
+                    tracker.append(b, int(t))
             out_tokens.append(nxt.reshape(B, 1).astype(ids.dtype))
             if i == max_new_tokens - 1 or (
                     eos_token_id is not None and bool(finished.all())):
@@ -1131,16 +1186,16 @@ def _generate_no_cache(model, ids, max_new_tokens, do_sample, temperature,
     finished = jnp.zeros((B,), bool)
     seen = (_seen_from_prompt(ids, model.config.vocab_size)
             if rp != 1.0 else None)
-    histories = ([list(np.asarray(ids)[b]) for b in range(B)]
-                 if ngram > 0 else None)
+    tracker = (_NgramBan([list(np.asarray(ids)[b]) for b in range(B)], ngram)
+               if ngram > 0 else None)
     out_tokens = []
     full = ids
     for i in range(max_new_tokens):
         hidden = model.llama(wrap(full))
         last = unwrap(model.lm_head_logits(hidden))[:, -1, :]
         key = _random.next_key()
-        if histories is not None:
-            banned = _ngram_banned(histories, ngram, model.config.vocab_size)
+        if tracker is not None:
+            banned = tracker.banned(model.config.vocab_size)
             if banned.any():
                 last = jnp.where(jnp.asarray(banned), -jnp.inf,
                                  last.astype(jnp.float32))
@@ -1151,9 +1206,9 @@ def _generate_no_cache(model, ids, max_new_tokens, do_sample, temperature,
             finished = finished | (nxt == eos_token_id)
         if seen is not None:
             seen = seen.at[jnp.arange(B), nxt].set(True)
-        if histories is not None:
+        if tracker is not None:
             for b, t in enumerate(np.asarray(nxt)):
-                histories[b].append(int(t))
+                tracker.append(b, int(t))
         out_tokens.append(nxt.reshape(B, 1).astype(ids.dtype))
         full = jnp.concatenate([full, out_tokens[-1]], axis=1)
         if eos_token_id is not None and bool(finished.all()):

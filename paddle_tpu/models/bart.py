@@ -332,9 +332,9 @@ class BartForConditionalGeneration(Layer):
     def generate(self, input_ids, max_new_tokens=20, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  attention_mask=None, **unsupported):
-        for k in unsupported:
-            raise NotImplementedError(
-                f"BART.generate does not support {k!r}")
+        from ..generation import reject_non_default_kwargs
+
+        reject_non_default_kwargs("BART", unsupported)
         from ..autograd import tape as _tape
         from ..framework import random as _random
         from ..generation import _select

@@ -266,6 +266,11 @@ class GPT2Model(Layer):
         return self.ln_f(hidden)
 
     def forward_cached(self, input_ids, kv_caches, rope_len):
+        # positions beyond the wpe table cannot occur here: every caller
+        # bounds its worst-case length against max_position_embeddings
+        # before allocating (generate() entry check, ContinuousBatchEngine
+        # __init__, speculative._prefill) — the ADVICE r4 overflow concern
+        # is closed at those entries, where the lengths are static
         s = input_ids.shape[1]
         rope = self._identity_rope(rope_len)
         hidden = self._embed(input_ids, self._positions(s, kv_caches))

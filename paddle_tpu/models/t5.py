@@ -387,10 +387,9 @@ class T5ForConditionalGeneration(Layer):
                  attention_mask=None, **unsupported):
         """Encoder once, then jitted cached decoder steps from
         decoder_start_token_id; stops when every row emits eos."""
-        for k, v in unsupported.items():
-            raise NotImplementedError(
-                f"T5.generate does not support {k!r} (decoder-only "
-                "families carry the full strategy surface)")
+        from ..generation import reject_non_default_kwargs
+
+        reject_non_default_kwargs("T5", unsupported)
         from ..autograd import tape as _tape
         from ..framework import random as _random
         from ..generation import _select

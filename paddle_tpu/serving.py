@@ -73,6 +73,9 @@ class ContinuousBatchEngine:
         if max_len > cfg.max_position_embeddings:
             raise ValueError(f"max_len {max_len} exceeds "
                              f"max_position_embeddings {cfg.max_position_embeddings}")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature} "
+                             "(0 decodes greedily)")
         self.model = model
         self.max_batch, self.max_len, self.page_size = max_batch, max_len, page_size
         self.eos_token_id = eos_token_id
@@ -127,6 +130,9 @@ class ContinuousBatchEngine:
             raise ValueError(
                 f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds engine max_len {self.max_len}")
+        if temperature is not None and temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature} "
+                             "(0 decodes greedily)")
         sampling = None
         if any(v is not None for v in (do_sample, temperature, top_k, top_p)):
             eng_s, eng_t, eng_k, eng_p = self._sample_cfg

@@ -558,3 +558,110 @@ def test_no_repeat_ngram_no_cache_matches_cached(tiny_model):
     b = tiny_model.generate(x, max_new_tokens=10, no_repeat_ngram_size=2,
                             use_cache=False).numpy()
     np.testing.assert_array_equal(a, b)
+
+
+class TestAdviceRegressions:
+    """ADVICE r4 low-severity items, pinned."""
+
+    def test_zero_temperature_rows_decode_greedily(self):
+        """sample_logits_rows with temperature=0 + do_sample must take the
+        argmax instead of overflowing the 1e6-scaled logits."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.generation import sample_logits_rows
+
+        logits = jnp.asarray([[0.1, 5.0, 0.2], [3.0, 0.0, 0.1]], jnp.float32)
+        out = sample_logits_rows(
+            logits, jax.random.key(0),
+            do_sample=jnp.asarray([True, True]),
+            temperature=jnp.asarray([0.0, 1.0], jnp.float32),
+            top_k=jnp.asarray([0, 0]), top_p=jnp.asarray([1.0, 1.0]))
+        assert int(out[0]) == 1  # greedy despite do_sample
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_engine_rejects_negative_temperature(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.serving import ContinuousBatchEngine
+
+        paddle.seed(0)
+        eng = ContinuousBatchEngine(
+            LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1)),
+            max_batch=2, max_len=32)
+        with pytest.raises(ValueError, match="temperature"):
+            eng.add_request(np.array([1, 2, 3]), 4, do_sample=True,
+                            temperature=-1.0)
+        # temperature=0 with do_sample is legal: it decodes greedily
+        eng.add_request(np.array([1, 2, 3]), 2, do_sample=True,
+                        temperature=0.0)
+        eng.run_until_done()
+
+    def test_gpt2_cached_decode_overflow_raises(self):
+        from paddle_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+        paddle.seed(0)
+        cfg = GPT2Config.tiny(max_position_embeddings=16)
+        m = GPT2LMHeadModel(cfg)
+        ids = paddle.to_tensor(np.ones((1, 10), np.int64))
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            m.generate(ids, max_new_tokens=10)  # 10 + 10 > 16
+
+    def test_t5_generate_accepts_default_kwargs(self):
+        from paddle_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+        paddle.seed(0)
+        m = T5ForConditionalGeneration(T5Config.tiny())
+        ids = paddle.to_tensor(np.ones((1, 6), np.int64))
+        out = m.generate(ids, max_new_tokens=3, num_beams=1, use_cache=True,
+                         repetition_penalty=1.0)  # explicit defaults: OK
+        assert out.shape[0] == 1
+        with pytest.raises(NotImplementedError, match="num_beams=2"):
+            m.generate(ids, max_new_tokens=3, num_beams=2)
+
+    def test_generate_defaults_dict_matches_signature(self):
+        """GENERATE_DEFAULTS is the drift-guard copy of generate()'s
+        defaults — keep them in lockstep."""
+        import inspect
+        from paddle_tpu.generation import GENERATE_DEFAULTS, generate
+
+        sig = inspect.signature(generate)
+        for k, v in GENERATE_DEFAULTS.items():
+            assert sig.parameters[k].default == v, (k, v)
+
+    def test_scalar_path_zero_temperature_greedy(self):
+        """generate(do_sample=True, temperature=0) is deterministic greedy
+        through the SCALAR sampling path too."""
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(9)
+        m = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1))
+        ids = paddle.to_tensor(np.arange(1, 7)[None, :])
+        a = m.generate(ids, max_new_tokens=6, do_sample=True,
+                       temperature=0.0)
+        b = m.generate(ids, max_new_tokens=6, do_sample=True,
+                       temperature=0.0)
+        g = m.generate(ids, max_new_tokens=6, do_sample=False)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        np.testing.assert_array_equal(a.numpy(), g.numpy())
+
+    def test_engine_level_negative_temperature_rejected(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.serving import ContinuousBatchEngine
+
+        paddle.seed(0)
+        with pytest.raises(ValueError, match="temperature"):
+            ContinuousBatchEngine(
+                LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1)),
+                max_batch=2, max_len=32, do_sample=True, temperature=-0.5)
+
+    def test_ngram_tracker_incremental_matches_oneshot(self):
+        from paddle_tpu.generation import _NgramBan, _ngram_banned
+
+        rng = np.random.RandomState(0)
+        hist = [list(rng.randint(0, 7, 25)) for _ in range(3)]
+        n, vocab = 3, 7
+        tracker = _NgramBan([h[:5] for h in hist], n)
+        for b, h in enumerate(hist):
+            for t in h[5:]:
+                tracker.append(b, t)
+        np.testing.assert_array_equal(tracker.banned(vocab),
+                                      _ngram_banned(hist, n, vocab))
