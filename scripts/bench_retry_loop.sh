@@ -46,7 +46,7 @@ run_cfg() {  # $1 = BENCH_CONFIG; extra VAR=val pairs in $2..
 while [ "$(date +%s)" -lt "$deadline" ]; do
   if probe_ok; then
     echo "$(date -Is) tunnel UP" >> "$log"
-    for c in 8b decode serve 1b longctx moe cp; do
+    for c in 8b decode serve 1b longctx moe cp pp; do
       have "$c" && continue
       run_cfg "$c"
       if ! probe_ok; then
