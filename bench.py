@@ -342,6 +342,9 @@ def pp_bench(devs, gen):
                                use_flash_attention=False)
         seq, batch, m, reps = 32, 8, 4, 3
     sched = os.environ.get("BENCH_PP_SCHEDULE", "1F1B")
+    # interleaving needs V > 1 chunks per stage (PipelineParallel validates
+    # at construction); every other schedule runs plain 2-stage
+    vpp = 2 if sched.upper() in ("VPP", "INTERLEAVE", "INTERLEAVED") else None
     ids = np.random.randint(0, cfg.vocab_size, (batch, seq + 1))
     x, y = paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
 
@@ -363,7 +366,9 @@ def pp_bench(devs, gen):
     from paddle_tpu.distributed.pipeline import PipelineParallel
 
     paddle.seed(0)
-    pipe = LlamaForCausalLMPipe(cfg, num_stages=2)
+    pipe = LlamaForCausalLMPipe(
+        cfg, num_stages=2,
+        **({"num_virtual_pipeline_stages": vpp} if vpp else {}))
     pp = PipelineParallel(pipe, accumulate_steps=m, schedule=sched)
     popt = opt.AdamW(3e-4, parameters=pipe.parameters())
     pp.train_batch([x, y], popt)  # compile all stage programs
@@ -385,7 +390,10 @@ def pp_bench(devs, gen):
         "pp_step_ms": round(pp_s * 1000, 1),
         "monolithic_step_ms": round(mono_s * 1000, 1),
         "scheduler_overhead": round(pp_s / mono_s, 3),
-        "config": "pp",
+        # per-schedule record keys: a ZBH1 capture must not mask (or block
+        # re-capture of) the default 1F1B row — same pattern as serve_int8
+        "config": "pp" if sched.upper() == "1F1B"
+                  else f"pp_{sched.lower()}",
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
@@ -621,6 +629,9 @@ def orchestrate():
     cfg_name = os.environ.get("BENCH_CONFIG", "1b")
     if cfg_name == "serve" and os.environ.get("BENCH_SERVE_INT8"):
         cfg_name = "serve_int8"
+    pp_sched = os.environ.get("BENCH_PP_SCHEDULE", "1F1B")
+    if cfg_name == "pp" and pp_sched.upper() != "1F1B":
+        cfg_name = f"pp_{pp_sched.lower()}"
     best = _load_best(cfg_name)
     if best is not None:
         best = dict(best)
