@@ -267,10 +267,21 @@ def _act_fn(activation: str):
     return getattr(jax.nn, activation)
 
 
+def _expert_act(z, activation: str):
+    """Hidden activation of the expert FFN. ``"swiglu"`` reads z as the
+    FUSED gate‖up projection output ([..., 2*hid] — the LLM-expert form:
+    DeepSeekMoE/Qwen2-MoE/ERNIE experts are silu(x@Wg) * (x@Wu) @ Wd);
+    the one definition serves the padded ([E, C, M]) and ragged paths."""
+    if activation == "swiglu":
+        g, u = jnp.split(z, 2, axis=-1)
+        return jax.nn.silu(g) * u
+    return _act_fn(activation)(z)
+
+
 def _grouped_ffn(xe, w1, b1, w2, b2, activation: str):
-    """[E, C, M] grouped two-layer FFN on raw arrays — shared by the Layer
-    forward and the tape-recorded apply() path."""
-    h = _act_fn(activation)(jnp.einsum("ecm,emh->ech", xe, w1) + b1)
+    """[E, C, M] grouped FFN on raw arrays — shared by the Layer forward
+    and the tape-recorded apply() path."""
+    h = _expert_act(jnp.einsum("ecm,emh->ech", xe, w1) + b1, activation)
     return jnp.einsum("ech,ehm->ecm", h, w2) + b2
 
 
@@ -287,13 +298,16 @@ class GroupedMLP(Layer):
         self.num_experts = num_experts
         self.d_model, self.d_hidden = d_model, d_hidden
         self.activation = activation
+        # swiglu experts fuse gate‖up into one [E, M, 2*hid] projection
+        # (one grouped GEMM instead of two)
+        fan1 = d_hidden * (2 if activation == "swiglu" else 1)
         # per-expert fans: the stacked [E, in, out] layout would otherwise be
         # read as conv-style (E*out receptive) by Initializer._fan
         self.w1 = self.create_parameter(
-            [num_experts, d_model, d_hidden],
+            [num_experts, d_model, fan1],
             default_initializer=XavierUniform(fan_in=d_model, fan_out=d_hidden))
         self.b1 = self.create_parameter(
-            [num_experts, 1, d_hidden], default_initializer=Constant(0.0), is_bias=True)
+            [num_experts, 1, fan1], default_initializer=Constant(0.0), is_bias=True)
         self.w2 = self.create_parameter(
             [num_experts, d_hidden, d_model],
             default_initializer=XavierUniform(fan_in=d_hidden, fan_out=d_model))
@@ -326,7 +340,8 @@ class GroupedMLP(Layer):
         w2, b2 = unwrap(self.w2), unwrap(self.b2)
         b1_tok = jnp.repeat(b1[:, 0], gs, axis=0, total_repeat_length=T)
         b2_tok = jnp.repeat(b2[:, 0], gs, axis=0, total_repeat_length=T)
-        h = _act_fn(self.activation)(jax.lax.ragged_dot(xs, w1, gs) + b1_tok)
+        h = _expert_act(jax.lax.ragged_dot(xs, w1, gs) + b1_tok,
+                        self.activation)
         out = jax.lax.ragged_dot(h, w2, gs) + b2_tok
         return wrap(out)
 

@@ -27,6 +27,10 @@ _LAZY = {
     "Qwen2Config": ("qwen2", "Qwen2Config"),
     "Qwen2ForCausalLM": ("qwen2", "Qwen2ForCausalLM"),
     "qwen2_from_hf": ("qwen2", "qwen2_from_hf"),
+    "qwen2_moe": ("qwen2_moe", None),
+    "Qwen2MoeConfig": ("qwen2_moe", "Qwen2MoeConfig"),
+    "Qwen2MoeForCausalLM": ("qwen2_moe", "Qwen2MoeForCausalLM"),
+    "qwen2_moe_from_hf": ("qwen2_moe", "qwen2_moe_from_hf"),
     "mistral": ("mistral", None),
     "MistralConfig": ("mistral", "MistralConfig"),
     "MistralForCausalLM": ("mistral", "MistralForCausalLM"),

@@ -38,6 +38,7 @@ class LlamaMoEConfig(LlamaConfig):
 
     n_routed_experts: int = 8
     n_shared_experts: int = 1
+    shared_expert_gate: bool = False       # Qwen2-MoE sigmoid shared gate
     num_experts_per_tok: int = 2
     moe_intermediate_size: int = 1408      # per-expert FFN width
     first_k_dense_replace: int = 1         # leading dense layers (DeepSeek)
@@ -78,9 +79,12 @@ class MoEMLP(Layer):
             [h, config.n_routed_experts],
             default_initializer=XavierUniform())
         with dtype_guard(config.dtype):  # expert weights in the config dtype
+            # SwiGLU experts (reference parity: DeepSeekMoE/Qwen2-MoE/ERNIE
+            # experts are gate/up/down; the fused gate‖up keeps it one
+            # grouped GEMM) — r5: was a plain 2-matmul silu FFN
             self.experts = GroupedMLP(config.n_routed_experts, h,
                                       config.moe_intermediate_size,
-                                      activation="silu")
+                                      activation="swiglu")
         # expert parallelism: when constructed under a hybrid topology, the
         # expert dim shards over the data axes (the reference's moe group
         # defaults to the dp communicator) and the dispatch einsums become
@@ -95,6 +99,13 @@ class MoEMLP(Layer):
             self.shared_expert = LlamaMLP(shared_cfg)
         else:
             self.shared_expert = None
+        if getattr(config, "shared_expert_gate", False):
+            # Qwen2-MoE: the shared expert's output is scaled by a learned
+            # per-token sigmoid gate (modeling_qwen2_moe shared_expert_gate)
+            self.shared_gate_weight = self.create_parameter(
+                [h, 1], default_initializer=XavierUniform())
+        else:
+            self.shared_gate_weight = None
         self._aux_loss = None
 
     def _ep_constrain(self, arr):
@@ -133,7 +144,7 @@ class MoEMLP(Layer):
             xe = self._ep_constrain(xe)  # all_to_all boundary (EP)
             from ..distributed.moe import _grouped_ffn
 
-            ye = _grouped_ffn(xe, w1, b1, w2, b2, "silu")
+            ye = _grouped_ffn(xe, w1, b1, w2, b2, "swiglu")
             ye = self._ep_constrain(ye)
             out = jnp.einsum("sec,ecm->sm", combine.astype(ye.dtype), ye)
             # Switch-style aux loss on the router distribution
@@ -148,7 +159,17 @@ class MoEMLP(Layer):
                          self.experts.w2, self.experts.b2)
         self._aux_loss = aux
         if self.shared_expert is not None:
-            out = out + self.shared_expert(x)
+            shared = self.shared_expert(x)
+            if self.shared_gate_weight is not None:
+                # through apply(): the eager tape must record the gate so
+                # shared_gate_weight trains outside jit too
+                shared = apply(
+                    "moe_shared_gate",
+                    lambda xx, gw, sh: jax.nn.sigmoid(
+                        xx.astype(jnp.float32) @ gw.astype(jnp.float32)
+                    ).astype(sh.dtype) * sh,
+                    x, self.shared_gate_weight, shared)
+            out = out + shared
         return out
 
 

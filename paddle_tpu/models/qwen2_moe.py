@@ -1,0 +1,198 @@
+"""Qwen2-MoE / Qwen1.5-MoE decoder family.
+
+Role parity: the reference serves Qwen-MoE through PaddleNLP's qwen2_moe
+modeling (BASELINE.json names "Qwen2-MoE EP" as a workload config). The
+architecture is the LlamaMoE machinery specialized three ways: q/k/v
+projection biases (the Qwen2 attention signature), a learned SIGMOID gate
+scaling the shared expert's output (``shared_expert_gate``), and
+no top-k renormalization (``norm_topk_prob=False`` — softmax over all
+experts, top-k weights used as-is). Routed experts are SwiGLU GroupedMLPs
+(fused gate‖up) shardable over the ep axis like every MoE family here.
+
+``qwen2_moe_from_hf`` converts a transformers ``Qwen2MoeForCausalLM``
+(per-expert gate/up/down projections are packed into the grouped [E, …]
+layout; the [E, h] router and [1, h] shared gate transpose to the paddle
+[in, out] convention).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .llama import _hf_to_np
+from .llama_moe import LlamaMoEConfig, LlamaMoEForCausalLM
+
+
+@dataclasses.dataclass
+class Qwen2MoeConfig(LlamaMoEConfig):
+    # Qwen1.5-MoE-A2.7B shape
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    max_position_embeddings: int = 8192
+    rope_theta: float = 1e6
+    attention_bias: bool = True            # Qwen2 q/k/v biases
+    n_routed_experts: int = 60
+    num_experts_per_tok: int = 4
+    moe_intermediate_size: int = 1408
+    n_shared_experts: int = 4              # shared inter 5632 = 4 x 1408
+    shared_expert_gate: bool = True        # sigmoid-gated shared expert
+    norm_topk_prob: bool = False           # HF Qwen2MoeConfig default
+    first_k_dense_replace: int = 0         # every layer is sparse
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=256,
+                    dtype="float32", n_routed_experts=4,
+                    num_experts_per_tok=2, moe_intermediate_size=64,
+                    n_shared_experts=2, first_k_dense_replace=0)
+        base.update(kw)
+        return Qwen2MoeConfig(**base)
+
+
+class Qwen2MoeForCausalLM(LlamaMoEForCausalLM):
+    """Qwen2-MoE causal LM — LlamaMoE decoder with q/k/v biases and the
+    sigmoid shared-expert gate."""
+
+    def __init__(self, config: Qwen2MoeConfig):
+        if not config.attention_bias:
+            raise ValueError("Qwen2-MoE uses attention_bias=True")
+        if not config.shared_expert_gate and config.n_shared_experts > 0:
+            raise ValueError("Qwen2-MoE gates its shared expert "
+                             "(shared_expert_gate=True)")
+        super().__init__(config)
+
+
+def _hf_config_to_qwen2_moe(hf_config, **overrides) -> Qwen2MoeConfig:
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    if get("decoder_sparse_step", 1) != 1 or get("mlp_only_layers", []):
+        raise NotImplementedError(
+            "qwen2_moe_from_hf: mixed sparse/dense layer patterns "
+            "(decoder_sparse_step != 1 or mlp_only_layers) are not "
+            "representable; this build supports uniformly-sparse stacks")
+    shared_inter = get("shared_expert_intermediate_size")
+    moe_inter = get("moe_intermediate_size")
+    if not shared_inter or not moe_inter:
+        raise KeyError(
+            "qwen2_moe_from_hf: config must carry positive "
+            "moe_intermediate_size and shared_expert_intermediate_size "
+            f"(got {moe_inter!r} / {shared_inter!r})")
+    if shared_inter % moe_inter:
+        raise NotImplementedError(
+            f"shared_expert_intermediate_size ({shared_inter}) must be a "
+            f"multiple of moe_intermediate_size ({moe_inter})")
+    kw = dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads"),
+        max_position_embeddings=get("max_position_embeddings"),
+        rms_norm_eps=get("rms_norm_eps", 1e-6),
+        rope_theta=get("rope_theta", 1e6),
+        tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+        n_routed_experts=get("num_experts"),
+        num_experts_per_tok=get("num_experts_per_tok"),
+        moe_intermediate_size=moe_inter,
+        n_shared_experts=shared_inter // moe_inter,
+        norm_topk_prob=bool(get("norm_topk_prob", False)),
+        router_aux_loss_coef=get("router_aux_loss_coef", 0.001),
+    )
+    kw.update(overrides)
+    return Qwen2MoeConfig(**kw)
+
+
+def load_hf_qwen2_moe(model: Qwen2MoeForCausalLM,
+                      hf_state_dict) -> Qwen2MoeForCausalLM:
+    """Pack a transformers Qwen2MoeForCausalLM state dict into the grouped
+    layout: per-expert gate_proj‖up_proj stack into experts.w1
+    [E, h, 2*inter] (down_proj into w2 [E, inter, h]); torch [out, in]
+    weights transpose to [in, out]."""
+    cfg = model.config
+    E, L = cfg.n_routed_experts, cfg.num_hidden_layers
+    mapped, consumed = {}, set()
+
+    def take(hf_key, transpose):
+        if hf_key not in hf_state_dict:
+            raise KeyError(f"load_hf_qwen2_moe: missing {hf_key!r}")
+        consumed.add(hf_key)
+        v = _hf_to_np(hf_state_dict[hf_key])
+        return v.T if transpose else v
+
+    mapped["llama.embed_tokens.weight"] = take("model.embed_tokens.weight",
+                                               False)
+    mapped["llama.norm.weight"] = take("model.norm.weight", False)
+    if model.lm_head is not None:
+        src = ("lm_head.weight" if "lm_head.weight" in hf_state_dict
+               else "model.embed_tokens.weight")
+        mapped["lm_head.weight"] = take(src, True)
+    for i in range(L):
+        hf, ours = f"model.layers.{i}", f"llama.layers.{i}"
+        for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            mapped[f"{ours}.self_attn.{proj}.weight"] = take(
+                f"{hf}.self_attn.{proj}.weight", True)
+        for proj in ("q_proj", "k_proj", "v_proj"):
+            mapped[f"{ours}.self_attn.{proj}.bias"] = take(
+                f"{hf}.self_attn.{proj}.bias", False)
+        mapped[f"{ours}.input_layernorm.weight"] = take(
+            f"{hf}.input_layernorm.weight", False)
+        mapped[f"{ours}.post_attention_layernorm.weight"] = take(
+            f"{hf}.post_attention_layernorm.weight", False)
+        # router: HF [E, h] -> gate_weight [h, E]
+        mapped[f"{ours}.mlp.gate_weight"] = take(f"{hf}.mlp.gate.weight",
+                                                 True)
+        # experts: stack per-expert gate||up into [E, h, 2*inter]
+        w1 = np.stack([
+            np.concatenate(
+                [take(f"{hf}.mlp.experts.{e}.gate_proj.weight", True),
+                 take(f"{hf}.mlp.experts.{e}.up_proj.weight", True)],
+                axis=-1)
+            for e in range(E)])
+        w2 = np.stack([take(f"{hf}.mlp.experts.{e}.down_proj.weight", True)
+                       for e in range(E)])
+        mapped[f"{ours}.mlp.experts.w1"] = w1
+        mapped[f"{ours}.mlp.experts.w2"] = w2
+        mapped[f"{ours}.mlp.experts.b1"] = np.zeros(
+            (E, 1, w1.shape[-1]), np.float32)  # HF experts carry no biases
+        mapped[f"{ours}.mlp.experts.b2"] = np.zeros(
+            (E, 1, cfg.hidden_size), np.float32)
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            mapped[f"{ours}.mlp.shared_expert.{proj}.weight"] = take(
+                f"{hf}.mlp.shared_expert.{proj}.weight", True)
+        # shared gate: HF [1, h] -> [h, 1]
+        mapped[f"{ours}.mlp.shared_gate_weight"] = take(
+            f"{hf}.mlp.shared_expert_gate.weight", True)
+    leftovers = [k for k in hf_state_dict
+                 if k not in consumed and k != "lm_head.weight"
+                 and not k.endswith("rotary_emb.inv_freq")]
+    if leftovers:
+        raise ValueError(
+            f"load_hf_qwen2_moe: checkpoint tensors this model cannot "
+            f"represent: {leftovers[:5]}"
+            f"{'...' if len(leftovers) > 5 else ''}")
+    missing, unexpected = model.set_state_dict(mapped)
+    assert not unexpected, unexpected
+    if missing:
+        raise KeyError(f"load_hf_qwen2_moe: model keys not covered: "
+                       f"{missing[:5]}")
+    return model
+
+
+def qwen2_moe_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a Qwen2MoeForCausalLM from a transformers model (or raw state
+    dict + config)."""
+    if hf_config is None:
+        hf_config = hf_model_or_state.config
+        state = hf_model_or_state.state_dict()
+    else:
+        state = hf_model_or_state
+    cfg = _hf_config_to_qwen2_moe(hf_config, **config_overrides)
+    return load_hf_qwen2_moe(Qwen2MoeForCausalLM(cfg), state)

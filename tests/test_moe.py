@@ -365,7 +365,9 @@ def test_llama_moe_ep_sharded_flagship():
             assert mlp._ep_axes == ("dp",)
             shapes = {s.data.shape
                       for s in mlp.experts.w1._array.addressable_shards}
-            assert shapes == {(1, cfg.hidden_size, cfg.moe_intermediate_size)}
+            # swiglu experts fuse gate||up: 2*moe_intermediate_size wide
+            assert shapes == {(1, cfg.hidden_size,
+                               2 * cfg.moe_intermediate_size)}
             step = parallelize(m, loss_fn, o)
         else:
             step = paddle.jit.train_step(m, loss_fn, o)
