@@ -1,9 +1,9 @@
 """Every causal/seq2seq family in the zoo, built + generating in one run:
 Llama-3 (RoPE GQA), Qwen2 (qkv bias), Mistral (sliding window), GPT-2
-(learned positions), DeepSeekMoE (routed experts), ERNIE-4.5 (MoE
-decoder), T5 (encoder-decoder) — all through the same generate surface,
-then one continuous-batching engine serving three different families'
-requests back to back.
+(learned positions), DeepSeekMoE (routed experts), Qwen2-MoE (sigmoid
+shared gate), ERNIE-4.5 (MoE decoder), T5/BART (encoder-decoder) — all
+through the same generate surface, then one continuous-batching engine
+serving three different families' requests back to back.
 
 Run: JAX_PLATFORMS=cpu python examples/model_families_tour.py
 """
@@ -40,6 +40,8 @@ def main():
             M.GPT2Config.tiny(num_hidden_layers=2, vocab_size=256))),
         ("llama-moe", M.LlamaMoEForCausalLM(
             M.LlamaMoEConfig.tiny_moe(vocab_size=256))),
+        ("qwen2-moe", M.Qwen2MoeForCausalLM(
+            M.Qwen2MoeConfig.tiny(vocab_size=256))),
         ("ernie-4.5", M.Ernie45ForCausalLM(
             M.Ernie45Config.tiny_moe(vocab_size=256))),
         ("t5", M.T5ForConditionalGeneration(M.T5Config.tiny(vocab_size=256))),

@@ -504,6 +504,74 @@ def _rows_match(a, n):
     return hasattr(a, "ndim") and a.ndim >= 1 and a.shape[0] == n
 
 
+class _EncDecBeamStep:
+    """Jitted enc-dec beam unit shared by T5/BART: gather the SELF-cache
+    rows each surviving beam came from (cross caches are identical across
+    a batch's K beams after tiling, so they stay untouched), run one
+    cached decoder step, return next-position log-probs. ``decode`` is the
+    family's cached decoder call:
+    ``decode(model, token, self_caches, cross_caches) ->
+    (hidden, new_self, _)``."""
+
+    def __init__(self, model, decode):
+        from .autograd import tape as _tape
+        from .nn.layer import functional_weights
+
+        def pure(state, token, row_idx, self_caches, cross_caches):
+            n = row_idx.shape[0]
+            take = lambda a: (jnp.take(a, row_idx, axis=0)
+                              if _rows_match(a, n) else a)
+            self_caches = jax.tree.map(take, self_caches)
+            with functional_weights(model, state), _tape.no_grad():
+                hidden, new_self, _ = decode(model, wrap(token),
+                                             self_caches, cross_caches)
+                logits = model.lm_head_logits(hidden)
+            logp = jax.nn.log_softmax(
+                unwrap(logits)[:, -1, :].astype(jnp.float32), axis=-1)
+            return logp, [
+                {k: (unwrap(v) if isinstance(v, Tensor) else v)
+                 for k, v in c.items()} for c in new_self]
+
+        self._jitted = jax.jit(pure, donate_argnums=(3,))
+        self._state = dict(model.functional_state())
+
+    def __call__(self, token, row_idx, self_caches, cross_caches):
+        return self._jitted(self._state, token, row_idx, self_caches,
+                            cross_caches)
+
+
+def encdec_beam_generate(model, decode, step0, token0, self_c, cross_c,
+                         max_new_tokens, num_beams, eos_token_id,
+                         length_penalty, early_stopping, cache_attr):
+    """Beam search over a cached enc-dec decoder (T5/BART ``num_beams``):
+    one plain cached step on the B rows scores the first position, caches
+    tile to B*K rows, and the jitted _EncDecBeamStep reorders self caches
+    by beam origin each subsequent step. Returns the padded [B, width]
+    token Tensor (HF generate semantics, like the decoder-only path)."""
+    import numpy as np
+
+    B, K = token0.shape[0], num_beams
+    logits, self_c = step0(token0, self_c, cross_c)
+    logp0 = np.asarray(jax.nn.log_softmax(
+        logits[:, -1, :].astype(jnp.float32), axis=-1))
+    tile = lambda t: jax.tree.map(
+        lambda a: jnp.repeat(a, K, axis=0) if _rows_match(a, B) else a, t)
+    self_c, cross_c = tile(self_c), tile(cross_c)
+    bstep = _memoized_step(model, cache_attr, (),
+                           lambda: _EncDecBeamStep(model, decode))
+    holder = {"self": self_c}
+
+    def step(token, row_idx):
+        logp, holder["self"] = bstep(token.astype(jnp.int32),
+                                     jnp.asarray(row_idx), holder["self"],
+                                     cross_c)
+        return np.asarray(logp)
+
+    arr = beam_search_loop(logp0, step, max_new_tokens, K, eos_token_id,
+                           length_penalty, early_stopping)
+    return wrap(jnp.asarray(arr))
+
+
 class _BeamStep:
     """Beam-search decode unit, ONE jitted dispatch per step: gather the
     cache rows each surviving beam came from (beam reordering), run the
@@ -569,15 +637,36 @@ def _beam_search(model, last, caches, max_len, max_new_tokens,
     import numpy as np
 
     B = last.shape[0]
-    K = num_beams
-    V = last.shape[-1]
-
     caches = jax.tree.map(
-        lambda a: jnp.repeat(a, K, axis=0) if _rows_match(a, B) else a,
-        caches)
+        lambda a: jnp.repeat(a, num_beams, axis=0) if _rows_match(a, B)
+        else a, caches)
+    step_fn = _get_beam_step(model, max_len)
+    holder = {"caches": caches}
 
-    logp0 = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
-    logp0 = np.asarray(jnp.repeat(logp0, K, axis=0)).reshape(B, K, V)
+    def step(token, row_idx):
+        logp, holder["caches"] = step_fn(token, jnp.asarray(row_idx),
+                                         holder["caches"])
+        return np.asarray(logp)
+
+    logp0 = np.asarray(jax.nn.log_softmax(last.astype(jnp.float32), axis=-1))
+    arr = beam_search_loop(logp0, step, max_new_tokens, num_beams,
+                           eos_token_id, length_penalty, early_stopping)
+    return wrap(jnp.asarray(arr))
+
+
+def beam_search_loop(logp0, step, max_new_tokens, num_beams, eos_token_id,
+                     length_penalty, early_stopping):
+    """The host scoring loop of beam search, decoupled from the model: a
+    caller supplies ``logp0`` (np [B, V] log-probs of the first position)
+    and ``step(token [B*K, 1] jnp, row_idx [B*K] np) -> np [B*K, V]``
+    log-probs of the next position, with beam-origin cache reordering the
+    step's own responsibility. Serves the decoder-only path and the
+    encoder-decoder families (T5/BART num_beams). Returns np [B, width]."""
+    import numpy as np
+
+    B, V = logp0.shape
+    K = num_beams
+    logp0 = np.repeat(logp0, K, axis=0).reshape(B, K, V)
     # beam 0 seeds the search; the copies start at -inf so step 1's top-k
     # cannot pick the same token K times
     cum = np.full((B, K), -np.inf, np.float64)
@@ -585,8 +674,6 @@ def _beam_search(model, last, caches, max_len, max_new_tokens,
     hyps = [_BeamHyps(K, length_penalty, early_stopping) for _ in range(B)]
     done = [False] * B
     beams_tokens = [[[] for _ in range(K)] for _ in range(B)]
-    step_fn = _get_beam_step(model, max_len)
-    row_idx = np.arange(B * K, dtype=np.int32)  # identity on the first step
     logp = logp0
 
     for i in range(max_new_tokens):
@@ -639,8 +726,7 @@ def _beam_search(model, last, caches, max_len, max_new_tokens,
         cum = np.asarray(next_cum, np.float64)
         row_idx = np.asarray(next_origin, np.int32).reshape(-1)
         token = jnp.asarray(np.asarray(next_tokens, np.int64).reshape(-1, 1))
-        logp_dev, caches = step_fn(token, jnp.asarray(row_idx), caches)
-        logp = np.asarray(logp_dev).reshape(B, K, V)
+        logp = step(token, row_idx).reshape(B, K, V)
 
     outs = []
     for b in range(B):
@@ -653,7 +739,7 @@ def _beam_search(model, last, caches, max_len, max_new_tokens,
     arr = np.full((B, width), fill, np.int64)
     for b, o in enumerate(outs):
         arr[b, : len(o)] = o
-    return wrap(jnp.asarray(arr))
+    return arr
 
 
 class _PrefillStep:

@@ -331,13 +331,19 @@ class BartForConditionalGeneration(Layer):
 
     def generate(self, input_ids, max_new_tokens=20, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 attention_mask=None, **unsupported):
+                 attention_mask=None, num_beams=1, length_penalty=1.0,
+                 early_stopping=False, **unsupported):
         from ..generation import reject_non_default_kwargs
 
         reject_non_default_kwargs("BART", unsupported)
+        if num_beams > 1 and do_sample:
+            # before any encoder compute: an argument error must be free
+            raise NotImplementedError(
+                "BART.generate: beam search composes with greedy "
+                "scoring only (do_sample=False)")
         from ..autograd import tape as _tape
         from ..framework import random as _random
-        from ..generation import _select
+        from ..generation import _select, encdec_beam_generate
 
         cfg = self.config
         eos = cfg.eos_token_id if eos_token_id is None else eos_token_id
@@ -355,6 +361,13 @@ class BartForConditionalGeneration(Layer):
                                                 enc_mask=am)
             step = _get_bart_decode_step(self, max_new_tokens)
             token = jnp.full((B, 1), cfg.decoder_start_token_id, jnp.int32)
+            if num_beams > 1:
+                return encdec_beam_generate(
+                    self,
+                    lambda m, t, s, c: m.model.decode_cached(t, s, c),
+                    step, token, self_c, cross_c, max_new_tokens,
+                    num_beams, eos, length_penalty, early_stopping,
+                    "_bart_beam_steps")
             finished = jnp.zeros((B,), bool)
             out = []
             for i in range(max_new_tokens):

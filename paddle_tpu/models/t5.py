@@ -384,15 +384,23 @@ class T5ForConditionalGeneration(Layer):
 
     def generate(self, input_ids, max_new_tokens=20, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 attention_mask=None, **unsupported):
+                 attention_mask=None, num_beams=1, length_penalty=1.0,
+                 early_stopping=False, **unsupported):
         """Encoder once, then jitted cached decoder steps from
-        decoder_start_token_id; stops when every row emits eos."""
+        decoder_start_token_id; stops when every row emits eos.
+        ``num_beams > 1`` runs the shared host-scored beam search over the
+        cached decoder (HF num_beams semantics)."""
         from ..generation import reject_non_default_kwargs
 
         reject_non_default_kwargs("T5", unsupported)
+        if num_beams > 1 and do_sample:
+            # before any encoder compute: an argument error must be free
+            raise NotImplementedError(
+                "T5.generate: beam search composes with greedy "
+                "scoring only (do_sample=False)")
         from ..autograd import tape as _tape
         from ..framework import random as _random
-        from ..generation import _select
+        from ..generation import _select, encdec_beam_generate
 
         cfg = self.config
         eos = cfg.eos_token_id if eos_token_id is None else eos_token_id
@@ -409,6 +417,13 @@ class T5ForConditionalGeneration(Layer):
                                                 enc_mask=am)
             step = _get_t5_decode_step(self, max_new_tokens)
             token = jnp.full((B, 1), cfg.decoder_start_token_id, jnp.int32)
+            if num_beams > 1:
+                return encdec_beam_generate(
+                    self,
+                    lambda m, t, s, c: m.decoder.forward_cached(t, s, c),
+                    step, token, self_c, cross_c, max_new_tokens,
+                    num_beams, eos, length_penalty, early_stopping,
+                    "_t5_beam_steps")
             finished = jnp.zeros((B,), bool)
             out = []
             for i in range(max_new_tokens):

@@ -120,3 +120,34 @@ def test_activation_and_length_guards():
     long_ids = paddle.to_tensor(np.ones((1, 20), np.int64))
     with pytest.raises(ValueError, match="max_position_embeddings"):
         m(long_ids, paddle.to_tensor(np.ones((1, 4), np.int64)))
+
+
+def test_bart_beam_search_matches_transformers():
+    """num_beams>1 on the BART enc-dec path: token-identical to HF."""
+    import torch
+    from transformers import BartConfig as HFConfig
+    from transformers import BartForConditionalGeneration as HFBart
+    from paddle_tpu.models.bart import bart_from_hf
+
+    torch.manual_seed(0)
+    # eos points at an UNLIKELY token (95) so the untrained net cannot
+    # retire every beam at step 1 (decoder_start==2 would otherwise be
+    # the eos too and both sides emit a width-1 "parity" trivially)
+    hf = HFBart(HFConfig(vocab_size=96, d_model=64, encoder_layers=2,
+                         decoder_layers=2, encoder_attention_heads=4,
+                         decoder_attention_heads=4, encoder_ffn_dim=128,
+                         decoder_ffn_dim=128, max_position_embeddings=64,
+                         forced_eos_token_id=None, forced_bos_token_id=None,
+                         bos_token_id=0, eos_token_id=95, pad_token_id=1,
+                         decoder_start_token_id=2)).eval()
+    ours = bart_from_hf(hf, dtype="float32")
+    ids = np.random.RandomState(1).randint(3, 95, (2, 8))
+    with torch.no_grad():
+        ref = hf.generate(torch.from_numpy(ids), max_new_tokens=7,
+                          num_beams=2, do_sample=False,
+                          early_stopping=False).numpy()[:, 1:]
+    got = ours.generate(paddle.to_tensor(ids), max_new_tokens=7,
+                        num_beams=2, eos_token_id=95).numpy()
+    assert got.shape[1] >= 5, got  # no silent truncation
+    w = min(got.shape[1], ref.shape[1])
+    np.testing.assert_array_equal(got[:, :w], ref[:, :w])

@@ -614,8 +614,8 @@ class TestAdviceRegressions:
         out = m.generate(ids, max_new_tokens=3, num_beams=1, use_cache=True,
                          repetition_penalty=1.0)  # explicit defaults: OK
         assert out.shape[0] == 1
-        with pytest.raises(NotImplementedError, match="num_beams=2"):
-            m.generate(ids, max_new_tokens=3, num_beams=2)
+        with pytest.raises(NotImplementedError, match="paged=True"):
+            m.generate(ids, max_new_tokens=3, paged=True)
 
     def test_generate_defaults_dict_matches_signature(self):
         """GENERATE_DEFAULTS is the drift-guard copy of generate()'s

@@ -93,8 +93,12 @@ def test_eos_stops_early_and_unsupported_raise():
     enc = paddle.to_tensor(np.random.RandomState(5).randint(2, 256, (1, 6)))
     out = m.generate(enc, max_new_tokens=50)
     assert out.shape[1] <= 50
-    with pytest.raises(NotImplementedError, match="num_beams"):
-        m.generate(enc, num_beams=3)
+    # num_beams is supported now (r5); beam SAMPLING and genuinely
+    # unsupported kwargs still fail loudly
+    with pytest.raises(NotImplementedError, match="do_sample"):
+        m.generate(enc, num_beams=3, do_sample=True)
+    with pytest.raises(NotImplementedError, match="paged"):
+        m.generate(enc, paged=True)
 
 
 def test_padded_generate_matches_unpadded():
@@ -124,3 +128,31 @@ def test_bf16_config_builds_bf16_params_and_generates():
         np.random.RandomState(0).randint(2, 256, (1, 8))), max_new_tokens=5,
         eos_token_id=-1)  # eos disabled: fixed-length regardless of argmax
     assert out.shape == [1, 5]
+
+
+def test_t5_beam_search_matches_transformers():
+    """num_beams>1 on the enc-dec path: token-identical to HF T5 beam
+    generate across beam widths and length penalties."""
+    import torch
+    from transformers import T5Config as HFConfig
+    from transformers import T5ForConditionalGeneration as HFT5
+    from paddle_tpu.models.t5 import t5_from_hf
+
+    torch.manual_seed(0)
+    hf = HFT5(HFConfig(vocab_size=96, d_model=64, d_kv=16, d_ff=128,
+                       num_layers=2, num_heads=4, relative_attention_num_buckets=8,
+                       relative_attention_max_distance=20,
+                       decoder_start_token_id=0,
+                       tie_word_embeddings=True)).eval()
+    ours = t5_from_hf(hf, dtype="float32")
+    ids = np.random.RandomState(0).randint(3, 96, (2, 8))
+    for beams, lp in ((2, 1.0), (3, 0.5)):
+        with torch.no_grad():
+            ref = hf.generate(torch.from_numpy(ids), max_new_tokens=7,
+                              num_beams=beams, length_penalty=lp,
+                              do_sample=False).numpy()[:, 1:]
+        got = ours.generate(paddle.to_tensor(ids), max_new_tokens=7,
+                            num_beams=beams, length_penalty=lp).numpy()
+        assert got.shape[1] >= 5, got  # no silent truncation
+        w = min(got.shape[1], ref.shape[1])
+        np.testing.assert_array_equal(got[:, :w], ref[:, :w])
