@@ -1,0 +1,133 @@
+"""Elastic data-parallel training: crash one worker, scale in, resume.
+
+The launcher runs this file's WORKER mode on two processes. At step 3,
+rank 1 dies. The launcher (``--max_restarts 1 --np_range 1:2``) detects
+the death, drops the failed rank, and relaunches the survivor as a world
+of ONE; the worker reshard-loads the newest checkpoint — including the
+one rank 0 wrote from its SIGTERM save-on-signal handler mid-step — and
+the loss continues its descent to convergence.
+
+Run: JAX_PLATFORMS=cpu python examples/elastic_training.py
+"""
+import glob
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def worker():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.elastic import on_restart_signal
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.optimizer import SGD
+
+    out = sys.argv[2]
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    inc = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world, timeout=60)
+    store.barrier(f"boot{inc}")
+
+    paddle.seed(0)  # same init everywhere; checkpoints overwrite it
+    model = nn.Linear(4, 1)
+    opt = SGD(learning_rate=0.1, parameters=model.parameters())
+
+    # resume from the NEWEST checkpoint across all former ranks (weights
+    # replicated under dp, so any newest copy is valid at any world size)
+    state = {"step": 0}
+    for f in sorted(glob.glob(os.path.join(out, "ck_*.pkl"))):
+        with open(f, "rb") as fh:
+            s = pickle.load(fh)
+        if s["step"] > state["step"]:
+            state = s
+    if state["step"]:
+        own = model.state_dict()
+        for k, v in state["w"].items():
+            own[k].set_value(paddle.to_tensor(v))
+        print(f"rank {rank}: resumed step {state['step']} world {world}",
+              flush=True)
+
+    def save():
+        state["w"] = {k: np.asarray(v._array)
+                      for k, v in model.state_dict().items()}
+        with open(os.path.join(out, f"ck_{rank}.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        print(f"rank {rank}: signal-saved step {state['step']}", flush=True)
+
+    guard = on_restart_signal(save)
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(64, 4).astype("float32")
+    Y = X @ np.array([[3.0], [-1.0], [2.0], [0.5]], np.float32) - 2.0
+    for step in range(state["step"], 30):
+        if rank == 1 and inc == 0 and step == 3:
+            print("rank 1: simulated hardware failure", flush=True)
+            os._exit(7)
+        shard = np.array_split(np.arange(64), world)[rank]
+        d = model(paddle.to_tensor(X[shard])) - paddle.to_tensor(Y[shard])
+        loss = (d * d).mean()
+        loss.backward()
+        # dp grad average over the store (the example rig's allreduce)
+        g = {k: p.grad.numpy() for k, p in zip("wb", model.parameters())}
+        store.set(f"g{inc}_{step}_{rank}", pickle.dumps(g))
+        acc = None
+        for r in range(world):
+            gr = pickle.loads(store.get(f"g{inc}_{step}_{r}", timeout=60))
+            acc = gr if acc is None else {k: acc[k] + gr[k] for k in acc}
+        with guard.shield():  # SIGTERM inside the update span defers save
+            for k, p in zip("wb", model.parameters()):
+                p.grad.set_value(paddle.to_tensor(acc[k] / world))
+            opt.step()
+            opt.clear_grad()
+            state["step"] = step + 1
+        if rank == 0 and (step + 1) % 10 == 0:
+            print(f"rank 0: step {step + 1} loss {float(loss.numpy()):.4f}",
+                  flush=True)
+    save()
+    print(f"rank {rank}: DONE loss={float(loss.numpy()):.5f} "
+          f"w={model.weight.numpy().reshape(-1).round(2).tolist()}",
+          flush=True)
+
+
+def main():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    with tempfile.TemporaryDirectory() as out:
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--master", f"127.0.0.1:{port}",
+             "--max_restarts", "1", "--np_range", "1:2",
+             "--log_dir", os.path.join(out, "logs"),
+             os.path.abspath(__file__), "--worker", out],
+            cwd=REPO, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": REPO})
+        print("\nworker logs:")
+        for lp in sorted(glob.glob(os.path.join(out, "logs", "*"))):
+            with open(lp) as f:
+                body = f.read().strip()
+            print(f"--- {os.path.basename(lp)} ---\n{body}")
+        assert r.returncode == 0, r.returncode
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker()
+    else:
+        main()

@@ -25,7 +25,8 @@ from .api import (
 )
 from .collective import (
     ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
-    reduce, gather,
+    reduce, gather, all_gather_object, broadcast_object_list,
+    scatter_object_list,
     reduce_scatter, all_to_all, broadcast, scatter, barrier, send, recv,
     psum, pmean, ppermute, axis_index,
 )

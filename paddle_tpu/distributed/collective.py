@@ -27,6 +27,7 @@ from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 from jax import shard_map
 
@@ -235,6 +236,105 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if isinstance(tensor, Tensor):
         tensor._array = result._array
     return result
+
+
+def _pickle_to_u8(obj):
+    import pickle
+
+    return np.frombuffer(pickle.dumps(obj), np.uint8)
+
+
+def _check_world_group(group, op_name: str):
+    """The multi-process object collectives ride process-wide
+    multihost_utils primitives; a sub-group would silently widen to the
+    world (same guard all_reduce applies)."""
+    if group is not None and group is not _default_group[0]:
+        raise NotImplementedError(
+            f"multi-process {op_name} supports only the default (world) "
+            "group")
+
+
+def all_gather_object(object_list, obj, group=None):
+    """paddle.distributed.all_gather_object parity
+    (communication/all_gather.py:87): every rank contributes one picklable
+    object; the list receives all of them in rank order. Multi-process:
+    objects ride pickled uint8 arrays through process_allgather (lengths
+    gathered first — payloads are ragged); single-controller: every rank
+    IS this process, so the list gets world copies."""
+    g = group or _ensure_default_group()
+    if _multiprocess():
+        _check_world_group(group, "all_gather_object")
+        import pickle
+
+        from jax.experimental import multihost_utils
+
+        payload = _pickle_to_u8(obj)
+        lens = multihost_utils.process_allgather(
+            np.asarray([payload.size], np.int64))
+        width = int(lens.max())
+        padded = np.zeros((width,), np.uint8)
+        padded[: payload.size] = payload
+        gathered = multihost_utils.process_allgather(padded)
+        object_list.clear()
+        object_list.extend(
+            pickle.loads(gathered[r, : int(lens[r, 0])].tobytes())
+            for r in range(gathered.shape[0]))
+        return object_list
+    import copy
+
+    object_list.clear()
+    # independent copies, matching the multiprocess branch's pickle
+    # round-trip: mutating one gathered entry must not alias the rest
+    object_list.extend(copy.deepcopy(obj) for _ in range(g.nranks))
+    return object_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """paddle.distributed.broadcast_object_list parity
+    (communication/broadcast.py:83): rank ``src``'s objects replace every
+    rank's list contents."""
+    if _multiprocess():
+        _check_world_group(group, "broadcast_object_list")
+        import pickle
+
+        from jax.experimental import multihost_utils
+
+        payload = (_pickle_to_u8(list(object_list))
+                   if jax.process_index() == src else np.zeros(0, np.uint8))
+        n = multihost_utils.broadcast_one_to_all(
+            np.asarray([payload.size], np.int64),
+            is_source=jax.process_index() == src)
+        buf = np.zeros((int(n[0]),), np.uint8)
+        buf[: payload.size] = payload
+        out = multihost_utils.broadcast_one_to_all(
+            buf, is_source=jax.process_index() == src)
+        object_list[:] = pickle.loads(np.asarray(out).tobytes())
+        return object_list
+    return object_list  # single-controller: src's list IS the list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """paddle.distributed.scatter_object_list parity
+    (communication/scatter.py:91): rank r receives ``in_object_list[r]``
+    from ``src``."""
+    g = group or _ensure_default_group()
+    if _multiprocess():
+        _check_world_group(group, "scatter_object_list")
+        holder = list(in_object_list or [])
+        broadcast_object_list(holder, src=src, group=group)
+        if len(holder) != jax.process_count():
+            raise ValueError(
+                f"scatter_object_list: {len(holder)} objects for "
+                f"{jax.process_count()} processes")
+        out_object_list[:] = [holder[jax.process_index()]]
+        return out_object_list
+    if in_object_list is not None and len(in_object_list) != g.nranks:
+        raise ValueError(
+            f"scatter_object_list: {len(in_object_list)} objects for "
+            f"{g.nranks} ranks")
+    out_object_list[:] = [in_object_list[0]] if in_object_list else []
+    return out_object_list
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):

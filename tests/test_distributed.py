@@ -292,3 +292,28 @@ def test_fleet_distributed_optimizer_wraps_gradient_merge():
     o = dist.fleet.distributed_optimizer(opt.SGD(0.1, parameters=m.parameters()))
     assert isinstance(o, GradientMergeOptimizer)
     assert o._k == 4
+
+
+def test_object_collectives_single_controller():
+    """all_gather_object/broadcast_object_list/scatter_object_list
+    (communication/{all_gather,broadcast,scatter}.py parity) in the
+    single-controller facade; the 2-process semantics ride the launch
+    collective integration test."""
+    import jax
+
+    import paddle_tpu.distributed as dist
+
+    world = jax.device_count()
+    objs = []
+    dist.all_gather_object(objs, {"x": 1})
+    assert len(objs) == world and objs[0] == {"x": 1}
+    lst = [{"cfg": 7}]
+    dist.broadcast_object_list(lst, src=0)
+    assert lst == [{"cfg": 7}]
+    out = []
+    dist.scatter_object_list(out, [f"obj{r}" for r in range(world)], src=0)
+    assert out == ["obj0"]
+    import pytest
+    with pytest.raises(ValueError, match="objects for"):
+        dist.scatter_object_list(out, ["too", "few"][: max(1, world - 1)]
+                                 if world > 2 else ["a", "b", "c"], src=0)

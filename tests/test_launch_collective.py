@@ -56,6 +56,20 @@ for p in model.parameters():
     p._grad = g / 2.0
 loss_sync = loss.clone()
 dist.all_reduce(loss_sync)
+# ---- object collectives over the 2-process world ----
+objs = []
+dist.all_gather_object(objs, {"rank": rank, "tag": f"r{rank}" * (rank + 1)})
+assert [o["rank"] for o in objs] == [0, 1], objs
+assert objs[1]["tag"] == "r1r1"
+
+blist = [{"cfg": 42, "note": "from rank0"}] if rank == 0 else [None]
+dist.broadcast_object_list(blist, src=0)
+assert blist[0]["cfg"] == 42, blist
+
+mine = []
+dist.scatter_object_list(mine, ["for-rank0", "for-rank1"], src=0)
+assert mine == [f"for-rank{rank}"], mine
+
 result = {
     "rank": rank,
     "mean_loss": float(loss_sync.numpy()) / 2.0,
