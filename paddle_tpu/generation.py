@@ -152,7 +152,7 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
 
 
 def paged_cached_attention(q, k, v, cos, sin, k_pages, v_pages, page_indices,
-                           lengths, page_size):
+                           lengths, page_size, window=None):
     """Single-token decode over the PAGED cache (in-layer dispatch).
 
     q [B,1,H,D]; pages [hk, n_pages, page_size, D]; lengths [B] = tokens
@@ -175,18 +175,31 @@ def paged_cached_attention(q, k, v, cos, sin, k_pages, v_pages, page_indices,
     v_pages = v_pages.at[:, rows, slot].set(
         jnp.moveaxis(v[:, 0], 0, 1).astype(v_pages.dtype))
     out = paged_decode_attention(q[:, 0], k_pages, v_pages, lengths + 1,
-                                 page_indices)
+                                 page_indices, window=window)
     return out[:, None], k_pages, v_pages
 
 
 def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
-                           pages_per_compute_block=None):
+                           pages_per_compute_block=None, window=None):
     """Decode attention over a paged cache: JAX's bundled Pallas kernel on
     TPU, a jnp gather reference (identical semantics) elsewhere.
+
+    ``window`` (Mistral sliding-window serving): only the last ``window``
+    positions attend. The bundled Pallas kernel has no lower-bound
+    masking, so windowed rows take the XLA gather path on every backend —
+    correct, HBM-unfused (a banded paged kernel is the optimization path).
 
     ``pages_per_compute_block`` defaults to the largest divisor of
     pages-per-sequence <= 8: bigger blocks amortize the kernel's grid
     overhead across more of the KV stream (HBM-bandwidth-bound op)."""
+    if window is not None:
+        cache_positions = page_indices.shape[1] * k_pages.shape[2]
+        if window < cache_positions:
+            return _paged_attention_ref(q, k_pages, v_pages, lengths,
+                                        page_indices, window=window)
+        # the band can never exclude a cached position (window >= cache
+        # capacity): keep the fused Pallas kernel — e.g. Mistral-7B's
+        # 4096 window served at max_len <= 4096
     try:
         on_tpu = jax.devices()[0].platform == "tpu"
     except Exception:
@@ -205,7 +218,8 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
     return _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices)
 
 
-def _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices):
+def _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices,
+                         window=None):
     B, H, D = q.shape
     hk, _n, page_size, _ = k_pages.shape
     g = H // hk
@@ -218,6 +232,9 @@ def _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices):
     scores = jnp.einsum("bkgd,bktd->bkgt", qg, k.astype(jnp.float32))
     scores = scores / math.sqrt(D)
     valid = jnp.arange(T)[None, :] < lengths[:, None]
+    if window is not None:
+        # band lower bound: only the newest `window` positions attend
+        valid &= jnp.arange(T)[None, :] >= (lengths[:, None] - window)
     scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgt,bktd->bkgd", probs, v.astype(jnp.float32))

@@ -246,15 +246,12 @@ class LlamaAttention(Layer):
             from ..generation import cached_attention, paged_cached_attention
 
             if "k_pages" in kv_cache:
-                if cfg.sliding_window is not None:
-                    raise NotImplementedError(
-                        "sliding_window with the paged KV cache is not "
-                        "supported; use paged=False")
                 out, kp, vp = apply(
                     "llama_attention_paged", paged_cached_attention,
                     q, k, v, cos, sin, kv_cache["k_pages"],
                     kv_cache["v_pages"], kv_cache["page_indices"],
-                    kv_cache["lengths"], kv_cache.get("page_size"))
+                    kv_cache["lengths"], kv_cache.get("page_size"),
+                    window=cfg.sliding_window)
                 result = self.o_proj(out.reshape([b, s, h * d]))
                 new = dict(kv_cache)
                 new.update(k_pages=kp, v_pages=vp,

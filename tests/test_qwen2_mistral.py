@@ -139,12 +139,19 @@ class TestMistral:
         np.testing.assert_array_equal(got[0], solo_long[0])
         np.testing.assert_array_equal(got[1], solo_short[0])
 
-    def test_paged_decode_refuses_window(self):
+    def test_paged_decode_supports_window(self):
+        """r5: paged decode applies the band lower bound (was a raise);
+        short-prompt smoke — the beyond-window leg lives in
+        TestWindowedPagedServing."""
         cfg = MistralConfig.tiny(sliding_window=8, use_flash_attention=False)
+        paddle.seed(0)
         m = MistralForCausalLM(cfg)
-        ids = paddle.to_tensor(np.zeros((1, 4), np.int64))
-        with pytest.raises(NotImplementedError, match="paged"):
-            m.generate(ids, max_new_tokens=2, paged=True, page_size=4)
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 512, (1, 4)))
+        dense = m.generate(ids, max_new_tokens=2).numpy()
+        paged = m.generate(ids, max_new_tokens=2, paged=True,
+                           page_size=4).numpy()
+        np.testing.assert_array_equal(dense, paged)
 
 
 class TestQwen2:
@@ -274,3 +281,66 @@ def test_mistral_beam_matches_transformers():
     got = ours.generate(paddle.to_tensor(ids), max_new_tokens=6,
                         num_beams=3).numpy()
     np.testing.assert_array_equal(got[:, :ref.shape[1]], ref)
+
+
+class TestWindowedPagedServing:
+    """Sliding window on the PAGED decode path (r5: was a raise): the
+    gather fallback applies the band lower bound, so Mistral serves
+    through the continuous-batching engine token-identically."""
+
+    def test_paged_generate_matches_dense_beyond_window(self):
+        paddle.seed(0)
+        cfg = MistralConfig.tiny(sliding_window=8, use_flash_attention=False)
+        m = MistralForCausalLM(cfg)
+        ids = np.random.RandomState(0).randint(0, 512, (2, 24))
+        t = paddle.to_tensor(ids)
+        dense = m.generate(t, max_new_tokens=6).numpy()
+        paged = m.generate(t, max_new_tokens=6, paged=True).numpy()
+        np.testing.assert_array_equal(dense, paged)
+
+    def test_engine_serves_windowed_model(self):
+        from paddle_tpu.serving import ContinuousBatchEngine
+
+        paddle.seed(0)
+        cfg = MistralConfig.tiny(sliding_window=8, use_flash_attention=False)
+        m = MistralForCausalLM(cfg)
+        ids = np.random.RandomState(0).randint(0, 512, (2, 24))
+        eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8)
+        r0 = eng.add_request(ids[0], 6)
+        r1 = eng.add_request(ids[1][:20], 6)  # ragged: different lengths
+        done = eng.run_until_done()
+        for rid, prompt in ((r0, ids[0]), (r1, ids[1][:20])):
+            solo = m.generate(paddle.to_tensor(prompt[None]),
+                              max_new_tokens=6).numpy()[0]
+            assert done[rid].tolist() == solo.tolist()
+
+    def test_paged_ref_window_band(self):
+        """_paged_attention_ref with a window must equal dense attention
+        over only the newest `window` positions."""
+        import jax.numpy as jnp
+        from paddle_tpu.generation import _paged_attention_ref
+
+        rng = np.random.RandomState(3)
+        B, H, hk, D, ps, npages = 2, 4, 2, 8, 4, 3
+        q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+        k_pages = jnp.asarray(rng.randn(hk, npages * B, ps, D), jnp.float32)
+        v_pages = jnp.asarray(rng.randn(hk, npages * B, ps, D), jnp.float32)
+        page_indices = jnp.arange(B * npages).reshape(B, npages)
+        lengths = jnp.asarray([10, 7], jnp.int32)
+        win = 4
+        out = _paged_attention_ref(q, k_pages, v_pages, lengths,
+                                   page_indices, window=win)
+        # dense reference over the gathered kv with the same band
+        k = jnp.moveaxis(k_pages[:, page_indices], 0, 1).reshape(B, hk, -1, D)
+        v = jnp.moveaxis(v_pages[:, page_indices], 0, 1).reshape(B, hk, -1, D)
+        T = k.shape[2]
+        g = H // hk
+        qg = q.reshape(B, hk, g, D)
+        s = jnp.einsum("bkgd,bktd->bkgt", qg, k) / np.sqrt(D)
+        idx = jnp.arange(T)[None, :]
+        band = (idx < lengths[:, None]) & (idx >= lengths[:, None] - win)
+        s = jnp.where(band[:, None, None], s, -jnp.inf)
+        ref = jnp.einsum("bkgt,bktd->bkgd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(ref.reshape(B, H, D)),
+                                   rtol=1e-5, atol=1e-5)
