@@ -646,7 +646,8 @@ class _BeamHyps:
 
 
 def _beam_search(model, last, caches, max_len, max_new_tokens,
-                 num_beams, eos_token_id, length_penalty, early_stopping):
+                 num_beams, eos_token_id, length_penalty, early_stopping,
+                 rp=1.0, histories0=None, min_new=0, ngram=0):
     """Host-scored beam search over the cached decode path (the LLM analog
     of nn.BeamSearchDecoder/dynamic_decode; HF generate num_beams
     semantics). ``last``/``caches`` arrive from the B-row prefill; beams
@@ -667,12 +668,15 @@ def _beam_search(model, last, caches, max_len, max_new_tokens,
 
     logp0 = np.asarray(jax.nn.log_softmax(last.astype(jnp.float32), axis=-1))
     arr = beam_search_loop(logp0, step, max_new_tokens, num_beams,
-                           eos_token_id, length_penalty, early_stopping)
+                           eos_token_id, length_penalty, early_stopping,
+                           rp=rp, histories0=histories0, min_new=min_new,
+                           ngram=ngram)
     return wrap(jnp.asarray(arr))
 
 
 def beam_search_loop(logp0, step, max_new_tokens, num_beams, eos_token_id,
-                     length_penalty, early_stopping):
+                     length_penalty, early_stopping, rp=1.0, histories0=None,
+                     min_new=0, ngram=0):
     """The host scoring loop of beam search, decoupled from the model: a
     caller supplies ``logp0`` (np [B, V] log-probs of the first position)
     and ``step(token [B*K, 1] jnp, row_idx [B*K] np) -> np [B*K, V]``
@@ -693,8 +697,48 @@ def beam_search_loop(logp0, step, max_new_tokens, num_beams, eos_token_id,
     beams_tokens = [[[] for _ in range(K)] for _ in range(B)]
     logp = logp0
 
+    # prompt n-gram maps built ONCE per batch row: per-step beam work then
+    # hashes only the short generated tail (+ the boundary n-grams via the
+    # prompt's last n-1 tokens), not the whole prompt again — the greedy
+    # path's _NgramBan amortization, adapted to beam reordering
+    base_maps = ([_NgramBan([h], ngram) for h in histories0]
+                 if (ngram and histories0 is not None) else None)
+
+    def _process(scores, step_i):
+        """HF beam-search processor order on the [B, K, V] scores."""
+        eos_active = bool(min_new and eos_token_id is not None
+                          and step_i < min_new)
+        if (histories0 is None and not eos_active) or all(done):
+            return scores
+        out = np.array(scores, np.float64)
+        for b in range(B):
+            if done[b] or histories0 is None:
+                continue
+            prompt = histories0[b]
+            tail = prompt[-(ngram - 1):] if ngram > 1 else []
+            for j in range(K):
+                gen = beams_tokens[b][j]
+                row = out[b, j]
+                if rp != 1.0 and (prompt or gen):
+                    idx = np.fromiter(set(prompt) | set(gen), np.int64)
+                    vals = row[idx]
+                    row[idx] = np.where(vals < 0, vals * rp, vals / rp)
+                if ngram:
+                    hist = prompt + gen
+                    prefix = (tuple(hist[-(ngram - 1):]) if ngram > 1
+                              else ())
+                    banned = set(base_maps[b].maps[0].get(prefix, ()))
+                    banned |= _NgramBan([tail + gen], ngram).maps[0].get(
+                        prefix, set())
+                    if banned:
+                        row[list(banned)] = -np.inf
+        if eos_active:
+            out[:, :, eos_token_id] = -np.inf
+        return out
+
     for i in range(max_new_tokens):
-        total = cum[:, :, None] + logp          # [B, K, V] float64 scores
+        logp_p = _process(logp, i)
+        total = cum[:, :, None] + logp_p        # [B, K, V] float64 scores
         flat = total.reshape(B, K * V)
         # 2K candidates per batch (eos hits may retire, HF convention);
         # O(KV) partial select, then sort only the survivors
@@ -1130,10 +1174,6 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
             raise NotImplementedError(
                 "beam search over the paged KV layout is not supported; "
                 "use paged=False (beams reorder dense cache rows)")
-        if penalized:
-            raise NotImplementedError(
-                "repetition_penalty/min_new_tokens/no_repeat_ngram_size "
-                "with num_beams>1 is not supported")
         if not use_cache:
             raise NotImplementedError("beam search needs use_cache=True")
     chunk = int(prefill_chunk_size) if prefill_chunk_size else 0
@@ -1227,9 +1267,17 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
                 c["row_pos"] = lengths
 
         if num_beams > 1:
+            histories0 = None
+            if rp != 1.0 or ngram > 0:
+                ids_np = np.asarray(ids)
+                lens_np = np.asarray(lengths)
+                histories0 = [list(map(int, ids_np[b, : lens_np[b]]))
+                              for b in range(B)]
             return _beam_search(model, last, caches, max_len,
                                 max_new_tokens, num_beams, eos_token_id,
-                                float(length_penalty), bool(early_stopping))
+                                float(length_penalty), bool(early_stopping),
+                                rp=rp, histories0=histories0,
+                                min_new=min_new, ngram=ngram)
 
         if eos_token_id is None and max_new_tokens > 1 and not penalized:
             # fixed-length decode: the whole loop is ONE lax.scan dispatch

@@ -486,6 +486,9 @@ class TestBeamSearch:
         # compare at FULL reference width: both sides pad with eos, so a
         # termination-length divergence cannot hide behind a prefix slice
         fill = eos if eos is not None else 0
+        # a LONGER best hypothesis than HF's is itself a divergence — it
+        # must not hide behind the width slice below
+        assert got.shape[1] <= ref.shape[1], (got, ref)
         if got.shape[1] < ref.shape[1]:
             got = np.pad(got, ((0, 0), (0, ref.shape[1] - got.shape[1])),
                          constant_values=fill)
@@ -519,8 +522,38 @@ class TestBeamSearch:
             ours.generate(ids, num_beams=2, do_sample=True)
         with pytest.raises(NotImplementedError, match="paged"):
             ours.generate(ids, num_beams=2, paged=True)
-        with pytest.raises(NotImplementedError, match="repetition"):
-            ours.generate(ids, num_beams=2, repetition_penalty=1.3)
+
+    @pytest.mark.parametrize("kw", [
+        dict(repetition_penalty=1.4),
+        dict(no_repeat_ngram_size=2),
+        dict(eos_token_id=5, min_new_tokens=4),
+        dict(repetition_penalty=1.3, no_repeat_ngram_size=3,
+             eos_token_id=5, min_new_tokens=3),
+    ])
+    def test_beams_compose_with_penalties(self, hf_pair, kw):
+        """r5: repetition_penalty / no_repeat_ngram_size / min_new_tokens
+        under num_beams>1 — HF applies the processors to the per-beam
+        log-softmax scores; token parity against transformers."""
+        import torch
+
+        hf, ours = hf_pair
+        ids = np.random.RandomState(1).randint(0, 128, (2, 10))
+        eos = kw.get("eos_token_id")
+        with torch.no_grad():
+            ref = hf.generate(torch.from_numpy(ids), max_new_tokens=8,
+                              do_sample=False, num_beams=3,
+                              pad_token_id=eos if eos is not None else 0,
+                              **kw).numpy()[:, 10:]
+        got = ours.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                            num_beams=3, **kw).numpy()
+        fill = eos if eos is not None else 0
+        # a LONGER best hypothesis than HF's is itself a divergence — it
+        # must not hide behind the width slice below
+        assert got.shape[1] <= ref.shape[1], (got, ref)
+        if got.shape[1] < ref.shape[1]:
+            got = np.pad(got, ((0, 0), (0, ref.shape[1] - got.shape[1])),
+                         constant_values=fill)
+        np.testing.assert_array_equal(got[:, :ref.shape[1]], ref)
 
 
 def test_no_repeat_ngram_matches_transformers():
