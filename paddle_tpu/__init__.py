@@ -449,7 +449,8 @@ def _install_inplace_functions():
         "divide", "equal", "erf", "erfinv", "exp", "expm1", "flatten",
         "floor", "floor_divide", "floor_mod", "frac", "gammainc",
         "gammaincc", "gammaln", "gcd", "geometric", "greater_equal",
-        "greater_than", "hypot", "i0", "index_fill", "index_put", "lcm",
+        "greater_than", "hypot", "i0", "index_add", "index_fill",
+        "index_put", "lcm",
         "ldexp", "lerp", "less", "less_equal", "less_than", "lgamma", "log",
         "log10", "log1p", "log2", "logical_and", "logical_not", "logical_or",
         "logical_xor", "logit", "masked_fill", "masked_scatter", "mod",
@@ -501,6 +502,13 @@ def __getattr__(name):
 
         return DataParallel
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    # lazy names must be introspectable (dir()/doc tooling/surface diffs),
+    # not just gettable
+    return sorted(set(globals()) | set(_LAZY_SUBMODULES)
+                  | {"Model", "DataParallel"})
 
 
 _finalize_schema()

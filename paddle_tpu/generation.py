@@ -703,6 +703,8 @@ def beam_search_loop(logp0, step, max_new_tokens, num_beams, eos_token_id,
     # path's _NgramBan amortization, adapted to beam reordering
     base_maps = ([_NgramBan([h], ngram) for h in histories0]
                  if (ngram and histories0 is not None) else None)
+    prompt_sets = ([set(h) for h in histories0]
+                   if (rp != 1.0 and histories0 is not None) else None)
 
     def _process(scores, step_i):
         """HF beam-search processor order on the [B, K, V] scores."""
@@ -720,7 +722,7 @@ def beam_search_loop(logp0, step, max_new_tokens, num_beams, eos_token_id,
                 gen = beams_tokens[b][j]
                 row = out[b, j]
                 if rp != 1.0 and (prompt or gen):
-                    idx = np.fromiter(set(prompt) | set(gen), np.int64)
+                    idx = np.fromiter(prompt_sets[b] | set(gen), np.int64)
                     vals = row[idx]
                     row[idx] = np.where(vals < 0, vals * rp, vals / rp)
                 if ngram:

@@ -30,3 +30,49 @@ def matrix_exp(x, name=None):
     import jax.scipy.linalg as _jsl
 
     return apply("matrix_exp", lambda a: _jsl.expm(a), x)
+
+
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="float16",
+                            act="identity", name=None):
+    """paddle.linalg.fp8_fp8_half_gemm_fused parity
+    (python/paddle/tensor/linalg.py:357 over the cutlass fp8 GEMM): both
+    operands quantize to float8_e4m3, the product accumulates at higher
+    precision, ``scale`` rescales, bias + activation fuse, and the result
+    lands in float16/bfloat16.
+
+    TPU-native: jnp.matmul over jnp.float8_e4m3fn inputs with a f32
+    ``preferred_element_type`` — XLA lowers to native fp8 MXU paths on
+    hardware that has them and upcasts elsewhere; either way the VALUES
+    carry fp8 quantization exactly like the reference kernel's.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .ops.registry import apply
+
+    if output_dtype not in ("float16", "bfloat16"):
+        raise ValueError(
+            f"output_dtype must be float16 or bfloat16, got {output_dtype!r}")
+    if act not in ("identity", "relu", "gelu"):
+        raise ValueError(f"act must be identity/relu/gelu, got {act!r}")
+    out_dt = jnp.dtype(output_dtype)
+
+    def fn(a, b, *rest):
+        a8 = a.astype(jnp.float8_e4m3fn)
+        b8 = b.astype(jnp.float8_e4m3fn)
+        if transpose_x:
+            a8 = jnp.swapaxes(a8, -1, -2)
+        if transpose_y:
+            b8 = jnp.swapaxes(b8, -1, -2)
+        out = jnp.matmul(a8, b8, preferred_element_type=jnp.float32) * scale
+        if rest:
+            out = out + rest[0].astype(jnp.float32)
+        if act == "relu":
+            out = jax.nn.relu(out)
+        elif act == "gelu":
+            out = jax.nn.gelu(out, approximate=False)
+        return out.astype(out_dt)
+
+    args = (x, y) if bias is None else (x, y, bias)
+    return apply("fp8_fp8_half_gemm_fused", fn, *args, differentiable=False)

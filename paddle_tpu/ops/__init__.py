@@ -135,6 +135,7 @@ def _install_tensor_methods():
     T.squeeze_ = _make_inplace(manipulation.squeeze)
     T.unsqueeze_ = _make_inplace(manipulation.unsqueeze)
     T.scatter_ = _make_inplace(manipulation.scatter)
+    T.index_add_ = _make_inplace(manipulation.index_add)
     T.uniform_ = creation.uniform_
     T.normal_ = creation.normal_
 

@@ -524,8 +524,11 @@ class TestBeamSearch:
             ours.generate(ids, num_beams=2, paged=True)
 
     @pytest.mark.parametrize("kw", [
-        dict(repetition_penalty=1.4),
-        dict(no_repeat_ngram_size=2),
+        # every config pins eos explicitly: HF otherwise falls back to
+        # its config default (2) while ours runs eos-free — divergent
+        # stopping behavior a seed change could surface
+        dict(repetition_penalty=1.4, eos_token_id=5),
+        dict(no_repeat_ngram_size=2, eos_token_id=5),
         dict(eos_token_id=5, min_new_tokens=4),
         dict(repetition_penalty=1.3, no_repeat_ngram_size=3,
              eos_token_id=5, min_new_tokens=3),

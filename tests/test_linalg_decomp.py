@@ -105,3 +105,33 @@ def test_slogdet():
     rs, rl = np.linalg.slogdet(x.numpy())
     np.testing.assert_allclose(sign.numpy(), rs, atol=1e-5)
     np.testing.assert_allclose(logdet.numpy(), rl, rtol=1e-4)
+
+
+def test_fp8_gemm_fused():
+    """fp8_fp8_half_gemm_fused (tensor/linalg.py:357): values carry fp8
+    quantization, scale/bias/act fuse, output lands in half/bf16."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.linalg as L
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(rng.randn(16, 4).astype("float32"))
+    b = paddle.to_tensor(rng.randn(4).astype("float32"))
+    out = L.fp8_fp8_half_gemm_fused(x, y, bias=b, scale=0.5,
+                                    output_dtype="bfloat16", act="relu")
+    assert str(out.dtype) == "bfloat16" and out.shape == [8, 4]
+    # reference computed through the same fp8 quantization
+    xq = np.asarray(x.numpy(), np.float32).astype(jnp.float8_e4m3fn).astype(np.float32)
+    yq = np.asarray(y.numpy(), np.float32).astype(jnp.float8_e4m3fn).astype(np.float32)
+    ref = np.maximum(xq @ yq * 0.5 + b.numpy(), 0.0)
+    np.testing.assert_allclose(out.numpy().astype(np.float32), ref,
+                               rtol=0.1, atol=0.1)  # fp8+bf16 tolerance
+    # transpose flags
+    out2 = L.fp8_fp8_half_gemm_fused(
+        paddle.to_tensor(x.numpy().T), y, transpose_x=True)
+    np.testing.assert_allclose(
+        out2.numpy().astype(np.float32),
+        (xq @ yq).astype(np.float16).astype(np.float32), rtol=0.1, atol=0.2)
+    with pytest.raises(ValueError, match="output_dtype"):
+        L.fp8_fp8_half_gemm_fused(x, y, output_dtype="float32")
