@@ -36,6 +36,14 @@ from .topology import (
     set_hybrid_communicate_group, get_hybrid_communicate_group,
 )
 from .parallel import DataParallel
+from . import checkpoint, io, launch  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict
+from .compat import (
+    DistModel, ParallelEnv, ParallelMode, ReduceType, ShardDataloader,
+    Strategy, alltoall, alltoall_single, destroy_process_group,
+    dtensor_from_fn, get_backend, irecv, is_available, isend,
+    shard_dataloader, shard_scaler, to_static, wait,
+)
 from . import fleet as _fleet_mod
 from .fleet import fleet
 from .parallel_layers import (

@@ -1,0 +1,389 @@
+"""Reference-surface tail of paddle.distributed: async p2p handles, legacy
+spellings, auto-parallel entry objects.
+
+Reference parity, by name:
+- ``isend/irecv/wait`` (communication/{send,recv}.py async forms +
+  communication/wait): under the single-controller XLA runtime every
+  dispatched collective is already asynchronous — the returned task's
+  ``wait()`` is ``block_until_ready`` on the result.
+- ``alltoall/alltoall_single`` (communication/all_to_all.py): the older
+  spellings of all_to_all.
+- ``get_backend/is_available/destroy_process_group`` (parallel.py): the
+  backend is XLA's collective stack, not nccl/gloo.
+- ``ReduceType`` (auto_parallel placement reduce kinds) and ``Strategy``
+  (auto_parallel/strategy.py — the same knobs DistributedStrategy
+  carries here).
+- ``ParallelEnv``/``ParallelMode`` (legacy parallel env probes).
+- ``dtensor_from_fn`` / ``shard_dataloader`` / ``shard_scaler``
+  (auto_parallel/api.py): dist-tensor construction + input pipeline
+  sharding; under GSPMD the scaler already operates on global arrays, so
+  ``shard_scaler`` is the identity contract.
+- ``DistModel`` / ``to_static`` (auto_parallel/api.py:2798): the
+  mode-switched wrapper over the compiled hybrid-parallel step.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..tensor_class import Tensor, unwrap, wrap
+from .collective import all_to_all, recv, send
+
+
+class P2POp:  # minimal task handle
+    pass
+
+
+class _Task:
+    """Completed-dispatch handle (ProcessGroup::Task analog): XLA queues
+    the transfer at dispatch; wait() syncs the payload."""
+
+    def __init__(self, tensor):
+        self._t = tensor
+
+    def wait(self):
+        arr = unwrap(self._t) if isinstance(self._t, Tensor) else self._t
+        if hasattr(arr, "block_until_ready"):
+            arr.block_until_ready()
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None, sync_op=False):
+    """Same SPMD contract as send(): the single-controller facade has no
+    eager P2P (it raises with guidance); where send works (pipeline
+    runtime paths), the returned task's wait() syncs the transfer."""
+    send(tensor, dst=dst, group=group, sync_op=False)
+    return _Task(tensor)
+
+
+def irecv(tensor, src=0, group=None, sync_op=False):
+    out = recv(tensor, src=src, group=group, sync_op=False)
+    return _Task(out)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """communication/wait parity: sync the tensor's pending work."""
+    arr = unwrap(tensor) if isinstance(tensor, Tensor) else tensor
+    if hasattr(arr, "block_until_ready"):
+        arr.block_until_ready()
+    return tensor
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Older spelling of all_to_all (same list-in/list-out contract)."""
+    return all_to_all(out_tensor_list, in_tensor_list, group=group,
+                      sync_op=sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """communication/all_to_all.py alltoall_single parity on the GLOBAL
+    array view: the leading dim shards over the group axis (rank r owns
+    chunk r), each rank's chunk splits into nranks sub-chunks, and the
+    exchange transposes sub-chunk ownership (lax.all_to_all in-graph —
+    the collective that actually rides ICI). Needs the leading dim
+    divisible by nranks^2 (global chunking x per-rank split). Uneven
+    split sizes are not represented."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "alltoall_single with explicit split sizes is not supported; "
+            "the XLA all_to_all splits the leading dim evenly")
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .collective import _axis
+
+    mesh, axes = _axis(group)
+    arr = unwrap(in_tensor) if isinstance(in_tensor, Tensor) \
+        else jnp.asarray(in_tensor)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    if arr.shape[0] % (n * n):
+        raise ValueError(
+            f"alltoall_single: leading dim {arr.shape[0]} must be "
+            f"divisible by nranks^2 ({n * n}) — global chunk per rank, "
+            "then one sub-chunk per destination")
+    from jax.sharding import NamedSharding, PartitionSpec
+    from jax import shard_map
+
+    spec = PartitionSpec(axes[0], *([None] * (arr.ndim - 1)))
+    fn = jax.jit(shard_map(
+        lambda x: lax.all_to_all(x, axes[0], split_axis=0, concat_axis=0,
+                                 tiled=True),
+        mesh=mesh, in_specs=(spec,), out_specs=spec))
+    out = fn(jax.device_put(arr, NamedSharding(mesh, spec)))
+    joined = wrap(out)
+    if out_tensor is not None and isinstance(out_tensor, Tensor):
+        out_tensor._array = joined._array
+        return out_tensor
+    return joined
+
+
+def get_backend(group=None) -> str:
+    return "XLA"
+
+
+def is_available() -> bool:
+    return True
+
+
+def destroy_process_group(group=None):
+    """Tear down the default group's cached mesh view. Sub-groups are
+    stateless mesh views — destroying one is a no-op."""
+    from . import collective
+
+    if group is None or group is collective._default_group[0]:
+        collective._default_group[0] = None
+
+
+class ReduceType:
+    """auto_parallel reduce kinds (placement Partial's reduce_type)."""
+
+    kRedSum = "sum"
+    kRedMax = "max"
+    kRedMin = "min"
+    kRedProd = "prod"
+    kRedAvg = "avg"
+    kRedAny = "any"
+    kRedAll = "all"
+
+
+class ParallelMode:
+    """fleet.base.topology ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ParallelEnv:
+    """Legacy env probe (parallel.py ParallelEnv): rank/world/device."""
+
+    @property
+    def rank(self) -> int:
+        from .env import get_rank
+
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        from .env import get_world_size
+
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        try:
+            return jax.local_devices()[0].id
+        except Exception:
+            return 0
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def local_rank(self) -> int:
+        return self.rank
+
+
+class Strategy:
+    """auto_parallel/strategy.py Strategy parity: the sub-config OBJECT
+    spelling (``s.sharding.stage = 3``, ``s.pipeline.schedule_mode =
+    "VPP"``) over the SAME live config records DistributedStrategy
+    exposes as ``*_configs`` — one knob store, two reference spellings.
+    Pass to fleet.init/to_static wherever a DistributedStrategy goes."""
+
+    def __init__(self):
+        from .strategy import DistributedStrategy
+
+        # composition, not inheritance: DistributedStrategy's `amp` /
+        # `recompute` properties return ENABLE BOOLS (the fleet spelling),
+        # while this surface must return the config objects
+        object.__setattr__(self, "_ds", DistributedStrategy())
+
+    @property
+    def sharding(self):
+        return self._ds._sharding
+
+    @property
+    def pipeline(self):
+        return self._ds._pipeline
+
+    @property
+    def amp(self):
+        return self._ds._amp
+
+    @property
+    def recompute(self):
+        return self._ds._recompute
+
+    @property
+    def gradient_merge(self):
+        return self._ds._gradient_merge
+
+    @property
+    def hybrid_configs(self):
+        return self._ds.hybrid_configs
+
+    @hybrid_configs.setter
+    def hybrid_configs(self, cfg):
+        self._ds.hybrid_configs = cfg
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_ds"), name)
+
+    def unwrap(self):
+        """The underlying DistributedStrategy (what fleet.init consumes)."""
+        return self._ds
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """auto_parallel/api.py dtensor_from_fn parity: build with ``fn`` then
+    place as a dist tensor."""
+    from .api import shard_tensor
+
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+class ShardDataloader:
+    """auto_parallel shard_dataloader result: iterates the wrapped loader,
+    placing array fields as dist tensors on ``mesh`` (batch dim 0 sharded
+    over the chosen MESH dim, everything else replicated). Dict batches
+    place every value — or only ``input_keys`` when given (other keys
+    pass through untouched)."""
+
+    def __init__(self, dataloader, mesh, placements, input_keys=None):
+        self._loader = dataloader
+        self._mesh = mesh
+        self._placements = placements
+        self._keys = set(input_keys) if input_keys is not None else None
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _place(self, x):
+        from .api import shard_tensor
+
+        return shard_tensor(x, self._mesh, self._placements)
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                yield {k: (self._place(v)
+                           if self._keys is None or k in self._keys else v)
+                       for k, v in batch.items()}
+            elif isinstance(batch, (list, tuple)):
+                yield type(batch)(self._place(b) for b in batch)
+            else:
+                yield self._place(batch)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    """auto_parallel/api.py shard_dataloader parity: ``shard_dims`` names
+    the MESH dimension (str name or int index) the BATCH dim shards over;
+    other mesh dims replicate. Default: the 'dp' dim when the mesh has
+    one, else mesh dim 0."""
+    from .placements import Replicate, Shard
+
+    if is_dataset_splitted:
+        raise NotImplementedError(
+            "is_dataset_splitted=True (pre-split per-rank datasets) is not "
+            "supported; the single-controller loader sees the global batch")
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    names = list(getattr(mesh, "dim_names", ()) or ())
+    rank = len(names) or getattr(getattr(mesh, "mesh", None), "ndim", 1)
+    if shard_dims is None:
+        mesh_dim = names.index("dp") if "dp" in names else 0
+    elif isinstance(shard_dims, str):
+        if shard_dims not in names:
+            raise ValueError(
+                f"shard_dims {shard_dims!r} is not a mesh dim of {names}")
+        mesh_dim = names.index(shard_dims)
+    else:
+        mesh_dim = int(shard_dims)
+    placements = [Replicate() for _ in range(rank)]
+    placements[mesh_dim] = Shard(0)
+    return ShardDataloader(dataloader, mesh, placements,
+                           input_keys=input_keys)
+
+
+def shard_scaler(scaler):
+    """auto_parallel shard_scaler parity: under GSPMD the GradScaler's
+    found-inf reduction already runs over global arrays — the scaler is
+    returned unchanged (the reference rewires its per-rank all-reduce)."""
+    return scaler
+
+
+class DistModel:
+    """auto_parallel/api.py DistModel: the mode-switched callable over the
+    compiled hybrid-parallel step. ``train()``/``eval()`` pick the mode;
+    calling with (inputs, labels) returns the loss in train/eval and the
+    model outputs in predict mode."""
+
+    def __init__(self, model, loss_fn=None, optimizer=None, strategy=None):
+        from .engine import parallelize
+
+        self._model = model
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self._mode = "train"
+        self._step = (parallelize(model, loss_fn, optimizer,
+                                  strategy=strategy)
+                      if loss_fn is not None and optimizer is not None
+                      else None)
+
+    def train(self):
+        self._mode = "train"
+        return self
+
+    def eval(self):
+        self._mode = "eval"
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        return self
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def state_dict(self, *a, **k):
+        return self._model.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._model.set_state_dict(*a, **k)
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            if self._step is None:
+                raise ValueError(
+                    "DistModel train mode needs loss_fn and optimizer "
+                    "(dist.to_static(model, loss_fn, optimizer))")
+            return self._step(*args)
+        if self._mode == "eval":
+            if self._loss_fn is None:
+                raise ValueError("DistModel eval mode needs a loss_fn")
+            from ..autograd import tape as _tape
+
+            with _tape.no_grad():
+                return self._loss_fn(self._model, *args)
+        from ..autograd import tape as _tape
+
+        with _tape.no_grad():
+            return self._model(*args)
+
+
+def to_static(model, loader=None, loss_fn=None, optimizer=None,
+              strategy=None):
+    """auto_parallel/api.py:2798 to_static parity: returns the DistModel
+    (the reference's single return). A ``loader`` is accepted for
+    signature parity — shard the input pipeline separately with
+    ``shard_dataloader`` (the loader itself is not rewrapped here)."""
+    return DistModel(model, loss_fn, optimizer, strategy)

@@ -1,0 +1,9 @@
+"""paddle.distributed.io parity (python/paddle/distributed/io.py): the
+save/load helpers a distributed trainer reaches through the distributed
+namespace. The sharded-checkpoint pair (save_state_dict/load_state_dict
+with reshard-on-load) lives in distributed.checkpoint and is re-exported
+here; whole-object save/load delegate to the framework io."""
+from ..framework_io import load, save  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+
+__all__ = ["save", "load", "save_state_dict", "load_state_dict"]

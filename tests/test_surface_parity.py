@@ -24,6 +24,23 @@ NAMESPACES = {
     "metric/__init__.py": ("paddle_tpu.metric", {}),
     "fft.py": ("paddle_tpu.fft", {}),
     "audio/__init__.py": ("paddle_tpu.audio", {}),
+    "nn/__init__.py": ("paddle_tpu.nn", {}),
+    "distributed/__init__.py": ("paddle_tpu.distributed", {
+        # parameter-server stack — SURVEY §2.5 sanctioned non-goal
+        "CountFilterEntry": "PS sparse-table entry config",
+        "ProbabilityEntry": "PS sparse-table entry config",
+        "ShowClickEntry": "PS sparse-table entry config",
+        "InMemoryDataset": "PS input pipeline; paddle.io covers",
+        "QueueDataset": "PS input pipeline; paddle.io covers",
+        # gloo CPU rendezvous backend — the TCPStore daemon is the
+        # bootstrap here; collectives ride XLA
+        "gloo_barrier": "gloo backend; TCPStore.barrier covers",
+        "gloo_init_parallel_env": "gloo backend; init_parallel_env covers",
+        "gloo_release": "gloo backend",
+        # legacy fleet op-style layer factory, superseded in-reference by
+        # the meta_parallel layers this build ships (Column/Row/Vocab)
+        "split": "legacy fleet.split layer factory; parallel_layers cover",
+    }),
 }
 
 
