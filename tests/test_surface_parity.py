@@ -25,6 +25,16 @@ NAMESPACES = {
     "fft.py": ("paddle_tpu.fft", {}),
     "audio/__init__.py": ("paddle_tpu.audio", {}),
     "nn/__init__.py": ("paddle_tpu.nn", {}),
+    "vision/__init__.py": ("paddle_tpu.vision", {}),
+    "vision/transforms/__init__.py": ("paddle_tpu.vision.transforms", {}),
+    "vision/ops.py": ("paddle_tpu.vision.ops", {}),
+    "optimizer/__init__.py": ("paddle_tpu.optimizer", {}),
+    "optimizer/lr.py": ("paddle_tpu.optimizer.lr", {}),
+    "static/__init__.py": ("paddle_tpu.static", {}),
+    "text/__init__.py": ("paddle_tpu.text", {}),
+    "geometric/__init__.py": ("paddle_tpu.geometric", {}),
+    "sparse/__init__.py": ("paddle_tpu.sparse", {}),
+    "distribution/__init__.py": ("paddle_tpu.distribution", {}),
     "distributed/__init__.py": ("paddle_tpu.distributed", {
         # parameter-server stack — SURVEY §2.5 sanctioned non-goal
         "CountFilterEntry": "PS sparse-table entry config",
@@ -49,7 +59,20 @@ def _ref_all(rel):
     m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
     if m is None:
         return set()
-    return set(re.findall(r"['\"]([A-Za-z_0-9]+)['\"]", m.group(1)))
+    names = set(re.findall(r"['\"]([A-Za-z_0-9]+)['\"]", m.group(1)))
+    # `__all__.extend(submodule.__all__)` (distribution/__init__.py:88):
+    # pull the extended submodule's literal list in too
+    for sub in re.findall(r"__all__\.extend\(\s*([A-Za-z_0-9]+)\.__all__",
+                          src):
+        subpath = os.path.join(os.path.dirname(os.path.join(REF, rel)),
+                               f"{sub}.py")
+        if os.path.exists(subpath):
+            sm = re.search(r"__all__\s*=\s*\[(.*?)\]",
+                           open(subpath).read(), re.S)
+            if sm:
+                names |= set(re.findall(r"['\"]([A-Za-z_0-9]+)['\"]",
+                                        sm.group(1)))
+    return names
 
 
 @pytest.mark.parametrize("rel", sorted(NAMESPACES))
