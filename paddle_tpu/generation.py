@@ -1139,7 +1139,9 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     hypothesis pool scored by sum-logprob / len**length_penalty); returns
     each row's best hypothesis.
 
-    ``attention_mask`` [B, S0] (1 = real token, right padding) makes
+    ``attention_mask`` [B, S0] (1 = real token; right- OR
+    left-padded rows — HF tokenizer output works directly, left pads
+    roll to the internal right-padded layout exactly) makes
     ragged batches correct: pad columns are never attended, RoPE positions
     continue per row from each row's true length, and the first sampled
     token reads each row's last real logit.
@@ -1205,18 +1207,31 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
         am = unwrap(attention_mask) if isinstance(attention_mask, Tensor) \
             else jnp.asarray(attention_mask)
         lengths = am.astype(jnp.int32).sum(1)
-        # RIGHT padding only: RoPE positions, the cache write layout, and
-        # the last-real-logit gather all assume each row's real tokens are
-        # a CONTIGUOUS PREFIX. Left padding (HF's generation convention) or
-        # interior holes would silently rotate/gather at wrong positions —
-        # fail loudly instead.
+        # The internal layout is RIGHT-padded: RoPE positions, the cache
+        # write layout, and the last-real-logit gather all assume each
+        # row's real tokens are a CONTIGUOUS PREFIX. LEFT-padded prompts
+        # (HF's generation convention) are accepted by rolling each row's
+        # suffix to the front — generated tokens are pad-layout-invariant,
+        # so this is exact. Interior holes still fail loudly.
         prefix = jnp.arange(S0)[None, :] < lengths[:, None]
-        if bool((am.astype(bool) != prefix).any()):
-            raise ValueError(
-                "generate(attention_mask=...) expects RIGHT-padded prompts "
-                "(each row's mask is 1s then 0s); got a left-padded or "
-                "non-contiguous mask. Re-pad on the right — ragged batches "
-                "are exact in this layout.")
+        amb = am.astype(bool)
+        if bool((amb != prefix).any()):
+            suffix = jnp.arange(S0)[None, :] >= (S0 - lengths)[:, None]
+            # PER-ROW gate: rows may mix right- and left-padded layouts
+            # (each contiguous); only interior holes are invalid
+            is_prefix = (amb == prefix).all(axis=1)
+            is_suffix = (amb == suffix).all(axis=1)
+            if not bool((is_prefix | is_suffix).all()):
+                raise ValueError(
+                    "generate(attention_mask=...) expects right- or "
+                    "left-padded prompts (contiguous real tokens); got a "
+                    "mask with interior holes.")
+            # roll left-padded rows' suffix to the front (right-padded
+            # rows shift by 0)
+            shifts = jnp.where(is_prefix, 0, S0 - lengths)[:, None]
+            idx = (jnp.arange(S0)[None, :] + shifts) % S0
+            ids = jnp.take_along_axis(ids, idx, axis=1)
+            am = jnp.take_along_axis(am, idx, axis=1)
         if bool((lengths < 1).any()):
             raise ValueError(
                 "generate(attention_mask=...): every row needs at least one "

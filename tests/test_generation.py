@@ -269,13 +269,13 @@ def test_ragged_paged_decode_matches_dense(tiny_model):
 
 
 def test_left_padded_mask_rejected(tiny_model):
-    """Left padding (HF generation convention) would silently compute
-    wrong RoPE positions in this layout — it must fail loudly."""
+    """Non-contiguous masks (interior holes) must fail loudly; left
+    padding is supported since r5 (rolled to the internal right-padded
+    layout — test_left_padded_prompts_match_right_padded)."""
     cfg = tiny_model.config
     ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 5))
-    for bad in ([[0, 0, 1, 1, 1], [1, 1, 1, 1, 1]],     # left padding
-                [[1, 0, 1, 1, 0], [1, 1, 1, 1, 1]]):    # interior hole
-        with pytest.raises(ValueError, match="RIGHT-padded"):
+    for bad in ([[1, 0, 1, 1, 0], [1, 1, 1, 1, 1]],):   # interior hole
+        with pytest.raises(ValueError, match="interior holes"):
             tiny_model.generate(
                 paddle.to_tensor(ids), max_new_tokens=3,
                 attention_mask=paddle.to_tensor(np.array(bad, "int64")))
@@ -701,3 +701,48 @@ class TestAdviceRegressions:
                 tracker.append(b, t)
         np.testing.assert_array_equal(tracker.banned(vocab),
                                       _ngram_banned(hist, n, vocab))
+
+
+def test_left_padded_prompts_match_right_padded(tiny_model):
+    """HF-convention LEFT padding (r5: was a raise): internally rolled to
+    the right-padded layout — rows decode exactly like their solo runs,
+    greedy and beamed; interior holes still fail loudly."""
+    cfg = tiny_model.config
+    rng = np.random.RandomState(11)
+    a = rng.randint(1, cfg.vocab_size, (1, 3))
+    b = rng.randint(1, cfg.vocab_size, (1, 6))
+    batch = np.zeros((2, 6), a.dtype)
+    batch[0, 3:] = a[0]
+    batch[1] = b[0]
+    left = np.array([[0, 0, 0, 1, 1, 1], [1, 1, 1, 1, 1, 1]], "int64")
+
+    for kw in (dict(), dict(num_beams=2, eos_token_id=5)):
+        solo_a = tiny_model.generate(paddle.to_tensor(a),
+                                     max_new_tokens=4, **kw).numpy()
+        solo_b = tiny_model.generate(paddle.to_tensor(b),
+                                     max_new_tokens=4, **kw).numpy()
+        out = tiny_model.generate(
+            paddle.to_tensor(batch), max_new_tokens=4,
+            attention_mask=paddle.to_tensor(left), **kw).numpy()
+        n = min(out.shape[1], solo_a.shape[1])
+        np.testing.assert_array_equal(out[0, :n], solo_a[0, :n])
+        n = min(out.shape[1], solo_b.shape[1])
+        np.testing.assert_array_equal(out[1, :n], solo_b[0, :n])
+
+    hole = np.array([[1, 0, 1, 1, 1, 1], [1, 1, 1, 1, 1, 1]], "int64")
+    with pytest.raises(ValueError, match="interior holes"):
+        tiny_model.generate(paddle.to_tensor(batch), max_new_tokens=2,
+                            attention_mask=paddle.to_tensor(hole))
+
+    # MIXED layouts: row 0 right-padded, row 1 left-padded — both valid
+    mixed_batch = np.zeros((2, 6), a.dtype)
+    mixed_batch[0, :3] = a[0]
+    mixed_batch[1] = b[0]
+    mixed = np.array([[1, 1, 1, 0, 0, 0], [1, 1, 1, 1, 1, 1]], "int64")
+    out = tiny_model.generate(paddle.to_tensor(mixed_batch),
+                              max_new_tokens=4,
+                              attention_mask=paddle.to_tensor(mixed)).numpy()
+    solo_a = tiny_model.generate(paddle.to_tensor(a), max_new_tokens=4).numpy()
+    solo_b = tiny_model.generate(paddle.to_tensor(b), max_new_tokens=4).numpy()
+    np.testing.assert_array_equal(out[0], solo_a[0])
+    np.testing.assert_array_equal(out[1], solo_b[0])
