@@ -25,3 +25,10 @@ def __getattr__(name):
         globals()[name] = getattr(_f, name)
         return globals()[name]
     raise AttributeError(f"module 'paddle_tpu.autograd' has no attribute {name!r}")
+
+
+def __dir__():
+    # lazy names must be introspectable, not just gettable
+    return sorted(set(globals()) | {"PyLayer", "PyLayerContext",
+                                    "jacobian", "hessian",
+                                    "saved_tensors_hooks"})

@@ -1,12 +1,9 @@
 """paddle.callbacks parity (python/paddle/callbacks.py): re-export of the
 hapi callback family."""
 from .hapi.callbacks import (  # noqa: F401
-    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger)
-
-try:  # optional extras if present
-    from .hapi.callbacks import ReduceLROnPlateau, VisualDL  # noqa: F401
-except ImportError:  # pragma: no cover
-    pass
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau, VisualDL, WandbCallback)
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping"]
+           "EarlyStopping", "ReduceLROnPlateau", "VisualDL",
+           "WandbCallback"]

@@ -219,6 +219,132 @@ class LRScheduler(Callback):
             s.step()
 
 
+class ReduceLROnPlateau(Callback):
+    """callbacks.py ReduceLROnPlateau parity: when the monitored metric
+    stops improving for ``patience`` epochs, multiply the optimizer's
+    learning rate by ``factor`` (not below ``min_lr``)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self._best = None
+        self._wait = 0
+        self._cooldown_left = 0
+        self._eval_fired = False
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self.mode == "min":
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        # the reference monitors the EVAL metric; once an eval has fired,
+        # epoch-end train logs are ignored (firing on both would double-
+        # count patience and mix train/eval values of the same name)
+        self._eval_fired = True
+        self._check(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self._eval_fired:
+            self._check(logs)
+
+    def _check(self, logs):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cooldown_left > 0:
+            # cooldown suppresses patience counting entirely (Keras/paddle
+            # semantics), it does not just reset the counter
+            self._cooldown_left -= 1
+            self._wait = 0
+            if self._better(cur):
+                self._best = cur
+            return
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            old = float(opt.get_lr())
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+            self._cooldown_left = self.cooldown
+            self._wait = 0
+
+
+class VisualDL(Callback):
+    """callbacks.py VisualDL parity. The visualdl wheel (its binary log
+    format + web UI) is not in this image, so scalars are written as
+    newline-JSON records under ``log_dir`` — the same data stream, a
+    portable format."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._last_step = 0
+        self._eval_count = 0
+
+    def _write(self, tag, step, logs):
+        import json
+        import os
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, "vdlrecords.jsonl")
+        metrics = {k: (float(v[0]) if isinstance(v, (list, tuple)) else
+                       float(v))
+                   for k, v in (logs or {}).items()
+                   if isinstance(v, (int, float)) or
+                   (isinstance(v, (list, tuple)) and v and
+                    isinstance(v[0], (int, float)))}
+        if not metrics:
+            return
+        with open(path, "a") as f:
+            f.write(json.dumps({"tag": tag, "step": step,
+                                **metrics}) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._last_step = step
+        self._write("train", step, logs)
+
+    def on_eval_end(self, logs=None):
+        self._eval_count += 1
+        self._write("eval", self._eval_count, logs)
+
+
+class WandbCallback(Callback):
+    """callbacks.py WandbCallback surface: the wandb SDK (a network
+    service client) is not in this image — constructing raises with
+    guidance rather than silently not logging."""
+
+    def __init__(self, *args, **kwargs):
+        raise ImportError(
+            "WandbCallback needs the `wandb` SDK, which is not available "
+            "in this image; use VisualDL (local JSONL scalars) or a "
+            "custom Callback instead")
+
+
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
                      verbose=1, save_freq=1, save_dir=None, metrics=None,
                      mode="train"):

@@ -18,7 +18,8 @@ from ..nn.layer import Layer
 from ..ops.registry import apply
 from ..tensor_class import Tensor, unwrap, wrap
 
-__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
+__all__ = ["QuantConfig", "QAT", "PTQ", "BaseQuanter", "BaseObserver",
+           "FakeQuanterWithAbsMaxObserver",
             "AbsMaxObserver", "QuanterFactory", "quanter"]
 
 
@@ -44,7 +45,27 @@ def quanter(name):  # decorator parity (quantization/factory.py)
     return deco
 
 
-class FakeQuanterWithAbsMaxObserver(Layer):
+class BaseQuanter(Layer):
+    """quantization/base_quanter.py parity: abstract quanter — forward
+    fake-quantizes, ``scales()``/``zero_points()`` expose the learned
+    quantization params."""
+
+    def forward(self, x):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def scales(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None  # symmetric quantization throughout this build
+
+
+class BaseObserver(BaseQuanter):
+    """quantization/base_observer.py parity: calibration-time observer —
+    forward passes through while tracking statistics."""
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
     """QAT activation/weight quanter (fake_quanter.py parity): moving
     average abs-max scale + straight-through-estimator rounding."""
 
@@ -86,7 +107,7 @@ class FakeQuanterWithAbsMaxObserver(Layer):
         return self._scale
 
 
-class AbsMaxObserver(Layer):
+class AbsMaxObserver(BaseObserver):
     """PTQ observer (observers/abs_max.py parity): track abs-max, no
     quantization during calibration."""
 

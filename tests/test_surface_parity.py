@@ -35,6 +35,15 @@ NAMESPACES = {
     "geometric/__init__.py": ("paddle_tpu.geometric", {}),
     "sparse/__init__.py": ("paddle_tpu.sparse", {}),
     "distribution/__init__.py": ("paddle_tpu.distribution", {}),
+    "incubate/__init__.py": ("paddle_tpu.incubate", {}),
+    "callbacks.py": ("paddle_tpu.callbacks", {}),
+    "hub.py": ("paddle_tpu.hub", {}),
+    "jit/__init__.py": ("paddle_tpu.jit", {}),
+    "profiler/__init__.py": ("paddle_tpu.profiler", {}),
+    "quantization/__init__.py": ("paddle_tpu.quantization", {}),
+    "regularizer.py": ("paddle_tpu.regularizer", {}),
+    "sysconfig.py": ("paddle_tpu.sysconfig", {}),
+    "autograd/__init__.py": ("paddle_tpu.autograd", {}),
     "distributed/__init__.py": ("paddle_tpu.distributed", {
         # parameter-server stack — SURVEY §2.5 sanctioned non-goal
         "CountFilterEntry": "PS sparse-table entry config",

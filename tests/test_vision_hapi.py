@@ -267,3 +267,56 @@ def test_viterbi_decode_matches_bruteforce():
                 best, best_path = s, path
         np.testing.assert_allclose(float(scores.numpy()[i]), best, rtol=1e-5)
         assert paths.numpy()[i, :int(lens[i])].tolist() == list(best_path)
+
+
+def test_reduce_lr_on_plateau_and_visualdl(tmp_path):
+    """callbacks.py ReduceLROnPlateau (lr drops after a plateau) and
+    VisualDL (JSONL scalar records under log_dir)."""
+    import json
+
+    from paddle_tpu.callbacks import ReduceLROnPlateau, VisualDL
+
+    class FakeOpt:
+        def __init__(self):
+            self._lr = 0.1
+        def get_lr(self):
+            return self._lr
+        def set_lr(self, v):
+            self._lr = v
+
+    class FakeModel:
+        pass
+
+    m = FakeModel()
+    m._optimizer = FakeOpt()
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           verbose=0)
+    cb.set_model(m)
+    for epoch, loss in enumerate([1.0, 0.5, 0.5, 0.5]):  # plateau from e1
+        cb.on_epoch_end(epoch, {"loss": loss})
+    assert abs(m._optimizer.get_lr() - 0.05) < 1e-9  # one halving
+
+    # eval metrics take over once seen (no double counting of patience),
+    # and cooldown SUPPRESSES counting
+    m2 = FakeModel(); m2._optimizer = FakeOpt()
+    cb2 = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                            cooldown=2, verbose=0)
+    cb2.set_model(m2)
+    for epoch in range(6):
+        cb2.on_epoch_end(epoch, {"loss": 123.0})   # train logs: ignored...
+        cb2.on_eval_end({"loss": 1.0})             # ...once eval fires
+    # flat eval loss, patience 1, cooldown 2: reductions at e1, e4 only
+    assert abs(m2._optimizer.get_lr() - 0.1 * 0.25) < 1e-9
+
+    vdl = VisualDL(log_dir=str(tmp_path))
+    vdl.on_train_batch_end(7, {"loss": 1.5})       # the MODEL's step number
+    vdl.on_eval_end({"acc": [0.75]})
+    recs = [json.loads(l) for l in
+            open(tmp_path / "vdlrecords.jsonl").read().splitlines()]
+    assert recs[0]["tag"] == "train" and recs[0]["loss"] == 1.5
+    assert recs[0]["step"] == 7                     # not a private counter
+    assert recs[1]["tag"] == "eval" and recs[1]["acc"] == 0.75
+
+    from paddle_tpu.callbacks import WandbCallback
+    with pytest.raises(ImportError, match="wandb"):
+        WandbCallback()
