@@ -171,7 +171,7 @@ def _install_method_tail(T):
         "histogramdd", "increment", "multiplex", "floor_mod", "isneginf",
         "isposinf", "isreal", "gammaincc", "gammainc", "concat", "reverse",
         "stack", "nanquantile", "broadcast_tensors", "as_complex", "as_real",
-        "bucketize", "trapezoid", "polar", "nextafter", "i0", "i0e", "i1",
+        "bucketize", "combinations", "trapezoid", "polar", "nextafter", "i0", "i0e", "i1",
         "i1e", "polygamma", "multinomial", "renorm", "bitwise_left_shift",
         "bitwise_right_shift", "atleast_1d", "atleast_2d", "atleast_3d",
         "sinc", "multigammaln", "isin", "sgn", "frexp", "signbit",

@@ -95,3 +95,17 @@ def test_namespace_surface(rel):
     assert not missing, (
         f"{ours_path} is missing {len(missing)} reference exports: "
         f"{missing}")
+
+
+def test_tensor_method_table():
+    """The reference's monkey-patched Tensor method table
+    (python/paddle/tensor/__init__.py::tensor_method_func, 386 names)
+    must fully resolve on paddle_tpu.Tensor."""
+    src = open(os.path.join(REF, "tensor/__init__.py")).read()
+    m = re.search(r"tensor_method_func\s*=\s*\[(.*?)\]", src, re.S)
+    ref = set(re.findall(r"['\"]([A-Za-z_0-9]+)['\"]", m.group(1)))
+    assert len(ref) > 350
+    import paddle_tpu
+
+    missing = sorted(ref - set(dir(paddle_tpu.Tensor)))
+    assert not missing, f"Tensor is missing {len(missing)} methods: {missing}"
