@@ -119,3 +119,114 @@ def distributed_optimizer(optimizer, strategy=None):
 
 def get_hybrid_communicate_group_():
     return fleet.get_hybrid_communicate_group()
+
+
+# ---------------------------------------------------------------------------
+# reference fleet surface tail (fleet/__init__.py __all__): Fleet class,
+# role makers, UtilBase. The PS server role never activates here (SURVEY
+# §2.5: parameter-server is a sanctioned non-goal) — role makers exist for
+# collective jobs and config compatibility.
+# ---------------------------------------------------------------------------
+from .topology import CommunicateTopology  # noqa: F401,E402
+
+Fleet = _Fleet
+
+
+class Role:
+    """fleet.base.role_maker Role constants."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Collective role maker reading the launcher's env
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM — what
+    paddle_tpu.distributed.launch exports)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        # both modes describe a WORKER here; parameter-server roles never
+        # activate (is_server() is always False) — SURVEY §2.5 non-goal
+        self._is_collective = bool(is_collective)
+
+    def _role(self):
+        return Role.WORKER
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_index(self):
+        return _env.get_rank()
+
+    def worker_num(self):
+        return _env.get_world_size()
+
+    def role_id(self):
+        return self.worker_index()
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Role maker with explicit ids instead of env probing."""
+
+    def __init__(self, is_collective=False, current_id=0, role=Role.WORKER,
+                 worker_num=1, server_endpoints=None, **kwargs):
+        super().__init__(is_collective=is_collective)
+        if role != Role.WORKER:
+            raise NotImplementedError(
+                "only Role.WORKER is supported (no parameter servers)")
+        self._current_id = int(current_id)
+        self._worker_num = int(worker_num)
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num
+
+
+class UtilBase:
+    """fleet.utils UtilBase: small cross-worker helpers over the
+    collective facade."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np  # noqa: F811 (local: fleet.py has no np import)
+
+        from ..tensor_class import Tensor
+        from . import collective
+
+        t = input if isinstance(input, Tensor) else None
+        if t is None:
+            import paddle_tpu as paddle
+
+            t = paddle.to_tensor(np.asarray(input))
+        op = {"sum": collective.ReduceOp.SUM, "max": collective.ReduceOp.MAX,
+              "min": collective.ReduceOp.MIN}[mode]
+        # reference contract (util_factory.py:96): returns a numpy array
+        return np.asarray(collective.all_reduce(t, op=op).numpy())
+
+    def barrier(self, comm_world="worker"):
+        from .collective import barrier
+
+        barrier()
+
+    def get_file_shard(self, files):
+        """This worker's CONTIGUOUS block of the caller's file list, in the
+        caller's order (util_factory.py:257: the first ``len % world``
+        trainers take one extra file) — round-robin or re-sorting would
+        change shard composition vs reference runs."""
+        rank, world = _env.get_rank(), max(_env.get_world_size(), 1)
+        base, extra = divmod(len(files), world)
+        start = rank * base + min(rank, extra)
+        return list(files[start:start + base + (1 if rank < extra else 0)])
+
+
+fleet.util = UtilBase()

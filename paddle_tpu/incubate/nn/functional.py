@@ -412,3 +412,104 @@ def variable_length_memory_efficient_attention(
 
 
 
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """incubate blha_get_max_len parity (blha_get_max_len.py:26): the max
+    encoder/decoder lengths the block-attention serving step needs for its
+    grid sizing. Returns (max_enc_len, max_dec_len) as scalar tensors."""
+    from ...tensor_class import unwrap, wrap
+
+    enc = jnp.max(unwrap(seq_lens_encoder))
+    dec = jnp.max(unwrap(seq_lens_decoder))
+    return wrap(enc.reshape(1)), wrap(dec.reshape(1))
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """incubate masked_multihead_attention parity
+    (masked_multihead_attention.py:51 over the CUDA fused decode kernel):
+    ONE decode step per row against the [2, B, H, max_len, D] inline
+    cache. The core contract — fused qkv input [B, 3*H*D] (+optional
+    [3, H, D] bias), per-row write positions, additive src_mask, cache
+    updated in place — is implemented; the CUDA-side quant/rotary/beam
+    extras raise (the TPU serving path does RoPE in the model and
+    quantizes weights, not activations)."""
+    from ...tensor_class import unwrap, wrap
+
+    for arg, name_ in ((rotary_tensor, "rotary_tensor"),
+                       (beam_cache_offset, "beam_cache_offset"),
+                       (qkv_out_scale, "qkv_out_scale"),
+                       (out_shift, "out_shift"), (out_smooth, "out_smooth"),
+                       (cum_offsets, "cum_offsets")):
+        if arg is not None:
+            raise NotImplementedError(
+                f"masked_multihead_attention: {name_} is a CUDA-kernel "
+                "extra; the TPU serving path applies RoPE in the model "
+                "and quantizes weights (nn.quant), not activations")
+    if out_scale != -1:
+        raise NotImplementedError(
+            "masked_multihead_attention: activation quant (out_scale) is "
+            "not supported; use nn.quant weight-only serving")
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention needs cache_kv "
+                         "[2, B, H, max_len, D]")
+    ck = unwrap(cache_kv)
+    _, B, H, T, D = ck.shape
+    qkv = unwrap(x).reshape(B, 3, H, D)
+    if bias is not None:
+        qkv = qkv + unwrap(bias)[None]
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # [B, H, D]
+    if sequence_lengths is not None:
+        pos = unwrap(sequence_lengths).reshape(B).astype(jnp.int32)
+    else:
+        pos = jnp.zeros((B,), jnp.int32)
+    rows = jnp.arange(B)
+    k_cache = ck[0].at[rows, :, pos].set(k.astype(ck.dtype))
+    v_cache = ck[1].at[rows, :, pos].set(v.astype(ck.dtype))
+    t_idx = jnp.arange(T)
+    valid = t_idx[None, :] <= pos[:, None]              # [B, T]
+    scores = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) / jnp.sqrt(
+                            jnp.asarray(D, jnp.float32))
+    scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+    if src_mask is not None:
+        sm = unwrap(src_mask).astype(jnp.float32)
+        scores = scores + sm.reshape(B, 1, -1)[:, :, :T]
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bht,bhtd->bhd", probs,
+                     v_cache.astype(jnp.float32)).astype(unwrap(x).dtype)
+    new_cache = jnp.stack([k_cache, v_cache])
+    from ...tensor_class import Tensor as _T
+
+    if isinstance(cache_kv, _T):
+        # honor the reference's in-place mutation contract: callers that
+        # pass the same cache Tensor every step (discarding the return)
+        # must see the update
+        cache_kv._array = new_cache
+        return wrap(out.reshape(B, H * D)), cache_kv
+    return wrap(out.reshape(B, H * D)), wrap(new_cache)
+
+
+def block_multihead_attention(*args, **kwargs):
+    """The reference's CUDA paged serving mega-kernel
+    (block_multihead_attention.py:33 over
+    block_multi_head_attention_kernel.cu). Its role — mixed prefill/
+    decode over block tables inside a continuous-batching server — is
+    filled TPU-natively by ``paddle_tpu.serving.ContinuousBatchEngine``
+    (admission scatter + one fused step) over
+    ``generation.paged_cached_attention`` / ``ops.pallas.append_attention``;
+    the 20-tensor CUDA calling convention itself is not reproduced."""
+    raise NotImplementedError(
+        "block_multihead_attention's serving role is provided by "
+        "paddle_tpu.serving.ContinuousBatchEngine (paged KV + continuous "
+        "batching) and generation.paged_cached_attention; drive those "
+        "instead of the CUDA kernel's calling convention")

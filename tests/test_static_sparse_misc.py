@@ -1127,3 +1127,50 @@ def test_strings_tokenizer_surface():
     import pytest
     with pytest.raises(ValueError):
         S.concat(S.StringTensor(["a"]), S.StringTensor(["a", "b"]))
+
+
+def test_masked_multihead_attention_and_blha():
+    """incubate serving entries (r5): masked_multihead_attention's core
+    decode-step contract vs a dense reference; blha_get_max_len."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rng = np.random.RandomState(0)
+    B, H, T, D = 2, 2, 8, 4
+    cache = np.zeros((2, B, H, T, D), np.float32)
+    # preload 3 cached positions per row
+    cache[:, :, :, :3] = rng.randn(2, B, H, 3, D)
+    pos = np.array([[3], [3]], np.int64)
+    x = rng.randn(B, 3 * H * D).astype(np.float32)
+    out, new_cache = IF.masked_multihead_attention(
+        paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(pos))
+    assert out.shape == [B, H * D]
+    qkv = x.reshape(B, 3, H, D)
+    kc = cache[0].copy()
+    vc = cache[1].copy()
+    kc[:, :, 3] = qkv[:, 1]
+    vc[:, :, 3] = qkv[:, 2]
+    np.testing.assert_allclose(np.asarray(new_cache.numpy()[0]), kc,
+                               rtol=1e-6)
+    # dense reference over the 4 live positions
+    q = qkv[:, 0]
+    s = np.einsum("bhd,bhtd->bht", q, kc[:, :, :4]) / np.sqrt(D)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bht,bhtd->bhd", p, vc[:, :, :4]).reshape(B, H * D)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    enc, dec = IF.blha_get_max_len(
+        paddle.to_tensor(np.array([5, 9])),
+        paddle.to_tensor(np.array([2, 1])), paddle.to_tensor(np.array([2])))
+    assert int(enc.numpy()[0]) == 9 and int(dec.numpy()[0]) == 2
+
+    with pytest.raises(NotImplementedError, match="ContinuousBatchEngine"):
+        IF.block_multihead_attention()
+    with pytest.raises(NotImplementedError, match="rotary"):
+        IF.masked_multihead_attention(
+            paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+            rotary_tensor=paddle.to_tensor(np.zeros((1,), np.float32)))

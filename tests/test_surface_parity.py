@@ -44,6 +44,15 @@ NAMESPACES = {
     "regularizer.py": ("paddle_tpu.regularizer", {}),
     "sysconfig.py": ("paddle_tpu.sysconfig", {}),
     "autograd/__init__.py": ("paddle_tpu.autograd", {}),
+    "incubate/nn/functional/__init__.py":
+        ("paddle_tpu.incubate.nn.functional", {}),
+    "nn/initializer/__init__.py": ("paddle_tpu.nn.initializer", {}),
+    "nn/utils/__init__.py": ("paddle_tpu.nn.utils", {}),
+    "distributed/fleet/__init__.py": ("paddle_tpu.distributed.fleet", {
+        # PS input-pipeline data generators — SURVEY §2.5 non-goal
+        "MultiSlotDataGenerator": "PS slot-data pipeline",
+        "MultiSlotStringDataGenerator": "PS slot-data pipeline",
+    }),
     "distributed/__init__.py": ("paddle_tpu.distributed", {
         # parameter-server stack — SURVEY §2.5 sanctioned non-goal
         "CountFilterEntry": "PS sparse-table entry config",
