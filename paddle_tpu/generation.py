@@ -186,8 +186,10 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
 
     ``window`` (Mistral sliding-window serving): only the last ``window``
     positions attend. The bundled Pallas kernel has no lower-bound
-    masking, so windowed rows take the XLA gather path on every backend —
-    correct, HBM-unfused (a banded paged kernel is the optimization path).
+    masking, so windowed rows take the O(window) page-gather path
+    (_paged_window_attention: only the <= ceil(window/page_size)+1 pages
+    the band intersects are read — HBM cost scales with the window, not
+    the cache capacity).
 
     ``pages_per_compute_block`` defaults to the largest divisor of
     pages-per-sequence <= 8: bigger blocks amortize the kernel's grid
@@ -195,8 +197,10 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
     if window is not None:
         cache_positions = page_indices.shape[1] * k_pages.shape[2]
         if window < cache_positions:
-            return _paged_attention_ref(q, k_pages, v_pages, lengths,
-                                        page_indices, window=window)
+            # gather ONLY the pages the band can touch: O(window) work
+            # regardless of max_len — the win windowed serving exists for
+            return _paged_window_attention(q, k_pages, v_pages, lengths,
+                                           page_indices, window)
         # the band can never exclude a cached position (window >= cache
         # capacity): keep the fused Pallas kernel — e.g. Mistral-7B's
         # 4096 window served at max_len <= 4096
@@ -218,6 +222,53 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
     return _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices)
 
 
+def _paged_window_attention(q, k_pages, v_pages, lengths, page_indices,
+                            window):
+    """Sliding-window decode over the paged cache, touching only the
+    pages the band intersects (≤ ceil(window/page_size)+1 per row): HBM
+    reads scale with the WINDOW, not the cache capacity — the long-
+    context property windowed serving exists for. Pure XLA (gather +
+    MXU matmul), exact vs the full-gather reference."""
+    B, H, D = q.shape
+    hk, _n, page_size, _ = k_pages.shape
+    g = H // hk
+    wp = (window + page_size - 1) // page_size + 1     # pages the band spans
+    n_pages_per_row = page_indices.shape[1]
+    wp = min(wp, n_pages_per_row)
+    # first page the band can touch (band = [len-window, len-1])
+    first = jnp.maximum(lengths - window, 0) // page_size        # [B]
+    first = jnp.minimum(first, jnp.maximum(n_pages_per_row - wp, 0))
+    offs = first[:, None] + jnp.arange(wp)[None, :]              # [B, wp]
+    rows = jnp.take_along_axis(page_indices, offs, axis=1)       # [B, wp]
+    k = jnp.moveaxis(k_pages[:, rows], 0, 1)     # [B, hk, wp, ps, D]
+    v = jnp.moveaxis(v_pages[:, rows], 0, 1)
+    W = wp * page_size
+    k = k.reshape(B, hk, W, D)
+    v = v.reshape(B, hk, W, D)
+    # global position of each gathered column
+    colpos = (offs[:, :, None] * page_size
+              + jnp.arange(page_size)[None, None, :]).reshape(B, W)
+    valid = (colpos < lengths[:, None]) & \
+            (colpos >= (lengths[:, None] - window))
+    return _banded_sdpa(q, k, v, valid)
+
+
+def _banded_sdpa(q, k, v, valid):
+    """Shared decode-attention tail: q [B,H,D], k/v [B,hk,T,D] gathered,
+    valid [B,T] column mask — the ONE place the f32 softmax numerics of
+    the paged decode paths live."""
+    B, H, D = q.shape
+    hk = k.shape[1]
+    g = H // hk
+    qg = q.reshape(B, hk, g, D).astype(jnp.float32)
+    scores = jnp.einsum("bkgd,bktd->bkgt", qg, k.astype(jnp.float32))
+    scores = scores / math.sqrt(D)
+    scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgt,bktd->bkgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
 def _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices,
                          window=None):
     B, H, D = q.shape
@@ -228,17 +279,11 @@ def _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices,
     T = k.shape[2] * page_size
     k = k.reshape(B, hk, T, D)
     v = v.reshape(B, hk, T, D)
-    qg = q.reshape(B, hk, g, D).astype(jnp.float32)
-    scores = jnp.einsum("bkgd,bktd->bkgt", qg, k.astype(jnp.float32))
-    scores = scores / math.sqrt(D)
     valid = jnp.arange(T)[None, :] < lengths[:, None]
     if window is not None:
         # band lower bound: only the newest `window` positions attend
         valid &= jnp.arange(T)[None, :] >= (lengths[:, None] - window)
-    scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgt,bktd->bkgd", probs, v.astype(jnp.float32))
-    return out.reshape(B, H, D).astype(q.dtype)
+    return _banded_sdpa(q, k, v, valid)
 
 
 # ---------------------------------------------------------------------------
