@@ -14,9 +14,9 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .strategy import DistributedStrategy
-from .topology import HybridCommunicateGroup, set_hybrid_communicate_group, get_hybrid_communicate_group
-from . import env as _env
+from ..strategy import DistributedStrategy
+from ..topology import HybridCommunicateGroup, set_hybrid_communicate_group, get_hybrid_communicate_group
+from .. import env as _env
 
 
 class _Fleet:
@@ -49,7 +49,7 @@ class _Fleet:
         return _env.get_rank() == 0
 
     def barrier_worker(self):
-        from .collective import barrier
+        from ..collective import barrier
 
         barrier()
 
@@ -62,7 +62,7 @@ class _Fleet:
         strategy = self._strategy
 
         # pipeline topology → wrap the PipelineLayer in the micro-batch runtime
-        from .pipeline import PipelineLayer, PipelineParallel
+        from ..pipeline import PipelineLayer, PipelineParallel
 
         if isinstance(model, PipelineLayer) and hcg.get_pipe_parallel_world_size() > 1:
             return PipelineParallel(model, hcg=hcg, strategy=strategy)
@@ -70,13 +70,13 @@ class _Fleet:
         # sharding axis → FSDP-style parameter placement rewrite (ZeRO-3 when
         # stage==3, else params replicated and only state shards at opt init)
         if hcg.get_sharding_parallel_world_size() > 1 and strategy.sharding_configs.stage >= 3:
-            from .api import ShardingStage3
+            from ..api import ShardingStage3
 
             ShardingStage3(axis_name="sharding", mesh=hcg.mesh).apply(model)
 
         # recompute wrapping
         if strategy.recompute:
-            from .recompute_layer import apply_recompute
+            from ..recompute_layer import apply_recompute
 
             apply_recompute(model, strategy.recompute_configs)
 
@@ -88,13 +88,13 @@ class _Fleet:
         hcg = self._hcg
         st = strategy or self._strategy
         if hcg.get_sharding_parallel_world_size() > 1 and st.sharding_configs.stage in (1, 2):
-            from .api import shard_optimizer, ShardingStage1, ShardingStage2
+            from ..api import shard_optimizer, ShardingStage1, ShardingStage2
 
             stage_cls = ShardingStage1 if st.sharding_configs.stage == 1 else ShardingStage2
             shard_optimizer(optimizer, stage_cls(axis_name="sharding", mesh=hcg.mesh))
         gm = st.gradient_merge
         if gm.enable and int(gm.k_steps) > 1:
-            from .gradient_merge import GradientMergeOptimizer
+            from ..gradient_merge import GradientMergeOptimizer
 
             optimizer = GradientMergeOptimizer(
                 optimizer, k_steps=int(gm.k_steps), avg=bool(gm.avg))
@@ -127,7 +127,8 @@ def get_hybrid_communicate_group_():
 # §2.5: parameter-server is a sanctioned non-goal) — role makers exist for
 # collective jobs and config compatibility.
 # ---------------------------------------------------------------------------
-from .topology import CommunicateTopology  # noqa: F401,E402
+from ..topology import CommunicateTopology  # noqa: F401,E402
+from . import meta_parallel, utils  # noqa: F401,E402 (attribute chains)
 
 Fleet = _Fleet
 
@@ -200,8 +201,8 @@ class UtilBase:
     def all_reduce(self, input, mode="sum", comm_world="worker"):
         import numpy as np  # noqa: F811 (local: fleet.py has no np import)
 
-        from ..tensor_class import Tensor
-        from . import collective
+        from ...tensor_class import Tensor
+        from .. import collective
 
         t = input if isinstance(input, Tensor) else None
         if t is None:
@@ -214,7 +215,7 @@ class UtilBase:
         return np.asarray(collective.all_reduce(t, op=op).numpy())
 
     def barrier(self, comm_world="worker"):
-        from .collective import barrier
+        from ..collective import barrier
 
         barrier()
 
@@ -230,3 +231,9 @@ class UtilBase:
 
 
 fleet.util = UtilBase()
+# `import paddle_tpu.distributed.fleet as m` resolves through getattr on
+# the parent package, which yields THIS INSTANCE (it shadows the module);
+# mirror the submodules so attribute chains (m.utils.recompute,
+# m.meta_parallel.PipelineLayer) work either way
+fleet.utils = utils
+fleet.meta_parallel = meta_parallel

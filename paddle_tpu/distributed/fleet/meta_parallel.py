@@ -1,0 +1,26 @@
+"""fleet.meta_parallel parity
+(python/paddle/distributed/fleet/meta_parallel/__init__.py): the hybrid-
+parallel building blocks trainers deep-import. TPU-native homes:
+parallel_layers (TP/SP layers over GSPMD shardings), pipeline (the host
+pipeline runtime — PipelineParallel serves both the plain and the
+interleaved/VPP schedules; there is no separate WithInterleave class,
+schedule="VPP" selects it), moe (expert parallel)."""
+from ..parallel_layers import (  # noqa: F401
+    ColumnParallelLinear, ColumnSequenceParallelLinear, ParallelCrossEntropy,
+    RowParallelLinear, RowSequenceParallelLinear, VocabParallelEmbedding,
+)
+from ..pipeline import (  # noqa: F401
+    LayerDesc, PipelineLayer, PipelineParallel, SharedLayerDesc,
+)
+
+#: the reference's interleaved class; here one runtime serves every
+#: schedule (PipelineParallel(schedule="VPP"))
+PipelineParallelWithInterleave = PipelineParallel
+
+from ..moe import MoELayer  # noqa: F401,E402
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ColumnSequenceParallelLinear",
+           "RowSequenceParallelLinear", "ParallelCrossEntropy",
+           "LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineParallel", "PipelineParallelWithInterleave", "MoELayer"]

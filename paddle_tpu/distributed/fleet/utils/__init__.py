@@ -1,0 +1,7 @@
+"""fleet.utils parity (python/paddle/distributed/fleet/utils/__init__.py):
+the deep-import surface trainers actually use — ``recompute`` (activation
+checkpointing over jax.checkpoint) and the sequence-parallel helpers."""
+from ...recompute_layer import RecomputeLayer, recompute  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401
+
+__all__ = ["recompute", "RecomputeLayer", "sequence_parallel_utils"]

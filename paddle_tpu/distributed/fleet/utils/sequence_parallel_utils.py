@@ -1,0 +1,14 @@
+"""fleet.utils.sequence_parallel_utils parity
+(python/paddle/distributed/fleet/utils/sequence_parallel_utils.py): the
+Megatron-SP boundary layers/ops. TPU-native: the classes live in
+distributed.parallel_layers (GSPMD shardings + in-graph collectives);
+this module is the reference's import path for them."""
+from ...parallel_layers import (  # noqa: F401
+    AllGatherOp, ColumnSequenceParallelLinear, GatherOp,
+    ReduceScatterOp, RowSequenceParallelLinear, ScatterOp,
+    mark_as_sequence_parallel_parameter,
+)
+
+__all__ = ["ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "GatherOp", "ScatterOp", "AllGatherOp", "ReduceScatterOp",
+           "mark_as_sequence_parallel_parameter"]

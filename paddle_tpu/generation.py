@@ -229,9 +229,8 @@ def _paged_window_attention(q, k_pages, v_pages, lengths, page_indices,
     reads scale with the WINDOW, not the cache capacity — the long-
     context property windowed serving exists for. Pure XLA (gather +
     MXU matmul), exact vs the full-gather reference."""
-    B, H, D = q.shape
+    B = q.shape[0]
     hk, _n, page_size, _ = k_pages.shape
-    g = H // hk
     wp = (window + page_size - 1) // page_size + 1     # pages the band spans
     n_pages_per_row = page_indices.shape[1]
     wp = min(wp, n_pages_per_row)
@@ -243,8 +242,8 @@ def _paged_window_attention(q, k_pages, v_pages, lengths, page_indices,
     k = jnp.moveaxis(k_pages[:, rows], 0, 1)     # [B, hk, wp, ps, D]
     v = jnp.moveaxis(v_pages[:, rows], 0, 1)
     W = wp * page_size
-    k = k.reshape(B, hk, W, D)
-    v = v.reshape(B, hk, W, D)
+    k = k.reshape(B, hk, W, k_pages.shape[-1])
+    v = v.reshape(B, hk, W, v_pages.shape[-1])
     # global position of each gathered column
     colpos = (offs[:, :, None] * page_size
               + jnp.arange(page_size)[None, None, :]).reshape(B, W)
