@@ -1,0 +1,71 @@
+"""Auto-parallel training with the dist.to_static surface: topology via
+fleet, a DistModel over the compiled hybrid step, a sharded input
+pipeline, and the auto_parallel Strategy spelling — the reference's
+semi-automatic parallelism workflow, GSPMD underneath.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python examples/auto_parallel_to_static.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import optimizer as opt
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    # the auto_parallel Strategy spelling writes the same knob store the
+    # fleet spelling reads
+    strategy = dist.Strategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "sharding_degree": 2, "pp_degree": 1,
+                               "sep_degree": 1}
+    strategy.sharding.stage = 3
+    dist.fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, use_flash_attention=False)
+    model = dist.fleet.distributed_model(LlamaForCausalLM(cfg))
+
+    def loss_fn(m, x, y):
+        loss, _ = m(x, labels=y)
+        return loss
+
+    dm = dist.to_static(model, loss_fn=loss_fn,
+                        optimizer=opt.AdamW(1e-3,
+                                            parameters=model.parameters()))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (64, 33))
+    ds = TensorDataset([paddle.to_tensor(ids[:, :-1]),
+                        paddle.to_tensor(ids[:, 1:])])
+    mesh = dist.get_hybrid_communicate_group().mesh
+    loader = dist.shard_dataloader(DataLoader(ds, batch_size=8), mesh,
+                                   shard_dims="dp")
+
+    for epoch in range(2):
+        for step, (x, y) in enumerate(loader):
+            loss = dm(x, y)
+        print(f"epoch {epoch}: loss {float(np.asarray(loss.numpy())):.4f}")
+
+    dm.eval()
+    x0, y0 = next(iter(loader))
+    print(f"eval loss: {float(np.asarray(dm(x0, y0).numpy())):.4f}")
+    dist.set_hybrid_communicate_group(None)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
