@@ -44,6 +44,8 @@ NAMESPACES = {
     "regularizer.py": ("paddle_tpu.regularizer", {}),
     "sysconfig.py": ("paddle_tpu.sysconfig", {}),
     "autograd/__init__.py": ("paddle_tpu.autograd", {}),
+    "utils/__init__.py": ("paddle_tpu.utils", {}),
+    "device/__init__.py": ("paddle_tpu.device", {}),
     "incubate/nn/functional/__init__.py":
         ("paddle_tpu.incubate.nn.functional", {}),
     "nn/initializer/__init__.py": ("paddle_tpu.nn.initializer", {}),
