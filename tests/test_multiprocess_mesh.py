@@ -171,6 +171,26 @@ ids = rng.randint(0, cfg.vocab_size, (4, 17))
 losses = [float(np.asarray(pp.train_batch(
     [paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])], opt)))
     for _ in range(2)]
+
+# interleaved VPP leg CROSS-HOST: S=2 stages (one per process) x V=2
+# chunks, Megatron-interleaved order over the same socket transfers
+paddle.seed(4)
+import dataclasses
+cfg4 = dataclasses.replace(cfg, num_hidden_layers=4)  # 4 parts for S2xV2
+s.pipeline_configs = {"schedule_mode": "VPP", "accumulate_steps": 4}
+vpipe = LlamaForCausalLMPipe(cfg4, num_virtual_pipeline_stages=2)
+vpp = dist.fleet.distributed_model(vpipe)
+assert vpp._schedule == "VPP"
+assert vpp._hybrid and vpp._multiproc
+vopt = SGD(0.05, parameters=vpipe.parameters())
+vloss = float(np.asarray(vpp.train_batch(
+    [paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])], vopt)))
+from paddle_tpu.distributed.pipeline import interleaved_order
+expect = interleaved_order(2, 2, vpp._accumulate_steps)
+for s_ in range(2):
+    got = [e for e in vpp.op_log if e[1] % 2 == s_]
+    assert got == expect[s_], f"stage {s_} not interleaved"
+losses.append(vloss)
 with open(os.path.join(out_dir, f"pp_rank{rank}.pkl"), "wb") as f:
     pickle.dump(losses, f)
 print(f"rank {rank} OK", flush=True)
@@ -213,6 +233,7 @@ def test_cross_host_pipeline_parallel(tmp_path):
             results.append(pickle.load(f))
     assert results[0] == results[1]          # both hosts agree
     assert results[0][1] < results[0][0]     # learns
+    assert np.isfinite(results[0][2])        # cross-host VPP leg ran
 
     # single-process reference: identical seeds/config on this process's
     # 8 virtual devices
@@ -240,4 +261,4 @@ def test_cross_host_pipeline_parallel(tmp_path):
             opt))) for _ in range(2)]
     finally:
         dist.set_hybrid_communicate_group(None)
-    np.testing.assert_allclose(results[0], ref, rtol=1e-6)
+    np.testing.assert_allclose(results[0][:2], ref, rtol=1e-6)
