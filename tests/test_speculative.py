@@ -65,3 +65,23 @@ def test_speculative_rejects_batched_input():
     with pytest.raises(ValueError):
         speculative_generate(target, draft,
                              paddle.to_tensor(np.zeros((2, 4), np.int64)))
+
+
+def test_speculative_composes_with_sliding_window():
+    """Speculative decode under a Mistral sliding window (prompt beyond
+    the window, so the band bites during verify): token-identical to
+    target greedy."""
+    from paddle_tpu.models.mistral import MistralConfig, MistralForCausalLM
+    from paddle_tpu.speculative import speculative_generate
+
+    paddle.seed(0)
+    cfg = MistralConfig.tiny(sliding_window=8, use_flash_attention=False)
+    target = MistralForCausalLM(cfg)
+    paddle.seed(1)
+    draft = MistralForCausalLM(MistralConfig.tiny(
+        sliding_window=8, num_hidden_layers=1, use_flash_attention=False))
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (1, 20)))
+    greedy = target.generate(ids, max_new_tokens=8).numpy()
+    spec = speculative_generate(target, draft, ids, max_new_tokens=8,
+                                draft_k=3).numpy()
+    np.testing.assert_array_equal(spec[0], greedy[0])
