@@ -107,3 +107,32 @@ def test_stacked_adapters_merge_keeps_model_trainable(lora_model):
     m, n = merge_lora(m)
     assert n == 4  # 2 layers x (q_proj + gate_proj)
     assert all(not p.stop_gradient for _, p in m.named_parameters())
+
+
+def test_lora_on_moe_family():
+    """LoRA wraps the MoE family's attention projections (routed experts
+    stay frozen), trains adapters-only, merges back."""
+    from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    paddle.seed(0)
+    m = Qwen2MoeForCausalLM(Qwen2MoeConfig.tiny())
+    peft, n = get_peft_model(m, LoRAConfig(r=4,
+                                           target_modules=["q_proj",
+                                                           "v_proj"]))
+    assert n == 4  # q+v per layer x 2 layers
+    trainable = [p for p in peft.parameters() if not p.stop_gradient]
+    assert len(trainable) == 8  # lora_A + lora_B per wrapped Linear
+    # expert weights frozen
+    assert m.llama.layers[0].mlp.experts.w1.stop_gradient
+
+    def loss_fn(mm, x, y):
+        loss, _ = mm(x, labels=y)
+        return loss
+
+    step = paddle.jit.train_step(peft, loss_fn,
+                                 opt.AdamW(1e-2, parameters=trainable))
+    x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 12)))
+    losses = [float(step(x, x).numpy()) for _ in range(3)]
+    assert losses[-1] < losses[0]
+    merge_lora(peft)
+    assert m.generate(x, max_new_tokens=4).shape == [2, 4]

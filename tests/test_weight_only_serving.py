@@ -101,3 +101,23 @@ def test_fused_ce_falls_back_for_swapped_head():
     loss, logits = m(ids, labels=ids)  # would AttributeError before the fallback
     assert logits is not None  # fell back to the logits path
     assert abs(float(loss.numpy()) - float(ref_loss.numpy())) < 0.2
+
+
+def test_int8_serving_composes_with_sliding_window():
+    """Weight-only int8 + windowed banded decode through the engine ==
+    the int8 model's solo generate."""
+    from paddle_tpu.models.mistral import MistralConfig, MistralForCausalLM
+    from paddle_tpu.nn.quant import quantize_for_serving
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    paddle.seed(2)
+    m = MistralForCausalLM(MistralConfig.tiny(sliding_window=8,
+                                              use_flash_attention=False))
+    q8, _ = quantize_for_serving(m)
+    ids = np.random.RandomState(1).randint(0, 512, (18,))
+    eng = ContinuousBatchEngine(q8, max_batch=2, max_len=64, page_size=8)
+    rid = eng.add_request(ids, 5)
+    done = eng.run_until_done()
+    solo = q8.generate(paddle.to_tensor(ids[None]),
+                       max_new_tokens=5).numpy()[0]
+    assert done[rid].tolist() == solo.tolist()
