@@ -125,14 +125,17 @@ def test_lora_on_moe_family():
     # expert weights frozen
     assert m.llama.layers[0].mlp.experts.w1.stop_gradient
 
-    def loss_fn(mm, x, y):
-        loss, _ = mm(x, labels=y)
-        return loss
-
-    step = paddle.jit.train_step(peft, loss_fn,
+    step = paddle.jit.train_step(peft, _loss_fn,
                                  opt.AdamW(1e-2, parameters=trainable))
     x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 12)))
     losses = [float(step(x, x).numpy()) for _ in range(3)]
     assert losses[-1] < losses[0]
+    def logits(mm):
+        out = mm(x)
+        return (out[0] if isinstance(out, tuple) else out).numpy()
+
+    before = logits(peft)              # adapter-applied logits
     merge_lora(peft)
+    # merge folds the adapters into the base weights: same function
+    np.testing.assert_allclose(logits(m), before, rtol=1e-4, atol=1e-5)
     assert m.generate(x, max_new_tokens=4).shape == [2, 4]
