@@ -20,6 +20,7 @@ _LAZY = {
     "ernie45": ("ernie45", None),
     "Ernie45Config": ("ernie45", "Ernie45Config"),
     "Ernie45ForCausalLM": ("ernie45", "Ernie45ForCausalLM"),
+    "ernie45_from_hf": ("ernie45", "ernie45_from_hf"),
     "sd3": ("sd3", None),
     "MMDiTConfig": ("sd3", "MMDiTConfig"),
     "MMDiT": ("sd3", "MMDiT"),

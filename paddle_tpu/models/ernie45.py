@@ -31,6 +31,9 @@ class Ernie45Config(LlamaMoEConfig):
     num_experts_per_tok: int = 6
     norm_topk_prob: bool = True       # softmax renorm over the selected k
     first_k_dense_replace: int = 1    # leading dense layer(s)
+    moe_correction_bias: bool = True  # aux-free balancing bias (the HF
+    # checkpoint's moe_statics.e_score_correction_bias) steers top-k
+    # SELECTION; combine weights stay the raw softmax probs
     router_aux_loss_coef: float = 0.001
 
     @staticmethod
@@ -64,3 +67,148 @@ class Ernie45ForCausalLM(LlamaMoEForCausalLM):
 
     def __init__(self, config: Ernie45Config):
         super().__init__(config)
+
+
+def _hf_config_to_ernie45(hf_config, **overrides) -> Ernie45Config:
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    if get("use_bias", False):
+        raise NotImplementedError(
+            "ernie45_from_hf: use_bias=True checkpoints are not "
+            "represented (the 4.5 text releases ship bias-free)")
+    end = get("moe_layer_end_index", -1)
+    layers = get("num_hidden_layers")
+    if end not in (-1, None) and end < layers - 1:
+        raise NotImplementedError(
+            "ernie45_from_hf: trailing dense layers "
+            f"(moe_layer_end_index={end} < {layers - 1}) are not "
+            "representable; only leading dense layers map")
+    if get("moe_layer_interval", 1) != 1:
+        raise NotImplementedError(
+            "ernie45_from_hf: moe_layer_interval != 1 (dense layers "
+            "interleaved mid-stack) is not representable; only leading "
+            "dense layers (moe_layer_start_index) map")
+    kw = dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads"),
+        max_position_embeddings=get("max_position_embeddings"),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        rope_theta=get("rope_theta", 500000.0),
+        tie_word_embeddings=bool(get("tie_word_embeddings", True)),
+        n_routed_experts=get("moe_num_experts"),
+        num_experts_per_tok=get("moe_k"),
+        moe_intermediate_size=get("moe_intermediate_size"),
+        n_shared_experts=get("moe_num_shared_experts"),
+        first_k_dense_replace=get("moe_layer_start_index", 1),
+    )
+    kw.update(overrides)
+    return Ernie45Config(**kw)
+
+
+def load_hf_ernie45(model: "Ernie45ForCausalLM",
+                    hf_state_dict) -> "Ernie45ForCausalLM":
+    """Pack a transformers Ernie4_5_MoeForCausalLM state dict: per-expert
+    gate/up/down stack into the grouped [E, ...] layout, the router and
+    its aux-free correction bias map onto gate_weight /
+    e_score_correction_bias, leading dense layers load as plain MLPs."""
+    import numpy as np
+
+    from .llama import _hf_to_np
+
+    cfg = model.config
+    E, L = cfg.n_routed_experts, cfg.num_hidden_layers
+    dense_upto = cfg.first_k_dense_replace
+    mapped, consumed = {}, set()
+
+    def take(hf_key, transpose):
+        if hf_key not in hf_state_dict:
+            raise KeyError(f"load_hf_ernie45: missing {hf_key!r}")
+        consumed.add(hf_key)
+        v = _hf_to_np(hf_state_dict[hf_key])
+        return v.T if transpose else v
+
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+
+    def take_rope_proj(hf_key, n_heads):
+        """ERNIE-4.5 applies INTERLEAVED (NeoX rotate-every-two) rotary;
+        this model applies the llama half-rotate convention. The two are
+        exactly equivalent under an even-then-odd reorder of each head's
+        projection rows (the Meta->HF llama converter's permutation), so
+        the checkpoint is converted rather than the kernel forked."""
+        w = _hf_to_np(hf_state_dict[hf_key])      # torch [out, in]
+        consumed.add(hf_key)
+        out_dim, in_dim = w.shape
+        wh = w.reshape(n_heads, head_dim, in_dim)
+        wh = np.concatenate([wh[:, 0::2], wh[:, 1::2]], axis=1)
+        return wh.reshape(out_dim, in_dim).T      # -> [in, out]
+
+    mapped["llama.embed_tokens.weight"] = take("model.embed_tokens.weight",
+                                               False)
+    mapped["llama.norm.weight"] = take("model.norm.weight", False)
+    if model.lm_head is not None:
+        src = ("lm_head.weight" if "lm_head.weight" in hf_state_dict
+               else "model.embed_tokens.weight")
+        mapped["lm_head.weight"] = take(src, True)
+    for i in range(L):
+        hf, ours = f"model.layers.{i}", f"llama.layers.{i}"
+        mapped[f"{ours}.self_attn.q_proj.weight"] = take_rope_proj(
+            f"{hf}.self_attn.q_proj.weight", cfg.num_attention_heads)
+        mapped[f"{ours}.self_attn.k_proj.weight"] = take_rope_proj(
+            f"{hf}.self_attn.k_proj.weight", cfg.num_key_value_heads)
+        for proj in ("v_proj", "o_proj"):
+            mapped[f"{ours}.self_attn.{proj}.weight"] = take(
+                f"{hf}.self_attn.{proj}.weight", True)
+        mapped[f"{ours}.input_layernorm.weight"] = take(
+            f"{hf}.input_layernorm.weight", False)
+        mapped[f"{ours}.post_attention_layernorm.weight"] = take(
+            f"{hf}.post_attention_layernorm.weight", False)
+        if i < dense_upto:
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                mapped[f"{ours}.mlp.{proj}.weight"] = take(
+                    f"{hf}.mlp.{proj}.weight", True)
+            continue
+        mapped[f"{ours}.mlp.gate_weight"] = take(f"{hf}.mlp.gate.weight",
+                                                 True)
+        mapped[f"{ours}.mlp.e_score_correction_bias"] = take(
+            f"{hf}.mlp.moe_statics.e_score_correction_bias",
+            False).reshape(E)
+        from .llama_moe import pack_hf_experts
+
+        (mapped[f"{ours}.mlp.experts.w1"],
+         mapped[f"{ours}.mlp.experts.b1"],
+         mapped[f"{ours}.mlp.experts.w2"],
+         mapped[f"{ours}.mlp.experts.b2"]) = pack_hf_experts(
+            take, f"{hf}.mlp", E, cfg.hidden_size)
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            mapped[f"{ours}.mlp.shared_expert.{proj}.weight"] = take(
+                f"{hf}.mlp.shared_experts.{proj}.weight", True)
+    leftovers = [k for k in hf_state_dict
+                 if k not in consumed and k != "lm_head.weight"
+                 and not k.endswith("rotary_emb.inv_freq")]
+    if leftovers:
+        raise ValueError(
+            f"load_hf_ernie45: checkpoint tensors this model cannot "
+            f"represent: {leftovers[:5]}"
+            f"{'...' if len(leftovers) > 5 else ''}")
+    missing, unexpected = model.set_state_dict(mapped)
+    assert not unexpected, unexpected
+    if missing:
+        raise KeyError(f"load_hf_ernie45: model keys not covered: "
+                       f"{missing[:5]}")
+    return model
+
+
+def ernie45_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build an Ernie45ForCausalLM from a transformers
+    Ernie4_5_MoeForCausalLM (or raw state dict + config)."""
+    if hf_config is None:
+        hf_config = hf_model_or_state.config
+        state = hf_model_or_state.state_dict()
+    else:
+        state = hf_model_or_state
+    cfg = _hf_config_to_ernie45(hf_config, **config_overrides)
+    return load_hf_ernie45(Ernie45ForCausalLM(cfg), state)

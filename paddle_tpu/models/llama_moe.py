@@ -39,6 +39,9 @@ class LlamaMoEConfig(LlamaConfig):
     n_routed_experts: int = 8
     n_shared_experts: int = 1
     shared_expert_gate: bool = False       # Qwen2-MoE sigmoid shared gate
+    moe_correction_bias: bool = False      # ERNIE/DeepSeek-V3 aux-free
+    # balancing: a per-expert bias added to the router probs for top-k
+    # SELECTION only (combine weights stay the raw softmax probs)
     num_experts_per_tok: int = 2
     moe_intermediate_size: int = 1408      # per-expert FFN width
     first_k_dense_replace: int = 1         # leading dense layers (DeepSeek)
@@ -56,6 +59,25 @@ class LlamaMoEConfig(LlamaConfig):
                     first_k_dense_replace=1)
         base.update(kw)
         return LlamaMoEConfig(**base)
+
+
+def pack_hf_experts(take, hf_prefix, n_experts, hidden_size):
+    """Stack a transformers checkpoint's per-expert gate/up/down weights
+    into the grouped [E, ...] layout (shared by the qwen2_moe and ernie45
+    loaders): returns (w1 fused gate||up, b1 zeros, w2, b2 zeros)."""
+    import numpy as np
+
+    w1 = np.stack([
+        np.concatenate([take(f"{hf_prefix}.experts.{e}.gate_proj.weight",
+                             True),
+                        take(f"{hf_prefix}.experts.{e}.up_proj.weight",
+                             True)], axis=-1)
+        for e in range(n_experts)])
+    w2 = np.stack([take(f"{hf_prefix}.experts.{e}.down_proj.weight", True)
+                   for e in range(n_experts)])
+    b1 = np.zeros((n_experts, 1, w1.shape[-1]), np.float32)
+    b2 = np.zeros((n_experts, 1, hidden_size), np.float32)
+    return w1, b1, w2, b2
 
 
 class MoEMLP(Layer):
@@ -99,6 +121,12 @@ class MoEMLP(Layer):
             self.shared_expert = LlamaMLP(shared_cfg)
         else:
             self.shared_expert = None
+        if getattr(config, "moe_correction_bias", False):
+            self.e_score_correction_bias = self.create_parameter(
+                [config.n_routed_experts],
+                default_initializer=Constant(0.0))
+        else:
+            self.e_score_correction_bias = None
         if getattr(config, "shared_expert_gate", False):
             # Qwen2-MoE: the shared expert's output is scaled by a learned
             # per-token sigmoid gate (modeling_qwen2_moe shared_expert_gate)
@@ -123,13 +151,21 @@ class MoEMLP(Layer):
         k = cfg.num_experts_per_tok
         E = cfg.n_routed_experts
 
-        def route_and_run(xf, gate_w, w1, b1, w2, b2):
+        def route_and_run(xf, gate_w, w1, b1, w2, b2, *sel_bias):
             tokens = xf.reshape(-1, h)
             S = tokens.shape[0]
             logits = (tokens.astype(jnp.float32)
                       @ gate_w.astype(jnp.float32))
             probs = jax.nn.softmax(logits, axis=-1)
-            topk_p, topk_idx = jax.lax.top_k(probs, k)
+            if sel_bias:
+                # aux-free balancing (HF Ernie4_5 moe_statics /
+                # DeepSeek-V3): the bias picks the experts, the raw
+                # probs weight the combine
+                sel = probs + sel_bias[0].astype(jnp.float32)
+                _, topk_idx = jax.lax.top_k(sel, k)
+                topk_p = jnp.take_along_axis(probs, topk_idx, axis=-1)
+            else:
+                topk_p, topk_idx = jax.lax.top_k(probs, k)
             if cfg.norm_topk_prob:
                 topk_p = topk_p / jnp.maximum(
                     topk_p.sum(-1, keepdims=True), 1e-20)
@@ -154,9 +190,11 @@ class MoEMLP(Layer):
             aux = E * jnp.sum(me * ce)
             return out.reshape(b, s, h).astype(xf.dtype), aux
 
+        extra = ([self.e_score_correction_bias]
+                 if self.e_score_correction_bias is not None else [])
         out, aux = apply("moe_mlp", route_and_run, x, self.gate_weight,
                          self.experts.w1, self.experts.b1,
-                         self.experts.w2, self.experts.b2)
+                         self.experts.w2, self.experts.b2, *extra)
         self._aux_loss = aux
         if self.shared_expert is not None:
             shared = self.shared_expert(x)

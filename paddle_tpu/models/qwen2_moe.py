@@ -149,21 +149,13 @@ def load_hf_qwen2_moe(model: Qwen2MoeForCausalLM,
         # router: HF [E, h] -> gate_weight [h, E]
         mapped[f"{ours}.mlp.gate_weight"] = take(f"{hf}.mlp.gate.weight",
                                                  True)
-        # experts: stack per-expert gate||up into [E, h, 2*inter]
-        w1 = np.stack([
-            np.concatenate(
-                [take(f"{hf}.mlp.experts.{e}.gate_proj.weight", True),
-                 take(f"{hf}.mlp.experts.{e}.up_proj.weight", True)],
-                axis=-1)
-            for e in range(E)])
-        w2 = np.stack([take(f"{hf}.mlp.experts.{e}.down_proj.weight", True)
-                       for e in range(E)])
-        mapped[f"{ours}.mlp.experts.w1"] = w1
-        mapped[f"{ours}.mlp.experts.w2"] = w2
-        mapped[f"{ours}.mlp.experts.b1"] = np.zeros(
-            (E, 1, w1.shape[-1]), np.float32)  # HF experts carry no biases
-        mapped[f"{ours}.mlp.experts.b2"] = np.zeros(
-            (E, 1, cfg.hidden_size), np.float32)
+        from .llama_moe import pack_hf_experts
+
+        (mapped[f"{ours}.mlp.experts.w1"],
+         mapped[f"{ours}.mlp.experts.b1"],
+         mapped[f"{ours}.mlp.experts.w2"],
+         mapped[f"{ours}.mlp.experts.b2"]) = pack_hf_experts(
+            take, f"{hf}.mlp", E, cfg.hidden_size)
         for proj in ("gate_proj", "up_proj", "down_proj"):
             mapped[f"{ours}.mlp.shared_expert.{proj}.weight"] = take(
                 f"{hf}.mlp.shared_expert.{proj}.weight", True)

@@ -214,3 +214,47 @@ def test_moe_serving_engine():
         solo = m.generate(paddle.to_tensor(p[None]),
                           max_new_tokens=5).numpy()[0]
         np.testing.assert_array_equal(done[rid], solo)
+
+
+def test_ernie45_logits_and_generate_match_transformers():
+    """ernie45_from_hf: full-precision parity with HF modeling_ernie4_5_moe
+    on a tiny shape — incl. the aux-free correction-bias routing
+    (moe_statics steers top-k SELECTION; raw softmax probs combine).
+    moe_capacity_factor is raised so the capacity dispatch drops no token
+    (HF routing is dropless)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers import Ernie4_5_MoeConfig as HFConfig
+    from transformers import Ernie4_5_MoeForCausalLM as HFErnie
+
+    from paddle_tpu.models.ernie45 import ernie45_from_hf
+
+    torch.manual_seed(0)
+    hf = HFErnie(HFConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=5e5,
+        moe_num_experts=4, moe_k=2, moe_intermediate_size=32,
+        moe_num_shared_experts=1, moe_layer_start_index=1,
+        use_bias=False, tie_word_embeddings=True,
+        attn_implementation="eager")).eval()
+    # a NONZERO correction bias so the selection-vs-combine split is
+    # actually exercised (zeros would make biased selection == plain topk)
+    with torch.no_grad():
+        for layer in hf.model.layers[1:]:
+            layer.mlp.moe_statics.e_score_correction_bias.add_(
+                torch.tensor([[0.3, -0.2, 0.1, -0.3]]))
+    ours = ernie45_from_hf(hf, dtype="float32", use_flash_attention=False,
+                           moe_capacity_factor=8.0)
+    assert ours.config.moe_correction_bias
+    assert ours.config.first_k_dense_replace == 1
+    ids = np.random.RandomState(0).randint(0, 96, (2, 9))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+    with torch.no_grad():
+        gref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                           do_sample=False, pad_token_id=0).numpy()[:, 9:]
+    ggot = ours.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(ggot, gref)
