@@ -17,7 +17,8 @@ from .extra import (  # noqa: F401
 
 __all__ = ["flash_attention", "flash_attn_unpadded", "flash_attn_qkvpacked",
            "flash_attn_varlen_qkvpacked", "flashmask_attention",
-           "scaled_dot_product_attention", "sdp_kernel"]
+           "scaled_dot_product_attention", "sdp_kernel",
+           "get_triangle_upper_mask", "calc_reduced_attention_scores"]
 
 
 class _CallableModule(types.ModuleType):
@@ -26,3 +27,37 @@ class _CallableModule(types.ModuleType):
 
 
 sys.modules[__name__].__class__ = _CallableModule
+
+
+def get_triangle_upper_mask(x, name=None):
+    """flash_attention.py:63 parity: a -1e4 strictly-upper-triangular
+    additive mask shaped like ``x`` (the [B, H, S, S] score layout)."""
+    import jax.numpy as jnp
+
+    from ...tensor_class import unwrap, wrap
+
+    a = unwrap(x)
+    mask = jnp.triu(jnp.full(a.shape, -1e4, a.dtype), k=1)
+    return wrap(mask)  # wrap() defaults stop_gradient=True
+
+
+def calc_reduced_attention_scores(query, key, softmax_lse, name=None):
+    """flash_attention.py:1832 parity: reduce_sum over the QUERY axis of
+    softmax(QK^T/sqrt(d)) using a PRECOMPUTED logsumexp (the flash
+    kernel's saved statistic) — probs are rebuilt blocklessly but never
+    normalized twice. query [B,Sq,H,D], key [B,Sk,H,D],
+    softmax_lse [B,H,Sq] -> [B,H,1,Sk]."""
+    import jax.numpy as jnp
+
+    from ...tensor_class import unwrap, wrap
+
+    qa = unwrap(query)
+    q = qa.astype(jnp.float32)
+    k = unwrap(key).astype(jnp.float32)
+    lse = unwrap(softmax_lse).astype(jnp.float32)
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, jnp.float32))
+    probs = jnp.exp(scores - lse[..., None])
+    out = probs.sum(axis=-2, keepdims=True)          # reduce over queries
+    return wrap(out.astype(qa.dtype))

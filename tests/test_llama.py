@@ -263,3 +263,29 @@ def test_fused_norm_blocks_scale_with_hidden():
     ro, rh = fnorm._add_rms_ref(x, r, w, 1e-6)
     np.testing.assert_allclose(np.asarray(o), np.asarray(ro), atol=1e-5)
     np.testing.assert_allclose(np.asarray(h), np.asarray(rh), atol=1e-5)
+
+
+def test_flash_attention_module_surface_tail():
+    """nn.functional.flash_attention module parity tail (r5):
+    get_triangle_upper_mask and calc_reduced_attention_scores (the lse-
+    reusing reduced-scores op) — numeric vs a full-softmax reference."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional import flash_attention as FA
+
+    rng = np.random.RandomState(0)
+    q = paddle.to_tensor(rng.randn(2, 4, 2, 8).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(2, 6, 2, 8).astype(np.float32))
+    s = np.einsum("bqhd,bkhd->bhqk", q.numpy(), k.numpy()) / np.sqrt(8)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = p.sum(-2, keepdims=True)
+    lse = paddle.to_tensor(
+        np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) + s.max(-1))
+    out = FA.calc_reduced_attention_scores(q, k, lse)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    m = FA.get_triangle_upper_mask(
+        paddle.to_tensor(np.zeros((1, 2, 4, 4), np.float32)))
+    assert m.stop_gradient
+    assert m.numpy()[0, 0, 0, 1] == -1e4 and m.numpy()[0, 0, 1, 1] == 0
