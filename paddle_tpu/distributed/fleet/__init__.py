@@ -128,7 +128,6 @@ def get_hybrid_communicate_group_():
 # collective jobs and config compatibility.
 # ---------------------------------------------------------------------------
 from ..topology import CommunicateTopology  # noqa: F401,E402
-from . import meta_parallel, utils  # noqa: F401,E402 (attribute chains)
 
 Fleet = _Fleet
 
@@ -235,5 +234,10 @@ fleet.util = UtilBase()
 # the parent package, which yields THIS INSTANCE (it shadows the module);
 # mirror the submodules so attribute chains (m.utils.recompute,
 # m.meta_parallel.PipelineLayer) work either way
+# imported at the BOTTOM: base.role_maker re-imports the classes defined
+# above (a top-of-module import would see a partially initialized package)
+from . import base, meta_parallel, utils  # noqa: F401,E402
+
 fleet.utils = utils
 fleet.meta_parallel = meta_parallel
+fleet.base = base

@@ -1,0 +1,7 @@
+"""fleet.base parity shims (python/paddle/distributed/fleet/base/): the
+deep-import homes of the topology / strategy / role-maker classes. Each
+resolves to this build's real implementation."""
+from . import topology  # noqa: F401
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .role_maker import (PaddleCloudRoleMaker, Role,  # noqa: F401
+                         UserDefinedRoleMaker)

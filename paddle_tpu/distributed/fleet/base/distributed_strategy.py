@@ -1,0 +1,3 @@
+"""fleet.base.distributed_strategy parity: the DistributedStrategy class's
+reference import home."""
+from ...strategy import DistributedStrategy  # noqa: F401

@@ -1,0 +1,6 @@
+"""fleet.base.role_maker parity: the role-maker classes' reference import
+home (worker roles only — parameter servers are out of scope)."""
+from .. import (PaddleCloudRoleMaker, Role,  # noqa: F401
+                UserDefinedRoleMaker)
+
+RoleMakerBase = PaddleCloudRoleMaker

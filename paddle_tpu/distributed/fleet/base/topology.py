@@ -1,0 +1,8 @@
+"""fleet.base.topology parity (fleet/base/topology.py): the import path
+PaddleNLP-style trainers use for CommunicateTopology /
+HybridCommunicateGroup / ParallelMode."""
+from ...topology import (CommunicateTopology,  # noqa: F401
+                         HybridCommunicateGroup,
+                         get_hybrid_communicate_group,
+                         set_hybrid_communicate_group)
+from ...compat import ParallelMode  # noqa: F401

@@ -430,6 +430,24 @@ def test_fleet_deep_import_paths():
         AllGatherOp, ColumnSequenceParallelLinear, GatherOp, ScatterOp)
 
     assert PipelineParallelWithInterleave is PipelineParallel
+    # fleet.base deep-import homes (PaddleNLP-style imports)
+    from paddle_tpu.distributed.fleet.base.distributed_strategy import (
+        DistributedStrategy as DS)
+    from paddle_tpu.distributed.fleet.base.role_maker import (
+        PaddleCloudRoleMaker, RoleMakerBase)
+    from paddle_tpu.distributed.fleet.base.topology import (
+        HybridCommunicateGroup as HCG, ParallelMode)
+
+    import paddle_tpu.distributed as dist_mod
+    from paddle_tpu.distributed.strategy import (
+        DistributedStrategy as CanonicalDS)
+    from paddle_tpu.distributed.topology import (
+        HybridCommunicateGroup as CanonicalHCG)
+
+    assert DS is CanonicalDS and RoleMakerBase is PaddleCloudRoleMaker
+    assert HCG is CanonicalHCG and ParallelMode.PIPELINE_PARALLEL == 2
+    # attribute chains reach base too
+    assert dist_mod.fleet.base.topology.HybridCommunicateGroup is CanonicalHCG
     # recompute really checkpoints: grads flow through
     x = paddle.to_tensor(np.random.RandomState(0).randn(4, 4).astype(
         "float32"), stop_gradient=False)
