@@ -486,6 +486,9 @@ def _empty_caches(model, batch, max_len, allowed=None, row_pos=None):
     hk = cfg.num_key_value_heads
     d = cfg.hidden_size // cfg.num_attention_heads
     dt = jnp.dtype(cfg.dtype) if isinstance(cfg.dtype, str) else cfg.dtype
+    # models with a non-k/v cache layout (MLA's compressed latent) provide
+    # their own per-layer buffer allocator
+    make = getattr(model.llama, "empty_cache_layer", None)
     caches = []
     for _ in range(cfg.num_hidden_layers):
         # pos starts as a PYTHON int so it stays a concrete constant even
@@ -496,9 +499,12 @@ def _empty_caches(model, batch, max_len, allowed=None, row_pos=None):
         # attention layer's `new` dict drops it), enabling the flash fast
         # path under jit; pos stays a python 0 so the first cache write
         # compiles as a static-offset slice
-        c = {"k": jnp.zeros((batch, max_len, hk, d), dt),
-             "v": jnp.zeros((batch, max_len, hk, d), dt),
-             "pos": 0, "prefill": True}
+        if make is not None:
+            c = dict(make(batch, max_len, dt), pos=0, prefill=True)
+        else:
+            c = {"k": jnp.zeros((batch, max_len, hk, d), dt),
+                 "v": jnp.zeros((batch, max_len, hk, d), dt),
+                 "pos": 0, "prefill": True}
         if allowed is not None:
             c["allowed"] = allowed
         if row_pos is not None:
@@ -513,7 +519,7 @@ def _unwrap_caches(caches):
         is_leaf=lambda x: isinstance(x, Tensor))
 
 
-_BUF_KEYS = ("k", "v", "k_pages", "v_pages")
+_BUF_KEYS = ("k", "v", "k_pages", "v_pages", "c_kv", "k_pe")
 
 
 def _split_caches(caches):
@@ -1319,6 +1325,11 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
             last, caches = prefill(ids, lengths, pad_mask)
 
         if paged:
+            if "k" not in caches[0]:
+                raise NotImplementedError(
+                    "the paged KV layout needs per-head k/v caches; MLA "
+                    "latent caches (c_kv/k_pe) decode through the dense "
+                    "buffer path (paged=False)")
             caches = _caches_to_paged(caches, page_size, lengths, pad_mask)
 
         # per-row RoPE positions for the generated tokens (ragged batches

@@ -11,6 +11,10 @@ _LAZY = {
     "llama_moe": ("llama_moe", None),
     "LlamaMoEConfig": ("llama_moe", "LlamaMoEConfig"),
     "LlamaMoEForCausalLM": ("llama_moe", "LlamaMoEForCausalLM"),
+    "deepseek": ("deepseek", None),
+    "DeepseekV2Config": ("deepseek", "DeepseekV2Config"),
+    "DeepseekV2ForCausalLM": ("deepseek", "DeepseekV2ForCausalLM"),
+    "deepseek_from_hf": ("deepseek", "deepseek_from_hf"),
     "ernie": ("ernie", None),
     "ErnieConfig": ("ernie", "ErnieConfig"),
     "ErnieModel": ("ernie", "ErnieModel"),

@@ -48,6 +48,15 @@ class LlamaMoEConfig(LlamaConfig):
     norm_topk_prob: bool = True            # Qwen2-MoE renormalizes top-k
     router_aux_loss_coef: float = 0.001
     moe_capacity_factor: float = 2.0
+    # DeepSeek-V3 routing: sigmoid affinity scores (softmax is V2/Qwen2),
+    # and a scalar multiplier on the routed-experts output
+    moe_scoring_func: str = "softmax"
+    routed_scaling_factor: float = 1.0
+    # group-limited (device-limited) routing: experts split into n_group
+    # groups, top-k restricted to the best topk_group groups per token
+    # (DeepSeek-V2 group_limited_greedy / V3 noaux_tc)
+    n_group: int = 1
+    topk_group: int = 1
 
     @staticmethod
     def tiny_moe(**kw):
@@ -156,16 +165,51 @@ class MoEMLP(Layer):
             S = tokens.shape[0]
             logits = (tokens.astype(jnp.float32)
                       @ gate_w.astype(jnp.float32))
-            probs = jax.nn.softmax(logits, axis=-1)
-            if sel_bias:
-                # aux-free balancing (HF Ernie4_5 moe_statics /
-                # DeepSeek-V3): the bias picks the experts, the raw
-                # probs weight the combine
-                sel = probs + sel_bias[0].astype(jnp.float32)
-                _, topk_idx = jax.lax.top_k(sel, k)
-                topk_p = jnp.take_along_axis(probs, topk_idx, axis=-1)
+            if cfg.moe_scoring_func == "sigmoid":
+                # DeepSeek-V3: per-expert sigmoid affinities (top-k over
+                # bias-corrected scores; combine weights renormalize below)
+                probs = jax.nn.sigmoid(logits)
+            elif cfg.moe_scoring_func == "softmax":
+                probs = jax.nn.softmax(logits, axis=-1)
             else:
-                topk_p, topk_idx = jax.lax.top_k(probs, k)
+                raise ValueError(
+                    f"moe_scoring_func must be 'softmax' or 'sigmoid', got "
+                    f"{cfg.moe_scoring_func!r}")
+            # aux-free balancing (HF Ernie4_5 moe_statics / DeepSeek-V3):
+            # the bias picks the experts, the raw probs weight the combine
+            sel = (probs + sel_bias[0].astype(jnp.float32) if sel_bias
+                   else probs)
+            if cfg.n_group > 1:
+                # group-limited selection (DeepSeek device-limited
+                # routing): keep only the topk_group best expert groups
+                # per token before the expert top-k. Group score: sum of
+                # the group's top-2 affinities under the aux-free bias
+                # (V3 noaux_tc), else the group max (V2
+                # group_limited_greedy).
+                G = cfg.n_group
+                if E % G != 0:
+                    raise ValueError(
+                        f"n_routed_experts {E} not divisible by n_group {G}")
+                if k > cfg.topk_group * (E // G):
+                    # top_k past the surviving experts would hand real
+                    # combine weight to -inf-masked (out-of-group) experts
+                    raise ValueError(
+                        f"num_experts_per_tok {k} exceeds the "
+                        f"{cfg.topk_group} allowed group(s) x {E // G} "
+                        f"experts/group")
+                sel_g = sel.reshape(S, G, E // G)
+                if sel_bias:
+                    top2, _ = jax.lax.top_k(sel_g, min(2, E // G))
+                    gscore = top2.sum(-1)
+                else:
+                    gscore = sel_g.max(-1)
+                _, gidx = jax.lax.top_k(gscore, cfg.topk_group)
+                gmask = jnp.zeros((S, G), bool).at[
+                    jnp.arange(S)[:, None], gidx].set(True)
+                sel = jnp.where(jnp.repeat(gmask, E // G, axis=1),
+                                sel, -jnp.inf)
+            _, topk_idx = jax.lax.top_k(sel, k)
+            topk_p = jnp.take_along_axis(probs, topk_idx, axis=-1)
             if cfg.norm_topk_prob:
                 topk_p = topk_p / jnp.maximum(
                     topk_p.sum(-1, keepdims=True), 1e-20)
@@ -183,10 +227,16 @@ class MoEMLP(Layer):
             ye = _grouped_ffn(xe, w1, b1, w2, b2, "swiglu")
             ye = self._ep_constrain(ye)
             out = jnp.einsum("sec,ecm->sm", combine.astype(ye.dtype), ye)
-            # Switch-style aux loss on the router distribution
-            me = probs.mean(0)
+            if cfg.routed_scaling_factor != 1.0:
+                out = out * jnp.asarray(cfg.routed_scaling_factor, ye.dtype)
+            # Switch-style aux loss on the router DISTRIBUTION — sigmoid
+            # affinities don't sum to 1, so the load measure always uses
+            # the softmax of the logits
+            dist = (probs if cfg.moe_scoring_func == "softmax"
+                    else jax.nn.softmax(logits, axis=-1))
+            me = dist.mean(0)
             ce = jax.nn.one_hot(topk_idx[:, 0], E,
-                                dtype=probs.dtype).mean(0)
+                                dtype=dist.dtype).mean(0)
             aux = E * jnp.sum(me * ce)
             return out.reshape(b, s, h).astype(xf.dtype), aux
 
@@ -214,9 +264,11 @@ class MoEMLP(Layer):
 class LlamaMoEDecoderLayer(Layer):
     """Llama attention block + (dense | MoE) FFN."""
 
+    attn_cls = LlamaAttention  # subclasses (DeepSeek MLA) swap the block
+
     def __init__(self, config: LlamaMoEConfig, layer_idx: int):
         super().__init__(dtype=config.dtype)
-        self.self_attn = LlamaAttention(config)
+        self.self_attn = type(self).attn_cls(config)
         self.is_moe = layer_idx >= config.first_k_dense_replace
         self.mlp = MoEMLP(config) if self.is_moe else LlamaMLP(config)
         self.input_layernorm = LlamaRMSNorm(config)
@@ -264,10 +316,12 @@ class LlamaMoEForCausalLM(LlamaForCausalLM):
     ``forward(..., labels=...)`` adds ``router_aux_loss_coef`` × the mean
     Switch aux loss over the MoE layers to the LM loss (load balancing)."""
 
+    model_cls = LlamaMoEModel  # subclasses (DeepSeek MLA) swap the trunk
+
     def __init__(self, config: LlamaMoEConfig):
         Layer.__init__(self, dtype=config.dtype)
         self.config = config
-        self.llama = LlamaMoEModel(config)
+        self.llama = type(self).model_cls(config)
         if config.tie_word_embeddings:
             self.lm_head = None
         else:
