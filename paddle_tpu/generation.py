@@ -1218,6 +1218,13 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
                          "delays the eos stop)")
     ngram = int(no_repeat_ngram_size)
     penalized = rp != 1.0 or min_new > 0 or ngram > 0
+    if paged and getattr(model.llama, "empty_cache_layer", None) is not None:
+        # fail BEFORE the prefill: the paged layout needs per-head k/v
+        # caches; MLA latent caches (c_kv/k_pe) decode dense-buffer only
+        raise NotImplementedError(
+            "the paged KV layout needs per-head k/v caches; MLA latent "
+            "caches (c_kv/k_pe) decode through the dense buffer path "
+            "(paged=False)")
     num_beams = int(num_beams)
     if num_beams > 1:
         if do_sample:
@@ -1325,11 +1332,6 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
             last, caches = prefill(ids, lengths, pad_mask)
 
         if paged:
-            if "k" not in caches[0]:
-                raise NotImplementedError(
-                    "the paged KV layout needs per-head k/v caches; MLA "
-                    "latent caches (c_kv/k_pe) decode through the dense "
-                    "buffer path (paged=False)")
             caches = _caches_to_paged(caches, page_size, lengths, pad_mask)
 
         # per-row RoPE positions for the generated tokens (ragged batches

@@ -1,9 +1,11 @@
 """Model zoo: flagship pretraining models (SURVEY §6 / BASELINE.json
 workload configs): Llama-3 (+ Qwen2 bias / Mistral sliding-window
-variants), GPT-2 (learned positions), DeepSeekMoE/Qwen2-MoE, ERNIE
-(encoder) + ERNIE-4.5 (MoE decoder), T5 and BART encoder-decoders, SD3
-MMDiT (DiT backbone + AutoencoderKL live in vision.models). Every
-family has HF checkpoint interop with transformers parity tests."""
+variants), GPT-2 (learned positions), DeepSeekMoE/Qwen2-MoE,
+DeepSeek-V2/V3 (MLA: compressed-latent KV cache + group-limited
+routing), ERNIE (encoder) + ERNIE-4.5 (MoE decoder), T5 and BART
+encoder-decoders, SD3 MMDiT (DiT backbone + AutoencoderKL live in
+vision.models). Every family has HF checkpoint interop with parity
+tests."""
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
                     LlamaDecoderLayer, LlamaForCausalLMPipe)
 

@@ -51,8 +51,7 @@ import jax.numpy as jnp
 from ..nn.layer import Layer
 from .. import nn
 from ..ops.registry import apply
-from ..tensor_class import wrap
-from .llama import (LlamaModel, LlamaRMSNorm, _make_linear, _rope_tables)
+from .llama import (LlamaModel, LlamaRMSNorm, _make_linear)
 from .llama_moe import (LlamaMoEConfig, LlamaMoEDecoderLayer,
                         LlamaMoEForCausalLM)
 
@@ -331,20 +330,9 @@ class DeepseekV2Model(LlamaModel):
             [DeepseekV2DecoderLayer(config, i)
              for i in range(config.num_hidden_layers)])
 
-    def _rope(self, seq_len):
+    def _rope_dim(self):
         # RoPE rides ONLY the decoupled qk_rope_head_dim slice (MLA)
-        if seq_len in self._rope_cache:
-            return self._rope_cache[seq_len]
-        cos, sin = _rope_tables(seq_len, self.config.qk_rope_head_dim,
-                                self.config.rope_theta,
-                                scaling=self.config.rope_scaling)
-        pair = (wrap(cos), wrap(sin))
-        try:
-            if jax.core.trace_state_clean():
-                self._rope_cache[seq_len] = pair
-        except Exception:  # pragma: no cover
-            pass
-        return pair
+        return self.config.qk_rope_head_dim
 
     def empty_cache_layer(self, batch, max_len, dtype):
         """Per-layer decode cache: the COMPRESSED latent + shared RoPE key

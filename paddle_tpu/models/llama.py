@@ -412,10 +412,15 @@ class LlamaModel(Layer):
         self.norm = LlamaRMSNorm(config)
         self._rope_cache = {}
 
+    def _rope_dim(self):
+        """Rotary table width; MLA trunks override (RoPE rides only the
+        decoupled qk_rope_head_dim slice)."""
+        return self.config.hidden_size // self.config.num_attention_heads
+
     def _rope(self, seq_len):
         if seq_len in self._rope_cache:
             return self._rope_cache[seq_len]
-        cos, sin = _rope_tables(seq_len, self.config.hidden_size // self.config.num_attention_heads,
+        cos, sin = _rope_tables(seq_len, self._rope_dim(),
                                 self.config.rope_theta,
                                 scaling=self.config.rope_scaling)
         pair = (wrap(cos), wrap(sin))

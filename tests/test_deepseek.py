@@ -167,6 +167,24 @@ def test_correction_bias_changes_selection_not_weights():
     np.testing.assert_allclose(base, back, atol=1e-6)
 
 
+def test_speculative_decode_matches_greedy(tiny_model):
+    """The multi-token verify step runs the ABSORBED path at pos>0 — a
+    draft/target speculative run over latent caches must emit exactly the
+    target's greedy sequence."""
+    from paddle_tpu.speculative import speculative_generate
+
+    target = tiny_model
+    np.random.seed(13)
+    draft = DeepseekV2ForCausalLM(DeepseekV2Config.tiny_mla(
+        num_hidden_layers=2))
+    ids = _ids(b=1, s=8, seed=2)
+    ref = np.asarray(target.generate(pd.to_tensor(ids),
+                                     max_new_tokens=8)._array)
+    got = np.asarray(speculative_generate(target, draft, ids,
+                                          max_new_tokens=8, draft_k=3)._array)
+    np.testing.assert_array_equal(got, ref)
+
+
 # ---------------------------------------------------------------------------
 # HF conversion parity: numpy reference with the HF interleaved-RoPE
 # convention (modeling_deepseek: view(d//2, 2).transpose de-interleave,
