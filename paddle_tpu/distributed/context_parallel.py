@@ -84,21 +84,20 @@ def _live_hops(n: int, s_k: int, causal: bool, window: Optional[int]) -> int:
     return n
 
 
-def _ring_einsum(q, k, v, axis_name: str, causal: bool, scale: float,
-                 window: Optional[int]):
-    """Streaming-softmax ring over XLA einsum blocks (the differentiable
-    reference path; also the fallback when splash's shape constraints
-    don't hold). q: [B,S,H,D], k/v: [B,S,Hkv,D] local shards."""
+def _ring_stream(qt, kv0, make_kv, s_k: int, axis_name: str, causal: bool,
+                 scale: float, window: Optional[int], dv: int):
+    """Shared streaming-softmax ring driver.
+
+    qt: [B,Hkv,G,Sq,Dk] grouped (UNscaled) queries. kv0: an arbitrary
+    pytree that rotates around the ring via ppermute; per hop
+    ``make_kv(kv0) -> (kc [B,Hkv,Sk,Dk], vc [B,Hkv,Sk,Dv])`` produces
+    this hop's keys/values (identity for a plain ring; latent expansion
+    for MLA). Accumulates the flash recurrence with an f32 (m, l, o)
+    carry; returns the normalized output [B,Hkv,G,Sq,Dv] (f32).
+    """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
-    b, s_q, h, d = q.shape
-    s_k, h_kv = k.shape[1], k.shape[2]
-    g = h // h_kv  # GQA group size; kv stays unexpanded through the ring
-
-    # q: [B,Hkv,G,Sq,D] grouped by kv head; k/v: [B,Hkv,Sk,D]
-    qt = jnp.swapaxes(q, 1, 2).reshape(b, h_kv, g, s_q, d)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
+    b, h_kv, g, s_q, _ = qt.shape
 
     q_pos = idx * s_q + jnp.arange(s_q)            # global query positions
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -107,12 +106,13 @@ def _ring_einsum(q, k, v, axis_name: str, causal: bool, scale: float,
     # derive the accumulators from qt (zeroed) so they carry the same
     # varying-manual-axes type as the inputs — both lax.cond branches (and
     # the scan carry) must agree on vma under shard_map's typing
-    o0 = qt.astype(jnp.float32) * 0.0
+    o0 = (jnp.zeros((b, h_kv, g, s_q, dv), jnp.float32)
+          + qt[..., :1].astype(jnp.float32) * 0.0)
     l0 = o0[..., 0]
     m0 = l0 - jnp.inf
 
     def step(carry, t):
-        kc, vc, m, l, o = carry
+        kv, m, l, o = carry
         kv_idx = (idx - t) % n
         k_pos = kv_idx * s_k + jnp.arange(s_k)
         if causal:
@@ -125,16 +125,32 @@ def _ring_einsum(q, k, v, axis_name: str, causal: bool, scale: float,
 
         def compute(args):
             m, l, o = args
+            kc, vc = make_kv(kv)
             return _block_step(qt, kc, vc, m, l, o, mask, scale)
 
         m, l, o = lax.cond(live, compute, lambda args: args, (m, l, o))
-        kc = lax.ppermute(kc, axis_name, perm)
-        vc = lax.ppermute(vc, axis_name, perm)
-        return (kc, vc, m, l, o), None
+        kv = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), kv)
+        return (kv, m, l, o), None
 
-    (_, _, m, l, o), _ = lax.scan(step, (kt, vt, m0, l0, o0),
-                                  jnp.arange(t_live))
-    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    (_, m, l, o), _ = lax.scan(step, (kv0, m0, l0, o0), jnp.arange(t_live))
+    return o / jnp.where(l == 0.0, 1.0, l)[..., None]
+
+
+def _ring_einsum(q, k, v, axis_name: str, causal: bool, scale: float,
+                 window: Optional[int]):
+    """Streaming-softmax ring over XLA einsum blocks (the differentiable
+    reference path; also the fallback when splash's shape constraints
+    don't hold). q: [B,S,H,D], k/v: [B,S,Hkv,D] local shards; kv heads
+    stay UNexpanded so every ppermute hop moves only kv-head bytes."""
+    b, s_q, h, d = q.shape
+    s_k, h_kv = k.shape[1], k.shape[2]
+    g = h // h_kv  # GQA group size
+
+    # q: [B,Hkv,G,Sq,D] grouped by kv head; k/v: [B,Hkv,Sk,D]
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, h_kv, g, s_q, d)
+    kv0 = (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
+    out = _ring_stream(qt, kv0, lambda kv: kv, s_k, axis_name, causal,
+                       scale, window, d)
     out = out.reshape(b, h, s_q, d)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
@@ -287,6 +303,64 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
             return _ring_splash(q, k, v, axis_name, causal, scale, window,
                                 interpret)
     return _ring_einsum(q, k, v, axis_name, causal, scale, window)
+
+
+def mla_ring_attention(q, c_kv, k_pe, w_kv_b, axis_name: str, *,
+                       nope_dim: int, v_dim: int,
+                       sm_scale: Optional[float] = None):
+    """Causal ring attention for Multi-head Latent Attention (DeepSeek).
+
+    The ring rotates the COMPRESSED latent instead of expanded K/V: each
+    ppermute hop moves ``kv_lora_rank + qk_rope_head_dim`` floats per
+    token (576 at DeepSeek-V2 shapes) versus ``H*(d_qk + d_v)`` for an
+    expanded ring (10240) — ~18x less ICI traffic. The receiving device
+    re-expands the hop's K/V locally from the latent
+    (``kv = c_kv · w_kv_b``, one MXU einsum that overlaps the next hop's
+    permute), so the bandwidth saving is bought with FLOPs the TPU has to
+    spare — the scaling-book trade in the direction the hardware wants.
+
+    Call INSIDE shard_map. q [B, S_local, H, dn+dr] with RoPE already
+    applied to its dr tail at GLOBAL positions; c_kv [B, S_local, r]
+    (already kv_a_layernormed); k_pe [B, S_local, dr] roped at global
+    positions; w_kv_b [r, H*(dn+dv)] (the local head shard under mp).
+    Returns [B, S_local, H, dv] in q.dtype. Always causal (the MLA
+    decoder family has no bidirectional/windowed variant).
+    """
+    b, s_q, h, dqk = q.shape
+    s_k = c_kv.shape[1]
+    dn, dv, dr = nope_dim, v_dim, dqk - nope_dim
+    r = c_kv.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / (dqk ** 0.5)
+    w3 = w_kv_b.reshape(r, h, dn + dv)
+
+    # qt grouped for the shared driver with Hkv=H, G=1: [B, H, 1, Sq, dqk]
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, h, 1, s_q, dqk)
+
+    def make_kv(kv):
+        ckv_c, kpe_c = kv
+        # local re-expansion of this hop's K/V from the latent
+        kvx = jnp.einsum("bsr,rhd->bhsd", ckv_c.astype(w3.dtype), w3)
+        kc = jnp.concatenate(
+            [kvx[..., :dn],
+             jnp.broadcast_to(kpe_c[:, None].astype(kvx.dtype),
+                              (b, h, s_k, dr))], axis=-1)
+        return kc, kvx[..., dn:]
+
+    out = _ring_stream(qt, (c_kv, k_pe), make_kv, s_k, axis_name,
+                       causal=True, scale=scale, window=None, dv=dv)
+    out = out.reshape(b, h, s_q, dv)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def cp_mesh_axes(hcg):
+    """(mesh, batch_axes, head_axis) for the model-side shard_map CP
+    dispatch — the one mesh-axis naming shared by every attention class
+    that shards its sequence over ``sep``."""
+    mesh = hcg.jax_mesh()
+    batch_ax = tuple(a for a in ("dp", "sharding")
+                     if mesh.shape[a] > 1) or None
+    head_ax = "mp" if mesh.shape["mp"] > 1 else None
+    return mesh, batch_ax, head_ax
 
 
 def _sdpa_core(q, k, v, causal, scale, window=None):

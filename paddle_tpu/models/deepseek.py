@@ -51,6 +51,7 @@ import jax.numpy as jnp
 from ..nn.layer import Layer
 from .. import nn
 from ..ops.registry import apply
+from ..distributed.topology import get_hybrid_communicate_group
 from .llama import (LlamaModel, LlamaRMSNorm, _make_linear)
 from .llama_moe import (LlamaMoEConfig, LlamaMoEDecoderLayer,
                         LlamaMoEForCausalLM)
@@ -284,6 +285,42 @@ class DeepseekV2Attention(Layer):
 
             q_pe_r = rope_ref(q_pe, cos, sin).astype(q_nope.dtype)
             k_pe_r = rope_ref(k_pe[:, :, None, :], cos, sin)
+            hcg = get_hybrid_communicate_group()
+            sep = (hcg is not None and hcg.get_sep_parallel_world_size() > 1)
+            if sep and cfg.sep_mode == "ulysses":
+                raise NotImplementedError(
+                    "MLA context parallelism rides the latent ring; "
+                    "Ulysses needs a per-head KV axis the latent doesn't "
+                    "have — use sep_mode='ring'")
+            if sep and cfg.sep_mode == "ring":
+                # context parallelism: the ring rotates the COMPRESSED
+                # latent (r+dr floats/token) and each hop re-expands K/V
+                # locally — see mla_ring_attention. ("allgather" falls
+                # through: GSPMD gathers the sequence for the dense path.)
+                import functools
+
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                from ..distributed.context_parallel import (
+                    cp_mesh_axes, mla_ring_attention)
+
+                mesh, batch_ax, head_ax = cp_mesh_axes(hcg)
+                q = jnp.concatenate([q_nope, q_pe_r], axis=-1)
+                cp = shard_map(
+                    functools.partial(
+                        mla_ring_attention, axis_name="sep", nope_dim=dn,
+                        v_dim=dv, sm_scale=1.0 / math.sqrt(dn + dr)),
+                    mesh=mesh,
+                    in_specs=(P(batch_ax, "sep", head_ax, None),
+                              P(batch_ax, "sep", None),
+                              P(batch_ax, "sep", None),
+                              P(None, head_ax)),
+                    out_specs=P(batch_ax, "sep", head_ax, None),
+                    check_vma=False)
+                out = cp(q, c_kv, k_pe_r[:, :, 0, :].astype(c_kv.dtype),
+                         w_kv_b)
+                return out.reshape(b, s, H * dv)
             kv = jnp.einsum("bsr,rhd->bshd", c_kv,
                             w_kv_b.reshape(cfg.kv_lora_rank, H, dn + dv))
             k_nope, v = kv[..., :dn], kv[..., dn:]

@@ -303,12 +303,9 @@ class LlamaAttention(Layer):
                 from jax.sharding import PartitionSpec as P
 
                 from ..distributed.context_parallel import (
-                    ring_attention, ulysses_attention)
+                    cp_mesh_axes, ring_attention, ulysses_attention)
 
-                mesh = hcg.jax_mesh()
-                batch_ax = tuple(a for a in ("dp", "sharding")
-                                 if mesh.shape[a] > 1) or None
-                head_ax = "mp" if mesh.shape["mp"] > 1 else None
+                mesh, batch_ax, head_ax = cp_mesh_axes(hcg)
                 spec = P(batch_ax, "sep", head_ax, None)
                 inner = (ring_attention if cfg.sep_mode == "ring"
                          else ulysses_attention)
