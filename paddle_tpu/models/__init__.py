@@ -16,6 +16,7 @@ _LAZY = {
     "deepseek": ("deepseek", None),
     "DeepseekV2Config": ("deepseek", "DeepseekV2Config"),
     "DeepseekV2ForCausalLM": ("deepseek", "DeepseekV2ForCausalLM"),
+    "DeepseekForCausalLMPipe": ("deepseek", "DeepseekForCausalLMPipe"),
     "deepseek_from_hf": ("deepseek", "deepseek_from_hf"),
     "ernie": ("ernie", None),
     "ErnieConfig": ("ernie", "ErnieConfig"),

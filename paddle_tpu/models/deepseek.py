@@ -388,6 +388,53 @@ class DeepseekV2ForCausalLM(LlamaMoEForCausalLM):
     model_cls = DeepseekV2Model
 
 
+# ---------------------------------------------------------------------------
+# pipeline-parallel DeepSeek (MLA + MoE under pp — the way the V2/V3
+# recipes actually train: pp x ep x mp)
+# ---------------------------------------------------------------------------
+
+from .llama import LlamaDecoderLayerPipe, LlamaForCausalLMPipe  # noqa: E402
+
+
+class DeepseekDecoderLayerPipe(LlamaDecoderLayerPipe):
+    """One MLA(+MoE) decoder layer as a pipeline item — the shared pipe
+    item with the decoder class and RoPE width (the decoupled
+    qk_rope_head_dim slice) swapped."""
+
+    decoder_cls = DeepseekV2DecoderLayer
+
+    def _rope_dim(self):
+        return self.config.qk_rope_head_dim
+
+
+class DeepseekForCausalLMPipe(LlamaForCausalLMPipe):
+    """Stage-partitioned DeepSeek-V2/V3 causal LM — the shared pipe
+    assembly with MLA+MoE decoder layers. Train with
+    ``fleet.distributed_model`` under pp_degree > 1, then
+    ``pp.train_batch([ids, labels], opt)``.
+
+    The pipeline loss is the stage-local LM loss, so the router aux term
+    cannot be accumulated across stages — use aux-free balancing
+    (``moe_correction_bias``, the V3 recipe) or set
+    ``router_aux_loss_coef=0``; a nonzero coef raises rather than being
+    silently dropped."""
+
+    decoder_pipe_cls = DeepseekDecoderLayerPipe
+    shared_embed_key = "deepseek_embed"
+
+    def _decoder_args(self, config, layer_idx):
+        return (config, layer_idx)  # first_k_dense_replace needs the index
+
+    def _check_config(self, config):
+        super()._check_config(config)
+        has_moe = config.first_k_dense_replace < config.num_hidden_layers
+        if has_moe and config.router_aux_loss_coef:
+            raise NotImplementedError(
+                "the pipeline loss cannot carry the cross-stage router aux "
+                "term; use aux-free balancing (moe_correction_bias) or "
+                "router_aux_loss_coef=0")
+
+
 def deepseek_from_hf(hf_model, config=None):
     """Convert a transformers ``DeepseekV2ForCausalLM``-style state dict.
 

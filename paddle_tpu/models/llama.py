@@ -581,22 +581,30 @@ def _tied_head_forward(layer: "LlamaEmbeddingPipe", hidden):
 class LlamaDecoderLayerPipe(Layer):
     """One decoder layer as a pipeline item: computes its own RoPE tables
     from the activation's seq length (constant-folded by XLA inside the
-    stage jit) so only [B, S, H] crosses stage boundaries."""
+    stage jit) so only [B, S, H] crosses stage boundaries.
 
-    def __init__(self, config: LlamaConfig):
+    Subclass hooks: ``decoder_cls`` (the wrapped layer class, given
+    ``(config, *extra_args)``) and ``_rope_dim`` (table width — MLA
+    families rope only their decoupled slice)."""
+
+    decoder_cls = LlamaDecoderLayer
+
+    def __init__(self, config: LlamaConfig, *layer_args):
         super().__init__(dtype=config.dtype)
         self.config = config
-        layer = LlamaDecoderLayer(config)
+        layer = type(self).decoder_cls(config, *layer_args)
         if config.recompute:
             from ..distributed.recompute_layer import RecomputeLayer
 
             layer = RecomputeLayer(layer)
         self.layer = layer
 
+    def _rope_dim(self):
+        return self.config.hidden_size // self.config.num_attention_heads
+
     def forward(self, hidden):
         cfg = self.config
-        cos, sin = _rope_tables(hidden.shape[1],
-                                cfg.hidden_size // cfg.num_attention_heads,
+        cos, sin = _rope_tables(hidden.shape[1], self._rope_dim(),
                                 cfg.rope_theta, scaling=cfg.rope_scaling)
         return self.layer(hidden, wrap(cos), wrap(sin))
 
@@ -633,31 +641,50 @@ class LlamaForCausalLMPipe(PipelineLayer):
     pp_degree > 1 — each stage's mp/sharding placements ride its submesh
     (pipeline.py hybrid mode) — then ``pp.train_batch([ids, labels], opt)``
     with ``labels`` already shifted (same contract as LlamaForCausalLM).
+
+    Subclass hooks (the DeepSeek pipe reuses this assembly verbatim):
+    ``decoder_pipe_cls``, ``shared_embed_key``, ``_decoder_args`` (extra
+    per-layer ctor args) and ``_check_config`` (family guards).
     """
 
-    def __init__(self, config: LlamaConfig, num_stages=None,
-                 seg_method="layer:LlamaDecoderLayerPipe", **pipe_kwargs):
+    decoder_pipe_cls = LlamaDecoderLayerPipe
+    shared_embed_key = "llama_embed"
+
+    def _decoder_args(self, config, layer_idx):
+        return (config,)
+
+    def _check_config(self, config):
         if config.fuse_linear_cross_entropy:
             # the pipeline head stage emits full logits into the pipeline
             # loss; honoring the flag would need a fused head+loss stage —
             # raise rather than silently skip the memory saving
             raise NotImplementedError(
                 "fuse_linear_cross_entropy is not supported by the pipeline "
-                "head stage; unset the flag for LlamaForCausalLMPipe")
+                f"head stage; unset the flag for {type(self).__name__}")
+
+    def __init__(self, config: LlamaConfig, num_stages=None,
+                 seg_method=None, **pipe_kwargs):
+        cls = type(self)
+        if seg_method is None:
+            seg_method = f"layer:{cls.decoder_pipe_cls.__name__}"
+        self._check_config(config)
         if num_stages is None:
             hcg = get_hybrid_communicate_group()
             num_stages = (hcg.get_pipe_parallel_world_size()
                           if hcg is not None else 1)
-        decoders = [LayerDesc(LlamaDecoderLayerPipe, config)
-                    for _ in range(config.num_hidden_layers)]
+        decoders = [LayerDesc(cls.decoder_pipe_cls,
+                              *self._decoder_args(config, i))
+                    for i in range(config.num_hidden_layers)]
         if config.tie_word_embeddings:
             from ..distributed.pipeline import SharedLayerDesc
 
-            descs = ([SharedLayerDesc("llama_embed", LlamaEmbeddingPipe,
+            descs = ([SharedLayerDesc(cls.shared_embed_key,
+                                      LlamaEmbeddingPipe,
                                       None, "weight", config)]
                      + decoders
                      + [LayerDesc(LlamaNormPipe, config),
-                        SharedLayerDesc("llama_embed", LlamaEmbeddingPipe,
+                        SharedLayerDesc(cls.shared_embed_key,
+                                        LlamaEmbeddingPipe,
                                         _tied_head_forward, "weight",
                                         config)])
         else:

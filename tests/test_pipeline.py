@@ -710,32 +710,33 @@ class TestHybridSharedLayers:
                                        rtol=2e-5, atol=2e-6)
 
 
+def _copy_pipe_weights(pipe, ref):
+    """Map untied pipe stage params onto the monolithic model's params
+    (shared by the Llama and DeepSeek pipe parity tests)."""
+    import jax.numpy as jnp
+
+    src = {}
+    L = ref.config.num_hidden_layers
+    items = []
+    for part in range(len(pipe._stages)):
+        items.extend(pipe.get_stage_layer(part)._items)
+    emb, layers, head = items[0], items[1:1 + L], items[1 + L]
+    src["llama.embed_tokens.weight"] = emb.embed_tokens.weight
+    for i, lp in enumerate(layers):
+        for name, p in lp.layer.named_parameters():
+            src[f"llama.layers.{i}.{name}"] = p
+    src["llama.norm.weight"] = head.norm.weight
+    src["lm_head.weight"] = head.lm_head.weight
+    own = dict(ref.named_parameters())
+    assert set(own) == set(src), (set(own) ^ set(src))
+    for k, p in src.items():
+        own[k]._array = jnp.asarray(np.asarray(p._array))
+
+
 class TestLlamaPipe:
     """LlamaForCausalLMPipe (PaddleNLP pipeline-llama pattern) under the
     hybrid mesh: pp2 x mp2 x sharding2 training parity vs LlamaForCausalLM
     with identical weights on one device."""
-
-    @staticmethod
-    def _copy_weights(pipe, ref):
-        """Map pipe stage params onto the monolithic model's params."""
-        import jax.numpy as jnp
-
-        src = {}
-        L = ref.config.num_hidden_layers
-        items = []
-        for part in range(len(pipe._stages)):
-            items.extend(pipe.get_stage_layer(part)._items)
-        emb, layers, head = items[0], items[1:1 + L], items[1 + L]
-        src["llama.embed_tokens.weight"] = emb.embed_tokens.weight
-        for i, lp in enumerate(layers):
-            for name, p in lp.layer.named_parameters():
-                src[f"llama.layers.{i}.{name}"] = p
-        src["llama.norm.weight"] = head.norm.weight
-        src["lm_head.weight"] = head.lm_head.weight
-        own = dict(ref.named_parameters())
-        assert set(own) == set(src), (set(own) ^ set(src))
-        for k, p in src.items():
-            own[k]._array = jnp.asarray(np.asarray(p._array))
 
     def test_llama_pipe_hybrid_parity(self):
         import paddle_tpu.distributed as dist
@@ -763,7 +764,7 @@ class TestLlamaPipe:
 
         paddle.seed(1)  # different init; weights copied from the pipe below
         ref = LlamaForCausalLM(cfg)
-        self._copy_weights(pipe, ref)
+        _copy_pipe_weights(pipe, ref)
         opt_r = SGD(learning_rate=0.05, parameters=ref.parameters())
 
         rng = np.random.RandomState(0)
@@ -779,6 +780,62 @@ class TestLlamaPipe:
             opt_r.clear_grad()
             np.testing.assert_allclose(float(np.asarray(loss_p)),
                                        float(loss_r.numpy()), rtol=2e-5)
+
+
+class TestDeepseekPipe:
+    """DeepseekForCausalLMPipe: MLA + MoE (aux-free V3 routing) under
+    pp2 x mp2 x sharding2 — training parity vs the monolithic
+    DeepseekV2ForCausalLM with identical weights on one device."""
+
+    def test_deepseek_pipe_hybrid_parity(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.models.deepseek import (DeepseekForCausalLMPipe,
+                                                DeepseekV2Config,
+                                                DeepseekV2ForCausalLM)
+
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                                   "pp_degree": 2, "sharding_degree": 2,
+                                   "sep_degree": 1}
+        strategy.sharding_configs = {"stage": 3}
+        cfg = DeepseekV2Config.tiny_v3(num_hidden_layers=2,
+                                       use_flash_attention=False)
+        try:
+            dist.fleet.init(is_collective=True, strategy=strategy)
+            paddle.seed(0)
+            pipe = DeepseekForCausalLMPipe(cfg)
+            assert pipe.num_stages == 2
+            pp = dist.fleet.distributed_model(pipe)
+            assert pp._hybrid
+            opt_p = SGD(learning_rate=0.05, parameters=pipe.parameters())
+        finally:
+            dist.set_hybrid_communicate_group(None)
+
+        paddle.seed(1)  # different init; weights copied from the pipe below
+        ref = DeepseekV2ForCausalLM(cfg)
+        _copy_pipe_weights(pipe, ref)
+        opt_r = SGD(learning_rate=0.05, parameters=ref.parameters())
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (4, 17))
+        x, y = ids[:, :-1], ids[:, 1:]
+        for _ in range(2):
+            loss_p = pp.train_batch(
+                [paddle.to_tensor(x), paddle.to_tensor(y)], opt_p)
+            loss_r, _ = ref(paddle.to_tensor(x), labels=paddle.to_tensor(y))
+            loss_r.backward()
+            opt_r.step()
+            opt_r.clear_grad()
+            np.testing.assert_allclose(float(np.asarray(loss_p)),
+                                       float(loss_r.numpy()), rtol=2e-5)
+
+    def test_nonzero_aux_coef_rejected(self):
+        from paddle_tpu.models.deepseek import (DeepseekForCausalLMPipe,
+                                                DeepseekV2Config)
+
+        cfg = DeepseekV2Config.tiny_mla()  # default aux coef 0.001
+        with pytest.raises(NotImplementedError, match="aux"):
+            DeepseekForCausalLMPipe(cfg, num_stages=1)
 
 
 class TestHybridVPP:
