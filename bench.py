@@ -148,6 +148,23 @@ def _serving_config(on_tpu):
     return LlamaConfig.tiny(num_hidden_layers=2)
 
 
+def _time_generate(model, ids, new, batch, **gen_kw):
+    """Shared decode-leg timing: warm-up with the SAME max_new_tokens (the
+    decode step jit is keyed on max_len, so a shorter warm-up would leave
+    the timed run compiling; warm wall time = compile + one full request),
+    then one timed request. Returns (tokens_per_sec, ms_per_token,
+    warm_run_s) — ms_per_token is whole-request time (prefill + all decode
+    steps) per generated token, NOT decode-step latency."""
+    t0 = time.perf_counter()
+    model.generate(ids, max_new_tokens=new, **gen_kw)
+    warm_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=new, **gen_kw)
+    dt = time.perf_counter() - t0
+    return (batch * out.shape[1] / dt,
+            dt * 1000 / max(out.shape[1], 1), warm_s)
+
+
 def decode_bench(devs, gen):
     """BENCH_CONFIG=decode: serving throughput on the REAL serving path —
     GQA splash flash prefill + paged-KV Pallas decode kernel (the
@@ -163,27 +180,80 @@ def decode_bench(devs, gen):
     model = LlamaForCausalLM(cfg)
     ids = paddle.to_tensor(
         np.random.randint(0, cfg.vocab_size, (batch, prompt)))
-    # warm-up with the SAME max_new_tokens: the decode step jit is keyed on
-    # max_len, so a shorter warm-up would leave the timed run compiling.
-    # Its wall time (compile + one full request) is reported as warm_run_s.
-    t0 = time.perf_counter()
-    model.generate(ids, max_new_tokens=new, paged=True)
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = model.generate(ids, max_new_tokens=new, paged=True)
-    dt = time.perf_counter() - t0
-    tokens_per_sec = batch * out.shape[1] / dt
+    tps, ms_tok, warm_s = _time_generate(model, ids, new, batch, paged=True)
     rec = {
         "metric": "llama_decode_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
+        "value": round(tps, 1),
         "unit": "tokens/s",
         "vs_baseline": 0.0,  # no reference decode number exists
         "platform": devs[0].platform,
-        # whole-request time (flash prefill + all decode steps) per generated
-        # token — NOT decode-step latency, which excludes prefill
-        "ms_per_token": round(dt * 1000 / max(out.shape[1], 1), 2),
-        "warm_run_s": round(compile_s, 1),
+        "ms_per_token": round(ms_tok, 2),
+        "warm_run_s": round(warm_s, 1),
         "config": "decode",
+        "tpu_gen": gen,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    print(json.dumps(rec))
+
+
+def mla_decode_bench(devs, gen):
+    """BENCH_CONFIG=mla: decode throughput through the COMPRESSED latent
+    cache (DeepSeek MLA, models/deepseek.py). To isolate the cache-layout
+    effect from kernel differences, the SAME leg also times a GQA model of
+    identical hidden/depth/FFN through the SAME dense-cache code path
+    (paged=False) — `mla_vs_gqa_dense` is the clean 576-vs-2048
+    cache-floats-per-token comparison; the headline value is the MLA
+    tokens/s."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.deepseek import (DeepseekV2Config,
+                                            DeepseekV2ForCausalLM)
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    on_tpu = devs[0].platform == "tpu"
+    base = _serving_config(on_tpu)
+    if on_tpu:
+        cfg = DeepseekV2Config(
+            vocab_size=base.vocab_size, hidden_size=base.hidden_size,
+            intermediate_size=base.intermediate_size,
+            num_hidden_layers=base.num_hidden_layers,
+            num_attention_heads=base.num_attention_heads,
+            num_key_value_heads=base.num_attention_heads,
+            max_position_embeddings=base.max_position_embeddings,
+            use_flash_attention=True, dtype="bfloat16",
+            kv_lora_rank=512, qk_nope_head_dim=128, qk_rope_head_dim=64,
+            v_head_dim=128, n_routed_experts=0,
+            first_k_dense_replace=10 ** 9)  # dense FFN: isolate attention
+        # longer context than the decode leg: the cache-layout effect is
+        # proportional to cached tokens, so give the comparison a real
+        # cache to stream (768+128 fits the serving config's max_pos 1024)
+        batch, prompt, new = 16, 768, 128
+    else:
+        cfg = DeepseekV2Config.tiny_mla(num_hidden_layers=2,
+                                        first_k_dense_replace=10 ** 9,
+                                        n_routed_experts=0)
+        batch, prompt, new = 2, 16, 16
+    paddle.seed(0)
+    model = DeepseekV2ForCausalLM(cfg)
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (batch, prompt)))
+    tps, ms_tok, warm_s = _time_generate(model, ids, new, batch)
+    # GQA control through the IDENTICAL dense-cache decode path
+    paddle.seed(0)
+    gqa = LlamaForCausalLM(base)
+    gqa_ids = paddle.to_tensor(
+        np.random.randint(0, base.vocab_size, (batch, prompt)))
+    gqa_tps, _, _ = _time_generate(gqa, gqa_ids, new, batch)
+    rec = {
+        "metric": "mla_decode_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # no reference MLA number exists
+        "platform": devs[0].platform,
+        "ms_per_token": round(ms_tok, 2),
+        "warm_run_s": round(warm_s, 1),
+        "gqa_dense_tokens_per_sec": round(gqa_tps, 1),
+        "mla_vs_gqa_dense": round(tps / gqa_tps, 3) if gqa_tps else None,
+        "config": "mla",
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
@@ -419,6 +489,8 @@ def main():
     cfg_name = os.environ.get("BENCH_CONFIG", "1b")
     if cfg_name == "decode":
         return decode_bench(devs, gen)
+    if cfg_name == "mla":
+        return mla_decode_bench(devs, gen)
     if cfg_name == "serve":
         return serve_bench(devs, gen)
     if cfg_name == "cp":

@@ -46,7 +46,7 @@ run_cfg() {  # $1 = BENCH_CONFIG; extra VAR=val pairs in $2..
 while [ "$(date +%s)" -lt "$deadline" ]; do
   if probe_ok; then
     echo "$(date -Is) tunnel UP" >> "$log"
-    for c in 8b decode serve 1b longctx moe cp pp; do
+    for c in 8b decode serve 1b longctx moe cp pp mla; do
       have "$c" && continue
       run_cfg "$c"
       if ! probe_ok; then
@@ -82,6 +82,13 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       sweep geo256x512 8b PD_SPLASH_BLOCK_Q=256 PD_SPLASH_BLOCK_KV=512 || continue
       sweep profile8b 8b BENCH_PROFILE=1
       [ -e "$stamp_dir/profile8b" ] || continue
+      # only declare done when EVERY config in the capture list has a TPU
+      # record — the core gate above covers 8b/decode/serve/longctx only,
+      # and a leg that failed its one attempt this window must keep the
+      # loop alive to retry next window
+      for c in 1b moe cp pp mla; do
+        have "$c" || continue 2
+      done
       echo "$(date -Is) all configs + sweeps captured — done" >> "$log"
       exit 0
     fi
