@@ -1,7 +1,8 @@
 """Every causal/seq2seq family in the zoo, built + generating in one run:
 Llama-3 (RoPE GQA), Qwen2 (qkv bias), Mistral (sliding window), GPT-2
 (learned positions), DeepSeekMoE (routed experts), Qwen2-MoE (sigmoid
-shared gate), ERNIE-4.5 (MoE decoder), T5/BART (encoder-decoder) — all
+shared gate), ERNIE-4.5 (MoE decoder), DeepSeek-V2/V3 (MLA latent cache,
+group-limited routing), T5/BART (encoder-decoder) — all
 through the same generate surface, then one continuous-batching engine
 serving three different families' requests back to back.
 
@@ -44,6 +45,10 @@ def main():
             M.Qwen2MoeConfig.tiny(vocab_size=256))),
         ("ernie-4.5", M.Ernie45ForCausalLM(
             M.Ernie45Config.tiny_moe(vocab_size=256))),
+        ("deepseek-v2", M.DeepseekV2ForCausalLM(
+            M.DeepseekV2Config.tiny_mla(vocab_size=256))),
+        ("deepseek-v3", M.DeepseekV2ForCausalLM(
+            M.DeepseekV2Config.tiny_v3(vocab_size=256))),
         ("t5", M.T5ForConditionalGeneration(M.T5Config.tiny(vocab_size=256))),
         ("bart", M.BartForConditionalGeneration(
             M.BartConfig.tiny(vocab_size=256))),

@@ -233,6 +233,15 @@ class DeepseekV2Attention(Layer):
                                       config=config)
         self.o_proj = _make_linear(H * dv, h, column=False, config=config)
 
+    def _kv_b_weight(self):
+        """kv_b_proj's weight for the absorbed/expansion contractions —
+        through the adapter-folded view when the layer is LoRA-wrapped
+        (reading .weight directly would silently bypass the adapter)."""
+        lin = self.kv_b_proj
+        if hasattr(lin, "effective_weight"):
+            return lin.effective_weight()
+        return lin.weight
+
     def _project(self, hidden_states):
         """Shared q/latent projections → (q_nope, q_pe, c_kv, k_pe)."""
         b, s = hidden_states.shape[0], hidden_states.shape[1]
@@ -260,7 +269,7 @@ class DeepseekV2Attention(Layer):
                 "mla_attention_cached", mla_cached_attention,
                 q_nope, q_pe, c_kv, k_pe, cos, sin,
                 kv_cache["c_kv"], kv_cache["k_pe"], kv_cache["pos"],
-                self.kv_b_proj.weight,
+                self._kv_b_weight(),
                 nope_dim=dn, v_dim=dv,
                 allowed=kv_cache.get("allowed"),
                 row_pos=kv_cache.get("row_pos"),
@@ -335,7 +344,7 @@ class DeepseekV2Attention(Layer):
             return out.reshape(b, s, H * dv)
 
         out = apply("mla_attention", attn_fn, q_nope, q_pe, c_kv, k_pe,
-                    cos, sin, self.kv_b_proj.weight)
+                    cos, sin, self._kv_b_weight())
         return self.o_proj(out)
 
 

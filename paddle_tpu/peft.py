@@ -82,6 +82,19 @@ class LoRALinear(Layer):
             h = _dropout(h, p=self.lora_dropout, training=True)
         return out + apply("lora_delta", delta, h, self.lora_A, self.lora_B)
 
+    def effective_weight(self):
+        """The adapter-folded weight W + (alpha/r)·A·B as a LIVE tensor —
+        for consumers that contract against the raw weight instead of
+        calling forward (the MLA absorbed decode path reads kv_b_proj's
+        weight directly); differentiable through A/B, so adapters train
+        even when the host layer never calls forward().
+
+        Cost note: the fold re-materializes the full weight at every call.
+        Inside a jitted scan decode XLA hoists it (loop-invariant), but
+        the host-loop decode pays it per step per layer — for adapter
+        SERVING, ``merge_lora`` first and decode the merged model."""
+        return self.base.weight + (self.lora_A @ self.lora_B) * self.scaling
+
     def merge(self) -> Linear:
         """Fold the adapter into the base weight; returns the base layer."""
         w = unwrap(self.base.weight)
