@@ -92,7 +92,16 @@ class LoRALinear(Layer):
         Cost note: the fold re-materializes the full weight at every call.
         Inside a jitted scan decode XLA hoists it (loop-invariant), but
         the host-loop decode pays it per step per layer — for adapter
-        SERVING, ``merge_lora`` first and decode the merged model."""
+        SERVING, ``merge_lora`` first and decode the merged model.
+
+        ``lora_dropout`` acts on the INPUT (``dropout(x)·A·B``) and has no
+        weight-space equivalent, so a training-mode fold would silently
+        skip the regularization other adapters get — raise instead."""
+        if self.lora_dropout > 0.0 and self.training:
+            raise NotImplementedError(
+                "effective_weight() cannot apply lora_dropout (an "
+                "input-space op); use lora_dropout=0 for weight-consuming "
+                "target modules, or eval() the model first")
         return self.base.weight + (self.lora_A @ self.lora_B) * self.scaling
 
     def merge(self) -> Linear:
