@@ -123,7 +123,7 @@ def _mla_sdpa(q, k, v, *, causal: bool, use_flash: bool, scale: float):
 def mla_cached_attention(q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf,
                          kpe_buf, pos, w_kv_b, *, nope_dim, v_dim,
                          allowed=None, row_pos=None, prefill=False,
-                         use_flash=False):
+                         use_flash=False, interpret=False):
     """RoPE + latent-cache write + absorbed MLA attention against the
     compressed buffer (the decode analog of generation.cached_attention).
 
@@ -180,10 +180,26 @@ def mla_cached_attention(q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf,
     w_uk, w_uv = w3[..., :nope_dim], w3[..., nope_dim:]
     q_lat = jnp.einsum("bshd,rhd->bshr", q_nope.astype(jnp.float32),
                        w_uk.astype(jnp.float32))
+    if S == 1 and use_flash:
+        # single-token decode: the Pallas kernel streams each latent block
+        # through VMEM ONCE for both scores and context (the einsum path
+        # below reads the buffer twice) — the decode-bandwidth fast path
+        from ..ops.pallas import mla_decode as pmd
+
+        ql = q_lat[:, 0] * scale                      # [B, H, r] pre-scaled
+        qp = q_pe[:, 0].astype(jnp.float32) * scale   # [B, H, dr]
+        if pmd.supported(ql, ckv_buf, kpe_buf, interpret=interpret):
+            ctx = pmd.mla_decode_attention(ql, qp, ckv_buf, kpe_buf, pos,
+                                           allowed=allowed,
+                                           interpret=interpret)
+            out = jnp.einsum("bhr,rhd->bhd", ctx.astype(jnp.float32),
+                             w_uv.astype(jnp.float32))
+            return (out[:, None].astype(q_nope.dtype), ckv_buf, kpe_buf)
     scores = (jnp.einsum("bshr,btr->bhst", q_lat,
                          ckv_buf.astype(jnp.float32))
+              # [..., :dr]: the TPU cache is lane-padded (empty_cache_layer)
               + jnp.einsum("bshd,btd->bhst", q_pe.astype(jnp.float32),
-                           kpe_buf.astype(jnp.float32))) * scale
+                           kpe_buf[..., :dr].astype(jnp.float32))) * scale
     T = ckv_buf.shape[1]
     t_idx = jnp.arange(T)
     valid = t_idx[None, :] <= (pos + jnp.arange(S))[:, None]   # [S, T]
@@ -383,11 +399,21 @@ class DeepseekV2Model(LlamaModel):
     def empty_cache_layer(self, batch, max_len, dtype):
         """Per-layer decode cache: the COMPRESSED latent + shared RoPE key
         (generation._empty_caches consumes this hook) —
-        kv_lora_rank + qk_rope_head_dim floats per token."""
+        kv_lora_rank + qk_rope_head_dim floats per token.
+
+        On TPU the k_pe buffer is allocated LANE-PADDED (width up to the
+        next 128 multiple, zeros beyond qk_rope_head_dim) so the Pallas
+        decode kernel consumes it zero-copy every step; writers write the
+        true width at offset 0 and einsum readers slice it back."""
         cfg = self.config
+        dr = cfg.qk_rope_head_dim
+        try:
+            if jax.default_backend() == "tpu":
+                dr = -(-dr // 128) * 128
+        except Exception:  # pragma: no cover
+            pass
         return {"c_kv": jnp.zeros((batch, max_len, cfg.kv_lora_rank), dtype),
-                "k_pe": jnp.zeros((batch, max_len, cfg.qk_rope_head_dim),
-                                  dtype)}
+                "k_pe": jnp.zeros((batch, max_len, dr), dtype)}
 
 
 class DeepseekV2ForCausalLM(LlamaMoEForCausalLM):

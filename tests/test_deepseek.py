@@ -167,6 +167,59 @@ def test_correction_bias_changes_selection_not_weights():
     np.testing.assert_allclose(base, back, atol=1e-6)
 
 
+@pytest.mark.parametrize("ragged", [False, True], ids=["full", "allowed"])
+def test_mla_decode_kernel_matches_einsum(ragged):
+    """The Pallas single-pass latent decode kernel (interpret mode) must
+    equal the absorbed einsum branch at S=1, including the column-validity
+    mask and a mid-buffer pos."""
+    from paddle_tpu.models.deepseek import mla_cached_attention
+    from paddle_tpu.models.llama import _rope_tables
+
+    rng = np.random.RandomState(31)
+    B, H, dn, dr, dv, r, T = 2, 8, 32, 16, 32, 128, 256
+    pos = 37
+    q_nope = rng.randn(B, 1, H, dn).astype(np.float32) * 0.3
+    q_pe = rng.randn(B, 1, H, dr).astype(np.float32) * 0.3
+    c_kv = rng.randn(B, 1, r).astype(np.float32) * 0.3
+    k_pe = rng.randn(B, 1, dr).astype(np.float32) * 0.3
+    ckv_buf = rng.randn(B, T, r).astype(np.float32) * 0.3
+    kpe_buf = rng.randn(B, T, dr).astype(np.float32) * 0.3
+    w = rng.randn(r, H * (dn + dv)).astype(np.float32) * 0.1
+    cos, sin = _rope_tables(T, dr, 10000.0)
+    allowed = None
+    if ragged:
+        import jax.numpy as jnp
+
+        al = np.ones((B, T), bool)
+        al[1, 5:20] = False   # interior hole in row 1's prompt history
+        allowed = jnp.asarray(al)
+
+    kw = dict(nope_dim=dn, v_dim=dv, allowed=allowed)
+    out_k, bk, pk = mla_cached_attention(
+        q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf, kpe_buf, pos, w,
+        use_flash=True, interpret=True, **kw)
+    out_e, be, pe = mla_cached_attention(
+        q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf, kpe_buf, pos, w,
+        use_flash=False, **kw)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_e),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(bk), np.asarray(be), atol=0)
+    np.testing.assert_allclose(np.asarray(pk), np.asarray(pe), atol=0)
+    if ragged:
+        # a FULLY masked row must come out zero (documented kernel
+        # behavior for dead rows — the einsum softmax would NaN)
+        import jax.numpy as jnp
+
+        dead = np.zeros((B, T), bool)
+        dead[0] = True   # row 1: no visible column at all
+        out_d, _, _ = mla_cached_attention(
+            q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf, kpe_buf, pos, w,
+            use_flash=True, interpret=True, nope_dim=dn, v_dim=dv,
+            allowed=jnp.asarray(dead))
+        assert np.isfinite(np.asarray(out_d)).all()
+        np.testing.assert_allclose(np.asarray(out_d)[1], 0.0, atol=0)
+
+
 def test_lora_on_mla():
     """LoRA composes with MLA: adapters on the MLA projections (q_proj /
     kv_b_proj / o_proj), identity at init, merge matches the adapter
