@@ -120,6 +120,37 @@ def _mla_sdpa(q, k, v, *, causal: bool, use_flash: bool, scale: float):
     return _sdpa_ref(q, k, v, causal=causal, scale=scale)
 
 
+def _absorbed_tail(q_lat, q_pe, ckv_buf, kpe_buf, w_uv, scale, dr, mask,
+                   kernel_pos, allowed, use_flash, interpret):
+    """The absorbed-attention tail shared by the generate() cache path and
+    the serving engine: optional S=1 Pallas hop (single pass over the
+    latent buffer), else masked-softmax einsums. q_lat [B,S,H,r] f32
+    UNscaled; q_pe [B,S,H,dr] roped; mask [B or 1, 1, S, T] bool;
+    kernel_pos scalar or [B] row limits for the kernel. Returns the
+    latent-absorbed output [B,S,H,dv] (f32)."""
+    S = q_lat.shape[1]
+    if S == 1 and use_flash:
+        from ..ops.pallas import mla_decode as pmd
+
+        ql = q_lat[:, 0] * scale
+        qp = q_pe[:, 0].astype(jnp.float32) * scale
+        if pmd.supported(ql, ckv_buf, kpe_buf, interpret=interpret):
+            ctx = pmd.mla_decode_attention(ql, qp, ckv_buf, kpe_buf,
+                                           kernel_pos, allowed=allowed,
+                                           interpret=interpret)
+            return jnp.einsum("bhr,rhd->bhd", ctx.astype(jnp.float32),
+                              w_uv.astype(jnp.float32))[:, None]
+    scores = (jnp.einsum("bshr,btr->bhst", q_lat,
+                         ckv_buf.astype(jnp.float32))
+              # [..., :dr]: the TPU cache is lane-padded (empty_cache_layer)
+              + jnp.einsum("bshd,btd->bhst", q_pe.astype(jnp.float32),
+                           kpe_buf[..., :dr].astype(jnp.float32))) * scale
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhst,btr->bshr", probs, ckv_buf.astype(jnp.float32))
+    return jnp.einsum("bshr,rhd->bshd", ctx, w_uv.astype(jnp.float32))
+
+
 def mla_cached_attention(q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf,
                          kpe_buf, pos, w_kv_b, *, nope_dim, v_dim,
                          allowed=None, row_pos=None, prefill=False,
@@ -176,40 +207,65 @@ def mla_cached_attention(q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf,
                         scale=scale)
         return out, ckv_buf, kpe_buf
 
-    # absorbed attention over the latent buffer
+    # absorbed attention over the latent buffer (shared tail; Pallas
+    # single-pass hop at S=1)
     w_uk, w_uv = w3[..., :nope_dim], w3[..., nope_dim:]
     q_lat = jnp.einsum("bshd,rhd->bshr", q_nope.astype(jnp.float32),
                        w_uk.astype(jnp.float32))
-    if S == 1 and use_flash:
-        # single-token decode: the Pallas kernel streams each latent block
-        # through VMEM ONCE for both scores and context (the einsum path
-        # below reads the buffer twice) — the decode-bandwidth fast path
-        from ..ops.pallas import mla_decode as pmd
-
-        ql = q_lat[:, 0] * scale                      # [B, H, r] pre-scaled
-        qp = q_pe[:, 0].astype(jnp.float32) * scale   # [B, H, dr]
-        if pmd.supported(ql, ckv_buf, kpe_buf, interpret=interpret):
-            ctx = pmd.mla_decode_attention(ql, qp, ckv_buf, kpe_buf, pos,
-                                           allowed=allowed,
-                                           interpret=interpret)
-            out = jnp.einsum("bhr,rhd->bhd", ctx.astype(jnp.float32),
-                             w_uv.astype(jnp.float32))
-            return (out[:, None].astype(q_nope.dtype), ckv_buf, kpe_buf)
-    scores = (jnp.einsum("bshr,btr->bhst", q_lat,
-                         ckv_buf.astype(jnp.float32))
-              # [..., :dr]: the TPU cache is lane-padded (empty_cache_layer)
-              + jnp.einsum("bshd,btd->bhst", q_pe.astype(jnp.float32),
-                           kpe_buf[..., :dr].astype(jnp.float32))) * scale
     T = ckv_buf.shape[1]
     t_idx = jnp.arange(T)
     valid = t_idx[None, :] <= (pos + jnp.arange(S))[:, None]   # [S, T]
     mask = valid[None, None]                                   # [1,1,S,T]
     if allowed is not None:
         mask = mask & allowed[:, None, None, :]                # [B,1,S,T]
-    scores = jnp.where(mask, scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)
-    ctx = jnp.einsum("bhst,btr->bshr", probs, ckv_buf.astype(jnp.float32))
-    out = jnp.einsum("bshr,rhd->bshd", ctx, w_uv.astype(jnp.float32))
+    out = _absorbed_tail(q_lat, q_pe, ckv_buf, kpe_buf, w_uv, scale, dr,
+                         mask, kernel_pos=pos, allowed=allowed,
+                         use_flash=use_flash, interpret=interpret)
+    return out.astype(q_nope.dtype), ckv_buf, kpe_buf
+
+
+def mla_serving_attention(q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf,
+                          kpe_buf, lengths, w_kv_b, *, nope_dim, v_dim,
+                          use_flash=False, interpret=False):
+    """Continuous-batching decode over the latent cache: each SLOT row sits
+    at its own length (requests admit/retire independently), so writes
+    scatter per row at ``lengths[b]``, RoPE rides per-row positions, and
+    attention masks ``t <= lengths[b]``. S must be 1 (one token per active
+    slot per engine step). Returns (out [B,1,H,dv], new_ckv, new_kpe).
+
+    The Pallas decode kernel takes the hop with per-row ``pos`` when the
+    shapes tile; else the masked einsum. Rows whose slot is empty
+    (length 0) compute one masked column of garbage that the engine
+    discards — identical to the paged path's dead-slot behavior."""
+    from ..generation import _rope_rows
+
+    B, S, H, dn = q_nope.shape
+    if S != 1:
+        raise ValueError(f"mla_serving_attention decodes one token per "
+                         f"slot per step, got S={S}")
+    dr = q_pe.shape[-1]
+    r = c_kv.shape[-1]
+    scale = 1.0 / math.sqrt(nope_dim + dr)
+    lengths = jnp.asarray(lengths, jnp.int32)
+
+    q_pe = _rope_rows(q_pe, cos, sin, lengths)
+    k_pe4 = _rope_rows(k_pe[:, :, None, :], cos, sin, lengths)
+
+    rows = jnp.arange(B)
+    ckv_buf = ckv_buf.at[rows, lengths].set(
+        c_kv[:, 0].astype(ckv_buf.dtype))
+    kpe_buf = kpe_buf.at[rows, lengths, :dr].set(
+        k_pe4[:, 0, 0, :].astype(kpe_buf.dtype))
+
+    w3 = w_kv_b.reshape(r, H, nope_dim + v_dim)
+    w_uk, w_uv = w3[..., :nope_dim], w3[..., nope_dim:]
+    q_lat = jnp.einsum("bshd,rhd->bshr", q_nope.astype(jnp.float32),
+                       w_uk.astype(jnp.float32))
+    T = ckv_buf.shape[1]
+    mask = (jnp.arange(T)[None, :] <= lengths[:, None])[:, None, None]
+    out = _absorbed_tail(q_lat, q_pe, ckv_buf, kpe_buf, w_uv, scale, dr,
+                         mask, kernel_pos=lengths, allowed=None,
+                         use_flash=use_flash, interpret=interpret)
     return out.astype(q_nope.dtype), ckv_buf, kpe_buf
 
 
@@ -280,6 +336,18 @@ class DeepseekV2Attention(Layer):
         cfg = self.config
         q_nope, q_pe, c_kv, k_pe = self._project(hidden_states)
 
+        if isinstance(kv_cache, dict) and "lengths" in kv_cache:
+            # continuous-batching engine cache: per-row slot lengths
+            out, ckv_buf, kpe_buf = apply(
+                "mla_attention_serving", mla_serving_attention,
+                q_nope, q_pe, c_kv, k_pe, cos, sin,
+                kv_cache["c_kv"], kv_cache["k_pe"], kv_cache["lengths"],
+                self._kv_b_weight(), nope_dim=dn, v_dim=dv,
+                use_flash=cfg.use_flash_attention)
+            result = self.o_proj(out.reshape([b, s, H * dv]))
+            new = {"c_kv": ckv_buf, "k_pe": kpe_buf,
+                   "lengths": kv_cache["lengths"] + s}
+            return result, new
         if isinstance(kv_cache, dict):
             out, ckv_buf, kpe_buf = apply(
                 "mla_attention_cached", mla_cached_attention,

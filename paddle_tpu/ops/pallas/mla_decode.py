@@ -73,7 +73,7 @@ def _kernel(pos_ref, qlat_ref, qpe_ref, ckv_ref, kpe_ref, allowed_ref,
             o_ref, *, H, r, dp, T, bkv, have_allowed):
     qlat = qlat_ref[0].astype(jnp.float32)         # [H, r] (pre-scaled)
     qpe = qpe_ref[0].astype(jnp.float32)           # [H, dp] (pre-scaled)
-    pos = pos_ref[0]
+    pos = pos_ref[pl.program_id(0)]                # per-row visible limit
     nb = T // bkv
 
     def body(i, carry):
@@ -135,7 +135,10 @@ def _decode_jit(q_lat, q_pe, ckv_buf, kpe_buf, pos, allowed, interpret):
         allowed = jnp.ones((B, T), jnp.int8)
     else:
         allowed = allowed.astype(jnp.int8)
-    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    # pos: scalar (shared decode offset) or [B] (per-row serving slots) —
+    # the kernel always reads pos_ref[row]
+    pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1),
+                               (B,))
 
     kern = functools.partial(_kernel, H=H, r=r, dp=dp, T=T, bkv=bkv,
                              have_allowed=have_allowed)
@@ -162,7 +165,8 @@ def mla_decode_attention(q_lat, q_pe, ckv_buf, kpe_buf, pos, allowed=None,
                          interpret: bool = False):
     """q_lat [B,H,r] (absorbed + PRE-SCALED), q_pe [B,H,dr] (RoPE'd +
     pre-scaled), ckv_buf [B,T,r], kpe_buf [B,T,dr] (current token already
-    written at ``pos``), pos scalar, allowed optional [B,T] column mask.
+    written at ``pos``), pos scalar OR [B] per-row limits (serving slots
+    at different lengths), allowed optional [B,T] column mask.
     Returns the latent-space context [B,H,r] — same math as the absorbed
     einsum branch of models.deepseek.mla_cached_attention at S=1."""
     return _decode_jit(q_lat, q_pe, ckv_buf, kpe_buf, pos, allowed,

@@ -81,21 +81,34 @@ class ContinuousBatchEngine:
         self.eos_token_id = eos_token_id
         self._sample_cfg = (do_sample, float(temperature), int(top_k), float(top_p))
 
-        hk = cfg.num_key_value_heads
-        d = cfg.hidden_size // cfg.num_attention_heads
         dt = jnp.dtype(cfg.dtype) if isinstance(cfg.dtype, str) else cfg.dtype
         self._pages_per_slot = max_len // page_size
-        n_pages = max_batch * self._pages_per_slot
-        page_indices = jnp.arange(n_pages, dtype=jnp.int32).reshape(
-            max_batch, self._pages_per_slot)
         self._lengths = jnp.zeros((max_batch,), jnp.int32)
-        self._caches = [{
-            "k_pages": jnp.zeros((hk, n_pages, page_size, d), dt),
-            "v_pages": jnp.zeros((hk, n_pages, page_size, d), dt),
-            "page_indices": page_indices,
-            "lengths": self._lengths,
-            "page_size": page_size,
-        } for _ in range(cfg.num_hidden_layers)]
+        # models with a latent decode cache (MLA) serve through per-slot
+        # rows of the compressed buffers instead of the paged K/V pool
+        make = getattr(model.llama, "empty_cache_layer", None)
+        self._latent_mode = make is not None
+        if self._latent_mode:
+            if enable_prefix_cache:
+                raise NotImplementedError(
+                    "prefix caching is page-granular; the MLA latent "
+                    "cache serves without it")
+            self._caches = [dict(make(max_batch, max_len, dt),
+                                 lengths=self._lengths)
+                            for _ in range(cfg.num_hidden_layers)]
+        else:
+            hk = cfg.num_key_value_heads
+            d = cfg.hidden_size // cfg.num_attention_heads
+            n_pages = max_batch * self._pages_per_slot
+            page_indices = jnp.arange(n_pages, dtype=jnp.int32).reshape(
+                max_batch, self._pages_per_slot)
+            self._caches = [{
+                "k_pages": jnp.zeros((hk, n_pages, page_size, d), dt),
+                "v_pages": jnp.zeros((hk, n_pages, page_size, d), dt),
+                "page_indices": page_indices,
+                "lengths": self._lengths,
+                "page_size": page_size,
+            } for _ in range(cfg.num_hidden_layers)]
         self._last = jnp.zeros((max_batch, cfg.vocab_size), jnp.float32)
 
         self._poisoned = False
@@ -432,9 +445,67 @@ class ContinuousBatchEngine:
         self._lengths = self._lengths.at[slot].set(S0)
         self.prefix_pages_reused += n_pref
 
+    def _latent_scatter_fn(self, bucket: int):
+        """Jitted, buffer-DONATING scatter of one prefilled prompt's latent
+        rows into a slot's row across all layers (the latent-mode analog of
+        _scatter_fn)."""
+        def build():
+            def scatter(bufs, prefill, slot):
+                out = []
+                for (ckv, kpe), c_new in zip(bufs, prefill):
+                    out.append((
+                        jax.lax.dynamic_update_slice(
+                            ckv, c_new["c_kv"].astype(ckv.dtype),
+                            (slot, 0, 0)),
+                        jax.lax.dynamic_update_slice(
+                            kpe, c_new["k_pe"].astype(kpe.dtype),
+                            (slot, 0, 0)),
+                    ))
+                return out
+
+            fn = jax.jit(scatter, donate_argnums=(0,))
+            fn._state = None  # _memoized_step refresh hook (stateless)
+            return fn
+
+        return _memoized_step(self.model, "_latent_scatter_fns", (bucket,),
+                              build)
+
+    def _prefill_into_latent(self, slot: int, req: _Request):
+        """Latent-mode admission: bucketed prefill of one prompt (latent
+        caches come back [1, bucket, ...]), scattered into the slot's row
+        of each layer's compressed buffers."""
+        S0 = int(req.ids.size)
+        bucket = self._bucket(S0)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :S0] = req.ids
+        ragged = S0 != bucket
+        prefill = _get_prefill_step(self.model, bucket, ragged)
+        pad_mask = None
+        if ragged:
+            pad_mask = jnp.zeros((1, bucket), bool).at[0, :S0].set(True)
+        last, caches = prefill(jnp.asarray(ids),
+                               jnp.asarray([S0], jnp.int32), pad_mask)
+        bufs = [(c["c_kv"], c["k_pe"]) for c in self._caches]
+        try:
+            new_bufs = self._latent_scatter_fn(bucket)(
+                bufs, caches, jnp.asarray(slot, jnp.int32))
+        except Exception as e:
+            self._poisoned = True
+            raise RuntimeError(
+                "ContinuousBatchEngine: admission failed after the latent "
+                "buffers were donated; the engine's cache state is invalid "
+                "— rebuild the engine and resubmit in-flight requests"
+            ) from e
+        for c_eng, (ckv, kpe) in zip(self._caches, new_bufs):
+            c_eng["c_kv"], c_eng["k_pe"] = ckv, kpe
+        self._last = self._last.at[slot].set(last[0].astype(jnp.float32))
+        self._lengths = self._lengths.at[slot].set(S0)
+
     def _prefill_into(self, slot: int, req: _Request):
         """Bucketed jitted prefill of one prompt, scattered into the slot's
         pages; the slot's last-logit row seeds sampling."""
+        if self._latent_mode:
+            return self._prefill_into_latent(slot, req)
         if self.enable_prefix_cache:
             src, n_pref = self._find_shared_prefix(req)
             if n_pref > 0:

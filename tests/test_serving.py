@@ -37,6 +37,44 @@ def test_staggered_requests_match_solo(tiny_model):
         np.testing.assert_array_equal(done[rid], solo, err_msg=f"req {rid}")
 
 
+def test_mla_latent_mode_staggered_match_solo():
+    """DeepSeek MLA serves through the engine's latent mode (per-slot rows
+    of the compressed buffers, per-row lengths): staggered requests with
+    mid-flight admission all match their solo greedy decode."""
+    from paddle_tpu.models.deepseek import (DeepseekV2Config,
+                                            DeepseekV2ForCausalLM)
+
+    paddle.seed(3)
+    m = DeepseekV2ForCausalLM(DeepseekV2Config.tiny_mla(num_hidden_layers=2))
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8)
+    assert eng._latent_mode
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, m.config.vocab_size, (n,))
+               for n in (5, 11, 3, 7)]
+    rids = [eng.add_request(p, max_new_tokens=6) for p in prompts[:3]]
+    assert eng.num_active == 2
+    for _ in range(3):
+        eng.step()
+    rids.append(eng.add_request(prompts[3], max_new_tokens=6))
+    done = eng.run_until_done()
+    assert set(done) == set(rids)
+    for rid, p in zip(rids, prompts):
+        solo = m.generate(paddle.to_tensor(p[None]),
+                          max_new_tokens=6).numpy()[0]
+        np.testing.assert_array_equal(done[rid], solo, err_msg=f"req {rid}")
+
+
+def test_mla_latent_mode_rejects_prefix_cache():
+    from paddle_tpu.models.deepseek import (DeepseekV2Config,
+                                            DeepseekV2ForCausalLM)
+
+    paddle.seed(3)
+    m = DeepseekV2ForCausalLM(DeepseekV2Config.tiny_mla(num_hidden_layers=2))
+    with pytest.raises(NotImplementedError, match="prefix"):
+        ContinuousBatchEngine(m, max_batch=2, max_len=64,
+                              enable_prefix_cache=True)
+
+
 def test_eos_retires_slot_early(tiny_model):
     """A row hitting eos frees its slot immediately (its output stops at
     eos) while the other row keeps decoding to its budget."""
