@@ -99,6 +99,22 @@ def _pad_lanes(x, to: int):
     return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, to - d)])
 
 
+def mla_softmax_scale(cfg):
+    """1/sqrt(d_qk) — times the yarn mscale_all_dim factor SQUARED when the
+    checkpoint scales softmax (HF DeepseekV2Attention under yarn:
+    ``softmax_scale *= yarn_get_mscale(factor, mscale_all_dim)**2``)."""
+    from .llama import _rope_type, _yarn_get_mscale
+
+    scale = 1.0 / math.sqrt(cfg.qk_nope_head_dim + cfg.qk_rope_head_dim)
+    rs = cfg.rope_scaling
+    if _rope_type(rs) == "yarn":
+        mad = float(rs.get("mscale_all_dim", 0) or 0)
+        if mad:
+            m = _yarn_get_mscale(float(rs["factor"]), mad)
+            scale = scale * m * m
+    return scale
+
+
 def _mla_sdpa(q, k, v, *, causal: bool, use_flash: bool, scale: float):
     """Expanded-attention hop shared by training and prefill: q/k at
     ``qk_nope+qk_rope`` width, v at ``v_head_dim``. Takes the splash
@@ -154,7 +170,7 @@ def _absorbed_tail(q_lat, q_pe, ckv_buf, kpe_buf, w_uv, scale, dr, mask,
 def mla_cached_attention(q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf,
                          kpe_buf, pos, w_kv_b, *, nope_dim, v_dim,
                          allowed=None, row_pos=None, prefill=False,
-                         use_flash=False, interpret=False):
+                         use_flash=False, interpret=False, sm_scale=None):
     """RoPE + latent-cache write + absorbed MLA attention against the
     compressed buffer (the decode analog of generation.cached_attention).
 
@@ -175,7 +191,7 @@ def mla_cached_attention(q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf,
     B, S, H, dn = q_nope.shape
     dr = q_pe.shape[-1]
     r = c_kv.shape[-1]
-    scale = 1.0 / math.sqrt(nope_dim + dr)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(nope_dim + dr)
     pos = jnp.asarray(pos, jnp.int32)
 
     k_pe4 = k_pe[:, :, None, :]                            # [B,S,1,dr]
@@ -226,7 +242,7 @@ def mla_cached_attention(q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf,
 
 def mla_serving_attention(q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf,
                           kpe_buf, lengths, w_kv_b, *, nope_dim, v_dim,
-                          use_flash=False, interpret=False):
+                          use_flash=False, interpret=False, sm_scale=None):
     """Continuous-batching decode over the latent cache: each SLOT row sits
     at its own length (requests admit/retire independently), so writes
     scatter per row at ``lengths[b]``, RoPE rides per-row positions, and
@@ -245,7 +261,7 @@ def mla_serving_attention(q_nope, q_pe, c_kv, k_pe, cos, sin, ckv_buf,
                          f"slot per step, got S={S}")
     dr = q_pe.shape[-1]
     r = c_kv.shape[-1]
-    scale = 1.0 / math.sqrt(nope_dim + dr)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(nope_dim + dr)
     lengths = jnp.asarray(lengths, jnp.int32)
 
     q_pe = _rope_rows(q_pe, cos, sin, lengths)
@@ -304,6 +320,7 @@ class DeepseekV2Attention(Layer):
         self.kv_b_proj = _make_linear(r, H * (dn + dv), column=True,
                                       config=config)
         self.o_proj = _make_linear(H * dv, h, column=False, config=config)
+        self.softmax_scale = mla_softmax_scale(config)
 
     def _kv_b_weight(self):
         """kv_b_proj's weight for the absorbed/expansion contractions —
@@ -343,7 +360,8 @@ class DeepseekV2Attention(Layer):
                 q_nope, q_pe, c_kv, k_pe, cos, sin,
                 kv_cache["c_kv"], kv_cache["k_pe"], kv_cache["lengths"],
                 self._kv_b_weight(), nope_dim=dn, v_dim=dv,
-                use_flash=cfg.use_flash_attention)
+                use_flash=cfg.use_flash_attention,
+                sm_scale=self.softmax_scale)
             result = self.o_proj(out.reshape([b, s, H * dv]))
             new = {"c_kv": ckv_buf, "k_pe": kpe_buf,
                    "lengths": kv_cache["lengths"] + s}
@@ -358,7 +376,8 @@ class DeepseekV2Attention(Layer):
                 allowed=kv_cache.get("allowed"),
                 row_pos=kv_cache.get("row_pos"),
                 prefill=bool(kv_cache.get("prefill", False)),
-                use_flash=cfg.use_flash_attention)
+                use_flash=cfg.use_flash_attention,
+                sm_scale=self.softmax_scale)
             result = self.o_proj(out.reshape([b, s, H * dv]))
             new = {"c_kv": ckv_buf, "k_pe": kpe_buf,
                    "pos": kv_cache["pos"] + s}
@@ -403,7 +422,7 @@ class DeepseekV2Attention(Layer):
                 cp = shard_map(
                     functools.partial(
                         mla_ring_attention, axis_name="sep", nope_dim=dn,
-                        v_dim=dv, sm_scale=1.0 / math.sqrt(dn + dr)),
+                        v_dim=dv, sm_scale=self.softmax_scale),
                     mesh=mesh,
                     in_specs=(P(batch_ax, "sep", head_ax, None),
                               P(batch_ax, "sep", None),
@@ -424,7 +443,7 @@ class DeepseekV2Attention(Layer):
                                   (b, s, H, dr))], axis=-1)
             out = _mla_sdpa(q, k, v, causal=True,
                             use_flash=cfg.use_flash_attention,
-                            scale=1.0 / math.sqrt(dn + dr))
+                            scale=self.softmax_scale)
             return out.reshape(b, s, H * dv)
 
         out = apply("mla_attention", attn_fn, q_nope, q_pe, c_kv, k_pe,
@@ -591,11 +610,12 @@ def deepseek_from_hf(hf_model, config=None):
                 getattr(hc, "aux_loss_alpha", 0.0) or 0.0),
             tie_word_embeddings=bool(getattr(hc, "tie_word_embeddings",
                                              False)))
-    # fail at CONVERT time on unsupported rope_scaling (yarn checkpoints)
-    # rather than lazily at the first forward
-    from .llama import _scale_inv_freq
+    # fail at CONVERT time on unsupported/malformed rope_scaling rather
+    # than lazily at the first forward (yarn parameter errors included)
+    from .llama import validate_rope_scaling
 
-    _scale_inv_freq(jnp.ones((2,), jnp.float32), config.rope_scaling)
+    validate_rope_scaling(config.rope_scaling,
+                          max_position=config.max_position_embeddings)
     model = DeepseekV2ForCausalLM(config)
     H, dn, dr = (config.num_attention_heads, config.qk_nope_head_dim,
                  config.qk_rope_head_dim)

@@ -97,7 +97,88 @@ class LlamaConfig:
         return LlamaConfig(**base)
 
 
-SUPPORTED_ROPE_SCALING = ("llama3", "linear")
+SUPPORTED_ROPE_SCALING = ("llama3", "linear", "yarn")
+
+
+def _rope_type(scaling: Optional[dict]) -> str:
+    if not scaling:
+        return "default"
+    return scaling.get("rope_type", scaling.get("type", "default")) or "default"
+
+
+def validate_rope_scaling(scaling: Optional[dict],
+                          max_position: Optional[int] = None) -> None:
+    """Checkpoint-loader gate: raise at CONVERT time both for rope_scaling
+    TYPES this build can't reproduce (NotImplementedError) and for
+    malformed configs of supported types (yarn parameter errors surface
+    here instead of lazily at the first forward)."""
+    rope_type = _rope_type(scaling)
+    if rope_type in ("default", "none"):
+        return
+    if rope_type not in SUPPORTED_ROPE_SCALING:
+        raise NotImplementedError(
+            f"rope_scaling type {rope_type!r} is not implemented "
+            f"(supported: {', '.join(sorted(SUPPORTED_ROPE_SCALING))})")
+    if rope_type == "yarn":
+        # dummy dims: only the parameter handling can raise
+        _yarn_params(scaling, 64, 10000.0, fallback_orig=max_position)
+
+
+def _yarn_get_mscale(scale: float, m: float = 1.0) -> float:
+    """yarn magnitude term (0.1·m·ln(s)+1) — shared by the table factor
+    and the DeepSeek softmax mscale."""
+    if scale <= 1:
+        return 1.0
+    return 0.1 * m * math.log(scale) + 1.0
+
+
+def _yarn_params(scaling: dict, dim: int, base: float,
+                 fallback_orig: Optional[int] = None):
+    """(inv_freq [dim//2], attention_factor) per transformers
+    modeling_rope_utils._compute_yarn_parameters — NTK-by-parts blended
+    interpolation/extrapolation frequencies, and the magnitude factor the
+    cos/sin tables are multiplied by (the DeepSeek mscale/mscale_all_dim
+    variant included). ``fallback_orig``: transformers anchors the
+    correction range to max_position_embeddings when the checkpoint omits
+    original_max_position_embeddings."""
+    factor = float(scaling["factor"])
+    orig = (scaling.get("original_max_position_embeddings")
+            or fallback_orig)
+    if not orig:
+        raise ValueError(
+            "yarn rope_scaling needs original_max_position_embeddings "
+            "(or a max_position fallback) to anchor the correction range")
+    orig = float(orig)
+
+    att = scaling.get("attention_factor")
+    if att is None:
+        mscale = scaling.get("mscale")
+        mscale_all_dim = scaling.get("mscale_all_dim")
+        if mscale and mscale_all_dim:
+            att = float(_yarn_get_mscale(factor, float(mscale))
+                        / _yarn_get_mscale(factor, float(mscale_all_dim)))
+        else:
+            att = _yarn_get_mscale(factor)
+    beta_fast = float(scaling.get("beta_fast") or 32)
+    beta_slow = float(scaling.get("beta_slow") or 1)
+
+    def corr_dim(rot):
+        return (dim * math.log(orig / (rot * 2 * math.pi))
+                / (2 * math.log(base)))
+
+    low, high = corr_dim(beta_fast), corr_dim(beta_slow)
+    if scaling.get("truncate", True):
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, dim - 1)
+    if low == high:
+        high += 0.001  # prevent singularity
+    ramp = jnp.clip(
+        (jnp.arange(dim // 2, dtype=jnp.float32) - low) / (high - low), 0, 1)
+    extrap = 1.0 - ramp                     # 1 = keep base freq (short wl)
+    pos_freqs = base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+    inv_freq = ((1.0 / (factor * pos_freqs)) * (1.0 - extrap)
+                + (1.0 / pos_freqs) * extrap)
+    return inv_freq, float(att)
 
 
 def _scale_inv_freq(inv_freq, scaling: Optional[dict]):
@@ -107,16 +188,22 @@ def _scale_inv_freq(inv_freq, scaling: Optional[dict]):
     wavelengths beyond the original context are divided by ``factor``,
     short wavelengths kept, the band between smoothly interpolated.
     "linear": classic position interpolation (all frequencies / factor).
+    "yarn" depends on head_dim/theta and a cos/sin magnitude factor, so it
+    is computed in _rope_tables (_yarn_params), not here.
     """
     if not scaling:
         return inv_freq
-    rope_type = scaling.get("rope_type", scaling.get("type", "default"))
-    if rope_type in ("default", "none", None):
+    rope_type = _rope_type(scaling)
+    if rope_type in ("default", "none"):
         return inv_freq
     if rope_type not in SUPPORTED_ROPE_SCALING:
         raise NotImplementedError(
             f"rope_scaling type {rope_type!r} is not implemented "
             f"(supported: {', '.join(sorted(SUPPORTED_ROPE_SCALING))})")
+    if rope_type == "yarn":
+        raise ValueError(
+            "yarn frequencies depend on head_dim/theta — build tables "
+            "through _rope_tables(scaling=...)")
     factor = float(scaling["factor"])
     if rope_type == "linear":
         return inv_freq / factor
@@ -135,12 +222,22 @@ def _scale_inv_freq(inv_freq, scaling: Optional[dict]):
     raise AssertionError(rope_type)  # unreachable: gated above
 
 
-def _rope_tables(seq_len, head_dim, theta, dtype=jnp.float32, scaling=None):
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    inv_freq = _scale_inv_freq(inv_freq, scaling)
+def _rope_tables(seq_len, head_dim, theta, dtype=jnp.float32, scaling=None,
+                 max_position=None):
+    att = 1.0
+    if _rope_type(scaling) == "yarn":
+        inv_freq, att = _yarn_params(scaling, head_dim, theta,
+                                     fallback_orig=max_position)
+    else:
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+        inv_freq = _scale_inv_freq(inv_freq, scaling)
     t = jnp.arange(seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)  # [S, D/2]
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, D]
+    if att != 1.0:
+        # yarn magnitude: cos/sin scaled by the attention factor (HF
+        # convention — q·k through the tables picks up att²)
+        return jnp.cos(emb) * att, jnp.sin(emb) * att
     return jnp.cos(emb), jnp.sin(emb)
 
 
@@ -419,7 +516,8 @@ class LlamaModel(Layer):
             return self._rope_cache[seq_len]
         cos, sin = _rope_tables(seq_len, self._rope_dim(),
                                 self.config.rope_theta,
-                                scaling=self.config.rope_scaling)
+                                scaling=self.config.rope_scaling,
+                                max_position=self.config.max_position_embeddings)
         pair = (wrap(cos), wrap(sin))
         # memoize only outside traces (a traced constant must not escape)
         try:
@@ -605,7 +703,8 @@ class LlamaDecoderLayerPipe(Layer):
     def forward(self, hidden):
         cfg = self.config
         cos, sin = _rope_tables(hidden.shape[1], self._rope_dim(),
-                                cfg.rope_theta, scaling=cfg.rope_scaling)
+                                cfg.rope_theta, scaling=cfg.rope_scaling,
+                                max_position=cfg.max_position_embeddings)
         return self.layer(hidden, wrap(cos), wrap(sin))
 
 
@@ -718,14 +817,9 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
            else lambda k, d=None: getattr(hf_config, k, d))
     scaling = get("rope_scaling")
     if scaling not in (None, {}):
-        rope_type = scaling.get("rope_type", scaling.get("type"))
-        if rope_type not in SUPPORTED_ROPE_SCALING + ("default",):
-            raise NotImplementedError(
-                f"hf_config_to_llama: rope_scaling type {rope_type!r} is "
-                f"not implemented (supported: "
-                f"{', '.join(SUPPORTED_ROPE_SCALING)}) — loading would "
-                "silently compute different logits than the checkpoint's "
-                "reference")
+        # type + parameter gate at CONVERT time (yarn math errors included)
+        validate_rope_scaling(dict(scaling),
+                              max_position=get("max_position_embeddings"))
     # HF Llama's attention_bias puts bias on q/k/v AND o; this build only
     # represents q/k/v bias (the Qwen2 layout) — map the Qwen2-style flag,
     # refuse a checkpoint that would carry an o_proj bias

@@ -470,10 +470,9 @@ class ContinuousBatchEngine:
         return _memoized_step(self.model, "_latent_scatter_fns", (bucket,),
                               build)
 
-    def _prefill_into_latent(self, slot: int, req: _Request):
-        """Latent-mode admission: bucketed prefill of one prompt (latent
-        caches come back [1, bucket, ...]), scattered into the slot's row
-        of each layer's compressed buffers."""
+    def _bucketed_prefill(self, req: _Request):
+        """Shared admission prefill: one prompt through the bucketed jitted
+        prefill step. Returns (last_logits [1,V], per-layer caches, S0)."""
         S0 = int(req.ids.size)
         bucket = self._bucket(S0)
         ids = np.zeros((1, bucket), np.int32)
@@ -485,6 +484,14 @@ class ContinuousBatchEngine:
             pad_mask = jnp.zeros((1, bucket), bool).at[0, :S0].set(True)
         last, caches = prefill(jnp.asarray(ids),
                                jnp.asarray([S0], jnp.int32), pad_mask)
+        return last, caches, S0
+
+    def _prefill_into_latent(self, slot: int, req: _Request):
+        """Latent-mode admission: bucketed prefill of one prompt (latent
+        caches come back [1, bucket, ...]), scattered into the slot's row
+        of each layer's compressed buffers."""
+        last, caches, S0 = self._bucketed_prefill(req)
+        bucket = self._bucket(S0)
         bufs = [(c["c_kv"], c["k_pe"]) for c in self._caches]
         try:
             new_bufs = self._latent_scatter_fn(bucket)(
@@ -510,17 +517,8 @@ class ContinuousBatchEngine:
             src, n_pref = self._find_shared_prefix(req)
             if n_pref > 0:
                 return self._prefill_with_prefix(slot, req, src, n_pref)
-        S0 = int(req.ids.size)
+        last, caches, S0 = self._bucketed_prefill(req)
         bucket = self._bucket(S0)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :S0] = req.ids
-        ragged = S0 != bucket
-        prefill = _get_prefill_step(self.model, bucket, ragged)
-        lengths = jnp.asarray([S0], jnp.int32)
-        pad_mask = None
-        if ragged:
-            pad_mask = jnp.zeros((1, bucket), bool).at[0, :S0].set(True)
-        last, caches = prefill(jnp.asarray(ids), lengths, pad_mask)
 
         base = slot * self._pages_per_slot
         pages = [(c["k_pages"], c["v_pages"]) for c in self._caches]

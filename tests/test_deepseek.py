@@ -283,16 +283,47 @@ def _np_rms(x, w, eps=1e-6):
     return (x / np.sqrt(v + eps) * w).astype(np.float64)
 
 
-def _hf_rope(x, theta=10000.0):
+def _np_mscale(scale, m=1.0):
+    return 1.0 if scale <= 1 else 0.1 * m * np.log(scale) + 1.0
+
+
+def _np_yarn(dim, base, scaling):
+    """modeling_deepseek DeepseekV2YarnRotaryEmbedding in numpy:
+    (inv_freq, cos/sin magnitude factor)."""
+    factor = scaling["factor"]
+    orig = scaling["original_max_position_embeddings"]
+
+    def corr(rot):
+        return dim * np.log(orig / (rot * 2 * np.pi)) / (2 * np.log(base))
+
+    low = max(np.floor(corr(scaling.get("beta_fast", 32))), 0)
+    high = min(np.ceil(corr(scaling.get("beta_slow", 1))), dim - 1)
+    if low == high:
+        high += 0.001
+    ramp = np.clip((np.arange(dim // 2) - low) / (high - low), 0, 1)
+    extrap = 1.0 - ramp
+    pf = base ** (np.arange(0, dim, 2) / dim)
+    inv = (1.0 / (factor * pf)) * (1 - extrap) + (1.0 / pf) * extrap
+    mscale = (_np_mscale(factor, scaling.get("mscale", 1.0))
+              / _np_mscale(factor, scaling.get("mscale_all_dim", 1.0)))
+    return inv, mscale
+
+
+def _hf_rope(x, theta=10000.0, scaling=None):
     """x [B,S,H,dr] straight from the (interleaved) checkpoint: HF first
-    de-interleaves (evens then odds), then applies rotate_half RoPE."""
+    de-interleaves (evens then odds), then applies rotate_half RoPE
+    (yarn-scaled frequencies + magnitude when ``scaling`` is set)."""
     b, s, h, d = x.shape
     x = x.reshape(b, s, h, d // 2, 2).transpose(0, 1, 2, 4, 3).reshape(
         b, s, h, d)
-    inv = 1.0 / theta ** (np.arange(0, d, 2) / d)
+    if scaling is not None:
+        inv, att = _np_yarn(d, theta, scaling)
+    else:
+        inv = 1.0 / theta ** (np.arange(0, d, 2) / d)
+        att = 1.0
     f = np.outer(np.arange(s), inv)
-    cos = np.concatenate([np.cos(f), np.cos(f)], -1)[None, :, None, :]
-    sin = np.concatenate([np.sin(f), np.sin(f)], -1)[None, :, None, :]
+    cos = att * np.concatenate([np.cos(f), np.cos(f)], -1)[None, :, None, :]
+    sin = att * np.concatenate([np.sin(f), np.sin(f)], -1)[None, :, None, :]
     rot = np.concatenate([-x[..., d // 2:], x[..., : d // 2]], -1)
     return x * cos + rot * sin
 
@@ -316,8 +347,9 @@ def _hf_reference_logits(sd, cfg, ids):
         q_nope, q_pe = q[..., :dn], q[..., dn:]
         kv_a = x @ sd[f"{p}.self_attn.kv_a_proj_with_mqa.weight"].T
         c_kv, k_pe = kv_a[..., :r], kv_a[..., r:]
-        q_pe = _hf_rope(q_pe)
-        k_pe = _hf_rope(k_pe[:, :, None, :])
+        scaling = cfg.get("rope_scaling")
+        q_pe = _hf_rope(q_pe, scaling=scaling)
+        k_pe = _hf_rope(k_pe[:, :, None, :], scaling=scaling)
         c_kv = _np_rms(c_kv, sd[f"{p}.self_attn.kv_a_layernorm.weight"])
         kv = (c_kv @ sd[f"{p}.self_attn.kv_b_proj.weight"].T).reshape(
             B, S, H, dn + dv)
@@ -325,7 +357,12 @@ def _hf_reference_logits(sd, cfg, ids):
             [kv[..., :dn], np.broadcast_to(k_pe, (B, S, H, dr))], -1)
         v = kv[..., dn:]
         qf = np.concatenate([q_nope, q_pe], -1)
-        scores = np.einsum("bshd,bthd->bhst", qf, k) / np.sqrt(dn + dr)
+        sm_scale = 1.0 / np.sqrt(dn + dr)
+        if scaling is not None:
+            # modeling_deepseek: softmax_scale *= mscale(all_dim)^2
+            sm_scale *= _np_mscale(scaling["factor"],
+                                   scaling.get("mscale_all_dim", 0.0)) ** 2
+        scores = np.einsum("bshd,bthd->bhst", qf, k) * sm_scale
         mask = np.tril(np.ones((S, S), bool))
         scores = np.where(mask[None, None], scores, -np.inf)
         w = np.exp(scores - scores.max(-1, keepdims=True))
@@ -352,8 +389,17 @@ class _FakeHF:
         return dict(self._sd)
 
 
-@pytest.mark.parametrize("q_lora", [None, 24], ids=["fullq", "qlora"])
-def test_from_hf_matches_numpy_reference(q_lora):
+@pytest.mark.parametrize("q_lora,rope_scaling", [
+    (None, None),
+    (24, None),
+    # DeepSeek-V2 ships yarn: distinct mscale / mscale_all_dim exercise
+    # BOTH the cos/sin magnitude factor and the softmax-scale mscale^2
+    (None, {"type": "yarn", "factor": 2.0,
+            "original_max_position_embeddings": 32,
+            "beta_fast": 32, "beta_slow": 1,
+            "mscale": 1.0, "mscale_all_dim": 0.4}),
+], ids=["fullq", "qlora", "yarn"])
+def test_from_hf_matches_numpy_reference(q_lora, rope_scaling):
     import types
 
     rng = np.random.RandomState(11)
@@ -389,13 +435,13 @@ def test_from_hf_matches_numpy_reference(q_lora):
         max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=10000.0,
         q_lora_rank=q_lora, kv_lora_rank=r, qk_nope_head_dim=dn,
         qk_rope_head_dim=dr, v_head_dim=dv, n_routed_experts=None,
-        tie_word_embeddings=False)
+        rope_scaling=rope_scaling, tie_word_embeddings=False)
     model = deepseek_from_hf(_FakeHF(sd, hf_cfg))
     ids = rng.randint(0, V, (2, 10))
     got = np.asarray(model(pd.to_tensor(ids))._array)
     ref = _hf_reference_logits(
         sd, dict(H=H, dn=dn, dr=dr, dv=dv, r=r, L=L,
-                 q_lora=bool(q_lora)), ids)
+                 q_lora=bool(q_lora), rope_scaling=rope_scaling), ids)
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
     # converted model decodes through the latent cache
     out = model.generate(pd.to_tensor(ids), max_new_tokens=4)

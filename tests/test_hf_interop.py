@@ -78,6 +78,8 @@ def test_shape_mismatch_rejected(hf_pair):
     {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
      "high_freq_factor": 4.0, "original_max_position_embeddings": 64},
     {"rope_type": "linear", "factor": 4.0},
+    {"rope_type": "yarn", "factor": 4.0,
+     "original_max_position_embeddings": 64},
 ])
 def test_rope_scaling_matches_transformers(rs):
     """Llama-3.1-style (llama3) and position-interpolation (linear)
@@ -110,10 +112,10 @@ def test_rope_scaling_matches_transformers(rs):
 def test_unsupported_rope_scaling_rejected():
     from paddle_tpu.models.llama import hf_config_to_llama
 
-    with pytest.raises(NotImplementedError, match="yarn"):
+    with pytest.raises(NotImplementedError, match="longrope"):
         hf_config_to_llama({"vocab_size": 64, "hidden_size": 64,
                             "intermediate_size": 128, "num_hidden_layers": 1,
                             "num_attention_heads": 2,
                             "max_position_embeddings": 64,
-                            "rope_scaling": {"rope_type": "yarn",
+                            "rope_scaling": {"rope_type": "longrope",
                                              "factor": 4.0}})
