@@ -66,6 +66,13 @@ class DeepseekV2Config(LlamaMoEConfig):
     qk_nope_head_dim: int = 128
     qk_rope_head_dim: int = 64
     v_head_dim: int = 128
+    # DeepSeek-V3 multi-token prediction: D extra sequential modules, each
+    # predicting token t+1+k from [RMSNorm(h_prev) ‖ RMSNorm(emb(t+k))]
+    # through a fusion projection + one decoder block, sharing the main
+    # embedding and lm head (arXiv:2412.19437 §2.2). Training-objective
+    # only: forward(labels=...) adds mtp_loss_lambda x the mean MTP CE.
+    num_nextn_predict_layers: int = 0
+    mtp_loss_lambda: float = 0.3
 
     @staticmethod
     def tiny_mla(**kw):
@@ -503,11 +510,102 @@ class DeepseekV2Model(LlamaModel):
                 "k_pe": jnp.zeros((batch, max_len, dr), dtype)}
 
 
+class DeepseekMTPLayer(Layer):
+    """One DeepSeek-V3 multi-token-prediction depth (arXiv:2412.19437
+    §2.2): fuse ``[RMSNorm(h_prev) ‖ RMSNorm(emb(t_shifted))]`` through a
+    2h→h projection, then one full (MLA + MoE/dense) decoder block. The
+    main model's embedding and lm head are SHARED — this module owns only
+    the two input norms, the fusion projection, the block, and the
+    pre-head norm. RoPE inside the block uses 0-based tables for the
+    shifted window — exact, since RoPE attention is relative."""
+
+    def __init__(self, config: DeepseekV2Config, layer_idx: int):
+        super().__init__(dtype=config.dtype)
+        from ..framework.dtype import dtype_guard
+
+        self.hnorm = LlamaRMSNorm(config)
+        self.enorm = LlamaRMSNorm(config)
+        with dtype_guard(config.dtype):
+            self.eh_proj = nn.Linear(2 * config.hidden_size,
+                                     config.hidden_size, bias_attr=False)
+        self.block = DeepseekV2DecoderLayer(config, layer_idx)
+        self.norm = LlamaRMSNorm(config)
+
+    def forward(self, h_prev, emb_next, cos, sin):
+        x = apply("mtp_fuse",
+                  lambda a, b: jnp.concatenate([a, b], axis=-1),
+                  self.hnorm(h_prev), self.enorm(emb_next))
+        return self.block(self.eh_proj(x), cos, sin)
+
+
 class DeepseekV2ForCausalLM(LlamaMoEForCausalLM):
     """DeepSeek-V2/V3 causal LM: MLA + MoE, latent-cache generate(); the
-    aux-loss plumbing (router_aux_loss_coef) comes from the MoE base."""
+    aux-loss plumbing (router_aux_loss_coef) comes from the MoE base.
+
+    ``num_nextn_predict_layers = D > 0`` adds the V3 multi-token-prediction
+    chain: depth k predicts token t+1+k through its own fused block over
+    the previous depth's hidden, sharing the embedding/head; training loss
+    becomes ``L_main + mtp_loss_lambda · mean_k(L_k)``. Inference paths
+    (generate/serving/speculative) ignore the MTP modules entirely."""
 
     model_cls = DeepseekV2Model
+
+    def __init__(self, config: DeepseekV2Config):
+        super().__init__(config)
+        D = int(config.num_nextn_predict_layers)
+        self.mtp_layers = (nn.LayerList(
+            [DeepseekMTPLayer(config, config.num_hidden_layers + k)
+             for k in range(D)]) if D else None)
+
+    def forward(self, input_ids, labels=None, attention_mask=None):
+        D = int(self.config.num_nextn_predict_layers)
+        if labels is None or not D:
+            return super().forward(input_ids, labels=labels,
+                                   attention_mask=attention_mask)
+        if self.config.fuse_linear_cross_entropy:
+            raise NotImplementedError(
+                "multi-token prediction computes explicit logits per "
+                "depth; unset fuse_linear_cross_entropy to train with "
+                "num_nextn_predict_layers > 0")
+        from .llama import causal_lm_loss
+
+        S = input_ids.shape[1]
+        if D >= S:
+            raise ValueError(
+                f"num_nextn_predict_layers {D} needs sequences longer "
+                f"than {D} tokens, got {S}")
+        normed, pre = self.llama(input_ids, attention_mask,
+                                 return_prenorm=True)
+        loss = causal_lm_loss(self.lm_head_logits(normed), labels)
+        # MTP chain: depth k (1-based) pairs the PRE-norm h_{k-1}[:, i]
+        # with emb(t_{i+k}) and targets labels[:, i+k] (= t_{i+k+1})
+        h_prev = pre
+        mtp_total = None
+        for k, layer in enumerate(self.mtp_layers, start=1):
+            L_k = S - k
+            emb_next = self.llama.embed_tokens(input_ids[:, k:]).astype(
+                self.config.dtype)
+            cos, sin = self.llama._rope(L_k)
+            h_prev = layer(h_prev[:, :L_k], emb_next, cos, sin)
+            logits_k = self.lm_head_logits(layer.norm(h_prev))
+            l_k = causal_lm_loss(logits_k, labels[:, k:])
+            mtp_total = l_k if mtp_total is None else mtp_total + l_k
+        loss = loss + self.config.mtp_loss_lambda * (mtp_total / D)
+        # router aux AFTER the chain so the MTP blocks' MoE routers get
+        # load-balancing gradient too (mean over every MoE layer that ran)
+        aux_terms = [l.mlp._aux_loss for l in self.llama.layers
+                     if getattr(l, "is_moe", False)
+                     and l.mlp._aux_loss is not None]
+        aux_terms += [layer.block.mlp._aux_loss for layer in self.mtp_layers
+                      if layer.block.is_moe
+                      and layer.block.mlp._aux_loss is not None]
+        if aux_terms:
+            total = aux_terms[0]
+            for t in aux_terms[1:]:
+                total = total + t
+            loss = loss + (self.config.router_aux_loss_coef
+                           * (total / len(aux_terms)))
+        return loss, None
 
 
 # ---------------------------------------------------------------------------
@@ -555,6 +653,11 @@ class DeepseekForCausalLMPipe(LlamaForCausalLMPipe):
                 "the pipeline loss cannot carry the cross-stage router aux "
                 "term; use aux-free balancing (moe_correction_bias) or "
                 "router_aux_loss_coef=0")
+        if config.num_nextn_predict_layers:
+            raise NotImplementedError(
+                "multi-token prediction is a monolithic-model training "
+                "objective; set num_nextn_predict_layers=0 for the "
+                "pipeline layout")
 
 
 def deepseek_from_hf(hf_model, config=None):

@@ -100,10 +100,13 @@ class LlamaConfig:
 SUPPORTED_ROPE_SCALING = ("llama3", "linear", "yarn")
 
 
-def _rope_type(scaling: Optional[dict]) -> str:
+def _rope_type(scaling: Optional[dict]):
+    """None/{} → "default"; a non-empty dict WITHOUT a type key returns
+    None so downstream gates refuse it (silently treating a typed-less
+    scaling dict as default would drop the checkpoint's scaling)."""
     if not scaling:
         return "default"
-    return scaling.get("rope_type", scaling.get("type", "default")) or "default"
+    return scaling.get("rope_type", scaling.get("type", None))
 
 
 def validate_rope_scaling(scaling: Optional[dict],
@@ -527,13 +530,17 @@ class LlamaModel(Layer):
             pass
         return pair
 
-    def forward(self, input_ids, attention_mask=None):
+    def forward(self, input_ids, attention_mask=None, return_prenorm=False):
         s = input_ids.shape[1]
         cos, sin = self._rope(s)
         hidden = self.embed_tokens(input_ids)
         hidden = hidden.astype(self.config.dtype)
         for layer in self.layers:
             hidden = layer(hidden, cos, sin, attention_mask)
+        if return_prenorm:
+            # (normed, pre-norm) — the MTP chain consumes the pre-norm
+            # last-layer representation (arXiv:2412.19437 §2.2)
+            return self.norm(hidden), hidden
         return self.norm(hidden)
 
     def forward_cached(self, input_ids, kv_caches, rope_len):

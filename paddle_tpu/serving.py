@@ -472,7 +472,8 @@ class ContinuousBatchEngine:
 
     def _bucketed_prefill(self, req: _Request):
         """Shared admission prefill: one prompt through the bucketed jitted
-        prefill step. Returns (last_logits [1,V], per-layer caches, S0)."""
+        prefill step. Returns (last_logits [1,V], per-layer caches, S0,
+        bucket)."""
         S0 = int(req.ids.size)
         bucket = self._bucket(S0)
         ids = np.zeros((1, bucket), np.int32)
@@ -484,14 +485,13 @@ class ContinuousBatchEngine:
             pad_mask = jnp.zeros((1, bucket), bool).at[0, :S0].set(True)
         last, caches = prefill(jnp.asarray(ids),
                                jnp.asarray([S0], jnp.int32), pad_mask)
-        return last, caches, S0
+        return last, caches, S0, bucket
 
     def _prefill_into_latent(self, slot: int, req: _Request):
         """Latent-mode admission: bucketed prefill of one prompt (latent
         caches come back [1, bucket, ...]), scattered into the slot's row
         of each layer's compressed buffers."""
-        last, caches, S0 = self._bucketed_prefill(req)
-        bucket = self._bucket(S0)
+        last, caches, S0, bucket = self._bucketed_prefill(req)
         bufs = [(c["c_kv"], c["k_pe"]) for c in self._caches]
         try:
             new_bufs = self._latent_scatter_fn(bucket)(
@@ -517,8 +517,7 @@ class ContinuousBatchEngine:
             src, n_pref = self._find_shared_prefix(req)
             if n_pref > 0:
                 return self._prefill_with_prefix(slot, req, src, n_pref)
-        last, caches, S0 = self._bucketed_prefill(req)
-        bucket = self._bucket(S0)
+        last, caches, S0, bucket = self._bucketed_prefill(req)
 
         base = slot * self._pages_per_slot
         pages = [(c["k_pages"], c["v_pages"]) for c in self._caches]

@@ -220,6 +220,66 @@ def test_mla_decode_kernel_matches_einsum(ragged):
         np.testing.assert_allclose(np.asarray(out_d)[1], 0.0, atol=0)
 
 
+class TestMTP:
+    """DeepSeek-V3 multi-token prediction (num_nextn_predict_layers)."""
+
+    def test_mtp_trains_and_changes_loss(self):
+        np.random.seed(41)
+        cfg = DeepseekV2Config.tiny_v3(num_nextn_predict_layers=2,
+                                       num_hidden_layers=2)
+        m = DeepseekV2ForCausalLM(cfg)
+        assert len(m.mtp_layers) == 2
+        # MTP blocks follow first_k_dense_replace: indices L..L+D are MoE
+        assert all(layer.block.is_moe for layer in m.mtp_layers)
+        ids = _ids(s=16, seed=7)
+        labels = np.concatenate([ids[:, 1:], -np.ones((2, 1), np.int64)], 1)
+        loss, logits = m(pd.to_tensor(ids), labels=pd.to_tensor(labels))
+        assert logits is None and np.isfinite(float(loss))
+        loss.backward()
+        for name, p in m.mtp_layers[0].named_parameters():
+            if p.grad is not None:
+                continue
+            raise AssertionError(f"no grad for mtp param {name}")
+        g = m.llama.embed_tokens.weight.grad   # shared embedding trains
+        assert g is not None
+
+        # the MTP term is a positive CE: lambda=0 strictly lowers the loss
+        import dataclasses
+
+        m.config = dataclasses.replace(cfg, mtp_loss_lambda=0.0)
+        loss0, _ = m(pd.to_tensor(ids), labels=pd.to_tensor(labels))
+        assert float(loss0) < float(loss)
+
+    def test_mtp_ignored_at_inference(self):
+        np.random.seed(43)
+        cfg = DeepseekV2Config.tiny_mla(num_nextn_predict_layers=1,
+                                        num_hidden_layers=2)
+        m = DeepseekV2ForCausalLM(cfg)
+        out = m.generate(pd.to_tensor(_ids(s=8, seed=1)), max_new_tokens=4)
+        assert np.asarray(out._array).shape == (2, 4)
+
+    def test_mtp_rejects_short_sequences_and_fused_ce(self):
+        cfg = DeepseekV2Config.tiny_mla(num_nextn_predict_layers=3,
+                                        num_hidden_layers=1)
+        m = DeepseekV2ForCausalLM(cfg)
+        ids = _ids(s=3, seed=2)
+        with pytest.raises(ValueError, match="longer"):
+            m(pd.to_tensor(ids), labels=pd.to_tensor(ids))
+        import dataclasses
+
+        m.config = dataclasses.replace(cfg, fuse_linear_cross_entropy=True)
+        with pytest.raises(NotImplementedError, match="fuse"):
+            m(pd.to_tensor(_ids(s=8, seed=2)),
+              labels=pd.to_tensor(_ids(s=8, seed=2)))
+
+    def test_mtp_rejected_by_pipe(self):
+        from paddle_tpu.models.deepseek import DeepseekForCausalLMPipe
+
+        cfg = DeepseekV2Config.tiny_v3(num_nextn_predict_layers=1)
+        with pytest.raises(NotImplementedError, match="multi-token"):
+            DeepseekForCausalLMPipe(cfg, num_stages=1)
+
+
 def test_lora_on_mla():
     """LoRA composes with MLA: adapters on the MLA projections (q_proj /
     kv_b_proj / o_proj), identity at init, merge matches the adapter

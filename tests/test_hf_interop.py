@@ -119,3 +119,11 @@ def test_unsupported_rope_scaling_rejected():
                             "max_position_embeddings": 64,
                             "rope_scaling": {"rope_type": "longrope",
                                              "factor": 4.0}})
+    # a scaling dict WITHOUT a type key must refuse too — treating it as
+    # default would silently drop the checkpoint's scaling
+    with pytest.raises(NotImplementedError, match="None"):
+        hf_config_to_llama({"vocab_size": 64, "hidden_size": 64,
+                            "intermediate_size": 128, "num_hidden_layers": 1,
+                            "num_attention_heads": 2,
+                            "max_position_embeddings": 64,
+                            "rope_scaling": {"factor": 4.0}})
