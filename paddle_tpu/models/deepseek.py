@@ -578,33 +578,30 @@ class DeepseekV2ForCausalLM(LlamaMoEForCausalLM):
                                  return_prenorm=True)
         loss = causal_lm_loss(self.lm_head_logits(normed), labels)
         # MTP chain: depth k (1-based) pairs the PRE-norm h_{k-1}[:, i]
-        # with emb(t_{i+k}) and targets labels[:, i+k] (= t_{i+k+1})
+        # with emb(t_{i+k}) and targets labels[:, i+k] (= t_{i+k+1}).
+        # Like the trunk's training path, the blocks are causal-only —
+        # pad positions are excluded through the label ignore mask, not an
+        # attention mask. Embedding and RoPE tables are computed once at
+        # full length and sliced per depth.
+        emb_full = self.llama.embed_tokens(input_ids).astype(
+            self.config.dtype)
+        cos_full, sin_full = self.llama._rope(S)
         h_prev = pre
         mtp_total = None
         for k, layer in enumerate(self.mtp_layers, start=1):
             L_k = S - k
-            emb_next = self.llama.embed_tokens(input_ids[:, k:]).astype(
-                self.config.dtype)
-            cos, sin = self.llama._rope(L_k)
-            h_prev = layer(h_prev[:, :L_k], emb_next, cos, sin)
+            h_prev = layer(h_prev[:, :L_k], emb_full[:, k:],
+                           cos_full[:L_k], sin_full[:L_k])
             logits_k = self.lm_head_logits(layer.norm(h_prev))
             l_k = causal_lm_loss(logits_k, labels[:, k:])
             mtp_total = l_k if mtp_total is None else mtp_total + l_k
         loss = loss + self.config.mtp_loss_lambda * (mtp_total / D)
         # router aux AFTER the chain so the MTP blocks' MoE routers get
         # load-balancing gradient too (mean over every MoE layer that ran)
-        aux_terms = [l.mlp._aux_loss for l in self.llama.layers
-                     if getattr(l, "is_moe", False)
-                     and l.mlp._aux_loss is not None]
-        aux_terms += [layer.block.mlp._aux_loss for layer in self.mtp_layers
-                      if layer.block.is_moe
-                      and layer.block.mlp._aux_loss is not None]
-        if aux_terms:
-            total = aux_terms[0]
-            for t in aux_terms[1:]:
-                total = total + t
-            loss = loss + (self.config.router_aux_loss_coef
-                           * (total / len(aux_terms)))
+        aux = self.aux_loss(
+            extra_layers=[layer.block for layer in self.mtp_layers])
+        if aux is not None:
+            loss = loss + self.config.router_aux_loss_coef * aux
         return loss, None
 
 

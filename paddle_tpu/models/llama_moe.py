@@ -335,8 +335,11 @@ class LlamaMoEForCausalLM(LlamaForCausalLM):
                     (config.hidden_size, config.vocab_size), jnp.float32)
                 .astype(self.lm_head.weight.dtype))
 
-    def aux_loss(self):
-        losses = [l.mlp._aux_loss for l in self.llama.layers
+    def aux_loss(self, extra_layers=()):
+        """Mean router aux over every MoE layer that ran — the trunk's,
+        plus any ``extra_layers`` (the DeepSeek MTP depth blocks)."""
+        losses = [l.mlp._aux_loss
+                  for l in list(self.llama.layers) + list(extra_layers)
                   if getattr(l, "is_moe", False)
                   and l.mlp._aux_loss is not None]
         if not losses:

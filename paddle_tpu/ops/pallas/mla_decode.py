@@ -115,8 +115,9 @@ def _kernel(pos_ref, qlat_ref, qpe_ref, ckv_ref, kpe_ref, allowed_ref,
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _decode_jit(q_lat, q_pe, ckv_buf, kpe_buf, pos, allowed, interpret):
+@functools.partial(jax.jit, static_argnames=("interpret", "bkv"))
+def _decode_jit(q_lat, q_pe, ckv_buf, kpe_buf, pos, allowed, interpret,
+                bkv=None):
     B, H, r = q_lat.shape
     T = ckv_buf.shape[1]
     dr = q_pe.shape[-1]
@@ -129,7 +130,8 @@ def _decode_jit(q_lat, q_pe, ckv_buf, kpe_buf, pos, allowed, interpret):
         # TPU so the hot decode loop never pays this)
         kpe_buf = jnp.pad(
             kpe_buf, ((0, 0), (0, 0), (0, dp - kpe_buf.shape[-1])))
-    bkv = next(b for b in (512, 256, 128) if T % b == 0)
+    if bkv is None:
+        bkv = next(b for b in (512, 256, 128) if T % b == 0)
     have_allowed = allowed is not None
     if not have_allowed:
         allowed = jnp.ones((B, T), jnp.int8)
@@ -169,5 +171,24 @@ def mla_decode_attention(q_lat, q_pe, ckv_buf, kpe_buf, pos, allowed=None,
     at different lengths), allowed optional [B,T] column mask.
     Returns the latent-space context [B,H,r] — same math as the absorbed
     einsum branch of models.deepseek.mla_cached_attention at S=1."""
+    T = ckv_buf.shape[1]
+    bkv = next(b for b in (512, 256, 128) if T % b == 0)
+    if not interpret:
+        # FLAGS_use_autotune: eager TPU calls measure the T-block grid
+        # once per (shape, dtype, device) and persist the winner; traced
+        # calls (scan decode / engine step) read the cache only
+        from . import autotune
+
+        key = (f"B{q_lat.shape[0]}xH{q_lat.shape[1]}xr{q_lat.shape[2]}"
+               f"xT{T} {ckv_buf.dtype}")
+        cands = [(b,) for b in (1024, 512, 256, 128) if T % b == 0]
+        can = _on_tpu() and autotune.is_concrete(q_lat, ckv_buf, pos)
+
+        def runner(cfg):
+            return lambda: _decode_jit(q_lat, q_pe, ckv_buf, kpe_buf, pos,
+                                       allowed, interpret, bkv=cfg[0])
+
+        (bkv,) = autotune.pick("mla_decode", key, (bkv,), cands, runner,
+                               can)
     return _decode_jit(q_lat, q_pe, ckv_buf, kpe_buf, pos, allowed,
-                       interpret)
+                       interpret, bkv=bkv)
