@@ -531,11 +531,16 @@ class DeepseekMTPLayer(Layer):
         self.block = DeepseekV2DecoderLayer(config, layer_idx)
         self.norm = LlamaRMSNorm(config)
 
-    def forward(self, h_prev, emb_next, cos, sin):
+    def fuse(self, h_prev, emb_next):
+        """[RMSNorm(h_prev) ‖ RMSNorm(emb_next)] → 2h→h projection — the
+        block input, shared by training and the speculative draft path."""
         x = apply("mtp_fuse",
                   lambda a, b: jnp.concatenate([a, b], axis=-1),
                   self.hnorm(h_prev), self.enorm(emb_next))
-        return self.block(self.eh_proj(x), cos, sin)
+        return self.eh_proj(x)
+
+    def forward(self, h_prev, emb_next, cos, sin):
+        return self.block(self.fuse(h_prev, emb_next), cos, sin)
 
 
 class DeepseekV2ForCausalLM(LlamaMoEForCausalLM):

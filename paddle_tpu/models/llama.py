@@ -543,9 +543,12 @@ class LlamaModel(Layer):
             return self.norm(hidden), hidden
         return self.norm(hidden)
 
-    def forward_cached(self, input_ids, kv_caches, rope_len):
+    def forward_cached(self, input_ids, kv_caches, rope_len,
+                       return_prenorm=False):
         """Decode-path forward over static KV caches (one dict per layer,
-        see generation.cached_attention). Returns (hidden, new_caches)."""
+        see generation.cached_attention). Returns (hidden, new_caches) —
+        or (normed, prenorm, new_caches) with ``return_prenorm`` (the MTP
+        speculative draft consumes the pre-norm stream)."""
         cos, sin = self._rope(rope_len)
         hidden = self.embed_tokens(input_ids)
         hidden = hidden.astype(self.config.dtype)
@@ -554,6 +557,8 @@ class LlamaModel(Layer):
             inner = getattr(layer, "inner", layer)  # unwrap RecomputeLayer
             hidden, c = inner(hidden, cos, sin, kv_cache=cache)
             new_caches.append(c)
+        if return_prenorm:
+            return self.norm(hidden), hidden, new_caches
         return self.norm(hidden), new_caches
 
 

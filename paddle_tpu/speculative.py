@@ -118,6 +118,31 @@ def _prefill(model, ids, max_len):
     return jnp.argmax(last, axis=-1).astype(jnp.int32), caches
 
 
+def _normalize_request(input_ids):
+    """Shared batch-1 request normalization: returns (ids [1,P] np,
+    out_dtype); raises on batched input (the dense cache keeps one scalar
+    write position)."""
+    ids = np.asarray(unwrap(input_ids) if hasattr(input_ids, "shape")
+                     else input_ids)
+    out_dtype = ids.dtype
+    if ids.ndim == 1:
+        ids = ids[None]
+    if ids.shape[0] != 1:
+        raise ValueError(
+            "speculative decoding is per-request (batch 1); run rows "
+            "separately or use model.generate for batched decode")
+    return ids, out_dtype
+
+
+def _finish(emitted, max_new_tokens, eos_token_id, out_dtype):
+    """Shared emit epilogue: truncate to the budget, cut at eos, wrap in
+    the request dtype."""
+    emitted = emitted[:max_new_tokens]
+    if eos_token_id is not None and eos_token_id in emitted:
+        emitted = emitted[: emitted.index(eos_token_id) + 1]
+    return wrap(jnp.asarray(np.asarray(emitted, out_dtype)[None]))
+
+
 def speculative_generate(target, draft, input_ids, max_new_tokens=20,
                          draft_k=4, eos_token_id=None):
     """Greedy speculative decode of ``input_ids`` [1, P] → [1, P + new].
@@ -126,15 +151,7 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=20,
     write position, and rows accepting different prefix lengths would need
     per-row rollback. Output is exactly ``target.generate`` greedy.
     """
-    ids = np.asarray(unwrap(input_ids) if hasattr(input_ids, "shape")
-                     else input_ids)
-    out_dtype = ids.dtype
-    if ids.ndim == 1:
-        ids = ids[None]
-    if ids.shape[0] != 1:
-        raise ValueError(
-            "speculative_generate is per-request (batch 1); run rows "
-            "separately or use model.generate for batched decode")
+    ids, out_dtype = _normalize_request(input_ids)
     B, P = ids.shape
     k = int(draft_k)
     if k < 1:
@@ -197,8 +214,98 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=20,
         if eos_token_id is not None and eos_token_id in accepted:
             break
 
-    emitted = emitted[:max_new_tokens]
-    if eos_token_id is not None and eos_token_id in emitted:
-        emitted = emitted[:emitted.index(eos_token_id) + 1]
     # same convention as model.generate: only the NEW tokens, input dtype
-    return wrap(jnp.asarray(np.asarray(emitted, out_dtype)[None]))
+    return _finish(emitted, max_new_tokens, eos_token_id, out_dtype)
+
+
+def mtp_speculative_generate(model, input_ids, max_new_tokens=20,
+                             eos_token_id=None):
+    """Self-speculative greedy decode for DeepSeek models trained with
+    multi-token prediction (``num_nextn_predict_layers >= 1``): the FIRST
+    MTP depth drafts one token per round from the main model's PRE-norm
+    hidden stream (the MTP block keeps its own latent cache over the
+    shifted sequence, exactly the pairing it was trained on), and a
+    2-token cached verify accepts or corrects (arXiv:2412.19437 §2.2
+    inference usage — the "free" extra token per forward).
+
+    Output is EXACTLY ``model.generate`` greedy — the draft only changes
+    how many tokens each main-model forward retires. Batch 1 (the dense
+    cache keeps one write position; see speculative_generate). This v1
+    drives the rounds as a host loop of EAGER cached forwards — the
+    correctness contract and stream bookkeeping live here; porting the
+    rounds onto speculative_generate's memoized jitted steps is the
+    performance follow-up and changes no semantics."""
+    from .generation import _empty_caches
+
+    mtp_layers = getattr(model, "mtp_layers", None)
+    if not mtp_layers:
+        raise ValueError(
+            "mtp_speculative_generate needs a model built with "
+            "num_nextn_predict_layers >= 1 (the MTP draft module)")
+    mtp = mtp_layers[0]
+    ids, out_dtype = _normalize_request(input_ids)
+    B, P = ids.shape
+    max_len = P + max_new_tokens + 3
+    if max_len > model.config.max_position_embeddings:
+        raise ValueError(
+            f"prompt+new(+3 speculation slack) = {max_len} exceeds "
+            f"max_position_embeddings "
+            f"{model.config.max_position_embeddings}")
+    ids_j = jnp.asarray(ids, jnp.int32)
+    dt = (jnp.dtype(model.config.dtype)
+          if isinstance(model.config.dtype, str) else model.config.dtype)
+
+    def emb(tokens_2d):
+        # .astype: same compute dtype the MTP block trained on
+        return model.llama.embed_tokens(
+            wrap(jnp.asarray(tokens_2d, jnp.int32))).astype(
+                model.config.dtype)
+
+    with _tape.no_grad():
+        cos, sin = model.llama._rope(max_len)
+        # main prefill (pre-norm stream kept for the MTP pairing)
+        caches = _empty_caches(model, 1, max_len)
+        normed, pre, caches = model.llama.forward_cached(
+            wrap(ids_j), caches, rope_len=max_len, return_prenorm=True)
+        t1 = int(jnp.argmax(
+            unwrap(model.lm_head_logits(normed[:, -1:]))[0, 0]))
+
+        # MTP stream cache: seed with pairs (h_i, t_{i+1}) for the prompt
+        mtp_cache = dict(model.llama.empty_cache_layer(1, max_len, dt),
+                         pos=0, prefill=True)
+        if P > 1:
+            x = mtp.fuse(pre[:, : P - 1], emb(ids[:, 1:]))
+            _, mtp_cache = mtp.block(x, cos, sin, kv_cache=mtp_cache)
+
+        emitted = [t1]
+        pending = t1               # exact, not yet written to the cache
+        h_tail = pre[:, -1:]       # pre-norm hidden(s) pairing the toks
+        toks = [t1]                # tokens pairing h_tail rows
+        while len(emitted) < max_new_tokens and (
+                eos_token_id is None or emitted[-1] != eos_token_id):
+            # 1. extend the MTP stream with the completed pairs, draft
+            x = mtp.fuse(h_tail, emb([toks]))
+            h_m, mtp_cache = mtp.block(x, cos, sin, kv_cache=mtp_cache)
+            draft = int(jnp.argmax(unwrap(
+                model.lm_head_logits(mtp.norm(h_m[:, -1:])))[0, 0]))
+            # 2. one 2-token verify forward retires up to 2 tokens
+            normed2, pre2, caches = model.llama.forward_cached(
+                wrap(jnp.asarray([[pending, draft]], jnp.int32)), caches,
+                rope_len=max_len, return_prenorm=True)
+            logits2 = unwrap(model.lm_head_logits(normed2))
+            g0 = int(jnp.argmax(logits2[0, 0]))
+            g1 = int(jnp.argmax(logits2[0, 1]))
+            if draft == g0:        # draft hit: two tokens from one forward
+                emitted.extend([draft, g1])
+                pending = g1
+                h_tail, toks = pre2, [draft, g1]
+            else:                  # miss: rewind the draft's cache entry
+                emitted.append(g0)
+                pending = g0
+                for c in caches:
+                    c["pos"] = c["pos"] - 1
+                h_tail, toks = pre2[:, :1], [g0]
+            if eos_token_id is not None and eos_token_id in emitted[-2:]:
+                break              # eos inside a hit pair stops the loop
+
+    return _finish(emitted, max_new_tokens, eos_token_id, out_dtype)

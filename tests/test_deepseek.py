@@ -272,6 +272,33 @@ class TestMTP:
             m(pd.to_tensor(_ids(s=8, seed=2)),
               labels=pd.to_tensor(_ids(s=8, seed=2)))
 
+    @pytest.mark.parametrize("seed", [0, 5])
+    def test_mtp_self_speculative_matches_greedy(self, seed):
+        """The MTP-draft speculative loop must emit exactly the main
+        model's greedy sequence — the draft only changes how many tokens
+        each verify forward retires (hit and miss paths both execute
+        across seeds)."""
+        from paddle_tpu.speculative import mtp_speculative_generate
+
+        np.random.seed(47)
+        cfg = DeepseekV2Config.tiny_mla(num_nextn_predict_layers=1,
+                                        num_hidden_layers=2)
+        m = DeepseekV2ForCausalLM(cfg)
+        ids = _ids(b=1, s=9, seed=seed)
+        ref = np.asarray(m.generate(pd.to_tensor(ids),
+                                    max_new_tokens=10)._array)
+        got = np.asarray(mtp_speculative_generate(
+            m, ids, max_new_tokens=10)._array)
+        np.testing.assert_array_equal(got, ref)
+
+    def test_mtp_speculative_needs_mtp(self):
+        from paddle_tpu.speculative import mtp_speculative_generate
+
+        m = DeepseekV2ForCausalLM(DeepseekV2Config.tiny_mla(
+            num_hidden_layers=1))
+        with pytest.raises(ValueError, match="num_nextn"):
+            mtp_speculative_generate(m, _ids(b=1, s=4), max_new_tokens=2)
+
     def test_mtp_rejected_by_pipe(self):
         from paddle_tpu.models.deepseek import DeepseekForCausalLMPipe
 
