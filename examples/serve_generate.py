@@ -46,6 +46,18 @@ def main():
     for rid in rids:
         print(f"request {rid}: {done[rid].tolist()}")
 
+    # DeepSeek MLA serves through the SAME engine in latent mode: the
+    # cache holds the compressed latent (kv_lora_rank + qk_rope_head_dim
+    # floats/token) per slot row instead of paged per-head K/V
+    from paddle_tpu.models import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    mla = DeepseekV2ForCausalLM(DeepseekV2Config.tiny_mla(
+        num_hidden_layers=2))
+    eng2 = ContinuousBatchEngine(mla, max_batch=2, max_len=64)
+    assert eng2._latent_mode
+    rid = eng2.add_request(rng.randint(0, 512, (7,)), max_new_tokens=6)
+    print("mla request:", eng2.run_until_done()[rid].tolist())
+
 
 if __name__ == "__main__":
     main()

@@ -89,10 +89,6 @@ class ContinuousBatchEngine:
         make = getattr(model.llama, "empty_cache_layer", None)
         self._latent_mode = make is not None
         if self._latent_mode:
-            if enable_prefix_cache:
-                raise NotImplementedError(
-                    "prefix caching is page-granular; the MLA latent "
-                    "cache serves without it")
             self._caches = [dict(make(max_batch, max_len, dt),
                                  lengths=self._lengths)
                             for _ in range(cfg.num_hidden_layers)]
@@ -413,11 +409,95 @@ class ContinuousBatchEngine:
             fn._state = None  # _memoized_step refresh hook (state is an arg)
             return fn
 
+        # max_len is part of the key: the traced forward_cached bakes a
+        # rope_len-row cos/sin table, so a second engine over the same
+        # model with a different max_len must NOT reuse this function
         return _memoized_step(self.model, "_suffix_prefill_fns",
-                              (n_pref, sb, ps), build, maxsize=16)
+                              (n_pref, sb, ps, self.max_len), build,
+                              maxsize=16)
 
     def _prefill_with_prefix(self, slot: int, req: _Request, src: int,
                              n_pref: int):
+        self._run_prefix_admission(
+            slot, req, src, n_pref, self._suffix_prefill_fn,
+            ("k_pages", "v_pages"), self._pages_per_slot, "page pool")
+
+    def _latent_suffix_prefill_fn(self, n_pref: int, sb: int):
+        """Jitted, buffer-DONATING prefix-cached admission for the latent
+        layout: gather the prefix latent ROWS from the source slot, run
+        the model over the suffix chunk at pos=prefix_len (absorbed-append
+        path), and write prefix+suffix rows into the destination slot —
+        token rows copy directly, no page tiling."""
+        from .autograd import tape as _tape2
+        from .nn.layer import functional_weights
+        from .tensor_class import wrap as _wrap
+
+        ps = self.page_size
+        pref_len = n_pref * ps
+        total = pref_len + sb
+        model = self.model
+        rope_len = self.max_len
+
+        def build():
+            def run(state, bufs, suffix_ids, suffix_len, src, dst):
+                with functional_weights(model, state), _tape2.no_grad():
+                    caches = []
+                    for ckv, kpe in bufs:
+                        r, dp = ckv.shape[-1], kpe.shape[-1]
+                        p_ckv = jax.lax.dynamic_slice(
+                            ckv, (src, 0, 0), (1, pref_len, r))
+                        p_kpe = jax.lax.dynamic_slice(
+                            kpe, (src, 0, 0), (1, pref_len, dp))
+                        ckv_t = jnp.zeros((1, total, r), ckv.dtype
+                                          ).at[:, :pref_len].set(p_ckv)
+                        kpe_t = jnp.zeros((1, total, dp), kpe.dtype
+                                          ).at[:, :pref_len].set(p_kpe)
+                        allowed = (jnp.arange(total)[None, :]
+                                   < pref_len + suffix_len)
+                        caches.append({
+                            "c_kv": ckv_t, "k_pe": kpe_t,
+                            "allowed": allowed,
+                            "pos": jnp.asarray(pref_len, jnp.int32)})
+                    hidden, caches = model.llama.forward_cached(
+                        _wrap(suffix_ids), caches, rope_len=rope_len)
+                    h_last = jnp.take_along_axis(
+                        unwrap(hidden),
+                        (suffix_len - 1).reshape(1, 1, 1).astype(jnp.int32),
+                        axis=1)
+                    last = unwrap(model.lm_head_logits(
+                        _wrap(h_last)))[:, 0, :]
+                    new_bufs = []
+                    for (ckv, kpe), c in zip(bufs, caches):
+                        ckv_t = (unwrap(c["c_kv"])
+                                 if isinstance(c["c_kv"], Tensor)
+                                 else c["c_kv"])
+                        kpe_t = (unwrap(c["k_pe"])
+                                 if isinstance(c["k_pe"], Tensor)
+                                 else c["k_pe"])
+                        new_bufs.append((
+                            jax.lax.dynamic_update_slice(
+                                ckv, ckv_t.astype(ckv.dtype), (dst, 0, 0)),
+                            jax.lax.dynamic_update_slice(
+                                kpe, kpe_t.astype(kpe.dtype), (dst, 0, 0)),
+                        ))
+                return last, new_bufs
+
+            fn = jax.jit(run, donate_argnums=(1,))
+            fn._state = None  # _memoized_step refresh hook (state is an arg)
+            return fn
+
+        # max_len in the key for the same rope_len-baking reason as
+        # _suffix_prefill_fn
+        return _memoized_step(self.model, "_latent_suffix_prefill_fns",
+                              (n_pref, sb, ps, self.max_len), build,
+                              maxsize=16)
+
+    def _run_prefix_admission(self, slot, req, src, n_pref, get_fn,
+                              buf_keys, idx_scale, poison_what):
+        """Shared prefix-cached admission wrapper (paged and latent modes
+        differ only in buffer keys, the jitted fn, and index scaling):
+        suffix bucketing, the donation-failure poisoning protocol, and
+        the slot bookkeeping live HERE once."""
         ps = self.page_size
         S0 = int(req.ids.size)
         pref_len = n_pref * ps
@@ -425,25 +505,32 @@ class ContinuousBatchEngine:
         sb = min(self._bucket(int(suf.size)), self.max_len - pref_len)
         ids = np.zeros((1, sb), np.int32)
         ids[0, :suf.size] = suf
-        fn = self._suffix_prefill_fn(n_pref, sb)
-        pages = [(c["k_pages"], c["v_pages"]) for c in self._caches]
+        fn = get_fn(n_pref, sb)
+        bufs = [tuple(c[k] for k in buf_keys) for c in self._caches]
         try:
-            last, new_pages = fn(
-                dict(self.model.functional_state()), pages,
+            last, new_bufs = fn(
+                dict(self.model.functional_state()), bufs,
                 jnp.asarray(ids), jnp.asarray(int(suf.size), jnp.int32),
-                jnp.asarray(src * self._pages_per_slot, jnp.int32),
-                jnp.asarray(slot * self._pages_per_slot, jnp.int32))
+                jnp.asarray(src * idx_scale, jnp.int32),
+                jnp.asarray(slot * idx_scale, jnp.int32))
         except Exception as e:
             self._poisoned = True
             raise RuntimeError(
-                "ContinuousBatchEngine: prefix-cached admission failed "
-                "after the page pool was donated; rebuild the engine and "
-                "resubmit in-flight requests") from e
-        for c_eng, (kp, vp) in zip(self._caches, new_pages):
-            c_eng["k_pages"], c_eng["v_pages"] = kp, vp
+                f"ContinuousBatchEngine: prefix-cached admission failed "
+                f"after the {poison_what} was donated; rebuild the engine "
+                f"and resubmit in-flight requests") from e
+        for c_eng, new in zip(self._caches, new_bufs):
+            for k, v in zip(buf_keys, new):
+                c_eng[k] = v
         self._last = self._last.at[slot].set(last[0].astype(jnp.float32))
         self._lengths = self._lengths.at[slot].set(S0)
         self.prefix_pages_reused += n_pref
+
+    def _prefill_with_prefix_latent(self, slot: int, req: _Request,
+                                    src: int, n_pref: int):
+        self._run_prefix_admission(
+            slot, req, src, n_pref, self._latent_suffix_prefill_fn,
+            ("c_kv", "k_pe"), 1, "latent buffer pool")
 
     def _latent_scatter_fn(self, bucket: int):
         """Jitted, buffer-DONATING scatter of one prefilled prompt's latent
@@ -490,7 +577,14 @@ class ContinuousBatchEngine:
     def _prefill_into_latent(self, slot: int, req: _Request):
         """Latent-mode admission: bucketed prefill of one prompt (latent
         caches come back [1, bucket, ...]), scattered into the slot's row
-        of each layer's compressed buffers."""
+        of each layer's compressed buffers. With prefix caching on, a
+        shared prefix is ROW-copied from the active source slot and only
+        the suffix runs the model."""
+        if self.enable_prefix_cache:
+            src, n_pref = self._find_shared_prefix(req)
+            if n_pref > 0:
+                return self._prefill_with_prefix_latent(slot, req, src,
+                                                        n_pref)
         last, caches, S0, bucket = self._bucketed_prefill(req)
         bufs = [(c["c_kv"], c["k_pe"]) for c in self._caches]
         try:

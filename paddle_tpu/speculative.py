@@ -219,7 +219,7 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=20,
 
 
 def mtp_speculative_generate(model, input_ids, max_new_tokens=20,
-                             eos_token_id=None):
+                             eos_token_id=None, return_stats=False):
     """Self-speculative greedy decode for DeepSeek models trained with
     multi-token prediction (``num_nextn_predict_layers >= 1``): the FIRST
     MTP depth drafts one token per round from the main model's PRE-norm
@@ -278,6 +278,7 @@ def mtp_speculative_generate(model, input_ids, max_new_tokens=20,
             _, mtp_cache = mtp.block(x, cos, sin, kv_cache=mtp_cache)
 
         emitted = [t1]
+        rounds = hits = 0          # draft-acceptance observability
         pending = t1               # exact, not yet written to the cache
         h_tail = pre[:, -1:]       # pre-norm hidden(s) pairing the toks
         toks = [t1]                # tokens pairing h_tail rows
@@ -295,7 +296,9 @@ def mtp_speculative_generate(model, input_ids, max_new_tokens=20,
             logits2 = unwrap(model.lm_head_logits(normed2))
             g0 = int(jnp.argmax(logits2[0, 0]))
             g1 = int(jnp.argmax(logits2[0, 1]))
+            rounds += 1
             if draft == g0:        # draft hit: two tokens from one forward
+                hits += 1
                 emitted.extend([draft, g1])
                 pending = g1
                 h_tail, toks = pre2, [draft, g1]
@@ -308,4 +311,10 @@ def mtp_speculative_generate(model, input_ids, max_new_tokens=20,
             if eos_token_id is not None and eos_token_id in emitted[-2:]:
                 break              # eos inside a hit pair stops the loop
 
-    return _finish(emitted, max_new_tokens, eos_token_id, out_dtype)
+    out = _finish(emitted, max_new_tokens, eos_token_id, out_dtype)
+    if return_stats:
+        # acceptance rate is THE speculative health metric: each hit
+        # retired 2 tokens from one main forward
+        return out, {"rounds": rounds, "hits": hits,
+                     "acceptance": (hits / rounds) if rounds else 0.0}
+    return out

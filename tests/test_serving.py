@@ -64,15 +64,32 @@ def test_mla_latent_mode_staggered_match_solo():
         np.testing.assert_array_equal(done[rid], solo, err_msg=f"req {rid}")
 
 
-def test_mla_latent_mode_rejects_prefix_cache():
+def test_mla_prefix_cache_token_parity():
+    """Latent-mode prefix caching: a second request sharing a long prompt
+    prefix with an ACTIVE slot is admitted by ROW-copying the prefix
+    latents and running only the suffix — output tokens identical to solo
+    decode, and the reuse counter moves."""
     from paddle_tpu.models.deepseek import (DeepseekV2Config,
                                             DeepseekV2ForCausalLM)
 
     paddle.seed(3)
     m = DeepseekV2ForCausalLM(DeepseekV2Config.tiny_mla(num_hidden_layers=2))
-    with pytest.raises(NotImplementedError, match="prefix"):
-        ContinuousBatchEngine(m, max_batch=2, max_len=64,
-                              enable_prefix_cache=True)
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8,
+                                enable_prefix_cache=True)
+    rng = np.random.RandomState(11)
+    base = rng.randint(0, m.config.vocab_size, (24,))
+    p1 = base
+    p2 = np.concatenate([base[:16], rng.randint(0, m.config.vocab_size,
+                                                (5,))])
+    r1 = eng.add_request(p1, max_new_tokens=6)
+    eng.step()                       # p1 active when p2 admits
+    r2 = eng.add_request(p2, max_new_tokens=6)
+    done = eng.run_until_done()
+    assert eng.prefix_pages_reused > 0
+    for rid, p in ((r1, p1), (r2, p2)):
+        solo = m.generate(paddle.to_tensor(p[None]),
+                          max_new_tokens=6).numpy()[0]
+        np.testing.assert_array_equal(done[rid], solo, err_msg=f"req {rid}")
 
 
 def test_eos_retires_slot_early(tiny_model):
