@@ -292,8 +292,38 @@ def serve_bench(devs, gen):
     cfg = _serving_config(on_tpu)
     slots, max_len, n_req = (16, 512, 48) if on_tpu else (4, 64, 8)
     paddle.seed(0)
-    model = LlamaForCausalLM(cfg)
     quantized = bool(os.environ.get("BENCH_SERVE_INT8"))
+    mla = bool(os.environ.get("BENCH_SERVE_MLA"))
+    if mla and quantized:
+        raise ValueError(
+            "BENCH_SERVE_MLA and BENCH_SERVE_INT8 are separate legs — a "
+            "partially-quantized MLA record would persist under the clean "
+            "serve_mla key; unset one")
+    if mla:
+        # latent-mode engine leg: DeepSeek MLA at the serving scale —
+        # per-slot compressed-latent rows instead of the paged K/V pool
+        from paddle_tpu.models.deepseek import (DeepseekV2Config,
+                                                DeepseekV2ForCausalLM)
+
+        if on_tpu:
+            cfg = DeepseekV2Config(
+                vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+                intermediate_size=cfg.intermediate_size,
+                num_hidden_layers=cfg.num_hidden_layers,
+                num_attention_heads=cfg.num_attention_heads,
+                num_key_value_heads=cfg.num_attention_heads,
+                max_position_embeddings=cfg.max_position_embeddings,
+                use_flash_attention=True, dtype="bfloat16",
+                kv_lora_rank=512, qk_nope_head_dim=128,
+                qk_rope_head_dim=64, v_head_dim=128, n_routed_experts=0,
+                first_k_dense_replace=10 ** 9)
+        else:
+            cfg = DeepseekV2Config.tiny_mla(num_hidden_layers=2,
+                                            first_k_dense_replace=10 ** 9,
+                                            n_routed_experts=0)
+        model = DeepseekV2ForCausalLM(cfg)
+    else:
+        model = LlamaForCausalLM(cfg)
     if quantized:
         # weight-only int8 serving leg: weights at 1 byte/element through
         # HBM (decode is weight-bandwidth-bound, so this is the knob)
@@ -317,14 +347,16 @@ def serve_bench(devs, gen):
     total = run()
     dt = time.perf_counter() - t0
     rec = {
-        "metric": "llama_serve_tokens_per_sec_per_chip",
+        "metric": ("mla_serve_tokens_per_sec_per_chip" if mla
+                   else "llama_serve_tokens_per_sec_per_chip"),
         "value": round(total / dt, 1),
         "unit": "tokens/s",
         "vs_baseline": 0.0,  # no reference serving number exists
         "platform": devs[0].platform,
         "requests": n_req,
         "slots": slots,
-        "config": "serve_int8" if quantized else "serve",
+        "config": ("serve_mla" if mla
+                   else "serve_int8" if quantized else "serve"),
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
@@ -718,7 +750,9 @@ def orchestrate():
     # 3. tunnel down or bench failed: fall back to the best TPU result seen
     # for THIS config (the int8 serve leg records under its own key)
     cfg_name = os.environ.get("BENCH_CONFIG", "1b")
-    if cfg_name == "serve" and os.environ.get("BENCH_SERVE_INT8"):
+    if cfg_name == "serve" and os.environ.get("BENCH_SERVE_MLA"):
+        cfg_name = "serve_mla"
+    elif cfg_name == "serve" and os.environ.get("BENCH_SERVE_INT8"):
         cfg_name = "serve_int8"
     pp_sched = os.environ.get("BENCH_PP_SCHEDULE", "1F1B")
     if cfg_name == "pp" and pp_sched.upper() != "1F1B":

@@ -409,9 +409,11 @@ class ContinuousBatchEngine:
             fn._state = None  # _memoized_step refresh hook (state is an arg)
             return fn
 
-        # max_len is part of the key: the traced forward_cached bakes a
-        # rope_len-row cos/sin table, so a second engine over the same
-        # model with a different max_len must NOT reuse this function
+        # max_len in the key is DEFENSIVE: a compiled program bakes a
+        # rope_len-row cos/sin table. The pref_len + sb <= max_len compile
+        # invariant already keeps any cross-engine reuse inside the baked
+        # table, but keying on max_len makes reuse impossible by
+        # construction rather than by invariant
         return _memoized_step(self.model, "_suffix_prefill_fns",
                               (n_pref, sb, ps, self.max_len), build,
                               maxsize=16)
@@ -486,7 +488,7 @@ class ContinuousBatchEngine:
             fn._state = None  # _memoized_step refresh hook (state is an arg)
             return fn
 
-        # max_len in the key for the same rope_len-baking reason as
+        # max_len in the key: same defensive reasoning as
         # _suffix_prefill_fn
         return _memoized_step(self.model, "_latent_suffix_prefill_fns",
                               (n_pref, sb, ps, self.max_len), build,

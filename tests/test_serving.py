@@ -92,6 +92,38 @@ def test_mla_prefix_cache_token_parity():
         np.testing.assert_array_equal(done[rid], solo, err_msg=f"req {rid}")
 
 
+def test_suffix_prefill_not_shared_across_max_len(tiny_model):
+    """Two engines with DIFFERENT max_len over the SAME model produce
+    correct tokens through the prefix-cached admission path. The
+    suffix-prefill memo key includes max_len DEFENSIVELY: a compiled
+    program bakes a rope_len-row table, and while today's invariant
+    (pref_len + sb <= max_len at compile time) keeps any reuse within
+    the baked table, keying on max_len makes cross-engine reuse
+    impossible by construction instead of by invariant."""
+    m = tiny_model
+    rng = np.random.RandomState(6)
+    base = rng.randint(0, m.config.vocab_size, (24,))
+    p2 = np.concatenate([base[:16], rng.randint(0, m.config.vocab_size,
+                                                (5,))])
+
+    def serve(max_len):
+        eng = ContinuousBatchEngine(m, max_batch=2, max_len=max_len,
+                                    page_size=8, enable_prefix_cache=True)
+        r1 = eng.add_request(base, max_new_tokens=6)
+        eng.step()
+        r2 = eng.add_request(p2, max_new_tokens=6)   # prefix-cached
+        done = eng.run_until_done()
+        assert eng.prefix_pages_reused > 0
+        return done[r1], done[r2]
+
+    serve(64)                       # populates the suffix-prefill cache
+    out1, out2 = serve(128)         # must NOT reuse the 64-row table fn
+    for out, p in ((out1, base), (out2, p2)):
+        solo = m.generate(paddle.to_tensor(p[None]),
+                          max_new_tokens=6).numpy()[0]
+        np.testing.assert_array_equal(out, solo)
+
+
 def test_eos_retires_slot_early(tiny_model):
     """A row hitting eos frees its slot immediately (its output stops at
     eos) while the other row keeps decoding to its budget."""
