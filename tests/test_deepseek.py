@@ -299,6 +299,26 @@ class TestMTP:
         with pytest.raises(ValueError, match="num_nextn"):
             mtp_speculative_generate(m, _ids(b=1, s=4), max_new_tokens=2)
 
+    def test_state_dict_roundtrip_with_mtp(self):
+        """MTP modules serialize with the model: a differently-initialized
+        model loaded from another's state_dict reproduces its training
+        loss exactly (guards the new parameters' registration)."""
+        import paddle_tpu as paddle
+
+        cfg = DeepseekV2Config.tiny_v3(num_nextn_predict_layers=1,
+                                       num_hidden_layers=2)
+        paddle.seed(51)
+        m1 = DeepseekV2ForCausalLM(cfg)
+        paddle.seed(99)
+        m2 = DeepseekV2ForCausalLM(cfg)
+        missing, unexpected = m2.set_state_dict(m1.state_dict())
+        assert not missing and not unexpected
+        ids = _ids(s=12, seed=8)
+        labels = np.concatenate([ids[:, 1:], -np.ones((2, 1), np.int64)], 1)
+        l1, _ = m1(pd.to_tensor(ids), labels=pd.to_tensor(labels))
+        l2, _ = m2(pd.to_tensor(ids), labels=pd.to_tensor(labels))
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
     def test_mtp_rejected_by_pipe(self):
         from paddle_tpu.models.deepseek import DeepseekForCausalLMPipe
 
