@@ -578,7 +578,9 @@ def main():
             from paddle_tpu.ops.pallas import flash_attention as _pf
             from paddle_tpu.ops.pallas import fused_norm as _fn
 
-            hd = cfg.hidden_size // cfg.num_attention_heads
+            from paddle_tpu.models.llama import head_dim_of
+
+            hd = head_dim_of(cfg)
             qa = jnp.zeros((batch, seq, cfg.num_attention_heads, hd),
                            jnp.bfloat16)
             ka = jnp.zeros((batch, seq, cfg.num_key_value_heads, hd),

@@ -482,9 +482,11 @@ def _seen_from_prompt(ids, vocab, pad_mask=None):
 # ---------------------------------------------------------------------------
 
 def _empty_caches(model, batch, max_len, allowed=None, row_pos=None):
+    from .models.llama import head_dim_of
+
     cfg = model.config
     hk = cfg.num_key_value_heads
-    d = cfg.hidden_size // cfg.num_attention_heads
+    d = head_dim_of(cfg)
     dt = jnp.dtype(cfg.dtype) if isinstance(cfg.dtype, str) else cfg.dtype
     # models with a non-k/v cache layout (MLA's compressed latent) provide
     # their own per-layer buffer allocator

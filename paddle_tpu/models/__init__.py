@@ -35,6 +35,10 @@ _LAZY = {
     "Qwen2Config": ("qwen2", "Qwen2Config"),
     "Qwen2ForCausalLM": ("qwen2", "Qwen2ForCausalLM"),
     "qwen2_from_hf": ("qwen2", "qwen2_from_hf"),
+    "qwen3": ("qwen3", None),
+    "Qwen3Config": ("qwen3", "Qwen3Config"),
+    "Qwen3ForCausalLM": ("qwen3", "Qwen3ForCausalLM"),
+    "qwen3_from_hf": ("qwen3", "qwen3_from_hf"),
     "qwen2_moe": ("qwen2_moe", None),
     "Qwen2MoeConfig": ("qwen2_moe", "Qwen2MoeConfig"),
     "Qwen2MoeForCausalLM": ("qwen2_moe", "Qwen2MoeForCausalLM"),

@@ -52,7 +52,7 @@ from ..nn.layer import Layer
 from .. import nn
 from ..ops.registry import apply
 from ..distributed.topology import get_hybrid_communicate_group
-from .llama import (LlamaModel, LlamaRMSNorm, _make_linear)
+from .llama import (LlamaModel, LlamaRMSNorm, _make_linear, _width_norm)
 from .llama_moe import (LlamaMoEConfig, LlamaMoEDecoderLayer,
                         LlamaMoEForCausalLM)
 
@@ -311,7 +311,7 @@ class DeepseekV2Attention(Layer):
             with dtype_guard(config.dtype):
                 self.q_a_proj = nn.Linear(h, config.q_lora_rank,
                                           bias_attr=None if bias else False)
-            self.q_a_layernorm = _rank_norm(config, config.q_lora_rank)
+            self.q_a_layernorm = _width_norm(config, config.q_lora_rank)
             self.q_b_proj = _make_linear(config.q_lora_rank, H * (dn + dr),
                                          column=True, config=config)
             self.q_proj = None
@@ -323,7 +323,7 @@ class DeepseekV2Attention(Layer):
         with dtype_guard(config.dtype):
             self.kv_a_proj_with_mqa = nn.Linear(
                 h, r + dr, bias_attr=None if bias else False)
-        self.kv_a_layernorm = _rank_norm(config, r)
+        self.kv_a_layernorm = _width_norm(config, r)
         self.kv_b_proj = _make_linear(r, H * (dn + dv), column=True,
                                       config=config)
         self.o_proj = _make_linear(H * dv, h, column=False, config=config)
@@ -456,12 +456,6 @@ class DeepseekV2Attention(Layer):
         out = apply("mla_attention", attn_fn, q_nope, q_pe, c_kv, k_pe,
                     cos, sin, self._kv_b_weight())
         return self.o_proj(out)
-
-
-def _rank_norm(config, width):
-    """RMSNorm over a low-rank latent width (q_a/kv_a layernorms)."""
-    sub = dataclasses.replace(config, hidden_size=width)
-    return LlamaRMSNorm(sub)
 
 
 class DeepseekV2DecoderLayer(LlamaMoEDecoderLayer):

@@ -53,6 +53,11 @@ class LlamaConfig:
     rope_scaling: Optional[dict] = None
     # bias on the q/k/v projections (Qwen2-style); o_proj stays bias-free
     attention_bias: bool = False
+    # attention head width decoupled from hidden_size/num_heads (Qwen3:
+    # e.g. hidden 2560, 32 heads, head_dim 128); None = the quotient
+    head_dim: Optional[int] = None
+    # per-head RMSNorm on q/k after projection, before RoPE (Qwen3)
+    qk_norm: bool = False
     # causal sliding-window attention (Mistral/Qwen2): each token attends
     # to at most the last `sliding_window` positions. The splash kernel
     # skips blocks outside the band (O(seq*window) work); dense fallbacks
@@ -95,6 +100,22 @@ class LlamaConfig:
                     max_position_embeddings=256, dtype="float32")
         base.update(kw)
         return LlamaConfig(**base)
+
+
+def head_dim_of(config) -> int:
+    """Attention head width — ``config.head_dim`` when set (Qwen3 decouples
+    it from hidden/heads), else the classic quotient. The ONE derivation
+    shared by the attention layer, rope tables, cache allocators, and the
+    serving engine."""
+    hd = getattr(config, "head_dim", None)
+    return int(hd) if hd else config.hidden_size // config.num_attention_heads
+
+
+def _width_norm(config, width):
+    """RMSNorm over an arbitrary trailing width (per-head q/k norms, the
+    MLA low-rank latents) built from the family config."""
+    sub = dataclasses.replace(config, hidden_size=width)
+    return LlamaRMSNorm(sub)
 
 
 SUPPORTED_ROPE_SCALING = ("llama3", "linear", "yarn")
@@ -317,8 +338,14 @@ class LlamaAttention(Layer):
         self.hidden_size = config.hidden_size
         self.num_heads = config.num_attention_heads
         self.num_kv_heads = config.num_key_value_heads
-        self.head_dim = config.hidden_size // config.num_attention_heads
+        self.head_dim = head_dim_of(config)
         bias = config.attention_bias
+        if config.qk_norm:
+            # Qwen3: per-head RMSNorm on q/k after projection, before RoPE
+            self.q_norm = _width_norm(config, self.head_dim)
+            self.k_norm = _width_norm(config, self.head_dim)
+        else:
+            self.q_norm = self.k_norm = None
         self.q_proj = _make_linear(self.hidden_size, self.num_heads * self.head_dim,
                                    column=True, config=config, has_bias=bias)
         self.k_proj = _make_linear(self.hidden_size, self.num_kv_heads * self.head_dim,
@@ -334,6 +361,9 @@ class LlamaAttention(Layer):
         q = self.q_proj(hidden_states).reshape([b, s, h, d])
         k = self.k_proj(hidden_states).reshape([b, s, hk, d])
         v = self.v_proj(hidden_states).reshape([b, s, hk, d])
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+            k = self.k_norm(k)
 
         cfg = self.config
 
@@ -512,7 +542,7 @@ class LlamaModel(Layer):
     def _rope_dim(self):
         """Rotary table width; MLA trunks override (RoPE rides only the
         decoupled qk_rope_head_dim slice)."""
-        return self.config.hidden_size // self.config.num_attention_heads
+        return head_dim_of(self.config)
 
     def _rope(self, seq_len):
         if seq_len in self._rope_cache:
@@ -710,7 +740,7 @@ class LlamaDecoderLayerPipe(Layer):
         self.layer = layer
 
     def _rope_dim(self):
-        return self.config.hidden_size // self.config.num_attention_heads
+        return head_dim_of(self.config)
 
     def forward(self, hidden):
         cfg = self.config
@@ -866,6 +896,7 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
         tie_word_embeddings=bool(get("tie_word_embeddings", False)),
         attention_bias=bool(get("attention_bias",
                                 get("model_type") == "qwen2")),
+        head_dim=get("head_dim"),
         sliding_window=window,
     )
     kw.update(overrides)
@@ -888,6 +919,10 @@ def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict) -> "LlamaForCausalLM
         for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
             plan[f"{ours}.self_attn.{proj}.weight"] = (
                 f"{hf}.self_attn.{proj}.weight", True)
+        if model.config.qk_norm:
+            for norm in ("q_norm", "k_norm"):  # per-head RMSNorm (Qwen3)
+                plan[f"{ours}.self_attn.{norm}.weight"] = (
+                    f"{hf}.self_attn.{norm}.weight", False)
         if model.config.attention_bias:
             for proj in ("q_proj", "k_proj", "v_proj"):  # o_proj stays bias-free
                 plan[f"{ours}.self_attn.{proj}.bias"] = (

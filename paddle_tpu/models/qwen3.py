@@ -1,0 +1,76 @@
+"""Qwen3 decoder family.
+
+Role parity: the reference serves the Qwen line through PaddleNLP's qwen
+modeling on the same fleet stack as its llama modeling; Qwen3 is that
+recipe with two signature deviations this build expresses as LlamaConfig
+knobs, so every path (training, hybrid parallel, serving, HF interop) is
+the already-tested Llama machinery:
+
+- ``qk_norm=True``: per-head RMSNorm on q/k after projection, before RoPE
+  (replaces Qwen2's q/k/v biases — Qwen3 is bias-free);
+- ``head_dim`` decoupled from hidden_size/num_heads (e.g. Qwen3-4B:
+  hidden 2560, 32 heads, head_dim 128).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .llama import LlamaConfig, LlamaForCausalLM, _from_hf
+
+
+@dataclasses.dataclass
+class Qwen3Config(LlamaConfig):
+    vocab_size: int = 151936
+    hidden_size: int = 4096
+    intermediate_size: int = 12288
+    num_hidden_layers: int = 36
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    head_dim: int | None = 128
+    max_position_embeddings: int = 40960
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1e6
+    attention_bias: bool = False
+    qk_norm: bool = True                 # the Qwen3 signature deviation
+
+    @staticmethod
+    def qwen3_8b(**kw):
+        return Qwen3Config(**kw)
+
+    @staticmethod
+    def qwen3_4b(**kw):
+        # head_dim 128 with hidden/heads = 80: the decoupled case
+        base = dict(hidden_size=2560, intermediate_size=9728,
+                    num_hidden_layers=36, num_attention_heads=32,
+                    num_key_value_heads=8, tie_word_embeddings=True)
+        base.update(kw)
+        return Qwen3Config(**base)
+
+    @staticmethod
+    def tiny(**kw):
+        # head_dim 32 != hidden/heads (16): the decoupling is exercised
+        # by every tiny-config test
+        base = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, head_dim=32,
+                    max_position_embeddings=256, dtype="float32")
+        base.update(kw)
+        return Qwen3Config(**base)
+
+
+class Qwen3ForCausalLM(LlamaForCausalLM):
+    """Qwen3 causal LM — Llama decoder with per-head q/k RMSNorm and a
+    decoupled head width."""
+
+    def __init__(self, config: Qwen3Config):
+        if not config.qk_norm:
+            raise ValueError("Qwen3 uses qk_norm=True")
+        super().__init__(config)
+
+
+def qwen3_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a Qwen3ForCausalLM from a transformers Qwen3 model (or a raw
+    state dict + config)."""
+    config_overrides.setdefault("qk_norm", True)
+    return _from_hf(Qwen3Config, Qwen3ForCausalLM, hf_model_or_state,
+                    hf_config, **config_overrides)

@@ -93,8 +93,10 @@ class ContinuousBatchEngine:
                                  lengths=self._lengths)
                             for _ in range(cfg.num_hidden_layers)]
         else:
+            from .models.llama import head_dim_of
+
             hk = cfg.num_key_value_heads
-            d = cfg.hidden_size // cfg.num_attention_heads
+            d = head_dim_of(cfg)
             n_pages = max_batch * self._pages_per_slot
             page_indices = jnp.arange(n_pages, dtype=jnp.int32).reshape(
                 max_batch, self._pages_per_slot)

@@ -8,7 +8,8 @@ import pytest
 
 import paddle_tpu as paddle
 
-FAMILIES = ["llama", "qwen2", "mistral", "gpt2", "qwen2_moe", "deepseek"]
+FAMILIES = ["llama", "qwen2", "qwen3", "mistral", "gpt2", "qwen2_moe",
+            "deepseek"]
 
 
 def _build(name):
@@ -21,6 +22,11 @@ def _build(name):
         from paddle_tpu.models.qwen2 import Qwen2Config, Qwen2ForCausalLM
 
         return Qwen2ForCausalLM(Qwen2Config.tiny(num_hidden_layers=2))
+    if name == "qwen3":
+        from paddle_tpu.models.qwen3 import Qwen3Config, Qwen3ForCausalLM
+
+        # head_dim != hidden/heads: every decode path sees the decoupling
+        return Qwen3ForCausalLM(Qwen3Config.tiny(num_hidden_layers=2))
     if name == "mistral":
         from paddle_tpu.models.mistral import (MistralConfig,
                                                MistralForCausalLM)
