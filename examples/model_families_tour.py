@@ -34,6 +34,8 @@ def main():
             M.LlamaConfig.tiny(num_hidden_layers=2, vocab_size=256))),
         ("qwen2", M.Qwen2ForCausalLM(
             M.Qwen2Config.tiny(num_hidden_layers=2, vocab_size=256))),
+        ("qwen3", M.Qwen3ForCausalLM(
+            M.Qwen3Config.tiny(num_hidden_layers=2, vocab_size=256))),
         ("mistral", M.MistralForCausalLM(
             M.MistralConfig.tiny(num_hidden_layers=2, vocab_size=256,
                                  sliding_window=8))),
