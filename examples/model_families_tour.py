@@ -45,6 +45,8 @@ def main():
             M.LlamaMoEConfig.tiny_moe(vocab_size=256))),
         ("qwen2-moe", M.Qwen2MoeForCausalLM(
             M.Qwen2MoeConfig.tiny(vocab_size=256))),
+        ("qwen3-moe", M.Qwen3MoeForCausalLM(
+            M.Qwen3MoeConfig.tiny(vocab_size=256))),
         ("ernie-4.5", M.Ernie45ForCausalLM(
             M.Ernie45Config.tiny_moe(vocab_size=256))),
         ("deepseek-v2", M.DeepseekV2ForCausalLM(

@@ -43,6 +43,10 @@ _LAZY = {
     "Qwen2MoeConfig": ("qwen2_moe", "Qwen2MoeConfig"),
     "Qwen2MoeForCausalLM": ("qwen2_moe", "Qwen2MoeForCausalLM"),
     "qwen2_moe_from_hf": ("qwen2_moe", "qwen2_moe_from_hf"),
+    "qwen3_moe": ("qwen3_moe", None),
+    "Qwen3MoeConfig": ("qwen3_moe", "Qwen3MoeConfig"),
+    "Qwen3MoeForCausalLM": ("qwen3_moe", "Qwen3MoeForCausalLM"),
+    "qwen3_moe_from_hf": ("qwen3_moe", "qwen3_moe_from_hf"),
     "mistral": ("mistral", None),
     "MistralConfig": ("mistral", "MistralConfig"),
     "MistralForCausalLM": ("mistral", "MistralForCausalLM"),

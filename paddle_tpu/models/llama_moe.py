@@ -70,6 +70,81 @@ class LlamaMoEConfig(LlamaConfig):
         return LlamaMoEConfig(**base)
 
 
+def load_hf_grouped_moe(model, hf_state_dict, *, attn_biases=False,
+                        qk_norms=False, shared_expert=False,
+                        shared_gate=False, who="load_hf_moe"):
+    """Shared HF→grouped-layout loader for the Qwen-MoE family shapes:
+    embed/norm/lm_head, per-layer attention (optionally q/k/v biases or
+    per-head q/k norms), router, per-expert projections packed via
+    pack_hf_experts, optional (gated) shared expert. torch [out, in]
+    weights transpose to [in, out]."""
+    from .llama import _hf_to_np
+
+    cfg = model.config
+    E, L = cfg.n_routed_experts, cfg.num_hidden_layers
+    mapped, consumed = {}, set()
+
+    def take(hf_key, transpose):
+        if hf_key not in hf_state_dict:
+            raise KeyError(f"{who}: missing {hf_key!r}")
+        consumed.add(hf_key)
+        v = _hf_to_np(hf_state_dict[hf_key])
+        return v.T if transpose else v
+
+    mapped["llama.embed_tokens.weight"] = take("model.embed_tokens.weight",
+                                               False)
+    mapped["llama.norm.weight"] = take("model.norm.weight", False)
+    if model.lm_head is not None:
+        src = ("lm_head.weight" if "lm_head.weight" in hf_state_dict
+               else "model.embed_tokens.weight")
+        mapped["lm_head.weight"] = take(src, True)
+    for i in range(L):
+        hf, ours = f"model.layers.{i}", f"llama.layers.{i}"
+        for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            mapped[f"{ours}.self_attn.{proj}.weight"] = take(
+                f"{hf}.self_attn.{proj}.weight", True)
+        if attn_biases:
+            for proj in ("q_proj", "k_proj", "v_proj"):
+                mapped[f"{ours}.self_attn.{proj}.bias"] = take(
+                    f"{hf}.self_attn.{proj}.bias", False)
+        if qk_norms:
+            for norm in ("q_norm", "k_norm"):
+                mapped[f"{ours}.self_attn.{norm}.weight"] = take(
+                    f"{hf}.self_attn.{norm}.weight", False)
+        mapped[f"{ours}.input_layernorm.weight"] = take(
+            f"{hf}.input_layernorm.weight", False)
+        mapped[f"{ours}.post_attention_layernorm.weight"] = take(
+            f"{hf}.post_attention_layernorm.weight", False)
+        # router: HF [E, h] -> gate_weight [h, E]
+        mapped[f"{ours}.mlp.gate_weight"] = take(f"{hf}.mlp.gate.weight",
+                                                 True)
+        (mapped[f"{ours}.mlp.experts.w1"],
+         mapped[f"{ours}.mlp.experts.b1"],
+         mapped[f"{ours}.mlp.experts.w2"],
+         mapped[f"{ours}.mlp.experts.b2"]) = pack_hf_experts(
+            take, f"{hf}.mlp", E, cfg.hidden_size)
+        if shared_expert:
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                mapped[f"{ours}.mlp.shared_expert.{proj}.weight"] = take(
+                    f"{hf}.mlp.shared_expert.{proj}.weight", True)
+        if shared_gate:
+            # shared gate: HF [1, h] -> [h, 1]
+            mapped[f"{ours}.mlp.shared_gate_weight"] = take(
+                f"{hf}.mlp.shared_expert_gate.weight", True)
+    leftovers = [k for k in hf_state_dict
+                 if k not in consumed and k != "lm_head.weight"
+                 and not k.endswith("rotary_emb.inv_freq")]
+    if leftovers:
+        raise ValueError(
+            f"{who}: checkpoint tensors this model cannot represent: "
+            f"{leftovers[:5]}{'...' if len(leftovers) > 5 else ''}")
+    missing, unexpected = model.set_state_dict(mapped)
+    assert not unexpected, unexpected
+    if missing:
+        raise KeyError(f"{who}: model keys not covered: {missing[:5]}")
+    return model
+
+
 def pack_hf_experts(take, hf_prefix, n_experts, hidden_size):
     """Stack a transformers checkpoint's per-expert gate/up/down weights
     into the grouped [E, ...] layout (shared by the qwen2_moe and ernie45

@@ -20,8 +20,9 @@ import dataclasses
 
 import numpy as np
 
-from .llama import _hf_to_np
-from .llama_moe import LlamaMoEConfig, LlamaMoEForCausalLM
+from .llama import validate_rope_scaling
+from .llama_moe import (LlamaMoEConfig, LlamaMoEForCausalLM,
+                        load_hf_grouped_moe)
 
 
 @dataclasses.dataclass
@@ -88,7 +89,12 @@ def _hf_config_to_qwen2_moe(hf_config, **overrides) -> Qwen2MoeConfig:
         raise NotImplementedError(
             f"shared_expert_intermediate_size ({shared_inter}) must be a "
             f"multiple of moe_intermediate_size ({moe_inter})")
+    scaling = get("rope_scaling")
+    if scaling not in (None, {}):
+        validate_rope_scaling(dict(scaling),
+                              max_position=get("max_position_embeddings"))
     kw = dict(
+        rope_scaling=(dict(scaling) if scaling else None),
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
         intermediate_size=get("intermediate_size"),
@@ -113,69 +119,10 @@ def _hf_config_to_qwen2_moe(hf_config, **overrides) -> Qwen2MoeConfig:
 def load_hf_qwen2_moe(model: Qwen2MoeForCausalLM,
                       hf_state_dict) -> Qwen2MoeForCausalLM:
     """Pack a transformers Qwen2MoeForCausalLM state dict into the grouped
-    layout: per-expert gate_proj‖up_proj stack into experts.w1
-    [E, h, 2*inter] (down_proj into w2 [E, inter, h]); torch [out, in]
-    weights transpose to [in, out]."""
-    cfg = model.config
-    E, L = cfg.n_routed_experts, cfg.num_hidden_layers
-    mapped, consumed = {}, set()
-
-    def take(hf_key, transpose):
-        if hf_key not in hf_state_dict:
-            raise KeyError(f"load_hf_qwen2_moe: missing {hf_key!r}")
-        consumed.add(hf_key)
-        v = _hf_to_np(hf_state_dict[hf_key])
-        return v.T if transpose else v
-
-    mapped["llama.embed_tokens.weight"] = take("model.embed_tokens.weight",
-                                               False)
-    mapped["llama.norm.weight"] = take("model.norm.weight", False)
-    if model.lm_head is not None:
-        src = ("lm_head.weight" if "lm_head.weight" in hf_state_dict
-               else "model.embed_tokens.weight")
-        mapped["lm_head.weight"] = take(src, True)
-    for i in range(L):
-        hf, ours = f"model.layers.{i}", f"llama.layers.{i}"
-        for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
-            mapped[f"{ours}.self_attn.{proj}.weight"] = take(
-                f"{hf}.self_attn.{proj}.weight", True)
-        for proj in ("q_proj", "k_proj", "v_proj"):
-            mapped[f"{ours}.self_attn.{proj}.bias"] = take(
-                f"{hf}.self_attn.{proj}.bias", False)
-        mapped[f"{ours}.input_layernorm.weight"] = take(
-            f"{hf}.input_layernorm.weight", False)
-        mapped[f"{ours}.post_attention_layernorm.weight"] = take(
-            f"{hf}.post_attention_layernorm.weight", False)
-        # router: HF [E, h] -> gate_weight [h, E]
-        mapped[f"{ours}.mlp.gate_weight"] = take(f"{hf}.mlp.gate.weight",
-                                                 True)
-        from .llama_moe import pack_hf_experts
-
-        (mapped[f"{ours}.mlp.experts.w1"],
-         mapped[f"{ours}.mlp.experts.b1"],
-         mapped[f"{ours}.mlp.experts.w2"],
-         mapped[f"{ours}.mlp.experts.b2"]) = pack_hf_experts(
-            take, f"{hf}.mlp", E, cfg.hidden_size)
-        for proj in ("gate_proj", "up_proj", "down_proj"):
-            mapped[f"{ours}.mlp.shared_expert.{proj}.weight"] = take(
-                f"{hf}.mlp.shared_expert.{proj}.weight", True)
-        # shared gate: HF [1, h] -> [h, 1]
-        mapped[f"{ours}.mlp.shared_gate_weight"] = take(
-            f"{hf}.mlp.shared_expert_gate.weight", True)
-    leftovers = [k for k in hf_state_dict
-                 if k not in consumed and k != "lm_head.weight"
-                 and not k.endswith("rotary_emb.inv_freq")]
-    if leftovers:
-        raise ValueError(
-            f"load_hf_qwen2_moe: checkpoint tensors this model cannot "
-            f"represent: {leftovers[:5]}"
-            f"{'...' if len(leftovers) > 5 else ''}")
-    missing, unexpected = model.set_state_dict(mapped)
-    assert not unexpected, unexpected
-    if missing:
-        raise KeyError(f"load_hf_qwen2_moe: model keys not covered: "
-                       f"{missing[:5]}")
-    return model
+    layout (shared loader; q/k/v biases + sigmoid-gated shared expert)."""
+    return load_hf_grouped_moe(model, hf_state_dict, attn_biases=True,
+                               shared_expert=True, shared_gate=True,
+                               who="load_hf_qwen2_moe")
 
 
 def qwen2_moe_from_hf(hf_model_or_state, hf_config=None, **config_overrides):

@@ -1,0 +1,126 @@
+"""Qwen3-MoE decoder family (Qwen3-30B-A3B class).
+
+Role parity: the reference's Qwen-MoE serving recipe (BASELINE.json names
+"Qwen2-MoE EP"), current generation. The architecture is the LlamaMoE
+machinery with the Qwen3 attention signature — per-head q/k RMSNorm
+(``qk_norm``), bias-free projections, ``head_dim`` decoupled from
+hidden/heads — and a plain routed MoE FFN: NO shared expert, softmax
+router with renormalized top-k (``norm_topk_prob=True``). Routed experts
+are SwiGLU GroupedMLPs (fused gate‖up) shardable over the ep axis like
+every MoE family here.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .llama import validate_rope_scaling
+from .llama_moe import (LlamaMoEConfig, LlamaMoEForCausalLM,
+                        load_hf_grouped_moe)
+
+
+@dataclasses.dataclass
+class Qwen3MoeConfig(LlamaMoEConfig):
+    # Qwen3-30B-A3B shape
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    intermediate_size: int = 6144
+    num_hidden_layers: int = 48
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 4
+    head_dim: int | None = 128             # decoupled (quotient is 64)
+    max_position_embeddings: int = 40960
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1e6
+    attention_bias: bool = False
+    qk_norm: bool = True                    # the Qwen3 attention signature
+    n_routed_experts: int = 128
+    num_experts_per_tok: int = 8
+    moe_intermediate_size: int = 768
+    n_shared_experts: int = 0               # no shared expert in Qwen3-MoE
+    norm_topk_prob: bool = True
+    first_k_dense_replace: int = 0          # every layer is sparse
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, head_dim=32,
+                    max_position_embeddings=256, dtype="float32",
+                    n_routed_experts=4, num_experts_per_tok=2,
+                    moe_intermediate_size=32, n_shared_experts=0,
+                    first_k_dense_replace=0)
+        base.update(kw)
+        return Qwen3MoeConfig(**base)
+
+
+class Qwen3MoeForCausalLM(LlamaMoEForCausalLM):
+    """Qwen3-MoE causal LM — LlamaMoE decoder with the Qwen3 attention
+    signature and a shared-expert-free routed FFN."""
+
+    def __init__(self, config: Qwen3MoeConfig):
+        if not config.qk_norm:
+            raise ValueError("Qwen3-MoE uses qk_norm=True")
+        if config.n_shared_experts:
+            raise ValueError("Qwen3-MoE has no shared expert "
+                             "(n_shared_experts=0)")
+        super().__init__(config)
+
+
+def _hf_config_to_qwen3_moe(hf_config, **overrides) -> Qwen3MoeConfig:
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    if get("decoder_sparse_step", 1) != 1 or get("mlp_only_layers", []):
+        raise NotImplementedError(
+            "qwen3_moe_from_hf: mixed sparse/dense layer patterns "
+            "(decoder_sparse_step != 1 or mlp_only_layers) are not "
+            "representable; this build supports uniformly-sparse stacks")
+    scaling = get("rope_scaling")
+    if scaling not in (None, {}):
+        # a yarn-scaled long-context checkpoint is config-only — validate
+        # and MAP it rather than silently building plain-RoPE tables
+        validate_rope_scaling(dict(scaling),
+                              max_position=get("max_position_embeddings"))
+    kw = dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads"),
+        head_dim=get("head_dim"),
+        max_position_embeddings=get("max_position_embeddings"),
+        rms_norm_eps=get("rms_norm_eps", 1e-6),
+        rope_theta=get("rope_theta", 1e6),
+        rope_scaling=(dict(scaling) if scaling else None),
+        tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+        n_routed_experts=get("num_experts"),
+        num_experts_per_tok=get("num_experts_per_tok"),
+        moe_intermediate_size=get("moe_intermediate_size"),
+        # False mirrors the HF Qwen3MoeConfig class default for configs
+        # that omit the key (shipped checkpoints set it explicitly)
+        norm_topk_prob=bool(get("norm_topk_prob", False)),
+        router_aux_loss_coef=get("router_aux_loss_coef", 0.001),
+    )
+    kw.update(overrides)
+    return Qwen3MoeConfig(**kw)
+
+
+def load_hf_qwen3_moe(model: Qwen3MoeForCausalLM,
+                      hf_state_dict) -> Qwen3MoeForCausalLM:
+    """Pack a transformers Qwen3MoeForCausalLM state dict into the grouped
+    layout (shared loader; q/k per-head norms, no biases, no shared
+    expert)."""
+    return load_hf_grouped_moe(model, hf_state_dict, qk_norms=True,
+                               who="load_hf_qwen3_moe")
+
+
+def qwen3_moe_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a Qwen3MoeForCausalLM from a transformers model (or raw state
+    dict + config)."""
+    if hf_config is None:
+        hf_config = hf_model_or_state.config
+        state = hf_model_or_state.state_dict()
+    else:
+        state = hf_model_or_state
+    cfg = _hf_config_to_qwen3_moe(hf_config, **config_overrides)
+    return load_hf_qwen3_moe(Qwen3MoeForCausalLM(cfg), state)

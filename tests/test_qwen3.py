@@ -59,3 +59,66 @@ def test_trains():
 def test_qk_norm_required():
     with pytest.raises(ValueError, match="qk_norm"):
         Qwen3ForCausalLM(Qwen3Config.tiny(qk_norm=False))
+
+
+class TestQwen3Moe:
+    def test_logits_and_generate_match_transformers(self):
+        from transformers import Qwen3MoeConfig as HFConfig
+        from transformers import Qwen3MoeForCausalLM as HFQwen3Moe
+
+        from paddle_tpu.models.qwen3_moe import qwen3_moe_from_hf
+
+        torch.manual_seed(0)
+        hf_cfg = HFConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=32,
+            max_position_embeddings=128, rms_norm_eps=1e-6,
+            rope_theta=1e6, tie_word_embeddings=False,
+            num_experts=4, num_experts_per_tok=2,
+            moe_intermediate_size=32, norm_topk_prob=True,
+            attn_implementation="eager")
+        hf = HFQwen3Moe(hf_cfg).eval()
+        ours = qwen3_moe_from_hf(hf, dtype="float32",
+                                 use_flash_attention=False)
+        assert ours.config.qk_norm and ours.config.head_dim == 32
+        assert ours.config.n_shared_experts == 0
+        ids = np.random.RandomState(0).randint(0, 128, (2, 9))
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids)).logits.numpy()
+        got = ours(paddle.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+        with torch.no_grad():
+            gref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                               do_sample=False).numpy()[:, 9:]
+        ggot = ours.generate(paddle.to_tensor(ids),
+                             max_new_tokens=6).numpy()
+        np.testing.assert_array_equal(ggot, gref)
+
+    def test_trains_with_aux_loss(self):
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.models.qwen3_moe import (Qwen3MoeConfig,
+                                                 Qwen3MoeForCausalLM)
+
+        paddle.seed(2)
+        m = Qwen3MoeForCausalLM(Qwen3MoeConfig.tiny())
+
+        def loss_fn(model, x, y):
+            loss, _ = model(x, labels=y)
+            return loss
+
+        step = paddle.jit.train_step(
+            m, loss_fn, opt.AdamW(1e-2, parameters=m.parameters()))
+        x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512,
+                                                              (2, 16)))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 512,
+                                                              (2, 16)))
+        losses = [float(step(x, y).numpy()) for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_shared_expert_rejected(self):
+        from paddle_tpu.models.qwen3_moe import (Qwen3MoeConfig,
+                                                 Qwen3MoeForCausalLM)
+
+        with pytest.raises(ValueError, match="shared"):
+            Qwen3MoeForCausalLM(Qwen3MoeConfig.tiny(n_shared_experts=1))
