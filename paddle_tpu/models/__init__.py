@@ -39,6 +39,10 @@ _LAZY = {
     "Qwen3Config": ("qwen3", "Qwen3Config"),
     "Qwen3ForCausalLM": ("qwen3", "Qwen3ForCausalLM"),
     "qwen3_from_hf": ("qwen3", "qwen3_from_hf"),
+    "mixtral": ("mixtral", None),
+    "MixtralConfig": ("mixtral", "MixtralConfig"),
+    "MixtralForCausalLM": ("mixtral", "MixtralForCausalLM"),
+    "mixtral_from_hf": ("mixtral", "mixtral_from_hf"),
     "qwen2_moe": ("qwen2_moe", None),
     "Qwen2MoeConfig": ("qwen2_moe", "Qwen2MoeConfig"),
     "Qwen2MoeForCausalLM": ("qwen2_moe", "Qwen2MoeForCausalLM"),

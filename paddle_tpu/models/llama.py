@@ -130,6 +130,18 @@ def _rope_type(scaling: Optional[dict]):
     return scaling.get("rope_type", scaling.get("type", None))
 
 
+def mapped_rope_scaling(get) -> Optional[dict]:
+    """hf_config_to_* helper: read ``rope_scaling`` through the mapper's
+    ``get``, validate it at CONVERT time, and return the dict (or None)
+    ready for the config kwarg — the one guard shared by every family
+    mapper."""
+    scaling = get("rope_scaling")
+    if scaling not in (None, {}):
+        validate_rope_scaling(dict(scaling),
+                              max_position=get("max_position_embeddings"))
+    return dict(scaling) if scaling else None
+
+
 def validate_rope_scaling(scaling: Optional[dict],
                           max_position: Optional[int] = None) -> None:
     """Checkpoint-loader gate: raise at CONVERT time both for rope_scaling
@@ -857,11 +869,8 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
     """Map a transformers LlamaConfig (object or dict) onto LlamaConfig."""
     get = (hf_config.get if isinstance(hf_config, dict)
            else lambda k, d=None: getattr(hf_config, k, d))
-    scaling = get("rope_scaling")
-    if scaling not in (None, {}):
-        # type + parameter gate at CONVERT time (yarn math errors included)
-        validate_rope_scaling(dict(scaling),
-                              max_position=get("max_position_embeddings"))
+    # type + parameter gate at CONVERT time (yarn math errors included)
+    scaling = mapped_rope_scaling(get)
     # HF Llama's attention_bias puts bias on q/k/v AND o; this build only
     # represents q/k/v bias (the Qwen2 layout) — map the Qwen2-style flag,
     # refuse a checkpoint that would carry an o_proj bias
@@ -892,7 +901,7 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
         max_position_embeddings=get("max_position_embeddings"),
         rms_norm_eps=get("rms_norm_eps", 1e-5),
         rope_theta=get("rope_theta", 10000.0),
-        rope_scaling=(dict(scaling) if scaling else None),
+        rope_scaling=scaling,
         tie_word_embeddings=bool(get("tie_word_embeddings", False)),
         attention_bias=bool(get("attention_bias",
                                 get("model_type") == "qwen2")),

@@ -72,12 +72,18 @@ class LlamaMoEConfig(LlamaConfig):
 
 def load_hf_grouped_moe(model, hf_state_dict, *, attn_biases=False,
                         qk_norms=False, shared_expert=False,
-                        shared_gate=False, who="load_hf_moe"):
+                        shared_gate=False, who="load_hf_moe",
+                        mlp_key="mlp",
+                        expert_keys=("gate_proj", "up_proj", "down_proj")):
     """Shared HF→grouped-layout loader for the Qwen-MoE family shapes:
     embed/norm/lm_head, per-layer attention (optionally q/k/v biases or
     per-head q/k norms), router, per-expert projections packed via
     pack_hf_experts, optional (gated) shared expert. torch [out, in]
-    weights transpose to [in, out]."""
+    weights transpose to [in, out].
+
+    ``mlp_key``/``expert_keys`` rename the MoE block for checkpoints that
+    don't follow the Qwen layout (Mixtral: ``block_sparse_moe`` with
+    per-expert ``w1``/``w3``/``w2`` as gate/up/down)."""
     from .llama import _hf_to_np
 
     cfg = model.config
@@ -116,21 +122,22 @@ def load_hf_grouped_moe(model, hf_state_dict, *, attn_biases=False,
         mapped[f"{ours}.post_attention_layernorm.weight"] = take(
             f"{hf}.post_attention_layernorm.weight", False)
         # router: HF [E, h] -> gate_weight [h, E]
-        mapped[f"{ours}.mlp.gate_weight"] = take(f"{hf}.mlp.gate.weight",
-                                                 True)
+        mapped[f"{ours}.mlp.gate_weight"] = take(
+            f"{hf}.{mlp_key}.gate.weight", True)
         (mapped[f"{ours}.mlp.experts.w1"],
          mapped[f"{ours}.mlp.experts.b1"],
          mapped[f"{ours}.mlp.experts.w2"],
          mapped[f"{ours}.mlp.experts.b2"]) = pack_hf_experts(
-            take, f"{hf}.mlp", E, cfg.hidden_size)
+            take, f"{hf}.{mlp_key}", E, cfg.hidden_size,
+            expert_keys=expert_keys)
         if shared_expert:
             for proj in ("gate_proj", "up_proj", "down_proj"):
                 mapped[f"{ours}.mlp.shared_expert.{proj}.weight"] = take(
-                    f"{hf}.mlp.shared_expert.{proj}.weight", True)
+                    f"{hf}.{mlp_key}.shared_expert.{proj}.weight", True)
         if shared_gate:
             # shared gate: HF [1, h] -> [h, 1]
             mapped[f"{ours}.mlp.shared_gate_weight"] = take(
-                f"{hf}.mlp.shared_expert_gate.weight", True)
+                f"{hf}.{mlp_key}.shared_expert_gate.weight", True)
     leftovers = [k for k in hf_state_dict
                  if k not in consumed and k != "lm_head.weight"
                  and not k.endswith("rotary_emb.inv_freq")]
@@ -145,19 +152,23 @@ def load_hf_grouped_moe(model, hf_state_dict, *, attn_biases=False,
     return model
 
 
-def pack_hf_experts(take, hf_prefix, n_experts, hidden_size):
+def pack_hf_experts(take, hf_prefix, n_experts, hidden_size,
+                    expert_keys=("gate_proj", "up_proj", "down_proj")):
     """Stack a transformers checkpoint's per-expert gate/up/down weights
-    into the grouped [E, ...] layout (shared by the qwen2_moe and ernie45
-    loaders): returns (w1 fused gate||up, b1 zeros, w2, b2 zeros)."""
+    into the grouped [E, ...] layout (shared by the qwen2_moe, ernie45 and
+    mixtral loaders): returns (w1 fused gate||up, b1 zeros, w2, b2 zeros).
+    ``expert_keys`` names the (gate, up, down) projections in the HF
+    checkpoint (Mixtral: w1/w3/w2)."""
     import numpy as np
 
+    gate_k, up_k, down_k = expert_keys
     w1 = np.stack([
-        np.concatenate([take(f"{hf_prefix}.experts.{e}.gate_proj.weight",
+        np.concatenate([take(f"{hf_prefix}.experts.{e}.{gate_k}.weight",
                              True),
-                        take(f"{hf_prefix}.experts.{e}.up_proj.weight",
+                        take(f"{hf_prefix}.experts.{e}.{up_k}.weight",
                              True)], axis=-1)
         for e in range(n_experts)])
-    w2 = np.stack([take(f"{hf_prefix}.experts.{e}.down_proj.weight", True)
+    w2 = np.stack([take(f"{hf_prefix}.experts.{e}.{down_k}.weight", True)
                    for e in range(n_experts)])
     b1 = np.zeros((n_experts, 1, w1.shape[-1]), np.float32)
     b2 = np.zeros((n_experts, 1, hidden_size), np.float32)

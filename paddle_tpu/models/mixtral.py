@@ -1,0 +1,126 @@
+"""Mixtral (8x7B / 8x22B) sparse-MoE decoder family.
+
+Role parity: the reference's MoE training stack (SURVEY §2.7 EP/MoE;
+`/root/reference/python/paddle/incubate/distributed/models/moe/moe_layer.py`)
+serves exactly this class of all-sparse top-2 decoders; PaddleNLP ships a
+mixtral modeling on it. Here the family is the LlamaMoE trunk specialized
+the Mixtral way:
+
+- every layer sparse (``first_k_dense_replace=0``), NO shared expert;
+- top-2 of 8 routing with softmax over the selected logits — numerically
+  identical to softmax-over-all + top-k renormalization, i.e. the trunk's
+  ``norm_topk_prob=True`` path;
+- SwiGLU experts (HF w1=gate, w3=up, w2=down → the fused gate‖up grouped
+  GEMM layout), bias-free GQA attention, optional causal sliding window.
+
+``mixtral_from_hf`` converts a transformers ``MixtralForCausalLM`` via the
+shared grouped loader with the ``block_sparse_moe``/w1-w3-w2 key scheme.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .llama import mapped_rope_scaling
+from .llama_moe import (LlamaMoEConfig, LlamaMoEForCausalLM,
+                        load_hf_grouped_moe)
+
+
+@dataclasses.dataclass
+class MixtralConfig(LlamaMoEConfig):
+    # Mixtral-8x7B shape
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 32768
+    rope_theta: float = 1e6
+    rms_norm_eps: float = 1e-5
+    n_routed_experts: int = 8
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int = 14336
+    n_shared_experts: int = 0              # no shared expert
+    first_k_dense_replace: int = 0         # every layer is sparse
+    norm_topk_prob: bool = True            # softmax over the top-2 logits
+    # the released Mixtral-8x7B config.json ships 0.02 (the HF CLASS
+    # default is 0.001; the mapper below follows the class default)
+    router_aux_loss_coef: float = 0.02
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=256,
+                    dtype="float32", n_routed_experts=4,
+                    num_experts_per_tok=2, moe_intermediate_size=64,
+                    n_shared_experts=0, first_k_dense_replace=0)
+        base.update(kw)
+        return MixtralConfig(**base)
+
+
+class MixtralForCausalLM(LlamaMoEForCausalLM):
+    """Mixtral causal LM — all-sparse LlamaMoE decoder, no shared expert,
+    renormalized top-k combine."""
+
+    def __init__(self, config: MixtralConfig):
+        if config.n_shared_experts:
+            raise ValueError("Mixtral has no shared expert "
+                             "(n_shared_experts=0)")
+        if not config.norm_topk_prob:
+            raise ValueError(
+                "Mixtral softmaxes over the selected top-k logits "
+                "(norm_topk_prob=True)")
+        if config.first_k_dense_replace:
+            raise ValueError("Mixtral is sparse from layer 0 "
+                             "(first_k_dense_replace=0)")
+        super().__init__(config)
+
+
+def _hf_config_to_mixtral(hf_config, **overrides) -> MixtralConfig:
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    kw = dict(
+        rope_scaling=mapped_rope_scaling(get),
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        # dense intermediate mirrors the expert width (no dense layers
+        # exist, but LlamaMLP shapes derive from it)
+        intermediate_size=get("intermediate_size"),
+        moe_intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads"),
+        max_position_embeddings=get("max_position_embeddings"),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        rope_theta=get("rope_theta", 1e6),
+        sliding_window=get("sliding_window"),
+        tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+        n_routed_experts=get("num_local_experts"),
+        num_experts_per_tok=get("num_experts_per_tok"),
+        router_aux_loss_coef=get("router_aux_loss_coef", 0.001),
+    )
+    kw.update(overrides)
+    return MixtralConfig(**kw)
+
+
+def load_hf_mixtral(model: MixtralForCausalLM,
+                    hf_state_dict) -> MixtralForCausalLM:
+    """Pack a transformers MixtralForCausalLM state dict into the grouped
+    layout (block_sparse_moe router; per-expert w1/w3/w2 = gate/up/down)."""
+    return load_hf_grouped_moe(model, hf_state_dict,
+                               who="load_hf_mixtral",
+                               mlp_key="block_sparse_moe",
+                               expert_keys=("w1", "w3", "w2"))
+
+
+def mixtral_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a MixtralForCausalLM from a transformers model (or raw state
+    dict + config)."""
+    if hf_config is None:
+        hf_config = hf_model_or_state.config
+        state = hf_model_or_state.state_dict()
+    else:
+        state = hf_model_or_state
+    cfg = _hf_config_to_mixtral(hf_config, **config_overrides)
+    return load_hf_mixtral(MixtralForCausalLM(cfg), state)

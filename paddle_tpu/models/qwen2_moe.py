@@ -20,7 +20,7 @@ import dataclasses
 
 import numpy as np
 
-from .llama import validate_rope_scaling
+from .llama import mapped_rope_scaling
 from .llama_moe import (LlamaMoEConfig, LlamaMoEForCausalLM,
                         load_hf_grouped_moe)
 
@@ -89,12 +89,8 @@ def _hf_config_to_qwen2_moe(hf_config, **overrides) -> Qwen2MoeConfig:
         raise NotImplementedError(
             f"shared_expert_intermediate_size ({shared_inter}) must be a "
             f"multiple of moe_intermediate_size ({moe_inter})")
-    scaling = get("rope_scaling")
-    if scaling not in (None, {}):
-        validate_rope_scaling(dict(scaling),
-                              max_position=get("max_position_embeddings"))
     kw = dict(
-        rope_scaling=(dict(scaling) if scaling else None),
+        rope_scaling=mapped_rope_scaling(get),
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
         intermediate_size=get("intermediate_size"),

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 
-from .llama import validate_rope_scaling
+from .llama import mapped_rope_scaling
 from .llama_moe import (LlamaMoEConfig, LlamaMoEForCausalLM,
                         load_hf_grouped_moe)
 
@@ -74,13 +74,10 @@ def _hf_config_to_qwen3_moe(hf_config, **overrides) -> Qwen3MoeConfig:
             "qwen3_moe_from_hf: mixed sparse/dense layer patterns "
             "(decoder_sparse_step != 1 or mlp_only_layers) are not "
             "representable; this build supports uniformly-sparse stacks")
-    scaling = get("rope_scaling")
-    if scaling not in (None, {}):
+    kw = dict(
         # a yarn-scaled long-context checkpoint is config-only — validate
         # and MAP it rather than silently building plain-RoPE tables
-        validate_rope_scaling(dict(scaling),
-                              max_position=get("max_position_embeddings"))
-    kw = dict(
+        rope_scaling=mapped_rope_scaling(get),
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
         intermediate_size=get("intermediate_size"),
@@ -91,7 +88,6 @@ def _hf_config_to_qwen3_moe(hf_config, **overrides) -> Qwen3MoeConfig:
         max_position_embeddings=get("max_position_embeddings"),
         rms_norm_eps=get("rms_norm_eps", 1e-6),
         rope_theta=get("rope_theta", 1e6),
-        rope_scaling=(dict(scaling) if scaling else None),
         tie_word_embeddings=bool(get("tie_word_embeddings", False)),
         n_routed_experts=get("num_experts"),
         num_experts_per_tok=get("num_experts_per_tok"),

@@ -9,7 +9,7 @@ import pytest
 import paddle_tpu as paddle
 
 FAMILIES = ["llama", "qwen2", "qwen3", "mistral", "gpt2", "qwen2_moe",
-            "deepseek"]
+            "deepseek", "mixtral"]
 
 
 def _build(name):
@@ -49,6 +49,11 @@ def _build(name):
 
         return DeepseekV2ForCausalLM(
             DeepseekV2Config.tiny_mla(num_hidden_layers=2))
+    if name == "mixtral":
+        from paddle_tpu.models.mixtral import (MixtralConfig,
+                                               MixtralForCausalLM)
+
+        return MixtralForCausalLM(MixtralConfig.tiny(num_hidden_layers=2))
     raise AssertionError(name)
 
 
