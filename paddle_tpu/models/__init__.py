@@ -39,6 +39,10 @@ _LAZY = {
     "Qwen3Config": ("qwen3", "Qwen3Config"),
     "Qwen3ForCausalLM": ("qwen3", "Qwen3ForCausalLM"),
     "qwen3_from_hf": ("qwen3", "qwen3_from_hf"),
+    "gemma": ("gemma", None),
+    "GemmaConfig": ("gemma", "GemmaConfig"),
+    "GemmaForCausalLM": ("gemma", "GemmaForCausalLM"),
+    "gemma_from_hf": ("gemma", "gemma_from_hf"),
     "mixtral": ("mixtral", None),
     "MixtralConfig": ("mixtral", "MixtralConfig"),
     "MixtralForCausalLM": ("mixtral", "MixtralForCausalLM"),

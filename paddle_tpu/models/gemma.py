@@ -1,0 +1,98 @@
+"""Gemma decoder family (Gemma-2B / 7B).
+
+Role parity: the reference's decoder zoo trains pre-norm RoPE
+architectures on the fleet hybrid stack (SURVEY §2.7 CS4); Gemma is that
+recipe with three signature deviations, each a LlamaConfig knob so the
+whole machinery (training, hybrid parallel, caches, serving, beam, LoRA)
+is the already-tested Llama path:
+
+- ``hidden_act="gelu_pytorch_tanh"``: GeGLU MLP (tanh-gelu gate instead of
+  silu);
+- ``rms_norm_offset=True``: norm weight parameterized as (1 + w), w
+  zeros-init — the checkpoint stores the delta from identity;
+- ``scale_embeddings=True``: embedding output multiplied by
+  sqrt(hidden_size) (the normalizer rounds to the compute dtype first).
+
+Plus head_dim 256 decoupled from hidden/heads (the Qwen3 knob) and tied
+embeddings always. ``gemma_from_hf`` converts transformers checkpoints —
+the key layout is exactly Llama's, so the mechanical loader is shared.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .llama import LlamaConfig, LlamaForCausalLM, _from_hf
+
+
+@dataclasses.dataclass
+class GemmaConfig(LlamaConfig):
+    # Gemma-7B shape
+    vocab_size: int = 256000
+    hidden_size: int = 3072
+    intermediate_size: int = 24576
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    head_dim: Optional[int] = 256
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = True
+    hidden_act: str = "gelu_pytorch_tanh"
+    rms_norm_offset: bool = True
+    scale_embeddings: bool = True
+
+    @staticmethod
+    def gemma_2b(**kw):
+        # 2B is the MQA member: 8 heads over 1 kv head, head_dim 256
+        base = dict(hidden_size=2048, intermediate_size=16384,
+                    num_hidden_layers=18, num_attention_heads=8,
+                    num_key_value_heads=1)
+        base.update(kw)
+        return GemmaConfig(**base)
+
+    @staticmethod
+    def tiny(**kw):
+        # head_dim 32 != hidden/heads (16): the decoupling stays exercised
+        base = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, head_dim=32,
+                    max_position_embeddings=256, dtype="float32")
+        base.update(kw)
+        return GemmaConfig(**base)
+
+
+class GemmaForCausalLM(LlamaForCausalLM):
+    """Gemma causal LM — Llama decoder with GeGLU, (1+w) norms, scaled
+    embeddings, and a tied head."""
+
+    def __init__(self, config: GemmaConfig):
+        if config.hidden_act != "gelu_pytorch_tanh":
+            raise ValueError("Gemma uses hidden_act='gelu_pytorch_tanh'")
+        if not config.rms_norm_offset:
+            raise ValueError("Gemma norms are (1 + w)-parameterized "
+                             "(rms_norm_offset=True)")
+        if not config.scale_embeddings:
+            raise ValueError("Gemma scales embeddings by sqrt(hidden_size) "
+                             "(scale_embeddings=True)")
+        if not config.tie_word_embeddings:
+            raise ValueError("Gemma ties the lm head to the embedding")
+        super().__init__(config)
+
+
+def gemma_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a GemmaForCausalLM from a transformers Gemma model (or a raw
+    state dict + config)."""
+    src = hf_config if hf_config is not None else hf_model_or_state.config
+    get = (src.get if isinstance(src, dict)
+           else lambda k, d=None: getattr(src, k, d))
+    # HF Gemma carries the real activation in hidden_activation (modeling
+    # falls back to gelu_pytorch_tanh when unset); hidden_act in those
+    # configs is vestigial
+    config_overrides.setdefault(
+        "hidden_act", get("hidden_activation") or "gelu_pytorch_tanh")
+    config_overrides.setdefault("rms_norm_offset", True)
+    config_overrides.setdefault("scale_embeddings", True)
+    return _from_hf(GemmaConfig, GemmaForCausalLM, hf_model_or_state,
+                    hf_config, **config_overrides)

@@ -70,6 +70,15 @@ class LlamaConfig:
     sep_mode: str = "ring"
     sequence_parallel: bool = False
     recompute: bool = False
+    # MLP gating activation: "silu" (SwiGLU — Llama/Qwen/Mistral) or
+    # "gelu_pytorch_tanh" (GeGLU — Gemma)
+    hidden_act: str = "silu"
+    # RMSNorm weight parameterized as (1 + w), zeros-init (Gemma): the
+    # checkpoint stores the DELTA from identity, and norm output is
+    # x_normed * (1 + w)
+    rms_norm_offset: bool = False
+    # multiply embedding output by sqrt(hidden_size) (Gemma input scaling)
+    scale_embeddings: bool = False
     # chunk the lm-head matmul + CE loss over token chunks (ops.fused_loss):
     # the [tokens, vocab] logits tensor never materializes — required to fit
     # large-vocab training shapes in one chip's HBM. forward(labels=...)
@@ -81,6 +90,10 @@ class LlamaConfig:
         if self.sep_mode not in ("ring", "ulysses", "allgather"):
             raise ValueError(
                 f"sep_mode must be 'ring', 'ulysses' or 'allgather', got {self.sep_mode!r}")
+        if self.hidden_act not in ("silu", "gelu_pytorch_tanh"):
+            raise NotImplementedError(
+                f"hidden_act must be 'silu' or 'gelu_pytorch_tanh', "
+                f"got {self.hidden_act!r}")
 
     @staticmethod
     def llama3_8b(**kw):
@@ -282,15 +295,25 @@ class LlamaRMSNorm(Layer):
         super().__init__(dtype=config.dtype)
         self.hidden_size = config.hidden_size
         self.variance_epsilon = config.rms_norm_eps
-        self.weight = self.create_parameter([config.hidden_size],
-                                            default_initializer=Constant(1.0),
-                                            dtype=config.dtype)
+        # Gemma parameterizes the norm weight as (1 + w) with w zeros-init
+        # (identity at init either way); effective_weight() is what every
+        # kernel call must consume
+        self.offset = (1.0 if getattr(config, "rms_norm_offset", False)
+                       else 0.0)
+        self.weight = self.create_parameter(
+            [config.hidden_size],
+            default_initializer=Constant(0.0 if self.offset else 1.0),
+            dtype=config.dtype)
+
+    def effective_weight(self):
+        return self.weight + self.offset if self.offset else self.weight
 
     def forward(self, x):
         from ..ops.pallas import fused_norm
 
         eps = self.variance_epsilon
-        return apply("rms_norm", lambda a, w: fused_norm.rms_norm(a, w, eps), x, self.weight)
+        return apply("rms_norm", lambda a, w: fused_norm.rms_norm(a, w, eps),
+                     x, self.effective_weight())
 
 
 def _mp_enabled():
@@ -329,6 +352,17 @@ def _make_embedding(config: LlamaConfig):
             (config.vocab_size, config.hidden_size), jnp.float32)
         .astype(emb.weight.dtype))
     return emb
+
+
+def _scale_embed(hidden, config):
+    """Gemma input scaling: hidden * sqrt(hidden_size), with the scalar
+    first rounded to the compute dtype (HF casts the normalizer to the
+    hidden dtype before multiplying — bf16 parity depends on it)."""
+    if not getattr(config, "scale_embeddings", False):
+        return hidden
+    dt = jax.dtypes.canonicalize_dtype(config.dtype)
+    scale = float(np.asarray(math.sqrt(config.hidden_size)).astype(dt))
+    return hidden * scale
 
 
 def _make_lm_head(config: LlamaConfig):
@@ -487,10 +521,12 @@ class LlamaAttention(Layer):
 
 
 class LlamaMLP(Layer):
-    """SwiGLU MLP."""
+    """Gated MLP: SwiGLU (silu gate — Llama) or GeGLU (tanh-gelu gate —
+    Gemma), selected by ``config.hidden_act``."""
 
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
+        self.hidden_act = getattr(config, "hidden_act", "silu")
         self.gate_proj = _make_linear(config.hidden_size, config.intermediate_size,
                                       column=True, config=config)
         self.up_proj = _make_linear(config.hidden_size, config.intermediate_size,
@@ -501,7 +537,12 @@ class LlamaMLP(Layer):
     def forward(self, x):
         gate = self.gate_proj(x)
         up = self.up_proj(x)
-        act = apply("swiglu", lambda g, u: jax.nn.silu(g) * u, gate, up)
+        if self.hidden_act == "gelu_pytorch_tanh":
+            act = apply("geglu",
+                        lambda g, u: jax.nn.gelu(g, approximate=True) * u,
+                        gate, up)
+        else:
+            act = apply("swiglu", lambda g, u: jax.nn.silu(g) * u, gate, up)
         return self.down_proj(act)
 
 
@@ -530,7 +571,8 @@ class LlamaDecoderLayer(Layer):
         hidden_states, residual = apply(
             "add_rms_norm",
             lambda a, r, w: fused_norm.add_rms_norm(a, r, w, eps),
-            hidden_states, residual, self.post_attention_layernorm.weight)
+            hidden_states, residual,
+            self.post_attention_layernorm.effective_weight())
         hidden_states = residual + self.mlp(hidden_states)
         if kv_cache is not None:
             return hidden_states, kv_cache
@@ -576,7 +618,7 @@ class LlamaModel(Layer):
         s = input_ids.shape[1]
         cos, sin = self._rope(s)
         hidden = self.embed_tokens(input_ids)
-        hidden = hidden.astype(self.config.dtype)
+        hidden = _scale_embed(hidden.astype(self.config.dtype), self.config)
         for layer in self.layers:
             hidden = layer(hidden, cos, sin, attention_mask)
         if return_prenorm:
@@ -593,7 +635,7 @@ class LlamaModel(Layer):
         speculative draft consumes the pre-norm stream)."""
         cos, sin = self._rope(rope_len)
         hidden = self.embed_tokens(input_ids)
-        hidden = hidden.astype(self.config.dtype)
+        hidden = _scale_embed(hidden.astype(self.config.dtype), self.config)
         new_caches = []
         for layer, cache in zip(self.layers, kv_caches):
             inner = getattr(layer, "inner", layer)  # unwrap RecomputeLayer
@@ -722,7 +764,8 @@ class LlamaEmbeddingPipe(Layer):
         self.embed_tokens = _make_embedding(config)
 
     def forward(self, input_ids):
-        return self.embed_tokens(input_ids).astype(self.config.dtype)
+        return _scale_embed(self.embed_tokens(input_ids)
+                            .astype(self.config.dtype), self.config)
 
 
 def _tied_head_forward(layer: "LlamaEmbeddingPipe", hidden):
@@ -869,6 +912,17 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
     """Map a transformers LlamaConfig (object or dict) onto LlamaConfig."""
     get = (hf_config.get if isinstance(hf_config, dict)
            else lambda k, d=None: getattr(hf_config, k, d))
+    # a Gemma checkpoint has EXACTLY Llama's key layout, so loading it
+    # through the plain-llama mapper would succeed and silently compute
+    # garbage ((1+w)-delta norms read as full weights, unscaled embeddings,
+    # silu instead of geglu) — refuse unless the Gemma knobs arrive via
+    # overrides (gemma_from_hf sets them)
+    if (str(get("model_type", "")).startswith("gemma")
+            and "rms_norm_offset" not in overrides):
+        raise NotImplementedError(
+            "this checkpoint is a Gemma-family model — convert it with "
+            "gemma_from_hf (llama_from_hf would misread its (1+w) norm "
+            "deltas and unscaled embeddings)")
     # type + parameter gate at CONVERT time (yarn math errors included)
     scaling = mapped_rope_scaling(get)
     # HF Llama's attention_bias puts bias on q/k/v AND o; this build only

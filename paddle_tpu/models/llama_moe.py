@@ -376,7 +376,8 @@ class LlamaMoEDecoderLayer(Layer):
         hidden_states, residual = apply(
             "add_rms_norm",
             lambda a, r, w: fused_norm.add_rms_norm(a, r, w, eps),
-            hidden_states, residual, self.post_attention_layernorm.weight)
+            hidden_states, residual,
+            self.post_attention_layernorm.effective_weight())
         hidden_states = residual + self.mlp(hidden_states)
         if kv_cache is not None:
             return hidden_states, kv_cache

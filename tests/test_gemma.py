@@ -1,0 +1,132 @@
+"""Gemma family: the three signature knobs (GeGLU, (1+w) norms, scaled
+embeddings), training, HF conversion + logits/greedy parity against
+transformers (7B-style GQA and 2B-style MQA tiny shapes)."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gemma import (GemmaConfig, GemmaForCausalLM,
+                                     gemma_from_hf)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def test_construction_and_knobs():
+    paddle.seed(0)
+    cfg = GemmaConfig.tiny()
+    m = GemmaForCausalLM(cfg)
+    # tied head, zeros-init norm weights (identity through the (1+w) form)
+    assert m.lm_head is None
+    norm = m.llama.layers[0].input_layernorm
+    assert norm.offset == 1.0
+    np.testing.assert_array_equal(norm.weight.numpy(),
+                                  np.zeros(cfg.hidden_size, np.float32))
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 12)))
+    loss, _ = m(ids, labels=ids)
+    assert np.isfinite(float(loss.numpy()))
+    with pytest.raises(ValueError, match="gelu_pytorch_tanh"):
+        GemmaForCausalLM(dataclasses.replace(cfg, hidden_act="silu"))
+    with pytest.raises(ValueError, match="rms_norm_offset"):
+        GemmaForCausalLM(dataclasses.replace(cfg, rms_norm_offset=False))
+    with pytest.raises(ValueError, match="sqrt"):
+        GemmaForCausalLM(dataclasses.replace(cfg, scale_embeddings=False))
+    with pytest.raises(NotImplementedError, match="hidden_act"):
+        dataclasses.replace(cfg, hidden_act="relu")
+
+
+def test_scale_embeddings_matters():
+    """The sqrt(hidden) input scaling must actually change the logits."""
+    paddle.seed(1)
+    m = GemmaForCausalLM(GemmaConfig.tiny())
+    ids = paddle.to_tensor(np.random.RandomState(1).randint(0, 512, (1, 8)))
+    a = m(ids).numpy()
+    m.config = dataclasses.replace(m.config, scale_embeddings=False)
+    m.llama.config = m.config
+    b = m(ids).numpy()
+    assert not np.allclose(a, b)
+
+
+def test_trains():
+    from paddle_tpu import optimizer as opt
+
+    paddle.seed(2)
+    m = GemmaForCausalLM(GemmaConfig.tiny())
+
+    def loss_fn(mm, x, y):
+        loss, _ = mm(x, labels=y)
+        return loss
+
+    step = paddle.jit.train_step(m, loss_fn,
+                                 opt.AdamW(1e-2, parameters=m.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 16)))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 512, (2, 16)))
+    losses = [float(step(x, y).numpy()) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+
+def _tiny_hf(mqa=False):
+    from transformers import GemmaConfig as HFConfig
+    from transformers import GemmaForCausalLM as HFGemma
+
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=1 if mqa else 2, head_dim=32,
+        max_position_embeddings=128, rms_norm_eps=1e-6,
+        rope_theta=10000.0, hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True, attn_implementation="eager")
+    return HFGemma(hf_cfg).eval()
+
+
+def test_llama_from_hf_refuses_gemma_checkpoints():
+    """A Gemma checkpoint has exactly Llama's key layout — the plain
+    mapper must refuse it instead of silently building a silu/no-offset
+    model that computes garbage."""
+    from paddle_tpu.models.llama import llama_from_hf
+
+    hf = _tiny_hf()
+    with pytest.raises(NotImplementedError, match="gemma_from_hf"):
+        llama_from_hf(hf, dtype="float32")
+
+
+def test_moe_trunk_honors_norm_offset():
+    """The MoE decoder's fused add_rms_norm must consume effective_weight()
+    — with rms_norm_offset=True its zeros-init weight means (1+0)=identity,
+    not a near-zero norm that collapses post-attention activations."""
+    from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    paddle.seed(7)
+    cfg = MixtralConfig.tiny(num_hidden_layers=1, rms_norm_offset=True)
+    m = MixtralForCausalLM(cfg)
+    norm = m.llama.layers[0].post_attention_layernorm
+    np.testing.assert_array_equal(norm.weight.numpy(),
+                                  np.zeros(cfg.hidden_size, np.float32))
+    ids = paddle.to_tensor(np.random.RandomState(8).randint(0, 512, (1, 8)))
+    logits = m(ids).numpy()
+    # identity norms at init: the logits must be in a healthy range, not
+    # collapsed toward the near-zero scale a raw-w read would produce
+    assert np.isfinite(logits).all()
+    assert np.abs(logits).max() > 1e-2
+
+
+@pytest.mark.parametrize("mqa", [False, True], ids=["gqa", "mqa"])
+def test_logits_and_generate_match_transformers(mqa):
+    hf = _tiny_hf(mqa=mqa)
+    ours = gemma_from_hf(hf, dtype="float32", use_flash_attention=False)
+    assert ours.config.rms_norm_offset and ours.config.scale_embeddings
+    assert ours.config.hidden_act == "gelu_pytorch_tanh"
+    assert ours.config.head_dim == 32
+    ids = np.random.RandomState(0).randint(0, 128, (2, 9))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+    with torch.no_grad():
+        gref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                           do_sample=False).numpy()[:, 9:]
+    ggot = ours.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(ggot, gref)

@@ -9,7 +9,7 @@ import pytest
 import paddle_tpu as paddle
 
 FAMILIES = ["llama", "qwen2", "qwen3", "mistral", "gpt2", "qwen2_moe",
-            "deepseek", "mixtral"]
+            "deepseek", "mixtral", "gemma"]
 
 
 def _build(name):
@@ -54,6 +54,11 @@ def _build(name):
                                                MixtralForCausalLM)
 
         return MixtralForCausalLM(MixtralConfig.tiny(num_hidden_layers=2))
+    if name == "gemma":
+        from paddle_tpu.models.gemma import GemmaConfig, GemmaForCausalLM
+
+        # GeGLU + (1+w) norms + scaled embeddings + tied head on every path
+        return GemmaForCausalLM(GemmaConfig.tiny(num_hidden_layers=2))
     raise AssertionError(name)
 
 
