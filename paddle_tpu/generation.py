@@ -55,7 +55,7 @@ def _rope_rows(x, cos, sin, row_pos):
 
 def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
                      row_pos=None, use_flash=False, interpret=False,
-                     prefill=False, window=None):
+                     prefill=False, window=None, softcap=None):
     """RoPE + cache write + masked GQA attention against a dense buffer.
 
     q [B,S,H,D]; k/v [B,S,hk,D]; cos/sin [>=max_len, D];
@@ -120,6 +120,9 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
     qg = q.reshape(B, S, hk, g, D)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg.astype(jnp.float32),
                         k_buf.astype(jnp.float32)) * scale
+    if softcap is not None:
+        # Gemma2 tanh soft cap, applied before masking (HF order)
+        scores = softcap * jnp.tanh(scores / softcap)
     T = k_buf.shape[1]
     t_idx = jnp.arange(T)
     s_idx = jnp.arange(S)
@@ -864,7 +867,12 @@ class _PrefillStep:
     logit. Eager prefill costs one device dispatch per op per layer; this is
     the serving path's second half of the TrainStep pattern."""
 
-    def __init__(self, model, max_len, ragged):
+    def __init__(self, model, max_len, ragged, rope_len=None):
+        # rope_len decouples the cos/sin table length from the cache
+        # length: the serving engine prefills into a BUCKET-sized cache but
+        # provisions rope at its max_len, so length-keyed rope regimes
+        # (Phi-3 longrope short/long factors) match its decode program
+        rope_len = max_len if rope_len is None else rope_len
         self._model = model
 
         def pure(state, ids, lengths, pad_mask):
@@ -874,7 +882,7 @@ class _PrefillStep:
                     model, B, max_len,
                     allowed=pad_mask if ragged else None)
                 hidden, caches = model.llama.forward_cached(
-                    wrap(ids), caches, rope_len=max_len)
+                    wrap(ids), caches, rope_len=rope_len)
                 h_last = jnp.take_along_axis(
                     unwrap(hidden),
                     (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)
@@ -909,10 +917,12 @@ def _memoized_step(model, attr, key, factory, maxsize=None):
     return step
 
 
-def _get_prefill_step(model, max_len, ragged):
+def _get_prefill_step(model, max_len, ragged, rope_len=None):
     # max_len varies per request: bound the cache (oldest-evicted)
-    return _memoized_step(model, "_prefill_steps", (max_len, ragged),
-                          lambda: _PrefillStep(model, max_len, ragged),
+    return _memoized_step(model, "_prefill_steps",
+                          (max_len, ragged, rope_len),
+                          lambda: _PrefillStep(model, max_len, ragged,
+                                               rope_len=rope_len),
                           maxsize=16)
 
 

@@ -43,10 +43,18 @@ _LAZY = {
     "GemmaConfig": ("gemma", "GemmaConfig"),
     "GemmaForCausalLM": ("gemma", "GemmaForCausalLM"),
     "gemma_from_hf": ("gemma", "gemma_from_hf"),
+    "gemma2": ("gemma2", None),
+    "Gemma2Config": ("gemma2", "Gemma2Config"),
+    "Gemma2ForCausalLM": ("gemma2", "Gemma2ForCausalLM"),
+    "gemma2_from_hf": ("gemma2", "gemma2_from_hf"),
     "mixtral": ("mixtral", None),
     "MixtralConfig": ("mixtral", "MixtralConfig"),
     "MixtralForCausalLM": ("mixtral", "MixtralForCausalLM"),
     "mixtral_from_hf": ("mixtral", "mixtral_from_hf"),
+    "phi3": ("phi3", None),
+    "Phi3Config": ("phi3", "Phi3Config"),
+    "Phi3ForCausalLM": ("phi3", "Phi3ForCausalLM"),
+    "phi3_from_hf": ("phi3", "phi3_from_hf"),
     "qwen2_moe": ("qwen2_moe", None),
     "Qwen2MoeConfig": ("qwen2_moe", "Qwen2MoeConfig"),
     "Qwen2MoeForCausalLM": ("qwen2_moe", "Qwen2MoeForCausalLM"),

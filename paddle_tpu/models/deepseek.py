@@ -471,7 +471,8 @@ class DeepseekV2Model(LlamaModel):
     ``empty_cache_layer``)."""
 
     def __init__(self, config: DeepseekV2Config):
-        base_cfg = dataclasses.replace(config, num_hidden_layers=0)
+        base_cfg = dataclasses.replace(config, num_hidden_layers=0,
+                                       layer_types=None)
         super().__init__(base_cfg)
         self.config = config
         # NOT RecomputeLayer-wrapped (matches LlamaMoEModel): the aux-loss

@@ -1,0 +1,155 @@
+"""Gemma2 decoder family (2B / 9B / 27B).
+
+Gemma's three knobs (GeGLU, (1+w) norms, scaled embeddings — gemma.py)
+plus the four Gemma2 deviations, each expressed at the trunk level so the
+cached/serving machinery still applies:
+
+- sandwich norms: post-attention norm on the attention OUTPUT before the
+  residual add, and a pre/post pair around the MLP (own decoder layer via
+  the ``_make_decoder_layer`` hook);
+- ``query_pre_attn_scalar``: softmax scale folded into q after projection
+  (LlamaAttention.q_premul — exact on every path since RoPE is linear);
+- tanh logit soft caps: ``attn_logit_softcapping`` on attention scores
+  (dense paths only — flash/paged/CP refuse loudly) and
+  ``final_logit_softcapping`` on the lm head (one override covers
+  training loss, generate, beam, and speculative paths);
+- alternating sliding/full attention via the trunk ``layer_types``
+  schedule.
+
+``gemma2_from_hf`` converts transformers checkpoints (Llama key layout +
+the two extra per-layer norms).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..nn.layer import Layer
+from .gemma import GemmaConfig
+from .llama import (LlamaAttention, LlamaForCausalLM, LlamaMLP, LlamaModel,
+                    LlamaRMSNorm, _from_hf, layer_window)
+
+
+@dataclasses.dataclass
+class Gemma2Config(GemmaConfig):
+    # Gemma2-9B shape
+    vocab_size: int = 256000
+    hidden_size: int = 3584
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 42
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 8
+    head_dim: Optional[int] = 256
+    query_pre_attn_scalar: Optional[float] = 256.0
+    attn_logit_softcapping: Optional[float] = 50.0
+    final_logit_softcapping: Optional[float] = 30.0
+    sliding_window: Optional[int] = 4096
+
+    def __post_init__(self):
+        if self.layer_types is None and self.sliding_window is not None:
+            # the Gemma2 alternation: even layers sliding, odd layers full
+            self.layer_types = tuple(
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(self.num_hidden_layers))
+        super().__post_init__()
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, head_dim=32,
+                    query_pre_attn_scalar=64.0, sliding_window=16,
+                    max_position_embeddings=256, dtype="float32")
+        base.update(kw)
+        return Gemma2Config(**base)
+
+
+class Gemma2DecoderLayer(Layer):
+    """Sandwich-norm decoder block: norm(attn) before the residual add and
+    a pre/post norm pair around the MLP (four (1+w) RMSNorms per layer)."""
+
+    def __init__(self, config: Gemma2Config):
+        super().__init__(dtype=config.dtype)
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = LlamaRMSNorm(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config)
+        self.pre_feedforward_layernorm = LlamaRMSNorm(config)
+        self.post_feedforward_layernorm = LlamaRMSNorm(config)
+
+    def forward(self, hidden_states, cos, sin, attention_mask=None,
+                kv_cache=None):
+        residual = hidden_states
+        hidden_states = self.input_layernorm(hidden_states)
+        if kv_cache is not None:
+            hidden_states, kv_cache = self.self_attn(
+                hidden_states, cos, sin, attention_mask, kv_cache)
+        else:
+            hidden_states = self.self_attn(hidden_states, cos, sin,
+                                           attention_mask)
+        hidden_states = self.post_attention_layernorm(hidden_states)
+        hidden_states = residual + hidden_states
+
+        residual = hidden_states
+        hidden_states = self.pre_feedforward_layernorm(hidden_states)
+        hidden_states = self.mlp(hidden_states)
+        hidden_states = self.post_feedforward_layernorm(hidden_states)
+        hidden_states = residual + hidden_states
+        if kv_cache is not None:
+            return hidden_states, kv_cache
+        return hidden_states
+
+
+class Gemma2Model(LlamaModel):
+    @staticmethod
+    def _make_decoder_layer(config, layer_idx):
+        layer = Gemma2DecoderLayer(config)
+        layer.self_attn.window = layer_window(config, layer_idx)
+        return layer
+
+
+class Gemma2ForCausalLM(LlamaForCausalLM):
+    """Gemma2 causal LM — sandwich-norm trunk; the final-logit soft cap
+    is a base-trunk behavior (LlamaForCausalLM.lm_head_logits applies
+    ``final_logit_softcapping`` for every family)."""
+
+    model_cls = Gemma2Model
+
+    def __init__(self, config: Gemma2Config):
+        if config.hidden_act != "gelu_pytorch_tanh":
+            raise ValueError("Gemma2 uses hidden_act='gelu_pytorch_tanh'")
+        if not (config.rms_norm_offset and config.scale_embeddings):
+            raise ValueError("Gemma2 needs rms_norm_offset=True and "
+                             "scale_embeddings=True (the Gemma base knobs)")
+        if not config.tie_word_embeddings:
+            raise ValueError("Gemma2 ties the lm head to the embedding")
+        super().__init__(config)
+
+
+def gemma2_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a Gemma2ForCausalLM from a transformers Gemma2 model (or a
+    raw state dict + config)."""
+    src = hf_config if hf_config is not None else hf_model_or_state.config
+    get = (src.get if isinstance(src, dict)
+           else lambda k, d=None: getattr(src, k, d))
+    config_overrides.setdefault(
+        "hidden_act", get("hidden_activation") or "gelu_pytorch_tanh")
+    config_overrides.setdefault("rms_norm_offset", True)
+    config_overrides.setdefault("scale_embeddings", True)
+    config_overrides.setdefault("query_pre_attn_scalar",
+                                get("query_pre_attn_scalar"))
+    config_overrides.setdefault("attn_logit_softcapping",
+                                get("attn_logit_softcapping"))
+    config_overrides.setdefault("final_logit_softcapping",
+                                get("final_logit_softcapping"))
+    # the base mapper's window logic is mistral-keyed; Gemma2's schedule
+    # arrives as the trunk layer_types + uniform window
+    config_overrides.setdefault("sliding_window", get("sliding_window"))
+    lt = get("layer_types")
+    config_overrides.setdefault("layer_types",
+                                tuple(lt) if lt is not None else None)
+    return _from_hf(Gemma2Config, Gemma2ForCausalLM, hf_model_or_state,
+                    hf_config,
+                    extra_layer_norms=("pre_feedforward_layernorm",
+                                       "post_feedforward_layernorm"),
+                    **config_overrides)

@@ -79,6 +79,20 @@ class LlamaConfig:
     rms_norm_offset: bool = False
     # multiply embedding output by sqrt(hidden_size) (Gemma input scaling)
     scale_embeddings: bool = False
+    # attention softmax scale numerator (Gemma2): scale becomes
+    # query_pre_attn_scalar**-0.5 instead of head_dim**-0.5. Implemented
+    # by pre-scaling q after projection (RoPE is linear, so this is exact
+    # on every attention path including the Pallas kernels)
+    query_pre_attn_scalar: Optional[float] = None
+    # tanh soft cap on attention logits (Gemma2): cap*tanh(scores/cap).
+    # Only the dense attention paths implement it — flash/paged refuse
+    attn_logit_softcapping: Optional[float] = None
+    # tanh soft cap on the lm-head logits (Gemma2)
+    final_logit_softcapping: Optional[float] = None
+    # per-layer attention kind (Gemma2 alternation): tuple of
+    # "sliding_attention"/"full_attention", one per layer — sliding layers
+    # use ``sliding_window``, full layers ignore it. None = uniform.
+    layer_types: Optional[tuple] = None
     # chunk the lm-head matmul + CE loss over token chunks (ops.fused_loss):
     # the [tokens, vocab] logits tensor never materializes — required to fit
     # large-vocab training shapes in one chip's HBM. forward(labels=...)
@@ -94,6 +108,26 @@ class LlamaConfig:
             raise NotImplementedError(
                 f"hidden_act must be 'silu' or 'gelu_pytorch_tanh', "
                 f"got {self.hidden_act!r}")
+        if self.final_logit_softcapping and self.fuse_linear_cross_entropy:
+            raise NotImplementedError(
+                "final_logit_softcapping cannot combine with "
+                "fuse_linear_cross_entropy (the chunked-CE scan computes "
+                "uncapped logits)")
+        if self.layer_types is not None:
+            self.layer_types = tuple(self.layer_types)
+            if len(self.layer_types) != self.num_hidden_layers:
+                raise ValueError(
+                    f"layer_types has {len(self.layer_types)} entries for "
+                    f"{self.num_hidden_layers} layers")
+            bad = set(self.layer_types) - {"sliding_attention",
+                                           "full_attention"}
+            if bad:
+                raise ValueError(f"unknown layer_types entries: {bad}")
+            if ("sliding_attention" in self.layer_types
+                    and self.sliding_window is None):
+                raise ValueError(
+                    "layer_types requests sliding_attention but "
+                    "sliding_window is not set")
 
     @staticmethod
     def llama3_8b(**kw):
@@ -115,6 +149,17 @@ class LlamaConfig:
         return LlamaConfig(**base)
 
 
+def layer_window(config, layer_idx: int):
+    """Layer ``layer_idx``'s sliding window: the uniform config value, or
+    the per-layer schedule when ``layer_types`` is set (Gemma2 alternates
+    sliding/full)."""
+    lt = getattr(config, "layer_types", None)
+    if not lt:
+        return config.sliding_window
+    return (config.sliding_window if lt[layer_idx] == "sliding_attention"
+            else None)
+
+
 def head_dim_of(config) -> int:
     """Attention head width — ``config.head_dim`` when set (Qwen3 decouples
     it from hidden/heads), else the classic quotient. The ONE derivation
@@ -131,7 +176,7 @@ def _width_norm(config, width):
     return LlamaRMSNorm(sub)
 
 
-SUPPORTED_ROPE_SCALING = ("llama3", "linear", "yarn")
+SUPPORTED_ROPE_SCALING = ("llama3", "linear", "yarn", "longrope")
 
 
 def _rope_type(scaling: Optional[dict]):
@@ -171,6 +216,54 @@ def validate_rope_scaling(scaling: Optional[dict],
     if rope_type == "yarn":
         # dummy dims: only the parameter handling can raise
         _yarn_params(scaling, 64, 10000.0, fallback_orig=max_position)
+    if rope_type == "longrope":
+        n_short = len(scaling.get("short_factor") or ())
+        n_long = len(scaling.get("long_factor") or ())
+        if not n_short or not n_long or n_short != n_long:
+            raise ValueError(
+                "longrope rope_scaling needs short_factor and long_factor "
+                f"lists of equal length (got {n_short}/{n_long})")
+        if not (scaling.get("original_max_position_embeddings")
+                or max_position):
+            raise ValueError(
+                "longrope rope_scaling needs "
+                "original_max_position_embeddings (or a max_position "
+                "fallback) to pick between the factor lists")
+
+
+def _longrope_params(scaling: dict, dim: int, base: float, seq_len: int,
+                     max_position: Optional[int] = None):
+    """(inv_freq [dim//2], attention_factor) per transformers
+    modeling_rope_utils._compute_longrope_parameters (Phi-3 LongRoPE):
+    per-dim rescaled frequencies — the short_factor list within the
+    pretrained window, the long_factor list beyond it — and a
+    sqrt(1 + ln(f)/ln(orig)) magnitude factor on the tables.
+
+    The factor list is chosen by the length the tables are BUILT for
+    (static under jit). transformers switches on the runtime position
+    instead, re-deriving frequencies mid-request when a cached generate
+    crosses the pretrained window; a table built for the request's true
+    maximum length applies the long factors from the start, which keeps
+    every cached position self-consistent."""
+    orig = int(scaling.get("original_max_position_embeddings")
+               or max_position)
+    factor = scaling.get("factor")
+    if max_position and orig:
+        factor = max_position / orig
+    att = scaling.get("attention_factor")
+    if att is None:
+        att = (1.0 if not factor or factor <= 1.0
+               else math.sqrt(1 + math.log(factor) / math.log(orig)))
+    ext = (scaling["long_factor"] if seq_len > orig
+           else scaling["short_factor"])
+    ext = jnp.asarray(ext, jnp.float32)
+    if ext.shape[0] != dim // 2:
+        raise ValueError(
+            f"longrope factor lists must have head_dim/2 = {dim // 2} "
+            f"entries, got {ext.shape[0]}")
+    inv_freq = 1.0 / (ext * base ** (
+        jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    return inv_freq, float(att)
 
 
 def _yarn_get_mscale(scale: float, m: float = 1.0) -> float:
@@ -277,6 +370,9 @@ def _rope_tables(seq_len, head_dim, theta, dtype=jnp.float32, scaling=None,
     if _rope_type(scaling) == "yarn":
         inv_freq, att = _yarn_params(scaling, head_dim, theta,
                                      fallback_orig=max_position)
+    elif _rope_type(scaling) == "longrope":
+        inv_freq, att = _longrope_params(scaling, head_dim, theta, seq_len,
+                                         max_position=max_position)
     else:
         inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
         inv_freq = _scale_inv_freq(inv_freq, scaling)
@@ -385,6 +481,15 @@ class LlamaAttention(Layer):
         self.num_heads = config.num_attention_heads
         self.num_kv_heads = config.num_key_value_heads
         self.head_dim = head_dim_of(config)
+        # per-INSTANCE sliding window: defaults to the config's uniform
+        # value; alternating-window families (Gemma2) set it per layer
+        self.window = config.sliding_window
+        # Gemma2 softmax-scale override, folded into q once after
+        # projection: every downstream path divides by sqrt(head_dim), so
+        # multiplying q by sqrt(head_dim)/sqrt(query_pre_attn_scalar)
+        # yields the target scale exactly (RoPE is linear and commutes)
+        qpas = getattr(config, "query_pre_attn_scalar", None)
+        self.q_premul = (math.sqrt(self.head_dim / qpas) if qpas else None)
         bias = config.attention_bias
         if config.qk_norm:
             # Qwen3: per-head RMSNorm on q/k after projection, before RoPE
@@ -410,8 +515,11 @@ class LlamaAttention(Layer):
         if self.q_norm is not None:
             q = self.q_norm(q)
             k = self.k_norm(k)
+        if self.q_premul is not None:
+            q = q * self.q_premul
 
         cfg = self.config
+        softcap = getattr(cfg, "attn_logit_softcapping", None)
 
         if isinstance(kv_cache, dict):
             # static-shape decode cache (serving path): jit-stable shapes at
@@ -422,12 +530,17 @@ class LlamaAttention(Layer):
             from ..generation import cached_attention, paged_cached_attention
 
             if "k_pages" in kv_cache:
+                if softcap is not None:
+                    raise NotImplementedError(
+                        "attn_logit_softcapping is not supported on the "
+                        "paged decode path — serve softcapped models "
+                        "through the dense cache")
                 out, kp, vp = apply(
                     "llama_attention_paged", paged_cached_attention,
                     q, k, v, cos, sin, kv_cache["k_pages"],
                     kv_cache["v_pages"], kv_cache["page_indices"],
                     kv_cache["lengths"], kv_cache.get("page_size"),
-                    window=cfg.sliding_window)
+                    window=self.window)
                 result = self.o_proj(out.reshape([b, s, h * d]))
                 new = dict(kv_cache)
                 new.update(k_pages=kp, v_pages=vp,
@@ -437,9 +550,9 @@ class LlamaAttention(Layer):
                 "llama_attention_cached", cached_attention, q, k, v, cos, sin,
                 kv_cache["k"], kv_cache["v"], kv_cache["pos"],
                 kv_cache.get("allowed"), kv_cache.get("row_pos"),
-                use_flash=cfg.use_flash_attention,
+                use_flash=(cfg.use_flash_attention and softcap is None),
                 prefill=bool(kv_cache.get("prefill", False)),
-                window=cfg.sliding_window)
+                window=self.window, softcap=softcap)
             result = self.o_proj(out.reshape([b, s, h * d]))
             new = {"k": k_buf, "v": v_buf, "pos": kv_cache["pos"] + s}
             if "allowed" in kv_cache:
@@ -461,13 +574,19 @@ class LlamaAttention(Layer):
             if cache:
                 k = jnp.concatenate([cache[0], k], axis=1)
                 v = jnp.concatenate([cache[1], v], axis=1)
-            win = cfg.sliding_window
+            win = self.window
             if win is not None and win <= 0:
                 raise ValueError("sliding_window must be positive")
             hcg = get_hybrid_communicate_group()
-            if (not cache and hcg is not None
-                    and hcg.get_sep_parallel_world_size() > 1
-                    and cfg.sep_mode in ("ring", "ulysses")):
+            cp_active = (not cache and hcg is not None
+                         and hcg.get_sep_parallel_world_size() > 1
+                         and cfg.sep_mode in ("ring", "ulysses"))
+            if softcap is not None and cp_active:
+                raise NotImplementedError(
+                    "attn_logit_softcapping under context parallelism is "
+                    "not supported (the ring/Ulysses kernels compute "
+                    "uncapped scores)")
+            if cp_active:
                 # context parallelism: sequence stays sharded over sep; k/v
                 # blocks ride the ring (or heads ride an all-to-all) instead
                 # of GSPMD all-gathering the whole sequence per device.
@@ -494,7 +613,8 @@ class LlamaAttention(Layer):
                     # checker must be off (the jax-documented pairing)
                     check_vma=False)
                 out = cp(q, k, v)
-            elif cfg.use_flash_attention and pf.supported(q, k, v):
+            elif (cfg.use_flash_attention and softcap is None
+                  and pf.supported(q, k, v)):
                 # GQA-native splash kernel: KV stays at num_kv_heads width
                 # through HBM (no _expand_gqa on the hot path)
                 out = pf.flash_attention_bshd(q, k, v, causal=True, window=win)
@@ -509,7 +629,8 @@ class LlamaAttention(Layer):
                     rows = jnp.arange(sq)[:, None] + off
                     cols = jnp.arange(sk)[None, :]
                     band = ((cols <= rows) & (cols > rows - win))[None, None]
-                out = _sdpa_ref(q, ke, ve, causal=band is None, mask=band)
+                out = _sdpa_ref(q, ke, ve, causal=band is None, mask=band,
+                                softcap=softcap)
             return out.reshape(b, out.shape[1], h * d), k, v
 
         cache_args = [kv_cache[0], kv_cache[1]] if kv_cache is not None else []
@@ -584,7 +705,8 @@ class LlamaModel(Layer):
         super().__init__(dtype=config.dtype)
         self.config = config
         self.embed_tokens = _make_embedding(config)
-        layers = [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        layers = [self._make_decoder_layer(config, i)
+                  for i in range(config.num_hidden_layers)]
         if config.recompute:
             from ..distributed.recompute_layer import RecomputeLayer
 
@@ -592,6 +714,15 @@ class LlamaModel(Layer):
         self.layers = nn.LayerList(layers)
         self.norm = LlamaRMSNorm(config)
         self._rope_cache = {}
+
+    @staticmethod
+    def _make_decoder_layer(config, layer_idx):
+        """Per-layer construction hook — families with per-layer structure
+        (Gemma2's sandwich norms) override this. The per-layer window
+        schedule (``layer_types``) is applied here for every family."""
+        layer = LlamaDecoderLayer(config)
+        layer.self_attn.window = layer_window(config, layer_idx)
+        return layer
 
     def _rope_dim(self):
         """Rotary table width; MLA trunks override (RoPE rides only the
@@ -647,10 +778,12 @@ class LlamaModel(Layer):
 
 
 class LlamaForCausalLM(Layer):
+    model_cls = LlamaModel  # trunk hook (Gemma2 swaps in sandwich norms)
+
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
         self.config = config
-        self.llama = LlamaModel(config)
+        self.llama = type(self).model_cls(config)
         if config.tie_word_embeddings:
             self.lm_head = None
         else:
@@ -658,8 +791,18 @@ class LlamaForCausalLM(Layer):
 
     def lm_head_logits(self, hidden):
         if self.lm_head is None:
-            return tied_lm_head_logits(hidden, self.llama.embed_tokens.weight)
-        return self.lm_head(hidden)
+            logits = tied_lm_head_logits(hidden,
+                                         self.llama.embed_tokens.weight)
+        else:
+            logits = self.lm_head(hidden)
+        cap = getattr(self.config, "final_logit_softcapping", None)
+        if cap:
+            # Gemma2 tanh soft cap — applied HERE so every consumer
+            # (training loss, generate, beam, speculative, serving) and
+            # every family on the trunk (MoE included) gets it
+            logits = apply("final_logit_softcap",
+                           lambda x: cap * jnp.tanh(x / cap), logits)
+        return logits
 
     def generate(self, input_ids, max_new_tokens=20, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
@@ -857,6 +1000,19 @@ class LlamaForCausalLMPipe(PipelineLayer):
             raise NotImplementedError(
                 "fuse_linear_cross_entropy is not supported by the pipeline "
                 f"head stage; unset the flag for {type(self).__name__}")
+        if getattr(config, "layer_types", None):
+            # pipe decoder items are index-free LayerDescs; honoring the
+            # schedule needs per-item window plumbing — raise rather than
+            # silently attend full/sliding on the wrong layers
+            raise NotImplementedError(
+                "the per-layer window schedule (layer_types) is not "
+                f"supported under {type(self).__name__}")
+        if getattr(config, "final_logit_softcapping", None):
+            # the pipe head stages project with the raw weight (no
+            # lm_head_logits hook)
+            raise NotImplementedError(
+                "final_logit_softcapping is not supported by the pipeline "
+                f"head stage of {type(self).__name__}")
 
     def __init__(self, config: LlamaConfig, num_stages=None,
                  seg_method=None, **pipe_kwargs):
@@ -966,7 +1122,8 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
-def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict) -> "LlamaForCausalLM":
+def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict,
+                  extra_layer_norms=()) -> "LlamaForCausalLM":
     """Load a HuggingFace Llama checkpoint's state dict into ``model``.
 
     Accepts torch tensors or arrays. torch ``nn.Linear`` stores weights
@@ -996,6 +1153,8 @@ def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict) -> "LlamaForCausalLM
             f"{hf}.input_layernorm.weight", False)
         plan[f"{ours}.post_attention_layernorm.weight"] = (
             f"{hf}.post_attention_layernorm.weight", False)
+        for norm in extra_layer_norms:  # Gemma2 sandwich norms
+            plan[f"{ours}.{norm}.weight"] = (f"{hf}.{norm}.weight", False)
     tied_alias = set()
     if model.lm_head is not None:
         src = ("lm_head.weight" if "lm_head.weight" in hf_state_dict
@@ -1032,7 +1191,7 @@ def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict) -> "LlamaForCausalLM
 
 
 def _from_hf(config_cls, model_cls, hf_model_or_state, hf_config=None,
-             **config_overrides):
+             extra_layer_norms=(), **config_overrides):
     """Shared HF-conversion protocol for the Llama-architecture families
     (Llama / Qwen2 / Mistral): unwrap model vs raw state, map the config,
     build, load."""
@@ -1045,7 +1204,8 @@ def _from_hf(config_cls, model_cls, hf_model_or_state, hf_config=None,
         state = hf_model_or_state
     base = hf_config_to_llama(hf_config, **config_overrides)
     cfg = base if config_cls is LlamaConfig else config_cls(**_dc.asdict(base))
-    return load_hf_llama(model_cls(cfg), state)
+    return load_hf_llama(model_cls(cfg), state,
+                         extra_layer_norms=extra_layer_norms)
 
 
 def llama_from_hf(hf_model_or_state, hf_config=None, **config_overrides):

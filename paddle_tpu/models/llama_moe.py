@@ -353,8 +353,19 @@ class LlamaMoEDecoderLayer(Layer):
     attn_cls = LlamaAttention  # subclasses (DeepSeek MLA) swap the block
 
     def __init__(self, config: LlamaMoEConfig, layer_idx: int):
+        from .llama import layer_window
+
         super().__init__(dtype=config.dtype)
         self.self_attn = type(self).attn_cls(config)
+        # per-layer window schedule (layer_types) applies to MoE trunks too;
+        # attention classes without window support (MLA) must refuse rather
+        # than silently attend fully
+        if hasattr(self.self_attn, "window"):
+            self.self_attn.window = layer_window(config, layer_idx)
+        elif getattr(config, "layer_types", None):
+            raise NotImplementedError(
+                f"{type(self.self_attn).__name__} does not support the "
+                "per-layer window schedule (layer_types)")
         self.is_moe = layer_idx >= config.first_k_dense_replace
         self.mlp = MoEMLP(config) if self.is_moe else LlamaMLP(config)
         self.input_layernorm = LlamaRMSNorm(config)
@@ -388,8 +399,11 @@ class LlamaMoEModel(LlamaModel):
     """LlamaModel with MoE decoder layers (embed/rope/norm reused)."""
 
     def __init__(self, config: LlamaMoEConfig):
-        # build the base with 0 layers, then install MoE layers
-        base_cfg = dataclasses.replace(config, num_hidden_layers=0)
+        # build the base with 0 layers, then install MoE layers (the
+        # per-layer schedule validates against num_hidden_layers, so it is
+        # cleared for the 0-layer shell and read from the REAL config)
+        base_cfg = dataclasses.replace(config, num_hidden_layers=0,
+                                       layer_types=None)
         super().__init__(base_cfg)
         self.config = config
         self.layers = nn.LayerList(

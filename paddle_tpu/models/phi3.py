@@ -1,0 +1,115 @@
+"""Phi-3 decoder family (mini / medium, 4k and 128k variants).
+
+Architecturally the Llama recipe (RoPE GQA, SwiGLU, RMSNorm, untied head)
+— the deviations are checkpoint packaging and long-context scaling:
+
+- fused projections in the checkpoint: ``qkv_proj`` ([q; k; v] stacked on
+  the out dim) and ``gate_up_proj`` ([gate; up]) — split here at CONVERT
+  time so the runtime keeps the trunk's separate (column-parallel)
+  projections;
+- LongRoPE (``rope_scaling type "longrope"``) for the 128k variants:
+  per-dim short/long frequency factor lists chosen by the table length
+  against ``original_max_position_embeddings``, with the
+  sqrt(1 + ln(f)/ln(orig)) magnitude factor (llama._longrope_params);
+- optional causal sliding window (the mini-4k ships 2047) on the trunk's
+  uniform-window machinery.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .llama import LlamaConfig, LlamaForCausalLM, _from_hf, _hf_to_np
+
+
+@dataclasses.dataclass
+class Phi3Config(LlamaConfig):
+    # Phi-3-mini shape
+    vocab_size: int = 32064
+    hidden_size: int = 3072
+    intermediate_size: int = 8192
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=256,
+                    dtype="float32")
+        base.update(kw)
+        return Phi3Config(**base)
+
+
+class Phi3ForCausalLM(LlamaForCausalLM):
+    """Phi-3 causal LM — the Llama trunk; the family identity lives in the
+    checkpoint converter (fused-projection split + LongRoPE mapping)."""
+
+
+def split_phi3_fused(hf_state_dict, hf_config):
+    """Translate a transformers Phi3 state dict to the Llama key layout:
+    ``qkv_proj`` splits into q/k/v on the out dim (torch [out, in] rows),
+    ``gate_up_proj`` into equal gate/up halves. Returns a new dict; all
+    other keys pass through unchanged."""
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    h = get("hidden_size")
+    heads = get("num_attention_heads")
+    kv = get("num_key_value_heads")
+    hd = get("head_dim") or h // heads
+    out = {}
+    for key, val in hf_state_dict.items():
+        if key.endswith(".self_attn.qkv_proj.weight"):
+            base = key[: -len("qkv_proj.weight")]
+            v = _hf_to_np(val)
+            if v.shape[0] != (heads + 2 * kv) * hd:
+                raise ValueError(
+                    f"{key}: fused qkv rows {v.shape[0]} != "
+                    f"(H + 2*kv) * head_dim = {(heads + 2 * kv) * hd}")
+            out[base + "q_proj.weight"] = v[: heads * hd]
+            out[base + "k_proj.weight"] = v[heads * hd: (heads + kv) * hd]
+            out[base + "v_proj.weight"] = v[(heads + kv) * hd:]
+        elif key.endswith(".mlp.gate_up_proj.weight"):
+            base = key[: -len("gate_up_proj.weight")]
+            v = _hf_to_np(val)
+            half = v.shape[0] // 2
+            out[base + "gate_proj.weight"] = v[:half]
+            out[base + "up_proj.weight"] = v[half:]
+        else:
+            out[key] = val
+    return out
+
+
+def phi3_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a Phi3ForCausalLM from a transformers Phi3 model (or a raw
+    state dict + config)."""
+    if hf_config is None:
+        hf_config = hf_model_or_state.config
+        state = hf_model_or_state.state_dict()
+    else:
+        state = hf_model_or_state
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    if (get("partial_rotary_factor") or 1.0) != 1.0:
+        raise NotImplementedError(
+            "phi3_from_hf: partial_rotary_factor != 1.0 is not supported")
+    scaling = get("rope_scaling")
+    if scaling:
+        # the factor-list choice anchors to original_max_position_embeddings,
+        # which Phi3 keeps as a CONFIG attribute — fold it into the scaling
+        # dict so the table builder sees it
+        scaling = dict(scaling)
+        orig = get("original_max_position_embeddings")
+        if orig:
+            scaling.setdefault("original_max_position_embeddings", orig)
+        config_overrides.setdefault("rope_scaling", scaling)
+    # the base mapper's window logic is mistral-keyed; Phi3's window (the
+    # mini-4k ships 2047) maps directly
+    config_overrides.setdefault("sliding_window", get("sliding_window"))
+    return _from_hf(Phi3Config, Phi3ForCausalLM,
+                    split_phi3_fused(state, hf_config), hf_config,
+                    **config_overrides)

@@ -16,14 +16,19 @@ from ...ops.registry import apply
 from ...framework import random as _random
 
 
-def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None, dropout_key=None):
-    """Pure-XLA SDPA on [B, S, H, D] layout, f32 softmax accumulation."""
+def _sdpa_ref(q, k, v, mask=None, dropout=0.0, causal=False, scale=None,
+              dropout_key=None, softcap=None):
+    """Pure-XLA SDPA on [B, S, H, D] layout, f32 softmax accumulation.
+    ``softcap``: Gemma2 tanh soft cap — scores become
+    softcap * tanh(scores / softcap) before masking."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     # [B,H,Sq,Sk]
     scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * s
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]
         cm = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)

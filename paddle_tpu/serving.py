@@ -570,7 +570,10 @@ class ContinuousBatchEngine:
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :S0] = req.ids
         ragged = S0 != bucket
-        prefill = _get_prefill_step(self.model, bucket, ragged)
+        # rope provisioned at the engine's max_len so length-keyed rope
+        # regimes (longrope) agree between this prefill and the decode step
+        prefill = _get_prefill_step(self.model, bucket, ragged,
+                                    rope_len=self.max_len)
         pad_mask = None
         if ragged:
             pad_mask = jnp.zeros((1, bucket), bool).at[0, :S0].set(True)

@@ -1,0 +1,151 @@
+"""Gemma2 family: sandwich norms, q-premul softmax scale, tanh soft caps,
+alternating sliding/full layers; HF conversion + logits/greedy parity
+against transformers; loud refusals on the unsupported kernel paths."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gemma2 import (Gemma2Config, Gemma2ForCausalLM,
+                                      gemma2_from_hf)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def test_construction_and_schedule():
+    paddle.seed(0)
+    cfg = Gemma2Config.tiny()
+    assert cfg.layer_types == ("sliding_attention", "full_attention")
+    m = Gemma2ForCausalLM(cfg)
+    layers = m.llama.layers
+    assert layers[0].self_attn.window == cfg.sliding_window
+    assert layers[1].self_attn.window is None
+    # q premul folds query_pre_attn_scalar: head_dim 32, scalar 64
+    assert layers[0].self_attn.q_premul == pytest.approx(
+        np.sqrt(32 / 64.0))
+    for norm in ("input_layernorm", "post_attention_layernorm",
+                 "pre_feedforward_layernorm", "post_feedforward_layernorm"):
+        assert getattr(layers[0], norm).offset == 1.0
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 12)))
+    loss, _ = m(ids, labels=ids)
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_layer_types_validation():
+    with pytest.raises(ValueError, match="entries for"):
+        Gemma2Config.tiny(layer_types=("full_attention",))
+    with pytest.raises(ValueError, match="unknown layer_types"):
+        Gemma2Config.tiny(layer_types=("full_attention", "banded"))
+    with pytest.raises(ValueError, match="sliding_window is not set"):
+        Gemma2Config.tiny(sliding_window=None,
+                          layer_types=("sliding_attention",
+                                       "full_attention"))
+    with pytest.raises(NotImplementedError, match="fuse_linear"):
+        Gemma2Config.tiny(fuse_linear_cross_entropy=True)
+
+
+def test_trains():
+    from paddle_tpu import optimizer as opt
+
+    paddle.seed(1)
+    m = Gemma2ForCausalLM(Gemma2Config.tiny())
+
+    def loss_fn(mm, x, y):
+        loss, _ = mm(x, labels=y)
+        return loss
+
+    step = paddle.jit.train_step(m, loss_fn,
+                                 opt.AdamW(1e-2, parameters=m.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 16)))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 512, (2, 16)))
+    losses = [float(step(x, y).numpy()) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+
+def test_paged_refuses_softcap():
+    paddle.seed(2)
+    m = Gemma2ForCausalLM(Gemma2Config.tiny())
+    ids = paddle.to_tensor(np.random.RandomState(3).randint(1, 512, (1, 8)))
+    with pytest.raises(NotImplementedError, match="paged"):
+        m.generate(ids, max_new_tokens=4, paged=True, page_size=4)
+
+
+def _tiny_hf(seq_window=8):
+    from transformers import Gemma2Config as HFConfig
+    from transformers import Gemma2ForCausalLM as HFGemma2
+
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, query_pre_attn_scalar=64.0,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        sliding_window=seq_window, max_position_embeddings=128,
+        rms_norm_eps=1e-6, rope_theta=10000.0,
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=True,
+        attn_implementation="eager")
+    return HFGemma2(hf_cfg).eval()
+
+
+def test_logits_and_generate_match_transformers():
+    """Prompt longer than the sliding window so the alternation genuinely
+    bites on layer 0 while layer 1 attends fully."""
+    hf = _tiny_hf(seq_window=8)
+    ours = gemma2_from_hf(hf, dtype="float32", use_flash_attention=False)
+    assert ours.config.attn_logit_softcapping == 50.0
+    assert ours.config.final_logit_softcapping == 30.0
+    assert ours.config.layer_types == ("sliding_attention",
+                                       "full_attention")
+    ids = np.random.RandomState(0).randint(0, 128, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+    with torch.no_grad():
+        gref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                           do_sample=False).numpy()[:, 12:]
+    ggot = ours.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(ggot, gref)
+
+
+def test_pipe_refuses_gemma2_knobs():
+    """The pipeline assembly cannot honor layer_types (index-free
+    LayerDescs) or the final soft cap (raw-weight head stages) — it must
+    refuse, not silently diverge."""
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLMPipe
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, sliding_window=8,
+                           layer_types=("sliding_attention",
+                                        "full_attention"))
+    with pytest.raises(NotImplementedError, match="layer_types"):
+        LlamaForCausalLMPipe(cfg, num_stages=1)
+    cfg2 = LlamaConfig.tiny(num_hidden_layers=2,
+                            final_logit_softcapping=30.0)
+    with pytest.raises(NotImplementedError, match="final_logit"):
+        LlamaForCausalLMPipe(cfg2, num_stages=1)
+
+
+def test_moe_trunk_honors_layer_schedule():
+    """layer_types flows into MoE trunks' per-layer attention windows."""
+    from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    paddle.seed(9)
+    cfg = MixtralConfig.tiny(num_hidden_layers=2, sliding_window=8,
+                             layer_types=("sliding_attention",
+                                          "full_attention"))
+    m = MixtralForCausalLM(cfg)
+    assert m.llama.layers[0].self_attn.window == 8
+    assert m.llama.layers[1].self_attn.window is None
+
+
+def test_final_softcap_changes_logits():
+    paddle.seed(4)
+    m = Gemma2ForCausalLM(Gemma2Config.tiny())
+    ids = paddle.to_tensor(np.random.RandomState(5).randint(0, 512, (1, 6)))
+    capped = m(ids).numpy()
+    m.config = dataclasses.replace(m.config, final_logit_softcapping=None)
+    uncapped = m(ids).numpy()
+    assert np.abs(capped).max() <= 30.0 + 1e-5
+    assert not np.allclose(capped, uncapped)

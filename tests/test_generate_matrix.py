@@ -9,7 +9,7 @@ import pytest
 import paddle_tpu as paddle
 
 FAMILIES = ["llama", "qwen2", "qwen3", "mistral", "gpt2", "qwen2_moe",
-            "deepseek", "mixtral", "gemma"]
+            "deepseek", "mixtral", "gemma", "gemma2", "phi3"]
 
 
 def _build(name):
@@ -59,6 +59,22 @@ def _build(name):
 
         # GeGLU + (1+w) norms + scaled embeddings + tied head on every path
         return GemmaForCausalLM(GemmaConfig.tiny(num_hidden_layers=2))
+    if name == "gemma2":
+        from paddle_tpu.models.gemma2 import (Gemma2Config,
+                                              Gemma2ForCausalLM)
+
+        # sandwich norms + softcaps + alternating window on every path
+        return Gemma2ForCausalLM(Gemma2Config.tiny(num_hidden_layers=2))
+    if name == "phi3":
+        from paddle_tpu.models.phi3 import Phi3Config, Phi3ForCausalLM
+
+        # longrope tables (long regime at these lengths) on every path
+        return Phi3ForCausalLM(Phi3Config.tiny(
+            num_hidden_layers=2,
+            rope_scaling={"rope_type": "longrope",
+                          "short_factor": [1.0] * 8,
+                          "long_factor": [2.0] * 8,
+                          "original_max_position_embeddings": 8}))
     raise AssertionError(name)
 
 
@@ -83,9 +99,10 @@ def test_cached_equals_no_cache(family_model):
 def test_cached_equals_paged(family_model):
     name, m = family_model
     x = _prompt(m)
-    if name == "deepseek":
-        # MLA's latent cache has no per-head pages by design; the paged
-        # path must refuse loudly, not silently mis-decode
+    if name in ("deepseek", "gemma2"):
+        # MLA's latent cache has no per-head pages by design; Gemma2's
+        # attention soft cap has no paged-kernel support — both must
+        # refuse loudly, not silently mis-decode
         with pytest.raises(NotImplementedError, match="paged"):
             m.generate(x, max_new_tokens=5, paged=True, page_size=4)
         return

@@ -1,0 +1,151 @@
+"""Phi-3 family: fused-projection split, LongRoPE (short and long
+regimes), sliding window; HF conversion + logits/greedy parity against
+transformers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.phi3 import (Phi3Config, Phi3ForCausalLM,
+                                    phi3_from_hf, split_phi3_fused)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _tiny_hf(rope_scaling=None, max_position=64, original_max=None,
+             window=None):
+    from transformers import Phi3Config as HFConfig
+    from transformers import Phi3ForCausalLM as HFPhi3
+
+    torch.manual_seed(0)
+    kw = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=max_position, rms_norm_eps=1e-5,
+        rope_theta=10000.0, sliding_window=window,
+        tie_word_embeddings=False, pad_token_id=0,
+        attn_implementation="eager")
+    if rope_scaling is not None:
+        kw["rope_scaling"] = rope_scaling
+    if original_max is not None:
+        kw["original_max_position_embeddings"] = original_max
+    return HFPhi3(HFConfig(**kw)).eval()
+
+
+def _parity(hf, ours, seq, seed=0):
+    ids = np.random.RandomState(seed).randint(0, 128, (2, seq))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+    with torch.no_grad():
+        gref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                           do_sample=False).numpy()[:, seq:]
+    ggot = ours.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(ggot, gref)
+
+
+def test_fused_split_and_plain_parity():
+    hf = _tiny_hf()
+    ours = phi3_from_hf(hf, dtype="float32", use_flash_attention=False)
+    # the fused checkpoint split into the trunk's separate projections
+    assert ours.llama.layers[0].self_attn.q_proj.weight.shape == [64, 4 * 16]
+    assert ours.llama.layers[0].self_attn.k_proj.weight.shape == [64, 2 * 16]
+    _parity(hf, ours, seq=12)
+
+
+def test_sliding_window_maps():
+    hf = _tiny_hf(window=6)
+    ours = phi3_from_hf(hf, dtype="float32", use_flash_attention=False)
+    assert ours.config.sliding_window == 6
+    _parity(hf, ours, seq=14, seed=1)
+
+
+def _longrope(short, long):
+    # HF Phi3Config validates the legacy "type" key spelling
+    return {"type": "longrope", "short_factor": short,
+            "long_factor": long}
+
+
+def test_longrope_short_regime_parity():
+    """Table length <= original_max: the short factors apply throughout."""
+    short = list(np.linspace(1.0, 1.5, 8))
+    long = list(np.linspace(2.0, 4.0, 8))
+    hf = _tiny_hf(rope_scaling=_longrope(short, long), max_position=96,
+                  original_max=96)
+    ours = phi3_from_hf(hf, dtype="float32", use_flash_attention=False)
+    assert ours.config.rope_scaling["type"] == "longrope"
+    _parity(hf, ours, seq=12, seed=2)
+
+
+def test_longrope_long_regime_parity():
+    """Prompt beyond original_max: transformers flips to the long factors
+    and the sqrt(1 + ln(f)/ln(orig)) magnitude — tables must match."""
+    short = list(np.linspace(1.0, 1.5, 8))
+    long = list(np.linspace(2.0, 4.0, 8))
+    hf = _tiny_hf(rope_scaling=_longrope(short, long), max_position=64,
+                  original_max=16)
+    ours = phi3_from_hf(hf, dtype="float32", use_flash_attention=False,
+                        # generate()'s cached tables are sized to
+                        # prompt+max_new; keep the no-cache comparison in
+                        # the same (long) regime
+                        )
+    ids = np.random.RandomState(3).randint(0, 128, (2, 24))  # 24 > 16
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+
+
+def test_longrope_validation():
+    from paddle_tpu.models.llama import validate_rope_scaling
+
+    with pytest.raises(ValueError, match="equal length"):
+        validate_rope_scaling({"rope_type": "longrope",
+                               "short_factor": [1.0],
+                               "long_factor": [1.0, 2.0]}, max_position=64)
+    with pytest.raises(ValueError, match="original_max"):
+        validate_rope_scaling({"rope_type": "longrope",
+                               "short_factor": [1.0],
+                               "long_factor": [2.0]})
+
+
+def test_longrope_engine_matches_solo():
+    """Regression: the serving engine's bucketed prefill used to build
+    rope at the BUCKET length while decode provisioned max_len — with
+    longrope the two picked different factor regimes and served garbage.
+    Prefill now provisions rope at the engine's max_len."""
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    paddle.seed(6)
+    m = Phi3ForCausalLM(Phi3Config.tiny(
+        num_hidden_layers=2,
+        rope_scaling={"rope_type": "longrope",
+                      "short_factor": [1.0] * 8,
+                      "long_factor": [2.0] * 8,
+                      "original_max_position_embeddings": 8}))
+    # prompt length == a bucket boundary == original_max: the bucket-sized
+    # table sat exactly at the short/long boundary
+    prompt = np.random.RandomState(7).randint(1, 512, (8,))
+    solo = m.generate(paddle.to_tensor(prompt[None]),
+                      max_new_tokens=6).numpy()[0]
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=32, page_size=8)
+    rid = eng.add_request(prompt.tolist(), max_new_tokens=6)
+    out = eng.run_until_done()[rid]
+    np.testing.assert_array_equal(np.asarray(out), solo)
+
+
+def test_split_rejects_bad_shapes():
+    hf = _tiny_hf()
+    sd = {k: v for k, v in hf.state_dict().items()}
+    key = "model.layers.0.self_attn.qkv_proj.weight"
+    sd[key] = torch.zeros(7, 64)
+    with pytest.raises(ValueError, match="fused qkv rows"):
+        split_phi3_fused(sd, hf.config)
+
+
+def test_partial_rotary_refused():
+    hf = _tiny_hf()
+    hf.config.partial_rotary_factor = 0.5
+    with pytest.raises(NotImplementedError, match="partial_rotary"):
+        phi3_from_hf(hf, dtype="float32")
