@@ -1,8 +1,10 @@
 """Every causal/seq2seq family in the zoo, built + generating in one run:
 Llama-3 (RoPE GQA), Qwen2 (qkv bias), Mistral (sliding window), GPT-2
-(learned positions), DeepSeekMoE (routed experts), Qwen2-MoE (sigmoid
-shared gate), ERNIE-4.5 (MoE decoder), DeepSeek-V2/V3 (MLA latent cache,
-group-limited routing), T5/BART (encoder-decoder) — all
+(learned positions), Gemma (GeGLU + (1+w) norms + scaled embeddings),
+Gemma2 (sandwich norms, soft caps, alternating windows), Phi-3 (LongRoPE),
+DeepSeekMoE (routed experts), Qwen2-MoE (sigmoid shared gate), Mixtral
+(all-sparse top-2), ERNIE-4.5 (MoE decoder), DeepSeek-V2/V3 (MLA latent
+cache, group-limited routing), T5/BART (encoder-decoder) — all
 through the same generate surface, then one continuous-batching engine
 serving three different families' requests back to back.
 
@@ -41,12 +43,20 @@ def main():
                                  sliding_window=8))),
         ("gpt2", M.GPT2LMHeadModel(
             M.GPT2Config.tiny(num_hidden_layers=2, vocab_size=256))),
+        ("gemma", M.GemmaForCausalLM(
+            M.GemmaConfig.tiny(num_hidden_layers=2, vocab_size=256))),
+        ("gemma2", M.Gemma2ForCausalLM(
+            M.Gemma2Config.tiny(num_hidden_layers=2, vocab_size=256))),
+        ("phi3", M.Phi3ForCausalLM(
+            M.Phi3Config.tiny(num_hidden_layers=2, vocab_size=256))),
         ("llama-moe", M.LlamaMoEForCausalLM(
             M.LlamaMoEConfig.tiny_moe(vocab_size=256))),
         ("qwen2-moe", M.Qwen2MoeForCausalLM(
             M.Qwen2MoeConfig.tiny(vocab_size=256))),
         ("qwen3-moe", M.Qwen3MoeForCausalLM(
             M.Qwen3MoeConfig.tiny(vocab_size=256))),
+        ("mixtral", M.MixtralForCausalLM(
+            M.MixtralConfig.tiny(vocab_size=256))),
         ("ernie-4.5", M.Ernie45ForCausalLM(
             M.Ernie45Config.tiny_moe(vocab_size=256))),
         ("deepseek-v2", M.DeepseekV2ForCausalLM(

@@ -155,7 +155,7 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
 
 
 def paged_cached_attention(q, k, v, cos, sin, k_pages, v_pages, page_indices,
-                           lengths, page_size, window=None):
+                           lengths, page_size, window=None, softcap=None):
     """Single-token decode over the PAGED cache (in-layer dispatch).
 
     q [B,1,H,D]; pages [hk, n_pages, page_size, D]; lengths [B] = tokens
@@ -178,12 +178,14 @@ def paged_cached_attention(q, k, v, cos, sin, k_pages, v_pages, page_indices,
     v_pages = v_pages.at[:, rows, slot].set(
         jnp.moveaxis(v[:, 0], 0, 1).astype(v_pages.dtype))
     out = paged_decode_attention(q[:, 0], k_pages, v_pages, lengths + 1,
-                                 page_indices, window=window)
+                                 page_indices, window=window,
+                                 softcap=softcap)
     return out[:, None], k_pages, v_pages
 
 
 def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
-                           pages_per_compute_block=None, window=None):
+                           pages_per_compute_block=None, window=None,
+                           softcap=None):
     """Decode attention over a paged cache: JAX's bundled Pallas kernel on
     TPU, a jnp gather reference (identical semantics) elsewhere.
 
@@ -203,10 +205,17 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
             # gather ONLY the pages the band can touch: O(window) work
             # regardless of max_len — the win windowed serving exists for
             return _paged_window_attention(q, k_pages, v_pages, lengths,
-                                           page_indices, window)
-        # the band can never exclude a cached position (window >= cache
-        # capacity): keep the fused Pallas kernel — e.g. Mistral-7B's
-        # 4096 window served at max_len <= 4096
+                                           page_indices, window,
+                                           softcap=softcap)
+        # else: the band can never exclude a cached position (window >=
+        # cache capacity) — fall through to the fused Pallas kernel,
+        # e.g. Mistral-7B's 4096 window served at max_len <= 4096
+    if softcap is not None:
+        # the bundled Pallas kernel computes uncapped scores; the exact
+        # gather reference (O(cache) reads) keeps softcapped models
+        # (Gemma2) servable through the paged engine
+        return _paged_attention_ref(q, k_pages, v_pages, lengths,
+                                    page_indices, softcap=softcap)
     try:
         on_tpu = jax.devices()[0].platform == "tpu"
     except Exception:
@@ -226,7 +235,7 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
 
 
 def _paged_window_attention(q, k_pages, v_pages, lengths, page_indices,
-                            window):
+                            window, softcap=None):
     """Sliding-window decode over the paged cache, touching only the
     pages the band intersects (≤ ceil(window/page_size)+1 per row): HBM
     reads scale with the WINDOW, not the cache capacity — the long-
@@ -252,19 +261,22 @@ def _paged_window_attention(q, k_pages, v_pages, lengths, page_indices,
               + jnp.arange(page_size)[None, None, :]).reshape(B, W)
     valid = (colpos < lengths[:, None]) & \
             (colpos >= (lengths[:, None] - window))
-    return _banded_sdpa(q, k, v, valid)
+    return _banded_sdpa(q, k, v, valid, softcap=softcap)
 
 
-def _banded_sdpa(q, k, v, valid):
+def _banded_sdpa(q, k, v, valid, softcap=None):
     """Shared decode-attention tail: q [B,H,D], k/v [B,hk,T,D] gathered,
     valid [B,T] column mask — the ONE place the f32 softmax numerics of
-    the paged decode paths live."""
+    the paged decode paths live. ``softcap``: Gemma2 tanh soft cap on the
+    scaled scores, applied before masking (HF order)."""
     B, H, D = q.shape
     hk = k.shape[1]
     g = H // hk
     qg = q.reshape(B, hk, g, D).astype(jnp.float32)
     scores = jnp.einsum("bkgd,bktd->bkgt", qg, k.astype(jnp.float32))
     scores = scores / math.sqrt(D)
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
     scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgt,bktd->bkgd", probs, v.astype(jnp.float32))
@@ -272,7 +284,7 @@ def _banded_sdpa(q, k, v, valid):
 
 
 def _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices,
-                         window=None):
+                         window=None, softcap=None):
     B, H, D = q.shape
     hk, _n, page_size, _ = k_pages.shape
     g = H // hk
@@ -285,7 +297,7 @@ def _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices,
     if window is not None:
         # band lower bound: only the newest `window` positions attend
         valid &= jnp.arange(T)[None, :] >= (lengths[:, None] - window)
-    return _banded_sdpa(q, k, v, valid)
+    return _banded_sdpa(q, k, v, valid, softcap=softcap)
 
 
 # ---------------------------------------------------------------------------

@@ -70,8 +70,9 @@ class Ernie45ForCausalLM(LlamaMoEForCausalLM):
 
 
 def _hf_config_to_ernie45(hf_config, **overrides) -> Ernie45Config:
-    get = (hf_config.get if isinstance(hf_config, dict)
-           else lambda k, d=None: getattr(hf_config, k, d))
+    from .llama import _hf_get
+
+    get = _hf_get(hf_config)
     if get("use_bias", False):
         raise NotImplementedError(
             "ernie45_from_hf: use_bias=True checkpoints are not "

@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-from .llama import LlamaConfig, LlamaForCausalLM, _from_hf
+from .llama import LlamaConfig, LlamaForCausalLM, _from_hf, _hf_get
 
 
 @dataclasses.dataclass
@@ -85,8 +85,7 @@ def gemma_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
     """Build a GemmaForCausalLM from a transformers Gemma model (or a raw
     state dict + config)."""
     src = hf_config if hf_config is not None else hf_model_or_state.config
-    get = (src.get if isinstance(src, dict)
-           else lambda k, d=None: getattr(src, k, d))
+    get = _hf_get(src)
     # HF Gemma carries the real activation in hidden_activation (modeling
     # falls back to gelu_pytorch_tanh when unset); hidden_act in those
     # configs is vestigial

@@ -10,9 +10,11 @@ cached/serving machinery still applies:
 - ``query_pre_attn_scalar``: softmax scale folded into q after projection
   (LlamaAttention.q_premul — exact on every path since RoPE is linear);
 - tanh logit soft caps: ``attn_logit_softcapping`` on attention scores
-  (dense paths only — flash/paged/CP refuse loudly) and
-  ``final_logit_softcapping`` on the lm head (one override covers
-  training loss, generate, beam, and speculative paths);
+  (the flash kernel falls back to the dense path; paged decode rides the
+  exact gather reference, so the continuous-batching engine serves
+  softcapped models; CP refuses loudly) and ``final_logit_softcapping``
+  applied in the base lm_head_logits (training loss, generate, beam,
+  speculative, serving);
 - alternating sliding/full attention via the trunk ``layer_types``
   schedule.
 
@@ -27,7 +29,7 @@ from typing import Optional
 from ..nn.layer import Layer
 from .gemma import GemmaConfig
 from .llama import (LlamaAttention, LlamaForCausalLM, LlamaMLP, LlamaModel,
-                    LlamaRMSNorm, _from_hf, layer_window)
+                    LlamaRMSNorm, _from_hf, _hf_get, layer_window)
 
 
 @dataclasses.dataclass
@@ -130,8 +132,7 @@ def gemma2_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
     """Build a Gemma2ForCausalLM from a transformers Gemma2 model (or a
     raw state dict + config)."""
     src = hf_config if hf_config is not None else hf_model_or_state.config
-    get = (src.get if isinstance(src, dict)
-           else lambda k, d=None: getattr(src, k, d))
+    get = _hf_get(src)
     config_overrides.setdefault(
         "hidden_act", get("hidden_activation") or "gelu_pytorch_tanh")
     config_overrides.setdefault("rms_norm_offset", True)

@@ -30,7 +30,7 @@ from ..nn.layer import Layer
 from ..nn.initializer import Normal
 from ..ops.registry import apply
 from ..tensor_class import Tensor, unwrap, wrap
-from .llama import causal_lm_loss, tied_lm_head_logits
+from .llama import _hf_get, causal_lm_loss, tied_lm_head_logits
 
 
 @dataclasses.dataclass
@@ -329,8 +329,7 @@ def gpt2_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
         state = hf_model_or_state.state_dict()
     else:
         state = hf_model_or_state
-    get = (hf_config.get if isinstance(hf_config, dict)
-           else lambda k, d=None: getattr(hf_config, k, d))
+    get = _hf_get(hf_config)
     kw = dict(vocab_size=get("vocab_size"),
               hidden_size=get("n_embd", get("hidden_size")),
               num_hidden_layers=get("n_layer", get("num_hidden_layers")),

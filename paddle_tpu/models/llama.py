@@ -85,7 +85,8 @@ class LlamaConfig:
     # on every attention path including the Pallas kernels)
     query_pre_attn_scalar: Optional[float] = None
     # tanh soft cap on attention logits (Gemma2): cap*tanh(scores/cap).
-    # Only the dense attention paths implement it — flash/paged refuse
+    # Flash falls back to the dense path; paged decode uses the exact
+    # gather reference; CP refuses loudly
     attn_logit_softcapping: Optional[float] = None
     # tanh soft cap on the lm-head logits (Gemma2)
     final_logit_softcapping: Optional[float] = None
@@ -186,6 +187,13 @@ def _rope_type(scaling: Optional[dict]):
     if not scaling:
         return "default"
     return scaling.get("rope_type", scaling.get("type", None))
+
+
+def _hf_get(hf_config):
+    """Uniform accessor over a transformers config OBJECT or a raw dict —
+    the one idiom every hf_config_to_* mapper needs."""
+    return (hf_config.get if isinstance(hf_config, dict)
+            else lambda k, d=None: getattr(hf_config, k, d))
 
 
 def mapped_rope_scaling(get) -> Optional[dict]:
@@ -530,17 +538,12 @@ class LlamaAttention(Layer):
             from ..generation import cached_attention, paged_cached_attention
 
             if "k_pages" in kv_cache:
-                if softcap is not None:
-                    raise NotImplementedError(
-                        "attn_logit_softcapping is not supported on the "
-                        "paged decode path — serve softcapped models "
-                        "through the dense cache")
                 out, kp, vp = apply(
                     "llama_attention_paged", paged_cached_attention,
                     q, k, v, cos, sin, kv_cache["k_pages"],
                     kv_cache["v_pages"], kv_cache["page_indices"],
                     kv_cache["lengths"], kv_cache.get("page_size"),
-                    window=self.window)
+                    window=self.window, softcap=softcap)
                 result = self.o_proj(out.reshape([b, s, h * d]))
                 new = dict(kv_cache)
                 new.update(k_pages=kp, v_pages=vp,
@@ -1066,8 +1069,7 @@ def _hf_to_np(v):
 
 def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
     """Map a transformers LlamaConfig (object or dict) onto LlamaConfig."""
-    get = (hf_config.get if isinstance(hf_config, dict)
-           else lambda k, d=None: getattr(hf_config, k, d))
+    get = _hf_get(hf_config)
     # a Gemma checkpoint has EXACTLY Llama's key layout, so loading it
     # through the plain-llama mapper would succeed and silently compute
     # garbage ((1+w)-delta norms read as full weights, unscaled embeddings,

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 
-from .llama import mapped_rope_scaling
+from .llama import _hf_get, mapped_rope_scaling
 from .llama_moe import (LlamaMoEConfig, LlamaMoEForCausalLM,
                         load_hf_grouped_moe)
 
@@ -78,8 +78,7 @@ class MixtralForCausalLM(LlamaMoEForCausalLM):
 
 
 def _hf_config_to_mixtral(hf_config, **overrides) -> MixtralConfig:
-    get = (hf_config.get if isinstance(hf_config, dict)
-           else lambda k, d=None: getattr(hf_config, k, d))
+    get = _hf_get(hf_config)
     kw = dict(
         rope_scaling=mapped_rope_scaling(get),
         vocab_size=get("vocab_size"),

@@ -18,7 +18,8 @@ from __future__ import annotations
 
 import dataclasses
 
-from .llama import LlamaConfig, LlamaForCausalLM, _from_hf, _hf_to_np
+from .llama import (LlamaConfig, LlamaForCausalLM, _from_hf, _hf_get,
+                    _hf_to_np)
 
 
 @dataclasses.dataclass
@@ -55,8 +56,7 @@ def split_phi3_fused(hf_state_dict, hf_config):
     ``qkv_proj`` splits into q/k/v on the out dim (torch [out, in] rows),
     ``gate_up_proj`` into equal gate/up halves. Returns a new dict; all
     other keys pass through unchanged."""
-    get = (hf_config.get if isinstance(hf_config, dict)
-           else lambda k, d=None: getattr(hf_config, k, d))
+    get = _hf_get(hf_config)
     h = get("hidden_size")
     heads = get("num_attention_heads")
     kv = get("num_key_value_heads")
@@ -92,8 +92,7 @@ def phi3_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
         state = hf_model_or_state.state_dict()
     else:
         state = hf_model_or_state
-    get = (hf_config.get if isinstance(hf_config, dict)
-           else lambda k, d=None: getattr(hf_config, k, d))
+    get = _hf_get(hf_config)
     if (get("partial_rotary_factor") or 1.0) != 1.0:
         raise NotImplementedError(
             "phi3_from_hf: partial_rotary_factor != 1.0 is not supported")

@@ -20,7 +20,7 @@ import dataclasses
 
 import numpy as np
 
-from .llama import mapped_rope_scaling
+from .llama import _hf_get, mapped_rope_scaling
 from .llama_moe import (LlamaMoEConfig, LlamaMoEForCausalLM,
                         load_hf_grouped_moe)
 
@@ -71,8 +71,7 @@ class Qwen2MoeForCausalLM(LlamaMoEForCausalLM):
 
 
 def _hf_config_to_qwen2_moe(hf_config, **overrides) -> Qwen2MoeConfig:
-    get = (hf_config.get if isinstance(hf_config, dict)
-           else lambda k, d=None: getattr(hf_config, k, d))
+    get = _hf_get(hf_config)
     if get("decoder_sparse_step", 1) != 1 or get("mlp_only_layers", []):
         raise NotImplementedError(
             "qwen2_moe_from_hf: mixed sparse/dense layer patterns "

@@ -488,8 +488,9 @@ def t5_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
         state = hf_model_or_state.state_dict()
     else:
         state = hf_model_or_state
-    get = (hf_config.get if isinstance(hf_config, dict)
-           else lambda k, d=None: getattr(hf_config, k, d))
+    from .llama import _hf_get
+
+    get = _hf_get(hf_config)
     ff = get("feed_forward_proj", "relu")
     kw = dict(vocab_size=get("vocab_size"), d_model=get("d_model"),
               d_kv=get("d_kv"), d_ff=get("d_ff"),

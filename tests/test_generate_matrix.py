@@ -99,10 +99,9 @@ def test_cached_equals_no_cache(family_model):
 def test_cached_equals_paged(family_model):
     name, m = family_model
     x = _prompt(m)
-    if name in ("deepseek", "gemma2"):
-        # MLA's latent cache has no per-head pages by design; Gemma2's
-        # attention soft cap has no paged-kernel support — both must
-        # refuse loudly, not silently mis-decode
+    if name == "deepseek":
+        # MLA's latent cache has no per-head pages by design; the paged
+        # path must refuse loudly, not silently mis-decode
         with pytest.raises(NotImplementedError, match="paged"):
             m.generate(x, max_new_tokens=5, paged=True, page_size=4)
         return
