@@ -1,0 +1,80 @@
+"""Deploy a model behind the OpenAI-style HTTP endpoint and exercise it:
+health check, a batch completion, an SSE streaming completion, and two
+concurrent clients riding one continuous-batching engine in-flight.
+
+Run: JAX_PLATFORMS=cpu python examples/serve_http.py
+"""
+import http.client
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import ContinuousBatchEngine
+from paddle_tpu.serving_http import CompletionServer
+
+
+def post(addr, body, stream=False):
+    conn = http.client.HTTPConnection(*addr, timeout=300)
+    conn.request("POST", "/v1/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read().decode()
+    conn.close()
+    if stream:
+        return [line[len("data: "):] for line in raw.splitlines()
+                if line.startswith("data: ")]
+    return json.loads(raw)
+
+
+def main():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+    engine = ContinuousBatchEngine(model, max_batch=4, max_len=64,
+                                   page_size=8)
+    with CompletionServer(engine, model_name="tiny-llama") as srv:
+        addr = srv.address
+        conn = http.client.HTTPConnection(*addr, timeout=30)
+        conn.request("GET", "/health")
+        print("health:", json.loads(conn.getresponse().read()))
+        conn.close()
+
+        rng = np.random.RandomState(0)
+        out = post(addr, {"prompt_token_ids": rng.randint(1, 512, 8).tolist(),
+                          "max_tokens": 6})
+        print("completion:", out["choices"][0]["token_ids"],
+              out["usage"])
+
+        events = post(addr, {"prompt_token_ids":
+                             rng.randint(1, 512, 5).tolist(),
+                             "max_tokens": 5, "stream": True}, stream=True)
+        toks = [json.loads(e)["choices"][0]["token_ids"][0]
+                for e in events if e != "[DONE]"]
+        print("streamed:", toks, "| terminator:", events[-1])
+
+        results = {}
+
+        def client(name, n):
+            results[name] = post(
+                addr, {"prompt_token_ids": rng.randint(1, 512, n).tolist(),
+                       "max_tokens": 6})["choices"][0]["token_ids"]
+
+        a = threading.Thread(target=client, args=("a", 9))
+        b = threading.Thread(target=client, args=("b", 4))
+        a.start(); b.start(); a.join(); b.join()
+        print("concurrent:", results)
+
+
+if __name__ == "__main__":
+    main()

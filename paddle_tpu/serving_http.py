@@ -1,0 +1,300 @@
+"""OpenAI-style HTTP front-end over the continuous-batching engine.
+
+Deployment-surface parity: the reference ships its serving engine behind
+an HTTP deployment story (FastDeploy / Paddle Serving around the
+`block_multi_head_attention` runtime); this is the equivalent front door
+for paddle_tpu, stdlib-only (no web framework in the image):
+
+- ``POST /v1/completions`` — OpenAI completions shape: ``prompt`` (string,
+  needs a ``tokenizer``) or ``prompt_token_ids`` (list of ints, no
+  tokenizer needed), ``max_tokens``, ``temperature`` / ``top_k`` /
+  ``top_p`` (per-request sampling rides the engine's per-row program),
+  ``stream`` (SSE chunks per token, ``data: [DONE]`` terminator);
+- ``GET /v1/models`` and ``GET /health``.
+
+Single-engine-thread design: device state (page pool, slot buffers) is
+touched ONLY by the engine thread; HTTP handler threads enqueue
+submissions and wait on per-request queues fed by the engine's
+``on_token`` streaming callbacks. The engine thread interleaves admission
+and decode exactly like ``run_until_done`` — in-flight batching across
+concurrent HTTP clients is the whole point.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["CompletionServer", "serve"]
+
+
+class _Submission:
+    __slots__ = ("ids", "params", "events", "rid")
+
+    def __init__(self, ids, params):
+        self.ids = ids
+        self.params = params
+        self.events: "queue.Queue" = queue.Queue()
+        self.rid = None
+
+
+class CompletionServer:
+    """HTTP wrapper around one ContinuousBatchEngine.
+
+    ``tokenizer`` is optional and duck-typed (``encode(str) -> ids``,
+    ``decode(ids) -> str`` — a transformers tokenizer works); without one
+    the server speaks token ids (``prompt_token_ids`` in,
+    ``token_ids`` out).
+    """
+
+    def __init__(self, engine, tokenizer=None, model_name: str = "paddle-tpu",
+                 host: str = "127.0.0.1", port: int = 0):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self._subs: "queue.Queue[_Submission]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._engine_loop,
+                                        daemon=True, name="engine-loop")
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="http-loop")
+
+    # ---- lifecycle ----------------------------------------------------
+    @property
+    def address(self):
+        return self._httpd.server_address  # (host, port) — port resolved
+
+    def start(self):
+        self._thread.start()
+        self._http_thread.start()
+        return self
+
+    def close(self):
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---- engine thread -------------------------------------------------
+    def _engine_loop(self):
+        eng = self.engine
+        while not self._stop.is_set():
+            # drain submissions (engine thread is the ONLY device-state
+            # toucher; add_request allocates host-side, admission happens
+            # inside step)
+            drained = False
+            while True:
+                try:
+                    sub = self._subs.get_nowait()
+                except queue.Empty:
+                    break
+                drained = True
+                ev = sub.events
+
+                def on_token(rid, tok, done, _ev=ev):
+                    _ev.put(("token", tok, done))
+
+                try:
+                    sub.rid = eng.add_request(sub.ids, on_token=on_token,
+                                              **sub.params)
+                except ValueError as e:     # client error -> HTTP 400
+                    ev.put(("error", str(e), True))
+                except Exception as e:      # engine fault -> HTTP 500
+                    ev.put(("fault", str(e), True))
+            if eng.num_active or getattr(eng, "_queue", None):
+                try:
+                    eng.step()
+                except Exception:
+                    # a failed step (poisoned engine, device fault) must
+                    # not hang clients: stop the loop; waiting handlers
+                    # time out against _stop and answer 500
+                    self._stop.set()
+                    raise
+            elif not drained:
+                # idle: block briefly on the submission queue
+                try:
+                    sub = self._subs.get(timeout=0.05)
+                    self._subs.put(sub)   # handle on the next iteration
+                except queue.Empty:
+                    pass
+
+    # ---- HTTP ----------------------------------------------------------
+    def _make_handler(server_self):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # silence request logging
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    eng = server_self.engine
+                    return self._json(200, {
+                        "status": "ok",
+                        "active": int(eng.num_active),
+                        "queued": len(getattr(eng, "_queue", ())),
+                        "max_batch": eng.max_batch,
+                        "max_len": eng.max_len,
+                    })
+                if self.path == "/v1/models":
+                    return self._json(200, {
+                        "object": "list",
+                        "data": [{"id": server_self.model_name,
+                                  "object": "model"}],
+                    })
+                return self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/completions":
+                    return self._json(404, {"error": "not found"})
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except Exception:
+                    return self._json(400, {"error": "invalid JSON body"})
+                try:
+                    ids = server_self._prompt_ids(req)
+                    params = dict(
+                        max_new_tokens=int(req.get("max_tokens", 16)))
+                    if ("temperature" in req or "top_p" in req
+                            or "top_k" in req or req.get("do_sample")):
+                        params.update(
+                            do_sample=True,
+                            temperature=float(req.get("temperature", 1.0)),
+                            top_k=int(req.get("top_k", 0)),
+                            top_p=float(req.get("top_p", 1.0)))
+                except (ValueError, TypeError) as e:
+                    # wrong-typed fields answer 400, not a dropped socket
+                    return self._json(400, {"error": str(e)})
+                sub = _Submission(ids, params)
+                server_self._subs.put(sub)
+                cid = f"cmpl-{uuid.uuid4().hex[:24]}"
+                if req.get("stream"):
+                    return self._stream(sub, cid, len(ids))
+                toks, err = [], None
+                while True:
+                    try:
+                        kind, payload, done = sub.events.get(timeout=1.0)
+                    except queue.Empty:
+                        if server_self._stop.is_set():
+                            return self._json(500,
+                                              {"error": "engine stopped"})
+                        continue
+                    if kind in ("error", "fault"):
+                        err = (kind, payload)
+                        break
+                    toks.append(int(payload))
+                    if done:
+                        break
+                if err is not None:
+                    kind, msg = err
+                    return self._json(400 if kind == "error" else 500,
+                                      {"error": msg})
+                eos = server_self.engine.eos_token_id
+                reason = ("stop" if eos is not None and toks
+                          and toks[-1] == eos else "length")
+                choice = {"index": 0, "finish_reason": reason,
+                          "token_ids": toks}
+                if server_self.tokenizer is not None:
+                    choice["text"] = server_self.tokenizer.decode(toks)
+                return self._json(200, {
+                    "id": cid, "object": "text_completion",
+                    "model": server_self.model_name,
+                    "choices": [choice],
+                    "usage": {"prompt_tokens": len(ids),
+                              "completion_tokens": len(toks),
+                              "total_tokens": len(ids) + len(toks)},
+                })
+
+            def _stream(self, sub, cid, n_prompt):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(payload: bytes):
+                    self.wfile.write(f"{len(payload):X}\r\n".encode()
+                                     + payload + b"\r\n")
+
+                while True:
+                    try:
+                        kind, payload, done = sub.events.get(timeout=1.0)
+                    except queue.Empty:
+                        if server_self._stop.is_set():
+                            chunk(b'data: {"error": "engine stopped"}\n\n')
+                            break
+                        continue
+                    if kind in ("error", "fault"):
+                        chunk(b'data: {"error": '
+                              + json.dumps(str(payload)).encode() + b"}\n\n")
+                        break
+                    piece = {"id": cid, "object": "text_completion",
+                             "choices": [{"index": 0,
+                                          "token_ids": [int(payload)]}]}
+                    if server_self.tokenizer is not None:
+                        piece["choices"][0]["text"] = (
+                            server_self.tokenizer.decode([int(payload)]))
+                    chunk(b"data: " + json.dumps(piece).encode() + b"\n\n")
+                    if done:
+                        break
+                chunk(b"data: [DONE]\n\n")
+                chunk(b"")  # chunked-encoding terminator
+
+        return Handler
+
+    def _prompt_ids(self, req):
+        if "prompt_token_ids" in req:
+            ids = req["prompt_token_ids"]
+            if (not isinstance(ids, list)
+                    or not all(isinstance(i, int) for i in ids)):
+                raise ValueError("prompt_token_ids must be a list of ints")
+            return ids
+        prompt = req.get("prompt")
+        if prompt is None:
+            raise ValueError("provide prompt or prompt_token_ids")
+        if self.tokenizer is None:
+            raise ValueError(
+                "string prompts need the server constructed with a "
+                "tokenizer; send prompt_token_ids instead")
+        return list(self.tokenizer.encode(prompt))
+
+
+def serve(model, *, max_batch=8, max_len=512, page_size=16, tokenizer=None,
+          host="127.0.0.1", port=8000, **engine_kwargs):
+    """One-call deployment: build the engine, start the server, block.
+
+    >>> from paddle_tpu.serving_http import serve
+    >>> serve(model, tokenizer=tok, port=8000)      # doctest: +SKIP
+    """
+    from .serving import ContinuousBatchEngine
+
+    eng = ContinuousBatchEngine(model, max_batch=max_batch, max_len=max_len,
+                                page_size=page_size, **engine_kwargs)
+    srv = CompletionServer(eng, tokenizer=tokenizer, host=host, port=port)
+    srv.start()
+    try:
+        srv._http_thread.join()
+    except KeyboardInterrupt:
+        srv.close()
+    return srv

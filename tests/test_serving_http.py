@@ -1,0 +1,179 @@
+"""HTTP serving front-end: completions (batch + streaming SSE), per-request
+sampling overrides, concurrent clients riding one continuous-batching
+engine, tokenizer-optional operation, error paths."""
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import ContinuousBatchEngine
+from paddle_tpu.serving_http import CompletionServer
+
+
+@pytest.fixture(scope="module")
+def served():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+    eng = ContinuousBatchEngine(model, max_batch=4, max_len=64, page_size=8)
+    srv = CompletionServer(eng, model_name="tiny-llama").start()
+    yield model, srv
+    srv.close()
+
+
+def _post(srv, path, body):
+    host, port = srv.address
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _get(srv, path):
+    host, port = srv.address
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = json.loads(resp.read())
+    conn.close()
+    return resp.status, data
+
+
+def test_completion_matches_solo_generate(served):
+    model, srv = served
+    prompt = np.random.RandomState(0).randint(1, 512, (9,)).tolist()
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=6).numpy()[0].tolist()
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 6})
+    assert status == 200
+    out = json.loads(data)
+    assert out["object"] == "text_completion"
+    assert out["choices"][0]["token_ids"] == solo
+    assert out["usage"]["completion_tokens"] == 6
+
+
+def test_streaming_sse(served):
+    model, srv = served
+    prompt = np.random.RandomState(1).randint(1, 512, (7,)).tolist()
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=5).numpy()[0].tolist()
+    host, port = srv.address
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt_token_ids": prompt, "max_tokens": 5,
+                             "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    raw = resp.read().decode()
+    conn.close()
+    events = [line[len("data: "):] for line in raw.splitlines()
+              if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    toks = [json.loads(e)["choices"][0]["token_ids"][0]
+            for e in events[:-1]]
+    assert toks == solo
+
+
+def test_concurrent_clients_in_flight(served):
+    model, srv = served
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(1, 512, (n,)).tolist() for n in (8, 5, 11)]
+    solos = [model.generate(paddle.to_tensor(np.asarray(p)[None]),
+                            max_new_tokens=6).numpy()[0].tolist()
+             for p in prompts]
+    results = [None] * len(prompts)
+
+    def worker(i):
+        status, data = _post(srv, "/v1/completions",
+                             {"prompt_token_ids": prompts[i],
+                              "max_tokens": 6})
+        results[i] = (status, json.loads(data))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    for i, (status, out) in enumerate(results):
+        assert status == 200
+        assert out["choices"][0]["token_ids"] == solos[i], i
+
+
+def test_sampling_override_and_reproducibility(served):
+    model, srv = served
+    prompt = np.random.RandomState(3).randint(1, 512, (6,)).tolist()
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 8,
+                          "temperature": 0.7, "top_k": 40})
+    assert status == 200
+    out = json.loads(data)
+    assert len(out["choices"][0]["token_ids"]) == 8
+
+
+def test_error_paths(served):
+    _, srv = served
+    status, data = _post(srv, "/v1/completions", {"max_tokens": 4})
+    assert status == 400 and b"prompt" in data
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt": "hello", "max_tokens": 4})
+    assert status == 400 and b"tokenizer" in data
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": [1] * 100, "max_tokens": 10})
+    assert status == 400 and b"max_len" in data
+    status, _ = _post(srv, "/v1/nope", {})
+    assert status == 404
+    # wrong-TYPED fields answer 400, not a dropped connection
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": [1, 2], "max_tokens": "ten"})
+    assert status == 400
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": [1, 2], "do_sample": True,
+                          "temperature": None})
+    assert status == 400
+
+
+def test_health_and_models(served):
+    _, srv = served
+    status, health = _get(srv, "/health")
+    assert status == 200 and health["status"] == "ok"
+    assert health["max_batch"] == 4
+    status, models = _get(srv, "/v1/models")
+    assert status == 200
+    assert models["data"][0]["id"] == "tiny-llama"
+
+
+def test_string_prompt_with_tokenizer():
+    """Duck-typed tokenizer: encode/decode round-trips through the server."""
+
+    class ToyTok:
+        def encode(self, s):
+            return [ord(c) % 256 + 1 for c in s]
+
+        def decode(self, ids):
+            return "".join(chr((i - 1) % 256) for i in ids)
+
+    paddle.seed(1)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+    eng = ContinuousBatchEngine(model, max_batch=2, max_len=64, page_size=8)
+    with CompletionServer(eng, tokenizer=ToyTok()) as srv:
+        tok = ToyTok()
+        prompt = "hello tpu"
+        ids = tok.encode(prompt)
+        solo = model.generate(paddle.to_tensor(np.asarray(ids)[None]),
+                              max_new_tokens=5).numpy()[0].tolist()
+        status, data = _post(srv, "/v1/completions",
+                             {"prompt": prompt, "max_tokens": 5})
+        assert status == 200
+        out = json.loads(data)
+        assert out["choices"][0]["token_ids"] == solo
+        assert out["choices"][0]["text"] == tok.decode(solo)
