@@ -63,6 +63,11 @@ def main():
             M.DeepseekV2Config.tiny_mla(vocab_size=256))),
         ("deepseek-v3", M.DeepseekV2ForCausalLM(
             M.DeepseekV2Config.tiny_v3(vocab_size=256))),
+        ("llava", M.LlavaForConditionalGeneration(M.LlavaConfig(
+            text_config=M.LlamaConfig.tiny(num_hidden_layers=2,
+                                           vocab_size=256),
+            vision_config=M.CLIPVisionConfig.tiny(),
+            image_token_index=255))),
         ("t5", M.T5ForConditionalGeneration(M.T5Config.tiny(vocab_size=256))),
         ("bart", M.BartForConditionalGeneration(
             M.BartConfig.tiny(vocab_size=256))),
@@ -71,6 +76,16 @@ def main():
         out = model.generate(ids, max_new_tokens=6)
         params = model.num_parameters() / 1e6
         print(f"{name:>10} ({params:5.2f}M params): {out.numpy()[0].tolist()}")
+
+    # multimodal: the llava member again, now WITH an image — placeholder
+    # tokens in the prompt are replaced by projected CLIP patch features
+    llava = dict(zoo)["llava"]
+    mm_ids = rng.randint(2, 250, (1, 10))
+    mm_ids[0, 2:6] = 255                      # 4 patches at 16px/8px
+    pixels = paddle.to_tensor(rng.randn(1, 3, 16, 16).astype("float32"))
+    mm_out = llava.generate(paddle.to_tensor(mm_ids), pixel_values=pixels,
+                            max_new_tokens=6)
+    print(f"\n{'llava+img':>10}: {mm_out.numpy()[0].tolist()}")
 
     # one engine per family class, three families served in-flight
     from paddle_tpu.serving import ContinuousBatchEngine

@@ -47,6 +47,12 @@ _LAZY = {
     "Gemma2Config": ("gemma2", "Gemma2Config"),
     "Gemma2ForCausalLM": ("gemma2", "Gemma2ForCausalLM"),
     "gemma2_from_hf": ("gemma2", "gemma2_from_hf"),
+    "llava": ("llava", None),
+    "LlavaConfig": ("llava", "LlavaConfig"),
+    "LlavaForConditionalGeneration": ("llava", "LlavaForConditionalGeneration"),
+    "CLIPVisionConfig": ("llava", "CLIPVisionConfig"),
+    "CLIPVisionTower": ("llava", "CLIPVisionTower"),
+    "llava_from_hf": ("llava", "llava_from_hf"),
     "mixtral": ("mixtral", None),
     "MixtralConfig": ("mixtral", "MixtralConfig"),
     "MixtralForCausalLM": ("mixtral", "MixtralForCausalLM"),

@@ -748,11 +748,18 @@ class LlamaModel(Layer):
             pass
         return pair
 
-    def forward(self, input_ids, attention_mask=None, return_prenorm=False):
-        s = input_ids.shape[1]
+    def forward(self, input_ids, attention_mask=None, return_prenorm=False,
+                inputs_embeds=None):
+        s = (input_ids if inputs_embeds is None else inputs_embeds).shape[1]
         cos, sin = self._rope(s)
-        hidden = self.embed_tokens(input_ids)
-        hidden = _scale_embed(hidden.astype(self.config.dtype), self.config)
+        if inputs_embeds is None:
+            hidden = self.embed_tokens(input_ids)
+            hidden = _scale_embed(hidden.astype(self.config.dtype),
+                                  self.config)
+        else:
+            # multimodal path (LLaVA): embeddings already merged with image
+            # features — scaling (if any) was applied at merge time
+            hidden = inputs_embeds
         for layer in self.layers:
             hidden = layer(hidden, cos, sin, attention_mask)
         if return_prenorm:
@@ -762,14 +769,20 @@ class LlamaModel(Layer):
         return self.norm(hidden)
 
     def forward_cached(self, input_ids, kv_caches, rope_len,
-                       return_prenorm=False):
+                       return_prenorm=False, inputs_embeds=None):
         """Decode-path forward over static KV caches (one dict per layer,
         see generation.cached_attention). Returns (hidden, new_caches) —
         or (normed, prenorm, new_caches) with ``return_prenorm`` (the MTP
-        speculative draft consumes the pre-norm stream)."""
+        speculative draft consumes the pre-norm stream).
+        ``inputs_embeds``: pre-merged embeddings (LLaVA prefill) — skips
+        the token embedding."""
         cos, sin = self._rope(rope_len)
-        hidden = self.embed_tokens(input_ids)
-        hidden = _scale_embed(hidden.astype(self.config.dtype), self.config)
+        if inputs_embeds is None:
+            hidden = self.embed_tokens(input_ids)
+            hidden = _scale_embed(hidden.astype(self.config.dtype),
+                                  self.config)
+        else:
+            hidden = inputs_embeds
         new_caches = []
         for layer, cache in zip(self.layers, kv_caches):
             inner = getattr(layer, "inner", layer)  # unwrap RecomputeLayer
@@ -1125,7 +1138,8 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
 
 
 def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict,
-                  extra_layer_norms=()) -> "LlamaForCausalLM":
+                  extra_layer_norms=(),
+                  ignore_missing_prefixes=()) -> "LlamaForCausalLM":
     """Load a HuggingFace Llama checkpoint's state dict into ``model``.
 
     Accepts torch tensors or arrays. torch ``nn.Linear`` stores weights
@@ -1187,6 +1201,11 @@ def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict,
             f"{'...' if len(leftovers) > 5 else ''}")
     missing, unexpected = model.set_state_dict(mapped)
     assert not unexpected, unexpected  # plan keys come from named_parameters
+    if ignore_missing_prefixes:
+        # multimodal wrappers (LLaVA) load their non-language submodules
+        # through their own plan; those keys are legitimately absent here
+        missing = [m for m in missing
+                   if not m.startswith(tuple(ignore_missing_prefixes))]
     if missing:
         raise KeyError(f"load_hf_llama: model keys not covered: {missing[:5]}")
     return model

@@ -1,0 +1,448 @@
+"""LLaVA vision-language family (CLIP ViT tower + projector + Llama).
+
+First multimodal member of the zoo. Architecture (HF modeling_llava):
+
+- a CLIP vision transformer (conv patch embed + CLS + learned positions,
+  pre-LN encoder blocks with quick-gelu MLPs) run to a chosen hidden
+  layer (``vision_feature_layer``, default -2 — the PENULTIMATE block's
+  output, no final post-LN), CLS dropped under the "default" strategy;
+- a 2-linear gelu projector into the text embedding space;
+- a Llama trunk consuming MERGED embeddings: every ``image_token_index``
+  placeholder in the prompt is replaced by one projected patch feature
+  (the trunk's ``inputs_embeds`` path). Decode after the multimodal
+  prefill is the ordinary cached token path, so eos/sampling behave
+  exactly like the text families.
+
+``llava_from_hf`` converts a transformers ``LlavaForConditionalGeneration``
+checkpoint (vision tower + projector mapped here; the language model
+rides ``load_hf_llama`` on the re-prefixed subset).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..nn.layer import Layer
+from ..ops.registry import apply
+from ..tensor_class import Tensor, unwrap, wrap
+from .llama import (LlamaConfig, LlamaForCausalLM, _hf_get, _hf_to_np,
+                    hf_config_to_llama, load_hf_llama)
+
+
+@dataclasses.dataclass
+class CLIPVisionConfig:
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    image_size: int = 336
+    patch_size: int = 14
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-5
+    dtype: str = "float32"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    image_size=16, patch_size=8)
+        base.update(kw)
+        return CLIPVisionConfig(**base)
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class CLIPAttention(Layer):
+    """Bidirectional MHA with q/k/v/out biases (the CLIP block)."""
+
+    def __init__(self, config: CLIPVisionConfig):
+        super().__init__(dtype=config.dtype)
+        d = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.q_proj = nn.Linear(d, d)
+        self.k_proj = nn.Linear(d, d)
+        self.v_proj = nn.Linear(d, d)
+        self.out_proj = nn.Linear(d, d)
+
+    def forward(self, x):
+        from ..nn.functional.attention import _sdpa_ref
+
+        b, s, d = x.shape
+        h = self.num_heads
+        q = self.q_proj(x).reshape([b, s, h, d // h])
+        k = self.k_proj(x).reshape([b, s, h, d // h])
+        v = self.v_proj(x).reshape([b, s, h, d // h])
+        out = apply("clip_attention",
+                    lambda q, k, v: _sdpa_ref(q, k, v, causal=False),
+                    q, k, v)
+        return self.out_proj(out.reshape([b, s, d]))
+
+
+class CLIPEncoderLayer(Layer):
+    def __init__(self, config: CLIPVisionConfig):
+        super().__init__(dtype=config.dtype)
+        d, eps = config.hidden_size, config.layer_norm_eps
+        self.layer_norm1 = nn.LayerNorm(d, epsilon=eps)
+        self.self_attn = CLIPAttention(config)
+        self.layer_norm2 = nn.LayerNorm(d, epsilon=eps)
+        self.fc1 = nn.Linear(d, config.intermediate_size)
+        self.fc2 = nn.Linear(config.intermediate_size, d)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.layer_norm1(x))
+        h = self.fc1(self.layer_norm2(x))
+        h = apply("quick_gelu", quick_gelu, h)
+        return x + self.fc2(h)
+
+
+class CLIPVisionTower(Layer):
+    """CLIP ViT up to (and including) every encoder block — ``forward``
+    returns the list of per-block hidden states so the caller can select
+    ``vision_feature_layer`` (HF keeps them all too)."""
+
+    def __init__(self, config: CLIPVisionConfig):
+        super().__init__(dtype=config.dtype)
+        d = config.hidden_size
+        self.config = config
+        self.patch_embedding = nn.Conv2D(
+            config.num_channels, d, kernel_size=config.patch_size,
+            stride=config.patch_size, bias_attr=False)
+        self.class_embedding = self.create_parameter(
+            [d], default_initializer=nn.initializer.Normal(0.0, 0.02))
+        self.position_embedding = nn.Embedding(config.num_patches + 1, d)
+        self.pre_layrnorm = nn.LayerNorm(d, epsilon=config.layer_norm_eps)
+        self.layers = nn.LayerList(
+            [CLIPEncoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.post_layernorm = nn.LayerNorm(d, epsilon=config.layer_norm_eps)
+
+    def forward(self, pixel_values):
+        """pixel_values [B, C, H, W] -> [embeddings, block1, ..., blockN]
+        hidden states (each [B, 1 + num_patches, D])."""
+        b = pixel_values.shape[0]
+        patches = self.patch_embedding(pixel_values)       # [B, D, h, w]
+        d = patches.shape[1]
+        patches = patches.reshape([b, d, -1]).transpose([0, 2, 1])
+        cls = self.class_embedding.reshape([1, 1, d]).expand([b, 1, d])
+        from ..ops import manipulation as _manip
+
+        x = _manip.concat([cls, patches], axis=1)
+        pos = wrap(jnp.arange(x.shape[1], dtype=jnp.int32))
+        x = x + self.position_embedding(pos)
+        x = self.pre_layrnorm(x)
+        states = [x]
+        for layer in self.layers:
+            x = layer(x)
+            states.append(x)
+        return states
+
+
+class LlavaMultiModalProjector(Layer):
+    def __init__(self, vision_hidden: int, text_hidden: int,
+                 act: str = "gelu", dtype: str = "float32"):
+        super().__init__(dtype=dtype)
+        if act != "gelu":
+            raise NotImplementedError(
+                f"projector_hidden_act {act!r} not supported (gelu only)")
+        self.linear_1 = nn.Linear(vision_hidden, text_hidden)
+        self.linear_2 = nn.Linear(text_hidden, text_hidden)
+
+    def forward(self, x):
+        h = self.linear_1(x)
+        h = apply("gelu", lambda a: jax.nn.gelu(a, approximate=False), h)
+        return self.linear_2(h)
+
+
+@dataclasses.dataclass
+class LlavaConfig:
+    text_config: LlamaConfig = None
+    vision_config: CLIPVisionConfig = None
+    image_token_index: int = 32000
+    vision_feature_layer: int = -2
+    vision_feature_select_strategy: str = "default"
+    projector_hidden_act: str = "gelu"
+
+    def __post_init__(self):
+        if self.text_config is None:
+            self.text_config = LlamaConfig()
+        if self.vision_config is None:
+            self.vision_config = CLIPVisionConfig()
+        if self.vision_feature_select_strategy not in ("default", "full"):
+            raise ValueError(
+                "vision_feature_select_strategy must be 'default' or "
+                f"'full', got {self.vision_feature_select_strategy!r}")
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(
+            text_config=LlamaConfig.tiny(num_hidden_layers=2),
+            vision_config=CLIPVisionConfig.tiny(),
+            image_token_index=511)
+        base.update(kw)
+        return LlavaConfig(**base)
+
+
+class LlavaForConditionalGeneration(LlamaForCausalLM):
+    """LLaVA: CLIP tower + projector + the Llama trunk.
+
+    ``self.config`` is the TEXT config (the cache/generation machinery
+    reads it); the multimodal wiring lives in ``self.llava_config``."""
+
+    def __init__(self, config: LlavaConfig):
+        super().__init__(config.text_config)
+        self.llava_config = config
+        self.vision_tower = CLIPVisionTower(config.vision_config)
+        self.multi_modal_projector = LlavaMultiModalProjector(
+            config.vision_config.hidden_size,
+            config.text_config.hidden_size,
+            act=config.projector_hidden_act,
+            dtype=config.text_config.dtype)
+
+    # ---- vision ------------------------------------------------------
+    def get_image_features(self, pixel_values):
+        """[n_images, C, H, W] -> [n_images, n_feats, text_hidden]."""
+        states = self.vision_tower(pixel_values)
+        feats = states[self.llava_config.vision_feature_layer]
+        if self.llava_config.vision_feature_select_strategy == "default":
+            feats = feats[:, 1:]                     # drop CLS
+        return self.multi_modal_projector(feats)
+
+    def merge_multimodal(self, input_ids, pixel_values):
+        """Token embeddings with every image placeholder replaced by one
+        projected patch feature, in order. Every tensor op here is
+        tape-recorded (``apply``/Layer calls), so the vision tower and
+        projector receive gradients in multimodal training; only the
+        placeholder POSITIONS are computed eagerly from the (integer,
+        non-differentiable) ids."""
+        from .llama import _scale_embed
+
+        embeds = self.llama.embed_tokens(input_ids)
+        embeds = _scale_embed(embeds.astype(self.config.dtype),
+                              self.config)
+        if pixel_values is None:
+            return embeds
+        feats = self.get_image_features(pixel_values)
+        feats = feats.reshape([-1, feats.shape[-1]])
+        ids_np = np.asarray(unwrap(input_ids))
+        mask = ids_np == self.llava_config.image_token_index
+        n_slots = int(mask.sum())
+        if n_slots != feats.shape[0]:
+            raise ValueError(
+                f"prompt has {n_slots} image tokens but the images "
+                f"produce {feats.shape[0]} features")
+        b_idx, s_idx = np.nonzero(mask)
+
+        def scatter(e, f):
+            return e.at[b_idx, s_idx].set(f.astype(e.dtype))
+
+        return apply("multimodal_merge", scatter, embeds, feats)
+
+    # ---- text --------------------------------------------------------
+    def forward(self, input_ids, pixel_values=None, labels=None,
+                attention_mask=None):
+        embeds = self.merge_multimodal(input_ids, pixel_values)
+        hidden = self.llama(input_ids, attention_mask,
+                            inputs_embeds=embeds)
+        logits = self.lm_head_logits(hidden)
+        if labels is None:
+            return logits
+        from .llama import causal_lm_loss
+
+        return causal_lm_loss(logits, labels), logits
+
+    def generate(self, input_ids, pixel_values=None, max_new_tokens=20,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 eos_token_id=None, **unsupported):
+        """Multimodal generate: merged-embedding cached prefill, then the
+        ordinary token decode loop. Text-only calls (no pixel_values)
+        defer to the full-featured base generate()."""
+        if pixel_values is None:
+            return super().generate(
+                input_ids, max_new_tokens=max_new_tokens,
+                do_sample=do_sample, temperature=temperature, top_k=top_k,
+                top_p=top_p, eos_token_id=eos_token_id, **unsupported)
+        for k, v in unsupported.items():
+            if v not in (None, False, 0, 1, 1.0, True):
+                raise NotImplementedError(
+                    f"llava generate with pixel_values: {k}={v!r} is not "
+                    "supported")
+        from ..framework import random as _random
+        from ..generation import _empty_caches, sample_logits_rows
+
+        ids = input_ids if isinstance(input_ids, Tensor) else wrap(
+            jnp.asarray(np.asarray(input_ids)))
+        B, S0 = ids.shape
+        if max_new_tokens <= 0:
+            return wrap(jnp.zeros((B, 0), jnp.int32))
+        max_len = S0 + max_new_tokens
+        embeds = self.merge_multimodal(ids, pixel_values)
+        caches = _empty_caches(self, B, max_len)
+        hidden, caches = self.llama.forward_cached(
+            ids, caches, rope_len=max_len, inputs_embeds=embeds)
+        # slice the last position BEFORE the lm head: the vocab matmul
+        # runs on [B, 1, H], not the whole prompt
+        last = unwrap(self.lm_head_logits(hidden[:, -1:]))[:, -1, :]
+        out = []
+        finished = np.zeros((B,), bool)
+        for _ in range(max_new_tokens):
+            if do_sample:
+                nxt = sample_logits_rows(
+                    jnp.asarray(last), _random.next_key(),
+                    jnp.full((B,), True),
+                    jnp.full((B,), float(temperature), jnp.float32),
+                    jnp.full((B,), int(top_k), jnp.int32),
+                    jnp.full((B,), float(top_p), jnp.float32))
+            else:
+                nxt = jnp.argmax(jnp.asarray(last), axis=-1)
+            tok = np.asarray(nxt, np.int64)
+            if eos_token_id is not None:
+                tok = np.where(finished, eos_token_id, tok)
+                finished |= tok == eos_token_id
+            out.append(tok)
+            if eos_token_id is not None and finished.all():
+                break
+            hidden, caches = self.llama.forward_cached(
+                wrap(jnp.asarray(tok[:, None], jnp.int32)), caches,
+                rope_len=max_len)
+            last = unwrap(self.lm_head_logits(hidden))[:, -1, :]
+        return wrap(jnp.asarray(np.stack(out, axis=1)))
+
+
+# ---- HF interop ------------------------------------------------------------
+
+def _hf_config_to_llava(hf_config, **overrides) -> LlavaConfig:
+    get = _hf_get(hf_config)
+    vc = get("vision_config")
+    vget = _hf_get(vc if isinstance(vc, dict) else vc.to_dict()
+                   if hasattr(vc, "to_dict") else vc)
+    if vget("hidden_act", "quick_gelu") != "quick_gelu":
+        raise NotImplementedError(
+            "CLIP tower supports hidden_act='quick_gelu' only")
+    vision = CLIPVisionConfig(
+        hidden_size=vget("hidden_size"),
+        intermediate_size=vget("intermediate_size"),
+        num_hidden_layers=vget("num_hidden_layers"),
+        num_attention_heads=vget("num_attention_heads"),
+        image_size=vget("image_size"),
+        patch_size=vget("patch_size"),
+        num_channels=vget("num_channels", 3),
+        layer_norm_eps=vget("layer_norm_eps", 1e-5))
+    tc = get("text_config")
+    text_overrides = overrides.pop("text_overrides", {})
+    text = hf_config_to_llama(
+        tc if isinstance(tc, dict) else tc, **text_overrides)
+    kw = dict(
+        text_config=text, vision_config=vision,
+        image_token_index=get("image_token_index", 32000),
+        vision_feature_layer=get("vision_feature_layer", -2),
+        vision_feature_select_strategy=get(
+            "vision_feature_select_strategy", "default"),
+        projector_hidden_act=get("projector_hidden_act", "gelu"))
+    kw.update(overrides)
+    return LlavaConfig(**kw)
+
+
+def load_hf_llava(model: LlavaForConditionalGeneration,
+                  hf_state_dict) -> LlavaForConditionalGeneration:
+    """Load a transformers Llava state dict: the language model through
+    load_hf_llama on the re-prefixed subset; vision tower + projector
+    mapped here (torch Linear [out,in] transposes; conv stays)."""
+    lang, rest = {}, {}
+    for k, v in hf_state_dict.items():
+        for pre in ("model.language_model.", "language_model.model."):
+            if k.startswith(pre):
+                lang["model." + k[len(pre):]] = v
+                break
+        else:
+            if k in ("lm_head.weight", "language_model.lm_head.weight"):
+                lang["lm_head.weight"] = v
+            else:
+                rest[k] = v
+    load_hf_llama(model, lang,
+                  ignore_missing_prefixes=("vision_tower",
+                                           "multi_modal_projector"))
+
+    mapped, consumed = {}, set()
+
+    def take(hf_key, transpose):
+        for pre in ("model.", ""):
+            if pre + hf_key in rest:
+                consumed.add(pre + hf_key)
+                v = _hf_to_np(rest[pre + hf_key])
+                return v.T if transpose else v
+        raise KeyError(f"load_hf_llava: missing {hf_key!r}")
+
+    vt, hf_vt = "vision_tower", "vision_tower.vision_model"
+    mapped[f"{vt}.patch_embedding.weight"] = take(
+        f"{hf_vt}.embeddings.patch_embedding.weight", False)
+    mapped[f"{vt}.class_embedding"] = take(
+        f"{hf_vt}.embeddings.class_embedding", False)
+    mapped[f"{vt}.position_embedding.weight"] = take(
+        f"{hf_vt}.embeddings.position_embedding.weight", False)
+    for norm in ("pre_layrnorm", "post_layernorm"):
+        for p in ("weight", "bias"):
+            mapped[f"{vt}.{norm}.{p}"] = take(f"{hf_vt}.{norm}.{p}", False)
+    L = model.llava_config.vision_config.num_hidden_layers
+    for i in range(L):
+        ours, hf = f"{vt}.layers.{i}", f"{hf_vt}.encoder.layers.{i}"
+        for proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            mapped[f"{ours}.self_attn.{proj}.weight"] = take(
+                f"{hf}.self_attn.{proj}.weight", True)
+            mapped[f"{ours}.self_attn.{proj}.bias"] = take(
+                f"{hf}.self_attn.{proj}.bias", False)
+        for norm in ("layer_norm1", "layer_norm2"):
+            for p in ("weight", "bias"):
+                mapped[f"{ours}.{norm}.{p}"] = take(f"{hf}.{norm}.{p}",
+                                                    False)
+        for fc, hf_fc in (("fc1", "mlp.fc1"), ("fc2", "mlp.fc2")):
+            mapped[f"{ours}.{fc}.weight"] = take(f"{hf}.{hf_fc}.weight",
+                                                 True)
+            mapped[f"{ours}.{fc}.bias"] = take(f"{hf}.{hf_fc}.bias", False)
+    for lin in ("linear_1", "linear_2"):
+        mapped[f"multi_modal_projector.{lin}.weight"] = take(
+            f"multi_modal_projector.{lin}.weight", True)
+        mapped[f"multi_modal_projector.{lin}.bias"] = take(
+            f"multi_modal_projector.{lin}.bias", False)
+    leftovers = [k for k in rest if k not in consumed]
+    if leftovers:
+        raise ValueError(
+            f"load_hf_llava: checkpoint tensors this model cannot "
+            f"represent: {leftovers[:5]}"
+            f"{'...' if len(leftovers) > 5 else ''}")
+    missing, unexpected = model.set_state_dict(mapped)
+    assert not unexpected, unexpected
+    # the language-model keys were loaded by load_hf_llama above and are
+    # legitimately absent from `mapped`; only vision/projector keys must
+    # be fully covered here
+    vision_missing = [m for m in missing
+                      if m.startswith(("vision_tower",
+                                       "multi_modal_projector"))]
+    if vision_missing:
+        raise KeyError(
+            f"load_hf_llava: model keys not covered: {vision_missing[:5]}")
+    return model
+
+
+def llava_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a LlavaForConditionalGeneration from a transformers Llava
+    model (or a raw state dict + config). Text-config overrides go in
+    ``text_overrides={...}``."""
+    if hf_config is None:
+        hf_config = hf_model_or_state.config
+        state = hf_model_or_state.state_dict()
+    else:
+        state = hf_model_or_state
+    cfg = _hf_config_to_llava(hf_config, **config_overrides)
+    return load_hf_llava(LlavaForConditionalGeneration(cfg), state)

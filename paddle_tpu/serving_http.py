@@ -165,11 +165,18 @@ class CompletionServer:
                 return self._json(404, {"error": "not found"})
 
             def do_POST(self):
+                # drain the body FIRST: replying without reading it would
+                # desync a keep-alive connection (HTTP/1.1 is on), making
+                # the next request parse the unread bytes as a request line
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n)
+                except Exception:
+                    return self._json(400, {"error": "unreadable body"})
                 if self.path != "/v1/completions":
                     return self._json(404, {"error": "not found"})
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n) or b"{}")
+                    req = json.loads(body or b"{}")
                 except Exception:
                     return self._json(400, {"error": "invalid JSON body"})
                 try:
@@ -237,17 +244,20 @@ class CompletionServer:
                     self.wfile.write(f"{len(payload):X}\r\n".encode()
                                      + payload + b"\r\n")
 
+                clean = True
                 while True:
                     try:
                         kind, payload, done = sub.events.get(timeout=1.0)
                     except queue.Empty:
                         if server_self._stop.is_set():
                             chunk(b'data: {"error": "engine stopped"}\n\n')
+                            clean = False
                             break
                         continue
                     if kind in ("error", "fault"):
                         chunk(b'data: {"error": '
                               + json.dumps(str(payload)).encode() + b"}\n\n")
+                        clean = False
                         break
                     piece = {"id": cid, "object": "text_completion",
                              "choices": [{"index": 0,
@@ -258,7 +268,11 @@ class CompletionServer:
                     chunk(b"data: " + json.dumps(piece).encode() + b"\n\n")
                     if done:
                         break
-                chunk(b"data: [DONE]\n\n")
+                if clean:
+                    # [DONE] signals CLEAN completion only — an SSE client
+                    # watching for it must not mistake a failed stream for
+                    # success
+                    chunk(b"data: [DONE]\n\n")
                 chunk(b"")  # chunked-encoding terminator
 
         return Handler

@@ -1,0 +1,158 @@
+"""LLaVA vision-language family: CLIP tower parity, multimodal merge,
+logits + greedy generate parity against transformers, image-token count
+validation, text-only fallthrough."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llava import (CLIPVisionConfig, LlavaConfig,
+                                     LlavaForConditionalGeneration,
+                                     llava_from_hf)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+IMG = 511          # image_token_index in the tiny config
+
+
+def _tiny_hf():
+    from transformers import CLIPVisionConfig as HFVision
+    from transformers import LlamaConfig as HFLlama
+    from transformers import LlavaConfig as HFLlava
+    from transformers import LlavaForConditionalGeneration as HFModel
+
+    torch.manual_seed(0)
+    vision = HFVision(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      image_size=16, patch_size=8)
+    text = HFLlama(vocab_size=512, hidden_size=128, intermediate_size=256,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   num_key_value_heads=2, max_position_embeddings=256,
+                   rms_norm_eps=1e-5, pad_token_id=0)
+    cfg = HFLlava(vision_config=vision, text_config=text,
+                  image_token_index=IMG, vision_feature_layer=-2,
+                  vision_feature_select_strategy="default",
+                  attn_implementation="eager")
+    return HFModel(cfg).eval()
+
+
+def _inputs(n_img_tokens=4, seq=12, batch=1, seed=0):
+    """Prompt with an image placeholder run; 16x16 image with 8x8 patches
+    -> 4 features per image."""
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(1, 500, (batch, seq))
+    ids[:, 2:2 + n_img_tokens] = IMG
+    pixels = rng.randn(batch, 3, 16, 16).astype(np.float32)
+    return ids, pixels
+
+
+def test_logits_match_transformers():
+    hf = _tiny_hf()
+    ours = llava_from_hf(hf, text_overrides=dict(
+        dtype="float32", use_flash_attention=False))
+    ids, pixels = _inputs()
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(ids),
+                 pixel_values=torch.from_numpy(pixels)).logits.numpy()
+    got = ours(paddle.to_tensor(ids),
+               pixel_values=paddle.to_tensor(pixels)).numpy()
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+
+
+def test_generate_matches_transformers():
+    hf = _tiny_hf()
+    ours = llava_from_hf(hf, text_overrides=dict(
+        dtype="float32", use_flash_attention=False))
+    ids, pixels = _inputs(seed=1)
+    with torch.no_grad():
+        gref = hf.generate(input_ids=torch.from_numpy(ids),
+                           pixel_values=torch.from_numpy(pixels),
+                           max_new_tokens=6,
+                           do_sample=False).numpy()[:, ids.shape[1]:]
+    ggot = ours.generate(paddle.to_tensor(ids),
+                         pixel_values=paddle.to_tensor(pixels),
+                         max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(ggot, gref)
+
+
+def test_batch_of_images():
+    hf = _tiny_hf()
+    ours = llava_from_hf(hf, text_overrides=dict(
+        dtype="float32", use_flash_attention=False))
+    ids, pixels = _inputs(batch=2, seed=2)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(ids),
+                 pixel_values=torch.from_numpy(pixels)).logits.numpy()
+    got = ours(paddle.to_tensor(ids),
+               pixel_values=paddle.to_tensor(pixels)).numpy()
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+
+
+def test_image_token_count_validated():
+    paddle.seed(0)
+    m = LlavaForConditionalGeneration(LlavaConfig.tiny())
+    ids, pixels = _inputs(n_img_tokens=3)   # needs 4
+    with pytest.raises(ValueError, match="image tokens"):
+        m(paddle.to_tensor(ids), pixel_values=paddle.to_tensor(pixels))
+
+
+def test_text_only_paths():
+    """Without pixel_values the model is the plain Llama trunk: forward
+    agrees with merged-embeds, generate defers to the full base path."""
+    paddle.seed(1)
+    m = LlavaForConditionalGeneration(LlavaConfig.tiny())
+    ids = paddle.to_tensor(np.random.RandomState(3).randint(1, 500, (1, 8)))
+    logits = m(ids).numpy()
+    assert np.isfinite(logits).all()
+    a = m.generate(ids, max_new_tokens=5).numpy()
+    b = m.generate(ids, max_new_tokens=5, use_cache=False).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_trains_end_to_end():
+    """Gradient flows through tower + projector + trunk: the VALUES of
+    vision-side weights must change (a severed merge tape would still
+    decrease the loss from trunk grads alone — review r5)."""
+    from paddle_tpu import optimizer as opt
+
+    paddle.seed(2)
+    m = LlavaForConditionalGeneration(LlavaConfig.tiny())
+    ids, pixels = _inputs(seed=4)
+    x = paddle.to_tensor(ids)
+    pv = paddle.to_tensor(pixels)
+    y = paddle.to_tensor(np.random.RandomState(5).randint(1, 500, ids.shape))
+    before = {
+        "tower_fc1": np.array(m.vision_tower.layers[0].fc1.weight.numpy()),
+        "tower_patch": np.array(m.vision_tower.patch_embedding
+                                .weight.numpy()),
+        "proj": np.array(m.multi_modal_projector.linear_1.weight.numpy()),
+        "embed": np.array(m.llama.embed_tokens.weight.numpy()),
+    }
+
+    optimizer = opt.AdamW(1e-2, parameters=m.parameters())
+    losses = []
+    for _ in range(4):
+        loss, _ = m(x, pixel_values=pv, labels=y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    assert not np.allclose(before["tower_fc1"],
+                           m.vision_tower.layers[0].fc1.weight.numpy())
+    assert not np.allclose(before["tower_patch"],
+                           m.vision_tower.patch_embedding.weight.numpy())
+    assert not np.allclose(before["proj"],
+                           m.multi_modal_projector.linear_1.weight.numpy())
+    assert not np.allclose(before["embed"],
+                           m.llama.embed_tokens.weight.numpy())
+
+
+def test_generate_zero_tokens():
+    paddle.seed(3)
+    m = LlavaForConditionalGeneration(LlavaConfig.tiny())
+    ids, pixels = _inputs(seed=6)
+    out = m.generate(paddle.to_tensor(ids),
+                     pixel_values=paddle.to_tensor(pixels),
+                     max_new_tokens=0).numpy()
+    assert out.shape == (1, 0)

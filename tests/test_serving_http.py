@@ -142,6 +142,49 @@ def test_error_paths(served):
     assert status == 400
 
 
+def test_keepalive_connection_reuse(served):
+    """One HTTP/1.1 connection, three requests back to back — including a
+    404 POST whose body must be drained, or the next request on the same
+    socket desyncs (review r5)."""
+    model, srv = served
+    host, port = srv.address
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    prompt = np.random.RandomState(9).randint(1, 512, (5,)).tolist()
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=4).numpy()[0].tolist()
+    for path, body, want in (
+            ("/v1/completions", {"prompt_token_ids": prompt,
+                                 "max_tokens": 4}, 200),
+            ("/v1/other", {"prompt_token_ids": prompt}, 404),
+            ("/v1/completions", {"prompt_token_ids": prompt,
+                                 "max_tokens": 4}, 200)):
+        conn.request("POST", path, json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        assert resp.status == want, (path, resp.status)
+        if want == 200:
+            assert json.loads(data)["choices"][0]["token_ids"] == solo
+    conn.close()
+
+
+def test_streaming_error_has_no_done(served):
+    """A failed stream must NOT end with [DONE] — SSE clients watching for
+    it would report success."""
+    _, srv = served
+    host, port = srv.address
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt_token_ids": [1] * 100,
+                             "max_tokens": 10, "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read().decode()
+    conn.close()
+    assert "error" in raw
+    assert "[DONE]" not in raw
+
+
 def test_health_and_models(served):
     _, srv = served
     status, health = _get(srv, "/health")
