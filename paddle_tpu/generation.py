@@ -879,22 +879,30 @@ class _PrefillStep:
     logit. Eager prefill costs one device dispatch per op per layer; this is
     the serving path's second half of the TrainStep pattern."""
 
-    def __init__(self, model, max_len, ragged, rope_len=None):
+    def __init__(self, model, max_len, ragged, rope_len=None,
+                 embeds_input=False):
         # rope_len decouples the cos/sin table length from the cache
         # length: the serving engine prefills into a BUCKET-sized cache but
         # provisions rope at its max_len, so length-keyed rope regimes
-        # (Phi-3 longrope short/long factors) match its decode program
+        # (Phi-3 longrope short/long factors) match its decode program.
+        # embeds_input: the first call argument is pre-merged embeddings
+        # (multimodal admission) instead of token ids.
         rope_len = max_len if rope_len is None else rope_len
         self._model = model
 
-        def pure(state, ids, lengths, pad_mask):
+        def pure(state, ids_or_embeds, lengths, pad_mask):
             with _functional_weights(model, state), _tape.no_grad():
-                B = ids.shape[0]
+                B = ids_or_embeds.shape[0]
                 caches = _empty_caches(
                     model, B, max_len,
                     allowed=pad_mask if ragged else None)
-                hidden, caches = model.llama.forward_cached(
-                    wrap(ids), caches, rope_len=rope_len)
+                if embeds_input:
+                    hidden, caches = model.llama.forward_cached(
+                        None, caches, rope_len=rope_len,
+                        inputs_embeds=wrap(ids_or_embeds))
+                else:
+                    hidden, caches = model.llama.forward_cached(
+                        wrap(ids_or_embeds), caches, rope_len=rope_len)
                 h_last = jnp.take_along_axis(
                     unwrap(hidden),
                     (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)
@@ -927,6 +935,18 @@ def _memoized_step(model, attr, key, factory, maxsize=None):
     else:
         step._state = dict(model.functional_state())
     return step
+
+
+def _get_prefill_step_embeds(model, max_len, ragged, rope_len=None):
+    """Multimodal prefill: same jitted computation as _get_prefill_step,
+    but the first argument is PRE-MERGED embeddings (LLaVA image features
+    already scattered into the prompt) instead of token ids."""
+    return _memoized_step(model, "_prefill_steps_embeds",
+                          (max_len, ragged, rope_len),
+                          lambda: _PrefillStep(model, max_len, ragged,
+                                               rope_len=rope_len,
+                                               embeds_input=True),
+                          maxsize=16)
 
 
 def _get_prefill_step(model, max_len, ragged, rope_len=None):

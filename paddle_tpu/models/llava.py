@@ -219,13 +219,24 @@ class LlavaForConditionalGeneration(LlamaForCausalLM):
             feats = feats[:, 1:]                     # drop CLS
         return self.multi_modal_projector(feats)
 
-    def merge_multimodal(self, input_ids, pixel_values):
+    def features_per_image(self) -> int:
+        """Patch features each image contributes after the select
+        strategy (the "default" strategy drops CLS)."""
+        n = self.llava_config.vision_config.num_patches
+        if self.llava_config.vision_feature_select_strategy == "full":
+            n += 1
+        return n
+
+    def merge_multimodal(self, input_ids, pixel_values, n_feats=None):
         """Token embeddings with every image placeholder replaced by one
         projected patch feature, in order. Every tensor op here is
         tape-recorded (``apply``/Layer calls), so the vision tower and
-        projector receive gradients in multimodal training; only the
-        placeholder POSITIONS are computed eagerly from the (integer,
-        non-differentiable) ids."""
+        projector receive gradients in multimodal training.
+
+        Eager calls validate the placeholder count against the images and
+        locate positions on host; a TRACED caller (the serving engine's
+        jitted merge step) passes the pre-validated ``n_feats`` so the
+        positions come from a size-bounded ``jnp.nonzero`` instead."""
         from .llama import _scale_embed
 
         embeds = self.llama.embed_tokens(input_ids)
@@ -235,19 +246,22 @@ class LlavaForConditionalGeneration(LlamaForCausalLM):
             return embeds
         feats = self.get_image_features(pixel_values)
         feats = feats.reshape([-1, feats.shape[-1]])
-        ids_np = np.asarray(unwrap(input_ids))
-        mask = ids_np == self.llava_config.image_token_index
-        n_slots = int(mask.sum())
-        if n_slots != feats.shape[0]:
-            raise ValueError(
-                f"prompt has {n_slots} image tokens but the images "
-                f"produce {feats.shape[0]} features")
-        b_idx, s_idx = np.nonzero(mask)
+        if n_feats is None:
+            ids_np = np.asarray(unwrap(input_ids))
+            n_slots = int(
+                (ids_np == self.llava_config.image_token_index).sum())
+            if n_slots != feats.shape[0]:
+                raise ValueError(
+                    f"prompt has {n_slots} image tokens but the images "
+                    f"produce {feats.shape[0]} features")
+            n_feats = n_slots
+        tok = self.llava_config.image_token_index
 
-        def scatter(e, f):
+        def scatter(ids_arr, e, f):
+            b_idx, s_idx = jnp.nonzero(ids_arr == tok, size=n_feats)
             return e.at[b_idx, s_idx].set(f.astype(e.dtype))
 
-        return apply("multimodal_merge", scatter, embeds, feats)
+        return apply("multimodal_merge", scatter, input_ids, embeds, feats)
 
     # ---- text --------------------------------------------------------
     def forward(self, input_ids, pixel_values=None, labels=None,

@@ -41,10 +41,10 @@ def _page_tiles(buf, page_size):
 
 class _Request:
     __slots__ = ("rid", "ids", "max_new_tokens", "tokens", "slot", "sampling",
-                 "on_token")
+                 "on_token", "pixel_values")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
-                 on_token=None):
+                 on_token=None, pixel_values=None):
         self.rid = rid
         self.ids = np.asarray(ids).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -52,6 +52,7 @@ class _Request:
         self.slot = -1
         self.sampling = sampling  # (do_sample, temperature, top_k, top_p) or None
         self.on_token = on_token  # streaming callback (rid, token, done)
+        self.pixel_values = pixel_values  # multimodal prompt (LLaVA)
 
 
 class ContinuousBatchEngine:
@@ -127,7 +128,7 @@ class ContinuousBatchEngine:
     # ---- public API ---------------------------------------------------------
     def add_request(self, ids, max_new_tokens: int = 64, do_sample=None,
                     temperature=None, top_k=None, top_p=None,
-                    on_token=None) -> int:
+                    on_token=None, pixel_values=None) -> int:
         """Queue one request. Sampling knobs default to the engine-level
         configuration; any per-request override routes decoding through the
         per-row sampling program (one compiled step serves the whole mix).
@@ -135,7 +136,13 @@ class ContinuousBatchEngine:
         ``on_token(rid, token, done)`` streams each generated token as the
         engine's step that produced it completes (token-level streaming —
         the serving front-end's SSE hook); exceptions it raises propagate
-        out of step()/run_until_done()."""
+        out of step()/run_until_done().
+
+        ``pixel_values`` ([n_images, C, H, W]) serves a MULTIMODAL prompt:
+        admission merges projected image features into the placeholder
+        positions (model.merge_multimodal) and prefills over embeddings;
+        decode is ordinary token traffic, so text and image requests batch
+        in-flight together."""
         ids = np.asarray(unwrap(ids) if isinstance(ids, Tensor) else ids).reshape(-1)
         if ids.size + max_new_tokens > self.max_len:
             raise ValueError(
@@ -144,6 +151,32 @@ class ContinuousBatchEngine:
         if temperature is not None and temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature} "
                              "(0 decodes greedily)")
+        if pixel_values is not None:
+            if not hasattr(self.model, "merge_multimodal"):
+                raise TypeError(
+                    f"{type(self.model).__name__} is not multimodal — "
+                    "pixel_values needs a model with merge_multimodal "
+                    "(LLaVA)")
+            if self._latent_mode:
+                raise NotImplementedError(
+                    "multimodal admission is not supported in latent "
+                    "(MLA) mode")
+            from .tensor_class import wrap
+
+            if not isinstance(pixel_values, Tensor):
+                pixel_values = wrap(jnp.asarray(np.asarray(pixel_values)))
+            # malformed multimodal prompts must fail HERE, not out of a
+            # later step() that would abort unrelated in-flight serving
+            n_slots = int((np.asarray(ids)
+                           == self.model.llava_config.image_token_index)
+                          .sum())
+            want = (pixel_values.shape[0]
+                    * self.model.features_per_image())
+            if n_slots != want:
+                raise ValueError(
+                    f"prompt has {n_slots} image tokens but "
+                    f"{pixel_values.shape[0]} image(s) produce {want} "
+                    "features")
         sampling = None
         if any(v is not None for v in (do_sample, temperature, top_k, top_p)):
             eng_s, eng_t, eng_k, eng_p = self._sample_cfg
@@ -157,7 +190,7 @@ class ContinuousBatchEngine:
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(_Request(rid, ids, max_new_tokens, sampling,
-                                    on_token))
+                                    on_token, pixel_values=pixel_values))
         self._admit()
         return rid
 
@@ -313,15 +346,43 @@ class ContinuousBatchEngine:
                               (bucket, ps), build)
 
     # ---- prefix caching ------------------------------------------------------
+    def _multimodal_merge_fn(self, ids_shape, px_shape):
+        """Memoized jitted multimodal merge: tower + projector +
+        placeholder scatter as one dispatch (keyed on prompt/image
+        shapes). n_feats is static — add_request validated the count."""
+        from .autograd import tape as _tape
+        from .generation import _functional_weights
+        from .tensor_class import wrap
+
+        model = self.model
+        n_feats = int(px_shape[0]) * model.features_per_image()
+
+        def build():
+            def pure(state, ids, pixels):
+                with _functional_weights(model, state), _tape.no_grad():
+                    return unwrap(model.merge_multimodal(
+                        wrap(ids), wrap(pixels), n_feats=n_feats))
+
+            fn = jax.jit(pure)
+            step = lambda ids, pixels: fn(step._state, ids, pixels)
+            step._state = dict(model.functional_state())
+            return step
+
+        return _memoized_step(model, "_mm_merge_steps",
+                              (tuple(ids_shape), tuple(px_shape)), build,
+                              maxsize=16)
+
     def _find_shared_prefix(self, req: _Request):
         """Longest page-aligned token prefix shared with an ACTIVE slot's
         prompt. Capped one token short of the whole prompt (the suffix
         prefill needs at least one token to produce the slot's logits)."""
         ps = self.page_size
+        if req.pixel_values is not None:
+            return -1, 0
         cap = (int(req.ids.size) - 1) // ps
         best_slot, best_n = -1, 0
         for s, r in enumerate(self._slots):
-            if r is None or cap <= 0:
+            if r is None or cap <= 0 or r.pixel_values is not None:
                 continue
             c = min(cap * ps, (int(r.ids.size) // ps) * ps)
             if c <= 0:
@@ -567,16 +628,39 @@ class ContinuousBatchEngine:
         bucket)."""
         S0 = int(req.ids.size)
         bucket = self._bucket(S0)
+        ragged = S0 != bucket
+        pad_mask = None
+        if ragged:
+            pad_mask = jnp.zeros((1, bucket), bool).at[0, :S0].set(True)
+        if req.pixel_values is not None:
+            # multimodal admission: ONE jitted merge (vision tower +
+            # projector + placeholder scatter — eager would pay a device
+            # dispatch per op per tower layer on the serving hot path),
+            # then the jitted embeds-prefill
+            from .generation import _get_prefill_step_embeds
+
+            pixels = unwrap(req.pixel_values)
+            merged = self._multimodal_merge_fn(
+                (1, S0), pixels.shape)(
+                    jnp.asarray(np.asarray(req.ids)[None], jnp.int32),
+                    pixels)
+            # the image array is consumed; keep only the is-multimodal
+            # marker (prefix-cache exclusion) instead of pinning pixels
+            # in host memory for the request's whole decode lifetime
+            req.pixel_values = True
+            embeds = jnp.zeros((1, bucket, merged.shape[-1]),
+                               merged.dtype).at[:, :S0].set(merged)
+            prefill = _get_prefill_step_embeds(self.model, bucket, ragged,
+                                               rope_len=self.max_len)
+            last, caches = prefill(embeds, jnp.asarray([S0], jnp.int32),
+                                   pad_mask)
+            return last, caches, S0, bucket
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :S0] = req.ids
-        ragged = S0 != bucket
         # rope provisioned at the engine's max_len so length-keyed rope
         # regimes (longrope) agree between this prefill and the decode step
         prefill = _get_prefill_step(self.model, bucket, ragged,
                                     rope_len=self.max_len)
-        pad_mask = None
-        if ragged:
-            pad_mask = jnp.zeros((1, bucket), bool).at[0, :S0].set(True)
         last, caches = prefill(jnp.asarray(ids),
                                jnp.asarray([S0], jnp.int32), pad_mask)
         return last, caches, S0, bucket

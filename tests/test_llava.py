@@ -148,6 +148,76 @@ def test_trains_end_to_end():
                            m.llama.embed_tokens.weight.numpy())
 
 
+def test_engine_serves_multimodal():
+    """Multimodal continuous batching: engine == solo generate, and text
+    and image requests batch in-flight together."""
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    paddle.seed(4)
+    m = LlavaForConditionalGeneration(LlavaConfig.tiny())
+    rng = np.random.RandomState(7)
+    mm_ids = rng.randint(1, 500, (9,)); mm_ids[2:6] = IMG
+    pixels = rng.randn(1, 3, 16, 16).astype(np.float32)
+    txt_ids = rng.randint(1, 500, (6,))
+
+    mm_solo = m.generate(paddle.to_tensor(mm_ids[None]),
+                         pixel_values=paddle.to_tensor(pixels),
+                         max_new_tokens=6).numpy()[0]
+    txt_solo = m.generate(paddle.to_tensor(txt_ids[None]),
+                          max_new_tokens=6).numpy()[0]
+
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=32, page_size=8)
+    r_mm = eng.add_request(mm_ids.tolist(), max_new_tokens=6,
+                           pixel_values=pixels)
+    eng.step()                      # image request in flight...
+    r_txt = eng.add_request(txt_ids.tolist(), max_new_tokens=6)
+    res = eng.run_until_done()
+    np.testing.assert_array_equal(np.asarray(res[r_mm]), mm_solo)
+    np.testing.assert_array_equal(np.asarray(res[r_txt]), txt_solo)
+
+
+def test_engine_multimodal_distinct_images_same_tokens():
+    """Two requests with IDENTICAL token prompts but different images must
+    produce different continuations (and never alias KV through the
+    prefix cache)."""
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    paddle.seed(5)
+    m = LlavaForConditionalGeneration(LlavaConfig.tiny())
+    rng = np.random.RandomState(8)
+    ids = rng.randint(1, 500, (9,)); ids[2:6] = IMG
+    px1 = rng.randn(1, 3, 16, 16).astype(np.float32)
+    px2 = rng.randn(1, 3, 16, 16).astype(np.float32) * 3.0
+
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=32, page_size=8,
+                                enable_prefix_cache=True)
+    r1 = eng.add_request(ids.tolist(), max_new_tokens=6, pixel_values=px1)
+    eng.step()
+    r2 = eng.add_request(ids.tolist(), max_new_tokens=6, pixel_values=px2)
+    res = eng.run_until_done()
+    assert eng.prefix_pages_reused == 0
+    s1 = m.generate(paddle.to_tensor(ids[None]),
+                    pixel_values=paddle.to_tensor(px1),
+                    max_new_tokens=6).numpy()[0]
+    s2 = m.generate(paddle.to_tensor(ids[None]),
+                    pixel_values=paddle.to_tensor(px2),
+                    max_new_tokens=6).numpy()[0]
+    np.testing.assert_array_equal(np.asarray(res[r1]), s1)
+    np.testing.assert_array_equal(np.asarray(res[r2]), s2)
+
+
+def test_engine_rejects_pixels_for_text_models():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    paddle.seed(6)
+    m = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=32, page_size=8)
+    with pytest.raises(TypeError, match="multimodal"):
+        eng.add_request([1, 2, 3], max_new_tokens=4,
+                        pixel_values=np.zeros((1, 3, 16, 16), np.float32))
+
+
 def test_generate_zero_tokens():
     paddle.seed(3)
     m = LlavaForConditionalGeneration(LlavaConfig.tiny())
