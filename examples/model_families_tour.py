@@ -77,6 +77,13 @@ def main():
         params = model.num_parameters() / 1e6
         print(f"{name:>10} ({params:5.2f}M params): {out.numpy()[0].tolist()}")
 
+    # audio: whisper transcribes a mel spectrogram (encoder conv frontend
+    # + cross-attending decoder) through the same cached generate shape
+    wh = M.WhisperForConditionalGeneration(M.WhisperConfig.tiny())
+    mel = paddle.to_tensor(rng.randn(1, 8, 32).astype("float32"))
+    wh_out = wh.generate(mel, max_new_tokens=6, eos_token_id=None)
+    print(f"\n{'whisper':>10}: {wh_out.numpy()[0].tolist()}")
+
     # multimodal: the llava member again, now WITH an image — placeholder
     # tokens in the prompt are replaced by projected CLIP patch features
     llava = dict(zoo)["llava"]

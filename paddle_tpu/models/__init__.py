@@ -47,6 +47,10 @@ _LAZY = {
     "Gemma2Config": ("gemma2", "Gemma2Config"),
     "Gemma2ForCausalLM": ("gemma2", "Gemma2ForCausalLM"),
     "gemma2_from_hf": ("gemma2", "gemma2_from_hf"),
+    "whisper": ("whisper", None),
+    "WhisperConfig": ("whisper", "WhisperConfig"),
+    "WhisperForConditionalGeneration": ("whisper", "WhisperForConditionalGeneration"),
+    "whisper_from_hf": ("whisper", "whisper_from_hf"),
     "llava": ("llava", None),
     "LlavaConfig": ("llava", "LlavaConfig"),
     "LlavaForConditionalGeneration": ("llava", "LlavaForConditionalGeneration"),

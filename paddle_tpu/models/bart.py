@@ -26,6 +26,10 @@ from ..nn.layer import Layer
 from ..ops.registry import apply
 from ..tensor_class import Tensor, Parameter, unwrap, wrap
 
+# sentinel: "caller did not pass eos_token_id" — maps to the config
+# default; an explicit None DISABLES eos (matching the decoder-only
+# families' semantics)
+_UNSET = object()
 
 @dataclasses.dataclass
 class BartConfig:
@@ -330,7 +334,7 @@ class BartForConditionalGeneration(Layer):
         return self_caches, cross_caches
 
     def generate(self, input_ids, max_new_tokens=20, do_sample=False,
-                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=_UNSET,
                  attention_mask=None, num_beams=1, length_penalty=1.0,
                  early_stopping=False, **unsupported):
         from ..generation import reject_non_default_kwargs
@@ -346,7 +350,7 @@ class BartForConditionalGeneration(Layer):
         from ..generation import _select, encdec_beam_generate
 
         cfg = self.config
-        eos = cfg.eos_token_id if eos_token_id is None else eos_token_id
+        eos = cfg.eos_token_id if eos_token_id is _UNSET else eos_token_id
         ids = unwrap(input_ids) if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         B = ids.shape[0]

@@ -219,6 +219,12 @@ class LlavaForConditionalGeneration(LlamaForCausalLM):
             feats = feats[:, 1:]                     # drop CLS
         return self.multi_modal_projector(feats)
 
+    @property
+    def multimodal_token_index(self) -> int:
+        """The placeholder token id — with merge_multimodal and
+        features_per_image, the serving engine's multimodal contract."""
+        return self.llava_config.image_token_index
+
     def features_per_image(self) -> int:
         """Patch features each image contributes after the select
         strategy (the "default" strategy drops CLS)."""

@@ -31,6 +31,10 @@ from ..ops.registry import apply
 from ..ops.pallas import fused_norm
 from ..tensor_class import Tensor, unwrap, wrap
 
+# sentinel: "caller did not pass eos_token_id" — maps to the config
+# default; an explicit None DISABLES eos (matching the decoder-only
+# families' semantics)
+_UNSET = object()
 
 @dataclasses.dataclass
 class T5Config:
@@ -383,7 +387,7 @@ class T5ForConditionalGeneration(Layer):
         return self_caches, cross_caches
 
     def generate(self, input_ids, max_new_tokens=20, do_sample=False,
-                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=_UNSET,
                  attention_mask=None, num_beams=1, length_penalty=1.0,
                  early_stopping=False, **unsupported):
         """Encoder once, then jitted cached decoder steps from
@@ -403,7 +407,7 @@ class T5ForConditionalGeneration(Layer):
         from ..generation import _select, encdec_beam_generate
 
         cfg = self.config
-        eos = cfg.eos_token_id if eos_token_id is None else eos_token_id
+        eos = cfg.eos_token_id if eos_token_id is _UNSET else eos_token_id
         ids = unwrap(input_ids) if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         B = ids.shape[0]

@@ -152,11 +152,17 @@ class ContinuousBatchEngine:
             raise ValueError(f"temperature must be >= 0, got {temperature} "
                              "(0 decodes greedily)")
         if pixel_values is not None:
-            if not hasattr(self.model, "merge_multimodal"):
+            # the multimodal model contract: merge_multimodal +
+            # multimodal_token_index + features_per_image (LLaVA
+            # implements it; the engine never reaches into family config)
+            if not all(hasattr(self.model, a) for a in
+                       ("merge_multimodal", "multimodal_token_index",
+                        "features_per_image")):
                 raise TypeError(
                     f"{type(self.model).__name__} is not multimodal — "
-                    "pixel_values needs a model with merge_multimodal "
-                    "(LLaVA)")
+                    "pixel_values needs a model implementing "
+                    "merge_multimodal / multimodal_token_index / "
+                    "features_per_image (LLaVA)")
             if self._latent_mode:
                 raise NotImplementedError(
                     "multimodal admission is not supported in latent "
@@ -168,8 +174,7 @@ class ContinuousBatchEngine:
             # malformed multimodal prompts must fail HERE, not out of a
             # later step() that would abort unrelated in-flight serving
             n_slots = int((np.asarray(ids)
-                           == self.model.llava_config.image_token_index)
-                          .sum())
+                           == self.model.multimodal_token_index).sum())
             want = (pixel_values.shape[0]
                     * self.model.features_per_image())
             if n_slots != want:
