@@ -293,12 +293,13 @@ def serve_bench(devs, gen):
     slots, max_len, n_req = (16, 512, 48) if on_tpu else (4, 64, 8)
     paddle.seed(0)
     quantized = bool(os.environ.get("BENCH_SERVE_INT8"))
+    int4 = bool(os.environ.get("BENCH_SERVE_INT4"))
     mla = bool(os.environ.get("BENCH_SERVE_MLA"))
-    if mla and quantized:
+    if sum(map(bool, (mla, quantized, int4))) > 1:
         raise ValueError(
-            "BENCH_SERVE_MLA and BENCH_SERVE_INT8 are separate legs — a "
-            "partially-quantized MLA record would persist under the clean "
-            "serve_mla key; unset one")
+            "BENCH_SERVE_MLA / BENCH_SERVE_INT8 / BENCH_SERVE_INT4 are "
+            "separate legs — a mixed record would persist under the wrong "
+            "key; set at most one")
     if mla:
         # latent-mode engine leg: DeepSeek MLA at the serving scale —
         # per-slot compressed-latent rows instead of the paged K/V pool
@@ -324,12 +325,15 @@ def serve_bench(devs, gen):
         model = DeepseekV2ForCausalLM(cfg)
     else:
         model = LlamaForCausalLM(cfg)
-    if quantized:
-        # weight-only int8 serving leg: weights at 1 byte/element through
-        # HBM (decode is weight-bandwidth-bound, so this is the knob)
+    if quantized or int4:
+        # weight-only serving legs: int8 = 1 byte/element, int4 = 0.5
+        # bytes/element through HBM (decode is weight-bandwidth-bound,
+        # so this is the knob)
         from paddle_tpu.nn.quant import quantize_for_serving
 
-        model, _ = quantize_for_serving(model)
+        model, _ = quantize_for_serving(
+            model, algo=("weight_only_int4" if int4
+                         else "weight_only_int8"))
     rng = np.random.RandomState(0)
 
     def run():
@@ -356,6 +360,7 @@ def serve_bench(devs, gen):
         "requests": n_req,
         "slots": slots,
         "config": ("serve_mla" if mla
+                   else "serve_int4" if int4
                    else "serve_int8" if quantized else "serve"),
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -756,6 +761,8 @@ def orchestrate():
         cfg_name = "serve_mla"
     elif cfg_name == "serve" and os.environ.get("BENCH_SERVE_INT8"):
         cfg_name = "serve_int8"
+    elif cfg_name == "serve" and os.environ.get("BENCH_SERVE_INT4"):
+        cfg_name = "serve_int4"
     pp_sched = os.environ.get("BENCH_PP_SCHEDULE", "1F1B")
     if cfg_name == "pp" and pp_sched.upper() != "1F1B":
         cfg_name = f"pp_{pp_sched.lower()}"

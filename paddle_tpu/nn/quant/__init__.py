@@ -1,9 +1,12 @@
 """paddle.nn.quant parity (python/paddle/nn/quant/): weight-only
 quantization ops + the quantized linear path used by LLM serving.
 
-TPU-native: int8 weight-only quantize/dequantize are plain jnp (absmax
-per-channel); weight_only_linear dequantizes into the matmul so XLA fuses
-the scale into the MXU epilogue.
+TPU-native: int8/int4 weight-only quantize/dequantize are plain jnp
+(absmax per-channel, or group-wise over the in dim for int4);
+weight_only_linear dequantizes into the matmul so XLA fuses the scale
+into the MXU epilogue. int4 packs two nibbles per int8 byte —
+0.5 bytes/element through HBM (the reference's weight_only_int4
+configuration, quantized_linear.py group_size -1/64/128).
 """
 from __future__ import annotations
 
@@ -17,28 +20,105 @@ __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
            "llm_int8_linear", "WeightOnlyLinear", "quantize_for_serving"]
 
 
+def _pack_int4(q):
+    """[in, out] int8 values in [-7, 7] -> [(in+1)//2, out] int8 with two
+    sign-extended nibbles per byte (row 2i low, row 2i+1 high)."""
+    if q.shape[0] % 2:
+        q = jnp.concatenate([q, jnp.zeros((1, q.shape[1]), q.dtype)])
+    low = q[0::2] & 0x0F
+    high = jnp.left_shift(q[1::2], 4)
+    return (high | low).astype(jnp.int8)
+
+
+def _unpack_int4(p):
+    """Inverse of _pack_int4 (output keeps the possible zero pad row)."""
+    low = jnp.right_shift(jnp.left_shift(p, 4), 4)   # sign-extend
+    high = jnp.right_shift(p, 4)                     # arithmetic shift
+    inter = jnp.stack([low, high], axis=1)           # [rows, 2, out]
+    return inter.reshape(p.shape[0] * 2, p.shape[1]).astype(jnp.int8)
+
+
+def _group_scale(scale, group_size, n_rows):
+    """Broadcast scales to the unpacked weight rows: per-channel [out]
+    stays as-is; group-wise [n_groups, out] repeats each group's scale
+    over its group_size rows (padded rows reuse the last group)."""
+    if scale.ndim == 1:
+        return scale
+    if group_size <= 0:
+        raise ValueError(
+            "weight scales are group-wise ([n_groups, out]) but "
+            "group_size was not passed — supply the group_size the "
+            "weight was quantized with (64 or 128)")
+    rep = jnp.repeat(scale, group_size, axis=0)
+    if rep.shape[0] < n_rows:                        # int4 pad row
+        rep = jnp.concatenate([rep, rep[-1:]] )
+    return rep[:n_rows]
+
+
+def _validate_group(algo, group_size, in_features=None):
+    if group_size == -1:
+        return
+    if algo != "weight_only_int4":
+        raise NotImplementedError(
+            "group_size quantization is the weight_only_int4 path "
+            f"(got algo {algo!r})")
+    if group_size not in (64, 128):
+        raise ValueError(
+            f"group_size must be -1, 64 or 128, got {group_size}")
+    if in_features is not None and in_features % group_size:
+        raise ValueError(
+            f"in_features {in_features} is not divisible by group_size "
+            f"{group_size}")
+
+
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
-    """ops.yaml `weight_quantize`: per-output-channel absmax int8.
-    Returns (quantized int8 weight [in, out], scales [out])."""
-    if algo not in ("weight_only_int8", "llm.int8"):
+    """ops.yaml `weight_quantize`: per-output-channel absmax.
+    int8 -> (int8 weight [in, out], scales [out]);
+    int4 -> (packed int8 [(in+1)//2, out] with two nibbles/byte, scales
+    [out] or [in/group_size, out] when group_size is 64/128)."""
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
         raise NotImplementedError(f"weight_quantize: algo {algo!r} "
-                                  "(int8 weight-only on TPU)")
+                                  "(int8/int4 weight-only on TPU)")
+    _validate_group(algo, group_size,
+                    in_features=int(unwrap(x).shape[0]))
+
+    int4 = algo == "weight_only_int4"
+    levels = 7.0 if int4 else 127.0
 
     def fn(w):
-        absmax = jnp.max(jnp.abs(w), axis=0)
-        scale = jnp.maximum(absmax, 1e-8) / 127.0
-        q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        if int4 and group_size != -1:
+            g = w.reshape(w.shape[0] // group_size, group_size, w.shape[1])
+            absmax = jnp.max(jnp.abs(g), axis=1)            # [n_groups, out]
+            scale = jnp.maximum(absmax, 1e-8) / levels
+            q = jnp.clip(jnp.round(g / scale[:, None]), -levels, levels)
+            q = q.reshape(w.shape).astype(jnp.int8)
+        else:
+            absmax = jnp.max(jnp.abs(w), axis=0)
+            scale = jnp.maximum(absmax, 1e-8) / levels
+            q = jnp.clip(jnp.round(w / scale),
+                         -levels, levels).astype(jnp.int8)
+        if int4:
+            return _pack_int4(q), scale.astype(jnp.float32)
         return q, scale.astype(jnp.float32)
 
     return apply("weight_quantize", fn, x, differentiable=False)
 
 
-def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float16", group_size=-1,
+                      in_features=None):
+    """Inverse of weight_quantize. For int4, ``in_features`` truncates the
+    possible zero pad row the nibble packing added."""
     from ...framework.dtype import convert_dtype
 
     dt = convert_dtype(out_dtype)
 
     def fn(q, s):
+        if algo == "weight_only_int4":
+            w = _unpack_int4(q).astype(jnp.float32)
+            n = in_features if in_features is not None else w.shape[0]
+            w = w[:n]
+            return (w * _group_scale(s, group_size, n)).astype(dt)
         return (q.astype(jnp.float32) * s).astype(dt)
 
     return apply("weight_dequantize", fn, x, scale, differentiable=False)
@@ -47,7 +127,9 @@ def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
     """ops.yaml `weight_only_linear`: y = x @ dequant(W) + b, scale fused
-    by XLA into the matmul epilogue."""
+    by XLA into the matmul epilogue. ``weight_dtype="int4"``: the weight
+    arrives nibble-packed; the activation width is the truth for the true
+    in dim (the packing may have added a zero pad row)."""
     def fn(a, q, *rest):
         i = 0
         b = None
@@ -57,9 +139,15 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
             i += 1
         if weight_scale is not None:
             s = rest[i]
-        w = q.astype(a.dtype)
-        if s is not None:
-            w = w * s.astype(a.dtype)
+        if weight_dtype == "int4":
+            w = _unpack_int4(q)[: a.shape[-1]].astype(a.dtype)
+            if s is not None:
+                w = w * _group_scale(s, group_size,
+                                     a.shape[-1]).astype(a.dtype)
+        else:
+            w = q.astype(a.dtype)
+            if s is not None:
+                w = w * s.astype(a.dtype)
         out = a @ w
         if b is not None:
             out = out + b
@@ -123,43 +211,55 @@ from ...tensor_class import Parameter as _Parameter
 
 
 class WeightOnlyLinear(_Layer):
-    """Inference-time weight-only int8 linear (role parity: the quantized
-    linear PaddleNLP swaps into LLM checkpoints for llm.int8 /
-    weight_only_int8 serving over ops.yaml's weight_only_linear).
+    """Inference-time weight-only int8/int4 linear (role parity: the
+    quantized linear PaddleNLP swaps into LLM checkpoints for llm.int8 /
+    weight_only_int8 / weight_only_int4 serving over ops.yaml's
+    weight_only_linear).
 
-    Storage: int8 weight [in, out] + f32 per-output-channel scales — the
-    weight moves through HBM at 1 byte/element (vs 2 for bf16); XLA fuses
-    the dequant scale into the matmul epilogue. Built from a float Linear
-    via ``from_linear``; not trainable (serving path only).
+    Storage: int8 weight [in, out] (1 byte/element through HBM) or int4
+    nibble-packed [(in+1)//2, out] (0.5 bytes/element) + f32 scales —
+    per-output-channel, or [in/group_size, out] group-wise for int4
+    (group_size 64/128, the reference's quantized_linear contract); XLA
+    fuses the dequant scale into the matmul epilogue. Built from a float
+    Linear via ``from_linear``; not trainable (serving path only).
     """
 
     def __init__(self, in_features, out_features, algo="weight_only_int8",
-                 llm_int8_threshold=6.0, quant_weight=None, weight_scale=None):
+                 llm_int8_threshold=6.0, quant_weight=None,
+                 weight_scale=None, group_size=-1):
         super().__init__()
-        if algo not in ("weight_only_int8", "llm.int8"):
+        if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
             raise NotImplementedError(f"WeightOnlyLinear: algo {algo!r}")
+        _validate_group(algo, group_size, in_features=in_features)
         self.in_features, self.out_features = in_features, out_features
         self.algo = algo
+        self.group_size = int(group_size)
         self.llm_int8_threshold = float(llm_int8_threshold)
+        int4 = algo == "weight_only_int4"
+        rows = (in_features + 1) // 2 if int4 else in_features
+        scale_shape = ((in_features // group_size, out_features)
+                       if int4 and group_size != -1 else (out_features,))
         # accept pre-quantized arrays: from_linear passes them directly so
         # conversion never materializes a throwaway zero buffer per layer
         self.quant_weight = _Parameter(
             unwrap(quant_weight) if quant_weight is not None
-            else jnp.zeros((in_features, out_features), jnp.int8),
+            else jnp.zeros((rows, out_features), jnp.int8),
             trainable=False)
         self.weight_scale = _Parameter(
             unwrap(weight_scale) if weight_scale is not None
-            else jnp.ones((out_features,), jnp.float32),
+            else jnp.ones(scale_shape, jnp.float32),
             trainable=False)
         self.bias = None
 
     @staticmethod
-    def from_linear(lin, algo="weight_only_int8", llm_int8_threshold=6.0):
+    def from_linear(lin, algo="weight_only_int8", llm_int8_threshold=6.0,
+                    group_size=-1):
         w = lin.weight
-        q, s = weight_quantize(w, algo=algo)
+        q, s = weight_quantize(w, algo=algo, group_size=group_size)
         layer = WeightOnlyLinear(int(w.shape[0]), int(w.shape[1]), algo=algo,
                                  llm_int8_threshold=llm_int8_threshold,
-                                 quant_weight=q, weight_scale=s)
+                                 quant_weight=q, weight_scale=s,
+                                 group_size=group_size)
         if getattr(lin, "bias", None) is not None:
             layer.bias = _Parameter(unwrap(lin.bias), trainable=False)
         return layer
@@ -169,12 +269,18 @@ class WeightOnlyLinear(_Layer):
             return llm_int8_linear(x, self.quant_weight, self.bias,
                                    self.weight_scale,
                                    threshold=self.llm_int8_threshold)
-        return weight_only_linear(x, self.quant_weight, self.bias,
-                                  self.weight_scale)
+        return weight_only_linear(
+            x, self.quant_weight, self.bias, self.weight_scale,
+            weight_dtype=("int4" if self.algo == "weight_only_int4"
+                          else "int8"),
+            group_size=self.group_size)
 
     def extra_repr(self):
-        return (f"in_features={self.in_features}, "
-                f"out_features={self.out_features}, algo={self.algo}")
+        r = (f"in_features={self.in_features}, "
+             f"out_features={self.out_features}, algo={self.algo}")
+        if self.group_size != -1:
+            r += f", group_size={self.group_size}"
+        return r
 
 
 # default target set: the decoder projections + lm head (embeddings stay
@@ -184,7 +290,7 @@ _QUANT_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj",
 
 
 def quantize_for_serving(model, algo="weight_only_int8", include=None,
-                         llm_int8_threshold=6.0):
+                         llm_int8_threshold=6.0, group_size=-1):
     """Swap every targeted float ``nn.Linear`` in ``model`` for a
     WeightOnlyLinear IN PLACE and return (model, n_replaced).
 
@@ -203,5 +309,6 @@ def quantize_for_serving(model, algo="weight_only_int8", include=None,
         model,
         lambda name, sub: isinstance(sub, Linear) and name in include,
         lambda sub: WeightOnlyLinear.from_linear(
-            sub, algo=algo, llm_int8_threshold=llm_int8_threshold))
+            sub, algo=algo, llm_int8_threshold=llm_int8_threshold,
+            group_size=group_size))
     return model, n

@@ -78,6 +78,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       sweep batch16 1b BENCH_BATCH=16 || continue
       sweep 8b-depth3 8b BENCH_8B_DEPTH=3 || continue
       sweep serve-int8 serve BENCH_SERVE_INT8=1 || continue
+      sweep serve-int4 serve BENCH_SERVE_INT4=1 || continue
       sweep serve-mla serve BENCH_SERVE_MLA=1 || continue
       sweep geo256x256 8b PD_SPLASH_BLOCK_Q=256 PD_SPLASH_BLOCK_KV=256 || continue
       sweep geo256x512 8b PD_SPLASH_BLOCK_Q=256 PD_SPLASH_BLOCK_KV=512 || continue

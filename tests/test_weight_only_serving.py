@@ -43,7 +43,57 @@ def test_quantized_logits_close_and_roundtrip(float_model):
     assert (np.abs(back.numpy() - w.numpy()) <= step[None, :] * 0.5 + 1e-6).all()
 
 
-@pytest.mark.parametrize("algo", ["weight_only_int8", "llm.int8"])
+def test_int4_pack_roundtrip_and_group_scales():
+    """Nibble packing is exact over [-7, 7]; group-wise dequant bounded by
+    the per-group step; odd in_features pads one zero row."""
+    from paddle_tpu.nn.quant import _pack_int4, _unpack_int4
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randint(-7, 8, (10, 6)), jnp.int8)
+    np.testing.assert_array_equal(np.asarray(_unpack_int4(_pack_int4(q))),
+                                  np.asarray(q))
+    q_odd = jnp.asarray(rng.randint(-7, 8, (9, 6)), jnp.int8)
+    back = np.asarray(_unpack_int4(_pack_int4(q_odd)))
+    np.testing.assert_array_equal(back[:9], np.asarray(q_odd))
+    assert (back[9] == 0).all()
+
+    w = paddle.to_tensor(rng.randn(128, 16).astype("float32"))
+    q4, s = weight_quantize(w, algo="weight_only_int4", group_size=64)
+    assert q4.shape == [64, 16] and s.shape == [2, 16]
+    back = weight_dequantize(q4, s, algo="weight_only_int4",
+                             out_dtype="float32", group_size=64,
+                             in_features=128).numpy()
+    wn = w.numpy()
+    step = np.abs(wn.reshape(2, 64, 16)).max(1) / 7.0     # [2, 16]
+    err = np.abs(back - wn).reshape(2, 64, 16).max(1)
+    assert (err <= step * 0.5 + 1e-6).all()
+
+
+def test_int4_linear_matches_dequantized_reference():
+    from paddle_tpu import nn
+
+    rng = np.random.RandomState(4)
+    lin = nn.Linear(48, 24)
+    x = paddle.to_tensor(rng.randn(2, 48).astype("float32"))
+    wol = WeightOnlyLinear.from_linear(lin, algo="weight_only_int4")
+    ref_w = weight_dequantize(wol.quant_weight, wol.weight_scale,
+                              algo="weight_only_int4",
+                              out_dtype="float32",
+                              in_features=48).numpy()
+    want = x.numpy() @ ref_w + lin.bias.numpy()
+    got = wol(x).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+    with pytest.raises(ValueError, match="group_size"):
+        weight_quantize(paddle.to_tensor(rng.randn(128, 8).astype("float32")),
+                        algo="weight_only_int4", group_size=32)
+    with pytest.raises(ValueError, match="divisible"):
+        weight_quantize(paddle.to_tensor(rng.randn(100, 8).astype("float32")),
+                        algo="weight_only_int4", group_size=64)
+
+
+@pytest.mark.parametrize("algo", ["weight_only_int8", "llm.int8",
+                                  "weight_only_int4"])
 def test_quantized_engine_matches_solo(float_model, algo):
     """The engine serving a quantized model is token-identical to the same
     quantized model's solo generate (the serving stack is quantization-
