@@ -112,7 +112,16 @@ def test_rope_scaling_matches_transformers(rs):
 def test_unsupported_rope_scaling_rejected():
     from paddle_tpu.models.llama import hf_config_to_llama
 
-    with pytest.raises(NotImplementedError, match="longrope"):
+    with pytest.raises(NotImplementedError, match="dynamic"):
+        hf_config_to_llama({"vocab_size": 64, "hidden_size": 64,
+                            "intermediate_size": 128, "num_hidden_layers": 1,
+                            "num_attention_heads": 2,
+                            "max_position_embeddings": 64,
+                            "rope_scaling": {"rope_type": "dynamic",
+                                             "factor": 4.0}})
+    # longrope IS supported now (Phi-3) — but a malformed dict (missing
+    # the factor lists) must still refuse at convert time
+    with pytest.raises(ValueError, match="short_factor"):
         hf_config_to_llama({"vocab_size": 64, "hidden_size": 64,
                             "intermediate_size": 128, "num_hidden_layers": 1,
                             "num_attention_heads": 2,
