@@ -41,10 +41,10 @@ def _page_tiles(buf, page_size):
 
 class _Request:
     __slots__ = ("rid", "ids", "max_new_tokens", "tokens", "slot", "sampling",
-                 "on_token", "pixel_values")
+                 "on_token", "pixel_values", "stop_token_ids")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
-                 on_token=None, pixel_values=None):
+                 on_token=None, pixel_values=None, stop_token_ids=None):
         self.rid = rid
         self.ids = np.asarray(ids).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -53,6 +53,11 @@ class _Request:
         self.sampling = sampling  # (do_sample, temperature, top_k, top_p) or None
         self.on_token = on_token  # streaming callback (rid, token, done)
         self.pixel_values = pixel_values  # multimodal prompt (LLaVA)
+        # per-request stop set (overrides the engine eos when NON-EMPTY;
+        # an empty list means "no per-request stops" and falls back to
+        # the engine eos, matching the HTTP layer's reading)
+        self.stop_token_ids = (frozenset(int(s) for s in stop_token_ids)
+                               if stop_token_ids else None)
 
 
 class ContinuousBatchEngine:
@@ -124,11 +129,17 @@ class ContinuousBatchEngine:
         # trivial: freed pages can be overwritten with no refcounts.
         self.enable_prefix_cache = bool(enable_prefix_cache)
         self.prefix_pages_reused = 0  # observability: total pages copied
+        # ---- observability counters (stats()) ---------------------------
+        self._n_requests = 0
+        self._n_finished = 0
+        self._n_tokens = 0
+        self._n_steps = 0
 
     # ---- public API ---------------------------------------------------------
     def add_request(self, ids, max_new_tokens: int = 64, do_sample=None,
                     temperature=None, top_k=None, top_p=None,
-                    on_token=None, pixel_values=None) -> int:
+                    on_token=None, pixel_values=None,
+                    stop_token_ids=None) -> int:
         """Queue one request. Sampling knobs default to the engine-level
         configuration; any per-request override routes decoding through the
         per-row sampling program (one compiled step serves the whole mix).
@@ -137,6 +148,10 @@ class ContinuousBatchEngine:
         engine's step that produced it completes (token-level streaming —
         the serving front-end's SSE hook); exceptions it raises propagate
         out of step()/run_until_done().
+
+        ``stop_token_ids`` retires the request on ANY of the given ids
+        (per-request stop set — overrides the engine-level eos for this
+        request; the OpenAI "stop" role).
 
         ``pixel_values`` ([n_images, C, H, W]) serves a MULTIMODAL prompt:
         admission merges projected image features into the placeholder
@@ -194,14 +209,32 @@ class ContinuousBatchEngine:
                 sampling = None  # explicit values equal to the defaults
         rid = self._next_rid
         self._next_rid += 1
+        self._n_requests += 1
         self._queue.append(_Request(rid, ids, max_new_tokens, sampling,
-                                    on_token, pixel_values=pixel_values))
+                                    on_token, pixel_values=pixel_values,
+                                    stop_token_ids=stop_token_ids))
         self._admit()
         return rid
 
     @property
     def num_active(self) -> int:
         return sum(r is not None for r in self._slots)
+
+    def stats(self) -> dict:
+        """Engine observability: lifetime counters + current occupancy
+        (the serving front-end's /health payload)."""
+        active = self.num_active
+        return {
+            "requests_admitted": self._n_requests,
+            "requests_finished": self._n_finished,
+            "requests_active": active,
+            "requests_queued": len(self._queue),
+            "decode_steps": self._n_steps,
+            "tokens_generated": self._n_tokens,
+            "slot_utilization": (active / self.max_batch
+                                 if self.max_batch else 0.0),
+            "prefix_pages_reused": self.prefix_pages_reused,
+        }
 
     def step(self) -> Dict[int, np.ndarray]:
         """Decode ONE token for every active slot (sample + forward fused
@@ -238,6 +271,7 @@ class ContinuousBatchEngine:
             nxt, self._last, self._caches = step(
                 self._last, _random.next_key(), self._caches)
         toks = np.asarray(nxt)
+        self._n_steps += 1
         retiring = []
         events = []  # (cb, rid, token, done): fired AFTER bookkeeping, so a
         # raising callback cannot leave _lengths/slot state desynced from
@@ -247,9 +281,13 @@ class ContinuousBatchEngine:
                 continue
             t = int(toks[s])
             req.tokens.append(t)
-            finished = (len(req.tokens) >= req.max_new_tokens
-                        or (self.eos_token_id is not None
-                            and t == self.eos_token_id))
+            self._n_tokens += 1
+            if req.stop_token_ids is not None:
+                stopped = t in req.stop_token_ids
+            else:
+                stopped = (self.eos_token_id is not None
+                           and t == self.eos_token_id)
+            finished = len(req.tokens) >= req.max_new_tokens or stopped
             if req.on_token is not None:
                 events.append((req.on_token, req.rid, t, finished))
             if finished:
@@ -261,6 +299,7 @@ class ContinuousBatchEngine:
         for s in retiring:
             req = self._slots[s]
             self._finished[req.rid] = np.asarray(req.tokens, np.int64)
+            self._n_finished += 1
             self._slots[s] = None
             self._lengths = self._lengths.at[s].set(0)
         # stream AFTER state is consistent: every callback fires even if an

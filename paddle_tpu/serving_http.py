@@ -149,13 +149,18 @@ class CompletionServer:
             def do_GET(self):
                 if self.path == "/health":
                     eng = server_self.engine
-                    return self._json(200, {
+                    stats = eng.stats()
+                    # legacy top-level keys alias the SAME stats read (one
+                    # snapshot — a monitor must never see them disagree)
+                    payload = {
                         "status": "ok",
-                        "active": int(eng.num_active),
-                        "queued": len(getattr(eng, "_queue", ())),
+                        "active": stats["requests_active"],
+                        "queued": stats["requests_queued"],
                         "max_batch": eng.max_batch,
                         "max_len": eng.max_len,
-                    })
+                        "stats": stats,
+                    }
+                    return self._json(200, payload)
                 if self.path == "/v1/models":
                     return self._json(200, {
                         "object": "list",
@@ -190,6 +195,9 @@ class CompletionServer:
                             temperature=float(req.get("temperature", 1.0)),
                             top_k=int(req.get("top_k", 0)),
                             top_p=float(req.get("top_p", 1.0)))
+                    stop = req.get("stop_token_ids")
+                    if stop is not None:
+                        params["stop_token_ids"] = [int(s) for s in stop]
                 except (ValueError, TypeError) as e:
                     # wrong-typed fields answer 400, not a dropped socket
                     return self._json(400, {"error": str(e)})
@@ -217,9 +225,12 @@ class CompletionServer:
                     kind, msg = err
                     return self._json(400 if kind == "error" else 500,
                                       {"error": msg})
+                stop_set = set(params.get("stop_token_ids") or ())
                 eos = server_self.engine.eos_token_id
-                reason = ("stop" if eos is not None and toks
-                          and toks[-1] == eos else "length")
+                if not stop_set and eos is not None:
+                    stop_set = {eos}
+                reason = ("stop" if toks and toks[-1] in stop_set
+                          else "length")
                 choice = {"index": 0, "finish_reason": reason,
                           "token_ids": toks}
                 if server_self.tokenizer is not None:

@@ -187,12 +187,53 @@ def test_streaming_error_has_no_done(served):
 
 def test_health_and_models(served):
     _, srv = served
+    # serve one request FIRST so the stats assertions hold regardless of
+    # which other tests ran against the module fixture
+    prompt = np.random.RandomState(10).randint(1, 512, (5,)).tolist()
+    status, _ = _post(srv, "/v1/completions",
+                      {"prompt_token_ids": prompt, "max_tokens": 3})
+    assert status == 200
     status, health = _get(srv, "/health")
     assert status == 200 and health["status"] == "ok"
     assert health["max_batch"] == 4
+    stats = health["stats"]
+    assert stats["requests_finished"] >= 1
+    assert stats["tokens_generated"] >= stats["requests_finished"]
+    assert 0.0 <= stats["slot_utilization"] <= 1.0
+    assert health["active"] == stats["requests_active"]
     status, models = _get(srv, "/v1/models")
     assert status == 200
     assert models["data"][0]["id"] == "tiny-llama"
+
+
+def test_stop_token_ids(served):
+    """The OpenAI 'stop' role: the request retires on any stop id, with
+    finish_reason 'stop'; an unreachable stop set runs to max_tokens."""
+    model, srv = served
+    prompt = np.random.RandomState(11).randint(1, 512, (6,)).tolist()
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=8).numpy()[0].tolist()
+    stop_at = solo[2]
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 8,
+                          "stop_token_ids": [stop_at]})
+    assert status == 200
+    out = json.loads(data)
+    assert out["choices"][0]["token_ids"] == solo[:3]
+    assert out["choices"][0]["finish_reason"] == "stop"
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 8,
+                          "stop_token_ids": [10 ** 6]})
+    out = json.loads(data)
+    assert out["choices"][0]["token_ids"] == solo
+    assert out["choices"][0]["finish_reason"] == "length"
+    # empty stop list == "no per-request stops": engine eos still applies
+    # (review r5: frozenset() used to silently disable eos)
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 8,
+                          "stop_token_ids": []})
+    assert status == 200
+    assert json.loads(data)["choices"][0]["token_ids"] == solo
 
 
 def test_string_prompt_with_tokenizer():
