@@ -53,9 +53,9 @@ class _Request:
         self.sampling = sampling  # (do_sample, temperature, top_k, top_p) or None
         self.on_token = on_token  # streaming callback (rid, token, done)
         self.pixel_values = pixel_values  # multimodal prompt (LLaVA)
-        # per-request stop set (overrides the engine eos when NON-EMPTY;
-        # an empty list means "no per-request stops" and falls back to
-        # the engine eos, matching the HTTP layer's reading)
+        # per-request stop set — ADDITIVE to the engine eos (OpenAI
+        # "stop" semantics: extra stop sequences never disable
+        # end-of-sequence termination)
         self.stop_token_ids = (frozenset(int(s) for s in stop_token_ids)
                                if stop_token_ids else None)
 
@@ -120,6 +120,7 @@ class ContinuousBatchEngine:
         self._queue: List[_Request] = []
         self._slots: List[Optional[_Request]] = [None] * max_batch
         self._finished: Dict[int, np.ndarray] = {}
+        self._finished_reason: Dict[int, str] = {}
 
         # ---- automatic prefix caching (vLLM-style, opt-in) --------------
         # At admission, the longest page-aligned token prefix shared with a
@@ -149,9 +150,9 @@ class ContinuousBatchEngine:
         the serving front-end's SSE hook); exceptions it raises propagate
         out of step()/run_until_done().
 
-        ``stop_token_ids`` retires the request on ANY of the given ids
-        (per-request stop set — overrides the engine-level eos for this
-        request; the OpenAI "stop" role).
+        ``stop_token_ids`` retires the request on ANY of the given ids,
+        IN ADDITION to the engine-level eos (the OpenAI "stop" role:
+        extra stops never disable end-of-sequence termination).
 
         ``pixel_values`` ([n_images, C, H, W]) serves a MULTIMODAL prompt:
         admission merges projected image features into the placeholder
@@ -220,6 +221,11 @@ class ContinuousBatchEngine:
     def num_active(self) -> int:
         return sum(r is not None for r in self._slots)
 
+    def finish_reason(self, rid: int) -> Optional[str]:
+        """Why a finished request retired: "stop" (eos or a per-request
+        stop id) or "length" (max_new_tokens). None while in flight."""
+        return self._finished_reason.get(rid)
+
     def stats(self) -> dict:
         """Engine observability: lifetime counters + current occupancy
         (the serving front-end's /health payload)."""
@@ -282,12 +288,16 @@ class ContinuousBatchEngine:
             t = int(toks[s])
             req.tokens.append(t)
             self._n_tokens += 1
-            if req.stop_token_ids is not None:
-                stopped = t in req.stop_token_ids
-            else:
-                stopped = (self.eos_token_id is not None
-                           and t == self.eos_token_id)
+            stopped = ((self.eos_token_id is not None
+                        and t == self.eos_token_id)
+                       or (req.stop_token_ids is not None
+                           and t in req.stop_token_ids))
             finished = len(req.tokens) >= req.max_new_tokens or stopped
+            if finished:
+                # recorded BEFORE the on_token callbacks fire, so a
+                # front-end reading it at the done event sees the truth
+                self._finished_reason[req.rid] = ("stop" if stopped
+                                                  else "length")
             if req.on_token is not None:
                 events.append((req.on_token, req.rid, t, finished))
             if finished:

@@ -9,7 +9,9 @@ for paddle_tpu, stdlib-only (no web framework in the image):
   needs a ``tokenizer``) or ``prompt_token_ids`` (list of ints, no
   tokenizer needed), ``max_tokens``, ``temperature`` / ``top_k`` /
   ``top_p`` (per-request sampling rides the engine's per-row program),
-  ``stream`` (SSE chunks per token, ``data: [DONE]`` terminator);
+  ``stop_token_ids``, ``stream`` (SSE chunks per token, ``data: [DONE]``
+  terminator), and ``pixel_values`` ([n_images, C, H, W] nested lists)
+  for multimodal models — image and text requests batch in-flight;
 - ``GET /v1/models`` and ``GET /health``.
 
 Single-engine-thread design: device state (page pool, slot buffers) is
@@ -109,7 +111,10 @@ class CompletionServer:
                 try:
                     sub.rid = eng.add_request(sub.ids, on_token=on_token,
                                               **sub.params)
-                except ValueError as e:     # client error -> HTTP 400
+                except (ValueError, TypeError,
+                        NotImplementedError) as e:
+                    # client error (bad params, pixel_values to a
+                    # non-multimodal model, ...) -> HTTP 400
                     ev.put(("error", str(e), True))
                 except Exception as e:      # engine fault -> HTTP 500
                     ev.put(("fault", str(e), True))
@@ -198,6 +203,17 @@ class CompletionServer:
                     stop = req.get("stop_token_ids")
                     if stop is not None:
                         params["stop_token_ids"] = [int(s) for s in stop]
+                    px = req.get("pixel_values")
+                    if px is not None:
+                        # multimodal request (LLaVA): nested lists
+                        # [n_images, C, H, W] -> the engine's jitted
+                        # merge + embeds prefill
+                        arr = np.asarray(px, np.float32)
+                        if arr.ndim != 4:
+                            raise ValueError(
+                                "pixel_values must be a nested list of "
+                                "shape [n_images, C, H, W]")
+                        params["pixel_values"] = arr
                 except (ValueError, TypeError) as e:
                     # wrong-typed fields answer 400, not a dropped socket
                     return self._json(400, {"error": str(e)})
@@ -225,12 +241,10 @@ class CompletionServer:
                     kind, msg = err
                     return self._json(400 if kind == "error" else 500,
                                       {"error": msg})
-                stop_set = set(params.get("stop_token_ids") or ())
-                eos = server_self.engine.eos_token_id
-                if not stop_set and eos is not None:
-                    stop_set = {eos}
-                reason = ("stop" if toks and toks[-1] in stop_set
-                          else "length")
+                # single source of truth: the ENGINE records why the
+                # request retired (recorded before the done event fires)
+                reason = (server_self.engine.finish_reason(sub.rid)
+                          or "length")
                 choice = {"index": 0, "finish_reason": reason,
                           "token_ids": toks}
                 if server_self.tokenizer is not None:

@@ -140,6 +140,11 @@ def test_error_paths(served):
                          {"prompt_token_ids": [1, 2], "do_sample": True,
                           "temperature": None})
     assert status == 400
+    # pixel_values to a text-only model: client error, not a 500 fault
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": [1, 2], "max_tokens": 3,
+                          "pixel_values": [[[[0.0]]]]})
+    assert status == 400 and b"multimodal" in data
 
 
 def test_keepalive_connection_reuse(served):
@@ -234,6 +239,61 @@ def test_stop_token_ids(served):
                           "stop_token_ids": []})
     assert status == 200
     assert json.loads(data)["choices"][0]["token_ids"] == solo
+
+
+def test_multimodal_over_http():
+    """A LLaVA model behind the HTTP server: pixel_values as nested lists,
+    served token-identically to solo multimodal generate; a text request
+    on the same server batches alongside."""
+    from paddle_tpu.models.llava import (LlavaConfig,
+                                         LlavaForConditionalGeneration)
+
+    paddle.seed(2)
+    model = LlavaForConditionalGeneration(LlavaConfig.tiny())
+    eng = ContinuousBatchEngine(model, max_batch=2, max_len=32, page_size=8)
+    rng = np.random.RandomState(12)
+    ids = rng.randint(1, 500, (9,)); ids[2:6] = 511
+    px = rng.randn(1, 3, 16, 16).astype(np.float32)
+    solo = model.generate(paddle.to_tensor(ids[None]),
+                          pixel_values=paddle.to_tensor(px),
+                          max_new_tokens=5).numpy()[0].tolist()
+    txt_ids = rng.randint(1, 500, (6,))
+    txt_solo = model.generate(paddle.to_tensor(txt_ids[None]),
+                              max_new_tokens=5).numpy()[0].tolist()
+    with CompletionServer(eng) as srv:
+        # an image request and a text request CONCURRENTLY on one server:
+        # the embeds-prefill and token-prefill admissions batch in-flight
+        results = {}
+
+        def client(name, body):
+            results[name] = _post(srv, "/v1/completions", body)
+
+        a = threading.Thread(target=client, args=("mm", {
+            "prompt_token_ids": ids.tolist(), "max_tokens": 5,
+            "pixel_values": px.tolist()}))
+        b = threading.Thread(target=client, args=("txt", {
+            "prompt_token_ids": txt_ids.tolist(), "max_tokens": 5}))
+        a.start(); b.start(); a.join(300); b.join(300)
+        status, data = results["mm"]
+        assert status == 200
+        assert json.loads(data)["choices"][0]["token_ids"] == solo
+        status, data = results["txt"]
+        assert status == 200
+        assert json.loads(data)["choices"][0]["token_ids"] == txt_solo
+        # pixel_values to a non-multimodal model answers 400 (not 500)
+        # malformed shape answers 400
+        status, data = _post(srv, "/v1/completions",
+                             {"prompt_token_ids": ids.tolist(),
+                              "max_tokens": 5,
+                              "pixel_values": [[1.0, 2.0]]})
+        assert status == 400 and b"n_images" in data
+        # wrong image-token count answers 400 through the engine's
+        # early validation
+        status, data = _post(srv, "/v1/completions",
+                             {"prompt_token_ids": [1, 511, 2],
+                              "max_tokens": 3,
+                              "pixel_values": px.tolist()})
+        assert status == 400 and b"image tokens" in data
 
 
 def test_string_prompt_with_tokenizer():
