@@ -39,12 +39,19 @@ from .nn.layer import functional_weights as _functional_weights
 
 def _rope_rows(x, cos, sin, row_pos):
     """RoPE with PER-ROW positions: x [B,S,H,D], row_pos [B] — row b's
-    token s sits at absolute position row_pos[b]+s (ragged decode)."""
+    token s sits at absolute position row_pos[b]+s (ragged decode);
+    width-aware via partial_rope."""
+    from .ops.pallas.fused_norm import partial_rope
+
+    return partial_rope(_rope_rows_full, x, cos, sin, row_pos)
+
+
+def _rope_rows_full(x, cos, sin, row_pos):
     S = x.shape[1]
+    d = x.shape[-1]
     idx = row_pos[:, None] + jnp.arange(S)[None, :]        # [B, S]
     cos_b = cos[idx]                                       # [B, S, D]
     sin_b = sin[idx]
-    d = x.shape[-1]
     x1, x2 = x[..., : d // 2], x[..., d // 2:]
     rotated = jnp.concatenate([-x2, x1], axis=-1)
     c = cos_b[:, :, None, :]

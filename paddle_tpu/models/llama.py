@@ -58,6 +58,10 @@ class LlamaConfig:
     head_dim: Optional[int] = None
     # per-head RMSNorm on q/k after projection, before RoPE (Qwen3)
     qk_norm: bool = False
+    # fraction of head_dim that rotates (GLM/StableLM/Phi-3-small class):
+    # rope tables are built at rope_dim_of(config) width and the
+    # application sites rotate only that leading slice
+    partial_rotary_factor: float = 1.0
     # causal sliding-window attention (Mistral/Qwen2): each token attends
     # to at most the last `sliding_window` positions. The splash kernel
     # skips blocks outside the band (O(seq*window) work); dense fallbacks
@@ -114,6 +118,10 @@ class LlamaConfig:
                 "final_logit_softcapping cannot combine with "
                 "fuse_linear_cross_entropy (the chunked-CE scan computes "
                 "uncapped logits)")
+        if not (0.0 < self.partial_rotary_factor <= 1.0):
+            raise ValueError(
+                f"partial_rotary_factor must be in (0, 1], got "
+                f"{self.partial_rotary_factor}")
         if self.layer_types is not None:
             self.layer_types = tuple(self.layer_types)
             if len(self.layer_types) != self.num_hidden_layers:
@@ -159,6 +167,14 @@ def layer_window(config, layer_idx: int):
         return config.sliding_window
     return (config.sliding_window if lt[layer_idx] == "sliding_attention"
             else None)
+
+
+def rope_dim_of(config) -> int:
+    """Width of the rotary tables: head_dim scaled by
+    partial_rotary_factor, floored to even (the rotate-half split)."""
+    r = int(head_dim_of(config)
+            * getattr(config, "partial_rotary_factor", 1.0))
+    return r - (r % 2)
 
 
 def head_dim_of(config) -> int:
@@ -572,8 +588,8 @@ class LlamaAttention(Layer):
             from ..ops.pallas import fused_norm, flash_attention as pf
             from ..nn.functional.attention import _sdpa_ref
 
-            q = fused_norm.fused_rope(q, cos, sin)
-            k = fused_norm.fused_rope(k, cos, sin)
+            q = fused_norm.apply_rope(q, cos, sin)
+            k = fused_norm.apply_rope(k, cos, sin)
             if cache:
                 k = jnp.concatenate([cache[0], k], axis=1)
                 v = jnp.concatenate([cache[1], v], axis=1)
@@ -730,7 +746,7 @@ class LlamaModel(Layer):
     def _rope_dim(self):
         """Rotary table width; MLA trunks override (RoPE rides only the
         decoupled qk_rope_head_dim slice)."""
-        return head_dim_of(self.config)
+        return rope_dim_of(self.config)
 
     def _rope(self, seq_len):
         if seq_len in self._rope_cache:
@@ -954,7 +970,7 @@ class LlamaDecoderLayerPipe(Layer):
         self.layer = layer
 
     def _rope_dim(self):
-        return head_dim_of(self.config)
+        return rope_dim_of(self.config)
 
     def forward(self, hidden):
         cfg = self.config
@@ -1131,6 +1147,7 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
         attention_bias=bool(get("attention_bias",
                                 get("model_type") == "qwen2")),
         head_dim=get("head_dim"),
+        partial_rotary_factor=float(get("partial_rotary_factor") or 1.0),
         sliding_window=window,
     )
     kw.update(overrides)

@@ -12,7 +12,9 @@ Architecturally the Llama recipe (RoPE GQA, SwiGLU, RMSNorm, untied head)
   against ``original_max_position_embeddings``, with the
   sqrt(1 + ln(f)/ln(orig)) magnitude factor (llama._longrope_params);
 - optional causal sliding window (the mini-4k ships 2047) on the trunk's
-  uniform-window machinery.
+  uniform-window machinery;
+- partial rotary (the small variants' partial_rotary_factor) via the
+  trunk's width-keyed rope tables.
 """
 from __future__ import annotations
 
@@ -93,9 +95,6 @@ def phi3_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
     else:
         state = hf_model_or_state
     get = _hf_get(hf_config)
-    if (get("partial_rotary_factor") or 1.0) != 1.0:
-        raise NotImplementedError(
-            "phi3_from_hf: partial_rotary_factor != 1.0 is not supported")
     scaling = get("rope_scaling")
     if scaling:
         # the factor-list choice anchors to original_max_position_embeddings,

@@ -202,14 +202,32 @@ add_rms_norm.defvjp(_add_rms_fwd, _add_rms_bwd)
 
 # ---------------- fused RoPE --------------------------------------------------
 
-def rope_ref(x, cos, sin):
-    """Rotate-half RoPE on [B, S, H, D]; cos/sin [S, D] (or broadcastable)."""
+def partial_rope(full_fn, x, cos, sin, *args):
+    """THE width-aware rotary wrapper (partial_rotary_factor —
+    GLM/StableLM/Phi-3-small class): tables narrower than the head rotate
+    only the leading slice through ``full_fn``; the tail passes through.
+    Every rope application path (eager fused, dense reference, ragged
+    per-row) routes here so the slicing rule lives in one place."""
+    r = cos.shape[-1]
+    if r == x.shape[-1]:
+        return full_fn(x, cos, sin, *args)
+    return jnp.concatenate([full_fn(x[..., :r], cos, sin, *args),
+                            x[..., r:]], axis=-1)
+
+
+def _rope_ref_full(x, cos, sin):
     d = x.shape[-1]
     x1, x2 = x[..., : d // 2], x[..., d // 2 :]
     rotated = jnp.concatenate([-x2, x1], axis=-1)
     c = cos.reshape(1, cos.shape[-2], 1, cos.shape[-1])
     s = sin.reshape(1, sin.shape[-2], 1, sin.shape[-1])
     return (x.astype(jnp.float32) * c + rotated.astype(jnp.float32) * s).astype(x.dtype)
+
+
+def rope_ref(x, cos, sin):
+    """Rotate-half RoPE on [B, S, H, D]; cos/sin [S, D] (or broadcastable);
+    width-aware via partial_rope."""
+    return partial_rope(_rope_ref_full, x, cos, sin)
 
 
 def _rope_kernel(x_ref, cs_ref, o_ref):
@@ -220,6 +238,11 @@ def _rope_kernel(x_ref, cs_ref, o_ref):
     x1, x2 = x[:, : d // 2], x[:, d // 2 :]
     rot = jnp.concatenate([-x2, x1], axis=-1)
     o_ref[:] = (x * cos + rot * sin).astype(o_ref.dtype)
+
+
+def apply_rope(x, cos, sin):
+    """Width-aware rotary over the fused kernel (see partial_rope)."""
+    return partial_rope(fused_rope, x, cos, sin)
 
 
 @jax.custom_vjp

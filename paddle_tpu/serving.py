@@ -60,6 +60,9 @@ class _Request:
                                if stop_token_ids else None)
 
 
+_REASON_KEEP = 4096  # finish-reason retention window (see step())
+
+
 class ContinuousBatchEngine:
     """In-flight batching: add_request() any time, step() decodes one token
     for every active slot, finished requests free their slot immediately.
@@ -120,7 +123,11 @@ class ContinuousBatchEngine:
         self._queue: List[_Request] = []
         self._slots: List[Optional[_Request]] = [None] * max_batch
         self._finished: Dict[int, np.ndarray] = {}
+        # finish reasons are kept for the last _REASON_KEEP requests only
+        # (the front-end reads right after the done event; an unbounded
+        # dict would grow with lifetime request count)
         self._finished_reason: Dict[int, str] = {}
+        self._reason_order: List[int] = []
 
         # ---- automatic prefix caching (vLLM-style, opt-in) --------------
         # At admission, the longest page-aligned token prefix shared with a
@@ -298,6 +305,10 @@ class ContinuousBatchEngine:
                 # front-end reading it at the done event sees the truth
                 self._finished_reason[req.rid] = ("stop" if stopped
                                                   else "length")
+                self._reason_order.append(req.rid)
+                while len(self._reason_order) > _REASON_KEEP:
+                    self._finished_reason.pop(self._reason_order.pop(0),
+                                              None)
             if req.on_token is not None:
                 events.append((req.on_token, req.rid, t, finished))
             if finished:

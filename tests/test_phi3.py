@@ -144,8 +144,34 @@ def test_split_rejects_bad_shapes():
         split_phi3_fused(sd, hf.config)
 
 
-def test_partial_rotary_refused():
-    hf = _tiny_hf()
-    hf.config.partial_rotary_factor = 0.5
-    with pytest.raises(NotImplementedError, match="partial_rotary"):
-        phi3_from_hf(hf, dtype="float32")
+def test_partial_rotary_parity():
+    """partial_rotary_factor=0.5 (the Phi-3-small / GLM / StableLM class):
+    only the leading half of each head rotates — logits and greedy must
+    match transformers on every decode path."""
+    from transformers import Phi3Config as HFConfig
+    from transformers import Phi3ForCausalLM as HFPhi3
+
+    torch.manual_seed(1)
+    hf = HFPhi3(HFConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        partial_rotary_factor=0.5, tie_word_embeddings=False,
+        pad_token_id=0, attn_implementation="eager")).eval()
+    ours = phi3_from_hf(hf, dtype="float32", use_flash_attention=False)
+    assert ours.config.partial_rotary_factor == 0.5
+    from paddle_tpu.models.llama import rope_dim_of
+
+    assert rope_dim_of(ours.config) == 8     # head_dim 16 -> 8 rotate
+    _parity(hf, ours, seq=12, seed=5)
+    # paged serving path sees the narrow tables too
+    ids = np.random.RandomState(6).randint(0, 128, (1, 9))
+    a = ours.generate(paddle.to_tensor(ids), max_new_tokens=5).numpy()
+    b = ours.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                      paged=True, page_size=4).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_partial_rotary_validation():
+    with pytest.raises(ValueError, match="partial_rotary_factor"):
+        Phi3Config.tiny(partial_rotary_factor=1.5)
