@@ -39,6 +39,13 @@ _LAZY = {
     "Qwen3Config": ("qwen3", "Qwen3Config"),
     "Qwen3ForCausalLM": ("qwen3", "Qwen3ForCausalLM"),
     "qwen3_from_hf": ("qwen3", "qwen3_from_hf"),
+    "glm": ("glm", None),
+    "GlmConfig": ("glm", "GlmConfig"),
+    "GlmForCausalLM": ("glm", "GlmForCausalLM"),
+    "Glm4Config": ("glm", "Glm4Config"),
+    "Glm4ForCausalLM": ("glm", "Glm4ForCausalLM"),
+    "glm_from_hf": ("glm", "glm_from_hf"),
+    "glm4_from_hf": ("glm", "glm4_from_hf"),
     "gemma": ("gemma", None),
     "GemmaConfig": ("gemma", "GemmaConfig"),
     "GemmaForCausalLM": ("gemma", "GemmaForCausalLM"),

@@ -1,0 +1,172 @@
+"""GLM / GLM-4 decoder families (glm-4-9b, GLM-4-0414 line).
+
+Both are the Llama trunk with partial INTERLEAVED rotary and q/k/v
+biases; GLM-4 additionally wraps each sublayer in the Gemma2-style
+sandwich (a norm on the sublayer OUTPUT before the residual add), so its
+trunk IS Gemma2Model with RMSNorm(1x) weights and silu MLPs — the
+structure reuse is exact, only the checkpoint key names differ.
+
+Rotary: GLM rotates the leading ``partial_rotary_factor`` slice of each
+head in INTERLEAVED pair layout ((2i, 2i+1) share frequency i). This
+build's kernels use the half-rotate layout, and the two are equivalent
+under an even-then-odd reorder of each head's rotary projection rows —
+the same de-interleave the ernie45/deepseek loaders do, here scoped to
+the rotary slice (the pass-through tail stays in place). Conversion
+permutes the checkpoint once; no kernel fork.
+
+``glm_from_hf`` (transformers ``GlmForCausalLM``) and ``glm4_from_hf``
+(``Glm4ForCausalLM``; fused gate_up split like phi3) convert with
+logits/greedy parity.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from .gemma2 import Gemma2Model
+from .llama import (LlamaConfig, LlamaForCausalLM, _from_hf, _hf_get,
+                    _hf_to_np)
+
+
+@dataclasses.dataclass
+class GlmConfig(LlamaConfig):
+    # glm-4-9b shape
+    vocab_size: int = 151552
+    hidden_size: int = 4096
+    intermediate_size: int = 13696
+    num_hidden_layers: int = 40
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 2
+    head_dim: Optional[int] = 128
+    max_position_embeddings: int = 131072
+    rms_norm_eps: float = 1.5625e-07
+    rope_theta: float = 10000.0
+    attention_bias: bool = True
+    partial_rotary_factor: float = 0.5
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, head_dim=16,
+                    max_position_embeddings=256, dtype="float32")
+        base.update(kw)
+        return GlmConfig(**base)
+
+
+@dataclasses.dataclass
+class Glm4Config(GlmConfig):
+    # GLM-4-0414 keeps the GLM attention signature; the block gains the
+    # sandwich norms (Gemma2Model structure)
+    pass
+
+
+class GlmForCausalLM(LlamaForCausalLM):
+    """GLM causal LM — Llama trunk with partial interleaved rotary
+    (converted to half-rotate at load) and q/k/v biases."""
+
+    def __init__(self, config: GlmConfig):
+        if not config.attention_bias:
+            raise ValueError("GLM uses attention_bias=True")
+        if config.partial_rotary_factor >= 1.0:
+            raise ValueError("GLM rotates a partial slice "
+                             "(partial_rotary_factor < 1)")
+        super().__init__(config)
+
+
+class Glm4ForCausalLM(GlmForCausalLM):
+    """GLM-4 causal LM — GLM attention on the sandwich-norm trunk."""
+
+    model_cls = Gemma2Model
+
+
+def deinterleave_rotary(w, n_heads, head_dim, rope_dim):
+    """Even-then-odd reorder of each head's ROTARY rows (torch [out, ...]
+    layout in, same layout out — works for weights and biases alike):
+    interleaved-pair rotation == half-rotate rotation after this
+    permutation; pass-through rows stay in place."""
+    v = w.reshape((n_heads, head_dim) + w.shape[1:])
+    rot = v[:, :rope_dim]
+    rot = np.concatenate([rot[:, 0::2], rot[:, 1::2]], axis=1)
+    return np.concatenate([rot, v[:, rope_dim:]], axis=1).reshape(w.shape)
+
+
+def _translate_glm_state(state, hf_config, sandwich):
+    """GLM checkpoint -> this build's key layout: q/k rotary rows
+    de-interleaved, fused gate_up split, GLM-4 norm names mapped onto the
+    Gemma2 sandwich attributes."""
+    get = _hf_get(hf_config)
+    heads = get("num_attention_heads")
+    hd = get("head_dim") or get("hidden_size") // heads
+    # the SAME even-floor rope_dim_of applies at runtime — the permuted
+    # row set must equal the rotated row set exactly
+    rd = int(hd * (get("partial_rotary_factor") or 0.5))
+    rd -= rd % 2
+    kv = get("num_key_value_heads")
+
+    renames = {}
+    if sandwich:
+        # ours <- GLM-4: post_attention(ours, on attn out) <-
+        # post_self_attn; pre_feedforward <- post_attention;
+        # post_feedforward <- post_mlp
+        renames = {
+            ".post_self_attn_layernorm.": ".post_attention_layernorm.",
+            ".post_attention_layernorm.": ".pre_feedforward_layernorm.",
+            ".post_mlp_layernorm.": ".post_feedforward_layernorm.",
+        }
+    out = {}
+    for key, val in state.items():
+        new_key = key
+        for old, new in renames.items():
+            if old in key:
+                new_key = key.replace(old, new)
+                break
+        if key.endswith((".self_attn.q_proj.weight",
+                         ".self_attn.q_proj.bias")):
+            out[new_key] = deinterleave_rotary(_hf_to_np(val), heads, hd,
+                                               rd)
+        elif key.endswith((".self_attn.k_proj.weight",
+                           ".self_attn.k_proj.bias")):
+            out[new_key] = deinterleave_rotary(_hf_to_np(val), kv, hd, rd)
+        elif key.endswith(".mlp.gate_up_proj.weight"):
+            v = _hf_to_np(val)
+            half = v.shape[0] // 2
+            base = new_key[: -len("gate_up_proj.weight")]
+            out[base + "gate_proj.weight"] = v[:half]
+            out[base + "up_proj.weight"] = v[half:]
+        else:
+            out[new_key] = val
+    return out
+
+
+def _glm_from_hf(config_cls, model_cls, sandwich, hf_model_or_state,
+                 hf_config=None, **config_overrides):
+    if hf_config is None:
+        hf_config = hf_model_or_state.config
+        state = hf_model_or_state.state_dict()
+    else:
+        state = hf_model_or_state
+    get = _hf_get(hf_config)
+    config_overrides.setdefault(
+        "partial_rotary_factor", float(get("partial_rotary_factor") or 0.5))
+    extra = (("pre_feedforward_layernorm", "post_feedforward_layernorm")
+             if sandwich else ())
+    return _from_hf(config_cls, model_cls,
+                    _translate_glm_state(state, hf_config, sandwich),
+                    hf_config, extra_layer_norms=extra, **config_overrides)
+
+
+def glm_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a GlmForCausalLM from a transformers Glm model (or a raw
+    state dict + config)."""
+    return _glm_from_hf(GlmConfig, GlmForCausalLM, False,
+                        hf_model_or_state, hf_config, **config_overrides)
+
+
+def glm4_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build a Glm4ForCausalLM from a transformers Glm4 model (or a raw
+    state dict + config)."""
+    return _glm_from_hf(Glm4Config, Glm4ForCausalLM, True,
+                        hf_model_or_state, hf_config, **config_overrides)

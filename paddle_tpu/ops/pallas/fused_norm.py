@@ -207,10 +207,17 @@ def partial_rope(full_fn, x, cos, sin, *args):
     GLM/StableLM/Phi-3-small class): tables narrower than the head rotate
     only the leading slice through ``full_fn``; the tail passes through.
     Every rope application path (eager fused, dense reference, ragged
-    per-row) routes here so the slicing rule lives in one place."""
+    per-row) routes here so the slicing rule lives in one place.
+    A partial width must be a rope_dim_of product: even and < head_dim
+    (a width-1 "broadcastable" table is NOT a partial width — it would
+    silently rotate one lane)."""
     r = cos.shape[-1]
     if r == x.shape[-1]:
         return full_fn(x, cos, sin, *args)
+    if r > x.shape[-1] or r % 2 or r < 2:
+        raise ValueError(
+            f"rope table width {r} is not a valid partial width for "
+            f"head_dim {x.shape[-1]} (must be even and smaller)")
     return jnp.concatenate([full_fn(x[..., :r], cos, sin, *args),
                             x[..., r:]], axis=-1)
 
@@ -225,8 +232,8 @@ def _rope_ref_full(x, cos, sin):
 
 
 def rope_ref(x, cos, sin):
-    """Rotate-half RoPE on [B, S, H, D]; cos/sin [S, D] (or broadcastable);
-    width-aware via partial_rope."""
+    """Rotate-half RoPE on [B, S, H, D]; cos/sin [S, D] (full width, or an
+    EVEN partial width — see partial_rope)."""
     return partial_rope(_rope_ref_full, x, cos, sin)
 
 

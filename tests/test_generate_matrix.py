@@ -9,7 +9,7 @@ import pytest
 import paddle_tpu as paddle
 
 FAMILIES = ["llama", "qwen2", "qwen3", "mistral", "gpt2", "qwen2_moe",
-            "deepseek", "mixtral", "gemma", "gemma2", "phi3"]
+            "deepseek", "mixtral", "gemma", "gemma2", "phi3", "glm4"]
 
 
 def _build(name):
@@ -65,6 +65,11 @@ def _build(name):
 
         # sandwich norms + softcaps + alternating window on every path
         return Gemma2ForCausalLM(Gemma2Config.tiny(num_hidden_layers=2))
+    if name == "glm4":
+        from paddle_tpu.models.glm import Glm4Config, Glm4ForCausalLM
+
+        # sandwich trunk + partial rotary + qkv bias on every path
+        return Glm4ForCausalLM(Glm4Config.tiny(num_hidden_layers=2))
     if name == "phi3":
         from paddle_tpu.models.phi3 import Phi3Config, Phi3ForCausalLM
 
