@@ -27,7 +27,7 @@ import numpy as np
 
 from .gemma2 import Gemma2Model
 from .llama import (LlamaConfig, LlamaForCausalLM, _from_hf, _hf_get,
-                    _hf_to_np)
+                    _hf_to_np, rope_dim_of)
 
 
 @dataclasses.dataclass
@@ -97,13 +97,16 @@ def _translate_glm_state(state, hf_config, sandwich):
     """GLM checkpoint -> this build's key layout: q/k rotary rows
     de-interleaved, fused gate_up split, GLM-4 norm names mapped onto the
     Gemma2 sandwich attributes."""
+    import types
+
     get = _hf_get(hf_config)
     heads = get("num_attention_heads")
     hd = get("head_dim") or get("hidden_size") // heads
-    # the SAME even-floor rope_dim_of applies at runtime — the permuted
-    # row set must equal the rotated row set exactly
-    rd = int(hd * (get("partial_rotary_factor") or 0.5))
-    rd -= rd % 2
+    # THE runtime derivation: the permuted row set must equal the rotated
+    # row set exactly, so the width comes from rope_dim_of itself
+    rd = rope_dim_of(types.SimpleNamespace(
+        head_dim=hd,
+        partial_rotary_factor=(get("partial_rotary_factor") or 0.5)))
     kv = get("num_key_value_heads")
 
     renames = {}
@@ -131,11 +134,9 @@ def _translate_glm_state(state, hf_config, sandwich):
                            ".self_attn.k_proj.bias")):
             out[new_key] = deinterleave_rotary(_hf_to_np(val), kv, hd, rd)
         elif key.endswith(".mlp.gate_up_proj.weight"):
-            v = _hf_to_np(val)
-            half = v.shape[0] // 2
-            base = new_key[: -len("gate_up_proj.weight")]
-            out[base + "gate_proj.weight"] = v[:half]
-            out[base + "up_proj.weight"] = v[half:]
+            from .phi3 import split_gate_up
+
+            split_gate_up(new_key, _hf_to_np(val), out)
         else:
             out[new_key] = val
     return out

@@ -1154,16 +1154,11 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
-def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict,
-                  extra_layer_norms=(),
-                  ignore_missing_prefixes=()) -> "LlamaForCausalLM":
-    """Load a HuggingFace Llama checkpoint's state dict into ``model``.
-
-    Accepts torch tensors or arrays. torch ``nn.Linear`` stores weights
-    [out, in]; this build stores [in, out] (paddle convention), so every
-    projection transposes. Config names follow HF conventions, so the key
-    mapping is mechanical (docstring contract in the module header).
-    """
+def _hf_llama_plan(model, extra_layer_norms=()):
+    """{our param name: (hf key, transpose)} for the Llama key layout —
+    the ONE mapping shared by the loader and the reverse exporter. The
+    (untied) lm head maps to "lm_head.weight"; loaders may redirect its
+    source for tied-in-HF checkpoints."""
     L = model.config.num_hidden_layers
     plan = {"llama.embed_tokens.weight": ("model.embed_tokens.weight", False),
             "llama.norm.weight": ("model.norm.weight", False)}
@@ -1188,11 +1183,44 @@ def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict,
             f"{hf}.post_attention_layernorm.weight", False)
         for norm in extra_layer_norms:  # Gemma2 sandwich norms
             plan[f"{ours}.{norm}.weight"] = (f"{hf}.{norm}.weight", False)
+    if model.lm_head is not None:
+        plan["lm_head.weight"] = ("lm_head.weight", True)
+    return plan
+
+
+def export_hf_llama(model: "LlamaForCausalLM", extra_layer_norms=()):
+    """The reverse of load_hf_llama: this model's weights as an
+    HF-key-layout numpy state dict (torch [out, in] projection layout),
+    ready for ``HFModel.load_state_dict`` via torch.from_numpy — train
+    here, deploy anywhere. Tied models omit lm_head.weight (HF re-ties
+    from the embedding). Round-trip parity is tested per family."""
+    plan = _hf_llama_plan(model, extra_layer_norms=extra_layer_norms)
+    params = dict(model.named_parameters())
+    out = {}
+    for name, (hf_key, transpose) in plan.items():
+        if name not in params:
+            raise KeyError(f"export_hf_llama: model has no param {name!r}")
+        v = np.asarray(unwrap(params[name])).astype(np.float32)
+        out[hf_key] = v.T if transpose else v
+    return out
+
+
+def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict,
+                  extra_layer_norms=(),
+                  ignore_missing_prefixes=()) -> "LlamaForCausalLM":
+    """Load a HuggingFace Llama checkpoint's state dict into ``model``.
+
+    Accepts torch tensors or arrays. torch ``nn.Linear`` stores weights
+    [out, in]; this build stores [in, out] (paddle convention), so every
+    projection transposes. Config names follow HF conventions, so the key
+    mapping is mechanical (docstring contract in the module header).
+    """
+    plan = _hf_llama_plan(model, extra_layer_norms=extra_layer_norms)
     tied_alias = set()
     if model.lm_head is not None:
-        src = ("lm_head.weight" if "lm_head.weight" in hf_state_dict
-               else "model.embed_tokens.weight")  # tied-in-HF checkpoint
-        plan["lm_head.weight"] = (src, True)
+        if "lm_head.weight" not in hf_state_dict:
+            # tied-in-HF checkpoint feeding an untied model
+            plan["lm_head.weight"] = ("model.embed_tokens.weight", True)
     else:
         # tied model: an HF checkpoint may still carry the lm_head alias of
         # the embedding — represented here through the tie, not a drop
@@ -1251,3 +1279,27 @@ def llama_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
     dict + config): ``llama_from_hf(HFLlama.from_pretrained(...))``."""
     return _from_hf(LlamaConfig, LlamaForCausalLM, hf_model_or_state,
                     hf_config, **config_overrides)
+
+
+def llama_to_hf(model):
+    """Export to the HF Llama checkpoint layout (see export_hf_llama) —
+    covers every family whose checkpoint IS the plain Llama key layout
+    (Llama/Qwen2/Qwen3/Mistral/Gemma; Gemma2 adds its sandwich norms).
+    Families whose conversion TRANSFORMS the checkpoint (Phi-3 fuses
+    projections, GLM de-interleaves rotary rows) REFUSE — exporting their
+    runtime weights under HF keys without reversing the transform would
+    emit a silently wrong checkpoint."""
+    from .gemma2 import Gemma2ForCausalLM
+    from .glm import GlmForCausalLM
+    from .phi3 import Phi3ForCausalLM
+
+    if isinstance(model, (GlmForCausalLM, Phi3ForCausalLM)):
+        raise NotImplementedError(
+            f"llama_to_hf: {type(model).__name__} checkpoints are "
+            "TRANSFORMED at load (fused projections / interleaved "
+            "rotary); the reverse transform is not implemented — "
+            "exporting raw runtime weights would be silently wrong")
+    extra = ()
+    if isinstance(model, Gemma2ForCausalLM):
+        extra = ("pre_feedforward_layernorm", "post_feedforward_layernorm")
+    return export_hf_llama(model, extra_layer_norms=extra)

@@ -76,14 +76,20 @@ def split_phi3_fused(hf_state_dict, hf_config):
             out[base + "k_proj.weight"] = v[heads * hd: (heads + kv) * hd]
             out[base + "v_proj.weight"] = v[(heads + kv) * hd:]
         elif key.endswith(".mlp.gate_up_proj.weight"):
-            base = key[: -len("gate_up_proj.weight")]
-            v = _hf_to_np(val)
-            half = v.shape[0] // 2
-            out[base + "gate_proj.weight"] = v[:half]
-            out[base + "up_proj.weight"] = v[half:]
+            split_gate_up(key, _hf_to_np(val), out)
         else:
             out[key] = val
     return out
+
+
+def split_gate_up(key, v, out):
+    """Fused [gate; up] checkpoint rows -> separate gate_proj/up_proj
+    entries (torch [out, in] halves) — shared by the phi3 and glm
+    translators."""
+    base = key[: -len("gate_up_proj.weight")]
+    half = v.shape[0] // 2
+    out[base + "gate_proj.weight"] = v[:half]
+    out[base + "up_proj.weight"] = v[half:]
 
 
 def phi3_from_hf(hf_model_or_state, hf_config=None, **config_overrides):

@@ -1,0 +1,119 @@
+"""Reverse HF export (llama_to_hf / export_hf_llama): weights trained
+here load into transformers with exact logits parity — the deploy-
+anywhere direction of the interop story."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                     llama_from_hf, llama_to_hf)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _load_into_hf(hf_model, sd):
+    hf_model.load_state_dict({k: torch.from_numpy(v) for k, v in sd.items()},
+                             strict=False)
+    return hf_model.eval()
+
+
+def test_llama_roundtrip_logits():
+    """Train a few steps HERE, export, load into transformers: logits
+    match to float tolerance."""
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM as HFLlama
+    from paddle_tpu import optimizer as opt
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+
+    def loss_fn(mm, x, y):
+        loss, _ = mm(x, labels=y)
+        return loss
+
+    step = paddle.jit.train_step(m, loss_fn,
+                                 opt.AdamW(1e-2, parameters=m.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 12)))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 512, (2, 12)))
+    for _ in range(3):
+        step(x, y)
+
+    sd = llama_to_hf(m)
+    assert "lm_head.weight" in sd                  # untied: exported
+    hf = _load_into_hf(HFLlama(HFConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        rope_theta=500000.0, attn_implementation="eager")), sd)
+    ids = np.random.RandomState(2).randint(0, 512, (2, 10))
+    ours = m(paddle.to_tensor(ids)).numpy()
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=5e-4)
+
+
+def test_gemma2_roundtrip_through_from_hf():
+    """from_hf(to_hf(m)) reproduces the model exactly (sandwich norms and
+    (1+w) deltas included)."""
+    from paddle_tpu.models.gemma2 import (Gemma2Config, Gemma2ForCausalLM,
+                                          gemma2_from_hf)
+    from paddle_tpu.models.llama import llama_to_hf
+
+    paddle.seed(1)
+    m = Gemma2ForCausalLM(Gemma2Config.tiny())
+    sd = llama_to_hf(m)
+    assert "lm_head.weight" not in sd              # tied: dropped
+    assert any("pre_feedforward_layernorm" in k for k in sd)
+    cfg = dict(
+        model_type="gemma2", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=32, query_pre_attn_scalar=64.0,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        sliding_window=16, layer_types=["sliding_attention",
+                                        "full_attention"],
+        max_position_embeddings=256, rms_norm_eps=1e-6,
+        rope_theta=10000.0, hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True)
+    m2 = gemma2_from_hf(sd, cfg, dtype="float32")
+    ids = paddle.to_tensor(np.random.RandomState(3).randint(0, 512, (2, 9)))
+    np.testing.assert_allclose(m(ids).numpy(), m2(ids).numpy(),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_transformed_families_refuse_export():
+    """GLM/Phi-3 checkpoints are TRANSFORMED at load; exporting raw
+    runtime weights would be silently wrong — must refuse."""
+    from paddle_tpu.models.glm import Glm4Config, Glm4ForCausalLM
+    from paddle_tpu.models.phi3 import Phi3Config, Phi3ForCausalLM
+
+    paddle.seed(3)
+    for m in (Glm4ForCausalLM(Glm4Config.tiny(num_hidden_layers=1)),
+              Phi3ForCausalLM(Phi3Config.tiny(num_hidden_layers=1))):
+        with pytest.raises(NotImplementedError, match="TRANSFORMED"):
+            llama_to_hf(m)
+
+
+def test_qwen3_roundtrip_through_transformers():
+    """Qwen3 (qk norms, decoupled head_dim) exports and reloads through
+    the real transformers model."""
+    from transformers import Qwen3Config as HFConfig
+    from transformers import Qwen3ForCausalLM as HFQwen3
+    from paddle_tpu.models.qwen3 import Qwen3Config, Qwen3ForCausalLM
+    from paddle_tpu.models.llama import llama_to_hf
+
+    paddle.seed(2)
+    m = Qwen3ForCausalLM(Qwen3Config.tiny(num_hidden_layers=2))
+    sd = llama_to_hf(m)
+    hf = _load_into_hf(HFQwen3(HFConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=256, rms_norm_eps=1e-6,
+        rope_theta=1e6, tie_word_embeddings=False,
+        attn_implementation="eager")), sd)
+    ids = np.random.RandomState(4).randint(0, 512, (1, 8))
+    ours = m.generate(paddle.to_tensor(ids), max_new_tokens=5).numpy()
+    with torch.no_grad():
+        theirs = hf.generate(torch.from_numpy(ids), max_new_tokens=5,
+                             do_sample=False).numpy()[:, 8:]
+    np.testing.assert_array_equal(ours, theirs)
