@@ -1046,14 +1046,19 @@ def _sample_and_forward(model, max_len, last, key, bufs, aux,
                         do_sample, temperature, top_k, top_p, sampler=None):
     """The fused per-token unit shared by the scan decode and the engine
     step: sample from ``last``, run one cached forward, return
-    (token, next logits, split caches). Caller provides the weight context
-    (functional_weights) and the RNG key; ``sampler`` overrides the scalar
-    sample_logits call (the per-row engine path)."""
+    (token, chosen-token logprob, next logits, split caches). The logprob
+    is under the model's RAW distribution over ``last`` (the OpenAI
+    "logprobs" field — one fused log_softmax gather while the logits are
+    in hand). Caller provides the weight context (functional_weights) and
+    the RNG key; ``sampler`` overrides the scalar sample_logits call (the
+    per-row engine path)."""
     if sampler is not None:
         nxt = sampler(last, key)
     else:
         nxt = sample_logits(last, key, do_sample=do_sample,
                             temperature=temperature, top_k=top_k, top_p=top_p)
+    lp = jax.nn.log_softmax(last.astype(jnp.float32), -1)[
+        jnp.arange(last.shape[0]), nxt]
     token = nxt[:, None].astype(jnp.int32)
     caches = [{**b, **a} for b, a in zip(bufs, aux)]
     with _tape.no_grad():
@@ -1061,7 +1066,7 @@ def _sample_and_forward(model, max_len, last, key, bufs, aux,
             wrap(token), caches, rope_len=max_len)
         logits = model.lm_head_logits(hidden)
     nb, na = _split_caches(_unwrap_caches(new_caches))
-    return nxt, unwrap(logits)[:, -1, :], nb, na
+    return nxt, lp, unwrap(logits)[:, -1, :], nb, na
 
 
 class _ScanDecodeStep:
@@ -1081,7 +1086,7 @@ class _ScanDecodeStep:
                 def body(carry, t):
                     last_t, bufs_t, aux_t = carry
                     key = jax.random.fold_in(base_key, t)
-                    nxt, last_n, nb, na = _sample_and_forward(
+                    nxt, _lp, last_n, nb, na = _sample_and_forward(
                         model, max_len, last_t, key, bufs_t, aux_t,
                         do_sample, temperature, top_k, top_p)
                     return (last_n, nb, na), nxt
@@ -1115,18 +1120,19 @@ class _SelectDecodeStep:
 
         def pure(state, last, key, bufs, aux):
             with _functional_weights(model, state):
-                nxt, last_n, nb, na = _sample_and_forward(
+                nxt, lp, last_n, nb, na = _sample_and_forward(
                     model, max_len, last, key, bufs, aux,
                     do_sample, temperature, top_k, top_p)
-            return nxt, last_n.astype(jnp.float32), nb, na
+            return nxt, lp, last_n.astype(jnp.float32), nb, na
 
         self._jitted = jax.jit(pure, donate_argnums=(3,))
         self._state = dict(model.functional_state())
 
     def __call__(self, last, key, caches):
         bufs, aux = _split_caches(caches)
-        nxt, last_f, nb, na = self._jitted(self._state, last, key, bufs, aux)
-        return nxt, last_f, [{**b, **a} for b, a in zip(nb, na)]
+        nxt, lp, last_f, nb, na = self._jitted(self._state, last, key,
+                                               bufs, aux)
+        return nxt, lp, last_f, [{**b, **a} for b, a in zip(nb, na)]
 
 
 class _SelectDecodeRowsStep:
@@ -1139,21 +1145,22 @@ class _SelectDecodeRowsStep:
 
         def pure(state, last, key, do_s, temp, tk, tp, bufs, aux):
             with _functional_weights(model, state):
-                nxt, last_n, nb, na = _sample_and_forward(
+                nxt, lp, last_n, nb, na = _sample_and_forward(
                     model, max_len, last, key, bufs, aux,
                     None, None, None, None,
                     sampler=lambda lg, k: sample_logits_rows(
                         lg, k, do_s, temp, tk, tp))
-            return nxt, last_n.astype(jnp.float32), nb, na
+            return nxt, lp, last_n.astype(jnp.float32), nb, na
 
         self._jitted = jax.jit(pure, donate_argnums=(7,))
         self._state = dict(model.functional_state())
 
     def __call__(self, last, key, do_s, temp, tk, tp, caches):
         bufs, aux = _split_caches(caches)
-        nxt, last_f, nb, na = self._jitted(self._state, last, key, do_s,
-                                           temp, tk, tp, bufs, aux)
-        return nxt, last_f, [{**b, **a} for b, a in zip(nb, na)]
+        nxt, lp, last_f, nb, na = self._jitted(self._state, last, key,
+                                               do_s, temp, tk, tp, bufs,
+                                               aux)
+        return nxt, lp, last_f, [{**b, **a} for b, a in zip(nb, na)]
 
 
 def _get_select_decode_rows(model, max_len):

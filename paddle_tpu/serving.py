@@ -41,10 +41,12 @@ def _page_tiles(buf, page_size):
 
 class _Request:
     __slots__ = ("rid", "ids", "max_new_tokens", "tokens", "slot", "sampling",
-                 "on_token", "pixel_values", "stop_token_ids")
+                 "on_token", "on_token_arity", "pixel_values",
+                 "stop_token_ids", "logprobs", "want_logprobs")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
-                 on_token=None, pixel_values=None, stop_token_ids=None):
+                 on_token=None, pixel_values=None, stop_token_ids=None,
+                 want_logprobs=False):
         self.rid = rid
         self.ids = np.asarray(ids).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -58,6 +60,21 @@ class _Request:
         # end-of-sequence termination)
         self.stop_token_ids = (frozenset(int(s) for s in stop_token_ids)
                                if stop_token_ids else None)
+        # chosen-token logprobs accumulate ONLY when asked — a retention
+        # window of full float lists nobody wants would dominate memory
+        self.want_logprobs = bool(want_logprobs)
+        self.logprobs: List[float] = []
+        # streaming callbacks may take (rid, tok, done) or a 4th logprob
+        # arg; arity detected once at admission
+        self.on_token_arity = 3
+        if on_token is not None:
+            import inspect
+
+            try:
+                self.on_token_arity = len(
+                    inspect.signature(on_token).parameters)
+            except (TypeError, ValueError):
+                self.on_token_arity = 3
 
 
 _REASON_KEEP = 4096  # finish-reason retention window (see step())
@@ -127,6 +144,7 @@ class ContinuousBatchEngine:
         # (the front-end reads right after the done event; an unbounded
         # dict would grow with lifetime request count)
         self._finished_reason: Dict[int, str] = {}
+        self._finished_logprobs: Dict[int, list] = {}
         self._reason_order: List[int] = []
 
         # ---- automatic prefix caching (vLLM-style, opt-in) --------------
@@ -147,7 +165,7 @@ class ContinuousBatchEngine:
     def add_request(self, ids, max_new_tokens: int = 64, do_sample=None,
                     temperature=None, top_k=None, top_p=None,
                     on_token=None, pixel_values=None,
-                    stop_token_ids=None) -> int:
+                    stop_token_ids=None, logprobs=False) -> int:
         """Queue one request. Sampling knobs default to the engine-level
         configuration; any per-request override routes decoding through the
         per-row sampling program (one compiled step serves the whole mix).
@@ -220,7 +238,8 @@ class ContinuousBatchEngine:
         self._n_requests += 1
         self._queue.append(_Request(rid, ids, max_new_tokens, sampling,
                                     on_token, pixel_values=pixel_values,
-                                    stop_token_ids=stop_token_ids))
+                                    stop_token_ids=stop_token_ids,
+                                    want_logprobs=logprobs))
         self._admit()
         return rid
 
@@ -232,6 +251,12 @@ class ContinuousBatchEngine:
         """Why a finished request retired: "stop" (eos or a per-request
         stop id) or "length" (max_new_tokens). None while in flight."""
         return self._finished_reason.get(rid)
+
+    def logprobs(self, rid: int):
+        """Chosen-token logprobs (model's raw distribution) for a
+        FINISHED request, aligned with its generated ids; None once
+        evicted from the retention window or while in flight."""
+        return self._finished_logprobs.get(rid)
 
     def stats(self) -> dict:
         """Engine observability: lifetime counters + current occupancy
@@ -271,7 +296,7 @@ class ContinuousBatchEngine:
             rows = [(r.sampling or self._sample_cfg) if r is not None
                     else self._sample_cfg for r in self._slots]
             step = _get_select_decode_rows(self.model, self.max_len)
-            nxt, self._last, self._caches = step(
+            nxt, logps, self._last, self._caches = step(
                 self._last, _random.next_key(),
                 jnp.asarray([r[0] for r in rows], bool),
                 jnp.asarray([r[1] for r in rows], jnp.float32),
@@ -281,9 +306,10 @@ class ContinuousBatchEngine:
         else:
             step = _get_select_decode(self.model, self.max_len, do_sample,
                                       temperature, top_k, top_p)
-            nxt, self._last, self._caches = step(
+            nxt, logps, self._last, self._caches = step(
                 self._last, _random.next_key(), self._caches)
         toks = np.asarray(nxt)
+        lps = np.asarray(logps)
         self._n_steps += 1
         retiring = []
         events = []  # (cb, rid, token, done): fired AFTER bookkeeping, so a
@@ -294,6 +320,9 @@ class ContinuousBatchEngine:
                 continue
             t = int(toks[s])
             req.tokens.append(t)
+            lp = float(lps[s])
+            if req.want_logprobs:
+                req.logprobs.append(lp)
             self._n_tokens += 1
             stopped = ((self.eos_token_id is not None
                         and t == self.eos_token_id)
@@ -305,12 +334,16 @@ class ContinuousBatchEngine:
                 # front-end reading it at the done event sees the truth
                 self._finished_reason[req.rid] = ("stop" if stopped
                                                   else "length")
+                if req.want_logprobs:
+                    self._finished_logprobs[req.rid] = list(req.logprobs)
                 self._reason_order.append(req.rid)
                 while len(self._reason_order) > _REASON_KEEP:
-                    self._finished_reason.pop(self._reason_order.pop(0),
-                                              None)
+                    old = self._reason_order.pop(0)
+                    self._finished_reason.pop(old, None)
+                    self._finished_logprobs.pop(old, None)
             if req.on_token is not None:
-                events.append((req.on_token, req.rid, t, finished))
+                events.append((req.on_token, req.on_token_arity,
+                               req.rid, t, lp, finished))
             if finished:
                 retiring.append(s)
         active = np.array([r is not None for r in self._slots])
@@ -326,9 +359,12 @@ class ContinuousBatchEngine:
         # stream AFTER state is consistent: every callback fires even if an
         # earlier one raises; the first exception then propagates
         first_exc = None
-        for cb, rid, t, done in events:
+        for cb, arity, rid, t, lp, done in events:
             try:
-                cb(rid, t, done)
+                if arity >= 4:
+                    cb(rid, t, done, lp)
+                else:
+                    cb(rid, t, done)
             except BaseException as e:  # noqa: BLE001 — deliberate collect
                 if first_exc is None:
                     first_exc = e

@@ -105,8 +105,8 @@ class CompletionServer:
                 drained = True
                 ev = sub.events
 
-                def on_token(rid, tok, done, _ev=ev):
-                    _ev.put(("token", tok, done))
+                def on_token(rid, tok, done, logprob, _ev=ev):
+                    _ev.put(("token", (tok, logprob), done))
 
                 try:
                     sub.rid = eng.add_request(sub.ids, on_token=on_token,
@@ -203,6 +203,11 @@ class CompletionServer:
                     stop = req.get("stop_token_ids")
                     if stop is not None:
                         params["stop_token_ids"] = [int(s) for s in stop]
+                    # OpenAI "logprobs" is an int 0-5 (0 = chosen-token
+                    # logprobs, no alternatives) or a bool — any non-None
+                    # value requests them
+                    if req.get("logprobs") is not None:
+                        params["logprobs"] = True
                     px = req.get("pixel_values")
                     if px is not None:
                         # multimodal request (LLaVA): nested lists
@@ -221,8 +226,9 @@ class CompletionServer:
                 server_self._subs.put(sub)
                 cid = f"cmpl-{uuid.uuid4().hex[:24]}"
                 if req.get("stream"):
-                    return self._stream(sub, cid, len(ids))
-                toks, err = [], None
+                    return self._stream(sub, cid, len(ids),
+                                        req.get("logprobs") is not None)
+                toks, lps, err = [], [], None
                 while True:
                     try:
                         kind, payload, done = sub.events.get(timeout=1.0)
@@ -234,7 +240,9 @@ class CompletionServer:
                     if kind in ("error", "fault"):
                         err = (kind, payload)
                         break
-                    toks.append(int(payload))
+                    tok, lp = payload
+                    toks.append(int(tok))
+                    lps.append(float(lp))
                     if done:
                         break
                 if err is not None:
@@ -247,6 +255,8 @@ class CompletionServer:
                           or "length")
                 choice = {"index": 0, "finish_reason": reason,
                           "token_ids": toks}
+                if req.get("logprobs") is not None:
+                    choice["logprobs"] = {"token_logprobs": lps}
                 if server_self.tokenizer is not None:
                     choice["text"] = server_self.tokenizer.decode(toks)
                 return self._json(200, {
@@ -258,7 +268,7 @@ class CompletionServer:
                               "total_tokens": len(ids) + len(toks)},
                 })
 
-            def _stream(self, sub, cid, n_prompt):
+            def _stream(self, sub, cid, n_prompt, want_logprobs=False):
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
@@ -284,12 +294,16 @@ class CompletionServer:
                               + json.dumps(str(payload)).encode() + b"}\n\n")
                         clean = False
                         break
+                    tok, lp = payload
                     piece = {"id": cid, "object": "text_completion",
                              "choices": [{"index": 0,
-                                          "token_ids": [int(payload)]}]}
+                                          "token_ids": [int(tok)]}]}
+                    if want_logprobs:
+                        piece["choices"][0]["logprobs"] = {
+                            "token_logprobs": [float(lp)]}
                     if server_self.tokenizer is not None:
                         piece["choices"][0]["text"] = (
-                            server_self.tokenizer.decode([int(payload)]))
+                            server_self.tokenizer.decode([int(tok)]))
                     chunk(b"data: " + json.dumps(piece).encode() + b"\n\n")
                     if done:
                         break

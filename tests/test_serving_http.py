@@ -241,6 +241,53 @@ def test_stop_token_ids(served):
     assert json.loads(data)["choices"][0]["token_ids"] == solo
 
 
+def test_logprobs(served):
+    """OpenAI logprobs field: chosen-token logprobs under the model's raw
+    distribution, aligned with the generated ids and verified against a
+    direct forward pass."""
+    model, srv = served
+    prompt = np.random.RandomState(13).randint(1, 512, (6,)).tolist()
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 4,
+                          "logprobs": True})
+    assert status == 200
+    out = json.loads(data)["choices"][0]
+    toks = out["token_ids"]
+    lps = out["logprobs"]["token_logprobs"]
+    assert len(lps) == len(toks) == 4
+    # verify the FIRST step's logprob against a direct forward
+    import jax.numpy as jnp
+    import jax
+
+    logits = model(paddle.to_tensor(np.asarray(prompt)[None])).numpy()
+    ref = jax.nn.log_softmax(jnp.asarray(logits[0, -1], jnp.float32))
+    assert abs(float(ref[toks[0]]) - lps[0]) < 1e-3
+    assert all(lp <= 0.0 for lp in lps)
+    # OpenAI spells it as an int; 0 is a VALID value meaning "chosen-token
+    # logprobs, no alternatives"
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 3,
+                          "logprobs": 0})
+    assert status == 200
+    assert len(json.loads(data)["choices"][0]["logprobs"]
+               ["token_logprobs"]) == 3
+    # streaming carries per-token logprobs in each SSE chunk
+    host, port = srv.address
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt_token_ids": prompt, "max_tokens": 3,
+                             "stream": True, "logprobs": True}),
+                 {"Content-Type": "application/json"})
+    raw = conn.getresponse().read().decode()
+    conn.close()
+    events = [json.loads(e[len("data: "):]) for e in raw.splitlines()
+              if e.startswith("data: ") and e != "data: [DONE]"]
+    stream_lps = [e["choices"][0]["logprobs"]["token_logprobs"][0]
+                  for e in events]
+    assert len(stream_lps) == 3
+    assert abs(stream_lps[0] - lps[0]) < 1e-6
+
+
 def test_multimodal_over_http():
     """A LLaVA model behind the HTTP server: pixel_values as nested lists,
     served token-identically to solo multimodal generate; a text request
