@@ -65,16 +65,27 @@ class _Request:
         self.want_logprobs = bool(want_logprobs)
         self.logprobs: List[float] = []
         # streaming callbacks may take (rid, tok, done) or a 4th logprob
-        # arg; arity detected once at admission
+        # arg; arity detected once at admission by counting REQUIRED
+        # positional parameters only (a defaulted 4th param keeps the
+        # 3-arg call — the logprob must never clobber a closure default;
+        # *args opts into the 4-arg form)
         self.on_token_arity = 3
         if on_token is not None:
             import inspect
 
             try:
-                self.on_token_arity = len(
-                    inspect.signature(on_token).parameters)
+                required, varargs = 0, False
+                for prm in inspect.signature(on_token).parameters.values():
+                    if prm.kind in (prm.POSITIONAL_ONLY,
+                                    prm.POSITIONAL_OR_KEYWORD):
+                        if prm.default is prm.empty:
+                            required += 1
+                    elif prm.kind == prm.VAR_POSITIONAL:
+                        varargs = True
+                if varargs or required >= 4:
+                    self.on_token_arity = 4
             except (TypeError, ValueError):
-                self.on_token_arity = 3
+                pass
 
 
 _REASON_KEEP = 4096  # finish-reason retention window (see step())
@@ -173,7 +184,10 @@ class ContinuousBatchEngine:
         ``on_token(rid, token, done)`` streams each generated token as the
         engine's step that produced it completes (token-level streaming —
         the serving front-end's SSE hook); exceptions it raises propagate
-        out of step()/run_until_done().
+        out of step()/run_until_done(). A callback with FOUR required
+        positional parameters (or ``*args``) receives the chosen-token
+        logprob as the 4th argument; a defaulted 4th parameter keeps the
+        3-arg call (the logprob never clobbers a closure default).
 
         ``stop_token_ids`` retires the request on ANY of the given ids,
         IN ADDITION to the engine-level eos (the OpenAI "stop" role:

@@ -9,9 +9,11 @@ for paddle_tpu, stdlib-only (no web framework in the image):
   needs a ``tokenizer``) or ``prompt_token_ids`` (list of ints, no
   tokenizer needed), ``max_tokens``, ``temperature`` / ``top_k`` /
   ``top_p`` (per-request sampling rides the engine's per-row program),
-  ``stop_token_ids``, ``stream`` (SSE chunks per token, ``data: [DONE]``
-  terminator), and ``pixel_values`` ([n_images, C, H, W] nested lists)
-  for multimodal models — image and text requests batch in-flight;
+  ``stop_token_ids``, ``logprobs``, ``n`` (sampled sibling completions
+  batch in-flight on the engine), ``stream`` (SSE chunks per token,
+  ``data: [DONE]`` terminator), and ``pixel_values`` ([n_images, C, H, W]
+  nested lists) for multimodal models — image and text requests batch
+  in-flight;
 - ``GET /v1/models`` and ``GET /health``.
 
 Single-engine-thread design: device state (page pool, slot buffers) is
@@ -36,13 +38,15 @@ __all__ = ["CompletionServer", "serve"]
 
 
 class _Submission:
-    __slots__ = ("ids", "params", "events", "rid")
+    __slots__ = ("ids", "params", "events", "rid", "n", "rids")
 
-    def __init__(self, ids, params):
+    def __init__(self, ids, params, n=1):
         self.ids = ids
         self.params = params
         self.events: "queue.Queue" = queue.Queue()
         self.rid = None
+        self.n = n          # OpenAI "n": sibling completions of one prompt
+        self.rids = []
 
 
 class CompletionServer:
@@ -106,11 +110,14 @@ class CompletionServer:
                 ev = sub.events
 
                 def on_token(rid, tok, done, logprob, _ev=ev):
-                    _ev.put(("token", (tok, logprob), done))
+                    _ev.put(("token", (rid, tok, logprob), done))
 
                 try:
-                    sub.rid = eng.add_request(sub.ids, on_token=on_token,
-                                              **sub.params)
+                    for _ in range(sub.n):
+                        sub.rids.append(
+                            eng.add_request(sub.ids, on_token=on_token,
+                                            **sub.params))
+                    sub.rid = sub.rids[0]
                 except (ValueError, TypeError,
                         NotImplementedError) as e:
                     # client error (bad params, pixel_values to a
@@ -204,10 +211,32 @@ class CompletionServer:
                     if stop is not None:
                         params["stop_token_ids"] = [int(s) for s in stop]
                     # OpenAI "logprobs" is an int 0-5 (0 = chosen-token
-                    # logprobs, no alternatives) or a bool — any non-None
-                    # value requests them
-                    if req.get("logprobs") is not None:
+                    # logprobs, no alternatives) or a bool — False means
+                    # OFF, any other non-None value (0 included) is ON
+                    lp_req = req.get("logprobs")
+                    want_logprobs = (lp_req is not None
+                                     and lp_req is not False)
+                    if want_logprobs:
                         params["logprobs"] = True
+                    n = int(req.get("n", 1))
+                    if n < 1:
+                        raise ValueError("n must be >= 1")
+                    if n > 1 and req.get("stream"):
+                        raise ValueError(
+                            "n > 1 does not combine with stream")
+                    if n > 1:
+                        # validate the EFFECTIVE sampling config (engine
+                        # defaults merged with request overrides) — n
+                        # deterministic completions would be identical
+                        eng_s, eng_t, _, _ = server_self.engine._sample_cfg
+                        eff_s = params.get("do_sample", eng_s)
+                        eff_t = params.get("temperature", eng_t)
+                        if not eff_s or eff_t <= 0:
+                            raise ValueError(
+                                "n > 1 needs effective sampling "
+                                "(do_sample with temperature > 0) — n "
+                                "deterministic completions would be "
+                                "identical")
                     px = req.get("pixel_values")
                     if px is not None:
                         # multimodal request (LLaVA): nested lists
@@ -222,13 +251,13 @@ class CompletionServer:
                 except (ValueError, TypeError) as e:
                     # wrong-typed fields answer 400, not a dropped socket
                     return self._json(400, {"error": str(e)})
-                sub = _Submission(ids, params)
+                sub = _Submission(ids, params, n=n)
                 server_self._subs.put(sub)
                 cid = f"cmpl-{uuid.uuid4().hex[:24]}"
                 if req.get("stream"):
-                    return self._stream(sub, cid, len(ids),
-                                        req.get("logprobs") is not None)
-                toks, lps, err = [], [], None
+                    return self._stream(sub, cid, len(ids), want_logprobs)
+                by_rid, lps_by_rid, err = {}, {}, None
+                finished = 0
                 while True:
                     try:
                         kind, payload, done = sub.events.get(timeout=1.0)
@@ -240,32 +269,42 @@ class CompletionServer:
                     if kind in ("error", "fault"):
                         err = (kind, payload)
                         break
-                    tok, lp = payload
-                    toks.append(int(tok))
-                    lps.append(float(lp))
+                    rid, tok, lp = payload
+                    by_rid.setdefault(rid, []).append(int(tok))
+                    lps_by_rid.setdefault(rid, []).append(float(lp))
                     if done:
-                        break
+                        finished += 1
+                        if finished == sub.n:
+                            break
                 if err is not None:
                     kind, msg = err
                     return self._json(400 if kind == "error" else 500,
                                       {"error": msg})
-                # single source of truth: the ENGINE records why the
-                # request retired (recorded before the done event fires)
-                reason = (server_self.engine.finish_reason(sub.rid)
-                          or "length")
-                choice = {"index": 0, "finish_reason": reason,
-                          "token_ids": toks}
-                if req.get("logprobs") is not None:
-                    choice["logprobs"] = {"token_logprobs": lps}
-                if server_self.tokenizer is not None:
-                    choice["text"] = server_self.tokenizer.decode(toks)
+                choices = []
+                total_completion = 0
+                for i, rid in enumerate(sub.rids):
+                    toks = by_rid.get(rid, [])
+                    total_completion += len(toks)
+                    # single source of truth: the ENGINE records why each
+                    # request retired (recorded before its done event)
+                    choice = {"index": i,
+                              "finish_reason":
+                                  (server_self.engine.finish_reason(rid)
+                                   or "length"),
+                              "token_ids": toks}
+                    if want_logprobs:
+                        choice["logprobs"] = {
+                            "token_logprobs": lps_by_rid.get(rid, [])}
+                    if server_self.tokenizer is not None:
+                        choice["text"] = server_self.tokenizer.decode(toks)
+                    choices.append(choice)
                 return self._json(200, {
                     "id": cid, "object": "text_completion",
                     "model": server_self.model_name,
-                    "choices": [choice],
+                    "choices": choices,
                     "usage": {"prompt_tokens": len(ids),
-                              "completion_tokens": len(toks),
-                              "total_tokens": len(ids) + len(toks)},
+                              "completion_tokens": total_completion,
+                              "total_tokens": len(ids) + total_completion},
                 })
 
             def _stream(self, sub, cid, n_prompt, want_logprobs=False):
@@ -294,7 +333,7 @@ class CompletionServer:
                               + json.dumps(str(payload)).encode() + b"}\n\n")
                         clean = False
                         break
-                    tok, lp = payload
+                    _rid, tok, lp = payload
                     piece = {"id": cid, "object": "text_completion",
                              "choices": [{"index": 0,
                                           "token_ids": [int(tok)]}]}

@@ -264,13 +264,18 @@ def test_logprobs(served):
     assert abs(float(ref[toks[0]]) - lps[0]) < 1e-3
     assert all(lp <= 0.0 for lp in lps)
     # OpenAI spells it as an int; 0 is a VALID value meaning "chosen-token
-    # logprobs, no alternatives"
+    # logprobs, no alternatives"; False means OFF
     status, data = _post(srv, "/v1/completions",
                          {"prompt_token_ids": prompt, "max_tokens": 3,
                           "logprobs": 0})
     assert status == 200
     assert len(json.loads(data)["choices"][0]["logprobs"]
                ["token_logprobs"]) == 3
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 3,
+                          "logprobs": False})
+    assert status == 200
+    assert "logprobs" not in json.loads(data)["choices"][0]
     # streaming carries per-token logprobs in each SSE chunk
     host, port = srv.address
     conn = http.client.HTTPConnection(host, port, timeout=120)
@@ -286,6 +291,33 @@ def test_logprobs(served):
                   for e in events]
     assert len(stream_lps) == 3
     assert abs(stream_lps[0] - lps[0]) < 1e-6
+
+
+def test_n_completions(served):
+    """OpenAI n: sampled sibling completions of one prompt, served
+    in-flight as separate engine requests with per-choice finish reasons
+    and logprobs; greedy n>1 and stream+n reject."""
+    _, srv = served
+    prompt = np.random.RandomState(14).randint(1, 512, (6,)).tolist()
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 5,
+                          "n": 3, "temperature": 0.9, "logprobs": True})
+    assert status == 200
+    out = json.loads(data)
+    assert len(out["choices"]) == 3
+    assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+    for c in out["choices"]:
+        assert len(c["token_ids"]) == 5
+        assert len(c["logprobs"]["token_logprobs"]) == 5
+    assert out["usage"]["completion_tokens"] == 15
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 5,
+                          "n": 3})
+    assert status == 400 and b"sampling" in data
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 5,
+                          "n": 2, "temperature": 0.9, "stream": True})
+    assert status == 400 and b"stream" in data
 
 
 def test_multimodal_over_http():
