@@ -51,6 +51,8 @@ def main():
             M.Phi3Config.tiny(num_hidden_layers=2, vocab_size=256))),
         ("glm4", M.Glm4ForCausalLM(
             M.Glm4Config.tiny(num_hidden_layers=2, vocab_size=256))),
+        ("olmo2", M.Olmo2ForCausalLM(
+            M.Olmo2Config.tiny(num_hidden_layers=2, vocab_size=256))),
         ("llama-moe", M.LlamaMoEForCausalLM(
             M.LlamaMoEConfig.tiny_moe(vocab_size=256))),
         ("qwen2-moe", M.Qwen2MoeForCausalLM(

@@ -68,6 +68,10 @@ _LAZY = {
     "MixtralConfig": ("mixtral", "MixtralConfig"),
     "MixtralForCausalLM": ("mixtral", "MixtralForCausalLM"),
     "mixtral_from_hf": ("mixtral", "mixtral_from_hf"),
+    "olmo2": ("olmo2", None),
+    "Olmo2Config": ("olmo2", "Olmo2Config"),
+    "Olmo2ForCausalLM": ("olmo2", "Olmo2ForCausalLM"),
+    "olmo2_from_hf": ("olmo2", "olmo2_from_hf"),
     "phi3": ("phi3", None),
     "Phi3Config": ("phi3", "Phi3Config"),
     "Phi3ForCausalLM": ("phi3", "Phi3ForCausalLM"),

@@ -56,8 +56,10 @@ class LlamaConfig:
     # attention head width decoupled from hidden_size/num_heads (Qwen3:
     # e.g. hidden 2560, 32 heads, head_dim 128); None = the quotient
     head_dim: Optional[int] = None
-    # per-head RMSNorm on q/k after projection, before RoPE (Qwen3)
-    qk_norm: bool = False
+    # RMSNorm on q/k after projection, before RoPE: False, True or
+    # "per_head" (Qwen3 — one norm per head over head_dim), or "full"
+    # (OLMo2 — one norm over the WHOLE projected width)
+    qk_norm: "bool | str" = False
     # fraction of head_dim that rotates (GLM/StableLM/Phi-3-small class):
     # rope tables are built at rope_dim_of(config) width and the
     # application sites rotate only that leading slice
@@ -118,6 +120,10 @@ class LlamaConfig:
                 "final_logit_softcapping cannot combine with "
                 "fuse_linear_cross_entropy (the chunked-CE scan computes "
                 "uncapped logits)")
+        if self.qk_norm not in (False, True, "per_head", "full"):
+            raise ValueError(
+                f"qk_norm must be False, True, 'per_head' or 'full', "
+                f"got {self.qk_norm!r}")
         if not (0.0 < self.partial_rotary_factor <= 1.0):
             raise ValueError(
                 f"partial_rotary_factor must be in (0, 1], got "
@@ -515,10 +521,18 @@ class LlamaAttention(Layer):
         qpas = getattr(config, "query_pre_attn_scalar", None)
         self.q_premul = (math.sqrt(self.head_dim / qpas) if qpas else None)
         bias = config.attention_bias
-        if config.qk_norm:
+        self.qk_norm_mode = ("per_head" if config.qk_norm is True
+                             else (config.qk_norm or None))
+        if self.qk_norm_mode == "per_head":
             # Qwen3: per-head RMSNorm on q/k after projection, before RoPE
             self.q_norm = _width_norm(config, self.head_dim)
             self.k_norm = _width_norm(config, self.head_dim)
+        elif self.qk_norm_mode == "full":
+            # OLMo2: ONE norm over the whole projected q (and k) width
+            self.q_norm = _width_norm(config,
+                                      self.num_heads * self.head_dim)
+            self.k_norm = _width_norm(config,
+                                      self.num_kv_heads * self.head_dim)
         else:
             self.q_norm = self.k_norm = None
         self.q_proj = _make_linear(self.hidden_size, self.num_heads * self.head_dim,
@@ -533,10 +547,15 @@ class LlamaAttention(Layer):
     def forward(self, hidden_states, cos, sin, attention_mask=None, kv_cache=None, position_offset=0):
         b, s = hidden_states.shape[0], hidden_states.shape[1]
         h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
-        q = self.q_proj(hidden_states).reshape([b, s, h, d])
-        k = self.k_proj(hidden_states).reshape([b, s, hk, d])
+        q_flat = self.q_proj(hidden_states)
+        k_flat = self.k_proj(hidden_states)
+        if self.qk_norm_mode == "full":   # OLMo2: norm BEFORE head split
+            q_flat = self.q_norm(q_flat)
+            k_flat = self.k_norm(k_flat)
+        q = q_flat.reshape([b, s, h, d])
+        k = k_flat.reshape([b, s, hk, d])
         v = self.v_proj(hidden_states).reshape([b, s, hk, d])
-        if self.q_norm is not None:
+        if self.qk_norm_mode == "per_head":
             q = self.q_norm(q)
             k = self.k_norm(k)
         if self.q_premul is not None:
@@ -1154,11 +1173,19 @@ def hf_config_to_llama(hf_config, **overrides) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
-def _hf_llama_plan(model, extra_layer_norms=()):
+#: the classic per-layer norm pair of the Llama key layout (OLMo2 swaps
+#: in its post-only pair, Gemma2 appends its sandwich norms)
+_DEFAULT_LAYER_NORMS = ("input_layernorm", "post_attention_layernorm")
+
+
+def _hf_llama_plan(model, extra_layer_norms=(), layer_norms=None):
     """{our param name: (hf key, transpose)} for the Llama key layout —
     the ONE mapping shared by the loader and the reverse exporter. The
     (untied) lm head maps to "lm_head.weight"; loaders may redirect its
-    source for tied-in-HF checkpoints."""
+    source for tied-in-HF checkpoints. ``layer_norms=None`` resolves to
+    the classic pair here (the single source of that default)."""
+    if layer_norms is None:
+        layer_norms = _DEFAULT_LAYER_NORMS
     L = model.config.num_hidden_layers
     plan = {"llama.embed_tokens.weight": ("model.embed_tokens.weight", False),
             "llama.norm.weight": ("model.norm.weight", False)}
@@ -1177,24 +1204,24 @@ def _hf_llama_plan(model, extra_layer_norms=()):
                     f"{hf}.self_attn.{proj}.bias", False)
         for proj in ("gate_proj", "up_proj", "down_proj"):
             plan[f"{ours}.mlp.{proj}.weight"] = (f"{hf}.mlp.{proj}.weight", True)
-        plan[f"{ours}.input_layernorm.weight"] = (
-            f"{hf}.input_layernorm.weight", False)
-        plan[f"{ours}.post_attention_layernorm.weight"] = (
-            f"{hf}.post_attention_layernorm.weight", False)
-        for norm in extra_layer_norms:  # Gemma2 sandwich norms
+        for norm in tuple(layer_norms) + tuple(extra_layer_norms):
+            # default: the classic input/post_attention pair; Gemma2 adds
+            # its sandwich norms; OLMo2 swaps in its post-only pair
             plan[f"{ours}.{norm}.weight"] = (f"{hf}.{norm}.weight", False)
     if model.lm_head is not None:
         plan["lm_head.weight"] = ("lm_head.weight", True)
     return plan
 
 
-def export_hf_llama(model: "LlamaForCausalLM", extra_layer_norms=()):
+def export_hf_llama(model: "LlamaForCausalLM", extra_layer_norms=(),
+                    layer_norms=None):
     """The reverse of load_hf_llama: this model's weights as an
     HF-key-layout numpy state dict (torch [out, in] projection layout),
     ready for ``HFModel.load_state_dict`` via torch.from_numpy — train
     here, deploy anywhere. Tied models omit lm_head.weight (HF re-ties
     from the embedding). Round-trip parity is tested per family."""
-    plan = _hf_llama_plan(model, extra_layer_norms=extra_layer_norms)
+    plan = _hf_llama_plan(model, extra_layer_norms=extra_layer_norms,
+                          layer_norms=layer_norms)
     params = dict(model.named_parameters())
     out = {}
     for name, (hf_key, transpose) in plan.items():
@@ -1206,7 +1233,7 @@ def export_hf_llama(model: "LlamaForCausalLM", extra_layer_norms=()):
 
 
 def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict,
-                  extra_layer_norms=(),
+                  extra_layer_norms=(), layer_norms=None,
                   ignore_missing_prefixes=()) -> "LlamaForCausalLM":
     """Load a HuggingFace Llama checkpoint's state dict into ``model``.
 
@@ -1215,7 +1242,8 @@ def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict,
     projection transposes. Config names follow HF conventions, so the key
     mapping is mechanical (docstring contract in the module header).
     """
-    plan = _hf_llama_plan(model, extra_layer_norms=extra_layer_norms)
+    plan = _hf_llama_plan(model, extra_layer_norms=extra_layer_norms,
+                          layer_norms=layer_norms)
     tied_alias = set()
     if model.lm_head is not None:
         if "lm_head.weight" not in hf_state_dict:
@@ -1257,7 +1285,7 @@ def load_hf_llama(model: "LlamaForCausalLM", hf_state_dict,
 
 
 def _from_hf(config_cls, model_cls, hf_model_or_state, hf_config=None,
-             extra_layer_norms=(), **config_overrides):
+             extra_layer_norms=(), layer_norms=None, **config_overrides):
     """Shared HF-conversion protocol for the Llama-architecture families
     (Llama / Qwen2 / Mistral): unwrap model vs raw state, map the config,
     build, load."""
@@ -1271,7 +1299,8 @@ def _from_hf(config_cls, model_cls, hf_model_or_state, hf_config=None,
     base = hf_config_to_llama(hf_config, **config_overrides)
     cfg = base if config_cls is LlamaConfig else config_cls(**_dc.asdict(base))
     return load_hf_llama(model_cls(cfg), state,
-                         extra_layer_norms=extra_layer_norms)
+                         extra_layer_norms=extra_layer_norms,
+                         layer_norms=layer_norms)
 
 
 def llama_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
@@ -1291,6 +1320,7 @@ def llama_to_hf(model):
     emit a silently wrong checkpoint."""
     from .gemma2 import Gemma2ForCausalLM
     from .glm import GlmForCausalLM
+    from .olmo2 import _OLMO2_NORMS, Olmo2ForCausalLM
     from .phi3 import Phi3ForCausalLM
 
     if isinstance(model, (GlmForCausalLM, Phi3ForCausalLM)):
@@ -1299,7 +1329,10 @@ def llama_to_hf(model):
             "TRANSFORMED at load (fused projections / interleaved "
             "rotary); the reverse transform is not implemented — "
             "exporting raw runtime weights would be silently wrong")
-    extra = ()
+    extra, norms = (), None
     if isinstance(model, Gemma2ForCausalLM):
         extra = ("pre_feedforward_layernorm", "post_feedforward_layernorm")
-    return export_hf_llama(model, extra_layer_norms=extra)
+    if isinstance(model, Olmo2ForCausalLM):
+        norms = _OLMO2_NORMS
+    return export_hf_llama(model, extra_layer_norms=extra,
+                           layer_norms=norms)

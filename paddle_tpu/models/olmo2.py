@@ -1,0 +1,104 @@
+"""OLMo2 decoder family (AI2's OLMo-2 line, 1B → 32B).
+
+The Llama trunk with two structural deviations, both trunk-level:
+
+- POST-norm-only blocks: ``h = h + norm(attn(h)); h = h + norm(mlp(h))``
+  — no input/pre norms (own decoder layer via the ``_make_decoder_layer``
+  hook; the final stack norm stays);
+- ``qk_norm="full"``: ONE RMSNorm over the whole projected q (and k)
+  width, applied before the head split (Qwen3's variant norms per head
+  after the split).
+
+Everything else is the Llama recipe (SwiGLU, full RoPE, untied head), so
+caches, serving, beams, LoRA and the engine all apply unchanged.
+``olmo2_from_hf`` converts transformers checkpoints — the key layout is
+Llama's with the post-only norm pair.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..nn.layer import Layer
+from .llama import (LlamaAttention, LlamaConfig, LlamaForCausalLM, LlamaMLP,
+                    LlamaModel, LlamaRMSNorm, _from_hf, layer_window)
+
+_OLMO2_NORMS = ("post_attention_layernorm", "post_feedforward_layernorm")
+
+
+@dataclasses.dataclass
+class Olmo2Config(LlamaConfig):
+    # OLMo-2-7B shape
+    vocab_size: int = 100352
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 500000.0
+    qk_norm: "bool | str" = "full"
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=256,
+                    dtype="float32")
+        base.update(kw)
+        return Olmo2Config(**base)
+
+
+class Olmo2DecoderLayer(Layer):
+    """Post-norm block: the sublayer OUTPUT is normed, then residual-added
+    — no pre-norms at all."""
+
+    def __init__(self, config: Olmo2Config):
+        super().__init__(dtype=config.dtype)
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config)
+        self.post_feedforward_layernorm = LlamaRMSNorm(config)
+
+    def forward(self, hidden_states, cos, sin, attention_mask=None,
+                kv_cache=None):
+        if kv_cache is not None:
+            a, kv_cache = self.self_attn(hidden_states, cos, sin,
+                                         attention_mask, kv_cache)
+        else:
+            a = self.self_attn(hidden_states, cos, sin, attention_mask)
+        hidden_states = hidden_states + self.post_attention_layernorm(a)
+        hidden_states = hidden_states + self.post_feedforward_layernorm(
+            self.mlp(hidden_states))
+        if kv_cache is not None:
+            return hidden_states, kv_cache
+        return hidden_states
+
+
+class Olmo2Model(LlamaModel):
+    @staticmethod
+    def _make_decoder_layer(config, layer_idx):
+        layer = Olmo2DecoderLayer(config)
+        layer.self_attn.window = layer_window(config, layer_idx)
+        return layer
+
+
+class Olmo2ForCausalLM(LlamaForCausalLM):
+    """OLMo2 causal LM — post-norm trunk + full-width q/k norms."""
+
+    model_cls = Olmo2Model
+
+    def __init__(self, config: Olmo2Config):
+        if config.qk_norm != "full":
+            raise ValueError("OLMo2 norms the WHOLE projected q/k "
+                             "(qk_norm='full')")
+        super().__init__(config)
+
+
+def olmo2_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
+    """Build an Olmo2ForCausalLM from a transformers Olmo2 model (or a
+    raw state dict + config)."""
+    config_overrides.setdefault("qk_norm", "full")
+    return _from_hf(Olmo2Config, Olmo2ForCausalLM, hf_model_or_state,
+                    hf_config, layer_norms=_OLMO2_NORMS,
+                    **config_overrides)

@@ -9,7 +9,8 @@ import pytest
 import paddle_tpu as paddle
 
 FAMILIES = ["llama", "qwen2", "qwen3", "mistral", "gpt2", "qwen2_moe",
-            "deepseek", "mixtral", "gemma", "gemma2", "phi3", "glm4"]
+            "deepseek", "mixtral", "gemma", "gemma2", "phi3", "glm4",
+            "olmo2"]
 
 
 def _build(name):
@@ -70,6 +71,11 @@ def _build(name):
 
         # sandwich trunk + partial rotary + qkv bias on every path
         return Glm4ForCausalLM(Glm4Config.tiny(num_hidden_layers=2))
+    if name == "olmo2":
+        from paddle_tpu.models.olmo2 import Olmo2Config, Olmo2ForCausalLM
+
+        # post-norm blocks + full-width qk norms on every path
+        return Olmo2ForCausalLM(Olmo2Config.tiny(num_hidden_layers=2))
     if name == "phi3":
         from paddle_tpu.models.phi3 import Phi3Config, Phi3ForCausalLM
 

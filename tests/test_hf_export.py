@@ -81,6 +81,32 @@ def test_gemma2_roundtrip_through_from_hf():
                                atol=1e-5, rtol=1e-5)
 
 
+def test_olmo2_roundtrip_through_transformers():
+    """OLMo2 (post-only norms, full-width qk norms) exports through its
+    own layer_norms plan and reloads in transformers greedily."""
+    from transformers import Olmo2Config as HFConfig
+    from transformers import Olmo2ForCausalLM as HFOlmo2
+    from paddle_tpu.models.olmo2 import Olmo2Config, Olmo2ForCausalLM
+
+    paddle.seed(4)
+    m = Olmo2ForCausalLM(Olmo2Config.tiny(num_hidden_layers=2))
+    sd = llama_to_hf(m)
+    assert any("post_feedforward_layernorm" in k for k in sd)
+    assert not any("input_layernorm" in k for k in sd)
+    hf = _load_into_hf(HFOlmo2(HFConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-6,
+        rope_theta=500000.0, tie_word_embeddings=False, pad_token_id=0,
+        attn_implementation="eager")), sd)
+    ids = np.random.RandomState(5).randint(0, 512, (1, 8))
+    ours = m.generate(paddle.to_tensor(ids), max_new_tokens=5).numpy()
+    with torch.no_grad():
+        theirs = hf.generate(torch.from_numpy(ids), max_new_tokens=5,
+                             do_sample=False).numpy()[:, 8:]
+    np.testing.assert_array_equal(ours, theirs)
+
+
 def test_transformed_families_refuse_export():
     """GLM/Phi-3 checkpoints are TRANSFORMED at load; exporting raw
     runtime weights would be silently wrong — must refuse."""

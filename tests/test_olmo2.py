@@ -1,0 +1,78 @@
+"""OLMo2 family: post-norm-only blocks, full-width q/k norms; HF
+conversion with logits/greedy parity; decode-path agreement."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.olmo2 import (Olmo2Config, Olmo2ForCausalLM,
+                                     olmo2_from_hf)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _tiny_hf():
+    from transformers import Olmo2Config as HFConfig
+    from transformers import Olmo2ForCausalLM as HFOlmo2
+
+    torch.manual_seed(0)
+    return HFOlmo2(HFConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6,
+        rope_theta=500000.0, tie_word_embeddings=False, pad_token_id=0,
+        attn_implementation="eager")).eval()
+
+
+def test_logits_and_generate_match_transformers():
+    hf = _tiny_hf()
+    ours = olmo2_from_hf(hf, dtype="float32", use_flash_attention=False)
+    assert ours.config.qk_norm == "full"
+    attn = ours.llama.layers[0].self_attn
+    assert attn.q_norm.hidden_size == 64          # full projected width
+    assert attn.k_norm.hidden_size == 32          # kv heads x head_dim
+    ids = np.random.RandomState(0).randint(0, 128, (2, 11))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    got = ours(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+    with torch.no_grad():
+        gref = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                           do_sample=False).numpy()[:, 11:]
+    ggot = ours.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(ggot, gref)
+
+
+def test_decode_paths_agree():
+    paddle.seed(0)
+    m = Olmo2ForCausalLM(Olmo2Config.tiny())
+    ids = paddle.to_tensor(np.random.RandomState(1).randint(1, 512, (1, 9)))
+    a = m.generate(ids, max_new_tokens=5).numpy()
+    b = m.generate(ids, max_new_tokens=5, use_cache=False).numpy()
+    c = m.generate(ids, max_new_tokens=5, paged=True, page_size=4).numpy()
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, c)
+
+
+def test_guards_and_training():
+    from paddle_tpu import optimizer as opt
+
+    with pytest.raises(ValueError, match="full"):
+        Olmo2ForCausalLM(Olmo2Config.tiny(qk_norm=True))
+    with pytest.raises(ValueError, match="qk_norm"):
+        Olmo2Config.tiny(qk_norm="banded")
+    paddle.seed(1)
+    m = Olmo2ForCausalLM(Olmo2Config.tiny())
+
+    def loss_fn(mm, x, y):
+        loss, _ = mm(x, labels=y)
+        return loss
+
+    step = paddle.jit.train_step(m, loss_fn,
+                                 opt.AdamW(1e-2, parameters=m.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 16)))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 512, (2, 16)))
+    losses = [float(step(x, y).numpy()) for _ in range(4)]
+    assert losses[-1] < losses[0]
