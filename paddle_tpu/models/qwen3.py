@@ -63,8 +63,10 @@ class Qwen3ForCausalLM(LlamaForCausalLM):
     decoupled head width."""
 
     def __init__(self, config: Qwen3Config):
-        if not config.qk_norm:
-            raise ValueError("Qwen3 uses qk_norm=True")
+        if config.qk_norm not in (True, "per_head"):
+            raise ValueError(
+                "Qwen3 uses PER-HEAD q/k norms (qk_norm=True); "
+                f"got qk_norm={config.qk_norm!r}")
         super().__init__(config)
 
 

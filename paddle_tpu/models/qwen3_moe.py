@@ -58,8 +58,10 @@ class Qwen3MoeForCausalLM(LlamaMoEForCausalLM):
     signature and a shared-expert-free routed FFN."""
 
     def __init__(self, config: Qwen3MoeConfig):
-        if not config.qk_norm:
-            raise ValueError("Qwen3-MoE uses qk_norm=True")
+        if config.qk_norm not in (True, "per_head"):
+            raise ValueError(
+                "Qwen3-MoE uses PER-HEAD q/k norms (qk_norm=True); "
+                f"got qk_norm={config.qk_norm!r}")
         if config.n_shared_experts:
             raise ValueError("Qwen3-MoE has no shared expert "
                              "(n_shared_experts=0)")

@@ -346,15 +346,10 @@ class ContinuousBatchEngine:
             if finished:
                 # recorded BEFORE the on_token callbacks fire, so a
                 # front-end reading it at the done event sees the truth
-                self._finished_reason[req.rid] = ("stop" if stopped
-                                                  else "length")
-                if req.want_logprobs:
-                    self._finished_logprobs[req.rid] = list(req.logprobs)
-                self._reason_order.append(req.rid)
-                while len(self._reason_order) > _REASON_KEEP:
-                    old = self._reason_order.pop(0)
-                    self._finished_reason.pop(old, None)
-                    self._finished_logprobs.pop(old, None)
+                self._record_reason(
+                    req.rid, "stop" if stopped else "length",
+                    logprobs=(list(req.logprobs) if req.want_logprobs
+                              else None))
             if req.on_token is not None:
                 events.append((req.on_token, req.on_token_arity,
                                req.rid, t, lp, finished))
@@ -399,6 +394,40 @@ class ContinuousBatchEngine:
         return out
 
     # ---- internals ----------------------------------------------------------
+    def cancel(self, rid: int) -> bool:
+        """Abort a request (client disconnect): queued requests drop
+        before admission; active requests free their slot immediately —
+        the next step() stops decoding the row and admission can refill
+        it. Partial tokens are NOT delivered. Returns True if the request
+        was live (queued or active); False if unknown or already
+        finished."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                del self._queue[i]
+                self._record_reason(rid, "cancelled")
+                return True
+        for s, req in enumerate(self._slots):
+            if req is not None and req.rid == rid:
+                self._slots[s] = None
+                self._lengths = self._lengths.at[s].set(0)
+                self._record_reason(rid, "cancelled")
+                self._admit()     # the freed slot can refill immediately
+                return True
+        return False
+
+    def _record_reason(self, rid: int, reason: str, logprobs=None):
+        """Record why a request ended and trim the retention window —
+        the ONE bookkeeping path for finishes AND cancels (a cancel-heavy
+        workload must not grow the window unboundedly)."""
+        self._finished_reason[rid] = reason
+        if logprobs is not None:
+            self._finished_logprobs[rid] = logprobs
+        self._reason_order.append(rid)
+        while len(self._reason_order) > _REASON_KEEP:
+            old = self._reason_order.pop(0)
+            self._finished_reason.pop(old, None)
+            self._finished_logprobs.pop(old, None)
+
     def _drain_finished(self):
         done, self._finished = self._finished, {}
         return done

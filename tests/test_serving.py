@@ -354,3 +354,32 @@ def test_prefix_cache_composes_with_sliding_window():
             paddle.to_tensor(np.concatenate([sys_prompt, t])[None]),
             max_new_tokens=5).numpy()[0]
         assert done[rid].tolist() == solo.tolist()
+
+
+def test_cancel_request(tiny_model):
+    """cancel(): queued requests drop before admission; active requests
+    free their slot (which refills from the queue) and the survivors'
+    outputs stay token-identical to solo — cancellation never perturbs
+    other rows."""
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    m = tiny_model
+    rng = np.random.RandomState(21)
+    keep_p = rng.randint(1, 512, (7,))
+    solo = m.generate(paddle.to_tensor(keep_p[None]),
+                      max_new_tokens=8).numpy()[0]
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8)
+    keep = eng.add_request(keep_p, max_new_tokens=8)
+    dead = eng.add_request(rng.randint(1, 512, (6,)), max_new_tokens=8)
+    queued = eng.add_request(rng.randint(1, 512, (5,)), max_new_tokens=4)
+    eng.step(); eng.step()
+    assert eng.cancel(dead) is True            # active -> slot freed
+    assert eng.finish_reason(dead) == "cancelled"
+    # the third request may be queued OR already admitted into the
+    # freed slot — either way it is live, so cancel returns True
+    assert eng.cancel(queued) is True
+    done = eng.run_until_done()
+    assert dead not in done
+    assert done[keep].tolist() == solo.tolist()
+    assert eng.cancel(keep) is False           # already finished
+    assert eng.cancel(10 ** 9) is False        # unknown
