@@ -115,7 +115,8 @@ class BartAttention(Layer):
                              unwrap(vh).astype(jnp.float32))
             return out.astype(unwrap(qh).dtype)
 
-        if isinstance(kv_cache, dict) and "pos" not in kv_cache:
+        if (isinstance(kv_cache, dict) and "pos" not in kv_cache
+                and "lengths" not in kv_cache):
             add = None
             cmask = kv_cache.get("mask")
             if cmask is not None:
@@ -123,6 +124,28 @@ class BartAttention(Layer):
             out = attend(q, kv_cache["k"], kv_cache["v"], add)
             return self.out_proj(
                 wrap(out.reshape(b, -1, self.n_heads * self.head_dim))), kv_cache
+        if isinstance(kv_cache, dict) and "lengths" in kv_cache:
+            # RAGGED single-token decode (the seq2seq serving engine):
+            # row r's new token writes at ITS length and attends columns
+            # 0..lengths[r] — slots of different ages share one step
+            s = hidden.shape[1]
+            if s != 1:
+                raise ValueError("ragged enc-dec decode is single-token")
+            lengths = kv_cache["lengths"]
+            k_new = self._split(self.k_proj(hidden), b)
+            v_new = self._split(self.v_proj(hidden), b)
+            rows = jnp.arange(b)
+            k_buf = kv_cache["k"].at[rows, lengths].set(
+                unwrap(k_new)[:, 0].astype(kv_cache["k"].dtype))
+            v_buf = kv_cache["v"].at[rows, lengths].set(
+                unwrap(v_new)[:, 0].astype(kv_cache["v"].dtype))
+            t_idx = jnp.arange(k_buf.shape[1])
+            valid = t_idx[None, :] <= lengths[:, None]          # [B, T]
+            add = jnp.where(valid[:, None, None, :], 0.0, -jnp.inf)
+            out = attend(q, k_buf, v_buf, add)
+            new = {"k": k_buf, "v": v_buf, "lengths": lengths + 1}
+            return self.out_proj(
+                wrap(out.reshape(b, s, self.n_heads * self.head_dim))), new
         if isinstance(kv_cache, dict):
             s = hidden.shape[1]
             k_new = self._split(self.k_proj(hidden), b)
@@ -273,9 +296,13 @@ class BartModel(Layer):
 
     def decode_cached(self, ids, self_caches, cross_caches):
         s = ids.shape[1]
-        pos = self_caches[0]["pos"]
+        if "lengths" in self_caches[0]:     # ragged serving rows
+            positions = (self_caches[0]["lengths"][:, None]
+                         + jnp.arange(s)[None, :])
+        else:
+            positions = self_caches[0]["pos"] + jnp.arange(s)
         hidden = self.decoder_ln_emb(
-            self._embed(ids, self.decoder_pos, pos + jnp.arange(s)))
+            self._embed(ids, self.decoder_pos, positions))
         new_self, new_cross = [], []
         for layer, sc, cc in zip(self.decoder_layers_list, self_caches,
                                  cross_caches):

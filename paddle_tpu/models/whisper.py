@@ -240,8 +240,12 @@ class WhisperModel(Layer):
 
     def decode_cached(self, ids, self_caches, cross_caches):
         s = ids.shape[1]
-        pos = self_caches[0]["pos"]
-        hidden = self._embed(ids, pos + jnp.arange(s))
+        if "lengths" in self_caches[0]:     # ragged serving rows
+            positions = (self_caches[0]["lengths"][:, None]
+                         + jnp.arange(s)[None, :])
+        else:
+            positions = self_caches[0]["pos"] + jnp.arange(s)
+        hidden = self._embed(ids, positions)
         new_self, new_cross = [], []
         for layer, sc, cc in zip(self.decoder_layers_list, self_caches,
                                  cross_caches):

@@ -42,7 +42,8 @@ def _page_tiles(buf, page_size):
 class _Request:
     __slots__ = ("rid", "ids", "max_new_tokens", "tokens", "slot", "sampling",
                  "on_token", "on_token_arity", "pixel_values",
-                 "stop_token_ids", "logprobs", "want_logprobs")
+                 "stop_token_ids", "logprobs", "want_logprobs",
+                 "encoder_input", "seed_ids")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
                  on_token=None, pixel_values=None, stop_token_ids=None,
@@ -64,6 +65,8 @@ class _Request:
         # window of full float lists nobody wants would dominate memory
         self.want_logprobs = bool(want_logprobs)
         self.logprobs: List[float] = []
+        self.encoder_input = None   # Seq2SeqBatchEngine payload
+        self.seed_ids = None        # Seq2SeqBatchEngine decoder prompt
         # streaming callbacks may take (rid, tok, done) or a 4th logprob
         # arg; arity detected once at admission by counting REQUIRED
         # positional parameters only (a defaulted 4th param keeps the
@@ -868,3 +871,242 @@ class ContinuousBatchEngine:
             c_eng["k_pages"], c_eng["v_pages"] = kp, vp
         self._last = self._last.at[slot].set(last[0].astype(jnp.float32))
         self._lengths = self._lengths.at[slot].set(S0)
+
+
+class Seq2SeqBatchEngine:
+    """Continuous batching for ENCODER-DECODER families (Whisper ASR,
+    BART seq2seq) — the enc-dec twin of ContinuousBatchEngine.
+
+    Fixed-shape design, same philosophy: per-slot pools hold each
+    request's encoder cross K/V (computed once at admission, masked to
+    its true encoder length) and a ragged self-cache ([B, max_decode_len]
+    rows with per-row lengths — the new BartAttention ragged branch);
+    every step() decodes ONE token for every active slot in a single
+    jitted dispatch. Admission runs the encoder + seed prefill for one
+    request on tiny B=1 caches and SCATTERS the rows into the slot.
+
+    T5 refuses: its relative-position bias indexes by a scalar decode
+    position and has no per-row form yet.
+    """
+
+    def __init__(self, model, max_batch: int, max_decode_len: int,
+                 max_encoder_len: int, eos_token_id=None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0):
+        name = type(model).__name__
+        if "T5" in name:
+            raise NotImplementedError(
+                "T5's relative-position bias has no per-row (ragged) "
+                "form; serve Whisper/BART, or T5 via solo generate()")
+        if not hasattr(getattr(model, "model", None), "decode_cached"):
+            raise TypeError(
+                f"{name} is not an encoder-decoder with cached decode")
+        self.model = model
+        cfg = model.config
+        table = getattr(cfg, "max_target_positions",
+                        getattr(cfg, "max_position_embeddings", None))
+        if table is not None and max_decode_len > table:
+            raise ValueError(
+                f"max_decode_len {max_decode_len} exceeds the decoder "
+                f"position table ({table}) — learned positions would "
+                "silently clamp")
+        self.max_batch = max_batch
+        self.max_decode_len = max_decode_len
+        self.max_encoder_len = max_encoder_len
+        self.eos_token_id = (cfg.eos_token_id if eos_token_id is None
+                             else eos_token_id)
+        self._sample_cfg = (bool(do_sample), float(temperature),
+                            int(top_k), float(top_p))
+        dt = jnp.dtype(cfg.dtype) if isinstance(cfg.dtype, str) else cfg.dtype
+        h = cfg.decoder_attention_heads
+        d = cfg.d_model // h
+        L = len(model.model.decoder_layers_list)
+        B = max_batch
+        self._self_k = [jnp.zeros((B, max_decode_len, h, d), dt)
+                        for _ in range(L)]
+        self._self_v = [jnp.zeros((B, max_decode_len, h, d), dt)
+                        for _ in range(L)]
+        self._cross_k = [jnp.zeros((B, max_encoder_len, h, d), dt)
+                         for _ in range(L)]
+        self._cross_v = [jnp.zeros((B, max_encoder_len, h, d), dt)
+                         for _ in range(L)]
+        self._enc_mask = jnp.zeros((B, max_encoder_len), bool)
+        self._lengths = jnp.zeros((B,), jnp.int32)
+        self._last = jnp.zeros((B, cfg.vocab_size), jnp.float32)
+        self._queue: List[_Request] = []
+        self._slots: List[Optional[_Request]] = [None] * B
+        self._finished: Dict[int, np.ndarray] = {}
+        self._next_rid = 0
+
+    # ---- public API ----------------------------------------------------
+    def add_request(self, encoder_input, max_new_tokens: int = 64,
+                    seed_ids=None) -> int:
+        """Queue one request. ``encoder_input``: mel features
+        [num_mel_bins, frames] for Whisper, token ids for BART.
+        ``seed_ids``: decoder prompt (Whisper task tokens); defaults to
+        decoder_start_token_id."""
+        enc = np.asarray(encoder_input)
+        n_seed = 1 if seed_ids is None else int(np.asarray(seed_ids).size)
+        if n_seed + max_new_tokens > self.max_decode_len:
+            raise ValueError(
+                f"seed ({n_seed}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds engine max_decode_len {self.max_decode_len}")
+        # encoder length is knowable HERE (BART: token count; Whisper:
+        # ceil(frames/2) after the stride-2 conv) — a request that cannot
+        # fit must fail on ITS call, never abort the batch mid-run
+        t_enc = (enc.size if enc.ndim == 1
+                 else (enc.shape[-1] + 1) // 2)
+        if t_enc > self.max_encoder_len:
+            raise ValueError(
+                f"encoder input needs {t_enc} positions > engine "
+                f"max_encoder_len {self.max_encoder_len}")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid, [0], max_new_tokens)
+        req.encoder_input = enc
+        req.seed_ids = (None if seed_ids is None
+                        else np.asarray(seed_ids, np.int32).reshape(-1))
+        self._queue.append(req)
+        self._admit()
+        return rid
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    def run_until_done(self):
+        out: Dict[int, np.ndarray] = {}
+        while self._queue or self.num_active:
+            out.update(self.step())
+        out.update(self._drain())
+        return out
+
+    def _drain(self):
+        done, self._finished = self._finished, {}
+        return done
+
+    # ---- admission -----------------------------------------------------
+    def _admit(self):
+        from .autograd import tape as _tape
+        from .tensor_class import wrap
+
+        while self._queue and None in self._slots:
+            slot = self._slots.index(None)
+            req = self._queue.pop(0)
+            model = self.model
+            cfg = model.config
+            with _tape.no_grad():
+                enc_in = req.encoder_input
+                if enc_in.ndim == 1:                     # BART token ids
+                    enc = model.model.encode(
+                        wrap(jnp.asarray(enc_in[None], jnp.int32)))
+                else:                                    # Whisper mel
+                    enc = model.model.encode(
+                        wrap(jnp.asarray(enc_in[None], jnp.float32)))
+                t_enc = enc.shape[1]
+                if t_enc > self.max_encoder_len:
+                    # add_request pre-validates, so this is a safety net
+                    # for models whose encoder length derivation differs:
+                    # fail THIS request, never the in-flight batch
+                    self._finished[req.rid] = np.asarray([], np.int64)
+                    continue
+                seed = (req.seed_ids if req.seed_ids is not None
+                        else np.asarray([cfg.decoder_start_token_id],
+                                        np.int32))
+                n_seed = int(seed.size)
+                # B=1 seed prefill on the model's own scalar-pos caches
+                self_c, cross_c = model._init_caches(enc, 1, n_seed)
+                hidden, self_c, _ = model.model.decode_cached(
+                    wrap(jnp.asarray(seed[None], jnp.int32)), self_c,
+                    cross_c)
+                last = unwrap(model.lm_head_logits(
+                    wrap(unwrap(hidden)[:, -1:])))[:, 0, :]
+                # scatter the request's rows into the slot pools
+                for l, (sc, cc) in enumerate(zip(self_c, cross_c)):
+                    self._self_k[l] = self._self_k[l].at[
+                        slot, :n_seed].set(sc["k"][0].astype(
+                            self._self_k[l].dtype))
+                    self._self_v[l] = self._self_v[l].at[
+                        slot, :n_seed].set(sc["v"][0].astype(
+                            self._self_v[l].dtype))
+                    self._cross_k[l] = self._cross_k[l].at[
+                        slot, :t_enc].set(cc["k"][0].astype(
+                            self._cross_k[l].dtype))
+                    self._cross_v[l] = self._cross_v[l].at[
+                        slot, :t_enc].set(cc["v"][0].astype(
+                            self._cross_v[l].dtype))
+                self._enc_mask = self._enc_mask.at[slot].set(False)
+                self._enc_mask = self._enc_mask.at[slot, :t_enc].set(True)
+                self._lengths = self._lengths.at[slot].set(n_seed)
+                self._last = self._last.at[slot].set(
+                    last[0].astype(jnp.float32))
+            self._slots[slot] = req
+            req.slot = slot
+
+    # ---- decode --------------------------------------------------------
+    def _step_fn(self):
+        from .generation import _memoized_step
+
+        model = self.model
+        do_sample, temperature, top_k, top_p = self._sample_cfg
+
+        def build():
+            from .autograd import tape as _tape
+            from .generation import _functional_weights, sample_logits
+            from .tensor_class import wrap
+
+            def pure(state, last, key, sk, sv, ck, cv, enc_mask, lengths):
+                with _functional_weights(model, state), _tape.no_grad():
+                    nxt = sample_logits(last, key, do_sample=do_sample,
+                                        temperature=temperature,
+                                        top_k=top_k, top_p=top_p)
+                    token = nxt[:, None].astype(jnp.int32)
+                    self_c = [{"k": k, "v": v, "lengths": lengths}
+                              for k, v in zip(sk, sv)]
+                    cross_c = [{"k": k, "v": v, "mask": enc_mask}
+                               for k, v in zip(ck, cv)]
+                    hidden, new_self, _ = model.model.decode_cached(
+                        wrap(token), self_c, cross_c)
+                    last_n = unwrap(model.lm_head_logits(
+                        wrap(unwrap(hidden)[:, -1:])))[:, 0, :]
+                return (nxt, last_n.astype(jnp.float32),
+                        [c["k"] for c in new_self],
+                        [c["v"] for c in new_self])
+
+            fn = jax.jit(pure, donate_argnums=(3, 4))
+            step = lambda *a: fn(step._state, *a)
+            step._state = dict(model.functional_state())
+            return step
+
+        key = (self.max_batch, self.max_decode_len, self.max_encoder_len,
+               self._sample_cfg)
+        return _memoized_step(model, "_seq2seq_steps", key, build,
+                              maxsize=8)
+
+    def step(self) -> Dict[int, np.ndarray]:
+        """Decode ONE token for every active slot (one fused dispatch);
+        returns newly finished requests {rid: generated ids}."""
+        self._admit()
+        if self.num_active == 0:
+            return self._drain()
+        step = self._step_fn()
+        nxt, self._last, self._self_k, self._self_v = step(
+            self._last, _random.next_key(), self._self_k, self._self_v,
+            self._cross_k, self._cross_v, self._enc_mask, self._lengths)
+        toks = np.asarray(nxt)
+        active = np.array([r is not None for r in self._slots])
+        self._lengths = jnp.where(jnp.asarray(active), self._lengths + 1,
+                                  self._lengths)
+        for s, req in enumerate(self._slots):
+            if req is None:
+                continue
+            t = int(toks[s])
+            req.tokens.append(t)
+            if (len(req.tokens) >= req.max_new_tokens
+                    or (self.eos_token_id is not None
+                        and t == self.eos_token_id)):
+                self._finished[req.rid] = np.asarray(req.tokens, np.int64)
+                self._slots[s] = None
+                self._lengths = self._lengths.at[s].set(0)
+        self._admit()
+        return self._drain()

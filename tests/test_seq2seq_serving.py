@@ -1,0 +1,124 @@
+"""Seq2SeqBatchEngine: continuous batching for encoder-decoder families —
+Whisper (ASR) and BART served in-flight, token-identical to solo
+generate; staggered admission; T5 refusal."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import Seq2SeqBatchEngine
+
+
+def _mel(frames=32, bins=8, seed=0):
+    return np.random.RandomState(seed).randn(bins, frames).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def whisper_model():
+    from paddle_tpu.models.whisper import (WhisperConfig,
+                                           WhisperForConditionalGeneration)
+
+    paddle.seed(0)
+    return WhisperForConditionalGeneration(WhisperConfig.tiny())
+
+
+def _solo(m, feats, n, seed_ids=None):
+    out = m.generate(paddle.to_tensor(feats[None]), max_new_tokens=n,
+                     decoder_input_ids=(None if seed_ids is None
+                                        else np.asarray(seed_ids)[None]),
+                     eos_token_id=None).numpy()[0]
+    eos = m.config.eos_token_id
+    if eos in out:
+        out = out[: list(out).index(eos) + 1]
+    return out.tolist()
+
+
+def test_whisper_engine_matches_solo(whisper_model):
+    m = whisper_model
+    eng = Seq2SeqBatchEngine(m, max_batch=2, max_decode_len=16,
+                             max_encoder_len=16)
+    feats = [_mel(seed=i) for i in range(3)]
+    solos = [_solo(m, f, 8) for f in feats]
+    r0 = eng.add_request(feats[0], max_new_tokens=8)
+    eng.step()                                  # r0 in flight...
+    r1 = eng.add_request(feats[1], max_new_tokens=8)
+    r2 = eng.add_request(feats[2], max_new_tokens=8)   # queued (2 slots)
+    done = eng.run_until_done()
+    for rid, solo in zip((r0, r1, r2), solos):
+        assert done[rid].tolist() == solo, rid
+
+
+def test_whisper_seed_prompt(whisper_model):
+    m = whisper_model
+    eng = Seq2SeqBatchEngine(m, max_batch=2, max_decode_len=16,
+                             max_encoder_len=16)
+    feats = _mel(seed=7)
+    seed = [1, 9, 4]
+    solo = _solo(m, feats, 6, seed_ids=seed)
+    rid = eng.add_request(feats, max_new_tokens=6, seed_ids=seed)
+    done = eng.run_until_done()
+    assert done[rid].tolist() == solo
+
+
+def test_bart_engine_matches_solo():
+    from paddle_tpu.models.bart import (BartConfig,
+                                        BartForConditionalGeneration)
+
+    paddle.seed(1)
+    m = BartForConditionalGeneration(BartConfig.tiny())
+    rng = np.random.RandomState(3)
+    enc_ids = [rng.randint(3, 256, (n,)) for n in (9, 6)]
+    solos = []
+    for ids in enc_ids:
+        out = m.generate(paddle.to_tensor(ids[None]), max_new_tokens=7,
+                         eos_token_id=-1).numpy()[0]
+        solos.append(out.tolist())
+    eng = Seq2SeqBatchEngine(m, max_batch=2, max_decode_len=16,
+                             max_encoder_len=16, eos_token_id=-1)
+    r0 = eng.add_request(enc_ids[0], max_new_tokens=7)
+    eng.step()
+    r1 = eng.add_request(enc_ids[1], max_new_tokens=7)
+    done = eng.run_until_done()
+    assert done[r0].tolist() == solos[0]
+    assert done[r1].tolist() == solos[1]
+
+
+def test_t5_refuses():
+    from paddle_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+    paddle.seed(2)
+    m = T5ForConditionalGeneration(T5Config.tiny())
+    with pytest.raises(NotImplementedError, match="relative-position"):
+        Seq2SeqBatchEngine(m, max_batch=2, max_decode_len=8,
+                           max_encoder_len=8)
+
+
+def test_budget_and_encoder_overflow(whisper_model):
+    m = whisper_model
+    eng = Seq2SeqBatchEngine(m, max_batch=1, max_decode_len=8,
+                             max_encoder_len=8)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.add_request(_mel(), max_new_tokens=9)
+    with pytest.raises(ValueError, match="max_encoder_len"):
+        eng.add_request(_mel(frames=32), max_new_tokens=4)
+        eng.run_until_done()
+
+
+def test_seed_counts_against_decode_budget(whisper_model):
+    """Review r5 repro: seed + max_new_tokens overran the self-cache rows
+    and silently diverged — now rejects at add_request."""
+    m = whisper_model
+    eng = Seq2SeqBatchEngine(m, max_batch=1, max_decode_len=8,
+                             max_encoder_len=16)
+    with pytest.raises(ValueError, match="seed"):
+        eng.add_request(_mel(), max_new_tokens=8, seed_ids=[1, 2, 3, 4, 5])
+    # the same request sized correctly serves exactly
+    solo = _solo(m, _mel(seed=11), 3, seed_ids=[1, 2, 3, 4, 5])
+    rid = eng.add_request(_mel(seed=11), max_new_tokens=3,
+                          seed_ids=[1, 2, 3, 4, 5])
+    assert eng.run_until_done()[rid].tolist() == solo
+
+
+def test_decode_table_validated(whisper_model):
+    with pytest.raises(ValueError, match="position table"):
+        Seq2SeqBatchEngine(whisper_model, max_batch=1,
+                           max_decode_len=10 ** 4, max_encoder_len=16)
