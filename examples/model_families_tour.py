@@ -88,6 +88,15 @@ def main():
     wh_out = wh.generate(mel, max_new_tokens=6, eos_token_id=None)
     print(f"\n{'whisper':>10}: {wh_out.numpy()[0].tolist()}")
 
+    # ...and Whisper through the enc-dec continuous-batching engine
+    from paddle_tpu.serving import Seq2SeqBatchEngine
+
+    s2s = Seq2SeqBatchEngine(wh, max_batch=2, max_decode_len=16,
+                             max_encoder_len=16)
+    rid = s2s.add_request(rng.randn(8, 32).astype("float32"),
+                          max_new_tokens=5)
+    print(f"{'whisper-engine':>14}: {s2s.run_until_done()[rid].tolist()}")
+
     # multimodal: the llava member again, now WITH an image — placeholder
     # tokens in the prompt are replaced by projected CLIP patch features
     llava = dict(zoo)["llava"]
