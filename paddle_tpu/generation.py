@@ -631,6 +631,16 @@ class _EncDecBeamStep:
                             cross_caches)
 
 
+def reject_sampled_beams(family: str, num_beams: int, do_sample: bool):
+    """The enc-dec families' shared guard: beam search composes with
+    greedy scoring only (raised BEFORE any encoder compute, so an
+    argument error is free)."""
+    if num_beams > 1 and do_sample:
+        raise NotImplementedError(
+            f"{family}.generate: beam search composes with greedy "
+            "scoring only (do_sample=False)")
+
+
 def encdec_beam_generate(model, decode, step0, token0, self_c, cross_c,
                          max_new_tokens, num_beams, eos_token_id,
                          length_penalty, early_stopping, cache_attr):

@@ -367,11 +367,9 @@ class BartForConditionalGeneration(Layer):
         from ..generation import reject_non_default_kwargs
 
         reject_non_default_kwargs("BART", unsupported)
-        if num_beams > 1 and do_sample:
-            # before any encoder compute: an argument error must be free
-            raise NotImplementedError(
-                "BART.generate: beam search composes with greedy "
-                "scoring only (do_sample=False)")
+        from ..generation import reject_sampled_beams
+
+        reject_sampled_beams("BART", num_beams, do_sample)
         from ..autograd import tape as _tape
         from ..framework import random as _random
         from ..generation import _select, encdec_beam_generate

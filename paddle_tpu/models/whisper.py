@@ -297,17 +297,22 @@ class WhisperForConditionalGeneration(Layer):
 
     def generate(self, input_features, decoder_input_ids=None,
                  max_new_tokens=20, do_sample=False, temperature=1.0,
-                 top_k=0, top_p=1.0, eos_token_id=_UNSET, **unsupported):
+                 top_k=0, top_p=1.0, eos_token_id=_UNSET, num_beams=1,
+                 length_penalty=1.0, early_stopping=False, **unsupported):
         """Cached autoregressive transcription. ``decoder_input_ids``
         seeds the decoder (task/language prompt tokens); defaults to
         ``decoder_start_token_id``. Token suppression/forcing beyond the
-        seed belongs to the tokenizer pipeline, not the model."""
+        seed belongs to the tokenizer pipeline, not the model.
+        ``num_beams>1``: HF-semantics beam search (greedy scoring)."""
         from ..generation import reject_non_default_kwargs
 
         reject_non_default_kwargs("Whisper", unsupported)
+        from ..generation import reject_sampled_beams
+
+        reject_sampled_beams("Whisper", num_beams, do_sample)
         from ..autograd import tape as _tape
         from ..framework import random as _random
-        from ..generation import _select
+        from ..generation import _select, encdec_beam_generate
 
         cfg = self.config
         eos = cfg.eos_token_id if eos_token_id is _UNSET else eos_token_id
@@ -331,6 +336,13 @@ class WhisperForConditionalGeneration(Layer):
             enc = self.model.encode(feats)
             self_c, cross_c = self._init_caches(enc, B, max_len)
             step = _get_whisper_decode_step(self, max_len)
+            if num_beams > 1:
+                return encdec_beam_generate(
+                    self,
+                    lambda m, t, s, c: m.model.decode_cached(t, s, c),
+                    step, seed, self_c, cross_c, max_new_tokens,
+                    num_beams, eos, length_penalty, early_stopping,
+                    "_whisper_beam_steps")
             token = seed
             finished = jnp.zeros((B,), bool)
             out = []

@@ -152,3 +152,33 @@ def test_frame_overflow_raises():
     m = WhisperForConditionalGeneration(WhisperConfig.tiny())
     with pytest.raises(ValueError, match="max_source_positions"):
         m.model.encode(paddle.to_tensor(_mel(frames=64, seed=8)))
+
+
+def test_beam_search_matches_transformers():
+    """num_beams>1 on the Whisper enc-dec path: token-identical to HF beam
+    generate (the tiny config carries no task-token forcing)."""
+    hf = _tiny_hf()
+    ours = whisper_from_hf(hf)
+    feats = _mel(seed=12)
+    seed_ids = np.full((2, 1), 1, np.int64)
+    with torch.no_grad():
+        # HF whisper counts max_new_tokens as TOTAL decoder length and
+        # echoes the seed: [2, 6] including the start token
+        ref = hf.generate(input_features=torch.from_numpy(feats),
+                          decoder_input_ids=torch.from_numpy(seed_ids),
+                          max_new_tokens=6, num_beams=2, do_sample=False,
+                          length_penalty=1.0,
+                          early_stopping=False).numpy()[:, 1:]
+    got = ours.generate(paddle.to_tensor(feats), max_new_tokens=5,
+                        num_beams=2).numpy()
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_beam_k1_equals_greedy():
+    paddle.seed(5)
+    m = WhisperForConditionalGeneration(WhisperConfig.tiny())
+    feats = paddle.to_tensor(_mel(seed=13))
+    a = m.generate(feats, max_new_tokens=5, eos_token_id=None).numpy()
+    b = m.generate(feats, max_new_tokens=5, eos_token_id=None,
+                   num_beams=1).numpy()
+    np.testing.assert_array_equal(a, b)
