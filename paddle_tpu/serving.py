@@ -94,7 +94,53 @@ class _Request:
 _REASON_KEEP = 4096  # finish-reason retention window (see step())
 
 
-class ContinuousBatchEngine:
+class _RequestBookkeeping:
+    """Queued/active cancel scanning + bounded finish-reason retention —
+    the request-accounting block BOTH engines share (decoder-only and
+    seq2seq). Subclasses provide _queue/_slots/_lengths/_admit and the
+    reason/logprob dicts."""
+
+    def finish_reason(self, rid: int):
+        """Why a finished request retired: "stop" | "length" |
+        "cancelled" (| "error" for a failed seq2seq admission). None
+        while in flight or once evicted from the retention window."""
+        return self._finished_reason.get(rid)
+
+    def cancel(self, rid: int) -> bool:
+        """Abort a request (client disconnect): queued requests drop
+        before admission; active requests free their slot immediately —
+        the next step() stops decoding the row and admission can refill
+        it. Partial tokens are NOT delivered. Returns True if the request
+        was live (queued or active); False if unknown or finished."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                del self._queue[i]
+                self._record_reason(rid, "cancelled")
+                return True
+        for s, req in enumerate(self._slots):
+            if req is not None and req.rid == rid:
+                self._slots[s] = None
+                self._lengths = self._lengths.at[s].set(0)
+                self._record_reason(rid, "cancelled")
+                self._admit()     # the freed slot can refill immediately
+                return True
+        return False
+
+    def _record_reason(self, rid: int, reason: str, logprobs=None):
+        """Record why a request ended and trim the retention window —
+        the ONE bookkeeping path for finishes AND cancels (a cancel-heavy
+        workload must not grow the window unboundedly)."""
+        self._finished_reason[rid] = reason
+        if logprobs is not None:
+            self._finished_logprobs[rid] = logprobs
+        self._reason_order.append(rid)
+        while len(self._reason_order) > _REASON_KEEP:
+            old = self._reason_order.pop(0)
+            self._finished_reason.pop(old, None)
+            getattr(self, "_finished_logprobs", {}).pop(old, None)
+
+
+class ContinuousBatchEngine(_RequestBookkeeping):
     """In-flight batching: add_request() any time, step() decodes one token
     for every active slot, finished requests free their slot immediately.
 
@@ -264,11 +310,6 @@ class ContinuousBatchEngine:
     def num_active(self) -> int:
         return sum(r is not None for r in self._slots)
 
-    def finish_reason(self, rid: int) -> Optional[str]:
-        """Why a finished request retired: "stop" (eos or a per-request
-        stop id) or "length" (max_new_tokens). None while in flight."""
-        return self._finished_reason.get(rid)
-
     def logprobs(self, rid: int):
         """Chosen-token logprobs (model's raw distribution) for a
         FINISHED request, aligned with its generated ids; None once
@@ -397,40 +438,6 @@ class ContinuousBatchEngine:
         return out
 
     # ---- internals ----------------------------------------------------------
-    def cancel(self, rid: int) -> bool:
-        """Abort a request (client disconnect): queued requests drop
-        before admission; active requests free their slot immediately —
-        the next step() stops decoding the row and admission can refill
-        it. Partial tokens are NOT delivered. Returns True if the request
-        was live (queued or active); False if unknown or already
-        finished."""
-        for i, req in enumerate(self._queue):
-            if req.rid == rid:
-                del self._queue[i]
-                self._record_reason(rid, "cancelled")
-                return True
-        for s, req in enumerate(self._slots):
-            if req is not None and req.rid == rid:
-                self._slots[s] = None
-                self._lengths = self._lengths.at[s].set(0)
-                self._record_reason(rid, "cancelled")
-                self._admit()     # the freed slot can refill immediately
-                return True
-        return False
-
-    def _record_reason(self, rid: int, reason: str, logprobs=None):
-        """Record why a request ended and trim the retention window —
-        the ONE bookkeeping path for finishes AND cancels (a cancel-heavy
-        workload must not grow the window unboundedly)."""
-        self._finished_reason[rid] = reason
-        if logprobs is not None:
-            self._finished_logprobs[rid] = logprobs
-        self._reason_order.append(rid)
-        while len(self._reason_order) > _REASON_KEEP:
-            old = self._reason_order.pop(0)
-            self._finished_reason.pop(old, None)
-            self._finished_logprobs.pop(old, None)
-
     def _drain_finished(self):
         done, self._finished = self._finished, {}
         return done
@@ -873,7 +880,7 @@ class ContinuousBatchEngine:
         self._lengths = self._lengths.at[slot].set(S0)
 
 
-class Seq2SeqBatchEngine:
+class Seq2SeqBatchEngine(_RequestBookkeeping):
     """Continuous batching for ENCODER-DECODER families (Whisper ASR,
     BART seq2seq) — the enc-dec twin of ContinuousBatchEngine.
 
@@ -936,7 +943,13 @@ class Seq2SeqBatchEngine:
         self._queue: List[_Request] = []
         self._slots: List[Optional[_Request]] = [None] * B
         self._finished: Dict[int, np.ndarray] = {}
+        self._finished_reason: Dict[int, str] = {}
+        self._reason_order: List[int] = []
         self._next_rid = 0
+        self._n_requests = 0
+        self._n_finished = 0
+        self._n_tokens = 0
+        self._n_steps = 0
 
     # ---- public API ----------------------------------------------------
     def add_request(self, encoder_input, max_new_tokens: int = 64,
@@ -962,6 +975,7 @@ class Seq2SeqBatchEngine:
                 f"max_encoder_len {self.max_encoder_len}")
         rid = self._next_rid
         self._next_rid += 1
+        self._n_requests += 1
         req = _Request(rid, [0], max_new_tokens)
         req.encoder_input = enc
         req.seed_ids = (None if seed_ids is None
@@ -973,6 +987,20 @@ class Seq2SeqBatchEngine:
     @property
     def num_active(self) -> int:
         return sum(r is not None for r in self._slots)
+
+    def stats(self) -> dict:
+        """Engine observability (mirrors ContinuousBatchEngine.stats)."""
+        active = self.num_active
+        return {
+            "requests_admitted": self._n_requests,
+            "requests_finished": self._n_finished,
+            "requests_active": active,
+            "requests_queued": len(self._queue),
+            "decode_steps": self._n_steps,
+            "tokens_generated": self._n_tokens,
+            "slot_utilization": (active / self.max_batch
+                                 if self.max_batch else 0.0),
+        }
 
     def run_until_done(self):
         out: Dict[int, np.ndarray] = {}
@@ -1009,6 +1037,8 @@ class Seq2SeqBatchEngine:
                     # for models whose encoder length derivation differs:
                     # fail THIS request, never the in-flight batch
                     self._finished[req.rid] = np.asarray([], np.int64)
+                    self._n_finished += 1
+                    self._record_reason(req.rid, "error")
                     continue
                 seed = (req.seed_ids if req.seed_ids is not None
                         else np.asarray([cfg.decoder_start_token_id],
@@ -1094,6 +1124,7 @@ class Seq2SeqBatchEngine:
             self._last, _random.next_key(), self._self_k, self._self_v,
             self._cross_k, self._cross_v, self._enc_mask, self._lengths)
         toks = np.asarray(nxt)
+        self._n_steps += 1
         active = np.array([r is not None for r in self._slots])
         self._lengths = jnp.where(jnp.asarray(active), self._lengths + 1,
                                   self._lengths)
@@ -1102,10 +1133,14 @@ class Seq2SeqBatchEngine:
                 continue
             t = int(toks[s])
             req.tokens.append(t)
-            if (len(req.tokens) >= req.max_new_tokens
-                    or (self.eos_token_id is not None
-                        and t == self.eos_token_id)):
+            self._n_tokens += 1
+            stopped = (self.eos_token_id is not None
+                       and t == self.eos_token_id)
+            if len(req.tokens) >= req.max_new_tokens or stopped:
                 self._finished[req.rid] = np.asarray(req.tokens, np.int64)
+                self._n_finished += 1
+                self._record_reason(req.rid,
+                                    "stop" if stopped else "length")
                 self._slots[s] = None
                 self._lengths = self._lengths.at[s].set(0)
         self._admit()

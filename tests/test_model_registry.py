@@ -1,0 +1,27 @@
+"""Every entry in the models lazy-import registry resolves — a missing
+module or symbol in the map would otherwise only fail on first attribute
+access in user code."""
+import importlib
+
+import paddle_tpu.models as M
+
+
+def test_every_registry_entry_resolves():
+    lazy = getattr(M, "_LAZY", None) or getattr(M, "_lazy", None)
+    if lazy is None:
+        # find the mapping attr generically
+        for name in dir(M):
+            v = getattr(M, name)
+            if (isinstance(v, dict) and v
+                    and all(isinstance(k, str) for k in v)
+                    and all(isinstance(t, tuple) and len(t) == 2
+                            for t in v.values())):
+                lazy = v
+                break
+    assert lazy, "models lazy-import map not found"
+    for public, (module, symbol) in sorted(lazy.items()):
+        mod = importlib.import_module(f"paddle_tpu.models.{module}")
+        if symbol is not None:
+            assert hasattr(mod, symbol), (public, module, symbol)
+        # and the public attribute itself resolves through the lazy hook
+        assert getattr(M, public) is not None, public

@@ -122,3 +122,23 @@ def test_decode_table_validated(whisper_model):
     with pytest.raises(ValueError, match="position table"):
         Seq2SeqBatchEngine(whisper_model, max_batch=1,
                            max_decode_len=10 ** 4, max_encoder_len=16)
+
+
+def test_cancel_and_stats(whisper_model):
+    m = whisper_model
+    eng = Seq2SeqBatchEngine(m, max_batch=2, max_decode_len=16,
+                             max_encoder_len=16)
+    keep_f = _mel(seed=20)
+    solo = _solo(m, keep_f, 6)
+    keep = eng.add_request(keep_f, max_new_tokens=6)
+    dead = eng.add_request(_mel(seed=21), max_new_tokens=6)
+    eng.step()
+    assert eng.cancel(dead) is True
+    assert eng.finish_reason(dead) == "cancelled"
+    done = eng.run_until_done()
+    assert dead not in done
+    assert done[keep].tolist() == solo
+    assert eng.finish_reason(keep) in ("stop", "length")
+    s = eng.stats()
+    assert s["requests_admitted"] == 2 and s["requests_finished"] == 1
+    assert s["tokens_generated"] >= len(solo)
