@@ -147,6 +147,20 @@ class T5Attention(Layer):
         bias = jnp.take(table, buckets, axis=0)       # [q, kv, heads]
         return jnp.moveaxis(bias, 2, 0)[None]         # [1, h, q, kv]
 
+    def compute_bias_rows(self, lengths, kv_len):
+        """PER-ROW bias for ragged single-token decode (the seq2seq
+        serving engine): [B, heads, 1, kv_len] with row r's query at
+        position lengths[r] — the same bucketing/table as compute_bias,
+        kept on the layer that owns the table."""
+        mem = jnp.arange(kv_len)[None, :]
+        buckets = _rel_position_bucket(
+            mem - lengths[:, None], self.bidirectional,
+            self.config.relative_attention_num_buckets,
+            self.config.relative_attention_max_distance)   # [B, kv]
+        table = unwrap(self.relative_attention_bias.weight)
+        bias = jnp.take(table, buckets, axis=0)            # [B, kv, h]
+        return jnp.moveaxis(bias, 2, 1)[:, :, None, :]     # [B, h, 1, kv]
+
     def _split(self, t, b):
         return t.reshape([b, -1, self.n_heads, self.d_kv])
 
@@ -170,7 +184,8 @@ class T5Attention(Layer):
                              unwrap(vh).astype(jnp.float32))
             return out.astype(unwrap(qh).dtype)
 
-        if isinstance(kv_cache, dict) and "pos" not in kv_cache:
+        if (isinstance(kv_cache, dict) and "pos" not in kv_cache
+                and "lengths" not in kv_cache):
             # cached cross-attention: K/V projected once from the encoder;
             # the encoder pad mask rides the cache (pad columns must stay
             # invisible at every decode step, not just inside the encoder)
@@ -181,6 +196,29 @@ class T5Attention(Layer):
                 add = m if add is None else add + m
             out = attend(q, kv_cache["k"], kv_cache["v"], add)
             return self.o(wrap(out.reshape(b, -1, self.n_heads * self.d_kv))), kv_cache
+        if isinstance(kv_cache, dict) and "lengths" in kv_cache:
+            # RAGGED single-token decode (the seq2seq serving engine):
+            # row r writes at ITS length and attends columns 0..lengths[r];
+            # the caller supplies the PER-ROW relative bias [B, h, 1, T]
+            s = hidden.shape[1]
+            if s != 1:
+                raise ValueError("ragged T5 decode is single-token")
+            lengths = kv_cache["lengths"]
+            k_new = self._split(self.k(hidden), b)
+            v_new = self._split(self.v(hidden), b)
+            rows = jnp.arange(b)
+            k_buf = kv_cache["k"].at[rows, lengths].set(
+                unwrap(k_new)[:, 0].astype(kv_cache["k"].dtype))
+            v_buf = kv_cache["v"].at[rows, lengths].set(
+                unwrap(v_new)[:, 0].astype(kv_cache["v"].dtype))
+            t_idx = jnp.arange(k_buf.shape[1])
+            valid = t_idx[None, :] <= lengths[:, None]
+            add = jnp.where(valid[:, None, None, :], 0.0, -jnp.inf)
+            if bias is not None:
+                add = add + bias.astype(jnp.float32)
+            out = attend(q, k_buf, v_buf, add)
+            new = {"k": k_buf, "v": v_buf, "lengths": lengths + 1}
+            return self.o(wrap(out.reshape(b, s, self.n_heads * self.d_kv))), new
         if isinstance(kv_cache, dict):
             # cached causal self-attention at scalar position pos
             s = hidden.shape[1]
@@ -305,12 +343,18 @@ class T5Stack(Layer):
         return self.final_norm(hidden)
 
     def forward_cached(self, ids, self_caches, cross_caches):
-        """Decoder step(s) at the caches' scalar position."""
+        """Decoder step(s) at the caches' scalar position — or at
+        per-row positions when the caches carry "lengths" (the seq2seq
+        serving engine's ragged rows)."""
         s = ids.shape[1]
         hidden = self.embed(ids)
-        pos = self_caches[0]["pos"]
         max_len = self_caches[0]["k"].shape[1]
-        bias = self._bias(s, max_len, q_offset=pos)
+        if "lengths" in self_caches[0]:
+            bias = self.blocks[0].self_attn.compute_bias_rows(
+                self_caches[0]["lengths"], max_len)
+        else:
+            pos = self_caches[0]["pos"]
+            bias = self._bias(s, max_len, q_offset=pos)
         new_self, new_cross = [], []
         for block, sc, cc in zip(self.blocks, self_caches, cross_caches):
             hidden, sc, cc = block(hidden, bias=bias, self_cache=sc,

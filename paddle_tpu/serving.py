@@ -882,7 +882,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
 
 class Seq2SeqBatchEngine(_RequestBookkeeping):
     """Continuous batching for ENCODER-DECODER families (Whisper ASR,
-    BART seq2seq) — the enc-dec twin of ContinuousBatchEngine.
+    BART and T5 seq2seq) — the enc-dec twin of ContinuousBatchEngine.
 
     Fixed-shape design, same philosophy: per-slot pools hold each
     request's encoder cross K/V (computed once at admission, masked to
@@ -892,8 +892,8 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
     jitted dispatch. Admission runs the encoder + seed prefill for one
     request on tiny B=1 caches and SCATTERS the rows into the slot.
 
-    T5 refuses: its relative-position bias indexes by a scalar decode
-    position and has no per-row form yet.
+    All three enc-dec families serve: Whisper/BART (learned positions)
+    and T5 (per-row relative-position bias via T5Stack._bias_rows).
     """
 
     def __init__(self, model, max_batch: int, max_decode_len: int,
@@ -901,11 +901,15 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0):
         name = type(model).__name__
-        if "T5" in name:
-            raise NotImplementedError(
-                "T5's relative-position bias has no per-row (ragged) "
-                "form; serve Whisper/BART, or T5 via solo generate()")
-        if not hasattr(getattr(model, "model", None), "decode_cached"):
+        # model adapter: Whisper/BART expose model.encode/decode_cached;
+        # T5 exposes encoder/decoder T5Stacks with forward_cached
+        if hasattr(getattr(model, "model", None), "decode_cached"):
+            self._encode_fn = model.model.encode
+            self._decode_fn = model.model.decode_cached
+        elif hasattr(getattr(model, "decoder", None), "forward_cached"):
+            self._encode_fn = model.encoder
+            self._decode_fn = model.decoder.forward_cached
+        else:
             raise TypeError(
                 f"{name} is not an encoder-decoder with cached decode")
         self.model = model
@@ -925,9 +929,11 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         self._sample_cfg = (bool(do_sample), float(temperature),
                             int(top_k), float(top_p))
         dt = jnp.dtype(cfg.dtype) if isinstance(cfg.dtype, str) else cfg.dtype
-        h = cfg.decoder_attention_heads
-        d = cfg.d_model // h
-        L = len(model.model.decoder_layers_list)
+        h = getattr(cfg, "decoder_attention_heads", None) or cfg.num_heads
+        d = getattr(cfg, "d_kv", None) or cfg.d_model // h
+        L = len(getattr(getattr(model, "model", None),
+                        "decoder_layers_list", None)
+                or model.decoder.blocks)
         B = max_batch
         self._self_k = [jnp.zeros((B, max_decode_len, h, d), dt)
                         for _ in range(L)]
@@ -955,7 +961,7 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
     def add_request(self, encoder_input, max_new_tokens: int = 64,
                     seed_ids=None) -> int:
         """Queue one request. ``encoder_input``: mel features
-        [num_mel_bins, frames] for Whisper, token ids for BART.
+        [num_mel_bins, frames] for Whisper, token ids for BART/T5.
         ``seed_ids``: decoder prompt (Whisper task tokens); defaults to
         decoder_start_token_id."""
         enc = np.asarray(encoder_input)
@@ -1025,11 +1031,11 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
             cfg = model.config
             with _tape.no_grad():
                 enc_in = req.encoder_input
-                if enc_in.ndim == 1:                     # BART token ids
-                    enc = model.model.encode(
+                if enc_in.ndim == 1:                # BART/T5 token ids
+                    enc = self._encode_fn(
                         wrap(jnp.asarray(enc_in[None], jnp.int32)))
-                else:                                    # Whisper mel
-                    enc = model.model.encode(
+                else:                               # Whisper mel
+                    enc = self._encode_fn(
                         wrap(jnp.asarray(enc_in[None], jnp.float32)))
                 t_enc = enc.shape[1]
                 if t_enc > self.max_encoder_len:
@@ -1046,7 +1052,7 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                 n_seed = int(seed.size)
                 # B=1 seed prefill on the model's own scalar-pos caches
                 self_c, cross_c = model._init_caches(enc, 1, n_seed)
-                hidden, self_c, _ = model.model.decode_cached(
+                hidden, self_c, _ = self._decode_fn(
                     wrap(jnp.asarray(seed[None], jnp.int32)), self_c,
                     cross_c)
                 last = unwrap(model.lm_head_logits(
@@ -1095,7 +1101,7 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                               for k, v in zip(sk, sv)]
                     cross_c = [{"k": k, "v": v, "mask": enc_mask}
                                for k, v in zip(ck, cv)]
-                    hidden, new_self, _ = model.model.decode_cached(
+                    hidden, new_self, _ = self._decode_fn(
                         wrap(token), self_c, cross_c)
                     last_n = unwrap(model.lm_head_logits(
                         wrap(unwrap(hidden)[:, -1:])))[:, 0, :]

@@ -82,14 +82,27 @@ def test_bart_engine_matches_solo():
     assert done[r1].tolist() == solos[1]
 
 
-def test_t5_refuses():
+def test_t5_engine_matches_solo():
+    """T5 serves through the engine too: the per-row relative-position
+    bias (T5Stack._bias_rows) makes ragged rows exact — staggered
+    admission token-identical to solo generate."""
     from paddle_tpu.models.t5 import T5Config, T5ForConditionalGeneration
 
     paddle.seed(2)
     m = T5ForConditionalGeneration(T5Config.tiny())
-    with pytest.raises(NotImplementedError, match="relative-position"):
-        Seq2SeqBatchEngine(m, max_batch=2, max_decode_len=8,
-                           max_encoder_len=8)
+    rng = np.random.RandomState(4)
+    enc_ids = [rng.randint(2, 256, (n,)) for n in (10, 7)]
+    solos = [m.generate(paddle.to_tensor(ids[None]), max_new_tokens=7,
+                        eos_token_id=-1).numpy()[0].tolist()
+             for ids in enc_ids]
+    eng = Seq2SeqBatchEngine(m, max_batch=2, max_decode_len=16,
+                             max_encoder_len=16, eos_token_id=-1)
+    r0 = eng.add_request(enc_ids[0], max_new_tokens=7)
+    eng.step()
+    r1 = eng.add_request(enc_ids[1], max_new_tokens=7)
+    done = eng.run_until_done()
+    assert done[r0].tolist() == solos[0]
+    assert done[r1].tolist() == solos[1]
 
 
 def test_budget_and_encoder_overflow(whisper_model):
