@@ -171,3 +171,32 @@ def test_int8_serving_composes_with_sliding_window():
     solo = q8.generate(paddle.to_tensor(ids[None]),
                        max_new_tokens=5).numpy()[0]
     assert done[rid].tolist() == solo.tolist()
+
+
+@pytest.mark.parametrize("family", ["gemma2", "olmo2", "glm4"])
+def test_int4_serving_across_new_families(family):
+    """quantize_for_serving targets named projections, so every
+    llama-trunk family quantizes; the engine stays token-identical to
+    the quantized model's own solo generate."""
+    if family == "gemma2":
+        from paddle_tpu.models.gemma2 import Gemma2Config as C
+        from paddle_tpu.models.gemma2 import Gemma2ForCausalLM as M
+    elif family == "olmo2":
+        from paddle_tpu.models.olmo2 import Olmo2Config as C
+        from paddle_tpu.models.olmo2 import Olmo2ForCausalLM as M
+    else:
+        from paddle_tpu.models.glm import Glm4Config as C
+        from paddle_tpu.models.glm import Glm4ForCausalLM as M
+
+    paddle.seed(10)
+    m = M(C.tiny(num_hidden_layers=2))
+    m, n = quantize_for_serving(m, algo="weight_only_int4")
+    assert n >= 2 * 7  # per-layer projections swapped (head may be tied)
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(1, 512, (7,))
+    solo = m.generate(paddle.to_tensor(prompt[None]),
+                      max_new_tokens=6).numpy()[0]
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=32, page_size=8)
+    rid = eng.add_request(prompt.tolist(), max_new_tokens=6)
+    done = eng.run_until_done()
+    np.testing.assert_array_equal(done[rid], solo)
