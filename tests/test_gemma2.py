@@ -168,3 +168,38 @@ def test_final_softcap_changes_logits():
     uncapped = m(ids).numpy()
     assert np.abs(capped).max() <= 30.0 + 1e-5
     assert not np.allclose(capped, uncapped)
+
+
+def test_lora_on_gemma2():
+    """peft targets named trunk Linears, so the sandwich trunk fine-tunes
+    with adapters only; merge restores a plain model with moved logits."""
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.peft import LoRAConfig, get_peft_model, merge_lora
+
+    paddle.seed(6)
+    m = Gemma2ForCausalLM(Gemma2Config.tiny(num_hidden_layers=1))
+    ids = paddle.to_tensor(np.random.RandomState(7).randint(1, 512, (2, 10)))
+    base_logits = m(ids).numpy()
+    m, n_adapters = get_peft_model(m, LoRAConfig(r=4, lora_alpha=8))
+    assert n_adapters > 0
+    trainable = [p for p in m.parameters() if not p.stop_gradient]
+    assert trainable and all("lora" in n for n, p in m.named_parameters()
+                             if not p.stop_gradient)
+    np.testing.assert_allclose(m(ids).numpy(), base_logits,
+                               atol=1e-5, rtol=1e-5)  # identity at init
+
+    def loss_fn(mm, x, y):
+        loss, _ = mm(x, labels=y)
+        return loss
+
+    step = paddle.jit.train_step(m, loss_fn,
+                                 opt.AdamW(5e-2, parameters=trainable))
+    y = paddle.to_tensor(np.random.RandomState(8).randint(1, 512, (2, 10)))
+    for _ in range(3):
+        step(ids, y)
+    tuned = m(ids).numpy()
+    assert not np.allclose(tuned, base_logits)
+    merged, n_merged = merge_lora(m)
+    assert n_merged == n_adapters
+    np.testing.assert_allclose(merged(ids).numpy(), tuned,
+                               atol=1e-4, rtol=1e-4)

@@ -143,3 +143,47 @@ def test_qwen3_roundtrip_through_transformers():
         theirs = hf.generate(torch.from_numpy(ids), max_new_tokens=5,
                              do_sample=False).numpy()[:, 8:]
     np.testing.assert_array_equal(ours, theirs)
+
+
+def test_qwen2_mistral_roundtrip_through_transformers():
+    """Bias (Qwen2) and windowed (Mistral) variants export and reload
+    through real transformers models with greedy parity."""
+    from transformers import MistralConfig as HFMistralC
+    from transformers import MistralForCausalLM as HFMistral
+    from transformers import Qwen2Config as HFQwen2C
+    from transformers import Qwen2ForCausalLM as HFQwen2
+    from paddle_tpu.models.mistral import MistralConfig, MistralForCausalLM
+    from paddle_tpu.models.qwen2 import Qwen2Config, Qwen2ForCausalLM
+
+    paddle.seed(6)
+    q = Qwen2ForCausalLM(Qwen2Config.tiny(num_hidden_layers=2))
+    sd = llama_to_hf(q)
+    assert any(k.endswith("q_proj.bias") for k in sd)
+    hfq = _load_into_hf(HFQwen2(HFQwen2C(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=1e6,
+        tie_word_embeddings=False, attn_implementation="eager")), sd)
+    ids = np.random.RandomState(7).randint(0, 512, (1, 8))
+    ours = q.generate(paddle.to_tensor(ids), max_new_tokens=5).numpy()
+    with torch.no_grad():
+        theirs = hfq.generate(torch.from_numpy(ids), max_new_tokens=5,
+                              do_sample=False).numpy()[:, 8:]
+    np.testing.assert_array_equal(ours, theirs)
+
+    paddle.seed(7)
+    m = MistralForCausalLM(MistralConfig.tiny(num_hidden_layers=2,
+                                              sliding_window=6))
+    sd = llama_to_hf(m)
+    hfm = _load_into_hf(HFMistral(HFMistralC(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        rope_theta=10000.0, sliding_window=6, tie_word_embeddings=False,
+        attn_implementation="eager")), sd)
+    ids = np.random.RandomState(8).randint(0, 512, (1, 12))
+    ours = m.generate(paddle.to_tensor(ids), max_new_tokens=5).numpy()
+    with torch.no_grad():
+        theirs = hfm.generate(torch.from_numpy(ids), max_new_tokens=5,
+                              do_sample=False).numpy()[:, 12:]
+    np.testing.assert_array_equal(ours, theirs)
