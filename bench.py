@@ -380,7 +380,7 @@ def cp_bench(devs, gen):
 
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from paddle_tpu.distributed.collective import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from paddle_tpu.distributed.context_parallel import ring_attention
@@ -633,6 +633,15 @@ def main():
     flops_per_token = _model_flops_per_token(cfg) + _attn_flops_per_token(cfg, seq)
     mfu = tokens_per_sec * flops_per_token / peak
 
+    # publish the measured step through the unified observability layer:
+    # the same train_step_seconds / tokens-per-sec / device-memory series
+    # a production train loop emits (hapi StepTimer), so bench records and
+    # live telemetry read off one catalog
+    from paddle_tpu.observability import StepTimer, catalog as _cat
+
+    StepTimer().observe(dt, n_samples=batch, n_tokens=tokens_per_step)
+    mem_in_use = int(_cat.DEVICE_MEM_IN_USE.value())
+
     rec = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -642,6 +651,7 @@ def main():
         "mfu": round(mfu, 4),
         "step_ms": round(dt * 1000, 1),
         "compile_s": round(compile_s, 1),
+        "device_mem_bytes": mem_in_use,
         "config": cfg_name,
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),

@@ -167,6 +167,7 @@ def is_grad_enabled():
 # cycles: nn imports paddle_tpu at module load)
 _LAZY_SUBMODULES = (
     "nn",
+    "observability",
     "optimizer",
     "amp",
     "io",

@@ -86,7 +86,7 @@ def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
     tgt_has_partial = any(isinstance(p, Partial) for p in placements)
     if partial_axes and not tgt_has_partial:
         # materialise pending reduction: psum over the partial axes
-        from jax import shard_map
+        from .collective import shard_map
 
         jmesh = mesh.jax_mesh()
         src_spec = placements_to_partition_spec(

@@ -107,7 +107,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
             f"divisible by nranks^2 ({n * n}) — global chunk per rank, "
             "then one sub-chunk per destination")
     from jax.sharding import NamedSharding, PartitionSpec
-    from jax import shard_map
+    from .collective import shard_map
 
     spec = PartitionSpec(axes[0], *([None] * (arr.ndim - 1)))
     fn = jax.jit(shard_map(

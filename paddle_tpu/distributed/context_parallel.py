@@ -431,7 +431,7 @@ def sep_attention(query, key, value, causal: bool = False,
     returns the global-shape result.
     """
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .collective import shard_map
 
     from ..tensor_class import Tensor, unwrap, wrap
     from .topology import get_hybrid_communicate_group

@@ -109,6 +109,14 @@ def _mem_stats(device=None):
     return stats or {}
 
 
+def memory_stats(device=None) -> dict:
+    """The runtime's raw per-device allocator stats, as a plain dict
+    (keys are runtime-dependent: bytes_in_use / peak_bytes_in_use /
+    bytes_limit on TPU; {} on backends that don't track). The
+    observability StepTimer publishes its memory gauges from this."""
+    return dict(_mem_stats(device))
+
+
 def memory_allocated(device=None) -> int:
     """Live bytes in use on the device (stats.cc Allocated stat)."""
     return int(_mem_stats(device).get("bytes_in_use", 0))

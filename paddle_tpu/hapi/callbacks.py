@@ -333,6 +333,52 @@ class VisualDL(Callback):
         self._write("eval", self._eval_count, logs)
 
 
+class StepTimer(Callback):
+    """Train-loop telemetry into the process-wide metrics registry
+    (paddle_tpu.observability): per-step wall time histogram, samples/s
+    and tokens/s gauges, and device-memory gauges — the same registry
+    the serving ``/metrics`` endpoint renders, so train and serve
+    telemetry read out of one place. When ``FLAGS_log_memory_stats`` is
+    set, each step also logs live/peak device bytes through the
+    rank-aware logger (the observability StepTimer's flag wiring).
+
+    ``tokens_per_sample`` (e.g. the sequence length) turns the
+    batch-size samples/s reading into tokens/s; ``snapshot_dir`` appends
+    a rank-aware JSONL registry snapshot every ``snapshot_freq`` steps.
+    """
+
+    def __init__(self, tokens_per_sample=None, snapshot_dir=None,
+                 snapshot_freq=100, logger=None):
+        super().__init__()
+        from ..observability import StepTimer as _CoreTimer
+
+        self.tokens_per_sample = tokens_per_sample
+        self.snapshot_freq = max(1, int(snapshot_freq))
+        self._timer = _CoreTimer(logger=logger)
+        self._writer = None
+        if snapshot_dir is not None:
+            from ..observability import SnapshotWriter
+
+            self._writer = SnapshotWriter(snapshot_dir, prefix="train")
+        self._seen = 0
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._timer.begin()
+
+    def on_train_batch_end(self, step, logs=None):
+        n = int((logs or {}).get("batch_size") or 0) or None
+        toks = (n * int(self.tokens_per_sample)
+                if n and self.tokens_per_sample else None)
+        self._timer.end(n_samples=n, n_tokens=toks)
+        self._seen += 1
+        if self._writer is not None and self._seen % self.snapshot_freq == 0:
+            self._writer.write(step=step)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None and self._seen:
+            self._writer.write(step=self._seen)
+
+
 class WandbCallback(Callback):
     """callbacks.py WandbCallback surface: the wandb SDK (a network
     service client) is not in this image — constructing raises with

@@ -418,7 +418,7 @@ class DeepseekV2Attention(Layer):
                 # through: GSPMD gathers the sequence for the dense path.)
                 import functools
 
-                from jax import shard_map
+                from ..distributed.collective import shard_map
                 from jax.sharding import PartitionSpec as P
 
                 from ..distributed.context_parallel import (

@@ -632,7 +632,7 @@ class LlamaAttention(Layer):
                 # so the ring moves num_kv_heads worth of bytes, not num_heads.
                 import functools
 
-                from jax import shard_map
+                from ..distributed.collective import shard_map
                 from jax.sharding import PartitionSpec as P
 
                 from ..distributed.context_parallel import (

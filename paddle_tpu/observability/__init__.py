@@ -1,0 +1,22 @@
+"""Unified observability: metrics registry, Prometheus exposition,
+rank-aware JSONL snapshots, and the train-loop StepTimer.
+
+Importing this package registers the full metric catalog (catalog.py)
+into the process-wide default registry — serving engines, the HTTP
+front-end, hapi callbacks, the profiler, and bench.py all publish into
+the SAME registry, so one ``GET /metrics`` (or one SnapshotWriter line)
+is a whole-process snapshot. scripts/check_metrics_catalog.py lints the
+registered names against the docs/SERVING.md catalog in both directions.
+"""
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
+                      DEFAULT_LATENCY_BUCKETS, PROMETHEUS_CONTENT_TYPE,
+                      get_registry)
+from . import catalog  # noqa: F401  (registers the catalog at import)
+from .snapshot import SnapshotWriter  # noqa: F401
+from .timer import StepTimer  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "DEFAULT_LATENCY_BUCKETS", "PROMETHEUS_CONTENT_TYPE",
+    "get_registry", "catalog", "SnapshotWriter", "StepTimer",
+]

@@ -1,0 +1,115 @@
+"""The metric catalog: every family this codebase publishes, declared in
+ONE place and registered into the default registry at import time.
+
+This is the contract surface: docs/SERVING.md documents exactly these
+names (scripts/check_metrics_catalog.py asserts both directions), the
+engines/HTTP/train hooks bind children off these family objects, and
+``GET /metrics`` renders them. Add a metric HERE (plus its docs row) —
+ad-hoc ``get_registry().counter(...)`` calls elsewhere would dodge the
+lint and drift out of the docs.
+"""
+from __future__ import annotations
+
+from .metrics import get_registry
+
+_R = get_registry()
+
+# ---- serving (ContinuousBatchEngine / Seq2SeqBatchEngine; label
+# engine="decoder" | "seq2seq") ----------------------------------------------
+
+SERVING_QUEUE_WAIT = _R.histogram(
+    "serving_queue_wait_seconds",
+    "Time a request spent queued before slot admission",
+    labels=("engine",))
+
+SERVING_TTFT = _R.histogram(
+    "serving_time_to_first_token_seconds",
+    "Submission to first generated token (queue wait + prefill + first "
+    "decode step)",
+    labels=("engine",))
+
+SERVING_INTER_TOKEN = _R.histogram(
+    "serving_inter_token_latency_seconds",
+    "Gap between consecutive generated tokens of one request",
+    labels=("engine",))
+
+SERVING_PREFILL = _R.histogram(
+    "serving_prefill_seconds",
+    "Admission prefill wall time per request (includes compiles on cold "
+    "prompt-length buckets)",
+    labels=("engine",))
+
+SERVING_DECODE_STEP = _R.histogram(
+    "serving_decode_step_seconds",
+    "One fused decode dispatch for all active slots (device step + host "
+    "sync)",
+    labels=("engine",))
+
+SERVING_REQUESTS = _R.counter(
+    "serving_requests_total",
+    "Lifetime request events (event=admitted|finished|cancelled)",
+    labels=("engine", "event"))
+
+SERVING_TOKENS = _R.counter(
+    "serving_tokens_generated_total",
+    "Lifetime generated tokens",
+    labels=("engine",))
+
+SERVING_PREFIX_LOOKUPS = _R.counter(
+    "serving_prefix_cache_lookups_total",
+    "Prefix-cache admissions by outcome (result=hit|miss; only counted "
+    "when enable_prefix_cache is on)",
+    labels=("engine", "result"))
+
+SERVING_PREFIX_PAGES = _R.counter(
+    "serving_prefix_cache_pages_reused_total",
+    "KV pages copied from an active slot instead of recomputed",
+    labels=("engine",))
+
+SERVING_ACTIVE_SLOTS = _R.gauge(
+    "serving_active_slots",
+    "Slots currently decoding (refreshed on every stats() snapshot)",
+    labels=("engine",))
+
+SERVING_QUEUE_DEPTH = _R.gauge(
+    "serving_queue_depth",
+    "Requests queued for a free slot (refreshed on every stats() "
+    "snapshot)",
+    labels=("engine",))
+
+# ---- HTTP front-end ---------------------------------------------------------
+
+HTTP_REQUESTS = _R.counter(
+    "serving_http_requests_total",
+    "HTTP responses by route and status code (unknown routes bucket "
+    "under path=other)",
+    labels=("path", "code"))
+
+# ---- training / step telemetry (StepTimer) ---------------------------------
+
+TRAIN_STEP_SECONDS = _R.histogram(
+    "train_step_seconds",
+    "Train-loop step wall time (StepTimer)",
+    labels=())
+
+TRAIN_TOKENS_PER_SEC = _R.gauge(
+    "train_tokens_per_second",
+    "Most recent step's token throughput (StepTimer)",
+    labels=())
+
+TRAIN_SAMPLES_PER_SEC = _R.gauge(
+    "train_samples_per_second",
+    "Most recent step's sample throughput (StepTimer / profiler ips)",
+    labels=())
+
+DEVICE_MEM_IN_USE = _R.gauge(
+    "device_memory_bytes_in_use",
+    "Live device bytes (framework.device.memory_stats bytes_in_use; 0 "
+    "when the backend doesn't track)",
+    labels=())
+
+DEVICE_MEM_PEAK = _R.gauge(
+    "device_memory_peak_bytes",
+    "Peak device bytes (framework.device.memory_stats "
+    "peak_bytes_in_use)",
+    labels=())

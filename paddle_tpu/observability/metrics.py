@@ -1,0 +1,396 @@
+"""Process-wide metrics registry: labeled Counter / Gauge / Histogram.
+
+The serving HTTP front-end records from handler threads while the engine
+thread records from step()/_admit(), so every mutation and every render
+takes the registry's ONE lock — per-metric locks would still need a
+registry-wide hold for a consistent exposition snapshot, so one lock is
+both simpler and sufficient (the critical sections are a dict update or
+a bisect, microseconds against a multi-ms decode step).
+
+Rendering follows the Prometheus text exposition format 0.0.4
+(histograms as cumulative ``_bucket{le=...}`` series plus ``_sum`` and
+``_count``; counters end in ``_total`` by convention). Latency
+histograms default to fixed log-spaced buckets spanning 100 µs .. 60 s —
+wide enough for both a single fused decode dispatch and a cold-bucket
+prefill compile.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "get_registry", "DEFAULT_LATENCY_BUCKETS", "PROMETHEUS_CONTENT_TYPE",
+]
+
+# log-spaced 1-2.5-5 decades, 100 µs .. 60 s (le upper bounds)
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 60.0)
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_INF = float("inf")
+
+
+def _fmt(v: float) -> str:
+    """Exposition number formatting: integral values print as integers
+    (Prometheus parses either; integers keep counter lines exact)."""
+    f = float(v)
+    if f == _INF:
+        return "+Inf"
+    if f != f:  # NaN
+        return "NaN"
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _escape_help(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
+
+
+class _MetricFamily:
+    """One named metric with a fixed label-name schema; children are the
+    per-label-value time series. All state mutations go through the
+    REGISTRY lock (shared, so one exposition render is one snapshot)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_str: str,
+                 label_names: Sequence[str], lock: threading.RLock):
+        self.name = name
+        self.help = help_str
+        self.label_names = tuple(label_names)
+        self._lock = lock
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def _label_key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if tuple(sorted(labels)) != tuple(sorted(self.label_names)):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.label_names}, "
+                f"got {tuple(sorted(labels))}")
+        return tuple(str(labels[k]) for k in self.label_names)
+
+    def _child(self, labels: Dict[str, str]):
+        key = self._label_key(labels)
+        child = self._children.get(key)
+        if child is None:
+            child = self._new_child()
+            self._children[key] = child
+        return child
+
+    def labels(self, **labels) -> "_BoundMetric":
+        """Pre-resolve one label combination (the engines bind their
+        children once at construction — no per-token dict hashing)."""
+        with self._lock:
+            return _BoundMetric(self, self._child(labels))
+
+    def _render_series(self, key: Tuple[str, ...], child) -> list:
+        raise NotImplementedError
+
+    def _label_str(self, key: Tuple[str, ...], extra: str = "") -> str:
+        parts = [f'{n}="{_escape_label(v)}"'
+                 for n, v in zip(self.label_names, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def render(self) -> list:
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for key in sorted(self._children):
+            lines.extend(self._render_series(key, self._children[key]))
+        return lines
+
+    def reset(self):
+        for child in self._children.values():
+            child.reset()
+
+
+class _BoundMetric:
+    """A (family, child) pair: the per-label-values handle hot paths hold."""
+
+    __slots__ = ("_family", "_child")
+
+    def __init__(self, family, child):
+        self._family = family
+        self._child = child
+
+    def inc(self, amount: float = 1.0):
+        with self._family._lock:
+            self._child.inc(amount)
+
+    def set(self, value: float):
+        with self._family._lock:
+            self._child.set(value)
+
+    def observe(self, value: float):
+        with self._family._lock:
+            self._child.observe(value)
+
+    @property
+    def value(self) -> float:
+        with self._family._lock:
+            return self._child.value
+
+    @property
+    def count(self) -> int:
+        with self._family._lock:
+            return self._child.count
+
+    @property
+    def sum(self) -> float:
+        with self._family._lock:
+            return self._child.sum
+
+
+class _CounterChild:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError("counters only go up")
+        self.value += amount
+
+    def reset(self):
+        self.value = 0.0
+
+
+class Counter(_MetricFamily):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0, **labels):
+        with self._lock:
+            self._child(labels).inc(amount)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._child(labels).value
+
+    def _render_series(self, key, child):
+        return [f"{self.name}{self._label_str(key)} {_fmt(child.value)}"]
+
+
+class _GaugeChild:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, value):
+        self.value = float(value)
+
+    def inc(self, amount=1.0):
+        self.value += amount
+
+    def reset(self):
+        self.value = 0.0
+
+
+class Gauge(_MetricFamily):
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, value: float, **labels):
+        with self._lock:
+            self._child(labels).set(value)
+
+    def inc(self, amount: float = 1.0, **labels):
+        with self._lock:
+            self._child(labels).inc(amount)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._child(labels).value
+
+    def _render_series(self, key, child):
+        return [f"{self.name}{self._label_str(key)} {_fmt(child.value)}"]
+
+
+class _HistogramChild:
+    __slots__ = ("bucket_counts", "sum", "count", "_edges")
+
+    def __init__(self, edges):
+        self._edges = edges
+        self.reset()
+
+    def observe(self, value):
+        v = float(value)
+        # le semantics: bisect_left finds the first edge >= v
+        self.bucket_counts[bisect.bisect_left(self._edges, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def reset(self):
+        self.bucket_counts = [0] * (len(self._edges) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_MetricFamily):
+    kind = "histogram"
+
+    def __init__(self, name, help_str, label_names, lock,
+                 buckets: Optional[Sequence[float]] = None):
+        super().__init__(name, help_str, label_names, lock)
+        edges = tuple(sorted(float(b) for b in
+                             (buckets or DEFAULT_LATENCY_BUCKETS)))
+        if not edges or any(e != e or e == _INF for e in edges):
+            raise ValueError("histogram buckets must be finite and non-empty")
+        self.buckets = edges
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, value: float, **labels):
+        with self._lock:
+            self._child(labels).observe(value)
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            return self._child(labels).count
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            return self._child(labels).sum
+
+    def bucket_counts(self, **labels) -> list:
+        """Per-bucket (non-cumulative) counts; trailing slot is +Inf."""
+        with self._lock:
+            return list(self._child(labels).bucket_counts)
+
+    def _render_series(self, key, child):
+        lines, cum = [], 0
+        for edge, n in zip(self.buckets, child.bucket_counts):
+            cum += n
+            le = 'le="%s"' % _fmt(edge)
+            lines.append(f"{self.name}_bucket{self._label_str(key, le)} "
+                         f"{cum}")
+        inf = 'le="+Inf"'
+        lines.append(f"{self.name}_bucket{self._label_str(key, inf)} "
+                     f"{child.count}")
+        lines.append(f"{self.name}_sum{self._label_str(key)} "
+                     f"{_fmt(child.sum)}")
+        lines.append(f"{self.name}_count{self._label_str(key)} "
+                     f"{child.count}")
+        return lines
+
+
+class MetricsRegistry:
+    """Name -> metric family, one lock for everything (see module doc).
+
+    Registration is idempotent: re-declaring a name returns the existing
+    family when kind/labels/buckets agree and raises when they don't (two
+    modules silently disagreeing on a schema is exactly the drift this
+    subsystem exists to prevent)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: Dict[str, _MetricFamily] = {}
+
+    def _register(self, cls, name, help_str, labels, **kw):
+        with self._lock:
+            existing = self._families.get(name)
+            if existing is not None:
+                same = (type(existing) is cls
+                        and existing.label_names == tuple(labels))
+                if same and cls is Histogram:
+                    want = tuple(sorted(
+                        float(b) for b in (kw.get("buckets")
+                                           or DEFAULT_LATENCY_BUCKETS)))
+                    same = existing.buckets == want
+                if not same:
+                    raise ValueError(
+                        f"metric {name!r} already registered with a "
+                        "different schema")
+                return existing
+            fam = cls(name, help_str, tuple(labels), self._lock, **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help_str: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter, name, help_str, labels)
+
+    def gauge(self, name: str, help_str: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge, name, help_str, labels)
+
+    def histogram(self, name: str, help_str: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._register(Histogram, name, help_str, labels,
+                              buckets=buckets)
+
+    def get(self, name: str) -> Optional[_MetricFamily]:
+        with self._lock:
+            return self._families.get(name)
+
+    def names(self) -> list:
+        with self._lock:
+            return sorted(self._families)
+
+    def describe(self) -> Dict[str, dict]:
+        """{name: {kind, help, labels}} — the catalog the docs lint
+        (scripts/check_metrics_catalog.py) checks against."""
+        with self._lock:
+            return {n: {"kind": f.kind, "help": f.help,
+                        "labels": list(f.label_names)}
+                    for n, f in self._families.items()}
+
+    def render_prometheus(self) -> str:
+        """One consistent snapshot in text exposition format 0.0.4."""
+        with self._lock:
+            lines = []
+            for name in sorted(self._families):
+                lines.extend(self._families[name].render())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Nested-dict snapshot (the JSONL writer's payload): per family,
+        {labels-tuple-as-str: value | {sum, count, buckets}}."""
+        with self._lock:
+            out = {}
+            for name, fam in self._families.items():
+                series = {}
+                for key, child in fam._children.items():
+                    skey = ",".join(f"{n}={v}" for n, v
+                                    in zip(fam.label_names, key))
+                    if fam.kind == "histogram":
+                        series[skey] = {"sum": child.sum,
+                                        "count": child.count,
+                                        "buckets": list(child.bucket_counts)}
+                    else:
+                        series[skey] = child.value
+                out[name] = {"kind": fam.kind, "series": series}
+            return out
+
+    def reset(self):
+        """Zero every series, keep registrations (test isolation)."""
+        with self._lock:
+            for fam in self._families.values():
+                fam.reset()
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry (what /metrics renders)."""
+    return _DEFAULT

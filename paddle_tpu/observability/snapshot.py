@@ -1,0 +1,52 @@
+"""Rank-aware JSONL metric snapshots.
+
+Multihost runs write one file PER RANK (the distributed/log_utils
+convention: rank from PADDLE_TRAINER_ID, falling back to RANK) so
+concurrent processes never interleave lines in one file; a single-process
+run writes an unsuffixed file. Each line is one self-contained snapshot:
+``{"ts": ..., "rank": ..., "step": ..., "metrics": {...}}``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = ["SnapshotWriter"]
+
+
+def _rank() -> Optional[int]:
+    r = os.environ.get("PADDLE_TRAINER_ID") or os.environ.get("RANK")
+    return int(r) if r is not None else None
+
+
+class SnapshotWriter:
+    """Append registry snapshots to ``<dir>/<prefix>[.rankN].jsonl``.
+
+    >>> w = SnapshotWriter("logs/metrics")
+    >>> w.write(step=10)            # one JSON line, flushed
+    """
+
+    def __init__(self, directory: str, prefix: str = "metrics",
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or get_registry()
+        self.rank = _rank()
+        suffix = f".rank{self.rank}" if self.rank is not None else ""
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, f"{prefix}{suffix}.jsonl")
+
+    def write(self, step: Optional[int] = None, extra: Optional[dict] = None):
+        """Append one snapshot line (opened per write: crash-safe, and
+        rank isolation means no other process holds this path)."""
+        rec = {"ts": time.time(), "rank": self.rank,
+               "metrics": self.registry.snapshot()}
+        if step is not None:
+            rec["step"] = int(step)
+        if extra:
+            rec.update(extra)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        return self.path

@@ -58,6 +58,13 @@ class benchmark:
                 self.ips.record(num_samples, 1)
                 self.last["ips"] = num_samples / dt if dt else 0.0
             self.last["batch_cost"] = dt
+            # mirror into the unified registry so Profiler-timed loops
+            # show up on /metrics and JSONL snapshots too
+            from ..observability import catalog as _cat
+
+            _cat.TRAIN_STEP_SECONDS.observe(dt)
+            if "ips" in self.last:
+                _cat.TRAIN_SAMPLES_PER_SEC.set(self.last["ips"])
         self._batch_start = now
 
     def end(self):

@@ -18,12 +18,14 @@ rows, immediately refill their slots. Ragged-ness is first-class because
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .observability import catalog as _metrics
 from .tensor_class import Tensor, unwrap
 from .framework import random as _random
 from .generation import (_get_prefill_step, _get_select_decode,
@@ -43,7 +45,8 @@ class _Request:
     __slots__ = ("rid", "ids", "max_new_tokens", "tokens", "slot", "sampling",
                  "on_token", "on_token_arity", "pixel_values",
                  "stop_token_ids", "logprobs", "want_logprobs",
-                 "encoder_input", "seed_ids")
+                 "encoder_input", "seed_ids", "t_enqueue", "t_admit",
+                 "t_last")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
                  on_token=None, pixel_values=None, stop_token_ids=None,
@@ -53,6 +56,11 @@ class _Request:
         self.max_new_tokens = int(max_new_tokens)
         self.tokens: List[int] = []
         self.slot = -1
+        # latency clock: submission -> admission (queue wait), submission
+        # -> first token (TTFT), token -> token (inter-token)
+        self.t_enqueue = time.perf_counter()
+        self.t_admit = None
+        self.t_last = None
         self.sampling = sampling  # (do_sample, temperature, top_k, top_p) or None
         self.on_token = on_token  # streaming callback (rid, token, done)
         self.pixel_values = pixel_values  # multimodal prompt (LLaVA)
@@ -95,10 +103,93 @@ _REASON_KEEP = 4096  # finish-reason retention window (see step())
 
 
 class _RequestBookkeeping:
-    """Queued/active cancel scanning + bounded finish-reason retention —
-    the request-accounting block BOTH engines share (decoder-only and
-    seq2seq). Subclasses provide _queue/_slots/_lengths/_admit and the
-    reason/logprob dicts."""
+    """Queued/active cancel scanning, bounded finish-reason retention,
+    and the unified counters/metrics/stats() layer — the request-
+    accounting block BOTH engines share (decoder-only and seq2seq).
+    Subclasses provide _slots/_lengths/_admit and max_batch, and call
+    _init_bookkeeping() from __init__."""
+
+    # decoder-only feature, but a shared stats() key: the two hand-copied
+    # stats() dicts had already drifted (the seq2seq copy lacked it)
+    prefix_pages_reused = 0
+
+    def _init_bookkeeping(self, engine: str):
+        """One init for queue/finish state, lifetime counters, and the
+        registry children (bound once here — no per-token label lookups
+        on the decode hot path)."""
+        self._engine_label = engine
+        self._next_rid = 0
+        self._queue: List[_Request] = []
+        self._finished: Dict[int, np.ndarray] = {}
+        # finish reasons are kept for the last _REASON_KEEP requests only
+        # (the front-end reads right after the done event; an unbounded
+        # dict would grow with lifetime request count)
+        self._finished_reason: Dict[int, str] = {}
+        self._finished_logprobs: Dict[int, list] = {}
+        self._reason_order: List[int] = []
+        self._n_requests = 0
+        self._n_finished = 0
+        self._n_cancelled = 0
+        self._n_tokens = 0
+        self._n_steps = 0
+        self._m_queue_wait = _metrics.SERVING_QUEUE_WAIT.labels(engine=engine)
+        self._m_ttft = _metrics.SERVING_TTFT.labels(engine=engine)
+        self._m_inter = _metrics.SERVING_INTER_TOKEN.labels(engine=engine)
+        self._m_prefill = _metrics.SERVING_PREFILL.labels(engine=engine)
+        self._m_step = _metrics.SERVING_DECODE_STEP.labels(engine=engine)
+        self._m_tokens = _metrics.SERVING_TOKENS.labels(engine=engine)
+        self._m_req_admitted = _metrics.SERVING_REQUESTS.labels(
+            engine=engine, event="admitted")
+        self._m_req_finished = _metrics.SERVING_REQUESTS.labels(
+            engine=engine, event="finished")
+        self._m_req_cancelled = _metrics.SERVING_REQUESTS.labels(
+            engine=engine, event="cancelled")
+        self._m_active = _metrics.SERVING_ACTIVE_SLOTS.labels(engine=engine)
+        self._m_depth = _metrics.SERVING_QUEUE_DEPTH.labels(engine=engine)
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    def stats(self) -> dict:
+        """Engine observability: lifetime counters + current occupancy
+        (the serving front-end's /health payload) — ONE implementation
+        for both engines, backed by the same counters the registry
+        exposes, so the payloads can't drift. Reading it also refreshes
+        the occupancy gauges: /health and /metrics see one snapshot."""
+        active = self.num_active
+        queued = len(self._queue)
+        self._m_active.set(active)
+        self._m_depth.set(queued)
+        return {
+            "requests_admitted": self._n_requests,
+            "requests_finished": self._n_finished,
+            "requests_cancelled": self._n_cancelled,
+            "requests_active": active,
+            "requests_queued": queued,
+            "decode_steps": self._n_steps,
+            "tokens_generated": self._n_tokens,
+            "slot_utilization": (active / self.max_batch
+                                 if self.max_batch else 0.0),
+            "prefix_pages_reused": self.prefix_pages_reused,
+        }
+
+    def _observe_admission(self, req: _Request, now: float):
+        """Queue-wait accounting at the moment a request takes a slot."""
+        self._m_queue_wait.observe(now - req.t_enqueue)
+        req.t_admit = now
+
+    def _observe_token(self, req: _Request, now: float):
+        """Per-token latency accounting (call after tokens.append): the
+        first token since submission is TTFT, later ones record the
+        inter-token gap."""
+        if len(req.tokens) == 1:
+            self._m_ttft.observe(now - req.t_enqueue)
+        elif req.t_last is not None:
+            self._m_inter.observe(now - req.t_last)
+        req.t_last = now
+        self._n_tokens += 1
+        self._m_tokens.inc()
 
     def finish_reason(self, rid: int):
         """Why a finished request retired: "stop" | "length" |
@@ -130,6 +221,9 @@ class _RequestBookkeeping:
         """Record why a request ended and trim the retention window —
         the ONE bookkeeping path for finishes AND cancels (a cancel-heavy
         workload must not grow the window unboundedly)."""
+        if reason == "cancelled":
+            self._n_cancelled += 1
+            self._m_req_cancelled.inc()
         self._finished_reason[rid] = reason
         if logprobs is not None:
             self._finished_logprobs[rid] = logprobs
@@ -196,16 +290,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._last = jnp.zeros((max_batch, cfg.vocab_size), jnp.float32)
 
         self._poisoned = False
-        self._next_rid = 0
-        self._queue: List[_Request] = []
         self._slots: List[Optional[_Request]] = [None] * max_batch
-        self._finished: Dict[int, np.ndarray] = {}
-        # finish reasons are kept for the last _REASON_KEEP requests only
-        # (the front-end reads right after the done event; an unbounded
-        # dict would grow with lifetime request count)
-        self._finished_reason: Dict[int, str] = {}
-        self._finished_logprobs: Dict[int, list] = {}
-        self._reason_order: List[int] = []
+        self._init_bookkeeping("decoder")
 
         # ---- automatic prefix caching (vLLM-style, opt-in) --------------
         # At admission, the longest page-aligned token prefix shared with a
@@ -215,11 +301,12 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         # trivial: freed pages can be overwritten with no refcounts.
         self.enable_prefix_cache = bool(enable_prefix_cache)
         self.prefix_pages_reused = 0  # observability: total pages copied
-        # ---- observability counters (stats()) ---------------------------
-        self._n_requests = 0
-        self._n_finished = 0
-        self._n_tokens = 0
-        self._n_steps = 0
+        self._m_prefix_hit = _metrics.SERVING_PREFIX_LOOKUPS.labels(
+            engine="decoder", result="hit")
+        self._m_prefix_miss = _metrics.SERVING_PREFIX_LOOKUPS.labels(
+            engine="decoder", result="miss")
+        self._m_prefix_pages = _metrics.SERVING_PREFIX_PAGES.labels(
+            engine="decoder")
 
     # ---- public API ---------------------------------------------------------
     def add_request(self, ids, max_new_tokens: int = 64, do_sample=None,
@@ -299,6 +386,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         rid = self._next_rid
         self._next_rid += 1
         self._n_requests += 1
+        self._m_req_admitted.inc()
         self._queue.append(_Request(rid, ids, max_new_tokens, sampling,
                                     on_token, pixel_values=pixel_values,
                                     stop_token_ids=stop_token_ids,
@@ -306,31 +394,11 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._admit()
         return rid
 
-    @property
-    def num_active(self) -> int:
-        return sum(r is not None for r in self._slots)
-
     def logprobs(self, rid: int):
         """Chosen-token logprobs (model's raw distribution) for a
         FINISHED request, aligned with its generated ids; None once
         evicted from the retention window or while in flight."""
         return self._finished_logprobs.get(rid)
-
-    def stats(self) -> dict:
-        """Engine observability: lifetime counters + current occupancy
-        (the serving front-end's /health payload)."""
-        active = self.num_active
-        return {
-            "requests_admitted": self._n_requests,
-            "requests_finished": self._n_finished,
-            "requests_active": active,
-            "requests_queued": len(self._queue),
-            "decode_steps": self._n_steps,
-            "tokens_generated": self._n_tokens,
-            "slot_utilization": (active / self.max_batch
-                                 if self.max_batch else 0.0),
-            "prefix_pages_reused": self.prefix_pages_reused,
-        }
 
     def step(self) -> Dict[int, np.ndarray]:
         """Decode ONE token for every active slot (sample + forward fused
@@ -343,6 +411,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._admit()
         if self.num_active == 0:
             return self._drain_finished()
+        t_dispatch = time.perf_counter()
         do_sample, temperature, top_k, top_p = self._sample_cfg
         for c in self._caches:
             c["lengths"] = self._lengths  # engine-owned (masks stale +1s)
@@ -368,6 +437,11 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 self._last, _random.next_key(), self._caches)
         toks = np.asarray(nxt)
         lps = np.asarray(logps)
+        # np.asarray forced the device->host sync, so the span covers the
+        # whole fused dispatch; ONE clock for every token this step
+        # produced (they came from one dispatch)
+        now = time.perf_counter()
+        self._m_step.observe(now - t_dispatch)
         self._n_steps += 1
         retiring = []
         events = []  # (cb, rid, token, done): fired AFTER bookkeeping, so a
@@ -381,7 +455,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             lp = float(lps[s])
             if req.want_logprobs:
                 req.logprobs.append(lp)
-            self._n_tokens += 1
+            self._observe_token(req, now)
             stopped = ((self.eos_token_id is not None
                         and t == self.eos_token_id)
                        or (req.stop_token_ids is not None
@@ -407,6 +481,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             req = self._slots[s]
             self._finished[req.rid] = np.asarray(req.tokens, np.int64)
             self._n_finished += 1
+            self._m_req_finished.inc()
             self._slots[s] = None
             self._lengths = self._lengths.at[s].set(0)
         # stream AFTER state is consistent: every callback fires even if an
@@ -466,7 +541,10 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             if slot < 0:
                 return
             req = self._queue.pop(0)
+            t_adm = time.perf_counter()
+            self._observe_admission(req, t_adm)
             self._prefill_into(slot, req)
+            self._m_prefill.observe(time.perf_counter() - t_adm)
             self._slots[slot] = req
             req.slot = slot
 
@@ -546,6 +624,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             n = common // ps
             if n > best_n:
                 best_slot, best_n = s, n
+        (self._m_prefix_hit if best_n > 0 else self._m_prefix_miss).inc()
         return best_slot, best_n
 
     def _suffix_prefill_fn(self, n_pref: int, sb: int):
@@ -744,6 +823,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._last = self._last.at[slot].set(last[0].astype(jnp.float32))
         self._lengths = self._lengths.at[slot].set(S0)
         self.prefix_pages_reused += n_pref
+        self._m_prefix_pages.inc(n_pref)
 
     def _prefill_with_prefix_latent(self, slot: int, req: _Request,
                                     src: int, n_pref: int):
@@ -946,16 +1026,8 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         self._enc_mask = jnp.zeros((B, max_encoder_len), bool)
         self._lengths = jnp.zeros((B,), jnp.int32)
         self._last = jnp.zeros((B, cfg.vocab_size), jnp.float32)
-        self._queue: List[_Request] = []
         self._slots: List[Optional[_Request]] = [None] * B
-        self._finished: Dict[int, np.ndarray] = {}
-        self._finished_reason: Dict[int, str] = {}
-        self._reason_order: List[int] = []
-        self._next_rid = 0
-        self._n_requests = 0
-        self._n_finished = 0
-        self._n_tokens = 0
-        self._n_steps = 0
+        self._init_bookkeeping("seq2seq")
 
     # ---- public API ----------------------------------------------------
     def add_request(self, encoder_input, max_new_tokens: int = 64,
@@ -982,6 +1054,7 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         rid = self._next_rid
         self._next_rid += 1
         self._n_requests += 1
+        self._m_req_admitted.inc()
         req = _Request(rid, [0], max_new_tokens)
         req.encoder_input = enc
         req.seed_ids = (None if seed_ids is None
@@ -989,24 +1062,6 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         self._queue.append(req)
         self._admit()
         return rid
-
-    @property
-    def num_active(self) -> int:
-        return sum(r is not None for r in self._slots)
-
-    def stats(self) -> dict:
-        """Engine observability (mirrors ContinuousBatchEngine.stats)."""
-        active = self.num_active
-        return {
-            "requests_admitted": self._n_requests,
-            "requests_finished": self._n_finished,
-            "requests_active": active,
-            "requests_queued": len(self._queue),
-            "decode_steps": self._n_steps,
-            "tokens_generated": self._n_tokens,
-            "slot_utilization": (active / self.max_batch
-                                 if self.max_batch else 0.0),
-        }
 
     def run_until_done(self):
         out: Dict[int, np.ndarray] = {}
@@ -1027,6 +1082,8 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         while self._queue and None in self._slots:
             slot = self._slots.index(None)
             req = self._queue.pop(0)
+            t_adm = time.perf_counter()
+            self._observe_admission(req, t_adm)
             model = self.model
             cfg = model.config
             with _tape.no_grad():
@@ -1044,6 +1101,7 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                     # fail THIS request, never the in-flight batch
                     self._finished[req.rid] = np.asarray([], np.int64)
                     self._n_finished += 1
+                    self._m_req_finished.inc()
                     self._record_reason(req.rid, "error")
                     continue
                 seed = (req.seed_ids if req.seed_ids is not None
@@ -1078,6 +1136,8 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                     last[0].astype(jnp.float32))
             self._slots[slot] = req
             req.slot = slot
+            # encoder + seed prefill IS this engine's admission prefill
+            self._m_prefill.observe(time.perf_counter() - t_adm)
 
     # ---- decode --------------------------------------------------------
     def _step_fn(self):
@@ -1125,11 +1185,14 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         self._admit()
         if self.num_active == 0:
             return self._drain()
+        t_dispatch = time.perf_counter()
         step = self._step_fn()
         nxt, self._last, self._self_k, self._self_v = step(
             self._last, _random.next_key(), self._self_k, self._self_v,
             self._cross_k, self._cross_v, self._enc_mask, self._lengths)
         toks = np.asarray(nxt)
+        now = time.perf_counter()
+        self._m_step.observe(now - t_dispatch)
         self._n_steps += 1
         active = np.array([r is not None for r in self._slots])
         self._lengths = jnp.where(jnp.asarray(active), self._lengths + 1,
@@ -1139,12 +1202,13 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                 continue
             t = int(toks[s])
             req.tokens.append(t)
-            self._n_tokens += 1
+            self._observe_token(req, now)
             stopped = (self.eos_token_id is not None
                        and t == self.eos_token_id)
             if len(req.tokens) >= req.max_new_tokens or stopped:
                 self._finished[req.rid] = np.asarray(req.tokens, np.int64)
                 self._n_finished += 1
+                self._m_req_finished.inc()
                 self._record_reason(req.rid,
                                     "stop" if stopped else "length")
                 self._slots[s] = None

@@ -14,7 +14,11 @@ for paddle_tpu, stdlib-only (no web framework in the image):
   ``data: [DONE]`` terminator), and ``pixel_values`` ([n_images, C, H, W]
   nested lists) for multimodal models — image and text requests batch
   in-flight;
-- ``GET /v1/models`` and ``GET /health``.
+- ``GET /v1/models`` and ``GET /health``;
+- ``GET /metrics`` — Prometheus text exposition of the process-wide
+  registry (``paddle_tpu.observability``): latency histograms
+  (queue-wait, TTFT, inter-token, prefill, decode-step), request/token
+  counters, occupancy gauges. Scrape it next to /health.
 
 Single-engine-thread design: device state (page pool, slot buffers) is
 touched ONLY by the engine thread; HTTP handler threads enqueue
@@ -34,7 +38,14 @@ from typing import Optional
 
 import numpy as np
 
+from .observability import PROMETHEUS_CONTENT_TYPE, get_registry
+from .observability.catalog import HTTP_REQUESTS
+
 __all__ = ["CompletionServer", "serve"]
+
+# known routes for the http counter — anything else buckets under
+# "other" so a scanner can't explode the label cardinality
+_KNOWN_ROUTES = ("/health", "/metrics", "/v1/models", "/v1/completions")
 
 
 class _Submission:
@@ -150,7 +161,13 @@ class CompletionServer:
             def log_message(self, *a):  # silence request logging
                 pass
 
+            def _count(self, code):
+                route = (self.path if self.path in _KNOWN_ROUTES
+                         else "other")
+                HTTP_REQUESTS.inc(path=route, code=str(code))
+
             def _json(self, code, obj):
+                self._count(code)
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -159,6 +176,20 @@ class CompletionServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path == "/metrics":
+                    # refresh the occupancy gauges off the engine's ONE
+                    # stats() snapshot, then render the whole registry;
+                    # counted BEFORE the render so a scrape sees itself
+                    server_self.engine.stats()
+                    self._count(200)
+                    body = get_registry().render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     PROMETHEUS_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path == "/health":
                     eng = server_self.engine
                     stats = eng.stats()
@@ -308,6 +339,7 @@ class CompletionServer:
                 })
 
             def _stream(self, sub, cid, n_prompt, want_logprobs=False):
+                self._count(200)
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")

@@ -168,6 +168,332 @@ def test_unique_name_and_run_check(capsys):
     assert "works" in capsys.readouterr().out
 
 
+# ---- unified metrics subsystem (paddle_tpu.observability) --------------------
+
+def _parse_prom(text):
+    """Tiny exposition parser: {(name, (sorted label items))} -> float."""
+    import re
+
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, val = line.rsplit(" ", 1)
+        if "{" in head:
+            name, rest = head.split("{", 1)
+            labels = tuple(sorted(
+                (k, v) for k, v in re.findall(r'(\w+)="([^"]*)"', rest)))
+        else:
+            name, labels = head, ()
+        out[(name, labels)] = float(val)
+    return out
+
+
+def test_registry_labels_and_idempotent_register():
+    from paddle_tpu.observability import MetricsRegistry
+
+    r = MetricsRegistry()
+    c = r.counter("reqs_total", "requests", labels=("engine", "event"))
+    c.inc(engine="a", event="ok")
+    c.inc(2, engine="a", event="ok")
+    c.inc(engine="b", event="err")
+    assert c.value(engine="a", event="ok") == 3
+    assert c.value(engine="b", event="err") == 1
+    # re-registering the same schema returns the SAME family
+    assert r.counter("reqs_total", labels=("engine", "event")) is c
+    # schema drift raises instead of silently forking
+    with pytest.raises(ValueError):
+        r.gauge("reqs_total")
+    with pytest.raises(ValueError):
+        r.counter("reqs_total", labels=("engine",))
+    # wrong label names raise
+    with pytest.raises(ValueError):
+        c.inc(engine="a", evnt="typo")
+    with pytest.raises(ValueError):
+        c.inc(1.0)  # missing labels entirely
+    g = r.gauge("depth")
+    g.set(7)
+    assert g.value() == 7
+    with pytest.raises(ValueError):
+        c.inc(-1, engine="a", event="ok")  # counters only go up
+
+
+def test_histogram_bucket_edges_le_semantics():
+    from paddle_tpu.observability import MetricsRegistry
+
+    r = MetricsRegistry()
+    h = r.histogram("lat", "latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 5.0, 100.0):
+        h.observe(v)
+    # le semantics: a value exactly AT the edge lands in that bucket
+    assert h.bucket_counts() == [2, 2, 1, 1]
+    assert h.count() == 6 and abs(h.sum() - 106.65) < 1e-9
+    parsed = _parse_prom(r.render_prometheus())
+    assert parsed[("lat_bucket", (("le", "0.1"),))] == 2
+    assert parsed[("lat_bucket", (("le", "1"),))] == 4     # cumulative
+    assert parsed[("lat_bucket", (("le", "10"),))] == 5
+    assert parsed[("lat_bucket", (("le", "+Inf"),))] == 6
+    assert parsed[("lat_count", ())] == 6
+
+
+def test_concurrent_increments_from_threads():
+    """HTTP handler threads and the engine thread record concurrently —
+    every mutation holds the registry lock, so totals are exact."""
+    import threading
+
+    from paddle_tpu.observability import MetricsRegistry
+
+    r = MetricsRegistry()
+    c = r.counter("hits_total", labels=("who",))
+    h = r.histogram("obs_seconds", buckets=(0.5,))
+    n_threads, per = 8, 500
+
+    def worker(i):
+        # both call styles under contention: family-level labeled inc and
+        # the pre-bound child the engines use on the hot path
+        child = c.labels(who=str(i % 2))
+        for k in range(per):
+            if k % 2:
+                c.inc(who=str(i % 2))
+            else:
+                child.inc()
+            h.observe(k * 1e-3)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = c.value(who="0") + c.value(who="1")
+    assert total == n_threads * per
+    assert h.count() == n_threads * per
+    # render under load-free conditions parses cleanly
+    assert ("hits_total", (("who", "0"),)) in _parse_prom(
+        r.render_prometheus())
+
+
+def test_exposition_escaping_and_roundtrip():
+    from paddle_tpu.observability import MetricsRegistry
+
+    r = MetricsRegistry()
+    c = r.counter("odd_total", 'help with "quotes"\nand newline',
+                  labels=("tag",))
+    c.inc(tag='va"l\nue')
+    text = r.render_prometheus()
+    assert '# HELP odd_total help with "quotes"\\nand newline' in text
+    assert r'tag="va\"l\nue"' in text
+    assert text.endswith("\n")
+
+
+def test_stats_payload_unified_across_engines():
+    """Satellite: ONE stats() implementation for both engines — identical
+    key sets (the old hand-copied seq2seq dict had already dropped
+    prefix_pages_reused)."""
+    from paddle_tpu.serving import (ContinuousBatchEngine,
+                                    Seq2SeqBatchEngine)
+
+    a = object.__new__(ContinuousBatchEngine)
+    b = object.__new__(Seq2SeqBatchEngine)
+    for eng, label in ((a, "decoder"), (b, "seq2seq")):
+        eng._slots = [None] * 4
+        eng.max_batch = 4
+        eng._init_bookkeeping(label)
+    sa, sb = a.stats(), b.stats()
+    assert set(sa) == set(sb)
+    assert sb["prefix_pages_reused"] == 0
+    assert ContinuousBatchEngine.stats is Seq2SeqBatchEngine.stats
+
+
+def test_engine_metrics_and_http_exposition():
+    """Acceptance: a short ContinuousBatchEngine serve, then GET /metrics
+    returns valid Prometheus text whose TTFT / inter-token / queue-wait
+    histogram counts match the served requests and tokens — with
+    engine-vs-solo token parity unchanged."""
+    import http.client
+    import json as _json
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.serving import ContinuousBatchEngine
+    from paddle_tpu.serving_http import CompletionServer
+
+    def decoder_series(parsed, name, **extra):
+        labels = tuple(sorted({"engine": "decoder", **extra}.items()))
+        return parsed.get((name, labels), 0.0)
+
+    before = _parse_prom(get_registry().render_prometheus())
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+    eng = ContinuousBatchEngine(model, max_batch=2, max_len=64, page_size=8)
+    with CompletionServer(eng) as srv:
+        host, port = srv.address
+        budgets = (5, 4)
+        solos = []
+        for i, budget in enumerate(budgets):
+            prompt = np.random.RandomState(20 + i).randint(
+                1, 512, (6 + i,)).tolist()
+            solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                                  max_new_tokens=budget).numpy()[0].tolist()
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            conn.request("POST", "/v1/completions",
+                         _json.dumps({"prompt_token_ids": prompt,
+                                      "max_tokens": budget}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            out = _json.loads(resp.read())
+            conn.close()
+            assert resp.status == 200
+            # parity: the engine serves the solo-generate tokens
+            assert out["choices"][0]["token_ids"] == solo
+            solos.append(solo)
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        ctype = resp.getheader("Content-Type")
+        text = resp.read().decode()
+        conn.close()
+    assert resp.status == 200 and "text/plain" in ctype
+    assert "# TYPE serving_time_to_first_token_seconds histogram" in text
+    after = _parse_prom(text)
+
+    n_req = len(budgets)
+    n_tok = sum(budgets)
+
+    def delta(name, **extra):
+        return (decoder_series(after, name, **extra)
+                - decoder_series(before, name, **extra))
+
+    assert delta("serving_time_to_first_token_seconds_count") == n_req
+    assert delta("serving_queue_wait_seconds_count") == n_req
+    assert delta("serving_inter_token_latency_seconds_count") == n_tok - n_req
+    assert delta("serving_tokens_generated_total") == n_tok
+    assert delta("serving_requests_total", event="admitted") == n_req
+    assert delta("serving_requests_total", event="finished") == n_req
+    assert delta("serving_prefill_seconds_count") == n_req
+    assert delta("serving_decode_step_seconds_count") >= max(budgets)
+    assert delta("serving_time_to_first_token_seconds_sum") > 0
+    # histograms are monotone: cumulative bucket counts never decrease
+    # with increasing le
+    import re as _re
+
+    for hist in ("serving_time_to_first_token_seconds",
+                 "serving_inter_token_latency_seconds",
+                 "serving_queue_wait_seconds"):
+        rows = [(float(m.group(1).replace("+Inf", "inf")),
+                 float(line.rsplit(" ", 1)[1]))
+                for line in text.splitlines()
+                for m in [_re.search(
+                    hist + r'_bucket\{engine="decoder",le="([^"]+)"\}',
+                    line)] if m]
+        edges = [e for e, _ in rows]
+        counts = [c for _, c in rows]
+        assert edges == sorted(edges) and counts == sorted(counts)
+    # /metrics sits NEXT TO /health: same engine snapshot both ways
+    assert decoder_series(after, "serving_active_slots") == 0
+    assert after[("serving_http_requests_total",
+                  (("code", "200"), ("path", "/metrics")))] >= 1
+
+
+def test_snapshot_writer_rank_aware(tmp_path, monkeypatch):
+    import json
+
+    from paddle_tpu.observability import SnapshotWriter
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    w = SnapshotWriter(str(tmp_path))
+    path = w.write(step=1)
+    w.write(step=2, extra={"phase": "train"})
+    assert path.endswith("metrics.rank3.jsonl")
+    lines = open(path).read().splitlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[0])
+    assert rec["rank"] == 3 and rec["step"] == 1 and "metrics" in rec
+    assert json.loads(lines[1])["phase"] == "train"
+    # unranked process: no suffix (single-file single-writer)
+    monkeypatch.delenv("PADDLE_TRAINER_ID")
+    monkeypatch.delenv("RANK", raising=False)
+    assert SnapshotWriter(str(tmp_path)).path.endswith("/metrics.jsonl")
+
+
+def test_step_timer_publishes_and_memory_flag(monkeypatch):
+    """Satellite: FLAGS_log_memory_stats (previously defined but dead)
+    now gates per-step memory logging through the rank-aware logger."""
+    import io
+    import logging
+
+    import paddle_tpu as paddle
+    from paddle_tpu.observability import StepTimer, catalog as cat
+
+    lg = logging.getLogger("test_step_timer_obs")
+    lg.handlers = []
+    lg.propagate = False
+    buf = io.StringIO()
+    lg.addHandler(logging.StreamHandler(buf))
+    lg.setLevel(logging.INFO)
+
+    steps_before = cat.TRAIN_STEP_SECONDS.count()
+    timer = StepTimer(logger=lg)
+    with timer.step(n_samples=4, n_tokens=128):
+        pass
+    assert cat.TRAIN_STEP_SECONDS.count() == steps_before + 1
+    assert cat.TRAIN_TOKENS_PER_SEC.value() > 0
+    assert cat.TRAIN_SAMPLES_PER_SEC.value() > 0
+    assert buf.getvalue() == ""          # flag off: silent
+
+    paddle.set_flags({"FLAGS_log_memory_stats": True})
+    try:
+        with timer.step():
+            pass
+        assert "device mem" in buf.getvalue()
+    finally:
+        paddle.set_flags({"FLAGS_log_memory_stats": False})
+    # end() without begin() must not record garbage
+    assert StepTimer().end() is None
+
+
+def test_hapi_step_timer_callback(tmp_path):
+    import json
+
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.hapi.callbacks import StepTimer
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.observability import catalog as cat
+
+    net = nn.Linear(4, 2)
+    m = Model(net)
+    m.prepare(opt.SGD(0.1, parameters=net.parameters()), nn.MSELoss())
+    x = np.random.randn(8, 4).astype("float32")
+    y = np.random.randn(8, 2).astype("float32")
+    before = cat.TRAIN_STEP_SECONDS.count()
+    cb = StepTimer(tokens_per_sample=4, snapshot_dir=str(tmp_path),
+                   snapshot_freq=3)
+    m.fit(list(zip(x, y)), batch_size=4, epochs=1, verbose=0,
+          callbacks=[cb])
+    assert cat.TRAIN_STEP_SECONDS.count() > before
+    files = [f for f in __import__("os").listdir(str(tmp_path))
+             if f.endswith(".jsonl")]
+    assert files, "snapshot not written"
+    line = open(tmp_path / files[0]).readline()
+    assert "train_step_seconds" in json.loads(line)["metrics"]
+
+
+def test_metrics_catalog_lint():
+    """Satellite: the docs/SERVING.md catalog and the registry agree
+    (both directions) — the standalone script doubles as a tier-1 test."""
+    import importlib.util
+    import os
+
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "check_metrics_catalog.py")
+    spec = importlib.util.spec_from_file_location("_metrics_lint", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
+
+
 def test_rank_aware_logger(capsys, monkeypatch):
     """log_utils parity: records carry the [rank N/M] tag and log_on_rank
     filters by rank."""
