@@ -1,6 +1,8 @@
 """Deploy a model behind the OpenAI-style HTTP endpoint and exercise it:
-health check, a batch completion, an SSE streaming completion, and two
-concurrent clients riding one continuous-batching engine in-flight.
+health check, a batch completion, an SSE streaming completion, two
+concurrent clients riding one continuous-batching engine in-flight, and
+the request-scoped trace a completion leaves behind (W3C traceparent in,
+span tree and chrome-trace download out).
 
 Run: JAX_PLATFORMS=cpu python examples/serve_http.py
 """
@@ -74,6 +76,29 @@ def main():
         b = threading.Thread(target=client, args=("b", 4))
         a.start(); b.start(); a.join(); b.join()
         print("concurrent:", results)
+
+        # request-scoped tracing: send a W3C traceparent, read the span
+        # tree back by trace id (GET /trace/chrome downloads the same
+        # trace as chrome://tracing JSON)
+        from paddle_tpu.observability import tracing
+
+        inbound = tracing.format_traceparent("ab" * 16, "cd" * 8)
+        conn = http.client.HTTPConnection(*addr, timeout=300)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt_token_ids":
+                                 rng.randint(1, 512, 6).tolist(),
+                                 "max_tokens": 4}),
+                     {"Content-Type": "application/json",
+                      "traceparent": inbound})
+        resp = conn.getresponse()
+        resp.read()
+        echoed = resp.getheader("traceparent")
+        conn.request("GET", "/trace?trace_id=" + echoed.split("-")[1])
+        spans = json.loads(conn.getresponse().read())["spans"]
+        conn.close()
+        print("trace:", [(s["name"],
+                          round((s["end_ns"] - s["start_ns"]) / 1e6, 3))
+                         for s in spans])
 
 
 if __name__ == "__main__":

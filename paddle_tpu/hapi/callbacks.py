@@ -345,6 +345,12 @@ class StepTimer(Callback):
     ``tokens_per_sample`` (e.g. the sequence length) turns the
     batch-size samples/s reading into tokens/s; ``snapshot_dir`` appends
     a rank-aware JSONL registry snapshot every ``snapshot_freq`` steps.
+
+    When request-scoped tracing is enabled
+    (``paddle_tpu.observability.tracing``), each epoch opens a
+    ``train.epoch`` span that parents the core timer's per-batch
+    ``train.step`` spans — train loops land on the same chrome-trace
+    timeline as serving requests.
     """
 
     def __init__(self, tokens_per_sample=None, snapshot_dir=None,
@@ -361,6 +367,27 @@ class StepTimer(Callback):
 
             self._writer = SnapshotWriter(snapshot_dir, prefix="train")
         self._seen = 0
+        self._epoch_span = None
+
+    def on_epoch_begin(self, epoch, logs=None):
+        from ..observability import tracing
+
+        tracer = tracing.get_tracer()
+        span = tracer.start_span(tracing.SPAN_TRAIN_EPOCH,
+                                 attrs={"epoch": int(epoch)})
+        if span:
+            # made current so the per-batch train.step spans nest under
+            # it (fit runs epochs on one thread)
+            tracer._push(span)
+            self._epoch_span = span
+
+    def on_epoch_end(self, epoch, logs=None):
+        span, self._epoch_span = self._epoch_span, None
+        if span is not None:
+            from ..observability import tracing
+
+            tracing.get_tracer()._pop(span)
+            span.end()
 
     def on_train_batch_begin(self, step, logs=None):
         self._timer.begin()

@@ -1214,12 +1214,18 @@ def _hf_llama_plan(model, extra_layer_norms=(), layer_norms=None):
 
 
 def export_hf_llama(model: "LlamaForCausalLM", extra_layer_norms=(),
-                    layer_norms=None):
+                    layer_norms=None, dtype=None):
     """The reverse of load_hf_llama: this model's weights as an
     HF-key-layout numpy state dict (torch [out, in] projection layout),
     ready for ``HFModel.load_state_dict`` via torch.from_numpy — train
     here, deploy anywhere. Tied models omit lm_head.weight (HF re-ties
-    from the embedding). Round-trip parity is tested per family."""
+    from the embedding). Round-trip parity is tested per family.
+
+    Dtype: each tensor keeps the PARAMETER's dtype (a bf16 model exports
+    a bf16 checkpoint at half the bytes of the old unconditional float32
+    upcast — note bf16 arrays carry the ``ml_dtypes`` numpy dtype, which
+    recent torch/safetensors understand; pass ``dtype="float32"`` for
+    consumers that don't). ``dtype`` forces a uniform cast when set."""
     plan = _hf_llama_plan(model, extra_layer_norms=extra_layer_norms,
                           layer_norms=layer_norms)
     params = dict(model.named_parameters())
@@ -1227,7 +1233,9 @@ def export_hf_llama(model: "LlamaForCausalLM", extra_layer_norms=(),
     for name, (hf_key, transpose) in plan.items():
         if name not in params:
             raise KeyError(f"export_hf_llama: model has no param {name!r}")
-        v = np.asarray(unwrap(params[name])).astype(np.float32)
+        v = np.asarray(unwrap(params[name]))
+        if dtype is not None:
+            v = v.astype(dtype)
         out[hf_key] = v.T if transpose else v
     return out
 
@@ -1310,14 +1318,15 @@ def llama_from_hf(hf_model_or_state, hf_config=None, **config_overrides):
                     hf_config, **config_overrides)
 
 
-def llama_to_hf(model):
+def llama_to_hf(model, dtype=None):
     """Export to the HF Llama checkpoint layout (see export_hf_llama) —
     covers every family whose checkpoint IS the plain Llama key layout
     (Llama/Qwen2/Qwen3/Mistral/Gemma; Gemma2 adds its sandwich norms).
     Families whose conversion TRANSFORMS the checkpoint (Phi-3 fuses
     projections, GLM de-interleaves rotary rows) REFUSE — exporting their
     runtime weights under HF keys without reversing the transform would
-    emit a silently wrong checkpoint."""
+    emit a silently wrong checkpoint. Parameter dtypes are preserved
+    (``dtype`` forces a uniform cast — see export_hf_llama)."""
     from .gemma2 import Gemma2ForCausalLM
     from .glm import GlmForCausalLM
     from .olmo2 import _OLMO2_NORMS, Olmo2ForCausalLM
@@ -1335,4 +1344,4 @@ def llama_to_hf(model):
     if isinstance(model, Olmo2ForCausalLM):
         norms = _OLMO2_NORMS
     return export_hf_llama(model, extra_layer_norms=extra,
-                           layer_norms=norms)
+                           layer_norms=norms, dtype=dtype)

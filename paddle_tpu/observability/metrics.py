@@ -18,12 +18,27 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
 from typing import Dict, Optional, Sequence, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "get_registry", "DEFAULT_LATENCY_BUCKETS", "PROMETHEUS_CONTENT_TYPE",
+    "set_exemplar_provider",
 ]
+
+# Optional cross-link to the tracing subsystem: when a provider is set
+# (tracing.Tracer.enable does), every histogram observation asks it for
+# the active trace_id and stores the latest one on the series as an
+# exemplar — so a latency outlier on /metrics points at the exact trace
+# that produced it. None (the default) costs one predicate per observe.
+_exemplar_provider = None
+
+
+def set_exemplar_provider(fn) -> None:
+    """``fn(metric_name, value) -> Optional[trace_id]``; None unhooks."""
+    global _exemplar_provider
+    _exemplar_provider = fn
 
 # log-spaced 1-2.5-5 decades, 100 µs .. 60 s (le upper bounds)
 DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
@@ -133,8 +148,10 @@ class _BoundMetric:
             self._child.set(value)
 
     def observe(self, value: float):
+        ex = (_exemplar_provider(self._family.name, value)
+              if _exemplar_provider is not None else None)
         with self._family._lock:
-            self._child.observe(value)
+            self._child.observe(value, ex)
 
     @property
     def value(self) -> float:
@@ -224,23 +241,28 @@ class Gauge(_MetricFamily):
 
 
 class _HistogramChild:
-    __slots__ = ("bucket_counts", "sum", "count", "_edges")
+    __slots__ = ("bucket_counts", "sum", "count", "exemplar", "_edges")
 
     def __init__(self, edges):
         self._edges = edges
         self.reset()
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
         v = float(value)
         # le semantics: bisect_left finds the first edge >= v
         self.bucket_counts[bisect.bisect_left(self._edges, v)] += 1
         self.sum += v
         self.count += 1
+        if exemplar is not None:
+            # latest-wins: one (value, trace_id, ts) per series bounds
+            # memory regardless of observation rate
+            self.exemplar = (v, str(exemplar), time.time())
 
     def reset(self):
         self.bucket_counts = [0] * (len(self._edges) + 1)
         self.sum = 0.0
         self.count = 0
+        self.exemplar = None
 
 
 class Histogram(_MetricFamily):
@@ -259,8 +281,10 @@ class Histogram(_MetricFamily):
         return _HistogramChild(self.buckets)
 
     def observe(self, value: float, **labels):
+        ex = (_exemplar_provider(self.name, value)
+              if _exemplar_provider is not None else None)
         with self._lock:
-            self._child(labels).observe(value)
+            self._child(labels).observe(value, ex)
 
     def count(self, **labels) -> int:
         with self._lock:
@@ -289,6 +313,14 @@ class Histogram(_MetricFamily):
                      f"{_fmt(child.sum)}")
         lines.append(f"{self.name}_count{self._label_str(key)} "
                      f"{child.count}")
+        if child.exemplar is not None:
+            # exemplar cross-link rendered as a comment: text exposition
+            # 0.0.4 has no exemplar syntax (that's OpenMetrics), and a
+            # comment keeps every 0.0.4 parser happy while a human (or
+            # the JSONL snapshot) can still follow the trace_id
+            v, tid, ts = child.exemplar
+            lines.append(f"# exemplar {self.name}{self._label_str(key)} "
+                         f'trace_id="{tid}" value={_fmt(v)} ts={ts:.3f}')
         return lines
 
 
@@ -376,6 +408,10 @@ class MetricsRegistry:
                         series[skey] = {"sum": child.sum,
                                         "count": child.count,
                                         "buckets": list(child.bucket_counts)}
+                        if child.exemplar is not None:
+                            v, tid, ts = child.exemplar
+                            series[skey]["exemplar"] = {
+                                "value": v, "trace_id": tid, "ts": ts}
                     else:
                         series[skey] = child.value
                 out[name] = {"kind": fam.kind, "series": series}

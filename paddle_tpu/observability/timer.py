@@ -17,6 +17,7 @@ import time
 from typing import Optional
 
 from . import catalog as _cat
+from . import tracing as _tracing
 
 __all__ = ["StepTimer"]
 
@@ -32,12 +33,16 @@ class StepTimer:
     def __init__(self, logger=None):
         self._t0: Optional[float] = None
         self._logger = logger  # injectable for tests; rank-aware default
+        self._span = None      # the open train.step span (tracing on)
         self.last_step_seconds: Optional[float] = None
         self.n_steps = 0
 
     # ---- recording styles ----------------------------------------------
     def begin(self):
         self._t0 = time.perf_counter()
+        tracer = _tracing.get_tracer()
+        if tracer.enabled:
+            self._span = tracer.start_span(_tracing.SPAN_TRAIN_STEP)
 
     def end(self, n_samples: Optional[int] = None,
             n_tokens: Optional[int] = None) -> Optional[float]:
@@ -47,7 +52,14 @@ class StepTimer:
             return None
         dt = time.perf_counter() - self._t0
         self._t0 = None
-        self.observe(dt, n_samples=n_samples, n_tokens=n_tokens)
+        span, self._span = self._span, None
+        # observe with the step's span current so the train_step_seconds
+        # histogram picks the trace_id up as an exemplar
+        with _tracing.get_tracer().use(span):
+            self.observe(dt, n_samples=n_samples, n_tokens=n_tokens)
+        if span is not None:
+            span.set_attr("step", self.n_steps)
+            span.end()
         return dt
 
     @contextlib.contextmanager

@@ -61,10 +61,21 @@ class benchmark:
             # mirror into the unified registry so Profiler-timed loops
             # show up on /metrics and JSONL snapshots too
             from ..observability import catalog as _cat
+            from ..observability import tracing as _tracing
 
             _cat.TRAIN_STEP_SECONDS.observe(dt)
             if "ips" in self.last:
                 _cat.TRAIN_SAMPLES_PER_SEC.set(self.last["ips"])
+            tracer = _tracing.get_tracer()
+            if tracer.enabled:
+                # the batch window as a train.step span (perf_counter
+                # and perf_counter_ns share one clock) — Profiler-timed
+                # loops land on the same timeline as serving spans
+                tracer.add_span(
+                    _tracing.SPAN_TRAIN_STEP,
+                    int(self._batch_start * 1e9), int(now * 1e9),
+                    attrs={"batch_cost": dt,
+                           "samples": int(num_samples or 0)})
         self._batch_start = now
 
     def end(self):

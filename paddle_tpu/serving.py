@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .observability import catalog as _metrics
+from .observability import tracing as _tracing
 from .tensor_class import Tensor, unwrap
 from .framework import random as _random
 from .generation import (_get_prefill_step, _get_select_decode,
@@ -46,7 +47,7 @@ class _Request:
                  "on_token", "on_token_arity", "pixel_values",
                  "stop_token_ids", "logprobs", "want_logprobs",
                  "encoder_input", "seed_ids", "t_enqueue", "t_admit",
-                 "t_last")
+                 "t_last", "span", "queue_span")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
                  on_token=None, pixel_values=None, stop_token_ids=None,
@@ -61,6 +62,10 @@ class _Request:
         self.t_enqueue = time.perf_counter()
         self.t_admit = None
         self.t_last = None
+        # request-scoped tracing: root span + its queue-wait child, both
+        # None while tracing is disabled (the engine's guarded fast path)
+        self.span = None
+        self.queue_span = None
         self.sampling = sampling  # (do_sample, temperature, top_k, top_p) or None
         self.on_token = on_token  # streaming callback (rid, token, done)
         self.pixel_values = pixel_values  # multimodal prompt (LLaVA)
@@ -112,6 +117,11 @@ class _RequestBookkeeping:
     # decoder-only feature, but a shared stats() key: the two hand-copied
     # stats() dicts had already drifted (the seq2seq copy lacked it)
     prefix_pages_reused = 0
+
+    # decode-step spans are SAMPLED: the request's first token always
+    # (every trace shows at least one decode child) and every Nth after —
+    # a full-length request traces O(tokens / N) spans, not O(tokens)
+    trace_decode_every = 16
 
     def _init_bookkeeping(self, engine: str):
         """One init for queue/finish state, lifetime counters, and the
@@ -175,21 +185,83 @@ class _RequestBookkeeping:
         }
 
     def _observe_admission(self, req: _Request, now: float):
-        """Queue-wait accounting at the moment a request takes a slot."""
-        self._m_queue_wait.observe(now - req.t_enqueue)
+        """Queue-wait accounting at the moment a request takes a slot.
+        Observed with the request's root span current, so the histogram
+        series picks up the trace_id as an exemplar."""
+        with _tracing.get_tracer().use(req.span):
+            self._m_queue_wait.observe(now - req.t_enqueue)
         req.t_admit = now
 
     def _observe_token(self, req: _Request, now: float):
         """Per-token latency accounting (call after tokens.append): the
         first token since submission is TTFT, later ones record the
-        inter-token gap."""
-        if len(req.tokens) == 1:
-            self._m_ttft.observe(now - req.t_enqueue)
-        elif req.t_last is not None:
-            self._m_inter.observe(now - req.t_last)
+        inter-token gap. Runs under the request's root span (when
+        tracing) so TTFT / inter-token exemplars cross-link."""
+        with _tracing.get_tracer().use(req.span):
+            if len(req.tokens) == 1:
+                self._m_ttft.observe(now - req.t_enqueue)
+            elif req.t_last is not None:
+                self._m_inter.observe(now - req.t_last)
         req.t_last = now
         self._n_tokens += 1
         self._m_tokens.inc()
+
+    # ---- request-scoped tracing (shared by both engines) ---------------
+    def _trace_submit(self, req: _Request, trace_ctx=None):
+        """Open the per-request root span (+ queue-wait child) at
+        submission. ``trace_ctx`` is an inbound ``(trace_id,
+        parent_span_id)`` pair (the HTTP layer's W3C traceparent) so
+        external callers correlate. No-op while tracing is disabled —
+        req.span stays None and every later hook short-circuits."""
+        tracer = _tracing.get_tracer()
+        if not tracer.enabled:
+            return
+        trace_id, parent_id = trace_ctx if trace_ctx else (None, None)
+        req.span = tracer.start_span(
+            _tracing.SPAN_REQUEST, trace_id=trace_id, parent_id=parent_id,
+            attrs={"rid": req.rid, "engine": self._engine_label,
+                   "prompt_tokens": int(req.ids.size),
+                   "max_new_tokens": req.max_new_tokens})
+        req.queue_span = tracer.start_span(_tracing.SPAN_QUEUE_WAIT,
+                                           parent=req.span)
+
+    def _trace_admit(self, req: _Request, slot: int):
+        """Close the queue-wait child the moment the request takes a
+        slot; the slot lands on the root span for the timeline view."""
+        if req.queue_span is not None:
+            req.queue_span.end()
+            req.queue_span = None
+        if req.span is not None:
+            req.span.set_attr("slot", slot)
+
+    def _trace_decode_step(self, req: _Request, start_ns: int, end_ns: int):
+        """Attach the (already timed) fused decode dispatch to this
+        request as a sampled child span — see trace_decode_every."""
+        n = len(req.tokens)
+        if req.span is not None and (n == 1
+                                     or n % self.trace_decode_every == 0):
+            _tracing.get_tracer().add_span(
+                _tracing.SPAN_DECODE_STEP, start_ns, end_ns,
+                parent=req.span, attrs={"token_index": n})
+
+    def _trace_end(self, req: _Request, status: str):
+        """Retire the request's spans: a still-open queue-wait child
+        (cancel before admission), an instant slot-free marker when it
+        held a slot, then the root with its final status."""
+        if req.queue_span is not None:
+            req.queue_span.end(status)
+            req.queue_span = None
+        span = req.span
+        if span is None:
+            return
+        req.span = None
+        if req.slot >= 0:
+            now = time.perf_counter_ns()
+            _tracing.get_tracer().add_span(
+                _tracing.SPAN_SLOT_FREE, now, now, parent=span,
+                attrs={"slot": req.slot})
+        span.set_attr("generated_tokens", len(req.tokens))
+        span.end(status)
 
     def finish_reason(self, rid: int):
         """Why a finished request retired: "stop" | "length" |
@@ -207,12 +279,14 @@ class _RequestBookkeeping:
             if req.rid == rid:
                 del self._queue[i]
                 self._record_reason(rid, "cancelled")
+                self._trace_end(req, "cancelled")
                 return True
         for s, req in enumerate(self._slots):
             if req is not None and req.rid == rid:
                 self._slots[s] = None
                 self._lengths = self._lengths.at[s].set(0)
                 self._record_reason(rid, "cancelled")
+                self._trace_end(req, "cancelled")
                 self._admit()     # the freed slot can refill immediately
                 return True
         return False
@@ -312,7 +386,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
     def add_request(self, ids, max_new_tokens: int = 64, do_sample=None,
                     temperature=None, top_k=None, top_p=None,
                     on_token=None, pixel_values=None,
-                    stop_token_ids=None, logprobs=False) -> int:
+                    stop_token_ids=None, logprobs=False,
+                    trace_ctx=None) -> int:
         """Queue one request. Sampling knobs default to the engine-level
         configuration; any per-request override routes decoding through the
         per-row sampling program (one compiled step serves the whole mix).
@@ -387,10 +462,15 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._next_rid += 1
         self._n_requests += 1
         self._m_req_admitted.inc()
-        self._queue.append(_Request(rid, ids, max_new_tokens, sampling,
-                                    on_token, pixel_values=pixel_values,
-                                    stop_token_ids=stop_token_ids,
-                                    want_logprobs=logprobs))
+        req = _Request(rid, ids, max_new_tokens, sampling,
+                       on_token, pixel_values=pixel_values,
+                       stop_token_ids=stop_token_ids,
+                       want_logprobs=logprobs)
+        # trace_ctx: inbound (trace_id, parent_span_id) — the HTTP
+        # layer's parsed W3C traceparent — parents this request's root
+        # span so the caller's trace continues through the engine
+        self._trace_submit(req, trace_ctx)
+        self._queue.append(req)
         self._admit()
         return rid
 
@@ -443,6 +523,11 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         now = time.perf_counter()
         self._m_step.observe(now - t_dispatch)
         self._n_steps += 1
+        # perf_counter and perf_counter_ns share one clock, so the span
+        # bounds come from the timestamps already taken for the metric
+        trace_on = _tracing.get_tracer().enabled
+        t0_ns, t1_ns = (int(t_dispatch * 1e9), int(now * 1e9)) \
+            if trace_on else (0, 0)
         retiring = []
         events = []  # (cb, rid, token, done): fired AFTER bookkeeping, so a
         # raising callback cannot leave _lengths/slot state desynced from
@@ -456,6 +541,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             if req.want_logprobs:
                 req.logprobs.append(lp)
             self._observe_token(req, now)
+            if trace_on:
+                self._trace_decode_step(req, t0_ns, t1_ns)
             stopped = ((self.eos_token_id is not None
                         and t == self.eos_token_id)
                        or (req.stop_token_ids is not None
@@ -484,6 +571,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             self._m_req_finished.inc()
             self._slots[s] = None
             self._lengths = self._lengths.at[s].set(0)
+            self._trace_end(req, "ok")
         # stream AFTER state is consistent: every callback fires even if an
         # earlier one raises; the first exception then propagates
         first_exc = None
@@ -543,8 +631,14 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             req = self._queue.pop(0)
             t_adm = time.perf_counter()
             self._observe_admission(req, t_adm)
-            self._prefill_into(slot, req)
-            self._m_prefill.observe(time.perf_counter() - t_adm)
+            self._trace_admit(req, slot)
+            tracer = _tracing.get_tracer()
+            with tracer.span(_tracing.SPAN_PREFILL, parent=req.span,
+                             attrs={"slot": slot,
+                                    "prompt_tokens": int(req.ids.size)}):
+                self._prefill_into(slot, req)
+            with tracer.use(req.span):
+                self._m_prefill.observe(time.perf_counter() - t_adm)
             self._slots[slot] = req
             req.slot = slot
 
@@ -607,25 +701,29 @@ class ContinuousBatchEngine(_RequestBookkeeping):
     def _find_shared_prefix(self, req: _Request):
         """Longest page-aligned token prefix shared with an ACTIVE slot's
         prompt. Capped one token short of the whole prompt (the suffix
-        prefill needs at least one token to produce the slot's logits)."""
-        ps = self.page_size
-        if req.pixel_values is not None:
-            return -1, 0
-        cap = (int(req.ids.size) - 1) // ps
-        best_slot, best_n = -1, 0
-        for s, r in enumerate(self._slots):
-            if r is None or cap <= 0 or r.pixel_values is not None:
-                continue
-            c = min(cap * ps, (int(r.ids.size) // ps) * ps)
-            if c <= 0:
-                continue
-            neq = req.ids[:c] != r.ids[:c]
-            common = c if not neq.any() else int(np.argmax(neq))
-            n = common // ps
-            if n > best_n:
-                best_slot, best_n = s, n
-        (self._m_prefix_hit if best_n > 0 else self._m_prefix_miss).inc()
-        return best_slot, best_n
+        prefill needs at least one token to produce the slot's logits).
+        Traced as a child of the admission prefill span (which is
+        current on the engine thread when tracing is on)."""
+        with _tracing.get_tracer().span(_tracing.SPAN_PREFIX_LOOKUP) as sp:
+            ps = self.page_size
+            if req.pixel_values is not None:
+                return -1, 0
+            cap = (int(req.ids.size) - 1) // ps
+            best_slot, best_n = -1, 0
+            for s, r in enumerate(self._slots):
+                if r is None or cap <= 0 or r.pixel_values is not None:
+                    continue
+                c = min(cap * ps, (int(r.ids.size) // ps) * ps)
+                if c <= 0:
+                    continue
+                neq = req.ids[:c] != r.ids[:c]
+                common = c if not neq.any() else int(np.argmax(neq))
+                n = common // ps
+                if n > best_n:
+                    best_slot, best_n = s, n
+            (self._m_prefix_hit if best_n > 0 else self._m_prefix_miss).inc()
+            sp.set_attr("pages", best_n)
+            return best_slot, best_n
 
     def _suffix_prefill_fn(self, n_pref: int, sb: int):
         """One jitted, page-DONATING admission with a cached prefix:
@@ -1031,11 +1129,12 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
 
     # ---- public API ----------------------------------------------------
     def add_request(self, encoder_input, max_new_tokens: int = 64,
-                    seed_ids=None) -> int:
+                    seed_ids=None, trace_ctx=None) -> int:
         """Queue one request. ``encoder_input``: mel features
         [num_mel_bins, frames] for Whisper, token ids for BART/T5.
         ``seed_ids``: decoder prompt (Whisper task tokens); defaults to
-        decoder_start_token_id."""
+        decoder_start_token_id. ``trace_ctx``: inbound (trace_id,
+        parent_span_id) for the request's root span."""
         enc = np.asarray(encoder_input)
         n_seed = 1 if seed_ids is None else int(np.asarray(seed_ids).size)
         if n_seed + max_new_tokens > self.max_decode_len:
@@ -1059,6 +1158,9 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         req.encoder_input = enc
         req.seed_ids = (None if seed_ids is None
                         else np.asarray(seed_ids, np.int32).reshape(-1))
+        self._trace_submit(req, trace_ctx)
+        if req.span is not None:
+            req.span.set_attr("encoder_positions", int(t_enc))
         self._queue.append(req)
         self._admit()
         return rid
@@ -1084,9 +1186,14 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
             req = self._queue.pop(0)
             t_adm = time.perf_counter()
             self._observe_admission(req, t_adm)
+            self._trace_admit(req, slot)
             model = self.model
             cfg = model.config
-            with _tape.no_grad():
+            # the encoder + seed prefill IS this engine's admission
+            # prefill — one span covers it
+            with _tracing.get_tracer().span(
+                    _tracing.SPAN_PREFILL, parent=req.span,
+                    attrs={"slot": slot}), _tape.no_grad():
                 enc_in = req.encoder_input
                 if enc_in.ndim == 1:                # BART/T5 token ids
                     enc = self._encode_fn(
@@ -1103,6 +1210,7 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                     self._n_finished += 1
                     self._m_req_finished.inc()
                     self._record_reason(req.rid, "error")
+                    self._trace_end(req, "error")
                     continue
                 seed = (req.seed_ids if req.seed_ids is not None
                         else np.asarray([cfg.decoder_start_token_id],
@@ -1137,7 +1245,8 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
             self._slots[slot] = req
             req.slot = slot
             # encoder + seed prefill IS this engine's admission prefill
-            self._m_prefill.observe(time.perf_counter() - t_adm)
+            with _tracing.get_tracer().use(req.span):
+                self._m_prefill.observe(time.perf_counter() - t_adm)
 
     # ---- decode --------------------------------------------------------
     def _step_fn(self):
@@ -1194,6 +1303,9 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         now = time.perf_counter()
         self._m_step.observe(now - t_dispatch)
         self._n_steps += 1
+        trace_on = _tracing.get_tracer().enabled
+        t0_ns, t1_ns = (int(t_dispatch * 1e9), int(now * 1e9)) \
+            if trace_on else (0, 0)
         active = np.array([r is not None for r in self._slots])
         self._lengths = jnp.where(jnp.asarray(active), self._lengths + 1,
                                   self._lengths)
@@ -1203,6 +1315,8 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
             t = int(toks[s])
             req.tokens.append(t)
             self._observe_token(req, now)
+            if trace_on:
+                self._trace_decode_step(req, t0_ns, t1_ns)
             stopped = (self.eos_token_id is not None
                        and t == self.eos_token_id)
             if len(req.tokens) >= req.max_new_tokens or stopped:
@@ -1213,5 +1327,6 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                                     "stop" if stopped else "length")
                 self._slots[s] = None
                 self._lengths = self._lengths.at[s].set(0)
+                self._trace_end(req, "ok")
         self._admit()
         return self._drain()

@@ -19,6 +19,13 @@ for paddle_tpu, stdlib-only (no web framework in the image):
   registry (``paddle_tpu.observability``): latency histograms
   (queue-wait, TTFT, inter-token, prefill, decode-step), request/token
   counters, occupancy gauges. Scrape it next to /health.
+- ``GET /trace?rid=N`` (or ``?trace_id=...``) — the request's recorded
+  spans as JSON, and ``GET /trace/chrome`` — a chrome://tracing JSON
+  download (optionally filtered the same way; the full dump merges the
+  profiler's host events onto the same timeline). ``POST
+  /v1/completions`` accepts an inbound W3C ``traceparent`` header
+  (continuing the caller's trace) and always answers with one, so
+  external callers correlate their spans with the engine's.
 
 Single-engine-thread design: device state (page pool, slot buffers) is
 touched ONLY by the engine thread; HTTP handler threads enqueue
@@ -35,29 +42,47 @@ import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
 from .observability import PROMETHEUS_CONTENT_TYPE, get_registry
+from .observability import tracing as _tracing
 from .observability.catalog import HTTP_REQUESTS
 
 __all__ = ["CompletionServer", "serve"]
 
 # known routes for the http counter — anything else buckets under
 # "other" so a scanner can't explode the label cardinality
-_KNOWN_ROUTES = ("/health", "/metrics", "/v1/models", "/v1/completions")
+_KNOWN_ROUTES = ("/health", "/metrics", "/v1/models", "/v1/completions",
+                 "/trace", "/trace/chrome")
 
 
 class _Submission:
-    __slots__ = ("ids", "params", "events", "rid", "n", "rids")
+    __slots__ = ("ids", "params", "events", "rid", "n", "rids",
+                 "trace_ctx")
 
-    def __init__(self, ids, params, n=1):
+    def __init__(self, ids, params, n=1, trace_ctx=None):
         self.ids = ids
         self.params = params
         self.events: "queue.Queue" = queue.Queue()
         self.rid = None
         self.n = n          # OpenAI "n": sibling completions of one prompt
         self.rids = []
+        self.trace_ctx = trace_ctx  # (trace_id, parent_span_id) | None
+
+
+class _Cancel:
+    """Engine-thread command: cancel every engine request of a
+    submission (a streaming client disconnected). Queued AFTER the
+    submission it refers to, so by the time the engine thread sees it
+    the rids are assigned (FIFO) — and cancel() ends the request's root
+    span with status=cancelled."""
+
+    __slots__ = ("sub",)
+
+    def __init__(self, sub: _Submission):
+        self.sub = sub
 
 
 class CompletionServer:
@@ -70,10 +95,17 @@ class CompletionServer:
     """
 
     def __init__(self, engine, tokenizer=None, model_name: str = "paddle-tpu",
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 enable_tracing: bool = True):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
+        # the server IS a tracing subscriber (it serves /trace), so it
+        # enables the process-wide tracer; pass enable_tracing=False to
+        # keep the engine's guarded no-trace fast path
+        if enable_tracing:
+            _tracing.get_tracer().enable()
+        self._tracer = _tracing.get_tracer()
         self._subs: "queue.Queue[_Submission]" = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._engine_loop,
@@ -105,12 +137,47 @@ class CompletionServer:
         self.close()
 
     # ---- engine thread -------------------------------------------------
+    def _handle_submission(self, sub):
+        """Process one queue item ON the engine thread: a cancel command
+        frees its submission's slots; a submission becomes engine
+        requests (add_request allocates host-side, admission happens
+        inside step)."""
+        eng = self.engine
+        if isinstance(sub, _Cancel):
+            for rid in sub.sub.rids:
+                try:
+                    eng.cancel(rid)
+                except Exception:
+                    # cancel() refills the freed slot (_admit): a failed
+                    # refill must stop the loop like a failed step —
+                    # waiting handlers time out against _stop, not hang
+                    self._stop.set()
+                    raise
+            return
+        ev = sub.events
+
+        def on_token(rid, tok, done, logprob, _ev=ev):
+            _ev.put(("token", (rid, tok, logprob), done))
+
+        try:
+            for _ in range(sub.n):
+                sub.rids.append(
+                    eng.add_request(sub.ids, on_token=on_token,
+                                    trace_ctx=sub.trace_ctx,
+                                    **sub.params))
+            sub.rid = sub.rids[0]
+        except (ValueError, TypeError, NotImplementedError) as e:
+            # client error (bad params, pixel_values to a
+            # non-multimodal model, ...) -> HTTP 400
+            ev.put(("error", str(e), True))
+        except Exception as e:      # engine fault -> HTTP 500
+            ev.put(("fault", str(e), True))
+
     def _engine_loop(self):
         eng = self.engine
         while not self._stop.is_set():
             # drain submissions (engine thread is the ONLY device-state
-            # toucher; add_request allocates host-side, admission happens
-            # inside step)
+            # toucher)
             drained = False
             while True:
                 try:
@@ -118,24 +185,7 @@ class CompletionServer:
                 except queue.Empty:
                     break
                 drained = True
-                ev = sub.events
-
-                def on_token(rid, tok, done, logprob, _ev=ev):
-                    _ev.put(("token", (rid, tok, logprob), done))
-
-                try:
-                    for _ in range(sub.n):
-                        sub.rids.append(
-                            eng.add_request(sub.ids, on_token=on_token,
-                                            **sub.params))
-                    sub.rid = sub.rids[0]
-                except (ValueError, TypeError,
-                        NotImplementedError) as e:
-                    # client error (bad params, pixel_values to a
-                    # non-multimodal model, ...) -> HTTP 400
-                    ev.put(("error", str(e), True))
-                except Exception as e:      # engine fault -> HTTP 500
-                    ev.put(("fault", str(e), True))
+                self._handle_submission(sub)
             if eng.num_active or getattr(eng, "_queue", None):
                 try:
                     eng.step()
@@ -146,10 +196,11 @@ class CompletionServer:
                     self._stop.set()
                     raise
             elif not drained:
-                # idle: block briefly on the submission queue
+                # idle: block briefly, then handle the submission
+                # DIRECTLY — re-enqueueing at the tail would let a
+                # steady trickle of newer submissions starve it
                 try:
-                    sub = self._subs.get(timeout=0.05)
-                    self._subs.put(sub)   # handle on the next iteration
+                    self._handle_submission(self._subs.get(timeout=0.05))
                 except queue.Empty:
                     pass
 
@@ -161,21 +212,79 @@ class CompletionServer:
             def log_message(self, *a):  # silence request logging
                 pass
 
+            # the handler's http.request span (None on GETs / when
+            # tracing is off); responses echo its traceparent
+            _trace_span = None
+
             def _count(self, code):
-                route = (self.path if self.path in _KNOWN_ROUTES
-                         else "other")
+                route = urlsplit(self.path).path
+                if route not in _KNOWN_ROUTES:
+                    route = "other"
                 HTTP_REQUESTS.inc(path=route, code=str(code))
 
-            def _json(self, code, obj):
+            def _send_traceparent(self):
+                sp = self._trace_span
+                if sp is not None and sp.trace_id:
+                    self.send_header(
+                        _tracing.TRACEPARENT_HEADER,
+                        _tracing.format_traceparent(sp.trace_id,
+                                                    sp.span_id))
+
+            def _json(self, code, obj, headers=()):
                 self._count(code)
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self._send_traceparent()
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _trace_query(self, query):
+                """?trace_id=... | ?rid=N[&engine=...] -> trace_id or
+                None (unknown rid / malformed query)."""
+                q = parse_qs(query)
+                if q.get("trace_id"):
+                    return q["trace_id"][0]
+                if q.get("rid"):
+                    try:
+                        rid = int(q["rid"][0])
+                    except ValueError:
+                        return None
+                    engine = (q.get("engine") or [None])[0]
+                    return server_self._tracer.find_request_trace(
+                        rid, engine=engine)
+                return None
+
             def do_GET(self):
+                # one handler instance serves a whole keep-alive
+                # connection: drop any previous POST's span so GETs
+                # don't echo a stale traceparent
+                self._trace_span = None
+                route, _, query = self.path.partition("?")
+                if route == "/trace":
+                    tid = self._trace_query(query)
+                    if tid is None:
+                        return self._json(404, {
+                            "error": "no trace: pass ?rid=<request id> "
+                                     "(finished or in flight) or "
+                                     "?trace_id=<32-hex id>"})
+                    return self._json(200, {
+                        "trace_id": tid,
+                        "spans": server_self._tracer.spans(tid)})
+                if route == "/trace/chrome":
+                    # chrome://tracing download; unfiltered dumps merge
+                    # the profiler's host events onto the same timeline
+                    tid = self._trace_query(query) if query else None
+                    if query and tid is None:
+                        return self._json(404, {"error": "no such trace"})
+                    trace = server_self._tracer.export_chrome(
+                        trace_id=tid)
+                    return self._json(200, trace, headers=(
+                        ("Content-Disposition",
+                         'attachment; filename="paddle_tpu_trace.json"'),))
                 if self.path == "/metrics":
                     # refresh the occupancy gauges off the engine's ONE
                     # stats() snapshot, then render the whole registry;
@@ -213,6 +322,26 @@ class CompletionServer:
                 return self._json(404, {"error": "not found"})
 
             def do_POST(self):
+                # one http.request span per POST, continuing the
+                # caller's trace when an inbound W3C traceparent header
+                # is present; its context parents the engine's
+                # serving.request root span
+                ctx = _tracing.parse_traceparent(
+                    self.headers.get(_tracing.TRACEPARENT_HEADER))
+                sp = server_self._tracer.start_span(
+                    _tracing.SPAN_HTTP_REQUEST,
+                    trace_id=ctx[0] if ctx else None,
+                    parent_id=ctx[1] if ctx else None,
+                    attrs={"method": "POST", "path": self.path})
+                self._trace_span = sp if sp else None
+                try:
+                    self._post_inner()
+                except BaseException:
+                    sp.end("error")
+                    raise
+                sp.end()
+
+            def _post_inner(self):
                 # drain the body FIRST: replying without reading it would
                 # desync a keep-alive connection (HTTP/1.1 is on), making
                 # the next request parse the unread bytes as a request line
@@ -229,8 +358,13 @@ class CompletionServer:
                     return self._json(400, {"error": "invalid JSON body"})
                 try:
                     ids = server_self._prompt_ids(req)
-                    params = dict(
-                        max_new_tokens=int(req.get("max_tokens", 16)))
+                    max_tokens = int(req.get("max_tokens", 16))
+                    if max_tokens < 1:
+                        # the engine checks budgets only post-append, so
+                        # max_tokens=0 would come back with ONE token —
+                        # reject here instead (OpenAI also 400s it)
+                        raise ValueError("max_tokens must be >= 1")
+                    params = dict(max_new_tokens=max_tokens)
                     if ("temperature" in req or "top_p" in req
                             or "top_k" in req or req.get("do_sample")):
                         params.update(
@@ -282,7 +416,10 @@ class CompletionServer:
                 except (ValueError, TypeError) as e:
                     # wrong-typed fields answer 400, not a dropped socket
                     return self._json(400, {"error": str(e)})
-                sub = _Submission(ids, params, n=n)
+                sp = self._trace_span
+                sub = _Submission(ids, params, n=n,
+                                  trace_ctx=((sp.trace_id, sp.span_id)
+                                             if sp is not None else None))
                 server_self._subs.put(sub)
                 cid = f"cmpl-{uuid.uuid4().hex[:24]}"
                 if req.get("stream"):
@@ -339,51 +476,69 @@ class CompletionServer:
                 })
 
             def _stream(self, sub, cid, n_prompt, want_logprobs=False):
-                self._count(200)
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-
                 def chunk(payload: bytes):
                     self.wfile.write(f"{len(payload):X}\r\n".encode()
                                      + payload + b"\r\n")
 
-                clean = True
-                while True:
-                    try:
-                        kind, payload, done = sub.events.get(timeout=1.0)
-                    except queue.Empty:
-                        if server_self._stop.is_set():
-                            chunk(b'data: {"error": "engine stopped"}\n\n')
+                try:
+                    self._count(200)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self._send_traceparent()
+                    self.end_headers()
+
+                    clean = True
+                    while True:
+                        try:
+                            kind, payload, done = sub.events.get(
+                                timeout=1.0)
+                        except queue.Empty:
+                            if server_self._stop.is_set():
+                                chunk(b'data: '
+                                      b'{"error": "engine stopped"}\n\n')
+                                clean = False
+                                break
+                            continue
+                        if kind in ("error", "fault"):
+                            chunk(b'data: {"error": '
+                                  + json.dumps(str(payload)).encode()
+                                  + b"}\n\n")
                             clean = False
                             break
-                        continue
-                    if kind in ("error", "fault"):
-                        chunk(b'data: {"error": '
-                              + json.dumps(str(payload)).encode() + b"}\n\n")
-                        clean = False
-                        break
-                    _rid, tok, lp = payload
-                    piece = {"id": cid, "object": "text_completion",
-                             "choices": [{"index": 0,
-                                          "token_ids": [int(tok)]}]}
-                    if want_logprobs:
-                        piece["choices"][0]["logprobs"] = {
-                            "token_logprobs": [float(lp)]}
-                    if server_self.tokenizer is not None:
-                        piece["choices"][0]["text"] = (
-                            server_self.tokenizer.decode([int(tok)]))
-                    chunk(b"data: " + json.dumps(piece).encode() + b"\n\n")
-                    if done:
-                        break
-                if clean:
-                    # [DONE] signals CLEAN completion only — an SSE client
-                    # watching for it must not mistake a failed stream for
-                    # success
-                    chunk(b"data: [DONE]\n\n")
-                chunk(b"")  # chunked-encoding terminator
+                        _rid, tok, lp = payload
+                        piece = {"id": cid, "object": "text_completion",
+                                 "choices": [{"index": 0,
+                                              "token_ids": [int(tok)]}]}
+                        if want_logprobs:
+                            piece["choices"][0]["logprobs"] = {
+                                "token_logprobs": [float(lp)]}
+                        if server_self.tokenizer is not None:
+                            piece["choices"][0]["text"] = (
+                                server_self.tokenizer.decode([int(tok)]))
+                        chunk(b"data: " + json.dumps(piece).encode()
+                              + b"\n\n")
+                        if done:
+                            break
+                    if clean:
+                        # [DONE] signals CLEAN completion only — an SSE
+                        # client watching for it must not mistake a failed
+                        # stream for success
+                        chunk(b"data: [DONE]\n\n")
+                    chunk(b"")  # chunked-encoding terminator
+                except OSError:
+                    # client went away mid-stream (BrokenPipeError /
+                    # reset): the engine must not keep decoding into a
+                    # dead socket — enqueue a cancel command to the
+                    # engine thread (it owns all device state), which
+                    # frees the slot(s) immediately and ends the
+                    # request's root span with status=cancelled
+                    server_self._subs.put(_Cancel(sub))
+                    if self._trace_span is not None:
+                        self._trace_span.set_attr("client_disconnected",
+                                                  True)
+                    self.close_connection = True
 
         return Handler
 

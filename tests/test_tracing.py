@@ -1,0 +1,485 @@
+"""Request-scoped tracing: span nesting across threads, W3C traceparent
+round-trips, ring-buffer bounding, chrome-trace export validity, the
+serving pipeline's span tree over HTTP, disconnect-cancel wiring, and
+the span-catalog lint."""
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import tracing
+from paddle_tpu.serving import ContinuousBatchEngine
+from paddle_tpu.serving_http import CompletionServer
+
+
+@pytest.fixture()
+def tracer():
+    """The process-wide tracer, enabled and clean; restored after."""
+    tr = tracing.get_tracer()
+    was_enabled = tr.enabled
+    tr.clear()
+    tr.enable()
+    yield tr
+    if not was_enabled:
+        tr.disable()
+    tr.clear()
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One tiny model + engine + server for the HTTP-level tests (the
+    server enables tracing — it subscribes via /trace)."""
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+    eng = ContinuousBatchEngine(model, max_batch=4, max_len=256,
+                                page_size=8)
+    srv = CompletionServer(eng, model_name="tiny-llama").start()
+    yield model, eng, srv
+    srv.close()
+    tracing.get_tracer().disable()
+    tracing.get_tracer().clear()
+
+
+def _post(srv, body, headers=None):
+    host, port = srv.address
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    conn.request("POST", "/v1/completions", json.dumps(body),
+                 {"Content-Type": "application/json", **(headers or {})})
+    resp = conn.getresponse()
+    data = resp.read()
+    hdrs = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, hdrs
+
+
+def _get(srv, path):
+    host, port = srv.address
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+# ---- tracer core -------------------------------------------------------------
+
+def test_span_nesting_and_context(tracer):
+    with tracer.span("outer", attrs={"k": 1}) as outer:
+        assert tracer.current() is outer
+        with tracer.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert tracer.current() is None
+    recs = {r["name"]: r for r in tracer.spans()}
+    assert recs["inner"]["parent_id"] == recs["outer"]["span_id"]
+    assert recs["outer"]["parent_id"] is None
+    assert recs["outer"]["attrs"]["k"] == 1
+    assert recs["outer"]["status"] == "ok"
+    # spans() filtered by trace
+    assert len(tracer.spans(recs["outer"]["trace_id"])) == 2
+
+
+def test_span_nesting_across_threads(tracer):
+    """The current-span stack is thread-local; cross-thread parenting is
+    explicit (parent= / use()) — the HTTP-handler-to-engine-thread
+    pattern."""
+    with tracer.span("root") as root:
+        seen = {}
+
+        def worker():
+            # a fresh thread has NO current span: an unparented span
+            # starts its own trace
+            orphan = tracer.start_span("orphan")
+            orphan.end()
+            # explicit parent crosses the thread boundary
+            with tracer.span("child", parent=root) as ch:
+                seen["child_trace"] = ch.trace_id
+            # use() adopts an existing span as current
+            with tracer.use(root):
+                with tracer.span("adopted") as ad:
+                    seen["adopted_parent"] = ad.parent_id
+            seen["after_use"] = tracer.current()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(30)
+        assert tracer.current() is root    # main stack untouched
+    recs = {r["name"]: r for r in tracer.spans()}
+    assert recs["orphan"]["trace_id"] != recs["root"]["trace_id"]
+    assert recs["orphan"]["parent_id"] is None
+    assert seen["child_trace"] == recs["root"]["trace_id"]
+    assert recs["child"]["parent_id"] == recs["root"]["span_id"]
+    assert seen["adopted_parent"] == recs["root"]["span_id"]
+    assert seen["after_use"] is None
+    assert recs["child"]["tid"] != recs["root"]["tid"]
+
+
+def test_span_error_status_and_decorator(tracer):
+    with pytest.raises(RuntimeError):
+        with tracer.span("boom"):
+            raise RuntimeError("x")
+    assert tracer.spans()[-1]["status"] == "error"
+
+    @tracing.trace("deco.op", kind="test")
+    def fn(x):
+        return x + 1
+
+    assert fn(1) == 2
+    rec = tracer.spans()[-1]
+    assert rec["name"] == "deco.op" and rec["attrs"]["kind"] == "test"
+
+
+def test_ring_buffer_bounded():
+    tr = tracing.Tracer(capacity=16)
+    tr.enabled = True  # local instance: no exemplar hook to install
+    for i in range(100):
+        tr.start_span(f"s{i}").end()
+    assert len(tr) == 16 and tr.capacity == 16
+    # oldest evicted, newest kept
+    names = [r["name"] for r in tr.spans()]
+    assert names == [f"s{i}" for i in range(84, 100)]
+    assert not tr._live  # ended spans left the live index
+
+
+def test_disabled_is_noop():
+    tr = tracing.Tracer()
+    assert not tr.enabled
+    sp = tr.start_span("x")
+    assert not sp and sp.trace_id is None
+    sp.set_attr("a", 1).end()
+    with tr.span("y") as y:
+        assert not y
+    assert len(tr) == 0
+
+
+def test_traceparent_roundtrip():
+    tid, sid = "a" * 32, "b" * 16
+    hdr = tracing.format_traceparent(tid, sid)
+    assert hdr == f"00-{tid}-{sid}-01"
+    assert tracing.parse_traceparent(hdr) == (tid, sid)
+    # case-normalised
+    assert tracing.parse_traceparent(hdr.upper().replace("00-", "00-")
+                                     ) == (tid, sid)
+    for bad in (None, "", "garbage", "00-short-b-01",
+                f"00-{'0' * 32}-{sid}-01",       # all-zero trace id
+                f"00-{tid}-{'0' * 16}-01",       # all-zero span id
+                f"ff-{tid}-{sid}-01",            # forbidden version
+                f"00-{tid}-{sid}-01-extra",      # version 00 is exactly 4
+                f"zz-{tid}-{sid}-01"):
+        assert tracing.parse_traceparent(bad) is None, bad
+    # future versions may carry extra fields
+    assert tracing.parse_traceparent(
+        f"01-{tid}-{sid}-01-extra") == (tid, sid)
+
+
+def test_chrome_export_merges_profiler(tracer, tmp_path):
+    from paddle_tpu.profiler import RecordEvent
+    from paddle_tpu.profiler.profiler import _recorder
+
+    with tracer.span("op.a"):
+        pass
+    _recorder.start()
+    with RecordEvent("host_ev"):
+        time.sleep(0.001)
+    _recorder.stop()
+    path = tmp_path / "trace.json"
+    tracer.export_chrome(path=str(path))
+    data = json.load(open(path))
+    events = data["traceEvents"]
+    names = {e["name"] for e in events}
+    assert "op.a" in names and "host_ev" in names   # one merged timeline
+    for e in events:
+        assert e["ph"] == "X" and e["dur"] >= 0 and "ts" in e
+    ours = next(e for e in events if e["name"] == "op.a")
+    assert ours["args"]["trace_id"] and ours["args"]["span_id"]
+    # a trace-filtered export excludes profiler events
+    only = tracer.export_chrome(trace_id=ours["args"]["trace_id"])
+    assert {e["name"] for e in only["traceEvents"]} == {"op.a"}
+
+
+def test_jsonl_export_through_snapshot_writer(tracer, tmp_path):
+    from paddle_tpu.observability import SnapshotWriter
+
+    with tracer.span("snap.op"):
+        pass
+    path = tracer.export_jsonl(SnapshotWriter(str(tmp_path)))
+    rec = json.loads(open(path).readline())
+    assert "metrics" in rec      # PR 1's snapshot payload, same line
+    assert [s["name"] for s in rec["spans"]] == ["snap.op"]
+
+
+def test_histogram_exemplar_crosslink(tracer):
+    from paddle_tpu.observability import MetricsRegistry
+
+    r = MetricsRegistry()
+    h = r.histogram("xl_seconds", "t", buckets=(1.0,))
+    h.observe(0.5)                     # outside any span: no exemplar
+    with tracer.span("xl.op") as sp:
+        h.observe(2.0)                 # inside: trace_id attaches
+        tid = sp.trace_id
+    child = h._children[()]
+    assert child.exemplar is not None
+    v, ex_tid, _ts = child.exemplar
+    assert v == 2.0 and ex_tid == tid
+    # both directions: the span picked the observation up as an attr
+    assert tracer.spans()[-1]["attrs"]["xl_seconds"] == 2.0
+    text = r.render_prometheus()
+    assert f'# exemplar xl_seconds trace_id="{tid}" value=2' in text
+    snap = r.snapshot()["xl_seconds"]["series"][""]
+    assert snap["exemplar"]["trace_id"] == tid
+    # disable unhooks the provider
+    tracer.disable()
+    h.observe(3.0)
+    assert child.exemplar[0] == 2.0
+    tracer.enable()
+
+
+def test_train_step_spans(tracer):
+    from paddle_tpu.observability import StepTimer
+    from paddle_tpu.profiler.timer import benchmark
+
+    with StepTimer().step(n_tokens=128):
+        pass
+    rec = tracer.spans()[-1]
+    assert rec["name"] == "train.step" and rec["status"] == "ok"
+    # exemplar cross-link: the step observation landed on the span
+    assert "train_step_seconds" in rec["attrs"]
+
+    b = benchmark()
+    b.begin()
+    b.step(num_samples=4)
+    rec = tracer.spans()[-1]
+    assert rec["name"] == "train.step"
+    assert rec["attrs"]["samples"] == 4
+
+
+def test_hapi_epoch_parents_steps(tracer):
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.hapi.callbacks import StepTimer
+    from paddle_tpu.hapi.model import Model
+
+    net = nn.Linear(4, 2)
+    m = Model(net)
+    m.prepare(opt.SGD(0.1, parameters=net.parameters()), nn.MSELoss())
+    x = np.random.randn(8, 4).astype("float32")
+    y = np.random.randn(8, 2).astype("float32")
+    m.fit(list(zip(x, y)), batch_size=4, epochs=1, verbose=0,
+          callbacks=[StepTimer()])
+    recs = tracer.spans()
+    epochs = [r for r in recs if r["name"] == "train.epoch"]
+    steps = [r for r in recs if r["name"] == "train.step"]
+    assert len(epochs) == 1 and len(steps) >= 2
+    assert all(s["parent_id"] == epochs[0]["span_id"] for s in steps)
+    assert all(s["trace_id"] == epochs[0]["trace_id"] for s in steps)
+
+
+# ---- serving pipeline over HTTP ---------------------------------------------
+
+def _request_tree(spans):
+    """{name: [records]} plus the single serving.request root."""
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    (root,) = by_name["serving.request"]
+    return by_name, root
+
+
+def test_http_trace_end_to_end(served):
+    """Acceptance: a completion request (no inbound traceparent)
+    produces a retrievable trace — queue/prefill/decode children under
+    one root, chrome export loads as valid JSON."""
+    model, eng, srv = served
+    prompt = np.random.RandomState(0).randint(1, 512, (9,)).tolist()
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=6).numpy()[0].tolist()
+    status, data, hdrs = _post(srv, {"prompt_token_ids": prompt,
+                                     "max_tokens": 6})
+    assert status == 200
+    assert json.loads(data)["choices"][0]["token_ids"] == solo
+    # the response ALWAYS carries our traceparent
+    ctx = tracing.parse_traceparent(hdrs["traceparent"])
+    assert ctx is not None
+    trace_id = ctx[0]
+    status, data = _get(srv, f"/trace?trace_id={trace_id}")
+    assert status == 200
+    body = json.loads(data)
+    assert body["trace_id"] == trace_id
+    by_name, root = _request_tree(body["spans"])
+    rid = root["attrs"]["rid"]
+    # the same trace resolves by request id
+    status, data = _get(srv, f"/trace?rid={rid}")
+    assert status == 200 and json.loads(data)["trace_id"] == trace_id
+    # span tree: http.request parents the serving root; queue-wait,
+    # prefill, decode and slot-free hang under the root
+    (http_span,) = by_name["http.request"]
+    assert root["parent_id"] == http_span["span_id"]
+    assert root["status"] == "ok"
+    assert root["attrs"]["generated_tokens"] == 6
+    assert root["attrs"]["prompt_tokens"] == 9
+    for name in ("serving.queue_wait", "serving.prefill",
+                 "serving.decode_step", "serving.slot_free"):
+        for rec in by_name[name]:
+            assert rec["trace_id"] == trace_id, name
+            assert rec["parent_id"] == root["span_id"], name
+            assert rec["end_ns"] >= rec["start_ns"], name
+    # decode spans are SAMPLED: 6 tokens at every-16th = the first only
+    assert len(by_name["serving.decode_step"]) == 1
+    assert by_name["serving.decode_step"][0]["attrs"]["token_index"] == 1
+    # chrome download: valid JSON, complete-event records for this trace
+    status, data = _get(srv, f"/trace/chrome?trace_id={trace_id}")
+    assert status == 200
+    chrome = json.loads(data)
+    names = {e["name"] for e in chrome["traceEvents"]}
+    assert {"serving.request", "serving.prefill",
+            "serving.decode_step"} <= names
+    # unknown rid answers 404, not a dropped socket
+    status, _ = _get(srv, "/trace?rid=999999")
+    assert status == 404
+    status, _ = _get(srv, "/trace")
+    assert status == 404
+
+
+def test_http_inbound_traceparent_propagates(served):
+    """An external caller's traceparent continues through http.request
+    into the engine's root span — cross-service correlation."""
+    model, eng, srv = served
+    tid, psid = "c" * 32, "d" * 16
+    prompt = np.random.RandomState(1).randint(1, 512, (5,)).tolist()
+    status, data, hdrs = _post(
+        srv, {"prompt_token_ids": prompt, "max_tokens": 3},
+        headers={"traceparent": tracing.format_traceparent(tid, psid)})
+    assert status == 200
+    # the response context stays in the CALLER's trace
+    ctx = tracing.parse_traceparent(hdrs["traceparent"])
+    assert ctx[0] == tid
+    status, data = _get(srv, f"/trace?trace_id={tid}")
+    assert status == 200
+    by_name, root = _request_tree(json.loads(data)["spans"])
+    (http_span,) = by_name["http.request"]
+    assert http_span["parent_id"] == psid        # caller's span
+    assert http_span["trace_id"] == tid
+    assert root["trace_id"] == tid
+    assert root["parent_id"] == http_span["span_id"]
+
+
+def test_max_tokens_validated(served):
+    """Satellite: max_tokens < 1 answers 400 (the engine's post-append
+    budget check would return ONE token for max_tokens=0)."""
+    _, _, srv = served
+    for bad in (0, -3):
+        status, data, _ = _post(srv, {"prompt_token_ids": [1, 2, 3],
+                                      "max_tokens": bad})
+        assert status == 400 and b"max_tokens" in data, bad
+    # the boundary value still serves
+    status, data, _ = _post(srv, {"prompt_token_ids": [1, 2, 3],
+                                  "max_tokens": 1})
+    assert status == 200
+    assert len(json.loads(data)["choices"][0]["token_ids"]) == 1
+
+
+def test_stream_disconnect_cancels_and_frees_slot(served):
+    """Satellite: a client that vanishes mid-stream must not hold a slot
+    — the handler enqueues cancel(rid) to the engine thread and the
+    request's root span ends with status=cancelled."""
+    import socket
+    import struct
+
+    model, eng, srv = served
+    cancelled_before = eng.stats()["requests_cancelled"]
+    host, port = srv.address
+    prompt = np.random.RandomState(2).randint(1, 512, (6,)).tolist()
+    body = json.dumps({"prompt_token_ids": prompt, "max_tokens": 240,
+                       "stream": True}).encode()
+    sock = socket.create_connection((host, port), timeout=120)
+    sock.sendall((f"POST /v1/completions HTTP/1.1\r\nHost: {host}\r\n"
+                  "Content-Type: application/json\r\n"
+                  f"Content-Length: {len(body)}\r\n\r\n").encode() + body)
+    first = sock.recv(200)   # headers + first SSE bytes: decoding started
+    assert b"200" in first
+    # SO_LINGER(0): close sends an RST, so the server's next chunk write
+    # fails like a real vanished client (a plain close of a duped fd
+    # would keep the connection alive)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                    struct.pack("ii", 1, 0))
+    sock.close()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        stats = eng.stats()
+        if (stats["requests_cancelled"] > cancelled_before
+                and stats["requests_active"] == 0):
+            break
+        time.sleep(0.05)
+    stats = eng.stats()
+    assert stats["requests_cancelled"] > cancelled_before
+    assert stats["requests_active"] == 0          # slot freed
+    # the root span retired as cancelled (give the engine thread a beat)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        cancelled = [r for r in tracing.get_tracer().spans()
+                     if r["name"] == "serving.request"
+                     and r["status"] == "cancelled"]
+        if cancelled:
+            break
+        time.sleep(0.05)
+    assert cancelled
+    assert cancelled[-1]["attrs"]["generated_tokens"] < 240
+
+
+def test_engine_tracing_disabled_fast_path():
+    """Acceptance guard: with no subscriber the engine allocates no
+    spans at all — requests carry span=None end to end."""
+    tr = tracing.get_tracer()
+    was_enabled = tr.enabled
+    tr.disable()
+    try:
+        paddle.seed(1)
+        model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1))
+        eng = ContinuousBatchEngine(model, max_batch=2, max_len=32,
+                                    page_size=8)
+        n_before = len(tr)
+        rid = eng.add_request(np.arange(1, 6), max_new_tokens=4)
+        done = eng.run_until_done()
+        assert len(done[rid]) == 4
+        assert len(tr) == n_before      # not one span recorded
+    finally:
+        if was_enabled:
+            tr.enable()
+
+
+def test_span_catalog_lint():
+    """Satellite: docs/SERVING.md's span catalog and the tracer's
+    registered names agree in both directions (tier-1, like the metric
+    lint)."""
+    import importlib.util
+    import os
+
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "check_span_catalog.py")
+    spec = importlib.util.spec_from_file_location("_span_lint", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
+
+
+def test_export_hf_preserves_dtype():
+    """Satellite: export_hf_llama keeps parameter dtype (a bf16 model
+    exports bf16, not a silent float32 upcast); dtype= forces a cast."""
+    from paddle_tpu.models.llama import llama_to_hf
+
+    paddle.seed(3)
+    m = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1,
+                                          dtype="bfloat16"))
+    sd = llama_to_hf(m)
+    assert {str(v.dtype) for v in sd.values()} == {"bfloat16"}
+    sd32 = llama_to_hf(m, dtype="float32")
+    assert {str(v.dtype) for v in sd32.values()} == {"float32"}
+    m2 = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1))
+    sd = llama_to_hf(m2)
+    assert {str(v.dtype) for v in sd.values()} == {"float32"}
