@@ -1,0 +1,21 @@
+"""paddle_tpu.analysis — pdlint, the framework-native static analyzer.
+
+Machine-checks the conventions the TPU-native collapse traded the
+reference's generators for: trace purity, hot-path host-sync hygiene,
+lock discipline, silent-exception hygiene, op-schema consistency, and
+the metrics/span catalog contracts. See docs/ANALYSIS.md for the rule
+catalog and ``scripts/pdlint.py`` for the CLI; the tier-1 gate lives in
+tests/test_static_analysis.py.
+"""
+from . import baseline, report  # noqa: F401
+from .core import (  # noqa: F401
+    Finding, ModuleContext, ProjectRule, Rule, RULES, analyze_file,
+    analyze_source, ast_rules, iter_py_files, project_rules,
+    register_rule, run,
+)
+
+__all__ = [
+    "Finding", "ModuleContext", "ProjectRule", "Rule", "RULES",
+    "analyze_file", "analyze_source", "ast_rules", "iter_py_files",
+    "project_rules", "register_rule", "run", "baseline", "report",
+]

@@ -1,0 +1,63 @@
+"""Baseline: checked-in grandfathered findings (``.pdlint_baseline.json``).
+
+A new rule landing on an old codebase faces a choice: fix every historic
+finding in the same PR, or never land the rule. The baseline is the
+third option — existing findings are recorded once and stop failing the
+gate, while any NEW finding (a key not in the file) still fails. Entries
+key on ``(file, rule, symbol, message)`` — no line numbers — so edits
+elsewhere in a file don't churn the baseline; moving or renaming the
+enclosing function intentionally invalidates the entry (the code changed,
+the finding deserves a fresh look).
+
+The file is a plain sorted-JSON list so diffs review like code.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Set, Tuple
+
+from .core import Finding
+
+__all__ = ["load", "save", "filter_new", "to_entries"]
+
+_VERSION = 1
+_FIELDS = ("file", "rule", "symbol", "message")
+
+Key = Tuple[str, str, str, str]
+
+
+def to_entries(findings: Iterable[Finding]) -> List[Dict[str, str]]:
+    entries = [{"file": f.file, "rule": f.rule, "symbol": f.symbol,
+                "message": f.message} for f in findings]
+    seen: Set[Key] = set()
+    out = []
+    for e in sorted(entries, key=lambda d: tuple(d[k] for k in _FIELDS)):
+        k = tuple(e[f] for f in _FIELDS)
+        if k not in seen:
+            seen.add(k)
+            out.append(e)
+    return out
+
+
+def save(path: str, findings: Iterable[Finding]) -> int:
+    entries = to_entries(findings)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": _VERSION, "findings": entries}, fh, indent=1,
+                  sort_keys=True)
+        fh.write("\n")
+    return len(entries)
+
+
+def load(path: str) -> Set[Key]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != _VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r}")
+    return {tuple(e[f] for f in _FIELDS) for e in data["findings"]}
+
+
+def filter_new(findings: Iterable[Finding],
+               baseline: Set[Key]) -> List[Finding]:
+    """Findings whose key is NOT grandfathered (the ones that fail)."""
+    return [f for f in findings if f.key() not in baseline]

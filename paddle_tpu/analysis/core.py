@@ -1,0 +1,288 @@
+"""pdlint core: rule registry, pragma suppression, and the file driver.
+
+The reference Paddle enforces framework invariants at generation time —
+ops.yaml drives the dispatch generators, kernel registration validates
+dtype/layout tables at load. The TPU-native collapse replaced those
+generators with conventions (jit-traced code stays pure, hot paths never
+sync to host, threaded state is lock-guarded), and conventions that
+nothing checks are the invariants that rot. This package is the checker:
+an AST-based analyzer with a pluggable rule registry, run over the whole
+package by ``scripts/pdlint.py`` and as a tier-1 gate
+(tests/test_static_analysis.py).
+
+Two rule kinds:
+
+- **AST rules** (`Rule`): per-module, pure ``ast`` — no paddle_tpu import
+  needed, so fixture snippets unit-test them in isolation.
+- **project rules** (`ProjectRule`): run once per invocation against the
+  repo root (op-schema consistency, the metrics/span catalog lints that
+  started life as standalone scripts).
+
+Suppression is explicit and local: ``# pdlint: disable=rule-id`` on the
+finding's line (comma-separate several ids, or ``disable=all``), or a
+checked-in ``.pdlint_baseline.json`` for grandfathered findings (see
+``baseline.py``). Baselines match on (file, rule, symbol, message) — not
+line numbers — so unrelated edits don't churn them.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence
+
+__all__ = [
+    "Finding", "Rule", "ProjectRule", "ModuleContext", "RULES",
+    "register_rule", "analyze_source", "analyze_file", "iter_py_files",
+    "run",
+]
+
+_PRAGMA = re.compile(
+    r"#\s*pdlint:\s*disable="
+    r"([A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic: ``file:line rule-id message``.
+
+    ``symbol`` is the innermost enclosing ``Class.method`` qualname — the
+    line-number-free identity baselines key on.
+    """
+
+    file: str
+    line: int
+    rule: str
+    message: str
+    symbol: str = ""
+
+    def key(self):
+        return (self.file, self.rule, self.symbol, self.message)
+
+    def render(self) -> str:
+        where = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.file}:{self.line} {self.rule} {self.message}{where}"
+
+
+class ModuleContext:
+    """Everything an AST rule needs about one module: the parsed tree,
+    source lines, the import alias map, per-line pragma suppressions, and
+    enclosing-scope qualnames."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = _import_aliases(self.tree)
+        self._scopes = _scope_spans(self.tree)
+
+    # ---- pragmas --------------------------------------------------------
+    def suppressed(self, line: int, rule_id: str) -> bool:
+        if not (1 <= line <= len(self.lines)):
+            return False
+        m = _PRAGMA.search(self.lines[line - 1])
+        if not m:
+            return False
+        ids = {s.strip() for s in m.group(1).split(",")}
+        return rule_id in ids or "all" in ids
+
+    # ---- scopes ---------------------------------------------------------
+    def symbol_for_line(self, line: int) -> str:
+        """Innermost def/class qualname containing ``line`` ("" at
+        module level)."""
+        best = ""
+        best_span = None
+        for (lo, hi, qual) in self._scopes:
+            if lo <= line <= hi and (best_span is None
+                                     or (hi - lo) <= best_span):
+                best, best_span = qual, hi - lo
+        return best
+
+    # ---- name resolution ------------------------------------------------
+    def resolve_call(self, func: ast.AST) -> str:
+        """Dotted path of a call target with the root resolved through
+        the module's import aliases (``np.asarray`` -> ``numpy.asarray``;
+        relative imports resolve to a leading dot, so a local module
+        aliased ``random`` never collides with the stdlib)."""
+        parts = _dotted_parts(func)
+        if not parts:
+            return ""
+        root = self.aliases.get(parts[0])
+        if root is not None:
+            parts = root.split(".") + parts[1:]
+        return ".".join(parts)
+
+
+def _dotted_parts(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> dotted module path, from every import in the module
+    (function-level included). Relative imports keep a leading "." so
+    they can never be mistaken for a stdlib module of the same name."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            prefix = "." * node.level + (node.module or "")
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{prefix}.{a.name}"
+    return out
+
+
+def _scope_spans(tree: ast.Module):
+    spans = []
+
+    def visit(node, qual):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+                spans.append((child.lineno, child.end_lineno or child.lineno,
+                              q))
+                visit(child, q)
+            else:
+                visit(child, qual)
+
+    visit(tree, "")
+    return spans
+
+
+# ---- rule registry ----------------------------------------------------------
+
+class Rule:
+    """An AST rule: ``check(ctx)`` yields findings for one module."""
+
+    id: str = ""
+    rationale: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleContext, line: int, message: str) -> Finding:
+        return Finding(file=ctx.path, line=line, rule=self.id,
+                       message=message, symbol=ctx.symbol_for_line(line))
+
+
+class ProjectRule(Rule):
+    """A whole-project rule: ``check_project(root)`` runs once per
+    invocation (op-schema consistency, catalog lints)."""
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(cls):
+    """Class decorator: instantiate and register under ``cls.id``."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in RULES and type(RULES[inst.id]) is not cls:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    RULES[inst.id] = inst
+    return cls
+
+
+def _ensure_rules_loaded():
+    from . import rules as _rules  # noqa: F401  (registers on import)
+
+
+def ast_rules(selected: Optional[Sequence[str]] = None) -> List[Rule]:
+    _ensure_rules_loaded()
+    return [r for rid, r in sorted(RULES.items())
+            if not isinstance(r, ProjectRule)
+            and (selected is None or rid in selected)]
+
+
+def project_rules(selected: Optional[Sequence[str]] = None
+                  ) -> List[ProjectRule]:
+    _ensure_rules_loaded()
+    return [r for rid, r in sorted(RULES.items())
+            if isinstance(r, ProjectRule)
+            and (selected is None or rid in selected)]
+
+
+# ---- drivers ----------------------------------------------------------------
+
+def analyze_source(source: str, filename: str = "<snippet>",
+                   rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run AST rules over one source string (the fixture-test entry
+    point). Pragma suppression applies exactly as on disk."""
+    ctx = ModuleContext(filename, source)
+    out: List[Finding] = []
+    for rule in (rules if rules is not None else ast_rules()):
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f.line, f.rule):
+                out.append(f)
+    return out
+
+
+def analyze_file(path: str, root: str,
+                 rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return analyze_source(source, rel, rules)
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, files in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            out.extend(os.path.join(dirpath, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return sorted(out)
+
+
+def run(paths: Optional[Sequence[str]] = None, root: Optional[str] = None,
+        selected: Optional[Sequence[str]] = None,
+        with_project_rules: bool = True) -> List[Finding]:
+    """Analyze ``paths`` (default: ``<root>/paddle_tpu``) and, unless
+    disabled, run the project rules against ``root``. Findings come back
+    sorted by (file, line, rule)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    if paths is None:
+        paths = [os.path.join(root, "paddle_tpu")]
+    arules = ast_rules(selected)
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            findings.extend(analyze_file(path, root, arules))
+        except SyntaxError as e:
+            findings.append(Finding(
+                file=os.path.relpath(path, root).replace(os.sep, "/"),
+                line=e.lineno or 1, rule="parse-error",
+                message=f"could not parse: {e.msg}"))
+    if with_project_rules:
+        for rule in project_rules(selected):
+            findings.extend(rule.check_project(root))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
+    return findings

@@ -1,0 +1,51 @@
+"""pdlint reporters: text (``file:line rule-id message``) and JSON.
+
+The JSON schema is a stability contract (tests/test_static_analysis.py
+pins it): CI consumers parse ``findings``/``counts``/``total`` and must
+not break when rules are added. Bump ``SCHEMA_VERSION`` on any
+shape-incompatible change.
+"""
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional
+
+from .core import Finding
+
+__all__ = ["render_text", "render_json", "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = 1
+
+
+def render_text(findings: Iterable[Finding],
+                baselined: int = 0) -> str:
+    findings = list(findings)
+    lines = [f.render() for f in findings]
+    tail = f"pdlint: {len(findings)} finding(s)"
+    if baselined:
+        tail += f" ({baselined} baselined, not shown)"
+    lines.append(tail)
+    return "\n".join(lines)
+
+
+def render_json(findings: Iterable[Finding], baselined: int = 0,
+                rule_ids: Optional[List[str]] = None) -> str:
+    findings = list(findings)
+    counts = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    doc = {
+        "schema_version": SCHEMA_VERSION,
+        "tool": "pdlint",
+        "findings": [
+            {"file": f.file, "line": f.line, "rule": f.rule,
+             "symbol": f.symbol, "message": f.message}
+            for f in findings
+        ],
+        "counts": counts,
+        "total": len(findings),
+        "baselined": baselined,
+    }
+    if rule_ids is not None:
+        doc["rules"] = sorted(rule_ids)
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"

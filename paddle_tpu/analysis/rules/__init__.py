@@ -1,0 +1,9 @@
+"""Rule modules — importing this package registers every rule into
+``core.RULES``. Add a rule by adding a module here and importing it;
+docs/ANALYSIS.md carries the per-rule catalog."""
+from . import trace_purity  # noqa: F401
+from . import host_sync  # noqa: F401
+from . import lock_discipline  # noqa: F401
+from . import silent_exception  # noqa: F401
+from . import op_schema  # noqa: F401
+from . import catalogs  # noqa: F401

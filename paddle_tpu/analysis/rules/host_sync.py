@@ -1,0 +1,150 @@
+"""host-sync: no hidden device→host syncs on serving hot paths.
+
+A TPU decode step is a single fused dispatch; the engine's throughput
+model assumes exactly ONE device→host transfer per step (the sampled
+tokens). Any extra ``.item()`` / ``int()`` / ``float()`` /
+``np.asarray()`` on a device value inside ``step()`` or a
+decode/prefill-path function blocks the host on the device queue and
+serializes dispatch — the classic silent 10x in serving loops.
+
+Scope: functions named ``step`` (or containing ``decode``/``prefill``)
+in the hot-path modules (serving.py, generation.py, speculative.py).
+The rule does LOCAL taint tracking rather than banning ``np.asarray``
+outright: a name assigned from a device-producing call (``jnp.*``, a
+jitted step, any non-host call) is device-tainted; converting it — or a
+subscript of it — to host is a finding, while host-side bookkeeping
+(``np.asarray`` of a Python list, ``int()`` of a length) stays legal.
+Deliberate sync points (the one per-step token fetch) carry an inline
+``# pdlint: disable=host-sync`` pragma, which is the documentation.
+
+Always flagged in hot functions, taint or not: ``.item()``,
+``.block_until_ready()``, ``jax.device_get()``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Set
+
+from ..core import Finding, ModuleContext, Rule, register_rule
+
+HOT_MODULES = {"serving.py", "generation.py", "speculative.py"}
+_HOT_NAME_PARTS = ("decode", "prefill")
+
+# calls whose results stay host-side (taint sinks, not sources)
+_HOST_BUILTINS = {
+    "len", "int", "float", "bool", "str", "list", "tuple", "dict", "set",
+    "sorted", "min", "max", "sum", "abs", "enumerate", "zip", "range",
+    "getattr", "hasattr", "isinstance", "repr",
+}
+_HOST_PREFIXES = ("numpy.", "time.", "os.", "math.")
+_SYNC_CONVERTERS = {"numpy.asarray", "numpy.array", "int", "float"}
+
+
+def _is_hot_module(path: str) -> bool:
+    return os.path.basename(path) in HOT_MODULES
+
+
+def _is_hot_function(name: str) -> bool:
+    return name == "step" or any(p in name for p in _HOT_NAME_PARTS)
+
+
+@register_rule
+class HostSyncRule(Rule):
+    id = "host-sync"
+    rationale = ("device→host syncs inside step()/decode/prefill paths "
+                 "block dispatch and serialize the serving loop")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not _is_hot_module(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and _is_hot_function(node.name)):
+                yield from self._check_hot(ctx, node)
+
+    def _check_hot(self, ctx: ModuleContext, fn) -> Iterable[Finding]:
+        tainted: Set[str] = set()
+        host: Set[str] = set()
+        # statement-ordered walk so assignments taint before uses
+        for node in self._ordered(fn):
+            if isinstance(node, ast.Assign):
+                self._track(ctx, node.value, node.targets, tainted, host)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if node.value is not None:
+                    self._track(ctx, node.value, [node.target], tainted,
+                                host)
+            if not isinstance(node, ast.Call):
+                continue
+            path = ctx.resolve_call(node.func)
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr == "item" and not node.args:
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"`.item()` in hot-path function '{fn.name}' "
+                        "forces a device→host sync per call")
+                    continue
+                if attr == "block_until_ready":
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"`.block_until_ready()` in hot-path function "
+                        f"'{fn.name}' blocks the dispatch queue")
+                    continue
+            if path == "jax.device_get":
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"`jax.device_get` in hot-path function '{fn.name}' "
+                    "forces a device→host sync")
+                continue
+            if path in _SYNC_CONVERTERS and node.args:
+                arg = node.args[0]
+                base = None
+                if isinstance(arg, ast.Name):
+                    base = arg.id
+                elif (isinstance(arg, ast.Subscript)
+                        and isinstance(arg.value, ast.Name)):
+                    base = arg.value.id
+                if base is not None and base in tainted and base not in host:
+                    label = path.split(".")[-1]
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"`{label}({base}…)` converts a device value to "
+                        f"host inside hot-path function '{fn.name}' — "
+                        "each conversion is a blocking sync")
+
+    # ---- taint tracking -------------------------------------------------
+    def _track(self, ctx, value, targets, tainted: Set[str],
+               host: Set[str]):
+        names = [leaf.id for t in targets for leaf in ast.walk(t)
+                 if isinstance(leaf, ast.Name)]
+        if not names:
+            return
+        is_device = False
+        if isinstance(value, ast.Call):
+            path = ctx.resolve_call(value.func)
+            is_device = not (
+                path in _HOST_BUILTINS
+                or any(path.startswith(p) for p in _HOST_PREFIXES))
+        elif isinstance(value, ast.Name):
+            is_device = value.id in tainted and value.id not in host
+        for n in names:
+            if is_device:
+                tainted.add(n)
+                host.discard(n)
+            else:
+                host.add(n)
+                tainted.discard(n)
+
+    def _ordered(self, fn):
+        """Depth-first, source-ordered traversal of the function body."""
+        out = []
+
+        def visit(node):
+            out.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fn.body:
+            visit(stmt)
+        return out

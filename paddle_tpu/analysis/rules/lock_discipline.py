@@ -1,0 +1,146 @@
+"""lock-discipline: lock-owning classes guard every shared-attr write.
+
+MetricsRegistry, Tracer, and the serving front-end mutate shared state
+from HTTP handler threads and the engine thread concurrently; the
+convention is one lock per owning class and every mutation under ``with
+self._lock``. The hazard this rule catches is the half-guarded
+attribute: written under the lock in one method and bare in another —
+the single pattern behind lost-update races (two interleaved
+read-modify-writes) and torn multi-field snapshots.
+
+A class "owns a lock" when a method assigns ``self.X =
+threading.Lock()/RLock()`` or ``__init__`` stores a lock-named
+parameter (``self._lock = lock`` — the shared-registry-lock idiom in
+observability/metrics.py). For each such class, instance-attribute
+writes (rebinds, augmented assigns, and subscript/attribute stores like
+``self._children[k] = v``) are classified as inside or outside a ``with
+self.<lock>`` block; an attribute with writes on BOTH sides is a
+finding. ``__init__``/``__new__`` writes don't count as off-lock — the
+object isn't shared during construction.
+
+Single-writer attributes (only ever written off-lock, e.g. a monotonic
+flag read lock-free on a hot path) are by design NOT findings: the rule
+targets mixed discipline, not lock-free design.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import Finding, ModuleContext, Rule, register_rule
+
+_LOCK_CALLS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_LOCK_NAME = re.compile(r"(^|_)r?lock$")
+_CTOR_METHODS = {"__init__", "__new__"}
+
+
+def _self_attr(node) -> str:
+    """'X' for a ``self.X`` attribute node, else ""."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+def _store_target_attr(target) -> str:
+    """The self-attribute a store mutates: ``self.X = ...``,
+    ``self.X[k] = ...``, ``self.X.y = ...`` all mutate X."""
+    node = target
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        name = _self_attr(node)
+        if name:
+            return name
+        node = node.value
+    return ""
+
+
+@register_rule
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    rationale = ("an attribute written both under and outside the owning "
+                 "lock is a lost-update race between threads")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(self, ctx: ModuleContext, cls) -> Iterable[Finding]:
+        lock_attrs = self._lock_attrs(ctx, cls)
+        if not lock_attrs:
+            return
+        # attr -> [(inside_lock, method, line)]
+        writes: Dict[str, List[Tuple[bool, str, int]]] = {}
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            self._scan_method(item, lock_attrs, writes)
+        for attr in sorted(writes):
+            if attr in lock_attrs:
+                continue
+            recs = writes[attr]
+            inside = [r for r in recs if r[0]]
+            outside = [r for r in recs
+                       if not r[0] and r[1] not in _CTOR_METHODS]
+            if inside and outside:
+                _, method, line = outside[0]
+                _, lmethod, lline = inside[0]
+                yield self.finding(
+                    ctx, line,
+                    f"attribute 'self.{attr}' of lock-owning class "
+                    f"'{cls.name}' is written off-lock in {method}() but "
+                    f"under the lock in {lmethod}() (line {lline}) — "
+                    "hold the lock for every write or split the state")
+
+    # ---- helpers --------------------------------------------------------
+    def _lock_attrs(self, ctx, cls) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                name = _self_attr(t)
+                if not name:
+                    continue
+                v = node.value
+                if (isinstance(v, ast.Call)
+                        and ctx.resolve_call(v.func) in _LOCK_CALLS):
+                    out.add(name)
+                elif (_LOCK_NAME.search(name)
+                        and isinstance(v, ast.Name)
+                        and _LOCK_NAME.search(v.id)):
+                    out.add(name)  # self._lock = lock (shared-lock idiom)
+        return out
+
+    def _scan_method(self, method, lock_attrs: Set[str],
+                     writes: Dict[str, List[Tuple[bool, str, int]]]):
+        def holds_lock(withnode) -> bool:
+            for item in withnode.items:
+                expr = item.context_expr
+                node = expr
+                while isinstance(node, ast.Attribute):
+                    if node.attr in lock_attrs:
+                        return True
+                    node = node.value
+            return False
+
+        def visit(node, locked: bool):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                locked = locked or holds_lock(node)
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    attr = _store_target_attr(t)
+                    if attr:
+                        writes.setdefault(attr, []).append(
+                            (locked, method.name, node.lineno))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not method:
+                return  # nested defs have their own self
+            for child in ast.iter_child_nodes(node):
+                visit(child, locked)
+
+        visit(method, False)

@@ -1,0 +1,192 @@
+"""op-schema: the declarative op table stays internally consistent.
+
+The reference's ops.yaml is validated by its generators at build time —
+a bad dtype list or a duplicate op name fails the build, not a user.
+Our ``OpDecl``/``Retrofit`` tables (paddle_tpu/ops/schema.py) are plain
+Python, so nothing stops a typo'd category, a dtype jax doesn't know, a
+differentiable op with no grad strategy, or two declarations silently
+shadowing one name (``register_retrofits`` skips names already in OPS —
+exactly the silent-drift case). This rule is the registration-time
+validator, plus a cross-check against the OpTest sweep enumeration
+(tests/test_op_suite.py): every declared op must be swept (OpSpec name
+or ``covers``), whitelisted with a reason, or carry a ``tested_by``
+pointer at a real test — statically, the same contract
+``test_registry_swept`` enforces at runtime.
+
+The validation core (``check_records``) is a pure function over the
+declaration records so fixture tests can feed known-bad tables without
+touching the real schema.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Callable, Iterable, List, Set, Tuple
+
+from ..core import Finding, ProjectRule, register_rule
+
+VALID_CATEGORIES = {
+    "math", "linalg", "manipulation", "creation", "nn", "signal",
+    "special", "random", "indexing", "fft",
+}
+VALID_DTYPES = {
+    "float32", "float64", "bfloat16", "float16",
+    "int8", "int16", "int32", "int64", "uint8", "bool",
+    "complex64", "complex128",
+}
+
+_SCHEMA_FILE = "paddle_tpu/ops/schema.py"
+_SWEEP_FILE = os.path.join("tests", "test_op_suite.py")
+_SPEC_CTORS = {"OpSpec", "U", "B", "RED"}
+
+
+def check_records(decls, retrofits,
+                  enumerated: Set[str],
+                  tested_by_ok: Callable[[str], bool]
+                  ) -> List[Tuple[str, str]]:
+    """Validate declaration records; returns (op-name, message) pairs.
+
+    ``decls``: objects with name/category/dtypes/differentiable/vjp/
+    n_outputs. ``retrofits``: objects with name/category/tested_by.
+    ``enumerated``: op names the sweep covers (spec names + covers +
+    whitelist). ``tested_by_ok(ref)``: does a tested_by pointer resolve.
+    """
+    problems: List[Tuple[str, str]] = []
+    seen: Set[str] = set()
+    for d in decls:
+        if d.name in seen:
+            problems.append((d.name, f"duplicate OpDecl name {d.name!r}"))
+        seen.add(d.name)
+        if d.category not in VALID_CATEGORIES:
+            problems.append((d.name, f"op {d.name!r}: unknown category "
+                             f"{d.category!r} (valid: "
+                             f"{sorted(VALID_CATEGORIES)})"))
+        bad = [t for t in d.dtypes if t not in VALID_DTYPES]
+        if bad:
+            problems.append((d.name,
+                             f"op {d.name!r}: unknown dtypes {bad}"))
+        if getattr(d, "n_outputs", 1) < 1:
+            problems.append((d.name, f"op {d.name!r}: n_outputs must be "
+                             ">= 1"))
+        if d.differentiable and not str(getattr(d, "vjp", "")).strip():
+            problems.append((d.name,
+                             f"op {d.name!r} is differentiable but "
+                             "declares no grad strategy (vjp)"))
+    for r in retrofits:
+        if r.name in seen:
+            problems.append((r.name,
+                             f"retrofit {r.name!r} shadows another "
+                             "declaration (register_retrofits silently "
+                             "skips names already registered)"))
+        seen.add(r.name)
+        if r.category not in VALID_CATEGORIES:
+            problems.append((r.name, f"retrofit {r.name!r}: unknown "
+                             f"category {r.category!r}"))
+        if r.tested_by and not tested_by_ok(r.tested_by):
+            problems.append((r.name,
+                             f"retrofit {r.name!r}: tested_by "
+                             f"{r.tested_by!r} does not point at an "
+                             "existing test"))
+
+    def covered(name: str, tested_by: str = "") -> bool:
+        if name in enumerated or name.rstrip("_") in enumerated:
+            return True
+        return bool(tested_by) and tested_by_ok(tested_by)
+
+    for d in decls:
+        if not covered(d.name):
+            problems.append((d.name,
+                             f"op {d.name!r} is not enumerated by the "
+                             "OpTest sweep (no OpSpec/covers/whitelist "
+                             "entry in tests/test_op_suite.py)"))
+    for r in retrofits:
+        if not covered(r.name, r.tested_by):
+            problems.append((r.name,
+                             f"retrofit {r.name!r} is not enumerated by "
+                             "the OpTest sweep and has no tested_by "
+                             "pointer"))
+    return problems
+
+
+def sweep_enumeration(sweep_path: str) -> Set[str]:
+    """Statically collect the op names tests/test_op_suite.py sweeps:
+    OpSpec/U/B/RED names, their ``covers`` tuples, and WHITELIST keys."""
+    with open(sweep_path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=sweep_path)
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in _SPEC_CTORS:
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                names.add(node.args[0].value.split(".")[-1])
+            for kw in node.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    names.add(str(kw.value.value).split(".")[-1])
+                if kw.arg == "covers" and isinstance(kw.value,
+                                                     (ast.Tuple, ast.List)):
+                    names.update(e.value for e in kw.value.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, str))
+        elif isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Name) and t.id == "WHITELIST"
+                   for t in node.targets) \
+                    and isinstance(node.value, ast.Dict):
+                names.update(k.value for k in node.value.keys
+                             if isinstance(k, ast.Constant)
+                             and isinstance(k.value, str))
+    return names
+
+
+def make_tested_by_checker(root: str) -> Callable[[str], bool]:
+    """``tests/test_x.py::test_y`` -> the file exists and defines the
+    test function (textual — no test import at lint time)."""
+
+    def ok(ref: str) -> bool:
+        if "::" not in ref:
+            return False
+        rel, test = ref.split("::", 1)
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            return False
+        with open(path, encoding="utf-8") as fh:
+            return bool(re.search(
+                rf"^def {re.escape(test)}\b", fh.read(), re.M))
+
+    return ok
+
+
+@register_rule
+class OpSchemaRule(ProjectRule):
+    id = "op-schema"
+    rationale = ("an invalid OpDecl/Retrofit (bad dtype/category, "
+                 "shadowed name, missing grad strategy, un-swept op) "
+                 "ships silently — the generators the reference had at "
+                 "build time")
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        import sys
+
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from paddle_tpu.ops import registry as _registry
+        from paddle_tpu.ops import schema as _schema
+
+        enumerated = sweep_enumeration(os.path.join(root, _SWEEP_FILE))
+        tested_ok = make_tested_by_checker(root)
+        problems = check_records(_schema.DECLS, _schema.RETROFITS,
+                                 enumerated, tested_ok)
+        # materialization check: every OpDecl must be live in the
+        # registry with its declaration attached (the generated-dispatch
+        # invariant — a decl that didn't materialize serves nothing)
+        for d in _schema.DECLS:
+            op = _registry.OPS.get(d.name)
+            if op is None or op.decl is not d:
+                problems.append((d.name,
+                                 f"op {d.name!r} declared in DECLS but "
+                                 "not materialized into ops.registry.OPS"))
+        for name, msg in problems:
+            yield Finding(file=_SCHEMA_FILE, line=1, rule=self.id,
+                          message=msg, symbol=name)

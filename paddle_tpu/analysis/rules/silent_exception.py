@@ -1,0 +1,70 @@
+"""silent-exception: broad handlers must not swallow errors invisibly.
+
+``except Exception: pass`` in a serving or distributed code path turns a
+real fault (a cancelled request that didn't cancel, a trace export that
+never happened, a store write that was lost) into silence — the failure
+mode that costs the most to debug because there is nothing to debug
+FROM. The fix hierarchy: narrow the exception type to what the code
+actually expects, or log through the rank-aware logger
+(``distributed.log_utils.get_logger``) so multihost lines stay
+attributable; a handler that is deliberately silent carries an inline
+``# pdlint: disable=silent-exception`` pragma with a comment saying why.
+
+Flagged: a handler catching a BROAD type (bare ``except``,
+``Exception``, ``BaseException`` — alone or in a tuple) whose body
+neither raises nor calls anything (no logging, no cleanup, no recovery —
+just ``pass``/constants/trivial assignments). Narrow handlers
+(``except queue.Empty: pass``) are legal: naming the exact exception IS
+the documentation.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Finding, ModuleContext, Rule, register_rule
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Attribute):
+        return type_node.attr in _BROAD
+    return False
+
+
+def _is_silent(body) -> bool:
+    """True when the handler neither raises nor calls anything — no log,
+    no cleanup, no recovery path."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Call, ast.Yield,
+                                 ast.YieldFrom, ast.Await)):
+                return False
+    return True
+
+
+@register_rule
+class SilentExceptionRule(Rule):
+    id = "silent-exception"
+    rationale = ("a broad except that neither logs nor re-raises makes "
+                 "real faults (cancel/trace/export failures) vanish")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node.type) and _is_silent(node.body):
+                caught = (ast.unparse(node.type) if node.type is not None
+                          else "<bare except>")
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"broad handler ({caught}) silently swallows the "
+                    "error — narrow the type or log via "
+                    "distributed.log_utils.get_logger()")

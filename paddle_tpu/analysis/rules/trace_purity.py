@@ -1,0 +1,150 @@
+"""trace-purity: jit-traced functions must be pure.
+
+XLA's correctness contract (and jax's) is that a traced function is a
+pure array program: side effects run ONCE at trace time and silently
+vanish from the compiled executable, wall-clock reads bake the
+trace-time value into the program as a constant, and host RNG
+(``random``/``np.random``) freezes one sample into every execution.
+Every ``jax.jit``/``pl.pallas_call`` target in this codebase (the engine
+scatter/prefill/decode steps, the Pallas kernels) must therefore avoid
+host side effects; this rule makes the convention machine-checked.
+
+Detected trace entry points:
+- ``@jax.jit`` (bare, or via ``functools.partial(jax.jit, ...)``)
+- ``jax.jit(fn, ...)`` where ``fn`` is a function defined in the module
+- ``pl.pallas_call(kernel, ...)`` — directly on a local def, or on a
+  name bound to ``functools.partial(kernel, ...)`` (the repo's idiom for
+  passing compile-time attrs into a kernel)
+
+Flagged inside a traced function (nested defs included — they execute at
+trace time):
+- calls into ``time.*``, stdlib ``random.*``, ``numpy.random.*``,
+  ``os.urandom``, ``print``, ``input``, ``open``
+- mutation of nonlocal/global state (a ``global``/``nonlocal``
+  declaration whose name is assigned in the traced body)
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from ..core import Finding, ModuleContext, Rule, register_rule
+
+_IMPURE_PREFIXES = (
+    "time.", "random.", "numpy.random.", "os.urandom",
+)
+_IMPURE_BUILTINS = {"print", "input", "open"}
+_JIT_CALLS = {"jax.jit", "jit"}
+_PALLAS_SUFFIX = ".pallas_call"
+_PARTIAL = {"functools.partial", "partial"}
+
+
+def _is_jit_path(path: str) -> bool:
+    return path in _JIT_CALLS or path.endswith(".jit") and path.startswith(
+        "jax")
+
+
+def _is_pallas_path(path: str) -> bool:
+    return path == "pallas_call" or path.endswith(_PALLAS_SUFFIX)
+
+
+@register_rule
+class TracePurityRule(Rule):
+    id = "trace-purity"
+    rationale = ("side effects inside jit/pallas-traced code run once at "
+                 "trace time and bake stale values into the compiled "
+                 "program")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        defs = self._collect_defs(ctx.tree)
+        partial_of = self._partial_bindings(ctx)
+        traced: Set[ast.AST] = set()
+
+        # decorator form
+        for fn in defs.values():
+            for dec in fn.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                path = ctx.resolve_call(target)
+                if _is_jit_path(path) or _is_pallas_path(path):
+                    traced.add(fn)
+                elif (isinstance(dec, ast.Call) and path in _PARTIAL
+                        and dec.args
+                        and _is_jit_path(ctx.resolve_call(dec.args[0]))):
+                    traced.add(fn)
+
+        # call form: jax.jit(fn, ...) / pl.pallas_call(kernel, ...)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            path = ctx.resolve_call(node.func)
+            if not (_is_jit_path(path) or _is_pallas_path(path)):
+                continue
+            first = node.args[0]
+            names: List[str] = []
+            if isinstance(first, ast.Name):
+                names.append(first.id)
+                names.extend(partial_of.get(first.id, ()))
+            elif (isinstance(first, ast.Call)
+                    and ctx.resolve_call(first.func) in _PARTIAL
+                    and first.args and isinstance(first.args[0], ast.Name)):
+                names.append(first.args[0].id)
+            for n in names:
+                if n in defs:
+                    traced.add(defs[n])
+
+        for fn in sorted(traced, key=lambda f: f.lineno):
+            yield from self._check_traced(ctx, fn)
+
+    # ---- helpers --------------------------------------------------------
+    def _collect_defs(self, tree) -> Dict[str, ast.AST]:
+        out: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.setdefault(node.name, node)
+        return out
+
+    def _partial_bindings(self, ctx: ModuleContext) -> Dict[str, List[str]]:
+        """name -> [kernel names] for ``k = functools.partial(fn, ...)``."""
+        out: Dict[str, List[str]] = {}
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and ctx.resolve_call(node.value.func) in _PARTIAL
+                    and node.value.args
+                    and isinstance(node.value.args[0], ast.Name)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.setdefault(t.id, []).append(
+                            node.value.args[0].id)
+        return out
+
+    def _check_traced(self, ctx: ModuleContext, fn) -> Iterable[Finding]:
+        assigned: Set[str] = set()
+        escaping: Dict[str, int] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                path = ctx.resolve_call(node.func)
+                if path in _IMPURE_BUILTINS or any(
+                        path == p.rstrip(".") or path.startswith(p)
+                        for p in _IMPURE_PREFIXES):
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"impure call {path}() inside jit/pallas-traced "
+                        f"function '{fn.name}' — runs at trace time, not "
+                        "per execution")
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                for name in node.names:
+                    escaping.setdefault(name, node.lineno)
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            assigned.add(leaf.id)
+        for name, line in sorted(escaping.items(), key=lambda kv: kv[1]):
+            if name in assigned:
+                yield self.finding(
+                    ctx, line,
+                    f"traced function '{fn.name}' mutates nonlocal/global "
+                    f"'{name}' — the write happens once at trace time")

@@ -666,7 +666,8 @@ def encdec_beam_generate(model, decode, step0, token0, self_c, cross_c,
         logp, holder["self"] = bstep(token.astype(jnp.int32),
                                      jnp.asarray(row_idx), holder["self"],
                                      cross_c)
-        return np.asarray(logp)
+        # beam scoring runs on host by design: ONE fetch per beam step
+        return np.asarray(logp)  # pdlint: disable=host-sync
 
     arr = beam_search_loop(logp0, step, max_new_tokens, K, eos_token_id,
                            length_penalty, early_stopping)
@@ -748,7 +749,8 @@ def _beam_search(model, last, caches, max_len, max_new_tokens,
     def step(token, row_idx):
         logp, holder["caches"] = step_fn(token, jnp.asarray(row_idx),
                                          holder["caches"])
-        return np.asarray(logp)
+        # beam scoring runs on host by design: ONE fetch per beam step
+        return np.asarray(logp)  # pdlint: disable=host-sync
 
     logp0 = np.asarray(jax.nn.log_softmax(last.astype(jnp.float32), axis=-1))
     arr = beam_search_loop(logp0, step, max_new_tokens, num_beams,

@@ -91,7 +91,7 @@ class StepTimer:
             from ..framework import device as _dev
 
             stats = _dev.memory_stats()
-        except Exception:  # no device backend (unit tests on bare CPU)
+        except Exception:  # pdlint: disable=silent-exception -- no device backend (bare-CPU unit tests); gauges fall back to 0
             stats = {}
         in_use = int(stats.get("bytes_in_use", 0))
         peak = int(stats.get("peak_bytes_in_use", in_use))
@@ -108,7 +108,7 @@ class StepTimer:
             from ..utils.flags import flag
 
             return bool(flag("FLAGS_log_memory_stats"))
-        except Exception:
+        except Exception:  # pdlint: disable=silent-exception -- flags module unavailable means the flag is unset
             return False
 
     @staticmethod

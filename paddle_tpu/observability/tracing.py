@@ -472,8 +472,13 @@ class Tracer:
                     events.append({
                         "name": name, "cat": typ, "ph": "X", "pid": pid,
                         "tid": tid, "ts": s_us, "dur": e_us - s_us})
-            except Exception:   # profiler unavailable: spans still export
-                pass
+            except Exception as e:
+                # profiler unavailable: the spans still export, but a
+                # silently thinner timeline would send someone hunting a
+                # phantom perf change — say what went missing and why
+                _logger().warning(
+                    "chrome trace export: profiler host events skipped "
+                    "(%s: %s)", type(e).__name__, e)
         trace = {"traceEvents": events, "displayTimeUnit": "ms"}
         if path is not None:
             with open(path, "w") as f:
@@ -486,6 +491,14 @@ class Tracer:
         (``{"spans": [...]}``) — one record correlates metrics and
         traces at a point in time."""
         return writer.write(extra={"spans": self.spans(trace_id)})
+
+
+def _logger():
+    """Rank-aware logger (lazy: distributed.log_utils reads env at
+    import, and tracing must stay import-light)."""
+    from ..distributed.log_utils import get_logger
+
+    return get_logger(name="paddle_tpu.observability")
 
 
 _TRACER = Tracer()

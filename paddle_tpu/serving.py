@@ -515,8 +515,10 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                                       temperature, top_k, top_p)
             nxt, logps, self._last, self._caches = step(
                 self._last, _random.next_key(), self._caches)
-        toks = np.asarray(nxt)
-        lps = np.asarray(logps)
+        # THE one deliberate device->host sync of the decode loop: every
+        # other host conversion below reads these already-fetched arrays
+        toks = np.asarray(nxt)    # pdlint: disable=host-sync
+        lps = np.asarray(logps)   # pdlint: disable=host-sync
         # np.asarray forced the device->host sync, so the span covers the
         # whole fused dispatch; ONE clock for every token this step
         # produced (they came from one dispatch)
@@ -581,7 +583,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                     cb(rid, t, done, lp)
                 else:
                     cb(rid, t, done)
-            except BaseException as e:  # noqa: BLE001 — deliberate collect
+            except BaseException as e:  # noqa: BLE001  # pdlint: disable=silent-exception -- collected, first one re-raised below
                 if first_exc is None:
                     first_exc = e
         if first_exc is not None:
@@ -1299,7 +1301,8 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         nxt, self._last, self._self_k, self._self_v = step(
             self._last, _random.next_key(), self._self_k, self._self_v,
             self._cross_k, self._cross_v, self._enc_mask, self._lengths)
-        toks = np.asarray(nxt)
+        # the seq2seq step's one deliberate device->host sync
+        toks = np.asarray(nxt)    # pdlint: disable=host-sync
         now = time.perf_counter()
         self._m_step.observe(now - t_dispatch)
         self._n_steps += 1

@@ -1,76 +1,38 @@
 #!/usr/bin/env python
 """Lint: the docs/SERVING.md metric catalog must match the registry.
 
-Every metric family registered at import of ``paddle_tpu.observability``
-must have a row in the "Metric catalog" table (name, kind, labels), and
-every documented row must correspond to a registered family — both
-directions, so a metric can neither ship undocumented nor linger in the
-docs after removal. Runs standalone (``python
-scripts/check_metrics_catalog.py``) and as a tier-1 test
-(tests/test_observability.py::test_metrics_catalog_lint).
+Thin wrapper — the check itself is the ``metrics-catalog`` pdlint rule
+(paddle_tpu/analysis/rules/catalogs.py), run by ``scripts/pdlint.py``
+and the tier-1 analysis gate; this entry point stays for muscle memory
+and for tests/test_observability.py::test_metrics_catalog_lint. Every
+registered metric family must have a docs row (name, kind, labels) and
+vice versa — both directions, so a metric can neither ship undocumented
+nor linger in the docs after removal.
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_DOCS = os.path.join(_REPO, "docs", "SERVING.md")
-
-# catalog rows look like: | `name` | kind | labels | meaning |
-_ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|\s*([a-z]+)\s*\|\s*([^|]*)\|")
-
-
-def documented_catalog(path: str = _DOCS) -> dict:
-    """{name: (kind, frozenset(labels))} parsed from the docs table."""
-    out = {}
-    with open(path) as f:
-        for line in f:
-            m = _ROW.match(line.strip())
-            if not m:
-                continue
-            name, kind, labels_cell = m.groups()
-            if kind not in ("counter", "gauge", "histogram"):
-                continue  # the stats()-mapping table, not the catalog
-            labels = frozenset(
-                l.strip() for l in labels_cell.split(",")
-                if l.strip() and l.strip() != "—")
-            out[name] = (kind, labels)
-    return out
-
-
-def registered_catalog() -> dict:
-    """{name: (kind, frozenset(labels))} from the live registry."""
-    sys.path.insert(0, _REPO)
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    from paddle_tpu.observability import get_registry
-
-    return {name: (d["kind"], frozenset(d["labels"]))
-            for name, d in get_registry().describe().items()}
 
 
 def main() -> int:
-    docs = documented_catalog()
-    reg = registered_catalog()
-    problems = []
-    for name in sorted(set(reg) - set(docs)):
-        problems.append(f"registered but not in docs/SERVING.md: {name}")
-    for name in sorted(set(docs) - set(reg)):
-        problems.append(f"documented but not registered: {name}")
-    for name in sorted(set(docs) & set(reg)):
-        if docs[name] != reg[name]:
-            problems.append(
-                f"schema drift for {name}: docs say "
-                f"{docs[name][0]}{sorted(docs[name][1])}, registry has "
-                f"{reg[name][0]}{sorted(reg[name][1])}")
+    sys.path.insert(0, _REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.analysis import project_rules
+
+    (rule,) = project_rules(["metrics-catalog"])
+    problems = list(rule.check_project(_REPO))
     if problems:
         print("metric catalog lint FAILED:", file=sys.stderr)
-        for p in problems:
-            print(f"  - {p}", file=sys.stderr)
+        for f in problems:
+            print(f"  - {f.message}", file=sys.stderr)
         return 1
-    print(f"metric catalog OK: {len(reg)} metrics documented and "
-          "registered")
+    from paddle_tpu.observability import get_registry
+
+    print(f"metric catalog OK: {len(get_registry().describe())} metrics "
+          "documented and registered")
     return 0
 
 

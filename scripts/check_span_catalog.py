@@ -1,96 +1,38 @@
 #!/usr/bin/env python
 """Lint: the docs/SERVING.md span catalog must match the tracer.
 
-The sibling of scripts/check_metrics_catalog.py for request-scoped
-tracing: every span name registered in
-``paddle_tpu.observability.tracing.SPAN_CATALOG`` must have a row in
-the "Span catalog" table, and every documented row must correspond to a
-registered name — both directions, so a span can neither ship
-undocumented nor linger in the docs after removal. It also asserts each
-registered name is actually EMITTED somewhere in paddle_tpu/ (via its
-``SPAN_*`` constant), so the catalog can't accumulate dead entries.
-Runs standalone and as a tier-1 test
-(tests/test_tracing.py::test_span_catalog_lint).
+Thin wrapper — the check itself is the ``span-catalog`` pdlint rule
+(paddle_tpu/analysis/rules/catalogs.py), run by ``scripts/pdlint.py``
+and the tier-1 analysis gate; this entry point stays for muscle memory
+and for tests/test_tracing.py::test_span_catalog_lint. Every name in
+``tracing.SPAN_CATALOG`` must have a docs row and vice versa, and every
+registered span's ``SPAN_*`` constant must be emitted somewhere outside
+tracing.py (no dead catalog entries).
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_DOCS = os.path.join(_REPO, "docs", "SERVING.md")
-
-# span rows look like: | `serving.request` | parent | meaning | — dots in
-# the name keep these rows invisible to the metric-catalog lint's regex
-_ROW = re.compile(r"^\|\s*`([a-z0-9_.]+)`\s*\|")
-
-
-def documented_spans(path: str = _DOCS) -> set:
-    """Span names parsed from the docs "Span catalog" table only."""
-    out = set()
-    in_section = False
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line.startswith("#"):
-                in_section = line.lstrip("#").strip() == "Span catalog"
-                continue
-            if not in_section:
-                continue
-            m = _ROW.match(line)
-            if m and m.group(1) != "span":
-                out.add(m.group(1))
-    return out
-
-
-def registered_spans() -> dict:
-    sys.path.insert(0, _REPO)
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    from paddle_tpu.observability import tracing
-
-    return dict(tracing.SPAN_CATALOG)
-
-
-def emitted_constants() -> set:
-    """SPAN_* constants referenced OUTSIDE tracing.py (the emit sites)."""
-    used = set()
-    pkg = os.path.join(_REPO, "paddle_tpu")
-    for dirpath, _, files in os.walk(pkg):
-        for fn in files:
-            if not fn.endswith(".py") or fn == "tracing.py":
-                continue
-            with open(os.path.join(dirpath, fn)) as f:
-                used.update(re.findall(r"\bSPAN_[A-Z_]+\b", f.read()))
-    return used
 
 
 def main() -> int:
-    docs = documented_spans()
-    reg = registered_spans()
-    problems = []
-    for name in sorted(set(reg) - docs):
-        problems.append(f"registered but not in docs/SERVING.md: {name}")
-    for name in sorted(docs - set(reg)):
-        problems.append(f"documented but not registered: {name}")
-    # every catalogued span must be emitted somewhere (constant usage)
     sys.path.insert(0, _REPO)
-    from paddle_tpu.observability import tracing
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.analysis import project_rules
 
-    used = emitted_constants()
-    for const, value in vars(tracing).items():
-        if (const.startswith("SPAN_") and isinstance(value, str)
-                and const != "SPAN_CATALOG" and const not in used):
-            problems.append(
-                f"span {value!r} ({const}) is registered but never "
-                "emitted outside tracing.py")
+    (rule,) = project_rules(["span-catalog"])
+    problems = list(rule.check_project(_REPO))
     if problems:
         print("span catalog lint FAILED:", file=sys.stderr)
-        for p in problems:
-            print(f"  - {p}", file=sys.stderr)
+        for f in problems:
+            print(f"  - {f.message}", file=sys.stderr)
         return 1
-    print(f"span catalog OK: {len(reg)} spans documented, registered, "
-          "and emitted")
+    from paddle_tpu.observability import tracing
+
+    print(f"span catalog OK: {len(tracing.SPAN_CATALOG)} spans "
+          "documented, registered, and emitted")
     return 0
 
 

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""pdlint CLI: run the framework-native static analyzer over the repo.
+
+Usage:
+    python scripts/pdlint.py                          # lint paddle_tpu/
+    python scripts/pdlint.py --json                   # JSON report
+    python scripts/pdlint.py --baseline .pdlint_baseline.json
+    python scripts/pdlint.py --write-baseline         # grandfather now
+    python scripts/pdlint.py --select silent-exception,host-sync
+    python scripts/pdlint.py --list-rules
+    python scripts/pdlint.py --no-project-rules paddle_tpu/serving.py
+
+Exit status: 0 when every finding is baselined (or none), 1 when any
+NEW finding exists — what tier-1 asserts
+(tests/test_static_analysis.py::test_pdlint_gate_zero_new_findings).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    sys.path.insert(0, _REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import baseline as bl
+    from paddle_tpu.analysis import report
+
+    p = argparse.ArgumentParser(prog="pdlint", description=__doc__)
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: paddle_tpu/)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the JSON report instead of text")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="suppress findings recorded in this baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to --baseline (or "
+                        ".pdlint_baseline.json) and exit 0")
+    p.add_argument("--select", default=None, metavar="IDS",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--no-project-rules", action="store_true",
+                   help="skip project rules (op-schema, catalog lints): "
+                        "AST rules only, no registry/docs cross-checks")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        analysis.ast_rules()  # force registration
+        for rid, rule in sorted(analysis.RULES.items()):
+            kind = ("project" if isinstance(rule, analysis.ProjectRule)
+                    else "ast")
+            print(f"{rid:18s} [{kind}]  {rule.rationale}")
+        return 0
+
+    selected = ([s.strip() for s in args.select.split(",")]
+                if args.select else None)
+    paths = [os.path.abspath(p_) for p_ in args.paths] or None
+    findings = analysis.run(paths=paths, root=_REPO, selected=selected,
+                            with_project_rules=not args.no_project_rules)
+
+    base_path = args.baseline or os.path.join(_REPO,
+                                              ".pdlint_baseline.json")
+    if args.write_baseline:
+        n = bl.save(base_path, findings)
+        print(f"pdlint: wrote {n} baselined finding(s) to "
+              f"{os.path.relpath(base_path, _REPO)}")
+        return 0
+
+    baselined = 0
+    if args.baseline:
+        known = bl.load(args.baseline)
+        new = bl.filter_new(findings, known)
+        baselined = len(findings) - len(new)
+        findings = new
+
+    out = (report.render_json(findings, baselined,
+                              rule_ids=sorted(analysis.RULES))
+           if args.as_json else report.render_text(findings, baselined))
+    print(out, end="" if args.as_json else "\n")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
