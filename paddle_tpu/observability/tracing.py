@@ -408,12 +408,34 @@ class Tracer:
         return sp.trace_id
 
     # ---- queries --------------------------------------------------------
-    def spans(self, trace_id: Optional[str] = None) -> List[dict]:
-        """Finished spans (oldest first), optionally one trace only."""
+    def spans(self, trace_id: Optional[str] = None,
+              include_live: bool = False) -> List[dict]:
+        """Finished spans (oldest first), optionally one trace only.
+
+        ``include_live=True`` appends snapshots of still-open spans
+        (``end_ns: None``, ``status: "in_flight"``) — a trace queried
+        while its request is mid-flight must not silently drop the
+        spans that haven't ended yet (e.g. the HTTP handler's
+        ``http.request`` span ends only after the response bytes are
+        written, so an immediate ``/trace`` query would race it)."""
         with self._lock:
             recs = list(self._buf)
+            live = list(self._live.values()) if include_live else []
         if trace_id is not None:
             recs = [r for r in recs if r["trace_id"] == trace_id]
+            live = [s for s in live if s.trace_id == trace_id]
+        for span in live:
+            recs.append({
+                "name": span.name,
+                "trace_id": span.trace_id,
+                "span_id": span.span_id,
+                "parent_id": span.parent_id,
+                "start_ns": span.start_ns,
+                "end_ns": None,
+                "tid": span.tid,
+                "status": "in_flight",
+                "attrs": dict(span.attrs),
+            })
         return recs
 
     def find_request_trace(self, rid: int,

@@ -271,9 +271,15 @@ class CompletionServer:
                             "error": "no trace: pass ?rid=<request id> "
                                      "(finished or in flight) or "
                                      "?trace_id=<32-hex id>"})
+                    # include_live: the POST handler's http.request span
+                    # ends only after its response bytes hit the socket,
+                    # so a caller chaining POST -> GET /trace would
+                    # otherwise race the handler thread and see a tree
+                    # missing its http.request node
                     return self._json(200, {
                         "trace_id": tid,
-                        "spans": server_self._tracer.spans(tid)})
+                        "spans": server_self._tracer.spans(
+                            tid, include_live=True)})
                 if route == "/trace/chrome":
                     # chrome://tracing download; unfiltered dumps merge
                     # the profiler's host events onto the same timeline

@@ -147,6 +147,32 @@ def test_ring_buffer_bounded():
     assert not tr._live  # ended spans left the live index
 
 
+def test_spans_include_live_snapshots(tracer):
+    """spans(include_live=True) snapshots still-open spans (end_ns None,
+    status in_flight); the default sticks to finished records — the
+    /trace endpoint must not drop a request's not-yet-ended spans."""
+    root = tracer.start_span("req.root", attrs={"rid": 7})
+    child = tracer.start_span("req.child", parent=root)
+    child.end()
+    tid = root.trace_id
+    assert [r["name"] for r in tracer.spans(tid)] == ["req.child"]
+    recs = {r["name"]: r for r in tracer.spans(tid, include_live=True)}
+    assert recs["req.child"]["status"] == "ok"
+    live = recs["req.root"]
+    assert live["status"] == "in_flight" and live["end_ns"] is None
+    assert live["span_id"] == root.span_id
+    assert live["attrs"] == {"rid": 7}
+    # other traces' live spans stay filtered out
+    other = tracer.start_span("other.root", trace_id="f" * 32)
+    assert "other.root" not in {
+        r["name"] for r in tracer.spans(tid, include_live=True)}
+    # once ended, the span appears exactly once (finished, not live too)
+    root.end()
+    other.end()
+    names = [r["name"] for r in tracer.spans(tid, include_live=True)]
+    assert sorted(names) == ["req.child", "req.root"]
+
+
 def test_disabled_is_noop():
     tr = tracing.Tracer()
     assert not tr.enabled
@@ -367,6 +393,27 @@ def test_http_inbound_traceparent_propagates(served):
     assert http_span["trace_id"] == tid
     assert root["trace_id"] == tid
     assert root["parent_id"] == http_span["span_id"]
+
+
+def test_trace_endpoint_includes_in_flight_spans(served):
+    """Regression: GET /trace must show a trace's still-open spans.
+    The POST handler's http.request span ends only after the response
+    bytes are written, so a caller chaining POST -> GET /trace races
+    the handler thread; serving it from the live index (end_ns null,
+    status in_flight) makes the tree complete either way."""
+    _, _, srv = served
+    tid = "a" * 31 + "b"
+    sp = tracing.get_tracer().start_span(
+        "http.request", trace_id=tid, attrs={"method": "POST"})
+    try:
+        status, data = _get(srv, f"/trace?trace_id={tid}")
+        assert status == 200
+        (rec,) = json.loads(data)["spans"]
+        assert rec["name"] == "http.request"
+        assert rec["span_id"] == sp.span_id
+        assert rec["status"] == "in_flight" and rec["end_ns"] is None
+    finally:
+        sp.end()
 
 
 def test_max_tokens_validated(served):
