@@ -3,9 +3,13 @@
 Machine-checks the conventions the TPU-native collapse traded the
 reference's generators for: trace purity, hot-path host-sync hygiene,
 lock discipline, silent-exception hygiene, op-schema consistency, and
-the metrics/span catalog contracts. See docs/ANALYSIS.md for the rule
-catalog and ``scripts/pdlint.py`` for the CLI; the tier-1 gate lives in
-tests/test_static_analysis.py.
+the metrics/span catalog contracts. The ``graph`` subpackage adds the
+second layer — jaxpr-level preflight rules (sharding, dtype promotion,
+retrace hazards, cost) that read the TRACED program instead of the
+source, run under ``pdlint --graph`` and ``Engine.preflight()``. See
+docs/ANALYSIS.md for the rule catalog and ``scripts/pdlint.py`` for the
+CLI; the tier-1 gates live in tests/test_static_analysis.py and
+tests/test_graph_analysis.py.
 """
 from . import baseline, report  # noqa: F401
 from .core import (  # noqa: F401

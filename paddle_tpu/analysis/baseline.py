@@ -13,12 +13,15 @@ The file is a plain sorted-JSON list so diffs review like code.
 """
 from __future__ import annotations
 
+import ast
 import json
+import os
 from typing import Dict, Iterable, List, Set, Tuple
 
 from .core import Finding
 
-__all__ = ["load", "save", "filter_new", "to_entries"]
+__all__ = ["load", "save", "filter_new", "to_entries", "load_entries",
+           "stale_entries"]
 
 _VERSION = 1
 _FIELDS = ("file", "rule", "symbol", "message")
@@ -61,3 +64,59 @@ def filter_new(findings: Iterable[Finding],
                baseline: Set[Key]) -> List[Finding]:
     """Findings whose key is NOT grandfathered (the ones that fail)."""
     return [f for f in findings if f.key() not in baseline]
+
+
+def load_entries(path: str) -> List[Dict[str, str]]:
+    """The raw entry dicts (``load`` collapses to keys; pruning needs
+    the fields)."""
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != _VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r}")
+    return list(data["findings"])
+
+
+def _symbols_in(path: str) -> Set[str]:
+    """Every def/class qualname a file defines (the ``symbol`` namespace
+    findings key on), plus "" for module level."""
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    out: Set[str] = {""}
+
+    def visit(node, qual):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+                out.add(q)
+                visit(child, q)
+            else:
+                visit(child, qual)
+
+    visit(tree, "")
+    return out
+
+
+def stale_entries(entries: Iterable[Dict[str, str]],
+                  root: str) -> List[Dict[str, str]]:
+    """Entries whose (file, symbol) no longer resolves: the file is gone,
+    unparsable, or no longer defines the symbol — dead weight that would
+    otherwise linger in the baseline forever. Graph-finding entries
+    (``<graph:...>``/``<preflight:...>`` pseudo-files) are never stale on
+    this test; they key on model+eqn, not source symbols."""
+    cache: Dict[str, Set[str]] = {}
+    out: List[Dict[str, str]] = []
+    for e in entries:
+        rel = e.get("file", "")
+        if rel.startswith("<"):
+            continue
+        path = os.path.join(root, rel)
+        if rel not in cache:
+            try:
+                cache[rel] = _symbols_in(path)
+            except (OSError, SyntaxError):
+                cache[rel] = set()   # gone or unparsable: all stale
+        if e.get("symbol", "") not in cache[rel]:
+            out.append(e)
+    return out

@@ -170,6 +170,10 @@ class Rule:
 
     id: str = ""
     rationale: str = ""
+    # graph rules trace model programs (expensive): excluded from default
+    # runs, included by ``run(graph=True)`` / ``pdlint --graph`` or by
+    # naming them in ``selected``
+    graph: bool = False
 
     def check(self, ctx: ModuleContext) -> Iterable[Finding]:
         raise NotImplementedError
@@ -215,12 +219,16 @@ def ast_rules(selected: Optional[Sequence[str]] = None) -> List[Rule]:
             and (selected is None or rid in selected)]
 
 
-def project_rules(selected: Optional[Sequence[str]] = None
-                  ) -> List[ProjectRule]:
+def project_rules(selected: Optional[Sequence[str]] = None,
+                  graph: bool = False) -> List[ProjectRule]:
+    """Graph rules run only when ``graph=True`` OR explicitly selected —
+    they trace model programs, and the default lint must stay instant."""
     _ensure_rules_loaded()
     return [r for rid, r in sorted(RULES.items())
             if isinstance(r, ProjectRule)
-            and (selected is None or rid in selected)]
+            and (selected is None or rid in selected)
+            and (graph or not r.graph or
+                 (selected is not None and rid in selected))]
 
 
 # ---- drivers ----------------------------------------------------------------
@@ -262,9 +270,11 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 
 def run(paths: Optional[Sequence[str]] = None, root: Optional[str] = None,
         selected: Optional[Sequence[str]] = None,
-        with_project_rules: bool = True) -> List[Finding]:
+        with_project_rules: bool = True,
+        graph: bool = False) -> List[Finding]:
     """Analyze ``paths`` (default: ``<root>/paddle_tpu``) and, unless
-    disabled, run the project rules against ``root``. Findings come back
+    disabled, run the project rules against ``root`` (graph rules only
+    with ``graph=True`` or when explicitly selected). Findings come back
     sorted by (file, line, rule)."""
     if root is None:
         root = os.path.dirname(os.path.dirname(
@@ -282,7 +292,7 @@ def run(paths: Optional[Sequence[str]] = None, root: Optional[str] = None,
                 line=e.lineno or 1, rule="parse-error",
                 message=f"could not parse: {e.msg}"))
     if with_project_rules:
-        for rule in project_rules(selected):
+        for rule in project_rules(selected, graph=graph):
             findings.extend(rule.check_project(root))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
     return findings

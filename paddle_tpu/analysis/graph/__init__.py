@@ -1,0 +1,26 @@
+"""paddle_tpu.analysis.graph — jaxpr-level preflight analysis.
+
+The second static-analysis layer: where pdlint's AST rules read Python
+source, these rules read the TRACED program (``jax.make_jaxpr`` over
+the model zoo's build functions) — sharding validity and propagation
+(graph-shard-spec), bf16→f32 upcasts (graph-dtype-promotion), jit-cache
+hazards (graph-retrace-hazard), byte/FLOP admission estimates
+(graph-preflight-cost), and OpDecl dtype honesty (graph-op-dtypes).
+
+Three surfaces: ``scripts/pdlint.py --graph``, ``Engine.preflight()``
+(serving.py, via :mod:`.preflight`), and the tier-1 zoo sweep
+(tests/test_graph_analysis.py). See docs/ANALYSIS.md "Graph rules".
+"""
+from . import cost, dtype_flow, op_dtypes, retrace, shard_spec, zoo  # noqa: F401
+from .preflight import (  # noqa: F401
+    PreflightError, PreflightReport, preflight_model,
+)
+from .trace import (  # noqa: F401
+    TracedGraph, iter_eqns, spec, trace_fn, trace_layer,
+)
+
+__all__ = [
+    "TracedGraph", "trace_fn", "trace_layer", "iter_eqns", "spec",
+    "PreflightError", "PreflightReport", "preflight_model",
+    "cost", "dtype_flow", "op_dtypes", "retrace", "shard_spec", "zoo",
+]

@@ -1,0 +1,125 @@
+"""preflight-cost: param/activation bytes and FLOPs from the jaxpr alone.
+
+The XLA fusion-analysis result (PAPERS.md) is that the traced graph
+carries enough structure for cost reasoning before any code is emitted;
+here that buys the serving property the reference got from its
+allocator dry-run: refuse a model that cannot fit BEFORE touching the
+device, with numbers in the refusal message.
+
+Estimates are deliberately coarse and deliberately *upper-bound-ish*:
+
+- ``param_bytes`` — exact (from the functional-state avals).
+- ``peak_activation_bytes`` — the widest single eqn's output bytes plus
+  its input bytes (XLA fuses aggressively, so liveness-accurate numbers
+  would require its buffer assignment; the widest-eqn bound is what the
+  admission decision needs).
+- ``flops`` — dot_general/conv as 2·M·N·K-style MACs, elementwise and
+  reductions as one FLOP per element. Good to ~2x, which is enough to
+  rank models and spot the accidental O(n²) at preflight.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+from .trace import TracedGraph, iter_eqns
+
+
+@dataclasses.dataclass
+class CostReport:
+    param_bytes: int = 0
+    peak_activation_bytes: int = 0
+    flops: int = 0
+    output_bytes: int = 0
+    eqns: int = 0
+
+    def total_resident_bytes(self) -> int:
+        """What must fit at once: weights + the widest live working set."""
+        return self.param_bytes + self.peak_activation_bytes
+
+    def as_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+def _nbytes(aval) -> int:
+    import jax.numpy as jnp
+
+    n = int(jnp.dtype(aval.dtype).itemsize)
+    for s in aval.shape:
+        n *= int(s)
+    return n
+
+
+def _numel(aval) -> int:
+    n = 1
+    for s in aval.shape:
+        n *= int(s)
+    return n
+
+
+def _dot_flops(eqn) -> int:
+    ((lc, _rc), (lb, _rb)) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval
+    out = eqn.outvars[0].aval
+    k = 1
+    for d in lc:
+        k *= int(lhs.shape[d])
+    return 2 * _numel(out) * k
+
+
+def _conv_flops(eqn) -> int:
+    rhs = eqn.invars[1].aval  # kernel
+    out = eqn.outvars[0].aval
+    per_out = 1
+    for s in rhs.shape[:-1] if len(rhs.shape) else ():
+        per_out *= int(s)
+    return 2 * _numel(out) * max(per_out, 1)
+
+
+def estimate(traced: TracedGraph) -> CostReport:
+    """Cost of one forward pass of the traced program."""
+    rep = CostReport(param_bytes=traced.param_bytes())
+    if not traced.ok:
+        return rep
+    cj = traced.closed_jaxpr
+    for aval in cj.out_avals:
+        if hasattr(aval, "shape"):
+            rep.output_bytes += _nbytes(aval)
+    for _path, eqn in iter_eqns(cj.jaxpr):
+        rep.eqns += 1
+        prim = eqn.primitive.name
+        outs = [v.aval for v in eqn.outvars if hasattr(v, "aval")]
+        ins = [v.aval for v in eqn.invars
+               if hasattr(v, "aval") and hasattr(v.aval, "shape")]
+        width = sum(_nbytes(a) for a in outs if hasattr(a, "shape")) + \
+            sum(_nbytes(a) for a in ins)
+        rep.peak_activation_bytes = max(rep.peak_activation_bytes, width)
+        if prim == "dot_general":
+            rep.flops += _dot_flops(eqn)
+        elif prim.startswith("conv_general"):
+            rep.flops += _conv_flops(eqn)
+        elif prim in ("pjit", "custom_vjp_call_jaxpr", "custom_jvp_call",
+                      "custom_vjp_call", "scan", "while", "cond"):
+            continue  # inner eqns are walked by iter_eqns themselves
+        else:
+            rep.flops += sum(_numel(a) for a in outs if hasattr(a, "shape"))
+    return rep
+
+
+def kv_cache_bytes(config: Any, max_batch: int, max_len: int) -> int:
+    """Decode-cache footprint for a served causal LM config (the paged
+    pool serving.py allocates): layers · 2 (K+V) · heads_kv · max_batch ·
+    max_len · head_dim · itemsize. Families without the fields return 0
+    (their engines size caches differently)."""
+    import jax.numpy as jnp
+
+    try:
+        layers = int(config.num_hidden_layers)
+        hk = int(config.num_key_value_heads)
+        from ...models.llama import head_dim_of
+
+        d = int(head_dim_of(config))
+        itemsize = int(jnp.dtype(config.dtype).itemsize)
+    except (AttributeError, TypeError):
+        return 0
+    return layers * 2 * hk * max_batch * max_len * d * itemsize

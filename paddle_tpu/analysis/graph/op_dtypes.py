@@ -1,0 +1,99 @@
+"""OpDecl.dtypes honesty: claimed dtype lists vs eval_shape reality.
+
+``OpDecl.dtypes`` is the ops.yaml dtype table analog, but nothing ever
+executed it — a decl could claim bfloat16 while its impl upcasts every
+bf16 input to float32 (jsp.special routines do), or claim float16 while
+the impl outright rejects it. The check is the same mechanism
+``infer_meta`` uses (ops/schema.py: jax.eval_shape of the registered
+impl): abstractly evaluate the impl at each claimed dtype and compare
+the output dtype.
+
+Signature discovery: the impl is probed at float32 (always claimed,
+always expected to work) over a small signature grid — 1..3 array
+operands, square-matrix then vector shapes, then a tensor-list operand
+(the add_n family). If nothing evaluates, the decl is skipped —
+unverifiable-cheaply is not a finding. With a working signature, each
+claimed dtype either evaluates (and its output dtype is compared) or
+raises (a rejected claim).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+# square first (keeps matmul-shaped binaries evaluable), vector second
+# (1-D-only signal ops); "list" probes a tensor-list operand
+_PROBE_SHAPES = ((4, 4), (8,))
+
+# float widths for upcast detection; int/bool outputs are never upcasts
+# (comparisons, argmax and friends legitimately change kind)
+_FLOAT_ORDER = {"bfloat16": 1, "float16": 1, "float32": 2, "float64": 3}
+
+
+def _eval(impl, dtype: str, sig):
+    import jax
+    import jax.numpy as jnp
+
+    from ...framework import random as _random
+
+    arity, shape, as_list = sig
+    specs = [jax.ShapeDtypeStruct(shape, jnp.dtype(dtype))
+             for _ in range(arity)]
+    # stateful-RNG impls (top_p_sampling) call next_key(); probe under a
+    # concrete context key and restore the global state — otherwise the
+    # abstract eval leaks a tracer into the process RNG
+    prev = _random.get_rng_state()
+    try:
+        with _random.rng_context(jax.random.key(0)):
+            if as_list:
+                return jax.eval_shape(impl, specs)
+            return jax.eval_shape(impl, *specs)
+    finally:
+        _random.set_rng_state(prev)
+
+
+def _working_signature(impl) -> Optional[tuple]:
+    for shape in _PROBE_SHAPES:
+        for arity in (1, 2, 3):
+            for as_list in (False, True) if arity == 2 else (False,):
+                sig = (arity, shape, as_list)
+                try:
+                    _eval(impl, "float32", sig)
+                    return sig
+                except Exception:  # pdlint: disable=silent-exception -- probe grid: a non-matching signature is the expected miss
+                    continue
+    return None
+
+
+def check_decl_dtypes(decls) -> List[Tuple[str, str]]:
+    """Returns (op-name, message) pairs for dtype-list lies."""
+    import jax
+
+    problems: List[Tuple[str, str]] = []
+    for d in decls:
+        impl = getattr(d, "impl", None)
+        if impl is None:
+            continue
+        sig = _working_signature(impl)
+        if sig is None:
+            continue
+        for dt in d.dtypes:
+            try:
+                out = _eval(impl, dt, sig)
+            except Exception as e:
+                problems.append((d.name,
+                                 f"op {d.name!r} claims dtype {dt!r} but "
+                                 f"its impl rejects it "
+                                 f"({type(e).__name__})"))
+                continue
+            leaves = jax.tree_util.tree_leaves(out)
+            if not leaves or dt not in _FLOAT_ORDER:
+                continue
+            out_dt = str(leaves[0].dtype)
+            if out_dt in _FLOAT_ORDER and \
+                    _FLOAT_ORDER[out_dt] > _FLOAT_ORDER[dt]:
+                problems.append((d.name,
+                                 f"op {d.name!r} claims dtype {dt!r} but "
+                                 f"its impl upcasts to {out_dt} — the "
+                                 "decl advertises support the kernel "
+                                 "doesn't keep"))
+    return problems

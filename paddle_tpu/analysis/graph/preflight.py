@@ -1,0 +1,166 @@
+"""Serving preflight: shard-spec + dtype + cost at model-load.
+
+``Engine.preflight()`` (serving.py) calls this before any buffer is
+allocated or step compiled: trace the model abstractly, validate the
+sharding annotations it carries, scan for dtype upcasts, and bound the
+memory footprint — then refuse with a STRUCTURED findings report
+instead of letting XLA crash minutes into compilation. The report
+reuses pdlint's ``Finding`` type so the same text/JSON reporters render
+it.
+
+Severity model: shard-spec violations, untraceable models, and budget
+overruns are ``fatal`` (the engine would crash or OOM); dtype upcasts
+are advisory (wrong-but-running). ``PreflightError`` carries the report.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ..core import Finding
+from . import cost as _cost
+from . import dtype_flow, retrace, shard_spec
+from .trace import TracedGraph, spec, trace_layer
+
+FATAL_RULES = ("graph-shard-spec", "graph-retrace-hazard",
+               "graph-preflight-cost")
+
+
+@dataclasses.dataclass
+class PreflightReport:
+    model: str
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    cost: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    @property
+    def fatal(self) -> List[Finding]:
+        return [f for f in self.findings if f.rule in FATAL_RULES]
+
+    @property
+    def ok(self) -> bool:
+        return not self.fatal
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines.append(f"preflight {self.model}: "
+                     f"{len(self.fatal)} fatal / "
+                     f"{len(self.findings)} finding(s), "
+                     f"cost={self.cost}")
+        return "\n".join(lines)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "model": self.model,
+            "ok": self.ok,
+            "cost": dict(self.cost),
+            "findings": [
+                {"rule": f.rule, "symbol": f.symbol, "message": f.message,
+                 "fatal": f.rule in FATAL_RULES}
+                for f in self.findings
+            ],
+        }
+
+
+class PreflightError(RuntimeError):
+    """Raised by Engine.preflight on fatal findings; ``.report`` holds
+    the structured PreflightReport."""
+
+    def __init__(self, report: PreflightReport):
+        super().__init__(
+            f"preflight rejected {report.model}:\n{report.render()}")
+        self.report = report
+
+
+def _collect_param_placements(model) -> Dict[str, tuple]:
+    """Placements already attached to parameters via dist.shard_tensor
+    (``_dist_attr``) -> {param_name: (mesh, placements)}."""
+    out = {}
+    for name, p in getattr(model, "named_parameters", lambda: [])():
+        attr = getattr(p, "_dist_attr", None)
+        if attr is not None:
+            out[name] = (attr.mesh, attr.placements)
+    return out
+
+
+def preflight_model(model, *, batch: int = 1, seq_len: int = 16,
+                    mesh=None, param_specs: Optional[Dict] = None,
+                    budget_bytes: Optional[int] = None,
+                    kv_cache_bytes: int = 0,
+                    allow_upcast=(),) -> PreflightReport:
+    """Run the three preflight layers over a live model.
+
+    ``mesh`` + ``param_specs`` ({name-substring: PartitionSpec tuple})
+    validate an EXPLICIT layout; independently, placements already
+    attached to parameters (``dist.shard_tensor``) are validated against
+    their own meshes. ``budget_bytes`` (device HBM available to this
+    model) turns the cost estimate into an admission decision;
+    ``kv_cache_bytes`` is added by the serving engine for its pool.
+    """
+    name = type(model).__name__
+    report = PreflightReport(model=name)
+    file = f"<preflight:{name}>"
+
+    import jax.numpy as jnp
+
+    ids = spec((batch, seq_len), jnp.int32)
+    traced = trace_layer(model, ids, name=name)
+    if traced.error is not None:
+        for key, msg in retrace.find_hazards(traced):
+            report.findings.append(Finding(
+                file=file, line=1, rule="graph-retrace-hazard",
+                message=msg, symbol=key))
+        return report
+
+    # ---- shard-spec ---------------------------------------------------------
+    if mesh is not None and param_specs:
+        axis_sizes = dict(zip(mesh.dim_names, mesh.shape))
+        for pname in traced.param_names:
+            aval = traced.param_avals[pname]
+            for pat, sp in param_specs.items():
+                if pat in pname:
+                    for msg in shard_spec.check_partition_spec(
+                            sp, axis_sizes, aval.shape,
+                            what=f"param {pname}"):
+                        report.findings.append(Finding(
+                            file=file, line=1, rule="graph-shard-spec",
+                            message=msg, symbol=pname))
+                    break
+    for pname, (pmesh, placements) in _collect_param_placements(
+            model).items():
+        arr_shape = traced.param_avals.get(pname)
+        if arr_shape is None:
+            continue
+        for msg in shard_spec.check_placements(
+                placements, pmesh, arr_shape.shape, what=f"param {pname}"):
+            report.findings.append(Finding(
+                file=file, line=1, rule="graph-shard-spec",
+                message=msg, symbol=pname))
+
+    # ---- dtype --------------------------------------------------------------
+    for up in dtype_flow.find_upcasts(traced, allow=allow_upcast):
+        report.findings.append(Finding(
+            file=file, line=1, rule="graph-dtype-promotion",
+            message=up.message(), symbol=f"{up.primitive}@{up.eqn_path}"))
+
+    # ---- retrace hazards (baked consts) -------------------------------------
+    for key, msg in retrace.find_hazards(traced):
+        report.findings.append(Finding(
+            file=file, line=1, rule="graph-retrace-hazard",
+            message=msg, symbol=key))
+
+    # ---- cost ---------------------------------------------------------------
+    rep = _cost.estimate(traced)
+    report.cost = rep.as_dict()
+    report.cost["kv_cache_bytes"] = int(kv_cache_bytes)
+    resident = rep.total_resident_bytes() + int(kv_cache_bytes)
+    report.cost["resident_bytes"] = resident
+    if budget_bytes is not None and resident > budget_bytes:
+        report.findings.append(Finding(
+            file=file, line=1, rule="graph-preflight-cost",
+            message=(f"model needs ~{resident} resident bytes "
+                     f"(params {rep.param_bytes} + peak activations "
+                     f"{rep.peak_activation_bytes} + kv cache "
+                     f"{int(kv_cache_bytes)}) but the budget is "
+                     f"{int(budget_bytes)} — refuse before compile"),
+            symbol="resident-bytes"))
+    return report

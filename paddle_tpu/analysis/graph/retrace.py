@@ -1,0 +1,103 @@
+"""retrace-hazard analysis: what defeats the jit cache or the trace.
+
+Three hazard classes, all invisible until a production step mysteriously
+recompiles (or never compiles):
+
+1. **Data-dependent output shape** — ``jnp.nonzero``/``unique``/boolean
+   masking make the output shape a function of VALUES; jax cannot trace
+   them abstractly and raises mid-compile. The harness captures that
+   exception (``TracedGraph.error``) and this module classifies it into
+   a finding instead of a crash.
+2. **Baked closure constants** — an array captured from the enclosing
+   scope is burned into EVERY specialization as a const: a weak-typed
+   scalar means a Python number got closed over (change it and the trace
+   silently keeps the stale value — or, via static args, retraces); a
+   large captured array multiplies its bytes by the number of compiled
+   shape buckets.
+3. **Live specialization blow-up** — the runtime half: StaticFunction
+   (paddle_tpu/jit) counts compiled specializations per callable, and
+   ``live_specialization_findings`` turns any count above threshold into
+   a finding. Wired to the same hook ``jit.set_verbosity`` uses.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .trace import TracedGraph
+
+# substrings that identify jax's value-dependence failure modes across
+# versions (ConcretizationTypeError and friends render differently)
+_DATA_DEP_MARKERS = (
+    "Abstract tracer value encountered",
+    "must be statically specified",
+    "data-dependent",
+    "NonConcreteBooleanIndex",
+    "Shapes must be 1D sequences of concrete values",
+    "TracerBoolConversionError",
+    "truth value of an array",
+    "concrete value",
+)
+
+LARGE_CONST_BYTES = 1 << 20  # 1 MiB baked per specialization
+
+
+def classify_trace_error(err: BaseException) -> str:
+    """'data-dependent' | 'other' — the retrace rule reports the first,
+    re-renders the second as a generic trace failure."""
+    text = f"{type(err).__name__}: {err}"
+    if any(m in text for m in _DATA_DEP_MARKERS):
+        return "data-dependent"
+    return "other"
+
+
+def find_hazards(traced: TracedGraph,
+                 large_const_bytes: int = LARGE_CONST_BYTES
+                 ) -> List[Tuple[str, str]]:
+    """Returns (key, message) pairs. ``key`` is stable for baselining:
+    'trace-error', or 'const<N>' for the N-th hazardous constant."""
+    out: List[Tuple[str, str]] = []
+    if traced.error is not None:
+        kind = classify_trace_error(traced.error)
+        if kind == "data-dependent":
+            out.append(("trace-error",
+                        "data-dependent output shape: the program cannot "
+                        "be traced abstractly and every distinct input "
+                        "VALUE would recompile — use a static size= / "
+                        "mask instead "
+                        f"({type(traced.error).__name__})"))
+        else:
+            out.append(("trace-error",
+                        f"model does not trace: "
+                        f"{type(traced.error).__name__}: "
+                        f"{str(traced.error).splitlines()[0][:160]}"))
+        return out
+    cj = traced.closed_jaxpr
+    for i, (var, val) in enumerate(zip(cj.jaxpr.constvars, cj.consts)):
+        aval = var.aval
+        if getattr(aval, "weak_type", False) and aval.shape == ():
+            out.append((f"const{i}",
+                        "weak-typed scalar constant baked at trace time "
+                        "— a Python number closed over the traced "
+                        "function; pass it as an argument or it freezes "
+                        "at its trace-time value"))
+            continue
+        nbytes = getattr(val, "nbytes", 0)
+        if nbytes >= large_const_bytes:
+            out.append((f"const{i}",
+                        f"captured constant ({int(nbytes)} bytes, shape "
+                        f"{tuple(aval.shape)}) is baked into every "
+                        "specialization — thread it through as an input "
+                        "so shape buckets share one copy"))
+    return out
+
+
+def live_specialization_findings(threshold: int = 8
+                                 ) -> List[Tuple[str, int]]:
+    """Consult the jit compile-cache statistics: StaticFunctions whose
+    specialization count crossed ``threshold`` (the shape-bucketing
+    contract says a serving step compiles a handful of buckets, not one
+    per request). Returns (name, count) pairs."""
+    from ...jit import specialization_stats
+
+    return [(name, n) for name, n in sorted(specialization_stats().items())
+            if n >= threshold]

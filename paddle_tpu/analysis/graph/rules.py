@@ -1,0 +1,170 @@
+"""The graph rules: jaxpr-level preflight checks in the pdlint registry.
+
+These are ``ProjectRule``s with ``graph = True`` — they trace models
+(hundreds of ms each, memoized per run), so they run only under
+``scripts/pdlint.py --graph`` (or when selected explicitly), keeping the
+default AST lint instant. Findings key on model+eqn
+(``file="<graph:llama>"``, ``symbol="dot_general@14"``) so the baseline
+machinery works unchanged for graph findings.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterable, List
+
+from ..core import Finding, ProjectRule, register_rule
+from . import cost as _cost
+from . import dtype_flow, op_dtypes, retrace, shard_spec, zoo
+
+_SCHEMA_FILE = "paddle_tpu/ops/schema.py"
+
+
+def _graph_file(model_name: str) -> str:
+    return f"<graph:{model_name}>"
+
+
+def _full_sweep() -> bool:
+    """Zoo scope: the fast 4-family set by default; PDLINT_GRAPH_SCOPE=
+    full widens to the whole zoo (the slow-marked sweep)."""
+    return os.environ.get("PDLINT_GRAPH_SCOPE", "") == "full"
+
+
+class GraphRule(ProjectRule):
+    """A project rule that traces programs; opt-in via --graph."""
+
+    graph = True
+
+
+@register_rule
+class ShardSpecRule(GraphRule):
+    id = "graph-shard-spec"
+    rationale = ("an invalid PartitionSpec (unknown axis, indivisible "
+                 "dim, double-sharded axis) or an implicit reshard on "
+                 "the step path surfaces as an opaque XLA crash or a "
+                 "silent all-to-all tax — both decidable before compile "
+                 "(GSPMD)")
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        full = _full_sweep()
+        for e in zoo.entries(full=full):
+            if e.shard is None:
+                continue
+            t = zoo.traced(e.name, full=full)
+            file = _graph_file(e.name)
+            if not t.ok:
+                continue  # the retrace rule owns trace failures
+            in_specs = {}
+            for name in t.param_names:
+                aval = t.param_avals[name]
+                sp = e.shard.spec_for(name, len(aval.shape))
+                if sp is None:
+                    continue
+                for msg in shard_spec.check_partition_spec(
+                        sp, e.shard.axis_sizes, aval.shape,
+                        what=f"param {name}"):
+                    yield Finding(file=file, line=1, rule=self.id,
+                                  message=msg, symbol=name)
+                in_specs[t.invar_index_of_param(name)] = \
+                    shard_spec.normalize_spec(sp, len(aval.shape))
+            for path, prim, msg in shard_spec.propagate(
+                    t, in_specs, e.shard.axis_sizes):
+                yield Finding(file=file, line=1, rule=self.id,
+                              message=f"implicit reshard: {msg}",
+                              symbol=f"{prim}@{path}")
+        # OpDecl.spmd notes vs observed eval_shape behavior — the
+        # propagation walk trusts those notes, so lies here mis-shard
+        from paddle_tpu.ops import schema as _schema
+
+        for name, msg in shard_spec.check_spmd_notes(_schema.DECLS):
+            yield Finding(file=_SCHEMA_FILE, line=1, rule=self.id,
+                          message=msg, symbol=name)
+
+
+@register_rule
+class DtypePromotionRule(GraphRule):
+    id = "graph-dtype-promotion"
+    rationale = ("a bf16-built model silently computing islands in f32 "
+                 "(weak-typed constants, dtype= reductions) doubles "
+                 "activation bytes with no accuracy contract — visible "
+                 "only at jaxpr level")
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        full = _full_sweep()
+        for e in zoo.entries(full=full):
+            if e.shard is not None:
+                continue  # sharded twin re-traces the same program
+            t = zoo.traced(e.name, full=full)
+            if not t.ok:
+                continue
+            for up in dtype_flow.find_upcasts(t, allow=e.allow_upcast):
+                yield Finding(file=_graph_file(e.name), line=1,
+                              rule=self.id, message=up.message(),
+                              symbol=f"{up.primitive}@{up.eqn_path}")
+
+
+@register_rule
+class RetraceHazardRule(GraphRule):
+    id = "graph-retrace-hazard"
+    rationale = ("data-dependent shapes and baked closure constants "
+                 "defeat the jit cache — every production step "
+                 "recompiles (or never compiles) where the trace could "
+                 "have said so upfront")
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        full = _full_sweep()
+        for e in zoo.entries(full=full):
+            if e.shard is not None:
+                continue
+            t = zoo.traced(e.name, full=full)
+            for key, msg in retrace.find_hazards(t):
+                yield Finding(file=_graph_file(e.name), line=1,
+                              rule=self.id, message=msg, symbol=key)
+
+
+@register_rule
+class PreflightCostRule(GraphRule):
+    id = "graph-preflight-cost"
+    rationale = ("serving admission must know param/activation bytes "
+                 "and FLOPs before touching the device — a family whose "
+                 "cost cannot be estimated cannot be preflighted")
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        full = _full_sweep()
+        for e in zoo.entries(full=full):
+            if e.shard is not None:
+                continue
+            t = zoo.traced(e.name, full=full)
+            if not t.ok:
+                continue
+            rep = _cost.estimate(t)
+            file = _graph_file(e.name)
+            if rep.param_bytes <= 0:
+                yield Finding(file=file, line=1, rule=self.id,
+                              message="param byte estimate is zero — "
+                              "the functional state carries no avals",
+                              symbol="param-bytes")
+            if rep.flops <= 0:
+                yield Finding(file=file, line=1, rule=self.id,
+                              message="FLOP estimate is zero — the "
+                              "traced program has no costed eqns",
+                              symbol="flops")
+
+
+@register_rule
+class OpDtypesRule(GraphRule):
+    id = "graph-op-dtypes"
+    rationale = ("an OpDecl claiming a dtype its impl upcasts or "
+                 "rejects advertises support the kernel doesn't keep — "
+                 "checkable by the same eval_shape path infer_meta uses")
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        import sys
+
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from paddle_tpu.ops import schema as _schema
+
+        for name, msg in op_dtypes.check_decl_dtypes(_schema.DECLS):
+            yield Finding(file=_SCHEMA_FILE, line=1, rule=self.id,
+                          message=msg, symbol=name)

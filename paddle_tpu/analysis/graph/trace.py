@@ -1,0 +1,166 @@
+"""The tracer harness: lift a Layer (or bare function) into a jaxpr.
+
+pdlint's AST rules see Python source; the bugs that actually burn TPU
+time live in the *traced program* — that is where dtype promotion
+happens, where a sharded dim meets a reshape, where a closure constant
+gets baked into every specialization. GSPMD (PAPERS.md) decides sharding
+entirely from the annotated program before execution, and the XLA fusion
+analysis paper reasons at the same granularity; ``TracedGraph`` is the
+carrier both use here: the closed jaxpr plus everything the graph rules
+need to key findings stably (parameter-name order, const avals, byte
+sizes).
+
+Tracing is ABSTRACT (``jax.make_jaxpr`` over ShapeDtypeStructs): no
+FLOP executes, no buffer allocates, so a 70B-config model preflights in
+the time it takes to trace — exactly the InferMeta-style gate the
+TPU-native collapse dropped when ops/schema.py moved shape inference
+into evaluation.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class TracedGraph:
+    """One traced program + the metadata graph rules key findings on.
+
+    ``param_names`` aligns 1:1 with the leading jaxpr invars (the
+    flattened functional state), then one rng-key invar, then the data
+    inputs — ``invar_spec_slots()`` exposes that layout so shard specs
+    given per parameter NAME map onto invars without guessing.
+    ``error`` is set (and ``closed_jaxpr`` None) when tracing raised —
+    the retrace-hazard rule classifies those instead of crashing the
+    lint run.
+    """
+
+    name: str
+    closed_jaxpr: Optional[Any] = None
+    param_names: List[str] = dataclasses.field(default_factory=list)
+    param_avals: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    n_data_inputs: int = 0
+    error: Optional[BaseException] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.closed_jaxpr is not None
+
+    def param_bytes(self) -> int:
+        return sum(int(jnp.dtype(a.dtype).itemsize) * _size(a.shape)
+                   for a in self.param_avals.values())
+
+    def invar_index_of_param(self, name: str) -> int:
+        """Index into ``closed_jaxpr.jaxpr.invars`` for a parameter name
+        (state leaves flatten in sorted-key order — dict pytrees)."""
+        return self.param_names.index(name)
+
+    def data_invars(self):
+        """The invars carrying the data inputs (after state + rng key)."""
+        return self.closed_jaxpr.jaxpr.invars[len(self.param_names) + 1:]
+
+
+def _size(shape) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def spec(shape, dtype) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+def trace_fn(fn: Callable, *arg_specs, name: str = "") -> TracedGraph:
+    """Trace a bare function over abstract inputs (the fixture entry
+    point). A trace-time exception is captured, not raised."""
+    name = name or getattr(fn, "__name__", "<fn>")
+    try:
+        cj = jax.make_jaxpr(fn)(*arg_specs)
+    except Exception as e:  # classified by the retrace-hazard rule
+        return TracedGraph(name=name, error=e,
+                           n_data_inputs=len(arg_specs))
+    return TracedGraph(name=name, closed_jaxpr=cj,
+                       n_data_inputs=len(arg_specs))
+
+
+def trace_layer(layer, *arg_specs, name: str = "",
+                method: Optional[str] = None) -> TracedGraph:
+    """Trace a Layer's forward (or ``method``) into a jaxpr.
+
+    Mirrors the StaticFunction pure wrapper (jit/__init__.py): the
+    functional state rides as the first traced input (so params are
+    invars, not baked consts), the rng key as the second, and the layer
+    is put in eval mode for the duration — dropout branches must not
+    differ between the preflighted program and the served one.
+    """
+    from ...autograd import tape as _tape
+    from ...framework import random as _random
+    from ...nn.layer import functional_weights
+    from ...tensor_class import Tensor, wrap
+
+    name = name or type(layer).__name__
+    state = layer.functional_state()
+    fn = getattr(layer, method) if method else layer.forward
+
+    def pure(state_arrs, rng_key, *xs):
+        subs = layer.sublayers(include_self=True)
+        prev_modes = [l.training for l in subs]
+        for l in subs:
+            l.training = False
+        try:
+            with functional_weights(layer, state_arrs), \
+                    _random.rng_context(rng_key):
+                out = fn(*[wrap(x) for x in xs])
+            return jax.tree_util.tree_map(
+                lambda x: x._array if isinstance(x, Tensor) else x, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+        finally:
+            for l, m in zip(subs, prev_modes):
+                l.training = m
+
+    state_specs = {k: spec(v.shape, v.dtype) for k, v in state.items()}
+    # dict pytrees flatten in sorted-key order — the invar <-> name map
+    param_names = sorted(state_specs)
+    key_spec = spec((2,), jnp.uint32)
+    prev = _tape.set_grad_enabled(False)
+    try:
+        cj = jax.make_jaxpr(pure)(state_specs, key_spec, *arg_specs)
+    except Exception as e:
+        return TracedGraph(name=name, error=e, param_names=param_names,
+                           param_avals=state_specs,
+                           n_data_inputs=len(arg_specs))
+    finally:
+        _tape.set_grad_enabled(prev)
+    return TracedGraph(name=name, closed_jaxpr=cj,
+                       param_names=param_names, param_avals=state_specs,
+                       n_data_inputs=len(arg_specs))
+
+
+def iter_eqns(jaxpr, _prefix: str = "") -> Iterator[Tuple[str, Any]]:
+    """Walk eqns depth-first, descending into sub-jaxprs (pjit bodies,
+    custom_vjp calls, scan/while carries). Yields ``(path, eqn)`` where
+    ``path`` is a stable dotted index ("14.custom_vjp_call_jaxpr.2") —
+    the eqn half of the model+eqn finding key."""
+    for i, eqn in enumerate(jaxpr.eqns):
+        yield f"{_prefix}{i}", eqn
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else [v]):
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    yield from iter_eqns(
+                        inner, f"{_prefix}{i}.{eqn.primitive.name}.")
+                elif hasattr(sub, "eqns"):
+                    yield from iter_eqns(
+                        sub, f"{_prefix}{i}.{eqn.primitive.name}.")
+
+
+def avals_in(eqn) -> List[Any]:
+    return [v.aval for v in eqn.invars if hasattr(v, "aval")]
+
+
+def avals_out(eqn) -> List[Any]:
+    return [v.aval for v in eqn.outvars if hasattr(v, "aval")]

@@ -1,0 +1,186 @@
+"""Graph-rule model enumeration: which families get preflighted, how.
+
+Each entry builds a tiny-config model in bfloat16 (the serving dtype —
+the dtype rule exists to protect exactly that build), declares its
+abstract inputs, and optionally a sharding layout to validate/propagate.
+The FAST set (llama, mixtral/MoE, whisper enc-dec, llama-sharded) is
+what tier-1 sweeps on every pdlint --graph run; ``entries(full=True)``
+extends over the wider zoo for the slow sweep.
+
+Traces are memoized per entry name — the four graph rules share one
+trace per model per process instead of re-tracing per rule.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from .trace import TracedGraph, spec, trace_layer
+
+
+@dataclasses.dataclass
+class ShardLayout:
+    """A mesh (axis name -> size; no devices needed) plus per-parameter
+    PartitionSpecs from pattern rules — the annotation set shard-spec
+    validates and feeds the propagation walk."""
+
+    axis_sizes: Dict[str, int]
+    # (substring-pattern, spec) — first match wins; unmatched params
+    # stay replicated
+    rules: Sequence[Tuple[str, Tuple]]
+
+    def spec_for(self, param_name: str, ndim: int) -> Optional[Tuple]:
+        for pat, sp in self.rules:
+            if pat in param_name:
+                return sp if len(sp) <= ndim else None
+        return None
+
+
+@dataclasses.dataclass
+class ZooEntry:
+    name: str
+    build: Callable[[], object]               # -> Layer (bf16 tiny config)
+    inputs: Callable[[object], tuple]         # model -> ShapeDtypeStructs
+    allow_upcast: FrozenSet[str] = frozenset()
+    shard: Optional[ShardLayout] = None
+
+
+def _llama():
+    from ...models.llama import LlamaConfig, LlamaForCausalLM
+
+    return LlamaForCausalLM(LlamaConfig.tiny(dtype="bfloat16"))
+
+
+def _mixtral():
+    from ...models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    return MixtralForCausalLM(MixtralConfig.tiny(dtype="bfloat16"))
+
+
+def _whisper():
+    from ...models.whisper import (WhisperConfig,
+                                   WhisperForConditionalGeneration)
+
+    return WhisperForConditionalGeneration(
+        WhisperConfig.tiny(dtype="bfloat16"))
+
+
+def _ids_inputs(model):
+    import jax.numpy as jnp
+
+    return (spec((2, 16), jnp.int32),)
+
+
+def _whisper_inputs(model):
+    import jax.numpy as jnp
+
+    cfg = model.config
+    # features arrive in the model dtype (the serving front-end casts) —
+    # the conv stem requires operand dtypes to match its weights
+    return (spec((1, cfg.num_mel_bins, 2 * cfg.max_source_positions),
+                 cfg.dtype),
+            spec((1, 8), jnp.int32))
+
+
+# Megatron layout over a (dp=2, mp=2) mesh: column-parallel projections
+# shard the OUT dim (weights are [in, out]), row-parallel shard IN;
+# embeddings/lm_head shard the vocab dim. mp=2 because the tiny config
+# has 2 kv heads — mp must divide them or the attention reshape
+# resharding the propagation walk flags is REAL (the known-bad fixture
+# pins exactly that case at mp=4).
+_LLAMA_SHARD = ShardLayout(
+    axis_sizes={"dp": 2, "mp": 2},
+    rules=(
+        ("q_proj.weight", (None, "mp")),
+        ("k_proj.weight", (None, "mp")),
+        ("v_proj.weight", (None, "mp")),
+        ("gate_proj.weight", (None, "mp")),
+        ("up_proj.weight", (None, "mp")),
+        ("o_proj.weight", ("mp", None)),
+        ("down_proj.weight", ("mp", None)),
+        ("embed_tokens.weight", ("mp", None)),
+        ("lm_head.weight", (None, "mp")),
+    ),
+)
+
+
+# the rope island: q/k convert to f32 and multiply the f32 cos/sin
+# tables by design (precision) — the one deliberate tensor-mix every
+# rope family carries. Allowing "mul" keeps dot_general/add/div/exp
+# mixes hot for these models.
+_ROPE = frozenset({"mul"})
+
+
+def entries(full: bool = False) -> List[ZooEntry]:
+    fast = [
+        ZooEntry("llama", _llama, _ids_inputs, allow_upcast=_ROPE),
+        ZooEntry("mixtral", _mixtral, _ids_inputs, allow_upcast=_ROPE),
+        ZooEntry("whisper", _whisper, _whisper_inputs),
+        ZooEntry("llama-sharded", _llama, _ids_inputs,
+                 shard=_LLAMA_SHARD),
+    ]
+    if not full:
+        return fast
+    return fast + [
+        ZooEntry("gpt2", _family("gpt2", "GPT2Config", "GPT2LMHeadModel"),
+                 _ids_inputs),
+        ZooEntry("qwen2", _family("qwen2", "Qwen2Config",
+                                  "Qwen2ForCausalLM"), _ids_inputs,
+                 allow_upcast=_ROPE),
+        ZooEntry("qwen3", _family("qwen3", "Qwen3Config",
+                                  "Qwen3ForCausalLM"), _ids_inputs,
+                 allow_upcast=_ROPE),
+        ZooEntry("mistral", _family("mistral", "MistralConfig",
+                                    "MistralForCausalLM"), _ids_inputs,
+                 allow_upcast=_ROPE),
+        ZooEntry("gemma", _family("gemma", "GemmaConfig",
+                                  "GemmaForCausalLM"), _ids_inputs,
+                 allow_upcast=_ROPE),
+        ZooEntry("gemma2", _family("gemma2", "Gemma2Config",
+                                   "Gemma2ForCausalLM"), _ids_inputs,
+                 allow_upcast=_ROPE),
+        ZooEntry("phi3", _family("phi3", "Phi3Config", "Phi3ForCausalLM"),
+                 _ids_inputs, allow_upcast=_ROPE),
+        ZooEntry("olmo2", _family("olmo2", "Olmo2Config",
+                                  "Olmo2ForCausalLM"), _ids_inputs,
+                 allow_upcast=_ROPE),
+        ZooEntry("glm", _family("glm", "GlmConfig", "GlmForCausalLM"),
+                 _ids_inputs, allow_upcast=_ROPE),
+        ZooEntry("qwen2-moe", _family("qwen2_moe", "Qwen2MoeConfig",
+                                      "Qwen2MoeForCausalLM"), _ids_inputs,
+                 allow_upcast=_ROPE),
+        ZooEntry("deepseek-mla", _family("deepseek", "DeepseekV2Config",
+                                         "DeepseekV2ForCausalLM",
+                                         tiny="tiny_mla"), _ids_inputs,
+                 allow_upcast=_ROPE),
+    ]
+
+
+def _family(mod: str, cfg_cls: str, model_cls: str, tiny: str = "tiny"):
+    def build():
+        import importlib
+
+        m = importlib.import_module(f"paddle_tpu.models.{mod}")
+        cfg = getattr(getattr(m, cfg_cls), tiny)(dtype="bfloat16")
+        return getattr(m, model_cls)(cfg)
+
+    build.__name__ = f"build_{mod}"
+    return build
+
+
+@functools.lru_cache(maxsize=32)
+def traced(name: str, full: bool = False) -> TracedGraph:
+    """Trace one zoo entry by name (memoized — rules share the trace)."""
+    for e in entries(full=full):
+        if e.name == name:
+            model = e.build()
+            return trace_layer(model, *e.inputs(model), name=e.name)
+    raise KeyError(f"no zoo entry {name!r}")
+
+
+def entry(name: str, full: bool = False) -> ZooEntry:
+    for e in entries(full=full):
+        if e.name == name:
+            return e
+    raise KeyError(f"no zoo entry {name!r}")

@@ -84,7 +84,7 @@ class Group:
     def rank(self) -> int:
         try:
             return jax.process_index() % self.nranks
-        except Exception:  # pragma: no cover
+        except RuntimeError:  # pragma: no cover — backend not initialized
             return 0
 
     @property
@@ -158,7 +158,7 @@ def _collective_fn(kind, mesh, axes, spec_in, spec_out, extra=None):
 def _multiprocess() -> bool:
     try:
         return jax.process_count() > 1
-    except Exception:  # pragma: no cover
+    except RuntimeError:  # pragma: no cover — backend not initialized
         return False
 
 

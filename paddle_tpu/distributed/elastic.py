@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Callable, List, Optional, Set
 
+from .log_utils import get_logger
+
 ELASTIC_EXIT_CODE = 101  # restart-requested (manager.py ELASTIC_EXIT_CODE analog)
 
 
@@ -86,8 +88,13 @@ class ElasticManager:
             while not self._stop.wait(self.ttl / 3.0):
                 try:
                     self._beat()
-                except Exception:
-                    pass  # transient store hiccup; next beat retries
+                except Exception as e:
+                    # transient store hiccup; next beat retries — but a
+                    # run of these is a lease about to lapse, so say so
+                    get_logger().warning(
+                        "elastic heartbeat for rank %s failed (%s: %s); "
+                        "retrying next beat", self.rank,
+                        type(e).__name__, e)
 
         self._hb_thread = threading.Thread(
             name="elastic-heartbeat", target=heartbeat, daemon=True)
@@ -104,22 +111,39 @@ class ElasticManager:
         rank's silent lease with a hang (manager.py exit(completed=True))."""
         try:
             self._store.set(f"{self._prefix}/done/{self.rank}", b"1")
-        except Exception:
-            pass
+        except Exception as e:
+            # failed deregistration makes this clean exit look like a
+            # hang to every peer watcher — the one elastic fault that
+            # must never be silent
+            get_logger().warning(
+                "elastic mark_done for rank %s failed (%s: %s); peers "
+                "may treat this exit as a lapsed lease", self.rank,
+                type(e).__name__, e)
         self._stop.set()
 
     def _is_done(self, rank: int) -> bool:
         try:
             self._store.get(f"{self._prefix}/done/{rank}", timeout=0.2)
             return True
-        except Exception:
+        except TimeoutError:
+            return False  # no done-marker within the probe window
+        except Exception as e:
+            # store unreachable is indistinguishable from "not done" for
+            # the caller, but not for the operator debugging a restart
+            # loop — log at debug (polled every watch interval)
+            get_logger().debug("elastic done-probe for rank %s failed "
+                               "(%s: %s)", rank, type(e).__name__, e)
             return False
 
     # ---- peer view ----------------------------------------------------------
     def _stamp(self, rank: int) -> Optional[float]:
         try:
             return float(self._store.get(self._key(rank), timeout=0.2))
-        except Exception:
+        except (TimeoutError, ValueError):
+            return None  # never registered / garbled stamp: not alive
+        except Exception as e:
+            get_logger().debug("elastic lease probe for rank %s failed "
+                               "(%s: %s)", rank, type(e).__name__, e)
             return None
 
     def alive_ranks(self) -> Set[int]:
@@ -165,7 +189,13 @@ class ElasticManager:
             while not self._stop.wait(interval):
                 try:
                     alive = self.alive_ranks()
-                except Exception:
+                except Exception as e:
+                    # a watcher that cannot see the store cannot detect
+                    # lost peers — the exact blindness worth a line
+                    get_logger().warning(
+                        "elastic watch cannot read the peer set "
+                        "(%s: %s); retrying in %.1fs",
+                        type(e).__name__, e, interval)
                     continue
                 seen |= alive
                 lost = {r for r in seen - alive

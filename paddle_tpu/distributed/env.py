@@ -51,7 +51,7 @@ def get_rank(group=None) -> int:
         return group.rank
     try:
         return jax.process_index()
-    except Exception:  # pragma: no cover
+    except RuntimeError:  # pragma: no cover — backend not initialized
         return 0
 
 
@@ -60,7 +60,7 @@ def get_world_size(group=None) -> int:
         return group.nranks
     try:
         return jax.process_count()
-    except Exception:  # pragma: no cover
+    except RuntimeError:  # pragma: no cover — backend not initialized
         return 1
 
 

@@ -79,8 +79,15 @@ def _handle(conn):
                 f"payload ok={payload['ok']}, "
                 f"{type(payload.get('value', payload.get('error'))).__name__}")})
         _send_msg(conn, blob)
-    except Exception:
-        pass
+    except Exception as e:
+        # a request we could not even parse/reply to leaves the CALLER
+        # blocked on its socket — log the server side so the hang is
+        # attributable
+        from .log_utils import get_logger
+
+        get_logger().warning("rpc handler dropped a request (%s: %s); "
+                             "the caller will see a closed connection",
+                             type(e).__name__, e)
     finally:
         conn.close()
 
@@ -188,8 +195,13 @@ def shutdown():
         try:
             # graceful: nobody tears down while a peer may still call in
             store.barrier("rpc_shutdown", timeout=60)
-        except Exception:
-            pass
+        except Exception as e:
+            from .log_utils import get_logger
+
+            get_logger().warning(
+                "rpc shutdown barrier failed (%s: %s); tearing down "
+                "anyway — a peer mid-call may see a dead endpoint",
+                type(e).__name__, e)
     srv = _GLOBAL.pop("server", None)
     if srv is not None:
         try:

@@ -65,7 +65,12 @@ def spawn(func, args: Iterable[Any] = (), nprocs: int = -1, join: bool = True,
             import jax
 
             nprocs = max(1, jax.local_device_count())
-        except Exception:
+        except Exception as e:
+            from .log_utils import get_logger
+
+            get_logger().warning(
+                "spawn: could not query local device count (%s: %s); "
+                "falling back to nprocs=1", type(e).__name__, e)
             nprocs = 1
     master = options.get("master")
     if master is None:

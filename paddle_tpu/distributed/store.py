@@ -107,11 +107,18 @@ class TCPStore:
             if getattr(self, "_server", None):
                 self._lib.pd_store_server_stop(self._server)
                 self._server = None
-        except Exception:
-            pass
+        except Exception as e:
+            # a close that didn't close leaks the port — the elastic
+            # restart loop then fails to rebind with a confusing EADDRINUSE
+            # far from the cause; one warning line points back here
+            from .log_utils import get_logger
+
+            get_logger().warning("TCPStore.close failed (%s: %s); the "
+                                 "daemon port may stay bound",
+                                 type(e).__name__, e)
 
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # pdlint: disable=silent-exception -- interpreter teardown: logging/ctypes may already be gone
             pass

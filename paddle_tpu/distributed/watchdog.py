@@ -123,8 +123,15 @@ class Watchdog:
         if self.on_timeout is not None:
             try:
                 self.on_timeout(self)
-            except Exception:
-                pass
+            except Exception as e:
+                # the stack dump above already happened; a broken
+                # callback must not be the last invisible act before
+                # the hard abort below
+                from .log_utils import get_logger
+
+                get_logger().warning("watchdog on_timeout callback "
+                                     "raised (%s: %s)",
+                                     type(e).__name__, e)
         if self.abort:
             # hard abort (AbortComm parity): the launcher sees the death,
             # kills peers, and its restart policy resumes from checkpoint

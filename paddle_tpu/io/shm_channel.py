@@ -133,5 +133,5 @@ class ShmChannel:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # pdlint: disable=silent-exception -- interpreter teardown: ctypes/logging may already be gone
             pass

@@ -19,7 +19,8 @@ jax.jit:
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional, Sequence
+import weakref
+from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -81,6 +82,9 @@ class StaticFunction:
         self._static_argnums = static_argnums
         self._compile_count = 0
         self._printed_sigs = set()
+        self._name = getattr(fn, "__qualname__", None) or getattr(
+            fn, "__name__", "<fn>")
+        _LIVE_STATIC_FUNCTIONS.add(self)
 
         if layer is not None:
             def pure(state, rng_key, training, *args, **kwargs):
@@ -145,8 +149,38 @@ class StaticFunction:
     def forward(self):
         return self
 
+    @property
+    def specializations(self) -> int:
+        """Compiled specializations of the underlying jax.jit cache —
+        the retrace-hazard signal graph analysis consumes
+        (analysis.graph.retrace.live_specialization_findings): a serving
+        step should compile a handful of shape buckets, not one per
+        request."""
+        try:
+            return int(self._jitted._cache_size())
+        except Exception:  # pdlint: disable=silent-exception -- private jax API; absent means "no signal", not a fault
+            return 0
+
     def concrete_program(self, *args):  # introspection hook
         return self._jitted.lower(*args)
+
+
+# every live StaticFunction, for the specialization-count hook: weak
+# refs, so watching compile caches never pins a model in memory
+_LIVE_STATIC_FUNCTIONS: "weakref.WeakSet[StaticFunction]" = weakref.WeakSet()
+
+
+def specialization_stats() -> Dict[str, int]:
+    """{callable-name: compiled-specialization-count} over every live
+    StaticFunction. Names collide across instances wrapping same-named
+    functions; the max wins (the hook exists to catch blow-ups, and the
+    blown-up instance is the interesting one)."""
+    out: Dict[str, int] = {}
+    for sf in list(_LIVE_STATIC_FUNCTIONS):
+        n = sf.specializations
+        if n:
+            out[sf._name] = max(out.get(sf._name, 0), n)
+    return out
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,

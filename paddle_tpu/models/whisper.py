@@ -188,11 +188,13 @@ class WhisperModel(Layer):
             self.decoder_pos = nn.Embedding(config.max_target_positions, d)
             self.encoder_ln = nn.LayerNorm(d)
             self.decoder_ln = nn.LayerNorm(d)
-        # fixed sinusoidal encoder positions, stored as a (non-trainable)
-        # weight to match the checkpoint layout
-        self.encoder_pos = nn.Embedding(config.max_source_positions, d)
+            # fixed sinusoidal encoder positions, stored as a
+            # (non-trainable) weight to match the checkpoint layout; the
+            # table follows the model dtype — an f32 island here would
+            # upcast every encoder activation at the stem
+            self.encoder_pos = nn.Embedding(config.max_source_positions, d)
         self.encoder_pos.weight.set_value(
-            sinusoids(config.max_source_positions, d))
+            sinusoids(config.max_source_positions, d).astype(config.dtype))
         self.encoder_pos.weight.stop_gradient = True
         self.encoder_layers_list = nn.LayerList(
             [WhisperEncoderLayer(config)

@@ -317,12 +317,50 @@ class ContinuousBatchEngine(_RequestBookkeeping):
     >>> done = eng.run_until_done()   # {rid: np.ndarray of generated ids}
     """
 
+    @classmethod
+    def preflight(cls, model, max_batch: int, max_len: int,
+                  page_size: int = 16, mesh=None, param_specs=None,
+                  budget_bytes: Optional[int] = None,
+                  allow_upcast=(), raise_on_fatal: bool = True):
+        """Jaxpr-level admission check BEFORE any buffer is allocated or
+        step compiled: shard-spec validity (explicit ``mesh`` +
+        ``param_specs`` patterns, plus placements already attached to
+        parameters via dist.shard_tensor), bf16→f32 dtype promotion, and
+        a param/activation/kv-cache byte bound against ``budget_bytes``.
+
+        Returns the structured ``PreflightReport``; with
+        ``raise_on_fatal`` (default) an indivisible sharding or an
+        over-budget model raises ``PreflightError`` carrying that report
+        — the findings-report replacement for the compile-time crash XLA
+        would produce minutes later. The trace is abstract
+        (jax.make_jaxpr): preflighting a 70B config costs tracing time,
+        not memory.
+        """
+        from .analysis.graph import preflight as _preflight
+        from .analysis.graph.cost import kv_cache_bytes as _kv_bytes
+
+        report = _preflight.preflight_model(
+            model, batch=1, seq_len=min(int(max_len), 128),
+            mesh=mesh, param_specs=param_specs, budget_bytes=budget_bytes,
+            kv_cache_bytes=_kv_bytes(model.config, max_batch, max_len),
+            allow_upcast=allow_upcast)
+        if raise_on_fatal and not report.ok:
+            raise _preflight.PreflightError(report)
+        return report
+
     def __init__(self, model, max_batch: int, max_len: int, page_size: int = 16,
                  eos_token_id: Optional[int] = None, do_sample: bool = False,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
-                 enable_prefix_cache: bool = False):
+                 enable_prefix_cache: bool = False,
+                 preflight: bool = False):
         if max_len % page_size != 0:
             raise ValueError("max_len must be a multiple of page_size")
+        if preflight:
+            # model-load gate: fail fast with a findings report (raises
+            # PreflightError) instead of crashing in compile or OOMing
+            # after the pools below are already allocated
+            type(self).preflight(model, max_batch, max_len,
+                                 page_size=page_size)
         cfg = model.config
         if max_len > cfg.max_position_embeddings:
             raise ValueError(f"max_len {max_len} exceeds "

@@ -7,6 +7,7 @@ Usage:
     python scripts/pdlint.py --baseline .pdlint_baseline.json
     python scripts/pdlint.py --write-baseline         # grandfather now
     python scripts/pdlint.py --select silent-exception,host-sync
+    python scripts/pdlint.py --graph                  # + jaxpr rules
     python scripts/pdlint.py --list-rules
     python scripts/pdlint.py --no-project-rules paddle_tpu/serving.py
 
@@ -47,6 +48,10 @@ def main(argv=None) -> int:
     p.add_argument("--no-project-rules", action="store_true",
                    help="skip project rules (op-schema, catalog lints): "
                         "AST rules only, no registry/docs cross-checks")
+    p.add_argument("--graph", action="store_true",
+                   help="also run the jaxpr-level graph rules (traces "
+                        "the zoo preflight set — slower; see "
+                        "docs/ANALYSIS.md 'Graph rules')")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -61,11 +66,30 @@ def main(argv=None) -> int:
                 if args.select else None)
     paths = [os.path.abspath(p_) for p_ in args.paths] or None
     findings = analysis.run(paths=paths, root=_REPO, selected=selected,
-                            with_project_rules=not args.no_project_rules)
+                            with_project_rules=not args.no_project_rules,
+                            graph=args.graph)
 
     base_path = args.baseline or os.path.join(_REPO,
                                               ".pdlint_baseline.json")
     if args.write_baseline:
+        # stale-entry pruning: report what the rewrite drops, split into
+        # entries whose (file, symbol) no longer resolves (dead weight
+        # that would linger forever) vs findings actually fixed
+        if os.path.isfile(base_path):
+            old = bl.load_entries(base_path)
+            new_keys = {f.key() for f in findings}
+            dropped = [e for e in old
+                       if (e["file"], e["rule"], e["symbol"], e["message"])
+                       not in new_keys]
+            stale = bl.stale_entries(dropped, _REPO)
+            stale_ids = {id(e) for e in stale}
+            for e in stale:
+                print(f"pdlint: pruned stale entry {e['file']} "
+                      f"[{e['symbol'] or '<module>'}] {e['rule']} "
+                      "(file/symbol no longer resolves)")
+            fixed = [e for e in dropped if id(e) not in stale_ids]
+            if fixed:
+                print(f"pdlint: dropped {len(fixed)} fixed finding(s)")
         n = bl.save(base_path, findings)
         print(f"pdlint: wrote {n} baselined finding(s) to "
               f"{os.path.relpath(base_path, _REPO)}")

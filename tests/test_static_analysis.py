@@ -414,6 +414,61 @@ def test_baseline_round_trip(tmp_path):
     assert bl.filter_new([fresh], known) == [fresh]
 
 
+def test_stale_baseline_entries_detected(tmp_path):
+    """Satellite: entries whose (file, symbol) no longer resolves are
+    stale — the file is gone, unparsable, or no longer defines the
+    symbol. Graph pseudo-files (``<graph:...>``) are never stale."""
+    (tmp_path / "live.py").write_text(
+        "class C:\n    def step(self):\n        pass\n")
+    entries = [
+        {"file": "live.py", "rule": "r", "symbol": "C.step", "message": "m"},
+        {"file": "live.py", "rule": "r", "symbol": "", "message": "m"},
+        {"file": "live.py", "rule": "r", "symbol": "C.gone", "message": "m"},
+        {"file": "deleted.py", "rule": "r", "symbol": "f", "message": "m"},
+        {"file": "<graph:llama>", "rule": "graph-dtype-promotion",
+         "symbol": "mul@3", "message": "m"},
+    ]
+    stale = bl.stale_entries(entries, str(tmp_path))
+    assert [(e["file"], e["symbol"]) for e in stale] == [
+        ("live.py", "C.gone"), ("deleted.py", "f")]
+
+
+def test_write_baseline_prunes_stale_entries(tmp_path, capsys):
+    """``--write-baseline`` reports and drops entries that no longer
+    resolve instead of letting them linger forever."""
+    import importlib.util
+
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "def f():\n    try:\n        g()\n"
+        "    except Exception:\n        pass\n")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"version": 1, "findings": [
+        # resolves (and is re-found): kept
+        {"file": "paddle_tpu/mod.py", "rule": "silent-exception",
+         "symbol": "f", "message": "broad `except Exception:` swallows "
+         "errors with no logging and no re-raise"},
+        # (file, symbol) gone: pruned as stale
+        {"file": "paddle_tpu/removed.py", "rule": "silent-exception",
+         "symbol": "old_fn", "message": "whatever"},
+    ]}))
+    path = os.path.join(_REPO, "scripts", "pdlint.py")
+    spec = importlib.util.spec_from_file_location("pdlint_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod._REPO = str(tmp_path)
+    rc = mod.main(["--write-baseline", "--baseline", str(base),
+                   "--no-project-rules", str(pkg)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pruned stale entry paddle_tpu/removed.py" in out
+    doc = json.loads(base.read_text())
+    files = [e["file"] for e in doc["findings"]]
+    assert "paddle_tpu/removed.py" not in files
+    assert "paddle_tpu/mod.py" in files
+
+
 def test_baseline_keys_survive_line_drift():
     src1 = ("def f():\n    try:\n        g()\n"
             "    except Exception:\n        pass\n")
