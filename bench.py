@@ -527,6 +527,20 @@ def pp_bench(devs, gen):
 
 
 def main():
+    # always-on forensics for bench runs: crashes (an OOM'd config, a
+    # hung collective) leave a rank-suffixed incident bundle — event
+    # ring, metrics snapshot, thread stacks — instead of a bare
+    # traceback. PD_INCIDENT_DIR overrides the destination.
+    from paddle_tpu.observability import flightrecorder as _frec
+
+    _frec.get_recorder().enable()
+    _frec.get_reporter().activate(
+        os.environ.get("PD_INCIDENT_DIR", "incidents"))
+    with _frec.incident_scope("bench"):
+        return _main_inner()
+
+
+def _main_inner():
     import jax
 
     import paddle_tpu as paddle

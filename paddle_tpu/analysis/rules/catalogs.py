@@ -1,6 +1,7 @@
-"""metrics-catalog / span-catalog: docs and registries agree, both ways.
+"""metrics-catalog / span-catalog / event-catalog: docs and registries
+agree, both ways.
 
-These two rules are the grown-up form of the original tier-1 lint
+The first two rules are the grown-up form of the original tier-1 lint
 scripts (scripts/check_metrics_catalog.py, check_span_catalog.py),
 re-homed under the pdlint runner; the scripts remain as thin wrappers.
 
@@ -12,6 +13,10 @@ re-homed under the pdlint runner; the scripts remain as thin wrappers.
   the "Span catalog" table and vice versa, and every registered span's
   ``SPAN_*`` constant is actually referenced outside tracing.py (no dead
   catalog entries).
+- **event-catalog**: the flight recorder's ``EVENT_CATALOG`` kinds
+  (flightrecorder.py) against the docs "Event catalog" table the same
+  way — documented, registered, and every ``EV_*`` constant actually
+  recorded outside flightrecorder.py.
 
 The comparison cores are pure functions over parsed dicts so fixture
 tests can exercise drift cases without importing the live registry.
@@ -54,17 +59,32 @@ def compare_metric_catalogs(docs: Dict[str, tuple],
 
 def compare_span_catalogs(docs: Set[str], registered: Set[str],
                           emitted_ok: Dict[str, bool]) -> List[str]:
+    return compare_name_catalogs(docs, registered, emitted_ok,
+                                 noun="span", home="tracing.py")
+
+
+def compare_event_catalogs(docs: Set[str], registered: Set[str],
+                           emitted_ok: Dict[str, bool]) -> List[str]:
+    return compare_name_catalogs(docs, registered, emitted_ok,
+                                 noun="event", home="flightrecorder.py")
+
+
+def compare_name_catalogs(docs: Set[str], registered: Set[str],
+                          emitted_ok: Dict[str, bool], noun: str,
+                          home: str) -> List[str]:
+    """The shared docs/registry/emit three-way check behind the span and
+    event catalog rules (they differ only in nouns and home module)."""
     problems = []
     for name in sorted(registered - docs):
-        problems.append(f"span registered but not in docs/SERVING.md: "
+        problems.append(f"{noun} registered but not in docs/SERVING.md: "
                         f"{name}")
     for name in sorted(docs - registered):
-        problems.append(f"span documented but not registered: {name}")
+        problems.append(f"{noun} documented but not registered: {name}")
     for name, ok in sorted(emitted_ok.items()):
         if not ok:
             problems.append(
-                f"span {name!r} is registered but never emitted outside "
-                "tracing.py")
+                f"{noun} {name!r} is registered but never emitted outside "
+                f"{home}")
     return problems
 
 
@@ -90,18 +110,27 @@ def documented_metrics(path: str) -> Dict[str, tuple]:
 
 def documented_spans(path: str) -> Set[str]:
     """Span names from the docs "Span catalog" section only."""
+    return _documented_names(path, "Span catalog", "span")
+
+
+def documented_events(path: str) -> Set[str]:
+    """Event kinds from the docs "Event catalog" section only."""
+    return _documented_names(path, "Event catalog", "kind")
+
+
+def _documented_names(path: str, section: str, header_cell: str) -> Set[str]:
     out = set()
     in_section = False
     with open(path, encoding="utf-8") as f:
         for line in f:
             line = line.strip()
             if line.startswith("#"):
-                in_section = line.lstrip("#").strip() == "Span catalog"
+                in_section = line.lstrip("#").strip() == section
                 continue
             if not in_section:
                 continue
             m = _SPAN_ROW.match(line)
-            if m and m.group(1) != "span":
+            if m and m.group(1) != header_cell:
                 out.add(m.group(1))
     return out
 
@@ -163,13 +192,48 @@ class SpanCatalogRule(ProjectRule):
     @staticmethod
     def _emitted_constants(root: str) -> Set[str]:
         """SPAN_* constants referenced OUTSIDE tracing.py (emit sites)."""
-        used: Set[str] = set()
-        pkg = os.path.join(root, "paddle_tpu")
-        for dirpath, _, files in os.walk(pkg):
-            for fn in files:
-                if not fn.endswith(".py") or fn == "tracing.py":
-                    continue
-                with open(os.path.join(dirpath, fn),
-                          encoding="utf-8") as f:
-                    used.update(re.findall(r"\bSPAN_[A-Z_]+\b", f.read()))
-        return used
+        return _referenced_constants(root, r"\bSPAN_[A-Z_]+\b",
+                                     "tracing.py")
+
+
+def _referenced_constants(root: str, pattern: str,
+                          home_file: str) -> Set[str]:
+    """Constants matching ``pattern`` referenced in paddle_tpu/ OUTSIDE
+    the catalog's home module (i.e. real emit sites)."""
+    used: Set[str] = set()
+    pkg = os.path.join(root, "paddle_tpu")
+    for dirpath, _, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py") or fn == home_file:
+                continue
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
+                used.update(re.findall(pattern, f.read()))
+    return used
+
+
+@register_rule
+class EventCatalogRule(ProjectRule):
+    id = "event-catalog"
+    rationale = ("a flight-recorder event kind must be documented, "
+                 "registered, and actually recorded — dead catalog "
+                 "entries and undocumented kinds both drift, and an "
+                 "undocumented kind makes incident bundles unreadable")
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        _bootstrap(root)
+        from paddle_tpu.observability import flightrecorder
+
+        docs = documented_events(os.path.join(root, _DOCS))
+        registered = set(flightrecorder.EVENT_CATALOG)
+        used = _referenced_constants(root, r"\bEV_[A-Z_]+\b",
+                                     "flightrecorder.py")
+        emitted_ok = {
+            value: (const in used)
+            for const, value in vars(flightrecorder).items()
+            if (const.startswith("EV_") and isinstance(value, str)
+                and const != "EVENT_CATALOG")
+        }
+        for msg in compare_event_catalogs(docs, registered, emitted_ok):
+            yield Finding(file=_DOCS.replace(os.sep, "/"), line=1,
+                          rule=self.id, message=msg,
+                          symbol="event-catalog")

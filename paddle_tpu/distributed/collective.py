@@ -43,6 +43,7 @@ except ImportError:
             kwargs["check_rep"] = kwargs.pop("check_vma")
         return _exp_shard_map(*args, **kwargs)
 
+from ..observability import flightrecorder as _frec
 from ..tensor_class import Tensor, unwrap, wrap
 from .process_mesh import ProcessMesh
 from .placements import Replicate, Shard, Partial
@@ -232,6 +233,26 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     arr = unwrap(tensor)
     kind = {"sum": "allreduce_sum", "max": "allreduce_max",
             "min": "allreduce_min", "avg": "allreduce_avg"}[op if isinstance(op, str) else "sum"]
+    rec = _frec.RECORDER
+    if rec.enabled:
+        # begin/end pairs in the black box: an incident bundle with an
+        # unmatched begin IS the hung collective (comm-task watchdog
+        # granularity, recovered at the host boundary)
+        import time as _time
+
+        rec.record(_frec.EV_COLLECTIVE_BEGIN, op=kind,
+                   multiprocess=_multiprocess())
+        t0 = _time.perf_counter()
+        try:
+            out = _all_reduce_inner(tensor, arr, kind, mesh, axes, group)
+        finally:
+            rec.record(_frec.EV_COLLECTIVE_END, op=kind,
+                       seconds=_time.perf_counter() - t0)
+        return out
+    return _all_reduce_inner(tensor, arr, kind, mesh, axes, group)
+
+
+def _all_reduce_inner(tensor, arr, kind, mesh, axes, group):
     if _multiprocess():
         _static_check(arr, "all_reduce")
         if group is not None and group is not _default_group[0]:
@@ -433,6 +454,19 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
+    rec = _frec.RECORDER
+    if rec.enabled:
+        import time as _time
+
+        rec.record(_frec.EV_COLLECTIVE_BEGIN, op="barrier",
+                   multiprocess=_multiprocess())
+        t0 = _time.perf_counter()
+        try:
+            (jax.device_put(0) + 0).block_until_ready()
+        finally:
+            rec.record(_frec.EV_COLLECTIVE_END, op="barrier",
+                       seconds=_time.perf_counter() - t0)
+        return
     (jax.device_put(0) + 0).block_until_ready()
 
 

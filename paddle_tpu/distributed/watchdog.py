@@ -59,6 +59,13 @@ class Watchdog:
 
     # ---- producer side -------------------------------------------------------
     def stamp(self, tag: str = ""):
+        from ..observability import flightrecorder as _frec
+
+        rec = _frec.RECORDER
+        if rec.enabled:
+            # rank heartbeats in the black box: the gap BEFORE a stall
+            # localises which step hung, across every rank's bundle
+            rec.record(_frec.EV_HEARTBEAT, name=self.name, tag=tag)
         with self._lock:
             self._last = time.monotonic()
             self._history.append((time.time(), tag))
@@ -99,6 +106,22 @@ class Watchdog:
 
     def _fire(self, age: float):
         self.fired = True
+        from ..observability import flightrecorder as _frec
+
+        _frec.RECORDER.record(_frec.EV_STALL, name=self.name,
+                              age_s=round(age, 3), timeout_s=self.timeout)
+        # a watchdog-declared stall IS an incident: write the bundle
+        # (event ring, spans, engine state, all-thread stacks) before
+        # the abort below can kill the process
+        if _frec.get_reporter().active:
+            try:
+                _frec.get_reporter().dump("watchdog_stall",
+                                          context=self.name)
+            except Exception as e:
+                from .log_utils import get_logger
+
+                get_logger().warning("watchdog incident dump failed "
+                                     "(%s: %s)", type(e).__name__, e)
         rank = os.environ.get("PADDLE_TRAINER_ID", "0")
         w = self._stream
         print(f"[watchdog:{self.name}] rank {rank}: NO PROGRESS for "

@@ -104,7 +104,7 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
         if not pos_is_zero:
             try:
                 pos_is_zero = int(pos) == 0  # eager caller: concrete scalar
-            except Exception:
+            except Exception:  # pdlint: disable=silent-exception -- int() on a traced offset raises by design (TracerError); 'unknown, stay dense' is the correct conservative branch, not a fault
                 pos_is_zero = False  # traced offset: unknown, stay dense
         if pos_is_zero and pf.supported(q, k, v, interpret=interpret):
             out = pf.flash_attention_bshd(q, k, v, causal=True,
@@ -225,7 +225,7 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
                                     page_indices, softcap=softcap)
     try:
         on_tpu = jax.devices()[0].platform == "tpu"
-    except Exception:
+    except Exception:  # pdlint: disable=silent-exception -- backend probe: jax.devices() raising (no backend initialised) means 'not on TPU'; the reference path below is the designed fallback
         on_tpu = False
     if on_tpu:
         from jax.experimental.pallas.ops.tpu.paged_attention import (

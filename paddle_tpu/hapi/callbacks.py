@@ -346,6 +346,13 @@ class StepTimer(Callback):
     batch-size samples/s reading into tokens/s; ``snapshot_dir`` appends
     a rank-aware JSONL registry snapshot every ``snapshot_freq`` steps.
 
+    ``incident_dir`` arms the incident forensics layer for the training
+    run: the process-wide flight recorder turns on (per-step
+    ``train.step`` events in the black box) and the IncidentReporter is
+    activated at that directory — a crash anywhere under ``fit()``
+    writes a rank-suffixed bundle (event ring, spans, metrics snapshot,
+    thread stacks; see docs/SERVING.md "Incident forensics").
+
     When request-scoped tracing is enabled
     (``paddle_tpu.observability.tracing``), each epoch opens a
     ``train.epoch`` span that parents the core timer's per-batch
@@ -354,7 +361,7 @@ class StepTimer(Callback):
     """
 
     def __init__(self, tokens_per_sample=None, snapshot_dir=None,
-                 snapshot_freq=100, logger=None):
+                 snapshot_freq=100, logger=None, incident_dir=None):
         super().__init__()
         from ..observability import StepTimer as _CoreTimer
 
@@ -366,6 +373,11 @@ class StepTimer(Callback):
             from ..observability import SnapshotWriter
 
             self._writer = SnapshotWriter(snapshot_dir, prefix="train")
+        if incident_dir is not None:
+            from ..observability import flightrecorder as _frec
+
+            _frec.get_recorder().enable()
+            _frec.get_reporter().activate(incident_dir)
         self._seen = 0
         self._epoch_span = None
 

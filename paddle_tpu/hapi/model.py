@@ -150,18 +150,26 @@ class Model:
         self.stop_training = False
         cbs.on_train_begin()
         history = []
-        for epoch in range(epochs):
-            for m in self._metrics:
-                m.reset()
-            cbs.on_epoch_begin(epoch)
-            logs = self._run_one_epoch(train_loader, cbs, "train", {})
-            cbs.on_epoch_end(epoch, logs)
-            if eval_loader is not None and epoch % eval_freq == 0:
-                eval_logs = self.evaluate_with_callbacks(eval_loader, cbs)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-            history.append(dict(logs))
-            if self.stop_training:
-                break
+        # crash boundary: a training crash (OOM mid-step, a raising
+        # callback, SIGTERM handled elsewhere) writes an incident bundle
+        # when a reporter is active — same forensics as the serving stack
+        from ..observability import flightrecorder as _frec
+
+        with _frec.incident_scope("hapi.fit"):
+            for epoch in range(epochs):
+                for m in self._metrics:
+                    m.reset()
+                cbs.on_epoch_begin(epoch)
+                logs = self._run_one_epoch(train_loader, cbs, "train", {})
+                cbs.on_epoch_end(epoch, logs)
+                if eval_loader is not None and epoch % eval_freq == 0:
+                    eval_logs = self.evaluate_with_callbacks(eval_loader,
+                                                            cbs)
+                    logs.update({f"eval_{k}": v
+                                 for k, v in eval_logs.items()})
+                history.append(dict(logs))
+                if self.stop_training:
+                    break
         cbs.on_train_end(logs if history else None)
         return history
 

@@ -20,16 +20,23 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       DEFAULT_LATENCY_BUCKETS, PROMETHEUS_CONTENT_TYPE,
                       get_registry, set_exemplar_provider)
 from . import catalog  # noqa: F401  (registers the catalog at import)
-from .snapshot import SnapshotWriter  # noqa: F401
+from .snapshot import SnapshotWriter, flush_all_writers  # noqa: F401
 from .timer import StepTimer  # noqa: F401
 from . import tracing  # noqa: F401
 from .tracing import (Span, Tracer, get_tracer,  # noqa: F401
                       parse_traceparent, format_traceparent)
+from . import flightrecorder  # noqa: F401
+from .flightrecorder import (FlightRecorder, IncidentReporter,  # noqa: F401
+                             get_recorder, get_reporter, install_reporter,
+                             incident_scope, validate_bundle, XlaOom)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_LATENCY_BUCKETS", "PROMETHEUS_CONTENT_TYPE",
     "get_registry", "set_exemplar_provider", "catalog", "SnapshotWriter",
-    "StepTimer", "tracing", "Span", "Tracer", "get_tracer",
-    "parse_traceparent", "format_traceparent",
+    "flush_all_writers", "StepTimer", "tracing", "Span", "Tracer",
+    "get_tracer", "parse_traceparent", "format_traceparent",
+    "flightrecorder", "FlightRecorder", "IncidentReporter", "get_recorder",
+    "get_reporter", "install_reporter", "incident_scope", "validate_bundle",
+    "XlaOom",
 ]

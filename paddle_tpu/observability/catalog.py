@@ -85,6 +85,20 @@ HTTP_REQUESTS = _R.counter(
     "under path=other)",
     labels=("path", "code"))
 
+# ---- observability self-telemetry ------------------------------------------
+
+TRACING_SPANS_DROPPED = _R.counter(
+    "tracing_spans_dropped_total",
+    "Finished spans evicted from the tracer's ring buffer (overflow — "
+    "raise Tracer(capacity=) if this grows during an investigation)",
+    labels=())
+
+FLIGHTRECORDER_EVENTS = _R.counter(
+    "flightrecorder_events_total",
+    "Flight-recorder events recorded, by event kind (see the event "
+    "catalog in docs/SERVING.md)",
+    labels=("kind",))
+
 # ---- training / step telemetry (StepTimer) ---------------------------------
 
 TRAIN_STEP_SECONDS = _R.histogram(

@@ -17,6 +17,7 @@ import time
 from typing import Optional
 
 from . import catalog as _cat
+from . import flightrecorder as _frec
 from . import tracing as _tracing
 
 __all__ = ["StepTimer"]
@@ -78,6 +79,9 @@ class StepTimer:
         dt = float(step_seconds)
         self.last_step_seconds = dt
         self.n_steps += 1
+        _rec = _frec.get_recorder()
+        if _rec.enabled:
+            _rec.record(_frec.EV_TRAIN_STEP, step=self.n_steps, seconds=dt)
         _cat.TRAIN_STEP_SECONDS.observe(dt)
         if n_tokens and dt > 0:
             _cat.TRAIN_TOKENS_PER_SEC.set(n_tokens / dt)

@@ -255,6 +255,8 @@ class Tracer:
         self._buf: deque = deque(maxlen=int(capacity))
         self._live: Dict[str, Span] = {}
         self._local = threading.local()
+        self._n_dropped = 0
+        self._m_dropped = None  # bound lazily on first overflow
         self.enabled = False
 
     # ---- lifecycle -----------------------------------------------------
@@ -279,6 +281,7 @@ class Tracer:
         with self._lock:
             self._buf.clear()
             self._live.clear()
+            self._n_dropped = 0
 
     @property
     def capacity(self) -> int:
@@ -349,9 +352,21 @@ class Tracer:
         span.end(status, end_ns=end_ns)
         return span
 
+    @property
+    def dropped(self) -> int:
+        """Finished spans evicted by ring overflow (lifetime count —
+        mirrored on /metrics as ``tracing_spans_dropped_total``)."""
+        with self._lock:
+            return self._n_dropped
+
     def _finish(self, span: Span):
         with self._lock:
             self._live.pop(span.span_id, None)
+            overflowed = len(self._buf) == self._buf.maxlen
+            if overflowed:
+                # the ring evicts silently otherwise — an operator
+                # debugging a sparse trace must be able to SEE overflow
+                self._n_dropped += 1
             self._buf.append({
                 "name": span.name,
                 "trace_id": span.trace_id,
@@ -363,6 +378,12 @@ class Tracer:
                 "status": span.status,
                 "attrs": dict(span.attrs),
             })
+        if overflowed:
+            if self._m_dropped is None:
+                from . import catalog as _cat
+
+                self._m_dropped = _cat.TRACING_SPANS_DROPPED.labels()
+            self._m_dropped.inc()
 
     # ---- context-manager / decorator APIs ------------------------------
     @contextlib.contextmanager

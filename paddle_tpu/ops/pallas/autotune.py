@@ -100,7 +100,7 @@ def device_kind() -> str:
     the cache file travels with the repo."""
     try:
         return jax.devices()[0].device_kind.replace(" ", "_")
-    except Exception:
+    except Exception:  # pdlint: disable=silent-exception -- backend probe: no initialised backend means the generic 'unknown' cache bucket, which is the designed fallback
         return "unknown"
 
 

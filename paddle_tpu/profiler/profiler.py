@@ -201,7 +201,13 @@ class Profiler:
             os.makedirs(self._trace_dir, exist_ok=True)
             jax.profiler.start_trace(self._trace_dir)
             self._jax_tracing = True
-        except Exception:  # pragma: no cover - device tracer unavailable
+        except Exception as e:  # pragma: no cover - device tracer unavailable
+            # host timers still work, but the requested device trace is
+            # silently missing otherwise — the flight recorder's compile
+            # and step timelines depend on knowing the tracer is absent
+            self._logger().warning(
+                "profiler: device trace unavailable, host timers only "
+                "(%s: %s)", type(e).__name__, e)
             self._jax_tracing = False
 
     def _device_stop(self):
@@ -211,9 +217,19 @@ class Profiler:
             import jax
 
             jax.profiler.stop_trace()
-        except Exception:  # pragma: no cover
-            pass
+        except Exception as e:  # pragma: no cover
+            # a failed stop means the trace file may be truncated — say
+            # so instead of letting the operator trust a partial profile
+            self._logger().warning(
+                "profiler: stop_trace failed, device trace may be "
+                "truncated (%s: %s)", type(e).__name__, e)
         self._jax_tracing = False
+
+    @staticmethod
+    def _logger():
+        from ..distributed.log_utils import get_logger
+
+        return get_logger(name="paddle_tpu.profiler")
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):

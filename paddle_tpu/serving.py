@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .observability import catalog as _metrics
+from .observability import flightrecorder as _frec
 from .observability import tracing as _tracing
 from .tensor_class import Tensor, unwrap
 from .framework import random as _random
@@ -184,6 +185,43 @@ class _RequestBookkeeping:
             "prefix_pages_reused": self.prefix_pages_reused,
         }
 
+    def debug_state(self) -> dict:
+        """Host-side engine state for incident bundles and /debug/dump:
+        the slot table (who holds what, how far along), the queue, and
+        the stats() snapshot — everything an operator needs to answer
+        "what was the engine doing when it died" without a debugger."""
+        slots = []
+        for s, r in enumerate(self._slots):
+            slots.append(None if r is None else {
+                "rid": r.rid,
+                "prompt_tokens": int(r.ids.size),
+                "generated": len(r.tokens),
+                "max_new_tokens": r.max_new_tokens,
+                "slot": s,
+            })
+        return {
+            "engine": self._engine_label,
+            "max_batch": self.max_batch,
+            "slots": slots,
+            "queue": [r.rid for r in self._queue],
+            "poisoned": bool(getattr(self, "_poisoned", False)),
+            "prefix_pages_reused": self.prefix_pages_reused,
+            "stats": self.stats(),
+        }
+
+    # ---- flight-recorder hooks (shared by both engines) ----------------
+    # every hook guards on RECORDER.enabled FIRST — the disabled decode
+    # hot path pays one attribute read, exactly like the tracer's
+
+    def _fr_submit(self, req: _Request):
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_SUBMIT, rid=req.rid,
+                       engine=self._engine_label,
+                       prompt_tokens=int(req.ids.size),
+                       max_new_tokens=req.max_new_tokens,
+                       queue_depth=len(self._queue))
+
     def _observe_admission(self, req: _Request, now: float):
         """Queue-wait accounting at the moment a request takes a slot.
         Observed with the request's root span current, so the histogram
@@ -228,6 +266,13 @@ class _RequestBookkeeping:
     def _trace_admit(self, req: _Request, slot: int):
         """Close the queue-wait child the moment the request takes a
         slot; the slot lands on the root span for the timeline view."""
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_ADMIT, rid=req.rid,
+                       engine=self._engine_label, slot=slot,
+                       queue_wait_s=(req.t_admit - req.t_enqueue
+                                     if req.t_admit is not None else None),
+                       free_slots=self._slots.count(None))
         if req.queue_span is not None:
             req.queue_span.end()
             req.queue_span = None
@@ -248,6 +293,11 @@ class _RequestBookkeeping:
         """Retire the request's spans: a still-open queue-wait child
         (cancel before admission), an instant slot-free marker when it
         held a slot, then the root with its final status."""
+        rec = _frec.RECORDER
+        if rec.enabled and req.slot >= 0:
+            rec.record(_frec.EV_SLOT_FREE, rid=req.rid,
+                       engine=self._engine_label, slot=req.slot,
+                       status=status, generated=len(req.tokens))
         if req.queue_span is not None:
             req.queue_span.end(status)
             req.queue_span = None
@@ -275,9 +325,13 @@ class _RequestBookkeeping:
         the next step() stops decoding the row and admission can refill
         it. Partial tokens are NOT delivered. Returns True if the request
         was live (queued or active); False if unknown or finished."""
+        rec = _frec.RECORDER
         for i, req in enumerate(self._queue):
             if req.rid == rid:
                 del self._queue[i]
+                if rec.enabled:
+                    rec.record(_frec.EV_CANCEL, rid=rid,
+                               engine=self._engine_label, where="queued")
                 self._record_reason(rid, "cancelled")
                 self._trace_end(req, "cancelled")
                 return True
@@ -285,6 +339,9 @@ class _RequestBookkeeping:
             if req is not None and req.rid == rid:
                 self._slots[s] = None
                 self._lengths = self._lengths.at[s].set(0)
+                if rec.enabled:
+                    rec.record(_frec.EV_CANCEL, rid=rid,
+                               engine=self._engine_label, where="active")
                 self._record_reason(rid, "cancelled")
                 self._trace_end(req, "cancelled")
                 self._admit()     # the freed slot can refill immediately
@@ -509,6 +566,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         # span so the caller's trace continues through the engine
         self._trace_submit(req, trace_ctx)
         self._queue.append(req)
+        self._fr_submit(req)
         self._admit()
         return rid
 
@@ -563,6 +621,12 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         now = time.perf_counter()
         self._m_step.observe(now - t_dispatch)
         self._n_steps += 1
+        rec = _frec.RECORDER
+        if rec.enabled:
+            # ONE event per fused dispatch (not per token): the black box
+            # stays O(steps) however many slots decode concurrently
+            rec.record(_frec.EV_STEP, engine=self._engine_label,
+                       active=self.num_active, seconds=now - t_dispatch)
         # perf_counter and perf_counter_ns share one clock, so the span
         # bounds come from the timestamps already taken for the metric
         trace_on = _tracing.get_tracer().enabled
@@ -681,6 +745,25 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 self._m_prefill.observe(time.perf_counter() - t_adm)
             self._slots[slot] = req
             req.slot = slot
+            self._fr_page_pressure()
+
+    def _fr_page_pressure(self):
+        """Sample kv page-pool pressure into the flight recorder after an
+        admission — the reading that explains a later OOM or an admit
+        stall. Host bookkeeping only (prompt + generated lengths); never
+        touches device arrays."""
+        rec = _frec.RECORDER
+        if not rec.enabled:
+            return
+        ps = self.page_size
+        used = 0
+        for r in self._slots:
+            if r is not None:
+                used += -(-(int(r.ids.size) + len(r.tokens)) // ps)
+        rec.record(_frec.EV_PAGE_PRESSURE, engine=self._engine_label,
+                   pages_used=used,
+                   pages_total=self.max_batch * self._pages_per_slot,
+                   free_slots=self._slots.count(None))
 
     def _scatter_fn(self, bucket: int):
         """One jitted, page-DONATING scatter of a prefilled prompt into a
@@ -1202,6 +1285,7 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         if req.span is not None:
             req.span.set_attr("encoder_positions", int(t_enc))
         self._queue.append(req)
+        self._fr_submit(req)
         self._admit()
         return rid
 
@@ -1344,6 +1428,10 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         now = time.perf_counter()
         self._m_step.observe(now - t_dispatch)
         self._n_steps += 1
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_STEP, engine=self._engine_label,
+                       active=self.num_active, seconds=now - t_dispatch)
         trace_on = _tracing.get_tracer().enabled
         t0_ns, t1_ns = (int(t_dispatch * 1e9), int(now * 1e9)) \
             if trace_on else (0, 0)

@@ -26,6 +26,12 @@ for paddle_tpu, stdlib-only (no web framework in the image):
   /v1/completions`` accepts an inbound W3C ``traceparent`` header
   (continuing the caller's trace) and always answers with one, so
   external callers correlate their spans with the engine's.
+- ``GET /debug/dump`` — the incident bundle (flight-recorder event
+  ring, spans, metrics snapshot, engine slot/queue state, thread
+  stacks) as JSON on demand; ``?write=1`` persists it rank-suffixed to
+  the incident directory. ``GET /debug/events?since=N`` tails the
+  flight-recorder ring incrementally. See docs/SERVING.md "Incident
+  forensics".
 
 Single-engine-thread design: device state (page pool, slot buffers) is
 touched ONLY by the engine thread; HTTP handler threads enqueue
@@ -47,6 +53,7 @@ from urllib.parse import parse_qs, urlsplit
 import numpy as np
 
 from .observability import PROMETHEUS_CONTENT_TYPE, get_registry
+from .observability import flightrecorder as _frec
 from .observability import tracing as _tracing
 from .observability.catalog import HTTP_REQUESTS
 
@@ -55,7 +62,7 @@ __all__ = ["CompletionServer", "serve"]
 # known routes for the http counter — anything else buckets under
 # "other" so a scanner can't explode the label cardinality
 _KNOWN_ROUTES = ("/health", "/metrics", "/v1/models", "/v1/completions",
-                 "/trace", "/trace/chrome")
+                 "/trace", "/trace/chrome", "/debug/dump", "/debug/events")
 
 
 class _Submission:
@@ -96,7 +103,8 @@ class CompletionServer:
 
     def __init__(self, engine, tokenizer=None, model_name: str = "paddle-tpu",
                  host: str = "127.0.0.1", port: int = 0,
-                 enable_tracing: bool = True):
+                 enable_tracing: bool = True,
+                 enable_flight_recorder: bool = True):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
@@ -106,6 +114,13 @@ class CompletionServer:
         if enable_tracing:
             _tracing.get_tracer().enable()
         self._tracer = _tracing.get_tracer()
+        # likewise a flight-recorder subscriber (it serves /debug/*):
+        # turn the black box on and let incident bundles see this
+        # engine's slot/queue state
+        if enable_flight_recorder:
+            _frec.get_recorder().enable()
+        _frec.get_reporter().register_engine(
+            getattr(engine, "_engine_label", "engine"), engine)
         self._subs: "queue.Queue[_Submission]" = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._engine_loop,
@@ -174,6 +189,14 @@ class CompletionServer:
             ev.put(("fault", str(e), True))
 
     def _engine_loop(self):
+        # crash boundary: an escaping engine fault writes an incident
+        # bundle (when a reporter is active) before the thread dies, and
+        # an XLA RESOURCE_EXHAUSTED re-raises enriched with the bundle
+        # path — the operator gets forensics, not a bare traceback
+        with _frec.incident_scope("serving.engine_loop"):
+            self._engine_loop_inner()
+
+    def _engine_loop_inner(self):
         eng = self.engine
         while not self._stop.is_set():
             # drain submissions (engine thread is the ONLY device-state
@@ -291,6 +314,38 @@ class CompletionServer:
                     return self._json(200, trace, headers=(
                         ("Content-Disposition",
                          'attachment; filename="paddle_tpu_trace.json"'),))
+                if route == "/debug/dump":
+                    # the incident bundle ON DEMAND (no crash needed):
+                    # event ring, spans, metrics, engine slot/queue
+                    # state, config, thread stacks. ?write=1 persists it
+                    # to the reporter's incident directory instead.
+                    rep = _frec.get_reporter()
+                    if parse_qs(query).get("write"):
+                        path = rep.dump("manual",
+                                        context="GET /debug/dump?write=1")
+                        return self._json(200, {"path": path})
+                    _frec.RECORDER.record(_frec.EV_INCIDENT,
+                                          reason="manual", path=None)
+                    return self._json(200, rep.bundle(
+                        "manual", context="GET /debug/dump"))
+                if route == "/debug/events":
+                    q = parse_qs(query)
+                    try:
+                        since = int((q.get("since") or ["0"])[0])
+                        limit = int((q.get("limit") or ["500"])[0])
+                    except ValueError:
+                        return self._json(
+                            400, {"error": "since/limit must be integers"})
+                    kind = (q.get("kind") or [None])[0]
+                    rec = _frec.get_recorder()
+                    evs = rec.events(since=since, kind=kind, limit=limit)
+                    return self._json(200, {
+                        "events": evs,
+                        # resume cursor: pass back as ?since= to tail the
+                        # ring incrementally
+                        "next_since": (evs[-1]["seq"] if evs else since),
+                        "stats": rec.stats(),
+                    })
                 if self.path == "/metrics":
                     # refresh the occupancy gauges off the engine's ONE
                     # stats() snapshot, then render the whole registry;
@@ -332,6 +387,10 @@ class CompletionServer:
                 # caller's trace when an inbound W3C traceparent header
                 # is present; its context parents the engine's
                 # serving.request root span
+                rec = _frec.RECORDER
+                if rec.enabled:
+                    rec.record(_frec.EV_HTTP_REQUEST, method="POST",
+                               path=self.path)
                 ctx = _tracing.parse_traceparent(
                     self.headers.get(_tracing.TRACEPARENT_HEADER))
                 sp = server_self._tracer.start_span(

@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""read_incident: pretty-print a paddle_tpu incident bundle.
+
+An incident bundle (see docs/SERVING.md "Incident forensics") is one
+JSON file holding the flight-recorder event ring, spans, a metrics
+snapshot, engine slot/queue state, and every thread's stack at the
+moment of failure. This tool renders it for a human mid-incident — a
+timeline, the last-K events per subsystem, the engine state, and a
+stack summary — so a bundle is usable without jq gymnastics.
+
+Usage:
+    python scripts/read_incident.py incident-....json
+    python scripts/read_incident.py incident-....json --events 40
+    python scripts/read_incident.py incident-....json --subsystem engine
+    python scripts/read_incident.py incident-....json --timeline
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_RESERVED = ("seq", "ts", "mono_ns", "kind", "tid")
+
+
+def load_bundle(path: str) -> dict:
+    """Load + schema-validate (a truncated or foreign file should fail
+    loudly, not render half a report)."""
+    sys.path.insert(0, _REPO)
+    from paddle_tpu.observability.flightrecorder import validate_bundle
+
+    with open(path, encoding="utf-8") as f:
+        return validate_bundle(json.load(f))
+
+
+def _fmt_fields(ev: dict) -> str:
+    return " ".join(f"{k}={ev[k]}" for k in ev if k not in _RESERVED)
+
+
+def _rel_ms(ev: dict, t_end_ns: float) -> float:
+    """Event age relative to the newest event, in ms (negative = past)."""
+    return (ev["mono_ns"] - t_end_ns) / 1e6
+
+
+def format_header(b: dict) -> List[str]:
+    when = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(b["ts"]))
+    lines = [
+        "=" * 72,
+        f"INCIDENT  reason={b['reason']}  context={b.get('context')}",
+        f"  at {when}  host={b['host']}  pid={b['pid']} "
+        f"rank={b['rank']}  schema={b['schema']}",
+    ]
+    cfg = b.get("config", {})
+    vers = " ".join(f"{k}={cfg[k]}" for k in
+                    ("python", "jax", "numpy", "paddle_tpu") if k in cfg)
+    if vers:
+        lines.append(f"  {vers}")
+    if cfg.get("devices"):
+        lines.append(f"  devices: {cfg['devices']}")
+    rec = b.get("recorder", {})
+    lines.append(f"  ring: {rec.get('buffered', 0)} buffered / "
+                 f"{rec.get('recorded', 0)} recorded / "
+                 f"{rec.get('dropped', 0)} dropped")
+    exc = b.get("exception")
+    if exc:
+        lines.append("-" * 72)
+        lines.append(f"EXCEPTION {exc['type']}"
+                     + (f" [{exc['classified']}]"
+                        if exc.get("classified") else "")
+                     + f": {exc['message']}")
+        tb = exc.get("traceback") or []
+        lines.extend("  " + ln for ln in tb[-6:])
+    return lines
+
+
+def format_timeline(b: dict, last: int = 30) -> List[str]:
+    """The merged event timeline, newest-anchored relative times."""
+    events = b.get("events") or []
+    if not events:
+        return ["(event ring empty — was the recorder enabled?)"]
+    t_end = max(e["mono_ns"] for e in events)
+    lines = [f"TIMELINE (last {min(last, len(events))} of {len(events)} "
+             "events; t is ms before the newest event)"]
+    for ev in events[-last:]:
+        lines.append(f"  t{_rel_ms(ev, t_end):+10.1f}ms  "
+                     f"{ev['kind']:<22} {_fmt_fields(ev)}")
+    return lines
+
+
+def format_subsystems(b: dict, k: int = 5,
+                      only: str = "") -> List[str]:
+    """Last-K events per subsystem (the prefix before the first dot)."""
+    groups: Dict[str, List[dict]] = {}
+    for ev in b.get("events") or []:
+        groups.setdefault(ev["kind"].split(".", 1)[0], []).append(ev)
+    lines = [f"LAST {k} EVENTS PER SUBSYSTEM"]
+    for sub in sorted(groups):
+        if only and sub != only:
+            continue
+        evs = groups[sub]
+        lines.append(f"  [{sub}]  ({len(evs)} events)")
+        for ev in evs[-k:]:
+            lines.append(f"    seq={ev['seq']:<6} {ev['kind']:<22} "
+                         f"{_fmt_fields(ev)}")
+    return lines
+
+
+def format_engines(b: dict) -> List[str]:
+    engines = b.get("engines") or {}
+    if not engines:
+        return ["(no engines registered)"]
+    lines = ["ENGINE STATE"]
+    for name, st in sorted(engines.items()):
+        if "error" in st:
+            lines.append(f"  [{name}] state unavailable: {st['error']}")
+            continue
+        stats = st.get("stats", {})
+        lines.append(
+            f"  [{name}] {stats.get('requests_active', '?')}/"
+            f"{st.get('max_batch', '?')} slots busy, "
+            f"{len(st.get('queue', []))} queued, "
+            f"poisoned={st.get('poisoned')}, "
+            f"steps={stats.get('decode_steps', '?')}, "
+            f"tokens={stats.get('tokens_generated', '?')}")
+        for slot in st.get("slots") or []:
+            if slot is None:
+                continue
+            lines.append(
+                f"    slot {slot['slot']}: rid={slot['rid']} "
+                f"{slot['generated']}/{slot['max_new_tokens']} tokens "
+                f"(prompt {slot['prompt_tokens']})")
+        if st.get("queue"):
+            lines.append(f"    queued rids: {st['queue']}")
+    return lines
+
+
+def format_threads(b: dict, frames: int = 3) -> List[str]:
+    lines = ["THREADS (innermost frames)"]
+    for th in b.get("threads") or []:
+        lines.append(f"  [{th.get('name', '?')}] id={th.get('thread_id')}")
+        stack = th.get("stack") or []
+        # each format_stack entry is "  File ...\n    code"; keep the
+        # innermost few so a deadlock reads at a glance
+        lines.extend("    " + ln.strip()
+                     for ln in stack[-frames:])
+    return lines
+
+
+def format_spans(b: dict, last: int = 10) -> List[str]:
+    spans = b.get("spans") or []
+    if not spans:
+        return []
+    lines = [f"SPANS (last {min(last, len(spans))} of {len(spans)})"]
+    for sp in spans[-last:]:
+        dur = ("in flight" if sp.get("end_ns") is None else
+               f"{(sp['end_ns'] - sp['start_ns']) / 1e6:.2f}ms")
+        lines.append(f"  {sp['name']:<22} {sp.get('status'):<10} {dur}  "
+                     f"trace={str(sp.get('trace_id'))[:8]}")
+    return lines
+
+
+def render(b: dict, events: int = 30, per_subsystem: int = 5,
+           subsystem: str = "", timeline_only: bool = False) -> str:
+    sections = [format_header(b)]
+    if timeline_only:
+        sections.append(format_timeline(b, last=events))
+    else:
+        sections.extend([
+            format_timeline(b, last=events),
+            format_subsystems(b, k=per_subsystem, only=subsystem),
+            format_engines(b),
+            format_spans(b),
+            format_threads(b),
+        ])
+    return "\n".join("\n".join(s) for s in sections if s)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="read_incident", description=__doc__)
+    p.add_argument("bundle", help="path to an incident-*.json bundle")
+    p.add_argument("--events", type=int, default=30,
+                   help="timeline length (default 30)")
+    p.add_argument("--per-subsystem", type=int, default=5,
+                   help="last-K events per subsystem (default 5)")
+    p.add_argument("--subsystem", default="",
+                   help="show only this subsystem's events "
+                        "(engine, http, jit, collective, rank, "
+                        "watchdog, train, incident)")
+    p.add_argument("--timeline", action="store_true",
+                   help="timeline only (skip subsystem/engine/thread "
+                        "sections)")
+    args = p.parse_args(argv)
+    try:
+        b = load_bundle(args.bundle)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"read_incident: {e}", file=sys.stderr)
+        return 1
+    print(render(b, events=args.events,
+                 per_subsystem=args.per_subsystem,
+                 subsystem=args.subsystem,
+                 timeline_only=args.timeline))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
