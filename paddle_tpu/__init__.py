@@ -107,7 +107,7 @@ def in_dynamic_mode() -> bool:
 
     try:
         return jax.core.trace_state_clean()
-    except Exception:  # pragma: no cover - jax internal API drift
+    except Exception:  # pragma: no cover - jax internal API drift  # pdlint: disable=silent-exception -- probe of a jax-internal API: outside a trace the True (eager) answer is correct, and there is nothing to log per-call on this hot predicate
         return True
 
 

@@ -15,6 +15,11 @@ from typing import Optional
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "csrc")
 _SOURCES = ["tcp_store.cpp", "shm_queue.cpp"]
+# -lrt: shm_open/shm_unlink live in librt before glibc 2.34 (the symbol
+# is in libc proper afterwards, where the flag is a harmless no-op) —
+# without it the .so builds fine and then fails at dlopen with
+# "undefined symbol: shm_open" on older glibc
+_LINK_FLAGS = ["-lrt"]
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 
@@ -31,6 +36,9 @@ def _src_digest() -> str:
     for s in _SOURCES:
         with open(os.path.join(_SRC_DIR, s), "rb") as f:
             h.update(f.read())
+    # link flags are part of the identity: a cached .so built WITHOUT
+    # -lrt would otherwise shadow the fixed build forever
+    h.update(" ".join(_LINK_FLAGS).encode())
     return h.hexdigest()[:16]
 
 
@@ -42,7 +50,7 @@ def build_native(verbose: bool = False) -> str:
     srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
     tmp = so + f".build.{os.getpid()}"
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           "-o", tmp, *srcs]
+           "-o", tmp, *srcs, *_LINK_FLAGS]
     try:
         subprocess.run(cmd, check=True, capture_output=not verbose)
     except (subprocess.CalledProcessError, FileNotFoundError) as e:

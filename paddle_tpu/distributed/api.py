@@ -43,7 +43,7 @@ class DistAttr:
 def _in_trace() -> bool:
     try:
         return not jax.core.trace_state_clean()
-    except Exception:  # pragma: no cover
+    except Exception:  # pragma: no cover  # pdlint: disable=silent-exception -- probe of a jax-internal API: False (not tracing) is the safe answer, and this predicate runs per shard_tensor call
         return False
 
 
@@ -124,8 +124,16 @@ def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements) -> Tensor:
             out = wrap(global_arr)
             out._dist_attr = DistAttr(mesh, placements)
             return out
-    except Exception:
-        pass
+    except Exception as e:
+        # falling back to the single-process path in a MULTI-process job
+        # silently builds a tensor from one rank's shard — numerically
+        # wrong everywhere else, so the downgrade must be visible
+        from .log_utils import get_logger
+
+        get_logger().warning(
+            "dtensor_from_local: multiprocess assembly failed (%s: %s); "
+            "falling back to the single-process layout",
+            type(e).__name__, e)
     # single-process path: arr already holds the full value laid out locally
     out = wrap(jax.device_put(arr, sharding))
     out._dist_attr = DistAttr(mesh, placements)

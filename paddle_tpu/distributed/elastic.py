@@ -135,6 +135,46 @@ class ElasticManager:
                                "(%s: %s)", rank, type(e).__name__, e)
             return False
 
+    # ---- membership metadata -------------------------------------------------
+    # a lease says a rank is ALIVE; metadata says WHAT it is. The serving
+    # pool (serving_cluster) publishes each worker's address/role/handoff
+    # channel here so the router discovers workers the same way trainers
+    # discover peers — through the store, no side channel.
+
+    def register_metadata(self, info: dict):
+        """Publish this rank's JSON metadata next to its lease."""
+        import json
+
+        self._store.set(f"{self._prefix}/meta/{self.rank}",
+                        json.dumps(info))
+
+    def peer_metadata(self, rank: int) -> Optional[dict]:
+        """A peer's published metadata, or None when it never published
+        (or published garbage — treated as absent, like a garbled lease
+        stamp)."""
+        import json
+
+        try:
+            raw = self._store.get(f"{self._prefix}/meta/{rank}",
+                                  timeout=0.2)
+            return json.loads(raw)
+        except (TimeoutError, ValueError):
+            return None
+        except Exception as e:
+            get_logger().debug("elastic metadata probe for rank %s failed "
+                               "(%s: %s)", rank, type(e).__name__, e)
+            return None
+
+    def lease_age(self, rank: Optional[int] = None) -> Optional[float]:
+        """Seconds since ``rank``'s (default: this rank's) newest
+        heartbeat stamp; None when it never registered. An age past
+        ``ttl`` is a lapsed lease — the /health surface exposes this so
+        a load balancer sees staleness before the pool reacts."""
+        st = self._stamp(self.rank if rank is None else rank)
+        if st is None:
+            return None
+        return max(0.0, time.monotonic() - st)
+
     # ---- peer view ----------------------------------------------------------
     def _stamp(self, rank: int) -> Optional[float]:
         try:

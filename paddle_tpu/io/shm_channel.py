@@ -75,11 +75,30 @@ def _unpack(buf: bytes) -> Any:
     return rebuild(skeleton)
 
 
+class ShmChannelTimeout(TimeoutError):
+    """A put()/get() deadline elapsed. Subclasses TimeoutError so existing
+    ``except TimeoutError`` consumers (the DataLoader drain loop) keep
+    working, but carries what a bare TimeoutError couldn't: WHICH channel
+    stalled and how full its ring was at the moment of the timeout — the
+    two facts that distinguish a dead producer (empty) from a stuck
+    consumer (full) without a debugger."""
+
+    def __init__(self, message: str, *, channel: str, qsize: int,
+                 op: str = "?"):
+        super().__init__(message)
+        self.channel = channel
+        self.qsize = qsize
+        self.op = op  # "put" | "get"
+
+
 class ShmChannel:
     """Process-shared bounded queue of python batches over the C++ ring."""
 
     def __init__(self, name: str = None, capacity_mb: int = 64,
                  create: bool = True):
+        # order matters for a failed constructor: __del__ runs on partial
+        # objects, so _h must exist before anything here can raise
+        self._h = None
         self._lib = load_native()
         self.name = name or f"/pdtpu_q_{os.getpid()}_{id(self) & 0xFFFF}"
         if create:
@@ -88,6 +107,7 @@ class ShmChannel:
         else:
             self._h = self._lib.pd_shmq_open(self.name.encode())
         if not self._h:
+            self._h = None
             raise RuntimeError(f"shm queue {'create' if create else 'open'} "
                                f"failed for {self.name}")
         self._owner = create
@@ -95,22 +115,38 @@ class ShmChannel:
     def open_in_child(self) -> "ShmChannel":
         return ShmChannel(self.name, create=False)
 
+    def _handle(self):
+        """The live native handle, or a clear error once closed — a None
+        handle passed into ctypes would segfault, not raise."""
+        if self._h is None:
+            raise BrokenPipeError(f"shm channel {self.name} is closed")
+        return self._h
+
     def put(self, obj: Any, timeout: float = 300.0) -> None:
+        h = self._handle()
         data = _pack(obj)
-        rc = self._lib.pd_shmq_push(self._h, data, len(data), timeout)
+        rc = self._lib.pd_shmq_push(h, data, len(data), timeout)
         if rc == 1:
-            raise TimeoutError("shm queue full")
+            depth = self.qsize()
+            raise ShmChannelTimeout(
+                f"shm channel {self.name}: put timed out after {timeout}s "
+                f"(ring full, qsize={depth}) — the consumer is not "
+                f"draining", channel=self.name, qsize=depth, op="put")
         if rc == -2:
-            raise BrokenPipeError("shm queue closed")
+            raise BrokenPipeError(f"shm channel {self.name} closed")
         if rc != 0:
             raise RuntimeError(f"shm push failed (batch {len(data)} bytes "
                                f"exceeds ring capacity?)")
 
     def get(self, timeout: float = 300.0) -> Any:
+        h = self._handle()
         out = ctypes.POINTER(ctypes.c_char)()
-        n = self._lib.pd_shmq_pop(self._h, ctypes.byref(out), timeout)
+        n = self._lib.pd_shmq_pop(h, ctypes.byref(out), timeout)
         if n == -2:
-            raise TimeoutError("shm queue empty")
+            raise ShmChannelTimeout(
+                f"shm channel {self.name}: get timed out after {timeout}s "
+                f"(ring empty, qsize=0) — no producer delivered",
+                channel=self.name, qsize=0, op="get")
         if n == -3:
             raise EOFError("shm queue closed and drained")
         if n < 0:
@@ -120,18 +156,23 @@ class ShmChannel:
         return _unpack(buf)
 
     def qsize(self) -> int:
-        return int(self._lib.pd_shmq_count(self._h))
+        return int(self._lib.pd_shmq_count(self._handle()))
 
     def close_writers(self):
-        self._lib.pd_shmq_close_writers(self._h)
+        self._lib.pd_shmq_close_writers(self._handle())
 
     def close(self):
-        if self._h:
-            self._lib.pd_shmq_close(self._h)
-            self._h = None
+        """Idempotent: the handle is swapped out BEFORE the native close,
+        so a second close() (or a close() racing __del__ at teardown) is
+        a no-op instead of a double-free on the same native handle."""
+        h, self._h = self._h, None
+        if h is not None:
+            self._lib.pd_shmq_close(h)
 
     def __del__(self):
         try:
-            self.close()
+            # getattr: __init__ may have failed before _h existed
+            if getattr(self, "_h", None) is not None:
+                self.close()
         except Exception:  # pdlint: disable=silent-exception -- interpreter teardown: ctypes/logging may already be gone
             pass

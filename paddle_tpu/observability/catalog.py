@@ -85,6 +85,21 @@ HTTP_REQUESTS = _R.counter(
     "under path=other)",
     labels=("path", "code"))
 
+# ---- disaggregated serving tier (serving_cluster router) -------------------
+
+ROUTER_PLACEMENTS = _R.counter(
+    "router_placements_total",
+    "Cluster-router placement outcomes (outcome=placed|retried|failed); "
+    "retried counts every failed attempt that was requeued, failed "
+    "counts requests that exhausted the retry budget",
+    labels=("outcome",))
+
+ROUTER_WORKERS = _R.gauge(
+    "router_workers",
+    "Workers in the router's pool by liveness (state=alive|lost; "
+    "refreshed on every pool poll and /metrics scrape)",
+    labels=("state",))
+
 # ---- observability self-telemetry ------------------------------------------
 
 TRACING_SPANS_DROPPED = _R.counter(

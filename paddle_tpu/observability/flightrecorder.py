@@ -116,6 +116,30 @@ EV_TRAIN_STEP = _register(
 EV_INCIDENT = _register(
     "incident.dump",
     "an incident bundle was written or served (reason, path)")
+EV_ROUTER_PLACE = _register(
+    "router.place",
+    "the cluster router placed a request on a worker (replica_id, role, "
+    "score, attempt, mode=direct|disagg)")
+EV_ROUTER_RETRY = _register(
+    "router.retry",
+    "a placement failed and the request was requeued onto another "
+    "worker (replica_id, attempt, delivered, reason)")
+EV_ROUTER_WORKER_JOIN = _register(
+    "router.worker_join",
+    "a worker's lease + metadata appeared in the pool (replica_id, "
+    "role, url)")
+EV_ROUTER_WORKER_LOST = _register(
+    "router.worker_lost",
+    "a worker left the pool (replica_id, reason=lease|connection) — "
+    "its in-flight requests requeue through router.retry")
+EV_KV_HANDOFF_SEND = _register(
+    "kv.handoff_send",
+    "a prefill worker shipped a finished prompt's KV pages to a decode "
+    "worker (handoff_id, channel, prompt_tokens, bytes)")
+EV_KV_HANDOFF_RECV = _register(
+    "kv.handoff_recv",
+    "a decode worker received a prefilled-KV bundle off its handoff "
+    "channel (handoff_id, channel, prompt_tokens, bytes)")
 
 
 # ---- the ring ---------------------------------------------------------------

@@ -85,6 +85,16 @@ SPAN_HTTP_REQUEST = _register(
     "http.request",
     "HTTP handler span; parents serving.request and carries the "
     "inbound traceparent context when the caller sent one")
+SPAN_ROUTER_REQUEST = _register(
+    "router.request",
+    "cluster-router handler span around one proxied completion "
+    "(continues the caller's traceparent; parents router.upstream and, "
+    "across the process boundary, the worker's http.request)")
+SPAN_ROUTER_UPSTREAM = _register(
+    "router.upstream",
+    "child of router.request: ONE placement attempt against one worker "
+    "(attrs: replica_id, role, attempt; a retried request records one "
+    "per attempt)")
 SPAN_TRAIN_STEP = _register(
     "train.step",
     "one train-loop step (observability StepTimer begin/end, and the "

@@ -48,7 +48,7 @@ class _Request:
                  "on_token", "on_token_arity", "pixel_values",
                  "stop_token_ids", "logprobs", "want_logprobs",
                  "encoder_input", "seed_ids", "t_enqueue", "t_admit",
-                 "t_last", "span", "queue_span")
+                 "t_last", "span", "queue_span", "handoff")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
                  on_token=None, pixel_values=None, stop_token_ids=None,
@@ -81,6 +81,7 @@ class _Request:
         self.logprobs: List[float] = []
         self.encoder_input = None   # Seq2SeqBatchEngine payload
         self.seed_ids = None        # Seq2SeqBatchEngine decoder prompt
+        self.handoff = None         # prefilled-KV bundle (disaggregated tier)
         # streaming callbacks may take (rid, tok, done) or a 4th logprob
         # arg; arity detected once at admission by counting REQUIRED
         # positional parameters only (a defaulted 4th param keeps the
@@ -543,16 +544,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                     f"prompt has {n_slots} image tokens but "
                     f"{pixel_values.shape[0]} image(s) produce {want} "
                     "features")
-        sampling = None
-        if any(v is not None for v in (do_sample, temperature, top_k, top_p)):
-            eng_s, eng_t, eng_k, eng_p = self._sample_cfg
-            sampling = (
-                bool(eng_s if do_sample is None else do_sample),
-                float(eng_t if temperature is None else temperature),
-                int(eng_k if top_k is None else top_k),
-                float(eng_p if top_p is None else top_p))
-            if sampling == self._sample_cfg:
-                sampling = None  # explicit values equal to the defaults
+        sampling = self._merge_sampling(do_sample, temperature, top_k, top_p)
         rid = self._next_rid
         self._next_rid += 1
         self._n_requests += 1
@@ -569,6 +561,138 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._fr_submit(req)
         self._admit()
         return rid
+
+    def _merge_sampling(self, do_sample, temperature, top_k, top_p):
+        """Per-request sampling tuple: engine defaults overlaid with the
+        request's overrides, collapsed to None when the result equals the
+        engine config (all-default mixes keep the static program)."""
+        if all(v is None for v in (do_sample, temperature, top_k, top_p)):
+            return None
+        eng_s, eng_t, eng_k, eng_p = self._sample_cfg
+        sampling = (
+            bool(eng_s if do_sample is None else do_sample),
+            float(eng_t if temperature is None else temperature),
+            int(eng_k if top_k is None else top_k),
+            float(eng_p if top_p is None else top_p))
+        return None if sampling == self._sample_cfg else sampling
+
+    # ---- disaggregated serving: prefill export / prefilled admission ----
+    def export_prefill(self, ids, max_new_tokens: int = 64) -> dict:
+        """Run the bucketed prefill for ONE prompt and return its KV as a
+        host-side handoff bundle instead of admitting it — the prefill
+        half of the disaggregated serving tier (serving_cluster). The
+        bundle is pure numpy (prompt ids, per-layer dense K/V buffers at
+        the prefill bucket, the last-logit row) so it ships over any
+        byte transport (io/shm_channel for the CPU dryrun path; device
+        collectives stay pluggable) and a peer engine over the SAME
+        weights resumes decoding with ``admit_prefilled``.
+
+        No slot is taken and no engine state changes — a prefill-role
+        worker's pool stays empty however many prompts it prefills."""
+        if self._latent_mode:
+            raise NotImplementedError(
+                "KV handoff is not supported in latent (MLA) mode — the "
+                "compressed cache rows are engine-layout-specific")
+        ids = np.asarray(unwrap(ids) if isinstance(ids, Tensor)
+                         else ids).reshape(-1)
+        if ids.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds engine max_len {self.max_len}")
+        req = _Request(-1, ids, max_new_tokens)
+        last, caches, S0, bucket = self._bucketed_prefill(req)
+        layers = []
+        for c in caches:
+            pair = []
+            for key in ("k", "v"):
+                buf = c[key] if not isinstance(c[key], Tensor) \
+                    else unwrap(c[key])
+                # the handoff IS the device->host export: one deliberate
+                # fetch per layer, off the decode loop entirely
+                pair.append(np.asarray(buf)[0])  # pdlint: disable=host-sync -- handoff export is the transfer
+            layers.append(tuple(pair))
+        last_row = np.asarray(last)[0].astype(np.float32)  # pdlint: disable=host-sync -- handoff export is the transfer
+        return {
+            "version": 1,
+            "ids": np.asarray(ids, np.int64),  # pdlint: disable=host-sync -- ids is the host prompt array, never device
+            "prompt_tokens": int(S0),  # pdlint: disable=host-sync -- S0 is a host int from _bucketed_prefill
+            "bucket": int(bucket),  # pdlint: disable=host-sync -- bucket is a host int from _bucketed_prefill
+            "page_size": int(self.page_size),
+            "layers": layers,
+            "last": last_row,
+        }
+
+    def admit_prefilled(self, handoff: dict, max_new_tokens: int = 64,
+                        do_sample=None, temperature=None, top_k=None,
+                        top_p=None, on_token=None, stop_token_ids=None,
+                        logprobs=False, trace_ctx=None) -> int:
+        """Queue a request whose prefill already happened on a PEER
+        engine (``export_prefill`` over the same weights): admission
+        scatters the bundle's KV buffers straight into the slot's pages
+        and decoding starts from the shipped last-logit row — the decode
+        half of the disaggregated tier. Sampling / stop / logprobs knobs
+        mirror ``add_request`` (they are decode-side concerns)."""
+        if self._latent_mode:
+            raise NotImplementedError(
+                "KV handoff is not supported in latent (MLA) mode")
+        bucket = int(handoff["bucket"])
+        if bucket % self.page_size != 0 or bucket > self.max_len:
+            raise ValueError(
+                f"handoff bucket {bucket} does not fit this engine "
+                f"(page_size {self.page_size}, max_len {self.max_len}) — "
+                f"prefill and decode engines must share the serving shape")
+        if len(handoff["layers"]) != len(self._caches):
+            raise ValueError(
+                f"handoff carries {len(handoff['layers'])} layers, engine "
+                f"has {len(self._caches)} — different models?")
+        ids = np.asarray(handoff["ids"]).reshape(-1)
+        if ids.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds engine max_len {self.max_len}")
+        if temperature is not None and temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature} "
+                             "(0 decodes greedily)")
+        sampling = self._merge_sampling(do_sample, temperature, top_k, top_p)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._n_requests += 1
+        self._m_req_admitted.inc()
+        req = _Request(rid, ids, max_new_tokens, sampling, on_token,
+                       stop_token_ids=stop_token_ids, want_logprobs=logprobs)
+        req.handoff = handoff
+        self._trace_submit(req, trace_ctx)
+        self._queue.append(req)
+        self._fr_submit(req)
+        self._admit()
+        return rid
+
+    def _admit_handoff(self, slot: int, req: _Request):
+        """Admission from a handoff bundle: rebuild the per-layer dense
+        buffers on device and reuse the SAME jitted page scatter as a
+        local prefill — no model forward runs here."""
+        h, req.handoff = req.handoff, None  # free the host KV after use
+        bucket, S0 = int(h["bucket"]), int(h["prompt_tokens"])
+        c_new = [{"k": jnp.asarray(k)[None], "v": jnp.asarray(v)[None]}
+                 for k, v in h["layers"]]
+        base = slot * self._pages_per_slot
+        pages = [(c["k_pages"], c["v_pages"]) for c in self._caches]
+        try:
+            new_pages = self._scatter_fn(bucket)(
+                pages, c_new, jnp.asarray(base, jnp.int32))
+        except Exception as e:
+            # same donation-failure protocol as a local prefill: the page
+            # pool may be gone, so poison instead of limping on
+            self._poisoned = True
+            raise RuntimeError(
+                "ContinuousBatchEngine: handoff admission failed after "
+                "the page pool was donated; rebuild the engine and "
+                "resubmit in-flight requests") from e
+        for c_eng, (kp, vp) in zip(self._caches, new_pages):
+            c_eng["k_pages"], c_eng["v_pages"] = kp, vp
+        self._last = self._last.at[slot].set(
+            jnp.asarray(h["last"], jnp.float32))
+        self._lengths = self._lengths.at[slot].set(S0)
 
     def logprobs(self, rid: int):
         """Chosen-token logprobs (model's raw distribution) for a
@@ -1151,6 +1275,10 @@ class ContinuousBatchEngine(_RequestBookkeeping):
     def _prefill_into(self, slot: int, req: _Request):
         """Bucketed jitted prefill of one prompt, scattered into the slot's
         pages; the slot's last-logit row seeds sampling."""
+        if req.handoff is not None:
+            # prefill already ran on a peer engine (disaggregated tier):
+            # scatter the shipped KV, run no model forward
+            return self._admit_handoff(slot, req)
         if self._latent_mode:
             return self._prefill_into_latent(slot, req)
         if self.enable_prefix_cache:

@@ -1,0 +1,31 @@
+"""Disaggregated serving tier: prefill/decode workers behind a
+queue-aware router.
+
+The single-process ``CompletionServer`` scaled out (ROADMAP item 1):
+
+- :mod:`worker` — a ``ContinuousBatchEngine`` per process in a role
+  (``prefill`` | ``decode`` | ``unified``), joining the pool through
+  ``distributed/elastic.py``'s lease/heartbeat + metadata;
+- :mod:`pool` — the router's membership + occupancy view (lease
+  freshness, ``/health`` polls, pending placements);
+- :mod:`router` — the front door: queue-depth-aware least-loaded
+  placement, SSE relay, bounded-retry failover, cross-process
+  ``traceparent`` propagation;
+- :mod:`kv_handoff` — prefill→decode KV shipping over
+  ``io/shm_channel`` (device collectives pluggable);
+- :mod:`launcher` — config → running tier (``scripts/serve_cluster.py``
+  is the CLI).
+
+See docs/SERVING.md "Disaggregated deployment".
+"""
+from .kv_handoff import KvHandoffReceiver, KvHandoffSender  # noqa: F401
+from .launcher import Cluster, launch_cluster, load_config  # noqa: F401
+from .pool import WorkerInfo, WorkerPool                    # noqa: F401
+from .router import RouterServer                            # noqa: F401
+from .worker import WorkerServer, run_worker                # noqa: F401
+
+__all__ = [
+    "Cluster", "KvHandoffReceiver", "KvHandoffSender", "RouterServer",
+    "WorkerInfo", "WorkerPool", "WorkerServer", "launch_cluster",
+    "load_config", "run_worker",
+]

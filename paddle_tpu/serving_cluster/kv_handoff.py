@@ -1,0 +1,190 @@
+"""KV handoff channel: prefill workers ship finished KV pages to decode
+workers.
+
+The disaggregated tier's data plane. A prefill worker runs
+``engine.export_prefill`` (bucketed prefill, KV fetched to host as numpy)
+and SENDS the bundle; the decode worker that owns the channel RECEIVES
+it, parks it by ``handoff_id``, and admits it with
+``engine.admit_prefilled`` when the router's completion request arrives —
+the decode engine never runs the prompt's forward pass.
+
+Transport is pluggable (``make_receiver``/``open_sender`` route through
+``TRANSPORTS``): the CPU dryrun path rides ``io/shm_channel``'s native
+ring (numpy payloads serialize as raw bytes, no pickle on the KV), and a
+device-collective transport can register under its own name when
+same-slice workers can move pages device-to-device without the host
+round-trip. Every send/recv is a flight-recorder event
+(``kv.handoff_send`` / ``kv.handoff_recv``) so a lost bundle is visible
+in both processes' rings.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..distributed.log_utils import get_logger
+from ..io.shm_channel import ShmChannel, ShmChannelTimeout
+from ..observability import flightrecorder as _frec
+
+__all__ = ["KvHandoffSender", "KvHandoffReceiver", "bundle_nbytes",
+           "make_receiver", "open_sender", "TRANSPORTS"]
+
+
+def bundle_nbytes(bundle: dict) -> int:
+    """Approximate wire size of a handoff bundle (the numpy leaves; the
+    skeleton is noise) — the number the flight-recorder events carry."""
+    total = 0
+
+    def walk(o):
+        nonlocal total
+        if isinstance(o, np.ndarray):
+            total += o.nbytes
+        elif isinstance(o, (list, tuple)):
+            for x in o:
+                walk(x)
+        elif isinstance(o, dict):
+            for x in o.values():
+                walk(x)
+
+    walk(bundle)
+    return total
+
+
+class KvHandoffSender:
+    """Prefill-side: opens a decode worker's channel BY NAME and pushes
+    bundles into it. One sender per (prefill worker, decode channel)
+    pair; senders are cheap — the ring is owned by the receiver."""
+
+    def __init__(self, channel_name: str, timeout: float = 30.0):
+        self.channel_name = channel_name
+        self.timeout = float(timeout)
+        self._chan = ShmChannel(channel_name, create=False)
+
+    def send(self, handoff_id: str, bundle: dict) -> int:
+        """Ship one bundle; returns its approximate byte size. Raises
+        ``ShmChannelTimeout`` when the decode worker stops draining."""
+        nbytes = bundle_nbytes(bundle)
+        self._chan.put({"handoff_id": handoff_id, "bundle": bundle},
+                       timeout=self.timeout)
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_KV_HANDOFF_SEND, handoff_id=handoff_id,
+                       channel=self.channel_name,
+                       prompt_tokens=int(bundle.get("prompt_tokens", 0)),
+                       bytes=nbytes)
+        return nbytes
+
+    def close(self):
+        self._chan.close()
+
+
+class KvHandoffReceiver:
+    """Decode-side: owns the shm ring, drains it from a consumer thread,
+    and parks bundles by ``handoff_id`` until the matching completion
+    request claims them with :meth:`wait`."""
+
+    def __init__(self, name: Optional[str] = None, capacity_mb: int = 64,
+                 max_parked: int = 64):
+        self.name = name or f"/pdtpu_kv_{os.getpid()}"
+        self._chan = ShmChannel(self.name, capacity_mb=capacity_mb,
+                                create=True)
+        self._lock = threading.Lock()
+        self._parked: Dict[str, dict] = {}
+        self._arrived = threading.Condition(self._lock)
+        self._max_parked = int(max_parked)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- consumer ------------------------------------------------------
+    def start(self) -> "KvHandoffReceiver":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name="kv-handoff-recv")
+        self._thread.start()
+        return self
+
+    def _drain(self):
+        while not self._stop.is_set():
+            try:
+                msg = self._chan.get(timeout=0.2)
+            except ShmChannelTimeout:
+                continue
+            except (EOFError, BrokenPipeError):
+                return  # channel closed: consumer is done
+            except Exception as e:
+                get_logger().warning(
+                    "kv handoff receiver %s: drain failed (%s: %s)",
+                    self.name, type(e).__name__, e)
+                continue
+            hid = msg.get("handoff_id")
+            bundle = msg.get("bundle")
+            if hid is None or bundle is None:
+                get_logger().warning("kv handoff receiver %s: malformed "
+                                     "message dropped", self.name)
+                continue
+            rec = _frec.RECORDER
+            if rec.enabled:
+                rec.record(_frec.EV_KV_HANDOFF_RECV, handoff_id=hid,
+                           channel=self.name,
+                           prompt_tokens=int(
+                               bundle.get("prompt_tokens", 0)),
+                           bytes=bundle_nbytes(bundle))
+            with self._arrived:
+                # bounded parking: an orphaned bundle (its completion
+                # request never came) must not hold KV bytes forever
+                while len(self._parked) >= self._max_parked:
+                    evicted = next(iter(self._parked))
+                    del self._parked[evicted]
+                    get_logger().warning(
+                        "kv handoff receiver %s: parked bundle %s "
+                        "evicted (never claimed)", self.name, evicted)
+                self._parked[hid] = bundle
+                self._arrived.notify_all()
+
+    # ---- claim ---------------------------------------------------------
+    def wait(self, handoff_id: str,
+             timeout: float = 30.0) -> Optional[dict]:
+        """Claim (and remove) the bundle for ``handoff_id``, blocking up
+        to ``timeout``; None when it never arrives (the prefill worker
+        died mid-handoff — the caller's 5xx turns into a router retry)."""
+        with self._arrived:
+            end = None if timeout is None else time.monotonic() + timeout
+            while handoff_id not in self._parked:
+                remain = None if end is None else end - time.monotonic()
+                if remain is not None and remain <= 0:
+                    return None
+                self._arrived.wait(timeout=remain)
+            return self._parked.pop(handoff_id)
+
+    def close(self):
+        # join the consumer BEFORE closing the ring: pd_shmq_close frees
+        # the native handle, and a drain thread still blocked inside
+        # pd_shmq_pop on it would fault, not raise
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._chan.close()
+
+
+# ---- transport registry -----------------------------------------------------
+# "shm" is the CPU dryrun path; a device-collective transport registers
+# its own (receiver_factory, sender_factory) pair here when pages can
+# move device-to-device without the host round-trip.
+
+TRANSPORTS = {
+    "shm": (KvHandoffReceiver, KvHandoffSender),
+}
+
+
+def make_receiver(kind: str = "shm", **kw) -> KvHandoffReceiver:
+    return TRANSPORTS[kind][0](**kw)
+
+
+def open_sender(channel_name: str, kind: str = "shm",
+                **kw) -> KvHandoffSender:
+    return TRANSPORTS[kind][1](channel_name, **kw)

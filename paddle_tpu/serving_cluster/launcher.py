@@ -1,0 +1,186 @@
+"""Cluster launcher: one call from config to a serving tier.
+
+Spawns N worker processes (each ``python -m
+paddle_tpu.serving_cluster.worker`` with a JSON config — real processes,
+so a worker death is a process death, exactly what the pool's lease
+watch and the router's retry path are built for), stands up the TCPStore
+the leases rendezvous on, and runs the WorkerPool + RouterServer in the
+calling process. ``scripts/serve_cluster.py`` is the CLI over this; the
+tier-1 multi-engine dryrun gate drives it directly.
+
+Config shape (TOML or JSON; see docs/SERVING.md "Disaggregated
+deployment")::
+
+    [cluster]
+    host = "127.0.0.1"   # router bind
+    port = 0             # 0 = ephemeral
+    job_id = "serve"
+    ttl = 5.0            # lease ttl seconds
+    max_retries = 2
+
+    [model]
+    kind = "tiny_llama"  # or factory = "pkg.module:fn"
+    seed = 0
+
+    [engine]
+    max_batch = 4
+    max_len = 64
+    page_size = 8
+
+    [[workers]]
+    role = "unified"
+    count = 2
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from ..distributed.log_utils import get_logger
+from ..distributed.store import TCPStore
+from .pool import WorkerPool
+from .router import RouterServer
+
+__all__ = ["Cluster", "launch_cluster", "load_config", "expand_workers"]
+
+
+def load_config(path: str) -> dict:
+    """TOML (via tomllib, python >= 3.11) or JSON config file."""
+    if path.endswith(".toml"):
+        try:
+            import tomllib
+        except ImportError as e:
+            raise RuntimeError(
+                "TOML configs need python >= 3.11 (tomllib); use a JSON "
+                "config on this interpreter") from e
+        with open(path, "rb") as f:
+            return tomllib.load(f)
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def expand_workers(cfg: dict) -> List[dict]:
+    """The ``workers`` section expanded to one role entry per process
+    (``count`` multiplies); defaults to two unified workers."""
+    specs = cfg.get("workers") or [{"role": "unified", "count": 2}]
+    out = []
+    for spec in specs:
+        for _ in range(int(spec.get("count", 1))):
+            out.append({k: v for k, v in spec.items() if k != "count"})
+    return out
+
+
+class Cluster:
+    """A running tier: router (in-process) + worker subprocesses."""
+
+    def __init__(self, cfg: dict, wait: bool = True,
+                 wait_timeout: float = 180.0):
+        cluster = dict(cfg.get("cluster") or {})
+        host = cluster.get("host", "127.0.0.1")
+        job_id = cluster.get("job_id", "serve")
+        ttl = float(cluster.get("ttl", 5.0))
+        worker_specs = expand_workers(cfg)
+        self.processes: List[subprocess.Popen] = []
+        self._replica_pids = {}
+        # the lease/metadata rendezvous point: master in THIS process so
+        # the router outliving every worker also owns the store
+        self.store = TCPStore(host, 0, is_master=True,
+                              world_size=len(worker_specs) + 1)
+        endpoint = f"{host}:{self.store.port}"
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (repo_root + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        for replica_id, spec in enumerate(worker_specs):
+            wcfg = {
+                "replica_id": replica_id,
+                "role": spec.get("role", "unified"),
+                "store": endpoint,
+                "world_size": len(worker_specs),
+                "job_id": job_id,
+                "ttl": ttl,
+                "host": host,
+                "port": int(spec.get("port", 0)),
+                "model": cfg.get("model") or {},
+                "engine": cfg.get("engine") or {},
+                "model_name": cluster.get("model_name", "paddle-tpu"),
+                "platform": cluster.get("platform"),
+                "compile_cache": cluster.get("compile_cache"),
+                "incident_dir": cluster.get("incident_dir"),
+            }
+            # -c (not -m): runpy warns when the module is already in
+            # sys.modules via the package import, and the entry is the
+            # same main() either way
+            p = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import sys; "
+                 "from paddle_tpu.serving_cluster.worker import main; "
+                 "sys.exit(main(sys.argv[1:]))",
+                 json.dumps(wcfg)], env=env, cwd=repo_root)
+            self.processes.append(p)
+            self._replica_pids[replica_id] = p
+        self.pool = WorkerPool(store=self.store,
+                               world_size=len(worker_specs),
+                               job_id=job_id, ttl=ttl)
+        self.router: Optional[RouterServer] = None
+        try:
+            if wait and not self.pool.wait_for_workers(
+                    len(worker_specs), timeout=wait_timeout):
+                raise RuntimeError(
+                    f"cluster: only {self.pool.alive_count()} of "
+                    f"{len(worker_specs)} workers joined within "
+                    f"{wait_timeout}s")
+            self.pool.start()
+            self.router = RouterServer(
+                self.pool, host=host, port=int(cluster.get("port", 0)),
+                model_name=cluster.get("model_name", "paddle-tpu"),
+                max_retries=int(cluster.get("max_retries", 2))).start()
+        except BaseException:
+            self.close()
+            raise
+
+    # ---- operations ------------------------------------------------------
+    @property
+    def address(self):
+        return self.router.address
+
+    def kill_worker(self, replica_id: int):
+        """SIGKILL one worker (crash simulation — no clean deregistration,
+        the lease must lapse / sockets must break for anyone to notice)."""
+        self._replica_pids[replica_id].kill()
+
+    def close(self):
+        if self.router is not None:
+            self.router.close()
+        self.pool.close()
+        for p in self.processes:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 10
+        for p in self.processes:
+            remain = max(0.1, deadline - time.monotonic())
+            try:
+                p.wait(timeout=remain)
+            except subprocess.TimeoutExpired:
+                get_logger().warning(
+                    "cluster: worker pid %s ignored SIGTERM; killing",
+                    p.pid)
+                p.kill()
+                p.wait(timeout=5)
+        self.store.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def launch_cluster(cfg: dict, **kw) -> Cluster:
+    """Spawn workers + pool + router from a parsed config dict."""
+    return Cluster(cfg, **kw)

@@ -1,0 +1,313 @@
+"""Worker pool: lease-backed membership + queue-depth-aware placement.
+
+The router's view of the serving tier. Workers register into the pool the
+way trainers join an elastic job — a TCPStore lease heartbeat
+(``distributed/elastic.py``) plus a metadata record (address, role, kv
+handoff channel) — and the pool watches both from the router process:
+
+- **membership** is lease freshness (``ElasticManager.alive_ranks``): a
+  worker whose heartbeat lapses is LOST, recorded as a
+  ``router.worker_lost`` flight-recorder event, and its in-flight
+  requests requeue at the router;
+- **occupancy** is the worker's own ``/health`` surface (active slots +
+  queue depth — the stats() snapshot both engines already publish),
+  polled on the same cadence, plus a local ``pending`` count of
+  placements this router has issued but not yet seen finish — the
+  queue-depth-aware part of least-loaded placement that a stale poll
+  alone would miss.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..distributed.elastic import ElasticManager
+from ..distributed.log_utils import get_logger
+from ..observability import flightrecorder as _frec
+from ..observability.catalog import ROUTER_WORKERS
+
+__all__ = ["WorkerInfo", "WorkerPool"]
+
+
+class WorkerInfo:
+    """One worker as the router sees it: identity (from the store
+    metadata), liveness (from the lease), and load (from /health polls +
+    local pending placements)."""
+
+    __slots__ = ("replica_id", "role", "host", "port", "pid", "kv_channel",
+                 "alive", "lease_age_s", "active", "queued", "pending",
+                 "probe_ok", "marked_dead_at")
+
+    def __init__(self, replica_id: int, meta: dict):
+        self.replica_id = replica_id
+        self.role = meta.get("role", "unified")
+        self.host = meta.get("host", "127.0.0.1")
+        self.port = int(meta.get("port", 0))
+        self.pid = meta.get("pid")
+        self.kv_channel = meta.get("kv_channel")
+        self.alive = True
+        self.lease_age_s: Optional[float] = None
+        self.active = 0
+        self.queued = 0
+        self.pending = 0     # placements issued but not finished HERE
+        self.probe_ok = False
+        self.marked_dead_at: Optional[float] = None  # monotonic, router-side
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def score(self) -> int:
+        """Placement score: lower is emptier. Active slots + the worker's
+        own queue + this router's not-yet-visible placements."""
+        return self.active + self.queued + self.pending
+
+    def snapshot(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "role": self.role,
+            "url": self.url,
+            "alive": self.alive,
+            "lease_age_s": self.lease_age_s,
+            "active": self.active,
+            "queued": self.queued,
+            "pending": self.pending,
+            "probe_ok": self.probe_ok,
+        }
+
+
+class WorkerPool:
+    """Membership + occupancy over an ElasticManager store view.
+
+    The pool never heartbeats itself (the router holds no lease); it is
+    the launcher-side watcher pattern of ``elastic.stale_ranks`` applied
+    to serving: membership is what the store says, not what the last
+    socket did.
+    """
+
+    def __init__(self, store=None, *, endpoint: Optional[str] = None,
+                 world_size: int = 1, job_id: str = "serve",
+                 ttl: float = 5.0, probe_timeout: float = 2.0,
+                 on_worker_lost: Optional[Callable[[WorkerInfo, str],
+                                                   None]] = None):
+        self._mgr = ElasticManager(store=store, endpoint=endpoint,
+                                   rank=-1, world_size=world_size,
+                                   ttl=ttl, job_id=job_id)
+        self.world_size = world_size
+        self.ttl = float(ttl)
+        self._probe_timeout = float(probe_timeout)
+        self._on_worker_lost = on_worker_lost
+        self._lock = threading.Lock()
+        self._workers: Dict[int, WorkerInfo] = {}
+        self._rr = 0  # least-loaded tie-break rotates
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self, interval: Optional[float] = None) -> "WorkerPool":
+        if self._thread is not None:
+            return self
+        interval = interval if interval is not None else self.ttl / 3.0
+
+        def watch():
+            while not self._stop.wait(interval):
+                try:
+                    self.refresh()
+                except Exception as e:
+                    # a pool that cannot refresh keeps serving its last
+                    # view; the blindness is worth a line, not a crash
+                    get_logger().warning(
+                        "worker pool refresh failed (%s: %s); serving "
+                        "the previous membership view",
+                        type(e).__name__, e)
+
+        self._thread = threading.Thread(target=watch, daemon=True,
+                                        name="worker-pool-watch")
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._stop.set()
+
+    # ---- membership ----------------------------------------------------
+    def refresh(self):
+        """One poll: lease view from the store, then /health occupancy
+        from each live worker. Store/network I/O runs OUTSIDE the lock;
+        results apply under it."""
+        alive = self._mgr.alive_ranks()
+        joined: List[Tuple[int, dict]] = []
+        ages: Dict[int, Optional[float]] = {}
+        with self._lock:
+            known = dict(self._workers)
+        for r in alive:
+            ages[r] = self._mgr.lease_age(r)
+            if r not in known:
+                meta = self._mgr.peer_metadata(r)
+                if meta is not None:
+                    joined.append((r, meta))
+        lost: List[WorkerInfo] = []
+        with self._lock:
+            for r, meta in joined:
+                if r in self._workers:
+                    continue
+                w = WorkerInfo(r, meta)
+                w.lease_age_s = ages.get(r)
+                self._workers[r] = w
+                rec = _frec.RECORDER
+                if rec.enabled:
+                    rec.record(_frec.EV_ROUTER_WORKER_JOIN,
+                               replica_id=r, role=w.role, url=w.url)
+                get_logger().info("worker pool: replica %s (%s) joined at "
+                                  "%s", r, w.role, w.url)
+            for r, w in self._workers.items():
+                if r in alive:
+                    w.lease_age_s = ages.get(r)
+                    if not w.alive and self._beat_after_death(w):
+                        # rejoin ONLY on a heartbeat newer than the
+                        # moment the router observed the death: a freshly
+                        # killed worker's lease stays "fresh" for up to
+                        # ttl, and rejoining on that stale stamp would
+                        # bounce requests into a dead socket until it
+                        # lapses (connection blips DO re-stamp, so they
+                        # rejoin within one heartbeat period)
+                        w.alive = True
+                        w.pending = 0
+                elif w.alive:
+                    self._mark_lost_locked(w, "lease")
+                    lost.append(w)
+            probe_targets = [(w.replica_id, w.url)
+                             for w in self._workers.values() if w.alive]
+        for w in lost:
+            self._notify_lost(w, "lease")
+        # occupancy probes (network) after the lock is released
+        for rid, url in probe_targets:
+            self._probe(rid, url)
+        self.refresh_gauges()
+
+    def _probe(self, replica_id: int, url: str):
+        try:
+            with urllib.request.urlopen(url + "/health",
+                                        timeout=self._probe_timeout) as r:
+                health = json.loads(r.read())
+            ok = True
+        except Exception as e:
+            get_logger().debug("worker pool: /health probe of replica %s "
+                               "failed (%s: %s)", replica_id,
+                               type(e).__name__, e)
+            health, ok = None, False
+        with self._lock:
+            w = self._workers.get(replica_id)
+            if w is None:
+                return
+            w.probe_ok = ok
+            if ok:
+                w.active = int(health.get("active", 0))
+                w.queued = int(health.get("queued", 0))
+
+    def _beat_after_death(self, w: WorkerInfo) -> bool:
+        """True when the worker's newest lease stamp postdates the moment
+        it was marked dead (CLOCK_MONOTONIC is host-wide, so the worker's
+        stamp and the router's clock compare directly — the same
+        assumption elastic leases already make)."""
+        if w.marked_dead_at is None or w.lease_age_s is None:
+            return True
+        return (time.monotonic() - w.lease_age_s) > w.marked_dead_at
+
+    def _mark_lost_locked(self, w: WorkerInfo, reason: str):
+        w.alive = False
+        w.probe_ok = False
+        w.pending = 0
+        w.marked_dead_at = time.monotonic()
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_ROUTER_WORKER_LOST,
+                       replica_id=w.replica_id, reason=reason)
+        get_logger().warning("worker pool: replica %s (%s) lost (%s)",
+                             w.replica_id, w.role, reason)
+
+    def _notify_lost(self, w: WorkerInfo, reason: str):
+        if self._on_worker_lost is not None:
+            try:
+                self._on_worker_lost(w, reason)
+            except Exception as e:
+                get_logger().warning(
+                    "worker pool: on_worker_lost callback failed "
+                    "(%s: %s)", type(e).__name__, e)
+
+    def mark_dead(self, replica_id: int, reason: str = "connection"):
+        """Router-observed death (a placement's socket broke): take the
+        worker out of rotation NOW — the lease takes up to ttl to lapse,
+        and routing more requests into a dead socket wastes their retry
+        budget. A fresh lease on a later refresh rejoins it."""
+        lost = None
+        with self._lock:
+            w = self._workers.get(replica_id)
+            if w is not None and w.alive:
+                self._mark_lost_locked(w, reason)
+                lost = w
+        if lost is not None:
+            self._notify_lost(lost, reason)
+
+    # ---- placement -----------------------------------------------------
+    def select(self, roles: Optional[Tuple[str, ...]] = None,
+               exclude: Tuple[int, ...] = ()) -> Optional[WorkerInfo]:
+        """Least-loaded live worker (optionally role-filtered), counting
+        the placement into ``pending`` so concurrent placements spread;
+        callers MUST ``release()`` the worker when the attempt ends."""
+        with self._lock:
+            live = [w for w in self._workers.values()
+                    if w.alive and w.replica_id not in exclude
+                    and (roles is None or w.role in roles)]
+            if not live:
+                return None
+            self._rr += 1
+            rr = self._rr
+            w = min(live, key=lambda w: (w.score(),
+                                         (w.replica_id + rr)
+                                         % (max(x.replica_id
+                                                for x in live) + 1)))
+            w.pending += 1
+            return w
+
+    def release(self, w: WorkerInfo):
+        with self._lock:
+            if w.pending > 0:
+                w.pending -= 1
+
+    def has_role(self, role: str) -> bool:
+        with self._lock:
+            return any(w.alive and w.role == role
+                       for w in self._workers.values())
+
+    # ---- views ---------------------------------------------------------
+    def workers(self) -> List[dict]:
+        with self._lock:
+            return [w.snapshot() for w in self._workers.values()]
+
+    def alive_count(self) -> int:
+        with self._lock:
+            return sum(w.alive for w in self._workers.values())
+
+    def refresh_gauges(self):
+        with self._lock:
+            alive = sum(w.alive for w in self._workers.values())
+            lost = len(self._workers) - alive
+        ROUTER_WORKERS.set(alive, state="alive")
+        ROUTER_WORKERS.set(lost, state="lost")
+
+    def wait_for_workers(self, n: int, timeout: float = 120.0) -> bool:
+        """Block until ``n`` workers have joined (registered lease +
+        metadata and answered a /health probe) or the deadline passes."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.refresh()
+            with self._lock:
+                ready = sum(1 for w in self._workers.values()
+                            if w.alive and w.probe_ok)
+            if ready >= n:
+                return True
+            time.sleep(0.2)
+        return False

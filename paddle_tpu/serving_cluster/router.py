@@ -1,0 +1,419 @@
+"""RouterServer: the disaggregated tier's front door.
+
+Admits ``POST /v1/completions`` on the same handler skeleton as the
+single-process server (``serving_http.ServingHandlerBase`` — so /metrics,
+/trace and /debug/* work identically on the router) and places each
+request on a worker with **queue-depth-aware least-loaded scheduling**:
+the pool scores every live worker by active slots + its own queue depth +
+this router's not-yet-visible placements, and the emptiest one wins.
+
+Streaming is relayed token by token (SSE in, SSE out). Fault handling is
+placement-scoped: a worker that dies mid-request (socket error, EOF
+before ``[DONE]``, 5xx) is marked dead in the pool and the request
+REQUEUES onto another worker within a bounded retry budget — for greedy
+streams the router skips the tokens it already delivered, so the client
+sees one continuous, correct stream across the failover. Every placement
+/ retry / loss decision is a flight-recorder event (``router.*``), and
+the router's ``router.request``/``router.upstream`` spans propagate
+``traceparent`` downstream, so one trace_id covers router and worker
+spans across processes.
+
+When the pool contains ``prefill``-role workers, requests run
+disaggregated: a prefill worker computes the prompt KV and ships it over
+the decode worker's handoff channel (``kv_handoff``), then the decode
+worker streams tokens from the shipped state.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import uuid
+from http.server import ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from ..distributed.log_utils import get_logger
+from ..observability import flightrecorder as _frec
+from ..observability import tracing as _tracing
+from ..observability.catalog import ROUTER_PLACEMENTS
+from ..serving_http import ServingHandlerBase
+from .pool import WorkerInfo, WorkerPool
+
+__all__ = ["RouterServer"]
+
+
+class _ClientError(Exception):
+    """The worker judged the request invalid (4xx): forward verbatim,
+    never retry — a bad request is bad on every replica."""
+
+    def __init__(self, status: int, body: dict):
+        super().__init__(f"client error {status}")
+        self.status = status
+        self.body = body
+
+
+class _UpstreamError(Exception):
+    """A placement attempt failed for reasons a DIFFERENT worker might
+    not share: transport death, 5xx, mid-stream EOF. ``dead`` names a
+    worker the router observed failing at the socket level (marked dead
+    in the pool immediately — the lease would take up to ttl to lapse)."""
+
+    def __init__(self, reason: str, dead: Optional[WorkerInfo] = None,
+                 exclude: Tuple[int, ...] = ()):
+        super().__init__(reason)
+        self.reason = reason
+        self.dead = dead
+        self.exclude = exclude
+
+
+class _ClientGone(Exception):
+    """The DOWNSTREAM client disconnected mid-relay; nothing to answer."""
+
+
+class RouterServer:
+    """HTTP front-end placing completions across a WorkerPool."""
+
+    def __init__(self, pool: WorkerPool, host: str = "127.0.0.1",
+                 port: int = 0, model_name: str = "paddle-tpu",
+                 max_retries: int = 2, upstream_timeout: float = 120.0,
+                 enable_tracing: bool = True,
+                 enable_flight_recorder: bool = True):
+        self.pool = pool
+        self.model_name = model_name
+        self.max_retries = int(max_retries)
+        self.upstream_timeout = float(upstream_timeout)
+        if enable_tracing:
+            _tracing.get_tracer().enable()
+        self._tracer = _tracing.get_tracer()
+        if enable_flight_recorder:
+            _frec.get_recorder().enable()
+        self._lock = threading.Lock()
+        self._placed = 0
+        self._retried = 0
+        self._failed = 0
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          self._make_handler())
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="router-http-loop")
+
+    # ---- lifecycle -----------------------------------------------------
+    @property
+    def address(self):
+        return self._httpd.server_address
+
+    def start(self):
+        self._http_thread.start()
+        return self
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---- handler hooks ---------------------------------------------------
+    def _make_handler(server_self):
+        class Handler(ServingHandlerBase):
+            server_obj = server_self
+            # the router's POST span is router.request, not http.request:
+            # it parents router.upstream AND (via the forwarded
+            # traceparent) the worker's http.request across the process
+            # boundary
+            post_span_name = _tracing.SPAN_ROUTER_REQUEST
+
+        return Handler
+
+    def _refresh_metrics(self):
+        self.pool.refresh_gauges()
+
+    def _health_payload(self) -> dict:
+        """The POOL's health, aggregated: per-worker liveness + occupancy
+        (so one scrape shows a load balancer the whole tier) plus the
+        router's own placement counters."""
+        workers = self.pool.workers()
+        alive = sum(1 for w in workers if w["alive"])
+        roles: dict = {}
+        for w in workers:
+            if w["alive"]:
+                roles[w["role"]] = roles.get(w["role"], 0) + 1
+        with self._lock:
+            router_stats = {"placed": self._placed,
+                            "retried": self._retried,
+                            "failed": self._failed,
+                            "max_retries": self.max_retries}
+        return {
+            "status": "ok" if alive else "unavailable",
+            "alive": alive,
+            "roles": roles,
+            "workers": {str(w["replica_id"]): w for w in workers},
+            "router": router_stats,
+        }
+
+    def _models_payload(self) -> dict:
+        return {"object": "list",
+                "data": [{"id": self.model_name, "object": "model"}]}
+
+    def _extra_get(self, handler, route, query) -> bool:
+        return False
+
+    def _post_handler(self, route):
+        return self._complete if route == "/v1/completions" else None
+
+    # ---- placement -------------------------------------------------------
+    def _plan(self, exclude: Tuple[int, ...]):
+        """(mode, prefill_worker | None, serve_worker) or None. Disagg
+        when a prefill-role worker AND a handoff-capable decode target
+        are both live; direct otherwise."""
+        serve = self.pool.select(roles=("decode", "unified"),
+                                 exclude=exclude)
+        if serve is None:
+            return None
+        if self.pool.has_role("prefill") and serve.kv_channel:
+            pre = self.pool.select(roles=("prefill",), exclude=exclude)
+            if pre is not None:
+                return ("disagg", pre, serve)
+        return ("direct", None, serve)
+
+    def _count_outcome(self, outcome: str):
+        ROUTER_PLACEMENTS.inc(outcome=outcome)
+        with self._lock:
+            if outcome == "placed":
+                self._placed += 1
+            elif outcome == "retried":
+                self._retried += 1
+            elif outcome == "failed":
+                self._failed += 1
+
+    def _complete(self, handler, req):
+        stream = bool(req.get("stream"))
+        # relay state survives retries: once SSE headers (or tokens) hit
+        # the client socket, a failover must continue the SAME stream —
+        # delivered counts the token chunks already written so the
+        # replacement worker's (deterministic) stream is deduplicated
+        state = {"headers_sent": False, "delivered": 0}
+        exclude: Tuple[int, ...] = ()
+        attempts = 0
+        last_reason = "no live worker available"
+        root = handler._trace_span
+        while attempts <= self.max_retries:
+            plan = self._plan(exclude)
+            if plan is None:
+                break
+            mode, pre, serve = plan
+            attempts += 1
+            rec = _frec.RECORDER
+            if rec.enabled:
+                rec.record(_frec.EV_ROUTER_PLACE,
+                           replica_id=serve.replica_id, role=serve.role,
+                           score=serve.score(), attempt=attempts,
+                           mode=mode)
+            sp = self._tracer.start_span(
+                _tracing.SPAN_ROUTER_UPSTREAM, parent=root,
+                attrs={"replica_id": serve.replica_id, "role": serve.role,
+                       "attempt": attempts, "mode": mode})
+            try:
+                up_req = req
+                if mode == "disagg":
+                    hid = self._prefill_hop(pre, serve, req, sp)
+                    up_req = {k: v for k, v in req.items()
+                              if k not in ("prompt", "prompt_token_ids",
+                                           "pixel_values")}
+                    up_req["handoff_id"] = hid
+                if stream:
+                    self._proxy_stream(handler, serve, up_req, state, sp)
+                else:
+                    status, body = self._post_json(
+                        serve, "/v1/completions", up_req, sp)
+                    if 400 <= status < 500:
+                        raise _ClientError(status, body)
+                    if status != 200:
+                        raise _UpstreamError(
+                            f"worker {serve.replica_id} answered "
+                            f"{status}: {body.get('error', body)}")
+                    handler._json(200, body)
+                sp.end()
+                self._count_outcome("placed")
+                return
+            except _ClientError as e:
+                sp.end("error")
+                handler._json(e.status, e.body)
+                return
+            except _ClientGone:
+                sp.end("cancelled")
+                handler.close_connection = True
+                return
+            except _UpstreamError as e:
+                sp.end("error")
+                last_reason = e.reason
+                if e.dead is not None:
+                    self.pool.mark_dead(e.dead.replica_id, "connection")
+                exclude = exclude + (serve.replica_id,) + tuple(e.exclude)
+                if rec.enabled:
+                    rec.record(_frec.EV_ROUTER_RETRY,
+                               replica_id=serve.replica_id,
+                               attempt=attempts,
+                               delivered=state["delivered"],
+                               reason=e.reason)
+                self._count_outcome("retried")
+                get_logger().warning(
+                    "router: placement attempt %s on replica %s failed "
+                    "(%s); requeueing", attempts, serve.replica_id,
+                    e.reason)
+            finally:
+                self.pool.release(serve)
+                if pre is not None:
+                    self.pool.release(pre)
+        # retry budget exhausted (or the pool is empty)
+        self._count_outcome("failed")
+        msg = (f"could not serve the request after {attempts} "
+               f"placement attempt(s): {last_reason}")
+        if state["headers_sent"]:
+            # mid-stream: the status line is long gone — end the SSE with
+            # an error and WITHOUT [DONE] (failed streams must not look
+            # clean), exactly like the single-process server
+            try:
+                handler._chunk(b'data: {"error": '
+                               + json.dumps(msg).encode() + b"}\n\n")
+                handler._chunk(b"")
+            except OSError:
+                handler.close_connection = True
+        else:
+            handler._json(502, {"error": msg})
+
+    # ---- upstream hops ---------------------------------------------------
+    def _headers(self, span) -> dict:
+        h = {"Content-Type": "application/json"}
+        if span:
+            h[_tracing.TRACEPARENT_HEADER] = _tracing.format_traceparent(
+                span.trace_id, span.span_id)
+        return h
+
+    def _post_json(self, worker: WorkerInfo, path: str, body: dict,
+                   span) -> Tuple[int, dict]:
+        """One upstream POST, full-body; transport failures raise
+        _UpstreamError naming the worker as observed-dead."""
+        conn = http.client.HTTPConnection(worker.host, worker.port,
+                                          timeout=self.upstream_timeout)
+        try:
+            conn.request("POST", path, json.dumps(body),
+                         self._headers(span))
+            resp = conn.getresponse()
+            status = resp.status
+            raw = resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            raise _UpstreamError(
+                f"worker {worker.replica_id} transport failure on "
+                f"{path}: {type(e).__name__}: {e}", dead=worker)
+        finally:
+            conn.close()
+        try:
+            parsed = json.loads(raw)
+        except ValueError:
+            parsed = {"error": raw.decode(errors="replace")}
+        return status, parsed
+
+    def _prefill_hop(self, pre: WorkerInfo, serve: WorkerInfo, req: dict,
+                     span) -> str:
+        """Run the prompt through a prefill worker, shipping its KV to
+        ``serve``'s handoff channel; returns the handoff id the decode
+        request claims."""
+        hid = uuid.uuid4().hex
+        body = {"channel": serve.kv_channel, "handoff_id": hid,
+                "max_tokens": req.get("max_tokens", 16)}
+        for k in ("prompt", "prompt_token_ids"):
+            if k in req:
+                body[k] = req[k]
+        try:
+            status, resp = self._post_json(pre, "/v1/prefill", body, span)
+        except _UpstreamError as e:
+            # the SERVE worker is fine — only exclude/blame the prefill
+            # worker so the retry can reuse the decode side
+            raise _UpstreamError(e.reason, dead=e.dead,
+                                 exclude=(pre.replica_id,)) from e
+        if 400 <= status < 500:
+            raise _ClientError(status, resp)
+        if status != 200:
+            raise _UpstreamError(
+                f"prefill worker {pre.replica_id} answered {status}: "
+                f"{resp.get('error', resp)}", exclude=(pre.replica_id,))
+        return hid
+
+    def _proxy_stream(self, handler, worker: WorkerInfo, body: dict,
+                      state: dict, span):
+        """Relay one SSE stream, skipping the first ``state['delivered']``
+        token chunks (a failover continuation repeats them)."""
+        conn = http.client.HTTPConnection(worker.host, worker.port,
+                                          timeout=self.upstream_timeout)
+        try:
+            try:
+                conn.request("POST", "/v1/completions", json.dumps(body),
+                             self._headers(span))
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException) as e:
+                raise _UpstreamError(
+                    f"worker {worker.replica_id} transport failure: "
+                    f"{type(e).__name__}: {e}", dead=worker)
+            if resp.status != 200:
+                try:
+                    raw = resp.read()
+                except (OSError, http.client.HTTPException):
+                    raw = b""
+                try:
+                    parsed = json.loads(raw)
+                except ValueError:
+                    parsed = {"error": raw.decode(errors="replace")}
+                if 400 <= resp.status < 500:
+                    raise _ClientError(resp.status, parsed)
+                raise _UpstreamError(
+                    f"worker {worker.replica_id} answered {resp.status}: "
+                    f"{parsed.get('error', parsed)}")
+            if not state["headers_sent"]:
+                handler._begin_sse()
+                state["headers_sent"] = True
+            seen = 0
+            while True:
+                try:
+                    line = resp.readline()
+                except (OSError, http.client.HTTPException) as e:
+                    raise _UpstreamError(
+                        f"worker {worker.replica_id} stream broke: "
+                        f"{type(e).__name__}: {e}", dead=worker)
+                if not line:
+                    # EOF without [DONE]: the worker died mid-stream
+                    raise _UpstreamError(
+                        f"worker {worker.replica_id} stream ended "
+                        "without [DONE]", dead=worker)
+                if not line.startswith(b"data: "):
+                    continue
+                payload = line[len(b"data: "):].strip()
+                if payload == b"[DONE]":
+                    try:
+                        handler._chunk(b"data: [DONE]\n\n")
+                        handler._chunk(b"")
+                    except OSError:
+                        raise _ClientGone()
+                    return
+                if payload.startswith(b'{"error"'):
+                    # engine-level mid-stream failure: another worker
+                    # can finish this request
+                    raise _UpstreamError(
+                        f"worker {worker.replica_id} streamed an error: "
+                        f"{payload.decode(errors='replace')}")
+                seen += 1
+                if seen <= state["delivered"]:
+                    continue  # already relayed before the failover
+                try:
+                    handler._chunk(b"data: " + payload + b"\n\n")
+                except OSError:
+                    # the DOWNSTREAM client went away: closing the
+                    # upstream socket makes the worker see its own SSE
+                    # disconnect and cancel the slot
+                    raise _ClientGone()
+                state["delivered"] += 1
+        finally:
+            conn.close()

@@ -1,0 +1,354 @@
+"""Cluster worker: one ContinuousBatchEngine in a role, behind HTTP.
+
+A worker is a :class:`~paddle_tpu.serving_http.CompletionServer` (same
+engine thread, same observability surface) plus the cluster contract:
+
+- **membership** — it registers a lease heartbeat + a metadata record
+  (address, role, kv channel) through ``distributed/elastic.py``'s
+  ElasticManager, so the router's WorkerPool discovers it through the
+  store like trainers discover peers;
+- **role** — ``unified`` serves completions end to end; ``prefill``
+  serves ``POST /v1/prefill`` (bucketed prefill → KV bundle shipped to a
+  decode worker's handoff channel) and refuses completions; ``decode``
+  additionally accepts completions whose prompt KV arrives by
+  ``handoff_id`` instead of running the prefill itself;
+- **/health** — gains ``role``, ``replica_id`` and ``lease_age_s`` so a
+  load balancer (and the router's aggregate /health) sees both what a
+  worker is and how fresh its membership claim is.
+
+``python -m paddle_tpu.serving_cluster.worker '<json cfg>'`` is the
+process entry the launcher (scripts/serve_cluster.py) spawns.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import uuid
+from typing import Optional
+
+from ..distributed.elastic import ElasticManager
+from ..distributed.log_utils import get_logger
+from ..serving_http import CompletionServer, EngineCommand, _Submission
+from .kv_handoff import KvHandoffReceiver, make_receiver, open_sender
+
+__all__ = ["WorkerServer", "run_worker", "build_model", "MODEL_BUILDERS"]
+
+ROLES = ("prefill", "decode", "unified")
+
+
+class _ExportPrefill(EngineCommand):
+    """Engine-thread command: run the bucketed prefill for one prompt and
+    return its host-side KV bundle (no slot taken)."""
+
+    def __init__(self, ids, max_new_tokens: int):
+        super().__init__()
+        self.ids = ids
+        self.max_new_tokens = max_new_tokens
+
+    def execute(self, engine):
+        return engine.export_prefill(self.ids,
+                                     max_new_tokens=self.max_new_tokens)
+
+
+class WorkerServer(CompletionServer):
+    """CompletionServer speaking the cluster protocol for one role."""
+
+    def __init__(self, engine, *, role: str = "unified",
+                 replica_id: int = 0,
+                 elastic: Optional[ElasticManager] = None,
+                 kv_receiver: Optional[KvHandoffReceiver] = None,
+                 handoff_wait_s: float = 30.0, **kw):
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        super().__init__(engine, **kw)
+        self.role = role
+        self.replica_id = int(replica_id)
+        self._elastic = elastic
+        self._kv = kv_receiver
+        self._handoff_wait_s = float(handoff_wait_s)
+        self._senders = {}           # channel name -> KvHandoffSender
+        self._senders_lock = threading.Lock()
+        if self._kv is not None:
+            self._kv.start()
+
+    def close(self):
+        super().close()
+        if self._kv is not None:
+            self._kv.close()
+        with self._senders_lock:
+            senders, self._senders = dict(self._senders), {}
+        for s in senders.values():
+            s.close()
+
+    # ---- cluster surface ------------------------------------------------
+    def health_extra(self) -> dict:
+        lease_age = (self._elastic.lease_age()
+                     if self._elastic is not None else None)
+        return {
+            "role": self.role,
+            "replica_id": self.replica_id,
+            "lease_age_s": lease_age,
+            "kv_channel": (self._kv.name if self._kv is not None
+                           else None),
+        }
+
+    def _post_handler(self, route):
+        if route == "/v1/prefill" and self.role in ("prefill", "unified"):
+            return self._prefill_post
+        return super()._post_handler(route)
+
+    # ---- completions (decode side of the handoff) -----------------------
+    def _complete(self, handler, req):
+        if "handoff_id" in req:
+            if self._kv is None:
+                return handler._json(409, {
+                    "error": f"this {self.role}-role worker has no kv "
+                             "handoff channel"})
+            return self._complete_from_handoff(handler, req)
+        if self.role == "prefill":
+            # a prefill-role worker holds no decode slots; the router
+            # must not fall back to it for full completions
+            return handler._json(409, {
+                "error": "prefill-role worker serves /v1/prefill only"})
+        return super()._complete(handler, req)
+
+    def _complete_from_handoff(self, handler, req):
+        hid = str(req["handoff_id"])
+        bundle = self._kv.wait(hid, timeout=self._handoff_wait_s)
+        if bundle is None:
+            # the prefill worker never delivered (died mid-handoff):
+            # a 5xx here is what turns into a router retry
+            return handler._json(504, {
+                "error": f"kv handoff {hid} not received within "
+                         f"{self._handoff_wait_s}s"})
+        try:
+            params, want_logprobs = self._parse_decode_params(req)
+        except (ValueError, TypeError) as e:
+            return handler._json(400, {"error": str(e)})
+        sp = handler._trace_span
+        sub = _Submission(None, params, handoff=bundle,
+                          trace_ctx=((sp.trace_id, sp.span_id)
+                                     if sp is not None else None))
+        self._subs.put(sub)
+        cid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        n_prompt = int(bundle["prompt_tokens"])
+        if req.get("stream"):
+            return self._stream(handler, sub, cid, want_logprobs)
+        return self._collect(handler, sub, cid, n_prompt, want_logprobs)
+
+    def _parse_decode_params(self, req):
+        """The decode-side subset of the completion params (the prompt
+        lives in the handoff bundle): token budget, sampling overrides,
+        stops, logprobs."""
+        max_tokens = int(req.get("max_tokens", 16))
+        if max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        params = dict(max_new_tokens=max_tokens)
+        if ("temperature" in req or "top_p" in req
+                or "top_k" in req or req.get("do_sample")):
+            params.update(
+                do_sample=True,
+                temperature=float(req.get("temperature", 1.0)),
+                top_k=int(req.get("top_k", 0)),
+                top_p=float(req.get("top_p", 1.0)))
+        stop = req.get("stop_token_ids")
+        if stop is not None:
+            params["stop_token_ids"] = [int(s) for s in stop]
+        lp_req = req.get("logprobs")
+        want_logprobs = (lp_req is not None and lp_req is not False)
+        if want_logprobs:
+            params["logprobs"] = True
+        return params, want_logprobs
+
+    # ---- the prefill hop -------------------------------------------------
+    def _prefill_post(self, handler, req):
+        try:
+            ids = self._prompt_ids(req)
+            max_tokens = int(req.get("max_tokens", 16))
+            if max_tokens < 1:
+                raise ValueError("max_tokens must be >= 1")
+            channel = req.get("channel")
+            if not channel:
+                raise ValueError(
+                    "prefill needs 'channel' — the decode worker's kv "
+                    "handoff channel name")
+            hid = str(req.get("handoff_id") or uuid.uuid4().hex)
+        except (ValueError, TypeError) as e:
+            return handler._json(400, {"error": str(e)})
+        try:
+            # the prefill runs ON the engine thread (only device-state
+            # toucher); the shm push happens HERE on the handler thread —
+            # the bundle is host numpy by then, and a full ring must
+            # stall this request, not the engine loop
+            bundle = self.submit_command(
+                _ExportPrefill(ids, max_tokens))
+            nbytes = self._sender(channel).send(hid, bundle)
+        except (ValueError, TypeError, NotImplementedError) as e:
+            return handler._json(400, {"error": str(e)})
+        except Exception as e:
+            get_logger().warning("prefill handoff %s -> %s failed "
+                                 "(%s: %s)", hid, channel,
+                                 type(e).__name__, e)
+            return handler._json(500, {"error": f"{type(e).__name__}: {e}"})
+        return handler._json(200, {
+            "handoff_id": hid,
+            "channel": channel,
+            "prompt_tokens": int(bundle["prompt_tokens"]),
+            "bytes": nbytes,
+        })
+
+    def _sender(self, channel: str):
+        with self._senders_lock:
+            s = self._senders.get(channel)
+            if s is None:
+                s = open_sender(channel)
+                self._senders[channel] = s
+            return s
+
+
+# ---- model construction in the worker process -------------------------------
+
+def _tiny_llama(spec: dict):
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+
+    kw = {k: spec[k] for k in ("num_hidden_layers", "hidden_size",
+                               "num_attention_heads",
+                               "num_key_value_heads") if k in spec}
+    return LlamaForCausalLM(LlamaConfig.tiny(**kw))
+
+
+MODEL_BUILDERS = {"tiny_llama": _tiny_llama}
+
+
+def build_model(spec: dict):
+    """Build the worker's model from its config spec: a registry ``kind``
+    or a dotted ``factory`` ("pkg.module:fn", called with the spec).
+    Weights must be DETERMINISTIC given the spec (every worker seeds
+    before building) — prefill and decode engines only interoperate over
+    identical weights."""
+    import paddle_tpu as paddle
+
+    paddle.seed(int(spec.get("seed", 0)))
+    factory = spec.get("factory")
+    if factory:
+        mod_name, _, fn_name = factory.partition(":")
+        if not fn_name:
+            raise ValueError(
+                f"factory must look like 'pkg.module:fn', got {factory!r}")
+        import importlib
+
+        return getattr(importlib.import_module(mod_name), fn_name)(spec)
+    kind = spec.get("kind")
+    if kind not in MODEL_BUILDERS:
+        raise ValueError(f"unknown model kind {kind!r} "
+                         f"(have {sorted(MODEL_BUILDERS)})")
+    return MODEL_BUILDERS[kind](spec)
+
+
+# ---- process entry ----------------------------------------------------------
+
+def run_worker(cfg: dict):
+    """Build the engine, join the pool, serve until SIGTERM.
+
+    Config keys: ``replica_id``, ``role``, ``store`` (TCPStore
+    host:port), ``world_size``, ``job_id``, ``ttl``, ``host``/``port``,
+    ``model`` (builder spec), ``engine`` (ContinuousBatchEngine kwargs),
+    ``platform`` (jax platform override), ``compile_cache`` (persistent
+    XLA cache dir), ``kv_capacity_mb``, ``incident_dir``.
+    """
+    platform = cfg.get("platform")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    cache_dir = cfg.get("compile_cache")
+    if cache_dir:
+        import jax
+
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception as e:  # older jax without the knobs: run uncached
+            get_logger().debug("worker: compile cache unavailable "
+                               "(%s: %s)", type(e).__name__, e)
+    from ..serving import ContinuousBatchEngine
+
+    replica_id = int(cfg.get("replica_id", 0))
+    role = cfg.get("role", "unified")
+    job_id = cfg.get("job_id", "serve")
+    ttl = float(cfg.get("ttl", 5.0))
+    if cfg.get("incident_dir"):
+        from ..observability.flightrecorder import install_reporter
+
+        install_reporter(cfg["incident_dir"])
+
+    model = build_model(cfg.get("model", {}))
+    engine = ContinuousBatchEngine(model, **cfg.get("engine", {}))
+
+    kv_receiver = None
+    if role in ("decode", "unified"):
+        kv_receiver = make_receiver(
+            name=f"/pdtpu_kv_{job_id}_{replica_id}_{os.getpid()}",
+            capacity_mb=int(cfg.get("kv_capacity_mb", 64)))
+
+    elastic = ElasticManager(endpoint=cfg["store"], rank=replica_id,
+                             world_size=int(cfg.get("world_size", 1)),
+                             ttl=ttl, job_id=job_id)
+    srv = WorkerServer(engine, role=role, replica_id=replica_id,
+                       elastic=elastic, kv_receiver=kv_receiver,
+                       model_name=cfg.get("model_name", "paddle-tpu"),
+                       host=cfg.get("host", "127.0.0.1"),
+                       port=int(cfg.get("port", 0)))
+    srv.start()
+    host, port = srv.address
+    # lease first, metadata second: the pool only reads metadata for
+    # ranks whose lease is already fresh, so a half-registered worker is
+    # invisible rather than half-visible
+    elastic.register()
+    elastic.register_metadata({
+        "host": host, "port": port, "role": role, "pid": os.getpid(),
+        "kv_channel": kv_receiver.name if kv_receiver else None,
+    })
+    get_logger().info("cluster worker %s (%s) serving on %s:%s",
+                      replica_id, role, host, port)
+
+    done = threading.Event()
+
+    def _term(signum, frame):
+        # clean teardown: deregister (peers must not read this exit as a
+        # lapsed lease), stop serving, leave
+        elastic.mark_done()
+        done.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    try:
+        done.wait()
+    except KeyboardInterrupt:
+        elastic.mark_done()
+    srv.close()
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m paddle_tpu.serving_cluster.worker "
+              "'<json config>' | <config.json>", file=sys.stderr)
+        return 2
+    raw = argv[0]
+    if raw.lstrip().startswith("{"):
+        cfg = json.loads(raw)
+    else:
+        with open(raw, encoding="utf-8") as f:
+            cfg = json.load(f)
+    run_worker(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,12 @@ submissions and wait on per-request queues fed by the engine's
 ``on_token`` streaming callbacks. The engine thread interleaves admission
 and decode exactly like ``run_until_done`` — in-flight batching across
 concurrent HTTP clients is the whole point.
+
+The handler skeleton (:class:`ServingHandlerBase`: observability GETs,
+traceparent echo, chunked SSE plumbing, POST span wiring) is shared with
+the disaggregated tier's :class:`~paddle_tpu.serving_cluster.RouterServer`
+and role workers — one front-door surface, however many processes serve
+behind it.
 """
 from __future__ import annotations
 
@@ -57,19 +63,20 @@ from .observability import flightrecorder as _frec
 from .observability import tracing as _tracing
 from .observability.catalog import HTTP_REQUESTS
 
-__all__ = ["CompletionServer", "serve"]
+__all__ = ["CompletionServer", "ServingHandlerBase", "serve"]
 
 # known routes for the http counter — anything else buckets under
 # "other" so a scanner can't explode the label cardinality
 _KNOWN_ROUTES = ("/health", "/metrics", "/v1/models", "/v1/completions",
-                 "/trace", "/trace/chrome", "/debug/dump", "/debug/events")
+                 "/v1/prefill", "/trace", "/trace/chrome", "/debug/dump",
+                 "/debug/events")
 
 
 class _Submission:
     __slots__ = ("ids", "params", "events", "rid", "n", "rids",
-                 "trace_ctx")
+                 "trace_ctx", "handoff")
 
-    def __init__(self, ids, params, n=1, trace_ctx=None):
+    def __init__(self, ids, params, n=1, trace_ctx=None, handoff=None):
         self.ids = ids
         self.params = params
         self.events: "queue.Queue" = queue.Queue()
@@ -77,6 +84,7 @@ class _Submission:
         self.n = n          # OpenAI "n": sibling completions of one prompt
         self.rids = []
         self.trace_ctx = trace_ctx  # (trace_id, parent_span_id) | None
+        self.handoff = handoff  # prefilled-KV bundle (disaggregated tier)
 
 
 class _Cancel:
@@ -90,6 +98,244 @@ class _Cancel:
 
     def __init__(self, sub: _Submission):
         self.sub = sub
+
+
+class EngineCommand:
+    """A unit of work executed ON the engine thread (the only device-state
+    toucher), with its result posted back to the waiting handler thread —
+    how the cluster worker runs prefill exports without a second thread
+    ever touching the page pool. Subclasses implement ``execute``."""
+
+    def __init__(self):
+        self.events: "queue.Queue" = queue.Queue()
+
+    def execute(self, engine):
+        raise NotImplementedError
+
+
+class ServingHandlerBase(BaseHTTPRequestHandler):
+    """The shared front-door handler skeleton: observability GET routes
+    (/health, /metrics, /trace, /trace/chrome, /debug/*), W3C traceparent
+    parse/echo around POSTs, the http counter, and chunked-SSE plumbing.
+
+    Concrete servers subclass per instance (``class Handler(
+    ServingHandlerBase): server_obj = self``) and customize through the
+    ``server_obj`` hooks: ``_refresh_metrics`` / ``_health_payload`` /
+    ``_models_payload`` / ``_post_handler`` / ``_extra_get`` — the
+    CompletionServer serves an engine behind them, the cluster
+    RouterServer a whole worker pool."""
+
+    protocol_version = "HTTP/1.1"
+    server_obj = None           # the owning server (set by the factory)
+    known_routes = _KNOWN_ROUTES
+    post_span_name = None       # default: http.request
+
+    # the handler's POST span (None on GETs / when tracing is off);
+    # responses echo its traceparent
+    _trace_span = None
+
+    def log_message(self, *a):  # silence request logging
+        pass
+
+    # ---- small shared plumbing ----------------------------------------
+    def _count(self, code):
+        route = urlsplit(self.path).path
+        if route not in self.known_routes:
+            route = "other"
+        HTTP_REQUESTS.inc(path=route, code=str(code))
+
+    def _send_traceparent(self):
+        sp = self._trace_span
+        if sp is not None and sp.trace_id:
+            self.send_header(
+                _tracing.TRACEPARENT_HEADER,
+                _tracing.format_traceparent(sp.trace_id, sp.span_id))
+
+    def _json(self, code, obj, headers=()):
+        self._count(code)
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self._send_traceparent()
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _chunk(self, payload: bytes):
+        """One HTTP/1.1 chunked-encoding frame (the SSE write primitive)."""
+        self.wfile.write(f"{len(payload):X}\r\n".encode()
+                         + payload + b"\r\n")
+
+    def _begin_sse(self):
+        """Status + SSE headers for a streaming response; after this only
+        ``_chunk`` writes are legal on the connection."""
+        self._count(200)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self._send_traceparent()
+        self.end_headers()
+
+    def _trace_query(self, query):
+        """?trace_id=... | ?rid=N[&engine=...] -> trace_id or
+        None (unknown rid / malformed query)."""
+        q = parse_qs(query)
+        if q.get("trace_id"):
+            return q["trace_id"][0]
+        if q.get("rid"):
+            try:
+                rid = int(q["rid"][0])
+            except ValueError:
+                return None
+            engine = (q.get("engine") or [None])[0]
+            return self.server_obj._tracer.find_request_trace(
+                rid, engine=engine)
+        return None
+
+    # ---- GET -----------------------------------------------------------
+    def do_GET(self):
+        # one handler instance serves a whole keep-alive
+        # connection: drop any previous POST's span so GETs
+        # don't echo a stale traceparent
+        self._trace_span = None
+        route, _, query = self.path.partition("?")
+        if self._common_get(route, query):
+            return
+        if self.server_obj._extra_get(self, route, query):
+            return
+        self._json(404, {"error": "not found"})
+
+    def _common_get(self, route, query) -> bool:
+        srv = self.server_obj
+        if route == "/trace":
+            tid = self._trace_query(query)
+            if tid is None:
+                self._json(404, {
+                    "error": "no trace: pass ?rid=<request id> "
+                             "(finished or in flight) or "
+                             "?trace_id=<32-hex id>"})
+                return True
+            # include_live: the POST handler's span ends only after its
+            # response bytes hit the socket, so a caller chaining POST ->
+            # GET /trace would otherwise race the handler thread and see
+            # a tree missing its http.request node
+            self._json(200, {
+                "trace_id": tid,
+                "spans": srv._tracer.spans(tid, include_live=True)})
+            return True
+        if route == "/trace/chrome":
+            # chrome://tracing download; unfiltered dumps merge
+            # the profiler's host events onto the same timeline
+            tid = self._trace_query(query) if query else None
+            if query and tid is None:
+                self._json(404, {"error": "no such trace"})
+                return True
+            trace = srv._tracer.export_chrome(trace_id=tid)
+            self._json(200, trace, headers=(
+                ("Content-Disposition",
+                 'attachment; filename="paddle_tpu_trace.json"'),))
+            return True
+        if route == "/debug/dump":
+            # the incident bundle ON DEMAND (no crash needed):
+            # event ring, spans, metrics, engine slot/queue
+            # state, config, thread stacks. ?write=1 persists it
+            # to the reporter's incident directory instead.
+            rep = _frec.get_reporter()
+            if parse_qs(query).get("write"):
+                path = rep.dump("manual",
+                                context="GET /debug/dump?write=1")
+                self._json(200, {"path": path})
+                return True
+            _frec.RECORDER.record(_frec.EV_INCIDENT,
+                                  reason="manual", path=None)
+            self._json(200, rep.bundle("manual", context="GET /debug/dump"))
+            return True
+        if route == "/debug/events":
+            q = parse_qs(query)
+            try:
+                since = int((q.get("since") or ["0"])[0])
+                limit = int((q.get("limit") or ["500"])[0])
+            except ValueError:
+                self._json(400, {"error": "since/limit must be integers"})
+                return True
+            kind = (q.get("kind") or [None])[0]
+            rec = _frec.get_recorder()
+            evs = rec.events(since=since, kind=kind, limit=limit)
+            self._json(200, {
+                "events": evs,
+                # resume cursor: pass back as ?since= to tail the
+                # ring incrementally
+                "next_since": (evs[-1]["seq"] if evs else since),
+                "stats": rec.stats(),
+            })
+            return True
+        if route == "/metrics":
+            # refresh the occupancy gauges off ONE stats() snapshot,
+            # then render the whole registry; counted BEFORE the render
+            # so a scrape sees itself
+            srv._refresh_metrics()
+            self._count(200)
+            body = get_registry().render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return True
+        if route == "/health":
+            self._json(200, srv._health_payload())
+            return True
+        if route == "/v1/models":
+            self._json(200, srv._models_payload())
+            return True
+        return False
+
+    # ---- POST ----------------------------------------------------------
+    def do_POST(self):
+        # one span per POST (http.request here; router.request on the
+        # cluster router), continuing the caller's trace when an inbound
+        # W3C traceparent header is present; its context parents the
+        # engine's serving.request root span
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_HTTP_REQUEST, method="POST",
+                       path=self.path)
+        ctx = _tracing.parse_traceparent(
+            self.headers.get(_tracing.TRACEPARENT_HEADER))
+        sp = self.server_obj._tracer.start_span(
+            self.post_span_name or _tracing.SPAN_HTTP_REQUEST,
+            trace_id=ctx[0] if ctx else None,
+            parent_id=ctx[1] if ctx else None,
+            attrs={"method": "POST", "path": self.path})
+        self._trace_span = sp if sp else None
+        try:
+            self._post_inner()
+        except BaseException:
+            sp.end("error")
+            raise
+        sp.end()
+
+    def _post_inner(self):
+        # drain the body FIRST: replying without reading it would
+        # desync a keep-alive connection (HTTP/1.1 is on), making
+        # the next request parse the unread bytes as a request line
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+        except Exception:
+            return self._json(400, {"error": "unreadable body"})
+        route = urlsplit(self.path).path
+        fn = self.server_obj._post_handler(route)
+        if fn is None:
+            return self._json(404, {"error": "not found"})
+        try:
+            req = json.loads(body or b"{}")
+        except Exception:
+            return self._json(400, {"error": "invalid JSON body"})
+        return fn(self, req)
 
 
 class CompletionServer:
@@ -152,11 +398,31 @@ class CompletionServer:
         self.close()
 
     # ---- engine thread -------------------------------------------------
+    def submit_command(self, cmd: EngineCommand, timeout: float = 120.0):
+        """Run ``cmd`` on the engine thread and wait for its result;
+        raises the error classes the POST paths map to 400/500."""
+        self._subs.put(cmd)
+        while True:
+            try:
+                kind, payload, _ = cmd.events.get(timeout=1.0)
+            except queue.Empty:
+                timeout -= 1.0
+                if self._stop.is_set():
+                    raise RuntimeError("engine stopped")
+                if timeout <= 0:
+                    raise TimeoutError("engine command timed out")
+                continue
+            if kind == "error":
+                raise ValueError(payload)
+            if kind == "fault":
+                raise RuntimeError(payload)
+            return payload
+
     def _handle_submission(self, sub):
         """Process one queue item ON the engine thread: a cancel command
-        frees its submission's slots; a submission becomes engine
-        requests (add_request allocates host-side, admission happens
-        inside step)."""
+        frees its submission's slots; an EngineCommand executes and posts
+        its result; a submission becomes engine requests (add_request
+        allocates host-side, admission happens inside step())."""
         eng = self.engine
         if isinstance(sub, _Cancel):
             for rid in sub.sub.rids:
@@ -169,17 +435,33 @@ class CompletionServer:
                     self._stop.set()
                     raise
             return
+        if isinstance(sub, EngineCommand):
+            try:
+                sub.events.put(("ok", sub.execute(eng), True))
+            except (ValueError, TypeError, NotImplementedError) as e:
+                sub.events.put(("error", str(e), True))
+            except Exception as e:    # engine fault -> HTTP 500
+                sub.events.put(("fault", str(e), True))
+            return
         ev = sub.events
 
         def on_token(rid, tok, done, logprob, _ev=ev):
             _ev.put(("token", (rid, tok, logprob), done))
 
         try:
-            for _ in range(sub.n):
+            if sub.handoff is not None:
+                # disaggregated tier: the prompt's KV arrived from a
+                # prefill worker; admit it without a local prefill
                 sub.rids.append(
-                    eng.add_request(sub.ids, on_token=on_token,
-                                    trace_ctx=sub.trace_ctx,
-                                    **sub.params))
+                    eng.admit_prefilled(sub.handoff, on_token=on_token,
+                                        trace_ctx=sub.trace_ctx,
+                                        **sub.params))
+            else:
+                for _ in range(sub.n):
+                    sub.rids.append(
+                        eng.add_request(sub.ids, on_token=on_token,
+                                        trace_ctx=sub.trace_ctx,
+                                        **sub.params))
             sub.rid = sub.rids[0]
         except (ValueError, TypeError, NotImplementedError) as e:
             # client error (bad params, pixel_values to a
@@ -227,385 +509,229 @@ class CompletionServer:
                 except queue.Empty:
                     pass
 
-    # ---- HTTP ----------------------------------------------------------
+    # ---- handler hooks --------------------------------------------------
     def _make_handler(server_self):
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *a):  # silence request logging
-                pass
-
-            # the handler's http.request span (None on GETs / when
-            # tracing is off); responses echo its traceparent
-            _trace_span = None
-
-            def _count(self, code):
-                route = urlsplit(self.path).path
-                if route not in _KNOWN_ROUTES:
-                    route = "other"
-                HTTP_REQUESTS.inc(path=route, code=str(code))
-
-            def _send_traceparent(self):
-                sp = self._trace_span
-                if sp is not None and sp.trace_id:
-                    self.send_header(
-                        _tracing.TRACEPARENT_HEADER,
-                        _tracing.format_traceparent(sp.trace_id,
-                                                    sp.span_id))
-
-            def _json(self, code, obj, headers=()):
-                self._count(code)
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                for k, v in headers:
-                    self.send_header(k, v)
-                self._send_traceparent()
-                self.end_headers()
-                self.wfile.write(body)
-
-            def _trace_query(self, query):
-                """?trace_id=... | ?rid=N[&engine=...] -> trace_id or
-                None (unknown rid / malformed query)."""
-                q = parse_qs(query)
-                if q.get("trace_id"):
-                    return q["trace_id"][0]
-                if q.get("rid"):
-                    try:
-                        rid = int(q["rid"][0])
-                    except ValueError:
-                        return None
-                    engine = (q.get("engine") or [None])[0]
-                    return server_self._tracer.find_request_trace(
-                        rid, engine=engine)
-                return None
-
-            def do_GET(self):
-                # one handler instance serves a whole keep-alive
-                # connection: drop any previous POST's span so GETs
-                # don't echo a stale traceparent
-                self._trace_span = None
-                route, _, query = self.path.partition("?")
-                if route == "/trace":
-                    tid = self._trace_query(query)
-                    if tid is None:
-                        return self._json(404, {
-                            "error": "no trace: pass ?rid=<request id> "
-                                     "(finished or in flight) or "
-                                     "?trace_id=<32-hex id>"})
-                    # include_live: the POST handler's http.request span
-                    # ends only after its response bytes hit the socket,
-                    # so a caller chaining POST -> GET /trace would
-                    # otherwise race the handler thread and see a tree
-                    # missing its http.request node
-                    return self._json(200, {
-                        "trace_id": tid,
-                        "spans": server_self._tracer.spans(
-                            tid, include_live=True)})
-                if route == "/trace/chrome":
-                    # chrome://tracing download; unfiltered dumps merge
-                    # the profiler's host events onto the same timeline
-                    tid = self._trace_query(query) if query else None
-                    if query and tid is None:
-                        return self._json(404, {"error": "no such trace"})
-                    trace = server_self._tracer.export_chrome(
-                        trace_id=tid)
-                    return self._json(200, trace, headers=(
-                        ("Content-Disposition",
-                         'attachment; filename="paddle_tpu_trace.json"'),))
-                if route == "/debug/dump":
-                    # the incident bundle ON DEMAND (no crash needed):
-                    # event ring, spans, metrics, engine slot/queue
-                    # state, config, thread stacks. ?write=1 persists it
-                    # to the reporter's incident directory instead.
-                    rep = _frec.get_reporter()
-                    if parse_qs(query).get("write"):
-                        path = rep.dump("manual",
-                                        context="GET /debug/dump?write=1")
-                        return self._json(200, {"path": path})
-                    _frec.RECORDER.record(_frec.EV_INCIDENT,
-                                          reason="manual", path=None)
-                    return self._json(200, rep.bundle(
-                        "manual", context="GET /debug/dump"))
-                if route == "/debug/events":
-                    q = parse_qs(query)
-                    try:
-                        since = int((q.get("since") or ["0"])[0])
-                        limit = int((q.get("limit") or ["500"])[0])
-                    except ValueError:
-                        return self._json(
-                            400, {"error": "since/limit must be integers"})
-                    kind = (q.get("kind") or [None])[0]
-                    rec = _frec.get_recorder()
-                    evs = rec.events(since=since, kind=kind, limit=limit)
-                    return self._json(200, {
-                        "events": evs,
-                        # resume cursor: pass back as ?since= to tail the
-                        # ring incrementally
-                        "next_since": (evs[-1]["seq"] if evs else since),
-                        "stats": rec.stats(),
-                    })
-                if self.path == "/metrics":
-                    # refresh the occupancy gauges off the engine's ONE
-                    # stats() snapshot, then render the whole registry;
-                    # counted BEFORE the render so a scrape sees itself
-                    server_self.engine.stats()
-                    self._count(200)
-                    body = get_registry().render_prometheus().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     PROMETHEUS_CONTENT_TYPE)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
-                if self.path == "/health":
-                    eng = server_self.engine
-                    stats = eng.stats()
-                    # legacy top-level keys alias the SAME stats read (one
-                    # snapshot — a monitor must never see them disagree)
-                    payload = {
-                        "status": "ok",
-                        "active": stats["requests_active"],
-                        "queued": stats["requests_queued"],
-                        "max_batch": eng.max_batch,
-                        "max_len": eng.max_len,
-                        "stats": stats,
-                    }
-                    return self._json(200, payload)
-                if self.path == "/v1/models":
-                    return self._json(200, {
-                        "object": "list",
-                        "data": [{"id": server_self.model_name,
-                                  "object": "model"}],
-                    })
-                return self._json(404, {"error": "not found"})
-
-            def do_POST(self):
-                # one http.request span per POST, continuing the
-                # caller's trace when an inbound W3C traceparent header
-                # is present; its context parents the engine's
-                # serving.request root span
-                rec = _frec.RECORDER
-                if rec.enabled:
-                    rec.record(_frec.EV_HTTP_REQUEST, method="POST",
-                               path=self.path)
-                ctx = _tracing.parse_traceparent(
-                    self.headers.get(_tracing.TRACEPARENT_HEADER))
-                sp = server_self._tracer.start_span(
-                    _tracing.SPAN_HTTP_REQUEST,
-                    trace_id=ctx[0] if ctx else None,
-                    parent_id=ctx[1] if ctx else None,
-                    attrs={"method": "POST", "path": self.path})
-                self._trace_span = sp if sp else None
-                try:
-                    self._post_inner()
-                except BaseException:
-                    sp.end("error")
-                    raise
-                sp.end()
-
-            def _post_inner(self):
-                # drain the body FIRST: replying without reading it would
-                # desync a keep-alive connection (HTTP/1.1 is on), making
-                # the next request parse the unread bytes as a request line
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = self.rfile.read(n)
-                except Exception:
-                    return self._json(400, {"error": "unreadable body"})
-                if self.path != "/v1/completions":
-                    return self._json(404, {"error": "not found"})
-                try:
-                    req = json.loads(body or b"{}")
-                except Exception:
-                    return self._json(400, {"error": "invalid JSON body"})
-                try:
-                    ids = server_self._prompt_ids(req)
-                    max_tokens = int(req.get("max_tokens", 16))
-                    if max_tokens < 1:
-                        # the engine checks budgets only post-append, so
-                        # max_tokens=0 would come back with ONE token —
-                        # reject here instead (OpenAI also 400s it)
-                        raise ValueError("max_tokens must be >= 1")
-                    params = dict(max_new_tokens=max_tokens)
-                    if ("temperature" in req or "top_p" in req
-                            or "top_k" in req or req.get("do_sample")):
-                        params.update(
-                            do_sample=True,
-                            temperature=float(req.get("temperature", 1.0)),
-                            top_k=int(req.get("top_k", 0)),
-                            top_p=float(req.get("top_p", 1.0)))
-                    stop = req.get("stop_token_ids")
-                    if stop is not None:
-                        params["stop_token_ids"] = [int(s) for s in stop]
-                    # OpenAI "logprobs" is an int 0-5 (0 = chosen-token
-                    # logprobs, no alternatives) or a bool — False means
-                    # OFF, any other non-None value (0 included) is ON
-                    lp_req = req.get("logprobs")
-                    want_logprobs = (lp_req is not None
-                                     and lp_req is not False)
-                    if want_logprobs:
-                        params["logprobs"] = True
-                    n = int(req.get("n", 1))
-                    if n < 1:
-                        raise ValueError("n must be >= 1")
-                    if n > 1 and req.get("stream"):
-                        raise ValueError(
-                            "n > 1 does not combine with stream")
-                    if n > 1:
-                        # validate the EFFECTIVE sampling config (engine
-                        # defaults merged with request overrides) — n
-                        # deterministic completions would be identical
-                        eng_s, eng_t, _, _ = server_self.engine._sample_cfg
-                        eff_s = params.get("do_sample", eng_s)
-                        eff_t = params.get("temperature", eng_t)
-                        if not eff_s or eff_t <= 0:
-                            raise ValueError(
-                                "n > 1 needs effective sampling "
-                                "(do_sample with temperature > 0) — n "
-                                "deterministic completions would be "
-                                "identical")
-                    px = req.get("pixel_values")
-                    if px is not None:
-                        # multimodal request (LLaVA): nested lists
-                        # [n_images, C, H, W] -> the engine's jitted
-                        # merge + embeds prefill
-                        arr = np.asarray(px, np.float32)
-                        if arr.ndim != 4:
-                            raise ValueError(
-                                "pixel_values must be a nested list of "
-                                "shape [n_images, C, H, W]")
-                        params["pixel_values"] = arr
-                except (ValueError, TypeError) as e:
-                    # wrong-typed fields answer 400, not a dropped socket
-                    return self._json(400, {"error": str(e)})
-                sp = self._trace_span
-                sub = _Submission(ids, params, n=n,
-                                  trace_ctx=((sp.trace_id, sp.span_id)
-                                             if sp is not None else None))
-                server_self._subs.put(sub)
-                cid = f"cmpl-{uuid.uuid4().hex[:24]}"
-                if req.get("stream"):
-                    return self._stream(sub, cid, len(ids), want_logprobs)
-                by_rid, lps_by_rid, err = {}, {}, None
-                finished = 0
-                while True:
-                    try:
-                        kind, payload, done = sub.events.get(timeout=1.0)
-                    except queue.Empty:
-                        if server_self._stop.is_set():
-                            return self._json(500,
-                                              {"error": "engine stopped"})
-                        continue
-                    if kind in ("error", "fault"):
-                        err = (kind, payload)
-                        break
-                    rid, tok, lp = payload
-                    by_rid.setdefault(rid, []).append(int(tok))
-                    lps_by_rid.setdefault(rid, []).append(float(lp))
-                    if done:
-                        finished += 1
-                        if finished == sub.n:
-                            break
-                if err is not None:
-                    kind, msg = err
-                    return self._json(400 if kind == "error" else 500,
-                                      {"error": msg})
-                choices = []
-                total_completion = 0
-                for i, rid in enumerate(sub.rids):
-                    toks = by_rid.get(rid, [])
-                    total_completion += len(toks)
-                    # single source of truth: the ENGINE records why each
-                    # request retired (recorded before its done event)
-                    choice = {"index": i,
-                              "finish_reason":
-                                  (server_self.engine.finish_reason(rid)
-                                   or "length"),
-                              "token_ids": toks}
-                    if want_logprobs:
-                        choice["logprobs"] = {
-                            "token_logprobs": lps_by_rid.get(rid, [])}
-                    if server_self.tokenizer is not None:
-                        choice["text"] = server_self.tokenizer.decode(toks)
-                    choices.append(choice)
-                return self._json(200, {
-                    "id": cid, "object": "text_completion",
-                    "model": server_self.model_name,
-                    "choices": choices,
-                    "usage": {"prompt_tokens": len(ids),
-                              "completion_tokens": total_completion,
-                              "total_tokens": len(ids) + total_completion},
-                })
-
-            def _stream(self, sub, cid, n_prompt, want_logprobs=False):
-                def chunk(payload: bytes):
-                    self.wfile.write(f"{len(payload):X}\r\n".encode()
-                                     + payload + b"\r\n")
-
-                try:
-                    self._count(200)
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/event-stream")
-                    self.send_header("Cache-Control", "no-cache")
-                    self.send_header("Transfer-Encoding", "chunked")
-                    self._send_traceparent()
-                    self.end_headers()
-
-                    clean = True
-                    while True:
-                        try:
-                            kind, payload, done = sub.events.get(
-                                timeout=1.0)
-                        except queue.Empty:
-                            if server_self._stop.is_set():
-                                chunk(b'data: '
-                                      b'{"error": "engine stopped"}\n\n')
-                                clean = False
-                                break
-                            continue
-                        if kind in ("error", "fault"):
-                            chunk(b'data: {"error": '
-                                  + json.dumps(str(payload)).encode()
-                                  + b"}\n\n")
-                            clean = False
-                            break
-                        _rid, tok, lp = payload
-                        piece = {"id": cid, "object": "text_completion",
-                                 "choices": [{"index": 0,
-                                              "token_ids": [int(tok)]}]}
-                        if want_logprobs:
-                            piece["choices"][0]["logprobs"] = {
-                                "token_logprobs": [float(lp)]}
-                        if server_self.tokenizer is not None:
-                            piece["choices"][0]["text"] = (
-                                server_self.tokenizer.decode([int(tok)]))
-                        chunk(b"data: " + json.dumps(piece).encode()
-                              + b"\n\n")
-                        if done:
-                            break
-                    if clean:
-                        # [DONE] signals CLEAN completion only — an SSE
-                        # client watching for it must not mistake a failed
-                        # stream for success
-                        chunk(b"data: [DONE]\n\n")
-                    chunk(b"")  # chunked-encoding terminator
-                except OSError:
-                    # client went away mid-stream (BrokenPipeError /
-                    # reset): the engine must not keep decoding into a
-                    # dead socket — enqueue a cancel command to the
-                    # engine thread (it owns all device state), which
-                    # frees the slot(s) immediately and ends the
-                    # request's root span with status=cancelled
-                    server_self._subs.put(_Cancel(sub))
-                    if self._trace_span is not None:
-                        self._trace_span.set_attr("client_disconnected",
-                                                  True)
-                    self.close_connection = True
+        class Handler(ServingHandlerBase):
+            server_obj = server_self
 
         return Handler
+
+    def _refresh_metrics(self):
+        # one stats() snapshot refreshes the occupancy gauges
+        self.engine.stats()
+
+    def _health_payload(self) -> dict:
+        eng = self.engine
+        stats = eng.stats()
+        # legacy top-level keys alias the SAME stats read (one
+        # snapshot — a monitor must never see them disagree)
+        payload = {
+            "status": "ok",
+            "active": stats["requests_active"],
+            "queued": stats["requests_queued"],
+            "max_batch": eng.max_batch,
+            "max_len": eng.max_len,
+            "stats": stats,
+        }
+        payload.update(self.health_extra())
+        return payload
+
+    def health_extra(self) -> dict:
+        """Extra /health keys (cluster workers add role / replica_id /
+        lease age here)."""
+        return {}
+
+    def _models_payload(self) -> dict:
+        return {
+            "object": "list",
+            "data": [{"id": self.model_name, "object": "model"}],
+        }
+
+    def _extra_get(self, handler, route, query) -> bool:
+        return False
+
+    def _post_handler(self, route):
+        return self._complete if route == "/v1/completions" else None
+
+    # ---- the completions POST -------------------------------------------
+    def _parse_completion(self, req):
+        """Request JSON -> (ids, params, n, want_logprobs); raises
+        ValueError/TypeError on client errors (the 400 path)."""
+        ids = self._prompt_ids(req)
+        max_tokens = int(req.get("max_tokens", 16))
+        if max_tokens < 1:
+            # the engine checks budgets only post-append, so
+            # max_tokens=0 would come back with ONE token —
+            # reject here instead (OpenAI also 400s it)
+            raise ValueError("max_tokens must be >= 1")
+        params = dict(max_new_tokens=max_tokens)
+        if ("temperature" in req or "top_p" in req
+                or "top_k" in req or req.get("do_sample")):
+            params.update(
+                do_sample=True,
+                temperature=float(req.get("temperature", 1.0)),
+                top_k=int(req.get("top_k", 0)),
+                top_p=float(req.get("top_p", 1.0)))
+        stop = req.get("stop_token_ids")
+        if stop is not None:
+            params["stop_token_ids"] = [int(s) for s in stop]
+        # OpenAI "logprobs" is an int 0-5 (0 = chosen-token
+        # logprobs, no alternatives) or a bool — False means
+        # OFF, any other non-None value (0 included) is ON
+        lp_req = req.get("logprobs")
+        want_logprobs = (lp_req is not None and lp_req is not False)
+        if want_logprobs:
+            params["logprobs"] = True
+        n = int(req.get("n", 1))
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        if n > 1 and req.get("stream"):
+            raise ValueError("n > 1 does not combine with stream")
+        if n > 1:
+            # validate the EFFECTIVE sampling config (engine
+            # defaults merged with request overrides) — n
+            # deterministic completions would be identical
+            eng_s, eng_t, _, _ = self.engine._sample_cfg
+            eff_s = params.get("do_sample", eng_s)
+            eff_t = params.get("temperature", eng_t)
+            if not eff_s or eff_t <= 0:
+                raise ValueError(
+                    "n > 1 needs effective sampling "
+                    "(do_sample with temperature > 0) — n "
+                    "deterministic completions would be "
+                    "identical")
+        px = req.get("pixel_values")
+        if px is not None:
+            # multimodal request (LLaVA): nested lists
+            # [n_images, C, H, W] -> the engine's jitted
+            # merge + embeds prefill
+            arr = np.asarray(px, np.float32)
+            if arr.ndim != 4:
+                raise ValueError(
+                    "pixel_values must be a nested list of "
+                    "shape [n_images, C, H, W]")
+            params["pixel_values"] = arr
+        return ids, params, n, want_logprobs
+
+    def _complete(self, handler, req):
+        try:
+            ids, params, n, want_logprobs = self._parse_completion(req)
+        except (ValueError, TypeError) as e:
+            # wrong-typed fields answer 400, not a dropped socket
+            return handler._json(400, {"error": str(e)})
+        sp = handler._trace_span
+        sub = _Submission(ids, params, n=n,
+                          trace_ctx=((sp.trace_id, sp.span_id)
+                                     if sp is not None else None))
+        self._subs.put(sub)
+        cid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        if req.get("stream"):
+            return self._stream(handler, sub, cid, want_logprobs)
+        return self._collect(handler, sub, cid, len(ids), want_logprobs)
+
+    def _collect(self, handler, sub, cid, n_prompt, want_logprobs):
+        """Batch (non-stream) response: wait for every token event, then
+        answer one completion object."""
+        by_rid, lps_by_rid, err = {}, {}, None
+        finished = 0
+        while True:
+            try:
+                kind, payload, done = sub.events.get(timeout=1.0)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return handler._json(500, {"error": "engine stopped"})
+                continue
+            if kind in ("error", "fault"):
+                err = (kind, payload)
+                break
+            rid, tok, lp = payload
+            by_rid.setdefault(rid, []).append(int(tok))
+            lps_by_rid.setdefault(rid, []).append(float(lp))
+            if done:
+                finished += 1
+                if finished == sub.n:
+                    break
+        if err is not None:
+            kind, msg = err
+            return handler._json(400 if kind == "error" else 500,
+                                 {"error": msg})
+        choices = []
+        total_completion = 0
+        for i, rid in enumerate(sub.rids):
+            toks = by_rid.get(rid, [])
+            total_completion += len(toks)
+            # single source of truth: the ENGINE records why each
+            # request retired (recorded before its done event)
+            choice = {"index": i,
+                      "finish_reason": (self.engine.finish_reason(rid)
+                                        or "length"),
+                      "token_ids": toks}
+            if want_logprobs:
+                choice["logprobs"] = {
+                    "token_logprobs": lps_by_rid.get(rid, [])}
+            if self.tokenizer is not None:
+                choice["text"] = self.tokenizer.decode(toks)
+            choices.append(choice)
+        return handler._json(200, {
+            "id": cid, "object": "text_completion",
+            "model": self.model_name,
+            "choices": choices,
+            "usage": {"prompt_tokens": n_prompt,
+                      "completion_tokens": total_completion,
+                      "total_tokens": n_prompt + total_completion},
+        })
+
+    def _stream(self, handler, sub, cid, want_logprobs=False):
+        try:
+            handler._begin_sse()
+            clean = True
+            while True:
+                try:
+                    kind, payload, done = sub.events.get(timeout=1.0)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        handler._chunk(b'data: '
+                                       b'{"error": "engine stopped"}\n\n')
+                        clean = False
+                        break
+                    continue
+                if kind in ("error", "fault"):
+                    handler._chunk(b'data: {"error": '
+                                   + json.dumps(str(payload)).encode()
+                                   + b"}\n\n")
+                    clean = False
+                    break
+                _rid, tok, lp = payload
+                piece = {"id": cid, "object": "text_completion",
+                         "choices": [{"index": 0,
+                                      "token_ids": [int(tok)]}]}
+                if want_logprobs:
+                    piece["choices"][0]["logprobs"] = {
+                        "token_logprobs": [float(lp)]}
+                if self.tokenizer is not None:
+                    piece["choices"][0]["text"] = (
+                        self.tokenizer.decode([int(tok)]))
+                handler._chunk(b"data: " + json.dumps(piece).encode()
+                               + b"\n\n")
+                if done:
+                    break
+            if clean:
+                # [DONE] signals CLEAN completion only — an SSE
+                # client watching for it must not mistake a failed
+                # stream for success
+                handler._chunk(b"data: [DONE]\n\n")
+            handler._chunk(b"")  # chunked-encoding terminator
+        except OSError:
+            # client went away mid-stream (BrokenPipeError /
+            # reset): the engine must not keep decoding into a
+            # dead socket — enqueue a cancel command to the
+            # engine thread (it owns all device state), which
+            # frees the slot(s) immediately and ends the
+            # request's root span with status=cancelled
+            self._subs.put(_Cancel(sub))
+            if handler._trace_span is not None:
+                handler._trace_span.set_attr("client_disconnected", True)
+            handler.close_connection = True
 
     def _prompt_ids(self, req):
         if "prompt_token_ids" in req:

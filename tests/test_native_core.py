@@ -139,6 +139,51 @@ def test_shm_channel_cross_process():
     chan.close()
 
 
+def test_shm_channel_timeout_is_named():
+    """Timeouts raise ShmChannelTimeout (a TimeoutError subclass — the
+    DataLoader's except clauses keep working) carrying the channel name
+    and queue depth, distinguishing a dead producer from a stuck
+    consumer."""
+    from paddle_tpu.io.shm_channel import ShmChannel, ShmChannelTimeout
+
+    chan = ShmChannel(capacity_mb=1)
+    # empty ring: get() times out with qsize 0
+    with pytest.raises(ShmChannelTimeout) as ei:
+        chan.get(timeout=0.1)
+    assert isinstance(ei.value, TimeoutError)
+    assert ei.value.channel == chan.name
+    assert ei.value.qsize == 0 and ei.value.op == "get"
+    assert chan.name in str(ei.value)
+    # full ring: put() times out with the depth at the moment of failure
+    big = np.zeros(400 * 1024, np.uint8)
+    with pytest.raises(ShmChannelTimeout) as ei:
+        for _ in range(8):
+            chan.put(big, timeout=0.1)
+    assert ei.value.qsize >= 1 and ei.value.op == "put"
+    assert ei.value.channel == chan.name
+    chan.close()
+
+
+def test_shm_channel_close_idempotent():
+    """Double close (and close racing __del__ at teardown) is a no-op,
+    not a double-free; post-close ops raise BrokenPipeError instead of
+    segfaulting on a dead native handle."""
+    from paddle_tpu.io.shm_channel import ShmChannel
+
+    chan = ShmChannel(capacity_mb=1)
+    chan.put(np.arange(4))
+    chan.close()
+    chan.close()      # second close: no-op
+    chan.__del__()    # teardown path on a closed channel: no-op
+    for op in (lambda: chan.put(1), lambda: chan.get(timeout=0.1),
+               chan.qsize, chan.close_writers):
+        with pytest.raises(BrokenPipeError):
+            op()
+    # a failed constructor leaves a partial object __del__ must survive
+    with pytest.raises(RuntimeError):
+        ShmChannel("/pdtpu_does_not_exist", create=False)
+
+
 def test_tcp_store_timeout_not_hang():
     """Ops against a dead daemon must error within the timeout, not hang
     (round-1 VERDICT Weak #1: native layer ignored the Python timeout)."""

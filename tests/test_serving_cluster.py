@@ -1,0 +1,457 @@
+"""Disaggregated serving tier: pool membership over leases, KV handoff
+between engines, and the multi-engine dryrun gate — router + real worker
+processes serving concurrent streamed completions token-identically to a
+single engine, surviving a worker kill mid-stream (bounded-retry requeue)
+with the placement/retry/handoff decisions visible as flight-recorder
+events and ONE trace_id spanning router and worker spans."""
+import json
+import http.client
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import ContinuousBatchEngine
+from paddle_tpu.observability import flightrecorder as frec
+
+_CACHE = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                        "/tmp/paddle_tpu_jax_cache")
+
+
+def _cluster_cfg(workers, max_batch=8, max_len=128, page_size=8,
+                 ttl=2.0, layers=2):
+    return {
+        "cluster": {"host": "127.0.0.1", "port": 0, "ttl": ttl,
+                    "platform": "cpu", "compile_cache": _CACHE,
+                    "model_name": "tiny-llama-cluster"},
+        "model": {"kind": "tiny_llama", "num_hidden_layers": layers,
+                  "seed": 0},
+        "engine": {"max_batch": max_batch, "max_len": max_len,
+                   "page_size": page_size},
+        "workers": workers,
+    }
+
+
+def _ref_model(layers=2):
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=layers))
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _stream_completion(host, port, body, on_first_token=None,
+                       timeout=300):
+    """POST a streaming completion; returns (clean, tokens,
+    traceparent)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.request("POST", "/v1/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.read()
+    tp = resp.getheader("traceparent")
+    toks, clean = [], False
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        if not line.startswith(b"data: "):
+            continue
+        payload = line[len(b"data: "):].strip()
+        if payload == b"[DONE]":
+            clean = True
+            break
+        d = json.loads(payload)
+        if "error" in d:
+            break
+        toks.append(d["choices"][0]["token_ids"][0])
+        if on_first_token is not None and len(toks) == 1:
+            on_first_token()
+    conn.close()
+    return clean, toks, tp
+
+
+# ---- in-process: engine handoff + kv channel --------------------------------
+
+def test_export_admit_handoff_matches_solo():
+    """export_prefill on one engine -> admit_prefilled on a PEER engine
+    (same weights): generated tokens identical to solo generate, and the
+    prefill engine's pool is untouched."""
+    model = _ref_model()
+    prompt = np.random.RandomState(0).randint(1, 512, (9,)).tolist()
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=6).numpy()[0].tolist()
+    pre = ContinuousBatchEngine(model, max_batch=2, max_len=64, page_size=8)
+    dec = ContinuousBatchEngine(model, max_batch=2, max_len=64, page_size=8)
+    bundle = pre.export_prefill(prompt, max_new_tokens=6)
+    assert pre.num_active == 0 and not pre._queue
+    assert bundle["prompt_tokens"] == len(prompt)
+    rid = dec.admit_prefilled(bundle, max_new_tokens=6)
+    out = dec.run_until_done()
+    assert out[rid].tolist() == solo
+    assert dec.finish_reason(rid) == "length"
+
+
+def test_export_admit_validation():
+    model = _ref_model()
+    eng = ContinuousBatchEngine(model, max_batch=2, max_len=64, page_size=8)
+    with pytest.raises(ValueError, match="max_len"):
+        eng.export_prefill([1] * 60, max_new_tokens=10)
+    bundle = eng.export_prefill([1, 2, 3], max_new_tokens=4)
+    # page-size mismatch between the tiers is a config error, not a crash
+    other = ContinuousBatchEngine(model, max_batch=2, max_len=60,
+                                  page_size=12)
+    with pytest.raises(ValueError, match="page_size"):
+        other.admit_prefilled(bundle, max_new_tokens=4)
+    # layer-count mismatch (different model depth)
+    deeper = ContinuousBatchEngine(
+        LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=3)),
+        max_batch=2, max_len=64, page_size=8)
+    with pytest.raises(ValueError, match="layers"):
+        deeper.admit_prefilled(bundle, max_new_tokens=4)
+
+
+def test_kv_handoff_channel_roundtrip():
+    """The shm transport end to end in one process: receiver owns the
+    ring, sender opens it by name, bundles park by handoff_id and decode
+    output stays token-identical; send/recv flight-recorder events land
+    in the ring."""
+    from paddle_tpu.serving_cluster import (KvHandoffReceiver,
+                                            KvHandoffSender)
+
+    model = _ref_model()
+    prompt = np.random.RandomState(5).randint(1, 512, (7,)).tolist()
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=5).numpy()[0].tolist()
+    pre = ContinuousBatchEngine(model, max_batch=2, max_len=64, page_size=8)
+    dec = ContinuousBatchEngine(model, max_batch=2, max_len=64, page_size=8)
+
+    rec = frec.get_recorder()
+    was_enabled = rec.enabled
+    rec.enable()
+    recv = KvHandoffReceiver(name=f"/pdtpu_kv_test_{os.getpid()}",
+                             capacity_mb=16).start()
+    try:
+        since = rec.stats()["recorded"]
+        sender = KvHandoffSender(recv.name)
+        bundle = pre.export_prefill(prompt, max_new_tokens=5)
+        nbytes = sender.send("h1", bundle)
+        assert nbytes > 0
+        got = recv.wait("h1", timeout=10)
+        assert got is not None
+        # unknown ids time out to None instead of blocking forever
+        assert recv.wait("nope", timeout=0.1) is None
+        rid = dec.admit_prefilled(got, max_new_tokens=5)
+        out = dec.run_until_done()
+        assert out[rid].tolist() == solo
+        kinds = [e["kind"] for e in rec.events(since=since, kind="kv")]
+        assert "kv.handoff_send" in kinds and "kv.handoff_recv" in kinds
+        sender.close()
+    finally:
+        recv.close()
+        if not was_enabled:
+            rec.disable()
+
+
+# ---- pool membership over real leases ---------------------------------------
+
+def test_pool_lease_membership_and_loss():
+    """Workers join the pool through ElasticManager leases + metadata;
+    a lapsed heartbeat marks the worker lost (router.worker_lost event),
+    and mark_dead takes a worker out of placement immediately."""
+    from paddle_tpu.distributed.elastic import ElasticManager
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.serving_cluster import WorkerPool
+
+    rec = frec.get_recorder()
+    was_enabled = rec.enabled
+    rec.enable()
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=3)
+    workers = []
+    try:
+        for r in range(2):
+            m = ElasticManager(store=store, rank=r, world_size=2,
+                               ttl=1.0, job_id="pooltest")
+            m.register()
+            m.register_metadata({"host": "127.0.0.1", "port": 1000 + r,
+                                 "role": "unified", "pid": 0,
+                                 "kv_channel": None})
+            workers.append(m)
+        pool = WorkerPool(store=store, world_size=2, job_id="pooltest",
+                          ttl=1.0, probe_timeout=0.2)
+        since = rec.stats()["recorded"]
+        pool.refresh()
+        snap = {w["replica_id"]: w for w in pool.workers()}
+        assert set(snap) == {0, 1}
+        assert all(w["alive"] for w in snap.values())
+        assert snap[0]["lease_age_s"] is not None
+        kinds = [e["kind"] for e in rec.events(since=since)]
+        assert kinds.count("router.worker_join") == 2
+
+        # placement is least-loaded with pending accounting
+        w_a = pool.select()
+        w_b = pool.select()
+        assert {w_a.replica_id, w_b.replica_id} == {0, 1}
+        pool.release(w_a)
+        pool.release(w_b)
+
+        # mark_dead pulls a worker out of rotation NOW
+        pool.mark_dead(0, "connection")
+        w = pool.select()
+        assert w.replica_id == 1
+        pool.release(w)
+
+        # a worker that KEEPS heartbeating rejoins once a stamp newer
+        # than the death observation lands (a stale-but-fresh lease from
+        # before the death must NOT resurrect it)
+        deadline = time.monotonic() + 10
+        back = False
+        while time.monotonic() < deadline and not back:
+            time.sleep(0.2)
+            pool.refresh()
+            back = {w["replica_id"] for w in pool.workers()
+                    if w["alive"]} == {0, 1}
+        assert back, "re-stamping worker never rejoined the pool"
+
+        # a lapsed heartbeat is a LOST worker
+        since = rec.stats()["recorded"]
+        workers[1].stop_heartbeat()
+        deadline = time.monotonic() + 10
+        lost = False
+        while time.monotonic() < deadline and not lost:
+            time.sleep(0.3)
+            pool.refresh()
+            snap = {w["replica_id"]: w for w in pool.workers()}
+            lost = not snap[1]["alive"]
+        assert lost, "lease lapse never marked the worker lost"
+        kinds = [e["kind"] for e in rec.events(since=since)]
+        assert "router.worker_lost" in kinds
+        assert snap[0]["alive"]
+        pool.close()
+    finally:
+        for m in workers:
+            m.close()
+        store.close()
+        if not was_enabled:
+            rec.disable()
+
+
+# ---- the multi-engine dryrun gate -------------------------------------------
+
+@pytest.fixture(scope="module")
+def unified_cluster():
+    from paddle_tpu.serving_cluster import launch_cluster
+
+    cluster = launch_cluster(_cluster_cfg(
+        [{"role": "unified", "count": 2}]))
+    yield cluster
+    cluster.close()
+
+
+def test_cluster_gate_concurrent_streams_and_failover(unified_cluster):
+    """THE gate: 8 concurrent streaming requests through the router over
+    2 CPU worker processes, token-identical to single-engine serving;
+    killing one worker mid-stream requeues its in-flight requests onto
+    the survivor (streams stay continuous and correct); the decisions
+    are flight-recorder events and one trace_id spans router + worker."""
+    cluster = unified_cluster
+    host, port = cluster.address
+    model = _ref_model()
+    rng = np.random.RandomState(3)
+    n_tok = 96
+    # ONE prompt length: every worker compiles exactly one prefill
+    # bucket, and the warmup round below pays for it — so in the real
+    # phase first tokens arrive in milliseconds and the kill lands with
+    # ~90 tokens still undelivered on every stream
+    prompts = [rng.randint(1, 512, (9,)).tolist() for _ in range(8)]
+    solos = [model.generate(paddle.to_tensor(np.asarray(p)[None]),
+                            max_new_tokens=n_tok).numpy()[0].tolist()
+             for p in prompts]
+
+    def warm(i):
+        conn = http.client.HTTPConnection(host, port, timeout=300)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt_token_ids": prompts[i],
+                                 "max_tokens": 1}),
+                     {"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+        conn.close()
+
+    warmers = [threading.Thread(target=warm, args=(i,)) for i in range(8)]
+    for t in warmers:
+        t.start()
+    for t in warmers:
+        t.join(timeout=300)
+
+    rec = frec.get_recorder()
+    since = rec.stats()["recorded"]
+    results = [None] * len(prompts)
+    first = [threading.Event() for _ in prompts]
+
+    def client(i):
+        results[i] = _stream_completion(
+            host, port,
+            {"prompt_token_ids": prompts[i], "max_tokens": n_tok,
+             "stream": True},
+            on_first_token=first[i].set)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for ev in first:
+        assert ev.wait(180), "a stream never produced its first token"
+    # every stream is mid-flight: kill one worker process (SIGKILL — no
+    # clean deregistration, exactly the failure the tier must absorb)
+    cluster.kill_worker(0)
+    for t in threads:
+        t.join(timeout=300)
+    for i, (clean, toks, _) in enumerate(results):
+        assert clean, f"stream {i} did not end with [DONE]"
+        assert toks == solos[i], f"stream {i} tokens diverged"
+
+    # placement/retry/loss decisions are flight-recorder events
+    evs = rec.events(since=since, kind="router")
+    kinds = [e["kind"] for e in evs]
+    assert kinds.count("router.place") >= len(prompts)
+    assert "router.worker_lost" in kinds
+    retries = [e for e in evs if e["kind"] == "router.retry"]
+    assert retries, "killing a worker mid-stream must requeue requests"
+    # the failover skipped already-delivered tokens (continuation, not
+    # replay): at least one retry happened after first tokens flowed
+    assert any(e["delivered"] >= 1 for e in retries)
+
+    # the router's aggregate /health shows the loss and the survivor
+    health = _get_json(f"http://{host}:{port}/health")
+    assert health["status"] == "ok"
+    workers = health["workers"]
+    assert len(workers) == 2
+    alive = [w for w in workers.values() if w["alive"]]
+    dead = [w for w in workers.values() if not w["alive"]]
+    assert len(alive) == 1 and len(dead) == 1
+    assert health["router"]["retried"] >= 1
+
+    # worker /health carries the cluster identity satellite
+    wh = _get_json(alive[0]["url"] + "/health")
+    assert wh["role"] == "unified"
+    assert wh["replica_id"] == alive[0]["replica_id"]
+    assert wh["lease_age_s"] is not None and wh["lease_age_s"] >= 0.0
+
+
+def test_cluster_gate_single_trace_spans_router_and_worker(
+        unified_cluster):
+    """One trace_id covers the router's router.request/router.upstream
+    and the worker's http.request/serving.request spans — the
+    cross-process timeline the tracer was built for."""
+    cluster = unified_cluster
+    host, port = cluster.address
+    model = _ref_model()
+    prompt = np.random.RandomState(9).randint(1, 512, (6,)).tolist()
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=4).numpy()[0].tolist()
+    clean, toks, tp = _stream_completion(
+        host, port, {"prompt_token_ids": prompt, "max_tokens": 4,
+                     "stream": True})
+    assert clean and toks == solo
+    assert tp, "router must answer with a traceparent"
+    trace_id = tp.split("-")[1]
+
+    router_spans = _get_json(
+        f"http://{host}:{port}/trace?trace_id={trace_id}")["spans"]
+    names = {s["name"] for s in router_spans}
+    assert {"router.request", "router.upstream"} <= names
+    assert all(s["trace_id"] == trace_id for s in router_spans)
+
+    health = _get_json(f"http://{host}:{port}/health")
+    worker_names = set()
+    for w in health["workers"].values():
+        if not w["alive"]:
+            continue
+        spans = _get_json(
+            w["url"] + f"/trace?trace_id={trace_id}")["spans"]
+        worker_names |= {s["name"] for s in spans}
+        assert all(s["trace_id"] == trace_id for s in spans)
+    assert {"http.request", "serving.request"} <= worker_names
+
+
+def test_cluster_prefill_decode_disaggregation():
+    """Role-split tier: a prefill worker computes the prompt KV and
+    ships it over the decode worker's shm handoff channel; the decode
+    worker streams token-identical output; both sides record their
+    handoff events."""
+    from paddle_tpu.serving_cluster import launch_cluster
+
+    model = _ref_model()
+    prompt = np.random.RandomState(7).randint(1, 512, (9,)).tolist()
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=8).numpy()[0].tolist()
+    with launch_cluster(_cluster_cfg(
+            [{"role": "prefill", "count": 1},
+             {"role": "decode", "count": 1}],
+            max_batch=4, max_len=64)) as cluster:
+        host, port = cluster.address
+        # non-stream
+        conn = http.client.HTTPConnection(host, port, timeout=180)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt_token_ids": prompt,
+                                 "max_tokens": 8}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        out = json.loads(resp.read())
+        conn.close()
+        assert out["choices"][0]["token_ids"] == solo
+        # stream
+        clean, toks, _ = _stream_completion(
+            host, port, {"prompt_token_ids": prompt, "max_tokens": 8,
+                         "stream": True})
+        assert clean and toks == solo
+        # handoff decisions visible in BOTH processes' rings
+        health = _get_json(f"http://{host}:{port}/health")
+        by_role = {w["role"]: w for w in health["workers"].values()}
+        pre_evs = _get_json(by_role["prefill"]["url"]
+                            + "/debug/events?kind=kv")["events"]
+        dec_evs = _get_json(by_role["decode"]["url"]
+                            + "/debug/events?kind=kv")["events"]
+        assert {"kv.handoff_send"} == {e["kind"] for e in pre_evs}
+        assert {"kv.handoff_recv"} == {e["kind"] for e in dec_evs}
+        assert len(pre_evs) >= 2 and len(dec_evs) >= 2
+        # a prefill-role worker refuses direct completions
+        conn = http.client.HTTPConnection(
+            by_role["prefill"]["url"].split("//")[1].split(":")[0],
+            int(by_role["prefill"]["url"].rsplit(":", 1)[1]),
+            timeout=30)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt_token_ids": prompt,
+                                 "max_tokens": 2}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 409
+        resp.read()
+        conn.close()
+
+
+# ---- launcher config plumbing -----------------------------------------------
+
+def test_launcher_config_loading(tmp_path):
+    from paddle_tpu.serving_cluster import load_config
+    from paddle_tpu.serving_cluster.launcher import expand_workers
+
+    cfg = _cluster_cfg([{"role": "prefill", "count": 2},
+                        {"role": "decode"}])
+    p = tmp_path / "cluster.json"
+    p.write_text(json.dumps(cfg))
+    loaded = load_config(str(p))
+    assert loaded["engine"]["max_batch"] == 8
+    roles = [w["role"] for w in expand_workers(loaded)]
+    assert roles == ["prefill", "prefill", "decode"]
+    # no workers section -> two unified workers, count stripped
+    assert [w["role"] for w in expand_workers({})] == ["unified"] * 2
+    assert all("count" not in w for w in expand_workers(loaded))
