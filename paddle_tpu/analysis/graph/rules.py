@@ -151,6 +151,76 @@ class PreflightCostRule(GraphRule):
 
 
 @register_rule
+class AutotuneCostTableRule(GraphRule):
+    id = "graph-cost-table"
+    rationale = ("a persisted autotune cost-table entry whose recorded "
+                 "bytes/FLOPs no longer match the kernel's analytical "
+                 "cost model was measured against a different kernel "
+                 "than the one shipping — its winner (and its roofline "
+                 "pruning evidence) is stale")
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        import json
+
+        from ...ops.pallas import autotune
+        # importing the kernel modules registers their cost models
+        from ...ops.pallas import decode_tail, fused_norm  # noqa: F401
+
+        path = autotune.cache_path()
+        if not os.path.isfile(path):
+            return
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            yield Finding(file=rel, line=1, rule=self.id,
+                          symbol="cache-file",
+                          message=f"autotune cache unreadable "
+                                  f"({type(e).__name__}: {e})")
+            return
+        for kernel, sigs in data.items():
+            if not isinstance(sigs, dict):
+                continue
+            for key, ent in sigs.items():
+                if not isinstance(ent, dict):
+                    continue
+                est = ent.get("est")
+                params = ent.get("params")
+                choice = ent.get("choice")
+                if not est or not params or not choice:
+                    continue  # pre-search-era entry: nothing to check
+                symbol = f"{kernel}:{key}"
+                try:
+                    cur = autotune.analytical_cost(kernel, params, choice)
+                except (KeyError, TypeError, ValueError) as e:
+                    yield Finding(
+                        file=rel, line=1, rule=self.id, symbol=symbol,
+                        message=f"cost model replay failed on recorded "
+                                f"params ({type(e).__name__}: {e})")
+                    continue
+                if cur is None:
+                    yield Finding(
+                        file=rel, line=1, rule=self.id, symbol=symbol,
+                        message="entry carries analytical estimates but "
+                                "no cost model is registered for this "
+                                "kernel anymore — stale evidence")
+                    continue
+                for field in ("bytes", "flops"):
+                    want = cur.get(field)
+                    got = est.get(field)
+                    if want is None or got is None:
+                        continue
+                    if abs(int(want) - int(got)) > max(1, int(want) // 100):
+                        yield Finding(
+                            file=rel, line=1, rule=self.id, symbol=symbol,
+                            message=f"recorded {field}={got} disagrees "
+                                    f"with the analytical estimate "
+                                    f"{want} — re-run the sweep (or fix "
+                                    f"the cost model drift)")
+
+
+@register_rule
 class OpDtypesRule(GraphRule):
     id = "graph-op-dtypes"
     rationale = ("an OpDecl claiming a dtype its impl upcasts or "

@@ -62,7 +62,8 @@ def _rope_rows_full(x, cos, sin, row_pos):
 
 def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
                      row_pos=None, use_flash=False, interpret=False,
-                     prefill=False, window=None, softcap=None):
+                     prefill=False, window=None, softcap=None,
+                     rope_applied=False):
     """RoPE + cache write + masked GQA attention against a dense buffer.
 
     q [B,S,H,D]; k/v [B,S,hk,D]; cos/sin [>=max_len, D];
@@ -74,6 +75,8 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
     the whole buffer — at pos=0 prefill, causal attention over the prompt
     equals causal self-attention on the S new tokens, so the flash kernel
     is exact and never touches the (mostly empty) Smax buffer.
+    ``rope_applied``: q/k arrive already rotated (the fused decode-tail
+    kernel ropes in-register) — skip the rope, keep everything else.
     Returns (out [B,S,H,D], new_k_buf, new_v_buf).
     """
     from .ops.pallas.fused_norm import rope_ref
@@ -81,7 +84,9 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
     B, S, H, D = q.shape
     hk = k_buf.shape[2]
     pos = jnp.asarray(pos, jnp.int32)
-    if row_pos is None:
+    if rope_applied:
+        pass
+    elif row_pos is None:
         cos_s = jax.lax.dynamic_slice_in_dim(cos, pos, S, 0)
         sin_s = jax.lax.dynamic_slice_in_dim(sin, pos, S, 0)
         q = rope_ref(q, cos_s, sin_s)
@@ -162,7 +167,8 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
 
 
 def paged_cached_attention(q, k, v, cos, sin, k_pages, v_pages, page_indices,
-                           lengths, page_size, window=None, softcap=None):
+                           lengths, page_size, window=None, softcap=None,
+                           rope_applied=False):
     """Single-token decode over the PAGED cache (in-layer dispatch).
 
     q [B,1,H,D]; pages [hk, n_pages, page_size, D]; lengths [B] = tokens
@@ -171,12 +177,14 @@ def paged_cached_attention(q, k, v, cos, sin, k_pages, v_pages, page_indices,
     (page_indices[b, lengths[b]//ps], lengths[b]%ps) — the
     block_multi_head_attention write pattern, which is what lets a
     continuous-batching server mix requests of different lengths in one
-    step.
+    step. ``rope_applied``: q/k arrive already rotated (fused decode
+    tail) — skip the per-row rope, keep the write + attention.
     """
     B = q.shape[0]
     lengths = jnp.asarray(lengths, jnp.int32)
-    q = _rope_rows(q, cos, sin, lengths)
-    k = _rope_rows(k, cos, sin, lengths)
+    if not rope_applied:
+        q = _rope_rows(q, cos, sin, lengths)
+        k = _rope_rows(k, cos, sin, lengths)
     page = lengths // page_size                     # [B]
     slot = lengths % page_size                      # [B]
     rows = page_indices[jnp.arange(B), page]        # [B]

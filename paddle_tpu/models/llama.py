@@ -544,6 +544,76 @@ class LlamaAttention(Layer):
         self.o_proj = _make_linear(self.num_heads * self.head_dim, self.hidden_size,
                                    column=False, config=config)
 
+    def cached_attn_core(self, q, k, v, cos, sin, kv_cache,
+                         rope_applied=False):
+        """Attention against the static-shape decode cache (serving
+        path): jit-stable shapes at every step. Two layouts, both with
+        in-place buffer updates: dense [B,Smax,hk,d], or paged (block
+        tables) matching block_multi_head_attention_kernel.cu.
+        ``allowed`` is an optional [B,T] column-validity mask (padded
+        prompts). ``rope_applied``: q/k arrive pre-rotated (the fused
+        decode-tail kernel). Returns (out [b, s, H*D] BEFORE o_proj,
+        new_cache) — split from o_proj so the fused epilogue can take
+        the projection into its own kernel."""
+        from ..generation import cached_attention, paged_cached_attention
+
+        b, s = q.shape[0], q.shape[1]
+        h, d = self.num_heads, self.head_dim
+        cfg = self.config
+        softcap = getattr(cfg, "attn_logit_softcapping", None)
+        if "k_pages" in kv_cache:
+            out, kp, vp = apply(
+                "llama_attention_paged", paged_cached_attention,
+                q, k, v, cos, sin, kv_cache["k_pages"],
+                kv_cache["v_pages"], kv_cache["page_indices"],
+                kv_cache["lengths"], kv_cache.get("page_size"),
+                window=self.window, softcap=softcap,
+                rope_applied=rope_applied)
+            new = dict(kv_cache)
+            new.update(k_pages=kp, v_pages=vp,
+                       lengths=kv_cache["lengths"] + s)
+            return out.reshape([b, s, h * d]), new
+        out, k_buf, v_buf = apply(
+            "llama_attention_cached", cached_attention, q, k, v, cos, sin,
+            kv_cache["k"], kv_cache["v"], kv_cache["pos"],
+            kv_cache.get("allowed"), kv_cache.get("row_pos"),
+            use_flash=(cfg.use_flash_attention and softcap is None),
+            prefill=bool(kv_cache.get("prefill", False)),
+            window=self.window, softcap=softcap,
+            rope_applied=rope_applied)
+        new = {"k": k_buf, "v": v_buf, "pos": kv_cache["pos"] + s}
+        if "allowed" in kv_cache:
+            new["allowed"] = kv_cache["allowed"]
+        if "row_pos" in kv_cache:
+            # per-row RoPE positions ADVANCE with each decoded token —
+            # frozen positions would rotate every generated token of a
+            # padded row at the same angle (review r4: ragged decode
+            # diverged from the solo run from the 5th token on)
+            new["row_pos"] = kv_cache["row_pos"] + s
+        return out.reshape([b, s, h * d]), new
+
+    def decode_fused_qkv(self, hidden_states, norm_weight, eps, cos, sin,
+                         kv_cache):
+        """S=1 fused ``rms_norm → q/k/v → rope`` through the decode-tail
+        megakernel (ops/pallas/decode_tail) — the caller has verified
+        the gate (fused_decode_supported). Returns (q, k, v) shaped like
+        the discrete projections, q/k already rotated at each row's
+        cache position."""
+        from ..ops.pallas import decode_tail
+
+        b = hidden_states.shape[0]
+        h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
+        cos_r, sin_r = _rope_rows_for_cache(cos, sin, kv_cache, b)
+        q2, k2, v2 = apply(
+            "fused_decode_qkv",
+            lambda x2, wn, wq, wk, wv, c, s_: decode_tail.fused_qkv_rope(
+                x2, wn, wq, wk, wv, c, s_, eps, h, hk, d),
+            hidden_states.reshape([b, self.hidden_size]), norm_weight,
+            self.q_proj.weight, self.k_proj.weight, self.v_proj.weight,
+            cos_r, sin_r)
+        return (q2.reshape([b, 1, h, d]), k2.reshape([b, 1, hk, d]),
+                v2.reshape([b, 1, hk, d]))
+
     def forward(self, hidden_states, cos, sin, attention_mask=None, kv_cache=None, position_offset=0):
         b, s = hidden_states.shape[0], hidden_states.shape[1]
         h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
@@ -565,43 +635,9 @@ class LlamaAttention(Layer):
         softcap = getattr(cfg, "attn_logit_softcapping", None)
 
         if isinstance(kv_cache, dict):
-            # static-shape decode cache (serving path): jit-stable shapes at
-            # every step. Two layouts, both with in-place buffer updates:
-            # dense [B,Smax,hk,d], or paged (block tables) matching
-            # block_multi_head_attention_kernel.cu. `allowed` is an optional
-            # [B,T] column-validity mask (padded prompts).
-            from ..generation import cached_attention, paged_cached_attention
-
-            if "k_pages" in kv_cache:
-                out, kp, vp = apply(
-                    "llama_attention_paged", paged_cached_attention,
-                    q, k, v, cos, sin, kv_cache["k_pages"],
-                    kv_cache["v_pages"], kv_cache["page_indices"],
-                    kv_cache["lengths"], kv_cache.get("page_size"),
-                    window=self.window, softcap=softcap)
-                result = self.o_proj(out.reshape([b, s, h * d]))
-                new = dict(kv_cache)
-                new.update(k_pages=kp, v_pages=vp,
-                           lengths=kv_cache["lengths"] + s)
-                return result, new
-            out, k_buf, v_buf = apply(
-                "llama_attention_cached", cached_attention, q, k, v, cos, sin,
-                kv_cache["k"], kv_cache["v"], kv_cache["pos"],
-                kv_cache.get("allowed"), kv_cache.get("row_pos"),
-                use_flash=(cfg.use_flash_attention and softcap is None),
-                prefill=bool(kv_cache.get("prefill", False)),
-                window=self.window, softcap=softcap)
-            result = self.o_proj(out.reshape([b, s, h * d]))
-            new = {"k": k_buf, "v": v_buf, "pos": kv_cache["pos"] + s}
-            if "allowed" in kv_cache:
-                new["allowed"] = kv_cache["allowed"]
-            if "row_pos" in kv_cache:
-                # per-row RoPE positions ADVANCE with each decoded token —
-                # frozen positions would rotate every generated token of a
-                # padded row at the same angle (review r4: ragged decode
-                # diverged from the solo run from the 5th token on)
-                new["row_pos"] = kv_cache["row_pos"] + s
-            return result, new
+            out_flat, new = self.cached_attn_core(q, k, v, cos, sin,
+                                                  kv_cache)
+            return self.o_proj(out_flat), new
 
         def attn_fn(q, k, v, cos, sin, *cache):
             from ..ops.pallas import fused_norm, flash_attention as pf
@@ -705,6 +741,61 @@ class LlamaMLP(Layer):
         return self.down_proj(act)
 
 
+def _rope_rows_for_cache(cos, sin, kv_cache, b):
+    """cos/sin rows at each row's CURRENT decode position, [B, D] f32 —
+    the fused decode-tail kernel ropes in-register, so the (tiny) table
+    gather happens here: paged caches decode at per-row ``lengths``,
+    ragged dense at ``row_pos``, plain dense batches share the scalar
+    ``pos``."""
+    cos_a, sin_a = unwrap(cos), unwrap(sin)
+    if "k_pages" in kv_cache:
+        idx = jnp.asarray(unwrap(kv_cache["lengths"]), jnp.int32)
+    elif "row_pos" in kv_cache:
+        idx = jnp.asarray(unwrap(kv_cache["row_pos"]), jnp.int32)
+    else:
+        pos = jnp.asarray(unwrap(kv_cache["pos"]), jnp.int32)
+        c = jax.lax.dynamic_slice_in_dim(cos_a, pos, 1, 0)
+        s = jax.lax.dynamic_slice_in_dim(sin_a, pos, 1, 0)
+        return (jnp.broadcast_to(c, (b, c.shape[-1])),
+                jnp.broadcast_to(s, (b, s.shape[-1])))
+    return cos_a[idx], sin_a[idx]
+
+
+def fused_decode_supported(layer, hidden_states, kv_cache, cos) -> bool:
+    """Trace-time gate for the fused S=1 decode tail
+    (FLAGS_use_fused_decode_tail): a dict decode cache at S=1 with the
+    plain attention structure the megakernels assume — no qk-norm, no q
+    pre-multiplier, no projection bias, no tensor parallelism,
+    dtype-uniform weights, full-width rotary — plus decode_tail's own
+    VMEM-feasibility gate. Anything else keeps the discrete reference
+    kernels (exact parity by construction)."""
+    from ..ops.pallas import decode_tail
+
+    if not decode_tail.enabled() or not isinstance(kv_cache, dict):
+        return False
+    if hidden_states.shape[1] != 1:
+        return False
+    attn = layer.self_attn
+    if not isinstance(attn, LlamaAttention):
+        return False
+    if attn.qk_norm_mode is not None or attn.q_premul is not None:
+        return False
+    lins = (attn.q_proj, attn.k_proj, attn.v_proj, attn.o_proj)
+    if any(type(l) is not nn.Linear or l.bias is not None for l in lins):
+        return False
+    x = unwrap(hidden_states)
+    if any(unwrap(l.weight).dtype != x.dtype for l in lins):
+        return False
+    norms = (layer.input_layernorm, layer.post_attention_layernorm)
+    if any(not isinstance(n, LlamaRMSNorm)
+           or unwrap(n.weight).dtype != x.dtype for n in norms):
+        return False
+    return decode_tail.supported(
+        x.shape[0], attn.hidden_size, attn.num_heads, attn.num_kv_heads,
+        attn.head_dim, unwrap(cos).shape[-1],
+        jnp.dtype(x.dtype).itemsize)
+
+
 class LlamaDecoderLayer(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
@@ -713,9 +804,45 @@ class LlamaDecoderLayer(Layer):
         self.input_layernorm = LlamaRMSNorm(config)
         self.post_attention_layernorm = LlamaRMSNorm(config)
 
+    def _forward_fused_decode(self, hidden_states, cos, sin, kv_cache):
+        """The S=1 serving tail as two megakernel dispatches around the
+        attention kernel (ops/pallas/decode_tail): norm→qkv→rope fused,
+        then o_proj→residual-add→norm fused — per-token activations stay
+        in VMEM instead of 4-6 HBM round trips per layer. Token-identical
+        to the discrete path (tier-1 parity test)."""
+        from ..ops.pallas import decode_tail
+
+        attn = self.self_attn
+        b = hidden_states.shape[0]
+        decode_tail.announce(
+            "paged" if "k_pages" in kv_cache else "dense", b,
+            attn.hidden_size, attn.num_heads, attn.num_kv_heads,
+            attn.head_dim)
+        q, k, v = attn.decode_fused_qkv(
+            hidden_states, self.input_layernorm.effective_weight(),
+            self.input_layernorm.variance_epsilon, cos, sin, kv_cache)
+        out_flat, new_cache = attn.cached_attn_core(
+            q, k, v, cos, sin, kv_cache, rope_applied=True)
+        eps = self.post_attention_layernorm.variance_epsilon
+        normed, residual = apply(
+            "fused_decode_epilogue",
+            lambda a, wo, r, w: decode_tail.fused_epilogue(a, wo, r, w,
+                                                           eps),
+            out_flat.reshape([b, attn.num_heads * attn.head_dim]),
+            attn.o_proj.weight,
+            hidden_states.reshape([b, attn.hidden_size]),
+            self.post_attention_layernorm.effective_weight())
+        hidden_states = residual.reshape([b, 1, attn.hidden_size]) + \
+            self.mlp(normed.reshape([b, 1, attn.hidden_size]))
+        return hidden_states, new_cache
+
     def forward(self, hidden_states, cos, sin, attention_mask=None, kv_cache=None):
         from ..ops.pallas import fused_norm
 
+        if kv_cache is not None and fused_decode_supported(
+                self, hidden_states, kv_cache, cos):
+            return self._forward_fused_decode(hidden_states, cos, sin,
+                                              kv_cache)
         residual = hidden_states
         hidden_states = self.input_layernorm(hidden_states)
         if kv_cache is not None:

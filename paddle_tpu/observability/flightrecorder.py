@@ -140,6 +140,16 @@ EV_KV_HANDOFF_RECV = _register(
     "kv.handoff_recv",
     "a decode worker received a prefilled-KV bundle off its handoff "
     "channel (handoff_id, channel, prompt_tokens, bytes)")
+EV_AUTOTUNE_SWEEP = _register(
+    "autotune.sweep",
+    "one autotune geometry sweep completed (kernel, key, choice, ms, "
+    "measured, failed, pruned) — the winner now persisted in the cost "
+    "table")
+EV_FUSED_STEP = _register(
+    "kernel.fused_step",
+    "the fused decode-tail Pallas path activated for a layer shape "
+    "(kernel, batch, hidden, heads, kv_heads, head_dim, layout) — once "
+    "per shape, not per step")
 
 
 # ---- the ring ---------------------------------------------------------------

@@ -25,7 +25,7 @@ from typing import Optional
 
 from .metrics import MetricsRegistry, get_registry
 
-__all__ = ["SnapshotWriter", "flush_all_writers"]
+__all__ = ["SnapshotWriter", "flush_all_writers", "track_flushable"]
 
 
 def _rank() -> Optional[int]:
@@ -37,6 +37,14 @@ def _rank() -> Optional[int]:
 # buffered tails; weak refs so tracking never pins a writer alive
 _LIVE_WRITERS: "weakref.WeakSet" = weakref.WeakSet()
 _ATEXIT_REGISTERED = False
+
+
+def track_flushable(obj) -> None:
+    """Enroll any object with a ``flush()`` method (and a ``path``
+    attribute for error lines) into the atexit/incident flush set — the
+    autotune cost table rides the same buffered-tail lifecycle as the
+    snapshot writers."""
+    _LIVE_WRITERS.add(obj)
 
 
 def flush_all_writers() -> None:

@@ -5,5 +5,6 @@ Role parity with the reference's fused CUDA kernels
 flash attention, fused RMSNorm/residual, fused RoPE, plus wrappers over JAX's
 bundled Pallas ops (splash attention, megablox grouped matmul for MoE).
 """
-from . import flash_attention, fused_norm
+from . import decode_tail, flash_attention, fused_norm
 from .fused_norm import rms_norm, add_rms_norm, fused_rope, rope_ref
+from .decode_tail import fused_qkv_rope, fused_epilogue

@@ -18,7 +18,7 @@ try:  # TPU-only import guard: keeps CPU test env importable
     from jax.experimental.pallas import tpu as pltpu
 
     _HAS_PLTPU = True
-except Exception:  # pragma: no cover
+except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
 
@@ -40,24 +40,48 @@ def _pick_block_rows(rows: int, d: int) -> int:
 
 
 def _block_candidates(rows: int, d: int):
-    """Row blocks the VMEM bound admits, for the autotune sweep."""
-    return [(b,) for b in (512, 256, 128, 64, 32, 16, 8)
-            if rows % b == 0 and b * d <= 512 * 1024]
+    """Row blocks that divide the grid, for the autotune search space —
+    wider than the VMEM bound alone admits: the roofline cost model
+    prunes infeasible geometries before they are ever launched."""
+    return [(b,) for b in (1024, 512, 256, 128, 64, 32, 16, 8)
+            if rows % b == 0]
+
+
+def _norm_cost(params: dict, choice: tuple, n_io: int = 2) -> dict:
+    """Analytical cost of a row-blocked norm kernel: ``n_io`` dtype-wide
+    HBM streams of [rows, d] (x+out for rms_norm; x+residual+out+sum for
+    add_rms_norm) plus the weight row; VPU flops ~ a few per element.
+    Registered with autotune so the graph-cost-table lint can replay it
+    against persisted entries."""
+    rows, d = int(params["rows"]), int(params["d"])
+    it = jnp.dtype(params["dtype"]).itemsize
+    (block,) = choice
+    return {
+        "bytes": n_io * rows * d * it + d * it,
+        "flops": (n_io + 2) * rows * d,
+        # per-cell working set: n_io dtype blocks + one f32 intermediate
+        "vmem_bytes": block * d * (n_io * it + 4),
+        "grid": rows // max(block, 1),
+    }
 
 
 def _tuned_block_rows(kernel: str, rows: int, d: int, dtype, runner,
                       *arrays) -> int:
-    """Heuristic block unless the autotune cache (ops/pallas/autotune.py,
-    the phi/kernels/autotune analog) knows — or can measure — better.
-    ``arrays`` are the kernel operands: a timed sweep is only legal when
-    they are concrete (not tracers) on a real TPU."""
+    """Heuristic block unless the autotune cost table
+    (ops/pallas/autotune.py, the phi/kernels/autotune analog) knows — or
+    can search out — better. ``arrays`` are the kernel operands: a timed
+    sweep is only legal when they are concrete (not tracers) on a real
+    TPU."""
     from . import autotune
 
     default = _pick_block_rows(rows, d)
     can_measure = _on_tpu() and autotune.is_concrete(*arrays)
-    (block,) = autotune.pick(kernel, f"rows{rows} d{d} {jnp.dtype(dtype)}",
-                             (default,), _block_candidates(rows, d),
-                             runner, can_measure)
+    params = {"rows": rows, "d": d, "dtype": str(jnp.dtype(dtype))}
+    (block,) = autotune.search(
+        kernel, f"rows{rows} d{d} {jnp.dtype(dtype)}", (default,),
+        _block_candidates(rows, d), runner, can_measure, params=params,
+        cost_model=lambda cfg: autotune.analytical_cost(kernel, params,
+                                                        cfg))
     return block
 
 
@@ -289,3 +313,17 @@ def _rope_bwd(res, g):
 
 
 fused_rope.defvjp(_rope_fwd, _rope_bwd)
+
+
+# cost models registered for the autotune search's roofline pruning and
+# the graph-cost-table lint's replay (see ops/pallas/autotune.py)
+def _register_cost_models():
+    from . import autotune
+
+    autotune.register_cost_model(
+        "rms_norm", functools.partial(_norm_cost, n_io=2))
+    autotune.register_cost_model(
+        "add_rms_norm", functools.partial(_norm_cost, n_io=4))
+
+
+_register_cost_models()

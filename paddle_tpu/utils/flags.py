@@ -78,6 +78,11 @@ define_flag("benchmark", False,
             "sync after every op for timing (paddle/phi/core/flags.cc benchmark)")
 define_flag("use_autotune", True,
             "enable kernel autotune cache (paddle/phi/kernels/autotune/)")
+define_flag("use_fused_decode_tail", False,
+            "fuse the S=1 decode tail (norm->qkv->rope and "
+            "o_proj->residual->norm) into the ops/pallas/decode_tail "
+            "megakernels; off = the discrete reference kernels (exact "
+            "parity, read at trace time like every flag)")
 define_flag("allocator_strategy", "auto_growth",
             "allocator strategy name; informational on TPU (XLA owns HBM)")
 define_flag("embedding_deterministic", False,

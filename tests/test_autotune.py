@@ -93,6 +93,178 @@ class TestPick:
         assert got == (64,)
 
 
+class TestSearch:
+    """The staged search: cost-table recording, failure pruning,
+    roofline ranking, deferred flush (PR 7)."""
+
+    def test_cost_table_records_all_candidates_and_roundtrips(
+            self, tuned_cache):
+        set_flags({"FLAGS_use_autotune": True})
+        calls = []
+        timings = {(128,): 0.02, (64,): 0.001, (32,): 0.01}
+        params = {"rows": 128, "d": 64, "dtype": "float32"}
+
+        def cost_model(cfg):
+            return {"bytes": 1000, "flops": 2000, "vmem_bytes": 10,
+                    "grid": 128 // cfg[0]}
+
+        got = autotune.search("k", "sig", (128,), list(timings),
+                              _runner_factory(timings, calls),
+                              can_measure=True, params=params,
+                              cost_model=cost_model, log=False)
+        assert got == (64,)
+        assert set(calls) == set(timings)
+        # a FRESH cache object (new-process analog) reads the full table
+        fresh = autotune.AutotuneCache(tuned_cache)
+        ent = fresh.entry("k", autotune.full_key("sig"))
+        assert ent["choice"] == [64]
+        assert ent["params"] == params
+        assert ent["est"]["bytes"] == 1000 and ent["est"]["flops"] == 2000
+        table = ent["table"]
+        assert set(table) == {"128", "64", "32"}
+        assert all(r["status"] == "ok" and r["ms"] >= 0
+                   for r in table.values())
+
+    def test_failures_recorded_and_never_retried(self, tuned_cache):
+        """An OOM-ing geometry is measured at most ONCE per device: the
+        failure lands in the cost table (kind + message) and later
+        searches prune it instead of launching it again."""
+        set_flags({"FLAGS_use_autotune": True})
+        calls = []
+
+        def runner(cfg):
+            def run():
+                calls.append(cfg)
+                raise RuntimeError("VMEM OOM")
+            return run
+
+        got = autotune.search("k", "oom", (128,), [(512,), (256,)],
+                              runner, can_measure=True, log=False)
+        assert got == (128,)          # no winner: heuristic default
+        assert len(calls) == 2
+        fresh = autotune.AutotuneCache(tuned_cache)
+        ent = fresh.entry("k", autotune.full_key("oom"))
+        assert ent["table"]["512"]["status"] == "fail"
+        assert "VMEM OOM" in ent["table"]["512"]["error"]
+        assert fresh.failures("k", autotune.full_key("oom")) == {
+            (512,), (256,)}
+        # second search: both candidates pruned, nothing launched
+        calls.clear()
+        got = autotune.search("k", "oom", (128,), [(512,), (256,)],
+                              runner, can_measure=True, log=False)
+        assert got == (128,) and calls == []
+
+    def test_roofline_pruning_drops_infeasible_and_ranks(
+            self, tuned_cache):
+        """A VMEM-infeasible candidate is recorded without launching;
+        max_measure keeps only the best-ranked survivors."""
+        set_flags({"FLAGS_use_autotune": True})
+        calls = []
+        timings = {(64,): 0.002, (32,): 0.002, (16,): 0.002}
+
+        def cost_model(cfg):
+            (b,) = cfg
+            return {"bytes": 1000, "flops": 1000,
+                    "vmem_bytes": 10 ** 9 if b == 16 else 10,
+                    "grid": 128 // b}  # fewer grid steps rank better
+
+        got = autotune.search("k", "pruned", (128,),
+                              [(64,), (32,), (16,)],
+                              _runner_factory(timings, calls),
+                              can_measure=True, cost_model=cost_model,
+                              max_measure=1, log=False)
+        assert set(calls) == {(64,)}  # only the best-ranked survivor
+        assert got == (64,)
+        fresh = autotune.AutotuneCache(tuned_cache)
+        tab = fresh.entry("k", autotune.full_key("pruned"))["table"]
+        assert tab["16"]["status"] == "infeasible"
+        assert "vmem" in tab["16"]["reason"]
+
+    def test_sweep_records_flightrecorder_event(self, tuned_cache):
+        from paddle_tpu.observability import flightrecorder as frec
+
+        set_flags({"FLAGS_use_autotune": True})
+        rec = frec.get_recorder()
+        rec.clear()
+        rec.enabled = True  # not enable(): skip the compile-events hook
+        try:
+            autotune.pick("k", "audited", (64,), [(64,), (32,)],
+                          _runner_factory({(64,): 0.001, (32,): 0.002},
+                                          []),
+                          can_measure=True, log=False)
+            evs = rec.events(kind="autotune.sweep")
+            assert evs and evs[0]["kernel"] == "k"
+            assert evs[0]["choice"] == [64]
+            assert evs[0]["measured"] == 2
+        finally:
+            rec.enabled = False
+            rec.clear()
+
+    def test_deferred_flush(self, tuned_cache):
+        """put() batches in memory; the file appears on flush (sweep
+        end / atexit / incident), not per entry."""
+        set_flags({"FLAGS_use_autotune": True})
+        cache = autotune.get_cache()
+        cache.put("k", "sig", (8,), 1.0)
+        assert not os.path.exists(tuned_cache)
+        cache.flush()
+        assert json.load(open(tuned_cache))["k"]["sig"]["choice"] == [8]
+        assert autotune._ATEXIT_REGISTERED  # atexit flush armed
+
+    def test_incident_flush_path(self, tuned_cache):
+        """The cache is tracked by the observability flush set: the
+        incident reporter's flush_all_writers persists a mid-search
+        table."""
+        from paddle_tpu.observability.snapshot import flush_all_writers
+
+        set_flags({"FLAGS_use_autotune": True})
+        autotune.get_cache().put("k", "mid-search", (4,), 2.0)
+        assert not os.path.exists(tuned_cache)
+        flush_all_writers()
+        assert json.load(open(tuned_cache))["k"]["mid-search"][
+            "choice"] == [4]
+
+    def test_corrupt_cache_file_starts_empty(self, tuned_cache):
+        with open(tuned_cache, "w") as f:
+            f.write("{ not json")
+        fresh = autotune.AutotuneCache(tuned_cache)
+        assert fresh.get("k", "sig") is None  # logged, not raised
+
+
+class TestStaleness:
+    """The guard at the cache-hit stage: a persisted winner whose
+    geometry no longer fits the current candidate space must fall back
+    (satellite: it had no test)."""
+
+    def test_stale_winner_falls_back_to_default(self, tuned_cache):
+        set_flags({"FLAGS_use_autotune": True})
+        autotune.get_cache().put("k", autotune.full_key("shape"),
+                                 (48,), 1.0)
+        got = autotune.pick("k", "shape", (128,), [(64,), (32,)],
+                            _runner_factory({}, []), can_measure=False)
+        assert got == (128,)  # (48,) not in the space: heuristic wins
+
+    def test_stale_winner_kernel_integration(self, tuned_cache):
+        """A persisted rms_norm block that no longer divides the row
+        count is ignored by the kernel wrapper — numerics unchanged."""
+        from paddle_tpu.ops.pallas import fused_norm as fn
+
+        set_flags({"FLAGS_use_autotune": True})
+        rows, d = 64, 256
+        autotune.get_cache().put(
+            "rms_norm", autotune.full_key(f"rows{rows} d{d} float32"),
+            (48,), 1.0)  # 64 % 48 != 0: not in the candidate space
+        block = fn._tuned_block_rows("rms_norm", rows, d, jnp.float32,
+                                     None)
+        assert block == fn._pick_block_rows(rows, d)
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 16, d),
+                        jnp.float32)
+        w = jnp.asarray(np.random.RandomState(1).randn(d), jnp.float32)
+        np.testing.assert_allclose(np.asarray(fn.rms_norm(x, w)),
+                                   np.asarray(fn._rmsnorm_ref(x, w, 1e-6)),
+                                   rtol=1e-5, atol=1e-5)
+
+
 class TestKernelIntegration:
     def test_rms_norm_uses_cached_block_and_stays_correct(self, tuned_cache):
         """A cached (non-default) geometry is honored by the kernel wrapper

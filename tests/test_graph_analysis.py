@@ -605,6 +605,60 @@ def test_preflight_untraceable_model_reports_retrace_hazard():
 # registry + CLI integration
 # ---------------------------------------------------------------------------
 
+def test_cost_table_rule_flags_drifted_entry(tmp_path, monkeypatch):
+    """graph-cost-table: a persisted entry whose recorded bytes/FLOPs
+    disagree with the live analytical model is flagged; agreeing and
+    pre-search-era (no-est) entries pass."""
+    import json as _json
+
+    from paddle_tpu.analysis.graph.rules import AutotuneCostTableRule
+    from paddle_tpu.ops.pallas import autotune
+
+    params = {"rows": 128, "d": 256, "dtype": "float32"}
+    good = autotune.analytical_cost("rms_norm", params, (8,))
+    assert good is not None  # fused_norm registers its model at import
+    data = {"rms_norm": {
+        "good @dev": {"choice": [8], "ms": 1.0, "params": params,
+                      "est": {"bytes": good["bytes"],
+                              "flops": good["flops"]}},
+        "drifted @dev": {"choice": [8], "ms": 1.0, "params": params,
+                         "est": {"bytes": good["bytes"] * 7,
+                                 "flops": good["flops"]}},
+        "legacy @dev": {"choice": [8], "ms": 1.0},
+    }}
+    path = tmp_path / "cache.json"
+    path.write_text(_json.dumps(data))
+    monkeypatch.setenv("PD_AUTOTUNE_CACHE", str(path))
+    findings = list(AutotuneCostTableRule().check_project(_REPO))
+    assert [f.symbol for f in findings] == ["rms_norm:drifted @dev"]
+    assert "bytes" in findings[0].message
+
+
+def test_cost_table_rule_absent_cache_is_silent(tmp_path, monkeypatch):
+    from paddle_tpu.analysis.graph.rules import AutotuneCostTableRule
+
+    monkeypatch.setenv("PD_AUTOTUNE_CACHE", str(tmp_path / "none.json"))
+    assert list(AutotuneCostTableRule().check_project(_REPO)) == []
+
+
+def test_cost_table_rule_orphaned_model_flagged(tmp_path, monkeypatch):
+    """Estimates recorded for a kernel whose cost model is gone = stale
+    evidence, flagged rather than skipped."""
+    import json as _json
+
+    from paddle_tpu.analysis.graph.rules import AutotuneCostTableRule
+
+    data = {"gone_kernel": {"sig @dev": {
+        "choice": [8], "ms": 1.0, "params": {"rows": 1},
+        "est": {"bytes": 10, "flops": 10}}}}
+    path = tmp_path / "cache.json"
+    path.write_text(_json.dumps(data))
+    monkeypatch.setenv("PD_AUTOTUNE_CACHE", str(path))
+    findings = list(AutotuneCostTableRule().check_project(_REPO))
+    assert len(findings) == 1
+    assert "no cost model" in findings[0].message
+
+
 def test_graph_rules_registered_but_excluded_by_default():
     analysis.ast_rules()  # force registration
     graph_ids = {"graph-shard-spec", "graph-dtype-promotion",
