@@ -48,7 +48,10 @@ class Finding:
     """One diagnostic: ``file:line rule-id message``.
 
     ``symbol`` is the innermost enclosing ``Class.method`` qualname — the
-    line-number-free identity baselines key on.
+    line-number-free identity baselines key on. ``data`` is an optional
+    JSON-able payload rules may attach (the shard-solver's rejected-plan
+    ledger); it rides the ``--json`` report but never the key or the
+    baseline.
     """
 
     file: str
@@ -56,6 +59,7 @@ class Finding:
     rule: str
     message: str
     symbol: str = ""
+    data: Optional[Dict] = None
 
     def key(self):
         return (self.file, self.rule, self.symbol, self.message)

@@ -6,15 +6,21 @@ the model zoo's build functions) — sharding validity and propagation
 (graph-shard-spec), bf16→f32 upcasts (graph-dtype-promotion), jit-cache
 hazards (graph-retrace-hazard), byte/FLOP admission estimates
 (graph-preflight-cost), and OpDecl dtype honesty (graph-op-dtypes).
+:mod:`.solver` inverts the shard-spec pass into a planner — the
+auto-sharding search behind ``param_specs="auto"``, the
+``graph-shard-solver`` audit rule, and ``scripts/pdlint.py --solve``.
 
 Three surfaces: ``scripts/pdlint.py --graph``, ``Engine.preflight()``
 (serving.py, via :mod:`.preflight`), and the tier-1 zoo sweep
 (tests/test_graph_analysis.py). See docs/ANALYSIS.md "Graph rules".
 """
-from . import cost, dtype_flow, op_dtypes, retrace, shard_spec, zoo  # noqa: F401
+from . import (  # noqa: F401
+    cost, dtype_flow, op_dtypes, retrace, shard_spec, solver, zoo,
+)
 from .preflight import (  # noqa: F401
     PreflightError, PreflightReport, preflight_model,
 )
+from .solver import ShardingPlan  # noqa: F401
 from .trace import (  # noqa: F401
     TracedGraph, iter_eqns, spec, trace_fn, trace_layer,
 )
@@ -22,5 +28,7 @@ from .trace import (  # noqa: F401
 __all__ = [
     "TracedGraph", "trace_fn", "trace_layer", "iter_eqns", "spec",
     "PreflightError", "PreflightReport", "preflight_model",
-    "cost", "dtype_flow", "op_dtypes", "retrace", "shard_spec", "zoo",
+    "ShardingPlan",
+    "cost", "dtype_flow", "op_dtypes", "retrace", "shard_spec",
+    "solver", "zoo",
 ]

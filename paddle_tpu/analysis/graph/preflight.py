@@ -31,6 +31,9 @@ class PreflightReport:
     model: str
     findings: List[Finding] = dataclasses.field(default_factory=list)
     cost: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: the auto-sharding solver's ShardingPlan.as_dict() when
+    #: param_specs="auto" ran (specs, byte/reshard accounting, ledger)
+    plan: Optional[Dict[str, Any]] = None
 
     @property
     def fatal(self) -> List[Finding]:
@@ -53,6 +56,7 @@ class PreflightReport:
             "model": self.model,
             "ok": self.ok,
             "cost": dict(self.cost),
+            "plan": self.plan,
             "findings": [
                 {"rule": f.rule, "symbol": f.symbol, "message": f.message,
                  "fatal": f.rule in FATAL_RULES}
@@ -90,10 +94,13 @@ def preflight_model(model, *, batch: int = 1, seq_len: int = 16,
     """Run the three preflight layers over a live model.
 
     ``mesh`` + ``param_specs`` ({name-substring: PartitionSpec tuple})
-    validate an EXPLICIT layout; independently, placements already
-    attached to parameters (``dist.shard_tensor``) are validated against
-    their own meshes. ``budget_bytes`` (device HBM available to this
-    model) turns the cost estimate into an admission decision;
+    validate an EXPLICIT layout; ``param_specs="auto"`` instead runs the
+    auto-sharding solver over the trace and adopts the cheapest feasible
+    plan (attached as ``report.plan``), so an arbitrary checkpoint +
+    mesh serves with a machine-chosen layout. Independently, placements
+    already attached to parameters (``dist.shard_tensor``) are validated
+    against their own meshes. ``budget_bytes`` (device HBM available to
+    this model) turns the cost estimate into an admission decision;
     ``kv_cache_bytes`` is added by the serving engine for its pool.
     """
     name = type(model).__name__
@@ -110,6 +117,44 @@ def preflight_model(model, *, batch: int = 1, seq_len: int = 16,
                 file=file, line=1, rule="graph-retrace-hazard",
                 message=msg, symbol=key))
         return report
+
+    # ---- auto-sharding solver -----------------------------------------------
+    plan = None
+    if isinstance(param_specs, str):
+        if param_specs != "auto":
+            report.findings.append(Finding(
+                file=file, line=1, rule="graph-shard-spec",
+                message=f"param_specs={param_specs!r} is not a layout — "
+                        "pass a spec mapping or 'auto'", symbol="auto"))
+            param_specs = None
+        elif mesh is None:
+            report.findings.append(Finding(
+                file=file, line=1, rule="graph-shard-spec",
+                message="param_specs='auto' needs a mesh to plan over",
+                symbol="auto"))
+            param_specs = None
+        else:
+            from . import solver as _solver
+
+            axis_sizes = dict(zip(mesh.dim_names, mesh.shape))
+            plan = _solver.solve(traced, axis_sizes,
+                                 budget_bytes=budget_bytes,
+                                 extra_bytes=int(kv_cache_bytes))
+            report.plan = plan.as_dict()
+            param_specs = dict(plan.specs)
+            if not plan.feasible:
+                report.findings.append(Finding(
+                    file=file, line=1, rule="graph-preflight-cost",
+                    message=(f"no sharding plan fits: the cheapest "
+                             f"({plan.assignment}) still needs "
+                             f"~{plan.resident_bytes()} resident bytes "
+                             f"per device (params "
+                             f"{plan.per_device_param_bytes} + peak "
+                             f"activations {plan.activation_bytes} + kv "
+                             f"cache {int(kv_cache_bytes)}) against a "
+                             f"budget of {int(budget_bytes)} — refuse "
+                             "before compile"),
+                    symbol="resident-bytes"))
 
     # ---- shard-spec ---------------------------------------------------------
     if mesh is not None and param_specs:
@@ -153,8 +198,14 @@ def preflight_model(model, *, batch: int = 1, seq_len: int = 16,
     report.cost = rep.as_dict()
     report.cost["kv_cache_bytes"] = int(kv_cache_bytes)
     resident = rep.total_resident_bytes() + int(kv_cache_bytes)
+    if plan is not None:
+        # under the solver's plan, params are sharded: the admission
+        # number is the per-device resident (kv already in extra_bytes),
+        # and the feasibility finding above owns the budget decision
+        resident = plan.resident_bytes()
     report.cost["resident_bytes"] = resident
-    if budget_bytes is not None and resident > budget_bytes:
+    if plan is None and budget_bytes is not None and \
+            resident > budget_bytes:
         report.findings.append(Finding(
             file=file, line=1, rule="graph-preflight-cost",
             message=(f"model needs ~{resident} resident bytes "

@@ -20,9 +20,19 @@ time here:
 
 Everything is pure (mesh = axis-name -> size mapping), so rules and
 fixtures run without devices or ``jax.Mesh`` construction.
+
+The walk reports two event classes (``ReshardEvent.expected``):
+*unexpected* implicit reshards (the lint findings ``propagate`` has
+always returned) and *expected* collectives — the planned Megatron
+communication GSPMD inserts by design (matched-contraction all-reduce,
+vocab-parallel embedding gather). Expected events are never findings,
+but they carry byte charges the auto-sharding solver (``solver.py``)
+sums into its cost metric, so a plan that leans on collectives pays for
+them in the search.
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 # a spec here is a tuple, one entry per tensor dim: None | axis-name |
@@ -194,6 +204,43 @@ def _reshape_groups(in_shape, out_shape):
     return groups
 
 
+@dataclasses.dataclass
+class ReshardEvent:
+    """One propagation event: an eqn where sharding forces communication.
+
+    ``expected=False`` — an *implicit* reshard (the lint finding: GSPMD
+    silently re-tiles). ``expected=True`` — a planned collective the
+    layout implies by design (matched-contraction all-reduce,
+    vocab-parallel embedding gather); never a finding, but ``bytes``
+    (the eqn's output bytes, the tensor that moves) feeds the solver's
+    cost metric.
+    """
+
+    path: str
+    primitive: str
+    message: str
+    bytes: int = 0
+    expected: bool = False
+
+    def as_tuple(self) -> Tuple[str, str, str]:
+        return (self.path, self.primitive, self.message)
+
+
+def _out_bytes(eqn) -> int:
+    import jax.numpy as jnp
+
+    total = 0
+    for v in eqn.outvars:
+        aval = getattr(v, "aval", None)
+        if aval is None or not hasattr(aval, "shape"):
+            continue
+        n = int(jnp.dtype(aval.dtype).itemsize)
+        for s in aval.shape:
+            n *= int(s)
+        total += n
+    return total
+
+
 def propagate(traced, in_specs: Dict[int, Spec],
               axis_sizes: Mapping[str, int]) -> List[Tuple[str, str, str]]:
     """Walk the top-level jaxpr propagating shardings forward.
@@ -202,14 +249,26 @@ def propagate(traced, in_specs: Dict[int, Spec],
     ``(eqn_path, primitive, message)`` for eqns that force an implicit
     reshard. Unknown primitives drop the sharding silently (GSPMD knows
     more rules than we model; silence beats noise) — the walk exists to
-    catch the two *decidable* hazards, not to re-implement GSPMD.
+    catch the *decidable* hazards, not to re-implement GSPMD. Expected
+    collectives (see :class:`ReshardEvent`) are not returned here; use
+    ``propagate_events`` for the full event stream the solver scores.
     """
+    return [e.as_tuple() for e in propagate_events(traced, in_specs,
+                                                   axis_sizes)
+            if not e.expected]
+
+
+def propagate_events(traced, in_specs: Dict[int, Spec],
+                     axis_sizes: Mapping[str, int]) -> List[ReshardEvent]:
+    """The event-stream form of :func:`propagate`: every implicit
+    reshard AND every expected collective, each with the byte charge
+    the solver's cost metric sums."""
     jaxpr = traced.closed_jaxpr.jaxpr
     env: Dict[Any, Spec] = {}
     for idx, sp in in_specs.items():
         var = jaxpr.invars[idx]
         env[var] = normalize_spec(sp, len(var.aval.shape))
-    findings: List[Tuple[str, str, str]] = []
+    events: List[ReshardEvent] = []
 
     def lookup(v):
         # Literals (inline constants) are unhashable and never sharded
@@ -222,15 +281,19 @@ def propagate(traced, in_specs: Dict[int, Spec],
         ins = [lookup(v) for v in eqn.invars if hasattr(v, "aval")]
         if not any(sp is not None for sp in ins):
             continue
+
+        def emit(msg, *, expected=False, prim=prim, path=path, eqn=eqn):
+            events.append(ReshardEvent(
+                path=str(path), primitive=prim, message=msg,
+                bytes=_out_bytes(eqn), expected=expected))
+
         out_spec: Optional[Spec] = None
         if prim in _ELEMENTWISE_SAFE and eqn.outvars:
             shape = eqn.outvars[0].aval.shape
             out_spec, conflict = _merge_specs(ins, shape)
             if conflict:
-                findings.append((str(path), prim,
-                                 "operands shard one dim over different "
-                                 "mesh axes — GSPMD inserts a reshard "
-                                 "to reconcile them"))
+                emit("operands shard one dim over different mesh axes — "
+                     "GSPMD inserts a reshard to reconcile them")
         elif prim == "transpose":
             (sp,) = [s for s in ins if s is not None][:1] or [None]
             if sp is not None:
@@ -252,11 +315,19 @@ def propagate(traced, in_specs: Dict[int, Spec],
             out_spec, msg = _propagate_reshape(sp, in_shape, out_shape,
                                                axis_sizes)
             if msg:
-                findings.append((str(path), prim, msg))
+                emit(msg)
         elif prim == "dot_general":
-            out_spec, msg = _propagate_dot(eqn, ins)
-            if msg:
-                findings.append((str(path), prim, msg))
+            out_spec, msgs = _propagate_dot(eqn, ins)
+            for msg, expected in msgs:
+                emit(msg, expected=expected)
+        elif prim == "gather":
+            out_spec, msgs = _propagate_gather(eqn, ins)
+            for msg, expected in msgs:
+                emit(msg, expected=expected)
+        elif prim.startswith("scatter"):
+            out_spec, msgs = _propagate_scatter(eqn, ins)
+            for msg, expected in msgs:
+                emit(msg, expected=expected)
         elif prim in _REDUCERS:
             sp = ins[0]
             if sp is not None:
@@ -269,7 +340,7 @@ def propagate(traced, in_specs: Dict[int, Spec],
                 if hasattr(ov, "aval") and \
                         len(ov.aval.shape) == len(out_spec):
                     env[ov] = out_spec
-    return findings
+    return events
 
 
 def _propagate_reshape(sp, in_shape, out_shape, axis_sizes):
@@ -306,28 +377,141 @@ def _propagate_reshape(sp, in_shape, out_shape, axis_sizes):
 
 
 def _propagate_dot(eqn, ins):
+    """Returns ``(out_spec, [(message, expected), ...])``."""
     ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
     lsp, rsp = (ins + [None, None])[:2]
+    msgs: List[Tuple[str, bool]] = []
     # contracting dims sharded over mismatched axes -> reshard before the
-    # matmul; matched axes -> partial output (GSPMD all-reduces: expected)
-    for i, (ld, rd) in enumerate(zip(lc, rc)):
+    # matmul; matched axes -> partial output (GSPMD all-reduces: the
+    # planned Megatron row-parallel collective — expected, but charged)
+    for ld, rd in zip(lc, rc):
         la = _axes_of(lsp[ld]) if lsp is not None else ()
         ra = _axes_of(rsp[rd]) if rsp is not None else ()
         if la and ra and la != ra:
-            return None, (f"contracting dims sharded over different axes "
-                          f"({la!r} vs {ra!r}) — implicit reshard before "
-                          "the matmul")
+            msgs.append((f"contracting dims sharded over different axes "
+                         f"({la!r} vs {ra!r}) — implicit reshard before "
+                         "the matmul", False))
+            return None, msgs
+        if la or ra:
+            # matched axes, or one side sharded with the other replicated
+            # (GSPMD slices the replicated operand locally — free): both
+            # produce a partial output that must be all-reduced
+            msgs.append((f"contracting dims sharded over "
+                         f"{(la or ra)!r} — partial output, GSPMD "
+                         "all-reduces (planned row-parallel collective)",
+                         True))
+    # batch dims sharded over mismatched axes -> one operand re-tiles
+    # before the batched matmul (the hazard _merge_specs used to miss)
+    batch_out: List = []
+    for ld, rd in zip(lb, rb):
+        la = _axes_of(lsp[ld]) if lsp is not None else ()
+        ra = _axes_of(rsp[rd]) if rsp is not None else ()
+        if la and ra and la != ra:
+            msgs.append((f"batch dims sharded over different axes "
+                         f"({la!r} vs {ra!r}) — implicit reshard before "
+                         "the batched matmul", False))
+            return None, msgs
+        if la:
+            batch_out.append(lsp[ld])
+        elif ra:
+            batch_out.append(rsp[rd])
+        else:
+            batch_out.append(None)
     # output layout: batch dims, then lhs free dims, then rhs free dims
-    out: List = []
-    for ld in lb:
-        out.append(lsp[ld] if lsp is not None else None)
+    out: List = list(batch_out)
     for d in range(len(eqn.invars[0].aval.shape)):
         if d not in lc and d not in lb:
             out.append(lsp[d] if lsp is not None else None)
     for d in range(len(eqn.invars[1].aval.shape)):
         if d not in rc and d not in rb:
             out.append(rsp[d] if rsp is not None else None)
-    return tuple(out), None
+    return tuple(out), msgs
+
+
+def _propagate_gather(eqn, ins):
+    """Gather (embedding lookups, the paged-KV page reads).
+
+    An indexed/collapsed dim that is sharded is the *vocab-parallel*
+    pattern — GSPMD lowers it to a masked local lookup + all-reduce (or
+    an all-gather of the table): planned, so an *expected* event. A
+    window dim whose slice is partial while sharded forces a genuine
+    re-tile (unexpected). Full-slice window dims keep their layout and
+    propagate into the matching output offset dims.
+    """
+    sp = ins[0] if ins else None
+    if sp is None or not any(e is not None for e in sp):
+        return None, []
+    dnums = eqn.params["dimension_numbers"]
+    slice_sizes = eqn.params["slice_sizes"]
+    op_shape = eqn.invars[0].aval.shape
+    out_shape = eqn.outvars[0].aval.shape
+    batching = tuple(getattr(dnums, "operand_batching_dims", ()) or ())
+    collapsed = set(dnums.collapsed_slice_dims) | set(batching)
+    indexed = set(dnums.start_index_map)
+    msgs: List[Tuple[str, bool]] = []
+    out: List = [None] * len(out_shape)
+    window_dims = [d for d in range(len(op_shape)) if d not in collapsed]
+    for out_d, op_d in zip(sorted(dnums.offset_dims), window_dims):
+        entry = sp[op_d]
+        if entry is None:
+            continue
+        if op_d in indexed or int(slice_sizes[op_d]) != int(op_shape[op_d]):
+            msgs.append((f"gather slices through dim {op_d} sharded over "
+                         f"{_axes_of(entry)!r} — implicit reshard to "
+                         "re-tile the window", False))
+        elif 0 <= out_d < len(out_shape):
+            out[out_d] = entry
+    for op_d in sorted(collapsed):
+        entry = sp[op_d]
+        if entry is not None:
+            msgs.append((f"gather indexes dim {op_d} sharded over "
+                         f"{_axes_of(entry)!r} — planned vocab/page-"
+                         "parallel lookup (masked + all-reduce)", True))
+    out_spec = tuple(out)
+    if not any(e is not None for e in out_spec):
+        out_spec = None
+    return out_spec, msgs
+
+
+def _propagate_scatter(eqn, ins):
+    """Scatter (the paged-KV cache write path).
+
+    Scatter preserves the operand's layout, so the output inherits its
+    spec — UNLESS the scattered-into dims are themselves sharded (the
+    updates land on other shards: GSPMD must all-to-all them), or the
+    updates' window dims are sharded differently from the operand's.
+    """
+    osp = ins[0] if ins else None
+    usp = ins[2] if len(ins) > 2 else None
+    if osp is None and usp is None:
+        return None, []
+    dnums = eqn.params["dimension_numbers"]
+    ndim = len(eqn.invars[0].aval.shape)
+    inserted = set(dnums.inserted_window_dims) | \
+        set(getattr(dnums, "operand_batching_dims", ()) or ())
+    scattered = set(dnums.scatter_dims_to_operand_dims) | inserted
+    msgs: List[Tuple[str, bool]] = []
+    if osp is not None:
+        for d in sorted(scattered):
+            if d < len(osp) and osp[d] is not None:
+                msgs.append((f"scatter writes into dim {d} sharded over "
+                             f"{_axes_of(osp[d])!r} — GSPMD must "
+                             "all-to-all the updates across shards",
+                             False))
+    # window dims: operand dims not inserted map onto update_window_dims
+    # in order; a mismatch re-tiles the updates before the write
+    if osp is not None and usp is not None:
+        window = [d for d in range(ndim) if d not in inserted]
+        for upd_d, op_d in zip(sorted(dnums.update_window_dims), window):
+            oe = osp[op_d] if op_d < len(osp) else None
+            ue = usp[upd_d] if upd_d < len(usp) else None
+            if oe is not None and ue is not None and \
+                    _axes_of(oe) != _axes_of(ue):
+                msgs.append((f"scatter updates shard dim {upd_d} over "
+                             f"{_axes_of(ue)!r} but the operand window "
+                             f"dim {op_d} is over {_axes_of(oe)!r} — "
+                             "implicit reshard of the updates", False))
+    return osp, msgs
 
 
 # ---- OpDecl.spmd cross-check ------------------------------------------------

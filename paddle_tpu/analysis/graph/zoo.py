@@ -36,6 +36,20 @@ class ShardLayout:
                 return sp if len(sp) <= ndim else None
         return None
 
+    def specs_for(self, traced) -> Dict[str, Tuple]:
+        """The full {param name: normalized spec} mapping this layout
+        assigns to a traced model — the hand-written plan the solver
+        rule and the quality tests score against."""
+        from . import shard_spec
+
+        out: Dict[str, Tuple] = {}
+        for name in traced.param_names:
+            aval = traced.param_avals[name]
+            sp = self.spec_for(name, len(aval.shape))
+            if sp is not None:
+                out[name] = shard_spec.normalize_spec(sp, len(aval.shape))
+        return out
+
 
 @dataclasses.dataclass
 class ZooEntry:

@@ -37,9 +37,13 @@ def render_json(findings: Iterable[Finding], baselined: int = 0,
     doc = {
         "schema_version": SCHEMA_VERSION,
         "tool": "pdlint",
+        # ``data`` (a rule-attached JSON payload, e.g. the shard-solver
+        # ledger) appears ONLY on findings that carry it — additive, so
+        # the pinned 5-key shape holds for every other finding
         "findings": [
-            {"file": f.file, "line": f.line, "rule": f.rule,
-             "symbol": f.symbol, "message": f.message}
+            dict({"file": f.file, "line": f.line, "rule": f.rule,
+                  "symbol": f.symbol, "message": f.message},
+                 **({"data": f.data} if f.data is not None else {}))
             for f in findings
         ],
         "counts": counts,

@@ -69,7 +69,7 @@ def _handle(conn):
         try:
             out = fn(*args, **(kwargs or {}))
             payload = {"ok": True, "value": out}
-        except Exception as e:  # noqa: BLE001 - forwarded to the caller
+        except Exception as e:  # noqa: BLE001  # pdlint: disable=silent-exception -- not swallowed: the exception object IS the reply payload, re-raised caller-side by rpc_sync
             payload = {"ok": False, "error": e}
         try:
             blob = pickle.dumps(payload)

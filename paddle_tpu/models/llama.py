@@ -903,11 +903,10 @@ class LlamaModel(Layer):
                                 max_position=self.config.max_position_embeddings)
         pair = (wrap(cos), wrap(sin))
         # memoize only outside traces (a traced constant must not escape)
-        try:
-            if jax.core.trace_state_clean():
-                self._rope_cache[seq_len] = pair
-        except Exception:  # pragma: no cover
-            pass
+        from ..jit import is_tracing
+
+        if not is_tracing():
+            self._rope_cache[seq_len] = pair
         return pair
 
     def forward(self, input_ids, attention_mask=None, return_prenorm=False,

@@ -190,13 +190,9 @@ def batch_norm(
         # tracers into the buffers; compiled training uses functional state
         # or use_global_stats, as in other XLA frameworks).
         if running_mean is not None:
-            try:
-                import jax.core as _jc
+            from ...jit import is_tracing
 
-                tracing = not _jc.trace_state_clean()
-            except Exception:  # pragma: no cover
-                tracing = False
-            if not tracing:
+            if not is_tracing():
                 arr = unwrap(x)
                 axes = tuple(i for i in range(arr.ndim) if i != ch_axis % arr.ndim)
                 batch_mean = jnp.mean(arr.astype(jnp.float32), axis=axes)

@@ -150,6 +150,12 @@ EV_FUSED_STEP = _register(
     "the fused decode-tail Pallas path activated for a layer shape "
     "(kernel, batch, hidden, heads, kv_heads, head_dim, layout) — once "
     "per shape, not per step")
+EV_AUTOSHARD = _register(
+    "preflight.autoshard",
+    "the auto-sharding solver chose a plan at engine preflight (model, "
+    "feasible, cost, per_device_bytes, reshard_bytes, plans_considered, "
+    "assignment) — the full plan + rejected ledger ride the "
+    "PreflightReport")
 
 
 # ---- the ring ---------------------------------------------------------------

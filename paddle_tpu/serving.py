@@ -386,6 +386,15 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         parameters via dist.shard_tensor), bf16→f32 dtype promotion, and
         a param/activation/kv-cache byte bound against ``budget_bytes``.
 
+        ``param_specs="auto"`` runs the auto-sharding solver instead of
+        validating hand-written specs: the cheapest feasible plan for
+        ``mesh`` + ``budget_bytes`` is adopted, returned on
+        ``report.plan`` (specs, per-device bytes, reshard bytes,
+        rejected-plan ledger), and announced as a
+        ``preflight.autoshard`` flight-recorder event — an arbitrary
+        checkpoint + mesh serves with a machine-chosen layout (apply it
+        with ``analysis.graph.solver.apply_plan``).
+
         Returns the structured ``PreflightReport``; with
         ``raise_on_fatal`` (default) an indivisible sharding or an
         over-budget model raises ``PreflightError`` carrying that report
@@ -402,6 +411,16 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             mesh=mesh, param_specs=param_specs, budget_bytes=budget_bytes,
             kv_cache_bytes=_kv_bytes(model.config, max_batch, max_len),
             allow_upcast=allow_upcast)
+        rec = _frec.RECORDER
+        if rec.enabled and report.plan is not None:
+            rec.record(_frec.EV_AUTOSHARD, model=report.model,
+                       feasible=bool(report.plan.get("feasible")),
+                       cost=report.plan.get("cost"),
+                       per_device_bytes=report.plan.get("resident_bytes"),
+                       reshard_bytes=report.plan.get("reshard_bytes"),
+                       plans_considered=report.plan.get(
+                           "plans_considered"),
+                       assignment=dict(report.plan.get("assignment", {})))
         if raise_on_fatal and not report.ok:
             raise _preflight.PreflightError(report)
         return report

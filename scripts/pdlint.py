@@ -8,6 +8,7 @@ Usage:
     python scripts/pdlint.py --write-baseline         # grandfather now
     python scripts/pdlint.py --select silent-exception,host-sync
     python scripts/pdlint.py --graph                  # + jaxpr rules
+    python scripts/pdlint.py --solve llama --mesh dp=2,mp=4
     python scripts/pdlint.py --list-rules
     python scripts/pdlint.py --no-project-rules paddle_tpu/serving.py
 
@@ -52,7 +53,19 @@ def main(argv=None) -> int:
                    help="also run the jaxpr-level graph rules (traces "
                         "the zoo preflight set — slower; see "
                         "docs/ANALYSIS.md 'Graph rules')")
+    p.add_argument("--solve", default=None, metavar="MODEL",
+                   help="run the auto-sharding solver over a zoo entry "
+                        "('all' = the fast zoo) and print the chosen "
+                        "plan instead of linting")
+    p.add_argument("--mesh", default="dp=2,mp=4", metavar="AXES",
+                   help="mesh axis sizes for --solve, e.g. dp=2,mp=4")
+    p.add_argument("--budget-bytes", type=int, default=None,
+                   metavar="N", help="per-device HBM budget for --solve "
+                                     "(default: unconstrained)")
     args = p.parse_args(argv)
+
+    if args.solve:
+        return _solve(args)
 
     if args.list_rules:
         analysis.ast_rules()  # force registration
@@ -107,6 +120,58 @@ def main(argv=None) -> int:
            if args.as_json else report.render_text(findings, baselined))
     print(out, end="" if args.as_json else "\n")
     return 1 if findings else 0
+
+
+def _solve(args) -> int:
+    """``--solve``: the auto-sharding planner as a CLI. Exit 0 when
+    every requested model has a feasible plan, 1 otherwise."""
+    import json
+
+    from paddle_tpu.analysis.graph import solver, zoo
+
+    axis_sizes = {}
+    for part in args.mesh.split(","):
+        axis, _, size = part.partition("=")
+        if not axis.strip() or not size.strip().isdigit():
+            print(f"pdlint: bad --mesh entry {part!r} "
+                  "(want e.g. dp=2,mp=4)", file=sys.stderr)
+            return 2
+        axis_sizes[axis.strip()] = int(size)
+    names = ([e.name for e in zoo.entries()] if args.solve == "all"
+             else [args.solve])
+    plans, rc = {}, 0
+    for name in names:
+        traced = zoo.traced(name)
+        if not traced.ok:
+            print(f"pdlint: {name} does not trace: {traced.error}",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        plan = solver.solve(traced, axis_sizes,
+                            budget_bytes=args.budget_bytes)
+        plans[name] = plan.as_dict()
+        if not plan.feasible:
+            rc = 1
+    if args.as_json:
+        print(json.dumps({"schema_version": 1, "tool": "pdlint-solve",
+                          "mesh": axis_sizes, "plans": plans},
+                         indent=1, sort_keys=True))
+        return rc
+    for name, plan in plans.items():
+        state = "ok" if plan["feasible"] else "OVER BUDGET"
+        print(f"{name}: {state} cost={plan['cost']} "
+              f"resident={plan['resident_bytes']} "
+              f"(params {plan['per_device_param_bytes']} + activations "
+              f"{plan['activation_bytes']} + extra {plan['extra_bytes']}) "
+              f"reshard={plan['reshard_bytes']} "
+              f"[{plan['n_reshard_events']} implicit / "
+              f"{plan['n_collective_events']} planned] "
+              f"plans={plan['plans_considered']}")
+        for klass, choice in sorted(plan["assignment"].items()):
+            print(f"  {klass:10s} -> {choice}")
+        for pname, sp in sorted(plan["specs"].items()):
+            print(f"    {pname}: {tuple(sp)}")
+    return rc
 
 
 if __name__ == "__main__":
