@@ -6,10 +6,15 @@ lock discipline, silent-exception hygiene, op-schema consistency, and
 the metrics/span catalog contracts. The ``graph`` subpackage adds the
 second layer — jaxpr-level preflight rules (sharding, dtype promotion,
 retrace hazards, cost) that read the TRACED program instead of the
-source, run under ``pdlint --graph`` and ``Engine.preflight()``. See
-docs/ANALYSIS.md for the rule catalog and ``scripts/pdlint.py`` for the
-CLI; the tier-1 gates live in tests/test_static_analysis.py and
-tests/test_graph_analysis.py.
+source, run under ``pdlint --graph`` and ``Engine.preflight()``. The
+``threads`` subpackage is the third — whole-program concurrency
+analysis (thread model, lock-order graph with deadlock-cycle witness
+chains, blocking-under-lock, cross-thread unguarded state) under
+``pdlint --threads``, paired with the runtime lock-order witness
+(``FLAGS_lock_witness``). See docs/ANALYSIS.md for the rule catalog and
+``scripts/pdlint.py`` for the CLI; the tier-1 gates live in
+tests/test_static_analysis.py, tests/test_graph_analysis.py and
+tests/test_thread_analysis.py.
 """
 from . import baseline, report  # noqa: F401
 from .core import (  # noqa: F401

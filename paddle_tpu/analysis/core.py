@@ -178,6 +178,10 @@ class Rule:
     # runs, included by ``run(graph=True)`` / ``pdlint --graph`` or by
     # naming them in ``selected``
     graph: bool = False
+    # thread rules build the whole-program concurrency model: excluded
+    # from default runs, included by ``run(threads=True)`` /
+    # ``pdlint --threads`` or by naming them in ``selected``
+    threads: bool = False
 
     def check(self, ctx: ModuleContext) -> Iterable[Finding]:
         raise NotImplementedError
@@ -224,14 +228,19 @@ def ast_rules(selected: Optional[Sequence[str]] = None) -> List[Rule]:
 
 
 def project_rules(selected: Optional[Sequence[str]] = None,
-                  graph: bool = False) -> List[ProjectRule]:
+                  graph: bool = False,
+                  threads: bool = False) -> List[ProjectRule]:
     """Graph rules run only when ``graph=True`` OR explicitly selected —
-    they trace model programs, and the default lint must stay instant."""
+    they trace model programs, and the default lint must stay instant.
+    Thread rules gate on ``threads=True`` the same way (they build the
+    whole-program concurrency model)."""
     _ensure_rules_loaded()
     return [r for rid, r in sorted(RULES.items())
             if isinstance(r, ProjectRule)
             and (selected is None or rid in selected)
             and (graph or not r.graph or
+                 (selected is not None and rid in selected))
+            and (threads or not r.threads or
                  (selected is not None and rid in selected))]
 
 
@@ -275,11 +284,12 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 def run(paths: Optional[Sequence[str]] = None, root: Optional[str] = None,
         selected: Optional[Sequence[str]] = None,
         with_project_rules: bool = True,
-        graph: bool = False) -> List[Finding]:
+        graph: bool = False, threads: bool = False) -> List[Finding]:
     """Analyze ``paths`` (default: ``<root>/paddle_tpu``) and, unless
     disabled, run the project rules against ``root`` (graph rules only
-    with ``graph=True`` or when explicitly selected). Findings come back
-    sorted by (file, line, rule)."""
+    with ``graph=True``, thread rules only with ``threads=True``, or
+    when explicitly selected). Findings come back sorted by (file,
+    line, rule)."""
     if root is None:
         root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
@@ -296,7 +306,7 @@ def run(paths: Optional[Sequence[str]] = None, root: Optional[str] = None,
                 line=e.lineno or 1, rule="parse-error",
                 message=f"could not parse: {e.msg}"))
     if with_project_rules:
-        for rule in project_rules(selected, graph=graph):
+        for rule in project_rules(selected, graph=graph, threads=threads):
             findings.extend(rule.check_project(root))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
     return findings

@@ -8,3 +8,4 @@ from . import silent_exception  # noqa: F401
 from . import op_schema  # noqa: F401
 from . import catalogs  # noqa: F401
 from ..graph import rules as graph_rules  # noqa: F401
+from ..threads import rules as thread_rules  # noqa: F401

@@ -9,9 +9,12 @@ the single pattern behind lost-update races (two interleaved
 read-modify-writes) and torn multi-field snapshots.
 
 A class "owns a lock" when a method assigns ``self.X =
-threading.Lock()/RLock()`` or ``__init__`` stores a lock-named
-parameter (``self._lock = lock`` — the shared-registry-lock idiom in
-observability/metrics.py). For each such class, instance-attribute
+threading.Lock()/RLock()`` (or the witness factories
+``make_lock``/``make_rlock`` from analysis/threads/witness.py — same
+semantics, optionally instrumented), wraps one in a Condition
+(``self.Y = threading.Condition(self.X)`` — a ``with self.Y`` holds X),
+or ``__init__`` stores a lock-named parameter (``self._lock = lock`` —
+the shared-registry-lock idiom in observability/metrics.py). For each such class, instance-attribute
 writes (rebinds, augmented assigns, and subscript/attribute stores like
 ``self._children[k] = v``) are classified as inside or outside a ``with
 self.<lock>`` block; an attribute with writes on BOTH sides is a
@@ -31,8 +34,15 @@ from typing import Dict, Iterable, List, Set, Tuple
 from ..core import Finding, ModuleContext, Rule, register_rule
 
 _LOCK_CALLS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_LOCK_FACTORIES = ("make_lock", "make_rlock")
+_COND_CALLS = {"threading.Condition", "Condition"}
 _LOCK_NAME = re.compile(r"(^|_)r?lock$")
 _CTOR_METHODS = {"__init__", "__new__"}
+
+
+def _is_lock_ctor(resolved: str) -> bool:
+    return (resolved in _LOCK_CALLS
+            or resolved.rsplit(".", 1)[-1] in _LOCK_FACTORIES)
 
 
 def _self_attr(node) -> str:
@@ -96,6 +106,7 @@ class LockDisciplineRule(Rule):
     # ---- helpers --------------------------------------------------------
     def _lock_attrs(self, ctx, cls) -> Set[str]:
         out: Set[str] = set()
+        conds = []
         for node in ast.walk(cls):
             if not isinstance(node, ast.Assign):
                 continue
@@ -104,13 +115,20 @@ class LockDisciplineRule(Rule):
                 if not name:
                     continue
                 v = node.value
-                if (isinstance(v, ast.Call)
-                        and ctx.resolve_call(v.func) in _LOCK_CALLS):
-                    out.add(name)
+                if isinstance(v, ast.Call):
+                    resolved = ctx.resolve_call(v.func)
+                    if _is_lock_ctor(resolved):
+                        out.add(name)
+                    elif resolved in _COND_CALLS:
+                        conds.append((name, v))
                 elif (_LOCK_NAME.search(name)
                         and isinstance(v, ast.Name)
                         and _LOCK_NAME.search(v.id)):
                     out.add(name)  # self._lock = lock (shared-lock idiom)
+        for name, call in conds:
+            # Condition() owns its own lock; Condition(self.X) guards X —
+            # either way `with self.<cond>` holds the lock
+            out.add(name)
         return out
 
     def _scan_method(self, method, lock_attrs: Set[str],

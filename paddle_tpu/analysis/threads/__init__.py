@@ -1,0 +1,30 @@
+"""paddle_tpu.analysis.threads — whole-program concurrency analysis.
+
+The serving tier is genuinely concurrent (router/worker/pool/kv_handoff
+watcher and drain threads, the engine thread beside HTTP handler threads,
+rpc/elastic/watchdog/checkpoint spawn sites), and the only concurrency
+rule pdlint had was per-class write discipline. This subpackage is the
+whole-program layer:
+
+- :mod:`model` — the **thread model**: walks ``threading.Thread(target=)``
+  sites, handler-dispatch entry points and loop threads, closes over the
+  project call graph, and maps every function to the set of threads that
+  can execute it.
+- :mod:`lock_graph` — the **lock-order graph**: lock identities per class
+  (Condition aliasing included), acquisition nesting across calls, cycle
+  detection with full file:line witness chains, and blocking-call
+  reachability while a lock is held.
+- :mod:`rules` — the pdlint rules over both: ``thread-naming`` (AST),
+  ``thread-deadlock`` / ``thread-blocking-under-lock`` /
+  ``thread-shared-state`` (project rules, opt-in via ``pdlint --threads``
+  the way graph rules opt in via ``--graph``).
+- :mod:`witness` — the **runtime lock-order witness**
+  (``FLAGS_lock_witness``): a thin instrumented-lock wrapper recording
+  per-thread acquisition order, validating it against the static graph,
+  emitting ``lock.order_violation`` flight-recorder events and riding
+  incident bundles.
+
+See docs/ANALYSIS.md "Concurrency rules".
+"""
+from .model import ProjectModel, get_model  # noqa: F401
+from .lock_graph import LockGraph, build_lock_graph  # noqa: F401

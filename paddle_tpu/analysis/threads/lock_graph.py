@@ -1,0 +1,601 @@
+"""Lock-order graph + blocking-under-lock + per-class access scan.
+
+Built over the :class:`~.model.ProjectModel`:
+
+- **Lock identities** are ``ClassName.attr`` for ``self.X =
+  threading.Lock()/RLock()`` (or the witness factories
+  ``make_lock``/``make_rlock``), ``module._NAME`` for module-level
+  locks. ``self.Y = threading.Condition(self.X)`` aliases Y to X (a
+  ``with self.Y`` holds X); a bare ``Condition()`` owns its own lock. A
+  lock stored from a constructor *parameter* (the shared-registry-lock
+  idiom in observability/metrics.py) keeps its own per-class identity —
+  conflating unknown shared locks could fabricate cycles, so the graph
+  stays conservative there.
+- **Edges** ``A -> B``: B is acquired while A is held — directly nested
+  ``with`` blocks, or transitively through the call graph (holding A and
+  calling a function whose closure acquires B). Every edge carries a
+  witness chain of ``file:line`` steps from A's acquisition through the
+  call sites to B's.
+- **Cycles** in the edge set are deadlock findings (two threads walking
+  the cycle from different entry points block forever); the finding
+  message and ``Finding.data`` carry the full witness chains.
+- **Blocking-under-lock**: calls that can block indefinitely or for an
+  operator-scale timeout — ``time.sleep``, ``ShmChannel.get/put``,
+  ``queue.Queue.get/put`` without a timeout, store/collective
+  ``barrier``, socket/SSE writes (``sendall``, ``wfile.write``,
+  ``urlopen``, ``getresponse``), subprocess waits — reachable while a
+  lock is held. ``Condition.wait`` is exempt (it *releases* the lock;
+  that is its contract).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .model import FuncKey, ProjectModel
+
+__all__ = ["LockGraph", "build_lock_graph", "static_edge_pairs"]
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "Lock", "RLock")
+_LOCK_FACTORY_SUFFIX = ("make_lock", "make_rlock")
+_COND_CTORS = ("threading.Condition", "Condition")
+
+# dotted-call suffixes that block regardless of receiver type
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "urllib.request.urlopen": "urlopen (network wait)",
+    "subprocess.run": "subprocess.run",
+    "subprocess.call": "subprocess.call",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+}
+# method names that block regardless of receiver type
+_BLOCKING_METHODS = {
+    "barrier": "barrier (peer wait)",
+    "sendall": "socket sendall",
+    "getresponse": "HTTP response wait",
+    "communicate": "subprocess communicate",
+}
+# receiver-typed blocking methods: type-token suffix -> {method: needs}
+# needs "always" | "no_timeout" (blocking only without a timeout arg)
+_TYPED_BLOCKING = {
+    "ShmChannel": {"get": "always", "put": "always"},
+    "Queue": {"get": "no_timeout", "put": "no_timeout"},
+    "SimpleQueue": {"get": "no_timeout"},
+    "Event": {"wait": "no_timeout"},
+    "Popen": {"wait": "always", "communicate": "always"},
+    "HTTPConnection": {"getresponse": "always", "request": "always"},
+}
+
+
+class Edge:
+    __slots__ = ("src", "dst", "witness")
+
+    def __init__(self, src, dst, witness):
+        self.src = src
+        self.dst = dst
+        # [(file, line, note), ...] from src's acquisition to dst's
+        self.witness: List[Tuple[str, int, str]] = witness
+
+    def chain(self) -> List[str]:
+        return [f"{f}:{ln} {note}" for f, ln, note in self.witness]
+
+
+class BlockingSite:
+    __slots__ = ("lock", "file", "line", "call", "chain")
+
+    def __init__(self, lock, file, line, call, chain):
+        self.lock = lock
+        self.file = file
+        self.line = line
+        self.call = call        # human description of the blocking call
+        self.chain: List[str] = chain
+
+
+class LockGraph:
+    def __init__(self):
+        self.locks: Dict[str, Tuple[str, int]] = {}    # id -> def site
+        self.edges: Dict[Tuple[str, str], Edge] = {}
+        self.blocking: List[BlockingSite] = []
+        # per-class: attr -> [(func_key, line, kind, locked)]
+        self.accesses: Dict[Tuple[str, str],
+                            Dict[str, List[tuple]]] = {}
+        self.class_locks: Dict[Tuple[str, str], Set[str]] = {}
+
+    def add_edge(self, src: str, dst: str, witness):
+        if src == dst:
+            return
+        self.edges.setdefault((src, dst), Edge(src, dst, witness))
+
+    def cycles(self) -> List[List[Tuple[str, str]]]:
+        """Each lock-order cycle once, as its edge list, canonicalised
+        to start at the smallest lock id."""
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+        sccs = _tarjan(adj)
+        out = []
+        for comp in sccs:
+            comp_set = set(comp)
+            if len(comp) == 1:
+                continue  # self-edges are filtered at add_edge
+            cycle = _find_cycle(adj, comp_set)
+            if cycle:
+                out.append([(cycle[i], cycle[(i + 1) % len(cycle)])
+                            for i in range(len(cycle))])
+        return out
+
+
+def _tarjan(adj: Dict[str, List[str]]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strong(v):
+        work = [(v, iter(adj.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(comp)
+
+    for v in list(adj):
+        if v not in index:
+            strong(v)
+    return out
+
+
+def _find_cycle(adj, comp: Set[str]) -> Optional[List[str]]:
+    start = min(comp)
+    path, seen = [start], {start}
+    node = start
+    while True:
+        nxt = None
+        for w in adj.get(node, ()):
+            if w == start and len(path) > 1:
+                return path
+            if w in comp and w not in seen:
+                nxt = w
+                break
+        if nxt is None:
+            if len(path) == 1:
+                # need at least one hop before closing
+                for w in adj.get(node, ()):
+                    if w in comp:
+                        nxt = w
+                        break
+                if nxt is None:
+                    return None
+            else:
+                return None
+        seen.add(nxt)
+        path.append(nxt)
+        node = nxt
+        if len(path) > len(comp) + 1:
+            return None
+
+
+# ---- lock identity ----------------------------------------------------------
+
+def _is_lock_ctor(dotted: str) -> bool:
+    return (dotted in _LOCK_CTORS
+            or dotted.rsplit(".", 1)[-1] in _LOCK_FACTORY_SUFFIX)
+
+
+def _class_lock_attrs(model: ProjectModel, cls) -> Dict[str, str]:
+    """attr -> lock id for the class, Condition aliases included."""
+    out: Dict[str, str] = {}
+    mod = model.modules[cls.file]
+    assigns = []
+    for node in ast.walk(cls.node):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            assigns.append(node)
+    for node in assigns:   # locks first
+        dotted = mod.ctx.resolve_call(node.value.func)
+        if not _is_lock_ctor(dotted):
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out[t.attr] = f"{cls.name}.{t.attr}"
+    for node in assigns:   # then conditions, which may alias them
+        dotted = mod.ctx.resolve_call(node.value.func)
+        if dotted not in _COND_CTORS:
+            continue
+        alias = None
+        if node.value.args:
+            a0 = node.value.args[0]
+            if (isinstance(a0, ast.Attribute)
+                    and isinstance(a0.value, ast.Name)
+                    and a0.value.id == "self" and a0.attr in out):
+                alias = out[a0.attr]
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out[t.attr] = alias or f"{cls.name}.{t.attr}"
+    # shared-lock idiom: self._lock = <ctor param> — own identity, but
+    # still recognised as "a lock" so nesting under it is tracked
+    for node in ast.walk(cls.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Name):
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and t.attr not in out
+                    and _lock_named(t.attr)
+                    and _lock_named(node.value.id)):
+                out[t.attr] = f"{cls.name}.{t.attr}"
+    return out
+
+
+def _lock_named(name: str) -> bool:
+    low = name.lower()
+    return low.endswith("lock") or low.endswith("_cond") \
+        or low.endswith("condition")
+
+
+def _module_locks(model: ProjectModel, mod) -> Dict[str, str]:
+    """NAME -> lock id for module-level lock assignments."""
+    out = {}
+    base = mod.file.rsplit("/", 1)[-1][:-3]
+    for node in mod.ctx.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            dotted = mod.ctx.resolve_call(node.value.func)
+            if not _is_lock_ctor(dotted):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = f"{base}.{t.id}"
+    return out
+
+
+# ---- the build --------------------------------------------------------------
+
+class _Builder:
+    def __init__(self, model: ProjectModel):
+        self.model = model
+        self.graph = LockGraph()
+        self.class_lock_attrs: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self.module_locks: Dict[str, Dict[str, str]] = {}
+        # summaries for the transitive closure
+        self.direct_acq: Dict[FuncKey, List[Tuple[str, int]]] = {}
+        self.direct_block: Dict[FuncKey, List[Tuple[str, int]]] = {}
+        self.trans_acq: Dict[FuncKey, Dict[str, List[tuple]]] = {}
+        self.trans_block: Dict[FuncKey, Dict[str, List[tuple]]] = {}
+        self.cond_ids: Set[str] = set()
+
+    def build(self) -> LockGraph:
+        model = self.model
+        for mod in model.modules.values():
+            self.module_locks[mod.file] = _module_locks(model, mod)
+            for cls in mod.classes.values():
+                attrs = _class_lock_attrs(model, cls)
+                self.class_lock_attrs[cls.key] = attrs
+                self.graph.class_locks[cls.key] = set(attrs.values())
+                for attr, lid in attrs.items():
+                    self.graph.locks.setdefault(lid,
+                                                (cls.file, cls.node.lineno))
+                    if self._is_condition_attr(model, cls, attr):
+                        self.cond_ids.add(f"{cls.name}.{attr}")
+        for key in model.functions:
+            self._summarize(key)
+        self._close()
+        for key in model.functions:
+            self._walk_function(key)
+        self._scan_accesses()
+        return self.graph
+
+    @staticmethod
+    def _is_condition_attr(model, cls, attr) -> bool:
+        tok = cls.attr_types.get(attr, "")
+        return tok.rsplit(".", 1)[-1] == "Condition"
+
+    # ---- resolving an acquire expression ------------------------------
+    def _lock_of_expr(self, fn, expr) -> Optional[str]:
+        model = self.model
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            cls = model.enclosing_class(fn)
+            if cls is not None:
+                for c in model.mro(cls):
+                    attrs = self.class_lock_attrs.get(c.key, {})
+                    if expr.attr in attrs:
+                        return attrs[expr.attr]
+            return None
+        if isinstance(expr, ast.Name):
+            return self.module_locks.get(fn.file, {}).get(expr.id)
+        return None
+
+    def _cond_wait_exempt(self, fn, call) -> bool:
+        """``<condition>.wait()`` releases the lock — never blocking."""
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("wait", "wait_for")):
+            return False
+        lock_id = self._lock_of_expr(fn, call.func.value)
+        return lock_id is not None
+
+    # ---- direct summaries ---------------------------------------------
+    def _classify_blocking(self, fn, call) -> Optional[str]:
+        model = self.model
+        if self._cond_wait_exempt(fn, call):
+            return None
+        dotted = model.call_dotted.get(id(call), "")
+        for suffix, desc in _BLOCKING_DOTTED.items():
+            if dotted == suffix or dotted.endswith("." + suffix):
+                return desc
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        meth = call.func.attr
+        # wfile.write — the SSE/socket write primitive
+        if meth == "write" and isinstance(call.func.value, ast.Attribute) \
+                and call.func.value.attr == "wfile":
+            return "socket write (wfile)"
+        recv_tok = model.recv_types.get(id(call), "")
+        recv_name = recv_tok.rsplit(".", 1)[-1]
+        typed = _TYPED_BLOCKING.get(recv_name)
+        if typed and meth in typed:
+            if typed[meth] == "always":
+                return f"{recv_name}.{meth}"
+            has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+            has_timeout = has_timeout or len(call.args) >= (
+                2 if meth in ("get", "put") else 1)
+            if not has_timeout:
+                return f"{recv_name}.{meth} without timeout"
+            return None
+        if meth in _BLOCKING_METHODS and recv_name not in _TYPED_BLOCKING:
+            return _BLOCKING_METHODS[meth]
+        return None
+
+    def _summarize(self, key: FuncKey):
+        fn = self.model.functions[key]
+        acq: List[Tuple[str, int]] = []
+        blk: List[Tuple[str, int]] = []
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    for item in child.items:
+                        lid = self._lock_of_expr(fn, item.context_expr)
+                        if lid is not None:
+                            acq.append((lid, child.lineno))
+                if isinstance(child, ast.Call):
+                    desc = self._classify_blocking(fn, child)
+                    if desc is not None:
+                        blk.append((desc, child.lineno))
+                walk(child)
+
+        walk(fn.node)
+        self.direct_acq[key] = acq
+        self.direct_block[key] = blk
+        self.trans_acq[key] = {
+            lid: [(fn.file, line, f"acquires {lid}")]
+            for lid, line in acq}
+        self.trans_block[key] = {
+            desc: [(fn.file, line, f"blocks in {desc}")]
+            for desc, line in blk}
+
+    def _close(self):
+        """Fixpoint: fold callee acquire/block summaries into callers,
+        prefixing the call-site step onto the witness chain."""
+        changed = True
+        guard = 0
+        while changed and guard < 50:
+            changed = False
+            guard += 1
+            for key, callees in self.model.edges.items():
+                if key not in self.trans_acq:
+                    if key not in self.model.functions:
+                        continue
+                for callee, line in callees:
+                    if callee not in self.trans_acq:
+                        continue
+                    ta = self.trans_acq.setdefault(key, {})
+                    tb = self.trans_block.setdefault(key, {})
+                    file = key[0]
+                    cname = self.model.functions[callee].qualname \
+                        if callee in self.model.functions else callee[1]
+                    for lid, chain in self.trans_acq[callee].items():
+                        if lid not in ta and len(chain) < 8:
+                            ta[lid] = ([(file, line, f"calls {cname}()")]
+                                       + chain)
+                            changed = True
+                    for desc, chain in self.trans_block[callee].items():
+                        if desc not in tb and len(chain) < 8:
+                            tb[desc] = ([(file, line, f"calls {cname}()")]
+                                        + chain)
+                            changed = True
+
+    # ---- the scoped walk (edges + blocking findings) -------------------
+    def _walk_function(self, key: FuncKey):
+        fn = self.model.functions[key]
+        held: List[Tuple[str, int]] = []
+
+        def on_acquire(lid, line):
+            for h, hline in held:
+                self.graph.add_edge(h, lid, [
+                    (fn.file, hline, f"{fn.qualname} acquires {h}"),
+                    (fn.file, line, f"then acquires {lid}")])
+
+        def on_call(call):
+            if not held:
+                return
+            desc = self._classify_blocking(fn, call)
+            if desc is not None:
+                h, hline = held[-1]
+                self.graph.blocking.append(BlockingSite(
+                    h, fn.file, call.lineno, desc,
+                    [f"{fn.file}:{hline} {fn.qualname} acquires {h}",
+                     f"{fn.file}:{call.lineno} blocks in {desc}"]))
+            for callee in self.model.call_targets.get(id(call), ()):
+                ta = self.trans_acq.get(callee, {})
+                tb = self.trans_block.get(callee, {})
+                cname = (self.model.functions[callee].qualname
+                         if callee in self.model.functions else callee[1])
+                for h, hline in held:
+                    for lid, chain in ta.items():
+                        self.graph.add_edge(h, lid, [
+                            (fn.file, hline,
+                             f"{fn.qualname} acquires {h}"),
+                            (fn.file, call.lineno, f"calls {cname}()"),
+                        ] + chain)
+                h, hline = held[-1]
+                for desc, chain in tb.items():
+                    self.graph.blocking.append(BlockingSite(
+                        h, fn.file, call.lineno, desc,
+                        [f"{fn.file}:{hline} {fn.qualname} acquires {h}",
+                         f"{fn.file}:{call.lineno} calls {cname}()"]
+                        + [f"{f}:{ln} {note}" for f, ln, note in chain]))
+
+        def walk_node(node, is_root=False):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and not is_root:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = 0
+                for item in node.items:
+                    walk_node(item.context_expr)   # calls in the expr
+                    lid = self._lock_of_expr(fn, item.context_expr)
+                    if lid is not None:
+                        on_acquire(lid, node.lineno)
+                        held.append((lid, node.lineno))
+                        acquired += 1
+                for grand in node.body:
+                    walk_node(grand)
+                for _ in range(acquired):
+                    held.pop()
+                return
+            if isinstance(node, ast.Call):
+                on_call(node)
+            for child in ast.iter_child_nodes(node):
+                walk_node(child)
+
+        walk_node(fn.node, is_root=True)
+
+    # ---- per-class attribute accesses ----------------------------------
+    def _scan_accesses(self):
+        model = self.model
+        for mod in model.modules.values():
+            for cls in mod.classes.values():
+                lock_attrs = self.class_lock_attrs.get(cls.key, {})
+                acc: Dict[str, List[tuple]] = {}
+                for mname, q in cls.methods.items():
+                    fkey = (mod.file, q)
+                    fn = model.functions.get(fkey)
+                    if fn is None:
+                        continue
+                    self._scan_method(fn, fkey, mname, lock_attrs, acc)
+                if acc:
+                    self.graph.accesses[cls.key] = acc
+
+    def _scan_method(self, fn, fkey, mname, lock_attrs, acc):
+        def note(attr, line, kind, locked):
+            if attr in lock_attrs:
+                return
+            acc.setdefault(attr, []).append((fkey, line, kind, locked,
+                                             mname))
+
+        def target_attr(node):
+            n = node
+            while isinstance(n, (ast.Subscript, ast.Attribute)):
+                if (isinstance(n, ast.Attribute)
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self"):
+                    return n.attr
+                n = n.value
+            return ""
+
+        def walk(node, locked):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and node is not fn.node:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    e = item.context_expr
+                    n = e
+                    while isinstance(n, ast.Attribute):
+                        if n.attr in lock_attrs:
+                            locked = True
+                        n = n.value
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    attr = target_attr(t)
+                    if attr:
+                        kind = "write"
+                        if isinstance(node, ast.Assign) \
+                                and isinstance(t, ast.Attribute) \
+                                and isinstance(node.value, ast.Constant):
+                            kind = "write-const"
+                        if isinstance(node, ast.AugAssign):
+                            kind = "write-rmw"
+                        note(attr, node.lineno, kind, locked)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    attr = target_attr(t)
+                    if attr:
+                        note(attr, node.lineno, "write", locked)
+            elif (isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                note(node.attr, node.lineno, "read", locked)
+            for child in ast.iter_child_nodes(node):
+                walk(child, locked)
+
+        walk(fn.node, False)
+
+
+def build_lock_graph(model: ProjectModel) -> LockGraph:
+    return _Builder(model).build()
+
+
+def static_edge_pairs(root: str) -> Set[Tuple[str, str]]:
+    """The static lock-order edge set for the runtime witness to
+    validate observed order against."""
+    from .model import get_model
+
+    graph = build_lock_graph(get_model(root))
+    return set(graph.edges)

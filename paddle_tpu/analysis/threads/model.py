@@ -1,0 +1,574 @@
+"""The thread model: which threads can execute each function.
+
+Pure-AST whole-program analysis (no paddle_tpu import — fixture snippets
+unit-test it in isolation, like every AST rule). Three steps:
+
+1. **Index** every module: functions (nested defs included, qualnames
+   like ``Cls.method.inner``), classes (bases, methods, the inferred
+   type of every ``self.X = ClassName(...)`` attribute), import aliases.
+2. **Resolve** a conservative call graph. Only confident edges exist:
+   ``self.m()`` through the project MRO, bare names through nested-def /
+   module / import scope, receivers whose type is known from a local
+   ``x = ClassName(...)`` or a ctor-assigned attribute, ``super().m()``,
+   and the serving handler's ``server_obj`` dispatch (resolved against
+   every project class that defines ``_make_handler``). A method
+   *reference* (``self.m`` passed as a callback, returned from
+   ``_post_handler``, a nested def passed as an argument) is an edge
+   from the referencing function — the callback runs on whatever thread
+   the referencer hands it to, which the closure then propagates.
+3. **Assign threads.** Roots: each ``threading.Thread(target=T)`` site
+   starts thread *name* (its ``name=`` kwarg, else ``thread@file:line``)
+   at ``T``; every method of a project ``BaseHTTPRequestHandler`` /
+   ``ServingHandlerBase`` subclass runs on ``http-handler``; every
+   public function/method that is neither a thread target nor a handler
+   method is callable from ``main``. Private functions inherit threads
+   purely from their callers — "a helper runs on whatever thread calls
+   it" is the model.
+
+The result (``ProjectModel.threads``) feeds the cross-thread shared
+state rule and the lock-order graph; ``threads_of()`` is the query API
+the fixture tests drive.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import ModuleContext, iter_py_files
+
+__all__ = ["ProjectModel", "get_model", "FuncKey", "SpawnSite"]
+
+FuncKey = Tuple[str, str]      # (rel_file, qualname)
+
+MAIN_THREAD = "main"
+HANDLER_THREAD = "http-handler"
+
+_THREAD_CALLS = ("threading.Thread", "Thread")
+_HANDLER_BASES = {"BaseHTTPRequestHandler", "ServingHandlerBase"}
+
+
+class SpawnSite:
+    """One ``threading.Thread(...)`` construction."""
+
+    __slots__ = ("file", "line", "target", "thread_name", "has_name",
+                 "daemon")
+
+    def __init__(self, file, line, target, thread_name, has_name, daemon):
+        self.file = file
+        self.line = line
+        self.target: Optional[FuncKey] = target
+        self.thread_name = thread_name
+        self.has_name = has_name
+        self.daemon = daemon
+
+
+class FuncInfo:
+    __slots__ = ("file", "qualname", "name", "line", "node", "cls_qual")
+
+    def __init__(self, file, qualname, name, line, node, cls_qual):
+        self.file = file
+        self.qualname = qualname
+        self.name = name
+        self.line = line
+        self.node = node
+        self.cls_qual = cls_qual      # enclosing class qualname or None
+
+    @property
+    def key(self) -> FuncKey:
+        return (self.file, self.qualname)
+
+
+class ClassInfo:
+    __slots__ = ("file", "qualname", "name", "node", "bases", "methods",
+                 "attr_types")
+
+    def __init__(self, file, qualname, name, node, bases):
+        self.file = file
+        self.qualname = qualname
+        self.name = name
+        self.node = node
+        self.bases: List[str] = bases          # resolved dotted strings
+        self.methods: Dict[str, str] = {}      # name -> qualname
+        self.attr_types: Dict[str, str] = {}   # self.X -> dotted type
+
+    @property
+    def key(self):
+        return (self.file, self.qualname)
+
+
+class ModuleInfo:
+    __slots__ = ("file", "ctx", "functions", "classes")
+
+    def __init__(self, file, ctx):
+        self.file = file
+        self.ctx: ModuleContext = ctx
+        self.functions: Dict[str, FuncInfo] = {}   # qualname -> info
+        self.classes: Dict[str, ClassInfo] = {}    # qualname -> info
+
+
+def _resolve_dotted(ctx: ModuleContext, node) -> str:
+    """Dotted path of an expression through the import alias map (same
+    resolution rule as ``ModuleContext.resolve_call``)."""
+    return ctx.resolve_call(node)
+
+
+class ProjectModel:
+    """The indexed project + call graph + thread assignment."""
+
+    MODULE_BODY = "<module>"   # pseudo-function for top-level statements
+
+    def __init__(self, sources: Dict[str, str]):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[FuncKey, FuncInfo] = {}
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        self.edges: Dict[FuncKey, List[Tuple[FuncKey, int]]] = {}
+        self.spawn_sites: List[SpawnSite] = []
+        self.server_classes: List[ClassInfo] = []
+        # per-Call-node resolution caches the lock-graph walk reuses
+        self.call_targets: Dict[int, List[FuncKey]] = {}
+        self.call_dotted: Dict[int, str] = {}
+        self.recv_types: Dict[int, str] = {}
+        self._spawn_target_ids: Set[int] = set()
+        self.threads: Dict[FuncKey, Set[str]] = {}
+        self._parse(sources)
+        self._resolve_all()
+        self._assign_threads()
+
+    # ---- step 1: index ---------------------------------------------------
+    def _parse(self, sources: Dict[str, str]):
+        for file, src in sorted(sources.items()):
+            try:
+                ctx = ModuleContext(file, src)
+            except SyntaxError:
+                continue
+            mod = ModuleInfo(file, ctx)
+            self.modules[file] = mod
+            self._index_scope(mod, ctx.tree, qual="", cls_qual=None)
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                self.classes_by_name.setdefault(cls.name, []).append(cls)
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                self._scan_attr_types(mod, cls)
+                if "_make_handler" in cls.methods:
+                    self.server_classes.append(cls)
+
+    def _index_scope(self, mod, node, qual, cls_qual):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+                fn = FuncInfo(mod.file, q, child.name, child.lineno, child,
+                              cls_qual)
+                mod.functions[q] = fn
+                self.functions[fn.key] = fn
+                if cls_qual is not None and qual == cls_qual:
+                    mod.classes[cls_qual].methods.setdefault(child.name, q)
+                self._index_scope(mod, child, q, cls_qual)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{qual}.{child.name}" if qual else child.name
+                bases = [b for b in
+                         (_resolve_dotted(mod.ctx, base)
+                          for base in child.bases) if b]
+                mod.classes[q] = ClassInfo(mod.file, q, child.name, child,
+                                           bases)
+                self._index_scope(mod, child, q, cls_qual=q)
+            else:
+                self._index_scope(mod, child, qual, cls_qual)
+
+    def _scan_attr_types(self, mod, cls):
+        """``self.X = ClassName(...)`` anywhere in the class body gives
+        attribute X a type token (dotted path, project or stdlib)."""
+        for node in ast.walk(cls.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            dotted = _resolve_dotted(mod.ctx, node.value.func)
+            if not dotted:
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    cls.attr_types.setdefault(t.attr, dotted)
+
+    # ---- resolution helpers ---------------------------------------------
+    def project_classes(self, dotted: str) -> List[ClassInfo]:
+        """Project ClassInfos a dotted type token may refer to (matched
+        on the final path component)."""
+        if not dotted:
+            return []
+        return self.classes_by_name.get(dotted.rsplit(".", 1)[-1], [])
+
+    def mro(self, cls: ClassInfo) -> List[ClassInfo]:
+        """cls followed by its project base chain (BFS, cycle-safe)."""
+        out, seen, queue = [], set(), [cls]
+        while queue:
+            c = queue.pop(0)
+            if c.key in seen:
+                continue
+            seen.add(c.key)
+            out.append(c)
+            for b in c.bases:
+                queue.extend(self.project_classes(b))
+        return out
+
+    def lookup_method(self, cls: ClassInfo, name: str) -> Optional[FuncKey]:
+        for c in self.mro(cls):
+            q = c.methods.get(name)
+            if q is not None:
+                return (c.file, q)
+        return None
+
+    def is_handler_class(self, cls: ClassInfo) -> bool:
+        for c in self.mro(cls):
+            for b in c.bases:
+                if b.rsplit(".", 1)[-1] in _HANDLER_BASES:
+                    return True
+        return cls.name in _HANDLER_BASES
+
+    def enclosing_class(self, fn: FuncInfo) -> Optional[ClassInfo]:
+        if fn.cls_qual is None:
+            return None
+        return self.modules[fn.file].classes.get(fn.cls_qual)
+
+    def attr_type(self, fn: FuncInfo, attr: str) -> str:
+        cls = self.enclosing_class(fn)
+        if cls is None:
+            return ""
+        for c in self.mro(cls):
+            if attr in c.attr_types:
+                return c.attr_types[attr]
+        return ""
+
+    # ---- step 2: the call graph -----------------------------------------
+    def _resolve_all(self):
+        for mod in self.modules.values():
+            body_key = (mod.file, self.MODULE_BODY)
+            self.edges.setdefault(body_key, [])
+            self._resolve_scope_body(mod, mod.ctx.tree, body_key,
+                                     func=None)
+            for fn in mod.functions.values():
+                self.edges.setdefault(fn.key, [])
+                self._resolve_scope_body(mod, fn.node, fn.key, func=fn)
+
+    def _resolve_scope_body(self, mod, scope_node, key, func):
+        """Walk one function body (or the module body) without
+        descending into nested defs (they are their own scopes), collect
+        call/ref edges, local types, and Thread spawn sites."""
+        local_types: Dict[str, str] = {}
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Assign):
+                    self._note_local_type(mod, func, child, local_types)
+                if isinstance(child, ast.Call):
+                    self._resolve_call_node(mod, func, key, child,
+                                            local_types)
+                elif (isinstance(child, ast.Attribute)
+                        and isinstance(child.ctx, ast.Load)):
+                    self._resolve_method_ref(mod, func, key, child)
+                walk(child)
+
+        walk(scope_node)
+
+    def _note_local_type(self, mod, func, assign, local_types):
+        v = assign.value
+        token = ""
+        if isinstance(v, ast.Call):
+            token = _resolve_dotted(mod.ctx, v.func)
+        elif isinstance(v, ast.Attribute) and func is not None:
+            if (isinstance(v.value, ast.Name) and v.value.id == "self"):
+                if v.attr == "server_obj":
+                    token = "<server_obj>"
+                else:
+                    token = self.attr_type(func, v.attr)
+        elif isinstance(v, ast.Name):
+            token = local_types.get(v.id, "")
+        if not token:
+            return
+        for t in assign.targets:
+            if isinstance(t, ast.Name):
+                local_types[t.id] = token
+
+    def _receiver_type(self, mod, func, expr, local_types) -> str:
+        """Type token of a call receiver expression, "" when unknown."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self":
+                return "<self>"
+            return local_types.get(expr.id, "")
+        if isinstance(expr, ast.Attribute):
+            if expr.attr == "server_obj":
+                return "<server_obj>"
+            if (isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self" and func is not None):
+                return self.attr_type(func, expr.attr)
+        if isinstance(expr, ast.Call):
+            # chained ctor: ClassName(...).m()
+            return _resolve_dotted(mod.ctx, expr.func)
+        return ""
+
+    def _method_candidates(self, mod, func, recv_token,
+                           name) -> List[FuncKey]:
+        if recv_token == "<self>" and func is not None:
+            cls = self.enclosing_class(func)
+            if cls is not None:
+                got = self.lookup_method(cls, name)
+                return [got] if got else []
+            return []
+        if recv_token == "<server_obj>":
+            out = []
+            for cls in self.server_classes:
+                got = self.lookup_method(cls, name)
+                if got:
+                    out.append(got)
+            return out
+        out = []
+        for cls in self.project_classes(recv_token):
+            got = self.lookup_method(cls, name)
+            if got:
+                out.append(got)
+        return out
+
+    def _bare_name_targets(self, mod, func, name) -> List[FuncKey]:
+        """A bare ``name`` in call position: nearest nested def in the
+        enclosing qualname chain, else a module-level function, else a
+        project function reached through a from-import."""
+        if func is not None:
+            parts = func.qualname.split(".")
+            for i in range(len(parts), 0, -1):
+                q = ".".join(parts[:i] + [name])
+                if q in self.modules[func.file].functions:
+                    return [(func.file, q)]
+        if name in mod.functions:
+            return [(mod.file, name)]
+        if name in mod.classes:      # same-module class: its ctor
+            got = self.lookup_method(mod.classes[name], "__init__")
+            return [got] if got else []
+        dotted = mod.ctx.aliases.get(name, "")
+        if dotted:
+            return self._dotted_targets(mod, dotted)
+        return []
+
+    def _dotted_targets(self, mod, dotted) -> List[FuncKey]:
+        """``pkg.module.fn`` / ``.module.fn`` -> a project module-level
+        function or ``Class.__init__`` (matched on the trailing
+        components; project files are keyed by path, so match module
+        basename + symbol)."""
+        parts = [p for p in dotted.split(".") if p]
+        if not parts:
+            return []
+        name = parts[-1]
+        # class constructor?
+        ctors = []
+        for cls in self.project_classes(name):
+            got = self.lookup_method(cls, "__init__")
+            if got:
+                ctors.append(got)
+            else:
+                # a class with no project __init__ still anchors threads
+                # at its methods through other edges; nothing to call
+                pass
+        if ctors:
+            return ctors
+        modbase = parts[-2] if len(parts) >= 2 else None
+        out = []
+        for file, m in self.modules.items():
+            if name in m.functions and m.functions[name].cls_qual is None:
+                base = os.path.basename(file)[:-3]
+                if modbase is None or base == modbase or modbase == name:
+                    out.append((file, name))
+        # a unique project-wide match is safe even without module hints
+        if not out:
+            hits = [(f, name) for f, m in self.modules.items()
+                    if name in m.functions
+                    and m.functions[name].cls_qual is None]
+            if len(hits) == 1:
+                out = hits
+        return out
+
+    def _callable_targets(self, mod, func, node, local_types,
+                          record=None) -> List[FuncKey]:
+        """Resolve a callable-position expression (call func or callback
+        argument) to project FuncKeys."""
+        if isinstance(node, ast.Name):
+            return self._bare_name_targets(mod, func, node.id)
+        if isinstance(node, ast.Attribute):
+            # super().m()
+            if (isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Name)
+                    and node.value.func.id == "super"
+                    and func is not None):
+                cls = self.enclosing_class(func)
+                if cls is not None:
+                    for c in self.mro(cls)[1:]:
+                        q = c.methods.get(node.attr)
+                        if q is not None:
+                            return [(c.file, q)]
+                return []
+            recv = self._receiver_type(mod, func, node.value, local_types)
+            if record is not None:
+                record(recv)
+            if recv:
+                return self._method_candidates(mod, func, recv, node.attr)
+            dotted = _resolve_dotted(mod.ctx, node)
+            if dotted:
+                return self._dotted_targets(mod, dotted)
+        return []
+
+    def _resolve_call_node(self, mod, func, key, call, local_types):
+        dotted = _resolve_dotted(mod.ctx, call.func)
+        self.call_dotted[id(call)] = dotted
+        if isinstance(call.func, ast.Attribute):
+            recv = self._receiver_type(mod, func, call.func.value,
+                                       local_types)
+            if recv:
+                self.recv_types[id(call)] = recv
+        if dotted in _THREAD_CALLS:
+            self._spawn_site(mod, func, call, local_types)
+            return
+        targets = self._callable_targets(mod, func, call.func, local_types)
+        self.call_targets[id(call)] = targets
+        for t in targets:
+            self.edges[key].append((t, call.lineno))
+        # callbacks in argument position run on a thread the callee
+        # chooses; attributing them to the passer is the conservative
+        # closure (on_token handed to the engine, signal handlers, ...)
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                for t in self._callable_targets(mod, func, arg,
+                                                local_types):
+                    self.edges[key].append((t, call.lineno))
+
+    def _resolve_method_ref(self, mod, func, key, attr_node):
+        """A bare ``self.m`` load (returned bound method, stored
+        callback) is an edge — the serving dispatch returns handler
+        methods from ``_post_handler``."""
+        if func is None or id(attr_node) in self._spawn_target_ids:
+            return
+        if not (isinstance(attr_node.value, ast.Name)
+                and attr_node.value.id == "self"):
+            return
+        cls = self.enclosing_class(func)
+        if cls is None:
+            return
+        got = self.lookup_method(cls, attr_node.attr)
+        if got is not None:
+            self.edges[key].append((got, attr_node.lineno))
+
+    def _spawn_site(self, mod, func, call, local_types):
+        target = None
+        thread_name, has_name, daemon = None, False, False
+        target_expr = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target_expr = kw.value
+            elif kw.arg == "name":
+                has_name = True
+                if isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    thread_name = kw.value.value
+            elif kw.arg == "daemon":
+                daemon = bool(isinstance(kw.value, ast.Constant)
+                              and kw.value.value)
+        if target_expr is None and len(call.args) >= 2:
+            target_expr = call.args[1]
+        if target_expr is not None:
+            # the target is a thread ROOT, not a call from the spawning
+            # function — keep the generic ref walk off it
+            self._spawn_target_ids.add(id(target_expr))
+            cands = self._callable_targets(mod, func, target_expr,
+                                           local_types)
+            target = cands[0] if cands else None
+        if thread_name is None:
+            thread_name = f"thread@{mod.file}:{call.lineno}"
+        self.spawn_sites.append(SpawnSite(
+            mod.file, call.lineno, target, thread_name, has_name, daemon))
+
+    # ---- step 3: threads -------------------------------------------------
+    @staticmethod
+    def _is_public(name: str) -> bool:
+        return (not name.startswith("_")
+                or (name.startswith("__") and name.endswith("__")))
+
+    def _assign_threads(self):
+        roots: List[Tuple[FuncKey, str]] = []
+        target_keys = set()
+        for sp in self.spawn_sites:
+            if sp.target is not None:
+                roots.append((sp.target, sp.thread_name))
+                target_keys.add(sp.target)
+        handler_methods = set()
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                if self.is_handler_class(cls):
+                    for q in cls.methods.values():
+                        k = (mod.file, q)
+                        handler_methods.add(k)
+                        roots.append((k, HANDLER_THREAD))
+        for key, fn in self.functions.items():
+            if key in target_keys or key in handler_methods:
+                continue
+            if self._is_public(fn.name):
+                roots.append((key, MAIN_THREAD))
+        for mod in self.modules.values():
+            roots.append(((mod.file, self.MODULE_BODY), MAIN_THREAD))
+        # propagate each label through the call graph to a fixpoint
+        self.threads = {}
+        work = []
+        for key, label in roots:
+            s = self.threads.setdefault(key, set())
+            if label not in s:
+                s.add(label)
+                work.append((key, label))
+        while work:
+            key, label = work.pop()
+            for callee, _line in self.edges.get(key, ()):
+                s = self.threads.setdefault(callee, set())
+                if label not in s:
+                    s.add(label)
+                    work.append((callee, label))
+
+    # ---- query API -------------------------------------------------------
+    def threads_of(self, file: str, qualname: str) -> Set[str]:
+        return set(self.threads.get((file, qualname), ()))
+
+    def ctx(self, file: str) -> ModuleContext:
+        return self.modules[file].ctx
+
+
+# ---- construction ----------------------------------------------------------
+
+def model_from_root(root: str,
+                    paths: Optional[List[str]] = None) -> ProjectModel:
+    paths = paths or [os.path.join(root, "paddle_tpu")]
+    sources = {}
+    for path in iter_py_files(paths):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                sources[rel] = fh.read()
+        except OSError:
+            continue
+    return ProjectModel(sources)
+
+
+_CACHE: Dict[tuple, ProjectModel] = {}
+
+
+def get_model(root: str) -> ProjectModel:
+    """Model for ``<root>/paddle_tpu``, cached per (root, file set,
+    newest mtime) so the three thread rules share one build."""
+    files = iter_py_files([os.path.join(root, "paddle_tpu")])
+    stamp = max((os.path.getmtime(f) for f in files), default=0.0)
+    key = (root, len(files), stamp)
+    model = _CACHE.get(key)
+    if model is None:
+        _CACHE.clear()
+        model = model_from_root(root)
+        _CACHE[key] = model
+    return model

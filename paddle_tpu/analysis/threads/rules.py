@@ -1,0 +1,225 @@
+"""The concurrency rules: thread-naming (AST) + the three whole-program
+rules over the thread model and lock-order graph (opt-in via ``pdlint
+--threads``, mirroring how graph rules opt in via ``--graph``).
+
+Findings point at real file:line sites, so the inline ``# pdlint:
+disable=<id>`` pragma and the baseline machinery work unchanged; witness
+chains (the file:line path proving an edge or a blocking reach) ride
+``Finding.data`` like the shard-solver's ledger.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List
+
+from ..core import Finding, ModuleContext, ProjectRule, Rule, register_rule
+from .lock_graph import build_lock_graph
+from .model import ProjectModel, get_model
+
+__all__ = ["deadlock_findings", "blocking_findings",
+           "shared_state_findings", "naming_findings"]
+
+_CTOR_METHODS = {"__init__", "__new__"}
+
+
+# ---- thread-naming (AST, always on) -----------------------------------------
+
+@register_rule
+class ThreadNamingRule(Rule):
+    id = "thread-naming"
+    rationale = ("an unnamed thread shows up as Thread-N in incident-"
+                 "bundle all-thread stack dumps — unattributable at "
+                 "3am; every spawn site passes name=")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.resolve_call(node.func) not in ("threading.Thread",
+                                                   "Thread"):
+                continue
+            if any(kw.arg == "name" for kw in node.keywords):
+                continue
+            if len(node.args) >= 3:     # positional name
+                continue
+            yield self.finding(
+                ctx, node.lineno,
+                "threading.Thread(...) without name= — unnamed threads "
+                "make incident-bundle stack dumps unattributable")
+
+
+# ---- the whole-program rules ------------------------------------------------
+
+def _suppressed(model: ProjectModel, file: str, line: int,
+                rule_id: str) -> bool:
+    mod = model.modules.get(file)
+    return mod is not None and mod.ctx.suppressed(line, rule_id)
+
+
+def _symbol(model: ProjectModel, file: str, line: int) -> str:
+    mod = model.modules.get(file)
+    return mod.ctx.symbol_for_line(line) if mod is not None else ""
+
+
+def deadlock_findings(model: ProjectModel,
+                      rule_id: str = "thread-deadlock") -> List[Finding]:
+    graph = build_lock_graph(model)
+    out = []
+    for cycle in graph.cycles():
+        edges = [graph.edges[pair] for pair in cycle]
+        path = " -> ".join([cycle[0][0]] + [b for (_a, b) in cycle])
+        file, line, _note = edges[0].witness[0]
+        witness_txt = "; ".join(
+            " | ".join(e.chain()) for e in edges)
+        if _suppressed(model, file, line, rule_id):
+            continue
+        out.append(Finding(
+            file=file, line=line, rule=rule_id,
+            symbol=_symbol(model, file, line),
+            message=(f"lock-order cycle {path} — two threads walking it "
+                     f"from different ends deadlock; witness: "
+                     f"{witness_txt}"),
+            data={"cycle": [a for (a, _b) in cycle] + [cycle[0][0]],
+                  "edges": [{"from": e.src, "to": e.dst,
+                             "witness": e.chain()} for e in edges]}))
+    return out
+
+
+def blocking_findings(model: ProjectModel,
+                      rule_id: str = "thread-blocking-under-lock"
+                      ) -> List[Finding]:
+    graph = build_lock_graph(model)
+    out, seen = [], set()
+    for site in graph.blocking:
+        key = (site.file, site.line, site.lock, site.call)
+        if key in seen:
+            continue
+        seen.add(key)
+        if _suppressed(model, site.file, site.line, rule_id):
+            continue
+        out.append(Finding(
+            file=site.file, line=site.line, rule=rule_id,
+            symbol=_symbol(model, site.file, site.line),
+            message=(f"blocking call ({site.call}) reachable while "
+                     f"holding {site.lock} — every other thread needing "
+                     "the lock stalls behind the wait; move the I/O "
+                     "outside the critical section"),
+            data={"lock": site.lock, "chain": site.chain}))
+    return out
+
+
+_THREADSAFE_TYPES = {"local"}   # threading.local attrs are per-thread
+
+
+def shared_state_findings(model: ProjectModel,
+                          rule_id: str = "thread-shared-state"
+                          ) -> List[Finding]:
+    graph = build_lock_graph(model)
+    out = []
+    for cls_key, attrs in sorted(graph.accesses.items()):
+        file, cls_qual = cls_key
+        cls = model.modules[file].classes[cls_qual]
+        for attr, recs in sorted(attrs.items()):
+            tok = cls.attr_types.get(attr, "")
+            if tok.rsplit(".", 1)[-1] in _THREADSAFE_TYPES:
+                continue
+            recs = [r for r in recs
+                    if model.functions[r[0]].name not in _CTOR_METHODS]
+            writes = [r for r in recs if r[2].startswith("write")]
+            if not writes:
+                continue
+            threads = set()
+            for fkey, _line, _kind, _locked, _m in recs:
+                threads |= model.threads.get(fkey, set())
+            if len(threads) < 2:
+                continue
+            unguarded = [r for r in recs if not r[3]]
+            if not unguarded:
+                continue
+            # lock-free publication: every write assigns a constant —
+            # a GIL-atomic store readers may legally race (the guarded
+            # fast-path flag idiom)
+            if all(r[2] == "write-const" for r in writes):
+                continue
+            # anchor at the first unguarded WRITE when there is one —
+            # that's the mutation a pragma would justify
+            anchor = next((r for r in unguarded
+                           if r[2].startswith("write")), unguarded[0])
+            _fk, line, kind, _lk, mname = anchor
+            if _suppressed(model, file, line, rule_id):
+                continue
+            verb = {"read": "read", "write": "written",
+                    "write-const": "written",
+                    "write-rmw": "read-modify-written"}.get(kind, kind)
+            g = next((r for r in recs if r[3]), None)
+            guarded_note = (f"; guarded in {g[4]}() line {g[1]}"
+                            if g else "; no access holds a lock")
+            out.append(Finding(
+                file=file, line=line, rule=rule_id,
+                symbol=_symbol(model, file, line),
+                message=(f"attribute 'self.{attr}' of '{cls.name}' is "
+                         f"shared across threads "
+                         f"{{{', '.join(sorted(threads))}}} but {verb} "
+                         f"without a lock in "
+                         f"{mname}(){guarded_note} — guard every access "
+                         "or confine the attribute to one thread"),
+                data={"threads": sorted(threads),
+                      "accesses": [
+                          {"method": m, "line": ln, "kind": k,
+                           "locked": lk,
+                           "threads": sorted(model.threads.get(fk, ()))}
+                          for fk, ln, k, lk, m in recs[:12]]}))
+    return out
+
+
+def naming_findings(model: ProjectModel) -> List[Finding]:
+    """Spawn sites without a name (the model's view — the AST rule is
+    the enforced twin; this powers the model fixture tests)."""
+    return [Finding(file=sp.file, line=sp.line, rule="thread-naming",
+                    message="unnamed thread", symbol="")
+            for sp in model.spawn_sites if not sp.has_name]
+
+
+class _ThreadRule(ProjectRule):
+    """Base: whole-program rules opt in via ``--threads``."""
+
+    threads = True
+
+    def _findings(self, model: ProjectModel) -> List[Finding]:
+        raise NotImplementedError
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        return self._findings(get_model(root))
+
+
+@register_rule
+class ThreadDeadlockRule(_ThreadRule):
+    id = "thread-deadlock"
+    rationale = ("a cycle in the lock-order graph is a deadlock waiting "
+                 "for the right interleaving; the finding carries the "
+                 "full file:line witness chain")
+
+    def _findings(self, model):
+        return deadlock_findings(model, self.id)
+
+
+@register_rule
+class BlockingUnderLockRule(_ThreadRule):
+    id = "thread-blocking-under-lock"
+    rationale = ("sleep/shm/socket/barrier/subprocess waits reachable "
+                 "under a held lock convoy every thread that needs it — "
+                 "I/O belongs outside critical sections")
+
+    def _findings(self, model):
+        return blocking_findings(model, self.id)
+
+
+@register_rule
+class ThreadSharedStateRule(_ThreadRule):
+    id = "thread-shared-state"
+    rationale = ("an attribute reachable from two threads with any "
+                 "unguarded access is a lost-update/torn-read race — "
+                 "the whole-program growth of lock-discipline")
+
+    def _findings(self, model):
+        return shared_state_findings(model, self.id)

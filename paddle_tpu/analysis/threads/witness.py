@@ -1,0 +1,278 @@
+"""Runtime lock-order witness (``FLAGS_lock_witness``, default off).
+
+The static lock-order graph (:mod:`lock_graph`) proves what *can* nest;
+the witness observes what *does*. When the flag is on, locks created
+through :func:`make_lock` / :func:`make_rlock` are thin instrumented
+wrappers: each acquisition records the per-thread stack of witness locks
+already held, every (held, acquired) pair becomes an observed order
+edge, and two validations run on each NEW edge:
+
+- **inversion** — the reverse edge was already observed at runtime: two
+  threads have taken the same two locks in opposite orders, the textbook
+  AB/BA deadlock, caught the first time it happens rather than the time
+  it hangs;
+- **static-order conflict** — the static graph contains a path from the
+  acquired lock back to the held one (so the static analysis says this
+  nesting direction is the *wrong way around* versus the code's own
+  order) and no forward edge sanctioning it.
+
+A violation appends to the report, emits a ``lock.order_violation``
+flight-recorder event (with both acquisition chains), and rides incident
+bundles (``bundle["lock_witness"]``) — the serving-cluster dryrun gate
+runs with the witness on and asserts zero violations over the real
+router+worker topology, validating the static graph against execution
+the way ``graph-cost-table`` validates the autotuner.
+
+Off is free: ``make_lock`` returns a plain ``threading.Lock``; the only
+cost is one flag read at construction time.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["make_lock", "make_rlock", "witness_enabled", "report",
+           "reset", "violations", "load_static_edges", "WitnessLock"]
+
+_STACK_LIMIT = 12       # frames kept per first-seen edge
+
+
+def witness_enabled() -> bool:
+    try:
+        from ...utils.flags import flag
+
+        return bool(flag("FLAGS_lock_witness"))
+    except (ImportError, KeyError):
+        return False    # stripped build without the flag registry
+
+
+class _Witness:
+    """Process-wide observed-order state. Internal synchronisation is a
+    plain lock (never a WitnessLock — the witness must not observe
+    itself)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._edges: Dict[Tuple[str, str], dict] = {}
+        self._violations: List[dict] = []
+        self._locks_seen: Set[str] = set()
+        self._static: Optional[Set[Tuple[str, str]]] = None
+        self._static_reach: Optional[Dict[str, Set[str]]] = None
+        self._static_tried = False
+
+    # ---- held-stack bookkeeping (thread-local, no lock needed) --------
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def on_acquire(self, name: str):
+        held = self._held()
+        new_pairs = []
+        with self._lock:
+            self._locks_seen.add(name)
+            for h in dict.fromkeys(held):       # dedupe, keep order
+                if h == name:
+                    continue
+                edge = self._edges.get((h, name))
+                if edge is None:
+                    new_pairs.append(h)
+                else:
+                    edge["count"] += 1
+        if new_pairs:
+            stack = [f"{f.filename.rsplit(os.sep, 1)[-1]}:{f.lineno} "
+                     f"{f.name}" for f in
+                     traceback.extract_stack(limit=_STACK_LIMIT)[:-2]]
+            for h in new_pairs:
+                self._record_edge(h, name, stack)
+        held.append(name)
+
+    def on_release(self, name: str):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    # ---- edges + validation -------------------------------------------
+    def _record_edge(self, src: str, dst: str, stack: List[str]):
+        with self._lock:
+            if (src, dst) in self._edges:
+                self._edges[(src, dst)]["count"] += 1
+                return
+            self._edges[(src, dst)] = {
+                "count": 1,
+                "thread": threading.current_thread().name,
+                "stack": stack,
+            }
+            reverse = self._edges.get((dst, src))
+        kind = None
+        prior = None
+        if reverse is not None:
+            kind = "inversion"
+            prior = reverse["stack"]
+        else:
+            self._ensure_static()
+            with self._lock:
+                reach = self._static_reach
+            if (reach is not None and src in reach.get(dst, ())
+                    and (src, dst) not in (self._static or ())):
+                kind = "static_conflict"
+        if kind is not None:
+            self._violation(kind, src, dst, stack, prior)
+
+    def _violation(self, kind, src, dst, stack, prior):
+        entry = {
+            "kind": kind,
+            "edge": [src, dst],
+            "thread": threading.current_thread().name,
+            "stack": stack,
+            "prior_stack": prior,
+        }
+        with self._lock:
+            self._violations.append(entry)
+        try:
+            from ...observability import flightrecorder as _frec
+
+            rec = _frec.RECORDER
+            if rec.enabled:
+                rec.record(_frec.EV_LOCK_ORDER, violation=kind, held=src,
+                           acquired=dst,
+                           thread=threading.current_thread().name)
+        except Exception:  # pdlint: disable=silent-exception -- the witness must never take its process down; the violation is still in the report
+            pass
+
+    # ---- static graph --------------------------------------------------
+    def _ensure_static(self):
+        with self._lock:
+            if self._static_tried:
+                return
+            self._static_tried = True
+        try:
+            import paddle_tpu
+
+            root = os.path.dirname(os.path.dirname(
+                os.path.abspath(paddle_tpu.__file__)))
+            self.set_static(load_static_edges(root))
+        except Exception:  # pdlint: disable=silent-exception -- no source tree at runtime (installed wheel): inversion detection still runs, static cross-check reports unavailable
+            pass
+
+    def set_static(self, edges: Set[Tuple[str, str]]):
+        """Install the static edge set (also disables the lazy load —
+        an explicit graph must not be clobbered by the repo scan)."""
+        reach: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            reach.setdefault(a, set()).add(b)
+        changed = True
+        while changed:
+            changed = False
+            for a in list(reach):
+                new = set()
+                for b in reach[a]:
+                    new |= reach.get(b, set())
+                if not new <= reach[a]:
+                    reach[a] |= new
+                    changed = True
+        with self._lock:
+            self._static = set(edges)
+            self._static_reach = reach
+            self._static_tried = True
+
+    # ---- surfaces -------------------------------------------------------
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": witness_enabled(),
+                "locks": sorted(self._locks_seen),
+                "edges": [
+                    {"from": a, "to": b, "count": e["count"],
+                     "thread": e["thread"]}
+                    for (a, b), e in sorted(self._edges.items())],
+                "violations": list(self._violations),
+                "static_edges": (len(self._static)
+                                 if self._static is not None else None),
+                "unmodeled_edges": sorted(
+                    f"{a} -> {b}" for (a, b) in self._edges
+                    if self._static is not None
+                    and (a, b) not in self._static),
+            }
+
+    def reset(self):
+        with self._lock:
+            self._edges.clear()
+            self._violations.clear()
+            self._locks_seen.clear()
+
+
+WITNESS = _Witness()
+
+
+class WitnessLock:
+    """A Lock/RLock wrapper reporting acquisition order to the witness.
+    Context-manager compatible, and ``threading.Condition`` accepts it
+    as its underlying lock (Condition's default ``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned`` fallbacks only need
+    acquire/release — so even a Condition's wait/notify traffic is
+    witnessed)."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str, rlock: bool = False):
+        self.name = name
+        self._inner = threading.RLock() if rlock else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            WITNESS.on_acquire(self.name)
+        return ok
+
+    def release(self):
+        WITNESS.on_release(self.name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def make_lock(name: str):
+    """A lock for cross-thread state: plain ``threading.Lock`` normally,
+    a witnessed wrapper under ``FLAGS_lock_witness``. ``name`` must be
+    the static identity ``ClassName.attr`` so runtime order validates
+    against the static graph."""
+    if witness_enabled():
+        return WitnessLock(name)
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    if witness_enabled():
+        return WitnessLock(name, rlock=True)
+    return threading.RLock()
+
+
+def report() -> dict:
+    return WITNESS.report()
+
+
+def violations() -> List[dict]:
+    return WITNESS.report()["violations"]
+
+
+def reset():
+    WITNESS.reset()
+
+
+def load_static_edges(root: str) -> Set[Tuple[str, str]]:
+    from .lock_graph import static_edge_pairs
+
+    return static_edge_pairs(root)

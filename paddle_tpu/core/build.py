@@ -65,7 +65,7 @@ def load_native() -> ctypes.CDLL:
     global _LIB
     with _LOCK:
         if _LIB is None:
-            lib = ctypes.CDLL(build_native())
+            lib = ctypes.CDLL(build_native())  # pdlint: disable=thread-blocking-under-lock -- deliberate: the one-time native cc build runs under the load lock so concurrent importers wait for ONE compile instead of racing N
             # TCP store
             lib.pd_store_server_start.restype = ctypes.c_void_p
             lib.pd_store_server_start.argtypes = [ctypes.c_int]

@@ -114,7 +114,11 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
         os.replace(meta_tmp, os.path.join(path, f"{pidx}.metadata"))
 
     if async_save:
-        t = threading.Thread(target=_write, daemon=False)
+        # non-daemon + named: the writer must survive to finish the
+        # checkpoint (wait_async_save joins it atexit), and its stack
+        # must be attributable in incident-bundle thread dumps
+        t = threading.Thread(target=_write, daemon=False,
+                             name="ckpt-async-writer")
         t.start()
         _ASYNC_WRITERS.append(t)
     else:

@@ -59,7 +59,8 @@ def _serve(server_sock):
             conn, _ = server_sock.accept()
         except OSError:
             return  # socket closed by shutdown()
-        threading.Thread(target=_handle, args=(conn,), daemon=True).start()
+        threading.Thread(target=_handle, args=(conn,), daemon=True,
+                         name="rpc-handle").start()
 
 
 def _handle(conn):
@@ -142,7 +143,8 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
     for r in range(world_size):
         d = pickle.loads(bytes(store.get(f"rpc/worker/{r}", timeout=60)))
         infos[d["name"]] = WorkerInfo(**d)
-    thread = threading.Thread(target=_serve, args=(srv,), daemon=True)
+    thread = threading.Thread(target=_serve, args=(srv,), daemon=True,
+                              name="rpc-serve")
     thread.start()
     _GLOBAL.update(me=name, infos=infos, server=srv, thread=thread,
                    store=store)
@@ -180,7 +182,8 @@ def rpc_async(to: str, fn, args=None, kwargs=None, timeout=None) -> Future:
         except Exception as e:  # noqa: BLE001
             fut.set_exception(e)
 
-    threading.Thread(target=runner, daemon=True).start()
+    threading.Thread(target=runner, daemon=True,
+                     name="rpc-async-runner").start()
     fut.wait = fut.result  # paddle FutureWrapper API
     return fut
 

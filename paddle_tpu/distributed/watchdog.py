@@ -52,7 +52,9 @@ class Watchdog:
         self._history_cap = history
         self._stream = stream or sys.stderr
         self._last = time.monotonic()
-        self._lock = threading.Lock()
+        from ..analysis.threads.witness import make_lock
+
+        self._lock = make_lock("Watchdog._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.fired = False

@@ -156,6 +156,13 @@ EV_AUTOSHARD = _register(
     "feasible, cost, per_device_bytes, reshard_bytes, plans_considered, "
     "assignment) — the full plan + rejected ledger ride the "
     "PreflightReport")
+EV_LOCK_ORDER = _register(
+    "lock.order_violation",
+    "the runtime lock-order witness (FLAGS_lock_witness) observed an "
+    "acquisition order that inverts an earlier observation or "
+    "contradicts the static lock graph "
+    "(violation=inversion|static_conflict, held, acquired, thread) — "
+    "the full stacks ride bundle['lock_witness']")
 
 
 # ---- the ring ---------------------------------------------------------------
@@ -337,9 +344,17 @@ BUNDLE_SCHEMA = {
     "engines": (dict,),
     "config": (dict,),
     "threads": (list,),
+    # the runtime lock-order witness report (None when FLAGS_lock_witness
+    # is off) — observed edges, violations, static cross-check
+    "lock_witness": (dict, type(None)),
 }
 
 _EVENT_KEYS = ("seq", "ts", "mono_ns", "kind", "tid")
+
+# keys added after paddle_tpu.incident/1 shipped: producers always emit
+# them, but a reader must keep accepting bundles written before they
+# existed (the version string is unchanged — the addition is additive)
+_OPTIONAL_KEYS = frozenset({"lock_witness"})
 
 
 def validate_bundle(bundle: dict) -> dict:
@@ -349,7 +364,8 @@ def validate_bundle(bundle: dict) -> dict:
     problems = []
     for key, types in BUNDLE_SCHEMA.items():
         if key not in bundle:
-            problems.append(f"missing key: {key}")
+            if key not in _OPTIONAL_KEYS:
+                problems.append(f"missing key: {key}")
         elif not isinstance(bundle[key], types):
             problems.append(
                 f"key {key}: expected {'/'.join(t.__name__ for t in types)},"
@@ -383,6 +399,20 @@ def _thread_stacks() -> List[dict]:
                       for ln in traceback.format_stack(frame)],
         })
     return out
+
+
+def _witness_report() -> Optional[dict]:
+    """The runtime lock-order witness report for the bundle (None when
+    ``FLAGS_lock_witness`` is off — the wrapper locks were never
+    created, so there is nothing to report)."""
+    try:
+        from ..analysis.threads import witness as _wit
+
+        if not _wit.witness_enabled():
+            return None
+        return _wit.report()
+    except Exception:  # pdlint: disable=silent-exception -- a crash dump must not die on an optional debug surface; the bundle just omits it
+        return None
 
 
 _CONFIG_ENV = ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM", "RANK",
@@ -433,7 +463,9 @@ class IncidentReporter:
     def __init__(self, directory: str = "incidents"):
         self.directory = directory
         self.active = False
-        self._lock = threading.RLock()
+        from ..analysis.threads import witness as _wit
+
+        self._lock = _wit.make_rlock("IncidentReporter._lock")
         self._engines: Dict[str, "weakref.ref"] = {}
         self._count = 0
         self._dumping = False
@@ -615,6 +647,7 @@ class IncidentReporter:
             "engines": self.engine_states(),
             "config": _config_info(),
             "threads": _thread_stacks(),
+            "lock_witness": _witness_report(),
         }
 
     def dump(self, reason: str, exc: Optional[BaseException] = None,

@@ -333,7 +333,11 @@ class MetricsRegistry:
     subsystem exists to prevent)."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        from ..analysis.threads.witness import make_rlock
+
+        # one witnessed identity for the registry AND every family (the
+        # shared-lock idiom passes this object into each metric)
+        self._lock = make_rlock("MetricsRegistry._lock")
         self._families: Dict[str, _MetricFamily] = {}
 
     def _register(self, cls, name, help_str, labels, **kw):

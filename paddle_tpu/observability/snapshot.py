@@ -83,7 +83,9 @@ class SnapshotWriter:
         self.rank = _rank()
         self.buffer_lines = int(buffer_lines)
         self._pending = []
-        self._lock = threading.Lock()
+        from ..analysis.threads.witness import make_lock
+
+        self._lock = make_lock("SnapshotWriter._lock")
         suffix = f".rank{self.rank}" if self.rank is not None else ""
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, f"{prefix}{suffix}.jsonl")

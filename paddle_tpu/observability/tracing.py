@@ -261,7 +261,9 @@ class Tracer:
     """
 
     def __init__(self, capacity: int = 8192):
-        self._lock = threading.Lock()
+        from ..analysis.threads.witness import make_lock
+
+        self._lock = make_lock("Tracer._lock")
         self._buf: deque = deque(maxlen=int(capacity))
         self._live: Dict[str, Span] = {}
         self._local = threading.local()

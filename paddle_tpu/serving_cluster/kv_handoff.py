@@ -26,6 +26,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..analysis.threads.witness import make_lock
 from ..distributed.log_utils import get_logger
 from ..io.shm_channel import ShmChannel, ShmChannelTimeout
 from ..observability import flightrecorder as _frec
@@ -92,7 +93,10 @@ class KvHandoffReceiver:
         self.name = name or f"/pdtpu_kv_{os.getpid()}"
         self._chan = ShmChannel(self.name, capacity_mb=capacity_mb,
                                 create=True)
-        self._lock = threading.Lock()
+        # the witness factory hands back a plain Lock unless
+        # FLAGS_lock_witness is on; Condition's acquire/release fallbacks
+        # work over either, so even wait/notify traffic is witnessed
+        self._lock = make_lock("KvHandoffReceiver._lock")
         self._parked: Dict[str, dict] = {}
         self._arrived = threading.Condition(self._lock)
         self._max_parked = int(max_parked)

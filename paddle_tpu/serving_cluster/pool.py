@@ -24,6 +24,7 @@ import time
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis.threads.witness import make_lock
 from ..distributed.elastic import ElasticManager
 from ..distributed.log_utils import get_logger
 from ..observability import flightrecorder as _frec
@@ -100,7 +101,7 @@ class WorkerPool:
         self.ttl = float(ttl)
         self._probe_timeout = float(probe_timeout)
         self._on_worker_lost = on_worker_lost
-        self._lock = threading.Lock()
+        self._lock = make_lock("WorkerPool._lock")
         self._workers: Dict[int, WorkerInfo] = {}
         self._rr = 0  # least-loaded tie-break rotates
         self._stop = threading.Event()

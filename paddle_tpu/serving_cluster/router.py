@@ -32,6 +32,7 @@ import uuid
 from http.server import ThreadingHTTPServer
 from typing import Optional, Tuple
 
+from ..analysis.threads.witness import make_lock
 from ..distributed.log_utils import get_logger
 from ..observability import flightrecorder as _frec
 from ..observability import tracing as _tracing
@@ -87,7 +88,7 @@ class RouterServer:
         self._tracer = _tracing.get_tracer()
         if enable_flight_recorder:
             _frec.get_recorder().enable()
-        self._lock = threading.Lock()
+        self._lock = make_lock("RouterServer._lock")
         self._placed = 0
         self._retried = 0
         self._failed = 0

@@ -29,6 +29,7 @@ import threading
 import uuid
 from typing import Optional
 
+from ..analysis.threads.witness import make_lock
 from ..distributed.elastic import ElasticManager
 from ..distributed.log_utils import get_logger
 from ..serving_http import CompletionServer, EngineCommand, _Submission
@@ -70,7 +71,7 @@ class WorkerServer(CompletionServer):
         self._kv = kv_receiver
         self._handoff_wait_s = float(handoff_wait_s)
         self._senders = {}           # channel name -> KvHandoffSender
-        self._senders_lock = threading.Lock()
+        self._senders_lock = make_lock("WorkerServer._senders_lock")
         if self._kv is not None:
             self._kv.start()
 

@@ -300,7 +300,7 @@ class Tensor:
             raise ValueError(
                 f"set_value shape mismatch: {tuple(arr.shape)} vs {tuple(self._array.shape)}"
             )
-        self._array = arr.astype(self._array.dtype)
+        self._array = arr.astype(self._array.dtype)  # pdlint: disable=thread-shared-state -- Tensors are step/request-local values: device state is touched only by the engine thread (single-engine-thread design), so instances never cross threads even though the METHODS are reachable from many
         return self
 
     def copy_(self, other):

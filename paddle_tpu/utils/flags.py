@@ -92,6 +92,13 @@ define_flag("cudnn_deterministic", False,
             "map to XLA deterministic reductions where applicable")
 define_flag("log_memory_stats", False,
             "log live/peak device memory at step boundaries (memory/stats.cc)")
+define_flag("lock_witness", False,
+            "instrument cross-thread locks with the runtime lock-order "
+            "witness (paddle_tpu/analysis/threads/witness.py): records "
+            "per-thread acquisition order, validates it against the "
+            "static lock graph, emits lock.order_violation flight-"
+            "recorder events and rides incident bundles; off = plain "
+            "threading locks, zero overhead")
 define_flag("collective_static_check", False,
             "verify shape/dtype agreement across processes before eager "
             "collectives (paddle/phi/core/distributed/check/static_check.cc)")

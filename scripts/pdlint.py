@@ -8,6 +8,7 @@ Usage:
     python scripts/pdlint.py --write-baseline         # grandfather now
     python scripts/pdlint.py --select silent-exception,host-sync
     python scripts/pdlint.py --graph                  # + jaxpr rules
+    python scripts/pdlint.py --threads                # + concurrency rules
     python scripts/pdlint.py --solve llama --mesh dp=2,mp=4
     python scripts/pdlint.py --list-rules
     python scripts/pdlint.py --no-project-rules paddle_tpu/serving.py
@@ -53,6 +54,10 @@ def main(argv=None) -> int:
                    help="also run the jaxpr-level graph rules (traces "
                         "the zoo preflight set — slower; see "
                         "docs/ANALYSIS.md 'Graph rules')")
+    p.add_argument("--threads", action="store_true",
+                   help="also run the whole-program concurrency rules "
+                        "(thread model + lock-order graph; see "
+                        "docs/ANALYSIS.md 'Concurrency rules')")
     p.add_argument("--solve", default=None, metavar="MODEL",
                    help="run the auto-sharding solver over a zoo entry "
                         "('all' = the fast zoo) and print the chosen "
@@ -80,7 +85,7 @@ def main(argv=None) -> int:
     paths = [os.path.abspath(p_) for p_ in args.paths] or None
     findings = analysis.run(paths=paths, root=_REPO, selected=selected,
                             with_project_rules=not args.no_project_rules,
-                            graph=args.graph)
+                            graph=args.graph, threads=args.threads)
 
     base_path = args.baseline or os.path.join(_REPO,
                                               ".pdlint_baseline.json")

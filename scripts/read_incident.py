@@ -151,6 +151,27 @@ def format_threads(b: dict, frames: int = 3) -> List[str]:
     return lines
 
 
+def format_lock_witness(b: dict) -> List[str]:
+    """The runtime lock-order witness section (absent unless
+    FLAGS_lock_witness was on in the crashed process)."""
+    w = b.get("lock_witness")
+    if not w:
+        return []
+    lines = [f"LOCK WITNESS ({len(w.get('locks') or [])} locks, "
+             f"{len(w.get('edges') or [])} order edges)"]
+    for v in w.get("violations") or []:
+        a, c = v.get("edge", ["?", "?"])
+        lines.append(f"  VIOLATION [{v.get('kind')}] {a} -> {c} "
+                     f"on thread {v.get('thread')}")
+        for ln in (v.get("stack") or [])[-3:]:
+            lines.append(f"      {ln}")
+    if not w.get("violations"):
+        lines.append("  no violations observed")
+    for e in (w.get("unmodeled_edges") or [])[:8]:
+        lines.append(f"  unmodeled by static graph: {e}")
+    return lines
+
+
 def format_spans(b: dict, last: int = 10) -> List[str]:
     spans = b.get("spans") or []
     if not spans:
@@ -175,6 +196,7 @@ def render(b: dict, events: int = 30, per_subsystem: int = 5,
             format_subsystems(b, k=per_subsystem, only=subsystem),
             format_engines(b),
             format_spans(b),
+            format_lock_witness(b),
             format_threads(b),
         ])
     return "\n".join("\n".join(s) for s in sections if s)

@@ -1,0 +1,587 @@
+"""threadcheck: the whole-program concurrency analysis
+(paddle_tpu/analysis/threads) and its runtime lock-order witness.
+
+1. **Thread-model fixtures** — Thread(target=self._loop) closure through
+   helpers, handler-class dispatch, public-vs-private main reachability.
+2. **Rule fixtures** — an AB/BA deadlock cycle with a file:line witness
+   chain, blocking-call-under-lock (direct, transitive, timeout and
+   Condition.wait exemptions), cross-thread unguarded attributes (with
+   the publication-flag exemption), thread-naming.
+3. **The runtime witness** — a forced order inversion records a
+   violation + a ``lock.order_violation`` flight-recorder event;
+   static-graph conflicts; flag gating; Condition compatibility.
+4. **The tier-1 gate** — ``scripts/pdlint.py --json --baseline
+   .pdlint_baseline.json --threads`` exits 0 with zero baselined
+   findings, next to the ``--graph`` gate.
+"""
+import importlib.util
+import json
+import os
+import threading
+
+import pytest
+
+from paddle_tpu import analysis
+from paddle_tpu.analysis.threads import model as tmodel
+from paddle_tpu.analysis.threads import rules as trules
+from paddle_tpu.analysis.threads import witness as twitness
+from paddle_tpu.analysis.threads.model import ProjectModel
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model(src, path="paddle_tpu/fix.py"):
+    return ProjectModel({path: src})
+
+
+def _load_script(name):
+    path = os.path.join(_REPO, "scripts", name)
+    spec = importlib.util.spec_from_file_location("pdlint_thr", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# the thread model
+# ---------------------------------------------------------------------------
+
+_LOOP_SRC = (
+    "import threading\n"
+    "class Worker:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.count = 0\n"
+    "    def start(self):\n"
+    "        self._t = threading.Thread(target=self._loop,\n"
+    "                                   name='worker-loop', daemon=True)\n"
+    "        self._t.start()\n"
+    "    def _loop(self):\n"
+    "        self._helper()\n"
+    "    def _helper(self):\n"
+    "        self.count += 1\n"
+    "    def snapshot(self):\n"
+    "        return self.count\n"
+)
+
+
+def test_thread_model_closure_through_thread_target():
+    """Thread(target=self._loop) makes _loop AND the private helper it
+    calls run on the named thread; the spawning method stays main."""
+    m = _model(_LOOP_SRC)
+    f = "paddle_tpu/fix.py"
+    assert m.threads_of(f, "Worker._loop") == {"worker-loop"}
+    assert m.threads_of(f, "Worker._helper") == {"worker-loop"}
+    assert m.threads_of(f, "Worker.snapshot") == {"main"}
+    assert m.threads_of(f, "Worker.start") == {"main"}
+    (site,) = [s for s in m.spawn_sites]
+    assert site.thread_name == "worker-loop" and site.has_name
+
+
+def test_thread_model_nested_def_target_and_callback():
+    src = (
+        "import threading\n"
+        "class Pool:\n"
+        "    def start(self):\n"
+        "        def watch():\n"
+        "            self._refresh()\n"
+        "        t = threading.Thread(target=watch, name='pool-watch')\n"
+        "        t.start()\n"
+        "    def _refresh(self):\n"
+        "        pass\n"
+    )
+    m = _model(src)
+    f = "paddle_tpu/fix.py"
+    assert m.threads_of(f, "Pool.start.watch") == {"pool-watch"}
+    assert m.threads_of(f, "Pool._refresh") == {"pool-watch"}
+
+
+def test_thread_model_handler_dispatch():
+    """Methods of a BaseHTTPRequestHandler subclass run on http-handler,
+    and the server_obj hook dispatch carries the label into the server
+    class's private handlers."""
+    src = (
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class Handler(BaseHTTPRequestHandler):\n"
+        "    server_obj = None\n"
+        "    def do_POST(self):\n"
+        "        fn = self.server_obj._post_handler('/x')\n"
+        "        fn(self, {})\n"
+        "class Server:\n"
+        "    def _make_handler(self):\n"
+        "        pass\n"
+        "    def _post_handler(self, route):\n"
+        "        return self._complete\n"
+        "    def _complete(self, handler, req):\n"
+        "        pass\n"
+    )
+    m = _model(src)
+    f = "paddle_tpu/fix.py"
+    assert "http-handler" in m.threads_of(f, "Handler.do_POST")
+    assert "http-handler" in m.threads_of(f, "Server._post_handler")
+    assert "http-handler" in m.threads_of(f, "Server._complete")
+
+
+def test_thread_model_real_repo_probes():
+    """The real serving tier maps correctly: the engine loop's work is
+    engine-thread-only, SSE collection is handler-thread, the pool
+    refresh is reachable from main AND the watch thread."""
+    m = tmodel.get_model(_REPO)
+    assert m.threads_of("paddle_tpu/serving_http.py",
+                        "CompletionServer._handle_submission") \
+        == {"engine-loop"}
+    assert m.threads_of("paddle_tpu/serving_http.py",
+                        "CompletionServer._collect") == {"http-handler"}
+    assert m.threads_of("paddle_tpu/serving_cluster/pool.py",
+                        "WorkerPool.refresh") >= {"main",
+                                                  "worker-pool-watch"}
+    assert m.threads_of("paddle_tpu/serving_cluster/kv_handoff.py",
+                        "KvHandoffReceiver._drain") == {"kv-handoff-recv"}
+
+
+def test_every_repo_spawn_site_is_named():
+    m = tmodel.get_model(_REPO)
+    unnamed = trules.naming_findings(m)
+    assert unnamed == [], [f"{x.file}:{x.line}" for x in unnamed]
+
+
+# ---------------------------------------------------------------------------
+# thread-naming (AST rule)
+# ---------------------------------------------------------------------------
+
+def test_thread_naming_flags_unnamed_thread():
+    finds = analysis.analyze_source(
+        "import threading\n"
+        "t = threading.Thread(target=print, daemon=True)\n"
+        "u = threading.Thread(target=print, name='ok')\n",
+        rules=analysis.ast_rules(["thread-naming"]))
+    assert [f.line for f in finds] == [2]
+    assert "name=" in finds[0].message
+
+
+def test_thread_naming_pragma_and_from_import():
+    finds = analysis.analyze_source(
+        "from threading import Thread\n"
+        "t = Thread(target=print)  # pdlint: disable=thread-naming\n"
+        "u = Thread(target=print)\n",
+        rules=analysis.ast_rules(["thread-naming"]))
+    assert [f.line for f in finds] == [3]
+
+
+# ---------------------------------------------------------------------------
+# thread-deadlock
+# ---------------------------------------------------------------------------
+
+_ABBA_SRC = (
+    "import threading\n"
+    "class AB:\n"
+    "    def __init__(self):\n"
+    "        self._la = threading.Lock()\n"
+    "        self._lb = threading.Lock()\n"
+    "    def ab(self):\n"
+    "        with self._la:\n"
+    "            with self._lb:\n"
+    "                pass\n"
+    "    def ba(self):\n"
+    "        with self._lb:\n"
+    "            with self._la:\n"
+    "                pass\n"
+)
+
+
+def test_deadlock_cycle_detected_with_witness_chain():
+    finds = trules.deadlock_findings(_model(_ABBA_SRC))
+    assert len(finds) == 1
+    f = finds[0]
+    assert f.rule == "thread-deadlock"
+    assert "AB._la" in f.message and "AB._lb" in f.message
+    # the witness chain is real file:line steps, riding data too
+    assert f.data and len(f.data["edges"]) == 2
+    for edge in f.data["edges"]:
+        assert all("paddle_tpu/fix.py:" in step
+                   for step in edge["witness"])
+    # cycle closes on itself
+    assert f.data["cycle"][0] == f.data["cycle"][-1]
+
+
+def test_deadlock_cross_class_transitive_cycle():
+    src = (
+        "import threading\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.a = A()\n"
+        "    def poke(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "    def back(self):\n"
+        "        with self._lock:\n"
+        "            self.a.go()\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.b = B()\n"
+        "    def go(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "    def fwd(self):\n"
+        "        with self._lock:\n"
+        "            self.b.poke()\n"
+    )
+    finds = trules.deadlock_findings(_model(src))
+    assert len(finds) == 1
+    chain = json.dumps(finds[0].data)
+    assert "calls" in chain   # the transitive step is in the witness
+
+
+def test_deadlock_consistent_order_is_clean():
+    src = (
+        "import threading\n"
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._la = threading.Lock()\n"
+        "        self._lb = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+    )
+    assert trules.deadlock_findings(_model(src)) == []
+
+
+def test_deadlock_pragma_suppresses():
+    src = _ABBA_SRC.replace(
+        "        with self._la:\n"
+        "            with self._lb:\n",
+        "        with self._la:  # pdlint: disable=thread-deadlock\n"
+        "            with self._lb:\n", 1)
+    assert trules.deadlock_findings(_model(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# thread-blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_blocking_under_lock_direct_and_exemptions():
+    src = (
+        "import queue\n"
+        "import threading\n"
+        "import time\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "    def bad_sleep(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(1)\n"
+        "    def bad_get(self):\n"
+        "        with self._lock:\n"
+        "            return self._q.get()\n"
+        "    def ok_get(self):\n"
+        "        with self._lock:\n"
+        "            return self._q.get(timeout=0.1)\n"
+        "    def ok_sleep(self):\n"
+        "        time.sleep(1)\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "    def ok_wait(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.wait()\n"
+    )
+    finds = trules.blocking_findings(_model(src))
+    by_line = {f.line: f for f in finds}
+    assert sorted(by_line) == [11, 14]
+    assert "time.sleep" in by_line[11].message
+    assert "without timeout" in by_line[14].message
+    assert by_line[11].data["lock"] == "S._lock"
+
+
+def test_blocking_under_lock_shm_channel_and_transitive():
+    src = (
+        "import threading\n"
+        "import time\n"
+        "from paddle_tpu.io.shm_channel import ShmChannel\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._chan = ShmChannel('x', create=True)\n"
+        "    def bad_put(self):\n"
+        "        with self._lock:\n"
+        "            self._chan.put({}, timeout=5)\n"
+        "    def _slow(self):\n"
+        "        time.sleep(2)\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self._slow()\n"
+    )
+    finds = trules.blocking_findings(_model(src))
+    msgs = {f.line: f.message for f in finds}
+    assert 10 in msgs and "ShmChannel.put" in msgs[10]
+    # the transitive finding anchors at the call inside the held region
+    assert 15 in msgs and "time.sleep" in msgs[15]
+    (trans,) = [f for f in finds if f.line == 15]
+    assert any("calls S._slow()" in step for step in trans.data["chain"])
+
+
+def test_blocking_under_lock_pragma_suppresses():
+    src = (
+        "import threading\n"
+        "import time\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def deliberate(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(1)  "
+        "# pdlint: disable=thread-blocking-under-lock -- why\n"
+    )
+    assert trules.blocking_findings(_model(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# thread-shared-state
+# ---------------------------------------------------------------------------
+
+def test_shared_state_unguarded_cross_thread_attr():
+    finds = trules.shared_state_findings(_model(_LOOP_SRC))
+    assert len(finds) == 1
+    f = finds[0]
+    assert "self.count" in f.message and "Worker" in f.message
+    assert set(f.data["threads"]) == {"main", "worker-loop"}
+    assert any(a["kind"] == "write-rmw" for a in f.data["accesses"])
+
+
+def test_shared_state_guarded_is_clean():
+    src = _LOOP_SRC.replace(
+        "    def _helper(self):\n"
+        "        self.count += 1\n",
+        "    def _helper(self):\n"
+        "        with self._lock:\n"
+        "            self.count += 1\n").replace(
+        "    def snapshot(self):\n"
+        "        return self.count\n",
+        "    def snapshot(self):\n"
+        "        with self._lock:\n"
+        "            return self.count\n")
+    assert trules.shared_state_findings(_model(src)) == []
+
+
+def test_shared_state_ctor_only_writes_and_publication_exempt():
+    src = (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.cfg = {}\n"        # ctor-only write: fine
+        "        self.enabled = False\n"
+        "    def start(self):\n"
+        "        t = threading.Thread(target=self._loop, name='w')\n"
+        "        t.start()\n"
+        "    def enable(self):\n"
+        "        self.enabled = True\n"   # constant publication: exempt
+        "    def _loop(self):\n"
+        "        if self.enabled:\n"
+        "            print(self.cfg)\n"
+    )
+    assert trules.shared_state_findings(_model(src)) == []
+
+
+def test_shared_state_single_thread_is_clean():
+    src = (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "    def bump(self):\n"
+        "        self.n += 1\n"       # public, but only main reaches it
+    )
+    assert trules.shared_state_findings(_model(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# the runtime witness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fresh_witness():
+    twitness.reset()
+    yield twitness.WITNESS
+    twitness.reset()
+
+
+def test_witness_inversion_violation_and_event(fresh_witness):
+    from paddle_tpu.observability import flightrecorder as frec
+
+    rec = frec.get_recorder()
+    was = rec.enabled
+    rec.enable()
+    since = rec.stats()["recorded"]
+    a = twitness.WitnessLock("Fix.A._lock")
+    b = twitness.WitnessLock("Fix.B._lock")
+    try:
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        th = threading.Thread(target=t1, name="wit-t1")
+        th.start()
+        th.join()
+        with b:
+            with a:      # the inversion
+                pass
+        rep = twitness.report()
+        assert {"Fix.A._lock", "Fix.B._lock"} <= set(rep["locks"])
+        (v,) = rep["violations"]
+        assert v["kind"] == "inversion"
+        assert v["edge"] == ["Fix.B._lock", "Fix.A._lock"]
+        assert v["stack"] and v["prior_stack"]
+        evs = [e for e in rec.events(since=since)
+               if e["kind"] == "lock.order_violation"]
+        assert len(evs) == 1
+        assert evs[0]["violation"] == "inversion"
+        assert evs[0]["held"] == "Fix.B._lock"
+        assert evs[0]["acquired"] == "Fix.A._lock"
+    finally:
+        if not was:
+            rec.disable()
+
+
+def test_witness_static_conflict(fresh_witness):
+    fresh_witness.set_static({("Fix.A._lock", "Fix.B._lock")})
+    a = twitness.WitnessLock("Fix.A._lock")
+    b = twitness.WitnessLock("Fix.B._lock")
+    with b:
+        with a:      # contradicts the static A -> B order
+            pass
+    (v,) = twitness.violations()
+    assert v["kind"] == "static_conflict"
+    rep = twitness.report()
+    assert rep["static_edges"] == 1
+
+
+def test_witness_consistent_order_is_clean(fresh_witness):
+    a = twitness.WitnessLock("Fix.A._lock")
+    b = twitness.WitnessLock("Fix.B._lock")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = twitness.report()
+    assert rep["violations"] == []
+    assert [(e["from"], e["to"]) for e in rep["edges"]] \
+        == [("Fix.A._lock", "Fix.B._lock")]
+    assert rep["edges"][0]["count"] == 3
+
+
+def test_witness_flag_gates_construction(fresh_witness):
+    from paddle_tpu.utils.flags import get_flags, set_flags
+
+    orig = get_flags("lock_witness")["lock_witness"]
+    try:
+        set_flags({"lock_witness": False})
+        assert isinstance(twitness.make_lock("X._lock"),
+                          type(threading.Lock()))
+        set_flags({"lock_witness": True})
+        lk = twitness.make_lock("X._lock")
+        assert isinstance(lk, twitness.WitnessLock)
+        rk = twitness.make_rlock("Y._lock")
+        assert isinstance(rk, twitness.WitnessLock)
+        # reentrancy: no self-edges, releases unwind
+        with rk:
+            with rk:
+                pass
+        assert twitness.report()["edges"] == []
+    finally:
+        set_flags({"lock_witness": orig})
+
+
+def test_witness_condition_compatibility(fresh_witness):
+    lk = twitness.WitnessLock("Fix.C._lock")
+    cv = threading.Condition(lk)
+    hit = []
+
+    def waiter():
+        with cv:
+            while not hit:
+                cv.wait(timeout=5)
+
+    th = threading.Thread(target=waiter, name="wit-cv")
+    th.start()
+    with cv:
+        hit.append(1)
+        cv.notify_all()
+    th.join(timeout=10)
+    assert not th.is_alive()
+    assert twitness.report()["violations"] == []
+
+
+def test_witness_static_edges_from_repo_graph():
+    """static_edge_pairs runs over the real tree (empty today — the
+    repo never nests cross-class locks — but the call path the lazy
+    loader uses must work)."""
+    edges = twitness.load_static_edges(_REPO)
+    assert isinstance(edges, set)
+
+
+# ---------------------------------------------------------------------------
+# registry / CLI / gate
+# ---------------------------------------------------------------------------
+
+def test_thread_rules_registered_and_gated():
+    analysis.ast_rules()
+    assert {"thread-naming", "thread-deadlock",
+            "thread-blocking-under-lock",
+            "thread-shared-state"} <= set(analysis.RULES)
+    default_ids = {r.id for r in analysis.core.project_rules()}
+    assert not any(i.startswith("thread-") for i in default_ids)
+    with_threads = {r.id for r in analysis.core.project_rules(
+        threads=True)}
+    assert {"thread-deadlock", "thread-blocking-under-lock",
+            "thread-shared-state"} <= with_threads
+    sel = {r.id for r in analysis.core.project_rules(
+        ["thread-deadlock"])}
+    assert sel == {"thread-deadlock"}
+
+
+def test_pdlint_cli_list_rules_covers_thread_ids(capsys):
+    mod = _load_script("pdlint.py")
+    assert mod.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("thread-naming", "thread-deadlock",
+                "thread-blocking-under-lock", "thread-shared-state"):
+        assert rid in out
+
+
+def test_threads_json_finding_shape():
+    """Thread findings ride the pinned JSON shape; witness chains land
+    additively in the per-finding data field."""
+    from paddle_tpu.analysis import report
+
+    finds = trules.deadlock_findings(_model(_ABBA_SRC))
+    doc = json.loads(report.render_json(finds))
+    (f,) = doc["findings"]
+    assert set(f) == {"file", "line", "rule", "symbol", "message",
+                      "data"}
+    assert f["data"]["edges"][0]["witness"]
+
+
+def test_pdlint_threads_gate_zero_new_findings(capsys):
+    """THE gate: ``scripts/pdlint.py --json --baseline
+    .pdlint_baseline.json --threads`` exits 0 with nothing baselined —
+    every finding the concurrency rules surface is fixed or pragma'd."""
+    mod = _load_script("pdlint.py")
+    rc = mod.main(["--json", "--threads", "--baseline",
+                   os.path.join(_REPO, ".pdlint_baseline.json")])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == 0, f"pdlint --threads found new findings:\n{out}"
+    assert doc["total"] == 0
+    assert doc["baselined"] == 0
+    assert "thread-deadlock" in doc["rules"]
