@@ -438,6 +438,103 @@ def serve_bench(devs, gen):
     print(json.dumps(rec))
 
 
+def mixed_serve_bench(devs, gen):
+    """BENCH_CONFIG=serve BENCH_SERVE_MIXED=1: the SLO-aware scheduler's
+    target workload — long-prompt arrivals landing over live short
+    decodes. Runs the same scenario with chunked prefill ON and OFF and
+    records TTFT for the long prompts plus inter-token p50/p99 for the
+    live decodes; the headline value is the chunked p99 inter-token
+    latency, with the monolithic run beside it so the stall reduction is
+    one record. Seeds ROADMAP item 5's load harness (CPU smoke persists
+    under BENCH_STATE.json:cpu_smoke)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    on_tpu = devs[0].platform == "tpu"
+    cfg = _serving_config(on_tpu)
+    if on_tpu:
+        slots, max_len, chunk = 8, 1024, 128
+        short_len, short_budget = 32, 192
+        long_len, long_budget, n_long = 704, 32, 3
+    else:
+        slots, max_len, chunk = 2, 128, 16
+        short_len, short_budget = 6, 48
+        long_len, long_budget, n_long = 96, 6, 2
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    shorts = [rng.randint(0, cfg.vocab_size, (short_len,))
+              for _ in range(slots - 1)]
+    longs = [rng.randint(0, cfg.vocab_size, (long_len,))
+             for _ in range(n_long)]
+
+    def run_once(chunk_tokens):
+        eng = ContinuousBatchEngine(
+            model, max_batch=slots, max_len=max_len, page_size=16,
+            prefill_chunk_tokens=chunk_tokens)
+        times = {}
+
+        def on_token(rid, tok, done):
+            times.setdefault(rid, []).append(time.perf_counter())
+
+        live = [eng.add_request(p, short_budget, on_token=on_token)
+                for p in shorts]
+        # live decodes under way before the first long prompt arrives
+        while not all(len(times.get(r, ())) >= 2 for r in live):
+            eng.step()
+        t_sub, ttfts = {}, []
+        for p in longs:
+            rid = eng.add_request(p, long_budget, on_token=on_token)
+            t_sub[rid] = time.perf_counter()
+            # let the arrival land over the live decodes before the next
+            for _ in range(4):
+                eng.step()
+        eng.run_until_done()
+        for rid, t0 in t_sub.items():
+            ttfts.append(times[rid][0] - t0)
+        gaps = []
+        for r in live:
+            ts = times[r]
+            gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+        gaps = np.asarray(gaps)
+        return {
+            "inter_token_p50_ms": round(float(np.percentile(gaps, 50))
+                                        * 1000, 3),
+            "inter_token_p99_ms": round(float(np.percentile(gaps, 99))
+                                        * 1000, 3),
+            "inter_token_max_ms": round(float(gaps.max()) * 1000, 3),
+            "ttft_long_p50_ms": round(float(np.percentile(ttfts, 50))
+                                      * 1000, 3),
+        }
+
+    # warm-up BOTH variants: the monolithic long-prompt bucket and the
+    # chunk/suffix programs compile here, so neither measured run pays a
+    # compile inside an inter-token gap
+    run_once(chunk)
+    run_once(None)
+    chunked = run_once(chunk)
+    mono = run_once(None)
+    rec = {
+        "metric": "llama_serve_mixed_inter_token_p99_ms",
+        "value": chunked["inter_token_p99_ms"],
+        "unit": "ms",
+        "vs_baseline": 0.0,  # no reference mixed-load number exists
+        "platform": devs[0].platform,
+        "chunk_tokens": chunk,
+        "chunked": chunked,
+        "monolithic": mono,
+        "stall_ratio_p99": round(
+            mono["inter_token_p99_ms"]
+            / max(chunked["inter_token_p99_ms"], 1e-9), 2),
+        "slots": slots,
+        "config": "serve_mixed",
+        "tpu_gen": gen,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    print(json.dumps(rec))
+
+
 def cp_bench(devs, gen):
     """BENCH_CONFIG=cp: context-parallel ring attention (splash kernel per
     hop — VERDICT r4 item 3) at long sequence, reporting ring-vs-direct-
@@ -632,6 +729,8 @@ def _main_inner():
     if cfg_name == "mla":
         return mla_decode_bench(devs, gen)
     if cfg_name == "serve":
+        if os.environ.get("BENCH_SERVE_MIXED"):
+            return mixed_serve_bench(devs, gen)
         return serve_bench(devs, gen)
     if cfg_name == "cp":
         return cp_bench(devs, gen)
@@ -868,7 +967,9 @@ def orchestrate():
     # 3. tunnel down or bench failed: fall back to the best TPU result seen
     # for THIS config (the int8 serve leg records under its own key)
     cfg_name = os.environ.get("BENCH_CONFIG", "1b")
-    if cfg_name == "serve" and os.environ.get("BENCH_SERVE_MLA"):
+    if cfg_name == "serve" and os.environ.get("BENCH_SERVE_MIXED"):
+        cfg_name = "serve_mixed"
+    elif cfg_name == "serve" and os.environ.get("BENCH_SERVE_MLA"):
         cfg_name = "serve_mla"
     elif cfg_name == "serve" and os.environ.get("BENCH_SERVE_INT8"):
         cfg_name = "serve_int8"

@@ -947,8 +947,11 @@ def _memoized_step(model, attr, key, factory, maxsize=None):
     """Per-model step memoization: jax.jit's compile cache keys on the
     function object, so a fresh step per generate() call would recompile
     every request (review finding). On a hit, the step re-reads the model's
-    CURRENT weights. ``maxsize`` evicts oldest entries (insertion order)
-    for caches whose key space is unbounded (per-request lengths)."""
+    CURRENT weights. ``maxsize`` evicts the LEAST-RECENTLY-USED entry for
+    caches whose key space is unbounded (per-request lengths): a hit
+    re-inserts its key at the back, so a working set that cycles through
+    many keys per request (the chunked-prefill suffix programs) keeps its
+    hot programs instead of evicting in insertion order."""
     cache = model.__dict__.get(attr)
     if cache is None:
         cache = {}
@@ -960,6 +963,9 @@ def _memoized_step(model, attr, key, factory, maxsize=None):
             cache.pop(next(iter(cache)))
         cache[key] = step
     else:
+        if maxsize is not None:
+            cache.pop(key)
+            cache[key] = step
         step._state = dict(model.functional_state())
     return step
 

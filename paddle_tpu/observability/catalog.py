@@ -47,7 +47,8 @@ SERVING_DECODE_STEP = _R.histogram(
 
 SERVING_REQUESTS = _R.counter(
     "serving_requests_total",
-    "Lifetime request events (event=admitted|finished|cancelled)",
+    "Lifetime request events "
+    "(event=admitted|finished|cancelled|rejected)",
     labels=("engine", "event"))
 
 SERVING_TOKENS = _R.counter(
@@ -65,6 +66,13 @@ SERVING_PREFIX_PAGES = _R.counter(
     "serving_prefix_cache_pages_reused_total",
     "KV pages copied from an active slot instead of recomputed",
     labels=("engine",))
+
+SERVING_SCHED = _R.counter(
+    "serving_sched_decisions_total",
+    "Scheduler decisions on the serving hot loop "
+    "(decision=chunk|preempt|restore) — each one is also a sched.* "
+    "flight-recorder event carrying the full context",
+    labels=("engine", "decision"))
 
 SERVING_ACTIVE_SLOTS = _R.gauge(
     "serving_active_slots",

@@ -156,6 +156,23 @@ EV_AUTOSHARD = _register(
     "feasible, cost, per_device_bytes, reshard_bytes, plans_considered, "
     "assignment) — the full plan + rejected ledger ride the "
     "PreflightReport")
+EV_SCHED_CHUNK = _register(
+    "sched.chunk",
+    "the scheduler advanced one prefill chunk for an admitted request "
+    "(rid, engine, slot, pos, tokens, final, seconds) — between chunks "
+    "live slots run a normal decode step, so pos traces the bounded-"
+    "stall interleave")
+EV_SCHED_PREEMPT = _register(
+    "sched.preempt",
+    "the scheduler evicted a low-priority slot's KV pages to host "
+    "memory and requeued the request with its generated tokens intact "
+    "(rid, engine, slot, kv_len, generated, bytes, priority, "
+    "by_priority)")
+EV_SCHED_RESTORE = _register(
+    "sched.restore",
+    "a preempted request re-took a slot: its host-side KV bundle was "
+    "scattered back into the page pool and decode resumed (rid, engine, "
+    "slot, kv_len, generated)")
 EV_LOCK_ORDER = _register(
     "lock.order_violation",
     "the runtime lock-order witness (FLAGS_lock_witness) observed an "

@@ -18,8 +18,10 @@ rows, immediately refill their slots. Ragged-ness is first-class because
 """
 from __future__ import annotations
 
+import math
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Deque, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +34,26 @@ from .tensor_class import Tensor, unwrap
 from .framework import random as _random
 from .generation import (_get_prefill_step, _get_select_decode,
                          _get_select_decode_rows, _memoized_step)
+
+
+#: default priority class — lower value is MORE important. 0 is the
+#: interactive tier, 1 the default, 2+ batch/background traffic.
+PRIORITY_DEFAULT = 1
+
+
+class QueueFull(RuntimeError):
+    """Typed admission rejection: the bounded queue (``max_queue``) is at
+    capacity and no slot is free. The HTTP front-end maps it to
+    ``429 Too Many Requests`` + ``Retry-After``; the cluster router
+    treats a worker's 429 as placement feedback (skip the worker, try
+    another) rather than a failover."""
+
+    def __init__(self, engine: str, depth: int, max_queue: int,
+                 retry_after_s: float = 1.0):
+        super().__init__(
+            f"{engine} engine admission queue is full "
+            f"({depth}/{max_queue} queued, no free slot); retry later")
+        self.retry_after_s = float(retry_after_s)
 
 
 def _page_tiles(buf, page_size):
@@ -48,11 +70,12 @@ class _Request:
                  "on_token", "on_token_arity", "pixel_values",
                  "stop_token_ids", "logprobs", "want_logprobs",
                  "encoder_input", "seed_ids", "t_enqueue", "t_admit",
-                 "t_last", "span", "queue_span", "handoff")
+                 "t_last", "span", "queue_span", "handoff",
+                 "priority", "deadline", "resume", "n_preempted")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
                  on_token=None, pixel_values=None, stop_token_ids=None,
-                 want_logprobs=False):
+                 want_logprobs=False, priority=None, slo_ms=None):
         self.rid = rid
         self.ids = np.asarray(ids).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -82,6 +105,14 @@ class _Request:
         self.encoder_input = None   # Seq2SeqBatchEngine payload
         self.seed_ids = None        # Seq2SeqBatchEngine decoder prompt
         self.handoff = None         # prefilled-KV bundle (disaggregated tier)
+        # SLO-aware scheduling: priority class (lower = more important)
+        # and an absolute deadline derived from the per-request SLO —
+        # the admission queue orders on (aged priority, deadline, rid)
+        self.priority = PRIORITY_DEFAULT if priority is None else int(priority)
+        self.deadline = (self.t_enqueue + float(slo_ms) / 1000.0
+                         if slo_ms is not None else math.inf)
+        self.resume = None          # host-side KV bundle after a preemption
+        self.n_preempted = 0
         # streaming callbacks may take (rid, tok, done) or a 4th logprob
         # arg; arity detected once at admission by counting REQUIRED
         # positional parameters only (a defaulted 4th param keeps the
@@ -125,6 +156,12 @@ class _RequestBookkeeping:
     # a full-length request traces O(tokens / N) spans, not O(tokens)
     trace_decode_every = 16
 
+    # starvation bound for priority admission: a queued request's
+    # effective class improves by one per aging_s waited, so any request
+    # is admitted within (priority * aging_s) of a continuous
+    # higher-priority stream. 0 disables aging (strict classes).
+    aging_s = 0.0
+
     def _init_bookkeeping(self, engine: str):
         """One init for queue/finish state, lifetime counters, and the
         registry children (bound once here — no per-token label lookups
@@ -138,10 +175,15 @@ class _RequestBookkeeping:
         # dict would grow with lifetime request count)
         self._finished_reason: Dict[int, str] = {}
         self._finished_logprobs: Dict[int, list] = {}
-        self._reason_order: List[int] = []
+        # deque: retirement trims from the FRONT every finish/cancel —
+        # list.pop(0) would be O(window) per retired request at high
+        # churn once the window is full
+        self._reason_order: Deque[int] = deque()
         self._n_requests = 0
         self._n_finished = 0
         self._n_cancelled = 0
+        self._n_rejected = 0
+        self._n_preempted = 0
         self._n_tokens = 0
         self._n_steps = 0
         self._m_queue_wait = _metrics.SERVING_QUEUE_WAIT.labels(engine=engine)
@@ -156,12 +198,36 @@ class _RequestBookkeeping:
             engine=engine, event="finished")
         self._m_req_cancelled = _metrics.SERVING_REQUESTS.labels(
             engine=engine, event="cancelled")
+        self._m_req_rejected = _metrics.SERVING_REQUESTS.labels(
+            engine=engine, event="rejected")
         self._m_active = _metrics.SERVING_ACTIVE_SLOTS.labels(engine=engine)
         self._m_depth = _metrics.SERVING_QUEUE_DEPTH.labels(engine=engine)
 
     @property
     def num_active(self) -> int:
         return sum(r is not None for r in self._slots)
+
+    # ---- priority admission (SLO-aware scheduling) ----------------------
+    def _sched_key(self, req: _Request, now: float):
+        """Admission order: (aged priority class, deadline, rid). Aging
+        subtracts one class per aging_s waited (starvation bound);
+        within a class the earliest SLO deadline wins (EDF), and rid
+        keeps same-class same-deadline traffic FIFO. With every request
+        at the default priority and no SLOs this IS pop(0)."""
+        eff = req.priority
+        if self.aging_s > 0:
+            eff -= int((now - req.t_enqueue) / self.aging_s)
+        return (eff, req.deadline, req.rid)
+
+    def _peek_next(self, now: float) -> Optional[_Request]:
+        if not self._queue:
+            return None
+        return min(self._queue, key=lambda r: self._sched_key(r, now))
+
+    def _pop_next(self, now: float) -> _Request:
+        req = self._peek_next(now)
+        self._queue.remove(req)
+        return req
 
     def stats(self) -> dict:
         """Engine observability: lifetime counters + current occupancy
@@ -177,8 +243,11 @@ class _RequestBookkeeping:
             "requests_admitted": self._n_requests,
             "requests_finished": self._n_finished,
             "requests_cancelled": self._n_cancelled,
+            "requests_rejected": self._n_rejected,
+            "requests_preempted": self._n_preempted,
             "requests_active": active,
             "requests_queued": queued,
+            "requests_prefilling": len(getattr(self, "_chunking", ())),
             "decode_steps": self._n_steps,
             "tokens_generated": self._n_tokens,
             "slot_utilization": (active / self.max_batch
@@ -199,12 +268,17 @@ class _RequestBookkeeping:
                 "generated": len(r.tokens),
                 "max_new_tokens": r.max_new_tokens,
                 "slot": s,
+                "priority": r.priority,
             })
         return {
             "engine": self._engine_label,
             "max_batch": self.max_batch,
             "slots": slots,
             "queue": [r.rid for r in self._queue],
+            "prefilling": {
+                s: {"rid": st.req.rid, "pos": st.pos,
+                    "prompt_tokens": int(st.req.ids.size)}
+                for s, st in getattr(self, "_chunking", {}).items()},
             "poisoned": bool(getattr(self, "_poisoned", False)),
             "prefix_pages_reused": self.prefix_pages_reused,
             "stats": self.stats(),
@@ -273,7 +347,8 @@ class _RequestBookkeeping:
                        engine=self._engine_label, slot=slot,
                        queue_wait_s=(req.t_admit - req.t_enqueue
                                      if req.t_admit is not None else None),
-                       free_slots=self._slots.count(None))
+                       free_slots=(self._slots.count(None)
+                                   - len(getattr(self, "_chunking", ()))))
         if req.queue_span is not None:
             req.queue_span.end()
             req.queue_span = None
@@ -347,6 +422,22 @@ class _RequestBookkeeping:
                 self._trace_end(req, "cancelled")
                 self._admit()     # the freed slot can refill immediately
                 return True
+        # a request mid chunked-prefill holds a RESERVED slot (not yet in
+        # _slots): drop the chunk state so the slot frees immediately
+        for s, st in list(getattr(self, "_chunking", {}).items()):
+            if st.req.rid == rid:
+                del self._chunking[s]
+                self._lengths = self._lengths.at[s].set(0)
+                if st.span is not None:
+                    st.span.end("cancelled")
+                if rec.enabled:
+                    rec.record(_frec.EV_CANCEL, rid=rid,
+                               engine=self._engine_label,
+                               where="prefilling")
+                self._record_reason(rid, "cancelled")
+                self._trace_end(st.req, "cancelled")
+                self._admit()
+                return True
         return False
 
     def _record_reason(self, rid: int, reason: str, logprobs=None):
@@ -361,9 +452,25 @@ class _RequestBookkeeping:
             self._finished_logprobs[rid] = logprobs
         self._reason_order.append(rid)
         while len(self._reason_order) > _REASON_KEEP:
-            old = self._reason_order.pop(0)
+            old = self._reason_order.popleft()
             self._finished_reason.pop(old, None)
             getattr(self, "_finished_logprobs", {}).pop(old, None)
+
+
+class _ChunkState:
+    """A request mid chunked-prefill: it has RESERVED a slot (invisible
+    to _free_slot) but is not yet decoding — ``pos`` tokens of its prompt
+    are already in the slot's pages, the rest lands one chunk per engine
+    step with a normal decode dispatch in between."""
+
+    __slots__ = ("req", "slot", "pos", "t_admit", "span")
+
+    def __init__(self, req: _Request, slot: int, t_admit: float, span=None):
+        self.req = req
+        self.slot = slot
+        self.pos = 0          # prompt tokens already prefilled (page-aligned)
+        self.t_admit = t_admit
+        self.span = span      # the serving.prefill span, open across chunks
 
 
 class ContinuousBatchEngine(_RequestBookkeeping):
@@ -429,9 +536,23 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                  eos_token_id: Optional[int] = None, do_sample: bool = False,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
                  enable_prefix_cache: bool = False,
-                 preflight: bool = False):
+                 preflight: bool = False,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 enable_preemption: bool = False,
+                 aging_s: float = 5.0):
         if max_len % page_size != 0:
             raise ValueError("max_len must be a multiple of page_size")
+        if prefill_chunk_tokens is not None:
+            prefill_chunk_tokens = int(prefill_chunk_tokens)
+            if (prefill_chunk_tokens <= 0
+                    or prefill_chunk_tokens % page_size != 0):
+                raise ValueError(
+                    f"prefill_chunk_tokens must be a positive multiple of "
+                    f"page_size ({page_size}), got {prefill_chunk_tokens} "
+                    "— later chunks continue at page-aligned positions")
+        if max_queue is not None and int(max_queue) < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
         if preflight:
             # model-load gate: fail fast with a findings report (raises
             # PreflightError) instead of crashing in compile or OOMing
@@ -482,6 +603,26 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._slots: List[Optional[_Request]] = [None] * max_batch
         self._init_bookkeeping("decoder")
 
+        # ---- SLO-aware scheduling ---------------------------------------
+        # chunked prefill: admission prefill lands prefill_chunk_tokens at
+        # a time (None = whole prompt at once, the monolithic path);
+        # between chunks step() runs a normal decode dispatch so a live
+        # slot's worst inter-token stall is one chunk-step
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        self.max_queue = None if max_queue is None else int(max_queue)
+        if enable_preemption and self._latent_mode:
+            raise ValueError(
+                "enable_preemption requires the paged KV layout — the "
+                "latent (MLA) compressed rows have no host eviction path")
+        self.enable_preemption = bool(enable_preemption)
+        self.aging_s = float(aging_s)
+        # slot -> _ChunkState: requests mid chunked-prefill (slot
+        # reserved, not yet decoding); insertion order is service order
+        self._chunking: Dict[int, _ChunkState] = {}
+        self._m_sched = {
+            d: _metrics.SERVING_SCHED.labels(engine="decoder", decision=d)
+            for d in ("chunk", "preempt", "restore")}
+
         # ---- automatic prefix caching (vLLM-style, opt-in) --------------
         # At admission, the longest page-aligned token prefix shared with a
         # still-ACTIVE slot's prompt is COPIED from that slot's pages
@@ -502,7 +643,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                     temperature=None, top_k=None, top_p=None,
                     on_token=None, pixel_values=None,
                     stop_token_ids=None, logprobs=False,
-                    trace_ctx=None) -> int:
+                    trace_ctx=None, priority=None, slo_ms=None) -> int:
         """Queue one request. Sampling knobs default to the engine-level
         configuration; any per-request override routes decoding through the
         per-row sampling program (one compiled step serves the whole mix).
@@ -523,7 +664,15 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         admission merges projected image features into the placeholder
         positions (model.merge_multimodal) and prefills over embeddings;
         decode is ordinary token traffic, so text and image requests batch
-        in-flight together."""
+        in-flight together.
+
+        ``priority`` (int, lower = more important; default
+        ``PRIORITY_DEFAULT``) and ``slo_ms`` (per-request latency target)
+        drive the SLO-aware admission order — see docs/SERVING.md
+        "Scheduling & SLOs". With ``max_queue`` configured, a request
+        that would wait behind a full queue raises :class:`QueueFull`
+        (the HTTP 429 path) instead of growing the backlog unboundedly."""
+        self._check_queue_bound()
         ids = np.asarray(unwrap(ids) if isinstance(ids, Tensor) else ids).reshape(-1)
         if ids.size + max_new_tokens > self.max_len:
             raise ValueError(
@@ -571,7 +720,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         req = _Request(rid, ids, max_new_tokens, sampling,
                        on_token, pixel_values=pixel_values,
                        stop_token_ids=stop_token_ids,
-                       want_logprobs=logprobs)
+                       want_logprobs=logprobs, priority=priority,
+                       slo_ms=slo_ms)
         # trace_ctx: inbound (trace_id, parent_span_id) — the HTTP
         # layer's parsed W3C traceparent — parents this request's root
         # span so the caller's trace continues through the engine
@@ -580,6 +730,18 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._fr_submit(req)
         self._admit()
         return rid
+
+    def _check_queue_bound(self):
+        """Bounded admission: reject (typed, counted) when the queue is
+        at max_queue AND no slot is free — a request that would be
+        admitted immediately never bounces off the bound."""
+        if (self.max_queue is not None
+                and len(self._queue) >= self.max_queue
+                and self._free_slot() < 0):
+            self._n_rejected += 1
+            self._m_req_rejected.inc()
+            raise QueueFull(self._engine_label, len(self._queue),
+                            self.max_queue)
 
     def _merge_sampling(self, do_sample, temperature, top_k, top_p):
         """Per-request sampling tuple: engine defaults overlaid with the
@@ -644,13 +806,16 @@ class ContinuousBatchEngine(_RequestBookkeeping):
     def admit_prefilled(self, handoff: dict, max_new_tokens: int = 64,
                         do_sample=None, temperature=None, top_k=None,
                         top_p=None, on_token=None, stop_token_ids=None,
-                        logprobs=False, trace_ctx=None) -> int:
+                        logprobs=False, trace_ctx=None, priority=None,
+                        slo_ms=None) -> int:
         """Queue a request whose prefill already happened on a PEER
         engine (``export_prefill`` over the same weights): admission
         scatters the bundle's KV buffers straight into the slot's pages
         and decoding starts from the shipped last-logit row — the decode
-        half of the disaggregated tier. Sampling / stop / logprobs knobs
-        mirror ``add_request`` (they are decode-side concerns)."""
+        half of the disaggregated tier. Sampling / stop / logprobs /
+        priority / SLO knobs mirror ``add_request`` (they are decode-side
+        concerns)."""
+        self._check_queue_bound()
         if self._latent_mode:
             raise NotImplementedError(
                 "KV handoff is not supported in latent (MLA) mode")
@@ -678,7 +843,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._n_requests += 1
         self._m_req_admitted.inc()
         req = _Request(rid, ids, max_new_tokens, sampling, on_token,
-                       stop_token_ids=stop_token_ids, want_logprobs=logprobs)
+                       stop_token_ids=stop_token_ids, want_logprobs=logprobs,
+                       priority=priority, slo_ms=slo_ms)
         req.handoff = handoff
         self._trace_submit(req, trace_ctx)
         self._queue.append(req)
@@ -722,12 +888,19 @@ class ContinuousBatchEngine(_RequestBookkeeping):
     def step(self) -> Dict[int, np.ndarray]:
         """Decode ONE token for every active slot (sample + forward fused
         into a single device dispatch); returns newly finished requests
-        {rid: generated ids}."""
+        {rid: generated ids}.
+
+        With chunked prefill enabled, each step advances AT MOST one
+        prefill chunk before the decode dispatch — a long prompt lands
+        over many steps while live slots keep producing tokens, so the
+        worst inter-token stall is one chunk-step instead of one full
+        prefill."""
         if self._poisoned:
             raise RuntimeError(
                 "ContinuousBatchEngine: a failed admission invalidated the "
                 "page pool; rebuild the engine and resubmit requests")
         self._admit()
+        self._advance_chunk()
         if self.num_active == 0:
             return self._drain_finished()
         t_dispatch = time.perf_counter()
@@ -808,9 +981,24 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             if finished:
                 retiring.append(s)
         active = np.array([r is not None for r in self._slots])
-        self._lengths = jnp.where(jnp.asarray(active),
-                                  self._lengths + 1,
-                                  jnp.zeros_like(self._lengths))
+        if self._chunking:
+            # mid-chunk slots HOLD their position: the fixed-shape decode
+            # dispatch wrote a throwaway token's KV at lengths[slot], and
+            # keeping lengths there parks that garbage exactly where the
+            # next chunk's scatter overwrites it (resetting to 0 would
+            # park it in page 0 — INSIDE the prefix the next chunk
+            # gathers)
+            hold = np.zeros(self.max_batch, bool)
+            for s in self._chunking:
+                hold[s] = True
+            self._lengths = jnp.where(
+                jnp.asarray(active), self._lengths + 1,
+                jnp.where(jnp.asarray(hold), self._lengths,
+                          jnp.zeros_like(self._lengths)))
+        else:
+            self._lengths = jnp.where(jnp.asarray(active),
+                                      self._lengths + 1,
+                                      jnp.zeros_like(self._lengths))
         for s in retiring:
             req = self._slots[s]
             self._finished[req.rid] = np.asarray(req.tokens, np.int64)
@@ -839,7 +1027,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
     def run_until_done(self, max_steps: Optional[int] = None) -> Dict[int, np.ndarray]:
         out: Dict[int, np.ndarray] = {}
         steps = 0
-        while self._queue or self.num_active:
+        while self._queue or self.num_active or self._chunking:
             out.update(self.step())
             steps += 1
             if max_steps is not None and steps >= max_steps:
@@ -854,7 +1042,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
 
     def _free_slot(self) -> int:
         for s, r in enumerate(self._slots):
-            if r is None:
+            if r is None and s not in self._chunking:
                 return s
         return -1
 
@@ -872,14 +1060,37 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 "ContinuousBatchEngine: a failed admission invalidated the "
                 "page pool; rebuild the engine and resubmit requests")
         while self._queue:
+            now = time.perf_counter()
             slot = self._free_slot()
             if slot < 0:
-                return
-            req = self._queue.pop(0)
+                # page pressure: a strictly-higher-priority queued request
+                # may evict a low-priority slot's KV to host memory
+                if not self._maybe_preempt(now):
+                    return
+                slot = self._free_slot()
+                if slot < 0:
+                    return
+            req = self._pop_next(now)
             t_adm = time.perf_counter()
             self._observe_admission(req, t_adm)
             self._trace_admit(req, slot)
             tracer = _tracing.get_tracer()
+            if req.resume is not None:
+                # a preempted request re-takes a slot: scatter the host
+                # KV bundle back, no model forward runs
+                with tracer.span(_tracing.SPAN_PREFILL, parent=req.span,
+                                 attrs={"slot": slot, "restore": True}):
+                    self._restore_into(slot, req)
+                with tracer.use(req.span):
+                    self._m_prefill.observe(time.perf_counter() - t_adm)
+                self._slots[slot] = req
+                req.slot = slot
+                self._fr_page_pressure()
+                continue
+            if self._start_chunked(slot, req, t_adm):
+                # slot reserved; chunks advance one per step() so live
+                # decodes keep flowing — see _advance_chunk
+                continue
             with tracer.span(_tracing.SPAN_PREFILL, parent=req.span,
                              attrs={"slot": slot,
                                     "prompt_tokens": int(req.ids.size)}):
@@ -889,6 +1100,195 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             self._slots[slot] = req
             req.slot = slot
             self._fr_page_pressure()
+
+    # ---- preemption: KV eviction to host, restore on re-admission -------
+    def _maybe_preempt(self, now: float) -> bool:
+        """Under a full pool, evict the least-important active slot's KV
+        pages to host memory when a STRICTLY more important request is
+        queued (raw priority classes — aging never triggers a
+        preemption, or same-class traffic would thrash). Returns True if
+        a slot was freed."""
+        if not self.enable_preemption or not self._queue:
+            return False
+        cand = self._peek_next(now)
+        victim_slot, victim_key = -1, None
+        for s, r in enumerate(self._slots):
+            if r is None or r.priority <= cand.priority:
+                continue
+            # least important first; within a class the most recently
+            # admitted loses (older work keeps its progress)
+            key = (r.priority, r.t_admit if r.t_admit is not None else now)
+            if victim_key is None or key > victim_key:
+                victim_slot, victim_key = s, key
+        if victim_slot < 0:
+            return False
+        self._preempt_slot(victim_slot, by=cand)
+        return True
+
+    def _preempt_slot(self, s: int, by: Optional[_Request] = None):
+        """Evict slot ``s``: serialize its KV pages + last-logit row to a
+        host-side bundle (the np.asarray reads ARE the deliberate
+        device->host transfer — this is the eviction), free the slot, and
+        requeue the request with its generated tokens intact. A later
+        _restore_into scatters the bundle back and decode resumes
+        token-identically."""
+        req = self._slots[s]
+        ps = self.page_size
+        kv_len = int(req.ids.size) + len(req.tokens)
+        bucket = self._bucket(kv_len)
+        n_pages = bucket // ps
+        base = s * self._pages_per_slot
+        layers = []
+        nbytes = 0
+        for c in self._caches:
+            pair = []
+            for key in ("k_pages", "v_pages"):
+                tiles = np.asarray(c[key][:, base:base + n_pages])
+                hk, n, _, d = tiles.shape
+                dense = np.moveaxis(tiles, 0, 2).reshape(n * ps, hk, d)
+                nbytes += dense.nbytes
+                pair.append(dense)
+            layers.append(tuple(pair))
+        last_row = np.asarray(self._last[s]).astype(np.float32)
+        req.resume = {"bucket": bucket, "kv_len": kv_len,
+                      "layers": layers, "last": last_row}
+        req.n_preempted += 1
+        self._n_preempted += 1
+        self._slots[s] = None
+        self._lengths = self._lengths.at[s].set(0)
+        req.slot = -1
+        self._queue.append(req)
+        self._m_sched["preempt"].inc()
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_SCHED_PREEMPT, rid=req.rid,
+                       engine=self._engine_label, slot=s, kv_len=kv_len,
+                       generated=len(req.tokens), bytes=nbytes,
+                       priority=req.priority,
+                       by_priority=(by.priority if by is not None
+                                    else None))
+
+    def _restore_into(self, slot: int, req: _Request):
+        """Re-admission of a preempted request: scatter its host KV
+        bundle back into the slot's pages (same jitted page scatter as a
+        handoff admission) and seed sampling from the saved last-logit
+        row — decode continues exactly where eviction stopped."""
+        r, req.resume = req.resume, None
+        bucket, kv_len = int(r["bucket"]), int(r["kv_len"])
+        c_new = [{"k": jnp.asarray(k)[None], "v": jnp.asarray(v)[None]}
+                 for k, v in r["layers"]]
+        base = slot * self._pages_per_slot
+        pages = [(c["k_pages"], c["v_pages"]) for c in self._caches]
+        try:
+            new_pages = self._scatter_fn(bucket)(
+                pages, c_new, jnp.asarray(base, jnp.int32))
+        except Exception as e:
+            self._poisoned = True
+            raise RuntimeError(
+                "ContinuousBatchEngine: preemption restore failed after "
+                "the page pool was donated; rebuild the engine and "
+                "resubmit in-flight requests") from e
+        for c_eng, (kp, vp) in zip(self._caches, new_pages):
+            c_eng["k_pages"], c_eng["v_pages"] = kp, vp
+        self._last = self._last.at[slot].set(
+            jnp.asarray(r["last"], jnp.float32))
+        self._lengths = self._lengths.at[slot].set(kv_len)
+        self._m_sched["restore"].inc()
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_SCHED_RESTORE, rid=req.rid,
+                       engine=self._engine_label, slot=slot,
+                       kv_len=kv_len, generated=len(req.tokens))
+
+    # ---- chunked prefill: admission interleaved with decode -------------
+    def _start_chunked(self, slot: int, req: _Request,
+                       t_adm: float) -> bool:
+        """Reserve ``slot`` for a chunked admission when the prompt is
+        longer than one chunk. Handoff/restore admissions carry no local
+        prefill and multimodal prompts prefill over merged embeddings
+        (no token suffix to continue from) — those stay monolithic."""
+        ct = self.prefill_chunk_tokens
+        if (ct is None or req.handoff is not None
+                or req.pixel_values is not None
+                or int(req.ids.size) <= ct):
+            return False
+        span = None
+        tracer = _tracing.get_tracer()
+        if tracer.enabled:
+            span = tracer.start_span(
+                _tracing.SPAN_PREFILL, parent=req.span,
+                attrs={"slot": slot, "chunked": True,
+                       "prompt_tokens": int(req.ids.size)})
+        self._chunking[slot] = _ChunkState(req, slot, t_adm, span)
+        req.slot = slot
+        return True
+
+    def _advance_chunk(self) -> bool:
+        """Advance ONE prefill chunk for the oldest reserved slot (FIFO —
+        a single prefill in flight keeps the stall bound at one
+        chunk-step). The first chunk seeds the cache via the bucketed
+        prefill (or the shared-prefix path on a prefix-cache hit); later
+        chunks reuse the suffix-prefill programs with src == dst. The
+        final chunk publishes the slot: lengths set, request active."""
+        if not self._chunking:
+            return False
+        slot, st = next(iter(self._chunking.items()))
+        req = st.req
+        ps = self.page_size
+        S0 = int(req.ids.size)
+        ct = self.prefill_chunk_tokens
+        t0 = time.perf_counter()
+        if st.pos == 0:
+            src, n_pref = (-1, 0)
+            if self.enable_prefix_cache:
+                with _tracing.get_tracer().use(st.span):
+                    src, n_pref = self._find_shared_prefix(req)
+            if n_pref > 0:
+                # prefix pages copy from the ACTIVE source slot and the
+                # first chunk of the remaining suffix runs the model —
+                # one fused dispatch, identical to a prefix admission
+                pref_len = n_pref * ps
+                take = min(ct, S0 - pref_len)
+                self._run_suffix_chunk(slot, src, n_pref,
+                                       req.ids[pref_len:pref_len + take])
+                self.prefix_pages_reused += n_pref
+                self._m_prefix_pages.inc(n_pref)
+                st.pos = pref_len + take
+            else:
+                take = min(ct, S0)
+                first = _Request(-1, req.ids[:take], 0)
+                last, caches, _, bucket = self._bucketed_prefill(first)
+                self._scatter_prefill(slot, last, caches, bucket)
+                st.pos = take
+        else:
+            take = min(ct, S0 - st.pos)
+            self._run_suffix_chunk(slot, slot, st.pos // ps,
+                                   req.ids[st.pos:st.pos + take])
+            st.pos += take
+        done = st.pos >= S0
+        if not done:
+            # park the reserved slot's length AT the chunk frontier: the
+            # interleaved decode dispatch writes a throwaway token's KV
+            # at lengths[slot], and the next chunk's scatter starts
+            # exactly there — the garbage never survives into a gather
+            self._lengths = self._lengths.at[slot].set(st.pos)
+        self._m_sched["chunk"].inc()
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_SCHED_CHUNK, rid=req.rid,
+                       engine=self._engine_label, slot=slot, pos=st.pos,
+                       tokens=int(take), final=done,
+                       seconds=time.perf_counter() - t0)
+        if done:
+            del self._chunking[slot]
+            self._lengths = self._lengths.at[slot].set(S0)
+            self._slots[slot] = req
+            if st.span is not None:
+                st.span.end()
+            with _tracing.get_tracer().use(req.span):
+                self._m_prefill.observe(time.perf_counter() - st.t_admit)
+            self._fr_page_pressure()
+        return True
 
     def _fr_page_pressure(self):
         """Sample kv page-pool pressure into the flight recorder after an
@@ -1073,16 +1473,16 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         # rope_len-row cos/sin table. The pref_len + sb <= max_len compile
         # invariant already keeps any cross-engine reuse inside the baked
         # table, but keying on max_len makes reuse impossible by
-        # construction rather than by invariant
+        # construction rather than by invariant. maxsize sized for
+        # chunked prefill: every chunk position is its own (n_pref, sb)
+        # program, O(max_len / chunk) of them, LRU-kept across admissions
         return _memoized_step(self.model, "_suffix_prefill_fns",
                               (n_pref, sb, ps, self.max_len), build,
-                              maxsize=16)
+                              maxsize=64)
 
     def _prefill_with_prefix(self, slot: int, req: _Request, src: int,
                              n_pref: int):
-        self._run_prefix_admission(
-            slot, req, src, n_pref, self._suffix_prefill_fn,
-            ("k_pages", "v_pages"), self._pages_per_slot, "page pool")
+        self._run_prefix_admission(slot, req, src, n_pref)
 
     def _latent_suffix_prefill_fn(self, n_pref: int, sb: int):
         """Jitted, buffer-DONATING prefix-cached admission for the latent
@@ -1148,26 +1548,35 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             fn._state = None  # _memoized_step refresh hook (state is an arg)
             return fn
 
-        # max_len in the key: same defensive reasoning as
-        # _suffix_prefill_fn
+        # max_len in the key: same defensive reasoning (and chunk-sized
+        # maxsize) as _suffix_prefill_fn
         return _memoized_step(self.model, "_latent_suffix_prefill_fns",
                               (n_pref, sb, ps, self.max_len), build,
-                              maxsize=16)
+                              maxsize=64)
 
-    def _run_prefix_admission(self, slot, req, src, n_pref, get_fn,
-                              buf_keys, idx_scale, poison_what):
-        """Shared prefix-cached admission wrapper (paged and latent modes
-        differ only in buffer keys, the jitted fn, and index scaling):
-        suffix bucketing, the donation-failure poisoning protocol, and
-        the slot bookkeeping live HERE once."""
+    def _run_suffix_chunk(self, slot: int, src: int, n_pref: int, suf):
+        """ONE suffix-prefill dispatch: copy ``n_pref`` prefix pages/rows
+        from slot ``src`` (== ``slot`` for a chunked-prefill
+        continuation), run the model over ``suf`` at pos = n_pref *
+        page_size, and scatter prefix + suffix into ``slot``. The shared
+        core of prefix-cached admission AND chunk advancement — both
+        layouts (paged and latent), the donation-failure poisoning
+        protocol, and the last-logit update live HERE once. Does NOT set
+        _lengths (callers publish the slot when the prompt completes)."""
         ps = self.page_size
-        S0 = int(req.ids.size)
         pref_len = n_pref * ps
-        suf = req.ids[pref_len:]
+        suf = np.asarray(suf).reshape(-1)
         sb = min(self._bucket(int(suf.size)), self.max_len - pref_len)
         ids = np.zeros((1, sb), np.int32)
         ids[0, :suf.size] = suf
-        fn = get_fn(n_pref, sb)
+        if self._latent_mode:
+            fn = self._latent_suffix_prefill_fn(n_pref, sb)
+            buf_keys, idx_scale = ("c_kv", "k_pe"), 1
+            poison_what = "latent buffer pool"
+        else:
+            fn = self._suffix_prefill_fn(n_pref, sb)
+            buf_keys, idx_scale = ("k_pages", "v_pages"), self._pages_per_slot
+            poison_what = "page pool"
         bufs = [tuple(c[k] for k in buf_keys) for c in self._caches]
         try:
             last, new_bufs = fn(
@@ -1178,22 +1587,27 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         except Exception as e:
             self._poisoned = True
             raise RuntimeError(
-                f"ContinuousBatchEngine: prefix-cached admission failed "
+                f"ContinuousBatchEngine: suffix prefill failed "
                 f"after the {poison_what} was donated; rebuild the engine "
                 f"and resubmit in-flight requests") from e
         for c_eng, new in zip(self._caches, new_bufs):
             for k, v in zip(buf_keys, new):
                 c_eng[k] = v
         self._last = self._last.at[slot].set(last[0].astype(jnp.float32))
+
+    def _run_prefix_admission(self, slot, req, src, n_pref):
+        """Prefix-cached MONOLITHIC admission: prefix copy + the whole
+        remaining suffix in one dispatch, then publish the slot."""
+        S0 = int(req.ids.size)
+        self._run_suffix_chunk(slot, src, n_pref,
+                               req.ids[n_pref * self.page_size:])
         self._lengths = self._lengths.at[slot].set(S0)
         self.prefix_pages_reused += n_pref
         self._m_prefix_pages.inc(n_pref)
 
     def _prefill_with_prefix_latent(self, slot: int, req: _Request,
                                     src: int, n_pref: int):
-        self._run_prefix_admission(
-            slot, req, src, n_pref, self._latent_suffix_prefill_fn,
-            ("c_kv", "k_pe"), 1, "latent buffer pool")
+        self._run_prefix_admission(slot, req, src, n_pref)
 
     def _latent_scatter_fn(self, bucket: int):
         """Jitted, buffer-DONATING scatter of one prefilled prompt's latent
@@ -1275,20 +1689,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 return self._prefill_with_prefix_latent(slot, req, src,
                                                         n_pref)
         last, caches, S0, bucket = self._bucketed_prefill(req)
-        bufs = [(c["c_kv"], c["k_pe"]) for c in self._caches]
-        try:
-            new_bufs = self._latent_scatter_fn(bucket)(
-                bufs, caches, jnp.asarray(slot, jnp.int32))
-        except Exception as e:
-            self._poisoned = True
-            raise RuntimeError(
-                "ContinuousBatchEngine: admission failed after the latent "
-                "buffers were donated; the engine's cache state is invalid "
-                "— rebuild the engine and resubmit in-flight requests"
-            ) from e
-        for c_eng, (ckv, kpe) in zip(self._caches, new_bufs):
-            c_eng["c_kv"], c_eng["k_pe"] = ckv, kpe
-        self._last = self._last.at[slot].set(last[0].astype(jnp.float32))
+        self._scatter_prefill(slot, last, caches, bucket)
         self._lengths = self._lengths.at[slot].set(S0)
 
     def _prefill_into(self, slot: int, req: _Request):
@@ -1305,27 +1706,50 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             if n_pref > 0:
                 return self._prefill_with_prefix(slot, req, src, n_pref)
         last, caches, S0, bucket = self._bucketed_prefill(req)
-
-        base = slot * self._pages_per_slot
-        pages = [(c["k_pages"], c["v_pages"]) for c in self._caches]
-        try:
-            new_pages = self._scatter_fn(bucket)(
-                pages, caches, jnp.asarray(base, jnp.int32))
-        except Exception as e:
-            # the scatter DONATES the page pool: a mid-admission failure
-            # (device OOM etc.) may have invalidated it, taking every
-            # in-flight request's KV with it — poison the engine so later
-            # calls fail with context instead of 'donated buffer deleted'
-            self._poisoned = True
-            raise RuntimeError(
-                "ContinuousBatchEngine: admission failed after the page "
-                "pool was donated; the engine's KV state is invalid — "
-                "rebuild the engine and resubmit in-flight requests"
-            ) from e
-        for c_eng, (kp, vp) in zip(self._caches, new_pages):
-            c_eng["k_pages"], c_eng["v_pages"] = kp, vp
-        self._last = self._last.at[slot].set(last[0].astype(jnp.float32))
+        self._scatter_prefill(slot, last, caches, bucket)
         self._lengths = self._lengths.at[slot].set(S0)
+
+    def _scatter_prefill(self, slot: int, last, caches, bucket: int):
+        """Scatter one bucketed prefill's caches into ``slot`` (pages or
+        latent rows) and seed its last-logit row. Shared by monolithic
+        admission and the FIRST chunk of a chunked admission — does NOT
+        set _lengths (the caller publishes the slot when the whole
+        prompt is in)."""
+        if self._latent_mode:
+            bufs = [(c["c_kv"], c["k_pe"]) for c in self._caches]
+            try:
+                new_bufs = self._latent_scatter_fn(bucket)(
+                    bufs, caches, jnp.asarray(slot, jnp.int32))
+            except Exception as e:
+                self._poisoned = True
+                raise RuntimeError(
+                    "ContinuousBatchEngine: admission failed after the "
+                    "latent buffers were donated; the engine's cache state "
+                    "is invalid — rebuild the engine and resubmit "
+                    "in-flight requests") from e
+            for c_eng, (ckv, kpe) in zip(self._caches, new_bufs):
+                c_eng["c_kv"], c_eng["k_pe"] = ckv, kpe
+        else:
+            base = slot * self._pages_per_slot
+            pages = [(c["k_pages"], c["v_pages"]) for c in self._caches]
+            try:
+                new_pages = self._scatter_fn(bucket)(
+                    pages, caches, jnp.asarray(base, jnp.int32))
+            except Exception as e:
+                # the scatter DONATES the page pool: a mid-admission
+                # failure (device OOM etc.) may have invalidated it,
+                # taking every in-flight request's KV with it — poison
+                # the engine so later calls fail with context instead of
+                # 'donated buffer deleted'
+                self._poisoned = True
+                raise RuntimeError(
+                    "ContinuousBatchEngine: admission failed after the "
+                    "page pool was donated; the engine's KV state is "
+                    "invalid — rebuild the engine and resubmit in-flight "
+                    "requests") from e
+            for c_eng, (kp, vp) in zip(self._caches, new_pages):
+                c_eng["k_pages"], c_eng["v_pages"] = kp, vp
+        self._last = self._last.at[slot].set(last[0].astype(jnp.float32))
 
 
 class Seq2SeqBatchEngine(_RequestBookkeeping):
@@ -1454,7 +1878,9 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
 
         while self._queue and None in self._slots:
             slot = self._slots.index(None)
-            req = self._queue.pop(0)
+            # same priority-queue pop as the decoder engine; with every
+            # request at the default class this is FIFO by rid
+            req = self._pop_next(time.perf_counter())
             t_adm = time.perf_counter()
             self._observe_admission(req, t_adm)
             self._trace_admit(req, slot)

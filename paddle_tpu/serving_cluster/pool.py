@@ -40,7 +40,7 @@ class WorkerInfo:
 
     __slots__ = ("replica_id", "role", "host", "port", "pid", "kv_channel",
                  "alive", "lease_age_s", "active", "queued", "pending",
-                 "probe_ok", "marked_dead_at")
+                 "probe_ok", "marked_dead_at", "busy_until")
 
     def __init__(self, replica_id: int, meta: dict):
         self.replica_id = replica_id
@@ -56,6 +56,7 @@ class WorkerInfo:
         self.pending = 0     # placements issued but not finished HERE
         self.probe_ok = False
         self.marked_dead_at: Optional[float] = None  # monotonic, router-side
+        self.busy_until = 0.0  # admission backpressure (429) backoff
 
     @property
     def url(self) -> str:
@@ -77,6 +78,7 @@ class WorkerInfo:
             "queued": self.queued,
             "pending": self.pending,
             "probe_ok": self.probe_ok,
+            "busy": self.busy_until > time.monotonic(),
         }
 
 
@@ -258,9 +260,11 @@ class WorkerPool:
         """Least-loaded live worker (optionally role-filtered), counting
         the placement into ``pending`` so concurrent placements spread;
         callers MUST ``release()`` the worker when the attempt ends."""
+        now = time.monotonic()
         with self._lock:
             live = [w for w in self._workers.values()
                     if w.alive and w.replica_id not in exclude
+                    and w.busy_until <= now
                     and (roles is None or w.role in roles)]
             if not live:
                 return None
@@ -272,6 +276,17 @@ class WorkerPool:
                                                 for x in live) + 1)))
             w.pending += 1
             return w
+
+    def mark_busy(self, replica_id: int, backoff_s: float = 0.5):
+        """Admission backpressure (a worker answered 429): take it out of
+        SELECTION for ``backoff_s`` without declaring it dead — its
+        engine is healthy, just full. Contrast mark_dead: a busy worker
+        keeps its lease, rejoins rotation by itself, and is never
+        failed over to another replica's retry budget."""
+        with self._lock:
+            w = self._workers.get(replica_id)
+            if w is not None:
+                w.busy_until = time.monotonic() + float(backoff_s)
 
     def release(self, w: WorkerInfo):
         with self._lock:

@@ -67,6 +67,21 @@ class _UpstreamError(Exception):
         self.exclude = exclude
 
 
+class _WorkerBusy(Exception):
+    """The worker answered 429 (bounded admission queue): placement
+    FEEDBACK, not a failure — skip the worker for a short backoff and
+    try another without marking it dead or burning the failover-retry
+    budget. If every worker is busy the client gets the 429 +
+    Retry-After back."""
+
+    def __init__(self, worker: WorkerInfo, body: dict,
+                 retry_after: str = "1"):
+        super().__init__(f"worker {worker.replica_id} busy")
+        self.worker = worker
+        self.body = body
+        self.retry_after = retry_after
+
+
 class _ClientGone(Exception):
     """The DOWNSTREAM client disconnected mid-relay; nothing to answer."""
 
@@ -92,6 +107,7 @@ class RouterServer:
         self._placed = 0
         self._retried = 0
         self._failed = 0
+        self._busy = 0
         self._httpd = ThreadingHTTPServer((host, port),
                                           self._make_handler())
         self._http_thread = threading.Thread(
@@ -146,6 +162,7 @@ class RouterServer:
             router_stats = {"placed": self._placed,
                             "retried": self._retried,
                             "failed": self._failed,
+                            "busy": self._busy,
                             "max_retries": self.max_retries}
         return {
             "status": "ok" if alive else "unavailable",
@@ -189,6 +206,8 @@ class RouterServer:
                 self._retried += 1
             elif outcome == "failed":
                 self._failed += 1
+            elif outcome == "busy":
+                self._busy += 1
 
     def _complete(self, handler, req):
         stream = bool(req.get("stream"))
@@ -200,6 +219,7 @@ class RouterServer:
         exclude: Tuple[int, ...] = ()
         attempts = 0
         last_reason = "no live worker available"
+        busy: Optional[_WorkerBusy] = None
         root = handler._trace_span
         while attempts <= self.max_retries:
             plan = self._plan(exclude)
@@ -248,6 +268,22 @@ class RouterServer:
                 sp.end("cancelled")
                 handler.close_connection = True
                 return
+            except _WorkerBusy as e:
+                sp.end("busy")
+                # placement FEEDBACK, not a failure: short busy backoff
+                # (not mark_dead), skip the worker this request, and do
+                # NOT burn the failover-retry budget on backpressure
+                busy = e
+                attempts -= 1
+                self.pool.mark_busy(e.worker.replica_id)
+                exclude = exclude + (e.worker.replica_id,)
+                if rec.enabled:
+                    rec.record(_frec.EV_ROUTER_RETRY,
+                               replica_id=e.worker.replica_id,
+                               attempt=attempts + 1,
+                               delivered=state["delivered"],
+                               reason="busy")
+                self._count_outcome("busy")
             except _UpstreamError as e:
                 sp.end("error")
                 last_reason = e.reason
@@ -271,6 +307,13 @@ class RouterServer:
                     self.pool.release(pre)
         # retry budget exhausted (or the pool is empty)
         self._count_outcome("failed")
+        if busy is not None and not state["headers_sent"]:
+            # every placeable worker pushed back: forward the
+            # backpressure (429 + Retry-After), never a 502 — the tier
+            # is healthy, just full
+            handler._json(429, busy.body or {"error": "all workers busy"},
+                          headers=(("Retry-After", busy.retry_after),))
+            return
         msg = (f"could not serve the request after {attempts} "
                f"placement attempt(s): {last_reason}")
         if state["headers_sent"]:
@@ -306,6 +349,8 @@ class RouterServer:
             resp = conn.getresponse()
             status = resp.status
             raw = resp.read()
+            retry_after = (resp.getheader("Retry-After") or "1"
+                           if status == 429 else None)
         except (OSError, http.client.HTTPException) as e:
             raise _UpstreamError(
                 f"worker {worker.replica_id} transport failure on "
@@ -316,6 +361,8 @@ class RouterServer:
             parsed = json.loads(raw)
         except ValueError:
             parsed = {"error": raw.decode(errors="replace")}
+        if status == 429:
+            raise _WorkerBusy(worker, parsed, retry_after)
         return status, parsed
 
     def _prefill_hop(self, pre: WorkerInfo, serve: WorkerInfo, req: dict,
@@ -368,6 +415,9 @@ class RouterServer:
                     parsed = json.loads(raw)
                 except ValueError:
                     parsed = {"error": raw.decode(errors="replace")}
+                if resp.status == 429:
+                    raise _WorkerBusy(worker, parsed,
+                                      resp.getheader("Retry-After") or "1")
                 if 400 <= resp.status < 500:
                     raise _ClientError(resp.status, parsed)
                 raise _UpstreamError(

@@ -158,6 +158,15 @@ class WorkerServer(CompletionServer):
         stop = req.get("stop_token_ids")
         if stop is not None:
             params["stop_token_ids"] = [int(s) for s in stop]
+        # SLO-aware scheduling rides the decode side: the decode worker
+        # owns the slot pool the priority/deadline queue feeds
+        if req.get("priority") is not None:
+            params["priority"] = int(req["priority"])
+        if req.get("slo_ms") is not None:
+            slo = float(req["slo_ms"])
+            if slo <= 0:
+                raise ValueError("slo_ms must be > 0")
+            params["slo_ms"] = slo
         lp_req = req.get("logprobs")
         want_logprobs = (lp_req is not None and lp_req is not False)
         if want_logprobs:

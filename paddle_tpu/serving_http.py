@@ -11,9 +11,11 @@ for paddle_tpu, stdlib-only (no web framework in the image):
   ``top_p`` (per-request sampling rides the engine's per-row program),
   ``stop_token_ids``, ``logprobs``, ``n`` (sampled sibling completions
   batch in-flight on the engine), ``stream`` (SSE chunks per token,
-  ``data: [DONE]`` terminator), and ``pixel_values`` ([n_images, C, H, W]
-  nested lists) for multimodal models — image and text requests batch
-  in-flight;
+  ``data: [DONE]`` terminator), ``priority`` / ``slo_ms`` (SLO-aware
+  admission — docs/SERVING.md "Scheduling & SLOs"), and ``pixel_values``
+  ([n_images, C, H, W] nested lists) for multimodal models — image and
+  text requests batch in-flight. A bounded engine queue (``max_queue``)
+  answers ``429 Too Many Requests`` + ``Retry-After`` when full;
 - ``GET /v1/models`` and ``GET /health``;
 - ``GET /metrics`` — Prometheus text exposition of the process-wide
   registry (``paddle_tpu.observability``): latency histograms
@@ -62,6 +64,7 @@ from .observability import PROMETHEUS_CONTENT_TYPE, get_registry
 from .observability import flightrecorder as _frec
 from .observability import tracing as _tracing
 from .observability.catalog import HTTP_REQUESTS
+from .serving import QueueFull
 
 __all__ = ["CompletionServer", "ServingHandlerBase", "serve"]
 
@@ -463,6 +466,15 @@ class CompletionServer:
                                         trace_ctx=sub.trace_ctx,
                                         **sub.params))
             sub.rid = sub.rids[0]
+        except QueueFull as e:
+            # bounded admission queue -> HTTP 429 + Retry-After; siblings
+            # of an n>1 request admitted before the bound hit are
+            # cancelled (the client sees ONE atomic rejection)
+            for rid in sub.rids:
+                eng.cancel(rid)
+            ev.put(("busy", {"error": str(e),
+                             "retry_after": max(1, round(e.retry_after_s))},
+                    True))
         except (ValueError, TypeError, NotImplementedError) as e:
             # client error (bad params, pixel_values to a
             # non-multimodal model, ...) -> HTTP 400
@@ -491,7 +503,8 @@ class CompletionServer:
                     break
                 drained = True
                 self._handle_submission(sub)
-            if eng.num_active or getattr(eng, "_queue", None):
+            if (eng.num_active or getattr(eng, "_queue", None)
+                    or getattr(eng, "_chunking", None)):
                 try:
                     eng.step()
                 except Exception:
@@ -575,6 +588,16 @@ class CompletionServer:
         stop = req.get("stop_token_ids")
         if stop is not None:
             params["stop_token_ids"] = [int(s) for s in stop]
+        # SLO-aware scheduling: priority class (lower = more important)
+        # and a per-request latency target, straight through to the
+        # engine's admission queue (docs/SERVING.md "Scheduling & SLOs")
+        if req.get("priority") is not None:
+            params["priority"] = int(req["priority"])
+        if req.get("slo_ms") is not None:
+            slo = float(req["slo_ms"])
+            if slo <= 0:
+                raise ValueError("slo_ms must be > 0")
+            params["slo_ms"] = slo
         # OpenAI "logprobs" is an int 0-5 (0 = chosen-token
         # logprobs, no alternatives) or a bool — False means
         # OFF, any other non-None value (0 included) is ON
@@ -641,6 +664,12 @@ class CompletionServer:
                 if self._stop.is_set():
                     return handler._json(500, {"error": "engine stopped"})
                 continue
+            if kind == "busy":
+                # bounded admission queue: backpressure, not failure —
+                # the client should retry after the hinted delay
+                return handler._json(
+                    429, {"error": payload["error"]},
+                    headers=(("Retry-After", str(payload["retry_after"])),))
             if kind in ("error", "fault"):
                 err = (kind, payload)
                 break
@@ -682,25 +711,47 @@ class CompletionServer:
         })
 
     def _stream(self, handler, sub, cid, want_logprobs=False):
+        # the SSE status line is DEFERRED to the first event: a rejected
+        # admission (bounded queue -> 429 + Retry-After) or a client
+        # error (-> 400) still gets a real status code instead of an
+        # error chunk inside a 200 stream. Once token bytes are on the
+        # wire, failures become in-stream error events (no [DONE]).
         try:
-            handler._begin_sse()
+            started = False
             clean = True
             while True:
                 try:
                     kind, payload, done = sub.events.get(timeout=1.0)
                 except queue.Empty:
                     if self._stop.is_set():
+                        if not started:
+                            return handler._json(
+                                500, {"error": "engine stopped"})
                         handler._chunk(b'data: '
                                        b'{"error": "engine stopped"}\n\n')
                         clean = False
                         break
                     continue
+                if kind == "busy":
+                    # admission precedes tokens, so busy only ever
+                    # arrives before the stream starts
+                    return handler._json(
+                        429, {"error": payload["error"]},
+                        headers=(("Retry-After",
+                                  str(payload["retry_after"])),))
                 if kind in ("error", "fault"):
+                    if not started:
+                        return handler._json(
+                            400 if kind == "error" else 500,
+                            {"error": str(payload)})
                     handler._chunk(b'data: {"error": '
                                    + json.dumps(str(payload)).encode()
                                    + b"}\n\n")
                     clean = False
                     break
+                if not started:
+                    handler._begin_sse()
+                    started = True
                 _rid, tok, lp = payload
                 piece = {"id": cid, "object": "text_completion",
                          "choices": [{"index": 0,

@@ -172,6 +172,24 @@ def format_lock_witness(b: dict) -> List[str]:
     return lines
 
 
+def format_sched(b: dict, last: int = 20) -> List[str]:
+    """Scheduler decisions (sched.chunk / sched.preempt / sched.restore)
+    pulled out of the timeline: the chunk/preempt/restore trail answers
+    'why did this request stall / lose its slot' at a glance. Absent
+    when the engine made no scheduler decisions."""
+    evs = [e for e in b.get("events") or []
+           if e.get("kind", "").startswith("sched.")]
+    if not evs:
+        return []
+    t_end = max(e["mono_ns"] for e in (b.get("events") or evs))
+    lines = [f"SCHEDULER DECISIONS (last {min(last, len(evs))} of "
+             f"{len(evs)})"]
+    for ev in evs[-last:]:
+        lines.append(f"  t{_rel_ms(ev, t_end):+10.1f}ms  "
+                     f"{ev['kind']:<14} {_fmt_fields(ev)}")
+    return lines
+
+
 def format_spans(b: dict, last: int = 10) -> List[str]:
     spans = b.get("spans") or []
     if not spans:
@@ -194,6 +212,7 @@ def render(b: dict, events: int = 30, per_subsystem: int = 5,
         sections.extend([
             format_timeline(b, last=events),
             format_subsystems(b, k=per_subsystem, only=subsystem),
+            format_sched(b),
             format_engines(b),
             format_spans(b),
             format_lock_witness(b),

@@ -78,6 +78,136 @@ def _stream_completion(host, port, body, on_first_token=None,
     return clean, toks, tp
 
 
+# ---- worker 429 = placement feedback ----------------------------------------
+
+class _FakePool:
+    """The WorkerPool protocol over hand-built WorkerInfo rows — enough
+    surface for RouterServer placement without a TCPStore."""
+
+    def __init__(self, workers):
+        from paddle_tpu.serving_cluster.pool import WorkerInfo
+
+        self._ws = {}
+        for rid, (host, port) in workers.items():
+            self._ws[rid] = WorkerInfo(rid, {"host": host, "port": port,
+                                             "role": "unified"})
+        self.busy_marks = []
+
+    def select(self, roles=None, exclude=()):
+        now = time.monotonic()
+        live = [w for w in self._ws.values()
+                if w.alive and w.replica_id not in exclude
+                and w.busy_until <= now]
+        if not live:
+            return None
+        w = min(live, key=lambda w: (w.score(), w.replica_id))
+        w.pending += 1
+        return w
+
+    def mark_busy(self, replica_id, backoff_s=0.5):
+        self.busy_marks.append(replica_id)
+        self._ws[replica_id].busy_until = time.monotonic() + backoff_s
+
+    def mark_dead(self, replica_id, reason="connection"):
+        self._ws[replica_id].alive = False
+
+    def release(self, w):
+        if w.pending > 0:
+            w.pending -= 1
+
+    def has_role(self, role):
+        return any(w.alive and w.role == role for w in self._ws.values())
+
+    def workers(self):
+        return [w.snapshot() for w in self._ws.values()]
+
+    def refresh_gauges(self):
+        pass
+
+
+def test_router_treats_worker_429_as_placement_feedback():
+    """A worker answering 429 (bounded admission queue) is SKIPPED — short
+    busy backoff, never marked dead, no failover-retry budget burned —
+    and the request lands on another replica. When every worker pushes
+    back, the client gets the 429 + Retry-After forwarded."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from paddle_tpu.serving_cluster.router import RouterServer
+    from paddle_tpu.serving_http import CompletionServer
+
+    class Busy(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            body = json.dumps({"error": "engine admission queue is "
+                                        "full"}).encode()
+            self.send_response(429)
+            self.send_header("Retry-After", "7")
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    busy_httpd = ThreadingHTTPServer(("127.0.0.1", 0), Busy)
+    threading.Thread(target=busy_httpd.serve_forever, daemon=True).start()
+    model = _ref_model()
+    eng = ContinuousBatchEngine(model, max_batch=4, max_len=64,
+                                page_size=8)
+    worker = CompletionServer(eng).start()
+    try:
+        # replica 0 = always-busy stub (lower replica id wins the
+        # fake pool's tie-break, so it is always tried FIRST)
+        pool = _FakePool({0: busy_httpd.server_address,
+                          1: worker.address})
+        router = RouterServer(pool, max_retries=1).start()
+        try:
+            host, port = router.address
+            prompt = [1, 2, 3, 4, 5]
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            conn.request("POST", "/v1/completions",
+                         json.dumps({"prompt_token_ids": prompt,
+                                     "max_tokens": 4}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = json.loads(resp.read())
+            conn.close()
+            assert resp.status == 200, data
+            solo = model.generate(paddle.to_tensor(
+                np.asarray(prompt)[None]), max_new_tokens=4).numpy()[0]
+            assert data["choices"][0]["token_ids"] == list(solo)
+            # feedback, not failure: busy-marked, still alive
+            assert pool.busy_marks == [0]
+            assert all(w["alive"] for w in pool.workers())
+            assert router._busy == 1 and router._placed == 1
+            assert router._retried == 0 and router._failed == 0
+
+            # every worker busy -> the 429 + Retry-After forwards
+            pool.mark_busy(1, backoff_s=30.0)
+            pool.busy_marks.clear()
+            time.sleep(0.6)   # stub's 0.5s backoff expires; it answers
+            # 429 again, and with no other placeable worker the router
+            # forwards the backpressure instead of 502ing
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            conn.request("POST", "/v1/completions",
+                         json.dumps({"prompt_token_ids": prompt,
+                                     "max_tokens": 4}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            ra = resp.getheader("Retry-After")
+            conn.close()
+            assert resp.status == 429 and ra == "7", (resp.status, body)
+            assert "full" in body["error"]
+        finally:
+            router.close()
+    finally:
+        worker.close()
+        busy_httpd.shutdown()
+        busy_httpd.server_close()
+
+
 # ---- in-process: engine handoff + kv channel --------------------------------
 
 def test_export_admit_handoff_matches_solo():

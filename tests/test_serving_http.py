@@ -147,6 +147,28 @@ def test_error_paths(served):
     assert status == 400 and b"multimodal" in data
 
 
+def test_priority_and_slo_params(served):
+    """priority / slo_ms ride the request JSON into the engine's
+    admission queue; malformed SLOs answer 400."""
+    model, srv = served
+    prompt = np.random.RandomState(3).randint(1, 512, (7,)).tolist()
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=5).numpy()[0].tolist()
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 5,
+                          "priority": 0, "slo_ms": 250.0})
+    assert status == 200
+    assert json.loads(data)["choices"][0]["token_ids"] == solo
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 2,
+                          "slo_ms": -5})
+    assert status == 400 and b"slo_ms" in data
+    status, data = _post(srv, "/v1/completions",
+                         {"prompt_token_ids": prompt, "max_tokens": 2,
+                          "priority": "urgent"})
+    assert status == 400
+
+
 def test_keepalive_connection_reuse(served):
     """One HTTP/1.1 connection, three requests back to back — including a
     404 POST whose body must be drained, or the next request on the same
