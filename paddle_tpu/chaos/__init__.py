@@ -1,0 +1,30 @@
+"""Deterministic chaos injection for the serving cluster.
+
+The robustness proof layer: the cluster claims to survive worker churn,
+handoff loss/corruption, heartbeat stalls and flaky transports — this
+package makes those claims falsifiable by *injecting* exactly those
+failures under a fixed-seed, replayable :class:`~.plan.FaultPlan`, with
+every injected fault recorded as a ``chaos.inject`` flight-recorder
+event so incident bundles show fault vs. symptom.
+
+- :mod:`plan` — the fault-plan data model (points, actions, nth-arrival
+  triggers, process scopes; JSON round-trip);
+- :mod:`inject` — the process-local injector behind the guarded
+  ``chaos.on(point, ...)`` hooks in kv_handoff / pool / router / worker
+  (free when no plan is installed);
+- :mod:`dryrun` — the seeded end-to-end runner: real multi-process
+  cluster + concurrent clients + the plan's faults, asserting every
+  stream completes token-identical with zero client-visible 5xx for
+  absorbable faults. ``scripts/chaos_dryrun.py`` is the CLI; the tier-1
+  chaos gate drives it from tests/test_chaos.py.
+
+See docs/SERVING.md "Failure domains & migration runbook".
+"""
+from .inject import (active, arm_engine, corrupt_bundle,  # noqa: F401
+                     install, install_from_env, on, uninstall)
+from .plan import Fault, FaultPlan, POINT_ACTIONS        # noqa: F401
+
+__all__ = [
+    "Fault", "FaultPlan", "POINT_ACTIONS", "active", "arm_engine",
+    "corrupt_bundle", "install", "install_from_env", "on", "uninstall",
+]

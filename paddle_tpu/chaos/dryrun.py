@@ -1,0 +1,297 @@
+"""Chaos dryrun: the seeded end-to-end robustness gate.
+
+Launches the REAL multi-process cluster (router + worker subprocesses
+over TCPStore leases and shm handoff rings), installs a fixed-seed
+:class:`~.plan.FaultPlan` in every process, drives concurrent streamed
+completions through the router while the plan injects worker death,
+handoff loss/corruption, a heartbeat stall and router↔worker 5xx — and
+checks the claims the serving tier makes about itself:
+
+- every stream completes **token-identical** to a solo run and ends with
+  a clean ``[DONE]``;
+- **zero client-visible 5xx** for absorbable faults (everything in the
+  default plan is absorbable: retries, failover and handoff re-export
+  must hide them);
+- corrupt bundles are **detected** (checksum → ``HandoffCorrupt``) and
+  retried, never admitted; dropped bundles time out and re-place;
+- a stalled heartbeat reaps the worker and a fresh lease **rejoins** it.
+
+``scripts/chaos_dryrun.py`` is the CLI over :func:`run_dryrun`; the
+tier-1 chaos gate (tests/test_chaos.py) drives it directly and asserts
+on the returned report.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+import urllib.request
+from typing import List, Optional
+
+from . import inject as _inject
+from .plan import Fault, FaultPlan
+
+__all__ = ["default_plan", "run_dryrun"]
+
+
+def default_plan(seed: int = 0) -> FaultPlan:
+    """The gate plan: one seeded plan combining every failure domain the
+    cluster claims to absorb. Counts are arrivals per point per process
+    (worker:0 is the prefill worker in the default topology; worker:2 a
+    decode worker)."""
+    return FaultPlan(seed=seed, faults=[
+        # the 2nd KV bundle worker:0 ships is silently lost — the decode
+        # side must 504 and the router re-place (fresh prefill, fresh
+        # bundle)
+        Fault("kv_handoff.send", "drop", nth=2, scope="worker:0"),
+        # the 4th is corrupted by one flipped byte AFTER sealing — the
+        # admitting engine must refuse it with HandoffCorrupt, and the
+        # router absorb the 5xx
+        Fault("kv_handoff.send", "corrupt", nth=4, scope="worker:0"),
+        # one placement hop fails as if the worker answered 500
+        Fault("router.upstream", "http_500", nth=6, scope="router"),
+        # worker:0's lease heartbeat stalls past its ttl (process alive,
+        # membership lapsed): the pool must reap it, traffic must flow
+        # without it, and the fresh post-stall stamp must rejoin it
+        Fault("worker.request", "stall_heartbeat", nth=3,
+              scope="worker:0", duration_s=4.0),
+        # a decode worker dies at its 20th engine step — SIGKILL-grade,
+        # mid-stream; relays must fail over and continue token-identical
+        Fault("worker.step", "kill", nth=20, scope="worker:2"),
+    ])
+
+
+def _stream_completion(host, port, body, timeout=300):
+    """POST a streaming completion; returns (status, clean, tokens)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            resp.read()
+            return resp.status, False, []
+        toks, clean = [], False
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            if not line.startswith(b"data: "):
+                continue
+            payload = line[len(b"data: "):].strip()
+            if payload == b"[DONE]":
+                clean = True
+                break
+            d = json.loads(payload)
+            if "error" in d or "migrated" in d:
+                break
+            toks.append(d["choices"][0]["token_ids"][0])
+        return 200, clean, toks
+    finally:
+        conn.close()
+
+
+def _get_json(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
+               max_tokens: int = 32, prompt_len: int = 9,
+               layers: int = 2, max_batch: int = 8, max_len: int = 128,
+               page_size: int = 8, ttl: float = 1.5,
+               handoff_wait_s: float = 3.0, max_retries: int = 5,
+               compile_cache: Optional[str] = None,
+               stream_timeout: float = 420.0) -> dict:
+    """Run the fixed-seed chaos plan against a real 1-prefill + 2-decode
+    cluster and return the report dict (see module docstring for the
+    claims it checks; ``report["ok"]`` is the verdict)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+    from ..observability import flightrecorder as frec
+    from ..serving_cluster import launch_cluster
+
+    plan = plan or default_plan()
+    cache = compile_cache or os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/paddle_tpu_jax_cache")
+    cfg = {
+        "cluster": {"host": "127.0.0.1", "port": 0, "ttl": ttl,
+                    "platform": "cpu", "compile_cache": cache,
+                    "handoff_wait_s": handoff_wait_s,
+                    "max_retries": max_retries,
+                    "model_name": "tiny-llama-chaos"},
+        "model": {"kind": "tiny_llama", "num_hidden_layers": layers,
+                  "seed": 0},
+        "engine": {"max_batch": max_batch, "max_len": max_len,
+                   "page_size": page_size},
+        "workers": [{"role": "prefill", "count": 1},
+                    {"role": "decode", "count": 2}],
+    }
+
+    # the reference run: same seed + spec as the workers build
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=layers))
+    rng = np.random.RandomState(plan.seed + 3)
+    prompts = [rng.randint(1, 512, (prompt_len,)).tolist()
+               for _ in range(streams)]
+    solos = [model.generate(paddle.to_tensor(np.asarray(p)[None]),
+                            max_new_tokens=max_tokens).numpy()[0].tolist()
+             for p in prompts]
+
+    rec = frec.get_recorder()
+    rec.enable()
+    since = rec.stats()["recorded"]
+    os.environ[_inject.ENV_PLAN] = plan.dumps()
+    injector = _inject.install(plan, scope="router")
+    cluster = None
+    try:
+        cluster = launch_cluster(cfg)
+        host, port = cluster.address
+        # one sequential warm request compiles the prefill/export bucket
+        # before the concurrent phase, so the handoff_wait_s clock runs
+        # against transport time, not first-compile time. The plan's
+        # counters see it (it is arrival #1 at each point) — no default
+        # fault triggers at nth=1.
+        conn = http.client.HTTPConnection(host, port, timeout=300)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt_token_ids": prompts[0],
+                                 "max_tokens": 1}),
+                     {"Content-Type": "application/json"})
+        warm = conn.getresponse()
+        warm.read()
+        conn.close()
+        if warm.status != 200:
+            raise RuntimeError(
+                f"chaos dryrun warmup failed: {warm.status}")
+        results: List[Optional[tuple]] = [None] * streams
+
+        def client(i):
+            results[i] = _stream_completion(
+                host, port,
+                {"prompt_token_ids": prompts[i],
+                 "max_tokens": max_tokens, "stream": True},
+                timeout=stream_timeout)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    name=f"chaos-client-{i}")
+                   for i in range(streams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=stream_timeout)
+
+        # kill-leg guarantee: placement races can starve the kill target
+        # of decode work in a light run (its only stream was the dropped
+        # bundle, say) — feed sequential streams until its per-process
+        # step counter crosses the plan's nth and the kill fires. These
+        # must be absorbed exactly like the planned ones: the failover
+        # replays them on the survivor, token-identical.
+        mopup_ok = True
+        for _ in range(10):
+            if cluster.processes[2].poll() is not None:
+                break
+            st, cl, tk = _stream_completion(
+                host, port, {"prompt_token_ids": prompts[0],
+                             "max_tokens": 24, "stream": True},
+                timeout=stream_timeout)
+            mopup_ok = (mopup_ok and st == 200 and cl
+                        and tk == solos[0][:24])
+
+        # the stalled worker must rejoin on its fresh post-pause lease
+        rejoined = False
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and not rejoined:
+            try:
+                health = _get_json(f"http://{host}:{port}/health")
+            except OSError:
+                break
+            w0 = health["workers"].get("0")
+            rejoined = bool(w0 and w0["alive"])
+            if not rejoined:
+                time.sleep(0.5)
+
+        # surviving workers' chaos.inject events (the killed worker's
+        # ring died with it — its evidence is the exit code below)
+        fired = {"router": injector.fired()}
+        try:
+            health = _get_json(f"http://{host}:{port}/health")
+            for rid_s, w in health["workers"].items():
+                if not w["alive"]:
+                    continue
+                evs = _get_json(w["url"]
+                                + "/debug/events?kind=chaos")["events"]
+                fired[f"worker:{rid_s}"] = [
+                    {k: e.get(k) for k in ("point", "action", "nth")}
+                    for e in evs]
+        except OSError:
+            pass
+
+        import subprocess
+
+        killed = cluster.processes[2].poll()
+        if killed is None:
+            try:
+                killed = cluster.processes[2].wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                killed = None  # kill fault never fired: report says so
+    finally:
+        os.environ.pop(_inject.ENV_PLAN, None)
+        _inject.uninstall()
+        if cluster is not None:
+            cluster.close()
+
+    evs = rec.events(since=since)
+    retries = [e for e in evs if e["kind"] == "router.retry"]
+    lost = [e for e in evs if e["kind"] == "router.worker_lost"]
+    stream_reports = []
+    client_5xx = 0
+    all_ok = True
+    for i, r in enumerate(results):
+        status, clean, toks = r if r is not None else (None, False, [])
+        identical = toks == solos[i]
+        if status is not None and status >= 500:
+            client_5xx += 1
+        ok = status == 200 and clean and identical
+        all_ok = all_ok and ok
+        stream_reports.append({"stream": i, "status": status,
+                               "clean": clean,
+                               "token_identical": identical,
+                               "tokens": len(toks)})
+    corrupt_detected = any("checksum mismatch" in str(e.get("reason", ""))
+                           for e in retries)
+    drop_detected = any("not received" in str(e.get("reason", ""))
+                        for e in retries)
+    drop_fired = any(f.get("action") == "drop"
+                     for fs in fired.values() for f in fs)
+    # a drop is ABSORBED either by its own symptom (the decode side's
+    # 504 "not received" timed out and the router re-placed) or masked
+    # by a concurrent failover (the waiting decode worker died inside
+    # the wait window and the same re-place path took over) — both are
+    # clean, and token identity above is the invariant that matters
+    drop_absorbed = drop_detected or (drop_fired and all_ok)
+    report = {
+        "plan": plan.as_dict(),
+        "streams": stream_reports,
+        "client_5xx": client_5xx,
+        "retries": [{k: e.get(k) for k in
+                     ("replica_id", "attempt", "delivered", "reason")}
+                    for e in retries],
+        "worker_lost": [{"replica_id": e.get("replica_id"),
+                         "reason": e.get("reason")} for e in lost],
+        "faults_fired": fired,
+        "corrupt_detected_and_retried": corrupt_detected,
+        "drop_detected_and_retried": drop_detected,
+        "drop_fired": drop_fired,
+        "drop_absorbed": drop_absorbed,
+        "stalled_worker_rejoined": rejoined,
+        "killed_worker_exit": killed,
+        "kill_mopup_ok": mopup_ok,
+        "ok": (all_ok and client_5xx == 0 and corrupt_detected
+               and drop_absorbed and rejoined and bool(lost)
+               and killed == 137 and mopup_ok),
+    }
+    return report

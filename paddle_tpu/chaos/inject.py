@@ -1,0 +1,170 @@
+"""Runtime fault injection: the process-local injector behind the
+``chaos.on(point, ...)`` hooks compiled into kv_handoff / pool / router /
+worker.
+
+Exactly one injector (or none) is active per process. The hooks pay one
+module-global read when no plan is installed — the production fast path
+is a ``None`` check, the same guarded-disable idiom as the tracer and
+flight recorder. With a plan installed, every arrival at a point bumps a
+per-point counter under a lock; a fault whose (point, scope, nth) matches
+fires ONCE, is recorded as a ``chaos.inject`` flight-recorder event in
+the injecting process (so incident bundles separate fault from symptom),
+and is returned to the call site, which applies the action's semantics
+(drop the message, flip a byte, answer 500, pause the heartbeat, exit).
+
+Worker subprocesses receive their plan through the environment:
+``PDTPU_CHAOS_PLAN`` (JSON, or a path to a JSON file) — the launcher
+exports it, ``run_worker`` calls :func:`install_from_env` with its
+``worker:<replica_id>`` scope. The router/driver process installs
+directly with :func:`install`.
+"""
+from __future__ import annotations
+
+import os
+import random
+from typing import Optional
+
+import numpy as np
+
+from ..analysis.threads.witness import make_lock
+from ..distributed.log_utils import get_logger
+from ..observability import flightrecorder as _frec
+from .plan import FaultPlan
+
+__all__ = ["ChaosInjector", "active", "install", "install_from_env",
+           "uninstall", "on", "corrupt_bundle", "arm_engine",
+           "ENV_PLAN"]
+
+ENV_PLAN = "PDTPU_CHAOS_PLAN"
+
+
+class ChaosInjector:
+    """Counts arrivals at injection points and fires matching faults."""
+
+    def __init__(self, plan: FaultPlan, scope: str):
+        self.plan = plan
+        self.scope = scope
+        self.rng = random.Random(plan.seed)
+        self._lock = make_lock("ChaosInjector._lock")
+        self._counts = {}      # point -> arrivals seen
+        self._spent = set()    # indices of faults that already fired
+        self._fired = []       # audit log of fired faults
+
+    def fire(self, point: str, **ctx):
+        """One arrival at ``point``; returns the matching Fault (now
+        spent) or None. The caller applies the action."""
+        with self._lock:
+            n = self._counts.get(point, 0) + 1
+            self._counts[point] = n
+            hit = None
+            for i, f in enumerate(self.plan.faults):
+                if (i in self._spent or f.point != point or f.nth != n
+                        or (f.scope is not None and f.scope != self.scope)):
+                    continue
+                hit = f
+                self._spent.add(i)
+                break
+            if hit is not None:
+                self._fired.append({"point": point, "action": hit.action,
+                                    "nth": n, "scope": self.scope})
+        if hit is None:
+            return None
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_CHAOS, point=point, action=hit.action,
+                       nth=n, scope=self.scope, detail=hit.detail)
+        get_logger().warning(
+            "chaos: injecting %s at %s (arrival %s, scope %s)",
+            hit.action, point, n, self.scope)
+        return hit
+
+    def fired(self):
+        with self._lock:
+            return list(self._fired)
+
+    def counts(self):
+        with self._lock:
+            return dict(self._counts)
+
+
+_ACTIVE: Optional[ChaosInjector] = None
+
+
+def active() -> Optional[ChaosInjector]:
+    return _ACTIVE
+
+
+def install(plan: FaultPlan, scope: str) -> ChaosInjector:
+    """Install ``plan`` as this process's injector (replacing any)."""
+    global _ACTIVE
+    _ACTIVE = ChaosInjector(plan, scope)
+    get_logger().info("chaos: plan installed (scope %s, %d faults)",
+                      scope, len(plan.faults))
+    return _ACTIVE
+
+
+def uninstall():
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def install_from_env(scope: str) -> Optional[ChaosInjector]:
+    """Install the plan the launcher exported via ``PDTPU_CHAOS_PLAN``
+    (inline JSON or a file path); None when the env carries no plan."""
+    raw = os.environ.get(ENV_PLAN)
+    if not raw:
+        return None
+    if raw.lstrip().startswith("{"):
+        plan = FaultPlan.loads(raw)
+    else:
+        plan = FaultPlan.load(raw)
+    return install(plan, scope)
+
+
+def on(point: str, **ctx):
+    """The injection hook: None on the (usual) no-plan fast path, else
+    the fired Fault for this arrival (or None when nothing matches)."""
+    inj = _ACTIVE
+    if inj is None:
+        return None
+    return inj.fire(point, **ctx)
+
+
+def corrupt_bundle(bundle: dict, rng: Optional[random.Random] = None) -> dict:
+    """A copy of ``bundle`` with ONE byte of its first KV leaf flipped —
+    applied AFTER sealing, so the receiver's checksum must catch it.
+    ``rng`` (default: the active injector's seeded rng) picks the byte,
+    keeping the corruption deterministic under a fixed-seed plan."""
+    rng = rng or (_ACTIVE.rng if _ACTIVE is not None else None)
+    out = dict(bundle)
+    layers = [list(pair) for pair in bundle["layers"]]
+    leaf = np.asarray(layers[0][0])
+    raw = bytearray(leaf.tobytes())
+    idx = rng.randrange(len(raw)) if rng is not None else len(raw) // 2
+    raw[idx] ^= 0xFF
+    layers[0][0] = np.frombuffer(bytes(raw),
+                                 dtype=leaf.dtype).reshape(leaf.shape)
+    out["layers"] = layers
+    return out
+
+
+def arm_engine(engine, injector: Optional[ChaosInjector] = None):
+    """Wrap ``engine.step`` with the ``worker.step`` injection point when
+    the plan carries one (``kill`` exits the process at the nth decode
+    step — SIGKILL-grade, no teardown). No-op otherwise: the decode hot
+    loop only pays the wrapper when a step fault is actually planned."""
+    inj = injector if injector is not None else _ACTIVE
+    if inj is None or "worker.step" not in inj.plan.points():
+        return engine
+    orig = engine.step
+
+    def step(*a, **kw):
+        f = inj.fire("worker.step")
+        if f is not None and f.action == "kill":
+            get_logger().warning(
+                "chaos: planned kill at engine step — exiting now")
+            os._exit(137)
+        return orig(*a, **kw)
+
+    engine.step = step
+    return engine

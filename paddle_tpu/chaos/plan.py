@@ -1,0 +1,129 @@
+"""FaultPlan: a seeded, deterministic description of the failures to
+inject into a serving cluster.
+
+A plan is data, not code: a list of :class:`Fault` specs, each naming an
+**injection point** (a guarded hook compiled into the serving stack),
+an **action**, the **nth arrival** at that point that should trigger it,
+and the **scope** (which process injects — ``worker:<replica_id>`` or
+``router``). Counting arrivals instead of sampling wall-clock makes a
+plan replayable: the same plan over the same request sequence injects
+the same faults, which is what lets the chaos dryrun gate assert
+token-identical completions under failure.
+
+Injection points and their legal actions:
+
+========================  =====================================================
+point                     actions
+========================  =====================================================
+``kv_handoff.send``       ``drop`` (bundle silently lost), ``corrupt``
+                          (one byte flipped AFTER sealing — the checksum
+                          must catch it), ``delay`` (``delay_s`` stall)
+``router.upstream``       ``http_500`` (placement attempt fails as if the
+                          worker answered 5xx), ``delay``
+``worker.request``        ``http_500`` (worker answers 500),
+                          ``stall_heartbeat`` (pause the lease heartbeat
+                          for ``duration_s`` — process alive, membership
+                          lapsed), ``delay``
+``worker.step``           ``kill`` (``os._exit`` at the nth engine decode
+                          step — SIGKILL-grade death, no teardown)
+``pool.probe``            ``probe_fail`` (the router's /health poll of a
+                          worker is treated as failed)
+========================  =====================================================
+
+Plans serialize as JSON (``dumps``/``loads``/``load``) so the launcher
+can hand one to worker subprocesses through the environment
+(``PDTPU_CHAOS_PLAN``) — see :mod:`paddle_tpu.chaos.inject`.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+__all__ = ["Fault", "FaultPlan", "POINT_ACTIONS"]
+
+POINT_ACTIONS = {
+    "kv_handoff.send": ("drop", "corrupt", "delay"),
+    "router.upstream": ("http_500", "delay"),
+    "worker.request": ("http_500", "stall_heartbeat", "delay"),
+    "worker.step": ("kill",),
+    "pool.probe": ("probe_fail",),
+}
+
+
+class Fault:
+    """One planned failure: fire ``action`` on the ``nth`` arrival at
+    ``point`` in the process whose injector scope equals ``scope``
+    (``None`` = any process that reaches the point). Each fault fires at
+    most once."""
+
+    __slots__ = ("point", "action", "nth", "scope", "delay_s",
+                 "duration_s", "detail")
+
+    def __init__(self, point: str, action: str, nth: int = 1,
+                 scope: Optional[str] = None, delay_s: float = 0.0,
+                 duration_s: float = 0.0, detail: Optional[str] = None):
+        if point not in POINT_ACTIONS:
+            raise ValueError(
+                f"unknown injection point {point!r} "
+                f"(have {sorted(POINT_ACTIONS)})")
+        if action not in POINT_ACTIONS[point]:
+            raise ValueError(
+                f"action {action!r} is not legal at {point!r} "
+                f"(legal: {POINT_ACTIONS[point]})")
+        if int(nth) < 1:
+            raise ValueError(f"nth is 1-based, got {nth}")
+        self.point = point
+        self.action = action
+        self.nth = int(nth)
+        self.scope = scope
+        self.delay_s = float(delay_s)
+        self.duration_s = float(duration_s)
+        self.detail = detail
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Fault":
+        return cls(**{k: d[k] for k in cls.__slots__ if k in d})
+
+    def __repr__(self):
+        extra = f" scope={self.scope}" if self.scope else ""
+        return (f"Fault({self.action}@{self.point} nth={self.nth}"
+                f"{extra})")
+
+
+class FaultPlan:
+    """An ordered set of faults plus the seed that makes any sampled
+    choice (e.g. which byte ``corrupt`` flips) reproducible."""
+
+    def __init__(self, faults: List[Fault], seed: int = 0):
+        self.faults = list(faults)
+        self.seed = int(seed)
+
+    def as_dict(self) -> dict:
+        return {"seed": self.seed,
+                "faults": [f.as_dict() for f in self.faults]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        return cls([Fault.from_dict(f) for f in d.get("faults", ())],
+                   seed=d.get("seed", 0))
+
+    def dumps(self) -> str:
+        return json.dumps(self.as_dict())
+
+    @classmethod
+    def loads(cls, raw: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(raw))
+
+    @classmethod
+    def load(cls, path: str) -> "FaultPlan":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+    def points(self):
+        return {f.point for f in self.faults}
+
+    def __repr__(self):
+        return f"FaultPlan(seed={self.seed}, faults={self.faults})"

@@ -65,6 +65,7 @@ class ElasticManager:
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self._watch_thread: Optional[threading.Thread] = None
+        self._hb_paused_until = 0.0  # monotonic; heartbeat skips beats
 
     # ---- lease --------------------------------------------------------------
     def _key(self, rank: int) -> str:
@@ -86,6 +87,9 @@ class ElasticManager:
 
         def heartbeat():
             while not self._stop.wait(self.ttl / 3.0):
+                if time.monotonic() < self._hb_paused_until:
+                    continue  # paused (chaos stall): process alive,
+                              # lease deliberately lapsing
                 try:
                     self._beat()
                 except Exception as e:
@@ -105,6 +109,14 @@ class ElasticManager:
         """Stop refreshing the lease (the test hook for a simulated hang —
         process alive, membership lapsed)."""
         self._stop.set()
+
+    def pause_heartbeat(self, duration_s: float):
+        """Skip lease beats for ``duration_s`` then resume — the
+        RECOVERABLE stall (chaos heartbeat-stall injection): the lease
+        lapses, peers reap this rank, and the fresh post-pause stamp is
+        what lets it rejoin (the pool only readmits on a heartbeat newer
+        than the observed death)."""
+        self._hb_paused_until = time.monotonic() + float(duration_s)
 
     def mark_done(self):
         """Deregister on CLEAN exit: peers must not confuse a finished

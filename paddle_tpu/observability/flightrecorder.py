@@ -173,6 +173,22 @@ EV_SCHED_RESTORE = _register(
     "a preempted request re-took a slot: its host-side KV bundle was "
     "scattered back into the page pool and decode resumed (rid, engine, "
     "slot, kv_len, generated)")
+EV_SCHED_MIGRATE_OUT = _register(
+    "sched.migrate_out",
+    "a live slot was exported for migration: KV pages + last-logit row "
+    "+ sampling state + delivered-token count serialized to a checksummed "
+    "host bundle and the slot freed (rid, engine, slot, kv_len, "
+    "generated, bytes)")
+EV_SCHED_MIGRATE_IN = _register(
+    "sched.migrate_in",
+    "a migrated request was admitted: the bundle's KV scattered back "
+    "through the restore path and decode resumed mid-stream (rid, "
+    "engine, generated, kv_len, prompt_tokens)")
+EV_CHAOS = _register(
+    "chaos.inject",
+    "a planned fault fired at a chaos injection point (point, action, "
+    "nth, scope, detail) — written by the injector itself, so incident "
+    "bundles separate injected fault from observed symptom")
 EV_LOCK_ORDER = _register(
     "lock.order_violation",
     "the runtime lock-order witness (FLAGS_lock_witness) observed an "

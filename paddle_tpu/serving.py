@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import math
 import time
+import zlib
 from collections import deque
 from typing import Deque, Dict, List, Optional
 
@@ -39,6 +40,94 @@ from .generation import (_get_prefill_step, _get_select_decode,
 #: default priority class — lower value is MORE important. 0 is the
 #: interactive tier, 1 the default, 2+ batch/background traffic.
 PRIORITY_DEFAULT = 1
+
+
+#: schema version stamped on every handoff / preemption / migration
+#: bundle. Bump it whenever the bundle layout changes — an engine only
+#: admits bundles speaking its own version (version skew between a
+#: prefill tier and a decode tier mid-deploy must fail typed, not
+#: scatter mis-shaped KV).
+HANDOFF_SCHEMA_VERSION = 2
+
+
+class HandoffCorrupt(RuntimeError):
+    """A handoff / preemption / migration bundle failed its integrity
+    check: checksum mismatch (bit-rot or a corrupted transport), schema
+    version skew (mixed-version tiers), or an internally inconsistent
+    payload. A RuntimeError (not ValueError) on purpose: the HTTP layer
+    maps it to a 5xx, which the cluster router treats as retryable — a
+    fresh prefill/migration produces a fresh bundle, so the fault is
+    absorbable upstream and must never be pinned on the client."""
+
+
+def _bundle_digest(bundle: dict) -> int:
+    """CRC32 over a bundle's leaves in deterministic (sorted-key) order.
+    Numpy leaves hash dtype+shape+raw bytes; scalars hash their repr;
+    the top-level ``checksum`` field is excluded (it holds the digest)."""
+    crc = 0
+
+    def upd(b: bytes):
+        nonlocal crc
+        crc = zlib.crc32(b, crc)
+
+    def walk(path, o):
+        if isinstance(o, np.ndarray):
+            upd(f"{path}:{o.dtype.str}:{o.shape}".encode())
+            upd(np.ascontiguousarray(o).tobytes())
+        elif isinstance(o, dict):
+            for k in sorted(o):
+                if path == "" and k == "checksum":
+                    continue
+                walk(f"{path}/{k}", o[k])
+        elif isinstance(o, (list, tuple)):
+            for i, x in enumerate(o):
+                walk(f"{path}[{i}]", x)
+        else:
+            upd(f"{path}={o!r}".encode())
+
+    walk("", bundle)
+    return crc
+
+
+def seal_bundle(bundle: dict) -> dict:
+    """Stamp ``version`` + ``checksum`` onto a bundle (in place). Every
+    producer (export_prefill, export_slot, the preemption evictor) seals;
+    every consumer verifies with :func:`verify_bundle`."""
+    bundle["version"] = HANDOFF_SCHEMA_VERSION
+    bundle.pop("checksum", None)
+    bundle["checksum"] = _bundle_digest(bundle)
+    return bundle
+
+
+def verify_bundle(bundle, kind: Optional[str] = None) -> dict:
+    """Integrity gate in front of every bundle admission: schema version,
+    checksum, and (when given) the bundle ``kind``. Raises
+    :class:`HandoffCorrupt` — typed, retryable — instead of letting a
+    bit-flipped or version-skewed bundle scatter garbage into the KV
+    pool."""
+    if not isinstance(bundle, dict):
+        raise HandoffCorrupt(
+            f"bundle is a {type(bundle).__name__}, not a dict")
+    v = bundle.get("version")
+    if v != HANDOFF_SCHEMA_VERSION:
+        raise HandoffCorrupt(
+            f"bundle schema version skew: bundle says {v!r}, this engine "
+            f"speaks {HANDOFF_SCHEMA_VERSION} — prefill and decode tiers "
+            "must run the same bundle schema")
+    if kind is not None and bundle.get("kind", "prefill") != kind:
+        raise HandoffCorrupt(
+            f"bundle kind {bundle.get('kind')!r} where {kind!r} was "
+            "expected")
+    got = bundle.get("checksum")
+    if got is None:
+        raise HandoffCorrupt("bundle carries no checksum")
+    want = _bundle_digest(bundle)
+    if int(got) != want:
+        raise HandoffCorrupt(
+            f"bundle checksum mismatch (stored {int(got):#010x}, "
+            f"computed {want:#010x}) — corrupted in transport or host "
+            "memory; discard and re-export")
+    return bundle
 
 
 class QueueFull(RuntimeError):
@@ -184,6 +273,8 @@ class _RequestBookkeeping:
         self._n_cancelled = 0
         self._n_rejected = 0
         self._n_preempted = 0
+        self._n_migrated_out = 0
+        self._n_migrated_in = 0
         self._n_tokens = 0
         self._n_steps = 0
         self._m_queue_wait = _metrics.SERVING_QUEUE_WAIT.labels(engine=engine)
@@ -245,6 +336,8 @@ class _RequestBookkeeping:
             "requests_cancelled": self._n_cancelled,
             "requests_rejected": self._n_rejected,
             "requests_preempted": self._n_preempted,
+            "requests_migrated_out": self._n_migrated_out,
+            "requests_migrated_in": self._n_migrated_in,
             "requests_active": active,
             "requests_queued": queued,
             "requests_prefilling": len(getattr(self, "_chunking", ())),
@@ -621,7 +714,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._chunking: Dict[int, _ChunkState] = {}
         self._m_sched = {
             d: _metrics.SERVING_SCHED.labels(engine="decoder", decision=d)
-            for d in ("chunk", "preempt", "restore")}
+            for d in ("chunk", "preempt", "restore", "migrate_out",
+                      "migrate_in")}
 
         # ---- automatic prefix caching (vLLM-style, opt-in) --------------
         # At admission, the longest page-aligned token prefix shared with a
@@ -793,15 +887,15 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 pair.append(np.asarray(buf)[0])  # pdlint: disable=host-sync -- handoff export is the transfer
             layers.append(tuple(pair))
         last_row = np.asarray(last)[0].astype(np.float32)  # pdlint: disable=host-sync -- handoff export is the transfer
-        return {
-            "version": 1,
+        return seal_bundle({
+            "kind": "prefill",
             "ids": np.asarray(ids, np.int64),  # pdlint: disable=host-sync -- ids is the host prompt array, never device
             "prompt_tokens": int(S0),  # pdlint: disable=host-sync -- S0 is a host int from _bucketed_prefill
             "bucket": int(bucket),  # pdlint: disable=host-sync -- bucket is a host int from _bucketed_prefill
             "page_size": int(self.page_size),
             "layers": layers,
             "last": last_row,
-        }
+        })
 
     def admit_prefilled(self, handoff: dict, max_new_tokens: int = 64,
                         do_sample=None, temperature=None, top_k=None,
@@ -819,6 +913,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         if self._latent_mode:
             raise NotImplementedError(
                 "KV handoff is not supported in latent (MLA) mode")
+        verify_bundle(handoff, kind="prefill")
         bucket = int(handoff["bucket"])
         if bucket % self.page_size != 0 or bucket > self.max_len:
             raise ValueError(
@@ -878,6 +973,145 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._last = self._last.at[slot].set(
             jnp.asarray(h["last"], jnp.float32))
         self._lengths = self._lengths.at[slot].set(S0)
+
+    # ---- live migration: export a decoding slot / admit it elsewhere -----
+    def export_slot(self, rid: int) -> dict:
+        """Export a request that is ACTIVELY DECODING as a sealed
+        migration bundle and release its slot — the out half of live
+        request migration (serving_cluster). The bundle carries
+        everything a peer engine over the same weights needs to continue
+        the stream mid-decode: the KV pages densified to host numpy, the
+        last-logit row, the prompt ids, the tokens generated so far
+        (the delivered count), and the decode-side request state
+        (sampling, stops, logprobs, priority, remaining SLO).
+
+        :meth:`admit_migrated` on the peer restores through the SAME
+        jitted page scatter as a preemption restore, so a greedy stream
+        continues token-identically. Queued / mid-prefill requests raise
+        ValueError — they hold no KV worth shipping; re-place them from
+        scratch instead."""
+        if self._latent_mode:
+            raise NotImplementedError(
+                "migration is not supported in latent (MLA) mode — the "
+                "compressed cache rows are engine-layout-specific")
+        slot = next((s for s, r in enumerate(self._slots)
+                     if r is not None and r.rid == rid), None)
+        if slot is None:
+            raise ValueError(
+                f"request {rid} holds no decoding slot (queued, "
+                "prefilling, finished or unknown) — only active slots "
+                "migrate; re-place queued requests from scratch")
+        req = self._slots[slot]
+        kv, nbytes = self._slot_kv_bundle(slot, req)
+        now = time.perf_counter()
+        bundle = seal_bundle({
+            "kind": "migrate",
+            "ids": np.asarray(req.ids, np.int64),
+            "prompt_tokens": int(req.ids.size),
+            "tokens": np.asarray(req.tokens, np.int64),
+            "max_new_tokens": int(req.max_new_tokens),
+            "sampling": list(req.sampling or self._sample_cfg),
+            "stop_token_ids": (sorted(req.stop_token_ids)
+                               if req.stop_token_ids else None),
+            "want_logprobs": bool(req.want_logprobs),
+            "logprobs": [float(x) for x in req.logprobs],
+            "priority": int(req.priority),
+            "slo_remaining_s": (None if req.deadline == math.inf
+                                else float(req.deadline - now)),
+            "page_size": int(self.page_size),
+            "bucket": int(kv["bucket"]),
+            "kv_len": int(kv["kv_len"]),
+            "layers": kv["layers"],
+            "last": kv["last"],
+        })
+        self._slots[slot] = None
+        self._lengths = self._lengths.at[slot].set(0)
+        self._n_migrated_out += 1
+        self._m_sched["migrate_out"].inc()
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_SCHED_MIGRATE_OUT, rid=rid,
+                       engine=self._engine_label, slot=slot,
+                       kv_len=int(kv["kv_len"]),
+                       generated=len(req.tokens), bytes=nbytes)
+        self._record_reason(rid, "migrated")
+        self._trace_end(req, "migrated")
+        req.slot = -1
+        self._admit()     # the freed slot can refill immediately
+        return bundle
+
+    def admit_migrated(self, handoff: dict, on_token=None,
+                       trace_ctx=None) -> int:
+        """Admit a mid-stream request exported by a peer engine's
+        :meth:`export_slot` (same weights): the bundle's KV scatters back
+        through the preemption-restore path and decode resumes exactly
+        where the source engine stopped. Decode-side knobs (sampling,
+        stops, logprobs, priority, SLO) come FROM THE BUNDLE — they must
+        match the source request for the continuation to be
+        token-identical — and ``on_token`` fires only for NEWLY generated
+        tokens, so a relay appends seamlessly after the tokens it already
+        delivered."""
+        self._check_queue_bound()
+        if self._latent_mode:
+            raise NotImplementedError(
+                "migration is not supported in latent (MLA) mode")
+        verify_bundle(handoff, kind="migrate")
+        bucket = int(handoff["bucket"])
+        if bucket % self.page_size != 0 or bucket > self.max_len:
+            raise ValueError(
+                f"migration bucket {bucket} does not fit this engine "
+                f"(page_size {self.page_size}, max_len {self.max_len}) — "
+                "source and destination engines must share the serving "
+                "shape")
+        if len(handoff["layers"]) != len(self._caches):
+            raise ValueError(
+                f"migration bundle carries {len(handoff['layers'])} "
+                f"layers, engine has {len(self._caches)} — different "
+                "models?")
+        ids = np.asarray(handoff["ids"]).reshape(-1)
+        tokens = [int(t) for t in np.asarray(handoff["tokens"]).reshape(-1)]
+        kv_len = int(handoff["kv_len"])
+        if kv_len != ids.size + len(tokens):
+            raise HandoffCorrupt(
+                f"migration bundle is inconsistent: kv_len {kv_len} != "
+                f"prompt {ids.size} + generated {len(tokens)}")
+        max_new = int(handoff["max_new_tokens"])
+        if ids.size + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens ({max_new}) "
+                f"exceeds engine max_len {self.max_len}")
+        samp = handoff.get("sampling")
+        sampling = self._merge_sampling(*samp) if samp else None
+        slo_rem = handoff.get("slo_remaining_s")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._n_requests += 1
+        self._m_req_admitted.inc()
+        req = _Request(rid, ids, max_new, sampling, on_token,
+                       stop_token_ids=handoff.get("stop_token_ids"),
+                       want_logprobs=bool(handoff.get("want_logprobs")),
+                       priority=handoff.get("priority"),
+                       slo_ms=(slo_rem * 1000.0 if slo_rem is not None
+                               else None))
+        req.tokens = tokens
+        req.logprobs = [float(x) for x in handoff.get("logprobs") or []]
+        # resume rides the preemption-restore path: _admit sees
+        # req.resume and scatters the KV back, no model forward runs
+        req.resume = seal_bundle({
+            "bucket": bucket, "kv_len": kv_len,
+            "layers": handoff["layers"], "last": handoff["last"]})
+        self._trace_submit(req, trace_ctx)
+        self._queue.append(req)
+        self._fr_submit(req)
+        self._n_migrated_in += 1
+        self._m_sched["migrate_in"].inc()
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_SCHED_MIGRATE_IN, rid=rid,
+                       engine=self._engine_label, generated=len(tokens),
+                       kv_len=kv_len, prompt_tokens=int(ids.size))
+        self._admit()
+        return rid
 
     def logprobs(self, rid: int):
         """Chosen-token logprobs (model's raw distribution) for a
@@ -1125,14 +1359,12 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._preempt_slot(victim_slot, by=cand)
         return True
 
-    def _preempt_slot(self, s: int, by: Optional[_Request] = None):
-        """Evict slot ``s``: serialize its KV pages + last-logit row to a
-        host-side bundle (the np.asarray reads ARE the deliberate
-        device->host transfer — this is the eviction), free the slot, and
-        requeue the request with its generated tokens intact. A later
-        _restore_into scatters the bundle back and decode resumes
-        token-identically."""
-        req = self._slots[s]
+    def _slot_kv_bundle(self, s: int, req: _Request):
+        """Serialize slot ``s``'s device state to a sealed host bundle
+        (the np.asarray reads ARE the deliberate device->host transfer):
+        KV pages densified per layer, the last-logit row, the kv length.
+        The one serializer behind preemption AND migration — both restore
+        through the same jitted page scatter. Returns (bundle, nbytes)."""
         ps = self.page_size
         kv_len = int(req.ids.size) + len(req.tokens)
         bucket = self._bucket(kv_len)
@@ -1150,8 +1382,18 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 pair.append(dense)
             layers.append(tuple(pair))
         last_row = np.asarray(self._last[s]).astype(np.float32)
-        req.resume = {"bucket": bucket, "kv_len": kv_len,
-                      "layers": layers, "last": last_row}
+        return seal_bundle({"bucket": bucket, "kv_len": kv_len,
+                            "layers": layers, "last": last_row}), nbytes
+
+    def _preempt_slot(self, s: int, by: Optional[_Request] = None):
+        """Evict slot ``s``: serialize its KV pages + last-logit row to a
+        host-side bundle, free the slot, and requeue the request with its
+        generated tokens intact. A later _restore_into scatters the
+        bundle back and decode resumes token-identically."""
+        req = self._slots[s]
+        bundle, nbytes = self._slot_kv_bundle(s, req)
+        kv_len = int(bundle["kv_len"])
+        req.resume = bundle
         req.n_preempted += 1
         self._n_preempted += 1
         self._slots[s] = None
@@ -1174,6 +1416,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         handoff admission) and seed sampling from the saved last-logit
         row — decode continues exactly where eviction stopped."""
         r, req.resume = req.resume, None
+        verify_bundle(r)  # preemption and migration bundles are sealed
         bucket, kv_len = int(r["bucket"]), int(r["kv_len"])
         c_new = [{"k": jnp.asarray(k)[None], "v": jnp.asarray(v)[None]}
                  for k, v in r["layers"]]

@@ -9,14 +9,18 @@ The single-process ``CompletionServer`` scaled out (ROADMAP item 1):
 - :mod:`pool` — the router's membership + occupancy view (lease
   freshness, ``/health`` polls, pending placements);
 - :mod:`router` — the front door: queue-depth-aware least-loaded
-  placement, SSE relay, bounded-retry failover, cross-process
-  ``traceparent`` propagation;
+  placement, SSE relay, bounded-retry failover, live-migration
+  continuations, ``POST /drain`` graceful-drain orchestration,
+  cross-process ``traceparent`` propagation;
 - :mod:`kv_handoff` — prefill→decode KV shipping over
-  ``io/shm_channel`` (device collectives pluggable);
+  ``io/shm_channel`` (device collectives pluggable); migration bundles
+  ride the same transport;
 - :mod:`launcher` — config → running tier (``scripts/serve_cluster.py``
   is the CLI).
 
-See docs/SERVING.md "Disaggregated deployment".
+See docs/SERVING.md "Disaggregated deployment" and "Failure domains &
+migration runbook"; :mod:`paddle_tpu.chaos` injects the failures this
+tier claims to absorb.
 """
 from .kv_handoff import KvHandoffReceiver, KvHandoffSender  # noqa: F401
 from .launcher import Cluster, launch_cluster, load_config  # noqa: F401

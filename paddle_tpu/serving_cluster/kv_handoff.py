@@ -27,6 +27,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..analysis.threads.witness import make_lock
+from ..chaos import inject as _chaos
 from ..distributed.log_utils import get_logger
 from ..io.shm_channel import ShmChannel, ShmChannelTimeout
 from ..observability import flightrecorder as _frec
@@ -69,6 +70,19 @@ class KvHandoffSender:
         """Ship one bundle; returns its approximate byte size. Raises
         ``ShmChannelTimeout`` when the decode worker stops draining."""
         nbytes = bundle_nbytes(bundle)
+        fault = _chaos.on("kv_handoff.send", handoff_id=handoff_id,
+                          channel=self.channel_name)
+        if fault is not None:
+            if fault.action == "drop":
+                # silently lost in transport: the receiver's wait() times
+                # out and the caller's 5xx turns into a router retry
+                return nbytes
+            if fault.action == "delay":
+                time.sleep(fault.delay_s)
+            elif fault.action == "corrupt":
+                # one byte flipped AFTER sealing — the admitting engine's
+                # checksum must refuse it with HandoffCorrupt
+                bundle = _chaos.corrupt_bundle(bundle)
         self._chan.put({"handoff_id": handoff_id, "bundle": bundle},
                        timeout=self.timeout)
         rec = _frec.RECORDER

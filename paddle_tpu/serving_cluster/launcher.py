@@ -112,6 +112,7 @@ class Cluster:
                 "platform": cluster.get("platform"),
                 "compile_cache": cluster.get("compile_cache"),
                 "incident_dir": cluster.get("incident_dir"),
+                "handoff_wait_s": cluster.get("handoff_wait_s", 30.0),
             }
             # -c (not -m): runpy warns when the module is already in
             # sys.modules via the package import, and the entry is the

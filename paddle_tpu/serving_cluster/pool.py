@@ -19,18 +19,35 @@ handoff channel) — and the pool watches both from the router process:
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..analysis.threads.witness import make_lock
+from ..chaos import inject as _chaos
 from ..distributed.elastic import ElasticManager
 from ..distributed.log_utils import get_logger
 from ..observability import flightrecorder as _frec
 from ..observability.catalog import ROUTER_WORKERS
 
-__all__ = ["WorkerInfo", "WorkerPool"]
+__all__ = ["WorkerInfo", "WorkerPool", "jittered"]
+
+# process-local jitter source for backoff/retry sleeps; seedable from
+# tests (bounds are pinned there), never from production paths
+_JITTER_RNG = random.Random()
+
+
+def jittered(base_s: float, frac: float = 0.5,
+             rng: Optional[random.Random] = None) -> float:
+    """``base_s`` spread uniformly over ``[base*(1-frac), base*(1+frac)]``.
+    Every busy-backoff and retry sleep routes through here: a fixed
+    constant synchronizes the retries of every caller that backed off at
+    the same mass-busy event, so they all stampede back in the same
+    instant — jitter decorrelates the retry times."""
+    lo = max(0.0, 1.0 - frac)
+    return float(base_s) * ((rng or _JITTER_RNG).uniform(lo, 1.0 + frac))
 
 
 class WorkerInfo:
@@ -40,7 +57,7 @@ class WorkerInfo:
 
     __slots__ = ("replica_id", "role", "host", "port", "pid", "kv_channel",
                  "alive", "lease_age_s", "active", "queued", "pending",
-                 "probe_ok", "marked_dead_at", "busy_until")
+                 "probe_ok", "marked_dead_at", "busy_until", "draining")
 
     def __init__(self, replica_id: int, meta: dict):
         self.replica_id = replica_id
@@ -57,6 +74,7 @@ class WorkerInfo:
         self.probe_ok = False
         self.marked_dead_at: Optional[float] = None  # monotonic, router-side
         self.busy_until = 0.0  # admission backpressure (429) backoff
+        self.draining = False  # drain in progress: placement excluded
 
     @property
     def url(self) -> str:
@@ -79,6 +97,7 @@ class WorkerInfo:
             "pending": self.pending,
             "probe_ok": self.probe_ok,
             "busy": self.busy_until > time.monotonic(),
+            "draining": self.draining,
         }
 
 
@@ -178,6 +197,9 @@ class WorkerPool:
                         # rejoin within one heartbeat period)
                         w.alive = True
                         w.pending = 0
+                        # a rejoin is a fresh incarnation: a drain that
+                        # ended in lease release must not haunt it
+                        w.draining = False
                 elif w.alive:
                     self._mark_lost_locked(w, "lease")
                     lost.append(w)
@@ -191,16 +213,20 @@ class WorkerPool:
         self.refresh_gauges()
 
     def _probe(self, replica_id: int, url: str):
-        try:
-            with urllib.request.urlopen(url + "/health",
-                                        timeout=self._probe_timeout) as r:
-                health = json.loads(r.read())
-            ok = True
-        except Exception as e:
-            get_logger().debug("worker pool: /health probe of replica %s "
-                               "failed (%s: %s)", replica_id,
-                               type(e).__name__, e)
+        fault = _chaos.on("pool.probe", replica_id=replica_id)
+        if fault is not None and fault.action == "probe_fail":
             health, ok = None, False
+        else:
+            try:
+                with urllib.request.urlopen(
+                        url + "/health", timeout=self._probe_timeout) as r:
+                    health = json.loads(r.read())
+                ok = True
+            except Exception as e:
+                get_logger().debug("worker pool: /health probe of replica "
+                                   "%s failed (%s: %s)", replica_id,
+                                   type(e).__name__, e)
+                health, ok = None, False
         with self._lock:
             w = self._workers.get(replica_id)
             if w is None:
@@ -209,6 +235,11 @@ class WorkerPool:
             if ok:
                 w.active = int(health.get("active", 0))
                 w.queued = int(health.get("queued", 0))
+                # a worker draining itself (operator hit its /drain
+                # directly) is honored the same as a router-initiated
+                # drain: no new placements land on it
+                if health.get("draining"):
+                    w.draining = True
 
     def _beat_after_death(self, w: WorkerInfo) -> bool:
         """True when the worker's newest lease stamp postdates the moment
@@ -263,7 +294,8 @@ class WorkerPool:
         now = time.monotonic()
         with self._lock:
             live = [w for w in self._workers.values()
-                    if w.alive and w.replica_id not in exclude
+                    if w.alive and not w.draining
+                    and w.replica_id not in exclude
                     and w.busy_until <= now
                     and (roles is None or w.role in roles)]
             if not live:
@@ -279,14 +311,40 @@ class WorkerPool:
 
     def mark_busy(self, replica_id: int, backoff_s: float = 0.5):
         """Admission backpressure (a worker answered 429): take it out of
-        SELECTION for ``backoff_s`` without declaring it dead — its
+        SELECTION for ~``backoff_s`` without declaring it dead — its
         engine is healthy, just full. Contrast mark_dead: a busy worker
         keeps its lease, rejoins rotation by itself, and is never
-        failed over to another replica's retry budget."""
+        failed over to another replica's retry budget. The backoff is
+        JITTERED (±50%): after a mass-busy event every router would
+        otherwise re-admit the same worker at the same instant."""
         with self._lock:
             w = self._workers.get(replica_id)
             if w is not None:
-                w.busy_until = time.monotonic() + float(backoff_s)
+                w.busy_until = time.monotonic() + jittered(backoff_s)
+
+    def set_draining(self, replica_id: int, draining: bool = True):
+        """Mark a worker draining (router-initiated drain): it stays
+        alive and probed but receives no new placements; migration picks
+        destinations through the same select(), which skips it."""
+        with self._lock:
+            w = self._workers.get(replica_id)
+            if w is not None:
+                w.draining = bool(draining)
+
+    def get(self, replica_id: int) -> Optional[WorkerInfo]:
+        """The WorkerInfo for a replica (None when unknown) — the pinned
+        lookup a migration continuation uses to follow a stream to the
+        destination the drain chose."""
+        with self._lock:
+            return self._workers.get(replica_id)
+
+    def claim(self, w: WorkerInfo):
+        """Count a placement onto a SPECIFIC worker into ``pending`` —
+        the select()-side bump for callers that pinned their target (a
+        migration continuation follows the stream to the destination the
+        drain chose). Pair with release() like a select()."""
+        with self._lock:
+            w.pending += 1
 
     def release(self, w: WorkerInfo):
         with self._lock:

@@ -28,17 +28,19 @@ from __future__ import annotations
 import http.client
 import json
 import threading
+import time
 import uuid
 from http.server import ThreadingHTTPServer
 from typing import Optional, Tuple
 
 from ..analysis.threads.witness import make_lock
+from ..chaos import inject as _chaos
 from ..distributed.log_utils import get_logger
 from ..observability import flightrecorder as _frec
 from ..observability import tracing as _tracing
 from ..observability.catalog import ROUTER_PLACEMENTS
 from ..serving_http import ServingHandlerBase
-from .pool import WorkerInfo, WorkerPool
+from .pool import WorkerInfo, WorkerPool, jittered
 
 __all__ = ["RouterServer"]
 
@@ -86,18 +88,38 @@ class _ClientGone(Exception):
     """The DOWNSTREAM client disconnected mid-relay; nothing to answer."""
 
 
+class _Migrated(Exception):
+    """The upstream worker ended the stream with a migrate marker: the
+    request's slot was exported to another worker (drain / rebalance).
+    NOT a failure — the relay continues on the destination by claiming
+    the named handoff id, without burning the failover-retry budget."""
+
+    def __init__(self, info: dict):
+        super().__init__(f"migrated to {info.get('dst')}")
+        self.info = info
+
+
 class RouterServer:
     """HTTP front-end placing completions across a WorkerPool."""
+
+    #: bound on PLANNED migration hops per request (a drain chain, not a
+    #: retry budget) — a pathological migrate loop must still terminate
+    max_migrations = 16
 
     def __init__(self, pool: WorkerPool, host: str = "127.0.0.1",
                  port: int = 0, model_name: str = "paddle-tpu",
                  max_retries: int = 2, upstream_timeout: float = 120.0,
+                 retry_backoff_s: float = 0.05,
                  enable_tracing: bool = True,
                  enable_flight_recorder: bool = True):
         self.pool = pool
         self.model_name = model_name
         self.max_retries = int(max_retries)
         self.upstream_timeout = float(upstream_timeout)
+        # jittered sleep before each failover retry: after a mass event
+        # (worker death under load) every relay would otherwise hammer
+        # the survivors in the same instant
+        self.retry_backoff_s = float(retry_backoff_s)
         if enable_tracing:
             _tracing.get_tracer().enable()
         self._tracer = _tracing.get_tracer()
@@ -180,7 +202,98 @@ class RouterServer:
         return False
 
     def _post_handler(self, route):
-        return self._complete if route == "/v1/completions" else None
+        if route == "/v1/completions":
+            return self._complete
+        if route == "/drain":
+            return self._drain
+        return None
+
+    # ---- graceful drain --------------------------------------------------
+    def _drain(self, handler, req):
+        """``POST /drain {"replica_id": N}``: gracefully drain a worker —
+        stop its admission, migrate its live slots to peers (zero token
+        loss), then release its pool lease. Answers the drain summary."""
+        try:
+            replica = int(req["replica_id"])
+        except (KeyError, TypeError, ValueError):
+            return handler._json(400, {
+                "error": "drain needs an integer 'replica_id'"})
+        try:
+            summary = self.drain_worker(
+                replica, timeout=float(req.get("timeout", 60.0)))
+        except ValueError as e:
+            return handler._json(404, {"error": str(e)})
+        except Exception as e:
+            return handler._json(502, {
+                "error": f"drain failed: {type(e).__name__}: {e}"})
+        return handler._json(200, summary)
+
+    def drain_worker(self, replica_id: int, timeout: float = 60.0) -> dict:
+        """Drain one worker: mark it draining in the pool (no new
+        placements), stop its admission (worker ``/drain``), migrate
+        every active slot to a peer with a handoff channel (the relays
+        follow their migrate markers), wait for the worker to empty, and
+        release its lease. Slots that cannot migrate (no destination,
+        n>1 sibling groups) finish locally — the drain waits them out.
+
+        Upgrades scale-down and deploys from "kill and re-prefill" to
+        zero-token-loss: a migrated stream is token-identical and its
+        SSE delivery continuous."""
+        w = self.pool.get(int(replica_id))
+        if w is None or not w.alive:
+            raise ValueError(f"no live worker {replica_id} in the pool")
+        self.pool.set_draining(replica_id)
+        migrated, failed = [], []
+        deadline = time.monotonic() + float(timeout)
+        drained = False
+        while time.monotonic() < deadline:
+            status, body = self._post_json(w, "/drain", {}, None)
+            if status != 200:
+                raise RuntimeError(
+                    f"worker {replica_id} refused /drain: {status} "
+                    f"{body.get('error', body)}")
+            active = [int(r) for r in body.get("active") or []]
+            if not (active or body.get("queued")
+                    or body.get("prefilling")):
+                drained = True
+                break
+            for rid in active:
+                dst = self.pool.select(roles=("decode", "unified"),
+                                       exclude=(int(replica_id),))
+                if dst is None or not dst.kv_channel:
+                    if dst is not None:
+                        self.pool.release(dst)
+                    # no migration destination: the slot finishes
+                    # locally, the drain loop waits it out
+                    if rid not in failed:
+                        failed.append(rid)
+                    continue
+                hid = uuid.uuid4().hex
+                try:
+                    st, resp = self._post_json(
+                        w, "/v1/migrate_out",
+                        {"rid": rid, "channel": dst.kv_channel,
+                         "dst": dst.replica_id, "handoff_id": hid}, None)
+                finally:
+                    self.pool.release(dst)
+                if st == 200:
+                    migrated.append(rid)
+                    if rid in failed:
+                        failed.remove(rid)
+                elif rid not in failed:
+                    # 409: finished / not yet decoding — next round
+                    failed.append(rid)
+            time.sleep(0.1)
+        released = False
+        if drained:
+            st, _resp = self._post_json(w, "/v1/release", {}, None)
+            released = (st == 200)
+        get_logger().info(
+            "router: drained worker %s (migrated=%s, local=%s, "
+            "released=%s)", replica_id, migrated, failed, released)
+        return {"replica_id": int(replica_id), "drained": drained,
+                "migrated": migrated, "finished_locally": failed,
+                "released": released}
 
     # ---- placement -------------------------------------------------------
     def _plan(self, exclude: Tuple[int, ...]):
@@ -218,16 +331,43 @@ class RouterServer:
         state = {"headers_sent": False, "delivered": 0}
         exclude: Tuple[int, ...] = ()
         attempts = 0
+        hops = 0      # planned migration continuations (not failures)
+        cont = None   # migrate-marker info pinning the next hop
         last_reason = "no live worker available"
         busy: Optional[_WorkerBusy] = None
         root = handler._trace_span
-        while attempts <= self.max_retries:
-            plan = self._plan(exclude)
-            if plan is None:
-                break
-            mode, pre, serve = plan
-            attempts += 1
+        while attempts <= self.max_retries and hops <= self.max_migrations:
             rec = _frec.RECORDER
+            pre = None
+            if cont is not None:
+                # a migrate marker pinned the destination: follow the
+                # stream there by claiming its handoff id — a PLANNED
+                # hop, so it spends max_migrations, not the retry budget
+                info, cont = cont, None
+                serve = self.pool.get(int(info.get("dst", -1)))
+                if serve is None or not serve.alive:
+                    # the drain's destination vanished before the
+                    # continuation landed: fall back to a full replay
+                    attempts += 1
+                    last_reason = (f"migration destination "
+                                   f"{info.get('dst')} left the pool")
+                    self._count_outcome("retried")
+                    continue
+                self.pool.claim(serve)
+                hops += 1
+                mode = "migrate"
+                # the destination streams only NEW tokens, numbered from
+                # the bundle's generated count
+                base = int(info.get("generated", state["delivered"]))
+                up_req = {"handoff_id": info["handoff_id"],
+                          "stream": stream}
+            else:
+                plan = self._plan(exclude)
+                if plan is None:
+                    break
+                mode, pre, serve = plan
+                attempts += 1
+                base = 0
             if rec.enabled:
                 rec.record(_frec.EV_ROUTER_PLACE,
                            replica_id=serve.replica_id, role=serve.role,
@@ -238,15 +378,18 @@ class RouterServer:
                 attrs={"replica_id": serve.replica_id, "role": serve.role,
                        "attempt": attempts, "mode": mode})
             try:
-                up_req = req
-                if mode == "disagg":
-                    hid = self._prefill_hop(pre, serve, req, sp)
-                    up_req = {k: v for k, v in req.items()
-                              if k not in ("prompt", "prompt_token_ids",
-                                           "pixel_values")}
-                    up_req["handoff_id"] = hid
+                if mode != "migrate":
+                    up_req = req
+                    if mode == "disagg":
+                        hid = self._prefill_hop(pre, serve, req, sp)
+                        up_req = {k: v for k, v in req.items()
+                                  if k not in ("prompt",
+                                               "prompt_token_ids",
+                                               "pixel_values")}
+                        up_req["handoff_id"] = hid
                 if stream:
-                    self._proxy_stream(handler, serve, up_req, state, sp)
+                    self._proxy_stream(handler, serve, up_req, state, sp,
+                                       base=base)
                 else:
                     status, body = self._post_json(
                         serve, "/v1/completions", up_req, sp)
@@ -256,10 +399,22 @@ class RouterServer:
                         raise _UpstreamError(
                             f"worker {serve.replica_id} answered "
                             f"{status}: {body.get('error', body)}")
+                    if isinstance(body, dict) and body.get("migrated"):
+                        raise _Migrated(body["migrated"])
                     handler._json(200, body)
                 sp.end()
                 self._count_outcome("placed")
                 return
+            except _Migrated as e:
+                sp.end()  # the upstream hop SUCCEEDED — by migrating
+                cont = e.info
+                if rec.enabled:
+                    rec.record(_frec.EV_ROUTER_RETRY,
+                               replica_id=serve.replica_id,
+                               attempt=attempts,
+                               delivered=state["delivered"],
+                               reason=("migrated to "
+                                       f"{e.info.get('dst')}"))
             except _ClientError as e:
                 sp.end("error")
                 handler._json(e.status, e.body)
@@ -289,7 +444,16 @@ class RouterServer:
                 last_reason = e.reason
                 if e.dead is not None:
                     self.pool.mark_dead(e.dead.replica_id, "connection")
-                exclude = exclude + (serve.replica_id,) + tuple(e.exclude)
+                if e.dead is not None or mode != "disagg":
+                    blame = (serve.replica_id,)
+                else:
+                    # a disagg decode worker answering 5xx is usually
+                    # reporting a BUNDLE problem (handoff never arrived,
+                    # checksum refused) — the worker is innocent, so a
+                    # retry may re-plan the same pair with a freshly
+                    # exported bundle instead of exhausting the pool
+                    blame = ()
+                exclude = exclude + blame + tuple(e.exclude)
                 if rec.enabled:
                     rec.record(_frec.EV_ROUTER_RETRY,
                                replica_id=serve.replica_id,
@@ -301,6 +465,10 @@ class RouterServer:
                     "router: placement attempt %s on replica %s failed "
                     "(%s); requeueing", attempts, serve.replica_id,
                     e.reason)
+                if self.retry_backoff_s > 0:
+                    # jittered, so a mass failure doesn't stampede every
+                    # relay onto the survivors in the same instant
+                    time.sleep(jittered(self.retry_backoff_s))
             finally:
                 self.pool.release(serve)
                 if pre is not None:
@@ -341,6 +509,7 @@ class RouterServer:
                    span) -> Tuple[int, dict]:
         """One upstream POST, full-body; transport failures raise
         _UpstreamError naming the worker as observed-dead."""
+        self._chaos_upstream(worker, path)
         conn = http.client.HTTPConnection(worker.host, worker.port,
                                           timeout=self.upstream_timeout)
         try:
@@ -391,10 +560,28 @@ class RouterServer:
                 f"{resp.get('error', resp)}", exclude=(pre.replica_id,))
         return hid
 
+    def _chaos_upstream(self, worker: WorkerInfo, path: str):
+        """router.upstream injection point: a planned http_500 fails the
+        placement attempt exactly like a worker 5xx would (retryable,
+        worker NOT marked dead), a delay stalls the hop."""
+        fault = _chaos.on("router.upstream",
+                          replica_id=worker.replica_id, path=path)
+        if fault is not None:
+            if fault.action == "http_500":
+                raise _UpstreamError(
+                    f"chaos: injected 5xx placing on worker "
+                    f"{worker.replica_id}")
+            if fault.action == "delay":
+                time.sleep(fault.delay_s)
+
     def _proxy_stream(self, handler, worker: WorkerInfo, body: dict,
-                      state: dict, span):
-        """Relay one SSE stream, skipping the first ``state['delivered']``
-        token chunks (a failover continuation repeats them)."""
+                      state: dict, span, base: int = 0):
+        """Relay one SSE stream, skipping the token chunks the client
+        already has: the upstream's chunks are numbered from ``base``
+        (0 for a full replay, the bundle's generated count for a
+        migration continuation that emits only new tokens), and chunks
+        numbered <= ``state['delivered']`` are dropped."""
+        self._chaos_upstream(worker, "/v1/completions")
         conn = http.client.HTTPConnection(worker.host, worker.port,
                                           timeout=self.upstream_timeout)
         try:
@@ -426,7 +613,7 @@ class RouterServer:
             if not state["headers_sent"]:
                 handler._begin_sse()
                 state["headers_sent"] = True
-            seen = 0
+            seen = int(base)
             while True:
                 try:
                     line = resp.readline()
@@ -449,6 +636,11 @@ class RouterServer:
                     except OSError:
                         raise _ClientGone()
                     return
+                if payload.startswith(b'{"migrated"'):
+                    # planned exit: the slot moved to another worker —
+                    # the relay continues there (every token generated
+                    # before the export was relayed ahead of the marker)
+                    raise _Migrated(json.loads(payload)["migrated"])
                 if payload.startswith(b'{"error"'):
                     # engine-level mid-stream failure: another worker
                     # can finish this request

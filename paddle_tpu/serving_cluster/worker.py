@@ -12,9 +12,15 @@ engine thread, same observability surface) plus the cluster contract:
   decode worker's handoff channel) and refuses completions; ``decode``
   additionally accepts completions whose prompt KV arrives by
   ``handoff_id`` instead of running the prefill itself;
-- **/health** — gains ``role``, ``replica_id`` and ``lease_age_s`` so a
-  load balancer (and the router's aggregate /health) sees both what a
-  worker is and how fresh its membership claim is.
+- **/health** — gains ``role``, ``replica_id``, ``lease_age_s`` and
+  ``draining`` so a load balancer (and the router's aggregate /health)
+  sees both what a worker is and how fresh its membership claim is;
+- **drain / migration** — ``POST /drain`` stops admission and reports
+  the live request ids; ``POST /v1/migrate_out`` exports one decoding
+  slot as a sealed bundle, ships it to a peer's handoff channel, and
+  ends the departing SSE stream with a migrate marker (the router's
+  relay follows it); ``POST /v1/release`` gives up the pool lease once
+  the drain emptied the worker.
 
 ``python -m paddle_tpu.serving_cluster.worker '<json cfg>'`` is the
 process entry the launcher (scripts/serve_cluster.py) spawns.
@@ -26,10 +32,12 @@ import os
 import signal
 import sys
 import threading
+import time
 import uuid
 from typing import Optional
 
 from ..analysis.threads.witness import make_lock
+from ..chaos import inject as _chaos
 from ..distributed.elastic import ElasticManager
 from ..distributed.log_utils import get_logger
 from ..serving_http import CompletionServer, EngineCommand, _Submission
@@ -54,6 +62,69 @@ class _ExportPrefill(EngineCommand):
                                      max_new_tokens=self.max_new_tokens)
 
 
+class _ListLive(EngineCommand):
+    """Engine-thread command: the live request ids by lifecycle stage —
+    what a drain still has to move (active slots migrate; queued and
+    mid-prefill requests become active first and migrate next round)."""
+
+    def execute(self, engine):
+        d = engine.debug_state()
+        return {
+            "active": [s["rid"] for s in d["slots"] if s is not None],
+            "queued": list(d["queue"]),
+            "prefilling": [v["rid"] for v in d["prefilling"].values()],
+        }
+
+
+class _ExportSlot(EngineCommand):
+    """Engine-thread command: export one decoding slot as a migration
+    bundle and detach its live submission (the handler thread ships the
+    bundle and ends the stream with a migrate marker)."""
+
+    def __init__(self, server: "WorkerServer", rid: int):
+        super().__init__()
+        self.server = server
+        self.rid = rid
+
+    def execute(self, engine):
+        sub = self.server._live_subs.get(self.rid)
+        if sub is not None and sub.n > 1:
+            raise ValueError(
+                f"request {self.rid} is one of n={sub.n} sibling "
+                "completions — sibling groups finish locally instead of "
+                "migrating")
+        bundle = engine.export_slot(self.rid)
+        self.server._live_subs.pop(self.rid, None)
+        return bundle, sub
+
+
+class _AdmitMigrated(EngineCommand):
+    """Engine-thread command: re-admit an exported bundle LOCALLY — the
+    fallback when the migration send fails after the slot was already
+    exported (the stream continues here as if nothing happened)."""
+
+    def __init__(self, server: "WorkerServer", bundle: dict, sub):
+        super().__init__()
+        self.server = server
+        self.bundle = bundle
+        self.sub = sub
+
+    def execute(self, engine):
+        sub = self.sub
+        if sub is None:
+            return engine.admit_migrated(self.bundle)
+        ev = sub.events
+
+        def on_token(rid, tok, done, logprob, _ev=ev):
+            _ev.put(("token", (rid, tok, logprob), done))
+
+        rid = engine.admit_migrated(self.bundle, on_token=on_token,
+                                    trace_ctx=sub.trace_ctx)
+        sub.rids.append(rid)
+        self.server._live_subs[rid] = sub
+        return rid
+
+
 class WorkerServer(CompletionServer):
     """CompletionServer speaking the cluster protocol for one role."""
 
@@ -72,6 +143,13 @@ class WorkerServer(CompletionServer):
         self._handoff_wait_s = float(handoff_wait_s)
         self._senders = {}           # channel name -> KvHandoffSender
         self._senders_lock = make_lock("WorkerServer._senders_lock")
+        # drain: admission stops, live slots migrate off, lease releases
+        self.draining = False
+        # rid -> live _Submission; ENGINE-THREAD ONLY (written in
+        # _handle_submission and the migrate command, both of which run
+        # on the engine thread) — the map that lets a migrate-out hand
+        # the departing stream its marker event
+        self._live_subs = {}
         if self._kv is not None:
             self._kv.start()
 
@@ -92,17 +170,135 @@ class WorkerServer(CompletionServer):
             "role": self.role,
             "replica_id": self.replica_id,
             "lease_age_s": lease_age,
+            "draining": self.draining,
             "kv_channel": (self._kv.name if self._kv is not None
                            else None),
         }
 
+    def _handle_submission(self, sub):
+        # engine thread: index live submissions by their engine rids so a
+        # migrate-out can detach the right stream; pruned lazily against
+        # the engine's live set (finished rids linger briefly, harmless)
+        super()._handle_submission(sub)
+        if isinstance(sub, _Submission):
+            for rid in sub.rids:
+                self._live_subs[rid] = sub
+            if len(self._live_subs) > 4 * max(self.engine.max_batch, 1):
+                eng = self.engine
+                live = {r.rid for r in eng._slots if r is not None}
+                live |= {r.rid for r in eng._queue}
+                live |= {st.req.rid
+                         for st in getattr(eng, "_chunking", {}).values()}
+                self._live_subs = {rid: s
+                                   for rid, s in self._live_subs.items()
+                                   if rid in live}
+
     def _post_handler(self, route):
+        fn = self._route_post(route)
+        if fn is None:
+            return None
+        fault = _chaos.on("worker.request", route=route)
+        if fault is not None:
+            if fault.action == "http_500":
+                return lambda handler, req: handler._json(
+                    500, {"error": "chaos: injected worker fault"})
+            if fault.action == "stall_heartbeat":
+                if self._elastic is not None:
+                    self._elastic.pause_heartbeat(
+                        fault.duration_s or 3.0 * self._elastic.ttl)
+            elif fault.action == "delay":
+                time.sleep(fault.delay_s)
+        return fn
+
+    def _route_post(self, route):
+        if route == "/drain":
+            return self._drain_post
+        if route == "/v1/migrate_out":
+            return self._migrate_out_post
+        if route == "/v1/release":
+            return self._release_post
         if route == "/v1/prefill" and self.role in ("prefill", "unified"):
             return self._prefill_post
         return super()._post_handler(route)
 
+    # ---- drain / migration ----------------------------------------------
+    def _drain_post(self, handler, req):
+        """Stop admission and report what is still live. Idempotent: the
+        router's drain loop re-POSTs to watch the worker empty out while
+        it migrates the active slots via /v1/migrate_out."""
+        self.draining = True
+        try:
+            live = self.submit_command(_ListLive())
+        except Exception as e:
+            return handler._json(500, {"error": f"{type(e).__name__}: {e}"})
+        return handler._json(200, {"draining": True,
+                                   "replica_id": self.replica_id, **live})
+
+    def _migrate_out_post(self, handler, req):
+        """Export one decoding slot and ship it to a peer's handoff
+        channel. The departing stream ends with a migrate marker naming
+        the handoff id + destination; if the SEND fails, the bundle is
+        re-admitted locally so the stream continues here instead of
+        stranding the client."""
+        try:
+            rid = int(req["rid"])
+            channel = req.get("channel")
+            if not channel:
+                raise ValueError("migrate_out needs 'channel' — the "
+                                 "destination worker's kv handoff channel")
+            dst = req.get("dst")
+            hid = str(req.get("handoff_id") or uuid.uuid4().hex)
+        except (KeyError, TypeError, ValueError) as e:
+            return handler._json(400, {"error": str(e)})
+        try:
+            bundle, sub = self.submit_command(_ExportSlot(self, rid))
+        except ValueError as e:
+            # not actively decoding (queued / prefilling / finished) or
+            # an n>1 sibling group: nothing exported, caller may retry
+            # next drain round
+            return handler._json(409, {"error": str(e)})
+        except Exception as e:
+            return handler._json(500, {"error": f"{type(e).__name__}: {e}"})
+        generated = int(len(bundle["tokens"]))
+        try:
+            nbytes = self._sender(channel).send(hid, bundle)
+        except Exception as e:
+            get_logger().warning(
+                "migrate_out %s -> %s failed (%s: %s); re-admitting "
+                "locally", hid, channel, type(e).__name__, e)
+            self.submit_command(_AdmitMigrated(self, bundle, sub))
+            return handler._json(502, {
+                "error": f"migration send failed ({type(e).__name__}: "
+                         f"{e}); request re-admitted locally"})
+        if sub is not None:
+            sub.events.put(("migrated",
+                            {"handoff_id": hid, "dst": dst,
+                             "generated": generated}, True))
+        return handler._json(200, {
+            "handoff_id": hid, "channel": channel, "dst": dst,
+            "rid": rid, "generated": generated, "bytes": nbytes,
+        })
+
+    def _release_post(self, handler, req):
+        """Release the pool lease after a drain: the pool sees the lease
+        lapse (no churn alarm — the drain was deliberate) and the worker
+        process can be torn down at leisure."""
+        if not self.draining:
+            return handler._json(409, {
+                "error": "release without drain — POST /drain first"})
+        if self._elastic is not None:
+            self._elastic.mark_done()
+        return handler._json(200, {"released": True,
+                                   "replica_id": self.replica_id})
+
     # ---- completions (decode side of the handoff) -----------------------
     def _complete(self, handler, req):
+        if self.draining:
+            # admission is closed; the router's placement already skips
+            # draining workers, so this only catches racing requests
+            return handler._json(503, {
+                "error": f"worker {self.replica_id} is draining; "
+                         "re-place this request"})
         if "handoff_id" in req:
             if self._kv is None:
                 return handler._json(409, {
@@ -120,21 +316,38 @@ class WorkerServer(CompletionServer):
         hid = str(req["handoff_id"])
         bundle = self._kv.wait(hid, timeout=self._handoff_wait_s)
         if bundle is None:
-            # the prefill worker never delivered (died mid-handoff):
-            # a 5xx here is what turns into a router retry
+            # the sender never delivered (died mid-handoff, or the
+            # transport dropped the bundle): a 5xx here is what turns
+            # into a router retry
             return handler._json(504, {
                 "error": f"kv handoff {hid} not received within "
                          f"{self._handoff_wait_s}s"})
+        sp = handler._trace_span
+        trace_ctx = ((sp.trace_id, sp.span_id) if sp is not None else None)
+        cid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        if bundle.get("kind") == "migrate":
+            # migration continuation: every decode-side knob rides the
+            # bundle; the stream emits only NEW tokens (the relay already
+            # delivered the rest), a collect prepends them
+            sub = _Submission(None, {}, handoff=bundle,
+                              trace_ctx=trace_ctx)
+            self._subs.put(sub)
+            want_logprobs = bool(bundle.get("want_logprobs"))
+            if req.get("stream"):
+                return self._stream(handler, sub, cid, want_logprobs)
+            prior = [int(t) for t in bundle["tokens"]]
+            prior_lp = [float(x) for x in bundle.get("logprobs") or []]
+            return self._collect(handler, sub, cid,
+                                 int(bundle["prompt_tokens"]),
+                                 want_logprobs, prior_tokens=prior,
+                                 prior_logprobs=prior_lp)
         try:
             params, want_logprobs = self._parse_decode_params(req)
         except (ValueError, TypeError) as e:
             return handler._json(400, {"error": str(e)})
-        sp = handler._trace_span
         sub = _Submission(None, params, handoff=bundle,
-                          trace_ctx=((sp.trace_id, sp.span_id)
-                                     if sp is not None else None))
+                          trace_ctx=trace_ctx)
         self._subs.put(sub)
-        cid = f"cmpl-{uuid.uuid4().hex[:24]}"
         n_prompt = int(bundle["prompt_tokens"])
         if req.get("stream"):
             return self._stream(handler, sub, cid, want_logprobs)
@@ -175,6 +388,9 @@ class WorkerServer(CompletionServer):
 
     # ---- the prefill hop -------------------------------------------------
     def _prefill_post(self, handler, req):
+        if self.draining:
+            return handler._json(503, {
+                "error": f"worker {self.replica_id} is draining"})
         try:
             ids = self._prompt_ids(req)
             max_tokens = int(req.get("max_tokens", 16))
@@ -297,9 +513,15 @@ def run_worker(cfg: dict):
         from ..observability.flightrecorder import install_reporter
 
         install_reporter(cfg["incident_dir"])
+    # chaos: a plan exported by the launcher/dryrun installs here with
+    # this worker's scope, arming the in-process injection points
+    # (kv_handoff.send, worker.request, worker.step)
+    injector = _chaos.install_from_env(scope=f"worker:{replica_id}")
 
     model = build_model(cfg.get("model", {}))
     engine = ContinuousBatchEngine(model, **cfg.get("engine", {}))
+    if injector is not None:
+        _chaos.arm_engine(engine, injector)
 
     kv_receiver = None
     if role in ("decode", "unified"):
@@ -312,6 +534,8 @@ def run_worker(cfg: dict):
                              ttl=ttl, job_id=job_id)
     srv = WorkerServer(engine, role=role, replica_id=replica_id,
                        elastic=elastic, kv_receiver=kv_receiver,
+                       handoff_wait_s=float(cfg.get("handoff_wait_s",
+                                                    30.0)),
                        model_name=cfg.get("model_name", "paddle-tpu"),
                        host=cfg.get("host", "127.0.0.1"),
                        port=int(cfg.get("port", 0)))

@@ -453,12 +453,20 @@ class CompletionServer:
 
         try:
             if sub.handoff is not None:
-                # disaggregated tier: the prompt's KV arrived from a
-                # prefill worker; admit it without a local prefill
-                sub.rids.append(
-                    eng.admit_prefilled(sub.handoff, on_token=on_token,
-                                        trace_ctx=sub.trace_ctx,
-                                        **sub.params))
+                if sub.handoff.get("kind") == "migrate":
+                    # live migration: the bundle carries the decode-side
+                    # request state (sampling, stops, budget) — admission
+                    # takes no params, the stream resumes mid-decode
+                    sub.rids.append(
+                        eng.admit_migrated(sub.handoff, on_token=on_token,
+                                           trace_ctx=sub.trace_ctx))
+                else:
+                    # disaggregated tier: the prompt's KV arrived from a
+                    # prefill worker; admit it without a local prefill
+                    sub.rids.append(
+                        eng.admit_prefilled(sub.handoff, on_token=on_token,
+                                            trace_ctx=sub.trace_ctx,
+                                            **sub.params))
             else:
                 for _ in range(sub.n):
                     sub.rids.append(
@@ -652,9 +660,12 @@ class CompletionServer:
             return self._stream(handler, sub, cid, want_logprobs)
         return self._collect(handler, sub, cid, len(ids), want_logprobs)
 
-    def _collect(self, handler, sub, cid, n_prompt, want_logprobs):
+    def _collect(self, handler, sub, cid, n_prompt, want_logprobs,
+                 prior_tokens=None, prior_logprobs=None):
         """Batch (non-stream) response: wait for every token event, then
-        answer one completion object."""
+        answer one completion object. ``prior_tokens``/``prior_logprobs``
+        prepend a migrated-in request's already-generated tokens (the
+        engine only fires on_token for NEW ones)."""
         by_rid, lps_by_rid, err = {}, {}, None
         finished = 0
         while True:
@@ -670,6 +681,12 @@ class CompletionServer:
                 return handler._json(
                     429, {"error": payload["error"]},
                     headers=(("Retry-After", str(payload["retry_after"])),))
+            if kind == "migrated":
+                # the request left this worker mid-decode (drain): hand
+                # the caller the handoff coordinates so the cluster
+                # router can collect the continuation from the
+                # destination worker
+                return handler._json(200, {"migrated": payload})
             if kind in ("error", "fault"):
                 err = (kind, payload)
                 break
@@ -688,6 +705,10 @@ class CompletionServer:
         total_completion = 0
         for i, rid in enumerate(sub.rids):
             toks = by_rid.get(rid, [])
+            if i == 0 and prior_tokens:
+                toks = list(prior_tokens) + toks
+                lps_by_rid[rid] = (list(prior_logprobs or [])
+                                   + lps_by_rid.get(rid, []))
             total_completion += len(toks)
             # single source of truth: the ENGINE records why each
             # request retired (recorded before its done event)
@@ -739,6 +760,21 @@ class CompletionServer:
                         429, {"error": payload["error"]},
                         headers=(("Retry-After",
                                   str(payload["retry_after"])),))
+                if kind == "migrated":
+                    # the request left this worker mid-decode (drain):
+                    # end the stream with a migrate marker and NO [DONE]
+                    # — the cluster router resumes the relay on the
+                    # destination worker; a direct client treats it like
+                    # an unfinished stream
+                    if not started:
+                        handler._begin_sse()
+                        started = True
+                    handler._chunk(
+                        b"data: "
+                        + json.dumps({"migrated": payload}).encode()
+                        + b"\n\n")
+                    clean = False
+                    break
                 if kind in ("error", "fault"):
                     if not started:
                         return handler._json(

@@ -190,6 +190,40 @@ def format_sched(b: dict, last: int = 20) -> List[str]:
     return lines
 
 
+def format_chaos(b: dict, last: int = 20) -> List[str]:
+    """Injected faults vs. migration symptoms, pulled out of the
+    timeline: ``chaos.inject`` rows are what the fault plan DID,
+    ``sched.migrate_out``/``sched.migrate_in`` (and migrate-reason
+    ``router.retry`` rows) are how the cluster moved requests in
+    response — reading them together separates fault from symptom.
+    Absent when nothing was injected or migrated."""
+    chaos = [e for e in b.get("events") or []
+             if e.get("kind") == "chaos.inject"]
+    moves = [e for e in b.get("events") or []
+             if e.get("kind") in ("sched.migrate_out", "sched.migrate_in")
+             or (e.get("kind") == "router.retry"
+                 and "migrated" in str(e.get("reason", "")))]
+    if not chaos and not moves:
+        return []
+    t_end = max(e["mono_ns"] for e in (b.get("events") or chaos + moves))
+    lines = []
+    if chaos:
+        lines.append(f"CHAOS (last {min(last, len(chaos))} of "
+                     f"{len(chaos)} injected faults)")
+        for ev in chaos[-last:]:
+            lines.append(f"  t{_rel_ms(ev, t_end):+10.1f}ms  "
+                         f"{ev.get('action', '?'):<16} "
+                         f"@ {ev.get('point', '?'):<18} "
+                         f"nth={ev.get('nth')} scope={ev.get('scope')}")
+    if moves:
+        lines.append(f"MIGRATION (last {min(last, len(moves))} of "
+                     f"{len(moves)} events)")
+        for ev in moves[-last:]:
+            lines.append(f"  t{_rel_ms(ev, t_end):+10.1f}ms  "
+                         f"{ev['kind']:<18} {_fmt_fields(ev)}")
+    return lines
+
+
 def format_spans(b: dict, last: int = 10) -> List[str]:
     spans = b.get("spans") or []
     if not spans:
@@ -213,6 +247,7 @@ def render(b: dict, events: int = 30, per_subsystem: int = 5,
             format_timeline(b, last=events),
             format_subsystems(b, k=per_subsystem, only=subsystem),
             format_sched(b),
+            format_chaos(b),
             format_engines(b),
             format_spans(b),
             format_lock_witness(b),

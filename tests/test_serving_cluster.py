@@ -111,6 +111,15 @@ class _FakePool:
     def mark_dead(self, replica_id, reason="connection"):
         self._ws[replica_id].alive = False
 
+    def get(self, replica_id):
+        return self._ws.get(replica_id)
+
+    def claim(self, w):
+        w.pending += 1
+
+    def set_draining(self, replica_id, draining=True):
+        self._ws[replica_id].draining = draining
+
     def release(self, w):
         if w.pending > 0:
             w.pending -= 1
@@ -290,6 +299,139 @@ def test_kv_handoff_channel_roundtrip():
             rec.disable()
 
 
+# ---- in-process: live migration (export_slot / admit_migrated) --------------
+
+def test_export_slot_admit_migrated_token_identical():
+    """Mid-decode migration between two engines over the same weights:
+    tokens generated on the source + tokens generated on the destination
+    equal an unmigrated run exactly; on_token on the destination fires
+    only for NEW tokens; sched.migrate_out/in events land in the ring."""
+    model = _ref_model()
+    prompt = np.random.RandomState(11).randint(1, 512, (9,)).tolist()
+    n_tok = 10
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=n_tok).numpy()[0].tolist()
+    rec = frec.get_recorder()
+    was_enabled = rec.enabled
+    rec.enable()
+    try:
+        since = rec.stats()["recorded"]
+        src = ContinuousBatchEngine(model, max_batch=2, max_len=64,
+                                    page_size=8)
+        dst = ContinuousBatchEngine(model, max_batch=2, max_len=64,
+                                    page_size=8)
+        src_toks, dst_toks = [], []
+        rid = src.add_request(prompt, max_new_tokens=n_tok,
+                              on_token=lambda r, t, d: src_toks.append(t),
+                              priority=0, slo_ms=60_000.0,
+                              stop_token_ids=[99999], logprobs=True)
+        for _ in range(4):
+            src.step()
+        bundle = src.export_slot(rid)
+        assert src.num_active == 0 and not src._queue
+        assert bundle["kind"] == "migrate"
+        assert len(bundle["tokens"]) == 4
+        assert src.finish_reason(rid) == "migrated"
+        assert src.stats()["requests_migrated_out"] == 1
+        rid2 = dst.admit_migrated(
+            bundle, on_token=lambda r, t, d: dst_toks.append(t))
+        out = dst.run_until_done()
+        assert src_toks + dst_toks == solo
+        assert out[rid2].tolist() == solo
+        assert dst.finish_reason(rid2) == "length"
+        # decode-side state survived the hop: logprobs cover ALL tokens
+        assert len(dst.logprobs(rid2)) == n_tok
+        assert dst.stats()["requests_migrated_in"] == 1
+        kinds = [e["kind"] for e in rec.events(since=since, kind="sched")]
+        assert "sched.migrate_out" in kinds
+        assert "sched.migrate_in" in kinds
+    finally:
+        if not was_enabled:
+            rec.disable()
+
+
+def test_nonstream_completion_survives_drain_with_prior_tokens():
+    """Non-stream drain path, in-process: worker A answers
+    ``{"migrated": ...}`` for a request mid-collect; the router
+    re-collects from the destination, prepending the bundle's prior
+    tokens — the client sees ONE complete token-identical completion and
+    both engines count the migration."""
+    from paddle_tpu.serving_cluster.kv_handoff import make_receiver
+    from paddle_tpu.serving_cluster.router import RouterServer
+    from paddle_tpu.serving_cluster.worker import WorkerServer
+
+    model = _ref_model()
+    n_tok = 240
+    prompt = np.random.RandomState(31).randint(1, 512, (9,)).tolist()
+    solo = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=n_tok).numpy()[0].tolist()
+    engines = [ContinuousBatchEngine(model, max_batch=2, max_len=256,
+                                     page_size=8) for _ in range(2)]
+    recvs = [make_receiver(name=f"/pdtpu_kv_ns{i}_{os.getpid()}",
+                           capacity_mb=32) for i in range(2)]
+    workers = [WorkerServer(engines[i], role="unified", replica_id=i,
+                            kv_receiver=recvs[i]).start()
+               for i in range(2)]
+    router = None
+    try:
+        pool = _FakePool({i: w.address for i, w in enumerate(workers)})
+        for i in range(2):
+            pool._ws[i].kv_channel = recvs[i].name
+        router = RouterServer(pool, max_retries=2).start()
+        host, port = router.address
+        result = {}
+
+        def post():
+            conn = http.client.HTTPConnection(host, port, timeout=300)
+            conn.request("POST", "/v1/completions",
+                         json.dumps({"prompt_token_ids": prompt,
+                                     "max_tokens": n_tok}),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            result["status"] = r.status
+            result["body"] = json.loads(r.read())
+            conn.close()
+
+        t = threading.Thread(target=post)
+        t.start()
+        # the fake pool's tie-break places on worker 0 first; drain it
+        # the moment its engine is actually decoding the request
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and engines[0].num_active == 0:
+            time.sleep(0.002)
+        assert engines[0].num_active == 1, "request never took a slot"
+        summary = router.drain_worker(0, timeout=60)
+        t.join(timeout=180)
+        assert summary["drained"] and summary["released"], summary
+        assert summary["migrated"], summary
+        assert result["status"] == 200, result
+        choice = result["body"]["choices"][0]
+        assert choice["token_ids"] == solo
+        assert result["body"]["usage"]["completion_tokens"] == n_tok
+        assert engines[0].stats()["requests_migrated_out"] == 1
+        assert engines[1].stats()["requests_migrated_in"] == 1
+    finally:
+        if router is not None:
+            router.close()
+        for w in workers:
+            w.close()
+
+
+def test_export_slot_only_active_slots_migrate():
+    model = _ref_model()
+    eng = ContinuousBatchEngine(model, max_batch=1, max_len=64,
+                                page_size=8)
+    r_active = eng.add_request([1, 2, 3], max_new_tokens=4)
+    r_queued = eng.add_request([4, 5, 6], max_new_tokens=4)
+    eng.step()
+    with pytest.raises(ValueError, match="no decoding slot"):
+        eng.export_slot(r_queued)
+    with pytest.raises(ValueError, match="no decoding slot"):
+        eng.export_slot(12345)
+    bundle = eng.export_slot(r_active)
+    assert bundle["kind"] == "migrate"
+
+
 # ---- pool membership over real leases ---------------------------------------
 
 def test_pool_lease_membership_and_loss():
@@ -364,6 +506,84 @@ def test_pool_lease_membership_and_loss():
         kinds = [e["kind"] for e in rec.events(since=since)]
         assert "router.worker_lost" in kinds
         assert snap[0]["alive"]
+        pool.close()
+    finally:
+        for m in workers:
+            m.close()
+        store.close()
+        if not was_enabled:
+            rec.disable()
+
+
+def test_pool_lease_expiry_reap_requeue_rejoin():
+    """Satellite: a worker whose heartbeat STALLS past its lease (process
+    alive — pause, not stop) is reaped (router.worker_lost, reason
+    lease), its pending placements are requeued (pending reset so the
+    retry path re-places them), it stays out of placement while stalled,
+    and it rejoins ONLY on a fresh post-stall lease stamp."""
+    from paddle_tpu.distributed.elastic import ElasticManager
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.serving_cluster import WorkerPool
+
+    rec = frec.get_recorder()
+    was_enabled = rec.enabled
+    rec.enable()
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=3)
+    workers = []
+    try:
+        for r in range(2):
+            m = ElasticManager(store=store, rank=r, world_size=2,
+                               ttl=1.0, job_id="leasetest")
+            m.register()
+            m.register_metadata({"host": "127.0.0.1", "port": 2000 + r,
+                                 "role": "unified", "pid": 0,
+                                 "kv_channel": None})
+            workers.append(m)
+        pool = WorkerPool(store=store, world_size=2, job_id="leasetest",
+                          ttl=1.0, probe_timeout=0.2)
+        pool.refresh()
+        assert {w["replica_id"] for w in pool.workers()
+                if w["alive"]} == {0, 1}
+
+        # a placement is in flight on worker 1 when its heartbeat stalls
+        w1 = pool.get(1)
+        sel = pool.select(exclude=(0,))
+        assert sel.replica_id == 1 and w1.pending == 1
+        pause_s = 3.0
+        t_pause = time.monotonic()
+        workers[1].pause_heartbeat(pause_s)
+        since = rec.stats()["recorded"]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and w1.alive:
+            time.sleep(0.2)
+            pool.refresh()
+        assert not w1.alive, "stalled lease never reaped"
+        evs = rec.events(since=since)
+        lost = [e for e in evs if e["kind"] == "router.worker_lost"]
+        assert lost and lost[0]["replica_id"] == 1
+        assert lost[0]["reason"] == "lease"
+        # pending placements were requeued: the reap zeroed the count so
+        # the retry path re-places without phantom load on the corpse
+        assert w1.pending == 0
+        # while stalled, placement never offers the reaped worker
+        assert pool.select(exclude=(0,)) is None
+
+        # rejoin happens ONLY on a fresh stamp: the worker stays dead
+        # for the remainder of the pause, then the first post-pause beat
+        # readmits it
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not w1.alive:
+            time.sleep(0.2)
+            pool.refresh()
+            if not w1.alive:
+                # every refresh during the stall must keep it dead
+                assert (time.monotonic() - t_pause) < pause_s + 5
+        assert w1.alive, "fresh post-stall lease never rejoined"
+        assert (time.monotonic() - t_pause) >= pause_s - 0.5, \
+            "rejoined on a stale pre-stall stamp"
+        got = pool.select(exclude=(0,))
+        assert got is not None and got.replica_id == 1
+        pool.release(got)
         pool.close()
     finally:
         for m in workers:
@@ -620,6 +840,115 @@ def test_cluster_prefill_decode_disaggregation():
         assert resp.status == 409
         resp.read()
         conn.close()
+
+
+# ---- live migration + drain dryrun gate -------------------------------------
+
+def test_cluster_gate_drain_migrates_live_streams():
+    """THE migration gate: streams mid-decode on a 2-worker cluster,
+    then POST /drain {replica_id: 0} on the router — worker 0's live
+    slots migrate to worker 1 over the kv_handoff transport with zero
+    token loss: every stream stays continuous (one SSE connection, clean
+    [DONE]) and token-identical to an undrained run; sched.migrate_out
+    fires on the source, sched.migrate_in on the destination; the
+    drained worker releases its lease and leaves the pool."""
+    from paddle_tpu.serving_cluster import launch_cluster
+
+    model = _ref_model()
+    rng = np.random.RandomState(21)
+    n_tok = 64
+    prompts = [rng.randint(1, 512, (9,)).tolist() for _ in range(4)]
+    solos = [model.generate(paddle.to_tensor(np.asarray(p)[None]),
+                            max_new_tokens=n_tok).numpy()[0].tolist()
+             for p in prompts]
+    with launch_cluster(_cluster_cfg(
+            [{"role": "unified", "count": 2}])) as cluster:
+        host, port = cluster.address
+
+        # warm both workers' compile caches so the drain lands while
+        # every stream has most of its tokens still undelivered
+        def warm(i):
+            conn = http.client.HTTPConnection(host, port, timeout=300)
+            conn.request("POST", "/v1/completions",
+                         json.dumps({"prompt_token_ids": prompts[i],
+                                     "max_tokens": 1}),
+                         {"Content-Type": "application/json"})
+            assert conn.getresponse().status == 200
+            conn.close()
+
+        warmers = [threading.Thread(target=warm, args=(i,))
+                   for i in range(4)]
+        for t in warmers:
+            t.start()
+        for t in warmers:
+            t.join(timeout=300)
+
+        results = [None] * len(prompts)
+        first = [threading.Event() for _ in prompts]
+
+        def client(i):
+            results[i] = _stream_completion(
+                host, port,
+                {"prompt_token_ids": prompts[i], "max_tokens": n_tok,
+                 "stream": True},
+                on_first_token=first[i].set)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for ev in first:
+            assert ev.wait(180), "a stream never produced a first token"
+
+        # every stream is mid-decode: drain worker 0 through the router
+        conn = http.client.HTTPConnection(host, port, timeout=180)
+        conn.request("POST", "/drain",
+                     json.dumps({"replica_id": 0, "timeout": 90}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        summary = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200, summary
+        assert summary["drained"], summary
+        assert summary["released"], summary
+        assert summary["migrated"], \
+            f"drain moved nothing (streams were live): {summary}"
+
+        for t in threads:
+            t.join(timeout=300)
+        for i, (clean, toks, _) in enumerate(results):
+            assert clean, f"stream {i} did not end with [DONE]"
+            assert toks == solos[i], f"stream {i} tokens diverged"
+
+        # migration decisions are flight-recorder events on both sides
+        health = _get_json(f"http://{host}:{port}/health")
+        w0, w1 = health["workers"]["0"], health["workers"]["1"]
+        out_evs = _get_json(w0["url"]
+                            + "/debug/events?kind=sched")["events"]
+        assert any(e["kind"] == "sched.migrate_out" for e in out_evs), \
+            [e["kind"] for e in out_evs]
+        in_evs = _get_json(w1["url"]
+                           + "/debug/events?kind=sched")["events"]
+        assert any(e["kind"] == "sched.migrate_in" for e in in_evs), \
+            [e["kind"] for e in in_evs]
+        # the drained worker refuses new admissions...
+        conn = http.client.HTTPConnection(
+            w0["url"].split("//")[1].split(":")[0],
+            int(w0["url"].rsplit(":", 1)[1]), timeout=30)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt_token_ids": prompts[0],
+                                 "max_tokens": 2}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 503, resp.read()
+        resp.read()
+        conn.close()
+        # ...and its released lease takes it out of the pool: placement
+        # lands everything on the survivor
+        clean, toks, _ = _stream_completion(
+            host, port, {"prompt_token_ids": prompts[0],
+                         "max_tokens": 4, "stream": True})
+        assert clean and toks == solos[0][:4]
 
 
 # ---- launcher config plumbing -----------------------------------------------
