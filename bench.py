@@ -535,6 +535,94 @@ def mixed_serve_bench(devs, gen):
     print(json.dumps(rec))
 
 
+def load_bench(devs, gen):
+    """BENCH_CONFIG=load: the traffic-replay & saturation harness
+    (paddle_tpu.loadgen) against an in-process serving_http server —
+    a QPS sweep locates the saturation knee, then a 2x-knee overload
+    run with a priority/SLO class mix records goodput-under-SLO, p99
+    TTFT per class, and the shed/429/504 accounting. The headline value
+    is goodput tokens/s at the knee; CPU smoke persists the record
+    schema under BENCH_STATE.json:cpu_smoke.load for the next TPU
+    capture."""
+    import paddle_tpu as paddle
+    from paddle_tpu.loadgen import (WorkloadSpec, find_knee, run_workload,
+                                    sweep)
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    from paddle_tpu.serving import ContinuousBatchEngine
+    from paddle_tpu.serving_http import CompletionServer
+
+    on_tpu = devs[0].platform == "tpu"
+    cfg = _serving_config(on_tpu)
+    if on_tpu:
+        slots, max_len, max_queue = 16, 512, 64
+        qps_list = (8, 16, 32, 64)
+        duration, prompt_rng, tok_rng = 5.0, (32, 128), (16, 64)
+        slo_hi, slo_lo = 4000.0, 1500.0
+    else:
+        # CPU smoke: capacity deliberately throttled (2 slots, long-ish
+        # outputs, tight low-class SLO) so the ladder brackets a REAL
+        # knee and the 2x-knee overload run exercises 429s and sheds
+        slots, max_len, max_queue = 2, 64, 4
+        qps_list = (4, 8, 16, 32)
+        duration, prompt_rng, tok_rng = 2.5, (4, 10), (8, 16)
+        slo_hi, slo_lo = 3000.0, 400.0
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    eng = ContinuousBatchEngine(model, max_batch=slots, max_len=max_len,
+                                page_size=16, max_queue=max_queue,
+                                aging_s=2.0)
+    spec = WorkloadSpec(
+        qps=qps_list[0], duration_s=duration, process="poisson",
+        prompt_tokens=prompt_rng, max_tokens=tok_rng,
+        classes=((0, slo_hi, 0.2), (1, slo_hi, 0.5), (2, slo_lo, 0.3)),
+        vocab_size=cfg.vocab_size, seed=0)
+    with CompletionServer(eng) as srv:
+        host, port = srv.address
+        url = f"http://{host}:{port}"
+        # warm the prompt-length buckets so the sweep measures serving,
+        # not first-compile time
+        run_workload(url, spec.replace(qps=2.0, duration_s=1.0))
+        curve = sweep(url, spec, qps_list)
+        knee = curve["knee_qps"]
+        overload = run_workload(url, spec.replace(qps=2.0 * knee))
+        knee_pt = next(p for p in curve["points"]
+                       if p["offered_qps"] == knee)
+    rec = {
+        "metric": "llama_load_goodput_tokens_per_sec",
+        "value": knee_pt["goodput"]["tokens_per_s"],
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # no reference load harness exists
+        "platform": devs[0].platform,
+        "knee_qps": knee,
+        "goodput_rps_at_knee": knee_pt["goodput"]["requests_per_s"],
+        "ttft_p99_ms_at_knee": knee_pt["ttft_ms"]["p99"],
+        "sweep": [{
+            "qps": p["offered_qps"],
+            "goodput_ratio": p["goodput"]["ratio"],
+            "ttft_p99_ms": p["ttft_ms"]["p99"],
+            "rejected_429": p["rejected_429"],
+            "shed_504": p["shed_504"],
+        } for p in curve["points"]],
+        "overload_2x_knee": {
+            "qps": overload["offered_qps"],
+            "goodput_ratio": overload["goodput"]["ratio"],
+            "rejected_429": overload["rejected_429"],
+            "shed_504": overload["shed_504"],
+            "http_5xx": overload["http_5xx"],
+            "timed_out": overload["timed_out"],
+            "ttft_p99_ms_top_class":
+                overload["by_priority"]["0"]["ttft_ms"]["p99"],
+            "schedule_digest": overload["schedule_digest"],
+        },
+        "slots": slots,
+        "max_queue": max_queue,
+        "config": "load",
+        "tpu_gen": gen,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    print(json.dumps(rec))
+
+
 def cp_bench(devs, gen):
     """BENCH_CONFIG=cp: context-parallel ring attention (splash kernel per
     hop — VERDICT r4 item 3) at long sequence, reporting ring-vs-direct-
@@ -732,6 +820,8 @@ def _main_inner():
         if os.environ.get("BENCH_SERVE_MIXED"):
             return mixed_serve_bench(devs, gen)
         return serve_bench(devs, gen)
+    if cfg_name == "load":
+        return load_bench(devs, gen)
     if cfg_name == "cp":
         return cp_bench(devs, gen)
     if cfg_name == "pp":

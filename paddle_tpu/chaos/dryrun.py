@@ -104,10 +104,21 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
                page_size: int = 8, ttl: float = 1.5,
                handoff_wait_s: float = 3.0, max_retries: int = 5,
                compile_cache: Optional[str] = None,
-               stream_timeout: float = 420.0) -> dict:
+               stream_timeout: float = 420.0,
+               load_qps: float = 0.0,
+               load_duration_s: float = 4.0) -> dict:
     """Run the fixed-seed chaos plan against a real 1-prefill + 2-decode
     cluster and return the report dict (see module docstring for the
-    claims it checks; ``report["ok"]`` is the verdict)."""
+    claims it checks; ``report["ok"]`` is the verdict).
+
+    With ``load_qps > 0`` the plan additionally fires UNDER GENERATED
+    LOAD: a seeded open-loop Poisson stream (paddle_tpu.loadgen, with a
+    priority/SLO class mix) drives the router concurrently with the
+    hand-built gate streams, and ``report["load"]`` carries the harness
+    summary — every load outcome must be typed (200 / 429 / 504 with
+    ``code=deadline_exceeded``), zero 5xx, zero silent stalls, and the
+    shed accounting must balance (requests_shed == deadline_misses when
+    no bounded queue displaces work)."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -167,6 +178,37 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
         if warm.status != 200:
             raise RuntimeError(
                 f"chaos dryrun warmup failed: {warm.status}")
+
+        # generated load UNDER the fault plan (not idle hand-built
+        # streams): an open-loop seeded mix with SLO classes runs
+        # concurrently with the gate streams below, so the kill / drop
+        # / corrupt / stall / 5xx faults fire while real traffic flows
+        load_outcomes: List = []
+        load_thread = None
+        load_before = None
+        if load_qps > 0:
+            from ..loadgen import (WorkloadSpec, run_schedule,
+                                   stack_stats, synthesize)
+
+            load_spec = WorkloadSpec(
+                qps=load_qps, duration_s=load_duration_s,
+                process="poisson", prompt_tokens=(4, prompt_len),
+                max_tokens=(4, 12),
+                classes=((0, None, 0.4), (1, 8000.0, 0.4),
+                         (2, 2500.0, 0.2)),
+                vocab_size=512, seed=plan.seed + 11)
+            load_schedule = synthesize(load_spec)
+            load_before = stack_stats(f"http://{host}:{port}")
+
+            def _drive_load():
+                load_outcomes.extend(run_schedule(
+                    f"http://{host}:{port}", load_schedule,
+                    stream_timeout=stream_timeout))
+
+            load_thread = threading.Thread(target=_drive_load,
+                                           name="chaos-loadgen",
+                                           daemon=True)
+            load_thread.start()
         results: List[Optional[tuple]] = [None] * streams
 
         def client(i):
@@ -213,6 +255,19 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
             rejoined = bool(w0 and w0["alive"])
             if not rejoined:
                 time.sleep(0.5)
+
+        # wind down the generated-load phase and read the stack's shed
+        # accounting off the survivors' /health counters
+        load_report = None
+        if load_thread is not None:
+            from ..loadgen import stack_stats, summarize
+
+            load_thread.join(timeout=stream_timeout)
+            load_after = stack_stats(f"http://{host}:{port}")
+            load_report = summarize(load_outcomes, load_duration_s,
+                                    offered_qps=load_qps,
+                                    stack_before=load_before,
+                                    stack_after=load_after)
 
         # surviving workers' chaos.inject events (the killed worker's
         # ring died with it — its evidence is the exit code below)
@@ -290,6 +345,7 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
         "stalled_worker_rejoined": rejoined,
         "killed_worker_exit": killed,
         "kill_mopup_ok": mopup_ok,
+        "load": load_report,
         "ok": (all_ok and client_5xx == 0 and corrupt_detected
                and drop_absorbed and rejoined and bool(lost)
                and killed == 137 and mopup_ok),

@@ -1,0 +1,366 @@
+"""Open-loop load harness: drive a serving target at a scheduled rate
+and measure what the SLOs actually got.
+
+Open-loop means arrivals follow the TRACE clock, not the target's
+responses — a saturated server keeps receiving requests exactly like
+production traffic, which is the only way the admission bound, deadline
+shedding and priority ordering ever get exercised. Each scheduled
+request runs on its own (named) thread: POST ``/v1/completions`` with
+``stream: true``, measure TTFT and inter-token gaps off the SSE chunks,
+and classify the outcome — completed, 429 (bounded queue / capacity
+shed, with its Retry-After), 504 (``code=deadline_exceeded``), 5xx
+(always a bug: the saturation gate pins this at zero), timeout (a
+silent stall — also pinned at zero), or a planned client cancel.
+
+:func:`summarize` folds outcomes into the report the ROADMAP asks for —
+p50/p99 TTFT, inter-token latency, **goodput-under-SLO** (completions
+whose first token landed inside their budget), shed/429/504 rates, and
+deltas of the stack's own counters (admitted / finished / rejected /
+shed / deadline misses / preempted / migrated) read from ``/health``
+before and after. :func:`sweep` walks a QPS ladder and
+:func:`find_knee` locates the saturation knee — the highest offered
+rate the target still serves at ≥ ``threshold`` goodput.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+from urllib.parse import urlsplit
+
+from ..distributed.log_utils import get_logger
+from .trace import TraceRequest, trace_digest
+from .workload import WorkloadSpec, synthesize
+
+__all__ = ["Outcome", "run_schedule", "summarize", "stack_stats",
+           "sweep", "find_knee", "run_workload"]
+
+#: the stack counters the harness reads before/after a run (summed over
+#: every live worker when the target is the cluster router)
+_STACK_KEYS = ("requests_admitted", "requests_finished",
+               "requests_cancelled", "requests_rejected", "requests_shed",
+               "deadline_misses", "requests_preempted",
+               "requests_migrated_out", "requests_migrated_in",
+               "tokens_generated")
+
+
+class Outcome:
+    """What one scheduled request actually experienced."""
+
+    __slots__ = ("index", "priority", "slo_ms", "t_sched", "lag_s",
+                 "status", "clean", "cancelled", "timed_out", "error",
+                 "code", "retry_after", "ttft_s", "gaps", "n_tokens")
+
+    def __init__(self, index: int, tr: TraceRequest):
+        self.index = index
+        self.priority = tr.priority
+        self.slo_ms = tr.slo_ms
+        self.t_sched = tr.t
+        self.lag_s = 0.0       # dispatch lag vs the trace clock
+        self.status: Optional[int] = None
+        self.clean = False
+        self.cancelled = False
+        self.timed_out = False
+        self.error: Optional[str] = None
+        self.code: Optional[str] = None
+        self.retry_after: Optional[str] = None
+        self.ttft_s: Optional[float] = None
+        self.gaps: List[float] = []
+        self.n_tokens = 0
+
+    @property
+    def in_slo(self) -> bool:
+        """Completed clean with the first token inside the SLO budget
+        (requests without an SLO count when they complete) — the
+        goodput predicate."""
+        if not (self.status == 200 and self.clean):
+            return False
+        if self.slo_ms is None or self.ttft_s is None:
+            return self.slo_ms is None
+        return self.ttft_s * 1000.0 <= self.slo_ms
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+def _host_port(url: str):
+    u = urlsplit(url if "//" in url else f"http://{url}")
+    return u.hostname or "127.0.0.1", int(u.port or 80)
+
+
+def _one_request(host: str, port: int, tr: TraceRequest, out: Outcome,
+                 timeout: float):
+    body = {"prompt_token_ids": tr.prompt_token_ids,
+            "max_tokens": tr.max_tokens, "stream": True,
+            "priority": tr.priority}
+    if tr.slo_ms is not None:
+        body["slo_ms"] = tr.slo_ms
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    t_sent = time.perf_counter()
+    try:
+        conn.request("POST", "/v1/completions", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out.status = resp.status
+        if resp.status != 200:
+            raw = resp.read()
+            out.retry_after = resp.getheader("Retry-After")
+            try:
+                parsed = json.loads(raw)
+                out.error = parsed.get("error")
+                out.code = parsed.get("code")
+            except ValueError:
+                out.error = raw.decode(errors="replace")
+            return
+        t_last = None
+        while True:
+            line = resp.readline()
+            if not line:
+                break               # EOF without [DONE]: not clean
+            if not line.startswith(b"data: "):
+                continue
+            payload = line[len(b"data: "):].strip()
+            if payload == b"[DONE]":
+                out.clean = True
+                break
+            d = json.loads(payload)
+            if "error" in d:
+                out.error = str(d["error"])
+                out.code = d.get("code")
+                break
+            if "migrated" in d:
+                # a drain moved the stream and no relay is following it
+                # (direct-to-worker target); treat like an unclean end
+                out.error = "migrated"
+                break
+            now = time.perf_counter()
+            if out.n_tokens == 0:
+                out.ttft_s = now - t_sent
+            elif t_last is not None:
+                out.gaps.append(now - t_last)
+            t_last = now
+            out.n_tokens += 1
+            if (tr.cancel_after_s is not None
+                    and now - t_sent >= tr.cancel_after_s):
+                out.cancelled = True
+                break               # close the socket mid-stream
+    except (TimeoutError, http.client.HTTPException, OSError) as e:
+        if isinstance(e, (TimeoutError,)) or "timed out" in str(e):
+            out.timed_out = True
+        out.error = f"{type(e).__name__}: {e}"
+    finally:
+        conn.close()
+
+
+def run_schedule(url: str, schedule: Sequence[TraceRequest], *,
+                 stream_timeout: float = 60.0,
+                 join_timeout: Optional[float] = None) -> List[Outcome]:
+    """Drive ``schedule`` against ``url`` open-loop. Returns one Outcome
+    per scheduled request (same order). The dispatcher sleeps to each
+    arrival offset and spawns the request regardless of how many are
+    still in flight — saturation is the point, not an error."""
+    host, port = _host_port(url)
+    ordered = sorted(range(len(schedule)), key=lambda i: schedule[i].t)
+    outcomes = [Outcome(i, tr) for i, tr in enumerate(schedule)]
+    threads = []
+    t0 = time.perf_counter()
+    for i in ordered:
+        tr = schedule[i]
+        delay = tr.t - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        outcomes[i].lag_s = max(0.0, (time.perf_counter() - t0) - tr.t)
+        th = threading.Thread(
+            target=_one_request, args=(host, port, tr, outcomes[i],
+                                       stream_timeout),
+            name=f"loadgen-req-{i}", daemon=True)
+        threads.append(th)
+        th.start()
+    deadline = time.monotonic() + (join_timeout if join_timeout is not None
+                                   else stream_timeout + 10.0)
+    for th in threads:
+        th.join(timeout=max(0.0, deadline - time.monotonic()))
+        if th.is_alive():
+            get_logger().warning(
+                "loadgen: request thread %s still alive past the join "
+                "deadline (counted as timed out)", th.name)
+    for out, th in zip((outcomes[i] for i in ordered), threads):
+        if th.is_alive():
+            out.timed_out = True
+    return outcomes
+
+
+def _pcts(vals: List[float]) -> Dict[str, float]:
+    import numpy as np
+
+    if not vals:
+        return {"p50": None, "p99": None}
+    a = np.asarray(vals, float) * 1000.0
+    return {"p50": round(float(np.percentile(a, 50)), 3),
+            "p99": round(float(np.percentile(a, 99)), 3)}
+
+
+def _bucket(outs: Sequence[Outcome], duration_s: float) -> dict:
+    completed = [o for o in outs if o.status == 200 and o.clean]
+    good = [o for o in outs if o.in_slo]
+    return {
+        "n": len(outs),
+        "completed": len(completed),
+        "rejected_429": sum(1 for o in outs if o.status == 429),
+        "shed_504": sum(1 for o in outs if o.status == 504),
+        "http_5xx": sum(1 for o in outs
+                        if o.status is not None and o.status >= 500
+                        and o.status != 504),
+        "midstream_error": sum(1 for o in outs if o.status == 200
+                               and not o.clean and not o.cancelled
+                               and not o.timed_out),
+        "cancelled": sum(1 for o in outs if o.cancelled),
+        "timed_out": sum(1 for o in outs if o.timed_out),
+        "untyped": sum(1 for o in outs
+                       if o.status not in (200, 429, 504)),
+        "ttft_ms": _pcts([o.ttft_s for o in completed
+                          if o.ttft_s is not None]),
+        "inter_token_ms": _pcts([g for o in completed for g in o.gaps]),
+        "goodput": {
+            "requests": len(good),
+            "ratio": round(len(good) / len(outs), 4) if outs else None,
+            "requests_per_s": round(len(good) / duration_s, 3),
+            "tokens_per_s": round(sum(o.n_tokens for o in good)
+                                  / duration_s, 1),
+        },
+    }
+
+
+def summarize(outcomes: Sequence[Outcome], duration_s: float,
+              offered_qps: Optional[float] = None,
+              stack_before: Optional[dict] = None,
+              stack_after: Optional[dict] = None,
+              digest: Optional[str] = None) -> dict:
+    """Fold a run's outcomes into the capacity report: overall and
+    per-priority-class latency/goodput/shed buckets, plus the stack's
+    own counter deltas when /health snapshots were taken."""
+    report = _bucket(outcomes, duration_s)
+    report["offered_qps"] = offered_qps
+    report["duration_s"] = duration_s
+    report["schedule_digest"] = digest
+    prios = sorted({o.priority for o in outcomes})
+    report["by_priority"] = {
+        str(p): _bucket([o for o in outcomes if o.priority == p],
+                        duration_s)
+        for p in prios}
+    if stack_before is not None and stack_after is not None:
+        report["stack"] = {
+            k: stack_after.get(k, 0) - stack_before.get(k, 0)
+            for k in _STACK_KEYS}
+    return report
+
+
+def stack_stats(url: str, timeout: float = 10.0) -> dict:
+    """Sum the serving stack's stats() counters behind ``url``: a
+    single-process server reports them on its own /health; the cluster
+    router's /health names every live worker, and each worker's /health
+    carries its engine's stats — the SAME counters either way, so load
+    reports read one schema."""
+    def _get(u):
+        with urllib.request.urlopen(u, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    totals = {k: 0 for k in _STACK_KEYS}
+    try:
+        payload = _get(url.rstrip("/") + "/health")
+    except (OSError, ValueError) as e:
+        get_logger().warning("loadgen: /health read failed (%s: %s)",
+                             type(e).__name__, e)
+        return totals
+    sources = []
+    if "workers" in payload:
+        for w in payload["workers"].values():
+            if not w.get("alive"):
+                continue
+            try:
+                sources.append(_get(w["url"] + "/health"))
+            except (OSError, ValueError) as e:
+                get_logger().warning(
+                    "loadgen: worker /health read failed (%s: %s)",
+                    type(e).__name__, e)
+    else:
+        sources.append(payload)
+    for src in sources:
+        stats = src.get("stats") or {}
+        for k in _STACK_KEYS:
+            totals[k] += int(stats.get(k, 0) or 0)
+    return totals
+
+
+def run_workload(url: str, spec: WorkloadSpec, *,
+                 stream_timeout: float = 60.0) -> dict:
+    """Synthesize + run + summarize one spec (the sweep's unit step).
+    The summary carries the schedule digest so repeat runs are provably
+    over the same traffic."""
+    schedule = synthesize(spec)
+    digest = trace_digest(schedule)
+    before = stack_stats(url)
+    outcomes = run_schedule(url, schedule, stream_timeout=stream_timeout)
+    after = stack_stats(url)
+    return summarize(outcomes, spec.duration_s, offered_qps=spec.qps,
+                     stack_before=before, stack_after=after,
+                     digest=digest)
+
+
+def _wait_idle(url: str, timeout: float = 30.0):
+    """Best-effort drain barrier between sweep points: poll /health
+    until no requests are active or queued anywhere, so point N+1
+    measures its own QPS rather than point N's backlog."""
+    deadline = time.monotonic() + timeout
+    host = url.rstrip("/")
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(host + "/health", timeout=5) as r:
+                payload = json.loads(r.read())
+        except (OSError, ValueError):
+            return
+        if "workers" in payload:
+            busy = sum(w.get("active", 0) + w.get("queued", 0)
+                       for w in payload["workers"].values()
+                       if w.get("alive"))
+        else:
+            busy = payload.get("active", 0) + payload.get("queued", 0)
+        if not busy:
+            return
+        time.sleep(0.1)
+
+
+def find_knee(points: Sequence[dict], threshold: float = 0.85) -> float:
+    """The saturation knee: the highest offered QPS whose goodput ratio
+    stays >= ``threshold`` (points below the knee serve what they are
+    offered; past it, sheds/429s/late TTFTs eat the margin). Falls back
+    to the lowest measured QPS when every point is past saturation."""
+    knee = None
+    for p in sorted(points, key=lambda p: p["offered_qps"]):
+        ratio = (p["goodput"]["ratio"] or 0.0)
+        if ratio >= threshold:
+            knee = p["offered_qps"]
+        else:
+            break
+    return knee if knee is not None else min(
+        p["offered_qps"] for p in points)
+
+
+def sweep(url: str, spec: WorkloadSpec, qps_list: Sequence[float], *,
+          threshold: float = 0.85, stream_timeout: float = 60.0,
+          settle_s: float = 30.0) -> dict:
+    """QPS sweep: run ``spec`` at each offered rate (same seed — the
+    schedules differ only by rate), locate the knee, and return
+    ``{"points": [...], "knee_qps": ...}`` — the capacity curve
+    scheduler/kernel/quantization PRs cite instead of anecdotes."""
+    points = []
+    for q in qps_list:
+        summary = run_workload(url, spec.replace(qps=float(q)),
+                               stream_timeout=stream_timeout)
+        points.append(summary)
+        _wait_idle(url, timeout=settle_s)
+    return {"points": points,
+            "knee_qps": find_knee(points, threshold=threshold)}

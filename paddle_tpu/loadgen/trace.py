@@ -1,0 +1,95 @@
+"""The replayable trace format: one JSONL line per request.
+
+A trace is the *schedule* of an open-loop load run — when each request
+arrives (seconds from run start) and exactly what it asks for — fully
+materialised so the same file drives the same byte-identical request
+sequence against any target (the single-process ``serving_http`` server
+or the cluster router; both speak ``POST /v1/completions``). Recorded
+traces and synthesized ones (:mod:`paddle_tpu.loadgen.workload`) share
+this one format, so "replay last Tuesday's overload" and "replay the
+seeded Poisson burst" are the same code path.
+
+Line schema (sorted keys, so a dumped trace is byte-stable)::
+
+    {"cancel_after_s": null, "max_tokens": 8, "priority": 1,
+     "prompt_token_ids": [17, 3, ...], "slo_ms": 250.0, "t": 0.8134}
+
+``t`` is the arrival offset; ``slo_ms``/``cancel_after_s`` are null when
+absent. The loader round-trips exactly what ``dumps_trace`` wrote.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Iterable, List, Optional
+
+__all__ = ["TraceRequest", "dumps_trace", "dump_trace", "loads_trace",
+           "load_trace", "trace_digest"]
+
+
+class TraceRequest:
+    """One scheduled request of an open-loop run."""
+
+    __slots__ = ("t", "prompt_token_ids", "max_tokens", "priority",
+                 "slo_ms", "cancel_after_s")
+
+    def __init__(self, t: float, prompt_token_ids, max_tokens: int,
+                 priority: int = 1, slo_ms: Optional[float] = None,
+                 cancel_after_s: Optional[float] = None):
+        self.t = float(t)
+        self.prompt_token_ids = [int(x) for x in prompt_token_ids]
+        self.max_tokens = int(max_tokens)
+        self.priority = int(priority)
+        self.slo_ms = None if slo_ms is None else float(slo_ms)
+        self.cancel_after_s = (None if cancel_after_s is None
+                               else float(cancel_after_s))
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceRequest":
+        return cls(**{k: d.get(k) for k in cls.__slots__
+                      if d.get(k) is not None or k in ("slo_ms",
+                                                       "cancel_after_s")})
+
+    def __repr__(self):
+        slo = f" slo={self.slo_ms}ms" if self.slo_ms is not None else ""
+        return (f"TraceRequest(t={self.t:.3f}, "
+                f"prompt={len(self.prompt_token_ids)}tok, "
+                f"max={self.max_tokens}, p{self.priority}{slo})")
+
+
+def dumps_trace(schedule: Iterable[TraceRequest]) -> str:
+    """Serialize a schedule as JSONL with sorted keys — the SAME
+    schedule always produces the SAME bytes (the determinism contract
+    the replay gate pins)."""
+    return "".join(json.dumps(tr.as_dict(), sort_keys=True) + "\n"
+                   for tr in schedule)
+
+
+def dump_trace(schedule: Iterable[TraceRequest], path: str) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(dumps_trace(schedule))
+    return path
+
+
+def loads_trace(raw: str) -> List[TraceRequest]:
+    out = []
+    for ln in raw.splitlines():
+        ln = ln.strip()
+        if ln:
+            out.append(TraceRequest.from_dict(json.loads(ln)))
+    return out
+
+
+def load_trace(path: str) -> List[TraceRequest]:
+    with open(path, encoding="utf-8") as f:
+        return loads_trace(f.read())
+
+
+def trace_digest(schedule: Iterable[TraceRequest]) -> str:
+    """sha256 over the canonical JSONL bytes: two runs replayed the same
+    schedule iff their digests match (what the summary report carries so
+    A/B capacity curves are provably over the same traffic)."""
+    return hashlib.sha256(dumps_trace(schedule).encode()).hexdigest()

@@ -1,0 +1,140 @@
+"""Seeded workload synthesis: arrival processes + request mixes.
+
+A :class:`WorkloadSpec` describes traffic statistically — arrival
+process (Poisson / uniform / on-off burst), prompt/output-length ranges,
+a priority/SLO class mix, a cancel rate — and :func:`synthesize`
+materialises it into a concrete :class:`~.trace.TraceRequest` schedule
+from ONE seed. The same (spec, seed) always yields the byte-identical
+schedule (pinned in tier-1): replay is only a referee if two runs
+provably saw the same traffic.
+"""
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Sequence, Tuple
+
+from .trace import TraceRequest
+
+__all__ = ["WorkloadSpec", "synthesize"]
+
+#: (priority, slo_ms | None, weight) — the default mix is one default-
+#: priority class with no SLO (pure FIFO traffic)
+ClassMix = Tuple[int, Optional[float], float]
+
+_PROCESSES = ("poisson", "uniform", "burst")
+
+
+class WorkloadSpec:
+    """Statistical description of an open-loop request stream.
+
+    ``qps`` is the mean offered rate over ``duration_s``. ``burst``
+    traffic alternates ``burst_on_s`` of Poisson arrivals at
+    ``qps * burst_factor`` with ``burst_off_s`` of silence (mean rate
+    stays ~``qps`` when on/off windows are equal and factor is 2).
+    ``classes`` is a weighted list of ``(priority, slo_ms, weight)``;
+    ``cancel_rate`` marks that fraction of requests for a mid-stream
+    client disconnect after ``cancel_after_s`` (uniform in the range).
+    """
+
+    __slots__ = ("qps", "duration_s", "process", "burst_on_s",
+                 "burst_off_s", "burst_factor", "prompt_tokens",
+                 "max_tokens", "classes", "cancel_rate",
+                 "cancel_after_s", "vocab_size", "seed")
+
+    def __init__(self, qps: float, duration_s: float,
+                 process: str = "poisson",
+                 burst_on_s: float = 1.0, burst_off_s: float = 1.0,
+                 burst_factor: float = 2.0,
+                 prompt_tokens: Tuple[int, int] = (4, 12),
+                 max_tokens: Tuple[int, int] = (4, 12),
+                 classes: Sequence[ClassMix] = ((1, None, 1.0),),
+                 cancel_rate: float = 0.0,
+                 cancel_after_s: Tuple[float, float] = (0.05, 0.5),
+                 vocab_size: int = 512, seed: int = 0):
+        if process not in _PROCESSES:
+            raise ValueError(f"process must be one of {_PROCESSES}, "
+                             f"got {process!r}")
+        if qps <= 0 or duration_s <= 0:
+            raise ValueError("qps and duration_s must be > 0")
+        if not classes or any(w <= 0 for _, _, w in classes):
+            raise ValueError("classes need positive weights")
+        self.qps = float(qps)
+        self.duration_s = float(duration_s)
+        self.process = process
+        self.burst_on_s = float(burst_on_s)
+        self.burst_off_s = float(burst_off_s)
+        self.burst_factor = float(burst_factor)
+        self.prompt_tokens = (int(prompt_tokens[0]), int(prompt_tokens[1]))
+        self.max_tokens = (int(max_tokens[0]), int(max_tokens[1]))
+        self.classes = tuple((int(p), None if s is None else float(s),
+                              float(w)) for p, s, w in classes)
+        self.cancel_rate = float(cancel_rate)
+        self.cancel_after_s = (float(cancel_after_s[0]),
+                               float(cancel_after_s[1]))
+        self.vocab_size = int(vocab_size)
+        self.seed = int(seed)
+
+    def replace(self, **kw) -> "WorkloadSpec":
+        d = {k: getattr(self, k) for k in self.__slots__}
+        d.update(kw)
+        return WorkloadSpec(**d)
+
+
+def _arrivals(spec: WorkloadSpec, rng: random.Random) -> List[float]:
+    t, out = 0.0, []
+    if spec.process == "uniform":
+        gap = 1.0 / spec.qps
+        t = gap
+        while t < spec.duration_s:
+            out.append(t)
+            t += gap
+        return out
+    if spec.process == "poisson":
+        while True:
+            t += rng.expovariate(spec.qps)
+            if t >= spec.duration_s:
+                return out
+            out.append(t)
+    # burst: Poisson at qps*burst_factor, arrivals outside the on-window
+    # of the (on+off) cycle are discarded — mean rate scales with the
+    # duty cycle, peaks probe the admission bound
+    cycle = spec.burst_on_s + spec.burst_off_s
+    while True:
+        t += rng.expovariate(spec.qps * spec.burst_factor)
+        if t >= spec.duration_s:
+            return out
+        if (t % cycle) < spec.burst_on_s:
+            out.append(t)
+
+
+def _pick_class(spec: WorkloadSpec, rng: random.Random) -> ClassMix:
+    total = sum(w for _, _, w in spec.classes)
+    x = rng.random() * total
+    for p, s, w in spec.classes:
+        x -= w
+        if x <= 0:
+            return (p, s, w)
+    return spec.classes[-1]
+
+
+def synthesize(spec: WorkloadSpec) -> List[TraceRequest]:
+    """Materialise the spec into a concrete schedule. Deterministic:
+    every random choice comes from one ``random.Random(spec.seed)``
+    stream, so the same spec yields the byte-identical trace."""
+    rng = random.Random(spec.seed)
+    schedule = []
+    for t in _arrivals(spec, rng):
+        plo, phi = spec.prompt_tokens
+        plen = rng.randint(plo, max(plo, phi))
+        ids = [rng.randrange(1, spec.vocab_size) for _ in range(plen)]
+        mlo, mhi = spec.max_tokens
+        max_toks = rng.randint(mlo, max(mlo, mhi))
+        prio, slo_ms, _ = _pick_class(spec, rng)
+        cancel = None
+        if spec.cancel_rate > 0 and rng.random() < spec.cancel_rate:
+            clo, chi = spec.cancel_after_s
+            cancel = rng.uniform(clo, chi)
+        schedule.append(TraceRequest(t, ids, max_toks, priority=prio,
+                                     slo_ms=slo_ms,
+                                     cancel_after_s=cancel))
+    return schedule

@@ -48,8 +48,15 @@ SERVING_DECODE_STEP = _R.histogram(
 SERVING_REQUESTS = _R.counter(
     "serving_requests_total",
     "Lifetime request events "
-    "(event=admitted|finished|cancelled|rejected)",
+    "(event=admitted|finished|cancelled|rejected|shed)",
     labels=("engine", "event"))
+
+SERVING_DEADLINE_MISSES = _R.counter(
+    "serving_deadline_misses_total",
+    "Queued requests shed because their end-to-end deadline had already "
+    "passed or was provably unmeetable (each is a sched.shed event and "
+    "an HTTP 504 with code=deadline_exceeded)",
+    labels=("engine",))
 
 SERVING_TOKENS = _R.counter(
     "serving_tokens_generated_total",
@@ -70,8 +77,9 @@ SERVING_PREFIX_PAGES = _R.counter(
 SERVING_SCHED = _R.counter(
     "serving_sched_decisions_total",
     "Scheduler decisions on the serving hot loop "
-    "(decision=chunk|preempt|restore) — each one is also a sched.* "
-    "flight-recorder event carrying the full context",
+    "(decision=chunk|preempt|restore|migrate_out|migrate_in|shed) — "
+    "each one is also a sched.* flight-recorder event carrying the "
+    "full context",
     labels=("engine", "decision"))
 
 SERVING_ACTIVE_SLOTS = _R.gauge(
@@ -97,9 +105,12 @@ HTTP_REQUESTS = _R.counter(
 
 ROUTER_PLACEMENTS = _R.counter(
     "router_placements_total",
-    "Cluster-router placement outcomes (outcome=placed|retried|failed); "
-    "retried counts every failed attempt that was requeued, failed "
-    "counts requests that exhausted the retry budget",
+    "Cluster-router placement outcomes "
+    "(outcome=placed|retried|busy|deadline|failed); retried counts "
+    "every failed attempt that was requeued, busy counts 429 placement "
+    "feedback, deadline counts requests shed at the router because "
+    "their SLO budget ran out, failed counts requests that exhausted "
+    "the retry budget",
     labels=("outcome",))
 
 ROUTER_WORKERS = _R.gauge(

@@ -173,6 +173,14 @@ EV_SCHED_RESTORE = _register(
     "a preempted request re-took a slot: its host-side KV bundle was "
     "scattered back into the page pool and decode resumed (rid, engine, "
     "slot, kv_len, generated)")
+EV_SCHED_SHED = _register(
+    "sched.shed",
+    "admission shed a queued request (rid, engine, priority, "
+    "where=expired|unmeetable|capacity, miss_ms, queue_depth) — "
+    "expired/unmeetable deadlines count serving_deadline_misses_total "
+    "and answer HTTP 504; capacity sheds displace the least-important "
+    "queued work when a strictly more important request arrives at a "
+    "full bounded queue (the victim answers 429)")
 EV_SCHED_MIGRATE_OUT = _register(
     "sched.migrate_out",
     "a live slot was exported for migration: KV pages + last-logit row "

@@ -135,7 +135,9 @@ class QueueFull(RuntimeError):
     capacity and no slot is free. The HTTP front-end maps it to
     ``429 Too Many Requests`` + ``Retry-After``; the cluster router
     treats a worker's 429 as placement feedback (skip the worker, try
-    another) rather than a failover."""
+    another) rather than a failover. ``retry_after_s`` is computed from
+    the engine's queue depth and observed drain rate (see
+    ``_retry_after_estimate``), not a constant."""
 
     def __init__(self, engine: str, depth: int, max_queue: int,
                  retry_after_s: float = 1.0):
@@ -143,6 +145,27 @@ class QueueFull(RuntimeError):
             f"{engine} engine admission queue is full "
             f"({depth}/{max_queue} queued, no free slot); retry later")
         self.retry_after_s = float(retry_after_s)
+
+
+class DeadlineExceeded(RuntimeError):
+    """Typed end-to-end deadline rejection: the request's SLO budget is
+    already spent — it was submitted with no remaining budget, or it
+    expired while queued (the admission loop sheds it BEFORE it can take
+    a slot, so the engine never burns a prefill on tokens nobody can
+    use). The HTTP front-end maps it to ``504 Gateway Timeout`` with
+    ``{"code": "deadline_exceeded"}``; the cluster router forwards a
+    worker's deadline-504 verbatim (the deadline is global — another
+    replica cannot un-expire it, so it must never be retried)."""
+
+    def __init__(self, engine: str, miss_ms: Optional[float] = None,
+                 rid: Optional[int] = None):
+        miss = (f" (deadline missed by {miss_ms:.0f}ms)"
+                if miss_ms is not None else "")
+        super().__init__(
+            f"{engine} engine request deadline exceeded{miss}; "
+            "the SLO budget was spent before decoding could start")
+        self.miss_ms = miss_ms
+        self.rid = rid
 
 
 def _page_tiles(buf, page_size):
@@ -160,7 +183,8 @@ class _Request:
                  "stop_token_ids", "logprobs", "want_logprobs",
                  "encoder_input", "seed_ids", "t_enqueue", "t_admit",
                  "t_last", "span", "queue_span", "handoff",
-                 "priority", "deadline", "resume", "n_preempted")
+                 "priority", "deadline", "resume", "n_preempted",
+                 "on_shed")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
                  on_token=None, pixel_values=None, stop_token_ids=None,
@@ -202,6 +226,11 @@ class _Request:
                          if slo_ms is not None else math.inf)
         self.resume = None          # host-side KV bundle after a preemption
         self.n_preempted = 0
+        # shed notification: the front-end's hook for learning that a
+        # QUEUED request was dropped (deadline expired / displaced by a
+        # more important arrival) — without it an HTTP submission would
+        # wait forever on a request the engine silently let go
+        self.on_shed = None         # callback (rid, info_dict) or None
         # streaming callbacks may take (rid, tok, done) or a 4th logprob
         # arg; arity detected once at admission by counting REQUIRED
         # positional parameters only (a defaulted 4th param keeps the
@@ -251,6 +280,11 @@ class _RequestBookkeeping:
     # higher-priority stream. 0 disables aging (strict classes).
     aging_s = 0.0
 
+    # class defaults so stats() works on engines that never shed
+    # (seq2seq has no deadline surface at all)
+    _n_shed = 0
+    _n_deadline_misses = 0
+
     def _init_bookkeeping(self, engine: str):
         """One init for queue/finish state, lifetime counters, and the
         registry children (bound once here — no per-token label lookups
@@ -291,8 +325,24 @@ class _RequestBookkeeping:
             engine=engine, event="cancelled")
         self._m_req_rejected = _metrics.SERVING_REQUESTS.labels(
             engine=engine, event="rejected")
+        self._m_req_shed = _metrics.SERVING_REQUESTS.labels(
+            engine=engine, event="shed")
+        self._m_deadline = _metrics.SERVING_DEADLINE_MISSES.labels(
+            engine=engine)
+        self._m_sched_shed = _metrics.SERVING_SCHED.labels(
+            engine=engine, decision="shed")
         self._m_active = _metrics.SERVING_ACTIVE_SLOTS.labels(engine=engine)
         self._m_depth = _metrics.SERVING_QUEUE_DEPTH.labels(engine=engine)
+        # overload estimators, both engine-thread-only: the FLOOR of
+        # admission->first-token (best case ever observed — a request
+        # whose remaining budget is below even that is PROVABLY
+        # unmeetable; a mean would mis-shed behind cold-compile
+        # outliers) and the gap between request finishes (the drain
+        # rate behind the computed Retry-After)
+        self._ttft_admit_floor: Optional[float] = None
+        self._ttft_admit_n = 0   # the floor arms only past a few samples
+        self._finish_interval_ewma: Optional[float] = None
+        self._t_last_finish: Optional[float] = None
 
     @property
     def num_active(self) -> int:
@@ -336,6 +386,8 @@ class _RequestBookkeeping:
             "requests_cancelled": self._n_cancelled,
             "requests_rejected": self._n_rejected,
             "requests_preempted": self._n_preempted,
+            "requests_shed": self._n_shed,
+            "deadline_misses": self._n_deadline_misses,
             "requests_migrated_out": self._n_migrated_out,
             "requests_migrated_in": self._n_migrated_in,
             "requests_active": active,
@@ -406,6 +458,15 @@ class _RequestBookkeeping:
         with _tracing.get_tracer().use(req.span):
             if len(req.tokens) == 1:
                 self._m_ttft.observe(now - req.t_enqueue)
+                if req.t_admit is not None:
+                    # admission -> first token, best case ever seen:
+                    # the service FLOOR the provably-unmeetable
+                    # deadline shed compares remaining budgets against
+                    x = now - req.t_admit
+                    f = self._ttft_admit_floor
+                    self._ttft_admit_floor = x if f is None \
+                        else min(f, x)
+                    self._ttft_admit_n += 1
             elif req.t_last is not None:
                 self._m_inter.observe(now - req.t_last)
         req.t_last = now
@@ -540,6 +601,17 @@ class _RequestBookkeeping:
         if reason == "cancelled":
             self._n_cancelled += 1
             self._m_req_cancelled.inc()
+        elif reason in ("stop", "length"):
+            # drain-rate estimate: EWMA of the gap between finishes —
+            # queue_depth * this gap is how long a bounced request
+            # should back off (the computed Retry-After)
+            now = time.perf_counter()
+            if self._t_last_finish is not None:
+                iv = now - self._t_last_finish
+                e = self._finish_interval_ewma
+                self._finish_interval_ewma = iv if e is None \
+                    else 0.7 * e + 0.3 * iv
+            self._t_last_finish = now
         self._finished_reason[rid] = reason
         if logprobs is not None:
             self._finished_logprobs[rid] = logprobs
@@ -737,7 +809,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                     temperature=None, top_k=None, top_p=None,
                     on_token=None, pixel_values=None,
                     stop_token_ids=None, logprobs=False,
-                    trace_ctx=None, priority=None, slo_ms=None) -> int:
+                    trace_ctx=None, priority=None, slo_ms=None,
+                    on_shed=None) -> int:
         """Queue one request. Sampling knobs default to the engine-level
         configuration; any per-request override routes decoding through the
         per-row sampling program (one compiled step serves the whole mix).
@@ -765,8 +838,21 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         drive the SLO-aware admission order — see docs/SERVING.md
         "Scheduling & SLOs". With ``max_queue`` configured, a request
         that would wait behind a full queue raises :class:`QueueFull`
-        (the HTTP 429 path) instead of growing the backlog unboundedly."""
-        self._check_queue_bound()
+        (the HTTP 429 path) instead of growing the backlog unboundedly —
+        unless it is strictly more important than some queued request,
+        in which case that victim is SHED instead (high-priority goodput
+        degrades last). ``slo_ms`` is also a hard deadline: a request
+        still queued when its budget runs out is shed typed
+        (``sched.shed`` -> HTTP 504 via ``on_shed(rid, info)``), and a
+        request submitted with no remaining budget raises
+        :class:`DeadlineExceeded` immediately."""
+        eff_priority = (PRIORITY_DEFAULT if priority is None
+                        else int(priority))
+        if slo_ms is not None and float(slo_ms) <= 0:
+            self._count_deadline_reject(float(slo_ms))
+            raise DeadlineExceeded(self._engine_label,
+                                   miss_ms=-float(slo_ms))
+        self._check_queue_bound(priority=eff_priority)
         ids = np.asarray(unwrap(ids) if isinstance(ids, Tensor) else ids).reshape(-1)
         if ids.size + max_new_tokens > self.max_len:
             raise ValueError(
@@ -816,6 +902,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                        stop_token_ids=stop_token_ids,
                        want_logprobs=logprobs, priority=priority,
                        slo_ms=slo_ms)
+        req.on_shed = on_shed
         # trace_ctx: inbound (trace_id, parent_span_id) — the HTTP
         # layer's parsed W3C traceparent — parents this request's root
         # span so the caller's trace continues through the engine
@@ -825,17 +912,122 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._admit()
         return rid
 
-    def _check_queue_bound(self):
-        """Bounded admission: reject (typed, counted) when the queue is
-        at max_queue AND no slot is free — a request that would be
-        admitted immediately never bounces off the bound."""
-        if (self.max_queue is not None
-                and len(self._queue) >= self.max_queue
-                and self._free_slot() < 0):
-            self._n_rejected += 1
-            self._m_req_rejected.inc()
-            raise QueueFull(self._engine_label, len(self._queue),
-                            self.max_queue)
+    def _retry_after_estimate(self) -> float:
+        """Backpressure hint for a bounced request: queue depth divided
+        by the observed drain rate (EWMA gap between request finishes),
+        clamped to [0.5s, 30s]. Before the first finish there is no rate
+        to read, so the hint falls back to 1s — never a silent constant
+        once the engine has history."""
+        iv = self._finish_interval_ewma
+        if not iv:
+            return 1.0
+        est = (len(self._queue) + 1) * iv
+        return min(30.0, max(0.5, est))
+
+    def _check_queue_bound(self, priority: Optional[int] = None):
+        """Bounded admission: when the queue is at max_queue AND no slot
+        is free, either SHED the least-important queued request to make
+        room for a strictly more important newcomer (high-priority
+        goodput degrades last under sustained pressure), or reject the
+        newcomer typed (QueueFull -> HTTP 429 with a computed
+        Retry-After). A request that would be admitted immediately never
+        bounces off the bound."""
+        if (self.max_queue is None
+                or len(self._queue) < self.max_queue
+                or self._free_slot() >= 0):
+            return
+        if priority is not None and self._queue:
+            # capacity shed: lowest class first, latest deadline within
+            # a class (the request least likely to still matter)
+            victim = max(self._queue,
+                         key=lambda r: (r.priority, r.deadline, r.rid))
+            if victim.priority > int(priority):
+                self._shed_request(victim, where="capacity")
+                return
+        self._n_rejected += 1
+        self._m_req_rejected.inc()
+        raise QueueFull(self._engine_label, len(self._queue),
+                        self.max_queue,
+                        retry_after_s=self._retry_after_estimate())
+
+    def _shed_request(self, req: _Request, where: str):
+        """Drop ONE queued request, typed and accounted: ``where`` is
+        "expired" (deadline already passed), "unmeetable" (remaining
+        budget below the observed admission->first-token service floor),
+        or "capacity" (displaced by a strictly more important arrival at
+        a full bounded queue). Emits sched.shed + the shed counters and
+        notifies the front-end through req.on_shed so an HTTP submission
+        answers a typed 504/429 instead of stalling silently."""
+        self._queue.remove(req)
+        now = time.perf_counter()
+        miss_ms = ((now - req.deadline) * 1000.0
+                   if req.deadline != math.inf else None)
+        self._n_shed += 1
+        self._m_req_shed.inc()
+        self._m_sched_shed.inc()
+        if where == "expired":
+            msg = (f"request {req.rid} deadline expired "
+                   f"{miss_ms:.0f}ms before admission")
+        elif where == "unmeetable":
+            msg = (f"request {req.rid} shed: remaining budget "
+                   f"{-miss_ms:.0f}ms is below the engine's observed "
+                   "service floor")
+        else:
+            msg = (f"request {req.rid} displaced by a higher-priority "
+                   "arrival at a full admission queue; retry later")
+        info = {"where": where, "miss_ms": miss_ms, "error": msg}
+        if where != "capacity":
+            self._n_deadline_misses += 1
+            self._m_deadline.inc()
+        else:
+            info["retry_after"] = self._retry_after_estimate()
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_SCHED_SHED, rid=req.rid,
+                       engine=self._engine_label, priority=req.priority,
+                       where=where, miss_ms=miss_ms,
+                       queue_depth=len(self._queue))
+        self._record_reason(req.rid, "shed")
+        self._trace_end(req, "shed")
+        if req.on_shed is not None:
+            req.on_shed(req.rid, info)
+
+    def _shed_expired(self, now: float):
+        """End-to-end deadline enforcement at the admission gate: shed
+        every queued request whose deadline has already passed, or whose
+        remaining budget is provably below the engine's observed
+        admission->first-token service floor — under overload the engine
+        must spend its steps on tokens someone can still use, never on
+        admitted-then-expired streams."""
+        if not self._queue:
+            return
+        # the floor arms only once a few first tokens have been timed:
+        # a single observation is usually compile-contaminated (cold
+        # prompt-length buckets), and a "floor" of one sample would
+        # mis-shed every tight-budget request after a cold start
+        est = (self._ttft_admit_floor
+               if self._ttft_admit_n >= 3 else None) or 0.0
+        for req in [r for r in self._queue if r.deadline != math.inf]:
+            if now >= req.deadline:
+                self._shed_request(req, where="expired")
+            elif est and now + est > req.deadline:
+                self._shed_request(req, where="unmeetable")
+
+    def _count_deadline_reject(self, slo_ms: float):
+        """A request submitted with its budget already spent (slo_ms <=
+        0, e.g. a deadline header that expired in transit): counted like
+        a shed — it is one, at the door — before the typed raise."""
+        self._n_shed += 1
+        self._m_req_shed.inc()
+        self._m_sched_shed.inc()
+        self._n_deadline_misses += 1
+        self._m_deadline.inc()
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_SCHED_SHED, rid=None,
+                       engine=self._engine_label, priority=None,
+                       where="expired", miss_ms=-float(slo_ms),
+                       queue_depth=len(self._queue))
 
     def _merge_sampling(self, do_sample, temperature, top_k, top_p):
         """Per-request sampling tuple: engine defaults overlaid with the
@@ -901,7 +1093,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                         do_sample=None, temperature=None, top_k=None,
                         top_p=None, on_token=None, stop_token_ids=None,
                         logprobs=False, trace_ctx=None, priority=None,
-                        slo_ms=None) -> int:
+                        slo_ms=None, on_shed=None) -> int:
         """Queue a request whose prefill already happened on a PEER
         engine (``export_prefill`` over the same weights): admission
         scatters the bundle's KV buffers straight into the slot's pages
@@ -909,7 +1101,13 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         half of the disaggregated tier. Sampling / stop / logprobs /
         priority / SLO knobs mirror ``add_request`` (they are decode-side
         concerns)."""
-        self._check_queue_bound()
+        eff_priority = (PRIORITY_DEFAULT if priority is None
+                        else int(priority))
+        if slo_ms is not None and float(slo_ms) <= 0:
+            self._count_deadline_reject(float(slo_ms))
+            raise DeadlineExceeded(self._engine_label,
+                                   miss_ms=-float(slo_ms))
+        self._check_queue_bound(priority=eff_priority)
         if self._latent_mode:
             raise NotImplementedError(
                 "KV handoff is not supported in latent (MLA) mode")
@@ -940,6 +1138,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         req = _Request(rid, ids, max_new_tokens, sampling, on_token,
                        stop_token_ids=stop_token_ids, want_logprobs=logprobs,
                        priority=priority, slo_ms=slo_ms)
+        req.on_shed = on_shed
         req.handoff = handoff
         self._trace_submit(req, trace_ctx)
         self._queue.append(req)
@@ -1041,7 +1240,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         return bundle
 
     def admit_migrated(self, handoff: dict, on_token=None,
-                       trace_ctx=None) -> int:
+                       trace_ctx=None, on_shed=None) -> int:
         """Admit a mid-stream request exported by a peer engine's
         :meth:`export_slot` (same weights): the bundle's KV scatters back
         through the preemption-restore path and decode resumes exactly
@@ -1051,7 +1250,11 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         token-identical — and ``on_token`` fires only for NEWLY generated
         tokens, so a relay appends seamlessly after the tokens it already
         delivered."""
-        self._check_queue_bound()
+        if not isinstance(handoff, dict):
+            raise HandoffCorrupt(
+                f"bundle is a {type(handoff).__name__}, not a dict")
+        self._check_queue_bound(
+            priority=int(handoff.get("priority", PRIORITY_DEFAULT)))
         if self._latent_mode:
             raise NotImplementedError(
                 "migration is not supported in latent (MLA) mode")
@@ -1093,6 +1296,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                        priority=handoff.get("priority"),
                        slo_ms=(slo_rem * 1000.0 if slo_rem is not None
                                else None))
+        req.on_shed = on_shed
         req.tokens = tokens
         req.logprobs = [float(x) for x in handoff.get("logprobs") or []]
         # resume rides the preemption-restore path: _admit sees
@@ -1295,6 +1499,12 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 "page pool; rebuild the engine and resubmit requests")
         while self._queue:
             now = time.perf_counter()
+            # deadline gate BEFORE the pop, against the same clock: a
+            # request whose budget is spent sheds typed here — it can
+            # never be admitted after its deadline expired
+            self._shed_expired(now)
+            if not self._queue:
+                return
             slot = self._free_slot()
             if slot < 0:
                 # page pressure: a strictly-higher-priority queued request

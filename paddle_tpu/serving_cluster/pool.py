@@ -57,7 +57,8 @@ class WorkerInfo:
 
     __slots__ = ("replica_id", "role", "host", "port", "pid", "kv_channel",
                  "alive", "lease_age_s", "active", "queued", "pending",
-                 "probe_ok", "marked_dead_at", "busy_until", "draining")
+                 "probe_ok", "marked_dead_at", "busy_until", "draining",
+                 "finished", "probed_at", "drain_rate")
 
     def __init__(self, replica_id: int, meta: dict):
         self.replica_id = replica_id
@@ -75,6 +76,12 @@ class WorkerInfo:
         self.marked_dead_at: Optional[float] = None  # monotonic, router-side
         self.busy_until = 0.0  # admission backpressure (429) backoff
         self.draining = False  # drain in progress: placement excluded
+        # drain-rate estimate off successive /health polls (finished
+        # counter deltas over poll gaps) — feeds the router's computed
+        # Retry-After when a worker's 429 carries no hint
+        self.finished = 0
+        self.probed_at: Optional[float] = None
+        self.drain_rate: Optional[float] = None  # requests/s, EWMA
 
     @property
     def url(self) -> str:
@@ -98,6 +105,7 @@ class WorkerInfo:
             "probe_ok": self.probe_ok,
             "busy": self.busy_until > time.monotonic(),
             "draining": self.draining,
+            "drain_rate": self.drain_rate,
         }
 
 
@@ -235,6 +243,18 @@ class WorkerPool:
             if ok:
                 w.active = int(health.get("active", 0))
                 w.queued = int(health.get("queued", 0))
+                stats = health.get("stats") or {}
+                fin = stats.get("requests_finished")
+                if fin is not None:
+                    now = time.monotonic()
+                    if (w.probed_at is not None and now > w.probed_at
+                            and int(fin) >= w.finished):
+                        inst = (int(fin) - w.finished) / (now - w.probed_at)
+                        w.drain_rate = (inst if w.drain_rate is None
+                                        else 0.5 * w.drain_rate
+                                        + 0.5 * inst)
+                    w.finished = int(fin)
+                    w.probed_at = now
                 # a worker draining itself (operator hit its /drain
                 # directly) is honored the same as a router-initiated
                 # drain: no new placements land on it

@@ -39,10 +39,15 @@ from ..distributed.log_utils import get_logger
 from ..observability import flightrecorder as _frec
 from ..observability import tracing as _tracing
 from ..observability.catalog import ROUTER_PLACEMENTS
-from ..serving_http import ServingHandlerBase
+from ..serving_http import DEADLINE_HEADER, ServingHandlerBase
 from .pool import WorkerInfo, WorkerPool, jittered
 
 __all__ = ["RouterServer"]
+
+
+def _deadline_body(note: str = "") -> dict:
+    return {"error": "request deadline exceeded" + note,
+            "code": "deadline_exceeded"}
 
 
 class _ClientError(Exception):
@@ -88,6 +93,15 @@ class _ClientGone(Exception):
     """The DOWNSTREAM client disconnected mid-relay; nothing to answer."""
 
 
+class _DeadlineExpired(Exception):
+    """The request's end-to-end SLO budget ran out at the router —
+    before a placement, or mid-hop (the upstream timeout now derives
+    from the REMAINING budget, not a fixed constant). Terminal and
+    typed: 504 with ``code=deadline_exceeded``, never a retry (another
+    replica cannot un-expire a global deadline) and never a mark_dead
+    (the worker did nothing wrong)."""
+
+
 class _Migrated(Exception):
     """The upstream worker ended the stream with a migrate marker: the
     request's slot was exported to another worker (drain / rebalance).
@@ -130,6 +144,7 @@ class RouterServer:
         self._retried = 0
         self._failed = 0
         self._busy = 0
+        self._deadline = 0
         self._httpd = ThreadingHTTPServer((host, port),
                                           self._make_handler())
         self._http_thread = threading.Thread(
@@ -185,6 +200,7 @@ class RouterServer:
                             "retried": self._retried,
                             "failed": self._failed,
                             "busy": self._busy,
+                            "deadline": self._deadline,
                             "max_retries": self.max_retries}
         return {
             "status": "ok" if alive else "unavailable",
@@ -321,6 +337,33 @@ class RouterServer:
                 self._failed += 1
             elif outcome == "busy":
                 self._busy += 1
+            elif outcome == "deadline":
+                self._deadline += 1
+
+    def _busy_blocked(self, exclude: Tuple[int, ...]):
+        """When placement found no worker, distinguish FULL from DOWN:
+        returns a live, non-draining, non-excluded worker that is only
+        unavailable because of a 429 busy backoff (None when the pool is
+        genuinely empty/dead). A full tier answers 429; only a dead one
+        earns the 502."""
+        candidates = [w for w in self.pool.workers()
+                      if w["alive"] and not w["draining"]
+                      and w["replica_id"] not in exclude]
+        if not (candidates and all(w["busy"] for w in candidates)):
+            return None
+        return self.pool.get(candidates[0]["replica_id"])
+
+    def _retry_after_for(self, worker: WorkerInfo) -> str:
+        """Retry-After fallback when a 429 carries no header: the
+        worker's last-reported backlog divided by its observed drain
+        rate (both from the pool's /health polls), clamped to [1s, 30s]
+        — backoff reflects actual congestion, not a constant."""
+        w = self.pool.get(worker.replica_id) or worker
+        depth = max(1, int(getattr(w, "queued", 0) or 0)
+                    + int(getattr(w, "active", 0) or 0))
+        rate = getattr(w, "drain_rate", None)
+        est = depth / rate if rate else 1.0
+        return str(max(1, min(30, round(est))))
 
     def _complete(self, handler, req):
         stream = bool(req.get("stream"))
@@ -336,7 +379,24 @@ class RouterServer:
         last_reason = "no live worker available"
         busy: Optional[_WorkerBusy] = None
         root = handler._trace_span
+        # end-to-end deadline: stamped at ARRIVAL, so every placement
+        # attempt (and the X-Request-Deadline header each hop carries)
+        # works off the remaining budget, not a fresh one
+        slo_deadline = None
+        try:
+            slo = req.get("slo_ms")
+            if slo is not None and float(slo) > 0:
+                slo_deadline = time.monotonic() + float(slo) / 1000.0
+        except (TypeError, ValueError):
+            pass   # malformed slo_ms: the worker's 400 will name it
         while attempts <= self.max_retries and hops <= self.max_migrations:
+            if (slo_deadline is not None
+                    and time.monotonic() >= slo_deadline):
+                # shed at the router: the budget is spent, so placing
+                # the request would burn a prefill on a stream nobody
+                # can use — answer typed instead
+                self._respond_deadline(handler, state, slo_deadline)
+                return
             rec = _frec.RECORDER
             pre = None
             if cont is not None:
@@ -381,7 +441,8 @@ class RouterServer:
                 if mode != "migrate":
                     up_req = req
                     if mode == "disagg":
-                        hid = self._prefill_hop(pre, serve, req, sp)
+                        hid = self._prefill_hop(pre, serve, req, sp,
+                                                deadline=slo_deadline)
                         up_req = {k: v for k, v in req.items()
                                   if k not in ("prompt",
                                                "prompt_token_ids",
@@ -389,10 +450,11 @@ class RouterServer:
                         up_req["handoff_id"] = hid
                 if stream:
                     self._proxy_stream(handler, serve, up_req, state, sp,
-                                       base=base)
+                                       base=base, deadline=slo_deadline)
                 else:
                     status, body = self._post_json(
-                        serve, "/v1/completions", up_req, sp)
+                        serve, "/v1/completions", up_req, sp,
+                        deadline=slo_deadline)
                     if 400 <= status < 500:
                         raise _ClientError(status, body)
                     if status != 200:
@@ -404,6 +466,12 @@ class RouterServer:
                     handler._json(200, body)
                 sp.end()
                 self._count_outcome("placed")
+                return
+            except _DeadlineExpired:
+                # the budget ran out mid-hop: typed 504 / error chunk,
+                # no retry, no mark_dead — the worker is innocent
+                sp.end("error")
+                self._respond_deadline(handler, state, slo_deadline)
                 return
             except _Migrated as e:
                 sp.end()  # the upstream hop SUCCEEDED — by migrating
@@ -417,7 +485,19 @@ class RouterServer:
                                        f"{e.info.get('dst')}"))
             except _ClientError as e:
                 sp.end("error")
-                handler._json(e.status, e.body)
+                if state["headers_sent"]:
+                    # the status line is long gone (a migrated stream's
+                    # continuation can 4xx/deadline-504 after tokens
+                    # flowed): end the SSE typed, without [DONE]
+                    try:
+                        handler._chunk(b"data: "
+                                       + json.dumps(e.body).encode()
+                                       + b"\n\n")
+                        handler._chunk(b"")
+                    except OSError:
+                        handler.close_connection = True
+                else:
+                    handler._json(e.status, e.body)
                 return
             except _ClientGone:
                 sp.end("cancelled")
@@ -475,13 +555,28 @@ class RouterServer:
                     self.pool.release(pre)
         # retry budget exhausted (or the pool is empty)
         self._count_outcome("failed")
-        if busy is not None and not state["headers_sent"]:
-            # every placeable worker pushed back: forward the
-            # backpressure (429 + Retry-After), never a 502 — the tier
-            # is healthy, just full
-            handler._json(429, busy.body or {"error": "all workers busy"},
-                          headers=(("Retry-After", busy.retry_after),))
-            return
+        if not state["headers_sent"]:
+            if busy is not None:
+                # every placeable worker pushed back: forward the
+                # backpressure (429 + Retry-After), never a 502 — the
+                # tier is healthy, just full
+                handler._json(429,
+                              busy.body or {"error": "all workers busy"},
+                              headers=(("Retry-After",
+                                        busy.retry_after),))
+                return
+            blocked = self._busy_blocked(exclude)
+            if blocked is not None:
+                # this request saw no 429 itself, but every live worker
+                # is sitting out a busy backoff earned from OTHER
+                # requests' rejections — same situation, same typed
+                # answer: the tier is at admission capacity, not down
+                handler._json(
+                    429, {"error": "all workers are at admission "
+                                   "capacity; retry later"},
+                    headers=(("Retry-After",
+                              self._retry_after_for(blocked)),))
+                return
         msg = (f"could not serve the request after {attempts} "
                f"placement attempt(s): {last_reason}")
         if state["headers_sent"]:
@@ -498,29 +593,70 @@ class RouterServer:
             handler._json(502, {"error": msg})
 
     # ---- upstream hops ---------------------------------------------------
-    def _headers(self, span) -> dict:
+    def _respond_deadline(self, handler, state: dict, slo_deadline):
+        """Answer a spent deadline typed: a real 504 before any bytes
+        went out, an error chunk (no [DONE]) mid-stream — never a
+        silent stall, never a retry."""
+        self._count_outcome("deadline")
+        miss_ms = (time.monotonic() - slo_deadline) * 1000.0 \
+            if slo_deadline is not None else 0.0
+        body = _deadline_body(f" (missed by {miss_ms:.0f}ms at the "
+                              "router)")
+        if state["headers_sent"]:
+            try:
+                handler._chunk(b"data: " + json.dumps(body).encode()
+                               + b"\n\n")
+                handler._chunk(b"")
+            except OSError:
+                handler.close_connection = True
+        else:
+            handler._json(504, body)
+
+    def _headers(self, span, deadline=None) -> dict:
         h = {"Content-Type": "application/json"}
         if span:
             h[_tracing.TRACEPARENT_HEADER] = _tracing.format_traceparent(
                 span.trace_id, span.span_id)
+        if deadline is not None:
+            # the deadline contract: each hop carries the REMAINING
+            # budget in ms, so the worker's admission deadline equals
+            # the router's minus elapsed time (pinned in tier-1)
+            h[DEADLINE_HEADER] = (
+                f"{max(0.0, (deadline - time.monotonic()) * 1000.0):.1f}")
         return h
 
+    def _upstream_timeout(self, deadline) -> float:
+        """The per-hop socket timeout derives from the remaining budget
+        (plus a small grace so the worker's own typed shed wins the
+        race) instead of the fixed constant — a spent deadline must
+        surface in bounded time, typed."""
+        if deadline is None:
+            return self.upstream_timeout
+        return min(self.upstream_timeout,
+                   max(0.05, deadline - time.monotonic()) + 2.0)
+
     def _post_json(self, worker: WorkerInfo, path: str, body: dict,
-                   span) -> Tuple[int, dict]:
+                   span, deadline=None) -> Tuple[int, dict]:
         """One upstream POST, full-body; transport failures raise
-        _UpstreamError naming the worker as observed-dead."""
+        _UpstreamError naming the worker as observed-dead — unless the
+        request's deadline has passed, which is the request's fault,
+        not the worker's (_DeadlineExpired)."""
         self._chaos_upstream(worker, path)
-        conn = http.client.HTTPConnection(worker.host, worker.port,
-                                          timeout=self.upstream_timeout)
+        conn = http.client.HTTPConnection(
+            worker.host, worker.port,
+            timeout=self._upstream_timeout(deadline))
         try:
             conn.request("POST", path, json.dumps(body),
-                         self._headers(span))
+                         self._headers(span, deadline))
             resp = conn.getresponse()
             status = resp.status
             raw = resp.read()
-            retry_after = (resp.getheader("Retry-After") or "1"
+            retry_after = ((resp.getheader("Retry-After")
+                            or self._retry_after_for(worker))
                            if status == 429 else None)
         except (OSError, http.client.HTTPException) as e:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise _DeadlineExpired() from e
             raise _UpstreamError(
                 f"worker {worker.replica_id} transport failure on "
                 f"{path}: {type(e).__name__}: {e}", dead=worker)
@@ -530,12 +666,18 @@ class RouterServer:
             parsed = json.loads(raw)
         except ValueError:
             parsed = {"error": raw.decode(errors="replace")}
+        if (status == 504 and isinstance(parsed, dict)
+                and parsed.get("code") == "deadline_exceeded"):
+            # a worker's deadline shed is TERMINAL: the budget is
+            # global, another replica cannot un-expire it — forward
+            # verbatim through the no-retry path
+            raise _ClientError(status, parsed)
         if status == 429:
             raise _WorkerBusy(worker, parsed, retry_after)
         return status, parsed
 
     def _prefill_hop(self, pre: WorkerInfo, serve: WorkerInfo, req: dict,
-                     span) -> str:
+                     span, deadline=None) -> str:
         """Run the prompt through a prefill worker, shipping its KV to
         ``serve``'s handoff channel; returns the handoff id the decode
         request claims."""
@@ -546,7 +688,8 @@ class RouterServer:
             if k in req:
                 body[k] = req[k]
         try:
-            status, resp = self._post_json(pre, "/v1/prefill", body, span)
+            status, resp = self._post_json(pre, "/v1/prefill", body, span,
+                                           deadline=deadline)
         except _UpstreamError as e:
             # the SERVE worker is fine — only exclude/blame the prefill
             # worker so the retry can reuse the decode side
@@ -575,21 +718,24 @@ class RouterServer:
                 time.sleep(fault.delay_s)
 
     def _proxy_stream(self, handler, worker: WorkerInfo, body: dict,
-                      state: dict, span, base: int = 0):
+                      state: dict, span, base: int = 0, deadline=None):
         """Relay one SSE stream, skipping the token chunks the client
         already has: the upstream's chunks are numbered from ``base``
         (0 for a full replay, the bundle's generated count for a
         migration continuation that emits only new tokens), and chunks
         numbered <= ``state['delivered']`` are dropped."""
         self._chaos_upstream(worker, "/v1/completions")
-        conn = http.client.HTTPConnection(worker.host, worker.port,
-                                          timeout=self.upstream_timeout)
+        conn = http.client.HTTPConnection(
+            worker.host, worker.port,
+            timeout=self._upstream_timeout(deadline))
         try:
             try:
                 conn.request("POST", "/v1/completions", json.dumps(body),
-                             self._headers(span))
+                             self._headers(span, deadline))
                 resp = conn.getresponse()
             except (OSError, http.client.HTTPException) as e:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise _DeadlineExpired() from e
                 raise _UpstreamError(
                     f"worker {worker.replica_id} transport failure: "
                     f"{type(e).__name__}: {e}", dead=worker)
@@ -602,9 +748,14 @@ class RouterServer:
                     parsed = json.loads(raw)
                 except ValueError:
                     parsed = {"error": raw.decode(errors="replace")}
+                if (resp.status == 504 and isinstance(parsed, dict)
+                        and parsed.get("code") == "deadline_exceeded"):
+                    # terminal typed shed — forward, never retry
+                    raise _ClientError(resp.status, parsed)
                 if resp.status == 429:
                     raise _WorkerBusy(worker, parsed,
-                                      resp.getheader("Retry-After") or "1")
+                                      resp.getheader("Retry-After")
+                                      or self._retry_after_for(worker))
                 if 400 <= resp.status < 500:
                     raise _ClientError(resp.status, parsed)
                 raise _UpstreamError(
@@ -618,6 +769,9 @@ class RouterServer:
                 try:
                     line = resp.readline()
                 except (OSError, http.client.HTTPException) as e:
+                    if (deadline is not None
+                            and time.monotonic() >= deadline):
+                        raise _DeadlineExpired() from e
                     raise _UpstreamError(
                         f"worker {worker.replica_id} stream broke: "
                         f"{type(e).__name__}: {e}", dead=worker)
@@ -642,6 +796,16 @@ class RouterServer:
                     # before the export was relayed ahead of the marker)
                     raise _Migrated(json.loads(payload)["migrated"])
                 if payload.startswith(b'{"error"'):
+                    try:
+                        d = json.loads(payload)
+                    except ValueError:
+                        d = {}
+                    if (isinstance(d, dict)
+                            and d.get("code") == "deadline_exceeded"):
+                        # a deadline shed after tokens flowed (preempted
+                        # then requeued past its budget): terminal —
+                        # forward typed, never replay on another worker
+                        raise _ClientError(504, d)
                     # engine-level mid-stream failure: another worker
                     # can finish this request
                     raise _UpstreamError(

@@ -40,7 +40,8 @@ from ..analysis.threads.witness import make_lock
 from ..chaos import inject as _chaos
 from ..distributed.elastic import ElasticManager
 from ..distributed.log_utils import get_logger
-from ..serving_http import CompletionServer, EngineCommand, _Submission
+from ..serving_http import (CompletionServer, EngineCommand, _Submission,
+                            apply_deadline_header)
 from .kv_handoff import KvHandoffReceiver, make_receiver, open_sender
 
 __all__ = ["WorkerServer", "run_worker", "build_model", "MODEL_BUILDERS"]
@@ -118,8 +119,12 @@ class _AdmitMigrated(EngineCommand):
         def on_token(rid, tok, done, logprob, _ev=ev):
             _ev.put(("token", (rid, tok, logprob), done))
 
+        def on_shed(rid, info, _ev=ev):
+            _ev.put(("shed", info, True))
+
         rid = engine.admit_migrated(self.bundle, on_token=on_token,
-                                    trace_ctx=sub.trace_ctx)
+                                    trace_ctx=sub.trace_ctx,
+                                    on_shed=on_shed)
         sub.rids.append(rid)
         self.server._live_subs[rid] = sub
         return rid
@@ -345,6 +350,12 @@ class WorkerServer(CompletionServer):
             params, want_logprobs = self._parse_decode_params(req)
         except (ValueError, TypeError) as e:
             return handler._json(400, {"error": str(e)})
+        # the router's deadline header carries the REMAINING budget —
+        # the decode-side admission deadline derives from it, never a
+        # fresh one (the prefill hop's time is already charged)
+        err = apply_deadline_header(handler, params)
+        if err is not None:
+            return handler._json(*err)
         sub = _Submission(None, params, handoff=bundle,
                           trace_ctx=trace_ctx)
         self._subs.put(sub)

@@ -64,9 +64,17 @@ from .observability import PROMETHEUS_CONTENT_TYPE, get_registry
 from .observability import flightrecorder as _frec
 from .observability import tracing as _tracing
 from .observability.catalog import HTTP_REQUESTS
-from .serving import QueueFull
+from .serving import DeadlineExceeded, QueueFull
 
-__all__ = ["CompletionServer", "ServingHandlerBase", "serve"]
+__all__ = ["CompletionServer", "ServingHandlerBase", "serve",
+           "DEADLINE_HEADER"]
+
+#: end-to-end deadline propagation: the cluster router stamps each
+#: upstream hop with the request's REMAINING budget in milliseconds, so
+#: the worker's admission deadline is the router's minus elapsed time —
+#: never a second, fresh budget. A non-positive value answers 504
+#: (code=deadline_exceeded) before the engine is touched.
+DEADLINE_HEADER = "X-Request-Deadline"
 
 # known routes for the http counter — anything else buckets under
 # "other" so a scanner can't explode the label cardinality
@@ -88,6 +96,36 @@ class _Submission:
         self.rids = []
         self.trace_ctx = trace_ctx  # (trace_id, parent_span_id) | None
         self.handoff = handoff  # prefilled-KV bundle (disaggregated tier)
+
+
+def _deadline_response(miss_note: str = "") -> dict:
+    """The ONE body shape every deadline 504 answers with: ``code`` is
+    how the cluster router tells a deadline-504 (terminal — forward
+    verbatim, the budget is global) from a transport/handoff 504
+    (retryable on another worker)."""
+    return {"error": "request deadline exceeded" + miss_note,
+            "code": "deadline_exceeded"}
+
+
+def apply_deadline_header(handler, params) -> Optional[tuple]:
+    """Fold an inbound X-Request-Deadline header (remaining budget, ms)
+    into the request params: the header WINS over any body ``slo_ms``
+    because it already accounts for time spent upstream. Returns a
+    ``(status, body)`` error response when the header is malformed or
+    the budget is already spent, else None."""
+    hdr = handler.headers.get(DEADLINE_HEADER)
+    if hdr is None:
+        return None
+    try:
+        remaining_ms = float(hdr)
+    except (TypeError, ValueError):
+        return (400, {"error": f"invalid {DEADLINE_HEADER} header "
+                               f"{hdr!r}: want remaining budget in ms"})
+    if remaining_ms <= 0:
+        return (504, _deadline_response(
+            f" (budget spent {-remaining_ms:.0f}ms before admission)"))
+    params["slo_ms"] = remaining_ms
+    return None
 
 
 class _Cancel:
@@ -451,6 +489,12 @@ class CompletionServer:
         def on_token(rid, tok, done, logprob, _ev=ev):
             _ev.put(("token", (rid, tok, logprob), done))
 
+        def on_shed(rid, info, _ev=ev):
+            # the engine dropped a QUEUED request (deadline expired /
+            # displaced at capacity): a typed event, so the waiting
+            # handler answers 504/429 instead of stalling silently
+            _ev.put(("shed", info, True))
+
         try:
             if sub.handoff is not None:
                 if sub.handoff.get("kind") == "migrate":
@@ -459,21 +503,31 @@ class CompletionServer:
                     # takes no params, the stream resumes mid-decode
                     sub.rids.append(
                         eng.admit_migrated(sub.handoff, on_token=on_token,
-                                           trace_ctx=sub.trace_ctx))
+                                           trace_ctx=sub.trace_ctx,
+                                           on_shed=on_shed))
                 else:
                     # disaggregated tier: the prompt's KV arrived from a
                     # prefill worker; admit it without a local prefill
                     sub.rids.append(
                         eng.admit_prefilled(sub.handoff, on_token=on_token,
                                             trace_ctx=sub.trace_ctx,
+                                            on_shed=on_shed,
                                             **sub.params))
             else:
                 for _ in range(sub.n):
                     sub.rids.append(
                         eng.add_request(sub.ids, on_token=on_token,
                                         trace_ctx=sub.trace_ctx,
+                                        on_shed=on_shed,
                                         **sub.params))
             sub.rid = sub.rids[0]
+        except DeadlineExceeded as e:
+            # the budget was spent before submission (a deadline header
+            # that expired in transit): typed 504, siblings cancelled
+            for rid in sub.rids:
+                eng.cancel(rid)
+            ev.put(("shed", {"where": "expired", "error": str(e),
+                             "miss_ms": e.miss_ms}, True))
         except QueueFull as e:
             # bounded admission queue -> HTTP 429 + Retry-After; siblings
             # of an n>1 request admitted before the bound hit are
@@ -650,6 +704,9 @@ class CompletionServer:
         except (ValueError, TypeError) as e:
             # wrong-typed fields answer 400, not a dropped socket
             return handler._json(400, {"error": str(e)})
+        err = apply_deadline_header(handler, params)
+        if err is not None:
+            return handler._json(*err)
         sp = handler._trace_span
         sub = _Submission(ids, params, n=n,
                           trace_ctx=((sp.trace_id, sp.span_id)
@@ -681,6 +738,22 @@ class CompletionServer:
                 return handler._json(
                     429, {"error": payload["error"]},
                     headers=(("Retry-After", str(payload["retry_after"])),))
+            if kind == "shed":
+                # the engine dropped this request from its queue:
+                # siblings of an n>1 submission are cancelled (one
+                # atomic answer), and the status is typed — 429 for a
+                # capacity displacement (retryable backpressure), 504
+                # for a spent deadline (terminal)
+                self._subs.put(_Cancel(sub))
+                if payload.get("where") == "capacity":
+                    ra = max(1, round(float(payload.get("retry_after",
+                                                        1.0))))
+                    return handler._json(
+                        429, {"error": payload["error"]},
+                        headers=(("Retry-After", str(ra)),))
+                return handler._json(
+                    504, {"error": payload["error"],
+                          "code": "deadline_exceeded"})
             if kind == "migrated":
                 # the request left this worker mid-decode (drain): hand
                 # the caller the handoff coordinates so the cluster
@@ -760,6 +833,28 @@ class CompletionServer:
                         429, {"error": payload["error"]},
                         headers=(("Retry-After",
                                   str(payload["retry_after"])),))
+                if kind == "shed":
+                    # usually pre-admission (real 429/504 status line);
+                    # a preempted-then-requeued stream can shed AFTER
+                    # tokens flowed — then it ends with a typed error
+                    # chunk and no [DONE]
+                    if not started:
+                        if payload.get("where") == "capacity":
+                            ra = max(1, round(float(
+                                payload.get("retry_after", 1.0))))
+                            return handler._json(
+                                429, {"error": payload["error"]},
+                                headers=(("Retry-After", str(ra)),))
+                        return handler._json(
+                            504, {"error": payload["error"],
+                                  "code": "deadline_exceeded"})
+                    handler._chunk(
+                        b"data: "
+                        + json.dumps(dict(_deadline_response(),
+                                          shed=payload.get("where"))
+                                     ).encode() + b"\n\n")
+                    clean = False
+                    break
                 if kind == "migrated":
                     # the request left this worker mid-decode (drain):
                     # end the stream with a migrate marker and NO [DONE]

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""load_replay: CLI over the traffic-replay & saturation harness.
+
+Synthesize a seeded workload (or replay a recorded JSONL trace) against
+a serving target — the single-process ``serving_http`` server or the
+cluster router — open-loop at a controlled QPS, and print the capacity
+report: p50/p99 TTFT, inter-token latency, goodput-under-SLO, and the
+429/shed/preempt/migrate accounting read off the stack's own /health
+counters. ``--sweep`` walks a QPS ladder and reports the saturation
+knee. See docs/SERVING.md "Capacity & overload runbook".
+
+Usage:
+    # synthesize 10 QPS for 30s against a running server
+    python scripts/load_replay.py --target http://127.0.0.1:8000 \\
+        --qps 10 --duration 30 --classes 0:500:0.2,1:1000:0.5,2:250:0.3
+
+    # write the schedule out (replayable referee), then replay it
+    python scripts/load_replay.py --qps 10 --duration 30 \\
+        --trace-out burst.jsonl --no-run
+    python scripts/load_replay.py --target http://... --trace-in burst.jsonl
+
+    # sweep for the knee
+    python scripts/load_replay.py --target http://... --sweep 4,8,16,32
+
+    # no target: spin an in-process tiny-llama server (smoke/demo)
+    python scripts/load_replay.py --qps 8 --duration 5
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _parse_range(s: str):
+    lo, _, hi = s.partition(":")
+    return (int(lo), int(hi or lo))
+
+
+def _parse_classes(s: str):
+    """"prio:slo_ms:weight,..." — empty slo_ms means no SLO."""
+    out = []
+    for part in s.split(","):
+        prio, slo, weight = part.split(":")
+        out.append((int(prio), float(slo) if slo else None,
+                    float(weight)))
+    return tuple(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="load_replay", description=__doc__)
+    p.add_argument("--target", default=None,
+                   help="base URL of the server/router; omitted = spin "
+                        "an in-process tiny-llama CompletionServer")
+    p.add_argument("--qps", type=float, default=8.0)
+    p.add_argument("--duration", type=float, default=10.0)
+    p.add_argument("--process", default="poisson",
+                   choices=("poisson", "uniform", "burst"))
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prompt-tokens", default="4:12", metavar="LO:HI")
+    p.add_argument("--max-tokens", default="4:12", metavar="LO:HI")
+    p.add_argument("--classes", default="1::1.0",
+                   help="prio:slo_ms:weight[,...]; empty slo_ms = none")
+    p.add_argument("--cancel-rate", type=float, default=0.0)
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--trace-in", default=None,
+                   help="replay this JSONL trace instead of synthesizing")
+    p.add_argument("--trace-out", default=None,
+                   help="write the synthesized schedule here")
+    p.add_argument("--no-run", action="store_true",
+                   help="with --trace-out: write the trace and exit")
+    p.add_argument("--sweep", default=None, metavar="Q1,Q2,...",
+                   help="QPS ladder: run each rate, report the knee")
+    p.add_argument("--knee-threshold", type=float, default=0.85)
+    p.add_argument("--stream-timeout", type=float, default=60.0)
+    p.add_argument("--json", action="store_true",
+                   help="print the raw report JSON only")
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.loadgen import (WorkloadSpec, dump_trace, load_trace,
+                                    run_schedule, stack_stats, summarize,
+                                    sweep, synthesize, trace_digest)
+
+    spec = WorkloadSpec(
+        qps=args.qps, duration_s=args.duration, process=args.process,
+        prompt_tokens=_parse_range(args.prompt_tokens),
+        max_tokens=_parse_range(args.max_tokens),
+        classes=_parse_classes(args.classes),
+        cancel_rate=args.cancel_rate, vocab_size=args.vocab,
+        seed=args.seed)
+
+    schedule = (load_trace(args.trace_in) if args.trace_in
+                else synthesize(spec))
+    if args.trace_out:
+        dump_trace(schedule, args.trace_out)
+        print(f"# wrote {len(schedule)} requests "
+              f"(digest {trace_digest(schedule)[:12]}) to "
+              f"{args.trace_out}", file=sys.stderr)
+        if args.no_run:
+            return 0
+
+    srv = None
+    target = args.target
+    if target is None:
+        # demo mode: an in-process tiny engine behind the real HTTP
+        # front door, so the CLI is runnable with zero setup
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.serving import ContinuousBatchEngine
+        from paddle_tpu.serving_http import CompletionServer
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+        eng = ContinuousBatchEngine(model, max_batch=4, max_len=64,
+                                    page_size=8, max_queue=8)
+        srv = CompletionServer(eng).start()
+        host, port = srv.address
+        target = f"http://{host}:{port}"
+        print(f"# in-process tiny-llama server at {target}",
+              file=sys.stderr)
+
+    try:
+        if args.sweep:
+            qps_list = [float(q) for q in args.sweep.split(",")]
+            report = sweep(target, spec, qps_list,
+                           threshold=args.knee_threshold,
+                           stream_timeout=args.stream_timeout)
+            if not args.json:
+                print(f"# knee at {report['knee_qps']} QPS",
+                      file=sys.stderr)
+        else:
+            before = stack_stats(target)
+            duration = (args.duration if not args.trace_in
+                        else max(tr.t for tr in schedule) + 1.0)
+            outcomes = run_schedule(target, schedule,
+                                    stream_timeout=args.stream_timeout)
+            report = summarize(outcomes, duration,
+                               offered_qps=len(schedule) / duration,
+                               stack_before=before,
+                               stack_after=stack_stats(target),
+                               digest=trace_digest(schedule))
+        print(json.dumps(report, indent=None if args.json else 1))
+    finally:
+        if srv is not None:
+            srv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

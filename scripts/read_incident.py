@@ -190,6 +190,39 @@ def format_sched(b: dict, last: int = 20) -> List[str]:
     return lines
 
 
+def format_admission(b: dict, last: int = 20) -> List[str]:
+    """Overload accounting pulled out of the timeline: ``sched.shed``
+    rows (deadline expiry / unmeetable budgets / capacity displacement)
+    against the submit/admit flow — the section that answers "who did
+    the engine turn away, and why" during a saturation incident. Absent
+    when nothing was shed."""
+    evs = b.get("events") or []
+    sheds = [e for e in evs if e.get("kind") == "sched.shed"]
+    if not sheds:
+        return []
+    by_where: Dict[str, int] = {}
+    for e in sheds:
+        w = str(e.get("where"))
+        by_where[w] = by_where.get(w, 0) + 1
+    n_submit = sum(1 for e in evs if e.get("kind") == "engine.submit")
+    n_admit = sum(1 for e in evs if e.get("kind") == "engine.admit")
+    t_end = max(e["mono_ns"] for e in evs)
+    lines = [
+        "ADMISSION / SHED  ("
+        + ", ".join(f"{k}={v}" for k, v in sorted(by_where.items()))
+        + f"; {n_submit} submitted / {n_admit} admitted in ring)"]
+    for ev in sheds[-last:]:
+        miss = ev.get("miss_ms")
+        lines.append(
+            f"  t{_rel_ms(ev, t_end):+10.1f}ms  shed "
+            f"rid={ev.get('rid')} p{ev.get('priority')} "
+            f"{ev.get('where')}"
+            + (f" miss={miss:.0f}ms" if isinstance(miss, (int, float))
+               else "")
+            + f" depth={ev.get('queue_depth')}")
+    return lines
+
+
 def format_chaos(b: dict, last: int = 20) -> List[str]:
     """Injected faults vs. migration symptoms, pulled out of the
     timeline: ``chaos.inject`` rows are what the fault plan DID,
@@ -247,6 +280,7 @@ def render(b: dict, events: int = 30, per_subsystem: int = 5,
             format_timeline(b, last=events),
             format_subsystems(b, k=per_subsystem, only=subsystem),
             format_sched(b),
+            format_admission(b),
             format_chaos(b),
             format_engines(b),
             format_spans(b),

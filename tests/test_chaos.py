@@ -195,11 +195,18 @@ def test_mark_busy_backoff_is_jittered():
 
 def test_chaos_dryrun_gate():
     """Tier-1 robustness gate: the real multi-process cluster under the
-    fixed-seed default plan. Worker kill + handoff drop + handoff
-    corruption + heartbeat stall + injected router 5xx, one run:
+    fixed-seed default plan, WITH generated open-loop load flowing
+    while the faults fire (not idle hand-built streams). Worker kill +
+    handoff drop + handoff corruption + heartbeat stall + injected
+    router 5xx, one run:
 
-    - every stream completes token-identical with a clean [DONE];
-    - zero client-visible 5xx (every injected fault was absorbable);
+    - every gate stream completes token-identical with a clean [DONE];
+    - zero client-visible 5xx (every injected fault was absorbable) —
+      for the gate streams AND the generated load;
+    - every generated-load rejection is typed (429 / deadline-504),
+      none stalls silently, and the shed accounting balances
+      (requests_shed == deadline_misses: no bounded queue here, so
+      every shed is a deadline miss);
     - the corrupt bundle was DETECTED (HandoffCorrupt checksum message
       in the retry reason) and retried — never admitted;
     - the dropped bundle was absorbed: its own 504 timeout re-placed it,
@@ -210,7 +217,8 @@ def test_chaos_dryrun_gate():
       lease; the killed worker exited with the planned code."""
     from paddle_tpu.chaos.dryrun import default_plan, run_dryrun
 
-    report = run_dryrun(default_plan(seed=0))
+    report = run_dryrun(default_plan(seed=0), load_qps=6.0,
+                        load_duration_s=4.0)
     assert report["streams"], "no streams ran"
     for s in report["streams"]:
         assert s["status"] == 200, report
@@ -233,3 +241,18 @@ def test_chaos_dryrun_gate():
     assert ("kv_handoff.send", "corrupt") in w0, fired
     assert ("worker.request", "stall_heartbeat") in w0, fired
     assert report["ok"], report
+
+    # the generated-load leg: traffic flowed WHILE the faults fired,
+    # and the overload contract held — typed outcomes only, zero 5xx,
+    # zero silent stalls, shed accounting balanced
+    load = report["load"]
+    assert load is not None and load["n"] > 0, load
+    assert load["http_5xx"] == 0, load
+    assert load["untyped"] == 0, load
+    assert load["timed_out"] == 0, load
+    stack = load["stack"]
+    # no bounded queue in the dryrun engines: every shed is a deadline
+    # miss, and the counters (summed over the same engines) must agree
+    assert stack["requests_shed"] == stack["deadline_misses"], stack
+    if load["shed_504"]:
+        assert stack["deadline_misses"] > 0, (load, stack)

@@ -1,0 +1,242 @@
+"""Traffic replay & saturation harness (paddle_tpu.loadgen): seeded
+synthesis determinism (replay is only a referee if two runs provably
+saw the same traffic), the JSONL trace round-trip, and THE tier-1
+saturation gate — a seconds-scale QPS burst at 2x the measured knee
+against an in-process engine, pinning the overload contract:
+
+- zero requests admitted after their deadline expired (shed rids never
+  appear as engine.admit events);
+- every rejection is typed — 429 with Retry-After or 504 with
+  code=deadline_exceeded — zero 5xx, zero silent stalls;
+- lowest-priority classes shed first, top-class p99 TTFT stays bounded;
+- goodput-under-SLO is reported, and the client-visible outcome counts
+  reconcile exactly with the engine's own shed/reject accounting.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.loadgen import (TraceRequest, WorkloadSpec, dumps_trace,
+                                find_knee, loads_trace, run_schedule,
+                                stack_stats, summarize, sweep, synthesize,
+                                trace_digest)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import flightrecorder as frec
+from paddle_tpu.serving import ContinuousBatchEngine
+from paddle_tpu.serving_http import CompletionServer
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+
+
+# ---- determinism: the referee must be reproducible --------------------------
+
+def test_synthesis_deterministic_and_seed_sensitive():
+    spec = WorkloadSpec(qps=12, duration_s=3, process="poisson",
+                        prompt_tokens=(4, 10), max_tokens=(4, 12),
+                        classes=((0, 500.0, 0.2), (1, 1000.0, 0.5),
+                                 (2, 250.0, 0.3)),
+                        cancel_rate=0.1, seed=7)
+    a, b = synthesize(spec), synthesize(spec)
+    # same seed + same spec => byte-identical schedule
+    assert dumps_trace(a) == dumps_trace(b)
+    assert trace_digest(a) == trace_digest(b)
+    # a different seed is different traffic
+    c = synthesize(spec.replace(seed=8))
+    assert trace_digest(c) != trace_digest(a)
+    # the mix actually covers the spec'd classes and cancel markers
+    prios = {tr.priority for tr in a}
+    assert prios <= {0, 1, 2} and len(prios) >= 2
+    assert any(tr.cancel_after_s is not None for tr in a)
+    assert all(tr.t < spec.duration_s for tr in a)
+
+
+def test_trace_roundtrip_byte_identical(tmp_path):
+    spec = WorkloadSpec(qps=10, duration_s=2, seed=3,
+                        classes=((1, 800.0, 1.0),))
+    sched = synthesize(spec)
+    raw = dumps_trace(sched)
+    again = loads_trace(raw)
+    assert dumps_trace(again) == raw          # loader loses nothing
+    path = tmp_path / "trace.jsonl"
+    path.write_text(raw)
+    from paddle_tpu.loadgen import load_trace
+
+    assert dumps_trace(load_trace(str(path))) == raw
+    # null-field round trip: no slo, no cancel
+    tr = TraceRequest(0.5, [1, 2, 3], 4)
+    rt = loads_trace(dumps_trace([tr]))[0]
+    assert rt.slo_ms is None and rt.cancel_after_s is None
+
+
+def test_arrival_processes():
+    base = dict(duration_s=4.0, prompt_tokens=(4, 4), max_tokens=(4, 4),
+                seed=5)
+    uni = synthesize(WorkloadSpec(qps=10, process="uniform", **base))
+    gaps = np.diff([tr.t for tr in uni])
+    assert np.allclose(gaps, 0.1)             # fixed 1/qps spacing
+    poi = synthesize(WorkloadSpec(qps=10, process="poisson", **base))
+    assert 10 <= len(poi) <= 80               # ~40 expected, seeded
+    assert np.diff([tr.t for tr in poi]).std() > 0
+    bur = synthesize(WorkloadSpec(qps=10, process="burst",
+                                  burst_on_s=1.0, burst_off_s=1.0,
+                                  burst_factor=2.0, **base))
+    # every burst arrival sits inside an on-window of the 2s cycle
+    assert all((tr.t % 2.0) < 1.0 for tr in bur)
+
+
+def test_find_knee_picks_last_good_point():
+    pts = [{"offered_qps": q, "goodput": {"ratio": r}}
+           for q, r in ((4, 1.0), (8, 0.95), (16, 0.6), (32, 0.2))]
+    assert find_knee(pts, threshold=0.85) == 8
+    # all past saturation -> lowest rate, never a crash
+    bad = [{"offered_qps": q, "goodput": {"ratio": 0.1}} for q in (4, 8)]
+    assert find_knee(bad) == 4
+
+
+# ---- live harness -----------------------------------------------------------
+
+def test_summary_stable_across_runs(tiny_model):
+    """Same seed + same trace => identical schedule digest and identical
+    outcome counts across two runs against a live engine (timing stats
+    move, the schedule and its accounting must not)."""
+    eng = ContinuousBatchEngine(tiny_model, max_batch=4, max_len=64,
+                                page_size=8)
+    spec = WorkloadSpec(qps=6, duration_s=1.5, prompt_tokens=(4, 8),
+                        max_tokens=(2, 4), seed=2,
+                        vocab_size=tiny_model.config.vocab_size)
+    sched = synthesize(spec)
+    with CompletionServer(eng) as srv:
+        host, port = srv.address
+        url = f"http://{host}:{port}"
+        runs = []
+        for _ in range(2):
+            outs = run_schedule(url, sched, stream_timeout=60)
+            runs.append(summarize(outs, spec.duration_s,
+                                  offered_qps=spec.qps,
+                                  digest=trace_digest(sched)))
+    a, b = runs
+    assert a["schedule_digest"] == b["schedule_digest"]
+    assert a["n"] == b["n"]
+    # unsaturated engine, no SLOs: both runs complete everything
+    assert a["completed"] == b["completed"] == a["n"]
+    assert a["http_5xx"] == b["http_5xx"] == 0
+    assert set(a["by_priority"]) == set(b["by_priority"])
+
+
+def test_saturation_gate(tiny_model):
+    """THE gate: sweep to the knee, then a 2x-knee overload burst with a
+    priority/SLO mix. Zero admitted-then-expired, all rejections typed,
+    zero 5xx / stalls, low classes shed first, top-class p99 TTFT
+    bounded, goodput reported and reconciled with engine accounting."""
+    # ONE slot + a short bounded queue: capacity is ~1/(tokens*step)
+    # rps, so the 2x-knee burst reliably builds the queue the 250ms
+    # class expires in — the gate needs real sheds and 429s, not a
+    # lucky fast engine
+    eng = ContinuousBatchEngine(tiny_model, max_batch=1, max_len=64,
+                                page_size=8, max_queue=8, aging_s=2.0)
+    rec = frec.get_recorder()
+    was = rec.enabled
+    rec.enable()
+    try:
+        with CompletionServer(eng) as srv:
+            host, port = srv.address
+            url = f"http://{host}:{port}"
+            base = WorkloadSpec(
+                qps=8, duration_s=2.0, process="poisson",
+                prompt_tokens=(4, 10), max_tokens=(16, 24),
+                classes=((0, 3000.0, 0.2), (1, 1500.0, 0.4),
+                         (2, 250.0, 0.4)),
+                vocab_size=tiny_model.config.vocab_size, seed=0)
+            # deterministic warm-up: both prompt-length buckets (8 and
+            # 16 at page_size=8) compile OUTSIDE the measured runs, and
+            # enough first tokens land to arm the engine's service
+            # floor — the sweep then measures serving, not compiles
+            run_schedule(url, [
+                TraceRequest(0.1 * i, [7] * plen, 16)
+                for i, plen in enumerate((5, 10, 5, 10, 5))],
+                stream_timeout=120)
+            curve = sweep(url, base, (16, 32), stream_timeout=60)
+            knee = curve["knee_qps"]
+            assert knee > 0                    # the knee is reported
+
+            over_spec = base.replace(qps=2.0 * knee, duration_s=2.0)
+            sched = synthesize(over_spec)
+            since = rec.stats()["recorded"]
+            before = stack_stats(url)
+            outs = run_schedule(url, sched, stream_timeout=60)
+            after = stack_stats(url)
+            summary = summarize(outs, over_spec.duration_s,
+                                offered_qps=over_spec.qps,
+                                stack_before=before, stack_after=after,
+                                digest=trace_digest(sched))
+    finally:
+        if not was:
+            rec.disable()
+
+    # --- every outcome typed; no stalls, no 5xx -------------------------
+    assert summary["untyped"] == 0, summary
+    assert summary["http_5xx"] == 0, summary
+    assert summary["timed_out"] == 0, summary
+    for o in outs:
+        assert o.status in (200, 429, 504), o.as_dict()
+        if o.status == 429:
+            assert o.retry_after is not None      # computed hint rides
+            assert 1 <= int(o.retry_after) <= 30  # the pinned bounds
+        if o.status == 504:
+            assert o.code == "deadline_exceeded", o.as_dict()
+
+    # --- accounting reconciles client <-> engine ------------------------
+    stack = summary["stack"]
+    assert stack["deadline_misses"] == summary["shed_504"], (summary,
+                                                             stack)
+    capacity_sheds = stack["requests_shed"] - stack["deadline_misses"]
+    assert capacity_sheds >= 0
+    assert summary["rejected_429"] == (stack["requests_rejected"]
+                                       + capacity_sheds), (summary, stack)
+
+    # --- zero admitted-then-expired: a shed rid never took a slot -------
+    evs = rec.events(since=since)
+    shed_rids = {e["rid"] for e in evs if e["kind"] == "sched.shed"}
+    admitted_rids = {e["rid"] for e in evs if e["kind"] == "engine.admit"}
+    assert shed_rids, "a 2x-knee burst with a 300ms class must shed"
+    assert not (shed_rids & admitted_rids)
+
+    # --- priority ordering: the top class degrades last -----------------
+    byp = summary["by_priority"]
+    p0, p2 = byp["0"], byp["2"]
+    r0 = p0["completed"] / p0["n"] if p0["n"] else 1.0
+    r2 = p2["completed"] / p2["n"] if p2["n"] else 1.0
+    assert r0 >= r2, (p0, p2)
+    if p0["completed"]:
+        assert p0["ttft_ms"]["p99"] < 10_000.0    # bounded, not stalled
+
+    # --- goodput-under-SLO is reported ----------------------------------
+    assert summary["goodput"]["ratio"] is not None
+    assert summary["goodput"]["tokens_per_s"] >= 0.0
+    assert summary["schedule_digest"] == trace_digest(sched)
+
+
+def test_stack_stats_single_process(tiny_model):
+    eng = ContinuousBatchEngine(tiny_model, max_batch=2, max_len=64,
+                                page_size=8)
+    with CompletionServer(eng) as srv:
+        host, port = srv.address
+        url = f"http://{host}:{port}"
+        before = stack_stats(url)
+        sched = [TraceRequest(0.0, [1, 2, 3, 4], 3)]
+        outs = run_schedule(url, sched, stream_timeout=60)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            after = stack_stats(url)
+            if after["requests_finished"] - before["requests_finished"]:
+                break
+            time.sleep(0.05)
+    assert outs[0].status == 200 and outs[0].clean
+    assert after["requests_admitted"] - before["requests_admitted"] == 1
+    assert after["tokens_generated"] - before["tokens_generated"] == 3

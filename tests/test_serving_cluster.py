@@ -968,3 +968,263 @@ def test_launcher_config_loading(tmp_path):
     # no workers section -> two unified workers, count stripped
     assert [w["role"] for w in expand_workers({})] == ["unified"] * 2
     assert all("count" not in w for w in expand_workers(loaded))
+
+
+# ---- end-to-end deadlines (overload resilience) -----------------------------
+
+def test_deadline_header_roundtrip_and_router_shed():
+    """The deadline contract, pinned: the router stamps each upstream
+    hop with X-Request-Deadline = its own budget MINUS elapsed time
+    (never a fresh budget), and a request whose budget is already spent
+    is shed AT the router — typed 504 with code=deadline_exceeded,
+    without ever touching a worker."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from paddle_tpu.serving_cluster.router import RouterServer
+
+    seen = []
+
+    class Stub(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            seen.append(self.headers.get("X-Request-Deadline"))
+            body = json.dumps({"choices": [{"index": 0,
+                                            "token_ids": [7]}]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=httpd.serve_forever, daemon=True,
+                     name="stub-worker-http").start()
+    pool = _FakePool({0: httpd.server_address})
+    router = RouterServer(pool, max_retries=1).start()
+    try:
+        host, port = router.address
+
+        def post(body):
+            c = http.client.HTTPConnection(host, port, timeout=60)
+            t0 = time.monotonic()
+            c.request("POST", "/v1/completions", json.dumps(body),
+                      {"Content-Type": "application/json"})
+            r = c.getresponse()
+            data = json.loads(r.read())
+            c.close()
+            return r.status, data, time.monotonic() - t0
+
+        st, data, elapsed = post({"prompt_token_ids": [1, 2, 3],
+                                  "max_tokens": 2, "slo_ms": 900.0})
+        assert st == 200, data
+        assert len(seen) == 1 and seen[0] is not None
+        remaining = float(seen[0])
+        # the worker's effective deadline is the router's minus elapsed:
+        # 0 < remaining <= 900, and the slack is bounded by the
+        # measured request wall time
+        assert 0 < remaining <= 900.0
+        assert 900.0 - remaining <= elapsed * 1000.0 + 50.0
+
+        # no slo: no header
+        st, data, _ = post({"prompt_token_ids": [1, 2, 3],
+                            "max_tokens": 2})
+        assert st == 200 and seen[1] is None
+
+        # spent budget: shed at the router, the stub never sees it
+        n_before = len(seen)
+        st, data, _ = post({"prompt_token_ids": [1, 2, 3],
+                            "max_tokens": 2, "slo_ms": 0.001})
+        assert st == 504 and data["code"] == "deadline_exceeded", data
+        assert len(seen) == n_before
+        health = _get_json(f"http://{host}:{port}/health")
+        assert health["router"]["deadline"] == 1
+    finally:
+        router.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_worker_effective_deadline_from_header():
+    """The worker half of the contract: an inbound X-Request-Deadline
+    header becomes the engine request's admission deadline (remaining
+    budget, header wins over body slo_ms) — pinned by inspecting the
+    queued request's absolute deadline."""
+    from paddle_tpu.serving_http import CompletionServer
+
+    model = _ref_model()
+    eng = ContinuousBatchEngine(model, max_batch=1, max_len=256,
+                                page_size=8)
+    with CompletionServer(eng) as srv:
+        host, port = srv.address
+        holder = http.client.HTTPConnection(host, port, timeout=120)
+        holder.request(
+            "POST", "/v1/completions",
+            json.dumps({"prompt_token_ids": [1, 2, 3, 4],
+                        "max_tokens": 250, "stream": True}),
+            {"Content-Type": "application/json"})
+        resp = holder.getresponse()
+        assert resp.status == 200
+        resp.readline()               # slot definitely held
+        probe = http.client.HTTPConnection(host, port, timeout=120)
+        t_send = time.perf_counter()
+        probe.request(
+            "POST", "/v1/completions",
+            json.dumps({"prompt_token_ids": [5, 6, 7], "max_tokens": 2,
+                        "slo_ms": 1.0}),   # body slo would shed instantly
+            {"Content-Type": "application/json",
+             "X-Request-Deadline": "5000"})
+        # the probe is QUEUED behind the holder: its engine deadline
+        # must derive from the header (5s), not the body (1ms)
+        import math
+
+        deadline = None
+        while time.perf_counter() - t_send < 10.0:
+            q = list(eng._queue)
+            if q and q[0].deadline != math.inf:
+                deadline = q[0].deadline
+                break
+            time.sleep(0.01)
+        assert deadline is not None, "probe never appeared in the queue"
+        remaining = deadline - time.perf_counter()
+        assert 3.5 <= remaining <= 5.0, remaining
+        r = probe.getresponse()
+        data = json.loads(r.read())
+        assert r.status == 200, data  # completed inside the 5s budget
+        probe.close()
+        resp.read()
+        holder.close()
+
+
+def test_client_disconnect_mid_relay_cancels_worker(unified_cluster):
+    """Satellite regression: a client dropping its SSE mid-relay (under
+    concurrent load) must propagate through the router to the worker —
+    the worker sees its own socket die, CANCELS the engine request
+    (engine.cancel event), and the slot frees instead of decoding to a
+    dead socket. Concurrent streams are unaffected."""
+    import socket as _socket
+
+    cluster = unified_cluster
+    host, port = cluster.address
+    model = _ref_model()
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(1, 512, (9,)).tolist() for _ in range(3)]
+    solos = [model.generate(paddle.to_tensor(np.asarray(p)[None]),
+                            max_new_tokens=64).numpy()[0].tolist()
+             for p in prompts]
+    # the live worker's cancel-event cursor BEFORE the drop
+    health = _get_json(f"http://{host}:{port}/health")
+    workers = [w for w in health["workers"].values() if w["alive"]]
+    assert workers
+    cursors = {w["url"]: _get_json(
+        w["url"] + "/debug/events?kind=engine.cancel")["next_since"]
+        for w in workers}
+
+    results = [None] * len(prompts)
+
+    def client(i):
+        results[i] = _stream_completion(
+            host, port,
+            {"prompt_token_ids": prompts[i], "max_tokens": 64,
+             "stream": True})
+
+    threads = [threading.Thread(target=client, args=(i,),
+                                name=f"disc-client-{i}")
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+
+    # the victim: read a couple of tokens, then drop the socket hard
+    victim = http.client.HTTPConnection(host, port, timeout=120)
+    victim.request("POST", "/v1/completions",
+                   json.dumps({"prompt_token_ids": prompts[0],
+                               "max_tokens": 100, "stream": True}),
+                   {"Content-Type": "application/json"})
+    vresp = victim.getresponse()
+    assert vresp.status == 200
+    got = 0
+    while got < 2:
+        line = vresp.readline()
+        if line.startswith(b"data: ") and b"token_ids" in line:
+            got += 1
+    victim.sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_LINGER,
+                           __import__("struct").pack("ii", 1, 0))
+    # close EVERY reference: the response's makefile object holds the
+    # fd, so sock.close() alone would leave the connection open and the
+    # router would never feel the drop
+    vresp.close()
+    victim.close()                    # last ref + linger(0) => RST
+
+    # the worker must emit engine.cancel and free the slot
+    cancelled = False
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and not cancelled:
+        for url, since in cursors.items():
+            try:
+                evs = _get_json(
+                    url + f"/debug/events?kind=engine.cancel"
+                          f"&since={since}")["events"]
+            except OSError:
+                continue
+            if any(e.get("where") == "active" for e in evs):
+                cancelled = True
+                break
+        if not cancelled:
+            time.sleep(0.25)
+    assert cancelled, "no worker cancelled the dropped stream's slot"
+
+    for t in threads:
+        t.join(timeout=300)
+    for i, (clean, toks, _) in enumerate(results):
+        assert clean and toks == solos[i], f"stream {i} was disturbed"
+
+    # every slot drains: the cancelled request's slot was freed
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        health = _get_json(f"http://{host}:{port}/health")
+        busy = sum(w.get("active", 0) for w in health["workers"].values()
+                   if w["alive"])
+        if busy == 0:
+            break
+        time.sleep(0.25)
+    assert busy == 0, "the dropped stream's slot never freed"
+
+
+def test_router_429_when_all_workers_busy_backed_off():
+    """A request arriving while EVERY live worker sits out a busy
+    backoff (earned from other requests' 429s) gets typed backpressure
+    — 429 + computed Retry-After — never the 502 a dead pool earns
+    (regression: found driving the load harness at a real router)."""
+    from paddle_tpu.serving_cluster.router import RouterServer
+    from paddle_tpu.serving_http import CompletionServer
+
+    model = _ref_model()
+    eng = ContinuousBatchEngine(model, max_batch=2, max_len=64,
+                                page_size=8)
+    worker = CompletionServer(eng).start()
+    try:
+        pool = _FakePool({0: worker.address})
+        router = RouterServer(pool, max_retries=1).start()
+        try:
+            host, port = router.address
+            pool.mark_busy(0, backoff_s=30.0)   # another request's 429
+            c = http.client.HTTPConnection(host, port, timeout=60)
+            c.request("POST", "/v1/completions",
+                      json.dumps({"prompt_token_ids": [1, 2, 3],
+                                  "max_tokens": 2}),
+                      {"Content-Type": "application/json"})
+            r = c.getresponse()
+            body = json.loads(r.read())
+            ra = r.getheader("Retry-After")
+            c.close()
+            assert r.status == 429, (r.status, body)
+            assert "capacity" in body["error"]
+            assert ra is not None and 1 <= int(ra) <= 30
+            # the worker is alive and untouched: no mark_dead happened
+            assert all(w["alive"] for w in pool.workers())
+        finally:
+            router.close()
+    finally:
+        worker.close()
