@@ -249,7 +249,58 @@ def decode_bench(devs, gen):
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
+    if os.environ.get("BENCH_SPEC"):
+        rec.update(_spec_decode_leg(model, on_tpu))
     print(json.dumps(rec))
+
+
+def _spec_decode_leg(model, on_tpu):
+    """BENCH_SPEC=1 rider on the decode leg: engine speculative decode
+    (n-gram drafter, BENCH_SPEC_K chunk width) on a REPETITIVE prompt —
+    the drafter's best case, so ``accepted_tokens_per_dispatch`` records
+    the acceptance ceiling of the multi-token step next to the one-token
+    step_ms. Persisted under BENCH_STATE.json:cpu_smoke.decode on CPU so
+    the next TPU capture has a before/after."""
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    spec_k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    cfg = model.config
+    if on_tpu:
+        slots, max_len, new = 8, 512, 96
+        pat = np.tile(np.asarray([3, 5, 7, 9]), 16)
+    else:
+        # 32-token repeating prompt + enough budget that the greedy
+        # stream's own cycles land in the drafter's history window —
+        # measured 1.4+ accepted tokens/dispatch on the smoke model
+        slots, max_len, new = 1, 256, 48
+        pat = np.tile(np.asarray([3, 5, 7, 9]), 8)
+
+    def run():
+        eng = ContinuousBatchEngine(model, max_batch=slots,
+                                    max_len=max_len, page_size=16,
+                                    speculative_k=spec_k)
+        for _ in range(slots):
+            eng.add_request(pat % cfg.vocab_size, new)
+        eng.run_until_done()
+        return eng.stats()
+
+    run()  # warm-up: compiles the prefill bucket + the spec verify step
+    t0 = time.perf_counter()
+    st = run()
+    dt = time.perf_counter() - t0
+    return {
+        "accepted_tokens_per_dispatch": round(
+            st["accepted_tokens_per_dispatch"], 3),
+        "spec": {
+            "k": spec_k,
+            "dispatches": st["spec_dispatches"],
+            "accepted_tokens": st["spec_accepted_tokens"],
+            "emitted_tokens": st["spec_emitted_tokens"],
+            "tokens_per_sec": round(st["tokens_generated"] / dt, 1),
+            "spec_step_ms": round(dt * 1000 / max(st["decode_steps"], 1),
+                                  3),
+        },
+    }
 
 
 def mla_decode_bench(devs, gen):
@@ -393,15 +444,23 @@ def serve_bench(devs, gen):
             model, algo=("weight_only_int4" if int4
                          else "weight_only_int8"))
     rng = np.random.RandomState(0)
+    # BENCH_SPEC=1: the engine runs multi-token speculative steps (n-gram
+    # drafter) — the record carries accepted_tokens_per_dispatch so spec
+    # and plain captures stay distinguishable
+    spec_k = (int(os.environ.get("BENCH_SPEC_K", "4"))
+              if os.environ.get("BENCH_SPEC") and not mla else None)
+    last_stats = {}
 
     def run():
         eng = ContinuousBatchEngine(model, max_batch=slots, max_len=max_len,
-                                    page_size=16)
+                                    page_size=16, speculative_k=spec_k)
         for i in range(n_req):
             plen = [64, 128, 200, 256][i % 4] if on_tpu else 4 + (i % 8)
             budget = [96, 128, 160][i % 3] if on_tpu else 6
             eng.add_request(rng.randint(0, cfg.vocab_size, (plen,)), budget)
         done = eng.run_until_done()
+        last_stats.clear()
+        last_stats.update(eng.stats())
         return sum(v.size for v in done.values())
 
     run()  # warm-up: compiles the bucketed prefills + the decode step
@@ -429,6 +488,9 @@ def serve_bench(devs, gen):
         "fused_decode_tail": fused,
         "requests": n_req,
         "slots": slots,
+        "speculative_k": spec_k,
+        "accepted_tokens_per_dispatch": round(
+            last_stats.get("accepted_tokens_per_dispatch", 0.0), 3),
         "config": ("serve_mla" if mla
                    else "serve_int4" if int4
                    else "serve_int8" if quantized else "serve"),

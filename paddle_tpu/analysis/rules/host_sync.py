@@ -7,8 +7,10 @@ tokens). Any extra ``.item()`` / ``int()`` / ``float()`` /
 decode/prefill-path function blocks the host on the device queue and
 serializes dispatch — the classic silent 10x in serving loops.
 
-Scope: functions named ``step`` (or containing ``decode``/``prefill``)
-in the hot-path modules (serving.py, generation.py, speculative.py).
+Scope: functions named ``step`` (or containing ``decode``/``prefill``/
+``spec`` — the engine speculation path ``_step_speculative`` and the
+speculative_generate/mtp round loops are decode hot paths too) in the
+hot-path modules (serving.py, generation.py, speculative.py).
 The rule does LOCAL taint tracking rather than banning ``np.asarray``
 outright: a name assigned from a device-producing call (``jnp.*``, a
 jitted step, any non-host call) is device-tainted; converting it — or a
@@ -29,7 +31,11 @@ from typing import Iterable, Set
 from ..core import Finding, ModuleContext, Rule, register_rule
 
 HOT_MODULES = {"serving.py", "generation.py", "speculative.py"}
-_HOT_NAME_PARTS = ("decode", "prefill")
+# "spec" pulls the engine speculation path (_step_speculative, the
+# speculative round loops) into scope: a per-round host sync beyond the
+# deliberate pragma'd fetch would serialize the multi-token dispatches
+# exactly like it would the one-token loop
+_HOT_NAME_PARTS = ("decode", "prefill", "spec")
 
 # calls whose results stay host-side (taint sinks, not sources)
 _HOST_BUILTINS = {

@@ -169,33 +169,76 @@ def cached_attention(q, k, v, cos, sin, k_buf, v_buf, pos, allowed=None,
 def paged_cached_attention(q, k, v, cos, sin, k_pages, v_pages, page_indices,
                            lengths, page_size, window=None, softcap=None,
                            rope_applied=False):
-    """Single-token decode over the PAGED cache (in-layer dispatch).
+    """Multi-token decode over the PAGED cache (in-layer dispatch).
 
-    q [B,1,H,D]; pages [hk, n_pages, page_size, D]; lengths [B] = tokens
-    already present PER ROW. Fully ragged: row b's new token is RoPE'd at
-    position lengths[b] and written at its own page/slot
-    (page_indices[b, lengths[b]//ps], lengths[b]%ps) — the
-    block_multi_head_attention write pattern, which is what lets a
-    continuous-batching server mix requests of different lengths in one
-    step. ``rope_applied``: q/k arrive already rotated (fused decode
-    tail) — skip the per-row rope, keep the write + attention.
+    q [B,S,H,D]; pages [hk, n_pages, page_size, D]; lengths [B] = tokens
+    already present PER ROW. Fully ragged: row b's token j is RoPE'd at
+    position lengths[b]+j and written at its own page/slot
+    (page_indices[b, pos//ps], pos%ps) — the block_multi_head_attention
+    write pattern, which is what lets a continuous-batching server mix
+    requests of different lengths in one step. S == 1 is the classic
+    decode step; S > 1 is the speculative-verify chunk (each chunk
+    position attends the cache plus the chunk prefix before it — the
+    chunk-causal mask). ``rope_applied``: q/k arrive already rotated
+    (fused decode tail) — skip the per-row rope, keep the write +
+    attention.
     """
-    B = q.shape[0]
+    B, S = q.shape[0], q.shape[1]
     lengths = jnp.asarray(lengths, jnp.int32)
     if not rope_applied:
         q = _rope_rows(q, cos, sin, lengths)
         k = _rope_rows(k, cos, sin, lengths)
-    page = lengths // page_size                     # [B]
-    slot = lengths % page_size                      # [B]
-    rows = page_indices[jnp.arange(B), page]        # [B]
+    if S == 1:
+        page = lengths // page_size                 # [B]
+        slot = lengths % page_size                  # [B]
+        rows = page_indices[jnp.arange(B), page]    # [B]
+        k_pages = k_pages.at[:, rows, slot].set(
+            jnp.moveaxis(k[:, 0], 0, 1).astype(k_pages.dtype))
+        v_pages = v_pages.at[:, rows, slot].set(
+            jnp.moveaxis(v[:, 0], 0, 1).astype(v_pages.dtype))
+        out = paged_decode_attention(q[:, 0], k_pages, v_pages, lengths + 1,
+                                     page_indices, window=window,
+                                     softcap=softcap)
+        return out[:, None], k_pages, v_pages
+    # speculative-verify chunk: scatter all S tokens at per-row positions
+    # lengths[b]+j, then chunk-causal attention over the gathered pages.
+    # Rejected-suffix KV lands ABOVE the row's post-accept frontier, where
+    # the next chunk's scatter overwrites it before lengths can reach it —
+    # the same parking invariant chunked prefill relies on.
+    pos = lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]  # [B,S]
+    page = pos // page_size
+    slot = pos % page_size
+    rows = jnp.take_along_axis(page_indices, page, axis=1)            # [B,S]
     k_pages = k_pages.at[:, rows, slot].set(
-        jnp.moveaxis(k[:, 0], 0, 1).astype(k_pages.dtype))
+        jnp.moveaxis(k, 2, 0).astype(k_pages.dtype))
     v_pages = v_pages.at[:, rows, slot].set(
-        jnp.moveaxis(v[:, 0], 0, 1).astype(v_pages.dtype))
-    out = paged_decode_attention(q[:, 0], k_pages, v_pages, lengths + 1,
-                                 page_indices, window=window,
-                                 softcap=softcap)
-    return out[:, None], k_pages, v_pages
+        jnp.moveaxis(v, 2, 0).astype(v_pages.dtype))
+    out = _paged_chunk_attention(q, k_pages, v_pages, lengths, page_indices,
+                                 window=window, softcap=softcap)
+    return out, k_pages, v_pages
+
+
+def _paged_chunk_attention(q, k_pages, v_pages, lengths, page_indices,
+                           window=None, softcap=None):
+    """Chunk attention over the paged cache: q [B,S,H,D] sits at per-row
+    positions lengths[b]+j; column t is visible from chunk position j iff
+    t <= lengths[b]+j (and, windowed, t > lengths[b]+j-window). XLA
+    gather + MXU matmul, exact vs the dense reference — the S=1 Pallas
+    decode kernel has no chunk-causal mask, so the verify chunk takes
+    this path on every backend."""
+    B, S = q.shape[0], q.shape[1]
+    hk, _n, page_size, D = k_pages.shape
+    k = jnp.moveaxis(k_pages[:, page_indices], 0, 1)  # [B,hk,pages,ps,D]
+    v = jnp.moveaxis(v_pages[:, page_indices], 0, 1)
+    T = k.shape[2] * page_size
+    k = k.reshape(B, hk, T, D)
+    v = v.reshape(B, hk, T, D)
+    qpos = lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]  # [B,S]
+    t_idx = jnp.arange(T)
+    valid = t_idx[None, None, :] <= qpos[:, :, None]                # [B,S,T]
+    if window is not None:
+        valid &= t_idx[None, None, :] > (qpos[:, :, None] - window)
+    return _chunk_sdpa(q, k, v, valid, softcap=softcap)
 
 
 def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
@@ -281,21 +324,29 @@ def _paged_window_attention(q, k_pages, v_pages, lengths, page_indices,
 
 def _banded_sdpa(q, k, v, valid, softcap=None):
     """Shared decode-attention tail: q [B,H,D], k/v [B,hk,T,D] gathered,
-    valid [B,T] column mask — the ONE place the f32 softmax numerics of
-    the paged decode paths live. ``softcap``: Gemma2 tanh soft cap on the
-    scaled scores, applied before masking (HF order)."""
-    B, H, D = q.shape
+    valid [B,T] column mask — the S=1 view of :func:`_chunk_sdpa` (the
+    ONE place the f32 softmax numerics of the paged decode paths live)."""
+    return _chunk_sdpa(q[:, None], k, v, valid[:, None],
+                       softcap=softcap)[:, 0]
+
+
+def _chunk_sdpa(q, k, v, valid, softcap=None):
+    """Decode/verify attention core: q [B,S,H,D] against gathered k/v
+    [B,hk,T,D] with a per-position column mask valid [B,S,T]. f32 scores
+    and softmax; ``softcap``: Gemma2 tanh soft cap on the scaled scores,
+    applied before masking (HF order)."""
+    B, S, H, D = q.shape
     hk = k.shape[1]
     g = H // hk
-    qg = q.reshape(B, hk, g, D).astype(jnp.float32)
-    scores = jnp.einsum("bkgd,bktd->bkgt", qg, k.astype(jnp.float32))
+    qg = q.reshape(B, S, hk, g, D).astype(jnp.float32)
+    scores = jnp.einsum("bskgd,bktd->bkgst", qg, k.astype(jnp.float32))
     scores = scores / math.sqrt(D)
     if softcap is not None:
         scores = softcap * jnp.tanh(scores / softcap)
-    scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
+    scores = jnp.where(valid[:, None, None, :, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgt,bktd->bkgd", probs, v.astype(jnp.float32))
-    return out.reshape(B, H, D).astype(q.dtype)
+    out = jnp.einsum("bkgst,bktd->bskgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, H, D).astype(q.dtype)
 
 
 def _paged_attention_ref(q, k_pages, v_pages, lengths, page_indices,
@@ -1187,6 +1238,80 @@ class _SelectDecodeRowsStep:
                                                do_s, temp, tk, tp, bufs,
                                                aux)
         return nxt, lp, last_f, [{**b, **a} for b, a in zip(nb, na)]
+
+
+class _SpecDecodeStep:
+    """Greedy speculative decode unit for the continuous-batching engine,
+    ONE jitted dispatch per round: argmax the carried logits (the token a
+    plain step would emit), forward a k-token chunk [g0, d_1..d_{k-1}] of
+    host-proposed draft tokens through the paged cache at per-row
+    positions, and compute the longest target-greedy-consistent accepted
+    run on device. Returns everything the engine's host loop needs in one
+    fetch: the emitted-token matrix, per-row emit counts, per-token
+    logprobs (raw distribution — the OpenAI logprobs field), and the
+    logits row that seeds the next round.
+
+    Token-identity is by construction: position 0 always forwards g0
+    (the verified greedy token), and draft j is emitted only when it
+    EQUALS the target's greedy choice at its position — junk drafts can
+    only be accepted when they happen to match the true token, so
+    acceptance changes latency, never output. Rejected-suffix KV parks
+    above the post-accept frontier (see paged_cached_attention)."""
+
+    def __init__(self, model, max_len, k):
+        self._model = model
+        k = int(k)
+
+        def pure(state, last, drafts, bufs, aux):
+            B = last.shape[0]
+            with _functional_weights(model, state), _tape.no_grad():
+                g0 = jnp.argmax(last, axis=-1).astype(jnp.int32)   # [B]
+                chunk = (jnp.concatenate([g0[:, None], drafts], axis=1)
+                         if k > 1 else g0[:, None])                # [B,k]
+                caches = [{**b, **a} for b, a in zip(bufs, aux)]
+                hidden, new_caches = model.llama.forward_cached(
+                    wrap(chunk), caches, rope_len=max_len)
+                logits = unwrap(model.lm_head_logits(hidden)
+                                ).astype(jnp.float32)              # [B,k,V]
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,k]
+            if k > 1:
+                ok = (drafts == greedy[:, :-1]).astype(jnp.int32)  # [B,k-1]
+                n_acc = jnp.cumprod(ok, axis=1).sum(axis=1)        # [B]
+            else:
+                n_acc = jnp.zeros((B,), jnp.int32)
+            # logits after the LAST emitted token (chunk position n_acc)
+            # seed the next round — the bonus token is next round's g0
+            new_last = jnp.take_along_axis(
+                logits, n_acc[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0]                                                 # [B,V]
+            lp0 = jax.nn.log_softmax(last.astype(jnp.float32), -1)[
+                jnp.arange(B), g0]                                  # [B]
+            if k > 1:
+                lpd = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits[:, :-1], -1),
+                    drafts[:, :, None].astype(jnp.int32), axis=2
+                )[:, :, 0]                                          # [B,k-1]
+                lps = jnp.concatenate([lp0[:, None], lpd], axis=1)
+            else:
+                lps = lp0[:, None]
+            nb, na = _split_caches(_unwrap_caches(new_caches))
+            return chunk, n_acc + 1, lps, new_last, nb, na
+
+        self._jitted = jax.jit(pure, donate_argnums=(3,))
+        self._state = dict(model.functional_state())
+
+    def __call__(self, last, drafts, caches):
+        bufs, aux = _split_caches(caches)
+        toks, n_emit, lps, last_f, nb, na = self._jitted(
+            self._state, last, drafts, bufs, aux)
+        return toks, n_emit, lps, last_f, [{**b, **a}
+                                           for b, a in zip(nb, na)]
+
+
+def _get_spec_decode(model, max_len, k):
+    return _memoized_step(
+        model, "_spec_decode_steps", (max_len, int(k)),
+        lambda: _SpecDecodeStep(model, max_len, k), maxsize=8)
 
 
 def _get_select_decode_rows(model, max_len):

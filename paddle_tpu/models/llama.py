@@ -594,25 +594,27 @@ class LlamaAttention(Layer):
 
     def decode_fused_qkv(self, hidden_states, norm_weight, eps, cos, sin,
                          kv_cache):
-        """S=1 fused ``rms_norm → q/k/v → rope`` through the decode-tail
+        """Fused ``rms_norm → q/k/v → rope`` through the decode-tail
         megakernel (ops/pallas/decode_tail) — the caller has verified
-        the gate (fused_decode_supported). Returns (q, k, v) shaped like
-        the discrete projections, q/k already rotated at each row's
-        cache position."""
+        the gate (fused_decode_supported). S=1 is the classic decode
+        step; an S>1 speculative-verify chunk flattens to B*S independent
+        rows (the kernels are row-parallel, and each row's rope position
+        is gathered per row). Returns (q, k, v) shaped like the discrete
+        projections, q/k already rotated at each row's cache position."""
         from ..ops.pallas import decode_tail
 
-        b = hidden_states.shape[0]
+        b, s = hidden_states.shape[0], hidden_states.shape[1]
         h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
-        cos_r, sin_r = _rope_rows_for_cache(cos, sin, kv_cache, b)
+        cos_r, sin_r = _rope_rows_for_cache(cos, sin, kv_cache, b, s)
         q2, k2, v2 = apply(
             "fused_decode_qkv",
             lambda x2, wn, wq, wk, wv, c, s_: decode_tail.fused_qkv_rope(
                 x2, wn, wq, wk, wv, c, s_, eps, h, hk, d),
-            hidden_states.reshape([b, self.hidden_size]), norm_weight,
+            hidden_states.reshape([b * s, self.hidden_size]), norm_weight,
             self.q_proj.weight, self.k_proj.weight, self.v_proj.weight,
             cos_r, sin_r)
-        return (q2.reshape([b, 1, h, d]), k2.reshape([b, 1, hk, d]),
-                v2.reshape([b, 1, hk, d]))
+        return (q2.reshape([b, s, h, d]), k2.reshape([b, s, hk, d]),
+                v2.reshape([b, s, hk, d]))
 
     def forward(self, hidden_states, cos, sin, attention_mask=None, kv_cache=None, position_offset=0):
         b, s = hidden_states.shape[0], hidden_states.shape[1]
@@ -741,39 +743,49 @@ class LlamaMLP(Layer):
         return self.down_proj(act)
 
 
-def _rope_rows_for_cache(cos, sin, kv_cache, b):
-    """cos/sin rows at each row's CURRENT decode position, [B, D] f32 —
-    the fused decode-tail kernel ropes in-register, so the (tiny) table
-    gather happens here: paged caches decode at per-row ``lengths``,
-    ragged dense at ``row_pos``, plain dense batches share the scalar
-    ``pos``."""
+def _rope_rows_for_cache(cos, sin, kv_cache, b, s=1):
+    """cos/sin rows at each row's CURRENT decode position(s), [B*S, D]
+    f32 — the fused decode-tail kernel ropes in-register, so the (tiny)
+    table gather happens here: paged caches decode at per-row
+    ``lengths`` (token j of a speculative-verify chunk sits at
+    lengths[b]+j), ragged dense at ``row_pos``, plain dense batches
+    share the scalar ``pos``. ``s > 1`` is paged-only (the gate keeps
+    dense chunks on the discrete path)."""
     cos_a, sin_a = unwrap(cos), unwrap(sin)
     if "k_pages" in kv_cache:
-        idx = jnp.asarray(unwrap(kv_cache["lengths"]), jnp.int32)
+        base = jnp.asarray(unwrap(kv_cache["lengths"]), jnp.int32)
+        if s == 1:
+            idx = base
+        else:
+            idx = (base[:, None]
+                   + jnp.arange(s, dtype=jnp.int32)[None, :]).reshape(-1)
     elif "row_pos" in kv_cache:
         idx = jnp.asarray(unwrap(kv_cache["row_pos"]), jnp.int32)
     else:
         pos = jnp.asarray(unwrap(kv_cache["pos"]), jnp.int32)
         c = jax.lax.dynamic_slice_in_dim(cos_a, pos, 1, 0)
-        s = jax.lax.dynamic_slice_in_dim(sin_a, pos, 1, 0)
+        s_ = jax.lax.dynamic_slice_in_dim(sin_a, pos, 1, 0)
         return (jnp.broadcast_to(c, (b, c.shape[-1])),
-                jnp.broadcast_to(s, (b, s.shape[-1])))
+                jnp.broadcast_to(s_, (b, s_.shape[-1])))
     return cos_a[idx], sin_a[idx]
 
 
 def fused_decode_supported(layer, hidden_states, kv_cache, cos) -> bool:
-    """Trace-time gate for the fused S=1 decode tail
-    (FLAGS_use_fused_decode_tail): a dict decode cache at S=1 with the
-    plain attention structure the megakernels assume — no qk-norm, no q
+    """Trace-time gate for the fused decode tail
+    (FLAGS_use_fused_decode_tail): a dict decode cache with the plain
+    attention structure the megakernels assume — no qk-norm, no q
     pre-multiplier, no projection bias, no tensor parallelism,
     dtype-uniform weights, full-width rotary — plus decode_tail's own
-    VMEM-feasibility gate. Anything else keeps the discrete reference
-    kernels (exact parity by construction)."""
+    VMEM-feasibility gate. S=1 is the classic decode step; an S>1
+    PAGED chunk (the engine's speculative verify) also qualifies — it
+    flattens to B*S independent rows with per-row rope positions.
+    Anything else keeps the discrete reference kernels (exact parity by
+    construction)."""
     from ..ops.pallas import decode_tail
 
     if not decode_tail.enabled() or not isinstance(kv_cache, dict):
         return False
-    if hidden_states.shape[1] != 1:
+    if hidden_states.shape[1] != 1 and "k_pages" not in kv_cache:
         return False
     attn = layer.self_attn
     if not isinstance(attn, LlamaAttention):
@@ -791,8 +803,8 @@ def fused_decode_supported(layer, hidden_states, kv_cache, cos) -> bool:
            or unwrap(n.weight).dtype != x.dtype for n in norms):
         return False
     return decode_tail.supported(
-        x.shape[0], attn.hidden_size, attn.num_heads, attn.num_kv_heads,
-        attn.head_dim, unwrap(cos).shape[-1],
+        x.shape[0] * x.shape[1], attn.hidden_size, attn.num_heads,
+        attn.num_kv_heads, attn.head_dim, unwrap(cos).shape[-1],
         jnp.dtype(x.dtype).itemsize)
 
 
@@ -805,17 +817,19 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = LlamaRMSNorm(config)
 
     def _forward_fused_decode(self, hidden_states, cos, sin, kv_cache):
-        """The S=1 serving tail as two megakernel dispatches around the
-        attention kernel (ops/pallas/decode_tail): norm→qkv→rope fused,
-        then o_proj→residual-add→norm fused — per-token activations stay
-        in VMEM instead of 4-6 HBM round trips per layer. Token-identical
-        to the discrete path (tier-1 parity test)."""
+        """The serving decode tail as two megakernel dispatches around
+        the attention kernel (ops/pallas/decode_tail): norm→qkv→rope
+        fused, then o_proj→residual-add→norm fused — per-token
+        activations stay in VMEM instead of 4-6 HBM round trips per
+        layer. An S>1 speculative-verify chunk rides the SAME kernels as
+        B*S flattened rows. Token-identical to the discrete path (tier-1
+        parity test)."""
         from ..ops.pallas import decode_tail
 
         attn = self.self_attn
-        b = hidden_states.shape[0]
+        b, s = hidden_states.shape[0], hidden_states.shape[1]
         decode_tail.announce(
-            "paged" if "k_pages" in kv_cache else "dense", b,
+            "paged" if "k_pages" in kv_cache else "dense", b * s,
             attn.hidden_size, attn.num_heads, attn.num_kv_heads,
             attn.head_dim)
         q, k, v = attn.decode_fused_qkv(
@@ -828,12 +842,12 @@ class LlamaDecoderLayer(Layer):
             "fused_decode_epilogue",
             lambda a, wo, r, w: decode_tail.fused_epilogue(a, wo, r, w,
                                                            eps),
-            out_flat.reshape([b, attn.num_heads * attn.head_dim]),
+            out_flat.reshape([b * s, attn.num_heads * attn.head_dim]),
             attn.o_proj.weight,
-            hidden_states.reshape([b, attn.hidden_size]),
+            hidden_states.reshape([b * s, attn.hidden_size]),
             self.post_attention_layernorm.effective_weight())
-        hidden_states = residual.reshape([b, 1, attn.hidden_size]) + \
-            self.mlp(normed.reshape([b, 1, attn.hidden_size]))
+        hidden_states = residual.reshape([b, s, attn.hidden_size]) + \
+            self.mlp(normed.reshape([b, s, attn.hidden_size]))
         return hidden_states, new_cache
 
     def forward(self, hidden_states, cos, sin, attention_mask=None, kv_cache=None):

@@ -74,6 +74,15 @@ SERVING_PREFIX_PAGES = _R.counter(
     "KV pages copied from an active slot instead of recomputed",
     labels=("engine",))
 
+SERVING_SPEC_ACCEPTED = _R.histogram(
+    "serving_spec_accepted_tokens",
+    "Draft tokens the target accepted per speculative verify, observed "
+    "once per slot per verify dispatch (engine=decoder: the continuous-"
+    "batching engine's n-gram drafter; engine=solo: speculative_generate; "
+    "engine=mtp: the MTP self-draft — there each observation is the 0/1 "
+    "hit of its single-draft round)",
+    labels=("engine",))
+
 SERVING_SCHED = _R.counter(
     "serving_sched_decisions_total",
     "Scheduler decisions on the serving hot loop "

@@ -181,6 +181,24 @@ EV_SCHED_SHED = _register(
     "and answer HTTP 504; capacity sheds displace the least-important "
     "queued work when a strictly more important request arrives at a "
     "full bounded queue (the victim answers 429)")
+EV_SPEC_PROPOSE = _register(
+    "sched.spec_propose",
+    "the engine's host drafter proposed speculative tokens for one "
+    "multi-token decode dispatch (engine, active, k, drafted) — drafted "
+    "counts n-gram-lookup tokens actually proposed across slots; slots "
+    "with no history match ride the dispatch with padding")
+EV_SPEC_VERIFY = _register(
+    "sched.spec_verify",
+    "one batched speculative verify dispatch scored every active slot's "
+    "proposal chunk (engine, active, k, seconds) — 1 event per dispatch "
+    "like engine.step, not per token")
+EV_SPEC_ACCEPT = _register(
+    "sched.spec_accept",
+    "acceptance outcome of one speculative verify dispatch (engine, "
+    "accepted, emitted, rate) — accepted counts draft tokens that "
+    "matched the target's greedy choice, emitted the tokens retired "
+    "(accepted + one verified token per slot), rate = accepted / "
+    "proposed")
 EV_SCHED_MIGRATE_OUT = _register(
     "sched.migrate_out",
     "a live slot was exported for migration: KV pages + last-logit row "

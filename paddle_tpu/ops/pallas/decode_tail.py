@@ -108,11 +108,40 @@ def _epilogue_cost(params: dict, choice: tuple) -> dict:
     }
 
 
+def _spec_verify_cost(params: dict, choice: tuple) -> dict:
+    """Whole-dispatch cost of one speculative verify at chunk width k
+    (the engine's multi-token decode step): the weight stream is read
+    ONCE per dispatch regardless of k — exactly why wider chunks raise
+    arithmetic intensity on the HBM-bound decode tail — while FLOPs and
+    activation traffic scale with b*k. Registered so the engine's
+    spec-k autotune sweep prunes/ranks like any kernel geometry and the
+    graph-cost-table lint can replay persisted entries."""
+    (k,) = choice
+    b = int(params["batch"])
+    hidden = int(params["hidden"])
+    layers = int(params["layers"])
+    inter = int(params["intermediate"])
+    wtot = int(params["wtot"])          # (H + 2*hk) * head_dim per layer
+    vocab = int(params["vocab"])
+    it = jnp.dtype(params["dtype"]).itemsize
+    # weights: qkv + o_proj + 3 MLP mats per layer + the lm head
+    w_elems = layers * (hidden * wtot + hidden * hidden
+                        + 3 * hidden * inter) + hidden * vocab
+    act_elems = b * k * (layers * (4 * hidden + 2 * inter) + vocab)
+    return {
+        "bytes": (w_elems + act_elems) * it,
+        "flops": 2 * b * k * w_elems,
+        "vmem_bytes": 0,                 # XLA-scheduled; never infeasible
+        "grid": 0,
+    }
+
+
 def _register_cost_models():
     from . import autotune
 
     autotune.register_cost_model("fused_qkv_rope", _qkv_cost)
     autotune.register_cost_model("fused_epilogue", _epilogue_cost)
+    autotune.register_cost_model("spec_verify", _spec_verify_cost)
 
 
 _register_cost_models()

@@ -34,7 +34,8 @@ from .observability import tracing as _tracing
 from .tensor_class import Tensor, unwrap
 from .framework import random as _random
 from .generation import (_get_prefill_step, _get_select_decode,
-                         _get_select_decode_rows, _memoized_step)
+                         _get_select_decode_rows, _get_spec_decode,
+                         _memoized_step)
 
 
 #: default priority class — lower value is MORE important. 0 is the
@@ -184,7 +185,7 @@ class _Request:
                  "encoder_input", "seed_ids", "t_enqueue", "t_admit",
                  "t_last", "span", "queue_span", "handoff",
                  "priority", "deadline", "resume", "n_preempted",
-                 "on_shed")
+                 "on_shed", "spec_rounds", "spec_accepted")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
                  on_token=None, pixel_values=None, stop_token_ids=None,
@@ -226,6 +227,11 @@ class _Request:
                          if slo_ms is not None else math.inf)
         self.resume = None          # host-side KV bundle after a preemption
         self.n_preempted = 0
+        # speculative-decode observability: verify rounds this request
+        # rode and draft tokens the target accepted for it (the span
+        # attributes _trace_end stamps at retirement)
+        self.spec_rounds = 0
+        self.spec_accepted = 0
         # shed notification: the front-end's hook for learning that a
         # QUEUED request was dropped (deadline expired / displaced by a
         # more important arrival) — without it an HTTP submission would
@@ -284,6 +290,13 @@ class _RequestBookkeeping:
     # (seq2seq has no deadline surface at all)
     _n_shed = 0
     _n_deadline_misses = 0
+
+    # speculative-decode counters: class defaults so stats() works on
+    # engines that never speculate (seq2seq, spec-off decoder engines)
+    _n_spec_steps = 0        # multi-token verify dispatches
+    _n_spec_emitted = 0      # tokens retired by spec dispatches
+    _n_spec_accepted = 0     # draft tokens the target accepted
+    _n_spec_slot_rounds = 0  # (active slot, spec dispatch) pairs
 
     def _init_bookkeeping(self, engine: str):
         """One init for queue/finish state, lifetime counters, and the
@@ -398,6 +411,16 @@ class _RequestBookkeeping:
             "slot_utilization": (active / self.max_batch
                                  if self.max_batch else 0.0),
             "prefix_pages_reused": self.prefix_pages_reused,
+            # speculative decode: tokens retired per slot per dispatch is
+            # THE speculation health number (1.0 = no speedup; the n-gram
+            # drafter earns its keep above it). All zeros when spec is
+            # off — the keys stay stable for dashboards either way.
+            "spec_dispatches": self._n_spec_steps,
+            "spec_emitted_tokens": self._n_spec_emitted,
+            "spec_accepted_tokens": self._n_spec_accepted,
+            "accepted_tokens_per_dispatch": (
+                self._n_spec_emitted / self._n_spec_slot_rounds
+                if self._n_spec_slot_rounds else 0.0),
         }
 
     def debug_state(self) -> dict:
@@ -541,6 +564,12 @@ class _RequestBookkeeping:
                 _tracing.SPAN_SLOT_FREE, now, now, parent=span,
                 attrs={"slot": req.slot})
         span.set_attr("generated_tokens", len(req.tokens))
+        if req.spec_rounds:
+            # speculative-decode health, per request: how many verify
+            # rounds it rode and how many draft tokens landed — the
+            # trace-side view of the acceptance histogram
+            span.set_attr("spec_rounds", req.spec_rounds)
+            span.set_attr("spec_accepted_tokens", req.spec_accepted)
         span.end(status)
 
     def finish_reason(self, rid: int):
@@ -638,6 +667,100 @@ class _ChunkState:
         self.span = span      # the serving.prefill span, open across chunks
 
 
+def _resolve_spec_k(model, max_batch: int, max_len: int,
+                    page_size: int = 16, default: int = 4,
+                    acceptance: float = 0.7) -> int:
+    """Pick the speculation chunk width ``k`` for THIS device from the
+    autotune cost table: the verify geometry is registered with
+    ``autotune.search()`` (kernel "spec_verify" — candidates are chunk
+    widths, the runner times one batched verify dispatch on throwaway
+    buffers, the registered analytical cost model prunes and ranks), and
+    the measured table is then re-ranked by EXPECTED retired tokens per
+    dispatch under a geometric acceptance model (``sum p^i`` — measured
+    acceptance is what makes wider chunks pay), because raw dispatch
+    latency alone always favors the narrowest chunk. Off-TPU or with
+    FLAGS_use_autotune off this returns ``default`` without touching the
+    device; a previously persisted table re-ranks without re-measuring."""
+    from .ops.pallas import autotune
+
+    if not autotune.enabled():
+        # the reference's switch semantics: flag off = heuristic only,
+        # even when a persisted table exists
+        return default
+    cfg = model.config
+    try:
+        from .models.llama import head_dim_of
+
+        hd = head_dim_of(cfg)
+        h, hk = cfg.num_attention_heads, cfg.num_key_value_heads
+        params = {
+            "batch": int(max_batch), "hidden": int(cfg.hidden_size),
+            "layers": int(cfg.num_hidden_layers),
+            "intermediate": int(cfg.intermediate_size),
+            "wtot": int((h + 2 * hk) * hd),
+            "vocab": int(cfg.vocab_size),
+            "dtype": str(cfg.dtype),
+        }
+    except (AttributeError, TypeError):
+        return default  # non-llama-shaped config: the heuristic default
+    sig = " ".join(f"{k_}{v}" for k_, v in sorted(params.items()))
+    cands = [(c,) for c in (2, 3, 4, 6, 8) if c <= max_len]
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:  # pdlint: disable=silent-exception -- backend probe: no initialised backend means 'not on TPU', the designed measure-nothing fallback
+        on_tpu = False
+    can = on_tpu and max_len % page_size == 0
+
+    def runner(choice):
+        (kk,) = choice
+        step = _get_spec_decode(model, max_len, kk)
+        dt = (jnp.dtype(cfg.dtype) if isinstance(cfg.dtype, str)
+              else cfg.dtype)
+
+        def run():
+            # throwaway pool per call: the verify step DONATES its cache
+            # buffers, so a timed repetition can never reuse them
+            from .models.llama import head_dim_of as _hd
+
+            d = _hd(cfg)
+            pps = max_len // page_size
+            n_pages = max_batch * pps
+            caches = [{
+                "k_pages": jnp.zeros(
+                    (cfg.num_key_value_heads, n_pages, page_size, d), dt),
+                "v_pages": jnp.zeros(
+                    (cfg.num_key_value_heads, n_pages, page_size, d), dt),
+                "page_indices": jnp.arange(
+                    n_pages, dtype=jnp.int32).reshape(max_batch, pps),
+                "lengths": jnp.zeros((max_batch,), jnp.int32),
+                "page_size": page_size,
+            } for _ in range(cfg.num_hidden_layers)]
+            last = jnp.zeros((max_batch, cfg.vocab_size), jnp.float32)
+            drafts = jnp.zeros((max_batch, max(kk - 1, 0)), jnp.int32)
+            return step(last, drafts, caches)[0]
+
+        return run
+
+    choice = autotune.search(
+        "spec_verify", sig, (default,), cands, runner, can,
+        params=params,
+        cost_model=lambda c: autotune.analytical_cost(
+            "spec_verify", params, c))
+    ent = autotune.get_cache().entry(
+        "spec_verify", autotune.full_key(sig)) or {}
+    table = ent.get("table") or {}
+    best_k, best_score = int(choice[0]), None  # pdlint: disable=host-sync -- autotune.search returns a host tuple from the cost table, never a device value; engine construction is off the decode loop anyway
+    for (kk,) in cands:
+        row = table.get(str(kk))
+        if not row or row.get("status") != "ok":
+            continue
+        expect = sum(acceptance ** i for i in range(kk))
+        score = row["ms"] / expect
+        if best_score is None or score < best_score:
+            best_k, best_score = kk, score
+    return best_k
+
+
 class ContinuousBatchEngine(_RequestBookkeeping):
     """In-flight batching: add_request() any time, step() decodes one token
     for every active slot, finished requests free their slot immediately.
@@ -705,9 +828,39 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                  prefill_chunk_tokens: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  enable_preemption: bool = False,
-                 aging_s: float = 5.0):
+                 aging_s: float = 5.0,
+                 speculative_k=None,
+                 speculative_ngram: int = 3):
         if max_len % page_size != 0:
             raise ValueError("max_len must be a multiple of page_size")
+        # ---- speculative decoding (multi-token steps) -------------------
+        # speculative_k = chunk width per decode dispatch: 1 verified
+        # token + up to k-1 n-gram-drafted tokens per slot per step.
+        # None/0 = off (the classic one-token step, bit-identical to
+        # before); "auto" = let the autotune cost table pick k for this
+        # device (see _resolve_spec_k). Greedy-only: dispatches with a
+        # sampling slot active fall back to the one-token step.
+        if speculative_k == "auto":
+            speculative_k = _resolve_spec_k(model, max_batch, max_len,
+                                            page_size=page_size)
+        if speculative_k is not None:
+            speculative_k = int(speculative_k)
+            if speculative_k < 1:
+                raise ValueError(
+                    f"speculative_k must be >= 1 (or 'auto'), got "
+                    f"{speculative_k}")
+            if speculative_k > max_len:
+                raise ValueError(
+                    f"speculative_k {speculative_k} exceeds max_len "
+                    f"{max_len}")
+            if getattr(model.llama, "empty_cache_layer", None) is not None:
+                raise NotImplementedError(
+                    "engine speculative decoding needs the paged KV "
+                    "layout — the latent (MLA) compressed rows have no "
+                    "multi-token ragged append path (use "
+                    "mtp_speculative_generate for MLA self-drafting)")
+        self.speculative_k = speculative_k or None
+        self.speculative_ngram = int(speculative_ngram)
         if prefill_chunk_tokens is not None:
             prefill_chunk_tokens = int(prefill_chunk_tokens)
             if (prefill_chunk_tokens <= 0
@@ -788,6 +941,10 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             d: _metrics.SERVING_SCHED.labels(engine="decoder", decision=d)
             for d in ("chunk", "preempt", "restore", "migrate_out",
                       "migrate_in")}
+        # acceptance histogram child bound once (no per-dispatch label
+        # lookups on the decode hot path), like every engine metric
+        self._m_spec_accept = _metrics.SERVING_SPEC_ACCEPTED.labels(
+            engine="decoder")
 
         # ---- automatic prefix caching (vLLM-style, opt-in) --------------
         # At admission, the longest page-aligned token prefix shared with a
@@ -803,6 +960,20 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             engine="decoder", result="miss")
         self._m_prefix_pages = _metrics.SERVING_PREFIX_PAGES.labels(
             engine="decoder")
+
+    def _require_fit(self, n_prompt: int, max_new: int):
+        """Slot-capacity admission check. With speculation on, every
+        decode dispatch writes a k-token chunk starting at the row's
+        frontier, so the LAST dispatch (frontier at prompt+new-1) still
+        needs k-1 slack positions for rejected-draft KV — without the
+        slack the chunk scatter would clamp onto the slot's last valid
+        page and corrupt it."""
+        slack = (self.speculative_k - 1) if self.speculative_k else 0
+        if n_prompt + max_new + slack > self.max_len:
+            extra = f" + speculation slack ({slack})" if slack else ""
+            raise ValueError(
+                f"prompt ({n_prompt}) + max_new_tokens ({max_new})"
+                f"{extra} exceeds engine max_len {self.max_len}")
 
     # ---- public API ---------------------------------------------------------
     def add_request(self, ids, max_new_tokens: int = 64, do_sample=None,
@@ -854,10 +1025,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                                    miss_ms=-float(slo_ms))
         self._check_queue_bound(priority=eff_priority)
         ids = np.asarray(unwrap(ids) if isinstance(ids, Tensor) else ids).reshape(-1)
-        if ids.size + max_new_tokens > self.max_len:
-            raise ValueError(
-                f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds engine max_len {self.max_len}")
+        self._require_fit(int(ids.size), int(max_new_tokens))
         if temperature is not None and temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature} "
                              "(0 decodes greedily)")
@@ -1123,10 +1291,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 f"handoff carries {len(handoff['layers'])} layers, engine "
                 f"has {len(self._caches)} — different models?")
         ids = np.asarray(handoff["ids"]).reshape(-1)
-        if ids.size + max_new_tokens > self.max_len:
-            raise ValueError(
-                f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds engine max_len {self.max_len}")
+        self._require_fit(int(ids.size), int(max_new_tokens))
         if temperature is not None and temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature} "
                              "(0 decodes greedily)")
@@ -1279,10 +1444,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 f"migration bundle is inconsistent: kv_len {kv_len} != "
                 f"prompt {ids.size} + generated {len(tokens)}")
         max_new = int(handoff["max_new_tokens"])
-        if ids.size + max_new > self.max_len:
-            raise ValueError(
-                f"prompt ({ids.size}) + max_new_tokens ({max_new}) "
-                f"exceeds engine max_len {self.max_len}")
+        self._require_fit(int(ids.size), max_new)
         samp = handoff.get("sampling")
         sampling = self._merge_sampling(*samp) if samp else None
         slo_rem = handoff.get("slo_remaining_s")
@@ -1341,6 +1503,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._advance_chunk()
         if self.num_active == 0:
             return self._drain_finished()
+        if self.speculative_k is not None and self._spec_eligible():
+            return self._step_speculative()
         t_dispatch = time.perf_counter()
         do_sample, temperature, top_k, top_p = self._sample_cfg
         for c in self._caches:
@@ -1447,6 +1611,189 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             self._trace_end(req, "ok")
         # stream AFTER state is consistent: every callback fires even if an
         # earlier one raises; the first exception then propagates
+        first_exc = None
+        for cb, arity, rid, t, lp, done in events:
+            try:
+                if arity >= 4:
+                    cb(rid, t, done, lp)
+                else:
+                    cb(rid, t, done)
+            except BaseException as e:  # noqa: BLE001  # pdlint: disable=silent-exception -- collected, first one re-raised below
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
+        self._admit()
+        return self._drain_finished()
+
+    # ---- speculative decoding: multi-token steps ------------------------
+    def _spec_eligible(self) -> bool:
+        """Speculation verifies against the GREEDY choice, so it is exact
+        only while every active slot decodes greedily (engine default or
+        per-request override; temperature ~ 0 counts as greedy exactly
+        like sample_logits). A dispatch with any sampling slot active
+        falls back to the one-token step — the engine re-enters
+        speculation as soon as the sampling requests retire."""
+        for r in self._slots:
+            if r is None:
+                continue
+            do_sample, temperature, _, _ = r.sampling or self._sample_cfg
+            if do_sample and temperature > 1e-6:
+                return False
+        return True
+
+    def _step_speculative(self) -> Dict[int, np.ndarray]:
+        """One MULTI-token decode step: the host n-gram drafter proposes
+        up to k-1 tokens per active slot from the slot's own prompt+token
+        history, ONE batched verify dispatch (generation._SpecDecodeStep)
+        forwards every slot's chunk [greedy, d_1..d_{k-1}] at per-row
+        paged positions, and accepted runs advance each slot by a
+        VARIABLE amount — rejected-draft KV parks above the new frontier
+        exactly like chunked prefill's throwaway writes, where the next
+        chunk's scatter overwrites it before lengths can reach it.
+        Token-identity to the one-token greedy step is by construction:
+        every emitted token equals the target's greedy choice at its
+        position (and carries the same raw-distribution logprob)."""
+        k = self.speculative_k
+        t_dispatch = time.perf_counter()
+        for c in self._caches:
+            c["lengths"] = self._lengths  # engine-owned (masks stale +1s)
+        # host drafter: pure bookkeeping-side work between dispatches —
+        # padding rides the dispatch for slots with no history match and
+        # can only be "accepted" when it equals the true greedy token
+        from .speculative import ngram_propose
+
+        drafts = np.zeros((self.max_batch, k - 1), np.int32)
+        n_drafted = 0
+        if k > 1:
+            for s, r in enumerate(self._slots):
+                if r is None:
+                    continue
+                hist = np.concatenate(
+                    [r.ids, np.asarray(r.tokens, np.int64)]) \
+                    if r.tokens else r.ids
+                # the lookup's FIRST token predicts the same position the
+                # in-dispatch argmax (g0) already decides, so the drafts
+                # that ride the chunk are its CONTINUATION c_1..c_{k-1}
+                # — using c_0 as d_1 would shift every cyclic proposal
+                # off by one and reject whole runs the history predicted
+                prop = ngram_propose(hist, k, self.speculative_ngram)
+                if prop.size > 1:
+                    use = prop[1:]
+                    drafts[s, :use.size] = use
+                    n_drafted += int(use.size)
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_SPEC_PROPOSE, engine=self._engine_label,
+                       active=self.num_active, k=k, drafted=n_drafted)
+        step = _get_spec_decode(self.model, self.max_len, k)
+        emitted, n_emit, logps, self._last, self._caches = step(
+            self._last, jnp.asarray(drafts), self._caches)
+        # THE deliberate device->host sync of the speculative decode
+        # loop: one dispatch produced all three arrays, the first
+        # conversion blocks, the other two read already-fetched results
+        toks = np.asarray(emitted)   # pdlint: disable=host-sync -- the step's one deliberate token fetch (host retirement needs the ints)
+        n_row = np.asarray(n_emit)   # pdlint: disable=host-sync -- same dispatch as toks; variable per-slot advance drives host bookkeeping
+        lps = np.asarray(logps)      # pdlint: disable=host-sync -- same dispatch as toks; the OpenAI logprobs field
+        now = time.perf_counter()
+        self._m_step.observe(now - t_dispatch)
+        self._n_steps += 1
+        self._n_spec_steps += 1
+        if rec.enabled:
+            rec.record(_frec.EV_STEP, engine=self._engine_label,
+                       active=self.num_active, seconds=now - t_dispatch)
+            rec.record(_frec.EV_SPEC_VERIFY, engine=self._engine_label,
+                       active=self.num_active, k=k,
+                       seconds=now - t_dispatch)
+        trace_on = _tracing.get_tracer().enabled
+        t0_ns, t1_ns = (int(t_dispatch * 1e9), int(now * 1e9)) \
+            if trace_on else (0, 0)
+        retiring = []
+        events = []
+        adv = np.zeros(self.max_batch, np.int64)
+        accepted_total = emitted_total = slot_rounds = 0
+        for s, req in enumerate(self._slots):
+            if req is None:
+                continue
+            n = int(n_row[s])
+            slot_rounds += 1
+            # deliver the accepted run, truncated at the request's stop
+            # condition (eos / stop set / budget) — tokens past a stop
+            # were never part of the greedy stream the client sees
+            deliver = []
+            stopped = False
+            for j in range(n):
+                t = int(toks[s, j])
+                deliver.append(t)
+                if ((self.eos_token_id is not None
+                     and t == self.eos_token_id)
+                        or (req.stop_token_ids is not None
+                            and t in req.stop_token_ids)):
+                    stopped = True
+                    break
+                if len(req.tokens) + len(deliver) >= req.max_new_tokens:
+                    break
+            for j, t in enumerate(deliver):
+                req.tokens.append(t)
+                if req.want_logprobs:
+                    req.logprobs.append(float(lps[s, j]))
+                self._observe_token(req, now)
+            req.spec_rounds += 1
+            req.spec_accepted += len(deliver) - 1
+            accepted_total += len(deliver) - 1
+            emitted_total += len(deliver)
+            self._m_spec_accept.observe(len(deliver) - 1)
+            if trace_on:
+                self._trace_decode_step(req, t0_ns, t1_ns)
+            finished = stopped or len(req.tokens) >= req.max_new_tokens
+            if finished:
+                self._record_reason(
+                    req.rid, "stop" if stopped else "length",
+                    logprobs=(list(req.logprobs) if req.want_logprobs
+                              else None))
+                retiring.append(s)
+            else:
+                adv[s] = len(deliver)   # == n: truncation always retires
+            if req.on_token is not None:
+                for j, t in enumerate(deliver):
+                    done = finished and j == len(deliver) - 1
+                    events.append((req.on_token, req.on_token_arity,
+                                   req.rid, t, float(lps[s, j]), done))
+        self._n_spec_emitted += emitted_total
+        self._n_spec_accepted += accepted_total
+        self._n_spec_slot_rounds += slot_rounds
+        if rec.enabled:
+            proposed = max(slot_rounds * (k - 1), 1)
+            rec.record(_frec.EV_SPEC_ACCEPT, engine=self._engine_label,
+                       accepted=accepted_total, emitted=emitted_total,
+                       rate=accepted_total / proposed)
+        # variable per-slot advance; reserved (mid-chunk) slots HOLD at
+        # their frontier exactly as in the one-token step — the k
+        # throwaway tokens the fixed-shape dispatch wrote for them park
+        # where the next chunk's scatter lands
+        active = np.array([r is not None for r in self._slots])
+        adv_j = jnp.asarray(adv, jnp.int32)
+        if self._chunking:
+            hold = np.zeros(self.max_batch, bool)
+            for s in self._chunking:
+                hold[s] = True
+            self._lengths = jnp.where(
+                jnp.asarray(active), self._lengths + adv_j,
+                jnp.where(jnp.asarray(hold), self._lengths,
+                          jnp.zeros_like(self._lengths)))
+        else:
+            self._lengths = jnp.where(jnp.asarray(active),
+                                      self._lengths + adv_j,
+                                      jnp.zeros_like(self._lengths))
+        for s in retiring:
+            req = self._slots[s]
+            self._finished[req.rid] = np.asarray(req.tokens, np.int64)
+            self._n_finished += 1
+            self._m_req_finished.inc()
+            self._slots[s] = None
+            self._lengths = self._lengths.at[s].set(0)
+            self._trace_end(req, "ok")
+        # stream AFTER state is consistent (same protocol as step())
         first_exc = None
         for cb, arity, rid, t, lp, done in events:
             try:

@@ -32,6 +32,60 @@ from .nn.layer import functional_weights as _functional_weights
 from .tensor_class import unwrap, wrap
 
 
+def _spec_accept_hist(engine: str):
+    """The shared acceptance histogram (serving_spec_accepted_tokens):
+    every speculative path — solo, MTP self-draft, and the serving
+    engine — publishes accepted-draft counts through the SAME registry
+    family, so acceptance health reads off one /metrics series instead
+    of caller-only stats dicts."""
+    from .observability import catalog as _metrics
+
+    return _metrics.SERVING_SPEC_ACCEPTED.labels(engine=engine)
+
+
+def _ngram_next(hist: np.ndarray, max_ngram: int):
+    """One prompt-lookup step: the token that followed the MOST RECENT
+    earlier occurrence of ``hist``'s trailing n-gram (n = ``max_ngram``
+    down to 1), or None when nothing repeats."""
+    L = int(hist.size)
+    if L < 2:
+        return None
+    for n in range(min(int(max_ngram), L - 1), 0, -1):
+        pat = hist[L - n:]
+        # windows starting before the trailing n-gram itself: a match at
+        # start s < L - n guarantees a continuation token exists
+        view = np.lib.stride_tricks.sliding_window_view(hist, n)
+        hits = np.nonzero((view[: L - n] == pat).all(axis=1))[0]
+        if hits.size:
+            return int(hist[int(hits[-1]) + n])  # most recent wins
+    return None
+
+
+def ngram_propose(history, k: int, max_ngram: int = 3) -> np.ndarray:
+    """Prompt-lookup draft proposal (n-gram drafter — no second model):
+    ITERATED single-token lookups — each proposed token is appended to a
+    working copy of the history before the next lookup, so the proposal
+    is the drafter's own autoregressive continuation (a periodic stream
+    extends past the raw history's end instead of truncating at it).
+    ``c[0]`` predicts the NEXT position, ``c[j]`` the one j after it.
+    Returns an int32 array of length <= k (empty when the history is too
+    short or nothing repeats — the caller pads; padding can only be
+    "accepted" when it coincidentally equals the target's greedy choice,
+    so junk proposals never change output, only acceptance rate).
+
+    Pure host work on the request's token history — the drafter runs
+    between engine dispatches and never touches the device."""
+    work = np.asarray(history).reshape(-1)
+    out = []
+    for _ in range(int(k)):
+        nxt = _ngram_next(work, max_ngram)
+        if nxt is None:
+            break
+        out.append(nxt)
+        work = np.append(work, nxt)
+    return np.asarray(out, np.int32)
+
+
 class _ProposeStep:
     """Draft proposal: feed ``seed`` (1 or 2 catch-up tokens), then scan
     ``k-1`` greedy single-token steps — one jitted dispatch for all ``k``
@@ -144,12 +198,20 @@ def _finish(emitted, max_new_tokens, eos_token_id, out_dtype):
 
 
 def speculative_generate(target, draft, input_ids, max_new_tokens=20,
-                         draft_k=4, eos_token_id=None):
+                         draft_k=4, eos_token_id=None, return_stats=False):
     """Greedy speculative decode of ``input_ids`` [1, P] → [1, P + new].
 
     Batch size 1 (per-request serving): the dense cache keeps ONE scalar
     write position, and rows accepting different prefix lengths would need
     per-row rollback. Output is exactly ``target.generate`` greedy.
+
+    ``return_stats=True`` returns ``(out, stats)`` with the same contract
+    as :func:`mtp_speculative_generate`: ``rounds`` (verify dispatches),
+    ``hits`` (draft tokens the target accepted), ``acceptance`` (hits /
+    (rounds * draft_k) — the fraction of proposed tokens that landed).
+    Acceptance is ALSO published per round through the metrics registry
+    (``serving_spec_accepted_tokens``, engine="solo") whether or not the
+    caller asks for stats.
     """
     ids, out_dtype = _normalize_request(input_ids)
     B, P = ids.shape
@@ -170,9 +232,11 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=20,
     _, dft_caches = _prefill(draft, ids, max_len)
     tgt_pos, dft_pos = P, P
 
-    emitted = [int(t0[0])]
-    last = int(t0[0])
+    emitted = [int(t0[0])]  # pdlint: disable=host-sync -- the prefill's one deliberate first-token fetch
+    last = emitted[0]
     catchup = []  # accepted tokens not yet written to the draft cache
+    rounds = hits = 0       # draft-acceptance observability
+    accept_hist = _spec_accept_hist("solo")
 
     def propose_step(seed_len):
         return _memoized_step(
@@ -188,17 +252,20 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=20,
         seed = jnp.asarray([catchup + [last]], jnp.int32)   # [1, 1|2]
         dft_caches = _set_pos(dft_caches, dft_pos)
         proposals, dft_caches = propose_step(seed.shape[1])(seed, dft_caches)
-        props = [int(x) for x in np.asarray(proposals[0])]   # d_1..d_k
+        props = [int(x) for x in np.asarray(proposals[0])]   # d_1..d_k  # pdlint: disable=host-sync -- the round's deliberate draft fetch (host builds the verify chunk from it)
 
         chunk = jnp.asarray([[last] + props], jnp.int32)     # [1, k+1]
         tgt_caches = _set_pos(tgt_caches, tgt_pos)
         greedy, tgt_caches = verify_step(chunk, tgt_caches)
-        g = [int(x) for x in np.asarray(greedy[0])]          # g_0..g_k
+        g = [int(x) for x in np.asarray(greedy[0])]          # g_0..g_k  # pdlint: disable=host-sync -- the round's deliberate verify fetch (acceptance is host control flow)
 
         m = 0
         while m < k and props[m] == g[m]:
             m += 1
         accepted = props[:m] + [g[m]]                        # ≤ k+1 tokens
+        rounds += 1
+        hits += m
+        accept_hist.observe(m)
 
         # context now ends ...last, d_1..d_m, g_m; g_m is the new `last`
         ctx_len_old = tgt_pos + 1        # context length BEFORE this round
@@ -215,7 +282,60 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=20,
             break
 
     # same convention as model.generate: only the NEW tokens, input dtype
-    return _finish(emitted, max_new_tokens, eos_token_id, out_dtype)
+    out = _finish(emitted, max_new_tokens, eos_token_id, out_dtype)
+    if return_stats:
+        return out, {"rounds": rounds, "hits": hits,
+                     "acceptance": (hits / (rounds * k)) if rounds else 0.0}
+    return out
+
+
+class _MTPRoundStep:
+    """One MTP self-speculative round as ONE jitted dispatch (the
+    mtp_speculative_generate docstring's promised follow-up off the eager
+    host loop): extend the MTP latent stream with the previous round's
+    completed (hidden, token) pairs and draft one token, then run the
+    2-token cached verify [pending, draft] on the main model — draft,
+    verify, and both cache updates in a single device program with the
+    big cache buffers donated. Keyed on ``n_pairs`` (1 after a miss, 2
+    after a hit — the only two carry shapes), memoized per model via
+    _memoized_step exactly like the propose/verify steps above."""
+
+    def __init__(self, model, max_len, n_pairs):
+        self._model = model
+        mtp = model.mtp_layers[0]
+
+        def pure(state, h_tail, toks, bufs, aux, mbufs, maux):
+            caches = [{**b, **a} for b, a in zip(bufs, aux)]
+            mtp_cache = {**mbufs[0], **maux[0]}
+            with _functional_weights(model, state), _tape.no_grad():
+                cos, sin = model.llama._rope(max_len)
+                emb = model.llama.embed_tokens(wrap(toks)).astype(
+                    model.config.dtype)
+                x = mtp.fuse(wrap(h_tail), emb)
+                h_m, mtp_cache = mtp.block(x, cos, sin, kv_cache=mtp_cache)
+                draft = jnp.argmax(unwrap(model.lm_head_logits(
+                    mtp.norm(h_m[:, -1:])))[0, 0]).astype(jnp.int32)
+                verify = jnp.stack([toks[0, -1], draft])[None, :]  # [1, 2]
+                normed2, pre2, caches = model.llama.forward_cached(
+                    wrap(verify), caches, rope_len=max_len,
+                    return_prenorm=True)
+                logits2 = unwrap(model.lm_head_logits(normed2))
+            g0 = jnp.argmax(logits2[0, 0]).astype(jnp.int32)
+            g1 = jnp.argmax(logits2[0, 1]).astype(jnp.int32)
+            nb, na = _split_caches(_unwrap_caches(caches))
+            mb, ma = _split_caches(_unwrap_caches([mtp_cache]))
+            return jnp.stack([g0, g1, draft]), unwrap(pre2), nb, na, mb, ma
+
+        self._jitted = jax.jit(pure, donate_argnums=(3, 5))
+        self._state = dict(model.functional_state())
+
+    def __call__(self, h_tail, toks, caches, mtp_caches):
+        bufs, aux = _split_caches(_unwrap_caches(caches))
+        mb, ma = _split_caches(_unwrap_caches(mtp_caches))
+        g, pre2, nb, na, mb2, ma2 = self._jitted(
+            self._state, h_tail, toks, bufs, aux, mb, ma)
+        return (g, pre2, [{**b, **a} for b, a in zip(nb, na)],
+                [{**b, **a} for b, a in zip(mb2, ma2)])
 
 
 def mtp_speculative_generate(model, input_ids, max_new_tokens=20,
@@ -230,11 +350,12 @@ def mtp_speculative_generate(model, input_ids, max_new_tokens=20,
 
     Output is EXACTLY ``model.generate`` greedy — the draft only changes
     how many tokens each main-model forward retires. Batch 1 (the dense
-    cache keeps one write position; see speculative_generate). This v1
-    drives the rounds as a host loop of EAGER cached forwards — the
-    correctness contract and stream bookkeeping live here; porting the
-    rounds onto speculative_generate's memoized jitted steps is the
-    performance follow-up and changes no semantics."""
+    cache keeps one write position; see speculative_generate). Each round
+    is ONE jitted dispatch (:class:`_MTPRoundStep`, memoized via
+    _memoized_step and keyed on the 1- or 2-pair carry shape); rollback
+    after a miss is a host-side cache ``pos`` reset, like
+    speculative_generate's. Acceptance is published per round through the
+    metrics registry (``serving_spec_accepted_tokens``, engine="mtp")."""
     from .generation import _empty_caches
 
     mtp_layers = getattr(model, "mtp_layers", None)
@@ -254,6 +375,7 @@ def mtp_speculative_generate(model, input_ids, max_new_tokens=20,
     ids_j = jnp.asarray(ids, jnp.int32)
     dt = (jnp.dtype(model.config.dtype)
           if isinstance(model.config.dtype, str) else model.config.dtype)
+    accept_hist = _spec_accept_hist("mtp")
 
     def emb(tokens_2d):
         # .astype: same compute dtype the MTP block trained on
@@ -267,7 +389,7 @@ def mtp_speculative_generate(model, input_ids, max_new_tokens=20,
         caches = _empty_caches(model, 1, max_len)
         normed, pre, caches = model.llama.forward_cached(
             wrap(ids_j), caches, rope_len=max_len, return_prenorm=True)
-        t1 = int(jnp.argmax(
+        t1 = int(jnp.argmax(  # pdlint: disable=host-sync -- the prefill's one deliberate first-token fetch
             unwrap(model.lm_head_logits(normed[:, -1:]))[0, 0]))
 
         # MTP stream cache: seed with pairs (h_i, t_{i+1}) for the prompt
@@ -276,38 +398,42 @@ def mtp_speculative_generate(model, input_ids, max_new_tokens=20,
         if P > 1:
             x = mtp.fuse(pre[:, : P - 1], emb(ids[:, 1:]))
             _, mtp_cache = mtp.block(x, cos, sin, kv_cache=mtp_cache)
+        # rounds are jitted from here on: the static "prefill" marker
+        # must not enter the traced aux (bool(tracer) raises), and
+        # positions are tracked host-side and stamped before each call
+        mtp_cache.pop("prefill", None)
+        pos_main, pos_mtp = P, max(P - 1, 0)
 
         emitted = [t1]
         rounds = hits = 0          # draft-acceptance observability
-        pending = t1               # exact, not yet written to the cache
-        h_tail = pre[:, -1:]       # pre-norm hidden(s) pairing the toks
+        h_tail = unwrap(pre)[:, -1:]   # pre-norm hidden(s) pairing toks
         toks = [t1]                # tokens pairing h_tail rows
         while len(emitted) < max_new_tokens and (
                 eos_token_id is None or emitted[-1] != eos_token_id):
-            # 1. extend the MTP stream with the completed pairs, draft
-            x = mtp.fuse(h_tail, emb([toks]))
-            h_m, mtp_cache = mtp.block(x, cos, sin, kv_cache=mtp_cache)
-            draft = int(jnp.argmax(unwrap(
-                model.lm_head_logits(mtp.norm(h_m[:, -1:])))[0, 0]))
-            # 2. one 2-token verify forward retires up to 2 tokens
-            normed2, pre2, caches = model.llama.forward_cached(
-                wrap(jnp.asarray([[pending, draft]], jnp.int32)), caches,
-                rope_len=max_len, return_prenorm=True)
-            logits2 = unwrap(model.lm_head_logits(normed2))
-            g0 = int(jnp.argmax(logits2[0, 0]))
-            g1 = int(jnp.argmax(logits2[0, 1]))
+            n = len(toks)
+            step = _memoized_step(
+                model, "_mtp_round_steps", (max_len, n),
+                lambda: _MTPRoundStep(model, max_len, n), maxsize=8)
+            caches = _set_pos(caches, pos_main)
+            mtp_cache["pos"] = jnp.asarray(pos_mtp, jnp.int32)
+            g_arr, pre2, caches, mcs = step(
+                h_tail, jnp.asarray([toks], jnp.int32), caches,
+                [mtp_cache])
+            mtp_cache = mcs[0]
+            g = np.asarray(g_arr)  # pdlint: disable=host-sync -- the round's ONE deliberate fetch: [g0, g1, draft] drive host acceptance control flow
+            g0, g1, draft = int(g[0]), int(g[1]), int(g[2])
             rounds += 1
+            pos_mtp += n           # the MTP stream grew by the n pairs
             if draft == g0:        # draft hit: two tokens from one forward
                 hits += 1
                 emitted.extend([draft, g1])
-                pending = g1
+                pos_main += 2
                 h_tail, toks = pre2, [draft, g1]
-            else:                  # miss: rewind the draft's cache entry
-                emitted.append(g0)
-                pending = g0
-                for c in caches:
-                    c["pos"] = c["pos"] - 1
+            else:                  # miss: the draft's cache entry is
+                emitted.append(g0)  # stale — the host pos rewind parks it
+                pos_main += 1
                 h_tail, toks = pre2[:, :1], [g0]
+            accept_hist.observe(1 if draft == g0 else 0)
             if eos_token_id is not None and eos_token_id in emitted[-2:]:
                 break              # eos inside a hit pair stops the loop
 

@@ -1,0 +1,528 @@
+"""Engine-integrated speculative decoding (serving.py speculative_k):
+multi-token steps in the continuous-batching engine.
+
+THE correctness gate: engine speculative decode is TOKEN-IDENTICAL to
+greedy non-speculative decode — against the solo dense-path generate
+AND the one-token engine — across paged and prefix-hit layouts, with
+chunked prefill and a preempt→restore cycle interleaved, and a slot
+exported mid-speculation seals a consistent migration bundle. Plus the
+drafter edge cases (empty history, k=1 degenerate rounds, eos inside an
+accepted run) and the acceptance observability stack (stats keys,
+serving_spec_accepted_tokens, sched.spec_* events, span attributes).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import flightrecorder as frec
+from paddle_tpu.serving import ContinuousBatchEngine
+from paddle_tpu.speculative import ngram_propose
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+
+
+@pytest.fixture()
+def recorder():
+    rec = frec.get_recorder()
+    was = rec.enabled
+    rec.enable()
+    yield rec
+    if not was:
+        rec.disable()
+
+
+def _solo(model, prompt, new):
+    return model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=new).numpy()[0]
+
+
+#: a prompt the n-gram drafter can actually mine — repetitive enough
+#: that the greedy stream's own cycles land in the history window
+def _repetitive(n_reps=8):
+    return np.tile(np.asarray([3, 5, 7, 9]), n_reps)
+
+
+# ---- the drafter ------------------------------------------------------------
+
+def test_ngram_propose_edge_cases():
+    """Empty/short histories return empty proposals (the engine pads);
+    matches prefer the LONGEST n-gram and its MOST RECENT occurrence,
+    and the iterated lookup EXTENDS a periodic history past its end
+    (each proposed token feeds the next lookup)."""
+    assert ngram_propose([], 3).size == 0
+    assert ngram_propose([5], 3).size == 0          # nothing before tail
+    np.testing.assert_array_equal(ngram_propose([1, 2, 3, 1, 2], 3),
+                                  [3, 1, 2])
+    np.testing.assert_array_equal(
+        ngram_propose([1, 2, 9, 4, 1, 2, 8, 4, 1, 2], 2), [8, 4])
+    # a constant run extends autoregressively, not truncating at the end
+    np.testing.assert_array_equal(ngram_propose([9, 9, 9], 3), [9, 9, 9])
+    # a period-2 cycle keeps cycling
+    np.testing.assert_array_equal(ngram_propose([4, 6, 4, 6], 4),
+                                  [4, 6, 4, 6])
+    assert ngram_propose([1, 2, 3], 0).size == 0    # k=0 degenerate
+    assert ngram_propose([1, 2, 3, 4], 3).size == 0  # nothing repeats
+
+
+# ---- token identity: THE gate ----------------------------------------------
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_spec_engine_token_identity_paged(tiny_model, k):
+    """Engine speculative decode at several chunk widths (k=1 is the
+    degenerate no-draft round) equals solo greedy generate (the dense
+    reference path) for every staggered request — random prompts (empty
+    drafter history / no n-gram hits) AND a repetitive prompt (real
+    accepted runs)."""
+    m = tiny_model
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(0, m.config.vocab_size, (n,))
+               for n in (5, 11, 3)] + [_repetitive()]
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8,
+                                speculative_k=k)
+    rids = [eng.add_request(p, max_new_tokens=8) for p in prompts[:3]]
+    for _ in range(3):
+        eng.step()
+    rids.append(eng.add_request(prompts[3], max_new_tokens=8))
+    done = eng.run_until_done()
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(done[rid], _solo(m, p, 8),
+                                      err_msg=f"req {rid} k={k}")
+
+
+def test_spec_accepts_on_repetitive_prompt(tiny_model):
+    """The n-gram drafter must actually EARN tokens on a repetitive
+    workload: accepted_tokens_per_dispatch > 1.0, counters and the
+    acceptance histogram move, output stays exactly greedy."""
+    from paddle_tpu.observability import catalog as cat
+
+    m = tiny_model
+    p = _repetitive()
+    n0 = cat.SERVING_SPEC_ACCEPTED.count(engine="decoder")
+    eng = ContinuousBatchEngine(m, max_batch=1, max_len=128, page_size=8,
+                                speculative_k=4)
+    rid = eng.add_request(p, max_new_tokens=16)
+    done = eng.run_until_done()
+    np.testing.assert_array_equal(done[rid], _solo(m, p, 16))
+    st = eng.stats()
+    assert st["spec_accepted_tokens"] > 0
+    assert st["accepted_tokens_per_dispatch"] > 1.0
+    assert st["spec_dispatches"] == st["decode_steps"]
+    assert st["spec_emitted_tokens"] == 16
+    # the histogram observed once per slot per dispatch
+    assert cat.SERVING_SPEC_ACCEPTED.count(engine="decoder") > n0
+
+
+def test_spec_with_prefix_cache_hit(tiny_model):
+    """Speculation over a prefix-cached admission: the second request
+    copies pages from the ACTIVE first slot, then both decode through
+    multi-token steps token-identically to solo."""
+    m = tiny_model
+    rng = np.random.RandomState(5)
+    shared = rng.randint(0, m.config.vocab_size, (17,))
+    p1 = np.concatenate([shared, rng.randint(0, m.config.vocab_size, (4,))])
+    p2 = np.concatenate([shared, rng.randint(0, m.config.vocab_size, (7,))])
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8,
+                                enable_prefix_cache=True, speculative_k=4)
+    r1 = eng.add_request(p1, max_new_tokens=6)
+    r2 = eng.add_request(p2, max_new_tokens=6)
+    assert eng.prefix_pages_reused == 2
+    done = eng.run_until_done()
+    for rid, p in ((r1, p1), (r2, p2)):
+        np.testing.assert_array_equal(done[rid], _solo(m, p, 6))
+
+
+def test_spec_with_chunked_prefill_interleaved(tiny_model):
+    """A long prompt lands chunk by chunk while a live slot runs
+    MULTI-token speculative dispatches in between: the reserved slot's
+    k throwaway writes park at its chunk frontier and the next chunk's
+    scatter overwrites them — both outputs exactly solo greedy."""
+    m = tiny_model
+    rng = np.random.RandomState(7)
+    long_p = rng.randint(0, m.config.vocab_size, (40,))
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8,
+                                prefill_chunk_tokens=16, speculative_k=4)
+    live = eng.add_request(_repetitive(4), max_new_tokens=12)
+    for _ in range(2):
+        eng.step()                       # live slot decoding speculatively
+    r_long = eng.add_request(long_p, max_new_tokens=6)
+    done = eng.run_until_done()
+    np.testing.assert_array_equal(done[live], _solo(m, _repetitive(4), 12))
+    np.testing.assert_array_equal(done[r_long], _solo(m, long_p, 6))
+
+
+def test_spec_with_preempt_restore_cycle(tiny_model, recorder):
+    """Preemption mid-speculation: the victim's bundle seals kv_len =
+    prompt + delivered tokens (rejected-draft garbage beyond it is
+    masked and overwritten after restore), and BOTH streams finish
+    token-identical to uninterrupted greedy runs."""
+    m = tiny_model
+    rng = np.random.RandomState(4)
+    long_p = rng.randint(0, m.config.vocab_size, (41,))
+    victim_p = _repetitive(6)            # speculation active when evicted
+    eng = ContinuousBatchEngine(m, max_batch=1, max_len=64, page_size=8,
+                                enable_preemption=True, speculative_k=3)
+    since = recorder.stats()["recorded"]
+    victim = eng.add_request(victim_p, max_new_tokens=12, priority=2)
+    for _ in range(3):
+        eng.step()
+    n_gen = len(eng._slots[0].tokens)
+    assert n_gen >= 3                    # spec steps emitted >= 1 each
+    hi = eng.add_request(long_p, max_new_tokens=6, priority=0)
+    done = eng.run_until_done()
+    np.testing.assert_array_equal(done[hi], _solo(m, long_p, 6))
+    np.testing.assert_array_equal(done[victim], _solo(m, victim_p, 12))
+    evs = recorder.events(since=since)
+    pre = [e for e in evs if e["kind"] == "sched.preempt"]
+    res = [e for e in evs if e["kind"] == "sched.restore"]
+    assert len(pre) == 1 and len(res) == 1
+    assert pre[0]["kv_len"] == res[0]["kv_len"] == victim_p.size + n_gen
+
+
+def test_spec_slot_migrates_mid_speculation(tiny_model):
+    """export_slot() on a speculating slot seals a consistent bundle: a
+    PEER engine admits it and the continued stream is token-identical —
+    the delivered prefix plus the peer's continuation equals solo."""
+    m = tiny_model
+    p = _repetitive()
+    src = ContinuousBatchEngine(m, max_batch=1, max_len=128, page_size=8,
+                                speculative_k=4)
+    rid = src.add_request(p, max_new_tokens=16)
+    for _ in range(2):
+        src.step()
+    delivered = list(src._slots[0].tokens)
+    assert delivered                       # mid-stream
+    bundle = src.export_slot(rid)
+    dst = ContinuousBatchEngine(m, max_batch=1, max_len=128, page_size=8,
+                                speculative_k=4)
+    rid2 = dst.admit_migrated(bundle)
+    done = dst.run_until_done()
+    np.testing.assert_array_equal(done[rid2], _solo(m, p, 16))
+    assert done[rid2][:len(delivered)].tolist() == delivered
+
+
+def test_spec_composes_with_sliding_window():
+    """Speculative verify under a Mistral sliding window: the chunk's
+    banded mask counts per-position true distances — token-identical to
+    solo greedy, with real acceptance on the repetitive slot."""
+    from paddle_tpu.models.mistral import MistralConfig, MistralForCausalLM
+
+    paddle.seed(0)
+    cfg = MistralConfig.tiny(sliding_window=8, use_flash_attention=False)
+    m = MistralForCausalLM(cfg)
+    p = np.random.RandomState(0).randint(0, 512, (20,))
+    rep = _repetitive(6)
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8,
+                                speculative_k=4)
+    r1 = eng.add_request(p, max_new_tokens=8)
+    r2 = eng.add_request(rep, max_new_tokens=8)
+    done = eng.run_until_done()
+    np.testing.assert_array_equal(done[r1], _solo(m, p, 8))
+    np.testing.assert_array_equal(done[r2], _solo(m, rep, 8))
+
+
+# ---- stop conditions inside an accepted run ---------------------------------
+
+def test_eos_inside_accepted_run(tiny_model, monkeypatch):
+    """eos landing at position >= 1 of an ACCEPTED run: tokens past it
+    are never delivered and the slot retires with reason "stop". An
+    oracle drafter (the true greedy continuation) makes the first
+    dispatch accept a full varied-token chunk deterministically, so the
+    eos is guaranteed to sit INSIDE the run, not at a dispatch
+    boundary."""
+    import paddle_tpu.speculative as spec_mod
+
+    m = tiny_model
+    rng = np.random.RandomState(3)
+    p = rng.randint(0, m.config.vocab_size, (9,))
+    ref = _solo(m, p, 16)
+
+    def oracle(history, k, max_ngram=3):
+        n_gen = np.asarray(history).reshape(-1).size - p.size
+        return np.asarray(ref[n_gen: n_gen + k], np.int32)
+
+    monkeypatch.setattr(spec_mod, "ngram_propose", oracle)
+    # first dispatch (k=4) accepts ref[0:4]; an eos at chunk position 2
+    # whose FIRST occurrence is there truncates mid-run
+    j = next(jj for jj in range(1, 4) if ref[jj] not in ref[:jj])
+    eos = int(ref[j])
+    eng = ContinuousBatchEngine(m, max_batch=1, max_len=64, page_size=8,
+                                eos_token_id=eos, speculative_k=4)
+    rid = eng.add_request(p, max_new_tokens=16)
+    done = eng.run_until_done()
+    np.testing.assert_array_equal(done[rid], ref[: j + 1])
+    assert eng.finish_reason(rid) == "stop"
+    st = eng.stats()
+    assert st["spec_dispatches"] == 1        # one multi-token dispatch
+    assert st["spec_emitted_tokens"] == j + 1
+
+
+def test_budget_truncates_inside_accepted_run(tiny_model):
+    """max_new_tokens hit mid-run: the engine delivers exactly the
+    budget and retires with reason "length" — extra accepted tokens are
+    discarded, never streamed."""
+    m = tiny_model
+    p = _repetitive()
+    ref = _solo(m, p, 5)
+    eng = ContinuousBatchEngine(m, max_batch=1, max_len=64, page_size=8,
+                                speculative_k=4)
+    rid = eng.add_request(p, max_new_tokens=5)
+    done = eng.run_until_done()
+    np.testing.assert_array_equal(done[rid], ref)
+    assert done[rid].size == 5
+    assert eng.finish_reason(rid) == "length"
+
+
+def test_stop_token_ids_inside_run(tiny_model, monkeypatch):
+    """Per-request stop sets truncate accepted runs exactly like the
+    engine eos (oracle drafter pins the stop inside the first run)."""
+    import paddle_tpu.speculative as spec_mod
+
+    m = tiny_model
+    rng = np.random.RandomState(3)
+    p = rng.randint(0, m.config.vocab_size, (9,))
+    ref = _solo(m, p, 16)
+
+    def oracle(history, k, max_ngram=3):
+        n_gen = np.asarray(history).reshape(-1).size - p.size
+        return np.asarray(ref[n_gen: n_gen + k], np.int32)
+
+    monkeypatch.setattr(spec_mod, "ngram_propose", oracle)
+    j = next(jj for jj in range(1, 4) if ref[jj] not in ref[:jj])
+    stop = int(ref[j])
+    eng = ContinuousBatchEngine(m, max_batch=1, max_len=64, page_size=8,
+                                speculative_k=4)
+    rid = eng.add_request(p, max_new_tokens=16, stop_token_ids=[stop])
+    done = eng.run_until_done()
+    np.testing.assert_array_equal(done[rid], ref[: j + 1])
+    assert eng.finish_reason(rid) == "stop"
+
+
+# ---- sampling fallback, streaming, logprobs ---------------------------------
+
+def test_sampling_slot_falls_back_to_one_token_step(tiny_model):
+    """A dispatch with a sampling slot active runs the one-token step
+    (speculation is greedy-exact only); the greedy request still equals
+    its solo run, and speculation resumes once the sampler retires."""
+    m = tiny_model
+    rng = np.random.RandomState(11)
+    pg = _repetitive()
+    ps = rng.randint(0, 512, (9,))
+    paddle.seed(123)
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=128, page_size=8,
+                                speculative_k=4)
+    r_greedy = eng.add_request(pg, max_new_tokens=16)
+    r_sample = eng.add_request(ps, max_new_tokens=4, do_sample=True,
+                               temperature=0.8, top_k=7)
+    done = eng.run_until_done()
+    np.testing.assert_array_equal(done[r_greedy], _solo(m, pg, 16))
+    assert done[r_sample].shape == (4,)
+    st = eng.stats()
+    # the sampler's 4 dispatches ran one-token; spec resumed after
+    assert 0 < st["spec_dispatches"] < st["decode_steps"]
+
+
+def test_spec_streaming_and_logprobs(tiny_model):
+    """on_token streams every token of an accepted run in order (last
+    one flagged done) and chosen-token logprobs align 1:1 with the
+    generated ids, exactly like the one-token engine."""
+    m = tiny_model
+    p = _repetitive()
+    streamed = []
+
+    def cb(rid, tok, done, lp):
+        streamed.append((tok, done, lp))
+
+    eng = ContinuousBatchEngine(m, max_batch=1, max_len=128, page_size=8,
+                                speculative_k=4)
+    rid = eng.add_request(p, max_new_tokens=12, on_token=cb, logprobs=True)
+    done = eng.run_until_done()
+    toks = [t for t, _, _ in streamed]
+    np.testing.assert_array_equal(np.asarray(toks), done[rid])
+    flags = [d for _, d, _ in streamed]
+    assert flags == [False] * (len(flags) - 1) + [True]
+    lps = eng.logprobs(rid)
+    assert lps is not None and len(lps) == done[rid].size
+    assert all(lp <= 0.0 for lp in lps)
+    # reference: the one-token engine's logprobs for the same stream
+    eng2 = ContinuousBatchEngine(m, max_batch=1, max_len=128, page_size=8)
+    rid2 = eng2.add_request(p, max_new_tokens=12, logprobs=True)
+    eng2.run_until_done()
+    np.testing.assert_allclose(lps, eng2.logprobs(rid2), rtol=2e-5,
+                               atol=2e-5)
+
+
+# ---- observability ----------------------------------------------------------
+
+def test_spec_events_and_span_attrs(tiny_model, recorder):
+    """Every spec dispatch leaves sched.spec_propose/verify/accept in
+    the flight recorder, and the request's root span carries the
+    spec_rounds / spec_accepted_tokens attributes at retirement."""
+    from paddle_tpu.observability import tracing
+
+    tracer = tracing.get_tracer()
+    was = tracer.enabled
+    tracer.enable()
+    try:
+        since = recorder.stats()["recorded"]
+        m = tiny_model
+        eng = ContinuousBatchEngine(m, max_batch=1, max_len=128,
+                                    page_size=8, speculative_k=4)
+        rid = eng.add_request(_repetitive(), max_new_tokens=12)
+        eng.run_until_done()
+        evs = recorder.events(since=since)
+        prop = [e for e in evs if e["kind"] == "sched.spec_propose"]
+        ver = [e for e in evs if e["kind"] == "sched.spec_verify"]
+        acc = [e for e in evs if e["kind"] == "sched.spec_accept"]
+        n = eng.stats()["spec_dispatches"]
+        assert len(prop) == len(ver) == len(acc) == n > 0
+        assert all(e["k"] == 4 for e in ver)
+        assert sum(e["emitted"] for e in acc) == 12
+        # newest-first over finished spans: rids restart per engine and
+        # earlier tests may have left same-rid (or still-live) request
+        # spans in the global tracer — the spec attrs identify ours
+        root = next(s for s in reversed(tracer.spans())
+                    if s["name"] == "serving.request"
+                    and s["attrs"].get("rid") == rid
+                    and "spec_rounds" in s["attrs"])
+        assert root["attrs"]["spec_rounds"] == n
+        assert root["attrs"]["spec_accepted_tokens"] == \
+            eng.stats()["spec_accepted_tokens"]
+    finally:
+        if not was:
+            tracer.disable()
+
+
+def test_spec_stats_keys_present_when_off(tiny_model):
+    """Dashboards read stable keys: a spec-off engine reports the spec
+    stats keys as zeros (and /health therefore always carries them)."""
+    eng = ContinuousBatchEngine(tiny_model, max_batch=1, max_len=32,
+                                page_size=8)
+    st = eng.stats()
+    assert st["spec_dispatches"] == 0
+    assert st["accepted_tokens_per_dispatch"] == 0.0
+
+
+# ---- admission guard rails --------------------------------------------------
+
+def test_spec_slack_enforced_at_admission(tiny_model):
+    """prompt + max_new + (k-1) must fit max_len: without the slack the
+    final dispatch's chunk scatter would clamp onto the slot's last
+    valid page."""
+    eng = ContinuousBatchEngine(tiny_model, max_batch=1, max_len=16,
+                                page_size=4, speculative_k=4)
+    eng.add_request(np.arange(1, 6), max_new_tokens=8)   # 5+8+3 == 16 ok
+    with pytest.raises(ValueError, match="speculation slack"):
+        eng.add_request(np.arange(1, 7), max_new_tokens=8)
+
+
+def test_spec_rejects_latent_mode():
+    from paddle_tpu.models.deepseek import (DeepseekV2Config,
+                                            DeepseekV2ForCausalLM)
+
+    paddle.seed(3)
+    m = DeepseekV2ForCausalLM(DeepseekV2Config.tiny_mla(
+        num_hidden_layers=1))
+    with pytest.raises(NotImplementedError, match="paged"):
+        ContinuousBatchEngine(m, max_batch=1, max_len=32, page_size=8,
+                              speculative_k=4)
+
+
+def test_spec_auto_k_off_device_defaults(tiny_model):
+    """speculative_k="auto" resolves through the autotune cost table;
+    off-TPU (no measurements possible) it lands on the default without
+    touching the device."""
+    eng = ContinuousBatchEngine(tiny_model, max_batch=1, max_len=64,
+                                page_size=8, speculative_k="auto")
+    assert eng.speculative_k == 4
+
+
+def test_spec_auto_k_reranks_by_expected_tokens(tiny_model, monkeypatch):
+    """The auto-k pick combines the measured per-dispatch cost table
+    with the geometric acceptance expectation: a wider chunk whose
+    dispatch is only marginally slower wins on expected retired tokens
+    per dispatch, and failed geometries are skipped."""
+    from paddle_tpu.ops.pallas import autotune
+    from paddle_tpu.serving import _resolve_spec_k
+
+    monkeypatch.setattr(autotune, "enabled", lambda: True)
+    captured = {}
+
+    def fake_search(kernel, sig, default, cands, runner, can, **kw):
+        captured["kernel"] = kernel
+        return default
+
+    class FakeCache:
+        def entry(self, kernel, key):
+            return {"table": {"2": {"status": "ok", "ms": 1.0},
+                              "4": {"status": "ok", "ms": 1.15},
+                              "6": {"status": "ok", "ms": 1.3},
+                              "8": {"status": "fail"}}}
+
+    monkeypatch.setattr(autotune, "search", fake_search)
+    monkeypatch.setattr(autotune, "get_cache", lambda: FakeCache())
+    # ms/E[tokens] at p=0.7: k=2 -> .59, k=4 -> .45, k=6 -> .44 (best)
+    assert _resolve_spec_k(tiny_model, 4, 64) == 6
+    assert captured["kernel"] == "spec_verify"
+
+
+def test_spec_invalid_k_rejected(tiny_model):
+    with pytest.raises(ValueError, match="speculative_k"):
+        ContinuousBatchEngine(tiny_model, max_batch=1, max_len=32,
+                              page_size=8, speculative_k=0)
+
+
+# ---- fused decode tail (megakernel) x speculation ---------------------------
+
+def test_spec_fused_decode_tail_token_identity():
+    """The S>1 verify chunk rides the fused decode-tail megakernels
+    (flattened B*S rows, per-row rope positions) where the gate admits:
+    token-identical to the discrete path, in interpret mode on CPU."""
+    from paddle_tpu.utils.flags import get_flags, set_flags
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=256,
+                      intermediate_size=512, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=256,
+                      use_flash_attention=False, dtype="float32")
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    p = _repetitive(5)
+    ref = _solo(m, p, 10)
+    prev = get_flags("FLAGS_use_fused_decode_tail")[
+        "FLAGS_use_fused_decode_tail"]
+    set_flags({"FLAGS_use_fused_decode_tail": True})
+    try:
+        eng = ContinuousBatchEngine(m, max_batch=2, max_len=64,
+                                    page_size=8, speculative_k=4)
+        rid = eng.add_request(p, max_new_tokens=10)
+        done = eng.run_until_done()
+        np.testing.assert_array_equal(done[rid], ref)
+    finally:
+        set_flags({"FLAGS_use_fused_decode_tail": prev})
+
+
+# ---- solo-path stats contract ----------------------------------------------
+
+def test_speculative_generate_return_stats(tiny_model):
+    """speculative_generate(return_stats=True) matches
+    mtp_speculative_generate's stats contract (rounds/hits/acceptance)
+    and never changes the emitted tokens."""
+    from paddle_tpu.speculative import speculative_generate
+
+    m = tiny_model
+    prompt = np.random.RandomState(0).randint(
+        0, m.config.vocab_size, (1, 9))
+    ref = m.generate(paddle.to_tensor(prompt), max_new_tokens=10).numpy()
+    out, stats = speculative_generate(
+        m, m, paddle.to_tensor(prompt), max_new_tokens=10, draft_k=3,
+        return_stats=True)
+    np.testing.assert_array_equal(out.numpy(), ref)
+    assert set(stats) == {"rounds", "hits", "acceptance"}
+    # perfect draft (target == draft): every proposal accepted
+    assert stats["hits"] == stats["rounds"] * 3
+    assert stats["acceptance"] == 1.0
