@@ -36,11 +36,18 @@ from .plan import Fault, FaultPlan
 __all__ = ["default_plan", "run_dryrun"]
 
 
+#: the request id the default plan's poison fault triggers on — the
+#: dryrun submits one request carrying it and asserts the quarantine
+#: contains the blast radius at <= 2 workers + exactly one typed 422
+POISON_RID = "poison-rid"
+
+
 def default_plan(seed: int = 0) -> FaultPlan:
     """The gate plan: one seeded plan combining every failure domain the
     cluster claims to absorb. Counts are arrivals per point per process
     (worker:0 is the prefill worker in the default topology; worker:2 a
-    decode worker)."""
+    decode worker); kills are incarnation-scoped so the supervisor's
+    respawn is not re-killed by the fault that killed its predecessor."""
     return FaultPlan(seed=seed, faults=[
         # the 2nd KV bundle worker:0 ships is silently lost — the decode
         # side must 504 and the router re-place (fresh prefill, fresh
@@ -58,8 +65,20 @@ def default_plan(seed: int = 0) -> FaultPlan:
         Fault("worker.request", "stall_heartbeat", nth=3,
               scope="worker:0", duration_s=4.0),
         # a decode worker dies at its 20th engine step — SIGKILL-grade,
-        # mid-stream; relays must fail over and continue token-identical
-        Fault("worker.step", "kill", nth=20, scope="worker:2"),
+        # mid-stream; relays must fail over and continue token-identical,
+        # and the SUPERVISOR must restart it (incarnation 0 only)
+        Fault("worker.step", "kill", nth=20, scope="worker:2",
+              incarnation=0),
+        # the DOUBLE-KILL: the restarted worker:2 dies again at its 5th
+        # step (incarnation 1 only) — the supervisor restarts it a
+        # second time and the pool still heals to full strength
+        Fault("worker.step", "kill", nth=5, scope="worker:2",
+              incarnation=1),
+        # the POISON: whichever worker (any incarnation) lets POISON_RID
+        # into a decode dispatch dies there — quarantine must contain it
+        # at <= 2 worker deaths and answer the client a typed 422
+        Fault("engine.dispatch", "crash_on_rid", detail=POISON_RID,
+              scope=None, incarnation=None),
     ])
 
 
@@ -106,10 +125,12 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
                compile_cache: Optional[str] = None,
                stream_timeout: float = 420.0,
                load_qps: float = 0.0,
-               load_duration_s: float = 4.0) -> dict:
+               load_duration_s: float = 4.0,
+               heal_timeout: float = 150.0,
+               poison: bool = True) -> dict:
     """Run the fixed-seed chaos plan against a real 1-prefill + 2-decode
-    cluster and return the report dict (see module docstring for the
-    claims it checks; ``report["ok"]`` is the verdict).
+    SUPERVISED cluster and return the report dict (see module docstring
+    for the claims it checks; ``report["ok"]`` is the verdict).
 
     With ``load_qps > 0`` the plan additionally fires UNDER GENERATED
     LOAD: a seeded open-loop Poisson stream (paddle_tpu.loadgen, with a
@@ -118,7 +139,20 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
     summary — every load outcome must be typed (200 / 429 / 504 with
     ``code=deadline_exceeded``), zero 5xx, zero silent stalls, and the
     shed accounting must balance (requests_shed == deadline_misses when
-    no bounded queue displaces work)."""
+    no bounded queue displaces work).
+
+    Since the self-healing PR the dryrun is the full
+    kill→restart→heal→quarantine story: after the classic fault window
+    it (a) waits for the supervisor to restart the killed worker and the
+    pool to return to full strength, (b) drives sequential streams until
+    the plan's DOUBLE-KILL fires in the restarted incarnation and heals
+    again, (c) submits the plan's POISON request (``POISON_RID``) and
+    asserts it kills at most ``QUARANTINE_THRESHOLD`` workers before the
+    router refuses it with a typed 422 ``code=request_quarantined``
+    (``poison=False`` skips this leg), and (d) replays a post-heal
+    loadgen burst asserting the healed tier still serves at the offered
+    rate with typed-only outcomes — capacity recovered, not merely
+    survived."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -135,6 +169,13 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
                     "handoff_wait_s": handoff_wait_s,
                     "max_retries": max_retries,
                     "model_name": "tiny-llama-chaos"},
+        # fast healing for the gate: short backoff (the compile cache is
+        # warm by restart time), generous breaker budget (the plan kills
+        # worker:2 twice ON PURPOSE — the breaker must contain loops,
+        # not the planned chaos), quick health-reset
+        "supervisor": {"backoff_base_s": 0.25, "backoff_max_s": 2.0,
+                       "breaker_threshold": 6, "breaker_window_s": 120.0,
+                       "healthy_reset_s": 5.0},
         "model": {"kind": "tiny_llama", "num_hidden_layers": layers,
                   "seed": 0},
         "engine": {"max_batch": max_batch, "max_len": max_len,
@@ -269,6 +310,106 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
                                     stack_before=load_before,
                                     stack_after=load_after)
 
+        # ---- self-healing: kill -> restart -> heal -> quarantine -----
+        def _alive_count() -> int:
+            try:
+                h = _get_json(f"http://{host}:{port}/health")
+            except OSError:
+                return 0
+            return sum(1 for w in h["workers"].values() if w["alive"])
+
+        def _wait_healed(n: int, timeout: float) -> bool:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if _alive_count() >= n:
+                    return True
+                time.sleep(0.4)
+            return False
+
+        sup = cluster.supervisor
+        n_workers = 3
+
+        # heal #1: the supervisor restarts the killed decode worker
+        # (same replica id, fresh lease + port) and the pool returns to
+        # full strength — capacity recovered without an operator
+        healed_after_kill = _wait_healed(n_workers, heal_timeout)
+
+        # the DOUBLE-KILL: drive sequential streams until the plan's
+        # incarnation-1 kill fires in the restarted worker:2 and the
+        # supervisor restarts it a SECOND time; every driven stream must
+        # be absorbed token-identical exactly like the planned kill
+        double_kill_streams_ok = True
+        restarts_w2 = 0
+        dk_deadline = time.monotonic() + heal_timeout
+        while time.monotonic() < dk_deadline:
+            w2 = (sup.state()["workers"].get("2") or {}) if sup else {}
+            restarts_w2 = len(w2.get("restarts") or ())
+            if restarts_w2 >= 2:
+                break
+            st, cl, tk = _stream_completion(
+                host, port, {"prompt_token_ids": prompts[1],
+                             "max_tokens": 16, "stream": True},
+                timeout=stream_timeout)
+            double_kill_streams_ok = (
+                double_kill_streams_ok and st == 200 and cl
+                and tk == solos[1][:16])
+        healed_after_double_kill = (restarts_w2 >= 2
+                                    and _wait_healed(n_workers,
+                                                     heal_timeout))
+
+        # the POISON: one request that deterministically kills whichever
+        # engine dispatches it. The quarantine must contain the blast
+        # radius at <= 2 workers and answer the CLIENT a typed 422 —
+        # exactly one, never a retry loop across the whole tier
+        poison_report = None
+        healed_after_poison = True
+        if poison and sup is not None:
+            conn = http.client.HTTPConnection(host, port,
+                                              timeout=stream_timeout)
+            try:
+                conn.request(
+                    "POST", "/v1/completions",
+                    json.dumps({"prompt_token_ids": prompts[0],
+                                "max_tokens": 8,
+                                "request_id": POISON_RID}),
+                    {"Content-Type": "application/json"})
+                p_resp = conn.getresponse()
+                try:
+                    p_body = json.loads(p_resp.read() or b"{}")
+                except ValueError:
+                    p_body = {}
+            finally:
+                conn.close()
+            ledger = sup.ledger.snapshot()
+            quarantine_rec = ledger["quarantined"].get(POISON_RID) or {}
+            poison_report = {
+                "status": p_resp.status,
+                "code": p_body.get("code"),
+                "deaths": len(ledger["implicated"].get(POISON_RID, ())),
+                "replicas": quarantine_rec.get("replicas"),
+                "quarantined": sorted(ledger["quarantined"]),
+            }
+            healed_after_poison = _wait_healed(n_workers, heal_timeout)
+
+        # post-heal capacity: replay a seeded open-loop burst at the
+        # same offered rate against the HEALED tier — goodput at the
+        # pre-fault knee, typed-only outcomes, zero 5xx (capacity
+        # recovered, not merely survived)
+        post_heal = None
+        if load_qps > 0:
+            from ..loadgen import (WorkloadSpec, run_schedule, summarize,
+                                   synthesize)
+
+            heal_spec = WorkloadSpec(
+                qps=load_qps, duration_s=2.5, process="poisson",
+                prompt_tokens=(4, prompt_len), max_tokens=(4, 10),
+                vocab_size=512, seed=plan.seed + 23)
+            heal_outs = run_schedule(
+                f"http://{host}:{port}", synthesize(heal_spec),
+                stream_timeout=stream_timeout)
+            post_heal = summarize(heal_outs, 2.5, offered_qps=load_qps)
+        supervisor_state = sup.state() if sup is not None else None
+
         # surviving workers' chaos.inject events (the killed worker's
         # ring died with it — its evidence is the exit code below)
         fired = {"router": injector.fired()}
@@ -328,6 +469,17 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
     # the wait window and the same re-place path took over) — both are
     # clean, and token identity above is the invariant that matters
     drop_absorbed = drop_detected or (drop_fired and all_ok)
+    poison_ok = True
+    if poison_report is not None:
+        poison_ok = (poison_report["status"] == 422
+                     and poison_report["code"] == "request_quarantined"
+                     and poison_report["deaths"] <= 2
+                     and poison_report["quarantined"] == [POISON_RID])
+    post_heal_ok = (post_heal is None
+                    or (post_heal["http_5xx"] == 0
+                        and post_heal["untyped"] == 0
+                        and post_heal["timed_out"] == 0
+                        and post_heal["completed"] > 0))
     report = {
         "plan": plan.as_dict(),
         "streams": stream_reports,
@@ -346,8 +498,20 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
         "killed_worker_exit": killed,
         "kill_mopup_ok": mopup_ok,
         "load": load_report,
+        # the self-healing story
+        "healed_after_kill": healed_after_kill,
+        "double_kill_restarts": restarts_w2,
+        "double_kill_streams_ok": double_kill_streams_ok,
+        "healed_after_double_kill": healed_after_double_kill,
+        "poison": poison_report,
+        "healed_after_poison": healed_after_poison,
+        "post_heal_load": post_heal,
+        "supervisor": supervisor_state,
         "ok": (all_ok and client_5xx == 0 and corrupt_detected
                and drop_absorbed and rejoined and bool(lost)
-               and killed == 137 and mopup_ok),
+               and killed == 137 and mopup_ok
+               and healed_after_kill and healed_after_double_kill
+               and double_kill_streams_ok and poison_ok
+               and healed_after_poison and post_heal_ok),
     }
     return report

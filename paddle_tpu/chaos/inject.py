@@ -33,17 +33,22 @@ from .plan import FaultPlan
 
 __all__ = ["ChaosInjector", "active", "install", "install_from_env",
            "uninstall", "on", "corrupt_bundle", "arm_engine",
-           "ENV_PLAN"]
+           "ENV_PLAN", "ENV_INCARNATION"]
 
 ENV_PLAN = "PDTPU_CHAOS_PLAN"
+#: the supervisor exports the respawned worker's restart generation here
+#: so incarnation-scoped faults (see plan.Fault) target one life of the
+#: process — a planned kill must not re-fire in the respawn it caused
+ENV_INCARNATION = "PDTPU_CHAOS_INCARNATION"
 
 
 class ChaosInjector:
     """Counts arrivals at injection points and fires matching faults."""
 
-    def __init__(self, plan: FaultPlan, scope: str):
+    def __init__(self, plan: FaultPlan, scope: str, incarnation: int = 0):
         self.plan = plan
         self.scope = scope
+        self.incarnation = int(incarnation)
         self.rng = random.Random(plan.seed)
         self._lock = make_lock("ChaosInjector._lock")
         self._counts = {}      # point -> arrivals seen
@@ -52,14 +57,25 @@ class ChaosInjector:
 
     def fire(self, point: str, **ctx):
         """One arrival at ``point``; returns the matching Fault (now
-        spent) or None. The caller applies the action."""
+        spent) or None. The caller applies the action. ``crash_on_rid``
+        faults match when their ``detail`` rid is in ``ctx["rids"]``
+        (the request ids entering the dispatch) instead of the arrival
+        count — the poison follows the request, not the clock."""
+        rids = ctx.get("rids") or ()
         with self._lock:
             n = self._counts.get(point, 0) + 1
             self._counts[point] = n
             hit = None
             for i, f in enumerate(self.plan.faults):
-                if (i in self._spent or f.point != point or f.nth != n
-                        or (f.scope is not None and f.scope != self.scope)):
+                if (i in self._spent or f.point != point
+                        or (f.scope is not None and f.scope != self.scope)
+                        or (f.incarnation is not None
+                            and f.incarnation != self.incarnation)):
+                    continue
+                if f.action == "crash_on_rid":
+                    if f.detail not in rids:
+                        continue
+                elif f.nth != n:
                     continue
                 hit = f
                 self._spent.add(i)
@@ -94,12 +110,13 @@ def active() -> Optional[ChaosInjector]:
     return _ACTIVE
 
 
-def install(plan: FaultPlan, scope: str) -> ChaosInjector:
+def install(plan: FaultPlan, scope: str,
+            incarnation: int = 0) -> ChaosInjector:
     """Install ``plan`` as this process's injector (replacing any)."""
     global _ACTIVE
-    _ACTIVE = ChaosInjector(plan, scope)
-    get_logger().info("chaos: plan installed (scope %s, %d faults)",
-                      scope, len(plan.faults))
+    _ACTIVE = ChaosInjector(plan, scope, incarnation=incarnation)
+    get_logger().info("chaos: plan installed (scope %s, incarnation %s, "
+                      "%d faults)", scope, incarnation, len(plan.faults))
     return _ACTIVE
 
 
@@ -110,7 +127,9 @@ def uninstall():
 
 def install_from_env(scope: str) -> Optional[ChaosInjector]:
     """Install the plan the launcher exported via ``PDTPU_CHAOS_PLAN``
-    (inline JSON or a file path); None when the env carries no plan."""
+    (inline JSON or a file path); None when the env carries no plan.
+    ``PDTPU_CHAOS_INCARNATION`` (set by the supervisor on respawn)
+    selects which incarnation-scoped faults arm in this process."""
     raw = os.environ.get(ENV_PLAN)
     if not raw:
         return None
@@ -118,7 +137,11 @@ def install_from_env(scope: str) -> Optional[ChaosInjector]:
         plan = FaultPlan.loads(raw)
     else:
         plan = FaultPlan.load(raw)
-    return install(plan, scope)
+    try:
+        incarnation = int(os.environ.get(ENV_INCARNATION, "0"))
+    except ValueError:
+        incarnation = 0
+    return install(plan, scope, incarnation=incarnation)
 
 
 def on(point: str, **ctx):

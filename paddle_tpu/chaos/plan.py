@@ -26,9 +26,24 @@ point                     actions
                           lapsed), ``delay``
 ``worker.step``           ``kill`` (``os._exit`` at the nth engine decode
                           step — SIGKILL-grade death, no teardown)
+``engine.dispatch``       ``crash_on_rid`` (``os._exit`` the moment the
+                          request id named by ``detail`` enters a decode
+                          dispatch — the deterministic poison request;
+                          ``nth`` is ignored, the rid IS the trigger)
 ``pool.probe``            ``probe_fail`` (the router's /health poll of a
                           worker is treated as failed)
 ========================  =====================================================
+
+**Incarnations.** Under the worker supervisor a killed worker restarts
+as the same replica with a bumped *incarnation* number; the respawned
+process re-installs the SAME plan from the environment. A fault's
+``incarnation`` field scopes it to one life of the process: the default
+``0`` fires only in the original incarnation (so a planned kill does
+not re-fire in the respawned worker and crash-loop it), an explicit
+integer targets that restart generation (``incarnation=1`` = the first
+respawn — how the gate stages a double-kill), and ``None`` fires in any
+incarnation (how ``crash_on_rid`` keeps killing whichever worker the
+poison request lands on until the quarantine refuses it).
 
 Plans serialize as JSON (``dumps``/``loads``/``load``) so the launcher
 can hand one to worker subprocesses through the environment
@@ -46,6 +61,7 @@ POINT_ACTIONS = {
     "router.upstream": ("http_500", "delay"),
     "worker.request": ("http_500", "stall_heartbeat", "delay"),
     "worker.step": ("kill",),
+    "engine.dispatch": ("crash_on_rid",),
     "pool.probe": ("probe_fail",),
 }
 
@@ -53,15 +69,19 @@ POINT_ACTIONS = {
 class Fault:
     """One planned failure: fire ``action`` on the ``nth`` arrival at
     ``point`` in the process whose injector scope equals ``scope``
-    (``None`` = any process that reaches the point). Each fault fires at
-    most once."""
+    (``None`` = any process that reaches the point) and whose
+    ``incarnation`` matches (``0`` = the original process, ``N`` = the
+    Nth supervised respawn, ``None`` = any). Each fault fires at most
+    once per process. ``crash_on_rid`` faults match on the request id in
+    ``detail`` instead of the arrival count."""
 
     __slots__ = ("point", "action", "nth", "scope", "delay_s",
-                 "duration_s", "detail")
+                 "duration_s", "detail", "incarnation")
 
     def __init__(self, point: str, action: str, nth: int = 1,
                  scope: Optional[str] = None, delay_s: float = 0.0,
-                 duration_s: float = 0.0, detail: Optional[str] = None):
+                 duration_s: float = 0.0, detail: Optional[str] = None,
+                 incarnation: Optional[int] = 0):
         if point not in POINT_ACTIONS:
             raise ValueError(
                 f"unknown injection point {point!r} "
@@ -72,6 +92,10 @@ class Fault:
                 f"(legal: {POINT_ACTIONS[point]})")
         if int(nth) < 1:
             raise ValueError(f"nth is 1-based, got {nth}")
+        if action == "crash_on_rid" and not detail:
+            raise ValueError(
+                "crash_on_rid needs detail=<request id> — the rid that "
+                "poisons its dispatch")
         self.point = point
         self.action = action
         self.nth = int(nth)
@@ -79,6 +103,7 @@ class Fault:
         self.delay_s = float(delay_s)
         self.duration_s = float(duration_s)
         self.detail = detail
+        self.incarnation = None if incarnation is None else int(incarnation)
 
     def as_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__slots__}

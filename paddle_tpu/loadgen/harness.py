@@ -44,7 +44,12 @@ _STACK_KEYS = ("requests_admitted", "requests_finished",
                "requests_cancelled", "requests_rejected", "requests_shed",
                "deadline_misses", "requests_preempted",
                "requests_migrated_out", "requests_migrated_in",
-               "tokens_generated")
+               "requests_degraded", "tokens_generated",
+               # cluster-level self-healing counters: summed from the
+               # router's supervisor section (zero on unsupervised /
+               # single-process stacks) — a load report shows how many
+               # worker restarts and quarantines the traffic window saw
+               "worker_restarts", "requests_quarantined")
 
 
 class Outcome:
@@ -277,6 +282,9 @@ def stack_stats(url: str, timeout: float = 10.0) -> dict:
         return totals
     sources = []
     if "workers" in payload:
+        sup = payload.get("supervisor") or {}
+        totals["worker_restarts"] = int(sup.get("restarts_total", 0) or 0)
+        totals["requests_quarantined"] = len(sup.get("quarantined", ()))
         for w in payload["workers"].values():
             if not w.get("alive"):
                 continue

@@ -115,11 +115,12 @@ HTTP_REQUESTS = _R.counter(
 ROUTER_PLACEMENTS = _R.counter(
     "router_placements_total",
     "Cluster-router placement outcomes "
-    "(outcome=placed|retried|busy|deadline|failed); retried counts "
-    "every failed attempt that was requeued, busy counts 429 placement "
-    "feedback, deadline counts requests shed at the router because "
-    "their SLO budget ran out, failed counts requests that exhausted "
-    "the retry budget",
+    "(outcome=placed|retried|busy|deadline|quarantined|failed); retried "
+    "counts every failed attempt that was requeued, busy counts 429 "
+    "placement feedback, deadline counts requests shed at the router "
+    "because their SLO budget ran out, quarantined counts poison "
+    "requests refused typed (422), failed counts requests that "
+    "exhausted the retry budget",
     labels=("outcome",))
 
 ROUTER_WORKERS = _R.gauge(
@@ -127,6 +128,22 @@ ROUTER_WORKERS = _R.gauge(
     "Workers in the router's pool by liveness (state=alive|lost; "
     "refreshed on every pool poll and /metrics scrape)",
     labels=("state",))
+
+WORKER_RESTARTS = _R.counter(
+    "worker_restarts_total",
+    "Supervised worker restarts by replica (each is a sup.restart "
+    "event: the supervisor observed the worker process die and "
+    "respawned it under the backoff ladder; breaker-held deaths are "
+    "NOT counted — they produce sup.breaker_open instead)",
+    labels=("replica",))
+
+REQUESTS_QUARANTINED = _R.counter(
+    "requests_quarantined_total",
+    "Request ids quarantined by the poison-request ledger (implicated "
+    "in >= 2 distinct worker deaths via deathnote/journal blame; the "
+    "router answers them 422 code=request_quarantined and never "
+    "retries them)",
+    labels=())
 
 # ---- observability self-telemetry ------------------------------------------
 

@@ -215,6 +215,31 @@ EV_CHAOS = _register(
     "a planned fault fired at a chaos injection point (point, action, "
     "nth, scope, detail) — written by the injector itself, so incident "
     "bundles separate injected fault from observed symptom")
+EV_SUP_RESTART = _register(
+    "sup.restart",
+    "the worker supervisor observed a worker process die and scheduled "
+    "its restart (replica_id, incarnation, exit_code, delay_s) — the "
+    "respawn reuses the same role/replica_id and registers a fresh "
+    "lease, so the pool heals to full strength without an operator")
+EV_SUP_BREAKER = _register(
+    "sup.breaker_open",
+    "a worker's restart circuit breaker tripped OPEN (replica_id, "
+    "restarts, window_s): more than the budgeted restarts inside the "
+    "sliding window — the supervisor holds the worker down and the "
+    "router /health reports degraded capacity until an operator resets")
+EV_SCHED_QUARANTINE = _register(
+    "sched.quarantine",
+    "a request id crossed the poison-quarantine threshold (rid, "
+    "deaths, replicas): implicated by deathnote/journal blame in >= 2 "
+    "distinct worker deaths — the router answers it 422 "
+    "code=request_quarantined and never retries it")
+EV_SCHED_DEGRADE = _register(
+    "sched.degrade",
+    "the engine caught an XLA OOM during admission/step and degraded "
+    "instead of dying (engine, rid, where, max_active_slots, previous):"
+    " the triggering request was shed typed and max_active_slots "
+    "durably shrank (floor 1), so preflight admission sees the reduced "
+    "budget")
 EV_LOCK_ORDER = _register(
     "lock.order_violation",
     "the runtime lock-order witness (FLAGS_lock_witness) observed an "

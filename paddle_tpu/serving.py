@@ -19,6 +19,7 @@ rows, immediately refill their slots. Ragged-ness is first-class because
 from __future__ import annotations
 
 import math
+import os
 import time
 import zlib
 from collections import deque
@@ -28,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .chaos import inject as _chaos
 from .observability import catalog as _metrics
 from .observability import flightrecorder as _frec
 from .observability import tracing as _tracing
@@ -185,12 +187,18 @@ class _Request:
                  "encoder_input", "seed_ids", "t_enqueue", "t_admit",
                  "t_last", "span", "queue_span", "handoff",
                  "priority", "deadline", "resume", "n_preempted",
-                 "on_shed", "spec_rounds", "spec_accepted")
+                 "on_shed", "spec_rounds", "spec_accepted", "ext_id")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
                  on_token=None, pixel_values=None, stop_token_ids=None,
-                 want_logprobs=False, priority=None, slo_ms=None):
+                 want_logprobs=False, priority=None, slo_ms=None,
+                 request_id=None):
         self.rid = rid
+        # the CALLER's request identity (the cluster router's request_id
+        # header/body field) — what the deathnote names, so poison blame
+        # correlates across workers and retries; engine rids are
+        # process-local and reset on restart
+        self.ext_id = None if request_id is None else str(request_id)
         self.ids = np.asarray(ids).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.tokens: List[int] = []
@@ -290,6 +298,9 @@ class _RequestBookkeeping:
     # (seq2seq has no deadline surface at all)
     _n_shed = 0
     _n_deadline_misses = 0
+    # OOM-degrade counter (decoder-only path; class default keeps the
+    # stats() key stable for seq2seq)
+    _n_degraded = 0
 
     # speculative-decode counters: class defaults so stats() works on
     # engines that never speculate (seq2seq, spec-off decoder engines)
@@ -298,12 +309,22 @@ class _RequestBookkeeping:
     _n_spec_accepted = 0     # draft tokens the target accepted
     _n_spec_slot_rounds = 0  # (active slot, spec dispatch) pairs
 
+    # pre-dispatch blame record (supervisor.Deathnote) — None outside
+    # supervised cluster workers, and the guard helpers never run then
+    deathnote = None
+
     def _init_bookkeeping(self, engine: str):
         """One init for queue/finish state, lifetime counters, and the
         registry children (bound once here — no per-token label lookups
         on the decode hot path)."""
         self._engine_label = engine
         self._next_rid = 0
+        # graceful OOM degradation: the engine's ADMISSION budget. Starts
+        # at max_batch and durably SHRINKS (floor 1) every time an XLA
+        # OOM is caught during admission/step — the engine sheds the
+        # triggering request typed and keeps serving at the reduced
+        # occupancy instead of dying (sched.degrade)
+        self.max_active_slots = int(getattr(self, "max_batch", 0) or 0)
         self._queue: List[_Request] = []
         self._finished: Dict[int, np.ndarray] = {}
         # finish reasons are kept for the last _REASON_KEEP requests only
@@ -410,6 +431,11 @@ class _RequestBookkeeping:
             "tokens_generated": self._n_tokens,
             "slot_utilization": (active / self.max_batch
                                  if self.max_batch else 0.0),
+            # the LIVE admission budget: == max_batch until an OOM
+            # degrade shrank it (sched.degrade); /health surfaces it so
+            # a balancer sees the reduced capacity, not just the symptom
+            "max_active_slots": self.max_active_slots,
+            "requests_degraded": self._n_degraded,
             "prefix_pages_reused": self.prefix_pages_reused,
             # speculative decode: tokens retired per slot per dispatch is
             # THE speculation health number (1.0 = no speedup; the n-gram
@@ -441,6 +467,7 @@ class _RequestBookkeeping:
         return {
             "engine": self._engine_label,
             "max_batch": self.max_batch,
+            "max_active_slots": self.max_active_slots,
             "slots": slots,
             "queue": [r.rid for r in self._queue],
             "prefilling": {
@@ -940,7 +967,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._m_sched = {
             d: _metrics.SERVING_SCHED.labels(engine="decoder", decision=d)
             for d in ("chunk", "preempt", "restore", "migrate_out",
-                      "migrate_in")}
+                      "migrate_in", "degrade")}
         # acceptance histogram child bound once (no per-dispatch label
         # lookups on the decode hot path), like every engine metric
         self._m_spec_accept = _metrics.SERVING_SPEC_ACCEPTED.labels(
@@ -981,7 +1008,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                     on_token=None, pixel_values=None,
                     stop_token_ids=None, logprobs=False,
                     trace_ctx=None, priority=None, slo_ms=None,
-                    on_shed=None) -> int:
+                    on_shed=None, request_id=None) -> int:
         """Queue one request. Sampling knobs default to the engine-level
         configuration; any per-request override routes decoding through the
         per-row sampling program (one compiled step serves the whole mix).
@@ -1069,7 +1096,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                        on_token, pixel_values=pixel_values,
                        stop_token_ids=stop_token_ids,
                        want_logprobs=logprobs, priority=priority,
-                       slo_ms=slo_ms)
+                       slo_ms=slo_ms, request_id=request_id)
         req.on_shed = on_shed
         # trace_ctx: inbound (trace_id, parent_span_id) — the HTTP
         # layer's parsed W3C traceparent — parents this request's root
@@ -1261,7 +1288,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                         do_sample=None, temperature=None, top_k=None,
                         top_p=None, on_token=None, stop_token_ids=None,
                         logprobs=False, trace_ctx=None, priority=None,
-                        slo_ms=None, on_shed=None) -> int:
+                        slo_ms=None, on_shed=None, request_id=None) -> int:
         """Queue a request whose prefill already happened on a PEER
         engine (``export_prefill`` over the same weights): admission
         scatters the bundle's KV buffers straight into the slot's pages
@@ -1302,7 +1329,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._m_req_admitted.inc()
         req = _Request(rid, ids, max_new_tokens, sampling, on_token,
                        stop_token_ids=stop_token_ids, want_logprobs=logprobs,
-                       priority=priority, slo_ms=slo_ms)
+                       priority=priority, slo_ms=slo_ms,
+                       request_id=request_id)
         req.on_shed = on_shed
         req.handoff = handoff
         self._trace_submit(req, trace_ctx)
@@ -1502,7 +1530,12 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._admit()
         self._advance_chunk()
         if self.num_active == 0:
+            self._clear_dispatch_guard()
             return self._drain_finished()
+        # pre-dispatch blame + poison injection: arm the deathnote with
+        # the rids entering this dispatch (covers the speculative branch
+        # too — it is the same device dispatch boundary)
+        self._dispatch_guard([r for r in self._slots if r is not None])
         if self.speculative_k is not None and self._spec_eligible():
             return self._step_speculative()
         t_dispatch = time.perf_counter()
@@ -1513,26 +1546,38 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         # all-default mixes keep the static program (no per-row filter
         # sorts, no [B] knob transfers), and the engine falls back to it
         # as soon as the overriding requests retire
-        if any(r is not None and r.sampling is not None for r in self._slots):
-            rows = [(r.sampling or self._sample_cfg) if r is not None
-                    else self._sample_cfg for r in self._slots]
-            step = _get_select_decode_rows(self.model, self.max_len)
-            nxt, logps, self._last, self._caches = step(
-                self._last, _random.next_key(),
-                jnp.asarray([r[0] for r in rows], bool),
-                jnp.asarray([r[1] for r in rows], jnp.float32),
-                jnp.asarray([r[2] for r in rows], jnp.int32),
-                jnp.asarray([r[3] for r in rows], jnp.float32),
-                self._caches)
-        else:
-            step = _get_select_decode(self.model, self.max_len, do_sample,
-                                      temperature, top_k, top_p)
-            nxt, logps, self._last, self._caches = step(
-                self._last, _random.next_key(), self._caches)
+        try:
+            with _frec.incident_scope("engine.step"):
+                if any(r is not None and r.sampling is not None
+                       for r in self._slots):
+                    rows = [(r.sampling or self._sample_cfg)
+                            if r is not None
+                            else self._sample_cfg for r in self._slots]
+                    step = _get_select_decode_rows(self.model,
+                                                   self.max_len)
+                    nxt, logps, self._last, self._caches = step(
+                        self._last, _random.next_key(),
+                        jnp.asarray([r[0] for r in rows], bool),
+                        jnp.asarray([r[1] for r in rows], jnp.float32),
+                        jnp.asarray([r[2] for r in rows], jnp.int32),
+                        jnp.asarray([r[3] for r in rows], jnp.float32),
+                        self._caches)
+                else:
+                    step = _get_select_decode(self.model, self.max_len,
+                                              do_sample, temperature,
+                                              top_k, top_p)
+                    nxt, logps, self._last, self._caches = step(
+                        self._last, _random.next_key(), self._caches)
+        except _frec.XlaOom as e:
+            # graceful degradation instead of an engine-loop death: shed
+            # the most recently admitted slot typed, shrink the budget
+            self._degrade_on_oom(None, where="step", exc=e)
+            return self._drain_finished()
         # THE one deliberate device->host sync of the decode loop: every
         # other host conversion below reads these already-fetched arrays
         toks = np.asarray(nxt)    # pdlint: disable=host-sync
         lps = np.asarray(logps)   # pdlint: disable=host-sync
+        self._clear_dispatch_guard()  # step success: blame record erased
         # np.asarray forced the device->host sync, so the span covers the
         # whole fused dispatch; ONE clock for every token this step
         # produced (they came from one dispatch)
@@ -1686,15 +1731,21 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         if rec.enabled:
             rec.record(_frec.EV_SPEC_PROPOSE, engine=self._engine_label,
                        active=self.num_active, k=k, drafted=n_drafted)
-        step = _get_spec_decode(self.model, self.max_len, k)
-        emitted, n_emit, logps, self._last, self._caches = step(
-            self._last, jnp.asarray(drafts), self._caches)
+        try:
+            with _frec.incident_scope("engine.step"):
+                step = _get_spec_decode(self.model, self.max_len, k)
+                emitted, n_emit, logps, self._last, self._caches = step(
+                    self._last, jnp.asarray(drafts), self._caches)
+        except _frec.XlaOom as e:
+            self._degrade_on_oom(None, where="step", exc=e)
+            return self._drain_finished()
         # THE deliberate device->host sync of the speculative decode
         # loop: one dispatch produced all three arrays, the first
         # conversion blocks, the other two read already-fetched results
         toks = np.asarray(emitted)   # pdlint: disable=host-sync -- the step's one deliberate token fetch (host retirement needs the ints)
         n_row = np.asarray(n_emit)   # pdlint: disable=host-sync -- same dispatch as toks; variable per-slot advance drives host bookkeeping
         lps = np.asarray(logps)      # pdlint: disable=host-sync -- same dispatch as toks; the OpenAI logprobs field
+        self._clear_dispatch_guard()  # step success: blame record erased
         now = time.perf_counter()
         self._m_step.observe(now - t_dispatch)
         self._n_steps += 1
@@ -1839,6 +1890,98 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             b *= 2
         return min(b, self.max_len)
 
+    # ---- crash containment: deathnote blame + graceful OOM degrade ------
+    def _dispatch_guard(self, reqs: List[_Request]):
+        """Pre-dispatch blame boundary, armed immediately before every
+        device dispatch (admission prefill carries the one admitting
+        request; a decode step carries every active slot):
+
+        - the **deathnote** (supervisor.Deathnote, cluster workers only)
+          atomically records the request ids entering the dispatch and
+          is erased on step success — if the process dies mid-dispatch
+          the supervisor blames exactly these rids, not every request
+          the router had in flight here;
+        - the ``engine.dispatch`` **chaos point** hands the injector the
+          same ids: a planned ``crash_on_rid`` fault kills the process
+          the moment its poison rid enters a dispatch (``os._exit``,
+          SIGKILL-grade — the deathnote survives to testify).
+
+        Free when neither a deathnote nor a chaos plan is installed
+        (solo engines: two attribute reads per step)."""
+        dn = self.deathnote
+        inj = _chaos.active()
+        if dn is None and inj is None:
+            return
+        rids = [r.ext_id if r.ext_id is not None else f"rid:{r.rid}"
+                for r in reqs]
+        if dn is not None:
+            if rids:
+                dn.arm(rids)
+            else:
+                dn.clear()
+        if inj is not None and rids:
+            fault = inj.fire("engine.dispatch", rids=tuple(rids))
+            if fault is not None and fault.action == "crash_on_rid":
+                os._exit(134)
+
+    def _clear_dispatch_guard(self):
+        dn = self.deathnote
+        if dn is not None:
+            dn.clear()
+
+    def _degrade_on_oom(self, req: Optional[_Request], where: str, exc):
+        """Graceful OOM degradation: an XLA RESOURCE_EXHAUSTED was
+        caught at a dispatch boundary (``where`` = "admit" | "step").
+        Instead of poisoning the engine loop, shed the TRIGGERING
+        request typed (the admitting request, or the most recently
+        admitted active slot — the marginal occupancy that broke the
+        budget), durably shrink ``max_active_slots`` to one below the
+        occupancy that OOM'd (floor 1), and emit ``sched.degrade`` so
+        /health and debug_state() show the reduced budget. The incident
+        bundle was already written by the dispatch's incident_scope."""
+        occupancy = (self.num_active + len(self._chunking)
+                     + (1 if req is not None else 0))
+        prev = self.max_active_slots
+        self.max_active_slots = max(1, min(prev, occupancy - 1))
+        victim = req
+        if victim is None:
+            cands = [r for r in self._slots if r is not None]
+            victim = max(cands, key=lambda r: (r.t_admit or 0.0, r.rid)) \
+                if cands else None
+        if (victim is not None and victim.slot >= 0
+                and self._slots[victim.slot] is victim):
+            self._slots[victim.slot] = None
+            self._lengths = self._lengths.at[victim.slot].set(0)
+            victim.slot = -1
+        self._n_degraded += 1
+        self._m_sched["degrade"].inc()
+        rec = _frec.RECORDER
+        if rec.enabled:
+            rec.record(_frec.EV_SCHED_DEGRADE, engine=self._engine_label,
+                       rid=(victim.rid if victim is not None else None),
+                       where=where,
+                       max_active_slots=self.max_active_slots,
+                       previous=prev)
+        if victim is None:
+            return
+        self._n_shed += 1
+        self._m_req_shed.inc()
+        self._m_sched_shed.inc()
+        msg = (f"request {victim.rid} shed: device out of memory during "
+               f"{where}; engine degraded max_active_slots "
+               f"{prev} -> {self.max_active_slots} ({exc})")
+        if rec.enabled:
+            rec.record(_frec.EV_SCHED_SHED, rid=victim.rid,
+                       engine=self._engine_label,
+                       priority=victim.priority, where="oom",
+                       miss_ms=None, queue_depth=len(self._queue))
+        self._record_reason(victim.rid, "shed")
+        self._trace_end(victim, "shed")
+        if victim.on_shed is not None:
+            victim.on_shed(victim.rid, {
+                "where": "oom", "error": msg, "miss_ms": None,
+                "retry_after": self._retry_after_estimate()})
+
     def _admit(self):
         if self._poisoned and self._queue:
             raise RuntimeError(
@@ -1851,6 +1994,16 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             # never be admitted after its deadline expired
             self._shed_expired(now)
             if not self._queue:
+                return
+            if (self.max_active_slots < self.max_batch
+                    and self.num_active + len(self._chunking)
+                    >= self.max_active_slots):
+                # OOM-degraded budget: the engine provably cannot serve
+                # max_batch concurrent slots on this device — admission
+                # respects the shrunken cap, the queue waits (and the
+                # gate binds ONLY once degraded: at full budget the
+                # slot-scan below owns the decision, so preemption
+                # still runs at a full pool)
                 return
             slot = self._free_slot()
             if slot < 0:
@@ -1882,10 +2035,21 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 # slot reserved; chunks advance one per step() so live
                 # decodes keep flowing — see _advance_chunk
                 continue
-            with tracer.span(_tracing.SPAN_PREFILL, parent=req.span,
-                             attrs={"slot": slot,
-                                    "prompt_tokens": int(req.ids.size)}):
-                self._prefill_into(slot, req)
+            self._dispatch_guard([req])
+            try:
+                with _frec.incident_scope("engine.admit"):
+                    with tracer.span(
+                            _tracing.SPAN_PREFILL, parent=req.span,
+                            attrs={"slot": slot,
+                                   "prompt_tokens": int(req.ids.size)}):
+                        self._prefill_into(slot, req)
+            except _frec.XlaOom as e:
+                # graceful degradation: the admission forward OOM'd
+                # BEFORE any donated scatter (scatter failures poison
+                # with a plain RuntimeError) — shed the trigger typed,
+                # shrink the budget, keep serving everyone else
+                self._degrade_on_oom(req, where="admit", exc=e)
+                continue
             with tracer.use(req.span):
                 self._m_prefill.observe(time.perf_counter() - t_adm)
             self._slots[slot] = req

@@ -16,7 +16,10 @@ The single-process ``CompletionServer`` scaled out (ROADMAP item 1):
   ``io/shm_channel`` (device collectives pluggable); migration bundles
   ride the same transport;
 - :mod:`launcher` — config → running tier (``scripts/serve_cluster.py``
-  is the CLI).
+  is the CLI);
+- :mod:`supervisor` — self-healing: worker restart with backoff + a
+  per-worker circuit breaker, deathnote-precise poison-request
+  quarantine, cluster-level incident indexing.
 
 See docs/SERVING.md "Disaggregated deployment" and "Failure domains &
 migration runbook"; :mod:`paddle_tpu.chaos` injects the failures this
@@ -26,10 +29,14 @@ from .kv_handoff import KvHandoffReceiver, KvHandoffSender  # noqa: F401
 from .launcher import Cluster, launch_cluster, load_config  # noqa: F401
 from .pool import WorkerInfo, WorkerPool                    # noqa: F401
 from .router import RouterServer                            # noqa: F401
+from .supervisor import (CircuitBreaker, Deathnote,         # noqa: F401
+                         QuarantineLedger, RestartBackoff,
+                         WorkerSupervisor)
 from .worker import WorkerServer, run_worker                # noqa: F401
 
 __all__ = [
-    "Cluster", "KvHandoffReceiver", "KvHandoffSender", "RouterServer",
-    "WorkerInfo", "WorkerPool", "WorkerServer", "launch_cluster",
-    "load_config", "run_worker",
+    "CircuitBreaker", "Cluster", "Deathnote", "KvHandoffReceiver",
+    "KvHandoffSender", "QuarantineLedger", "RestartBackoff",
+    "RouterServer", "WorkerInfo", "WorkerPool", "WorkerServer",
+    "WorkerSupervisor", "launch_cluster", "load_config", "run_worker",
 ]

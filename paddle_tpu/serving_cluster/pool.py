@@ -169,15 +169,27 @@ class WorkerPool:
         results apply under it."""
         alive = self._mgr.alive_ranks()
         joined: List[Tuple[int, dict]] = []
+        refreshed: Dict[int, dict] = {}
         ages: Dict[int, Optional[float]] = {}
         with self._lock:
             known = dict(self._workers)
         for r in alive:
             ages[r] = self._mgr.lease_age(r)
-            if r not in known:
+            w = known.get(r)
+            if w is None:
                 meta = self._mgr.peer_metadata(r)
                 if meta is not None:
                     joined.append((r, meta))
+            elif not w.alive or not w.probe_ok:
+                # a dead-or-unprobeable worker with a fresh lease may be
+                # a supervised RESTART of the same replica: its metadata
+                # (port, pid, kv channel) is new, so refetch it until
+                # the worker probes healthy again — rejoining on the
+                # dead incarnation's port would bounce placements into
+                # a closed socket forever
+                meta = self._mgr.peer_metadata(r)
+                if meta is not None:
+                    refreshed[r] = meta
         lost: List[WorkerInfo] = []
         with self._lock:
             for r, meta in joined:
@@ -195,6 +207,16 @@ class WorkerPool:
             for r, w in self._workers.items():
                 if r in alive:
                     w.lease_age_s = ages.get(r)
+                    meta = refreshed.get(r)
+                    if meta is not None and meta.get("pid") != w.pid:
+                        # a different pid behind the same replica id:
+                        # the supervisor respawned it — adopt the fresh
+                        # incarnation's address wholesale
+                        w.host = meta.get("host", w.host)
+                        w.port = int(meta.get("port", w.port))
+                        w.pid = meta.get("pid")
+                        w.kv_channel = meta.get("kv_channel")
+                        w.role = meta.get("role", w.role)
                     if not w.alive and self._beat_after_death(w):
                         # rejoin ONLY on a heartbeat newer than the
                         # moment the router observed the death: a freshly

@@ -125,11 +125,28 @@ class RouterServer:
                  max_retries: int = 2, upstream_timeout: float = 120.0,
                  retry_backoff_s: float = 0.05,
                  enable_tracing: bool = True,
-                 enable_flight_recorder: bool = True):
+                 enable_flight_recorder: bool = True,
+                 quarantine=None, supervisor=None):
         self.pool = pool
         self.model_name = model_name
         self.max_retries = int(max_retries)
         self.upstream_timeout = float(upstream_timeout)
+        # poison containment (supervisor.QuarantineLedger): a request id
+        # implicated in >= 2 distinct worker deaths answers a typed 422
+        # code=request_quarantined and is NEVER placed again — one
+        # poisoned input must not serially crash the whole tier
+        self._quarantine = quarantine
+        # the worker supervisor (when this router fronts a supervised
+        # launcher): notified the moment a placement socket observes a
+        # death, so deathnote blame lands before the next retry; its
+        # state() rides /health as the degraded-capacity report
+        self._supervisor = supervisor
+        if (self._quarantine is None and supervisor is not None):
+            self._quarantine = supervisor.ledger
+        # in-flight journal: request_id -> replica_id currently serving
+        # it — the imprecise whole-batch blame fallback the supervisor
+        # reads when a worker dies without arming a deathnote
+        self._journal = {}
         # jittered sleep before each failover retry: after a mass event
         # (worker death under load) every relay would otherwise hammer
         # the survivors in the same instant
@@ -145,6 +162,7 @@ class RouterServer:
         self._failed = 0
         self._busy = 0
         self._deadline = 0
+        self._quarantined_hits = 0
         self._httpd = ThreadingHTTPServer((host, port),
                                           self._make_handler())
         self._http_thread = threading.Thread(
@@ -187,8 +205,11 @@ class RouterServer:
 
     def _health_payload(self) -> dict:
         """The POOL's health, aggregated: per-worker liveness + occupancy
-        (so one scrape shows a load balancer the whole tier) plus the
-        router's own placement counters."""
+        (so one scrape shows a load balancer the whole tier), the
+        router's own placement counters, and — under supervision — the
+        supervisor's restart/breaker/quarantine report. ``status`` says
+        ``degraded`` while a breaker holds a worker down or a restart is
+        pending: the tier serves, but below its provisioned capacity."""
         workers = self.pool.workers()
         alive = sum(1 for w in workers if w["alive"])
         roles: dict = {}
@@ -201,14 +222,33 @@ class RouterServer:
                             "failed": self._failed,
                             "busy": self._busy,
                             "deadline": self._deadline,
+                            "quarantined": self._quarantined_hits,
                             "max_retries": self.max_retries}
-        return {
-            "status": "ok" if alive else "unavailable",
+        status = "ok" if alive else "unavailable"
+        supervisor = None
+        if self._supervisor is not None:
+            supervisor = self._supervisor.state()
+            # the ledger's full implication lists are forensics
+            # (SUPERVISOR.json / read_incident --index); /health carries
+            # the operator summary
+            q = supervisor.pop("quarantine", {})
+            supervisor["quarantined"] = sorted(q.get("quarantined", ()))
+            supervisor["deaths_recorded"] = q.get("deaths_recorded", 0)
+            degraded = (supervisor["breakers_open"] > 0
+                        or any(not w["alive"]
+                               for w in supervisor["workers"].values()))
+            if alive and degraded:
+                status = "degraded"
+        payload = {
+            "status": status,
             "alive": alive,
             "roles": roles,
             "workers": {str(w["replica_id"]): w for w in workers},
             "router": router_stats,
         }
+        if supervisor is not None:
+            payload["supervisor"] = supervisor
+        return payload
 
     def _models_payload(self) -> dict:
         return {"object": "list",
@@ -339,6 +379,8 @@ class RouterServer:
                 self._busy += 1
             elif outcome == "deadline":
                 self._deadline += 1
+            elif outcome == "quarantined":
+                self._quarantined_hits += 1
 
     def _busy_blocked(self, exclude: Tuple[int, ...]):
         """When placement found no worker, distinguish FULL from DOWN:
@@ -367,6 +409,16 @@ class RouterServer:
 
     def _complete(self, handler, req):
         stream = bool(req.get("stream"))
+        # the request's cluster-wide identity: the client's request_id,
+        # or one stamped here — every upstream hop carries it (the
+        # engine's deathnote names it), the in-flight journal keys on
+        # it, and the quarantine ledger refuses it after 2 worker
+        # deaths. A router-stamped id still contains a crash loop WITHIN
+        # this relay's retry budget; a client-provided id additionally
+        # survives re-submissions.
+        req_id = str(req.get("request_id")
+                     or f"req-{uuid.uuid4().hex[:16]}")
+        req = dict(req, request_id=req_id)
         # relay state survives retries: once SSE headers (or tokens) hit
         # the client socket, a failover must continue the SAME stream —
         # delivered counts the token chunks already written so the
@@ -390,6 +442,15 @@ class RouterServer:
         except (TypeError, ValueError):
             pass   # malformed slo_ms: the worker's 400 will name it
         while attempts <= self.max_retries and hops <= self.max_migrations:
+            if (self._quarantine is not None
+                    and self._quarantine.is_quarantined(req_id)):
+                # poison containment: this rid has now been implicated
+                # in >= 2 distinct worker deaths — typed 422, never
+                # another placement (checked per attempt, so the retry
+                # loop itself stops the serial crash amplification the
+                # moment the second death lands)
+                self._respond_quarantined(handler, state, req_id)
+                return
             if (slo_deadline is not None
                     and time.monotonic() >= slo_deadline):
                 # shed at the router: the budget is spent, so placing
@@ -428,6 +489,7 @@ class RouterServer:
                 mode, pre, serve = plan
                 attempts += 1
                 base = 0
+            self._journal_place(req_id, serve.replica_id)
             if rec.enabled:
                 rec.record(_frec.EV_ROUTER_PLACE,
                            replica_id=serve.replica_id, role=serve.role,
@@ -524,6 +586,15 @@ class RouterServer:
                 last_reason = e.reason
                 if e.dead is not None:
                     self.pool.mark_dead(e.dead.replica_id, "connection")
+                    if self._supervisor is not None:
+                        # blame NOW, before the retry places this rid
+                        # again: the supervisor checks waitpid, reads
+                        # the worker's deathnote (falling back to this
+                        # relay's journal entry) and records the death
+                        # in the quarantine ledger — the loop-top check
+                        # sees a second death immediately
+                        self._supervisor.note_worker_death(
+                            e.dead.replica_id, fallback_rids=(req_id,))
                 if e.dead is not None or mode != "disagg":
                     blame = (serve.replica_id,)
                 else:
@@ -550,10 +621,18 @@ class RouterServer:
                     # relay onto the survivors in the same instant
                     time.sleep(jittered(self.retry_backoff_s))
             finally:
+                self._journal_clear(req_id)
                 self.pool.release(serve)
                 if pre is not None:
                     self.pool.release(pre)
-        # retry budget exhausted (or the pool is empty)
+        # retry budget exhausted (or the pool is empty) — but if this
+        # rid's LAST death is what emptied the pool, the quarantine may
+        # have tripped after the loop-top check: answer the typed 422,
+        # not a 502 (the tier is poisoned-by-this-request, not down)
+        if (self._quarantine is not None
+                and self._quarantine.is_quarantined(req_id)):
+            self._respond_quarantined(handler, state, req_id)
+            return
         self._count_outcome("failed")
         if not state["headers_sent"]:
             if busy is not None:
@@ -591,6 +670,44 @@ class RouterServer:
                 handler.close_connection = True
         else:
             handler._json(502, {"error": msg})
+
+    # ---- poison quarantine ----------------------------------------------
+    def _journal_place(self, req_id: str, replica_id: int):
+        with self._lock:
+            self._journal[req_id] = int(replica_id)
+
+    def _journal_clear(self, req_id: str):
+        with self._lock:
+            self._journal.pop(req_id, None)
+
+    def inflight_on(self, replica_id: int):
+        """Request ids this router currently has placed on ``replica_id``
+        — the supervisor's whole-batch blame fallback when a worker dies
+        without arming a deathnote."""
+        with self._lock:
+            return [rid for rid, r in self._journal.items()
+                    if r == int(replica_id)]
+
+    def _respond_quarantined(self, handler, state: dict, req_id: str):
+        """Answer a quarantined rid typed: 422 ``request_quarantined``
+        before any bytes went out, an error chunk (no [DONE]) mid-stream
+        — and NEVER another placement; the 4xx contract (a bad request
+        is bad on every replica) now extends to requests proven to kill
+        replicas."""
+        self._count_outcome("quarantined")
+        body = {"error": (f"request {req_id} quarantined: implicated in "
+                          "repeated worker crashes; it will not be "
+                          "retried"),
+                "code": "request_quarantined"}
+        if state["headers_sent"]:
+            try:
+                handler._chunk(b"data: " + json.dumps(body).encode()
+                               + b"\n\n")
+                handler._chunk(b"")
+            except OSError:
+                handler.close_connection = True
+        else:
+            handler._json(422, body)
 
     # ---- upstream hops ---------------------------------------------------
     def _respond_deadline(self, handler, state: dict, slo_deadline):

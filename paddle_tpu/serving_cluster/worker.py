@@ -391,6 +391,10 @@ class WorkerServer(CompletionServer):
             if slo <= 0:
                 raise ValueError("slo_ms must be > 0")
             params["slo_ms"] = slo
+        # the router's request identity: the deathnote names it, so
+        # poison blame follows the request across workers and retries
+        if req.get("request_id") is not None:
+            params["request_id"] = str(req["request_id"])
         lp_req = req.get("logprobs")
         want_logprobs = (lp_req is not None and lp_req is not False)
         if want_logprobs:
@@ -533,6 +537,12 @@ def run_worker(cfg: dict):
     engine = ContinuousBatchEngine(model, **cfg.get("engine", {}))
     if injector is not None:
         _chaos.arm_engine(engine, injector)
+    if cfg.get("deathnote"):
+        # supervised worker: arm the pre-dispatch blame record so a
+        # crash mid-dispatch names exactly the rids it died stepping
+        from .supervisor import Deathnote
+
+        engine.deathnote = Deathnote(cfg["deathnote"])
 
     kv_receiver = None
     if role in ("decode", "unified"):

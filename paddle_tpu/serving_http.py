@@ -605,6 +605,11 @@ class CompletionServer:
             "active": stats["requests_active"],
             "queued": stats["requests_queued"],
             "max_batch": eng.max_batch,
+            # the LIVE admission budget — < max_batch after an OOM
+            # degrade (sched.degrade), so a balancer sees the reduced
+            # capacity directly on /health
+            "max_active_slots": stats.get("max_active_slots",
+                                          eng.max_batch),
             "max_len": eng.max_len,
             "stats": stats,
         }
@@ -660,6 +665,11 @@ class CompletionServer:
             if slo <= 0:
                 raise ValueError("slo_ms must be > 0")
             params["slo_ms"] = slo
+        # the caller's request identity (the cluster router stamps one
+        # on every placement): what the engine's deathnote names, so a
+        # poison request is blamed consistently across workers/retries
+        if req.get("request_id") is not None:
+            params["request_id"] = str(req["request_id"])
         # OpenAI "logprobs" is an int 0-5 (0 = chosen-token
         # logprobs, no alternatives) or a bool — False means
         # OFF, any other non-None value (0 included) is ON
@@ -742,14 +752,19 @@ class CompletionServer:
                 # the engine dropped this request from its queue:
                 # siblings of an n>1 submission are cancelled (one
                 # atomic answer), and the status is typed — 429 for a
-                # capacity displacement (retryable backpressure), 504
-                # for a spent deadline (terminal)
+                # capacity displacement or an OOM degrade (both
+                # retryable backpressure; the degrade 429 carries
+                # code=engine_degraded), 504 for a spent deadline
+                # (terminal)
                 self._subs.put(_Cancel(sub))
-                if payload.get("where") == "capacity":
+                if payload.get("where") in ("capacity", "oom"):
                     ra = max(1, round(float(payload.get("retry_after",
                                                         1.0))))
+                    body = {"error": payload["error"]}
+                    if payload["where"] == "oom":
+                        body["code"] = "engine_degraded"
                     return handler._json(
-                        429, {"error": payload["error"]},
+                        429, body,
                         headers=(("Retry-After", str(ra)),))
                 return handler._json(
                     504, {"error": payload["error"],
@@ -839,11 +854,14 @@ class CompletionServer:
                     # tokens flowed — then it ends with a typed error
                     # chunk and no [DONE]
                     if not started:
-                        if payload.get("where") == "capacity":
+                        if payload.get("where") in ("capacity", "oom"):
                             ra = max(1, round(float(
                                 payload.get("retry_after", 1.0))))
+                            body = {"error": payload["error"]}
+                            if payload["where"] == "oom":
+                                body["code"] = "engine_degraded"
                             return handler._json(
-                                429, {"error": payload["error"]},
+                                429, body,
                                 headers=(("Retry-After", str(ra)),))
                         return handler._json(
                             504, {"error": payload["error"],

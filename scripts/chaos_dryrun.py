@@ -91,6 +91,24 @@ def main(argv=None) -> int:
         print(f"  faults fired in {scope}: "
               + (", ".join(f"{f['action']}@{f['point']}#{f['nth']}"
                            for f in fired) or "(none observed)"))
+    print(f"  healed after kill: {report['healed_after_kill']}")
+    print(f"  double-kill restarts: {report['double_kill_restarts']} "
+          f"(streams absorbed: {report['double_kill_streams_ok']}, "
+          f"healed: {report['healed_after_double_kill']})")
+    poison = report.get("poison")
+    if poison is not None:
+        print(f"  poison: status={poison['status']} "
+              f"code={poison['code']} deaths={poison['deaths']} "
+              f"quarantined={poison['quarantined']} "
+              f"(healed after: {report['healed_after_poison']})")
+    sup = report.get("supervisor") or {}
+    print(f"  supervisor: {sup.get('restarts_total', 0)} restarts, "
+          f"{sup.get('breakers_open', 0)} breakers open")
+    post = report.get("post_heal_load")
+    if post is not None:
+        print(f"  post-heal load: {post['completed']}/{post['n']} "
+              f"completed, 5xx={post['http_5xx']}, "
+              f"untyped={post['untyped']}")
     return 0 if report["ok"] else 1
 
 

@@ -13,6 +13,13 @@ Usage:
     python scripts/read_incident.py incident-....json --events 40
     python scripts/read_incident.py incident-....json --subsystem engine
     python scripts/read_incident.py incident-....json --timeline
+    python scripts/read_incident.py --index incidents/
+
+``--index DIR`` renders the CLUSTER-level view the worker supervisor
+maintains instead of one bundle: the ``INDEX.jsonl`` bundle index (one
+line per incident bundle swept from the workers' incident dir) and the
+SUPERVISOR section from ``SUPERVISOR.json`` — restart history per
+worker, circuit-breaker state, and the poison-quarantine ledger.
 """
 from __future__ import annotations
 
@@ -257,6 +264,82 @@ def format_chaos(b: dict, last: int = 20) -> List[str]:
     return lines
 
 
+def format_supervisor(state: dict) -> List[str]:
+    """The SUPERVISOR section: restart history, breaker state and the
+    quarantine ledger (from SUPERVISOR.json — the supervisor rewrites it
+    on every incident sweep)."""
+    if not state:
+        return []
+    lines = [f"SUPERVISOR ({state.get('restarts_total', 0)} restarts, "
+             f"{state.get('breakers_open', 0)} breakers open, "
+             f"{state.get('quarantined_total', 0)} quarantined)"]
+    for rid, w in sorted((state.get("workers") or {}).items()):
+        br = w.get("breaker") or {}
+        br_s = ("OPEN" if br.get("open")
+                else f"closed ({br.get('restarts_in_window', 0)}/"
+                     f"{br.get('threshold', '?')} in "
+                     f"{br.get('window_s', '?')}s)")
+        lines.append(
+            f"  worker {rid}: incarnation {w.get('incarnation', 0)}, "
+            f"{'alive' if w.get('alive') else 'DOWN'}"
+            + (" [HELD OPEN]" if w.get("held_open") else "")
+            + f", breaker {br_s}")
+        for r in (w.get("restarts") or [])[-5:]:
+            when = time.strftime("%H:%M:%S",
+                                 time.localtime(r.get("ts", 0)))
+            lines.append(f"    restart at {when}: exit {r.get('exit')} "
+                         f"(incarnation {r.get('incarnation')}, backoff "
+                         f"{r.get('delay_s')}s)")
+    q = state.get("quarantine") or {}
+    for rid, rec in sorted((q.get("quarantined") or {}).items()):
+        lines.append(f"  QUARANTINED rid {rid}: {rec.get('deaths')} "
+                     f"deaths on workers {rec.get('replicas')}")
+    for rid, recs in sorted((q.get("implicated") or {}).items()):
+        if rid in (q.get("quarantined") or {}):
+            continue
+        lines.append(f"  implicated rid {rid}: "
+                     f"{len(recs)} death(s) on workers "
+                     f"{sorted({r.get('replica_id') for r in recs})}")
+    return lines
+
+
+def render_index(directory: str, last: int = 30) -> str:
+    """The cluster-level view: INDEX.jsonl entries + SUPERVISOR.json."""
+    sections: List[List[str]] = []
+    index_path = os.path.join(directory, "INDEX.jsonl")
+    entries = []
+    try:
+        with open(index_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    entries.append(json.loads(line))
+    except OSError:
+        pass
+    lines = [f"INCIDENT INDEX  {index_path} "
+             f"({len(entries)} bundles indexed)"]
+    for e in entries[-last:]:
+        when = (time.strftime("%Y-%m-%d %H:%M:%S",
+                              time.localtime(e["ts"]))
+                if isinstance(e.get("ts"), (int, float)) else "?")
+        lines.append(f"  {when}  {e.get('reason', '?'):<12} "
+                     f"pid={e.get('pid')} rank={e.get('rank')}  "
+                     f"{e.get('file')}"
+                     + (f"  [{e['error']}]" if e.get("error") else ""))
+    if not entries:
+        lines.append("  (no bundles indexed yet)")
+    sections.append(lines)
+    sup_path = os.path.join(directory, "SUPERVISOR.json")
+    try:
+        with open(sup_path, encoding="utf-8") as f:
+            sections.append(format_supervisor(json.load(f)))
+    except OSError:
+        sections.append([f"(no SUPERVISOR.json in {directory})"])
+    except ValueError as e:
+        sections.append([f"(unreadable SUPERVISOR.json: {e})"])
+    return "\n".join("\n".join(s) for s in sections if s)
+
+
 def format_spans(b: dict, last: int = 10) -> List[str]:
     spans = b.get("spans") or []
     if not spans:
@@ -292,7 +375,12 @@ def render(b: dict, events: int = 30, per_subsystem: int = 5,
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="read_incident", description=__doc__)
-    p.add_argument("bundle", help="path to an incident-*.json bundle")
+    p.add_argument("bundle", nargs="?",
+                   help="path to an incident-*.json bundle")
+    p.add_argument("--index", metavar="DIR",
+                   help="render a supervisor incident directory "
+                        "(INDEX.jsonl + SUPERVISOR.json) instead of "
+                        "one bundle")
     p.add_argument("--events", type=int, default=30,
                    help="timeline length (default 30)")
     p.add_argument("--per-subsystem", type=int, default=5,
@@ -305,6 +393,11 @@ def main(argv=None) -> int:
                    help="timeline only (skip subsystem/engine/thread "
                         "sections)")
     args = p.parse_args(argv)
+    if args.index:
+        print(render_index(args.index, last=args.events))
+        return 0
+    if not args.bundle:
+        p.error("a bundle path (or --index DIR) is required")
     try:
         b = load_bundle(args.bundle)
     except (OSError, ValueError, json.JSONDecodeError) as e:

@@ -58,6 +58,55 @@ def test_fault_plan_roundtrip_and_validation():
         Fault("pool.probe", "probe_fail", nth=0)
 
 
+def test_injector_incarnation_scoping_and_crash_on_rid():
+    """Incarnation-scoped faults target ONE life of a supervised worker
+    (default 0 = the original process, so a planned kill never re-fires
+    in the respawn it caused; None = any), and crash_on_rid matches on
+    the poison rid entering the dispatch instead of the arrival count."""
+    plan = FaultPlan(seed=0, faults=[
+        Fault("worker.step", "kill", nth=1, scope="w"),            # inc 0
+        Fault("worker.step", "kill", nth=2, scope="w",
+              incarnation=1),
+        Fault("engine.dispatch", "crash_on_rid", detail="poison",
+              incarnation=None),
+    ])
+    # original process: only the incarnation-0 kill arms
+    inj0 = ChaosInjector(plan, scope="w", incarnation=0)
+    assert inj0.fire("worker.step").action == "kill"
+    assert inj0.fire("worker.step") is None       # inc-1 fault invisible
+    # the respawn: its own kill at ITS 2nd step, not the spent one
+    inj1 = ChaosInjector(plan, scope="w", incarnation=1)
+    assert inj1.fire("worker.step") is None
+    assert inj1.fire("worker.step").action == "kill"
+    # crash_on_rid: fires in ANY incarnation, only when the rid rides
+    inj2 = ChaosInjector(plan, scope="w", incarnation=7)
+    assert inj2.fire("engine.dispatch", rids=("a", "b")) is None
+    hit = inj2.fire("engine.dispatch", rids=("a", "poison"))
+    assert hit is not None and hit.action == "crash_on_rid"
+    assert inj2.fire("engine.dispatch", rids=("poison",)) is None  # spent
+    # round-trip preserves the new fields
+    again = FaultPlan.loads(plan.dumps())
+    assert again.faults[1].incarnation == 1
+    assert again.faults[2].incarnation is None
+    assert again.faults[2].detail == "poison"
+    with pytest.raises(ValueError, match="crash_on_rid needs detail"):
+        Fault("engine.dispatch", "crash_on_rid")
+    # env-driven incarnation selection (what the supervisor exports)
+    import os as _os
+
+    from paddle_tpu.chaos import inject as _inj
+
+    _os.environ[_inj.ENV_PLAN] = plan.dumps()
+    _os.environ[_inj.ENV_INCARNATION] = "1"
+    try:
+        inj = chaos.install_from_env(scope="w")
+        assert inj.incarnation == 1
+    finally:
+        _os.environ.pop(_inj.ENV_PLAN, None)
+        _os.environ.pop(_inj.ENV_INCARNATION, None)
+        chaos.uninstall()
+
+
 def test_injector_fires_on_nth_arrival_once_scoped():
     plan = FaultPlan(seed=0, faults=[
         Fault("kv_handoff.send", "drop", nth=3, scope="worker:0"),
@@ -194,11 +243,13 @@ def test_mark_busy_backoff_is_jittered():
 # ---- THE chaos gate ---------------------------------------------------------
 
 def test_chaos_dryrun_gate():
-    """Tier-1 robustness gate: the real multi-process cluster under the
-    fixed-seed default plan, WITH generated open-loop load flowing
-    while the faults fire (not idle hand-built streams). Worker kill +
-    handoff drop + handoff corruption + heartbeat stall + injected
-    router 5xx, one run:
+    """Tier-1 robustness gate: the real multi-process SUPERVISED cluster
+    under the fixed-seed default plan, WITH generated open-loop load
+    flowing while the faults fire (not idle hand-built streams). Worker
+    kill + handoff drop + handoff corruption + heartbeat stall +
+    injected router 5xx, then the self-healing story — restart, a
+    double-kill, a poison request, a post-heal capacity replay — in ONE
+    run:
 
     - every gate stream completes token-identical with a clean [DONE];
     - zero client-visible 5xx (every injected fault was absorbable) —
@@ -214,8 +265,22 @@ def test_chaos_dryrun_gate():
       inside the wait window) the failover re-place path took over —
       either way the stream stayed token-identical;
     - the heartbeat-stalled worker was reaped and rejoined on a fresh
-      lease; the killed worker exited with the planned code."""
-    from paddle_tpu.chaos.dryrun import default_plan, run_dryrun
+      lease (its PROCESS never died — the supervisor must not restart a
+      stall); the killed worker exited with the planned code;
+    - SELF-HEALING: the supervisor restarted the killed worker (same
+      replica id, fresh lease/port) and pool capacity returned to all 3
+      workers; the plan's incarnation-1 DOUBLE-KILL fired in the
+      restarted worker and healed again, with every stream driven
+      through that window absorbed token-identical;
+    - POISON CONTAINMENT: the crash_on_rid request killed at most 2
+      workers before the quarantine refused it with exactly one typed
+      422 code=request_quarantined; NO innocent rid was quarantined
+      (deathnote blame precision at cluster level);
+    - POST-HEAL CAPACITY: a seeded open-loop burst at the same offered
+      rate against the healed tier completed with typed-only outcomes
+      and zero 5xx — capacity recovered, not merely survived."""
+    from paddle_tpu.chaos.dryrun import (POISON_RID, default_plan,
+                                         run_dryrun)
 
     report = run_dryrun(default_plan(seed=0), load_qps=6.0,
                         load_duration_s=4.0)
@@ -240,6 +305,36 @@ def test_chaos_dryrun_gate():
     assert ("kv_handoff.send", "drop") in w0, fired
     assert ("kv_handoff.send", "corrupt") in w0, fired
     assert ("worker.request", "stall_heartbeat") in w0, fired
+
+    # self-healing: restart -> heal -> double-kill -> heal
+    assert report["healed_after_kill"], report
+    assert report["double_kill_restarts"] >= 2, report
+    assert report["double_kill_streams_ok"], report
+    assert report["healed_after_double_kill"], report
+    sup = report["supervisor"]
+    assert sup["restarts_total"] >= 2, sup
+    assert sup["breakers_open"] == 0, sup   # planned chaos != crash loop
+    # the stall leg proves restart is death-triggered: worker:0 stalled
+    # its HEARTBEAT but never died, so it was reaped+rejoined, NOT
+    # restarted
+    assert sup["workers"]["0"]["incarnation"] == 0, sup
+
+    # poison containment: <= 2 worker deaths, exactly one typed 422,
+    # only the poison rid in the quarantine ledger
+    poison = report["poison"]
+    assert poison is not None, report
+    assert poison["status"] == 422, poison
+    assert poison["code"] == "request_quarantined", poison
+    assert poison["deaths"] <= 2, poison
+    assert poison["quarantined"] == [POISON_RID], poison
+    assert report["healed_after_poison"], report
+
+    # post-heal capacity at the offered rate: typed-only, zero 5xx
+    post = report["post_heal_load"]
+    assert post is not None and post["completed"] > 0, post
+    assert post["http_5xx"] == 0 and post["untyped"] == 0, post
+    assert post["timed_out"] == 0, post
+
     assert report["ok"], report
 
     # the generated-load leg: traffic flowed WHILE the faults fired,
@@ -256,3 +351,8 @@ def test_chaos_dryrun_gate():
     assert stack["requests_shed"] == stack["deadline_misses"], stack
     if load["shed_504"]:
         assert stack["deadline_misses"] > 0, (load, stack)
+    # the harness now records the healing counters off the router's
+    # supervisor section: the window saw restarts, zero quarantines
+    # (the poison leg runs after the load window)
+    after = load.get("stack")
+    assert "worker_restarts" in after and "requests_quarantined" in after

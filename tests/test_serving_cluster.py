@@ -608,8 +608,12 @@ def unified_cluster():
     os.environ["FLAGS_lock_witness"] = "1"
     set_flags({"lock_witness": True})
     try:
+        # supervise=False: this module's failover gate pins the PR-6
+        # semantics (a killed worker STAYS dead and the survivor carries
+        # the streams) — the supervised kill→restart→heal→quarantine
+        # story has its own referee in the chaos dryrun gate
         cluster = launch_cluster(_cluster_cfg(
-            [{"role": "unified", "count": 2}]))
+            [{"role": "unified", "count": 2}]), supervise=False)
     except BaseException:
         os.environ.pop("FLAGS_lock_witness", None)
         set_flags({"lock_witness": False})

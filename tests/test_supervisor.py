@@ -1,0 +1,547 @@
+"""Self-healing cluster: worker supervision, crash-loop containment,
+and poison-request quarantine (the supervisor PR's unit tier).
+
+Covers the fake-clock backoff/breaker contracts (bounds, jitter range,
+window expiry, reset on sustained health), deathnote blame precision
+(batch of 4, only the poison rid quarantined), the quarantine ledger's
+death-key dedupe, graceful OOM degradation in the engine
+(shed-typed + durable max_active_slots shrink + sched.degrade), the
+supervisor's process-level restart/hold-open behavior over real (tiny)
+subprocesses, the cluster incident index + read_incident --index, and
+the router's 422 request_quarantined contract. The multi-process
+kill→restart→heal→quarantine story is refereed by the chaos dryrun gate
+(tests/test_chaos.py)."""
+import json
+import http.client
+import os
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import flightrecorder as frec
+from paddle_tpu.serving import ContinuousBatchEngine
+from paddle_tpu.serving_cluster.supervisor import (
+    CircuitBreaker, Deathnote, QuarantineLedger, RestartBackoff,
+    WorkerSupervisor)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ref_model(layers=2):
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=layers))
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    return ContinuousBatchEngine(model, **kw)
+
+
+# ---- restart backoff ---------------------------------------------------------
+
+def test_backoff_exponential_bounds_and_jitter():
+    b = RestartBackoff(base_s=0.5, max_s=8.0, factor=2.0,
+                       jitter_frac=0.5, rng=random.Random(0))
+    # attempt k's nominal delay is min(8, 0.5 * 2^k), jittered ±50%
+    for k in range(8):
+        d = b.next_delay()
+        nominal = min(8.0, 0.5 * (2.0 ** k))
+        assert nominal * 0.5 - 1e-9 <= d <= nominal * 1.5 + 1e-9, (k, d)
+    # the ladder is capped, not unbounded
+    assert b.next_delay() <= 8.0 * 1.5 + 1e-9
+    # jitter actually spreads (a constant would re-synchronize a mass
+    # restart): many samples at one attempt level cover > half the band
+    samples = []
+    for _ in range(500):
+        bb = RestartBackoff(base_s=1.0, max_s=1.0, jitter_frac=0.5,
+                            rng=random.Random(len(samples)))
+        samples.append(bb.next_delay())
+    assert min(samples) >= 0.5 - 1e-9 and max(samples) <= 1.5 + 1e-9
+    assert max(samples) - min(samples) > 0.5
+    # reset() starts the ladder over
+    b.reset()
+    assert b.attempt == 0
+    assert b.next_delay() <= 0.5 * 1.5 + 1e-9
+
+
+# ---- circuit breaker (fake clock) -------------------------------------------
+
+def test_breaker_trips_at_threshold_within_window():
+    clock = [0.0]
+    b = CircuitBreaker(threshold=3, window_s=60.0, clock=lambda: clock[0])
+    assert b.allow() and b.allow() and b.allow()   # 3 restarts budgeted
+    assert not b.allow()                            # 4th trips OPEN
+    assert b.is_open
+    # open HOLDS: later arrivals stay refused, even past the window
+    clock[0] = 1000.0
+    assert not b.allow()
+    st = b.state()
+    assert st["open"] and st["threshold"] == 3
+
+
+def test_breaker_window_expiry_and_sustained_health_reset():
+    clock = [0.0]
+    b = CircuitBreaker(threshold=2, window_s=10.0, clock=lambda: clock[0])
+    assert b.allow()           # t=0
+    clock[0] = 6.0
+    assert b.allow()           # t=6: 2 in window — at budget
+    # sustained health: stamps age out of the sliding window, so the
+    # breaker never trips and the full budget returns
+    clock[0] = 17.0            # t=17: both stamps (0, 6) expired
+    assert b.allow() and not b.is_open
+    assert b.state()["restarts_in_window"] == 1
+    # ... but a burst inside one window still trips
+    assert b.allow()
+    assert not b.allow() and b.is_open
+    b.reset()
+    assert not b.is_open and b.allow()
+
+
+def test_breaker_validates_threshold():
+    with pytest.raises(ValueError, match="threshold"):
+        CircuitBreaker(threshold=0)
+
+
+# ---- deathnote + quarantine ledger ------------------------------------------
+
+def test_deathnote_arm_read_clear(tmp_path):
+    path = str(tmp_path / "dn" / "deathnote-0.json")
+    dn = Deathnote(path)
+    assert Deathnote.read(path) is None          # absent between steps
+    dn.arm(["poison", "a", "b"])
+    assert Deathnote.read(path) == ["poison", "a", "b"]
+    dn.arm(["c"])                                # re-arm replaces
+    assert Deathnote.read(path) == ["c"]
+    dn.clear()
+    assert Deathnote.read(path) is None
+    dn.clear()                                   # idempotent
+    # unreadable mid-write garbage reads as None, not a crash
+    with open(path, "w") as f:
+        f.write("{not json")
+    assert Deathnote.read(path) is None
+
+
+def test_ledger_blame_precision_batch_of_4():
+    """THE deathnote-precision scenario: the poison rid is co-batched
+    with 3 innocents when it kills worker A (all 4 implicated once);
+    its second victim's deathnote names ONLY the poison — so exactly
+    one rid crosses the 2-death threshold and the innocents, who
+    finished elsewhere, are never quarantined."""
+    led = QuarantineLedger()
+    newly = led.record_death(0, death_key=1111,
+                             rids=["poison", "a", "b", "c"])
+    assert newly == []                      # one death implicates, only
+    assert led.quarantined() == []          # two quarantine
+    newly = led.record_death(1, death_key=2222, rids=["poison"])
+    assert newly == ["poison"]
+    assert led.is_quarantined("poison")
+    for innocent in ("a", "b", "c"):
+        assert not led.is_quarantined(innocent)
+    snap = led.snapshot()
+    assert snap["quarantined"]["poison"]["replicas"] == [0, 1]
+    assert len(snap["implicated"]["a"]) == 1
+
+
+def test_ledger_dedupes_same_death_key():
+    """A death observed twice — by the router's broken socket AND the
+    monitor's waitpid — must count ONCE per rid: the dedupe key is the
+    dead child's pid."""
+    led = QuarantineLedger()
+    led.record_death(0, death_key=777, rids=["r"])
+    led.record_death(0, death_key=777, rids=["r"])  # same pid re-blamed
+    assert not led.is_quarantined("r")
+    assert len(led.snapshot()["implicated"]["r"]) == 1
+    led.record_death(1, death_key=888, rids=["r"])
+    assert led.is_quarantined("r")
+
+
+# ---- engine: deathnote arming at dispatch boundaries ------------------------
+
+class _RecordingNote(Deathnote):
+    def __init__(self, path):
+        super().__init__(path)
+        self.armed = []
+
+    def arm(self, rids):
+        self.armed.append(list(rids))
+        super().arm(rids)
+
+
+def test_engine_arms_deathnote_per_dispatch(tmp_path):
+    """The deathnote names exactly the rids entering each dispatch —
+    the admitting request alone at its prefill, the full active batch
+    at each decode step — and is ERASED once the step succeeds."""
+    eng = _engine(_ref_model())
+    dn = _RecordingNote(str(tmp_path / "deathnote-0.json"))
+    eng.deathnote = dn
+    rids = [eng.add_request([i + 1, i + 2, i + 3], max_new_tokens=3,
+                            request_id=f"req-{i}") for i in range(4)]
+    assert len(rids) == 4
+    eng.run_until_done()
+    # admission arms: each request was armed ALONE at its prefill
+    solo_arms = [a for a in dn.armed if len(a) == 1]
+    assert [a[0] for a in solo_arms[:4]] == [f"req-{i}" for i in range(4)]
+    # decode arms: the full batch of 4 rode at least one step together
+    assert ["req-0", "req-1", "req-2", "req-3"] in dn.armed
+    # erased on success — no stale blame after the engine drained
+    assert Deathnote.read(dn.path) is None
+
+
+def test_engine_deathnote_falls_back_to_engine_rids(tmp_path):
+    """Requests without a caller request_id are named rid:<engine rid>
+    so the blame record is never silently empty."""
+    eng = _engine(_ref_model())
+    dn = _RecordingNote(str(tmp_path / "deathnote-1.json"))
+    eng.deathnote = dn
+    rid = eng.add_request([1, 2, 3], max_new_tokens=2)
+    eng.run_until_done()
+    assert [f"rid:{rid}"] in dn.armed
+
+
+# ---- engine: graceful OOM degradation ---------------------------------------
+
+def _oom_error():
+    return RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "1234567 bytes")
+
+
+def test_step_oom_sheds_typed_and_shrinks_budget(monkeypatch):
+    """An XLA OOM during the decode dispatch must NOT kill the engine
+    loop: the most recently admitted slot is shed typed (where=oom),
+    max_active_slots durably shrinks, sched.degrade is recorded, and
+    the surviving slots keep decoding."""
+    import paddle_tpu.serving as S
+
+    rec = frec.get_recorder()
+    rec.enable()
+    since = rec.stats()["recorded"]
+    eng = _engine(_ref_model())
+    shed = []
+    r_old = eng.add_request([1, 2, 3], max_new_tokens=4)
+    r_new = eng.add_request([4, 5, 6], max_new_tokens=4,
+                            request_id="victim",
+                            on_shed=lambda rid, info: shed.append(info))
+    orig = S._get_select_decode
+    state = {"boomed": False}
+
+    def flaky(*a, **kw):
+        if not state["boomed"]:
+            state["boomed"] = True
+
+            def raise_oom(*aa, **kk):
+                raise _oom_error()
+
+            return raise_oom
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(S, "_get_select_decode", flaky)
+    done = eng.run_until_done()
+    # the older slot survived and finished; the marginal one was shed
+    assert r_old in done
+    assert r_new not in done
+    assert eng.finish_reason(r_new) == "shed"
+    assert shed and shed[0]["where"] == "oom"
+    assert "retry_after" in shed[0]
+    # durable shrink, floor respected, visible on every surface
+    assert eng.max_active_slots == 1
+    assert eng.stats()["max_active_slots"] == 1
+    assert eng.stats()["requests_degraded"] == 1
+    assert eng.debug_state()["max_active_slots"] == 1
+    evs = [e for e in rec.events(since=since)
+           if e["kind"] == "sched.degrade"]
+    assert evs and evs[0]["where"] == "step"
+    assert evs[0]["max_active_slots"] == 1
+    shed_evs = [e for e in rec.events(since=since)
+                if e["kind"] == "sched.shed" and e.get("where") == "oom"]
+    assert shed_evs and shed_evs[0]["rid"] == r_new
+
+
+def test_admission_oom_sheds_admitting_request(monkeypatch):
+    """An OOM in the admission prefill sheds the ADMITTING request (the
+    trigger), not an already-serving slot, and later admissions respect
+    the reduced budget."""
+    eng = _engine(_ref_model())
+    r1 = eng.add_request([1, 2, 3], max_new_tokens=3)
+    eng.step()                       # r1 active
+    orig = eng._bucketed_prefill
+    state = {"boomed": False}
+
+    def flaky(req):
+        if not state["boomed"]:
+            state["boomed"] = True
+            raise _oom_error()
+        return orig(req)
+
+    shed = []
+    eng._bucketed_prefill = flaky
+    r2 = eng.add_request([4, 5, 6], max_new_tokens=3,
+                         on_shed=lambda rid, info: shed.append(info))
+    done = eng.run_until_done()
+    assert r1 in done and r2 not in done
+    assert shed and shed[0]["where"] == "oom"
+    # occupancy was 1 active + 1 admitting -> budget shrinks to 1
+    assert eng.max_active_slots == 1
+    # the reduced budget GATES admission: with one slot busy, a queued
+    # request waits instead of taking a second slot
+    r3 = eng.add_request([7, 8, 9], max_new_tokens=2)
+    r4 = eng.add_request([7, 8, 10], max_new_tokens=2)
+    eng.step()
+    assert eng.num_active <= 1
+    done = eng.run_until_done()
+    assert r3 in done and r4 in done   # served, serially
+
+
+def test_oom_budget_floor_is_one(monkeypatch):
+    """Repeated OOMs can never shrink the budget below one slot."""
+    eng = _engine(_ref_model())
+    orig = eng._bucketed_prefill
+    state = {"booms": 3}
+
+    def flaky(req):
+        if state["booms"] > 0:
+            state["booms"] -= 1
+            raise _oom_error()
+        return orig(req)
+
+    eng._bucketed_prefill = flaky
+    outs = []
+    for i in range(4):
+        outs.append(eng.add_request([i + 1, i + 2], max_new_tokens=2))
+    done = eng.run_until_done()
+    assert eng.max_active_slots == 1
+    assert len(done) == 1              # three shed, the last one served
+
+
+# ---- supervisor over real (tiny) subprocesses -------------------------------
+
+def _sleep_spawn(replica_id, incarnation):
+    return subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"])
+
+
+def _wait(pred, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_supervisor_restarts_dead_worker(tmp_path):
+    rec = frec.get_recorder()
+    rec.enable()
+    since = rec.stats()["recorded"]
+    sup = WorkerSupervisor(state_dir=str(tmp_path),
+                           backoff_base_s=0.05, backoff_max_s=0.2,
+                           poll_interval_s=0.05, healthy_reset_s=0.5)
+    p0 = _sleep_spawn(0, 0)
+    sup.adopt(0, _sleep_spawn, p0)
+    sup.start()
+    try:
+        p0.kill()
+        assert _wait(lambda: (sup.proc(0) is not None
+                              and sup.proc(0).pid != p0.pid
+                              and sup.proc(0).poll() is None))
+        st = sup.state()
+        w = st["workers"]["0"]
+        assert w["incarnation"] == 1 and w["alive"]
+        assert len(w["restarts"]) == 1
+        assert st["restarts_total"] == 1
+        evs = [e for e in rec.events(since=since)
+               if e["kind"] == "sup.restart"]
+        assert evs and evs[0]["replica_id"] == 0
+        assert evs[0]["incarnation"] == 1
+    finally:
+        sup.close()
+    # close() reaped everything: no zombies, no survivors
+    assert sup.proc(0) is None or sup.proc(0).poll() is not None
+
+
+def test_supervisor_breaker_holds_crash_loop(tmp_path):
+    rec = frec.get_recorder()
+    rec.enable()
+    since = rec.stats()["recorded"]
+    sup = WorkerSupervisor(state_dir=str(tmp_path),
+                           backoff_base_s=0.05, backoff_max_s=0.1,
+                           poll_interval_s=0.05,
+                           breaker_threshold=1, breaker_window_s=60.0)
+    p0 = _sleep_spawn(0, 0)
+    sup.adopt(0, _sleep_spawn, p0)
+    sup.start()
+    try:
+        p0.kill()
+        # restart #1 is within budget...
+        assert _wait(lambda: sup.state()["workers"]["0"]["incarnation"]
+                     == 1)
+        assert _wait(lambda: sup.proc(0) is not None
+                     and sup.proc(0).poll() is None)
+        # ...the second death trips the breaker: held open, no respawn
+        sup.proc(0).kill()
+        assert _wait(lambda: sup.state()["workers"]["0"]["held_open"])
+        time.sleep(0.3)
+        st = sup.state()["workers"]["0"]
+        assert st["incarnation"] == 1 and not st["alive"]
+        assert st["breaker"]["open"]
+        assert sup.state()["breakers_open"] == 1
+        evs = [e for e in rec.events(since=since)
+               if e["kind"] == "sup.breaker_open"]
+        assert evs and evs[0]["replica_id"] == 0
+        # operator reset: breaker closes and the worker respawns
+        sup.reset_breaker(0)
+        assert _wait(lambda: sup.state()["workers"]["0"]["alive"])
+        assert sup.state()["workers"]["0"]["incarnation"] == 2
+    finally:
+        sup.close()
+
+
+def test_supervisor_blames_via_deathnote_then_journal(tmp_path):
+    """note_worker_death prefers the deathnote (precise) and falls back
+    to the router journal; both dedupe on the dead pid; a live process
+    is never blamed (connection blip != crash)."""
+    sup = WorkerSupervisor(state_dir=str(tmp_path), poll_interval_s=5.0)
+    p0 = _sleep_spawn(0, 0)
+    sup.adopt(0, _sleep_spawn, p0)
+    # alive process: a broken socket alone records nothing
+    assert sup.note_worker_death(0, fallback_rids=("x",)) is False
+    assert sup.ledger.snapshot()["implicated"] == {}
+    # dead with a deathnote: precise blame, fallback ignored
+    Deathnote(sup.deathnote_path(0)).arm(["poison"])
+    p0.kill()
+    p0.wait(timeout=10)
+    assert sup.note_worker_death(0, fallback_rids=("journal-rid",))
+    snap = sup.ledger.snapshot()
+    assert list(snap["implicated"]) == ["poison"]
+    # the deathnote was consumed
+    assert Deathnote.read(sup.deathnote_path(0)) is None
+    # second observation of the same pid: deduped
+    assert sup.note_worker_death(0, fallback_rids=("poison",))
+    assert len(snap["implicated"]["poison"]) == 1
+    # a fresh incarnation dying WITHOUT a deathnote blames the journal
+    sup._workers[0].proc = p1 = _sleep_spawn(0, 1)
+    sup.inflight_fn = lambda replica: ["journal-rid"]
+    p1.kill()
+    p1.wait(timeout=10)
+    assert sup.note_worker_death(0)
+    assert "journal-rid" in sup.ledger.snapshot()["implicated"]
+    sup.close()
+
+
+def test_supervisor_incident_sweep_and_read_incident_index(
+        tmp_path, capsys):
+    import importlib.util
+
+    inc = tmp_path / "incidents"
+    inc.mkdir()
+    for i, reason in enumerate(("xla_oom", "signal")):
+        (inc / f"incident-2026-00{i}-{reason}.json").write_text(
+            json.dumps({"reason": reason, "context": f"c{i}",
+                        "ts": 1700000000.0 + i, "pid": 100 + i,
+                        "rank": None}))
+    (inc / "not-an-incident.txt").write_text("ignored")
+    sup = WorkerSupervisor(incident_dir=str(inc), state_dir=str(inc),
+                           poll_interval_s=5.0)
+    sup.adopt(0, _sleep_spawn, _sleep_spawn(0, 0))
+    sup.ledger.record_death(0, 1, ["p"])
+    sup.ledger.record_death(1, 2, ["p"])
+    assert sup.sweep_incidents() == 2
+    assert sup.sweep_incidents() == 0       # idempotent: already indexed
+    index = [json.loads(ln) for ln in
+             (inc / "INDEX.jsonl").read_text().splitlines()]
+    assert [e["reason"] for e in index] == ["xla_oom", "signal"]
+    state = json.loads((inc / "SUPERVISOR.json").read_text())
+    assert state["quarantined_total"] == 1
+    sup.close()
+
+    spec = importlib.util.spec_from_file_location(
+        "_read_incident_sup", os.path.join(_REPO, "scripts",
+                                           "read_incident.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--index", str(inc)]) == 0
+    out = capsys.readouterr().out
+    assert "INCIDENT INDEX" in out and "2 bundles indexed" in out
+    assert "xla_oom" in out
+    assert "SUPERVISOR" in out
+    assert "QUARANTINED rid p" in out
+    # bundle-less invocation without --index still errors usefully
+    with pytest.raises(SystemExit):
+        mod.main([])
+
+
+# ---- router: 422 request_quarantined ----------------------------------------
+
+def test_router_answers_quarantined_rid_422_without_placement():
+    """A quarantined rid is refused at the door — typed 422
+    code=request_quarantined, zero upstream placements — and an
+    unrelated rid still places normally."""
+    from paddle_tpu.serving_cluster.router import RouterServer
+
+    class _NeverPool:
+        """select() must never be reached for the quarantined rid."""
+
+        def __init__(self):
+            self.selects = 0
+
+        def select(self, roles=None, exclude=()):
+            self.selects += 1
+            return None
+
+        def workers(self):
+            return []
+
+        def refresh_gauges(self):
+            pass
+
+        def get(self, replica_id):
+            return None
+
+        def has_role(self, role):
+            return False
+
+    led = QuarantineLedger()
+    led.record_death(0, 1, ["poison"])
+    led.record_death(1, 2, ["poison"])
+    assert led.is_quarantined("poison")
+    pool = _NeverPool()
+    router = RouterServer(pool, quarantine=led, max_retries=1).start()
+    try:
+        host, port = router.address
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt_token_ids": [1, 2],
+                                 "max_tokens": 2,
+                                 "request_id": "poison"}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 422, body
+        assert body["code"] == "request_quarantined"
+        assert pool.selects == 0
+        # an innocent rid is NOT blocked (it 502s on the empty pool —
+        # the quarantine gate is per-rid, not a tier switch)
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt_token_ids": [1, 2],
+                                 "max_tokens": 2,
+                                 "request_id": "innocent"}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        assert resp.status == 502
+        assert pool.selects >= 1
+        # /health counts the refusals
+        assert router._health_payload()["router"]["quarantined"] == 1
+    finally:
+        router.close()
