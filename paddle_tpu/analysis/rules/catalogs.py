@@ -1,5 +1,5 @@
-"""metrics-catalog / span-catalog / event-catalog: docs and registries
-agree, both ways.
+"""metrics-catalog / span-catalog / event-catalog / alert-catalog: docs
+and registries agree, both ways.
 
 The first two rules are the grown-up form of the original tier-1 lint
 scripts (scripts/check_metrics_catalog.py, check_span_catalog.py),
@@ -17,6 +17,12 @@ re-homed under the pdlint runner; the scripts remain as thin wrappers.
   (flightrecorder.py) against the docs "Event catalog" table the same
   way — documented, registered, and every ``EV_*`` constant actually
   recorded outside flightrecorder.py.
+- **alert-catalog**: the default SLO objectives
+  (``alerts.DEFAULT_OBJECTIVES`` ∪ ``alerts.CLUSTER_OBJECTIVES``)
+  against the docs "Alert catalog" table both ways, PLUS every metric
+  an objective reads must actually exist (a registered family or a
+  declared federated series) — an alert burning against a renamed
+  counter would silently never fire.
 
 The comparison cores are pure functions over parsed dicts so fixture
 tests can exercise drift cases without importing the live registry.
@@ -69,6 +75,25 @@ def compare_event_catalogs(docs: Set[str], registered: Set[str],
                                  noun="event", home="flightrecorder.py")
 
 
+def compare_alert_catalogs(docs: Set[str], registered: Set[str],
+                           metric_refs: Dict[str, List[str]],
+                           known_metrics: Set[str]) -> List[str]:
+    """Docs ↔ objective registries both ways (the shared name-catalog
+    core), plus the alert-specific third leg: every metric an objective
+    reads must exist — in the metrics registry or the declared
+    federated-series set."""
+    problems = compare_name_catalogs(docs, registered, {}, noun="alert",
+                                     home="alerts.py")
+    for name in sorted(metric_refs):
+        for metric in metric_refs[name]:
+            if metric not in known_metrics:
+                problems.append(
+                    f"alert {name!r} reads metric {metric!r}, which is "
+                    "neither a registered metric family nor a declared "
+                    "federated series — the objective can never fire")
+    return problems
+
+
 def compare_name_catalogs(docs: Set[str], registered: Set[str],
                           emitted_ok: Dict[str, bool], noun: str,
                           home: str) -> List[str]:
@@ -116,6 +141,11 @@ def documented_spans(path: str) -> Set[str]:
 def documented_events(path: str) -> Set[str]:
     """Event kinds from the docs "Event catalog" section only."""
     return _documented_names(path, "Event catalog", "kind")
+
+
+def documented_alerts(path: str) -> Set[str]:
+    """Alert names from the docs "Alert catalog" section only."""
+    return _documented_names(path, "Alert catalog", "alert")
 
 
 def _documented_names(path: str, section: str, header_cell: str) -> Set[str]:
@@ -209,6 +239,31 @@ def _referenced_constants(root: str, pattern: str,
             with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
                 used.update(re.findall(pattern, f.read()))
     return used
+
+
+@register_rule
+class AlertCatalogRule(ProjectRule):
+    id = "alert-catalog"
+    rationale = ("a default SLO objective must be documented (operators "
+                 "act on alert names), every documented alert must still "
+                 "exist, and every metric an objective reads must be "
+                 "real — an alert over a renamed counter silently never "
+                 "fires")
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        _bootstrap(root)
+        from paddle_tpu.observability import alerts, get_registry
+
+        docs = documented_alerts(os.path.join(root, _DOCS))
+        objectives = dict(alerts.DEFAULT_OBJECTIVES)
+        objectives.update(alerts.CLUSTER_OBJECTIVES)
+        metric_refs = {n: o.metric_names() for n, o in objectives.items()}
+        known = set(get_registry().names()) | set(alerts.FEDERATED_SERIES)
+        for msg in compare_alert_catalogs(docs, set(objectives),
+                                          metric_refs, known):
+            yield Finding(file=_DOCS.replace(os.sep, "/"), line=1,
+                          rule=self.id, message=msg,
+                          symbol="alert-catalog")
 
 
 @register_rule

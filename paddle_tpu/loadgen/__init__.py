@@ -14,13 +14,13 @@ runbook: docs/SERVING.md "Capacity & overload runbook".
 from .trace import (TraceRequest, dump_trace, dumps_trace, load_trace,
                     loads_trace, trace_digest)
 from .workload import WorkloadSpec, synthesize
-from .harness import (Outcome, find_knee, run_schedule, run_workload,
-                      stack_stats, summarize, sweep)
+from .harness import (Outcome, alerts_state, find_knee, run_schedule,
+                      run_workload, stack_stats, summarize, sweep)
 
 __all__ = [
     "TraceRequest", "dump_trace", "dumps_trace", "load_trace",
     "loads_trace", "trace_digest",
     "WorkloadSpec", "synthesize",
-    "Outcome", "find_knee", "run_schedule", "run_workload",
-    "stack_stats", "summarize", "sweep",
+    "Outcome", "alerts_state", "find_knee", "run_schedule",
+    "run_workload", "stack_stats", "summarize", "sweep",
 ]

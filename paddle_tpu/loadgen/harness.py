@@ -36,7 +36,7 @@ from .trace import TraceRequest, trace_digest
 from .workload import WorkloadSpec, synthesize
 
 __all__ = ["Outcome", "run_schedule", "summarize", "stack_stats",
-           "sweep", "find_knee", "run_workload"]
+           "alerts_state", "sweep", "find_knee", "run_workload"]
 
 #: the stack counters the harness reads before/after a run (summed over
 #: every live worker when the target is the cluster router)
@@ -301,6 +301,29 @@ def stack_stats(url: str, timeout: float = 10.0) -> dict:
         for k in _STACK_KEYS:
             totals[k] += int(stats.get(k, 0) or 0)
     return totals
+
+
+def alerts_state(url: str, timeout: float = 10.0) -> dict:
+    """One ``GET /alerts`` read folded to what a load run cares about:
+    which alerts are firing and how many transitions the alerting layer
+    has made — a saturation run that trips (or fails to trip) an SLO
+    alert is a harness-visible fact, not something to eyeball on a
+    dashboard afterwards."""
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/alerts",
+                                    timeout=timeout) as r:
+            payload = json.loads(r.read())
+    except (OSError, ValueError) as e:
+        get_logger().warning("loadgen: /alerts read failed (%s: %s)",
+                             type(e).__name__, e)
+        return {"enabled": False, "firing": [], "transitions_total": 0,
+                "transitions": []}
+    return {"enabled": bool(payload.get("enabled")),
+            "firing": list(payload.get("firing") or ()),
+            "transitions_total": int(payload.get("transitions_total", 0)),
+            "transitions": [
+                {k: t.get(k) for k in ("alert", "from", "to", "t")}
+                for t in payload.get("transitions") or ()]}
 
 
 def run_workload(url: str, spec: WorkloadSpec, *,

@@ -29,6 +29,10 @@ from . import flightrecorder  # noqa: F401
 from .flightrecorder import (FlightRecorder, IncidentReporter,  # noqa: F401
                              get_recorder, get_reporter, install_reporter,
                              incident_scope, validate_bundle, XlaOom)
+from . import timeseries  # noqa: F401
+from .timeseries import TimeSeriesStore, get_store  # noqa: F401
+from . import alerts  # noqa: F401
+from .alerts import AlertManager, SloObjective  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -38,5 +42,6 @@ __all__ = [
     "get_tracer", "parse_traceparent", "format_traceparent",
     "flightrecorder", "FlightRecorder", "IncidentReporter", "get_recorder",
     "get_reporter", "install_reporter", "incident_scope", "validate_bundle",
-    "XlaOom",
+    "XlaOom", "timeseries", "TimeSeriesStore", "get_store", "alerts",
+    "AlertManager", "SloObjective",
 ]

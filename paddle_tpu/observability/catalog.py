@@ -83,6 +83,15 @@ SERVING_SPEC_ACCEPTED = _R.histogram(
     "hit of its single-draft round)",
     labels=("engine",))
 
+SERVING_SLO_OUTCOMES = _R.counter(
+    "serving_slo_outcomes_total",
+    "Finished requests that carried an slo_ms budget, by whether they "
+    "retired inside it (outcome=good|late) — the goodput-under-SLO "
+    "numerator/denominator the slo_goodput_burn alert burns against "
+    "(requests without an SLO are not counted; deadline SHEDS count "
+    "serving_deadline_misses_total instead)",
+    labels=("engine", "outcome"))
+
 SERVING_SCHED = _R.counter(
     "serving_sched_decisions_total",
     "Scheduler decisions on the serving hot loop "
@@ -146,6 +155,26 @@ REQUESTS_QUARANTINED = _R.counter(
     labels=())
 
 # ---- observability self-telemetry ------------------------------------------
+
+ALERTS_TRANSITIONS = _R.counter(
+    "alerts_transitions_total",
+    "Alert state-machine transitions by objective and destination "
+    "state (to=pending|firing|resolved|ok; ok counts a pending breach "
+    "that cleared before its for_s hold — a suppressed flap). Every "
+    "firing/resolved transition is also an alert.fire/alert.resolve "
+    "flight-recorder event",
+    labels=("alert", "to"))
+
+METRICS_SERIES_DROPPED = _R.counter(
+    "metrics_series_dropped_total",
+    "Updates routed to a family's {overflow=\"true\"} bucket because "
+    "the family hit its label-cardinality cap (max_series, default "
+    "256) — a per-request id leaking into a label shows up HERE "
+    "instead of as unbounded registry growth",
+    labels=("metric",))
+# counting a drop ON the drop counter would recurse into another drop;
+# its own overflow bucket still bounds it (cardinality = family count)
+METRICS_SERIES_DROPPED._count_drops = False
 
 TRACING_SPANS_DROPPED = _R.counter(
     "tracing_spans_dropped_total",

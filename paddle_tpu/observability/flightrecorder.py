@@ -240,6 +240,18 @@ EV_SCHED_DEGRADE = _register(
     " the triggering request was shed typed and max_active_slots "
     "durably shrank (floor 1), so preflight admission sees the reduced "
     "budget")
+EV_ALERT_FIRE = _register(
+    "alert.fire",
+    "an SLO alert crossed pending->firing (alert, manager, severity, "
+    "state_from, detail) — the objective's condition held for its full "
+    "for_s hold; detail carries the burn rates / threshold value that "
+    "fired it")
+EV_ALERT_RESOLVE = _register(
+    "alert.resolve",
+    "a firing SLO alert resolved (alert, manager, severity, "
+    "state_from, detail): the condition stayed clean for the "
+    "objective's resolve_s hold — flaps shorter than the hold never "
+    "produce this pair")
 EV_LOCK_ORDER = _register(
     "lock.order_violation",
     "the runtime lock-order witness (FLAGS_lock_witness) observed an "
@@ -431,6 +443,13 @@ BUNDLE_SCHEMA = {
     # the runtime lock-order witness report (None when FLAGS_lock_witness
     # is off) — observed edges, violations, static cross-check
     "lock_witness": (dict, type(None)),
+    # the recent TSDB window (paddle_tpu.timeseries/1 dump; None when
+    # the time-series store never sampled) — an incident reader sees
+    # the minutes BEFORE the crash, not just the terminal snapshot
+    "timeseries": (dict, type(None)),
+    # every live AlertManager's state + bounded transition history
+    # (None when no manager exists)
+    "alerts": (dict, type(None)),
 }
 
 _EVENT_KEYS = ("seq", "ts", "mono_ns", "kind", "tid")
@@ -438,7 +457,7 @@ _EVENT_KEYS = ("seq", "ts", "mono_ns", "kind", "tid")
 # keys added after paddle_tpu.incident/1 shipped: producers always emit
 # them, but a reader must keep accepting bundles written before they
 # existed (the version string is unchanged — the addition is additive)
-_OPTIONAL_KEYS = frozenset({"lock_witness"})
+_OPTIONAL_KEYS = frozenset({"lock_witness", "timeseries", "alerts"})
 
 
 def validate_bundle(bundle: dict) -> dict:
@@ -483,6 +502,32 @@ def _thread_stacks() -> List[dict]:
                       for ln in traceback.format_stack(frame)],
         })
     return out
+
+
+def _timeseries_window() -> Optional[dict]:
+    """The recent TSDB window for the bundle (None when the store never
+    sampled — alert-free processes and old readers see the same absent
+    shape)."""
+    try:
+        from . import timeseries as _ts
+
+        store = _ts.get_store()
+        if not store.stats()["samples"]:
+            return None
+        return store.dump()
+    except Exception:  # pdlint: disable=silent-exception -- a crash dump must not die on an optional history surface; the bundle just omits it
+        return None
+
+
+def _alerts_state() -> Optional[dict]:
+    """Every live AlertManager's state for the bundle (None when no
+    manager exists)."""
+    try:
+        from . import alerts as _alerts
+
+        return _alerts.snapshot_all()
+    except Exception:  # pdlint: disable=silent-exception -- a crash dump must not die on the alerting layer; the bundle just omits it
+        return None
 
 
 def _witness_report() -> Optional[dict]:
@@ -732,6 +777,8 @@ class IncidentReporter:
             "config": _config_info(),
             "threads": _thread_stacks(),
             "lock_witness": _witness_report(),
+            "timeseries": _timeseries_window(),
+            "alerts": _alerts_state(),
         }
 
     def dump(self, reason: str, exc: Optional[BaseException] = None,

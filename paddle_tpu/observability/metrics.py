@@ -23,8 +23,8 @@ from typing import Dict, Optional, Sequence, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "get_registry", "DEFAULT_LATENCY_BUCKETS", "PROMETHEUS_CONTENT_TYPE",
-    "set_exemplar_provider",
+    "get_registry", "DEFAULT_LATENCY_BUCKETS", "DEFAULT_MAX_SERIES",
+    "PROMETHEUS_CONTENT_TYPE", "set_exemplar_provider",
 ]
 
 # Optional cross-link to the tracing subsystem: when a provider is set
@@ -48,6 +48,17 @@ DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _INF = float("inf")
+
+#: default cap on distinct label-value sets per metric family. A label
+#: mistake (a per-request id leaking into a label) must never OOM a
+#: long-running worker: past the cap, new label sets collapse into ONE
+#: ``{overflow="true"}`` series and ``metrics_series_dropped_total``
+#: counts the updates that landed there.
+DEFAULT_MAX_SERIES = 256
+
+#: sentinel child key for the overflow bucket (never collides with a
+#: real label-values tuple, which is always a tuple of strings)
+_OVERFLOW_KEY = ("__overflow__",)
 
 
 def _fmt(v: float) -> str:
@@ -79,11 +90,18 @@ class _MetricFamily:
 
     kind = "untyped"
 
+    # overflow-routed updates are counted through the registry's
+    # metrics_series_dropped_total family — except ON that family
+    # itself, where counting a drop would recurse into another drop
+    _count_drops = True
+
     def __init__(self, name: str, help_str: str,
-                 label_names: Sequence[str], lock: threading.RLock):
+                 label_names: Sequence[str], lock: threading.RLock,
+                 max_series: int = DEFAULT_MAX_SERIES):
         self.name = name
         self.help = help_str
         self.label_names = tuple(label_names)
+        self.max_series = int(max_series)
         self._lock = lock
         self._children: Dict[Tuple[str, ...], object] = {}
 
@@ -98,9 +116,31 @@ class _MetricFamily:
         key = self._label_key(labels)
         child = self._children.get(key)
         if child is None:
+            # label-cardinality guard: the overflow child does not count
+            # against the cap, so a family is bounded at max_series + 1
+            # children however many distinct label sets arrive
+            n_real = len(self._children) - (_OVERFLOW_KEY in self._children)
+            if key != _OVERFLOW_KEY and n_real >= self.max_series:
+                child = self._children.get(_OVERFLOW_KEY)
+                if child is None:
+                    child = self._new_child()
+                    self._children[_OVERFLOW_KEY] = child
+                if self._count_drops:
+                    self._count_dropped()
+                return child
             child = self._new_child()
             self._children[key] = child
         return child
+
+    def _count_dropped(self):
+        """One overflow-routed update (under the registry RLock — the
+        drop counter lives in the same registry, and re-entrancy is
+        exactly why the registry lock is an RLock). Lazy import: this
+        module cannot import catalog at module scope (catalog imports
+        metrics)."""
+        from .catalog import METRICS_SERIES_DROPPED
+
+        METRICS_SERIES_DROPPED.inc(metric=self.name)
 
     def labels(self, **labels) -> "_BoundMetric":
         """Pre-resolve one label combination (the engines bind their
@@ -112,11 +152,22 @@ class _MetricFamily:
         raise NotImplementedError
 
     def _label_str(self, key: Tuple[str, ...], extra: str = "") -> str:
-        parts = [f'{n}="{_escape_label(v)}"'
-                 for n, v in zip(self.label_names, key)]
+        if key == _OVERFLOW_KEY:
+            # the cardinality-guard bucket renders with the ONE reserved
+            # label instead of the family's schema — the values that
+            # would have gone here are exactly what must not be kept
+            parts = ['overflow="true"']
+        else:
+            parts = [f'{n}="{_escape_label(v)}"'
+                     for n, v in zip(self.label_names, key)]
         if extra:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
+
+    def _labels_dict(self, key: Tuple[str, ...]) -> Dict[str, str]:
+        if key == _OVERFLOW_KEY:
+            return {"overflow": "true"}
+        return dict(zip(self.label_names, key))
 
     def render(self) -> list:
         lines = [f"# HELP {self.name} {_escape_help(self.help)}",
@@ -269,8 +320,10 @@ class Histogram(_MetricFamily):
     kind = "histogram"
 
     def __init__(self, name, help_str, label_names, lock,
-                 buckets: Optional[Sequence[float]] = None):
-        super().__init__(name, help_str, label_names, lock)
+                 buckets: Optional[Sequence[float]] = None,
+                 max_series: int = DEFAULT_MAX_SERIES):
+        super().__init__(name, help_str, label_names, lock,
+                         max_series=max_series)
         edges = tuple(sorted(float(b) for b in
                              (buckets or DEFAULT_LATENCY_BUCKETS)))
         if not edges or any(e != e or e == _INF for e in edges):
@@ -332,13 +385,14 @@ class MetricsRegistry:
     modules silently disagreeing on a schema is exactly the drift this
     subsystem exists to prevent)."""
 
-    def __init__(self):
+    def __init__(self, max_series_per_metric: int = DEFAULT_MAX_SERIES):
         from ..analysis.threads.witness import make_rlock
 
         # one witnessed identity for the registry AND every family (the
         # shared-lock idiom passes this object into each metric)
         self._lock = make_rlock("MetricsRegistry._lock")
         self._families: Dict[str, _MetricFamily] = {}
+        self.max_series_per_metric = int(max_series_per_metric)
 
     def _register(self, cls, name, help_str, labels, **kw):
         with self._lock:
@@ -356,7 +410,8 @@ class MetricsRegistry:
                         f"metric {name!r} already registered with a "
                         "different schema")
                 return existing
-            fam = cls(name, help_str, tuple(labels), self._lock, **kw)
+            fam = cls(name, help_str, tuple(labels), self._lock,
+                      max_series=self.max_series_per_metric, **kw)
             self._families[name] = fam
             return fam
 
@@ -407,7 +462,7 @@ class MetricsRegistry:
                 series = {}
                 for key, child in fam._children.items():
                     skey = ",".join(f"{n}={v}" for n, v
-                                    in zip(fam.label_names, key))
+                                    in fam._labels_dict(key).items())
                     if fam.kind == "histogram":
                         series[skey] = {"sum": child.sum,
                                         "count": child.count,
@@ -419,6 +474,27 @@ class MetricsRegistry:
                     else:
                         series[skey] = child.value
                 out[name] = {"kind": fam.kind, "series": series}
+            return out
+
+    def collect(self) -> list:
+        """One consistent flat sample of every series for the
+        time-series store: ``[(name, kind, labels_dict, value, edges)]``
+        where ``value`` is a float for counter/gauge and ``(count, sum,
+        bucket_counts)`` for a histogram (``edges`` is None for scalar
+        kinds). The overflow bucket samples as ``{overflow: "true"}``."""
+        with self._lock:
+            out = []
+            for name, fam in self._families.items():
+                for key, child in fam._children.items():
+                    labels = fam._labels_dict(key)
+                    if fam.kind == "histogram":
+                        out.append((name, "histogram", labels,
+                                    (child.count, float(child.sum),
+                                     tuple(child.bucket_counts)),
+                                    fam.buckets))
+                    else:
+                        out.append((name, fam.kind, labels,
+                                    float(child.value), None))
             return out
 
     def reset(self):

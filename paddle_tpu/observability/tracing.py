@@ -95,6 +95,12 @@ SPAN_ROUTER_UPSTREAM = _register(
     "child of router.request: ONE placement attempt against one worker "
     "(attrs: replica_id, role, attempt; a retried request records one "
     "per attempt)")
+SPAN_ALERT = _register(
+    "alert.transition",
+    "instant marker dropped by the AlertManager when an alert fires or "
+    "resolves (attrs: alert, from, to, severity) — the alerting "
+    "layer's judgments land on the same timeline as the signals that "
+    "caused them")
 SPAN_TRAIN_STEP = _register(
     "train.step",
     "one train-loop step (observability StepTimer begin/end, and the "

@@ -302,6 +302,13 @@ class _RequestBookkeeping:
     # stats() key stable for seq2seq)
     _n_degraded = 0
 
+    # SLO-outcome counters: finished requests that carried an slo_ms,
+    # split by whether they retired inside it — the goodput-under-SLO
+    # signal the slo_goodput_burn alert burns against (class defaults
+    # so stats() works on engines that never see an SLO)
+    _n_slo_good = 0
+    _n_slo_late = 0
+
     # speculative-decode counters: class defaults so stats() works on
     # engines that never speculate (seq2seq, spec-off decoder engines)
     _n_spec_steps = 0        # multi-token verify dispatches
@@ -361,6 +368,10 @@ class _RequestBookkeeping:
             engine=engine, event="rejected")
         self._m_req_shed = _metrics.SERVING_REQUESTS.labels(
             engine=engine, event="shed")
+        self._m_slo_good = _metrics.SERVING_SLO_OUTCOMES.labels(
+            engine=engine, outcome="good")
+        self._m_slo_late = _metrics.SERVING_SLO_OUTCOMES.labels(
+            engine=engine, outcome="late")
         self._m_deadline = _metrics.SERVING_DEADLINE_MISSES.labels(
             engine=engine)
         self._m_sched_shed = _metrics.SERVING_SCHED.labels(
@@ -424,6 +435,8 @@ class _RequestBookkeeping:
             "deadline_misses": self._n_deadline_misses,
             "requests_migrated_out": self._n_migrated_out,
             "requests_migrated_in": self._n_migrated_in,
+            "requests_slo_good": self._n_slo_good,
+            "requests_slo_late": self._n_slo_late,
             "requests_active": active,
             "requests_queued": queued,
             "requests_prefilling": len(getattr(self, "_chunking", ())),
@@ -448,6 +461,21 @@ class _RequestBookkeeping:
                 self._n_spec_emitted / self._n_spec_slot_rounds
                 if self._n_spec_slot_rounds else 0.0),
         }
+
+    def _count_finished(self, req: "_Request", slo: bool = True):
+        """Retirement accounting shared by every finish site: the
+        lifetime counter plus — when the request carried an slo_ms —
+        the good/late SLO outcome (``slo=False`` skips the SLO split
+        for error retirements, which are neither)."""
+        self._n_finished += 1
+        self._m_req_finished.inc()
+        if slo and req.deadline != math.inf:
+            if time.perf_counter() <= req.deadline:
+                self._n_slo_good += 1
+                self._m_slo_good.inc()
+            else:
+                self._n_slo_late += 1
+                self._m_slo_late.inc()
 
     def debug_state(self) -> dict:
         """Host-side engine state for incident bundles and /debug/dump:
@@ -1649,8 +1677,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         for s in retiring:
             req = self._slots[s]
             self._finished[req.rid] = np.asarray(req.tokens, np.int64)
-            self._n_finished += 1
-            self._m_req_finished.inc()
+            self._count_finished(req)
             self._slots[s] = None
             self._lengths = self._lengths.at[s].set(0)
             self._trace_end(req, "ok")
@@ -1839,8 +1866,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         for s in retiring:
             req = self._slots[s]
             self._finished[req.rid] = np.asarray(req.tokens, np.int64)
-            self._n_finished += 1
-            self._m_req_finished.inc()
+            self._count_finished(req)
             self._slots[s] = None
             self._lengths = self._lengths.at[s].set(0)
             self._trace_end(req, "ok")
@@ -2868,8 +2894,7 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                     # for models whose encoder length derivation differs:
                     # fail THIS request, never the in-flight batch
                     self._finished[req.rid] = np.asarray([], np.int64)
-                    self._n_finished += 1
-                    self._m_req_finished.inc()
+                    self._count_finished(req, slo=False)
                     self._record_reason(req.rid, "error")
                     self._trace_end(req, "error")
                     continue
@@ -2987,8 +3012,7 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                        and t == self.eos_token_id)
             if len(req.tokens) >= req.max_new_tokens or stopped:
                 self._finished[req.rid] = np.asarray(req.tokens, np.int64)
-                self._n_finished += 1
-                self._m_req_finished.inc()
+                self._count_finished(req)
                 self._record_reason(req.rid,
                                     "stop" if stopped else "length")
                 self._slots[s] = None

@@ -161,6 +161,12 @@ class Cluster:
                                world_size=len(worker_specs),
                                job_id=job_id, ttl=ttl)
         self.router: Optional[RouterServer] = None
+        # cluster.ts_interval_s is a SCOPED cadence override: remember
+        # the process store's interval so close() restores it — a
+        # gate-speed cluster (0.25s sampling) torn down inside a larger
+        # process must not leave 4 Hz background sampling behind
+        from ..observability.timeseries import get_store
+        self._prev_ts_interval = get_store().interval_s
         # teardown must run even on an unhandled exit: atexit-armed and
         # idempotent (a second close(), from atexit after an explicit
         # close or a signal, is a no-op)
@@ -179,6 +185,12 @@ class Cluster:
                 self.pool, host=host, port=int(cluster.get("port", 0)),
                 model_name=cluster.get("model_name", "paddle-tpu"),
                 max_retries=int(cluster.get("max_retries", 2)),
+                # cluster watchtower knobs: sampler cadence and the
+                # alert-window scale (the chaos dryrun runs second-scale
+                # windows so fire->resolve is observable in one gate)
+                ts_interval_s=cluster.get("ts_interval_s"),
+                alert_time_scale=float(
+                    cluster.get("alert_time_scale", 1.0)),
                 supervisor=self.supervisor).start()
             if self.supervisor is not None:
                 # the router's in-flight journal is the supervisor's
@@ -276,6 +288,9 @@ class Cluster:
             pass
         if self.router is not None:
             self.router.close()
+        from ..observability.timeseries import get_store
+
+        get_store().set_interval(self._prev_ts_interval)
         self.pool.close()
         if self.supervisor is not None:
             # the supervisor owns the children now: terminate + reap

@@ -58,7 +58,7 @@ class WorkerInfo:
     __slots__ = ("replica_id", "role", "host", "port", "pid", "kv_channel",
                  "alive", "lease_age_s", "active", "queued", "pending",
                  "probe_ok", "marked_dead_at", "busy_until", "draining",
-                 "finished", "probed_at", "drain_rate")
+                 "finished", "probed_at", "drain_rate", "stats")
 
     def __init__(self, replica_id: int, meta: dict):
         self.replica_id = replica_id
@@ -82,6 +82,10 @@ class WorkerInfo:
         self.finished = 0
         self.probed_at: Optional[float] = None
         self.drain_rate: Optional[float] = None  # requests/s, EWMA
+        # the worker's last full stats() snapshot off /health — what the
+        # router's federation collector turns into per-replica
+        # cluster_* time series (empty until the first probe)
+        self.stats: dict = {}
 
     @property
     def url(self) -> str:
@@ -266,6 +270,7 @@ class WorkerPool:
                 w.active = int(health.get("active", 0))
                 w.queued = int(health.get("queued", 0))
                 stats = health.get("stats") or {}
+                w.stats = stats
                 fin = stats.get("requests_finished")
                 if fin is not None:
                     now = time.monotonic()
@@ -402,6 +407,14 @@ class WorkerPool:
     def workers(self) -> List[dict]:
         with self._lock:
             return [w.snapshot() for w in self._workers.values()]
+
+    def worker_stats(self) -> List[Tuple[int, bool, dict]]:
+        """``(replica_id, alive, last stats snapshot)`` per worker — the
+        federation collector's feed (the snapshots are the dicts the
+        probe already fetched; no extra network I/O per sample)."""
+        with self._lock:
+            return [(w.replica_id, w.alive, dict(w.stats))
+                    for w in self._workers.values()]
 
     def alive_count(self) -> int:
         with self._lock:

@@ -29,17 +29,22 @@ import http.client
 import json
 import threading
 import time
+import urllib.request
 import uuid
 from http.server import ThreadingHTTPServer
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 from ..analysis.threads.witness import make_lock
 from ..chaos import inject as _chaos
 from ..distributed.log_utils import get_logger
+from ..observability import alerts as _alerts
 from ..observability import flightrecorder as _frec
+from ..observability import timeseries as _ts
 from ..observability import tracing as _tracing
 from ..observability.catalog import ROUTER_PLACEMENTS
-from ..serving_http import DEADLINE_HEADER, ServingHandlerBase
+from ..observability.metrics import PROMETHEUS_CONTENT_TYPE, get_registry
+from ..serving_http import (DEADLINE_HEADER, ServingHandlerBase,
+                            alerts_payload, timeseries_payload)
 from .pool import WorkerInfo, WorkerPool, jittered
 
 __all__ = ["RouterServer"]
@@ -126,6 +131,9 @@ class RouterServer:
                  retry_backoff_s: float = 0.05,
                  enable_tracing: bool = True,
                  enable_flight_recorder: bool = True,
+                 enable_timeseries: bool = True,
+                 ts_interval_s: Optional[float] = None,
+                 alert_objectives=None, alert_time_scale: float = 1.0,
                  quarantine=None, supervisor=None):
         self.pool = pool
         self.model_name = model_name
@@ -156,6 +164,23 @@ class RouterServer:
         self._tracer = _tracing.get_tracer()
         if enable_flight_recorder:
             _frec.get_recorder().enable()
+        # cluster watchtower: the router's ts-sampler additionally
+        # federates pool/supervisor-derived series (per-replica worker
+        # counters off the probes the pool already runs, live-worker
+        # count, breaker state) into the process store, and a CLUSTER
+        # AlertManager judges the tier-level objectives over it — one
+        # GET /alerts answers "is the tier healthy" with history
+        self._alert_mgr = None
+        self._ts_store = None
+        if enable_timeseries:
+            self._ts_store = _ts.get_store()
+            self._ts_store.add_collector(self._collect_cluster)
+            self._ts_store.start(interval_s=ts_interval_s)
+            self._alert_mgr = _alerts.AlertManager(
+                self._ts_store,
+                alert_objectives
+                or _alerts.cluster_objectives(alert_time_scale),
+                name="cluster").attach()
         self._lock = make_lock("RouterServer._lock")
         self._placed = 0
         self._retried = 0
@@ -179,6 +204,13 @@ class RouterServer:
         return self
 
     def close(self):
+        if self._ts_store is not None:
+            # the store is a process singleton that outlives this
+            # router: unhook the collector/listener so a torn-down
+            # router's dead pool is never sampled again
+            self._ts_store.remove_collector(self._collect_cluster)
+            if self._alert_mgr is not None:
+                self._alert_mgr.detach()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -254,7 +286,130 @@ class RouterServer:
         return {"object": "list",
                 "data": [{"id": self.model_name, "object": "model"}]}
 
+    def _timeseries_payload(self, query: str) -> dict:
+        return timeseries_payload(query)
+
+    def _alerts_payload(self) -> dict:
+        # the CLUSTER manager: tier-level objectives over the federated
+        # store, not the per-process serving defaults
+        return alerts_payload(self._alert_mgr)
+
+    # ---- metrics federation ----------------------------------------------
+    # the worker-stats counters the collector federates as per-replica
+    # cluster_* series (keys off the engines' shared stats() schema);
+    # alerts.FEDERATED_SERIES pins the resulting names for the lint
+    _FEDERATED_STATS = (
+        ("requests_admitted", "cluster_requests_admitted"),
+        ("requests_finished", "cluster_requests_finished"),
+        ("requests_shed", "cluster_requests_shed"),
+        ("deadline_misses", "cluster_deadline_misses"),
+        ("tokens_generated", "cluster_tokens_generated"),
+    )
+
+    def _collect_cluster(self) -> list:
+        """ts-sampler collector: pool/supervisor-derived series. Reads
+        ONLY state the pool's own /health probes already hold — a
+        sample never does network I/O."""
+        out: list = []
+        alive = 0
+        for rid, w_alive, stats in self.pool.worker_stats():
+            if not w_alive:
+                continue
+            alive += 1
+            labels = {"replica": str(rid)}
+            for key, series in self._FEDERATED_STATS:
+                if key in stats:
+                    out.append((series, "counter", labels,
+                                float(stats.get(key) or 0), None))
+        out.append(("cluster_workers_alive", "gauge", {}, float(alive),
+                    None))
+        breakers = 0.0
+        if self._supervisor is not None:
+            try:
+                breakers = float(self._supervisor.state()["breakers_open"])
+            except Exception as e:
+                get_logger().debug("federation: supervisor state "
+                                   "unavailable (%s: %s)",
+                                   type(e).__name__, e)
+        out.append(("cluster_breakers_open", "gauge", {}, breakers, None))
+        return out
+
+    def _scrape_worker(self, url: str) -> str:
+        timeout = getattr(self.pool, "_probe_timeout", 2.0)
+        with urllib.request.urlopen(url + "/metrics", timeout=timeout) as r:
+            return r.read().decode("utf-8", errors="replace")
+
+    @staticmethod
+    def _merge_exposition(text: str, replica: str, seen_meta: set
+                          ) -> List[str]:
+        """Label-merge one process's exposition into the federated view:
+        every sample line gains ``replica="N"``; # HELP/# TYPE headers
+        are kept once per family; other comments (exemplars) are
+        dropped — a federated surface carries samples, not per-process
+        annotations."""
+        lines: List[str] = []
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                    meta_key = (parts[1], parts[2])
+                    if meta_key not in seen_meta:
+                        seen_meta.add(meta_key)
+                        lines.append(line)
+                continue
+            name, _, rest = line.partition("{")
+            if rest:                                 # name{labels} value
+                lines.append(f'{name}{{replica="{replica}",{rest}')
+            else:                                    # name value
+                name, _, value = line.partition(" ")
+                lines.append(f'{name}{{replica="{replica}"}} {value}')
+        return lines
+
+    def _cluster_metrics_text(self) -> str:
+        """``GET /metrics/cluster``: one exposition for the whole tier —
+        the router's own registry (``replica="router"``), every live
+        worker's /metrics scraped and label-merged per replica, and the
+        pool/supervisor-derived gauges. A worker that fails its scrape
+        contributes a comment, never a 5xx: a half-scraped tier view
+        still beats none mid-incident."""
+        seen_meta: set = set()
+        lines = self._merge_exposition(
+            get_registry().render_prometheus(), "router", seen_meta)
+        for w in self.pool.workers():
+            if not w["alive"]:
+                continue
+            rid = str(w["replica_id"])
+            try:
+                text = self._scrape_worker(w["url"])
+            except (OSError, ValueError) as e:
+                lines.append(f'# scrape_error replica="{rid}" '
+                             f'{type(e).__name__}: {e}')
+                continue
+            lines.extend(self._merge_exposition(text, rid, seen_meta))
+        for name, _kind, labels, value, _e in self._collect_cluster():
+            label_s = "".join(f'{{replica="{v}"}}'
+                              for k, v in labels.items() if k == "replica")
+            kind = "gauge" if name.startswith("cluster_workers") \
+                or name == "cluster_breakers_open" else "counter"
+            meta_key = ("TYPE", name)
+            if meta_key not in seen_meta:
+                seen_meta.add(meta_key)
+                lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name}{label_s} {value:g}")
+        return "\n".join(lines) + "\n"
+
     def _extra_get(self, handler, route, query) -> bool:
+        if route == "/metrics/cluster":
+            handler._count(200)
+            body = self._cluster_metrics_text().encode()
+            handler.send_response(200)
+            handler.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+            return True
         return False
 
     def _post_handler(self, route):

@@ -67,7 +67,7 @@ from .observability.catalog import HTTP_REQUESTS
 from .serving import DeadlineExceeded, QueueFull
 
 __all__ = ["CompletionServer", "ServingHandlerBase", "serve",
-           "DEADLINE_HEADER"]
+           "DEADLINE_HEADER", "timeseries_payload", "alerts_payload"]
 
 #: end-to-end deadline propagation: the cluster router stamps each
 #: upstream hop with the request's REMAINING budget in milliseconds, so
@@ -78,9 +78,42 @@ DEADLINE_HEADER = "X-Request-Deadline"
 
 # known routes for the http counter — anything else buckets under
 # "other" so a scanner can't explode the label cardinality
-_KNOWN_ROUTES = ("/health", "/metrics", "/v1/models", "/v1/completions",
-                 "/v1/prefill", "/trace", "/trace/chrome", "/debug/dump",
-                 "/debug/events")
+_KNOWN_ROUTES = ("/health", "/metrics", "/metrics/cluster", "/v1/models",
+                 "/v1/completions", "/v1/prefill", "/trace",
+                 "/trace/chrome", "/debug/dump", "/debug/events",
+                 "/timeseries", "/alerts")
+
+
+def timeseries_payload(query: str) -> dict:
+    """``GET /timeseries`` body: the process store's pinned-schema dump
+    (optionally ``?metric=``-filtered and ``?window=``-bounded seconds)
+    plus the store's own stats — the sparkline feed for
+    scripts/watch_cluster.py."""
+    from .observability import timeseries as _ts
+
+    store = _ts.get_store()
+    q = parse_qs(query)
+    window = None
+    if q.get("window"):
+        try:
+            window = float(q["window"][0])
+        except ValueError:
+            window = None
+    metric = (q.get("metric") or [None])[0]
+    payload = store.dump(window_s=window, name=metric)
+    payload["stats"] = store.stats()
+    return payload
+
+
+def alerts_payload(manager) -> dict:
+    """``GET /alerts`` body for one AlertManager (None renders the
+    disabled shape — same keys, so pollers never branch)."""
+    if manager is None:
+        return {"enabled": False, "manager": None, "firing": [],
+                "alerts": [], "transitions": [], "transitions_total": 0}
+    payload = manager.state()
+    payload["enabled"] = True
+    return payload
 
 
 class _Submission:
@@ -326,6 +359,14 @@ class ServingHandlerBase(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return True
+        if route == "/timeseries":
+            # the TSDB window dump: history for sparklines/debugging,
+            # where /metrics is the point-in-time exposition
+            self._json(200, srv._timeseries_payload(query))
+            return True
+        if route == "/alerts":
+            self._json(200, srv._alerts_payload())
+            return True
         if route == "/health":
             self._json(200, srv._health_payload())
             return True
@@ -391,7 +432,9 @@ class CompletionServer:
     def __init__(self, engine, tokenizer=None, model_name: str = "paddle-tpu",
                  host: str = "127.0.0.1", port: int = 0,
                  enable_tracing: bool = True,
-                 enable_flight_recorder: bool = True):
+                 enable_flight_recorder: bool = True,
+                 enable_timeseries: bool = True,
+                 ts_interval_s: Optional[float] = None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
@@ -406,6 +449,17 @@ class CompletionServer:
         # engine's slot/queue state
         if enable_flight_recorder:
             _frec.get_recorder().enable()
+        # and a time-series subscriber (it serves /timeseries + /alerts):
+        # start the process-wide ts-sampler and attach the default
+        # SLO/burn-rate AlertManager — both process singletons, shared
+        # by every server in the process like the tracer/recorder
+        self._alert_mgr = None
+        if enable_timeseries:
+            from .observability import alerts as _alerts
+            from .observability import timeseries as _ts
+
+            _ts.get_store().start(interval_s=ts_interval_s)
+            self._alert_mgr = _alerts.default_manager()
         _frec.get_reporter().register_engine(
             getattr(engine, "_engine_label", "engine"), engine)
         self._subs: "queue.Queue[_Submission]" = queue.Queue()
@@ -626,6 +680,12 @@ class CompletionServer:
             "object": "list",
             "data": [{"id": self.model_name, "object": "model"}],
         }
+
+    def _timeseries_payload(self, query: str) -> dict:
+        return timeseries_payload(query)
+
+    def _alerts_payload(self) -> dict:
+        return alerts_payload(self._alert_mgr)
 
     def _extra_get(self, handler, route, query) -> bool:
         return False

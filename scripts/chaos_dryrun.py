@@ -104,6 +104,13 @@ def main(argv=None) -> int:
     sup = report.get("supervisor") or {}
     print(f"  supervisor: {sup.get('restarts_total', 0)} restarts, "
           f"{sup.get('breakers_open', 0)} breakers open")
+    alerts = report.get("alerts")
+    if alerts is not None:
+        print(f"  alerts: worker_restart_rate fired="
+              f"{alerts['restart_fired']} resolved="
+              f"{alerts['restart_resolved']} "
+              f"(all fired: {alerts['fired']}, "
+              f"firing at end: {alerts['firing_final']})")
     post = report.get("post_heal_load")
     if post is not None:
         print(f"  post-heal load: {post['completed']}/{post['n']} "

@@ -179,6 +179,56 @@ def format_lock_witness(b: dict) -> List[str]:
     return lines
 
 
+def format_alerts(b: dict, last: int = 20) -> List[str]:
+    """The ALERTS section: what the alerting layer judged around the
+    incident — firing alerts at dump time (from ``bundle.alerts``, the
+    managers' state), the transition timeline (manager history merged
+    with ``alert.fire``/``alert.resolve`` ring events), and a one-line
+    note on the TSDB window riding the bundle. Absent when the process
+    ran without the watchtower."""
+    state = b.get("alerts") or {}
+    managers = state.get("managers") or []
+    evs = [e for e in b.get("events") or []
+           if e.get("kind", "").startswith("alert.")]
+    ts = b.get("timeseries") or {}
+    if not managers and not evs and not ts:
+        return []
+    firing = [(m.get("manager"), name) for m in managers
+              for name in m.get("firing") or ()]
+    n_trans = sum(m.get("transitions_total", 0) for m in managers)
+    lines = [f"ALERTS ({len(firing)} firing at dump time, "
+             f"{n_trans} transitions recorded)"]
+    for mgr, name in firing:
+        by_name = {}
+        for m in managers:
+            if m.get("manager") == mgr:
+                by_name = {a["name"]: a for a in m.get("alerts") or []}
+        a = by_name.get(name, {})
+        lines.append(f"  FIRING [{mgr}] {name} "
+                     f"severity={a.get('severity')} "
+                     f"fired_count={a.get('fired_count')} "
+                     f"detail={a.get('detail')}")
+    trans = [dict(t, _src=m.get("manager")) for m in managers
+             for t in m.get("transitions") or ()]
+    trans.sort(key=lambda t: t.get("t") or 0)
+    for t in trans[-last:]:
+        when = t.get("t")
+        when_s = f"{when:.1f}s" if isinstance(when, (int, float)) else "?"
+        lines.append(f"  t={when_s}  [{t.get('_src')}] "
+                     f"{t.get('alert')}: {t.get('from')} -> "
+                     f"{t.get('to')}")
+    if not trans and evs:
+        for ev in evs[-last:]:
+            lines.append(f"  seq={ev['seq']:<6} {ev['kind']:<14} "
+                         f"alert={ev.get('alert')} "
+                         f"manager={ev.get('manager')}")
+    if ts.get("series"):
+        lines.append(f"  timeseries window: {len(ts['series'])} series "
+                     f"(schema {ts.get('schema')}, sampled every "
+                     f"{ts.get('interval_s')}s)")
+    return lines
+
+
 def format_sched(b: dict, last: int = 20) -> List[str]:
     """Scheduler decisions (sched.chunk / sched.preempt / sched.restore)
     pulled out of the timeline: the chunk/preempt/restore trail answers
@@ -362,6 +412,7 @@ def render(b: dict, events: int = 30, per_subsystem: int = 5,
         sections.extend([
             format_timeline(b, last=events),
             format_subsystems(b, k=per_subsystem, only=subsystem),
+            format_alerts(b),
             format_sched(b),
             format_admission(b),
             format_chaos(b),

@@ -278,7 +278,13 @@ def test_chaos_dryrun_gate():
       (deathnote blame precision at cluster level);
     - POST-HEAL CAPACITY: a seeded open-loop burst at the same offered
       rate against the healed tier completed with typed-only outcomes
-      and zero 5xx — capacity recovered, not merely survived."""
+      and zero 5xx — capacity recovered, not merely survived;
+    - WATCHTOWER: the router's cluster AlertManager (second-scale
+      windows via alert_time_scale) judged the kills end to end — the
+      worker_restart_rate objective FIRED while the supervisor was
+      restarting workers and RESOLVED once the scaled window drained
+      after the heal, deterministically (the clean-run zero-alert
+      control lives in the serving-cluster federation gate)."""
     from paddle_tpu.chaos.dryrun import (POISON_RID, default_plan,
                                          run_dryrun)
 
@@ -334,6 +340,14 @@ def test_chaos_dryrun_gate():
     assert post is not None and post["completed"] > 0, post
     assert post["http_5xx"] == 0 and post["untyped"] == 0, post
     assert post["timed_out"] == 0, post
+
+    # the watchtower judged the kills: fire while restarting, resolve
+    # after heal — proven over the real federated store, not unit math
+    alerts = report["alerts"]
+    assert alerts is not None and alerts["enabled"], report
+    assert alerts["restart_fired"], alerts
+    assert alerts["restart_resolved"], alerts
+    assert "worker_restart_rate" not in alerts["firing_final"], alerts
 
     assert report["ok"], report
 

@@ -28,7 +28,14 @@ def _cluster_cfg(workers, max_batch=8, max_len=128, page_size=8,
     return {
         "cluster": {"host": "127.0.0.1", "port": 0, "ttl": ttl,
                     "platform": "cpu", "compile_cache": _CACHE,
-                    "model_name": "tiny-llama-cluster"},
+                    "model_name": "tiny-llama-cluster",
+                    # watchtower at test speed: fast sampling + short
+                    # alert windows (restart window 6s, lost window
+                    # 1.5s) so residue from EARLIER test files' clusters
+                    # ages out of every window before the gate reads
+                    # /alerts — the clean-run control stays deterministic
+                    "ts_interval_s": 0.25,
+                    "alert_time_scale": 0.05},
         "model": {"kind": "tiny_llama", "num_hidden_layers": layers,
                   "seed": 0},
         "engine": {"max_batch": max_batch, "max_len": max_len,
@@ -129,6 +136,10 @@ class _FakePool:
 
     def workers(self):
         return [w.snapshot() for w in self._ws.values()]
+
+    def worker_stats(self):
+        return [(w.replica_id, w.alive, dict(w.stats))
+                for w in self._ws.values()]
 
     def refresh_gauges(self):
         pass
@@ -622,6 +633,64 @@ def unified_cluster():
     cluster.close()
     os.environ.pop("FLAGS_lock_witness", None)
     set_flags({"lock_witness": False})
+
+
+def test_cluster_gate_federation_and_clean_alerts(unified_cluster):
+    """Cluster watchtower federation + the zero-false-positive control.
+    Runs FIRST against the module cluster (before the failover gate
+    kills a worker): on an untouched 2-worker tier under normal
+    traffic, ``GET /metrics/cluster`` merges both workers' expositions
+    with ``replica`` labels plus the pool-derived series,
+    ``/timeseries`` carries the federated cluster series (names pinned
+    to ``alerts.FEDERATED_SERIES``), and the router's cluster
+    AlertManager fires NOTHING."""
+    from paddle_tpu.observability import alerts as al
+    from paddle_tpu.observability import timeseries as tsm
+
+    cluster = unified_cluster
+    host, port = cluster.address
+    url = f"http://{host}:{port}"
+    for _ in range(2):
+        clean, toks, _tp = _stream_completion(
+            host, port, {"prompt_token_ids": [5, 6, 7],
+                         "max_tokens": 4, "stream": True})
+        assert clean and len(toks) == 4
+    # a fresh probe (worker stats into the pool) then a forced sample
+    # (pool stats into the federated store + one alert evaluation) —
+    # the background cadences must not gate the assertions
+    cluster.pool.refresh()
+    tsm.get_store().sample_once()
+
+    # ---- /metrics/cluster: one exposition for the whole tier --------
+    with urllib.request.urlopen(url + "/metrics/cluster",
+                                timeout=30) as r:
+        assert "text/plain" in (r.headers.get("Content-Type") or "")
+        text = r.read().decode()
+    for rid in ("0", "1"):
+        assert f'serving_requests_total{{replica="{rid}",' in text, rid
+    assert 'replica="router"' in text
+    assert "cluster_workers_alive 2" in text
+    assert "# TYPE cluster_workers_alive gauge" in text
+    # HELP/TYPE headers appear once per family, not once per replica
+    assert text.count("# TYPE serving_requests_total counter") == 1
+
+    # ---- the federated series are exactly the declared set ----------
+    ts = _get_json(url + "/timeseries")
+    cluster_series = {s["name"] for s in ts["series"]
+                      if s["name"].startswith("cluster_")}
+    assert "cluster_workers_alive" in cluster_series
+    assert cluster_series <= set(al.FEDERATED_SERIES)
+    reps = {s["labels"].get("replica") for s in ts["series"]
+            if s["name"] == "cluster_requests_finished"}
+    assert {"0", "1"} <= reps
+
+    # ---- the clean-run control: ZERO false-positive alerts ----------
+    a = _get_json(url + "/alerts")
+    assert a["enabled"] is True and a["manager"] == "cluster"
+    assert {x["name"] for x in a["alerts"]} == set(al.CLUSTER_OBJECTIVES)
+    assert a["firing"] == []
+    fired = [t for t in a["transitions"] if t["to"] == "firing"]
+    assert fired == [], fired
 
 
 def test_cluster_gate_concurrent_streams_and_failover(unified_cluster):

@@ -499,6 +499,9 @@ def test_router_answers_quarantined_rid_422_without_placement():
         def workers(self):
             return []
 
+        def worker_stats(self):
+            return []
+
         def refresh_gauges(self):
             pass
 
