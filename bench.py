@@ -246,12 +246,46 @@ def decode_bench(devs, gen):
         "warm_run_s": round(warm_s, 1),
         "batch": batch,
         "config": "decode",
+        "phases": _phase_leg(model, on_tpu),
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     if os.environ.get("BENCH_SPEC"):
         rec.update(_spec_decode_leg(model, on_tpu))
     print(json.dumps(rec))
+
+
+def _phase_means(eng):
+    """Mean milliseconds per step-anatomy phase from the engine's step
+    profiler (docs/SERVING.md "Step anatomy & roofline accounting") —
+    the bench-record form of ``GET /profile``'s phases block."""
+    pay = eng.profiler.payload(top_k=0)
+    return {name: round(info["mean_ms"], 3)
+            for name, info in pay["phases"].items()}
+
+
+def _phase_leg(model, on_tpu):
+    """Per-phase step anatomy for the decode leg: ``_time_generate``
+    times ``model.generate`` (no engine), so a short profiler-enabled
+    ContinuousBatchEngine run supplies the phase breakdown that lands
+    under BENCH_STATE.json:cpu_smoke.decode.phases."""
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    cfg = model.config
+    slots, max_len, new = (8, 512, 64) if on_tpu else (2, 64, 8)
+    rng = np.random.RandomState(0)
+    eng = ContinuousBatchEngine(model, max_batch=slots,
+                                max_len=max_len, page_size=16)
+
+    def load():
+        for i in range(slots):
+            eng.add_request(rng.randint(0, cfg.vocab_size, (8 + i,)), new)
+        eng.run_until_done()
+
+    load()                  # warm-up with the profiler off: the phase
+    eng.profiler.enable()   # means must not be compile-dominated
+    load()
+    return _phase_means(eng)
 
 
 def _spec_decode_leg(model, on_tpu):
@@ -451,9 +485,16 @@ def serve_bench(devs, gen):
               if os.environ.get("BENCH_SPEC") and not mla else None)
     last_stats = {}
 
+    engines = []
+
     def run():
         eng = ContinuousBatchEngine(model, max_batch=slots, max_len=max_len,
                                     page_size=16, speculative_k=spec_k)
+        # per-phase step anatomy rides on the record (profiler off by
+        # default; the timed run's engine is engines[-1])
+        eng.profiler.enable()
+        engines.clear()
+        engines.append(eng)
         for i in range(n_req):
             plen = [64, 128, 200, 256][i % 4] if on_tpu else 4 + (i % 8)
             budget = [96, 128, 160][i % 3] if on_tpu else 6
@@ -494,6 +535,7 @@ def serve_bench(devs, gen):
         "config": ("serve_mla" if mla
                    else "serve_int4" if int4
                    else "serve_int8" if quantized else "serve"),
+        "phases": _phase_means(engines[-1]) if engines else {},
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }

@@ -33,6 +33,9 @@ from . import timeseries  # noqa: F401
 from .timeseries import TimeSeriesStore, get_store  # noqa: F401
 from . import alerts  # noqa: F401
 from .alerts import AlertManager, SloObjective  # noqa: F401
+from . import perf  # noqa: F401
+from .perf import (PhaseClock, StepProfiler, get_profiler,  # noqa: F401
+                   profile_payload)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -43,5 +46,6 @@ __all__ = [
     "flightrecorder", "FlightRecorder", "IncidentReporter", "get_recorder",
     "get_reporter", "install_reporter", "incident_scope", "validate_bundle",
     "XlaOom", "timeseries", "TimeSeriesStore", "get_store", "alerts",
-    "AlertManager", "SloObjective",
+    "AlertManager", "SloObjective", "perf", "PhaseClock", "StepProfiler",
+    "get_profiler", "profile_payload",
 ]

@@ -247,6 +247,15 @@ def default_objectives(time_scale: float = 1.0
             metric="serving_time_to_first_token_seconds",
             agg="quantile", quantile=0.99, window_s=300.0,
             op=">", threshold=2.0, for_s=60.0, resolve_s=120.0),
+        SloObjective(
+            "decode_step_p99_high", "threshold", severity="ticket",
+            summary="p99 device-dispatch time per decode step over the "
+                    "window exceeds the latency bound — the model is "
+                    "slower than the step budget allows",
+            metric="serving_step_phase_seconds",
+            labels={"phase": "dispatch"},
+            agg="quantile", quantile=0.99, window_s=300.0,
+            op=">", threshold=1.0, for_s=60.0, resolve_s=120.0),
     ]
     return {o.name: o.scaled(time_scale) if time_scale != 1.0 else o
             for o in objs}
@@ -308,6 +317,8 @@ FEDERATED_SERIES = frozenset({
     "cluster_requests_shed",
     "cluster_deadline_misses",
     "cluster_tokens_generated",
+    "cluster_profile_step_ms",
+    "cluster_profile_roofline_ratio",
 })
 
 

@@ -259,6 +259,13 @@ EV_LOCK_ORDER = _register(
     "contradicts the static lock graph "
     "(violation=inversion|static_conflict, held, acquired, thread) — "
     "the full stacks ride bundle['lock_witness']")
+EV_PERF_ROOFLINE = _register(
+    "perf.roofline",
+    "the step-anatomy profiler persisted a roofline observation into "
+    "the autotune cost table (engine, measured_ms, predicted_ms, ratio, "
+    "mfu) — one (signature, measured, predicted) training row for a "
+    "later learned cost-model fit; see docs/SERVING.md 'Step anatomy & "
+    "roofline accounting'")
 
 
 # ---- the ring ---------------------------------------------------------------
@@ -450,6 +457,10 @@ BUNDLE_SCHEMA = {
     # every live AlertManager's state + bounded transition history
     # (None when no manager exists)
     "alerts": (dict, type(None)),
+    # the step-anatomy profile (perf.profile_payload(); None when no
+    # engine ever registered a profiler) — per-phase p50/p99, roofline
+    # ratios, and the top-K slowest recent steps at crash time
+    "profile": (dict, type(None)),
 }
 
 _EVENT_KEYS = ("seq", "ts", "mono_ns", "kind", "tid")
@@ -457,7 +468,8 @@ _EVENT_KEYS = ("seq", "ts", "mono_ns", "kind", "tid")
 # keys added after paddle_tpu.incident/1 shipped: producers always emit
 # them, but a reader must keep accepting bundles written before they
 # existed (the version string is unchanged — the addition is additive)
-_OPTIONAL_KEYS = frozenset({"lock_witness", "timeseries", "alerts"})
+_OPTIONAL_KEYS = frozenset({"lock_witness", "timeseries", "alerts",
+                            "profile"})
 
 
 def validate_bundle(bundle: dict) -> dict:
@@ -527,6 +539,20 @@ def _alerts_state() -> Optional[dict]:
 
         return _alerts.snapshot_all()
     except Exception:  # pdlint: disable=silent-exception -- a crash dump must not die on the alerting layer; the bundle just omits it
+        return None
+
+
+def _profile_section() -> Optional[dict]:
+    """The step-anatomy profile for the bundle (None when no engine
+    ever registered a profiler — processes without serving engines and
+    old readers see the same absent shape)."""
+    try:
+        from . import perf as _perf
+
+        if not _perf._PROFILERS:
+            return None
+        return _perf.profile_payload()
+    except Exception:  # pdlint: disable=silent-exception -- a crash dump must not die on an optional perf surface; the bundle just omits it
         return None
 
 
@@ -779,6 +805,7 @@ class IncidentReporter:
             "lock_witness": _witness_report(),
             "timeseries": _timeseries_window(),
             "alerts": _alerts_state(),
+            "profile": _profile_section(),
         }
 
     def dump(self, reason: str, exc: Optional[BaseException] = None,

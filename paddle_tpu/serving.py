@@ -32,6 +32,7 @@ import numpy as np
 from .chaos import inject as _chaos
 from .observability import catalog as _metrics
 from .observability import flightrecorder as _frec
+from .observability import perf as _perf
 from .observability import tracing as _tracing
 from .tensor_class import Tensor, unwrap
 from .framework import random as _random
@@ -187,7 +188,8 @@ class _Request:
                  "encoder_input", "seed_ids", "t_enqueue", "t_admit",
                  "t_last", "span", "queue_span", "handoff",
                  "priority", "deadline", "resume", "n_preempted",
-                 "on_shed", "spec_rounds", "spec_accepted", "ext_id")
+                 "on_shed", "spec_rounds", "spec_accepted", "ext_id",
+                 "dispatches")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
                  on_token=None, pixel_values=None, stop_token_ids=None,
@@ -240,6 +242,9 @@ class _Request:
         # attributes _trace_end stamps at retirement)
         self.spec_rounds = 0
         self.spec_accepted = 0
+        # fused dispatches this request rode (per-request cost
+        # accounting: the usage block's dispatches / tokens-per-dispatch)
+        self.dispatches = 0
         # shed notification: the front-end's hook for learning that a
         # QUEUED request was dropped (deadline expired / displaced by a
         # more important arrival) — without it an HTTP submission would
@@ -339,6 +344,9 @@ class _RequestBookkeeping:
         # dict would grow with lifetime request count)
         self._finished_reason: Dict[int, str] = {}
         self._finished_logprobs: Dict[int, list] = {}
+        # per-request usage (the completion response's cost-accounting
+        # block) — same retention window as the finish reasons
+        self._finished_usage: Dict[int, dict] = {}
         # deque: retirement trims from the FRONT every finish/cancel —
         # list.pop(0) would be O(window) per retired request at high
         # churn once the window is full
@@ -378,6 +386,10 @@ class _RequestBookkeeping:
             engine=engine, decision="shed")
         self._m_active = _metrics.SERVING_ACTIVE_SLOTS.labels(engine=engine)
         self._m_depth = _metrics.SERVING_QUEUE_DEPTH.labels(engine=engine)
+        # step-anatomy profiler: constructed disabled (guarded fast path
+        # — every hot site checks prof.enabled first); the HTTP server
+        # or a bench harness enables it
+        self.profiler = _perf.StepProfiler(engine)
         # overload estimators, both engine-thread-only: the FLOOR of
         # admission->first-token (best case ever observed — a request
         # whose remaining budget is below even that is PROVABLY
@@ -460,6 +472,10 @@ class _RequestBookkeeping:
             "accepted_tokens_per_dispatch": (
                 self._n_spec_emitted / self._n_spec_slot_rounds
                 if self._n_spec_slot_rounds else 0.0),
+            # step-anatomy profiler scalars (0.0 until enabled + traffic)
+            # — the router federates these as cluster_* series, so a
+            # perf regression on one replica is visible tier-wide
+            **self.profiler.federated(),
         }
 
     def _count_finished(self, req: "_Request", slo: bool = True):
@@ -469,6 +485,7 @@ class _RequestBookkeeping:
         for error retirements, which are neither)."""
         self._n_finished += 1
         self._m_req_finished.inc()
+        self._record_usage(req)
         if slo and req.deadline != math.inf:
             if time.perf_counter() <= req.deadline:
                 self._n_slo_good += 1
@@ -633,6 +650,32 @@ class _RequestBookkeeping:
         while in flight or once evicted from the retention window."""
         return self._finished_reason.get(rid)
 
+    def _record_usage(self, req: _Request):
+        """Per-request cost accounting at retirement: token counts plus
+        where the request's wall time went (queue vs compute) and how
+        many fused dispatches it rode — the response's ``usage`` block
+        and, divided out, tokens-per-dispatch (the per-request view of
+        the engine-wide speculation health number)."""
+        now = time.perf_counter()
+        t_admit = req.t_admit if req.t_admit is not None else now
+        done = req.t_last if req.t_last is not None else now
+        n_disp = req.dispatches
+        n_tok = len(req.tokens)
+        self._finished_usage[req.rid] = {
+            "prompt_tokens": int(req.ids.size),
+            "completion_tokens": n_tok,
+            "queue_ms": max(0.0, (t_admit - req.t_enqueue) * 1e3),
+            "compute_ms": max(0.0, (done - t_admit) * 1e3),
+            "dispatches": n_disp,
+            "accepted_tokens_per_dispatch": (n_tok / n_disp
+                                             if n_disp else 0.0),
+        }
+
+    def request_usage(self, rid: int) -> Optional[dict]:
+        """The usage block of a FINISHED request; None while in flight
+        or once evicted from the retention window."""
+        return self._finished_usage.get(rid)
+
     def cancel(self, rid: int) -> bool:
         """Abort a request (client disconnect): queued requests drop
         before admission; active requests free their slot immediately —
@@ -704,6 +747,7 @@ class _RequestBookkeeping:
             old = self._reason_order.popleft()
             self._finished_reason.pop(old, None)
             getattr(self, "_finished_logprobs", {}).pop(old, None)
+            getattr(self, "_finished_usage", {}).pop(old, None)
 
 
 class _ChunkState:
@@ -975,6 +1019,10 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._poisoned = False
         self._slots: List[Optional[_Request]] = [None] * max_batch
         self._init_bookkeeping("decoder")
+        # roofline join: llama-shaped configs get the serving_decode_step
+        # cost model (None keeps phase attribution without a roofline)
+        self.profiler.set_cost_params(
+            _perf.decode_step_params(cfg, max_batch))
 
         # ---- SLO-aware scheduling ---------------------------------------
         # chunked prefill: admission prefill lands prefill_chunk_tokens at
@@ -1555,8 +1603,18 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             raise RuntimeError(
                 "ContinuousBatchEngine: a failed admission invalidated the "
                 "page pool; rebuild the engine and resubmit requests")
+        # step-anatomy clock: the tracer's guarded fast path — one
+        # attribute read while profiling is off
+        prof = self.profiler
+        clk = prof.clock if prof.enabled else None
+        if clk is not None:
+            clk.begin()
         self._admit()
+        if clk is not None:
+            clk.lap("admit")
         self._advance_chunk()
+        if clk is not None:
+            clk.lap("prefill")
         if self.num_active == 0:
             self._clear_dispatch_guard()
             return self._drain_finished()
@@ -1565,7 +1623,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         # too — it is the same device dispatch boundary)
         self._dispatch_guard([r for r in self._slots if r is not None])
         if self.speculative_k is not None and self._spec_eligible():
-            return self._step_speculative()
+            return self._step_speculative(clk)
         t_dispatch = time.perf_counter()
         do_sample, temperature, top_k, top_p = self._sample_cfg
         for c in self._caches:
@@ -1601,10 +1659,14 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             # the most recently admitted slot typed, shrink the budget
             self._degrade_on_oom(None, where="step", exc=e)
             return self._drain_finished()
+        if clk is not None:
+            clk.lap("dispatch")
         # THE one deliberate device->host sync of the decode loop: every
         # other host conversion below reads these already-fetched arrays
         toks = np.asarray(nxt)    # pdlint: disable=host-sync
         lps = np.asarray(logps)   # pdlint: disable=host-sync
+        if clk is not None:
+            clk.lap("sync")
         self._clear_dispatch_guard()  # step success: blame record erased
         # np.asarray forced the device->host sync, so the span covers the
         # whole fused dispatch; ONE clock for every token this step
@@ -1612,12 +1674,14 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         now = time.perf_counter()
         self._m_step.observe(now - t_dispatch)
         self._n_steps += 1
+        fr_seq = 0
         rec = _frec.RECORDER
         if rec.enabled:
             # ONE event per fused dispatch (not per token): the black box
             # stays O(steps) however many slots decode concurrently
-            rec.record(_frec.EV_STEP, engine=self._engine_label,
-                       active=self.num_active, seconds=now - t_dispatch)
+            fr_seq = rec.record(_frec.EV_STEP, engine=self._engine_label,
+                                active=self.num_active,
+                                seconds=now - t_dispatch)
         # perf_counter and perf_counter_ns share one clock, so the span
         # bounds come from the timestamps already taken for the metric
         trace_on = _tracing.get_tracer().enabled
@@ -1630,6 +1694,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         for s, req in enumerate(self._slots):
             if req is None:
                 continue
+            req.dispatches += 1
             t = int(toks[s])
             req.tokens.append(t)
             lp = float(lps[s])
@@ -1695,7 +1760,17 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                     first_exc = e
         if first_exc is not None:
             raise first_exc
+        if clk is not None:
+            clk.lap("retire")
         self._admit()
+        if clk is not None:
+            clk.lap("admit")   # trailing refill accumulates into admit
+            prof.commit(
+                active=int(active.sum()),
+                kv_len=max((int(r.ids.size) + len(r.tokens)
+                            for r in self._slots if r is not None),
+                           default=0),
+                fr_seq=fr_seq)
         return self._drain_finished()
 
     # ---- speculative decoding: multi-token steps ------------------------
@@ -1714,7 +1789,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 return False
         return True
 
-    def _step_speculative(self) -> Dict[int, np.ndarray]:
+    def _step_speculative(self, clk=None) -> Dict[int, np.ndarray]:
         """One MULTI-token decode step: the host n-gram drafter proposes
         up to k-1 tokens per active slot from the slot's own prompt+token
         history, ONE batched verify dispatch (generation._SpecDecodeStep)
@@ -1758,6 +1833,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         if rec.enabled:
             rec.record(_frec.EV_SPEC_PROPOSE, engine=self._engine_label,
                        active=self.num_active, k=k, drafted=n_drafted)
+        if clk is not None:
+            clk.lap("draft")   # host n-gram propose, pre-dispatch
         try:
             with _frec.incident_scope("engine.step"):
                 step = _get_spec_decode(self.model, self.max_len, k)
@@ -1766,20 +1843,26 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         except _frec.XlaOom as e:
             self._degrade_on_oom(None, where="step", exc=e)
             return self._drain_finished()
+        if clk is not None:
+            clk.lap("dispatch")
         # THE deliberate device->host sync of the speculative decode
         # loop: one dispatch produced all three arrays, the first
         # conversion blocks, the other two read already-fetched results
         toks = np.asarray(emitted)   # pdlint: disable=host-sync -- the step's one deliberate token fetch (host retirement needs the ints)
         n_row = np.asarray(n_emit)   # pdlint: disable=host-sync -- same dispatch as toks; variable per-slot advance drives host bookkeeping
         lps = np.asarray(logps)      # pdlint: disable=host-sync -- same dispatch as toks; the OpenAI logprobs field
+        if clk is not None:
+            clk.lap("sync")
         self._clear_dispatch_guard()  # step success: blame record erased
         now = time.perf_counter()
         self._m_step.observe(now - t_dispatch)
         self._n_steps += 1
         self._n_spec_steps += 1
+        fr_seq = 0
         if rec.enabled:
-            rec.record(_frec.EV_STEP, engine=self._engine_label,
-                       active=self.num_active, seconds=now - t_dispatch)
+            fr_seq = rec.record(_frec.EV_STEP, engine=self._engine_label,
+                                active=self.num_active,
+                                seconds=now - t_dispatch)
             rec.record(_frec.EV_SPEC_VERIFY, engine=self._engine_label,
                        active=self.num_active, k=k,
                        seconds=now - t_dispatch)
@@ -1793,6 +1876,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         for s, req in enumerate(self._slots):
             if req is None:
                 continue
+            req.dispatches += 1
             n = int(n_row[s])
             slot_rounds += 1
             # deliver the accepted run, truncated at the request's stop
@@ -1883,7 +1967,17 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                     first_exc = e
         if first_exc is not None:
             raise first_exc
+        if clk is not None:
+            clk.lap("retire")
         self._admit()
+        if clk is not None:
+            clk.lap("admit")   # trailing refill accumulates into admit
+            self.profiler.commit(
+                active=int(active.sum()),
+                kv_len=max((int(r.ids.size) + len(r.tokens)
+                            for r in self._slots if r is not None),
+                           default=0),
+                fr_seq=fr_seq)
         return self._drain_finished()
 
     def run_until_done(self, max_steps: Optional[int] = None) -> Dict[int, np.ndarray]:
@@ -2977,7 +3071,16 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
     def step(self) -> Dict[int, np.ndarray]:
         """Decode ONE token for every active slot (one fused dispatch);
         returns newly finished requests {rid: generated ids}."""
+        # step-anatomy clock (guarded fast path, same as the decoder
+        # engine); the encoder+seed prefill inside _admit IS this
+        # engine's admission prefill, so it attributes to "admit"
+        prof = self.profiler
+        clk = prof.clock if prof.enabled else None
+        if clk is not None:
+            clk.begin()
         self._admit()
+        if clk is not None:
+            clk.lap("admit")
         if self.num_active == 0:
             return self._drain()
         t_dispatch = time.perf_counter()
@@ -2985,15 +3088,21 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         nxt, self._last, self._self_k, self._self_v = step(
             self._last, _random.next_key(), self._self_k, self._self_v,
             self._cross_k, self._cross_v, self._enc_mask, self._lengths)
+        if clk is not None:
+            clk.lap("dispatch")
         # the seq2seq step's one deliberate device->host sync
         toks = np.asarray(nxt)    # pdlint: disable=host-sync
+        if clk is not None:
+            clk.lap("sync")
         now = time.perf_counter()
         self._m_step.observe(now - t_dispatch)
         self._n_steps += 1
+        fr_seq = 0
         rec = _frec.RECORDER
         if rec.enabled:
-            rec.record(_frec.EV_STEP, engine=self._engine_label,
-                       active=self.num_active, seconds=now - t_dispatch)
+            fr_seq = rec.record(_frec.EV_STEP, engine=self._engine_label,
+                                active=self.num_active,
+                                seconds=now - t_dispatch)
         trace_on = _tracing.get_tracer().enabled
         t0_ns, t1_ns = (int(t_dispatch * 1e9), int(now * 1e9)) \
             if trace_on else (0, 0)
@@ -3003,6 +3112,7 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
         for s, req in enumerate(self._slots):
             if req is None:
                 continue
+            req.dispatches += 1
             t = int(toks[s])
             req.tokens.append(t)
             self._observe_token(req, now)
@@ -3018,5 +3128,10 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                 self._slots[s] = None
                 self._lengths = self._lengths.at[s].set(0)
                 self._trace_end(req, "ok")
+        if clk is not None:
+            clk.lap("retire")
         self._admit()
+        if clk is not None:
+            clk.lap("admit")   # trailing refill accumulates into admit
+            prof.commit(active=int(active.sum()), fr_seq=fr_seq)
         return self._drain()

@@ -44,7 +44,8 @@ from ..observability import tracing as _tracing
 from ..observability.catalog import ROUTER_PLACEMENTS
 from ..observability.metrics import PROMETHEUS_CONTENT_TYPE, get_registry
 from ..serving_http import (DEADLINE_HEADER, ServingHandlerBase,
-                            alerts_payload, timeseries_payload)
+                            alerts_payload, profile_payload,
+                            timeseries_payload)
 from .pool import WorkerInfo, WorkerPool, jittered
 
 __all__ = ["RouterServer"]
@@ -306,6 +307,14 @@ class RouterServer:
         ("tokens_generated", "cluster_tokens_generated"),
     )
 
+    # step-anatomy profiler scalars federated as per-replica GAUGES (the
+    # watch_cluster perf panel's sparkline feed); same /health-probe
+    # transport as the counters above — a sample never does network I/O
+    _FEDERATED_PERF = (
+        ("profile_step_ms", "cluster_profile_step_ms"),
+        ("profile_roofline_ratio", "cluster_profile_roofline_ratio"),
+    )
+
     def _collect_cluster(self) -> list:
         """ts-sampler collector: pool/supervisor-derived series. Reads
         ONLY state the pool's own /health probes already hold — a
@@ -320,6 +329,10 @@ class RouterServer:
             for key, series in self._FEDERATED_STATS:
                 if key in stats:
                     out.append((series, "counter", labels,
+                                float(stats.get(key) or 0), None))
+            for key, series in self._FEDERATED_PERF:
+                if key in stats:
+                    out.append((series, "gauge", labels,
                                 float(stats.get(key) or 0), None))
         out.append(("cluster_workers_alive", "gauge", {}, float(alive),
                     None))
@@ -388,17 +401,35 @@ class RouterServer:
                              f'{type(e).__name__}: {e}')
                 continue
             lines.extend(self._merge_exposition(text, rid, seen_meta))
-        for name, _kind, labels, value, _e in self._collect_cluster():
+        for name, kind, labels, value, _e in self._collect_cluster():
             label_s = "".join(f'{{replica="{v}"}}'
                               for k, v in labels.items() if k == "replica")
-            kind = "gauge" if name.startswith("cluster_workers") \
-                or name == "cluster_breakers_open" else "counter"
             meta_key = ("TYPE", name)
             if meta_key not in seen_meta:
                 seen_meta.add(meta_key)
                 lines.append(f"# TYPE {name} {kind}")
             lines.append(f"{name}{label_s} {value:g}")
         return "\n".join(lines) + "\n"
+
+    def _cluster_profile(self, query: str) -> dict:
+        """``GET /profile/cluster``: every live worker's /profile
+        fetched and keyed by replica id. Same contract as the metrics
+        federation — a worker that fails its fetch contributes an error
+        entry, never a 5xx."""
+        q = f"?{query}" if query else ""
+        timeout = getattr(self.pool, "_probe_timeout", 2.0)
+        out: dict = {"schema_version": 1, "replicas": {}, "errors": {}}
+        for w in self.pool.workers():
+            if not w["alive"]:
+                continue
+            rid = str(w["replica_id"])
+            try:
+                with urllib.request.urlopen(w["url"] + "/profile" + q,
+                                            timeout=timeout) as r:
+                    out["replicas"][rid] = json.loads(r.read())
+            except (OSError, ValueError) as e:
+                out["errors"][rid] = f"{type(e).__name__}: {e}"
+        return out
 
     def _extra_get(self, handler, route, query) -> bool:
         if route == "/metrics/cluster":
@@ -409,6 +440,14 @@ class RouterServer:
             handler.send_header("Content-Length", str(len(body)))
             handler.end_headers()
             handler.wfile.write(body)
+            return True
+        if route == "/profile":
+            # the router process has no engine; the payload is its own
+            # (empty) profiler view — the federated one is next door
+            handler._json(200, profile_payload(query))
+            return True
+        if route == "/profile/cluster":
+            handler._json(200, self._cluster_profile(query))
             return True
         return False
 

@@ -62,12 +62,14 @@ import numpy as np
 
 from .observability import PROMETHEUS_CONTENT_TYPE, get_registry
 from .observability import flightrecorder as _frec
+from .observability import perf as _perf
 from .observability import tracing as _tracing
 from .observability.catalog import HTTP_REQUESTS
 from .serving import DeadlineExceeded, QueueFull
 
 __all__ = ["CompletionServer", "ServingHandlerBase", "serve",
-           "DEADLINE_HEADER", "timeseries_payload", "alerts_payload"]
+           "DEADLINE_HEADER", "timeseries_payload", "alerts_payload",
+           "profile_payload"]
 
 #: end-to-end deadline propagation: the cluster router stamps each
 #: upstream hop with the request's REMAINING budget in milliseconds, so
@@ -81,7 +83,7 @@ DEADLINE_HEADER = "X-Request-Deadline"
 _KNOWN_ROUTES = ("/health", "/metrics", "/metrics/cluster", "/v1/models",
                  "/v1/completions", "/v1/prefill", "/trace",
                  "/trace/chrome", "/debug/dump", "/debug/events",
-                 "/timeseries", "/alerts")
+                 "/timeseries", "/alerts", "/profile", "/profile/cluster")
 
 
 def timeseries_payload(query: str) -> dict:
@@ -103,6 +105,22 @@ def timeseries_payload(query: str) -> dict:
     payload = store.dump(window_s=window, name=metric)
     payload["stats"] = store.stats()
     return payload
+
+
+def profile_payload(query: str = "") -> dict:
+    """``GET /profile`` body: every registered engine's step anatomy —
+    per-phase p50/p99/share over the recent window, roofline ratios and
+    MFU, and the top-K slowest steps with their flight-recorder seqs
+    (``?top=`` bounds K; docs/SERVING.md 'Step anatomy & roofline
+    accounting')."""
+    q = parse_qs(query)
+    top_k = 5
+    if q.get("top"):
+        try:
+            top_k = max(0, min(int(q["top"][0]), 64))
+        except ValueError:
+            top_k = 5
+    return _perf.profile_payload(top_k)
 
 
 def alerts_payload(manager) -> dict:
@@ -462,6 +480,12 @@ class CompletionServer:
             self._alert_mgr = _alerts.default_manager()
         _frec.get_reporter().register_engine(
             getattr(engine, "_engine_label", "engine"), engine)
+        # and a step-anatomy subscriber (it serves /profile): enable the
+        # engine's profiler — the guarded fast path only pays once a
+        # subscriber exists, exactly like the tracer/recorder
+        prof = getattr(engine, "profiler", None)
+        if prof is not None:
+            prof.enable()
         self._subs: "queue.Queue[_Submission]" = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._engine_loop,
@@ -688,6 +712,9 @@ class CompletionServer:
         return alerts_payload(self._alert_mgr)
 
     def _extra_get(self, handler, route, query) -> bool:
+        if route == "/profile":
+            handler._json(200, profile_payload(query))
+            return True
         return False
 
     def _post_handler(self, route):
@@ -870,14 +897,37 @@ class CompletionServer:
             if self.tokenizer is not None:
                 choice["text"] = self.tokenizer.decode(toks)
             choices.append(choice)
+        usage = {"prompt_tokens": n_prompt,
+                 "completion_tokens": total_completion,
+                 "total_tokens": n_prompt + total_completion}
+        usage.update(self._usage_extras(sub.rids))
         return handler._json(200, {
             "id": cid, "object": "text_completion",
             "model": self.model_name,
             "choices": choices,
-            "usage": {"prompt_tokens": n_prompt,
-                      "completion_tokens": total_completion,
-                      "total_tokens": n_prompt + total_completion},
+            "usage": usage,
         })
+
+    def _usage_extras(self, rids) -> dict:
+        """Per-request cost accounting from the engine's retention
+        window (queue vs compute milliseconds, fused dispatches ridden,
+        tokens retired per dispatch). Across an n>1 submission the
+        dispatches sum and the wall-clock fields take the max — the
+        siblings decode concurrently. Empty when every rid already left
+        the engine's retention window."""
+        rows = [u for u in (self.engine.request_usage(r) for r in rids)
+                if u is not None]
+        if not rows:
+            return {}
+        disp = sum(u["dispatches"] for u in rows)
+        n_tok = sum(u["completion_tokens"] for u in rows)
+        return {
+            "queue_ms": round(max(u["queue_ms"] for u in rows), 3),
+            "compute_ms": round(max(u["compute_ms"] for u in rows), 3),
+            "dispatches": disp,
+            "accepted_tokens_per_dispatch": round(
+                n_tok / disp if disp else 0.0, 4),
+        }
 
     def _stream(self, handler, sub, cid, want_logprobs=False):
         # the SSE status line is DEFERRED to the first event: a rejected
@@ -971,6 +1021,26 @@ class CompletionServer:
                 if self.tokenizer is not None:
                     piece["choices"][0]["text"] = (
                         self.tokenizer.decode([int(tok)]))
+                if done:
+                    # the final pre-[DONE] payload carries the usage
+                    # block (token counts + the engine's cost
+                    # accounting, same shape as the non-stream
+                    # response's usage field) ON the last token chunk
+                    # rather than in an extra empty-choices event —
+                    # clients that index choices[0] on every event
+                    # keep working unmodified
+                    rows = [u for u in (self.engine.request_usage(r)
+                                        for r in sub.rids)
+                            if u is not None]
+                    if rows:
+                        n_tok = sum(u["completion_tokens"] for u in rows)
+                        piece["usage"] = {
+                            "prompt_tokens": rows[0]["prompt_tokens"],
+                            "completion_tokens": n_tok,
+                            "total_tokens": (rows[0]["prompt_tokens"]
+                                             + n_tok)}
+                        piece["usage"].update(
+                            self._usage_extras(sub.rids))
                 handler._chunk(b"data: " + json.dumps(piece).encode()
                                + b"\n\n")
                 if done:

@@ -38,6 +38,16 @@ DEFAULT_METRICS = (
     "worker_restarts_total",
 )
 
+#: perf panel series (metric, printf format for the last value): the
+#: router's federated per-replica gauges first, then the process-local
+#: roofline gauges a single server publishes
+PERF_METRICS = (
+    ("cluster_profile_step_ms", "%.2f ms"),
+    ("cluster_profile_roofline_ratio", "%.3f"),
+    ("serving_roofline_ratio", "%.3f"),
+    ("serving_mfu", "%.3f"),
+)
+
 
 def _get(url: str, timeout: float = 5.0):
     with urllib.request.urlopen(url, timeout=timeout) as r:
@@ -157,6 +167,23 @@ def render(snap: dict, metrics) -> str:
         lines.append(f"ENGINE  active={health.get('active')} "
                      f"queued={health.get('queued')} "
                      f"max_active_slots={health.get('max_active_slots')}")
+    # ---- perf panel: step anatomy / roofline --------------------------
+    # federated gauges on a router (per-replica labels), process gauges
+    # on a single server; silent when neither has published yet
+    perf_rows = []
+    for metric, fmt in PERF_METRICS:
+        for s in series_windows(ts, metric):
+            if not s["values"]:
+                continue
+            label = f"{metric}{{{s['labels']}}}" if s["labels"] \
+                else metric
+            perf_rows.append(
+                f"  {label:<52} {sparkline(s['values'])} "
+                f"last={fmt % s['last']}")
+    if perf_rows:
+        lines.append("PERF  (decode step anatomy & roofline — see "
+                     "GET /profile for the per-phase breakdown)")
+        lines.extend(perf_rows)
     # ---- sparklines ---------------------------------------------------
     if ts.get("error"):
         lines.append(f"TIMESERIES  unavailable ({ts['error']})")
