@@ -247,6 +247,7 @@ def decode_bench(devs, gen):
         "batch": batch,
         "config": "decode",
         "phases": _phase_leg(model, on_tpu),
+        "kv": _kv_leg(model, on_tpu),
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
@@ -286,6 +287,47 @@ def _phase_leg(model, on_tpu):
     eng.profiler.enable()   # means must not be compile-dominated
     load()
     return _phase_means(eng)
+
+
+def _kv_summary(eng):
+    """The ``kv`` block a bench record carries: pages peak, prefix hit
+    ratio, and the measured-vs-preflight byte ratio off the engine's
+    KV atlas (docs/SERVING.md "KV & memory atlas") — the capacity
+    baseline the quantized-serving work lands against."""
+    pay = eng.kvatlas.payload()
+    pre = pay["preflight"]["kv_cache_bytes"]
+    peak_bytes = pay["pages_peak"] * pay["bytes_per_page"]
+    return {
+        "kv_pages_peak": pay["pages_peak"],
+        "kv_bytes_peak": peak_bytes,
+        "prefix_hit_ratio": round(pay["prefix"]["hit_ratio"], 3),
+        "capacity_bytes": pay["capacity_bytes"],
+        "preflight_kv_cache_bytes": pre,
+        "measured_vs_preflight": (round(peak_bytes / pre, 4)
+                                  if pre else None),
+    }
+
+
+def _kv_leg(model, on_tpu):
+    """KV-atlas capacity numbers for the decode leg: a short
+    atlas-enabled engine run over prompts sharing a page-aligned prefix
+    (so the prefix-reuse index sees traffic) — lands under
+    BENCH_STATE.json:cpu_smoke.decode.kv."""
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    cfg = model.config
+    slots, max_len, new = (8, 512, 64) if on_tpu else (2, 64, 8)
+    rng = np.random.RandomState(0)
+    eng = ContinuousBatchEngine(model, max_batch=slots, max_len=max_len,
+                                page_size=16, enable_prefix_cache=True)
+    eng.kvatlas.enable()
+    shared = rng.randint(0, cfg.vocab_size, (32,))
+    for i in range(slots):
+        ids = np.concatenate(
+            [shared, rng.randint(0, cfg.vocab_size, (4 + i,))])
+        eng.add_request(ids, new)
+    eng.run_until_done()
+    return _kv_summary(eng)
 
 
 def _spec_decode_leg(model, on_tpu):
@@ -490,9 +532,11 @@ def serve_bench(devs, gen):
     def run():
         eng = ContinuousBatchEngine(model, max_batch=slots, max_len=max_len,
                                     page_size=16, speculative_k=spec_k)
-        # per-phase step anatomy rides on the record (profiler off by
-        # default; the timed run's engine is engines[-1])
+        # per-phase step anatomy + KV-atlas capacity numbers ride on
+        # the record (both off by default; the timed run's engine is
+        # engines[-1])
         eng.profiler.enable()
+        eng.kvatlas.enable()
         engines.clear()
         engines.append(eng)
         for i in range(n_req):
@@ -536,6 +580,7 @@ def serve_bench(devs, gen):
                    else "serve_int4" if int4
                    else "serve_int8" if quantized else "serve"),
         "phases": _phase_means(engines[-1]) if engines else {},
+        "kv": _kv_summary(engines[-1]) if engines else {},
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }

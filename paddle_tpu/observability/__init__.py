@@ -36,6 +36,8 @@ from .alerts import AlertManager, SloObjective  # noqa: F401
 from . import perf  # noqa: F401
 from .perf import (PhaseClock, StepProfiler, get_profiler,  # noqa: F401
                    profile_payload)
+from . import kvatlas  # noqa: F401
+from .kvatlas import KvAtlas, get_atlas, kvstate_payload  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -47,5 +49,6 @@ __all__ = [
     "get_reporter", "install_reporter", "incident_scope", "validate_bundle",
     "XlaOom", "timeseries", "TimeSeriesStore", "get_store", "alerts",
     "AlertManager", "SloObjective", "perf", "PhaseClock", "StepProfiler",
-    "get_profiler", "profile_payload",
+    "get_profiler", "profile_payload", "kvatlas", "KvAtlas", "get_atlas",
+    "kvstate_payload",
 ]

@@ -256,6 +256,16 @@ def default_objectives(time_scale: float = 1.0
             labels={"phase": "dispatch"},
             agg="quantile", quantile=0.99, window_s=300.0,
             op=">", threshold=1.0, for_s=60.0, resolve_s=120.0),
+        SloObjective(
+            "kv_pressure_high", "threshold", severity="page",
+            summary="free-slot headroom in the decoder's KV pool has "
+                    "been below 10% of the admission budget for a "
+                    "sustained window — page pressure is about to "
+                    "become preemption churn or OOM degrade",
+            metric="serving_kv_headroom_frac",
+            labels={"engine": "decoder"},
+            agg="avg", window_s=60.0, op="<", threshold=0.10,
+            for_s=60.0, resolve_s=120.0),
     ]
     return {o.name: o.scaled(time_scale) if time_scale != 1.0 else o
             for o in objs}
@@ -319,6 +329,10 @@ FEDERATED_SERIES = frozenset({
     "cluster_tokens_generated",
     "cluster_profile_step_ms",
     "cluster_profile_roofline_ratio",
+    "cluster_kv_pages_in_use",
+    "cluster_kv_bytes",
+    "cluster_kv_headroom_slots",
+    "cluster_prefix_hit_ratio",
 })
 
 

@@ -145,6 +145,47 @@ SERVING_MFU = _R.gauge(
     "profiled window",
     labels=("engine",))
 
+SERVING_KV_PAGES_IN_USE = _R.gauge(
+    "serving_kv_pages_in_use",
+    "Live KV-cache pages held by active + chunk-reserved slots "
+    "(KvAtlas ledger; 0 while the atlas is disabled or the engine is "
+    "unpaged)",
+    labels=("engine",))
+
+SERVING_KV_BYTES = _R.gauge(
+    "serving_kv_bytes",
+    "Live KV-cache bytes held by active + chunk-reserved slots "
+    "(pages_in_use x page_size x per-token KV bytes from the model "
+    "config)",
+    labels=("engine",))
+
+SERVING_KV_HEADROOM_SLOTS = _R.gauge(
+    "serving_kv_headroom_slots",
+    "Free slots under the LIVE admission budget (max_active_slots, "
+    "which OOM degrade shrinks) — the capacity-forecast numerator",
+    labels=("engine",))
+
+SERVING_KV_HEADROOM_FRAC = _R.gauge(
+    "serving_kv_headroom_frac",
+    "Free-slot headroom as a fraction of the live admission budget "
+    "(1.0 = empty pool; the kv_pressure_high alert watches this)",
+    labels=("engine",))
+
+SERVING_PREFIX_HIT_RATIO = _R.gauge(
+    "serving_prefix_hit_ratio",
+    "Prefix-cache admission hit ratio since process start "
+    "(hits / (hits + misses); 0 before any lookup)",
+    labels=("engine",))
+
+SERVING_BUNDLE_BYTES = _R.histogram(
+    "serving_bundle_bytes",
+    "Size of sealed KV bundles crossing the host boundary, by kind "
+    "(preempt = eviction to host, migrate = export to a peer, handoff "
+    "= prefill->decode transfer)",
+    labels=("engine", "kind"),
+    buckets=(4096.0, 16384.0, 65536.0, 262144.0, 1048576.0, 4194304.0,
+             16777216.0, 67108864.0, 268435456.0, 1073741824.0))
+
 # ---- HTTP front-end ---------------------------------------------------------
 
 HTTP_REQUESTS = _R.counter(

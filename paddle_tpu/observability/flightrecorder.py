@@ -461,6 +461,11 @@ BUNDLE_SCHEMA = {
     # engine ever registered a profiler) — per-phase p50/p99, roofline
     # ratios, and the top-K slowest recent steps at crash time
     "profile": (dict, type(None)),
+    # the KV & memory atlas (kvatlas.kvstate_payload(); None when no
+    # engine ever registered an atlas) — pool occupancy, per-slot page
+    # ledger, host-parked preemption bytes and the prefix-reuse index
+    # at crash time: the memory story behind an OOM incident
+    "kvstate": (dict, type(None)),
 }
 
 _EVENT_KEYS = ("seq", "ts", "mono_ns", "kind", "tid")
@@ -469,7 +474,7 @@ _EVENT_KEYS = ("seq", "ts", "mono_ns", "kind", "tid")
 # them, but a reader must keep accepting bundles written before they
 # existed (the version string is unchanged — the addition is additive)
 _OPTIONAL_KEYS = frozenset({"lock_witness", "timeseries", "alerts",
-                            "profile"})
+                            "profile", "kvstate"})
 
 
 def validate_bundle(bundle: dict) -> dict:
@@ -553,6 +558,20 @@ def _profile_section() -> Optional[dict]:
             return None
         return _perf.profile_payload()
     except Exception:  # pdlint: disable=silent-exception -- a crash dump must not die on an optional perf surface; the bundle just omits it
+        return None
+
+
+def _kvstate_section() -> Optional[dict]:
+    """The KV & memory atlas for the bundle (None when no engine ever
+    registered an atlas — processes without serving engines and old
+    readers see the same absent shape)."""
+    try:
+        from . import kvatlas as _kvatlas
+
+        if not _kvatlas._ATLASES:
+            return None
+        return _kvatlas.kvstate_payload()
+    except Exception:  # pdlint: disable=silent-exception -- a crash dump must not die on an optional memory surface; the bundle just omits it
         return None
 
 
@@ -806,6 +825,7 @@ class IncidentReporter:
             "timeseries": _timeseries_window(),
             "alerts": _alerts_state(),
             "profile": _profile_section(),
+            "kvstate": _kvstate_section(),
         }
 
     def dump(self, reason: str, exc: Optional[BaseException] = None,

@@ -32,6 +32,7 @@ import numpy as np
 from .chaos import inject as _chaos
 from .observability import catalog as _metrics
 from .observability import flightrecorder as _frec
+from .observability import kvatlas as _kvatlas
 from .observability import perf as _perf
 from .observability import tracing as _tracing
 from .tensor_class import Tensor, unwrap
@@ -390,6 +391,11 @@ class _RequestBookkeeping:
         # — every hot site checks prof.enabled first); the HTTP server
         # or a bench harness enables it
         self.profiler = _perf.StepProfiler(engine)
+        # KV & memory atlas: same guarded-fast-path contract. This
+        # degenerate (unpaged) instance keeps every surface total;
+        # engines with a paged pool replace it with a configured one
+        self.kvatlas = _kvatlas.KvAtlas(
+            engine, max_batch=int(getattr(self, "max_batch", 0) or 0))
         # overload estimators, both engine-thread-only: the FLOOR of
         # admission->first-token (best case ever observed — a request
         # whose remaining budget is below even that is PROVABLY
@@ -476,6 +482,9 @@ class _RequestBookkeeping:
             # — the router federates these as cluster_* series, so a
             # perf regression on one replica is visible tier-wide
             **self.profiler.federated(),
+            # KV-atlas scalars ride the same transport: /health -> pool
+            # probe cache -> router TSDB collector (cluster_kv_*)
+            **self.kvatlas.federated(),
         }
 
     def _count_finished(self, req: "_Request", slo: bool = True):
@@ -501,14 +510,22 @@ class _RequestBookkeeping:
         "what was the engine doing when it died" without a debugger."""
         slots = []
         for s, r in enumerate(self._slots):
-            slots.append(None if r is None else {
+            if r is None:
+                slots.append(None)
+                continue
+            row = {
                 "rid": r.rid,
                 "prompt_tokens": int(r.ids.size),
                 "generated": len(r.tokens),
                 "max_new_tokens": r.max_new_tokens,
                 "slot": s,
                 "priority": r.priority,
-            })
+            }
+            # atlas ledger columns (page/byte footprint + prefix reuse
+            # depth); computed from the row's lengths when disabled
+            row.update(self.kvatlas.slot_info(
+                s, int(r.ids.size) + len(r.tokens)))
+            slots.append(row)
         return {
             "engine": self._engine_label,
             "max_batch": self.max_batch,
@@ -686,6 +703,9 @@ class _RequestBookkeeping:
         for i, req in enumerate(self._queue):
             if req.rid == rid:
                 del self._queue[i]
+                at = self.kvatlas
+                if at.enabled:
+                    at.unpark(rid)  # a preempted request dies in queue
                 if rec.enabled:
                     rec.record(_frec.EV_CANCEL, rid=rid,
                                engine=self._engine_label, where="queued")
@@ -696,6 +716,8 @@ class _RequestBookkeeping:
             if req is not None and req.rid == rid:
                 self._slots[s] = None
                 self._lengths = self._lengths.at[s].set(0)
+                if self.kvatlas.enabled:
+                    self.kvatlas.free_slot(s)
                 if rec.enabled:
                     rec.record(_frec.EV_CANCEL, rid=rid,
                                engine=self._engine_label, where="active")
@@ -709,6 +731,8 @@ class _RequestBookkeeping:
             if st.req.rid == rid:
                 del self._chunking[s]
                 self._lengths = self._lengths.at[s].set(0)
+                if self.kvatlas.enabled:
+                    self.kvatlas.free_slot(s)
                 if st.span is not None:
                     st.span.end("cancelled")
                 if rec.enabled:
@@ -1023,6 +1047,28 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         # cost model (None keeps phase attribution without a roofline)
         self.profiler.set_cost_params(
             _perf.decode_step_params(cfg, max_batch))
+        # KV & memory atlas, configured with this pool's real geometry —
+        # replaces the degenerate instance _init_bookkeeping registered.
+        # preflight_bytes is the PREDICTED pool footprint (the memory
+        # analogue of the profiler's roofline join): measured occupancy
+        # is reported against it on /kvstate and in bench kv legs
+        try:
+            from .analysis.graph.cost import kv_cache_bytes as _kv_pre
+
+            _preflight = int(_kv_pre(cfg, max_batch, max_len)) or None
+        except Exception:  # pdlint: disable=silent-exception -- the preflight join is best-effort; the ledger stays exact without it
+            _preflight = None
+        self.kvatlas = _kvatlas.KvAtlas(
+            "decoder", max_batch=max_batch, page_size=page_size,
+            pages_per_slot=self._pages_per_slot,
+            bytes_per_token=_kvatlas.kv_bytes_per_token(cfg),
+            paged=not self._latent_mode, preflight_bytes=_preflight)
+        # sealed-bundle size histogram children (preempt eviction,
+        # migration export, prefill->decode handoff) — always-on like
+        # the other engine histograms, not atlas-gated
+        self._m_bundle = {
+            k: _metrics.SERVING_BUNDLE_BYTES.labels(engine="decoder", kind=k)
+            for k in ("preempt", "migrate", "handoff")}
 
         # ---- SLO-aware scheduling ---------------------------------------
         # chunked prefill: admission prefill lands prefill_chunk_tokens at
@@ -1230,6 +1276,10 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         notifies the front-end through req.on_shed so an HTTP submission
         answers a typed 504/429 instead of stalling silently."""
         self._queue.remove(req)
+        if self.kvatlas.enabled:
+            # a preempted request shed from the queue abandons its
+            # host-parked bundle
+            self.kvatlas.unpark(req.rid)
         now = time.perf_counter()
         miss_ms = ((now - req.deadline) * 1000.0
                    if req.deadline != math.inf else None)
@@ -1421,6 +1471,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         local prefill — no model forward runs here."""
         h, req.handoff = req.handoff, None  # free the host KV after use
         bucket, S0 = int(h["bucket"]), int(h["prompt_tokens"])
+        self._m_bundle["handoff"].observe(float(
+            sum(k.nbytes + v.nbytes for k, v in h["layers"])))
         c_new = [{"k": jnp.asarray(k)[None], "v": jnp.asarray(v)[None]}
                  for k, v in h["layers"]]
         base = slot * self._pages_per_slot
@@ -1494,6 +1546,9 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         })
         self._slots[slot] = None
         self._lengths = self._lengths.at[slot].set(0)
+        self._m_bundle["migrate"].observe(float(nbytes))
+        if self.kvatlas.enabled:
+            self.kvatlas.free_slot(slot)
         self._n_migrated_out += 1
         self._m_sched["migrate_out"].inc()
         rec = _frec.RECORDER
@@ -1570,6 +1625,11 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         req.resume = seal_bundle({
             "bucket": bucket, "kv_len": kv_len,
             "layers": handoff["layers"], "last": handoff["last"]})
+        if self.kvatlas.enabled:
+            # the bundle parks host-side until a slot frees and the
+            # restore scatters it back (unpark in _restore_into)
+            self.kvatlas.park(rid, int(
+                sum(k.nbytes + v.nbytes for k, v in handoff["layers"])))
         self._trace_submit(req, trace_ctx)
         self._queue.append(req)
         self._fr_submit(req)
@@ -1691,12 +1751,16 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         events = []  # (cb, rid, token, done): fired AFTER bookkeeping, so a
         # raising callback cannot leave _lengths/slot state desynced from
         # the already-advanced device step
+        at = self.kvatlas
+        at_on = at.enabled  # hoisted: one predicate for the whole loop
         for s, req in enumerate(self._slots):
             if req is None:
                 continue
             req.dispatches += 1
             t = int(toks[s])
             req.tokens.append(t)
+            if at_on:
+                at.advance(s)
             lp = float(lps[s])
             if req.want_logprobs:
                 req.logprobs.append(lp)
@@ -1745,6 +1809,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             self._count_finished(req)
             self._slots[s] = None
             self._lengths = self._lengths.at[s].set(0)
+            if at_on:
+                at.free_slot(s)
             self._trace_end(req, "ok")
         # stream AFTER state is consistent: every callback fires even if an
         # earlier one raises; the first exception then propagates
@@ -1873,6 +1939,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         events = []
         adv = np.zeros(self.max_batch, np.int64)
         accepted_total = emitted_total = slot_rounds = 0
+        at = self.kvatlas
+        at_on = at.enabled
         for s, req in enumerate(self._slots):
             if req is None:
                 continue
@@ -1900,6 +1968,11 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 if req.want_logprobs:
                     req.logprobs.append(float(lps[s, j]))
                 self._observe_token(req, now)
+            if at_on and deliver:
+                # ledger frontier = delivered tokens only; rejected-draft
+                # KV above it is garbage the next scatter overwrites, so
+                # it is rightly uncounted
+                at.advance(s, len(deliver))
             req.spec_rounds += 1
             req.spec_accepted += len(deliver) - 1
             accepted_total += len(deliver) - 1
@@ -1953,6 +2026,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             self._count_finished(req)
             self._slots[s] = None
             self._lengths = self._lengths.at[s].set(0)
+            if at_on:
+                at.free_slot(s)
             self._trace_end(req, "ok")
         # stream AFTER state is consistent (same protocol as step())
         first_exc = None
@@ -2068,10 +2143,14 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             cands = [r for r in self._slots if r is not None]
             victim = max(cands, key=lambda r: (r.t_admit or 0.0, r.rid)) \
                 if cands else None
+        if self.kvatlas.enabled:
+            self.kvatlas.set_budget(self.max_active_slots)
         if (victim is not None and victim.slot >= 0
                 and self._slots[victim.slot] is victim):
             self._slots[victim.slot] = None
             self._lengths = self._lengths.at[victim.slot].set(0)
+            if self.kvatlas.enabled:
+                self.kvatlas.free_slot(victim.slot)
             victim.slot = -1
         self._n_degraded += 1
         self._m_sched["degrade"].inc()
@@ -2149,6 +2228,9 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                     self._m_prefill.observe(time.perf_counter() - t_adm)
                 self._slots[slot] = req
                 req.slot = slot
+                if self.kvatlas.enabled:
+                    self.kvatlas.set_slot(
+                        slot, int(req.ids.size) + len(req.tokens))
                 self._fr_page_pressure()
                 continue
             if self._start_chunked(slot, req, t_adm):
@@ -2174,6 +2256,9 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 self._m_prefill.observe(time.perf_counter() - t_adm)
             self._slots[slot] = req
             req.slot = slot
+            if self.kvatlas.enabled:
+                self.kvatlas.set_slot(
+                    slot, int(req.ids.size) + len(req.tokens))
             self._fr_page_pressure()
 
     # ---- preemption: KV eviction to host, restore on re-admission -------
@@ -2241,6 +2326,11 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._lengths = self._lengths.at[s].set(0)
         req.slot = -1
         self._queue.append(req)
+        self._m_bundle["preempt"].observe(float(nbytes))
+        if self.kvatlas.enabled:
+            # device pages freed, host bundle parked until restore
+            self.kvatlas.free_slot(s)
+            self.kvatlas.park(req.rid, nbytes)
         self._m_sched["preempt"].inc()
         rec = _frec.RECORDER
         if rec.enabled:
@@ -2277,6 +2367,10 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._last = self._last.at[slot].set(
             jnp.asarray(r["last"], jnp.float32))
         self._lengths = self._lengths.at[slot].set(kv_len)
+        if self.kvatlas.enabled:
+            # the host bundle was consumed by the scatter; the slot's
+            # ledger entry publishes at the _admit restore site
+            self.kvatlas.unpark(req.rid)
         self._m_sched["restore"].inc()
         rec = _frec.RECORDER
         if rec.enabled:
@@ -2337,6 +2431,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                                        req.ids[pref_len:pref_len + take])
                 self.prefix_pages_reused += n_pref
                 self._m_prefix_pages.inc(n_pref)
+                if self.kvatlas.enabled:
+                    self.kvatlas.note_prefix_hit(slot, req.ids, n_pref)
                 st.pos = pref_len + take
             else:
                 take = min(ct, S0)
@@ -2356,6 +2452,10 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             # at lengths[slot], and the next chunk's scatter starts
             # exactly there — the garbage never survives into a gather
             self._lengths = self._lengths.at[slot].set(st.pos)
+            if self.kvatlas.enabled:
+                # ledger frontier tracks landed chunks only (the
+                # throwaway decode writes above it are uncounted garbage)
+                self.kvatlas.set_slot(slot, st.pos, chunk=True)
         self._m_sched["chunk"].inc()
         rec = _frec.RECORDER
         if rec.enabled:
@@ -2367,6 +2467,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             del self._chunking[slot]
             self._lengths = self._lengths.at[slot].set(S0)
             self._slots[slot] = req
+            if self.kvatlas.enabled:
+                self.kvatlas.set_slot(slot, S0)  # chunk flag clears here
             if st.span is not None:
                 st.span.end()
             with _tracing.get_tracer().use(req.span):
@@ -2472,6 +2574,9 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 if n > best_n:
                     best_slot, best_n = s, n
             (self._m_prefix_hit if best_n > 0 else self._m_prefix_miss).inc()
+            if best_n <= 0 and self.kvatlas.enabled:
+                # hits index at the slot-aware admission sites instead
+                self.kvatlas.note_prefix_miss()
             sp.set_attr("pages", best_n)
             return best_slot, best_n
 
@@ -2688,6 +2793,9 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._lengths = self._lengths.at[slot].set(S0)
         self.prefix_pages_reused += n_pref
         self._m_prefix_pages.inc(n_pref)
+        if self.kvatlas.enabled:
+            # reuse depth rides to the slot's publish in _admit
+            self.kvatlas.note_prefix_hit(slot, req.ids, n_pref)
 
     def _prefill_with_prefix_latent(self, slot: int, req: _Request,
                                     src: int, n_pref: int):

@@ -58,7 +58,7 @@ class WorkerInfo:
     __slots__ = ("replica_id", "role", "host", "port", "pid", "kv_channel",
                  "alive", "lease_age_s", "active", "queued", "pending",
                  "probe_ok", "marked_dead_at", "busy_until", "draining",
-                 "finished", "probed_at", "drain_rate", "stats")
+                 "finished", "probed_at", "drain_rate", "stats", "kv")
 
     def __init__(self, replica_id: int, meta: dict):
         self.replica_id = replica_id
@@ -86,6 +86,10 @@ class WorkerInfo:
         # router's federation collector turns into per-replica
         # cluster_* time series (empty until the first probe)
         self.stats: dict = {}
+        # the worker's published KV summary (prefix-hash index top,
+        # headroom, hit ratio) off the store metadata — the
+        # prefix-affinity / capacity feedstock; refreshed every poll
+        self.kv = meta.get("kv")
 
     @property
     def url(self) -> str:
@@ -110,6 +114,7 @@ class WorkerInfo:
             "busy": self.busy_until > time.monotonic(),
             "draining": self.draining,
             "drain_rate": self.drain_rate,
+            "kv": self.kv,
         }
 
 
@@ -184,13 +189,15 @@ class WorkerPool:
                 meta = self._mgr.peer_metadata(r)
                 if meta is not None:
                     joined.append((r, meta))
-            elif not w.alive or not w.probe_ok:
-                # a dead-or-unprobeable worker with a fresh lease may be
-                # a supervised RESTART of the same replica: its metadata
-                # (port, pid, kv channel) is new, so refetch it until
-                # the worker probes healthy again — rejoining on the
-                # dead incarnation's port would bounce placements into
-                # a closed socket forever
+            else:
+                # refetch metadata for every live rank each poll: a
+                # dead-or-unprobeable worker with a fresh lease may be a
+                # supervised RESTART of the same replica whose address
+                # (port, pid, kv channel) is new — rejoining on the dead
+                # incarnation's port would bounce placements into a
+                # closed socket forever — and a HEALTHY worker
+                # republishes its kv summary (prefix hashes + headroom)
+                # on the lease cadence, which only this read can see
                 meta = self._mgr.peer_metadata(r)
                 if meta is not None:
                     refreshed[r] = meta
@@ -212,6 +219,8 @@ class WorkerPool:
                 if r in alive:
                     w.lease_age_s = ages.get(r)
                     meta = refreshed.get(r)
+                    if meta is not None:
+                        w.kv = meta.get("kv", w.kv)
                     if meta is not None and meta.get("pid") != w.pid:
                         # a different pid behind the same replica id:
                         # the supervisor respawned it — adopt the fresh

@@ -44,8 +44,8 @@ from ..observability import tracing as _tracing
 from ..observability.catalog import ROUTER_PLACEMENTS
 from ..observability.metrics import PROMETHEUS_CONTENT_TYPE, get_registry
 from ..serving_http import (DEADLINE_HEADER, ServingHandlerBase,
-                            alerts_payload, profile_payload,
-                            timeseries_payload)
+                            alerts_payload, kvstate_payload,
+                            profile_payload, timeseries_payload)
 from .pool import WorkerInfo, WorkerPool, jittered
 
 __all__ = ["RouterServer"]
@@ -315,6 +315,16 @@ class RouterServer:
         ("profile_roofline_ratio", "cluster_profile_roofline_ratio"),
     )
 
+    # KV-atlas scalars federated as per-replica GAUGES (the
+    # watch_cluster MEM panel's sparkline feed + the capacity signal
+    # ROADMAP item 4 consumes); same zero-I/O transport
+    _FEDERATED_KV = (
+        ("kv_pages_in_use", "cluster_kv_pages_in_use"),
+        ("kv_bytes", "cluster_kv_bytes"),
+        ("kv_headroom_slots", "cluster_kv_headroom_slots"),
+        ("prefix_hit_ratio", "cluster_prefix_hit_ratio"),
+    )
+
     def _collect_cluster(self) -> list:
         """ts-sampler collector: pool/supervisor-derived series. Reads
         ONLY state the pool's own /health probes already hold — a
@@ -331,6 +341,10 @@ class RouterServer:
                     out.append((series, "counter", labels,
                                 float(stats.get(key) or 0), None))
             for key, series in self._FEDERATED_PERF:
+                if key in stats:
+                    out.append((series, "gauge", labels,
+                                float(stats.get(key) or 0), None))
+            for key, series in self._FEDERATED_KV:
                 if key in stats:
                     out.append((series, "gauge", labels,
                                 float(stats.get(key) or 0), None))
@@ -431,6 +445,31 @@ class RouterServer:
                 out["errors"][rid] = f"{type(e).__name__}: {e}"
         return out
 
+    def _cluster_kvstate(self, query: str) -> dict:
+        """``GET /kvstate/cluster``: every live worker's /kvstate fetched
+        and keyed by replica id, plus each replica's pool-published kv
+        summary (prefix hashes + headroom off store metadata — readable
+        even when the worker's HTTP fetch fails). Same contract as the
+        other federations: fetch failures land in ``errors``, never a
+        5xx."""
+        q = f"?{query}" if query else ""
+        timeout = getattr(self.pool, "_probe_timeout", 2.0)
+        out: dict = {"schema_version": 1, "replicas": {}, "errors": {},
+                     "pool": {}}
+        for w in self.pool.workers():
+            if not w["alive"]:
+                continue
+            rid = str(w["replica_id"])
+            if w.get("kv") is not None:
+                out["pool"][rid] = w["kv"]
+            try:
+                with urllib.request.urlopen(w["url"] + "/kvstate" + q,
+                                            timeout=timeout) as r:
+                    out["replicas"][rid] = json.loads(r.read())
+            except (OSError, ValueError) as e:
+                out["errors"][rid] = f"{type(e).__name__}: {e}"
+        return out
+
     def _extra_get(self, handler, route, query) -> bool:
         if route == "/metrics/cluster":
             handler._count(200)
@@ -448,6 +487,14 @@ class RouterServer:
             return True
         if route == "/profile/cluster":
             handler._json(200, self._cluster_profile(query))
+            return True
+        if route == "/kvstate":
+            # no engine in the router process — the (empty) local atlas
+            # view; the federated one is next door
+            handler._json(200, kvstate_payload(query))
+            return True
+        if route == "/kvstate/cluster":
+            handler._json(200, self._cluster_kvstate(query))
             return True
         return False
 

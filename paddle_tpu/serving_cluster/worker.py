@@ -565,15 +565,36 @@ def run_worker(cfg: dict):
     # lease first, metadata second: the pool only reads metadata for
     # ranks whose lease is already fresh, so a half-registered worker is
     # invisible rather than half-visible
-    elastic.register()
-    elastic.register_metadata({
+    meta = {
         "host": host, "port": port, "role": role, "pid": os.getpid(),
         "kv_channel": kv_receiver.name if kv_receiver else None,
-    })
+    }
+
+    def _kv_meta():
+        # prefix-hash summary + headroom for the router: the
+        # prefix-affinity / capacity feedstock (ROADMAP items 3a, 4)
+        atlas = getattr(engine, "kvatlas", None)
+        return atlas.cluster_summary() if atlas is not None else None
+
+    elastic.register()
+    elastic.register_metadata(dict(meta, kv=_kv_meta()))
     get_logger().info("cluster worker %s (%s) serving on %s:%s",
                       replica_id, role, host, port)
 
     done = threading.Event()
+
+    def _republish():
+        # register_metadata is a plain store set, so the kv summary can
+        # refresh on the lease cadence; the pool re-reads metadata for
+        # alive ranks every refresh()
+        while not done.wait(max(1.0, ttl / 2.0)):
+            try:
+                elastic.register_metadata(dict(meta, kv=_kv_meta()))
+            except Exception:  # pdlint: disable=silent-exception -- a metadata refresh must never kill the serving worker; the stale summary just ages out
+                pass
+
+    threading.Thread(target=_republish, daemon=True,
+                     name="kv-meta-republish").start()
 
     def _term(signum, frame):
         # clean teardown: deregister (peers must not read this exit as a

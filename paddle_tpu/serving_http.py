@@ -62,6 +62,7 @@ import numpy as np
 
 from .observability import PROMETHEUS_CONTENT_TYPE, get_registry
 from .observability import flightrecorder as _frec
+from .observability import kvatlas as _kvatlas
 from .observability import perf as _perf
 from .observability import tracing as _tracing
 from .observability.catalog import HTTP_REQUESTS
@@ -69,7 +70,7 @@ from .serving import DeadlineExceeded, QueueFull
 
 __all__ = ["CompletionServer", "ServingHandlerBase", "serve",
            "DEADLINE_HEADER", "timeseries_payload", "alerts_payload",
-           "profile_payload"]
+           "profile_payload", "kvstate_payload"]
 
 #: end-to-end deadline propagation: the cluster router stamps each
 #: upstream hop with the request's REMAINING budget in milliseconds, so
@@ -83,7 +84,8 @@ DEADLINE_HEADER = "X-Request-Deadline"
 _KNOWN_ROUTES = ("/health", "/metrics", "/metrics/cluster", "/v1/models",
                  "/v1/completions", "/v1/prefill", "/trace",
                  "/trace/chrome", "/debug/dump", "/debug/events",
-                 "/timeseries", "/alerts", "/profile", "/profile/cluster")
+                 "/timeseries", "/alerts", "/profile", "/profile/cluster",
+                 "/kvstate", "/kvstate/cluster")
 
 
 def timeseries_payload(query: str) -> dict:
@@ -121,6 +123,16 @@ def profile_payload(query: str = "") -> dict:
         except ValueError:
             top_k = 5
     return _perf.profile_payload(top_k)
+
+
+def kvstate_payload(query: str = "") -> dict:
+    """``GET /kvstate`` body: every registered engine's KV & memory
+    atlas — pool occupancy/headroom, the per-slot page ledger, the
+    prefix-reuse index, host-parked preemption bytes, the
+    measured-vs-preflight capacity join, and the time-to-full forecast
+    (docs/SERVING.md 'KV & memory atlas')."""
+    del query  # no parameters yet; signature matches the payload peers
+    return _kvatlas.kvstate_payload()
 
 
 def alerts_payload(manager) -> dict:
@@ -486,6 +498,11 @@ class CompletionServer:
         prof = getattr(engine, "profiler", None)
         if prof is not None:
             prof.enable()
+        # the server also serves /kvstate: the KV & memory atlas gets a
+        # subscriber the moment an HTTP front-end wraps the engine
+        atlas = getattr(engine, "kvatlas", None)
+        if atlas is not None:
+            atlas.enable()
         self._subs: "queue.Queue[_Submission]" = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._engine_loop,
@@ -714,6 +731,9 @@ class CompletionServer:
     def _extra_get(self, handler, route, query) -> bool:
         if route == "/profile":
             handler._json(200, profile_payload(query))
+            return True
+        if route == "/kvstate":
+            handler._json(200, kvstate_payload(query))
             return True
         return False
 

@@ -390,6 +390,70 @@ def render_index(directory: str, last: int = 30) -> str:
     return "\n".join("\n".join(s) for s in sections if s)
 
 
+def _fmt_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n:.0f}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def format_kvstate(b: dict) -> List[str]:
+    """The KV/MEMORY section: the memory story at dump time (absent
+    for bundles written before the ``kvstate`` key existed, or from
+    processes without serving engines)."""
+    kv = b.get("kvstate")
+    if not kv:
+        return []
+    lines = ["KV/MEMORY (atlas at dump time)"]
+    for name, a in sorted((kv.get("engines") or {}).items()):
+        pool = (f"  [{name}] {a.get('pages_in_use', 0)} pages "
+                f"({_fmt_bytes(a.get('bytes_in_use', 0))}) of "
+                f"{a.get('capacity_pages', 0)} "
+                f"({_fmt_bytes(a.get('capacity_bytes', 0))}), "
+                f"headroom {a.get('headroom_slots', '?')} slots "
+                f"({100.0 * (a.get('headroom_frac') or 0):.0f}%), "
+                f"peak {a.get('pages_peak', 0)} pages")
+        lines.append(pool)
+        if a.get("chunk_parked_pages"):
+            lines.append(f"    chunk-frontier parked: "
+                         f"{a['chunk_parked_pages']} pages")
+        if a.get("host_parked_requests"):
+            lines.append(
+                f"    host-parked (preempted): "
+                f"{a['host_parked_requests']} requests, "
+                f"{_fmt_bytes(a.get('host_parked_bytes', 0))}")
+        pref = a.get("prefix") or {}
+        if pref.get("hits") or pref.get("misses"):
+            lines.append(
+                f"    prefix reuse: {pref.get('hits', 0)} hits / "
+                f"{pref.get('misses', 0)} misses "
+                f"(ratio {pref.get('hit_ratio', 0.0):.3f}, "
+                f"{pref.get('index_size', 0)} indexed)")
+            for e in (pref.get("index") or [])[:5]:
+                lines.append(f"      prefix {e.get('hash')}: "
+                             f"{e.get('hits')} hits, "
+                             f"{e.get('pages')} pages deep")
+        for s, row in sorted((a.get("slots") or {}).items(),
+                             key=lambda kv_: int(kv_[0])):
+            lines.append(
+                f"    slot {s}: {row.get('pages')} pages "
+                f"({_fmt_bytes(row.get('bytes', 0))}), "
+                f"{row.get('tokens')} tokens"
+                + (f", {row['prefix_pages']} prefix pages"
+                   if row.get("prefix_pages") else "")
+                + (" [chunk frontier]" if row.get("chunk") else ""))
+        fc = a.get("forecast") or {}
+        if fc.get("eta_s") is not None:
+            lines.append(f"    forecast: pool full in {fc['eta_s']:.0f}s "
+                         f"at net {fc.get('net_slots_per_s'):.2f} slots/s")
+    return lines
+
+
 def format_spans(b: dict, last: int = 10) -> List[str]:
     spans = b.get("spans") or []
     if not spans:
@@ -417,6 +481,7 @@ def render(b: dict, events: int = 30, per_subsystem: int = 5,
             format_admission(b),
             format_chaos(b),
             format_engines(b),
+            format_kvstate(b),
             format_spans(b),
             format_lock_witness(b),
             format_threads(b),

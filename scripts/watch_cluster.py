@@ -48,6 +48,18 @@ PERF_METRICS = (
     ("serving_mfu", "%.3f"),
 )
 
+#: memory panel series (same shape as PERF_METRICS): the router's
+#: federated per-replica KV-atlas gauges first, then the process-local
+#: gauges a single server publishes
+MEM_METRICS = (
+    ("cluster_kv_bytes", "%.0f B"),
+    ("cluster_kv_headroom_slots", "%.0f"),
+    ("cluster_prefix_hit_ratio", "%.3f"),
+    ("serving_kv_bytes", "%.0f B"),
+    ("serving_kv_headroom_slots", "%.0f"),
+    ("serving_prefix_hit_ratio", "%.3f"),
+)
+
 
 def _get(url: str, timeout: float = 5.0):
     with urllib.request.urlopen(url, timeout=timeout) as r:
@@ -184,6 +196,21 @@ def render(snap: dict, metrics) -> str:
         lines.append("PERF  (decode step anatomy & roofline — see "
                      "GET /profile for the per-phase breakdown)")
         lines.extend(perf_rows)
+    # ---- memory panel: KV atlas ---------------------------------------
+    mem_rows = []
+    for metric, fmt in MEM_METRICS:
+        for s in series_windows(ts, metric):
+            if not s["values"]:
+                continue
+            label = f"{metric}{{{s['labels']}}}" if s["labels"] \
+                else metric
+            mem_rows.append(
+                f"  {label:<52} {sparkline(s['values'])} "
+                f"last={fmt % s['last']}")
+    if mem_rows:
+        lines.append("MEM  (KV pool occupancy & prefix reuse — see "
+                     "GET /kvstate for the per-slot ledger)")
+        lines.extend(mem_rows)
     # ---- sparklines ---------------------------------------------------
     if ts.get("error"):
         lines.append(f"TIMESERIES  unavailable ({ts['error']})")

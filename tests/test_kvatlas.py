@@ -1,0 +1,594 @@
+"""KV & memory atlas (observability/kvatlas.py): the live page-pool
+ledger, prefix-reuse telemetry, capacity forecasting, and cluster
+memory federation (docs/SERVING.md "KV & memory atlas").
+
+THE correctness gate pinned here is the exactness invariant: at every
+engine step of a chunked / speculative / preempted / migrated run, the
+atlas's incrementally-maintained totals equal ``kvatlas.recompute`` —
+pool pages and bytes recomputed from engine config + live slot lengths
+— while the runs themselves stay token-identical to solo decodes. Plus
+the < 1% enabled-overhead gate, the disabled-by-default contract, the
+``GET /kvstate`` / ``GET /kvstate/cluster`` surfaces, the TSDB
+time-to-full forecast on a fake clock, and the incident-bundle
+``kvstate`` section with its read_incident rendering.
+"""
+import http.client
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import catalog as cat
+from paddle_tpu.observability import flightrecorder as frec
+from paddle_tpu.observability import kvatlas
+from paddle_tpu.serving import ContinuousBatchEngine
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+
+
+def _solo(model, prompt, new):
+    return model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                          max_new_tokens=new).numpy()[0]
+
+
+def _assert_exact(eng):
+    """The invariant: ledger totals == ground truth recomputed from
+    engine config + slot lengths, byte for byte."""
+    gt = kvatlas.recompute(eng)
+    at = eng.kvatlas
+    with at._lock:
+        pages, chunk_pages = at._pages, at._chunk_pages
+    assert pages == gt["pages"], (
+        f"ledger {pages} pages != recomputed {gt['pages']}")
+    assert pages * at.bytes_per_page == gt["bytes"]
+    assert 0 <= chunk_pages <= pages
+
+
+def _run_exact(eng, max_steps=600):
+    """Step to completion, checking exactness after EVERY step."""
+    done = {}
+    for _ in range(max_steps):
+        done.update(eng.step())
+        _assert_exact(eng)
+        if eng.num_active == 0 and not eng._queue \
+                and not eng._chunking:
+            break
+    return done
+
+
+# ---- exactness legs ---------------------------------------------------------
+
+def test_exactness_chunked_prefill_with_prefix_reuse(tiny_model):
+    """Chunked prefill + prefix-cache hit: the ledger tracks the chunk
+    frontier's parked pages exactly at every step, adopts the reuse
+    depth into the slot entry, and the run stays token-identical."""
+    m = tiny_model
+    rng = np.random.RandomState(11)
+    base = rng.randint(0, m.config.vocab_size, (24,))
+    p_a = np.concatenate([base, rng.randint(0, m.config.vocab_size, (9,))])
+    p_b = np.concatenate([base, rng.randint(0, m.config.vocab_size, (17,))])
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8,
+                                prefill_chunk_tokens=16,
+                                enable_prefix_cache=True)
+    at = eng.kvatlas.enable()
+    r_a = eng.add_request(p_a, max_new_tokens=8)
+    saw_chunk = False
+    for _ in range(4):
+        eng.step()
+        _assert_exact(eng)
+        saw_chunk = saw_chunk or at._chunk_pages > 0
+    assert saw_chunk, "chunk frontier never parked pages in the ledger"
+    r_b = eng.add_request(p_b, max_new_tokens=8)
+    done = _run_exact(eng)
+    np.testing.assert_array_equal(done[r_a], _solo(m, p_a, 8))
+    np.testing.assert_array_equal(done[r_b], _solo(m, p_b, 8))
+    # the reuse landed in the prefix index and the hit ratio moved
+    assert at._prefix_hits >= 1
+    pay = at.payload()
+    assert pay["prefix"]["hit_ratio"] > 0
+    assert pay["prefix"]["index"], "reused prefix never indexed"
+    assert pay["prefix"]["index"][0]["pages"] >= 1
+    # drained: every page and parked byte released
+    assert pay["pages_in_use"] == 0 and pay["host_parked_bytes"] == 0
+    assert pay["chunk_parked_pages"] == 0
+    assert pay["pages_peak"] > 0
+
+
+def test_exactness_speculative(tiny_model):
+    """Speculative decode: the ledger frontier advances by DELIVERED
+    tokens only (rejected-draft KV above it is garbage the next scatter
+    overwrites), so recompute from ids+tokens matches every step."""
+    m = tiny_model
+    p = np.tile(np.asarray([3, 5, 7, 9]), 8)
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=128, page_size=8,
+                                speculative_k=4)
+    eng.kvatlas.enable()
+    rid = eng.add_request(p, max_new_tokens=16)
+    done = _run_exact(eng)
+    np.testing.assert_array_equal(done[rid], _solo(m, p, 16))
+    assert eng.stats()["spec_dispatches"] > 0
+
+
+def test_exactness_preempt_restore(tiny_model):
+    """Preempt→restore: eviction frees the slot's device pages and
+    parks the bundle bytes host-side; restore consumes the parked bytes
+    and republishes the slot — exact at every step, token-identical."""
+    m = tiny_model
+    rng = np.random.RandomState(4)
+    long_p = rng.randint(0, m.config.vocab_size, (41,))
+    short_p = rng.randint(0, m.config.vocab_size, (5,))
+    eng = ContinuousBatchEngine(m, max_batch=1, max_len=64, page_size=8,
+                                enable_preemption=True)
+    at = eng.kvatlas.enable()
+    n0 = cat.SERVING_BUNDLE_BYTES.count(engine="decoder", kind="preempt")
+    victim = eng.add_request(short_p, max_new_tokens=12, priority=2)
+    for _ in range(3):
+        eng.step()
+        _assert_exact(eng)
+    hi = eng.add_request(long_p, max_new_tokens=6, priority=0)
+    saw_parked = False
+    done = {}
+    for _ in range(600):
+        done.update(eng.step())
+        _assert_exact(eng)
+        saw_parked = saw_parked or at._parked_bytes > 0
+        if eng.num_active == 0 and not eng._queue:
+            break
+    np.testing.assert_array_equal(done[hi], _solo(m, long_p, 6))
+    np.testing.assert_array_equal(done[victim], _solo(m, short_p, 12))
+    assert saw_parked, "preempted bundle never parked host bytes"
+    assert at._parked_bytes == 0 and not at._parked     # restore unparked
+    assert cat.SERVING_BUNDLE_BYTES.count(engine="decoder",
+                                          kind="preempt") == n0 + 1
+
+
+def test_exactness_migration(tiny_model):
+    """export_slot frees the source ledger; admit_migrated parks the
+    bundle host-side on the destination until the restore scatters it
+    back — both ledgers exact throughout, stream token-identical."""
+    m = tiny_model
+    p = np.random.RandomState(11).randint(1, m.config.vocab_size, (9,))
+    n_tok = 10
+    solo = _solo(m, p, n_tok)
+    n0 = cat.SERVING_BUNDLE_BYTES.count(engine="decoder", kind="migrate")
+    src = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8)
+    src.kvatlas.enable()
+    rid = src.add_request(p, max_new_tokens=n_tok)
+    for _ in range(4):
+        src.step()
+        _assert_exact(src)
+    bundle = src.export_slot(rid)
+    _assert_exact(src)
+    with src.kvatlas._lock:
+        assert src.kvatlas._pages == 0      # migrated out: pool empty
+    assert cat.SERVING_BUNDLE_BYTES.count(engine="decoder",
+                                          kind="migrate") == n0 + 1
+    # a single-slot destination with the slot held: the bundle PARKS
+    # host-side until the holder retires and the restore scatters it
+    dst = ContinuousBatchEngine(m, max_batch=1, max_len=64, page_size=8)
+    at = dst.kvatlas.enable()
+    holder = dst.add_request(np.arange(1, 6), max_new_tokens=3)
+    dst.step()
+    _assert_exact(dst)
+    rid2 = dst.admit_migrated(bundle)
+    assert at._parked_bytes > 0             # parked until the restore
+    _assert_exact(dst)
+    done = _run_exact(dst)
+    assert holder in done
+    np.testing.assert_array_equal(done[rid2], solo)
+    assert at._parked_bytes == 0 and not at._parked
+
+
+def test_latent_engine_has_no_paged_pool():
+    """MLA engines carry no paged KV pool: the atlas reports paged=False
+    and zero pages while headroom/occupancy still track."""
+    from paddle_tpu.models.deepseek import (DeepseekV2Config,
+                                            DeepseekV2ForCausalLM)
+
+    paddle.seed(3)
+    m = DeepseekV2ForCausalLM(DeepseekV2Config.tiny_mla(num_hidden_layers=2))
+    eng = ContinuousBatchEngine(m, max_batch=2, max_len=64, page_size=8)
+    assert eng._latent_mode
+    at = eng.kvatlas.enable()
+    assert at.paged is False
+    rid = eng.add_request(np.arange(1, 8), max_new_tokens=4)
+    eng.step()
+    fed = at.federated()
+    assert fed["kv_pages_in_use"] == 0.0
+    assert fed["kv_headroom_slots"] == 1.0          # one of two slots
+    done = eng.run_until_done()
+    assert rid in done
+    assert at.federated()["kv_headroom_slots"] == 2.0
+    # per-token coefficient uses the latent layout (c_kv + k_pe rows)
+    cfg = m.config
+    item = kvatlas._dtype_bytes(getattr(cfg, "dtype", "bfloat16"))
+    expect = cfg.num_hidden_layers * (
+        cfg.kv_lora_rank + cfg.qk_rope_head_dim) * item
+    assert at.bytes_per_token == expect > 0
+
+
+def test_kv_bytes_per_token_paged_layout(tiny_model):
+    from paddle_tpu.models.llama import head_dim_of
+
+    cfg = tiny_model.config
+    item = kvatlas._dtype_bytes(getattr(cfg, "dtype", "bfloat16"))
+    hk = cfg.num_key_value_heads or cfg.num_attention_heads
+    expect = 2 * cfg.num_hidden_layers * hk * head_dim_of(cfg) * item
+    assert kvatlas.kv_bytes_per_token(cfg) == expect > 0
+    eng = ContinuousBatchEngine(tiny_model, max_batch=2, max_len=64,
+                                page_size=8)
+    at = eng.kvatlas
+    assert at.bytes_per_token == expect
+    assert at.bytes_per_page == expect * 8
+    # capacity arithmetic follows the engine geometry
+    assert at.pages_per_slot == 64 // 8
+    pay = at.payload()
+    assert pay["capacity_pages"] == 2 * (64 // 8)
+    assert pay["capacity_bytes"] == pay["capacity_pages"] * at.bytes_per_page
+
+
+# ---- disabled-by-default & the overhead gate --------------------------------
+
+def test_atlas_disabled_by_default_mutates_nothing(tiny_model):
+    eng = ContinuousBatchEngine(tiny_model, max_batch=2, max_len=64,
+                                page_size=8)
+    at = eng.kvatlas
+    assert at.enabled is False
+    rid = eng.add_request(np.arange(1, 8), max_new_tokens=4)
+    assert rid in eng.run_until_done()
+    assert at._mutations == 0 and not at._slots
+    # slot_info stays truthful through the computed fallback
+    info = at.slot_info(0, kv_tokens=17)
+    assert info["kv_pages"] == 3            # ceil(17 / 8)
+    assert info["kv_bytes"] == 3 * at.bytes_per_page
+    # stats() still carries the federated keys (zeros + full headroom),
+    # so the router's collector never KeyErrors on an atlas-off worker
+    st = eng.stats()
+    assert st["kv_pages_in_use"] == 0.0
+    assert st["kv_headroom_slots"] == 2.0
+    assert st["prefix_hit_ratio"] == 0.0
+
+
+def test_atlas_overhead_under_one_percent(tiny_model):
+    """The enabled per-step instrumentation (one advance per active
+    slot, gauge batch included) must cost < 1% of a real decode step."""
+    eng = ContinuousBatchEngine(tiny_model, max_batch=2, max_len=64,
+                                page_size=8)
+    eng.profiler.enable()
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        eng.add_request(rng.randint(1, tiny_model.config.vocab_size,
+                                    (5 + i,)), 12)
+    eng.run_until_done()
+    step_p50_ms = eng.profiler.payload()["step_ms"]["p50"]
+    assert step_p50_ms > 0
+
+    at = kvatlas.KvAtlas("overhead_gate", max_batch=2, page_size=8,
+                         pages_per_slot=8, bytes_per_token=1024,
+                         paged=True)
+    at.enable()
+    at.set_slot(0, 5)
+    at.set_slot(1, 7)
+    for _ in range(200):                    # warm the gauge-batch path
+        at.advance(0)
+        at.advance(1)
+    # min over rounds: a single scheduler preemption inflates a mean
+    # but not the best round, so the gate holds under full-suite load
+    rounds, per = 10, 200
+    over_ms = float("inf")
+    for _ in range(rounds):
+        at.set_slot(0, 5)
+        at.set_slot(1, 7)
+        t0 = time.perf_counter()
+        for _ in range(per):
+            at.advance(0)                   # the two-active-slot step
+            at.advance(1)
+        over_ms = min(over_ms, (time.perf_counter() - t0) * 1e3 / per)
+    assert over_ms < 0.01 * step_p50_ms, (
+        f"atlas overhead {over_ms * 1e3:.2f}us is "
+        f">= 1% of a {step_p50_ms:.3f}ms decode step")
+
+
+# ---- prefix-reuse index -----------------------------------------------------
+
+def test_prefix_key_is_page_aligned():
+    at = kvatlas.KvAtlas("prefix_unit", max_batch=2, page_size=4,
+                         pages_per_slot=4, bytes_per_token=10, paged=True)
+    a = np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 9])
+    b = np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 42])       # differs past 2 pages
+    c = np.asarray([1, 2, 3, 4, 9, 9, 9, 9])           # differs inside
+    assert at.prefix_key(a, 2) == at.prefix_key(b, 2)
+    assert at.prefix_key(a, 2) != at.prefix_key(c, 2)
+    assert at.prefix_key(a, 1) != at.prefix_key(a, 2)  # depth matters
+
+
+def test_prefix_index_is_lru_bounded():
+    at = kvatlas.KvAtlas("prefix_lru", max_batch=2, page_size=2,
+                         pages_per_slot=4, bytes_per_token=10, paged=True)
+    at.enable()
+    rng = np.random.RandomState(0)
+    n = kvatlas.PREFIX_INDEX_CAP + 40
+    for i in range(n):
+        at.note_prefix_hit(0, rng.randint(0, 1000, (4,)), 2)
+    assert len(at._index) == kvatlas.PREFIX_INDEX_CAP
+    assert at._prefix_evicted >= 40
+    assert at._prefix_hits == n
+    summary = at.prefix_summary(top=5)
+    assert len(summary) == 5
+    assert all(set(e) == {"hash", "pages", "hits"} for e in summary)
+    # a repeat hit refreshes the entry and bumps its count to the top
+    ids = np.asarray([7, 7, 7, 7])
+    for _ in range(3):
+        at.note_prefix_hit(1, ids, 2)
+    assert at.prefix_summary(top=1)[0]["hash"] == at.prefix_key(ids, 2)
+    assert at.prefix_summary(top=1)[0]["hits"] == 3
+    cs = at.cluster_summary(top=3)
+    assert cs["prefix_hit_ratio"] == 1.0 and len(cs["prefixes"]) == 3
+
+
+# ---- capacity forecast ------------------------------------------------------
+
+def test_forecast_time_to_full_on_fake_clock():
+    """Admissions outpacing finishes by 1 slot/s with 6 free slots →
+    eta_s ≈ 6 s; a draining pool (net ≤ 0) forecasts no fill time."""
+    from paddle_tpu.observability import timeseries as tsm
+
+    clk = {"t": 1000.0}
+    store = tsm.TimeSeriesStore(interval_s=1.0,
+                                clock=lambda: clk["t"]).enable()
+    at = kvatlas.KvAtlas("fc_engine", max_batch=6, page_size=8,
+                         pages_per_slot=8, bytes_per_token=64, paged=True)
+    at.enable()
+    cat.SERVING_REQUESTS.labels(engine="fc_engine", event="admitted")
+    cat.SERVING_REQUESTS.labels(engine="fc_engine", event="finished")
+    store.sample_once()
+    for _ in range(12):
+        clk["t"] += 1.0
+        cat.SERVING_REQUESTS.inc(2.0, engine="fc_engine", event="admitted")
+        cat.SERVING_REQUESTS.inc(1.0, engine="fc_engine", event="finished")
+        store.sample_once()
+    fc = at.forecast(store=store, now=clk["t"], window_s=10.0)
+    assert fc["headroom_slots"] == 6
+    assert fc["admit_rate"] == pytest.approx(2.0, rel=0.15)
+    assert fc["finish_rate"] == pytest.approx(1.0, rel=0.15)
+    assert fc["net_slots_per_s"] == pytest.approx(1.0, rel=0.3)
+    assert fc["eta_s"] == pytest.approx(6.0, rel=0.3)
+    # draining: finishes now outpace admissions → no fill forecast
+    for _ in range(12):
+        clk["t"] += 1.0
+        cat.SERVING_REQUESTS.inc(2.0, engine="fc_engine", event="finished")
+        store.sample_once()
+    fc = at.forecast(store=store, now=clk["t"], window_s=10.0)
+    assert fc["net_slots_per_s"] is not None
+    assert fc["net_slots_per_s"] < 0 and fc["eta_s"] is None
+
+
+# ---- alert objective --------------------------------------------------------
+
+def test_kv_pressure_objective_registered():
+    from paddle_tpu.observability import alerts as al
+
+    obj = al.DEFAULT_OBJECTIVES["kv_pressure_high"]
+    assert obj.metric == "serving_kv_headroom_frac"
+    assert obj.op == "<" and obj.threshold == pytest.approx(0.10)
+    assert obj.window_s == 60.0 and obj.for_s == 60.0
+    assert obj.labels == {"engine": "decoder"}
+    # the federation list carries the cluster kv series
+    assert {"cluster_kv_pages_in_use", "cluster_kv_bytes",
+            "cluster_kv_headroom_slots",
+            "cluster_prefix_hit_ratio"} <= set(al.FEDERATED_SERIES)
+
+
+# ---- debug_state columns ----------------------------------------------------
+
+def test_debug_state_carries_kv_columns(tiny_model):
+    eng = ContinuousBatchEngine(tiny_model, max_batch=2, max_len=64,
+                                page_size=8, enable_prefix_cache=True)
+    eng.kvatlas.enable()
+    rng = np.random.RandomState(2)
+    base = rng.randint(0, tiny_model.config.vocab_size, (16,))
+    eng.add_request(np.concatenate([base, [5, 6, 7]]), max_new_tokens=16)
+    eng.step()
+    eng.add_request(np.concatenate([base, [9, 8]]), max_new_tokens=4)
+    eng.step()
+    rows = [r for r in eng.debug_state()["slots"] if r is not None]
+    assert rows
+    for row in rows:
+        assert row["kv_pages"] > 0
+        assert row["kv_bytes"] == row["kv_pages"] * eng.kvatlas.bytes_per_page
+        assert "prefix_pages" in row
+    assert any(r["prefix_pages"] > 0 for r in rows), \
+        "prefix reuse never surfaced in debug_state"
+    eng.run_until_done()
+
+
+# ---- HTTP surfaces ----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served(tiny_model):
+    from paddle_tpu.serving_http import CompletionServer
+
+    eng = ContinuousBatchEngine(tiny_model, max_batch=2, max_len=64,
+                                page_size=8)
+    with CompletionServer(eng, model_name="tiny-kvatlas") as srv:
+        yield srv
+
+
+def _post(srv, path, body):
+    host, port = srv.address
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = resp.status, json.loads(resp.read())
+    conn.close()
+    return out
+
+
+def _get(srv, path):
+    host, port = srv.address
+    with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_kvstate_endpoint(served):
+    code, _ = _post(served, "/v1/completions",
+                    {"prompt_token_ids": [3, 5, 7], "max_tokens": 6})
+    assert code == 200
+    doc = _get(served, "/kvstate")
+    assert doc["schema_version"] == 1
+    eng = doc["engines"]["decoder"]
+    assert eng["enabled"] is True            # the server enabled it
+    assert eng["paged"] is True
+    assert eng["page_size"] == 8 and eng["pages_per_slot"] == 8
+    assert eng["bytes_per_page"] > 0
+    assert eng["capacity_pages"] == 16
+    assert eng["pages_peak"] >= 1            # traffic left a peak behind
+    assert eng["pages_in_use"] == 0          # drained
+    assert eng["headroom_slots"] == 2
+    assert eng["chunk_parked_pages"] == 0
+    assert eng["host_parked_bytes"] == 0
+    assert set(eng["prefix"]) >= {"hits", "misses", "hit_ratio", "index"}
+    assert "kv_cache_bytes" in eng["preflight"]
+    assert set(eng["forecast"]) >= {"eta_s", "headroom_slots"}
+    # stats()/health carries the federated scalars
+    st = _get(served, "/health")["stats"]
+    for key in ("kv_pages_in_use", "kv_bytes", "kv_headroom_slots",
+                "kv_headroom_frac", "prefix_hit_ratio"):
+        assert key in st
+    assert st["kv_headroom_slots"] == 2.0
+    # the occupancy gauges published
+    assert cat.SERVING_KV_HEADROOM_SLOTS.value(engine="decoder") == 2.0
+
+
+def test_bundle_carries_kvstate_section(served):
+    b = frec.get_reporter().bundle("manual", context="kvatlas-unit")
+    frec.validate_bundle(b)
+    assert b["kvstate"]["schema_version"] == 1
+    assert "decoder" in b["kvstate"]["engines"]
+    # additive-optional: a bundle written before this PR still validates
+    legacy = {k: v for k, v in b.items() if k != "kvstate"}
+    frec.validate_bundle(legacy)
+
+
+def test_read_incident_prints_kv_memory_section(tiny_model, tmp_path,
+                                                capsys):
+    """scripts/read_incident.py renders the kvstate section — pool
+    line, per-slot rows, host-parked residency."""
+    import importlib.util
+
+    eng = ContinuousBatchEngine(tiny_model, max_batch=2, max_len=64,
+                                page_size=8)
+    eng.kvatlas.enable()
+    rep = frec.IncidentReporter(str(tmp_path))
+    rep.register_engine("decoder", eng)
+    eng.add_request(np.arange(1, 8), max_new_tokens=12)
+    for _ in range(3):
+        eng.step()                       # slots active at dump time
+    path = rep.activate().dump("manual", context="kvatlas-test")
+    eng.run_until_done()
+    spec = importlib.util.spec_from_file_location(
+        "_read_incident_kv",
+        os.path.join(_REPO, "scripts", "read_incident.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "KV/MEMORY" in out
+    assert "slot 0" in out and "headroom" in out
+
+
+# ---- cluster federation -----------------------------------------------------
+
+def test_cluster_kvstate_federation(tmp_path, monkeypatch):
+    """Router-side ``GET /kvstate/cluster`` federates ≥ 2 workers keyed
+    by replica id with their pool-metadata prefix summaries, and the
+    federated TSDB carries the per-replica kv gauges under their
+    declared series names — live, never a 5xx."""
+    monkeypatch.setenv("PD_AUTOTUNE_CACHE", str(tmp_path / "at.json"))
+    from paddle_tpu.observability import alerts as al
+    from paddle_tpu.observability import timeseries as tsm
+    from paddle_tpu.serving_cluster import launch_cluster
+
+    cluster = launch_cluster({
+        "cluster": {"host": "127.0.0.1", "port": 0, "ttl": 2.0,
+                    "platform": "cpu", "model_name": "tiny-kv-cluster",
+                    "ts_interval_s": 0.25},
+        "model": {"kind": "tiny_llama", "num_hidden_layers": 2,
+                  "seed": 0},
+        "engine": {"max_batch": 4, "max_len": 64, "page_size": 8},
+        "workers": [{"role": "unified", "count": 2}],
+    }, supervise=False)
+    try:
+        host, port = cluster.address
+        url = f"http://{host}:{port}"
+        for i in range(4):                   # traffic lands on both
+            code, body = _post_url(host, port, "/v1/completions",
+                                   {"prompt_token_ids": [2 + i, 5, 9],
+                                    "max_tokens": 4})
+            assert code == 200
+            assert body["usage"]["completion_tokens"] == 4
+        with urllib.request.urlopen(url + "/kvstate/cluster",
+                                    timeout=30) as r:
+            doc = json.loads(r.read())
+        assert doc["schema_version"] == 1
+        assert set(doc["replicas"]) == {"0", "1"}, doc.get("errors")
+        for rid, sub in doc["replicas"].items():
+            dec = sub["engines"]["decoder"]
+            assert dec["enabled"] is True, rid
+            assert dec["pages_peak"] >= 0
+            assert dec["capacity_pages"] == 4 * (64 // 8)
+        # workers published their kv summary through pool metadata
+        cluster.pool.refresh()
+        with urllib.request.urlopen(url + "/kvstate/cluster",
+                                    timeout=30) as r:
+            doc = json.loads(r.read())
+        assert set(doc["pool"]) == {"0", "1"}
+        for summ in doc["pool"].values():
+            assert {"kv_pages_in_use", "headroom_slots",
+                    "prefix_hit_ratio", "prefixes"} <= set(summ)
+        # the per-replica kv gauges reach the federated store under
+        # their FEDERATED_SERIES names
+        tsm.get_store().sample_once()
+        with urllib.request.urlopen(url + "/timeseries",
+                                    timeout=30) as r:
+            ts = json.loads(r.read())
+        kv_series = {s["name"] for s in ts["series"]
+                     if s["name"].startswith(("cluster_kv_",
+                                              "cluster_prefix_"))}
+        assert kv_series == {"cluster_kv_pages_in_use",
+                             "cluster_kv_bytes",
+                             "cluster_kv_headroom_slots",
+                             "cluster_prefix_hit_ratio"}
+        assert kv_series <= set(al.FEDERATED_SERIES)
+        reps = {s["labels"].get("replica") for s in ts["series"]
+                if s["name"] == "cluster_kv_headroom_slots"}
+        assert {"0", "1"} <= reps
+        # the router's own (engineless) /kvstate answers 200, empty
+        with urllib.request.urlopen(url + "/kvstate", timeout=30) as r:
+            local = json.loads(r.read())
+        assert local["schema_version"] == 1
+    finally:
+        cluster.close()
+
+
+def _post_url(host, port, path, body):
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = resp.status, json.loads(resp.read())
+    conn.close()
+    return out
