@@ -11,20 +11,25 @@ source, run under ``pdlint --graph`` and ``Engine.preflight()``. The
 analysis (thread model, lock-order graph with deadlock-cycle witness
 chains, blocking-under-lock, cross-thread unguarded state) under
 ``pdlint --threads``, paired with the runtime lock-order witness
-(``FLAGS_lock_witness``). See docs/ANALYSIS.md for the rule catalog and
-``scripts/pdlint.py`` for the CLI; the tier-1 gates live in
-tests/test_static_analysis.py, tests/test_graph_analysis.py and
-tests/test_thread_analysis.py.
+(``FLAGS_lock_witness``). The ``lifecycle`` subpackage is the fourth —
+CFG-based must-release analysis (``cfg.py`` control-flow graphs, the
+resource catalog, the ``leak-path`` dataflow) under
+``pdlint --lifecycle``; see docs/ANALYSIS.md "Lifecycle analysis". The
+full rule catalog is in docs/ANALYSIS.md and ``scripts/pdlint.py`` is
+the CLI; the tier-1 gates live in tests/test_static_analysis.py,
+tests/test_graph_analysis.py, tests/test_thread_analysis.py and
+tests/test_lifecycle_analysis.py.
 """
 from . import baseline, report  # noqa: F401
 from .core import (  # noqa: F401
     Finding, ModuleContext, ProjectRule, Rule, RULES, analyze_file,
-    analyze_source, ast_rules, iter_py_files, project_rules,
-    register_rule, run,
+    analyze_source, ast_rules, iter_py_files, module_context,
+    project_rules, register_rule, run,
 )
 
 __all__ = [
     "Finding", "ModuleContext", "ProjectRule", "Rule", "RULES",
     "analyze_file", "analyze_source", "ast_rules", "iter_py_files",
-    "project_rules", "register_rule", "run", "baseline", "report",
+    "module_context", "project_rules", "register_rule", "run",
+    "baseline", "report",
 ]

@@ -13,15 +13,14 @@ The file is a plain sorted-JSON list so diffs review like code.
 """
 from __future__ import annotations
 
-import ast
 import json
 import os
 from typing import Dict, Iterable, List, Set, Tuple
 
-from .core import Finding
+from .core import Finding, module_context
 
-__all__ = ["load", "save", "filter_new", "to_entries", "load_entries",
-           "stale_entries"]
+__all__ = ["load", "save", "save_entries", "filter_new", "to_entries",
+           "load_entries", "stale_entries"]
 
 _VERSION = 1
 _FIELDS = ("file", "rule", "symbol", "message")
@@ -43,7 +42,13 @@ def to_entries(findings: Iterable[Finding]) -> List[Dict[str, str]]:
 
 
 def save(path: str, findings: Iterable[Finding]) -> int:
-    entries = to_entries(findings)
+    return save_entries(path, to_entries(findings))
+
+
+def save_entries(path: str, entries: List[Dict[str, str]]) -> int:
+    """Write raw entry dicts (what ``--prune-baseline`` rewrites after
+    dropping stale ones — no lint run involved)."""
+    entries = sorted(entries, key=lambda d: tuple(d[k] for k in _FIELDS))
     with open(path, "w", encoding="utf-8") as fh:
         json.dump({"version": _VERSION, "findings": entries}, fh, indent=1,
                   sort_keys=True)
@@ -77,25 +82,12 @@ def load_entries(path: str) -> List[Dict[str, str]]:
     return list(data["findings"])
 
 
-def _symbols_in(path: str) -> Set[str]:
+def _symbols_in(path: str, rel: str) -> Set[str]:
     """Every def/class qualname a file defines (the ``symbol`` namespace
-    findings key on), plus "" for module level."""
-    with open(path, encoding="utf-8") as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    out: Set[str] = {""}
-
-    def visit(node, qual):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef)):
-                q = f"{qual}.{child.name}" if qual else child.name
-                out.add(q)
-                visit(child, q)
-            else:
-                visit(child, qual)
-
-    visit(tree, "")
-    return out
+    findings key on), plus "" for module level. Goes through the shared
+    parse cache — a stale check right after a lint run re-parses
+    nothing."""
+    return module_context(path, rel).symbols()
 
 
 def stale_entries(entries: Iterable[Dict[str, str]],
@@ -114,7 +106,7 @@ def stale_entries(entries: Iterable[Dict[str, str]],
         path = os.path.join(root, rel)
         if rel not in cache:
             try:
-                cache[rel] = _symbols_in(path)
+                cache[rel] = _symbols_in(path, rel)
             except (OSError, SyntaxError):
                 cache[rel] = set()   # gone or unparsable: all stale
         if e.get("symbol", "") not in cache[rel]:

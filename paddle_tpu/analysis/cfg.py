@@ -1,0 +1,447 @@
+"""Control-flow graphs over Python AST — the lifecycle layer's substrate.
+
+The AST rules so far pattern-match single statements; the resource-leak
+class (``lifecycle/``) is a *path* property: "is the release reachable
+from the acquire on EVERY path out of the function, including the
+exception edges". Answering that needs a real CFG, so this module builds
+one — per function, statement-granular, with the edges that matter for
+unwind reasoning:
+
+- branch edges (``true``/``false``) for ``if``/``while``/``for`` heads
+  (a ``while True:`` head emits no ``false`` edge);
+- loop back-edges (``loop``), ``break``/``continue`` edges routed to a
+  lazily-created ``loopexit`` node so abrupt loop exits stay distinct
+  from normal exhaustion;
+- ``raise`` edges from every statement that can raise (any statement
+  containing a call — the caller may pass a ``noraise`` allowlist of
+  resolved call paths that are trusted not to throw) to the innermost
+  handler dispatch, else to the function's ``raise`` exit;
+- ``try``/``except``/``else``/``finally``: a lazy ``except`` dispatch
+  node chains handlers in order (``except`` into the first, ``nomatch``
+  between them, a final ``raise`` edge out unless the last handler is
+  broad); ``finally`` bodies are DUPLICATED per continuation kind
+  (normal / raise / return / break / continue), exactly the way
+  compilers lower them, so a ``return`` inside ``try`` correctly runs
+  the finally copy and then leaves via a ``return`` edge while the
+  normal path runs its own copy and falls through;
+- ``with``: the head node owns the context expressions (and their
+  ``raise`` edge); body statements keep their own raise edges — the
+  manager's ``__exit__`` runs on that unwind implicitly, which is why
+  the lifecycle pass treats ``with``-bound resources as managed.
+
+Three exits per graph: ``entry``, ``exit`` (normal return / fall-off),
+and ``raise`` (an exception escaping the function). Nested function and
+class bodies are opaque single statements (they execute at *call* time,
+not here); calls inside ``lambda``/nested ``def`` bodies never produce
+raise edges for the enclosing function.
+
+Pure ``ast`` — no paddle_tpu import — so fixture snippets unit-test the
+builder in isolation (tests/test_lifecycle_analysis.py), and future rule
+families (the PR-19 adapter-registry checks) can reuse it as-is.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, Tuple
+
+__all__ = ["ControlFlowGraph", "CFGNode", "build_cfg", "function_nodes",
+           "may_raise"]
+
+
+class CFGNode:
+    """One CFG node: a statement (or a synthetic head/exit marker).
+
+    ``kind`` is one of ``entry``/``exit``/``raise`` (the three boundary
+    nodes), ``stmt`` (a simple statement), ``branch`` (an ``if`` test),
+    ``loop`` (a ``while``/``for`` head), ``with`` (a ``with`` head),
+    ``except`` (a handler-dispatch point), ``handler`` (one ``except``
+    clause head), ``finally`` (the entry of one duplicated finally
+    copy), ``loopexit`` (the landing point of ``break``). ``stmt`` holds
+    the originating AST node (shared between finally copies)."""
+
+    __slots__ = ("id", "kind", "stmt", "line")
+
+    def __init__(self, nid: int, kind: str, stmt: Optional[ast.AST]):
+        self.id = nid
+        self.kind = kind
+        self.stmt = stmt
+        self.line = getattr(stmt, "lineno", 0)
+
+    @property
+    def label(self) -> str:
+        if self.kind in ("entry", "exit", "raise"):
+            return self.kind
+        return f"{self.kind}@{self.line}"
+
+    def __repr__(self):
+        return f"CFGNode({self.label})"
+
+
+class ControlFlowGraph:
+    """Nodes + labeled edges + the three boundary nodes."""
+
+    def __init__(self, name: str, line: int):
+        self.name = name
+        self.line = line
+        self.nodes: Dict[int, CFGNode] = {}
+        self._succ: Dict[int, List[Tuple[int, str]]] = {}
+        self._pred: Dict[int, List[Tuple[int, str]]] = {}
+        self.entry = self._add("entry", None)
+        self.exit = self._add("exit", None)
+        self.raise_exit = self._add("raise", None)
+
+    def _add(self, kind: str, stmt) -> int:
+        nid = len(self.nodes)
+        self.nodes[nid] = CFGNode(nid, kind, stmt)
+        self._succ[nid] = []
+        self._pred[nid] = []
+        return nid
+
+    def add_edge(self, src: int, dst: int, kind: str):
+        if (dst, kind) not in self._succ[src]:
+            self._succ[src].append((dst, kind))
+            self._pred[dst].append((src, kind))
+
+    def succ(self, nid: int) -> List[Tuple[int, str]]:
+        return self._succ[nid]
+
+    def pred(self, nid: int) -> List[Tuple[int, str]]:
+        return self._pred[nid]
+
+    def edge_labels(self) -> set:
+        """``{(src.label, kind, dst.label)}`` — the unit-test surface.
+        Finally copies share a label (same source line), which is fine
+        for membership assertions."""
+        return {(self.nodes[s].label, kind, self.nodes[d].label)
+                for s in self._succ for (d, kind) in self._succ[s]}
+
+    def stmt_nodes(self) -> Iterable[CFGNode]:
+        return (n for n in self.nodes.values() if n.stmt is not None)
+
+
+# ---- may-raise classification ----------------------------------------------
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+
+
+def _eager_nodes(node: ast.AST) -> Iterable[ast.AST]:
+    """Sub-nodes evaluated when ``node`` executes — nested function/
+    lambda/class bodies are skipped (they run later, elsewhere); a
+    ``def``/``class`` statement itself evaluates only its decorators,
+    defaults, and bases now."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        roots: List[ast.AST] = list(node.decorator_list)
+        roots += [d for d in node.args.defaults]
+        roots += [d for d in node.args.kw_defaults if d is not None]
+    elif isinstance(node, ast.ClassDef):
+        roots = list(node.decorator_list) + list(node.bases) \
+            + [k.value for k in node.keywords]
+    else:
+        roots = [node]
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _SCOPE_BARRIERS):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def may_raise(node: ast.AST,
+              resolver: Optional[Callable[[ast.AST], str]] = None,
+              noraise: FrozenSet[str] = frozenset()) -> bool:
+    """Conservative: a statement/expression can raise iff it contains a
+    call (or an ``await``) outside nested scopes. ``resolver`` +
+    ``noraise`` whitelist resolved call paths trusted not to throw
+    (loggers, monotonic clocks, metric counters) so the leak pass does
+    not report a leak path through ``log.info``."""
+    for n in _eager_nodes(node):
+        if isinstance(n, ast.Await):
+            return True
+        if isinstance(n, ast.Call):
+            if resolver is not None and noraise:
+                name = resolver(n.func)
+                if name and (name in noraise
+                             or name.rsplit(".", 1)[-1] in noraise):
+                    continue
+            return True
+    return False
+
+
+# ---- builder ---------------------------------------------------------------
+
+class _Target:
+    """A lazily-materialized jump target: finally copies (and loop-exit
+    landing nodes) are built only when something actually jumps there,
+    so a try without a break never grows a break-finally copy."""
+
+    __slots__ = ("_make", "_id")
+
+    def __init__(self, make: Callable[[], int]):
+        self._make = make
+        self._id: Optional[int] = None
+
+    def __call__(self) -> int:
+        if self._id is None:
+            self._id = self._make()
+        return self._id
+
+    @property
+    def created(self) -> bool:
+        return self._id is not None
+
+
+def _const(nid: int) -> _Target:
+    t = _Target(lambda: nid)
+    return t
+
+
+class _Ctx:
+    """Where abrupt completions go from the current position."""
+
+    __slots__ = ("raise_to", "return_to", "break_to", "continue_to")
+
+    def __init__(self, raise_to, return_to, break_to, continue_to):
+        self.raise_to = raise_to
+        self.return_to = return_to
+        self.break_to = break_to
+        self.continue_to = continue_to
+
+    def replace(self, **kw) -> "_Ctx":
+        vals = {s: getattr(self, s) for s in self.__slots__}
+        vals.update(kw)
+        return _Ctx(**vals)
+
+
+Frontier = List[Tuple[int, str]]
+
+
+class _Builder:
+    def __init__(self, resolver, noraise):
+        self.resolver = resolver
+        self.noraise = noraise
+        self.cfg: ControlFlowGraph = None  # set in build
+
+    # -- helpers ----------------------------------------------------------
+    def _new(self, kind: str, stmt) -> int:
+        return self.cfg._add(kind, stmt)
+
+    def _connect(self, frontier: Frontier, dst: int,
+                 kind: Optional[str] = None):
+        for (src, k) in frontier:
+            self.cfg.add_edge(src, dst, kind if kind is not None else k)
+
+    def _raises(self, node) -> bool:
+        return may_raise(node, self.resolver, self.noraise)
+
+    # -- entry ------------------------------------------------------------
+    def build(self, func) -> ControlFlowGraph:
+        self.cfg = ControlFlowGraph(func.name, func.lineno)
+        ctx = _Ctx(raise_to=_const(self.cfg.raise_exit),
+                   return_to=_const(self.cfg.exit),
+                   break_to=None, continue_to=None)
+        frontier = self._seq(func.body, [(self.cfg.entry, "next")], ctx)
+        self._connect(frontier, self.cfg.exit)
+        return self.cfg
+
+    def _seq(self, stmts, frontier: Frontier, ctx: _Ctx) -> Frontier:
+        for stmt in stmts:
+            frontier = self._stmt(stmt, frontier, ctx)
+        return frontier
+
+    # -- statement dispatch ------------------------------------------------
+    def _stmt(self, stmt, frontier: Frontier, ctx: _Ctx) -> Frontier:
+        handler = getattr(self, f"_stmt_{type(stmt).__name__}", None)
+        if handler is not None:
+            return handler(stmt, frontier, ctx)
+        return self._simple(stmt, frontier, ctx)
+
+    def _simple(self, stmt, frontier: Frontier, ctx: _Ctx) -> Frontier:
+        n = self._new("stmt", stmt)
+        self._connect(frontier, n)
+        if self._raises(stmt):
+            self.cfg.add_edge(n, ctx.raise_to(), "raise")
+        return [(n, "next")]
+
+    def _stmt_Return(self, stmt, frontier, ctx):
+        n = self._new("stmt", stmt)
+        self._connect(frontier, n)
+        if stmt.value is not None and self._raises(stmt.value):
+            self.cfg.add_edge(n, ctx.raise_to(), "raise")
+        self.cfg.add_edge(n, ctx.return_to(), "return")
+        return []
+
+    def _stmt_Raise(self, stmt, frontier, ctx):
+        n = self._new("stmt", stmt)
+        self._connect(frontier, n)
+        self.cfg.add_edge(n, ctx.raise_to(), "raise")
+        return []
+
+    def _stmt_Break(self, stmt, frontier, ctx):
+        n = self._new("stmt", stmt)
+        self._connect(frontier, n)
+        if ctx.break_to is not None:
+            self.cfg.add_edge(n, ctx.break_to(), "break")
+        return []
+
+    def _stmt_Continue(self, stmt, frontier, ctx):
+        n = self._new("stmt", stmt)
+        self._connect(frontier, n)
+        if ctx.continue_to is not None:
+            self.cfg.add_edge(n, ctx.continue_to(), "continue")
+        return []
+
+    def _stmt_Assert(self, stmt, frontier, ctx):
+        n = self._new("stmt", stmt)
+        self._connect(frontier, n)
+        self.cfg.add_edge(n, ctx.raise_to(), "raise")
+        return [(n, "next")]
+
+    def _stmt_If(self, stmt, frontier, ctx):
+        n = self._new("branch", stmt)
+        self._connect(frontier, n)
+        if self._raises(stmt.test):
+            self.cfg.add_edge(n, ctx.raise_to(), "raise")
+        out = self._seq(stmt.body, [(n, "true")], ctx)
+        if stmt.orelse:
+            out = out + self._seq(stmt.orelse, [(n, "false")], ctx)
+        else:
+            out = out + [(n, "false")]
+        return out
+
+    def _loop(self, stmt, frontier, ctx, test_raises: bool,
+              always_enters: bool):
+        head = self._new("loop", stmt)
+        self._connect(frontier, head)
+        if test_raises:
+            self.cfg.add_edge(head, ctx.raise_to(), "raise")
+        brk = _Target(lambda: self._new("loopexit", stmt))
+        body_ctx = ctx.replace(break_to=brk, continue_to=_const(head))
+        body = self._seq(stmt.body, [(head, "true")], body_ctx)
+        self._connect(body, head, kind="loop")
+        out: Frontier = []
+        if not always_enters:
+            if stmt.orelse:
+                out += self._seq(stmt.orelse, [(head, "false")], ctx)
+            else:
+                out += [(head, "false")]
+        if brk.created:
+            out += [(brk(), "next")]
+        return out
+
+    def _stmt_While(self, stmt, frontier, ctx):
+        infinite = (isinstance(stmt.test, ast.Constant)
+                    and stmt.test.value is True)
+        return self._loop(stmt, frontier, ctx,
+                          test_raises=self._raises(stmt.test),
+                          always_enters=infinite)
+
+    def _stmt_For(self, stmt, frontier, ctx):
+        return self._loop(stmt, frontier, ctx,
+                          test_raises=self._raises(stmt.iter),
+                          always_enters=False)
+
+    _stmt_AsyncFor = _stmt_For
+
+    def _stmt_With(self, stmt, frontier, ctx):
+        head = self._new("with", stmt)
+        self._connect(frontier, head)
+        if any(self._raises(item.context_expr) for item in stmt.items):
+            self.cfg.add_edge(head, ctx.raise_to(), "raise")
+        return self._seq(stmt.body, [(head, "with")], ctx)
+
+    _stmt_AsyncWith = _stmt_With
+
+    def _stmt_Try(self, stmt, frontier, ctx):
+        octx = ctx
+        if stmt.finalbody:
+            # one lazily-built finally COPY per continuation kind, each
+            # flowing on to the outer target with that kind's edge
+            def fin(outer: Optional[_Target], kind: str):
+                if outer is None:
+                    return None
+
+                def make() -> int:
+                    entry = self._new("finally", stmt.finalbody[0])
+                    f = self._seq(stmt.finalbody, [(entry, "next")], octx)
+                    self._connect(f, outer(), kind=kind)
+                    return entry
+                return _Target(make)
+
+            ctx = _Ctx(raise_to=fin(octx.raise_to, "raise"),
+                       return_to=fin(octx.return_to, "return"),
+                       break_to=fin(octx.break_to, "break"),
+                       continue_to=fin(octx.continue_to, "continue"))
+        body_ctx = ctx
+        dispatch = None
+        if stmt.handlers:
+            dispatch = _Target(lambda: self._new("except", stmt))
+            body_ctx = ctx.replace(raise_to=dispatch)
+        out = self._seq(stmt.body, frontier, body_ctx)
+        if stmt.orelse:
+            out = self._seq(stmt.orelse, out, ctx)
+        if dispatch is not None and dispatch.created:
+            prev: Frontier = [(dispatch(), "except")]
+            caught_all = False
+            for h in stmt.handlers:
+                hn = self._new("handler", h)
+                self._connect(prev, hn)
+                out = out + self._seq(h.body, [(hn, "caught")], ctx)
+                prev = [(hn, "nomatch")]
+                if _is_broad_handler(h.type):
+                    caught_all = True
+                    prev = []
+                    break
+            if prev and not caught_all:
+                # no handler matched: the exception keeps unwinding
+                # (through the finally, when there is one)
+                self._connect(prev, ctx.raise_to(), kind="raise")
+        if stmt.finalbody:
+            # normal completion runs its own finally copy and falls out
+            entry = self._new("finally", stmt.finalbody[0])
+            self._connect(out, entry)
+            out = self._seq(stmt.finalbody, [(entry, "next")], octx)
+        return out
+
+
+def _is_broad_handler(type_node) -> bool:
+    """``except:`` / ``except Exception:`` / ``except BaseException:``
+    (alone or in a tuple) stop the unwind for everything the leak pass
+    reasons about."""
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad_handler(e) for e in type_node.elts)
+    name = type_node.attr if isinstance(type_node, ast.Attribute) else (
+        type_node.id if isinstance(type_node, ast.Name) else "")
+    return name in ("Exception", "BaseException")
+
+
+def build_cfg(func: ast.AST,
+              resolver: Optional[Callable[[ast.AST], str]] = None,
+              noraise: FrozenSet[str] = frozenset()) -> ControlFlowGraph:
+    """CFG for one ``FunctionDef``/``AsyncFunctionDef``."""
+    return _Builder(resolver, noraise).build(func)
+
+
+def function_nodes(tree: ast.AST):
+    """Every function in a module, outermost-first, with its qualname —
+    nested defs included (their bodies are opaque in the ENCLOSING
+    function's CFG but get their own graph here)."""
+    out = []
+
+    def visit(node, qual):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+                out.append((q, child))
+                visit(child, q)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{qual}.{child.name}" if qual else child.name
+                visit(child, q)
+            else:
+                visit(child, qual)
+
+    visit(tree, "")
+    return out

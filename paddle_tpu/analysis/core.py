@@ -27,20 +27,49 @@ line numbers — so unrelated edits don't churn them.
 from __future__ import annotations
 
 import ast
+import contextlib
 import dataclasses
+import gc
+import io
 import os
 import re
-from typing import Dict, Iterable, List, Optional, Sequence
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
     "Finding", "Rule", "ProjectRule", "ModuleContext", "RULES",
     "register_rule", "analyze_source", "analyze_file", "iter_py_files",
-    "run",
+    "module_context", "unused_pragma_findings", "run",
 ]
 
 _PRAGMA = re.compile(
     r"#\s*pdlint:\s*disable="
     r"([A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)")
+
+UNUSED_DISABLE = "unused-disable"
+
+
+def _parse_pragmas(source: str, lines: List[str]) -> Dict[int, Set[str]]:
+    """line -> disabled rule ids, from COMMENT tokens only — a docstring
+    that *quotes* a pragma (the rule docs do) is not a pragma. Falls back
+    to a raw line scan when the file doesn't tokenize cleanly."""
+    out: Dict[int, Set[str]] = {}
+    if "pdlint:" not in source:
+        return out          # skip tokenizing the ~90% of pragma-free files
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                m = _PRAGMA.search(tok.string)
+                if m:
+                    out[tok.start[0]] = {s.strip()
+                                         for s in m.group(1).split(",")}
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        out = {}
+        for i, line in enumerate(lines, 1):
+            m = _PRAGMA.search(line)
+            if m:
+                out[i] = {s.strip() for s in m.group(1).split(",")}
+    return out
 
 
 @dataclasses.dataclass
@@ -81,16 +110,27 @@ class ModuleContext:
         self.tree = ast.parse(source, filename=path)
         self.aliases = _import_aliases(self.tree)
         self._scopes = _scope_spans(self.tree)
+        self.pragmas = _parse_pragmas(source, self.lines)
+        # (line, id) pairs that actually suppressed a finding this run —
+        # what the unused-disable check keys on. Reset per invocation
+        # because contexts are cached across runs (``module_context``).
+        self.pragma_used: Set[Tuple[int, str]] = set()
 
     # ---- pragmas --------------------------------------------------------
+    def reset_pragma_usage(self):
+        self.pragma_used.clear()
+
     def suppressed(self, line: int, rule_id: str) -> bool:
-        if not (1 <= line <= len(self.lines)):
+        ids = self.pragmas.get(line)
+        if not ids:
             return False
-        m = _PRAGMA.search(self.lines[line - 1])
-        if not m:
-            return False
-        ids = {s.strip() for s in m.group(1).split(",")}
-        return rule_id in ids or "all" in ids
+        if rule_id in ids:
+            self.pragma_used.add((line, rule_id))
+            return True
+        if "all" in ids:
+            self.pragma_used.add((line, "all"))
+            return True
+        return False
 
     # ---- scopes ---------------------------------------------------------
     def symbol_for_line(self, line: int) -> str:
@@ -103,6 +143,13 @@ class ModuleContext:
                                      or (hi - lo) <= best_span):
                 best, best_span = qual, hi - lo
         return best
+
+    def symbols(self) -> Set[str]:
+        """Every def/class qualname this module defines, plus "" for
+        module level — the namespace finding/baseline symbols live in."""
+        out = {""}
+        out.update(q for (_lo, _hi, q) in self._scopes)
+        return out
 
     # ---- name resolution ------------------------------------------------
     def resolve_call(self, func: ast.AST) -> str:
@@ -182,6 +229,10 @@ class Rule:
     # from default runs, included by ``run(threads=True)`` /
     # ``pdlint --threads`` or by naming them in ``selected``
     threads: bool = False
+    # lifecycle rules walk per-function CFGs for every catalog resource:
+    # excluded from default runs, included by ``run(lifecycle=True)`` /
+    # ``pdlint --lifecycle`` or by naming them in ``selected``
+    lifecycle: bool = False
 
     def check(self, ctx: ModuleContext) -> Iterable[Finding]:
         raise NotImplementedError
@@ -220,20 +271,28 @@ def _ensure_rules_loaded():
     from . import rules as _rules  # noqa: F401  (registers on import)
 
 
-def ast_rules(selected: Optional[Sequence[str]] = None) -> List[Rule]:
+def ast_rules(selected: Optional[Sequence[str]] = None,
+              lifecycle: bool = False) -> List[Rule]:
+    """Lifecycle rules gate exactly like graph/thread project rules: on
+    ``lifecycle=True`` / ``pdlint --lifecycle``, or by naming them in
+    ``selected`` — the default lint stays instant."""
     _ensure_rules_loaded()
     return [r for rid, r in sorted(RULES.items())
             if not isinstance(r, ProjectRule)
-            and (selected is None or rid in selected)]
+            and (selected is None or rid in selected)
+            and (lifecycle or not r.lifecycle or
+                 (selected is not None and rid in selected))]
 
 
 def project_rules(selected: Optional[Sequence[str]] = None,
                   graph: bool = False,
-                  threads: bool = False) -> List[ProjectRule]:
+                  threads: bool = False,
+                  lifecycle: bool = False) -> List[ProjectRule]:
     """Graph rules run only when ``graph=True`` OR explicitly selected —
     they trace model programs, and the default lint must stay instant.
     Thread rules gate on ``threads=True`` the same way (they build the
-    whole-program concurrency model)."""
+    whole-program concurrency model), lifecycle rules on
+    ``lifecycle=True``."""
     _ensure_rules_loaded()
     return [r for rid, r in sorted(RULES.items())
             if isinstance(r, ProjectRule)
@@ -241,30 +300,98 @@ def project_rules(selected: Optional[Sequence[str]] = None,
             and (graph or not r.graph or
                  (selected is not None and rid in selected))
             and (threads or not r.threads or
+                 (selected is not None and rid in selected))
+            and (lifecycle or not r.lifecycle or
                  (selected is not None and rid in selected))]
+
+
+# ---- shared parse cache -----------------------------------------------------
+
+# abs path -> ((mtime_ns, size), ModuleContext). One parse per file per
+# run, shared by the AST pass, the thread model, and the baseline stale
+# check; invalidated by any on-disk change.
+_CTX_CACHE: Dict[str, Tuple[Tuple[int, int], "ModuleContext"]] = {}
+
+
+def module_context(path: str, rel: Optional[str] = None) -> ModuleContext:
+    """The cached ModuleContext for ``path`` (re-parsed only when the
+    file changed). ``rel`` is the repo-relative name findings carry;
+    a cached context built under a different name is rebuilt."""
+    st = os.stat(path)
+    key = (st.st_mtime_ns, st.st_size)
+    name = rel if rel is not None else path
+    hit = _CTX_CACHE.get(path)
+    if hit is not None and hit[0] == key and hit[1].path == name:
+        return hit[1]
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    ctx = ModuleContext(name, source)
+    _CTX_CACHE[path] = (key, ctx)
+    return ctx
 
 
 # ---- drivers ----------------------------------------------------------------
 
-def analyze_source(source: str, filename: str = "<snippet>",
-                   rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
-    """Run AST rules over one source string (the fixture-test entry
-    point). Pragma suppression applies exactly as on disk."""
-    ctx = ModuleContext(filename, source)
+def _check_ctx(ctx: ModuleContext, rules: Sequence[Rule]) -> List[Finding]:
     out: List[Finding] = []
-    for rule in (rules if rules is not None else ast_rules()):
+    for rule in rules:
         for f in rule.check(ctx):
             if not ctx.suppressed(f.line, f.rule):
                 out.append(f)
     return out
 
 
+def unused_pragma_findings(ctx: ModuleContext,
+                           ran_ids: Set[str]) -> List[Finding]:
+    """``unused-disable`` findings: a pragma naming a rule that RAN this
+    invocation but suppressed nothing (dead suppression rots into a
+    false sense of coverage), or naming no registered rule at all (a
+    typo that silently disables nothing). Ids of rules that did not run
+    — a ``leak-path`` pragma on a default, non-``--lifecycle`` pass —
+    are never flagged; neither is ``disable=all`` (the escape hatch for
+    generated code)."""
+    out: List[Finding] = []
+    for line in sorted(ctx.pragmas):
+        for rid in sorted(ctx.pragmas[line]):
+            if rid in ("all", UNUSED_DISABLE):
+                continue
+            if rid not in RULES:
+                f = Finding(file=ctx.path, line=line, rule=UNUSED_DISABLE,
+                            message=(f"disable pragma names unknown rule "
+                                     f"'{rid}' (typo? see --list-rules)"),
+                            symbol=ctx.symbol_for_line(line))
+            elif rid in ran_ids and (line, rid) not in ctx.pragma_used:
+                f = Finding(file=ctx.path, line=line, rule=UNUSED_DISABLE,
+                            message=(f"disable pragma for '{rid}' "
+                                     "suppresses nothing on this line"),
+                            symbol=ctx.symbol_for_line(line))
+            else:
+                continue
+            if not ctx.suppressed(f.line, f.rule):
+                out.append(f)
+    return out
+
+
+def analyze_source(source: str, filename: str = "<snippet>",
+                   rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run AST rules over one source string (the fixture-test entry
+    point). Pragma suppression applies exactly as on disk."""
+    ctx = ModuleContext(filename, source)
+    rules = list(rules) if rules is not None else ast_rules()
+    out = _check_ctx(ctx, rules)
+    ran_ids = {r.id for r in rules}
+    if UNUSED_DISABLE in ran_ids:
+        out.extend(unused_pragma_findings(ctx, ran_ids))
+    return out
+
+
 def analyze_file(path: str, root: str,
                  rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
-    with open(path, encoding="utf-8") as fh:
-        source = fh.read()
     rel = os.path.relpath(path, root).replace(os.sep, "/")
-    return analyze_source(source, rel, rules)
+    ctx = module_context(path, rel)
+    ctx.reset_pragma_usage()
+    return _check_ctx(ctx, list(rules) if rules is not None
+                      else ast_rules())
 
 
 def iter_py_files(paths: Sequence[str]) -> List[str]:
@@ -284,29 +411,75 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 def run(paths: Optional[Sequence[str]] = None, root: Optional[str] = None,
         selected: Optional[Sequence[str]] = None,
         with_project_rules: bool = True,
-        graph: bool = False, threads: bool = False) -> List[Finding]:
+        graph: bool = False, threads: bool = False,
+        lifecycle: bool = False) -> List[Finding]:
     """Analyze ``paths`` (default: ``<root>/paddle_tpu``) and, unless
     disabled, run the project rules against ``root`` (graph rules only
-    with ``graph=True``, thread rules only with ``threads=True``, or
-    when explicitly selected). Findings come back sorted by (file,
-    line, rule)."""
+    with ``graph=True``, thread rules only with ``threads=True``,
+    lifecycle rules only with ``lifecycle=True``, or when explicitly
+    selected). Every finding — AST and project alike — honors the
+    per-line disable pragma; pragmas that suppress nothing are
+    themselves findings (``unused-disable``). Findings come back sorted
+    by (file, line, rule)."""
+    with _gc_paused():
+        return _run(paths, root, selected, with_project_rules, graph,
+                    threads, lifecycle)
+
+
+@contextlib.contextmanager
+def _gc_paused():
+    """Cyclic GC off for the duration of a run: the shared parse cache
+    keeps every module's AST alive, and gen-2 collections re-traversing
+    millions of live AST nodes mid-walk double the wall time. Linting
+    allocates nothing cyclic that refcounting doesn't already free."""
+    enabled = gc.isenabled()
+    gc.disable()
+    try:
+        yield
+    finally:
+        if enabled:
+            gc.enable()
+
+
+def _run(paths, root, selected, with_project_rules, graph, threads,
+         lifecycle) -> List[Finding]:
     if root is None:
         root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
     if paths is None:
         paths = [os.path.join(root, "paddle_tpu")]
-    arules = ast_rules(selected)
+    arules = ast_rules(selected, lifecycle=lifecycle)
+    ran_ids = {r.id for r in arules}
     findings: List[Finding] = []
+    ctxs: Dict[str, ModuleContext] = {}
     for path in iter_py_files(paths):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
         try:
-            findings.extend(analyze_file(path, root, arules))
+            ctx = module_context(path, rel)
         except SyntaxError as e:
             findings.append(Finding(
-                file=os.path.relpath(path, root).replace(os.sep, "/"),
-                line=e.lineno or 1, rule="parse-error",
+                file=rel, line=e.lineno or 1, rule="parse-error",
                 message=f"could not parse: {e.msg}"))
+            continue
+        ctx.reset_pragma_usage()
+        ctxs[rel] = ctx
+        findings.extend(_check_ctx(ctx, arules))
     if with_project_rules:
-        for rule in project_rules(selected, graph=graph, threads=threads):
-            findings.extend(rule.check_project(root))
+        prules = project_rules(selected, graph=graph, threads=threads,
+                               lifecycle=lifecycle)
+        ran_ids |= {r.id for r in prules}
+        for rule in prules:
+            for f in rule.check_project(root):
+                # uniform pragma handling: a project-rule finding on a
+                # file we parsed is suppressible exactly like an AST one
+                # (thread rules also self-filter; marking usage on the
+                # shared context is what keeps unused-disable honest)
+                c = ctxs.get(f.file)
+                if c is not None and c.suppressed(f.line, f.rule):
+                    continue
+                findings.append(f)
+    if UNUSED_DISABLE in ran_ids:
+        for rel in sorted(ctxs):
+            findings.extend(unused_pragma_findings(ctxs[rel], ran_ids))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
     return findings

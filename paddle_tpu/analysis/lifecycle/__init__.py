@@ -1,0 +1,33 @@
+"""Lifecycle analysis: CFG-based must-release checking (``leak-path``).
+
+The serving stack balances acquire/release pairs by convention — a pool
+lease claimed per placement and released per attempt, a tracer span
+ended on every outcome, a KV bundle exported from one engine and
+admitted into another, a file or socket closed after use. Every ceiling
+in the roadmap's next arc (multi-tenant adapter handles, quantized KV
+pages, the cluster cache tier, autoscaler-driven drain) multiplies
+those pairs, and a single exception-path miss is permanent capacity
+loss on a fleet that sizes itself. The reference C++ made this class
+structurally impossible with scope guards; RAII-less Python needs a
+checker instead.
+
+Three pieces:
+
+- ``analysis/cfg.py`` (one level up, reusable): statement-granular
+  control-flow graphs with branch/loop/try/finally/with/raise edges;
+- ``resources.py``: the catalog — which calls acquire which resource,
+  which calls/methods release it, and which hand ownership elsewhere
+  (transfer is NOT a leak: returning a bundle, sealing it into a
+  channel, parking a lease on ``self``);
+- ``dataflow.py``: the intraprocedural must-release walk over the CFG
+  (with one-level summaries for same-module helpers), producing
+  ``leak-path`` findings that name the resource, the acquire site, and
+  the concrete escape edge.
+
+Registered as the ``leak-path`` rule (``lifecycle/rules.py``), gated
+behind ``pdlint --lifecycle`` exactly like ``--graph``/``--threads``,
+and held green by tests/test_lifecycle_analysis.py. The catalog rows
+live in docs/ANALYSIS.md ("Lifecycle analysis").
+"""
+from .resources import CATALOG, ResourceSpec  # noqa: F401
+from .dataflow import check_module  # noqa: F401

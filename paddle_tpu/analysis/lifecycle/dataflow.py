@@ -1,0 +1,414 @@
+"""The must-release dataflow: walk the CFG from each acquire site.
+
+Intraprocedural, per function, with one-level summaries for same-module
+helpers (the engines route slot/lease teardown through helpers, and a
+``self._teardown(lease)`` that releases its parameter must count).
+
+The walk is deliberately binary: from the acquire node, explore every
+CFG path while the resource is HELD; a statement that releases,
+transfers, aliases, or rebinds the resource ENDS its path. Reaching the
+function's ``exit`` or ``raise`` boundary while still HELD is a leak,
+reported with the concrete escape edge (the statement whose raise edge
+left the function, or the return that skipped the release). Reaching
+the acquire node again while HELD is the loop re-acquire leak.
+
+Cheap None-narrowing keeps the common guard clean: on a branch testing
+``v is None`` / ``not v`` the resource is vacuously absent down the
+None edge, so ``if lease is None: return`` never reports. The same
+narrowing covers the -1 index-sentinel convention (``if slot < 0:
+return`` after ``_alloc_slot``). Everything
+fancier (aliases, tuple unpacking, cross-function flows) conservatively
+ends tracking — for a gate, silence beats a false leak.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .. import cfg as _cfg
+from .resources import CATALOG, CONTAINER_STORES, NORAISE, ResourceSpec, match
+
+__all__ = ["check_module", "LeakReport"]
+
+_MAX_TEXT = 64
+
+
+class LeakReport:
+    """One leak: everything the rule needs to render a Finding."""
+
+    __slots__ = ("line", "resource", "var", "acquire_text", "escape")
+
+    def __init__(self, line, resource, var, acquire_text, escape):
+        self.line = line
+        self.resource = resource
+        self.var = var
+        self.acquire_text = acquire_text
+        self.escape = escape
+
+    @property
+    def message(self) -> str:
+        who = f"{self.resource} '{self.var}'" if self.var else self.resource
+        return (f"{who} acquired via `{self.acquire_text}` "
+                f"{self.escape}")
+
+
+def _short(node: ast.AST) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on stdlib ast
+        text = type(node).__name__
+    text = " ".join(text.split())
+    return text if len(text) <= _MAX_TEXT else text[:_MAX_TEXT - 1] + "…"
+
+
+def _names(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_name(node, v: str) -> bool:
+    return isinstance(node, ast.Name) and node.id == v
+
+
+def _arg_names(call: ast.Call) -> Iterable[str]:
+    for a in call.args:
+        if isinstance(a, ast.Starred):
+            a = a.value
+        if isinstance(a, ast.Name):
+            yield a.id
+    for kw in call.keywords:
+        if isinstance(kw.value, ast.Name):
+            yield kw.value.id
+
+
+# ---- one-level helper summaries --------------------------------------------
+
+def module_summaries(ctx) -> Dict[str, Tuple[ast.AST, Dict]]:
+    """``{helper_name: (func_def, {(spec_name, param): effect})}`` —
+    which parameters each module-local function releases or transfers,
+    judged ONLY by direct catalog matches in its body (one level: a
+    helper of a helper does not count)."""
+    out: Dict[str, Tuple[ast.AST, Dict]] = {}
+    for _qual, func in _cfg.function_nodes(ctx.tree):
+        params = {a.arg for a in func.args.args} - {"self", "cls"}
+        if not params:
+            continue
+        effects: Dict[Tuple[str, str], str] = {}
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                resolved = ctx.resolve_call(node.func)
+                args = [a for a in _arg_names(node) if a in params]
+                recv = (node.func.value.id
+                        if isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in params else None)
+                for spec in CATALOG:
+                    if args and any(match(resolved, p)
+                                    for p in spec.release_arg):
+                        for a in args:
+                            effects[(spec.name, a)] = "release"
+                    if recv and isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in spec.release_methods:
+                        effects.setdefault((spec.name, recv), "release")
+                    if args and any(match(resolved, p)
+                                    for p in spec.transfer_arg):
+                        for a in args:
+                            effects.setdefault((spec.name, a), "transfer")
+            elif isinstance(node, ast.Assign):
+                stored = _names(node.value)
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in node.targets):
+                    for p in params & stored:
+                        for spec in CATALOG:
+                            effects.setdefault((spec.name, p), "transfer")
+        if effects:
+            out[func.name] = (func, effects)
+    return out
+
+
+def _summary_effect(call: ast.Call, resolved: str, v: str, spec,
+                    summaries) -> Optional[str]:
+    helper = resolved.rsplit(".", 1)[-1]
+    entry = summaries.get(helper)
+    if entry is None:
+        return None
+    func, effects = entry
+    params = [a.arg for a in func.args.args]
+    offset = 1 if (params[:1] in (["self"], ["cls"])
+                   and isinstance(call.func, ast.Attribute)) else 0
+    param = None
+    for i, a in enumerate(call.args):
+        if _is_name(a, v) and i + offset < len(params):
+            param = params[i + offset]
+            break
+    if param is None:
+        for kw in call.keywords:
+            if _is_name(kw.value, v) and kw.arg:
+                param = kw.arg
+                break
+    if param is None:
+        return None
+    return effects.get((spec.name, param))
+
+
+# ---- per-statement effect on one held resource -----------------------------
+
+def _call_effect(exprs: List[ast.AST], v: str, spec: ResourceSpec, ctx,
+                 summaries) -> Optional[str]:
+    for root in exprs:
+        for node in _cfg._eager_nodes(root):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve_call(node.func)
+            has_v = any(a == v for a in _arg_names(node))
+            if has_v and any(match(resolved, p)
+                             for p in spec.release_arg):
+                return "release"
+            if isinstance(node.func, ast.Attribute) \
+                    and _is_name(node.func.value, v) \
+                    and node.func.attr in spec.release_methods:
+                return "release"
+            if has_v and any(match(resolved, p)
+                             for p in spec.transfer_arg):
+                return "transfer"
+            if has_v and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in CONTAINER_STORES:
+                return "transfer"
+            if has_v:
+                eff = _summary_effect(node, resolved, v, spec, summaries)
+                if eff:
+                    return eff
+    return None
+
+
+def _effect(node: _cfg.CFGNode, v: str, spec: ResourceSpec, ctx,
+            summaries) -> Optional[str]:
+    """What this CFG node does to held resource ``v``: ``release`` /
+    ``transfer`` / ``stop`` (alias, rebind, del — tracking ends
+    conservatively) / None (no effect)."""
+    stmt = node.stmt
+    kind = node.kind
+    if kind == "branch":
+        return _call_effect([stmt.test], v, spec, ctx, summaries)
+    if kind == "loop":
+        it = stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor)) \
+            else stmt.test
+        if isinstance(stmt, (ast.For, ast.AsyncFor)) \
+                and v in _names(stmt.target):
+            return "stop"
+        return _call_effect([it], v, spec, ctx, summaries)
+    if kind == "with":
+        exprs = [item.context_expr for item in stmt.items]
+        eff = _call_effect(exprs, v, spec, ctx, summaries)
+        if eff:
+            return eff
+        if any(v in _names(item.context_expr) for item in stmt.items):
+            # ``with closing(v):`` / ``with v:`` — managed from here
+            return "transfer"
+        if any(item.optional_vars is not None
+               and v in _names(item.optional_vars)
+               for item in stmt.items):
+            return "stop"
+        return None
+    if kind == "handler":
+        return "stop" if stmt.name == v else None
+    if kind != "stmt":
+        return None
+    # ---- plain statements ------------------------------------------------
+    if isinstance(stmt, ast.Return):
+        if stmt.value is not None and v in _names(stmt.value):
+            return "transfer"
+        return _call_effect([stmt.value], v, spec, ctx, summaries) \
+            if stmt.value is not None else None
+    if isinstance(stmt, ast.Delete):
+        if any(v in _names(t) for t in stmt.targets):
+            return "stop"
+        return None
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        value = stmt.value
+        eff = _call_effect([value], v, spec, ctx, summaries) \
+            if value is not None else None
+        if eff:
+            return eff
+        if value is not None and v in _names(value):
+            if any(isinstance(t, (ast.Attribute, ast.Subscript,
+                                  ast.Tuple, ast.List))
+                   for t in targets):
+                return "transfer"     # parked on an object / container
+            if any(isinstance(t, ast.Name) for t in targets):
+                return "stop"         # alias: w = v
+        if any(_is_name(t, v) for t in targets):
+            return "stop"             # rebind: v = <something else>
+        return None
+    if isinstance(stmt, ast.Expr):
+        val = stmt.value
+        if isinstance(val, (ast.Yield, ast.YieldFrom, ast.Await)):
+            inner = val.value
+            if inner is not None and v in _names(inner):
+                return "transfer"
+            return _call_effect([inner], v, spec, ctx, summaries) \
+                if inner is not None else None
+        return _call_effect([val], v, spec, ctx, summaries)
+    if isinstance(stmt, ast.Raise):
+        exprs = [e for e in (stmt.exc, stmt.cause) if e is not None]
+        if any(v in _names(e) for e in exprs):
+            return "transfer"         # the exception now carries it
+        return _call_effect(exprs, v, spec, ctx, summaries)
+    return _call_effect([stmt], v, spec, ctx, summaries)
+
+
+def _narrowed_edges(node: _cfg.CFGNode, v: str) -> Dict[str, bool]:
+    """Edge kinds on which ``v`` is provably None/absent after this
+    branch: ``{'true': True}`` means the true edge cannot hold the
+    resource."""
+    if node.kind not in ("branch", "loop") \
+            or not isinstance(node.stmt, (ast.If, ast.While)):
+        return {}
+    test = node.stmt.test
+    if _is_name(test, v):
+        return {"false": True}
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and _is_name(test.operand, v):
+        return {"true": True}
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and _is_name(test.left, v) \
+            and isinstance(test.comparators[0], ast.Constant):
+        const = test.comparators[0].value
+        op = test.ops[0]
+        if const is None:
+            if isinstance(op, ast.Is):
+                return {"true": True}
+            if isinstance(op, ast.IsNot):
+                return {"false": True}
+        # the index-sentinel convention: acquires that return -1 for
+        # "nothing available" (engine _alloc_slot) guard with < 0
+        if const == 0:
+            if isinstance(op, ast.Lt):
+                return {"true": True}
+            if isinstance(op, ast.GtE):
+                return {"false": True}
+        if const == -1:
+            if isinstance(op, ast.Eq):
+                return {"true": True}
+            if isinstance(op, ast.NotEq):
+                return {"false": True}
+    return {}
+
+
+# ---- acquire-site discovery ------------------------------------------------
+
+def _acquire_sites(g: _cfg.ControlFlowGraph, ctx):
+    """Yield ``(node, var, spec, text, discarded)`` for every catalog
+    acquire in this function's CFG. Finally-copy duplicates are deduped
+    by (ast stmt, spec)."""
+    seen = set()
+    for node in g.nodes.values():
+        stmt = node.stmt
+        if stmt is None or node.kind != "stmt":
+            continue
+        value = None
+        var = None
+        discarded = False
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            var, value = stmt.targets[0].id, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name):
+            var, value = stmt.target.id, stmt.value
+        elif isinstance(stmt, ast.Expr):
+            value, discarded = stmt.value, True
+        if not isinstance(value, ast.Call):
+            continue
+        resolved = ctx.resolve_call(value.func)
+        if not resolved:
+            continue
+        for spec in CATALOG:
+            if any(match(resolved, p) for p in spec.acquire):
+                key = (id(stmt), spec.name)
+                if key not in seen:
+                    seen.add(key)
+                    yield node, var, spec, _short(value), discarded
+            elif discarded and spec.acquire_arg \
+                    and any(match(resolved, p) for p in spec.acquire_arg):
+                args = list(_arg_names(value))
+                if args:
+                    key = (id(stmt), spec.name)
+                    if key not in seen:
+                        seen.add(key)
+                        yield node, args[0], spec, _short(value), False
+
+
+# ---- the walk --------------------------------------------------------------
+
+def _walk(g: _cfg.ControlFlowGraph, start: int, v: str,
+          spec: ResourceSpec, ctx, summaries) -> Optional[str]:
+    """First escape description while HELD, or None when every path
+    releases/transfers."""
+    from collections import deque
+
+    q = deque()
+    for (dst, kind) in g.succ(start):
+        if kind == "raise":
+            continue      # the acquire call itself failed: nothing held
+        q.append((dst, kind, start))
+    seen = set()
+    while q:
+        nid, kind, src = q.popleft()
+        if nid == g.exit:
+            s = g.nodes[src]
+            if s.stmt is not None and isinstance(s.stmt, ast.Return):
+                return f"leaks at `{_short(s.stmt)}` (line {s.line})"
+            return "leaks at function exit"
+        if nid == g.raise_exit:
+            s = g.nodes[src]
+            what = _short(s.stmt) if s.stmt is not None else "a statement"
+            return f"leaks when `{what}` raises"
+        if nid == start:
+            return ("is re-acquired while a previous acquisition is "
+                    "still held (loop path without release)")
+        if nid in seen:
+            continue
+        seen.add(nid)
+        node = g.nodes[nid]
+        eff = _effect(node, v, spec, ctx, summaries) \
+            if node.stmt is not None else None
+        if eff in ("release", "transfer", "stop"):
+            continue
+        narrowed = _narrowed_edges(node, v)
+        for (dst, k) in g.succ(nid):
+            if narrowed.get(k):
+                continue
+            q.append((dst, k, nid))
+    return None
+
+
+def check_module(ctx) -> List[LeakReport]:
+    """Every leak in one module — the ``leak-path`` rule's core."""
+    reports: List[LeakReport] = []
+    reported = set()
+    summaries = module_summaries(ctx)
+    for _qual, func in _cfg.function_nodes(ctx.tree):
+        try:
+            g = _cfg.build_cfg(func, resolver=ctx.resolve_call,
+                               noraise=NORAISE)
+        except RecursionError:      # pathological nesting: skip, don't die
+            continue
+        for (node, var, spec, text, discarded) in _acquire_sites(g, ctx):
+            key = (node.line, spec.name, var)
+            if key in reported:
+                continue
+            if discarded:
+                reported.add(key)
+                reports.append(LeakReport(
+                    node.line, spec.name, var, text,
+                    "is discarded immediately — bind it so it can be "
+                    "released, or transfer it"))
+                continue
+            escape = _walk(g, node.id, var, spec, ctx, summaries)
+            if escape is not None:
+                reported.add(key)
+                reports.append(LeakReport(node.line, spec.name, var,
+                                          text, escape))
+    reports.sort(key=lambda r: (r.line, r.resource, r.var or ""))
+    return reports

@@ -1,0 +1,183 @@
+"""The resource catalog: acquire/release/transfer signatures.
+
+Each ``ResourceSpec`` declares one resource family by the *shape of the
+calls* that move it through its lifecycle. Patterns come in two forms:
+
+- ``"open"`` — exact match on the alias-resolved call path;
+- ``"*.select"`` / ``"*.pool.select"`` — suffix match (any receiver):
+  ``self.pool.select`` matches both.
+
+Ownership semantics the dataflow honors for every spec:
+
+- binding the acquire call inside a ``with`` item is MANAGED — the
+  context manager's ``__exit__`` is the release;
+- returning/yielding the resource, storing it into an attribute,
+  subscript, or container (``.append``/``.put``/…), or passing it to a
+  declared ``transfer_arg`` call TRANSFERS ownership — not a leak;
+- aliasing (``w = v``) conservatively ends tracking (the checker is a
+  leak detector, not an escape analysis — silence beats a false leak);
+- a spec's ``release_methods`` release via the resource itself
+  (``v.close()``); ``release_arg`` patterns release via a call that
+  takes the resource (``pool.release(v)``).
+
+To declare a NEW resource (the PR-19 adapter registry will): add a spec
+here, a catalog row to docs/ANALYSIS.md, and a positive/negative fixture
+pair to tests/test_lifecycle_analysis.py. Nothing else — the dataflow
+is table-driven.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+__all__ = ["ResourceSpec", "CATALOG", "NORAISE", "CONTAINER_STORES"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceSpec:
+    """One resource family.
+
+    ``acquire``       calls whose RESULT is the resource
+    ``acquire_arg``   calls that turn their first argument into a held
+                      resource (``pool.claim(w)``)
+    ``release_methods`` method names released via the resource
+                      (``v.close()``)
+    ``release_arg``   calls that release the resource passed as any
+                      argument (``pool.release(v)``)
+    ``transfer_arg``  calls that take ownership of the resource passed
+                      as an argument (``engine.admit_migrated(bundle)``)
+    ``with_ok``       acquiring inside a ``with`` item is managed
+    """
+
+    name: str
+    rationale: str
+    acquire: Tuple[str, ...] = ()
+    acquire_arg: Tuple[str, ...] = ()
+    release_methods: Tuple[str, ...] = ()
+    release_arg: Tuple[str, ...] = ()
+    transfer_arg: Tuple[str, ...] = ()
+    with_ok: bool = True
+
+
+def match(resolved: str, pattern: str) -> bool:
+    """``"*.x.y"`` is a dotted-suffix pattern; anything else is exact
+    (after import-alias resolution)."""
+    if pattern.startswith("*."):
+        suffix = pattern[1:]                   # keep the leading dot
+        return resolved.endswith(suffix) or resolved == pattern[2:]
+    return resolved == pattern
+
+
+# Container/method calls that count as ownership transfer for EVERY
+# spec: the resource now lives in a structure someone else drains.
+CONTAINER_STORES = frozenset({
+    "append", "add", "put", "put_nowait", "push", "insert", "extend",
+    "setdefault", "register", "appendleft", "send", "submit",
+})
+
+# Calls trusted not to raise: without this list every logger line
+# between an acquire and its release would be a reported leak path.
+# Deliberately small — only no-fail bookkeeping primitives.
+NORAISE = frozenset({
+    # clocks and ids
+    "time.monotonic", "time.perf_counter", "time.perf_counter_ns",
+    "time.time", "time.time_ns", "uuid.uuid4",
+    # the rank-aware logger and stdlib logging surface
+    "get_logger", "debug", "info", "warning", "error", "exception",
+    # metric families (observability.metrics): counters/gauges never
+    # raise on the hot path by contract
+    "inc", "dec", "set", "observe", "labels",
+    # flight recorder: record() is the measured-<1%-overhead hot path
+    # and swallows internally by contract
+    "record",
+    # the pool lease teardown is a lock-guarded decrement — no-raise by
+    # contract, so a finally can release one lease before another
+    # without manufacturing a leak path between them
+    "self.pool.release", "pool.release",
+    # builtins that cannot fail on the values these paths feed them
+    "len", "isinstance", "id", "repr", "str", "int", "float", "bool",
+    "min", "max", "abs", "round", "sorted", "list", "dict", "tuple",
+    "frozenset", "getattr", "hasattr", "format", "join", "split",
+    "strip", "startswith", "endswith", "items", "keys", "values",
+    "copy", "get", "pop", "discard", "clear", "update", "remove",
+})
+
+
+CATALOG: Tuple[ResourceSpec, ...] = (
+    ResourceSpec(
+        name="file-handle",
+        rationale=("an unclosed file keeps an fd until GC feels like "
+                   "it; under fd pressure the next open() fails"),
+        acquire=("open", "io.open", "os.fdopen", "gzip.open",
+                 "codecs.open"),
+        release_methods=("close",),
+    ),
+    ResourceSpec(
+        name="socket",
+        rationale=("a leaked socket holds a port and a peer; routers "
+                   "and probes open thousands over a process lifetime"),
+        acquire=("socket.socket", "socket.create_connection",
+                 "socket.socketpair"),
+        release_methods=("close", "detach"),
+    ),
+    ResourceSpec(
+        name="http-conn",
+        rationale=("an HTTPConnection left open after an error path "
+                   "pins its socket; the pool probe and relay open one "
+                   "per poll/placement"),
+        acquire=("http.client.HTTPConnection",
+                 "httplib.HTTPConnection"),
+        release_methods=("close",),
+    ),
+    ResourceSpec(
+        name="pool-lease",
+        rationale=("select()/claim() count a pending placement onto a "
+                   "worker; a path that skips release() makes the "
+                   "router see phantom load forever and starves the "
+                   "replica"),
+        acquire=("*.pool.select",),
+        acquire_arg=("*.pool.claim",),
+        release_arg=("*.pool.release",),
+    ),
+    ResourceSpec(
+        name="tracer-span",
+        rationale=("a start_span() without end() on some path never "
+                   "reaches the buffer — the trace shows a hole "
+                   "exactly where the failure was"),
+        acquire=("*.start_span",),
+        release_methods=("end",),
+    ),
+    ResourceSpec(
+        name="kv-bundle",
+        rationale=("an exported KV bundle owns a live request's "
+                   "progress; dropping it on an exception path loses "
+                   "the stream's tokens irrecoverably"),
+        acquire=("*.export_slot", "*.export_prefill"),
+        transfer_arg=("*.admit_migrated", "*.admit_prefilled",
+                      "*.offer", "*.seal"),
+    ),
+    ResourceSpec(
+        name="engine-slot",
+        rationale=("a KV slot freed on no path is permanent capacity "
+                   "loss — the engine's max_batch shrinks by one until "
+                   "restart"),
+        acquire=("*._alloc_slot",),
+        release_arg=("*._release_slot",),
+    ),
+    ResourceSpec(
+        name="lock-handle",
+        rationale=("a bare .acquire() whose .release() is skippable "
+                   "deadlocks the next waiter; with-blocks make it "
+                   "structural"),
+        acquire_arg=("*._lock.acquire",),
+        release_arg=("*._lock.release",),
+    ),
+    ResourceSpec(
+        name="process-handle",
+        rationale=("a spawned worker process neither waited, "
+                   "terminated, nor parked on the supervisor is a "
+                   "zombie holding its TPU chips"),
+        acquire=("subprocess.Popen",),
+        release_methods=("wait", "terminate", "kill", "communicate"),
+    ),
+)

@@ -1,0 +1,57 @@
+"""The ``leak-path`` rule: must-release checking over per-function CFGs.
+
+A thin adapter — the work is ``cfg.build_cfg`` + ``dataflow.check_module``
+against the ``resources.CATALOG``. Gated behind ``pdlint --lifecycle``
+(the walk visits every path of every function for every catalog
+resource; the default lint must stay instant), or by naming it in
+``--select``.
+
+Scope inside paddle_tpu/ is the serving tier — the modules that actually
+move slots, leases, bundles, and spans. Kernel/analysis internals churn
+ASTs and locks in ways the catalog was never written for; widening scope
+there would only manufacture suppression noise. Fixture snippets (any
+path outside paddle_tpu/) are always checked, so tests exercise the
+rule without a serving-path filename.
+"""
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..core import Finding, ModuleContext, Rule, register_rule
+from .dataflow import check_module
+
+__all__ = ["LeakPathRule"]
+
+_SERVING_PREFIXES = (
+    "paddle_tpu/serving",          # serving.py, serving_http.py,
+                                   # serving_cluster/*
+    "paddle_tpu/observability/",
+    "paddle_tpu/chaos",
+    "paddle_tpu/loadgen",
+    "paddle_tpu/speculative",
+)
+
+
+def _in_scope(path: str) -> bool:
+    if not path.startswith("paddle_tpu/"):
+        return True                # fixtures and snippets: always check
+    return path.startswith(_SERVING_PREFIXES)
+
+
+@register_rule
+class LeakPathRule(Rule):
+    id = "leak-path"
+    rationale = ("a resource acquired on one path must be released, "
+                 "transferred, or returned on EVERY path; an "
+                 "exception-edge leak is permanent capacity loss "
+                 "(docs/ANALYSIS.md 'Lifecycle analysis')")
+    lifecycle = True
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not _in_scope(ctx.path):
+            return
+        for r in check_module(ctx):
+            f = self.finding(ctx, r.line, r.message)
+            f.data = {"resource": r.resource, "var": r.var,
+                      "acquire": r.acquire_text}
+            yield f

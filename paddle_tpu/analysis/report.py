@@ -1,20 +1,27 @@
-"""pdlint reporters: text (``file:line rule-id message``) and JSON.
+"""pdlint reporters: text (``file:line rule-id message``), JSON, SARIF.
 
 The JSON schema is a stability contract (tests/test_static_analysis.py
 pins it): CI consumers parse ``findings``/``counts``/``total`` and must
 not break when rules are added. Bump ``SCHEMA_VERSION`` on any
-shape-incompatible change.
+shape-incompatible change. SARIF (``--format sarif``) is 2.1.0 — the
+shape CI annotators ingest; fingerprints reuse the baseline key (file,
+rule, symbol, message) so annotations survive unrelated edits exactly
+like the baseline does.
 """
 from __future__ import annotations
 
 import json
-from typing import Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 from .core import Finding
 
-__all__ = ["render_text", "render_json", "SCHEMA_VERSION"]
+__all__ = ["render_text", "render_json", "render_sarif",
+           "SCHEMA_VERSION"]
 
 SCHEMA_VERSION = 1
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
 
 
 def render_text(findings: Iterable[Finding],
@@ -52,4 +59,50 @@ def render_json(findings: Iterable[Finding], baselined: int = 0,
     }
     if rule_ids is not None:
         doc["rules"] = sorted(rule_ids)
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"
+
+
+def render_sarif(findings: Iterable[Finding],
+                 rules: Optional[Dict[str, object]] = None) -> str:
+    """SARIF 2.1.0. ``rules`` is the registry (id -> Rule) so the tool
+    component carries each rule's rationale; results fingerprint on the
+    baseline key, not line numbers."""
+    findings = list(findings)
+    rule_meta = []
+    for rid in sorted(rules or {}):
+        rule_meta.append({
+            "id": rid,
+            "shortDescription": {"text": getattr(rules[rid], "rationale",
+                                                 "") or rid},
+        })
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.file,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+            "partialFingerprints": {
+                "pdlintKey/v1": "|".join(f.key()),
+            },
+        })
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "pdlint",
+                "informationUri": "docs/ANALYSIS.md",
+                "rules": rule_meta,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
     return json.dumps(doc, indent=1, sort_keys=True) + "\n"

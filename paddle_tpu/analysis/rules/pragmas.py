@@ -1,0 +1,34 @@
+"""``unused-disable``: pragma hygiene.
+
+A ``# pdlint: disable=<id>`` that suppresses nothing is worse than
+noise — it documents a violation that no longer exists (or never did,
+when the id is a typo), and it will silently swallow the NEXT real
+finding on that line. Core tracks which pragmas actually fired
+(``ModuleContext.pragma_used``); this rule only declares the id and
+rationale for the catalog. The findings themselves are produced by
+``core.unused_pragma_findings`` after all selected rules have run,
+because "unused" is only decidable once every rule has had its chance
+to use the pragma. Ids of rules that did NOT run this invocation are
+never flagged — a ``leak-path`` pragma is live documentation even on a
+default, non-``--lifecycle`` pass.
+"""
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..core import Finding, ModuleContext, Rule, register_rule
+
+__all__ = ["UnusedDisableRule"]
+
+
+@register_rule
+class UnusedDisableRule(Rule):
+    id = "unused-disable"
+    rationale = ("a disable pragma that suppresses nothing documents a "
+                 "violation that no longer exists and will silently "
+                 "swallow the next real finding on its line")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        # driver-computed (core.unused_pragma_findings): needs the
+        # whole run's pragma-usage state, not one rule's pass
+        return ()

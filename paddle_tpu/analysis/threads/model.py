@@ -35,7 +35,7 @@ import ast
 import os
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..core import ModuleContext, iter_py_files
+from ..core import ModuleContext, iter_py_files, module_context
 
 __all__ = ["ProjectModel", "get_model", "FuncKey", "SpawnSite"]
 
@@ -118,7 +118,14 @@ class ProjectModel:
 
     MODULE_BODY = "<module>"   # pseudo-function for top-level statements
 
-    def __init__(self, sources: Dict[str, str]):
+    def __init__(self, sources: Dict[str, str],
+                 contexts: Optional[Dict[str, ModuleContext]] = None):
+        # ``contexts`` are pre-parsed ModuleContexts (the shared
+        # ``core.module_context`` cache): one parse per file per run,
+        # and pragma-usage marks land on the SAME context objects the
+        # driver's unused-disable check reads. ``sources`` alone (the
+        # fixture-test path) parses privately.
+        self._contexts = contexts or {}
         self.modules: Dict[str, ModuleInfo] = {}
         self.functions: Dict[FuncKey, FuncInfo] = {}
         self.classes_by_name: Dict[str, List[ClassInfo]] = {}
@@ -139,7 +146,7 @@ class ProjectModel:
     def _parse(self, sources: Dict[str, str]):
         for file, src in sorted(sources.items()):
             try:
-                ctx = ModuleContext(file, src)
+                ctx = self._contexts.get(file) or ModuleContext(file, src)
             except SyntaxError:
                 continue
             mod = ModuleInfo(file, ctx)
@@ -547,14 +554,23 @@ def model_from_root(root: str,
                     paths: Optional[List[str]] = None) -> ProjectModel:
     paths = paths or [os.path.join(root, "paddle_tpu")]
     sources = {}
+    contexts = {}
     for path in iter_py_files(paths):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
         try:
-            with open(path, encoding="utf-8") as fh:
-                sources[rel] = fh.read()
+            ctx = module_context(path, rel)
         except OSError:
             continue
-    return ProjectModel(sources)
+        except SyntaxError:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    sources[rel] = fh.read()
+            except OSError:
+                pass
+            continue
+        sources[rel] = ctx.source
+        contexts[rel] = ctx
+    return ProjectModel(sources, contexts=contexts)
 
 
 _CACHE: Dict[tuple, ProjectModel] = {}

@@ -221,13 +221,15 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
         # counters see it (it is arrival #1 at each point) — no default
         # fault triggers at nth=1.
         conn = http.client.HTTPConnection(host, port, timeout=300)
-        conn.request("POST", "/v1/completions",
-                     json.dumps({"prompt_token_ids": prompts[0],
-                                 "max_tokens": 1}),
-                     {"Content-Type": "application/json"})
-        warm = conn.getresponse()
-        warm.read()
-        conn.close()
+        try:
+            conn.request("POST", "/v1/completions",
+                         json.dumps({"prompt_token_ids": prompts[0],
+                                     "max_tokens": 1}),
+                         {"Content-Type": "application/json"})
+            warm = conn.getresponse()
+            warm.read()
+        finally:
+            conn.close()
         if warm.status != 200:
             raise RuntimeError(
                 f"chaos dryrun warmup failed: {warm.status}")

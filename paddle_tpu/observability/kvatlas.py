@@ -100,7 +100,7 @@ def kv_bytes_per_token(cfg) -> int:
         from ..models.llama import head_dim_of
 
         d = int(head_dim_of(cfg))
-    except Exception:  # pdlint: disable=silent-exception -- non-llama configs fall back to the hidden/heads quotient
+    except Exception:  # non-llama configs fall back to the hidden/heads quotient
         hidden = int(getattr(cfg, "hidden_size", 0) or 0)
         heads = int(getattr(cfg, "num_attention_heads", 1) or 1)
         d = hidden // max(1, heads)

@@ -413,16 +413,21 @@ class Tracer:
         if not sp:
             yield sp
             return
-        self._push(sp)
         try:
+            # inside the try: if _push itself fails the span still ends
+            # (status=error) instead of leaking — _pop tolerates a span
+            # that never made it onto the stack
+            self._push(sp)
             yield sp
         except BaseException:
-            self._pop(sp)
+            # end before pop: ending is what delivers the span to the
+            # buffer, popping only maintains the current-span stack
             sp.end("error")
+            self._pop(sp)
             raise
         else:
-            self._pop(sp)
             sp.end()
+            self._pop(sp)
 
     def use(self, span: Optional[Span]):
         """Make an EXISTING span current for the block without ending it

@@ -693,6 +693,18 @@ class _RequestBookkeeping:
         or once evicted from the retention window."""
         return self._finished_usage.get(rid)
 
+    def _release_slot(self, s: int) -> None:
+        """Slot teardown, in ONE place: clear the request binding, zero
+        the ragged length row, and hand the slot's KV pages back to the
+        atlas. Idempotent on an already-free slot. Every retire, cancel,
+        preempt, migrate-out, and degrade path routes through here —
+        pdlint's engine-slot lifecycle rule anchors on this name, so an
+        inlined copy that forgets the atlas half shows up as a leak."""
+        self._slots[s] = None
+        self._lengths = self._lengths.at[s].set(0)
+        if self.kvatlas.enabled:
+            self.kvatlas.free_slot(s)
+
     def cancel(self, rid: int) -> bool:
         """Abort a request (client disconnect): queued requests drop
         before admission; active requests free their slot immediately —
@@ -714,10 +726,7 @@ class _RequestBookkeeping:
                 return True
         for s, req in enumerate(self._slots):
             if req is not None and req.rid == rid:
-                self._slots[s] = None
-                self._lengths = self._lengths.at[s].set(0)
-                if self.kvatlas.enabled:
-                    self.kvatlas.free_slot(s)
+                self._release_slot(s)
                 if rec.enabled:
                     rec.record(_frec.EV_CANCEL, rid=rid,
                                engine=self._engine_label, where="active")
@@ -730,9 +739,7 @@ class _RequestBookkeeping:
         for s, st in list(getattr(self, "_chunking", {}).items()):
             if st.req.rid == rid:
                 del self._chunking[s]
-                self._lengths = self._lengths.at[s].set(0)
-                if self.kvatlas.enabled:
-                    self.kvatlas.free_slot(s)
+                self._release_slot(s)
                 if st.span is not None:
                     st.span.end("cancelled")
                 if rec.enabled:
@@ -776,7 +783,7 @@ class _RequestBookkeeping:
 
 class _ChunkState:
     """A request mid chunked-prefill: it has RESERVED a slot (invisible
-    to _free_slot) but is not yet decoding — ``pos`` tokens of its prompt
+    to _alloc_slot) but is not yet decoding — ``pos`` tokens of its prompt
     are already in the slot's pages, the rest lands one chunk per engine
     step with a normal decode dispatch in between."""
 
@@ -1251,7 +1258,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         bounces off the bound."""
         if (self.max_queue is None
                 or len(self._queue) < self.max_queue
-                or self._free_slot() >= 0):
+                or self._alloc_slot() >= 0):
             return
         if priority is not None and self._queue:
             # capacity shed: lowest class first, latest deadline within
@@ -1397,7 +1404,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                     else unwrap(c[key])
                 # the handoff IS the device->host export: one deliberate
                 # fetch per layer, off the decode loop entirely
-                pair.append(np.asarray(buf)[0])  # pdlint: disable=host-sync -- handoff export is the transfer
+                pair.append(np.asarray(buf)[0])  # handoff export is the transfer
             layers.append(tuple(pair))
         last_row = np.asarray(last)[0].astype(np.float32)  # pdlint: disable=host-sync -- handoff export is the transfer
         return seal_bundle({
@@ -1544,11 +1551,8 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             "layers": kv["layers"],
             "last": kv["last"],
         })
-        self._slots[slot] = None
-        self._lengths = self._lengths.at[slot].set(0)
+        self._release_slot(slot)
         self._m_bundle["migrate"].observe(float(nbytes))
-        if self.kvatlas.enabled:
-            self.kvatlas.free_slot(slot)
         self._n_migrated_out += 1
         self._m_sched["migrate_out"].inc()
         rec = _frec.RECORDER
@@ -1807,10 +1811,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             req = self._slots[s]
             self._finished[req.rid] = np.asarray(req.tokens, np.int64)
             self._count_finished(req)
-            self._slots[s] = None
-            self._lengths = self._lengths.at[s].set(0)
-            if at_on:
-                at.free_slot(s)
+            self._release_slot(s)
             self._trace_end(req, "ok")
         # stream AFTER state is consistent: every callback fires even if an
         # earlier one raises; the first exception then propagates
@@ -2024,10 +2025,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             req = self._slots[s]
             self._finished[req.rid] = np.asarray(req.tokens, np.int64)
             self._count_finished(req)
-            self._slots[s] = None
-            self._lengths = self._lengths.at[s].set(0)
-            if at_on:
-                at.free_slot(s)
+            self._release_slot(s)
             self._trace_end(req, "ok")
         # stream AFTER state is consistent (same protocol as step())
         first_exc = None
@@ -2071,7 +2069,9 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         done, self._finished = self._finished, {}
         return done
 
-    def _free_slot(self) -> int:
+    def _alloc_slot(self) -> int:
+        """Pick a free slot index, or -1 when none — the acquire half of
+        the _alloc_slot/_release_slot pair the lifecycle rule tracks."""
         for s, r in enumerate(self._slots):
             if r is None and s not in self._chunking:
                 return s
@@ -2147,10 +2147,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             self.kvatlas.set_budget(self.max_active_slots)
         if (victim is not None and victim.slot >= 0
                 and self._slots[victim.slot] is victim):
-            self._slots[victim.slot] = None
-            self._lengths = self._lengths.at[victim.slot].set(0)
-            if self.kvatlas.enabled:
-                self.kvatlas.free_slot(victim.slot)
+            self._release_slot(victim.slot)
             victim.slot = -1
         self._n_degraded += 1
         self._m_sched["degrade"].inc()
@@ -2204,13 +2201,13 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                 # slot-scan below owns the decision, so preemption
                 # still runs at a full pool)
                 return
-            slot = self._free_slot()
+            slot = self._alloc_slot()  # pdlint: disable=leak-path -- finder only: the slot is not reserved until _slots[slot] = req binds it, so a raise before that leaks nothing
             if slot < 0:
                 # page pressure: a strictly-higher-priority queued request
                 # may evict a low-priority slot's KV to host memory
                 if not self._maybe_preempt(now):
                     return
-                slot = self._free_slot()
+                slot = self._alloc_slot()  # pdlint: disable=leak-path -- finder only, same as above
                 if slot < 0:
                     return
             req = self._pop_next(now)
@@ -2322,14 +2319,12 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         req.resume = bundle
         req.n_preempted += 1
         self._n_preempted += 1
-        self._slots[s] = None
-        self._lengths = self._lengths.at[s].set(0)
+        self._release_slot(s)
         req.slot = -1
         self._queue.append(req)
         self._m_bundle["preempt"].observe(float(nbytes))
         if self.kvatlas.enabled:
-            # device pages freed, host bundle parked until restore
-            self.kvatlas.free_slot(s)
+            # device pages freed above; host bundle parked until restore
             self.kvatlas.park(req.rid, nbytes)
         self._m_sched["preempt"].inc()
         rec = _frec.RECORDER
@@ -3233,8 +3228,7 @@ class Seq2SeqBatchEngine(_RequestBookkeeping):
                 self._count_finished(req)
                 self._record_reason(req.rid,
                                     "stop" if stopped else "length")
-                self._slots[s] = None
-                self._lengths = self._lengths.at[s].set(0)
+                self._release_slot(s)
                 self._trace_end(req, "ok")
         if clk is not None:
             clk.lap("retire")

@@ -256,7 +256,7 @@ class Cluster:
                 if signal.getsignal(sig) is not prev:
                     signal.signal(sig, prev)
             except (ValueError, OSError, TypeError):
-                pass  # pdlint: disable=silent-exception -- teardown off the main thread cannot rewire signals; the process is exiting anyway
+                pass  # teardown off the main thread cannot rewire signals; the process is exiting anyway
         self._prev_signals = {}
 
     # ---- operations ------------------------------------------------------

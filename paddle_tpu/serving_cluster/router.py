@@ -601,11 +601,19 @@ class RouterServer:
                                  exclude=exclude)
         if serve is None:
             return None
-        if self.pool.has_role("prefill") and serve.kv_channel:
-            pre = self.pool.select(roles=("prefill",), exclude=exclude)
-            if pre is not None:
-                return ("disagg", pre, serve)
-        return ("direct", None, serve)
+        try:
+            if self.pool.has_role("prefill") and serve.kv_channel:
+                pre = self.pool.select(roles=("prefill",),
+                                       exclude=exclude)
+                if pre is not None:
+                    return ("disagg", pre, serve)
+            return ("direct", None, serve)
+        except BaseException:
+            # the lease counts pending load on the worker; an exception
+            # between select() and the ownership-transferring return
+            # would otherwise leave phantom load behind forever
+            self.pool.release(serve)
+            raise
 
     def _count_outcome(self, outcome: str):
         ROUTER_PLACEMENTS.inc(outcome=outcome)
@@ -730,16 +738,28 @@ class RouterServer:
                 mode, pre, serve = plan
                 attempts += 1
                 base = 0
-            self._journal_place(req_id, serve.replica_id)
-            if rec.enabled:
-                rec.record(_frec.EV_ROUTER_PLACE,
-                           replica_id=serve.replica_id, role=serve.role,
-                           score=serve.score(), attempt=attempts,
-                           mode=mode)
-            sp = self._tracer.start_span(
-                _tracing.SPAN_ROUTER_UPSTREAM, parent=root,
-                attrs={"replica_id": serve.replica_id, "role": serve.role,
-                       "attempt": attempts, "mode": mode})
+            try:
+                self._journal_place(req_id, serve.replica_id)
+                if rec.enabled:
+                    rec.record(_frec.EV_ROUTER_PLACE,
+                               replica_id=serve.replica_id,
+                               role=serve.role, score=serve.score(),
+                               attempt=attempts, mode=mode)
+                sp = self._tracer.start_span(
+                    _tracing.SPAN_ROUTER_UPSTREAM, parent=root,
+                    attrs={"replica_id": serve.replica_id,
+                           "role": serve.role, "attempt": attempts,
+                           "mode": mode})
+            except BaseException:
+                # the attempt never started, so the attempt's finally
+                # below can never run — the leases would stay counted as
+                # phantom pending load on the workers. Releases first:
+                # they cannot raise, the journal write could
+                self.pool.release(serve)
+                if pre is not None:
+                    self.pool.release(pre)
+                self._journal_clear(req_id)
+                raise
             try:
                 if mode != "migrate":
                     up_req = req
@@ -862,10 +882,19 @@ class RouterServer:
                     # relay onto the survivors in the same instant
                     time.sleep(jittered(self.retry_backoff_s))
             finally:
-                self._journal_clear(req_id)
+                # releases first (no-raise decrements), then the span,
+                # then the journal write — ordered so nothing that can
+                # fail runs before a resource others account for is
+                # given back. Span.end is idempotent (first end wins):
+                # the typed ends in the handlers above stay
+                # authoritative, this only catches exceptions no
+                # handler matched, where the span would otherwise never
+                # reach the trace buffer
                 self.pool.release(serve)
                 if pre is not None:
                     self.pool.release(pre)
+                sp.end("error")
+                self._journal_clear(req_id)
         # retry budget exhausted (or the pool is empty) — but if this
         # rid's LAST death is what emptied the pool, the quarantine may
         # have tripped after the loop-top check: answer the typed 422,

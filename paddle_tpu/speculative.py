@@ -389,7 +389,7 @@ def mtp_speculative_generate(model, input_ids, max_new_tokens=20,
         caches = _empty_caches(model, 1, max_len)
         normed, pre, caches = model.llama.forward_cached(
             wrap(ids_j), caches, rope_len=max_len, return_prenorm=True)
-        t1 = int(jnp.argmax(  # pdlint: disable=host-sync -- the prefill's one deliberate first-token fetch
+        t1 = int(jnp.argmax(  # the prefill's one deliberate first-token fetch
             unwrap(model.lm_head_logits(normed[:, -1:]))[0, 0]))
 
         # MTP stream cache: seed with pairs (h_i, t_{i+1}) for the prompt

@@ -9,6 +9,9 @@ Usage:
     python scripts/pdlint.py --select silent-exception,host-sync
     python scripts/pdlint.py --graph                  # + jaxpr rules
     python scripts/pdlint.py --threads                # + concurrency rules
+    python scripts/pdlint.py --lifecycle              # + leak-path rules
+    python scripts/pdlint.py --format sarif           # SARIF 2.1.0 report
+    python scripts/pdlint.py --prune-baseline         # drop stale entries
     python scripts/pdlint.py --solve llama --mesh dp=2,mp=4
     python scripts/pdlint.py --list-rules
     python scripts/pdlint.py --no-project-rules paddle_tpu/serving.py
@@ -37,12 +40,20 @@ def main(argv=None) -> int:
     p.add_argument("paths", nargs="*",
                    help="files/dirs to lint (default: paddle_tpu/)")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit the JSON report instead of text")
+                   help="emit the JSON report (same as --format json)")
+    p.add_argument("--format", default=None, dest="fmt",
+                   choices=("text", "json", "sarif"),
+                   help="report format (default text; sarif is 2.1.0 "
+                        "for CI inline annotation)")
     p.add_argument("--baseline", default=None, metavar="FILE",
                    help="suppress findings recorded in this baseline")
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings to --baseline (or "
                         ".pdlint_baseline.json) and exit 0")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="rewrite --baseline (or .pdlint_baseline.json) "
+                        "dropping entries whose file/symbol no longer "
+                        "resolves, then exit 0 — no lint run")
     p.add_argument("--select", default=None, metavar="IDS",
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--list-rules", action="store_true",
@@ -58,6 +69,11 @@ def main(argv=None) -> int:
                    help="also run the whole-program concurrency rules "
                         "(thread model + lock-order graph; see "
                         "docs/ANALYSIS.md 'Concurrency rules')")
+    p.add_argument("--lifecycle", action="store_true",
+                   help="also run the CFG-based resource-leak rules "
+                        "(must-release dataflow over slots, leases, "
+                        "bundles, spans; see docs/ANALYSIS.md "
+                        "'Lifecycle analysis')")
     p.add_argument("--solve", default=None, metavar="MODEL",
                    help="run the auto-sharding solver over a zoo entry "
                         "('all' = the fast zoo) and print the chosen "
@@ -80,15 +96,34 @@ def main(argv=None) -> int:
             print(f"{rid:18s} [{kind}]  {rule.rationale}")
         return 0
 
+    base_path = args.baseline or os.path.join(_REPO,
+                                              ".pdlint_baseline.json")
+    if args.prune_baseline:
+        if not os.path.isfile(base_path):
+            print(f"pdlint: no baseline at "
+                  f"{os.path.relpath(base_path, _REPO)} — nothing to "
+                  "prune")
+            return 0
+        entries = bl.load_entries(base_path)
+        stale = bl.stale_entries(entries, _REPO)
+        stale_ids = {id(e) for e in stale}
+        kept = [e for e in entries if id(e) not in stale_ids]
+        for e in stale:
+            print(f"pdlint: pruned stale entry {e['file']} "
+                  f"[{e.get('symbol') or '<module>'}] {e['rule']} "
+                  "(file/symbol no longer resolves)")
+        bl.save_entries(base_path, kept)
+        print(f"pdlint: kept {len(kept)} of {len(entries)} baselined "
+              f"finding(s) in {os.path.relpath(base_path, _REPO)}")
+        return 0
+
     selected = ([s.strip() for s in args.select.split(",")]
                 if args.select else None)
     paths = [os.path.abspath(p_) for p_ in args.paths] or None
     findings = analysis.run(paths=paths, root=_REPO, selected=selected,
                             with_project_rules=not args.no_project_rules,
-                            graph=args.graph, threads=args.threads)
-
-    base_path = args.baseline or os.path.join(_REPO,
-                                              ".pdlint_baseline.json")
+                            graph=args.graph, threads=args.threads,
+                            lifecycle=args.lifecycle)
     if args.write_baseline:
         # stale-entry pruning: report what the rewrite drops, split into
         # entries whose (file, symbol) no longer resolves (dead weight
@@ -120,10 +155,15 @@ def main(argv=None) -> int:
         baselined = len(findings) - len(new)
         findings = new
 
-    out = (report.render_json(findings, baselined,
-                              rule_ids=sorted(analysis.RULES))
-           if args.as_json else report.render_text(findings, baselined))
-    print(out, end="" if args.as_json else "\n")
+    fmt = args.fmt or ("json" if args.as_json else "text")
+    if fmt == "json":
+        out = report.render_json(findings, baselined,
+                                 rule_ids=sorted(analysis.RULES))
+    elif fmt == "sarif":
+        out = report.render_sarif(findings, rules=analysis.RULES)
+    else:
+        out = report.render_text(findings, baselined)
+    print(out, end="" if fmt in ("json", "sarif") else "\n")
     return 1 if findings else 0
 
 
@@ -180,4 +220,10 @@ def _solve(args) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    rc = main()
+    # skip interpreter teardown: the shared parse cache holds every
+    # module's AST, and refcount-freeing millions of nodes at exit costs
+    # ~2s of pure shutdown. Nothing here needs finalizers.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

@@ -1,0 +1,595 @@
+"""pdlint --lifecycle: the CFG-based resource-leak layer.
+
+Four blocks, mirroring tests/test_static_analysis.py:
+
+1. **CFG unit tests** — the builder's edge sets on the constructs that
+   break naive walkers (try/finally with return, while-True/break,
+   except chains, else clauses, nested with, may_raise whitelisting).
+2. **Fixture corpus per lifecycle behavior** — a known-leaking snippet
+   that FAILS and a known-good twin that stays clean, for every escape
+   kind (except-edge, early return, loop re-acquire, discarded) and
+   every non-leak (transfer via return/attr/container, finally-release,
+   with-managed, None and -1 sentinel guards, helper summaries).
+3. **Framework tests** — leak-path pragma suppression, the generalized
+   unused-disable rule, SARIF output shape, --prune-baseline.
+4. **The tier-1 gate** — ``scripts/pdlint.py --lifecycle --json`` over
+   the whole package exits 0 with ZERO baselined leak-path entries,
+   plus regression tests for the real leaks this pass found and fixed
+   (router lease guards, Tracer.span end-before-pop).
+"""
+import ast
+import importlib.util
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu import analysis
+from paddle_tpu.analysis import cfg
+from paddle_tpu.analysis import baseline as bl
+from paddle_tpu.analysis import report
+
+
+def _rules():
+    return analysis.ast_rules(["leak-path"])
+
+
+def lint(src, filename="fix.py"):
+    """leak-path findings for one dedented snippet."""
+    found = analysis.analyze_source(textwrap.dedent(src), filename,
+                                    _rules())
+    return [f.message for f in found]
+
+
+def _cfg_of(src, noraise=frozenset()):
+    tree = ast.parse(textwrap.dedent(src))
+    func = cfg.function_nodes(tree)[0][1]
+    return cfg.build_cfg(func, noraise=noraise)
+
+
+# ---------------------------------------------------------------------------
+# 1. the CFG builder on its own
+# ---------------------------------------------------------------------------
+
+def test_cfg_if_else_edges():
+    g = _cfg_of("""
+    def f(c):
+        if c:
+            a = 1
+        else:
+            a = 2
+        return a
+    """)
+    labels = g.edge_labels()
+    assert ("branch@3", "true", "stmt@4") in labels
+    assert ("branch@3", "false", "stmt@6") in labels
+    assert ("stmt@7", "return", "exit") in labels
+    # a bare-name test cannot raise: no raise edge off the branch
+    assert not any(s == "branch@3" and k == "raise"
+                   for (s, k, _d) in labels)
+
+
+def test_cfg_try_finally_with_return_runs_finally():
+    """The classic: ``return`` inside try must route THROUGH the
+    finally body before reaching exit — the property the whole leak
+    pass rests on."""
+    g = _cfg_of("""
+    def f(x):
+        try:
+            return x
+        finally:
+            done()
+    """)
+    labels = g.edge_labels()
+    assert ("stmt@4", "return", "finally@6") in labels
+    assert ("finally@6", "next", "stmt@6") in labels
+    assert ("stmt@6", "return", "exit") in labels
+    # no edge skips the finally: the return stmt never reaches exit
+    # directly
+    assert ("stmt@4", "return", "exit") not in labels
+
+
+def test_cfg_while_true_has_no_false_exit():
+    g = _cfg_of("""
+    def f():
+        while True:
+            if ready():
+                break
+        return 1
+    """)
+    labels = g.edge_labels()
+    # while True: the loop head's ONLY structured exit is the break
+    assert not any(s == "loop@3" and k == "false"
+                   for (s, k, _d) in labels)
+    assert ("stmt@5", "break", "loopexit@3") in labels
+    assert ("loopexit@3", "next", "stmt@6") in labels
+
+
+def test_cfg_except_dispatch_and_narrow_handler_unwind():
+    g = _cfg_of("""
+    def f():
+        try:
+            risky()
+        except ValueError:
+            handle()
+        return 1
+    """)
+    labels = g.edge_labels()
+    assert ("stmt@4", "raise", "except@3") in labels
+    assert ("handler@5", "caught", "stmt@6") in labels
+    # a NARROW handler may not match: the unwind continues out
+    assert ("handler@5", "raise", "raise") in labels
+
+
+def test_cfg_else_clauses():
+    g = _cfg_of("""
+    def f():
+        try:
+            risky()
+        except ValueError:
+            handle()
+        else:
+            good()
+        return 1
+    """)
+    labels = g.edge_labels()
+    # try-body success flows into the else body, never the handler
+    assert ("stmt@4", "next", "stmt@8") in labels
+    assert ("stmt@8", "next", "stmt@9") in labels
+    g2 = _cfg_of("""
+    def f(xs):
+        for x in xs:
+            use(x)
+        else:
+            done()
+    """)
+    labels2 = g2.edge_labels()
+    assert ("loop@3", "false", "stmt@6") in labels2
+    assert ("stmt@4", "loop", "loop@3") in labels2
+
+
+def test_cfg_nested_with_unwind():
+    g = _cfg_of("""
+    def f(p):
+        with open(p) as a:
+            with open(p) as b:
+                use(a, b)
+    """)
+    labels = g.edge_labels()
+    assert ("with@3", "with", "with@4") in labels
+    assert ("with@4", "with", "stmt@5") in labels
+    # each context expr can raise during acquisition
+    assert ("with@3", "raise", "raise") in labels
+    assert ("with@4", "raise", "raise") in labels
+
+
+def test_may_raise_whitelist_and_scope_barriers():
+    stmt = ast.parse("log.info('x')").body[0]
+    assert cfg.may_raise(stmt)
+    assert not cfg.may_raise(stmt, resolver=lambda n: "log.info",
+                             noraise=frozenset({"info"}))
+    assert not cfg.may_raise(ast.parse("x = y + 1").body[0])
+    # a call inside a lambda body runs LATER, elsewhere
+    assert not cfg.may_raise(ast.parse("cb = lambda: boom()").body[0])
+
+
+# ---------------------------------------------------------------------------
+# 2. fixture corpus: every escape kind and every non-leak
+# ---------------------------------------------------------------------------
+
+def test_leak_on_except_edge():
+    msgs = lint("""
+    def f(pool, risky):
+        w = pool.select()
+        risky(w)
+        pool.release(w)
+    """)
+    assert len(msgs) == 1
+    assert "pool-lease 'w'" in msgs[0]
+    assert "leaks when `risky(w)` raises" in msgs[0]
+
+
+def test_finally_release_is_clean():
+    assert lint("""
+    def f(pool, risky):
+        w = pool.select()
+        try:
+            risky(w)
+        finally:
+            pool.release(w)
+    """) == []
+
+
+def test_leak_on_early_return_names_the_return():
+    msgs = lint("""
+    def f(pool, cond):
+        w = pool.select()
+        if cond:
+            return 0
+        pool.release(w)
+        return 1
+    """)
+    assert len(msgs) == 1
+    assert "leaks at `return 0` (line 5)" in msgs[0]
+
+
+def test_transfer_via_return_is_clean():
+    assert lint("""
+    def f(pool):
+        w = pool.select()
+        return w
+    """) == []
+
+
+def test_none_and_index_sentinel_guards_are_clean():
+    assert lint("""
+    def f(pool):
+        w = pool.select()
+        if w is None:
+            return None
+        return w
+    """) == []
+    # the -1 convention: engine _alloc_slot answers -1 for "no slot"
+    assert lint("""
+    def f(self):
+        s = self._alloc_slot()
+        if s < 0:
+            return None
+        try:
+            self.use(s)
+        finally:
+            self._release_slot(s)
+    """) == []
+
+
+def test_engine_slot_leak_without_release():
+    msgs = lint("""
+    def f(self, risky):
+        s = self._alloc_slot()
+        if s < 0:
+            return None
+        risky(s)
+        self._release_slot(s)
+    """)
+    assert len(msgs) == 1
+    assert "engine-slot 's'" in msgs[0]
+
+
+def test_with_managed_acquire_is_clean():
+    assert lint("""
+    def f(path, risky):
+        with open(path) as fh:
+            risky(fh.read())
+    """) == []
+
+
+def test_loop_reacquire_leak_and_released_loop_clean():
+    msgs = lint("""
+    def f(pool, items, risky):
+        for it in items:
+            w = pool.select()
+            if w is None:
+                continue
+            risky(it)
+            pool.release(w)
+    """)
+    assert len(msgs) == 1
+    assert "leaks when `risky(it)` raises" in msgs[0]
+    assert lint("""
+    def f(pool, items):
+        for it in items:
+            w = pool.select()
+            if w is None:
+                continue
+            pool.release(w)
+    """) == []
+
+
+def test_discarded_acquire_is_flagged():
+    msgs = lint("""
+    import subprocess
+    def f():
+        subprocess.Popen(['ls'])
+    """)
+    assert len(msgs) == 1
+    assert "process-handle" in msgs[0]
+    assert "discarded immediately" in msgs[0]
+
+
+def test_transfer_via_attribute_and_container_store():
+    assert lint("""
+    def f(self, pool):
+        w = pool.select()
+        self.w = w
+    """) == []
+    assert lint("""
+    def f(pool, q):
+        w = pool.select()
+        q.append(w)
+    """) == []
+
+
+def test_one_level_helper_summary_releases():
+    assert lint("""
+    class R:
+        def _teardown(self, w):
+            self.pool.release(w)
+        def go(self, risky):
+            w = self.pool.select()
+            try:
+                risky()
+            finally:
+                self._teardown(w)
+    """) == []
+
+
+def test_kv_bundle_transfer_vs_drop():
+    assert lint("""
+    def f(engine, dst):
+        b = engine.export_slot(3)
+        dst.admit_migrated(b)
+    """) == []
+    msgs = lint("""
+    def f(engine, dst, risky):
+        b = engine.export_slot(3)
+        risky()
+        dst.admit_migrated(b)
+    """)
+    assert len(msgs) == 1
+    assert "kv-bundle 'b'" in msgs[0]
+
+
+def test_tracer_span_needs_end_on_every_path():
+    msgs = lint("""
+    def f(tracer, risky):
+        sp = tracer.start_span('x')
+        risky()
+        sp.end()
+    """)
+    assert len(msgs) == 1
+    assert "tracer-span 'sp'" in msgs[0]
+    assert lint("""
+    def f(tracer, risky):
+        sp = tracer.start_span('x')
+        try:
+            risky()
+        finally:
+            sp.end()
+    """) == []
+
+
+def test_pool_claim_counts_as_acquire():
+    msgs = lint("""
+    def f(self, w, risky):
+        self.pool.claim(w)
+        risky()
+        self.pool.release(w)
+    """)
+    assert len(msgs) == 1
+    assert "pool-lease 'w'" in msgs[0]
+
+
+def test_noraise_calls_are_not_escape_edges():
+    # the logger between acquire and release is trusted not to throw
+    assert lint("""
+    def f(pool, log):
+        w = pool.select()
+        log.info('placing %s', w)
+        pool.release(w)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# 3. framework: pragmas, unused-disable, SARIF, --prune-baseline
+# ---------------------------------------------------------------------------
+
+def test_leak_path_pragma_suppresses():
+    assert lint("""
+    def f(pool, risky):
+        w = pool.select()  # pdlint: disable=leak-path -- deliberate
+        risky(w)
+        pool.release(w)
+    """) == []
+
+
+def test_unused_disable_flags_dead_pragma():
+    src = ("def f():\n"
+           "    return 1  # pdlint: disable=silent-exception\n")
+    msgs = [f.message for f in analysis.analyze_source(src, "m.py")
+            if f.rule == "unused-disable"]
+    assert len(msgs) == 1
+    assert "suppresses nothing" in msgs[0]
+
+
+def test_used_disable_is_not_flagged():
+    src = ("def f():\n"
+           "    try:\n"
+           "        risky()\n"
+           "    except Exception:  # pdlint: disable=silent-exception\n"
+           "        pass\n")
+    found = analysis.analyze_source(src, "m.py")
+    assert [f for f in found if f.rule == "unused-disable"] == []
+    assert [f for f in found if f.rule == "silent-exception"] == []
+
+
+def test_unknown_rule_id_in_pragma_is_flagged():
+    src = "x = 1  # pdlint: disable=leek-path\n"
+    msgs = [f.message for f in analysis.analyze_source(src, "m.py")
+            if f.rule == "unused-disable"]
+    assert len(msgs) == 1
+    assert "unknown rule 'leek-path'" in msgs[0]
+
+
+def test_disable_all_and_gated_rule_ids_never_flagged():
+    # 'all' is a policy statement, not a rule id
+    src = "x = 1  # pdlint: disable=all\n"
+    found = analysis.analyze_source(src, "m.py")
+    assert [f for f in found if f.rule == "unused-disable"] == []
+    # a pragma for a GATED rule family (leak-path only runs under
+    # --lifecycle) must not be called unused by a default run that
+    # never executed the rule
+    src2 = ("def f(pool, risky):\n"
+            "    w = pool.select()  # pdlint: disable=leak-path\n"
+            "    risky(w)\n"
+            "    pool.release(w)\n")
+    found2 = analysis.analyze_source(src2, "m.py")
+    assert [f for f in found2 if f.rule == "unused-disable"] == []
+
+
+def test_lifecycle_rules_are_gated_from_default_runs():
+    leaky = ("def f(pool, risky):\n"
+             "    w = pool.select()\n"
+             "    risky(w)\n"
+             "    pool.release(w)\n")
+    default = analysis.analyze_source(leaky, "m.py")
+    assert [f for f in default if f.rule == "leak-path"] == []
+    gated = analysis.analyze_source(leaky, "m.py",
+                                    analysis.ast_rules(lifecycle=True))
+    assert [f for f in gated if f.rule == "leak-path"]
+
+
+def test_sarif_output_shape():
+    leaky = ("def f(pool, risky):\n"
+             "    w = pool.select()\n"
+             "    risky(w)\n"
+             "    pool.release(w)\n")
+    findings = analysis.analyze_source(leaky, "m.py", _rules())
+    doc = json.loads(report.render_sarif(findings, rules=analysis.RULES))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "pdlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "leak-path" in rule_ids
+    res = run["results"][0]
+    assert res["ruleId"] == "leak-path"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "m.py"
+    assert loc["region"]["startLine"] == 2
+    # the fingerprint is the baseline key: stable across line drift
+    assert res["partialFingerprints"]["pdlintKey/v1"] \
+        == "|".join(findings[0].key())
+
+
+def _load_script(name):
+    path = os.path.join(_REPO, "scripts", name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_prune_baseline_drops_stale_keeps_live(tmp_path, capsys):
+    base = tmp_path / "bl.json"
+    live = {"file": "paddle_tpu/serving.py", "rule": "silent-exception",
+            "symbol": "ContinuousBatchEngine._admit", "message": "m"}
+    stale = {"file": "paddle_tpu/serving.py", "rule": "silent-exception",
+             "symbol": "ClassThatNeverExisted.method", "message": "m"}
+    gone = {"file": "paddle_tpu/no_such_file.py", "rule": "host-sync",
+            "symbol": "", "message": "m"}
+    bl.save_entries(str(base), [live, stale, gone])
+    mod = _load_script("pdlint.py")
+    rc = mod.main(["--prune-baseline", "--baseline", str(base)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "kept 1 of 3" in out
+    kept = bl.load_entries(str(base))
+    assert kept == [live]
+
+
+# ---------------------------------------------------------------------------
+# 4. the tier-1 gate + regressions for the leaks this pass found
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_gate_zero_findings(capsys):
+    """THE gate: ``scripts/pdlint.py --lifecycle --json`` over the whole
+    package exits 0 — with the checked-in baseline EMPTY, so zero
+    baselined leak-path entries exist anywhere (the acceptance
+    criterion: every real leak was fixed, never grandfathered)."""
+    mod = _load_script("pdlint.py")
+    rc = mod.main(["--lifecycle", "--json"])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == 0, f"pdlint --lifecycle found leaks:\n{out}"
+    assert doc["total"] == 0
+    entries = bl.load_entries(os.path.join(_REPO,
+                                           ".pdlint_baseline.json"))
+    assert [e for e in entries if e["rule"] == "leak-path"] == []
+
+
+def test_rule_catalog_lists_lifecycle_rules(capsys):
+    mod = _load_script("pdlint.py")
+    assert mod.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "leak-path" in out
+    assert "unused-disable" in out
+
+
+def test_router_plan_releases_lease_when_planning_raises():
+    """Regression (found by leak-path): an exception between
+    ``pool.select()`` and _plan's ownership-transferring return left
+    the lease counted as phantom pending load forever."""
+    from paddle_tpu.serving_cluster.pool import WorkerInfo
+    from paddle_tpu.serving_cluster.router import RouterServer
+
+    class Pool:
+        def __init__(self):
+            self.w = WorkerInfo(0, {"host": "h", "port": 1,
+                                    "role": "unified"})
+            self.released = []
+
+        def select(self, roles=None, exclude=()):
+            self.w.pending += 1
+            return self.w
+
+        def has_role(self, role):
+            raise RuntimeError("pool backend lost")
+
+        def release(self, w):
+            w.pending -= 1
+            self.released.append(w.replica_id)
+
+    rts = RouterServer.__new__(RouterServer)
+    rts.pool = Pool()
+    # kv_channel truthy forces the has_role() probe on the plan path
+    rts.pool.w.kv_channel = "chan"
+    with pytest.raises(RuntimeError):
+        rts._plan(())
+    assert rts.pool.released == [0]
+    assert rts.pool.w.pending == 0
+
+
+def test_tracer_span_ends_even_when_pop_raises():
+    """Regression (found by leak-path): the span context manager called
+    ``_pop`` BEFORE ``end`` — a raising pop lost the span entirely, a
+    hole in the trace exactly where the failure was."""
+    from paddle_tpu.observability import tracing
+
+    class PopBomb(tracing.Tracer):
+        def _pop(self, span):
+            super()._pop(span)
+            raise RuntimeError("stack corrupted")
+
+    tr = PopBomb(capacity=16)
+    tr.enable()
+    with pytest.raises(RuntimeError):
+        with tr.span("work"):
+            pass
+    recs = [r for r in tr.spans() if r["name"] == "work"]
+    assert len(recs) == 1          # ended BEFORE the pop raised
+
+
+def test_tracer_span_error_status_on_body_raise():
+    from paddle_tpu.observability import tracing
+
+    tr = tracing.Tracer(capacity=16)
+    tr.enable()
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    rec = [r for r in tr.spans() if r["name"] == "boom"][0]
+    assert rec["status"] == "error"
