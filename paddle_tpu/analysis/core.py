@@ -233,6 +233,11 @@ class Rule:
     # excluded from default runs, included by ``run(lifecycle=True)`` /
     # ``pdlint --lifecycle`` or by naming them in ``selected``
     lifecycle: bool = False
+    # error rules compute interprocedural exception summaries (thread
+    # model + CFG fixpoint): excluded from default runs, included by
+    # ``run(errors=True)`` / ``pdlint --errors`` or by naming them in
+    # ``selected``
+    errors: bool = False
 
     def check(self, ctx: ModuleContext) -> Iterable[Finding]:
         raise NotImplementedError
@@ -287,12 +292,13 @@ def ast_rules(selected: Optional[Sequence[str]] = None,
 def project_rules(selected: Optional[Sequence[str]] = None,
                   graph: bool = False,
                   threads: bool = False,
-                  lifecycle: bool = False) -> List[ProjectRule]:
+                  lifecycle: bool = False,
+                  errors: bool = False) -> List[ProjectRule]:
     """Graph rules run only when ``graph=True`` OR explicitly selected —
     they trace model programs, and the default lint must stay instant.
     Thread rules gate on ``threads=True`` the same way (they build the
     whole-program concurrency model), lifecycle rules on
-    ``lifecycle=True``."""
+    ``lifecycle=True``, error-flow rules on ``errors=True``."""
     _ensure_rules_loaded()
     return [r for rid, r in sorted(RULES.items())
             if isinstance(r, ProjectRule)
@@ -302,6 +308,8 @@ def project_rules(selected: Optional[Sequence[str]] = None,
             and (threads or not r.threads or
                  (selected is not None and rid in selected))
             and (lifecycle or not r.lifecycle or
+                 (selected is not None and rid in selected))
+            and (errors or not r.errors or
                  (selected is not None and rid in selected))]
 
 
@@ -412,18 +420,18 @@ def run(paths: Optional[Sequence[str]] = None, root: Optional[str] = None,
         selected: Optional[Sequence[str]] = None,
         with_project_rules: bool = True,
         graph: bool = False, threads: bool = False,
-        lifecycle: bool = False) -> List[Finding]:
+        lifecycle: bool = False, errors: bool = False) -> List[Finding]:
     """Analyze ``paths`` (default: ``<root>/paddle_tpu``) and, unless
     disabled, run the project rules against ``root`` (graph rules only
     with ``graph=True``, thread rules only with ``threads=True``,
-    lifecycle rules only with ``lifecycle=True``, or when explicitly
-    selected). Every finding — AST and project alike — honors the
-    per-line disable pragma; pragmas that suppress nothing are
-    themselves findings (``unused-disable``). Findings come back sorted
-    by (file, line, rule)."""
+    lifecycle rules only with ``lifecycle=True``, error-flow rules only
+    with ``errors=True``, or when explicitly selected). Every finding —
+    AST and project alike — honors the per-line disable pragma; pragmas
+    that suppress nothing are themselves findings (``unused-disable``).
+    Findings come back sorted by (file, line, rule)."""
     with _gc_paused():
         return _run(paths, root, selected, with_project_rules, graph,
-                    threads, lifecycle)
+                    threads, lifecycle, errors)
 
 
 @contextlib.contextmanager
@@ -442,7 +450,7 @@ def _gc_paused():
 
 
 def _run(paths, root, selected, with_project_rules, graph, threads,
-         lifecycle) -> List[Finding]:
+         lifecycle, errors) -> List[Finding]:
     if root is None:
         root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
@@ -466,7 +474,7 @@ def _run(paths, root, selected, with_project_rules, graph, threads,
         findings.extend(_check_ctx(ctx, arules))
     if with_project_rules:
         prules = project_rules(selected, graph=graph, threads=threads,
-                               lifecycle=lifecycle)
+                               lifecycle=lifecycle, errors=errors)
         ran_ids |= {r.id for r in prules}
         for rule in prules:
             for f in rule.check_project(root):

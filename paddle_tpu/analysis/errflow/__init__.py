@@ -1,0 +1,17 @@
+"""errflow — interprocedural exception-flow analysis (``pdlint
+--errors``).
+
+Per-function exception summaries (which types can escape, with
+raise-site provenance) computed by a call-graph fixpoint that composes
+the PR-18 CFG (handler-dispatch edges for catch/narrow/re-raise) with
+the PR-9 whole-program thread model, plus the typed-error lattice and
+the HTTP error taxonomy. See docs/ANALYSIS.md "Exception-flow
+analysis"; the rules live in ``rules.py`` and the tier-1 gate in
+tests/test_errflow_analysis.py.
+"""
+from .lattice import ErrorLattice  # noqa: F401
+from .summaries import ErrorFlow, get_flow  # noqa: F401
+from .taxonomy import NON_RETRYABLE, RETRYABLE, TAXONOMY  # noqa: F401
+
+__all__ = ["ErrorLattice", "ErrorFlow", "get_flow", "TAXONOMY",
+           "RETRYABLE", "NON_RETRYABLE"]

@@ -1,0 +1,212 @@
+"""The exception-type lattice: classification + catch semantics.
+
+Exception types flow through the summaries engine as plain NAMES (the
+final dotted component — ``QueueFull``, ``_WorkerBusy``, ``OSError``),
+resolved against two hierarchies:
+
+- the **project hierarchy** from the thread model's class index
+  (``ClassInfo.bases``, resolved dotted strings), so ``except
+  RuntimeError`` is known to catch ``HandoffCorrupt``;
+- a **builtin hierarchy** table (the exception subtree of the stdlib
+  that serving code actually meets), so ``except OSError`` is known to
+  catch ``ConnectionResetError``.
+
+Every type lands in one of four classes:
+
+- ``control`` — a leading-underscore project exception: routing
+  control flow (``_Migrated``, ``_WorkerBusy``, ``_DeadlineExpired``).
+  Swallowing one breaks the router's protocol, silently.
+- ``fault``   — any other project exception (``QueueFull``,
+  ``HandoffCorrupt``, ``XlaOom``): a typed error with an HTTP contract.
+- ``fatal``   — ``SystemExit``/``KeyboardInterrupt``/``GeneratorExit``/
+  ``MemoryError``: escaping a thread root is the *intended* behavior
+  (crash loud), so escape rules skip them.
+- ``generic`` — everything else, including the ``Exception`` token the
+  engine manufactures for calls it cannot resolve. Caught only by
+  broad handlers; never reported by the typed rules.
+
+Pure functions over the ``ProjectModel`` — no paddle_tpu import — so
+fixture snippets unit-test the lattice in isolation
+(tests/test_errflow_analysis.py).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+__all__ = ["ErrorLattice", "BUILTIN_PARENT", "FATAL_TYPES",
+           "CONTROL", "FAULT", "FATAL", "GENERIC", "GENERIC_TOKEN",
+           "handler_spec"]
+
+CONTROL = "control"
+FAULT = "fault"
+FATAL = "fatal"
+GENERIC = "generic"
+
+#: the token the engine emits for a call it cannot resolve — "external
+#: code may raise something"; caught only by broad handlers
+GENERIC_TOKEN = "Exception"
+
+FATAL_TYPES = frozenset({
+    "SystemExit", "KeyboardInterrupt", "GeneratorExit", "MemoryError",
+})
+
+# child -> parent, the stdlib exception subtree serving code meets.
+# Aliases (IOError, EnvironmentError, socket.timeout) map onto their
+# canonical node so ``except OSError`` catches all spellings.
+BUILTIN_PARENT = {
+    "BaseException": None,
+    "SystemExit": "BaseException",
+    "KeyboardInterrupt": "BaseException",
+    "GeneratorExit": "BaseException",
+    "Exception": "BaseException",
+    "ArithmeticError": "Exception",
+    "ZeroDivisionError": "ArithmeticError",
+    "OverflowError": "ArithmeticError",
+    "FloatingPointError": "ArithmeticError",
+    "AssertionError": "Exception",
+    "AttributeError": "Exception",
+    "BufferError": "Exception",
+    "EOFError": "Exception",
+    "ImportError": "Exception",
+    "ModuleNotFoundError": "ImportError",
+    "LookupError": "Exception",
+    "IndexError": "LookupError",
+    "KeyError": "LookupError",
+    "MemoryError": "Exception",
+    "NameError": "Exception",
+    "UnboundLocalError": "NameError",
+    "OSError": "Exception",
+    "IOError": "OSError",
+    "EnvironmentError": "OSError",
+    "FileNotFoundError": "OSError",
+    "FileExistsError": "OSError",
+    "PermissionError": "OSError",
+    "IsADirectoryError": "OSError",
+    "NotADirectoryError": "OSError",
+    "InterruptedError": "OSError",
+    "BlockingIOError": "OSError",
+    "ChildProcessError": "OSError",
+    "ProcessLookupError": "OSError",
+    "ConnectionError": "OSError",
+    "BrokenPipeError": "ConnectionError",
+    "ConnectionResetError": "ConnectionError",
+    "ConnectionAbortedError": "ConnectionError",
+    "ConnectionRefusedError": "ConnectionError",
+    "TimeoutError": "OSError",
+    "timeout": "TimeoutError",          # socket.timeout
+    "ReferenceError": "Exception",
+    "RuntimeError": "Exception",
+    "NotImplementedError": "RuntimeError",
+    "RecursionError": "RuntimeError",
+    "StopIteration": "Exception",
+    "StopAsyncIteration": "Exception",
+    "SyntaxError": "Exception",
+    "IndentationError": "SyntaxError",
+    "TabError": "IndentationError",
+    "SystemError": "Exception",
+    "TypeError": "Exception",
+    "ValueError": "Exception",
+    "UnicodeError": "ValueError",
+    "UnicodeDecodeError": "UnicodeError",
+    "UnicodeEncodeError": "UnicodeError",
+}
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def handler_spec(type_node: Optional[ast.AST],
+                 resolver) -> Tuple[List[str], bool]:
+    """``(type names, is_broad)`` for one ``except`` clause. A bare
+    ``except`` or any ``Exception``/``BaseException`` member (alone or
+    in a tuple) makes the handler broad; names resolve through the
+    module's import aliases (``requests.Timeout`` -> ``Timeout``)."""
+    if type_node is None:
+        return [], True
+    if isinstance(type_node, ast.Tuple):
+        names, broad = [], False
+        for elt in type_node.elts:
+            n, b = handler_spec(elt, resolver)
+            names.extend(n)
+            broad = broad or b
+        return names, broad
+    dotted = resolver(type_node) if resolver is not None else ""
+    name = dotted.rsplit(".", 1)[-1] if dotted else ""
+    if not name:
+        if isinstance(type_node, ast.Attribute):
+            name = type_node.attr
+        elif isinstance(type_node, ast.Name):
+            name = type_node.id
+    if name in _BROAD:
+        return [name], True
+    return ([name] if name else []), False
+
+
+class ErrorLattice:
+    """Classification and subtype queries over one ``ProjectModel``."""
+
+    def __init__(self, model):
+        self.model = model
+        self._ancestors_cache = {}
+        self._class_cache = {}
+
+    # ---- hierarchy -------------------------------------------------------
+    def is_project_exception(self, name: str) -> bool:
+        """True when ``name`` is a project class whose base chain
+        reaches the builtin exception tree."""
+        hit = self._class_cache.get(name)
+        if hit is not None:
+            return hit
+        out = False
+        for cls in self.model.classes_by_name.get(name, ()):
+            for c in self.model.mro(cls):
+                for base in c.bases:
+                    if base.rsplit(".", 1)[-1] in BUILTIN_PARENT:
+                        out = True
+        self._class_cache[name] = out
+        return out
+
+    def ancestors(self, name: str) -> Set[str]:
+        """``name`` plus every ancestor type name, through project bases
+        into the builtin tree (cycle-safe; union over same-named project
+        classes)."""
+        hit = self._ancestors_cache.get(name)
+        if hit is not None:
+            return hit
+        out: Set[str] = set()
+        stack = [name]
+        while stack:
+            n = stack.pop()
+            if n in out:
+                continue
+            out.add(n)
+            parent = BUILTIN_PARENT.get(n)
+            if parent:
+                stack.append(parent)
+            for cls in self.model.classes_by_name.get(n, ()):
+                stack.extend(b.rsplit(".", 1)[-1] for b in cls.bases)
+        self._ancestors_cache[name] = out
+        return out
+
+    # ---- classification --------------------------------------------------
+    def classify(self, name: str) -> str:
+        if name in FATAL_TYPES:
+            return FATAL
+        if self.is_project_exception(name):
+            return CONTROL if name.startswith("_") else FAULT
+        return GENERIC
+
+    # ---- catch semantics -------------------------------------------------
+    def caught_by(self, exc_name: str, handler_names: Iterable[str],
+                  broad: bool = False) -> bool:
+        """Does ``except (handler_names)`` stop ``exc_name``? True when
+        any handler name is ``exc_name`` or one of its ancestors. The
+        ``GENERIC_TOKEN`` (an *unknown* external exception) is caught
+        only by broad handlers — a narrow ``except ValueError`` may or
+        may not match it, and escape analysis must stay conservative."""
+        if broad:
+            return True
+        if exc_name == GENERIC_TOKEN:
+            return False
+        anc = self.ancestors(exc_name)
+        return any(h in anc for h in handler_names)

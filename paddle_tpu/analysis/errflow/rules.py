@@ -1,0 +1,362 @@
+"""The ``--errors`` rules: typed-error contract enforcement over the
+exception summaries.
+
+Four rules, all whole-program (``ProjectRule``), all gated behind
+``pdlint --errors`` exactly like ``--graph``/``--threads``/
+``--lifecycle`` gate theirs, with baseline/ratchet/SARIF/``--select``
+riding the existing machinery:
+
+- **error-thread-escape** — an exception can escape a thread root from
+  the PR-9 thread model uncaught: the thread dies silently and the
+  daemon it implemented (engine loop, supervisor monitor, ts-sampler,
+  heartbeat republisher, handoff drain) just... stops. Typed
+  (control/fault) escapes are named with raise-site provenance; a
+  generic-only escape set still fires — it means the root has at least
+  one call path with no guard at all. Fatal types
+  (KeyboardInterrupt) are exempt — crashing loud is their contract.
+- **error-http-contract** — the docs/SERVING.md "Error taxonomy" table
+  against ``taxonomy.TAXONOMY`` against the actual emit sites, all
+  directions (see taxonomy.py).
+- **error-swallow** — a broad ``except`` whose arrival set (per the
+  summaries) includes a typed exception it neither re-raises nor maps:
+  swallowing a control-flow type breaks the router protocol outright;
+  swallowing a fault type without even referencing the bound exception
+  loses the typed contract invisibly. The type-aware upgrade of
+  ``silent-exception``.
+- **error-retry-unsafe** — a retry/failover loop that can re-dispatch
+  after catching an error the taxonomy marks non-retryable (a global
+  deadline cannot be un-expired by another replica; a quarantined
+  request must never be placed again).
+
+Scope is the serving tier + observability (the lifecycle scope);
+fixture files outside ``paddle_tpu/`` are always checked so the tests
+can stage both sides of every rule.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Tuple
+
+from ..core import Finding, ProjectRule, register_rule
+from ..lifecycle.rules import _in_scope
+from ..threads.model import ProjectModel, get_model
+from . import taxonomy as tax
+from .lattice import handler_spec
+from .summaries import ErrorFlow, get_flow
+
+__all__ = ["thread_escape_findings", "swallow_findings",
+           "retry_unsafe_findings", "http_contract_findings",
+           "scope_roots"]
+
+_DOCS = os.path.join("docs", "SERVING.md")
+
+# the emit-site scan is serving-tier only: that is where responses are
+# assembled ("code" dict literals elsewhere would be coincidences)
+_EMIT_PREFIX = "paddle_tpu/serving"
+
+
+def scope_roots(model: ProjectModel) -> List[Tuple[str, str]]:
+    """What the engine analyzes: every function in an in-scope file
+    plus every resolved spawn target (roots pull their out-of-scope
+    callees in through the call graph)."""
+    roots = [key for key, fn in sorted(model.functions.items())
+             if _in_scope(fn.file)]
+    roots += [sp.target for sp in model.spawn_sites
+              if sp.target is not None]
+    return roots
+
+
+def _suppressed(model: ProjectModel, file: str, line: int,
+                rule_id: str) -> bool:
+    mod = model.modules.get(file)
+    return mod is not None and mod.ctx.suppressed(line, rule_id)
+
+
+def _symbol(model: ProjectModel, file: str, line: int) -> str:
+    mod = model.modules.get(file)
+    return mod.ctx.symbol_for_line(line) if mod is not None else ""
+
+
+def _fmt_types(typed) -> str:
+    return ", ".join(f"{t} (from {o[0]}:{o[1]})"
+                     for t, o in sorted(typed.items()))
+
+
+# ---- error-thread-escape ----------------------------------------------------
+
+def thread_escape_findings(model: ProjectModel, flow: ErrorFlow,
+                           rule_id: str = "error-thread-escape"
+                           ) -> List[Finding]:
+    out = []
+    for sp in model.spawn_sites:
+        if sp.target is None or not _in_scope(sp.file):
+            continue
+        escapes = flow.escapes_of(sp.target)
+        nonfatal = {t: o for t, o in escapes.items()
+                    if flow.lattice.classify(t) != "fatal"}
+        if not nonfatal:
+            continue
+        if _suppressed(model, sp.file, sp.line, rule_id):
+            continue
+        _tfile, tqual = sp.target
+        typed = flow.typed(nonfatal)
+        if typed:
+            what = f"uncaught {_fmt_types(typed)}"
+        else:
+            what = ("any uncaught exception (unguarded call paths in "
+                    "the loop body)")
+        out.append(Finding(
+            file=sp.file, line=sp.line, rule=rule_id,
+            symbol=_symbol(model, sp.file, sp.line),
+            message=(f"thread '{sp.thread_name}' root {tqual}() can die "
+                     f"on {what} — a silently-dead "
+                     "daemon thread; catch at the root (log, recover or "
+                     "re-arm) or pragma a deliberate crash boundary"),
+            data={"target": list(sp.target),
+                  "escapes": {t: {"file": o[0], "line": o[1]}
+                              for t, o in sorted(nonfatal.items())}}))
+    return out
+
+
+# ---- error-swallow ----------------------------------------------------------
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _handler_walk(handler: ast.ExceptHandler):
+    stack = list(handler.body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _SCOPE_BARRIERS):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in _handler_walk(handler))
+
+
+def _uses_bound_name(handler: ast.ExceptHandler) -> bool:
+    if not handler.name:
+        return False
+    return any(isinstance(n, ast.Name) and n.id == handler.name
+               for n in _handler_walk(handler))
+
+
+def swallow_findings(model: ProjectModel, flow: ErrorFlow,
+                     rule_id: str = "error-swallow") -> List[Finding]:
+    out = []
+    for file in sorted(model.modules):
+        if not _in_scope(file):
+            continue
+        mod = model.modules[file]
+        for node in ast.walk(mod.ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            _names, broad = handler_spec(node.type, mod.ctx.resolve_call)
+            if not broad:
+                continue
+            typed = flow.typed(flow.handler_arrivals.get(id(node), {}))
+            if not typed or _reraises(node):
+                continue
+            control = {t: o for t, o in typed.items()
+                       if flow.lattice.classify(t) == "control"}
+            if control:
+                what, types = "control-flow", control
+                hint = ("handle it by type before the broad clause or "
+                        "re-raise — swallowing it breaks the routing "
+                        "protocol")
+            elif not _uses_bound_name(node):
+                what, types = "typed", typed
+                hint = ("bind the exception and map it to its "
+                        "documented response (docs/SERVING.md 'Error "
+                        "taxonomy'), or narrow the except")
+            else:
+                continue
+            if _suppressed(model, file, node.lineno, rule_id):
+                continue
+            caught_txt = (ast.unparse(node.type) if node.type is not None
+                          else "<bare except>")
+            out.append(Finding(
+                file=file, line=node.lineno, rule=rule_id,
+                symbol=_symbol(model, file, node.lineno),
+                message=(f"broad handler ({caught_txt}) swallows {what} "
+                         f"exception(s) {_fmt_types(types)} — {hint}"),
+                data={"swallowed": {t: {"file": o[0], "line": o[1]}
+                                    for t, o in sorted(types.items())}}))
+    return out
+
+
+# ---- error-retry-unsafe -----------------------------------------------------
+
+def _try_loops(fn_node) -> List[Tuple[ast.Try, ast.AST]]:
+    """Every ``try`` with its nearest enclosing loop, nested defs
+    excluded (they are their own functions)."""
+    out = []
+
+    def walk(node, loop):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPE_BARRIERS):
+                continue
+            nl = child if isinstance(child, (ast.While, ast.For,
+                                             ast.AsyncFor)) else loop
+            if isinstance(child, ast.Try) and nl is not None:
+                out.append((child, nl))
+            walk(child, nl)
+
+    walk(fn_node, None)
+    return out
+
+
+def _handler_rejoins_loop(cfg, handler_ast, loop_ast) -> bool:
+    """CFG reachability: from the handler's body, can control reach the
+    loop head again (fall-through to the back-edge, or ``continue``)
+    without leaving the function? ``return``/``break``/``raise`` paths
+    don't count as re-dispatch."""
+    hid = lid = None
+    for n in cfg.nodes.values():
+        if n.kind == "handler" and n.stmt is handler_ast:
+            hid = n.id
+        elif n.kind == "loop" and n.stmt is loop_ast:
+            lid = n.id
+    if hid is None or lid is None:
+        return False
+    stack = [d for (d, k) in cfg.succ(hid) if k == "caught"]
+    seen = set(stack)
+    while stack:
+        n = stack.pop()
+        if n == lid:
+            return True
+        for (d, k) in cfg.succ(n):
+            if k != "raise" and d not in seen:
+                seen.add(d)
+                stack.append(d)
+    return False
+
+
+def retry_unsafe_findings(model: ProjectModel, flow: ErrorFlow,
+                          rule_id: str = "error-retry-unsafe"
+                          ) -> List[Finding]:
+    out = []
+    for file in sorted(model.modules):
+        if not _in_scope(file):
+            continue
+        mod = model.modules[file]
+        for qual in sorted(mod.functions):
+            fn = mod.functions[qual]
+            pairs = _try_loops(fn.node)
+            if not pairs:
+                continue
+            cfg = flow.function_cfg(fn.key)
+            for (try_stmt, loop) in pairs:
+                for handler in try_stmt.handlers:
+                    names, broad = handler_spec(handler.type,
+                                                mod.ctx.resolve_call)
+                    arr = flow.handler_arrivals.get(id(handler), {})
+                    bad = ({t for t in arr if t in tax.NON_RETRYABLE}
+                           | {n for n in names if n in tax.NON_RETRYABLE})
+                    if not bad:
+                        continue
+                    if not _handler_rejoins_loop(cfg, handler, loop):
+                        continue
+                    if _suppressed(model, file, handler.lineno, rule_id):
+                        continue
+                    bad_txt = ", ".join(sorted(bad))
+                    out.append(Finding(
+                        file=file, line=handler.lineno, rule=rule_id,
+                        symbol=_symbol(model, file, handler.lineno),
+                        message=(f"retry loop can re-dispatch after "
+                                 f"catching non-retryable {bad_txt} "
+                                 "(docs/SERVING.md 'Error taxonomy') — "
+                                 "answer the client and return instead "
+                                 "of burning a retry on an error no "
+                                 "replica can fix"),
+                        data={"non_retryable": sorted(bad),
+                              "loop_line": loop.lineno}))
+    return out
+
+
+# ---- error-http-contract ----------------------------------------------------
+
+def http_contract_findings(model: ProjectModel, root: str,
+                           rule_id: str = "error-http-contract"
+                           ) -> List[Finding]:
+    docs_path = os.path.join(root, _DOCS)
+    docs = (tax.documented_taxonomy(docs_path)
+            if os.path.isfile(docs_path) else {})
+    trees = {f: m.ctx.tree for f, m in model.modules.items()
+             if f.startswith(_EMIT_PREFIX)}
+    problems = tax.compare_taxonomy(
+        docs, tax.TAXONOMY,
+        known_classes=set(model.classes_by_name),
+        codes_emitted=tax.emitted_codes(trees),
+        statuses_emitted=tax.emitted_statuses(trees))
+    return [Finding(file=_DOCS.replace(os.sep, "/"), line=1,
+                    rule=rule_id, message=msg, symbol="error-taxonomy")
+            for msg in problems]
+
+
+# ---- registration -----------------------------------------------------------
+
+class _ErrorRule(ProjectRule):
+    """Base: exception-flow rules opt in via ``--errors``."""
+
+    errors = True
+
+    def _findings(self, model: ProjectModel, flow: ErrorFlow,
+                  root: str) -> List[Finding]:
+        raise NotImplementedError
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        model = get_model(root)
+        flow = get_flow(model)
+        flow.analyze(scope_roots(model))
+        return self._findings(model, flow, root)
+
+
+@register_rule
+class ErrorThreadEscapeRule(_ErrorRule):
+    id = "error-thread-escape"
+    rationale = ("an exception escaping a thread root kills the daemon "
+                 "silently — the sampler/monitor/republisher just "
+                 "stops; every root catches, logs, and decides")
+
+    def _findings(self, model, flow, root):
+        return thread_escape_findings(model, flow, self.id)
+
+
+@register_rule
+class ErrorHttpContractRule(_ErrorRule):
+    id = "error-http-contract"
+    rationale = ("the typed error ↔ HTTP status ↔ code= ↔ retryable "
+                 "contract must match docs, taxonomy, and the actual "
+                 "emit sites, all directions — clients program against "
+                 "it")
+
+    def _findings(self, model, flow, root):
+        return http_contract_findings(model, root, self.id)
+
+
+@register_rule
+class ErrorSwallowRule(_ErrorRule):
+    id = "error-swallow"
+    rationale = ("a broad except that swallows a typed control-flow or "
+                 "fault exception un-types the error contract — the "
+                 "type-aware upgrade of silent-exception")
+
+    def _findings(self, model, flow, root):
+        return swallow_findings(model, flow, self.id)
+
+
+@register_rule
+class ErrorRetryUnsafeRule(_ErrorRule):
+    id = "error-retry-unsafe"
+    rationale = ("re-dispatching after a non-retryable error (expired "
+                 "deadline, quarantined request, client error) wastes "
+                 "capacity and can double-execute — the taxonomy marks "
+                 "what a retry can never fix")
+
+    def _findings(self, model, flow, root):
+        return retry_unsafe_findings(model, flow, self.id)

@@ -1,0 +1,373 @@
+"""Interprocedural exception summaries: what can escape each function.
+
+Composes the two analyses that already exist:
+
+- the **PR-18 CFG** (``analysis/cfg.py``) supplies handler-dispatch
+  structure per function — ``except`` dispatch nodes chaining handlers
+  in order with ``nomatch`` edges, ``finally`` bodies duplicated per
+  continuation kind, and a ``raise`` exit;
+- the **PR-9 thread model** (``analysis/threads/model.py``) supplies
+  the whole-program call graph (``edges``, ``call_targets``) and the
+  class index the lattice resolves types against.
+
+Per function, a forward dataflow over the CFG's exceptional edges
+computes the set of exception TYPES (lattice names, with raise-site
+provenance) that can reach each handler dispatch and the ``raise``
+exit:
+
+- an explicit ``raise X(...)`` contributes ``X``;
+- a bare ``raise`` inside a handler re-raises that handler's arrival
+  set (so a narrow-then-re-raise handler is transparent);
+- ``raise e`` where ``e`` is the handler's bound name does the same;
+- any other statement containing calls contributes the union of its
+  resolved project callees' summaries, or the ``GENERIC_TOKEN``
+  (``Exception``) for calls the model cannot resolve — minus the
+  lifecycle ``NORAISE`` allowlist (loggers, clocks, metric counters);
+- at an ``except`` dispatch, each handler subtracts the types it
+  catches (lattice subtype query; broad handlers catch everything) and
+  passes the remainder down the ``nomatch`` chain and out the final
+  ``raise`` edge;
+- a ``finally`` raise-copy passes the in-flight set through to the
+  outer raise target (its own statements contribute their own raises).
+
+Function summaries reach a fixpoint over the call graph with a
+worklist — SCCs (mutual recursion) converge because the per-type sets
+only grow. The per-function pass itself iterates until handler-arrival
+sets stabilize (a bare ``raise`` feeds on them).
+
+``ErrorFlow`` is the cached engine the ``--errors`` rules share; one
+instance per ``ProjectModel`` (``get_flow``), so ``--threads --errors``
+builds the model once and ``--errors`` reuses every parsed tree.
+"""
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..cfg import _eager_nodes, build_cfg
+from ..lifecycle.resources import NORAISE
+from .lattice import ErrorLattice, GENERIC_TOKEN, handler_spec
+
+__all__ = ["ErrorFlow", "get_flow", "Summary", "NORAISE_ERRFLOW"]
+
+# Stop-event plumbing on top of the lifecycle allowlist: a daemon
+# loop's own head (``while not self._stop.wait(t)``, ``while not
+# self._stop.is_set()``) and plain sleeps never raise non-fatally —
+# without these every correctly guarded root would still "escape"
+# through its loop condition. ``is_set`` is bare (only event-likes
+# have it); ``wait`` is full-path only (``proc.wait(timeout=)`` DOES
+# raise).
+NORAISE_ERRFLOW = NORAISE | frozenset({
+    "self._stop.wait", "self._stop.is_set", "self._stop.clear",
+    "stop.wait", "stop.is_set", "done.wait", "is_set", "time.sleep",
+    # ``Popen.poll`` and one-argument ``type(e)`` in log lines cannot
+    # fail; teardown ``close()`` in a finally is no-raise by the same
+    # convention that puts it on the lifecycle release path
+    "poll", "type", "close",
+    # pure state resets (backoff ladders, breakers) by the same
+    # convention as the builtin ``clear``/``update`` entries
+    "reset",
+    # the stdlib client constructor stores fields — connect is lazy,
+    # on request()
+    "http.client.HTTPConnection",
+})
+
+#: escaping type name -> (rel_file, line) of the first-seen raise site
+Summary = Dict[str, Tuple[str, int]]
+
+FuncKey = Tuple[str, str]
+
+
+class ErrorFlow:
+    """The summaries engine over one ``ProjectModel``."""
+
+    def __init__(self, model, noraise=NORAISE_ERRFLOW):
+        self.model = model
+        self.lattice = ErrorLattice(model)
+        self.noraise = frozenset(noraise)
+        #: FuncKey -> Summary (escaping set), for every analyzed function
+        self.summaries: Dict[FuncKey, Summary] = {}
+        #: id(ast.ExceptHandler) -> Summary arriving at that handler
+        #: (the caught set), for every analyzed function — what the
+        #: swallow and retry rules read
+        self.handler_arrivals: Dict[int, Summary] = {}
+        self._cfgs: Dict[FuncKey, object] = {}
+        self._encl_handler: Dict[FuncKey, Dict[int, ast.ExceptHandler]] = {}
+        self._analyzed: Set[FuncKey] = set()
+
+    # ---- public API ------------------------------------------------------
+    def escapes_of(self, key: FuncKey) -> Summary:
+        """The escape summary for one function (analyzing on demand)."""
+        self.analyze([key])
+        return self.summaries.get(key, {})
+
+    def typed(self, summary: Summary, classes=("control", "fault")
+              ) -> Summary:
+        """The control/fault subset of a summary — what the typed rules
+        report (generic externals and fatal signals are noise)."""
+        return {t: o for t, o in summary.items()
+                if self.lattice.classify(t) in classes}
+
+    def analyze(self, roots: List[FuncKey]):
+        """Fixpoint the summaries for ``roots`` and everything they
+        reach through the call graph. Idempotent per key."""
+        todo = [k for k in roots
+                if k in self.model.functions and k not in self._analyzed]
+        if not todo:
+            return
+        reach: Set[FuncKey] = set()
+        stack = list(todo)
+        while stack:
+            k = stack.pop()
+            if k in reach or k not in self.model.functions:
+                continue
+            reach.add(k)
+            for (callee, _line) in self.model.edges.get(k, ()):
+                stack.append(callee)
+        callers: Dict[FuncKey, Set[FuncKey]] = {}
+        for k in reach:
+            for (callee, _line) in self.model.edges.get(k, ()):
+                if callee in reach:
+                    callers.setdefault(callee, set()).add(k)
+        work = deque(sorted(reach))
+        queued = set(work)
+        while work:
+            k = work.popleft()
+            queued.discard(k)
+            new = self._evaluate(k)
+            if new != self.summaries.get(k):
+                self.summaries[k] = new
+                for caller in callers.get(k, ()):
+                    if caller not in queued:
+                        queued.add(caller)
+                        work.append(caller)
+        self._analyzed |= reach
+
+    # ---- per-function evaluation -----------------------------------------
+    def function_cfg(self, key: FuncKey):
+        cfg = self._cfgs.get(key)
+        if cfg is None:
+            fn = self.model.functions[key]
+            ctx = self.model.modules[fn.file].ctx
+            cfg = build_cfg(fn.node, resolver=ctx.resolve_call,
+                            noraise=self.noraise)
+            self._cfgs[key] = cfg
+            # innermost enclosing handler per raise statement, for bare
+            # ``raise`` / ``raise e`` re-raise semantics
+            encl: Dict[int, ast.ExceptHandler] = {}
+
+            def walk(node, handler):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Lambda)):
+                        continue       # nested scope: its own CFG
+                    h = child if isinstance(child, ast.ExceptHandler) \
+                        else handler
+                    if isinstance(child, ast.Raise) and h is not None:
+                        encl[id(child)] = h
+                    walk(child, h)
+
+            walk(fn.node, None)
+            self._encl_handler[key] = encl
+        return cfg
+
+    def _evaluate(self, key: FuncKey) -> Summary:
+        fn = self.model.functions[key]
+        ctx = self.model.modules[fn.file].ctx
+        cfg = self.function_cfg(key)
+        arrivals: Dict[int, Summary] = {}
+        while True:
+            escapes, new_arr = self._propagate(key, fn, ctx, cfg, arrivals)
+            if new_arr == arrivals:
+                break
+            arrivals = new_arr
+        for hid, s in arrivals.items():
+            merged = dict(self.handler_arrivals.get(hid, {}))
+            merged.update({t: o for t, o in s.items() if t not in merged})
+            self.handler_arrivals[hid] = merged
+        return escapes
+
+    def _propagate(self, key, fn, ctx, cfg, arr_in):
+        """One forward pass over the exceptional edges: returns (escape
+        summary, handler arrivals). ``arr_in`` feeds bare-raise gen."""
+        pending: Dict[int, Summary] = {}
+        arrivals: Dict[int, Summary] = {}
+        work: deque = deque()
+        queued: Set[int] = set()
+
+        def contribute(nid: int, items: Summary):
+            if not items:
+                return
+            tgt = pending.setdefault(nid, {})
+            new = {t: o for t, o in items.items() if t not in tgt}
+            if not new:
+                return
+            tgt.update(new)
+            if (cfg.nodes[nid].kind in ("except", "finally")
+                    and nid not in queued):
+                queued.add(nid)
+                work.append(nid)
+
+        for nid in sorted(cfg.nodes):
+            g = self._gen(key, fn, ctx, cfg.nodes[nid], arr_in)
+            if not g:
+                continue
+            for (dst, kind) in cfg.succ(nid):
+                if kind == "raise":
+                    contribute(dst, g)
+
+        while work:
+            nid = work.popleft()
+            queued.discard(nid)
+            node = cfg.nodes[nid]
+            items = dict(pending.get(nid, {}))
+            if node.kind == "except":
+                self._dispatch(cfg, nid, items, arrivals, contribute, ctx)
+            elif node.kind == "finally":
+                self._passthrough(cfg, nid, items, contribute)
+        return dict(pending.get(cfg.raise_exit, {})), arrivals
+
+    def _dispatch(self, cfg, nid, items, arrivals, contribute, ctx):
+        """Walk the handler chain off one dispatch node: each handler
+        subtracts what it catches; the remainder leaves on the last
+        handler's ``raise`` edge (absent when a broad handler ends the
+        chain)."""
+        remaining = dict(items)
+        cur = nid
+        while True:
+            nxt = [d for (d, k) in cfg.succ(cur)
+                   if k in ("except", "nomatch")
+                   and cfg.nodes[d].kind == "handler"]
+            if not nxt:
+                break
+            hnode = cfg.nodes[nxt[0]]
+            hstmt = hnode.stmt                    # ast.ExceptHandler
+            names, broad = handler_spec(hstmt.type, ctx.resolve_call)
+            caught = {t: o for t, o in remaining.items()
+                      if self.lattice.caught_by(t, names, broad)}
+            tgt = arrivals.setdefault(id(hstmt), {})
+            tgt.update({t: o for t, o in caught.items() if t not in tgt})
+            remaining = {t: o for t, o in remaining.items()
+                         if t not in caught}
+            cur = hnode.id
+        if remaining:
+            for (d, k) in cfg.succ(cur):
+                if k == "raise":
+                    contribute(d, remaining)
+
+    def _passthrough(self, cfg, nid, items, contribute):
+        """A ``finally`` raise-copy: the in-flight set survives the
+        finally body (unless the body raises its own — those edges get
+        their own gen contributions) and leaves on every ``raise`` edge
+        out of the copy. Slight over-approximation: a finally that
+        raises masks the pending exception, we keep both."""
+        seen = {nid}
+        stack = [nid]
+        while stack:
+            n = stack.pop()
+            for (d, k) in cfg.succ(n):
+                if k == "raise":
+                    contribute(d, items)
+                elif d not in seen:
+                    seen.add(d)
+                    stack.append(d)
+
+    # ---- gen sets --------------------------------------------------------
+    def _gen(self, key, fn, ctx, node, arr_in) -> Summary:
+        """What executing ``node`` can itself raise (callee summaries
+        included), independent of anything already in flight."""
+        s = node.stmt
+        if s is None or node.kind in ("except", "handler", "finally",
+                                      "loopexit"):
+            return {}
+        if node.kind == "stmt" and isinstance(s, ast.Raise):
+            return self._gen_raise(key, fn, ctx, s, arr_in)
+        if node.kind == "stmt" and isinstance(s, ast.Assert):
+            return {"AssertionError": (fn.file, s.lineno)}
+        if node.kind == "branch":
+            roots = [s.test]
+        elif node.kind == "loop":
+            roots = [s.iter] if isinstance(s, (ast.For, ast.AsyncFor)) \
+                else [s.test]
+        elif node.kind == "with":
+            roots = [item.context_expr for item in s.items]
+        else:
+            roots = [s]
+        out: Summary = {}
+        for root in roots:
+            for sub in _eager_nodes(root):
+                if isinstance(sub, ast.Await):
+                    out.setdefault(GENERIC_TOKEN,
+                                   (fn.file, getattr(sub, "lineno",
+                                                     s.lineno)))
+                elif isinstance(sub, ast.Call):
+                    self._gen_call(fn, ctx, sub, out)
+        return out
+
+    def _gen_call(self, fn, ctx, call, out: Summary):
+        # an exact full-path allowlist entry (``self._stop.wait``,
+        # ``done.wait``) is a no-raise CONTRACT on that call site — it
+        # beats target resolution, which can mis-bind an Event method
+        # to a same-named project function
+        resolved = ctx.resolve_call(call.func)
+        if resolved and resolved in self.noraise:
+            return
+        targets = self.model.call_targets.get(id(call))
+        if targets:
+            for t in targets:
+                # a resolved TOP-LEVEL function whose name is on the
+                # allowlist keeps its no-raise contract (get_logger);
+                # methods have dotted qualnames so ``ShmChannel.get``
+                # is never masked by the bare builtin entry ``get``
+                if t[1] in self.noraise:
+                    continue
+                for typ, origin in self.summaries.get(t, {}).items():
+                    out.setdefault(typ, origin)
+            return
+        if resolved and resolved.rsplit(".", 1)[-1] in self.noraise:
+            return
+        # chains rooted at a call (``get_logger().warning(...)``) defeat
+        # dotted-path resolution; the method name alone still settles
+        # the noraise question
+        if (not resolved and isinstance(call.func, ast.Attribute)
+                and call.func.attr in self.noraise):
+            return
+        out.setdefault(GENERIC_TOKEN, (fn.file, call.lineno))
+
+    def _gen_raise(self, key, fn, ctx, s: ast.Raise, arr_in) -> Summary:
+        handler = self._encl_handler.get(key, {}).get(id(s))
+        if s.exc is None:
+            # bare re-raise: the enclosing handler's arrival set
+            if handler is not None:
+                return dict(arr_in.get(id(handler), {}))
+            return {GENERIC_TOKEN: (fn.file, s.lineno)}
+        if (handler is not None and handler.name
+                and isinstance(s.exc, ast.Name)
+                and s.exc.id == handler.name):
+            # ``except X as e: ... raise e`` — same as a bare raise
+            return dict(arr_in.get(id(handler), {}))
+        target = s.exc.func if isinstance(s.exc, ast.Call) else s.exc
+        dotted = ctx.resolve_call(target)
+        name = dotted.rsplit(".", 1)[-1] if dotted else ""
+        # CamelCase (after any leading underscores — control-plane types
+        # are ``_Migrated``-style by convention) means a class reference;
+        # anything else is ``raise some_variable`` with the type unknown
+        if not name.lstrip("_")[:1].isupper():
+            name = GENERIC_TOKEN
+        return {name: (fn.file, s.lineno)}
+
+
+# one engine per model: --errors rules share summaries, and a combined
+# --threads --errors run reuses the model get_model() already built
+_FLOWS: Dict[int, ErrorFlow] = {}
+
+
+def get_flow(model) -> ErrorFlow:
+    flow = _FLOWS.get(id(model))
+    if flow is None or flow.model is not model:
+        _FLOWS.clear()
+        flow = ErrorFlow(model)
+        _FLOWS[id(model)] = flow
+    return flow

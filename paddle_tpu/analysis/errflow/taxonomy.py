@@ -1,0 +1,224 @@
+"""The typed-error taxonomy: class ↔ HTTP status ↔ ``code=`` ↔ retryable.
+
+The single source of truth the ``error-http-contract`` rule enforces
+three ways (mirroring the metric/span/event/alert catalog lints):
+
+1. **docs** — every entry here has a row in docs/SERVING.md's "Error
+   taxonomy" table with matching status/code/retryable cells, and every
+   documented row names an entry here (both directions);
+2. **classes** — every entry's error class exists in the project class
+   index (pseudo-entries in parentheses, like ``(quarantine)``, name a
+   guard rather than an exception and skip this leg);
+3. **emit sites** — every ``code=`` string here is actually emitted in
+   the serving tier (a ``"code": "..."`` dict literal or a
+   ``body["code"] = "..."`` store), every emitted code string is in the
+   taxonomy, and every concrete status here appears at a
+   ``._json(<status>, ...)`` response site.
+
+``RETRYABLE``/``NON_RETRYABLE`` feed the ``error-retry-unsafe`` rule:
+a failover loop must not re-dispatch after catching a non-retryable
+error (a global deadline cannot be un-expired by another replica; a
+quarantined request must never be placed again).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["TaxonomyEntry", "TAXONOMY", "NON_RETRYABLE", "RETRYABLE",
+           "documented_taxonomy", "compare_taxonomy", "emitted_codes",
+           "emitted_statuses"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TaxonomyEntry:
+    """One row of the error contract.
+
+    ``status`` is None when the error never maps to a response of its
+    own (``_ClientGone`` — nobody left to answer; ``_Migrated`` — the
+    relay continues) or when it forwards a dynamic status
+    (``_ClientError`` re-emits the worker's 4xx, documented "4xx").
+    ``code`` is the ``code=`` body field, "" when the body carries none.
+    """
+
+    cls: str
+    status: Optional[int]
+    status_doc: str           # the docs cell: "429", "4xx", "—"
+    code: str
+    retryable: bool
+    kind: str                 # backpressure/deadline/degrade/...
+    note: str
+
+    @property
+    def is_pseudo(self) -> bool:
+        """Guard rows like ``(quarantine)`` — no exception class."""
+        return self.cls.startswith("(")
+
+
+TAXONOMY: Tuple[TaxonomyEntry, ...] = (
+    TaxonomyEntry("QueueFull", 429, "429", "", True, "backpressure",
+                  "bounded admission queue; Retry-After is computed"),
+    TaxonomyEntry("XlaOom", 429, "429", "engine_degraded", True,
+                  "degrade",
+                  "device OOM tripped the degrade ladder; retry after "
+                  "Retry-After"),
+    TaxonomyEntry("DeadlineExceeded", 504, "504", "deadline_exceeded",
+                  False, "deadline",
+                  "the request's SLO budget ran out in the engine"),
+    TaxonomyEntry("HandoffCorrupt", 500, "500", "", True, "migration",
+                  "KV bundle failed checksum/schema checks; a fresh "
+                  "export succeeds"),
+    TaxonomyEntry("_WorkerBusy", 429, "429", "", True, "control",
+                  "worker 429 is placement feedback — try another "
+                  "replica, don't burn the retry budget"),
+    TaxonomyEntry("_UpstreamError", 502, "502", "", True, "control",
+                  "transport death / 5xx / mid-stream EOF; another "
+                  "worker may not share it"),
+    TaxonomyEntry("_ClientError", None, "4xx", "", False, "control",
+                  "the worker judged the request invalid; forwarded "
+                  "verbatim — bad on every replica"),
+    TaxonomyEntry("_ClientGone", None, "—", "", False, "control",
+                  "downstream client disconnected; nothing to answer"),
+    TaxonomyEntry("_DeadlineExpired", 504, "504", "deadline_exceeded",
+                  False, "control",
+                  "SLO budget ran out at the router; terminal"),
+    TaxonomyEntry("_Migrated", None, "—", "", True, "control",
+                  "planned migration hop; the relay continues on the "
+                  "destination"),
+    TaxonomyEntry("(quarantine)", 422, "422", "request_quarantined",
+                  False, "guard",
+                  "request id implicated in >= 2 worker deaths; never "
+                  "placed again"),
+)
+
+NON_RETRYABLE: Set[str] = {e.cls for e in TAXONOMY if not e.retryable}
+RETRYABLE: Set[str] = {e.cls for e in TAXONOMY if e.retryable}
+
+# | `QueueFull` | 429 | — | yes | backpressure | note |
+_ROW = re.compile(
+    r"^\|\s*`?\(?([A-Za-z_][A-Za-z0-9_]*)\)?`?\s*"
+    r"\|\s*([0-9]{3}|4xx|—)\s*"
+    r"\|\s*(?:`([a-z_]+)`|—)\s*"
+    r"\|\s*(yes|no)\s*\|")
+
+
+def documented_taxonomy(path: str, section: str = "Error taxonomy"
+                        ) -> Dict[str, Tuple[str, str, bool]]:
+    """{class: (status_cell, code, retryable)} parsed from the docs
+    table (section matched the way every catalog lint matches its
+    section header)."""
+    out: Dict[str, Tuple[str, str, bool]] = {}
+    in_section = False
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("#"):
+                in_section = line.lstrip("#").strip() == section
+                continue
+            if not in_section:
+                continue
+            m = _ROW.match(line)
+            if not m:
+                continue
+            name, status, code, retry = m.groups()
+            if name == "error":
+                continue          # the header row
+            key = f"({name})" if f"({name})" in {e.cls for e in TAXONOMY} \
+                else name
+            out[key] = (status, code or "", retry == "yes")
+    return out
+
+
+def compare_taxonomy(docs: Dict[str, Tuple[str, str, bool]],
+                     entries: Tuple[TaxonomyEntry, ...],
+                     known_classes: Set[str],
+                     codes_emitted: Set[str],
+                     statuses_emitted: Set[int]) -> List[str]:
+    """The pure comparison core (fixture-testable without the repo):
+    docs ↔ taxonomy both ways with per-cell drift, classes exist,
+    codes and statuses actually emitted, emitted codes documented."""
+    problems: List[str] = []
+    reg = {e.cls: e for e in entries}
+    for name in sorted(set(reg) - set(docs)):
+        problems.append(
+            f"error {name} is in the taxonomy but has no row in "
+            "docs/SERVING.md 'Error taxonomy'")
+    for name in sorted(set(docs) - set(reg)):
+        problems.append(
+            f"error {name} is documented but not in the taxonomy "
+            "(analysis/errflow/taxonomy.py)")
+    for name in sorted(set(docs) & set(reg)):
+        e = reg[name]
+        status_cell, code, retry = docs[name]
+        want = (e.status_doc, e.code, e.retryable)
+        if (status_cell, code, retry) != want:
+            problems.append(
+                f"contract drift for {name}: docs say "
+                f"status={status_cell} code={code or '—'} "
+                f"retryable={'yes' if retry else 'no'}, taxonomy has "
+                f"status={e.status_doc} code={e.code or '—'} "
+                f"retryable={'yes' if e.retryable else 'no'}")
+    for e in entries:
+        if not e.is_pseudo and e.cls not in known_classes:
+            problems.append(
+                f"taxonomy names error class {e.cls} but no such class "
+                "exists in the project")
+        if e.code and e.code not in codes_emitted:
+            problems.append(
+                f"taxonomy code '{e.code}' ({e.cls}) is never emitted "
+                "in the serving tier")
+        if e.status is not None and e.status not in statuses_emitted:
+            problems.append(
+                f"taxonomy status {e.status} ({e.cls}) never appears at "
+                "a _json() response site")
+    reg_codes = {e.code for e in entries if e.code}
+    for code in sorted(codes_emitted - reg_codes):
+        problems.append(
+            f"serving tier emits code='{code}' but the taxonomy has no "
+            "entry for it")
+    return problems
+
+
+# ---- emit-site scanning -----------------------------------------------------
+
+def emitted_codes(trees: Dict[str, ast.Module]) -> Set[str]:
+    """Every ``code`` string the serving tier can put in a response
+    body: ``{"code": "x"}`` dict literals and ``body["code"] = "x"``
+    subscript stores."""
+    out: Set[str] = set()
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if (isinstance(k, ast.Constant) and k.value == "code"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)):
+                        out.add(v.value)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.slice, ast.Constant)
+                            and t.slice.value == "code"
+                            and isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)):
+                        out.add(node.value.value)
+    return out
+
+
+def emitted_statuses(trees: Dict[str, ast.Module]) -> Set[int]:
+    """First-argument int literals of ``._json(...)`` calls — the
+    response-emit sites the span/event catalog lints' emit legs
+    correspond to."""
+    out: Set[int] = set()
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_json"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, int)):
+                out.add(node.args[0].value)
+    return out

@@ -8,6 +8,8 @@ from . import silent_exception  # noqa: F401
 from . import op_schema  # noqa: F401
 from . import catalogs  # noqa: F401
 from . import pragmas  # noqa: F401
+from . import fused_coverage  # noqa: F401
 from ..graph import rules as graph_rules  # noqa: F401
 from ..threads import rules as thread_rules  # noqa: F401
 from ..lifecycle import rules as lifecycle_rules  # noqa: F401
+from ..errflow import rules as errflow_rules  # noqa: F401

@@ -1,0 +1,105 @@
+"""fused-coverage: which zoo families ride the fused decode tail.
+
+The fused decode-tail megakernels (ops/pallas/decode_tail) only engage
+when ``fused_decode_structural`` accepts a family's decoder layer — a
+quiet structural change (a new bias, a qk-norm, a non-RMSNorm) silently
+drops the family back to the discrete kernels and shows up as a perf
+regression weeks later, if ever. This rule sweeps the tiny-config zoo
+through the STRUCTURAL half of the gate on every default pdlint run and
+pins the passing set both ways:
+
+- a family in ``FUSED_FLOOR`` that stops passing is a coverage
+  REGRESSION (the finding names the family);
+- a family passing that is NOT in the floor must be added to it (the
+  pin stays exact, like the catalog lints' two-direction checks).
+
+Whisper (enc-dec) and gpt2 (non-llama attention) are not candidates —
+the fused tail is a llama-family decode optimization by construction.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterable, List
+
+from ..core import Finding, ProjectRule, register_rule
+
+__all__ = ["FUSED_FLOOR", "CANDIDATES", "structural_coverage"]
+
+#: zoo families with a llama-style decode path — what the sweep builds
+CANDIDATES = ("llama", "mixtral", "qwen2", "qwen3", "mistral", "gemma",
+              "gemma2", "phi3", "olmo2", "glm", "qwen2-moe",
+              "deepseek-mla")
+
+#: the pinned floor: families whose decoder layers pass the structural
+#: fused-decode gate today. qwen2/glm carry qkv bias, qwen3/olmo2
+#: qk-norm, gemma2 extra post-norms, deepseek-mla MLA attention — all
+#: correctly off the fused path.
+FUSED_FLOOR = frozenset({"llama", "mixtral", "mistral", "gemma", "phi3"})
+
+_ANCHOR = "paddle_tpu/models/llama.py"
+
+
+def _decoder_layer(model):
+    for sub in model.sublayers():
+        if getattr(sub, "self_attn", None) is not None:
+            return sub
+    return None
+
+
+# the sweep builds a dozen tiny models (~seconds); every pdlint family
+# gate in a test process runs this rule, so memoize per candidate set
+_COVERAGE_CACHE: dict = {}
+
+
+def structural_coverage(candidates=CANDIDATES) -> dict:
+    """{family: passes structural gate} over tiny-config zoo builds."""
+    hit = _COVERAGE_CACHE.get(candidates)
+    if hit is not None:
+        return dict(hit)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax.numpy as jnp
+
+    from ...models.llama import fused_decode_structural
+    from ..graph import zoo
+
+    out = {}
+    for name in candidates:
+        layer = _decoder_layer(zoo.entry(name, full=True).build())
+        out[name] = (layer is not None
+                     and fused_decode_structural(layer, jnp.bfloat16))
+    _COVERAGE_CACHE[candidates] = dict(out)
+    return out
+
+
+@register_rule
+class FusedCoverageRule(ProjectRule):
+    id = "fused-coverage"
+    rationale = ("a structural change silently dropping a family off "
+                 "the fused decode tail is a perf regression nobody "
+                 "sees — the floor pins which families pass the gate, "
+                 "both directions")
+
+    def check_project(self, root: str) -> Iterable[Finding]:
+        coverage = structural_coverage()
+        out: List[Finding] = []
+        for name in sorted(FUSED_FLOOR):
+            if not coverage.get(name, False):
+                out.append(Finding(
+                    file=_ANCHOR, line=1, rule=self.id,
+                    symbol="fused-coverage",
+                    message=(f"fused-decode coverage regression: family "
+                             f"'{name}' no longer passes "
+                             "fused_decode_structural — its serving "
+                             "decode fell back to the discrete kernels "
+                             "(remove it from FUSED_FLOOR only if the "
+                             "structural change is deliberate)")))
+        for name, ok in sorted(coverage.items()):
+            if ok and name not in FUSED_FLOOR:
+                out.append(Finding(
+                    file=_ANCHOR, line=1, rule=self.id,
+                    symbol="fused-coverage",
+                    message=(f"family '{name}' now passes the fused "
+                             "decode structural gate but is not in "
+                             "FUSED_FLOOR — add it so the coverage "
+                             "gain is pinned")))
+        return out

@@ -35,6 +35,7 @@ import time
 import urllib.request
 from typing import List, Optional
 
+from ..distributed.log_utils import get_logger
 from . import inject as _inject
 from .plan import Fault, FaultPlan
 
@@ -256,9 +257,17 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
             load_before = stack_stats(f"http://{host}:{port}")
 
             def _drive_load():
-                load_outcomes.extend(run_schedule(
-                    f"http://{host}:{port}", load_schedule,
-                    stream_timeout=stream_timeout))
+                try:
+                    load_outcomes.extend(run_schedule(
+                        f"http://{host}:{port}", load_schedule,
+                        stream_timeout=stream_timeout))
+                except Exception as e:
+                    # a dead load generator must show up in the report
+                    # as missing outcomes, not as a hung thread the
+                    # join below silently abandons
+                    get_logger().warning(
+                        "chaos dryrun: background load failed (%s: %s)",
+                        type(e).__name__, e)
 
             load_thread = threading.Thread(target=_drive_load,
                                            name="chaos-loadgen",
@@ -267,11 +276,19 @@ def run_dryrun(plan: Optional[FaultPlan] = None, *, streams: int = 4,
         results: List[Optional[tuple]] = [None] * streams
 
         def client(i):
-            results[i] = _stream_completion(
-                host, port,
-                {"prompt_token_ids": prompts[i],
-                 "max_tokens": max_tokens, "stream": True},
-                timeout=stream_timeout)
+            try:
+                results[i] = _stream_completion(
+                    host, port,
+                    {"prompt_token_ids": prompts[i],
+                     "max_tokens": max_tokens, "stream": True},
+                    timeout=stream_timeout)
+            except Exception as e:
+                # a None result already means "stream failed" to the
+                # gate checks below — record why instead of dying with
+                # the verdict unexplained
+                get_logger().warning(
+                    "chaos dryrun: gate stream %d failed (%s: %s)",
+                    i, type(e).__name__, e)
 
         threads = [threading.Thread(target=client, args=(i,),
                                     name=f"chaos-client-{i}")

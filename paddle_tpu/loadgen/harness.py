@@ -103,6 +103,7 @@ def _one_request(host: str, port: int, tr: TraceRequest, out: Outcome,
             "priority": tr.priority}
     if tr.slo_ms is not None:
         body["slo_ms"] = tr.slo_ms
+    # the constructor never raises (connect is lazy, on request())
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     t_sent = time.perf_counter()
     try:
@@ -155,6 +156,11 @@ def _one_request(host: str, port: int, tr: TraceRequest, out: Outcome,
     except (TimeoutError, http.client.HTTPException, OSError) as e:
         if isinstance(e, (TimeoutError,)) or "timed out" in str(e):
             out.timed_out = True
+        out.error = f"{type(e).__name__}: {e}"
+    except Exception as e:
+        # e.g. a malformed SSE payload (json.loads above): the outcome
+        # must record the failure — a dead request thread would count
+        # as a clean-looking 200 in the aggregate
         out.error = f"{type(e).__name__}: {e}"
     finally:
         conn.close()

@@ -770,24 +770,17 @@ def _rope_rows_for_cache(cos, sin, kv_cache, b, s=1):
     return cos_a[idx], sin_a[idx]
 
 
-def fused_decode_supported(layer, hidden_states, kv_cache, cos) -> bool:
-    """Trace-time gate for the fused decode tail
-    (FLAGS_use_fused_decode_tail): a dict decode cache with the plain
-    attention structure the megakernels assume — no qk-norm, no q
-    pre-multiplier, no projection bias, no tensor parallelism,
-    dtype-uniform weights, full-width rotary — plus decode_tail's own
-    VMEM-feasibility gate. S=1 is the classic decode step; an S>1
-    PAGED chunk (the engine's speculative verify) also qualifies — it
-    flattens to B*S independent rows with per-row rope positions.
-    Anything else keeps the discrete reference kernels (exact parity by
-    construction)."""
-    from ..ops.pallas import decode_tail
-
-    if not decode_tail.enabled() or not isinstance(kv_cache, dict):
-        return False
-    if hidden_states.shape[1] != 1 and "k_pages" not in kv_cache:
-        return False
-    attn = layer.self_attn
+def fused_decode_structural(layer, dtype) -> bool:
+    """The WEIGHT-STRUCTURE half of the fused decode-tail gate: does
+    this decoder layer look like what the megakernels assume — llama
+    attention with no qk-norm, no q pre-multiplier, no projection
+    bias, no tensor parallelism (plain ``nn.Linear``), dtype-uniform
+    weights and RMSNorm scales. Shape/cache/VMEM feasibility is the
+    dynamic half (``fused_decode_supported``); this half is also what
+    the ``fused-coverage`` pdlint rule sweeps the model zoo with — a
+    family regressing off the fused path fails that gate, not a perf
+    bisect three weeks later."""
+    attn = getattr(layer, "self_attn", None)
     if not isinstance(attn, LlamaAttention):
         return False
     if attn.qk_norm_mode is not None or attn.q_premul is not None:
@@ -795,13 +788,34 @@ def fused_decode_supported(layer, hidden_states, kv_cache, cos) -> bool:
     lins = (attn.q_proj, attn.k_proj, attn.v_proj, attn.o_proj)
     if any(type(l) is not nn.Linear or l.bias is not None for l in lins):
         return False
-    x = unwrap(hidden_states)
-    if any(unwrap(l.weight).dtype != x.dtype for l in lins):
+    if any(unwrap(l.weight).dtype != dtype for l in lins):
         return False
-    norms = (layer.input_layernorm, layer.post_attention_layernorm)
+    norms = (getattr(layer, "input_layernorm", None),
+             getattr(layer, "post_attention_layernorm", None))
     if any(not isinstance(n, LlamaRMSNorm)
-           or unwrap(n.weight).dtype != x.dtype for n in norms):
+           or unwrap(n.weight).dtype != dtype for n in norms):
         return False
+    return True
+
+
+def fused_decode_supported(layer, hidden_states, kv_cache, cos) -> bool:
+    """Trace-time gate for the fused decode tail
+    (FLAGS_use_fused_decode_tail): the structural predicate above on a
+    dict decode cache, plus decode_tail's own VMEM-feasibility gate.
+    S=1 is the classic decode step; an S>1 PAGED chunk (the engine's
+    speculative verify) also qualifies — it flattens to B*S independent
+    rows with per-row rope positions. Anything else keeps the discrete
+    reference kernels (exact parity by construction)."""
+    from ..ops.pallas import decode_tail
+
+    if not decode_tail.enabled() or not isinstance(kv_cache, dict):
+        return False
+    if hidden_states.shape[1] != 1 and "k_pages" not in kv_cache:
+        return False
+    x = unwrap(hidden_states)
+    if not fused_decode_structural(layer, x.dtype):
+        return False
+    attn = layer.self_attn
     return decode_tail.supported(
         x.shape[0] * x.shape[1], attn.hidden_size, attn.num_heads,
         attn.num_kv_heads, attn.head_dim, unwrap(cos).shape[-1],

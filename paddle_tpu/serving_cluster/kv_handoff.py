@@ -139,30 +139,40 @@ class KvHandoffReceiver:
                     "kv handoff receiver %s: drain failed (%s: %s)",
                     self.name, type(e).__name__, e)
                 continue
-            hid = msg.get("handoff_id")
-            bundle = msg.get("bundle")
-            if hid is None or bundle is None:
-                get_logger().warning("kv handoff receiver %s: malformed "
-                                     "message dropped", self.name)
-                continue
-            rec = _frec.RECORDER
-            if rec.enabled:
-                rec.record(_frec.EV_KV_HANDOFF_RECV, handoff_id=hid,
-                           channel=self.name,
-                           prompt_tokens=int(
-                               bundle.get("prompt_tokens", 0)),
-                           bytes=bundle_nbytes(bundle))
-            with self._arrived:
-                # bounded parking: an orphaned bundle (its completion
-                # request never came) must not hold KV bytes forever
-                while len(self._parked) >= self._max_parked:
-                    evicted = next(iter(self._parked))
-                    del self._parked[evicted]
+            try:
+                hid = msg.get("handoff_id")
+                bundle = msg.get("bundle")
+                if hid is None or bundle is None:
                     get_logger().warning(
-                        "kv handoff receiver %s: parked bundle %s "
-                        "evicted (never claimed)", self.name, evicted)
-                self._parked[hid] = bundle
-                self._arrived.notify_all()
+                        "kv handoff receiver %s: malformed message "
+                        "dropped", self.name)
+                    continue
+                rec = _frec.RECORDER
+                if rec.enabled:
+                    rec.record(_frec.EV_KV_HANDOFF_RECV, handoff_id=hid,
+                               channel=self.name,
+                               prompt_tokens=int(
+                                   bundle.get("prompt_tokens", 0)),
+                               bytes=bundle_nbytes(bundle))
+                with self._arrived:
+                    # bounded parking: an orphaned bundle (its
+                    # completion request never came) must not hold KV
+                    # bytes forever
+                    while len(self._parked) >= self._max_parked:
+                        evicted = next(iter(self._parked))
+                        del self._parked[evicted]
+                        get_logger().warning(
+                            "kv handoff receiver %s: parked bundle %s "
+                            "evicted (never claimed)", self.name,
+                            evicted)
+                    self._parked[hid] = bundle
+                    self._arrived.notify_all()
+            except Exception as e:
+                # one bad bundle loses one handoff (the claimer times
+                # out into the router-retry path), never the receiver
+                get_logger().warning(
+                    "kv handoff receiver %s: parking failed (%s: %s)",
+                    self.name, type(e).__name__, e)
 
     # ---- claim ---------------------------------------------------------
     def wait(self, handoff_id: str,

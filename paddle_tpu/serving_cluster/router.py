@@ -520,6 +520,18 @@ class RouterServer:
                 replica, timeout=float(req.get("timeout", 60.0)))
         except ValueError as e:
             return handler._json(404, {"error": str(e)})
+        except _ClientError as e:
+            # the worker judged a drain-path request invalid: forward
+            # the verdict verbatim, as the completion path would
+            return handler._json(e.status, e.body)
+        except _WorkerBusy as e:
+            return handler._json(429, dict(e.body,
+                                           retry_after=e.retry_after))
+        except _DeadlineExpired:
+            return handler._json(504, _deadline_body())
+        except _UpstreamError as e:
+            return handler._json(502, {
+                "error": f"drain failed upstream: {e.reason}"})
         except Exception as e:
             return handler._json(502, {
                 "error": f"drain failed: {type(e).__name__}: {e}"})

@@ -571,7 +571,16 @@ class WorkerSupervisor:
                                 # out on their own)
                                 sup.backoff.reset()
                 elif due:
-                    self._respawn(sup)
+                    try:
+                        self._respawn(sup)
+                    except Exception as e:
+                        # a failed spawn (fork/exec pressure) must not
+                        # kill supervision: next_restart_at is still in
+                        # the past, so the next tick retries
+                        get_logger().warning(
+                            "supervisor: respawn of worker %s failed "
+                            "(%s: %s); retrying next tick",
+                            sup.replica_id, type(e).__name__, e)
 
     def reset_breaker(self, replica_id: int):
         """Operator intervention: close a held-open breaker and schedule

@@ -505,7 +505,7 @@ class CompletionServer:
             atlas.enable()
         self._subs: "queue.Queue[_Submission]" = queue.Queue()
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._engine_loop,
+        self._thread = threading.Thread(target=self._engine_loop,  # pdlint: disable=error-thread-escape -- deliberate crash boundary: incident_scope writes the forensics bundle and the death is VISIBLE (waiters time out against _stop, /health degrades)
                                         daemon=True, name="engine-loop")
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._http_thread = threading.Thread(

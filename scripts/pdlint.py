@@ -10,6 +10,8 @@ Usage:
     python scripts/pdlint.py --graph                  # + jaxpr rules
     python scripts/pdlint.py --threads                # + concurrency rules
     python scripts/pdlint.py --lifecycle              # + leak-path rules
+    python scripts/pdlint.py --errors                 # + exception-flow rules
+    python scripts/pdlint.py --all                    # every gated family
     python scripts/pdlint.py --format sarif           # SARIF 2.1.0 report
     python scripts/pdlint.py --prune-baseline         # drop stale entries
     python scripts/pdlint.py --solve llama --mesh dp=2,mp=4
@@ -74,6 +76,16 @@ def main(argv=None) -> int:
                         "(must-release dataflow over slots, leases, "
                         "bundles, spans; see docs/ANALYSIS.md "
                         "'Lifecycle analysis')")
+    p.add_argument("--errors", action="store_true",
+                   help="also run the interprocedural exception-flow "
+                        "rules (per-function escape summaries over the "
+                        "call graph + the typed-error HTTP contract; "
+                        "see docs/ANALYSIS.md 'Exception-flow "
+                        "analysis')")
+    p.add_argument("--all", action="store_true", dest="all_families",
+                   help="run every gated family in one invocation "
+                        "(default + graph + threads + lifecycle + "
+                        "errors) with one merged report and exit code")
     p.add_argument("--solve", default=None, metavar="MODEL",
                    help="run the auto-sharding solver over a zoo entry "
                         "('all' = the fast zoo) and print the chosen "
@@ -120,10 +132,12 @@ def main(argv=None) -> int:
     selected = ([s.strip() for s in args.select.split(",")]
                 if args.select else None)
     paths = [os.path.abspath(p_) for p_ in args.paths] or None
+    if args.all_families:
+        args.graph = args.threads = args.lifecycle = args.errors = True
     findings = analysis.run(paths=paths, root=_REPO, selected=selected,
                             with_project_rules=not args.no_project_rules,
                             graph=args.graph, threads=args.threads,
-                            lifecycle=args.lifecycle)
+                            lifecycle=args.lifecycle, errors=args.errors)
     if args.write_baseline:
         # stale-entry pruning: report what the rewrite drops, split into
         # entries whose (file, symbol) no longer resolves (dead weight

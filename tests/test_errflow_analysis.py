@@ -1,0 +1,549 @@
+"""errflow: the interprocedural exception-flow analysis
+(paddle_tpu/analysis/errflow) behind ``pdlint --errors``.
+
+1. **Lattice fixtures** — control/fault/fatal/generic classification,
+   project-hierarchy catch semantics, broad-handler detection.
+2. **Engine fixtures** — handler subtraction, narrow-then-re-raise
+   transparency (bare ``raise`` and ``raise e``), ``finally``
+   raise-copy keeping both the pending and the masking type, SCC
+   (mutual recursion) convergence.
+3. **Rule fixtures** — both sides of every rule: a thread root that can
+   die vs one guarded at the root; control-swallow (fires even when
+   logged) vs fault-swallow-with-triage (clean); a retry loop that
+   re-dispatches after a non-retryable error vs one that answers and
+   returns; taxonomy drift in every direction over the pure
+   ``compare_taxonomy`` core.
+4. **Pinned repo summaries** — the escape sets of known serving
+   functions, so a refactor that changes what can escape
+   ``RouterServer._post_json`` shows up here, not in production.
+5. **The tier-1 gate** — ``scripts/pdlint.py --json --errors`` exits 0
+   with an EMPTY baseline, and ``unused-disable`` treats the
+   ``error-*`` family per-family (a staged pragma is exempt on default
+   runs, flagged once ``--errors`` actually runs the rule).
+"""
+import importlib.util
+import json
+import os
+
+from paddle_tpu import analysis
+from paddle_tpu.analysis.errflow import taxonomy as tax
+from paddle_tpu.analysis.errflow.lattice import (ErrorLattice,
+                                                 GENERIC_TOKEN,
+                                                 handler_spec)
+from paddle_tpu.analysis.errflow.rules import (http_contract_findings,
+                                               retry_unsafe_findings,
+                                               scope_roots,
+                                               swallow_findings,
+                                               thread_escape_findings)
+from paddle_tpu.analysis.errflow.summaries import ErrorFlow, get_flow
+from paddle_tpu.analysis.threads.model import ProjectModel, get_model
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIX = "fix.py"          # outside paddle_tpu/ -> always in scope
+
+
+def _model(src, path=_FIX):
+    return ProjectModel({path: src})
+
+
+def _flow(m):
+    flow = ErrorFlow(m)
+    flow.analyze(sorted(m.functions))
+    return flow
+
+
+def _load_script(name):
+    path = os.path.join(_REPO, "scripts", name)
+    spec = importlib.util.spec_from_file_location("pdlint_err", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# the lattice
+# ---------------------------------------------------------------------------
+
+_HIER_SRC = (
+    "class _Hop(Exception):\n"
+    "    pass\n"
+    "class Corrupt(RuntimeError):\n"
+    "    pass\n"
+)
+
+
+def test_lattice_classification():
+    lat = ErrorLattice(_model(_HIER_SRC))
+    assert lat.classify("_Hop") == "control"
+    assert lat.classify("Corrupt") == "fault"
+    assert lat.classify("KeyboardInterrupt") == "fatal"
+    assert lat.classify("MemoryError") == "fatal"
+    # builtins are generic: no project contract attaches to ValueError
+    assert lat.classify("ValueError") == "generic"
+    assert lat.classify(GENERIC_TOKEN) == "generic"
+
+
+def test_lattice_catch_semantics():
+    lat = ErrorLattice(_model(_HIER_SRC))
+    # project class caught through its base chain into the builtin tree
+    assert lat.caught_by("Corrupt", ["RuntimeError"])
+    assert lat.caught_by("Corrupt", ["Exception"])
+    assert not lat.caught_by("Corrupt", ["ValueError"])
+    # builtin hierarchy: except OSError stops ConnectionResetError
+    assert lat.caught_by("ConnectionResetError", ["OSError"])
+    # the unknown-external token is stopped ONLY by broad handlers
+    assert not lat.caught_by(GENERIC_TOKEN, ["ValueError"])
+    assert lat.caught_by(GENERIC_TOKEN, [], broad=True)
+
+
+def test_handler_spec_broad_detection():
+    import ast
+
+    def spec(src):
+        handler = ast.parse(src).body[0].handlers[0]
+        return handler_spec(handler.type, None)
+
+    assert spec("try:\n a\nexcept Exception:\n b\n") == (["Exception"],
+                                                         True)
+    assert spec("try:\n a\nexcept:\n b\n") == ([], True)
+    assert spec("try:\n a\nexcept OSError as e:\n b\n") == (["OSError"],
+                                                            False)
+    names, broad = spec("try:\n a\nexcept (ValueError, Exception):\n b\n")
+    assert broad and "ValueError" in names
+
+
+# ---------------------------------------------------------------------------
+# the summaries engine
+# ---------------------------------------------------------------------------
+
+def test_handler_subtraction_interprocedural():
+    """A callee's typed raise is subtracted by a caller's matching
+    handler (through the base chain) and escapes a non-matching one."""
+    m = _model(_HIER_SRC + (
+        "def boom():\n"
+        "    raise Corrupt('bad')\n"
+        "def stopped():\n"
+        "    try:\n"
+        "        return boom()\n"
+        "    except RuntimeError:\n"
+        "        return None\n"
+        "def missed():\n"
+        "    try:\n"
+        "        return boom()\n"
+        "    except ValueError:\n"
+        "        return None\n"
+    ))
+    flow = _flow(m)
+    assert "Corrupt" in flow.escapes_of((_FIX, "boom"))
+    assert "Corrupt" not in flow.escapes_of((_FIX, "stopped"))
+    esc = flow.escapes_of((_FIX, "missed"))
+    assert esc["Corrupt"] == (_FIX, 6)       # provenance: the raise site
+
+
+def test_narrow_reraise_is_transparent():
+    """``except _Hop: ... raise`` and ``except _Hop as e: raise e`` both
+    re-emit the arrival set — the handler is observability, not a
+    swallow, and the type keeps flowing to the real catcher."""
+    m = _model(_HIER_SRC + (
+        "def src():\n"
+        "    raise _Hop()\n"
+        "def relay_bare():\n"
+        "    try:\n"
+        "        return src()\n"
+        "    except _Hop:\n"
+        "        raise\n"
+        "def relay_bound():\n"
+        "    try:\n"
+        "        return src()\n"
+        "    except _Hop as e:\n"
+        "        raise e\n"
+    ))
+    flow = _flow(m)
+    assert "_Hop" in flow.escapes_of((_FIX, "relay_bare"))
+    assert "_Hop" in flow.escapes_of((_FIX, "relay_bound"))
+
+
+def test_finally_keeps_pending_and_masking_types():
+    """A raising ``finally`` masks the in-flight exception at runtime;
+    the engine deliberately over-approximates and keeps BOTH — losing
+    the pending type would hide the original contract."""
+    m = _model(_HIER_SRC + (
+        "def masked():\n"
+        "    try:\n"
+        "        raise Corrupt()\n"
+        "    finally:\n"
+        "        raise _Hop()\n"
+    ))
+    esc = _flow(m).escapes_of((_FIX, "masked"))
+    assert "Corrupt" in esc and "_Hop" in esc
+
+
+def test_scc_mutual_recursion_converges():
+    m = _model(_HIER_SRC + (
+        "def a(n):\n"
+        "    if n:\n"
+        "        return b(n - 1)\n"
+        "    raise Corrupt()\n"
+        "def b(n):\n"
+        "    return a(n)\n"
+    ))
+    flow = _flow(m)
+    assert "Corrupt" in flow.escapes_of((_FIX, "a"))
+    assert "Corrupt" in flow.escapes_of((_FIX, "b"))
+
+
+# ---------------------------------------------------------------------------
+# error-thread-escape
+# ---------------------------------------------------------------------------
+
+_SPAWN = (
+    "import threading\n"
+    "class Corrupt(RuntimeError):\n"
+    "    pass\n"
+    "class Daemon:\n"
+    "    def start(self):\n"
+    "        self._stop = threading.Event()\n"
+    "        self._t = threading.Thread(target=self._loop,\n"
+    "                                   name='d-loop', daemon=True)\n"
+    "        self._t.start()\n"
+    "    def _work(self):\n"
+    "        raise Corrupt('bad frame')\n"
+)
+
+
+def test_thread_escape_fires_on_typed_escape():
+    m = _model(_SPAWN + (
+        "    def _loop(self):\n"
+        "        while not self._stop.is_set():\n"
+        "            self._work()\n"
+    ))
+    (f,) = thread_escape_findings(m, _flow(m))
+    assert f.rule == "error-thread-escape"
+    assert "Corrupt" in f.message and "d-loop" in f.message
+    assert "Corrupt" in f.data["escapes"]
+
+
+def test_thread_escape_guarded_root_is_clean():
+    m = _model(_SPAWN + (
+        "    def _loop(self):\n"
+        "        while not self._stop.is_set():\n"
+        "            try:\n"
+        "                self._work()\n"
+        "            except Exception as e:\n"
+        "                self._last = e\n"
+    ))
+    assert thread_escape_findings(m, _flow(m)) == []
+
+
+def test_thread_escape_generic_only_still_fires():
+    """No typed escape, but an unresolvable external call means at
+    least one path has no guard at all — the root can still die."""
+    m = _model(_SPAWN + (
+        "    def _loop(self):\n"
+        "        while not self._stop.is_set():\n"
+        "            self.sock.recv(4096)\n"
+    ))
+    (f,) = thread_escape_findings(m, _flow(m))
+    assert "any uncaught exception" in f.message
+
+
+def test_thread_escape_fatal_exempt_and_pragma():
+    fatal = _model(_SPAWN + (
+        "    def _loop(self):\n"
+        "        while not self._stop.is_set():\n"
+        "            raise SystemExit(0)\n"
+    ))
+    assert thread_escape_findings(fatal, _flow(fatal)) == []
+    pragma = _model(_SPAWN.replace(
+        "target=self._loop,",
+        "target=self._loop,  # pdlint: disable=error-thread-escape"
+    ) + (
+        "    def _loop(self):\n"
+        "        while not self._stop.is_set():\n"
+        "            self._work()\n"
+    ))
+    assert thread_escape_findings(pragma, _flow(pragma)) == []
+
+
+# ---------------------------------------------------------------------------
+# error-swallow
+# ---------------------------------------------------------------------------
+
+_SWALLOW_HDR = _HIER_SRC + (
+    "def hop():\n"
+    "    raise _Hop()\n"
+    "def fault():\n"
+    "    raise Corrupt()\n"
+)
+
+
+def test_swallow_control_fires_even_when_logged():
+    m = _model(_SWALLOW_HDR + (
+        "def caller():\n"
+        "    try:\n"
+        "        return hop()\n"
+        "    except Exception as e:\n"
+        "        print(e)\n"
+    ))
+    (f,) = swallow_findings(m, _flow(m))
+    assert "control-flow" in f.message and "_Hop" in f.message
+    assert "_Hop" in f.data["swallowed"]
+
+
+def test_swallow_silent_fault_fires_triaged_fault_clean():
+    silent = _model(_SWALLOW_HDR + (
+        "def caller():\n"
+        "    try:\n"
+        "        return fault()\n"
+        "    except Exception:\n"
+        "        return None\n"
+    ))
+    (f,) = swallow_findings(silent, _flow(silent))
+    assert "Corrupt" in f.message
+    triaged = _model(_SWALLOW_HDR + (
+        "def caller():\n"
+        "    try:\n"
+        "        return fault()\n"
+        "    except Exception as e:\n"
+        "        return {'error': str(e)}\n"
+    ))
+    assert swallow_findings(triaged, _flow(triaged)) == []
+
+
+def test_swallow_reraise_and_narrow_exempt():
+    reraise = _model(_SWALLOW_HDR + (
+        "def caller():\n"
+        "    try:\n"
+        "        return hop()\n"
+        "    except Exception:\n"
+        "        raise\n"
+    ))
+    assert swallow_findings(reraise, _flow(reraise)) == []
+    narrow = _model(_SWALLOW_HDR + (
+        "def caller():\n"
+        "    try:\n"
+        "        return hop()\n"
+        "    except ValueError:\n"
+        "        return None\n"
+    ))
+    assert swallow_findings(narrow, _flow(narrow)) == []
+
+
+# ---------------------------------------------------------------------------
+# error-retry-unsafe
+# ---------------------------------------------------------------------------
+
+_RETRY_HDR = (
+    "class _DeadlineExpired(Exception):\n"
+    "    pass\n"
+    "class _UpstreamError(Exception):\n"
+    "    pass\n"
+    "def dispatch(w):\n"
+    "    raise _DeadlineExpired()\n"
+)
+
+
+def test_retry_unsafe_fires_on_nonretryable_rejoin():
+    m = _model(_RETRY_HDR + (
+        "def failover(workers):\n"
+        "    for w in workers:\n"
+        "        try:\n"
+        "            return dispatch(w)\n"
+        "        except _DeadlineExpired:\n"
+        "            continue\n"
+    ))
+    (f,) = retry_unsafe_findings(m, _flow(m))
+    assert f.rule == "error-retry-unsafe"
+    assert "_DeadlineExpired" in f.message
+    assert "_DeadlineExpired" in f.data["non_retryable"]
+
+
+def test_retry_unsafe_broad_handler_caught_by_arrival():
+    """Even an untyped ``except Exception: continue`` is unsafe when
+    the ARRIVAL set (per the summaries) carries a non-retryable type."""
+    m = _model(_RETRY_HDR + (
+        "def failover(workers):\n"
+        "    for w in workers:\n"
+        "        try:\n"
+        "            return dispatch(w)\n"
+        "        except Exception:\n"
+        "            continue\n"
+    ))
+    (f,) = retry_unsafe_findings(m, _flow(m))
+    assert "_DeadlineExpired" in f.data["non_retryable"]
+
+
+def test_retry_honoring_catalog_is_clean():
+    """Answering the client on the non-retryable type (return) while
+    failing over only on the retryable one is the documented shape."""
+    m = _model(_RETRY_HDR + (
+        "def failover(workers):\n"
+        "    for w in workers:\n"
+        "        try:\n"
+        "            return dispatch(w)\n"
+        "        except _DeadlineExpired:\n"
+        "            return None\n"
+        "        except _UpstreamError:\n"
+        "            continue\n"
+    ))
+    assert retry_unsafe_findings(m, _flow(m)) == []
+
+
+# ---------------------------------------------------------------------------
+# error-http-contract: the pure comparison core
+# ---------------------------------------------------------------------------
+
+def _perfect_world():
+    docs = {e.cls: (e.status_doc, e.code, e.retryable)
+            for e in tax.TAXONOMY}
+    known = {e.cls for e in tax.TAXONOMY if not e.is_pseudo}
+    codes = {e.code for e in tax.TAXONOMY if e.code}
+    statuses = {e.status for e in tax.TAXONOMY if e.status is not None}
+    return docs, known, codes, statuses
+
+
+def test_taxonomy_in_agreement_is_clean():
+    docs, known, codes, statuses = _perfect_world()
+    assert tax.compare_taxonomy(docs, tax.TAXONOMY, known, codes,
+                                statuses) == []
+
+
+def test_taxonomy_drift_fires_in_every_direction():
+    docs, known, codes, statuses = _perfect_world()
+    # a taxonomy entry with no docs row
+    short = dict(docs)
+    del short["QueueFull"]
+    msgs = tax.compare_taxonomy(short, tax.TAXONOMY, known, codes,
+                                statuses)
+    assert any("QueueFull" in m and "no row" in m for m in msgs)
+    # a docs row with no taxonomy entry
+    extra = dict(docs, GhostError=("500", "", True))
+    msgs = tax.compare_taxonomy(extra, tax.TAXONOMY, known, codes,
+                                statuses)
+    assert any("GhostError" in m and "not in the taxonomy" in m
+               for m in msgs)
+    # per-cell drift: the docs call a terminal error retryable
+    flipped = dict(docs, _DeadlineExpired=("504", "deadline_exceeded",
+                                           True))
+    msgs = tax.compare_taxonomy(flipped, tax.TAXONOMY, known, codes,
+                                statuses)
+    assert any("contract drift for _DeadlineExpired" in m for m in msgs)
+    # a taxonomy class that does not exist in the project
+    msgs = tax.compare_taxonomy(docs, tax.TAXONOMY,
+                                known - {"XlaOom"}, codes, statuses)
+    assert any("XlaOom" in m and "no such class" in m for m in msgs)
+    # a documented code= the serving tier never emits
+    msgs = tax.compare_taxonomy(docs, tax.TAXONOMY, known,
+                                codes - {"request_quarantined"},
+                                statuses)
+    assert any("request_quarantined" in m and "never emitted" in m
+               for m in msgs)
+    # an emitted code= the taxonomy does not document
+    msgs = tax.compare_taxonomy(docs, tax.TAXONOMY, known,
+                                codes | {"mystery_mode"}, statuses)
+    assert any("mystery_mode" in m and "no entry" in m for m in msgs)
+
+
+def test_documented_taxonomy_roundtrips_the_repo_docs():
+    """docs/SERVING.md 'Error taxonomy' parses back to exactly the
+    registry — the live half of the two-direction lint."""
+    docs = tax.documented_taxonomy(
+        os.path.join(_REPO, "docs", "SERVING.md"))
+    assert docs == {e.cls: (e.status_doc, e.code, e.retryable)
+                    for e in tax.TAXONOMY}
+
+
+# ---------------------------------------------------------------------------
+# pinned repo summaries
+# ---------------------------------------------------------------------------
+
+def test_pinned_serving_escape_summaries():
+    """What can escape the load-bearing serving functions, pinned. A
+    refactor that adds or removes an escaping type must update this
+    test AND the docs taxonomy it implements."""
+    m = get_model(_REPO)
+    flow = get_flow(m)
+    flow.analyze(scope_roots(m))
+
+    def typed(file, qual):
+        return set(flow.typed(flow.escapes_of((file, qual))))
+
+    assert typed("paddle_tpu/serving.py",
+                 "ContinuousBatchEngine._check_queue_bound") == {
+        "QueueFull"}
+    assert typed("paddle_tpu/serving.py", "verify_bundle") == {
+        "HandoffCorrupt"}
+    assert typed("paddle_tpu/serving_cluster/router.py",
+                 "RouterServer._post_json") == {
+        "_ClientError", "_DeadlineExpired", "_UpstreamError",
+        "_WorkerBusy"}
+    # the relay adds the mid-stream control hops
+    proxy = typed("paddle_tpu/serving_cluster/router.py",
+                  "RouterServer._proxy_stream")
+    assert {"_Migrated", "_ClientGone"} <= proxy
+    # the real lattice classifies the real types
+    assert flow.lattice.classify("_Migrated") == "control"
+    assert flow.lattice.classify("QueueFull") == "fault"
+    # and the repo itself is clean under the typed rules
+    assert thread_escape_findings(m, flow) == []
+    assert swallow_findings(m, flow) == []
+    assert retry_unsafe_findings(m, flow) == []
+    assert http_contract_findings(m, _REPO) == []
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate + per-family pragma hygiene
+# ---------------------------------------------------------------------------
+
+def test_pdlint_errors_gate_empty_baseline(capsys):
+    """``--errors`` exits 0 against an EMPTY baseline: every real
+    finding this analysis ever produced was FIXED, not baselined."""
+    mod = _load_script("pdlint.py")
+    rc = mod.main(["--json", "--errors"])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == 0, f"pdlint --errors found new findings:\n{out}"
+    assert doc["total"] == 0
+    assert doc["baselined"] == 0
+    for rid in ("error-thread-escape", "error-http-contract",
+                "error-swallow", "error-retry-unsafe"):
+        assert rid in doc["rules"]
+
+
+def test_unused_disable_is_per_family(tmp_path):
+    """A staged ``disable=error-swallow`` pragma is exempt on a default
+    run (the family did not run) and flagged as unused-disable the
+    moment ``--errors`` runs the rule and it suppresses nothing."""
+    f = tmp_path / "fix.py"
+    f.write_text(
+        "def handle(req):\n"
+        "    try:\n"
+        "        return req.parse()\n"
+        "    except Exception:  # pdlint: disable=error-swallow -- staged\n"
+        "        return None\n")
+
+    def unused(findings):
+        return [fd for fd in findings if fd.rule == "unused-disable"
+                and "error-swallow" in fd.message]
+
+    plain = analysis.run(paths=[str(f)], root=str(tmp_path),
+                         with_project_rules=False)
+    assert unused(plain) == []
+    full = analysis.run(paths=[str(f)], root=str(tmp_path),
+                        selected=["unused-disable", "error-swallow"])
+    assert len(unused(full)) == 1
+
+
+# ---------------------------------------------------------------------------
+# fused-coverage (satellite): the structural sweep itself
+# ---------------------------------------------------------------------------
+
+def test_fused_coverage_structural_split():
+    """llama's decoder layer passes the structural fused-decode gate;
+    qwen2 (qkv bias) correctly does not — the two sides the floor
+    pins."""
+    from paddle_tpu.analysis.rules.fused_coverage import (
+        FUSED_FLOOR, structural_coverage)
+    cov = structural_coverage()
+    assert cov["llama"] is True and cov["qwen2"] is False
+    assert "llama" in FUSED_FLOOR and "qwen2" not in FUSED_FLOOR

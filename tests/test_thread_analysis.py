@@ -572,16 +572,22 @@ def test_threads_json_finding_shape():
     assert f["data"]["edges"][0]["witness"]
 
 
-def test_pdlint_threads_gate_zero_new_findings(capsys):
-    """THE gate: ``scripts/pdlint.py --json --baseline
-    .pdlint_baseline.json --threads`` exits 0 with nothing baselined —
-    every finding the concurrency rules surface is fixed or pragma'd."""
+def test_pdlint_all_gate_zero_new_findings(capsys):
+    """THE gate, now via ``--all``: every gated family (default + graph
+    + threads + lifecycle + errors) in ONE invocation with one merged
+    report and exit code — the combined run shares the parse cache and
+    the thread model, so this is cheaper than the families separately."""
     mod = _load_script("pdlint.py")
-    rc = mod.main(["--json", "--threads", "--baseline",
+    rc = mod.main(["--json", "--all", "--baseline",
                    os.path.join(_REPO, ".pdlint_baseline.json")])
     out = capsys.readouterr().out
     doc = json.loads(out)
-    assert rc == 0, f"pdlint --threads found new findings:\n{out}"
+    assert rc == 0, f"pdlint --all found new findings:\n{out}"
     assert doc["total"] == 0
     assert doc["baselined"] == 0
+    # the merged run registered every family's rules
     assert "thread-deadlock" in doc["rules"]
+    assert "leak-path" in doc["rules"]
+    assert "error-thread-escape" in doc["rules"]
+    assert "fused-coverage" in doc["rules"]
+    assert "graph-dtype-promotion" in doc["rules"]
