@@ -248,6 +248,7 @@ def decode_bench(devs, gen):
         "config": "decode",
         "phases": _phase_leg(model, on_tpu),
         "kv": _kv_leg(model, on_tpu),
+        "audit": _audit_leg(model, on_tpu),
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
@@ -328,6 +329,57 @@ def _kv_leg(model, on_tpu):
         eng.add_request(ids, new)
     eng.run_until_done()
     return _kv_summary(eng)
+
+
+def _audit_leg(model, on_tpu):
+    """Correctness-sentinel numbers for a bench record: a short engine
+    run with shadow audits at rate 1.0 (every finished request replayed
+    on the reference path by the audit worker), against an identical
+    audit-off run for the hot-path overhead delta. Lands under
+    BENCH_STATE.json:cpu_smoke.{decode,serve}.audit — the divergence
+    count must stay 0 (docs/SERVING.md "Correctness sentinel")."""
+    from paddle_tpu.serving import ContinuousBatchEngine
+
+    cfg = model.config
+    slots, max_len, new = (8, 512, 32) if on_tpu else (2, 64, 8)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, (8 + i,))
+               for i in range(slots)]
+
+    def run(audit_rate):
+        eng = ContinuousBatchEngine(model, max_batch=slots,
+                                    max_len=max_len, page_size=16)
+        if audit_rate:
+            eng.sentinel.enable(audit_rate=audit_rate)
+            eng.sentinel.start()
+        for ids in prompts:
+            eng.add_request(ids, new)
+        t0 = time.perf_counter()
+        eng.run_until_done()
+        return eng, time.perf_counter() - t0
+
+    run(0.0)                       # warm-up: compiles are shared
+    _, t_off = run(0.0)            # steady-state audit-off baseline
+    eng, t_on = run(1.0)
+    # drain: every finished request reaches a verdict before we count
+    deadline = time.time() + 120.0
+    fed = eng.sentinel.federated()
+    while (fed["audit_pass"] + fed["audit_diverged"]
+           + fed["audit_skipped"] < len(prompts)
+           and time.time() < deadline):
+        time.sleep(0.05)
+        fed = eng.sentinel.federated()
+    eng.sentinel.stop()
+    return {
+        "audit_pass": int(fed["audit_pass"]),
+        "audit_diverged": int(fed["audit_diverged"]),
+        "audit_skipped": int(fed["audit_skipped"]),
+        "logprob_drift_last": float(fed["audit_drift"]),
+        # engine-loop wall delta with audits enqueueing at rate 1.0 —
+        # the replay itself runs post-finish on the audit worker
+        "overhead_pct": round(100.0 * (t_on - t_off) / t_off, 2)
+        if t_off else None,
+    }
 
 
 def _spec_decode_leg(model, on_tpu):
@@ -581,6 +633,7 @@ def serve_bench(devs, gen):
                    else "serve_int8" if quantized else "serve"),
         "phases": _phase_means(engines[-1]) if engines else {},
         "kv": _kv_summary(engines[-1]) if engines else {},
+        "audit": _audit_leg(model, on_tpu),
         "tpu_gen": gen,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }

@@ -30,6 +30,11 @@ point                     actions
                           request id named by ``detail`` enters a decode
                           dispatch — the deterministic poison request;
                           ``nth`` is ignored, the rid IS the trigger)
+``engine.logits``         ``perturb_logit`` (the nth decode step emits a
+                          flipped token for its first active slot —
+                          silent wrong-output drift, NOT a crash; the
+                          correctness sentinel's injected-divergence
+                          drill, bisectable by replay_divergence)
 ``pool.probe``            ``probe_fail`` (the router's /health poll of a
                           worker is treated as failed)
 ========================  =====================================================
@@ -62,6 +67,7 @@ POINT_ACTIONS = {
     "worker.request": ("http_500", "stall_heartbeat", "delay"),
     "worker.step": ("kill",),
     "engine.dispatch": ("crash_on_rid",),
+    "engine.logits": ("perturb_logit",),
     "pool.probe": ("probe_fail",),
 }
 

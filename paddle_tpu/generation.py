@@ -994,6 +994,18 @@ class _PrefillStep:
         return self._jitted(self._state, ids, lengths, pad_mask)
 
 
+def _trace_flags_key() -> tuple:
+    """The trace-relevant flag values as seen by THIS thread (including
+    any thread-local overlay). Folded into every step-memoization key:
+    flags are read at trace time, so a cached executable is only valid
+    for the flag values it was traced under — a flag flip (or an audit
+    thread's flag_overrides) must get its own program, not silently
+    reuse one traced the other way."""
+    from .utils.flags import flag
+
+    return (bool(flag("FLAGS_use_fused_decode_tail")),)
+
+
 def _memoized_step(model, attr, key, factory, maxsize=None):
     """Per-model step memoization: jax.jit's compile cache keys on the
     function object, so a fresh step per generate() call would recompile
@@ -1002,7 +1014,12 @@ def _memoized_step(model, attr, key, factory, maxsize=None):
     caches whose key space is unbounded (per-request lengths): a hit
     re-inserts its key at the back, so a working set that cycles through
     many keys per request (the chunked-prefill suffix programs) keeps its
-    hot programs instead of evicting in insertion order."""
+    hot programs instead of evicting in insertion order.
+
+    Keys are extended with the trace-relevant flag fingerprint
+    (:func:`_trace_flags_key`) so programs traced under different flag
+    values never alias."""
+    key = (key, _trace_flags_key())
     cache = model.__dict__.get(attr)
     if cache is None:
         cache = {}

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import threading
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -35,21 +36,36 @@ class HookRemoveHelper:
         self._hooks.pop(self._id, None)
 
 
+#: process-wide trace serializer: ``functional_weights`` swaps TRACER
+#: arrays into the layer's parameters for the duration of a jit trace,
+#: so two threads tracing against the same model concurrently would
+#: read each other's tracers (the serving engine compiling a prefill
+#: while the correctness sentinel's audit worker retraces the reference
+#: decode path). The traced body only runs at TRACE time — compiled
+#: executions never enter this context — so the decode hot path never
+#: contends here. Reentrant: a traced body that traces an inner jitted
+#: step on the same thread re-enters freely.
+_TRACE_LOCK = threading.RLock()
+
+
 @contextlib.contextmanager
 def functional_weights(layer, state):
     """Temporarily install a functional parameter pytree on ``layer`` inside
     a trace, restoring the original arrays after — the shared spine of every
     jitted step (TrainStep, pipeline stage fns, serving prefill/decode).
     Yields the layer's live state_dict so callers can read in-trace buffer
-    updates (BatchNorm stats) before the restore."""
-    own = layer.state_dict()
-    snapshot = {k: t._array for k, t in own.items()}
-    layer.load_functional_state(state)
-    try:
-        yield own
-    finally:
-        for k, t in own.items():
-            t._array = snapshot[k]
+    updates (BatchNorm stats) before the restore. Cross-thread traces
+    serialize on :data:`_TRACE_LOCK` — the parameter swap is a mutation
+    of shared model state."""
+    with _TRACE_LOCK:
+        own = layer.state_dict()
+        snapshot = {k: t._array for k, t in own.items()}
+        layer.load_functional_state(state)
+        try:
+            yield own
+        finally:
+            for k, t in own.items():
+                t._array = snapshot[k]
 
 
 class Layer:
@@ -315,8 +331,13 @@ class Layer:
 
     # ---- functional bridge (jit / pjit path) ---------------------------------
     def functional_state(self) -> Dict[str, Any]:
-        """Pure pytree {name: jax.Array} of all parameters + buffers."""
-        return {k: v._array for k, v in self.state_dict().items()}
+        """Pure pytree {name: jax.Array} of all parameters + buffers.
+        Serializes on :data:`_TRACE_LOCK`: while another thread's trace
+        is inside :func:`functional_weights` the parameters hold that
+        trace's TRACERS, and a concurrent snapshot would capture (and
+        leak) them instead of real arrays."""
+        with _TRACE_LOCK:
+            return {k: v._array for k, v in self.state_dict().items()}
 
     def load_functional_state(self, state: Dict[str, Any]):
         own = self.state_dict()

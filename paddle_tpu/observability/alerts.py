@@ -266,6 +266,16 @@ def default_objectives(time_scale: float = 1.0
             labels={"engine": "decoder"},
             agg="avg", window_s=60.0, op="<", threshold=0.10,
             for_s=60.0, resolve_s=120.0),
+        SloObjective(
+            "audit_divergence", "threshold", severity="page",
+            summary="the correctness sentinel recorded a diverged "
+                    "verdict inside the window — a live token stream "
+                    "disagreed with the reference replay; inspect the "
+                    "sealed divergence bundle and run "
+                    "scripts/replay_divergence.py",
+            metric="serving_audit_total", labels={"verdict": "diverged"},
+            agg="increase", window_s=600.0, op=">=", threshold=1.0,
+            for_s=0.0, resolve_s=60.0),
     ]
     return {o.name: o.scaled(time_scale) if time_scale != 1.0 else o
             for o in objs}
@@ -307,6 +317,15 @@ def cluster_objectives(time_scale: float = 1.0
             metric="requests_quarantined_total", agg="increase",
             window_s=600.0, op=">=", threshold=1.0,
             for_s=0.0, resolve_s=60.0),
+        SloObjective(
+            "cluster_audit_divergence", "threshold", severity="page",
+            summary="some replica's correctness sentinel recorded a "
+                    "diverged verdict inside the window — find the "
+                    "replica on GET /audit/cluster and replay its "
+                    "sealed divergence bundle",
+            metric="cluster_audit_diverged", agg="increase",
+            window_s=600.0, op=">=", threshold=1.0,
+            for_s=0.0, resolve_s=60.0),
     ]
     return {o.name: o.scaled(time_scale) if time_scale != 1.0 else o
             for o in objs}
@@ -333,6 +352,10 @@ FEDERATED_SERIES = frozenset({
     "cluster_kv_bytes",
     "cluster_kv_headroom_slots",
     "cluster_prefix_hit_ratio",
+    "cluster_audit_pass",
+    "cluster_audit_diverged",
+    "cluster_audit_skipped",
+    "cluster_audit_drift",
 })
 
 

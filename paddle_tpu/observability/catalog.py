@@ -186,6 +186,30 @@ SERVING_BUNDLE_BYTES = _R.histogram(
     buckets=(4096.0, 16384.0, 65536.0, 262144.0, 1048576.0, 4194304.0,
              16777216.0, 67108864.0, 268435456.0, 1073741824.0))
 
+SERVING_AUDIT = _R.counter(
+    "serving_audit_total",
+    "Correctness-sentinel audit verdicts (pass = reference replay "
+    "token-identical, diverged = any token mismatch — a sealed "
+    "paddle_tpu.divergence/1 bundle exists for each, skipped = audit "
+    "shed by budget/eligibility, never silent)",
+    labels=("engine", "verdict"))
+
+SERVING_AUDIT_DRIFT = _R.histogram(
+    "serving_audit_logprob_drift",
+    "Max per-position |logprob(live) - logprob(reference)| over one "
+    "audited request (observed on pass AND diverged verdicts; drift "
+    "without token divergence is numeric noise to trend, not an alert)",
+    labels=("engine",),
+    buckets=(1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0))
+
+SERVING_AUDIT_FIRST_DIVERGENCE = _R.histogram(
+    "serving_audit_first_divergence_position",
+    "Token position of the first live/reference mismatch (observed on "
+    "diverged verdicts only — early positions implicate prefill, late "
+    "positions the decode tail or speculation)",
+    labels=("engine",),
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0))
+
 # ---- HTTP front-end ---------------------------------------------------------
 
 HTTP_REQUESTS = _R.counter(

@@ -266,6 +266,23 @@ EV_PERF_ROOFLINE = _register(
     "mfu) — one (signature, measured, predicted) training row for a "
     "later learned cost-model fit; see docs/SERVING.md 'Step anatomy & "
     "roofline accounting'")
+EV_AUDIT_PASS = _register(
+    "audit.pass",
+    "a correctness-sentinel audit replayed the request on the "
+    "reference path and matched token-for-token (rid, source="
+    "shadow|ondemand|canary, n_tokens, drift = max per-position "
+    "logprob delta)")
+EV_AUDIT_DIVERGE = _register(
+    "audit.diverge",
+    "a correctness-sentinel audit DIVERGED from the reference path "
+    "(rid, source, first_divergence = token index of the first "
+    "mismatch, drift) — a sealed paddle_tpu.divergence/1 bundle was "
+    "captured; replay it with scripts/replay_divergence.py")
+EV_AUDIT_SKIP = _register(
+    "audit.skip",
+    "a correctness-sentinel audit was shed instead of run (rid, "
+    "reason=queue_full|load|headroom|sampling|reason|unsupported) — "
+    "skips are counted, never silent, so audit coverage is auditable")
 
 
 # ---- the ring ---------------------------------------------------------------
@@ -466,6 +483,11 @@ BUNDLE_SCHEMA = {
     # ledger, host-parked preemption bytes and the prefix-reuse index
     # at crash time: the memory story behind an OOM incident
     "kvstate": (dict, type(None)),
+    # the correctness sentinel (sentinel.audit_payload(); None when no
+    # engine ever registered a sentinel) — audit verdict counters,
+    # canary fingerprint/results and recent divergence bundle paths at
+    # crash time: was the model already producing wrong tokens?
+    "audit": (dict, type(None)),
 }
 
 _EVENT_KEYS = ("seq", "ts", "mono_ns", "kind", "tid")
@@ -474,7 +496,7 @@ _EVENT_KEYS = ("seq", "ts", "mono_ns", "kind", "tid")
 # them, but a reader must keep accepting bundles written before they
 # existed (the version string is unchanged — the addition is additive)
 _OPTIONAL_KEYS = frozenset({"lock_witness", "timeseries", "alerts",
-                            "profile", "kvstate"})
+                            "profile", "kvstate", "audit"})
 
 
 def validate_bundle(bundle: dict) -> dict:
@@ -572,6 +594,20 @@ def _kvstate_section() -> Optional[dict]:
             return None
         return _kvatlas.kvstate_payload()
     except Exception:  # pdlint: disable=silent-exception -- a crash dump must not die on an optional memory surface; the bundle just omits it
+        return None
+
+
+def _audit_section() -> Optional[dict]:
+    """The correctness-sentinel view for the bundle (None when no engine
+    ever registered a sentinel — processes without serving engines and
+    old readers see the same absent shape)."""
+    try:
+        from . import sentinel as _sentinel
+
+        if not _sentinel._SENTINELS:
+            return None
+        return _sentinel.audit_payload()
+    except Exception:  # pdlint: disable=silent-exception -- a crash dump must not die on an optional audit surface; the bundle just omits it
         return None
 
 
@@ -826,6 +862,7 @@ class IncidentReporter:
             "alerts": _alerts_state(),
             "profile": _profile_section(),
             "kvstate": _kvstate_section(),
+            "audit": _audit_section(),
         }
 
     def dump(self, reason: str, exc: Optional[BaseException] = None,

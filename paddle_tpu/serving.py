@@ -34,6 +34,7 @@ from .observability import catalog as _metrics
 from .observability import flightrecorder as _frec
 from .observability import kvatlas as _kvatlas
 from .observability import perf as _perf
+from .observability import sentinel as _sentinel
 from .observability import tracing as _tracing
 from .tensor_class import Tensor, unwrap
 from .framework import random as _random
@@ -190,7 +191,7 @@ class _Request:
                  "t_last", "span", "queue_span", "handoff",
                  "priority", "deadline", "resume", "n_preempted",
                  "on_shed", "spec_rounds", "spec_accepted", "ext_id",
-                 "dispatches")
+                 "dispatches", "audit")
 
     def __init__(self, rid, ids, max_new_tokens, sampling=None,
                  on_token=None, pixel_values=None, stop_token_ids=None,
@@ -246,6 +247,10 @@ class _Request:
         # fused dispatches this request rode (per-request cost
         # accounting: the usage block's dispatches / tokens-per-dispatch)
         self.dispatches = 0
+        # correctness-sentinel mark: None (unaudited), "shadow" (rate-
+        # sampled) or "ondemand" (X-Audit forced) — set at admission,
+        # carried through preemption/migration, consumed at retirement
+        self.audit = None
         # shed notification: the front-end's hook for learning that a
         # QUEUED request was dropped (deadline expired / displaced by a
         # more important arrival) — without it an HTTP submission would
@@ -396,6 +401,10 @@ class _RequestBookkeeping:
         # engines with a paged pool replace it with a configured one
         self.kvatlas = _kvatlas.KvAtlas(
             engine, max_batch=int(getattr(self, "max_batch", 0) or 0))
+        # correctness sentinel: same guarded-fast-path contract (one
+        # attribute read at admission/retirement when off). Engines whose
+        # decode the reference replay can reproduce mark it auditable
+        self.sentinel = _sentinel.CorrectnessSentinel(engine, self)
         # overload estimators, both engine-thread-only: the FLOOR of
         # admission->first-token (best case ever observed — a request
         # whose remaining budget is below even that is PROVABLY
@@ -485,6 +494,8 @@ class _RequestBookkeeping:
             # KV-atlas scalars ride the same transport: /health -> pool
             # probe cache -> router TSDB collector (cluster_kv_*)
             **self.kvatlas.federated(),
+            # correctness-sentinel verdict counters (cluster_audit_*)
+            **self.sentinel.federated(),
         }
 
     def _count_finished(self, req: "_Request", slo: bool = True):
@@ -495,6 +506,11 @@ class _RequestBookkeeping:
         self._n_finished += 1
         self._m_req_finished.inc()
         self._record_usage(req)
+        sn = self.sentinel
+        if sn.enabled and req.audit is not None:
+            # snapshot + enqueue only (budget gates are attribute
+            # reads); the replay itself runs on the audit worker
+            sn.on_finish(req, self._finished_reason.get(req.rid))
         if slo and req.deadline != math.inf:
             if time.perf_counter() <= req.deadline:
                 self._n_slo_good += 1
@@ -1070,6 +1086,9 @@ class ContinuousBatchEngine(_RequestBookkeeping):
             pages_per_slot=self._pages_per_slot,
             bytes_per_token=_kvatlas.kv_bytes_per_token(cfg),
             paged=not self._latent_mode, preflight_bytes=_preflight)
+        # the reference replay reproduces exactly this engine's decode
+        # semantics, so the correctness sentinel may audit it
+        self.sentinel.auditable = True
         # sealed-bundle size histogram children (preempt eviction,
         # migration export, prefill->decode handoff) — always-on like
         # the other engine histograms, not atlas-gated
@@ -1137,7 +1156,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                     on_token=None, pixel_values=None,
                     stop_token_ids=None, logprobs=False,
                     trace_ctx=None, priority=None, slo_ms=None,
-                    on_shed=None, request_id=None) -> int:
+                    on_shed=None, request_id=None, audit=None) -> int:
         """Queue one request. Sampling knobs default to the engine-level
         configuration; any per-request override routes decoding through the
         per-row sampling program (one compiled step serves the whole mix).
@@ -1172,7 +1191,15 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         still queued when its budget runs out is shed typed
         (``sched.shed`` -> HTTP 504 via ``on_shed(rid, info)``), and a
         request submitted with no remaining budget raises
-        :class:`DeadlineExceeded` immediately."""
+        :class:`DeadlineExceeded` immediately.
+
+        ``audit`` drives the correctness sentinel: ``True`` forces an
+        on-demand audit (the HTTP ``X-Audit: 1`` contract — the verdict
+        is waitable via ``sentinel.wait_verdict``), ``False`` opts the
+        request out, ``None`` (default) leaves it to the sentinel's
+        sampling rate. Only effectively-greedy text requests are
+        auditable; a forced audit of an ineligible request records a
+        ``skipped`` verdict rather than failing the request."""
         eff_priority = (PRIORITY_DEFAULT if priority is None
                         else int(priority))
         if slo_ms is not None and float(slo_ms) <= 0:
@@ -1227,6 +1254,7 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                        want_logprobs=logprobs, priority=priority,
                        slo_ms=slo_ms, request_id=request_id)
         req.on_shed = on_shed
+        self._mark_audit(req, audit)
         # trace_ctx: inbound (trace_id, parent_span_id) — the HTTP
         # layer's parsed W3C traceparent — parents this request's root
         # span so the caller's trace continues through the engine
@@ -1235,6 +1263,39 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         self._fr_submit(req)
         self._admit()
         return rid
+
+    def _mark_audit(self, req: _Request, audit):
+        """Admission-time correctness-sentinel decision: mark the
+        request for a shadow (rate-sampled) or on-demand (forced) audit.
+        Eligibility is effectively-greedy text decoding — the reference
+        replay IS greedy, so a sampled request has no reference stream
+        to compare against. A forced audit of an ineligible request
+        records a ``skipped`` verdict (typed reason, waitable) instead
+        of silently auditing nothing. Audited requests accumulate
+        chosen-token logprobs so the verdict carries per-position
+        drift."""
+        sn = self.sentinel
+        if audit is False or not sn.enabled:
+            return
+        forced = bool(audit)
+        if not forced and not sn.should_sample():
+            return
+        eff = req.sampling or self._sample_cfg
+        if not sn.auditable or req.pixel_values is not None \
+                or req.encoder_input is not None:
+            if forced:
+                sn.register_forced(req.rid)
+                sn.skip(req.rid, "unsupported", "ondemand", req.ext_id)
+            return
+        if eff[0]:
+            if forced:
+                sn.register_forced(req.rid)
+                sn.skip(req.rid, "sampling", "ondemand", req.ext_id)
+            return
+        req.audit = "ondemand" if forced else "shadow"
+        req.want_logprobs = True
+        if forced:
+            sn.register_forced(req.rid)
 
     def _retry_after_estimate(self) -> float:
         """Backpressure hint for a bounced request: queue depth divided
@@ -1542,6 +1603,10 @@ class ContinuousBatchEngine(_RequestBookkeeping):
                                if req.stop_token_ids else None),
             "want_logprobs": bool(req.want_logprobs),
             "logprobs": [float(x) for x in req.logprobs],
+            # additive: the correctness-sentinel mark migrates with the
+            # stream, so the DESTINATION engine audits the whole stream
+            # end-to-end (the migration-leg audit invariant)
+            "audit": req.audit,
             "priority": int(req.priority),
             "slo_remaining_s": (None if req.deadline == math.inf
                                 else float(req.deadline - now)),
@@ -1624,6 +1689,16 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         req.on_shed = on_shed
         req.tokens = tokens
         req.logprobs = [float(x) for x in handoff.get("logprobs") or []]
+        # the sentinel mark rides the bundle (additive — absent from
+        # pre-audit bundles): a migrated-in stream finishes HERE, so the
+        # audit obligation lands on this engine
+        aud = handoff.get("audit")
+        if aud in ("shadow", "ondemand") and self.sentinel.enabled \
+                and self.sentinel.auditable:
+            req.audit = aud
+            req.want_logprobs = True
+            if aud == "ondemand":
+                self.sentinel.register_forced(rid)
         # resume rides the preemption-restore path: _admit sees
         # req.resume and scatters the KV back, no model forward runs
         req.resume = seal_bundle({
@@ -1732,6 +1807,23 @@ class ContinuousBatchEngine(_RequestBookkeeping):
         if clk is not None:
             clk.lap("sync")
         self._clear_dispatch_guard()  # step success: blame record erased
+        inj = _chaos.active()
+        if inj is not None and "engine.logits" in inj.plan.points():
+            # chaos: one emitted token flipped AFTER the device sync —
+            # the silent-drift drill the correctness sentinel must
+            # catch, and replay_divergence must bisect back to the plan
+            fault = inj.fire("engine.logits")
+            if fault is not None and fault.action == "perturb_logit":
+                s0 = next((s for s, r in enumerate(self._slots)
+                           if r is not None), None)
+                if s0 is not None:
+                    vocab = int(self.model.config.vocab_size)
+                    t_new = (int(toks[s0]) + 1) % vocab
+                    if self.eos_token_id is not None \
+                            and t_new == int(self.eos_token_id):
+                        t_new = (t_new + 1) % vocab
+                    toks = toks.copy()
+                    toks[s0] = t_new
         # np.asarray forced the device->host sync, so the span covers the
         # whole fused dispatch; ONE clock for every token this step
         # produced (they came from one dispatch)

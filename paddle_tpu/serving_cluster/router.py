@@ -43,9 +43,10 @@ from ..observability import timeseries as _ts
 from ..observability import tracing as _tracing
 from ..observability.catalog import ROUTER_PLACEMENTS
 from ..observability.metrics import PROMETHEUS_CONTENT_TYPE, get_registry
-from ..serving_http import (DEADLINE_HEADER, ServingHandlerBase,
-                            alerts_payload, kvstate_payload,
-                            profile_payload, timeseries_payload)
+from ..serving_http import (AUDIT_HEADER, DEADLINE_HEADER,
+                            ServingHandlerBase, alerts_payload,
+                            kvstate_payload, profile_payload,
+                            timeseries_payload)
 from .pool import WorkerInfo, WorkerPool, jittered
 
 __all__ = ["RouterServer"]
@@ -325,6 +326,18 @@ class RouterServer:
         ("prefix_hit_ratio", "cluster_prefix_hit_ratio"),
     )
 
+    # correctness-sentinel scalars federated per replica: the verdict
+    # counters feed the cluster_audit_divergence objective, the drift
+    # gauge feeds the watch_cluster AUDIT sparkline; same zero-I/O
+    # /health-probe transport (kind rides the tuple — counters and a
+    # gauge share the table)
+    _FEDERATED_AUDIT = (
+        ("audit_pass", "cluster_audit_pass", "counter"),
+        ("audit_diverged", "cluster_audit_diverged", "counter"),
+        ("audit_skipped", "cluster_audit_skipped", "counter"),
+        ("audit_drift", "cluster_audit_drift", "gauge"),
+    )
+
     def _collect_cluster(self) -> list:
         """ts-sampler collector: pool/supervisor-derived series. Reads
         ONLY state the pool's own /health probes already hold — a
@@ -347,6 +360,10 @@ class RouterServer:
             for key, series in self._FEDERATED_KV:
                 if key in stats:
                     out.append((series, "gauge", labels,
+                                float(stats.get(key) or 0), None))
+            for key, series, kind in self._FEDERATED_AUDIT:
+                if key in stats:
+                    out.append((series, kind, labels,
                                 float(stats.get(key) or 0), None))
         out.append(("cluster_workers_alive", "gauge", {}, float(alive),
                     None))
@@ -470,6 +487,27 @@ class RouterServer:
                 out["errors"][rid] = f"{type(e).__name__}: {e}"
         return out
 
+    def _cluster_audit(self, query: str) -> dict:
+        """``GET /audit/cluster``: every live worker's /audit fetched and
+        keyed by replica id — the tier-wide sentinel view (who audited,
+        who skipped, whose canaries drifted, where the sealed divergence
+        bundles live). Same contract as the other federations: fetch
+        failures land in ``errors``, never a 5xx."""
+        q = f"?{query}" if query else ""
+        timeout = getattr(self.pool, "_probe_timeout", 2.0)
+        out: dict = {"schema_version": 1, "replicas": {}, "errors": {}}
+        for w in self.pool.workers():
+            if not w["alive"]:
+                continue
+            rid = str(w["replica_id"])
+            try:
+                with urllib.request.urlopen(w["url"] + "/audit" + q,
+                                            timeout=timeout) as r:
+                    out["replicas"][rid] = json.loads(r.read())
+            except (OSError, ValueError) as e:
+                out["errors"][rid] = f"{type(e).__name__}: {e}"
+        return out
+
     def _extra_get(self, handler, route, query) -> bool:
         if route == "/metrics/cluster":
             handler._count(200)
@@ -495,6 +533,16 @@ class RouterServer:
             return True
         if route == "/kvstate/cluster":
             handler._json(200, self._cluster_kvstate(query))
+            return True
+        if route == "/audit":
+            # no engine in the router process — the (empty) local
+            # sentinel view; the federated one is next door
+            from ..observability import sentinel as _sentinel
+
+            handler._json(200, _sentinel.audit_payload())
+            return True
+        if route == "/audit/cluster":
+            handler._json(200, self._cluster_audit(query))
             return True
         return False
 
@@ -670,6 +718,13 @@ class RouterServer:
 
     def _complete(self, handler, req):
         stream = bool(req.get("stream"))
+        # the on-demand audit header survives the router hop as the
+        # equivalent body field (upstream hops carry only the parsed
+        # body; the worker accepts either form — serving_http
+        # AUDIT_HEADER) and so also survives a failover re-placement
+        hdr = (handler.headers.get(AUDIT_HEADER) or "").strip().lower()
+        if hdr in ("1", "true") and "audit" not in req:
+            req = dict(req, audit=True)
         # the request's cluster-wide identity: the client's request_id,
         # or one stamped here — every upstream hop carries it (the
         # engine's deathnote names it), the in-flight journal keys on

@@ -626,9 +626,14 @@ class WorkerSupervisor:
         }
 
     def sweep_incidents(self) -> int:
-        """Index every not-yet-seen incident bundle in ``incident_dir``
-        into ``INDEX.jsonl`` (one line per bundle: file, reason,
-        context, ts, pid, rank) and refresh ``SUPERVISOR.json``.
+        """Index every not-yet-seen incident OR divergence bundle in
+        ``incident_dir`` into ``INDEX.jsonl`` (one line per bundle:
+        file, reason, context, ts, pid, rank) and refresh
+        ``SUPERVISOR.json``. Divergence bundles (written by the
+        correctness sentinel) index with reason ``divergence`` and a
+        context naming the audit source and first diverged position, so
+        the cluster index answers "has ANY worker produced wrong
+        tokens" the same way it answers "has any worker crashed".
         Returns the number of newly indexed bundles."""
         if not self.state_dir:
             return 0
@@ -641,7 +646,8 @@ class WorkerSupervisor:
         index_path = os.path.join(self.state_dir, "INDEX.jsonl")
         lines = []
         for name in names:
-            if (not name.startswith("incident-")
+            is_div = name.startswith("divergence-")
+            if (not (name.startswith("incident-") or is_div)
                     or not name.endswith(".json")):
                 continue
             with self._lock:
@@ -653,8 +659,20 @@ class WorkerSupervisor:
             try:
                 with open(path, encoding="utf-8") as f:
                     b = json.load(f)
-                entry.update({k: b.get(k) for k in
-                              ("reason", "context", "ts", "pid", "rank")})
+                if is_div:
+                    entry.update({
+                        "reason": "divergence",
+                        "context": (f"{b.get('source', '?')} "
+                                    f"rid={b.get('rid')} "
+                                    f"first={b.get('first_divergence')} "
+                                    f"engine={b.get('engine', '?')}"),
+                        "ts": os.path.getmtime(path),
+                        "pid": None, "rank": None,
+                    })
+                else:
+                    entry.update({k: b.get(k) for k in
+                                  ("reason", "context", "ts",
+                                   "pid", "rank")})
             except (OSError, ValueError) as e:
                 entry["error"] = f"{type(e).__name__}: {e}"
             lines.append(json.dumps(entry, default=str))

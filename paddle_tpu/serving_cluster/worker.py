@@ -535,6 +535,9 @@ def run_worker(cfg: dict):
 
     model = build_model(cfg.get("model", {}))
     engine = ContinuousBatchEngine(model, **cfg.get("engine", {}))
+    # the sentinel records the model spec into divergence bundles so
+    # scripts/replay_divergence.py can rebuild the model offline
+    engine.sentinel.model_spec = cfg.get("model", {})
     if injector is not None:
         _chaos.arm_engine(engine, injector)
     if cfg.get("deathnote"):
@@ -559,7 +562,13 @@ def run_worker(cfg: dict):
                                                     30.0)),
                        model_name=cfg.get("model_name", "paddle-tpu"),
                        host=cfg.get("host", "127.0.0.1"),
-                       port=int(cfg.get("port", 0)))
+                       port=int(cfg.get("port", 0)),
+                       # correctness-sentinel knobs (None defers to the
+                       # PDTPU_AUDIT_RATE / PDTPU_CANARY_INTERVAL_S /
+                       # PDTPU_DIVERGENCE_DIR environment)
+                       audit_rate=cfg.get("audit_rate"),
+                       canary_interval_s=cfg.get("canary_interval_s"),
+                       divergence_dir=cfg.get("divergence_dir"))
     srv.start()
     host, port = srv.address
     # lease first, metadata second: the pool only reads metadata for

@@ -34,6 +34,13 @@ for paddle_tpu, stdlib-only (no web framework in the image):
   the incident directory. ``GET /debug/events?since=N`` tails the
   flight-recorder ring incrementally. See docs/SERVING.md "Incident
   forensics".
+- ``GET /audit`` — the correctness sentinel's state (verdict counts,
+  skip reasons, canary fingerprint, recent verdicts, sealed divergence
+  bundles). ``POST /v1/completions`` accepts an ``X-Audit: 1`` header
+  or body ``audit=true`` for a GUARANTEED shadow audit whose verdict
+  block rides the response next to ``usage``; sampled shadow audits
+  and pinned canary probes run on the named audit-worker thread. See
+  docs/SERVING.md "Correctness sentinel".
 
 Single-engine-thread design: device state (page pool, slot buffers) is
 touched ONLY by the engine thread; HTTP handler threads enqueue
@@ -51,8 +58,10 @@ behind it.
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -64,13 +73,14 @@ from .observability import PROMETHEUS_CONTENT_TYPE, get_registry
 from .observability import flightrecorder as _frec
 from .observability import kvatlas as _kvatlas
 from .observability import perf as _perf
+from .observability import sentinel as _sentinel
 from .observability import tracing as _tracing
 from .observability.catalog import HTTP_REQUESTS
 from .serving import DeadlineExceeded, QueueFull
 
 __all__ = ["CompletionServer", "ServingHandlerBase", "serve",
-           "DEADLINE_HEADER", "timeseries_payload", "alerts_payload",
-           "profile_payload", "kvstate_payload"]
+           "DEADLINE_HEADER", "AUDIT_HEADER", "timeseries_payload",
+           "alerts_payload", "profile_payload", "kvstate_payload"]
 
 #: end-to-end deadline propagation: the cluster router stamps each
 #: upstream hop with the request's REMAINING budget in milliseconds, so
@@ -85,7 +95,22 @@ _KNOWN_ROUTES = ("/health", "/metrics", "/metrics/cluster", "/v1/models",
                  "/v1/completions", "/v1/prefill", "/trace",
                  "/trace/chrome", "/debug/dump", "/debug/events",
                  "/timeseries", "/alerts", "/profile", "/profile/cluster",
-                 "/kvstate", "/kvstate/cluster")
+                 "/kvstate", "/kvstate/cluster", "/audit", "/audit/cluster")
+
+#: ``X-Audit: 1`` on a completions POST forces a shadow audit of that
+#: request (the on-demand contract): the response's ``audit`` block
+#: carries the verdict. Equivalent to body ``audit=true``.
+AUDIT_HEADER = "X-Audit"
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
 
 
 def timeseries_payload(query: str) -> dict:
@@ -464,7 +489,10 @@ class CompletionServer:
                  enable_tracing: bool = True,
                  enable_flight_recorder: bool = True,
                  enable_timeseries: bool = True,
-                 ts_interval_s: Optional[float] = None):
+                 ts_interval_s: Optional[float] = None,
+                 audit_rate: Optional[float] = None,
+                 canary_interval_s: Optional[float] = None,
+                 divergence_dir: Optional[str] = None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
@@ -503,6 +531,24 @@ class CompletionServer:
         atlas = getattr(engine, "kvatlas", None)
         if atlas is not None:
             atlas.enable()
+        # and /audit: the correctness sentinel wakes with the front-end.
+        # audit_rate=0.0 (the default) still serves the on-demand
+        # X-Audit contract — only SAMPLED shadow audits are off; the env
+        # knobs let the cluster launcher arm sampling/canaries without
+        # plumbing kwargs through every process entry
+        self._sentinel = getattr(engine, "sentinel", None)
+        if self._sentinel is not None:
+            if audit_rate is None:
+                audit_rate = _env_float("PDTPU_AUDIT_RATE")
+            if canary_interval_s is None:
+                canary_interval_s = _env_float("PDTPU_CANARY_INTERVAL_S")
+            if divergence_dir is None:
+                divergence_dir = os.environ.get("PDTPU_DIVERGENCE_DIR")
+            self._sentinel.enable(audit_rate=audit_rate,
+                                  canary_interval_s=canary_interval_s,
+                                  divergence_dir=divergence_dir)
+            self._sentinel.submitter = self._canary_submit
+            self._sentinel.start()
         self._subs: "queue.Queue[_Submission]" = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._engine_loop,  # pdlint: disable=error-thread-escape -- deliberate crash boundary: incident_scope writes the forensics bundle and the death is VISIBLE (waiters time out against _stop, /health degrades)
@@ -523,6 +569,10 @@ class CompletionServer:
 
     def close(self):
         self._stop.set()
+        if self._sentinel is not None:
+            # stop the audit worker FIRST: a canary submitted after the
+            # engine loop exits would wait out its full timeout
+            self._sentinel.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=30)
@@ -735,7 +785,40 @@ class CompletionServer:
         if route == "/kvstate":
             handler._json(200, kvstate_payload(query))
             return True
+        if route == "/audit":
+            handler._json(200, _sentinel.audit_payload())
+            return True
         return False
+
+    def _canary_submit(self, ids, max_new):
+        """Sentinel-injected canary runner (audit-worker thread): the
+        pinned prompt rides the REAL submission path — engine thread,
+        live decode, every feature under test — with its own audit off;
+        the sentinel compares against the pinned baseline itself.
+        Returns (tokens, logprobs), or None when the engine can't take
+        it right now (canaries only ever spend idle capacity)."""
+        if self._stop.is_set():
+            return None
+        sub = _Submission([int(t) for t in np.asarray(ids).reshape(-1)],
+                          dict(max_new_tokens=int(max_new), audit=False,
+                               logprobs=True))
+        self._subs.put(sub)
+        toks, lps = [], []
+        deadline = time.time() + 60.0
+        while True:
+            try:
+                kind, payload, done = sub.events.get(timeout=1.0)
+            except queue.Empty:
+                if self._stop.is_set() or time.time() > deadline:
+                    return None
+                continue
+            if kind != "token":
+                return None     # busy/shed/error: defer, never crash
+            _rid, tok, lp = payload
+            toks.append(int(tok))
+            lps.append(float(lp))
+            if done:
+                return toks, lps
 
     def _post_handler(self, route):
         return self._complete if route == "/v1/completions" else None
@@ -821,6 +904,13 @@ class CompletionServer:
         except (ValueError, TypeError) as e:
             # wrong-typed fields answer 400, not a dropped socket
             return handler._json(400, {"error": str(e)})
+        # the on-demand audit contract: X-Audit: 1 (or body audit=true)
+        # guarantees a shadow audit whose verdict block rides the
+        # response next to usage — docs/SERVING.md "Correctness sentinel"
+        hdr = (handler.headers.get(AUDIT_HEADER) or "").strip().lower()
+        want_audit = bool(req.get("audit")) or hdr in ("1", "true")
+        if want_audit:
+            params["audit"] = True
         err = apply_deadline_header(handler, params)
         if err is not None:
             return handler._json(*err)
@@ -831,11 +921,14 @@ class CompletionServer:
         self._subs.put(sub)
         cid = f"cmpl-{uuid.uuid4().hex[:24]}"
         if req.get("stream"):
-            return self._stream(handler, sub, cid, want_logprobs)
-        return self._collect(handler, sub, cid, len(ids), want_logprobs)
+            return self._stream(handler, sub, cid, want_logprobs,
+                                want_audit=want_audit)
+        return self._collect(handler, sub, cid, len(ids), want_logprobs,
+                             want_audit=want_audit)
 
     def _collect(self, handler, sub, cid, n_prompt, want_logprobs,
-                 prior_tokens=None, prior_logprobs=None):
+                 prior_tokens=None, prior_logprobs=None,
+                 want_audit=False):
         """Batch (non-stream) response: wait for every token event, then
         answer one completion object. ``prior_tokens``/``prior_logprobs``
         prepend a migrated-in request's already-generated tokens (the
@@ -921,12 +1014,38 @@ class CompletionServer:
                  "completion_tokens": total_completion,
                  "total_tokens": n_prompt + total_completion}
         usage.update(self._usage_extras(sub.rids))
-        return handler._json(200, {
+        body = {
             "id": cid, "object": "text_completion",
             "model": self.model_name,
             "choices": choices,
             "usage": usage,
-        })
+        }
+        if want_audit:
+            body["audit"] = self._audit_block(sub.rids)
+        return handler._json(200, body)
+
+    def _audit_block(self, rids) -> dict:
+        """The ``audit`` response field of a force-audited request:
+        block (bounded) for each rid's verdict and report the worst —
+        diverged beats skipped beats pass. An on-demand audit is never
+        silently absent: a disabled sentinel or a timed-out wait still
+        answers a typed ``skipped`` verdict."""
+        sn = self._sentinel
+        if sn is None or not sn.enabled:
+            return {"verdict": "skipped", "reason": "disabled"}
+        vs = [v for v in (sn.wait_verdict(r) for r in rids)
+              if v is not None]
+        if not vs:
+            return {"verdict": "skipped", "reason": "timeout"}
+        worst = next((v for v in vs if v["verdict"] == "diverged"),
+                     next((v for v in vs if v["verdict"] == "skipped"),
+                          vs[0]))
+        out = {k: worst.get(k)
+               for k in ("verdict", "reason", "source",
+                         "first_divergence", "logprob_drift")}
+        if worst.get("bundle"):
+            out["bundle"] = worst["bundle"]
+        return out
 
     def _usage_extras(self, rids) -> dict:
         """Per-request cost accounting from the engine's retention
@@ -949,7 +1068,8 @@ class CompletionServer:
                 n_tok / disp if disp else 0.0, 4),
         }
 
-    def _stream(self, handler, sub, cid, want_logprobs=False):
+    def _stream(self, handler, sub, cid, want_logprobs=False,
+                want_audit=False):
         # the SSE status line is DEFERRED to the first event: a rejected
         # admission (bounded queue -> 429 + Retry-After) or a client
         # error (-> 400) still gets a real status code instead of an
@@ -1061,6 +1181,11 @@ class CompletionServer:
                                              + n_tok)}
                         piece["usage"].update(
                             self._usage_extras(sub.rids))
+                    if want_audit:
+                        # the final usage chunk carries the on-demand
+                        # audit verdict, same shape as the non-stream
+                        # response's audit field
+                        piece["audit"] = self._audit_block(sub.rids)
                 handler._chunk(b"data: " + json.dumps(piece).encode()
                                + b"\n\n")
                 if done:

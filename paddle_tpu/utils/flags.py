@@ -9,8 +9,10 @@ launch.
 """
 from __future__ import annotations
 
+import contextlib
 import os
-from typing import Any, Dict, Optional
+import threading
+from typing import Any, Dict, Iterator, Optional
 
 
 class _Flag:
@@ -32,6 +34,18 @@ class _Flag:
 
 _REGISTRY: Dict[str, _Flag] = {}
 
+# thread-local flag overlay: a reader sees its own overrides ON TOP of
+# the global registry, without mutating it. Flags are read at trace time
+# and jax traces on the calling thread, so an audit/replay thread can
+# retrace the reference path (fused tail off) while the engine thread's
+# traces keep seeing the live flag values — flipping the global would
+# race every concurrent trace.
+_TLS = threading.local()
+
+
+def _overrides() -> Dict[str, Any]:
+    return getattr(_TLS, "overrides", None) or {}
+
 
 def define_flag(name: str, default: Any, help_str: str = "") -> None:
     if not name.startswith("FLAGS_"):
@@ -42,16 +56,39 @@ def define_flag(name: str, default: Any, help_str: str = "") -> None:
 
 def get_flags(name: Optional[object] = None) -> Dict[str, Any]:
     """paddle.get_flags parity: str or list of str → {name: value}."""
+    ov = _overrides()
     if name is None:
-        return {k: f.value for k, f in _REGISTRY.items()}
+        return {k: ov.get(k, f.value) for k, f in _REGISTRY.items()}
     names = [name] if isinstance(name, str) else list(name)
     out = {}
     for n in names:
         key = n if n.startswith("FLAGS_") else "FLAGS_" + n
         if key not in _REGISTRY:
             raise ValueError(f"unknown flag {n!r}")
-        out[n] = _REGISTRY[key].value
+        out[n] = ov.get(key, _REGISTRY[key].value)
     return out
+
+
+@contextlib.contextmanager
+def flag_overrides(d: Dict[str, Any]) -> Iterator[None]:
+    """Override flags for THIS THREAD only, for the duration of the
+    with-block. Unknown flag names raise up front (same contract as
+    set_flags); values are coerced through the flag's parser. Nesting
+    stacks — the inner block wins, the outer overlay is restored on
+    exit."""
+    layer = {}
+    for n, v in d.items():
+        key = n if n.startswith("FLAGS_") else "FLAGS_" + n
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {n!r}")
+        f = _REGISTRY[key]
+        layer[key] = f._parse(v) if isinstance(v, str) else f.typ(v)
+    prev = getattr(_TLS, "overrides", None)
+    _TLS.overrides = dict(prev or {}, **layer)
+    try:
+        yield
+    finally:
+        _TLS.overrides = prev
 
 
 def set_flags(d: Dict[str, Any]) -> None:
@@ -65,8 +102,11 @@ def set_flags(d: Dict[str, Any]) -> None:
 
 
 def flag(name: str) -> Any:
-    """Fast internal read."""
+    """Fast internal read (honors the thread-local overlay)."""
     key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    ov = getattr(_TLS, "overrides", None)
+    if ov and key in ov:
+        return ov[key]
     return _REGISTRY[key].value
 
 

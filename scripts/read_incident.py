@@ -454,6 +454,45 @@ def format_kvstate(b: dict) -> List[str]:
     return lines
 
 
+def format_audit(b: dict) -> List[str]:
+    """The AUDIT section: the correctness sentinel's verdict counters,
+    canary state and recent divergences at dump time (absent for
+    bundles written before the ``audit`` key existed, or from
+    processes without serving engines)."""
+    audit = b.get("audit")
+    if not audit:
+        return []
+    lines = ["AUDIT (correctness sentinel at dump time)"]
+    for name, s in sorted((audit.get("engines") or {}).items()):
+        v = s.get("verdicts") or {}
+        lines.append(
+            f"  [{name}] {'enabled' if s.get('enabled') else 'DISABLED'}"
+            f" rate={s.get('audit_rate')}: {v.get('pass', 0)} pass / "
+            f"{v.get('diverged', 0)} DIVERGED / "
+            f"{v.get('skipped', 0)} skipped, "
+            f"drift {s.get('logprob_drift_last', 0.0):.3g}")
+        skips = s.get("skip_reasons") or {}
+        if skips:
+            lines.append("    skips: " + ", ".join(
+                f"{k}={n}" for k, n in sorted(skips.items())))
+        can = s.get("canary") or {}
+        if can.get("fingerprint"):
+            lines.append(
+                f"    canary: {can.get('runs', 0)} runs every "
+                f"{can.get('interval_s')}s, {can.get('deferred', 0)} "
+                f"deferred, fingerprint {str(can['fingerprint'])[:12]}")
+        for r in (s.get("recent") or [])[-5:]:
+            if r.get("verdict") != "diverged":
+                continue
+            lines.append(
+                f"    DIVERGED rid {r.get('rid')} ({r.get('source')}): "
+                f"first at position {r.get('first_divergence')}, "
+                f"drift {r.get('drift', 0.0):.3g}")
+        for p in list(s.get("divergence_paths") or [])[-3:]:
+            lines.append(f"    bundle: {p}")
+    return lines
+
+
 def format_spans(b: dict, last: int = 10) -> List[str]:
     spans = b.get("spans") or []
     if not spans:
@@ -482,6 +521,7 @@ def render(b: dict, events: int = 30, per_subsystem: int = 5,
             format_chaos(b),
             format_engines(b),
             format_kvstate(b),
+            format_audit(b),
             format_spans(b),
             format_lock_witness(b),
             format_threads(b),

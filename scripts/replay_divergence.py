@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""replay_divergence: offline forensics for a sealed divergence bundle.
+
+A divergence bundle (see docs/SERVING.md "Correctness sentinel") is
+written when a shadow audit or canary probe catches the serving engine
+emitting tokens that the reference decode path would not have produced.
+This tool re-runs the recorded request offline and answers the two
+questions an on-call engineer actually has:
+
+  1. does it still diverge? (``reference`` and ``diverged`` repro lines)
+  2. WHICH feature is to blame? — the replay bisects over the feature
+     set that was active at capture time (fused tail, speculation,
+     chunked prefill, prefix cache, chaos plan), re-running with each
+     feature enabled alone and blaming every one that independently
+     reproduces a divergence.
+
+Usage:
+    python scripts/replay_divergence.py divergence-....json
+    python scripts/replay_divergence.py divergence-....json --model spec.json
+    python scripts/replay_divergence.py divergence-....json --json
+
+The model is rebuilt from the bundle's recorded ``model_spec`` (workers
+stamp their cfg["model"] into every bundle); ``--model`` overrides it
+with a JSON spec file for bundles captured before the spec was recorded
+or when replaying against a patched checkpoint.
+
+Exit status: 0 when the replay ran and produced a blame verdict, 2 when
+the divergence did NOT reproduce (the report still prints — a vanished
+divergence is itself a finding), 1 on load/seal/model errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_model(bundle: dict, spec_path: str | None):
+    sys.path.insert(0, _REPO)
+    from paddle_tpu.serving_cluster.worker import build_model
+
+    if spec_path:
+        with open(spec_path, encoding="utf-8") as f:
+            spec = json.load(f)
+    else:
+        spec = bundle.get("model_spec")
+        if not spec:
+            raise SystemExit("bundle records no model_spec; pass --model "
+                             "with a JSON model spec (same shape as the "
+                             "worker cfg[\"model\"] section)")
+    return build_model(spec)
+
+
+def format_report(report: dict) -> list:
+    feats = report.get("features") or []
+    lines = [
+        "=" * 72,
+        "DIVERGENCE REPLAY",
+        "=" * 72,
+        f"features at capture : {', '.join(feats) if feats else '(none)'}",
+        f"reference reproduced: {report.get('ref_reproduced')}",
+        f"divergence reproduced: {report.get('diverged_reproduced')}",
+        f"first divergence    : recorded="
+        f"{report.get('first_divergence_recorded')} "
+        f"replayed={report.get('first_divergence_replayed')}",
+    ]
+    blame = report.get("blame") or []
+    lines.append(f"blame               : "
+                 f"{' + '.join(blame) if blame else '(none — vanished)'}")
+    runs = report.get("runs") or []
+    if runs:
+        lines.append("-" * 72)
+        lines.append("bisection runs:")
+        for r in runs:
+            on = ", ".join(r.get("features") or []) or "(baseline)"
+            lines.append(f"  [{on:<40s}] diverged={r.get('diverged')} "
+                         f"first={r.get('first_divergence')}")
+    lines.append("=" * 72)
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="replay + flag-bisect a sealed divergence bundle")
+    ap.add_argument("bundle", help="divergence-*.json written by the "
+                                   "correctness sentinel")
+    ap.add_argument("--model", default=None, metavar="SPEC.json",
+                    help="JSON model spec overriding the bundle's "
+                         "recorded model_spec")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw replay report as JSON")
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, _REPO)
+    from paddle_tpu.observability import sentinel
+
+    bundle = sentinel.load_bundle(args.bundle)  # seal + schema verified
+    model = _build_model(bundle, args.model)
+    report = sentinel.replay_bundle(
+        bundle, model, log=None if args.as_json else print)
+    if args.as_json:
+        print(json.dumps(report, indent=1, sort_keys=True, default=str))
+    else:
+        for line in format_report(report):
+            print(line)
+    return 0 if report.get("diverged_reproduced") else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,17 @@ MEM_METRICS = (
     ("serving_prefix_hit_ratio", "%.3f"),
 )
 
+#: audit panel series (same shape as PERF_METRICS): the correctness
+#: sentinel's federated per-replica counters/drift gauge — a non-zero
+#: cluster_audit_diverged strip is the dashboard's "the model is
+#: WRONG" signal, distinct from every load/latency panel above it
+AUDIT_METRICS = (
+    ("cluster_audit_pass", "%.0f"),
+    ("cluster_audit_diverged", "%.0f"),
+    ("cluster_audit_skipped", "%.0f"),
+    ("cluster_audit_drift", "%.3g"),
+)
+
 
 def _get(url: str, timeout: float = 5.0):
     with urllib.request.urlopen(url, timeout=timeout) as r:
@@ -211,6 +222,21 @@ def render(snap: dict, metrics) -> str:
         lines.append("MEM  (KV pool occupancy & prefix reuse — see "
                      "GET /kvstate for the per-slot ledger)")
         lines.extend(mem_rows)
+    # ---- audit panel: correctness sentinel ----------------------------
+    audit_rows = []
+    for metric, fmt in AUDIT_METRICS:
+        for s in series_windows(ts, metric):
+            if not s["values"]:
+                continue
+            label = f"{metric}{{{s['labels']}}}" if s["labels"] \
+                else metric
+            audit_rows.append(
+                f"  {label:<52} {sparkline(s['values'])} "
+                f"last={fmt % s['last']}")
+    if audit_rows:
+        lines.append("AUDIT  (shadow audits & canary probes — see "
+                     "GET /audit/cluster for verdicts and bundles)")
+        lines.extend(audit_rows)
     # ---- sparklines ---------------------------------------------------
     if ts.get("error"):
         lines.append(f"TIMESERIES  unavailable ({ts['error']})")

@@ -1,0 +1,269 @@
+"""Correctness sentinel (paddle_tpu.observability.sentinel): shadow
+audits on the reference decode path, typed skip verdicts, the injected-
+divergence drill (chaos -> sealed bundle -> alert -> offline replay with
+flag bisection), canary probes, the federated stats contract, and the
+< 1% enabled-overhead gate. See docs/SERVING.md "Correctness sentinel".
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.chaos import inject as chaos
+from paddle_tpu.chaos.plan import Fault, FaultPlan
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import alerts as al
+from paddle_tpu.observability import flightrecorder as frec
+from paddle_tpu.observability import sentinel
+from paddle_tpu.observability import timeseries as ts
+from paddle_tpu.serving import ContinuousBatchEngine, HandoffCorrupt
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=2))
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    return ContinuousBatchEngine(model, **kw)
+
+
+def _wait_counts(sn, want, timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        fed = sn.federated()
+        if (fed["audit_pass"] + fed["audit_diverged"]
+                + fed["audit_skipped"]) >= want:
+            return fed
+        time.sleep(0.01)
+    raise AssertionError(f"audits never drained: {sn.federated()}")
+
+
+# ---- shadow audits ----------------------------------------------------------
+
+def test_shadow_audit_clean_run_all_pass(tiny_model):
+    """A clean greedy run at audit_rate=1.0: every finished request is
+    replayed on the reference path and passes token-for-token; logprob
+    drift is float-noise scale; zero divergence bundles are sealed."""
+    eng = _engine(tiny_model)
+    sn = eng.sentinel
+    assert sn.auditable and not sn.enabled    # engine opts in, off by default
+    sn.enable(audit_rate=1.0)
+    sn.start()
+    rec = frec.get_recorder()
+    was = rec.enabled
+    rec.enable()
+    try:
+        since = rec.stats()["recorded"]
+        rng = np.random.RandomState(3)
+        rids = [eng.add_request(rng.randint(1, 512, (5 + i,)),
+                                max_new_tokens=6) for i in range(2)]
+        eng.run_until_done()
+        _wait_counts(sn, 2)       # shadow audits drain asynchronously
+        verdicts = [sn.wait_verdict(r) for r in rids]
+        assert all(v is not None for v in verdicts), verdicts
+        assert [v["verdict"] for v in verdicts] == ["pass", "pass"]
+        for v in verdicts:
+            assert v["source"] == "shadow"
+            assert v["first_divergence"] is None
+            assert v["logprob_drift"] < 1e-4   # fused-vs-reference noise
+        assert not sn.divergence_bundles()
+        st = eng.stats()
+        assert st["audit_pass"] == 2.0
+        assert st["audit_diverged"] == 0.0
+        kinds = [e["kind"] for e in rec.events(since=since, kind="audit")]
+        assert kinds.count("audit.pass") == 2
+    finally:
+        sn.stop()
+        if not was:
+            rec.disable()
+
+
+def test_forced_audit_of_sampled_request_skips_typed(tiny_model):
+    """The on-demand contract for an ineligible request: a sampled
+    request has no greedy reference stream, so audit=True records a
+    waitable ``skipped`` verdict with reason ``sampling`` — typed,
+    never silent."""
+    eng = _engine(tiny_model)
+    sn = eng.sentinel
+    sn.enable(audit_rate=0.0)
+    sn.start()
+    try:
+        rid = eng.add_request(np.arange(1, 7), max_new_tokens=4,
+                              do_sample=True, temperature=0.9, audit=True)
+        v = sn.wait_verdict(rid, timeout=30.0)   # skipped at ADMISSION
+        assert v is not None
+        assert v["verdict"] == "skipped"
+        assert v["reason"] == "sampling"
+        assert v["source"] == "ondemand"
+        eng.run_until_done()                     # the request still runs
+        assert sn.federated()["audit_skipped"] == 1.0
+        assert sn.payload()["skip_reasons"] == {"sampling": 1}
+    finally:
+        sn.stop()
+
+
+# ---- the injected-divergence drill ------------------------------------------
+
+def test_divergence_drill_bundle_alert_and_replay_bisection(
+        tiny_model, tmp_path):
+    """THE acceptance drill: a chaos plan perturbs ONE emitted token;
+    the audit catches it (first_divergence at the perturbed position),
+    seals EXACTLY one checksummed divergence bundle, the
+    ``audit_divergence`` objective fires off the metric increase, and
+    the offline replay reproduces both streams and bisects blame back
+    to the chaos plan."""
+    store = ts.TimeSeriesStore(registry=None)
+    store.enable()
+    store.sample_once()
+    plan = FaultPlan(seed=0, faults=[
+        Fault("engine.logits", "perturb_logit", nth=2)])
+    chaos.install(plan, scope="worker:0")
+    eng = _engine(tiny_model)
+    sn = eng.sentinel
+    sn.enable(audit_rate=0.0, divergence_dir=str(tmp_path))
+    sn.start()
+    try:
+        rid = eng.add_request(np.arange(1, 8), max_new_tokens=6,
+                              audit=True)
+        eng.run_until_done()
+        v = sn.wait_verdict(rid, timeout=120.0)
+        assert v is not None and v["verdict"] == "diverged", v
+        assert v["first_divergence"] == 1      # nth=2 flips step 2's token
+        assert v["source"] == "ondemand"
+        assert v.get("bundle"), v
+    finally:
+        sn.stop()
+        chaos.uninstall()
+
+    # exactly ONE sealed bundle on disk; load re-verifies the checksum
+    files = sorted(p for p in os.listdir(tmp_path)
+                   if p.startswith("divergence-") and p.endswith(".json"))
+    assert len(files) == 1, files
+    path = os.path.join(tmp_path, files[0])
+    bundle = sentinel.load_bundle(path)
+    assert bundle["first_divergence"] == 1
+    assert bundle["chaos"] is not None
+    assert bundle["config"]["max_len"] == 64
+
+    # the alert objective fires off the counter increase
+    store.sample_once()
+    objs = al.default_objectives()
+    mgr = al.AlertManager(
+        store, {"audit_divergence": objs["audit_divergence"]}, name="sn")
+    mgr.evaluate()
+    assert mgr.firing() == ["audit_divergence"]
+
+    # a flipped byte is HandoffCorrupt, not a wrong-answer replay
+    with open(path) as f:
+        raw = json.load(f)
+    raw["live_tokens"][0] = int(raw["live_tokens"][0]) + 1
+    tampered = os.path.join(tmp_path, "tampered.json")
+    with open(tampered, "w") as f:
+        json.dump(raw, f)
+    with pytest.raises(HandoffCorrupt):
+        sentinel.load_bundle(tampered)
+
+    # offline replay: both streams reproduce, bisection blames the plan
+    report = sentinel.replay_bundle(bundle, tiny_model)
+    assert report["ref_reproduced"] is True
+    assert report["diverged_reproduced"] is True
+    assert report["blame"] == ["chaos"]
+    assert report["first_divergence_replayed"] == 1
+
+
+# ---- canary probes ----------------------------------------------------------
+
+def test_canary_probes_pin_baseline_and_pass(tiny_model):
+    """Canaries pin expected outputs once per (config, flag-set)
+    fingerprint and re-verify through the injected submitter; a clean
+    engine passes every probe and the fingerprint is visible."""
+    eng = _engine(tiny_model)
+    sn = eng.sentinel
+    sn.enable(n_canaries=2, canary_prompt_len=4, canary_max_new=4)
+    sn.submitter = lambda ids, mnew: sentinel.reference_decode(
+        eng.model, ids, mnew, eng.eos_token_id, None)
+    results = sn.run_canaries()
+    assert len(results) == 2
+    assert all(r["verdict"] == "pass" for r in results)
+    pay = sn.payload()
+    assert pay["canary"]["runs"] == 1
+    assert pay["canary"]["fingerprint"]
+    fp = pay["canary"]["fingerprint"]
+    # a canary-config change re-baselines: the fingerprint moves
+    sn.enable(n_canaries=1, canary_max_new=5)
+    sn.run_canaries()
+    assert sn.payload()["canary"]["fingerprint"] != fp
+
+
+# ---- surfaces: stats, federation, alerts, incident bundles ------------------
+
+def test_federated_keys_alert_objectives_and_incident_section(
+        tiny_model, tmp_path):
+    """The contract the router/alerts/forensics surfaces pin: stats()
+    always carries the audit scalars (even disabled), the objectives
+    are registered on both sides, the federated series are declared,
+    and incident bundles grow the additive ``audit`` section."""
+    eng = _engine(tiny_model)
+    st = eng.stats()
+    for key in ("audit_pass", "audit_diverged", "audit_skipped",
+                "audit_drift"):
+        assert st[key] == 0.0
+    assert "audit_divergence" in al.default_objectives()
+    assert "cluster_audit_divergence" in al.cluster_objectives()
+    assert {"cluster_audit_pass", "cluster_audit_diverged",
+            "cluster_audit_skipped",
+            "cluster_audit_drift"} <= set(al.FEDERATED_SERIES)
+    # GET /audit document shape
+    pay = sentinel.audit_payload()
+    assert pay["schema_version"] == 1
+    assert eng.sentinel.engine in pay["engines"]
+    # incident bundles carry it (additive-optional: validate accepts
+    # both presence and absence)
+    rep = frec.IncidentReporter(str(tmp_path))
+    b = rep.bundle("sentinel_test")
+    frec.validate_bundle(b)
+    assert b["audit"] is not None
+    assert eng.sentinel.engine in b["audit"]["engines"]
+    stripped = dict(b)
+    del stripped["audit"]
+    frec.validate_bundle(stripped)           # pre-audit bundles still load
+
+
+# ---- the < 1% overhead gate -------------------------------------------------
+
+def test_sentinel_overhead_under_one_percent_of_decode_step(tiny_model):
+    """The enabled sentinel's cost on an UNAUDITED request — the
+    admission-time sampling decision plus the finish-path guard — must
+    stay under 1% of a real decode step."""
+    eng = _engine(tiny_model)
+    eng.profiler.enable()
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        eng.add_request(rng.randint(1, 512, (5 + i,)), 12)
+    eng.run_until_done()
+    step_p50_ms = eng.profiler.payload()["step_ms"]["p50"]
+    assert step_p50_ms > 0
+
+    sn = eng.sentinel
+    sn.enable(audit_rate=0.0)
+    # min over rounds: a scheduler preemption inflates a mean but not
+    # the best round (the kvatlas/profiler gate convention)
+    rounds, per = 10, 200
+    over_ms = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(per):
+            eng._mark_audit(None, None)       # admission decision
+            sn.should_sample()                # the finish-path gate
+        over_ms = min(over_ms, (time.perf_counter() - t0) * 1e3 / per)
+    assert over_ms < 0.01 * step_p50_ms, (
+        f"sentinel overhead {over_ms * 1e3:.2f}us is "
+        f">= 1% of a {step_p50_ms:.3f}ms decode step")

@@ -361,6 +361,73 @@ def test_export_slot_admit_migrated_token_identical():
             rec.disable()
 
 
+def test_migrated_stream_audits_end_to_end_on_destination():
+    """The migration-leg audit invariant: the sentinel mark rides the
+    migrate bundle, the audit obligation lands on the DESTINATION (where
+    the stream finishes), and the destination's reference replay covers
+    the WHOLE stream — source-generated tokens included — so a
+    migration that corrupted the hop would diverge, not escape."""
+    model = _ref_model()
+    prompt = np.random.RandomState(12).randint(1, 512, (9,)).tolist()
+    src = ContinuousBatchEngine(model, max_batch=2, max_len=64,
+                                page_size=8)
+    dst = ContinuousBatchEngine(model, max_batch=2, max_len=64,
+                                page_size=8)
+    src.sentinel.enable(audit_rate=0.0)
+    dst.sentinel.enable(audit_rate=0.0)
+    dst.sentinel.start()
+    try:
+        rid = src.add_request(prompt, max_new_tokens=8, audit=True)
+        for _ in range(4):
+            src.step()
+        bundle = src.export_slot(rid)
+        assert bundle["audit"] == "ondemand"   # the mark survives the hop
+        rid2 = dst.admit_migrated(bundle)
+        dst.run_until_done()
+        v = dst.sentinel.wait_verdict(rid2, timeout=120.0)
+        assert v is not None, dst.sentinel.payload()
+        assert v["verdict"] == "pass", v
+        assert v["source"] == "ondemand"
+        assert v["n_tokens"] == 8              # prior + new tokens audited
+        assert dst.sentinel.federated()["audit_pass"] == 1.0
+        assert src.sentinel.federated()["audit_pass"] == 0.0
+    finally:
+        dst.sentinel.stop()
+
+
+def test_preempted_restored_stream_audits_end_to_end():
+    """The preemption-leg audit invariant: a victim that round-tripped
+    through host memory (preempt -> restore) keeps its on-demand audit
+    mark and its accumulated logprobs, and the post-restore finish
+    audits the WHOLE stream against the reference path — the PR-10
+    token-identity invariant checked by the live sentinel, not just the
+    example-based scheduler tests."""
+    model = _ref_model()
+    rng = np.random.RandomState(4)
+    short_p = rng.randint(1, 512, (5,))
+    long_p = rng.randint(1, 512, (41,))
+    eng = ContinuousBatchEngine(model, max_batch=1, max_len=64,
+                                page_size=8, enable_preemption=True)
+    sn = eng.sentinel
+    sn.enable(audit_rate=0.0)
+    sn.start()
+    try:
+        victim = eng.add_request(short_p, max_new_tokens=12, priority=2,
+                                 audit=True)
+        for _ in range(3):
+            eng.step()                      # victim has generated tokens
+        eng.add_request(long_p, max_new_tokens=6, priority=0)
+        eng.run_until_done()
+        assert eng.stats()["requests_preempted"] == 1
+        v = sn.wait_verdict(victim, timeout=120.0)
+        assert v is not None, sn.payload()
+        assert v["verdict"] == "pass", v
+        assert v["source"] == "ondemand"
+        assert v["n_tokens"] == 12          # pre- and post-preempt tokens
+    finally:
+        sn.stop()
+
+
 def test_nonstream_completion_survives_drain_with_prior_tokens():
     """Non-stream drain path, in-process: worker A answers
     ``{"migrated": ...}`` for a request mid-collect; the router
